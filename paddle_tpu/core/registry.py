@@ -1,0 +1,75 @@
+"""Op registry: op type -> (jax lowering, shape inference, grad maker).
+
+Replaces the reference's static-registrar macro system
+(reference: paddle/fluid/framework/op_registry.h:127-196 REGISTER_OPERATOR /
+REGISTER_OP / REGISTER_OP_*_KERNEL and op_info.h OpInfoMap). Where the
+reference registers per-(place, dtype, layout, library) kernels, here one jax
+lowering serves all places — XLA does the per-backend codegen — so the
+"kernel" axis collapses to a single ``lower`` function, optionally shadowed by
+a Pallas implementation for hot ops.
+
+Gradients: ops may register an explicit ``grad`` maker (emitting grad OpDescs
+like the reference's GradOpDescMaker, op_registry.h:148), but the default is
+the *generic vjp* maker — the grad op replays the forward lowering under
+``jax.vjp``. This is the TPU-native answer to the reference's hand-written
+grad kernels: XLA differentiates the same code path it compiles.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+_REGISTRY: Dict[str, "OpDef"] = {}
+
+
+class OpDef(object):
+    __slots__ = ("type", "lower", "infer_shape", "grad_maker", "host",
+                 "stateful_outputs", "custom_grad_lower", "no_gradient")
+
+    def __init__(self, type, lower=None, infer_shape=None, grad_maker=None,
+                 host=False, stateful_outputs=(), no_gradient=False):
+        self.type = type
+        self.lower = lower
+        self.infer_shape = infer_shape
+        self.grad_maker = grad_maker          # fn(op, block, grad_map) -> [Operator descs]
+        self.host = host                      # must run eagerly on host (save/load/py_func)
+        self.stateful_outputs = tuple(stateful_outputs)  # output slots aliasing inputs (in-place state)
+        self.no_gradient = no_gradient
+
+
+def register_op(type, infer_shape=None, grad_maker=None, host=False,
+                stateful_outputs=(), no_gradient=False):
+    """Decorator registering ``fn`` as the jax lowering for op ``type``."""
+
+    def deco(fn):
+        _REGISTRY[type] = OpDef(type, lower=fn, infer_shape=infer_shape,
+                                grad_maker=grad_maker, host=host,
+                                stateful_outputs=stateful_outputs,
+                                no_gradient=no_gradient)
+        return fn
+
+    return deco
+
+
+def set_grad_maker(type, maker):
+    lookup_checked(type).grad_maker = maker
+
+
+def set_infer_shape(type, fn):
+    lookup_checked(type).infer_shape = fn
+
+
+def lookup(type) -> Optional[OpDef]:
+    return _REGISTRY.get(type)
+
+
+def lookup_checked(type) -> OpDef:
+    opdef = _REGISTRY.get(type)
+    if opdef is None:
+        raise NotImplementedError(
+            "Op %r has no registered lowering. Registered: %s..."
+            % (type, sorted(_REGISTRY)[:20]))
+    return opdef
+
+
+def registered_ops() -> List[str]:
+    return sorted(_REGISTRY)

@@ -1,0 +1,90 @@
+"""Scope: the runtime name -> value store.
+
+reference: paddle/fluid/framework/scope.h:38 (hierarchical Scope) and
+variable.h (type-erased Variable). Here values are jax Arrays (device
+buffers), host ``LoDTensor``s, numpy arrays, or arbitrary host objects (RAW).
+Hierarchy is kept for control-flow/step scopes and the ``global_scope()``
+singleton matches executor.py's.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class Scope(object):
+    def __init__(self, parent: "Scope" = None):
+        self.parent = parent
+        self._vars: Dict[str, Any] = {}
+        self._kids = []
+
+    def var(self, name: str):
+        """Find-or-create (reference: Scope::Var)."""
+        if name not in self._vars:
+            self._vars[name] = None
+        return self._vars[name]
+
+    def find_var(self, name: str):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def has_var(self, name: str) -> bool:
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return True
+            s = s.parent
+        return False
+
+    def set_var(self, name: str, value):
+        # write-through to the scope that owns the name, else local
+        s = self
+        while s is not None:
+            if name in s._vars:
+                s._vars[name] = value
+                return
+            s = s.parent
+        self._vars[name] = value
+
+    def erase(self, name: str):
+        self._vars.pop(name, None)
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(self)
+        self._kids.append(kid)
+        return kid
+
+    def drop_kids(self):
+        self._kids = []
+
+    def local_var_names(self):
+        return list(self._vars)
+
+    def __contains__(self, name):
+        return self.has_var(name)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+def scope_guard(scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _guard():
+        global _global_scope
+        old = _global_scope
+        _global_scope = scope
+        try:
+            yield
+        finally:
+            _global_scope = old
+
+    return _guard()

@@ -1,0 +1,75 @@
+"""Variable kinds and dtype system for the TPU-native framework.
+
+Mirrors the role of the reference's ``VarType`` proto enum
+(reference: paddle/fluid/framework/framework.proto:101-135) and the fp16
+support (reference: paddle/fluid/platform/float16.h:71) — here bfloat16 is the
+first-class reduced precision type because the MXU natively consumes bf16.
+"""
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+try:  # jax ships ml_dtypes
+    import ml_dtypes
+
+    bfloat16 = np.dtype(ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover
+    bfloat16 = np.dtype("float32")
+
+
+class VarType(enum.Enum):
+    """Kinds of variables a Block can hold.
+
+    reference: paddle/fluid/framework/framework.proto:101-135 (17 kinds).
+    The TPU build keeps the ones that survive the XLA-native redesign;
+    CHANNEL/PLACE_LIST die (host async replaces CSP), READER becomes the
+    reader stack in ``paddle_tpu.reader``.
+    """
+
+    LOD_TENSOR = 1        # dense array, optionally with LoD (ragged) metadata
+    SELECTED_ROWS = 2     # sparse row-subset gradient (embedding grads)
+    LOD_TENSOR_ARRAY = 3  # list of LoDTensors (dynamic RNN outputs)
+    LOD_RANK_TABLE = 4    # sequences sorted by length (dynamic RNN batching)
+    STEP_SCOPES = 5       # control-flow bookkeeping (kept for API parity)
+    FETCH_LIST = 6
+    FEED_MINIBATCH = 7
+    READER = 8
+    RAW = 9               # arbitrary host object
+
+
+# Canonical dtype registry: string name -> numpy dtype.
+_DTYPES = {
+    "float32": np.dtype("float32"),
+    "float64": np.dtype("float64"),
+    "float16": np.dtype("float16"),
+    "bfloat16": bfloat16,
+    "int8": np.dtype("int8"),
+    "uint8": np.dtype("uint8"),
+    "int16": np.dtype("int16"),
+    "int32": np.dtype("int32"),
+    "int64": np.dtype("int64"),
+    "bool": np.dtype("bool"),
+}
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalise any dtype spec (str | np.dtype | jnp dtype) to np.dtype."""
+    if dtype is None:
+        return _DTYPES["float32"]
+    if isinstance(dtype, str):
+        if dtype in _DTYPES:
+            return _DTYPES[dtype]
+        return np.dtype(dtype)
+    return np.dtype(dtype)
+
+
+def is_floating(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return d in (
+        _DTYPES["float32"],
+        _DTYPES["float64"],
+        _DTYPES["float16"],
+        _DTYPES["bfloat16"],
+    )

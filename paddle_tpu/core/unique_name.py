@@ -1,0 +1,26 @@
+"""Unique name generator (reference: python/paddle/fluid/framework.py:unique_name
+via paddle/fluid/pybind ``unique_integer``). Thread-unsafe by design: program
+construction is single-threaded Python, like the reference."""
+from __future__ import annotations
+
+import collections
+import contextlib
+
+_counters: dict = collections.defaultdict(int)
+
+
+def generate(key: str) -> str:
+    _counters[key] += 1
+    return "%s_%d" % (key, _counters[key] - 1)
+
+
+@contextlib.contextmanager
+def guard(new_state=None):
+    """Reset the namespace (used by tests to make programs reproducible)."""
+    global _counters
+    old = _counters
+    _counters = collections.defaultdict(int) if new_state is None else new_state
+    try:
+        yield
+    finally:
+        _counters = old

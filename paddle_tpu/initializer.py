@@ -1,0 +1,136 @@
+"""Initializers emitted as ops into the startup program.
+
+reference: python/paddle/fluid/initializer.py:437 (Constant/Uniform/Normal/
+Xavier/MSRA each appending an init op to the startup block).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .core import ir
+
+
+class Initializer(object):
+    def __call__(self, var, block):
+        raise NotImplementedError
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, var, block):
+        block.append_op(type="fill_constant", outputs={"Out": [var.name]},
+                        attrs={"shape": list(var.shape), "value": self.value,
+                               "dtype": str(var.dtype)})
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, low=-1.0, high=1.0, seed=0):
+        self.low, self.high, self.seed = low, high, seed
+
+    def __call__(self, var, block):
+        block.append_op(type="uniform_random", outputs={"Out": [var.name]},
+                        attrs={"shape": list(var.shape), "min": self.low,
+                               "max": self.high, "seed": self.seed,
+                               "dtype": str(var.dtype)})
+
+
+class NormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, var, block):
+        block.append_op(type="gaussian_random", outputs={"Out": [var.name]},
+                        attrs={"shape": list(var.shape), "mean": self.loc,
+                               "std": self.scale, "seed": self.seed,
+                               "dtype": str(var.dtype)})
+
+
+def _fans(var):
+    shape = var.shape
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    recept = 1
+    for d in shape[2:]:
+        recept *= d
+    return shape[1] * recept, shape[0] * recept
+
+
+class XavierInitializer(Initializer):
+    """reference: initializer.py Xavier (Glorot)."""
+
+    def __init__(self, uniform=True, fan_in=None, fan_out=None, seed=0):
+        self.uniform, self.fan_in, self.fan_out, self.seed = \
+            uniform, fan_in, fan_out, seed
+
+    def __call__(self, var, block):
+        fi, fo = _fans(var)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        if self.uniform:
+            limit = math.sqrt(6.0 / (fi + fo))
+            UniformInitializer(-limit, limit, self.seed)(var, block)
+        else:
+            std = math.sqrt(2.0 / (fi + fo))
+            NormalInitializer(0.0, std, self.seed)(var, block)
+
+
+class MSRAInitializer(Initializer):
+    """reference: initializer.py MSRA (He)."""
+
+    def __init__(self, uniform=True, fan_in=None, seed=0):
+        self.uniform, self.fan_in, self.seed = uniform, fan_in, seed
+
+    def __call__(self, var, block):
+        fi, _ = _fans(var)
+        fi = self.fan_in if self.fan_in is not None else fi
+        if self.uniform:
+            limit = math.sqrt(6.0 / fi)
+            UniformInitializer(-limit, limit, self.seed)(var, block)
+        else:
+            NormalInitializer(0.0, math.sqrt(2.0 / fi), self.seed)(var, block)
+
+
+class TruncatedNormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, var, block):
+        block.append_op(type="truncated_gaussian_random",
+                        outputs={"Out": [var.name]},
+                        attrs={"shape": list(var.shape), "mean": self.loc,
+                               "std": self.scale, "dtype": str(var.dtype)})
+
+
+class NumpyArrayInitializer(Initializer):
+    def __init__(self, value):
+        self.value = np.asarray(value)
+
+    def __call__(self, var, block):
+        block.append_op(type="assign_value", outputs={"Out": [var.name]},
+                        attrs={"shape": list(self.value.shape),
+                               "values": self.value,
+                               "dtype": str(var.dtype)})
+
+
+# reference-style aliases
+Constant = ConstantInitializer
+Uniform = UniformInitializer
+Normal = NormalInitializer
+Xavier = XavierInitializer
+MSRA = MSRAInitializer
+TruncatedNormal = TruncatedNormalInitializer
+
+_global_weight_initializer = None
+_global_bias_initializer = None
+
+
+def default_weight_initializer():
+    return _global_weight_initializer or XavierInitializer()
+
+
+def default_bias_initializer():
+    return _global_bias_initializer or ConstantInitializer(0.0)

@@ -1,0 +1,12 @@
+"""layers DSL — flat namespace like ``fluid.layers.*``
+(reference: python/paddle/fluid/layers/__init__.py)."""
+from . import io, nn, tensor  # noqa: F401
+from .io import *  # noqa: F401,F403
+from .nn import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403
+from .nn import concat_nn  # noqa: F401
+
+__all__ = []
+__all__ += io.__all__
+__all__ += nn.__all__
+__all__ += tensor.__all__

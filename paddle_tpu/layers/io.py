@@ -1,0 +1,21 @@
+"""Input layers. reference: python/paddle/fluid/layers/io.py (data:…,
+ListenAndServ:102, Send:173 — the send/recv pair becomes sharding in
+paddle_tpu.parallel; `data` remains the feed declaration)."""
+from __future__ import annotations
+
+from ..core import ir
+from ..core.types import VarType
+
+__all__ = ["data"]
+
+
+def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
+         type=VarType.LOD_TENSOR, stop_gradient=True):
+    """Declare a feed variable. reference: layers/io.py data()."""
+    helper_block = ir.default_main_program().current_block()
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    return helper_block.create_var(name=name, shape=shape, dtype=dtype,
+                                   lod_level=lod_level, type=type,
+                                   stop_gradient=stop_gradient)

@@ -1,0 +1,57 @@
+"""Operator sugar on Variables (reference: python/paddle/fluid/layers/
+math_op_patch.py — monkey-patches Variable with __add__ etc.)."""
+from __future__ import annotations
+
+from ..core import ir
+
+
+def binary(x, other, op, reverse=False):
+    prog = x.block.program
+    if prog is not ir.default_main_program():
+        # ops on vars of a non-default program must land in that program
+        old = ir.switch_main_program(prog)
+        try:
+            return _binary(x, other, op, reverse)
+        finally:
+            ir.switch_main_program(old)
+    return _binary(x, other, op, reverse)
+
+
+def _binary(x, other, op, reverse=False):
+    from .layer_helper import LayerHelper
+    helper = LayerHelper(op)
+    if isinstance(other, (int, float)):
+        if op == "elementwise_add":
+            return _scale(helper, x, 1.0, float(other))
+        if op == "elementwise_sub":
+            if reverse:
+                return _scale(helper, x, -1.0, float(other))
+            return _scale(helper, x, 1.0, -float(other))
+        if op == "elementwise_mul":
+            return _scale(helper, x, float(other), 0.0)
+        if op == "elementwise_div" and not reverse:
+            return _scale(helper, x, 1.0 / float(other), 0.0)
+        # build a constant tensor for the general case
+        const = helper.create_variable_for_type_inference(dtype=x.dtype)
+        helper.append_op(type="fill_constant", outputs={"Out": [const]},
+                         attrs={"shape": list(x.shape or (1,)),
+                                "value": float(other),
+                                "dtype": str(x.dtype)})
+        other = const
+    a, b = (other, x) if reverse else (x, other)
+    dtype = "bool" if op in ("less_than", "less_equal", "greater_than",
+                             "greater_equal", "equal", "not_equal") else x.dtype
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    out.shape = x.shape
+    helper.append_op(type=op, inputs={"X": [a], "Y": [b]},
+                     outputs={"Out": [out]}, attrs={"axis": -1})
+    return out
+
+
+def _scale(helper, x, scale, bias):
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    out.shape = x.shape
+    helper.append_op(type="scale", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"scale": scale, "bias": bias,
+                            "bias_after_scale": True})
+    return out

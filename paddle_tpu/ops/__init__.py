@@ -1,0 +1,16 @@
+"""Importing this package registers every op lowering (the analog of the
+reference's static registrars firing at library load,
+paddle/fluid/framework/op_registry.h)."""
+from . import (  # noqa: F401
+    common,
+    generic_grad,
+    tensor_ops,
+    math_ops,
+    nn_ops,
+    loss_ops,
+    optimizer_ops,
+    metric_ops,
+    io_ops,
+)
+
+from ..core.registry import registered_ops  # noqa: F401

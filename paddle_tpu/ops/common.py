@@ -1,0 +1,64 @@
+"""Shared lowering helpers (role of reference operators/math/ functors +
+elementwise_op_function.h broadcasting)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.executor import TracedLoD, raw_data, with_lod_of
+from ..core.types import convert_dtype
+
+
+def np_dtype(attr_val, default="float32"):
+    return convert_dtype(attr_val if attr_val is not None else default)
+
+
+def jdt(attr_val, default="float32"):
+    return jnp.dtype(np_dtype(attr_val, default))
+
+
+def bcast_y_to_x(x, y, axis):
+    """Paddle elementwise broadcasting: Y's shape must be a contiguous
+    sub-sequence of X's, placed at ``axis`` (default -1 = align trailing).
+    reference: paddle/fluid/operators/elementwise_op_function.h (get_mid_dims).
+    """
+    if x.ndim == y.ndim:
+        return y
+    if axis is None or axis == -1:
+        axis = x.ndim - y.ndim
+    # trim trailing size-1 dims of y (paddle allows e.g. (3,1) vs axis math)
+    yshape = list(y.shape)
+    while yshape and yshape[-1] == 1 and len(yshape) > x.ndim - axis:
+        yshape = yshape[:-1]
+    new_shape = [1] * axis + yshape + [1] * (x.ndim - axis - len(yshape))
+    return jnp.reshape(y, new_shape)
+
+
+def flatten_to_2d(x, num_col_dims):
+    """reference: mul_op flattening by x_num_col_dims."""
+    lead = 1
+    for d in x.shape[:num_col_dims]:
+        lead *= d
+    rest = 1
+    for d in x.shape[num_col_dims:]:
+        rest *= d
+    return jnp.reshape(x, (lead, rest))
+
+
+def elementwise(ctx, fn):
+    x = ctx.input("X")
+    y = ctx.input("Y")
+    xd, yd = raw_data(x), raw_data(y)
+    yb = bcast_y_to_x(xd, yd, ctx.attr("axis", -1))
+    out = fn(xd, yb)
+    scale = ctx.attr("scale")  # fused scale some paddle elementwise ops carry
+    if scale is not None and scale != 1.0:
+        out = out * scale
+    ctx.set_output("Out", with_lod_of(x, out))
+
+
+def prod(it):
+    p = 1
+    for v in it:
+        p *= v
+    return p

@@ -1,0 +1,97 @@
+"""IO / debug ops. save & load are host ops (run eagerly, reaching the Scope);
+print lowers to jax.debug.print so it works inside jit too.
+
+reference: paddle/fluid/operators/{save,load,save_combine,load_combine,
+print,feed,fetch}_op.cc
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.executor import raw_data, with_lod_of
+from ..core.lod import LoDTensor
+from ..core.registry import register_op
+
+
+def _save_array(path, value):
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if isinstance(value, LoDTensor):
+        payload = {"data": np.asarray(value.numpy()), "lod": value.lod()}
+    else:
+        payload = {"data": np.asarray(value), "lod": []}
+    with open(path, "wb") as f:
+        pickle.dump(payload, f)
+
+
+def _load_array(path):
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    if payload["lod"]:
+        return LoDTensor(payload["data"], payload["lod"])
+    return jnp.asarray(payload["data"])
+
+
+@register_op("save", host=True, no_gradient=True)
+def save(ctx):
+    path = ctx.attr("file_path")
+    if not ctx.attr("overwrite", True) and os.path.exists(path):
+        raise IOError("%s exists and overwrite=False" % path)
+    _save_array(path, ctx.input("X"))
+
+
+@register_op("load", host=True, no_gradient=True)
+def load(ctx):
+    ctx.set_output("Out", _load_array(ctx.attr("file_path")))
+
+
+@register_op("save_combine", host=True, no_gradient=True)
+def save_combine(ctx):
+    path = ctx.attr("file_path")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    vals = ctx.inputs("X")
+    payload = []
+    for v in vals:
+        if isinstance(v, LoDTensor):
+            payload.append({"data": np.asarray(v.numpy()), "lod": v.lod()})
+        else:
+            payload.append({"data": np.asarray(v), "lod": []})
+    with open(path, "wb") as f:
+        pickle.dump(payload, f)
+
+
+@register_op("load_combine", host=True, no_gradient=True)
+def load_combine(ctx):
+    with open(ctx.attr("file_path"), "rb") as f:
+        payload = pickle.load(f)
+    outs = []
+    for item in payload:
+        if item["lod"]:
+            outs.append(LoDTensor(item["data"], item["lod"]))
+        else:
+            outs.append(jnp.asarray(item["data"]))
+    ctx.set_outputs("Out", outs)
+
+
+@register_op("print", no_gradient=True)
+def print_op(ctx):
+    """reference: operators/print_op.cc — works under jit via debug callback."""
+    x = ctx.input("In") if ctx.has_input("In") else ctx.input("X")
+    msg = ctx.attr("message", "")
+    jax.debug.print(msg + " {x}", x=raw_data(x))
+    slot = "Out" if ctx.output_names("Out") else "Output"
+    ctx.set_output(slot, x)
+
+
+@register_op("feed", no_gradient=True)
+def feed(ctx):
+    ctx.set_output("Out", ctx.input("X"))
+
+
+@register_op("fetch", no_gradient=True)
+def fetch(ctx):
+    ctx.set_output("Out", ctx.input("X"))

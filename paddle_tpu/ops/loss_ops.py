@@ -1,0 +1,160 @@
+"""Loss ops. reference: paddle/fluid/operators/{cross_entropy,
+softmax_with_cross_entropy,sigmoid_cross_entropy_with_logits,hinge_loss,
+huber_loss,smooth_l1_loss,rank_loss,margin_rank_loss,cos_sim,
+squared_l2_norm,squared_l2_distance,log_loss,bpr...}_op.*"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.executor import raw_data, with_lod_of
+from ..core.registry import register_op
+
+
+def _infer_loss_rowwise(op, block, x_slot="X"):
+    xv = block._find_var_recursive(op.input(x_slot)[0])
+    for slot in ("Y", "Out", "Loss"):
+        for n in op.output(slot):
+            ov = block._find_var_recursive(n)
+            if ov is not None and xv is not None and xv.shape is not None:
+                ov.shape = (xv.shape[0], 1)
+                ov.dtype = xv.dtype
+
+
+@register_op("cross_entropy", infer_shape=_infer_loss_rowwise)
+def cross_entropy(ctx):
+    """reference: operators/cross_entropy_op.* — X is probabilities
+    (post-softmax); hard labels [N,1] int or soft labels [N,D]."""
+    x = ctx.input("X")
+    xd = raw_data(x)
+    label = raw_data(ctx.input("Label"))
+    logx = jnp.log(jnp.clip(xd, 1e-15, 1.0))
+    if ctx.attr("soft_label", False):
+        loss = -jnp.sum(label.astype(xd.dtype) * logx, axis=-1, keepdims=True)
+    else:
+        lab = label.astype(jnp.int32).reshape(label.shape[0])
+        picked = jnp.take_along_axis(logx, lab[:, None], axis=-1)
+        loss = -picked
+    ctx.set_output("Y", with_lod_of(x, loss))
+
+
+@register_op("softmax_with_cross_entropy", infer_shape=_infer_loss_rowwise)
+def softmax_with_cross_entropy(ctx):
+    """reference: operators/softmax_with_cross_entropy_op.* — fused, the
+    numerically-stable path (XLA fuses logsumexp into the matmul epilogue)."""
+    logits = raw_data(ctx.input("Logits"))
+    label = raw_data(ctx.input("Label"))
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ctx.set_output("Softmax", jnp.exp(logp))
+    if ctx.attr("soft_label", False):
+        loss = -jnp.sum(label.astype(logits.dtype) * logp, axis=-1, keepdims=True)
+    else:
+        lab = label.astype(jnp.int32).reshape(label.shape[0])
+        loss = -jnp.take_along_axis(logp, lab[:, None], axis=-1)
+    ctx.set_output("Loss", loss)
+
+
+@register_op("sigmoid_cross_entropy_with_logits", infer_shape=None)
+def sigmoid_ce_with_logits(ctx):
+    x = raw_data(ctx.input("X"))
+    label = raw_data(ctx.input("Label")).astype(x.dtype)
+    loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ctx.set_output("Out", loss)
+
+
+@register_op("square_error_cost")
+def square_error_cost(ctx):
+    x = raw_data(ctx.input("X"))
+    y = raw_data(ctx.input("Y"))
+    ctx.set_output("Out", jnp.square(x - y))
+
+
+@register_op("squared_l2_distance")
+def squared_l2_distance(ctx):
+    x = raw_data(ctx.input("X"))
+    y = raw_data(ctx.input("Y"))
+    d = x - y
+    ctx.set_output("sub_result", d)
+    ctx.set_output("Out", jnp.sum(d * d, axis=-1, keepdims=True))
+
+
+@register_op("squared_l2_norm")
+def squared_l2_norm(ctx):
+    x = raw_data(ctx.input("X"))
+    ctx.set_output("Out", jnp.sum(x * x).reshape((1,)))
+
+
+@register_op("hinge_loss")
+def hinge_loss(ctx):
+    logits = raw_data(ctx.input("Logits"))
+    labels = raw_data(ctx.input("Labels")).astype(logits.dtype)
+    ctx.set_output("Loss", jnp.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * logits))
+
+
+@register_op("huber_loss")
+def huber_loss(ctx):
+    x = raw_data(ctx.input("X"))
+    y = raw_data(ctx.input("Y"))
+    d = ctx.attr("delta", 1.0)
+    r = y - x
+    a = jnp.abs(r)
+    loss = jnp.where(a <= d, 0.5 * r * r, d * (a - 0.5 * d))
+    ctx.set_output("Residual", r)
+    ctx.set_output("Out", loss)
+
+
+@register_op("smooth_l1_loss")
+def smooth_l1_loss(ctx):
+    x = raw_data(ctx.input("X"))
+    y = raw_data(ctx.input("Y"))
+    sigma = ctx.attr("sigma", 1.0)
+    s2 = sigma * sigma
+    d = x - y
+    if ctx.has_input("InsideWeight"):
+        d = d * raw_data(ctx.input("InsideWeight"))
+    a = jnp.abs(d)
+    l = jnp.where(a < 1.0 / s2, 0.5 * d * d * s2, a - 0.5 / s2)
+    if ctx.has_input("OutsideWeight"):
+        l = l * raw_data(ctx.input("OutsideWeight"))
+    ctx.set_output("Diff", d)
+    ctx.set_output("Out", jnp.sum(l.reshape(l.shape[0], -1), axis=1, keepdims=True))
+
+
+@register_op("log_loss")
+def log_loss(ctx):
+    p = raw_data(ctx.input("Predicted"))
+    y = raw_data(ctx.input("Labels")).astype(p.dtype)
+    e = ctx.attr("epsilon", 1e-4)
+    ctx.set_output("Loss", -y * jnp.log(p + e) - (1.0 - y) * jnp.log(1.0 - p + e))
+
+
+@register_op("rank_loss")
+def rank_loss(ctx):
+    label = raw_data(ctx.input("Label"))
+    left = raw_data(ctx.input("Left"))
+    right = raw_data(ctx.input("Right"))
+    d = left - right
+    ctx.set_output("Out", jnp.log1p(jnp.exp(d)) - label.astype(d.dtype) * d)
+
+
+@register_op("margin_rank_loss")
+def margin_rank_loss(ctx):
+    label = raw_data(ctx.input("Label"))
+    x1 = raw_data(ctx.input("X1"))
+    x2 = raw_data(ctx.input("X2"))
+    m = ctx.attr("margin", 0.0)
+    out = jnp.maximum(0.0, -label.astype(x1.dtype) * (x1 - x2) + m)
+    ctx.set_output("Out", out)
+    ctx.set_output("Activated", (out > 0).astype(x1.dtype))
+
+
+@register_op("cos_sim")
+def cos_sim(ctx):
+    x = raw_data(ctx.input("X"))
+    y = raw_data(ctx.input("Y"))
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True))
+    dot = jnp.sum(x * y, axis=-1, keepdims=True)
+    ctx.set_output("XNorm", xn)
+    ctx.set_output("YNorm", yn)
+    ctx.set_output("Out", dot / (xn * yn + 1e-12))

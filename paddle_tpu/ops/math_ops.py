@@ -1,0 +1,244 @@
+"""Math ops: matmul/mul, elementwise family, reductions, comparisons.
+
+reference: paddle/fluid/operators/{mul,matmul,elementwise_*,reduce_*,sum,scale,
+clip,cumsum,top_k,compare}_op.* with functors in operators/math/ (gemm via
+cuBLAS in math_function.cc, matmul.h). Here matmul lowers to jnp.matmul with
+``preferred_element_type=float32`` so bf16 inputs accumulate in fp32 on the
+MXU — the TPU analog of the reference's float16 math_function specialisations.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import registry
+from ..core.executor import raw_data, with_lod_of
+from ..core.registry import register_op
+from .common import bcast_y_to_x, elementwise, flatten_to_2d, jdt, prod
+
+
+def _acc_type(x):
+    return jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+
+
+def _infer_mul(op, block):
+    xv = block._find_var_recursive(op.input("X")[0])
+    yv = block._find_var_recursive(op.input("Y")[0])
+    ov = block._find_var_recursive(op.output("Out")[0])
+    if None in (xv, yv, ov) or xv.shape is None or yv.shape is None:
+        return
+    xn = op.attr("x_num_col_dims", 1)
+    yn = op.attr("y_num_col_dims", 1)
+    ov.shape = tuple(xv.shape[:xn]) + tuple(yv.shape[yn:])
+    ov.dtype = xv.dtype
+
+
+@register_op("mul", infer_shape=_infer_mul)
+def mul(ctx):
+    """reference: operators/mul_op.cc — flatten then gemm."""
+    x = raw_data(ctx.input("X"))
+    y = raw_data(ctx.input("Y"))
+    xn = ctx.attr("x_num_col_dims", 1)
+    yn = ctx.attr("y_num_col_dims", 1)
+    x2 = flatten_to_2d(x, xn)
+    y2 = flatten_to_2d(y, yn)
+    out = jnp.matmul(x2, y2, preferred_element_type=_acc_type(x))
+    if out.dtype != x.dtype:
+        out = out.astype(x.dtype)
+    out = out.reshape(tuple(x.shape[:xn]) + tuple(y.shape[yn:]))
+    ctx.set_output("Out", out)
+
+
+@register_op("matmul")
+def matmul(ctx):
+    """reference: operators/matmul_op.cc (transpose_X/Y attrs, batched)."""
+    x = raw_data(ctx.input("X"))
+    y = raw_data(ctx.input("Y"))
+    if ctx.attr("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if ctx.attr("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y, preferred_element_type=_acc_type(x))
+    if out.dtype != x.dtype:
+        out = out.astype(x.dtype)
+    alpha = ctx.attr("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    ctx.set_output("Out", out)
+
+
+def _infer_ew(op, block):
+    xv = block._find_var_recursive(op.input("X")[0])
+    ov = block._find_var_recursive(op.output("Out")[0])
+    if xv is not None and ov is not None:
+        ov.shape = xv.shape
+        ov.dtype = xv.dtype
+
+
+for _name, _fn in [
+    ("elementwise_add", jnp.add),
+    ("elementwise_sub", jnp.subtract),
+    ("elementwise_mul", jnp.multiply),
+    ("elementwise_div", jnp.divide),
+    ("elementwise_max", jnp.maximum),
+    ("elementwise_min", jnp.minimum),
+    ("elementwise_pow", jnp.power),
+]:
+    register_op(_name, infer_shape=_infer_ew)(
+        functools.partial(lambda ctx, f: elementwise(ctx, f), f=_fn))
+
+
+@register_op("sum", infer_shape=_infer_ew)
+def sum_op(ctx):
+    """Multi-input add; grad-accumulation workhorse
+    (reference: operators/sum_op.cc, also merges SelectedRows)."""
+    xs = ctx.inputs("X")
+    out = raw_data(xs[0])
+    for v in xs[1:]:
+        out = out + raw_data(v)
+    ctx.set_output("Out", with_lod_of(xs[0], out))
+
+
+@register_op("scale", infer_shape=_infer_ew)
+def scale(ctx):
+    x = ctx.input("X")
+    s = ctx.attr("scale", 1.0)
+    b = ctx.attr("bias", 0.0)
+    bas = ctx.attr("bias_after_scale", True)
+    xd = raw_data(x)
+    out = xd * s + b if bas else (xd + b) * s
+    ctx.set_output("Out", with_lod_of(x, out))
+
+
+@register_op("clip", infer_shape=_infer_ew)
+def clip(ctx):
+    x = raw_data(ctx.input("X"))
+    ctx.set_output("Out", jnp.clip(x, ctx.attr("min"), ctx.attr("max")))
+
+
+@register_op("clip_by_norm", infer_shape=_infer_ew)
+def clip_by_norm(ctx):
+    x = raw_data(ctx.input("X"))
+    mn = ctx.attr("max_norm")
+    norm = jnp.sqrt(jnp.sum(x * x))
+    ctx.set_output("Out", jnp.where(norm > mn, x * (mn / jnp.maximum(norm, 1e-12)), x))
+
+
+@register_op("cumsum")
+def cumsum(ctx):
+    x = raw_data(ctx.input("X"))
+    axis = ctx.attr("axis", -1)
+    if ctx.attr("reverse", False):
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if ctx.attr("exclusive", False):
+        out = out - x
+    if ctx.attr("reverse", False):
+        out = jnp.flip(out, axis)
+    ctx.set_output("Out", out)
+
+
+# -- reductions -------------------------------------------------------------
+
+def _reduce(ctx, fn):
+    x = raw_data(ctx.input("X"))
+    if ctx.attr("reduce_all", False):
+        dim = None
+    else:
+        dim = ctx.attr("dim", [0])
+        dim = tuple(dim) if isinstance(dim, (list, tuple)) else (dim,)
+    out = fn(x, axis=dim, keepdims=ctx.attr("keep_dim", False))
+    ctx.set_output("Out", out)
+
+
+for _name, _fn in [
+    ("reduce_sum", jnp.sum), ("reduce_mean", jnp.mean),
+    ("reduce_max", jnp.max), ("reduce_min", jnp.min),
+    ("reduce_prod", jnp.prod),
+]:
+    register_op(_name)(functools.partial(lambda ctx, f: _reduce(ctx, f), f=_fn))
+
+
+def _infer_mean(op, block):
+    ov = block._find_var_recursive(op.output("Out")[0])
+    xv = block._find_var_recursive(op.input("X")[0])
+    if ov is not None:
+        ov.shape = (1,)
+        if xv is not None:
+            ov.dtype = xv.dtype
+
+
+@register_op("mean", infer_shape=_infer_mean)
+def mean(ctx):
+    x = raw_data(ctx.input("X"))
+    ctx.set_output("Out", jnp.mean(x).reshape((1,)))
+
+
+@register_op("norm")
+def norm(ctx):
+    x = raw_data(ctx.input("X"))
+    axis = ctx.attr("axis", 1)
+    eps = ctx.attr("epsilon", 1e-10)
+    n = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True) + eps)
+    ctx.set_output("Norm", n)
+    ctx.set_output("Out", x / n)
+
+
+# -- comparisons / logicals -------------------------------------------------
+
+def _compare(ctx, fn):
+    x = raw_data(ctx.input("X"))
+    y = raw_data(ctx.input("Y"))
+    ctx.set_output("Out", fn(x, bcast_y_to_x(x, y, ctx.attr("axis", -1))))
+
+
+for _name, _fn in [
+    ("less_than", jnp.less), ("less_equal", jnp.less_equal),
+    ("greater_than", jnp.greater), ("greater_equal", jnp.greater_equal),
+    ("equal", jnp.equal), ("not_equal", jnp.not_equal),
+]:
+    register_op(_name, no_gradient=True)(
+        functools.partial(lambda ctx, f: _compare(ctx, f), f=_fn))
+
+
+for _name, _fn in [
+    ("logical_and", jnp.logical_and), ("logical_or", jnp.logical_or),
+    ("logical_xor", jnp.logical_xor),
+]:
+    register_op(_name, no_gradient=True)(
+        functools.partial(lambda ctx, f: _compare(ctx, f), f=_fn))
+
+
+@register_op("logical_not", no_gradient=True)
+def logical_not(ctx):
+    ctx.set_output("Out", jnp.logical_not(raw_data(ctx.input("X"))))
+
+
+@register_op("top_k", no_gradient=True)
+def top_k(ctx):
+    """reference: operators/top_k_op.* / cuda hl_top_k.h (beam search core)."""
+    x = raw_data(ctx.input("X"))
+    k = ctx.attr("k", 1)
+    vals, idx = jax.lax.top_k(x, k)
+    ctx.set_output("Out", vals)
+    ctx.set_output("Indices", idx.astype(jnp.int64))
+
+
+@register_op("maximum")
+def maximum(ctx):
+    _compare_noop = None
+    x = raw_data(ctx.input("X"))
+    y = raw_data(ctx.input("Y"))
+    ctx.set_output("Out", jnp.maximum(x, y))
+
+
+@register_op("isfinite", no_gradient=True)
+def isfinite(ctx):
+    xs = ctx.inputs("X")
+    ok = jnp.asarray(True)
+    for v in xs:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(raw_data(v))))
+    ctx.set_output("Out", ok)

@@ -1,0 +1,106 @@
+"""Metric ops. reference: paddle/fluid/operators/{accuracy,auc,
+precision_recall}_op.*"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.executor import raw_data
+from ..core.registry import register_op
+
+
+@register_op("accuracy", no_gradient=True)
+def accuracy(ctx):
+    """reference: operators/accuracy_op.* — Out: top-k hit ratio; takes the
+    Indices output of a top_k op plus the int label column."""
+    indices = raw_data(ctx.input("Indices")).astype(jnp.int64)
+    label = raw_data(ctx.input("Label")).astype(jnp.int64).reshape(-1, 1)
+    hit = jnp.any(indices == label, axis=1)
+    total = jnp.asarray(indices.shape[0], dtype=jnp.int64)
+    correct = jnp.sum(hit).astype(jnp.int64)
+    ctx.set_output("Accuracy",
+                   (correct.astype(jnp.float32) / total.astype(jnp.float32)
+                    ).reshape((1,)))
+    ctx.set_output("Correct", correct.reshape((1,)).astype(jnp.int32))
+    ctx.set_output("Total", total.reshape((1,)).astype(jnp.int32))
+
+
+@register_op("auc", no_gradient=True)
+def auc(ctx):
+    """Batch AUC via thresholded TP/FP curve (reference: operators/auc_op.cc)."""
+    probs = raw_data(ctx.input("Out"))
+    label = raw_data(ctx.input("Label")).reshape(-1).astype(jnp.float32)
+    num_t = ctx.attr("num_thresholds", 200)
+    pos_prob = probs[:, 1] if probs.ndim == 2 and probs.shape[1] > 1 \
+        else probs.reshape(-1)
+    th = jnp.linspace(0.0, 1.0, num_t)
+    pred_pos = pos_prob[None, :] >= th[:, None]
+    tp = jnp.sum(pred_pos * label[None, :], axis=1)
+    fp = jnp.sum(pred_pos * (1.0 - label[None, :]), axis=1)
+    pos = jnp.maximum(jnp.sum(label), 1e-6)
+    neg = jnp.maximum(jnp.sum(1.0 - label), 1e-6)
+    tpr = tp / pos
+    fpr = fp / neg
+    auc_val = -jnp.trapezoid(tpr, fpr) if hasattr(jnp, "trapezoid") \
+        else -jnp.trapz(tpr, fpr)
+    ctx.set_output("AUC", jnp.abs(auc_val).reshape(()))
+
+
+@register_op("precision_recall", no_gradient=True)
+def precision_recall(ctx):
+    probs = raw_data(ctx.input("MaxProbs"))
+    indices = raw_data(ctx.input("Indices")).reshape(-1)
+    labels = raw_data(ctx.input("Labels")).reshape(-1)
+    cls = ctx.attr("class_number")
+    pred = indices.astype(jnp.int32)
+    lab = labels.astype(jnp.int32)
+    onehot_p = jnp.eye(cls)[pred]
+    onehot_l = jnp.eye(cls)[lab]
+    tp = jnp.sum(onehot_p * onehot_l, axis=0)
+    fp = jnp.sum(onehot_p * (1 - onehot_l), axis=0)
+    fn = jnp.sum((1 - onehot_p) * onehot_l, axis=0)
+    prec = tp / jnp.maximum(tp + fp, 1e-6)
+    rec = tp / jnp.maximum(tp + fn, 1e-6)
+    f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-6)
+    ctx.set_output("BatchMetrics",
+                   jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1),
+                              jnp.sum(tp) / jnp.maximum(jnp.sum(tp + fp), 1e-6),
+                              jnp.sum(tp) / jnp.maximum(jnp.sum(tp + fn), 1e-6),
+                              jnp.zeros(())]))
+
+
+@register_op("edit_distance", no_gradient=True)
+def edit_distance(ctx):
+    """Levenshtein distance between two int sequences (dense [N, T] form).
+    reference: operators/edit_distance_op.* (LoD inputs there)."""
+    import jax
+
+    hyp = raw_data(ctx.input("Hyps")).astype(jnp.int32)
+    ref = raw_data(ctx.input("Refs")).astype(jnp.int32)
+    if hyp.ndim == 1:
+        hyp = hyp[None, :]
+        ref = ref[None, :]
+    norm = ctx.attr("normalized", False)
+
+    def one(h, r):
+        m, n = h.shape[0], r.shape[0]
+        row = jnp.arange(n + 1, dtype=jnp.float32)
+
+        def body(row, hi):
+            def inner(carry, j):
+                prev_diag, newrow_last = carry
+                cost = jnp.where(hi == r[j - 1], 0.0, 1.0)
+                val = jnp.minimum(jnp.minimum(row[j] + 1.0, newrow_last + 1.0),
+                                  prev_diag + cost)
+                return (row[j], val), val
+
+            (_, _), vals = jax.lax.scan(inner, (row[0], row[0] + 1.0),
+                                        jnp.arange(1, n + 1))
+            return jnp.concatenate([row[:1] + 1.0, vals]), None
+
+        out, _ = jax.lax.scan(lambda c, hi: (body(c, hi)[0], None), row, h)
+        d = out[n]
+        return d / n if norm else d
+
+    dists = jax.vmap(one)(hyp, ref)
+    ctx.set_output("Out", dists.reshape(-1, 1))
+    ctx.set_output("SequenceNum", jnp.asarray([hyp.shape[0]], dtype=jnp.int64))

@@ -1,0 +1,499 @@
+"""NN ops: activations, softmax, conv, pooling, normalization, dropout.
+
+reference: paddle/fluid/operators/{activation,softmax,conv,pool,batch_norm,
+dropout,lrn,prelu}_op.* (+ cudnn variants conv_cudnn_op.cu.cc etc.). The cudnn
+library axis disappears: XLA's conv emitter targets the MXU directly; NCHW
+semantics are preserved at the API (reference layout) and XLA re-lays-out
+internally for TPU.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import registry
+from ..core.executor import raw_data, with_lod_of
+from ..core.registry import register_op
+from .common import jdt, prod
+
+
+# -- activations ------------------------------------------------------------
+# reference: operators/activation_op.cc (~20 in one file) — same here.
+
+def _act(ctx, fn):
+    x = ctx.input("X")
+    ctx.set_output("Out", with_lod_of(x, fn(raw_data(x))))
+
+
+def _infer_same(op, block):
+    names = op.input("X")
+    if not names:
+        return
+    xv = block._find_var_recursive(names[0])
+    for n in op.output("Out"):
+        ov = block._find_var_recursive(n)
+        if ov is not None and xv is not None:
+            ov.shape = xv.shape
+            ov.dtype = xv.dtype
+            ov.lod_level = xv.lod_level
+
+
+_ACTIVATIONS = {
+    "sigmoid": jax.nn.sigmoid,
+    "logsigmoid": jax.nn.log_sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "relu6": lambda x: jnp.clip(x, 0.0, 6.0),
+    "exp": jnp.exp,
+    "abs": jnp.abs,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "round": jnp.round,
+    "log": jnp.log,
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "reciprocal": lambda x: 1.0 / x,
+    "softplus": jax.nn.softplus,
+    "softsign": lambda x: x / (1.0 + jnp.abs(x)),
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tanh_shrink": lambda x: x - jnp.tanh(x),
+    "softshrink": lambda x: jnp.sign(x) * jnp.maximum(jnp.abs(x) - 0.5, 0.0),
+    "sign": jnp.sign,
+}
+for _name, _fn in _ACTIVATIONS.items():
+    register_op(_name, infer_shape=_infer_same)(
+        functools.partial(lambda ctx, f: _act(ctx, f), f=_fn))
+
+
+@register_op("leaky_relu", infer_shape=_infer_same)
+def leaky_relu(ctx):
+    a = ctx.attr("alpha", 0.02)
+    _act(ctx, lambda x: jnp.where(x > 0, x, a * x))
+
+
+@register_op("elu", infer_shape=_infer_same)
+def elu(ctx):
+    a = ctx.attr("alpha", 1.0)
+    _act(ctx, lambda x: jnp.where(x > 0, x, a * (jnp.exp(x) - 1.0)))
+
+
+@register_op("brelu", infer_shape=_infer_same)
+def brelu(ctx):
+    lo, hi = ctx.attr("t_min", 0.0), ctx.attr("t_max", 24.0)
+    _act(ctx, lambda x: jnp.clip(x, lo, hi))
+
+
+@register_op("soft_relu", infer_shape=_infer_same)
+def soft_relu(ctx):
+    t = ctx.attr("threshold", 40.0)
+    _act(ctx, lambda x: jnp.log1p(jnp.exp(jnp.clip(x, -t, t))))
+
+
+@register_op("hard_sigmoid", infer_shape=_infer_same)
+def hard_sigmoid(ctx):
+    s = ctx.attr("slope", 0.2)
+    o = ctx.attr("offset", 0.5)
+    _act(ctx, lambda x: jnp.clip(s * x + o, 0.0, 1.0))
+
+
+@register_op("swish", infer_shape=_infer_same)
+def swish(ctx):
+    b = ctx.attr("beta", 1.0)
+    _act(ctx, lambda x: x * jax.nn.sigmoid(b * x))
+
+
+@register_op("thresholded_relu", infer_shape=_infer_same)
+def thresholded_relu(ctx):
+    t = ctx.attr("threshold", 1.0)
+    _act(ctx, lambda x: jnp.where(x > t, x, 0.0))
+
+
+@register_op("stanh", infer_shape=_infer_same)
+def stanh(ctx):
+    a = ctx.attr("scale_a", 0.67)
+    b = ctx.attr("scale_b", 1.7159)
+    _act(ctx, lambda x: b * jnp.tanh(a * x))
+
+
+@register_op("pow", infer_shape=_infer_same)
+def pow_op(ctx):
+    f = ctx.attr("factor", 1.0)
+    _act(ctx, lambda x: jnp.power(x, f))
+
+
+@register_op("prelu", infer_shape=_infer_same)
+def prelu(ctx):
+    x = raw_data(ctx.input("X"))
+    alpha = raw_data(ctx.input("Alpha"))
+    mode = ctx.attr("mode", "all")
+    if mode == "channel" and alpha.ndim == 1:
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    ctx.set_output("Out", jnp.where(x > 0, x, alpha * x))
+
+
+@register_op("softmax", infer_shape=_infer_same)
+def softmax(ctx):
+    x = ctx.input("X")
+    ctx.set_output("Out", with_lod_of(x, jax.nn.softmax(raw_data(x), axis=-1)))
+
+
+@register_op("log_softmax", infer_shape=_infer_same)
+def log_softmax(ctx):
+    x = ctx.input("X")
+    ctx.set_output("Out", with_lod_of(x, jax.nn.log_softmax(raw_data(x), axis=-1)))
+
+
+@register_op("maxout")
+def maxout(ctx):
+    x = raw_data(ctx.input("X"))
+    g = ctx.attr("groups")
+    n, c, h, w = x.shape
+    ctx.set_output("Out", x.reshape(n, c // g, g, h, w).max(axis=2))
+
+
+# -- dropout (custom grad: uses the saved mask) ------------------------------
+
+def _dropout_grad_maker(op, block, grad_of, no_grad):
+    gout = grad_of.get(op.output("Out")[0])
+    if gout is None:
+        return None
+    xname = op.input("X")[0]
+    if xname in no_grad:
+        return None
+    return [("dropout_grad",
+             {"Mask": op.output("Mask"), "Out@GRAD": [gout]},
+             {"X@GRAD": [xname + "@GRAD"]},
+             dict(op.attrs))]
+
+
+@register_op("dropout", grad_maker=_dropout_grad_maker, infer_shape=_infer_same)
+def dropout(ctx):
+    """reference: operators/dropout_op.* — train: x*mask; test: x*(1-p)."""
+    x = ctx.input("X")
+    xd = raw_data(x)
+    p = ctx.attr("dropout_prob", 0.5)
+    if ctx.attr("is_test", False):
+        ctx.set_output("Out", with_lod_of(x, xd * (1.0 - p)))
+        ctx.set_output("Mask", jnp.ones_like(xd))
+        return
+    key = ctx.next_rng()
+    mask = (jax.random.uniform(key, xd.shape) >= p).astype(xd.dtype)
+    ctx.set_output("Out", with_lod_of(x, xd * mask))
+    ctx.set_output("Mask", mask)
+
+
+@register_op("dropout_grad")
+def dropout_grad(ctx):
+    mask = raw_data(ctx.input("Mask"))
+    dy = raw_data(ctx.input("Out@GRAD"))
+    ctx.set_output("X@GRAD", dy * mask)
+
+
+# -- conv / pool ------------------------------------------------------------
+
+def _conv_out_dim(i, k, p, s, d=1):
+    ke = (k - 1) * d + 1
+    return (i + 2 * p - ke) // s + 1
+
+
+def _infer_conv2d(op, block):
+    xv = block._find_var_recursive(op.input("Input")[0])
+    fv = block._find_var_recursive(op.input("Filter")[0])
+    ov = block._find_var_recursive(op.output("Output")[0])
+    if None in (xv, fv, ov) or xv.shape is None or fv.shape is None:
+        return
+    s = op.attr("strides", [1, 1])
+    p = op.attr("paddings", [0, 0])
+    d = op.attr("dilations", [1, 1])
+    n, _, h, w = xv.shape
+    oc, _, kh, kw = fv.shape
+    ov.shape = (n, oc, _conv_out_dim(h, kh, p[0], s[0], d[0]),
+                _conv_out_dim(w, kw, p[1], s[1], d[1]))
+    ov.dtype = xv.dtype
+
+
+@register_op("conv2d", infer_shape=_infer_conv2d)
+def conv2d(ctx):
+    """reference: operators/conv_op.cc + conv_cudnn_op.cu.cc. NCHW/OIHW."""
+    x = raw_data(ctx.input("Input"))
+    w = raw_data(ctx.input("Filter"))
+    s = ctx.attr("strides", [1, 1])
+    p = ctx.attr("paddings", [0, 0])
+    d = ctx.attr("dilations", [1, 1])
+    groups = ctx.attr("groups", 1) or 1
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=tuple(s),
+        padding=[(p[0], p[0]), (p[1], p[1])],
+        rhs_dilation=tuple(d),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype in (jnp.bfloat16,) else None)
+    ctx.set_output("Output", out.astype(x.dtype))
+
+
+@register_op("depthwise_conv2d", infer_shape=_infer_conv2d)
+def depthwise_conv2d(ctx):
+    ctx.op.attrs.setdefault("groups", None)
+    x = raw_data(ctx.input("Input"))
+    w = raw_data(ctx.input("Filter"))
+    groups = ctx.attr("groups") or x.shape[1]
+    s = ctx.attr("strides", [1, 1])
+    p = ctx.attr("paddings", [0, 0])
+    d = ctx.attr("dilations", [1, 1])
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=tuple(s),
+        padding=[(p[0], p[0]), (p[1], p[1])],
+        rhs_dilation=tuple(d),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups)
+    ctx.set_output("Output", out)
+
+
+@register_op("conv2d_transpose")
+def conv2d_transpose(ctx):
+    """reference: operators/conv_transpose_op.cc. Filter layout IOHW."""
+    x = raw_data(ctx.input("Input"))
+    w = raw_data(ctx.input("Filter"))
+    s = ctx.attr("strides", [1, 1])
+    p = ctx.attr("paddings", [0, 0])
+    d = ctx.attr("dilations", [1, 1])
+    out = jax.lax.conv_transpose(
+        x, w, strides=tuple(s),
+        padding=[(p[0], p[0]), (p[1], p[1])],
+        rhs_dilation=tuple(d),
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        transpose_kernel=True)
+    ctx.set_output("Output", out)
+
+
+@register_op("conv3d")
+def conv3d(ctx):
+    x = raw_data(ctx.input("Input"))
+    w = raw_data(ctx.input("Filter"))
+    s = ctx.attr("strides", [1, 1, 1])
+    p = ctx.attr("paddings", [0, 0, 0])
+    d = ctx.attr("dilations", [1, 1, 1])
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=tuple(s),
+        padding=[(pi, pi) for pi in p], rhs_dilation=tuple(d),
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=ctx.attr("groups", 1) or 1)
+    ctx.set_output("Output", out)
+
+
+def _infer_pool2d(op, block):
+    xv = block._find_var_recursive(op.input("X")[0])
+    ov = block._find_var_recursive(op.output("Out")[0])
+    if None in (xv, ov) or xv.shape is None:
+        return
+    if op.attr("global_pooling", False):
+        ov.shape = (xv.shape[0], xv.shape[1], 1, 1)
+        ov.dtype = xv.dtype
+        return
+    k = op.attr("ksize")
+    s = op.attr("strides", [1, 1])
+    p = op.attr("paddings", [0, 0])
+    ceil = op.attr("ceil_mode", False)
+
+    def od(i, kk, pp, ss):
+        num = i + 2 * pp - kk
+        return (num + ss - 1) // ss + 1 if ceil else num // ss + 1
+
+    n, c, h, w = xv.shape
+    ov.shape = (n, c, od(h, k[0], p[0], s[0]), od(w, k[1], p[1], s[1]))
+    ov.dtype = xv.dtype
+
+
+@register_op("pool2d", infer_shape=_infer_pool2d)
+def pool2d(ctx):
+    """reference: operators/pool_op.cc + math/pooling.*"""
+    x = raw_data(ctx.input("X"))
+    ptype = ctx.attr("pooling_type", "max")
+    if ctx.attr("global_pooling", False):
+        if ptype == "max":
+            out = jnp.max(x, axis=(2, 3), keepdims=True)
+        else:
+            out = jnp.mean(x, axis=(2, 3), keepdims=True)
+        ctx.set_output("Out", out)
+        return
+    k = ctx.attr("ksize")
+    s = ctx.attr("strides", [1, 1])
+    p = ctx.attr("paddings", [0, 0])
+    dims = (1, 1, k[0], k[1])
+    strides = (1, 1, s[0], s[1])
+    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    if ptype == "max":
+        init = -jnp.inf
+        out = jax.lax.reduce_window(x, init, jax.lax.max, dims, strides, pads)
+    else:
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides, pads)
+        if ctx.attr("exclusive", True) and (p[0] or p[1]):
+            ones = jnp.ones_like(x)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims,
+                                           strides, pads)
+            out = summed / counts
+        else:
+            out = summed / float(k[0] * k[1])
+    ctx.set_output("Out", out)
+
+
+@register_op("pool3d")
+def pool3d(ctx):
+    x = raw_data(ctx.input("X"))
+    ptype = ctx.attr("pooling_type", "max")
+    if ctx.attr("global_pooling", False):
+        red = jnp.max if ptype == "max" else jnp.mean
+        ctx.set_output("Out", red(x, axis=(2, 3, 4), keepdims=True))
+        return
+    k = ctx.attr("ksize")
+    s = ctx.attr("strides", [1, 1, 1])
+    p = ctx.attr("paddings", [0, 0, 0])
+    dims = (1, 1) + tuple(k)
+    strides = (1, 1) + tuple(s)
+    pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+    if ptype == "max":
+        out = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims, strides, pads)
+    else:
+        out = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides,
+                                    pads) / float(prod(k))
+    ctx.set_output("Out", out)
+
+
+# -- normalization ----------------------------------------------------------
+
+@register_op("batch_norm", infer_shape=_infer_same)
+def batch_norm(ctx):
+    """reference: operators/batch_norm_op.cc. NCHW; running stats update in
+    the program (MeanOut/VarianceOut alias the persistable Mean/Variance vars,
+    so the executor's state pass-through carries them across steps)."""
+    x = raw_data(ctx.input("X"))
+    scale = raw_data(ctx.input("Scale"))
+    bias = raw_data(ctx.input("Bias"))
+    mean = raw_data(ctx.input("Mean"))
+    var = raw_data(ctx.input("Variance"))
+    eps = ctx.attr("epsilon", 1e-5)
+    momentum = ctx.attr("momentum", 0.9)
+    is_test = ctx.attr("is_test", False)
+    layout = ctx.attr("data_layout", "NCHW")
+    axes = (0, 2, 3) if (x.ndim == 4 and layout == "NCHW") else \
+           (0, 1, 2) if (x.ndim == 4) else (0,)
+    cshape = [1] * x.ndim
+    caxis = 1 if (x.ndim == 4 and layout == "NCHW") else x.ndim - 1
+    cshape[caxis] = x.shape[caxis]
+
+    if is_test:
+        use_mean, use_var = mean, var
+        saved_mean, saved_var = mean, var
+        new_mean, new_var = mean, var
+    else:
+        bm = jnp.mean(x, axis=axes)
+        bv = jnp.var(x, axis=axes)
+        use_mean, use_var = bm, bv
+        saved_mean = bm
+        saved_var = 1.0 / jnp.sqrt(bv + eps)
+        new_mean = momentum * mean + (1.0 - momentum) * bm
+        new_var = momentum * var + (1.0 - momentum) * bv
+    inv = 1.0 / jnp.sqrt(use_var + eps)
+    y = (x - use_mean.reshape(cshape)) * (inv * scale).reshape(cshape) \
+        + bias.reshape(cshape)
+    ctx.set_output("Y", y)
+    ctx.set_output("MeanOut", new_mean)
+    ctx.set_output("VarianceOut", new_var)
+    ctx.set_output("SavedMean", saved_mean)
+    ctx.set_output("SavedVariance", saved_var)
+
+
+def _bn_grad_maker(op, block, grad_of, no_grad):
+    """batch_norm grad must not differentiate through the running-stat
+    update; restrict the vjp to (X, Scale, Bias) -> Y."""
+    g = grad_of.get(op.output("Y")[0])
+    if g is None:
+        return None
+    inputs = {"X": list(op.input("X")), "Scale": list(op.input("Scale")),
+              "Bias": list(op.input("Bias")), "Mean": list(op.input("Mean")),
+              "Variance": list(op.input("Variance")),
+              "Y": list(op.output("Y")), "Y@GRAD": [g]}
+    outputs = {}
+    diff = {}
+    for slot in ("X", "Scale", "Bias"):
+        n = op.input(slot)[0]
+        if n not in no_grad:
+            outputs[slot + "@GRAD"] = [n + "@GRAD"]
+            diff[slot] = [True]
+    if not outputs:
+        return None
+    attrs = dict(op.attrs)
+    attrs["__fwd_type__"] = "batch_norm"
+    attrs["__fwd_input_slots__"] = ["X", "Scale", "Bias", "Mean", "Variance"]
+    attrs["__fwd_output_slots__"] = ["Y"]
+    attrs["__diff_slots__"] = diff
+    return [("generic_grad", inputs, outputs, attrs)]
+
+
+registry.lookup("batch_norm").grad_maker = _bn_grad_maker
+
+
+@register_op("layer_norm", infer_shape=_infer_same)
+def layer_norm(ctx):
+    x = raw_data(ctx.input("X"))
+    begin = ctx.attr("begin_norm_axis", 1)
+    axes = tuple(range(begin, x.ndim))
+    eps = ctx.attr("epsilon", 1e-5)
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) / jnp.sqrt(var + eps)
+    if ctx.has_input("Scale"):
+        y = y * raw_data(ctx.input("Scale")).reshape((1,) * begin + x.shape[begin:])
+    if ctx.has_input("Bias"):
+        y = y + raw_data(ctx.input("Bias")).reshape((1,) * begin + x.shape[begin:])
+    ctx.set_output("Y", y)
+    ctx.set_output("Mean", mean.reshape(x.shape[:begin] + (1,) * 0).reshape(-1))
+    ctx.set_output("Variance", var.reshape(-1))
+
+
+@register_op("lrn")
+def lrn(ctx):
+    """reference: operators/lrn_op.cc — cross-channel local response norm."""
+    x = raw_data(ctx.input("X"))
+    n = ctx.attr("n", 5)
+    k = ctx.attr("k", 2.0)
+    alpha = ctx.attr("alpha", 1e-4)
+    beta = ctx.attr("beta", 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    ctx.set_output("Out", x / jnp.power(mid, beta))
+    ctx.set_output("MidOut", mid)
+
+
+@register_op("l2_normalize", infer_shape=_infer_same)
+def l2_normalize(ctx):
+    x = raw_data(ctx.input("X"))
+    axis = ctx.attr("axis", 1)
+    eps = ctx.attr("epsilon", 1e-12)
+    ctx.set_output("Out", x / jnp.sqrt(
+        jnp.maximum(jnp.sum(x * x, axis=axis, keepdims=True), eps)))
+
+
+@register_op("im2sequence")
+def im2sequence(ctx):
+    x = raw_data(ctx.input("X"))
+    k = ctx.attr("kernels")
+    s = ctx.attr("strides", [1, 1])
+    p = ctx.attr("paddings", [0, 0, 0, 0])
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[2]), (p[1], p[3])))
+    oh = (xp.shape[2] - k[0]) // s[0] + 1
+    ow = (xp.shape[3] - k[1]) // s[1] + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        xp, filter_shape=tuple(k), window_strides=tuple(s), padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # patches: [N, C*kh*kw, oh, ow] -> [N*oh*ow, C*kh*kw]
+    out = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * k[0] * k[1])
+    ctx.set_output("Out", out)

@@ -1,0 +1,197 @@
+"""Optimizers-as-ops. reference: paddle/fluid/operators/{sgd,momentum,adam,
+adamax,adagrad,decayed_adagrad,adadelta,rmsprop,ftrl,proximal_gd,
+proximal_adagrad}_op.cc — each consumes Param/Grad/LearningRate (+accumulators)
+and writes ParamOut (aliasing Param, so the executor state pass carries the
+update). On TPU all of these fuse into the backward XLA computation."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.executor import raw_data
+from ..core.registry import register_op
+
+
+def _lr(ctx):
+    return raw_data(ctx.input("LearningRate")).reshape(())
+
+
+@register_op("sgd", no_gradient=True, stateful_outputs=("ParamOut",))
+def sgd(ctx):
+    p = raw_data(ctx.input("Param"))
+    g = raw_data(ctx.input("Grad"))
+    ctx.set_output("ParamOut", p - _lr(ctx) * g)
+
+
+@register_op("momentum", no_gradient=True,
+             stateful_outputs=("ParamOut", "VelocityOut"))
+def momentum(ctx):
+    p = raw_data(ctx.input("Param"))
+    g = raw_data(ctx.input("Grad"))
+    v = raw_data(ctx.input("Velocity"))
+    mu = ctx.attr("mu")
+    lr = _lr(ctx)
+    v_new = mu * v + g
+    if ctx.attr("use_nesterov", False):
+        p_new = p - (g + mu * v_new) * lr
+    else:
+        p_new = p - lr * v_new
+    ctx.set_output("ParamOut", p_new)
+    ctx.set_output("VelocityOut", v_new)
+
+
+@register_op("adam", no_gradient=True,
+             stateful_outputs=("ParamOut", "Moment1Out", "Moment2Out"))
+def adam(ctx):
+    p = raw_data(ctx.input("Param"))
+    g = raw_data(ctx.input("Grad"))
+    m1 = raw_data(ctx.input("Moment1"))
+    m2 = raw_data(ctx.input("Moment2"))
+    b1p = raw_data(ctx.input("Beta1Pow")).reshape(())
+    b2p = raw_data(ctx.input("Beta2Pow")).reshape(())
+    b1 = ctx.attr("beta1", 0.9)
+    b2 = ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    lr = _lr(ctx) * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
+    m1n = b1 * m1 + (1.0 - b1) * g
+    m2n = b2 * m2 + (1.0 - b2) * g * g
+    ctx.set_output("ParamOut", p - lr * m1n / (jnp.sqrt(m2n) + eps))
+    ctx.set_output("Moment1Out", m1n)
+    ctx.set_output("Moment2Out", m2n)
+
+
+@register_op("adamax", no_gradient=True,
+             stateful_outputs=("ParamOut", "MomentOut", "InfNormOut"))
+def adamax(ctx):
+    p = raw_data(ctx.input("Param"))
+    g = raw_data(ctx.input("Grad"))
+    m = raw_data(ctx.input("Moment"))
+    inf = raw_data(ctx.input("InfNorm"))
+    b1p = raw_data(ctx.input("Beta1Pow")).reshape(())
+    b1 = ctx.attr("beta1", 0.9)
+    b2 = ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    mn = b1 * m + (1.0 - b1) * g
+    infn = jnp.maximum(b2 * inf, jnp.abs(g))
+    lr = _lr(ctx) / (1.0 - b1p)
+    ctx.set_output("ParamOut", p - lr * mn / (infn + eps))
+    ctx.set_output("MomentOut", mn)
+    ctx.set_output("InfNormOut", infn)
+
+
+@register_op("adagrad", no_gradient=True,
+             stateful_outputs=("ParamOut", "MomentOut"))
+def adagrad(ctx):
+    p = raw_data(ctx.input("Param"))
+    g = raw_data(ctx.input("Grad"))
+    m = raw_data(ctx.input("Moment"))
+    eps = ctx.attr("epsilon", 1e-6)
+    mn = m + g * g
+    ctx.set_output("ParamOut", p - _lr(ctx) * g / (jnp.sqrt(mn) + eps))
+    ctx.set_output("MomentOut", mn)
+
+
+@register_op("decayed_adagrad", no_gradient=True,
+             stateful_outputs=("ParamOut", "MomentOut"))
+def decayed_adagrad(ctx):
+    p = raw_data(ctx.input("Param"))
+    g = raw_data(ctx.input("Grad"))
+    m = raw_data(ctx.input("Moment"))
+    decay = ctx.attr("decay", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    mn = decay * m + (1.0 - decay) * g * g
+    ctx.set_output("ParamOut", p - _lr(ctx) * g / (jnp.sqrt(mn) + eps))
+    ctx.set_output("MomentOut", mn)
+
+
+@register_op("adadelta", no_gradient=True,
+             stateful_outputs=("ParamOut", "AvgSquaredGradOut",
+                               "AvgSquaredUpdateOut"))
+def adadelta(ctx):
+    p = raw_data(ctx.input("Param"))
+    g = raw_data(ctx.input("Grad"))
+    ag = raw_data(ctx.input("AvgSquaredGrad"))
+    au = raw_data(ctx.input("AvgSquaredUpdate"))
+    rho = ctx.attr("rho", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    agn = rho * ag + (1.0 - rho) * g * g
+    upd = -jnp.sqrt((au + eps) / (agn + eps)) * g
+    aun = rho * au + (1.0 - rho) * upd * upd
+    ctx.set_output("ParamOut", p + upd)
+    ctx.set_output("AvgSquaredGradOut", agn)
+    ctx.set_output("AvgSquaredUpdateOut", aun)
+
+
+@register_op("rmsprop", no_gradient=True,
+             stateful_outputs=("ParamOut", "MomentOut", "MeanSquareOut"))
+def rmsprop(ctx):
+    p = raw_data(ctx.input("Param"))
+    g = raw_data(ctx.input("Grad"))
+    ms = raw_data(ctx.input("MeanSquare"))
+    mom = raw_data(ctx.input("Moment"))
+    rho = ctx.attr("decay", 0.9)
+    eps = ctx.attr("epsilon", 1e-10)
+    mu = ctx.attr("momentum", 0.0)
+    msn = rho * ms + (1.0 - rho) * g * g
+    momn = mu * mom + _lr(ctx) * g / jnp.sqrt(msn + eps)
+    ctx.set_output("ParamOut", p - momn)
+    ctx.set_output("MomentOut", momn)
+    ctx.set_output("MeanSquareOut", msn)
+
+
+@register_op("ftrl", no_gradient=True,
+             stateful_outputs=("ParamOut", "SquaredAccumOut", "LinearAccumOut"))
+def ftrl(ctx):
+    p = raw_data(ctx.input("Param"))
+    g = raw_data(ctx.input("Grad"))
+    sq = raw_data(ctx.input("SquaredAccumulator"))
+    lin = raw_data(ctx.input("LinearAccumulator"))
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    lr_power = ctx.attr("lr_power", -0.5)
+    lr = _lr(ctx)
+    new_sq = sq + g * g
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq, -lr_power)) / lr
+    new_lin = lin + g - sigma * p
+    if lr_power == -0.5:
+        denom = jnp.sqrt(new_sq) / lr + 2.0 * l2
+    else:
+        denom = jnp.power(new_sq, -lr_power) / lr + 2.0 * l2
+    pre = jnp.clip(new_lin, -l1, l1) - new_lin
+    ctx.set_output("ParamOut", pre / denom)
+    ctx.set_output("SquaredAccumOut", new_sq)
+    ctx.set_output("LinearAccumOut", new_lin)
+
+
+@register_op("proximal_gd", no_gradient=True, stateful_outputs=("ParamOut",))
+def proximal_gd(ctx):
+    p = raw_data(ctx.input("Param"))
+    g = raw_data(ctx.input("Grad"))
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    lr = _lr(ctx)
+    prox = p - lr * g
+    sign = jnp.sign(prox)
+    ctx.set_output("ParamOut",
+                   sign * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+                   / (1.0 + lr * l2))
+
+
+@register_op("proximal_adagrad", no_gradient=True,
+             stateful_outputs=("ParamOut", "MomentOut"))
+def proximal_adagrad(ctx):
+    p = raw_data(ctx.input("Param"))
+    g = raw_data(ctx.input("Grad"))
+    m = raw_data(ctx.input("Moment"))
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    mn = m + g * g
+    lr = _lr(ctx) / jnp.sqrt(mn + 1e-12)
+    prox = p - lr * g
+    sign = jnp.sign(prox)
+    ctx.set_output("ParamOut",
+                   sign * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+                   / (1.0 + lr * l2))
+    ctx.set_output("MomentOut", mn)

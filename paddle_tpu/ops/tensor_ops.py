@@ -1,0 +1,351 @@
+"""Data/tensor manipulation ops.
+
+reference: paddle/fluid/operators/{fill_constant,uniform_random,gaussian_random,
+assign,cast,concat,split,reshape,transpose,expand,gather,scatter,one_hot,
+lookup_table,shape,pad,slice,...}_op.cc — each a Maker+InferShape+CPU/CUDA
+kernel pair there; here a single jax lowering each, fused by XLA.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import registry
+from ..core.executor import TracedLoD, raw_data, with_lod_of
+from ..core.registry import register_op
+from .common import jdt, prod
+
+
+# -- creation ---------------------------------------------------------------
+
+def _shape_attr(ctx):
+    return [int(d) for d in ctx.attr("shape")]
+
+
+def _infer_from_shape_attr(op, block):
+    for n in op.output("Out"):
+        v = block._find_var_recursive(n)
+        if v is not None and op.attr("shape") is not None:
+            v.shape = tuple(int(d) for d in op.attr("shape"))
+
+
+@register_op("fill_constant", infer_shape=_infer_from_shape_attr)
+def fill_constant(ctx):
+    ctx.set_output("Out", jnp.full(_shape_attr(ctx),
+                                   ctx.attr("value", 0.0),
+                                   dtype=jdt(ctx.attr("dtype"))))
+
+
+@register_op("fill_constant_batch_size_like")
+def fill_constant_batch_size_like(ctx):
+    ref = raw_data(ctx.input("Input"))
+    shape = _shape_attr(ctx)
+    in_idx = ctx.attr("input_dim_idx", 0)
+    out_idx = ctx.attr("output_dim_idx", 0)
+    shape[out_idx] = ref.shape[in_idx]
+    ctx.set_output("Out", jnp.full(shape, ctx.attr("value", 0.0),
+                                   dtype=jdt(ctx.attr("dtype"))))
+
+
+@register_op("fill_zeros_like")
+def fill_zeros_like(ctx):
+    x = ctx.input("X")
+    ctx.set_output("Out", with_lod_of(x, jnp.zeros_like(raw_data(x))))
+
+
+@register_op("uniform_random", infer_shape=_infer_from_shape_attr,
+             no_gradient=True)
+def uniform_random(ctx):
+    key = ctx.next_rng()
+    ctx.set_output("Out", jax.random.uniform(
+        key, _shape_attr(ctx), dtype=jdt(ctx.attr("dtype")),
+        minval=ctx.attr("min", -1.0), maxval=ctx.attr("max", 1.0)))
+
+
+@register_op("gaussian_random", infer_shape=_infer_from_shape_attr,
+             no_gradient=True)
+def gaussian_random(ctx):
+    key = ctx.next_rng()
+    out = jax.random.normal(key, _shape_attr(ctx), dtype=jdt(ctx.attr("dtype")))
+    ctx.set_output("Out", out * ctx.attr("std", 1.0) + ctx.attr("mean", 0.0))
+
+
+@register_op("truncated_gaussian_random", infer_shape=_infer_from_shape_attr,
+             no_gradient=True)
+def truncated_gaussian_random(ctx):
+    key = ctx.next_rng()
+    out = jax.random.truncated_normal(key, -2.0, 2.0, _shape_attr(ctx),
+                                      dtype=jdt(ctx.attr("dtype")))
+    ctx.set_output("Out", out * ctx.attr("std", 1.0) + ctx.attr("mean", 0.0))
+
+
+@register_op("assign")
+def assign(ctx):
+    ctx.set_output("Out", ctx.input("X"))
+
+
+@register_op("shape", no_gradient=True)
+def shape_op(ctx):
+    x = raw_data(ctx.input("Input") if ctx.has_input("Input") else ctx.input("X"))
+    ctx.set_output("Out", jnp.asarray(x.shape, dtype=jnp.int64))
+
+
+@register_op("cast")
+def cast(ctx):
+    x = ctx.input("X")
+    ctx.set_output("Out", with_lod_of(
+        x, raw_data(x).astype(jdt(ctx.attr("out_dtype")))))
+
+
+def _infer_elem_like(op, block, in_slot="X"):
+    names = op.input(in_slot)
+    if not names:
+        return
+    iv = block._find_var_recursive(names[0])
+    for n in op.output("Out"):
+        ov = block._find_var_recursive(n)
+        if ov is not None and iv is not None:
+            ov.shape = iv.shape
+            if ov.dtype is None:
+                ov.dtype = iv.dtype
+
+
+registry.set_infer_shape("assign", _infer_elem_like)
+registry.set_infer_shape("fill_zeros_like", _infer_elem_like)
+
+
+# -- shaping ----------------------------------------------------------------
+
+def _resolve_shape(shape, x):
+    shape = list(int(d) for d in shape)
+    total = prod(x.shape)
+    if 0 in shape:
+        shape = [x.shape[i] if d == 0 else d for i, d in enumerate(shape)]
+    if -1 in shape:
+        known = prod(d for d in shape if d != -1)
+        shape[shape.index(-1)] = total // max(known, 1)
+    return shape
+
+
+def _infer_reshape(op, block):
+    iv = block._find_var_recursive(op.input("X")[0])
+    ov = block._find_var_recursive(op.output("Out")[0])
+    if iv is None or ov is None or iv.shape is None:
+        return
+    shape = list(op.attr("shape"))
+    if -1 not in iv.shape:
+        ov.shape = tuple(_resolve_shape(shape, _FakeShaped(iv.shape)))
+    else:
+        ov.shape = tuple(shape)
+    ov.dtype = iv.dtype
+
+
+class _FakeShaped(object):
+    def __init__(self, shape):
+        self.shape = tuple(shape)
+
+
+@register_op("reshape", infer_shape=_infer_reshape)
+def reshape(ctx):
+    x = raw_data(ctx.input("X"))
+    ctx.set_output("Out", jnp.reshape(x, _resolve_shape(ctx.attr("shape"), x)))
+
+
+@register_op("squeeze")
+def squeeze(ctx):
+    x = raw_data(ctx.input("X"))
+    axes = ctx.attr("axes") or [i for i, d in enumerate(x.shape) if d == 1]
+    ctx.set_output("Out", jnp.squeeze(x, axis=tuple(axes)))
+
+
+@register_op("unsqueeze")
+def unsqueeze(ctx):
+    x = raw_data(ctx.input("X"))
+    out = x
+    for a in sorted(ctx.attr("axes")):
+        out = jnp.expand_dims(out, a)
+    ctx.set_output("Out", out)
+
+
+def _infer_transpose(op, block):
+    iv = block._find_var_recursive(op.input("X")[0])
+    ov = block._find_var_recursive(op.output("Out")[0])
+    if iv is not None and ov is not None and iv.shape is not None:
+        ov.shape = tuple(iv.shape[a] for a in op.attr("axis"))
+        ov.dtype = iv.dtype
+
+
+@register_op("transpose", infer_shape=_infer_transpose)
+def transpose(ctx):
+    ctx.set_output("Out", jnp.transpose(raw_data(ctx.input("X")),
+                                        ctx.attr("axis")))
+
+
+@register_op("expand")
+def expand(ctx):
+    x = raw_data(ctx.input("X"))
+    times = ctx.attr("expand_times")
+    ctx.set_output("Out", jnp.tile(x, times))
+
+
+def _infer_concat(op, block):
+    vs = [block._find_var_recursive(n) for n in op.input("X")]
+    ov = block._find_var_recursive(op.output("Out")[0])
+    if ov is None or any(v is None or v.shape is None for v in vs):
+        return
+    axis = op.attr("axis", 0)
+    shape = list(vs[0].shape)
+    if all(v.shape[axis] != -1 for v in vs):
+        shape[axis] = sum(v.shape[axis] for v in vs)
+    ov.shape = tuple(shape)
+    ov.dtype = vs[0].dtype
+
+
+@register_op("concat", infer_shape=_infer_concat)
+def concat(ctx):
+    xs = [raw_data(v) for v in ctx.inputs("X")]
+    ctx.set_output("Out", jnp.concatenate(xs, axis=ctx.attr("axis", 0)))
+
+
+@register_op("split")
+def split(ctx):
+    x = raw_data(ctx.input("X"))
+    axis = ctx.attr("axis", 0)
+    sections = ctx.attr("sections")
+    num = ctx.attr("num", 0)
+    if sections:
+        idx = np.cumsum(sections)[:-1].tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num or len(ctx.output_names("Out")), axis=axis)
+    ctx.set_outputs("Out", outs)
+
+
+@register_op("gather")
+def gather(ctx):
+    x = raw_data(ctx.input("X"))
+    idx = raw_data(ctx.input("Index")).astype(jnp.int32).reshape(-1)
+    ctx.set_output("Out", jnp.take(x, idx, axis=0))
+
+
+@register_op("scatter")
+def scatter(ctx):
+    x = raw_data(ctx.input("X"))
+    idx = raw_data(ctx.input("Ids")).astype(jnp.int32).reshape(-1)
+    upd = raw_data(ctx.input("Updates"))
+    ctx.set_output("Out", x.at[idx].set(upd))
+
+
+@register_op("one_hot", no_gradient=True)
+def one_hot(ctx):
+    x = raw_data(ctx.input("X")).astype(jnp.int32)
+    depth = ctx.attr("depth")
+    flat = x.reshape(x.shape[:-1] if x.shape and x.shape[-1] == 1 else x.shape)
+    out = jax.nn.one_hot(flat, depth, dtype=jdt(ctx.attr("dtype"), "float32"))
+    ctx.set_output("Out", out)
+
+
+@register_op("pad")
+def pad(ctx):
+    x = raw_data(ctx.input("X"))
+    p = ctx.attr("paddings")
+    cfg = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    ctx.set_output("Out", jnp.pad(x, cfg, constant_values=ctx.attr("pad_value", 0.0)))
+
+
+@register_op("slice")
+def slice_op(ctx):
+    x = raw_data(ctx.input("Input"))
+    axes = ctx.attr("axes")
+    starts, ends = ctx.attr("starts"), ctx.attr("ends")
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        idx[a] = slice(s, e)
+    ctx.set_output("Out", x[tuple(idx)])
+
+
+@register_op("crop")
+def crop(ctx):
+    x = raw_data(ctx.input("X"))
+    offsets = ctx.attr("offsets")
+    shape = ctx.attr("shape")
+    if ctx.has_input("Y"):
+        shape = raw_data(ctx.input("Y")).shape
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    ctx.set_output("Out", x[idx])
+
+
+@register_op("lookup_table")
+def lookup_table(ctx):
+    """Embedding lookup. reference: operators/lookup_table_op.cc (CUDA gather
+    kernel + SelectedRows grad); here one jnp.take the MXU-adjacent gather,
+    grads handled by generic vjp (dense) — the sparse SelectedRows grad path
+    lives in ops/selected_rows.py for the distributed embedding story."""
+    w = raw_data(ctx.input("W"))
+    ids_v = ctx.input("Ids")
+    ids = raw_data(ids_v).astype(jnp.int32)
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
+    padding_idx = ctx.attr("padding_idx", -1)
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    ctx.set_output("Out", with_lod_of(ids_v, out))
+
+
+@register_op("increment", stateful_outputs=("Out",))
+def increment(ctx):
+    x = raw_data(ctx.input("X"))
+    ctx.set_output("Out", x + ctx.attr("step", 1.0))
+
+
+@register_op("is_empty", no_gradient=True)
+def is_empty(ctx):
+    x = raw_data(ctx.input("X"))
+    ctx.set_output("Out", jnp.asarray(prod(x.shape) == 0))
+
+
+@register_op("arg_max", no_gradient=True)
+def arg_max(ctx):
+    x = raw_data(ctx.input("X"))
+    ctx.set_output("Out", jnp.argmax(x, axis=ctx.attr("axis", -1)).astype(jnp.int64))
+
+
+@register_op("arg_min", no_gradient=True)
+def arg_min(ctx):
+    x = raw_data(ctx.input("X"))
+    ctx.set_output("Out", jnp.argmin(x, axis=ctx.attr("axis", -1)).astype(jnp.int64))
+
+
+@register_op("argsort", no_gradient=True)
+def argsort(ctx):
+    x = raw_data(ctx.input("X"))
+    axis = ctx.attr("axis", -1)
+    idx = jnp.argsort(x, axis=axis)
+    ctx.set_output("Indices", idx.astype(jnp.int64))
+    ctx.set_output("Out", jnp.sort(x, axis=axis))
+
+
+@register_op("range", no_gradient=True, host=True)
+def range_op(ctx):
+    start = raw_data(ctx.input("Start")).reshape(())
+    end = raw_data(ctx.input("End")).reshape(())
+    step = raw_data(ctx.input("Step")).reshape(())
+    # static shapes demand concrete bounds; range is host-built in practice
+    ctx.set_output("Out", jnp.arange(int(start), int(end), int(step)))
+
+
+@register_op("assign_value", no_gradient=True,
+             infer_shape=_infer_from_shape_attr)
+def assign_value(ctx):
+    vals = np.asarray(ctx.attr("values"))
+    ctx.set_output("Out", jnp.asarray(vals.astype(jdt(ctx.attr("dtype"),
+                                                      str(vals.dtype)))))
+
+
+@register_op("reverse")
+def reverse(ctx):
+    x = raw_data(ctx.input("X"))
+    ctx.set_output("Out", jnp.flip(x, axis=tuple(ctx.attr("axis"))))

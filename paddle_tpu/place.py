@@ -1,0 +1,44 @@
+"""Places: where computation runs.
+
+reference: paddle/fluid/platform/place.h:53 (boost::variant<CUDAPlace,
+CPUPlace>). Here the accelerator is TPU; CPUPlace maps to the jax cpu backend
+(used by the 8-virtual-device test mesh). A Place pins which jax backend the
+Executor uses; multi-chip placement is expressed with meshes
+(paddle_tpu.parallel), not per-device Places.
+"""
+from __future__ import annotations
+
+import jax
+
+
+class Place(object):
+    backend = None
+
+    def __eq__(self, other):
+        return type(self) is type(other) and getattr(self, "device_id", 0) == \
+            getattr(other, "device_id", 0)
+
+    def __repr__(self):
+        return type(self).__name__ + "()"
+
+
+class TPUPlace(Place):
+    backend = "tpu"
+
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+
+class CPUPlace(Place):
+    backend = "cpu"
+
+
+# alias kept for reference-API compatibility (CUDAPlace -> accelerator place)
+CUDAPlace = TPUPlace
+
+
+def is_compiled_with_tpu() -> bool:
+    try:
+        return any(d.platform != "cpu" for d in jax.devices())
+    except RuntimeError:
+        return False

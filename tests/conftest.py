@@ -1,0 +1,35 @@
+import os
+
+# 8 virtual CPU devices: the multi-chip sharding tests run on a CPU mesh
+# (real multi-chip TPU isn't available in CI; the sharding lowering is
+# identical, only the collective fabric differs).
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+# The env image's sitecustomize imports jax at interpreter start with
+# JAX_PLATFORMS=axon already snapshotted, so the env var above can be too
+# late — force the config directly before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    """Each test gets fresh default programs, scope, and name counters."""
+    import paddle_tpu as pt
+    from paddle_tpu.core import unique_name
+
+    main, startup = pt.Program(), pt.Program()
+    old_main = pt.switch_main_program(main)
+    old_startup = pt.switch_startup_program(startup)
+    scope = pt.Scope()
+    with unique_name.guard():
+        with pt.scope_guard(scope):
+            yield
+    pt.switch_main_program(old_main)
+    pt.switch_startup_program(old_startup)

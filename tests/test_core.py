@@ -1,0 +1,179 @@
+"""Core substrate tests: IR, executor, backward, optimizer convergence.
+
+Modeled on the reference's framework tests + book/test_fit_a_line.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def test_program_ir_build():
+    x = layers.data(name="x", shape=[13])
+    y = layers.fc(input=x, size=1)
+    prog = pt.default_main_program()
+    assert x.shape == (-1, 13)
+    assert y.shape == (-1, 1)
+    types = [op.type for op in prog.global_block().ops]
+    assert "mul" in types and "elementwise_add" in types
+    params = prog.all_parameters()
+    assert len(params) == 2
+    assert sorted(p.shape for p in params) == [(1,), (13, 1)]
+
+
+def test_executor_forward():
+    x = layers.data(name="x", shape=[4])
+    y = layers.fc(input=x, size=3, act="relu",
+                  param_attr=pt.ParamAttr(initializer=pt.Constant(0.5)),
+                  bias_attr=pt.ParamAttr(initializer=pt.Constant(1.0)))
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    xv = np.ones((2, 4), dtype=np.float32)
+    (out,) = exe.run(feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(out, np.full((2, 3), 3.0), rtol=1e-6)
+
+
+def test_fill_and_fetch():
+    c = layers.fill_constant(shape=[2, 3], dtype="float32", value=7.0)
+    exe = pt.Executor()
+    (out,) = exe.run(fetch_list=[c])
+    np.testing.assert_allclose(out, np.full((2, 3), 7.0))
+
+
+def test_backward_grads_match_numeric():
+    x = layers.data(name="x", shape=[3])
+    w_init = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], dtype=np.float32)
+    y = layers.fc(input=x, size=2, bias_attr=False,
+                  param_attr=pt.ParamAttr(name="w_fc"))
+    loss = layers.mean(y)
+    pg = pt.append_backward(loss)
+    assert len(pg) == 1
+    p, g = pg[0]
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    pt.global_scope().set_var("w_fc", w_init)
+    xv = np.array([[1.0, 0.5, -1.0], [2.0, 1.0, 0.0]], dtype=np.float32)
+    (gv,) = exe.run(feed={"x": xv}, fetch_list=[g])
+    # d(mean)/dW = x^T @ ones/(N*2)
+    expected = xv.T @ np.full((2, 2), 1.0 / 4.0)
+    np.testing.assert_allclose(gv, expected, rtol=1e-5)
+
+
+def test_grad_accumulation_multi_consumer():
+    # x used by two ops -> grads must sum
+    x = layers.data(name="x", shape=[2])
+    x.stop_gradient = False
+    a = layers.scale(x, scale=2.0)
+    b = layers.scale(x, scale=3.0)
+    s = layers.elementwise_add(a, b)
+    loss = layers.reduce_sum(s)
+    grads = pt.calc_gradient(loss, [x])
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    xv = np.ones((1, 2), dtype=np.float32)
+    (gv,) = exe.run(feed={"x": xv}, fetch_list=[grads[0]])
+    np.testing.assert_allclose(gv, np.full((1, 2), 5.0), rtol=1e-6)
+
+
+def test_sgd_linear_regression_converges():
+    """reference: book/test_fit_a_line.py — train until loss small."""
+    rng = np.random.RandomState(0)
+    true_w = rng.randn(4, 1).astype(np.float32)
+    x = layers.data(name="x", shape=[4])
+    y = layers.data(name="y", shape=[1])
+    pred = layers.fc(input=x, size=1)
+    loss = layers.mean(layers.square_error_cost(input=pred, label=y))
+    opt = pt.optimizer.SGDOptimizer(learning_rate=0.1)
+    opt.minimize(loss)
+
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    losses = []
+    for i in range(60):
+        xv = rng.randn(32, 4).astype(np.float32)
+        yv = xv @ true_w
+        (lv,) = exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < 0.02, losses[-10:]
+    assert losses[-1] < losses[0] * 0.1
+
+
+def test_adam_classification_converges():
+    rng = np.random.RandomState(1)
+    x = layers.data(name="x", shape=[10])
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    h = layers.fc(input=x, size=32, act="relu")
+    logits = layers.fc(input=h, size=3)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    pt.optimizer.AdamOptimizer(learning_rate=0.01).minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    w = rng.randn(10, 3).astype(np.float32)
+    first = last = None
+    for i in range(80):
+        xv = rng.randn(64, 10).astype(np.float32)
+        yv = np.argmax(xv @ w, axis=1).astype(np.int64)[:, None]
+        (lv,) = exe.run(feed={"x": xv, "label": yv}, fetch_list=[loss])
+        if first is None:
+            first = float(lv)
+        last = float(lv)
+    assert last < first * 0.5, (first, last)
+
+
+def test_momentum_and_other_optimizers_run():
+    for opt in [pt.optimizer.MomentumOptimizer(0.01, momentum=0.9),
+                pt.optimizer.AdagradOptimizer(0.01),
+                pt.optimizer.RMSPropOptimizer(0.01),
+                pt.optimizer.AdadeltaOptimizer(1.0),
+                pt.optimizer.AdamaxOptimizer(0.01),
+                pt.optimizer.DecayedAdagradOptimizer(0.01),
+                pt.optimizer.FtrlOptimizer(0.05)]:
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data(name="x", shape=[4])
+            y = layers.data(name="y", shape=[1])
+            pred = layers.fc(input=x, size=1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            opt.minimize(loss)
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            xv = np.ones((8, 4), dtype=np.float32)
+            yv = np.ones((8, 1), dtype=np.float32)
+            l0 = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])[0]
+            for _ in range(10):
+                (l1,) = exe.run(main, feed={"x": xv, "y": yv},
+                                fetch_list=[loss])
+            assert float(l1) < float(l0), (opt.type, float(l0), float(l1))
+
+
+def test_regularizer_and_clip():
+    x = layers.data(name="x", shape=[4])
+    y = layers.data(name="y", shape=[1])
+    pred = layers.fc(input=x, size=1,
+                     param_attr=pt.ParamAttr(
+                         regularizer=pt.regularizer.L2Decay(0.1)))
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    pt.clip.set_gradient_clip(pt.clip.GradientClipByValue(0.1))
+    opt = pt.optimizer.SGDOptimizer(0.1)
+    opt.minimize(loss)
+    exe = pt.Executor()
+    exe.run(pt.default_startup_program())
+    xv = np.ones((4, 4), dtype=np.float32)
+    yv = np.ones((4, 1), dtype=np.float32)
+    exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+
+
+def test_program_clone_for_test_freezes_dropout():
+    x = layers.data(name="x", shape=[8])
+    h = layers.dropout(layers.fc(input=x, size=8), dropout_prob=0.5)
+    prog = pt.default_main_program()
+    test_prog = prog.clone(for_test=True)
+    d_ops = [op for op in test_prog.global_block().ops
+             if op.type == "dropout"]
+    assert d_ops and all(op.attr("is_test") for op in d_ops)
+    # original untouched
+    assert not any(op.attr("is_test") for op in
+                   prog.global_block().ops if op.type == "dropout")
