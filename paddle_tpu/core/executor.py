@@ -246,6 +246,32 @@ def _to_device_value(v, device=None):
     return jax.device_put(np.asarray(v), device)
 
 
+def _dist_shardings(dist, state, feed):
+    """in_shardings pytree for ``fn(state, feed, rng_key)`` under a mesh.
+
+    Params/persistables follow the DistContext's spec map; feeds shard their
+    batch (leading) dim over the data axis when divisible, else replicate;
+    LoD offset arrays are global (replicated) alongside batch-sharded data;
+    the RNG key replicates. This is the whole 'distribute transpile' at the
+    execution layer — XLA GSPMD derives every collective from these seeds
+    (replaces reference: distribute_transpiler.py:132 program rewriting).
+    """
+    from jax.sharding import NamedSharding
+    mesh = dist.mesh
+    repl = dist.replicated()
+
+    def feed_shard(name, v):
+        if isinstance(v, TracedLoD):
+            # LoD offsets are global: replicate alongside batch-sharded data
+            return TracedLoD(feed_shard(name, v.data), (repl,) * len(v.lod))
+        spec = dist.strategy.spec_for_feed(name, getattr(v, "shape", ()), mesh)
+        return NamedSharding(mesh, spec)
+
+    state_sh = {n: dist.sharding_for(n, v) for n, v in state.items()}
+    feed_sh = {n: feed_shard(n, v) for n, v in feed.items()}
+    return (state_sh, feed_sh, repl)
+
+
 def _fetch_to_host(val, return_numpy=True):
     if isinstance(val, TracedLoD):
         t = LoDTensor(np.asarray(val.data),
@@ -260,11 +286,14 @@ class Executor(object):
     """reference: python/paddle/fluid/executor.py:166 (class Executor) /
     paddle/fluid/framework/executor.cc:86 (Executor::Run)."""
 
-    def __init__(self, place=None):
+    def __init__(self, place=None, dist_context=None):
         from .. import place as place_mod
         self.place = place if place is not None else place_mod.TPUPlace()
         self._cache: Dict[Any, Any] = {}
         self._device_cache = None
+        # DistContext from paddle_tpu.parallel: when set, the jitted block is
+        # compiled with mesh shardings (SPMD) instead of pinned to one device
+        self.dist_context = dist_context
 
     def _device(self):
         """Resolve the jax device this Place pins; None = jax default."""
@@ -282,16 +311,25 @@ class Executor(object):
     # -- public API ----------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_jit=True, feed_var_name="feed",
-            fetch_var_name="fetch"):
+            fetch_var_name="fetch", dist_context=None):
         program = program if program is not None else ir.default_main_program()
         scope = scope if scope is not None else global_scope()
         feed = feed or {}
         fetch_list = fetch_list or []
         fetch_names = [f.name if isinstance(f, ir.Variable) else f
                        for f in fetch_list]
+        dist = dist_context if dist_context is not None else self.dist_context
 
-        dev_feed = {k: _to_device_value(v, self._device())
-                    for k, v in feed.items()}
+        # under a mesh, leave feeds uncommitted: jit's in_shardings place them
+        dev = None if dist is not None else self._device()
+        dev_feed = {k: _to_device_value(v, dev) for k, v in feed.items()}
+        if dist is not None:
+            # host ops (save/load) can't be jit-traced; the eager path works
+            # on sharded buffers too (np.asarray gathers), so fall through
+            if not (_is_host_block(program.global_block()) or not use_jit):
+                return [_fetch_to_host(o, return_numpy) for o in
+                        self._run_jit(program, dev_feed, fetch_names, scope,
+                                      dist=dist)]
         block = program.global_block()
 
         if _is_host_block(block) or not use_jit:
@@ -314,17 +352,27 @@ class Executor(object):
         return [env[n] for n in fetch_names]
 
     # -- jit path --------------------------------------------------------------
-    def _run_jit(self, program, feed, fetch_names, scope):
+    def _run_jit(self, program, feed, fetch_names, scope, dist=None):
         state_names = self._state_inputs(program, scope, feed)
         state = {n: scope.find_var(n) for n in state_names}
+        if dist is not None:
+            # align committed buffers with the declared shardings (no-op when
+            # already placed; reshards e.g. replicated startup output → tp)
+            state = {n: jax.device_put(v, dist.sharding_for(n, v))
+                     for n, v in state.items()}
         key = (program._uid, program._version, _feed_signature(feed),
-               tuple(fetch_names), tuple(sorted(
+               tuple(fetch_names),
+               dist.cache_token() if dist is not None else None,
+               tuple(sorted(
                    (n, tuple(getattr(v, "shape", ())),
                     str(getattr(v, "dtype", type(v).__name__)))
                    for n, v in state.items())))
         fn = self._cache.get(key)
         if fn is None:
-            fn = self._compile(program, feed, fetch_names, state_names)
+            shardings = (_dist_shardings(dist, state, feed)
+                         if dist is not None else None)
+            fn = self._compile(program, feed, fetch_names, state_names,
+                               shardings=shardings)
             self._cache[key] = fn
         rng_key = self._rng_key(program, scope)
         fetches, new_state, new_key = fn(state, feed, rng_key)
@@ -333,7 +381,8 @@ class Executor(object):
         scope.set_var(RNG_VAR, new_key)
         return fetches
 
-    def _compile(self, program, feed_template, fetch_names, state_names):
+    def _compile(self, program, feed_template, fetch_names, state_names,
+                 shardings=None):
         block = program.global_block()
         persist = self._persistable_names(program)
         written = {n for op_ in _iter_ops(block) for n in op_.output_arg_names}
@@ -356,6 +405,8 @@ class Executor(object):
             fetches = [env[n] for n in fetch_names]
             return fetches, new_state, rng.key
 
+        if shardings is not None:
+            return jax.jit(fn, donate_argnums=(0,), in_shardings=shardings)
         return jax.jit(fn, donate_argnums=(0,))
 
     # -- helpers ---------------------------------------------------------------

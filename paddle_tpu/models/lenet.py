@@ -1,0 +1,30 @@
+"""LeNet-5 for MNIST.
+
+reference: python/paddle/fluid/tests/book/test_recognize_digits.py (conv_net)
+— conv-pool x2 + fc, the book's canonical digit recognizer.
+"""
+from __future__ import annotations
+
+from .. import layers
+
+
+def _conv_pool(input, num_filters, filter_size, pool_size, pool_stride, act):
+    conv = layers.conv2d(input, num_filters=num_filters,
+                         filter_size=filter_size, act=act)
+    return layers.pool2d(conv, pool_size=pool_size, pool_stride=pool_stride,
+                         pool_type="max")
+
+
+def lenet5(img, label=None, class_num=10):
+    """Returns (prediction, avg_cost, acc) — cost/acc are None without label."""
+    c1 = _conv_pool(img, num_filters=20, filter_size=5, pool_size=2,
+                    pool_stride=2, act="relu")
+    c2 = _conv_pool(c1, num_filters=50, filter_size=5, pool_size=2,
+                    pool_stride=2, act="relu")
+    prediction = layers.fc(c2, size=class_num, act="softmax")
+    if label is None:
+        return prediction, None, None
+    cost = layers.cross_entropy(prediction, label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(prediction, label)
+    return prediction, avg_cost, acc

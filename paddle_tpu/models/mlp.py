@@ -1,0 +1,22 @@
+"""Simple MLP (the book's "multilayer_perceptron").
+
+reference: python/paddle/fluid/tests/book/test_recognize_digits.py (mlp),
+test_fit_a_line.py (single fc regressor).
+"""
+from __future__ import annotations
+
+from .. import layers
+
+
+def mlp(x, label=None, hidden_sizes=(200, 200), class_num=10,
+        act="relu", pred_act="softmax"):
+    h = x
+    for size in hidden_sizes:
+        h = layers.fc(h, size=size, act=act)
+    prediction = layers.fc(h, size=class_num, act=pred_act)
+    if label is None:
+        return prediction, None, None
+    cost = layers.cross_entropy(prediction, label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(prediction, label)
+    return prediction, avg_cost, acc

@@ -455,7 +455,7 @@ def layer_norm(ctx):
     ctx.set_output("Variance", var.reshape(-1))
 
 
-@register_op("lrn")
+@register_op("lrn", infer_shape=_infer_same)
 def lrn(ctx):
     """reference: operators/lrn_op.cc — cross-channel local response norm."""
     x = raw_data(ctx.input("X"))

@@ -1,0 +1,17 @@
+"""Distributed execution: device meshes + sharding assignment.
+
+The reference distributes three ways — MultiGradientMachine thread-ring
+(reference: paddle/gserver/gradientmachines/MultiGradientMachine.h:44-99),
+NCCL collective ops (reference: paddle/fluid/operators/nccl_op.cc:95), and
+parameter servers reached by a program-rewriting DistributeTranspiler
+(reference: python/paddle/fluid/distribute_transpiler.py:132). On TPU all
+three collapse into one mechanism: place the program's tensors on a
+`jax.sharding.Mesh` and let XLA GSPMD insert all-reduce/all-gather over ICI.
+The transpiler therefore *assigns shardings* instead of rewriting the program
+into send/recv ops.
+"""
+from .mesh import make_mesh, get_default_mesh, set_default_mesh  # noqa: F401
+from .api import (  # noqa: F401
+    DistContext, ShardingStrategy, DistributeTranspiler, data_parallel,
+)
+from .env import get_world_size, get_rank, init_distributed  # noqa: F401

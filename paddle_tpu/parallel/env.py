@@ -1,0 +1,40 @@
+"""Multi-host process environment.
+
+Replaces etcd discovery + the Go master/pserver bootstrap
+(reference: go/pserver/etcd_client.go:70-204, go/master/service.go) with the
+JAX distributed coordination service: one coordinator address, every host
+calls ``init_distributed`` and the runtime wires global device ids.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None):
+    """Initialise multi-host JAX. No-op when single-process (the common
+    dev/test path). Env fallbacks mirror the reference's flags
+    (trainer_id/num_gradient_servers, reference: paddle/utils/Flags.cpp:44-65).
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "PADDLE_TPU_COORDINATOR")
+    if coordinator_address is None:
+        return False
+    num_processes = num_processes or int(os.environ.get(
+        "PADDLE_TPU_NUM_PROCESSES", "1"))
+    process_id = process_id if process_id is not None else int(os.environ.get(
+        "PADDLE_TPU_PROCESS_ID", "0"))
+    jax.distributed.initialize(coordinator_address, num_processes, process_id)
+    return True
+
+
+def get_world_size() -> int:
+    return jax.process_count()
+
+
+def get_rank() -> int:
+    return jax.process_index()

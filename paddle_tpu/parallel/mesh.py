@@ -1,0 +1,57 @@
+"""Device mesh construction.
+
+Replaces the reference's device bookkeeping (trainer_count flag,
+reference: paddle/utils/Flags.cpp:18-95; Communicator over GPU ids,
+reference: paddle/fluid/operators/nccl/nccl_gpu_common.h) with a named
+`jax.sharding.Mesh`: axis names are the parallelism dimensions (dp/tp/pp/sp)
+and collectives ride ICI within a slice, DCN across slices.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+_default_mesh: Optional[Mesh] = None
+
+
+def make_mesh(axes: Dict[str, int], devices=None) -> Mesh:
+    """Build a Mesh from ``{"dp": 4, "tp": 2}``-style axis sizes.
+
+    ``-1`` for at most one axis means "all remaining devices". Axis order is
+    the dict order: put the fastest-varying (most bandwidth-hungry, e.g. tp)
+    axis last so it lands on adjacent ICI neighbours.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = dict(axes)
+    known = 1
+    wild = None
+    for k, v in sizes.items():
+        if v == -1:
+            if wild is not None:
+                raise ValueError("only one axis may be -1")
+            wild = k
+        else:
+            known *= v
+    if wild is not None:
+        if len(devices) % known:
+            raise ValueError("%d devices not divisible by %d" %
+                             (len(devices), known))
+        sizes[wild] = len(devices) // known
+    total = int(np.prod(list(sizes.values())))
+    if total > len(devices):
+        raise ValueError("mesh needs %d devices, have %d" %
+                         (total, len(devices)))
+    arr = np.array(devices[:total]).reshape(list(sizes.values()))
+    return Mesh(arr, tuple(sizes.keys()))
+
+
+def set_default_mesh(mesh: Optional[Mesh]):
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def get_default_mesh() -> Optional[Mesh]:
+    return _default_mesh

@@ -1,0 +1,89 @@
+"""Model-zoo smoke tests: build each model, run one jitted forward pass
+(reference test analog: python/paddle/fluid/tests/book/ quick-build portions;
+benchmark configs benchmark/paddle/image/*.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, models
+
+
+def _run_classifier(build_fn, in_shape, class_dim):
+    img = layers.data("img", shape=in_shape, dtype="float32")
+    label = layers.data("label", shape=[1], dtype="int64")
+    pred = build_fn(img)
+    cost = layers.cross_entropy(pred, label)
+    avg = layers.mean(cost)
+    exe = pt.Executor(pt.TPUPlace(0))
+    exe.run(pt.default_startup_program())
+    bs = 2
+    feed = {
+        "img": np.random.rand(bs, *in_shape).astype("float32"),
+        "label": np.random.randint(0, class_dim, (bs, 1)).astype("int64"),
+    }
+    out, = exe.run(pt.default_main_program(), feed=feed, fetch_list=[avg])
+    assert np.isfinite(out).all()
+    return out
+
+
+def test_lenet5():
+    img = layers.data("img", shape=[1, 28, 28], dtype="float32")
+    label = layers.data("label", shape=[1], dtype="int64")
+    pred, avg, acc = models.lenet5(img, label)
+    exe = pt.Executor(pt.TPUPlace(0))
+    exe.run(pt.default_startup_program())
+    feed = {"img": np.random.rand(4, 1, 28, 28).astype("float32"),
+            "label": np.random.randint(0, 10, (4, 1)).astype("int64")}
+    a, c = exe.run(pt.default_main_program(), feed=feed,
+                   fetch_list=[avg, acc])
+    assert np.isfinite(a) and 0.0 <= float(c) <= 1.0
+
+
+def test_mlp_trains():
+    x = layers.data("x", shape=[64], dtype="float32")
+    label = layers.data("label", shape=[1], dtype="int64")
+    pred, avg, _ = models.mlp(x, label, hidden_sizes=(32,), class_num=4)
+    opt = pt.SGD(learning_rate=0.1)
+    opt.minimize(avg)
+    exe = pt.Executor(pt.TPUPlace(0))
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(0)
+    xs = rng.rand(16, 64).astype("float32")
+    ys = (xs.sum(1, keepdims=True) > 32).astype("int64")
+    losses = []
+    for _ in range(30):
+        l, = exe.run(pt.default_main_program(),
+                     feed={"x": xs, "label": ys}, fetch_list=[avg])
+        losses.append(float(l))
+    assert losses[-1] < losses[0]
+
+
+def test_resnet_cifar():
+    _run_classifier(lambda im: models.resnet_cifar10(im, depth=20),
+                    [3, 32, 32], 10)
+
+
+def test_resnet50_imagenet_builds():
+    img = layers.data("img", shape=[3, 224, 224], dtype="float32")
+    pred = models.resnet_imagenet(img, class_dim=1000, depth=50)
+    assert pred.shape[-1] == 1000
+    # count of conv ops should match 53 convs of resnet-50 (incl. shortcuts)
+    n_convs = sum(1 for op in pt.default_main_program().global_block().ops
+                  if op.type == "conv2d")
+    assert n_convs == 53
+
+
+def test_vgg_cifar():
+    _run_classifier(lambda im: models.vgg_cifar(im), [3, 32, 32], 10)
+
+
+def test_alexnet_builds():
+    img = layers.data("img", shape=[3, 224, 224], dtype="float32")
+    pred = models.alexnet(img)
+    assert pred.shape[-1] == 1000
+
+
+def test_googlenet_builds():
+    img = layers.data("img", shape=[3, 224, 224], dtype="float32")
+    pred = models.googlenet(img)
+    assert pred.shape[-1] == 1000
