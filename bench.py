@@ -1,0 +1,70 @@
+"""Headline benchmark: ResNet-50 training throughput, one chip.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's best published single-device ResNet-50 training
+number, 84.08 images/sec (reference: benchmark/IntelOptimizedPaddle.md:40-46,
+2S Xeon 6148; its GPU tables stop at AlexNet/GoogLeNet on K40m). See
+BASELINE.md.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 84.08
+
+
+def main():
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu import layers, models
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+
+    main_p, startup = pt.Program(), pt.Program()
+    pt.switch_main_program(main_p)
+    pt.switch_startup_program(startup)
+
+    img = layers.data("img", shape=[3, 224, 224], dtype="float32")
+    label = layers.data("label", shape=[1], dtype="int64")
+    pred = models.resnet_imagenet(img, class_dim=1000, depth=50)
+    cost = layers.cross_entropy(pred, label)
+    avg = layers.mean(cost)
+    pt.Momentum(learning_rate=0.1, momentum=0.9).minimize(avg)
+
+    exe = pt.Executor(pt.TPUPlace(0))
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.rand(batch, 3, 224, 224).astype("float32"),
+            "label": rng.randint(0, 1000, (batch, 1)).astype("int64")}
+
+    # warmup (compile + 2 steps)
+    for _ in range(3):
+        loss, = exe.run(main_p, feed=feed, fetch_list=[avg],
+                        return_numpy=False)
+    np.asarray(loss)  # sync
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, = exe.run(main_p, feed=feed, fetch_list=[avg],
+                        return_numpy=False)
+    np.asarray(loss)  # sync
+    dt = time.perf_counter() - t0
+
+    img_s = batch * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(img_s, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

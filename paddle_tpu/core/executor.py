@@ -77,14 +77,15 @@ class RngSource(object):
 class LowerContext(object):
     """What an op lowering sees: traced inputs, attrs, output setter, RNG."""
 
-    __slots__ = ("op", "env", "rng", "block", "executor_hooks")
+    __slots__ = ("op", "env", "rng", "block", "value_hook")
 
     def __init__(self, op: ir.Operator, env: Dict[str, Any], rng: RngSource,
-                 block: ir.Block):
+                 block: ir.Block, value_hook=None):
         self.op = op
         self.env = env
         self.rng = rng
         self.block = block
+        self.value_hook = value_hook
 
     # inputs -----------------------------------------------------------------
     def input(self, slot, idx=0):
@@ -111,6 +112,8 @@ class LowerContext(object):
         names = self.op.output(slot)
         if len(names) <= idx:
             return  # optional output not wired
+        if self.value_hook is not None:
+            value = self.value_hook(names[idx], value)
         self.env[names[idx]] = value
 
     def set_outputs(self, slot, values):
@@ -153,12 +156,15 @@ class LowerContext(object):
         return blk
 
 
-def trace_ops(block: ir.Block, env: Dict[str, Any], rng: RngSource):
+def trace_ops(block: ir.Block, env: Dict[str, Any], rng: RngSource,
+              value_hook=None):
     """Run every op's lowering over ``env`` (symbolic when tracing, concrete
-    when eager). This is the whole 'executor hot loop' — at trace time only."""
+    when eager). This is the whole 'executor hot loop' — at trace time only.
+    ``value_hook(name, value)`` intercepts every produced value (used to pin
+    sharding constraints on named intermediates, e.g. @GRAD vars)."""
     for op in block.ops:
         opdef = registry.lookup_checked(op.type)
-        opdef.lower(LowerContext(op, env, rng, block))
+        opdef.lower(LowerContext(op, env, rng, block, value_hook))
 
 
 class FunctionalContext(LowerContext):
@@ -323,19 +329,15 @@ class Executor(object):
         # under a mesh, leave feeds uncommitted: jit's in_shardings place them
         dev = None if dist is not None else self._device()
         dev_feed = {k: _to_device_value(v, dev) for k, v in feed.items()}
-        if dist is not None:
-            # host ops (save/load) can't be jit-traced; the eager path works
-            # on sharded buffers too (np.asarray gathers), so fall through
-            if not (_is_host_block(program.global_block()) or not use_jit):
-                return [_fetch_to_host(o, return_numpy) for o in
-                        self._run_jit(program, dev_feed, fetch_names, scope,
-                                      dist=dist)]
         block = program.global_block()
 
         if _is_host_block(block) or not use_jit:
+            # host ops (save/load) can't be jit-traced; the eager path works
+            # on sharded buffers too (np.asarray gathers)
             outs = self._run_eager(program, dev_feed, fetch_names, scope)
         else:
-            outs = self._run_jit(program, dev_feed, fetch_names, scope)
+            outs = self._run_jit(program, dev_feed, fetch_names, scope,
+                                 dist=dist)
         return [_fetch_to_host(o, return_numpy) for o in outs]
 
     # -- eager path (host ops, debugging) -------------------------------------
@@ -372,7 +374,7 @@ class Executor(object):
             shardings = (_dist_shardings(dist, state, feed)
                          if dist is not None else None)
             fn = self._compile(program, feed, fetch_names, state_names,
-                               shardings=shardings)
+                               shardings=shardings, dist=dist)
             self._cache[key] = fn
         rng_key = self._rng_key(program, scope)
         fetches, new_state, new_key = fn(state, feed, rng_key)
@@ -382,7 +384,7 @@ class Executor(object):
         return fetches
 
     def _compile(self, program, feed_template, fetch_names, state_names,
-                 shardings=None):
+                 shardings=None, dist=None):
         block = program.global_block()
         persist = self._persistable_names(program)
         written = {n for op_ in _iter_ops(block) for n in op_.output_arg_names}
@@ -391,11 +393,21 @@ class Executor(object):
         extra_out = sorted((written & persist) - set(state_names)
                            - set(feed_template))
 
+        value_hook = None
+        if dist is not None:
+            def value_hook(name, value):
+                # pin named intermediates (notably @GRAD vars) to their
+                # assigned spec so GSPMD reduce-scatters where ZeRO shards
+                if name in dist.specs and hasattr(value, "ndim"):
+                    return jax.lax.with_sharding_constraint(
+                        value, dist.sharding_for(name, value))
+                return value
+
         def fn(state, feed, rng_key):
             env = dict(feed)
             env.update(state)
             rng = RngSource(rng_key)
-            trace_ops(block, env, rng)
+            trace_ops(block, env, rng, value_hook)
             # every state input passes through (unwritten entries alias their
             # donated input buffer; written ones carry the update)
             new_state = {n: env[n] for n in state_names}

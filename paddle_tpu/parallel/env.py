@@ -24,10 +24,12 @@ def init_distributed(coordinator_address: Optional[str] = None,
         "PADDLE_TPU_COORDINATOR")
     if coordinator_address is None:
         return False
-    num_processes = num_processes or int(os.environ.get(
-        "PADDLE_TPU_NUM_PROCESSES", "1"))
-    process_id = process_id if process_id is not None else int(os.environ.get(
-        "PADDLE_TPU_PROCESS_ID", "0"))
+    # leave unset values as None: jax.distributed auto-detects process
+    # count/rank on TPU pods; forcing 1/0 would make every host rank 0
+    if num_processes is None and "PADDLE_TPU_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["PADDLE_TPU_NUM_PROCESSES"])
+    if process_id is None and "PADDLE_TPU_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["PADDLE_TPU_PROCESS_ID"])
     jax.distributed.initialize(coordinator_address, num_processes, process_id)
     return True
 

@@ -41,9 +41,13 @@ def make_mesh(axes: Dict[str, int], devices=None) -> Mesh:
                              (len(devices), known))
         sizes[wild] = len(devices) // known
     total = int(np.prod(list(sizes.values())))
-    if total > len(devices):
-        raise ValueError("mesh needs %d devices, have %d" %
-                         (total, len(devices)))
+    if total != len(devices):
+        # never silently idle chips: an explicit sub-mesh must pass an
+        # explicit device list
+        raise ValueError(
+            "mesh axes %r need %d devices but %d are available; use -1 for "
+            "one axis or pass devices= explicitly" %
+            (sizes, total, len(devices)))
     arr = np.array(devices[:total]).reshape(list(sizes.values()))
     return Mesh(arr, tuple(sizes.keys()))
 
