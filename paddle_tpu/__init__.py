@@ -26,6 +26,17 @@ from .core import unique_name  # noqa: F401
 from .place import CPUPlace, CUDAPlace, TPUPlace, Place  # noqa: F401
 
 from . import layers  # noqa: F401
+from . import nets  # noqa: F401
+from . import io  # noqa: F401
+from .io import (  # noqa: F401
+    save_vars, save_params, save_persistables, load_vars, load_params,
+    load_persistables, save_inference_model, load_inference_model,
+    get_inference_program,
+)
+from . import learning_rate_decay  # noqa: F401
+from . import evaluator  # noqa: F401
+from . import profiler  # noqa: F401
+from . import debugger  # noqa: F401
 from . import initializer  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401

@@ -19,6 +19,7 @@ kept as the debug path.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -331,6 +332,9 @@ class Executor(object):
         dev_feed = {k: _to_device_value(v, dev) for k, v in feed.items()}
         block = program.global_block()
 
+        from .. import profiler as _prof
+        timing = _prof.profiler_enabled()
+        t0 = time.perf_counter() if timing else 0.0
         if _is_host_block(block) or not use_jit:
             # host ops (save/load) can't be jit-traced; the eager path works
             # on sharded buffers too (np.asarray gathers)
@@ -338,6 +342,10 @@ class Executor(object):
         else:
             outs = self._run_jit(program, dev_feed, fetch_names, scope,
                                  dist=dist)
+        if timing:
+            jax.block_until_ready([raw_data(o) for o in outs])
+            _prof.record_run("program_%d_run" % program._uid,
+                             time.perf_counter() - t0)
         return [_fetch_to_host(o, return_numpy) for o in outs]
 
     # -- eager path (host ops, debugging) -------------------------------------

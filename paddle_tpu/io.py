@@ -1,0 +1,167 @@
+"""Model persistence: save/load variables and inference-model export.
+
+reference: python/paddle/fluid/io.py:66,129,142,295,380 (save_vars/save_params/
+save_persistables, load_* counterparts, save_inference_model/
+load_inference_model). Matching semantics: persistence is expressed as
+``save``/``load`` ops run in a temporary program by an Executor, so remote /
+sharded buffers are gathered by the same machinery as any other fetch; the
+inference model is the pruned Program serialized next to its persistables
+(reference serializes the ProgramDesc protobuf to ``__model__``;
+paddle/fluid/inference/io.h:27-37 is the C++ loading side).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+from .core import ir
+from .core.executor import Executor
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables",
+    "load_vars", "load_params", "load_persistables",
+    "save_inference_model", "load_inference_model",
+    "get_inference_program",
+]
+
+MODEL_FILENAME = "__model__"
+
+
+def is_persistable(var):
+    return var.persistable
+
+
+def is_parameter(var):
+    return isinstance(var, ir.Parameter)
+
+
+def _build_io_program(op_type, dirname, vars, filename):
+    prog = ir.Program()
+    block = prog.global_block()
+    names = []
+    for v in vars:
+        nv = block.create_var(name=v.name, shape=v.shape, dtype=v.dtype,
+                              lod_level=v.lod_level, persistable=True)
+        names.append(nv.name)
+    if filename is None:
+        for n in names:
+            path = os.path.join(dirname, n)
+            if op_type == "save":
+                block.append_op("save", inputs={"X": [n]},
+                                attrs={"file_path": path})
+            else:
+                block.append_op("load", outputs={"Out": [n]},
+                                attrs={"file_path": path})
+    else:
+        path = os.path.join(dirname, filename)
+        if op_type == "save":
+            block.append_op("save_combine", inputs={"X": names},
+                            attrs={"file_path": path})
+        else:
+            block.append_op("load_combine", outputs={"Out": names},
+                            attrs={"file_path": path})
+    return prog
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """reference: io.py:66 save_vars."""
+    if vars is None:
+        main_program = main_program or ir.default_main_program()
+        vars = [v for v in main_program.list_vars()
+                if (predicate or is_persistable)(v)]
+    vars = [v for v in vars if v.type == ir.VarType.LOD_TENSOR]
+    prog = _build_io_program("save", dirname, vars, filename)
+    executor.run(prog)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    """reference: io.py save_params — only Parameters, not optimizer state."""
+    save_vars(executor, dirname, main_program, predicate=is_parameter,
+              filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """reference: io.py:142 — params + optimizer accumulators + LR etc., i.e.
+    everything needed to resume training."""
+    save_vars(executor, dirname, main_program, predicate=is_persistable,
+              filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """reference: io.py load_vars."""
+    if vars is None:
+        main_program = main_program or ir.default_main_program()
+        vars = [v for v in main_program.list_vars()
+                if (predicate or is_persistable)(v)]
+    prog = _build_io_program("load", dirname, vars, filename)
+    executor.run(prog)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, predicate=is_parameter,
+              filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    """reference: io.py:231 — resume = load persistables + re-run."""
+    load_vars(executor, dirname, main_program, predicate=is_persistable,
+              filename=filename)
+
+
+def get_inference_program(target_vars, main_program=None):
+    main_program = main_program or ir.default_main_program()
+    fetches = [v.name if isinstance(v, ir.Variable) else v
+               for v in target_vars]
+    return main_program.prune(feeds=[], fetches=fetches)
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None):
+    """Prune to the inference slice and persist program + parameters.
+
+    reference: io.py:295 save_inference_model. The serialized ``__model__`` is
+    the pickled pruned Program (our ProgramDesc equivalent); persistables land
+    beside it.
+    """
+    main_program = main_program or ir.default_main_program()
+    if isinstance(feeded_var_names, str):
+        feeded_var_names = [feeded_var_names]
+    if isinstance(target_vars, ir.Variable):
+        target_vars = [target_vars]
+    fetch_names = [v.name if isinstance(v, ir.Variable) else v
+                   for v in target_vars]
+
+    os.makedirs(dirname, exist_ok=True)
+    pruned = main_program.prune(feeds=feeded_var_names, fetches=fetch_names)
+    payload = {"program": pruned, "feed_names": list(feeded_var_names),
+               "fetch_names": fetch_names}
+    with open(os.path.join(dirname, model_filename or MODEL_FILENAME),
+              "wb") as f:
+        pickle.dump(payload, f)
+    # only persistables the pruned graph actually reads
+    needed = set()
+    for op in pruned.global_block().ops:
+        needed.update(op.input_arg_names)
+    vars = [v for v in main_program.list_vars()
+            if v.persistable and v.name in needed]
+    save_vars(executor, dirname, vars=vars, filename=params_filename)
+    return fetch_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    """reference: io.py:380 load_inference_model → (program, feeds, fetches)."""
+    with open(os.path.join(dirname, model_filename or MODEL_FILENAME),
+              "rb") as f:
+        payload = pickle.load(f)
+    program = payload["program"]
+    # re-issue a fresh uid so executor compile caches never collide with a
+    # live program that happened to get the same counter value pre-pickle
+    ir.Program._uid_counter[0] += 1
+    program._uid = ir.Program._uid_counter[0]
+    vars = [v for v in program.list_vars() if v.persistable]
+    load_vars(executor, dirname, vars=vars, filename=params_filename)
+    return program, payload["feed_names"], payload["fetch_names"]

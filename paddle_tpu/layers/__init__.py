@@ -5,8 +5,17 @@ from .io import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .nn import concat_nn  # noqa: F401
+from . import ops as _ops_mod  # noqa: F401
 
 __all__ = []
 __all__ += io.__all__
 __all__ += nn.__all__
 __all__ += tensor.__all__
+
+# auto-generated simple-op layers fill any name not hand-written above
+# (reference: fluid/layers/ops.py registered after nn.py the same way)
+for _n in _ops_mod.__all__:
+    if _n not in globals():
+        globals()[_n] = getattr(_ops_mod, _n)
+        __all__.append(_n)
+del _n

@@ -23,6 +23,7 @@ __all__ = [
     "elementwise_sub", "elementwise_mul", "elementwise_div", "lrn", "prelu",
     "pad", "label_smooth", "sigmoid_cross_entropy_with_logits", "maxout",
     "relu", "log", "im2sequence", "expand", "squeeze", "unsqueeze",
+    "edit_distance",
 ]
 
 
@@ -580,3 +581,23 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
                      attrs={"kernels": filter_size, "strides": stride,
                             "paddings": padding})
     return out
+
+
+def edit_distance(input, label, normalized=False, ignored_tokens=None,
+                  name=None):
+    """Levenshtein distance per sequence pair → ([N,1] distances, [1] count).
+
+    reference: layers/nn.py edit_distance over operators/edit_distance_op.*
+    (``ignored_tokens`` are erased before comparison there via an implicit
+    sequence_erase; here they ride through as an op attr — apply
+    layers.sequence_erase on LoD inputs for identical semantics).
+    """
+    helper = LayerHelper("edit_distance", **locals())
+    out = helper.create_variable_for_type_inference("float32")
+    seq_num = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="edit_distance",
+                     inputs={"Hyps": [input], "Refs": [label]},
+                     outputs={"Out": [out], "SequenceNum": [seq_num]},
+                     attrs={"normalized": normalized,
+                            "ignored_tokens": list(ignored_tokens or [])})
+    return out, seq_num

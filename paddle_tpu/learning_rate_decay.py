@@ -1,0 +1,131 @@
+"""Learning-rate schedules emitted as ops on a global step counter.
+
+reference: python/paddle/fluid/learning_rate_decay.py (exponential_decay,
+natural_exp_decay, inverse_time_decay, polynomial_decay, piecewise_decay).
+Each schedule appends a handful of elementwise ops computing the decayed LR
+from an auto-incremented step variable; the optimizer consumes the resulting
+Variable, so the schedule fuses into the same XLA step computation.
+"""
+from __future__ import annotations
+
+import math
+
+from . import layers
+from .core import unique_name
+from .initializer import ConstantInitializer
+from .layers.layer_helper import LayerHelper
+
+__all__ = ["exponential_decay", "natural_exp_decay", "inverse_time_decay",
+           "polynomial_decay", "piecewise_decay"]
+
+
+def _decay_step_counter(begin=0):
+    """Persistable float32 step counter incremented once per executed step
+    (reference: layers/tensor.py autoincreased_step_counter)."""
+    helper = LayerHelper("global_step_counter")
+    counter = helper.create_global_variable(
+        name=unique_name.generate("@LR_DECAY_COUNTER@"),
+        shape=(1,), dtype="float32", persistable=True)
+    helper.set_variable_initializer(
+        counter, ConstantInitializer(float(begin - 1)))
+    layers.increment(counter, value=1.0, in_place=True)
+    return counter
+
+
+def _binary(op_type, x, y):
+    helper = LayerHelper(op_type)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """lr * decay_rate ^ (step / decay_steps); staircase floors the exponent.
+    ``b^x`` lowers as ``exp(x·ln b)`` — branch-free, fuses on the VPU.
+
+    reference: learning_rate_decay.py exponential_decay.
+    """
+    step = _decay_step_counter()
+    div = layers.scale(step, scale=1.0 / float(decay_steps))
+    if staircase:
+        div = layers.floor(div)
+    powed = layers.exp(layers.scale(div, scale=math.log(float(decay_rate))))
+    return layers.scale(powed, scale=float(learning_rate))
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """lr * exp(-decay_rate * step / decay_steps).
+
+    reference: learning_rate_decay.py natural_exp_decay.
+    """
+    step = _decay_step_counter()
+    div = layers.scale(step, scale=1.0 / float(decay_steps))
+    if staircase:
+        div = layers.floor(div)
+    return layers.scale(
+        layers.exp(layers.scale(div, scale=-float(decay_rate))),
+        scale=float(learning_rate))
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    """lr / (1 + decay_rate * step / decay_steps).
+
+    reference: learning_rate_decay.py inverse_time_decay.
+    """
+    step = _decay_step_counter()
+    div = layers.scale(step, scale=1.0 / float(decay_steps))
+    if staircase:
+        div = layers.floor(div)
+    denom = layers.scale(div, scale=float(decay_rate), bias=1.0)
+    return layers.scale(layers.reciprocal(denom), scale=float(learning_rate))
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    """(lr - end) * (1 - step/decay_steps)^power + end.
+
+    reference: learning_rate_decay.py polynomial_decay.
+    """
+    step = _decay_step_counter()
+    if cycle:
+        ratio = layers.scale(step, scale=1.0 / float(decay_steps))
+        mult = layers.ceil(ratio)
+        ones = layers.fill_constant(shape=[1], dtype="float32", value=1.0)
+        mult = _binary("elementwise_max", mult, ones)  # step==0 ⇒ mult 1
+        decay_var = layers.scale(mult, scale=float(decay_steps))
+    else:
+        decay_var = layers.fill_constant(shape=[1], dtype="float32",
+                                         value=float(decay_steps))
+        step = _binary("elementwise_min", step, decay_var)
+    frac = 1.0 - step / decay_var
+    if float(power) == 1.0:
+        poly = frac
+    else:
+        # frac ∈ [0,1]; guard log(0) by clipping away from zero
+        safe = layers.clip(frac, min=1e-12, max=1.0)
+        poly = layers.exp(layers.scale(layers.log(safe), scale=float(power)))
+    return layers.scale(poly,
+                        scale=float(learning_rate) - float(end_learning_rate),
+                        bias=float(end_learning_rate))
+
+
+def piecewise_decay(boundaries, values):
+    """Piecewise-constant LR by step boundaries.
+
+    reference: learning_rate_decay.py piecewise_decay — built there from a
+    Switch of less_than branches; here the branchless TPU form: index =
+    #boundaries crossed, then one gather from the value table.
+    """
+    if len(values) != len(boundaries) + 1:
+        raise ValueError("len(values) must be len(boundaries) + 1")
+    step = _decay_step_counter()
+    bounds = layers.assign([float(b) for b in boundaries])
+    table = layers.assign([float(v) for v in values])
+    crossed = layers.cast(_binary("less_equal", bounds, step), "float32")
+    idx = layers.cast(layers.reduce_sum(crossed), "int32")
+    idx = layers.reshape(idx, shape=[1])
+    return layers.gather(table, idx)

@@ -1,0 +1,115 @@
+"""Profiling: per-run host timers + XLA/xplane trace capture.
+
+reference: python/paddle/fluid/profiler.py:20-125 (profiler / cuda_profiler
+context managers over the C++ RecordEvent profiler,
+paddle/fluid/platform/profiler.h:60-151) and platform/device_tracer.h (CUPTI
+timeline). On TPU the per-op host loop doesn't exist — one jitted program is
+one device launch — so the host profiler records per-run wall/compile times
+per program, and the device timeline comes from jax.profiler's xplane trace
+(TensorBoard-compatible), which is the CUPTI-tracer equivalent.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+__all__ = ["start_profiler", "stop_profiler", "reset_profiler", "profiler",
+           "cuda_profiler", "xla_trace", "profiler_enabled", "record_run"]
+
+_enabled = False
+_records = defaultdict(list)  # label -> [seconds]
+
+
+def profiler_enabled():
+    return _enabled
+
+
+def record_run(label, seconds):
+    """Called by Executor.run while profiling is on."""
+    if _enabled:
+        _records[label].append(seconds)
+
+
+def start_profiler(state="All"):
+    """reference: profiler.py start_profiler (state CPU/GPU/All — moot on
+    TPU: the device timeline needs xla_trace instead)."""
+    global _enabled
+    _enabled = True
+
+
+def reset_profiler():
+    _records.clear()
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    """Print the aggregated per-program table
+    (reference: platform/profiler.h:138-151 PrintProfiler)."""
+    global _enabled
+    _enabled = False
+    rows = []
+    for label, times in _records.items():
+        n = len(times)
+        total = sum(times)
+        rows.append((label, n, total, total / n, min(times), max(times)))
+    key = {None: lambda r: 0, "default": lambda r: 0,
+           "calls": lambda r: -r[1], "total": lambda r: -r[2],
+           "ave": lambda r: -r[3], "min": lambda r: -r[4],
+           "max": lambda r: -r[5]}.get(sorted_key, lambda r: 0)
+    rows.sort(key=key)
+    lines = ["%-40s %8s %12s %12s %12s %12s" %
+             ("Event", "Calls", "Total(ms)", "Avg(ms)", "Min(ms)", "Max(ms)")]
+    for label, n, total, avg, mn, mx in rows:
+        lines.append("%-40s %8d %12.3f %12.3f %12.3f %12.3f" %
+                     (label, n, total * 1e3, avg * 1e3, mn * 1e3, mx * 1e3))
+    report = "\n".join(lines)
+    if profile_path:
+        with open(profile_path, "w") as f:
+            f.write(report + "\n")
+    print(report)
+    return rows
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path=None):
+    """reference: profiler.py:125 profiler context manager."""
+    start_profiler(state)
+    reset_profiler()
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file=None, output_mode=None, config=None):
+    """Device-timeline capture. The reference wraps nvprof
+    (profiler.py:20-60); the TPU analog is an xplane trace directory
+    loadable in TensorBoard/XProf."""
+    if output_file:
+        with xla_trace(output_file):
+            yield
+    else:
+        yield
+
+
+@contextlib.contextmanager
+def xla_trace(logdir):
+    """jax.profiler trace — kernel timeline, HBM usage, per-fusion costs
+    (device_tracer equivalent; reference: platform/device_tracer.h:30-60)."""
+    import jax
+    jax.profiler.start_trace(str(logdir))
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def record_event(name):
+    """Host-side RAII timer (reference: platform/profiler.h RecordEvent)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        record_run(name, time.perf_counter() - t0)

@@ -1,0 +1,167 @@
+"""Tests for io (save/load/inference export), LR schedules, nets,
+evaluators, profiler, debugger."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, learning_rate_decay, nets
+
+
+def _linear_program():
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    return x, y, pred, loss
+
+
+def _feed(n=8):
+    rng = np.random.RandomState(0)
+    return {"x": rng.rand(n, 4).astype("float32"),
+            "y": rng.rand(n, 1).astype("float32")}
+
+
+class TestIO:
+    def test_save_load_persistables(self, tmp_path):
+        _, _, pred, loss = _linear_program()
+        pt.SGD(learning_rate=0.1).minimize(loss)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        exe.run(feed=_feed(), fetch_list=[loss])
+        before = {p.name: np.asarray(pt.fetch_var(p.name))
+                  for p in pt.default_main_program().all_parameters()}
+
+        pt.save_persistables(exe, str(tmp_path / "ckpt"))
+        # clobber params, then restore
+        exe.run(pt.default_startup_program())
+        pt.load_persistables(exe, str(tmp_path / "ckpt"),
+                             pt.default_main_program())
+        for name, val in before.items():
+            np.testing.assert_allclose(np.asarray(pt.fetch_var(name)), val,
+                                       rtol=1e-6)
+
+    def test_save_load_inference_model(self, tmp_path):
+        _, _, pred, loss = _linear_program()
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        want = exe.run(feed=_feed(), fetch_list=[pred])[0]
+
+        pt.save_inference_model(str(tmp_path / "model"), ["x"], [pred], exe)
+
+        prog, feeds, fetches = pt.load_inference_model(
+            str(tmp_path / "model"), exe)
+        assert feeds == ["x"]
+        got = exe.run(prog, feed={"x": _feed()["x"]}, fetch_list=fetches)[0]
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+class TestLRDecay:
+    @pytest.mark.parametrize("sched,expected", [
+        (lambda: learning_rate_decay.exponential_decay(1.0, 10, 0.5),
+         lambda s: 0.5 ** (s / 10.0)),
+        (lambda: learning_rate_decay.natural_exp_decay(1.0, 10, 0.5),
+         lambda s: np.exp(-0.5 * s / 10.0)),
+        (lambda: learning_rate_decay.inverse_time_decay(1.0, 10, 0.5),
+         lambda s: 1.0 / (1 + 0.5 * s / 10.0)),
+        (lambda: learning_rate_decay.polynomial_decay(1.0, 10, 0.1, 2.0),
+         lambda s: (1.0 - 0.1) * (1 - min(s, 10) / 10.0) ** 2 + 0.1),
+    ])
+    def test_schedules(self, sched, expected):
+        lr = sched()
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        for step in range(5):
+            got = exe.run(feed={}, fetch_list=[lr])[0]
+            np.testing.assert_allclose(got, [expected(float(step))],
+                                       rtol=1e-5, atol=1e-7)
+
+    def test_piecewise(self):
+        lr = learning_rate_decay.piecewise_decay([2, 4], [1.0, 0.5, 0.1])
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        got = [float(exe.run(feed={}, fetch_list=[lr])[0][0])
+               for _ in range(6)]
+        np.testing.assert_allclose(got, [1.0, 1.0, 0.5, 0.5, 0.1, 0.1],
+                                   rtol=1e-6)
+
+    def test_optimizer_consumes_schedule(self):
+        _, _, _, loss = _linear_program()
+        lr = learning_rate_decay.exponential_decay(0.1, 100, 0.9)
+        pt.SGD(learning_rate=lr).minimize(loss)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        l0 = exe.run(feed=_feed(), fetch_list=[loss])[0]
+        l1 = exe.run(feed=_feed(), fetch_list=[loss])[0]
+        assert float(l1) < float(l0)
+
+
+class TestNets:
+    def test_simple_img_conv_pool(self):
+        img = layers.data("img", shape=[1, 8, 8], dtype="float32")
+        out = nets.simple_img_conv_pool(img, num_filters=4, filter_size=3,
+                                        pool_size=2, pool_stride=2,
+                                        act="relu")
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        r = exe.run(feed={"img": np.random.rand(2, 1, 8, 8).astype("f4")},
+                    fetch_list=[out])[0]
+        assert r.shape[0] == 2 and r.shape[1] == 4
+
+    def test_glu(self):
+        x = layers.data("x", shape=[6], dtype="float32")
+        out = nets.glu(x, dim=-1)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        xv = np.random.rand(3, 6).astype("f4")
+        r = exe.run(feed={"x": xv}, fetch_list=[out])[0]
+        a, b = xv[:, :3], xv[:, 3:]
+        np.testing.assert_allclose(r, a / (1 + np.exp(-b)) * 1, rtol=1e-5)
+
+    def test_scaled_dot_product_attention(self):
+        q = layers.data("q", shape=[5, 8], dtype="float32")
+        out = nets.scaled_dot_product_attention(q, q, q, num_heads=2)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        r = exe.run(feed={"q": np.random.rand(2, 5, 8).astype("f4")},
+                    fetch_list=[out])[0]
+        assert r.shape == (2, 5, 8)
+
+
+class TestEvaluator:
+    def test_accuracy_evaluator(self):
+        from paddle_tpu.evaluator import Accuracy
+        x = layers.data("x", shape=[4], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        pred = layers.softmax(layers.fc(x, size=3))
+        acc = Accuracy(input=pred, label=label)
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        acc.reset(exe)
+        for _ in range(3):
+            exe.run(feed={"x": np.random.rand(8, 4).astype("f4"),
+                          "label": np.random.randint(0, 3, (8, 1))},
+                    fetch_list=acc.metrics)
+        v = acc.eval(exe)
+        assert 0.0 <= float(v) <= 1.0
+
+
+class TestProfilerDebugger:
+    def test_profiler(self, capsys):
+        from paddle_tpu import profiler
+        _, _, _, loss = _linear_program()
+        exe = pt.Executor()
+        exe.run(pt.default_startup_program())
+        with profiler.profiler(sorted_key="total"):
+            exe.run(feed=_feed(), fetch_list=[loss])
+        out = capsys.readouterr().out
+        assert "program_" in out and "Calls" in out
+
+    def test_debugger(self, tmp_path):
+        from paddle_tpu import debugger
+        _, _, _, loss = _linear_program()
+        text = debugger.pprint_program_codes(pt.default_main_program())
+        assert "mean" in text
+        dot = debugger.draw_block_graphviz(
+            pt.default_main_program().global_block(),
+            path=str(tmp_path / "g.dot"))
+        assert "digraph" in dot
