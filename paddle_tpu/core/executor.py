@@ -38,17 +38,28 @@ class TracedLoD(object):
 
     The traced analog of LoDTensor (reference: lod_tensor.h:101); offsets ride
     through jit as ordinary arrays so sequence ops can rebuild segment ids.
+
+    ``max_lens`` is the static (host-known, per lod level) maximum sequence
+    length, captured at feed time. It is what lets scan-based sequence ops
+    (dynamic_lstm/gru, sequence_conv, crf…) pad the ragged batch to a fixed
+    [num_seqs, max_len, ...] layout inside jit — the TPU-native replacement
+    for the reference's sequence2batch reordering
+    (reference: operators/math/sequence2batch.h, cuda hl_sequence.h:70).
+    Distinct max_lens re-specialise the compile cache; bucketing at the
+    reader bounds how many.
     """
 
-    def __init__(self, data, lod=()):
+    def __init__(self, data, lod=(), max_lens=None):
         self.data = data
         self.lod = tuple(lod)  # tuple of 1-D int32 offset arrays
+        self.max_lens = (tuple(max_lens) if max_lens is not None
+                         else (None,) * len(self.lod))
 
 
 jax.tree_util.register_pytree_node(
     TracedLoD,
-    lambda t: (((t.data,) + t.lod), None),
-    lambda aux, ch: TracedLoD(ch[0], ch[1:]))
+    lambda t: (((t.data,) + t.lod), t.max_lens),
+    lambda aux, ch: TracedLoD(ch[0], ch[1:], max_lens=aux))
 
 
 def raw_data(v):
@@ -58,7 +69,7 @@ def raw_data(v):
 def with_lod_of(v, data):
     """Wrap ``data`` with the lod of ``v`` (sequence-preserving elementwise ops)."""
     if isinstance(v, TracedLoD) and v.lod:
-        return TracedLoD(data, v.lod)
+        return TracedLoD(data, v.lod, max_lens=v.max_lens)
     return data
 
 
@@ -235,7 +246,7 @@ def _feed_signature(feed: Dict[str, Any]):
         v = feed[name]
         if isinstance(v, TracedLoD):
             sig.append((name, tuple(v.data.shape), str(v.data.dtype),
-                        tuple(len(l) for l in v.lod)))
+                        tuple(len(l) for l in v.lod), v.max_lens))
         else:
             sig.append((name, tuple(v.shape), str(v.dtype)))
     return tuple(sig)
@@ -245,9 +256,15 @@ def _to_device_value(v, device=None):
     """Normalise a fed python value into a jnp array or TracedLoD."""
     if isinstance(v, LoDTensor):
         data = jax.device_put(np.asarray(v.numpy()), device)
+        host_lod = v.lod()
         lod = tuple(jax.device_put(np.asarray(l, dtype=np.int32), device)
-                    for l in v.lod())
-        return TracedLoD(data, lod) if lod else data
+                    for l in host_lod)
+        if lod:
+            max_lens = tuple(
+                int(max((b - a for a, b in zip(l, l[1:])), default=0))
+                for l in host_lod)
+            return TracedLoD(data, lod, max_lens=max_lens)
+        return data
     if isinstance(v, TracedLoD):
         return v
     return jax.device_put(np.asarray(v), device)
@@ -270,7 +287,8 @@ def _dist_shardings(dist, state, feed):
     def feed_shard(name, v):
         if isinstance(v, TracedLoD):
             # LoD offsets are global: replicate alongside batch-sharded data
-            return TracedLoD(feed_shard(name, v.data), (repl,) * len(v.lod))
+            return TracedLoD(feed_shard(name, v.data), (repl,) * len(v.lod),
+                             max_lens=v.max_lens)
         spec = dist.strategy.spec_for_feed(name, getattr(v, "shape", ()), mesh)
         return NamedSharding(mesh, spec)
 

@@ -1,8 +1,9 @@
 """layers DSL — flat namespace like ``fluid.layers.*``
 (reference: python/paddle/fluid/layers/__init__.py)."""
-from . import io, nn, tensor  # noqa: F401
+from . import io, nn, sequence, tensor  # noqa: F401
 from .io import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
+from .sequence import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .nn import concat_nn  # noqa: F401
 from . import ops as _ops_mod  # noqa: F401
@@ -10,6 +11,7 @@ from . import ops as _ops_mod  # noqa: F401
 __all__ = []
 __all__ += io.__all__
 __all__ += nn.__all__
+__all__ += sequence.__all__
 __all__ += tensor.__all__
 
 # auto-generated simple-op layers fill any name not hand-written above

@@ -1,0 +1,390 @@
+"""Sequence layers DSL: RNNs, sequence ops, CRF, CTC.
+
+reference: python/paddle/fluid/layers/nn.py (dynamic_lstm, dynamic_gru,
+sequence_conv, sequence_pool, sequence_expand, sequence_softmax,
+sequence_first_step, sequence_last_step, linear_chain_crf, crf_decoding,
+warpctc, row_conv, lstm_unit, gru_unit, nce) — each appends ops via
+LayerHelper, mirroring the reference signatures.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..param_attr import ParamAttr
+from .layer_helper import LayerHelper
+
+__all__ = [
+    "dynamic_lstm", "dynamic_gru", "lstm_unit", "gru_unit", "sequence_conv",
+    "sequence_pool", "sequence_softmax", "sequence_expand", "sequence_concat",
+    "sequence_reshape", "sequence_slice", "sequence_erase",
+    "sequence_first_step", "sequence_last_step", "lod_reset", "row_conv",
+    "linear_chain_crf", "crf_decoding", "warpctc", "ctc_greedy_decoder",
+    "chunk_eval", "nce",
+]
+
+
+def dynamic_lstm(input, size, param_attr=None, bias_attr=None,
+                 use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None,
+                 h_0=None, c_0=None):
+    """Whole-sequence LSTM over a ragged (LoD) batch.
+    reference: layers/nn.py dynamic_lstm -> operators/lstm_op.cc. ``input``
+    is the [T, 4*hidden] projection (apply fc first, as the reference does);
+    ``size`` is 4*hidden."""
+    helper = LayerHelper("lstm", **locals())
+    hidden = size // 4
+    weight = helper.create_parameter(helper.param_attr,
+                                     shape=[hidden, 4 * hidden], dtype=dtype)
+    bias_size = [1, 7 * hidden if use_peepholes else 4 * hidden]
+    bias = helper.create_parameter(helper.bias_attr or ParamAttr(),
+                                   shape=bias_size, dtype=dtype, is_bias=True)
+    h = helper.create_variable_for_type_inference(dtype)
+    c = helper.create_variable_for_type_inference(dtype)
+    h.lod_level = c.lod_level = input.lod_level
+    h.shape = c.shape = tuple(input.shape[:-1]) + (hidden,)
+    inputs = {"Input": [input], "Weight": [weight], "Bias": [bias]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    if c_0 is not None:
+        inputs["C0"] = [c_0]
+    helper.append_op(type="lstm", inputs=inputs,
+                     outputs={"Hidden": [h], "Cell": [c]},
+                     attrs={"use_peepholes": use_peepholes,
+                            "is_reverse": is_reverse,
+                            "gate_activation": gate_activation,
+                            "cell_activation": cell_activation,
+                            "candidate_activation": candidate_activation})
+    return h, c
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, dtype="float32",
+                name=None):
+    """reference: layers/nn.py dynamic_gru -> operators/gru_op.cc. ``input``
+    is the [T, 3*size] projection; returns hidden [T, size]."""
+    helper = LayerHelper("gru", **locals())
+    weight = helper.create_parameter(helper.param_attr,
+                                     shape=[size, 3 * size], dtype=dtype)
+    bias = helper.create_parameter(helper.bias_attr or ParamAttr(),
+                                   shape=[1, 3 * size], dtype=dtype,
+                                   is_bias=True)
+    h = helper.create_variable_for_type_inference(dtype)
+    h.lod_level = input.lod_level
+    h.shape = tuple(input.shape[:-1]) + (size,)
+    inputs = {"Input": [input], "Weight": [weight], "Bias": [bias]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    helper.append_op(type="gru", inputs=inputs, outputs={"Hidden": [h]},
+                     attrs={"is_reverse": is_reverse,
+                            "gate_activation": gate_activation,
+                            "activation": candidate_activation})
+    return h
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """One LSTM step on dense tensors (for Static/DynamicRNN bodies).
+    reference: layers/nn.py lstm_unit -> operators/lstm_unit_op.cc —
+    fc([x, h_prev]) -> 4D gates -> cell update."""
+    from . import nn as _nn
+    from . import tensor as _tensor
+    helper = LayerHelper("lstm_unit", **locals())
+    size = cell_t_prev.shape[-1]
+    concat_in = _tensor.concat([x_t, hidden_t_prev], axis=1)
+    fc_out = _nn.fc(concat_in, size=4 * size, param_attr=param_attr,
+                    bias_attr=bias_attr)
+    c = helper.create_variable_for_type_inference(x_t.dtype)
+    h = helper.create_variable_for_type_inference(x_t.dtype)
+    c.shape = h.shape = cell_t_prev.shape
+    helper.append_op(type="lstm_unit",
+                     inputs={"X": [fc_out], "C_prev": [cell_t_prev]},
+                     outputs={"C": [c], "H": [h]},
+                     attrs={"forget_bias": forget_bias})
+    return h, c
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid"):
+    """One GRU step. reference: layers/nn.py gru_unit ->
+    operators/gru_unit_op.cc; ``size`` is 3*hidden like the reference."""
+    helper = LayerHelper("gru_unit", **locals())
+    dtype = helper.input_dtype()
+    hidden_dim = size // 3
+    weight = helper.create_parameter(helper.param_attr,
+                                     shape=[hidden_dim, 3 * hidden_dim],
+                                     dtype=dtype)
+    bias = helper.create_parameter(helper.bias_attr or ParamAttr(),
+                                   shape=[1, 3 * hidden_dim], dtype=dtype,
+                                   is_bias=True)
+    gate = helper.create_variable_for_type_inference(dtype)
+    reset_h = helper.create_variable_for_type_inference(dtype)
+    updated = helper.create_variable_for_type_inference(dtype)
+    updated.shape = hidden.shape
+    helper.append_op(type="gru_unit",
+                     inputs={"Input": [input], "HiddenPrev": [hidden],
+                             "Weight": [weight], "Bias": [bias]},
+                     outputs={"Gate": [gate], "ResetHiddenPrev": [reset_h],
+                              "Hidden": [updated]},
+                     attrs={"activation": activation,
+                            "gate_activation": gate_activation})
+    return updated, reset_h, gate
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=None, bias_attr=None, param_attr=None, act=None):
+    """reference: layers/nn.py sequence_conv -> operators/sequence_conv_op."""
+    helper = LayerHelper("sequence_conv", **locals())
+    dtype = helper.input_dtype()
+    filter_shape = [filter_size * input.shape[-1], num_filters]
+    filter_param = helper.create_parameter(helper.param_attr,
+                                           shape=filter_shape, dtype=dtype)
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    pre_bias.lod_level = input.lod_level
+    pre_bias.shape = tuple(input.shape[:-1]) + (num_filters,)
+    helper.append_op(type="sequence_conv",
+                     inputs={"X": [input], "Filter": [filter_param]},
+                     outputs={"Out": [pre_bias]},
+                     attrs={"contextStride": filter_stride,
+                            "contextStart": -int(filter_size // 2),
+                            "contextLength": filter_size})
+    pre_act = helper.append_bias_op(pre_bias)
+    return helper.append_activation(pre_act)
+
+
+def sequence_pool(input, pool_type):
+    """reference: layers/nn.py sequence_pool -> operators/sequence_pool_op."""
+    helper = LayerHelper("sequence_pool", **locals())
+    dtype = helper.input_dtype()
+    out = helper.create_variable_for_type_inference(dtype)
+    max_index = helper.create_variable_for_type_inference(dtype="int32",
+                                                          stop_gradient=True)
+    out.shape = (input.shape[0], ) + tuple(input.shape[1:])
+    out.lod_level = max(input.lod_level - 1, 0)
+    helper.append_op(type="sequence_pool", inputs={"X": [input]},
+                     outputs={"Out": [out], "MaxIndex": [max_index]},
+                     attrs={"pooltype": pool_type.upper()})
+    return out
+
+
+def sequence_first_step(input):
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input):
+    return sequence_pool(input, "last")
+
+
+def sequence_softmax(input, name=None):
+    helper = LayerHelper("sequence_softmax", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape, out.lod_level = input.shape, input.lod_level
+    helper.append_op(type="sequence_softmax", inputs={"X": [input]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_expand(x, y, name=None):
+    helper = LayerHelper("sequence_expand", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape, out.lod_level = x.shape, max(y.lod_level, 1)
+    helper.append_op(type="sequence_expand", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_concat(input, name=None):
+    helper = LayerHelper("sequence_concat", **locals())
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    out.lod_level = max(v.lod_level for v in inputs)
+    helper.append_op(type="sequence_concat", inputs={"X": list(inputs)},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_reshape(input, new_dim):
+    helper = LayerHelper("sequence_reshape", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.lod_level = input.lod_level
+    out.shape = (input.shape[0], new_dim)
+    helper.append_op(type="sequence_reshape", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs={"new_dim": new_dim})
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper("sequence_slice", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.lod_level = input.lod_level
+    helper.append_op(type="sequence_slice",
+                     inputs={"X": [input], "Offset": [offset],
+                             "Length": [length]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_erase(input, tokens, name=None):
+    helper = LayerHelper("sequence_erase", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.lod_level = input.lod_level
+    helper.append_op(type="sequence_erase", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs={"tokens": list(tokens)})
+    return out
+
+
+def lod_reset(x, y=None, target_lod=None):
+    helper = LayerHelper("lod_reset", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    out.lod_level = 1 if y is None else max(y.lod_level, 1)
+    inputs = {"X": [x]}
+    attrs = {}
+    if y is not None:
+        inputs["Y"] = [y]
+    elif target_lod is not None:
+        attrs["target_lod"] = list(target_lod)
+    else:
+        raise ValueError("lod_reset needs y or target_lod")
+    helper.append_op(type="lod_reset", inputs=inputs, outputs={"Out": [out]},
+                     attrs=attrs)
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """reference: layers/nn.py row_conv -> operators/row_conv_op.cc."""
+    helper = LayerHelper("row_conv", **locals())
+    dtype = helper.input_dtype()
+    filter_shape = [future_context_size + 1, input.shape[-1]]
+    filter_param = helper.create_parameter(helper.param_attr,
+                                           shape=filter_shape, dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    out.shape, out.lod_level = input.shape, input.lod_level
+    helper.append_op(type="row_conv",
+                     inputs={"X": [input], "Filter": [filter_param]},
+                     outputs={"Out": [out]})
+    return helper.append_activation(out)
+
+
+def linear_chain_crf(input, label, param_attr=None):
+    """reference: layers/nn.py linear_chain_crf ->
+    operators/linear_chain_crf_op; returns per-sequence -log p(y|x)."""
+    helper = LayerHelper("linear_chain_crf", **locals())
+    size = input.shape[-1]
+    transition = helper.create_parameter(helper.param_attr,
+                                         shape=[size + 2, size],
+                                         dtype=helper.input_dtype())
+    alpha = helper.create_variable_for_type_inference(helper.input_dtype())
+    emission_exps = helper.create_variable_for_type_inference(
+        helper.input_dtype())
+    transition_exps = helper.create_variable_for_type_inference(
+        helper.input_dtype())
+    log_likelihood = helper.create_variable_for_type_inference(
+        helper.input_dtype())
+    helper.append_op(type="linear_chain_crf",
+                     inputs={"Emission": [input], "Transition": [transition],
+                             "Label": [label]},
+                     outputs={"Alpha": [alpha],
+                              "EmissionExps": [emission_exps],
+                              "TransitionExps": [transition_exps],
+                              "LogLikelihood": [log_likelihood]})
+    return log_likelihood
+
+
+def crf_decoding(input, param_attr, label=None):
+    """reference: layers/nn.py crf_decoding -> operators/crf_decoding_op."""
+    helper = LayerHelper("crf_decoding", **locals())
+    transition = helper.get_parameter(param_attr.name)
+    viterbi_path = helper.create_variable_for_type_inference(dtype="int64")
+    viterbi_path.lod_level = input.lod_level
+    inputs = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        inputs["Label"] = [label]
+    helper.append_op(type="crf_decoding", inputs=inputs,
+                     outputs={"ViterbiPath": [viterbi_path]})
+    return viterbi_path
+
+
+def warpctc(input, label, blank=0, norm_by_times=False):
+    """reference: layers/nn.py warpctc -> operators/warpctc_op.cc."""
+    helper = LayerHelper("warpctc", **locals())
+    loss_out = helper.create_variable_for_type_inference(input.dtype)
+    grad_out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="warpctc",
+                     inputs={"Logits": [input], "Label": [label]},
+                     outputs={"WarpCTCGrad": [grad_out], "Loss": [loss_out]},
+                     attrs={"blank": blank, "norm_by_times": norm_by_times})
+    return loss_out
+
+
+def ctc_greedy_decoder(input, blank, name=None):
+    """argmax over classes + ctc_align (merge repeats, drop blanks).
+    reference: layers/nn.py ctc_greedy_decoder."""
+    from . import tensor as _tensor
+    helper = LayerHelper("ctc_greedy_decoder", **locals())
+    top1 = _tensor.argmax(input, axis=-1)
+    # keep the lod of the input on the argmax indices
+    ids = lod_reset(top1, y=input)
+    out = helper.create_variable_for_type_inference(dtype="int64")
+    out.lod_level = 1
+    helper.append_op(type="ctc_align", inputs={"Input": [ids]},
+                     outputs={"Output": [out]},
+                     attrs={"blank": blank, "merge_repeated": True})
+    return out
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None):
+    """reference: layers/nn.py chunk_eval -> operators/chunk_eval_op.cc."""
+    helper = LayerHelper("chunk_eval", **locals())
+    precision = helper.create_variable_for_type_inference(dtype="float32")
+    recall = helper.create_variable_for_type_inference(dtype="float32")
+    f1_score = helper.create_variable_for_type_inference(dtype="float32")
+    num_infer = helper.create_variable_for_type_inference(dtype="int64")
+    num_label = helper.create_variable_for_type_inference(dtype="int64")
+    num_correct = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op(type="chunk_eval",
+                     inputs={"Inference": [input], "Label": [label]},
+                     outputs={"Precision": [precision], "Recall": [recall],
+                              "F1-Score": [f1_score],
+                              "NumInferChunks": [num_infer],
+                              "NumLabelChunks": [num_label],
+                              "NumCorrectChunks": [num_correct]},
+                     attrs={"num_chunk_types": num_chunk_types,
+                            "chunk_scheme": chunk_scheme,
+                            "excluded_chunk_types": excluded_chunk_types or []})
+    return precision, recall, f1_score, num_infer, num_label, num_correct
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None):
+    """Noise-contrastive estimation loss.
+    reference: layers/nn.py nce -> operators/nce_op.cc. Negative samples are
+    drawn by a separate uniform_random int op feeding a deterministic
+    nce_core op, so the generic-vjp grad replays cleanly."""
+    helper = LayerHelper("nce", **locals())
+    dtype = helper.input_dtype()
+    dim = input.shape[-1]
+    num_neg = num_neg_samples or 10
+    w = helper.create_parameter(helper.param_attr,
+                                shape=[num_total_classes, dim], dtype=dtype)
+    b = helper.create_parameter(helper.bias_attr or ParamAttr(),
+                                shape=[num_total_classes, 1], dtype=dtype,
+                                is_bias=True)
+    samples = helper.create_variable_for_type_inference(dtype="int64",
+                                                        stop_gradient=True)
+    helper.append_op(type="uniform_random_int",
+                     outputs={"Out": [samples]},
+                     attrs={"shape": [num_neg], "low": 0,
+                            "high": num_total_classes})
+    cost = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="nce_core",
+                     inputs={"Input": [input], "Label": [label],
+                             "Weight": [w], "Bias": [b],
+                             "Samples": [samples]},
+                     outputs={"Cost": [cost]},
+                     attrs={"num_total_classes": num_total_classes,
+                            "num_neg_samples": num_neg})
+    cost.shape = (input.shape[0], 1)
+    return cost

@@ -11,6 +11,7 @@ from . import (  # noqa: F401
     optimizer_ops,
     metric_ops,
     io_ops,
+    sequence_ops,
 )
 
 from ..core.registry import registered_ops  # noqa: F401

@@ -38,8 +38,10 @@ def _infer_mul(op, block):
 
 @register_op("mul", infer_shape=_infer_mul)
 def mul(ctx):
-    """reference: operators/mul_op.cc — flatten then gemm."""
-    x = raw_data(ctx.input("X"))
+    """reference: operators/mul_op.cc — flatten then gemm. Preserves the
+    input's LoD (fc over ragged sequences keeps sequence structure)."""
+    x_v = ctx.input("X")
+    x = raw_data(x_v)
     y = raw_data(ctx.input("Y"))
     xn = ctx.attr("x_num_col_dims", 1)
     yn = ctx.attr("y_num_col_dims", 1)
@@ -49,7 +51,7 @@ def mul(ctx):
     if out.dtype != x.dtype:
         out = out.astype(x.dtype)
     out = out.reshape(tuple(x.shape[:xn]) + tuple(y.shape[yn:]))
-    ctx.set_output("Out", out)
+    ctx.set_output("Out", with_lod_of(x_v, out))
 
 
 @register_op("matmul")

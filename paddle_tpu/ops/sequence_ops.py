@@ -1,0 +1,835 @@
+"""Sequence ops over ragged (LoD) batches.
+
+reference: paddle/fluid/operators/sequence_{pool,softmax,expand,conv,concat,
+reshape,slice,erase}_op.*, row_conv_op.*, lstm_op.*, gru_op.*, lstm_unit_op.*,
+gru_unit_op.*, linear_chain_crf_op.*, crf_decoding_op.*, warpctc_op.*,
+chunk_eval_op.*, lod_reset_op.cc, and the shared functors in
+operators/math/{sequence2batch,sequence_pooling,sequence_padding,
+lstm_compute,gru_compute,context_project}.*.
+
+TPU-first design: the device currency is TracedLoD = (dense concat data,
+int32 offset vectors, static max_lens). Two lowering families:
+
+1. *Segment ops* (pool/softmax/expand): work directly on the concatenated
+   layout with segment-ids derived from offsets — jax segment reductions;
+   no padding, XLA-fusable, MXU-irrelevant (bandwidth bound).
+2. *Scan ops* (lstm/gru/conv/crf/ctc): pad the ragged batch to the static
+   [num_seqs, max_len, ...] layout (max_len captured at feed time) and run
+   ``lax.scan`` over time with masks — the replacement for the reference's
+   sequence2batch reorder machinery. The recurrent matmul is [batch, D] x
+   [D, 4D] per step — batched and MXU-shaped.
+
+Ops whose *output shape* depends on runtime lod values (sequence_slice,
+sequence_erase, ctc greedy decode) are host ops: they run on the eager
+executor path with concrete values — exactly the reference's per-op
+interpreter semantics, kept as the escape hatch (SURVEY.md §7 hard part (b)).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import registry
+from ..core.executor import TracedLoD, raw_data, with_lod_of
+from ..core.registry import register_op
+
+
+# ---------------------------------------------------------------------------
+# ragged <-> padded helpers (role of operators/math/sequence2batch.h)
+
+def seq_offsets(v, level=-1):
+    if not isinstance(v, TracedLoD) or not v.lod:
+        raise ValueError(
+            "sequence op input must carry LoD — feed a LoDTensor "
+            "(built e.g. via build_lod_tensor / DataFeeder with lod_level>0)")
+    return v.lod[level]
+
+
+def _is_concrete(x):
+    return not isinstance(jnp.asarray(x), jax.core.Tracer)
+
+
+def static_max_len(v, level=-1):
+    """The static pad length for scan ops: feed-time max_lens if present,
+    else (eager path) computed from the concrete offsets."""
+    lv = level if level >= 0 else len(v.lod) + level
+    ml = v.max_lens[lv] if v.max_lens else None
+    if ml is not None:
+        return int(ml)
+    offs = v.lod[lv]
+    if _is_concrete(offs):
+        d = np.asarray(offs)
+        return int((d[1:] - d[:-1]).max()) if len(d) > 1 else 0
+    raise ValueError(
+        "sequence op needs a static max sequence length under jit; feed the "
+        "input as a LoDTensor through Executor.run (which records max_lens), "
+        "or run with use_jit=False")
+
+
+def segment_ids(offsets, total):
+    """[0,2,5] -> [0,0,1,1,1]; empty sequences skip ids (cumsum of marks)."""
+    marks = jnp.zeros((total,), jnp.int32).at[offsets[1:-1]].add(
+        1, mode="drop")
+    return jnp.cumsum(marks)
+
+
+def _expand_mask(mask, ref):
+    """Broadcast a [...,] bool mask against trailing feature dims of ref."""
+    while mask.ndim < ref.ndim:
+        mask = mask[..., None]
+    return mask
+
+
+def lod_to_padded(data, offsets, max_len):
+    """Concat [total, ...] -> padded [num_seqs, max_len, ...] + bool mask."""
+    lengths = offsets[1:] - offsets[:-1]
+    t = jnp.arange(max_len, dtype=offsets.dtype)
+    idx = offsets[:-1, None] + t[None, :]
+    mask = t[None, :] < lengths[:, None]
+    idx = jnp.where(mask, idx, 0)
+    padded = jnp.take(data, idx, axis=0)
+    padded = jnp.where(_expand_mask(mask, padded), padded, 0)
+    return padded, mask
+
+
+def reverse_padded(padded, mask, offsets, max_len):
+    """Reverse each sequence in place within its valid prefix."""
+    lengths = offsets[1:] - offsets[:-1]
+    t = jnp.arange(max_len)
+    ridx = jnp.where(mask, lengths[:, None] - 1 - t[None, :], 0)
+    return jnp.take_along_axis(padded, ridx[..., None], axis=1)
+
+
+def padded_to_lod(padded, offsets, total):
+    """Padded [num_seqs, T, ...] -> concat [total, ...] (inverse scatter)."""
+    n, T = padded.shape[0], padded.shape[1]
+    lengths = offsets[1:] - offsets[:-1]
+    t = jnp.arange(T, dtype=offsets.dtype)
+    mask = t[None, :] < lengths[:, None]
+    idx = jnp.where(mask, offsets[:-1, None] + t[None, :], total)
+    flat = padded.reshape((n * T,) + padded.shape[2:])
+    out = jnp.zeros((total,) + padded.shape[2:], padded.dtype)
+    return out.at[idx.reshape(-1)].set(flat, mode="drop")
+
+
+# ---------------------------------------------------------------------------
+# segment-reduction ops
+
+@register_op("sequence_pool")
+def sequence_pool(ctx):
+    """reference: operators/sequence_pool_op.cc + math/sequence_pooling.cc.
+    Pools each sequence to one row (drops the last lod level)."""
+    x = ctx.input("X")
+    data = raw_data(x)
+    offs = seq_offsets(x)
+    n = offs.shape[0] - 1
+    total = data.shape[0]
+    sid = segment_ids(offs, total)
+    ptype = str(ctx.attr("pooltype", "AVERAGE")).upper()
+    lengths = (offs[1:] - offs[:-1]).astype(data.dtype)
+    safe_len = jnp.maximum(lengths, 1)
+    if ptype == "SUM":
+        out = jax.ops.segment_sum(data, sid, num_segments=n)
+    elif ptype == "AVERAGE":
+        out = jax.ops.segment_sum(data, sid, num_segments=n)
+        out = out / _expand_mask(safe_len, out).astype(data.dtype)
+    elif ptype == "SQRT":
+        out = jax.ops.segment_sum(data, sid, num_segments=n)
+        out = out / jnp.sqrt(_expand_mask(safe_len, out).astype(data.dtype))
+    elif ptype == "MAX":
+        out = jax.ops.segment_max(data, sid, num_segments=n)
+        # empty sequences would be -inf; zero them like the reference
+        out = jnp.where(_expand_mask(lengths > 0, out), out, 0)
+        if ctx.output_names("MaxIndex"):
+            pos = jnp.arange(total, dtype=jnp.int32)
+            best = jnp.take(out, sid, axis=0) == data
+            idx = jax.ops.segment_min(
+                jnp.where(best, pos[:, None], total), sid, num_segments=n)
+            ctx.set_output("MaxIndex", idx.astype(jnp.int32))
+    elif ptype == "LAST":
+        out = jnp.take(data, jnp.maximum(offs[1:] - 1, 0), axis=0)
+        out = jnp.where(_expand_mask(lengths > 0, out), out, 0)
+    elif ptype == "FIRST":
+        out = jnp.take(data, jnp.minimum(offs[:-1], total - 1), axis=0)
+        out = jnp.where(_expand_mask(lengths > 0, out), out, 0)
+    else:
+        raise ValueError("unknown pooltype %r" % ptype)
+    # result: one row per sequence; remaining lod = outer levels
+    if len(x.lod) > 1:
+        out = TracedLoD(out, x.lod[:-1], max_lens=x.max_lens[:-1])
+    ctx.set_output("Out", out)
+
+
+@register_op("sequence_softmax")
+def sequence_softmax(ctx):
+    """Softmax within each sequence over the concatenated rows.
+    reference: operators/sequence_softmax_op.cc."""
+    x = ctx.input("X")
+    data = raw_data(x)
+    flat = data.reshape((data.shape[0],))
+    offs = seq_offsets(x)
+    n = offs.shape[0] - 1
+    sid = segment_ids(offs, flat.shape[0])
+    mx = jax.ops.segment_max(flat, sid, num_segments=n)
+    mx = jnp.where(jnp.isfinite(mx), mx, 0)
+    e = jnp.exp(flat - jnp.take(mx, sid))
+    z = jax.ops.segment_sum(e, sid, num_segments=n)
+    out = (e / jnp.take(z, sid)).reshape(data.shape)
+    ctx.set_output("Out", with_lod_of(x, out))
+
+
+@register_op("sequence_expand")
+def sequence_expand(ctx):
+    """Expand rows of X to match Y's sequence structure.
+    reference: operators/sequence_expand_op.cc. X row i (or X's sequence i)
+    repeats for each element of Y's sequence i; output aligns with Y's rows
+    (a static shape — no dynamic sizes under jit)."""
+    x = ctx.input("X")
+    y = ctx.input("Y")
+    xd = raw_data(x)
+    y_offs = seq_offsets(y, 0)
+    total_y = raw_data(y).shape[0]
+    sid_y = segment_ids(y_offs, total_y)
+
+    if isinstance(x, TracedLoD) and x.lod:
+        # expand whole sequences of X: out seq i = X's sequence i repeated;
+        # this general form needs per-row mapping built from both lods
+        x_offs = seq_offsets(x, 0)
+        # row j of output (aligned to y rows): belongs to y seq s=sid_y[j];
+        # position within that y seq: p = j - y_offs[s]; maps to x row
+        # x_offs[s] + p mod len_x(s) — the reference requires len_y(s) to be
+        # a multiple/equal of len_x(s); equal-length repeat covers book usage
+        pos = jnp.arange(total_y, dtype=jnp.int32) - jnp.take(y_offs[:-1], sid_y)
+        x_len = jnp.take(x_offs[1:] - x_offs[:-1], sid_y)
+        src = jnp.take(x_offs[:-1], sid_y) + pos % jnp.maximum(x_len, 1)
+        out = jnp.take(xd, src, axis=0)
+    else:
+        # X row per sequence (the dominant pattern: encoder state into
+        # every decoder step) — one gather
+        out = jnp.take(xd, sid_y, axis=0)
+    ctx.set_output("Out", TracedLoD(out, y.lod, max_lens=y.max_lens)
+                   if isinstance(y, TracedLoD) and y.lod else out)
+
+
+@register_op("sequence_concat")
+def sequence_concat(ctx):
+    """Concat multiple LoD inputs sequence-wise (time axis within each
+    sequence). reference: operators/sequence_concat_op.cc."""
+    xs = ctx.inputs("X")
+    offs = [seq_offsets(v) for v in xs]
+    datas = [raw_data(v) for v in xs]
+    max_lens = [static_max_len(v) for v in xs]
+    n = offs[0].shape[0] - 1
+    T = sum(max_lens)
+    padded_parts, lengths = [], []
+    for d, o, ml in zip(datas, offs, max_lens):
+        p, _ = lod_to_padded(d, o, ml)
+        padded_parts.append(p)
+        lengths.append(o[1:] - o[:-1])
+    # stitch each sequence's parts back to back inside a [n, T] frame
+    out_len = sum(lengths)
+    new_offs = jnp.concatenate(
+        [jnp.zeros((1,), offs[0].dtype), jnp.cumsum(out_len)])
+    total = sum(d.shape[0] for d in datas)
+    feat = datas[0].shape[1:]
+    buf = jnp.zeros((n, T) + feat, datas[0].dtype)
+    start = jnp.zeros((n,), offs[0].dtype)
+    for p, l in zip(padded_parts, lengths):
+        t = jnp.arange(p.shape[1], dtype=offs[0].dtype)
+        cols = start[:, None] + t[None, :]
+        mask = t[None, :] < l[:, None]
+        rows = jnp.broadcast_to(jnp.arange(n)[:, None], cols.shape)
+        cols = jnp.where(mask, cols, T)
+        buf = buf.at[rows.reshape(-1), cols.reshape(-1)].set(
+            p.reshape((-1,) + feat), mode="drop")
+        start = start + l
+    out = padded_to_lod(buf, new_offs, total)
+    ctx.set_output("Out", TracedLoD(out, (new_offs,), max_lens=(T,)))
+
+
+@register_op("sequence_reshape")
+def sequence_reshape(ctx):
+    """Change the feature dim; sequence lengths rescale by old/new ratio.
+    reference: operators/sequence_reshape_op.cc."""
+    x = ctx.input("X")
+    data = raw_data(x)
+    new_dim = int(ctx.attr("new_dim"))
+    old_dim = data.shape[-1]
+    offs = seq_offsets(x)
+    out = data.reshape((-1, new_dim))
+    new_offs = (offs * old_dim) // new_dim
+    ml = x.max_lens[-1]
+    ml = None if ml is None else (ml * old_dim + new_dim - 1) // new_dim
+    ctx.set_output("Out", TracedLoD(out, (new_offs,), max_lens=(ml,)))
+
+
+@register_op("lod_reset")
+def lod_reset(ctx):
+    """Replace the lod of X with target lod (attr or Y's lod).
+    reference: operators/lod_reset_op.cc."""
+    x = ctx.input("X")
+    y = ctx.input("Y")
+    data = raw_data(x)
+    if y is not None:
+        if isinstance(y, TracedLoD) and y.lod:
+            ctx.set_output("Out", TracedLoD(data, y.lod, max_lens=y.max_lens))
+        else:
+            offs = raw_data(y).astype(jnp.int32).reshape(-1)
+            ctx.set_output("Out", TracedLoD(data, (offs,)))
+        return
+    target = ctx.attr("target_lod")
+    offs = jnp.asarray(target, jnp.int32)
+    ml = int(np.max(np.diff(np.asarray(target)))) if len(target) > 1 else 0
+    ctx.set_output("Out", TracedLoD(data, (offs,), max_lens=(ml,)))
+
+
+# -- host ops: output shape depends on lod values ---------------------------
+
+@register_op("sequence_slice", host=True)
+def sequence_slice(ctx):
+    """reference: operators/sequence_slice_op.cc (eager-only: ragged output
+    sizes are data-dependent)."""
+    x = ctx.input("X")
+    offset = np.asarray(raw_data(ctx.input("Offset"))).reshape(-1)
+    length = np.asarray(raw_data(ctx.input("Length"))).reshape(-1)
+    data = np.asarray(raw_data(x))
+    offs = np.asarray(seq_offsets(x))
+    pieces, lens = [], []
+    for i in range(len(offs) - 1):
+        s = int(offs[i] + offset[i])
+        pieces.append(data[s:s + int(length[i])])
+        lens.append(int(length[i]))
+    out = np.concatenate(pieces, axis=0) if pieces else data[:0]
+    new_offs = np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
+    ctx.set_output("Out", TracedLoD(jnp.asarray(out),
+                                    (jnp.asarray(new_offs),),
+                                    max_lens=(max(lens) if lens else 0,)))
+
+
+@register_op("sequence_erase", host=True)
+def sequence_erase(ctx):
+    """Remove listed tokens from each sequence.
+    reference: operators/sequence_erase_op.cc (eager-only)."""
+    x = ctx.input("X")
+    tokens = set(int(t) for t in ctx.attr("tokens", []))
+    data = np.asarray(raw_data(x)).reshape(-1)
+    offs = np.asarray(seq_offsets(x))
+    pieces, lens = [], []
+    for i in range(len(offs) - 1):
+        seg = data[offs[i]:offs[i + 1]]
+        seg = seg[~np.isin(seg, list(tokens))] if tokens else seg
+        pieces.append(seg)
+        lens.append(len(seg))
+    out = (np.concatenate(pieces) if pieces else data[:0]).reshape(-1, 1)
+    new_offs = np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
+    ctx.set_output("Out", TracedLoD(jnp.asarray(out),
+                                    (jnp.asarray(new_offs),),
+                                    max_lens=(max(lens) if lens else 0,)))
+
+
+@register_op("ctc_align", host=True)
+def ctc_align(ctx):
+    """CTC greedy decode: merge repeats, drop blanks (ragged output).
+    reference: operators/ctc_align_op.cc."""
+    x = ctx.input("Input")
+    blank = int(ctx.attr("blank", 0))
+    merge = bool(ctx.attr("merge_repeated", True))
+    data = np.asarray(raw_data(x)).reshape(-1)
+    offs = np.asarray(seq_offsets(x))
+    pieces, lens = [], []
+    for i in range(len(offs) - 1):
+        seg = data[offs[i]:offs[i + 1]]
+        if merge and len(seg):
+            keep = np.concatenate([[True], seg[1:] != seg[:-1]])
+            seg = seg[keep]
+        seg = seg[seg != blank]
+        pieces.append(seg)
+        lens.append(len(seg))
+    out = (np.concatenate(pieces) if pieces else data[:0]).reshape(-1, 1)
+    new_offs = np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
+    ctx.set_output("Output", TracedLoD(jnp.asarray(out),
+                                       (jnp.asarray(new_offs),),
+                                       max_lens=(max(lens) if lens else 0,)))
+
+
+# ---------------------------------------------------------------------------
+# context-window convs
+
+@register_op("sequence_conv")
+def sequence_conv(ctx):
+    """Context-window projection + matmul within each sequence.
+    reference: operators/sequence_conv_op.cc + math/context_project.h."""
+    x = ctx.input("X")
+    filt = raw_data(ctx.input("Filter"))
+    data = raw_data(x)
+    offs = seq_offsets(x)
+    ml = static_max_len(x)
+    ctx_len = int(ctx.attr("contextLength"))
+    ctx_start = int(ctx.attr("contextStart", -((ctx_len - 1) // 2)))
+    padded, mask = lod_to_padded(data, offs, ml)  # [n, T, D]
+    cols = []
+    for j in range(ctx_len):
+        shift = ctx_start + j
+        rolled = jnp.roll(padded, -shift, axis=1)
+        t = jnp.arange(ml)
+        valid = (t + shift >= 0) & (t + shift < ml)
+        valid = valid[None, :] & jnp.roll(mask, -shift, axis=1)
+        cols.append(jnp.where(valid[..., None], rolled, 0))
+    ctxmat = jnp.concatenate(cols, axis=-1)  # [n, T, ctx_len*D]
+    out = jnp.einsum("ntd,df->ntf", ctxmat, filt)
+    out = jnp.where(mask[..., None], out, 0)
+    out = padded_to_lod(out, offs, data.shape[0])
+    ctx.set_output("Out", with_lod_of(x, out))
+
+
+@register_op("row_conv")
+def row_conv(ctx):
+    """Lookahead row convolution (elementwise per feature).
+    reference: operators/row_conv_op.cc."""
+    x = ctx.input("X")
+    filt = raw_data(ctx.input("Filter"))  # [future_ctx, D]
+    data = raw_data(x)
+    offs = seq_offsets(x)
+    ml = static_max_len(x)
+    padded, mask = lod_to_padded(data, offs, ml)
+    out = jnp.zeros_like(padded)
+    for j in range(filt.shape[0]):
+        rolled = jnp.roll(padded, -j, axis=1)
+        t = jnp.arange(ml)
+        valid = (t + j < ml)[None, :] & jnp.roll(mask, -j, axis=1)
+        out = out + jnp.where(valid[..., None], rolled, 0) * filt[j][None, None, :]
+    out = jnp.where(mask[..., None], out, 0)
+    ctx.set_output("Out", with_lod_of(x, padded_to_lod(out, offs,
+                                                       data.shape[0])))
+
+
+# ---------------------------------------------------------------------------
+# recurrent scan ops
+
+_ACT = {
+    "sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh, "relu": jax.nn.relu,
+    "identity": lambda v: v, "": lambda v: v,
+}
+
+
+@register_op("lstm")
+def lstm(ctx):
+    """Whole-sequence LSTM over a ragged batch via lax.scan.
+
+    reference: operators/lstm_op.cc + math/lstm_compute.* (and the legacy
+    fused hl_lstm_parallel_forward, cuda/include/hl_lstm.h:42). Input is the
+    pre-projected [total, 4D] gate input (x·W done by an fc layer, as in the
+    reference); Weight [D, 4D] is the recurrent projection; gate slab order
+    (c̃, i, f, o) matches the reference's W_{ch,ih,fh,oh} concatenation. Bias
+    [1, 4D] or [1, 7D] with peepholes (b + W_{ic,fc,oc}).
+    """
+    x = ctx.input("Input")
+    w = raw_data(ctx.input("Weight"))
+    bias = ctx.input("Bias")
+    bias = raw_data(bias) if bias is not None else None
+    h0 = ctx.input("H0")
+    c0 = ctx.input("C0")
+    data = raw_data(x)
+    offs = seq_offsets(x)
+    ml = static_max_len(x)
+    n = offs.shape[0] - 1
+    D = w.shape[0]
+    use_peep = bool(ctx.attr("use_peepholes", True))
+    rev = bool(ctx.attr("is_reverse", False))
+    g_act = _ACT[ctx.attr("gate_activation", "sigmoid")]
+    c_act = _ACT[ctx.attr("cell_activation", "tanh")]
+    cand_act = _ACT[ctx.attr("candidate_activation", "tanh")]
+
+    padded, mask = lod_to_padded(data, offs, ml)  # [n, T, 4D]
+    if rev:
+        padded = reverse_padded(padded, mask, offs, ml)
+    xs = jnp.swapaxes(padded, 0, 1)          # [T, n, 4D]
+    ms = jnp.swapaxes(mask, 0, 1)            # [T, n]
+
+    if bias is not None:
+        b4 = bias.reshape(-1)[:4 * D]
+        xs = xs + b4[None, None, :]
+        if use_peep and bias.size >= 7 * D:
+            w_ic = bias.reshape(-1)[4 * D:5 * D]
+            w_fc = bias.reshape(-1)[5 * D:6 * D]
+            w_oc = bias.reshape(-1)[6 * D:7 * D]
+        else:
+            use_peep = False
+    else:
+        use_peep = False
+
+    h_init = raw_data(h0) if h0 is not None else jnp.zeros((n, D), data.dtype)
+    c_init = raw_data(c0) if c0 is not None else jnp.zeros((n, D), data.dtype)
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        g_in, m = inp
+        g = g_in + jnp.dot(h_prev, w)        # [n, 4D]  — the MXU matmul
+        c_t, i_t, f_t, o_t = jnp.split(g, 4, axis=-1)
+        if use_peep:
+            i_t = i_t + c_prev * w_ic[None, :]
+            f_t = f_t + c_prev * w_fc[None, :]
+        i = g_act(i_t)
+        f = g_act(f_t)
+        cand = cand_act(c_t)
+        c = f * c_prev + i * cand
+        if use_peep:
+            o_t = o_t + c * w_oc[None, :]
+        o = g_act(o_t)
+        h = o * c_act(c)
+        m_ = m[:, None].astype(h.dtype)
+        h = h * m_ + h_prev * (1 - m_)
+        c = c * m_ + c_prev * (1 - m_)
+        return (h, c), (h, c)
+
+    (_, _), (hs, cs) = jax.lax.scan(step, (h_init, c_init), (xs, ms))
+    hs = jnp.swapaxes(hs, 0, 1)
+    cs = jnp.swapaxes(cs, 0, 1)
+    if rev:
+        hs = reverse_padded(hs, mask, offs, ml)
+        cs = reverse_padded(cs, mask, offs, ml)
+    ctx.set_output("Hidden", with_lod_of(x, padded_to_lod(hs, offs,
+                                                          data.shape[0])))
+    ctx.set_output("Cell", with_lod_of(x, padded_to_lod(cs, offs,
+                                                        data.shape[0])))
+
+
+@register_op("gru")
+def gru(ctx):
+    """Whole-sequence GRU via lax.scan. reference: operators/gru_op.cc +
+    math/gru_compute.*. Input [total, 3D] pre-projected; Weight [D, 3D]:
+    first [D, 2D] update|reset recurrent weights, last [D, D] candidate."""
+    x = ctx.input("Input")
+    w = raw_data(ctx.input("Weight"))
+    bias = ctx.input("Bias")
+    bias = raw_data(bias) if bias is not None else None
+    h0 = ctx.input("H0")
+    data = raw_data(x)
+    offs = seq_offsets(x)
+    ml = static_max_len(x)
+    n = offs.shape[0] - 1
+    D = w.shape[0]
+    rev = bool(ctx.attr("is_reverse", False))
+    g_act = _ACT[ctx.attr("gate_activation", "sigmoid")]
+    cand_act = _ACT[ctx.attr("activation", "tanh")]
+
+    w_ur = w[:, :2 * D]
+    w_c = w[:, 2 * D:]
+    padded, mask = lod_to_padded(data, offs, ml)
+    if rev:
+        padded = reverse_padded(padded, mask, offs, ml)
+    if bias is not None:
+        padded = padded + bias.reshape(-1)[None, None, :]
+    xs = jnp.swapaxes(padded, 0, 1)
+    ms = jnp.swapaxes(mask, 0, 1)
+    h_init = raw_data(h0) if h0 is not None else jnp.zeros((n, D), data.dtype)
+
+    def step(h_prev, inp):
+        g_in, m = inp
+        ur = g_act(g_in[:, :2 * D] + jnp.dot(h_prev, w_ur))
+        u, r = jnp.split(ur, 2, axis=-1)
+        cand = cand_act(g_in[:, 2 * D:] + jnp.dot(r * h_prev, w_c))
+        # reference gru_kernel.h gru_finalOutput: h = (1-u)*h_prev + u*cand
+        h = (1.0 - u) * h_prev + u * cand
+        m_ = m[:, None].astype(h.dtype)
+        h = h * m_ + h_prev * (1 - m_)
+        return h, h
+
+    _, hs = jax.lax.scan(step, h_init, (xs, ms))
+    hs = jnp.swapaxes(hs, 0, 1)
+    if rev:
+        hs = reverse_padded(hs, mask, offs, ml)
+    ctx.set_output("Hidden", with_lod_of(x, padded_to_lod(hs, offs,
+                                                          data.shape[0])))
+
+
+@register_op("lstm_unit")
+def lstm_unit(ctx):
+    """Single LSTM step on dense batches (used by Static/DynamicRNN).
+    reference: operators/lstm_unit_op.cc. X = [N, 4D] pre-activation gates
+    (i, f, o, c̃ packed as c̃,i,f,o to match the lstm op), C_prev = [N, D]."""
+    g = raw_data(ctx.input("X"))
+    c_prev = raw_data(ctx.input("C_prev"))
+    forget_bias = float(ctx.attr("forget_bias", 0.0))
+    c_t, i_t, f_t, o_t = jnp.split(g, 4, axis=-1)
+    i = jax.nn.sigmoid(i_t)
+    f = jax.nn.sigmoid(f_t + forget_bias)
+    o = jax.nn.sigmoid(o_t)
+    c = f * c_prev + i * jnp.tanh(c_t)
+    h = o * jnp.tanh(c)
+    ctx.set_output("C", c)
+    ctx.set_output("H", h)
+
+
+@register_op("gru_unit")
+def gru_unit(ctx):
+    """Single GRU step. reference: operators/gru_unit_op.cc. Input [N, 3D]
+    pre-projected x; Weight [D, 3D]; HiddenPrev [N, D]."""
+    g_in = raw_data(ctx.input("Input"))
+    h_prev = raw_data(ctx.input("HiddenPrev"))
+    w = raw_data(ctx.input("Weight"))
+    bias = ctx.input("Bias")
+    D = w.shape[0]
+    if bias is not None:
+        g_in = g_in + raw_data(bias).reshape(-1)[None, :]
+    g_act = _ACT[ctx.attr("gate_activation", "sigmoid")]
+    cand_act = _ACT[ctx.attr("activation", "tanh")]
+    ur = g_act(g_in[:, :2 * D] + jnp.dot(h_prev, w[:, :2 * D]))
+    u, r = jnp.split(ur, 2, axis=-1)
+    cand = cand_act(g_in[:, 2 * D:] + jnp.dot(r * h_prev, w[:, 2 * D:]))
+    # reference gru_unit_op.h: h = u*(c - h_prev) + h_prev = (1-u)h_prev + u*c
+    h = (1.0 - u) * h_prev + u * cand
+    ctx.set_output("Gate", jnp.concatenate([ur, cand], axis=-1))
+    ctx.set_output("ResetHiddenPrev", r * h_prev)
+    ctx.set_output("Hidden", h)
+
+
+# ---------------------------------------------------------------------------
+# structured prediction: CRF, CTC
+
+def _crf_pieces(ctx):
+    em_v = ctx.input("Emission")
+    emission = raw_data(em_v)
+    trans = raw_data(ctx.input("Transition"))  # [n_tags+2, n_tags]
+    offs = seq_offsets(em_v)
+    ml = static_max_len(em_v)
+    start_w, end_w, tr = trans[0], trans[1], trans[2:]
+    padded, mask = lod_to_padded(emission, offs, ml)  # [n, T, K]
+    return em_v, emission, offs, ml, start_w, end_w, tr, padded, mask
+
+
+@register_op("linear_chain_crf")
+def linear_chain_crf(ctx):
+    """Negative log-likelihood of a linear-chain CRF, forward algorithm as a
+    log-space lax.scan over the padded batch.
+
+    reference: operators/linear_chain_crf_op.{cc,h} (Transition rows 0/1 are
+    the start/end weights, rows 2+ the tag-to-tag matrix). Output
+    LogLikelihood[i] = -log p(label_i | emission_i), one row per sequence.
+    """
+    (em_v, emission, offs, ml, start_w, end_w, tr, padded,
+     mask) = _crf_pieces(ctx)
+    label = raw_data(ctx.input("Label")).reshape(-1).astype(jnp.int32)
+    lab_p, _ = lod_to_padded(label[:, None], offs, ml)
+    lab_p = lab_p[..., 0]                     # [n, T]
+    n, T, K = padded.shape
+    lengths = offs[1:] - offs[:-1]
+
+    # log partition: alpha recursion
+    def step(alpha, inp):
+        em_t, m = inp                         # [n, K], [n]
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + tr[None, :, :], axis=1)
+        nxt = nxt + em_t
+        alpha = jnp.where(m[:, None], nxt, alpha)
+        return alpha, None
+
+    alpha0 = start_w[None, :] + padded[:, 0, :]
+    xs = (jnp.swapaxes(padded, 0, 1)[1:], jnp.swapaxes(mask, 0, 1)[1:])
+    alpha, _ = jax.lax.scan(step, alpha0, xs)
+    last_tag_scores = alpha + end_w[None, :]
+    log_z = jax.nn.logsumexp(last_tag_scores, axis=-1)  # [n]
+
+    # gold path score
+    t_idx = jnp.arange(T)
+    em_score = jnp.sum(
+        jnp.where(mask, jnp.take_along_axis(
+            padded, lab_p[..., None], axis=-1)[..., 0], 0), axis=1)
+    prev_lab = lab_p[:, :-1]
+    next_lab = lab_p[:, 1:]
+    pair_mask = mask[:, 1:]
+    tr_score = jnp.sum(
+        jnp.where(pair_mask, tr[prev_lab, next_lab], 0), axis=1)
+    first_lab = lab_p[:, 0]
+    last_pos = jnp.maximum(lengths - 1, 0)
+    last_lab = jnp.take_along_axis(lab_p, last_pos[:, None], axis=1)[:, 0]
+    gold = em_score + tr_score + start_w[first_lab] + end_w[last_lab]
+    nll = (log_z - gold)[:, None]
+    ctx.set_output("LogLikelihood", nll)
+    ctx.set_output("Alpha", with_lod_of(
+        em_v, padded_to_lod(
+            jnp.broadcast_to(alpha[:, None, :], (n, T, K)),
+            offs, emission.shape[0])))
+    ctx.set_output("EmissionExps", with_lod_of(em_v, jnp.exp(emission)))
+    ctx.set_output("TransitionExps", jnp.exp(
+        jnp.concatenate([start_w[None], end_w[None], tr], axis=0)))
+
+
+@register_op("crf_decoding", no_gradient=True)
+def crf_decoding(ctx):
+    """Viterbi decode; with Label given, outputs per-token 0/1 correctness.
+    reference: operators/crf_decoding_op.{cc,h}."""
+    (em_v, emission, offs, ml, start_w, end_w, tr, padded,
+     mask) = _crf_pieces(ctx)
+    n, T, K = padded.shape
+    lengths = offs[1:] - offs[:-1]
+
+    def fwd(carry, inp):
+        score = carry                         # [n, K]
+        em_t, m = inp
+        cand = score[:, :, None] + tr[None, :, :]
+        best_prev = jnp.argmax(cand, axis=1)  # [n, K]
+        nxt = jnp.max(cand, axis=1) + em_t
+        score = jnp.where(m[:, None], nxt, score)
+        return score, best_prev
+
+    score0 = start_w[None, :] + padded[:, 0, :]
+    xs = (jnp.swapaxes(padded, 0, 1)[1:], jnp.swapaxes(mask, 0, 1)[1:])
+    score, back = jax.lax.scan(fwd, score0, xs)   # back: [T-1, n, K]
+    last = jnp.argmax(score + end_w[None, :], axis=-1)  # [n]
+
+    def bwd(carry, inp):
+        tag, t = carry, inp                   # tag [n]
+        bp, step_t = t
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        # only move back while within the sequence
+        in_seq = step_t < (lengths - 1)
+        tag = jnp.where(in_seq, prev, tag)
+        return tag, tag
+
+    steps = jnp.arange(T - 1)[::-1] if T > 1 else jnp.zeros((0,), jnp.int32)
+    _, tags_rev = jax.lax.scan(bwd, last, (back[::-1], steps))
+    if T > 1:
+        path = jnp.concatenate([tags_rev[::-1], last[:, None].T], axis=0)
+    else:
+        path = last[None, :]
+    path = jnp.swapaxes(path, 0, 1)           # [n, T]
+    flat = padded_to_lod(path[..., None].astype(jnp.int64), offs,
+                         emission.shape[0])
+    label = ctx.input("Label")
+    if label is not None:
+        gold = raw_data(label).reshape(-1, 1).astype(jnp.int64)
+        flat = (flat == gold).astype(jnp.int64)
+    ctx.set_output("ViterbiPath", with_lod_of(em_v, flat))
+
+
+@register_op("warpctc")
+def warpctc(ctx):
+    """CTC loss on ragged logits/labels via the standard log-space DP
+    (the role warp-ctc plays in the reference: operators/warpctc_op.* and
+    platform/dynload/warpctc.h — here a pure-XLA computation, optax-style)."""
+    import optax
+    logits_v = ctx.input("Logits")
+    label_v = ctx.input("Label")
+    logits = raw_data(logits_v)
+    offs_x = seq_offsets(logits_v)
+    ml_x = static_max_len(logits_v)
+    labels = raw_data(label_v).reshape(-1)
+    offs_y = seq_offsets(label_v)
+    ml_y = max(static_max_len(label_v), 1)
+    blank = int(ctx.attr("blank", 0))
+    norm_by_times = bool(ctx.attr("norm_by_times", False))
+
+    lp, lp_mask = lod_to_padded(logits, offs_x, ml_x)     # [n, T, K]
+    lab_p, lab_mask = lod_to_padded(labels[:, None], offs_y, ml_y)
+    lab_p = lab_p[..., 0].astype(jnp.int32)
+    loss = optax.ctc_loss(
+        lp, (~lp_mask).astype(lp.dtype),
+        lab_p, (~lab_mask).astype(lp.dtype), blank_id=blank)
+    if norm_by_times:
+        loss = loss / jnp.maximum(
+            (offs_x[1:] - offs_x[:-1]).astype(loss.dtype), 1)
+    ctx.set_output("Loss", loss[:, None])
+
+
+@register_op("uniform_random_int", no_gradient=True)
+def uniform_random_int(ctx):
+    """Integer sampler feeding nce_core (so NCE's grad replays without
+    randomness). reference role: operators/math/sampler.h UniformSampler."""
+    shape = [int(d) for d in ctx.attr("shape")]
+    low = int(ctx.attr("low", 0))
+    high = int(ctx.attr("high", 2))
+    out = jax.random.randint(ctx.next_rng(), shape, low, high)
+    ctx.set_output("Out", out.astype(jnp.int64))
+
+
+@register_op("nce_core")
+def nce_core(ctx):
+    """NCE loss given pre-drawn negative samples (uniform noise dist.).
+    reference: operators/nce_op.{cc,h} — logistic loss on the true class +
+    num_neg sampled classes, noise probability 1/num_total_classes."""
+    x = raw_data(ctx.input("Input"))             # [N, D]
+    label = raw_data(ctx.input("Label")).reshape(-1).astype(jnp.int32)
+    w = raw_data(ctx.input("Weight"))            # [C, D]
+    b = ctx.input("Bias")
+    samples = raw_data(ctx.input("Samples")).astype(jnp.int32)  # [S]
+    num_total = int(ctx.attr("num_total_classes"))
+    num_neg = int(ctx.attr("num_neg_samples", samples.shape[0]))
+    noise_p = 1.0 / float(num_total)
+
+    true_logit = jnp.sum(x * jnp.take(w, label, axis=0), axis=-1)
+    neg_logit = jnp.dot(x, jnp.take(w, samples, axis=0).T)  # [N, S]
+    if b is not None:
+        bias = raw_data(b).reshape(-1)
+        true_logit = true_logit + jnp.take(bias, label)
+        neg_logit = neg_logit + jnp.take(bias, samples)[None, :]
+    # P(d=1|x,y) = exp(s) / (exp(s) + k*q(y))
+    kq = num_neg * noise_p
+    pos_ll = true_logit - jnp.logaddexp(true_logit, jnp.log(kq))
+    neg_ll = jnp.log(kq) - jnp.logaddexp(neg_logit, jnp.log(kq))
+    cost = -(pos_ll + jnp.sum(neg_ll, axis=-1))
+    ctx.set_output("Cost", cost[:, None])
+
+
+@register_op("chunk_eval", host=True, no_gradient=True)
+def chunk_eval(ctx):
+    """Chunking (NER-style) precision/recall/F1 over IOB/IOE/IOBES tags.
+    reference: operators/chunk_eval_op.cc, gserver ChunkEvaluator.cpp."""
+    inf_v = ctx.input("Inference")
+    lab_v = ctx.input("Label")
+    num_chunk_types = int(ctx.attr("num_chunk_types"))
+    scheme = str(ctx.attr("chunk_scheme", "IOB"))
+    excluded = set(ctx.attr("excluded_chunk_types", []) or [])
+    inf = np.asarray(raw_data(inf_v)).reshape(-1)
+    lab = np.asarray(raw_data(lab_v)).reshape(-1)
+    offs = np.asarray(seq_offsets(lab_v))
+
+    # per-scheme (begin, inside, end, single) position codes; -1 = unused
+    # (reference: chunk_eval_op.h GetSegments' tag_begin/inside/end/single)
+    POS = {"IOB": (0, 1, -1, -1), "IOE": (-1, 0, 1, -1),
+           "IOBES": (0, 1, 2, 3), "plain": (-1, -1, -1, 0)}
+    N_POS = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}
+    p_begin, p_inside, p_end, p_single = POS[scheme]
+    n_pos = N_POS[scheme]
+
+    def extract(seq):
+        parsed = [((int(t) // n_pos, int(t) % n_pos)
+                   if 0 <= int(t) < num_chunk_types * n_pos else None)
+                  for t in seq]
+        chunks = []
+        start = None
+        for i, cur in enumerate(parsed):
+            if cur is None:
+                start = None
+                continue
+            ctype, pos = cur
+            prev = parsed[i - 1] if i > 0 else None
+            begins = (pos in (p_begin, p_single) or prev is None
+                      or prev[0] != ctype or prev[1] in (p_end, p_single))
+            if begins:
+                start = i
+            nxt = parsed[i + 1] if i + 1 < len(parsed) else None
+            ends = (pos in (p_end, p_single) or nxt is None
+                    or nxt[0] != ctype or nxt[1] in (p_begin, p_single))
+            if ends and start is not None:
+                if ctype not in excluded:
+                    chunks.append((start, i, ctype))
+                start = None
+        return set(chunks)
+
+    n_inf = n_lab = n_correct = 0
+    for i in range(len(offs) - 1):
+        ic = extract(inf[offs[i]:offs[i + 1]])
+        lc = extract(lab[offs[i]:offs[i + 1]])
+        n_inf += len(ic)
+        n_lab += len(lc)
+        n_correct += len(ic & lc)
+    p = n_correct / n_inf if n_inf else 0.0
+    r = n_correct / n_lab if n_lab else 0.0
+    f1 = 2 * p * r / (p + r) if p + r else 0.0
+    ctx.set_output("Precision", jnp.asarray([np.float32(p)]))
+    ctx.set_output("Recall", jnp.asarray([np.float32(r)]))
+    ctx.set_output("F1-Score", jnp.asarray([np.float32(f1)]))
+    ctx.set_output("NumInferChunks", jnp.asarray([n_inf], jnp.int64))
+    ctx.set_output("NumLabelChunks", jnp.asarray([n_lab], jnp.int64))
+    ctx.set_output("NumCorrectChunks", jnp.asarray([n_correct], jnp.int64))

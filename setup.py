@@ -7,5 +7,5 @@ setup(
                 "rebuild on JAX/XLA/Pallas)",
     packages=find_packages(include=["paddle_tpu", "paddle_tpu.*"]),
     python_requires=">=3.10",
-    install_requires=["numpy", "jax"],
+    install_requires=["numpy", "jax", "optax"],
 )

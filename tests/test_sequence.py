@@ -1,0 +1,400 @@
+"""Sequence stack tests: segment ops, scan RNNs, CRF/CTC, NCE.
+
+Mirrors the reference's OpTest contract style (numpy golden vs lowering;
+reference: python/paddle/fluid/tests/unittests/test_sequence_*.py,
+test_lstm_op.py, test_linear_chain_crf_op.py, test_warpctc_op.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import LoDTensor, build_lod_tensor
+
+
+def _lod_feed(arrays):
+    return build_lod_tensor([np.asarray(a, np.float32) for a in arrays])
+
+
+def _run(fetch, feed, startup=True, **kw):
+    exe = fluid.Executor(fluid.CPUPlace())
+    if startup:
+        exe.run(fluid.default_startup_program())
+    return exe.run(feed=feed, fetch_list=fetch, **kw)
+
+
+def fresh_programs():
+    prog, sprog = fluid.Program(), fluid.Program()
+    return fluid.program_guard(prog, sprog)
+
+
+SEQS = [np.arange(1, 7, dtype=np.float32).reshape(3, 2),
+        np.array([[10.0, 20.0]], np.float32),
+        np.arange(7, 11, dtype=np.float32).reshape(2, 2)]
+
+
+@pytest.mark.parametrize("pool,expect", [
+    ("sum", [s.sum(0) for s in SEQS]),
+    ("average", [s.mean(0) for s in SEQS]),
+    ("sqrt", [s.sum(0) / np.sqrt(len(s)) for s in SEQS]),
+    ("max", [s.max(0) for s in SEQS]),
+    ("first", [s[0] for s in SEQS]),
+    ("last", [s[-1] for s in SEQS]),
+])
+def test_sequence_pool(pool, expect):
+    with fresh_programs():
+        x = fluid.layers.data("x", shape=[2], dtype="float32", lod_level=1)
+        out = fluid.layers.sequence_pool(x, pool)
+        r, = _run([out], {"x": _lod_feed(SEQS)}, startup=False)
+    np.testing.assert_allclose(r, np.stack(expect), rtol=1e-5)
+
+
+def test_sequence_softmax():
+    seqs = [np.array([[1.0], [2.0], [3.0]]), np.array([[5.0], [1.0]])]
+    with fresh_programs():
+        x = fluid.layers.data("x", shape=[1], dtype="float32", lod_level=1)
+        out = fluid.layers.sequence_softmax(x)
+        r, = _run([out], {"x": _lod_feed(seqs)}, startup=False)
+    r = np.asarray(r.numpy()).reshape(-1)
+    def sm(v):
+        e = np.exp(v - v.max())
+        return e / e.sum()
+    np.testing.assert_allclose(r[:3], sm(np.array([1.0, 2, 3])), rtol=1e-5)
+    np.testing.assert_allclose(r[3:], sm(np.array([5.0, 1])), rtol=1e-5)
+
+
+def test_sequence_expand_row_per_seq():
+    # x: one row per sequence of y -> each row repeats len(y_i) times
+    x_rows = np.array([[1.0, 1], [2, 2]], np.float32)
+    y_seqs = [np.zeros((3, 1), np.float32), np.zeros((2, 1), np.float32)]
+    with fresh_programs():
+        x = fluid.layers.data("x", shape=[2], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32", lod_level=1)
+        out = fluid.layers.sequence_expand(x, y)
+        r, = _run([out], {"x": x_rows, "y": _lod_feed(y_seqs)}, startup=False)
+    got = np.asarray(r.numpy())
+    want = np.array([[1, 1]] * 3 + [[2, 2]] * 2, np.float32)
+    np.testing.assert_allclose(got, want)
+
+
+def test_sequence_reshape():
+    seqs = [np.arange(8, dtype=np.float32).reshape(4, 2)]
+    with fresh_programs():
+        x = fluid.layers.data("x", shape=[2], dtype="float32", lod_level=1)
+        out = fluid.layers.sequence_reshape(x, 4)
+        r, = _run([out], {"x": _lod_feed(seqs)}, startup=False)
+    np.testing.assert_allclose(np.asarray(r.numpy()),
+                               np.arange(8, dtype=np.float32).reshape(2, 4))
+
+
+def test_sequence_concat():
+    a = [np.array([[1.0], [2]]), np.array([[3.0]])]
+    b = [np.array([[4.0]]), np.array([[5.0], [6]])]
+    with fresh_programs():
+        x = fluid.layers.data("x", shape=[1], dtype="float32", lod_level=1)
+        y = fluid.layers.data("y", shape=[1], dtype="float32", lod_level=1)
+        out = fluid.layers.sequence_concat([x, y])
+        r, = _run([out], {"x": _lod_feed(a), "y": _lod_feed(b)},
+                  startup=False)
+    np.testing.assert_allclose(np.asarray(r.numpy()).reshape(-1),
+                               [1, 2, 4, 3, 5, 6])
+    assert r.lod() == [[0, 3, 6]]
+
+
+def test_sequence_slice_and_erase_eager():
+    seqs = [np.arange(5, dtype=np.float32).reshape(5, 1),
+            np.arange(10, 14, dtype=np.float32).reshape(4, 1)]
+    with fresh_programs():
+        x = fluid.layers.data("x", shape=[1], dtype="float32", lod_level=1)
+        off = fluid.layers.data("off", shape=[1], dtype="int64")
+        ln = fluid.layers.data("ln", shape=[1], dtype="int64")
+        out = fluid.layers.sequence_slice(x, off, ln)
+        r, = _run([out], {"x": _lod_feed(seqs),
+                          "off": np.array([[1], [0]], np.int64),
+                          "ln": np.array([[2], [3]], np.int64)},
+                  startup=False)
+    np.testing.assert_allclose(np.asarray(r.numpy()).reshape(-1),
+                               [1, 2, 10, 11, 12])
+
+
+def test_dynamic_lstm_shapes_and_grad():
+    np.random.seed(0)
+    seqs = [np.random.randn(4, 8).astype(np.float32),
+            np.random.randn(2, 8).astype(np.float32)]
+    with fresh_programs():
+        x = fluid.layers.data("x", shape=[8], dtype="float32", lod_level=1)
+        proj = fluid.layers.fc(x, size=16 * 4)
+        h, c = fluid.layers.dynamic_lstm(proj, size=16 * 4)
+        last = fluid.layers.sequence_last_step(h)
+        loss = fluid.layers.mean(last)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        feed = {"x": _lod_feed(seqs)}
+        l0 = float(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0]))
+        hv, cv = exe.run(feed=feed, fetch_list=[h, c])
+    assert np.asarray(hv.numpy()).shape == (6, 16)
+    assert np.asarray(cv.numpy()).shape == (6, 16)
+    assert hv.lod() == [[0, 4, 6]]
+    assert np.isfinite(l0)
+
+
+def test_dynamic_lstm_masking_matches_single():
+    """A ragged batch must give each sequence the same result as running it
+    alone (mask correctness)."""
+    np.random.seed(1)
+    s1 = np.random.randn(3, 4).astype(np.float32)
+    s2 = np.random.randn(5, 4).astype(np.float32)
+
+    def run_lstm(seqs):
+        prog, sprog = fluid.Program(), fluid.Program()
+        prog.random_seed = sprog.random_seed = 7
+        with fluid.program_guard(prog, sprog):
+            x = fluid.layers.data("x", shape=[4], dtype="float32",
+                                  lod_level=1)
+            h, _ = fluid.layers.dynamic_lstm(x, size=4,
+                                             param_attr=fluid.ParamAttr(
+                                                 name="lw",
+                                                 initializer=fluid.Constant(0.1)),
+                                             bias_attr=fluid.ParamAttr(
+                                                 name="lb",
+                                                 initializer=fluid.Constant(0.0)))
+            exe = fluid.Executor(fluid.CPUPlace())
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe.run(sprog)
+                r, = exe.run(prog, feed={"x": _lod_feed(seqs)},
+                             fetch_list=[h])
+        return np.asarray(r.numpy())
+
+    both = run_lstm([s1, s2])
+    alone1 = run_lstm([s1])
+    alone2 = run_lstm([s2])
+    np.testing.assert_allclose(both[:3], alone1, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(both[3:], alone2, rtol=1e-4, atol=1e-5)
+
+
+def test_dynamic_gru_runs():
+    np.random.seed(2)
+    seqs = [np.random.randn(3, 12).astype(np.float32),
+            np.random.randn(1, 12).astype(np.float32)]
+    with fresh_programs():
+        x = fluid.layers.data("x", shape=[12], dtype="float32", lod_level=1)
+        h = fluid.layers.dynamic_gru(x, size=4)
+        pooled = fluid.layers.sequence_pool(h, "average")
+        loss = fluid.layers.mean(pooled)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        r, = exe.run(feed={"x": _lod_feed(seqs)}, fetch_list=[h])
+    assert np.asarray(r.numpy()).shape == (4, 4)
+
+
+def test_dynamic_gru_matches_numpy_golden():
+    """Pin GRU numerics to the reference recurrence
+    h = (1-u)*h_prev + u*cand (gru_kernel.h gru_finalOutput)."""
+    np.random.seed(7)
+    D = 3
+    T = 4
+    xs = np.random.randn(T, 3 * D).astype(np.float32)
+    w = np.random.randn(D, 3 * D).astype(np.float32) * 0.5
+    with fresh_programs():
+        x = fluid.layers.data("x", shape=[3 * D], dtype="float32",
+                              lod_level=1)
+        h = fluid.layers.dynamic_gru(
+            x, size=D,
+            param_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.NumpyArrayInitializer(w)),
+            bias_attr=fluid.ParamAttr(initializer=fluid.Constant(0.0)))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        r, = exe.run(feed={"x": _lod_feed([xs])}, fetch_list=[h])
+    got = np.asarray(r.numpy())
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+    h_prev = np.zeros(D, np.float32)
+    want = []
+    for t in range(T):
+        ur = sig(xs[t, :2 * D] + h_prev @ w[:, :2 * D])
+        u, rr = ur[:D], ur[D:]
+        cand = np.tanh(xs[t, 2 * D:] + (rr * h_prev) @ w[:, 2 * D:])
+        h_prev = (1.0 - u) * h_prev + u * cand
+        want.append(h_prev.copy())
+    np.testing.assert_allclose(got, np.stack(want), rtol=1e-4, atol=1e-5)
+
+
+def test_chunk_eval_ioe_end_tags():
+    # IOE, 1 chunk type: I=0, E=1. [I,E,I,E] = two chunks, both correct.
+    tags = [np.array([[0], [1], [0], [1]], np.int64)]
+    with fresh_programs():
+        x = fluid.layers.data("x", shape=[1], dtype="int64", lod_level=1)
+        y = fluid.layers.data("y", shape=[1], dtype="int64", lod_level=1)
+        outs = fluid.layers.chunk_eval(x, y, "IOE", 1)
+        t = LoDTensor(np.concatenate(tags), [[0, 4]])
+        rs = _run([outs[3], outs[4], outs[5]], {"x": t, "y": t},
+                  startup=False)
+    n_inf, n_lab, n_corr = (int(np.asarray(v)[0]) for v in rs)
+    assert (n_inf, n_lab, n_corr) == (2, 2, 2)
+
+
+def test_sequence_conv_window():
+    seqs = [np.ones((4, 2), np.float32)]
+    with fresh_programs():
+        x = fluid.layers.data("x", shape=[2], dtype="float32", lod_level=1)
+        out = fluid.layers.sequence_conv(
+            x, num_filters=1, filter_size=3,
+            param_attr=fluid.ParamAttr(initializer=fluid.Constant(1.0)),
+            bias_attr=False)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        r, = exe.run(feed={"x": _lod_feed(seqs)}, fetch_list=[out])
+    # interior rows see 3 ctx rows * 2 feats = 6; edges see 4
+    np.testing.assert_allclose(np.asarray(r.numpy()).reshape(-1),
+                               [4, 6, 6, 4])
+
+
+def test_linear_chain_crf_sums_to_prob():
+    """-log p summed over all label paths of a tiny CRF must equal ~1
+    (checked via brute-force enumeration)."""
+    np.random.seed(3)
+    K, T = 3, 2
+    em = np.random.randn(T, K).astype(np.float32)
+    trans = np.random.randn(K + 2, K).astype(np.float32) * 0.3
+
+    def crf_nll(labels):
+        with fresh_programs():
+            x = fluid.layers.data("x", shape=[K], dtype="float32",
+                                  lod_level=1)
+            y = fluid.layers.data("y", shape=[1], dtype="int64", lod_level=1)
+            nll = fluid.layers.linear_chain_crf(
+                x, y, param_attr=fluid.ParamAttr(
+                    name="crf_t%d" % (hash(tuple(labels)) % 10000),
+                    initializer=fluid.initializer.NumpyArrayInitializer(trans)))
+            exe = fluid.Executor(fluid.CPUPlace())
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe.run(fluid.default_startup_program())
+                xt = LoDTensor(em, [[0, T]])
+                yt = LoDTensor(np.array(labels, np.int64).reshape(-1, 1),
+                               [[0, T]])
+                r, = exe.run(feed={"x": xt, "y": yt}, fetch_list=[nll])
+        return float(np.asarray(r)[0, 0])
+
+    total = 0.0
+    for a in range(K):
+        for b in range(K):
+            total += np.exp(-crf_nll([a, b]))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-4)
+
+
+def test_crf_decoding_matches_bruteforce():
+    np.random.seed(4)
+    K, T = 3, 4
+    em = np.random.randn(T, K).astype(np.float32)
+    trans = np.random.randn(K + 2, K).astype(np.float32) * 0.5
+    # brute-force best path
+    best, best_score = None, -1e9
+    import itertools
+    for path in itertools.product(range(K), repeat=T):
+        s = trans[0, path[0]] + trans[1, path[-1]] + sum(
+            em[t, path[t]] for t in range(T)) + sum(
+            trans[2 + path[t], path[t + 1]] for t in range(T - 1))
+        if s > best_score:
+            best, best_score = path, s
+    with fresh_programs():
+        x = fluid.layers.data("x", shape=[K], dtype="float32", lod_level=1)
+        crf_attr = fluid.ParamAttr(
+            name="crfw_dec",
+            initializer=fluid.initializer.NumpyArrayInitializer(trans))
+        nll = fluid.layers.linear_chain_crf(
+            x, fluid.layers.data("y", shape=[1], dtype="int64", lod_level=1),
+            param_attr=crf_attr)
+        path_var = fluid.layers.crf_decoding(x, crf_attr)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        xt = LoDTensor(em, [[0, T]])
+        yt = LoDTensor(np.zeros((T, 1), np.int64), [[0, T]])
+        r, = exe.run(feed={"x": xt, "y": yt}, fetch_list=[path_var])
+    np.testing.assert_array_equal(np.asarray(r.numpy()).reshape(-1),
+                                  list(best))
+
+
+def test_warpctc_loss_positive_and_trains():
+    np.random.seed(5)
+    T, K = 6, 5
+    logits = [np.random.randn(T, K).astype(np.float32)]
+    labels = [np.array([[1], [2], [3]], np.int64)]
+    with fresh_programs():
+        x = fluid.layers.data("x", shape=[K], dtype="float32", lod_level=1)
+        y = fluid.layers.data("y", shape=[1], dtype="int64", lod_level=1)
+        loss = fluid.layers.warpctc(x, y, blank=0)
+        avg = fluid.layers.mean(loss)
+        fluid.optimizer.SGD(learning_rate=0.0).minimize(avg)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        xt = build_lod_tensor(logits)
+        yt = LoDTensor(np.concatenate(labels), [[0, 3]])
+        r, = exe.run(feed={"x": xt, "y": yt}, fetch_list=[avg])
+    assert float(np.asarray(r)) > 0
+
+
+def test_ctc_greedy_decoder():
+    # argmax path: [1,1,0,2,2,0] -> merge+deblank -> [1,2]
+    T, K = 6, 3
+    logits = np.full((T, K), -5.0, np.float32)
+    for t, k in enumerate([1, 1, 0, 2, 2, 0]):
+        logits[t, k] = 5.0
+    with fresh_programs():
+        x = fluid.layers.data("x", shape=[K], dtype="float32", lod_level=1)
+        out = fluid.layers.ctc_greedy_decoder(x, blank=0)
+        r, = _run([out], {"x": build_lod_tensor([logits])}, startup=False)
+    np.testing.assert_array_equal(np.asarray(r.numpy()).reshape(-1), [1, 2])
+
+
+def test_chunk_eval_iob():
+    # IOB, 1 chunk type: tags B=0, I=1, O=2
+    inf = [np.array([[0], [1], [2], [0]], np.int64)]
+    lab = [np.array([[0], [1], [2], [2]], np.int64)]
+    with fresh_programs():
+        x = fluid.layers.data("x", shape=[1], dtype="int64", lod_level=1)
+        y = fluid.layers.data("y", shape=[1], dtype="int64", lod_level=1)
+        outs = fluid.layers.chunk_eval(x, y, "IOB", 1)
+        xt = LoDTensor(np.concatenate(inf), [[0, 4]])
+        yt = LoDTensor(np.concatenate(lab), [[0, 4]])
+        rs = _run(list(outs), {"x": xt, "y": yt}, startup=False)
+    precision, recall = float(np.asarray(rs[0])), float(np.asarray(rs[1]))
+    assert precision == 0.5 and recall == 1.0
+
+
+def test_nce_trains():
+    np.random.seed(6)
+    with fresh_programs():
+        x = fluid.layers.data("x", shape=[8], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        cost = fluid.layers.nce(x, y, num_total_classes=20,
+                                num_neg_samples=5)
+        loss = fluid.layers.mean(cost)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        feed = {"x": np.random.randn(4, 8).astype(np.float32),
+                "y": np.array([[1], [2], [3], [4]], np.int64)}
+        l0 = float(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0]))
+        for _ in range(10):
+            l = float(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0]))
+    assert np.isfinite(l0) and l < l0
+
+
+def test_row_conv():
+    seqs = [np.ones((3, 2), np.float32)]
+    with fresh_programs():
+        x = fluid.layers.data("x", shape=[2], dtype="float32", lod_level=1)
+        out = fluid.layers.row_conv(
+            x, future_context_size=1,
+            param_attr=fluid.ParamAttr(initializer=fluid.Constant(1.0)))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        r, = exe.run(feed={"x": _lod_feed(seqs)}, fetch_list=[out])
+    # out[t] = x[t] + x[t+1] (last row only itself)
+    np.testing.assert_allclose(np.asarray(r.numpy()),
+                               [[2, 2], [2, 2], [1, 1]])
