@@ -160,7 +160,8 @@ def sequence_pool(input, pool_type):
     out = helper.create_variable_for_type_inference(dtype)
     max_index = helper.create_variable_for_type_inference(dtype="int32",
                                                           stop_gradient=True)
-    out.shape = (input.shape[0], ) + tuple(input.shape[1:])
+    if input.shape is not None:
+        out.shape = tuple(input.shape)
     out.lod_level = max(input.lod_level - 1, 0)
     helper.append_op(type="sequence_pool", inputs={"X": [input]},
                      outputs={"Out": [out], "MaxIndex": [max_index]},

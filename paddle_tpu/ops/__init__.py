@@ -12,6 +12,7 @@ from . import (  # noqa: F401
     metric_ops,
     io_ops,
     sequence_ops,
+    control_flow_ops,
 )
 
 from ..core.registry import registered_ops  # noqa: F401

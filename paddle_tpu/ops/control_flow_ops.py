@@ -1,0 +1,404 @@
+"""Control flow ops: compare/logical, LoDTensorArray, While, StaticRNN scan,
+conditional block, dynamic-RNN support ops, beam search.
+
+reference: paddle/fluid/operators/{compare_op,logical_op,while_op,
+recurrent_op,conditional_block_op,tensor_array_read_write_op,
+lod_rank_table_op,lod_tensor_to_array_op,array_to_lod_tensor_op,
+shrink_rnn_memory_op,reorder_lod_tensor_by_rank_op,max_sequence_len_op,
+lod_array_length_op,increment_op,beam_search_op,beam_search_decode_op}.*
+
+TPU-first split (SURVEY.md §7 hard part (b)):
+- compare/logical and the ``recurrent`` (StaticRNN) op are pure jax —
+  StaticRNN traces its step block inside ``lax.scan``, so a whole RNN
+  compiles to one XLA while-with-static-shapes.
+- While / arrays / rank-table machinery have *data-dependent shapes per
+  iteration* (the batch shrinks as short sequences end). These are host ops:
+  they run on the eager executor path with concrete values — exactly the
+  reference's per-op interpreter semantics, preserved as the compatibility
+  path. The jit-compiled way to the same models is dynamic_lstm/gru (masked
+  scan) — that is where TPU performance lives.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import registry
+from ..core.executor import (LowerContext, RngSource, TracedLoD, raw_data,
+                             trace_ops, with_lod_of)
+from ..core.registry import register_op
+
+
+# ---------------------------------------------------------------------------
+# compare / logical (reference: operators/compare_op.cc, logical_op.cc)
+
+def _binary(ctx, fn):
+    x = raw_data(ctx.input("X"))
+    y = raw_data(ctx.input("Y"))
+    ctx.set_output("Out", fn(x, y))
+
+
+for _t, _f in [("less_than", jnp.less), ("less_equal", jnp.less_equal),
+               ("greater_than", jnp.greater),
+               ("greater_equal", jnp.greater_equal),
+               ("equal", jnp.equal), ("not_equal", jnp.not_equal),
+               ("logical_and", jnp.logical_and),
+               ("logical_or", jnp.logical_or),
+               ("logical_xor", jnp.logical_xor)]:
+    register_op(_t, no_gradient=True)(
+        (lambda f: lambda ctx: _binary(ctx, f))(_f))
+
+
+# ---------------------------------------------------------------------------
+# LoDTensorArray read/write (host: arrays are python lists in the env)
+# reference: operators/tensor_array_read_write_op.cc
+
+class LoDTensorArrayVal(list):
+    """Runtime value of a LOD_TENSOR_ARRAY variable (python list of values)."""
+
+
+def _array_of(ctx, slot, create=True):
+    names = (ctx.op.output(slot) if slot in ctx.op.outputs
+             else ctx.op.input(slot))
+    name = names[0]
+    arr = ctx.env.get(name)
+    if arr is None and create:
+        arr = LoDTensorArrayVal()
+        ctx.env[name] = arr
+    return arr, name
+
+
+@register_op("write_to_array", host=True, no_gradient=True)
+def write_to_array(ctx):
+    x = ctx.input("X")
+    i = int(np.asarray(raw_data(ctx.input("I"))).reshape(-1)[0])
+    arr, name = _array_of(ctx, "Out")
+    # Out may alias an input array var of the same name
+    while len(arr) <= i:
+        arr.append(None)
+    arr[i] = x
+    ctx.env[name] = arr
+
+
+@register_op("read_from_array", host=True, no_gradient=True)
+def read_from_array(ctx):
+    arr = ctx.input("X")
+    i = int(np.asarray(raw_data(ctx.input("I"))).reshape(-1)[0])
+    ctx.set_output("Out", arr[i])
+
+
+@register_op("lod_array_length", host=True, no_gradient=True)
+def lod_array_length(ctx):
+    arr = ctx.input("X")
+    ctx.set_output("Out", jnp.asarray([len(arr)], jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# LoDRankTable family (host) — the dynamic-RNN ragged-batch scheduler
+# reference: operators/lod_rank_table_op.cc, framework/lod_rank_table.h
+
+class RankTableVal(object):
+    """items: list of (original_seq_index, length), sorted by length desc
+    (stable). reference: framework/lod_rank_table.h."""
+
+    def __init__(self, items):
+        self.items = items
+
+    def __len__(self):
+        return len(self.items)
+
+
+@register_op("lod_rank_table", host=True, no_gradient=True)
+def lod_rank_table(ctx):
+    x = ctx.input("X")
+    level = int(ctx.attr("level", 0))
+    offs = np.asarray(x.lod[level])
+    lengths = (offs[1:] - offs[:-1]).tolist()
+    items = sorted(enumerate(lengths), key=lambda p: -p[1])
+    ctx.set_output("Out", RankTableVal(items))
+
+
+@register_op("max_sequence_len", host=True, no_gradient=True)
+def max_sequence_len(ctx):
+    table = ctx.input("RankTable")
+    ml = table.items[0][1] if table.items else 0
+    ctx.set_output("Out", jnp.asarray([ml], jnp.int64))
+
+
+@register_op("lod_tensor_to_array", host=True, no_gradient=True)
+def lod_tensor_to_array(ctx):
+    """Split ragged x into per-time-step dense tensors ordered by rank table
+    (batch shrinks as short sequences end).
+    reference: operators/lod_tensor_to_array_op.cc."""
+    x = ctx.input("X")
+    table = ctx.input("RankTable")
+    data = np.asarray(raw_data(x))
+    offs = np.asarray(x.lod[-1])
+    T = table.items[0][1] if table.items else 0
+    steps = LoDTensorArrayVal()
+    for t in range(T):
+        rows = [offs[idx] + t for idx, ln in table.items if ln > t]
+        steps.append(jnp.asarray(data[np.asarray(rows, np.int64)]))
+    arr, name = _array_of(ctx, "Out")
+    arr[:] = steps
+    ctx.env[name] = arr
+
+
+@register_op("array_to_lod_tensor", host=True, no_gradient=True)
+def array_to_lod_tensor(ctx):
+    """Inverse of lod_tensor_to_array. reference:
+    operators/array_to_lod_tensor_op.cc."""
+    arr = ctx.input("X")
+    table = ctx.input("RankTable")
+    n = len(table.items)
+    lengths_sorted = [ln for _, ln in table.items]
+    feat = arr[0].shape[1:] if arr else ()
+    dtype = arr[0].dtype if arr else jnp.float32
+    seqs = [[] for _ in range(n)]
+    for t, step in enumerate(arr):
+        step = np.asarray(step)
+        alive = [k for k in range(n) if lengths_sorted[k] > t]
+        for row, k in enumerate(alive):
+            seqs[k].append(step[row])
+    # un-sort back to original sequence order
+    out_seqs = [None] * n
+    for k, (orig_idx, _) in enumerate(table.items):
+        out_seqs[orig_idx] = np.stack(seqs[k]) if seqs[k] else \
+            np.zeros((0,) + feat, dtype)
+    data = np.concatenate(out_seqs, axis=0)
+    lengths = [len(s) for s in out_seqs]
+    offs = np.concatenate([[0], np.cumsum(lengths)]).astype(np.int32)
+    ctx.set_output("Out", TracedLoD(jnp.asarray(data), (jnp.asarray(offs),),
+                                    max_lens=(max(lengths) if lengths else 0,)))
+
+
+@register_op("shrink_rnn_memory", host=True)
+def shrink_rnn_memory(ctx):
+    """Keep the first k rows of memory where k = #sequences still alive at
+    step i. reference: operators/shrink_rnn_memory_op.cc."""
+    x = raw_data(ctx.input("X"))
+    i = int(np.asarray(raw_data(ctx.input("I"))).reshape(-1)[0])
+    table = ctx.input("RankTable")
+    k = sum(1 for _, ln in table.items if ln > i)
+    ctx.set_output("Out", x[:k])
+
+
+@register_op("reorder_lod_tensor_by_rank", host=True)
+def reorder_lod_tensor_by_rank(ctx):
+    """Permute sequences (or rows for a plain tensor) into rank-table order.
+    reference: operators/reorder_lod_tensor_by_rank_op.cc."""
+    x = ctx.input("X")
+    table = ctx.input("RankTable")
+    order = [idx for idx, _ in table.items]
+    if isinstance(x, TracedLoD) and x.lod:
+        data = np.asarray(raw_data(x))
+        offs = np.asarray(x.lod[-1])
+        pieces = [data[offs[i]:offs[i + 1]] for i in order]
+        lengths = [len(p) for p in pieces]
+        new_offs = np.concatenate([[0], np.cumsum(lengths)]).astype(np.int32)
+        ctx.set_output("Out", TracedLoD(
+            jnp.asarray(np.concatenate(pieces, axis=0)),
+            (jnp.asarray(new_offs),),
+            max_lens=(max(lengths) if lengths else 0,)))
+    else:
+        data = raw_data(x)
+        ctx.set_output("Out", jnp.take(data, jnp.asarray(order), axis=0))
+
+
+# ---------------------------------------------------------------------------
+# While (host loop) — reference: operators/while_op.cc:35
+
+@register_op("while", host=True, no_gradient=True)
+def while_op(ctx):
+    sub = ctx.sub_block()
+    cond_name = ctx.op.input("Condition")[0]
+    max_iters = int(ctx.attr("max_iters", 10000))
+    it = 0
+    while bool(np.asarray(raw_data(ctx.env[cond_name])).reshape(-1)[0]):
+        trace_ops(sub, ctx.env, ctx.rng)
+        it += 1
+        if it >= max_iters:
+            raise RuntimeError("while op exceeded max_iters=%d" % max_iters)
+    # written vars live in the flat env already — nothing to copy out
+
+
+@register_op("conditional_block", host=True, no_gradient=True)
+def conditional_block(ctx):
+    """Run the sub-block iff the (scalar bool) condition holds.
+    reference: operators/conditional_block_op.cc."""
+    conds = ctx.inputs("Cond") if ctx.has_input("Cond") else ctx.inputs("X")
+    flag = all(bool(np.asarray(raw_data(c)).reshape(-1)[0]) for c in conds)
+    if flag:
+        trace_ops(ctx.sub_block(), ctx.env, ctx.rng)
+
+
+# ---------------------------------------------------------------------------
+# StaticRNN: one jittable scan over the step block
+# reference: operators/recurrent_op.cc (RecurrentOp runs the step block per
+# time step with memory links) — here the whole loop is lax.scan, so XLA
+# sees a single fused while loop with static shapes.
+
+@register_op("recurrent")
+def recurrent(ctx):
+    """Slot contract (set up by layers.StaticRNN):
+      inputs  X    — outer sequence tensors, time on axis 0
+              Boot — initial memory values
+              P    — outer vars the step block reads (params etc.)
+      outputs Out  — stacked step outputs [T, ...]
+              FinalMems — last memory values (optional)
+      attrs   inner names parallel to each slot (the step block's var names),
+              memory pre/post name pairs, is_reverse, sub_block.
+    Everything flows through slots, so the generic-vjp grad op replays the
+    whole scan under jax.vjp — BPTT for free, compiled by XLA."""
+    sub = ctx.sub_block()
+    x_inner = list(ctx.attr("x_inner", []))
+    mem_pre = list(ctx.attr("mem_pre", []))
+    mem_post = list(ctx.attr("mem_post", []))
+    p_names = list(ctx.attr("p_names", []))
+    out_inner = list(ctx.attr("out_inner", []))
+    is_reverse = bool(ctx.attr("is_reverse", False))
+
+    xs = []
+    for i in range(len(x_inner)):
+        v = raw_data(ctx.input("X", i))
+        xs.append(v[::-1] if is_reverse else v)
+    init = tuple(raw_data(ctx.input("Boot", i))
+                 for i in range(len(mem_pre)))
+    params = {p_names[i]: ctx.input("P", i) for i in range(len(p_names))}
+    key0 = ctx.rng.next() if ctx.rng is not None else None
+
+    def body(carry, x_t):
+        mems, key = carry
+        env = dict(params)
+        env.update(zip(x_inner, x_t))
+        env.update(zip(mem_pre, mems))
+        rng = RngSource(key) if key is not None else None
+        trace_ops(sub, env, rng)
+        new_mems = tuple(raw_data(env[p]) for p in mem_post)
+        outs = tuple(raw_data(env[n]) for n in out_inner)
+        return (new_mems, rng.key if rng is not None else None), outs
+
+    (final_mems, _), stacked = jax.lax.scan(body, (init, key0), tuple(xs))
+    for i in range(len(out_inner)):
+        v = stacked[i]
+        ctx.set_output("Out", v[::-1] if is_reverse else v, idx=i)
+    for i in range(len(mem_pre)):
+        ctx.set_output("FinalMems", final_mems[i], idx=i)
+
+
+# ---------------------------------------------------------------------------
+# beam search (host) — reference: operators/beam_search_op.cc,
+# beam_search_decode_op.cc; legacy top-k kernel cuda/include/hl_top_k.h
+
+@register_op("beam_search", host=True, no_gradient=True)
+def beam_search(ctx):
+    """One step of beam expansion.
+
+    pre_ids: [num_prefixes, 1] current last token per live prefix, 2-level
+    lod [[src->prefix], [prefix->1]]. ids/scores: [num_prefixes, K]
+    candidates (accumulated scores). Selects top beam_size per source.
+    Output lod level 1 counts how many selected items each input prefix
+    contributed — the parent pointers beam_search_decode walks back.
+    """
+    pre_ids_v = ctx.input("pre_ids")
+    ids = np.asarray(raw_data(ctx.input("ids")))
+    scores = np.asarray(raw_data(ctx.input("scores")))
+    beam_size = int(ctx.attr("beam_size"))
+    end_id = int(ctx.attr("end_id"))
+    src_offs = np.asarray(pre_ids_v.lod[0])
+    pre_ids = np.asarray(raw_data(pre_ids_v)).reshape(-1)
+    n_pref = ids.shape[0]
+
+    sel_ids, sel_scores, sel_parent = [], [], []
+    for s in range(len(src_offs) - 1):
+        cands = []
+        for p in range(src_offs[s], src_offs[s + 1]):
+            if pre_ids[p] == end_id:
+                # ended prefix propagates itself once
+                cands.append((float(scores[p, 0]), int(end_id), p))
+                continue
+            for k in range(ids.shape[1]):
+                cands.append((float(scores[p, k]), int(ids[p, k]), p))
+        cands.sort(key=lambda c: -c[0])
+        chosen = cands[:beam_size]
+        chosen.sort(key=lambda c: c[2])  # group by parent prefix
+        for sc, tid, p in chosen:
+            sel_scores.append(sc)
+            sel_ids.append(tid)
+            sel_parent.append(p)
+
+    parent_counts = np.zeros(n_pref, np.int64)
+    for p in sel_parent:
+        parent_counts[p] += 1
+    lvl1 = np.concatenate([[0], np.cumsum(parent_counts)]).astype(np.int32)
+    # level 0: src -> selected item offsets
+    lvl0 = [0]
+    for s in range(len(src_offs) - 1):
+        lvl0.append(int(lvl1[src_offs[s + 1]]))
+    lvl0 = np.asarray(lvl0, np.int32)
+    out_ids = jnp.asarray(np.asarray(sel_ids, np.int64).reshape(-1, 1))
+    out_scores = jnp.asarray(
+        np.asarray(sel_scores, np.float32).reshape(-1, 1))
+    lod = (jnp.asarray(lvl0), jnp.asarray(lvl1))
+    ctx.set_output("selected_ids", TracedLoD(out_ids, lod))
+    ctx.set_output("selected_scores", TracedLoD(out_scores, lod))
+
+
+@register_op("beam_search_decode", host=True, no_gradient=True)
+def beam_search_decode(ctx):
+    """Backtrack the per-step beam arrays into full sentences.
+    reference: operators/beam_search_decode_op.cc."""
+    ids_arr = ctx.input("Ids")
+    scores_arr = ctx.input("Scores")
+    if not ids_arr:
+        raise ValueError("beam_search_decode: empty Ids array")
+    # steps[t]: (ids [n_t], parents map via lod level1 over step t-1 items)
+    steps = []
+    for t, v in enumerate(ids_arr):
+        ids_t = np.asarray(raw_data(v)).reshape(-1)
+        lvl0 = np.asarray(v.lod[0])
+        lvl1 = np.asarray(v.lod[1]) if len(v.lod) > 1 else None
+        sc_t = np.asarray(raw_data(scores_arr[t])).reshape(-1)
+        steps.append((ids_t, sc_t, lvl0, lvl1))
+
+    n_src = len(steps[0][2]) - 1
+    sentences, sent_scores, per_src_counts = [], [], []
+    last_ids, last_sc, last_lvl0, _ = steps[-1]
+
+    def parent_of(t, item):
+        """Index of item's parent in step t-1 via step t's level-1 lod."""
+        lvl1 = steps[t][3]
+        if lvl1 is None:
+            return item
+        return int(np.searchsorted(lvl1, item, side="right") - 1)
+
+    for s in range(n_src):
+        cnt = 0
+        for item in range(int(last_lvl0[s]), int(last_lvl0[s + 1])):
+            toks = []
+            it = item
+            for t in range(len(steps) - 1, -1, -1):
+                toks.append(int(steps[t][0][it]))
+                if t > 0:
+                    it = parent_of(t, it)
+            toks.reverse()
+            sentences.append(toks)
+            sent_scores.append(float(last_sc[item]))
+            cnt += 1
+        per_src_counts.append(cnt)
+
+    flat = np.concatenate([np.asarray(t, np.int64) for t in sentences]) \
+        if sentences else np.zeros((0,), np.int64)
+    sent_lens = [len(t) for t in sentences]
+    lvl1 = np.concatenate([[0], np.cumsum(sent_lens)]).astype(np.int32)
+    lvl0 = np.concatenate([[0], np.cumsum(per_src_counts)]).astype(np.int32)
+    # scores per sentence, broadcast per token for the scores output
+    flat_sc = np.concatenate(
+        [np.full(l, sc, np.float32) for l, sc in zip(sent_lens, sent_scores)]
+    ) if sentences else np.zeros((0,), np.float32)
+    lod = (jnp.asarray(lvl0), jnp.asarray(lvl1))
+    ctx.set_output("SentenceIds", TracedLoD(
+        jnp.asarray(flat.reshape(-1, 1)), lod))
+    ctx.set_output("SentenceScores", TracedLoD(
+        jnp.asarray(flat_sc.reshape(-1, 1)), lod))
