@@ -28,6 +28,9 @@ from .place import CPUPlace, CUDAPlace, TPUPlace, Place  # noqa: F401
 from . import layers  # noqa: F401
 from . import nets  # noqa: F401
 from . import io  # noqa: F401
+from . import reader  # noqa: F401
+from . import dataset  # noqa: F401
+from .data_feeder import DataFeeder  # noqa: F401
 from .io import (  # noqa: F401
     save_vars, save_params, save_persistables, load_vars, load_params,
     load_persistables, save_inference_model, load_inference_model,

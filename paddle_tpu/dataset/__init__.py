@@ -1,0 +1,28 @@
+"""Datasets with the reference's reader APIs.
+
+reference: python/paddle/v2/dataset/__init__.py (mnist, imikolov, imdb,
+cifar, movielens, conll05, uci_housing, sentiment, wmt14, wmt16, mq2007,
+flowers, voc2012). Each module exposes train()/test() creator functions
+returning sample generators with the reference's field structure —
+synthetic-deterministic here (see common.py).
+"""
+from . import (  # noqa: F401
+    cifar,
+    common,
+    conll05,
+    flowers,
+    imdb,
+    imikolov,
+    mnist,
+    movielens,
+    mq2007,
+    sentiment,
+    uci_housing,
+    voc2012,
+    wmt14,
+    wmt16,
+)
+
+__all__ = ["mnist", "imikolov", "imdb", "cifar", "movielens", "conll05",
+           "sentiment", "uci_housing", "wmt14", "wmt16", "mq2007", "flowers",
+           "voc2012", "common"]

@@ -1,0 +1,41 @@
+"""CIFAR-10/100. reference: python/paddle/v2/dataset/cifar.py — rows of
+(image[3072] float32 in [0, 1], label int)."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+TRAIN_SIZE = 1024
+TEST_SIZE = 256
+
+
+def _reader(n, classes, split):
+    def reader():
+        rng = common.seeded_rng("cifar%d-%s" % (classes, split))
+        per = 3072 // classes if classes <= 3072 else 1
+        for i in range(n):
+            label = int(rng.randint(0, classes))
+            img = rng.uniform(0.0, 0.4, 3072).astype(np.float32)
+            img[label * per:(label + 1) * per] += 0.5
+            yield np.clip(img, 0.0, 1.0), label
+
+    return reader
+
+
+def train10():
+    return _reader(TRAIN_SIZE, 10, "train")
+
+
+def test10():
+    return _reader(TEST_SIZE, 10, "test")
+
+
+def train100():
+    return _reader(TRAIN_SIZE, 100, "train")
+
+
+def test100():
+    return _reader(TEST_SIZE, 100, "test")

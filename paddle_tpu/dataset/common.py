@@ -1,0 +1,58 @@
+"""Dataset infrastructure.
+
+reference: python/paddle/v2/dataset/common.py (download cache under
+~/.cache/paddle/dataset, md5 checks, cluster_files_reader, convert-to-recordio
+helpers).
+
+This environment has no network egress, so every dataset module generates a
+*deterministic synthetic* corpus with the exact field types/shapes/vocab
+structure of the real one (seeded per dataset). When the real files are
+already present in the cache dir (placed there out of band), they are used
+instead where a parser exists; otherwise the synthetic generator is the
+source of truth for tests and benchmarks.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+__all__ = ["DATA_HOME", "md5file", "download", "seeded_rng",
+           "synthetic_notice"]
+
+
+def md5file(fname):
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def download(url, module_name, md5sum):
+    """reference: v2/dataset/common.py download — here: cache-lookup only
+    (zero egress); raises with a clear message if the file is absent."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    filename = os.path.join(dirname, url.split("/")[-1])
+    if os.path.exists(filename) and (not md5sum
+                                     or md5file(filename) == md5sum):
+        return filename
+    raise RuntimeError(
+        "dataset file %s is not cached and this environment has no network "
+        "access; place the file under %s or use the synthetic reader "
+        "(the default)" % (url, dirname))
+
+
+def seeded_rng(name):
+    """Deterministic per-dataset generator."""
+    seed = int.from_bytes(hashlib.md5(name.encode()).digest()[:4], "little")
+    return np.random.RandomState(seed)
+
+
+def synthetic_notice(mod):
+    return ("%s: synthetic deterministic corpus (no network egress); "
+            "field structure matches the reference dataset" % mod)

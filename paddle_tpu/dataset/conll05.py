@@ -1,0 +1,59 @@
+"""CoNLL-2005 SRL. reference: python/paddle/v2/dataset/conll05.py — rows of
+(word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, verb_ids, mark, label_ids)
+— 8 input sequences + BIO label sequence; get_dict()/get_embedding()."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["get_dict", "get_embedding", "test", "train"]
+
+WORD_VOCAB = 4000
+LABEL_KINDS = 30          # ~ 2*roles + O  (BIO over roles)
+PRED_VOCAB = 300
+TRAIN_SIZE = 256
+TEST_SIZE = 64
+
+
+def get_dict():
+    word_dict = {"<w%d>" % i: i for i in range(WORD_VOCAB)}
+    verb_dict = {"<v%d>" % i: i for i in range(PRED_VOCAB)}
+    label_dict = {"<l%d>" % i: i for i in range(LABEL_KINDS)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    rng = common.seeded_rng("conll05-emb")
+    return rng.normal(0, 0.1, (WORD_VOCAB, 32)).astype(np.float32)
+
+
+def _reader(n, split):
+    def reader():
+        rng = common.seeded_rng("conll05-" + split)
+        for _ in range(n):
+            length = int(rng.randint(4, 30))
+            words = [int(w) for w in rng.randint(0, WORD_VOCAB, length)]
+            verb_pos = int(rng.randint(0, length))
+            verb = [int(rng.randint(0, PRED_VOCAB))] * length
+            mark = [1 if i == verb_pos else 0 for i in range(length)]
+
+            def ctx(off):
+                return [words[min(max(i + off, 0), length - 1)]
+                        for i in range(length)]
+
+            # labels loosely depend on distance to the verb
+            labels = [int((abs(i - verb_pos) * 2 + words[i]) % LABEL_KINDS)
+                      for i in range(length)]
+            yield (words, ctx(-2), ctx(-1), ctx(0), ctx(1), ctx(2), verb,
+                   mark, labels)
+
+    return reader
+
+
+def train():
+    return _reader(TRAIN_SIZE, "train")
+
+
+def test():
+    return _reader(TEST_SIZE, "test")

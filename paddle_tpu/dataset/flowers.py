@@ -1,0 +1,37 @@
+"""Oxford 102 flowers. reference: python/paddle/v2/dataset/flowers.py — rows
+of (image [3*224*224] float32, label int in [0,102))."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "valid"]
+
+TRAIN_SIZE = 128
+TEST_SIZE = 32
+DIM = 3 * 224 * 224
+
+
+def _reader(n, split):
+    def reader():
+        rng = common.seeded_rng("flowers-" + split)
+        for _ in range(n):
+            label = int(rng.randint(0, 102))
+            img = rng.uniform(0, 0.3, DIM).astype(np.float32)
+            img[label * 100:(label + 1) * 100] += 0.6
+            yield np.clip(img, 0, 1), label
+
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=False):
+    return _reader(TRAIN_SIZE, "train")
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=False):
+    return _reader(TEST_SIZE, "test")
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=False):
+    return _reader(TEST_SIZE, "valid")

@@ -1,0 +1,54 @@
+"""IMDB sentiment. reference: python/paddle/v2/dataset/imdb.py — rows of
+(word_id_sequence, label 0/1); word_dict() maps token -> id."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "word_dict"]
+
+VOCAB = 5147          # mimic a realistic small vocab
+TRAIN_SIZE = 1024
+TEST_SIZE = 256
+
+_POS_WORDS = None
+
+
+def word_dict():
+    return {"<w%d>" % i: i for i in range(VOCAB)}
+
+
+def _pos_words():
+    global _POS_WORDS
+    if _POS_WORDS is None:
+        rng = common.seeded_rng("imdb-poswords")
+        _POS_WORDS = set(int(w) for w in rng.choice(VOCAB, 400,
+                                                    replace=False))
+    return _POS_WORDS
+
+
+def _reader(n, split):
+    def reader():
+        rng = common.seeded_rng("imdb-" + split)
+        pos = _pos_words()
+        pos_arr = np.array(sorted(pos))
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            length = int(rng.randint(8, 120))
+            words = rng.randint(0, VOCAB, length)
+            if label == 1:  # positive reviews use positive words more
+                k = max(1, length // 3)
+                idx = rng.choice(length, k, replace=False)
+                words[idx] = pos_arr[rng.randint(0, len(pos_arr), k)]
+            yield [int(w) for w in words], label
+
+    return reader
+
+
+def train(word_idx=None):
+    return _reader(TRAIN_SIZE, "train")
+
+
+def test(word_idx=None):
+    return _reader(TEST_SIZE, "test")

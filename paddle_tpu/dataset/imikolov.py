@@ -1,0 +1,54 @@
+"""PTB language model (imikolov). reference:
+python/paddle/v2/dataset/imikolov.py — build_dict() then train(word_idx, n)
+yields n-gram tuples of word ids (the word2vec book test feeds n=5)."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["build_dict", "train", "test"]
+
+VOCAB = 2074
+TRAIN_SENT = 512
+TEST_SENT = 128
+
+
+def build_dict(min_word_freq=50):
+    d = {"<w%d>" % i: i for i in range(VOCAB - 2)}
+    d["<unk>"] = VOCAB - 2
+    d["<e>"] = VOCAB - 1
+    return d
+
+
+def _sentences(split, n_sent):
+    rng = common.seeded_rng("imikolov-" + split)
+    # markov-ish chains so n-gram models have signal
+    trans = common.seeded_rng("imikolov-trans").randint(0, VOCAB, VOCAB)
+    for _ in range(n_sent):
+        length = int(rng.randint(5, 25))
+        w = int(rng.randint(0, VOCAB))
+        sent = [w]
+        for _ in range(length - 1):
+            w = int((trans[w] + rng.randint(0, 7)) % VOCAB)
+            sent.append(w)
+        yield sent
+
+
+def _ngram_reader(split, n_sent, word_idx, n):
+    def reader():
+        for sent in _sentences(split, n_sent):
+            if len(sent) >= n:
+                sent = [min(w, len(word_idx) - 1) for w in sent]
+                for i in range(n, len(sent) + 1):
+                    yield tuple(sent[i - n:i])
+
+    return reader
+
+
+def train(word_idx, n):
+    return _ngram_reader("train", TRAIN_SENT, word_idx, n)
+
+
+def test(word_idx, n):
+    return _ngram_reader("test", TEST_SENT, word_idx, n)

@@ -1,0 +1,33 @@
+"""MNIST. reference: python/paddle/v2/dataset/mnist.py — rows of
+(image[784] float32 in [-1, 1], label int in [0, 9])."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test"]
+
+TRAIN_SIZE = 2048   # synthetic corpus sizes (real: 60000/10000)
+TEST_SIZE = 512
+
+
+def _reader(n, split):
+    def reader():
+        rng = common.seeded_rng("mnist-" + split)
+        for i in range(n):
+            label = int(rng.randint(0, 10))
+            # blobs correlated with the label so models can actually learn
+            img = rng.normal(-1.0, 0.3, 784).astype(np.float32)
+            img[label * 70:(label + 1) * 70] += 1.5
+            yield np.clip(img, -1.0, 1.0), label
+
+    return reader
+
+
+def train():
+    return _reader(TRAIN_SIZE, "train")
+
+
+def test():
+    return _reader(TEST_SIZE, "test")

@@ -1,0 +1,86 @@
+"""MovieLens-1M. reference: python/paddle/v2/dataset/movielens.py — rows of
+(user_id, gender_id, age_id, job_id, movie_id, category_ids, title_ids,
+score); plus max_*_id helpers the recommender book test uses."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "get_movie_title_dict", "max_movie_id",
+           "max_user_id", "max_job_id", "movie_categories", "user_info",
+           "movie_info", "age_table"]
+
+_N_USERS = 600
+_N_MOVIES = 400
+_N_JOBS = 21
+_N_CATEGORIES = 18
+_TITLE_VOCAB = 512
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+TRAIN_SIZE = 2048
+TEST_SIZE = 256
+
+
+def max_user_id():
+    return _N_USERS
+
+
+def max_movie_id():
+    return _N_MOVIES
+
+
+def max_job_id():
+    return _N_JOBS - 1
+
+
+def movie_categories():
+    return {"<c%d>" % i: i for i in range(_N_CATEGORIES)}
+
+
+def get_movie_title_dict():
+    return {"<t%d>" % i: i for i in range(_TITLE_VOCAB)}
+
+
+def user_info():
+    rng = common.seeded_rng("ml-users")
+    return {i: (i, int(rng.randint(0, 2)), int(rng.randint(0, len(age_table))),
+                int(rng.randint(0, _N_JOBS)))
+            for i in range(1, _N_USERS + 1)}
+
+
+def movie_info():
+    rng = common.seeded_rng("ml-movies")
+    return {i: (i,
+                sorted(set(int(c) for c in rng.randint(0, _N_CATEGORIES,
+                                                       rng.randint(1, 4)))),
+                [int(t) for t in rng.randint(0, _TITLE_VOCAB,
+                                             rng.randint(1, 6))])
+            for i in range(1, _N_MOVIES + 1)}
+
+
+def _reader(n, split):
+    users = user_info()
+    movies = movie_info()
+
+    def reader():
+        rng = common.seeded_rng("ml-" + split)
+        for _ in range(n):
+            uid = int(rng.randint(1, _N_USERS + 1))
+            mid = int(rng.randint(1, _N_MOVIES + 1))
+            _, gender, age, job = users[uid]
+            _, cats, title = movies[mid]
+            # rating correlated with (uid+mid) parity for learnability
+            score = float(((uid * 31 + mid * 17) % 5) + 1)
+            yield uid, gender, age, job, mid, cats, title, \
+                np.array([score], np.float32)
+
+    return reader
+
+
+def train():
+    return _reader(TRAIN_SIZE, "train")
+
+
+def test():
+    return _reader(TEST_SIZE, "test")

@@ -1,0 +1,42 @@
+"""MQ2007 learning-to-rank. reference: python/paddle/v2/dataset/mq2007.py —
+pairwise mode yields (query_pos_features, query_neg_features), listwise
+(label_list, feature_list); 46 features per doc."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test"]
+
+N_FEATURES = 46
+TRAIN_QUERIES = 128
+TEST_QUERIES = 32
+
+
+def _reader(n_queries, split, format):
+    def reader():
+        rng = common.seeded_rng("mq2007-" + split)
+        w = common.seeded_rng("mq2007-w").normal(0, 1, N_FEATURES)
+        for _ in range(n_queries):
+            n_docs = int(rng.randint(2, 10))
+            feats = rng.normal(0, 1, (n_docs, N_FEATURES)).astype(np.float32)
+            scores = feats @ w + rng.normal(0, 0.1, n_docs)
+            rels = np.digitize(scores, np.percentile(scores, [33, 66]))
+            if format == "pairwise":
+                for i in range(n_docs):
+                    for j in range(n_docs):
+                        if rels[i] > rels[j]:
+                            yield feats[i], feats[j]
+            else:
+                yield [int(r) for r in rels], [f for f in feats]
+
+    return reader
+
+
+def train(format="pairwise"):
+    return _reader(TRAIN_QUERIES, "train", format)
+
+
+def test(format="pairwise"):
+    return _reader(TEST_QUERIES, "test", format)

@@ -1,0 +1,19 @@
+"""NLTK movie-review sentiment. reference:
+python/paddle/v2/dataset/sentiment.py — rows of (word_ids, label 0/1)."""
+from __future__ import annotations
+
+from . import common, imdb
+
+__all__ = ["get_word_dict", "train", "test"]
+
+
+def get_word_dict():
+    return imdb.word_dict()
+
+
+def train():
+    return imdb._reader(512, "sent-train")
+
+
+def test():
+    return imdb._reader(128, "sent-test")

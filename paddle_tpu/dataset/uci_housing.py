@@ -1,0 +1,42 @@
+"""UCI Housing. reference: python/paddle/v2/dataset/uci_housing.py — rows of
+(features[13] float32 normalised, price[1] float32)."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test"]
+
+feature_names = [
+    "CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS", "RAD", "TAX",
+    "PTRATIO", "B", "LSTAT",
+]
+
+TRAIN_SIZE = 404
+TEST_SIZE = 102
+
+# a fixed linear ground truth + noise so fit_a_line converges like the real
+# dataset does
+_rng = common.seeded_rng("uci-weights")
+_W = _rng.normal(0.0, 1.0, 13).astype(np.float32)
+_B = 22.5
+
+
+def _reader(n, split):
+    def reader():
+        rng = common.seeded_rng("uci-" + split)
+        for _ in range(n):
+            x = rng.normal(0.0, 1.0, 13).astype(np.float32)
+            y = float(x @ _W + _B + rng.normal(0.0, 0.5))
+            yield x, np.array([y], np.float32)
+
+    return reader
+
+
+def train():
+    return _reader(TRAIN_SIZE, "train")
+
+
+def test():
+    return _reader(TEST_SIZE, "test")

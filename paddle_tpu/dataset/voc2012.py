@@ -1,0 +1,41 @@
+"""PASCAL VOC2012 segmentation. reference:
+python/paddle/v2/dataset/voc2012.py — rows of (image [3,H,W], seg label
+[H,W] int in [0,21))."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "val"]
+
+H = W = 64   # synthetic resolution (real images vary)
+TRAIN_SIZE = 64
+TEST_SIZE = 16
+
+
+def _reader(n, split):
+    def reader():
+        rng = common.seeded_rng("voc2012-" + split)
+        for _ in range(n):
+            img = rng.uniform(0, 1, (3, H, W)).astype(np.float32)
+            label = np.zeros((H, W), np.int32)
+            cls = int(rng.randint(1, 21))
+            x0, y0 = rng.randint(0, H // 2), rng.randint(0, W // 2)
+            label[x0:x0 + H // 2, y0:y0 + W // 2] = cls
+            img[0, x0:x0 + H // 2, y0:y0 + W // 2] += 0.5
+            yield img, label
+
+    return reader
+
+
+def train():
+    return _reader(TRAIN_SIZE, "train")
+
+
+def test():
+    return _reader(TEST_SIZE, "test")
+
+
+def val():
+    return _reader(TEST_SIZE, "val")

@@ -1,0 +1,34 @@
+"""WMT-14 fr->en. reference: python/paddle/v2/dataset/wmt14.py — rows of
+(src_ids, trg_ids_with_<s>, trg_ids_next_with_<e>); ids 0/1/2 are
+<s>/<e>/<unk>."""
+from __future__ import annotations
+
+from . import common
+
+__all__ = ["train", "test", "START", "END", "UNK"]
+
+START, END, UNK = 0, 1, 2
+TRAIN_SIZE = 512
+TEST_SIZE = 64
+
+
+def _reader(n, split, dict_size):
+    def reader():
+        rng = common.seeded_rng("wmt14-" + split)
+        for _ in range(n):
+            slen = int(rng.randint(3, 15))
+            src = [int(w) for w in rng.randint(3, dict_size, slen)]
+            # target: deterministic "translation" (reverse + shift) so
+            # seq2seq models can learn the mapping
+            trg = [(w + 7) % (dict_size - 3) + 3 for w in reversed(src)]
+            yield src, [START] + trg, trg + [END]
+
+    return reader
+
+
+def train(dict_size):
+    return _reader(TRAIN_SIZE, "train", dict_size)
+
+
+def test(dict_size):
+    return _reader(TEST_SIZE, "test", dict_size)

@@ -1,0 +1,41 @@
+"""WMT-16 en->de (multi-lingual API of the reference).
+reference: python/paddle/v2/dataset/wmt16.py."""
+from __future__ import annotations
+
+from . import common
+
+__all__ = ["train", "test", "validation", "get_dict"]
+
+TRAIN_SIZE = 512
+TEST_SIZE = 64
+
+
+def get_dict(lang, dict_size, reverse=False):
+    d = {"<w%d>" % i: i for i in range(dict_size)}
+    if reverse:
+        return {v: k for k, v in d.items()}
+    return d
+
+
+def _reader(n, split, src_dict_size, trg_dict_size):
+    def reader():
+        rng = common.seeded_rng("wmt16-" + split)
+        for _ in range(n):
+            slen = int(rng.randint(3, 15))
+            src = [int(w) for w in rng.randint(3, src_dict_size, slen)]
+            trg = [(w + 11) % (trg_dict_size - 3) + 3 for w in reversed(src)]
+            yield src, [0] + trg, trg + [1]
+
+    return reader
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    return _reader(TRAIN_SIZE, "train", src_dict_size, trg_dict_size)
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    return _reader(TEST_SIZE, "test", src_dict_size, trg_dict_size)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    return _reader(TEST_SIZE, "valid", src_dict_size, trg_dict_size)

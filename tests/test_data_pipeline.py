@@ -1,0 +1,130 @@
+"""Reader decorators, DataFeeder, datasets.
+
+reference: python/paddle/v2/reader/tests/decorator_test.py,
+python/paddle/v2/tests/test_data_feeder-ish coverage."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import reader as rd
+from paddle_tpu import dataset
+
+
+def _counter(n):
+    def r():
+        for i in range(n):
+            yield i
+    return r
+
+
+def test_shuffle_batch_chain_firstn():
+    r = rd.shuffle(_counter(10), buf_size=4)
+    assert sorted(r()) == list(range(10))
+    b = rd.batch(_counter(7), batch_size=3)
+    batches = list(b())
+    assert [len(x) for x in batches] == [3, 3, 1]
+    b = rd.batch(_counter(7), batch_size=3, drop_last=True)
+    assert [len(x) for x in list(b())] == [3, 3]
+    c = rd.chain(_counter(2), _counter(3))
+    assert list(c()) == [0, 1, 0, 1, 2]
+    f = rd.firstn(_counter(100), 5)
+    assert list(f()) == [0, 1, 2, 3, 4]
+
+
+def test_map_compose_buffered_xmap():
+    m = rd.map_readers(lambda a, b: a + b, _counter(3), _counter(3))
+    assert list(m()) == [0, 2, 4]
+    comp = rd.compose(_counter(3), _counter(3))
+    assert list(comp()) == [(0, 0), (1, 1), (2, 2)]
+    buf = rd.buffered(_counter(50), 8)
+    assert sorted(buf()) == list(range(50))
+    xm = rd.xmap_readers(lambda x: x * 2, _counter(20), 4, 8, order=True)
+    assert list(xm()) == [2 * i for i in range(20)]
+
+
+def test_bucket_bounds_shapes():
+    def ragged():
+        rng = np.random.RandomState(0)
+        for _ in range(100):
+            ln = int(rng.randint(1, 100))
+            yield (list(range(ln)), 0)
+
+    batches = list(rd.bucket(ragged, batch_size=8,
+                             buckets=(16, 32, 64, 128))())
+    total = sum(len(b) for b in batches)
+    assert total == 100
+    for b in batches:
+        lens = [len(s[0]) for s in b]
+        # all samples in a batch fall in one bucket
+        bks = set()
+        for ln in lens:
+            for bk in (16, 32, 64, 128):
+                if ln <= bk:
+                    bks.add(bk)
+                    break
+        assert len(bks) == 1
+
+
+def test_data_feeder_dense_and_lod():
+    x = fluid.layers.data("img", shape=[4], dtype="float32")
+    y = fluid.layers.data("label", shape=[1], dtype="int64")
+    s = fluid.layers.data("seq", shape=[1], dtype="int64", lod_level=1)
+    feeder = fluid.DataFeeder(feed_list=[x, y, s], place=fluid.CPUPlace())
+    batch = [
+        (np.ones(4, np.float32), 3, [1, 2, 3]),
+        (np.zeros(4, np.float32), 1, [7]),
+    ]
+    feed = feeder.feed(batch)
+    assert feed["img"].shape == (2, 4)
+    assert feed["label"].shape == (2, 1)
+    t = feed["seq"]
+    assert t.lod() == [[0, 3, 4]]
+    np.testing.assert_array_equal(t.numpy().reshape(-1), [1, 2, 3, 7])
+
+
+def test_datasets_shapes():
+    img, lab = next(dataset.mnist.train()())
+    assert img.shape == (784,) and 0 <= lab < 10
+    img, lab = next(dataset.cifar.train10()())
+    assert img.shape == (3072,) and 0 <= lab < 10
+    x, y = next(dataset.uci_housing.train()())
+    assert x.shape == (13,) and y.shape == (1,)
+    words, lab = next(dataset.imdb.train(dataset.imdb.word_dict())())
+    assert len(words) > 0 and lab in (0, 1)
+    wd = dataset.imikolov.build_dict()
+    gram = next(dataset.imikolov.train(wd, 5)())
+    assert len(gram) == 5
+    row = next(dataset.movielens.train()())
+    assert len(row) == 8
+    row = next(dataset.conll05.test()())
+    assert len(row) == 9 and len(row[0]) == len(row[8])
+    src, trg_in, trg_out = next(dataset.wmt14.train(1000)())
+    assert trg_in[0] == dataset.wmt14.START and trg_out[-1] == dataset.wmt14.END
+    assert len(trg_in) == len(trg_out)
+
+
+def test_dataset_determinism():
+    a = list(dataset.mnist.test()())
+    b = list(dataset.mnist.test()())
+    np.testing.assert_array_equal(a[0][0], b[0][0])
+    assert [r[1] for r in a] == [r[1] for r in b]
+
+
+def test_feeder_trains_on_mnist():
+    """End-to-end: dataset -> shuffle -> batch -> DataFeeder -> Executor."""
+    img = fluid.layers.data("img", shape=[784], dtype="float32")
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    fc = fluid.layers.fc(img, size=10, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(fc, label))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder(feed_list=[img, label],
+                              place=fluid.CPUPlace())
+    train_reader = fluid.reader.batch(
+        fluid.reader.shuffle(fluid.dataset.mnist.train(), buf_size=500),
+        batch_size=64)
+    losses = []
+    for batch in train_reader():
+        l, = exe.run(feed=feeder.feed(batch), fetch_list=[loss])
+        losses.append(float(np.asarray(l).reshape(-1)[0]))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])
