@@ -162,6 +162,12 @@ def load_inference_model(dirname, executor, model_filename=None,
     # live program that happened to get the same counter value pre-pickle
     ir.Program._uid_counter[0] += 1
     program._uid = ir.Program._uid_counter[0]
-    vars = [v for v in program.list_vars() if v.persistable]
+    # only persistables the pruned graph actually reads (the program keeps
+    # all var *defs* through pruning; train-only state was never saved)
+    needed = set()
+    for op in program.global_block().ops:
+        needed.update(op.input_arg_names)
+    vars = [v for v in program.list_vars()
+            if v.persistable and v.name in needed]
     load_vars(executor, dirname, vars=vars, filename=params_filename)
     return program, payload["feed_names"], payload["fetch_names"]

@@ -95,6 +95,8 @@ def array_write(x, i, array=None):
         array = helper.main_block.create_var(
             name="{0}.out".format(helper.name), dtype=x.dtype,
             type=VarType.LOD_TENSOR_ARRAY)
+    if array.shape is None:
+        array.shape = x.shape
     helper.append_op(type="write_to_array",
                      inputs={"X": [x], "I": [i]},
                      outputs={"Out": [array]})
@@ -104,6 +106,7 @@ def array_write(x, i, array=None):
 def array_read(array, i):
     helper = LayerHelper("array_read", **locals())
     out = helper.create_variable_for_type_inference(dtype=array.dtype)
+    out.shape = array.shape
     helper.append_op(type="read_from_array",
                      inputs={"X": [array], "I": [i]},
                      outputs={"Out": [out]})
@@ -124,7 +127,8 @@ def array_length(array):
 def lod_rank_table(x, level=0):
     helper = LayerHelper("lod_rank_table", **locals())
     table = helper.main_block.create_var(
-        name="{0}.out".format(helper.name), type=VarType.LOD_RANK_TABLE)
+        name="{0}.out".format(helper.name), type=VarType.LOD_RANK_TABLE,
+        dtype="int32", stop_gradient=True)
     helper.append_op(type="lod_rank_table", inputs={"X": [x]},
                      outputs={"Out": [table]}, attrs={"level": level})
     return table
@@ -145,6 +149,7 @@ def lod_tensor_to_array(x, table):
     array = helper.main_block.create_var(
         name="{0}.out".format(helper.name), dtype=x.dtype,
         type=VarType.LOD_TENSOR_ARRAY)
+    array.shape = x.shape
     helper.append_op(type="lod_tensor_to_array",
                      inputs={"X": [x], "RankTable": [table]},
                      outputs={"Out": [array]})
@@ -155,6 +160,7 @@ def array_to_lod_tensor(x, table):
     helper = LayerHelper("array_to_lod_tensor", **locals())
     tmp = helper.create_variable_for_type_inference(dtype=x.dtype)
     tmp.lod_level = 1
+    tmp.shape = x.shape
     helper.append_op(type="array_to_lod_tensor",
                      inputs={"X": [x], "RankTable": [table]},
                      outputs={"Out": [tmp]})
@@ -164,6 +170,7 @@ def array_to_lod_tensor(x, table):
 def shrink_memory(x, i, table):
     helper = LayerHelper("shrink_memory", **locals())
     out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    out.shape = x.shape
     helper.append_op(type="shrink_rnn_memory",
                      inputs={"X": [x], "I": [i], "RankTable": [table]},
                      outputs={"Out": [out]})
@@ -174,6 +181,7 @@ def reorder_lod_tensor_by_rank(x, rank_table):
     helper = LayerHelper("reorder_lod_tensor_by_rank", **locals())
     out = helper.create_variable_for_type_inference(dtype=x.dtype)
     out.lod_level = x.lod_level
+    out.shape = x.shape
     helper.append_op(type="reorder_lod_tensor_by_rank",
                      inputs={"X": [x], "RankTable": [rank_table]},
                      outputs={"Out": [out]})
@@ -195,13 +203,29 @@ class BlockGuard(object):
         return exc_type is None
 
 
+def _block_reads_writes(sub):
+    """Outer vars a sub-block reads / writes (flat namespace)."""
+    written, read = [], []
+    for op in sub.ops:
+        for n in op.input_arg_names:
+            if n not in read and n not in written:
+                read.append(n)
+        for n in op.output_arg_names:
+            if n not in written:
+                written.append(n)
+    return read, written
+
+
 class While(object):
     """reference: layers/control_flow.py:607. Usage:
         cond = layers.less_than(i, n)
         w = While(cond)
         with w.block():
             ... ops; must update cond ...
-    Runs on the eager executor path (data-dependent iteration shapes)."""
+    Runs on the eager executor path (data-dependent iteration shapes).
+    Reads/writes of the body are declared as op inputs/outputs so
+    append_backward's path walk reaches upstream producers, and while_grad
+    (per-iteration vjp BPTT) trains through the loop."""
 
     def __init__(self, cond, name=None):
         self.helper = LayerHelper("while", name=name)
@@ -216,10 +240,12 @@ class While(object):
             yield
         finally:
             program.rollback()
+        read, written = _block_reads_writes(sub)
         parent_block.append_op(
             type="while",
-            inputs={"Condition": [self.cond_var]},
-            outputs={"Out": []},
+            inputs={"Condition": [self.cond_var],
+                    "X": [n for n in read if n != self.cond_var.name]},
+            outputs={"Out": list(written)},
             attrs={"sub_block": sub.idx})
 
 
@@ -529,10 +555,11 @@ class IfElse(object):
         finally:
             program.rollback()
             self.status = IfElse.OUT_IF_ELSE_BLOCKS
+        read, written = _block_reads_writes(sub)
         program.current_block().append_op(
             type="conditional_block",
-            inputs={"Cond": [cond]},
-            outputs={"Out": []},
+            inputs={"Cond": [cond], "X": read},
+            outputs={"Out": written},
             attrs={"sub_block": sub.idx})
 
     def true_block(self):
@@ -591,8 +618,10 @@ class Switch(object):
             yield
         finally:
             program.rollback()
+        read, written = _block_reads_writes(sub)
         parent.append_op(type="conditional_block",
-                         inputs={"Cond": conds}, outputs={"Out": []},
+                         inputs={"Cond": conds, "X": read},
+                         outputs={"Out": written},
                          attrs={"sub_block": sub.idx})
 
     @contextlib.contextmanager
@@ -604,9 +633,10 @@ class Switch(object):
             yield
         finally:
             program.rollback()
+        read, written = _block_reads_writes(sub)
         parent.append_op(type="conditional_block",
-                         inputs={"Cond": list(self.pre_not_conds)},
-                         outputs={"Out": []},
+                         inputs={"Cond": list(self.pre_not_conds), "X": read},
+                         outputs={"Out": written},
                          attrs={"sub_block": sub.idx})
 
 

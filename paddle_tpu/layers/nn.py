@@ -19,7 +19,8 @@ __all__ = [
     "square_error_cost", "softmax_with_cross_entropy", "accuracy", "topk",
     "matmul", "reshape", "transpose", "split", "concat_nn", "reduce_sum",
     "reduce_mean", "reduce_max", "reduce_min", "l2_normalize", "one_hot",
-    "clip", "clip_by_norm", "mean", "mul", "scale", "dot", "elementwise_add",
+    "clip", "clip_by_norm", "mean", "mul", "scale", "dot", "cos_sim",
+    "elementwise_add",
     "elementwise_sub", "elementwise_mul", "elementwise_div", "lrn", "prelu",
     "pad", "label_smooth", "sigmoid_cross_entropy_with_logits", "maxout",
     "relu", "log", "im2sequence", "expand", "squeeze", "unsqueeze",
@@ -455,6 +456,19 @@ def elementwise_div(x, y, axis=-1, act=None, name=None):
 
 def l2_normalize(x, axis, epsilon=1e-12, name=None):
     return _simple("l2_normalize", x, {"axis": axis, "epsilon": epsilon})
+
+
+def cos_sim(X, Y):
+    """reference: layers/nn.py cos_sim -> operators/cos_sim_op.cc."""
+    helper = LayerHelper("cos_sim", **locals())
+    out = helper.create_variable_for_type_inference(dtype=X.dtype)
+    xnorm = helper.create_variable_for_type_inference(dtype=X.dtype)
+    ynorm = helper.create_variable_for_type_inference(dtype=X.dtype)
+    out.shape = (X.shape[0], 1) if X.shape else None
+    helper.append_op(type="cos_sim", inputs={"X": [X], "Y": [Y]},
+                     outputs={"Out": [out], "XNorm": [xnorm],
+                              "YNorm": [ynorm]})
+    return out
 
 
 def one_hot(input, depth):

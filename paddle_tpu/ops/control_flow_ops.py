@@ -55,7 +55,15 @@ for _t, _f in [("less_than", jnp.less), ("less_equal", jnp.less_equal),
 # reference: operators/tensor_array_read_write_op.cc
 
 class LoDTensorArrayVal(list):
-    """Runtime value of a LOD_TENSOR_ARRAY variable (python list of values)."""
+    """Runtime value of a LOD_TENSOR_ARRAY variable (python list of values).
+    Registered as a pytree so whole arrays flow through jax.vjp in
+    while_grad (cotangents per element)."""
+
+
+jax.tree_util.register_pytree_node(
+    LoDTensorArrayVal,
+    lambda a: (tuple(a), None),
+    lambda aux, ch: LoDTensorArrayVal(ch))
 
 
 def _array_of(ctx, slot, create=True):
@@ -69,7 +77,20 @@ def _array_of(ctx, slot, create=True):
     return arr, name
 
 
-@register_op("write_to_array", host=True, no_gradient=True)
+def _write_to_array_grad_maker(op, block, grad_of, no_grad):
+    from ..core.ir import grad_var_name
+    out_name = op.output("Out")[0]
+    g = grad_of.get(out_name)
+    x_name = op.input("X")[0]
+    if g is None or x_name in no_grad:
+        return None
+    return [("write_to_array_grad",
+             {"I": list(op.input("I")), "Out@GRAD": [g]},
+             {"X@GRAD": [grad_var_name(x_name)]}, {})]
+
+
+@register_op("write_to_array", host=True,
+             grad_maker=_write_to_array_grad_maker)
 def write_to_array(ctx):
     x = ctx.input("X")
     i = int(np.asarray(raw_data(ctx.input("I"))).reshape(-1)[0])
@@ -81,11 +102,46 @@ def write_to_array(ctx):
     ctx.env[name] = arr
 
 
-@register_op("read_from_array", host=True, no_gradient=True)
+@register_op("write_to_array_grad", host=True, no_gradient=True)
+def write_to_array_grad(ctx):
+    arr_g = ctx.input("Out@GRAD")
+    i = int(np.asarray(raw_data(ctx.input("I"))).reshape(-1)[0])
+    if isinstance(arr_g, LoDTensorArrayVal) and i < len(arr_g) \
+            and arr_g[i] is not None:
+        ctx.set_output("X@GRAD", arr_g[i])
+
+
+def _read_from_array_grad_maker(op, block, grad_of, no_grad):
+    from ..core.ir import grad_var_name
+    out_name = op.output("Out")[0]
+    g = grad_of.get(out_name)
+    x_name = op.input("X")[0]
+    if g is None or x_name in no_grad:
+        return None
+    return [("read_from_array_grad",
+             {"X": [x_name], "I": list(op.input("I")), "Out@GRAD": [g]},
+             {"X@GRAD": [grad_var_name(x_name)]}, {})]
+
+
+@register_op("read_from_array", host=True,
+             grad_maker=_read_from_array_grad_maker)
 def read_from_array(ctx):
     arr = ctx.input("X")
     i = int(np.asarray(raw_data(ctx.input("I"))).reshape(-1)[0])
     ctx.set_output("Out", arr[i])
+
+
+@register_op("read_from_array_grad", host=True, no_gradient=True)
+def read_from_array_grad(ctx):
+    """Grad of reading slot i: an array of zeros except slot i."""
+    arr = ctx.input("X")
+    g = ctx.input("Out@GRAD")
+    i = int(np.asarray(raw_data(ctx.input("I"))).reshape(-1)[0])
+    out = LoDTensorArrayVal(
+        jax.tree_util.tree_map(jnp.zeros_like, e) if e is not None else None
+        for e in arr)
+    out[i] = g
+    ctx.set_output("X@GRAD", out)
 
 
 @register_op("lod_array_length", host=True, no_gradient=True)
@@ -126,7 +182,23 @@ def max_sequence_len(ctx):
     ctx.set_output("Out", jnp.asarray([ml], jnp.int64))
 
 
-@register_op("lod_tensor_to_array", host=True, no_gradient=True)
+def _lod_array_conv_grad_maker(grad_type):
+    def maker(op, block, grad_of, no_grad):
+        from ..core.ir import grad_var_name
+        out_name = op.output("Out")[0]
+        g = grad_of.get(out_name)
+        x_name = op.input("X")[0]
+        if g is None or x_name in no_grad:
+            return None
+        return [(grad_type,
+                 {"X": [x_name], "RankTable": list(op.input("RankTable")),
+                  "Out@GRAD": [g]},
+                 {"X@GRAD": [grad_var_name(x_name)]}, {})]
+    return maker
+
+
+@register_op("lod_tensor_to_array", host=True,
+             grad_maker=_lod_array_conv_grad_maker("lod_tensor_to_array_grad"))
 def lod_tensor_to_array(ctx):
     """Split ragged x into per-time-step dense tensors ordered by rank table
     (batch shrinks as short sequences end).
@@ -145,7 +217,26 @@ def lod_tensor_to_array(ctx):
     ctx.env[name] = arr
 
 
-@register_op("array_to_lod_tensor", host=True, no_gradient=True)
+@register_op("lod_tensor_to_array_grad", host=True, no_gradient=True)
+def lod_tensor_to_array_grad(ctx):
+    """Scatter per-step cotangents back to the concat LoD layout."""
+    x = ctx.input("X")
+    table = ctx.input("RankTable")
+    arr_g = ctx.input("Out@GRAD")
+    data = raw_data(x)
+    offs = np.asarray(x.lod[-1])
+    out = jnp.zeros_like(data)
+    for t, step_g in enumerate(arr_g):
+        if step_g is None:
+            continue
+        rows = np.asarray([offs[idx] + t for idx, ln in table.items
+                           if ln > t], np.int32)
+        out = out.at[rows].add(raw_data(step_g))
+    ctx.set_output("X@GRAD", with_lod_of(x, out))
+
+
+@register_op("array_to_lod_tensor", host=True,
+             grad_maker=_lod_array_conv_grad_maker("array_to_lod_tensor_grad"))
 def array_to_lod_tensor(ctx):
     """Inverse of lod_tensor_to_array. reference:
     operators/array_to_lod_tensor_op.cc."""
@@ -173,7 +264,54 @@ def array_to_lod_tensor(ctx):
                                     max_lens=(max(lengths) if lengths else 0,)))
 
 
-@register_op("shrink_rnn_memory", host=True)
+@register_op("array_to_lod_tensor_grad", host=True, no_gradient=True)
+def array_to_lod_tensor_grad(ctx):
+    """Split the concat cotangent back into per-step arrays (inverse of the
+    forward gather, rank-table ordered)."""
+    x_arr = ctx.input("X")
+    table = ctx.input("RankTable")
+    g = raw_data(ctx.input("Out@GRAD"))
+    g = np.asarray(g)
+    n = len(table.items)
+    lengths_sorted = [ln for _, ln in table.items]
+    # original-order sequence starts in the concat grad
+    lengths_orig = [0] * n
+    for k, (orig_idx, ln) in enumerate(table.items):
+        lengths_orig[orig_idx] = ln
+    starts = np.concatenate([[0], np.cumsum(lengths_orig)])[:-1]
+    out = LoDTensorArrayVal()
+    T = len(x_arr)
+    for t in range(T):
+        alive = [k for k in range(n) if lengths_sorted[k] > t]
+        rows = [g[starts[table.items[k][0]] + t] for k in alive]
+        out.append(jnp.asarray(np.stack(rows)) if rows else
+                   jnp.zeros((0,) + g.shape[1:], g.dtype))
+    ctx.set_output("X@GRAD", out)
+
+
+def _shrink_memory_grad_maker(op, block, grad_of, no_grad):
+    from ..core.ir import grad_var_name
+    out_name = op.output("Out")[0]
+    g = grad_of.get(out_name)
+    x_name = op.input("X")[0]
+    if g is None or x_name in no_grad:
+        return None
+    return [("shrink_rnn_memory_grad",
+             {"X": [x_name], "Out@GRAD": [g]},
+             {"X@GRAD": [grad_var_name(x_name)]}, {})]
+
+
+@register_op("shrink_rnn_memory_grad", host=True, no_gradient=True)
+def shrink_rnn_memory_grad(ctx):
+    x = raw_data(ctx.input("X"))
+    g = raw_data(ctx.input("Out@GRAD"))
+    k = g.shape[0]
+    pad = jnp.zeros((x.shape[0] - k,) + x.shape[1:], x.dtype)
+    ctx.set_output("X@GRAD", jnp.concatenate([g, pad], axis=0))
+
+
+@register_op("shrink_rnn_memory", host=True,
+             grad_maker=_shrink_memory_grad_maker)
 def shrink_rnn_memory(ctx):
     """Keep the first k rows of memory where k = #sequences still alive at
     step i. reference: operators/shrink_rnn_memory_op.cc."""
@@ -209,18 +347,144 @@ def reorder_lod_tensor_by_rank(ctx):
 # ---------------------------------------------------------------------------
 # While (host loop) — reference: operators/while_op.cc:35
 
-@register_op("while", host=True, no_gradient=True)
+# Backward (reference: while_op.cc WhileGradOp) is per-iteration jax.vjp over
+# the step block, driven by env snapshots the forward loop saves — BPTT
+# through the interpreter loop.
+
+def _sub_reads_writes(sub):
+    written, read = [], []
+    for op in sub.ops:
+        for n in op.output_arg_names:
+            if n not in written:
+                written.append(n)
+        for n in op.input_arg_names:
+            if n not in read:
+                read.append(n)
+    # loop-carried by default: written vars (incl. arrays mutated in place)
+    carried = read + [n for n in written if n not in read]
+    return carried, written
+
+
+def _snap_env(env):
+    return {k: (LoDTensorArrayVal(v) if isinstance(v, LoDTensorArrayVal)
+                else v) for k, v in env.items()}
+
+
+def _snap_key(op):
+    return "@WHILE_SNAP@%d" % id(op)
+
+
+@register_op("while", host=True)
 def while_op(ctx):
     sub = ctx.sub_block()
     cond_name = ctx.op.input("Condition")[0]
     max_iters = int(ctx.attr("max_iters", 10000))
+    snaps = []
     it = 0
     while bool(np.asarray(raw_data(ctx.env[cond_name])).reshape(-1)[0]):
+        snaps.append(_snap_env(ctx.env))
         trace_ops(sub, ctx.env, ctx.rng)
         it += 1
         if it >= max_iters:
             raise RuntimeError("while op exceeded max_iters=%d" % max_iters)
-    # written vars live in the flat env already — nothing to copy out
+    ctx.env[_snap_key(ctx.op)] = snaps
+
+
+def _is_float_val(v):
+    if isinstance(v, LoDTensorArrayVal):
+        return len(v) > 0 and all(e is not None and _is_float_val(e)
+                                  for e in v)
+    if isinstance(v, TracedLoD):
+        v = v.data
+    dt = getattr(v, "dtype", None)
+    return dt is not None and jnp.issubdtype(dt, jnp.floating)
+
+
+def _while_grad_maker(op, block, grad_of, no_grad):
+    from ..core.ir import grad_var_name
+    sub = block.program.blocks[op.attr("sub_block")] \
+        if isinstance(op.attr("sub_block"), int) else op.attr("sub_block")
+    carried, written = _sub_reads_writes(sub)
+    outg = [grad_of.get(n) or "" for n in written]
+    if not any(outg):
+        return None
+    gout = []
+    for n in carried:
+        var = block._find_var_recursive(n)
+        ok = (n not in no_grad and var is not None
+              and not getattr(var, "stop_gradient", False))
+        gout.append(grad_var_name(n) if ok else "")
+    if not any(gout):
+        return None
+    inputs = {"Read": list(carried), "Out": list(written),
+              "Out@GRAD": outg}
+    outputs = {"Read@GRAD": gout}
+    attrs = {"sub_block": op.attr("sub_block"),
+             "carried": list(carried), "written": list(written),
+             "snap_key": _snap_key(op)}
+    return [("while_grad", inputs, outputs, attrs)]
+
+
+registry.lookup_checked("while").grad_maker = _while_grad_maker
+
+
+@register_op("while_grad", host=True, no_gradient=True)
+def while_grad(ctx):
+    """Reverse sweep: for each forward iteration (latest first), jax.vjp the
+    step block as a pure function of its float inputs/carried state.
+    Host ops inside the body must not touch *differentiable* values with
+    numpy (array read/write and shrink_memory are safe: indices stay
+    concrete via the snapshot closure)."""
+    sub = ctx.sub_block()
+    carried = list(ctx.attr("carried"))
+    written = list(ctx.attr("written"))
+    snaps = ctx.env.pop(ctx.attr("snap_key"), [])
+    w_set = set(written)
+
+    # initial cotangents from downstream consumers of final values
+    cot = {}
+    for n, gname in zip(written, ctx.op.input("Out@GRAD")):
+        if gname and gname in ctx.env:
+            cot[n] = ctx.env[gname]
+
+    for env_t in reversed(snaps):
+        p_names = [n for n in carried
+                   if n in env_t and _is_float_val(env_t[n])]
+        primals = [env_t[n] for n in p_names]
+        w_float = [n for n in written
+                   if n in env_t and (n in cot or _is_float_val(env_t.get(n)))]
+
+        def f(*pvals):
+            env2 = _snap_env(env_t)
+            env2.update(zip(p_names, pvals))
+            trace_ops(sub, env2, None)
+            return tuple(env2[n] for n in w_float)
+
+        outs, vjp = jax.vjp(f, *primals)
+        cot_vec = tuple(
+            cot.get(n, jax.tree_util.tree_map(jnp.zeros_like, o))
+            for n, o in zip(w_float, outs))
+        gins = vjp(cot_vec)
+        new_cot = {}
+        for n, g in zip(p_names, gins):
+            if n in w_set:
+                new_cot[n] = g
+            else:
+                prev = cot.get(n)
+                new_cot[n] = g if prev is None else \
+                    jax.tree_util.tree_map(jnp.add, prev, g)
+        # cotangents of non-carried written vars die (overwritten next pass)
+        cot = new_cot
+
+    for n, gname in zip(carried, ctx.op.output("Read@GRAD")):
+        if gname:
+            g = cot.get(n)
+            if g is None:
+                base = ctx.env.get(n)
+                if base is None or not _is_float_val(base):
+                    continue
+                g = jax.tree_util.tree_map(jnp.zeros_like, base)
+            ctx.env[gname] = g
 
 
 @register_op("conditional_block", host=True, no_gradient=True)

@@ -33,10 +33,16 @@ def generic_grad(ctx):
     in_vals = {s: ctx.inputs(s) for s in in_slots}
     prim_index = []  # (slot, idx) in flattening order
     primals = []
+    def _jax_value(v):
+        from ..core.executor import TracedLoD
+        return (hasattr(v, "dtype") or isinstance(v, TracedLoD)
+                or isinstance(v, (list, tuple)))
+
     for s in in_slots:
         flags = diff_slots.get(s, [False] * len(in_vals[s]))
         for i, v in enumerate(in_vals[s]):
-            if i < len(flags) and flags[i] and v is not None:
+            if i < len(flags) and flags[i] and v is not None \
+                    and _jax_value(v):
                 prim_index.append((s, i))
                 primals.append(v)
 

@@ -204,8 +204,12 @@ def _infer_concat(op, block):
 
 @register_op("concat", infer_shape=_infer_concat)
 def concat(ctx):
-    xs = [raw_data(v) for v in ctx.inputs("X")]
-    ctx.set_output("Out", jnp.concatenate(xs, axis=ctx.attr("axis", 0)))
+    ins = ctx.inputs("X")
+    xs = [raw_data(v) for v in ins]
+    out = jnp.concatenate(xs, axis=ctx.attr("axis", 0))
+    # feature-axis concat of ragged inputs keeps the sequence structure
+    ctx.set_output("Out", with_lod_of(ins[0], out)
+                   if ctx.attr("axis", 0) != 0 else out)
 
 
 @register_op("split")
@@ -298,7 +302,9 @@ def lookup_table(ctx):
 @register_op("increment", stateful_outputs=("Out",))
 def increment(ctx):
     x = raw_data(ctx.input("X"))
-    ctx.set_output("Out", x + ctx.attr("step", 1.0))
+    # preserve dtype: loop counters must stay integral (reference
+    # increment_op casts step to X's type)
+    ctx.set_output("Out", x + jnp.asarray(ctx.attr("step", 1.0), x.dtype))
 
 
 @register_op("is_empty", no_gradient=True)

@@ -1,0 +1,49 @@
+"""Book: linear regression on UCI housing.
+reference model: python/paddle/fluid/tests/book/test_fit_a_line.py —
+fc(size=1) + square_error_cost, SGD, save/load inference round trip."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_fit_a_line(tmp_path):
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    y_predict = fluid.layers.fc(input=x, size=1, act=None)
+    cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+    avg_cost = fluid.layers.mean(cost)
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+
+    train_reader = fluid.reader.batch(
+        fluid.reader.shuffle(fluid.dataset.uci_housing.train(),
+                             buf_size=500),
+        batch_size=20)
+    place = fluid.CPUPlace()
+    feeder = fluid.DataFeeder(place=place, feed_list=[x, y])
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+
+    first, last = None, None
+    for epoch in range(4):
+        for data in train_reader():
+            c, = exe.run(feed=feeder.feed(data), fetch_list=[avg_cost])
+            c = float(np.asarray(c).reshape(-1)[0])
+            if first is None:
+                first = c
+            last = c
+    assert last < first * 0.5, (first, last)
+
+    # save/load inference round trip (reference: the book tests' saved
+    # models are reloaded by C++ inference tests)
+    path = str(tmp_path / "fit_a_line.model")
+    fluid.io.save_inference_model(path, ["x"], [y_predict], exe)
+    infer_prog, feed_names, fetch_targets = \
+        fluid.io.load_inference_model(path, exe)
+    sample = np.random.rand(3, 13).astype(np.float32)
+    golden_prog = fluid.io.get_inference_program([y_predict])
+    out_full = exe.run(golden_prog, feed={"x": sample},
+                       fetch_list=[y_predict.name])[0]
+    out_inf = exe.run(infer_prog, feed={feed_names[0]: sample},
+                      fetch_list=fetch_targets)[0]
+    np.testing.assert_allclose(np.asarray(out_full), np.asarray(out_inf),
+                               rtol=1e-5)

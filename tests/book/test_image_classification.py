@@ -1,0 +1,81 @@
+"""Book: CIFAR-10 image classification, small VGG and ResNet.
+reference model: python/paddle/fluid/tests/book/test_image_classification.py
+(vgg16_bn_drop and resnet_cifar10)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def vgg_small(input):
+    def conv_block(ipt, num_filter, groups):
+        return fluid.nets.img_conv_group(
+            input=ipt, pool_size=2, pool_stride=2,
+            conv_num_filter=[num_filter] * groups, conv_filter_size=3,
+            conv_act="relu", conv_with_batchnorm=True, pool_type="max")
+
+    conv1 = conv_block(input, 8, 2)
+    conv2 = conv_block(conv1, 16, 2)
+    fc1 = fluid.layers.fc(input=conv2, size=32, act=None)
+    bn = fluid.layers.batch_norm(input=fc1, act="relu")
+    return fluid.layers.fc(input=bn, size=32, act=None)
+
+
+def resnet_small(input):
+    def conv_bn_layer(input, ch_out, filter_size, stride, padding,
+                      act="relu"):
+        tmp = fluid.layers.conv2d(input=input, filter_size=filter_size,
+                                  num_filters=ch_out, stride=stride,
+                                  padding=padding, act=None, bias_attr=False)
+        return fluid.layers.batch_norm(input=tmp, act=act)
+
+    def shortcut(input, ch_in, ch_out, stride):
+        if ch_in != ch_out:
+            return conv_bn_layer(input, ch_out, 1, stride, 0, None)
+        return input
+
+    def basicblock(input, ch_in, ch_out, stride):
+        tmp = conv_bn_layer(input, ch_out, 3, stride, 1)
+        tmp = conv_bn_layer(tmp, ch_out, 3, 1, 1, act=None)
+        short = shortcut(input, ch_in, ch_out, stride)
+        return fluid.layers.elementwise_add(x=tmp, y=short, act="relu")
+
+    conv1 = conv_bn_layer(input, ch_out=8, filter_size=3, stride=1,
+                          padding=1)
+    res1 = basicblock(conv1, 8, 8, 1)
+    res2 = basicblock(res1, 8, 16, 2)
+    pool = fluid.layers.pool2d(input=res2, pool_size=8, pool_type="avg",
+                               pool_stride=1, global_pooling=True)
+    return pool
+
+
+@pytest.mark.parametrize("net", [vgg_small, resnet_small])
+def test_image_classification(net):
+    images = fluid.layers.data(name="pixel", shape=[3, 32, 32],
+                               dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    feat = net(images)
+    predict = fluid.layers.fc(input=feat, size=10, act="softmax")
+    cost = fluid.layers.cross_entropy(input=predict, label=label)
+    avg_cost = fluid.layers.mean(cost)
+    acc = fluid.layers.accuracy(input=predict, label=label)
+    fluid.optimizer.Adam(learning_rate=0.002).minimize(avg_cost)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    train_reader = fluid.reader.batch(
+        fluid.reader.shuffle(fluid.dataset.cifar.train10(), buf_size=512),
+        batch_size=32)
+
+    costs, accs = [], []
+    for i, data in enumerate(train_reader()):
+        imgs = np.stack([s[0].reshape(3, 32, 32) for s in data])
+        labels = np.array([[s[1]] for s in data], np.int64)
+        c, a = exe.run(feed={"pixel": imgs, "label": labels},
+                       fetch_list=[avg_cost, acc])
+        costs.append(float(np.asarray(c).reshape(-1)[0]))
+        accs.append(float(np.asarray(a).reshape(-1)[0]))
+        if i >= 15:
+            break
+    assert np.mean(costs[-3:]) < np.mean(costs[:3])

@@ -1,0 +1,102 @@
+"""Book: CoNLL-05 semantic role labeling with a deep bidirectional LSTM
+stack and a CRF head. reference model:
+python/paddle/fluid/tests/book/test_label_semantic_roles.py."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import build_lod_tensor
+
+word_dict, verb_dict, label_dict = fluid.dataset.conll05.get_dict()
+word_dict_len = len(word_dict)
+label_dict_len = len(label_dict)
+pred_len = len(verb_dict)
+
+mark_dict_len = 2
+word_dim = 16
+mark_dim = 4
+hidden_dim = 32
+depth = 4
+mix_hidden_lr = 1.0
+
+
+def db_lstm(word, predicate, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, mark):
+    predicate_embedding = fluid.layers.embedding(
+        input=predicate, size=[pred_len, word_dim],
+        param_attr=fluid.ParamAttr(name="vemb"))
+    mark_embedding = fluid.layers.embedding(
+        input=mark, size=[mark_dict_len, mark_dim])
+    word_input = [word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2]
+    emb_layers = [fluid.layers.embedding(
+        size=[word_dict_len, word_dim], input=x,
+        param_attr=fluid.ParamAttr(name="word_emb")) for x in word_input]
+    emb_layers.append(predicate_embedding)
+    emb_layers.append(mark_embedding)
+
+    hidden_0_layers = [fluid.layers.fc(input=emb, size=hidden_dim)
+                       for emb in emb_layers]
+    hidden_0 = fluid.layers.sums(input=hidden_0_layers)
+    lstm_0, _ = fluid.layers.dynamic_lstm(
+        input=hidden_0, size=hidden_dim, candidate_activation="relu",
+        gate_activation="sigmoid", cell_activation="sigmoid")
+    input_tmp = [hidden_0, lstm_0]
+    for i in range(1, depth):
+        mix_hidden = fluid.layers.sums(input=[
+            fluid.layers.fc(input=input_tmp[0], size=hidden_dim),
+            fluid.layers.fc(input=input_tmp[1], size=hidden_dim)])
+        lstm, _ = fluid.layers.dynamic_lstm(
+            input=mix_hidden, size=hidden_dim,
+            candidate_activation="relu", gate_activation="sigmoid",
+            cell_activation="sigmoid", is_reverse=((i % 2) == 1))
+        input_tmp = [mix_hidden, lstm]
+    feature_out = fluid.layers.sums(input=[
+        fluid.layers.fc(input=input_tmp[0], size=label_dict_len),
+        fluid.layers.fc(input=input_tmp[1], size=label_dict_len)])
+    return feature_out
+
+
+def test_label_semantic_roles():
+    def seq_data(name):
+        return fluid.layers.data(name=name, shape=[1], dtype="int64",
+                                 lod_level=1)
+
+    word = seq_data("word_data")
+    predicate = seq_data("verb_data")
+    ctx_n2 = seq_data("ctx_n2_data")
+    ctx_n1 = seq_data("ctx_n1_data")
+    ctx_0 = seq_data("ctx_0_data")
+    ctx_p1 = seq_data("ctx_p1_data")
+    ctx_p2 = seq_data("ctx_p2_data")
+    mark = seq_data("mark_data")
+    feature_out = db_lstm(word, predicate, ctx_n2, ctx_n1, ctx_0, ctx_p1,
+                          ctx_p2, mark)
+    target = seq_data("target")
+    crf_cost = fluid.layers.linear_chain_crf(
+        input=feature_out, label=target,
+        param_attr=fluid.ParamAttr(name="crfw", learning_rate=mix_hidden_lr))
+    avg_cost = fluid.layers.mean(crf_cost)
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+
+    crf_decode = fluid.layers.crf_decoding(
+        input=feature_out, param_attr=fluid.ParamAttr(name="crfw"))
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    reader = fluid.reader.batch(fluid.dataset.conll05.test(), batch_size=8)
+
+    costs = []
+    for i, data in enumerate(reader()):
+        feed = {}
+        names = ["word_data", "ctx_n2_data", "ctx_n1_data", "ctx_0_data",
+                 "ctx_p1_data", "ctx_p2_data", "verb_data", "mark_data",
+                 "target"]
+        for j, nm in enumerate(names):
+            feed[nm] = build_lod_tensor(
+                [np.array(s[j], np.int64).reshape(-1, 1) for s in data])
+        c, path = exe.run(feed=feed, fetch_list=[avg_cost, crf_decode])
+        costs.append(float(np.asarray(c).reshape(-1)[0]))
+        if i >= 15:
+            break
+    assert np.isfinite(costs).all()
+    assert np.mean(costs[-3:]) < np.mean(costs[:3]), costs
+    # decoded path aligns with the token stream
+    assert np.asarray(path.numpy()).shape[1] == 1

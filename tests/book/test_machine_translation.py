@@ -1,0 +1,145 @@
+"""Book: NMT seq2seq — train with DynamicRNN decoder, decode with beam
+search. reference model:
+python/paddle/fluid/tests/book/test_machine_translation.py."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import LoDTensor, build_lod_tensor
+
+pd = fluid.layers
+
+dict_size = 500
+hidden_dim = 16
+word_dim = 16
+batch_size = 2
+max_length = 6
+beam_size = 2
+decoder_size = hidden_dim
+
+
+def encoder():
+    src_word_id = pd.data(name="src_word_id", shape=[1], dtype="int64",
+                          lod_level=1)
+    src_embedding = pd.embedding(input=src_word_id,
+                                 size=[dict_size, word_dim],
+                                 param_attr=fluid.ParamAttr(name="vemb"))
+    fc1 = pd.fc(input=src_embedding, size=hidden_dim * 4, act="tanh")
+    lstm_hidden0, lstm_0 = pd.dynamic_lstm(input=fc1, size=hidden_dim * 4)
+    return pd.sequence_last_step(input=lstm_hidden0)
+
+
+def decoder_train(context):
+    trg_language_word = pd.data(name="target_language_word", shape=[1],
+                                dtype="int64", lod_level=1)
+    trg_embedding = pd.embedding(input=trg_language_word,
+                                 size=[dict_size, word_dim],
+                                 param_attr=fluid.ParamAttr(name="vemb"))
+    rnn = pd.DynamicRNN()
+    with rnn.block():
+        current_word = rnn.step_input(trg_embedding)
+        pre_state = rnn.memory(init=context)
+        current_state = pd.fc(input=[current_word, pre_state],
+                              size=decoder_size, act="tanh")
+        current_score = pd.fc(input=current_state, size=dict_size,
+                              act="softmax")
+        rnn.update_memory(pre_state, current_state)
+        rnn.output(current_score)
+    return rnn()
+
+
+def decoder_decode(context):
+    init_state = context
+    array_len = pd.fill_constant(shape=[1], dtype="int64", value=max_length)
+    counter = pd.zeros(shape=[1], dtype="int64", force_cpu=True)
+    state_array = pd.create_array("float32")
+    pd.array_write(init_state, array=state_array, i=counter)
+    ids_array = pd.create_array("int64")
+    scores_array = pd.create_array("float32")
+    init_ids = pd.data(name="init_ids", shape=[1], dtype="int64",
+                       lod_level=2)
+    init_scores = pd.data(name="init_scores", shape=[1], dtype="float32",
+                          lod_level=2)
+    pd.array_write(init_ids, array=ids_array, i=counter)
+    pd.array_write(init_scores, array=scores_array, i=counter)
+    cond = pd.less_than(x=counter, y=array_len)
+    while_op = pd.While(cond=cond)
+    with while_op.block():
+        pre_ids = pd.array_read(array=ids_array, i=counter)
+        pre_state = pd.array_read(array=state_array, i=counter)
+        pre_score = pd.array_read(array=scores_array, i=counter)
+        pre_state_expanded = pd.sequence_expand(pre_state, pre_score)
+        pre_ids_emb = pd.embedding(input=pre_ids,
+                                   size=[dict_size, word_dim])
+        current_state = pd.fc(input=[pre_ids_emb, pre_state_expanded],
+                              size=decoder_size, act="tanh")
+        current_score = pd.fc(input=current_state, size=dict_size,
+                              act="softmax")
+        topk_scores, topk_indices = pd.topk(current_score, k=beam_size)
+        selected_ids, selected_scores = pd.beam_search(
+            pre_ids, topk_indices, topk_scores, beam_size, end_id=10,
+            level=0)
+        pd.increment(x=counter, value=1, in_place=True)
+        pd.array_write(current_state, array=state_array, i=counter)
+        pd.array_write(selected_ids, array=ids_array, i=counter)
+        pd.array_write(selected_scores, array=scores_array, i=counter)
+        pd.less_than(x=counter, y=array_len, cond=cond)
+    return pd.beam_search_decode(ids=ids_array, scores=scores_array)
+
+
+def to_lod(seqs, dtype=np.int64):
+    return build_lod_tensor([np.array(s, dtype).reshape(-1, 1)
+                             for s in seqs])
+
+
+def test_train():
+    context = encoder()
+    rnn_out = decoder_train(context)
+    label = pd.data(name="target_language_next_word", shape=[1],
+                    dtype="int64", lod_level=1)
+    cost = pd.cross_entropy(input=rnn_out, label=label)
+    avg_cost = pd.mean(cost)
+    fluid.optimizer.Adagrad(learning_rate=0.05).minimize(avg_cost)
+
+    train_data = fluid.reader.batch(
+        fluid.dataset.wmt14.train(dict_size), batch_size=batch_size)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    costs = []
+    for i, data in enumerate(train_data()):
+        feed = {"src_word_id": to_lod([d[0] for d in data]),
+                "target_language_word": to_lod([d[1] for d in data]),
+                "target_language_next_word": to_lod([d[2] for d in data])}
+        c, = exe.run(feed=feed, fetch_list=[avg_cost])
+        costs.append(float(np.asarray(c).reshape(-1)[0]))
+        if i >= 12:
+            break
+    assert np.isfinite(costs).all()
+    assert np.mean(costs[-3:]) < np.mean(costs[:3]), costs
+
+
+def test_decode():
+    context = encoder()
+    translation_ids, translation_scores = decoder_decode(context)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    init_ids_data = np.ones((batch_size, 1), np.int64)
+    init_scores_data = np.ones((batch_size, 1), np.float32)
+    init_lod = [[i for i in range(batch_size)] + [batch_size]] * 2
+    init_ids = LoDTensor(init_ids_data, init_lod)
+    init_scores = LoDTensor(init_scores_data, init_lod)
+
+    data = list(fluid.reader.batch(fluid.dataset.wmt14.train(dict_size),
+                                   batch_size=batch_size)())[0]
+    result_ids, result_scores = exe.run(
+        feed={"src_word_id": to_lod([d[0] for d in data]),
+              "init_ids": init_ids, "init_scores": init_scores},
+        fetch_list=[translation_ids, translation_scores],
+        return_numpy=False)
+    lod = result_ids.lod()
+    # beam_size sentences per source, each bounded by max_length+1 tokens
+    assert len(lod[0]) - 1 == batch_size
+    n_sentences = lod[0][-1]
+    assert n_sentences == batch_size * beam_size
+    lengths = [b - a for a, b in zip(lod[1], lod[1][1:])]
+    assert all(1 <= l <= max_length + 1 for l in lengths)

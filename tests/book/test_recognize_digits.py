@@ -1,0 +1,52 @@
+"""Book: MNIST digits, MLP and LeNet conv variants.
+reference model: python/paddle/fluid/tests/book/test_recognize_digits.py."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def mlp(img, label):
+    hidden = fluid.layers.fc(input=img, size=64, act="relu")
+    hidden = fluid.layers.fc(input=hidden, size=64, act="relu")
+    prediction = fluid.layers.fc(input=hidden, size=10, act="softmax")
+    return prediction
+
+
+def conv_net(img, label):
+    img2d = fluid.layers.reshape(img, [-1, 1, 28, 28])
+    conv_pool_1 = fluid.nets.simple_img_conv_pool(
+        input=img2d, filter_size=5, num_filters=8, pool_size=2,
+        pool_stride=2, act="relu")
+    conv_pool_2 = fluid.nets.simple_img_conv_pool(
+        input=conv_pool_1, filter_size=5, num_filters=16, pool_size=2,
+        pool_stride=2, act="relu")
+    return fluid.layers.fc(input=conv_pool_2, size=10, act="softmax")
+
+
+@pytest.mark.parametrize("net", [mlp, conv_net])
+def test_recognize_digits(net):
+    img = fluid.layers.data(name="img", shape=[784], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    prediction = net(img, label)
+    cost = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_cost = fluid.layers.mean(cost)
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+    fluid.optimizer.Adam(learning_rate=0.003).minimize(avg_cost)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder(place=place, feed_list=[img, label])
+    train_reader = fluid.reader.batch(
+        fluid.reader.shuffle(fluid.dataset.mnist.train(), buf_size=500),
+        batch_size=64)
+
+    costs, accs = [], []
+    for data in train_reader():
+        c, a = exe.run(feed=feeder.feed(data), fetch_list=[avg_cost, acc])
+        costs.append(float(np.asarray(c).reshape(-1)[0]))
+        accs.append(float(np.asarray(a).reshape(-1)[0]))
+    assert np.mean(accs[-5:]) > np.mean(accs[:5]) + 0.1, \
+        (np.mean(accs[:5]), np.mean(accs[-5:]))
+    assert np.mean(costs[-5:]) < np.mean(costs[:5])

@@ -1,0 +1,87 @@
+"""Book: MovieLens recommender.
+reference model: python/paddle/fluid/tests/book/test_recommender_system.py —
+user/movie feature fusion (embeddings + fc + sequence pooling over
+categories/title), cos_sim head, square_error_cost."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import build_lod_tensor
+
+IS_SPARSE = False
+
+
+def get_usr_combined_features():
+    ml = fluid.dataset.movielens
+    uid = fluid.layers.data(name="user_id", shape=[1], dtype="int64")
+    usr_emb = fluid.layers.embedding(input=uid,
+                                     size=[ml.max_user_id() + 1, 16])
+    usr_fc = fluid.layers.fc(input=usr_emb, size=16)
+    gender = fluid.layers.data(name="gender_id", shape=[1], dtype="int64")
+    g_emb = fluid.layers.embedding(input=gender, size=[2, 8])
+    g_fc = fluid.layers.fc(input=g_emb, size=8)
+    age = fluid.layers.data(name="age_id", shape=[1], dtype="int64")
+    a_emb = fluid.layers.embedding(input=age,
+                                   size=[len(ml.age_table), 8])
+    a_fc = fluid.layers.fc(input=a_emb, size=8)
+    job = fluid.layers.data(name="job_id", shape=[1], dtype="int64")
+    j_emb = fluid.layers.embedding(input=job, size=[ml.max_job_id() + 1, 8])
+    j_fc = fluid.layers.fc(input=j_emb, size=8)
+    concat = fluid.layers.concat(input=[usr_fc, g_fc, a_fc, j_fc], axis=1)
+    return fluid.layers.fc(input=concat, size=32, act="tanh")
+
+
+def get_mov_combined_features():
+    ml = fluid.dataset.movielens
+    mov_id = fluid.layers.data(name="movie_id", shape=[1], dtype="int64")
+    mov_emb = fluid.layers.embedding(input=mov_id,
+                                     size=[ml.max_movie_id() + 1, 16])
+    mov_fc = fluid.layers.fc(input=mov_emb, size=16)
+    category_id = fluid.layers.data(name="category_id", shape=[1],
+                                    dtype="int64", lod_level=1)
+    mov_cat_emb = fluid.layers.embedding(input=category_id, size=[18, 16])
+    mov_cat = fluid.layers.sequence_pool(input=mov_cat_emb, pool_type="sum")
+    title_id = fluid.layers.data(name="title_ids", shape=[1], dtype="int64",
+                                 lod_level=1)
+    title_emb = fluid.layers.embedding(input=title_id, size=[512, 16])
+    title_pool = fluid.layers.sequence_pool(input=title_emb,
+                                            pool_type="sum")
+    concat = fluid.layers.concat(input=[mov_fc, mov_cat, title_pool], axis=1)
+    return fluid.layers.fc(input=concat, size=32, act="tanh")
+
+
+def test_recommender_system():
+    usr = get_usr_combined_features()
+    mov = get_mov_combined_features()
+    inference = fluid.layers.cos_sim(X=usr, Y=mov)
+    scale_infer = fluid.layers.scale(x=inference, scale=5.0)
+    label = fluid.layers.data(name="score", shape=[1], dtype="float32")
+    square_cost = fluid.layers.square_error_cost(input=scale_infer,
+                                                 label=label)
+    avg_cost = fluid.layers.mean(square_cost)
+    fluid.optimizer.SGD(learning_rate=0.2).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    reader = fluid.reader.batch(
+        fluid.reader.shuffle(fluid.dataset.movielens.train(), buf_size=512),
+        batch_size=32)
+
+    costs = []
+    for i, batch in enumerate(reader()):
+        feed = {
+            "user_id": np.array([[s[0]] for s in batch], np.int64),
+            "gender_id": np.array([[s[1]] for s in batch], np.int64),
+            "age_id": np.array([[s[2]] for s in batch], np.int64),
+            "job_id": np.array([[s[3]] for s in batch], np.int64),
+            "movie_id": np.array([[s[4]] for s in batch], np.int64),
+            "category_id": build_lod_tensor(
+                [np.array(s[5], np.int64).reshape(-1, 1) for s in batch]),
+            "title_ids": build_lod_tensor(
+                [np.array(s[6], np.int64).reshape(-1, 1) for s in batch]),
+            "score": np.array([s[7] for s in batch], np.float32),
+        }
+        c, = exe.run(feed=feed, fetch_list=[avg_cost])
+        costs.append(float(np.asarray(c).reshape(-1)[0]))
+        if i >= 30:
+            break
+    assert np.mean(costs[-5:]) < np.mean(costs[:5])

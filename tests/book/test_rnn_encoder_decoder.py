@@ -1,0 +1,85 @@
+"""Book: plain RNN encoder-decoder (no attention).
+reference model: python/paddle/fluid/tests/book/notest_rnn_encoder_decoer.py
+— bidirectional LSTM encoder pooled into the decoder init state, DynamicRNN
+decoder over target words."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import build_lod_tensor
+
+pd = fluid.layers
+
+dict_size = 300
+word_dim = 16
+hidden_dim = 16
+decoder_size = hidden_dim
+batch_size = 2
+
+
+def bi_lstm_encoder(input_seq, hidden_size):
+    input_forward_proj = pd.fc(input=input_seq, size=hidden_size * 4,
+                               bias_attr=False)
+    forward, _ = pd.dynamic_lstm(input=input_forward_proj,
+                                 size=hidden_size * 4, use_peepholes=False)
+    input_reversed_proj = pd.fc(input=input_seq, size=hidden_size * 4,
+                                bias_attr=False)
+    reversed_lstm, _ = pd.dynamic_lstm(input=input_reversed_proj,
+                                       size=hidden_size * 4,
+                                       is_reverse=True, use_peepholes=False)
+    return forward, reversed_lstm
+
+
+def test_rnn_encoder_decoder_train():
+    src_word_id = pd.data(name="source_sequence", shape=[1], dtype="int64",
+                          lod_level=1)
+    src_embedding = pd.embedding(input=src_word_id,
+                                 size=[dict_size, word_dim])
+    src_forward, src_reversed = bi_lstm_encoder(src_embedding, hidden_dim)
+    encoded_vector = pd.concat(input=[src_forward, src_reversed], axis=1)
+    enc_vec_last = pd.sequence_last_step(input=encoded_vector)
+    decoder_boot = pd.fc(input=enc_vec_last, size=decoder_size, act="tanh")
+
+    trg_word_id = pd.data(name="target_sequence", shape=[1], dtype="int64",
+                          lod_level=1)
+    trg_embedding = pd.embedding(input=trg_word_id,
+                                 size=[dict_size, word_dim])
+
+    rnn = pd.DynamicRNN()
+    with rnn.block():
+        current_word = rnn.step_input(trg_embedding)
+        mem = rnn.memory(init=decoder_boot)
+        decoder_inputs = pd.fc(input=[current_word, mem],
+                               size=decoder_size * 3, bias_attr=False)
+        h, _, _ = pd.gru_unit(input=decoder_inputs, hidden=mem,
+                              size=decoder_size * 3)
+        rnn.update_memory(mem, h)
+        out = pd.fc(input=h, size=dict_size, act="softmax")
+        rnn.output(out)
+    prediction = rnn()
+
+    label = pd.data(name="label_sequence", shape=[1], dtype="int64",
+                    lod_level=1)
+    cost = pd.cross_entropy(input=prediction, label=label)
+    avg_cost = pd.mean(cost)
+    fluid.optimizer.Adagrad(learning_rate=0.05).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    reader = fluid.reader.batch(fluid.dataset.wmt14.train(dict_size),
+                                batch_size=batch_size)
+
+    def to_lod(seqs):
+        return build_lod_tensor([np.array(s, np.int64).reshape(-1, 1)
+                                 for s in seqs])
+
+    costs = []
+    for i, data in enumerate(reader()):
+        feed = {"source_sequence": to_lod([d[0] for d in data]),
+                "target_sequence": to_lod([d[1] for d in data]),
+                "label_sequence": to_lod([d[2] for d in data])}
+        c, = exe.run(feed=feed, fetch_list=[avg_cost])
+        costs.append(float(np.asarray(c).reshape(-1)[0]))
+        if i >= 10:
+            break
+    assert np.isfinite(costs).all()
+    assert np.mean(costs[-3:]) < np.mean(costs[:3]), costs

@@ -1,0 +1,76 @@
+"""Book: IMDB sentiment, conv net and stacked LSTM.
+reference model: python/paddle/fluid/tests/book/test_understand_sentiment.py
+(convolution_net and stacked_lstm_net)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import build_lod_tensor
+
+VOCAB = 5147
+EMB_DIM = 16
+HID_DIM = 16
+
+
+def convolution_net(data, label, input_dim):
+    emb = fluid.layers.embedding(input=data, size=[input_dim, EMB_DIM])
+    conv_3 = fluid.nets.sequence_conv_pool(input=emb, num_filters=HID_DIM,
+                                           filter_size=3, act="tanh",
+                                           pool_type="sqrt")
+    conv_4 = fluid.nets.sequence_conv_pool(input=emb, num_filters=HID_DIM,
+                                           filter_size=4, act="tanh",
+                                           pool_type="sqrt")
+    prediction = fluid.layers.fc(input=[conv_3, conv_4], size=2,
+                                 act="softmax")
+    cost = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_cost = fluid.layers.mean(cost)
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+    return avg_cost, acc, prediction
+
+
+def stacked_lstm_net(data, label, input_dim, stacked_num=3):
+    emb = fluid.layers.embedding(input=data, size=[input_dim, EMB_DIM])
+    fc1 = fluid.layers.fc(input=emb, size=HID_DIM)
+    lstm1, cell1 = fluid.layers.dynamic_lstm(input=fc1, size=HID_DIM)
+    inputs = [fc1, lstm1]
+    for i in range(2, stacked_num + 1):
+        fc = fluid.layers.fc(input=inputs, size=HID_DIM)
+        lstm, cell = fluid.layers.dynamic_lstm(
+            input=fc, size=HID_DIM, is_reverse=(i % 2) == 0)
+        inputs = [fc, lstm]
+    fc_last = fluid.layers.sequence_pool(input=inputs[0], pool_type="max")
+    lstm_last = fluid.layers.sequence_pool(input=inputs[1], pool_type="max")
+    prediction = fluid.layers.fc(input=[fc_last, lstm_last], size=2,
+                                 act="softmax")
+    cost = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_cost = fluid.layers.mean(cost)
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+    return avg_cost, acc, prediction
+
+
+@pytest.mark.parametrize("net", [convolution_net, stacked_lstm_net])
+def test_understand_sentiment(net):
+    data = fluid.layers.data(name="words", shape=[1], dtype="int64",
+                             lod_level=1)
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    avg_cost, acc, _ = net(data, label, VOCAB)
+    fluid.optimizer.Adam(learning_rate=0.002).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    train_reader = fluid.reader.bucket(
+        fluid.reader.shuffle(fluid.dataset.imdb.train(None), buf_size=512),
+        batch_size=16, buckets=(32, 64, 128))
+
+    costs = []
+    for i, batch in enumerate(train_reader()):
+        words = build_lod_tensor(
+            [np.array(s[0], np.int64).reshape(-1, 1) for s in batch])
+        labels = np.array([[s[1]] for s in batch], np.int64)
+        c, = exe.run(feed={"words": words, "label": labels},
+                     fetch_list=[avg_cost])
+        costs.append(float(np.asarray(c).reshape(-1)[0]))
+        if i >= 25:
+            break
+    assert np.mean(costs[-5:]) < np.mean(costs[:5]), \
+        (np.mean(costs[:5]), np.mean(costs[-5:]))

@@ -1,0 +1,47 @@
+"""Book: word2vec n-gram model.
+reference model: python/paddle/fluid/tests/book/test_word2vec.py — 4 shared
+embeddings concat -> fc -> softmax over vocab."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+EMB_DIM = 16
+N = 5
+
+
+def test_word2vec():
+    word_dict = fluid.dataset.imikolov.build_dict()
+    dict_size = len(word_dict)
+
+    words = [fluid.layers.data(name="word_%d" % i, shape=[1], dtype="int64")
+             for i in range(4)]
+    next_word = fluid.layers.data(name="next_word", shape=[1],
+                                  dtype="int64")
+    embs = [fluid.layers.embedding(
+        input=w, size=[dict_size, EMB_DIM],
+        param_attr=fluid.ParamAttr(name="shared_w")) for w in words]
+    concat = fluid.layers.concat(input=embs, axis=1)
+    hidden1 = fluid.layers.fc(input=concat, size=64, act="sigmoid")
+    predict = fluid.layers.fc(input=hidden1, size=dict_size, act="softmax")
+    cost = fluid.layers.cross_entropy(input=predict, label=next_word)
+    avg_cost = fluid.layers.mean(cost)
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    reader = fluid.reader.batch(
+        fluid.dataset.imikolov.train(word_dict, N), batch_size=64)
+
+    costs = []
+    for i, batch in enumerate(reader()):
+        arr = np.array(batch, np.int64)
+        feed = {"word_%d" % j: arr[:, j:j + 1] for j in range(4)}
+        feed["next_word"] = arr[:, 4:5]
+        c, = exe.run(feed=feed, fetch_list=[avg_cost])
+        costs.append(float(np.asarray(c).reshape(-1)[0]))
+        if i >= 40:
+            break
+    assert np.mean(costs[-5:]) < np.mean(costs[:5])
+    # the embedding table is shared: one parameter named shared_w
+    names = [p.name for p in fluid.default_main_program().all_parameters()]
+    assert names.count("shared_w") == 1

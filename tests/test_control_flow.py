@@ -120,6 +120,41 @@ def test_dynamic_rnn_ragged_eager():
     np.testing.assert_allclose(got, want, rtol=1e-4)
 
 
+def test_dynamic_rnn_trains_through_while():
+    """Decoder-style DynamicRNN (memory init from an upstream fc) must train
+    end-to-end: while_grad BPTT + array/lod conversion grads + boot grads."""
+    layers = fluid.layers
+    np.random.seed(11)
+    seqs = [np.random.randn(4, 3).astype(np.float32),
+            np.random.randn(2, 3).astype(np.float32)]
+    ctx_in = np.random.randn(2, 4).astype(np.float32)
+
+    x = layers.data("x", shape=[3], dtype="float32", lod_level=1)
+    c = layers.data("c", shape=[4], dtype="float32")
+    context = fluid.layers.fc(c, size=4, act="tanh")
+    rnn = fluid.layers.DynamicRNN()
+    with rnn.block():
+        w_t = rnn.step_input(x)
+        pre = rnn.memory(init=context)
+        cur = fluid.layers.fc([w_t, pre], size=4, act="tanh")
+        rnn.update_memory(pre, cur)
+        rnn.output(cur)
+    out = rnn()
+    last = layers.sequence_last_step(out)
+    loss = layers.mean(layers.reduce_sum(layers.elementwise_mul(last, last),
+                                         dim=1))
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = {"x": build_lod_tensor(seqs), "c": ctx_in}
+    l0 = float(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0]))
+    for _ in range(15):
+        l = float(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0]))
+    assert np.isfinite(l0)
+    assert l < l0, (l0, l)
+
+
 def test_ifelse_scalar():
     layers = fluid.layers
     a = layers.data("a", shape=[1], append_batch_size=False)
