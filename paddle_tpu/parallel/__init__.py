@@ -15,3 +15,7 @@ from .api import (  # noqa: F401
     DistContext, ShardingStrategy, DistributeTranspiler, data_parallel,
 )
 from .env import get_world_size, get_rank, init_distributed  # noqa: F401
+from .ring import (  # noqa: F401
+    ring_attention, ring_attention_sharded, ulysses_attention,
+    ulysses_attention_sharded,
+)

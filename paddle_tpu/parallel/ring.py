@@ -1,0 +1,154 @@
+"""Context parallelism: ring attention and Ulysses (all-to-all) sequence
+parallelism over a mesh axis.
+
+No reference equivalent — the reference (2018) scales sequence length only
+via LoD ragged batching (SURVEY.md §5 long-context note); this module is the
+modern TPU answer the build plan requires: shard the *sequence* dimension
+over ICI and either
+
+- **ring attention**: keep q local, rotate k/v blocks around the ring with
+  ``lax.ppermute`` while accumulating online-softmax partials (memory
+  O(seq/devices), bandwidth rides neighbouring ICI links), or
+- **Ulysses**: ``all_to_all`` heads<->sequence so each device runs full-
+  sequence attention for a head subset (one collective each way).
+
+Both are pure-jax functions designed for use under ``shard_map`` /
+``pjit`` over a Mesh axis; `ring_attention_sharded` wraps the shard_map
+plumbing for the common case.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _online_merge(acc, new_max, new_num, new_den):
+    """Merge a new block into (running_max, running_num, running_den)."""
+    m, num, den = acc
+    mx = jnp.maximum(m, new_max)
+    alpha = jnp.exp(m - mx)
+    beta = jnp.exp(new_max - mx)
+    return mx, num * alpha[..., None] + new_num * beta[..., None], \
+        den * alpha + new_den * beta
+
+
+def _block_attn(q, k, v, scale, mask=None):
+    """One q-block x k-block attention partial: returns (max, num, den)
+    in the online-softmax decomposition."""
+    s = jnp.einsum("...qhd,...khd->...hqk", q, k) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -jnp.inf)
+    m = jnp.max(s, axis=-1)                          # [..., h, q]
+    m = jnp.where(jnp.isfinite(m), m, 0.0)           # fully-masked rows
+    p = jnp.exp(s - m[..., None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    num = jnp.einsum("...hqk,...khd->...hqd", p, v)  # [..., h, q, d]
+    den = jnp.sum(p, axis=-1)                        # [..., h, q]
+    return m, num, den
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+    """Attention over a sequence sharded on ``axis_name`` (call under
+    shard_map). q/k/v: [batch, seq_chunk, heads, dim] per device.
+
+    Rotates k/v blocks ring-wise with ppermute; each step contributes an
+    online-softmax partial, so no device ever materialises the full
+    [seq, seq] score matrix.
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    chunk = q.shape[1]
+    B, Q, H, D = q.shape
+
+    def local_mask(q_owner, k_owner):
+        if not causal:
+            return None
+        # global positions of q rows / k cols for these owners
+        qpos = q_owner * chunk + jnp.arange(chunk)
+        kpos = k_owner * chunk + jnp.arange(chunk)
+        return (qpos[:, None] >= kpos[None, :])[None, None, :, :]
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        (k_blk, v_blk), acc = carry
+        k_owner = (idx - t) % n
+        m, num, den = _block_attn(q, k_blk, v_blk, scale,
+                                  local_mask(idx, k_owner))
+        acc = _online_merge(acc, m, num, den)
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return ((k_blk, v_blk), acc), None
+
+    # -1e30 (not -inf) keeps exp(m0 - mx) an exact 0 without nan risk;
+    # derive from q so the carry carries the same varying (sp) axis type
+    qT = jnp.swapaxes(q, 1, 2)            # [B, H, Q, D]
+    m0 = qT[..., 0] * 0 - 1e30
+    num0 = qT * 0
+    den0 = qT[..., 0] * 0
+    ((_, _), (m, num, den)), _ = jax.lax.scan(
+        step, (((k, v), (m0, num0, den0))), jnp.arange(n))
+    out = num / jnp.maximum(den[..., None], 1e-20)
+    return jnp.einsum("...hqd->...qhd", out)
+
+
+def ring_attention_sharded(q, k, v, mesh: Mesh, seq_axis: str = "sp",
+                           causal=False):
+    """shard_map wrapper: q/k/v are global [batch, seq, heads, dim] arrays
+    (or sharded already); the sequence dim shards over ``seq_axis``."""
+    from jax import shard_map
+    spec = P(None, seq_axis, None, None)
+    fn = functools.partial(ring_attention, axis_name=seq_axis,
+                           causal=causal)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)(q, k, v)
+
+
+def ulysses_attention(q, k, v, axis_name, causal=False, scale=None):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style), call under
+    shard_map: trade the sequence shard for a head shard, run dense local
+    attention on the full sequence for heads/n, trade back."""
+    n = jax.lax.psum(1, axis_name)
+    B, S_loc, H, D = q.shape
+    assert H % n == 0, "heads must divide the sequence-parallel degree"
+
+    def seq2head(x):
+        # [B, S/n, H, D] -> [B, S, H/n, D]
+        x = x.reshape(B, S_loc, n, H // n, D)
+        x = jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                               tiled=False)
+        return x.reshape(B, S_loc * n, H // n, D)
+
+    def head2seq(x):
+        S = x.shape[1]
+        x = x.reshape(B, n, S // n, H // n, D)
+        x = jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=3,
+                               tiled=False)
+        return x.reshape(B, S // n, H, D)
+
+    qg, kg, vg = seq2head(q), seq2head(k), seq2head(v)
+    scale = scale if scale is not None else D ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", qg, kg) * scale
+    if causal:
+        S = qg.shape[1]
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, vg)
+    return head2seq(o)
+
+
+def ulysses_attention_sharded(q, k, v, mesh: Mesh, seq_axis: str = "sp",
+                              causal=False):
+    from jax import shard_map
+    spec = P(None, seq_axis, None, None)
+    fn = functools.partial(ulysses_attention, axis_name=seq_axis,
+                           causal=causal)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)(q, k, v)
