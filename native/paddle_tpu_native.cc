@@ -1,0 +1,347 @@
+// paddle_tpu native runtime: recordio storage, threaded prefetch loader,
+// fault-tolerant task master.
+//
+// Role in the framework (see SURVEY.md):
+//  - recordio: the chunked record format the reference's Go master shards
+//    datasets by (reference: go/master/service.go partition over RecordIO
+//    chunks; python/paddle/v2/reader/creator.py:60 recordio creator).
+//  - loader: the double-buffered prefetch data path (reference:
+//    paddle/gserver/dataproviders/DataProvider.h DoubleBufferedDataProvider
+//    and PyDataProvider2.cpp) — worker threads parse records into a bounded
+//    blocking queue the Python feeder drains.
+//  - master: in-process equivalent of the Go master task queue (reference:
+//    go/master/service.go GetTask:368 lease+timeout, TaskFinished:411,
+//    TaskFailed:455 requeue-until-failureMax, pass barrier ErrPassAfter).
+//
+// Exposed as a flat C ABI consumed by ctypes (paddle_tpu/native/__init__.py)
+// — the environment has no pybind11; ctypes over a C ABI is the supported
+// binding path.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <condition_variable>
+#include <chrono>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// crc32 (IEEE, small table-free variant — records are small; simplicity wins)
+
+static uint32_t crc32_update(uint32_t crc, const uint8_t* buf, size_t len) {
+  crc = ~crc;
+  for (size_t i = 0; i < len; ++i) {
+    crc ^= buf[i];
+    for (int k = 0; k < 8; ++k)
+      crc = (crc >> 1) ^ (0xEDB88320u & (~(crc & 1) + 1));
+  }
+  return ~crc;
+}
+
+// ---------------------------------------------------------------------------
+// recordio: [magic "PTRC"][records...]; record = [u32 len][u32 crc][payload]
+
+struct RioWriter {
+  FILE* f;
+  uint64_t count;
+};
+
+struct RioReader {
+  FILE* f;
+  std::vector<uint8_t> buf;
+};
+
+static const char kMagic[4] = {'P', 'T', 'R', 'C'};
+
+void* rio_writer_open(const char* path) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  if (fwrite(kMagic, 1, 4, f) != 4) { fclose(f); return nullptr; }
+  return new RioWriter{f, 0};
+}
+
+int rio_writer_write(void* h, const uint8_t* data, uint32_t len) {
+  auto* w = static_cast<RioWriter*>(h);
+  uint32_t crc = crc32_update(0, data, len);
+  if (fwrite(&len, 4, 1, w->f) != 1) return -1;
+  if (fwrite(&crc, 4, 1, w->f) != 1) return -1;
+  if (len && fwrite(data, 1, len, w->f) != len) return -1;
+  w->count++;
+  return 0;
+}
+
+uint64_t rio_writer_count(void* h) {
+  return static_cast<RioWriter*>(h)->count;
+}
+
+int rio_writer_close(void* h) {
+  auto* w = static_cast<RioWriter*>(h);
+  int rc = fclose(w->f);
+  delete w;
+  return rc;
+}
+
+void* rio_reader_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  char magic[4];
+  if (fread(magic, 1, 4, f) != 4 || memcmp(magic, kMagic, 4) != 0) {
+    fclose(f);
+    return nullptr;
+  }
+  return new RioReader{f, {}};
+}
+
+// returns payload length (>=0), -1 on EOF, -2 on corruption
+int64_t rio_reader_next(void* h, const uint8_t** out) {
+  auto* r = static_cast<RioReader*>(h);
+  uint32_t len, crc;
+  if (fread(&len, 4, 1, r->f) != 1) return -1;
+  if (fread(&crc, 4, 1, r->f) != 1) return -2;
+  r->buf.resize(len);
+  if (len && fread(r->buf.data(), 1, len, r->f) != len) return -2;
+  if (crc32_update(0, r->buf.data(), len) != crc) return -2;
+  *out = r->buf.data();
+  return static_cast<int64_t>(len);
+}
+
+int rio_reader_seek_record(void* h, uint64_t n) {
+  // skip n records from the start (used to shard files into master tasks)
+  auto* r = static_cast<RioReader*>(h);
+  if (fseek(r->f, 4, SEEK_SET) != 0) return -1;
+  for (uint64_t i = 0; i < n; ++i) {
+    uint32_t len;
+    if (fread(&len, 4, 1, r->f) != 1) return -1;
+    if (fseek(r->f, 4 + static_cast<long>(len), SEEK_CUR) != 0) return -1;
+  }
+  return 0;
+}
+
+int rio_reader_close(void* h) {
+  auto* r = static_cast<RioReader*>(h);
+  int rc = fclose(r->f);
+  delete r;
+  return rc;
+}
+
+// ---------------------------------------------------------------------------
+// loader: N worker threads read recordio files into a bounded queue
+
+struct Loader {
+  std::vector<std::string> paths;
+  size_t queue_cap;
+  std::deque<std::vector<uint8_t>> queue;
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  std::vector<std::thread> workers;
+  size_t next_file = 0;
+  int active_workers = 0;
+  bool stop = false;
+  std::vector<uint8_t> last;  // buffer handed to the consumer
+
+  void worker() {
+    for (;;) {
+      std::string path;
+      {
+        std::lock_guard<std::mutex> g(mu);
+        if (stop || next_file >= paths.size()) break;
+        path = paths[next_file++];
+      }
+      void* r = rio_reader_open(path.c_str());
+      if (!r) continue;
+      const uint8_t* p;
+      int64_t len;
+      while ((len = rio_reader_next(r, &p)) >= 0) {
+        std::vector<uint8_t> rec(p, p + len);
+        std::unique_lock<std::mutex> lk(mu);
+        cv_push.wait(lk, [&] { return queue.size() < queue_cap || stop; });
+        if (stop) break;
+        queue.push_back(std::move(rec));
+        cv_pop.notify_one();
+      }
+      rio_reader_close(r);
+      {
+        std::lock_guard<std::mutex> g(mu);
+        if (stop) break;
+      }
+    }
+    std::lock_guard<std::mutex> g(mu);
+    if (--active_workers == 0) cv_pop.notify_all();
+  }
+};
+
+void* loader_create(const char** paths, int n_paths, int n_threads,
+                    int queue_cap) {
+  auto* L = new Loader();
+  for (int i = 0; i < n_paths; ++i) L->paths.emplace_back(paths[i]);
+  L->queue_cap = queue_cap > 0 ? queue_cap : 64;
+  int nt = n_threads > 0 ? n_threads : 1;
+  L->active_workers = nt;
+  for (int i = 0; i < nt; ++i)
+    L->workers.emplace_back(&Loader::worker, L);
+  return L;
+}
+
+// returns record length, -1 when the pass is exhausted
+int64_t loader_next(void* h, const uint8_t** out) {
+  auto* L = static_cast<Loader*>(h);
+  std::unique_lock<std::mutex> lk(L->mu);
+  L->cv_pop.wait(lk, [&] {
+    return !L->queue.empty() || L->active_workers == 0;
+  });
+  if (L->queue.empty()) return -1;
+  L->last = std::move(L->queue.front());
+  L->queue.pop_front();
+  L->cv_push.notify_one();
+  *out = L->last.data();
+  return static_cast<int64_t>(L->last.size());
+}
+
+void loader_destroy(void* h) {
+  auto* L = static_cast<Loader*>(h);
+  {
+    std::lock_guard<std::mutex> g(L->mu);
+    L->stop = true;
+  }
+  L->cv_push.notify_all();
+  L->cv_pop.notify_all();
+  for (auto& t : L->workers) t.join();
+  delete L;
+}
+
+// ---------------------------------------------------------------------------
+// master: task queue with leases, timeouts, failure caps, pass barrier
+
+struct Task {
+  int64_t id;
+  std::vector<uint8_t> payload;
+  int failures = 0;
+};
+
+struct Master {
+  int failure_max;
+  double timeout_sec;
+  std::mutex mu;
+  std::deque<Task> todo;
+  std::map<int64_t, std::pair<Task, std::chrono::steady_clock::time_point>>
+      pending;  // leased
+  std::vector<Task> done;
+  std::vector<Task> failed;  // poisoned (failures >= failure_max)
+  int64_t next_id = 1;
+  std::vector<uint8_t> last;
+
+  void reclaim_expired() {
+    auto now = std::chrono::steady_clock::now();
+    for (auto it = pending.begin(); it != pending.end();) {
+      double age = std::chrono::duration<double>(now - it->second.second)
+                       .count();
+      if (age > timeout_sec) {
+        Task t = std::move(it->second.first);
+        t.failures++;
+        it = pending.erase(it);
+        if (t.failures >= failure_max)
+          failed.push_back(std::move(t));
+        else
+          todo.push_back(std::move(t));
+      } else {
+        ++it;
+      }
+    }
+  }
+};
+
+void* master_create(int failure_max, double timeout_sec) {
+  auto* m = new Master();
+  m->failure_max = failure_max > 0 ? failure_max : 3;
+  m->timeout_sec = timeout_sec > 0 ? timeout_sec : 60.0;
+  return m;
+}
+
+int64_t master_add_task(void* h, const uint8_t* payload, uint32_t len) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  Task t;
+  t.id = m->next_id++;
+  t.payload.assign(payload, payload + len);
+  m->todo.push_back(std::move(t));
+  return m->todo.back().id;
+}
+
+// lease a task: returns id (>0) and payload; 0 = pass finished (all done);
+// -1 = nothing available right now but pass not finished (retry later)
+int64_t master_get_task(void* h, const uint8_t** out, int64_t* out_len) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  m->reclaim_expired();
+  if (m->todo.empty()) {
+    *out_len = 0;
+    return m->pending.empty() ? 0 : -1;
+  }
+  Task t = std::move(m->todo.front());
+  m->todo.pop_front();
+  int64_t id = t.id;
+  m->last = t.payload;
+  *out = m->last.data();
+  *out_len = static_cast<int64_t>(m->last.size());
+  m->pending[id] = {std::move(t), std::chrono::steady_clock::now()};
+  return id;
+}
+
+int master_task_finished(void* h, int64_t id) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  auto it = m->pending.find(id);
+  if (it == m->pending.end()) return -1;
+  m->done.push_back(std::move(it->second.first));
+  m->pending.erase(it);
+  return 0;
+}
+
+int master_task_failed(void* h, int64_t id) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  auto it = m->pending.find(id);
+  if (it == m->pending.end()) return -1;
+  Task t = std::move(it->second.first);
+  m->pending.erase(it);
+  t.failures++;
+  if (t.failures >= m->failure_max)
+    m->failed.push_back(std::move(t));
+  else
+    m->todo.push_back(std::move(t));
+  return 0;
+}
+
+int64_t master_counts(void* h, int64_t* todo, int64_t* pending,
+                      int64_t* done, int64_t* failed) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  m->reclaim_expired();
+  *todo = m->todo.size();
+  *pending = m->pending.size();
+  *done = m->done.size();
+  *failed = m->failed.size();
+  return *todo + *pending;
+}
+
+// start a new pass: re-queue all done tasks (failed stay poisoned)
+int master_new_pass(void* h) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  for (auto& t : m->done) {
+    t.failures = 0;
+    m->todo.push_back(std::move(t));
+  }
+  m->done.clear();
+  return 0;
+}
+
+void master_destroy(void* h) { delete static_cast<Master*>(h); }
+
+}  // extern "C"

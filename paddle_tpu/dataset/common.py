@@ -56,3 +56,34 @@ def seeded_rng(name):
 def synthetic_notice(mod):
     return ("%s: synthetic deterministic corpus (no network egress); "
             "field structure matches the reference dataset" % mod)
+
+
+def convert(output_path, reader, line_count, name_prefix):
+    """Serialize a reader's samples into sharded native recordio files.
+    reference: v2/dataset/common.py convert (reader -> recordio shards the
+    Go master partitions into tasks)."""
+    import pickle
+
+    from .. import native
+
+    paths = []
+    idx = 0
+    w = None
+    written = 0
+    for sample in reader():
+        if w is None:
+            p = os.path.join(output_path,
+                             "%s-%05d.rio" % (name_prefix, idx))
+            os.makedirs(output_path, exist_ok=True)
+            w = native.Writer(p)
+            paths.append(p)
+        w.write(pickle.dumps(sample))
+        written += 1
+        if written >= line_count:
+            w.close()
+            w = None
+            written = 0
+            idx += 1
+    if w is not None:
+        w.close()
+    return paths

@@ -1,0 +1,249 @@
+"""ctypes bindings to the native runtime (native/paddle_tpu_native.cc):
+recordio storage, threaded prefetch loader, fault-tolerant task master.
+
+Built on demand with make/g++ (no pybind11 in this environment; the C ABI +
+ctypes is the binding layer, playing the role of the reference's pybind
+`core`, paddle/fluid/pybind/pybind.cc:60, for these host-runtime pieces).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libpaddle_tpu_native.so")
+_lock = threading.Lock()
+_lib = None
+_build_error = None
+
+
+def _build():
+    subprocess.run(["make", "-s", "-C", _NATIVE_DIR], check=True,
+                   capture_output=True)
+
+
+def load():
+    """Build (if needed) and load the native library; raises RuntimeError
+    with the build log when no toolchain is available."""
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_error is not None:
+            raise RuntimeError(_build_error)
+        try:
+            if not os.path.exists(_LIB_PATH) or (
+                    os.path.getmtime(_LIB_PATH) <
+                    os.path.getmtime(os.path.join(
+                        _NATIVE_DIR, "paddle_tpu_native.cc"))):
+                _build()
+            lib = ctypes.CDLL(_LIB_PATH)
+        except Exception as e:  # toolchain absent / build broke
+            _build_error = "native runtime unavailable: %s" % e
+            raise RuntimeError(_build_error)
+        _configure(lib)
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    try:
+        load()
+        return True
+    except RuntimeError:
+        return False
+
+
+def _configure(lib):
+    c = ctypes
+    u8p = c.POINTER(c.c_uint8)
+    lib.rio_writer_open.restype = c.c_void_p
+    lib.rio_writer_open.argtypes = [c.c_char_p]
+    lib.rio_writer_write.restype = c.c_int
+    lib.rio_writer_write.argtypes = [c.c_void_p, u8p, c.c_uint32]
+    lib.rio_writer_count.restype = c.c_uint64
+    lib.rio_writer_count.argtypes = [c.c_void_p]
+    lib.rio_writer_close.restype = c.c_int
+    lib.rio_writer_close.argtypes = [c.c_void_p]
+    lib.rio_reader_open.restype = c.c_void_p
+    lib.rio_reader_open.argtypes = [c.c_char_p]
+    lib.rio_reader_next.restype = c.c_int64
+    lib.rio_reader_next.argtypes = [c.c_void_p, c.POINTER(u8p)]
+    lib.rio_reader_seek_record.restype = c.c_int
+    lib.rio_reader_seek_record.argtypes = [c.c_void_p, c.c_uint64]
+    lib.rio_reader_close.restype = c.c_int
+    lib.rio_reader_close.argtypes = [c.c_void_p]
+    lib.loader_create.restype = c.c_void_p
+    lib.loader_create.argtypes = [c.POINTER(c.c_char_p), c.c_int, c.c_int,
+                                  c.c_int]
+    lib.loader_next.restype = c.c_int64
+    lib.loader_next.argtypes = [c.c_void_p, c.POINTER(u8p)]
+    lib.loader_destroy.restype = None
+    lib.loader_destroy.argtypes = [c.c_void_p]
+    lib.master_create.restype = c.c_void_p
+    lib.master_create.argtypes = [c.c_int, c.c_double]
+    lib.master_add_task.restype = c.c_int64
+    lib.master_add_task.argtypes = [c.c_void_p, u8p, c.c_uint32]
+    lib.master_get_task.restype = c.c_int64
+    lib.master_get_task.argtypes = [c.c_void_p, c.POINTER(u8p),
+                                    c.POINTER(c.c_int64)]
+    lib.master_task_finished.restype = c.c_int
+    lib.master_task_finished.argtypes = [c.c_void_p, c.c_int64]
+    lib.master_task_failed.restype = c.c_int
+    lib.master_task_failed.argtypes = [c.c_void_p, c.c_int64]
+    lib.master_counts.restype = c.c_int64
+    lib.master_counts.argtypes = [c.c_void_p] + [c.POINTER(c.c_int64)] * 4
+    lib.master_new_pass.restype = c.c_int
+    lib.master_new_pass.argtypes = [c.c_void_p]
+    lib.master_destroy.restype = None
+    lib.master_destroy.argtypes = [c.c_void_p]
+
+
+def _as_u8p(data: bytes):
+    return ctypes.cast(ctypes.create_string_buffer(data, len(data)),
+                       ctypes.POINTER(ctypes.c_uint8))
+
+
+# -- python-facing wrappers ---------------------------------------------------
+
+class Writer(object):
+    """recordio writer. reference role: recordio format the Go master
+    shards by (go/master/service.go partition)."""
+
+    def __init__(self, path):
+        self._lib = load()
+        self._h = self._lib.rio_writer_open(path.encode())
+        if not self._h:
+            raise IOError("cannot open %s for writing" % path)
+
+    def write(self, record: bytes):
+        if self._lib.rio_writer_write(self._h, _as_u8p(record),
+                                      len(record)) != 0:
+            raise IOError("recordio write failed")
+
+    @property
+    def count(self):
+        return self._lib.rio_writer_count(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.rio_writer_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+class Reader(object):
+    def __init__(self, path, skip_records=0):
+        self._lib = load()
+        self._h = self._lib.rio_reader_open(path.encode())
+        if not self._h:
+            raise IOError("cannot open recordio file %s" % path)
+        if skip_records:
+            if self._lib.rio_reader_seek_record(self._h, skip_records) != 0:
+                raise IOError("seek past end of %s" % path)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        p = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._lib.rio_reader_next(self._h, ctypes.byref(p))
+        if n == -1:
+            raise StopIteration
+        if n == -2:
+            raise IOError("recordio corruption detected (crc mismatch)")
+        return ctypes.string_at(p, n)
+
+    def close(self):
+        if self._h:
+            self._lib.rio_reader_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+class PrefetchLoader(object):
+    """Threaded record loader over recordio files (native double-buffer
+    path; reference role: DataProvider double-buffering)."""
+
+    def __init__(self, paths, num_threads=2, queue_cap=256):
+        self._lib = load()
+        arr = (ctypes.c_char_p * len(paths))(*[p.encode() for p in paths])
+        self._h = self._lib.loader_create(arr, len(paths), num_threads,
+                                          queue_cap)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        p = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._lib.loader_next(self._h, ctypes.byref(p))
+        if n < 0:
+            raise StopIteration
+        return ctypes.string_at(p, n)
+
+    def close(self):
+        if self._h:
+            self._lib.loader_destroy(self._h)
+            self._h = None
+
+
+class TaskMaster(object):
+    """Fault-tolerant task queue (lease/timeout/failure-cap/pass semantics
+    of the reference Go master, in-process; multi-host deployments front it
+    with jax.distributed's coordination service)."""
+
+    PASS_FINISHED = 0
+
+    def __init__(self, failure_max=3, timeout_sec=60.0):
+        self._lib = load()
+        self._h = self._lib.master_create(failure_max, timeout_sec)
+
+    def add_task(self, payload: bytes) -> int:
+        return self._lib.master_add_task(self._h, _as_u8p(payload),
+                                         len(payload))
+
+    def get_task(self):
+        """-> (task_id, payload) | ("wait", None) | (None, None) when the
+        pass is finished."""
+        p = ctypes.POINTER(ctypes.c_uint8)()
+        n = ctypes.c_int64()
+        tid = self._lib.master_get_task(self._h, ctypes.byref(p),
+                                        ctypes.byref(n))
+        if tid == 0:
+            return None, None
+        if tid == -1:
+            return "wait", None
+        return tid, ctypes.string_at(p, n.value)
+
+    def task_finished(self, task_id):
+        self._lib.master_task_finished(self._h, task_id)
+
+    def task_failed(self, task_id):
+        self._lib.master_task_failed(self._h, task_id)
+
+    def counts(self):
+        vals = [ctypes.c_int64() for _ in range(4)]
+        self._lib.master_counts(self._h, *[ctypes.byref(v) for v in vals])
+        return {"todo": vals[0].value, "pending": vals[1].value,
+                "done": vals[2].value, "failed": vals[3].value}
+
+    def new_pass(self):
+        self._lib.master_new_pass(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.master_destroy(self._h)
+            self._h = None

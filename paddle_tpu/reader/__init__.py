@@ -23,7 +23,45 @@ import queue as _queue
 __all__ = [
     "map_readers", "buffered", "compose", "chain", "shuffle", "firstn",
     "xmap_readers", "batch", "bucket", "cache", "multiprocess_guard",
+    "recordio", "recordio_prefetch",
 ]
+
+
+def recordio(paths, deserializer=None):
+    """Reader over native recordio files (one record per sample).
+    reference: python/paddle/v2/reader/creator.py:60 (creator.recordio)."""
+    if isinstance(paths, str):
+        paths = [paths]
+
+    def reader():
+        from .. import native
+        for p in paths:
+            with native.Reader(p) as r:
+                for rec in r:
+                    yield deserializer(rec) if deserializer else rec
+
+    return reader
+
+
+def recordio_prefetch(paths, deserializer=None, num_threads=2,
+                      queue_cap=256):
+    """Reader over recordio files via the native threaded prefetch loader
+    (the C++ double-buffer data path; reference role:
+    gserver/dataproviders DoubleBufferedDataProvider)."""
+    if isinstance(paths, str):
+        paths = [paths]
+
+    def reader():
+        from .. import native
+        loader = native.PrefetchLoader(paths, num_threads=num_threads,
+                                       queue_cap=queue_cap)
+        try:
+            for rec in loader:
+                yield deserializer(rec) if deserializer else rec
+        finally:
+            loader.close()
+
+    return reader
 
 
 def map_readers(func, *readers):
