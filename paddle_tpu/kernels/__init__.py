@@ -1,0 +1,10 @@
+"""Pallas TPU kernels for hot ops.
+
+Role of the reference's hand-written CUDA kernels (paddle/cuda hl_*,
+operators/math/detail lstm/gru kernels, conv_cudnn): where XLA's automatic
+fusion isn't enough, a Pallas kernel owns the VMEM working set explicitly.
+Kernels fall back to pure-jax (or interpret mode off-TPU) so every call site
+works on any backend; see /opt/skills/guides/pallas_guide.md for the
+blocking rules followed here.
+"""
+from .flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,147 @@
+"""Flash attention (forward) as a Pallas TPU kernel.
+
+Streams k/v blocks through VMEM against a resident q block, maintaining the
+online-softmax (running max / numerator / denominator) decomposition, so the
+[S, S] score matrix never materialises in HBM — the single-chip sibling of
+parallel/ring.py's cross-chip ring (same math, different memory wall).
+
+Backward is recompute-based (jax.custom_vjp over the dense reference
+implementation) — standard flash practice: recompute beats storing S²
+activations; a dedicated Pallas backward is a later optimisation.
+
+No reference equivalent (attention postdates the 2018 codebase); this is a
+capability the TPU build adds, used by nets.scaled_dot_product_attention.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+BLOCK_Q = 128
+BLOCK_K = 128
+
+
+def _dense_reference(q, k, v, causal, scale):
+    s = jnp.einsum("bqd,bkd->bqk", q, k) * scale
+    if causal:
+        S = q.shape[1]
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask[None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v)
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, scale, block_k,
+               seq_len):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)              # [BLOCK_Q, D]
+    bq, d = q.shape
+    n_k = seq_len // block_k
+
+    def body(ki, acc):
+        m, num, den = acc
+        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T,
+                    preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (bq, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, -1e30)
+        blk_max = jnp.max(s, axis=-1)
+        new_m = jnp.maximum(m, blk_max)
+        p = jnp.exp(s - new_m[:, None])
+        alpha = jnp.exp(m - new_m)
+        num = num * alpha[:, None] + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32)
+        den = den * alpha + jnp.sum(p, axis=-1)
+        return new_m, num, den
+
+    m0 = jnp.full((bq,), -1e30, jnp.float32)
+    num0 = jnp.zeros((bq, d), jnp.float32)
+    den0 = jnp.zeros((bq,), jnp.float32)
+    if causal and bq == block_k:
+        # blocks strictly above the diagonal contribute nothing
+        n_k = qi + 1
+    m, num, den = jax.lax.fori_loop(0, n_k, body, (m0, num0, den0))
+    o_ref[0] = (num / jnp.maximum(den[:, None], 1e-20)).astype(o_ref.dtype)
+
+
+def _fa_forward(q3, k3, v3, causal, scale, interpret):
+    """q3/k3/v3: [BH, S, D] -> [BH, S, D]."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+    BH, S, D = q3.shape
+    block_q = min(BLOCK_Q, S)
+    block_k = min(BLOCK_K, S)
+    kernel = functools.partial(_fa_kernel, causal=causal, scale=scale,
+                               block_k=block_k, seq_len=S)
+    return pl.pallas_call(
+        kernel,
+        grid=(BH, S // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
+        interpret=interpret,
+    )(q3, k3, v3)
+
+
+def _on_tpu():
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q3, k3, v3, causal, scale):
+    return _fa_forward(q3, k3, v3, causal, scale, interpret=not _on_tpu())
+
+
+def _flash_fwd(q3, k3, v3, causal, scale):
+    return _flash(q3, k3, v3, causal, scale), (q3, k3, v3)
+
+
+def _flash_bwd(causal, scale, res, g):
+    q3, k3, v3 = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: _dense_reference(q, k, v, causal, scale),
+        q3, k3, v3)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None):
+    """q/k/v: [batch, seq, heads, dim] -> [batch, seq, heads, dim].
+
+    Pallas streamed-softmax forward on TPU (interpret mode elsewhere),
+    recompute backward. Sequence length must divide by the 128-wide block
+    (or be <=128); ragged batches bucket to these sizes upstream."""
+    B, S, H, D = q.shape
+    if S > BLOCK_Q and S % BLOCK_Q != 0:
+        # off-size sequence: dense fallback keeps semantics
+        scale_ = scale if scale is not None else D ** -0.5
+        merged = _dense_reference(
+            q.transpose(0, 2, 1, 3).reshape(B * H, S, D),
+            k.transpose(0, 2, 1, 3).reshape(B * H, S, D),
+            v.transpose(0, 2, 1, 3).reshape(B * H, S, D), causal, scale_)
+        return merged.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+    scale = scale if scale is not None else D ** -0.5
+    q3 = q.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    k3 = k.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    v3 = v.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    o3 = _flash(q3, k3, v3, causal, scale)
+    return o3.reshape(B, H, S, D).transpose(0, 2, 1, 3)

@@ -13,6 +13,7 @@ from . import (  # noqa: F401
     io_ops,
     sequence_ops,
     control_flow_ops,
+    attention_ops,
 )
 
 from ..core.registry import registered_ops  # noqa: F401

@@ -1,0 +1,24 @@
+"""Attention ops backed by the Pallas flash kernel.
+
+No 2018 reference equivalent (attention postdates the codebase); these ops
+give the layers DSL a fused attention primitive the transformer-era models
+use, with the Pallas kernel on TPU and dense fallback elsewhere.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.executor import raw_data, with_lod_of
+from ..core.registry import register_op
+from ..kernels import flash_attention as _flash
+
+
+@register_op("flash_attention")
+def flash_attention_op(ctx):
+    """Q/K/V: [batch, seq, heads, dim] dense tensors."""
+    q = raw_data(ctx.input("Q"))
+    k = raw_data(ctx.input("K"))
+    v = raw_data(ctx.input("V"))
+    causal = bool(ctx.attr("causal", False))
+    out = _flash(q, k, v, causal=causal)
+    ctx.set_output("Out", out)

@@ -1,0 +1,77 @@
+"""Pallas flash attention vs dense reference (interpret mode on CPU)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.kernels import flash_attention
+from paddle_tpu.kernels.flash_attention import _dense_reference
+
+
+def dense(q, k, v, causal):
+    B, S, H, D = q.shape
+    o = _dense_reference(
+        q.transpose(0, 2, 1, 3).reshape(B * H, S, D),
+        k.transpose(0, 2, 1, 3).reshape(B * H, S, D),
+        v.transpose(0, 2, 1, 3).reshape(B * H, S, D), causal, D ** -0.5)
+    return o.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("seq", [128, 256])
+def test_flash_matches_dense(causal, seq):
+    rng = np.random.RandomState(0)
+    B, H, D = 2, 2, 64
+    q = jnp.asarray(rng.randn(B, seq, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, seq, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, seq, H, D), jnp.float32)
+    out = flash_attention(q, k, v, causal=causal)
+    want = dense(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_grads_match_dense():
+    rng = np.random.RandomState(1)
+    B, S, H, D = 1, 128, 2, 32
+    q = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+
+    g1 = jax.grad(lambda q, k, v: jnp.sum(
+        flash_attention(q, k, v, causal=True) ** 2), argnums=(0, 1, 2))(
+            q, k, v)
+    g2 = jax.grad(lambda q, k, v: jnp.sum(
+        dense(q, k, v, True) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_flash_odd_seq_fallback():
+    rng = np.random.RandomState(2)
+    q = jnp.asarray(rng.randn(1, 100, 2, 16), jnp.float32)
+    out = flash_attention(q, q, q, causal=True)
+    want = dense(q, q, q, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_op_in_program():
+    import paddle_tpu as fluid
+    q = fluid.layers.data("q", shape=[128, 2, 32], dtype="float32")
+    out_var = fluid.layers.data("qq", shape=[1], dtype="float32")  # unused
+    helper_block = fluid.default_main_program().global_block()
+    out = helper_block.create_var(name="attn_out", dtype="float32")
+    helper_block.append_op(type="flash_attention",
+                           inputs={"Q": ["q"], "K": ["q"], "V": ["q"]},
+                           outputs={"Out": [out]},
+                           attrs={"causal": True})
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(3)
+    qv = rng.randn(2, 128, 2, 32).astype(np.float32)
+    r, = exe.run(feed={"q": qv, "qq": np.zeros((1, 1), np.float32)},
+                 fetch_list=["attn_out"])
+    want = dense(jnp.asarray(qv), jnp.asarray(qv), jnp.asarray(qv), True)
+    np.testing.assert_allclose(np.asarray(r), np.asarray(want), rtol=2e-4,
+                               atol=2e-5)
