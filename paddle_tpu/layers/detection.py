@@ -1,0 +1,306 @@
+"""Detection layers DSL: SSD pipeline (priors, matching, loss, output).
+
+reference: python/paddle/fluid/layers/detection.py (detection_output:46,
+detection_map:138, bipartite_match:175, target_assign:245, ssd_loss:317,
+multi_box_head:532) + layers/ops auto-generated prior_box/iou_similarity/
+box_coder wrappers.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..param_attr import ParamAttr
+from .layer_helper import LayerHelper
+
+__all__ = [
+    "prior_box", "iou_similarity", "box_coder", "bipartite_match",
+    "target_assign", "mine_hard_examples", "multiclass_nms",
+    "detection_output", "detection_map", "ssd_loss", "multi_box_head",
+    "roi_pool",
+]
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
+              variance=None, flip=False, clip=False, steps=None, offset=0.5,
+              name=None):
+    helper = LayerHelper("prior_box", **locals())
+    boxes = helper.create_variable_for_type_inference("float32")
+    variances = helper.create_variable_for_type_inference("float32")
+    boxes.stop_gradient = variances.stop_gradient = True
+    steps = steps or [0.0, 0.0]
+    helper.append_op(
+        type="prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [variances]},
+        attrs={"min_sizes": list(min_sizes),
+               "max_sizes": list(max_sizes or []),
+               "aspect_ratios": list(aspect_ratios or [1.0]),
+               "variances": list(variance or [0.1, 0.1, 0.2, 0.2]),
+               "flip": flip, "clip": clip,
+               "step_w": steps[0], "step_h": steps[1], "offset": offset})
+    return boxes, variances
+
+
+def iou_similarity(x, y, name=None):
+    helper = LayerHelper("iou_similarity", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.lod_level = x.lod_level
+    helper.append_op(type="iou_similarity", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", name=None):
+    helper = LayerHelper("box_coder", **locals())
+    out = helper.create_variable_for_type_inference(target_box.dtype)
+    helper.append_op(type="box_coder",
+                     inputs={"PriorBox": [prior_box],
+                             "PriorBoxVar": [prior_box_var],
+                             "TargetBox": [target_box]},
+                     outputs={"OutputBox": [out]},
+                     attrs={"code_type": code_type})
+    return out
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    helper = LayerHelper("bipartite_match", **locals())
+    match_indices = helper.create_variable_for_type_inference("int32")
+    match_distance = helper.create_variable_for_type_inference("float32")
+    match_indices.stop_gradient = match_distance.stop_gradient = True
+    helper.append_op(type="bipartite_match",
+                     inputs={"DistMat": [dist_matrix]},
+                     outputs={"ColToRowMatchIndices": [match_indices],
+                              "ColToRowMatchDist": [match_distance]},
+                     attrs={"match_type": match_type or "bipartite",
+                            "dist_threshold": dist_threshold or 0.5})
+    return match_indices, match_distance
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=None, name=None):
+    helper = LayerHelper("target_assign", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_weight = helper.create_variable_for_type_inference("float32")
+    out.stop_gradient = out_weight.stop_gradient = True
+    inputs = {"X": [input], "MatchIndices": [matched_indices]}
+    if negative_indices is not None:
+        inputs["NegIndices"] = [negative_indices]
+    helper.append_op(type="target_assign", inputs=inputs,
+                     outputs={"Out": [out], "OutWeight": [out_weight]},
+                     attrs={"mismatch_value": mismatch_value or 0})
+    return out, out_weight
+
+
+def mine_hard_examples(cls_loss, match_indices, neg_pos_ratio=3.0,
+                       name=None):
+    helper = LayerHelper("mine_hard_examples", **locals())
+    neg_indices = helper.create_variable_for_type_inference("int32")
+    neg_indices.lod_level = 1
+    updated = helper.create_variable_for_type_inference("int32")
+    neg_indices.stop_gradient = updated.stop_gradient = True
+    helper.append_op(type="mine_hard_examples",
+                     inputs={"ClsLoss": [cls_loss],
+                             "MatchIndices": [match_indices]},
+                     outputs={"NegIndices": [neg_indices],
+                              "UpdatedMatchIndices": [updated]},
+                     attrs={"neg_pos_ratio": neg_pos_ratio})
+    return neg_indices, updated
+
+
+def multiclass_nms(bboxes, scores, background_label=0, score_threshold=0.01,
+                   nms_top_k=400, nms_threshold=0.3, keep_top_k=200,
+                   name=None):
+    helper = LayerHelper("multiclass_nms", **locals())
+    out = helper.create_variable_for_type_inference(bboxes.dtype)
+    out.lod_level = 1
+    out.stop_gradient = True
+    helper.append_op(type="multiclass_nms",
+                     inputs={"BBoxes": [bboxes], "Scores": [scores]},
+                     outputs={"Out": [out]},
+                     attrs={"background_label": background_label,
+                            "score_threshold": score_threshold,
+                            "nms_top_k": nms_top_k,
+                            "nms_threshold": nms_threshold,
+                            "keep_top_k": keep_top_k})
+    return out
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, name=None):
+    """Decode + per-class NMS. reference: layers/detection.py:46."""
+    from . import nn as _nn
+    from . import tensor as _tensor
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    scores_t = _nn.transpose(scores, perm=[0, 2, 1])  # [N, C, M]
+    return multiclass_nms(decoded, scores_t,
+                          background_label=background_label,
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, nms_threshold=nms_threshold,
+                          keep_top_k=keep_top_k)
+
+
+def detection_map(detect_res, label, overlap_threshold=0.5,
+                  evaluate_difficult=True, ap_version="integral"):
+    helper = LayerHelper("detection_map", **locals())
+    map_out = helper.create_variable_for_type_inference("float32")
+    pos_count = helper.create_variable_for_type_inference("int32")
+    true_pos = helper.create_variable_for_type_inference("float32")
+    false_pos = helper.create_variable_for_type_inference("float32")
+    for v in (map_out, pos_count, true_pos, false_pos):
+        v.stop_gradient = True
+    helper.append_op(type="detection_map",
+                     inputs={"DetectRes": [detect_res], "Label": [label]},
+                     outputs={"MAP": [map_out],
+                              "AccumPosCount": [pos_count],
+                              "AccumTruePos": [true_pos],
+                              "AccumFalsePos": [false_pos]},
+                     attrs={"overlap_threshold": overlap_threshold,
+                            "ap_type": ap_version})
+    return map_out
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, loc_loss_weight=1.0, conf_loss_weight=1.0,
+             match_type="per_prediction", mining_type="max_negative",
+             name=None):
+    """SSD multibox loss: match, mine hard negatives, localisation smooth-l1
+    + confidence softmax loss. reference: layers/detection.py:317 ssd_loss
+    (and gserver MultiBoxLossLayer)."""
+    from . import nn as _nn
+    from . import tensor as _tensor
+
+    # 1. match priors to gt by IoU
+    iou = iou_similarity(x=gt_box, y=prior_box)
+    matched_indices, matched_dist = bipartite_match(iou, match_type,
+                                                    overlap_threshold)
+    # 2. confidence loss for mining (targets as constants)
+    gt_label_t, _ = target_assign(gt_label, matched_indices,
+                                  mismatch_value=background_label)
+    # conf: [N, M, C]; cross entropy per prior
+    conf_sm = _nn.softmax(confidence)
+    cls_loss = _cross_entropy_3d(conf_sm, gt_label_t)
+    neg_indices, updated_match = mine_hard_examples(
+        cls_loss, matched_indices, neg_pos_ratio)
+    # 3. final targets incl. mined negatives
+    conf_target, conf_weight = target_assign(
+        gt_label, matched_indices, negative_indices=neg_indices,
+        mismatch_value=background_label)
+    enc = box_coder(prior_box,
+                    prior_box_var if prior_box_var is not None else
+                    _tensor.ones([prior_box.shape[0] or 1, 4], "float32"),
+                    gt_box, code_type="encode_center_size")
+    loc_target, loc_weight = target_assign(enc, matched_indices,
+                                           mismatch_value=0)
+    # 4. losses
+    loc_diff = _nn.elementwise_sub(location, loc_target)
+    loc_l = _nn.reduce_sum(
+        _smooth_l1(loc_diff), dim=-1, keep_dim=True)
+    loc_l = _nn.elementwise_mul(loc_l, loc_weight)
+    conf_l = _cross_entropy_3d(conf_sm, conf_target)
+    conf_l = _nn.elementwise_mul(_nn.unsqueeze(conf_l, [2]), conf_weight)
+    loss = _nn.elementwise_add(
+        _nn.scale(loc_l, scale=loc_loss_weight),
+        _nn.scale(conf_l, scale=conf_loss_weight))
+    return loss
+
+
+def _smooth_l1(x):
+    from . import nn as _nn
+    from .layer_helper import LayerHelper
+    helper = LayerHelper("ssd_smooth_l1")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = x.shape
+    helper.append_op(type="smooth_l1_core", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def _cross_entropy_3d(probs, labels):
+    """-log p[label] over the last axis of [N, M, C] probs; labels
+    [N, M, 1] int."""
+    helper = LayerHelper("ce3d")
+    out = helper.create_variable_for_type_inference(probs.dtype)
+    helper.append_op(type="gather_neg_log", inputs={"X": [probs],
+                                                    "Label": [labels]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=None, flip=True, clip=False,
+                   kernel_size=1, pad=0, stride=1, name=None):
+    """Per-feature-map loc/conf conv heads + concatenated priors.
+    reference: layers/detection.py:532 multi_box_head."""
+    from . import nn as _nn
+    from . import tensor as _tensor
+
+    n_layers = len(inputs)
+    if min_sizes is None:
+        # reference's ratio interpolation
+        min_ratio = min_ratio if min_ratio is not None else 20
+        max_ratio = max_ratio if max_ratio is not None else 90
+        step = int((max_ratio - min_ratio) / max(n_layers - 2, 1))
+        min_sizes, max_sizes = [], []
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes[:n_layers - 1]
+        max_sizes = [base_size * 0.20] + max_sizes[:n_layers - 1]
+
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, inp in enumerate(inputs):
+        mins = min_sizes[i]
+        maxs = max_sizes[i] if max_sizes else None
+        mins = mins if isinstance(mins, (list, tuple)) else [mins]
+        maxs = ([maxs] if maxs and not isinstance(maxs, (list, tuple))
+                else maxs)
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i],
+                                            (list, tuple)) \
+            else [aspect_ratios[i]]
+        box, var = prior_box(inp, image, mins, maxs, ar, variance, flip,
+                             clip, steps[i] if steps else None, offset)
+        num_priors = (len(ar) * (2 if flip else 1) - (len(ar) - 1 if flip
+                      else 0))
+        # priors per location = len(expanded ars) per min + one per max
+        num_boxes = box.shape[2] if box.shape else None
+        boxes_all.append(_nn.reshape(box, [-1, 4]))
+        vars_all.append(_nn.reshape(var, [-1, 4]))
+        np_ = num_boxes
+        loc = _nn.conv2d(inp, num_filters=np_ * 4,
+                         filter_size=kernel_size, padding=pad,
+                         stride=stride)
+        loc = _nn.transpose(loc, perm=[0, 2, 3, 1])
+        locs.append(_nn.reshape(loc, [loc.shape[0] or -1, -1, 4]))
+        conf = _nn.conv2d(inp, num_filters=np_ * num_classes,
+                          filter_size=kernel_size, padding=pad,
+                          stride=stride)
+        conf = _nn.transpose(conf, perm=[0, 2, 3, 1])
+        confs.append(_nn.reshape(conf, [conf.shape[0] or -1, -1,
+                                        num_classes]))
+    mbox_locs = _tensor.concat(locs, axis=1)
+    mbox_confs = _tensor.concat(confs, axis=1)
+    box = _tensor.concat(boxes_all, axis=0)
+    var = _tensor.concat(vars_all, axis=0)
+    return mbox_locs, mbox_confs, box, var
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0):
+    helper = LayerHelper("roi_pool", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    argmaxes = helper.create_variable_for_type_inference("int32")
+    argmaxes.stop_gradient = True
+    helper.append_op(type="roi_pool",
+                     inputs={"X": [input], "ROIs": [rois]},
+                     outputs={"Out": [out], "Argmax": [argmaxes]},
+                     attrs={"pooled_height": pooled_height,
+                            "pooled_width": pooled_width,
+                            "spatial_scale": spatial_scale})
+    return out

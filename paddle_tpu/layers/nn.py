@@ -19,7 +19,7 @@ __all__ = [
     "square_error_cost", "softmax_with_cross_entropy", "accuracy", "topk",
     "matmul", "reshape", "transpose", "split", "concat_nn", "reduce_sum",
     "reduce_mean", "reduce_max", "reduce_min", "l2_normalize", "one_hot",
-    "clip", "clip_by_norm", "mean", "mul", "scale", "dot", "cos_sim",
+    "clip", "clip_by_norm", "mean", "mul", "scale", "dot", "cos_sim", "slice",
     "elementwise_add",
     "elementwise_sub", "elementwise_mul", "elementwise_div", "lrn", "prelu",
     "pad", "label_smooth", "sigmoid_cross_entropy_with_logits", "maxout",
@@ -456,6 +456,17 @@ def elementwise_div(x, y, axis=-1, act=None, name=None):
 
 def l2_normalize(x, axis, epsilon=1e-12, name=None):
     return _simple("l2_normalize", x, {"axis": axis, "epsilon": epsilon})
+
+
+def slice(input, axes, starts, ends, name=None):
+    """reference: operators/slice_op.cc."""
+    helper = LayerHelper("slice", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="slice", inputs={"Input": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"axes": list(axes), "starts": list(starts),
+                            "ends": list(ends)})
+    return out
 
 
 def cos_sim(X, Y):

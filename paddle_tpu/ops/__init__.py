@@ -14,6 +14,7 @@ from . import (  # noqa: F401
     sequence_ops,
     control_flow_ops,
     attention_ops,
+    detection_ops,
 )
 
 from ..core.registry import registered_ops  # noqa: F401

@@ -1,0 +1,448 @@
+"""Detection ops: SSD-era anchors, matching, NMS, ROI pooling.
+
+reference: paddle/fluid/operators/{prior_box,iou_similarity,box_coder,
+bipartite_match,target_assign,mine_hard_examples,multiclass_nms,
+detection_output,detection_map,roi_pool}_op.* and the legacy gserver
+MultiBoxLossLayer/DetectionOutputLayer/ROIPoolLayer.
+
+Static-shape ops (prior_box, iou_similarity, box_coder, roi_pool) are pure
+jax; matching/NMS/mAP have data-dependent outputs (LoD results) and run as
+host ops on the eager path, like the reference's CPU-only kernels
+(multiclass_nms_op.cc is CPU-only in the reference too).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.executor import TracedLoD, raw_data, with_lod_of
+from ..core.registry import register_op
+
+
+@register_op("prior_box", no_gradient=True)
+def prior_box(ctx):
+    """SSD anchors for one feature map. reference: operators/prior_box_op.h
+    — outputs Boxes/Variances [H, W, num_priors, 4] (normalised ltrb)."""
+    inp = raw_data(ctx.input("Input"))
+    image = raw_data(ctx.input("Image"))
+    min_sizes = [float(v) for v in ctx.attr("min_sizes")]
+    max_sizes = [float(v) for v in ctx.attr("max_sizes", []) or []]
+    ars = [float(v) for v in ctx.attr("aspect_ratios", [1.0])]
+    variances = [float(v) for v in ctx.attr("variances",
+                                            [0.1, 0.1, 0.2, 0.2])]
+    flip = bool(ctx.attr("flip", False))
+    clip = bool(ctx.attr("clip", False))
+    step_w = float(ctx.attr("step_w", 0.0))
+    step_h = float(ctx.attr("step_h", 0.0))
+    offset = float(ctx.attr("offset", 0.5))
+
+    H, W = inp.shape[2], inp.shape[3]
+    img_h, img_w = image.shape[2], image.shape[3]
+    sw = step_w or img_w / W
+    sh = step_h or img_h / H
+
+    # expanded aspect ratios as the reference does (1.0 first, then ar and
+    # optionally 1/ar)
+    out_ars = [1.0]
+    for ar in ars:
+        if abs(ar - 1.0) < 1e-6:
+            continue
+        out_ars.append(ar)
+        if flip:
+            out_ars.append(1.0 / ar)
+
+    widths, heights = [], []
+    for ms in min_sizes:
+        for ar in out_ars:
+            widths.append(ms * math.sqrt(ar))
+            heights.append(ms / math.sqrt(ar))
+        # one extra prior per max_size: sqrt(min*max) square
+    for ms, mx in zip(min_sizes, max_sizes):
+        s = math.sqrt(ms * mx)
+        widths.append(s)
+        heights.append(s)
+    num_priors = len(widths)
+    widths = jnp.asarray(widths, jnp.float32)
+    heights = jnp.asarray(heights, jnp.float32)
+
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * sw
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * sh
+    cxg, cyg = jnp.meshgrid(cx, cy)          # [H, W]
+    cxg = cxg[..., None]
+    cyg = cyg[..., None]
+    x1 = (cxg - widths / 2.0) / img_w
+    y1 = (cyg - heights / 2.0) / img_h
+    x2 = (cxg + widths / 2.0) / img_w
+    y2 = (cyg + heights / 2.0) / img_h
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)  # [H, W, P, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (H, W, num_priors, 4))
+    ctx.set_output("Boxes", boxes)
+    ctx.set_output("Variances", var)
+
+
+def _iou_matrix(a, b):
+    """a: [N, 4], b: [M, 4] -> [N, M] IoU (ltrb)."""
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * \
+        jnp.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * \
+        jnp.maximum(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register_op("iou_similarity", no_gradient=True)
+def iou_similarity(ctx):
+    """reference: operators/iou_similarity_op.h."""
+    x = ctx.input("X")
+    y = raw_data(ctx.input("Y"))
+    out = _iou_matrix(raw_data(x), y)
+    ctx.set_output("Out", with_lod_of(x, out))
+
+
+@register_op("box_coder", no_gradient=True)
+def box_coder(ctx):
+    """Encode/decode center-size box deltas.
+    reference: operators/box_coder_op.h."""
+    prior = raw_data(ctx.input("PriorBox"))        # [M, 4]
+    pvar = ctx.input("PriorBoxVar")
+    pvar = raw_data(pvar) if pvar is not None else jnp.ones_like(prior)
+    target_v = ctx.input("TargetBox")
+    target = raw_data(target_v)
+    code_type = str(ctx.attr("code_type", "encode_center_size"))
+
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+
+    if code_type.lower() == "encode_center_size":
+        # target [N, 4] gt boxes -> deltas [N, M, 4]
+        tw = target[:, 2] - target[:, 0]
+        th = target[:, 3] - target[:, 1]
+        tcx = target[:, 0] + tw / 2
+        tcy = target[:, 1] + th / 2
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :] / pvar[None, :, 0]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :] / pvar[None, :, 1]
+        dw = jnp.log(jnp.maximum(tw[:, None] / pw[None, :], 1e-10)) \
+            / pvar[None, :, 2]
+        dh = jnp.log(jnp.maximum(th[:, None] / ph[None, :], 1e-10)) \
+            / pvar[None, :, 3]
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)
+    else:
+        # decode: target [N, M, 4] deltas -> boxes [N, M, 4]
+        dx, dy, dw, dh = (target[..., i] for i in range(4))
+        cx = dx * pvar[None, :, 0] * pw[None, :] + pcx[None, :]
+        cy = dy * pvar[None, :, 1] * ph[None, :] + pcy[None, :]
+        w = jnp.exp(dw * pvar[None, :, 2]) * pw[None, :]
+        h = jnp.exp(dh * pvar[None, :, 3]) * ph[None, :]
+        out = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                        axis=-1)
+    ctx.set_output("OutputBox", with_lod_of(target_v, out))
+
+
+@register_op("bipartite_match", host=True, no_gradient=True)
+def bipartite_match(ctx):
+    """Greedy bipartite matching per batch item (LoD level groups rows).
+    reference: operators/bipartite_match_op.cc BipartiteMatchKernel."""
+    dist_v = ctx.input("DistMat")
+    dist = np.asarray(raw_data(dist_v))
+    match_type = str(ctx.attr("match_type", "bipartite"))
+    overlap_threshold = float(ctx.attr("dist_threshold", 0.5))
+    if isinstance(dist_v, TracedLoD) and dist_v.lod:
+        offs = np.asarray(dist_v.lod[-1])
+    else:
+        offs = np.asarray([0, dist.shape[0]])
+    B = len(offs) - 1
+    M = dist.shape[1]
+    match_idx = np.full((B, M), -1, np.int32)
+    match_dist = np.zeros((B, M), np.float32)
+    for b in range(B):
+        d = dist[offs[b]:offs[b + 1]].copy()   # [rows, M]
+        if d.size == 0:
+            continue
+        # greedy global-max assignment
+        work = d.copy()
+        n_rows = work.shape[0]
+        for _ in range(min(n_rows, M)):
+            r, c = np.unravel_index(np.argmax(work), work.shape)
+            if work[r, c] <= 0:
+                break
+            match_idx[b, c] = r
+            match_dist[b, c] = d[r, c]
+            work[r, :] = -1
+            work[:, c] = -1
+        if match_type == "per_prediction":
+            for c in range(M):
+                if match_idx[b, c] == -1:
+                    r = int(np.argmax(d[:, c]))
+                    if d[r, c] >= overlap_threshold:
+                        match_idx[b, c] = r
+                        match_dist[b, c] = d[r, c]
+    ctx.set_output("ColToRowMatchIndices", jnp.asarray(match_idx))
+    ctx.set_output("ColToRowMatchDist", jnp.asarray(match_dist))
+
+
+@register_op("target_assign", host=True, no_gradient=True)
+def target_assign(ctx):
+    """Scatter per-gt rows to per-prior slots by match indices.
+    reference: operators/target_assign_op.h."""
+    x_v = ctx.input("X")
+    x = np.asarray(raw_data(x_v))                 # [total_gt, K]
+    match = np.asarray(raw_data(ctx.input("MatchIndices")))  # [B, M]
+    neg_v = ctx.input("NegIndices")
+    mismatch_value = ctx.attr("mismatch_value", 0)
+    offs = np.asarray(x_v.lod[-1]) if isinstance(x_v, TracedLoD) and x_v.lod \
+        else np.asarray([0, x.shape[0]])
+    B, M = match.shape
+    K = x.shape[-1] if x.ndim > 1 else 1
+    per_prior = (x.ndim == 3)   # [total_gt, M, K] (encoded loc targets)
+    x2 = x if per_prior else x.reshape(x.shape[0], K)
+    out = np.full((B, M, K), mismatch_value,
+                  x2.dtype if x2.dtype != np.int32 else x2.dtype)
+    wt = np.zeros((B, M, 1), np.float32)
+    for b in range(B):
+        for m in range(M):
+            r = match[b, m]
+            if r >= 0:
+                out[b, m] = x2[offs[b] + r, m] if per_prior \
+                    else x2[offs[b] + r]
+                wt[b, m] = 1.0
+    if neg_v is not None:
+        neg = np.asarray(raw_data(neg_v)).reshape(-1)
+        noffs = np.asarray(neg_v.lod[-1]) if isinstance(neg_v, TracedLoD) \
+            and neg_v.lod else np.asarray([0, len(neg)])
+        for b in range(min(B, len(noffs) - 1)):
+            for idx in neg[noffs[b]:noffs[b + 1]]:
+                out[b, int(idx)] = mismatch_value
+                wt[b, int(idx)] = 1.0
+    ctx.set_output("Out", jnp.asarray(out))
+    ctx.set_output("OutWeight", jnp.asarray(wt))
+
+
+@register_op("mine_hard_examples", host=True, no_gradient=True)
+def mine_hard_examples(ctx):
+    """Pick hard negatives by loss, neg:pos ratio capped.
+    reference: operators/mine_hard_examples_op.cc."""
+    cls_loss = np.asarray(raw_data(ctx.input("ClsLoss")))   # [B, M]
+    match = np.asarray(raw_data(ctx.input("MatchIndices")))  # [B, M]
+    neg_pos_ratio = float(ctx.attr("neg_pos_ratio", 3.0))
+    B, M = match.shape
+    upd = match.copy()
+    neg_rows, neg_lens = [], []
+    for b in range(B):
+        pos = int((match[b] >= 0).sum())
+        n_neg = int(min(M - pos, max(1, pos) * neg_pos_ratio))
+        cand = [(cls_loss[b, m], m) for m in range(M) if match[b, m] < 0]
+        cand.sort(key=lambda t: -t[0])
+        chosen = sorted(m for _, m in cand[:n_neg])
+        neg_rows.extend(chosen)
+        neg_lens.append(len(chosen))
+    noffs = np.concatenate([[0], np.cumsum(neg_lens)]).astype(np.int32)
+    ctx.set_output("NegIndices", TracedLoD(
+        jnp.asarray(np.asarray(neg_rows, np.int32).reshape(-1, 1)),
+        (jnp.asarray(noffs),)))
+    ctx.set_output("UpdatedMatchIndices", jnp.asarray(upd))
+
+
+def _nms_single(boxes, scores, thresh, top_k):
+    order = np.argsort(-scores)
+    if top_k > 0:
+        order = order[:top_k]
+    keep = []
+    while len(order):
+        i = order[0]
+        keep.append(i)
+        if len(order) == 1:
+            break
+        rest = order[1:]
+        ious = np.asarray(_iou_matrix(jnp.asarray(boxes[i:i + 1]),
+                                      jnp.asarray(boxes[rest])))[0]
+        order = rest[ious <= thresh]
+    return keep
+
+
+@register_op("multiclass_nms", host=True, no_gradient=True)
+def multiclass_nms(ctx):
+    """Per-class NMS + cross-class cap; LoD output rows
+    [label, score, x1, y1, x2, y2].
+    reference: operators/multiclass_nms_op.cc."""
+    bboxes = np.asarray(raw_data(ctx.input("BBoxes")))   # [B, M, 4]
+    scores = np.asarray(raw_data(ctx.input("Scores")))   # [B, C, M]
+    bg = int(ctx.attr("background_label", 0))
+    score_threshold = float(ctx.attr("score_threshold", 0.01))
+    nms_threshold = float(ctx.attr("nms_threshold", 0.3))
+    nms_top_k = int(ctx.attr("nms_top_k", 400))
+    keep_top_k = int(ctx.attr("keep_top_k", 200))
+    B, C, M = scores.shape
+    rows, lens = [], []
+    for b in range(B):
+        dets = []
+        for c in range(C):
+            if c == bg:
+                continue
+            sc = scores[b, c]
+            mask = sc > score_threshold
+            if not mask.any():
+                continue
+            idx = np.where(mask)[0]
+            keep = _nms_single(bboxes[b, idx], sc[idx], nms_threshold,
+                               nms_top_k)
+            for k in keep:
+                i = idx[k]
+                dets.append([float(c), float(sc[i])] +
+                            [float(v) for v in bboxes[b, i]])
+        dets.sort(key=lambda d: -d[1])
+        if keep_top_k > 0:
+            dets = dets[:keep_top_k]
+        rows.extend(dets)
+        lens.append(len(dets))
+    offs = np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
+    data = np.asarray(rows, np.float32).reshape(-1, 6) if rows else \
+        np.zeros((0, 6), np.float32)
+    ctx.set_output("Out", TracedLoD(jnp.asarray(data),
+                                    (jnp.asarray(offs),)))
+
+
+@register_op("detection_map", host=True, no_gradient=True)
+def detection_map(ctx):
+    """mAP (11-point interpolated or integral) over LoD detections vs LoD
+    ground truth. reference: operators/detection_map_op.h."""
+    det_v = ctx.input("DetectRes")      # lod rows [label, score, 4 box]
+    gt_v = ctx.input("Label")           # lod rows [label, 4 box] (+diff?)
+    overlap = float(ctx.attr("overlap_threshold", 0.5))
+    ap_type = str(ctx.attr("ap_type", "integral"))
+    det = np.asarray(raw_data(det_v))
+    gt = np.asarray(raw_data(gt_v))
+    d_offs = np.asarray(det_v.lod[-1])
+    g_offs = np.asarray(gt_v.lod[-1])
+    B = len(d_offs) - 1
+
+    # collect per-class scored TP/FP marks + gt counts
+    tps = {}
+    n_gt = {}
+    for b in range(B):
+        dets = det[d_offs[b]:d_offs[b + 1]]
+        gts = gt[g_offs[b]:g_offs[b + 1]]
+        for g in gts:
+            n_gt[int(g[0])] = n_gt.get(int(g[0]), 0) + 1
+        used = np.zeros(len(gts), bool)
+        for d in sorted(dets, key=lambda r: -r[1]):
+            c = int(d[0])
+            best, best_i = 0.0, -1
+            for i, g in enumerate(gts):
+                if int(g[0]) != c or used[i]:
+                    continue
+                iou = float(np.asarray(_iou_matrix(
+                    jnp.asarray(d[None, 2:6]), jnp.asarray(g[None, 1:5])))
+                    [0, 0])
+                if iou > best:
+                    best, best_i = iou, i
+            ok = best >= overlap and best_i >= 0
+            if ok:
+                used[best_i] = True
+            tps.setdefault(c, []).append((float(d[1]), ok))
+
+    aps = []
+    for c, marks in tps.items():
+        if n_gt.get(c, 0) == 0:
+            continue
+        marks.sort(key=lambda t: -t[0])
+        tp_cum = np.cumsum([1 if ok else 0 for _, ok in marks])
+        fp_cum = np.cumsum([0 if ok else 1 for _, ok in marks])
+        rec = tp_cum / n_gt[c]
+        prec = tp_cum / np.maximum(tp_cum + fp_cum, 1)
+        if ap_type == "11point":
+            ap = float(np.mean([prec[rec >= t].max() if (rec >= t).any()
+                                else 0.0 for t in np.linspace(0, 1, 11)]))
+        else:
+            ap = 0.0
+            prev_r = 0.0
+            for r, p in zip(rec, prec):
+                ap += (r - prev_r) * p
+                prev_r = r
+        aps.append(ap)
+    m_ap = float(np.mean(aps)) if aps else 0.0
+    ctx.set_output("MAP", jnp.asarray([m_ap], jnp.float32))
+    ctx.set_output("AccumPosCount", jnp.zeros((1,), jnp.int32))
+    ctx.set_output("AccumTruePos", jnp.zeros((1, 2), jnp.float32))
+    ctx.set_output("AccumFalsePos", jnp.zeros((1, 2), jnp.float32))
+
+
+@register_op("smooth_l1_core")
+def smooth_l1_core(ctx):
+    """Elementwise smooth-l1 of a difference tensor (ssd_loss helper;
+    reference math: operators/smooth_l1_loss_op.h SmoothL1Functor)."""
+    x = raw_data(ctx.input("X"))
+    ax = jnp.abs(x)
+    ctx.set_output("Out", jnp.where(ax < 1.0, 0.5 * x * x, ax - 0.5))
+
+
+@register_op("gather_neg_log")
+def gather_neg_log(ctx):
+    """-log p[label] along the last axis: probs [N, M, C], label [N, M, 1]
+    -> [N, M] (ssd_loss confidence loss)."""
+    p = raw_data(ctx.input("X"))
+    lab = raw_data(ctx.input("Label")).astype(jnp.int32)
+    if lab.ndim == p.ndim:
+        lab = lab[..., 0]
+    picked = jnp.take_along_axis(p, lab[..., None], axis=-1)[..., 0]
+    ctx.set_output("Out", -jnp.log(jnp.maximum(picked, 1e-10)))
+
+
+@register_op("roi_pool")
+def roi_pool(ctx):
+    """Max-pool each ROI to a fixed grid.
+    reference: operators/roi_pool_op.h."""
+    x = raw_data(ctx.input("X"))                  # [N, C, H, W]
+    rois_v = ctx.input("ROIs")
+    rois = raw_data(rois_v)                       # [R, 4] (lod: rois->image)
+    ph = int(ctx.attr("pooled_height", 1))
+    pw = int(ctx.attr("pooled_width", 1))
+    spatial_scale = float(ctx.attr("spatial_scale", 1.0))
+    N, C, H, W = x.shape
+
+    if isinstance(rois_v, TracedLoD) and rois_v.lod:
+        offs = rois_v.lod[-1]
+        total = rois.shape[0]
+        from .sequence_ops import segment_ids
+        img_of_roi = segment_ids(offs, total)
+    else:
+        img_of_roi = jnp.zeros((rois.shape[0],), jnp.int32)
+
+    def pool_one(roi, img_idx):
+        fmap = x[img_idx]                          # [C, H, W]
+        x1 = jnp.round(roi[0] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        ys = jnp.arange(H)
+        xs = jnp.arange(W)
+        out = jnp.zeros((C, ph, pw), x.dtype)
+        for i in range(ph):
+            for j in range(pw):
+                ys0 = y1 + (i * rh) // ph
+                ys1 = y1 + ((i + 1) * rh + ph - 1) // ph
+                xs0 = x1 + (j * rw) // pw
+                xs1 = x1 + ((j + 1) * rw + pw - 1) // pw
+                mask = ((ys[:, None] >= ys0) & (ys[:, None] < ys1) &
+                        (xs[None, :] >= xs0) & (xs[None, :] < xs1))
+                cell = jnp.where(mask[None], fmap, -jnp.inf)
+                v = jnp.max(cell, axis=(1, 2))
+                out = out.at[:, i, j].set(jnp.where(jnp.isfinite(v), v, 0))
+        return out
+
+    out = jax.vmap(pool_one)(rois, img_of_roi)
+    ctx.set_output("Out", out)
+    ctx.set_output("Argmax", jnp.zeros(out.shape, jnp.int32))

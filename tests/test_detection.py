@@ -1,0 +1,168 @@
+"""Detection stack: priors, IoU, box codec, matching, NMS, mAP, ROI pool,
+SSD loss. reference tests: python/paddle/fluid/tests/unittests/
+test_{prior_box,iou_similarity,box_coder,bipartite_match,multiclass_nms,
+detection_map,roi_pool}_op.py and test_detection (layers)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import LoDTensor
+
+
+def _exe():
+    e = fluid.Executor(fluid.CPUPlace())
+    return e
+
+
+def test_prior_box_shapes_and_values():
+    inp = fluid.layers.data("fm", shape=[8, 4, 4], dtype="float32")
+    img = fluid.layers.data("img", shape=[3, 32, 32], dtype="float32")
+    boxes, vars_ = fluid.layers.prior_box(
+        inp, img, min_sizes=[8.0], max_sizes=[16.0],
+        aspect_ratios=[1.0, 2.0], flip=True, clip=True)
+    exe = _exe()
+    b, v = exe.run(feed={"fm": np.zeros((1, 8, 4, 4), np.float32),
+                         "img": np.zeros((1, 3, 32, 32), np.float32)},
+                   fetch_list=[boxes, vars_])
+    b, v = np.asarray(b), np.asarray(v)
+    # priors: ar{1, 2, 1/2} for min + 1 for sqrt(min*max) = 4
+    assert b.shape == (4, 4, 4, 4)
+    assert v.shape == b.shape
+    assert (b >= 0).all() and (b <= 1).all()
+    # center prior at cell (0,0): min_size square centered at offset*step
+    cx = (b[0, 0, 0, 0] + b[0, 0, 0, 2]) / 2
+    np.testing.assert_allclose(cx * 32, 4.0, atol=1e-5)  # 0.5 * (32/4)
+
+
+def test_iou_similarity_known():
+    x = fluid.layers.data("x", shape=[4], dtype="float32")
+    y = fluid.layers.data("y", shape=[4], dtype="float32")
+    out = fluid.layers.iou_similarity(x, y)
+    exe = _exe()
+    xv = np.array([[0, 0, 2, 2]], np.float32)
+    yv = np.array([[1, 1, 3, 3], [0, 0, 2, 2], [4, 4, 5, 5]], np.float32)
+    r, = exe.run(feed={"x": xv, "y": yv}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(r)[0], [1 / 7, 1.0, 0.0],
+                               rtol=1e-5)
+
+
+def test_box_coder_round_trip():
+    prior = fluid.layers.data("prior", shape=[4], dtype="float32")
+    pvar = fluid.layers.data("pvar", shape=[4], dtype="float32")
+    gt = fluid.layers.data("gt", shape=[4], dtype="float32")
+    enc = fluid.layers.box_coder(prior, pvar, gt,
+                                 code_type="encode_center_size")
+    dec = fluid.layers.box_coder(prior, pvar, enc,
+                                 code_type="decode_center_size")
+    exe = _exe()
+    prior_v = np.array([[0, 0, 4, 4], [2, 2, 8, 10]], np.float32)
+    pvar_v = np.full((2, 4), 0.1, np.float32)
+    gt_v = np.array([[1, 1, 3, 5]], np.float32)
+    d, = exe.run(feed={"prior": prior_v, "pvar": pvar_v, "gt": gt_v},
+                 fetch_list=[dec])
+    d = np.asarray(d)  # [1, 2, 4]: decoding the encoding returns the gt
+    np.testing.assert_allclose(d[0, 0], gt_v[0], rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(d[0, 1], gt_v[0], rtol=1e-4, atol=1e-4)
+
+
+def test_bipartite_match():
+    dist = fluid.layers.data("dist", shape=[3], dtype="float32",
+                             lod_level=1)
+    idx, d = fluid.layers.bipartite_match(dist)
+    exe = _exe()
+    # 1 batch item, 2 gt rows x 3 priors
+    mat = np.array([[0.9, 0.2, 0.1], [0.8, 0.7, 0.3]], np.float32)
+    t = LoDTensor(mat, [[0, 2]])
+    i_, d_ = exe.run(feed={"dist": t}, fetch_list=[idx, d])
+    i_, d_ = np.asarray(i_), np.asarray(d_)
+    # greedy: (row0, col0, 0.9) then (row1, col1, 0.7)
+    assert i_[0, 0] == 0 and i_[0, 1] == 1 and i_[0, 2] == -1
+    np.testing.assert_allclose(d_[0, :2], [0.9, 0.7], rtol=1e-5)
+
+
+def test_multiclass_nms_suppresses():
+    bboxes = fluid.layers.data("bb", shape=[3, 4], dtype="float32")
+    scores = fluid.layers.data("sc", shape=[2, 3], dtype="float32")
+    out = fluid.layers.multiclass_nms(bboxes, scores, background_label=0,
+                                      score_threshold=0.1,
+                                      nms_threshold=0.4)
+    exe = _exe()
+    bb = np.array([[[0, 0, 2, 2], [0, 0, 2.1, 2.1], [5, 5, 7, 7]]],
+                  np.float32)
+    sc = np.zeros((1, 2, 3), np.float32)
+    sc[0, 1] = [0.9, 0.8, 0.7]   # class 1 scores per box
+    r, = exe.run(feed={"bb": bb, "sc": sc}, fetch_list=[out])
+    data = np.asarray(r.numpy())
+    # boxes 0 and 1 overlap heavily -> one survives; box 2 separate
+    assert data.shape == (2, 6)
+    np.testing.assert_allclose(sorted(data[:, 1]), [0.7, 0.9], rtol=1e-5)
+
+
+def test_detection_map_perfect():
+    det = fluid.layers.data("det", shape=[6], dtype="float32", lod_level=1)
+    gt = fluid.layers.data("gt", shape=[5], dtype="float32", lod_level=1)
+    m = fluid.layers.detection_map(det, gt, ap_version="integral")
+    exe = _exe()
+    det_rows = np.array([[1, 0.9, 0, 0, 2, 2]], np.float32)
+    gt_rows = np.array([[1, 0, 0, 2, 2]], np.float32)
+    r, = exe.run(feed={"det": LoDTensor(det_rows, [[0, 1]]),
+                       "gt": LoDTensor(gt_rows, [[0, 1]])},
+                 fetch_list=[m])
+    np.testing.assert_allclose(np.asarray(r), [1.0], rtol=1e-5)
+
+
+def test_roi_pool():
+    x = fluid.layers.data("x", shape=[1, 4, 4], dtype="float32")
+    rois = fluid.layers.data("rois", shape=[4], dtype="float32",
+                             lod_level=1)
+    out = fluid.layers.roi_pool(x, rois, pooled_height=2, pooled_width=2)
+    exe = _exe()
+    fmap = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    roi = LoDTensor(np.array([[0, 0, 3, 3]], np.float32), [[0, 1]])
+    r, = exe.run(feed={"x": fmap, "rois": roi}, fetch_list=[out])
+    r = np.asarray(r)
+    assert r.shape == (1, 1, 2, 2)
+    np.testing.assert_allclose(r[0, 0], [[5, 7], [13, 15]])
+
+
+def test_ssd_loss_trains():
+    np.random.seed(0)
+    M, C = 8, 3
+    loc = fluid.layers.data("loc", shape=[M, 4], dtype="float32")
+    conf = fluid.layers.data("conf", shape=[M, C], dtype="float32")
+    gt_box = fluid.layers.data("gt_box", shape=[4], dtype="float32",
+                               lod_level=1)
+    gt_label = fluid.layers.data("gt_label", shape=[1], dtype="int64",
+                                 lod_level=1)
+    pb = fluid.layers.data("pb", shape=[4], dtype="float32")
+    pbv = fluid.layers.data("pbv", shape=[4], dtype="float32")
+    # make loc/conf functions of trainable parameters
+    dummy = fluid.layers.data("one", shape=[1], dtype="float32")
+    base = fluid.layers.fc(dummy, size=M * (4 + C))
+    loc_p = fluid.layers.reshape(
+        fluid.layers.slice(base, axes=[1], starts=[0], ends=[M * 4]),
+        [-1, M, 4])
+    conf_p = fluid.layers.reshape(
+        fluid.layers.slice(base, axes=[1], starts=[M * 4],
+                           ends=[M * (4 + C)]), [-1, M, C])
+    loss = fluid.layers.ssd_loss(loc_p, conf_p, gt_box, gt_label, pb, pbv)
+    avg = fluid.layers.mean(fluid.layers.reduce_sum(loss, dim=[1, 2]))
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(avg)
+
+    exe = _exe()
+    exe.run(fluid.default_startup_program())
+    priors = np.stack([np.array([i, i, i + 2.0, i + 2.0]) for i in
+                       range(M)]).astype(np.float32)
+    feed = {
+        "one": np.ones((1, 1), np.float32),
+        "gt_box": LoDTensor(np.array([[0, 0, 2, 2], [4, 4, 6, 6]],
+                                     np.float32), [[0, 2]]),
+        "gt_label": LoDTensor(np.array([[1], [2]], np.int64), [[0, 2]]),
+        "pb": priors,
+        "pbv": np.full((M, 4), 0.1, np.float32),
+        "loc": np.zeros((1, M, 4), np.float32),
+        "conf": np.zeros((1, M, C), np.float32),
+    }
+    l0 = float(np.asarray(exe.run(feed=feed, fetch_list=[avg])[0]))
+    for _ in range(12):
+        l = float(np.asarray(exe.run(feed=feed, fetch_list=[avg])[0]))
+    assert np.isfinite(l0) and l < l0, (l0, l)
