@@ -31,6 +31,18 @@ from . import io  # noqa: F401
 from . import reader  # noqa: F401
 from . import dataset  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401
+from . import parallel  # noqa: F401
+from .parallel import DistributeTranspiler  # noqa: F401
+from . import concurrency  # noqa: F401
+from .concurrency import Go, Channel  # noqa: F401
+from . import trainer as trainer_mod  # noqa: F401
+from .trainer import Trainer  # noqa: F401
+from . import kernels  # noqa: F401
+from . import native  # noqa: F401
+from . import nets  # noqa: F401
+from .memory_optimization_transpiler import (  # noqa: F401
+    memory_optimize, release_memory,
+)
 from .io import (  # noqa: F401
     save_vars, save_params, save_persistables, load_vars, load_params,
     load_persistables, save_inference_model, load_inference_model,

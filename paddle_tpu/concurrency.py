@@ -1,0 +1,100 @@
+"""Go/Channel CSP concurrency, host-side.
+
+reference: python/paddle/fluid/concurrency.py:232 (Go/Channel wrappers over
+framework/channel.h:28 and operators/go_op.cc:29 — CSP *inside* programs).
+
+TPU-first inversion (SURVEY.md §2.1 Channels note): device programs are
+single XLA computations, so CSP moves to the host — Go spawns a thread,
+Channel is a bounded queue. The reference's main use (reader prefetch
+pipelines) is covered by reader.buffered / the native PrefetchLoader; this
+module keeps the programming-model parity for user code.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+
+__all__ = ["Go", "Channel", "ChannelClosed", "make_channel",
+           "channel_send", "channel_recv", "channel_close"]
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class Channel(object):
+    """Typed bounded channel (reference: framework/channel.h:28
+    Channel<T>::Send/Receive semantics: send to closed raises, receive on
+    closed drains then signals)."""
+
+    _CLOSED = object()
+
+    def __init__(self, capacity=0):
+        self._q = _queue.Queue(maxsize=capacity)
+        self._closed = threading.Event()
+
+    def send(self, value):
+        if self._closed.is_set():
+            raise ChannelClosed("send on closed channel")
+        self._q.put(value)
+
+    def recv(self, timeout=None):
+        """-> (value, ok); ok=False when closed and drained."""
+        while True:
+            try:
+                v = self._q.get(timeout=0.05 if self._closed.is_set()
+                                else timeout)
+            except _queue.Empty:
+                if self._closed.is_set():
+                    return None, False
+                continue
+            if v is Channel._CLOSED:
+                self._q.put(Channel._CLOSED)  # wake other receivers
+                return None, False
+            return v, True
+
+    def close(self):
+        self._closed.set()
+        self._q.put(Channel._CLOSED)
+
+    def __iter__(self):
+        while True:
+            v, ok = self.recv()
+            if not ok:
+                return
+            yield v
+
+
+class Go(object):
+    """Run a function (or a with-block builder) concurrently.
+    reference: concurrency.py Go / operators/go_op.cc (spawns the block on
+    the framework ThreadPool)."""
+
+    def __init__(self, fn=None, *args, **kwargs):
+        self._thread = None
+        if fn is not None:
+            self._thread = threading.Thread(target=fn, args=args,
+                                            kwargs=kwargs, daemon=True)
+            self._thread.start()
+
+    def join(self, timeout=None):
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+
+def make_channel(dtype=None, capacity=0):
+    return Channel(capacity=capacity)
+
+
+def channel_send(channel, value):
+    channel.send(value)
+    return True
+
+
+def channel_recv(channel, return_value=None):
+    v, ok = channel.recv()
+    return (v if ok else return_value), ok
+
+
+def channel_close(channel):
+    channel.close()

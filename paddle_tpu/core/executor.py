@@ -311,7 +311,7 @@ class Executor(object):
     """reference: python/paddle/fluid/executor.py:166 (class Executor) /
     paddle/fluid/framework/executor.cc:86 (Executor::Run)."""
 
-    def __init__(self, place=None, dist_context=None):
+    def __init__(self, place=None, dist_context=None, check_nan_inf=False):
         from .. import place as place_mod
         self.place = place if place is not None else place_mod.TPUPlace()
         self._cache: Dict[Any, Any] = {}
@@ -319,6 +319,8 @@ class Executor(object):
         # DistContext from paddle_tpu.parallel: when set, the jitted block is
         # compiled with mesh shardings (SPMD) instead of pinned to one device
         self.dist_context = dist_context
+        # FLAGS_check_nan_inf analog; forces the eager path when on
+        self.check_nan_inf = check_nan_inf
 
     def _device(self):
         """Resolve the jax device this Place pins; None = jax default."""
@@ -353,7 +355,7 @@ class Executor(object):
         from .. import profiler as _prof
         timing = _prof.profiler_enabled()
         t0 = time.perf_counter() if timing else 0.0
-        if _is_host_block(block) or not use_jit:
+        if _is_host_block(block) or not use_jit or self.check_nan_inf:
             # host ops (save/load) can't be jit-traced; the eager path works
             # on sharded buffers too (np.asarray gathers)
             outs = self._run_eager(program, dev_feed, fetch_names, scope)
@@ -375,7 +377,19 @@ class Executor(object):
             env[n] = scope.find_var(n)
         rng = RngSource(self._rng_key(program, scope))
         env["@SCOPE@"] = scope  # host ops (save/load) reach the scope directly
-        trace_ops(block, env, rng)
+        value_hook = None
+        if self.check_nan_inf:
+            # FLAGS_check_nan_inf analog (reference: executor.cc:30,135-143
+            # per-op output scan) — eager-path debug guard
+            def value_hook(name, value):
+                data = raw_data(value)
+                if hasattr(data, "dtype") and jnp.issubdtype(
+                        jnp.asarray(data).dtype, jnp.floating):
+                    if not bool(jnp.isfinite(data).all()):
+                        raise FloatingPointError(
+                            "NaN/Inf detected in %r" % name)
+                return value
+        trace_ops(block, env, rng, value_hook)
         self._writeback(program, scope, env, rng.key)
         return [env[n] for n in fetch_names]
 

@@ -15,6 +15,7 @@ from . import (  # noqa: F401
     control_flow_ops,
     attention_ops,
     detection_ops,
+    selected_rows,
 )
 
 from ..core.registry import registered_ops  # noqa: F401

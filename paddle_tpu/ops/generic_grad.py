@@ -65,6 +65,11 @@ def generic_grad(ctx):
         return tuple(raw_data(o) if o is not None else jnp.zeros(())
                      for o in flat)
 
+    if getattr(ctx.block.program, "_remat", False):
+        # memory_optimize'd program: recompute the op's forward during the
+        # backward instead of keeping residuals (jax.checkpoint), trading
+        # FLOPs for activation memory
+        fwd_fn = jax.checkpoint(fwd_fn)
     outs, vjp = jax.vjp(fwd_fn, *primals)
 
     # cotangents from the incoming Out@GRAD slots ('' names -> zero)

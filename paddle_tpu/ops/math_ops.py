@@ -97,7 +97,18 @@ for _name, _fn in [
 def sum_op(ctx):
     """Multi-input add; grad-accumulation workhorse
     (reference: operators/sum_op.cc, also merges SelectedRows)."""
+    from .selected_rows import SelectedRowsVal
     xs = ctx.inputs("X")
+    if any(isinstance(v, SelectedRowsVal) for v in xs):
+        if all(isinstance(v, SelectedRowsVal) for v in xs):
+            rows = jnp.concatenate([v.rows for v in xs])
+            vals = jnp.concatenate([v.values for v in xs])
+            ctx.set_output("Out", SelectedRowsVal(rows, vals,
+                                                  xs[0].height))
+            return
+        # mixed: densify the sparse parts
+        xs = [v.to_dense() if isinstance(v, SelectedRowsVal) else v
+              for v in xs]
     out = raw_data(xs[0])
     for v in xs[1:]:
         out = out + raw_data(v)

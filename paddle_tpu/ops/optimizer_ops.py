@@ -18,8 +18,14 @@ def _lr(ctx):
 @register_op("sgd", no_gradient=True, stateful_outputs=("ParamOut",))
 def sgd(ctx):
     p = raw_data(ctx.input("Param"))
-    g = raw_data(ctx.input("Grad"))
-    ctx.set_output("ParamOut", p - _lr(ctx) * g)
+    g = ctx.input("Grad")
+    from .selected_rows import SelectedRowsVal, sgd_selected_rows
+    if isinstance(g, SelectedRowsVal):
+        # sparse embedding grad: touch only the looked-up rows
+        # (reference: operators/sgd_op.h SelectedRows branch)
+        ctx.set_output("ParamOut", sgd_selected_rows(p, _lr(ctx), g))
+        return
+    ctx.set_output("ParamOut", p - _lr(ctx) * raw_data(g))
 
 
 @register_op("momentum", no_gradient=True,

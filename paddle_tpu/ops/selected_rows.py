@@ -1,0 +1,84 @@
+"""SelectedRows: sparse row-subset gradients for embeddings.
+
+reference: paddle/fluid/framework/selected_rows.h, the sparse grad path of
+operators/lookup_table_op.* (is_sparse=True emits SelectedRows W@GRAD),
+operators/sgd_op.cc (SelectedRows-aware update), operators/sum_op.cc
+(merges SelectedRows), math/selected_rows_functor.*.
+
+TPU-first shape discipline: rows/values keep the *token count* of the batch
+(fixed per feed signature — no dynamic compaction); duplicate rows are fine
+because the scatter-add (`.at[rows].add`) accumulates them, which is exactly
+the segment-sum XLA emits. This avoids materialising the dense
+[vocab, dim] gradient for large embedding tables.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import registry
+from ..core.executor import raw_data
+from ..core.ir import grad_var_name
+from ..core.registry import register_op
+
+
+class SelectedRowsVal(object):
+    """rows: int32 [n]; values: [n, dim]; height: vocab size."""
+
+    def __init__(self, rows, values, height):
+        self.rows = rows
+        self.values = values
+        self.height = height
+
+    def to_dense(self):
+        out = jnp.zeros((self.height,) + self.values.shape[1:],
+                        self.values.dtype)
+        return out.at[self.rows].add(self.values)
+
+
+jax.tree_util.register_pytree_node(
+    SelectedRowsVal,
+    lambda s: ((s.rows, s.values), s.height),
+    lambda h, ch: SelectedRowsVal(ch[0], ch[1], h))
+
+
+def _lookup_table_grad_maker(op, block, grad_of, no_grad):
+    if not op.attr("is_sparse", False):
+        from ..core.backward import default_grad_maker
+        return default_grad_maker(op, block, grad_of, no_grad)
+    out_name = op.output("Out")[0]
+    g = grad_of.get(out_name)
+    w_name = op.input("W")[0]
+    if g is None or w_name in no_grad:
+        return None
+    return [("lookup_table_sparse_grad",
+             {"Ids": list(op.input("Ids")), "W": [w_name],
+              "Out@GRAD": [g]},
+             {"W@GRAD": [grad_var_name(w_name)]},
+             {"padding_idx": op.attr("padding_idx", -1)})]
+
+
+registry.lookup_checked("lookup_table").grad_maker = _lookup_table_grad_maker
+
+
+@register_op("lookup_table_sparse_grad", no_gradient=True)
+def lookup_table_sparse_grad(ctx):
+    """W@GRAD as SelectedRows(ids, out_grad) — never densifies the table
+    gradient. reference: lookup_table_op.h LookupTableGradKernel's
+    SelectedRows branch."""
+    w = raw_data(ctx.input("W"))
+    ids = raw_data(ctx.input("Ids")).astype(jnp.int32).reshape(-1)
+    g = raw_data(ctx.input("Out@GRAD"))
+    dim = w.shape[1]
+    vals = g.reshape(-1, dim)
+    padding_idx = ctx.attr("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[:, None]
+        vals = vals * mask.astype(vals.dtype)
+    ctx.set_output("W@GRAD", SelectedRowsVal(ids, vals, w.shape[0]))
+
+
+def sgd_selected_rows(param, lr, grad: SelectedRowsVal):
+    """w[rows] -= lr * values (duplicates accumulate).
+    reference: operators/sgd_op.h SelectedRows branch."""
+    return param.at[grad.rows].add(-lr * grad.values)

@@ -1,0 +1,131 @@
+"""Event-driven trainer: the v2 ``SGD.train`` loop + events, fluid-style.
+
+reference: python/paddle/v2/trainer.py:63,137-215 (SGD class: per-batch
+feeder -> forwardBackward -> update, events Begin/EndIteration,
+Begin/EndPass fired into a user handler) and the per-pass checkpointing of
+paddle/trainer/ParamUtil.cpp.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from . import io as _io
+from .core import ir
+from .core.executor import Executor
+from .core.scope import global_scope
+from .data_feeder import DataFeeder
+
+
+class BeginPass(object):
+    def __init__(self, pass_id):
+        self.pass_id = pass_id
+
+
+class EndPass(object):
+    def __init__(self, pass_id, metrics=None):
+        self.pass_id = pass_id
+        self.metrics = metrics or {}
+
+
+class BeginIteration(object):
+    def __init__(self, pass_id, batch_id):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+
+
+class EndIteration(object):
+    def __init__(self, pass_id, batch_id, cost, metrics=None):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.cost = cost
+        self.metrics = metrics or {}
+
+
+class Trainer(object):
+    """Drive a built program over a reader with events.
+
+    Usage:
+        trainer = Trainer(cost=avg_cost, optimizer=fluid.SGD(0.01),
+                          feed_list=[x, y], place=fluid.TPUPlace())
+        trainer.train(reader, num_passes=2, event_handler=handler)
+    """
+
+    def __init__(self, cost, optimizer, feed_list, place=None,
+                 fetch_list=None, main_program=None, startup_program=None,
+                 checkpoint_dir=None, dist_context=None):
+        self.cost = cost
+        self.main_program = main_program or ir.default_main_program()
+        self.startup_program = startup_program or \
+            ir.default_startup_program()
+        self.optimizer = optimizer
+        with ir.program_guard(self.main_program, self.startup_program):
+            optimizer.minimize(cost)
+        self.exe = Executor(place, dist_context=dist_context)
+        self.feeder = DataFeeder(feed_list, place=place,
+                                 program=self.main_program)
+        self.fetch_list = [cost] + list(fetch_list or [])
+        self.checkpoint_dir = checkpoint_dir
+        self._initialized = False
+
+    def _maybe_init(self):
+        if self._initialized:
+            return
+        self.exe.run(self.startup_program)
+        if self.checkpoint_dir and os.path.isdir(self.checkpoint_dir) and \
+                os.listdir(self.checkpoint_dir):
+            # resume = load persistables (optimizer accumulators included;
+            # reference: io.py save_persistables semantics)
+            _io.load_persistables(self.exe, self.checkpoint_dir,
+                                  main_program=self.main_program)
+        self._initialized = True
+
+    def train(self, reader, num_passes=1, event_handler=None):
+        self._maybe_init()
+        handler = event_handler or (lambda e: None)
+        for pass_id in range(num_passes):
+            handler(BeginPass(pass_id))
+            costs = []
+            for batch_id, data in enumerate(reader()):
+                handler(BeginIteration(pass_id, batch_id))
+                outs = self.exe.run(self.main_program,
+                                    feed=self.feeder.feed(data),
+                                    fetch_list=self.fetch_list)
+                cost = float(np.asarray(outs[0]).reshape(-1)[0])
+                costs.append(cost)
+                handler(EndIteration(pass_id, batch_id, cost,
+                                     {"fetches": outs[1:]}))
+            if self.checkpoint_dir:
+                self.save_checkpoint()
+            handler(EndPass(pass_id,
+                            {"avg_cost": float(np.mean(costs))
+                             if costs else float("nan")}))
+
+    def test(self, reader, fetch_list=None, program=None):
+        """Average fetched metrics over a reader (reference:
+        v2/trainer.py test / fluid book tests' test loops)."""
+        self._maybe_init()
+        program = program or self.main_program
+        fetches = fetch_list or self.fetch_list
+        acc = None
+        n = 0
+        for data in reader():
+            outs = self.exe.run(program, feed=self.feeder.feed(data),
+                                fetch_list=fetches)
+            vals = [float(np.asarray(o).reshape(-1)[0]) for o in outs]
+            acc = vals if acc is None else [a + v for a, v in zip(acc,
+                                                                  vals)]
+            n += 1
+        return [a / max(n, 1) for a in (acc or [])]
+
+    def save_checkpoint(self, dirname=None):
+        dirname = dirname or self.checkpoint_dir
+        os.makedirs(dirname, exist_ok=True)
+        _io.save_persistables(self.exe, dirname,
+                              main_program=self.main_program)
+
+    def save_inference_model(self, dirname, feeded_var_names, target_vars):
+        _io.save_inference_model(dirname, feeded_var_names, target_vars,
+                                 self.exe, main_program=self.main_program)

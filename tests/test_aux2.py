@@ -1,0 +1,101 @@
+"""Aux subsystems round 2: event trainer, concurrency, memory_optimize,
+NaN check, sparse embedding grads."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def test_trainer_events_and_checkpoint(tmp_path):
+    events = []
+    x = fluid.layers.data("x", shape=[13], dtype="float32")
+    y = fluid.layers.data("y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(x, size=1)
+    cost = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    ckpt = str(tmp_path / "ckpt")
+    trainer = fluid.Trainer(cost=cost,
+                            optimizer=fluid.optimizer.SGD(0.01),
+                            feed_list=[x, y], place=fluid.CPUPlace(),
+                            checkpoint_dir=ckpt)
+    reader = fluid.reader.batch(fluid.dataset.uci_housing.train(),
+                                batch_size=32)
+    trainer.train(reader, num_passes=2,
+                  event_handler=lambda e: events.append(type(e).__name__))
+    assert events[0] == "BeginPass" and events[-1] == "EndPass"
+    assert "BeginIteration" in events and "EndIteration" in events
+    assert events.count("EndPass") == 2
+    # checkpoint was written; a fresh trainer resumes from it
+    import os
+    assert os.listdir(ckpt)
+
+
+def test_channel_send_recv_close():
+    ch = fluid.Channel(capacity=4)
+    results = []
+
+    def consumer():
+        for v in ch:
+            results.append(v)
+
+    g = fluid.Go(consumer)
+    for i in range(10):
+        ch.send(i)
+    ch.close()
+    g.join(timeout=5)
+    assert results == list(range(10))
+    with pytest.raises(fluid.concurrency.ChannelClosed):
+        ch.send(11)
+
+
+def test_memory_optimize_liveness_and_trains():
+    x = fluid.layers.data("x", shape=[8], dtype="float32")
+    h1 = fluid.layers.fc(x, size=8, act="relu")
+    h2 = fluid.layers.fc(h1, size=8, act="relu")
+    h3 = fluid.layers.fc(h2, size=8, act="relu")
+    loss = fluid.layers.mean(h3)
+    fluid.optimizer.SGD(0.01).minimize(loss)
+    pairs = fluid.memory_optimize(fluid.default_main_program())
+    assert fluid.default_main_program()._remat
+    assert isinstance(pairs, list)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = {"x": np.random.rand(4, 8).astype(np.float32)}
+    l0 = float(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0]))
+    l1 = float(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0]))
+    assert np.isfinite(l0) and l1 < l0
+
+
+def test_check_nan_inf_catches():
+    x = fluid.layers.data("x", shape=[2], dtype="float32")
+    out = fluid.layers.log(x)   # log of negative -> nan
+    exe = fluid.Executor(fluid.CPUPlace(), check_nan_inf=True)
+    with pytest.raises(FloatingPointError):
+        exe.run(feed={"x": np.array([[-1.0, 2.0]], np.float32)},
+                fetch_list=[out])
+    # clean input passes
+    r, = exe.run(feed={"x": np.array([[1.0, 2.0]], np.float32)},
+                 fetch_list=[out])
+    assert np.isfinite(np.asarray(r)).all()
+
+
+def test_sparse_embedding_grad_selected_rows():
+    """is_sparse=True embeddings update only touched rows via SelectedRows
+    (reference: lookup_table_op SelectedRows grad + sgd_op sparse branch)."""
+    ids = fluid.layers.data("ids", shape=[1], dtype="int64")
+    emb = fluid.layers.embedding(
+        ids, size=[50, 4], is_sparse=True,
+        param_attr=fluid.ParamAttr(name="sp_emb",
+                                   initializer=fluid.Constant(1.0)))
+    loss = fluid.layers.mean(emb)
+    fluid.optimizer.SGD(learning_rate=1.0).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    exe.run(feed={"ids": np.array([[3], [7], [3]], np.int64)},
+            fetch_list=[loss])
+    w = np.asarray(fluid.fetch_var("sp_emb"))
+    touched = {3, 7}
+    for r in range(50):
+        if r in touched:
+            assert (w[r] != 1.0).all(), r
+        else:
+            np.testing.assert_array_equal(w[r], np.ones(4, np.float32))
