@@ -1,0 +1,71 @@
+"""Automatic mixed precision: bf16 compute on the MXU, f32 accumulation.
+
+Role of the reference's float16 support (reference:
+paddle/fluid/platform/float16.h:71 and the cudnn fp16 kernel registrations)
+— on TPU the native reduced precision is bfloat16 (same exponent range as
+f32, so no loss scaling needed, unlike fp16). Enabling AMP on a program
+makes the matmul/conv lowerings cast operands to bf16 and accumulate in f32
+(preferred_element_type), roughly doubling MXU throughput; parameters and
+optimizer state stay f32.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from .core import ir
+
+__all__ = ["enable", "disable", "amp_guard", "cast_inputs"]
+
+
+def enable(program=None):
+    program = program or ir.default_main_program()
+    program._amp = True
+    return program
+
+
+def disable(program=None):
+    program = program or ir.default_main_program()
+    program._amp = False
+    return program
+
+
+@contextlib.contextmanager
+def amp_guard(program=None):
+    program = program or ir.default_main_program()
+    old = getattr(program, "_amp", False)
+    program._amp = True
+    try:
+        yield
+    finally:
+        program._amp = old
+
+
+def _on_tpu():
+    import jax
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+_ON_TPU = None
+
+
+def cast_inputs(ctx, *arrays):
+    """bf16-cast float operands when the op's program runs under AMP.
+    No-op off TPU: AMP targets the MXU; CPU XLA lacks the mixed
+    bf16->f32 dot emitter."""
+    global _ON_TPU
+    if not getattr(ctx.block.program, "_amp", False):
+        return arrays
+    if _ON_TPU is None:
+        _ON_TPU = _on_tpu()
+    if not _ON_TPU:
+        return arrays
+    return tuple(
+        a.astype(jnp.bfloat16)
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+        and a.dtype != jnp.bfloat16 else a
+        for a in arrays)

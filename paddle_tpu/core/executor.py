@@ -336,9 +336,24 @@ class Executor(object):
         return self._device_cache
 
     # -- public API ----------------------------------------------------------
+    def prepare_feed(self, feed):
+        """Transfer a feed dict to the device once; the returned dict can be
+        passed to run() repeatedly without re-transferring (device_put of an
+        already-committed array is a no-op). The reference's analog is the
+        data-provider double buffer keeping batches device-resident."""
+        dev = None if self.dist_context is not None else self._device()
+        return {k: _to_device_value(v, dev) for k, v in feed.items()}
+
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_jit=True, feed_var_name="feed",
-            fetch_var_name="fetch", dist_context=None):
+            fetch_var_name="fetch", dist_context=None, repeat=1):
+        """``repeat=K`` compiles K whole training steps into one
+        ``lax.scan`` dispatch (fetches come from the last step). This is the
+        standard TPU step-fusion pattern: one host round-trip amortises K
+        steps of dispatch and argument shipping — the modern analog of the
+        reference's num_batches_per_send_parameter local accumulation
+        (reference: utils/Flags.cpp:44-65). Requires the jit path and a
+        constant feed across the K steps."""
         program = program if program is not None else ir.default_main_program()
         scope = scope if scope is not None else global_scope()
         feed = feed or {}
@@ -358,10 +373,12 @@ class Executor(object):
         if _is_host_block(block) or not use_jit or self.check_nan_inf:
             # host ops (save/load) can't be jit-traced; the eager path works
             # on sharded buffers too (np.asarray gathers)
+            if repeat != 1:
+                raise ValueError("repeat>1 requires the jit path")
             outs = self._run_eager(program, dev_feed, fetch_names, scope)
         else:
             outs = self._run_jit(program, dev_feed, fetch_names, scope,
-                                 dist=dist)
+                                 dist=dist, repeat=repeat)
         if timing:
             jax.block_until_ready([raw_data(o) for o in outs])
             _prof.record_run("program_%d_run" % program._uid,
@@ -394,7 +411,8 @@ class Executor(object):
         return [env[n] for n in fetch_names]
 
     # -- jit path --------------------------------------------------------------
-    def _run_jit(self, program, feed, fetch_names, scope, dist=None):
+    def _run_jit(self, program, feed, fetch_names, scope, dist=None,
+                 repeat=1):
         state_names = self._state_inputs(program, scope, feed)
         state = {n: scope.find_var(n) for n in state_names}
         if dist is not None:
@@ -403,7 +421,7 @@ class Executor(object):
             state = {n: jax.device_put(v, dist.sharding_for(n, v))
                      for n, v in state.items()}
         key = (program._uid, program._version, _feed_signature(feed),
-               tuple(fetch_names),
+               tuple(fetch_names), repeat,
                dist.cache_token() if dist is not None else None,
                tuple(sorted(
                    (n, tuple(getattr(v, "shape", ())),
@@ -414,7 +432,8 @@ class Executor(object):
             shardings = (_dist_shardings(dist, state, feed)
                          if dist is not None else None)
             fn = self._compile(program, feed, fetch_names, state_names,
-                               shardings=shardings, dist=dist)
+                               shardings=shardings, dist=dist,
+                               repeat=repeat)
             self._cache[key] = fn
         rng_key = self._rng_key(program, scope)
         fetches, new_state, new_key = fn(state, feed, rng_key)
@@ -424,7 +443,7 @@ class Executor(object):
         return fetches
 
     def _compile(self, program, feed_template, fetch_names, state_names,
-                 shardings=None, dist=None):
+                 shardings=None, dist=None, repeat=1):
         block = program.global_block()
         persist = self._persistable_names(program)
         written = {n for op_ in _iter_ops(block) for n in op_.output_arg_names}
@@ -443,7 +462,7 @@ class Executor(object):
                         value, dist.sharding_for(name, value))
                 return value
 
-        def fn(state, feed, rng_key):
+        def one_step(state, feed, rng_key):
             env = dict(feed)
             env.update(state)
             rng = RngSource(rng_key)
@@ -456,6 +475,24 @@ class Executor(object):
                     new_state[n] = env[n]
             fetches = [env[n] for n in fetch_names]
             return fetches, new_state, rng.key
+
+        if repeat == 1:
+            fn = one_step
+        else:
+            def fn(state, feed, rng_key):
+                # first step outside the scan: it may add extra_out keys,
+                # after which the carry structure is stable
+                fetches, state, rng_key = one_step(state, feed, rng_key)
+
+                def body(carry, _):
+                    st, key = carry
+                    f, st2, key2 = one_step(st, feed, key)
+                    return (st2, key2), f
+
+                (state, rng_key), fs = jax.lax.scan(
+                    body, (state, rng_key), None, length=repeat - 1)
+                fetches = [f[-1] for f in fs]  # last step's fetches
+                return fetches, state, rng_key
 
         if shardings is not None:
             return jax.jit(fn, donate_argnums=(0,), in_shardings=shardings)

@@ -39,17 +39,21 @@ def _infer_mul(op, block):
 @register_op("mul", infer_shape=_infer_mul)
 def mul(ctx):
     """reference: operators/mul_op.cc — flatten then gemm. Preserves the
-    input's LoD (fc over ragged sequences keeps sequence structure)."""
+    input's LoD (fc over ragged sequences keeps sequence structure).
+    Under AMP the gemm runs bf16 with f32 accumulation."""
+    from .. import amp
     x_v = ctx.input("X")
     x = raw_data(x_v)
     y = raw_data(ctx.input("Y"))
+    out_dtype = x.dtype
+    x, y = amp.cast_inputs(ctx, x, y)
     xn = ctx.attr("x_num_col_dims", 1)
     yn = ctx.attr("y_num_col_dims", 1)
     x2 = flatten_to_2d(x, xn)
     y2 = flatten_to_2d(y, yn)
     out = jnp.matmul(x2, y2, preferred_element_type=_acc_type(x))
-    if out.dtype != x.dtype:
-        out = out.astype(x.dtype)
+    if out.dtype != out_dtype:
+        out = out.astype(out_dtype)
     out = out.reshape(tuple(x.shape[:xn]) + tuple(y.shape[yn:]))
     ctx.set_output("Out", with_lod_of(x_v, out))
 
@@ -57,15 +61,18 @@ def mul(ctx):
 @register_op("matmul")
 def matmul(ctx):
     """reference: operators/matmul_op.cc (transpose_X/Y attrs, batched)."""
+    from .. import amp
     x = raw_data(ctx.input("X"))
     y = raw_data(ctx.input("Y"))
+    out_dtype = x.dtype
+    x, y = amp.cast_inputs(ctx, x, y)
     if ctx.attr("transpose_X", False):
         x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
     if ctx.attr("transpose_Y", False):
         y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
     out = jnp.matmul(x, y, preferred_element_type=_acc_type(x))
-    if out.dtype != x.dtype:
-        out = out.astype(x.dtype)
+    if out.dtype != out_dtype:
+        out = out.astype(out_dtype)
     alpha = ctx.attr("alpha", 1.0)
     if alpha != 1.0:
         out = out * alpha

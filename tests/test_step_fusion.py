@@ -1,0 +1,43 @@
+"""repeat=K step fusion: one dispatch must equal K sequential steps."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _build():
+    x = fluid.layers.data("x", shape=[4], dtype="float32")
+    y = fluid.layers.data("y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(x, size=1,
+                           param_attr=fluid.ParamAttr(name="w_fused"),
+                           bias_attr=fluid.ParamAttr(name="b_fused"))
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def test_repeat_matches_sequential():
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(8, 4).astype(np.float32),
+            "y": rng.rand(8, 1).astype(np.float32)}
+
+    prog, sprog = fluid.Program(), fluid.Program()
+    prog.random_seed = sprog.random_seed = 3
+    with fluid.program_guard(prog, sprog):
+        loss = _build()
+        # sequential: 5 single-step dispatches
+        scope_a = fluid.Scope()
+        with fluid.scope_guard(scope_a):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(sprog)
+            for _ in range(5):
+                la, = exe.run(prog, feed=feed, fetch_list=[loss])
+            w_a = np.asarray(scope_a.find_var("w_fused"))
+        # fused: one dispatch of 5 steps
+        scope_b = fluid.Scope()
+        with fluid.scope_guard(scope_b):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(sprog)
+            lb, = exe.run(prog, feed=feed, fetch_list=[loss], repeat=5)
+            w_b = np.asarray(scope_b.find_var("w_fused"))
+    np.testing.assert_allclose(w_a, w_b, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb), rtol=1e-5)
