@@ -44,6 +44,7 @@ from .memory_optimization_transpiler import (  # noqa: F401
     memory_optimize, release_memory,
 )
 from . import amp  # noqa: F401
+from . import inference  # noqa: F401
 from .io import (  # noqa: F401
     save_vars, save_params, save_persistables, load_vars, load_params,
     load_persistables, save_inference_model, load_inference_model,

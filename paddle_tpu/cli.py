@@ -1,0 +1,127 @@
+"""Command line: ``python -m paddle_tpu {train,bench,info,convert}``.
+
+reference: the ``paddle`` binary (paddle/trainer/TrainerMain.cpp:32 —
+``paddle train``, ``paddle pserver``, ``paddle merge_model``; launch wrapper
+paddle/scripts/submit_local.sh.in:173). TPU redesign: there is no pserver
+role — distribution is SPMD sharding — so the surviving verbs are train
+(drive a user config), bench (the benchmark harnesses), convert (dataset ->
+recordio shards), info (device/platform report).
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+
+def _load_config(path):
+    spec = importlib.util.spec_from_file_location("train_config", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def cmd_train(args):
+    """Config contract: the file defines ``model()`` returning a dict with
+    keys cost, feed_list, reader (and optionally optimizer, num_passes)."""
+    import paddle_tpu as pt
+
+    cfg = _load_config(args.config)
+    spec = cfg.model()
+    optimizer = spec.get("optimizer") or pt.optimizer.SGD(
+        learning_rate=args.learning_rate)
+    trainer = pt.Trainer(cost=spec["cost"], optimizer=optimizer,
+                         feed_list=spec["feed_list"],
+                         checkpoint_dir=args.checkpoint_dir or None)
+
+    def handler(e):
+        if isinstance(e, pt.trainer_mod.EndIteration):
+            if e.batch_id % args.log_period == 0:
+                print("pass %d batch %d cost %.5f"
+                      % (e.pass_id, e.batch_id, e.cost))
+        elif isinstance(e, pt.trainer_mod.EndPass):
+            print("pass %d done: %s" % (e.pass_id, e.metrics))
+
+    trainer.train(spec["reader"],
+                  num_passes=args.num_passes or spec.get("num_passes", 1),
+                  event_handler=handler)
+    return 0
+
+
+def cmd_bench(args):
+    sys.argv = [sys.argv[0]] + (args.extra or [])
+    if args.suite == "resnet":
+        import bench
+        bench.main()
+    elif args.suite == "image":
+        from benchmark import image_bench
+        print(json.dumps(image_bench.bench(model=args.model or "resnet50",
+                                           batch_size=args.batch_size)))
+    elif args.suite == "rnn":
+        from benchmark import rnn_bench
+        print(json.dumps(rnn_bench.bench(batch_size=args.batch_size)))
+    return 0
+
+
+def cmd_info(args):
+    import jax
+
+    import paddle_tpu as pt
+    devs = jax.devices()
+    print(json.dumps({
+        "version": pt.__version__,
+        "platform": devs[0].platform,
+        "device_count": len(devs),
+        "devices": [str(d) for d in devs],
+        "registered_ops": len(pt.ops.registered_ops()),
+        "native_runtime": pt.native.available(),
+    }, indent=2))
+    return 0
+
+
+def cmd_convert(args):
+    import paddle_tpu as pt
+
+    mod = pt.dataset
+    for part in args.dataset.split("."):
+        mod = getattr(mod, part)
+    reader = getattr(mod, args.split)()
+    paths = pt.dataset.common.convert(args.output, reader,
+                                      args.records_per_shard, args.dataset)
+    print(json.dumps({"shards": paths}))
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="paddle_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    t = sub.add_parser("train", help="train a model config")
+    t.add_argument("config")
+    t.add_argument("--num_passes", type=int, default=0)
+    t.add_argument("--learning_rate", type=float, default=0.01)
+    t.add_argument("--checkpoint_dir", default="")
+    t.add_argument("--log_period", type=int, default=10)
+    t.set_defaults(fn=cmd_train)
+
+    b = sub.add_parser("bench", help="run a benchmark suite")
+    b.add_argument("suite", choices=["resnet", "image", "rnn"])
+    b.add_argument("--model", default=None)
+    b.add_argument("--batch_size", type=int, default=64)
+    b.add_argument("extra", nargs="*")
+    b.set_defaults(fn=cmd_bench)
+
+    i = sub.add_parser("info", help="device / build report")
+    i.set_defaults(fn=cmd_info)
+
+    c = sub.add_parser("convert", help="dataset -> recordio shards")
+    c.add_argument("dataset")
+    c.add_argument("--split", default="train")
+    c.add_argument("--output", default="./recordio")
+    c.add_argument("--records_per_shard", type=int, default=4096)
+    c.set_defaults(fn=cmd_convert)
+
+    args = p.parse_args(argv)
+    return args.fn(args)

@@ -353,6 +353,60 @@ class FtrlOptimizer(Optimizer):
                    "lr_power": self._lr_power})
 
 
+class ModelAverage(object):
+    """Exponential/window parameter averaging for evaluation.
+
+    reference: paddle/parameter/AverageOptimizer.cpp (legacy
+    AverageOptimizer / do_average_in_cpu) — keeps a running average of each
+    trainable parameter; ``apply()`` swaps averages in for eval,
+    ``restore()`` swaps the training values back. Host-side state: the
+    averaging update is a cheap axpy the executor runs on fetched
+    parameters after each step (call ``update()`` per step or wire it into
+    a Trainer event handler)."""
+
+    def __init__(self, average_window_rate=0.15, min_average_window=100,
+                 max_average_window=10000, program=None, scope=None):
+        import numpy as np
+        from .core import ir
+        from .core.scope import global_scope
+        self._np = np
+        self.program = program or ir.default_main_program()
+        self.scope = scope or global_scope()
+        self.rate = average_window_rate
+        self._avg = {}
+        self._backup = None
+        self._count = 0
+
+    def _params(self):
+        return [p.name for p in self.program.all_parameters()
+                if getattr(p, "trainable", True)]
+
+    def update(self):
+        np = self._np
+        self._count += 1
+        for n in self._params():
+            v = np.asarray(self.scope.find_var(n))
+            if n not in self._avg:
+                self._avg[n] = v.astype(np.float64).copy()
+            else:
+                self._avg[n] += (v - self._avg[n]) / self._count
+
+    def apply(self, executor=None, need_restore=True):
+        np = self._np
+        if need_restore:
+            self._backup = {n: np.asarray(self.scope.find_var(n)).copy()
+                            for n in self._params()}
+        for n, a in self._avg.items():
+            cur = np.asarray(self.scope.find_var(n))
+            self.scope.set_var(n, a.astype(cur.dtype))
+
+    def restore(self, executor=None):
+        if self._backup:
+            for n, v in self._backup.items():
+                self.scope.set_var(n, v)
+            self._backup = None
+
+
 # reference-style short aliases
 SGD = SGDOptimizer
 Momentum = MomentumOptimizer

@@ -1,0 +1,124 @@
+"""OpTest: the reference's per-op contract harness, numpy-vs-lowering.
+
+reference: python/paddle/fluid/tests/unittests/op_test.py — declare
+``op_type``, inputs and expected outputs; ``check_output`` builds the single
+op and compares; ``check_grad`` compares analytic gradients against central
+finite differences (delta / max_relative_error knobs). Here the analytic
+gradient comes from the generic-vjp grad op — exactly what training uses.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core import ir
+from paddle_tpu.core.lod import LoDTensor
+
+
+class OpTest(object):
+    op_type = None
+
+    def setup(self):
+        """Subclasses set self.inputs, self.outputs, self.attrs."""
+        raise NotImplementedError
+
+    # -- plumbing ------------------------------------------------------------
+    def _build(self):
+        self.attrs = {}
+        self.setup()
+        prog, sprog = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, sprog):
+            in_slots = {}
+            self._in_vars = {}
+            for slot, val in self.inputs.items():
+                vals = val if isinstance(val, list) else [(slot, val)]
+                names = []
+                for name, v in vals:
+                    arr = v.numpy() if isinstance(v, LoDTensor) else v
+                    var = prog.global_block().create_var(
+                        name=name, shape=arr.shape, dtype=str(arr.dtype),
+                        lod_level=len(v.lod()) if isinstance(v, LoDTensor)
+                        else 0)
+                    names.append(name)
+                    self._in_vars[name] = v
+                in_slots[slot] = names
+            out_slots = {}
+            self._out_names = {}
+            for slot, val in self.outputs.items():
+                vals = val if isinstance(val, list) else [(slot, val)]
+                names = []
+                for name, v in vals:
+                    prog.global_block().create_var(name=name)
+                    names.append(name)
+                    self._out_names.setdefault(slot, []).append((name, v))
+                out_slots[slot] = names
+            prog.global_block().append_op(type=self.op_type,
+                                          inputs=in_slots,
+                                          outputs=out_slots,
+                                          attrs=self.attrs)
+        return prog
+
+    def _feed(self):
+        return dict(self._in_vars)
+
+    def check_output(self, atol=1e-5, rtol=1e-5):
+        prog = self._build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            for slot, pairs in self._out_names.items():
+                fetch = [n for n, _ in pairs]
+                outs = exe.run(prog, feed=self._feed(), fetch_list=fetch)
+                for (name, want), got in zip(pairs, outs):
+                    got = got.numpy() if isinstance(got, LoDTensor) \
+                        else np.asarray(got)
+                    np.testing.assert_allclose(
+                        got, np.asarray(want), atol=atol, rtol=rtol,
+                        err_msg="output %s of %s" % (name, self.op_type))
+
+    def check_grad(self, inputs_to_check, output_name, delta=5e-3,
+                   max_relative_error=5e-3):
+        """Analytic (generic-vjp) vs central finite differences of a scalar
+        reduction of ``output_name``."""
+        prog = self._build()
+        with fluid.program_guard(prog):
+            out_var = prog.global_block().var(output_name)
+            loss = fluid.layers.mean(out_var)
+            grads = fluid.calc_gradient(
+                loss, [prog.global_block().var(n)
+                       for n in inputs_to_check])
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            analytic = exe.run(prog, feed=self._feed(),
+                               fetch_list=[g.name for g in grads])
+        for name, g in zip(inputs_to_check, analytic):
+            base = self._in_vars[name]
+            arr = (base.numpy() if isinstance(base, LoDTensor)
+                   else np.asarray(base)).astype(np.float64)
+            numeric = np.zeros_like(arr)
+            flat = arr.reshape(-1)
+            num_flat = numeric.reshape(-1)
+            for i in range(flat.size):
+                for sign in (+1, -1):
+                    pert = flat.copy()
+                    pert[i] += sign * delta
+                    pv = pert.reshape(arr.shape).astype(np.float32)
+                    feed = self._feed()
+                    feed[name] = (LoDTensor(pv, base.lod())
+                                  if isinstance(base, LoDTensor) else pv)
+                    with fluid.scope_guard(fluid.Scope()):
+                        val, = exe.run(prog, feed=feed,
+                                       fetch_list=[loss.name])
+                    if sign > 0:
+                        num_flat[i] = float(np.asarray(val).reshape(-1)[0])
+                    else:
+                        num_flat[i] -= float(np.asarray(val).reshape(-1)[0])
+                num_flat[i] /= 2 * delta
+            ga = np.asarray(g, np.float64)
+            denom = np.maximum(np.abs(numeric), np.abs(ga))
+            denom[denom < 1e-3] = 1.0
+            rel = np.abs(ga - numeric) / denom
+            assert rel.max() <= max_relative_error, (
+                "grad of %s wrt %s: max rel err %.4g > %.4g"
+                % (self.op_type, name, rel.max(), max_relative_error))

@@ -78,6 +78,30 @@ def test_check_nan_inf_catches():
     assert np.isfinite(np.asarray(r)).all()
 
 
+def test_model_average():
+    x = fluid.layers.data("x", shape=[4], dtype="float32")
+    pred = fluid.layers.fc(x, size=1,
+                           param_attr=fluid.ParamAttr(name="ma_w"))
+    loss = fluid.layers.mean(pred)
+    fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    ma = fluid.optimizer.ModelAverage()
+    feed = {"x": np.ones((2, 4), np.float32)}
+    ws = []
+    for _ in range(3):
+        exe.run(feed=feed, fetch_list=[loss])
+        ma.update()
+        ws.append(np.asarray(fluid.global_scope().find_var("ma_w")).copy())
+    ma.apply()
+    avg_w = np.asarray(fluid.global_scope().find_var("ma_w"))
+    np.testing.assert_allclose(avg_w, np.mean(ws, axis=0), rtol=1e-5)
+    ma.restore()
+    np.testing.assert_allclose(
+        np.asarray(fluid.global_scope().find_var("ma_w")), ws[-1],
+        rtol=1e-6)
+
+
 def test_sparse_embedding_grad_selected_rows():
     """is_sparse=True embeddings update only touched rows via SelectedRows
     (reference: lookup_table_op SelectedRows grad + sgd_op sparse branch)."""

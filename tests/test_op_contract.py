@@ -1,0 +1,81 @@
+"""Op contract tests via the OpTest harness (reference:
+fluid/tests/unittests/test_{softmax,conv2d,mul,lstm...}_op.py style)."""
+import numpy as np
+
+from op_test import OpTest
+
+
+class TestSoftmaxOp(OpTest):
+    op_type = "softmax"
+
+    def setup(self):
+        x = np.random.RandomState(0).rand(4, 7).astype(np.float32)
+        e = np.exp(x - x.max(axis=-1, keepdims=True))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": e / e.sum(axis=-1, keepdims=True)}
+
+
+def test_softmax_output_and_grad():
+    t = TestSoftmaxOp()
+    t.check_output()
+    t = TestSoftmaxOp()
+    t.check_grad(["X"], "Out")
+
+
+class TestMulOp(OpTest):
+    op_type = "mul"
+
+    def setup(self):
+        rng = np.random.RandomState(1)
+        x = rng.rand(3, 4).astype(np.float32)
+        y = rng.rand(4, 5).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x @ y}
+
+
+def test_mul_output_and_grad():
+    t = TestMulOp()
+    t.check_output()
+    t = TestMulOp()
+    t.check_grad(["X", "Y"], "Out")
+
+
+class TestConv2dOp(OpTest):
+    op_type = "conv2d"
+
+    def setup(self):
+        rng = np.random.RandomState(2)
+        x = rng.rand(1, 2, 5, 5).astype(np.float32)
+        w = rng.rand(3, 2, 3, 3).astype(np.float32)
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 1}
+        out = np.zeros((1, 3, 5, 5), np.float32)
+        xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        for o in range(3):
+            for i in range(5):
+                for j in range(5):
+                    out[0, o, i, j] = np.sum(
+                        xp[0, :, i:i + 3, j:j + 3] * w[o])
+        self.inputs = {"Input": [("Input", x)], "Filter": [("Filter", w)]}
+        self.outputs = {"Output": [("Output", out)]}
+
+
+def test_conv2d_output_and_grad():
+    t = TestConv2dOp()
+    t.check_output(atol=1e-4, rtol=1e-4)
+    t = TestConv2dOp()
+    t.check_grad(["Filter"], "Output", max_relative_error=1e-2)
+
+
+class TestLayerNormOp(OpTest):
+    op_type = "log_softmax"
+
+    def setup(self):
+        x = np.random.RandomState(3).rand(3, 6).astype(np.float32)
+        e = x - x.max(-1, keepdims=True)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": e - np.log(np.exp(e).sum(-1, keepdims=True))}
+
+
+def test_log_softmax_output():
+    TestLayerNormOp().check_output(atol=1e-5)
