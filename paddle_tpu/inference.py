@@ -17,7 +17,6 @@ import pickle
 
 import numpy as np
 
-from . import io as _io
 from .core import ir
 from .core.executor import RngSource, trace_ops
 from .core.scope import global_scope

@@ -373,6 +373,8 @@ class ModelAverage(object):
         self.program = program or ir.default_main_program()
         self.scope = scope or global_scope()
         self.rate = average_window_rate
+        self.min_window = min_average_window
+        self.max_window = max_average_window
         self._avg = {}
         self._backup = None
         self._count = 0
@@ -384,16 +386,23 @@ class ModelAverage(object):
     def update(self):
         np = self._np
         self._count += 1
+        # reference AverageOptimizer window: recent min(count, W) updates,
+        # W = clip(rate * numUpdates, min_window, max_window)
+        window = min(max(self.rate * self._count, self.min_window),
+                     self.max_window)
+        n_eff = min(self._count, window)
         for n in self._params():
             v = np.asarray(self.scope.find_var(n))
             if n not in self._avg:
                 self._avg[n] = v.astype(np.float64).copy()
             else:
-                self._avg[n] += (v - self._avg[n]) / self._count
+                self._avg[n] += (v - self._avg[n]) / n_eff
 
     def apply(self, executor=None, need_restore=True):
         np = self._np
-        if need_restore:
+        if need_restore and self._backup is None:
+            # never overwrite an existing backup: a second apply() would
+            # snapshot the averaged weights and lose the training state
             self._backup = {n: np.asarray(self.scope.find_var(n)).copy()
                             for n in self._params()}
         for n, a in self._avg.items():

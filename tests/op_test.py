@@ -66,15 +66,15 @@ class OpTest(object):
         exe = fluid.Executor(fluid.CPUPlace())
         scope = fluid.Scope()
         with fluid.scope_guard(scope):
-            for slot, pairs in self._out_names.items():
-                fetch = [n for n, _ in pairs]
-                outs = exe.run(prog, feed=self._feed(), fetch_list=fetch)
-                for (name, want), got in zip(pairs, outs):
-                    got = got.numpy() if isinstance(got, LoDTensor) \
-                        else np.asarray(got)
-                    np.testing.assert_allclose(
-                        got, np.asarray(want), atol=atol, rtol=rtol,
-                        err_msg="output %s of %s" % (name, self.op_type))
+            pairs = [p for ps in self._out_names.values() for p in ps]
+            outs = exe.run(prog, feed=self._feed(),
+                           fetch_list=[n for n, _ in pairs])
+            for (name, want), got in zip(pairs, outs):
+                got = got.numpy() if isinstance(got, LoDTensor) \
+                    else np.asarray(got)
+                np.testing.assert_allclose(
+                    got, np.asarray(want), atol=atol, rtol=rtol,
+                    err_msg="output %s of %s" % (name, self.op_type))
 
     def check_grad(self, inputs_to_check, output_name, delta=5e-3,
                    max_relative_error=5e-3):

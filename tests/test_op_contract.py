@@ -67,7 +67,7 @@ def test_conv2d_output_and_grad():
     t.check_grad(["Filter"], "Output", max_relative_error=1e-2)
 
 
-class TestLayerNormOp(OpTest):
+class TestLogSoftmaxOp(OpTest):
     op_type = "log_softmax"
 
     def setup(self):
@@ -78,4 +78,4 @@ class TestLayerNormOp(OpTest):
 
 
 def test_log_softmax_output():
-    TestLayerNormOp().check_output(atol=1e-5)
+    TestLogSoftmaxOp().check_output(atol=1e-5)
