@@ -43,9 +43,12 @@ def amp_guard(program=None):
 
 
 def _on_tpu():
+    """True for any accelerator backend (TPU reports platform 'tpu';
+    tunnelled PJRT plugins may report their own name, e.g. 'axon' — treat
+    everything that isn't the cpu host backend as MXU-capable)."""
     import jax
     try:
-        return jax.devices()[0].platform == "tpu"
+        return jax.devices()[0].platform not in ("cpu",)
     except Exception:
         return False
 

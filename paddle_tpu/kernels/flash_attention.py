@@ -98,10 +98,10 @@ def _fa_forward(q3, k3, v3, causal, scale, interpret):
 
 
 def _on_tpu():
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:
-        return False
+    # shared accelerator check (tunnelled PJRT plugins report their own
+    # platform name; anything non-cpu runs the compiled Pallas path)
+    from ..amp import _on_tpu as _amp_on_tpu
+    return _amp_on_tpu()
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))

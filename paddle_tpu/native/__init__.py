@@ -8,6 +8,7 @@ ctypes is the binding layer, playing the role of the reference's pybind
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -35,11 +36,20 @@ def load():
         if _build_error is not None:
             raise RuntimeError(_build_error)
         try:
-            if not os.path.exists(_LIB_PATH) or (
-                    os.path.getmtime(_LIB_PATH) <
-                    os.path.getmtime(os.path.join(
-                        _NATIVE_DIR, "paddle_tpu_native.cc"))):
+            # rebuild keyed on source content hash, not mtimes (git
+            # checkouts don't preserve mtime ordering)
+            src = os.path.join(_NATIVE_DIR, "paddle_tpu_native.cc")
+            with open(src, "rb") as f:
+                digest = hashlib.sha256(f.read()).hexdigest()
+            stamp = _LIB_PATH + ".srchash"
+            stale = True
+            if os.path.exists(_LIB_PATH) and os.path.exists(stamp):
+                with open(stamp) as f:
+                    stale = f.read().strip() != digest
+            if stale:
                 _build()
+                with open(stamp, "w") as f:
+                    f.write(digest)
             lib = ctypes.CDLL(_LIB_PATH)
         except Exception as e:  # toolchain absent / build broke
             _build_error = "native runtime unavailable: %s" % e

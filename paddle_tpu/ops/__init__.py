@@ -16,6 +16,7 @@ from . import (  # noqa: F401
     attention_ops,
     detection_ops,
     selected_rows,
+    explicit_grads,  # last: attaches custom grad makers to the ops above
 )
 
 from ..core.registry import registered_ops  # noqa: F401

@@ -130,6 +130,9 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None):
         x = x.reshape(B, n, S // n, H // n, D)
         x = jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=3,
                                tiled=False)
+        # received axis 3 indexes the source head-*group*; it must be
+        # major when merging back to H = n * (H//n) global heads
+        x = x.swapaxes(2, 3)
         return x.reshape(B, S // n, H, D)
 
     qg, kg, vg = seq2head(q), seq2head(k), seq2head(v)

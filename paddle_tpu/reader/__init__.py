@@ -76,11 +76,18 @@ def map_readers(func, *readers):
     return reader
 
 
-def shuffle(reader, buf_size):
-    """reference: v2/reader/decorator.py shuffle — buffered shuffle."""
+def shuffle(reader, buf_size, seed=None):
+    """reference: v2/reader/decorator.py shuffle — buffered shuffle.
+
+    Each invocation (i.e. each training pass) advances the permutation so
+    successive epochs see different orders; pass ``seed`` for a
+    deterministic-but-per-pass-varying stream."""
+    epoch = [0]
 
     def data_reader():
-        rng = _random.Random(0)
+        epoch[0] += 1
+        rng = (_random.Random(seed * 1000003 + epoch[0])
+               if seed is not None else _random.Random())
         buf = []
         for e in reader():
             buf.append(e)

@@ -103,12 +103,25 @@ class Trainer(object):
                             {"avg_cost": float(np.mean(costs))
                              if costs else float("nan")}))
 
+    def _test_program(self, fetches):
+        """Pruned for-test clone: drops backward + optimizer ops so
+        evaluation never updates parameters or accumulators (reference:
+        the separate test program of Program.clone(for_test=True))."""
+        names = tuple(f.name if isinstance(f, ir.Variable) else f
+                      for f in fetches)
+        cached = getattr(self, "_test_cache", None)
+        if cached is None or cached[0] != names:
+            pruned = self.main_program.prune(
+                feeds=list(self.feeder.feed_names), fetches=names)
+            self._test_cache = (names, pruned)
+        return self._test_cache[1]
+
     def test(self, reader, fetch_list=None, program=None):
         """Average fetched metrics over a reader (reference:
         v2/trainer.py test / fluid book tests' test loops)."""
         self._maybe_init()
-        program = program or self.main_program
         fetches = fetch_list or self.fetch_list
+        program = program or self._test_program(fetches)
         acc = None
         n = 0
         for data in reader():

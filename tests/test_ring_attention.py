@@ -50,6 +50,23 @@ def test_ulysses_matches_dense(causal):
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("heads", [16, 24])
+def test_ulysses_more_heads_than_devices(heads):
+    """H > sp degree: head2seq's received device axis is head-group-major;
+    regression test for the head-permutation bug (round-1 advisor)."""
+    rng = np.random.RandomState(3)
+    B, S, D = 2, 64, 4
+    q = jnp.asarray(rng.randn(B, S, heads, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, S, heads, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, S, heads, D), jnp.float32)
+    mesh = make_mesh({"sp": 8})
+    out = ulysses_attention_sharded(q, k, v, mesh, seq_axis="sp",
+                                    causal=True)
+    want = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
 def test_ring_attention_grad_flows():
     rng = np.random.RandomState(2)
     B, S, H, D = 1, 32, 2, 4
