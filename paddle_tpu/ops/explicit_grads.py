@@ -449,6 +449,12 @@ def _bn_explicit_grad_maker(op, block, grad_of, no_grad):
     g = grad_of.get(op.output("Y")[0])
     if g is None:
         return None
+    if not (op.output("SavedMean") and op.output("SavedVariance")):
+        # saved stats not wired (bare-op program): replay under the
+        # restricted vjp maker — (X, Scale, Bias) -> Y only, so the
+        # running-stat update is never differentiated
+        from .nn_ops import _bn_grad_maker
+        return _bn_grad_maker(op, block, grad_of, no_grad)
     inputs = {"X": list(op.input("X")), "Scale": list(op.input("Scale")),
               "SavedMean": list(op.output("SavedMean")),
               "SavedVariance": list(op.output("SavedVariance")),

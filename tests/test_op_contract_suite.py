@@ -1,0 +1,674 @@
+"""Systematic per-op contract suite (reference: the 194 per-op files under
+python/paddle/fluid/tests/unittests/test_*_op.py, all built on op_test.py).
+
+Data-driven: each CASE is (name, op_type, builder) where builder() returns a
+dict with inputs / outputs (numpy references) / attrs / optional grad spec.
+``test_coverage`` asserts the suite spans >= 100 distinct op types.
+"""
+import zlib
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+from paddle_tpu.core.lod import LoDTensor
+
+
+class _Case(OpTest):
+    def __init__(self, op_type, spec):
+        self.op_type = op_type
+        self._spec = spec
+
+    def setup(self):
+        self.inputs = self._spec["inputs"]
+        self.outputs = self._spec["outputs"]
+        self.attrs = dict(self._spec.get("attrs", {}))
+
+
+def _r(seed, *shape):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+def _u(seed, *shape):
+    return (np.random.RandomState(seed).rand(*shape).astype(np.float32)
+            + 0.25)
+
+
+CASES = []
+
+
+def case(name, op_type, **spec):
+    """spec: inputs={slot: np|LoDTensor|[(name,val)...]}, outputs likewise,
+    attrs={}, grad=([inputs], out_name), atol/rtol/grad_rel."""
+    CASES.append((name, op_type, spec))
+
+
+# ---------------------------------------------------------------------------
+# activations: X -> Out elementwise
+# ---------------------------------------------------------------------------
+
+def _act(name, fn, x=None, grad=True, **kw):
+    x = (_r(zlib.crc32(name.encode()) % 1000, 3, 4)
+         if x is None else x)
+    spec = dict(inputs={"X": x}, outputs={"Out": fn(x).astype(np.float32)},
+                **kw)
+    if grad:
+        spec["grad"] = (["X"], "Out")
+    case(name, name, **spec)
+
+
+_sig = lambda x: 1.0 / (1.0 + np.exp(-x))
+_act("sigmoid", _sig)
+_act("logsigmoid", lambda x: np.log(_sig(x)))
+_act("tanh", np.tanh)
+_x_off0 = _r(11, 3, 4) + np.sign(_r(11, 3, 4)) * 0.1  # keep away from 0
+_act("relu", lambda x: np.maximum(x, 0.0), x=_x_off0)
+_act("relu6", lambda x: np.clip(x, 0, 6), x=_x_off0 * 4, grad=False)
+_act("exp", np.exp)
+_act("abs", np.abs, x=_x_off0)
+_act("ceil", np.ceil, grad=False)
+_act("floor", np.floor, grad=False)
+_act("round", np.round, grad=False)
+_act("log", np.log, x=_u(12, 3, 4))
+_act("square", np.square)
+_act("sqrt", np.sqrt, x=_u(13, 3, 4))
+_act("reciprocal", lambda x: 1.0 / x, x=_u(14, 3, 4))
+_act("softplus", lambda x: np.log1p(np.exp(x)))
+_act("softsign", lambda x: x / (1.0 + np.abs(x)))
+_act("sin", np.sin)
+_act("cos", np.cos)
+_act("tanh_shrink", lambda x: x - np.tanh(x))
+_act("softshrink",
+     lambda x: np.sign(x) * np.maximum(np.abs(x) - 0.5, 0.0),
+     x=_r(15, 3, 4) * 2, grad=False)
+_act("sign", np.sign, grad=False)
+
+_x16 = _x_off0
+case("leaky_relu", "leaky_relu", inputs={"X": _x16},
+     outputs={"Out": np.where(_x16 > 0, _x16, 0.1 * _x16).astype(np.float32)},
+     attrs={"alpha": 0.1}, grad=(["X"], "Out"))
+case("elu", "elu", inputs={"X": _x16},
+     outputs={"Out": np.where(_x16 > 0, _x16,
+                              1.0 * (np.exp(_x16) - 1)).astype(np.float32)},
+     attrs={"alpha": 1.0}, grad=(["X"], "Out"))
+_x17 = _r(17, 3, 4) * 3
+case("brelu", "brelu", inputs={"X": _x17},
+     outputs={"Out": np.clip(_x17, -1.0, 1.0)},
+     attrs={"t_min": -1.0, "t_max": 1.0})
+case("soft_relu", "soft_relu", inputs={"X": _x17},
+     outputs={"Out": np.log1p(np.exp(np.clip(_x17, -40, 40)))},
+     attrs={"threshold": 40.0}, grad=(["X"], "Out"))
+_x18 = _r(18, 3, 4)
+case("hard_sigmoid", "hard_sigmoid", inputs={"X": _x18},
+     outputs={"Out": np.clip(0.2 * _x18 + 0.5, 0, 1)},
+     attrs={"slope": 0.2, "offset": 0.5})
+case("swish", "swish", inputs={"X": _x18},
+     outputs={"Out": (_x18 * _sig(_x18)).astype(np.float32)},
+     attrs={"beta": 1.0}, grad=(["X"], "Out"))
+_x19 = _r(19, 3, 4) * 2
+case("thresholded_relu", "thresholded_relu", inputs={"X": _x19},
+     outputs={"Out": np.where(_x19 > 1.0, _x19, 0.0).astype(np.float32)},
+     attrs={"threshold": 1.0})
+case("stanh", "stanh", inputs={"X": _x18},
+     outputs={"Out": (1.7159 * np.tanh(0.67 * _x18)).astype(np.float32)},
+     attrs={"scale_a": 0.67, "scale_b": 1.7159}, grad=(["X"], "Out"))
+_x20 = _u(20, 3, 4)
+case("pow", "pow", inputs={"X": _x20},
+     outputs={"Out": np.power(_x20, 2.0).astype(np.float32)},
+     attrs={"factor": 2.0}, grad=(["X"], "Out"))
+_alpha = np.asarray([0.25], np.float32)
+case("prelu", "prelu",
+     inputs={"X": [("X", _x16)], "Alpha": [("Alpha", _alpha)]},
+     outputs={"Out": np.where(_x16 > 0, _x16, 0.25 * _x16)
+              .astype(np.float32)},
+     attrs={"mode": "all"})
+
+_x21 = _r(21, 4, 7)
+_e21 = np.exp(_x21 - _x21.max(-1, keepdims=True))
+case("softmax", "softmax", inputs={"X": _x21},
+     outputs={"Out": _e21 / _e21.sum(-1, keepdims=True)},
+     grad=(["X"], "Out"))
+case("log_softmax", "log_softmax", inputs={"X": _x21},
+     outputs={"Out": np.log(_e21 / _e21.sum(-1, keepdims=True))})
+_x22 = _r(22, 2, 4, 2, 2)
+case("maxout", "maxout", inputs={"X": _x22},
+     outputs={"Out": _x22.reshape(2, 2, 2, 2, 2).max(axis=2)},
+     attrs={"groups": 2})
+
+# ---------------------------------------------------------------------------
+# math: matmul family, elementwise, reductions, comparisons
+# ---------------------------------------------------------------------------
+
+_mx, _my = _r(30, 2, 3, 4), _r(31, 4, 5)
+case("mul_ncd", "mul",
+     inputs={"X": [("X", _mx)], "Y": [("Y", _my)]},
+     outputs={"Out": (_mx.reshape(6, 4) @ _my).reshape(2, 3, 5)},
+     attrs={"x_num_col_dims": 2, "y_num_col_dims": 1})
+_m2x, _m2y = _r(32, 3, 4), _r(33, 4, 5)
+case("mul", "mul", inputs={"X": [("X", _m2x)], "Y": [("Y", _m2y)]},
+     outputs={"Out": _m2x @ _m2y}, grad=(["X", "Y"], "Out"))
+_ma, _mb = _r(34, 2, 3, 4), _r(35, 2, 5, 4)
+case("matmul_tY", "matmul",
+     inputs={"X": [("X", _ma)], "Y": [("Y", _mb)]},
+     outputs={"Out": _ma @ _mb.transpose(0, 2, 1)},
+     attrs={"transpose_Y": True}, grad=(["X", "Y"], "Out"))
+_mc, _md = _r(36, 3, 4), _r(37, 3, 5)
+case("matmul_tX", "matmul",
+     inputs={"X": [("X", _mc)], "Y": [("Y", _md)]},
+     outputs={"Out": _mc.T @ _md}, attrs={"transpose_X": True},
+     grad=(["X", "Y"], "Out"))
+
+_ex, _ey = _r(40, 2, 3, 4), _r(41, 3)
+case("elementwise_add_bcast", "elementwise_add",
+     inputs={"X": [("X", _ex)], "Y": [("Y", _ey)]},
+     outputs={"Out": _ex + _ey[None, :, None]}, attrs={"axis": 1},
+     grad=(["X", "Y"], "Out"))
+_e2 = _r(42, 3, 4)
+case("elementwise_sub", "elementwise_sub",
+     inputs={"X": [("X", _ex[0])], "Y": [("Y", _e2)]},
+     outputs={"Out": _ex[0] - _e2}, grad=(["X", "Y"], "Out"))
+case("elementwise_mul", "elementwise_mul",
+     inputs={"X": [("X", _ex[0])], "Y": [("Y", _e2)]},
+     outputs={"Out": _ex[0] * _e2}, grad=(["X", "Y"], "Out"))
+_e3 = _u(43, 3, 4)
+case("elementwise_div", "elementwise_div",
+     inputs={"X": [("X", _ex[0])], "Y": [("Y", _e3)]},
+     outputs={"Out": _ex[0] / _e3}, grad=(["X", "Y"], "Out"))
+case("elementwise_max", "elementwise_max",
+     inputs={"X": [("X", _ex[0])], "Y": [("Y", _e2)]},
+     outputs={"Out": np.maximum(_ex[0], _e2)})
+case("elementwise_min", "elementwise_min",
+     inputs={"X": [("X", _ex[0])], "Y": [("Y", _e2)]},
+     outputs={"Out": np.minimum(_ex[0], _e2)})
+_e4 = _u(44, 3, 4)
+case("elementwise_pow", "elementwise_pow",
+     inputs={"X": [("X", _e4)], "Y": [("Y", np.full((3, 4), 2.0,
+                                                    np.float32))]},
+     outputs={"Out": _e4 ** 2})
+
+_s1, _s2, _s3 = _r(45, 3, 4), _r(46, 3, 4), _r(47, 3, 4)
+case("sum", "sum",
+     inputs={"X": [("s1", _s1), ("s2", _s2), ("s3", _s3)]},
+     outputs={"Out": _s1 + _s2 + _s3}, grad=(["s1", "s2"], "Out"))
+case("scale", "scale", inputs={"X": _s1},
+     outputs={"Out": _s1 * 2.5 + 1.0},
+     attrs={"scale": 2.5, "bias": 1.0, "bias_after_scale": True},
+     grad=(["X"], "Out"))
+case("clip", "clip", inputs={"X": _x17},
+     outputs={"Out": np.clip(_x17, -1.0, 1.0)},
+     attrs={"min": -1.0, "max": 1.0})
+_cn = _r(48, 4, 3)
+_cn_norm = np.sqrt((_cn ** 2).sum())
+case("clip_by_norm", "clip_by_norm", inputs={"X": _cn},
+     outputs={"Out": _cn * min(1.0, 1.0 / _cn_norm)},
+     attrs={"max_norm": 1.0})
+case("cumsum", "cumsum", inputs={"X": _s1},
+     outputs={"Out": np.cumsum(_s1, axis=1)}, attrs={"axis": 1},
+     grad=(["X"], "Out"))
+
+_rx = _r(50, 2, 3, 4)
+case("reduce_sum", "reduce_sum", inputs={"X": _rx},
+     outputs={"Out": _rx.sum(axis=1, keepdims=True)},
+     attrs={"dim": [1], "keep_dim": True}, grad=(["X"], "Out"))
+case("reduce_mean", "reduce_mean", inputs={"X": _rx},
+     outputs={"Out": np.asarray(_rx.mean(), np.float32).reshape(())},
+     attrs={"reduce_all": True})
+case("reduce_max", "reduce_max", inputs={"X": _rx},
+     outputs={"Out": _rx.max(axis=2)}, attrs={"dim": [2]})
+case("reduce_min", "reduce_min", inputs={"X": _rx},
+     outputs={"Out": _rx.min(axis=0)}, attrs={"dim": [0]})
+_rp = _u(51, 2, 3)
+case("reduce_prod", "reduce_prod", inputs={"X": _rp},
+     outputs={"Out": _rp.prod(axis=1)}, attrs={"dim": [1]})
+case("mean", "mean", inputs={"X": _rx},
+     outputs={"Out": np.asarray([_rx.mean()], np.float32)},
+     grad=(["X"], "Out"))
+_nx = _r(52, 3, 4)
+_nn = np.sqrt((_nx ** 2).sum(axis=1, keepdims=True) + 1e-10)
+case("norm", "norm", inputs={"X": _nx},
+     outputs={"Out": _nx / _nn, "Norm": _nn}, attrs={"axis": 1})
+case("maximum", "maximum",
+     inputs={"X": [("X", _ex[0])], "Y": [("Y", _e2)]},
+     outputs={"Out": np.maximum(_ex[0], _e2)})
+
+_ca, _cb = _r(53, 3, 4), _r(54, 3, 4)
+for _nm, _np_fn in [("less_than", np.less), ("less_equal", np.less_equal),
+                    ("greater_than", np.greater),
+                    ("greater_equal", np.greater_equal),
+                    ("equal", np.equal), ("not_equal", np.not_equal)]:
+    case(_nm, _nm, inputs={"X": [("X", _ca)], "Y": [("Y", _cb)]},
+         outputs={"Out": _np_fn(_ca, _cb)})
+_ba = _ca > 0
+_bb = _cb > 0
+for _nm, _np_fn in [("logical_and", np.logical_and),
+                    ("logical_or", np.logical_or),
+                    ("logical_xor", np.logical_xor)]:
+    case(_nm, _nm, inputs={"X": [("X", _ba)], "Y": [("Y", _bb)]},
+         outputs={"Out": _np_fn(_ba, _bb)})
+case("logical_not", "logical_not", inputs={"X": _ba},
+     outputs={"Out": np.logical_not(_ba)})
+_fin = _r(55, 3, 3)
+_fin[1, 1] = np.inf
+case("isfinite", "isfinite", inputs={"X": _fin},
+     outputs={"Out": np.asarray(False)})
+
+_tk = _r(56, 3, 5)
+_tk_idx = np.argsort(-_tk, axis=1)[:, :2]
+case("top_k", "top_k", inputs={"X": _tk},
+     outputs={"Out": [("Out", np.take_along_axis(_tk, _tk_idx, 1))],
+              "Indices": [("Indices", _tk_idx.astype(np.int64))]},
+     attrs={"k": 2})
+case("arg_max", "arg_max", inputs={"X": _tk},
+     outputs={"Out": np.argmax(_tk, -1).astype(np.int64)})
+case("arg_min", "arg_min", inputs={"X": _tk},
+     outputs={"Out": np.argmin(_tk, -1).astype(np.int64)})
+case("argsort", "argsort", inputs={"X": _tk},
+     outputs={"Out": [("Out", np.sort(_tk, -1))],
+              "Indices": [("Indices", np.argsort(_tk, -1)
+                           .astype(np.int64))]})
+case("cast", "cast", inputs={"X": _tk},
+     outputs={"Out": _tk.astype(np.int32)},
+     attrs={"in_dtype": "float32", "out_dtype": "int32"})
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+_pr = _u(60, 4, 5)
+_pr = (_pr / _pr.sum(-1, keepdims=True)).astype(np.float32)
+_lab = np.asarray([[1], [0], [4], [2]], np.int64)
+case("cross_entropy", "cross_entropy",
+     inputs={"X": [("X", _pr)], "Label": [("Label", _lab)]},
+     outputs={"Y": -np.log(_pr[np.arange(4), _lab[:, 0]])[:, None]},
+     grad=(["X"], "Y"))
+_soft = _u(61, 4, 5)
+_soft = (_soft / _soft.sum(-1, keepdims=True)).astype(np.float32)
+case("cross_entropy_soft", "cross_entropy",
+     inputs={"X": [("X", _pr)], "Label": [("Label", _soft)]},
+     outputs={"Y": -(np.log(_pr) * _soft).sum(-1, keepdims=True)},
+     attrs={"soft_label": True})
+_lg = _r(62, 4, 5)
+_lp = _lg - _lg.max(-1, keepdims=True)
+_lp = _lp - np.log(np.exp(_lp).sum(-1, keepdims=True))
+case("softmax_with_cross_entropy", "softmax_with_cross_entropy",
+     inputs={"Logits": [("Logits", _lg)], "Label": [("Label", _lab)]},
+     outputs={"Loss": [("Loss", -_lp[np.arange(4), _lab[:, 0]][:, None])],
+              "Softmax": [("Softmax", np.exp(_lp))]},
+     grad=(["Logits"], "Loss"))
+_sx = _r(63, 4, 3)
+_sl = (np.random.RandomState(64).rand(4, 3) > 0.5).astype(np.float32)
+case("sigmoid_cross_entropy_with_logits",
+     "sigmoid_cross_entropy_with_logits",
+     inputs={"X": [("X", _sx)], "Label": [("Label", _sl)]},
+     outputs={"Out": np.maximum(_sx, 0) - _sx * _sl +
+              np.log1p(np.exp(-np.abs(_sx)))},
+     grad=(["X"], "Out"))
+_qa, _qb = _r(65, 4, 3), _r(66, 4, 3)
+case("square_error_cost", "square_error_cost",
+     inputs={"X": [("X", _qa)], "Y": [("Y", _qb)]},
+     outputs={"Out": (_qa - _qb) ** 2}, grad=(["X"], "Out"))
+case("squared_l2_distance", "squared_l2_distance",
+     inputs={"X": [("X", _qa)], "Y": [("Y", _qb)]},
+     outputs={"Out": ((_qa - _qb) ** 2).sum(-1, keepdims=True)})
+case("squared_l2_norm", "squared_l2_norm", inputs={"X": _qa},
+     outputs={"Out": np.asarray([(_qa ** 2).sum()], np.float32)})
+_hl = _r(67, 4, 1)
+_hlab = (np.random.RandomState(68).rand(4, 1) > 0.5).astype(np.float32)
+case("hinge_loss", "hinge_loss",
+     inputs={"Logits": [("Logits", _hl)], "Labels": [("Labels", _hlab)]},
+     outputs={"Loss": np.maximum(0.0, 1.0 - (2 * _hlab - 1) * _hl)})
+_hr = _qa - _qb
+case("huber_loss", "huber_loss",
+     inputs={"X": [("X", _qb)], "Y": [("Y", _qa)]},
+     outputs={"Out": np.where(np.abs(_hr) <= 1.0, 0.5 * _hr ** 2,
+                              np.abs(_hr) - 0.5).astype(np.float32)},
+     attrs={"delta": 1.0})
+_p2 = _u(69, 4, 1) / 2
+_l2 = (np.random.RandomState(70).rand(4, 1) > 0.5).astype(np.float32)
+case("log_loss", "log_loss",
+     inputs={"Predicted": [("Predicted", _p2)], "Labels": [("Labels", _l2)]},
+     outputs={"Loss": -_l2 * np.log(_p2 + 1e-4) -
+              (1 - _l2) * np.log(1 - _p2 + 1e-4)},
+     attrs={"epsilon": 1e-4})
+_rl, _rr = _r(71, 4, 1), _r(72, 4, 1)
+_rlab = (np.random.RandomState(73).rand(4, 1) > 0.5).astype(np.float32)
+case("rank_loss", "rank_loss",
+     inputs={"Label": [("Label", _rlab)], "Left": [("Left", _rl)],
+             "Right": [("Right", _rr)]},
+     outputs={"Out": np.log1p(np.exp(_rl - _rr)) - _rlab * (_rl - _rr)})
+case("margin_rank_loss", "margin_rank_loss",
+     inputs={"Label": [("Label", _rlab * 2 - 1)], "X1": [("X1", _rl)],
+             "X2": [("X2", _rr)]},
+     outputs={"Out": np.maximum(
+         0.0, -(_rlab * 2 - 1) * (_rl - _rr) + 0.1).astype(np.float32)},
+     attrs={"margin": 0.1})
+_cs_n = np.sqrt((_qa ** 2).sum(-1, keepdims=True))
+_cs_m = np.sqrt((_qb ** 2).sum(-1, keepdims=True))
+case("cos_sim", "cos_sim",
+     inputs={"X": [("X", _qa)], "Y": [("Y", _qb)]},
+     outputs={"Out": (_qa * _qb).sum(-1, keepdims=True) /
+              (_cs_n * _cs_m + 1e-12)})
+
+# ---------------------------------------------------------------------------
+# nn: conv / pool / norm / embedding
+# ---------------------------------------------------------------------------
+
+def _conv2d_ref(x, w, s, p):
+    B, C, H, W = x.shape
+    O, _, KH, KW = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
+    OH = (H + 2 * p[0] - KH) // s[0] + 1
+    OW = (W + 2 * p[1] - KW) // s[1] + 1
+    out = np.zeros((B, O, OH, OW), np.float32)
+    for b in range(B):
+        for o in range(O):
+            for i in range(OH):
+                for j in range(OW):
+                    out[b, o, i, j] = np.sum(
+                        xp[b, :, i * s[0]:i * s[0] + KH,
+                           j * s[1]:j * s[1] + KW] * w[o])
+    return out
+
+
+_cx, _cw = _r(80, 1, 2, 5, 5), _r(81, 3, 2, 3, 3)
+case("conv2d_s2", "conv2d",
+     inputs={"Input": [("Input", _cx)], "Filter": [("Filter", _cw)]},
+     outputs={"Output": _conv2d_ref(_cx, _cw, (2, 2), (1, 1))},
+     attrs={"strides": [2, 2], "paddings": [1, 1], "dilations": [1, 1],
+            "groups": 1},
+     grad=(["Input", "Filter"], "Output"), atol=1e-4, rtol=1e-4,
+     grad_rel=2e-2)
+
+
+def _dwconv_ref(x, w, s, p):
+    B, C, H, W = x.shape
+    out = np.concatenate([
+        _conv2d_ref(x[:, c:c + 1], w[c:c + 1, :1], s, p)
+        for c in range(C)], axis=1)
+    return out
+
+
+_dx, _dw = _r(82, 1, 3, 4, 4), _r(83, 3, 1, 3, 3)
+case("depthwise_conv2d", "depthwise_conv2d",
+     inputs={"Input": [("Input", _dx)], "Filter": [("Filter", _dw)]},
+     outputs={"Output": _dwconv_ref(_dx, _dw, (1, 1), (1, 1))},
+     attrs={"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+            "groups": 3}, atol=1e-4, rtol=1e-4)
+
+
+def _conv3d_ref(x, w):
+    B, C, D, H, W = x.shape
+    O, _, KD, KH, KW = w.shape
+    out = np.zeros((B, O, D - KD + 1, H - KH + 1, W - KW + 1), np.float32)
+    for o in range(O):
+        for i in range(out.shape[2]):
+            for j in range(out.shape[3]):
+                for k in range(out.shape[4]):
+                    out[0, o, i, j, k] = np.sum(
+                        x[0, :, i:i + KD, j:j + KH, k:k + KW] * w[o])
+    return out
+
+
+_c3x, _c3w = _r(84, 1, 2, 3, 3, 3), _r(85, 2, 2, 2, 2, 2)
+case("conv3d", "conv3d",
+     inputs={"Input": [("Input", _c3x)], "Filter": [("Filter", _c3w)]},
+     outputs={"Output": _conv3d_ref(_c3x, _c3w)},
+     attrs={"strides": [1, 1, 1], "paddings": [0, 0, 0],
+            "dilations": [1, 1, 1], "groups": 1}, atol=1e-4, rtol=1e-4)
+
+_px = _r(86, 1, 2, 4, 4)
+case("pool2d_max", "pool2d", inputs={"X": _px},
+     outputs={"Out": _px.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))},
+     attrs={"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2],
+            "paddings": [0, 0]}, grad=(["X"], "Out"))
+case("pool2d_avg", "pool2d", inputs={"X": _px},
+     outputs={"Out": _px.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))},
+     attrs={"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2],
+            "paddings": [0, 0]}, grad=(["X"], "Out"))
+case("pool2d_global", "pool2d", inputs={"X": _px},
+     outputs={"Out": _px.max(axis=(2, 3), keepdims=True)},
+     attrs={"pooling_type": "max", "ksize": [1, 1],
+            "global_pooling": True})
+_p3 = _r(87, 1, 1, 2, 4, 4)
+case("pool3d", "pool3d", inputs={"X": _p3},
+     outputs={"Out": _p3.reshape(1, 1, 1, 2, 2, 2, 2, 2)
+              .max(axis=(3, 5, 7))},
+     attrs={"pooling_type": "max", "ksize": [2, 2, 2],
+            "strides": [2, 2, 2], "paddings": [0, 0, 0]})
+
+_bx = _r(88, 2, 3, 4, 4)
+_bsc = _u(89, 3)
+_bbi = _r(90, 3)
+_bmean = _r(91, 3) * 0.1
+_bvar = _u(92, 3)
+_bref = ((_bx - _bmean[None, :, None, None]) /
+         np.sqrt(_bvar[None, :, None, None] + 1e-5) *
+         _bsc[None, :, None, None] + _bbi[None, :, None, None])
+case("batch_norm_infer", "batch_norm",
+     inputs={"X": [("X", _bx)], "Scale": [("Scale", _bsc)],
+             "Bias": [("Bias", _bbi)], "Mean": [("Mean", _bmean)],
+             "Variance": [("Variance", _bvar)]},
+     outputs={"Y": _bref.astype(np.float32)},
+     attrs={"is_test": True, "epsilon": 1e-5}, atol=1e-4, rtol=1e-4)
+_bm_t = _bx.mean(axis=(0, 2, 3))
+_bv_t = _bx.var(axis=(0, 2, 3))
+_bref_t = ((_bx - _bm_t[None, :, None, None]) /
+           np.sqrt(_bv_t[None, :, None, None] + 1e-5) *
+           _bsc[None, :, None, None] + _bbi[None, :, None, None])
+case("batch_norm_train", "batch_norm",
+     inputs={"X": [("X", _bx)], "Scale": [("Scale", _bsc)],
+             "Bias": [("Bias", _bbi)], "Mean": [("Mean", _bmean)],
+             "Variance": [("Variance", _bvar)]},
+     outputs={"Y": [("Y", _bref_t.astype(np.float32))],
+              "MeanOut": [("MeanOut",
+                           (0.9 * _bmean + 0.1 * _bm_t).astype(np.float32))],
+              "VarianceOut": [("VarianceOut",
+                               (0.9 * _bvar + 0.1 * _bv_t)
+                               .astype(np.float32))],
+              "SavedMean": [("SavedMean", _bm_t.astype(np.float32))],
+              "SavedVariance": [("SavedVariance",
+                                 (1.0 / np.sqrt(_bv_t + 1e-5))
+                                 .astype(np.float32))]},
+     attrs={"is_test": False, "epsilon": 1e-5, "momentum": 0.9},
+     atol=1e-4, rtol=1e-4, grad=(["X", "Scale", "Bias"], "Y"),
+     grad_rel=2e-2)
+
+_lx = _r(93, 3, 4)
+_lm = _lx.mean(-1, keepdims=True)
+_lv = _lx.var(-1, keepdims=True)
+case("layer_norm", "layer_norm",
+     inputs={"X": _lx},
+     outputs={"Y": ((_lx - _lm) / np.sqrt(_lv + 1e-5)).astype(np.float32)},
+     attrs={"begin_norm_axis": 1, "epsilon": 1e-5}, atol=1e-4, rtol=1e-4)
+_l2x = _r(94, 3, 4)
+case("l2_normalize", "l2_normalize", inputs={"X": _l2x},
+     outputs={"Out": _l2x / np.sqrt((_l2x ** 2).sum(1, keepdims=True)
+                                    + 1e-10)},
+     attrs={"axis": 1, "epsilon": 1e-10}, atol=1e-4, rtol=1e-4)
+_do = _r(95, 3, 4)
+case("dropout_infer", "dropout", inputs={"X": _do},
+     outputs={"Out": _do * 0.6},
+     attrs={"dropout_prob": 0.4, "is_test": True})
+
+_W = _r(96, 6, 3)
+_ids = np.asarray([[1], [3], [5], [0]], np.int64)
+case("lookup_table", "lookup_table",
+     inputs={"W": [("W", _W)], "Ids": [("Ids", _ids)]},
+     outputs={"Out": _W[_ids[:, 0]]}, grad=(["W"], "Out"))
+_oh = np.asarray([[0], [2], [1]], np.int64)
+case("one_hot", "one_hot", inputs={"X": _oh},
+     outputs={"Out": np.eye(4, dtype=np.float32)[_oh[:, 0]]},
+     attrs={"depth": 4})
+_acc_idx = np.asarray([[1, 0], [2, 3], [0, 1]], np.int64)
+_acc_lab = np.asarray([[1], [0], [2]], np.int64)
+case("accuracy", "accuracy",
+     inputs={"Indices": [("Indices", _acc_idx)],
+             "Label": [("Label", _acc_lab)]},
+     outputs={"Accuracy": [("Accuracy",
+                            np.asarray([1.0 / 3.0], np.float32))]})
+
+# ---------------------------------------------------------------------------
+# tensor manipulation
+# ---------------------------------------------------------------------------
+
+case("fill_constant", "fill_constant", inputs={},
+     outputs={"Out": np.full((2, 3), 1.5, np.float32)},
+     attrs={"shape": [2, 3], "value": 1.5, "dtype": "float32"})
+case("fill_zeros_like", "fill_zeros_like", inputs={"X": _s1},
+     outputs={"Out": np.zeros_like(_s1)})
+case("fill_constant_batch_size_like", "fill_constant_batch_size_like",
+     inputs={"Input": _r(100, 5, 2)},
+     outputs={"Out": np.full((5, 3), 2.0, np.float32)},
+     attrs={"shape": [-1, 3], "value": 2.0, "dtype": "float32",
+            "input_dim_idx": 0, "output_dim_idx": 0})
+case("assign", "assign", inputs={"X": _s1}, outputs={"Out": _s1})
+case("assign_value", "assign_value", inputs={},
+     outputs={"Out": np.asarray([1.0, 2.0, 3.0], np.float32)},
+     attrs={"values": [1.0, 2.0, 3.0], "shape": [3], "dtype": "float32"})
+_cc1, _cc2 = _r(101, 2, 3), _r(102, 2, 2)
+case("concat", "concat",
+     inputs={"X": [("c1", _cc1), ("c2", _cc2)]},
+     outputs={"Out": np.concatenate([_cc1, _cc2], axis=1)},
+     attrs={"axis": 1}, grad=(["c1", "c2"], "Out"))
+_sp = _r(103, 4, 6)
+case("split", "split",
+     inputs={"X": _sp},
+     outputs={"Out": [("sp0", _sp[:, :3]), ("sp1", _sp[:, 3:])]},
+     attrs={"num": 2, "axis": 1})
+case("reshape", "reshape", inputs={"X": _sp},
+     outputs={"Out": _sp.reshape(2, 12)}, attrs={"shape": [2, 12]},
+     grad=(["X"], "Out"))
+_sq = _r(104, 3, 1, 4)
+case("squeeze", "squeeze", inputs={"X": _sq},
+     outputs={"Out": _sq.reshape(3, 4)}, attrs={"axes": [1]})
+case("unsqueeze", "unsqueeze", inputs={"X": _sp},
+     outputs={"Out": _sp[:, None]}, attrs={"axes": [1]})
+case("transpose", "transpose", inputs={"X": _rx},
+     outputs={"Out": _rx.transpose(2, 0, 1)}, attrs={"axis": [2, 0, 1]},
+     grad=(["X"], "Out"))
+case("expand", "expand", inputs={"X": _cc1},
+     outputs={"Out": np.tile(_cc1, (2, 1))}, attrs={"expand_times": [2, 1]})
+case("pad", "pad", inputs={"X": _cc1},
+     outputs={"Out": np.pad(_cc1, ((1, 0), (0, 2)),
+                            constant_values=0.5)},
+     attrs={"paddings": [1, 0, 0, 2], "pad_value": 0.5},
+     grad=(["X"], "Out"))
+case("slice", "slice", inputs={"Input": _rx},
+     outputs={"Out": _rx[:, 1:3]},
+     attrs={"axes": [1], "starts": [1], "ends": [3]})
+case("crop", "crop", inputs={"X": _sp},
+     outputs={"Out": _sp[1:3, 2:5]},
+     attrs={"offsets": [1, 2], "shape": [2, 3]})
+_gx = _r(105, 5, 3)
+_gi = np.asarray([0, 3, 1], np.int64)
+case("gather", "gather",
+     inputs={"X": [("X", _gx)], "Index": [("Index", _gi)]},
+     outputs={"Out": _gx[_gi]}, grad=(["X"], "Out"))
+_sc_base = _r(106, 5, 3)
+_sc_upd = _r(107, 2, 3)
+_sc_out = _sc_base.copy()
+_sc_out[[1, 4]] = _sc_upd
+case("scatter", "scatter",
+     inputs={"X": [("X", _sc_base)],
+             "Ids": [("Ids", np.asarray([1, 4], np.int64))],
+             "Updates": [("Updates", _sc_upd)]},
+     outputs={"Out": _sc_out})
+case("increment", "increment", inputs={"X": np.asarray([2.0], np.float32)},
+     outputs={"Out": np.asarray([3.0], np.float32)}, attrs={"step": 1.0})
+case("is_empty", "is_empty", inputs={"X": _s1},
+     outputs={"Out": np.asarray(False)})
+case("shape", "shape", inputs={"X": _rx},
+     outputs={"Out": np.asarray([2, 3, 4], np.int64)})
+case("reverse", "reverse", inputs={"X": _sp},
+     outputs={"Out": _sp[::-1]}, attrs={"axis": [0]})
+
+# ---------------------------------------------------------------------------
+# sequence ops (LoD contracts)
+# ---------------------------------------------------------------------------
+
+_seq = _r(110, 6, 2)   # two sequences: lengths 4 and 2
+_lod = [[0, 4, 6]]
+case("sequence_pool_sum", "sequence_pool",
+     inputs={"X": LoDTensor(_seq, _lod)},
+     outputs={"Out": np.stack([_seq[:4].sum(0), _seq[4:].sum(0)])},
+     attrs={"pooltype": "SUM"})
+case("sequence_pool_avg", "sequence_pool",
+     inputs={"X": LoDTensor(_seq, _lod)},
+     outputs={"Out": np.stack([_seq[:4].mean(0), _seq[4:].mean(0)])},
+     attrs={"pooltype": "AVERAGE"})
+case("sequence_pool_max", "sequence_pool",
+     inputs={"X": LoDTensor(_seq, _lod)},
+     outputs={"Out": np.stack([_seq[:4].max(0), _seq[4:].max(0)])},
+     attrs={"pooltype": "MAX"})
+case("sequence_pool_last", "sequence_pool",
+     inputs={"X": LoDTensor(_seq, _lod)},
+     outputs={"Out": np.stack([_seq[3], _seq[5]])},
+     attrs={"pooltype": "LAST"})
+case("sequence_pool_first", "sequence_pool",
+     inputs={"X": LoDTensor(_seq, _lod)},
+     outputs={"Out": np.stack([_seq[0], _seq[4]])},
+     attrs={"pooltype": "FIRST"})
+
+_ssx = _r(111, 5, 1)
+_sslod = [[0, 3, 5]]
+
+
+def _seq_softmax_ref(x, offs):
+    out = np.zeros_like(x)
+    for a, b in zip(offs, offs[1:]):
+        e = np.exp(x[a:b] - x[a:b].max())
+        out[a:b] = e / e.sum()
+    return out
+
+
+case("sequence_softmax", "sequence_softmax",
+     inputs={"X": LoDTensor(_ssx, _sslod)},
+     outputs={"Out": LoDTensor(_seq_softmax_ref(_ssx, [0, 3, 5]), _sslod)})
+
+_sex = _r(112, 2, 3)   # one row per sequence
+_sey = _r(113, 5, 1)
+_selod = [[0, 3, 5]]
+case("sequence_expand", "sequence_expand",
+     inputs={"X": [("X", _sex)], "Y": [("Y", LoDTensor(_sey, _selod))]},
+     outputs={"Out": LoDTensor(_sex[[0, 0, 0, 1, 1]], _selod)})
+
+_sr = _r(114, 4, 6)    # lengths 3,1 of dim 6 -> dim 3 doubles lengths
+case("sequence_reshape", "sequence_reshape",
+     inputs={"X": LoDTensor(_sr, [[0, 3, 4]])},
+     outputs={"Out": LoDTensor(_sr.reshape(8, 3), [[0, 6, 8]])},
+     attrs={"new_dim": 3})
+
+case("lod_reset", "lod_reset",
+     inputs={"X": LoDTensor(_seq, _lod)},
+     outputs={"Out": LoDTensor(_seq, [[0, 2, 6]])},
+     attrs={"target_lod": [0, 2, 6]})
+
+
+# ---------------------------------------------------------------------------
+# runners
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name,op_type,spec",
+                         CASES, ids=[c[0] for c in CASES])
+def test_output(name, op_type, spec):
+    t = _Case(op_type, spec)
+    t.check_output(atol=spec.get("atol", 1e-5), rtol=spec.get("rtol", 1e-5))
+
+
+_GRAD_CASES = [c for c in CASES if "grad" in c[2]]
+
+
+@pytest.mark.parametrize("name,op_type,spec", _GRAD_CASES,
+                         ids=[c[0] for c in _GRAD_CASES])
+def test_grad(name, op_type, spec):
+    t = _Case(op_type, spec)
+    ins, out = spec["grad"]
+    t.check_grad(ins, out,
+                 max_relative_error=spec.get("grad_rel", 5e-3))
+
+
+def test_coverage():
+    """The suite must span >=100 distinct op types (VERDICT r1 item 4)."""
+    ops = {c[1] for c in CASES}
+    assert len(ops) >= 100, "op contract coverage %d < 100: %s" % (
+        len(ops), sorted(ops))
