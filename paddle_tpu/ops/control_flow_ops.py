@@ -11,12 +11,19 @@ TPU-first split (SURVEY.md §7 hard part (b)):
 - compare/logical and the ``recurrent`` (StaticRNN) op are pure jax —
   StaticRNN traces its step block inside ``lax.scan``, so a whole RNN
   compiles to one XLA while-with-static-shapes.
-- While / arrays / rank-table machinery have *data-dependent shapes per
-  iteration* (the batch shrinks as short sequences end). These are host ops:
-  they run on the eager executor path with concrete values — exactly the
-  reference's per-op interpreter semantics, preserved as the compatibility
-  path. The jit-compiled way to the same models is dynamic_lstm/gru (masked
-  scan) — that is where TPU performance lives.
+- While / arrays / rank-table machinery *jit-compile by trace-time
+  unrolling*: loop counters and conditions ride as ConcreteScalar (the
+  analog of the reference's force_cpu counters that while_op.cc reads on
+  host), so the While condition is known while tracing and the loop unrolls
+  into the XLA graph — trip count = the rank table's static max_len, which
+  comes from the feed's LoD signature (distinct max_lens re-specialise the
+  compile cache; reader bucketing bounds how many). The ragged "batch
+  shrinks as short sequences end" layout becomes a fixed-capacity padded
+  layout: every step tensor keeps all n rank-ordered rows, alive rows are a
+  prefix (descending-length sort), dead rows carry masked zeros that
+  array_to_lod_tensor never gathers — so values AND grads match the
+  reference's dynamic-shape semantics exactly. Data-dependent *selection*
+  (beam_search) stays host: its output sizes aren't static-shapable.
 """
 from __future__ import annotations
 
@@ -25,29 +32,41 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import registry
-from ..core.executor import (LowerContext, RngSource, TracedLoD, raw_data,
-                             trace_ops, with_lod_of)
+from ..core.executor import (ConcreteScalar, LowerContext, RngSource,
+                             TracedLoD, concrete_value, raw_data, trace_ops,
+                             with_lod_of)
 from ..core.registry import register_op
 
 
 # ---------------------------------------------------------------------------
 # compare / logical (reference: operators/compare_op.cc, logical_op.cc)
 
-def _binary(ctx, fn):
-    x = raw_data(ctx.input("X"))
-    y = raw_data(ctx.input("Y"))
-    ctx.set_output("Out", fn(x, y))
+def _binary(ctx, fn, pyfn):
+    xv = ctx.input("X")
+    yv = ctx.input("Y")
+    out = fn(raw_data(xv), raw_data(yv))
+    cx, cy = concrete_value(xv), concrete_value(yv)
+    if cx is not None and cy is not None:
+        # both operands known at trace time (loop counters / max-seq-len):
+        # the comparison is too — this is what lets While unroll under jit.
+        # Must be a *python* comparison: inside a jit trace even jnp ops on
+        # python scalars stage out to tracers.
+        out = ConcreteScalar(bool(pyfn(cx, cy)), out)
+    ctx.set_output("Out", out)
 
 
-for _t, _f in [("less_than", jnp.less), ("less_equal", jnp.less_equal),
-               ("greater_than", jnp.greater),
-               ("greater_equal", jnp.greater_equal),
-               ("equal", jnp.equal), ("not_equal", jnp.not_equal),
-               ("logical_and", jnp.logical_and),
-               ("logical_or", jnp.logical_or),
-               ("logical_xor", jnp.logical_xor)]:
+for _t, _f, _p in [
+        ("less_than", jnp.less, lambda a, b: a < b),
+        ("less_equal", jnp.less_equal, lambda a, b: a <= b),
+        ("greater_than", jnp.greater, lambda a, b: a > b),
+        ("greater_equal", jnp.greater_equal, lambda a, b: a >= b),
+        ("equal", jnp.equal, lambda a, b: a == b),
+        ("not_equal", jnp.not_equal, lambda a, b: a != b),
+        ("logical_and", jnp.logical_and, lambda a, b: bool(a) and bool(b)),
+        ("logical_or", jnp.logical_or, lambda a, b: bool(a) or bool(b)),
+        ("logical_xor", jnp.logical_xor, lambda a, b: bool(a) != bool(b))]:
     register_op(_t, no_gradient=True)(
-        (lambda f: lambda ctx: _binary(ctx, f))(_f))
+        (lambda f, p: lambda ctx: _binary(ctx, f, p))(_f, _p))
 
 
 # ---------------------------------------------------------------------------
@@ -89,11 +108,21 @@ def _write_to_array_grad_maker(op, block, grad_of, no_grad):
              {"X@GRAD": [grad_var_name(x_name)]}, {})]
 
 
-@register_op("write_to_array", host=True,
-             grad_maker=_write_to_array_grad_maker)
+def _index_of(ctx, slot="I"):
+    """Concrete python index of an array op's I input. Loop counters ride as
+    ConcreteScalar so this works under jit tracing too; a genuinely traced
+    index would raise (correct: list-backed arrays need static slots)."""
+    v = ctx.input(slot)
+    cv = concrete_value(v)
+    if cv is not None:
+        return int(cv)
+    return int(np.asarray(raw_data(v)).reshape(-1)[0])
+
+
+@register_op("write_to_array", grad_maker=_write_to_array_grad_maker)
 def write_to_array(ctx):
     x = ctx.input("X")
-    i = int(np.asarray(raw_data(ctx.input("I"))).reshape(-1)[0])
+    i = _index_of(ctx)
     arr, name = _array_of(ctx, "Out")
     # Out may alias an input array var of the same name
     while len(arr) <= i:
@@ -102,10 +131,10 @@ def write_to_array(ctx):
     ctx.env[name] = arr
 
 
-@register_op("write_to_array_grad", host=True, no_gradient=True)
+@register_op("write_to_array_grad", no_gradient=True)
 def write_to_array_grad(ctx):
     arr_g = ctx.input("Out@GRAD")
-    i = int(np.asarray(raw_data(ctx.input("I"))).reshape(-1)[0])
+    i = _index_of(ctx)
     if isinstance(arr_g, LoDTensorArrayVal) and i < len(arr_g) \
             and arr_g[i] is not None:
         ctx.set_output("X@GRAD", arr_g[i])
@@ -123,20 +152,19 @@ def _read_from_array_grad_maker(op, block, grad_of, no_grad):
              {"X@GRAD": [grad_var_name(x_name)]}, {})]
 
 
-@register_op("read_from_array", host=True,
-             grad_maker=_read_from_array_grad_maker)
+@register_op("read_from_array", grad_maker=_read_from_array_grad_maker)
 def read_from_array(ctx):
     arr = ctx.input("X")
-    i = int(np.asarray(raw_data(ctx.input("I"))).reshape(-1)[0])
+    i = _index_of(ctx)
     ctx.set_output("Out", arr[i])
 
 
-@register_op("read_from_array_grad", host=True, no_gradient=True)
+@register_op("read_from_array_grad", no_gradient=True)
 def read_from_array_grad(ctx):
     """Grad of reading slot i: an array of zeros except slot i."""
     arr = ctx.input("X")
     g = ctx.input("Out@GRAD")
-    i = int(np.asarray(raw_data(ctx.input("I"))).reshape(-1)[0])
+    i = _index_of(ctx)
     out = LoDTensorArrayVal(
         jax.tree_util.tree_map(jnp.zeros_like, e) if e is not None else None
         for e in arr)
@@ -144,42 +172,69 @@ def read_from_array_grad(ctx):
     ctx.set_output("X@GRAD", out)
 
 
-@register_op("lod_array_length", host=True, no_gradient=True)
+@register_op("lod_array_length", no_gradient=True)
 def lod_array_length(ctx):
     arr = ctx.input("X")
-    ctx.set_output("Out", jnp.asarray([len(arr)], jnp.int32))
+    ctx.set_output("Out", ConcreteScalar(
+        len(arr), jnp.asarray([len(arr)], jnp.int64)))
 
 
 # ---------------------------------------------------------------------------
-# LoDRankTable family (host) — the dynamic-RNN ragged-batch scheduler
+# LoDRankTable family — the dynamic-RNN ragged-batch scheduler
 # reference: operators/lod_rank_table_op.cc, framework/lod_rank_table.h
 
 class RankTableVal(object):
-    """items: list of (original_seq_index, length), sorted by length desc
-    (stable). reference: framework/lod_rank_table.h."""
+    """Traced rank table: per-sequence lengths and the stable
+    descending-length sort order ride as device arrays, while the trip
+    count (max_len) and sequence/token counts stay static — they come from
+    the feed's LoD signature, which already keys the compile cache.
+    ``items`` keeps the reference's public concrete (seq_index, length)
+    accessor (framework/lod_rank_table.h LoDRankTable::items) for host/user
+    code; it concretises lazily, so it is only usable on the eager path."""
 
-    def __init__(self, items):
-        self.items = items
+    def __init__(self, lengths, order, max_len, total=None):
+        self.lengths = lengths    # [n] per-seq lengths, original order
+        self.order = order        # [n] rank (desc-length, stable) order
+        self.max_len = int(max_len)   # static trip count
+        self.total = total        # static token count (None if unknown)
+        self._items = None
 
     def __len__(self):
-        return len(self.items)
+        return int(self.order.shape[0])
+
+    @property
+    def items(self):
+        if self._items is None:
+            order = np.asarray(self.order)  # concretises: eager path only
+            lens = np.asarray(self.lengths)
+            self._items = [(int(i), int(lens[i])) for i in order]
+        return self._items
 
 
-@register_op("lod_rank_table", host=True, no_gradient=True)
+@register_op("lod_rank_table", no_gradient=True)
 def lod_rank_table(ctx):
     x = ctx.input("X")
     level = int(ctx.attr("level", 0))
-    offs = np.asarray(x.lod[level])
-    lengths = (offs[1:] - offs[:-1]).tolist()
-    items = sorted(enumerate(lengths), key=lambda p: -p[1])
-    ctx.set_output("Out", RankTableVal(items))
+    offs = x.lod[level]
+    lengths = offs[1:] - offs[:-1]
+    # stable sort by descending length (reference lod_rank_table.h)
+    order = jnp.argsort(-lengths)
+    ml = None
+    if x.max_lens and level < len(x.max_lens):
+        ml = x.max_lens[level]
+    if ml is None:
+        # concrete offsets (eager path / host-built LoD): measure directly.
+        # Under jit this raises — feed through LoDTensor so max_lens is set.
+        ml = int(np.max(np.asarray(lengths))) if len(lengths) else 0
+    total = (int(x.data.shape[0]) if level == len(x.lod) - 1 else None)
+    ctx.set_output("Out", RankTableVal(lengths, order, ml, total=total))
 
 
-@register_op("max_sequence_len", host=True, no_gradient=True)
+@register_op("max_sequence_len", no_gradient=True)
 def max_sequence_len(ctx):
     table = ctx.input("RankTable")
-    ml = table.items[0][1] if table.items else 0
-    ctx.set_output("Out", jnp.asarray([ml], jnp.int64))
+    ctx.set_output("Out", ConcreteScalar(
+        table.max_len, jnp.asarray([table.max_len], jnp.int64)))
 
 
 def _lod_array_conv_grad_maker(grad_type):
@@ -197,96 +252,122 @@ def _lod_array_conv_grad_maker(grad_type):
     return maker
 
 
-@register_op("lod_tensor_to_array", host=True,
+def _rank_gather_plan(x, table):
+    """(starts, lens_sorted): per rank-ordered row r, the token offset of
+    sequence order[r] and its length — the whole ragged schedule as two
+    traced [n] vectors."""
+    offs = x.lod[-1]
+    lengths = offs[1:] - offs[:-1]
+    starts = jnp.take(offs, table.order)
+    lens_sorted = jnp.take(lengths, table.order)
+    return starts, lens_sorted
+
+
+def _mask_rows(alive, rows):
+    m = alive.reshape((-1,) + (1,) * (rows.ndim - 1))
+    return jnp.where(m, rows, jnp.zeros((), rows.dtype))
+
+
+@register_op("lod_tensor_to_array",
              grad_maker=_lod_array_conv_grad_maker("lod_tensor_to_array_grad"))
 def lod_tensor_to_array(ctx):
-    """Split ragged x into per-time-step dense tensors ordered by rank table
-    (batch shrinks as short sequences end).
-    reference: operators/lod_tensor_to_array_op.cc."""
+    """Split ragged x into per-time-step tensors in rank-table order.
+    reference: operators/lod_tensor_to_array_op.cc produces shrinking
+    [k_t, F] steps; here every step keeps the fixed capacity [n, F] so the
+    While body stays static-shaped under jit — alive rows are exactly the
+    prefix (descending-length order), dead rows are masked zeros that
+    array_to_lod_tensor never gathers back."""
     x = ctx.input("X")
     table = ctx.input("RankTable")
-    data = np.asarray(raw_data(x))
-    offs = np.asarray(x.lod[-1])
-    T = table.items[0][1] if table.items else 0
+    data = raw_data(x)
+    starts, lens_sorted = _rank_gather_plan(x, table)
+    hi = max(int(data.shape[0]) - 1, 0)
     steps = LoDTensorArrayVal()
-    for t in range(T):
-        rows = [offs[idx] + t for idx, ln in table.items if ln > t]
-        steps.append(jnp.asarray(data[np.asarray(rows, np.int64)]))
+    for t in range(table.max_len):
+        idx = jnp.clip(starts + t, 0, hi)
+        alive = lens_sorted > t
+        steps.append(_mask_rows(alive, jnp.take(data, idx, axis=0)))
     arr, name = _array_of(ctx, "Out")
     arr[:] = steps
     ctx.env[name] = arr
 
 
-@register_op("lod_tensor_to_array_grad", host=True, no_gradient=True)
+@register_op("lod_tensor_to_array_grad", no_gradient=True)
 def lod_tensor_to_array_grad(ctx):
-    """Scatter per-step cotangents back to the concat LoD layout."""
+    """Scatter per-step cotangents back to the concat LoD layout (dead rows
+    masked out; their clipped indices then add zero)."""
     x = ctx.input("X")
     table = ctx.input("RankTable")
     arr_g = ctx.input("Out@GRAD")
     data = raw_data(x)
-    offs = np.asarray(x.lod[-1])
+    starts, lens_sorted = _rank_gather_plan(x, table)
+    hi = max(int(data.shape[0]) - 1, 0)
     out = jnp.zeros_like(data)
     for t, step_g in enumerate(arr_g):
         if step_g is None:
             continue
-        rows = np.asarray([offs[idx] + t for idx, ln in table.items
-                           if ln > t], np.int32)
-        out = out.at[rows].add(raw_data(step_g))
+        idx = jnp.clip(starts + t, 0, hi)
+        out = out.at[idx].add(
+            _mask_rows(lens_sorted > t, raw_data(step_g)).astype(out.dtype))
     ctx.set_output("X@GRAD", with_lod_of(x, out))
 
 
-@register_op("array_to_lod_tensor", host=True,
+def _array_total_tokens(table, arr):
+    if table.total is not None:
+        return table.total
+    # eager fallback: concretise the lengths
+    return int(np.sum(np.asarray(table.lengths)))
+
+
+def _array_token_plan(table, total):
+    """For each output token j (original sequence order): its time step t_j
+    and its rank-ordered row r_j — traced index vectors of static length."""
+    lengths = table.lengths
+    offs = jnp.concatenate([jnp.zeros((1,), lengths.dtype),
+                            jnp.cumsum(lengths)])
+    j = jnp.arange(total)
+    s = jnp.searchsorted(offs, j, side="right") - 1   # original seq index
+    t = j - jnp.take(offs, s)                         # position within seq
+    inv = jnp.argsort(table.order)                    # seq -> rank row
+    r = jnp.take(inv, s)
+    return t, r, offs
+
+
+@register_op("array_to_lod_tensor",
              grad_maker=_lod_array_conv_grad_maker("array_to_lod_tensor_grad"))
 def array_to_lod_tensor(ctx):
-    """Inverse of lod_tensor_to_array. reference:
-    operators/array_to_lod_tensor_op.cc."""
+    """Inverse of lod_tensor_to_array: gather [T, n, F] fixed-capacity steps
+    back to the ragged concat layout, original sequence order.
+    reference: operators/array_to_lod_tensor_op.cc."""
     arr = ctx.input("X")
     table = ctx.input("RankTable")
-    n = len(table.items)
-    lengths_sorted = [ln for _, ln in table.items]
-    feat = arr[0].shape[1:] if arr else ()
-    dtype = arr[0].dtype if arr else jnp.float32
-    seqs = [[] for _ in range(n)]
-    for t, step in enumerate(arr):
-        step = np.asarray(step)
-        alive = [k for k in range(n) if lengths_sorted[k] > t]
-        for row, k in enumerate(alive):
-            seqs[k].append(step[row])
-    # un-sort back to original sequence order
-    out_seqs = [None] * n
-    for k, (orig_idx, _) in enumerate(table.items):
-        out_seqs[orig_idx] = np.stack(seqs[k]) if seqs[k] else \
-            np.zeros((0,) + feat, dtype)
-    data = np.concatenate(out_seqs, axis=0)
-    lengths = [len(s) for s in out_seqs]
-    offs = np.concatenate([[0], np.cumsum(lengths)]).astype(np.int32)
-    ctx.set_output("Out", TracedLoD(jnp.asarray(data), (jnp.asarray(offs),),
-                                    max_lens=(max(lengths) if lengths else 0,)))
+    if not arr:  # all sequences empty: zero-token output
+        n = len(table)
+        ctx.set_output("Out", TracedLoD(
+            jnp.zeros((0,), jnp.float32),
+            (jnp.zeros((n + 1,), jnp.int32),), max_lens=(0,)))
+        return
+    total = _array_total_tokens(table, arr)
+    stacked = jnp.stack([raw_data(v) for v in arr])   # [T, n, F]
+    t_idx, r_idx, offs = _array_token_plan(table, total)
+    data = stacked[t_idx, r_idx]
+    ctx.set_output("Out", TracedLoD(data, (offs.astype(jnp.int32),),
+                                    max_lens=(table.max_len,)))
 
 
-@register_op("array_to_lod_tensor_grad", host=True, no_gradient=True)
+@register_op("array_to_lod_tensor_grad", no_gradient=True)
 def array_to_lod_tensor_grad(ctx):
-    """Split the concat cotangent back into per-step arrays (inverse of the
-    forward gather, rank-table ordered)."""
+    """Scatter the concat cotangent back into per-step [n, F] arrays."""
     x_arr = ctx.input("X")
     table = ctx.input("RankTable")
     g = raw_data(ctx.input("Out@GRAD"))
-    g = np.asarray(g)
-    n = len(table.items)
-    lengths_sorted = [ln for _, ln in table.items]
-    # original-order sequence starts in the concat grad
-    lengths_orig = [0] * n
-    for k, (orig_idx, ln) in enumerate(table.items):
-        lengths_orig[orig_idx] = ln
-    starts = np.concatenate([[0], np.cumsum(lengths_orig)])[:-1]
-    out = LoDTensorArrayVal()
+    total = int(g.shape[0])
     T = len(x_arr)
-    for t in range(T):
-        alive = [k for k in range(n) if lengths_sorted[k] > t]
-        rows = [g[starts[table.items[k][0]] + t] for k in alive]
-        out.append(jnp.asarray(np.stack(rows)) if rows else
-                   jnp.zeros((0,) + g.shape[1:], g.dtype))
-    ctx.set_output("X@GRAD", out)
+    n = len(table)
+    t_idx, r_idx, _ = _array_token_plan(table, total)
+    buf = jnp.zeros((T, n) + tuple(g.shape[1:]), g.dtype)
+    buf = buf.at[t_idx, r_idx].add(g)
+    ctx.set_output("X@GRAD", LoDTensorArrayVal(buf[t] for t in range(T)))
 
 
 def _shrink_memory_grad_maker(op, block, grad_of, no_grad):
@@ -301,55 +382,73 @@ def _shrink_memory_grad_maker(op, block, grad_of, no_grad):
              {"X@GRAD": [grad_var_name(x_name)]}, {})]
 
 
-@register_op("shrink_rnn_memory_grad", host=True, no_gradient=True)
+@register_op("shrink_rnn_memory_grad", no_gradient=True)
 def shrink_rnn_memory_grad(ctx):
     x = raw_data(ctx.input("X"))
     g = raw_data(ctx.input("Out@GRAD"))
     k = g.shape[0]
+    if k == x.shape[0]:
+        ctx.set_output("X@GRAD", g)
+        return
     pad = jnp.zeros((x.shape[0] - k,) + x.shape[1:], x.dtype)
     ctx.set_output("X@GRAD", jnp.concatenate([g, pad], axis=0))
 
 
-@register_op("shrink_rnn_memory", host=True,
-             grad_maker=_shrink_memory_grad_maker)
+@register_op("shrink_rnn_memory", grad_maker=_shrink_memory_grad_maker)
 def shrink_rnn_memory(ctx):
-    """Keep the first k rows of memory where k = #sequences still alive at
-    step i. reference: operators/shrink_rnn_memory_op.cc."""
-    x = raw_data(ctx.input("X"))
-    i = int(np.asarray(raw_data(ctx.input("I"))).reshape(-1)[0])
-    table = ctx.input("RankTable")
-    k = sum(1 for _, ln in table.items if ln > i)
-    ctx.set_output("Out", x[:k])
+    """reference: operators/shrink_rnn_memory_op.cc keeps the first k rows
+    where k = #sequences alive at step i. Under the fixed-capacity layout
+    every step tensor keeps all n rows (alive rows are the rank-order
+    prefix), so shrink is the identity: rows past k hold stale memory that
+    no later op gathers, and their cotangents are exactly zero.
+
+    Caveat: this matches the reference exactly for per-row step bodies (the
+    DynamicRNN contract). A body op that mixes rows across the batch
+    (batch-mean of the hidden state, batch norm) would see the n-k dead
+    rows too — such reductions inside a ragged DynamicRNN are
+    ill-defined in the reference as well (k changes per step)."""
+    ctx.set_output("Out", raw_data(ctx.input("X")))
 
 
-@register_op("reorder_lod_tensor_by_rank", host=True)
+@register_op("reorder_lod_tensor_by_rank")
 def reorder_lod_tensor_by_rank(ctx):
-    """Permute sequences (or rows for a plain tensor) into rank-table order.
+    """Permute sequences (or rows for a plain tensor) into rank-table order,
+    as a traced token-level gather.
     reference: operators/reorder_lod_tensor_by_rank_op.cc."""
     x = ctx.input("X")
     table = ctx.input("RankTable")
-    order = [idx for idx, _ in table.items]
+    order = table.order
     if isinstance(x, TracedLoD) and x.lod:
-        data = np.asarray(raw_data(x))
-        offs = np.asarray(x.lod[-1])
-        pieces = [data[offs[i]:offs[i + 1]] for i in order]
-        lengths = [len(p) for p in pieces]
-        new_offs = np.concatenate([[0], np.cumsum(lengths)]).astype(np.int32)
-        ctx.set_output("Out", TracedLoD(
-            jnp.asarray(np.concatenate(pieces, axis=0)),
-            (jnp.asarray(new_offs),),
-            max_lens=(max(lengths) if lengths else 0,)))
-    else:
         data = raw_data(x)
-        ctx.set_output("Out", jnp.take(data, jnp.asarray(order), axis=0))
+        offs = x.lod[-1]
+        lengths = offs[1:] - offs[:-1]
+        lens_sorted = jnp.take(lengths, order)
+        new_offs = jnp.concatenate(
+            [jnp.zeros((1,), offs.dtype), jnp.cumsum(lens_sorted)])
+        total = int(data.shape[0])
+        j = jnp.arange(total)
+        r = jnp.searchsorted(new_offs, j, side="right") - 1
+        pos = j - jnp.take(new_offs, r)
+        src = jnp.take(offs, jnp.take(order, r)) + pos
+        ml = x.max_lens[-1] if x.max_lens and x.max_lens[-1] is not None \
+            else table.max_len
+        ctx.set_output("Out", TracedLoD(
+            jnp.take(data, src, axis=0), (new_offs.astype(jnp.int32),),
+            max_lens=(ml,)))
+    else:
+        ctx.set_output("Out", jnp.take(raw_data(x), order, axis=0))
 
 
 # ---------------------------------------------------------------------------
-# While (host loop) — reference: operators/while_op.cc:35
+# While — reference: operators/while_op.cc:35. The reference reads the loop
+# condition on host each iteration; here the condition is a ConcreteScalar
+# chain (force_cpu counter + max_sequence_len), so the same read happens at
+# *trace time* and the loop unrolls into the jitted graph — trip count is
+# static per feed signature (max_lens), which already keys the compile cache.
 
 # Backward (reference: while_op.cc WhileGradOp) is per-iteration jax.vjp over
-# the step block, driven by env snapshots the forward loop saves — BPTT
-# through the interpreter loop.
+# the step block, driven by env snapshots the forward loop saves — BPTT,
+# traced into the same XLA computation on the jit path.
 
 def _sub_reads_writes(sub):
     written, read = [], []
@@ -374,14 +473,27 @@ def _snap_key(op):
     return "@WHILE_SNAP@%d" % id(op)
 
 
-@register_op("while", host=True)
+def _cond_true(env, cond_name):
+    v = env[cond_name]
+    cv = concrete_value(v)
+    if cv is not None:
+        return bool(cv)
+    # eager path: the value is a concrete device array. Under jit tracing a
+    # non-ConcreteScalar condition means the loop bound is data-dependent in
+    # a way tracing can't unroll — jax raises a concretization error here;
+    # route such programs through use_jit=False (the reference interpreter
+    # semantics) or express the bound via the LoD (max_sequence_len).
+    return bool(np.asarray(raw_data(v)).reshape(-1)[0])
+
+
+@register_op("while")
 def while_op(ctx):
     sub = ctx.sub_block()
     cond_name = ctx.op.input("Condition")[0]
     max_iters = int(ctx.attr("max_iters", 10000))
     snaps = []
     it = 0
-    while bool(np.asarray(raw_data(ctx.env[cond_name])).reshape(-1)[0]):
+    while _cond_true(ctx.env, cond_name):
         snaps.append(_snap_env(ctx.env))
         trace_ops(sub, ctx.env, ctx.rng)
         it += 1
@@ -428,7 +540,7 @@ def _while_grad_maker(op, block, grad_of, no_grad):
 registry.lookup_checked("while").grad_maker = _while_grad_maker
 
 
-@register_op("while_grad", host=True, no_gradient=True)
+@register_op("while_grad", no_gradient=True)
 def while_grad(ctx):
     """Reverse sweep: for each forward iteration (latest first), jax.vjp the
     step block as a pure function of its float inputs/carried state.

@@ -12,7 +12,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import registry
-from ..core.executor import TracedLoD, raw_data, with_lod_of
+from ..core.executor import (ConcreteScalar, TracedLoD, concrete_value,
+                             raw_data, with_lod_of)
 from ..core.registry import register_op
 from .common import jdt, prod
 
@@ -32,9 +33,20 @@ def _infer_from_shape_attr(op, block):
 
 @register_op("fill_constant", infer_shape=_infer_from_shape_attr)
 def fill_constant(ctx):
-    ctx.set_output("Out", jnp.full(_shape_attr(ctx),
-                                   ctx.attr("value", 0.0),
-                                   dtype=jdt(ctx.attr("dtype"))))
+    shape = _shape_attr(ctx)
+    dt = jdt(ctx.attr("dtype"))
+    val = ctx.attr("value", 0.0)
+    data = jnp.full(shape, val, dtype=dt)
+    numel = 1
+    for d in shape:
+        numel *= int(d)
+    if numel == 1 and jnp.issubdtype(dt, jnp.integer):
+        # scalar integer fills (loop counters, array bounds) keep their
+        # trace-time value — the analog of the reference's force_cpu
+        # fill_constant that while_op.cc reads on host each iteration
+        ctx.set_output("Out", ConcreteScalar(int(val), data))
+    else:
+        ctx.set_output("Out", data)
 
 
 @register_op("fill_constant_batch_size_like")
@@ -301,10 +313,18 @@ def lookup_table(ctx):
 
 @register_op("increment", stateful_outputs=("Out",))
 def increment(ctx):
-    x = raw_data(ctx.input("X"))
+    xv = ctx.input("X")
+    x = raw_data(xv)
+    step = ctx.attr("step", 1.0)
     # preserve dtype: loop counters must stay integral (reference
     # increment_op casts step to X's type)
-    ctx.set_output("Out", x + jnp.asarray(ctx.attr("step", 1.0), x.dtype))
+    out = x + jnp.asarray(step, x.dtype)
+    cv = concrete_value(xv)
+    if cv is not None:
+        # concrete counters stay concrete — While conditions unroll under jit
+        step = int(step) if isinstance(cv, int) else step
+        out = ConcreteScalar(cv + step, out)
+    ctx.set_output("Out", out)
 
 
 @register_op("is_empty", no_gradient=True)

@@ -97,6 +97,10 @@ def test_label_semantic_roles():
         if i >= 15:
             break
     assert np.isfinite(costs).all()
+    # whole train step (8 embeddings + 4 stacked lstm scans + CRF) is one
+    # jitted XLA computation — no eager fallback
+    assert exe.stats["jit_runs"] > 0 and exe.stats["eager_runs"] == 0, \
+        exe.stats
     assert np.mean(costs[-3:]) < np.mean(costs[:3]), costs
     # decoded path aligns with the token stream
     assert np.asarray(path.numpy()).shape[1] == 1

@@ -115,6 +115,10 @@ def test_train():
             break
     assert np.isfinite(costs).all()
     assert np.mean(costs[-3:]) < np.mean(costs[:3]), costs
+    # the DynamicRNN While/rank-table program must jit-compile (trace-time
+    # unrolled), not fall back to the per-op interpreter path
+    assert exe.stats["jit_runs"] > 0 and exe.stats["eager_runs"] == 0, \
+        exe.stats
 
 
 def test_decode():
