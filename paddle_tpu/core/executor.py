@@ -317,6 +317,12 @@ def _to_device_value(v, device=None):
         return data
     if isinstance(v, TracedLoD):
         return v
+    if isinstance(v, jax.Array):
+        # already device-resident (prepare_feed / previous fetch): device_put
+        # of a committed array is a no-op. Round-tripping through np.asarray
+        # here would force a device->host transfer per step — catastrophic
+        # over a tunneled TPU (10s/step class, not microseconds).
+        return jax.device_put(v, device) if device is not None else v
     return jax.device_put(np.asarray(v), device)
 
 
@@ -443,13 +449,19 @@ class Executor(object):
             except (jax.errors.ConcretizationTypeError,
                     jax.errors.TracerArrayConversionError,
                     jax.errors.TracerBoolConversionError,
-                    jax.errors.TracerIntegerConversionError):
+                    jax.errors.TracerIntegerConversionError) as e:
                 # genuinely data-dependent control flow (a While condition /
                 # array index computed from fed data, not a ConcreteScalar
                 # counter chain): tracing can't unroll it. Fall back to the
                 # reference's per-op interpreter semantics for this program.
                 if repeat != 1:
                     raise
+                import warnings
+                warnings.warn(
+                    "program %d hit data-dependent control flow during jit "
+                    "tracing and will run on the per-op interpreter path "
+                    "from now on (10-100x slower on TPU). Cause: %s"
+                    % (program._uid, str(e).splitlines()[0]), RuntimeWarning)
                 self._force_eager.add(program._uid)
                 self.stats["eager_runs"] += 1
                 outs = self._run_eager(program, dev_feed, fetch_names, scope)
@@ -510,7 +522,12 @@ class Executor(object):
                                repeat=repeat)
             self._cache[key] = fn
         rng_key = self._rng_key(program, scope)
-        fetches, new_state, new_key = fn(state, feed, rng_key)
+        try:
+            fetches, new_state, new_key = fn(state, feed, rng_key)
+        except Exception:
+            # a failed first trace must not leave a dead compiled fn cached
+            self._cache.pop(key, None)
+            raise
         for n, v in new_state.items():
             scope.set_var(n, v)
         scope.set_var(RNG_VAR, new_key)
@@ -542,11 +559,17 @@ class Executor(object):
             rng = RngSource(rng_key)
             trace_ops(block, env, rng, value_hook)
             # every state input passes through (unwritten entries alias their
-            # donated input buffer; written ones carry the update)
-            new_state = {n: env[n] for n in state_names}
+            # donated input buffer; written ones carry the update). Persisted
+            # state must not hold ConcreteScalar: its python value is pytree
+            # *aux* data, so a changing counter would re-specialise (retrace
+            # + recompile) the whole step every run.
+            new_state = {n: raw_data(env[n]) if isinstance(
+                env[n], ConcreteScalar) else env[n] for n in state_names}
             for n in extra_out:
                 if n in env:
-                    new_state[n] = env[n]
+                    v = env[n]
+                    new_state[n] = raw_data(v) if isinstance(
+                        v, ConcreteScalar) else v
             fetches = [env[n] for n in fetch_names]
             return fetches, new_state, rng.key
 
@@ -599,7 +622,9 @@ class Executor(object):
         persist = self._persistable_names(program)
         for n, v in env.items():
             if n in persist:
-                scope.set_var(n, v)
+                # scope never holds ConcreteScalar (see one_step new_state)
+                scope.set_var(n, raw_data(v) if isinstance(v, ConcreteScalar)
+                              else v)
         scope.set_var(RNG_VAR, rng_key)
 
     def close(self):

@@ -175,8 +175,9 @@ def read_from_array_grad(ctx):
 @register_op("lod_array_length", no_gradient=True)
 def lod_array_length(ctx):
     arr = ctx.input("X")
+    # int32 array form (x64 is disabled); host consumers read the python int
     ctx.set_output("Out", ConcreteScalar(
-        len(arr), jnp.asarray([len(arr)], jnp.int64)))
+        len(arr), jnp.asarray([len(arr)], jnp.int32)))
 
 
 # ---------------------------------------------------------------------------
@@ -234,7 +235,7 @@ def lod_rank_table(ctx):
 def max_sequence_len(ctx):
     table = ctx.input("RankTable")
     ctx.set_output("Out", ConcreteScalar(
-        table.max_len, jnp.asarray([table.max_len], jnp.int64)))
+        table.max_len, jnp.asarray([table.max_len], jnp.int32)))
 
 
 def _lod_array_conv_grad_maker(grad_type):
@@ -250,6 +251,14 @@ def _lod_array_conv_grad_maker(grad_type):
                   "Out@GRAD": [g]},
                  {"X@GRAD": [grad_var_name(x_name)]}, {})]
     return maker
+
+
+def _under_trace(table):
+    """True when the rank table's arrays are jit tracers (compile path);
+    False on the eager interpreter path, where the reference's true
+    dynamic-shape semantics (shrinking [k_t, F] steps) are preserved."""
+    return isinstance(table.lengths, jax.core.Tracer) or \
+        isinstance(table.order, jax.core.Tracer)
 
 
 def _rank_gather_plan(x, table):
@@ -280,13 +289,22 @@ def lod_tensor_to_array(ctx):
     x = ctx.input("X")
     table = ctx.input("RankTable")
     data = raw_data(x)
-    starts, lens_sorted = _rank_gather_plan(x, table)
-    hi = max(int(data.shape[0]) - 1, 0)
-    steps = LoDTensorArrayVal()
-    for t in range(table.max_len):
-        idx = jnp.clip(starts + t, 0, hi)
-        alive = lens_sorted > t
-        steps.append(_mask_rows(alive, jnp.take(data, idx, axis=0)))
+    if not _under_trace(table):
+        # eager interpreter: reference dynamic shapes ([k_t, F] steps)
+        offs = np.asarray(x.lod[-1])
+        steps = LoDTensorArrayVal()
+        for t in range(table.max_len):
+            rows = [offs[idx] + t for idx, ln in table.items if ln > t]
+            steps.append(jnp.take(data, jnp.asarray(rows, jnp.int32),
+                                  axis=0))
+    else:
+        starts, lens_sorted = _rank_gather_plan(x, table)
+        hi = max(int(data.shape[0]) - 1, 0)
+        steps = LoDTensorArrayVal()
+        for t in range(table.max_len):
+            idx = jnp.clip(starts + t, 0, hi)
+            alive = lens_sorted > t
+            steps.append(_mask_rows(alive, jnp.take(data, idx, axis=0)))
     arr, name = _array_of(ctx, "Out")
     arr[:] = steps
     ctx.env[name] = arr
@@ -300,15 +318,25 @@ def lod_tensor_to_array_grad(ctx):
     table = ctx.input("RankTable")
     arr_g = ctx.input("Out@GRAD")
     data = raw_data(x)
-    starts, lens_sorted = _rank_gather_plan(x, table)
-    hi = max(int(data.shape[0]) - 1, 0)
     out = jnp.zeros_like(data)
-    for t, step_g in enumerate(arr_g):
-        if step_g is None:
-            continue
-        idx = jnp.clip(starts + t, 0, hi)
-        out = out.at[idx].add(
-            _mask_rows(lens_sorted > t, raw_data(step_g)).astype(out.dtype))
+    if not _under_trace(table):
+        offs = np.asarray(x.lod[-1])
+        for t, step_g in enumerate(arr_g):
+            if step_g is None:
+                continue
+            rows = np.asarray([offs[idx] + t for idx, ln in table.items
+                               if ln > t], np.int32)
+            out = out.at[rows].add(raw_data(step_g)[:len(rows)]
+                                   .astype(out.dtype))
+    else:
+        starts, lens_sorted = _rank_gather_plan(x, table)
+        hi = max(int(data.shape[0]) - 1, 0)
+        for t, step_g in enumerate(arr_g):
+            if step_g is None:
+                continue
+            idx = jnp.clip(starts + t, 0, hi)
+            out = out.at[idx].add(_mask_rows(
+                lens_sorted > t, raw_data(step_g)).astype(out.dtype))
     ctx.set_output("X@GRAD", with_lod_of(x, out))
 
 
@@ -347,6 +375,30 @@ def array_to_lod_tensor(ctx):
             jnp.zeros((0,), jnp.float32),
             (jnp.zeros((n + 1,), jnp.int32),), max_lens=(0,)))
         return
+    if not _under_trace(table):
+        # eager interpreter: steps carry true shrinking [k_t, F] shapes
+        n = len(table)
+        lengths_sorted = [ln for _, ln in table.items]
+        seqs = [[] for _ in range(n)]
+        for t, step in enumerate(arr):
+            step = np.asarray(raw_data(step))
+            alive = [k for k in range(n) if lengths_sorted[k] > t]
+            for row, k in enumerate(alive):
+                if row < step.shape[0]:
+                    seqs[k].append(step[row])
+        feat = np.asarray(raw_data(arr[0])).shape[1:]
+        dtype = np.asarray(raw_data(arr[0])).dtype
+        out_seqs = [None] * n
+        for k, (orig_idx, _) in enumerate(table.items):
+            out_seqs[orig_idx] = (np.stack(seqs[k]) if seqs[k]
+                                  else np.zeros((0,) + feat, dtype))
+        data = np.concatenate(out_seqs, axis=0)
+        lengths = [len(s) for s in out_seqs]
+        offs = np.concatenate([[0], np.cumsum(lengths)]).astype(np.int32)
+        ctx.set_output("Out", TracedLoD(
+            jnp.asarray(data), (jnp.asarray(offs),),
+            max_lens=(max(lengths) if lengths else 0,)))
+        return
     total = _array_total_tokens(table, arr)
     stacked = jnp.stack([raw_data(v) for v in arr])   # [T, n, F]
     t_idx, r_idx, offs = _array_token_plan(table, total)
@@ -364,6 +416,22 @@ def array_to_lod_tensor_grad(ctx):
     total = int(g.shape[0])
     T = len(x_arr)
     n = len(table)
+    if not _under_trace(table):
+        # eager: per-step [k_t, F] cotangents matching the forward shapes
+        gh = np.asarray(g)
+        lengths_sorted = [ln for _, ln in table.items]
+        lengths_orig = [0] * n
+        for orig_idx, ln in table.items:
+            lengths_orig[orig_idx] = ln
+        starts = np.concatenate([[0], np.cumsum(lengths_orig)])[:-1]
+        out = LoDTensorArrayVal()
+        for t in range(T):
+            alive = [k for k in range(n) if lengths_sorted[k] > t]
+            rows = [gh[starts[table.items[k][0]] + t] for k in alive]
+            out.append(jnp.asarray(np.stack(rows)) if rows else
+                       jnp.zeros((0,) + gh.shape[1:], gh.dtype))
+        ctx.set_output("X@GRAD", out)
+        return
     t_idx, r_idx, _ = _array_token_plan(table, total)
     buf = jnp.zeros((T, n) + tuple(g.shape[1:]), g.dtype)
     buf = buf.at[t_idx, r_idx].add(g)
@@ -402,12 +470,20 @@ def shrink_rnn_memory(ctx):
     prefix), so shrink is the identity: rows past k hold stale memory that
     no later op gathers, and their cotangents are exactly zero.
 
-    Caveat: this matches the reference exactly for per-row step bodies (the
-    DynamicRNN contract). A body op that mixes rows across the batch
-    (batch-mean of the hidden state, batch norm) would see the n-k dead
-    rows too — such reductions inside a ragged DynamicRNN are
-    ill-defined in the reference as well (k changes per step)."""
-    ctx.set_output("Out", raw_data(ctx.input("X")))
+    Jit caveat: the identity matches the reference exactly for per-row step
+    bodies (the DynamicRNN contract); a body op that mixes rows across the
+    batch (batch-mean of the hidden state) would see the n-k dead rows too.
+    The eager interpreter path below performs the true shrink, so such
+    programs keep reference semantics via use_jit=False / the automatic
+    data-dependent fallback."""
+    x = raw_data(ctx.input("X"))
+    table = ctx.input("RankTable")
+    if not _under_trace(table) and not isinstance(x, jax.core.Tracer):
+        i = _index_of(ctx)
+        k = sum(1 for _, ln in table.items if ln > i)
+        ctx.set_output("Out", x[:k])
+        return
+    ctx.set_output("Out", x)
 
 
 @register_op("reorder_lod_tensor_by_rank")

@@ -301,8 +301,11 @@ def conv2d_grad(ctx):
     want_dw = bool(ctx.op.output("Filter@GRAD"))
     acc = jnp.float32
 
-    if groups != 1 or tuple(d) != (1, 1):
-        # rare shape: defer to XLA's conv transpose rules via a compact vjp
+    from .nn_ops import conv_impl
+    if groups != 1 or tuple(d) != (1, 1) or conv_impl() != "matmul":
+        # native-conv mode (and rare shapes): XLA's conv transpose rules via
+        # a vjp over the single lax.conv primitive — the re-traced forward
+        # is one primitive that XLA CSEs with the real forward
         def f(x_, w_):
             return jax.lax.conv_general_dilated(
                 x_, w_, window_strides=tuple(s),

@@ -216,14 +216,20 @@ def _infer_conv2d(op, block):
     ov.dtype = xv.dtype
 
 
+def conv_impl():
+    """Which dense-conv lowering to use: 'conv' = lax.conv_general_dilated
+    (XLA:TPU's native conv->MXU path, the default) or 'matmul' = KH*KW
+    shifted einsums (the im2col+gemm role of reference
+    operators/math/im2col.* + conv_op.h GemmConvKernel). bench.py autotunes
+    this on the real device and pins PADDLE_TPU_CONV_IMPL."""
+    import os
+    return os.environ.get("PADDLE_TPU_CONV_IMPL", "conv")
+
+
 def _conv_shifted_matmul(x, w, s, p):
     """Convolution as KH*KW shifted einsums — each one a clean MXU matmul.
-
-    On this TPU stack lax.conv's emitter reaches only a few TFLOP/s while
-    dot_general hits near peak; decomposing the conv into per-tap matmuls
-    (the role the reference's im2col + gemm plays on CUDA,
-    operators/math/im2col.* + conv_op.h GemmConvKernel) recovers ~5x. Same
-    FLOPs, same math; XLA fuses the adds."""
+    Same FLOPs as the native conv; XLA fuses the adds. Kept selectable for
+    stacks where the conv emitter underperforms dot_general."""
     B, C, H, W = x.shape
     O, _, KH, KW = w.shape
     xp = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
@@ -258,7 +264,7 @@ def conv2d(ctx):
     p = ctx.attr("paddings", [0, 0])
     d = ctx.attr("dilations", [1, 1])
     groups = ctx.attr("groups", 1) or 1
-    if groups == 1 and tuple(d) == (1, 1):
+    if groups == 1 and tuple(d) == (1, 1) and conv_impl() == "matmul":
         out = _conv_shifted_matmul(x, w, s, p)
     else:
         # under AMP the conv stays uniformly bf16 (the conv transpose rule

@@ -297,3 +297,23 @@ def test_while_data_dependent_falls_back_eager():
     # second run goes straight to the eager path (program remembered)
     r, = exe.run(feed={"n": np.asarray([3], np.int64)}, fetch_list=[total])
     assert float(np.asarray(r).reshape(-1)[0]) == 3.0
+
+
+def test_concrete_counter_not_persisted():
+    """A persistable int counter (autoincreased_step_counter pattern) must be
+    written back to the scope as a plain array, not a ConcreteScalar — a
+    concrete value in jitted state is pytree aux data, so a changing counter
+    would force a full retrace+recompile every step."""
+    from paddle_tpu.core.executor import ConcreteScalar
+    layers = fluid.layers
+    step = layers.create_global_var(shape=[1], value=0, dtype="int64",
+                                    persistable=True, name="step_counter")
+    layers.increment(x=step, value=1.0, in_place=True)
+    out = layers.scale(step, scale=1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    for _ in range(3):
+        r, = exe.run(feed={}, fetch_list=[out])
+    v = fluid.global_scope().find_var("step_counter")
+    assert not isinstance(v, ConcreteScalar), type(v)
+    assert int(np.asarray(v).reshape(-1)[0]) == 3
