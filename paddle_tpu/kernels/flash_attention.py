@@ -1,13 +1,22 @@
-"""Flash attention (forward) as a Pallas TPU kernel.
+"""Flash attention (forward + backward) as Pallas TPU kernels.
 
-Streams k/v blocks through VMEM against a resident q block, maintaining the
-online-softmax (running max / numerator / denominator) decomposition, so the
-[S, S] score matrix never materialises in HBM — the single-chip sibling of
-parallel/ring.py's cross-chip ring (same math, different memory wall).
+Forward streams k/v blocks through VMEM against a resident q block,
+maintaining the online-softmax (running max / numerator / denominator)
+decomposition, and emits the per-row logsumexp — so the [S, S] score matrix
+never materialises in HBM. Backward is the FlashAttention-2 recompute
+scheme as two Pallas kernels: a dK/dV kernel (grid over k blocks, loop over
+q blocks) and a dQ kernel (grid over q blocks, loop over k blocks); every
+score/probability tile lives only as a [block_q, block_k] VMEM tile.
 
-Backward is recompute-based (jax.custom_vjp over the dense reference
-implementation) — standard flash practice: recompute beats storing S²
-activations; a dedicated Pallas backward is a later optimisation.
+Ragged sequence lengths (S % 128 != 0) are handled by padding to the block
+size and masking padded k positions inside the kernels; padded q rows are
+sliced off (and contribute exactly zero to dK/dV because their dO rows are
+zero-padded).
+
+The logsumexp output is what lets parallel/ring.py chain per-ring-step
+flash calls with the numerically exact merge
+``o = (o_a * exp(lse_a - lse) + o_b * exp(lse_b - lse))`` — gradients flow
+through both o and lse (the dlse term folds into the backward's delta).
 
 No reference equivalent (attention postdates the 2018 codebase); this is a
 capability the TPU build adds, used by nets.scaled_dot_product_attention.
@@ -21,6 +30,7 @@ import jax.numpy as jnp
 
 BLOCK_Q = 128
 BLOCK_K = 128
+NEG_INF = -1e30
 
 
 def _dense_reference(q, k, v, causal, scale):
@@ -33,27 +43,42 @@ def _dense_reference(q, k, v, causal, scale):
     return jnp.einsum("bqk,bkd->bqd", p, v)
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, scale, block_k,
-               seq_len):
+# ---------------------------------------------------------------------------
+# forward kernel: one q block vs streamed k/v blocks -> o block + lse rows
+
+def _masked_scores(q, k_blk, q_start, k_start, *, causal, scale, valid_len,
+                   kv_len):
+    """Scaled q@k^T tile with the causal and padded-k masks applied — the
+    single source of masking truth shared by forward and both backward
+    kernels (they must never disagree)."""
+    s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+    bq, bk = s.shape
+    kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    if causal:
+        qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+    if valid_len < kv_len:
+        s = jnp.where(kpos < valid_len, s, NEG_INF)
+    return s
+
+
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, causal, scale, block_k,
+               kv_len, valid_len):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)              # [BLOCK_Q, D]
     bq, d = q.shape
-    n_k = seq_len // block_k
+    n_k = kv_len // block_k
 
     def body(ki, acc):
         m, num, den = acc
         k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
         v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k_blk.T,
-                    preferred_element_type=jnp.float32) * scale
-        if causal:
-            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32,
-                                                      (bq, block_k), 0)
-            kpos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, -1e30)
+        s = _masked_scores(q, k_blk, qi * bq, ki * block_k, causal=causal,
+                           scale=scale, valid_len=valid_len, kv_len=kv_len)
         blk_max = jnp.max(s, axis=-1)
         new_m = jnp.maximum(m, blk_max)
         p = jnp.exp(s - new_m[:, None])
@@ -63,38 +88,174 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, scale, block_k,
         den = den * alpha + jnp.sum(p, axis=-1)
         return new_m, num, den
 
-    m0 = jnp.full((bq,), -1e30, jnp.float32)
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
     num0 = jnp.zeros((bq, d), jnp.float32)
     den0 = jnp.zeros((bq,), jnp.float32)
     if causal and bq == block_k:
         # blocks strictly above the diagonal contribute nothing
         n_k = qi + 1
     m, num, den = jax.lax.fori_loop(0, n_k, body, (m0, num0, den0))
-    o_ref[0] = (num / jnp.maximum(den[:, None], 1e-20)).astype(o_ref.dtype)
+    den_safe = jnp.maximum(den, 1e-20)
+    o_ref[0] = (num / den_safe[:, None]).astype(o_ref.dtype)
+    l_ref[0] = (m + jnp.log(den_safe)).astype(jnp.float32)
 
 
-def _fa_forward(q3, k3, v3, causal, scale, interpret):
-    """q3/k3/v3: [BH, S, D] -> [BH, S, D]."""
+def _fa_forward(q3, k3, v3, causal, scale, valid_len, interpret):
+    """q3 [BH, Sq, D], k3/v3 [BH, Sk, D] -> (o [BH, Sq, D], lse [BH, Sq]).
+    Sq may differ from Sk (ring-attention block chaining); causal requires
+    Sq == Sk (aligned positions)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu  # noqa: F401
 
-    BH, S, D = q3.shape
-    block_q = min(BLOCK_Q, S)
-    block_k = min(BLOCK_K, S)
+    BH, Sq, D = q3.shape
+    Sk = k3.shape[1]
+    block_q = min(BLOCK_Q, Sq)
+    block_k = min(BLOCK_K, Sk)
     kernel = functools.partial(_fa_kernel, causal=causal, scale=scale,
-                               block_k=block_k, seq_len=S)
+                               block_k=block_k, kv_len=Sk,
+                               valid_len=valid_len)
     return pl.pallas_call(
         kernel,
-        grid=(BH, S // block_q),
+        grid=(BH, Sq // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sq, D), q3.dtype),
+            jax.ShapeDtypeStruct((BH, Sq), jnp.float32),
+        ],
         interpret=interpret,
     )(q3, k3, v3)
+
+
+# ---------------------------------------------------------------------------
+# backward kernels (FlashAttention-2 recompute scheme)
+#
+# With p = exp(s - lse):  dv = p^T dO;  dp = dO v^T;
+# ds = p * (dp - delta) * scale where delta = rowsum(dO * o) - dlse;
+# dq = ds k;  dk = ds^T q.  All tiles [block_q, block_k] in VMEM.
+
+
+def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, dl_ref,
+                       dk_ref, dv_ref, *, causal, scale, block_q,
+                       q_len, kv_len, valid_len):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    k_blk = k_ref[0].astype(jnp.float32)          # [BLOCK_K, D]
+    v_blk = v_ref[0].astype(jnp.float32)
+    bk, d = k_blk.shape
+    n_q = q_len // block_q
+
+    def body(qi, acc):
+        dk, dv = acc
+        q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        lse = l_ref[0, pl.ds(qi * block_q, block_q)]
+        delta = dl_ref[0, pl.ds(qi * block_q, block_q)]
+        s = _masked_scores(q, k_blk, qi * block_q, ki * bk, causal=causal,
+                           scale=scale, valid_len=valid_len, kv_len=kv_len)
+        p = jnp.exp(s - lse[:, None])
+        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    start = (ki * bk) // block_q if (causal and bk == block_q) else 0
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, n_q, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, dl_ref,
+                      dq_ref, *, causal, scale, block_k, kv_len,
+                      valid_len):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)              # [BLOCK_Q, D]
+    do = do_ref[0].astype(jnp.float32)
+    lse = l_ref[0]
+    delta = dl_ref[0]
+    bq, d = q.shape
+    n_k = kv_len // block_k
+
+    def body(ki, dq):
+        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = _masked_scores(q, k_blk, qi * bq, ki * block_k, causal=causal,
+                           scale=scale, valid_len=valid_len, kv_len=kv_len)
+        p = jnp.exp(s - lse[:, None])
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+
+    if causal and bq == block_k:
+        n_k = qi + 1
+    dq = jax.lax.fori_loop(0, n_k, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _fa_backward(q3, k3, v3, do3, lse, delta, causal, scale, valid_len,
+                 interpret):
+    from jax.experimental import pallas as pl
+
+    BH, Sq, D = q3.shape
+    Sk = k3.shape[1]
+    block_q = min(BLOCK_Q, Sq)
+    block_k = min(BLOCK_K, Sk)
+    dkv = pl.pallas_call(
+        functools.partial(_fa_bwd_dkv_kernel, causal=causal, scale=scale,
+                          block_q=block_q, q_len=Sq, kv_len=Sk,
+                          valid_len=valid_len),
+        grid=(BH, Sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, Sq, D), lambda b, i: (b, 0, 0)),     # q
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),  # k blk
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),  # v blk
+            pl.BlockSpec((1, Sq, D), lambda b, i: (b, 0, 0)),     # do
+            pl.BlockSpec((1, Sq), lambda b, i: (b, 0)),           # lse
+            pl.BlockSpec((1, Sq), lambda b, i: (b, 0)),           # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sk, D), k3.dtype),
+            jax.ShapeDtypeStruct((BH, Sk, D), v3.dtype),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+    dq = pl.pallas_call(
+        functools.partial(_fa_bwd_dq_kernel, causal=causal, scale=scale,
+                          block_k=block_k, kv_len=Sk, valid_len=valid_len),
+        grid=(BH, Sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # q blk
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),     # k
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),     # v
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # do blk
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),      # lse
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),      # delta
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q3.dtype),
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dkv[0], dkv[1]
+
+
+# ---------------------------------------------------------------------------
 
 
 def _on_tpu():
@@ -104,44 +265,69 @@ def _on_tpu():
     return _amp_on_tpu()
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash(q3, k3, v3, causal, scale):
-    return _fa_forward(q3, k3, v3, causal, scale, interpret=not _on_tpu())
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q3, k3, v3, causal, scale, valid_len):
+    """[BH, S, D] x3 -> (o [BH, S, D], lse [BH, S]); S % block == 0."""
+    return _fa_forward(q3, k3, v3, causal, scale, valid_len,
+                       interpret=not _on_tpu())
 
 
-def _flash_fwd(q3, k3, v3, causal, scale):
-    return _flash(q3, k3, v3, causal, scale), (q3, k3, v3)
+def _flash_fwd(q3, k3, v3, causal, scale, valid_len):
+    o, lse = _flash(q3, k3, v3, causal, scale, valid_len)
+    return (o, lse), (q3, k3, v3, o, lse)
 
 
-def _flash_bwd(causal, scale, res, g):
-    q3, k3, v3 = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: _dense_reference(q, k, v, causal, scale),
-        q3, k3, v3)
-    return vjp(g)
+def _flash_bwd(causal, scale, valid_len, res, cots):
+    q3, k3, v3, o, lse = res
+    do3, dlse = cots
+    # delta folds the lse cotangent: ds = p * (dp - rowsum(do*o) + dlse)
+    delta = jnp.einsum("bsd,bsd->bs", do3.astype(jnp.float32),
+                       o.astype(jnp.float32))
+    if dlse is not None:
+        delta = delta - dlse
+    dq, dk, dv = _fa_backward(q3, k3, v3, do3, lse, delta, causal, scale,
+                              valid_len, interpret=not _on_tpu())
+    return dq, dk, dv
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _pad_seq(x, S_pad):
+    B, S, H, D = x.shape
+    if S == S_pad:
+        return x
+    return jnp.pad(x, ((0, 0), (0, S_pad - S), (0, 0), (0, 0)))
+
+
+def flash_attention_with_lse(q, k, v, causal=False, scale=None):
+    """q/k/v: [batch, seq, heads, dim] -> (out [B, S, H, D], lse [B, H, S]).
+
+    Any sequence length: S pads up to the 128-wide block internally; padded
+    k positions are masked inside the kernels and padded q rows sliced off.
+    The lse output makes per-block results mergeable (ring attention).
+    """
+    B, S, H, D = q.shape
+    Sk = k.shape[1]
+    if causal and S != Sk:
+        raise ValueError("causal flash attention needs q/k aligned lengths")
+    scale = scale if scale is not None else D ** -0.5
+    bq = min(BLOCK_Q, max(S, 1))
+    bk = min(BLOCK_K, max(Sk, 1))
+    S_pad = ((S + bq - 1) // bq) * bq
+    Sk_pad = ((Sk + bk - 1) // bk) * bk
+    q3 = _pad_seq(q, S_pad).transpose(0, 2, 1, 3).reshape(B * H, S_pad, D)
+    k3 = _pad_seq(k, Sk_pad).transpose(0, 2, 1, 3).reshape(B * H, Sk_pad, D)
+    v3 = _pad_seq(v, Sk_pad).transpose(0, 2, 1, 3).reshape(B * H, Sk_pad, D)
+    o3, lse = _flash(q3, k3, v3, causal, scale, Sk)
+    o = o3.reshape(B, H, S_pad, D)[:, :, :S].transpose(0, 2, 1, 3)
+    return o, lse.reshape(B, H, S_pad)[:, :, :S]
 
 
 def flash_attention(q, k, v, causal=False, scale=None):
     """q/k/v: [batch, seq, heads, dim] -> [batch, seq, heads, dim].
 
     Pallas streamed-softmax forward on TPU (interpret mode elsewhere),
-    recompute backward. Sequence length must divide by the 128-wide block
-    (or be <=128); ragged batches bucket to these sizes upstream."""
-    B, S, H, D = q.shape
-    if S > BLOCK_Q and S % BLOCK_Q != 0:
-        # off-size sequence: dense fallback keeps semantics
-        scale_ = scale if scale is not None else D ** -0.5
-        merged = _dense_reference(
-            q.transpose(0, 2, 1, 3).reshape(B * H, S, D),
-            k.transpose(0, 2, 1, 3).reshape(B * H, S, D),
-            v.transpose(0, 2, 1, 3).reshape(B * H, S, D), causal, scale_)
-        return merged.reshape(B, H, S, D).transpose(0, 2, 1, 3)
-    scale = scale if scale is not None else D ** -0.5
-    q3 = q.transpose(0, 2, 1, 3).reshape(B * H, S, D)
-    k3 = k.transpose(0, 2, 1, 3).reshape(B * H, S, D)
-    v3 = v.transpose(0, 2, 1, 3).reshape(B * H, S, D)
-    o3 = _flash(q3, k3, v3, causal, scale)
-    return o3.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+    Pallas recompute backward (dq/dk/dv kernels) — no [S, S] buffer in
+    either direction, any sequence length."""
+    return flash_attention_with_lse(q, k, v, causal, scale)[0]

@@ -52,19 +52,72 @@ def _block_attn(q, k, v, scale, mask=None):
     return m, num, den
 
 
-def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+def ring_attention(q, k, v, axis_name, causal=False, scale=None,
+                   use_flash=True):
     """Attention over a sequence sharded on ``axis_name`` (call under
     shard_map). q/k/v: [batch, seq_chunk, heads, dim] per device.
 
     Rotates k/v blocks ring-wise with ppermute; each step contributes an
     online-softmax partial, so no device ever materialises the full
     [seq, seq] score matrix.
+
+    With ``use_flash`` each step's local block attention runs through the
+    Pallas flash kernel (kernels/flash_attention.py) — forward AND backward
+    stay blockwise (no [chunk, chunk] HBM score tile either); per-step
+    (o, lse) partials merge with the exact logsumexp identity. The pure-jnp
+    online-softmax path remains for comparison/debug.
     """
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     chunk = q.shape[1]
     B, Q, H, D = q.shape
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    if use_flash:
+        from ..kernels.flash_attention import flash_attention_with_lse
+
+        def full_blk(q_, k_, v_):
+            return flash_attention_with_lse(q_, k_, v_, causal=False,
+                                            scale=scale)
+
+        def diag_blk(q_, k_, v_):
+            return flash_attention_with_lse(q_, k_, v_, causal=True,
+                                            scale=scale)
+
+        def skip_blk(q_, k_, v_):
+            return (jnp.zeros(q_.shape, q_.dtype),
+                    jnp.full((B, H, Q), -1e30, jnp.float32))
+
+        def step(carry, t):
+            (k_blk, v_blk), (o_acc, lse_acc) = carry
+            k_owner = (idx - t) % n
+            if causal:
+                # 0: diagonal (causal within block), 1: fully visible,
+                # 2: entirely in the future (contributes nothing)
+                branch = jnp.where(k_owner == idx, 0,
+                                   jnp.where(k_owner < idx, 1, 2))
+                o_t, lse_t = jax.lax.switch(
+                    branch, (diag_blk, full_blk, skip_blk), q, k_blk, v_blk)
+            else:
+                o_t, lse_t = full_blk(q, k_blk, v_blk)
+            new_lse = jnp.logaddexp(lse_acc, lse_t)          # [B, H, Q]
+            w_acc = jnp.exp(lse_acc - new_lse).transpose(0, 2, 1)[..., None]
+            w_t = jnp.exp(lse_t - new_lse).transpose(0, 2, 1)[..., None]
+            # accumulate in f32 (bf16/f16 inputs would otherwise change the
+            # scan carry dtype after the first merge)
+            o_acc = o_acc * w_acc + o_t.astype(jnp.float32) * w_t
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            return ((k_blk, v_blk), (o_acc, new_lse)), None
+
+        o0 = jnp.zeros(q.shape, jnp.float32) + 0.0 * q.astype(jnp.float32)
+        lse0 = jnp.full((B, H, Q), -1e30, jnp.float32) + 0.0 * \
+            jnp.swapaxes(q, 1, 2)[..., 0].astype(jnp.float32)
+        ((_, _), (o, _)), _ = jax.lax.scan(
+            step, ((k, v), (o0, lse0)), jnp.arange(n))
+        return o.astype(q.dtype)
 
     def local_mask(q_owner, k_owner):
         if not causal:
@@ -73,8 +126,6 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
         qpos = q_owner * chunk + jnp.arange(chunk)
         kpos = k_owner * chunk + jnp.arange(chunk)
         return (qpos[:, None] >= kpos[None, :])[None, None, :, :]
-
-    perm = [(i, (i + 1) % n) for i in range(n)]
 
     def step(carry, t):
         (k_blk, v_blk), acc = carry
@@ -106,8 +157,9 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, seq_axis: str = "sp",
     spec = P(None, seq_axis, None, None)
     fn = functools.partial(ring_attention, axis_name=seq_axis,
                            causal=causal)
+    # check_vma=False: pallas_call out_shapes don't carry vma annotations
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec)(q, k, v)
+                     out_specs=spec, check_vma=False)(q, k, v)
 
 
 def ulysses_attention(q, k, v, axis_name, causal=False, scale=None):
