@@ -45,10 +45,17 @@ class ShardingStrategy(object):
       this axis on dim 0 when divisible (ZeRO-1/pserver analog).
     """
 
-    def __init__(self, data_axis="dp", param_rules=None, zero_axis=None):
+    def __init__(self, data_axis="dp", param_rules=None, zero_axis=None,
+                 embedding_axis=None):
         self.data_axis = data_axis
         self.param_rules: List[Tuple[str, P]] = list(param_rules or [])
         self.zero_axis = zero_axis
+        # mesh axis is_distributed embedding tables row-shard over; None
+        # falls back to zero_axis, then data_axis — the TPU-native form of
+        # the reference's pserver-row-sharded large embedding
+        # (reference: operators/lookup_table_op.cc is_distributed,
+        # doc/design/cluster_train/large_model_dist_train.md)
+        self.embedding_axis = embedding_axis
 
     def spec_for_param(self, name: str, shape, mesh: Mesh) -> P:
         for pat, spec in self.param_rules:
@@ -129,13 +136,49 @@ class DistributeTranspiler(object):
             raise ValueError("no mesh: pass one or set_default_mesh(...)")
         strategy = strategy or ShardingStrategy(
             data_axis=mesh.axis_names[0])
+        # is_distributed lookup tables row-shard over the embedding axis:
+        # the gather/scatter collectives GSPMD derives replace the
+        # reference's pserver prefetch round-trip
+        emb_axis = (strategy.embedding_axis or strategy.zero_axis
+                    or strategy.data_axis)
+        if strategy.embedding_axis and \
+                strategy.embedding_axis not in mesh.shape:
+            raise ValueError("embedding_axis %r is not a mesh axis (%s)"
+                             % (strategy.embedding_axis,
+                                tuple(mesh.shape)))
+        dist_tables = set()
+        if emb_axis in mesh.shape:
+            ax_size = mesh.shape[emb_axis]
+            for blk in program.blocks:
+                for op in blk.ops:
+                    if op.type == "lookup_table" and \
+                            op.attr("is_distributed", False):
+                        w = blk._find_var_recursive(op.input("W")[0])
+                        if w is not None and w.shape and \
+                                w.shape[0] % ax_size == 0:
+                            dist_tables.add(w.name)
         specs: Dict[str, P] = {}
         for v in program.list_vars():
             if isinstance(v, ir.Parameter) or v.persistable:
-                specs[v.name] = strategy.spec_for_param(
-                    v.name, v.shape or (), mesh)
                 # optimizer accumulators follow their parameter (they are
-                # created as <param>_<suffix> persistables by optimizer.py)
+                # created as <param>_<suffix> persistable non-Parameter
+                # vars by optimizer.py; the Parameter guard keeps sibling
+                # weights like "<table>_proj" out)
+                base_table = next(
+                    (t for t in dist_tables
+                     if v.name == t or (not isinstance(v, ir.Parameter)
+                                        and v.name.startswith(t + "_"))),
+                    None)
+                explicit = any(re.search(pat, v.name)
+                               for pat, _ in strategy.param_rules)
+                if base_table is not None and not explicit and v.shape \
+                        and v.shape[0] % mesh.shape[emb_axis] == 0:
+                    specs[v.name] = P(emb_axis)
+                else:
+                    # explicit param_rules win over the automatic
+                    # is_distributed row-sharding (first hit wins contract)
+                    specs[v.name] = strategy.spec_for_param(
+                        v.name, v.shape or (), mesh)
         # grad vars follow their parameter's spec
         for v in program.list_vars():
             if v.name.endswith(ir.GRAD_SUFFIX):
