@@ -344,4 +344,303 @@ int master_new_pass(void* h) {
 
 void master_destroy(void* h) { delete static_cast<Master*>(h); }
 
+// ---------------------------------------------------------------------------
+// master snapshot/restore: the Go master persists its task queue to etcd so
+// a restarted master resumes where it left off (reference:
+// go/master/service.go:313-366 snapshot/recover, go/pserver/etcd_client.go).
+// Here: an atomic file snapshot of todo+pending payloads (a leased task is
+// snapshotted as re-runnable — exactly the Go master's recovery semantics).
+
+static const char kSnapMagic[4] = {'P', 'T', 'S', 'N'};
+
+int master_snapshot(void* h, const char* path) {
+  auto* m = static_cast<Master*>(h);
+  std::vector<std::vector<uint8_t>> payloads;
+  {
+    std::lock_guard<std::mutex> g(m->mu);
+    for (auto& t : m->todo) payloads.push_back(t.payload);
+    for (auto& kv : m->pending) payloads.push_back(kv.second.first.payload);
+  }
+  std::string tmp = std::string(path) + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "wb");
+  if (!f) return -1;
+  uint32_t n = static_cast<uint32_t>(payloads.size());
+  if (fwrite(kSnapMagic, 1, 4, f) != 4 || fwrite(&n, 4, 1, f) != 1) {
+    fclose(f);
+    return -1;
+  }
+  for (auto& pl : payloads) {
+    uint32_t len = static_cast<uint32_t>(pl.size());
+    if (fwrite(&len, 4, 1, f) != 1 ||
+        (len && fwrite(pl.data(), 1, len, f) != len)) {
+      fclose(f);
+      remove(tmp.c_str());
+      return -1;
+    }
+  }
+  // fclose flushes the stdio buffer: an ENOSPC surfacing here must not
+  // atomically install a truncated snapshot
+  if (fclose(f) != 0) {
+    remove(tmp.c_str());
+    return -1;
+  }
+  return rename(tmp.c_str(), path);
+}
+
+int64_t master_restore(void* h, const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  char magic[4];
+  uint32_t n = 0;
+  if (fread(magic, 1, 4, f) != 4 || memcmp(magic, kSnapMagic, 4) != 0 ||
+      fread(&n, 4, 1, f) != 1) {
+    fclose(f);
+    return -1;
+  }
+  int64_t added = 0;
+  for (uint32_t i = 0; i < n; ++i) {
+    uint32_t len = 0;
+    std::vector<uint8_t> pl;
+    if (fread(&len, 4, 1, f) != 1) { added = -1; break; }  // truncated
+    pl.resize(len);
+    if (len && fread(pl.data(), 1, len, f) != len) { added = -1; break; }
+    master_add_task(h, pl.data(), len);
+    ++added;
+  }
+  fclose(f);
+  return added;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// master RPC server: a TCP front over the task queue so worker *processes*
+// (local or cross-host) lease tasks — the role of the Go master's RPC
+// service (reference: go/master/service.go:368 GetTask, :411 TaskFinished,
+// :455 TaskFailed served over net/rpc; go/master/client.go).
+//
+// Frame: request  [u8 op][u32 len][payload]
+//        response [i64 a][u32 len][payload]
+// ops: 1 GET (a=id, payload=task)  2 ADD (payload=task, a=id)
+//      3 FIN [i64 id] (a=rc)       4 FAIL [i64 id] (a=rc)
+//      5 COUNTS (payload=4xi64)    6 NEW_PASS (a=rc)
+//      7 SNAPSHOT [path] (a=rc)    8 PING (a=42)
+
+#include <sys/socket.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <unistd.h>
+#include <atomic>
+#include <memory>
+
+namespace {
+
+int64_t master_get_task_copy(void* h, std::vector<uint8_t>* out,
+                             int64_t* out_len);
+
+struct Conn {
+  std::thread thread;
+  int fd;
+  std::atomic<bool> done{false};
+};
+
+struct MasterServer {
+  void* master;
+  int listen_fd;
+  int port;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::vector<std::unique_ptr<Conn>> conns;
+  std::mutex conns_mu;
+
+  void reap_finished() {  // caller holds conns_mu
+    for (auto it = conns.begin(); it != conns.end();) {
+      if ((*it)->done.load()) {
+        (*it)->thread.join();
+        it = conns.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+};
+
+static bool read_full(int fd, void* buf, size_t len) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (len) {
+    ssize_t r = read(fd, p, len);
+    if (r <= 0) return false;
+    p += r;
+    len -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+static bool write_full(int fd, const void* buf, size_t len) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (len) {
+    ssize_t r = write(fd, p, len);
+    if (r <= 0) return false;
+    p += r;
+    len -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+static bool reply(int fd, int64_t a, const uint8_t* data, uint32_t len) {
+  if (!write_full(fd, &a, 8)) return false;
+  if (!write_full(fd, &len, 4)) return false;
+  return !len || write_full(fd, data, len);
+}
+
+static void serve_conn(MasterServer* s, Conn* c) {
+  int fd = c->fd;
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  while (!s->stop.load()) {
+    uint8_t op;
+    uint32_t len;
+    if (!read_full(fd, &op, 1) || !read_full(fd, &len, 4)) break;
+    std::vector<uint8_t> payload(len);
+    if (len && !read_full(fd, payload.data(), len)) break;
+    bool ok = true;
+    switch (op) {
+      case 1: {  // GET
+        int64_t out_len = 0;
+        int64_t id = master_get_task_copy(s->master, &payload, &out_len);
+        ok = reply(fd, id, payload.data(),
+                   static_cast<uint32_t>(id > 0 ? out_len : 0));
+        break;
+      }
+      case 2: {  // ADD
+        int64_t id = master_add_task(s->master, payload.data(), len);
+        ok = reply(fd, id, nullptr, 0);
+        break;
+      }
+      case 3:
+      case 4: {  // FIN / FAIL
+        int64_t id = 0;
+        if (len == 8) memcpy(&id, payload.data(), 8);
+        int rc = (op == 3) ? master_task_finished(s->master, id)
+                           : master_task_failed(s->master, id);
+        ok = reply(fd, rc, nullptr, 0);
+        break;
+      }
+      case 5: {  // COUNTS
+        int64_t c[4];
+        master_counts(s->master, &c[0], &c[1], &c[2], &c[3]);
+        ok = reply(fd, 0, reinterpret_cast<uint8_t*>(c), 32);
+        break;
+      }
+      case 6:
+        ok = reply(fd, master_new_pass(s->master), nullptr, 0);
+        break;
+      case 7: {
+        std::string path(payload.begin(), payload.end());
+        ok = reply(fd, master_snapshot(s->master, path.c_str()), nullptr, 0);
+        break;
+      }
+      case 8:
+        ok = reply(fd, 42, nullptr, 0);
+        break;
+      default:
+        ok = false;
+    }
+    if (!ok) break;
+  }
+  close(fd);
+  c->done.store(true);
+}
+
+// thread-safe GET variant: copies the payload into the caller's vector
+// (master_get_task returns a pointer into master->last, unsafe across
+// concurrent RPC connections)
+int64_t master_get_task_copy(void* h, std::vector<uint8_t>* out,
+                             int64_t* out_len) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  m->reclaim_expired();
+  if (m->todo.empty()) {
+    out->clear();
+    *out_len = 0;
+    // -1 = "wait": tasks are still leased and may requeue on lease expiry;
+    // 0 = the pass is genuinely finished (matches master_get_task)
+    return m->pending.empty() ? 0 : -1;
+  }
+  Task t = std::move(m->todo.front());
+  m->todo.pop_front();
+  int64_t id = t.id;
+  *out = t.payload;
+  *out_len = static_cast<int64_t>(out->size());
+  m->pending.emplace(id,
+                     std::make_pair(std::move(t),
+                                    std::chrono::steady_clock::now()));
+  return id;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Start serving the master's queue on TCP `port` (0 = ephemeral); returns
+// the bound port or -1. The returned handle must outlive the master.
+void* master_serve(void* master, int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(fd, 64) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  auto* s = new MasterServer();
+  s->master = master;
+  s->listen_fd = fd;
+  s->port = ntohs(addr.sin_port);
+  s->accept_thread = std::thread([s]() {
+    while (!s->stop.load()) {
+      int cfd = accept(s->listen_fd, nullptr, nullptr);
+      if (cfd < 0) {
+        if (s->stop.load()) break;
+        continue;
+      }
+      std::lock_guard<std::mutex> g(s->conns_mu);
+      s->reap_finished();  // bound thread growth on long-lived masters
+      auto conn = std::unique_ptr<Conn>(new Conn());
+      conn->fd = cfd;
+      conn->thread = std::thread(serve_conn, s, conn.get());
+      s->conns.push_back(std::move(conn));
+    }
+  });
+  return s;
+}
+
+int master_serve_port(void* h) {
+  return static_cast<MasterServer*>(h)->port;
+}
+
+void master_serve_stop(void* h) {
+  auto* s = static_cast<MasterServer*>(h);
+  s->stop.store(true);
+  shutdown(s->listen_fd, SHUT_RDWR);
+  close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  {
+    std::lock_guard<std::mutex> g(s->conns_mu);
+    // unblock handler threads parked in read() before joining them
+    for (auto& c : s->conns)
+      if (!c->done.load()) shutdown(c->fd, SHUT_RDWR);
+    for (auto& c : s->conns)
+      if (c->thread.joinable()) c->thread.join();
+  }
+  delete s;
+}
+
 }  // extern "C"

@@ -36,7 +36,8 @@ from .parallel import DistributeTranspiler  # noqa: F401
 from . import concurrency  # noqa: F401
 from .concurrency import Go, Channel  # noqa: F401
 from . import trainer as trainer_mod  # noqa: F401
-from .trainer import Trainer  # noqa: F401
+from .trainer import (Trainer, BeginPass, EndPass, BeginIteration,  # noqa: F401
+                      EndIteration)
 from . import kernels  # noqa: F401
 from . import native  # noqa: F401
 from . import nets  # noqa: F401

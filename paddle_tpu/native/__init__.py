@@ -110,6 +110,16 @@ def _configure(lib):
     lib.master_new_pass.argtypes = [c.c_void_p]
     lib.master_destroy.restype = None
     lib.master_destroy.argtypes = [c.c_void_p]
+    lib.master_snapshot.restype = c.c_int
+    lib.master_snapshot.argtypes = [c.c_void_p, c.c_char_p]
+    lib.master_restore.restype = c.c_int64
+    lib.master_restore.argtypes = [c.c_void_p, c.c_char_p]
+    lib.master_serve.restype = c.c_void_p
+    lib.master_serve.argtypes = [c.c_void_p, c.c_int]
+    lib.master_serve_port.restype = c.c_int
+    lib.master_serve_port.argtypes = [c.c_void_p]
+    lib.master_serve_stop.restype = None
+    lib.master_serve_stop.argtypes = [c.c_void_p]
 
 
 def _as_u8p(data: bytes):
@@ -254,6 +264,120 @@ class TaskMaster(object):
         self._lib.master_new_pass(self._h)
 
     def close(self):
+        if self._serve_h:
+            self._lib.master_serve_stop(self._serve_h)
+            self._serve_h = None
         if self._h:
             self._lib.master_destroy(self._h)
             self._h = None
+
+    # -- cross-process service (reference: go/master/service.go RPC) -------
+    _serve_h = None
+
+    def serve(self, port=0) -> int:
+        """Expose the queue over TCP so worker *processes* lease tasks
+        (length-prefixed binary protocol; see MasterClient). Returns the
+        bound port."""
+        h = self._lib.master_serve(self._h, port)
+        if not h:
+            raise RuntimeError("master_serve failed (port %d)" % port)
+        self._serve_h = h
+        return self._lib.master_serve_port(h)
+
+    def snapshot(self, path) -> None:
+        """Atomic snapshot of todo+pending payloads — leased tasks are
+        persisted re-runnable, the Go master's etcd recovery semantics
+        (go/master/service.go:313-366)."""
+        rc = self._lib.master_snapshot(self._h, path.encode())
+        if rc != 0:
+            raise IOError("master_snapshot(%r) rc=%d" % (path, rc))
+
+    def restore(self, path) -> int:
+        """Re-queue tasks from a snapshot; returns how many were added."""
+        n = self._lib.master_restore(self._h, path.encode())
+        if n < 0:
+            raise IOError("master_restore(%r) failed" % path)
+        return n
+
+
+class MasterClient(object):
+    """Socket client for TaskMaster.serve — what a worker process runs
+    (reference: go/master/client.go). Frames:
+    request [u8 op][u32 len][payload], response [i64 a][u32 len][payload].
+    """
+
+    GET, ADD, FIN, FAIL, COUNTS, NEW_PASS, SNAPSHOT, PING = range(1, 9)
+
+    def __init__(self, host, port, timeout=30.0):
+        import socket
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def _call(self, op, payload=b""):
+        import struct
+        self._sock.sendall(struct.pack("<BI", op, len(payload)) + payload)
+        hdr = self._recv(12)
+        a, n = struct.unpack("<qI", hdr)
+        data = self._recv(n) if n else b""
+        return a, data
+
+    def _recv(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("master connection closed")
+            buf += chunk
+        return buf
+
+    def get_task(self):
+        """-> (task_id, payload) | ("wait", None) while other workers hold
+        leases | (None, None) when the pass is finished — the same contract
+        as TaskMaster.get_task."""
+        tid, data = self._call(self.GET)
+        if tid == 0:
+            return None, None
+        if tid < 0:
+            return "wait", None
+        return tid, data
+
+    def add_task(self, payload: bytes) -> int:
+        tid, _ = self._call(self.ADD, payload)
+        return tid
+
+    def task_finished(self, task_id) -> bool:
+        """False when the lease had already expired and the task was
+        reclaimed — the caller's work may run twice; don't double-commit."""
+        import struct
+        rc, _ = self._call(self.FIN, struct.pack("<q", task_id))
+        return rc == 0
+
+    def task_failed(self, task_id) -> bool:
+        import struct
+        rc, _ = self._call(self.FAIL, struct.pack("<q", task_id))
+        return rc == 0
+
+    def counts(self):
+        import struct
+        _, data = self._call(self.COUNTS)
+        todo, pending, done, failed = struct.unpack("<4q", data)
+        return {"todo": todo, "pending": pending, "done": done,
+                "failed": failed}
+
+    def new_pass(self):
+        self._call(self.NEW_PASS)
+
+    def snapshot(self, path):
+        rc, _ = self._call(self.SNAPSHOT, path.encode())
+        if rc != 0:
+            raise IOError("snapshot rc=%d" % rc)
+
+    def ping(self) -> bool:
+        try:
+            a, _ = self._call(self.PING)
+            return a == 42
+        except Exception:
+            return False
+
+    def close(self):
+        self._sock.close()

@@ -2,6 +2,7 @@
 
 reference behaviors mirrored: go/master/service_test.go (lease timeout,
 failure cap, pass semantics), v2/reader recordio creator round trip."""
+import os
 import pickle
 import time
 
@@ -124,3 +125,208 @@ def test_master_lease_timeout_requeues():
     tid3, payload = m.get_task()
     assert isinstance(tid3, int) and payload == b"t"
     m.close()
+
+
+# ---------------------------------------------------------------------------
+# cross-process fault tolerance (VERDICT r1 item 7)
+
+_WORKER_SCRIPT = r"""
+import struct, sys, time
+sys.path.insert(0, %(repo)r)
+from paddle_tpu import native
+
+host, port, mode = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+cli = native.MasterClient(host, port)
+if mode == "hang":
+    # lease one task then hang forever (gets SIGKILLed by the parent):
+    # the lease must expire and the task requeue to a healthy worker
+    while True:
+        tid, payload = cli.get_task()
+        if tid is not None:
+            print("LEASED", tid, flush=True)
+            time.sleep(3600)
+        time.sleep(0.01)
+else:
+    done = 0
+    while True:
+        tid, payload = cli.get_task()
+        if tid is None:          # pass finished: nothing todo, nothing leased
+            break
+        if tid == "wait":        # other workers hold leases; poll
+            time.sleep(0.02)
+            continue
+        time.sleep(0.01)  # "process" the task
+        cli.task_finished(tid)
+        done += 1
+    print("DONE", done, flush=True)
+"""
+
+
+def test_master_rpc_kill_worker_requeues_tasks(tmp_path):
+    """Worker processes lease tasks over the RPC front; a SIGKILLed worker's
+    lease expires and its task is re-run by a healthy worker — the Go
+    master's GetTask/TaskFinished/timeout semantics across real processes
+    (reference: go/master/service.go:368,411,455)."""
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    native = pytest.importorskip("paddle_tpu.native")
+    if not native.available():
+        pytest.skip("no native toolchain")
+
+    m = native.TaskMaster(failure_max=3, timeout_sec=1.0)
+    port = m.serve(0)
+    n_tasks = 12
+    for i in range(n_tasks):
+        m.add_task(b"task-%d" % i)
+
+    script = _WORKER_SCRIPT % {"repo": os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))}
+    hang = subprocess.Popen(
+        [sys.executable, "-c", script, "127.0.0.1", str(port), "hang"],
+        stdout=subprocess.PIPE, text=True)
+    # wait until the hanging worker actually leased a task
+    line = hang.stdout.readline()
+    assert line.startswith("LEASED"), line
+
+    good = subprocess.Popen(
+        [sys.executable, "-c", script, "127.0.0.1", str(port), "work"],
+        stdout=subprocess.PIPE, text=True)
+
+    hang.send_signal(signal.SIGKILL)
+    hang.wait()
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        c = m.counts()
+        if c["done"] == n_tasks:
+            break
+        time.sleep(0.1)
+    good.wait(timeout=30)
+    c = m.counts()
+    assert c["done"] == n_tasks, c
+    assert c["failed"] == 0, c
+    m.close()
+
+
+def test_master_snapshot_restore(tmp_path):
+    """Snapshot persists todo AND leased tasks re-runnable; a fresh master
+    restores them (the etcd recovery role, go/master/service.go:313-366)."""
+    native = pytest.importorskip("paddle_tpu.native")
+    if not native.available():
+        pytest.skip("no native toolchain")
+    snap = str(tmp_path / "master.snap")
+
+    m = native.TaskMaster(failure_max=3, timeout_sec=60.0)
+    for i in range(5):
+        m.add_task(b"t%d" % i)
+    leased_id, payload = m.get_task()   # one task in pending
+    assert leased_id not in (None, "wait")
+    m.snapshot(snap)
+    m.close()
+
+    m2 = native.TaskMaster()
+    assert m2.restore(snap) == 5        # pending snapshotted as re-runnable
+    got = set()
+    while True:
+        tid, p = m2.get_task()
+        if tid in (None, "wait"):
+            break
+        got.add(bytes(p))
+        m2.task_finished(tid)
+    assert got == {b"t%d" % i for i in range(5)}
+    m2.close()
+
+
+_TRAINER_SCRIPT = r"""
+import os, sys
+sys.path.insert(0, %(repo)r)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as fluid
+
+ckpt, passes_file, die_at = sys.argv[1], sys.argv[2], int(sys.argv[3])
+
+x = fluid.layers.data("x", shape=[8])
+y = fluid.layers.data("y", shape=[1], dtype="int64")
+pred = fluid.layers.fc(fluid.layers.fc(x, size=16, act="relu"), size=4,
+                       act="softmax")
+cost = fluid.layers.mean(fluid.layers.cross_entropy(pred, y))
+opt = fluid.optimizer.SGD(learning_rate=0.2)
+
+rng = np.random.RandomState(0)
+data = [(rng.rand(8).astype("float32"), rng.randint(0, 4, (1,)))
+        for _ in range(32)]
+reader = fluid.reader.batch(lambda: iter(data), batch_size=8)
+
+trainer = fluid.Trainer(cost, opt, feed_list=[x, y],
+                        place=fluid.CPUPlace(), checkpoint_dir=ckpt)
+
+def handler(ev):
+    from paddle_tpu.trainer import EndPass
+    if isinstance(ev, EndPass):
+        with open(passes_file, "a") as f:
+            f.write("%%d %%.6f\n" %% (ev.pass_id, ev.metrics["avg_cost"]))
+        if ev.pass_id + 1 >= die_at:
+            os._exit(7)  # simulated crash AFTER checkpointing this pass
+
+trainer.train(reader, num_passes=6, event_handler=handler)
+"""
+
+
+def test_trainer_kill_and_resume(tmp_path):
+    """Kill a trainer process mid-run; a restarted trainer resumes from the
+    per-pass checkpoint and the loss continues from where it left off."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ckpt = str(tmp_path / "ckpt")
+    passes = str(tmp_path / "passes.txt")
+    script = _TRAINER_SCRIPT % {"repo": repo}
+
+    # run 1: dies (os._exit) after pass 2's checkpoint
+    p1 = subprocess.run([sys.executable, "-c", script, ckpt, passes, "3"],
+                        capture_output=True, text=True, timeout=300)
+    assert p1.returncode == 7, p1.stderr[-2000:]
+    lines1 = open(passes).read().strip().splitlines()
+    assert len(lines1) == 3
+
+    # run 2: resumes from the checkpoint, finishes the remaining passes
+    p2 = subprocess.run([sys.executable, "-c", script, ckpt, passes, "99"],
+                        capture_output=True, text=True, timeout=300)
+    assert p2.returncode == 0, p2.stderr[-2000:]
+    lines = open(passes).read().strip().splitlines()
+    losses = [float(l.split()[1]) for l in lines]
+    # resumed run continues improving on the crashed run's last loss
+    assert losses[-1] < losses[2], losses
+    # and did not restart from scratch: its first loss is already below
+    # the cold run's first loss
+    assert losses[3] < losses[0], losses
+
+
+def test_master_serve_stop_with_open_connection():
+    """close() must not deadlock while a client connection is still open
+    (handler threads parked in read() are shut down before joining)."""
+    import threading
+
+    native = pytest.importorskip("paddle_tpu.native")
+    if not native.available():
+        pytest.skip("no native toolchain")
+    m = native.TaskMaster()
+    port = m.serve(0)
+    cli = native.MasterClient("127.0.0.1", port)
+    assert cli.ping()
+    closed = threading.Event()
+
+    def _close():
+        m.close()
+        closed.set()
+
+    t = threading.Thread(target=_close, daemon=True)
+    t.start()
+    assert closed.wait(10.0), "TaskMaster.close() deadlocked"
+    cli.close()
