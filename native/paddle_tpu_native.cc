@@ -17,6 +17,9 @@
 // — the environment has no pybind11; ctypes over a C ABI is the supported
 // binding path.
 
+#include <fcntl.h>
+#include <unistd.h>
+
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -378,13 +381,25 @@ int master_snapshot(void* h, const char* path) {
       return -1;
     }
   }
-  // fclose flushes the stdio buffer: an ENOSPC surfacing here must not
-  // atomically install a truncated snapshot
-  if (fclose(f) != 0) {
+  // fclose flushes stdio to the page cache only; fsync makes the install
+  // crash-durable — recovery after power loss is the feature's whole point
+  if (fflush(f) != 0 || fsync(fileno(f)) != 0 || fclose(f) != 0) {
     remove(tmp.c_str());
     return -1;
   }
-  return rename(tmp.c_str(), path);
+  if (rename(tmp.c_str(), path) != 0) {
+    remove(tmp.c_str());
+    return -1;
+  }
+  std::string dir(path);
+  size_t slash = dir.find_last_of('/');
+  dir = (slash == std::string::npos) ? "." : dir.substr(0, slash);
+  int dfd = open(dir.c_str(), O_RDONLY);
+  if (dfd >= 0) {
+    fsync(dfd);
+    close(dfd);
+  }
+  return 0;
 }
 
 int64_t master_restore(void* h, const char* path) {
@@ -398,10 +413,11 @@ int64_t master_restore(void* h, const char* path) {
     return -1;
   }
   int64_t added = 0;
+  const uint32_t kMaxTask = 64u << 20;  // corrupt-length guard
   for (uint32_t i = 0; i < n; ++i) {
     uint32_t len = 0;
     std::vector<uint8_t> pl;
-    if (fread(&len, 4, 1, f) != 1) { added = -1; break; }  // truncated
+    if (fread(&len, 4, 1, f) != 1 || len > kMaxTask) { added = -1; break; }
     pl.resize(len);
     if (len && fread(pl.data(), 1, len, f) != len) { added = -1; break; }
     master_add_task(h, pl.data(), len);
@@ -457,6 +473,7 @@ struct MasterServer {
     for (auto it = conns.begin(); it != conns.end();) {
       if ((*it)->done.load()) {
         (*it)->thread.join();
+        close((*it)->fd);
         it = conns.erase(it);
       } else {
         ++it;
@@ -501,6 +518,9 @@ static void serve_conn(MasterServer* s, Conn* c) {
     uint8_t op;
     uint32_t len;
     if (!read_full(fd, &op, 1) || !read_full(fd, &len, 4)) break;
+    if (len > (64u << 20)) break;  // non-protocol/garbage connection:
+                                   // never let untrusted bytes size an
+                                   // unbounded allocation in the master
     std::vector<uint8_t> payload(len);
     if (len && !read_full(fd, payload.data(), len)) break;
     bool ok = true;
@@ -548,7 +568,8 @@ static void serve_conn(MasterServer* s, Conn* c) {
     }
     if (!ok) break;
   }
-  close(fd);
+  // fd stays open: the owner (reap_finished / master_serve_stop) closes it
+  // after joining, so a shutdown() from stop can never hit a recycled fd
   c->done.store(true);
 }
 
@@ -634,11 +655,14 @@ void master_serve_stop(void* h) {
   if (s->accept_thread.joinable()) s->accept_thread.join();
   {
     std::lock_guard<std::mutex> g(s->conns_mu);
-    // unblock handler threads parked in read() before joining them
+    // unblock handler threads parked in read() before joining them; fds
+    // stay valid until after the join (handlers never close their own)
     for (auto& c : s->conns)
-      if (!c->done.load()) shutdown(c->fd, SHUT_RDWR);
-    for (auto& c : s->conns)
+      shutdown(c->fd, SHUT_RDWR);
+    for (auto& c : s->conns) {
       if (c->thread.joinable()) c->thread.join();
+      close(c->fd);
+    }
   }
   delete s;
 }

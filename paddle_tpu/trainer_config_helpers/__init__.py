@@ -1,0 +1,15 @@
+"""trainer_config_helpers: the v1 config DSL over the fluid/TPU path.
+
+reference: python/paddle/trainer_config_helpers/__init__.py — star-exports
+the layer DSL, activations, attrs, poolings, optimizers, networks,
+evaluators so `from paddle.trainer_config_helpers import *` configs run
+unchanged (modulo the package name).
+"""
+from .activations import *        # noqa: F401,F403
+from .attrs import *              # noqa: F401,F403
+from .poolings import *           # noqa: F401,F403
+from .layers import *             # noqa: F401,F403
+from .networks import *           # noqa: F401,F403
+from .evaluators import *         # noqa: F401,F403
+from .optimizers import *         # noqa: F401,F403
+from .data_sources import *      # noqa: F401,F403

@@ -1,0 +1,72 @@
+"""v1 activation objects.
+
+reference: python/paddle/trainer_config_helpers/activations.py — each class
+names a gserver activation (paddle/gserver/activations/ActivationFunction.cpp);
+here ``name`` is the fluid activation string the layer DSL passes through
+(None = linear/identity).
+"""
+
+
+class BaseActivation(object):
+    name = None
+
+    def __repr__(self):
+        return "%s()" % type(self).__name__
+
+
+class LinearActivation(BaseActivation):
+    name = None
+
+
+IdentityActivation = LinearActivation
+
+
+class ReluActivation(BaseActivation):
+    name = "relu"
+
+
+class BReluActivation(BaseActivation):
+    name = "brelu"
+
+
+class SoftReluActivation(BaseActivation):
+    name = "soft_relu"
+
+
+class SigmoidActivation(BaseActivation):
+    name = "sigmoid"
+
+
+class TanhActivation(BaseActivation):
+    name = "tanh"
+
+
+class STanhActivation(BaseActivation):
+    name = "stanh"
+
+
+class SoftmaxActivation(BaseActivation):
+    name = "softmax"
+
+
+class SequenceSoftmaxActivation(BaseActivation):
+    name = "sequence_softmax"
+
+
+class ExpActivation(BaseActivation):
+    name = "exp"
+
+
+class AbsActivation(BaseActivation):
+    name = "abs"
+
+
+class SquareActivation(BaseActivation):
+    name = "square"
+
+
+class LogActivation(BaseActivation):
+    name = "log"
+
+
+__all__ = [n for n in dir() if n.endswith("Activation")]

@@ -1,0 +1,62 @@
+"""v1 attribute objects -> fluid ParamAttr.
+
+reference: python/paddle/trainer_config_helpers/attrs.py
+(ParameterAttribute wraps parameter config: init, lr, decay;
+ExtraLayerAttribute carries dropout/device hints).
+"""
+from __future__ import annotations
+
+from ..param_attr import ParamAttr
+from .. import initializer as _init
+from .. import regularizer as _reg
+
+__all__ = ["ParameterAttribute", "ExtraLayerAttribute", "ParamAttr",
+           "ExtraAttr"]
+
+
+class ParameterAttribute(object):
+    def __init__(self, name=None, is_static=False, initial_std=None,
+                 initial_mean=None, initial_max=None, initial_min=None,
+                 l1_rate=None, l2_rate=None, learning_rate=1.0,
+                 momentum=None, gradient_clipping_threshold=None,
+                 sparse_update=False):
+        self.name = name
+        self.is_static = is_static
+        self.initial_std = initial_std
+        self.initial_mean = initial_mean
+        self.initial_max = initial_max
+        self.initial_min = initial_min
+        self.l1_rate = l1_rate
+        self.l2_rate = l2_rate
+        self.learning_rate = learning_rate
+        self.sparse_update = sparse_update
+
+    def to_fluid(self):
+        init = None
+        if self.initial_max is not None or self.initial_min is not None:
+            init = _init.Uniform(self.initial_min or 0.0,
+                                 self.initial_max or 1.0)
+        elif self.initial_std is not None or self.initial_mean is not None:
+            init = _init.Normal(self.initial_mean or 0.0,
+                                self.initial_std
+                                if self.initial_std is not None else 0.01)
+        reg = None
+        if self.l2_rate:
+            reg = _reg.L2DecayRegularizer(self.l2_rate)
+        elif self.l1_rate:
+            reg = _reg.L1DecayRegularizer(self.l1_rate)
+        return ParamAttr(name=self.name, initializer=init,
+                         learning_rate=self.learning_rate,
+                         regularizer=reg,
+                         trainable=not self.is_static)
+
+
+class ExtraLayerAttribute(object):
+    def __init__(self, error_clipping_threshold=None, drop_rate=None,
+                 device=None):
+        self.error_clipping_threshold = error_clipping_threshold
+        self.drop_rate = drop_rate
+        self.device = device
+
+
+ExtraAttr = ExtraLayerAttribute

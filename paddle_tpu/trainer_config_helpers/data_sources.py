@@ -1,0 +1,52 @@
+"""v1 data sources + config args.
+
+reference: python/paddle/trainer_config_helpers/data_sources.py
+(define_py_data_sources2 registers a python provider module) and
+python/paddle/trainer/config_parser.py get_config_arg (command-line config
+args threaded into the config namespace).
+"""
+from __future__ import annotations
+
+import importlib
+
+__all__ = ["define_py_data_sources2", "get_config_arg", "set_config_args",
+           "get_data_sources"]
+
+_CONFIG_ARGS = {}
+_DATA_SOURCES = {}
+
+
+def set_config_args(args):
+    """What ``paddle train --config_args=k=v,...`` provides; tests/runners
+    call this before exec-ing a config."""
+    _CONFIG_ARGS.clear()
+    _CONFIG_ARGS.update(args or {})
+
+
+def get_config_arg(name, type_, default=None):
+    v = _CONFIG_ARGS.get(name, default)
+    if v is None:
+        return None
+    if type_ is bool and isinstance(v, str):
+        return v.lower() in ("1", "true", "yes")
+    return type_(v)
+
+
+def define_py_data_sources2(train_list, test_list, module, obj, args=None):
+    """Record the provider; the runner resolves ``module.obj(args)`` into a
+    reader when training starts."""
+    _DATA_SOURCES.clear()
+    _DATA_SOURCES.update(dict(train_list=train_list, test_list=test_list,
+                              module=module, obj=obj, args=args or {}))
+
+
+def get_data_sources():
+    return dict(_DATA_SOURCES)
+
+
+def resolve_provider():
+    """-> generator fn from the registered provider module, or None."""
+    if not _DATA_SOURCES:
+        return None
+    mod = importlib.import_module(_DATA_SOURCES["module"])
+    return getattr(mod, _DATA_SOURCES["obj"])

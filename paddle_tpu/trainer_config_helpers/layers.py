@@ -1,0 +1,439 @@
+"""v1 layer DSL: the trainer_config_helpers surface over the fluid path.
+
+reference: python/paddle/trainer_config_helpers/layers.py (~100 *_layer
+functions writing the v1 ModelConfig proto via config_parser.py). Here each
+function appends fluid ops into the default program immediately and returns
+a ``LayerOutput`` — the config-graph indirection collapses because the
+Program IS the config (Program-as-config, SURVEY.md §2.1). The image DSL's
+flat-vector convention (data layers are flat [size]; conv layers know
+height/width/channels) is preserved by carrying (channels, height, width)
+on LayerOutput and reshaping at the flat->image boundary.
+"""
+from __future__ import annotations
+
+from .. import layers as F
+from ..core import ir
+from .activations import BaseActivation, LinearActivation
+from .attrs import ExtraLayerAttribute, ParameterAttribute
+from .poolings import BasePoolingType, MaxPooling
+
+__all__ = [
+    "LayerOutput", "data_layer", "fc_layer", "embedding_layer",
+    "img_conv_layer", "img_pool_layer", "batch_norm_layer", "addto_layer",
+    "concat_layer", "dropout_layer", "pool_layer", "lstmemory",
+    "grumemory", "max_id_layer", "classification_cost", "cross_entropy",
+    "cross_entropy_with_selfnorm", "regression_cost", "square_error_cost",
+    "mixed_layer", "full_matrix_projection", "identity_projection",
+    "table_projection", "trans_full_matrix_projection", "outputs",
+    "get_output_layers",
+]
+
+
+class LayerOutput(object):
+    """What every *_layer returns: the fluid var plus the v1 metadata the
+    DSL chains on (reference: layers.py:330 LayerOutput)."""
+
+    def __init__(self, name, var, size=None, channels=None, height=None,
+                 width=None):
+        self.name = name
+        self.var = var
+        self.size = size
+        self.channels = channels
+        self.height = height
+        self.width = width
+
+    def __repr__(self):
+        return "LayerOutput(%s, size=%s)" % (self.name, self.size)
+
+
+def _act_name(act):
+    if act is None:
+        return None
+    if isinstance(act, BaseActivation):
+        return act.name
+    return act
+
+
+def _param(attr):
+    if isinstance(attr, ParameterAttribute):
+        return attr.to_fluid()
+    return attr
+
+
+def _bias(attr):
+    if attr is False:
+        return False
+    if attr is None or attr is True:
+        return None
+    return _param(attr)
+
+
+def _as_image(layer, channels):
+    """Reshape a flat [N, size] var to [N, C, H, W] at the flat->image
+    boundary (v1 data layers are flat; reference config_parser infers the
+    image shape from num_channels + sqrt)."""
+    if layer.channels is not None:
+        return layer.var, layer.channels, layer.height, layer.width
+    if channels is None:
+        raise ValueError(
+            "img layer needs num_channels when input %r is flat"
+            % layer.name)
+    hw = int(round((layer.size // channels) ** 0.5))
+    if channels * hw * hw != layer.size:
+        raise ValueError("cannot infer square image from size %d / %d "
+                         "channels" % (layer.size, channels))
+    var = F.reshape(layer.var, shape=[-1, channels, hw, hw])
+    return var, channels, hw, hw
+
+
+_OUTPUTS = []
+
+
+def outputs(*layers):
+    """reference: config_parser outputs() — records the config's output
+    layers (cost first for training configs)."""
+    del _OUTPUTS[:]
+    for l in layers:
+        _OUTPUTS.append(l)
+
+
+def get_output_layers():
+    return list(_OUTPUTS)
+
+
+# ---------------------------------------------------------------------------
+# data / fc / embedding
+
+def _register_data_var(var):
+    """Record feed declaration order on the program (v2 Topology reads it
+    to map reader tuple positions -> feeds, reference v2/topology.py
+    data_type())."""
+    var.is_data = True
+    prog = ir.default_main_program()
+    if not hasattr(prog, "_data_vars_order"):
+        prog._data_vars_order = []
+    prog._data_vars_order.append(var)
+
+
+def data_layer(name, size, height=None, width=None, dtype="float32",
+               is_seq=False):
+    """reference: layers.py data_layer — flat dense vector (or int ids when
+    dtype is integral); height/width tag image shape for conv layers."""
+    lod = 1 if is_seq else 0
+    if dtype.startswith("int"):
+        var = F.data(name=name, shape=[1], dtype=dtype, lod_level=lod)
+        _register_data_var(var)
+        return LayerOutput(name, var, size=size)
+    var = F.data(name=name, shape=[size], dtype=dtype, lod_level=lod)
+    _register_data_var(var)
+    out = LayerOutput(name, var, size=size)
+    if height and width:
+        out.channels = size // (height * width)
+        out.height, out.width = height, width
+        out.var = F.reshape(var, shape=[-1, out.channels, height, width])
+    return out
+
+
+def _flatten(layer):
+    if layer.channels is not None:
+        size = layer.channels * layer.height * layer.width
+        return F.reshape(layer.var, shape=[-1, size]), size
+    return layer.var, layer.size
+
+
+def fc_layer(input, size, act=None, name=None, param_attr=None,
+             bias_attr=None, layer_attr=None):
+    """reference: layers.py fc_layer:1013."""
+    ins = input if isinstance(input, (list, tuple)) else [input]
+    flat = [_flatten(l)[0] for l in ins]
+    var = F.fc(flat, size=size, act=_act_name(act),
+               param_attr=_param(param_attr), bias_attr=_bias(bias_attr),
+               name=name)
+    return LayerOutput(name or var.name, var, size=size)
+
+
+def embedding_layer(input, size, name=None, param_attr=None):
+    """reference: layers.py embedding_layer (table_projection over ids)."""
+    var = F.embedding(input.var, size=[input.size, size],
+                      param_attr=_param(param_attr))
+    return LayerOutput(name or var.name, var, size=size)
+
+
+# ---------------------------------------------------------------------------
+# image stack
+
+def img_conv_layer(input, filter_size, num_filters, name=None,
+                   num_channels=None, act=None, groups=1, stride=1,
+                   padding=0, dilation=1, bias_attr=None, param_attr=None,
+                   shared_biases=True, layer_attr=None, trans=False,
+                   filter_size_y=None, stride_y=None, padding_y=None):
+    """reference: layers.py img_conv_layer (ExpandConvLayer / cudnn conv)."""
+    var, c, h, w = _as_image(input, num_channels)
+    fy = filter_size_y or filter_size
+    sy = stride_y or stride
+    py = padding_y if padding_y is not None else padding
+    out = F.conv2d(var, num_filters=num_filters,
+                   filter_size=(filter_size, fy),
+                   stride=(stride, sy), padding=(padding, py),
+                   dilation=dilation, groups=groups, act=_act_name(act),
+                   param_attr=_param(param_attr), bias_attr=_bias(bias_attr),
+                   name=name)
+    oh = (h + 2 * padding - dilation * (filter_size - 1) - 1) // stride + 1
+    ow = (w + 2 * py - dilation * (fy - 1) - 1) // sy + 1
+    return LayerOutput(name or out.name, out,
+                       size=num_filters * oh * ow,
+                       channels=num_filters, height=oh, width=ow)
+
+
+def img_pool_layer(input, pool_size, name=None, num_channels=None,
+                   pool_type=None, stride=1, padding=0, pool_size_y=None,
+                   stride_y=None, padding_y=None, ceil_mode=True,
+                   layer_attr=None):
+    """reference: layers.py img_pool_layer."""
+    var, c, h, w = _as_image(input, num_channels)
+    pt = (pool_type or MaxPooling()).name
+    is_sum = pt == "sum"
+    if is_sum:  # spatial sum pool = avg * window area (reference semantics)
+        pt = "avg"
+    py = pool_size_y or pool_size
+    sy = stride_y or stride
+    pdy = padding_y if padding_y is not None else padding
+    out = F.pool2d(var, pool_size=(pool_size, py), pool_type=pt,
+                   pool_stride=(stride, sy), pool_padding=(padding, pdy),
+                   ceil_mode=ceil_mode, name=name)
+    if is_sum:
+        out = F.scale(out, scale=float(pool_size * py))
+
+    def odim(i, k, p, s):
+        if ceil_mode:
+            return (i - k + 2 * p + s - 1) // s + 1
+        return (i - k + 2 * p) // s + 1
+
+    oh, ow = odim(h, pool_size, padding, stride), odim(w, py, pdy, sy)
+    return LayerOutput(name or out.name, out, size=c * oh * ow,
+                       channels=c, height=oh, width=ow)
+
+
+def batch_norm_layer(input, name=None, act=None, num_channels=None,
+                     bias_attr=None, param_attr=None, layer_attr=None,
+                     use_global_stats=None, moving_average_fraction=0.9):
+    """reference: layers.py batch_norm_layer."""
+    if input.channels is not None:
+        var = input.var
+    else:
+        var, _, _, _ = _as_image(input, num_channels)
+    out = F.batch_norm(var, act=_act_name(act),
+                       param_attr=_param(param_attr),
+                       bias_attr=_bias(bias_attr),
+                       is_test=bool(use_global_stats),
+                       momentum=moving_average_fraction, name=name)
+    return LayerOutput(name or out.name, out, size=input.size,
+                       channels=input.channels, height=input.height,
+                       width=input.width)
+
+
+def addto_layer(input, name=None, act=None, bias_attr=None,
+                layer_attr=None):
+    """reference: layers.py addto_layer (AddtoLayer: elementwise sum +
+    activation) — the residual-connection primitive."""
+    ins = input if isinstance(input, (list, tuple)) else [input]
+    out = ins[0].var
+    for l in ins[1:]:
+        out = F.elementwise_add(out, l.var)
+    a = _act_name(act)
+    if a:
+        out = getattr(F, a)(out)
+    first = ins[0]
+    return LayerOutput(name or out.name, out, size=first.size,
+                       channels=first.channels, height=first.height,
+                       width=first.width)
+
+
+def concat_layer(input, name=None, act=None, layer_attr=None):
+    """reference: layers.py concat_layer (channel concat for images,
+    feature concat for flat vectors)."""
+    ins = list(input)
+    if all(l.channels is not None for l in ins):
+        out = F.concat([l.var for l in ins], axis=1)
+        c = sum(l.channels for l in ins)
+        first = ins[0]
+        return LayerOutput(name or out.name, out,
+                           size=c * first.height * first.width, channels=c,
+                           height=first.height, width=first.width)
+    flats = [_flatten(l) for l in ins]
+    out = F.concat([v for v, _ in flats], axis=1)
+    return LayerOutput(name or out.name, out,
+                       size=sum(s for _, s in flats))
+
+
+def dropout_layer(input, dropout_rate, name=None):
+    """reference: layers.py dropout_layer."""
+    out = F.dropout(input.var, dropout_prob=dropout_rate, name=name)
+    return LayerOutput(name or out.name, out, size=input.size,
+                       channels=input.channels, height=input.height,
+                       width=input.width)
+
+
+# ---------------------------------------------------------------------------
+# sequence stack
+
+def pool_layer(input, pooling_type=None, name=None, agg_level=None,
+               layer_attr=None):
+    """Sequence pooling. reference: layers.py pool_layer."""
+    pt = (pooling_type or MaxPooling()).name
+    if pt == "sqrt":
+        pt = "sqrt"
+    out = F.sequence_pool(input.var, pool_type=pt)
+    return LayerOutput(name or out.name, out, size=input.size)
+
+
+def lstmemory(input, name=None, reverse=False, act=None,
+              gate_act=None, state_act=None, bias_attr=None,
+              param_attr=None, layer_attr=None):
+    """reference: layers.py lstmemory — the v1 LSTM over a pre-projected
+    input (callers project to 4*size first, as simple_lstm does)."""
+    size = input.size // 4
+    h, _ = F.dynamic_lstm(
+        input.var, size=input.size, is_reverse=reverse,
+        gate_activation=_act_name(gate_act) or "sigmoid",
+        cell_activation=_act_name(state_act) or "tanh",
+        candidate_activation=_act_name(act) or "tanh",
+        param_attr=_param(param_attr), bias_attr=_bias(bias_attr))
+    return LayerOutput(name or h.name, h, size=size)
+
+
+def grumemory(input, name=None, reverse=False, act=None, gate_act=None,
+              bias_attr=None, param_attr=None, layer_attr=None):
+    """reference: layers.py grumemory (input pre-projected to 3*size)."""
+    size = input.size // 3
+    h = F.dynamic_gru(
+        input.var, size=size, is_reverse=reverse,
+        gate_activation=_act_name(gate_act) or "sigmoid",
+        candidate_activation=_act_name(act) or "tanh",
+        param_attr=_param(param_attr), bias_attr=_bias(bias_attr))
+    return LayerOutput(name or h.name, h, size=size)
+
+
+# ---------------------------------------------------------------------------
+# mixed layer + projections (reference: layers.py mixed_layer / projections)
+
+class _Projection(object):
+    def __init__(self, build, size):
+        self.build = build     # fn() -> fluid var
+        self.size = size
+
+
+def full_matrix_projection(input, size, param_attr=None):
+    flat, _ = _flatten(input)
+    return _Projection(
+        lambda: F.fc(flat, size=size, bias_attr=False,
+                     param_attr=_param(param_attr)), size)
+
+
+def trans_full_matrix_projection(input, size, param_attr=None):
+    return full_matrix_projection(input, size, param_attr)
+
+
+def identity_projection(input, offset=None, size=None):
+    def build():
+        if offset:
+            end = offset + (size or input.size - offset)
+            return F.slice(input.var, axes=[1], starts=[offset],
+                           ends=[end])
+        return input.var
+    return _Projection(build, size or input.size)
+
+
+def table_projection(input, size, param_attr=None):
+    return _Projection(
+        lambda: F.embedding(input.var, size=[input.size, size],
+                            param_attr=_param(param_attr)), size)
+
+
+class mixed_layer(object):
+    """``with mixed_layer(size=..) as m: m += full_matrix_projection(..)``
+    reference: layers.py mixed_layer (MixedLayer summing projections)."""
+
+    def __init__(self, size=None, name=None, act=None, bias_attr=None,
+                 layer_attr=None, input=None):
+        self.size = size
+        self.name = name
+        self.act = act
+        self.bias_attr = bias_attr
+        self._projs = []
+        if input is not None:
+            for p in (input if isinstance(input, (list, tuple))
+                      else [input]):
+                self._projs.append(p)
+        self._out = None
+        if input is not None:
+            self._finalize()
+
+    def __iadd__(self, proj):
+        self._projs.append(proj)
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self._finalize()
+        return False
+
+    def _finalize(self):
+        if not self._projs:
+            raise ValueError("mixed_layer has no projections")
+        out = self._projs[0].build()
+        for p in self._projs[1:]:
+            out = F.elementwise_add(out, p.build())
+        a = _act_name(self.act)
+        if a:
+            out = getattr(F, a)(out)
+        size = self.size or self._projs[0].size
+        self._out = LayerOutput(self.name or out.name, out, size=size)
+
+    def __getattr__(self, item):
+        # delegate to the finalized LayerOutput (mixed_layer() is used as
+        # an input to other layers after the with-block)
+        if self._out is None:
+            raise AttributeError(item)
+        return getattr(self._out, item)
+
+
+# ---------------------------------------------------------------------------
+# costs / eval
+
+def max_id_layer(input, name=None):
+    out = F.argmax(input.var, axis=1)
+    return LayerOutput(name or "max_id", out, size=1)
+
+
+def classification_cost(input, label, name=None, weight=None,
+                        evaluator=None, layer_attr=None):
+    """reference: layers.py classification_cost (softmax output assumed)."""
+    cost = F.cross_entropy(input.var, label.var)
+    out = F.mean(cost)
+    return LayerOutput(name or out.name, out, size=1)
+
+
+def cross_entropy(input, label, name=None, coeff=1.0, weight=None,
+                  layer_attr=None):
+    cost = F.mean(F.cross_entropy(input.var, label.var))
+    if coeff != 1.0:
+        cost = F.scale(cost, scale=coeff)
+    return LayerOutput(name or cost.name, cost, size=1)
+
+
+cross_entropy_with_selfnorm = cross_entropy
+
+
+def square_error_cost(input, label, name=None, coeff=1.0,
+                      layer_attr=None):
+    cost = F.mean(F.square_error_cost(input.var, label.var))
+    if coeff != 1.0:
+        cost = F.scale(cost, scale=coeff)
+    return LayerOutput(name or cost.name, cost, size=1)
+
+
+regression_cost = square_error_cost

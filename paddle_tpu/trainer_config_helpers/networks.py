@@ -1,0 +1,86 @@
+"""v1 network compositions.
+
+reference: python/paddle/trainer_config_helpers/networks.py
+(simple_img_conv_pool, img_conv_bn_pool, simple_lstm, bidirectional_lstm,
+simple_gru — macro layers over the DSL).
+"""
+from __future__ import annotations
+
+from .activations import (LinearActivation, ReluActivation,
+                          SigmoidActivation, TanhActivation)
+from .layers import (batch_norm_layer, fc_layer, img_conv_layer,
+                     img_pool_layer, lstmemory, grumemory, pool_layer)
+from .poolings import MaxPooling
+
+__all__ = ["simple_img_conv_pool", "img_conv_bn_pool", "simple_lstm",
+           "simple_gru", "bidirectional_lstm"]
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
+                         name=None, pool_type=None, act=None, groups=1,
+                         conv_stride=1, conv_padding=0, bias_attr=None,
+                         num_channel=None, param_attr=None,
+                         pool_stride=1, pool_padding=0):
+    conv = img_conv_layer(input=input, filter_size=filter_size,
+                          num_filters=num_filters, num_channels=num_channel,
+                          act=act, groups=groups, stride=conv_stride,
+                          padding=conv_padding, bias_attr=bias_attr,
+                          param_attr=param_attr,
+                          name="%s_conv" % name if name else None)
+    return img_pool_layer(input=conv, pool_size=pool_size,
+                          pool_type=pool_type or MaxPooling(),
+                          stride=pool_stride, padding=pool_padding,
+                          name="%s_pool" % name if name else None)
+
+
+def img_conv_bn_pool(input, filter_size, num_filters, pool_size, name=None,
+                     pool_type=None, act=None, groups=1, conv_stride=1,
+                     conv_padding=0, conv_bias_attr=None, num_channel=None,
+                     conv_param_attr=None, pool_stride=1, pool_padding=0):
+    conv = img_conv_layer(input=input, filter_size=filter_size,
+                          num_filters=num_filters, num_channels=num_channel,
+                          act=LinearActivation(), groups=groups,
+                          stride=conv_stride, padding=conv_padding,
+                          bias_attr=conv_bias_attr,
+                          param_attr=conv_param_attr,
+                          name="%s_conv" % name if name else None)
+    bn = batch_norm_layer(input=conv, act=act,
+                          name="%s_bn" % name if name else None)
+    return img_pool_layer(input=bn, pool_size=pool_size,
+                          pool_type=pool_type or MaxPooling(),
+                          stride=pool_stride, padding=pool_padding,
+                          name="%s_pool" % name if name else None)
+
+
+def simple_lstm(input, size, name=None, reverse=False, mat_param_attr=None,
+                bias_param_attr=None, inner_param_attr=None, act=None,
+                gate_act=None, state_act=None):
+    """fc (4*size) + lstmemory. reference: networks.py simple_lstm."""
+    fc = fc_layer(input=input, size=size * 4, act=LinearActivation(),
+                  param_attr=mat_param_attr, bias_attr=False,
+                  name="%s_transform" % name if name else None)
+    return lstmemory(input=fc, name=name, reverse=reverse, act=act,
+                     gate_act=gate_act, state_act=state_act,
+                     param_attr=inner_param_attr,
+                     bias_attr=bias_param_attr)
+
+
+def simple_gru(input, size, name=None, reverse=False, mixed_param_attr=None,
+               gru_param_attr=None, act=None, gate_act=None):
+    fc = fc_layer(input=input, size=size * 3, act=LinearActivation(),
+                  param_attr=mixed_param_attr, bias_attr=False,
+                  name="%s_transform" % name if name else None)
+    return grumemory(input=fc, name=name, reverse=reverse, act=act,
+                     gate_act=gate_act, param_attr=gru_param_attr)
+
+
+def bidirectional_lstm(input, size, name=None, return_seq=False):
+    fwd = simple_lstm(input=input, size=size, reverse=False,
+                      name="%s_fw" % (name or "bi_lstm"))
+    bwd = simple_lstm(input=input, size=size, reverse=True,
+                      name="%s_bw" % (name or "bi_lstm"))
+    from .layers import concat_layer
+    out = concat_layer(input=[fwd, bwd], name=name)
+    if return_seq:
+        return out
+    return pool_layer(input=out, pooling_type=MaxPooling())

@@ -1,0 +1,138 @@
+"""v1 optimizer objects + settings().
+
+reference: python/paddle/trainer_config_helpers/optimizers.py
+(BaseSGDOptimizer subclasses + settings() writing the global TrainerConfig).
+Here each maps onto the fluid optimizer classes; ``settings`` records the
+choice in a module-global config the runner/v2-trainer consumes.
+"""
+from __future__ import annotations
+
+from .. import optimizer as _opt
+from .. import regularizer as _reg
+
+__all__ = ["settings", "get_settings", "MomentumOptimizer", "AdamOptimizer",
+           "AdamaxOptimizer", "AdaGradOptimizer", "DecayedAdaGradOptimizer",
+           "AdaDeltaOptimizer", "RMSPropOptimizer",
+           "L2Regularization", "L1Regularization", "BaseSGDOptimizer"]
+
+
+class BaseSGDOptimizer(object):
+    def to_fluid(self, learning_rate, regularization=None):
+        raise NotImplementedError
+
+
+class MomentumOptimizer(BaseSGDOptimizer):
+    def __init__(self, momentum=0.9, sparse=False):
+        self.momentum = momentum
+
+    def to_fluid(self, learning_rate, regularization=None):
+        return _opt.Momentum(learning_rate=learning_rate,
+                             momentum=self.momentum,
+                             regularization=regularization)
+
+
+class AdamOptimizer(BaseSGDOptimizer):
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8):
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def to_fluid(self, learning_rate, regularization=None):
+        return _opt.Adam(learning_rate=learning_rate, beta1=self.beta1,
+                         beta2=self.beta2, epsilon=self.epsilon,
+                         regularization=regularization)
+
+
+class AdamaxOptimizer(BaseSGDOptimizer):
+    def __init__(self, beta1=0.9, beta2=0.999):
+        self.beta1, self.beta2 = beta1, beta2
+
+    def to_fluid(self, learning_rate, regularization=None):
+        return _opt.Adamax(learning_rate=learning_rate, beta1=self.beta1,
+                           beta2=self.beta2,
+                           regularization=regularization)
+
+
+class AdaGradOptimizer(BaseSGDOptimizer):
+    def __init__(self, epsilon=1e-6):
+        self.epsilon = epsilon
+
+    def to_fluid(self, learning_rate, regularization=None):
+        return _opt.Adagrad(learning_rate=learning_rate,
+                            epsilon=self.epsilon,
+                            regularization=regularization)
+
+
+class DecayedAdaGradOptimizer(BaseSGDOptimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6):
+        self.rho, self.epsilon = rho, epsilon
+
+    def to_fluid(self, learning_rate, regularization=None):
+        return _opt.DecayedAdagrad(learning_rate=learning_rate,
+                                   decay=self.rho, epsilon=self.epsilon,
+                                   regularization=regularization)
+
+
+class AdaDeltaOptimizer(BaseSGDOptimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6):
+        self.rho, self.epsilon = rho, epsilon
+
+    def to_fluid(self, learning_rate, regularization=None):
+        return _opt.Adadelta(learning_rate=learning_rate, rho=self.rho,
+                             epsilon=self.epsilon,
+                             regularization=regularization)
+
+
+class RMSPropOptimizer(BaseSGDOptimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6):
+        self.rho, self.epsilon = rho, epsilon
+
+    def to_fluid(self, learning_rate, regularization=None):
+        return _opt.RMSProp(learning_rate=learning_rate, rho=self.rho,
+                            epsilon=self.epsilon,
+                            regularization=regularization)
+
+
+class L2Regularization(object):
+    def __init__(self, rate):
+        self.rate = rate
+
+    def to_fluid(self):
+        return _reg.L2DecayRegularizer(self.rate)
+
+
+class L1Regularization(object):
+    def __init__(self, rate):
+        self.rate = rate
+
+    def to_fluid(self):
+        return _reg.L1DecayRegularizer(self.rate)
+
+
+_SETTINGS = {}
+
+
+def settings(batch_size=None, learning_rate=1e-3, learning_method=None,
+             regularization=None, gradient_clipping_threshold=None,
+             **kwargs):
+    """Record the trainer config (reference: optimizers.py settings() -> the
+    global TrainerConfig proto). Consumed by make_optimizer()/the runner."""
+    _SETTINGS.clear()
+    _SETTINGS.update(dict(
+        batch_size=batch_size, learning_rate=learning_rate,
+        learning_method=learning_method or MomentumOptimizer(0.0),
+        regularization=regularization,
+        gradient_clipping_threshold=gradient_clipping_threshold))
+    _SETTINGS.update(kwargs)
+
+
+def get_settings():
+    return dict(_SETTINGS)
+
+
+def make_optimizer():
+    """fluid optimizer from the last settings() call."""
+    if not _SETTINGS:
+        raise RuntimeError("settings(...) has not been called")
+    reg = _SETTINGS.get("regularization")
+    return _SETTINGS["learning_method"].to_fluid(
+        _SETTINGS["learning_rate"],
+        regularization=reg.to_fluid() if reg is not None else None)

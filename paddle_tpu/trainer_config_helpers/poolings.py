@@ -1,0 +1,40 @@
+"""v1 pooling type objects.
+
+reference: python/paddle/trainer_config_helpers/poolings.py — names map to
+paddle/gserver pooling implementations; here to fluid pool_type strings
+(spatial pooling) and sequence_pool types.
+"""
+
+__all__ = ["BasePoolingType", "MaxPooling", "AvgPooling", "SumPooling",
+           "SquareRootNPooling", "CudnnMaxPooling", "CudnnAvgPooling",
+           "MaxWithMaskPoolingType"]
+
+
+class BasePoolingType(object):
+    name = None
+
+    def __repr__(self):
+        return "%s()" % type(self).__name__
+
+
+class MaxPooling(BasePoolingType):
+    name = "max"
+
+
+CudnnMaxPooling = MaxPooling
+MaxWithMaskPoolingType = MaxPooling
+
+
+class AvgPooling(BasePoolingType):
+    name = "avg"
+
+
+CudnnAvgPooling = AvgPooling
+
+
+class SumPooling(BasePoolingType):
+    name = "sum"
+
+
+class SquareRootNPooling(BasePoolingType):
+    name = "sqrt"

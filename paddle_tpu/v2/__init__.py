@@ -1,0 +1,33 @@
+"""paddle.v2-compatible API over the fluid/TPU path.
+
+reference: python/paddle/v2/__init__.py — the event-loop era user API:
+``layer``/``activation``/``attr``/``pooling``/``data_type`` build the
+topology, ``parameters.create`` materialises weights, ``SGD.train`` drives
+passes firing events, ``infer`` runs the forward. Here every piece is a
+facade over the fluid Program path (one jitted XLA step underneath).
+"""
+from __future__ import annotations
+
+from . import activation          # noqa: F401
+from . import attr                # noqa: F401
+from . import config              # noqa: F401
+from . import data_type           # noqa: F401
+from . import event               # noqa: F401
+from . import layer               # noqa: F401
+from . import networks            # noqa: F401
+from . import optimizer           # noqa: F401
+from . import parameters          # noqa: F401
+from . import pooling             # noqa: F401
+from . import topology            # noqa: F401
+from .minibatch import batch      # noqa: F401
+from .trainer import SGD          # noqa: F401
+from .inference import infer, Inference  # noqa: F401
+
+from .. import dataset            # noqa: F401
+from .. import reader             # noqa: F401
+
+
+def init(use_gpu=False, trainer_count=1, **kwargs):
+    """reference: python/paddle/v2/__init__.py init() (swig_paddle.initPaddle
+    flags). Devices are managed by jax; this validates args and is a no-op."""
+    return None

@@ -1,0 +1,23 @@
+"""v2 activation objects. reference: python/paddle/v2/activation.py —
+renames the v1 activation classes (Relu, Sigmoid, ...)."""
+from ..trainer_config_helpers import activations as _a
+
+Base = _a.BaseActivation
+Tanh = _a.TanhActivation
+Sigmoid = _a.SigmoidActivation
+Softmax = _a.SoftmaxActivation
+Relu = _a.ReluActivation
+BRelu = _a.BReluActivation
+SoftRelu = _a.SoftReluActivation
+STanh = _a.STanhActivation
+Linear = _a.LinearActivation
+Identity = _a.LinearActivation
+Exp = _a.ExpActivation
+Abs = _a.AbsActivation
+Square = _a.SquareActivation
+Log = _a.LogActivation
+SequenceSoftmax = _a.SequenceSoftmaxActivation
+
+__all__ = ["Base", "Tanh", "Sigmoid", "Softmax", "Relu", "BRelu",
+           "SoftRelu", "STanh", "Linear", "Identity", "Exp", "Abs",
+           "Square", "Log", "SequenceSoftmax"]

@@ -1,0 +1,11 @@
+"""v2 attrs. reference: python/paddle/v2/attr.py (Param/Extra aliases)."""
+from ..trainer_config_helpers.attrs import (ParameterAttribute,
+                                            ExtraLayerAttribute)
+
+Param = ParameterAttribute
+Extra = ExtraLayerAttribute
+ParamAttr = ParameterAttribute
+ExtraAttr = ExtraLayerAttribute
+
+__all__ = ["Param", "Extra", "ParamAttr", "ExtraAttr",
+           "ParameterAttribute", "ExtraLayerAttribute"]

@@ -1,0 +1,23 @@
+"""The v2 shim's program bookkeeping.
+
+The reference v2 API builds a config graph lazily and parses it per
+Topology (python/paddle/v2/config_base.py, topology.py). Here layer calls
+append fluid ops eagerly into a module-managed (main, startup) program pair
+— Program-as-config — and Topology/Parameters/SGD all reference it.
+``reset()`` starts a fresh model (what a new interpreter run is to the
+reference).
+"""
+from __future__ import annotations
+
+from ..core import ir
+
+
+def programs():
+    """The CURRENT default program pair — never cached: a second model in
+    the same process (or a test fixture) switches the defaults, and a stale
+    cache would bind its Topology/Parameters to the first model."""
+    return ir.default_main_program(), ir.default_startup_program()
+
+
+def reset():
+    """Kept for API compatibility; programs() always reads the defaults."""

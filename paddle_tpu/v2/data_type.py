@@ -1,0 +1,54 @@
+"""v2 data types: name the wire format of each data layer.
+
+reference: python/paddle/v2/data_type.py (InputType over dense/sparse/int,
+seq_type NO_SEQUENCE/SEQUENCE/SUB_SEQUENCE).
+"""
+from __future__ import annotations
+
+
+class InputType(object):
+    def __init__(self, dim, seq_type, dtype, shape):
+        self.dim = dim
+        self.seq_type = seq_type     # 0 none, 1 sequence, 2 sub-sequence
+        self.dtype = dtype
+        self.shape = shape
+
+
+def dense_vector(dim, seq_type=0):
+    return InputType(dim, seq_type, "float32", [dim])
+
+
+def dense_array(dim, seq_type=0):
+    return dense_vector(dim, seq_type)
+
+
+def dense_vector_sequence(dim):
+    return dense_vector(dim, seq_type=1)
+
+
+def integer_value(value_range, seq_type=0):
+    return InputType(value_range, seq_type, "int64", [1])
+
+
+def integer_value_sequence(value_range):
+    return integer_value(value_range, seq_type=1)
+
+
+def sparse_binary_vector(dim, seq_type=0):
+    """Ids of the active positions; fed as an int sequence and embedded/
+    one-hot downstream (the dense TPU representation)."""
+    return InputType(dim, seq_type, "int64", [1])
+
+
+def sparse_binary_vector_sequence(dim):
+    return sparse_binary_vector(dim, seq_type=1)
+
+
+sparse_float_vector = sparse_binary_vector
+sparse_vector = sparse_binary_vector
+
+__all__ = ["InputType", "dense_vector", "dense_array",
+           "dense_vector_sequence", "integer_value",
+           "integer_value_sequence", "sparse_binary_vector",
+           "sparse_binary_vector_sequence", "sparse_float_vector",
+           "sparse_vector"]

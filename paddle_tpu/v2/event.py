@@ -1,0 +1,42 @@
+"""v2 training events. reference: python/paddle/v2/event.py."""
+from __future__ import annotations
+
+__all__ = ["BeginPass", "EndPass", "BeginIteration", "EndIteration",
+           "TestResult"]
+
+
+class WithMetric(object):
+    def __init__(self, evaluator=None):
+        self.evaluator = evaluator
+
+
+class TestResult(WithMetric):
+    def __init__(self, evaluator=None, cost=None):
+        super(TestResult, self).__init__(evaluator)
+        self.cost = cost
+
+
+class BeginPass(object):
+    def __init__(self, pass_id):
+        self.pass_id = pass_id
+
+
+class EndPass(WithMetric):
+    def __init__(self, pass_id, evaluator=None, gm=None):
+        super(EndPass, self).__init__(evaluator)
+        self.pass_id = pass_id
+
+
+class BeginIteration(object):
+    def __init__(self, pass_id, batch_id):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+
+
+class EndIteration(WithMetric):
+    def __init__(self, pass_id, batch_id, cost, evaluator=None):
+        super(EndIteration, self).__init__(evaluator)
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.cost = cost
+        self.metrics = {"cost": cost}

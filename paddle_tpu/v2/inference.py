@@ -1,0 +1,42 @@
+"""v2 inference. reference: python/paddle/v2/inference.py (Inference
+wraps a topology+parameters; infer() runs the forward over input rows)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .parameters import Parameters
+from .topology import Topology
+from .trainer import _feed_from_batch
+
+__all__ = ["Inference", "infer"]
+
+
+class Inference(object):
+    def __init__(self, output_layer, parameters):
+        from .. import Executor, CPUPlace
+        self.topology = Topology(output_layer)
+        self.outputs = [l.var for l in self.topology.layers]
+        self.parameters = parameters if isinstance(parameters, Parameters) \
+            else None
+        self._raw_params = None if self.parameters is not None else \
+            parameters
+        self.exe = Executor(CPUPlace())
+        self._data_vars = self.topology.data_type()
+        self.program = self.topology.main_program.prune(
+            feeds=[n for n, _ in self._data_vars],
+            fetches=[v.name for v in self.outputs])
+
+    def infer(self, input, feeding=None, field="value"):
+        scope = self.parameters.scope if self.parameters is not None \
+            else None
+        feed = _feed_from_batch(self._data_vars, input, feeding)
+        outs = self.exe.run(self.program, feed=feed,
+                            fetch_list=self.outputs, scope=scope)
+        res = [np.asarray(o.numpy() if hasattr(o, "numpy") else o)
+               for o in outs]
+        return res[0] if len(res) == 1 else res
+
+
+def infer(output_layer, parameters, input, feeding=None, field="value"):
+    return Inference(output_layer, parameters).infer(input, feeding=feeding,
+                                                     field=field)

@@ -1,0 +1,55 @@
+"""v2 layer functions.
+
+reference: python/paddle/v2/layer.py — exposes the v1 DSL's layers under
+v2 names (``fc`` for fc_layer, ``img_conv`` for img_conv_layer, ...), with
+``data`` typed by v2 data_type. Each call appends fluid ops eagerly (see
+config.py) and returns the shared LayerOutput.
+"""
+from __future__ import annotations
+
+from ..trainer_config_helpers import layers as _v1
+from ..trainer_config_helpers.layers import LayerOutput  # noqa: F401
+from .data_type import InputType
+
+__all__ = [
+    "data", "fc", "embedding", "img_conv", "img_pool", "batch_norm",
+    "addto", "concat", "dropout", "pooling", "lstmemory", "grumemory",
+    "max_id", "classification_cost", "cross_entropy_cost",
+    "square_error_cost", "mixed", "full_matrix_projection",
+    "identity_projection", "table_projection", "parse_network",
+]
+
+
+def data(name, type, height=None, width=None):
+    assert isinstance(type, InputType), "v2 layer.data needs a data_type"
+    return _v1.data_layer(name=name, size=type.dim, height=height,
+                          width=width, dtype=type.dtype,
+                          is_seq=type.seq_type > 0)
+
+
+fc = _v1.fc_layer
+embedding = _v1.embedding_layer
+img_conv = _v1.img_conv_layer
+img_pool = _v1.img_pool_layer
+batch_norm = _v1.batch_norm_layer
+addto = _v1.addto_layer
+concat = _v1.concat_layer
+dropout = _v1.dropout_layer
+pooling = _v1.pool_layer
+lstmemory = _v1.lstmemory
+grumemory = _v1.grumemory
+max_id = _v1.max_id_layer
+classification_cost = _v1.classification_cost
+cross_entropy_cost = _v1.cross_entropy
+square_error_cost = _v1.square_error_cost
+mixed = _v1.mixed_layer
+full_matrix_projection = _v1.full_matrix_projection
+identity_projection = _v1.identity_projection
+table_projection = _v1.table_projection
+
+
+def parse_network(*outputs):
+    """reference: v2/layer.py parse_network — resolve output layers into
+    the underlying model config; here: the fluid main program."""
+    from .config import programs
+    return programs()[0]

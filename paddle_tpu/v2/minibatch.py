@@ -1,0 +1,4 @@
+"""v2 minibatch. reference: python/paddle/v2/minibatch.py (batch)."""
+from ..reader import batch  # noqa: F401
+
+__all__ = ["batch"]

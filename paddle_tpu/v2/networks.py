@@ -1,0 +1,8 @@
+"""v2 network macros. reference: python/paddle/v2/networks.py (re-exports
+trainer_config_helpers.networks under v2 naming)."""
+from ..trainer_config_helpers.networks import (  # noqa: F401
+    simple_img_conv_pool, img_conv_bn_pool, simple_lstm, simple_gru,
+    bidirectional_lstm)
+
+__all__ = ["simple_img_conv_pool", "img_conv_bn_pool", "simple_lstm",
+           "simple_gru", "bidirectional_lstm"]

@@ -1,0 +1,110 @@
+"""v2 optimizers.
+
+reference: python/paddle/v2/optimizer.py — classes bundling learning rate,
+method, and regularization into one object passed to SGD(update_equation=).
+"""
+from __future__ import annotations
+
+from .. import optimizer as _opt
+from .. import regularizer as _reg
+
+__all__ = ["Optimizer", "Momentum", "Adam", "Adamax", "AdaGrad",
+           "DecayedAdaGrad", "AdaDelta", "RMSProp",
+           "ModelAverage", "L2Regularization"]
+
+
+class L2Regularization(object):
+    def __init__(self, rate):
+        self.rate = rate
+
+
+def _reg_of(regularization):
+    if regularization is None:
+        return None
+    return _reg.L2DecayRegularizer(regularization.rate)
+
+
+class Optimizer(object):
+    def to_fluid(self):
+        raise NotImplementedError
+
+
+class Momentum(Optimizer):
+    def __init__(self, momentum=0.9, learning_rate=1e-3, sparse=False,
+                 regularization=None, model_average=None, **kw):
+        self.kw = dict(learning_rate=learning_rate, momentum=momentum,
+                       regularization=_reg_of(regularization))
+
+    def to_fluid(self):
+        return _opt.Momentum(**self.kw)
+
+
+class Adam(Optimizer):
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 learning_rate=1e-3, regularization=None,
+                 model_average=None, **kw):
+        self.kw = dict(learning_rate=learning_rate, beta1=beta1,
+                       beta2=beta2, epsilon=epsilon,
+                       regularization=_reg_of(regularization))
+
+    def to_fluid(self):
+        return _opt.Adam(**self.kw)
+
+
+class Adamax(Optimizer):
+    def __init__(self, beta1=0.9, beta2=0.999, learning_rate=1e-3,
+                 regularization=None, **kw):
+        self.kw = dict(learning_rate=learning_rate, beta1=beta1,
+                       beta2=beta2, regularization=_reg_of(regularization))
+
+    def to_fluid(self):
+        return _opt.Adamax(**self.kw)
+
+
+class AdaGrad(Optimizer):
+    def __init__(self, learning_rate=1e-3, epsilon=1e-6,
+                 regularization=None, **kw):
+        self.kw = dict(learning_rate=learning_rate, epsilon=epsilon,
+                       regularization=_reg_of(regularization))
+
+    def to_fluid(self):
+        return _opt.Adagrad(**self.kw)
+
+
+class DecayedAdaGrad(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, learning_rate=1e-3,
+                 regularization=None, **kw):
+        self.kw = dict(learning_rate=learning_rate, decay=rho,
+                       epsilon=epsilon,
+                       regularization=_reg_of(regularization))
+
+    def to_fluid(self):
+        return _opt.DecayedAdagrad(**self.kw)
+
+
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, learning_rate=1e-3,
+                 regularization=None, **kw):
+        self.kw = dict(learning_rate=learning_rate, rho=rho,
+                       epsilon=epsilon,
+                       regularization=_reg_of(regularization))
+
+    def to_fluid(self):
+        return _opt.Adadelta(**self.kw)
+
+
+class RMSProp(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, learning_rate=1e-3,
+                 regularization=None, **kw):
+        self.kw = dict(learning_rate=learning_rate, rho=rho,
+                       epsilon=epsilon,
+                       regularization=_reg_of(regularization))
+
+    def to_fluid(self):
+        return _opt.RMSProp(**self.kw)
+
+
+class ModelAverage(object):
+    def __init__(self, average_window, max_average_window=None, **kw):
+        self.average_window = average_window
+        self.max_average_window = max_average_window

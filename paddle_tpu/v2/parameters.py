@@ -1,0 +1,106 @@
+"""v2 Parameters: dict-like facade over the scope's parameter values.
+
+reference: python/paddle/v2/parameters.py:441 (Parameters: names/get/set/
+to_tar/from_tar over the gradient machine's args).
+"""
+from __future__ import annotations
+
+import tarfile
+import io
+
+import numpy as np
+
+from ..core.scope import global_scope
+
+__all__ = ["create", "Parameters"]
+
+
+def create(topology):
+    """Initialise (startup program) and wrap the topology's parameters.
+    Accepts a Topology or output LayerOutput(s), like the reference
+    (parameters.create(cost))."""
+    from .topology import Topology
+    if not isinstance(topology, Topology):
+        topology = Topology(topology)
+    from .. import Executor, CPUPlace
+    p = Parameters(topology)
+    exe = Executor(CPUPlace())
+    exe.run(topology.startup_program, scope=p.scope)
+    return p
+
+
+class Parameters(object):
+    def __init__(self, topology, scope=None):
+        self.topology = topology
+        self.scope = scope or global_scope()
+        from ..core import ir
+        self._names = [v.name for v in topology.main_program.list_vars()
+                       if isinstance(v, ir.Parameter)]
+
+    def names(self):
+        return list(self._names)
+
+    def keys(self):
+        return self.names()
+
+    def has_key(self, key):
+        return key in self._names
+
+    def __contains__(self, key):
+        return key in self._names
+
+    def __iter__(self):
+        return iter(self._names)
+
+    def __len__(self):
+        return len(self._names)
+
+    def get(self, name):
+        v = self.scope.find_var(name)
+        if v is None:
+            raise KeyError("parameter %r not initialised" % name)
+        return np.asarray(v)
+
+    def __getitem__(self, name):
+        return self.get(name)
+
+    def set(self, name, value):
+        import jax.numpy as jnp
+        self.scope.set_var(name, jnp.asarray(value))
+
+    def __setitem__(self, name, value):
+        self.set(name, value)
+
+    def get_shape(self, name):
+        return tuple(self.get(name).shape)
+
+    def to_tar(self, f):
+        """reference: parameters.py to_tar (one member per parameter)."""
+        with tarfile.open(fileobj=f, mode="w") as tar:
+            for n in self._names:
+                buf = io.BytesIO()
+                np.save(buf, self.get(n))
+                data = buf.getvalue()
+                info = tarfile.TarInfo(name=n)
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+
+    @staticmethod
+    def from_tar(f, topology=None):
+        """-> {name: ndarray}; pass a topology to get a bound Parameters."""
+        out = {}
+        with tarfile.open(fileobj=f, mode="r") as tar:
+            for m in tar.getmembers():
+                buf = io.BytesIO(tar.extractfile(m).read())
+                out[m.name] = np.load(buf)
+        if topology is None:
+            return out
+        p = Parameters(topology)
+        for n, v in out.items():
+            p.set(n, v)
+        return p
+
+    def init_from_tar(self, f):
+        for n, v in Parameters.from_tar(f).items():
+            if n in self._names:
+                self.set(n, v)

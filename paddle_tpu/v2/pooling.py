@@ -1,0 +1,11 @@
+"""v2 pooling objects. reference: python/paddle/v2/pooling.py."""
+from ..trainer_config_helpers import poolings as _p
+
+Max = _p.MaxPooling
+CudnnMax = _p.MaxPooling
+Avg = _p.AvgPooling
+CudnnAvg = _p.AvgPooling
+Sum = _p.SumPooling
+SquareRootN = _p.SquareRootNPooling
+
+__all__ = ["Max", "CudnnMax", "Avg", "CudnnAvg", "Sum", "SquareRootN"]

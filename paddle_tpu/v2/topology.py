@@ -1,0 +1,41 @@
+"""v2 Topology: the set of output layers + their program.
+
+reference: python/paddle/v2/topology.py:145 — wraps the parsed ModelConfig,
+answers data-layer ordering and proto serialization. Here it binds the
+output LayerOutputs to the fluid (main, startup) programs they were built
+into.
+"""
+from __future__ import annotations
+
+from ..trainer_config_helpers.layers import LayerOutput
+from .config import programs
+
+__all__ = ["Topology"]
+
+
+class Topology(object):
+    def __init__(self, layers, extra_layers=None):
+        if isinstance(layers, LayerOutput):
+            layers = [layers]
+        self.layers = list(layers)
+        if extra_layers:
+            self.layers += list(extra_layers)
+        self.main_program, self.startup_program = programs()
+
+    def data_layers(self):
+        """name -> data var for every feed the topology needs."""
+        return {n: v for n, v in self.data_type()}
+
+    def data_type(self):
+        """[(name, var)] in declaration order (reference: topology.py
+        data_type() returns proto data types; callers zip with feeding
+        indices)."""
+        return [(v.name, v)
+                for v in getattr(self.main_program, "_data_vars_order", [])]
+
+    def proto(self):
+        return self.main_program
+
+    def serialize_for_inference(self, stream):
+        import pickle
+        pickle.dump([l.name for l in self.layers], stream)

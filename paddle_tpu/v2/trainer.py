@@ -1,0 +1,95 @@
+"""v2 SGD trainer: the event-loop facade.
+
+reference: python/paddle/v2/trainer.py:37 (class SGD: __init__(cost,
+parameters, update_equation, extra_layers), train(reader, num_passes,
+event_handler, feeding), test(reader, feeding)) — the gradient-machine
+loop; here one fluid Executor jit step per batch.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import event as v2_event
+from .parameters import Parameters
+from .topology import Topology
+
+__all__ = ["SGD"]
+
+
+def _feed_from_batch(data_vars, batch_data, feeding):
+    """v2 readers yield tuples per sample; feeding maps name->index."""
+    from ..data_feeder import DataFeeder
+    order = sorted(feeding.items(), key=lambda kv: kv[1]) if feeding else \
+        [(name, i) for i, (name, _) in enumerate(data_vars)]
+    names = [n for n, _ in order]
+    by_name = dict(data_vars)
+    feeder = DataFeeder([by_name[n] for n in names], place=None)
+    return feeder.feed([[row[i] for n, i in order] for row in batch_data])
+
+
+class SGD(object):
+    def __init__(self, cost, parameters, update_equation, extra_layers=None,
+                 is_local=True, **kwargs):
+        from .. import Executor, CPUPlace
+        self.__topology__ = Topology(cost, extra_layers)
+        self.cost = self.__topology__.layers[0]
+        if not isinstance(parameters, Parameters):
+            raise TypeError("parameters must come from paddle.parameters."
+                            "create(...)")
+        self.parameters = parameters
+        self.__optimizer__ = update_equation.to_fluid()
+        self.__optimizer__.minimize(
+            self.cost.var,
+            startup_program=self.__topology__.startup_program)
+        self.exe = Executor(CPUPlace())
+        self._data_vars = self.__topology__.data_type()
+        # minimize() appended the accumulator init ops to the startup
+        # program AFTER parameters.create already ran it. Re-run it in the
+        # parameters' scope to materialise them, preserving any weights the
+        # user set in between (init_from_tar etc).
+        keep = {n: parameters.scope.find_var(n) for n in parameters.names()
+                if parameters.scope.find_var(n) is not None}
+        self.exe.run(self.__topology__.startup_program,
+                     scope=parameters.scope)
+        for n, v in keep.items():
+            parameters.scope.set_var(n, v)
+
+    def train(self, reader, num_passes=1, event_handler=None, feeding=None):
+        """reference: v2/trainer.py:137 — fires Begin/EndPass and
+        Begin/EndIteration around jitted train steps."""
+        handler = event_handler or (lambda e: None)
+        scope = self.parameters.scope
+        for pass_id in range(num_passes):
+            handler(v2_event.BeginPass(pass_id))
+            costs = []
+            for batch_id, batch_data in enumerate(reader()):
+                handler(v2_event.BeginIteration(pass_id, batch_id))
+                feed = _feed_from_batch(self._data_vars, batch_data,
+                                        feeding)
+                c, = self.exe.run(self.__topology__.main_program,
+                                  feed=feed, fetch_list=[self.cost.var],
+                                  scope=scope)
+                c = float(np.asarray(c).reshape(-1)[0])
+                costs.append(c)
+                handler(v2_event.EndIteration(pass_id, batch_id, c))
+            handler(v2_event.EndPass(pass_id, evaluator={
+                "cost": float(np.mean(costs)) if costs else float("nan")}))
+
+    def test(self, reader, feeding=None):
+        """reference: v2/trainer.py:217 — forward-only over a reader."""
+        scope = self.parameters.scope
+        test_prog = self.__topology__.main_program.prune(
+            feeds=[n for n, _ in self._data_vars],
+            fetches=[self.cost.var.name])
+        costs = []
+        for batch_data in reader():
+            feed = _feed_from_batch(self._data_vars, batch_data, feeding)
+            c, = self.exe.run(test_prog, feed=feed,
+                              fetch_list=[self.cost.var], scope=scope)
+            costs.append(float(np.asarray(c).reshape(-1)[0]))
+        return v2_event.TestResult(
+            evaluator={"cost": float(np.mean(costs))},
+            cost=float(np.mean(costs)))
+
+    def save_parameter_to_tar(self, f):
+        self.parameters.to_tar(f)

@@ -1,0 +1,285 @@
+"""v1 (trainer_config_helpers) and v2 API shims over the fluid path.
+
+reference models: benchmark/paddle/image/resnet.py (the v1 config the shim
+must run shape-for-shape), python/paddle/v2/tests/test_layer.py,
+python/paddle/v2/tests/test_topology.py, v2 mnist quickstart shape.
+"""
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+# ---------------------------------------------------------------------------
+# v1: the reference ResNet benchmark config, ported shape-for-shape
+# (reference: benchmark/paddle/image/resnet.py — conv_bn_layer /
+# bottleneck_block / mid_projection / layer_num dispatch)
+
+def _build_resnet_v1_config(height, width, num_class, layer_num,
+                            batch_size):
+    from paddle_tpu.trainer_config_helpers import (
+        data_layer, img_conv_layer, img_pool_layer, batch_norm_layer,
+        addto_layer, fc_layer, classification_cost, outputs, settings,
+        get_config_arg, set_config_args, MomentumOptimizer,
+        L2Regularization, LinearActivation, ReluActivation,
+        SoftmaxActivation, AvgPooling, MaxPooling)
+
+    set_config_args({"batch_size": batch_size, "layer_num": layer_num,
+                     "height": height, "width": width,
+                     "num_class": num_class})
+    batch_size = get_config_arg("batch_size", int, 64)
+    layer_num = get_config_arg("layer_num", int, 50)
+    height = get_config_arg("height", int, 224)
+    width = get_config_arg("width", int, 224)
+    num_class = get_config_arg("num_class", int, 1000)
+
+    settings(batch_size=batch_size, learning_rate=0.01 / batch_size,
+             learning_method=MomentumOptimizer(0.9),
+             regularization=L2Regularization(0.0005 * batch_size))
+
+    def conv_bn_layer(name, input, filter_size, num_filters, stride,
+                      padding, channels=None,
+                      active_type=ReluActivation()):
+        tmp = img_conv_layer(name=name + "_conv", input=input,
+                             filter_size=filter_size,
+                             num_channels=channels,
+                             num_filters=num_filters, stride=stride,
+                             padding=padding, act=LinearActivation(),
+                             bias_attr=False)
+        return batch_norm_layer(name=name + "_bn", input=tmp,
+                                act=active_type)
+
+    def bottleneck_block(name, input, num_filters1, num_filters2):
+        last_name = conv_bn_layer(name + "_branch2a", input, 1,
+                                  num_filters1, 1, 0)
+        last_name = conv_bn_layer(name + "_branch2b", last_name, 3,
+                                  num_filters1, 1, 1)
+        last_name = conv_bn_layer(name + "_branch2c", last_name, 1,
+                                  num_filters2, 1, 0,
+                                  active_type=LinearActivation())
+        return addto_layer(name=name + "_addto",
+                           input=[input, last_name],
+                           act=ReluActivation())
+
+    def mid_projection(name, input, num_filters1, num_filters2, stride=2):
+        branch1 = conv_bn_layer(name + "_branch1", input, 1, num_filters2,
+                                stride, 0,
+                                active_type=LinearActivation())
+        last_name = conv_bn_layer(name + "_branch2a", input, 1,
+                                  num_filters1, stride, 0)
+        last_name = conv_bn_layer(name + "_branch2b", last_name, 3,
+                                  num_filters1, 1, 1)
+        last_name = conv_bn_layer(name + "_branch2c", last_name, 1,
+                                  num_filters2, 1, 0,
+                                  active_type=LinearActivation())
+        return addto_layer(name=name + "_addto",
+                           input=[branch1, last_name],
+                           act=ReluActivation())
+
+    img = data_layer(name="image", size=height * width * 3, height=height,
+                     width=width)
+    lbl = data_layer(name="label", size=num_class, dtype="int64")
+
+    tmp = conv_bn_layer("conv1", img, filter_size=7, channels=3,
+                        num_filters=64, stride=2, padding=3)
+    tmp = img_pool_layer(name="pool1", input=tmp, pool_size=3, stride=2,
+                         pool_type=MaxPooling())
+
+    # layer_num dispatch (reference resnet.py: res2_1..res5_3 for 50)
+    assert layer_num == 50, "test ports the 50-layer branch"
+    depth_conf = [3, 4, 6, 3]
+    num_filters1 = [64, 128, 256, 512]
+    num_filters2 = [256, 512, 1024, 2048]
+    for stage, depth in enumerate(depth_conf):
+        for i in range(depth):
+            name = "res%d_%d" % (stage + 2, i + 1)
+            if i == 0:
+                tmp = mid_projection(name, tmp, num_filters1[stage],
+                                     num_filters2[stage],
+                                     stride=1 if stage == 0 else 2)
+            else:
+                tmp = bottleneck_block(name, tmp, num_filters1[stage],
+                                       num_filters2[stage])
+
+    tmp = img_pool_layer(name="pool5", input=tmp,
+                         pool_size=tmp.height, stride=1,
+                         pool_type=AvgPooling())
+    out = fc_layer(name="output", input=tmp, size=num_class,
+                   act=SoftmaxActivation())
+    cost = classification_cost(input=out, label=lbl)
+    outputs(cost)
+    return cost
+
+
+def test_v1_resnet50_benchmark_config_trains():
+    """The reference v1 ResNet-50 benchmark config structure trains through
+    the shim (reduced input resolution/batch for the CPU test)."""
+    from paddle_tpu.trainer_config_helpers import get_output_layers
+    from paddle_tpu.trainer_config_helpers.optimizers import make_optimizer
+
+    H = W = 16
+    bs, classes = 4, 10
+    cost = _build_resnet_v1_config(H, W, classes, 50, bs)
+    assert get_output_layers() == [cost]
+    make_optimizer().minimize(cost.var)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {"image": rng.rand(bs, 3 * H * W).astype("float32"),
+            "label": rng.randint(0, classes, (bs, 1)).astype("int64")}
+    losses = [float(np.asarray(exe.run(feed=feed,
+                                       fetch_list=[cost.var])[0])
+                    .reshape(-1)[0]) for _ in range(4)]
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
+    # jit path, single XLA computation per step
+    assert exe.stats["eager_runs"] == 0
+
+
+def test_v1_sequence_dsl():
+    """simple_lstm + pooling + cost over a ragged batch (v1 text path)."""
+    from paddle_tpu.trainer_config_helpers import (
+        data_layer, embedding_layer, fc_layer, classification_cost,
+        outputs, settings, AdamOptimizer, SoftmaxActivation)
+    from paddle_tpu.trainer_config_helpers.networks import simple_lstm
+    from paddle_tpu.trainer_config_helpers.layers import pool_layer
+    from paddle_tpu.trainer_config_helpers.poolings import MaxPooling
+    from paddle_tpu.trainer_config_helpers.optimizers import make_optimizer
+    from paddle_tpu.core.lod import build_lod_tensor
+
+    settings(batch_size=4, learning_rate=0.01,
+             learning_method=AdamOptimizer())
+    words = data_layer(name="words", size=100, dtype="int64", is_seq=True)
+    emb = embedding_layer(input=words, size=16)
+    lstm = simple_lstm(input=emb, size=8)
+    pooled = pool_layer(input=lstm, pooling_type=MaxPooling())
+    pred = fc_layer(input=pooled, size=2, act=SoftmaxActivation())
+    lbl = data_layer(name="label", size=2, dtype="int64")
+    cost = classification_cost(input=pred, label=lbl)
+    outputs(cost)
+    make_optimizer().minimize(cost.var)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(1)
+    seqs = [rng.randint(0, 100, (int(n), 1)).astype(np.int64)
+            for n in (3, 5, 2, 4)]
+    feed = {"words": build_lod_tensor(seqs),
+            "label": rng.randint(0, 2, (4, 1)).astype(np.int64)}
+    l0 = float(np.asarray(exe.run(feed=feed,
+                                  fetch_list=[cost.var])[0]).reshape(-1)[0])
+    for _ in range(5):
+        l = float(np.asarray(exe.run(feed=feed, fetch_list=[cost.var])[0])
+                  .reshape(-1)[0])
+    assert np.isfinite(l) and l < l0
+
+
+def test_v1_mixed_layer_projections():
+    from paddle_tpu.trainer_config_helpers import (
+        data_layer, mixed_layer, full_matrix_projection,
+        identity_projection, TanhActivation)
+
+    a = data_layer(name="a", size=8)
+    b = data_layer(name="b", size=8)
+    with mixed_layer(size=8, act=TanhActivation()) as m:
+        m += full_matrix_projection(input=a, size=8)
+        m += identity_projection(input=b)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(2)
+    out, = exe.run(feed={"a": rng.rand(2, 8).astype("float32"),
+                         "b": rng.rand(2, 8).astype("float32")},
+                   fetch_list=[m.var])
+    assert np.asarray(out).shape == (2, 8)
+
+
+# ---------------------------------------------------------------------------
+# v2 API
+
+def _v2():
+    import paddle_tpu.v2 as paddle
+    return paddle
+
+
+def test_v2_train_infer_tar_roundtrip():
+    """The canonical v2 quickstart: layer DSL -> parameters.create ->
+    SGD.train with events -> infer -> parameters tar round trip
+    (reference: python/paddle/v2/trainer.py:137, parameters.py to_tar)."""
+    paddle = _v2()
+    images = paddle.layer.data(name="pixel",
+                               type=paddle.data_type.dense_vector(64),
+                               height=8, width=8)
+    label = paddle.layer.data(name="label",
+                              type=paddle.data_type.integer_value(10))
+    conv = paddle.networks.simple_img_conv_pool(
+        input=images, filter_size=3, num_filters=8, num_channel=1,
+        pool_size=2, pool_stride=2, act=paddle.activation.Relu())
+    hidden = paddle.layer.fc(input=conv, size=32,
+                             act=paddle.activation.Tanh())
+    predict = paddle.layer.fc(input=hidden, size=10,
+                              act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=predict, label=label)
+
+    parameters = paddle.parameters.create(cost)
+    assert len(parameters.names()) >= 4
+    optimizer = paddle.optimizer.Momentum(
+        learning_rate=0.1, momentum=0.9,
+        regularization=paddle.optimizer.L2Regularization(rate=5e-4))
+    trainer = paddle.SGD(cost=cost, parameters=parameters,
+                         update_equation=optimizer)
+
+    rng = np.random.RandomState(0)
+    data = [(rng.rand(64).astype("float32"), int(rng.randint(10)))
+            for _ in range(64)]
+    seen = {"end_pass": [], "iters": 0}
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndIteration):
+            seen["iters"] += 1
+        elif isinstance(e, paddle.event.EndPass):
+            seen["end_pass"].append(e.evaluator["cost"])
+
+    trainer.train(reader=paddle.batch(lambda: iter(data), batch_size=16),
+                  num_passes=4, event_handler=handler,
+                  feeding={"pixel": 0, "label": 1})
+    assert seen["iters"] == 16
+    assert seen["end_pass"][-1] < seen["end_pass"][0]
+
+    res = trainer.test(reader=paddle.batch(lambda: iter(data),
+                                           batch_size=16),
+                       feeding={"pixel": 0, "label": 1})
+    assert np.isfinite(res.cost)
+
+    probs = paddle.infer(output_layer=predict, parameters=parameters,
+                         input=data[:4], feeding={"pixel": 0, "label": 1})
+    assert probs.shape == (4, 10)
+    np.testing.assert_allclose(np.asarray(probs).sum(1), np.ones(4),
+                               rtol=1e-4)
+
+    buf = io.BytesIO()
+    parameters.to_tar(buf)
+    buf.seek(0)
+    back = paddle.parameters.Parameters.from_tar(buf)
+    assert set(back) == set(parameters.names())
+    for n in parameters.names():
+        np.testing.assert_array_equal(back[n], parameters.get(n))
+
+
+def test_v2_parameters_set_survives_sgd_init():
+    """Weights set between parameters.create and SGD() must survive the
+    accumulator re-initialisation."""
+    paddle = _v2()
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    y = paddle.layer.data(name="y", type=paddle.data_type.dense_vector(1))
+    pred = paddle.layer.fc(input=x, size=1)
+    cost = paddle.layer.square_error_cost(input=pred, label=y)
+    params = paddle.parameters.create(cost)
+    wname = [n for n in params.names() if n.endswith(".w_0")][0]
+    custom = np.full(params.get(wname).shape, 0.5, np.float32)
+    params.set(wname, custom)
+    paddle.SGD(cost=cost, parameters=params,
+               update_equation=paddle.optimizer.Adam(learning_rate=1e-3))
+    np.testing.assert_array_equal(params.get(wname), custom)
