@@ -383,7 +383,8 @@ int master_snapshot(void* h, const char* path) {
   }
   // fclose flushes stdio to the page cache only; fsync makes the install
   // crash-durable — recovery after power loss is the feature's whole point
-  if (fflush(f) != 0 || fsync(fileno(f)) != 0 || fclose(f) != 0) {
+  bool flushed = (fflush(f) == 0) && (fsync(fileno(f)) == 0);
+  if (fclose(f) != 0 || !flushed) {  // always close; never leak the fd
     remove(tmp.c_str());
     return -1;
   }

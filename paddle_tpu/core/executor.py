@@ -224,9 +224,19 @@ def trace_ops(block: ir.Block, env: Dict[str, Any], rng: RngSource,
     when eager). This is the whole 'executor hot loop' — at trace time only.
     ``value_hook(name, value)`` intercepts every produced value (used to pin
     sharding constraints on named intermediates, e.g. @GRAD vars)."""
-    for op in block.ops:
-        opdef = registry.lookup_checked(op.type)
-        opdef.lower(LowerContext(op, env, rng, block, value_hook))
+    from .. import profiler as _prof
+    if _prof.profiler_enabled():
+        for op in block.ops:
+            opdef = registry.lookup_checked(op.type)
+            t0 = time.perf_counter()
+            opdef.lower(LowerContext(op, env, rng, block, value_hook))
+            _prof.record_op_event(op.type, op.output_arg_names[0]
+                                  if op.output_arg_names else op.type,
+                                  t0, time.perf_counter())
+    else:
+        for op in block.ops:
+            opdef = registry.lookup_checked(op.type)
+            opdef.lower(LowerContext(op, env, rng, block, value_hook))
 
 
 class FunctionalContext(LowerContext):
@@ -473,6 +483,8 @@ class Executor(object):
 
     # -- eager path (host ops, debugging) -------------------------------------
     def _run_eager(self, program, feed, fetch_names, scope):
+        from .. import profiler as _prof
+        _prof.set_phase("eager")
         block = program.global_block()
         env = dict(feed)
         state_names = self._state_inputs(program, scope, feed)
@@ -506,8 +518,9 @@ class Executor(object):
             # already placed; reshards e.g. replicated startup output → tp)
             state = {n: jax.device_put(v, dist.sharding_for(n, v))
                      for n, v in state.items()}
+        from .. import profiler as _prof
         key = (program._uid, program._version, _feed_signature(feed),
-               tuple(fetch_names), repeat,
+               tuple(fetch_names), repeat, _prof.profiler_enabled(),
                dist.cache_token() if dist is not None else None,
                tuple(sorted(
                    (n, tuple(getattr(v, "shape", ())),
@@ -592,8 +605,35 @@ class Executor(object):
                 return fetches, state, rng_key
 
         if shardings is not None:
-            return jax.jit(fn, donate_argnums=(0,), in_shardings=shardings)
-        return jax.jit(fn, donate_argnums=(0,))
+            jitted = jax.jit(fn, donate_argnums=(0,), in_shardings=shardings)
+        else:
+            jitted = jax.jit(fn, donate_argnums=(0,))
+        from .. import profiler as _prof
+        if _prof.profiler_enabled():
+            # AOT-compile so the timeline artifact gets XLA's compiled cost
+            # analysis + collective census for this program
+            # (device_tracer.h role; see profiler.write_timeline)
+            label = "program_%d" % program._uid
+            mesh_devices = (dist.num_devices if dist is not None else 1)
+
+            memo = {}
+
+            def profiled(state, feed, rng_key):
+                if "c" not in memo:
+                    _prof.set_phase("trace")
+                    try:
+                        memo["c"] = jitted.lower(state, feed,
+                                                 rng_key).compile()
+                    finally:
+                        _prof.set_phase("eager")
+                # re-record each call: a reset_profiler() between sessions
+                # must not leave the artifact's programs section empty
+                _prof.record_program_analysis(label, memo["c"],
+                                              mesh_devices)
+                return memo["c"](state, feed, rng_key)
+
+            return profiled
+        return jitted
 
     # -- helpers ---------------------------------------------------------------
     def _persistable_names(self, program):

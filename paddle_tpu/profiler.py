@@ -15,20 +15,39 @@ import time
 from collections import defaultdict
 
 __all__ = ["start_profiler", "stop_profiler", "reset_profiler", "profiler",
-           "cuda_profiler", "xla_trace", "profiler_enabled", "record_run"]
+           "cuda_profiler", "xla_trace", "profiler_enabled", "record_run",
+           "record_op_event", "record_program_analysis", "write_timeline"]
 
 _enabled = False
 _records = defaultdict(list)  # label -> [seconds]
+_op_events = []               # chrome-trace X events (eager per-op spans)
+_program_analyses = {}        # label -> {flops, bytes, collectives, ...}
+_T0 = time.perf_counter()
 
 
 def profiler_enabled():
     return _enabled
 
 
+_phase = "eager"
+
+
+def set_phase(phase):
+    """'eager' = per-op spans are real run time; 'trace' = spans measure
+    trace/lowering cost (the jit path runs as one fused program)."""
+    global _phase
+    _phase = phase
+
+
 def record_run(label, seconds):
     """Called by Executor.run while profiling is on."""
     if _enabled:
         _records[label].append(seconds)
+        t_end = time.perf_counter()
+        _op_events.append({
+            "name": label, "cat": "program", "ph": "X",
+            "ts": (t_end - seconds - _T0) * 1e6, "dur": seconds * 1e6,
+            "pid": 0, "tid": 1, "args": {}})
 
 
 def start_profiler(state="All"):
@@ -40,6 +59,95 @@ def start_profiler(state="All"):
 
 def reset_profiler():
     _records.clear()
+    del _op_events[:]
+    _program_analyses.clear()
+
+
+def record_op_event(op_type, name, t_start, t_end):
+    """Per-op span from the eager interpreter path (on the jit path the
+    per-op loop does not exist at run time — op granularity comes from the
+    program analysis + xla_trace instead)."""
+    _op_events.append({
+        "name": "%s:%s" % (op_type, name), "cat": "op", "ph": "X",
+        "ts": (t_start - _T0) * 1e6, "dur": (t_end - t_start) * 1e6,
+        "pid": 0, "tid": 0,
+        "args": {"op_type": op_type, "phase": _phase}})
+
+
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+                "collective-permute", "all-to-all")
+
+
+def record_program_analysis(label, compiled, mesh_devices=1):
+    """XLA's compiled cost analysis + a census of the collectives GSPMD
+    inserted — the mesh 'barrier stat': every collective is a cross-device
+    sync point (reference: platform/device_tracer.h timeline +
+    profiler.proto role, in compiled-program form)."""
+    entry = {"mesh_devices": int(mesh_devices)}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        entry["flops"] = float(ca.get("flops", 0.0))
+        entry["bytes_accessed"] = float(ca.get("bytes accessed", 0.0))
+    except Exception:
+        pass
+    try:
+        text = compiled.as_text()
+        coll = {}
+        for kind in _COLLECTIVES:
+            # "<kind>(" appears only at instruction call sites (operand
+            # references are "%<kind>.N" — no open paren); async pairs
+            # count once via -start
+            n = text.count(" %s(" % kind) + text.count(" %s-start(" % kind)
+            if n:
+                coll[kind] = n
+        entry["collectives"] = coll
+        entry["barrier_points"] = sum(coll.values())
+    except Exception:
+        entry.setdefault("collectives", {})
+        entry.setdefault("barrier_points", 0)
+    try:
+        mem = compiled.memory_analysis()
+        if mem is not None:
+            entry["peak_device_memory_bytes"] = int(
+                getattr(mem, "temp_size_in_bytes", 0)
+                + getattr(mem, "argument_size_in_bytes", 0)
+                + getattr(mem, "output_size_in_bytes", 0))
+    except Exception:
+        pass
+    _program_analyses[label] = entry
+
+
+def write_timeline(path):
+    """Write the structured timeline artifact (JSON):
+
+    - ``trace_events``: chrome-trace (catapult) spans — per-op eager spans
+      and per-program run spans; loadable in chrome://tracing / Perfetto —
+      the device_tracer.proto analog
+      (reference: paddle/fluid/platform/device_tracer.h:30-60).
+    - ``host_events``: aggregated wall-time table (profiler.h role).
+    - ``programs``: per-compiled-program XLA cost analysis, collective
+      census ('barrier stat' for mesh runs) and memory analysis.
+    """
+    import json
+    rows = []
+    for label, times in _records.items():
+        n = len(times)
+        total = sum(times)
+        rows.append({"name": label, "calls": n, "total_ms": total * 1e3,
+                     "avg_ms": total / n * 1e3,
+                     "min_ms": min(times) * 1e3,
+                     "max_ms": max(times) * 1e3})
+    artifact = {
+        "schema": "paddle_tpu.timeline.v1",
+        "trace_events": list(_op_events),
+        "host_events": rows,
+        "programs": dict(_program_analyses),
+    }
+    with open(path, "w") as f:
+        json.dump(artifact, f, indent=1)
+    return artifact
 
 
 def stop_profiler(sorted_key=None, profile_path=None):
@@ -71,13 +179,18 @@ def stop_profiler(sorted_key=None, profile_path=None):
 
 
 @contextlib.contextmanager
-def profiler(state="All", sorted_key=None, profile_path=None):
-    """reference: profiler.py:125 profiler context manager."""
+def profiler(state="All", sorted_key=None, profile_path=None,
+             timeline_path=None):
+    """reference: profiler.py:125 profiler context manager. Pass
+    ``timeline_path`` to also write the structured JSON timeline artifact
+    (see write_timeline)."""
     start_profiler(state)
     reset_profiler()
     try:
         yield
     finally:
+        if timeline_path:
+            write_timeline(timeline_path)
         stop_profiler(sorted_key, profile_path)
 
 

@@ -19,10 +19,14 @@ def classification_error_evaluator(input, label, name=None, weight=None):
 
 
 def auc_evaluator(input, label, name=None, weight=None):
-    from ..evaluator import auc as _auc
-    out = _auc(input.var, label.var)
-    var = out[0] if isinstance(out, (list, tuple)) else out
-    return LayerOutput(name or "auc", var, size=1)
+    from ..layers.layer_helper import LayerHelper
+    helper = LayerHelper("auc")
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(type="auc",
+                     inputs={"Out": [input.var], "Label": [label.var]},
+                     outputs={"AUC": [out]},
+                     attrs={"num_thresholds": 200})
+    return LayerOutput(name or "auc", out, size=1)
 
 
 def precision_recall_evaluator(input, label, name=None, positive_label=None,

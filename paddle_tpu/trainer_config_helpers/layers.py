@@ -172,6 +172,18 @@ def img_conv_layer(input, filter_size, num_filters, name=None,
     fy = filter_size_y or filter_size
     sy = stride_y or stride
     py = padding_y if padding_y is not None else padding
+    if trans:
+        # ExpandConvTransLayer (deconv) — reference layers.py trans=True
+        out = F.conv2d_transpose(
+            var, num_filters=num_filters, filter_size=(filter_size, fy),
+            stride=(stride, sy), padding=(padding, py), act=_act_name(act),
+            param_attr=_param(param_attr), bias_attr=_bias(bias_attr),
+            name=name)
+        oh = (h - 1) * stride - 2 * padding + filter_size
+        ow = (w - 1) * sy - 2 * py + fy
+        return LayerOutput(name or out.name, out,
+                           size=num_filters * oh * ow,
+                           channels=num_filters, height=oh, width=ow)
     out = F.conv2d(var, num_filters=num_filters,
                    filter_size=(filter_size, fy),
                    stride=(stride, sy), padding=(padding, py),

@@ -16,19 +16,34 @@ class Inference(object):
         from .. import Executor, CPUPlace
         self.topology = Topology(output_layer)
         self.outputs = [l.var for l in self.topology.layers]
-        self.parameters = parameters if isinstance(parameters, Parameters) \
-            else None
-        self._raw_params = None if self.parameters is not None else \
-            parameters
+        if isinstance(parameters, Parameters):
+            self.parameters = parameters
+        else:
+            # a plain {name: ndarray} mapping (Parameters.from_tar without
+            # a topology): bind it to a fresh scope
+            self.parameters = Parameters(self.topology)
+            from ..core.scope import Scope
+            self.parameters.scope = Scope()
+            for n, v in dict(parameters).items():
+                self.parameters.set(n, v)
         self.exe = Executor(CPUPlace())
-        self._data_vars = self.topology.data_type()
+        all_data = self.topology.data_type()
         self.program = self.topology.main_program.prune(
-            feeds=[n for n, _ in self._data_vars],
+            feeds=[n for n, _ in all_data],
             fetches=[v.name for v in self.outputs])
+        # only the feeds the pruned forward actually reads (labels and
+        # other training-only inputs drop out — reference v2 Topology over
+        # output_layer only needs reachable inputs)
+        needed = set()
+        for op in self.program.global_block().ops:
+            needed.update(op.input_arg_names)
+        self._data_vars = [(n, v) for n, v in all_data if n in needed]
 
     def infer(self, input, feeding=None, field="value"):
-        scope = self.parameters.scope if self.parameters is not None \
-            else None
+        scope = self.parameters.scope
+        if feeding is not None:
+            feeding = {k: v for k, v in feeding.items()
+                       if k in dict(self._data_vars)}
         feed = _feed_from_batch(self._data_vars, input, feeding)
         outs = self.exe.run(self.program, feed=feed,
                             fetch_list=self.outputs, scope=scope)

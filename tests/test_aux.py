@@ -165,3 +165,86 @@ class TestProfilerDebugger:
             pt.default_main_program().global_block(),
             path=str(tmp_path / "g.dot"))
         assert "digraph" in dot
+
+
+def test_profiler_timeline_artifact(tmp_path):
+    """profiler(timeline_path=...) writes the structured timeline: chrome
+    trace events, host wall-time table, per-program XLA cost analysis with
+    the collective census (VERDICT r1 item 9; reference:
+    platform/device_tracer.h:30-60 + profiler.proto role)."""
+    import json
+    import paddle_tpu as fluid
+    from paddle_tpu import profiler as prof
+
+    layers = fluid.layers
+    x = layers.data("x", shape=[8])
+    y = layers.data("y", shape=[1], dtype="int64")
+    pred = layers.fc(layers.fc(x, size=16, act="relu"), size=4,
+                     act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    path = str(tmp_path / "timeline.json")
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(4, 8).astype("float32"),
+            "y": rng.randint(0, 4, (4, 1)).astype("int64")}
+    with prof.profiler(timeline_path=path, profile_path=str(
+            tmp_path / "table.txt")):
+        for _ in range(3):
+            exe.run(feed=feed, fetch_list=[loss])
+        # eager pass gives real per-op spans
+        exe.run(feed=feed, fetch_list=[loss], use_jit=False)
+
+    art = json.load(open(path))
+    assert art["schema"] == "paddle_tpu.timeline.v1"
+    # host table has the program timer
+    assert any(r["calls"] >= 3 for r in art["host_events"])
+    # chrome-trace events: program spans + eager op spans
+    cats = {e["cat"] for e in art["trace_events"]}
+    assert "program" in cats and "op" in cats
+    op_ev = [e for e in art["trace_events"] if e["cat"] == "op"]
+    assert any(e["args"]["phase"] == "eager" for e in op_ev)
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in op_ev)
+    # per-program XLA analysis with flops and the collective census
+    progs = art["programs"]
+    assert progs, "no program analysis captured"
+    entry = next(iter(progs.values()))
+    assert entry.get("flops", 0) > 0
+    assert "collectives" in entry and "barrier_points" in entry
+
+
+def test_profiler_timeline_mesh_collectives(tmp_path):
+    """Under a dp mesh the program analysis reports the collectives GSPMD
+    inserted (the barrier stat for mesh runs)."""
+    import json
+    import paddle_tpu as fluid
+    from paddle_tpu import profiler as prof
+    from paddle_tpu.parallel import make_mesh, data_parallel
+
+    layers = fluid.layers
+    x = layers.data("x", shape=[8])
+    y = layers.data("y", shape=[1], dtype="int64")
+    pred = layers.fc(x, size=4, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    mesh = make_mesh({"dp": -1})
+    ctx = data_parallel(mesh)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace(), dist_context=ctx)
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.rand(16, 8).astype("float32"),
+                "y": rng.randint(0, 4, (16, 1)).astype("int64")}
+        path = str(tmp_path / "timeline.json")
+        with prof.profiler(timeline_path=path):
+            exe.run(fluid.default_main_program(), feed=feed,
+                    fetch_list=[loss])
+    art = json.load(open(path))
+    entry = next(iter(art["programs"].values()))
+    assert entry["mesh_devices"] == 8
+    # dp grad sync must appear as at least one all-reduce barrier
+    assert entry["barrier_points"] >= 1, entry

@@ -283,3 +283,51 @@ def test_v2_parameters_set_survives_sgd_init():
     paddle.SGD(cost=cost, parameters=params,
                update_equation=paddle.optimizer.Adam(learning_rate=1e-3))
     np.testing.assert_array_equal(params.get(wname), custom)
+
+
+def test_v2_infer_without_label_column():
+    """Inference input has no label column (canonical v2 usage) and raw
+    tar-loaded weights work without a bound Parameters object."""
+    paddle = _v2()
+    x = paddle.layer.data(name="px", type=paddle.data_type.dense_vector(6))
+    label = paddle.layer.data(name="lb",
+                              type=paddle.data_type.integer_value(3))
+    pred = paddle.layer.fc(input=x, size=3,
+                           act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=pred, label=label)
+    params = paddle.parameters.create(cost)
+
+    rng = np.random.RandomState(4)
+    rows = [(rng.rand(6).astype("float32"),) for _ in range(3)]
+    probs = paddle.infer(output_layer=pred, parameters=params, input=rows)
+    assert probs.shape == (3, 3)
+
+    # raw dict from a tar (no topology binding) must actually be used
+    buf = io.BytesIO()
+    params.to_tar(buf)
+    buf.seek(0)
+    raw = paddle.parameters.Parameters.from_tar(buf)
+    probs2 = paddle.infer(output_layer=pred, parameters=raw, input=rows)
+    np.testing.assert_allclose(np.asarray(probs), np.asarray(probs2),
+                               rtol=1e-5)
+
+
+def test_profiler_after_warm_cache(tmp_path):
+    """A program compiled before profiling still contributes its analysis
+    when profiled later (cache key includes profiler state)."""
+    import json
+    import paddle_tpu as fluid
+    from paddle_tpu import profiler as prof
+
+    x = fluid.layers.data("x", shape=[4])
+    out = fluid.layers.fc(x, size=2)
+    loss = fluid.layers.mean(out)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = {"x": np.ones((2, 4), np.float32)}
+    exe.run(feed=feed, fetch_list=[loss])            # warm, profiler off
+    path = str(tmp_path / "tl.json")
+    with prof.profiler(timeline_path=path):
+        exe.run(feed=feed, fetch_list=[loss])
+    art = json.load(open(path))
+    assert art["programs"], "profiled run must capture program analysis"
