@@ -24,7 +24,8 @@ __all__ = [
     "elementwise_sub", "elementwise_mul", "elementwise_div", "lrn", "prelu",
     "pad", "label_smooth", "sigmoid_cross_entropy_with_logits", "maxout",
     "relu", "log", "im2sequence", "expand", "squeeze", "unsqueeze",
-    "edit_distance",
+    "edit_distance", "hsigmoid", "factorization_machine", "multiplex",
+    "spp", "max_pool2d_with_index", "unpool", "mdlstm",
 ]
 
 
@@ -626,3 +627,139 @@ def edit_distance(input, label, normalized=False, ignored_tokens=None,
                      attrs={"normalized": normalized,
                             "ignored_tokens": list(ignored_tokens or [])})
     return out, seq_num
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None):
+    """Hierarchical sigmoid classifier over a complete binary tree.
+    reference: layers in gserver/layers/HierarchicalSigmoidLayer.cpp /
+    fluid operators/hierarchical_sigmoid_op."""
+    helper = LayerHelper("hierarchical_sigmoid", **locals())
+    dtype = helper.input_dtype()
+    dim = input.shape[-1]
+    w = helper.create_parameter(helper.param_attr,
+                                shape=[num_classes - 1, dim], dtype=dtype)
+    b = helper.create_parameter(helper.bias_attr or ParamAttr(),
+                                shape=[num_classes - 1, 1], dtype=dtype,
+                                is_bias=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    out.shape = (input.shape[0], 1)
+    helper.append_op(type="hierarchical_sigmoid",
+                     inputs={"X": [input], "W": [w], "Label": [label],
+                             "Bias": [b]},
+                     outputs={"Out": [out]},
+                     attrs={"num_classes": num_classes})
+    return out
+
+
+def factorization_machine(input, factor_size, param_attr=None, name=None):
+    """Second-order factorization machine interaction term.
+    reference: gserver/layers/FactorizationMachineLayer.cpp."""
+    helper = LayerHelper("factorization_machine", **locals())
+    dtype = helper.input_dtype()
+    dim = input.shape[-1]
+    v = helper.create_parameter(helper.param_attr,
+                                shape=[dim, factor_size], dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    out.shape = (input.shape[0], 1)
+    helper.append_op(type="factorization_machine",
+                     inputs={"X": [input], "V": [v]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select among candidate tensors by index.
+    reference: layers/nn.py multiplex -> operators/multiplex_op.cc."""
+    helper = LayerHelper("multiplex", **locals())
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    out.shape = inputs[0].shape
+    helper.append_op(type="multiplex",
+                     inputs={"X": list(inputs), "Ids": [index]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def spp(input, pyramid_height, pool_type="max", name=None):
+    """Spatial pyramid pooling. reference: operators/spp_op.cc."""
+    helper = LayerHelper("spp", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    c = input.shape[1] if input.shape else None
+    if c is not None:
+        bins = sum(4 ** l for l in range(pyramid_height))
+        out.shape = (input.shape[0], c * bins)
+    helper.append_op(type="spp", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"pyramid_height": pyramid_height,
+                            "pooling_type": pool_type})
+    return out
+
+
+def max_pool2d_with_index(input, pool_size, pool_stride=None,
+                          pool_padding=0, name=None):
+    """Max pooling that also returns argmax positions (for unpool).
+    reference: operators/max_pool_with_index_op."""
+    helper = LayerHelper("max_pool2d_with_index", **locals())
+    ks = [pool_size, pool_size] if isinstance(pool_size, int) else \
+        list(pool_size)
+    st = ks if pool_stride is None else (
+        [pool_stride, pool_stride] if isinstance(pool_stride, int)
+        else list(pool_stride))
+    pd = [pool_padding, pool_padding] if isinstance(pool_padding, int) \
+        else list(pool_padding)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mask = helper.create_variable_for_type_inference("int32")
+    mask.stop_gradient = True
+    helper.append_op(type="max_pool2d_with_index",
+                     inputs={"X": [input]},
+                     outputs={"Out": [out], "Mask": [mask]},
+                     attrs={"ksize": ks, "strides": st, "paddings": pd})
+    return out, mask
+
+
+def unpool(input, indices, unpool_size=None, pool_size=2, pool_stride=None,
+           pool_padding=0, name=None):
+    """Max unpooling using indices from max_pool2d_with_index. Pass either
+    unpool_size or the pooling geometry that produced the indices.
+    reference: operators/unpool_op.cc."""
+    helper = LayerHelper("unpool", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ks = [pool_size, pool_size] if isinstance(pool_size, int) else \
+        list(pool_size)
+    st = ks if pool_stride is None else (
+        [pool_stride, pool_stride] if isinstance(pool_stride, int)
+        else list(pool_stride))
+    pd = [pool_padding, pool_padding] if isinstance(pool_padding, int) \
+        else list(pool_padding)
+    attrs = {"ksize": ks, "strides": st, "paddings": pd}
+    if unpool_size is not None:
+        attrs["unpooled_size"] = list(unpool_size)
+    helper.append_op(type="unpool",
+                     inputs={"X": [input], "Indices": [indices]},
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def mdlstm(input, size, param_attr=None, bias_attr=None, name=None):
+    """2-D grid LSTM: each cell conditions on the left and up neighbors.
+    input: [N, H, W, C] -> out [N, H, W, size].
+    reference: gserver/layers/MDLstmLayer.cpp."""
+    helper = LayerHelper("mdlstm", **locals())
+    dtype = helper.input_dtype()
+    c = input.shape[-1]
+    wx = helper.create_parameter(helper.param_attr, shape=[c, 5 * size],
+                                 dtype=dtype)
+    wl = helper.create_parameter(ParamAttr(), shape=[size, 5 * size],
+                                 dtype=dtype)
+    wu = helper.create_parameter(ParamAttr(), shape=[size, 5 * size],
+                                 dtype=dtype)
+    b = helper.create_parameter(helper.bias_attr or ParamAttr(),
+                                shape=[5 * size], dtype=dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    out.shape = tuple(input.shape[:-1]) + (size,)
+    helper.append_op(type="mdlstm",
+                     inputs={"X": [input], "WeightX": [wx],
+                             "WeightL": [wl], "WeightU": [wu],
+                             "Bias": [b]},
+                     outputs={"Out": [out]})
+    return out

@@ -359,7 +359,8 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types,
 
 
 def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
-        bias_attr=None, num_neg_samples=None):
+        bias_attr=None, num_neg_samples=None, sampler="uniform",
+        custom_dist=None):
     """Noise-contrastive estimation loss.
     reference: layers/nn.py nce -> operators/nce_op.cc. Negative samples are
     drawn by a separate uniform_random int op feeding a deterministic
@@ -375,17 +376,33 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
                                 is_bias=True)
     samples = helper.create_variable_for_type_inference(dtype="int64",
                                                         stop_gradient=True)
-    helper.append_op(type="uniform_random_int",
-                     outputs={"Out": [samples]},
-                     attrs={"shape": [num_neg], "low": 0,
-                            "high": num_total_classes})
+    if sampler == "log_uniform":
+        helper.append_op(type="log_uniform_random_int",
+                         outputs={"Out": [samples]},
+                         attrs={"shape": [num_neg],
+                                "range": num_total_classes})
+    elif sampler == "custom_dist":
+        # sample via inverse-CDF of the user distribution
+        # (reference: operators/math/sampler.h CustomSampler)
+        helper.append_op(type="custom_dist_random_int",
+                         inputs={"Probs": [custom_dist]},
+                         outputs={"Out": [samples]},
+                         attrs={"shape": [num_neg]})
+    else:
+        helper.append_op(type="uniform_random_int",
+                         outputs={"Out": [samples]},
+                         attrs={"shape": [num_neg], "low": 0,
+                                "high": num_total_classes})
     cost = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": [input], "Label": [label], "Weight": [w],
+              "Bias": [b], "Samples": [samples]}
+    if sampler == "custom_dist":
+        inputs["CustomDistProbs"] = [custom_dist]
     helper.append_op(type="nce_core",
-                     inputs={"Input": [input], "Label": [label],
-                             "Weight": [w], "Bias": [b],
-                             "Samples": [samples]},
+                     inputs=inputs,
                      outputs={"Cost": [cost]},
                      attrs={"num_total_classes": num_total_classes,
-                            "num_neg_samples": num_neg})
+                            "num_neg_samples": num_neg,
+                            "sampler": sampler})
     cost.shape = (input.shape[0], 1)
     return cost

@@ -15,6 +15,7 @@ from . import (  # noqa: F401
     control_flow_ops,
     attention_ops,
     detection_ops,
+    misc_ops,
     selected_rows,
     explicit_grads,  # last: attaches custom grad makers to the ops above
 )

@@ -1,0 +1,293 @@
+"""Ops tail: hierarchical sigmoid, factorization machine, multiplex,
+spatial pyramid pooling, max-pool-with-index / unpool, 2-D (MD) LSTM,
+log-uniform sampler.
+
+reference: paddle/gserver/layers/HierarchicalSigmoidLayer.cpp +
+fluid operators/hierarchical_sigmoid_op (MatrixBitCodeFunctor),
+gserver/layers/FactorizationMachineLayer.cpp, operators/multiplex_op.cc,
+operators/spp_op.cc, operators/unpool_op.cc + math/unpooling.cc,
+gserver/layers/MDLstmLayer.cpp, operators/math/sampler.h (LogUniform).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.executor import raw_data, with_lod_of
+from ..core.registry import register_op
+from .common import jdt
+
+
+# ---------------------------------------------------------------------------
+# hierarchical sigmoid — complete-binary-tree coded softmax
+
+def _tree_codes(num_classes):
+    """Static (path_node_index, path_bit, path_mask) tables for every class
+    under the complete-binary-tree coding of the reference's SimpleCode:
+    c = class + num_classes; length = findLastSet(c) - 1;
+    node(bit) = (c >> (length - 1 - bit)) - 1;
+    bit(bit)  = (c >> (length - 1 - bit - 1)) & 1  (child direction).
+    Padded to the max code length with mask=0."""
+    import numpy as np
+    max_len = int(math.floor(math.log2(2 * num_classes - 1)))
+    nodes = np.zeros((num_classes, max_len), np.int32)
+    bits = np.zeros((num_classes, max_len), np.float32)
+    mask = np.zeros((num_classes, max_len), np.float32)
+    for c in range(num_classes):
+        code = c + num_classes
+        length = code.bit_length() - 1
+        for i in range(length):
+            nodes[c, i] = (code >> (length - i)) - 1
+            bits[c, i] = float((code >> (length - i - 1)) & 1)
+            mask[c, i] = 1.0
+    return jnp.asarray(nodes), jnp.asarray(bits), jnp.asarray(mask)
+
+
+@register_op("hierarchical_sigmoid")
+def hierarchical_sigmoid(ctx):
+    """Cost[n] = -sum_i log sigmoid((1-2*bit_i) * (x_n . w_node_i + b_node_i))
+    over the label's root-to-leaf path. The code tables are static arrays
+    (gathered by traced labels), so the whole op is one batched gather +
+    matmul — no per-sample host loop.
+    reference: operators/hierarchical_sigmoid_op.h HierarchicalSigmoidKernel
+    + gserver/layers/HierarchicalSigmoidLayer.cpp."""
+    x = raw_data(ctx.input("X"))                         # [N, D]
+    w = raw_data(ctx.input("W"))                         # [C-1, D]
+    label = raw_data(ctx.input("Label")).reshape(-1).astype(jnp.int32)
+    bias = ctx.input("Bias")
+    num_classes = int(ctx.attr("num_classes"))
+    nodes, bits, mask = _tree_codes(num_classes)
+    n_idx = jnp.take(nodes, label, axis=0)               # [N, L]
+    n_bit = jnp.take(bits, label, axis=0)
+    n_mask = jnp.take(mask, label, axis=0)
+    w_path = jnp.take(w, n_idx, axis=0)                  # [N, L, D]
+    logits = jnp.einsum("nd,nld->nl", x, w_path)
+    if bias is not None:
+        logits = logits + jnp.take(raw_data(bias).reshape(-1), n_idx)
+    sign = 1.0 - 2.0 * n_bit
+    # -log sigmoid(sign * logit) = softplus(-sign * logit)
+    cost = jnp.sum(jax.nn.softplus(-sign * logits) * n_mask, axis=1)
+    ctx.set_output("Out", cost[:, None])
+
+
+@register_op("factorization_machine")
+def factorization_machine(ctx):
+    """Second-order FM term: 0.5 * sum_k ((x V)_k^2 - (x^2 V^2)_k).
+    reference: gserver/layers/FactorizationMachineLayer.cpp (latentVectors_
+    V [D, K])."""
+    x = raw_data(ctx.input("X"))                         # [N, D]
+    v = raw_data(ctx.input("V"))                         # [D, K]
+    xv = jnp.dot(x, v)
+    x2v2 = jnp.dot(x * x, v * v)
+    out = 0.5 * jnp.sum(xv * xv - x2v2, axis=1, keepdims=True)
+    ctx.set_output("Out", out)
+
+
+@register_op("multiplex")
+def multiplex(ctx):
+    """Out[i] = Ins[ids[i]][i]: per-row selection among K candidates.
+    reference: operators/multiplex_op.cc."""
+    ids = raw_data(ctx.input("Ids")).reshape(-1).astype(jnp.int32)
+    ins = [raw_data(v) for v in ctx.inputs("X")]
+    stacked = jnp.stack(ins)                             # [K, N, ...]
+    out = stacked[ids, jnp.arange(stacked.shape[1])]
+    ctx.set_output("Out", out)
+
+
+# ---------------------------------------------------------------------------
+# spatial pyramid pooling (reference: operators/spp_op.cc): per level l,
+# adaptive-pool X into 2^l x 2^l bins, flatten, concat over levels.
+
+def _adaptive_pool2d(x, bins, pool_type):
+    N, C, H, W = x.shape
+    outs = []
+    for by in range(bins):
+        y0 = (by * H) // bins
+        y1 = max(((by + 1) * H + bins - 1) // bins, y0 + 1)
+        row = []
+        for bx in range(bins):
+            x0 = (bx * W) // bins
+            x1 = max(((bx + 1) * W + bins - 1) // bins, x0 + 1)
+            win = x[:, :, y0:y1, x0:x1]
+            r = (jnp.max(win, axis=(2, 3)) if pool_type == "max"
+                 else jnp.mean(win, axis=(2, 3)))
+            row.append(r)
+        outs.append(jnp.stack(row, axis=-1))             # [N, C, bins]
+    return jnp.stack(outs, axis=-2)                      # [N, C, bins, bins]
+
+
+@register_op("spp")
+def spp(ctx):
+    x = raw_data(ctx.input("X"))
+    levels = int(ctx.attr("pyramid_height"))
+    ptype = str(ctx.attr("pooling_type", "max"))
+    feats = []
+    for l in range(levels):
+        pooled = _adaptive_pool2d(x, 2 ** l, ptype)
+        feats.append(pooled.reshape(x.shape[0], -1))
+    ctx.set_output("Out", jnp.concatenate(feats, axis=1))
+
+
+# ---------------------------------------------------------------------------
+# max pool with index + unpool (reference: operators/max_pool_with_index_op,
+# unpool_op.cc + math/unpooling.cc — indices are flat positions within each
+# [H, W] map)
+
+@register_op("max_pool2d_with_index")
+def max_pool2d_with_index(ctx):
+    x = raw_data(ctx.input("X"))
+    N, C, H, W = x.shape
+    ks = ctx.attr("ksize", [2, 2])
+    st = ctx.attr("strides", ks)
+    pd = ctx.attr("paddings", [0, 0])
+    kh, kw = int(ks[0]), int(ks[1])
+    sh, sw = int(st[0]), int(st[1])
+    ph, pw = int(pd[0]), int(pd[1])
+    neg = jnp.finfo(x.dtype).min
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                 constant_values=neg)
+    OH = (H + 2 * ph - kh) // sh + 1
+    OW = (W + 2 * pw - kw) // sw + 1
+    # window positions as [OH*OW, kh*kw] flat indices into the padded map,
+    # then gather and argmax — index arithmetic maps back to unpadded H*W
+    oy = jnp.arange(OH) * sh
+    ox = jnp.arange(OW) * sw
+    wy = jnp.arange(kh)
+    wx = jnp.arange(kw)
+    ys = oy[:, None, None, None] + wy[None, None, :, None]  # [OH,1,kh,1]
+    xs = ox[None, :, None, None] + wx[None, None, None, :]  # [1,OW,1,kw]
+    ys = jnp.broadcast_to(ys, (OH, OW, kh, kw))
+    xs = jnp.broadcast_to(xs, (OH, OW, kh, kw))
+    flat = (ys * (W + 2 * pw) + xs).reshape(OH * OW, kh * kw)
+    xp_flat = xp.reshape(N, C, -1)
+    wins = jnp.take(xp_flat, flat, axis=2)               # [N,C,OH*OW,khkw]
+    arg = jnp.argmax(wins, axis=3)
+    out = jnp.max(wins, axis=3).reshape(N, C, OH, OW)
+    # winner position in padded coords -> unpadded flat H*W index
+    win_flat = jnp.take_along_axis(
+        jnp.broadcast_to(flat[None, None], wins.shape).astype(jnp.int32),
+        arg[..., None].astype(jnp.int32), axis=3)[..., 0]
+    py = win_flat // (W + 2 * pw) - ph
+    px = win_flat % (W + 2 * pw) - pw
+    idx = (py * W + px).reshape(N, C, OH, OW)
+    ctx.set_output("Out", out)
+    ctx.set_output("Mask", idx.astype(jnp.int32))
+
+
+@register_op("unpool")
+def unpool(ctx):
+    """Scatter pooled activations back to the positions recorded by
+    max_pool2d_with_index. reference: operators/unpool_op.cc."""
+    x = raw_data(ctx.input("X"))                         # [N,C,h,w]
+    idx = raw_data(ctx.input("Indices")).astype(jnp.int32)
+    out_hw = ctx.attr("unpooled_size", None)
+    if out_hw is None:
+        # invert the pooling geometry the layer recorded on this op
+        ks = ctx.attr("ksize", [2, 2])
+        st = ctx.attr("strides", ks)
+        pd = ctx.attr("paddings", [0, 0])
+        out_hw = [(x.shape[2] - 1) * int(st[0]) - 2 * int(pd[0])
+                  + int(ks[0]),
+                  (x.shape[3] - 1) * int(st[1]) - 2 * int(pd[1])
+                  + int(ks[1])]
+    OH, OW = int(out_hw[0]), int(out_hw[1])
+    N, C, h, w = x.shape
+    flat = jnp.zeros((N, C, OH * OW), x.dtype)
+    # assignment, not accumulation: with overlapping windows a position can
+    # win several windows, each carrying the SAME max value — reference
+    # math/unpooling.cc writes output[index] = input[i]
+    flat = flat.at[
+        jnp.arange(N)[:, None, None],
+        jnp.arange(C)[None, :, None],
+        idx.reshape(N, C, h * w)].set(x.reshape(N, C, h * w))
+    ctx.set_output("Out", flat.reshape(N, C, OH, OW))
+
+
+# ---------------------------------------------------------------------------
+# MD (2-D grid) LSTM — reference: gserver/layers/MDLstmLayer.cpp: an LSTM
+# over a 2-D grid where each cell sees hidden/cell state from BOTH the left
+# and the up neighbor. Lowered as a lax.scan over rows whose body is a
+# lax.scan over columns — XLA sees two nested static loops.
+
+@register_op("mdlstm")
+def mdlstm(ctx):
+    x = raw_data(ctx.input("X"))                         # [N, H, W, C]
+    wx = raw_data(ctx.input("WeightX"))                  # [C, 5*D]
+    wl = raw_data(ctx.input("WeightL"))                  # [D, 5*D]
+    wu = raw_data(ctx.input("WeightU"))                  # [D, 5*D]
+    b = ctx.input("Bias")
+    D = wl.shape[0]
+    N, H, W, C = x.shape
+    pre = jnp.einsum("nhwc,cd->nhwd", x, wx)
+    if b is not None:
+        pre = pre + raw_data(b).reshape(1, 1, 1, -1)
+
+    def row_step(carry_row, pre_row):
+        # carry_row: hidden/cell of the row above: [N, W, D] each
+        h_up, c_up = carry_row
+
+        def col_step(carry_col, col_in):
+            h_left, c_left = carry_col                   # [N, D]
+            pre_t, h_upc, c_upc = col_in                 # [N,5D],[N,D],[N,D]
+            g = pre_t + jnp.dot(h_left, wl) + jnp.dot(h_upc, wu)
+            i, f_l, f_u, o, cand = jnp.split(g, 5, axis=1)
+            i = jax.nn.sigmoid(i)
+            f_l = jax.nn.sigmoid(f_l)
+            f_u = jax.nn.sigmoid(f_u)
+            o = jax.nn.sigmoid(o)
+            cand = jnp.tanh(cand)
+            c = f_l * c_left + f_u * c_upc + i * cand
+            h = o * jnp.tanh(c)
+            return (h, c), (h, c)
+
+        z = jnp.zeros((N, D), x.dtype)
+        (_, _), (hs, cs) = jax.lax.scan(
+            col_step, (z, z),
+            (pre_row.swapaxes(0, 1), h_up.swapaxes(0, 1),
+             c_up.swapaxes(0, 1)))
+        hs = hs.swapaxes(0, 1)                           # [N, W, D]
+        cs = cs.swapaxes(0, 1)
+        return (hs, cs), hs
+
+    z_row = jnp.zeros((N, W, D), x.dtype)
+    (_, _), out = jax.lax.scan(row_step, (z_row, z_row),
+                               pre.swapaxes(0, 1))       # scan over H
+    ctx.set_output("Out", out.swapaxes(0, 1))            # [N, H, W, D]
+
+
+# ---------------------------------------------------------------------------
+# log-uniform (Zipfian) negative sampler — reference: operators/math/
+# sampler.h LogUniformSampler: P(k) = log((k+2)/(k+1)) / log(range+1).
+
+@register_op("log_uniform_random_int", no_gradient=True)
+def log_uniform_random_int(ctx):
+    shape = [int(d) for d in ctx.attr("shape")]
+    rng_range = int(ctx.attr("range"))
+    key = ctx.next_rng()
+    u = jax.random.uniform(key, tuple(shape))
+    # inverse CDF: k = floor(exp(u * log(range+1))) - 1
+    k = jnp.exp(u * math.log(rng_range + 1.0)) - 1.0
+    out = jnp.clip(k.astype(jnp.int64), 0, rng_range - 1)
+    ctx.set_output("Out", out)
+
+
+def log_uniform_prob(samples, rng_range):
+    """log P(k) under the log-uniform sampler (for NCE/IS corrections)."""
+    k = samples.astype(jnp.float32)
+    return jnp.log(jnp.log((k + 2.0) / (k + 1.0))
+                   / math.log(rng_range + 1.0))
+
+
+@register_op("custom_dist_random_int", no_gradient=True)
+def custom_dist_random_int(ctx):
+    """Inverse-CDF sampling from a user categorical distribution.
+    reference: operators/math/sampler.h CustomSampler (alias table role)."""
+    shape = [int(d) for d in ctx.attr("shape")]
+    probs = raw_data(ctx.input("Probs")).reshape(-1)
+    key = ctx.next_rng()
+    cdf = jnp.cumsum(probs / jnp.sum(probs))
+    u = jax.random.uniform(key, tuple(shape))
+    out = jnp.searchsorted(cdf, u).astype(jnp.int64)
+    ctx.set_output("Out", jnp.clip(out, 0, probs.shape[0] - 1))

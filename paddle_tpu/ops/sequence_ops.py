@@ -755,7 +755,25 @@ def nce_core(ctx):
     samples = raw_data(ctx.input("Samples")).astype(jnp.int32)  # [S]
     num_total = int(ctx.attr("num_total_classes"))
     num_neg = int(ctx.attr("num_neg_samples", samples.shape[0]))
-    noise_p = 1.0 / float(num_total)
+    sampler = str(ctx.attr("sampler", "uniform"))
+
+    # log q(y) per class under the noise distribution (reference:
+    # operators/math/sampler.h Uniform/LogUniform/CustomSampler)
+    import math as _math
+    if sampler == "log_uniform":
+        from .misc_ops import log_uniform_prob
+        log_q_label = log_uniform_prob(label, num_total)
+        log_q_samples = log_uniform_prob(samples, num_total)
+    elif sampler == "custom_dist":
+        probs = raw_data(ctx.input("CustomDistProbs")).reshape(-1)
+        log_q = jnp.log(jnp.maximum(probs, 1e-20))
+        log_q_label = jnp.take(log_q, label)
+        log_q_samples = jnp.take(log_q, samples)
+    else:
+        log_q_label = jnp.full((label.shape[0],),
+                               -_math.log(float(num_total)))
+        log_q_samples = jnp.full((samples.shape[0],),
+                                 -_math.log(float(num_total)))
 
     true_logit = jnp.sum(x * jnp.take(w, label, axis=0), axis=-1)
     neg_logit = jnp.dot(x, jnp.take(w, samples, axis=0).T)  # [N, S]
@@ -764,9 +782,11 @@ def nce_core(ctx):
         true_logit = true_logit + jnp.take(bias, label)
         neg_logit = neg_logit + jnp.take(bias, samples)[None, :]
     # P(d=1|x,y) = exp(s) / (exp(s) + k*q(y))
-    kq = num_neg * noise_p
-    pos_ll = true_logit - jnp.logaddexp(true_logit, jnp.log(kq))
-    neg_ll = jnp.log(kq) - jnp.logaddexp(neg_logit, jnp.log(kq))
+    log_kq_pos = _math.log(float(num_neg)) + log_q_label        # [N]
+    log_kq_neg = _math.log(float(num_neg)) + log_q_samples      # [S]
+    pos_ll = true_logit - jnp.logaddexp(true_logit, log_kq_pos)
+    neg_ll = log_kq_neg[None, :] - jnp.logaddexp(neg_logit,
+                                                 log_kq_neg[None, :])
     cost = -(pos_ll + jnp.sum(neg_ll, axis=-1))
     ctx.set_output("Cost", cost[:, None])
 

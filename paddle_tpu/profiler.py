@@ -119,6 +119,15 @@ def record_program_analysis(label, compiled, mesh_devices=1):
     _program_analyses[label] = entry
 
 
+def get_program_analysis(label):
+    return _program_analyses.get(label)
+
+
+def put_program_analysis(label, entry):
+    if entry is not None:
+        _program_analyses[label] = entry
+
+
 def write_timeline(path):
     """Write the structured timeline artifact (JSON):
 
@@ -189,9 +198,11 @@ def profiler(state="All", sorted_key=None, profile_path=None,
     try:
         yield
     finally:
-        if timeline_path:
-            write_timeline(timeline_path)
-        stop_profiler(sorted_key, profile_path)
+        try:
+            if timeline_path:
+                write_timeline(timeline_path)
+        finally:
+            stop_profiler(sorted_key, profile_path)
 
 
 @contextlib.contextmanager
