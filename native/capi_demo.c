@@ -1,0 +1,67 @@
+/* Minimal C deployment of a paddle_tpu exported artifact — the analog of
+ * the reference's capi examples (paddle/capi/examples/model_inference).
+ *
+ *   ./capi_demo <repo_root> <artifact_dir> <n_floats_in> <dims...>
+ *
+ * Feeds one float32 input of ones and prints the first 8 outputs. */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+extern int paddle_tpu_init(const char* repo_root);
+extern void* paddle_tpu_machine_create_for_inference(const char* dir);
+extern int paddle_tpu_machine_forward(void* m, const float** inputs,
+                                      const int64_t** shapes,
+                                      const int* ndims, int n_inputs,
+                                      float* out_buf, int64_t out_capacity,
+                                      int64_t* out_shape, int* out_ndim);
+extern void paddle_tpu_machine_destroy(void* m);
+
+int main(int argc, char** argv) {
+  if (argc < 5) {
+    fprintf(stderr, "usage: %s repo_root artifact_dir n_floats dims...\n",
+            argv[0]);
+    return 2;
+  }
+  if (paddle_tpu_init(argv[1]) != 0) {
+    fprintf(stderr, "init failed\n");
+    return 1;
+  }
+  void* m = paddle_tpu_machine_create_for_inference(argv[2]);
+  if (!m) {
+    fprintf(stderr, "create failed\n");
+    return 1;
+  }
+  int64_t n = atoll(argv[3]);
+  int ndim = argc - 4;
+  int64_t shape[8];
+  for (int i = 0; i < ndim; ++i) shape[i] = atoll(argv[4 + i]);
+
+  float* in = (float*)malloc(n * sizeof(float));
+  for (int64_t i = 0; i < n; ++i) in[i] = 1.0f;
+  const float* inputs[1] = {in};
+  const int64_t* shapes[1] = {shape};
+  int ndims[1] = {ndim};
+
+  float out[4096];
+  int64_t out_shape[8];
+  int out_ndim = 0;
+  int rc = paddle_tpu_machine_forward(m, inputs, shapes, ndims, 1, out,
+                                      4096, out_shape, &out_ndim);
+  if (rc != 0) {
+    fprintf(stderr, "forward failed\n");
+    return 1;
+  }
+  printf("out_ndim=%d shape=[", out_ndim);
+  int64_t numel = 1;
+  for (int i = 0; i < out_ndim; ++i) {
+    printf(i ? ",%lld" : "%lld", (long long)out_shape[i]);
+    numel *= out_shape[i];
+  }
+  printf("]\nvalues:");
+  for (int64_t i = 0; i < numel && i < 8; ++i) printf(" %.6f", out[i]);
+  printf("\n");
+  paddle_tpu_machine_destroy(m);
+  free(in);
+  return 0;
+}

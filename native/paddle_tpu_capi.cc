@@ -1,0 +1,178 @@
+// paddle_tpu C inference API: deploy an exported artifact from plain C/C++.
+//
+// Role: the reference ships a C ABI for inference deployment
+// (paddle/capi/gradient_machine.h:36 paddle_gradient_machine_create_for_-
+// inference, :52 paddle_gradient_machine_forward) so applications embed the
+// model without the Python stack. Here the exported artifact is a compiled
+// StableHLO program (paddle_tpu/inference.py export_compiled); the runtime
+// that executes it is XLA via an embedded CPython+jax interpreter — the
+// same dependency surface the artifact needs anyway, behind a stable flat
+// C ABI. Build: make -C native capi  ->  libpaddle_tpu_capi.so.
+//
+// Contract (all float32, row-major):
+//   paddle_tpu_init(repo_root)               once per process
+//   m  = paddle_tpu_machine_create_for_inference(artifact_dir)
+//   rc = paddle_tpu_machine_forward(m, inputs, shapes, ndims, n_inputs,
+//                                   out_buf, out_capacity, out_shape,
+//                                   out_ndim)   // output 0
+//   paddle_tpu_machine_destroy(m)
+//   paddle_tpu_shutdown()
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+const char* kHelper = R"PYHELPER(
+import numpy as np
+import paddle_tpu.inference as _inf
+
+_models = {}
+
+def load(path):
+    _models[path] = _inf.load_compiled(path)
+    return len(_models[path].feed_names)
+
+def forward(path, buffers, shapes):
+    m = _models[path]
+    feed = {}
+    for name, buf, shp in zip(m.feed_names, buffers, shapes):
+        feed[name] = np.frombuffer(buf, dtype=np.float32).reshape(shp)
+    outs = m.run(feed)
+    out = np.asarray(outs[0], dtype=np.float32)
+    return out.tobytes(), list(out.shape)
+)PYHELPER";
+
+PyObject* g_helper = nullptr;
+
+struct Machine {
+  std::string path;
+};
+
+int ensure_helper() {
+  if (g_helper) return 0;
+  PyObject* code = Py_CompileString(kHelper, "<paddle_tpu_capi>",
+                                    Py_file_input);
+  if (!code) {
+    PyErr_Print();
+    return -1;
+  }
+  g_helper = PyImport_ExecCodeModule(
+      const_cast<char*>("_paddle_tpu_capi_helper"), code);
+  Py_DECREF(code);
+  if (!g_helper) {
+    PyErr_Print();
+    return -1;
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Initialise the embedded interpreter. `repo_root` (may be NULL) is
+// prepended to sys.path so `import paddle_tpu` resolves in deployments
+// that vendor the wheel next to the artifact.
+int paddle_tpu_init(const char* repo_root) {
+  bool fresh = !Py_IsInitialized();
+  if (fresh) Py_Initialize();
+  PyGILState_STATE g = PyGILState_Ensure();
+  int rc = 0;
+  if (repo_root && repo_root[0]) {
+    PyObject* sys_path = PySys_GetObject("path");  // borrowed
+    PyObject* p = PyUnicode_FromString(repo_root);
+    if (!sys_path || !p || PyList_Insert(sys_path, 0, p) != 0) rc = -1;
+    Py_XDECREF(p);
+  }
+  if (rc == 0) rc = ensure_helper();
+  PyGILState_Release(g);
+  if (fresh) {
+    // Py_Initialize leaves this thread holding the GIL; release it so
+    // other application threads can enter the API (PyGILState_Ensure)
+    // without deadlocking on the initialising thread
+    PyEval_SaveThread();
+  }
+  return rc;
+}
+
+void* paddle_tpu_machine_create_for_inference(const char* artifact_dir) {
+  PyGILState_STATE g = PyGILState_Ensure();
+  void* out = nullptr;
+  if (ensure_helper() == 0) {
+    PyObject* r = PyObject_CallMethod(g_helper, "load", "s", artifact_dir);
+    if (r) {
+      Py_DECREF(r);
+      out = new Machine{artifact_dir};
+    } else {
+      PyErr_Print();
+    }
+  }
+  PyGILState_Release(g);
+  return out;
+}
+
+// inputs[i]: float32 buffer; shapes[i]: dims (ndims[i] entries), in the
+// artifact's feed order (meta feed_names, sorted). Output 0 is copied into
+// out_buf (capacity in floats); its shape into out_shape (out_ndim dims).
+int paddle_tpu_machine_forward(void* machine, const float** inputs,
+                               const int64_t** shapes, const int* ndims,
+                               int n_inputs, float* out_buf,
+                               int64_t out_capacity, int64_t* out_shape,
+                               int* out_ndim) {
+  auto* m = static_cast<Machine*>(machine);
+  if (!m) return -1;
+  PyGILState_STATE g = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* bufs = PyList_New(n_inputs);
+  PyObject* shps = PyList_New(n_inputs);
+  for (int i = 0; i < n_inputs; ++i) {
+    int64_t numel = 1;
+    PyObject* shp = PyList_New(ndims[i]);
+    for (int d = 0; d < ndims[i]; ++d) {
+      numel *= shapes[i][d];
+      PyList_SetItem(shp, d, PyLong_FromLongLong(shapes[i][d]));
+    }
+    PyList_SetItem(shps, i, shp);
+    PyList_SetItem(bufs, i, PyBytes_FromStringAndSize(
+        reinterpret_cast<const char*>(inputs[i]),
+        static_cast<Py_ssize_t>(numel * sizeof(float))));
+  }
+  PyObject* r = PyObject_CallMethod(g_helper, "forward", "sOO",
+                                    m->path.c_str(), bufs, shps);
+  Py_DECREF(bufs);
+  Py_DECREF(shps);
+  if (r && PyTuple_Check(r) && PyTuple_Size(r) == 2) {
+    PyObject* data = PyTuple_GetItem(r, 0);   // borrowed
+    PyObject* shape = PyTuple_GetItem(r, 1);
+    Py_ssize_t nbytes = PyBytes_Size(data);
+    int nd = static_cast<int>(PyList_Size(shape));
+    if (nbytes / static_cast<Py_ssize_t>(sizeof(float)) <= out_capacity) {
+      memcpy(out_buf, PyBytes_AsString(data), nbytes);
+      for (int d = 0; d < nd; ++d)
+        out_shape[d] = PyLong_AsLongLong(PyList_GetItem(shape, d));
+      *out_ndim = nd;
+      rc = 0;
+    }
+  } else if (!r) {
+    PyErr_Print();
+  }
+  Py_XDECREF(r);
+  PyGILState_Release(g);
+  return rc;
+}
+
+void paddle_tpu_machine_destroy(void* machine) {
+  delete static_cast<Machine*>(machine);
+}
+
+void paddle_tpu_shutdown(void) {
+  // leave the interpreter up: jax/XLA teardown at Py_Finalize is unsafe
+  // from arbitrary host threads; process exit reclaims everything
+}
+
+}  // extern "C"

@@ -1,0 +1,83 @@
+"""Multi-process / multi-host launcher: ``python -m paddle_tpu.launch``.
+
+reference: paddle/scripts/cluster_train/paddle.py (the v1 cluster launcher:
+fans a job out over conf.py's HOSTS, wires trainer_id/ports, aborts the job
+when any worker dies) and the fluid k8s yamls (benchmark/cluster/vgg16/*).
+
+TPU-native shape: every host runs ONE process (jax.distributed handles the
+in-host chips); the launcher assigns ranks, points everyone at the
+coordinator, and propagates failure — the moral equivalent of the
+reference's ssh fan-out, for localhost process counts or as the per-host
+entry point under k8s (see cluster/ for pod specs).
+
+Usage:
+  python -m paddle_tpu.launch --nprocs 4 --coordinator HOST:PORT \
+      train.py --your-args
+Workers see PADDLE_TPU_COORDINATOR / _NUM_PROCESSES / _PROCESS_ID, which
+``paddle_tpu.parallel.env.init_distributed()`` consumes.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+def launch(nprocs, coordinator, script_argv, env=None, python=None):
+    """Spawn ``nprocs`` ranked worker processes; return the first non-zero
+    exit code (killing the rest), or 0. The fail-fast barrier matches the
+    reference launcher's job-abort semantics."""
+    procs = []
+    base_env = dict(env if env is not None else os.environ)
+    python = python or sys.executable
+    rc = 0
+    try:
+        for rank in range(nprocs):
+            e = dict(base_env)
+            e["PADDLE_TPU_COORDINATOR"] = coordinator
+            e["PADDLE_TPU_NUM_PROCESSES"] = str(nprocs)
+            e["PADDLE_TPU_PROCESS_ID"] = str(rank)
+            procs.append(subprocess.Popen([python] + list(script_argv),
+                                          env=e))
+        remaining = set(range(nprocs))
+        while remaining and rc == 0:
+            for i in list(remaining):
+                r = procs[i].poll()
+                if r is None:
+                    continue
+                remaining.discard(i)
+                if r != 0:
+                    rc = r
+            if remaining and rc == 0:
+                import time
+                time.sleep(0.05)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    return rc
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.launch",
+        description="rank-assigning multi-process launcher")
+    ap.add_argument("--nprocs", type=int, default=1)
+    ap.add_argument("--coordinator", default="127.0.0.1:12355")
+    ap.add_argument("script", nargs=argparse.REMAINDER,
+                    help="script and its args")
+    args = ap.parse_args(argv)
+    if not args.script:
+        ap.error("missing training script")
+    return launch(args.nprocs, args.coordinator, args.script)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -639,14 +639,16 @@ def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
     dim = input.shape[-1]
     w = helper.create_parameter(helper.param_attr,
                                 shape=[num_classes - 1, dim], dtype=dtype)
-    b = helper.create_parameter(helper.bias_attr or ParamAttr(),
-                                shape=[num_classes - 1, 1], dtype=dtype,
-                                is_bias=True)
+    b = None
+    if bias_attr is not False:
+        b = helper.create_parameter(helper.bias_attr or ParamAttr(),
+                                    shape=[num_classes - 1, 1], dtype=dtype,
+                                    is_bias=True)
     out = helper.create_variable_for_type_inference(dtype)
     out.shape = (input.shape[0], 1)
     helper.append_op(type="hierarchical_sigmoid",
                      inputs={"X": [input], "W": [w], "Label": [label],
-                             "Bias": [b]},
+                             "Bias": [b] if b is not None else []},
                      outputs={"Out": [out]},
                      attrs={"num_classes": num_classes})
     return out
@@ -753,13 +755,16 @@ def mdlstm(input, size, param_attr=None, bias_attr=None, name=None):
                                  dtype=dtype)
     wu = helper.create_parameter(ParamAttr(), shape=[size, 5 * size],
                                  dtype=dtype)
-    b = helper.create_parameter(helper.bias_attr or ParamAttr(),
-                                shape=[5 * size], dtype=dtype, is_bias=True)
+    b = None
+    if bias_attr is not False:
+        b = helper.create_parameter(helper.bias_attr or ParamAttr(),
+                                    shape=[5 * size], dtype=dtype,
+                                    is_bias=True)
     out = helper.create_variable_for_type_inference(dtype)
     out.shape = tuple(input.shape[:-1]) + (size,)
     helper.append_op(type="mdlstm",
                      inputs={"X": [input], "WeightX": [wx],
                              "WeightL": [wl], "WeightU": [wu],
-                             "Bias": [b]},
+                             "Bias": [b] if b is not None else []},
                      outputs={"Out": [out]})
     return out

@@ -382,6 +382,10 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
                          attrs={"shape": [num_neg],
                                 "range": num_total_classes})
     elif sampler == "custom_dist":
+        if custom_dist is None:
+            raise ValueError(
+                "nce(sampler='custom_dist') requires custom_dist (a "
+                "[num_total_classes] probability variable)")
         # sample via inverse-CDF of the user distribution
         # (reference: operators/math/sampler.h CustomSampler)
         helper.append_op(type="custom_dist_random_int",

@@ -15,9 +15,8 @@ import math
 import jax
 import jax.numpy as jnp
 
-from ..core.executor import raw_data, with_lod_of
+from ..core.executor import raw_data
 from ..core.registry import register_op
-from .common import jdt
 
 
 # ---------------------------------------------------------------------------

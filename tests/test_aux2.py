@@ -123,3 +123,40 @@ def test_sparse_embedding_grad_selected_rows():
             assert (w[r] != 1.0).all(), r
         else:
             np.testing.assert_array_equal(w[r], np.ones(4, np.float32))
+
+
+def test_launcher_assigns_ranks_and_fails_fast(tmp_path):
+    """python -m paddle_tpu.launch: rank env wiring + whole-job abort when
+    a worker fails (reference: paddle/scripts/cluster_train/paddle.py)."""
+    import subprocess
+    import sys
+
+    from paddle_tpu.launch import launch
+
+    out_dir = str(tmp_path)
+    script = (
+        "import os, sys\n"
+        "rank = os.environ['PADDLE_TPU_PROCESS_ID']\n"
+        "n = os.environ['PADDLE_TPU_NUM_PROCESSES']\n"
+        "coord = os.environ['PADDLE_TPU_COORDINATOR']\n"
+        "open(%r + '/rank_' + rank, 'w').write(n + ' ' + coord)\n"
+        % out_dir)
+    sc = str(tmp_path / "worker.py")
+    open(sc, "w").write(script)
+    rc = launch(3, "127.0.0.1:45671", [sc])
+    assert rc == 0
+    for r in range(3):
+        content = open(str(tmp_path / ("rank_%d" % r))).read()
+        assert content == "3 127.0.0.1:45671"
+
+    # any worker failing aborts the job with its exit code
+    bad = str(tmp_path / "bad.py")
+    open(bad, "w").write(
+        "import os, sys, time\n"
+        "if os.environ['PADDLE_TPU_PROCESS_ID'] == '1': sys.exit(3)\n"
+        "time.sleep(60)\n")
+    import time
+    t0 = time.time()
+    rc = launch(3, "127.0.0.1:45672", [bad])
+    assert rc == 3
+    assert time.time() - t0 < 30, "launcher must kill surviving workers"

@@ -1,6 +1,9 @@
 """AOT compiled-inference export/load round trip (PJRT/C-API parity path).
 reference role: capi inference create_for_inference + inference/io.h."""
+import os
+
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 
@@ -23,3 +26,47 @@ def test_export_compiled_round_trip(tmp_path):
     got = model.run({"x": sample})[0]
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_c_abi_inference_entry_point(tmp_path):
+    """Export a model, then run inference from a plain C program through
+    libpaddle_tpu_capi.so — no Python in the deployment code path
+    (reference: paddle/capi/gradient_machine.h:36,52 + capi examples)."""
+    import shutil
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    native = os.path.join(repo, "native")
+    if shutil.which("g++") is None or shutil.which("cc") is None:
+        pytest.skip("no C toolchain")
+
+    # 1. build + export a tiny model with known weights
+    x = fluid.layers.data("x", shape=[4])
+    w_init = fluid.ParamAttr(
+        name="capi_w",
+        initializer=fluid.initializer.ConstantInitializer(0.5))
+    out = fluid.layers.fc(x, size=3, param_attr=w_init,
+                          bias_attr=False, act=None)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    art = str(tmp_path / "artifact")
+    from paddle_tpu import inference as pinf
+    pinf.export_compiled(art, ["x"], [out], exe,
+                         example_feed={"x": np.ones((2, 4), np.float32)})
+
+    # 2. build the C ABI lib + demo binary
+    subprocess.run(["make", "-s", "-C", native, "capi", "demo"], check=True,
+                   capture_output=True)
+
+    # 3. run the C program; ones @ 0.5-filled [4,3] weight = rows of 2.0
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PYTHONPATH", None)  # deployment: repo path comes via argv
+    r = subprocess.run([os.path.join(native, "capi_demo"), repo, art,
+                        "8", "2", "4"],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, (r.stdout, r.stderr[-2000:])
+    assert "shape=[2,3]" in r.stdout, r.stdout
+    vals = [float(v) for v in
+            r.stdout.split("values:")[1].split()]
+    np.testing.assert_allclose(vals, [2.0] * 6, rtol=1e-5)
