@@ -263,8 +263,20 @@ class Block(object):
         if opdef is not None and opdef.infer_shape is not None:
             try:
                 opdef.infer_shape(op, self)
-            except Exception:
-                pass  # best-effort; real shapes come from tracing
+            except Exception as e:
+                # best-effort (real shapes come from tracing) but never
+                # silent: the failure is recorded for debugging, and
+                # PADDLE_TPU_DEBUG_SHAPES=1 surfaces it immediately —
+                # otherwise shape bugs appear only as cryptic trace errors
+                import os
+                rec = getattr(self.program, "_shape_infer_failures", None)
+                if rec is None:
+                    rec = self.program._shape_infer_failures = []
+                rec.append((op.type, str(e)))
+                if os.environ.get("PADDLE_TPU_DEBUG_SHAPES"):
+                    import warnings
+                    warnings.warn("shape inference failed for %s: %s"
+                                  % (op, e), RuntimeWarning)
 
     def __repr__(self):
         lines = ["Block %d (parent %d):" % (self.idx, self.parent_idx)]
@@ -358,12 +370,30 @@ class Program(object):
         """
         p = self.clone(for_test=True)
         blk = p.global_block()
+
+        def sub_block_reads(op, prog):
+            """All names a control-flow op's sub-blocks read (recursive):
+            keeping the op must keep its body's upstream producers."""
+            names = set()
+            for key, a in op.attrs.items():
+                sub = None
+                if isinstance(a, Block):
+                    sub = a
+                elif isinstance(a, int) and key in ("sub_block", "block"):
+                    sub = prog.blocks[a]
+                if sub is not None:
+                    for sop in sub.ops:
+                        names |= set(sop.input_arg_names)
+                        names |= sub_block_reads(sop, prog)
+            return names
+
         needed = set(fetches)
         kept = []
         for op in reversed(blk.ops):
             if set(op.output_arg_names) & needed:
                 kept.append(op)
                 needed |= set(op.input_arg_names)
+                needed |= sub_block_reads(op, p)
         blk.ops = list(reversed(kept))
         return p
 

@@ -16,6 +16,11 @@ class Scope(object):
         self.parent = parent
         self._vars: Dict[str, Any] = {}
         self._kids = []
+        # bumped when the VARIABLE SET changes (new name added/removed) —
+        # executors key their state-signature memo on it; value updates
+        # don't bump (shapes/dtypes of existing entries are re-validated
+        # only when the set changes, which is when new persistables appear)
+        self._names_version = 0
 
     def var(self, name: str):
         """Find-or-create (reference: Scope::Var)."""
@@ -48,8 +53,11 @@ class Scope(object):
                 return
             s = s.parent
         self._vars[name] = value
+        self._names_version += 1
 
     def erase(self, name: str):
+        if name in self._vars:
+            self._names_version += 1
         self._vars.pop(name, None)
 
     def new_scope(self) -> "Scope":

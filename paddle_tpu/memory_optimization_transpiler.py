@@ -82,14 +82,34 @@ class ControlFlowGraph(object):
         return pairs
 
 
-def memory_optimize(input_program: ir.Program, print_log=False, level=0):
+# activation-heavy ops whose residuals dominate training memory: the
+# default selective-checkpoint set (trading their recompute FLOPs for
+# activation memory is the profitable direction; cheap elementwise ops are
+# NOT worth re-running)
+DEFAULT_REMAT_TYPES = frozenset((
+    "conv2d", "depthwise_conv2d", "mul", "matmul", "dynamic_lstm",
+    "dynamic_gru", "sequence_conv", "flash_attention", "mdlstm"))
+
+
+def memory_optimize(input_program: ir.Program, print_log=False, level=0,
+                    remat_types=None):
     """Enable rematerialisation for the program and report the reuse the
     liveness analysis finds (XLA applies the actual buffer sharing when it
-    compiles the traced computation)."""
+    compiles the traced computation).
+
+    ``remat_types``: which op types get jax.checkpoint'd in their backward
+    (selective checkpointing). Default: the activation-heavy set
+    DEFAULT_REMAT_TYPES; pass True for every op (the old global flag),
+    or an iterable of type names."""
     cfg = ControlFlowGraph(input_program).analyze()
     pairs = cfg.reuse_pairs()
     input_program._memory_optimized = True
-    input_program._remat = True
+    if remat_types is True:
+        input_program._remat = True
+    else:
+        input_program._remat_types = frozenset(
+            remat_types if remat_types is not None
+            else DEFAULT_REMAT_TYPES)
     if print_log:
         for dead, reuse in pairs:
             print("memory_optimize: %s can reuse %s" % (reuse, dead))

@@ -4,6 +4,9 @@ fc(size=1) + square_error_cost, SGD, save/load inference round trip."""
 import numpy as np
 
 import paddle_tpu as fluid
+import pytest
+
+pytestmark = pytest.mark.slow  # book e2e: minutes on CPU
 
 
 def test_fit_a_line(tmp_path):

@@ -6,6 +6,8 @@ import pytest
 
 import paddle_tpu as fluid
 
+pytestmark = pytest.mark.slow  # book e2e: minutes on CPU
+
 
 def vgg_small(input):
     def conv_block(ipt, num_filter, groups):

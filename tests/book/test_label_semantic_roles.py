@@ -6,10 +6,16 @@ import numpy as np
 import paddle_tpu as fluid
 from paddle_tpu.core.lod import build_lod_tensor
 
-word_dict, verb_dict, label_dict = fluid.dataset.conll05.get_dict()
-word_dict_len = len(word_dict)
-label_dict_len = len(label_dict)
-pred_len = len(verb_dict)
+import pytest
+
+pytestmark = pytest.mark.slow  # book e2e: minutes on CPU
+
+
+def _dicts():
+    # inside a function: module import happens at pytest COLLECTION time,
+    # and the fast gate (-m "not slow") must not pay for dataset builds
+    word_dict, verb_dict, label_dict = fluid.dataset.conll05.get_dict()
+    return len(word_dict), len(verb_dict), len(label_dict)
 
 mark_dict_len = 2
 word_dim = 16
@@ -19,7 +25,8 @@ depth = 4
 mix_hidden_lr = 1.0
 
 
-def db_lstm(word, predicate, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, mark):
+def db_lstm(word, predicate, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, mark,
+            word_dict_len, pred_len, label_dict_len):
     predicate_embedding = fluid.layers.embedding(
         input=predicate, size=[pred_len, word_dim],
         param_attr=fluid.ParamAttr(name="vemb"))
@@ -55,6 +62,8 @@ def db_lstm(word, predicate, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, mark):
 
 
 def test_label_semantic_roles():
+    word_dict_len, pred_len, label_dict_len = _dicts()
+
     def seq_data(name):
         return fluid.layers.data(name=name, shape=[1], dtype="int64",
                                  lod_level=1)
@@ -68,7 +77,8 @@ def test_label_semantic_roles():
     ctx_p2 = seq_data("ctx_p2_data")
     mark = seq_data("mark_data")
     feature_out = db_lstm(word, predicate, ctx_n2, ctx_n1, ctx_0, ctx_p1,
-                          ctx_p2, mark)
+                          ctx_p2, mark, word_dict_len, pred_len,
+                          label_dict_len)
     target = seq_data("target")
     crf_cost = fluid.layers.linear_chain_crf(
         input=feature_out, label=target,

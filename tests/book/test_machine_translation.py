@@ -5,6 +5,9 @@ import numpy as np
 
 import paddle_tpu as fluid
 from paddle_tpu.core.lod import LoDTensor, build_lod_tensor
+import pytest
+
+pytestmark = pytest.mark.slow  # book e2e: minutes on CPU
 
 pd = fluid.layers
 

@@ -5,6 +5,8 @@ import pytest
 
 import paddle_tpu as fluid
 
+pytestmark = pytest.mark.slow  # book e2e: minutes on CPU
+
 
 def mlp(img, label):
     hidden = fluid.layers.fc(input=img, size=64, act="relu")

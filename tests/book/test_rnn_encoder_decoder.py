@@ -6,6 +6,9 @@ import numpy as np
 
 import paddle_tpu as fluid
 from paddle_tpu.core.lod import build_lod_tensor
+import pytest
+
+pytestmark = pytest.mark.slow  # book e2e: minutes on CPU
 
 pd = fluid.layers
 

@@ -7,6 +7,8 @@ import pytest
 import paddle_tpu as fluid
 from paddle_tpu.core.lod import build_lod_tensor
 
+pytestmark = pytest.mark.slow  # book e2e: minutes on CPU
+
 VOCAB = 5147
 EMB_DIM = 16
 HID_DIM = 16

@@ -4,6 +4,9 @@ embeddings concat -> fc -> softmax over vocab."""
 import numpy as np
 
 import paddle_tpu as fluid
+import pytest
+
+pytestmark = pytest.mark.slow  # book e2e: minutes on CPU
 
 EMB_DIM = 16
 N = 5

@@ -55,7 +55,9 @@ def test_memory_optimize_liveness_and_trains():
     loss = fluid.layers.mean(h3)
     fluid.optimizer.SGD(0.01).minimize(loss)
     pairs = fluid.memory_optimize(fluid.default_main_program())
-    assert fluid.default_main_program()._remat
+    from paddle_tpu.memory_optimization_transpiler import \
+        DEFAULT_REMAT_TYPES
+    assert fluid.default_main_program()._remat_types == DEFAULT_REMAT_TYPES
     assert isinstance(pairs, list)
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(fluid.default_startup_program())
