@@ -26,6 +26,7 @@ class Scope(object):
         """Find-or-create (reference: Scope::Var)."""
         if name not in self._vars:
             self._vars[name] = None
+            self._names_version += 1
         return self._vars[name]
 
     def find_var(self, name: str):

@@ -100,15 +100,18 @@ def memory_optimize(input_program: ir.Program, print_log=False, level=0,
     ``remat_types``: which op types get jax.checkpoint'd in their backward
     (selective checkpointing). Default: the activation-heavy set
     DEFAULT_REMAT_TYPES; pass True for every op (the old global flag),
-    or an iterable of type names."""
+    False (or an empty iterable) for none, or an iterable of type names."""
     cfg = ControlFlowGraph(input_program).analyze()
     pairs = cfg.reuse_pairs()
     input_program._memory_optimized = True
     if remat_types is True:
         input_program._remat = True
     else:
+        # a later selective/disable call overrides an earlier global one
+        input_program._remat = False
         input_program._remat_types = frozenset(
-            remat_types if remat_types is not None
+            () if remat_types is False
+            else remat_types if remat_types is not None
             else DEFAULT_REMAT_TYPES)
     if print_log:
         for dead, reuse in pairs:

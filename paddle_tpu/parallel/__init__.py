@@ -19,3 +19,6 @@ from .ring import (  # noqa: F401
     ring_attention, ring_attention_sharded, ulysses_attention,
     ulysses_attention_sharded,
 )
+from .pipeline import (  # noqa: F401
+    pipeline, pipelined_step_fn, stack_stage_params,
+)
