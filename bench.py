@@ -116,30 +116,50 @@ def _measure(pt, layers, models, batch, steps, fuse, amp_on, scope):
         loss = np.asarray(loss)  # sync
         _log("compile+first run %.1fs, loss=%.4f" % (time.time() - tc,
                                                      float(loss.reshape(-1)[0])))
+        # the device can be externally contended (shared/tunnelled chip:
+        # observed >10x swings between identical runs) — time several
+        # windows and report the best, which is the least-contended sample
         iters = max(steps // fuse, 1)
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out, = exe.run(main_p, feed=feed, fetch_list=[avg],
-                           return_numpy=False, repeat=fuse)
-        np.asarray(out)  # sync
-        dt = time.perf_counter() - t0
-    img_s = batch * fuse * iters / dt
-    _log("batch=%d fuse=%d amp=%s: %.2f img/s (%.1f ms/step)"
-         % (batch, fuse, amp_on, img_s, 1e3 * dt / (fuse * iters)))
+        best_dt = float("inf")
+        windows_done = 0
+        for _ in range(3 if _remaining() > 90 else 1):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out, = exe.run(main_p, feed=feed, fetch_list=[avg],
+                               return_numpy=False, repeat=fuse)
+            np.asarray(out)  # host read-back = true sync over the tunnel
+            best_dt = min(best_dt, time.perf_counter() - t0)
+            windows_done += 1
+            if _remaining() < 60:
+                break
+    img_s = batch * fuse * iters / best_dt
+    _log("batch=%d fuse=%d amp=%s: %.2f img/s best-of-%d (%.1f ms/step)"
+         % (batch, fuse, amp_on, img_s, windows_done,
+            1e3 * best_dt / (fuse * iters)))
     return img_s
 
 
 def _autotune_conv():
     """Pick the dense-conv lowering empirically on the real device: time one
     ResNet-middle conv layer (fwd+bwd) as lax.conv vs shifted-matmul and pin
-    PADDLE_TPU_CONV_IMPL to the winner. ~2 small compiles, bounded cost."""
+    PADDLE_TPU_CONV_IMPL to the winner. ~2 small compiles, bounded cost.
+
+    Timing caveats this must survive (tunnelled PJRT device):
+    - ``block_until_ready`` can return before the work actually ran — only a
+      device->host transfer (np.asarray) is a true sync;
+    - loop-invariant code hoists: the timed op must consume the loop carry
+      and feed it, or XLA runs it once (or never — constant inputs fold).
+    So: random inputs, iterations chained through a carry that perturbs the
+    input, one host read-back at the end, best-of-2 trials per impl.
+    """
     if "PADDLE_TPU_CONV_IMPL" in os.environ:
         return os.environ["PADDLE_TPU_CONV_IMPL"]
     import jax
     import jax.numpy as jnp
 
-    x = jnp.ones((32, 128, 28, 28), jnp.bfloat16)
-    w = jnp.ones((128, 128, 3, 3), jnp.bfloat16)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    x = jax.random.normal(k1, (64, 128, 28, 28), jnp.bfloat16)
+    w = jax.random.normal(k2, (128, 128, 3, 3), jnp.bfloat16) * 0.05
 
     def native(x_, w_):
         return jax.lax.conv_general_dilated(
@@ -152,21 +172,35 @@ def _autotune_conv():
         for ky in range(3):
             for kx in range(3):
                 patch = jax.lax.slice(xp, (0, 0, ky, kx),
-                                      (32, 128, ky + 28, kx + 28))
+                                      (64, 128, ky + 28, kx + 28))
                 t = jnp.einsum("bchw,oc->bohw", patch, w_[:, :, ky, kx])
                 out = t if out is None else out + t
         return out
 
+    N_ITER = 8
+
     def time_impl(f):
-        loss = jax.jit(jax.grad(lambda x_, w_: f(x_, w_).astype(
-            jnp.float32).sum(), argnums=(0, 1)))
-        r = loss(x, w)
-        jax.block_until_ready(r)
-        t0 = time.perf_counter()
-        for _ in range(3):
-            r = loss(x, w)
-        jax.block_until_ready(r)
-        return (time.perf_counter() - t0) / 3
+        grad = jax.grad(
+            lambda x_, w_: f(x_, w_).astype(jnp.float32).sum(),
+            argnums=(0, 1))
+
+        def chained(x_, w_):
+            def body(c, _):
+                dx, dw = grad(x_ + c, w_)
+                s = (jnp.sum(dx.astype(jnp.float32))
+                     + jnp.sum(dw.astype(jnp.float32)))
+                return (s * 1e-30).astype(x_.dtype), None
+            return jax.lax.scan(body, jnp.zeros((), x_.dtype), None,
+                                length=N_ITER)[0]
+
+        g = jax.jit(chained)
+        float(np.asarray(g(x, w)))  # compile + warm
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            float(np.asarray(g(x, w)))  # host read-back = real sync
+            best = min(best, (time.perf_counter() - t0) / N_ITER)
+        return best
 
     try:
         tn = time_impl(native)

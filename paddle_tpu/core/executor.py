@@ -379,7 +379,7 @@ class Executor(object):
     """reference: python/paddle/fluid/executor.py:166 (class Executor) /
     paddle/fluid/framework/executor.cc:86 (Executor::Run)."""
 
-    def __init__(self, place=None, dist_context=None, check_nan_inf=False):
+    def __init__(self, place=None, dist_context=None, check_nan_inf=None):
         from .. import place as place_mod
         self.place = place if place is not None else place_mod.TPUPlace()
         self._cache: Dict[Any, Any] = {}
@@ -387,8 +387,11 @@ class Executor(object):
         # DistContext from paddle_tpu.parallel: when set, the jitted block is
         # compiled with mesh shardings (SPMD) instead of pinned to one device
         self.dist_context = dist_context
-        # FLAGS_check_nan_inf analog; forces the eager path when on
-        self.check_nan_inf = check_nan_inf
+        # FLAGS_check_nan_inf analog; forces the eager path when on.
+        # None defers to the process flag at each run(), like the reference
+        # reading FLAGS inside Run() (reference: executor.cc:30) — so a
+        # flags_guard around run() takes effect on an existing Executor
+        self._check_nan_inf_arg = check_nan_inf
         # which path each run() took — tests assert dynamic-control-flow
         # programs really compile (VERDICT r1 item 3)
         self.stats = {"jit_runs": 0, "eager_runs": 0}
@@ -401,6 +404,17 @@ class Executor(object):
         # across scope lifetimes.
         import weakref
         self._state_memo = weakref.WeakKeyDictionary()
+
+    @property
+    def check_nan_inf(self):
+        if self._check_nan_inf_arg is not None:
+            return self._check_nan_inf_arg
+        from ..flags import FLAGS
+        return FLAGS.check_nan_inf
+
+    @check_nan_inf.setter
+    def check_nan_inf(self, v):
+        self._check_nan_inf_arg = v
 
     def _device(self):
         """Resolve the jax device this Place pins; None = jax default."""

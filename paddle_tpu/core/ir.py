@@ -273,7 +273,9 @@ class Block(object):
                 if rec is None:
                     rec = self.program._shape_infer_failures = []
                 rec.append((op.type, str(e)))
-                if os.environ.get("PADDLE_TPU_DEBUG_SHAPES"):
+                from ..flags import FLAGS
+                if (os.environ.get("PADDLE_TPU_DEBUG_SHAPES")
+                        or FLAGS.debug_shapes):
                     import warnings
                     warnings.warn("shape inference failed for %s: %s"
                                   % (op, e), RuntimeWarning)

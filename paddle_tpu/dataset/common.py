@@ -18,8 +18,19 @@ import os
 
 import numpy as np
 
-DATA_HOME = os.path.expanduser(
-    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+def data_home():
+    """Dataset cache dir, resolved at call time so both the env var and
+    ``set_flags({'data_home': ...})`` take effect (env wins)."""
+    env = os.environ.get("PADDLE_TPU_DATA_HOME")
+    if env:
+        return os.path.expanduser(env)
+    from ..flags import FLAGS
+    return os.path.expanduser(FLAGS.data_home)
+
+
+# import-time snapshot kept for API parity (reference: v2/dataset/common.py
+# DATA_HOME); prefer data_home() in new code
+DATA_HOME = data_home()
 
 __all__ = ["DATA_HOME", "md5file", "download", "seeded_rng",
            "synthetic_notice"]

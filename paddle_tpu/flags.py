@@ -1,0 +1,206 @@
+"""Process-level flag registry: the gflags role.
+
+The reference configures its runtime through three generations of gflags
+(legacy set: reference paddle/utils/Flags.cpp:18-95 — use_gpu,
+trainer_count, port, trainer_id…; fluid's own: FLAGS_benchmark,
+FLAGS_check_nan_inf in framework/executor.cc:29-32, dynload dirs in
+platform/dynload/dynamic_loader.cc:25-44) re-exported to Python via
+``core.init_gflags`` (pybind.cc). This module is the TPU-native analog:
+a typed, declared-with-default registry, overridable three ways —
+
+- environment: ``PADDLE_TPU_FLAGS="check_nan_inf=true,conv_impl=matmul"``
+  or per-flag ``PADDLE_TPU_FLAG_CHECK_NAN_INF=true`` (read at first use);
+- code: ``flags.FLAGS.check_nan_inf = True`` or ``flags.set_flags({...})``;
+- CLI: ``init_from_args(argv)`` consumes ``--name=value`` pairs and returns
+  the rest (the InitGflags role, reference: framework/init.cc:25).
+
+Declaring is ``DEFINE_bool/int32/float/string(name, default, help)``;
+reading is attribute access on ``FLAGS``. Unknown names raise — the same
+contract as gflags' compile-time check.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List
+
+__all__ = ["FLAGS", "DEFINE_bool", "DEFINE_int32", "DEFINE_float",
+           "DEFINE_string", "set_flags", "get_flags", "init_from_args",
+           "flags_guard"]
+
+_TRUE = frozenset(("1", "true", "yes", "on"))
+_FALSE = frozenset(("0", "false", "no", "off", ""))
+
+
+def _parse_bool(s):
+    if isinstance(s, bool):
+        return s
+    t = str(s).strip().lower()
+    if t in _TRUE:
+        return True
+    if t in _FALSE:
+        return False
+    raise ValueError("not a boolean: %r" % (s,))
+
+
+class _FlagDef(object):
+    __slots__ = ("name", "default", "help", "parse")
+
+    def __init__(self, name, default, help_, parse):
+        self.name = name
+        self.default = default
+        self.help = help_
+        self.parse = parse
+
+
+class _Flags(object):
+    """Attribute-style access over the registry; thread-safe writes."""
+
+    def __init__(self):
+        object.__setattr__(self, "_defs", {})
+        object.__setattr__(self, "_values", {})
+        object.__setattr__(self, "_lock", threading.Lock())
+        object.__setattr__(self, "_env_loaded", False)
+
+    # -- registry ----------------------------------------------------------
+    def _define(self, name, default, help_, parse):
+        with self._lock:
+            if name in self._defs:
+                raise ValueError("flag %r already defined" % name)
+            self._defs[name] = _FlagDef(name, default, help_, parse)
+
+    def _load_env_once(self):
+        if self._env_loaded:
+            return
+        with self._lock:
+            if self._env_loaded:
+                return
+            blob = os.environ.get("PADDLE_TPU_FLAGS", "")
+            for pair in blob.split(","):
+                pair = pair.strip()
+                if not pair:
+                    continue
+                k, _, v = pair.partition("=")
+                d = self._defs.get(k.strip())
+                if d is not None:
+                    self._values[d.name] = d.parse(v.strip())
+            for name, d in self._defs.items():
+                env_key = "PADDLE_TPU_FLAG_" + name.upper()
+                if env_key in os.environ:
+                    self._values[name] = d.parse(os.environ[env_key])
+            object.__setattr__(self, "_env_loaded", True)
+
+    # -- access ------------------------------------------------------------
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        self._load_env_once()
+        if name not in self._defs:
+            raise AttributeError("undeclared flag %r" % name)
+        return self._values.get(name, self._defs[name].default)
+
+    def __setattr__(self, name, value):
+        self._load_env_once()
+        if name not in self._defs:
+            raise AttributeError("undeclared flag %r" % name)
+        with self._lock:
+            self._values[name] = self._defs[name].parse(value)
+
+    def _snapshot(self) -> Dict[str, Any]:
+        self._load_env_once()
+        return {n: self._values.get(n, d.default)
+                for n, d in self._defs.items()}
+
+
+FLAGS = _Flags()
+
+
+def DEFINE_bool(name, default, help=""):
+    FLAGS._define(name, default, help, _parse_bool)
+
+
+def DEFINE_int32(name, default, help=""):
+    FLAGS._define(name, default, help, int)
+
+
+def DEFINE_float(name, default, help=""):
+    FLAGS._define(name, default, help, float)
+
+
+def DEFINE_string(name, default, help=""):
+    FLAGS._define(name, default, help, str)
+
+
+def set_flags(d: Dict[str, Any]):
+    for k, v in d.items():
+        setattr(FLAGS, k, v)
+
+
+def get_flags(names=None) -> Dict[str, Any]:
+    snap = FLAGS._snapshot()
+    if names is None:
+        return snap
+    return {n: snap[n] for n in names}
+
+
+def init_from_args(argv: List[str]) -> List[str]:
+    """Consume ``--flag=value`` / ``--flag value`` pairs for declared flags;
+    returns the remaining argv (unknown args pass through untouched)."""
+    rest, i = [], 0
+    FLAGS._load_env_once()
+    while i < len(argv):
+        a = argv[i]
+        if a.startswith("--"):
+            k, eq, v = a[2:].partition("=")
+            if k in FLAGS._defs:
+                if not eq:
+                    if i + 1 >= len(argv):
+                        raise ValueError("flag --%s needs a value" % k)
+                    v, i = argv[i + 1], i + 1
+                setattr(FLAGS, k, v)
+                i += 1
+                continue
+        rest.append(a)
+        i += 1
+    return rest
+
+
+class flags_guard(object):
+    """Scoped overrides: ``with flags_guard(check_nan_inf=True): ...``."""
+
+    def __init__(self, **over):
+        self._over = over
+        self._saved = {}
+
+    def __enter__(self):
+        for k, v in self._over.items():
+            self._saved[k] = getattr(FLAGS, k)
+            setattr(FLAGS, k, v)
+        return FLAGS
+
+    def __exit__(self, *exc):
+        for k, v in self._saved.items():
+            setattr(FLAGS, k, v)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Core flag set (the FLAGS_* the rest of the framework consults; the legacy
+# trainer flags live on their consumers' call signatures instead)
+
+DEFINE_bool("check_nan_inf", False,
+            "scan every op output for NaN/Inf on the per-op path "
+            "(reference: FLAGS_check_nan_inf, executor.cc:30)")
+DEFINE_bool("benchmark", False,
+            "synchronise and time every Executor.run "
+            "(reference: FLAGS_benchmark, executor.cc:29)")
+DEFINE_string("conv_impl", "conv",
+              "dense conv2d lowering: 'conv' (lax.conv) or 'matmul' "
+              "(shifted einsums); bench.py autotunes this on device")
+DEFINE_bool("debug_shapes", False,
+            "raise (instead of recording) on shape-inference failures")
+DEFINE_string("data_home", "~/.cache/paddle_tpu/dataset",
+              "dataset cache directory (reference: v2/dataset common)")
+DEFINE_int32("log_period", 100,
+             "steps between trainer progress lines "
+             "(reference: utils/Flags.cpp log_period)")

@@ -223,7 +223,11 @@ def conv_impl():
     operators/math/im2col.* + conv_op.h GemmConvKernel). bench.py autotunes
     this on the real device and pins PADDLE_TPU_CONV_IMPL."""
     import os
-    return os.environ.get("PADDLE_TPU_CONV_IMPL", "conv")
+    env = os.environ.get("PADDLE_TPU_CONV_IMPL")
+    if env:
+        return env
+    from ..flags import FLAGS
+    return FLAGS.conv_impl
 
 
 def _conv_shifted_matmul(x, w, s, p):

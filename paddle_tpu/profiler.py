@@ -14,7 +14,9 @@ import contextlib
 import time
 from collections import defaultdict
 
-__all__ = ["start_profiler", "stop_profiler", "reset_profiler", "profiler",
+__all__ = ["timer", "stat_summary", "print_stats", "reset_stats",
+           "BarrierStat",
+           "start_profiler", "stop_profiler", "reset_profiler", "profiler",
            "cuda_profiler", "xla_trace", "profiler_enabled", "record_run",
            "record_op_event", "record_program_analysis", "write_timeline"]
 
@@ -237,3 +239,127 @@ def record_event(name):
         yield
     finally:
         record_run(name, time.perf_counter() - t0)
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical stats: the REGISTER_TIMER role (reference: paddle/utils/Stat.h
+# — per-name accumulated timers printed as a tree every log period, plus
+# BarrierStat for straggler analysis across trainers). Here: nested `timer`
+# scopes accumulate (count/total/max) per dotted path; `print_stats` renders
+# the tree; `BarrierStat.observe` records per-member arrival times of a
+# collective/barrier and reports the straggler gap.
+
+import threading as _threading
+
+_stat_state = _threading.local()
+
+
+class _StatNode(object):
+    __slots__ = ("count", "total", "max")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+
+    def add(self, dt):
+        self.count += 1
+        self.total += dt
+        self.max = max(self.max, dt)
+
+
+_stats = {}
+_stats_lock = _threading.Lock()
+
+
+@contextlib.contextmanager
+def timer(name):
+    """Accumulating hierarchical timer: nesting builds dotted paths.
+
+    >>> with profiler.timer("forward"):
+    ...     with profiler.timer("conv"):   # recorded as "forward.conv"
+    ...         ...
+    """
+    stack = getattr(_stat_state, "stack", None)
+    if stack is None:
+        stack = _stat_state.stack = []
+    stack.append(name)
+    path = ".".join(stack)
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        stack.pop()
+        with _stats_lock:
+            _stats.setdefault(path, _StatNode()).add(dt)
+
+
+def stat_summary():
+    """{path: (count, total_s, avg_s, max_s)} snapshot."""
+    with _stats_lock:
+        return {p: (n.count, n.total, n.total / n.count, n.max)
+                for p, n in _stats.items() if n.count}
+
+
+def print_stats(file=None):
+    """Render the timer tree (REGISTER_TIMER print analog)."""
+    import sys as _sys
+    out = file or _sys.stdout
+    snap = stat_summary()
+    if not snap:
+        print("(no stats recorded)", file=out)
+        return
+    print("%-40s %8s %12s %12s %12s" %
+          ("timer", "count", "total_ms", "avg_ms", "max_ms"), file=out)
+    for path in sorted(snap):
+        cnt, tot, avg, mx = snap[path]
+        depth = path.count(".")
+        label = "  " * depth + path.rsplit(".", 1)[-1]
+        print("%-40s %8d %12.3f %12.3f %12.3f" %
+              (label, cnt, 1e3 * tot, 1e3 * avg, 1e3 * mx), file=out)
+
+
+def reset_stats():
+    with _stats_lock:
+        _stats.clear()
+
+
+class BarrierStat(object):
+    """Straggler analysis for an N-member barrier (reference:
+    paddle/pserver/ParameterServer2 BarrierStat / utils/Stat.h): feed each
+    member's arrival timestamp per round; report the slowest-minus-fastest
+    gap and which member lags most often."""
+
+    def __init__(self, n_members, name="barrier"):
+        self.n = n_members
+        self.name = name
+        self._round = {}
+        self._gaps = []
+        self._slowest = {}  # member id (any hashable) -> lag-round count
+        self._lock = _threading.Lock()
+
+    def observe(self, member, t=None):
+        t = time.perf_counter() if t is None else t
+        with self._lock:
+            self._round[member] = t
+            if len(self._round) == self.n:
+                ts = self._round
+                fastest = min(ts, key=ts.get)
+                slowest = max(ts, key=ts.get)
+                self._gaps.append(ts[slowest] - ts[fastest])
+                self._slowest[slowest] = self._slowest.get(slowest, 0) + 1
+                self._round = {}
+
+    def summary(self):
+        with self._lock:
+            if not self._gaps:
+                return {"rounds": 0}
+            worst = max(self._slowest, key=self._slowest.get)
+            return {
+                "rounds": len(self._gaps),
+                "mean_gap_s": sum(self._gaps) / len(self._gaps),
+                "max_gap_s": max(self._gaps),
+                "worst_member": worst,
+                "worst_member_lag_rounds": self._slowest[worst],
+            }

@@ -162,3 +162,36 @@ def test_launcher_assigns_ranks_and_fails_fast(tmp_path):
     rc = launch(3, "127.0.0.1:45672", [bad])
     assert rc == 3
     assert time.time() - t0 < 30, "launcher must kill surviving workers"
+
+
+# -- hierarchical stat timers (reference: paddle/utils/Stat.h) --------------
+
+def test_stat_timer_tree_and_print(capsys):
+    from paddle_tpu import profiler
+    import time as _t
+    profiler.reset_stats()
+    with profiler.timer("pass"):
+        for _ in range(3):
+            with profiler.timer("batch"):
+                _t.sleep(0.001)
+    snap = profiler.stat_summary()
+    assert snap["pass"][0] == 1
+    assert snap["pass.batch"][0] == 3
+    assert snap["pass"][1] >= snap["pass.batch"][1]
+    profiler.print_stats()
+    out = capsys.readouterr().out
+    assert "batch" in out and "count" in out
+    profiler.reset_stats()
+
+
+def test_barrier_stat_straggler():
+    from paddle_tpu import profiler
+    bs = profiler.BarrierStat(4)
+    for r in range(5):
+        for m in range(4):
+            # member 2 always arrives 10ms late
+            bs.observe(m, t=r * 1.0 + (0.01 if m == 2 else 0.0))
+    s = bs.summary()
+    assert s["rounds"] == 5
+    assert s["worst_member"] == 2
+    assert abs(s["mean_gap_s"] - 0.01) < 1e-6

@@ -1,0 +1,70 @@
+"""Flag registry (the gflags role; reference: paddle/utils/Flags.cpp:18-95,
+framework/executor.cc:29-32 FLAGS_check_nan_inf / FLAGS_benchmark,
+framework/init.cc:25 InitGflags)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import flags, layers
+
+
+def test_defaults_and_types():
+    assert flags.FLAGS.check_nan_inf is False
+    assert flags.FLAGS.conv_impl == "conv"
+    assert isinstance(flags.FLAGS.log_period, int)
+
+
+def test_set_and_guard():
+    flags.set_flags({"log_period": 7})
+    assert flags.FLAGS.log_period == 7
+    with flags.flags_guard(log_period=3, check_nan_inf=True):
+        assert flags.FLAGS.log_period == 3
+        assert flags.FLAGS.check_nan_inf is True
+    assert flags.FLAGS.log_period == 7
+    assert flags.FLAGS.check_nan_inf is False
+    flags.set_flags({"log_period": 100})
+
+
+def test_bool_parsing_and_unknown():
+    with flags.flags_guard(check_nan_inf="true"):
+        assert flags.FLAGS.check_nan_inf is True
+    with pytest.raises(AttributeError):
+        flags.FLAGS.not_a_flag
+    with pytest.raises(AttributeError):
+        flags.FLAGS.no_such = 1
+    with pytest.raises(ValueError):
+        flags.set_flags({"check_nan_inf": "maybe"})
+
+
+def test_init_from_args():
+    rest = flags.init_from_args(
+        ["prog", "--log_period=5", "--keep", "--check_nan_inf", "on", "x"])
+    assert rest == ["prog", "--keep", "x"]
+    assert flags.FLAGS.log_period == 5
+    assert flags.FLAGS.check_nan_inf is True
+    flags.set_flags({"log_period": 100, "check_nan_inf": False})
+
+
+def test_get_flags_subset():
+    d = flags.get_flags(["conv_impl", "benchmark"])
+    assert set(d) == {"conv_impl", "benchmark"}
+
+
+def test_executor_consults_check_nan_inf_flag():
+    main, startup = pt.Program(), pt.Program()
+    pt.switch_main_program(main)
+    pt.switch_startup_program(startup)
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.fc(x, size=2)
+    with pt.scope_guard(pt.Scope()):
+        with flags.flags_guard(check_nan_inf=True):
+            exe = pt.Executor(pt.CPUPlace())
+            assert exe.check_nan_inf is True
+            exe.run(startup)
+            with pytest.raises(FloatingPointError):
+                exe.run(main, feed={"x": np.full((2, 4), np.nan,
+                                                 dtype="float32")},
+                        fetch_list=[y])
+        # explicit argument wins over the flag
+        exe2 = pt.Executor(pt.CPUPlace(), check_nan_inf=False)
+        assert exe2.check_nan_inf is False
