@@ -15,7 +15,9 @@ import queue as _queue
 import threading
 
 __all__ = ["Go", "Channel", "ChannelClosed", "make_channel",
-           "channel_send", "channel_recv", "channel_close"]
+           "channel_send", "channel_recv", "channel_close",
+           "prog_make_channel", "prog_channel_send", "prog_channel_recv",
+           "prog_channel_close", "ProgGo"]
 
 
 class ChannelClosed(Exception):
@@ -25,18 +27,29 @@ class ChannelClosed(Exception):
 class Channel(object):
     """Typed bounded channel (reference: framework/channel.h:28
     Channel<T>::Send/Receive semantics: send to closed raises, receive on
-    closed drains then signals)."""
+    closed drains then signals). ``capacity=0`` is an UNBUFFERED channel:
+    send rendezvouses — it blocks until a receiver has taken the value,
+    like the reference (and Go), not python-Queue's 'maxsize 0 = infinite'.
+    """
 
     _CLOSED = object()
 
     def __init__(self, capacity=0):
-        self._q = _queue.Queue(maxsize=capacity)
+        self._unbuffered = capacity == 0
+        self._q = _queue.Queue(maxsize=1 if capacity == 0 else capacity)
         self._closed = threading.Event()
 
     def send(self, value):
         if self._closed.is_set():
             raise ChannelClosed("send on closed channel")
         self._q.put(value)
+        if self._unbuffered:
+            # rendezvous: wait until a receiver task_done()s this item (or
+            # the channel closes underneath a stranded sender)
+            while self._q.unfinished_tasks:
+                if self._closed.is_set():
+                    return
+                self._closed.wait(0.01)
 
     def recv(self, timeout=None):
         """-> (value, ok); ok=False when closed and drained."""
@@ -48,8 +61,12 @@ class Channel(object):
                 if self._closed.is_set():
                     return None, False
                 continue
+            self._q.task_done()
             if v is Channel._CLOSED:
-                self._q.put(Channel._CLOSED)  # wake other receivers
+                try:
+                    self._q.put_nowait(Channel._CLOSED)  # wake others
+                except _queue.Full:
+                    pass
                 return None, False
             return v, True
 
@@ -98,3 +115,89 @@ def channel_recv(channel, return_value=None):
 
 def channel_close(channel):
     channel.close()
+
+
+# ---------------------------------------------------------------------------
+# In-program CSP: the reference's fluid.concurrency surface — these append
+# channel/go OPS to the current program (reference:
+# python/paddle/fluid/concurrency.py:232, ops in ops/channel_ops.py here).
+# Programs using them run on the host interpreter path, like the
+# reference's CPU-only channel ops.
+
+def prog_make_channel(dtype="float32", capacity=0, name=None):
+    """Append a channel_create op; returns the CHANNEL variable."""
+    from .layers.layer_helper import LayerHelper
+    helper = LayerHelper("channel_create", name=name)
+    ch = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(type="channel_create", inputs={},
+                     outputs={"Out": [ch]},
+                     attrs={"capacity": int(capacity)})
+    return ch
+
+
+def prog_channel_send(channel, value):
+    """Append a channel_send op; returns the Status variable."""
+    from .layers.layer_helper import LayerHelper
+    helper = LayerHelper("channel_send")
+    status = helper.create_variable_for_type_inference(dtype="bool")
+    helper.append_op(type="channel_send",
+                     inputs={"Channel": [channel], "X": [value]},
+                     outputs={"Status": [status]})
+    return status
+
+
+def prog_channel_recv(channel, return_value):
+    """Append a channel_recv op. ``return_value`` is the template variable
+    delivered (zeroed) when the channel is closed and drained; returns
+    (out, status)."""
+    from .layers.layer_helper import LayerHelper
+    helper = LayerHelper("channel_recv")
+    out = helper.create_variable_for_type_inference(dtype=return_value.dtype)
+    out.shape = return_value.shape
+    status = helper.create_variable_for_type_inference(dtype="bool")
+    helper.append_op(type="channel_recv",
+                     inputs={"Channel": [channel],
+                             "ReturnValue": [return_value]},
+                     outputs={"Out": [out], "Status": [status]})
+    return out, status
+
+
+def prog_channel_close(channel):
+    from .layers.layer_helper import LayerHelper
+    LayerHelper("channel_close").append_op(
+        type="channel_close", inputs={"Channel": [channel]}, outputs={})
+
+
+class ProgGo(object):
+    """``with ProgGo():`` captures the appended ops into a sub-block run
+    asynchronously by a go op (reference: concurrency.py Go wrapping
+    go_op.cc:29). The spawned block communicates via channels."""
+
+    def __init__(self, name=None):
+        from .layers.layer_helper import LayerHelper
+        self.helper = LayerHelper("go", name=name)
+
+    def __enter__(self):
+        self._program = self.helper.main_program
+        self._parent = self._program.current_block()
+        self._sub = self._program.create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self._program.rollback()
+        if exc_type is not None:
+            return False
+        reads = []
+        for op in self._sub.ops:
+            reads.extend(op.input_arg_names)
+        produced = set()
+        for op in self._sub.ops:
+            produced.update(op.output_arg_names)
+        ext = [n for n in dict.fromkeys(reads)
+               if n not in produced and self._parent._find_var_recursive(n)]
+        self._parent.append_op(
+            type="go",
+            inputs={"X": ext},
+            outputs={},
+            attrs={"sub_block": self._sub.idx})
+        return False

@@ -51,6 +51,9 @@ class NormalInitializer(Initializer):
 
 def _fans(var):
     shape = var.shape
+    if len(shape) <= 1:
+        n = shape[0] if shape else 1
+        return n, n
     if len(shape) == 2:
         return shape[0], shape[1]
     recept = 1

@@ -16,6 +16,7 @@ from . import (  # noqa: F401
     attention_ops,
     detection_ops,
     misc_ops,
+    channel_ops,
     selected_rows,
     explicit_grads,  # last: attaches custom grad makers to the ops above
 )

@@ -1,0 +1,93 @@
+"""In-program CSP: channel + go ops (host path).
+
+reference: framework/channel.h:28 (Channel<T>::Send/Receive),
+operators/channel_create_op.cc / channel_send_op.cc / channel_recv_op.cc /
+channel_close_op.cc, operators/go_op.cc:29 (spawns a sub-block on the
+framework ThreadPool sharing the parent scope).
+
+Device programs are single XLA computations, so these are host ops: a
+program containing them runs on the per-op interpreter path, exactly like
+the reference executor runs channel ops on CPU regardless of device. The
+channel value itself is a ``concurrency.Channel`` held in the environment;
+``go`` runs its sub-block's lowerings on a daemon thread over a snapshot of
+the parent environment (communication happens through channels, the CSP
+contract — a go block's other writes stay local to it).
+"""
+from __future__ import annotations
+
+import threading
+
+from ..core.registry import register_op
+from ..core.executor import raw_data
+
+__all__ = []
+
+
+@register_op("channel_create", host=True, no_gradient=True)
+def channel_create(ctx):
+    from ..concurrency import Channel
+    ctx.set_output("Out", Channel(capacity=ctx.attr("capacity", 0)))
+
+
+@register_op("channel_send", host=True, no_gradient=True)
+def channel_send(ctx):
+    ch = ctx.input("Channel")
+    from ..concurrency import ChannelClosed
+    try:
+        ch.send(ctx.input("X"))
+        ok = True
+    except ChannelClosed:
+        ok = False
+    ctx.set_output("Status", ok)
+
+
+@register_op("channel_recv", host=True, no_gradient=True)
+def channel_recv(ctx):
+    ch = ctx.input("Channel")
+    v, ok = ch.recv()
+    if not ok:
+        # closed-and-drained: deliver the ReturnValue template (zeros), the
+        # reference's "receive on closed yields default" contract
+        v = ctx.input("ReturnValue")
+        if v is not None:
+            import jax.numpy as jnp
+            v = jnp.zeros_like(raw_data(v))
+    ctx.set_output("Out", v)
+    ctx.set_output("Status", ok)
+
+
+@register_op("channel_close", host=True, no_gradient=True)
+def channel_close(ctx):
+    ctx.input("Channel").close()
+
+
+@register_op("go", host=True, no_gradient=True)
+def go(ctx):
+    from ..core.executor import trace_ops, RngSource
+    import jax
+
+    sub = ctx.sub_block()
+    # snapshot: the goroutine sees parent values as of spawn; its own
+    # writes stay local (channels are the communication path)
+    env = dict(ctx.env)
+    env.pop("@SCOPE@", None)
+    rng = RngSource(jax.random.PRNGKey(ctx.attr("seed", 0)))
+
+    def run():
+        try:
+            trace_ops(sub, env, rng)
+        except Exception as e:  # noqa: BLE001 — goroutine boundary
+            # a dead goroutine must not strand blocked receivers: close
+            # every channel it could reach (closed recv delivers the
+            # default + ok=False) and surface the error
+            from ..concurrency import Channel
+            import warnings
+            for v in env.values():
+                if isinstance(v, Channel):
+                    v.close()
+            ctx.env.setdefault("@GO_ERRORS@", []).append(e)
+            warnings.warn("go block failed: %r" % (e,), RuntimeWarning)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    ctx.env.setdefault("@GO_THREADS@", []).append(t)

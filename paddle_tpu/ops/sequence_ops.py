@@ -383,6 +383,33 @@ def sequence_conv(ctx):
     ctx.set_output("Out", with_lod_of(x, out))
 
 
+@register_op("context_project")
+def context_project(ctx):
+    """The context window WITHOUT the filter matmul: row i becomes the
+    concat of its ctx_len neighbours (zero-padded at sequence edges) —
+    the reference's ContextProjection building block
+    (reference: operators/math/context_project.h,
+    gserver/layers ContextProjection in MixedLayer)."""
+    x = ctx.input("X")
+    data = raw_data(x)
+    offs = seq_offsets(x)
+    ml = static_max_len(x)
+    ctx_len = int(ctx.attr("contextLength"))
+    ctx_start = int(ctx.attr("contextStart", -((ctx_len - 1) // 2)))
+    padded, mask = lod_to_padded(data, offs, ml)  # [n, T, D]
+    cols = []
+    for j in range(ctx_len):
+        shift = ctx_start + j
+        rolled = jnp.roll(padded, -shift, axis=1)
+        t = jnp.arange(ml)
+        valid = (t + shift >= 0) & (t + shift < ml)
+        valid = valid[None, :] & jnp.roll(mask, -shift, axis=1)
+        cols.append(jnp.where(valid[..., None], rolled, 0))
+    ctxmat = jnp.concatenate(cols, axis=-1)
+    out = padded_to_lod(ctxmat, offs, data.shape[0])
+    ctx.set_output("Out", with_lod_of(x, out))
+
+
 @register_op("row_conv")
 def row_conv(ctx):
     """Lookahead row convolution (elementwise per feature).

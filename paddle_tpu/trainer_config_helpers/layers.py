@@ -24,9 +24,16 @@ __all__ = [
     "grumemory", "max_id_layer", "classification_cost", "cross_entropy",
     "cross_entropy_with_selfnorm", "regression_cost", "square_error_cost",
     "mixed_layer", "full_matrix_projection", "identity_projection",
-    "table_projection", "trans_full_matrix_projection", "outputs",
+    "table_projection", "trans_full_matrix_projection",
+    "context_projection", "dotmul_projection", "scaling_projection",
+    "dotmul_operator", "conv_projection", "conv_operator",
+    "recurrent_group", "memory", "beam_search", "StaticInput",
+    "GeneratedInput", "outputs",
     "get_output_layers",
 ]
+
+
+_group_stack = []  # active recurrent_group/beam_search step contexts
 
 
 class LayerOutput(object):
@@ -41,6 +48,15 @@ class LayerOutput(object):
         self.channels = channels
         self.height = height
         self.width = width
+        # inside a recurrent_group/beam_search step, named layers register
+        # for name-linked memory recurrence (reference: layers.py memory)
+        if _group_stack and name and not name.startswith("@"):
+            made = _group_stack[-1]["made"]
+            if name in made and made[name].var is not var:
+                raise ValueError(
+                    "two step layers share the name %r — memory linkage "
+                    "would be ambiguous" % name)
+            made[name] = self
 
     def __repr__(self):
         return "LayerOutput(%s, size=%s)" % (self.name, self.size)
@@ -403,6 +419,7 @@ class mixed_layer(object):
         if a:
             out = getattr(F, a)(out)
         size = self.size or self._projs[0].size
+        self.size = size
         self._out = LayerOutput(self.name or out.name, out, size=size)
 
     def __getattr__(self, item):
@@ -449,3 +466,307 @@ def square_error_cost(input, label, name=None, coeff=1.0,
 
 
 regression_cost = square_error_cost
+
+
+# ---------------------------------------------------------------------------
+# MixedLayer projection/operator tail
+# (reference: gserver/layers/{ContextProjection,ConvProjection,
+#  DotMulProjection,DotMulOperator,ScalingProjection}.cpp inside MixedLayer)
+
+def context_projection(input, context_len, context_start=None,
+                       padding_attr=False):
+    """Concat of each step's context window within its sequence
+    (reference: ContextProjection; trainable_padding unsupported — edge
+    steps are zero-padded, the padding_attr=False behavior)."""
+    if padding_attr not in (False, None):
+        raise NotImplementedError("trainable context padding")
+    start = (-((context_len - 1) // 2) if context_start is None
+             else context_start)
+
+    def build():
+        from ..layers.layer_helper import LayerHelper
+        helper = LayerHelper("context_project")
+        out = helper.create_variable_for_type_inference(
+            dtype=input.var.dtype)
+        out.lod_level = getattr(input.var, "lod_level", 1)
+        helper.append_op(type="context_project",
+                         inputs={"X": [input.var]},
+                         outputs={"Out": [out]},
+                         attrs={"contextLength": int(context_len),
+                                "contextStart": int(start)})
+        return out
+
+    return _Projection(build, (input.size or 0) * context_len)
+
+
+def dotmul_projection(input, param_attr=None):
+    """Per-dimension learned scale: out = x . w (reference:
+    DotMulProjection)."""
+    def build():
+        from ..layers.layer_helper import LayerHelper
+        from ..param_attr import ParamAttr
+        helper = LayerHelper("dotmul_projection")
+        w = helper.create_parameter(attr=_param(param_attr) or ParamAttr(),
+                                    shape=[input.size], dtype="float32")
+        return F.elementwise_mul(input.var, w)
+    return _Projection(build, input.size)
+
+
+def scaling_projection(input, param_attr=None):
+    """One learned scalar times the input (reference: ScalingProjection)."""
+    def build():
+        from ..layers.layer_helper import LayerHelper
+        from ..param_attr import ParamAttr
+        helper = LayerHelper("scaling_projection")
+        w = helper.create_parameter(attr=_param(param_attr) or ParamAttr(),
+                                    shape=[1], dtype="float32")
+        return F.elementwise_mul(input.var,
+                                 F.expand(w, expand_times=[input.size]))
+    return _Projection(build, input.size)
+
+
+def dotmul_operator(a, b, scale=1.0):
+    """Elementwise a*b*scale as a mixed_layer operand (reference:
+    DotMulOperator — operators take two inputs, no parameters)."""
+    def build():
+        out = F.elementwise_mul(a.var, b.var)
+        if scale != 1.0:
+            out = F.scale(out, scale=scale)
+        return out
+    return _Projection(build, a.size)
+
+
+def conv_projection(input, filter_size, num_filters, num_channels=None,
+                    stride=1, padding=0, param_attr=None):
+    """Image conv producing a flat vector operand (reference:
+    ConvProjection/ConvOperator in MixedLayer)."""
+    def build():
+        img = _as_image(input, num_channels)
+        out = F.conv2d(img.var_image, num_filters=num_filters,
+                       filter_size=filter_size, stride=stride,
+                       padding=padding, param_attr=_param(param_attr),
+                       bias_attr=False)
+        return F.reshape(out, shape=[0, -1])
+    # output spatial dims depend on input HxW; size resolved lazily (None)
+    return _Projection(build, None)
+
+
+def conv_operator(img, filter, filter_size, num_filters,
+                  num_channels=None, stride=1, padding=0,
+                  filter_size_y=None, stride_y=None, padding_y=None):
+    """Conv whose FILTER is another layer's output, not a parameter
+    (reference: ConvOperator in MixedLayer — two inputs, no weights)."""
+    def build():
+        from ..layers.layer_helper import LayerHelper
+        iv, c, h, w = _as_image(img, num_channels)
+        fy = filter_size_y or filter_size
+        filt = F.reshape(filter.var,
+                         shape=[num_filters, c, filter_size, fy])
+        helper = LayerHelper("conv_operator")
+        out = helper.create_variable_for_type_inference(dtype=iv.dtype)
+        helper.append_op(
+            type="conv2d",
+            inputs={"Input": [iv], "Filter": [filt]},
+            outputs={"Output": [out]},
+            attrs={"strides": [stride, stride_y or stride],
+                   "paddings": [padding,
+                                padding if padding_y is None else padding_y],
+                   "dilations": [1, 1], "groups": 1})
+        return F.reshape(out, shape=[0, -1])
+    return _Projection(build, None)
+
+
+# ---------------------------------------------------------------------------
+# Recurrent groups + generation-mode beam search
+# (reference: trainer_config_helpers/layers.py recurrent_group/memory +
+#  gserver/gradientmachines/RecurrentGradientMachine.h:32,70-110 — the
+#  generation mode drives the user's step callback per timestep)
+
+class StaticInput(object):
+    """Non-sequence input delivered unchanged to every step
+    (reference: layers.py StaticInput)."""
+
+    def __init__(self, input, is_seq=False, size=None):
+        self.input = input
+        self.is_seq = is_seq
+        self.size = size or input.size
+
+
+class GeneratedInput(object):
+    """Generation slot: at each step the embedding of the previous
+    prediction (reference: layers.py GeneratedInput)."""
+
+    def __init__(self, size, embedding_name, embedding_size):
+        self.size = size                    # vocabulary size
+        self.embedding_name = embedding_name
+        self.embedding_size = embedding_size
+
+
+def memory(name, size=None, boot_layer=None, is_seq=False):
+    """Previous-step value of the step layer called ``name``
+    (reference: layers.py memory — name-linked recurrence). Must be called
+    inside ``recurrent_group``/``beam_search``'s step function; the step
+    must produce a layer with that exact name."""
+    if not _group_stack:
+        raise RuntimeError("memory() outside a recurrent_group step")
+    ctx = _group_stack[-1]
+    pre = ctx["make_memory"](name, size, boot_layer)
+    out = LayerOutput("@pre_" + name, pre, size=size or
+                      (boot_layer.size if boot_layer else None))
+    ctx["memories"].append((name, out))
+    return out
+
+
+def recurrent_group(step, input, reverse=False, name=None):
+    """Run ``step`` over the sequence(s); memories recur by name
+    (reference: layers.py recurrent_group -> RecurrentGradientMachine).
+    Maps onto DynamicRNN: ragged batches shrink as sequences end."""
+    if reverse:
+        raise NotImplementedError(
+            "reverse=True: reverse the sequences at the reader (or use "
+            "lstmemory/grumemory reverse=True, which scan backward)")
+    inputs = list(input) if isinstance(input, (list, tuple)) else [input]
+    rnn = F.DynamicRNN()
+    ctx = {"memories": [], "made": {}, "rnn": rnn}
+
+    def make_memory(name_, size, boot_layer):
+        if boot_layer is not None:
+            v = rnn.memory(init=boot_layer.var)
+            sz = size or boot_layer.size
+        else:
+            v = rnn.memory(shape=[size], value=0.0)
+            sz = size
+        if getattr(v, "shape", None) is None and sz:
+            v.shape = (-1, sz)  # array read/shrink lose static shape
+        return v
+
+    ctx["make_memory"] = make_memory
+    _group_stack.append(ctx)
+    try:
+        with rnn.block():
+            args = []
+            for i in inputs:
+                if isinstance(i, StaticInput):
+                    v = rnn.static_input(i.input.var)
+                    args.append(LayerOutput(None, v, size=i.size))
+                else:
+                    v = rnn.step_input(i.var)
+                    args.append(LayerOutput(None, v, size=i.size))
+            out = step(*args)
+            outs = (list(out) if isinstance(out, (list, tuple))
+                    else [out])
+            for mem_name, pre in ctx["memories"]:
+                made = ctx["made"].get(mem_name)
+                if made is None:
+                    raise ValueError(
+                        "memory(%r) declared but the step produced no "
+                        "layer named %r" % (mem_name, mem_name))
+                rnn.update_memory(pre.var, made.var)
+            rnn.output(*[o.var for o in outs])
+    finally:
+        _group_stack.pop()
+    res = rnn()
+    if isinstance(res, (list, tuple)):
+        return [LayerOutput(name, r, size=o.size)
+                for r, o in zip(res, outs)]
+    return LayerOutput(name, res, size=outs[0].size)
+
+
+def beam_search(step, input, bos_id, eos_id, beam_size,
+                max_length=30, name=None):
+    """Generation mode: drive the user's ``step`` callback per decode step
+    under a While + beam_search program (reference:
+    RecurrentGradientMachine.h:70-110 generation w/ user callbacks,
+    trainer_config_helpers/layers.py beam_search).
+
+    ``input`` holds exactly one GeneratedInput (the predicted-word
+    embedding slot) plus optional StaticInput/LayerOutput context vectors.
+    ``step(current_word, *statics)`` returns the per-word probability
+    layer; memories recur by name as in recurrent_group. Feed vars
+    ``init_ids``/``init_scores`` (lod_level=2) seed the beams; returns
+    (translation_ids, translation_scores)."""
+    pd = F
+    inputs = list(input) if isinstance(input, (list, tuple)) else [input]
+    gens = [i for i in inputs if isinstance(i, GeneratedInput)]
+    if len(gens) != 1:
+        raise ValueError("beam_search needs exactly one GeneratedInput")
+    gen = gens[0]
+    statics = [i for i in inputs if not isinstance(i, GeneratedInput)]
+
+    program = ir.default_main_program()
+    outer = program.current_block()
+
+    array_len = pd.fill_constant(shape=[1], dtype="int64",
+                                 value=max_length)
+    counter = pd.zeros(shape=[1], dtype="int64", force_cpu=True)
+    init_ids = pd.data(name="init_ids", shape=[1], dtype="int64",
+                       lod_level=2)
+    init_scores = pd.data(name="init_scores", shape=[1], dtype="float32",
+                          lod_level=2)
+    ids_array = pd.create_array("int64")
+    scores_array = pd.create_array("float32")
+    pd.array_write(init_ids, array=ids_array, i=counter)
+    pd.array_write(init_scores, array=scores_array, i=counter)
+
+    state_arrays = {}
+
+    def make_memory(name_, size, boot_layer):
+        # state array must be seeded in the OUTER block (before the while
+        # op); the while body is being built when this runs, so hop out
+        arr = state_arrays.get(name_)
+        if arr is None:
+            saved = program._current_block_idx
+            program._current_block_idx = outer.idx
+            try:
+                arr = pd.create_array("float32")
+                boot = (boot_layer.var if boot_layer is not None else
+                        pd.fill_constant(shape=[1, size], dtype="float32",
+                                         value=0.0))
+                zero = pd.zeros(shape=[1], dtype="int64", force_cpu=True)
+                pd.array_write(boot, array=arr, i=zero)
+            finally:
+                program._current_block_idx = saved
+            state_arrays[name_] = arr
+        pre_raw = pd.array_read(array=arr, i=counter)
+        # expand recurrent state to the current beam width
+        return pd.sequence_expand(pre_raw, pd.array_read(
+            array=scores_array, i=counter))
+
+    cond = pd.less_than(x=counter, y=array_len)
+    w = pd.While(cond=cond)
+    ctx = {"memories": [], "made": {}, "make_memory": make_memory}
+    _group_stack.append(ctx)
+    try:
+        with w.block():
+            pre_ids = pd.array_read(array=ids_array, i=counter)
+            pre_scores = pd.array_read(array=scores_array, i=counter)
+            from ..param_attr import ParamAttr
+            word_emb = pd.embedding(
+                input=pre_ids, size=[gen.size, gen.embedding_size],
+                param_attr=ParamAttr(name=gen.embedding_name))
+            args = [LayerOutput(None, word_emb, size=gen.embedding_size)]
+            for s in statics:
+                lo = s.input if isinstance(s, StaticInput) else s
+                args.append(lo)
+            out = step(*args)
+            prob = out[0] if isinstance(out, (list, tuple)) else out
+            topk_scores, topk_indices = pd.topk(prob.var, k=beam_size)
+            sel_ids, sel_scores = pd.beam_search(
+                pre_ids, topk_indices, topk_scores, beam_size,
+                end_id=eos_id, level=0)
+            pd.increment(x=counter, value=1, in_place=True)
+            for mem_name, _pre in ctx["memories"]:
+                made = ctx["made"].get(mem_name)
+                if made is None:
+                    raise ValueError("step produced no layer named %r"
+                                     % mem_name)
+                pd.array_write(made.var, array=state_arrays[mem_name],
+                               i=counter)
+            pd.array_write(sel_ids, array=ids_array, i=counter)
+            pd.array_write(sel_scores, array=scores_array, i=counter)
+            pd.less_than(x=counter, y=array_len, cond=cond)
+    finally:
+        _group_stack.pop()
+    ids, scores = pd.beam_search_decode(ids=ids_array, scores=scores_array)
+    return (LayerOutput(name, ids, size=1),
+            LayerOutput(None, scores, size=1))

@@ -1,0 +1,126 @@
+"""v2 master client: fault-tolerant training-data dispatch.
+
+reference: python/paddle/v2/master/client.py:29 — a ctypes wrapper over the
+Go master (go/master/client.go) where trainers call ``set_dataset(paths)``
+once and then stream ``next_record()``; the master leases RecordIO chunks
+as tasks, re-queues them when a trainer dies, and signals pass end.
+
+Here the same contract rides the native C++ task master
+(native/paddle_tpu_native.cc): tasks are recordio file paths, leased over
+the TCP RPC front (``TaskMaster.serve`` / ``MasterClient``) so N worker
+processes share one pass of the dataset with crash re-queue semantics.
+"""
+from __future__ import annotations
+
+import time
+
+from .. import native
+
+__all__ = ["client"]
+
+
+class client(object):
+    """``client(addr)`` connects to a served TaskMaster
+    (``"host:port"``); ``client()`` runs an in-process master — the
+    single-trainer mode, same API.
+
+    The reference constructor took etcd endpoints + buffer size
+    (v2/master/client.py:29); discovery here is the address handed out by
+    the launcher (paddle_tpu.launch), which replaces etcd.
+    """
+
+    def __init__(self, addr=None, timeout_sec=60.0, failure_max=3):
+        if addr is None:
+            self._master = native.TaskMaster(failure_max=failure_max,
+                                             timeout_sec=timeout_sec)
+            self._rpc = None
+        else:
+            host, _, port = addr.partition(":")
+            self._master = None
+            self._rpc = native.MasterClient(host, int(port))
+        self._task = None        # (task_id, payload)
+        self._reader = None
+        self._paths_added = False
+
+    def _api(self):
+        return self._rpc if self._rpc is not None else self._master
+
+    # -- dataset ------------------------------------------------------------
+    def set_dataset(self, paths, trainer_id=0):
+        """Register recordio files as the pass's task list. Exactly ONE
+        trainer registers: only ``trainer_id == 0`` adds tasks (the
+        reference elects the task-adding trainer via an etcd lock,
+        go/master/client.go — a counts()-based check would race when two
+        workers start simultaneously). Re-registration within a pass (e.g.
+        after ``new_pass`` re-queued the finished tasks) is a no-op."""
+        if trainer_id != 0:
+            return
+        api = self._api()
+        counts = api.counts()
+        if counts["todo"] or counts["pending"] or counts["done"]:
+            return
+        for p in paths:
+            api.add_task(str(p).encode("utf-8"))
+        self._paths_added = True
+
+    def new_pass(self, paths=None):
+        self._api().new_pass()
+        if paths is not None:
+            self.set_dataset(paths)
+
+    # -- record stream -------------------------------------------------------
+    def next_record(self):
+        """Next record's bytes, or ``None`` at pass end (the reference
+        returns (b'', -1) there). Blocks briefly while other workers hold
+        the remaining leases."""
+        while True:
+            if self._reader is not None:
+                try:
+                    return next(self._reader)
+                except StopIteration:
+                    self._reader = None
+                    tid, _ = self._task
+                    self._task = None
+                    self._api().task_finished(tid)
+                except Exception:
+                    # corrupt mid-stream: fail the task NOW (failure_max
+                    # discards it after N tries) rather than leaving the
+                    # lease to time out
+                    self._reader = None
+                    tid, _ = self._task
+                    self._task = None
+                    self._api().task_failed(tid)
+            tid, payload = self._api().get_task()
+            if tid is None:
+                return None
+            if tid == "wait":
+                time.sleep(0.05)
+                continue
+            self._task = (tid, payload)
+            try:
+                self._reader = iter(
+                    native.Reader(payload.decode("utf-8")))
+            except Exception:
+                # unreadable file: report failure (failure_max discards the
+                # poison task; reference go/master/service.go:313)
+                self._reader = None
+                self._task = None
+                self._api().task_failed(tid)
+
+    def records(self):
+        """Generator over the remainder of the pass — plugs straight into
+        the reader-decorator stack (paddle.batch(client.records, ...))."""
+        while True:
+            r = self.next_record()
+            if r is None:
+                return
+            yield r
+
+    def paddle_start_get_records(self, pass_id=0):  # reference API name
+        return self.records()
+
+    def close(self):
+        if self._rpc is not None:
+            self._rpc.close()
+        elif self._master is not None:
+            self._master.close()

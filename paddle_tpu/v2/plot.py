@@ -1,0 +1,72 @@
+"""Training-curve plotting (reference: python/paddle/v2/plot/plot.py —
+``Ploter`` collecting (step, value) series per title, drawn with matplotlib
+in notebooks or silently skipped in terminals via DISABLE_PLOT)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["Ploter"]
+
+
+class PlotData(object):
+    def __init__(self):
+        self.step = []
+        self.value = []
+
+    def append(self, step, value):
+        self.step.append(step)
+        self.value.append(value)
+
+    def reset(self):
+        self.step = []
+        self.value = []
+
+
+class Ploter(object):
+    """``Ploter("train cost", "test cost")``; ``append(title, step, v)``;
+    ``plot(path=None)`` draws (or saves) when matplotlib is importable,
+    otherwise just keeps the series queryable (``data(title)``)."""
+
+    def __init__(self, *titles):
+        self.__args__ = titles
+        self.__plot_data__ = {t: PlotData() for t in titles}
+        self.__disable_plot__ = os.environ.get("DISABLE_PLOT", "")
+
+    def __plot_is_disabled__(self):
+        return self.__disable_plot__.lower() == "true"
+
+    def append(self, title, step, value):
+        assert title in self.__plot_data__, (
+            "title %r not in %r" % (title, self.__args__))
+        self.__plot_data__[title].append(step, value)
+
+    def data(self, title):
+        d = self.__plot_data__[title]
+        return list(zip(d.step, d.value))
+
+    def plot(self, path=None):
+        if self.__plot_is_disabled__():
+            return
+        try:
+            import matplotlib
+            if path is not None or not os.environ.get("DISPLAY"):
+                matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+        except Exception:
+            return  # headless image; series remain available via data()
+        titles = []
+        for title in self.__args__:
+            d = self.__plot_data__[title]
+            if len(d.step) > 0:
+                plt.plot(d.step, d.value)
+                titles.append(title)
+        plt.legend(titles, loc="upper left")
+        if path is None:
+            plt.show()
+        else:
+            plt.savefig(path)
+        plt.close()
+
+    def reset(self):
+        for d in self.__plot_data__.values():
+            d.reset()

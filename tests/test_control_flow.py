@@ -317,3 +317,104 @@ def test_concrete_counter_not_persisted():
     v = fluid.global_scope().find_var("step_counter")
     assert not isinstance(v, ConcreteScalar), type(v)
     assert int(np.asarray(v).reshape(-1)[0]) == 3
+
+
+# -- in-program CSP channels (reference: operators/channel_*.cc, go_op.cc) --
+
+def test_csp_channel_producer_consumer_program():
+    """A go block produces into a channel; the main block consumes —
+    the reference's concurrent_programming design doc example shape."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers, concurrency
+    main, startup = pt.Program(), pt.Program()
+    pt.switch_main_program(main)
+    pt.switch_startup_program(startup)
+
+    x = layers.data("x", shape=[4], dtype="float32")
+    ch = concurrency.prog_make_channel(dtype="float32", capacity=2)
+    with concurrency.ProgGo():
+        doubled = layers.scale(x, scale=2.0)
+        concurrency.prog_channel_send(ch, doubled)
+    out, status = concurrency.prog_channel_recv(ch, x)
+    got = layers.scale(out, scale=1.0)
+
+    with pt.scope_guard(pt.Scope()):
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        xs = np.arange(4, dtype="float32").reshape(1, 4)
+        r, s = exe.run(main, feed={"x": xs}, fetch_list=[got, status])
+        np.testing.assert_allclose(r, xs * 2.0, rtol=1e-6)
+        assert bool(np.asarray(s))
+    assert exe.stats["eager_runs"] > 0  # channel programs take the host path
+
+
+def test_csp_channel_close_delivers_default():
+    import paddle_tpu as pt
+    from paddle_tpu import layers, concurrency
+    main, startup = pt.Program(), pt.Program()
+    pt.switch_main_program(main)
+    pt.switch_startup_program(startup)
+
+    x = layers.data("x", shape=[3], dtype="float32")
+    ch = concurrency.prog_make_channel(dtype="float32")
+    concurrency.prog_channel_close(ch)
+    out, status = concurrency.prog_channel_recv(ch, x)
+    outv = layers.scale(out, scale=1.0)
+    with pt.scope_guard(pt.Scope()):
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        xs = np.ones((2, 3), dtype="float32")
+        r, s = exe.run(main, feed={"x": xs}, fetch_list=[outv, status])
+        assert not bool(np.asarray(s))
+        np.testing.assert_allclose(r, np.zeros_like(xs))
+
+
+def test_unbuffered_channel_rendezvous():
+    """capacity=0 send blocks until a receiver takes the value
+    (reference: framework/channel.h unbuffered semantics)."""
+    import threading, time
+    from paddle_tpu.concurrency import Channel
+    ch = Channel(capacity=0)
+    t_done = []
+
+    def producer():
+        t0 = time.perf_counter()
+        ch.send(1)
+        t_done.append(time.perf_counter() - t0)
+
+    t = threading.Thread(target=producer)
+    t.start()
+    time.sleep(0.15)
+    assert not t_done, "send returned before any receiver arrived"
+    v, ok = ch.recv()
+    t.join(2)
+    assert (v, ok) == (1, True)
+    assert t_done and t_done[0] >= 0.14
+
+
+def test_go_block_failure_closes_channels():
+    """A crashing goroutine closes its channels so receivers get the
+    closed-channel default instead of deadlocking."""
+    import warnings
+    import paddle_tpu as pt
+    from paddle_tpu import layers, concurrency
+    main, startup = pt.Program(), pt.Program()
+    pt.switch_main_program(main)
+    pt.switch_startup_program(startup)
+    x = layers.data("x", shape=[2], dtype="float32")
+    ch = concurrency.prog_make_channel(dtype="float32")
+    with concurrency.ProgGo():
+        # reads a var that won't exist in the goroutine env -> raises
+        bad = layers.scale(layers.data("nope", shape=[2],
+                                       dtype="float32"), scale=1.0)
+        concurrency.prog_channel_send(ch, bad)
+    out, status = concurrency.prog_channel_recv(ch, x)
+    o = layers.scale(out, scale=1.0)
+    with pt.scope_guard(pt.Scope()):
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            r, s = exe.run(main, feed={"x": np.ones((1, 2), "float32")},
+                           fetch_list=[o, status])
+        assert not bool(np.asarray(s))  # closed, not hung

@@ -10,6 +10,7 @@ import numpy as np
 import pytest
 
 import paddle_tpu as fluid
+from paddle_tpu import native
 
 
 # ---------------------------------------------------------------------------
@@ -331,3 +332,216 @@ def test_profiler_after_warm_cache(tmp_path):
         exe.run(feed=feed, fetch_list=[loss])
     art = json.load(open(path))
     assert art["programs"], "profiled run must capture program analysis"
+
+
+# -- v2 master client (reference: python/paddle/v2/master/client.py) ---------
+
+@pytest.mark.skipif(not native.available(), reason="native runtime not built")
+def test_v2_master_client_streams_pass(tmp_path):
+    from paddle_tpu import v2
+    paths = []
+    for i in range(3):
+        p = str(tmp_path / ("part-%d.recordio" % i))
+        with native.Writer(p) as w:
+            for j in range(5):
+                w.write(("rec-%d-%d" % (i, j)).encode())
+        paths.append(p)
+    c = v2.master.client(timeout_sec=5.0)
+    c.set_dataset(paths)
+    got = sorted(c.records())
+    assert len(got) == 15
+    assert got[0] == b"rec-0-0"
+    assert c.next_record() is None  # pass finished
+    # second pass re-registers
+    c.new_pass(paths)
+    assert len(list(c.records())) == 15
+    c.close()
+
+
+@pytest.mark.skipif(not native.available(), reason="native runtime not built")
+def test_v2_master_client_remote_two_workers(tmp_path):
+    from paddle_tpu import v2
+    m = native.TaskMaster(timeout_sec=30.0)
+    port = m.serve(0)
+    paths = []
+    for i in range(4):
+        p = str(tmp_path / ("r%d.recordio" % i))
+        with native.Writer(p) as w:
+            w.write(("only-%d" % i).encode())
+        paths.append(p)
+    import threading
+    c1 = v2.master.client("127.0.0.1:%d" % port)
+    c2 = v2.master.client("127.0.0.1:%d" % port)
+    c1.set_dataset(paths)
+    c2.set_dataset(paths)  # second registration is a no-op
+    got = {0: [], 1: []}
+
+    def worker(i, c):
+        # a worker with no leasable task blocks until pass end, so the two
+        # workers must drain concurrently (the real deployment shape)
+        got[i] = list(c.records())
+
+    ts = [threading.Thread(target=worker, args=(0, c1)),
+          threading.Thread(target=worker, args=(1, c2))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert sorted(got[0] + got[1]) == [b"only-0", b"only-1", b"only-2",
+                                       b"only-3"]
+    assert got[0] and got[1]  # both workers leased work
+    c1.close(); c2.close(); m.close()
+
+
+def test_v2_ploter_collects_series():
+    from paddle_tpu import v2
+    pl = v2.plot.Ploter("train", "test")
+    pl.append("train", 0, 1.0)
+    pl.append("train", 1, 0.5)
+    pl.append("test", 0, 1.2)
+    assert pl.data("train") == [(0, 1.0), (1, 0.5)]
+    pl.plot(path="/tmp/_ploter_test.png")  # headless-safe
+    pl.reset()
+    assert pl.data("train") == []
+
+
+# -- MixedLayer projection tail + recurrent groups + generation -------------
+
+def _fresh():
+    main, startup = fluid.Program(), fluid.Program()
+    fluid.switch_main_program(main)
+    fluid.switch_startup_program(startup)
+    return main, startup
+
+
+def test_mixed_layer_projection_tail():
+    from paddle_tpu import trainer_config_helpers as tch
+    main, startup = _fresh()
+    x = tch.data_layer("x", size=8)
+    y = tch.data_layer("y", size=8)
+    with tch.mixed_layer(size=8) as m:
+        m += tch.dotmul_projection(x)
+        m += tch.scaling_projection(y)
+        m += tch.dotmul_operator(x, y, scale=0.5)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        r, = exe.run(main, feed={
+            "x": np.ones((2, 8), dtype="float32"),
+            "y": np.full((2, 8), 2.0, dtype="float32")},
+            fetch_list=[m.var])
+        assert r.shape == (2, 8)
+        assert np.isfinite(r).all()
+
+
+def test_context_projection_window():
+    from paddle_tpu import trainer_config_helpers as tch
+    from paddle_tpu.core.lod import LoDTensor
+    main, startup = _fresh()
+    seq = tch.data_layer("seq", size=2, is_seq=True)
+    with tch.mixed_layer() as m:
+        m += tch.context_projection(seq, context_len=3)
+    assert m.size == 6
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        data = np.arange(8, dtype="float32").reshape(4, 2)
+        t = LoDTensor(data, [[0, 2, 4]])
+        r, = exe.run(main, feed={"seq": t}, fetch_list=[m.var])
+        r = np.asarray(r)
+        assert r.shape == (4, 6)
+        # row 0 of seq 0: left context zero-padded, then rows 0 and 1
+        np.testing.assert_allclose(r[0], [0, 0, 0, 1, 2, 3])
+        # row 1 of seq 0: rows 0, 1, then right edge zero-padded
+        np.testing.assert_allclose(r[1], [0, 1, 2, 3, 0, 0])
+        # sequence boundary: row 2 starts sequence 1 (no bleed from row 1)
+        np.testing.assert_allclose(r[2], [0, 0, 4, 5, 6, 7])
+
+
+def test_recurrent_group_memory_by_name():
+    from paddle_tpu import trainer_config_helpers as tch
+    from paddle_tpu.core.lod import LoDTensor
+    main, startup = _fresh()
+    seq = tch.data_layer("seq", size=4, is_seq=True)
+
+    def step(cur):
+        h_pre = tch.memory("h", size=4)
+        h = tch.fc_layer([cur, h_pre], size=4, act="tanh", name="h")
+        return h
+
+    out = tch.recurrent_group(step, seq)
+    last = tch.LayerOutput("last", fluid.layers.sequence_last_step(out.var),
+                           size=4)
+    cost = tch.square_error_cost(last, tch.data_layer("tgt", size=4))
+    fluid.SGD(learning_rate=0.1).minimize(cost.var)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        data = rng.randn(5, 4).astype("float32")
+        t = LoDTensor(data, [[0, 2, 5]])
+        feed = {"seq": t, "tgt": rng.randn(2, 4).astype("float32")}
+        losses = [float(np.asarray(exe.run(main, feed=feed,
+                                           fetch_list=[cost.var])[0]))
+                  for _ in range(8)]
+        assert losses[-1] < losses[0]
+
+
+def test_beam_search_generation_callback():
+    """Generation mode: user step callback + named memory drive a beam
+    decode (reference: RecurrentGradientMachine.h:70-110)."""
+    from paddle_tpu import trainer_config_helpers as tch
+    from paddle_tpu.core.lod import LoDTensor
+    main, startup = _fresh()
+    vocab, emb_dim, hid = 20, 8, 8
+    ctx_v = tch.data_layer("ctx", size=hid)
+
+    def step(cur_word, ctx):
+        h_pre = tch.memory("h", size=hid, boot_layer=ctx)
+        h = tch.fc_layer([cur_word, h_pre], size=hid, act="tanh", name="h")
+        prob = tch.fc_layer(h, size=vocab, act="softmax")
+        return prob
+
+    ids, scores = tch.beam_search(
+        step, input=[tch.GeneratedInput(size=vocab, embedding_name="gemb",
+                                        embedding_size=emb_dim),
+                     ctx_v],
+        bos_id=0, eos_id=1, beam_size=2, max_length=4)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        init_ids = LoDTensor(np.zeros((1, 1), dtype="int64"),
+                             [[0, 1], [0, 1]])
+        init_scores = LoDTensor(np.ones((1, 1), dtype="float32"),
+                                [[0, 1], [0, 1]])
+        out_ids, out_scores = exe.run(
+            main, feed={"ctx": np.random.RandomState(0).randn(
+                            1, hid).astype("float32"),
+                        "init_ids": init_ids,
+                        "init_scores": init_scores},
+            fetch_list=[ids.var, scores.var], return_numpy=False)
+        seqs = np.asarray(out_ids.numpy()).reshape(-1)
+        assert len(seqs) > 0  # decoded something
+        assert np.asarray(out_scores.numpy()).shape[0] == seqs.shape[0]
+
+
+def test_conv_operator_filter_from_layer():
+    """conv_operator: the filter is another layer's output, no parameters
+    (reference: ConvOperator in MixedLayer)."""
+    from paddle_tpu import trainer_config_helpers as tch
+    main, startup = _fresh()
+    img = tch.data_layer("img", size=2 * 4 * 4, height=4, width=4)
+    filt = tch.data_layer("filt", size=3 * 2 * 3 * 3)  # O=3,C=2,3x3
+    with tch.mixed_layer() as m:
+        m += tch.conv_operator(img, filt, filter_size=3, num_filters=3)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        n_params = sum(1 for v in main.list_vars()
+                       if isinstance(v, fluid.Parameter))
+        assert n_params == 0  # operator has no weights
+        r, = exe.run(main, feed={
+            "img": np.ones((2, 32), dtype="float32"),
+            "filt": np.ones((2, 54), dtype="float32")[:1]},
+            fetch_list=[m.var])
+        assert np.asarray(r).shape == (2, 3 * 2 * 2)  # 4x4 conv3 -> 2x2
