@@ -16,7 +16,7 @@ import jax.numpy as jnp
 
 from .core import ir
 
-__all__ = ["enable", "disable", "amp_guard", "cast_inputs"]
+__all__ = ["enable", "disable", "amp_guard", "cast_inputs", "force"]
 
 
 def enable(program=None):
@@ -54,19 +54,32 @@ def _on_tpu():
 
 
 _ON_TPU = None
+_FORCE = None  # tri-state: None = auto (device probe), True/False = pinned
+
+
+def force(mode):
+    """Pin the cast decision: ``force(True)`` applies bf16 casts even on
+    the CPU backend (numerics tests), ``force(False)`` disables them,
+    ``force(None)`` restores the device probe."""
+    global _FORCE
+    _FORCE = mode
 
 
 def cast_inputs(ctx, *arrays):
     """bf16-cast float operands when the op's program runs under AMP.
-    No-op off TPU: AMP targets the MXU; CPU XLA lacks the mixed
-    bf16->f32 dot emitter."""
+    No-op off TPU (unless ``force(True)``): AMP targets the MXU; CPU XLA
+    lacks the mixed bf16->f32 dot emitter."""
     global _ON_TPU
     if not getattr(ctx.block.program, "_amp", False):
         return arrays
-    if _ON_TPU is None:
-        _ON_TPU = _on_tpu()
-    if not _ON_TPU:
-        return arrays
+    if _FORCE is not None:
+        if not _FORCE:
+            return arrays
+    else:
+        if _ON_TPU is None:
+            _ON_TPU = _on_tpu()
+        if not _ON_TPU:
+            return arrays
     return tuple(
         a.astype(jnp.bfloat16)
         if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)

@@ -245,3 +245,81 @@ def test_executor_state_signature_memoized():
     scope = pt.global_scope()
     assert scope in exe._state_memo
     assert len(exe._state_memo[scope]) == 2  # startup + main
+
+
+# -- hybrid execution: host ops between jitted device segments --------------
+
+def test_hybrid_path_for_save_program(tmp_path):
+    """A training program with a mid-block host op (per-step save, the
+    reference per-pass checkpoint shape) no longer drops the whole block
+    to the interpreter: device segments jit, only save interprets."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    main, startup = pt.Program(), pt.Program()
+    pt.switch_main_program(main)
+    pt.switch_startup_program(startup)
+    x = layers.data("x", shape=[8], dtype="float32")
+    label = layers.data("label", shape=[1], dtype="int64")
+    h = layers.fc(x, size=16, act="relu",
+                  param_attr=pt.ParamAttr(name="hyb_w"))
+    pred = layers.fc(h, size=4, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, label))
+    pt.SGD(learning_rate=0.1).minimize(loss)
+    ck = str(tmp_path / "hyb_w.ckpt")
+    main.global_block().append_op(
+        type="save", inputs={"X": ["hyb_w"]}, outputs={},
+        attrs={"file_path": ck})
+    with pt.scope_guard(pt.Scope()):
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.rand(4, 8).astype("float32"),
+                "label": rng.randint(0, 4, (4, 1)).astype("int64")}
+        losses = [float(np.asarray(exe.run(main, feed=feed,
+                                           fetch_list=[loss])[0]))
+                  for _ in range(6)]
+    import os
+    assert os.path.exists(ck)
+    assert exe.stats["hybrid_runs"] >= 6
+    assert exe.stats["eager_runs"] == 0
+    assert losses[-1] < losses[0]
+
+
+def test_hybrid_matches_eager_numerics():
+    """Hybrid and pure-eager execution produce identical losses for the
+    same host-op-bearing program."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    def build():
+        main, startup = pt.Program(), pt.Program()
+        pt.switch_main_program(main)
+        pt.switch_startup_program(startup)
+        x = layers.data("x", shape=[6], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        h = layers.fc(x, size=12, act="tanh",
+                      param_attr=pt.ParamAttr(name="w_hyb"))
+        main.global_block().append_op(
+            type="save", inputs={"X": ["w_hyb"]}, outputs={},
+            attrs={"file_path": str(__import__("tempfile").mkdtemp()) + "/_hyb_num.ckpt"})
+        pred = layers.fc(h, size=3, act="softmax",
+                         param_attr=pt.ParamAttr(name="w_hyb2"))
+        loss = layers.mean(layers.cross_entropy(pred, label))
+        pt.SGD(learning_rate=0.05).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(1)
+    feed = {"x": rng.rand(4, 6).astype("float32"),
+            "label": rng.randint(0, 3, (4, 1)).astype("int64")}
+    results = {}
+    for mode in ("hybrid", "eager"):
+        main, startup, loss = build()
+        with pt.scope_guard(pt.Scope()):
+            exe = pt.Executor(pt.CPUPlace())
+            exe.run(startup)
+            ls = [float(np.asarray(exe.run(
+                main, feed=feed, fetch_list=[loss],
+                use_jit=(mode == "hybrid"))[0])) for _ in range(5)]
+        results[mode] = ls
+    np.testing.assert_allclose(results["hybrid"], results["eager"],
+                               rtol=1e-5)
