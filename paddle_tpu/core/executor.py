@@ -567,17 +567,26 @@ class Executor(object):
             env[n] = scope.find_var(n)
         env["@SCOPE@"] = scope
 
-        segments = self._partition_segments(block)
-        # names read downstream of each segment (for output pruning)
-        persist = self._persistable_names(program)
-        keep = set(fetch_names) | persist | set(state_names)
-        later_reads = []
-        acc = set(keep)
-        for kind, ops in reversed(segments):
-            later_reads.append(set(acc))
-            for op in ops:
-                acc.update(op.input_arg_names)
-        later_reads.reverse()
+        # static per-program analysis, memoized on (uid, version, fetches):
+        # rebuilding the partition + reverse-liveness chain every step would
+        # be O(#ops) Python work per run (cf. the _state_memo rationale)
+        akey = (program._uid, program._version, "hyb-analysis",
+                tuple(fetch_names), tuple(state_names))
+        cached = self._cache.get(akey)
+        if cached is None:
+            segments = self._partition_segments(block)
+            persist = self._persistable_names(program)
+            keep = set(fetch_names) | persist | set(state_names)
+            later_reads = []
+            acc = set(keep)
+            for kind, ops in reversed(segments):
+                later_reads.append(set(acc))
+                for op in ops:
+                    acc.update(op.input_arg_names)
+            later_reads.reverse()
+            self._cache[akey] = (segments, later_reads)
+        else:
+            segments, later_reads = cached
 
         rng_key = self._rng_key(program, scope)
         for idx, (kind, ops) in enumerate(segments):
@@ -670,6 +679,13 @@ class Executor(object):
         return segs
 
     def _compile_segment(self, block, ops, out_names, static_in):
+        # ConcreteScalar outputs keep their trace-time python value across
+        # the jit boundary: the concrete chain is a pure function of the
+        # static (cache-keyed) inputs, so the value recorded at trace time
+        # holds for every call of this compiled segment — downstream
+        # segments with counter-indexed array ops stay hybrid
+        static_out = {}
+
         def seg_fn(inputs, rng_key):
             env = dict(static_in)
             env.update(inputs)
@@ -678,10 +694,22 @@ class Executor(object):
             out = {}
             for n in out_names:
                 v = env[n]
-                out[n] = raw_data(v) if isinstance(v, ConcreteScalar) else v
+                if isinstance(v, ConcreteScalar):
+                    static_out[n] = v.value
+                    out[n] = v.data
+                else:
+                    out[n] = v
             return out, rng.key
 
-        return jax.jit(seg_fn)
+        jitted = jax.jit(seg_fn)
+
+        def wrapper(inputs, rng_key):
+            outs, key = jitted(inputs, rng_key)
+            for n, val in static_out.items():
+                outs[n] = ConcreteScalar(val, outs[n])
+            return outs, key
+
+        return wrapper
 
     # -- eager path (host ops, debugging) -------------------------------------
     def _run_eager(self, program, feed, fetch_names, scope):

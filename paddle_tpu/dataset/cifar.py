@@ -1,6 +1,14 @@
 """CIFAR-10/100. reference: python/paddle/v2/dataset/cifar.py — rows of
-(image[3072] float32 in [0, 1], label int)."""
+(image[3072] float32 in [0, 1], label int).
+
+Real data: the reference caches ``cifar-10-python.tar.gz`` /
+``cifar-100-python.tar.gz`` (pickled batches of {data: [N,3072] u8,
+labels/fine_labels: [N]}); when present under ``<data_home>/cifar/`` they
+are parsed, else the synthetic corpus is generated."""
 from __future__ import annotations
+
+import pickle
+import tarfile
 
 import numpy as np
 
@@ -12,7 +20,33 @@ TRAIN_SIZE = 1024
 TEST_SIZE = 256
 
 
+def _real_reader(tar_path, classes, split):
+    sub = "data_batch" if split == "train" else "test_batch"
+    if classes == 100:
+        sub = "train" if split == "train" else "test"
+    key = b"labels" if classes == 10 else b"fine_labels"
+
+    def reader():
+        with tarfile.open(tar_path, mode="r") as tar:
+            members = sorted(m.name for m in tar.getmembers()
+                             if sub in m.name and m.name.find(".") == -1)
+            for name in members:
+                batch = pickle.load(tar.extractfile(name),
+                                    encoding="bytes")
+                for im, lb in zip(batch[b"data"], batch[key]):
+                    # reference normalizes to [0, 1] (v2/dataset/cifar.py)
+                    yield im.astype(np.float32) / 255.0, int(lb)
+
+    return reader
+
+
 def _reader(n, classes, split):
+    tar_name = ("cifar-10-python.tar.gz" if classes == 10
+                else "cifar-100-python.tar.gz")
+    tar_path = common.cached_file("cifar", tar_name)
+    if tar_path:
+        return _real_reader(tar_path, classes, split)
+
     def reader():
         rng = common.seeded_rng("cifar%d-%s" % (classes, split))
         per = 3072 // classes if classes <= 3072 else 1

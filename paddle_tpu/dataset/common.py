@@ -33,7 +33,16 @@ def data_home():
 DATA_HOME = data_home()
 
 __all__ = ["DATA_HOME", "md5file", "download", "seeded_rng",
-           "synthetic_notice"]
+           "synthetic_notice", "cached_file"]
+
+
+def cached_file(module_name, filename):
+    """Path of a real dataset file under the cache dir, or None. This is
+    the switch between the real-format parsers and the synthetic
+    generators: files are placed out of band (no egress here), named as
+    the reference's download() would have cached them."""
+    p = os.path.join(data_home(), module_name, filename)
+    return p if os.path.exists(p) else None
 
 
 def md5file(fname):

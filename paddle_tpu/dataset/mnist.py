@@ -1,6 +1,14 @@
 """MNIST. reference: python/paddle/v2/dataset/mnist.py — rows of
-(image[784] float32 in [-1, 1], label int in [0, 9])."""
+(image[784] float32 in [-1, 1], label int in [0, 9]).
+
+When the real idx files (train-images-idx3-ubyte.gz etc., the names the
+reference's download() caches) are present under ``<data_home>/mnist/``,
+they are parsed; otherwise a deterministic synthetic corpus with the same
+schema is generated."""
 from __future__ import annotations
+
+import gzip
+import struct
 
 import numpy as np
 
@@ -11,8 +19,55 @@ __all__ = ["train", "test"]
 TRAIN_SIZE = 2048   # synthetic corpus sizes (real: 60000/10000)
 TEST_SIZE = 512
 
+_FILES = {"train": ("train-images-idx3-ubyte.gz",
+                    "train-labels-idx1-ubyte.gz"),
+          "test": ("t10k-images-idx3-ubyte.gz",
+                   "t10k-labels-idx1-ubyte.gz")}
+
+
+def _open_maybe_gz(path):
+    if path.endswith(".gz"):
+        return gzip.open(path, "rb")
+    return open(path, "rb")
+
+
+def _parse_idx_images(path):
+    with _open_maybe_gz(path) as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 0x803:
+            raise ValueError("%s: bad idx3 magic 0x%x" % (path, magic))
+        data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+    return data.reshape(n, rows * cols)
+
+
+def _parse_idx_labels(path):
+    with _open_maybe_gz(path) as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != 0x801:
+            raise ValueError("%s: bad idx1 magic 0x%x" % (path, magic))
+        return np.frombuffer(f.read(n), dtype=np.uint8)
+
+
+def _real_reader(img_path, lbl_path):
+    def reader():
+        imgs = _parse_idx_images(img_path)
+        lbls = _parse_idx_labels(lbl_path)
+        for im, lb in zip(imgs, lbls):
+            # the reference normalizes to [-1, 1] (v2/dataset/mnist.py)
+            yield (im.astype(np.float32) / 255.0 * 2.0 - 1.0), int(lb)
+
+    return reader
+
 
 def _reader(n, split):
+    img_gz, lbl_gz = _FILES[split]
+    img_p = (common.cached_file("mnist", img_gz)
+             or common.cached_file("mnist", img_gz[:-3]))
+    lbl_p = (common.cached_file("mnist", lbl_gz)
+             or common.cached_file("mnist", lbl_gz[:-3]))
+    if img_p and lbl_p:
+        return _real_reader(img_p, lbl_p)
+
     def reader():
         rng = common.seeded_rng("mnist-" + split)
         for i in range(n):

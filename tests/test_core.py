@@ -285,7 +285,7 @@ def test_hybrid_path_for_save_program(tmp_path):
     assert losses[-1] < losses[0]
 
 
-def test_hybrid_matches_eager_numerics():
+def test_hybrid_matches_eager_numerics(tmp_path):
     """Hybrid and pure-eager execution produce identical losses for the
     same host-op-bearing program."""
     import paddle_tpu as pt
@@ -301,7 +301,7 @@ def test_hybrid_matches_eager_numerics():
                       param_attr=pt.ParamAttr(name="w_hyb"))
         main.global_block().append_op(
             type="save", inputs={"X": ["w_hyb"]}, outputs={},
-            attrs={"file_path": str(__import__("tempfile").mkdtemp()) + "/_hyb_num.ckpt"})
+            attrs={"file_path": str(tmp_path / "_hyb_num.ckpt")})
         pred = layers.fc(h, size=3, act="softmax",
                          param_attr=pt.ParamAttr(name="w_hyb2"))
         loss = layers.mean(layers.cross_entropy(pred, label))
@@ -323,3 +323,33 @@ def test_hybrid_matches_eager_numerics():
         results[mode] = ls
     np.testing.assert_allclose(results["hybrid"], results["eager"],
                                rtol=1e-5)
+
+
+def test_hybrid_concrete_counter_crosses_host_boundary(tmp_path):
+    """A trace-time counter produced before a host op and consumed by
+    array ops after it keeps the program on the hybrid path (the counter's
+    python value rides across the jit segment boundary)."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    main, startup = pt.Program(), pt.Program()
+    pt.switch_main_program(main)
+    pt.switch_startup_program(startup)
+    x = layers.data("x", shape=[4], dtype="float32")
+    h = layers.fc(x, size=4, param_attr=pt.ParamAttr(name="cc_w"))
+    i = layers.zeros(shape=[1], dtype="int64", force_cpu=True)
+    layers.increment(i, value=1, in_place=True)
+    main.global_block().append_op(
+        type="save", inputs={"X": ["cc_w"]}, outputs={},
+        attrs={"file_path": str(tmp_path / "cc_w.ckpt")})
+    arr = layers.create_array("float32")
+    layers.array_write(h, array=arr, i=i)
+    back = layers.array_read(array=arr, i=i)
+    out = layers.scale(back, scale=2.0)
+    with pt.scope_guard(pt.Scope()):
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        xs = np.ones((2, 4), dtype="float32")
+        r, = exe.run(main, feed={"x": xs}, fetch_list=[out])
+        r2, = exe.run(main, feed={"x": xs}, fetch_list=[out])
+        np.testing.assert_allclose(r, r2)
+    assert exe.stats["hybrid_runs"] == 2, exe.stats

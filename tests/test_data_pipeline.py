@@ -128,3 +128,64 @@ def test_feeder_trains_on_mnist():
         l, = exe.run(feed=feeder.feed(batch), fetch_list=[loss])
         losses.append(float(np.asarray(l).reshape(-1)[0]))
     assert np.mean(losses[-5:]) < np.mean(losses[:5])
+
+
+# -- real-format dataset parsers (reference: v2/dataset/{mnist,cifar}.py) ---
+
+def test_mnist_real_idx_files_parsed(tmp_path, monkeypatch):
+    """When the standard idx .gz files exist under data_home/mnist, the
+    reader parses them instead of generating synthetic data."""
+    import gzip
+    import struct
+    d = tmp_path / "mnist"
+    d.mkdir()
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (5, 28, 28), dtype=np.uint8)
+    lbls = np.asarray([3, 1, 4, 1, 5], dtype=np.uint8)
+    with gzip.open(d / "train-images-idx3-ubyte.gz", "wb") as f:
+        f.write(struct.pack(">IIII", 0x803, 5, 28, 28))
+        f.write(imgs.tobytes())
+    with gzip.open(d / "train-labels-idx1-ubyte.gz", "wb") as f:
+        f.write(struct.pack(">II", 0x801, 5))
+        f.write(lbls.tobytes())
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+    from paddle_tpu.dataset import mnist
+    rows = list(mnist.train()())
+    assert len(rows) == 5
+    im0, lb0 = rows[0]
+    assert lb0 == 3 and im0.shape == (784,)
+    np.testing.assert_allclose(
+        im0, imgs[0].reshape(-1).astype(np.float32) / 255.0 * 2.0 - 1.0,
+        rtol=1e-6)
+
+
+def test_mnist_synthetic_fallback_without_files(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+    from paddle_tpu.dataset import mnist
+    rows = list(mnist.test()())
+    assert len(rows) == mnist.TEST_SIZE
+    assert rows[0][0].shape == (784,)
+
+
+def test_cifar_real_tar_parsed(tmp_path, monkeypatch):
+    import io
+    import pickle
+    import tarfile
+    d = tmp_path / "cifar"
+    d.mkdir()
+    rng = np.random.RandomState(1)
+    batch = {b"data": rng.randint(0, 256, (4, 3072), dtype=np.uint8),
+             b"labels": [7, 0, 2, 9]}
+    blob = pickle.dumps(batch)
+    with tarfile.open(d / "cifar-10-python.tar.gz", "w:gz") as tar:
+        info = tarfile.TarInfo("cifar-10-batches-py/data_batch_1")
+        info.size = len(blob)
+        tar.addfile(info, io.BytesIO(blob))
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+    from paddle_tpu.dataset import cifar
+    rows = list(cifar.train10()())
+    assert len(rows) == 4
+    assert rows[0][1] == 7
+    np.testing.assert_allclose(
+        rows[0][0], batch[b"data"][0].astype(np.float32) / 255.0,
+        rtol=1e-6)
