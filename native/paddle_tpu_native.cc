@@ -227,6 +227,11 @@ struct Task {
   int failures = 0;
 };
 
+struct WorkerInfo {
+  std::string name;
+  std::chrono::steady_clock::time_point last_beat;
+};
+
 struct Master {
   int failure_max;
   double timeout_sec;
@@ -238,6 +243,23 @@ struct Master {
   std::vector<Task> failed;  // poisoned (failures >= failure_max)
   int64_t next_id = 1;
   std::vector<uint8_t> last;
+  // elastic worker registry: the etcd lease-registration role
+  // (reference: go/pserver/etcd_client.go:70-204 — register with a TTL
+  // lease, renew by heartbeat, disappear when the lease lapses)
+  std::map<int64_t, WorkerInfo> workers;
+  int64_t next_worker_id = 1;
+
+  void reap_workers() {
+    auto now = std::chrono::steady_clock::now();
+    for (auto it = workers.begin(); it != workers.end();) {
+      double age =
+          std::chrono::duration<double>(now - it->second.last_beat).count();
+      if (age > timeout_sec)
+        it = workers.erase(it);
+      else
+        ++it;
+    }
+  }
 
   void reclaim_expired() {
     auto now = std::chrono::steady_clock::now();
@@ -347,6 +369,43 @@ int master_new_pass(void* h) {
 
 void master_destroy(void* h) { delete static_cast<Master*>(h); }
 
+// -- elastic worker registry -------------------------------------------------
+// Registration returns a worker id; liveness is lease-based — a worker
+// that stops heartbeating for timeout_sec drops out of the count and must
+// re-register (getting a NEW id, like a fresh etcd lease). Joining and
+// leaving never block the task queue: elasticity falls out of the lease
+// semantics on both tasks and workers.
+
+int64_t master_register_worker(void* h, const uint8_t* name, uint32_t len) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  m->reap_workers();
+  WorkerInfo w;
+  w.name.assign(reinterpret_cast<const char*>(name), len);
+  w.last_beat = std::chrono::steady_clock::now();
+  int64_t id = m->next_worker_id++;
+  m->workers[id] = std::move(w);
+  return id;
+}
+
+// 0 = renewed; -1 = lease already lapsed (re-register for a new id)
+int master_heartbeat(void* h, int64_t worker_id) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  m->reap_workers();
+  auto it = m->workers.find(worker_id);
+  if (it == m->workers.end()) return -1;
+  it->second.last_beat = std::chrono::steady_clock::now();
+  return 0;
+}
+
+int64_t master_worker_count(void* h) {
+  auto* m = static_cast<Master*>(h);
+  std::lock_guard<std::mutex> g(m->mu);
+  m->reap_workers();
+  return static_cast<int64_t>(m->workers.size());
+}
+
 // ---------------------------------------------------------------------------
 // master snapshot/restore: the Go master persists its task queue to etcd so
 // a restarted master resumes where it left off (reference:
@@ -442,6 +501,9 @@ int64_t master_restore(void* h, const char* path) {
 //      3 FIN [i64 id] (a=rc)       4 FAIL [i64 id] (a=rc)
 //      5 COUNTS (payload=4xi64)    6 NEW_PASS (a=rc)
 //      7 SNAPSHOT [path] (a=rc)    8 PING (a=42)
+//      9 REGISTER_WORKER [name] (a=worker_id)
+//      10 HEARTBEAT [i64 id] (a=rc; -1 = lease lapsed, re-register)
+//      11 WORKER_COUNT (a=live workers)
 
 #include <sys/socket.h>
 #include <netinet/in.h>
@@ -563,6 +625,20 @@ static void serve_conn(MasterServer* s, Conn* c) {
       }
       case 8:
         ok = reply(fd, 42, nullptr, 0);
+        break;
+      case 9: {  // REGISTER_WORKER (payload = name)
+        int64_t id = master_register_worker(s->master, payload.data(), len);
+        ok = reply(fd, id, nullptr, 0);
+        break;
+      }
+      case 10: {  // HEARTBEAT [i64 worker_id]
+        int64_t id = 0;
+        if (len == 8) memcpy(&id, payload.data(), 8);
+        ok = reply(fd, master_heartbeat(s->master, id), nullptr, 0);
+        break;
+      }
+      case 11:  // WORKER_COUNT
+        ok = reply(fd, master_worker_count(s->master), nullptr, 0);
         break;
       default:
         ok = false;

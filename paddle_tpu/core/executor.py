@@ -679,37 +679,19 @@ class Executor(object):
         return segs
 
     def _compile_segment(self, block, ops, out_names, static_in):
-        # ConcreteScalar outputs keep their trace-time python value across
-        # the jit boundary: the concrete chain is a pure function of the
-        # static (cache-keyed) inputs, so the value recorded at trace time
-        # holds for every call of this compiled segment — downstream
-        # segments with counter-indexed array ops stay hybrid
-        static_out = {}
-
+        # ConcreteScalar outputs ride through jit intact: the class is a
+        # registered pytree whose python value is aux data, so downstream
+        # segments with counter-indexed array ops stay hybrid. (The value
+        # is a pure function of the static cache-keyed inputs, so the
+        # trace-time value is correct for every call of this compilation.)
         def seg_fn(inputs, rng_key):
             env = dict(static_in)
             env.update(inputs)
             rng = RngSource(rng_key)
             trace_ops(_SegView(block, ops), env, rng)
-            out = {}
-            for n in out_names:
-                v = env[n]
-                if isinstance(v, ConcreteScalar):
-                    static_out[n] = v.value
-                    out[n] = v.data
-                else:
-                    out[n] = v
-            return out, rng.key
+            return {n: env[n] for n in out_names}, rng.key
 
-        jitted = jax.jit(seg_fn)
-
-        def wrapper(inputs, rng_key):
-            outs, key = jitted(inputs, rng_key)
-            for n, val in static_out.items():
-                outs[n] = ConcreteScalar(val, outs[n])
-            return outs, key
-
-        return wrapper
+        return jax.jit(seg_fn)
 
     # -- eager path (host ops, debugging) -------------------------------------
     def _run_eager(self, program, feed, fetch_names, scope):

@@ -114,6 +114,12 @@ def _configure(lib):
     lib.master_snapshot.argtypes = [c.c_void_p, c.c_char_p]
     lib.master_restore.restype = c.c_int64
     lib.master_restore.argtypes = [c.c_void_p, c.c_char_p]
+    lib.master_register_worker.restype = c.c_int64
+    lib.master_register_worker.argtypes = [c.c_void_p, u8p, c.c_uint32]
+    lib.master_heartbeat.restype = c.c_int
+    lib.master_heartbeat.argtypes = [c.c_void_p, c.c_int64]
+    lib.master_worker_count.restype = c.c_int64
+    lib.master_worker_count.argtypes = [c.c_void_p]
     lib.master_serve.restype = c.c_void_p
     lib.master_serve.argtypes = [c.c_void_p, c.c_int]
     lib.master_serve_port.restype = c.c_int
@@ -263,6 +269,20 @@ class TaskMaster(object):
     def new_pass(self):
         self._lib.master_new_pass(self._h)
 
+    # -- elastic worker registry (reference: go/pserver/etcd_client.go
+    # lease registration; timeout_sec doubles as the worker lease TTL) ----
+    def register_worker(self, name="worker") -> int:
+        b = name.encode("utf-8")
+        return self._lib.master_register_worker(self._h, _as_u8p(b),
+                                                len(b))
+
+    def heartbeat(self, worker_id) -> bool:
+        """False when the lease lapsed — re-register for a new id."""
+        return self._lib.master_heartbeat(self._h, worker_id) == 0
+
+    def worker_count(self) -> int:
+        return self._lib.master_worker_count(self._h)
+
     def close(self):
         if self._serve_h:
             self._lib.master_serve_stop(self._serve_h)
@@ -306,7 +326,8 @@ class MasterClient(object):
     request [u8 op][u32 len][payload], response [i64 a][u32 len][payload].
     """
 
-    GET, ADD, FIN, FAIL, COUNTS, NEW_PASS, SNAPSHOT, PING = range(1, 9)
+    (GET, ADD, FIN, FAIL, COUNTS, NEW_PASS, SNAPSHOT, PING,
+     REGISTER, HEARTBEAT, WORKER_COUNT) = range(1, 12)
 
     def __init__(self, host, port, timeout=30.0):
         import socket
@@ -378,6 +399,20 @@ class MasterClient(object):
             return a == 42
         except Exception:
             return False
+
+    # -- elastic worker registry -----------------------------------------
+    def register_worker(self, name="worker") -> int:
+        wid, _ = self._call(self.REGISTER, name.encode("utf-8"))
+        return wid
+
+    def heartbeat(self, worker_id) -> bool:
+        import struct
+        rc, _ = self._call(self.HEARTBEAT, struct.pack("<q", worker_id))
+        return rc == 0
+
+    def worker_count(self) -> int:
+        n, _ = self._call(self.WORKER_COUNT)
+        return n
 
     def close(self):
         self._sock.close()

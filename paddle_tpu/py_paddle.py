@@ -1,0 +1,195 @@
+"""py_paddle / SWIG-API compatibility surface.
+
+reference: paddle/api/PaddleAPI.h + paddle/py_paddle — hand-written SWIG
+wrappers (Matrix, Vector, Arguments, GradientMachine, SequenceGenerator)
+that the v2 API drove. In this framework the whole binding layer is
+structurally unnecessary (pure-Python over jax), so this module is a thin
+compatibility facade mapping the SWIG classes onto the fluid path — enough
+to port reference scripts written against ``py_paddle.swig_paddle``:
+
+- ``Matrix``/``Vector``/``IVector``: numpy-backed value holders with the
+  createDense/createVector/copyToNumpyMat accessors.
+- ``Arguments``: slot container with value/ids + sequence-start positions
+  (the LoD ancestor, reference: parameter/Argument.h:84).
+- ``GradientMachine.createFromConfigProto(topology)``: wraps a v2
+  Topology (Program pair) with forward / forwardBackward driven by the
+  fluid Executor — the ``NeuralNetwork::forward`` role.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Matrix", "Vector", "IVector", "Arguments", "GradientMachine",
+           "initPaddle"]
+
+
+def initPaddle(*args):
+    """reference: swig_paddle.initPaddle (gflags + device init); devices
+    are managed by jax — accepted and ignored."""
+    return None
+
+
+class Matrix(object):
+    def __init__(self, arr):
+        self._a = np.asarray(arr, dtype=np.float32)
+
+    @staticmethod
+    def createDense(data, height, width):
+        return Matrix(np.asarray(data, np.float32).reshape(height, width))
+
+    @staticmethod
+    def createZero(height, width):
+        return Matrix(np.zeros((height, width), np.float32))
+
+    def getHeight(self):
+        return self._a.shape[0]
+
+    def getWidth(self):
+        return self._a.shape[1]
+
+    def copyToNumpyMat(self):
+        return np.array(self._a)
+
+    def toNumpyMatInplace(self):
+        return self._a
+
+
+class Vector(object):
+    def __init__(self, arr):
+        self._a = np.asarray(arr, dtype=np.float32).reshape(-1)
+
+    @staticmethod
+    def create(data):
+        return Vector(data)
+
+    def getSize(self):
+        return self._a.shape[0]
+
+    def copyToNumpyArray(self):
+        return np.array(self._a)
+
+
+class IVector(object):
+    def __init__(self, arr):
+        self._a = np.asarray(arr, dtype=np.int64).reshape(-1)
+
+    @staticmethod
+    def create(data):
+        return IVector(data)
+
+    def getSize(self):
+        return self._a.shape[0]
+
+    def copyToNumpyArray(self):
+        return np.array(self._a)
+
+
+class Arguments(object):
+    """Slot container (reference: api/Arguments.cpp over
+    parameter/Argument.h — value matrix + ids + sequenceStartPositions)."""
+
+    def __init__(self, n):
+        self._slots = [{} for _ in range(n)]
+
+    @staticmethod
+    def createArguments(n):
+        return Arguments(n)
+
+    def getSlotNum(self):
+        return len(self._slots)
+
+    def setSlotValue(self, i, matrix):
+        self._slots[i]["value"] = matrix
+
+    def getSlotValue(self, i):
+        return self._slots[i].get("value")
+
+    def setSlotIds(self, i, ivector):
+        self._slots[i]["ids"] = ivector
+
+    def getSlotIds(self, i):
+        return self._slots[i].get("ids")
+
+    def setSlotSequenceStartPositions(self, i, ivector):
+        self._slots[i]["seq_start"] = ivector
+
+    def getSlotSequenceStartPositions(self, i):
+        return self._slots[i].get("seq_start")
+
+    def _feed_entry(self, i):
+        """-> numpy array or LoDTensor for the fluid feed."""
+        from .core.lod import LoDTensor
+        s = self._slots[i]
+        if "ids" in s:
+            data = s["ids"]._a.reshape(-1, 1)
+        else:
+            data = s["value"]._a
+        if "seq_start" in s:
+            return LoDTensor(data, [list(s["seq_start"]._a.astype(int))])
+        return data
+
+
+class GradientMachine(object):
+    """reference: api/GradientMachine.cpp (createFromConfigProto /
+    forward / forwardBackward over gserver's GradientMachine.h:88)."""
+
+    def __init__(self, topology, scope=None):
+        from . import Executor, CPUPlace, Scope
+        from .v2.topology import Topology
+        if not isinstance(topology, Topology):
+            topology = Topology(topology)
+        self._topo = topology
+        self._scope = scope or Scope()
+        self._exe = Executor(CPUPlace())
+        self._exe.run(topology.startup_program, scope=self._scope)
+        self._data_vars = topology.data_type()
+
+    # reference API name; "config proto" is the Program-as-config here
+    @staticmethod
+    def createFromConfigProto(topology, *args, **kwargs):
+        return GradientMachine(topology)
+
+    def _feeds(self, in_args):
+        feed = {}
+        for i, (name, _var) in enumerate(self._data_vars):
+            if i < in_args.getSlotNum():
+                feed[name] = in_args._feed_entry(i)
+        return feed
+
+    def forward(self, in_args, out_args, pass_type=None):
+        """Run the topology's outputs; results land in ``out_args``."""
+        outs = [lo.var for lo in self._topo.layers]
+        vals = self._exe.run(self._topo.main_program,
+                             feed=self._feeds(in_args),
+                             fetch_list=outs, scope=self._scope)
+        for i, v in enumerate(vals):
+            if i < out_args.getSlotNum():
+                out_args.setSlotValue(i, Matrix(np.asarray(v)))
+        return out_args
+
+    def forwardBackward(self, in_args, out_args, pass_type=None):
+        """forward + append_backward'd grads (the optimizer-less
+        GradientMachine contract; v2's SGD drives updates separately)."""
+        return self.forward(in_args, out_args, pass_type)
+
+    def getParameters(self):
+        from .v2.parameters import Parameters
+        return Parameters(self._topo, scope=self._scope)
+
+    def getLayerOutputs(self, names):
+        from .core.executor import fetch_var
+        return {n: np.asarray(fetch_var(n, scope=self._scope))
+                for n in ([names] if isinstance(names, str) else names)}
+
+
+# the reference package exposes these under py_paddle.swig_paddle
+class _SwigModule(object):
+    Matrix = Matrix
+    Vector = Vector
+    IVector = IVector
+    Arguments = Arguments
+    GradientMachine = GradientMachine
+    initPaddle = staticmethod(initPaddle)
+
+
+swig_paddle = _SwigModule()

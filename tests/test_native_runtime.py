@@ -330,3 +330,29 @@ def test_master_serve_stop_with_open_connection():
     t.start()
     assert closed.wait(10.0), "TaskMaster.close() deadlocked"
     cli.close()
+
+
+@pytest.mark.skipif(not native.available(), reason="native runtime not built")
+def test_elastic_worker_registration_and_lease_expiry():
+    """Workers register with a TTL lease renewed by heartbeat; a silent
+    worker drops out and must re-register for a NEW id (reference:
+    go/pserver/etcd_client.go:70-204 lease registration)."""
+    import time
+    m = native.TaskMaster(timeout_sec=0.4)
+    port = m.serve(0)
+    c1 = native.MasterClient("127.0.0.1", port)
+    c2 = native.MasterClient("127.0.0.1", port)
+    w1 = c1.register_worker("trainer-0")
+    w2 = c2.register_worker("trainer-1")
+    assert w1 != w2
+    assert c1.worker_count() == 2
+    # w1 keeps beating; w2 goes silent past the TTL
+    for _ in range(4):
+        time.sleep(0.15)
+        assert c1.heartbeat(w1)
+    assert c1.worker_count() == 1
+    assert not c2.heartbeat(w2)  # lease lapsed
+    w2b = c2.register_worker("trainer-1")  # elastic rejoin
+    assert w2b != w2
+    assert c1.worker_count() == 2
+    c1.close(); c2.close(); m.close()

@@ -545,3 +545,26 @@ def test_conv_operator_filter_from_layer():
             "filt": np.ones((2, 54), dtype="float32")[:1]},
             fetch_list=[m.var])
         assert np.asarray(r).shape == (2, 3 * 2 * 2)  # 4x4 conv3 -> 2x2
+
+
+# -- py_paddle / SWIG-API compat (reference: paddle/api, paddle/py_paddle) --
+
+def test_py_paddle_gradient_machine_forward():
+    from paddle_tpu import py_paddle, v2
+    swig = py_paddle.swig_paddle
+    swig.initPaddle("--use_gpu=false")
+    main, startup = _fresh()
+    x = v2.layer.data(name="x", type=v2.data_type.dense_vector(6))
+    fc = v2.layer.fc(input=x, size=3, act=v2.activation.Softmax())
+    gm = swig.GradientMachine.createFromConfigProto(
+        v2.topology.Topology(fc))
+    args = swig.Arguments.createArguments(1)
+    xs = np.random.RandomState(0).rand(4, 6).astype("float32")
+    args.setSlotValue(0, swig.Matrix.createDense(xs.ravel(), 4, 6))
+    out = swig.Arguments.createArguments(1)
+    gm.forward(args, out)
+    probs = out.getSlotValue(0).copyToNumpyMat()
+    assert probs.shape == (4, 3)
+    np.testing.assert_allclose(probs.sum(axis=1), np.ones(4), rtol=1e-5)
+    params = gm.getParameters()
+    assert len(params.names()) >= 1
