@@ -40,20 +40,25 @@ def bench(batch_size=64, hidden=512, seq_len=100, vocab=30000, layers_n=2,
     rng = np.random.RandomState(0)
     seqs = [rng.randint(0, vocab, (seq_len, 1)).astype("int64")
             for _ in range(batch_size)]
-    feed = {"words": build_lod_tensor(seqs),
-            "label": rng.randint(0, 2, (batch_size, 1)).astype("int64")}
-    for _ in range(warmup):
-        exe.run(feed=feed, fetch_list=[loss])
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out, = exe.run(feed=feed, fetch_list=[loss])
-    np.asarray(out)
-    dt = (time.perf_counter() - t0) / iters
+    feed = exe.prepare_feed({
+        "words": build_lod_tensor(seqs),
+        "label": rng.randint(0, 2, (batch_size, 1)).astype("int64")})
+    for _ in range(max(warmup, 1)):
+        out, = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
+    np.asarray(out)  # true sync over tunnelled devices
+    best = float("inf")
+    for _ in range(3):  # best-of-3 windows (repo-root bench.py rationale)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out, = exe.run(feed=feed, fetch_list=[loss],
+                           return_numpy=False)
+        np.asarray(out)
+        best = min(best, (time.perf_counter() - t0) / iters)
     tokens = batch_size * seq_len
     return {"model": "lstm%dx%d" % (layers_n, hidden),
             "batch_size": batch_size, "seq_len": seq_len,
-            "ms_per_batch": round(dt * 1e3, 2),
-            "tokens_per_sec": round(tokens / dt, 2)}
+            "ms_per_batch": round(best * 1e3, 2),
+            "tokens_per_sec": round(tokens / best, 2)}
 
 
 if __name__ == "__main__":

@@ -204,3 +204,6 @@ DEFINE_string("data_home", "~/.cache/paddle_tpu/dataset",
 DEFINE_int32("log_period", 100,
              "steps between trainer progress lines "
              "(reference: utils/Flags.cpp log_period)")
+DEFINE_string("lstm_impl", "scan",
+              "whole-sequence LSTM lowering: 'scan' (lax.scan) or "
+              "'pallas' (fused VMEM-resident kernel, standard gate set)")

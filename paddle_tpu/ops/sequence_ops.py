@@ -510,7 +510,22 @@ def lstm(ctx):
         c = c * m_ + c_prev * (1 - m_)
         return (h, c), (h, c)
 
-    (_, _), (hs, cs) = jax.lax.scan(step, (h_init, c_init), (xs, ms))
+    # Pallas fused path (hl_lstm_parallel_forward role): one kernel runs
+    # the whole recurrence with the weight VMEM-resident. Opt-in via
+    # flags.lstm_impl="pallas"; standard gate set only, and TPU tiling
+    # wants D a multiple of the 128 lane width.
+    from ..flags import FLAGS
+    use_fused = (FLAGS.lstm_impl == "pallas" and not use_peep
+                 and ctx.attr("gate_activation", "sigmoid") == "sigmoid"
+                 and ctx.attr("cell_activation", "tanh") == "tanh"
+                 and ctx.attr("candidate_activation", "tanh") == "tanh"
+                 and D % 128 == 0)
+    if use_fused:
+        from ..kernels.fused_lstm import fused_lstm
+        hs, cs = fused_lstm(xs, w, h_init, c_init,
+                            ms.astype(jnp.float32))
+    else:
+        (_, _), (hs, cs) = jax.lax.scan(step, (h_init, c_init), (xs, ms))
     hs = jnp.swapaxes(hs, 0, 1)
     cs = jnp.swapaxes(cs, 0, 1)
     if rev:

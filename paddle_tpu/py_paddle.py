@@ -159,8 +159,9 @@ class GradientMachine(object):
     def forward(self, in_args, out_args, pass_type=None):
         """Run the topology's outputs; results land in ``out_args``."""
         outs = [lo.var for lo in self._topo.layers]
+        self._last_feed = self._feeds(in_args)
         vals = self._exe.run(self._topo.main_program,
-                             feed=self._feeds(in_args),
+                             feed=self._last_feed,
                              fetch_list=outs, scope=self._scope)
         for i, v in enumerate(vals):
             if i < out_args.getSlotNum():
@@ -168,18 +169,45 @@ class GradientMachine(object):
         return out_args
 
     def forwardBackward(self, in_args, out_args, pass_type=None):
-        """forward + append_backward'd grads (the optimizer-less
-        GradientMachine contract; v2's SGD drives updates separately)."""
-        return self.forward(in_args, out_args, pass_type)
+        """forward + backward: parameter gradients are computed against
+        the topology's cost (its FIRST output, the v2 convention) and kept
+        readable via ``getParamGrad`` — the GradientMachine contract where
+        the updater applies them separately (reference:
+        api/GradientMachine.cpp forwardBackward)."""
+        from .core.backward import append_backward
+        from .core import ir
+        if not getattr(self, "_grads_appended", False):
+            cost = self._topo.layers[0].var
+            from .core.ir import program_guard
+            with program_guard(self._topo.main_program,
+                               self._topo.startup_program):
+                self._param_grads = append_backward(cost)
+            self._grads_appended = True
+        out = self.forward(in_args, out_args, pass_type)
+        grad_vars = [g for _p, g in self._param_grads]
+        vals = self._exe.run(self._topo.main_program,
+                             feed=self._last_feed,
+                             fetch_list=grad_vars, scope=self._scope)
+        self._grads = {p.name: np.asarray(v)
+                       for (p, _g), v in zip(self._param_grads, vals)}
+        return out
+
+    def getParamGrad(self, name):
+        """numpy gradient of a parameter from the last forwardBackward."""
+        return self._grads[name]
 
     def getParameters(self):
         from .v2.parameters import Parameters
         return Parameters(self._topo, scope=self._scope)
 
     def getLayerOutputs(self, names):
-        from .core.executor import fetch_var
-        return {n: np.asarray(fetch_var(n, scope=self._scope))
-                for n in ([names] if isinstance(names, str) else names)}
+        """Activations for named layers from the LAST forward's inputs
+        (re-fetched: the executor persists only parameters in the scope)."""
+        names = [names] if isinstance(names, str) else list(names)
+        vals = self._exe.run(self._topo.main_program,
+                             feed=self._last_feed, fetch_list=names,
+                             scope=self._scope)
+        return {n: np.asarray(v) for n, v in zip(names, vals)}
 
 
 # the reference package exposes these under py_paddle.swig_paddle

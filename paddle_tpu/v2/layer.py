@@ -42,6 +42,7 @@ max_id = _v1.max_id_layer
 classification_cost = _v1.classification_cost
 cross_entropy_cost = _v1.cross_entropy
 square_error_cost = _v1.square_error_cost
+mse_cost = _v1.square_error_cost  # reference v2 alias
 mixed = _v1.mixed_layer
 full_matrix_projection = _v1.full_matrix_projection
 identity_projection = _v1.identity_projection

@@ -568,3 +568,30 @@ def test_py_paddle_gradient_machine_forward():
     np.testing.assert_allclose(probs.sum(axis=1), np.ones(4), rtol=1e-5)
     params = gm.getParameters()
     assert len(params.names()) >= 1
+
+
+def test_py_paddle_forward_backward_grads_and_layer_outputs():
+    from paddle_tpu import py_paddle, v2
+    swig = py_paddle.swig_paddle
+    main, startup = _fresh()
+    x = v2.layer.data(name="x", type=v2.data_type.dense_vector(5))
+    fc = v2.layer.fc(input=x, size=4, act=v2.activation.Tanh())
+    cost = v2.layer.mse_cost(input=fc, label=v2.layer.data(
+        name="lbl", type=v2.data_type.dense_vector(4)))
+    gm = swig.GradientMachine.createFromConfigProto(
+        v2.topology.Topology(cost))
+    args = swig.Arguments.createArguments(2)
+    rng = np.random.RandomState(0)
+    args.setSlotValue(0, swig.Matrix.createDense(
+        rng.rand(3, 5).astype("float32").ravel(), 3, 5))
+    args.setSlotValue(1, swig.Matrix.createDense(
+        rng.rand(3, 4).astype("float32").ravel(), 3, 4))
+    out = swig.Arguments.createArguments(1)
+    gm.forwardBackward(args, out)
+    params = gm.getParameters()
+    w_name = [n for n in params.names() if ".w" in n or "w_" in n][0]
+    g = gm.getParamGrad(w_name)
+    assert g.shape == params.get(w_name).shape
+    assert np.abs(g).sum() > 0  # real gradients, not zeros
+    acts = gm.getLayerOutputs([cost.var.name])
+    assert cost.var.name in acts
