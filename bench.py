@@ -23,7 +23,13 @@ import time
 
 import numpy as np
 
-BASELINE_IMG_S = 84.08
+# single source for per-model baselines: benchmark/baselines.py
+# (dependency-free; values transcribed from BASELINE.md)
+try:
+    from benchmark.baselines import REF_BASELINES as _REF
+    BASELINE_IMG_S = _REF["resnet50"]
+except Exception:  # driver may run bench.py from an odd cwd
+    BASELINE_IMG_S = 84.08
 BUDGET_SEC = float(os.environ.get("BENCH_BUDGET_SEC", "1500"))
 _T0 = time.time()
 

@@ -1,0 +1,12 @@
+"""Reference baseline numbers (single source; transcribed from repo-root
+BASELINE.md — the reference's best published per-model training
+throughputs). Dependency-free so bench.py can import it before any heavy
+framework/jax initialization."""
+
+# img/s, best published value per model (BASELINE.md rows)
+REF_BASELINES = {
+    "alexnet": 626.5,     # IntelOptimizedPaddle.md:58-66, bs256
+    "vgg16": 30.44,       # vgg-19 row, bs256 (closest config)
+    "googlenet": 269.50,  # IntelOptimizedPaddle.md:49-55, bs256
+    "resnet50": 84.08,    # IntelOptimizedPaddle.md:40-46, bs256
+}

@@ -27,9 +27,7 @@ MODELS = {
 }
 
 
-# best published reference numbers per model (img/s; repo-root BASELINE.md)
-REF_BASELINES = {"alexnet": 626.5, "vgg16": 30.44, "googlenet": 269.50,
-                 "resnet50": 84.08}
+from benchmark.baselines import REF_BASELINES  # single source
 
 
 def bench(model="resnet50", batch_size=64, iters=16, warmup=1,

@@ -578,7 +578,16 @@ def gru(ctx):
         h = h * m_ + h_prev * (1 - m_)
         return h, h
 
-    _, hs = jax.lax.scan(step, h_init, (xs, ms))
+    # fused Pallas path: see the lstm op's use_fused note (gru analog of
+    # hl_gpu_gru; opt-in via flags.lstm_impl="pallas")
+    from ..flags import FLAGS
+    if (FLAGS.lstm_impl == "pallas" and D % 128 == 0
+            and ctx.attr("gate_activation", "sigmoid") == "sigmoid"
+            and ctx.attr("activation", "tanh") == "tanh"):
+        from ..kernels.fused_gru import fused_gru
+        hs = fused_gru(xs, w, h_init, ms.astype(jnp.float32))
+    else:
+        _, hs = jax.lax.scan(step, h_init, (xs, ms))
     hs = jnp.swapaxes(hs, 0, 1)
     if rev:
         hs = reverse_padded(hs, mask, offs, ml)

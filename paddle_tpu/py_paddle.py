@@ -173,24 +173,30 @@ class GradientMachine(object):
         the topology's cost (its FIRST output, the v2 convention) and kept
         readable via ``getParamGrad`` — the GradientMachine contract where
         the updater applies them separately (reference:
-        api/GradientMachine.cpp forwardBackward)."""
+        api/GradientMachine.cpp forwardBackward). Outputs and grads come
+        from ONE executor run, so stochastic ops (dropout) see a single
+        forward and the reported activations match the gradients."""
         from .core.backward import append_backward
-        from .core import ir
+        from .core.ir import program_guard
         if not getattr(self, "_grads_appended", False):
             cost = self._topo.layers[0].var
-            from .core.ir import program_guard
             with program_guard(self._topo.main_program,
                                self._topo.startup_program):
                 self._param_grads = append_backward(cost)
             self._grads_appended = True
-        out = self.forward(in_args, out_args, pass_type)
+        outs = [lo.var for lo in self._topo.layers]
         grad_vars = [g for _p, g in self._param_grads]
+        self._last_feed = self._feeds(in_args)
         vals = self._exe.run(self._topo.main_program,
                              feed=self._last_feed,
-                             fetch_list=grad_vars, scope=self._scope)
-        self._grads = {p.name: np.asarray(v)
-                       for (p, _g), v in zip(self._param_grads, vals)}
-        return out
+                             fetch_list=outs + grad_vars,
+                             scope=self._scope)
+        for i in range(len(outs)):
+            if i < out_args.getSlotNum():
+                out_args.setSlotValue(i, Matrix(np.asarray(vals[i])))
+        self._grads = {p.name: np.asarray(v) for (p, _g), v in
+                       zip(self._param_grads, vals[len(outs):])}
+        return out_args
 
     def getParamGrad(self, name):
         """numpy gradient of a parameter from the last forwardBackward."""
@@ -203,6 +209,10 @@ class GradientMachine(object):
     def getLayerOutputs(self, names):
         """Activations for named layers from the LAST forward's inputs
         (re-fetched: the executor persists only parameters in the scope)."""
+        if not hasattr(self, "_last_feed"):
+            raise RuntimeError(
+                "getLayerOutputs needs a forward first — call "
+                "forward()/forwardBackward() before reading activations")
         names = [names] if isinstance(names, str) else list(names)
         vals = self._exe.run(self._topo.main_program,
                              feed=self._last_feed, fetch_list=names,

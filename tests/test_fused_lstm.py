@@ -105,3 +105,74 @@ def test_lstm_op_pallas_path_matches_scan():
 
     np.testing.assert_allclose(run("pallas"), run("scan"),
                                rtol=2e-4, atol=2e-5)
+
+
+# -- fused GRU (companion kernel) -------------------------------------------
+
+def test_fused_gru_forward_and_grads_match_scan():
+    from paddle_tpu.kernels.fused_gru import fused_gru
+    rng = np.random.RandomState(5)
+    Tg, Ng, Dg = 5, 8, 128
+    xs = jnp.asarray(rng.randn(Tg, Ng, 3 * Dg).astype("float32") * 0.4)
+    w = jnp.asarray(rng.randn(Dg, 3 * Dg).astype("float32") * 0.1)
+    h0 = jnp.asarray(rng.randn(Ng, Dg).astype("float32") * 0.2)
+    lens = rng.randint(1, Tg + 1, Ng)
+    mask = jnp.asarray((np.arange(Tg)[:, None] < lens[None, :])
+                       .astype("float32"))
+
+    def ref(xs, w, h0):
+        w_ur, w_c = w[:, :2 * Dg], w[:, 2 * Dg:]
+
+        def step(h_prev, inp):
+            x_t, m = inp
+            ur = jax.nn.sigmoid(x_t[:, :2 * Dg] + h_prev @ w_ur)
+            u, r = ur[:, :Dg], ur[:, Dg:]
+            cand = jnp.tanh(x_t[:, 2 * Dg:] + (r * h_prev) @ w_c)
+            h = (1 - u) * h_prev + u * cand
+            m_ = m[:, None]
+            h = h * m_ + h_prev * (1 - m_)
+            return h, h
+
+        return jax.lax.scan(step, h0, (xs, mask))[1]
+
+    hs = fused_gru(xs, w, h0, mask, True)
+    hr = ref(xs, w, h0)
+    np.testing.assert_allclose(np.asarray(hs), np.asarray(hr),
+                               rtol=2e-5, atol=2e-5)
+
+    t = jnp.asarray(rng.randn(Tg, Ng, Dg).astype("float32"))
+    gf = jax.grad(lambda *a: jnp.sum(fused_gru(*a, mask, True) * t),
+                  argnums=(0, 1, 2))(xs, w, h0)
+    gr = jax.grad(lambda *a: jnp.sum(ref(*a) * t),
+                  argnums=(0, 1, 2))(xs, w, h0)
+    for a, b, name in zip(gf, gr, ("dxs", "dw", "dh0")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4, err_msg=name)
+
+
+def test_gru_op_pallas_path_matches_scan():
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.core.lod import LoDTensor
+
+    def run(impl):
+        main, startup = pt.Program(), pt.Program()
+        pt.switch_main_program(main)
+        pt.switch_startup_program(startup)
+        xv = layers.data("x", shape=[3 * D], dtype="float32", lod_level=1)
+        h = layers.dynamic_gru(input=xv, size=D)
+        loss = layers.mean(layers.sequence_pool(input=h, pool_type="max"))
+        pt.SGD(learning_rate=0.1).minimize(loss)
+        rng = np.random.RandomState(6)
+        feed = {"x": LoDTensor(rng.randn(6, 3 * D).astype("float32") * 0.3,
+                               [[0, 2, 6]])}
+        with pt.scope_guard(pt.Scope()):
+            with pt.flags_guard(lstm_impl=impl):
+                exe = pt.Executor(pt.CPUPlace())
+                exe.run(startup)
+                return [float(np.asarray(exe.run(main, feed=feed,
+                                                 fetch_list=[loss])[0]))
+                        for _ in range(3)]
+
+    np.testing.assert_allclose(run("pallas"), run("scan"),
+                               rtol=2e-4, atol=2e-5)
