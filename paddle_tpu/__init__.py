@@ -46,6 +46,7 @@ from .memory_optimization_transpiler import (  # noqa: F401
 )
 from . import amp  # noqa: F401
 from . import flags  # noqa: F401
+from . import enforce  # noqa: F401
 from .flags import FLAGS, set_flags, get_flags, flags_guard  # noqa: F401
 from . import inference  # noqa: F401
 from .io import (  # noqa: F401

@@ -225,18 +225,31 @@ def trace_ops(block: ir.Block, env: Dict[str, Any], rng: RngSource,
     ``value_hook(name, value)`` intercepts every produced value (used to pin
     sharding constraints on named intermediates, e.g. @GRAD vars)."""
     from .. import profiler as _prof
-    if _prof.profiler_enabled():
-        for op in block.ops:
-            opdef = registry.lookup_checked(op.type)
-            t0 = time.perf_counter()
+    timing = _prof.profiler_enabled()
+    for op in block.ops:
+        opdef = registry.lookup_checked(op.type)
+        t0 = time.perf_counter() if timing else 0.0
+        try:
             opdef.lower(LowerContext(op, env, rng, block, value_hook))
+        except Exception as e:
+            _annotate_op_error(e, op)
+            raise
+        if timing:
             _prof.record_op_event(op.type, op.output_arg_names[0]
                                   if op.output_arg_names else op.type,
                                   t0, time.perf_counter())
-    else:
-        for op in block.ops:
-            opdef = registry.lookup_checked(op.type)
-            opdef.lower(LowerContext(op, env, rng, block, value_hook))
+
+
+def _annotate_op_error(e, op):
+    """Attach the failing op's identity to the exception (the layer-aware
+    crash context of reference utils/CustomStackTrace.h): deep trace
+    errors otherwise point at jax internals with no hint WHICH program op
+    produced the offending computation."""
+    try:
+        e.add_note("while lowering op %r (inputs=%s -> outputs=%s)"
+                   % (op.type, op.input_arg_names, op.output_arg_names))
+    except Exception:
+        pass  # non-annotatable exception type; never mask the original
 
 
 class FunctionalContext(LowerContext):

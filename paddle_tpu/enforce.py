@@ -1,0 +1,60 @@
+"""Error enforcement helpers: the PADDLE_ENFORCE role.
+
+reference: paddle/fluid/platform/enforce.h — condition macros that raise
+with formatted messages and captured context (the C++ side adds stack
+traces; Python exceptions carry those natively). The executor adds the
+layer-aware context itself (each op lowering failure is annotated with the
+op being lowered — the utils/CustomStackTrace role), so these helpers are
+the user/API-facing validation surface.
+"""
+from __future__ import annotations
+
+__all__ = ["EnforceError", "enforce", "enforce_eq", "enforce_ne",
+           "enforce_gt", "enforce_ge", "enforce_lt", "enforce_le",
+           "enforce_not_none"]
+
+
+class EnforceError(ValueError):
+    """reference: platform/enforce.h EnforceNotMet."""
+
+
+def enforce(cond, msg="", *fmt):
+    if not cond:
+        raise EnforceError(msg % fmt if fmt else (msg or
+                                                  "enforce failed"))
+
+
+def _cmp(a, b, op, sym, msg):
+    if not op(a, b):
+        raise EnforceError("enforce %r %s %r failed%s"
+                           % (a, sym, b, (": " + msg) if msg else ""))
+
+
+def enforce_eq(a, b, msg=""):
+    _cmp(a, b, lambda x, y: x == y, "==", msg)
+
+
+def enforce_ne(a, b, msg=""):
+    _cmp(a, b, lambda x, y: x != y, "!=", msg)
+
+
+def enforce_gt(a, b, msg=""):
+    _cmp(a, b, lambda x, y: x > y, ">", msg)
+
+
+def enforce_ge(a, b, msg=""):
+    _cmp(a, b, lambda x, y: x >= y, ">=", msg)
+
+
+def enforce_lt(a, b, msg=""):
+    _cmp(a, b, lambda x, y: x < y, "<", msg)
+
+
+def enforce_le(a, b, msg=""):
+    _cmp(a, b, lambda x, y: x <= y, "<=", msg)
+
+
+def enforce_not_none(v, msg=""):
+    if v is None:
+        raise EnforceError(msg or "value must not be None")
+    return v

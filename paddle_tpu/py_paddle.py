@@ -156,6 +156,13 @@ class GradientMachine(object):
                 feed[name] = in_args._feed_entry(i)
         return feed
 
+    @staticmethod
+    def _fill_out_args(out_args, vals):
+        for i, v in enumerate(vals):
+            if i < out_args.getSlotNum():
+                out_args.setSlotValue(i, Matrix(np.asarray(v)))
+        return out_args
+
     def forward(self, in_args, out_args, pass_type=None):
         """Run the topology's outputs; results land in ``out_args``."""
         outs = [lo.var for lo in self._topo.layers]
@@ -163,10 +170,7 @@ class GradientMachine(object):
         vals = self._exe.run(self._topo.main_program,
                              feed=self._last_feed,
                              fetch_list=outs, scope=self._scope)
-        for i, v in enumerate(vals):
-            if i < out_args.getSlotNum():
-                out_args.setSlotValue(i, Matrix(np.asarray(v)))
-        return out_args
+        return self._fill_out_args(out_args, vals)
 
     def forwardBackward(self, in_args, out_args, pass_type=None):
         """forward + backward: parameter gradients are computed against
@@ -191,12 +195,9 @@ class GradientMachine(object):
                              feed=self._last_feed,
                              fetch_list=outs + grad_vars,
                              scope=self._scope)
-        for i in range(len(outs)):
-            if i < out_args.getSlotNum():
-                out_args.setSlotValue(i, Matrix(np.asarray(vals[i])))
         self._grads = {p.name: np.asarray(v) for (p, _g), v in
                        zip(self._param_grads, vals[len(outs):])}
-        return out_args
+        return self._fill_out_args(out_args, vals[:len(outs)])
 
     def getParamGrad(self, name):
         """numpy gradient of a parameter from the last forwardBackward."""

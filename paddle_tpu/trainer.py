@@ -84,19 +84,30 @@ class Trainer(object):
 
     def train(self, reader, num_passes=1, event_handler=None):
         self._maybe_init()
+        from . import profiler as _prof
+        from .flags import FLAGS
         handler = event_handler or (lambda e: None)
+        log_period = FLAGS.log_period
         for pass_id in range(num_passes):
             handler(BeginPass(pass_id))
             costs = []
-            for batch_id, data in enumerate(reader()):
-                handler(BeginIteration(pass_id, batch_id))
-                outs = self.exe.run(self.main_program,
-                                    feed=self.feeder.feed(data),
-                                    fetch_list=self.fetch_list)
-                cost = float(np.asarray(outs[0]).reshape(-1)[0])
-                costs.append(cost)
-                handler(EndIteration(pass_id, batch_id, cost,
-                                     {"fetches": outs[1:]}))
+            with _prof.timer("pass"):
+                for batch_id, data in enumerate(reader()):
+                    handler(BeginIteration(pass_id, batch_id))
+                    with _prof.timer("batch"):
+                        outs = self.exe.run(self.main_program,
+                                            feed=self.feeder.feed(data),
+                                            fetch_list=self.fetch_list)
+                    cost = float(np.asarray(outs[0]).reshape(-1)[0])
+                    costs.append(cost)
+                    if log_period and (batch_id + 1) % log_period == 0:
+                        # the reference's per-log_period batch line
+                        # (reference: TrainerInternal.cpp:159-171)
+                        print("pass %d batch %d: cost=%.6f (avg %.6f)"
+                              % (pass_id, batch_id, cost,
+                                 float(np.mean(costs[-log_period:]))))
+                    handler(EndIteration(pass_id, batch_id, cost,
+                                         {"fetches": outs[1:]}))
             if self.checkpoint_dir:
                 self.save_checkpoint()
             handler(EndPass(pass_id,

@@ -29,7 +29,8 @@ class client(object):
     the launcher (paddle_tpu.launch), which replaces etcd.
     """
 
-    def __init__(self, addr=None, timeout_sec=60.0, failure_max=3):
+    def __init__(self, addr=None, timeout_sec=60.0, failure_max=3,
+                 worker_name=None):
         if addr is None:
             self._master = native.TaskMaster(failure_max=failure_max,
                                              timeout_sec=timeout_sec)
@@ -41,6 +42,51 @@ class client(object):
         self._task = None        # (task_id, payload)
         self._reader = None
         self._paths_added = False
+        self._hb_stop = None
+        self._hb = None
+        if worker_name is not None:
+            # elastic membership: register and keep the lease alive on a
+            # daemon thread (the etcd keepalive role) at 1/4 of the TTL.
+            # Remote mode gets its OWN connection: MasterClient frames are
+            # not thread-safe, and sharing the records() socket would
+            # interleave request/response pairs. In-process mode shares the
+            # TaskMaster handle (the C side holds a mutex per call).
+            import threading
+            if self._rpc is not None:
+                host, _, port = addr.partition(":")
+                hb_api = native.MasterClient(host, int(port))
+            else:
+                hb_api = self._master
+            self.worker_id = hb_api.register_worker(worker_name)
+            self._hb_stop = threading.Event()
+
+            def beat():
+                misses = 0
+                while not self._hb_stop.wait(max(timeout_sec / 4.0, 0.05)):
+                    try:
+                        if not hb_api.heartbeat(self.worker_id):
+                            # lease lapsed (e.g. long GC pause): rejoin
+                            self.worker_id = hb_api.register_worker(
+                                worker_name)
+                        misses = 0
+                    except Exception:
+                        # transient RPC failure must not silently lapse a
+                        # live worker's lease — retry a few beats first
+                        misses += 1
+                        if misses >= 3:
+                            import warnings
+                            warnings.warn(
+                                "master keepalive lost after %d attempts; "
+                                "worker lease will lapse" % misses,
+                                RuntimeWarning)
+                            return
+                if self._rpc is not None:
+                    try:
+                        hb_api.close()
+                    except Exception:
+                        pass
+            self._hb = threading.Thread(target=beat, daemon=True)
+            self._hb.start()
 
     def _api(self):
         return self._rpc if self._rpc is not None else self._master
@@ -120,6 +166,11 @@ class client(object):
         return self.records()
 
     def close(self):
+        if self._hb_stop is not None:
+            self._hb_stop.set()
+            # join BEFORE destroying the backend: the beat thread must not
+            # call into a freed TaskMaster handle or closed socket
+            self._hb.join(timeout=5.0)
         if self._rpc is not None:
             self._rpc.close()
         elif self._master is not None:

@@ -195,3 +195,41 @@ def test_barrier_stat_straggler():
     assert s["rounds"] == 5
     assert s["worst_member"] == 2
     assert abs(s["mean_gap_s"] - 0.01) < 1e-6
+
+
+# -- enforce helpers + op-context crash notes -------------------------------
+
+def test_enforce_helpers():
+    from paddle_tpu import enforce as E
+    E.enforce(True)
+    E.enforce_eq(3, 3)
+    E.enforce_ge(4, 4)
+    assert E.enforce_not_none(5) == 5
+    with pytest.raises(E.EnforceError):
+        E.enforce(False, "bad %d", 7)
+    with pytest.raises(E.EnforceError):
+        E.enforce_lt(2, 1)
+
+
+def test_lowering_error_names_the_op():
+    """A failing lowering carries the op identity as an exception note
+    (utils/CustomStackTrace role)."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    main, startup = pt.Program(), pt.Program()
+    pt.switch_main_program(main)
+    pt.switch_startup_program(startup)
+    a = layers.data("a", shape=[4], dtype="float32")
+    b = layers.data("b", shape=[5], dtype="float32")
+    bad = layers.elementwise_add(a, b)  # incompatible shapes at trace
+    with pt.scope_guard(pt.Scope()):
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        try:
+            exe.run(main, feed={"a": np.ones((2, 4), "float32"),
+                                "b": np.ones((2, 5), "float32")},
+                    fetch_list=[bad])
+            assert False, "expected a shape error"
+        except Exception as e:
+            notes = "".join(getattr(e, "__notes__", []))
+            assert "elementwise_add" in notes, notes

@@ -98,8 +98,9 @@ def test_lstm_op_pallas_path_matches_scan():
             with pt.flags_guard(lstm_impl=impl):
                 exe = pt.Executor(pt.CPUPlace())
                 exe.run(startup)
-                ls = [float(np.asarray(exe.run(main, feed=feed,
-                                               fetch_list=[loss])[0]))
+                ls = [float(np.asarray(exe.run(
+                          main, feed=feed,
+                          fetch_list=[loss])[0]).reshape(-1)[0])
                       for _ in range(3)]
         return ls
 
@@ -170,8 +171,9 @@ def test_gru_op_pallas_path_matches_scan():
             with pt.flags_guard(lstm_impl=impl):
                 exe = pt.Executor(pt.CPUPlace())
                 exe.run(startup)
-                return [float(np.asarray(exe.run(main, feed=feed,
-                                                 fetch_list=[loss])[0]))
+                return [float(np.asarray(exe.run(
+                            main, feed=feed,
+                            fetch_list=[loss])[0]).reshape(-1)[0])
                         for _ in range(3)]
 
     np.testing.assert_allclose(run("pallas"), run("scan"),

@@ -595,3 +595,28 @@ def test_py_paddle_forward_backward_grads_and_layer_outputs():
     assert np.abs(g).sum() > 0  # real gradients, not zeros
     acts = gm.getLayerOutputs([cost.var.name])
     assert cost.var.name in acts
+
+
+@pytest.mark.skipif(not native.available(), reason="native runtime not built")
+def test_v2_master_client_worker_keepalive(tmp_path):
+    """worker_name= registers the client in the elastic registry and a
+    daemon heartbeat keeps the lease alive while records stream."""
+    import time
+    from paddle_tpu import v2
+    m = native.TaskMaster(timeout_sec=0.6)
+    port = m.serve(0)
+    p = str(tmp_path / "r.recordio")
+    with native.Writer(p) as w:
+        for j in range(3):
+            w.write(("x%d" % j).encode())
+    c = v2.master.client("127.0.0.1:%d" % port, timeout_sec=0.6,
+                         worker_name="trainer-0")
+    c.set_dataset([p])
+    assert m.worker_count() == 1
+    time.sleep(1.0)  # well past the TTL: the keepalive must have renewed
+    assert m.worker_count() == 1
+    assert len(list(c.records())) == 3
+    c.close()
+    time.sleep(1.0)
+    assert m.worker_count() == 0  # closed client's lease lapses
+    m.close()
