@@ -303,20 +303,49 @@ def depthwise_conv2d(ctx):
     ctx.set_output("Output", out)
 
 
-@register_op("conv2d_transpose")
+def _infer_conv2d_transpose(op, block):
+    xv = block._find_var_recursive(op.input("Input")[0])
+    fv = block._find_var_recursive(op.input("Filter")[0])
+    ov = block._find_var_recursive(op.output("Output")[0])
+    if None in (xv, fv, ov) or xv.shape is None or fv.shape is None:
+        return
+    s = op.attr("strides", [1, 1])
+    p = op.attr("paddings", [0, 0])
+    d = op.attr("dilations", [1, 1])
+    n, _, h, w = xv.shape
+    _, oc, kh, kw = fv.shape
+    ov.shape = (n, oc,
+                (h - 1) * s[0] - 2 * p[0] + (kh - 1) * d[0] + 1,
+                (w - 1) * s[1] - 2 * p[1] + (kw - 1) * d[1] + 1)
+    ov.dtype = xv.dtype
+
+
+@register_op("conv2d_transpose", infer_shape=_infer_conv2d_transpose)
 def conv2d_transpose(ctx):
-    """reference: operators/conv_transpose_op.cc. Filter layout IOHW."""
+    """reference: operators/conv_transpose_op.cc. Filter layout IOHW
+    ([deconv-input channels, num_filters, KH, KW]).
+
+    Lowered as the gradient-of-conv formulation: dilate the input by the
+    stride (lhs_dilation), pad by KH-1-p, and convolve with the spatially
+    flipped filter — output size (H-1)*s - 2p + KH, the reference's deconv
+    contract. (jax.lax.conv_transpose's transpose_kernel path expects the
+    forward-conv kernel layout and mis-shapes under this filter layout.)"""
     x = raw_data(ctx.input("Input"))
     w = raw_data(ctx.input("Filter"))
     s = ctx.attr("strides", [1, 1])
     p = ctx.attr("paddings", [0, 0])
     d = ctx.attr("dilations", [1, 1])
-    out = jax.lax.conv_transpose(
-        x, w, strides=tuple(s),
-        padding=[(p[0], p[0]), (p[1], p[1])],
+    kh, kw = w.shape[2], w.shape[3]
+    keh = (kh - 1) * d[0] + 1  # effective (dilated) kernel extents
+    kew = (kw - 1) * d[1] + 1
+    out = jax.lax.conv_general_dilated(
+        x, jnp.flip(w, (2, 3)),
+        window_strides=(1, 1),
+        padding=[(keh - 1 - p[0], keh - 1 - p[0]),
+                 (kew - 1 - p[1], kew - 1 - p[1])],
+        lhs_dilation=tuple(s),
         rhs_dilation=tuple(d),
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
-        transpose_kernel=True)
+        dimension_numbers=("NCHW", "IOHW", "NCHW"))
     ctx.set_output("Output", out)
 
 

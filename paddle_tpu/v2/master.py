@@ -71,15 +71,16 @@ class client(object):
                         misses = 0
                     except Exception:
                         # transient RPC failure must not silently lapse a
-                        # live worker's lease — retry a few beats first
+                        # live worker's lease: keep retrying (the master
+                        # may be restarting) and warn once so the
+                        # operator can see the flapping
                         misses += 1
-                        if misses >= 3:
+                        if misses == 3:
                             import warnings
                             warnings.warn(
-                                "master keepalive lost after %d attempts; "
-                                "worker lease will lapse" % misses,
+                                "master keepalive failing (%d attempts); "
+                                "retrying each beat" % misses,
                                 RuntimeWarning)
-                            return
                 if self._rpc is not None:
                     try:
                         hb_api.close()
