@@ -78,6 +78,31 @@ def synthetic_notice(mod):
             "field structure matches the reference dataset" % mod)
 
 
+def cluster_files_reader(files_pattern, trainer_count, trainer_id):
+    """Round-robin shard assignment: trainer i reads every file whose sort
+    index % trainer_count == i (reference: v2/dataset/common.py
+    cluster_files_reader — the static-sharding alternative to the
+    fault-tolerant master dispatch). Yields unpickled samples written by
+    ``convert``."""
+    import glob
+    import pickle
+
+    from .. import native
+
+    def reader():
+        files = sorted(glob.glob(files_pattern))
+        if not files:
+            raise IOError("no files match %r" % files_pattern)
+        for i, path in enumerate(files):
+            if i % trainer_count != trainer_id:
+                continue
+            with native.Reader(path) as r:
+                for rec in r:
+                    yield pickle.loads(rec)
+
+    return reader
+
+
 def convert(output_path, reader, line_count, name_prefix):
     """Serialize a reader's samples into sharded native recordio files.
     reference: v2/dataset/common.py convert (reader -> recordio shards the

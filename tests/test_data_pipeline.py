@@ -189,3 +189,29 @@ def test_cifar_real_tar_parsed(tmp_path, monkeypatch):
     np.testing.assert_allclose(
         rows[0][0], batch[b"data"][0].astype(np.float32) / 255.0,
         rtol=1e-6)
+
+
+def test_convert_and_cluster_files_reader(tmp_path):
+    """convert shards a reader into recordio; cluster_files_reader gives
+    each trainer a disjoint round-robin file subset (reference:
+    v2/dataset/common.py convert + cluster_files_reader)."""
+    from paddle_tpu import native
+    if not native.available():
+        import pytest as _pytest
+        _pytest.skip("native runtime not built")
+    from paddle_tpu.dataset import common
+
+    def reader():
+        for i in range(10):
+            yield (i, np.float32(i) * 2.0)
+
+    paths = common.convert(str(tmp_path), reader, line_count=3,
+                           name_prefix="part")
+    assert len(paths) == 4  # 3+3+3+1
+    r0 = common.cluster_files_reader(str(tmp_path / "part-*.rio"), 2, 0)
+    r1 = common.cluster_files_reader(str(tmp_path / "part-*.rio"), 2, 1)
+    s0 = list(r0())
+    s1 = list(r1())
+    assert len(s0) + len(s1) == 10
+    assert {x[0] for x in s0} | {x[0] for x in s1} == set(range(10))
+    assert {x[0] for x in s0} & {x[0] for x in s1} == set()
