@@ -25,34 +25,20 @@ def _provision(n=8):
         import jax
         jax.config.update("jax_platforms", "cpu")
         return jax
-    import threading
-
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo_root)
     import jax
-
-    probe = {"n": 0}
-
-    def _probe():
-        try:
-            probe["n"] = len(jax.devices())
-        except Exception:
-            probe["n"] = 0
-
-    t = threading.Thread(target=_probe, daemon=True)
-    t.start()
-    t.join(15.0)
-    if probe["n"] >= n:
+    from paddle_tpu.parallel.env import cpu_mesh_env, probe_device_count
+    if probe_device_count(20.0) >= n:
         return jax
     import subprocess
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
-                        + " --xla_force_host_platform_device_count=%d" % n)
+    env = cpu_mesh_env(n)
     # scripts put their own dir on sys.path, not the repo root
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-    raise SystemExit(subprocess.run(
+    proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--cpu-mesh"],
-        env=env, cwd=repo_root).returncode)
+        env=env, cwd=repo_root, timeout=540)
+    raise SystemExit(proc.returncode)
 
 
 def main():

@@ -61,11 +61,14 @@ def precision_recall(ctx):
     prec = tp / jnp.maximum(tp + fp, 1e-6)
     rec = tp / jnp.maximum(tp + fn, 1e-6)
     f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-6)
+    micro_p = jnp.sum(tp) / jnp.maximum(jnp.sum(tp + fp), 1e-6)
+    micro_r = jnp.sum(tp) / jnp.maximum(jnp.sum(tp + fn), 1e-6)
+    micro_f1 = 2 * micro_p * micro_r / jnp.maximum(micro_p + micro_r, 1e-6)
+    # slots: macro P/R/F1 then micro P/R/F1
+    # (reference: operators/precision_recall_op.h BatchMetrics layout)
     ctx.set_output("BatchMetrics",
                    jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1),
-                              jnp.sum(tp) / jnp.maximum(jnp.sum(tp + fp), 1e-6),
-                              jnp.sum(tp) / jnp.maximum(jnp.sum(tp + fn), 1e-6),
-                              jnp.zeros(())]))
+                              micro_p, micro_r, micro_f1]))
 
 
 @register_op("edit_distance", no_gradient=True)

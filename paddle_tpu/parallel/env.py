@@ -40,3 +40,37 @@ def get_world_size() -> int:
 
 def get_rank() -> int:
     return jax.process_index()
+
+
+def probe_device_count(deadline_sec=20.0) -> int:
+    """Device count, or 0 if backend init doesn't answer within the
+    deadline. Backend discovery can block indefinitely on a dead tunnelled
+    accelerator, so the probe runs on a daemon thread — callers
+    (dryrun_multichip, examples/pipeline_demo) fall back to a virtual CPU
+    mesh in a FRESH subprocess when this returns too few devices (a hung
+    in-process init cannot be recovered)."""
+    import threading
+
+    result = {"n": 0}
+
+    def _probe():
+        try:
+            result["n"] = len(jax.devices())
+        except Exception:
+            result["n"] = 0
+
+    t = threading.Thread(target=_probe, daemon=True)
+    t.start()
+    t.join(deadline_sec)
+    return result["n"]
+
+
+def cpu_mesh_env(n, base_env=None):
+    """Environment dict for re-exec'ing a child onto an n-device virtual
+    CPU mesh (JAX_PLATFORMS + xla_force_host_platform_device_count)."""
+    env = dict(base_env if base_env is not None else os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=%d"
+                        % int(n))
+    return env
