@@ -1114,13 +1114,14 @@ _fn = np.asarray([0.0, 1.0, 1.0])
 _prec = _tp / np.maximum(_tp + _fp, 1e-6)
 _rec = _tp / np.maximum(_tp + _fn, 1e-6)
 _f1 = 2 * _prec * _rec / np.maximum(_prec + _rec, 1e-6)
+_mp = _tp.sum() / (_tp + _fp).sum()
+_mr = _tp.sum() / (_tp + _fn).sum()
 case("precision_recall", "precision_recall",
      inputs={"MaxProbs": _u(104, 5, 1), "Indices": _pr_idx,
              "Labels": _pr_lab},
      outputs={"BatchMetrics": np.asarray(
-         [_prec.mean(), _rec.mean(), _f1.mean(),
-          _tp.sum() / (_tp + _fp).sum(), _tp.sum() / (_tp + _fn).sum(),
-          2 * 0.6 * 0.6 / 1.2], np.float32)},  # micro-F1
+         [_prec.mean(), _rec.mean(), _f1.mean(), _mp, _mr,
+          2 * _mp * _mr / (_mp + _mr)], np.float32)},
      attrs={"class_number": 3}, atol=1e-5)
 
 
@@ -1173,3 +1174,90 @@ def test_coverage():
     ops = {c[1] for c in CASES}
     assert len(ops) >= 125, "op contract coverage %d < 125: %s" % (
         len(ops), sorted(ops))
+
+
+# ---------------------------------------------------------------------------
+# random ops: property tests (shape/dtype/moments/determinism) — the OpTest
+# exact-value harness doesn't apply (reference: test_uniform_random_op /
+# test_gaussian_random_op also assert moments, not values)
+# ---------------------------------------------------------------------------
+
+def _run_random(op_type, attrs):
+    import paddle_tpu as pt
+    main, startup = pt.Program(), pt.Program()
+    pt.switch_main_program(main)
+    pt.switch_startup_program(startup)
+    main.random_seed = 7
+    blk = main.global_block()
+    blk.create_var(name="r_out", shape=None, dtype="float32")
+    blk.append_op(type=op_type, inputs={}, outputs={"Out": ["r_out"]},
+                  attrs=attrs)
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        a, = exe.run(main, feed={}, fetch_list=["r_out"])
+    with pt.scope_guard(pt.Scope()):
+        b, = exe.run(main, feed={}, fetch_list=["r_out"])
+    return np.asarray(a), np.asarray(b)
+
+
+def test_uniform_random_properties():
+    a, b = _run_random("uniform_random",
+                       {"shape": [512, 8], "min": -2.0, "max": 3.0})
+    assert a.shape == (512, 8)
+    assert a.min() >= -2.0 and a.max() <= 3.0
+    assert abs(a.mean() - 0.5) < 0.15  # mean of U(-2,3)
+    np.testing.assert_array_equal(a, b)  # seeded: deterministic re-run
+
+
+def test_gaussian_random_properties():
+    a, b = _run_random("gaussian_random",
+                       {"shape": [2048, 4], "mean": 1.5, "std": 0.5})
+    assert a.shape == (2048, 4)
+    assert abs(a.mean() - 1.5) < 0.05
+    assert abs(a.std() - 0.5) < 0.05
+    np.testing.assert_array_equal(a, b)
+
+
+def test_truncated_gaussian_random_properties():
+    a, _ = _run_random("truncated_gaussian_random",
+                       {"shape": [2048, 4], "mean": 0.0, "std": 1.0})
+    # truncation at 2 std (reference: truncated_gaussian_random_op.cc)
+    assert np.abs(a).max() <= 2.0 + 1e-5
+    assert abs(a.mean()) < 0.08
+
+
+def test_prior_box_minimal_config():
+    """One min_size, ar=[1], no flip/max: one prior per cell centered at
+    ((i+offset)*step)/img with extent min_size (reference:
+    operators/prior_box_op.h)."""
+    import paddle_tpu as pt
+    main, startup = pt.Program(), pt.Program()
+    pt.switch_main_program(main)
+    pt.switch_startup_program(startup)
+    blk = main.global_block()
+    feat = np.zeros((1, 8, 2, 2), np.float32)
+    img = np.zeros((1, 3, 8, 8), np.float32)
+    for nm, arr in (("pb_in", feat), ("pb_img", img)):
+        blk.create_var(name=nm, shape=arr.shape, dtype="float32")
+    for nm in ("pb_boxes", "pb_var"):
+        blk.create_var(name=nm, shape=None, dtype="float32")
+    blk.append_op(type="prior_box",
+                  inputs={"Input": ["pb_in"], "Image": ["pb_img"]},
+                  outputs={"Boxes": ["pb_boxes"], "Variances": ["pb_var"]},
+                  attrs={"min_sizes": [4.0], "aspect_ratios": [1.0],
+                         "variances": [0.1, 0.1, 0.2, 0.2],
+                         "offset": 0.5})
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        boxes, var = exe.run(main, feed={"pb_in": feat, "pb_img": img},
+                             fetch_list=["pb_boxes", "pb_var"])
+    boxes = np.asarray(boxes)
+    assert boxes.shape == (2, 2, 1, 4)
+    # cell (0,0): center (0.5*4, 0.5*4)=(2,2); box (2±2)/8
+    np.testing.assert_allclose(boxes[0, 0, 0], [0.0, 0.0, 0.5, 0.5],
+                               atol=1e-6)
+    # cell (1,1): center (6,6); box (6±2)/8
+    np.testing.assert_allclose(boxes[1, 1, 0], [0.5, 0.5, 1.0, 1.0],
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(var)[0, 0, 0],
+                               [0.1, 0.1, 0.2, 0.2], atol=1e-6)
