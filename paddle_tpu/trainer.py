@@ -76,10 +76,18 @@ class Trainer(object):
         self.exe.run(self.startup_program)
         if self.checkpoint_dir and os.path.isdir(self.checkpoint_dir) and \
                 os.listdir(self.checkpoint_dir):
-            # resume = load persistables (optimizer accumulators included;
-            # reference: io.py save_persistables semantics)
-            _io.load_persistables(self.exe, self.checkpoint_dir,
-                                  main_program=self.main_program)
+            from . import checkpoint as _ckpt
+            if _ckpt._is_complete(self.checkpoint_dir):
+                # manifest/shard layout written by save_checkpoint(
+                # sharded=True or async_=True)
+                _ckpt.load_checkpoint(
+                    self.checkpoint_dir, self.main_program,
+                    dist_context=self.exe.dist_context)
+            else:
+                # resume = load persistables (optimizer accumulators
+                # included; reference: io.py save_persistables semantics)
+                _io.load_persistables(self.exe, self.checkpoint_dir,
+                                      main_program=self.main_program)
         self._initialized = True
 
     def train(self, reader, num_passes=1, event_handler=None):
@@ -144,8 +152,16 @@ class Trainer(object):
             n += 1
         return [a / max(n, 1) for a in (acc or [])]
 
-    def save_checkpoint(self, dirname=None):
+    def save_checkpoint(self, dirname=None, sharded=False, async_=False):
+        """Default: save/load-op persistables (reference io.py semantics).
+        ``sharded``/``async_`` route through paddle_tpu.checkpoint —
+        per-shard files under a mesh, background write, atomic + marker
+        (the Go pserver checkpoint role)."""
         dirname = dirname or self.checkpoint_dir
+        if sharded or async_:
+            from . import checkpoint as _ckpt
+            return _ckpt.save_checkpoint(dirname, self.main_program,
+                                         async_=async_)
         os.makedirs(dirname, exist_ok=True)
         _io.save_persistables(self.exe, dirname,
                               main_program=self.main_program)
