@@ -53,14 +53,18 @@ def _snapshot(scope, var_names):
             continue
         if isinstance(v, jax.Array) and hasattr(v, "addressable_shards") \
                 and len(v.addressable_shards) > 1:
-            shards = []
-            for i, sh in enumerate(v.addressable_shards):
+            shards, seen = [], set()
+            for sh in v.addressable_shards:
                 idx = []
                 for dim, sl in enumerate(sh.index):
                     start = 0 if sl.start is None else int(sl.start)
                     stop = (v.shape[dim] if sl.stop is None
                             else int(sl.stop))
                     idx.append([start, stop])
+                key = tuple(map(tuple, idx))
+                if key in seen:
+                    continue  # replicas: one copy per distinct index range
+                seen.add(key)
                 shards.append({"index": idx,
                                "data": np.asarray(sh.data)})
             entries[name] = {"shape": list(v.shape),
@@ -96,9 +100,16 @@ def _write(dirname, entries, step):
     # marker LAST: its presence certifies every byte above it
     with open(os.path.join(tmp, _COMPLETE), "w") as f:
         json.dump({"step": step, "sizes": sizes}, f)
+    # never delete the old GOOD checkpoint before the new one is in place:
+    # move it aside, swap, then drop the aside copy
+    aside = dirname + ".old"
+    if os.path.exists(aside):
+        shutil.rmtree(aside)
     if os.path.exists(dirname):
-        shutil.rmtree(dirname)
+        os.replace(dirname, aside)
     os.replace(tmp, dirname)
+    if os.path.exists(aside):
+        shutil.rmtree(aside)
 
 
 class AsyncCheckpoint(object):
@@ -170,7 +181,8 @@ def latest_checkpoint(root):
     if not os.path.isdir(root):
         return None
     cands = [os.path.join(root, d) for d in os.listdir(root)
-             if os.path.isdir(os.path.join(root, d))]
+             if os.path.isdir(os.path.join(root, d))
+             and not d.endswith((".tmp", ".old"))]
     cands = [d for d in cands if _is_complete(d)]
     return max(cands, key=os.path.getmtime) if cands else None
 

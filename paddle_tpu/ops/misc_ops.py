@@ -290,3 +290,51 @@ def custom_dist_random_int(ctx):
     u = jax.random.uniform(key, tuple(shape))
     out = jnp.searchsorted(cdf, u).astype(jnp.int64)
     ctx.set_output("Out", jnp.clip(out, 0, probs.shape[0] - 1))
+
+
+@register_op("bilinear_interp")
+def bilinear_interp(ctx):
+    """Bilinear resize of [N,C,H,W] feature maps with the reference's
+    align-corners ratio (reference: operators/bilinear_interp_op.cc,
+    gserver/layers/BilinearInterpLayer.cpp)."""
+    x = raw_data(ctx.input("X"))
+    oh = int(ctx.attr("out_h"))
+    ow = int(ctx.attr("out_w"))
+    N, C, H, W = x.shape
+    rh = (H - 1.0) / (oh - 1.0) if oh > 1 else 0.0
+    rw = (W - 1.0) / (ow - 1.0) if ow > 1 else 0.0
+    ys = jnp.arange(oh, dtype=jnp.float32) * rh
+    xs = jnp.arange(ow, dtype=jnp.float32) * rw
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, H - 1)
+    x1 = jnp.minimum(x0 + 1, W - 1)
+    wy = (ys - y0.astype(jnp.float32))[:, None]
+    wx = (xs - x0.astype(jnp.float32))[None, :]
+    tl = x[:, :, y0[:, None], x0[None, :]]
+    tr = x[:, :, y0[:, None], x1[None, :]]
+    bl = x[:, :, y1[:, None], x0[None, :]]
+    br = x[:, :, y1[:, None], x1[None, :]]
+    out = (tl * (1 - wy) * (1 - wx) + tr * (1 - wy) * wx
+           + bl * wy * (1 - wx) + br * wy * wx)
+    ctx.set_output("Out", out)
+
+
+@register_op("conv_shift")
+def conv_shift(ctx):
+    """Circular row-wise correlation: Out[i, j] = sum_k X[i, (j + k - M//2)
+    mod N] * Y[i, k] (reference: operators/conv_shift_op.cc,
+    gserver/layers/ConvShiftLayer.cpp; Y width M must be odd)."""
+    x = raw_data(ctx.input("X"))     # [B, N]
+    y = raw_data(ctx.input("Y"))     # [B, M]
+    M = y.shape[1]
+    if M % 2 != 1:
+        raise ValueError(
+            "conv_shift: Y width must be odd (got %d) so the kernel has a "
+            "center (reference conv_shift_op enforces this)" % M)
+    half = M // 2
+    out = None
+    for k in range(M):
+        t = jnp.roll(x, half - k, axis=1) * y[:, k:k + 1]
+        out = t if out is None else out + t
+    ctx.set_output("Out", out)

@@ -152,17 +152,25 @@ class Trainer(object):
             n += 1
         return [a / max(n, 1) for a in (acc or [])]
 
-    def save_checkpoint(self, dirname=None, sharded=False, async_=False):
+    def save_checkpoint(self, dirname=None, sharded=False, async_=False,
+                        step=None):
         """Default: save/load-op persistables (reference io.py semantics).
         ``sharded``/``async_`` route through paddle_tpu.checkpoint —
         per-shard files under a mesh, background write, atomic + marker
         (the Go pserver checkpoint role)."""
         dirname = dirname or self.checkpoint_dir
+        from . import checkpoint as _ckpt
         if sharded or async_:
-            from . import checkpoint as _ckpt
             return _ckpt.save_checkpoint(dirname, self.main_program,
-                                         async_=async_)
+                                         step=step, async_=async_)
         os.makedirs(dirname, exist_ok=True)
+        # a stale manifest in the same dir would shadow this newer
+        # persistables save on resume (_maybe_init prefers the manifest
+        # layout); retire it
+        for fn in (_ckpt._COMPLETE, _ckpt._MANIFEST):
+            p = os.path.join(dirname, fn)
+            if os.path.exists(p):
+                os.remove(p)
         _io.save_persistables(self.exe, dirname,
                               main_program=self.main_program)
 

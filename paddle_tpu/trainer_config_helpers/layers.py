@@ -28,7 +28,11 @@ __all__ = [
     "context_projection", "dotmul_projection", "scaling_projection",
     "dotmul_operator", "conv_projection", "conv_operator",
     "recurrent_group", "memory", "beam_search", "StaticInput",
-    "GeneratedInput", "outputs",
+    "GeneratedInput", "cos_sim", "interpolation_layer",
+    "sum_to_one_norm_layer", "slope_intercept_layer", "power_layer",
+    "scaling_layer", "linear_comb_layer", "trans_layer", "repeat_layer",
+    "expand_layer", "seq_reshape_layer", "bilinear_interp_layer",
+    "conv_shift_layer", "block_expand_layer", "maxout_layer", "outputs",
     "get_output_layers",
 ]
 
@@ -770,3 +774,153 @@ def beam_search(step, input, bos_id, eos_id, beam_size,
     ids, scores = pd.beam_search_decode(ids=ids_array, scores=scores_array)
     return (LayerOutput(name, ids, size=1),
             LayerOutput(None, scores, size=1))
+
+
+# ---------------------------------------------------------------------------
+# v1 layer tail: elementwise/arithmetic/shape layers
+# (reference: trainer_config_helpers/layers.py cos_sim, interpolation_layer,
+#  linear_comb_layer, sum_to_one_norm_layer, slope_intercept_layer,
+#  power_layer, scaling_layer, trans_layer, repeat_layer, expand_layer,
+#  seq_reshape_layer, bilinear_interp_layer, conv_shift_layer,
+#  block_expand_layer, maxout_layer)
+
+def _append_simple(op_type, inputs, attrs, out_dtype="float32",
+                   lod_level=0):
+    from ..layers.layer_helper import LayerHelper
+    helper = LayerHelper(op_type)
+    out = helper.create_variable_for_type_inference(dtype=out_dtype)
+    out.lod_level = lod_level
+    helper.append_op(type=op_type, inputs=inputs,
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def cos_sim(a, b, scale=1.0, size=1, name=None):
+    """reference: layers.py cos_sim (CosSimLayer)."""
+    out = F.cos_sim(a.var, b.var)
+    if scale != 1.0:
+        out = F.scale(out, scale=scale)
+    return LayerOutput(name, out, size=1)
+
+
+def interpolation_layer(input, weight, name=None):
+    """out = w*a + (1-w)*b with per-sample scalar weight
+    (reference: InterpolationLayer)."""
+    a, b = input
+    wa = F.elementwise_mul(a.var, weight.var)
+    one_minus = F.scale(weight.var, scale=-1.0, bias=1.0)
+    wb = F.elementwise_mul(b.var, one_minus)
+    return LayerOutput(name, F.elementwise_add(wa, wb), size=a.size)
+
+
+def sum_to_one_norm_layer(input, name=None):
+    """Row-normalize to sum 1 (reference: SumToOneNormLayer)."""
+    s = F.reduce_sum(input.var, dim=1, keep_dim=True)
+    return LayerOutput(name, F.elementwise_div(input.var, s),
+                       size=input.size)
+
+
+def slope_intercept_layer(input, slope=1.0, intercept=0.0, name=None):
+    """a*x + b (reference: SlopeInterceptLayer)."""
+    return LayerOutput(name, F.scale(input.var, scale=slope,
+                                     bias=intercept), size=input.size)
+
+
+def power_layer(input, weight, name=None):
+    """x ** w with per-sample scalar exponent (reference: PowerLayer) —
+    a real pow, defined for non-positive inputs (exp(w*log x) is not)."""
+    out = _append_simple("elementwise_pow",
+                         {"X": [input.var], "Y": [weight.var]}, {})
+    return LayerOutput(name, out, size=input.size)
+
+
+def scaling_layer(input, weight, name=None):
+    """Per-sample scalar times the row (reference: ScalingLayer — weight
+    is a [N, 1] layer, unlike scaling_projection's parameter)."""
+    return LayerOutput(name, F.elementwise_mul(input.var, weight.var),
+                       size=input.size)
+
+
+def linear_comb_layer(weights, vectors, size, name=None):
+    """out[n] = sum_i w[n,i] * vec[n, i*size:(i+1)*size]
+    (reference: LinearCombinationLayer/convex_comb_layer)."""
+    n_groups = vectors.size // size
+    vecs = F.reshape(vectors.var, shape=[0, n_groups, size])
+    w = F.reshape(weights.var, shape=[0, n_groups, 1])
+    out = F.reduce_sum(F.elementwise_mul(vecs, w), dim=1)
+    return LayerOutput(name, out, size=size)
+
+
+def trans_layer(input, name=None):
+    """Transpose the [H, W]-shaped feature matrix (reference: TransLayer,
+    whole-matrix transpose: batch is the matrix height)."""
+    return LayerOutput(name, F.transpose(input.var, perm=[1, 0]),
+                       size=input.size)
+
+
+def repeat_layer(input, num_repeats, name=None):
+    """Tile the feature vector num_repeats times
+    (reference: FeatureMapExpandLayer/repeat_layer)."""
+    return LayerOutput(name, F.expand(input.var,
+                                      expand_times=[1, num_repeats]),
+                       size=input.size * num_repeats)
+
+
+def expand_layer(input, expand_as, expand_level=0, name=None):
+    """Expand per-sequence rows to match expand_as's lod
+    (reference: ExpandLayer -> fluid sequence_expand)."""
+    if expand_level != 0:
+        raise NotImplementedError(
+            "expand_level=%r: only element-level expansion is mapped"
+            % expand_level)
+    return LayerOutput(name, F.sequence_expand(input.var, expand_as.var),
+                       size=input.size)
+
+
+def seq_reshape_layer(input, reshape_size, name=None):
+    """reference: SequenceReshapeLayer -> fluid sequence_reshape."""
+    return LayerOutput(name, F.sequence_reshape(input.var, reshape_size),
+                       size=reshape_size)
+
+
+def bilinear_interp_layer(input, out_size_x, out_size_y, num_channels=None,
+                          name=None):
+    """reference: BilinearInterpLayer (gserver) / bilinear_interp op."""
+    img = _as_image(input, num_channels)
+    var, c, h, w = img
+    out = _append_simple("bilinear_interp", {"X": [var]},
+                         {"out_h": int(out_size_y),
+                          "out_w": int(out_size_x)})
+    lo = LayerOutput(name, F.reshape(out, shape=[0, -1]),
+                     size=c * out_size_x * out_size_y)
+    lo.channels, lo.height, lo.width = c, out_size_y, out_size_x
+    return lo
+
+
+def conv_shift_layer(a, b, name=None):
+    """Circular correlation of each row of a with the (odd-width) row of b
+    (reference: ConvShiftLayer)."""
+    out = _append_simple("conv_shift", {"X": [a.var], "Y": [b.var]}, {})
+    return LayerOutput(name, out, size=a.size)
+
+
+def block_expand_layer(input, block_x, block_y, stride_x=1, stride_y=1,
+                       padding_x=0, padding_y=0, num_channels=None,
+                       name=None):
+    """Image -> sequence of patch rows (reference: BlockExpandLayer ->
+    fluid im2sequence)."""
+    var, c, h, w = _as_image(input, num_channels)
+    out = F.im2sequence(var, filter_size=[block_y, block_x],
+                        stride=[stride_y, stride_x],
+                        padding=[padding_y, padding_x])
+    return LayerOutput(name, out, size=c * block_x * block_y)
+
+
+def maxout_layer(input, groups, num_channels=None, name=None):
+    """reference: MaxOutLayer -> fluid maxout op."""
+    var, c, h, w = _as_image(input, num_channels)
+    out = _append_simple("maxout", {"X": [var]}, {"groups": groups})
+    lo = LayerOutput(name, F.reshape(out, shape=[0, -1]),
+                     size=(c // groups) * h * w)
+    lo.channels, lo.height, lo.width = c // groups, h, w
+    return lo

@@ -620,3 +620,88 @@ def test_v2_master_client_worker_keepalive(tmp_path):
     time.sleep(1.0)
     assert m.worker_count() == 0  # closed client's lease lapses
     m.close()
+
+
+def test_v1_layer_tail_elementwise_batch():
+    """cos_sim / interpolation / sum_to_one_norm / slope_intercept /
+    power / scaling / linear_comb / trans / repeat (reference
+    trainer_config_helpers layer tail), checked against numpy."""
+    from paddle_tpu import trainer_config_helpers as tch
+    main, startup = _fresh()
+    a = tch.data_layer("a", size=6)
+    b = tch.data_layer("b", size=6)
+    w = tch.data_layer("w", size=1)
+    outs = {
+        "cos": tch.cos_sim(a, b),
+        "interp": tch.interpolation_layer([a, b], w),
+        "s1n": tch.sum_to_one_norm_layer(a),
+        "slope": tch.slope_intercept_layer(a, slope=2.0, intercept=1.0),
+        "power": tch.power_layer(a, w),
+        "scaling": tch.scaling_layer(a, w),
+        "lincomb": tch.linear_comb_layer(tch.data_layer("lw", size=2),
+                                         tch.data_layer("lv", size=6),
+                                         size=3),
+        "rep": tch.repeat_layer(a, 2),
+    }
+    rng = np.random.RandomState(0)
+    av = rng.rand(3, 6).astype("float32") + 0.2
+    bv = rng.rand(3, 6).astype("float32") + 0.2
+    wv = rng.rand(3, 1).astype("float32")
+    lwv = rng.rand(3, 2).astype("float32")
+    lvv = rng.rand(3, 6).astype("float32")
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        vals = exe.run(main, feed={"a": av, "b": bv, "w": wv,
+                                   "lw": lwv, "lv": lvv},
+                       fetch_list=[o.var for o in outs.values()])
+    got = dict(zip(outs, [np.asarray(v) for v in vals]))
+    cos_want = (av * bv).sum(1) / (np.linalg.norm(av, axis=1)
+                                   * np.linalg.norm(bv, axis=1))
+    np.testing.assert_allclose(got["cos"].reshape(-1), cos_want, rtol=1e-5)
+    np.testing.assert_allclose(got["interp"], wv * av + (1 - wv) * bv,
+                               rtol=1e-5)
+    np.testing.assert_allclose(got["s1n"],
+                               av / av.sum(1, keepdims=True), rtol=1e-5)
+    np.testing.assert_allclose(got["slope"], 2 * av + 1, rtol=1e-5)
+    np.testing.assert_allclose(got["power"], av ** wv, rtol=1e-4)
+    np.testing.assert_allclose(got["scaling"], av * wv, rtol=1e-5)
+    lin_want = (lvv.reshape(3, 2, 3) * lwv[:, :, None]).sum(1)
+    np.testing.assert_allclose(got["lincomb"], lin_want, rtol=1e-5)
+    np.testing.assert_allclose(got["rep"], np.tile(av, (1, 2)), rtol=1e-6)
+
+
+def test_v1_layer_tail_image_and_shift():
+    """bilinear_interp / conv_shift / block_expand / maxout layers."""
+    from paddle_tpu import trainer_config_helpers as tch
+    main, startup = _fresh()
+    img = tch.data_layer("img", size=2 * 4 * 4, height=4, width=4)
+    up = tch.bilinear_interp_layer(img, out_size_x=8, out_size_y=8)
+    assert up.size == 2 * 8 * 8 and up.height == 8
+    be = tch.block_expand_layer(img, block_x=2, block_y=2,
+                                stride_x=2, stride_y=2)
+    assert be.size == 2 * 2 * 2
+    mo = tch.maxout_layer(img, groups=2)
+    assert mo.size == 1 * 4 * 4
+    xa = tch.data_layer("xa", size=5)
+    xb = tch.data_layer("xb", size=3)
+    cs = tch.conv_shift_layer(xa, xb)
+    rng = np.random.RandomState(1)
+    feed = {"img": rng.rand(2, 32).astype("float32"),
+            "xa": rng.rand(2, 5).astype("float32"),
+            "xb": rng.rand(2, 3).astype("float32")}
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        u, b_, m, c = exe.run(main, feed=feed,
+                              fetch_list=[up.var, be.var, mo.var, cs.var])
+    assert np.asarray(u).shape == (2, 128)
+    assert np.asarray(b_).shape == (8, 8)  # 2 imgs x 4 patches, C*2*2
+    assert np.asarray(m).shape == (2, 16)
+    want = np.zeros((2, 5), np.float32)
+    for i in range(2):
+        for j in range(5):
+            for k in range(3):
+                want[i, j] += feed["xa"][i, (j + k - 1) % 5] \
+                    * feed["xb"][i, k]
+    np.testing.assert_allclose(np.asarray(c), want, rtol=1e-5)
