@@ -1,0 +1,106 @@
+"""Image preprocessing utilities (reference: python/paddle/v2/image.py —
+load/resize/crop/flip/normalize helpers the v2 image pipelines compose,
+there via cv2; here pure numpy with bilinear resampling so the pipeline
+has zero native deps).
+
+Array convention matches the reference: HWC uint8/float in, ``to_chw``
+transposes for the NCHW model stack, ``simple_transform`` is the standard
+train/test path (resize short side -> crop -> optional flip -> CHW ->
+normalize).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["resize_short", "center_crop", "random_crop",
+           "left_right_flip", "to_chw", "simple_transform",
+           "batch_images"]
+
+
+def _bilinear_resize(im, oh, ow):
+    h, w = im.shape[:2]
+    if (h, w) == (oh, ow):
+        return im.astype(np.float32)
+    ys = np.linspace(0, h - 1, oh)
+    xs = np.linspace(0, w - 1, ow)
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    im = im.astype(np.float32)
+    if im.ndim == 2:
+        im = im[:, :, None]
+        squeeze = True
+    else:
+        squeeze = False
+    out = (im[y0[:, None], x0[None, :]] * (1 - wy) * (1 - wx)
+           + im[y0[:, None], x1[None, :]] * (1 - wy) * wx
+           + im[y1[:, None], x0[None, :]] * wy * (1 - wx)
+           + im[y1[:, None], x1[None, :]] * wy * wx)
+    return out[:, :, 0] if squeeze else out
+
+
+def resize_short(im, size):
+    """Scale so the SHORT side equals ``size`` (reference: resize_short)."""
+    h, w = im.shape[:2]
+    if h < w:
+        oh, ow = size, int(round(w * size / float(h)))
+    else:
+        oh, ow = int(round(h * size / float(w))), size
+    return _bilinear_resize(im, oh, ow)
+
+
+def center_crop(im, size):
+    """reference: center_crop — square center window."""
+    h, w = im.shape[:2]
+    y = (h - size) // 2
+    x = (w - size) // 2
+    return im[y:y + size, x:x + size]
+
+
+def random_crop(im, size, rng=None):
+    """reference: random_crop."""
+    rng = rng or np.random
+    h, w = im.shape[:2]
+    y = rng.randint(0, h - size + 1)
+    x = rng.randint(0, w - size + 1)
+    return im[y:y + size, x:x + size]
+
+
+def left_right_flip(im):
+    """reference: left_right_flip (horizontal mirror)."""
+    return im[:, ::-1]
+
+
+def to_chw(im, order=(2, 0, 1)):
+    """HWC -> CHW (reference: to_chw)."""
+    return np.transpose(im, order)
+
+
+def simple_transform(im, resize_size, crop_size, is_train,
+                     mean=None, scale=1.0, rng=None):
+    """The standard pipeline (reference: simple_transform): resize short
+    side, random-crop+maybe-flip when training else center-crop, CHW,
+    subtract mean (scalar, per-channel, or full map), scale."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        rng = rng or np.random
+        im = random_crop(im, crop_size, rng=rng)
+        if rng.randint(0, 2):
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size)
+    im = to_chw(im).astype(np.float32)
+    if mean is not None:
+        mean = np.asarray(mean, np.float32)
+        if mean.ndim == 1:
+            mean = mean[:, None, None]  # per-channel
+        im = im - mean
+    return im * scale
+
+
+def batch_images(ims):
+    """Stack a list of CHW images into [N, C, H, W] float32."""
+    return np.stack([np.asarray(i, np.float32) for i in ims])

@@ -705,3 +705,30 @@ def test_v1_layer_tail_image_and_shift():
                 want[i, j] += feed["xa"][i, (j + k - 1) % 5] \
                     * feed["xb"][i, k]
     np.testing.assert_allclose(np.asarray(c), want, rtol=1e-5)
+
+
+def test_v2_image_transforms():
+    """resize_short/center_crop/flip/to_chw/simple_transform (reference:
+    python/paddle/v2/image.py) — numpy semantics checks."""
+    from paddle_tpu.v2 import image as I
+    rng = np.random.RandomState(0)
+    im = rng.randint(0, 255, (40, 60, 3)).astype(np.uint8)
+    r = I.resize_short(im, 20)
+    assert r.shape == (20, 30, 3)  # short side 40 -> 20, aspect kept
+    c = I.center_crop(r, 16)
+    assert c.shape == (16, 16, 3)
+    f = I.left_right_flip(c)
+    np.testing.assert_allclose(f[:, 0], c[:, -1])
+    chw = I.to_chw(c)
+    assert chw.shape == (3, 16, 16)
+    # identity resize is exact
+    np.testing.assert_allclose(I.resize_short(im[:32, :32], 32),
+                               im[:32, :32].astype(np.float32))
+    t = I.simple_transform(im, 24, 16, is_train=False,
+                           mean=[1.0, 2.0, 3.0], scale=0.5)
+    assert t.shape == (3, 16, 16) and t.dtype == np.float32
+    t2 = I.simple_transform(im, 24, 16, is_train=True,
+                            rng=np.random.RandomState(1))
+    assert t2.shape == (3, 16, 16)
+    b = I.batch_images([t, t2])
+    assert b.shape == (2, 3, 16, 16)
