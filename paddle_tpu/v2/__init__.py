@@ -19,6 +19,7 @@ from . import optimizer           # noqa: F401
 from . import parameters          # noqa: F401
 from . import plot                # noqa: F401
 from . import master              # noqa: F401
+from . import image               # noqa: F401
 from . import pooling             # noqa: F401
 from . import topology            # noqa: F401
 from .minibatch import batch      # noqa: F401

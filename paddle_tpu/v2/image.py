@@ -52,8 +52,17 @@ def resize_short(im, size):
     return _bilinear_resize(im, oh, ow)
 
 
+def _check_crop(im, size):
+    h, w = im.shape[:2]
+    if size > h or size > w:
+        raise ValueError(
+            "crop size %d exceeds image %dx%d — resize_short to >= crop "
+            "size first" % (size, h, w))
+
+
 def center_crop(im, size):
     """reference: center_crop — square center window."""
+    _check_crop(im, size)
     h, w = im.shape[:2]
     y = (h - size) // 2
     x = (w - size) // 2
@@ -62,6 +71,7 @@ def center_crop(im, size):
 
 def random_crop(im, size, rng=None):
     """reference: random_crop."""
+    _check_crop(im, size)
     rng = rng or np.random
     h, w = im.shape[:2]
     y = rng.randint(0, h - size + 1)
@@ -92,6 +102,8 @@ def simple_transform(im, resize_size, crop_size, is_train,
             im = left_right_flip(im)
     else:
         im = center_crop(im, crop_size)
+    if im.ndim == 2:
+        im = im[:, :, None]  # grayscale: 1-channel CHW
     im = to_chw(im).astype(np.float32)
     if mean is not None:
         mean = np.asarray(mean, np.float32)

@@ -732,3 +732,16 @@ def test_v2_image_transforms():
     assert t2.shape == (3, 16, 16)
     b = I.batch_images([t, t2])
     assert b.shape == (2, 3, 16, 16)
+
+
+def test_v2_image_grayscale_and_crop_validation():
+    from paddle_tpu import v2
+    rng = np.random.RandomState(2)
+    gray = rng.randint(0, 255, (30, 40)).astype(np.uint8)
+    t = v2.image.simple_transform(gray, 24, 16, is_train=False)
+    assert t.shape == (1, 16, 16)
+    with pytest.raises(ValueError):
+        v2.image.center_crop(gray, 64)
+    with pytest.raises(ValueError):
+        v2.image.random_crop(gray, 64)
+    assert hasattr(v2, "image")  # facade attribute
