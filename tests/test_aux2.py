@@ -233,3 +233,43 @@ def test_lowering_error_names_the_op():
         except Exception as e:
             notes = "".join(getattr(e, "__notes__", []))
             assert "elementwise_add" in notes, notes
+
+
+def test_memory_optimized_model_matches_unoptimized():
+    """The book_memory_optimization tier contract (reference:
+    tests/book_memory_optimization/): the same model with
+    memory_optimize applied trains to IDENTICAL losses — remat +
+    buffer-reuse must not change numerics."""
+    from paddle_tpu import layers
+
+    def run(optimize):
+        from paddle_tpu.core import unique_name
+        unique_name._counters.clear()
+        main, startup = fluid.Program(), fluid.Program()
+        fluid.switch_main_program(main)
+        fluid.switch_startup_program(startup)
+        img = layers.data("img", shape=[1, 12, 12], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        conv = layers.conv2d(img, num_filters=4, filter_size=3,
+                             act="relu")
+        pool = layers.pool2d(conv, pool_size=2, pool_stride=2)
+        pred = layers.fc(pool, size=10, act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, label))
+        fluid.Momentum(learning_rate=0.05, momentum=0.9).minimize(loss)
+        if optimize:
+            pairs = fluid.memory_optimize(main, remat_types=True)
+            assert isinstance(pairs, list)
+        rng = np.random.RandomState(0)
+        feed = {"img": rng.rand(8, 1, 12, 12).astype("float32"),
+                "label": rng.randint(0, 10, (8, 1)).astype("int64")}
+        with fluid.scope_guard(fluid.Scope()):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            return [float(np.asarray(exe.run(main, feed=feed,
+                                             fetch_list=[loss])[0])
+                          .reshape(-1)[0]) for _ in range(5)]
+
+    base = run(False)
+    opt = run(True)
+    np.testing.assert_allclose(opt, base, rtol=1e-5)
+    assert opt[-1] < opt[0]

@@ -353,3 +353,37 @@ def test_hybrid_concrete_counter_crosses_host_boundary(tmp_path):
         r2, = exe.run(main, feed={"x": xs}, fetch_list=[out])
         np.testing.assert_allclose(r, r2)
     assert exe.stats["hybrid_runs"] == 2, exe.stats
+
+
+def test_error_paths_are_actionable():
+    """The probe set that matters (verify recipe): run-before-startup
+    names the missing var; unknown fetch and wrong-rank feeds fail with
+    clear errors rather than deep trace debris."""
+    import pytest
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    main, startup = pt.Program(), pt.Program()
+    pt.switch_main_program(main)
+    pt.switch_startup_program(startup)
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.fc(x, size=2, param_attr=pt.ParamAttr(name="ep_w"))
+    feed = {"x": np.ones((2, 4), dtype="float32")}
+    with pt.scope_guard(pt.Scope()):
+        exe = pt.Executor(pt.CPUPlace())
+        # run BEFORE startup: the missing parameter is named
+        with pytest.raises(KeyError, match="ep_w"):
+            exe.run(main, feed=feed, fetch_list=[y])
+        exe.run(startup)
+        # unknown fetch name
+        with pytest.raises(KeyError):
+            exe.run(main, feed=feed, fetch_list=["no_such_var"])
+        # wrong feed rank surfaces as a shape error naming the op
+        with pytest.raises(Exception) as ei:
+            exe.run(main, feed={"x": np.ones((2, 4, 4), "float32")},
+                    fetch_list=[y])
+        notes = "".join(getattr(ei.value, "__notes__", []) or [])
+        assert ("mul" in notes or "shape" in str(ei.value).lower()
+                or "dot" in str(ei.value).lower())
+        # recovery: a correct feed still works after the failures
+        out, = exe.run(main, feed=feed, fetch_list=[y])
+        assert np.asarray(out).shape == (2, 2)
