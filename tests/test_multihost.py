@@ -58,6 +58,22 @@ WORKER = textwrap.dedent("""
                 l, "addressable_shards") else l).reshape(-1)[0])
         print("RESULT proc %%d step %%d loss %%.6f" %% (r, i, lv),
               flush=True)
+
+    # context parallelism across the REAL process boundary: ring
+    # attention with the sequence sharded over the 2-process mesh,
+    # ppermute riding the gloo fabric
+    from paddle_tpu.parallel import ring_attention_sharded
+    from paddle_tpu.parallel import make_mesh as _mm
+    sp_mesh = _mm({"sp": -1})
+    rngq = np.random.RandomState(7)
+    B, S, H, D = 1, 16, 2, 8
+    q = rngq.randn(B, S, H, D).astype("float32")
+    out = ring_attention_sharded(q, q, q, sp_mesh, seq_axis="sp",
+                                 causal=True)
+    # the jitted global sum is replicated, so every rank can read it
+    osum = float(np.asarray(
+        jax.jit(lambda a: a.astype(jax.numpy.float32).sum())(out)))
+    print("RING proc %%d sum %%.6f" %% (r, osum), flush=True)
 """)
 
 
@@ -104,3 +120,6 @@ def test_two_process_data_parallel_training(tmp_path):
             "ranks diverged at step %d: %r" % (s, by_step[s]))
         losses.append(by_step[s][0])
     assert losses[-1] < losses[0]
+    rings = re.findall(r"RING proc (\d) sum (-?[0-9.]+)", out)
+    assert len(rings) == 2, out[-2000:]
+    assert rings[0][1] == rings[1][1]  # cross-process ring agrees
