@@ -476,11 +476,49 @@ class Executor(object):
         return self._device_cache
 
     # -- public API ----------------------------------------------------------
-    def prepare_feed(self, feed):
+    def prepare_feed(self, feed, local_shard=False):
         """Transfer a feed dict to the device once; the returned dict can be
         passed to run() repeatedly without re-transferring (device_put of an
         already-committed array is a no-op). The reference's analog is the
-        data-provider double buffer keeping batches device-resident."""
+        data-provider double buffer keeping batches device-resident.
+
+        ``local_shard=True`` (multi-host, needs a dist_context): each
+        process passes only ITS slice of the global batch — the slices are
+        assembled into one global array sharded per the strategy's feed
+        spec (``jax.make_array_from_process_local_data``). This is the
+        reference's per-trainer data shard (each trainer reads its own
+        file split / master leases) in SPMD form."""
+        if local_shard:
+            dist = self.dist_context
+            if dist is None:
+                raise ValueError("local_shard feeds need a dist_context")
+            out = {}
+            nproc = jax.process_count()
+            for k, v in feed.items():
+                if isinstance(v, LoDTensor):
+                    raise NotImplementedError(
+                        "local_shard feeds don't carry LoD yet — feed "
+                        "ragged data replicated (plain prepare_feed) or "
+                        "pre-pad to dense")
+                arr = np.asarray(v)
+                # the sharding decision must see the GLOBAL batch shape
+                # (divisibility checks against a local slice would flip
+                # small feeds to replicated)
+                gshape = ((arr.shape[0] * nproc,) + tuple(arr.shape[1:])
+                          if arr.ndim else arr.shape)
+                spec = dist.strategy.spec_for_feed(k, gshape, dist.mesh)
+                if not any(p is not None for p in tuple(spec)):
+                    # a replicated spec + per-rank local slices would
+                    # install DIFFERENT buffers as "the" replicated array:
+                    # silent cross-host divergence. Refuse loudly.
+                    raise ValueError(
+                        "local_shard feed %r resolves to a replicated "
+                        "spec (global batch %s not divisible by the data "
+                        "axis?) — pass identical data via plain "
+                        "prepare_feed instead" % (k, gshape))
+                sh = jax.sharding.NamedSharding(dist.mesh, spec)
+                out[k] = jax.make_array_from_process_local_data(sh, arr)
+            return out
         dev = None if self.dist_context is not None else self._device()
         return {k: _to_device_value(v, dev) for k, v in feed.items()}
 

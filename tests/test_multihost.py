@@ -59,6 +59,43 @@ WORKER = textwrap.dedent("""
         print("RESULT proc %%d step %%d loss %%.6f" %% (r, i, lv),
               flush=True)
 
+    # phase 2: per-host LOCAL data shards — each rank feeds only its half
+    # of the global batch (prepare_feed(local_shard=True)); grads sync via
+    # the cross-process collective, so losses must match a single-process
+    # run on the concatenated batch exactly
+    from paddle_tpu.core import unique_name
+    unique_name._counters.clear()
+    main2, startup2 = pt.Program(), pt.Program()
+    pt.switch_main_program(main2)
+    pt.switch_startup_program(startup2)
+    x2 = layers.data("x", shape=[8], dtype="float32")
+    y2 = layers.data("y", shape=[1], dtype="int64")
+    pred2 = layers.fc(x2, size=2, act="softmax",
+                      param_attr=pt.ParamAttr(name="mh_w2"))
+    loss2 = layers.mean(layers.cross_entropy(pred2, y2))
+    pt.SGD(learning_rate=0.5).minimize(loss2)
+    ctx2 = DistributeTranspiler().transpile(
+        program=main2, mesh=mesh,
+        strategy=ShardingStrategy(data_axis="dp"))
+    sc2 = pt.Scope()
+    with pt.scope_guard(sc2):
+        exe2 = pt.Executor(pt.CPUPlace(), dist_context=ctx2)
+        exe2.run(startup2)
+        rng2 = np.random.RandomState(5)
+        gx = rng2.rand(8, 8).astype("float32")
+        gy = rng2.randint(0, 2, (8, 1)).astype("int64")
+        lo = slice(r * 4, (r + 1) * 4)      # THIS rank's shard only
+        feed2 = exe2.prepare_feed({"x": gx[lo], "y": gy[lo]},
+                                  local_shard=True)
+        for i in range(3):
+            l2, = exe2.run(main2, feed=feed2, fetch_list=[loss2],
+                           return_numpy=False)
+            lv2 = float(np.asarray(
+                l2.addressable_shards[0].data if hasattr(
+                    l2, "addressable_shards") else l2).reshape(-1)[0])
+            print("SHARD proc %%d step %%d loss %%.6f" %% (r, i, lv2),
+                  flush=True)
+
     # context parallelism across the REAL process boundary: ring
     # attention with the sequence sharded over the 2-process mesh,
     # ppermute riding the gloo fabric
@@ -123,3 +160,43 @@ def test_two_process_data_parallel_training(tmp_path):
     rings = re.findall(r"RING proc (\d) sum (-?[0-9.]+)", out)
     assert len(rings) == 2, out[-2000:]
     assert rings[0][1] == rings[1][1]  # cross-process ring agrees
+
+    # local-shard phase: lockstep AND equal to a single-process reference
+    # on the concatenated batch
+    shard_rows = re.findall(r"SHARD proc (\d) step (\d) loss ([0-9.]+)",
+                            out)
+    assert len(shard_rows) == 6, out[-2000:]
+    got = {}
+    for p_, s_, l_ in shard_rows:
+        got.setdefault(int(s_), {})[int(p_)] = float(l_)
+    ref_losses = _single_process_reference()
+    for s_ in range(3):
+        assert got[s_][0] == got[s_][1], got
+        np.testing.assert_allclose(got[s_][0], ref_losses[s_], rtol=2e-4)
+
+
+def _single_process_reference():
+    """The same sharded-feed program, single process, full batch."""
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.core import unique_name
+    unique_name._counters.clear()
+    main, startup = pt.Program(), pt.Program()
+    pt.switch_main_program(main)
+    pt.switch_startup_program(startup)
+    x = layers.data("x", shape=[8], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="int64")
+    pred = layers.fc(x, size=2, act="softmax",
+                     param_attr=pt.ParamAttr(name="mh_w2"))
+    loss = layers.mean(layers.cross_entropy(pred, y))
+    pt.SGD(learning_rate=0.5).minimize(loss)
+    rng = np.random.RandomState(5)
+    gx = rng.rand(8, 8).astype("float32")
+    gy = rng.randint(0, 2, (8, 1)).astype("int64")
+    with pt.scope_guard(pt.Scope()):
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        return [float(np.asarray(exe.run(
+            main, feed={"x": gx, "y": gy}, fetch_list=[loss])[0])
+            .reshape(-1)[0]) for _ in range(3)]
