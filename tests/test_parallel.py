@@ -187,3 +187,38 @@ def test_distributed_embedding_dense_grads_also_shard():
         losses = [float(exe.run(main, feed=feed, fetch_list=[avg])[0])
                   for _ in range(6)]
     assert losses[-1] < losses[0]
+
+
+def test_step_fusion_under_mesh_matches_sequential():
+    """run(repeat=K) under a dp x tp mesh: K fused SPMD steps equal K
+    sequential SPMD steps (the production TPU stepping mode — dispatch
+    amortization must not change collective math)."""
+    from paddle_tpu.core import unique_name
+
+    def run(repeat):
+        unique_name._counters.clear()
+        main, startup = pt.Program(), pt.Program()
+        pt.switch_main_program(main)
+        pt.switch_startup_program(startup)
+        avg = _build_mlp_trainer(lr=0.2)
+        mesh = make_mesh({"dp": 4, "tp": 2})
+        ctx = DistributeTranspiler().transpile(
+            program=main, mesh=mesh,
+            strategy=ShardingStrategy(
+                data_axis="dp", param_rules=[(r"fc_\d+\.w_0$",
+                                              P(None, "tp"))]))
+        feed = _data()
+        with pt.scope_guard(pt.Scope()):
+            exe = pt.Executor(pt.CPUPlace(), dist_context=ctx)
+            exe.run(startup)
+            dev_feed = exe.prepare_feed(feed)
+            if repeat == 1:
+                for _ in range(4):
+                    out, = exe.run(main, feed=dev_feed, fetch_list=[avg],
+                                   return_numpy=False)
+            else:
+                out, = exe.run(main, feed=dev_feed, fetch_list=[avg],
+                               return_numpy=False, repeat=4)
+            return float(np.asarray(out).reshape(-1)[0])
+
+    np.testing.assert_allclose(run(4), run(1), rtol=1e-5)
