@@ -123,5 +123,19 @@ def main(argv=None):
     c.add_argument("--records_per_shard", type=int, default=4096)
     c.set_defaults(fn=cmd_convert)
 
+    # the reference exposed cluster fan-out through the same binary
+    # (`paddle train/pserver`, scripts/cluster_train); mirror that shape
+    ln = sub.add_parser(
+        "launch", help="multi-process launcher (see paddle_tpu.launch)")
+    ln.add_argument("--nprocs", type=int, required=True)
+    ln.add_argument("--coordinator", required=True)
+    ln.add_argument("script_argv", nargs=argparse.REMAINDER)
+
+    def cmd_launch(args):
+        from .launch import launch
+        return launch(args.nprocs, args.coordinator, args.script_argv)
+
+    ln.set_defaults(fn=cmd_launch)
+
     args = p.parse_args(argv)
     return args.fn(args)
