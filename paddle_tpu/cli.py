@@ -133,6 +133,8 @@ def main(argv=None):
 
     def cmd_launch(args):
         from .launch import launch
+        if not args.script_argv:
+            p.error("launch: missing training script")
         return launch(args.nprocs, args.coordinator, args.script_argv)
 
     ln.set_defaults(fn=cmd_launch)

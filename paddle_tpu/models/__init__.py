@@ -12,3 +12,4 @@ from .vgg import vgg16, vgg_cifar  # noqa: F401
 from .resnet import resnet, resnet_cifar10, resnet_imagenet  # noqa: F401
 from .alexnet import alexnet  # noqa: F401
 from .googlenet import googlenet  # noqa: F401
+from .transformer import transformer_lm, transformer_block  # noqa: F401

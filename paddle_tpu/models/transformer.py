@@ -1,0 +1,92 @@
+"""Decoder-only transformer language model — the modern flagship family.
+
+No 2018 reference equivalent (attention postdates the snapshot; its
+sequence flagship was the attention-seq2seq book model,
+python/paddle/fluid/tests/book/test_machine_translation.py). This is the
+capability the TPU build adds on top: pre-norm causal blocks whose
+attention is the ``flash_attention`` op — the Pallas kernel on TPU
+(kernels/flash_attention.py), dense fallback elsewhere — with every
+matmul batched for the MXU. Long sequences shard over a context-parallel
+mesh axis via parallel/ring.py; tensor-parallel specs for the qkv/mlp
+weights come from ShardingStrategy param_rules (see tests/test_models.py).
+"""
+from __future__ import annotations
+
+from ..layers import nn as L
+from ..layers import ops as OPS
+from ..layers import tensor as T
+from ..layers.layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+
+
+def causal_flash_attention(q, k, v, num_heads):
+    """[B, S, hidden] q/k/v -> [B, S, hidden] via the flash_attention op
+    (causal)."""
+    B_S_H = q.shape
+    hidden = B_S_H[-1]
+    seq = B_S_H[-2]
+    dh = hidden // num_heads
+    qh = L.reshape(q, shape=[0, seq, num_heads, dh])
+    kh = L.reshape(k, shape=[0, seq, num_heads, dh])
+    vh = L.reshape(v, shape=[0, seq, num_heads, dh])
+    helper = LayerHelper("flash_attention")
+    out = helper.create_variable_for_type_inference(dtype=q.dtype)
+    out.shape = qh.shape
+    helper.append_op(type="flash_attention",
+                     inputs={"Q": [qh], "K": [kh], "V": [vh]},
+                     outputs={"Out": [out]},
+                     attrs={"causal": True})
+    return L.reshape(out, shape=[0, seq, hidden])
+
+
+def transformer_block(x, hidden, num_heads, ffn_mult=4, prefix="blk"):
+    """Pre-norm block: x + attn(ln(x)); x + ffn(ln(x))."""
+    h = L.layer_norm(x, begin_norm_axis=2,
+                     param_attr=ParamAttr(name=prefix + "_ln1_w"),
+                     bias_attr=ParamAttr(name=prefix + "_ln1_b"))
+    q = L.fc(h, size=hidden, num_flatten_dims=2, bias_attr=False,
+             param_attr=ParamAttr(name=prefix + "_q"))
+    k = L.fc(h, size=hidden, num_flatten_dims=2, bias_attr=False,
+             param_attr=ParamAttr(name=prefix + "_k"))
+    v = L.fc(h, size=hidden, num_flatten_dims=2, bias_attr=False,
+             param_attr=ParamAttr(name=prefix + "_v"))
+    att = causal_flash_attention(q, k, v, num_heads)
+    proj = L.fc(att, size=hidden, num_flatten_dims=2, bias_attr=False,
+                param_attr=ParamAttr(name=prefix + "_proj"))
+    x = L.elementwise_add(x, proj)
+    h2 = L.layer_norm(x, begin_norm_axis=2,
+                      param_attr=ParamAttr(name=prefix + "_ln2_w"),
+                      bias_attr=ParamAttr(name=prefix + "_ln2_b"))
+    up = L.fc(h2, size=hidden * ffn_mult, num_flatten_dims=2, act="relu",
+              param_attr=ParamAttr(name=prefix + "_up"))
+    down = L.fc(up, size=hidden, num_flatten_dims=2, bias_attr=False,
+                param_attr=ParamAttr(name=prefix + "_down"))
+    return L.elementwise_add(x, down)
+
+
+def transformer_lm(tokens, vocab_size, hidden=64, num_layers=2,
+                   num_heads=4, ffn_mult=4):
+    """``tokens`` [B, S] int64 -> logits [B, S, vocab_size].
+
+    Learned positional embeddings added to token embeddings, N pre-norm
+    causal blocks, final layer norm, untied projection head.
+    """
+    seq = tokens.shape[1]
+    emb = L.embedding(tokens, size=[vocab_size, hidden],
+                      param_attr=ParamAttr(name="tok_emb"))
+    # position ids: cumsum over a ones row - 1, per batch row
+    ones = T.fill_constant_batch_size_like(tokens, shape=[-1, seq],
+                                           dtype="float32", value=1.0)
+    pos_ids = T.cast(L.scale(OPS.cumsum(ones, axis=1), scale=1.0, bias=-1.0),
+                     "int64")
+    pos = L.embedding(pos_ids, size=[seq, hidden],
+                      param_attr=ParamAttr(name="pos_emb"))
+    x = L.elementwise_add(emb, pos)
+    for i in range(num_layers):
+        x = transformer_block(x, hidden, num_heads, ffn_mult,
+                              prefix="blk%d" % i)
+    x = L.layer_norm(x, begin_norm_axis=2,
+                     param_attr=ParamAttr(name="final_ln_w"),
+                     bias_attr=ParamAttr(name="final_ln_b"))
+    return L.fc(x, size=vocab_size, num_flatten_dims=2, bias_attr=False,
+                param_attr=ParamAttr(name="lm_head"))
