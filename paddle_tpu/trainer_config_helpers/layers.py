@@ -32,7 +32,10 @@ __all__ = [
     "sum_to_one_norm_layer", "slope_intercept_layer", "power_layer",
     "scaling_layer", "linear_comb_layer", "trans_layer", "repeat_layer",
     "expand_layer", "seq_reshape_layer", "bilinear_interp_layer",
-    "conv_shift_layer", "block_expand_layer", "maxout_layer", "outputs",
+    "conv_shift_layer", "block_expand_layer", "maxout_layer",
+    "rank_cost", "huber_regression_cost",
+    "multi_binary_label_cross_entropy", "sum_cost", "img_cmrnorm_layer",
+    "outputs",
     "get_output_layers",
 ]
 
@@ -923,4 +926,71 @@ def maxout_layer(input, groups, num_channels=None, name=None):
     lo = LayerOutput(name, F.reshape(out, shape=[0, -1]),
                      size=(c // groups) * h * w)
     lo.channels, lo.height, lo.width = c // groups, h, w
+    return lo
+
+
+# ---------------------------------------------------------------------------
+# v1 cost-layer tail (reference: layers.py rank_cost, huber_regression_cost,
+#  multi_binary_label_cross_entropy, sum_cost, lambda_cost role via
+#  rank_cost; img_cmrnorm_layer over the lrn op)
+
+def rank_cost(left, right, label, name=None, coeff=1.0):
+    """Pairwise RankNet cost (reference: rank_cost -> RankingCost)."""
+    out = _append_simple("rank_loss",
+                         {"Left": [left.var], "Right": [right.var],
+                          "Label": [label.var]}, {})
+    cost = F.mean(out)
+    if coeff != 1.0:
+        cost = F.scale(cost, scale=coeff)
+    return LayerOutput(name, cost, size=1)
+
+
+def huber_regression_cost(input, label, delta=1.0, name=None, coeff=1.0):
+    """reference: huber_regression_cost (HuberRegressionLoss). The op's
+    optional Residual output stays unwired (the executor skips it)."""
+    out = _append_simple("huber_loss",
+                         {"X": [input.var], "Y": [label.var]},
+                         {"delta": float(delta)})
+    cost = F.mean(out)
+    if coeff != 1.0:
+        cost = F.scale(cost, scale=coeff)
+    return LayerOutput(name, cost, size=1)
+
+
+def multi_binary_label_cross_entropy(input, label, name=None, coeff=1.0):
+    """Per-bit cross entropy on PROBABILITIES — the v1 contract (the input
+    layer carries a sigmoid activation, like every sibling cost layer
+    here; reference: MultiBinaryLabelCrossEntropy)."""
+    p = F.clip(input.var, min=1e-7, max=1.0 - 1e-7)
+    one_minus_l = F.scale(label.var, scale=-1.0, bias=1.0)
+    one_minus_p = F.scale(p, scale=-1.0, bias=1.0)
+    ce = F.scale(F.elementwise_add(
+        F.elementwise_mul(label.var, F.log(p)),
+        F.elementwise_mul(one_minus_l, F.log(one_minus_p))), scale=-1.0)
+    cost = F.mean(ce)
+    if coeff != 1.0:
+        cost = F.scale(cost, scale=coeff)
+    return LayerOutput(name, cost, size=1)
+
+
+def sum_cost(input, name=None):
+    """reference: sum_cost (SumCost — just sums the input)."""
+    return LayerOutput(name, F.reduce_sum(input.var), size=1)
+
+
+def img_cmrnorm_layer(input, size=5, scale=0.0001, power=0.75,
+                      num_channels=None, name=None):
+    """Cross-map response norm (reference: img_cmrnorm_layer ->
+    CMRProjectionNormLayer). The v1 config_parser divides scale by size
+    before it reaches the kernel (reference: config_parser.py:1352), and
+    the kernel computes x*(1 + scale'*SUM(x^2))^-pow
+    (reference: function/CrossMapNormalOp.cpp:38) — so alpha = scale/size
+    and k = 1."""
+    var, c, h, w = _as_image(input, num_channels)
+    out = _append_simple("lrn", {"X": [var]},
+                         {"n": int(size), "alpha": float(scale) / size,
+                          "beta": float(power), "k": 1.0})
+    lo = LayerOutput(name, F.reshape(out, shape=[0, -1]),
+                     size=c * h * w)
+    lo.channels, lo.height, lo.width = c, h, w
     return lo

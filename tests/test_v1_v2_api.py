@@ -745,3 +745,51 @@ def test_v2_image_grayscale_and_crop_validation():
     with pytest.raises(ValueError):
         v2.image.random_crop(gray, 64)
     assert hasattr(v2, "image")  # facade attribute
+
+
+def test_v1_cost_layer_tail():
+    """rank_cost / huber_regression / multi_binary_ce / sum_cost /
+    img_cmrnorm (reference cost-layer tail), numpy-checked."""
+    from paddle_tpu import trainer_config_helpers as tch
+    main, startup = _fresh()
+    l = tch.data_layer("l", size=1)
+    r = tch.data_layer("r", size=1)
+    yy = tch.data_layer("yy", size=1)
+    xb = tch.data_layer("xb", size=4)
+    lb = tch.data_layer("lb", size=4)
+    img = tch.data_layer("cimg", size=3 * 4 * 4, height=4, width=4)
+    outs = [tch.rank_cost(l, r, yy),
+            tch.huber_regression_cost(l, r, delta=1.0),
+            tch.multi_binary_label_cross_entropy(xb, lb),
+            tch.sum_cost(l),
+            tch.img_cmrnorm_layer(img, size=3)]
+    rng = np.random.RandomState(0)
+    feed = {"l": rng.randn(3, 1).astype("float32"),
+            "r": rng.randn(3, 1).astype("float32"),
+            "yy": rng.randint(0, 2, (3, 1)).astype("float32"),
+            "xb": rng.rand(3, 4).astype("float32"),
+            "lb": rng.randint(0, 2, (3, 4)).astype("float32"),
+            "cimg": rng.rand(2, 48).astype("float32")}
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        vals = exe.run(main, feed=feed, fetch_list=[o.var for o in outs])
+    d = feed["l"] - feed["r"]
+    want_rank = np.mean(np.log1p(np.exp(d)) - feed["yy"] * d)
+    np.testing.assert_allclose(np.asarray(vals[0]).ravel()[0], want_rank,
+                               rtol=1e-5)
+    # v1 contract: input is PROBABILITIES
+    x = np.clip(feed["xb"], 1e-7, 1 - 1e-7)
+    want_ce = -np.mean(feed["lb"] * np.log(x)
+                       + (1 - feed["lb"]) * np.log(1 - x))
+    np.testing.assert_allclose(np.asarray(vals[2]).ravel()[0], want_ce,
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(vals[3]).ravel()[0],
+                               feed["l"].sum(), rtol=1e-5)
+    im = feed["cimg"].reshape(2, 3, 4, 4)
+    sq = np.pad(im ** 2, ((0, 0), (1, 1), (0, 0), (0, 0)))
+    acc = sum(sq[:, i:i + 3] for i in range(3))
+    want_norm = im / (1.0 + (1e-4 / 3) * acc) ** 0.75  # alpha = scale/size
+    np.testing.assert_allclose(np.asarray(vals[4]),
+                               want_norm.reshape(2, -1), rtol=1e-5)
+    assert np.isfinite(np.asarray(vals[1])).all()
