@@ -35,7 +35,7 @@ __all__ = [
     "conv_shift_layer", "block_expand_layer", "maxout_layer",
     "rank_cost", "huber_regression_cost",
     "multi_binary_label_cross_entropy", "sum_cost", "img_cmrnorm_layer",
-    "outputs",
+    "crf_layer", "crf_decoding_layer", "ctc_layer", "outputs",
     "get_output_layers",
 ]
 
@@ -987,10 +987,58 @@ def img_cmrnorm_layer(input, size=5, scale=0.0001, power=0.75,
     (reference: function/CrossMapNormalOp.cpp:38) — so alpha = scale/size
     and k = 1."""
     var, c, h, w = _as_image(input, num_channels)
-    out = _append_simple("lrn", {"X": [var]},
-                         {"n": int(size), "alpha": float(scale) / size,
-                          "beta": float(power), "k": 1.0})
+    out = F.lrn(var, n=int(size), k=1.0, alpha=float(scale) / size,
+                beta=float(power))
     lo = LayerOutput(name, F.reshape(out, shape=[0, -1]),
                      size=c * h * w)
     lo.channels, lo.height, lo.width = c, h, w
     return lo
+
+
+# ---------------------------------------------------------------------------
+# structured prediction (reference: layers.py crf_layer, crf_decoding_layer,
+#  ctc_layer, warp_ctc_layer — gserver CRFLayer/CTCLayer/WarpCTCLayer)
+
+def crf_layer(input, label, param_attr=None, name=None):
+    """Linear-chain CRF negative log likelihood over a ragged batch
+    (reference: crf_layer). ``input`` is the per-tag emission layer."""
+    cost = F.linear_chain_crf(input.var, label.var,
+                              param_attr=_param(param_attr))
+    out = F.mean(cost)
+    return LayerOutput(name, out, size=1)
+
+
+def crf_decoding_layer(input, param_attr, label=None, name=None):
+    """Viterbi decode with the CRF's learned transitions (reference:
+    crf_decoding_layer) — ``param_attr`` must NAME the crf_layer's
+    transition parameter (there is no usable default). With ``label``,
+    emits per-position correctness instead (the reference's evaluation
+    mode)."""
+    if param_attr is None:
+        raise ValueError(
+            "crf_decoding_layer needs the param_attr naming the "
+            "crf_layer's transition parameter")
+    out = F.crf_decoding(input.var, _param(param_attr),
+                         label=label.var if label is not None else None)
+    return LayerOutput(name, out, size=1)
+
+
+def ctc_layer(input, label, size=None, blank=None, norm_by_times=False,
+              name=None):
+    """CTC cost following the warp_ctc contract: ``input`` is the
+    PRE-softmax projection (the underlying op log-softmaxes internally;
+    v1's plain ctc_layer wanted softmaxed input — reference
+    config_parser asserts that — but its warp_ctc_layer, which this maps
+    to, takes logits). ``size`` is num_classes+1; blank defaults to the
+    LAST index (size-1), the v1 convention."""
+    size = size or input.size
+    if blank is None:
+        if not size:
+            raise ValueError(
+                "ctc_layer cannot infer the blank index: pass size "
+                "(num_classes+1) or blank explicitly")
+        blank = size - 1
+    cost = F.warpctc(input.var, label.var, blank=int(blank),
+                     norm_by_times=norm_by_times)
+    out = F.mean(cost)
+    return LayerOutput(name, out, size=1)

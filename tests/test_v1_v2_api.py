@@ -793,3 +793,56 @@ def test_v1_cost_layer_tail():
     np.testing.assert_allclose(np.asarray(vals[4]),
                                want_norm.reshape(2, -1), rtol=1e-5)
     assert np.isfinite(np.asarray(vals[1])).all()
+
+
+def test_v1_crf_and_ctc_layers():
+    """crf_layer trains a ragged tagger; ctc_layer trains an alignment-free
+    sequence cost; crf_decoding_layer decodes (reference structured-
+    prediction layer family)."""
+    from paddle_tpu import trainer_config_helpers as tch
+    from paddle_tpu.core.lod import LoDTensor
+    main, startup = _fresh()
+    feats = tch.data_layer("feats", size=4, is_seq=True)
+    tags = tch.data_layer("tags", size=3, dtype="int64", is_seq=True)
+    emit = tch.fc_layer(feats, size=3)
+    crf = tch.crf_layer(emit, tags,
+                        param_attr=tch.ParameterAttribute(name="crf_w")
+                        if hasattr(tch, "ParameterAttribute") else None)
+    fluid.SGD(learning_rate=0.1).minimize(crf.var)
+    decoded = tch.crf_decoding_layer(
+        emit, param_attr=tch.ParameterAttribute(name="crf_w")
+        if hasattr(tch, "ParameterAttribute") else None)
+    rng = np.random.RandomState(0)
+    data = rng.rand(6, 4).astype("float32")
+    lab = rng.randint(0, 3, (6, 1)).astype("int64")
+    feed = {"feats": LoDTensor(data, [[0, 3, 6]]),
+            "tags": LoDTensor(lab, [[0, 3, 6]])}
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        ls = [float(np.asarray(exe.run(main, feed=feed,
+                                       fetch_list=[crf.var])[0])
+                    .reshape(-1)[0]) for _ in range(10)]
+        assert ls[-1] < ls[0]
+        dec, = exe.run(main, feed=feed, fetch_list=[decoded.var],
+                       return_numpy=False)
+        assert np.asarray(dec.numpy()).shape[0] == 6
+
+    # ctc: 5 feature frames per sequence, 2-symbol vocab + blank
+    main2, startup2 = _fresh()
+    frames = tch.data_layer("frames", size=3, is_seq=True)
+    labels = tch.data_layer("labels", size=2, dtype="int64", is_seq=True)
+    soft = tch.fc_layer(frames, size=3)
+    ctc = tch.ctc_layer(soft, labels, size=3)  # blank = 2
+    fluid.SGD(learning_rate=0.05).minimize(ctc.var)
+    fdata = rng.rand(10, 3).astype("float32")
+    ldata = rng.randint(0, 2, (4, 1)).astype("int64")
+    feed2 = {"frames": LoDTensor(fdata, [[0, 5, 10]]),
+             "labels": LoDTensor(ldata, [[0, 2, 4]])}
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup2)
+        ls = [float(np.asarray(exe.run(main2, feed=feed2,
+                                       fetch_list=[ctc.var])[0])
+                    .reshape(-1)[0]) for _ in range(10)]
+        assert np.isfinite(ls).all() and ls[-1] < ls[0]
