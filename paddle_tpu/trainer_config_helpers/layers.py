@@ -999,38 +999,61 @@ def img_cmrnorm_layer(input, size=5, scale=0.0001, power=0.75,
 # structured prediction (reference: layers.py crf_layer, crf_decoding_layer,
 #  ctc_layer, warp_ctc_layer — gserver CRFLayer/CTCLayer/WarpCTCLayer)
 
-def crf_layer(input, label, param_attr=None, name=None):
+def crf_layer(input, label, size=None, weight=None, param_attr=None,
+              name=None, coeff=1.0, layer_attr=None):
     """Linear-chain CRF negative log likelihood over a ragged batch
-    (reference: crf_layer). ``input`` is the per-tag emission layer."""
+    (reference: crf_layer — v1 signature preserved; ``weight``/
+    ``layer_attr`` accepted like the sibling cost layers). ``input`` is
+    the per-tag emission layer."""
+    if size is not None and input.size and size != input.size:
+        raise ValueError(
+            "crf_layer size=%d but the emission layer has %d tags"
+            % (size, input.size))
     cost = F.linear_chain_crf(input.var, label.var,
                               param_attr=_param(param_attr))
     out = F.mean(cost)
+    if coeff != 1.0:
+        out = F.scale(out, scale=coeff)
     return LayerOutput(name, out, size=1)
 
 
-def crf_decoding_layer(input, param_attr, label=None, name=None):
+def crf_decoding_layer(input, size=None, label=None, param_attr=None,
+                       name=None, layer_attr=None):
     """Viterbi decode with the CRF's learned transitions (reference:
-    crf_decoding_layer) — ``param_attr`` must NAME the crf_layer's
-    transition parameter (there is no usable default). With ``label``,
-    emits per-position correctness instead (the reference's evaluation
-    mode)."""
-    if param_attr is None:
+    crf_decoding_layer — v1 signature: size is the 2nd positional).
+    ``param_attr`` must NAME the crf_layer's transition parameter (there
+    is no usable default). With ``label``, emits per-position
+    correctness instead (the reference's evaluation mode)."""
+    pa = _param(param_attr)
+    if pa is None or getattr(pa, "name", None) is None:
         raise ValueError(
-            "crf_decoding_layer needs the param_attr naming the "
-            "crf_layer's transition parameter")
-    out = F.crf_decoding(input.var, _param(param_attr),
+            "crf_decoding_layer needs a param_attr NAMING the "
+            "crf_layer's transition parameter (e.g. "
+            "ParameterAttribute(name='crf_w') shared with crf_layer)")
+    if size is not None and input.size and size != input.size:
+        raise ValueError(
+            "crf_decoding_layer size=%d but the emission layer has %d "
+            "tags" % (size, input.size))
+    out = F.crf_decoding(input.var, pa,
                          label=label.var if label is not None else None)
     return LayerOutput(name, out, size=1)
 
 
-def ctc_layer(input, label, size=None, blank=None, norm_by_times=False,
-              name=None):
+def ctc_layer(input, label, size=None, name=None, norm_by_times=False,
+              layer_attr=None, blank=None):
     """CTC cost following the warp_ctc contract: ``input`` is the
     PRE-softmax projection (the underlying op log-softmaxes internally;
     v1's plain ctc_layer wanted softmaxed input — reference
     config_parser asserts that — but its warp_ctc_layer, which this maps
-    to, takes logits). ``size`` is num_classes+1; blank defaults to the
-    LAST index (size-1), the v1 convention."""
+    to, takes logits). v1 signature preserved (size, name,
+    norm_by_times); ``size`` is num_classes+1, validated against the
+    input width like the v1 config_parser's assert; blank defaults to
+    the LAST index (size-1), the v1 convention."""
+    if size is not None and input.size and size != input.size:
+        raise ValueError(
+            "ctc_layer size=%d but the projection layer is %d wide "
+            "(size must be num_classes+1 == input width)"
+            % (size, input.size))
     size = size or input.size
     if blank is None:
         if not size:

@@ -805,13 +805,11 @@ def test_v1_crf_and_ctc_layers():
     feats = tch.data_layer("feats", size=4, is_seq=True)
     tags = tch.data_layer("tags", size=3, dtype="int64", is_seq=True)
     emit = tch.fc_layer(feats, size=3)
-    crf = tch.crf_layer(emit, tags,
-                        param_attr=tch.ParameterAttribute(name="crf_w")
-                        if hasattr(tch, "ParameterAttribute") else None)
+    crf = tch.crf_layer(emit, tags, size=3,
+                        param_attr=tch.ParameterAttribute(name="crf_w"))
     fluid.SGD(learning_rate=0.1).minimize(crf.var)
     decoded = tch.crf_decoding_layer(
-        emit, param_attr=tch.ParameterAttribute(name="crf_w")
-        if hasattr(tch, "ParameterAttribute") else None)
+        emit, 3, param_attr=tch.ParameterAttribute(name="crf_w"))
     rng = np.random.RandomState(0)
     data = rng.rand(6, 4).astype("float32")
     lab = rng.randint(0, 3, (6, 1)).astype("int64")
