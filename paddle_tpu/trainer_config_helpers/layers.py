@@ -798,7 +798,7 @@ def _append_simple(op_type, inputs, attrs, out_dtype="float32",
     return out
 
 
-def cos_sim(a, b, scale=1.0, size=1, name=None):
+def cos_sim(a, b, scale=1.0, size=1, name=None, layer_attr=None):
     """reference: layers.py cos_sim (CosSimLayer)."""
     out = F.cos_sim(a.var, b.var)
     if scale != 1.0:
@@ -806,7 +806,7 @@ def cos_sim(a, b, scale=1.0, size=1, name=None):
     return LayerOutput(name, out, size=1)
 
 
-def interpolation_layer(input, weight, name=None):
+def interpolation_layer(input, weight, name=None, layer_attr=None):
     """out = w*a + (1-w)*b with per-sample scalar weight
     (reference: InterpolationLayer)."""
     a, b = input
@@ -816,20 +816,21 @@ def interpolation_layer(input, weight, name=None):
     return LayerOutput(name, F.elementwise_add(wa, wb), size=a.size)
 
 
-def sum_to_one_norm_layer(input, name=None):
+def sum_to_one_norm_layer(input, name=None, layer_attr=None):
     """Row-normalize to sum 1 (reference: SumToOneNormLayer)."""
     s = F.reduce_sum(input.var, dim=1, keep_dim=True)
     return LayerOutput(name, F.elementwise_div(input.var, s),
                        size=input.size)
 
 
-def slope_intercept_layer(input, slope=1.0, intercept=0.0, name=None):
+def slope_intercept_layer(input, name=None, slope=1.0, intercept=0.0,
+                          layer_attr=None):
     """a*x + b (reference: SlopeInterceptLayer)."""
     return LayerOutput(name, F.scale(input.var, scale=slope,
                                      bias=intercept), size=input.size)
 
 
-def power_layer(input, weight, name=None):
+def power_layer(input, weight, name=None, layer_attr=None):
     """x ** w with per-sample scalar exponent (reference: PowerLayer) —
     a real pow, defined for non-positive inputs (exp(w*log x) is not)."""
     out = _append_simple("elementwise_pow",
@@ -837,16 +838,19 @@ def power_layer(input, weight, name=None):
     return LayerOutput(name, out, size=input.size)
 
 
-def scaling_layer(input, weight, name=None):
+def scaling_layer(input, weight, name=None, layer_attr=None):
     """Per-sample scalar times the row (reference: ScalingLayer — weight
     is a [N, 1] layer, unlike scaling_projection's parameter)."""
     return LayerOutput(name, F.elementwise_mul(input.var, weight.var),
                        size=input.size)
 
 
-def linear_comb_layer(weights, vectors, size, name=None):
+def linear_comb_layer(weights, vectors, size=None, name=None,
+                      layer_attr=None):
     """out[n] = sum_i w[n,i] * vec[n, i*size:(i+1)*size]
     (reference: LinearCombinationLayer/convex_comb_layer)."""
+    if size is None:
+        size = vectors.size // weights.size  # M weights over M groups
     n_groups = vectors.size // size
     vecs = F.reshape(vectors.var, shape=[0, n_groups, size])
     w = F.reshape(weights.var, shape=[0, n_groups, 1])
@@ -854,22 +858,33 @@ def linear_comb_layer(weights, vectors, size, name=None):
     return LayerOutput(name, out, size=size)
 
 
-def trans_layer(input, name=None):
+def trans_layer(input, name=None, layer_attr=None):
     """Transpose the [H, W]-shaped feature matrix (reference: TransLayer,
     whole-matrix transpose: batch is the matrix height)."""
     return LayerOutput(name, F.transpose(input.var, perm=[1, 0]),
                        size=input.size)
 
 
-def repeat_layer(input, num_repeats, name=None):
+def repeat_layer(input, num_repeats, as_row_vector=True, act=None,
+                 name=None, layer_attr=None):
     """Tile the feature vector num_repeats times
-    (reference: FeatureMapExpandLayer/repeat_layer)."""
-    return LayerOutput(name, F.expand(input.var,
-                                      expand_times=[1, num_repeats]),
-                       size=input.size * num_repeats)
+    (reference: FeatureMapExpandLayer/repeat_layer). as_row_vector=True
+    repeats the whole row ([a b] -> [a b a b]); False repeats each
+    element in place ([a b] -> [a a b b])."""
+    if as_row_vector:
+        out = F.expand(input.var, expand_times=[1, num_repeats])
+    else:
+        col = F.reshape(input.var, shape=[0, input.size, 1])
+        out = F.reshape(F.expand(col, expand_times=[1, 1, num_repeats]),
+                        shape=[0, input.size * num_repeats])
+    a = _act_name(act)
+    if a:
+        out = getattr(F, a)(out)
+    return LayerOutput(name, out, size=input.size * num_repeats)
 
 
-def expand_layer(input, expand_as, expand_level=0, name=None):
+def expand_layer(input, expand_as, name=None, bias_attr=False,
+                 expand_level=0, layer_attr=None):
     """Expand per-sequence rows to match expand_as's lod
     (reference: ExpandLayer -> fluid sequence_expand)."""
     if expand_level != 0:
@@ -880,14 +895,16 @@ def expand_layer(input, expand_as, expand_level=0, name=None):
                        size=input.size)
 
 
-def seq_reshape_layer(input, reshape_size, name=None):
+def seq_reshape_layer(input, reshape_size, act=None, name=None,
+                      layer_attr=None):
     """reference: SequenceReshapeLayer -> fluid sequence_reshape."""
     return LayerOutput(name, F.sequence_reshape(input.var, reshape_size),
                        size=reshape_size)
 
 
-def bilinear_interp_layer(input, out_size_x, out_size_y, num_channels=None,
-                          name=None):
+def bilinear_interp_layer(input, out_size_x=None, out_size_y=None,
+                          name=None, layer_attr=None,
+                          num_channels=None):
     """reference: BilinearInterpLayer (gserver) / bilinear_interp op."""
     img = _as_image(input, num_channels)
     var, c, h, w = img
@@ -900,26 +917,27 @@ def bilinear_interp_layer(input, out_size_x, out_size_y, num_channels=None,
     return lo
 
 
-def conv_shift_layer(a, b, name=None):
+def conv_shift_layer(a, b, name=None, layer_attr=None):
     """Circular correlation of each row of a with the (odd-width) row of b
     (reference: ConvShiftLayer)."""
     out = _append_simple("conv_shift", {"X": [a.var], "Y": [b.var]}, {})
     return LayerOutput(name, out, size=a.size)
 
 
-def block_expand_layer(input, block_x, block_y, stride_x=1, stride_y=1,
-                       padding_x=0, padding_y=0, num_channels=None,
-                       name=None):
+def block_expand_layer(input, block_x=0, block_y=0, stride_x=0,
+                       stride_y=0, padding_x=0, padding_y=0,
+                       num_channels=None, name=None, layer_attr=None):
     """Image -> sequence of patch rows (reference: BlockExpandLayer ->
     fluid im2sequence)."""
     var, c, h, w = _as_image(input, num_channels)
     out = F.im2sequence(var, filter_size=[block_y, block_x],
-                        stride=[stride_y, stride_x],
+                        stride=[stride_y or 1, stride_x or 1],
                         padding=[padding_y, padding_x])
     return LayerOutput(name, out, size=c * block_x * block_y)
 
 
-def maxout_layer(input, groups, num_channels=None, name=None):
+def maxout_layer(input, groups, num_channels=None, name=None,
+                 layer_attr=None):
     """reference: MaxOutLayer -> fluid maxout op."""
     var, c, h, w = _as_image(input, num_channels)
     out = _append_simple("maxout", {"X": [var]}, {"groups": groups})
@@ -934,7 +952,8 @@ def maxout_layer(input, groups, num_channels=None, name=None):
 #  multi_binary_label_cross_entropy, sum_cost, lambda_cost role via
 #  rank_cost; img_cmrnorm_layer over the lrn op)
 
-def rank_cost(left, right, label, name=None, coeff=1.0):
+def rank_cost(left, right, label, weight=None, name=None, coeff=1.0,
+              layer_attr=None):
     """Pairwise RankNet cost (reference: rank_cost -> RankingCost)."""
     out = _append_simple("rank_loss",
                          {"Left": [left.var], "Right": [right.var],
@@ -945,7 +964,8 @@ def rank_cost(left, right, label, name=None, coeff=1.0):
     return LayerOutput(name, cost, size=1)
 
 
-def huber_regression_cost(input, label, delta=1.0, name=None, coeff=1.0):
+def huber_regression_cost(input, label, name=None, delta=1.0,
+                          coeff=1.0, layer_attr=None):
     """reference: huber_regression_cost (HuberRegressionLoss). The op's
     optional Residual output stays unwired (the executor skips it)."""
     out = _append_simple("huber_loss",
@@ -957,7 +977,8 @@ def huber_regression_cost(input, label, delta=1.0, name=None, coeff=1.0):
     return LayerOutput(name, cost, size=1)
 
 
-def multi_binary_label_cross_entropy(input, label, name=None, coeff=1.0):
+def multi_binary_label_cross_entropy(input, label, name=None, coeff=1.0,
+                                     layer_attr=None):
     """Per-bit cross entropy on PROBABILITIES — the v1 contract (the input
     layer carries a sigmoid activation, like every sibling cost layer
     here; reference: MultiBinaryLabelCrossEntropy)."""
@@ -973,13 +994,13 @@ def multi_binary_label_cross_entropy(input, label, name=None, coeff=1.0):
     return LayerOutput(name, cost, size=1)
 
 
-def sum_cost(input, name=None):
+def sum_cost(input, name=None, layer_attr=None):
     """reference: sum_cost (SumCost — just sums the input)."""
     return LayerOutput(name, F.reduce_sum(input.var), size=1)
 
 
-def img_cmrnorm_layer(input, size=5, scale=0.0001, power=0.75,
-                      num_channels=None, name=None):
+def img_cmrnorm_layer(input, size, scale=0.0128, power=0.75,
+                      name=None, num_channels=None, layer_attr=None):
     """Cross-map response norm (reference: img_cmrnorm_layer ->
     CMRProjectionNormLayer). The v1 config_parser divides scale by size
     before it reaches the kernel (reference: config_parser.py:1352), and

@@ -762,7 +762,7 @@ def test_v1_cost_layer_tail():
             tch.huber_regression_cost(l, r, delta=1.0),
             tch.multi_binary_label_cross_entropy(xb, lb),
             tch.sum_cost(l),
-            tch.img_cmrnorm_layer(img, size=3)]
+            tch.img_cmrnorm_layer(img, size=3, scale=1e-4)]
     rng = np.random.RandomState(0)
     feed = {"l": rng.randn(3, 1).astype("float32"),
             "r": rng.randn(3, 1).astype("float32"),
