@@ -138,8 +138,8 @@ def _register_data_var(var):
     prog._data_vars_order.append(var)
 
 
-def data_layer(name, size, height=None, width=None, dtype="float32",
-               is_seq=False):
+def data_layer(name, size, depth=None, height=None, width=None,
+               layer_attr=None, dtype="float32", is_seq=False):
     """reference: layers.py data_layer — flat dense vector (or int ids when
     dtype is integral); height/width tag image shape for conv layers."""
     lod = 1 if is_seq else 0
@@ -175,7 +175,8 @@ def fc_layer(input, size, act=None, name=None, param_attr=None,
     return LayerOutput(name or var.name, var, size=size)
 
 
-def embedding_layer(input, size, name=None, param_attr=None):
+def embedding_layer(input, size, name=None, param_attr=None,
+                    layer_attr=None):
     """reference: layers.py embedding_layer (table_projection over ids)."""
     var = F.embedding(input.var, size=[input.size, size],
                       param_attr=_param(param_attr))
@@ -221,10 +222,16 @@ def img_conv_layer(input, filter_size, num_filters, name=None,
 
 
 def img_pool_layer(input, pool_size, name=None, num_channels=None,
-                   pool_type=None, stride=1, padding=0, pool_size_y=None,
-                   stride_y=None, padding_y=None, ceil_mode=True,
-                   layer_attr=None):
-    """reference: layers.py img_pool_layer."""
+                   pool_type=None, stride=1, padding=0, layer_attr=None,
+                   pool_size_y=None, stride_y=None, padding_y=None,
+                   ceil_mode=True, exclude_mode=None):
+    """reference: layers.py img_pool_layer. ``exclude_mode`` (padded-
+    border divisor choice for avg pool) is not mapped; only the default
+    is supported."""
+    if exclude_mode is not None:
+        raise NotImplementedError(
+            "img_pool_layer exclude_mode is not supported (XLA avg pool "
+            "uses the include-padding divisor)")
     var, c, h, w = _as_image(input, num_channels)
     pt = (pool_type or MaxPooling()).name
     is_sum = pt == "sum"
@@ -249,9 +256,11 @@ def img_pool_layer(input, pool_size, name=None, num_channels=None,
                        channels=c, height=oh, width=ow)
 
 
-def batch_norm_layer(input, name=None, act=None, num_channels=None,
-                     bias_attr=None, param_attr=None, layer_attr=None,
-                     use_global_stats=None, moving_average_fraction=0.9):
+def batch_norm_layer(input, act=None, name=None, img3D=False,
+                     num_channels=None, bias_attr=None, param_attr=None,
+                     layer_attr=None, batch_norm_type=None, epsilon=1e-5,
+                     moving_average_fraction=0.9, use_global_stats=None,
+                     mean_var_names=None):
     """reference: layers.py batch_norm_layer."""
     if input.channels is not None:
         var = input.var
@@ -261,13 +270,14 @@ def batch_norm_layer(input, name=None, act=None, num_channels=None,
                        param_attr=_param(param_attr),
                        bias_attr=_bias(bias_attr),
                        is_test=bool(use_global_stats),
+                       epsilon=epsilon,
                        momentum=moving_average_fraction, name=name)
     return LayerOutput(name or out.name, out, size=input.size,
                        channels=input.channels, height=input.height,
                        width=input.width)
 
 
-def addto_layer(input, name=None, act=None, bias_attr=None,
+def addto_layer(input, act=None, name=None, bias_attr=None,
                 layer_attr=None):
     """reference: layers.py addto_layer (AddtoLayer: elementwise sum +
     activation) — the residual-connection primitive."""
@@ -284,12 +294,16 @@ def addto_layer(input, name=None, act=None, bias_attr=None,
                        width=first.width)
 
 
-def concat_layer(input, name=None, act=None, layer_attr=None):
+def concat_layer(input, act=None, name=None, layer_attr=None,
+                 bias_attr=None):
     """reference: layers.py concat_layer (channel concat for images,
     feature concat for flat vectors)."""
     ins = list(input)
+    a = _act_name(act)
     if all(l.channels is not None for l in ins):
         out = F.concat([l.var for l in ins], axis=1)
+        if a:
+            out = getattr(F, a)(out)
         c = sum(l.channels for l in ins)
         first = ins[0]
         return LayerOutput(name or out.name, out,
@@ -297,6 +311,8 @@ def concat_layer(input, name=None, act=None, layer_attr=None):
                            height=first.height, width=first.width)
     flats = [_flatten(l) for l in ins]
     out = F.concat([v for v, _ in flats], axis=1)
+    if a:
+        out = getattr(F, a)(out)
     return LayerOutput(name or out.name, out,
                        size=sum(s for _, s in flats))
 
@@ -322,11 +338,14 @@ def pool_layer(input, pooling_type=None, name=None, agg_level=None,
     return LayerOutput(name or out.name, out, size=input.size)
 
 
-def lstmemory(input, name=None, reverse=False, act=None,
+def lstmemory(input, name=None, size=None, reverse=False, act=None,
               gate_act=None, state_act=None, bias_attr=None,
               param_attr=None, layer_attr=None):
     """reference: layers.py lstmemory — the v1 LSTM over a pre-projected
     input (callers project to 4*size first, as simple_lstm does)."""
+    if size is not None and size != input.size // 4:
+        raise ValueError("lstmemory size=%d but the projected input "
+                         "implies %d" % (size, input.size // 4))
     size = input.size // 4
     h, _ = F.dynamic_lstm(
         input.var, size=input.size, is_reverse=reverse,
@@ -337,9 +356,13 @@ def lstmemory(input, name=None, reverse=False, act=None,
     return LayerOutput(name or h.name, h, size=size)
 
 
-def grumemory(input, name=None, reverse=False, act=None, gate_act=None,
-              bias_attr=None, param_attr=None, layer_attr=None):
+def grumemory(input, size=None, name=None, reverse=False, act=None,
+              gate_act=None, bias_attr=None, param_attr=None,
+              layer_attr=None):
     """reference: layers.py grumemory (input pre-projected to 3*size)."""
+    if size is not None and size != input.size // 3:
+        raise ValueError("grumemory size=%d but the projected input "
+                         "implies %d" % (size, input.size // 3))
     size = input.size // 3
     h = F.dynamic_gru(
         input.var, size=size, is_reverse=reverse,
@@ -445,11 +468,13 @@ def max_id_layer(input, name=None):
     return LayerOutput(name or "max_id", out, size=1)
 
 
-def classification_cost(input, label, name=None, weight=None,
-                        evaluator=None, layer_attr=None):
+def classification_cost(input, label, weight=None, name=None,
+                        evaluator=None, layer_attr=None, coeff=1.0):
     """reference: layers.py classification_cost (softmax output assumed)."""
     cost = F.cross_entropy(input.var, label.var)
     out = F.mean(cost)
+    if coeff != 1.0:
+        out = F.scale(out, scale=coeff)
     return LayerOutput(name or out.name, out, size=1)
 
 
@@ -464,7 +489,7 @@ def cross_entropy(input, label, name=None, coeff=1.0, weight=None,
 cross_entropy_with_selfnorm = cross_entropy
 
 
-def square_error_cost(input, label, name=None, coeff=1.0,
+def square_error_cost(input, label, weight=None, name=None, coeff=1.0,
                       layer_attr=None):
     cost = F.mean(F.square_error_cost(input.var, label.var))
     if coeff != 1.0:
@@ -898,14 +923,21 @@ def expand_layer(input, expand_as, name=None, bias_attr=False,
 def seq_reshape_layer(input, reshape_size, act=None, name=None,
                       layer_attr=None):
     """reference: SequenceReshapeLayer -> fluid sequence_reshape."""
-    return LayerOutput(name, F.sequence_reshape(input.var, reshape_size),
-                       size=reshape_size)
+    out = F.sequence_reshape(input.var, reshape_size)
+    a = _act_name(act)
+    if a:
+        out = getattr(F, a)(out)
+    return LayerOutput(name, out, size=reshape_size)
 
 
 def bilinear_interp_layer(input, out_size_x=None, out_size_y=None,
                           name=None, layer_attr=None,
                           num_channels=None):
     """reference: BilinearInterpLayer (gserver) / bilinear_interp op."""
+    if out_size_x is None or out_size_y is None:
+        raise ValueError(
+            "bilinear_interp_layer needs out_size_x and out_size_y "
+            "(the v1 config asserts both)")
     img = _as_image(input, num_channels)
     var, c, h, w = img
     out = _append_simple("bilinear_interp", {"X": [var]},
