@@ -383,7 +383,18 @@ def sequence_conv(ctx):
     ctx.set_output("Out", with_lod_of(x, out))
 
 
-@register_op("context_project")
+def _infer_context_project(op, block):
+    xv = block._find_var_recursive(op.input("X")[0])
+    ov = block._find_var_recursive(op.output("Out")[0])
+    if None in (xv, ov) or xv.shape is None:
+        return
+    cl = op.attr("contextLength")
+    ov.shape = tuple(xv.shape[:-1]) + (xv.shape[-1] * int(cl),)
+    ov.dtype = xv.dtype
+    ov.lod_level = xv.lod_level
+
+
+@register_op("context_project", infer_shape=_infer_context_project)
 def context_project(ctx):
     """The context window WITHOUT the filter matmul: row i becomes the
     concat of its ctx_len neighbours (zero-padded at sequence edges) —

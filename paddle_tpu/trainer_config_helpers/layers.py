@@ -261,11 +261,15 @@ def batch_norm_layer(input, act=None, name=None, img3D=False,
                      layer_attr=None, batch_norm_type=None, epsilon=1e-5,
                      moving_average_fraction=0.9, use_global_stats=None,
                      mean_var_names=None):
-    """reference: layers.py batch_norm_layer."""
+    """reference: layers.py batch_norm_layer. Image inputs normalize per
+    channel map; flat inputs (fc outputs) normalize per feature, the
+    v1 batch-norm-on-fc case."""
     if input.channels is not None:
         var = input.var
-    else:
+    elif num_channels is not None:
         var, _, _, _ = _as_image(input, num_channels)
+    else:
+        var = input.var  # flat [N, C]: per-feature batch norm
     out = F.batch_norm(var, act=_act_name(act),
                        param_attr=_param(param_attr),
                        bias_attr=_bias(bias_attr),
@@ -472,6 +476,8 @@ def classification_cost(input, label, weight=None, name=None,
                         evaluator=None, layer_attr=None, coeff=1.0):
     """reference: layers.py classification_cost (softmax output assumed)."""
     cost = F.cross_entropy(input.var, label.var)
+    if weight is not None:
+        cost = F.elementwise_mul(cost, weight.var)
     out = F.mean(cost)
     if coeff != 1.0:
         out = F.scale(out, scale=coeff)
@@ -491,7 +497,10 @@ cross_entropy_with_selfnorm = cross_entropy
 
 def square_error_cost(input, label, weight=None, name=None, coeff=1.0,
                       layer_attr=None):
-    cost = F.mean(F.square_error_cost(input.var, label.var))
+    cost = F.square_error_cost(input.var, label.var)
+    if weight is not None:
+        cost = F.elementwise_mul(cost, weight.var)
+    cost = F.mean(cost)
     if coeff != 1.0:
         cost = F.scale(cost, scale=coeff)
     return LayerOutput(name or cost.name, cost, size=1)

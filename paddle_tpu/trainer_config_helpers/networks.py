@@ -13,7 +13,9 @@ from .layers import (batch_norm_layer, fc_layer, img_conv_layer,
 from .poolings import MaxPooling
 
 __all__ = ["simple_img_conv_pool", "img_conv_bn_pool", "simple_lstm",
-           "simple_gru", "bidirectional_lstm"]
+           "simple_gru", "bidirectional_lstm", "sequence_conv_pool",
+           "img_conv_group", "small_vgg", "bidirectional_gru",
+           "simple_attention", "dot_product_attention"]
 
 
 def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
@@ -66,21 +68,197 @@ def simple_lstm(input, size, name=None, reverse=False, mat_param_attr=None,
 
 
 def simple_gru(input, size, name=None, reverse=False, mixed_param_attr=None,
-               gru_param_attr=None, act=None, gate_act=None):
+               gru_param_attr=None, act=None, gate_act=None,
+               gru_bias_attr=None):
     fc = fc_layer(input=input, size=size * 3, act=LinearActivation(),
                   param_attr=mixed_param_attr, bias_attr=False,
                   name="%s_transform" % name if name else None)
     return grumemory(input=fc, name=name, reverse=reverse, act=act,
-                     gate_act=gate_act, param_attr=gru_param_attr)
+                     gate_act=gate_act, param_attr=gru_param_attr,
+                     bias_attr=gru_bias_attr)
 
 
 def bidirectional_lstm(input, size, name=None, return_seq=False):
+    """reference: networks.py bidirectional_lstm — return_seq=False
+    concatenates last_seq(fwd) with first_seq(bwd) (the two full-context
+    summaries), NOT a pool."""
+    from .layers import concat_layer
+    from .. import layers as F
+    from .layers import LayerOutput
     fwd = simple_lstm(input=input, size=size, reverse=False,
                       name="%s_fw" % (name or "bi_lstm"))
     bwd = simple_lstm(input=input, size=size, reverse=True,
                       name="%s_bw" % (name or "bi_lstm"))
-    from .layers import concat_layer
-    out = concat_layer(input=[fwd, bwd], name=name)
     if return_seq:
-        return out
-    return pool_layer(input=out, pooling_type=MaxPooling())
+        return concat_layer(input=[fwd, bwd], name=name)
+    fw_last = LayerOutput(None, F.sequence_last_step(fwd.var),
+                          size=fwd.size)
+    bw_first = LayerOutput(None, F.sequence_first_step(bwd.var),
+                           size=bwd.size)
+    return concat_layer(input=[fw_last, bw_first], name=name)
+
+
+def sequence_conv_pool(input, context_len, hidden_size, name=None,
+                       context_start=None, pool_type=None,
+                       context_proj_layer_name=None,
+                       context_proj_param_attr=False, fc_layer_name=None,
+                       fc_param_attr=None, fc_bias_attr=None,
+                       fc_act=None, pool_bias_attr=None,
+                       fc_attr=None, context_attr=None, pool_attr=None):
+    """Text-CNN block: context window -> fc -> sequence pool
+    (reference: networks.py sequence_conv_pool)."""
+    from .layers import context_projection, mixed_layer
+    with mixed_layer(name=context_proj_layer_name) as m:
+        m += context_projection(input, context_len=context_len,
+                                context_start=context_start,
+                                padding_attr=context_proj_param_attr)
+    proj = fc_layer(input=m, size=hidden_size, act=fc_act,
+                    name=fc_layer_name, param_attr=fc_param_attr,
+                    bias_attr=fc_bias_attr)
+    return pool_layer(input=proj,
+                      pooling_type=pool_type or MaxPooling(), name=name)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, num_channels=None,
+                   conv_padding=1, conv_filter_size=3, conv_act=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0,
+                   pool_stride=1, pool_type=None, param_attr=None):
+    """VGG-style conv stack + pool (reference: networks.py
+    img_conv_group). Scalar conv args broadcast over the group."""
+    from .layers import dropout_layer
+    n = len(conv_num_filter)
+
+    def bc(v):
+        return v if isinstance(v, (list, tuple)) else [v] * n
+
+    pads, fss, acts = bc(conv_padding), bc(conv_filter_size), bc(conv_act)
+    bns = bc(conv_with_batchnorm)
+    drops = bc(conv_batchnorm_drop_rate)
+    tmp = input
+    for i in range(n):
+        act_i = LinearActivation() if bns[i] else (acts[i]
+                                                   or ReluActivation())
+        tmp = img_conv_layer(input=tmp, filter_size=fss[i],
+                             num_filters=conv_num_filter[i],
+                             num_channels=num_channels if i == 0 else None,
+                             padding=pads[i], act=act_i,
+                             param_attr=param_attr)
+        if bns[i]:
+            tmp = batch_norm_layer(input=tmp,
+                                   act=acts[i] or ReluActivation())
+            if drops[i]:
+                tmp = dropout_layer(input=tmp, dropout_rate=drops[i])
+    return img_pool_layer(input=tmp, pool_size=pool_size,
+                          stride=pool_stride,
+                          pool_type=pool_type or MaxPooling())
+
+
+def small_vgg(input_image, num_channels, num_classes):
+    """The benchmark 'small vgg' topology (reference: networks.py
+    small_vgg -> vgg_ with groups [2,2,3,3])."""
+    from .layers import dropout_layer
+    tmp = input_image
+    channels = num_channels
+    for groups, filters in ((2, 64), (2, 128), (3, 256), (3, 512)):
+        tmp = img_conv_group(tmp, [filters] * groups, pool_size=2,
+                             num_channels=channels, pool_stride=2,
+                             conv_with_batchnorm=True)
+        channels = None
+    tmp = dropout_layer(input=tmp, dropout_rate=0.5)
+    tmp = fc_layer(input=tmp, size=512, act=LinearActivation())
+    tmp = batch_norm_layer(input=tmp, act=ReluActivation())
+    tmp = dropout_layer(input=tmp, dropout_rate=0.5)
+    from .activations import SoftmaxActivation
+    return fc_layer(input=tmp, size=num_classes, act=SoftmaxActivation())
+
+
+def bidirectional_gru(input, size, name=None, return_seq=False,
+                      fwd_act=None, fwd_gate_act=None,
+                      fwd_gru_param_attr=None, fwd_gru_bias_attr=None,
+                      bwd_act=None, bwd_gate_act=None,
+                      bwd_gru_param_attr=None, bwd_gru_bias_attr=None,
+                      concat_act=None, **extra):
+    """reference: networks.py bidirectional_gru — per-direction act/attr
+    options forwarded; return_seq=False concatenates last_seq(fwd) with
+    first_seq(bwd)."""
+    if extra:
+        raise TypeError("bidirectional_gru: unsupported options %r"
+                        % sorted(extra))
+    from .layers import concat_layer, LayerOutput
+    from .. import layers as F
+    fwd = simple_gru(input=input, size=size, reverse=False,
+                     name="%s_fw" % (name or "bi_gru"), act=fwd_act,
+                     gate_act=fwd_gate_act,
+                     gru_param_attr=fwd_gru_param_attr,
+                     gru_bias_attr=fwd_gru_bias_attr)
+    bwd = simple_gru(input=input, size=size, reverse=True,
+                     name="%s_bw" % (name or "bi_gru"), act=bwd_act,
+                     gate_act=bwd_gate_act,
+                     gru_param_attr=bwd_gru_param_attr,
+                     gru_bias_attr=bwd_gru_bias_attr)
+    if return_seq:
+        return concat_layer(input=[fwd, bwd], name=name, act=concat_act)
+    fw_last = LayerOutput(None, F.sequence_last_step(fwd.var),
+                          size=fwd.size)
+    bw_first = LayerOutput(None, F.sequence_first_step(bwd.var),
+                           size=bwd.size)
+    return concat_layer(input=[fw_last, bw_first], name=name,
+                        act=concat_act)
+
+
+def simple_attention(encoded_sequence, encoded_proj, decoder_state,
+                     transform_param_attr=None, softmax_param_attr=None,
+                     weight_act=None, name=None):
+    """Bahdanau-style additive attention (reference: networks.py
+    simple_attention): score = v . tanh(enc_proj + W s), weights softmax
+    over the sequence, output = weighted sum of encoded_sequence."""
+    from .layers import expand_layer, addto_layer, fc_layer as _fc
+    from .. import layers as F
+    decoder_proj = _fc(input=decoder_state, size=encoded_proj.size,
+                       act=LinearActivation(), bias_attr=False,
+                       param_attr=transform_param_attr)
+    expanded = expand_layer(input=decoder_proj, expand_as=encoded_proj)
+    combined = addto_layer(input=[encoded_proj, expanded],
+                           act=weight_act or TanhActivation())
+    scores = _fc(input=combined, size=1, act=LinearActivation(),
+                 bias_attr=False, param_attr=softmax_param_attr)
+    weights = F.sequence_softmax(scores.var)
+    weighted = F.elementwise_mul(encoded_sequence.var, weights)
+    ctx = F.sequence_pool(input=weighted, pool_type="sum")
+    from .layers import LayerOutput
+    return LayerOutput(name, ctx, size=encoded_sequence.size)
+
+
+def dot_product_attention(encoded_sequence, attended_sequence,
+                          transformed_state, softmax_param_attr=None,
+                          name=None):
+    """Dot-product attention (reference: networks.py
+    dot_product_attention): expand the state over the sequence, dot with
+    encoded steps, softmax, weighted-sum the attended sequence."""
+    from .layers import expand_layer, LayerOutput
+    from .. import layers as F
+    expanded = expand_layer(input=transformed_state,
+                            expand_as=encoded_sequence)
+    dots = F.reduce_sum(F.elementwise_mul(expanded.var,
+                                          encoded_sequence.var),
+                        dim=1, keep_dim=True)
+    # reduce_sum drops the ragged structure; restore it from the sequence
+    dots = F.lod_reset(dots, y=encoded_sequence.var)
+    # the reference applies a trainable size-1 fc (a learned scale) to
+    # the dots before the sequence softmax (networks.py fc_layer(size=1));
+    # realized as a [1] parameter multiply (the dots are already scalar
+    # per step, so fc(size=1) == elementwise scale)
+    from .attrs import ParameterAttribute as _PA
+    from ..layers.layer_helper import LayerHelper
+    from ..param_attr import ParamAttr as _FPA
+    helper = LayerHelper("dot_attn_scale")
+    pa = (softmax_param_attr.to_fluid()
+          if isinstance(softmax_param_attr, _PA)
+          else (softmax_param_attr or _FPA()))
+    w = helper.create_parameter(attr=pa, shape=[1], dtype="float32")
+    scaled_dots = F.lod_reset(F.elementwise_mul(dots, w),
+                              y=encoded_sequence.var)
+    weights = F.sequence_softmax(scaled_dots)
+    weighted = F.elementwise_mul(attended_sequence.var, weights)
+    ctx = F.sequence_pool(input=weighted, pool_type="sum")
+    return LayerOutput(name, ctx, size=attended_sequence.size)

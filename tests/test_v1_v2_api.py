@@ -844,3 +844,78 @@ def test_v1_crf_and_ctc_layers():
                                        fetch_list=[ctc.var])[0])
                     .reshape(-1)[0]) for _ in range(10)]
         assert np.isfinite(ls).all() and ls[-1] < ls[0]
+
+
+def test_v1_network_combinators():
+    """sequence_conv_pool (text CNN), img_conv_group/small_vgg blocks,
+    bidirectional_gru, simple_attention, dot_product_attention
+    (reference: networks.py combinators)."""
+    from paddle_tpu import trainer_config_helpers as tch
+    from paddle_tpu.trainer_config_helpers import networks as N
+    from paddle_tpu.core.lod import LoDTensor
+
+    # text CNN + bidirectional gru over a ragged batch
+    main, startup = _fresh()
+    words = tch.data_layer("w", size=100, dtype="int64", is_seq=True)
+    emb = tch.embedding_layer(input=words, size=12)
+    cnn = N.sequence_conv_pool(input=emb, context_len=3, hidden_size=8)
+    bg = N.bidirectional_gru(input=tch.fc_layer(emb, size=9), size=3)
+    lbl = tch.data_layer("y", size=2, dtype="int64")
+    pred = tch.fc_layer(input=[cnn, bg], size=2,
+                        act=tch.SoftmaxActivation())
+    cost = tch.classification_cost(input=pred, label=lbl)
+    fluid.Adam(learning_rate=0.02).minimize(cost.var)
+    rng = np.random.RandomState(0)
+    seqs, offs, ys = [], [0], []
+    for i in range(6):
+        L = rng.randint(3, 7)
+        y = i % 2
+        seqs.append(rng.randint(y * 50, y * 50 + 50, (L, 1)).astype(
+            "int64"))
+        offs.append(offs[-1] + L)
+        ys.append([y])
+    feed = {"w": LoDTensor(np.concatenate(seqs), [offs]),
+            "y": np.asarray(ys, dtype="int64")}
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        ls = [float(np.asarray(exe.run(main, feed=feed,
+                                       fetch_list=[cost.var])[0])
+                    .reshape(-1)[0]) for _ in range(20)]
+        assert ls[-1] < ls[0] * 0.7, (ls[0], ls[-1])
+
+    # attention combinators produce per-decoder-step contexts
+    main2, startup2 = _fresh()
+    enc = tch.data_layer("enc", size=6, is_seq=True)
+    enc_proj = tch.fc_layer(enc, size=6)
+    state = tch.data_layer("st", size=4)
+    ctx = N.simple_attention(encoded_sequence=enc, encoded_proj=enc_proj,
+                            decoder_state=state)
+    tstate = tch.fc_layer(state, size=6)
+    ctx2 = N.dot_product_attention(encoded_sequence=enc_proj,
+                                   attended_sequence=enc,
+                                   transformed_state=tstate)
+    data = rng.rand(5, 6).astype("float32")
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup2)
+        c1, c2 = exe.run(main2, feed={
+            "enc": LoDTensor(data, [[0, 2, 5]]),
+            "st": rng.rand(2, 4).astype("float32")},
+            fetch_list=[ctx.var, ctx2.var])
+        assert np.asarray(c1).shape == (2, 6)
+        assert np.asarray(c2).shape == (2, 6)
+        assert np.isfinite(np.asarray(c1)).all()
+
+    # small_vgg builds and runs forward (tiny image)
+    main3, startup3 = _fresh()
+    img = tch.data_layer("img", size=3 * 16 * 16, height=16, width=16)
+    pred3 = N.small_vgg(img, num_channels=3, num_classes=4)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup3)
+        p3, = exe.run(main3, feed={"img": rng.rand(2, 768).astype(
+            "float32")}, fetch_list=[pred3.var])
+        assert np.asarray(p3).shape == (2, 4)
+        np.testing.assert_allclose(np.asarray(p3).sum(1), np.ones(2),
+                                   rtol=1e-5)
