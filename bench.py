@@ -1,94 +1,210 @@
-"""Headline benchmark: ResNet-50 training throughput + MFU, one chip.
+"""Headline benchmark: ResNet-50 training throughput + MFU.
 
-Prints progressive JSON lines {"metric", "value", "unit", "vs_baseline", ...}
-to stdout — the LAST line is the final result. Status goes to stderr. A
-watchdog guarantees a JSON line is printed and the process exits 0 before
-the time budget expires, no matter where compilation or device init stalls
-(BENCH_BUDGET_SEC, default 1500).
+Failure-proof staged harness (VERDICT r2 item 1). The parent process
+imports NO jax: it spawns two children and merges their stdout JSON —
+
+  * an ``axon`` child (the real TPU chip behind the tunnel) that pays
+    device init ONCE in a single long-lived process and then walks an
+    escalating stage ladder: tiny-matmul probe -> ResNet-50 bs32 ->
+    ResNet-50 bs128 step-fused -> AMP-off comparison; and
+  * a ``cpu`` child (JAX_PLATFORMS=cpu) that banks a small-but-real
+    ResNet-50 number within minutes, so a hung device tunnel can never
+    again produce value 0.0 (BENCH_r01 rc=124, BENCH_r02 value 0.0 both
+    died inside device init — observed >25 min stalls in jax.devices()).
+
+Every improvement is printed immediately as a JSON line; the LAST stdout
+line is the final result. The parent guarantees that line exists and
+exits 0 before BENCH_BUDGET_SEC (default 1500) expires, no matter where
+a child stalls. Status/heartbeats go to stderr.
 
 Baseline: the reference's best published single-device ResNet-50 training
 number, 84.08 images/sec (reference: benchmark/IntelOptimizedPaddle.md:40-46,
 2S Xeon 6148; its GPU tables stop at AlexNet/GoogLeNet on K40m). See
-BASELINE.md. MFU is flops-based: XLA's compiled cost analysis when
-available, else the analytic ~3x forward FLOPs estimate, against the
-device's peak bf16 TFLOP/s.
+BASELINE.md. MFU is flops-based against the chip's peak bf16 TFLOP/s
+(generation from PALLAS_AXON_TPU_GEN when set).
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import threading
 import time
 
-import numpy as np
-
 # single source for per-model baselines: benchmark/baselines.py
 # (dependency-free; values transcribed from BASELINE.md)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 try:
     from benchmark.baselines import REF_BASELINES as _REF
     BASELINE_IMG_S = _REF["resnet50"]
 except Exception:  # driver may run bench.py from an odd cwd
     BASELINE_IMG_S = 84.08
-BUDGET_SEC = float(os.environ.get("BENCH_BUDGET_SEC", "1500"))
+
 _T0 = time.time()
+BUDGET_SEC = float(os.environ.get("BENCH_BUDGET_SEC", "1500"))
+# absolute wall deadline shared with children; parent reserves a margin
+DEADLINE = float(os.environ.get("BENCH_DEADLINE_UNIX", _T0 + BUDGET_SEC - 15))
 
 # peak bf16 FLOP/s per chip by TPU generation (public spec sheets)
 _PEAK_FLOPS = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
 # training step ~= 3x forward; ResNet-50 fwd @224 ~= 3.8 GFLOP/image
 _ANALYTIC_FLOPS_PER_IMG = 3 * 3.8e9
 
-_best = {"line": None}
-_lock = threading.Lock()
+METRIC = "resnet50_train_images_per_sec_per_chip"
 
 
-def _emit(result):
-    line = json.dumps(result)
-    with _lock:
-        _best["line"] = line
-        print(line, flush=True)
-
-
-def _log(msg):
-    print("[bench %6.1fs] %s" % (time.time() - _T0, msg), file=sys.stderr,
-          flush=True)
-
-
-def _watchdog():
-    deadline = _T0 + BUDGET_SEC
-    while True:
-        time.sleep(5)
-        if time.time() >= deadline:
-            with _lock:  # _emit prints under this lock, so the last
-                # stdout line is always a complete JSON record
-                if _best["line"] is None:
-                    print(json.dumps({
-                        "metric": "resnet50_train_images_per_sec_per_chip",
-                        "value": 0.0, "unit": "images/sec",
-                        "vs_baseline": 0.0,
-                        "error": "budget expired before any measurement "
-                                 "completed (device init or compile stall)",
-                    }), flush=True)
-            _log("watchdog: budget %.0fs expired, exiting" % BUDGET_SEC)
-            os._exit(0)
+def _log(tag, msg):
+    print("[bench %s %6.1fs] %s" % (tag, time.time() - _T0, msg),
+          file=sys.stderr, flush=True)
 
 
 def _remaining():
-    return BUDGET_SEC - (time.time() - _T0)
+    return DEADLINE - time.time()
 
+
+# ---------------------------------------------------------------------------
+# parent: orchestrate children, merge progressive JSON, guarantee the line
+# ---------------------------------------------------------------------------
+
+def parent_main():
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache")
+    base_env = dict(os.environ)
+    base_env.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+    base_env["BENCH_DEADLINE_UNIX"] = repr(DEADLINE)
+
+    state = {"best": None, "best_tag": None, "probe": {}, "final": False}
+    lock = threading.Lock()
+
+    def merge(rec, tag):
+        """Fold one child record into the best-known headline and print it."""
+        with lock:
+            if state["final"]:
+                return  # the final line has been printed; stay last
+            if rec.get("kind") == "probe":
+                # per-child: a CPU probe must never decorate a TPU headline
+                state["probe"][tag] = {
+                    k: v for k, v in rec.items() if k != "kind"}
+                return
+            rec.pop("kind", None)
+            best = state["best"]
+            # prefer higher throughput; a TPU number also beats a CPU
+            # number of any size (the metric is per-*chip*). >= so a
+            # same-value record enriched with extra fields (the AMP-off
+            # comparison) replaces the plain one.
+            better = best is None or (
+                (rec.get("platform") != "cpu", rec.get("value", 0.0))
+                >= (best.get("platform") != "cpu", best.get("value", 0.0)))
+            if better:
+                state["best"], state["best_tag"] = rec, tag
+                out = dict(rec)
+                for k, v in state["probe"].get(tag, {}).items():
+                    out.setdefault(k, v)
+                print(json.dumps(out), flush=True)
+
+    def reader(proc, tag):
+        for raw in iter(proc.stdout.readline, b""):
+            line = raw.decode("utf-8", "replace").strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                _log(tag, "non-json stdout: %s" % line[:200])
+                continue
+            merge(rec, tag)
+        proc.stdout.close()
+
+    def spawn(child, env):
+        p = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child", child],
+            stdout=subprocess.PIPE, stderr=sys.stderr, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        t = threading.Thread(target=reader, args=(p, child), daemon=True)
+        t.start()
+        return p, t
+
+    procs = []
+    # CPU safety child first: banks a real number in minutes
+    cpu_env = dict(base_env)
+    cpu_env["JAX_PLATFORMS"] = "cpu"
+    procs.append(("cpu",) + spawn("cpu", cpu_env))
+    # the real measurement: single long-lived device process
+    if os.environ.get("JAX_PLATFORMS", "axon") != "cpu":
+        procs.append(("axon",) + spawn("axon", base_env))
+
+    while _remaining() > 0 and any(p.poll() is None for _, p, _t in procs):
+        time.sleep(2)
+        # once the axon child has exited with a TPU headline, the CPU
+        # safety child can never improve the result (TPU outranks CPU in
+        # merge) — stop burning the budget on its compile grind
+        axon_done = all(p.poll() is not None
+                        for tag, p, _t in procs if tag == "axon")
+        with lock:
+            have_tpu = (state["best"] is not None
+                        and state["best"].get("platform") != "cpu")
+        if axon_done and have_tpu:
+            for tag, p, _t in procs:
+                if tag == "cpu" and p.poll() is None:
+                    _log("parent", "TPU result final: stopping cpu child")
+                    p.kill()
+
+    for tag, p, _t in procs:
+        if p.poll() is None:
+            _log("parent", "deadline: killing %s child" % tag)
+            p.kill()
+    # drain buffered child stdout so an already-emitted result is not lost
+    # to the exit race (the contract is: LAST stdout line = final result)
+    for _tag, _p, t in procs:
+        t.join(timeout=5)
+
+    with lock:
+        state["final"] = True
+        if state["best"] is None:
+            print(json.dumps({
+                "metric": METRIC, "value": 0.0, "unit": "images/sec",
+                "vs_baseline": 0.0,
+                "error": "no stage completed before the budget expired",
+            }), flush=True)
+        else:
+            out = dict(state["best"])
+            for k, v in state["probe"].get(state["best_tag"], {}).items():
+                out.setdefault(k, v)
+            print(json.dumps(out), flush=True)
+    _log("parent", "done (budget %.0fs, used %.0fs)"
+         % (BUDGET_SEC, time.time() - _T0))
+    # reader threads are daemons; a wedged child already got SIGKILL
+    os._exit(0)
+
+
+# ---------------------------------------------------------------------------
+# children: one process, one platform, an escalating stage ladder
+# ---------------------------------------------------------------------------
 
 def _peak_flops(dev):
+    if getattr(dev, "platform", "") == "cpu":
+        # nominal; MFU on CPU is not meaningful. Checked FIRST: the CPU
+        # safety child inherits PALLAS_AXON_TPU_GEN from the parent env
+        # and must not score itself against a TPU's peak.
+        return 1e12
+    # the device's own kind wins; the env generation hint is the fallback
+    # for tunnelled devices that report an opaque kind
     kind = (getattr(dev, "device_kind", "") or "").lower()
     for gen, peak in _PEAK_FLOPS.items():
         if gen in kind:
             return peak
-    plat = getattr(dev, "platform", "")
-    if plat == "cpu":
-        return 1e12  # nominal; MFU on CPU is not meaningful
+    gen_env = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    if gen_env in _PEAK_FLOPS:
+        return _PEAK_FLOPS[gen_env]
     return _PEAK_FLOPS["v5e"]  # tunnelled single-chip default
 
 
-def _build_program(pt, layers, models, batch, amp_on):
+def _emit(rec):
+    print(json.dumps(rec), flush=True)
+
+
+def _build_program(pt, layers, models, amp_on):
     main_p, startup = pt.Program(), pt.Program()
     pt.switch_main_program(main_p)
     pt.switch_startup_program(startup)
@@ -101,27 +217,28 @@ def _build_program(pt, layers, models, batch, amp_on):
     if amp_on:
         # bf16 matmul/conv with f32 accumulation: the MXU's native precision
         pt.amp.enable(main_p)
-    return main_p, startup, avg
+    return main_p, avg
 
 
-def _measure(pt, layers, models, batch, steps, fuse, amp_on, scope):
+def _measure(pt, layers, models, tag, batch, steps, fuse, amp_on):
     """Build + compile + time `steps` training steps; returns img/s."""
-    import jax
-    main_p, startup, avg = _build_program(pt, layers, models, batch, amp_on)
-    with pt.scope_guard(scope):
+    import numpy as np
+    main_p, avg = _build_program(pt, layers, models, amp_on)
+    with pt.scope_guard(pt.Scope()):
         exe = pt.Executor(pt.TPUPlace(0))
-        exe.run(startup)
+        exe.run(pt.default_startup_program())
         rng = np.random.RandomState(0)
         feed = exe.prepare_feed(
             {"img": rng.rand(batch, 3, 224, 224).astype("float32"),
              "label": rng.randint(0, 1000, (batch, 1)).astype("int64")})
-        _log("compiling batch=%d fuse=%d amp=%s ..." % (batch, fuse, amp_on))
+        _log(tag, "compiling batch=%d fuse=%d amp=%s ..."
+             % (batch, fuse, amp_on))
         tc = time.time()
         loss, = exe.run(main_p, feed=feed, fetch_list=[avg],
                         return_numpy=False, repeat=fuse)
         loss = np.asarray(loss)  # sync
-        _log("compile+first run %.1fs, loss=%.4f" % (time.time() - tc,
-                                                     float(loss.reshape(-1)[0])))
+        _log(tag, "compile+first run %.1fs, loss=%.4f"
+             % (time.time() - tc, float(loss.reshape(-1)[0])))
         # the device can be externally contended (shared/tunnelled chip:
         # observed >10x swings between identical runs) — time several
         # windows and report the best, which is the least-contended sample
@@ -139,16 +256,17 @@ def _measure(pt, layers, models, batch, steps, fuse, amp_on, scope):
             if _remaining() < 60:
                 break
     img_s = batch * fuse * iters / best_dt
-    _log("batch=%d fuse=%d amp=%s: %.2f img/s best-of-%d (%.1f ms/step)"
+    _log(tag, "batch=%d fuse=%d amp=%s: %.2f img/s best-of-%d (%.1f ms/step)"
          % (batch, fuse, amp_on, img_s, windows_done,
             1e3 * best_dt / (fuse * iters)))
     return img_s
 
 
-def _autotune_conv():
+def _autotune_conv(tag):
     """Pick the dense-conv lowering empirically on the real device: time one
     ResNet-middle conv layer (fwd+bwd) as lax.conv vs shifted-matmul and pin
-    PADDLE_TPU_CONV_IMPL to the winner. ~2 small compiles, bounded cost.
+    PADDLE_TPU_CONV_IMPL to the winner. The pick is persisted next to the
+    compilation cache so repeat runs (and the driver's run) skip it.
 
     Timing caveats this must survive (tunnelled PJRT device):
     - ``block_until_ready`` can return before the work actually ran — only a
@@ -162,10 +280,34 @@ def _autotune_conv():
         return os.environ["PADDLE_TPU_CONV_IMPL"]
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     if jax.devices()[0].platform == "cpu":
-        # nothing to tune off-TPU, and the chained-grad timing loop can eat
-        # minutes of the budget on a CPU backend
+        # nothing to tune off-TPU — and the cached pick below is a *TPU*
+        # pick; the shifted-matmul lowering it may name can eat minutes of
+        # the budget on a CPU backend
+        os.environ["PADDLE_TPU_CONV_IMPL"] = "conv"
+        return "conv"
+    # the pick is device-specific: key the cache on the chip identity so a
+    # pick measured on one generation is never reused on another
+    dev_key = "%s|%s" % (getattr(jax.devices()[0], "device_kind", "?"),
+                         os.environ.get("PALLAS_AXON_TPU_GEN", ""))
+    cache = os.path.join(os.environ.get("JAX_COMPILATION_CACHE_DIR", "."),
+                         "conv_autotune.json")
+    try:
+        with open(cache) as f:
+            rec = json.load(f)
+        if rec.get("device") == dev_key:
+            pick = rec["pick"]
+            _log(tag, "conv autotune: cached pick=%s" % pick)
+            os.environ["PADDLE_TPU_CONV_IMPL"] = pick
+            return pick
+        _log(tag, "conv autotune cache is for %r, not %r — retuning"
+             % (rec.get("device"), dev_key))
+    except Exception:
+        pass
+    if _remaining() < 300:
+        # near the deadline the two extra compiles are not worth the risk
         os.environ["PADDLE_TPU_CONV_IMPL"] = "conv"
         return "conv"
 
@@ -218,87 +360,142 @@ def _autotune_conv():
         tn = time_impl(native)
         tm = time_impl(matmul)
         pick = "conv" if tn <= tm else "matmul"
-        _log("conv autotune: native=%.1fms matmul=%.1fms -> %s"
+        _log(tag, "conv autotune: native=%.1fms matmul=%.1fms -> %s"
              % (1e3 * tn, 1e3 * tm, pick))
+        try:
+            os.makedirs(os.path.dirname(cache), exist_ok=True)
+            with open(cache, "w") as f:
+                json.dump({"pick": pick, "device": dev_key,
+                           "native_ms": 1e3 * tn,
+                           "matmul_ms": 1e3 * tm}, f)
+        except Exception as e:
+            _log(tag, "could not persist conv pick: %r" % e)
     except Exception as e:
         pick = "conv"
-        _log("conv autotune failed (%s), defaulting to native conv" % e)
+        _log(tag, "conv autotune failed (%s), defaulting to native conv" % e)
     os.environ["PADDLE_TPU_CONV_IMPL"] = pick
     return pick
 
 
-def main():
-    threading.Thread(target=_watchdog, daemon=True).start()
+def child_main(tag):
+    import numpy as np
 
-    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
-    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 16
-
-    # persistent compilation cache: repeat runs (and the small->large
-    # progression) skip recompiles across processes
-    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             ".jax_cache")
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
     import jax
+    if tag == "cpu":
+        # the env image's sitecustomize snapshots JAX_PLATFORMS=axon at
+        # interpreter start, so the env var alone is too late — force the
+        # config before any backend initializes (same fix as tests/conftest)
+        jax.config.update("jax_platforms", "cpu")
     try:
-        jax.config.update("jax_compilation_cache_dir",
-                          os.environ["JAX_COMPILATION_CACHE_DIR"])
+        if cache_dir:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass
 
-    _log("initializing device ...")
+    _log(tag, "initializing device ...")
+    t0 = time.time()
     dev = jax.devices()[0]
-    _log("device: %s (%s)" % (dev, getattr(dev, "device_kind", "?")))
-    # touch the device so init cost doesn't pollute the first measurement
-    import jax.numpy as jnp
-    jnp.ones((128, 128)).block_until_ready()
+    _log(tag, "device up in %.1fs: %s (%s)"
+         % (time.time() - t0, dev, getattr(dev, "device_kind", "?")))
+    peak = _peak_flops(dev)
+    platform = dev.platform
 
-    conv_pick = _autotune_conv()
+    # stage A: tiny matmul probe — proves the device answers, measures
+    # achievable dense TFLOP/s as context for the MFU number
+    import jax.numpy as jnp
+    n = 4096 if platform != "cpu" else 1024
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    a = jax.random.normal(k1, (n, n), jnp.bfloat16)
+    b = jax.random.normal(k2, (n, n), jnp.bfloat16)
+
+    @jax.jit
+    def mm_chain(a_, b_):
+        def body(c, _):
+            c = (a_ + c * 1e-30) @ b_
+            return c, None
+        return jax.lax.scan(body, jnp.zeros_like(a_), None, length=8)[0]
+
+    # read back a 1x1 slice: still a true host-transfer sync over the
+    # tunnel, without timing the full 33 MB result payload
+    float(np.asarray(mm_chain(a, b)[:1, :1]).astype(np.float32))  # compile
+    t0 = time.perf_counter()
+    float(np.asarray(mm_chain(a, b)[:1, :1]).astype(np.float32))
+    dt = (time.perf_counter() - t0) / 8
+    tflops = 2 * n ** 3 / dt / 1e12
+    _log(tag, "probe matmul %dx%d: %.1f TFLOP/s (peak %.0f)"
+         % (n, n, tflops, peak / 1e12))
+    _emit({"kind": "probe", "probe_tflops": round(tflops, 1),
+           "device_kind": getattr(dev, "device_kind", "?")})
+
+    conv_pick = _autotune_conv(tag)
 
     import paddle_tpu as pt
     from paddle_tpu import layers, models
 
-    peak = _peak_flops(dev)
+    def headline(img_s, bs, extra=None):
+        rec = {"kind": "headline", "metric": METRIC,
+               "value": round(img_s, 2), "unit": "images/sec",
+               "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+               "batch": bs, "platform": platform, "conv_impl": conv_pick,
+               "mfu": round(img_s * _ANALYTIC_FLOPS_PER_IMG / peak, 4)}
+        rec.update(extra or {})
+        return rec
 
-    def result(img_s, bs, extra=None):
-        r = {"metric": "resnet50_train_images_per_sec_per_chip",
-             "value": round(img_s, 2), "unit": "images/sec",
-             "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
-             "batch": bs, "conv_impl": conv_pick,
-             "mfu": round(img_s * _ANALYTIC_FLOPS_PER_IMG / peak, 4)}
-        r.update(extra or {})
-        return r
-
-    # phase 1: small config — guarantees a number exists early
-    small_bs = min(32, batch)
-    img_s = _measure(pt, layers, models, small_bs, steps=4, fuse=1,
-                     amp_on=True, scope=pt.Scope())
-    _emit(result(img_s, small_bs, {"phase": "small"}))
-
-    # phase 2: full config, step-fused
-    if _remaining() > 120:
-        fuse = 4
-        img_s_full = _measure(pt, layers, models, batch, steps=steps,
-                              fuse=fuse, amp_on=True, scope=pt.Scope())
-        final = result(max(img_s_full, img_s),
-                       batch if img_s_full >= img_s else small_bs)
-        _emit(final)
+    if platform == "cpu":
+        ladder = [  # (batch, steps, fuse, amp)
+            (8, 2, 1, True),
+            (32, 4, 2, True),
+        ]
     else:
-        final = result(img_s, small_bs)
+        # `python bench.py <batch> <steps>` customizes the big stage
+        big_bs = int(os.environ.get("BENCH_BATCH", "128"))
+        big_steps = int(os.environ.get("BENCH_STEPS", "16"))
+        ladder = [
+            (min(32, big_bs), 4, 1, True),
+            (big_bs, big_steps, max(big_steps // 4, 1), True),
+        ]
 
-    # phase 3: AMP-off comparison (VERDICT r1 item 5 — prove AMP on-device)
-    if _remaining() > 120:
+    final = None
+    for batch, steps, fuse, amp in ladder:
+        if final is not None and _remaining() < 150:
+            _log(tag, "skipping batch=%d stage: %.0fs left"
+                 % (batch, _remaining()))
+            break
         try:
-            img_s_noamp = _measure(pt, layers, models, batch, steps=max(
-                steps // 2, 4), fuse=2, amp_on=False, scope=pt.Scope())
+            img_s = _measure(pt, layers, models, tag, batch, steps, fuse, amp)
+        except Exception as e:
+            _log(tag, "stage batch=%d failed: %r" % (batch, e))
+            continue
+        rec = headline(img_s, batch)
+        if final is None or rec["value"] > final["value"]:
+            final = rec
+        _emit(final)
+
+    # AMP-off comparison (kept from r2: proves bf16 wins on-device)
+    if final is not None and platform != "cpu" and _remaining() > 150:
+        try:
+            img_s_noamp = _measure(pt, layers, models, tag, final["batch"],
+                                   steps=8, fuse=2, amp_on=False)
             final = dict(final)
             final["amp_off_img_s"] = round(img_s_noamp, 2)
-            final["amp_speedup"] = round(final["value"]
-                                         / max(img_s_noamp, 1e-9), 3)
+            final["amp_speedup"] = round(
+                final["value"] / max(img_s_noamp, 1e-9), 3)
             _emit(final)
         except Exception as e:  # comparison is best-effort
-            _log("amp-off phase failed: %s" % e)
+            _log(tag, "amp-off phase failed: %r" % e)
+    _log(tag, "child done")
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        child_main(sys.argv[2])
+    else:
+        # legacy CLI contract: `python bench.py [batch [steps]]` bounds the
+        # device child's big stage (forwarded via env, not dropped)
+        if len(sys.argv) > 1:
+            os.environ["BENCH_BATCH"] = str(int(sys.argv[1]))
+        if len(sys.argv) > 2:
+            os.environ["BENCH_STEPS"] = str(int(sys.argv[2]))
+        parent_main()

@@ -53,8 +53,17 @@ def cmd_train(args):
 def cmd_bench(args):
     sys.argv = [sys.argv[0]] + (args.extra or [])
     if args.suite == "resnet":
+        import os
+
         import bench
-        bench.main()
+        # bench.py's CLI contract (batch/steps) rides env vars into the
+        # device child; replicate it for `paddle_tpu bench resnet B S`
+        extra = args.extra or []
+        if len(extra) > 0:
+            os.environ["BENCH_BATCH"] = str(int(extra[0]))
+        if len(extra) > 1:
+            os.environ["BENCH_STEPS"] = str(int(extra[1]))
+        bench.parent_main()
     elif args.suite == "image":
         from benchmark import image_bench
         print(json.dumps(image_bench.bench(model=args.model or "resnet50",

@@ -158,25 +158,48 @@ class DistributeTranspiler(object):
                                 w.shape[0] % ax_size == 0:
                             dist_tables.add(w.name)
         specs: Dict[str, P] = {}
+        param_specs: Dict[str, Tuple[P, Tuple]] = {}
         for v in program.list_vars():
-            if isinstance(v, ir.Parameter) or v.persistable:
-                # optimizer accumulators follow their parameter (they are
-                # created as <param>_<suffix> persistable non-Parameter
-                # vars by optimizer.py; the Parameter guard keeps sibling
-                # weights like "<table>_proj" out)
-                base_table = next(
-                    (t for t in dist_tables
-                     if v.name == t or (not isinstance(v, ir.Parameter)
-                                        and v.name.startswith(t + "_"))),
-                    None)
+            if isinstance(v, ir.Parameter):
                 explicit = any(re.search(pat, v.name)
                                for pat, _ in strategy.param_rules)
-                if base_table is not None and not explicit and v.shape \
+                if v.name in dist_tables and not explicit and v.shape \
                         and v.shape[0] % mesh.shape[emb_axis] == 0:
-                    specs[v.name] = P(emb_axis)
+                    spec = P(emb_axis)
                 else:
                     # explicit param_rules win over the automatic
                     # is_distributed row-sharding (first hit wins contract)
+                    spec = strategy.spec_for_param(
+                        v.name, v.shape or (), mesh)
+                param_specs[v.name] = (spec, tuple(v.shape or ()))
+                specs[v.name] = spec
+        # optimizer accumulators follow their parameter EXACTLY (they are
+        # created as <param>_<suffix> persistable non-Parameter vars by
+        # optimizer.py). They must not re-derive a spec of their own: a
+        # `$`-anchored param_rule that matches `fc.w_0` but not
+        # `fc.w_0_velocity_0` would let the accumulator fall through to
+        # zero_axis, and the mismatched update op then forces GSPMD into
+        # replicate-then-repartition resharding of the grad ("[SPMD]
+        # Involuntary full rematerialization", MULTICHIP_r02). Longest
+        # parameter-name prefix wins so a sibling parameter like
+        # "<table>_proj" (itself a Parameter, matched above) never
+        # captures another parameter's accumulators.
+        by_len = sorted(param_specs, key=len, reverse=True)
+        for v in program.list_vars():
+            if v.persistable and v.name not in specs:
+                # an explicit rule hitting the accumulator's own name still
+                # wins (first-hit-wins contract) — co-sharding is only the
+                # default for rule-less accumulators
+                explicit = any(re.search(pat, v.name)
+                               for pat, _ in strategy.param_rules)
+                owner = None if explicit else next(
+                    (p for p in by_len if v.name.startswith(p + "_")), None)
+                if owner is not None and \
+                        tuple(v.shape or ()) == param_specs[owner][1]:
+                    # same-shaped accumulator (velocity/moment): co-shard
+                    specs[v.name] = param_specs[owner][0]
+                else:
+                    # scalars (beta_pow), LR vars, unrelated persistables
                     specs[v.name] = strategy.spec_for_param(
                         v.name, v.shape or (), mesh)
         # grad vars follow their parameter's spec
