@@ -262,11 +262,24 @@ def _measure(pt, layers, models, tag, batch, steps, fuse, amp_on):
     return img_s
 
 
+_TUNE_DEFAULTS = {"PADDLE_TPU_CONV_IMPL": "conv",
+                  "PADDLE_TPU_CONV_LAYOUT": "nchw",
+                  "PADDLE_TPU_CONV_S2D": "0"}
+
+
 def _autotune_conv(tag):
-    """Pick the dense-conv lowering empirically on the real device: time one
-    ResNet-middle conv layer (fwd+bwd) as lax.conv vs shifted-matmul and pin
-    PADDLE_TPU_CONV_IMPL to the winner. The pick is persisted next to the
-    compilation cache so repeat runs (and the driver's run) skip it.
+    """Empirically pick the conv lowering config on the real device and pin
+    it via env (the framework reads these at trace time):
+
+    - PADDLE_TPU_CONV_IMPL:   lax.conv vs KH*KW shifted einsums, timed on a
+      ResNet-middle 3x3 conv (fwd+bwd);
+    - PADDLE_TPU_CONV_LAYOUT: nchw passthrough vs nhwc-internal (channel
+      dim on the vector lanes), same middle conv;
+    - PADDLE_TPU_CONV_S2D:    ImageNet stem 7x7/s2 direct vs space-to-depth
+      + 4x4/s1 (4x lane utilization on the 3-channel input).
+
+    All three picks persist next to the compilation cache keyed on chip
+    identity, so repeat runs (and the driver's run) skip the sweep.
 
     Timing caveats this must survive (tunnelled PJRT device):
     - ``block_until_ready`` can return before the work actually ran — only a
@@ -274,21 +287,31 @@ def _autotune_conv(tag):
     - loop-invariant code hoists: the timed op must consume the loop carry
       and feed it, or XLA runs it once (or never — constant inputs fold).
     So: random inputs, iterations chained through a carry that perturbs the
-    input, one host read-back at the end, best-of-2 trials per impl.
+    input, one 1x1-slice host read-back at the end, best-of-2 trials per
+    candidate.
     """
-    if "PADDLE_TPU_CONV_IMPL" in os.environ:
-        return os.environ["PADDLE_TPU_CONV_IMPL"]
     import jax
     import jax.numpy as jnp
     import numpy as np
 
+    overridden = {k: os.environ[k] for k in _TUNE_DEFAULTS
+                  if k in os.environ}
+
+    def pin(picks):
+        for k, v in _TUNE_DEFAULTS.items():
+            os.environ[k] = picks.get(k, v)
+        os.environ.update(overridden)  # explicit env wins over the tuner
+        return {k: os.environ[k] for k in _TUNE_DEFAULTS}
+
+    if set(_TUNE_DEFAULTS) <= set(overridden):
+        _log(tag, "conv autotune: all picks pinned by env, skipping sweep")
+        return pin({})
     if jax.devices()[0].platform == "cpu":
-        # nothing to tune off-TPU — and the cached pick below is a *TPU*
-        # pick; the shifted-matmul lowering it may name can eat minutes of
-        # the budget on a CPU backend
-        os.environ["PADDLE_TPU_CONV_IMPL"] = "conv"
-        return "conv"
-    # the pick is device-specific: key the cache on the chip identity so a
+        # nothing to tune off-TPU — and the cached picks below are *TPU*
+        # picks; the shifted-matmul lowering they may name can eat minutes
+        # of the budget on a CPU backend
+        return pin({})
+    # picks are device-specific: key the cache on the chip identity so a
     # pick measured on one generation is never reused on another
     dev_key = "%s|%s" % (getattr(jax.devices()[0], "device_kind", "?"),
                          os.environ.get("PALLAS_AXON_TPU_GEN", ""))
@@ -298,83 +321,113 @@ def _autotune_conv(tag):
         with open(cache) as f:
             rec = json.load(f)
         if rec.get("device") == dev_key:
-            pick = rec["pick"]
-            _log(tag, "conv autotune: cached pick=%s" % pick)
-            os.environ["PADDLE_TPU_CONV_IMPL"] = pick
-            return pick
+            _log(tag, "conv autotune: cached picks=%s" % rec["picks"])
+            return pin(rec["picks"])
         _log(tag, "conv autotune cache is for %r, not %r — retuning"
              % (rec.get("device"), dev_key))
     except Exception:
         pass
     if _remaining() < 300:
-        # near the deadline the two extra compiles are not worth the risk
-        os.environ["PADDLE_TPU_CONV_IMPL"] = "conv"
-        return "conv"
+        # near the deadline the extra compiles are not worth the risk
+        return pin({})
 
-    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
-    x = jax.random.normal(k1, (64, 128, 28, 28), jnp.bfloat16)
-    w = jax.random.normal(k2, (128, 128, 3, 3), jnp.bfloat16) * 0.05
-
-    def native(x_, w_):
-        return jax.lax.conv_general_dilated(
-            x_, w_, window_strides=(1, 1), padding=[(1, 1), (1, 1)],
-            dimension_numbers=("NCHW", "OIHW", "NCHW"))
-
-    def matmul(x_, w_):
-        xp = jnp.pad(x_, ((0, 0), (0, 0), (1, 1), (1, 1)))
-        out = None
-        for ky in range(3):
-            for kx in range(3):
-                patch = jax.lax.slice(xp, (0, 0, ky, kx),
-                                      (64, 128, ky + 28, kx + 28))
-                t = jnp.einsum("bchw,oc->bohw", patch, w_[:, :, ky, kx])
-                out = t if out is None else out + t
-        return out
+    from paddle_tpu.ops.nn_ops import (
+        _conv_native, _conv_shifted_matmul, _conv_stem_s2d)
 
     N_ITER = 8
 
-    def time_impl(f):
-        grad = jax.grad(
-            lambda x_, w_: f(x_, w_).astype(jnp.float32).sum(),
-            argnums=(0, 1))
-
-        def chained(x_, w_):
-            def body(c, _):
-                dx, dw = grad(x_ + c, w_)
-                s = (jnp.sum(dx.astype(jnp.float32))
-                     + jnp.sum(dw.astype(jnp.float32)))
-                return (s * 1e-30).astype(x_.dtype), None
-            return jax.lax.scan(body, jnp.zeros((), x_.dtype), None,
-                                length=N_ITER)[0]
-
-        g = jax.jit(chained)
-        float(np.asarray(g(x, w)))  # compile + warm
-        best = float("inf")
-        for _ in range(2):
-            t0 = time.perf_counter()
-            float(np.asarray(g(x, w)))  # host read-back = real sync
-            best = min(best, (time.perf_counter() - t0) / N_ITER)
-        return best
-
-    try:
-        tn = time_impl(native)
-        tm = time_impl(matmul)
-        pick = "conv" if tn <= tm else "matmul"
-        _log(tag, "conv autotune: native=%.1fms matmul=%.1fms -> %s"
-             % (1e3 * tn, 1e3 * tm, pick))
+    def time_fn(f, x, w, env):
+        """Best-of-2 per-iteration seconds for fwd+bwd of f under `env`
+        (read at trace time by the framework's conv_layout()/conv_impl())."""
+        saved = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
         try:
-            os.makedirs(os.path.dirname(cache), exist_ok=True)
-            with open(cache, "w") as f:
-                json.dump({"pick": pick, "device": dev_key,
-                           "native_ms": 1e3 * tn,
-                           "matmul_ms": 1e3 * tm}, f)
-        except Exception as e:
-            _log(tag, "could not persist conv pick: %r" % e)
+            grad = jax.grad(
+                lambda x_, w_: f(x_, w_).astype(jnp.float32).sum(),
+                argnums=(0, 1))
+
+            def chained(x_, w_):
+                def body(c, _):
+                    dx, dw = grad(x_ + c, w_)
+                    s = (jnp.sum(dx.astype(jnp.float32))
+                         + jnp.sum(dw.astype(jnp.float32)))
+                    return (s * 1e-30).astype(x_.dtype), None
+                return jax.lax.scan(body, jnp.zeros((), x_.dtype), None,
+                                    length=N_ITER)[0]
+
+            g = jax.jit(chained)
+            float(np.asarray(g(x, w)[()]))  # compile + warm (scalar sync)
+            best = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                float(np.asarray(g(x, w)[()]))
+                best = min(best, (time.perf_counter() - t0) / N_ITER)
+            return best
+        finally:
+            for k, v in saved.items():
+                os.environ.pop(k, None) if v is None else \
+                    os.environ.__setitem__(k, v)
+
+    k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(0), 4)
+    xm = jax.random.normal(k1, (64, 128, 28, 28), jnp.bfloat16)
+    wm = jax.random.normal(k2, (128, 128, 3, 3), jnp.bfloat16) * 0.05
+    xs = jax.random.normal(k3, (64, 3, 224, 224), jnp.bfloat16)
+    ws = jax.random.normal(k4, (64, 3, 7, 7), jnp.bfloat16) * 0.05
+
+    def mid(x_, w_):
+        return _conv_native(x_, w_, (1, 1), (1, 1), (1, 1), 1, None)
+
+    def mid_matmul(x_, w_):
+        # the exact production lowering the 'matmul' pick would enable —
+        # not a local copy that could drift (f32 accumulation included)
+        return _conv_shifted_matmul(x_, w_, (1, 1), (1, 1))
+
+    def stem(x_, w_):
+        return _conv_native(x_, w_, (2, 2), (3, 3), (1, 1), 1, None)
+
+    def stem_s2d(x_, w_):
+        return _conv_stem_s2d(x_, w_, None)
+
+    picks, timings = {}, {}
+    try:
+        t_nchw = time_fn(mid, xm, wm, {"PADDLE_TPU_CONV_LAYOUT": "nchw"})
+        t_nhwc = time_fn(mid, xm, wm, {"PADDLE_TPU_CONV_LAYOUT": "nhwc"})
+        t_mm = time_fn(mid_matmul, xm, wm, {})
+        timings.update(mid_nchw_ms=1e3 * t_nchw, mid_nhwc_ms=1e3 * t_nhwc,
+                       mid_matmul_ms=1e3 * t_mm)
+        layout = "nchw" if t_nchw <= t_nhwc else "nhwc"
+        picks["PADDLE_TPU_CONV_LAYOUT"] = layout
+        if t_mm < min(t_nchw, t_nhwc):
+            picks["PADDLE_TPU_CONV_IMPL"] = "matmul"
+        _log(tag, "conv autotune mid: nchw=%.1fms nhwc=%.1fms matmul=%.1fms"
+             % (1e3 * t_nchw, 1e3 * t_nhwc, 1e3 * t_mm))
+        stem_swept = False
+        if _remaining() > 240:
+            env = {"PADDLE_TPU_CONV_LAYOUT": layout}
+            t_direct = time_fn(stem, xs, ws, env)
+            t_s2d = time_fn(stem_s2d, xs, ws, env)
+            timings.update(stem_direct_ms=1e3 * t_direct,
+                           stem_s2d_ms=1e3 * t_s2d)
+            if t_s2d < t_direct:
+                picks["PADDLE_TPU_CONV_S2D"] = "1"
+            stem_swept = True
+            _log(tag, "conv autotune stem: direct=%.1fms s2d=%.1fms"
+                 % (1e3 * t_direct, 1e3 * t_s2d))
+        if stem_swept:
+            # only a COMPLETE sweep may persist: a budget-truncated cache
+            # would silently pin the skipped dimensions to defaults on
+            # every future run of this device
+            try:
+                os.makedirs(os.path.dirname(cache), exist_ok=True)
+                with open(cache, "w") as f:
+                    json.dump({"picks": picks, "device": dev_key,
+                               "timings_ms": {k: round(v, 2) for k, v
+                                              in timings.items()}}, f)
+            except Exception as e:
+                _log(tag, "could not persist conv picks: %r" % e)
     except Exception as e:
-        pick = "conv"
-        _log(tag, "conv autotune failed (%s), defaulting to native conv" % e)
-    os.environ["PADDLE_TPU_CONV_IMPL"] = pick
-    return pick
+        _log(tag, "conv autotune failed (%r), using defaults" % e)
+    return pin(picks)
 
 
 def child_main(tag):
@@ -429,7 +482,7 @@ def child_main(tag):
     _emit({"kind": "probe", "probe_tflops": round(tflops, 1),
            "device_kind": getattr(dev, "device_kind", "?")})
 
-    conv_pick = _autotune_conv(tag)
+    picks = _autotune_conv(tag)
 
     import paddle_tpu as pt
     from paddle_tpu import layers, models
@@ -438,7 +491,10 @@ def child_main(tag):
         rec = {"kind": "headline", "metric": METRIC,
                "value": round(img_s, 2), "unit": "images/sec",
                "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
-               "batch": bs, "platform": platform, "conv_impl": conv_pick,
+               "batch": bs, "platform": platform,
+               "conv_impl": picks["PADDLE_TPU_CONV_IMPL"],
+               "conv_layout": picks["PADDLE_TPU_CONV_LAYOUT"],
+               "conv_s2d": picks["PADDLE_TPU_CONV_S2D"],
                "mfu": round(img_s * _ANALYTIC_FLOPS_PER_IMG / peak, 4)}
         rec.update(extra or {})
         return rec

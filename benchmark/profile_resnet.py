@@ -1,0 +1,148 @@
+"""Per-HLO cost breakdown of the compiled ResNet-50 training step.
+
+Answers VERDICT r2 "where does the other ~94% go": AOT-compiles the same
+program bench.py measures, dumps XLA's compiled cost analysis (flops,
+bytes accessed, arithmetic intensity), a per-op-category census of the
+optimized HLO, and the analytic-vs-reported FLOP ratio. Works on any
+backend (CPU included — the HLO structure is what's being audited; only
+the timing belongs to the TPU).
+
+Usage: python -m benchmark.profile_resnet [batch] [--amp=0] [--json out]
+Env:   PADDLE_TPU_CONV_LAYOUT / PADDLE_TPU_CONV_S2D / PADDLE_TPU_CONV_IMPL
+       select the lowering variant being audited (see flags.py).
+
+reference role: benchmark/paddle/image/ + tools/timeline.py — the
+reference records per-op timings; on TPU the compiled whole-program HLO
+is the ground truth, so the audit is per-fusion, not per-op.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import re
+import sys
+
+import numpy as np
+
+
+def build_step(batch, amp_on=True):
+    import paddle_tpu as pt
+    from paddle_tpu import layers, models
+
+    main, startup = pt.Program(), pt.Program()
+    pt.switch_main_program(main)
+    pt.switch_startup_program(startup)
+    img = layers.data("img", shape=[3, 224, 224], dtype="float32")
+    label = layers.data("label", shape=[1], dtype="int64")
+    pred = models.resnet_imagenet(img, class_dim=1000, depth=50)
+    avg = layers.mean(layers.cross_entropy(pred, label))
+    pt.Momentum(learning_rate=0.1, momentum=0.9).minimize(avg)
+    if amp_on:
+        pt.amp.enable(main)
+    return main, startup, avg
+
+
+def lower_step(batch, amp_on=True):
+    """AOT-lower the one-step training fn exactly as the Executor would."""
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu.core.executor import trace_ops, RngSource
+
+    main, startup, avg = build_step(batch, amp_on)
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe = pt.Executor(pt.TPUPlace(0))
+        exe.run(startup)
+        state_names = sorted(v.name for v in main.list_vars()
+                             if v.persistable and scope.has_var(v.name))
+        state = {n: scope.find_var(n) for n in state_names}
+    block = main.global_block()
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.rand(batch, 3, 224, 224).astype("float32"),
+            "label": rng.randint(0, 1000, (batch, 1)).astype("int64")}
+
+    def one_step(state, feed, key):
+        env = dict(feed)
+        env.update(state)
+        trace_ops(block, env, RngSource(key))
+        return env[avg.name], {n: env[n] for n in state_names}
+
+    return (jax.jit(one_step, donate_argnums=(0,))
+               .lower(state, feed, jax.random.PRNGKey(0)).compile())
+
+
+def hlo_census(compiled):
+    """Optimized-HLO op census: count + total shape-bytes per op kind."""
+    text = compiled.as_text()
+    census = collections.Counter()
+    conv_lines, transpose_bytes = [], 0
+    for line in text.splitlines():
+        m = re.search(r"=\s+\S+\s+(\w[\w-]*)\(", line)
+        if not m:
+            continue
+        kind = m.group(1)
+        census[kind] += 1
+        if kind == "convolution":
+            conv_lines.append(line.strip()[:160])
+        if kind == "transpose":
+            sm = re.match(r"\s*\S+\s+=\s+(\w+)\[([\d,]*)\]", line)
+            if sm and sm.group(2):
+                n = 1
+                for d in sm.group(2).split(","):
+                    n *= int(d)
+                transpose_bytes += n * (2 if "bf16" in sm.group(1) else 4)
+    return census, conv_lines, transpose_bytes
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    out_path = None
+    if "--json" in argv:
+        i = argv.index("--json")
+        out_path = argv[i + 1]
+        del argv[i:i + 2]
+    amp_on = True
+    if any(a.startswith("--amp") for a in argv):
+        a = [a for a in argv if a.startswith("--amp")][0]
+        amp_on = not a.endswith("=0")
+        argv = [x for x in argv if not x.startswith("--amp")]
+    batch = int(argv[0]) if argv else 32
+
+    compiled = lower_step(batch, amp_on)
+    ca = compiled.cost_analysis() or {}
+    flops = float(ca.get("flops", 0.0))
+    bytes_acc = float(ca.get("bytes accessed", 0.0))
+    analytic = 3 * 3.8e9 * batch  # 3x fwd, 3.8 GFLOP/img fwd @224
+    census, conv_lines, transpose_bytes = hlo_census(compiled)
+    try:
+        mem = compiled.memory_analysis()
+        peak_bytes = int(getattr(mem, "temp_size_in_bytes", 0)
+                         + getattr(mem, "argument_size_in_bytes", 0))
+    except Exception:
+        peak_bytes = 0
+
+    report = {
+        "batch": batch, "amp": amp_on,
+        "xla_flops": flops, "analytic_flops": analytic,
+        "flops_ratio_vs_analytic": round(flops / analytic, 3)
+        if flops else None,
+        "bytes_accessed": bytes_acc,
+        "arith_intensity_flops_per_byte": round(flops / bytes_acc, 1)
+        if bytes_acc else None,
+        "peak_memory_bytes": peak_bytes,
+        "hlo_census_top": dict(census.most_common(15)),
+        "n_convolutions": census.get("convolution", 0),
+        "n_transposes": census.get("transpose", 0),
+        "transpose_bytes": transpose_bytes,
+        "sample_conv_hlo": conv_lines[:4],
+    }
+    line = json.dumps(report, indent=2)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+    return report
+
+
+if __name__ == "__main__":
+    main()

@@ -197,6 +197,17 @@ DEFINE_bool("benchmark", False,
 DEFINE_string("conv_impl", "conv",
               "dense conv2d lowering: 'conv' (lax.conv) or 'matmul' "
               "(shifted einsums); bench.py autotunes this on device")
+DEFINE_string("conv_layout", "nchw",
+              "internal conv execution layout: 'nchw' (the API contract "
+              "layout, passed through) or 'nhwc' (transpose to NHWC/HWIO "
+              "around the conv — TPU vector lanes ride the channel dim; "
+              "XLA cancels the transpose pairs between adjacent convs); "
+              "bench.py autotunes this on device")
+DEFINE_bool("conv_first_s2d", False,
+            "rewrite the ImageNet stem conv (7x7/s2/p3, C_in<=4) as "
+            "space-to-depth + 4x4/s1 conv: 4x better MXU lane utilization "
+            "on the 3-channel input (the public MLPerf ResNet trick); "
+            "numerically exact, autotuned by bench.py")
 DEFINE_bool("debug_shapes", False,
             "raise (instead of recording) on shape-inference failures")
 DEFINE_string("data_home", "~/.cache/paddle_tpu/dataset",

@@ -230,6 +230,80 @@ def conv_impl():
     return FLAGS.conv_impl
 
 
+def conv_layout():
+    """Internal conv execution layout ('nchw' passthrough or 'nhwc'
+    transposed). The op API contract stays NCHW either way; 'nhwc' wraps
+    each conv in transposes that XLA's algebraic simplifier cancels
+    between adjacent convs (elementwise ops in between are layout-moved).
+    bench.py autotunes this on the real device and pins
+    PADDLE_TPU_CONV_LAYOUT."""
+    import os
+    env = os.environ.get("PADDLE_TPU_CONV_LAYOUT")
+    if env:
+        return env
+    from ..flags import FLAGS
+    return FLAGS.conv_layout
+
+
+def conv_first_s2d():
+    import os
+    env = os.environ.get("PADDLE_TPU_CONV_S2D")
+    if env is not None:
+        return env not in ("0", "false", "False", "")
+    from ..flags import FLAGS
+    return FLAGS.conv_first_s2d
+
+
+def _conv_native(x, w, s, p, d, groups, pe):
+    """lax.conv in the selected internal layout (x NCHW, w OIHW in/out)."""
+    if conv_layout() == "nhwc":
+        out = jax.lax.conv_general_dilated(
+            jnp.transpose(x, (0, 2, 3, 1)), jnp.transpose(w, (2, 3, 1, 0)),
+            window_strides=tuple(s), padding=[(p[0], p[0]), (p[1], p[1])],
+            rhs_dilation=tuple(d),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=groups, preferred_element_type=pe)
+        return jnp.transpose(out, (0, 3, 1, 2))
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=tuple(s),
+        padding=[(p[0], p[0]), (p[1], p[1])], rhs_dilation=tuple(d),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups, preferred_element_type=pe)
+
+
+def _conv_stem_s2d(x, w, pe):
+    """ImageNet stem conv (7x7 / stride 2 / pad 3) as space-to-depth(2) +
+    4x4 / stride 1 conv — numerically exact, 4x the input channels for the
+    MXU's lanes (C=3 pads to the same tile as C=12; the 7x7-on-3-channels
+    stem is the classic TPU under-utilization case, public MLPerf ResNet
+    technique).
+
+    Derivation: out[h'] = sum_{ky=0..6} k[ky] * x[2h'+ky-3]. Substitute
+    m = ky+1 (zero-pad the kernel to 8 taps, leading zero) and split
+    m = 2a+dy: x[2(h'-2+a)+dy], i.e. the s2d plane dy sampled at h'-2+a —
+    a 4-tap stride-1 conv over the s2d image with spatial padding (2,1)."""
+    B, C, H, W = x.shape
+    O = w.shape[0]
+    xr = x.reshape(B, C, H // 2, 2, W // 2, 2)
+    xs = jnp.transpose(xr, (0, 1, 3, 5, 2, 4)).reshape(
+        B, C * 4, H // 2, W // 2)
+    k8 = jnp.pad(w, ((0, 0), (0, 0), (1, 0), (1, 0)))
+    k4 = k8.reshape(O, C, 4, 2, 4, 2)           # [o, c, ay, dy, ax, dx]
+    k4 = jnp.transpose(k4, (0, 1, 3, 5, 2, 4)).reshape(O, C * 4, 4, 4)
+    if conv_layout() == "nhwc":
+        out = jax.lax.conv_general_dilated(
+            jnp.transpose(xs, (0, 2, 3, 1)),
+            jnp.transpose(k4, (2, 3, 1, 0)),
+            window_strides=(1, 1), padding=[(2, 1), (2, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=pe)
+        return jnp.transpose(out, (0, 3, 1, 2))
+    return jax.lax.conv_general_dilated(
+        xs, k4, window_strides=(1, 1), padding=[(2, 1), (2, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=pe)
+
+
 def _conv_shifted_matmul(x, w, s, p):
     """Convolution as KH*KW shifted einsums — each one a clean MXU matmul.
     Same FLOPs as the native conv; XLA fuses the adds. Kept selectable for
@@ -268,20 +342,21 @@ def conv2d(ctx):
     p = ctx.attr("paddings", [0, 0])
     d = ctx.attr("dilations", [1, 1])
     groups = ctx.attr("groups", 1) or 1
-    if groups == 1 and tuple(d) == (1, 1) and conv_impl() == "matmul":
+    # under AMP the conv stays uniformly bf16 (the conv transpose rule
+    # can't mix an f32 preferred output with bf16 operands)
+    pe = (jnp.float32 if (not amp_on and x.dtype in (jnp.bfloat16,))
+          else None)
+    if (conv_first_s2d() and groups == 1 and tuple(d) == (1, 1)
+            and x.shape[1] <= 4 and w.shape[2:] == (7, 7)
+            and tuple(s) == (2, 2) and tuple(p) == (3, 3)
+            and x.shape[2] % 2 == 0 and x.shape[3] % 2 == 0):
+        # the stem rewrite outranks conv_impl: the tuner times the stem
+        # candidates specifically, so an enabled s2d pick must execute
+        out = _conv_stem_s2d(x, w, pe)
+    elif groups == 1 and tuple(d) == (1, 1) and conv_impl() == "matmul":
         out = _conv_shifted_matmul(x, w, s, p)
     else:
-        # under AMP the conv stays uniformly bf16 (the conv transpose rule
-        # can't mix an f32 preferred output with bf16 operands)
-        pe = (jnp.float32 if (not amp_on and x.dtype in (jnp.bfloat16,))
-              else None)
-        out = jax.lax.conv_general_dilated(
-            x, w, window_strides=tuple(s),
-            padding=[(p[0], p[0]), (p[1], p[1])],
-            rhs_dilation=tuple(d),
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
-            feature_group_count=groups,
-            preferred_element_type=pe)
+        out = _conv_native(x, w, s, p, d, groups, pe)
     ctx.set_output("Output", out.astype(out_dtype))
 
 
@@ -294,12 +369,7 @@ def depthwise_conv2d(ctx):
     s = ctx.attr("strides", [1, 1])
     p = ctx.attr("paddings", [0, 0])
     d = ctx.attr("dilations", [1, 1])
-    out = jax.lax.conv_general_dilated(
-        x, w, window_strides=tuple(s),
-        padding=[(p[0], p[0]), (p[1], p[1])],
-        rhs_dilation=tuple(d),
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        feature_group_count=groups)
+    out = _conv_native(x, w, s, p, d, groups, None)
     ctx.set_output("Output", out)
 
 
