@@ -541,6 +541,27 @@ def child_main(tag):
             _emit(final)
         except Exception as e:  # comparison is best-effort
             _log(tag, "amp-off phase failed: %r" % e)
+
+    # second north-star metric: LSTM tokens/sec at the reference's bs64
+    # h512 config (benchmark/README.md:110-117 — 184 ms/batch on K40m),
+    # carried as fields on the headline record so the driver's single
+    # parsed JSON line holds both metrics
+    if final is not None and platform != "cpu" and _remaining() > 180:
+        try:
+            from benchmark.baselines import REF_LSTM_TOKENS_S
+            from benchmark.rnn_bench import bench as lstm_bench
+            _log(tag, "lstm bench bs=64 h=512 ...")
+            r = lstm_bench(batch_size=64, hidden=512, seq_len=100, iters=6)
+            final = dict(final)
+            final["lstm_tokens_per_sec"] = r["tokens_per_sec"]
+            final["lstm_ms_per_batch"] = r["ms_per_batch"]
+            final["lstm_vs_baseline"] = round(
+                r["tokens_per_sec"] / REF_LSTM_TOKENS_S[(64, 512)], 3)
+            _emit(final)
+            _log(tag, "lstm: %.0f tokens/s (%.1f ms/batch)"
+                 % (r["tokens_per_sec"], r["ms_per_batch"]))
+        except Exception as e:
+            _log(tag, "lstm phase failed: %r" % e)
     _log(tag, "child done")
 
 

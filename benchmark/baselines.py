@@ -10,3 +10,13 @@ REF_BASELINES = {
     "googlenet": 269.50,  # IntelOptimizedPaddle.md:49-55, bs256
     "resnet50": 84.08,    # IntelOptimizedPaddle.md:40-46, bs256
 }
+
+# LSTM text-classification (2xLSTM+fc), reference benchmark/README.md
+# rows 110-126 (K40m): ms/batch at the bs64 configs; tokens/sec derived
+# at seq_len=100 (the harness's sequence length)
+REF_LSTM_MS_PER_BATCH = {  # (batch, hidden) -> ms
+    (64, 256): 83.0, (64, 512): 184.0, (64, 1280): 641.0,
+    (128, 256): 110.0, (128, 512): 261.0, (128, 1280): 1007.0,
+}
+REF_LSTM_TOKENS_S = {k: round(k[0] * 100 / (v / 1e3), 1)
+                     for k, v in REF_LSTM_MS_PER_BATCH.items()}

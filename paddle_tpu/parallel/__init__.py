@@ -22,3 +22,6 @@ from .ring import (  # noqa: F401
 from .pipeline import (  # noqa: F401
     pipeline, pipelined_step_fn, stack_stage_params,
 )
+from .async_sgd import (  # noqa: F401
+    AsyncParameterServer, AsyncSGDUpdater, build_grad_program,
+)

@@ -1,0 +1,285 @@
+"""Asynchronous SGD: a host-driven parameter service with bounded
+staleness.
+
+The reference makes async SGD a first-class training mode: trainers push
+gradients and pull parameters without a barrier, and the pserver applies
+the optimizer host-side the moment a gradient arrives (reference:
+proto/ParameterService.proto:24-40 PSERVER_UPDATE_MODE_ASYNC_SGD,
+paddle/pserver/ParameterServer2.h:57-95 asyncUpdate + controlled-staleness
+``asyncLaggedGradientsNum``, trainer/RemoteParameterUpdater.cpp async
+path). This module is the executable TPU-native equivalent:
+
+- the *device* computes gradients as a compiled grad-only program (no
+  optimizer ops — ``build_grad_program``/``Optimizer.minimize`` minus the
+  update pass);
+- the *host* parameter service applies updates in numpy the instant a
+  push lands (exactly where the reference applies them: pserver CPU), and
+  serves the newest parameters to any puller, no barrier;
+- staleness is *bounded*, not unbounded: a worker's ``pull`` for step
+  ``t`` blocks until every registered worker has pushed step
+  ``t - cap - 1`` — no gradient consumed this step is based on a peer
+  state more than ``cap+1`` of that peer's versions old, and step 0 is
+  always admitted (SSP semantics; the reference's lagged-gradient cap
+  plays this role).
+
+Sync/async live on one spectrum here: ``staleness_cap=0`` with one worker
+is EXACTLY sequential SGD (tested bit-for-bit in
+tests/test_async_sgd.py); ``staleness_cap=None`` is the reference's fully
+async mode.
+
+Transport is length-prefixed pickles over TCP — same trust model as the
+reference's unauthenticated protobuf-over-TCP pserver protocol
+(ParameterService.proto): a private cluster fabric, not an internet
+service.
+
+doc/design/async_sgd.md records when to prefer synchronous SPMD instead
+(on-mesh training); this module is for the host-cluster niche the
+reference served — heterogeneous workers, elastic membership, WAN-ish
+links — where unbarriered progress genuinely buys utilization.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["AsyncParameterServer", "AsyncSGDUpdater", "build_grad_program"]
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        hdr += chunk
+    n = struct.unpack("<Q", hdr)[0]
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        srv = self.server.owner
+        try:
+            while True:
+                msg = _recv_msg(self.request)
+                kind = msg["op"]
+                if kind == "pull":
+                    _send_msg(self.request, srv._pull(
+                        msg["worker"], msg["step"]))
+                elif kind == "push":
+                    _send_msg(self.request, srv._push(
+                        msg["worker"], msg["step"], msg["grads"]))
+                elif kind == "bye":
+                    _send_msg(self.request, {"ok": True})
+                    return
+                else:
+                    _send_msg(self.request, {"error": "bad op %r" % kind})
+        except (ConnectionError, EOFError):
+            return
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class AsyncParameterServer(object):
+    """Host parameter service (reference ParameterServer2 role).
+
+    ``optimizer``: 'sgd' or 'momentum' — applied in numpy per push, the
+    pserver-side optimization of the reference (ParameterServer2.h
+    asyncUpdate; the optimizer runs where the parameters live).
+
+    ``staleness_cap``: None = fully async (PSERVER_UPDATE_MODE_ASYNC_SGD);
+    an int = bounded staleness — ``pull`` for step t blocks until every
+    one of ``n_workers`` workers has pushed step ``t - cap - 1``, so
+    step 0 always proceeds and a cap-0 single worker is exactly
+    sequential SGD (SSP).
+    """
+
+    def __init__(self, params: Dict[str, np.ndarray], lr: float,
+                 optimizer: str = "sgd", momentum: float = 0.9,
+                 staleness_cap: Optional[int] = None, n_workers: int = 1,
+                 host: str = "127.0.0.1", port: int = 0,
+                 pull_timeout: float = 60.0):
+        self._params = {k: np.array(v, dtype=np.float32, copy=True)
+                        for k, v in params.items()}
+        self._velocity = {k: np.zeros_like(v)
+                          for k, v in self._params.items()}
+        if optimizer not in ("sgd", "momentum"):
+            raise ValueError("optimizer must be 'sgd' or 'momentum'")
+        self._opt = optimizer
+        self._lr = float(lr)
+        self._mu = float(momentum)
+        self.staleness_cap = staleness_cap
+        self.n_workers = int(n_workers)
+        self._pull_timeout = pull_timeout
+        self._clock = {}            # worker -> highest pushed step
+        self._version = 0           # total pushes applied
+        self._cv = threading.Condition()
+        self._srv = _TCPServer((host, port), _Handler)
+        self._srv.owner = self
+        self._thread = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    @property
+    def address(self):
+        h, p = self._srv.server_address[:2]
+        return (h, p)
+
+    @property
+    def version(self):
+        with self._cv:
+            return self._version
+
+    def params(self):
+        with self._cv:
+            return {k: v.copy() for k, v in self._params.items()}
+
+    # -- service ops --------------------------------------------------------
+    def _min_clock(self):
+        if len(self._clock) < self.n_workers:
+            return -1  # unregistered workers count as step -1 (none pushed)
+        return min(self._clock.values())
+
+    def _pull(self, worker, step):
+        with self._cv:
+            if self.staleness_cap is not None:
+                # SSP gate: a pull for step t is admitted once every
+                # worker has PUSHED step t-cap-1, i.e. no gradient this
+                # step consumes can be based on params more than cap+1
+                # versions-per-worker old (clocks start at -1 = nothing
+                # pushed, so step 0 is always admitted)
+                ok = self._cv.wait_for(
+                    lambda: self._min_clock()
+                    >= step - self.staleness_cap - 1,
+                    timeout=self._pull_timeout)
+                if not ok:
+                    return {"error": "staleness gate timed out "
+                                     "(worker %r step %d, clocks %r)"
+                                     % (worker, step, self._clock)}
+            return {"version": self._version,
+                    "params": {k: v.copy()
+                               for k, v in self._params.items()}}
+
+    def _push(self, worker, step, grads):
+        with self._cv:
+            unknown = sorted(set(grads) - set(self._params))
+            if unknown:
+                # reject rather than silently no-op: pushing by grad-var
+                # name ('w@GRAD') instead of param name is the natural
+                # client mistake and must not advance the clock
+                return {"error": "push names not on the server: %r "
+                                 "(push by PARAM name, not grad name)"
+                                 % unknown}
+            for name, g in grads.items():
+                p = self._params[name]
+                g = np.asarray(g, dtype=np.float32).reshape(p.shape)
+                if self._opt == "momentum":
+                    v = self._velocity[name]
+                    v *= self._mu
+                    v += g
+                    p -= self._lr * v
+                else:
+                    p -= self._lr * g
+            prev = self._clock.get(worker, -1)
+            self._clock[worker] = max(prev, step)
+            self._version += 1
+            self._cv.notify_all()
+            return {"version": self._version}
+
+
+class AsyncSGDUpdater(object):
+    """Trainer-side client (reference RemoteParameterUpdater role): pull
+    the newest parameters into the scope, run the compiled grad program,
+    push the gradients — no barrier with other workers."""
+
+    def __init__(self, address, worker_id=0, timeout=180.0):
+        # the socket deadline must comfortably exceed the server's
+        # pull_timeout (default 60s): if the client gave up first, the
+        # server's late reply would stay queued and desync every
+        # subsequent request on this connection
+        self._addr = tuple(address)
+        self.worker_id = worker_id
+        self._sock = socket.create_connection(self._addr, timeout=timeout)
+
+    def _rpc(self, msg):
+        try:
+            _send_msg(self._sock, msg)
+            rep = _recv_msg(self._sock)
+        except Exception:
+            # a timed-out/broken exchange leaves an unconsumed reply in
+            # flight — the connection is unusable, don't let the next
+            # call read a stale response as its own
+            self._sock.close()
+            raise
+        if "error" in rep:
+            raise RuntimeError(rep["error"])
+        return rep
+
+    def pull(self, step=0):
+        rep = self._rpc({"op": "pull", "worker": self.worker_id,
+                         "step": step})
+        return rep["version"], rep["params"]
+
+    def pull_into(self, scope, step=0):
+        version, params = self.pull(step)
+        for name, value in params.items():
+            scope.set_var(name, value)
+        return version
+
+    def push(self, grads, step):
+        grads = {k: np.asarray(v) for k, v in grads.items()}
+        rep = self._rpc({"op": "push", "worker": self.worker_id,
+                         "step": step, "grads": grads})
+        return rep["version"]
+
+    def close(self):
+        try:
+            _send_msg(self._sock, {"op": "bye"})
+            _recv_msg(self._sock)
+        except Exception:
+            pass
+        self._sock.close()
+
+
+def build_grad_program(loss, parameter_list=None):
+    """Append backward (grad ops only, NO optimizer ops) to the loss's
+    program — the trainer side of async SGD computes gradients on device
+    and ships them; the optimizer runs on the parameter service
+    (reference: RemoteParameterUpdater::updateImpl — trainers never apply
+    dense updates locally in remote mode).
+
+    Returns [(param, grad_var)] like Optimizer.minimize's second result.
+    """
+    from ..core.backward import append_backward
+    return append_backward(loss, parameter_list)
