@@ -26,6 +26,7 @@ __all__ = [
     "relu", "log", "im2sequence", "expand", "squeeze", "unsqueeze",
     "edit_distance", "hsigmoid", "factorization_machine", "multiplex",
     "spp", "max_pool2d_with_index", "unpool", "mdlstm",
+    "conv3d", "pool3d", "smooth_l1",
 ]
 
 
@@ -182,6 +183,82 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
                             "global_pooling": global_pooling,
                             "ceil_mode": ceil_mode})
     return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, act=None,
+           name=None):
+    """reference: fluid layers/nn.py conv3d — NCDHW, filter OIDHW."""
+    helper = LayerHelper("conv3d", **locals())
+    dtype = helper.input_dtype()
+    num_channels = input.shape[1]
+    groups = groups or 1
+    if isinstance(filter_size, int):
+        filter_size = [filter_size] * 3
+    if isinstance(stride, int):
+        stride = [stride] * 3
+    if isinstance(padding, int):
+        padding = [padding] * 3
+    if isinstance(dilation, int):
+        dilation = [dilation] * 3
+    filter_shape = [num_filters, num_channels // groups] + list(filter_size)
+
+    from ..initializer import NormalInitializer
+    fan_in = filter_shape[1] * filter_shape[2] * filter_shape[3] * \
+        filter_shape[4]
+    w = helper.create_parameter(
+        helper.param_attr, shape=filter_shape, dtype=dtype,
+        default_initializer=NormalInitializer(0.0, (2.0 / fan_in) ** 0.5))
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="conv3d",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [pre_bias]},
+                     attrs={"strides": stride, "paddings": padding,
+                            "dilations": dilation, "groups": groups})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           name=None):
+    """reference: fluid layers/nn.py pool3d — NCDHW."""
+    helper = LayerHelper("pool3d", **locals())
+    if isinstance(pool_size, int):
+        pool_size = [pool_size] * 3
+    if isinstance(pool_stride, int):
+        pool_stride = [pool_stride] * 3
+    if isinstance(pool_padding, int):
+        pool_padding = [pool_padding] * 3
+    out = helper.create_variable_for_type_inference(helper.input_dtype())
+    helper.append_op(type="pool3d", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"pooling_type": pool_type, "ksize": pool_size,
+                            "strides": pool_stride, "paddings": pool_padding,
+                            "global_pooling": global_pooling,
+                            "ceil_mode": ceil_mode})
+    return out
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=1.0):
+    """Per-row smooth-L1 loss, [N, 1] (reference:
+    operators/smooth_l1_loss_op.cc; gserver SmoothL1CostLayer uses
+    sigma=1). With a=|x-y|, t=1/sigma^2: 0.5*sigma^2*a^2 for a<t else
+    a-0.5*t, summed over the row. Branch-free form:
+    0.5*sigma^2*min(a,t)^2 + (a - min(a,t))."""
+    from .. import layers as _F
+    diff = _F.elementwise_sub(x, y)
+    if inside_weight is not None:
+        diff = _F.elementwise_mul(diff, inside_weight)
+    s2 = float(sigma) * float(sigma)
+    t = 1.0 / s2
+    a = _F.abs(diff)
+    amin = _F.clip(a, 0.0, t)
+    quad = _F.scale(_F.elementwise_mul(amin, amin), scale=0.5 * s2)
+    per_elem = _F.elementwise_add(quad, _F.elementwise_sub(a, amin))
+    if outside_weight is not None:
+        per_elem = _F.elementwise_mul(per_elem, outside_weight)
+    return _F.reduce_sum(per_elem, dim=1, keep_dim=True)
 
 
 def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,

@@ -19,7 +19,7 @@ __all__ = [
     "sequence_reshape", "sequence_slice", "sequence_erase",
     "sequence_first_step", "sequence_last_step", "lod_reset", "row_conv",
     "linear_chain_crf", "crf_decoding", "warpctc", "ctc_greedy_decoder",
-    "chunk_eval", "nce",
+    "chunk_eval", "nce", "kmax_seq_score", "sub_nested_seq",
 ]
 
 
@@ -410,3 +410,29 @@ def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
                             "sampler": sampler})
     cost.shape = (input.shape[0], 1)
     return cost
+
+
+def kmax_seq_score(input, beam_size=1, name=None):
+    """Per-sequence top-beam_size within-sequence indices of a [total, 1]
+    score LoD tensor; [n_seqs, beam_size] int64, -1 padded (reference:
+    gserver/layers/KmaxSeqScoreLayer.cpp)."""
+    helper = LayerHelper("kmax_seq_score", **locals())
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="kmax_seq_score", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"beam_size": beam_size})
+    return out
+
+
+def sub_nested_seq(input, selected_indices, name=None):
+    """Select sub-sequences of a nested sequence by per-outer-sequence
+    indices ([n_outer, k], -1 padded); output is a lod level 1 sequence
+    (reference: gserver/layers/SubNestedSequenceLayer.cpp)."""
+    helper = LayerHelper("sub_nested_seq", **locals())
+    out = helper.create_variable_for_type_inference(
+        helper.input_dtype())
+    helper.append_op(type="sub_nested_seq",
+                     inputs={"X": [input],
+                             "SelectedIndices": [selected_indices]},
+                     outputs={"Out": [out]})
+    return out

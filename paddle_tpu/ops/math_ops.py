@@ -58,7 +58,25 @@ def mul(ctx):
     ctx.set_output("Out", with_lod_of(x_v, out))
 
 
-@register_op("matmul")
+def _infer_matmul(op, block):
+    xv = block._find_var_recursive(op.input("X")[0])
+    yv = block._find_var_recursive(op.input("Y")[0])
+    ov = block._find_var_recursive(op.output("Out")[0])
+    if None in (xv, yv, ov) or xv.shape is None or yv.shape is None:
+        return
+    xs, ys = list(xv.shape), list(yv.shape)
+    if op.attr("transpose_X", False) and len(xs) > 1:
+        xs[-1], xs[-2] = xs[-2], xs[-1]
+    if op.attr("transpose_Y", False) and len(ys) > 1:
+        ys[-1], ys[-2] = ys[-2], ys[-1]
+    if len(xs) == 1 or len(ys) == 1:
+        return  # vector cases: leave unset
+    batch = xs[:-2] if len(xs) >= len(ys) else ys[:-2]
+    ov.shape = tuple(batch) + (xs[-2], ys[-1])
+    ov.dtype = xv.dtype
+
+
+@register_op("matmul", infer_shape=_infer_matmul)
 def matmul(ctx):
     """reference: operators/matmul_op.cc (transpose_X/Y attrs, batched)."""
     from .. import amp
@@ -174,12 +192,35 @@ def _reduce(ctx, fn):
     ctx.set_output("Out", out)
 
 
+def _infer_reduce(op, block):
+    xv = block._find_var_recursive(op.input("X")[0])
+    ov = block._find_var_recursive(op.output("Out")[0])
+    if None in (xv, ov) or xv.shape is None:
+        return
+    if op.attr("reduce_all", False):
+        ov.shape = (1,) if op.attr("keep_dim", False) else ()
+        ov.dtype = xv.dtype
+        return
+    dim = op.attr("dim", [0])
+    dims = set(dim if isinstance(dim, (list, tuple)) else [dim])
+    dims = {d % len(xv.shape) for d in dims}
+    if op.attr("keep_dim", False):
+        shape = tuple(1 if i in dims else d
+                      for i, d in enumerate(xv.shape))
+    else:
+        shape = tuple(d for i, d in enumerate(xv.shape)
+                      if i not in dims)
+    ov.shape = shape
+    ov.dtype = xv.dtype
+
+
 for _name, _fn in [
     ("reduce_sum", jnp.sum), ("reduce_mean", jnp.mean),
     ("reduce_max", jnp.max), ("reduce_min", jnp.min),
     ("reduce_prod", jnp.prod),
 ]:
-    register_op(_name)(functools.partial(lambda ctx, f: _reduce(ctx, f), f=_fn))
+    register_op(_name, infer_shape=_infer_reduce)(
+        functools.partial(lambda ctx, f: _reduce(ctx, f), f=_fn))
 
 
 def _infer_mean(op, block):

@@ -107,3 +107,32 @@ def edit_distance(ctx):
     dists = jax.vmap(one)(hyp, ref)
     ctx.set_output("Out", dists.reshape(-1, 1))
     ctx.set_output("SequenceNum", jnp.asarray([hyp.shape[0]], dtype=jnp.int64))
+
+
+@register_op("positive_negative_pair", no_gradient=True)
+def positive_negative_pair(ctx):
+    """reference: operators/positive_negative_pair_op.* (v1
+    PnpairEvaluator): over item pairs with different labels inside one
+    query, count score-order agreements (pos), disagreements (neg), ties
+    (neutral, weighted 1/2). Queries come from QueryID when given, else
+    each LoD sequence is a query."""
+    s_in = ctx.input("Score")
+    score = raw_data(s_in).reshape(-1)
+    label = raw_data(ctx.input("Label")).reshape(-1)
+    if ctx.has_input("QueryID"):
+        qid = raw_data(ctx.input("QueryID")).reshape(-1)
+    else:
+        from .sequence_ops import seq_offsets, segment_ids
+        offs = seq_offsets(s_in)
+        qid = segment_ids(offs, score.shape[0])
+    same_q = qid[:, None] == qid[None, :]
+    ldiff = label[:, None] - label[None, :]
+    sdiff = score[:, None] - score[None, :]
+    # consider each unordered pair once: label_i > label_j
+    cand = same_q & (ldiff > 0)
+    pos = jnp.sum(jnp.where(cand & (sdiff > 0), 1.0, 0.0))
+    neg = jnp.sum(jnp.where(cand & (sdiff < 0), 1.0, 0.0))
+    neu = jnp.sum(jnp.where(cand & (sdiff == 0), 1.0, 0.0))
+    ctx.set_output("PositivePair", pos.reshape(1))
+    ctx.set_output("NegativePair", neg.reshape(1))
+    ctx.set_output("NeutralPair", neu.reshape(1))

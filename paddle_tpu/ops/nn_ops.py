@@ -419,7 +419,24 @@ def conv2d_transpose(ctx):
     ctx.set_output("Output", out)
 
 
-@register_op("conv3d")
+def _infer_conv3d(op, block):
+    xv = block._find_var_recursive(op.input("Input")[0])
+    fv = block._find_var_recursive(op.input("Filter")[0])
+    ov = block._find_var_recursive(op.output("Output")[0])
+    if None in (xv, fv, ov) or xv.shape is None or fv.shape is None:
+        return
+    s = op.attr("strides", [1, 1, 1])
+    p = op.attr("paddings", [0, 0, 0])
+    d = op.attr("dilations", [1, 1, 1])
+    n = xv.shape[0]
+    oc = fv.shape[0]
+    spatial = tuple(_conv_out_dim(xv.shape[2 + i], fv.shape[2 + i],
+                                  p[i], s[i], d[i]) for i in range(3))
+    ov.shape = (n, oc) + spatial
+    ov.dtype = xv.dtype
+
+
+@register_op("conv3d", infer_shape=_infer_conv3d)
 def conv3d(ctx):
     x = raw_data(ctx.input("Input"))
     w = raw_data(ctx.input("Filter"))
@@ -490,7 +507,30 @@ def pool2d(ctx):
     ctx.set_output("Out", out)
 
 
-@register_op("pool3d")
+def _infer_pool3d(op, block):
+    xv = block._find_var_recursive(op.input("X")[0])
+    ov = block._find_var_recursive(op.output("Out")[0])
+    if None in (xv, ov) or xv.shape is None:
+        return
+    if op.attr("global_pooling", False):
+        ov.shape = xv.shape[:2] + (1, 1, 1)
+        ov.dtype = xv.dtype
+        return
+    k = op.attr("ksize")
+    s = op.attr("strides", [1, 1, 1])
+    p = op.attr("paddings", [0, 0, 0])
+    ceil = op.attr("ceil_mode", False)
+
+    def od(i, kk, pp, ss):
+        num = i + 2 * pp - kk
+        return (num + ss - 1) // ss + 1 if ceil else num // ss + 1
+
+    ov.shape = xv.shape[:2] + tuple(
+        od(xv.shape[2 + i], k[i], p[i], s[i]) for i in range(3))
+    ov.dtype = xv.dtype
+
+
+@register_op("pool3d", infer_shape=_infer_pool3d)
 def pool3d(ctx):
     x = raw_data(ctx.input("X"))
     ptype = ctx.attr("pooling_type", "max")
@@ -645,4 +685,28 @@ def im2sequence(ctx):
         dimension_numbers=("NCHW", "OIHW", "NCHW"))
     # patches: [N, C*kh*kw, oh, ow] -> [N*oh*ow, C*kh*kw]
     out = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * k[0] * k[1])
+    ctx.set_output("Out", out)
+
+
+@register_op("scale_sub_region", infer_shape=_infer_same)
+def scale_sub_region(ctx):
+    """reference: operators/scale_sub_region_op.* / gserver
+    ScaleSubRegionLayer: multiply the [c1..c2, h1..h2, w1..w2] region of
+    each [C, H, W] image by ``value``; Indices is [N, 6] one-based
+    inclusive (c1, c2, h1, h2, w1, w2). Branch-free: a broadcasted iota
+    mask, differentiable w.r.t. X."""
+    x = raw_data(ctx.input("X"))
+    idx = raw_data(ctx.input("Indices")).astype(jnp.int32)
+    value = ctx.attr("value", 1.0)
+    n, c, h, w = x.shape
+    mask = jnp.ones((n, 1, 1, 1), jnp.bool_)
+    for a, dim in ((0, c), (1, h), (2, w)):
+        r = jnp.arange(dim, dtype=jnp.int32)
+        shape = [1, 1, 1, 1]
+        shape[a + 1] = dim
+        r = r.reshape(shape)
+        lo = (idx[:, 2 * a] - 1).reshape(n, 1, 1, 1)
+        hi = (idx[:, 2 * a + 1] - 1).reshape(n, 1, 1, 1)
+        mask = mask & (r >= lo) & (r <= hi)
+    out = jnp.where(mask, x * value, x)
     ctx.set_output("Out", out)

@@ -915,3 +915,196 @@ def chunk_eval(ctx):
     ctx.set_output("NumInferChunks", jnp.asarray([n_inf], jnp.int64))
     ctx.set_output("NumLabelChunks", jnp.asarray([n_lab], jnp.int64))
     ctx.set_output("NumCorrectChunks", jnp.asarray([n_correct], jnp.int64))
+
+
+# -- beam-training sequence selection ops ----------------------------------
+# (reference: gserver/layers/KmaxSeqScoreLayer.cpp,
+#  gserver/layers/SubNestedSequenceLayer.cpp — the v1 beam-training pair)
+
+def _infer_kmax_seq_score(op, block):
+    ov = block._find_var_recursive(op.output("Out")[0])
+    if ov is not None:
+        ov.shape = (None, op.attr("beam_size", 1))
+        ov.dtype = "int64"
+
+
+@register_op("kmax_seq_score", infer_shape=_infer_kmax_seq_score,
+             no_gradient=True)
+def kmax_seq_score(ctx):
+    """Top beam_size WITHIN-sequence indices of a [total, 1] score
+    sequence, one row per sequence, -1 padding past the sequence length
+    (reference: KmaxSeqScoreLayer.cpp). TPU form: pad the ragged scores to
+    [n, max_len] with -inf and lax.top_k the dense matrix."""
+    x = ctx.input("X")
+    data = raw_data(x)
+    offs = seq_offsets(x)
+    max_len = static_max_len(x)
+    k = int(ctx.attr("beam_size", 1))
+    flat = data.reshape(data.shape[0])
+    padded, mask = lod_to_padded(flat, offs, max_len)
+    padded = jnp.where(mask, padded, -jnp.inf)
+    kk = min(k, max_len) if max_len else 0
+    if kk == 0:
+        ctx.set_output("Out", jnp.full((offs.shape[0] - 1, k), -1,
+                                       jnp.int64))
+        return
+    scores, idx = jax.lax.top_k(padded, kk)
+    valid = jnp.take_along_axis(mask, idx, axis=1)
+    idx = jnp.where(valid, idx, -1).astype(jnp.int64)
+    if kk < k:
+        idx = jnp.pad(idx, ((0, 0), (0, k - kk)), constant_values=-1)
+    ctx.set_output("Out", idx)
+
+
+def _infer_sub_nested_seq(op, block):
+    xv = block._find_var_recursive(op.input("X")[0])
+    ov = block._find_var_recursive(op.output("Out")[0])
+    if None in (xv, ov) or xv.shape is None:
+        return
+    ov.shape = xv.shape
+    ov.dtype = xv.dtype
+
+
+@register_op("sub_nested_seq", infer_shape=_infer_sub_nested_seq)
+def sub_nested_seq(ctx):
+    """Select sub-sequences of a nested (lod level 2) sequence by
+    per-outer-sequence indices (reference: SubNestedSequenceLayer.cpp;
+    used with kmax_seq_score for beam training).
+
+    SelectedIndices is [n_outer, k] with -1 padding. The output is a lod
+    level 1 sequence with a STATIC layout: n_outer*k slots (invalid
+    selections become zero-length sequences) over a dense buffer of the
+    input's total rows (tail rows past the final offset are zeroed) —
+    data-dependent result sizes cannot exist under XLA's static shapes,
+    so emptiness is encoded in the offsets, not the buffer size."""
+    x = ctx.input("X")
+    sel = raw_data(ctx.input("SelectedIndices"))
+    if not isinstance(x, TracedLoD) or len(x.lod) < 2:
+        raise ValueError("sub_nested_seq input must be a nested (lod "
+                         "level 2) sequence")
+    data = raw_data(x)
+    outer, inner = x.lod[0], x.lod[1]
+    total = data.shape[0]
+    n_outer, k = sel.shape
+    sel = sel.astype(jnp.int32)
+    valid = sel >= 0
+    n_sub = (outer[1:] - outer[:-1])  # subseqs per outer sequence
+    valid = valid & (sel < n_sub[:, None])
+    g = jnp.where(valid, outer[:-1, None] + sel, 0)  # global subseq idx
+    g_flat = g.reshape(-1)
+    valid_flat = valid.reshape(-1)
+    seg_len = inner[1:] - inner[:-1]
+    new_lens = jnp.where(valid_flat, jnp.take(seg_len, g_flat, axis=0), 0)
+    new_offs = jnp.concatenate(
+        [jnp.zeros((1,), new_lens.dtype), jnp.cumsum(new_lens)])
+    # out row r -> slot t (the selected subsequence it falls in) -> source
+    r = jnp.arange(total, dtype=new_offs.dtype)
+    t = jnp.searchsorted(new_offs[1:], r, side="right")
+    t = jnp.clip(t, 0, n_outer * k - 1)
+    src = jnp.take(inner[:-1], jnp.take(g_flat, t), axis=0) \
+        + (r - jnp.take(new_offs, t))
+    src = jnp.clip(src, 0, total - 1)
+    out = jnp.take(data, src, axis=0)
+    live = (r < new_offs[-1])
+    out = jnp.where(_expand_mask(live, out), out, 0)
+    ml = x.max_lens[-1] if x.max_lens else None
+    ctx.set_output("Out", TracedLoD(out, (new_offs.astype(jnp.int32),),
+                                    max_lens=(ml,)))
+
+
+@register_op("sequence_reverse")
+def sequence_reverse_op(ctx):
+    """Reverse each sequence's step order in place (reference:
+    operators/sequence_reverse_op.h role): pad, flip valid prefixes,
+    unpad."""
+    x = ctx.input("X")
+    data = raw_data(x)
+    offs = seq_offsets(x)
+    ml = static_max_len(x)
+    padded, mask = lod_to_padded(data, offs, ml)
+    rev = reverse_padded(padded, mask, offs, ml)
+    out = padded_to_lod(rev, offs, data.shape[0])
+    ctx.set_output("Y", TracedLoD(out, x.lod, max_lens=x.max_lens))
+
+
+@register_op("simple_rnn")
+def simple_rnn(ctx):
+    """Whole-sequence vanilla RNN via masked lax.scan (reference:
+    gserver/layers/RecurrentLayer.cpp: h_t = act(x_t + W h_{t-1} + b);
+    the input arrives pre-projected, the v1 recurrent_layer contract)."""
+    x = ctx.input("Input")
+    w = raw_data(ctx.input("Weight"))
+    bias = ctx.input("Bias")
+    bias = raw_data(bias) if bias is not None else None
+    data = raw_data(x)
+    offs = seq_offsets(x)
+    ml = static_max_len(x)
+    act = _ACT[ctx.attr("activation", "tanh")]
+    rev = bool(ctx.attr("is_reverse", False))
+    D = w.shape[0]
+    n = offs.shape[0] - 1
+    padded, mask = lod_to_padded(data, offs, ml)
+    if rev:
+        padded = reverse_padded(padded, mask, offs, ml)
+    if bias is not None:
+        padded = padded + bias.reshape(-1)[None, None, :]
+    xs = jnp.swapaxes(padded, 0, 1)
+    ms = jnp.swapaxes(mask, 0, 1)
+
+    def step(h_prev, inp):
+        x_t, m = inp
+        h = act(x_t + jnp.dot(h_prev, w))
+        m_ = m[:, None].astype(h.dtype)
+        h = h * m_ + h_prev * (1 - m_)
+        return h, h
+
+    _, hs = jax.lax.scan(step, jnp.zeros((n, D), data.dtype), (xs, ms))
+    hs = jnp.swapaxes(hs, 0, 1)
+    if rev:
+        hs = reverse_padded(hs, mask, offs, ml)
+    out = padded_to_lod(hs, offs, data.shape[0])
+    ctx.set_output("Hidden", TracedLoD(out, x.lod, max_lens=x.max_lens))
+
+
+@register_op("lambda_rank_cost")
+def lambda_rank_cost(ctx):
+    """LambdaRank listwise cost (reference: gserver/layers/LambdaCost.cpp,
+    v1 lambda_cost). Per query sequence, the differentiable surrogate
+    sum_{rel_i > rel_j} |dNDCG_ij| * log(1 + exp(-(s_i - s_j))) — its
+    gradient is exactly the lambda_ij the reference backpropagates
+    (Burges et al.). Dense TPU form: pad each query to [n, max_len],
+    build the full pair matrix, mask invalid/equal-relevance pairs.
+
+    Score = model scores [total, 1]; Label = relevance [total, 1];
+    ndcg_num truncates the DCG position discount."""
+    s_in = ctx.input("Score")
+    r_in = ctx.input("Label")
+    s = raw_data(s_in).reshape(-1)
+    r = raw_data(r_in).reshape(-1)
+    offs = seq_offsets(s_in if isinstance(s_in, TracedLoD) else r_in)
+    ml = static_max_len(s_in if isinstance(s_in, TracedLoD) else r_in)
+    k = int(ctx.attr("ndcg_num", 5))
+    ps, mask = lod_to_padded(s, offs, ml)          # [n, L]
+    pr, _ = lod_to_padded(r, offs, ml)
+    # ideal DCG per query: sort relevances descending, discount 1/log2(pos+2)
+    disc = 1.0 / jnp.log2(jnp.arange(ml) + 2.0)
+    disc = jnp.where(jnp.arange(ml) < k, disc, 0.0)
+    r_sorted = -jnp.sort(-jnp.where(mask, pr, 0.0), axis=1)
+    idcg = jnp.sum((2.0 ** r_sorted - 1.0) * disc[None, :], axis=1)
+    idcg = jnp.maximum(idcg, 1e-5)
+    # rank of each item by current score (descending) -> its discount
+    order = jnp.argsort(-jnp.where(mask, ps, -jnp.inf), axis=1)
+    ranks = jnp.argsort(order, axis=1)             # position of item i
+    d_i = jnp.take(disc, jnp.minimum(ranks, ml - 1))
+    gain = (2.0 ** jnp.where(mask, pr, 0.0) - 1.0)
+    # |dNDCG_ij| = |g_i - g_j| * |d_i - d_j| / idcg  (swap i<->j effect)
+    dg = jnp.abs(gain[:, :, None] - gain[:, None, :])
+    dd = jnp.abs(d_i[:, :, None] - d_i[:, None, :])
+    w = dg * dd / idcg[:, None, None]
+    rel_diff = pr[:, :, None] - pr[:, None, :]
+    pair_mask = (rel_diff > 0) & mask[:, :, None] & mask[:, None, :]
+    sd = ps[:, :, None] - ps[:, None, :]
+    pair_cost = jnp.log1p(jnp.exp(-jnp.clip(sd, -30.0, 30.0)))
+    per_query = jnp.sum(jnp.where(pair_mask, w * pair_cost, 0.0),
+                        axis=(1, 2))
+    ctx.set_output("Out", jnp.mean(per_query).reshape(1))

@@ -171,7 +171,19 @@ def squeeze(ctx):
     ctx.set_output("Out", jnp.squeeze(x, axis=tuple(axes)))
 
 
-@register_op("unsqueeze")
+def _infer_unsqueeze(op, block):
+    xv = block._find_var_recursive(op.input("X")[0])
+    ov = block._find_var_recursive(op.output("Out")[0])
+    if None in (xv, ov) or xv.shape is None:
+        return
+    shape = list(xv.shape)
+    for a in sorted(op.attr("axes")):
+        shape.insert(a, 1)
+    ov.shape = tuple(shape)
+    ov.dtype = xv.dtype
+
+
+@register_op("unsqueeze", infer_shape=_infer_unsqueeze)
 def unsqueeze(ctx):
     x = raw_data(ctx.input("X"))
     out = x
@@ -270,7 +282,32 @@ def pad(ctx):
     ctx.set_output("Out", jnp.pad(x, cfg, constant_values=ctx.attr("pad_value", 0.0)))
 
 
-@register_op("slice")
+def _infer_slice(op, block):
+    xv = block._find_var_recursive(op.input("Input")[0])
+    ov = block._find_var_recursive(op.output("Out")[0])
+    if None in (xv, ov) or xv.shape is None:
+        return
+    shape = list(xv.shape)
+    for a, s, e in zip(op.attr("axes"), op.attr("starts"),
+                       op.attr("ends")):
+        dim = shape[a]
+        if dim is not None and dim >= 0:
+            # mirror Python slice semantics exactly (the runtime builds
+            # slice(s, e)): negative indices wrap, bounds clamp
+            s_ = s + dim if s < 0 else s
+            e_ = e + dim if e < 0 else e
+            s_ = min(max(s_, 0), dim)
+            e_ = min(max(e_, 0), dim)
+            shape[a] = max(e_ - s_, 0)
+        elif s >= 0 and e >= 0:
+            shape[a] = e - s
+        else:
+            return  # negative index on an unknown dim: shape unknowable
+    ov.shape = tuple(shape)
+    ov.dtype = xv.dtype
+
+
+@register_op("slice", infer_shape=_infer_slice)
 def slice_op(ctx):
     x = raw_data(ctx.input("Input"))
     axes = ctx.attr("axes")
@@ -375,3 +412,28 @@ def assign_value(ctx):
 def reverse(ctx):
     x = raw_data(ctx.input("X"))
     ctx.set_output("Out", jnp.flip(x, axis=tuple(ctx.attr("axis"))))
+
+
+def _infer_sampling_id(op, block):
+    xv = block._find_var_recursive(op.input("X")[0])
+    ov = block._find_var_recursive(op.output("Out")[0])
+    if None in (xv, ov) or xv.shape is None:
+        return
+    ov.shape = (xv.shape[0],)
+    ov.dtype = "int64"
+
+
+@register_op("sampling_id", infer_shape=_infer_sampling_id,
+             no_gradient=True)
+def sampling_id(ctx):
+    """Sample one class id per row from a [N, C] probability matrix
+    (reference: operators/sampling_id_op.cc / gserver SamplingIdLayer —
+    the stochastic counterpart of maxid for generation). Inverse-CDF with
+    the program's traced rng: id = #{j : cdf_j < u * total}."""
+    x = raw_data(ctx.input("X"))
+    key = ctx.next_rng()
+    u = jax.random.uniform(key, (x.shape[0], 1), jnp.float32)
+    cdf = jnp.cumsum(x.astype(jnp.float32), axis=1)
+    total = cdf[:, -1:]
+    ids = jnp.sum((cdf < u * total).astype(jnp.int64), axis=1)
+    ctx.set_output("Out", jnp.minimum(ids, x.shape[1] - 1))

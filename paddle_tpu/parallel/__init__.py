@@ -21,6 +21,7 @@ from .ring import (  # noqa: F401
 )
 from .pipeline import (  # noqa: F401
     pipeline, pipelined_step_fn, stack_stage_params,
+    pipeline_hetero, pipelined_hetero_step_fn,
 )
 from .async_sgd import (  # noqa: F401
     AsyncParameterServer, AsyncSGDUpdater, build_grad_program,

@@ -33,7 +33,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["pipeline", "pipelined_step_fn", "stack_stage_params"]
+__all__ = ["pipeline", "pipelined_step_fn", "stack_stage_params",
+           "pipeline_hetero", "pipelined_hetero_step_fn"]
 
 
 def stack_stage_params(per_stage_params):
@@ -101,6 +102,153 @@ def pipeline(stage_fn, n_micro, axis_name="pp", remat=False):
             jnp.where(last, y_micro, jnp.zeros_like(y_micro)), axis_name)
 
     return body
+
+
+def pipeline_hetero(stage_fns, n_micro, axis_name="pp", remat=False):
+    """Heterogeneous-stage pipeline body: real models (embedding trunk
+    head) whose stages share NO parameter structure.
+
+    ``stage_fns[i](params_i, x) -> y``; stage 0 consumes the raw
+    microbatch, stages 1..n-2 map activation -> activation (one common
+    shape — the ppermute payload), the last stage maps activation -> the
+    output (its own shape). Per tick each device runs ``lax.switch`` on
+    its stage index, so the compiled program contains every stage but
+    each device executes (and holds live activations for) only its own —
+    compute and activation memory pipeline exactly as in the homogeneous
+    case.
+
+    Tradeoff, stated plainly: the per-stage param TREES are replicated
+    over ``pp`` (XLA SPMD has no per-device pytree placement; true
+    weight-memory scaling needs the homogeneous stacked form above,
+    whose leading stage axis shards). Gradients still compute on the
+    owning stage's device only (untaken switch branches contribute
+    zeros) and are psum'd over ``pp``. reference analog:
+    gserver/gradientmachines/ParallelNeuralNetwork.h per-layer device
+    placement — which also kept every parameter on its worker while
+    pipelining compute.
+    """
+    stage_fns = [jax.checkpoint(f) if remat else f for f in stage_fns]
+    n_stages = len(stage_fns)
+
+    def body(all_params, x_micro, act_tpl, out_tpl):
+        stage = jax.lax.axis_index(axis_name)
+        n_ticks = n_micro + n_stages - 1
+        last = jnp.equal(stage, n_stages - 1)
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def make_branch(i):
+            def branch(operands):
+                x_t, act_in = operands
+                inp = x_t if i == 0 else act_in
+                y = stage_fns[i](all_params[i], inp)
+                if i == n_stages - 1:
+                    return jnp.zeros(act_tpl.shape, act_tpl.dtype), y
+                return (y.astype(act_tpl.dtype),
+                        jnp.zeros(out_tpl.shape, out_tpl.dtype))
+            return branch
+
+        branches = [make_branch(i) for i in range(n_stages)]
+
+        def tick(carry, t):
+            act_in = carry
+            x_t = jax.lax.dynamic_index_in_dim(
+                x_micro, jnp.minimum(t, n_micro - 1), 0, keepdims=False)
+            act_out, final = jax.lax.switch(stage, branches, (x_t, act_in))
+            out = jnp.where(last & (t >= n_stages - 1), final,
+                            jnp.zeros_like(final))
+            nxt = jax.lax.ppermute(act_out, axis_name, perm)
+            return nxt, out
+
+        state0 = jnp.zeros(act_tpl.shape, act_tpl.dtype)
+        _, outs = jax.lax.scan(tick, state0, jnp.arange(n_ticks))
+        y_micro = jax.lax.dynamic_slice_in_dim(outs, n_stages - 1,
+                                               n_micro, 0)
+        return jax.lax.psum(
+            jnp.where(last, y_micro, jnp.zeros_like(y_micro)), axis_name)
+
+    return body
+
+
+def pipelined_hetero_step_fn(stage_fns, loss_fn, mesh: Mesh, n_micro,
+                             axis_name="pp", data_axis=None, remat=False):
+    """Training-step builder for heterogeneous stages: returns a jitted
+    ``step(params_tuple, x, y, lr) -> (loss, new_params_tuple)`` where
+    ``params_tuple[i]`` is stage i's own pytree (any structure)."""
+    from jax.experimental.shard_map import shard_map
+
+    n_stages = len(stage_fns)
+    body = pipeline_hetero(stage_fns, n_micro, axis_name=axis_name,
+                           remat=remat)
+    batch_spec = (None, data_axis) if data_axis else (None,)
+
+    def per_device(params, xm, ym, lr, act_tpl, out_tpl):
+        n_pp = jax.lax.psum(1, axis_name)
+
+        def loss_of(p):
+            yp = body(p, xm, act_tpl, out_tpl)
+            l = loss_fn(yp, ym) / n_pp
+            if data_axis:
+                l = jax.lax.pmean(l, data_axis)
+            return l
+
+        loss, grads = jax.value_and_grad(loss_of)(params)
+        loss = jax.lax.psum(loss, axis_name)
+        # each stage's grads are nonzero only on its own device (the
+        # untaken switch branches differentiate to zeros); collect
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, axis_name), grads)
+        if data_axis:
+            grads = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, data_axis), grads)
+        new_params = jax.tree_util.tree_map(
+            lambda p, g: p - lr * g, params, grads)
+        return loss, new_params
+
+    xspec = P(*batch_spec)
+    rep = P()
+
+    def step(params, x, y, lr):
+        n = x.shape[0]
+        if n % n_micro:
+            raise ValueError("batch %d not divisible by n_micro %d"
+                             % (n, n_micro))
+        xm = x.reshape((n_micro, n // n_micro) + x.shape[1:])
+        ym = y.reshape((n_micro, n // n_micro) + y.shape[1:])
+        # inter-stage activation/output templates via shape-only eval of
+        # the stage chain on one PER-DEVICE microbatch (the dp axis, when
+        # present, shards the microbatch dim before the body sees it)
+        mb = n // n_micro
+        if data_axis:
+            dp = mesh.shape[data_axis]
+            if mb % dp:
+                raise ValueError("microbatch %d not divisible by %s=%d"
+                                 % (mb, data_axis, dp))
+            mb //= dp
+        act_tpl = jax.eval_shape(
+            stage_fns[0], params[0],
+            jax.ShapeDtypeStruct((mb,) + x.shape[1:], xm.dtype))
+        h = act_tpl
+        for i in range(1, n_stages - 1):
+            h = jax.eval_shape(stage_fns[i], params[i], h)
+            if h.shape != act_tpl.shape:
+                raise ValueError(
+                    "stage %d activation %s != pipeline activation %s "
+                    "(inter-stage payloads must share one shape)"
+                    % (i, h.shape, act_tpl.shape))
+        out_tpl = jax.eval_shape(stage_fns[-1], params[-1], h)
+        act_z = jnp.zeros(act_tpl.shape, act_tpl.dtype)
+        out_z = jnp.zeros(out_tpl.shape, out_tpl.dtype)
+
+        param_specs = jax.tree_util.tree_map(lambda _: rep, params)
+        smapped = shard_map(
+            per_device, mesh=mesh,
+            in_specs=(param_specs, xspec, xspec, rep, rep, rep),
+            out_specs=(rep, param_specs),
+            check_rep=False)
+        lr = jnp.asarray(lr, jnp.float32)
+        return smapped(params, xm, ym, lr, act_z, out_z)
+
+    return jax.jit(step)
 
 
 def pipelined_step_fn(stage_fn, loss_fn, mesh: Mesh, n_micro,

@@ -13,3 +13,6 @@ from .networks import *           # noqa: F401,F403
 from .evaluators import *         # noqa: F401,F403
 from .optimizers import *         # noqa: F401,F403
 from .data_sources import *      # noqa: F401,F403
+from .config_parser import (      # noqa: F401
+    ModelConfig, parse_config, parse_config_and_serialize,
+)

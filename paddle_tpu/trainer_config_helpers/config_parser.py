@@ -1,0 +1,100 @@
+"""v1 config parsing: config-as-data entry point.
+
+reference: python/paddle/trainer/config_parser.py:4350 ``parse_config``
+— runs a trainer-config (a python file or callable using the
+trainer_config_helpers DSL) and returns the serialized model config. The
+proto indirection collapses here (Program-as-config): the result wraps
+the built main/startup Programs plus their canonical protostr rendering
+(core/serialize.py), which golden tests diff exactly like the
+reference's protostr fixtures (trainer_config_helpers/tests/configs/).
+"""
+from __future__ import annotations
+
+from ..core import ir
+from ..core.serialize import (program_from_protostr, program_to_dict,
+                              program_to_protostr)
+
+__all__ = ["parse_config", "ModelConfig", "parse_config_and_serialize"]
+
+
+class ModelConfig(object):
+    """What parse_config returns: the built topology as data."""
+
+    def __init__(self, main_program, startup_program, outputs):
+        self.main_program = main_program
+        self.startup_program = startup_program
+        self.output_layer_names = [getattr(o, "name", str(o))
+                                   for o in outputs]
+        order = getattr(main_program, "_data_vars_order", [])
+        self.input_layer_names = [v.name for v in order]
+        self.parameter_names = sorted(
+            p.name for p in main_program.all_parameters())
+
+    def to_dict(self):
+        return {
+            "main_program": program_to_dict(self.main_program),
+            "startup_program": program_to_dict(self.startup_program),
+            "input_layer_names": self.input_layer_names,
+            "output_layer_names": self.output_layer_names,
+            "parameter_names": self.parameter_names,
+        }
+
+    def to_protostr(self):
+        """Canonical text form (the protostr golden-file analog)."""
+        import json
+        return json.dumps(self.to_dict(), sort_keys=True, indent=1)
+
+
+def _parse_arg_str(config_arg_str):
+    """reference config_parser: 'a=1,b=str' -> kwargs (ints/floats/bools
+    coerced)."""
+    args = {}
+    for part in (config_arg_str or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        for conv in (int, float):
+            try:
+                v = conv(v)
+                break
+            except ValueError:
+                continue
+        else:
+            if v in ("True", "False"):
+                v = v == "True"
+        args[k.strip()] = v
+    return args
+
+
+def parse_config(config, config_arg_str=""):
+    """Build ``config`` (a callable, or a path to a python file executed
+    like the reference's trainer config) under a fresh program pair and
+    return its ModelConfig. reference: config_parser.py:4350."""
+    from . import layers as v1
+
+    main, startup = ir.Program(), ir.Program()
+    old_main = ir.switch_main_program(main)
+    old_startup = ir.switch_startup_program(startup)
+    from ..core import unique_name
+    try:
+        with unique_name.guard():
+            if callable(config):
+                config(**_parse_arg_str(config_arg_str))
+            else:
+                glb = {"__name__": "__paddle_trainer_config__"}
+                glb.update(_parse_arg_str(config_arg_str))
+                with open(config) as f:
+                    code = compile(f.read(), config, "exec")
+                exec(code, glb)
+            outputs = v1.get_output_layers()
+        return ModelConfig(main, startup, outputs)
+    finally:
+        ir.switch_main_program(old_main)
+        ir.switch_startup_program(old_startup)
+
+
+def parse_config_and_serialize(config, config_arg_str=""):
+    """reference: config_parser.py parse_config_and_serialize (the
+    wire-format entry the C++ trainer consumed)."""
+    return parse_config(config, config_arg_str).to_protostr()

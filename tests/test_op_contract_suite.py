@@ -1192,10 +1192,11 @@ def test_grad(name, op_type, spec):
 
 
 def test_coverage():
-    """The suite must span >=127 distinct op types (VERDICT r1 item 4,
-    expanded round 2)."""
+    """The CASES harness must span >=158 distinct op types; the combined
+    >=200 floor (with the program-level contracts) is asserted in
+    test_op_contract_suite2.py (VERDICT r2 item 4)."""
     ops = {c[1] for c in CASES}
-    assert len(ops) >= 127, "op contract coverage %d < 127: %s" % (
+    assert len(ops) >= 158, "op contract coverage %d < 158: %s" % (
         len(ops), sorted(ops))
 
 

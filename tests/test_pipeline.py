@@ -14,7 +14,8 @@ from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
 from paddle_tpu.parallel import (
-    make_mesh, pipeline, pipelined_step_fn, stack_stage_params)
+    make_mesh, pipeline, pipelined_step_fn, stack_stage_params,
+    pipelined_hetero_step_fn)
 
 FEAT = 16
 
@@ -132,3 +133,120 @@ def test_pipeline_remat_matches():
                                        np.asarray(g0["w"]), rtol=1e-5)
         else:
             g0 = g
+
+
+# -- heterogeneous stages (VERDICT r2 item 8) -------------------------------
+
+def _hetero_transformer_stages(vocab=32, seq=6, d=8, heads=2):
+    """A REAL 2-stage transformer with non-identical stages: stage 0 =
+    token+position embedding; stage 1 = self-attention block + pooled
+    vocab head. No shared parameter structure between stages."""
+    rng = np.random.RandomState(0)
+
+    def r(*shape):
+        return jnp.asarray(rng.randn(*shape) * 0.1, jnp.float32)
+
+    p0 = {"emb": r(vocab, d), "pos": r(seq, d)}
+    p1 = {"wq": r(d, d), "wk": r(d, d), "wv": r(d, d), "wo": r(d, d),
+          "w_out": r(d, vocab), "b_out": jnp.zeros((vocab,), jnp.float32)}
+
+    def stage_embed(p, ids):                       # [mb, seq] -> [mb,seq,d]
+        return p["emb"][ids] + p["pos"][None, :, :]
+
+    def stage_attn_head(p, h):                     # [mb,seq,d] -> [mb,vocab]
+        q, k, v = h @ p["wq"], h @ p["wk"], h @ p["wv"]
+        att = jax.nn.softmax(q @ jnp.swapaxes(k, -1, -2)
+                             / jnp.sqrt(h.shape[-1]), axis=-1)
+        h = h + (att @ v) @ p["wo"]
+        pooled = h.mean(axis=1)
+        return pooled @ p["w_out"] + p["b_out"]
+
+    return [stage_embed, stage_attn_head], (p0, p1)
+
+
+def _ce(logits_micro, y_micro):
+    # [n_micro, mb, V] vs [n_micro, mb]
+    logp = jax.nn.log_softmax(logits_micro, axis=-1)
+    picked = jnp.take_along_axis(logp, y_micro[..., None],
+                                 axis=-1)[..., 0]
+    return -picked.mean()
+
+
+def test_hetero_pipeline_matches_sequential():
+    """2-stage transformer (embedding | attention+head), pp=2: the
+    pipelined loss AND the updated params must equal the plain
+    sequential computation exactly."""
+    stage_fns, params = _hetero_transformer_stages()
+    mesh = make_mesh({"pp": 2}, devices=jax.devices()[:2])
+    n_micro, mb, seq = 4, 3, 6
+    rng = np.random.RandomState(1)
+    x = rng.randint(0, 32, (n_micro * mb, seq)).astype(np.int32)
+    y = rng.randint(0, 32, (n_micro * mb,)).astype(np.int32)
+    lr = 0.2
+
+    step = pipelined_hetero_step_fn(stage_fns, _ce, mesh, n_micro)
+    loss, new_params = step(params, x, y, lr)
+
+    def seq_loss(p):
+        xm = x.reshape(n_micro, mb, seq)
+        logits = jnp.stack([
+            stage_fns[1](p[1], stage_fns[0](p[0], xm[i]))
+            for i in range(n_micro)])
+        return _ce(logits, jnp.asarray(y.reshape(n_micro, mb)))
+
+    ref_loss, ref_grads = jax.value_and_grad(seq_loss)(params)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    ref_new = jax.tree_util.tree_map(lambda p, g: p - lr * g, params,
+                                     ref_grads)
+    for a, b in zip(jax.tree_util.tree_leaves(new_params),
+                    jax.tree_util.tree_leaves(ref_new)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=1e-6)
+
+
+def test_hetero_pipeline_trains_dp_x_pp():
+    """4-stage hetero pipeline (embed | trunk | trunk | head) over a
+    dp=2 x pp=4 mesh, with data parallelism on the microbatch dim."""
+    vocab, seq, d = 16, 4, 8
+    rng = np.random.RandomState(2)
+
+    def r(*shape):
+        return jnp.asarray(rng.randn(*shape) * 0.1, jnp.float32)
+
+    p_embed = {"emb": r(vocab, d)}
+    p_t1 = {"w": r(d, d)}
+    p_t2 = {"w1": r(d, d), "w2": r(d, d)}     # deliberately different tree
+    p_head = {"w": r(d, vocab)}
+
+    fns = [
+        lambda p, ids: p["emb"][ids],
+        lambda p, h: h + jnp.tanh(h @ p["w"]),
+        lambda p, h: h + jnp.tanh(jnp.tanh(h @ p["w1"]) @ p["w2"]),
+        lambda p, h: (h.mean(axis=1) @ p["w"]),
+    ]
+    params = (p_embed, p_t1, p_t2, p_head)
+    mesh = make_mesh({"dp": 2, "pp": 4})
+    n_micro = 8
+    x = rng.randint(0, vocab, (16, seq)).astype(np.int32)
+    y = rng.randint(0, vocab, (16,)).astype(np.int32)
+
+    step = pipelined_hetero_step_fn(fns, _ce, mesh, n_micro,
+                                    data_axis="dp")
+    losses = []
+    for _ in range(6):
+        loss, params = step(params, x, y, 0.5)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    assert all(np.isfinite(l) for l in losses)
+
+
+def test_hetero_pipeline_rejects_mismatched_activation():
+    fns = [lambda p, x: x @ p, lambda p, h: h[:, :2] @ p,
+           lambda p, h: h @ p]
+    params = (jnp.eye(4), jnp.eye(2), jnp.eye(2))
+    mesh = make_mesh({"pp": 3}, devices=jax.devices()[:3])
+    step = pipelined_hetero_step_fn(
+        fns, lambda yp, yt: jnp.mean((yp - yt) ** 2), mesh, n_micro=3)
+    x = np.zeros((6, 4), np.float32)
+    with pytest.raises(ValueError, match="activation"):
+        step((params), x, np.zeros((6, 2), np.float32), 0.1)
