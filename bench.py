@@ -449,7 +449,26 @@ def child_main(tag):
 
     _log(tag, "initializing device ...")
     t0 = time.time()
-    dev = jax.devices()[0]
+    dev = None
+    while dev is None:
+        try:
+            dev = jax.devices()[0]
+        except Exception as e:
+            # a tunnelled backend can fail transiently while its pool
+            # provisions (observed: RuntimeError UNAVAILABLE after a long
+            # block). Retry while budget remains — the CPU child has
+            # already banked a number either way.
+            if _remaining() < 240:
+                _log(tag, "device init failed (%r), no budget to retry"
+                     % e)
+                return
+            _log(tag, "device init failed (%r), retrying in 20s" % e)
+            time.sleep(20)
+            try:
+                from jax.extend.backend import clear_backends
+                clear_backends()
+            except Exception:
+                pass
     _log(tag, "device up in %.1fs: %s (%s)"
          % (time.time() - t0, dev, getattr(dev, "device_kind", "?")))
     peak = _peak_flops(dev)

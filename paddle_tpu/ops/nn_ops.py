@@ -489,15 +489,26 @@ def pool2d(ctx):
     k = ctx.attr("ksize")
     s = ctx.attr("strides", [1, 1])
     p = ctx.attr("paddings", [0, 0])
+    ceil = bool(ctx.attr("ceil_mode", False))
     dims = (1, 1, k[0], k[1])
     strides = (1, 1, s[0], s[1])
-    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    # ceil_mode covers the partial trailing window with extra right/bottom
+    # padding: out = ceil((i+2p-k)/s)+1 (reference: math/pooling.cc; the
+    # v1 img_pool_layer defaults to ceil)
+    extra = [0, 0]
+    if ceil:
+        for a, i in ((0, x.shape[2]), (1, x.shape[3])):
+            num = i + 2 * p[a] - k[a]
+            out_d = (num + s[a] - 1) // s[a] + 1
+            extra[a] = max((out_d - 1) * s[a] + k[a] - (i + 2 * p[a]), 0)
+    pads = ((0, 0), (0, 0), (p[0], p[0] + extra[0]),
+            (p[1], p[1] + extra[1]))
     if ptype == "max":
         init = -jnp.inf
         out = jax.lax.reduce_window(x, init, jax.lax.max, dims, strides, pads)
     else:
         summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides, pads)
-        if ctx.attr("exclusive", True) and (p[0] or p[1]):
+        if ctx.attr("exclusive", True) and (p[0] or p[1] or any(extra)):
             ones = jnp.ones_like(x)
             counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims,
                                            strides, pads)
@@ -541,14 +552,30 @@ def pool3d(ctx):
     k = ctx.attr("ksize")
     s = ctx.attr("strides", [1, 1, 1])
     p = ctx.attr("paddings", [0, 0, 0])
+    ceil = bool(ctx.attr("ceil_mode", False))
     dims = (1, 1) + tuple(k)
     strides = (1, 1) + tuple(s)
-    pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+    extra = [0, 0, 0]
+    if ceil:
+        for a in range(3):
+            i = x.shape[2 + a]
+            num = i + 2 * p[a] - k[a]
+            out_d = (num + s[a] - 1) // s[a] + 1
+            extra[a] = max((out_d - 1) * s[a] + k[a] - (i + 2 * p[a]), 0)
+    pads = ((0, 0), (0, 0)) + tuple(
+        (p[a], p[a] + extra[a]) for a in range(3))
     if ptype == "max":
         out = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims, strides, pads)
     else:
-        out = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides,
-                                    pads) / float(prod(k))
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides,
+                                       pads)
+        if any(p) or any(extra):
+            counts = jax.lax.reduce_window(jnp.ones_like(x), 0.0,
+                                           jax.lax.add, dims, strides,
+                                           pads)
+            out = summed / counts
+        else:
+            out = summed / float(prod(k))
     ctx.set_output("Out", out)
 
 

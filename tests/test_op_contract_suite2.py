@@ -782,3 +782,35 @@ def test_combined_coverage_200():
     assert len(ops) >= 200, (
         "op contract coverage %d < 200 (uncovered: %s)"
         % (len(ops), sorted(set(_REGISTRY) - ops)))
+
+
+@covers("pool2d", "pool3d")
+def test_pool_ceil_mode_contract():
+    """ceil_mode=True covers the partial trailing window (the v1
+    img_pool_layer DEFAULT — previously the lowering floored and shapes
+    disagreed with the DSL's computed sizes). Max and exclusive-avg both
+    checked against numpy on a 5x5/pool2/stride2 image."""
+    x = np.arange(25, dtype=np.float32).reshape(1, 1, 5, 5)
+    xv = F.data("x", shape=[1, 5, 5], dtype="float32")
+    pmax = F.pool2d(xv, pool_size=2, pool_type="max", pool_stride=2,
+                    ceil_mode=True)
+    pavg = F.pool2d(xv, pool_size=2, pool_type="avg", pool_stride=2,
+                    ceil_mode=True)
+    vol = np.arange(27, dtype=np.float32).reshape(1, 1, 3, 3, 3)
+    vv = F.data("v", shape=[1, 3, 3, 3], dtype="float32")
+    p3 = F.pool3d(vv, pool_size=2, pool_type="max", pool_stride=2,
+                  ceil_mode=True)
+    exe = _exe()
+    m, a, t = exe.run(feed={"x": x, "v": vol},
+                      fetch_list=[pmax, pavg, p3])
+    m, a, t = np.asarray(m), np.asarray(a), np.asarray(t)
+    assert m.shape == (1, 1, 3, 3) and t.shape == (1, 1, 2, 2, 2)
+    xi = x[0, 0]
+    cols = [slice(0, 2), slice(2, 4), slice(4, 5)]
+    want_max = np.array([[xi[r, c].max() for c in cols] for r in cols])
+    want_avg = np.array([[xi[r, c].mean() for c in cols] for r in cols])
+    np.testing.assert_allclose(m[0, 0], want_max)
+    np.testing.assert_allclose(a[0, 0], want_avg, rtol=1e-6)
+    vi = vol[0, 0]
+    np.testing.assert_allclose(
+        t[0, 0, 1, 1, 1], vi[2:3, 2:3, 2:3].max())
