@@ -359,9 +359,11 @@ for _conv in ("conv2d", "depthwise_conv2d"):
 
 @register_op("pool2d_grad", no_gradient=True)
 def pool2d_grad(ctx):
-    """reference: operators/pool_op.cc grad + math/pooling.*. The vjp here
-    traces a single reduce_window primitive (XLA lowers its transpose to
-    select-and-scatter natively) — not a full lowering replay."""
+    """reference: operators/pool_op.cc grad + math/pooling.*. The vjp
+    replays nn_ops.pool2d_apply — the exact function the forward lowering
+    uses (incl. ceil_mode extra padding) — so forward/grad shapes cannot
+    diverge; XLA lowers the reduce_window transpose to select-and-scatter
+    natively."""
     x = raw_data(ctx.input("X"))
     dy = raw_data(ctx.input("Out@GRAD"))
     ptype = ctx.attr("pooling_type", "max")
@@ -377,26 +379,15 @@ def pool2d_grad(ctx):
             ctx.set_output("X@GRAD",
                            jnp.broadcast_to(dy / n, x.shape).astype(x.dtype))
         return
+    from .nn_ops import pool2d_apply
     k = ctx.attr("ksize")
     s = ctx.attr("strides", [1, 1])
     p = ctx.attr("paddings", [0, 0])
-    dims = (1, 1, k[0], k[1])
-    strides = (1, 1, s[0], s[1])
-    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    ceil = bool(ctx.attr("ceil_mode", False))
     exclusive = ctx.attr("exclusive", True)
 
     def f(x_):
-        if ptype == "max":
-            return jax.lax.reduce_window(x_, -jnp.inf, jax.lax.max, dims,
-                                         strides, pads)
-        summed = jax.lax.reduce_window(x_, 0.0, jax.lax.add, dims, strides,
-                                       pads)
-        if exclusive and (p[0] or p[1]):
-            ones = jnp.ones_like(x_)
-            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims,
-                                           strides, pads)
-            return summed / counts
-        return summed / float(k[0] * k[1])
+        return pool2d_apply(x_, ptype, k, s, p, ceil, exclusive)
 
     _, vjp = jax.vjp(f, x)
     dx, = vjp(dy.astype(x.dtype))

@@ -69,6 +69,15 @@ for _name, _fn in _ACTIVATIONS.items():
         functools.partial(lambda ctx, f: _act(ctx, f), f=_fn))
 
 
+@register_op("hard_shrink", infer_shape=_infer_same)
+def hard_shrink(ctx):
+    """reference: operators/activation_op.cc HardShrinkFunctor — pass x
+    through only outside [-threshold, threshold]."""
+    t = ctx.attr("threshold", 0.5)
+    _act(ctx, lambda x: jnp.where((x > t) | (x < -t), x,
+                                  jnp.zeros((), x.dtype)))
+
+
 @register_op("leaky_relu", infer_shape=_infer_same)
 def leaky_relu(ctx):
     a = ctx.attr("alpha", 0.02)
@@ -419,6 +428,45 @@ def conv2d_transpose(ctx):
     ctx.set_output("Output", out)
 
 
+def _infer_conv3d_transpose(op, block):
+    xv = block._find_var_recursive(op.input("Input")[0])
+    fv = block._find_var_recursive(op.input("Filter")[0])
+    ov = block._find_var_recursive(op.output("Output")[0])
+    if None in (xv, fv, ov) or xv.shape is None or fv.shape is None:
+        return
+    s = op.attr("strides", [1, 1, 1])
+    p = op.attr("paddings", [0, 0, 0])
+    d = op.attr("dilations", [1, 1, 1])
+    n = xv.shape[0]
+    oc = fv.shape[1]
+    spatial = tuple(
+        (xv.shape[2 + i] - 1) * s[i] - 2 * p[i]
+        + (fv.shape[2 + i] - 1) * d[i] + 1 for i in range(3))
+    ov.shape = (n, oc) + spatial
+    ov.dtype = xv.dtype
+
+
+@register_op("conv3d_transpose", infer_shape=_infer_conv3d_transpose)
+def conv3d_transpose(ctx):
+    """reference: operators/conv_transpose_op.cc (3d registration).
+    Filter layout IODHW; same gradient-of-conv formulation as
+    conv2d_transpose above, one spatial dim up."""
+    x = raw_data(ctx.input("Input"))
+    w = raw_data(ctx.input("Filter"))
+    s = ctx.attr("strides", [1, 1, 1])
+    p = ctx.attr("paddings", [0, 0, 0])
+    d = ctx.attr("dilations", [1, 1, 1])
+    ke = [(w.shape[2 + i] - 1) * d[i] + 1 for i in range(3)]
+    out = jax.lax.conv_general_dilated(
+        x, jnp.flip(w, (2, 3, 4)),
+        window_strides=(1, 1, 1),
+        padding=[(ke[i] - 1 - p[i], ke[i] - 1 - p[i]) for i in range(3)],
+        lhs_dilation=tuple(s),
+        rhs_dilation=tuple(d),
+        dimension_numbers=("NCDHW", "IODHW", "NCDHW"))
+    ctx.set_output("Output", out)
+
+
 def _infer_conv3d(op, block):
     xv = block._find_var_recursive(op.input("Input")[0])
     fv = block._find_var_recursive(op.input("Filter")[0])
@@ -474,22 +522,11 @@ def _infer_pool2d(op, block):
     ov.dtype = xv.dtype
 
 
-@register_op("pool2d", infer_shape=_infer_pool2d)
-def pool2d(ctx):
-    """reference: operators/pool_op.cc + math/pooling.*"""
-    x = raw_data(ctx.input("X"))
-    ptype = ctx.attr("pooling_type", "max")
-    if ctx.attr("global_pooling", False):
-        if ptype == "max":
-            out = jnp.max(x, axis=(2, 3), keepdims=True)
-        else:
-            out = jnp.mean(x, axis=(2, 3), keepdims=True)
-        ctx.set_output("Out", out)
-        return
-    k = ctx.attr("ksize")
-    s = ctx.attr("strides", [1, 1])
-    p = ctx.attr("paddings", [0, 0])
-    ceil = bool(ctx.attr("ceil_mode", False))
+def pool2d_apply(x, ptype, k, s, p, ceil, exclusive):
+    """Pure pool2d forward shared by the lowering below AND by
+    explicit_grads.pool2d_grad's jax.vjp replay — one definition, so the
+    forward and the gradient can never disagree on padding/ceil semantics
+    (reference: operators/pool_op.cc + math/pooling.cc)."""
     dims = (1, 1, k[0], k[1])
     strides = (1, 1, s[0], s[1])
     # ceil_mode covers the partial trailing window with extra right/bottom
@@ -504,17 +541,34 @@ def pool2d(ctx):
     pads = ((0, 0), (0, 0), (p[0], p[0] + extra[0]),
             (p[1], p[1] + extra[1]))
     if ptype == "max":
-        init = -jnp.inf
-        out = jax.lax.reduce_window(x, init, jax.lax.max, dims, strides, pads)
-    else:
-        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides, pads)
-        if ctx.attr("exclusive", True) and (p[0] or p[1] or any(extra)):
-            ones = jnp.ones_like(x)
-            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims,
-                                           strides, pads)
-            out = summed / counts
+        return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims,
+                                     strides, pads)
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides, pads)
+    if exclusive and (p[0] or p[1] or any(extra)):
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims,
+                                       strides, pads)
+        return summed / counts
+    return summed / float(k[0] * k[1])
+
+
+@register_op("pool2d", infer_shape=_infer_pool2d)
+def pool2d(ctx):
+    """reference: operators/pool_op.cc + math/pooling.*"""
+    x = raw_data(ctx.input("X"))
+    ptype = ctx.attr("pooling_type", "max")
+    if ctx.attr("global_pooling", False):
+        if ptype == "max":
+            out = jnp.max(x, axis=(2, 3), keepdims=True)
         else:
-            out = summed / float(k[0] * k[1])
+            out = jnp.mean(x, axis=(2, 3), keepdims=True)
+        ctx.set_output("Out", out)
+        return
+    out = pool2d_apply(x, ptype, ctx.attr("ksize"),
+                       ctx.attr("strides", [1, 1]),
+                       ctx.attr("paddings", [0, 0]),
+                       bool(ctx.attr("ceil_mode", False)),
+                       ctx.attr("exclusive", True))
     ctx.set_output("Out", out)
 
 

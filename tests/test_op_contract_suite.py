@@ -429,6 +429,71 @@ case("pool2d_global", "pool2d", inputs={"X": _px},
      outputs={"Out": _px.max(axis=(2, 3), keepdims=True)},
      attrs={"pooling_type": "max", "ksize": [1, 1],
             "global_pooling": True})
+
+
+def _np_pool2d(x, ptype, k, s, p, ceil, exclusive):
+    """Numpy oracle for pool2d incl. ceil_mode partial trailing windows
+    (reference: operators/math/pooling.cc)."""
+    n, c, h, w = x.shape
+
+    def odim(i, kk, pp, ss):
+        num = i + 2 * pp - kk
+        return (num + ss - 1) // ss + 1 if ceil else num // ss + 1
+
+    oh, ow = odim(h, k[0], p[0], s[0]), odim(w, k[1], p[1], s[1])
+    out = np.zeros((n, c, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            h0, w0 = i * s[0] - p[0], j * s[1] - p[1]
+            h1, w1 = min(h0 + k[0], h), min(w0 + k[1], w)
+            h0, w0 = max(h0, 0), max(w0, 0)
+            win = x[:, :, h0:h1, w0:w1]
+            if ptype == "max":
+                out[:, :, i, j] = win.max(axis=(2, 3))
+            elif exclusive:
+                out[:, :, i, j] = win.mean(axis=(2, 3))
+            else:
+                out[:, :, i, j] = win.sum(axis=(2, 3)) / float(k[0] * k[1])
+    return out
+
+
+# ceil_mode x {max,avg} x {exclusive,inclusive}: the partial trailing
+# window (5x5 input, 2x2/s2 kernel -> 3x3 out under ceil) exercises the
+# extra right/bottom padding in both forward and grad replay.
+_pxc = _r(92, 1, 2, 5, 5)
+case("pool2d_max_ceil", "pool2d", inputs={"X": _pxc},
+     outputs={"Out": _np_pool2d(_pxc, "max", [2, 2], [2, 2], [0, 0],
+                                True, True)},
+     attrs={"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2],
+            "paddings": [0, 0], "ceil_mode": True}, grad=(["X"], "Out"))
+case("pool2d_avg_ceil_excl", "pool2d", inputs={"X": _pxc},
+     outputs={"Out": _np_pool2d(_pxc, "avg", [2, 2], [2, 2], [0, 0],
+                                True, True)},
+     attrs={"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2],
+            "paddings": [0, 0], "ceil_mode": True, "exclusive": True},
+     grad=(["X"], "Out"))
+case("pool2d_avg_ceil_incl", "pool2d", inputs={"X": _pxc},
+     outputs={"Out": _np_pool2d(_pxc, "avg", [2, 2], [2, 2], [0, 0],
+                                True, False)},
+     attrs={"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2],
+            "paddings": [0, 0], "ceil_mode": True, "exclusive": False},
+     grad=(["X"], "Out"))
+# k=3,s=3,p=1 on 6x6: num=5, ceil out=3, extra=1 — nonzero base padding
+# AND nonzero ceil extra padding interact, and every window still touches
+# real input (a window fully inside padding is UB in the reference kernel:
+# math/pooling.cc divides by an empty-window count)
+_pxc6 = _r(93, 1, 2, 6, 6)
+case("pool2d_max_ceil_pad", "pool2d", inputs={"X": _pxc6},
+     outputs={"Out": _np_pool2d(_pxc6, "max", [3, 3], [3, 3], [1, 1],
+                                True, True)},
+     attrs={"pooling_type": "max", "ksize": [3, 3], "strides": [3, 3],
+            "paddings": [1, 1], "ceil_mode": True}, grad=(["X"], "Out"))
+case("pool2d_avg_ceil_pad_excl", "pool2d", inputs={"X": _pxc6},
+     outputs={"Out": _np_pool2d(_pxc6, "avg", [3, 3], [3, 3], [1, 1],
+                                True, True)},
+     attrs={"pooling_type": "avg", "ksize": [3, 3], "strides": [3, 3],
+            "paddings": [1, 1], "ceil_mode": True, "exclusive": True},
+     grad=(["X"], "Out"))
 _p3 = _r(87, 1, 1, 2, 4, 4)
 case("pool3d", "pool3d", inputs={"X": _p3},
      outputs={"Out": _p3.reshape(1, 1, 1, 2, 2, 2, 2, 2)
@@ -1166,6 +1231,142 @@ case("conv_shift", "conv_shift",
      inputs={"X": _csx, "Y": _csy},
      outputs={"Out": _csw},
      grad=(["X", "Y"], "Out"))
+
+
+# ---------------------------------------------------------------------------
+# round-4 expansion: the fluid op tail (reference registration sites
+# activation_op.cc hard_shrink, l1_norm_op.cc, modified_huber_loss_op.cc,
+# bilinear_tensor_product_op.cc, conv_transpose_op.cc 3d,
+# pool_with_index_op.cc max_pool3d_with_index)
+# ---------------------------------------------------------------------------
+
+# keep samples away from the +-0.5 threshold so finite differences do not
+# straddle the kink
+_hsx = _r(120, 3, 4)
+_hsx = np.where(np.abs(np.abs(_hsx) - 0.5) < 0.05, _hsx + 0.2, _hsx) \
+    .astype(np.float32)
+case("hard_shrink", "hard_shrink", inputs={"X": _hsx},
+     outputs={"Out": np.where(np.abs(_hsx) > 0.5, _hsx, 0.0)
+              .astype(np.float32)},
+     attrs={"threshold": 0.5}, grad=(["X"], "Out"))
+
+_l1x = _x_off0  # bounded away from 0: |x| kink
+case("l1_norm", "l1_norm", inputs={"X": _l1x},
+     outputs={"Out": np.sum(np.abs(_l1x)).reshape(1).astype(np.float32)},
+     grad=(["X"], "Out"))
+
+
+def _mhuber_ref(x, y):
+    v = x * (2.0 * y - 1.0)
+    return np.where(v < -1.0, -4.0 * v,
+                    np.where(v < 1.0, (1.0 - v) ** 2, 0.0)), v
+
+
+_mhx = (_r(121, 6, 1) * 2.0).astype(np.float32)
+_mhy = (np.arange(6).reshape(6, 1) % 2).astype(np.float32)
+_mhv = _mhx * (2 * _mhy - 1)
+_mhx = np.where(np.abs(np.abs(_mhv) - 1.0) < 0.05, _mhx * 1.5, _mhx) \
+    .astype(np.float32)
+_mhl, _mhv = _mhuber_ref(_mhx, _mhy)
+case("modified_huber_loss", "modified_huber_loss",
+     inputs={"X": _mhx, "Y": _mhy},
+     outputs={"Out": _mhl.astype(np.float32),
+              "IntermediateVal": _mhv.astype(np.float32)},
+     grad=(["X"], "Out"))
+
+_btx, _bty = _r(122, 3, 4), _r(123, 3, 5)
+_btw = (_r(124, 2, 4, 5) * 0.3).astype(np.float32)
+_btb = _r(125, 1, 2)
+case("bilinear_tensor_product", "bilinear_tensor_product",
+     inputs={"X": _btx, "Y": _bty, "Weight": _btw, "Bias": _btb},
+     outputs={"Out": (np.einsum("bm,kmn,bn->bk", _btx, _btw, _bty)
+                      + _btb).astype(np.float32)},
+     atol=1e-4, rtol=1e-4, grad=(["X", "Y", "Weight"], "Out"))
+
+
+def _conv3dt_ref(x, w, s, p):
+    B, IC, D, H, W = x.shape
+    _, OC, KD, KH, KW = w.shape
+    fD, fH, fW = ((D - 1) * s[0] + KD, (H - 1) * s[1] + KH,
+                  (W - 1) * s[2] + KW)
+    full = np.zeros((B, OC, fD, fH, fW), np.float64)
+    for b in range(B):
+        for ic in range(IC):
+            for z in range(D):
+                for y in range(H):
+                    for xx in range(W):
+                        full[b, :, z * s[0]:z * s[0] + KD,
+                             y * s[1]:y * s[1] + KH,
+                             xx * s[2]:xx * s[2] + KW] += (
+                            x[b, ic, z, y, xx] * w[ic])
+    return full[:, :, p[0]:fD - p[0], p[1]:fH - p[1],
+                p[2]:fW - p[2]].astype(np.float32)
+
+
+_c3tx = _r(126, 1, 2, 2, 3, 3)
+_c3tw = (_r(127, 2, 2, 2, 2, 2) * 0.5).astype(np.float32)
+case("conv3d_transpose", "conv3d_transpose",
+     inputs={"Input": [("Input", _c3tx)], "Filter": [("Filter", _c3tw)]},
+     outputs={"Output": _conv3dt_ref(_c3tx, _c3tw, [2, 2, 2], [0, 0, 0])},
+     attrs={"strides": [2, 2, 2], "paddings": [0, 0, 0],
+            "dilations": [1, 1, 1]}, atol=1e-4, rtol=1e-4,
+     grad=(["Input", "Filter"], "Output"))
+case("conv3d_transpose_pad", "conv3d_transpose",
+     inputs={"Input": [("Input", _c3tx)], "Filter": [("Filter", _c3tw)]},
+     outputs={"Output": _conv3dt_ref(_c3tx, _c3tw, [1, 1, 1], [1, 1, 1])},
+     attrs={"strides": [1, 1, 1], "paddings": [1, 1, 1],
+            "dilations": [1, 1, 1]}, atol=1e-4, rtol=1e-4)
+
+
+def _mp3_ref(x, k, s):
+    N, C, D, H, W = x.shape
+    od = [(D - k[0]) // s[0] + 1, (H - k[1]) // s[1] + 1,
+          (W - k[2]) // s[2] + 1]
+    out = np.zeros((N, C) + tuple(od), np.float32)
+    idx = np.zeros((N, C) + tuple(od), np.int32)
+    for n in range(N):
+        for c in range(C):
+            for z in range(od[0]):
+                for y in range(od[1]):
+                    for xx in range(od[2]):
+                        win = x[n, c, z * s[0]:z * s[0] + k[0],
+                                y * s[1]:y * s[1] + k[1],
+                                xx * s[2]:xx * s[2] + k[2]]
+                        a = np.unravel_index(np.argmax(win), win.shape)
+                        out[n, c, z, y, xx] = win[a]
+                        idx[n, c, z, y, xx] = (
+                            (z * s[0] + a[0]) * H * W
+                            + (y * s[1] + a[1]) * W + (xx * s[2] + a[2]))
+    return out, idx
+
+
+_mp3x = _r(128, 1, 2, 4, 4, 4)
+_mp3o, _mp3i = _mp3_ref(_mp3x, [2, 2, 2], [2, 2, 2])
+case("max_pool3d_with_index", "max_pool3d_with_index",
+     inputs={"X": _mp3x},
+     outputs={"Out": _mp3o, "Mask": _mp3i},
+     attrs={"ksize": [2, 2, 2], "strides": [2, 2, 2],
+            "paddings": [0, 0, 0]})
+
+# fill / minus / label_smooth (reference: fill_op.cc, minus_op.cc,
+# label_smooth_op.cc)
+case("fill", "fill", inputs={},
+     outputs={"Out": np.asarray([[1.5, -2.0], [0.0, 3.25]], np.float32)},
+     attrs={"shape": [2, 2], "dtype": "float32",
+            "value": [1.5, -2.0, 0.0, 3.25]})
+_mnx, _mny = _r(129, 3, 4), _r(130, 3, 4)
+case("minus", "minus", inputs={"X": _mnx, "Y": _mny},
+     outputs={"Out": (_mnx - _mny).astype(np.float32)},
+     grad=(["X", "Y"], "Out"))
+_lsx = _sig(_r(131, 4, 5)).astype(np.float32)
+case("label_smooth_uniform", "label_smooth", inputs={"X": _lsx},
+     outputs={"Out": (0.9 * _lsx + 0.1 / 5).astype(np.float32)},
+     attrs={"epsilon": 0.1}, grad=(["X"], "Out"))
+_lsd = (np.arange(1, 6, dtype=np.float32) / 15.0).reshape(1, 5)
+case("label_smooth_prior", "label_smooth",
+     inputs={"X": _lsx, "PriorDist": _lsd},
+     outputs={"Out": (0.9 * _lsx + 0.1 * _lsd).astype(np.float32)},
+     attrs={"epsilon": 0.1})
 
 
 # ---------------------------------------------------------------------------
