@@ -22,6 +22,7 @@ __all__ = [
     "logical_or", "lod_rank_table", "max_sequence_len", "lod_tensor_to_array",
     "array_to_lod_tensor", "shrink_memory", "reorder_lod_tensor_by_rank",
     "beam_search", "beam_search_decode", "zeros_like",
+    "split_lod_tensor", "merge_lod_tensor",
 ]
 
 
@@ -519,13 +520,60 @@ class DynamicRNN(object):
                             .format(method))
 
 
-# -- IfElse / Switch ---------------------------------------------------------
+# -- split/merge_lod_tensor + IfElse / Switch --------------------------------
+
+def split_lod_tensor(input, mask, level=0):
+    """Split ``input`` rows (or whole sequences at lod ``level``) by the
+    boolean column ``mask`` into (true_branch, false_branch).
+
+    reference: layers/control_flow.py:55 -> operators/split_lod_tensor_op.cc.
+    TPU contract: outputs keep input's full row capacity; selected rows are
+    stably compacted to the front, the tail is zeros (see the op docstring
+    in ops/control_flow_ops.py for the padding contract)."""
+    helper = LayerHelper("split_lod_tensor", **locals())
+    out_true = helper.create_variable_for_type_inference(input.dtype)
+    out_false = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="split_lod_tensor",
+        inputs={"X": [input], "Mask": [mask]},
+        outputs={"OutTrue": [out_true], "OutFalse": [out_false]},
+        attrs={"level": level})
+    return out_true, out_false
+
+
+def merge_lod_tensor(in_true, in_false, x, mask, level=0):
+    """Inverse of split_lod_tensor: reassemble rows by ``mask`` position.
+
+    reference: layers/control_flow.py:101 -> operators/merge_lod_tensor_op.cc.
+    ``x`` supplies the output's shape/LoD frame (the reference reads its lod;
+    here it also carries lod_level for sequence merges)."""
+    helper = LayerHelper("merge_lod_tensor", **locals())
+    out = helper.create_variable_for_type_inference(in_true.dtype)
+    helper.append_op(
+        type="merge_lod_tensor",
+        inputs={"X": [x], "Mask": [mask], "InTrue": [in_true],
+                "InFalse": [in_false]},
+        outputs={"Out": [out]},
+        attrs={"level": level})
+    return out
+
 
 class IfElse(object):
-    """reference: layers/control_flow.py IfElse — two conditional blocks over
-    a boolean mask; true_block/false_block see masked slices of inputs.
-    This implementation keeps the reference API for scalar conditions (the
-    dominant use) via conditional_block ops."""
+    """Row-masked two-branch construct.
+
+    reference: layers/control_flow.py:1247 IfElse — the condition is a
+    boolean column over batch rows; ``input(x)`` yields the branch's masked
+    slice via split_lod_tensor, ``output(...)`` registers branch results,
+    and ``__call__`` merges them back row-by-row with merge_lod_tensor.
+
+    TPU-first inversion: the reference wraps each branch in a
+    ConditionalBlock that the interpreter may skip at runtime; here BOTH
+    branches trace unconditionally on fixed-capacity masked tensors, so the
+    whole construct (and its gradient) compiles into one XLA program — no
+    host round-trip. Rows a branch does not own are zero-padded by split
+    and never selected by merge, so values and grads match the reference's
+    dynamic-row semantics for row-wise branch computation (the IfElse
+    contract). A scalar (1-row) condition degenerates to classic if/else."""
 
     OUT_IF_ELSE_BLOCKS = 0
     IN_IF_ELSE_TRUE_BLOCKS = 1
@@ -534,63 +582,60 @@ class IfElse(object):
     def __init__(self, cond, name=None):
         self.helper = LayerHelper("ifelse", name=name)
         self.cond = cond
+        self.input_table = {}
+        self.output_table = ([], [])  # (false_outs, true_outs) — ref order
         self.status = IfElse.OUT_IF_ELSE_BLOCKS
 
     @contextlib.contextmanager
-    def _block(self, invert):
-        from . import tensor as _tensor
-        program = self.helper.main_program
-        cond = self.cond
-        if invert:
-            parent = program.current_block()
-            notv = self.helper.create_variable_for_type_inference("bool")
-            parent.append_op(type="logical_not", inputs={"X": [cond]},
-                             outputs={"Out": [notv]})
-            cond = notv
-        sub = program.create_block()
-        self.status = (IfElse.IN_IF_ELSE_FALSE_BLOCKS if invert
-                       else IfElse.IN_IF_ELSE_TRUE_BLOCKS)
+    def _guard(self, is_true):
+        if self.status != IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("You cannot invoke IfElse.block() inside a block")
+        self.status = (IfElse.IN_IF_ELSE_TRUE_BLOCKS if is_true
+                       else IfElse.IN_IF_ELSE_FALSE_BLOCKS)
         try:
             yield
         finally:
-            program.rollback()
             self.status = IfElse.OUT_IF_ELSE_BLOCKS
-        read, written = _block_reads_writes(sub)
-        program.current_block().append_op(
-            type="conditional_block",
-            inputs={"Cond": [cond], "X": read},
-            outputs={"Out": written},
-            attrs={"sub_block": sub.idx})
+        if len(self.output_table[1 if is_true else 0]) == 0:
+            raise ValueError("Must set output inside block")
 
     def true_block(self):
-        return self._block(invert=False)
+        return self._guard(True)
 
     def false_block(self):
-        return self._block(invert=True)
+        return self._guard(False)
 
     def input(self, x):
-        # scalar-condition IfElse: inputs pass through unchanged
-        return x
+        if self.status == IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("input must be called inside true/false blocks")
+        if id(x) not in self.input_table:
+            self.input_table[id(x)] = split_lod_tensor(x, self.cond, level=0)
+        out_true, out_false = self.input_table[id(x)]
+        return (out_true if self.status == IfElse.IN_IF_ELSE_TRUE_BLOCKS
+                else out_false)
 
     def output(self, *outs):
-        # write through a shared out var so whichever branch runs fills it
-        for i, o in enumerate(outs):
-            name = "%s.out.%d" % (self.helper.name, i)
-            parent = self.helper.main_program.global_block()
-            if not parent.has_var(name):
-                parent.create_var(name=name, dtype=o.dtype)
-            self.helper.main_program.current_block().append_op(
-                type="assign", inputs={"X": [o]},
-                outputs={"Out": [parent.var(name)]})
+        if self.status == IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("output can only be invoked inside a block")
+        table = self.output_table[
+            1 if self.status == IfElse.IN_IF_ELSE_TRUE_BLOCKS else 0]
+        table.extend(outs)
 
     def __call__(self):
-        parent = self.helper.main_program.global_block()
-        outs = []
-        i = 0
-        while parent.has_var("%s.out.%d" % (self.helper.name, i)):
-            outs.append(parent.var("%s.out.%d" % (self.helper.name, i)))
-            i += 1
-        return outs
+        if self.status != IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("IfElse::__call__ must be out of sub-block")
+        false_len, true_len = map(len, self.output_table)
+        if false_len == 0 and true_len == 0:
+            raise ValueError(
+                "Must invoke true_block/false_block before __call__")
+        if false_len != true_len and false_len != 0 and true_len != 0:
+            raise ValueError("The output side must be same")
+        if false_len == 0 or true_len == 0:
+            return list(self.output_table[0 if false_len != 0 else 1])
+        return [
+            merge_lod_tensor(in_true=true_var, in_false=false_var,
+                             mask=self.cond, x=self.cond, level=0)
+            for false_var, true_var in zip(*self.output_table)]
 
 
 class Switch(object):

@@ -854,3 +854,270 @@ def beam_search_decode(ctx):
         jnp.asarray(flat.reshape(-1, 1)), lod))
     ctx.set_output("SentenceScores", TracedLoD(
         jnp.asarray(flat_sc.reshape(-1, 1)), lod))
+
+
+# ---------------------------------------------------------------------------
+# split_lod_tensor / merge_lod_tensor — the row-masked IfElse substrate
+# reference: operators/split_lod_tensor_op.cc, operators/merge_lod_tensor_op.cc,
+# python layers/control_flow.py:55,101 and IfElse (:1247).
+#
+# Fixed-capacity padding contract (TPU-first): the reference's outputs have
+# data-dependent heights (count of true/false rows), which XLA cannot
+# static-shape. Here OutTrue/OutFalse keep X's FULL row capacity N; the
+# selected rows are stably compacted to the front (original order preserved,
+# exactly the reference's copy order) and the tail is zeros.
+# merge_lod_tensor inverts by mask-position arithmetic, so
+# split -> rowwise branch -> merge reproduces the reference's semantics
+# bit-for-bit on the real rows as long as the branch computes row-wise (the
+# IfElse contract). Padded tail rows cost compute but never leak values —
+# the same fixed-capacity trade every masked lowering in this repo makes
+# (see ops/sequence_ops.py, ops/detection_ops.py).
+#
+# LoD inputs (level > 0 sequences) split whole sequences; the offsets are
+# data-dependent, so that path needs concrete offsets (eager/hybrid
+# executor) — same rule as the runtime-shape sequence ops.
+
+def _mask_bool(v):
+    m = raw_data(v)
+    return (m.reshape(-1) != 0)
+
+
+def _compact_rows(x, keep):
+    """Rows of ``x`` where ``keep`` stably compacted to the front; zero tail."""
+    n = x.shape[0]
+    keep_i = keep.astype(jnp.int32)
+    order = jnp.argsort(1 - keep_i, stable=True)
+    cnt = jnp.sum(keep_i)
+    alive = (jnp.arange(n) < cnt).reshape((n,) + (1,) * (x.ndim - 1))
+    return jnp.where(alive, x[order], jnp.zeros((), x.dtype))
+
+
+def _check_lod_level(op_name, x, level):
+    """Only level=0 on single-level LoD is implemented; a silently wrong
+    split at another level would corrupt sequence routing, so refuse."""
+    if int(level or 0) != 0 or len(x.lod) > 1:
+        raise NotImplementedError(
+            "%s: only level=0 on single-level LoD is implemented "
+            "(got level=%r, lod depth %d). reference: "
+            "operators/split_lod_tensor_op.cc GetSubLoDAndAbsoluteOffset "
+            "handles nested levels." % (op_name, level, len(x.lod)))
+
+
+def _split_lod_host(x, mask):
+    """Concrete-offset sequence split at the outermost lod level."""
+    offs = np.asarray(x.lod[0])
+    data = np.asarray(raw_data(x))
+    mask = np.asarray(mask)
+    parts = {True: ([], [0]), False: ([], [0])}
+    for i in range(len(offs) - 1):
+        rows, lod = parts[bool(mask[i])]
+        rows.append(data[offs[i]:offs[i + 1]])
+        lod.append(lod[-1] + int(offs[i + 1] - offs[i]))
+    outs = []
+    for flag in (True, False):
+        rows, lod = parts[flag]
+        dat = (np.concatenate(rows, axis=0) if rows
+               else np.zeros((0,) + data.shape[1:], data.dtype))
+        outs.append(TracedLoD(jnp.asarray(dat),
+                              (jnp.asarray(np.asarray(lod, np.int32)),)))
+    return outs
+
+
+def _infer_split_lod(op, block):
+    xv = block._find_var_recursive(op.input("X")[0])
+    for slot in ("OutTrue", "OutFalse"):
+        ov = block._find_var_recursive(op.output(slot)[0])
+        if None in (xv, ov) or xv.shape is None:
+            continue
+        ov.shape = tuple(xv.shape)
+        ov.dtype = xv.dtype
+        ov.lod_level = getattr(xv, "lod_level", 0)
+
+
+def _split_lod_grad_maker(op, block, grad_of, no_grad):
+    from ..core.ir import grad_var_name
+    gt = grad_of.get(op.output("OutTrue")[0])
+    gf = grad_of.get(op.output("OutFalse")[0])
+    x_name = op.input("X")[0]
+    if (gt is None and gf is None) or x_name in no_grad:
+        return None
+    inputs = {"Mask": list(op.input("Mask")), "X": [x_name]}
+    if gt is not None:
+        inputs["OutTrue@GRAD"] = [gt]
+    if gf is not None:
+        inputs["OutFalse@GRAD"] = [gf]
+    return [("split_lod_tensor_grad", inputs,
+             {"X@GRAD": [grad_var_name(x_name)]}, dict(op.attrs))]
+
+
+@register_op("split_lod_tensor", infer_shape=_infer_split_lod,
+             grad_maker=_split_lod_grad_maker)
+def split_lod_tensor(ctx):
+    x = ctx.input("X")
+    mask = _mask_bool(ctx.input("Mask"))
+    if isinstance(x, TracedLoD) and x.lod:
+        _check_lod_level("split_lod_tensor", x, ctx.attr("level", 0))
+        out_t, out_f = _split_lod_host(x, mask)
+        ctx.set_output("OutTrue", out_t)
+        ctx.set_output("OutFalse", out_f)
+        return
+    data = raw_data(x)
+    if mask.shape[0] == 1 and data.shape[0] != 1:
+        # scalar condition over a multi-row tensor (classic if/else):
+        # both branches see the whole input; merge_lod_tensor selects
+        # one side wholesale with the same broadcast rule
+        ctx.set_output("OutTrue", data)
+        ctx.set_output("OutFalse", data)
+        return
+    if mask.shape[0] != data.shape[0]:
+        raise ValueError(
+            "split_lod_tensor: mask has %d rows but X has %d — the mask "
+            "must be a per-row boolean column (or a single scalar)"
+            % (mask.shape[0], data.shape[0]))
+    ctx.set_output("OutTrue", _compact_rows(data, mask))
+    ctx.set_output("OutFalse", _compact_rows(data, jnp.logical_not(mask)))
+
+
+@register_op("split_lod_tensor_grad", no_gradient=True)
+def split_lod_tensor_grad(ctx):
+    mask = _mask_bool(ctx.input("Mask"))
+    x = ctx.input("X")
+    gt = ctx.input("OutTrue@GRAD") if ctx.has_input("OutTrue@GRAD") else None
+    gf = ctx.input("OutFalse@GRAD") if ctx.has_input("OutFalse@GRAD") else None
+    if isinstance(x, TracedLoD) and x.lod:
+        # sequence split: grads are the two compacted ragged branches;
+        # merging them back by the mask is exactly the forward merge path
+        dat = raw_data(x)
+        zeros = TracedLoD(jnp.zeros_like(dat), x.lod, max_lens=x.max_lens)
+        ctx.set_output("X@GRAD", _merge_lod_host(
+            x, mask,
+            gt if gt is not None else _split_lod_host(zeros, mask)[0],
+            gf if gf is not None else _split_lod_host(zeros, mask)[1]))
+        return
+    ref = raw_data(gt if gt is not None else gf)
+    zt = raw_data(gt) if gt is not None else jnp.zeros_like(ref)
+    zf = raw_data(gf) if gf is not None else jnp.zeros_like(ref)
+    if mask.shape[0] == 1 and ref.shape[0] != 1:
+        # scalar pass-through forward (OutTrue = OutFalse = X): the vjp of
+        # a fan-out is the sum of the branch cotangents
+        ctx.set_output("X@GRAD", zt + zf)
+        return
+    ctx.set_output("X@GRAD", _merge_rows(zt, zf, mask))
+
+
+def _merge_rows(t, f, mask):
+    if mask.shape[0] == 1 and t.shape[0] != 1:
+        # scalar condition: select one branch wholesale (the inverse of
+        # split_lod_tensor's scalar pass-through)
+        sel = mask.reshape((1,) + (1,) * (t.ndim - 1))
+        return jnp.where(sel, t, f)
+    n = mask.shape[0]
+    mask_i = mask.astype(jnp.int32)
+    pos_t = jnp.clip(jnp.cumsum(mask_i) - 1, 0, max(t.shape[0] - 1, 0))
+    pos_f = jnp.clip(jnp.cumsum(1 - mask_i) - 1, 0, max(f.shape[0] - 1, 0))
+    sel = mask.reshape((n,) + (1,) * (t.ndim - 1))
+    return jnp.where(sel, t[pos_t], f[pos_f])
+
+
+def _infer_merge_lod(op, block):
+    xv = block._find_var_recursive(op.input("X")[0])
+    tv = block._find_var_recursive(op.input("InTrue")[0])
+    ov = block._find_var_recursive(op.output("Out")[0])
+    if ov is None:
+        return
+    mv = block._find_var_recursive(op.input("Mask")[0])
+    rows = None
+    if mv is not None and mv.shape:
+        rows = mv.shape[0]
+    if tv is not None and tv.shape is not None:
+        if rows == 1 and tv.shape[0] not in (None, 1):
+            # scalar mask broadcast: runtime selects a whole branch, so the
+            # output keeps the branches' row count
+            rows = tv.shape[0]
+        ov.shape = ((rows,) + tuple(tv.shape[1:])
+                    if rows is not None else tuple(tv.shape))
+        ov.dtype = tv.dtype
+    if xv is not None:
+        ov.lod_level = getattr(xv, "lod_level", 0)
+
+
+def _merge_lod_grad_maker(op, block, grad_of, no_grad):
+    from ..core.ir import grad_var_name
+    g = grad_of.get(op.output("Out")[0])
+    if g is None:
+        return None
+    outputs = {}
+    for slot in ("InTrue", "InFalse"):
+        names = op.input(slot)
+        if names and names[0] not in no_grad:
+            v = block._find_var_recursive(names[0])
+            if v is not None and not v.stop_gradient:
+                outputs[slot + "@GRAD"] = [grad_var_name(names[0])]
+    if not outputs:
+        return None
+    return [("merge_lod_tensor_grad",
+             {"Mask": list(op.input("Mask")), "X": list(op.input("X")),
+              "Out@GRAD": [g]},
+             outputs, dict(op.attrs))]
+
+
+def _merge_lod_host(x, mask, t, f):
+    """Concrete-offset sequence merge: reassemble whole sequences by the
+    mask (inverse of _split_lod_host)."""
+    offs = np.asarray(x.lod[0])
+    td, fd = np.asarray(raw_data(t)), np.asarray(raw_data(f))
+    m = np.asarray(mask)
+    rows, ti, fi = [], 0, 0
+    for i in range(len(offs) - 1):
+        ln = int(offs[i + 1] - offs[i])
+        if m[i]:
+            rows.append(td[ti:ti + ln])
+            ti += ln
+        else:
+            rows.append(fd[fi:fi + ln])
+            fi += ln
+    dat = (np.concatenate(rows, axis=0) if rows
+           else np.zeros((0,) + td.shape[1:], td.dtype))
+    return TracedLoD(jnp.asarray(dat), x.lod, max_lens=x.max_lens)
+
+
+@register_op("merge_lod_tensor", infer_shape=_infer_merge_lod,
+             grad_maker=_merge_lod_grad_maker)
+def merge_lod_tensor(ctx):
+    """Out[i] = InTrue[rank of i among true rows] if Mask[i] else
+    InFalse[rank among false rows] — the exact inverse of split_lod_tensor
+    under the fixed-capacity contract."""
+    mask = _mask_bool(ctx.input("Mask"))
+    t = ctx.input("InTrue")
+    f = ctx.input("InFalse")
+    x = ctx.input("X")
+    if isinstance(x, TracedLoD) and x.lod:
+        _check_lod_level("merge_lod_tensor", x, ctx.attr("level", 0))
+        ctx.set_output("Out", _merge_lod_host(x, mask, t, f))
+        return
+    ctx.set_output("Out", _merge_rows(raw_data(t), raw_data(f), mask))
+
+
+@register_op("merge_lod_tensor_grad", no_gradient=True)
+def merge_lod_tensor_grad(ctx):
+    mask = _mask_bool(ctx.input("Mask"))
+    gv = ctx.input("Out@GRAD")
+    x = ctx.input("X")
+    if isinstance(x, TracedLoD) and x.lod:
+        # sequence merge: the grad splits back into the two ragged branches
+        g_lod = TracedLoD(raw_data(gv), x.lod, max_lens=x.max_lens)
+        gt, gf = _split_lod_host(g_lod, mask)
+        ctx.set_output("InTrue@GRAD", gt)
+        ctx.set_output("InFalse@GRAD", gf)
+        return
+    g = raw_data(gv)
+    if mask.shape[0] == 1 and g.shape[0] != 1:
+        # scalar select: cotangent flows only to the chosen branch
+        sel = mask.reshape((1,) + (1,) * (g.ndim - 1))
+        zero = jnp.zeros((), g.dtype)
+        ctx.set_output("InTrue@GRAD", jnp.where(sel, g, zero))
+        ctx.set_output("InFalse@GRAD", jnp.where(sel, zero, g))
+        return
+    # set_output is a no-op for unwired optional slots
+    ctx.set_output("InTrue@GRAD", _compact_rows(g, mask))
+    ctx.set_output("InFalse@GRAD", _compact_rows(g, jnp.logical_not(mask)))

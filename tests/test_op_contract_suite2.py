@@ -163,6 +163,43 @@ def test_lstm_op_contract():
                                rtol=1e-4, atol=1e-5)
 
 
+@covers("lstmp")
+def test_lstmp_op_contract():
+    """dynamic_lstmp vs numpy: standard cell + tanh projection feeding back
+    as the recurrent input (reference: operators/lstmp_op.h)."""
+    rng = np.random.RandomState(11)
+    D, P = 3, 2
+    seq = rng.randn(4, 4 * D).astype(np.float32) * 0.5
+    x = F.data("x", shape=[4 * D], dtype="float32", lod_level=1)
+    proj, cell = F.dynamic_lstmp(
+        x, size=4 * D, proj_size=P, use_peepholes=False,
+        param_attr=pt.ParamAttr(name="lstmp.w"),
+        bias_attr=False, name="lstmp")
+    exe = _exe()
+    feed = exe.prepare_feed({"x": build_lod_tensor([seq])})
+    got_p, got_c = exe.run(feed=feed, fetch_list=[proj, cell],
+                           return_numpy=False)
+    w = np.asarray(pt.global_scope().find_var("lstmp.w"))        # [P, 4D]
+    wp = np.asarray(pt.global_scope().find_var("lstmp.w_proj"))  # [D, P]
+    rv = np.zeros(P, np.float32)
+    cv = np.zeros(D, np.float32)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    want_p, want_c = [], []
+    for t in range(4):
+        g = seq[t] + rv @ w
+        cand, i, f, o = (np.tanh(g[:D]), sig(g[D:2 * D]),
+                         sig(g[2 * D:3 * D]), sig(g[3 * D:]))
+        cv = f * cv + i * cand
+        hv = o * np.tanh(cv)
+        rv = np.tanh(hv @ wp)
+        want_p.append(rv.copy())
+        want_c.append(cv.copy())
+    np.testing.assert_allclose(_np(got_p), np.asarray(want_p),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(_np(got_c), np.asarray(want_c),
+                               rtol=1e-4, atol=1e-5)
+
+
 @covers("simple_rnn")
 def test_simple_rnn_op_contract():
     rng = np.random.RandomState(5)
@@ -390,15 +427,17 @@ def test_dynamic_rnn_substrate_and_static_rnn():
 
 @covers("conditional_block")
 def test_conditional_block_contract():
+    # IfElse is now conditional-block-free (masked split/merge lowering);
+    # Switch still drives conditional_block, so it carries this contract
     a = F.data("a", shape=[1], append_batch_size=False)
     zero = F.fill_constant(shape=[1], dtype="float32", value=0.0)
-    cond = F.less_than(a, zero)
-    ie = F.IfElse(cond)
-    with ie.true_block():
-        ie.output(F.scale(a, scale=-1.0))
-    with ie.false_block():
-        ie.output(F.scale(a, scale=1.0))
-    out = ie()[0]
+    out = F.create_global_var(shape=[1], value=0.0, dtype="float32",
+                              persistable=True, name="cb_contract_out")
+    sw = F.Switch()
+    with sw.case(F.less_than(a, zero)):
+        F.assign(F.scale(a, scale=-1.0), out)
+    with sw.default():
+        F.assign(F.scale(a, scale=1.0), out)
     exe = _exe()
     got, = exe.run(feed={"a": np.array([-3.0], np.float32)},
                    fetch_list=[out], use_jit=False)

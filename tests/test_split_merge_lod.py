@@ -1,0 +1,278 @@
+"""split_lod_tensor / merge_lod_tensor + row-masked IfElse.
+
+reference: operators/split_lod_tensor_op.cc, merge_lod_tensor_op.cc,
+python layers/control_flow.py:55,101, IfElse (:1247), and the e2e usage in
+python/paddle/fluid/tests/test_mnist_if_else_op.py.
+
+Fixed-capacity padding contract under test: split outputs keep the input's
+full row capacity with selected rows stably compacted to the front and a
+zero tail; merge is the exact inverse on the real rows.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.lod import LoDTensor, build_lod_tensor
+
+
+def _fresh():
+    main, startup = fluid.Program(), fluid.Program()
+    return main, startup
+
+
+def _np_split(x, mask):
+    t = x[mask]
+    f = x[~mask]
+    out_t = np.zeros_like(x)
+    out_f = np.zeros_like(x)
+    out_t[:len(t)] = t
+    out_f[:len(f)] = f
+    return out_t, out_f
+
+
+def test_split_dense_compacts_and_zero_pads():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3], append_batch_size=False)
+        m = fluid.layers.data("m", shape=[5], dtype="bool",
+                              append_batch_size=False)
+        t, f = fluid.layers.split_lod_tensor(x, m)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.arange(15, dtype=np.float32).reshape(5, 3)
+    mv = np.array([True, False, True, False, True])
+    rt, rf = exe.run(main, feed={"x": xv, "m": mv}, fetch_list=[t, f])
+    want_t, want_f = _np_split(xv, mv)
+    np.testing.assert_allclose(np.asarray(rt), want_t)
+    np.testing.assert_allclose(np.asarray(rf), want_f)
+
+
+def test_merge_inverts_split():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2], append_batch_size=False)
+        m = fluid.layers.data("m", shape=[6], dtype="bool",
+                              append_batch_size=False)
+        t, f = fluid.layers.split_lod_tensor(x, m)
+        out = fluid.layers.merge_lod_tensor(in_true=t, in_false=f, x=x,
+                                            mask=m)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(7)
+    xv = rng.randn(6, 2).astype(np.float32)
+    for pattern in ([1, 1, 0, 0, 1, 0], [0] * 6, [1] * 6):
+        mv = np.array(pattern, dtype=bool)
+        got, = exe.run(main, feed={"x": xv, "m": mv}, fetch_list=[out])
+        np.testing.assert_allclose(np.asarray(got), xv, err_msg=str(pattern))
+
+
+def test_split_merge_gradient_routes_by_mask():
+    """d(sum(merge(2*t, -1*f)))/dx = 2 on true rows, -1 on false rows."""
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4, 3], append_batch_size=False)
+        x.stop_gradient = False
+        m = fluid.layers.data("m", shape=[4], dtype="bool",
+                              append_batch_size=False)
+        t, f = fluid.layers.split_lod_tensor(x, m)
+        out = fluid.layers.merge_lod_tensor(
+            in_true=fluid.layers.scale(t, scale=2.0),
+            in_false=fluid.layers.scale(f, scale=-1.0), x=x, mask=m)
+        loss = fluid.layers.reduce_sum(out)
+        g, = fluid.calc_gradient(loss, [x])
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.random.RandomState(3).randn(4, 3).astype(np.float32)
+    mv = np.array([True, False, False, True])
+    gv, = exe.run(main, feed={"x": xv, "m": mv}, fetch_list=[g])
+    want = np.where(mv[:, None], 2.0, -1.0).astype(np.float32)
+    want = np.broadcast_to(want, (4, 3))
+    np.testing.assert_allclose(np.asarray(gv), want)
+
+
+def test_split_lod_sequences_eager():
+    """lod_level>0: whole sequences routed by the mask (concrete offsets)."""
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[1], lod_level=1)
+        m = fluid.layers.data("m", shape=[3], dtype="bool",
+                              append_batch_size=False)
+        t, f = fluid.layers.split_lod_tensor(x, m)
+    exe = fluid.Executor(fluid.CPUPlace())
+    seqs = [np.array([[1.], [2.]], np.float32),
+            np.array([[3.]], np.float32),
+            np.array([[4.], [5.], [6.]], np.float32)]
+    mv = np.array([True, False, True])
+    rt, rf = exe.run(main, feed={"x": build_lod_tensor(seqs), "m": mv},
+                     fetch_list=[t, f], use_jit=False)
+    rt = rt.numpy() if isinstance(rt, LoDTensor) else np.asarray(rt)
+    rf = rf.numpy() if isinstance(rf, LoDTensor) else np.asarray(rf)
+    np.testing.assert_allclose(rt.reshape(-1), [1, 2, 4, 5, 6])
+    np.testing.assert_allclose(rf.reshape(-1), [3])
+
+
+def test_ifelse_rowmask_trains_mnist_style():
+    """The reference's IfElse e2e shape (test_mnist_if_else_op.py): rows with
+    label<5 go through one fc stack, the rest through another; merged
+    predictions train under momentum. Loss must decrease; the whole program
+    (both branches) runs jitted."""
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("x", shape=[8, 16], append_batch_size=False)
+        img.stop_gradient = False
+        label = fluid.layers.data("y", shape=[8, 1], dtype="int64",
+                                  append_batch_size=False)
+        limit = fluid.layers.fill_constant(shape=[8, 1], dtype="int64",
+                                           value=5)
+        cond = fluid.layers.less_than(label, limit)
+        ie = fluid.layers.IfElse(cond)
+        with ie.true_block():
+            true_image = ie.input(img)
+            hidden = fluid.layers.fc(true_image, size=24, act="tanh")
+            prob = fluid.layers.fc(hidden, size=10, act="softmax")
+            ie.output(prob)
+        with ie.false_block():
+            false_image = ie.input(img)
+            hidden = fluid.layers.fc(false_image, size=32, act="tanh")
+            prob = fluid.layers.fc(hidden, size=10, act="softmax")
+            ie.output(prob)
+        prob = ie()[0]
+        loss = fluid.layers.cross_entropy(prob, label)
+        avg = fluid.layers.mean(loss)
+        fluid.Momentum(learning_rate=0.1, momentum=0.9).minimize(avg)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        xv = rng.randn(8, 16).astype(np.float32)
+        yv = rng.randint(0, 10, (8, 1)).astype(np.int64)
+        losses = []
+        for _ in range(12):
+            lv, = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[avg])
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_ifelse_single_branch_output():
+    """Reference allows a one-sided IfElse: outputs come from that table."""
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data("a", shape=[3, 1], append_batch_size=False)
+        zero = fluid.layers.fill_constant(shape=[3, 1], dtype="float32",
+                                          value=0.0)
+        cond = fluid.layers.less_than(a, zero)
+        ie = fluid.layers.IfElse(cond)
+        with ie.true_block():
+            neg = ie.input(a)
+            ie.output(fluid.layers.scale(neg, scale=-1.0))
+        out = ie()[0]
+    exe = fluid.Executor(fluid.CPUPlace())
+    av = np.array([[-2.0], [3.0], [-4.0]], np.float32)
+    got, = exe.run(main, feed={"a": av}, fetch_list=[out])
+    # single-sided: the true table is returned as-is (compacted + padded)
+    np.testing.assert_allclose(np.asarray(got).reshape(-1), [2.0, 4.0, 0.0])
+
+
+def test_split_selected_rows_op():
+    """Shard rows by height_sections with rebased indices.
+    reference: operators/split_selected_rows_op.cc (height_sections doc
+    example: rows [7,5,11,12] over sections [4,8] -> [] and [1,3,7,8])."""
+    from paddle_tpu.core.registry import lookup
+    from paddle_tpu.ops.selected_rows import SelectedRowsVal
+    import jax.numpy as jnp
+
+    class _Ctx(object):
+        def __init__(self, x, sections, n_out):
+            self._x = x
+            self._sections = sections
+            self.outs = [None] * n_out
+
+        def input(self, slot, idx=0):
+            assert slot == "X"
+            return self._x
+
+        def attr(self, name, default=None):
+            return self._sections if name == "height_sections" else default
+
+        def set_output(self, slot, value, idx=0):
+            self.outs[idx] = value
+
+    x = SelectedRowsVal(jnp.asarray([7, 5, 11, 2], jnp.int32),
+                        jnp.asarray(np.arange(8, dtype=np.float32)
+                                    .reshape(4, 2)), height=12)
+    ctx = _Ctx(x, [4, 8], 2)
+    lookup("split_selected_rows").lower(ctx)
+    s0, s1 = ctx.outs
+    assert s0.height == 4 and s1.height == 8
+    np.testing.assert_array_equal(np.asarray(s0.rows), [2])
+    np.testing.assert_allclose(np.asarray(s0.values), [[6.0, 7.0]])
+    # order preserved, indices rebased to the section start (ref doc:
+    # rows {7,5} sections {4,8} -> out1.rows {3,1})
+    np.testing.assert_array_equal(np.asarray(s1.rows), [3, 1, 7])
+    np.testing.assert_allclose(np.asarray(s1.values),
+                               np.arange(6, dtype=np.float32).reshape(3, 2))
+
+
+def test_ifelse_scalar_cond_multirow_passthrough():
+    """Code-review regression: a 1-row (scalar) condition over multi-row
+    inputs must select a whole branch, not truncate to row 0."""
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data("a", shape=[1], append_batch_size=False)
+        x = fluid.layers.data("x", shape=[4, 2], append_batch_size=False)
+        five = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                          value=5.0)
+        cond = fluid.layers.less_than(a, five)
+        ie = fluid.layers.IfElse(cond)
+        with ie.true_block():
+            ie.output(fluid.layers.scale(ie.input(x), scale=2.0))
+        with ie.false_block():
+            ie.output(fluid.layers.scale(ie.input(x), scale=-1.0))
+        out = ie()[0]
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.arange(8, dtype=np.float32).reshape(4, 2)
+    got, = exe.run(main, feed={"a": np.array([3.0], np.float32), "x": xv},
+                   fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(got), 2.0 * xv)
+    got, = exe.run(main, feed={"a": np.array([7.0], np.float32), "x": xv},
+                   fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(got), -xv)
+
+
+def test_split_mask_length_mismatch_raises():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4, 2], append_batch_size=False)
+        m = fluid.layers.data("m", shape=[3], dtype="bool",
+                              append_batch_size=False)
+        t, f = fluid.layers.split_lod_tensor(x, m)
+    exe = fluid.Executor(fluid.CPUPlace())
+    import pytest
+    with pytest.raises(Exception, match="mask has 3 rows but X has 4"):
+        exe.run(main, feed={"x": np.zeros((4, 2), np.float32),
+                            "m": np.array([True, False, True])},
+                fetch_list=[t])
+
+
+def test_split_merge_sequence_gradient():
+    """Code-review regression: lod_level>0 split/merge gradients reassemble
+    per-sequence (not per-mask-row) cotangents."""
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[1], lod_level=1)
+        x.stop_gradient = False
+        m = fluid.layers.data("m", shape=[3], dtype="bool",
+                              append_batch_size=False)
+        t, f = fluid.layers.split_lod_tensor(x, m)
+        out = fluid.layers.merge_lod_tensor(
+            in_true=fluid.layers.scale(t, scale=2.0),
+            in_false=fluid.layers.scale(f, scale=-1.0), x=x, mask=m)
+        loss = fluid.layers.reduce_sum(out)
+        g, = fluid.calc_gradient(loss, [x])
+    exe = fluid.Executor(fluid.CPUPlace())
+    seqs = [np.array([[1.], [2.]], np.float32),
+            np.array([[3.]], np.float32),
+            np.array([[4.], [5.], [6.]], np.float32)]
+    mv = np.array([True, False, True])
+    gv, = exe.run(main, feed={"x": build_lod_tensor(seqs), "m": mv},
+                  fetch_list=[g], use_jit=False)
+    gv = gv.numpy() if isinstance(gv, LoDTensor) else np.asarray(gv)
+    # seq0 (2 rows) and seq2 (3 rows) went true (x2), seq1 went false (x-1)
+    np.testing.assert_allclose(gv.reshape(-1), [2, 2, -1, 2, 2, 2])
