@@ -13,8 +13,8 @@ from .layer_helper import LayerHelper
 _UNARY = [
     "sigmoid", "logsigmoid", "tanh", "relu", "relu6", "exp", "abs", "ceil",
     "floor", "round", "log", "square", "sqrt", "reciprocal", "softplus",
-    "softsign", "sin", "cos", "tanh_shrink", "softshrink", "sign",
-    "brelu", "leaky_relu", "soft_relu", "elu", "swish", "stanh",
+    "softsign", "sin", "cos", "tanh_shrink", "softshrink", "hard_shrink",
+    "sign", "brelu", "leaky_relu", "soft_relu", "elu", "swish", "stanh",
     "hard_sigmoid", "thresholded_relu", "pow", "logical_not", "isfinite",
     "cumsum",
 ]

@@ -14,7 +14,8 @@ from ..param_attr import ParamAttr
 from .layer_helper import LayerHelper
 
 __all__ = [
-    "dynamic_lstm", "dynamic_gru", "lstm_unit", "gru_unit", "sequence_conv",
+    "dynamic_lstm", "dynamic_lstmp", "dynamic_gru", "lstm_unit", "gru_unit",
+    "sequence_conv",
     "sequence_pool", "sequence_softmax", "sequence_expand", "sequence_concat",
     "sequence_reshape", "sequence_slice", "sequence_erase",
     "sequence_first_step", "sequence_last_step", "lod_reset", "row_conv",
@@ -36,14 +37,16 @@ def dynamic_lstm(input, size, param_attr=None, bias_attr=None,
     hidden = size // 4
     weight = helper.create_parameter(helper.param_attr,
                                      shape=[hidden, 4 * hidden], dtype=dtype)
-    bias_size = [1, 7 * hidden if use_peepholes else 4 * hidden]
-    bias = helper.create_parameter(helper.bias_attr or ParamAttr(),
-                                   shape=bias_size, dtype=dtype, is_bias=True)
     h = helper.create_variable_for_type_inference(dtype)
     c = helper.create_variable_for_type_inference(dtype)
     h.lod_level = c.lod_level = input.lod_level
     h.shape = c.shape = tuple(input.shape[:-1]) + (hidden,)
-    inputs = {"Input": [input], "Weight": [weight], "Bias": [bias]}
+    inputs = {"Input": [input], "Weight": [weight]}
+    if bias_attr is not False:
+        bias_size = [1, 7 * hidden if use_peepholes else 4 * hidden]
+        inputs["Bias"] = [helper.create_parameter(
+            helper.bias_attr or ParamAttr(), shape=bias_size, dtype=dtype,
+            is_bias=True)]
     if h_0 is not None:
         inputs["H0"] = [h_0]
     if c_0 is not None:
@@ -56,6 +59,47 @@ def dynamic_lstm(input, size, param_attr=None, bias_attr=None,
                             "cell_activation": cell_activation,
                             "candidate_activation": candidate_activation})
     return h, c
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None):
+    """LSTM with a recurrent projection layer.
+    reference: layers/nn.py:403 dynamic_lstmp -> operators/lstmp_op.cc.
+    ``size`` is 4*hidden; ``proj_size`` the projection width P. Returns
+    (projection [T, P], cell [T, hidden])."""
+    helper = LayerHelper("lstmp", **locals())
+    hidden = size // 4
+    weight = helper.create_parameter(helper.param_attr,
+                                     shape=[proj_size, 4 * hidden],
+                                     dtype=dtype)
+    proj_weight = helper.create_parameter(
+        ParamAttr(name=(name + ".w_proj") if name else None),
+        shape=[hidden, proj_size], dtype=dtype)
+    proj = helper.create_variable_for_type_inference(dtype)
+    c = helper.create_variable_for_type_inference(dtype)
+    proj.lod_level = c.lod_level = input.lod_level
+    proj.shape = tuple(input.shape[:-1]) + (proj_size,)
+    c.shape = tuple(input.shape[:-1]) + (hidden,)
+    inputs = {"Input": [input], "Weight": [weight],
+              "ProjWeight": [proj_weight]}
+    if bias_attr is not False:
+        bias_size = [1, 7 * hidden if use_peepholes else 4 * hidden]
+        inputs["Bias"] = [helper.create_parameter(
+            helper.bias_attr or ParamAttr(), shape=bias_size, dtype=dtype,
+            is_bias=True)]
+    helper.append_op(type="lstmp",
+                     inputs=inputs,
+                     outputs={"Projection": [proj], "Cell": [c]},
+                     attrs={"use_peepholes": use_peepholes,
+                            "is_reverse": is_reverse,
+                            "gate_activation": gate_activation,
+                            "cell_activation": cell_activation,
+                            "candidate_activation": candidate_activation,
+                            "proj_activation": proj_activation})
+    return proj, c
 
 
 def dynamic_gru(input, size, param_attr=None, bias_attr=None,

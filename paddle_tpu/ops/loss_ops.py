@@ -84,6 +84,42 @@ def squared_l2_norm(ctx):
     ctx.set_output("Out", jnp.sum(x * x).reshape((1,)))
 
 
+@register_op("label_smooth")
+def label_smooth(ctx):
+    """reference: operators/label_smooth_op.cc — out = (1-eps)*X + eps*mu,
+    mu = PriorDist when given else uniform 1/num_classes."""
+    x = raw_data(ctx.input("X"))
+    eps = ctx.attr("epsilon", 0.0)
+    if ctx.has_input("PriorDist"):
+        mu = raw_data(ctx.input("PriorDist")).reshape(1, -1)
+    else:
+        mu = 1.0 / x.shape[-1]
+    ctx.set_output("Out", (1.0 - eps) * x + eps * mu)
+
+
+@register_op("l1_norm")
+def l1_norm(ctx):
+    """reference: operators/l1_norm_op.cc — Out = sum(|X|) (scalar)."""
+    x = raw_data(ctx.input("X"))
+    ctx.set_output("Out", jnp.sum(jnp.abs(x)).reshape((1,)))
+
+
+@register_op("modified_huber_loss")
+def modified_huber_loss(ctx):
+    """reference: operators/modified_huber_loss_op.{cc,h} — binary labels
+    y in {0,1}; v = x*(2y-1); loss = -4v for v<-1, (1-v)^2 for -1<=v<1,
+    else 0. IntermediateVal carries v (the reference grad kernel reads
+    it; here the piecewise vjp reproduces its -4 / -2(1-v) branches)."""
+    x = raw_data(ctx.input("X"))
+    y = raw_data(ctx.input("Y")).astype(x.dtype)
+    v = x * (2.0 * y - 1.0)
+    loss = jnp.where(v < -1.0, -4.0 * v,
+                     jnp.where(v < 1.0, (1.0 - v) ** 2,
+                               jnp.zeros((), x.dtype)))
+    ctx.set_output("IntermediateVal", v)
+    ctx.set_output("Out", loss)
+
+
 @register_op("hinge_loss")
 def hinge_loss(ctx):
     logits = raw_data(ctx.input("Logits"))

@@ -118,6 +118,15 @@ for _name, _fn in [
         functools.partial(lambda ctx, f: elementwise(ctx, f), f=_fn))
 
 
+@register_op("minus", infer_shape=_infer_ew)
+def minus(ctx):
+    """reference: operators/minus_op.cc — Out = X - Y (no axis broadcast;
+    the v1-era subtraction op)."""
+    x = ctx.input("X")
+    ctx.set_output("Out", with_lod_of(
+        x, raw_data(x) - raw_data(ctx.input("Y"))))
+
+
 @register_op("sum", infer_shape=_infer_ew)
 def sum_op(ctx):
     """Multi-input add; grad-accumulation workhorse

@@ -175,6 +175,65 @@ def max_pool2d_with_index(ctx):
     ctx.set_output("Mask", idx.astype(jnp.int32))
 
 
+@register_op("max_pool3d_with_index")
+def max_pool3d_with_index(ctx):
+    """3d analog of max_pool2d_with_index above — indices are flat
+    positions within each [D, H, W] volume. reference:
+    operators/pool_with_index_op.cc (max_pool3d_with_index registration)
+    + math/pooling.cc MaxPool3dWithIndexFunctor."""
+    x = raw_data(ctx.input("X"))
+    N, C, D, H, W = x.shape
+    ks = [int(k) for k in ctx.attr("ksize", [2, 2, 2])]
+    st = [int(s) for s in ctx.attr("strides", ks)]
+    pd = [int(p) for p in ctx.attr("paddings", [0, 0, 0])]
+    neg = jnp.finfo(x.dtype).min
+    xp = jnp.pad(x, ((0, 0), (0, 0)) + tuple((p, p) for p in pd),
+                 constant_values=neg)
+    od = [(dim + 2 * pd[i] - ks[i]) // st[i] + 1
+          for i, dim in enumerate((D, H, W))]
+    pD, pH, pW = (D + 2 * pd[0], H + 2 * pd[1], W + 2 * pd[2])
+    # window origin grids and intra-window offsets -> flat padded indices
+    oz = (jnp.arange(od[0]) * st[0])[:, None, None, None, None, None]
+    oy = (jnp.arange(od[1]) * st[1])[None, :, None, None, None, None]
+    ox = (jnp.arange(od[2]) * st[2])[None, None, :, None, None, None]
+    wz = jnp.arange(ks[0])[None, None, None, :, None, None]
+    wy = jnp.arange(ks[1])[None, None, None, None, :, None]
+    wx = jnp.arange(ks[2])[None, None, None, None, None, :]
+    zs = jnp.broadcast_to(oz + wz, tuple(od) + tuple(ks))
+    ys = jnp.broadcast_to(oy + wy, tuple(od) + tuple(ks))
+    xs = jnp.broadcast_to(ox + wx, tuple(od) + tuple(ks))
+    flat = ((zs * pH + ys) * pW + xs).reshape(
+        od[0] * od[1] * od[2], ks[0] * ks[1] * ks[2])
+    xp_flat = xp.reshape(N, C, -1)
+    wins = jnp.take(xp_flat, flat, axis=2)
+    arg = jnp.argmax(wins, axis=3)
+    out = jnp.max(wins, axis=3).reshape(N, C, *od)
+    win_flat = jnp.take_along_axis(
+        jnp.broadcast_to(flat[None, None], wins.shape).astype(jnp.int32),
+        arg[..., None].astype(jnp.int32), axis=3)[..., 0]
+    pz = win_flat // (pH * pW) - pd[0]
+    py = (win_flat // pW) % pH - pd[1]
+    px = win_flat % pW - pd[2]
+    idx = ((pz * H + py) * W + px).reshape(N, C, *od)
+    ctx.set_output("Out", out)
+    ctx.set_output("Mask", idx.astype(jnp.int32))
+
+
+@register_op("bilinear_tensor_product")
+def bilinear_tensor_product(ctx):
+    """reference: operators/bilinear_tensor_product_op.cc —
+    out[b, k] = x[b] @ W[k] @ y[b] + bias[k]; X [B, M], Y [B, N],
+    Weight [K, M, N], Bias [1, K]. One einsum: the MXU sees a batched
+    matmul instead of the reference's per-output-channel GEMM loop."""
+    x = raw_data(ctx.input("X"))
+    y = raw_data(ctx.input("Y"))
+    w = raw_data(ctx.input("Weight"))
+    out = jnp.einsum("bm,kmn,bn->bk", x, w, y)
+    if ctx.has_input("Bias"):
+        out = out + raw_data(ctx.input("Bias")).reshape(1, -1)
+    ctx.set_output("Out", out)
+
+
 @register_op("unpool")
 def unpool(ctx):
     """Scatter pooled activations back to the positions recorded by

@@ -82,3 +82,25 @@ def sgd_selected_rows(param, lr, grad: SelectedRowsVal):
     """w[rows] -= lr * values (duplicates accumulate).
     reference: operators/sgd_op.h SelectedRows branch."""
     return param.at[grad.rows].add(-lr * grad.values)
+
+
+@register_op("split_selected_rows", host=True, no_gradient=True)
+def split_selected_rows(ctx):
+    """Shard a SelectedRows value by ``height_sections`` row ranges,
+    rebasing each output's row indices to its section start — the pserver
+    sharding primitive. reference: operators/split_selected_rows_op.cc.
+    Row membership is data-dependent, so this runs on the host path (same
+    rule as the runtime-shape sequence ops)."""
+    import numpy as np
+    x = ctx.input("X")
+    sections = [int(s) for s in ctx.attr("height_sections", [])]
+    if not sections:
+        sections = [x.height]
+    starts = np.cumsum([0] + sections)
+    rows = np.asarray(x.rows)
+    vals = np.asarray(x.values)
+    for i in range(len(sections)):
+        m = (rows >= starts[i]) & (rows < starts[i + 1])
+        ctx.set_output("Out", SelectedRowsVal(
+            jnp.asarray((rows[m] - starts[i]).astype(np.int32)),
+            jnp.asarray(vals[m]), sections[i]), idx=i)

@@ -548,6 +548,91 @@ def lstm(ctx):
                                                         data.shape[0])))
 
 
+@register_op("lstmp")
+def lstmp(ctx):
+    """LSTM with a recurrent projection layer (LSTMP).
+
+    reference: operators/lstmp_op.{cc,h} — after the standard cell, the
+    hidden state is projected to P dims (r = proj_act(h @ ProjWeight)) and
+    the *projection* feeds back as the recurrent input. Input [total, 4D]
+    pre-projected gate input; Weight [P, 4D] recurrent weights from the
+    projection; ProjWeight [D, P]. Outputs Projection [total, P] and
+    Cell [total, D]. Same lax.scan shape as the lstm op above."""
+    x = ctx.input("Input")
+    w = raw_data(ctx.input("Weight"))            # [P, 4D]
+    w_proj = raw_data(ctx.input("ProjWeight"))   # [D, P]
+    bias = ctx.input("Bias")
+    bias = raw_data(bias) if bias is not None else None
+    h0 = ctx.input("H0")
+    c0 = ctx.input("C0")
+    data = raw_data(x)
+    offs = seq_offsets(x)
+    ml = static_max_len(x)
+    n = offs.shape[0] - 1
+    D = w_proj.shape[0]
+    P = w_proj.shape[1]
+    use_peep = bool(ctx.attr("use_peepholes", True))
+    rev = bool(ctx.attr("is_reverse", False))
+    g_act = _ACT[ctx.attr("gate_activation", "sigmoid")]
+    c_act = _ACT[ctx.attr("cell_activation", "tanh")]
+    cand_act = _ACT[ctx.attr("candidate_activation", "tanh")]
+    proj_act = _ACT[ctx.attr("proj_activation", "tanh")]
+
+    padded, mask = lod_to_padded(data, offs, ml)  # [n, T, 4D]
+    if rev:
+        padded = reverse_padded(padded, mask, offs, ml)
+    xs = jnp.swapaxes(padded, 0, 1)
+    ms = jnp.swapaxes(mask, 0, 1)
+
+    if bias is not None:
+        b4 = bias.reshape(-1)[:4 * D]
+        xs = xs + b4[None, None, :]
+        if use_peep and bias.size >= 7 * D:
+            w_ic = bias.reshape(-1)[4 * D:5 * D]
+            w_fc = bias.reshape(-1)[5 * D:6 * D]
+            w_oc = bias.reshape(-1)[6 * D:7 * D]
+        else:
+            use_peep = False
+    else:
+        use_peep = False
+
+    r_init = raw_data(h0) if h0 is not None else jnp.zeros((n, P), data.dtype)
+    c_init = raw_data(c0) if c0 is not None else jnp.zeros((n, D), data.dtype)
+
+    def step(carry, inp):
+        r_prev, c_prev = carry
+        g_in, m = inp
+        g = g_in + jnp.dot(r_prev, w)            # [n, 4D]
+        c_t, i_t, f_t, o_t = jnp.split(g, 4, axis=-1)
+        if use_peep:
+            i_t = i_t + c_prev * w_ic[None, :]
+            f_t = f_t + c_prev * w_fc[None, :]
+        i = g_act(i_t)
+        f = g_act(f_t)
+        cand = cand_act(c_t)
+        c = f * c_prev + i * cand
+        if use_peep:
+            o_t = o_t + c * w_oc[None, :]
+        o = g_act(o_t)
+        h = o * c_act(c)
+        r = proj_act(jnp.dot(h, w_proj))         # [n, P]
+        m_ = m[:, None].astype(r.dtype)
+        r = r * m_ + r_prev * (1 - m_)
+        c = c * m_ + c_prev * (1 - m_)
+        return (r, c), (r, c)
+
+    (_, _), (rs, cs) = jax.lax.scan(step, (r_init, c_init), (xs, ms))
+    rs = jnp.swapaxes(rs, 0, 1)
+    cs = jnp.swapaxes(cs, 0, 1)
+    if rev:
+        rs = reverse_padded(rs, mask, offs, ml)
+        cs = reverse_padded(cs, mask, offs, ml)
+    ctx.set_output("Projection", with_lod_of(x, padded_to_lod(
+        rs, offs, data.shape[0])))
+    ctx.set_output("Cell", with_lod_of(x, padded_to_lod(
+        cs, offs, data.shape[0])))
+
+
 @register_op("gru")
 def gru(ctx):
     """Whole-sequence GRU via lax.scan. reference: operators/gru_op.cc +

@@ -49,6 +49,17 @@ def fill_constant(ctx):
         ctx.set_output("Out", data)
 
 
+@register_op("fill", infer_shape=_infer_from_shape_attr)
+def fill(ctx):
+    """reference: operators/fill_op.cc — materialize the float 'value' list
+    attr as a tensor of 'shape'/'dtype' (force_cpu is moot: XLA decides
+    placement)."""
+    shape = _shape_attr(ctx)
+    dt = jdt(ctx.attr("dtype"))
+    vals = jnp.asarray(ctx.attr("value", []), dtype=dt)
+    ctx.set_output("Out", vals.reshape(shape))
+
+
 @register_op("fill_constant_batch_size_like")
 def fill_constant_batch_size_like(ctx):
     ref = raw_data(ctx.input("Input"))
@@ -58,6 +69,32 @@ def fill_constant_batch_size_like(ctx):
     shape[out_idx] = ref.shape[in_idx]
     ctx.set_output("Out", jnp.full(shape, ctx.attr("value", 0.0),
                                    dtype=jdt(ctx.attr("dtype"))))
+
+
+def _rand_batch_size_like(ctx, sampler):
+    """shape with the batch dim taken from Input, filled with random draws.
+    reference: operators/{uniform,gaussian}_random_batch_size_like_op.cc."""
+    ref = raw_data(ctx.input("Input"))
+    shape = _shape_attr(ctx)
+    shape[ctx.attr("output_dim_idx", 0)] = ref.shape[
+        ctx.attr("input_dim_idx", 0)]
+    ctx.set_output("Out", sampler(tuple(shape), jdt(ctx.attr("dtype"))))
+
+
+@register_op("uniform_random_batch_size_like", no_gradient=True)
+def uniform_random_batch_size_like(ctx):
+    lo, hi = ctx.attr("min", -1.0), ctx.attr("max", 1.0)
+    _rand_batch_size_like(
+        ctx, lambda shape, dt: jax.random.uniform(
+            ctx.next_rng(), shape, dt, minval=lo, maxval=hi))
+
+
+@register_op("gaussian_random_batch_size_like", no_gradient=True)
+def gaussian_random_batch_size_like(ctx):
+    mean, std = ctx.attr("mean", 0.0), ctx.attr("std", 1.0)
+    _rand_batch_size_like(
+        ctx, lambda shape, dt: mean + std * jax.random.normal(
+            ctx.next_rng(), shape, dt))
 
 
 @register_op("fill_zeros_like")

@@ -1,0 +1,77 @@
+"""Registry-vs-reference audit: every op the reference registers is either
+registered here or on the documented by-design substitution list.
+
+reference: the REGISTER_OP / REGISTER_OPERATOR / REGISTER_OP_WITHOUT_GRADIENT
+sites under paddle/fluid/operators/ (op_registry.h:127-196 macros).
+"""
+import os
+import re
+
+import pytest
+
+from paddle_tpu.core import registry
+
+_REF_OPS_DIR = "/root/reference/paddle/fluid/operators"
+
+# By-design substitutions: reference op -> what replaces it in the TPU-first
+# architecture (SURVEY.md §2 sanctions; VERDICT r3 item 7's allowed list).
+BY_DESIGN = {
+    # communication: XLA collectives / GSPMD sharding replace explicit
+    # send/recv programs and NCCL communicator ops
+    "nccl": "XLA collectives over ICI (parallel/api.py meshes)",
+    "send": "GSPMD sharding; async path = parallel/async_sgd.py host service",
+    "recv": "GSPMD sharding; async path = parallel/async_sgd.py host service",
+    "listen_and_serv": "parallel/async_sgd.py host parameter service",
+    # reader stack: variables-as-readers replaced by the python reader
+    # decorators + native threaded prefetch (reader.py, native/)
+    "create_batch_reader": "reader.py batch decorator",
+    "create_random_data_generator": "reader.py synthetic readers",
+    "create_shuffle_reader": "reader.py shuffle decorator",
+    "read": "DataFeeder/executor feed path",
+    # intra-node parallelism: pjit/shard_map over a Mesh
+    "parallel_do": "parallel/api.py data-parallel mesh sharding",
+    "get_places": "jax.devices()/Mesh enumeration",
+    # backward-machinery internal helper ops
+    "rnn_memory_helper": "program-level backward handles RNN memories",
+    # deprecated scalar/masked cond op (no python layer in the reference);
+    # superseded by split_lod_tensor/merge_lod_tensor IfElse which we
+    # implement (ops/control_flow_ops.py)
+    "cond": "masked IfElse via split/merge_lod_tensor",
+    # legacy v1-ported SSD head; the reference's own python layer
+    # (layers/detection.py:46 detection_output) composes box_coder +
+    # multiclass_nms instead — we implement that composition
+    "detection_output": "layers/detection.py box_coder + multiclass_nms",
+    # nce is split into deterministic nce_core + explicit sampler ops so
+    # the generic vjp replays cleanly (layers/sequence.py nce)
+    "nce": "nce_core + {log_}uniform_random_int sampler ops",
+}
+
+
+@pytest.mark.skipif(not os.path.isdir(_REF_OPS_DIR),
+                    reason="reference tree not present")
+def test_registry_covers_reference_registrations():
+    pat = re.compile(
+        r"(?:REGISTER_OP|REGISTER_OPERATOR|REGISTER_OP_WITHOUT_GRADIENT)"
+        r"\(\s*([a-z0-9_]+)")
+    ref_ops = set()
+    for root, _dirs, files in os.walk(_REF_OPS_DIR):
+        for f in files:
+            if not f.endswith(".cc"):
+                continue
+            with open(os.path.join(root, f), errors="replace") as fh:
+                ref_ops.update(pat.findall(fh.read()))
+    ref_ops = {o for o in ref_ops if not o.endswith("_grad")}
+    assert len(ref_ops) > 180, "suspiciously few reference sites parsed"
+
+    ours = set(registry._REGISTRY)
+    missing = sorted(ref_ops - ours - set(BY_DESIGN))
+    assert not missing, (
+        "reference ops with neither a registered lowering nor a by-design "
+        "substitution entry: %s" % missing)
+
+    # the substitution list must not rot into a dumping ground: every entry
+    # must still be a real reference op that we genuinely do not register
+    stale = sorted(k for k in BY_DESIGN
+                   if k not in ref_ops or k in ours)
+    assert not stale, "BY_DESIGN entries stale (implemented or gone): %s" \
+        % stale
