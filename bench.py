@@ -714,6 +714,26 @@ def child_main(tag):
         finally:
             wd.clear()
 
+    # inference throughput: the compiled-artifact deploy path, vs the
+    # reference's inference table (IntelOptimizedPaddle.md:84-90, 217.69
+    # img/s ResNet-50 bs16)
+    if final is not None and platform != "cpu" and _remaining() > 240:
+        wd.phase("infer", max(_remaining(), 1))
+        try:
+            from benchmark.infer_bench import bench_one
+            _log(tag, "inference bench bs=16 (compiled artifact) ...")
+            r = bench_one(16, iters=8)
+            final = dict(final)
+            final["infer_bs16_img_s"] = r["img_s"]
+            final["infer_vs_baseline"] = r["vs_ref"]
+            _emit(final)
+            _log(tag, "infer bs16: %.1f img/s (%.1f ms/batch)"
+                 % (r["img_s"], r["ms_per_batch"]))
+        except Exception as e:
+            _log(tag, "inference phase failed: %r" % e)
+        finally:
+            wd.clear()
+
     # dense TFLOP/s probe LAST — context for the MFU number, never a
     # gatekeeper in front of the headline
     if final is not None and platform != "cpu" and _remaining() > 60:

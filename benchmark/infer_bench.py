@@ -1,0 +1,107 @@
+"""Inference throughput benchmark: compiled-artifact ResNet-50.
+
+reference: benchmark/IntelOptimizedPaddle.md:79-90 (inference tables;
+ResNet-50 217.69 img/s at bs16 on 2S Xeon 6148) and the C-API deploy path
+(capi/gradient_machine.h:36). Here the artifact is the AOT-compiled
+StableHLO program exported by paddle_tpu.inference.export_compiled — the
+measurement covers exactly what a deployment serves: load_compiled + run.
+
+Usage: python benchmark/infer_bench.py [--batches 1,2,4,8,16]
+Prints one JSON line per batch size and writes
+benchmark/results/infer_<platform>.json.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers, models
+
+# reference inference table rows (IntelOptimizedPaddle.md:84-90)
+REF_RESNET50_INFER = {1: 50.3, 2: 83.7, 4: 152.7, 8: 211.0, 16: 217.69}
+
+
+def build_and_export(dirname, batch, image_size=224):
+    # restore the caller's default programs: bench.py's child process runs
+    # more phases after this in the same interpreter
+    main, startup = pt.Program(), pt.Program()
+    prev_main = pt.switch_main_program(main)
+    prev_startup = pt.switch_startup_program(startup)
+    try:
+        img = layers.data("img", shape=[3, image_size, image_size],
+                          dtype="float32")
+        pred = models.resnet_imagenet(img, class_dim=1000, depth=50)
+        exe = pt.Executor(pt.TPUPlace())
+        exe.run(startup)
+        example = {"img": np.zeros((batch, 3, image_size, image_size),
+                                   np.float32)}
+        pt.inference.export_compiled(dirname, ["img"], [pred], exe,
+                                     main_program=main,
+                                     example_feed=example)
+    finally:
+        pt.switch_main_program(prev_main)
+        pt.switch_startup_program(prev_startup)
+
+
+def bench_one(batch, iters=8, windows=3, image_size=224, tmp=None):
+    import shutil
+    import tempfile
+    d = tmp or tempfile.mkdtemp(prefix="ptpu_infer_")
+    try:
+        t0 = time.time()
+        build_and_export(d, batch, image_size)
+        export_s = time.time() - t0
+        model = pt.inference.load_compiled(d)
+        rng = np.random.RandomState(0)
+        feed = {"img": rng.rand(batch, 3, image_size,
+                                image_size).astype("float32")}
+        out = model.run(feed)  # warm (first call finishes compile/transfer)
+        np.asarray(out[0])
+        best = float("inf")
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = model.run(feed)
+            np.asarray(out[0])  # host read-back = true sync
+            best = min(best, time.perf_counter() - t0)
+        img_s = batch * iters / best
+    finally:
+        if tmp is None:
+            shutil.rmtree(d, ignore_errors=True)
+    return {"batch": batch, "img_s": round(img_s, 2),
+            "ms_per_batch": round(1e3 * best / iters, 2),
+            "export_s": round(export_s, 1),
+            "vs_ref": round(img_s / REF_RESNET50_INFER.get(batch, 217.69),
+                            3)}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batches", default="1,2,4,8,16")
+    ap.add_argument("--iters", type=int, default=8)
+    args = ap.parse_args(argv)
+    import jax
+    platform = jax.devices()[0].platform
+    rows = []
+    for bs in [int(b) for b in args.batches.split(",")]:
+        r = bench_one(bs, iters=args.iters)
+        r["platform"] = platform
+        print(json.dumps(r), flush=True)
+        rows.append(r)
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "results", "infer_%s.json" % platform)
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump({"metric": "resnet50_infer_images_per_sec",
+                   "reference": REF_RESNET50_INFER, "rows": rows}, f,
+                  indent=1)
+    print("wrote %s" % out)
+
+
+if __name__ == "__main__":
+    main()

@@ -31,7 +31,10 @@ class OpDef(object):
         self.lower = lower
         self.infer_shape = infer_shape
         self.grad_maker = grad_maker          # fn(op, block, grad_map) -> [Operator descs]
-        self.host = host                      # must run eagerly on host (save/load/py_func)
+        # must run eagerly on host (save/load/py_func). Either a bool or a
+        # predicate fn(op)->bool for ops that are host-only under certain
+        # attrs (e.g. sequence_pool with stride windows)
+        self.host = host
         self.stateful_outputs = tuple(stateful_outputs)  # output slots aliasing inputs (in-place state)
         self.no_gradient = no_gradient
 

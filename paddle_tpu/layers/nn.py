@@ -26,7 +26,7 @@ __all__ = [
     "relu", "log", "im2sequence", "expand", "squeeze", "unsqueeze",
     "edit_distance", "hsigmoid", "factorization_machine", "multiplex",
     "spp", "max_pool2d_with_index", "unpool", "mdlstm",
-    "conv3d", "pool3d", "smooth_l1",
+    "conv3d", "conv3d_transpose", "pool3d", "smooth_l1",
 ]
 
 
@@ -157,6 +157,42 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
                                 dtype=dtype)
     pre_bias = helper.create_variable_for_type_inference(dtype)
     helper.append_op(type="conv2d_transpose",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [pre_bias]},
+                     attrs={"strides": stride, "paddings": padding,
+                            "dilations": dilation})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, param_attr=None,
+                     bias_attr=None, act=None, name=None):
+    """reference: operators/conv_transpose_op.cc 3d registration (and the
+    v1 DeConv3DLayer, gserver/layers/DeConv3DLayer.cpp). NCDHW, filter
+    IODHW."""
+    helper = LayerHelper("conv3d_transpose", **locals())
+    dtype = helper.input_dtype()
+    num_channels = input.shape[1]
+    if isinstance(stride, int):
+        stride = [stride] * 3
+    if isinstance(padding, int):
+        padding = [padding] * 3
+    if isinstance(dilation, int):
+        dilation = [dilation] * 3
+    if filter_size is None:
+        dims = input.shape[2:5]
+        osz = output_size if isinstance(output_size, (list, tuple)) \
+            else [output_size] * 3
+        filter_size = [osz[i] - (dims[i] - 1) * stride[i] + 2 * padding[i]
+                       for i in range(3)]
+    elif isinstance(filter_size, int):
+        filter_size = [filter_size] * 3
+    filter_shape = [num_channels, num_filters] + list(filter_size)
+    w = helper.create_parameter(helper.param_attr, shape=filter_shape,
+                                dtype=dtype)
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="conv3d_transpose",
                      inputs={"Input": [input], "Filter": [w]},
                      outputs={"Output": [pre_bias]},
                      attrs={"strides": stride, "paddings": padding,

@@ -197,8 +197,14 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
     return helper.append_activation(pre_act)
 
 
-def sequence_pool(input, pool_type):
-    """reference: layers/nn.py sequence_pool -> operators/sequence_pool_op."""
+def sequence_pool(input, pool_type, stride=-1):
+    """reference: layers/nn.py sequence_pool -> operators/sequence_pool_op.
+    ``stride`` > 0 pools stride-sized windows within each sequence to a
+    shorter sequence (the v1 SequencePoolLayer stride semantics)."""
+    if stride != -1 and stride <= 0:
+        raise ValueError(
+            "sequence_pool stride must be -1 (whole sequence) or > 0, "
+            "got %r" % (stride,))
     helper = LayerHelper("sequence_pool", **locals())
     dtype = helper.input_dtype()
     out = helper.create_variable_for_type_inference(dtype)
@@ -206,19 +212,21 @@ def sequence_pool(input, pool_type):
                                                           stop_gradient=True)
     if input.shape is not None:
         out.shape = tuple(input.shape)
-    out.lod_level = max(input.lod_level - 1, 0)
+    out.lod_level = (input.lod_level if stride > 0
+                     else max(input.lod_level - 1, 0))
     helper.append_op(type="sequence_pool", inputs={"X": [input]},
                      outputs={"Out": [out], "MaxIndex": [max_index]},
-                     attrs={"pooltype": pool_type.upper()})
+                     attrs={"pooltype": pool_type.upper(),
+                            "stride": int(stride)})
     return out
 
 
-def sequence_first_step(input):
-    return sequence_pool(input, "first")
+def sequence_first_step(input, stride=-1):
+    return sequence_pool(input, "first", stride=stride)
 
 
-def sequence_last_step(input):
-    return sequence_pool(input, "last")
+def sequence_last_step(input, stride=-1):
+    return sequence_pool(input, "last", stride=stride)
 
 
 def sequence_softmax(input, name=None):

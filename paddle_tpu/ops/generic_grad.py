@@ -19,7 +19,19 @@ def _zeros_like(v):
     return jnp.zeros_like(raw_data(v))
 
 
-@registry.register_op("generic_grad")
+def _generic_grad_is_host(op):
+    """A generic grad replays its forward lowering, so it is host-bound
+    exactly when the forward op is (incl. predicate-host ops like
+    sequence_pool with stride windows — the forward attrs are copied onto
+    the grad op, so the forward's predicate evaluates unchanged)."""
+    fwd = registry.lookup(op.attr("__fwd_type__"))
+    if fwd is None:
+        return False
+    h = fwd.host
+    return bool(h(op)) if callable(h) else bool(h)
+
+
+@registry.register_op("generic_grad", host=_generic_grad_is_host)
 def generic_grad(ctx):
     fwd_type = ctx.attr("__fwd_type__")
     in_slots = list(ctx.attr("__fwd_input_slots__"))

@@ -116,17 +116,79 @@ def padded_to_lod(padded, offsets, total):
 # ---------------------------------------------------------------------------
 # segment-reduction ops
 
-@register_op("sequence_pool")
+def _sequence_pool_stride(ctx, x, data, offs, stride, ptype):
+    """Stride windows: each sequence is cut into ceil(len/stride) windows
+    of `stride` timesteps and every window pools to one row, so the output
+    is a *sequence* of window results (reference:
+    gserver/layers/SequencePoolLayer.cpp stride_, SequenceLastInstanceLayer
+    select first/last within each window; the window start positions come
+    from CalcSequenceStartPositions).
+
+    Output row count depends on the concrete lengths, so this is a host
+    path (same rule as the runtime-shape sequence ops) — but the windowing
+    indices are built in python and the arithmetic stays in jnp, so the
+    generic-vjp grad replays it and training works."""
+    offs_c = [int(v) for v in np.asarray(offs)]
+    new_offs = [0]
+    starts, ends = [], []
+    for i in range(len(offs_c) - 1):
+        for w0 in range(offs_c[i], offs_c[i + 1], stride):
+            starts.append(w0)
+            ends.append(min(w0 + stride, offs_c[i + 1]))
+        new_offs.append(len(starts))
+    nwin = len(starts)
+    wlens = np.asarray(ends) - np.asarray(starts)
+    wsid = np.repeat(np.arange(nwin), wlens)
+    sid = jnp.asarray(wsid, jnp.int32)
+    if ptype == "SUM":
+        out = jax.ops.segment_sum(data, sid, num_segments=nwin)
+    elif ptype == "AVERAGE":
+        out = jax.ops.segment_sum(data, sid, num_segments=nwin)
+        out = out / jnp.asarray(wlens, data.dtype)[:, None]
+    elif ptype == "SQRT":
+        out = jax.ops.segment_sum(data, sid, num_segments=nwin)
+        out = out / jnp.sqrt(jnp.asarray(wlens, data.dtype))[:, None]
+    elif ptype == "MAX":
+        out = jax.ops.segment_max(data, sid, num_segments=nwin)
+    elif ptype == "LAST":
+        out = jnp.take(data, jnp.asarray(np.asarray(ends) - 1), axis=0)
+    elif ptype == "FIRST":
+        out = jnp.take(data, jnp.asarray(np.asarray(starts)), axis=0)
+    else:
+        raise ValueError("unknown pooltype %r" % ptype)
+    ctx.set_output("Out", TracedLoD(
+        out, (jnp.asarray(np.asarray(new_offs, np.int32)),)))
+
+
+def _seq_pool_is_host(op):
+    return int(op.attr("stride", -1) or -1) > 0
+
+
+@register_op("sequence_pool", host=_seq_pool_is_host)
 def sequence_pool(ctx):
     """reference: operators/sequence_pool_op.cc + math/sequence_pooling.cc.
-    Pools each sequence to one row (drops the last lod level)."""
+    Pools each sequence to one row (drops the last lod level); with the v1
+    stride attr, pools stride-sized windows to a shorter sequence."""
     x = ctx.input("X")
     data = raw_data(x)
     offs = seq_offsets(x)
+    stride = int(ctx.attr("stride", -1) or -1)
+    if stride > 0:
+        ptype_s = str(ctx.attr("pooltype", "AVERAGE")).upper()
+        ptype_s = {"AVG": "AVERAGE"}.get(ptype_s, ptype_s)
+        if len(x.lod) > 1:
+            raise NotImplementedError(
+                "sequence_pool stride windows on nested sequences "
+                "(the reference SequencePoolLayer asserts this too)")
+        _sequence_pool_stride(ctx, x, data, offs, stride, ptype_s)
+        return
     n = offs.shape[0] - 1
     total = data.shape[0]
     sid = segment_ids(offs, total)
     ptype = str(ctx.attr("pooltype", "AVERAGE")).upper()
+    # the v1 DSL spells it "avg" (poolings.py AvgPooling.name); the fluid
+    # op enum spells it AVERAGE — accept both
+    ptype = {"AVG": "AVERAGE"}.get(ptype, ptype)
     lengths = (offs[1:] - offs[:-1]).astype(data.dtype)
     safe_len = jnp.maximum(lengths, 1)
     if ptype == "SUM":
@@ -397,25 +459,48 @@ def _infer_context_project(op, block):
 @register_op("context_project", infer_shape=_infer_context_project)
 def context_project(ctx):
     """The context window WITHOUT the filter matmul: row i becomes the
-    concat of its ctx_len neighbours (zero-padded at sequence edges) —
-    the reference's ContextProjection building block
-    (reference: operators/math/context_project.h,
-    gserver/layers ContextProjection in MixedLayer)."""
+    concat of its ctx_len neighbours — the reference's ContextProjection
+    building block (reference: operators/math/context_project.h,
+    gserver/layers ContextProjection in MixedLayer).
+
+    Off-sequence context positions are zero-padded, or — when the optional
+    PaddingData input [up_pad + down_pad, D] is wired — filled with the
+    learned padding rows: position -k before a sequence reads
+    w[up_pad - k], position len+q after it reads w[up_pad + q]
+    (padding_trainable in the reference kernel)."""
     x = ctx.input("X")
     data = raw_data(x)
     offs = seq_offsets(x)
     ml = static_max_len(x)
     ctx_len = int(ctx.attr("contextLength"))
     ctx_start = int(ctx.attr("contextStart", -((ctx_len - 1) // 2)))
+    pad_w = (raw_data(ctx.input("PaddingData"))
+             if ctx.has_input("PaddingData") else None)
+    up_pad = max(0, -ctx_start)
     padded, mask = lod_to_padded(data, offs, ml)  # [n, T, D]
+    lens = (offs[1:] - offs[:-1])                 # [n]
     cols = []
     for j in range(ctx_len):
         shift = ctx_start + j
         rolled = jnp.roll(padded, -shift, axis=1)
         t = jnp.arange(ml)
-        valid = (t + shift >= 0) & (t + shift < ml)
+        pos = t + shift
+        valid = (pos >= 0) & (pos < ml)
         valid = valid[None, :] & jnp.roll(mask, -shift, axis=1)
-        cols.append(jnp.where(valid[..., None], rolled, 0))
+        col = jnp.where(valid[..., None], rolled, 0)
+        if pad_w is not None and pad_w.shape[0] > 0:
+            wsz = pad_w.shape[0]
+            before = (pos < 0)[None, :]                       # [1, T]
+            w_b = pad_w[jnp.clip(up_pad + pos, 0, wsz - 1)]   # [T, D]
+            col = jnp.where(before[..., None], w_b[None], col)
+            after = pos[None, :] >= lens[:, None]             # [n, T]
+            a_idx = jnp.clip(up_pad + pos[None, :] - lens[:, None],
+                             0, wsz - 1)
+            col = jnp.where(after[..., None], pad_w[a_idx], col)
+            # rows past each sequence's end are dropped by padded_to_lod;
+            # zero them so the gather never leaks padding rows
+            col = jnp.where(mask[..., None], col, 0)
+        cols.append(col)
     ctxmat = jnp.concatenate(cols, axis=-1)
     out = padded_to_lod(ctxmat, offs, data.shape[0])
     ctx.set_output("Out", with_lod_of(x, out))

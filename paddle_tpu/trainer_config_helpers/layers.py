@@ -544,21 +544,35 @@ regression_cost = square_error_cost
 def context_projection(input, context_len, context_start=None,
                        padding_attr=False):
     """Concat of each step's context window within its sequence
-    (reference: ContextProjection; trainable_padding unsupported — edge
-    steps are zero-padded, the padding_attr=False behavior)."""
-    if padding_attr not in (False, None):
-        raise NotImplementedError("trainable context padding")
+    (reference: ContextProjection). padding_attr=False zero-pads edge
+    steps; a truthy padding_attr (True or ParamAttr) learns the
+    [up_pad + down_pad, dim] edge rows instead
+    (reference: gserver ContextProjection trainable_padding,
+    operators/math/context_project.h padding_trainable)."""
     start = (-((context_len - 1) // 2) if context_start is None
              else context_start)
+    trainable = padding_attr not in (False, None)
 
     def build():
         from ..layers.layer_helper import LayerHelper
+        from ..param_attr import ParamAttr
         helper = LayerHelper("context_project")
+        inputs = {"X": [input.var]}
+        if trainable:
+            up = max(0, -int(start))
+            down = max(0, int(start) + int(context_len) - 1)
+            if up + down > 0:
+                attr = (_param(padding_attr)
+                        if not isinstance(padding_attr, bool) else None)
+                w = helper.create_parameter(
+                    attr or ParamAttr(), shape=[up + down, input.size],
+                    dtype="float32")
+                inputs["PaddingData"] = [w]
         out = helper.create_variable_for_type_inference(
             dtype=input.var.dtype)
         out.lod_level = getattr(input.var, "lod_level", 1)
         helper.append_op(type="context_project",
-                         inputs={"X": [input.var]},
+                         inputs=inputs,
                          outputs={"Out": [out]},
                          attrs={"contextLength": int(context_len),
                                 "contextStart": int(start)})
@@ -1368,20 +1382,17 @@ def scale_sub_region_layer(input, indices, value, name=None):
 def first_seq(input, name=None, agg_level=AggregateLevel.TO_NO_SEQUENCE,
               stride=-1, layer_attr=None):
     """reference: layers.py first_seq (SequenceLastInstanceLayer with
-    select_first; stride windows unsupported — the fluid op takes the
-    whole sequence)."""
-    if stride != -1:
-        raise NotImplementedError("first_seq stride windows")
-    out = F.sequence_first_step(input.var)
+    select_first). ``stride`` > 0 returns the first instance of every
+    stride-sized window as a shorter sequence
+    (gserver/layers/SequenceLastInstanceLayer.cpp stride_)."""
+    out = F.sequence_first_step(input.var, stride=stride)
     return LayerOutput(name or out.name, out, size=input.size)
 
 
 def last_seq(input, name=None, agg_level=AggregateLevel.TO_NO_SEQUENCE,
              stride=-1, layer_attr=None):
-    """reference: layers.py last_seq."""
-    if stride != -1:
-        raise NotImplementedError("last_seq stride windows")
-    out = F.sequence_last_step(input.var)
+    """reference: layers.py last_seq; stride windows as in first_seq."""
+    out = F.sequence_last_step(input.var, stride=stride)
     return LayerOutput(name or out.name, out, size=input.size)
 
 
@@ -1389,9 +1400,14 @@ def pooling_layer(input, pooling_type=None, name=None, bias_attr=None,
                   agg_level=AggregateLevel.TO_NO_SEQUENCE, stride=-1,
                   layer_attr=None):
     """reference: layers.py pooling_layer — the canonical name of the
-    sequence pool (pool_layer above is the repo's earlier spelling)."""
+    sequence pool (pool_layer above is the repo's earlier spelling).
+    ``stride`` > 0 pools each stride-sized window to one row
+    (gserver/layers/SequencePoolLayer.cpp stride_)."""
     if stride != -1:
-        raise NotImplementedError("pooling_layer stride windows")
+        # F.sequence_pool validates stride (-1 or > 0)
+        pt = (pooling_type or MaxPooling()).name
+        out = F.sequence_pool(input.var, pool_type=pt, stride=stride)
+        return LayerOutput(name or out.name, out, size=input.size)
     return pool_layer(input, pooling_type=pooling_type, name=name,
                       layer_attr=layer_attr)
 
@@ -1749,17 +1765,27 @@ def img_conv3d_layer(input, filter_size, num_filters, name=None,
                      padding=0, bias_attr=None, param_attr=None,
                      shared_biases=True, layer_attr=None, trans=False,
                      layer_type=None):
-    """reference: layers.py img_conv3d_layer (Conv3DLayer). The flat v1
-    input carries (depth, height, width) on the LayerOutput (set by
-    data_layer(depth=...) or a previous 3d layer); trans
-    (DeConv3DLayer) is not lowered."""
-    if trans:
-        raise NotImplementedError("img_conv3d_layer trans=True (deconv3d)")
+    """reference: layers.py img_conv3d_layer (Conv3DLayer; trans=True ->
+    DeConv3DLayer). The flat v1 input carries (depth, height, width) on the
+    LayerOutput (set by data_layer(depth=...) or a previous 3d layer)."""
     var, c, d, h, w = _as_volume(input, num_channels)
     fs = filter_size if isinstance(filter_size, (list, tuple)) \
         else [filter_size] * 3
     st = stride if isinstance(stride, (list, tuple)) else [stride] * 3
     pd = padding if isinstance(padding, (list, tuple)) else [padding] * 3
+    if trans:
+        out = F.conv3d_transpose(
+            var, num_filters=num_filters, filter_size=fs, stride=st,
+            padding=pd, act=_act_name(act), param_attr=_param(param_attr),
+            bias_attr=_bias(bias_attr))
+        od = (d - 1) * st[0] - 2 * pd[0] + fs[0]
+        oh = (h - 1) * st[1] - 2 * pd[1] + fs[1]
+        ow = (w - 1) * st[2] - 2 * pd[2] + fs[2]
+        lo = LayerOutput(name or out.name, out,
+                         size=num_filters * od * oh * ow)
+        lo.channels, lo.depth, lo.height, lo.width = (num_filters, od, oh,
+                                                      ow)
+        return lo
     out = F.conv3d(var, num_filters=num_filters, filter_size=fs,
                    stride=st, padding=pd, groups=groups,
                    act=_act_name(act), param_attr=_param(param_attr),
@@ -1785,8 +1811,13 @@ def img_pool3d_layer(input, pool_size, name=None, num_channels=None,
     pd = [padding_z if padding_z is not None else padding,
           padding_y if padding_y is not None else padding, padding]
     pt = (pool_type or MaxPooling()).name
-    if pt == "sum":
-        raise NotImplementedError("3d sum pooling")
+    if pt not in ("max", "avg"):
+        # reference parity: config_parser.py:1276 parse_pool3d
+        # config_asserts pool_type in [max-projection, avg-projection]
+        raise ValueError(
+            "pool-type %s is not in ['max-projection', 'avg-projection'] "
+            "for 3d pooling (reference: config_parser.py parse_pool3d)"
+            % pt)
     out = F.pool3d(var, pool_size=ks, pool_type=pt, pool_stride=st,
                    pool_padding=pd, ceil_mode=ceil_mode)
 
