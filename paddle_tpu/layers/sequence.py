@@ -214,10 +214,12 @@ def sequence_pool(input, pool_type, stride=-1):
         out.shape = tuple(input.shape)
     out.lod_level = (input.lod_level if stride > 0
                      else max(input.lod_level - 1, 0))
+    attrs = {"pooltype": pool_type.upper()}
+    if stride > 0:  # default -1 stays un-serialized (golden-config stable)
+        attrs["stride"] = int(stride)
     helper.append_op(type="sequence_pool", inputs={"X": [input]},
                      outputs={"Out": [out], "MaxIndex": [max_index]},
-                     attrs={"pooltype": pool_type.upper(),
-                            "stride": int(stride)})
+                     attrs=attrs)
     return out
 
 

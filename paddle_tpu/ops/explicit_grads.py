@@ -301,18 +301,17 @@ def conv2d_grad(ctx):
     want_dw = bool(ctx.op.output("Filter@GRAD"))
     acc = jnp.float32
 
-    from .nn_ops import conv_impl
-    if groups != 1 or tuple(d) != (1, 1) or conv_impl() != "matmul":
-        # native-conv mode (and rare shapes): XLA's conv transpose rules via
-        # a vjp over the single lax.conv primitive — the re-traced forward
-        # is one primitive that XLA CSEs with the real forward
+    from .nn_ops import _conv2d_is_s2d_stem, conv2d_apply, conv_impl
+    use_taps = (groups == 1 and tuple(d) == (1, 1)
+                and conv_impl() == "matmul"
+                and not _conv2d_is_s2d_stem(x, w, s, p, d, groups))
+    if not use_taps:
+        # replay the EXACT production forward dispatch (layout/impl/s2d
+        # as autotuned) under jax.vjp: XLA's conv transpose rules emit the
+        # native backprop convs in the same layout, and the re-traced
+        # forward primitive CSEs with the real forward
         def f(x_, w_):
-            return jax.lax.conv_general_dilated(
-                x_, w_, window_strides=tuple(s),
-                padding=[(p[0], p[0]), (p[1], p[1])],
-                rhs_dilation=tuple(d),
-                dimension_numbers=("NCHW", "OIHW", "NCHW"),
-                feature_group_count=groups)
+            return conv2d_apply(x_, w_, s, p, d, groups, None)
         _, vjp = jax.vjp(f, x, w)
         dx, dw = vjp(dy.astype(x.dtype))
         if want_dx:

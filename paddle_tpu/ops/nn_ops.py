@@ -335,6 +335,28 @@ def _conv_shifted_matmul(x, w, s, p):
     return out
 
 
+def _conv2d_is_s2d_stem(x, w, s, p, d, groups):
+    return (conv_first_s2d() and groups == 1 and tuple(d) == (1, 1)
+            and x.shape[1] <= 4 and w.shape[2:] == (7, 7)
+            and tuple(s) == (2, 2) and tuple(p) == (3, 3)
+            and x.shape[2] % 2 == 0 and x.shape[3] % 2 == 0)
+
+
+def conv2d_apply(x, w, s, p, d, groups, pe):
+    """Pure conv2d forward dispatch (layout / impl / s2d-stem aware),
+    shared by the lowering below AND by explicit_grads.conv2d_grad's vjp
+    replay — one definition, so the backward always runs in the same
+    layout/impl the autotuner picked for the forward (and XLA can CSE the
+    replayed primitive with the real forward)."""
+    if _conv2d_is_s2d_stem(x, w, s, p, d, groups):
+        # the stem rewrite outranks conv_impl: the tuner times the stem
+        # candidates specifically, so an enabled s2d pick must execute
+        return _conv_stem_s2d(x, w, pe)
+    if groups == 1 and tuple(d) == (1, 1) and conv_impl() == "matmul":
+        return _conv_shifted_matmul(x, w, s, p)
+    return _conv_native(x, w, s, p, d, groups, pe)
+
+
 @register_op("conv2d", infer_shape=_infer_conv2d)
 def conv2d(ctx):
     """reference: operators/conv_op.cc + conv_cudnn_op.cu.cc. NCHW/OIHW.
@@ -355,17 +377,7 @@ def conv2d(ctx):
     # can't mix an f32 preferred output with bf16 operands)
     pe = (jnp.float32 if (not amp_on and x.dtype in (jnp.bfloat16,))
           else None)
-    if (conv_first_s2d() and groups == 1 and tuple(d) == (1, 1)
-            and x.shape[1] <= 4 and w.shape[2:] == (7, 7)
-            and tuple(s) == (2, 2) and tuple(p) == (3, 3)
-            and x.shape[2] % 2 == 0 and x.shape[3] % 2 == 0):
-        # the stem rewrite outranks conv_impl: the tuner times the stem
-        # candidates specifically, so an enabled s2d pick must execute
-        out = _conv_stem_s2d(x, w, pe)
-    elif groups == 1 and tuple(d) == (1, 1) and conv_impl() == "matmul":
-        out = _conv_shifted_matmul(x, w, s, p)
-    else:
-        out = _conv_native(x, w, s, p, d, groups, pe)
+    out = conv2d_apply(x, w, s, p, d, groups, pe)
     ctx.set_output("Output", out.astype(out_dtype))
 
 

@@ -116,6 +116,26 @@ def padded_to_lod(padded, offsets, total):
 # ---------------------------------------------------------------------------
 # segment-reduction ops
 
+def _segment_pool(data, sid, nseg, lengths, ptype):
+    """The SUM/AVERAGE/SQRT/MAX segment-reduction ladder shared by the
+    whole-sequence and stride-window paths of sequence_pool (``lengths``:
+    float segment sizes, shape [nseg]). MAX zeroes empty segments like the
+    reference (math/sequence_pooling.cc)."""
+    safe = jnp.maximum(lengths, 1)
+    if ptype == "SUM":
+        return jax.ops.segment_sum(data, sid, num_segments=nseg)
+    if ptype == "AVERAGE":
+        out = jax.ops.segment_sum(data, sid, num_segments=nseg)
+        return out / _expand_mask(safe, out).astype(data.dtype)
+    if ptype == "SQRT":
+        out = jax.ops.segment_sum(data, sid, num_segments=nseg)
+        return out / jnp.sqrt(_expand_mask(safe, out).astype(data.dtype))
+    if ptype == "MAX":
+        out = jax.ops.segment_max(data, sid, num_segments=nseg)
+        return jnp.where(_expand_mask(lengths > 0, out), out, 0)
+    raise ValueError("unknown pooltype %r" % ptype)
+
+
 def _sequence_pool_stride(ctx, x, data, offs, stride, ptype):
     """Stride windows: each sequence is cut into ceil(len/stride) windows
     of `stride` timesteps and every window pools to one row, so the output
@@ -138,24 +158,14 @@ def _sequence_pool_stride(ctx, x, data, offs, stride, ptype):
         new_offs.append(len(starts))
     nwin = len(starts)
     wlens = np.asarray(ends) - np.asarray(starts)
-    wsid = np.repeat(np.arange(nwin), wlens)
-    sid = jnp.asarray(wsid, jnp.int32)
-    if ptype == "SUM":
-        out = jax.ops.segment_sum(data, sid, num_segments=nwin)
-    elif ptype == "AVERAGE":
-        out = jax.ops.segment_sum(data, sid, num_segments=nwin)
-        out = out / jnp.asarray(wlens, data.dtype)[:, None]
-    elif ptype == "SQRT":
-        out = jax.ops.segment_sum(data, sid, num_segments=nwin)
-        out = out / jnp.sqrt(jnp.asarray(wlens, data.dtype))[:, None]
-    elif ptype == "MAX":
-        out = jax.ops.segment_max(data, sid, num_segments=nwin)
-    elif ptype == "LAST":
+    sid = jnp.asarray(np.repeat(np.arange(nwin), wlens), jnp.int32)
+    if ptype == "LAST":
         out = jnp.take(data, jnp.asarray(np.asarray(ends) - 1), axis=0)
     elif ptype == "FIRST":
         out = jnp.take(data, jnp.asarray(np.asarray(starts)), axis=0)
     else:
-        raise ValueError("unknown pooltype %r" % ptype)
+        out = _segment_pool(data, sid, nwin,
+                            jnp.asarray(wlens, data.dtype), ptype)
     ctx.set_output("Out", TracedLoD(
         out, (jnp.asarray(np.asarray(new_offs, np.int32)),)))
 
@@ -173,50 +183,35 @@ def sequence_pool(ctx):
     data = raw_data(x)
     offs = seq_offsets(x)
     stride = int(ctx.attr("stride", -1) or -1)
-    if stride > 0:
-        ptype_s = str(ctx.attr("pooltype", "AVERAGE")).upper()
-        ptype_s = {"AVG": "AVERAGE"}.get(ptype_s, ptype_s)
-        if len(x.lod) > 1:
-            raise NotImplementedError(
-                "sequence_pool stride windows on nested sequences "
-                "(the reference SequencePoolLayer asserts this too)")
-        _sequence_pool_stride(ctx, x, data, offs, stride, ptype_s)
-        return
-    n = offs.shape[0] - 1
-    total = data.shape[0]
-    sid = segment_ids(offs, total)
     ptype = str(ctx.attr("pooltype", "AVERAGE")).upper()
     # the v1 DSL spells it "avg" (poolings.py AvgPooling.name); the fluid
     # op enum spells it AVERAGE — accept both
     ptype = {"AVG": "AVERAGE"}.get(ptype, ptype)
+    if stride > 0:
+        if len(x.lod) > 1:
+            raise NotImplementedError(
+                "sequence_pool stride windows on nested sequences "
+                "(the reference SequencePoolLayer asserts this too)")
+        _sequence_pool_stride(ctx, x, data, offs, stride, ptype)
+        return
+    n = offs.shape[0] - 1
+    total = data.shape[0]
+    sid = segment_ids(offs, total)
     lengths = (offs[1:] - offs[:-1]).astype(data.dtype)
-    safe_len = jnp.maximum(lengths, 1)
-    if ptype == "SUM":
-        out = jax.ops.segment_sum(data, sid, num_segments=n)
-    elif ptype == "AVERAGE":
-        out = jax.ops.segment_sum(data, sid, num_segments=n)
-        out = out / _expand_mask(safe_len, out).astype(data.dtype)
-    elif ptype == "SQRT":
-        out = jax.ops.segment_sum(data, sid, num_segments=n)
-        out = out / jnp.sqrt(_expand_mask(safe_len, out).astype(data.dtype))
-    elif ptype == "MAX":
-        out = jax.ops.segment_max(data, sid, num_segments=n)
-        # empty sequences would be -inf; zero them like the reference
-        out = jnp.where(_expand_mask(lengths > 0, out), out, 0)
-        if ctx.output_names("MaxIndex"):
-            pos = jnp.arange(total, dtype=jnp.int32)
-            best = jnp.take(out, sid, axis=0) == data
-            idx = jax.ops.segment_min(
-                jnp.where(best, pos[:, None], total), sid, num_segments=n)
-            ctx.set_output("MaxIndex", idx.astype(jnp.int32))
-    elif ptype == "LAST":
+    if ptype == "LAST":
         out = jnp.take(data, jnp.maximum(offs[1:] - 1, 0), axis=0)
         out = jnp.where(_expand_mask(lengths > 0, out), out, 0)
     elif ptype == "FIRST":
         out = jnp.take(data, jnp.minimum(offs[:-1], total - 1), axis=0)
         out = jnp.where(_expand_mask(lengths > 0, out), out, 0)
     else:
-        raise ValueError("unknown pooltype %r" % ptype)
+        out = _segment_pool(data, sid, n, lengths, ptype)
+        if ptype == "MAX" and ctx.output_names("MaxIndex"):
+            pos = jnp.arange(total, dtype=jnp.int32)
+            best = jnp.take(out, sid, axis=0) == data
+            idx = jax.ops.segment_min(
+                jnp.where(best, pos[:, None], total), sid, num_segments=n)
+            ctx.set_output("MaxIndex", idx.astype(jnp.int32))
     # result: one row per sequence; remaining lod = outer levels
     if len(x.lod) > 1:
         out = TracedLoD(out, x.lod[:-1], max_lens=x.max_lens[:-1])

@@ -1774,6 +1774,10 @@ def img_conv3d_layer(input, filter_size, num_filters, name=None,
     st = stride if isinstance(stride, (list, tuple)) else [stride] * 3
     pd = padding if isinstance(padding, (list, tuple)) else [padding] * 3
     if trans:
+        if groups not in (None, 1):
+            raise NotImplementedError(
+                "img_conv3d_layer(trans=True) with groups=%r — the "
+                "conv3d_transpose lowering is ungrouped" % (groups,))
         out = F.conv3d_transpose(
             var, num_filters=num_filters, filter_size=fs, stride=st,
             padding=pd, act=_act_name(act), param_attr=_param(param_attr),
