@@ -309,9 +309,15 @@ def conv2d_grad(ctx):
         # replay the EXACT production forward dispatch (layout/impl/s2d
         # as autotuned) under jax.vjp: XLA's conv transpose rules emit the
         # native backprop convs in the same layout, and the re-traced
-        # forward primitive CSEs with the real forward
+        # forward primitive CSEs with the real forward. pe mirrors the
+        # forward lowering's accumulation policy (f32 accumulation for
+        # bf16 operands outside AMP) so the replay is bit-identical.
+        amp_on = getattr(ctx.block.program, "_amp", False)
+        pe = (jnp.float32 if (not amp_on and x.dtype in (jnp.bfloat16,))
+              else None)
+
         def f(x_, w_):
-            return conv2d_apply(x_, w_, s, p, d, groups, None)
+            return conv2d_apply(x_, w_, s, p, d, groups, pe)
         _, vjp = jax.vjp(f, x, w)
         dx, dw = vjp(dy.astype(x.dtype))
         if want_dx:

@@ -1,0 +1,82 @@
+"""Book variant tiers the reference runs beyond the plain models:
+
+- memory-optimized book runs (reference:
+  python/paddle/fluid/tests/book_memory_optimization/ — same models with
+  memory_optimize(program) applied), and
+- parallel book runs (reference: test_recognize_digits.py's use_parallel
+  combinations via parallel_do; here data parallelism is a mesh sharding
+  over the 8 virtual devices).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.parallel import data_parallel, make_mesh
+
+pytestmark = pytest.mark.slow  # book e2e: minutes on CPU
+
+
+def _lenet(img):
+    img2d = fluid.layers.reshape(img, [-1, 1, 28, 28])
+    conv_pool_1 = fluid.nets.simple_img_conv_pool(
+        input=img2d, filter_size=5, num_filters=8, pool_size=2,
+        pool_stride=2, act="relu")
+    conv_pool_2 = fluid.nets.simple_img_conv_pool(
+        input=conv_pool_1, filter_size=5, num_filters=16, pool_size=2,
+        pool_stride=2, act="relu")
+    return fluid.layers.fc(input=conv_pool_2, size=10, act="softmax")
+
+
+def _build():
+    img = fluid.layers.data(name="img", shape=[784], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    prediction = _lenet(img)
+    cost = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_cost = fluid.layers.mean(cost)
+    acc = fluid.layers.accuracy(input=prediction, label=label)
+    fluid.optimizer.Adam(learning_rate=0.003).minimize(avg_cost)
+    return img, label, avg_cost, acc
+
+
+def _train(exe, img, label, avg_cost, acc, batches=40):
+    place = fluid.CPUPlace()
+    feeder = fluid.DataFeeder(place=place, feed_list=[img, label])
+    train_reader = fluid.reader.batch(
+        fluid.reader.shuffle(fluid.dataset.mnist.train(), buf_size=500),
+        batch_size=64)
+    costs, accs = [], []
+    for i, data in enumerate(train_reader()):
+        c, a = exe.run(feed=feeder.feed(data), fetch_list=[avg_cost, acc])
+        costs.append(float(np.asarray(c).reshape(-1)[0]))
+        accs.append(float(np.asarray(a).reshape(-1)[0]))
+        if i + 1 >= batches:
+            break
+    return costs, accs
+
+
+def test_recognize_digits_memory_optimized():
+    """reference: book_memory_optimization/test_memopt_* — the same model
+    trains with memory_optimize applied to the program."""
+    img, label, avg_cost, acc = _build()
+    pairs = fluid.memory_optimize(fluid.default_main_program())
+    assert isinstance(pairs, list)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    costs, accs = _train(exe, img, label, avg_cost, acc)
+    assert np.mean(accs[-5:]) > np.mean(accs[:5]) + 0.1, \
+        (np.mean(accs[:5]), np.mean(accs[-5:]))
+    assert np.mean(costs[-5:]) < np.mean(costs[:5])
+
+
+def test_recognize_digits_data_parallel():
+    """reference: test_recognize_digits use_parallel=True (parallel_do over
+    places) — here the same training sharded dp over the 8-device mesh."""
+    img, label, avg_cost, acc = _build()
+    mesh = make_mesh({"dp": -1})
+    ctx = data_parallel(mesh)
+    exe = fluid.Executor(fluid.CPUPlace(), dist_context=ctx)
+    exe.run(fluid.default_startup_program())
+    costs, accs = _train(exe, img, label, avg_cost, acc)
+    assert np.mean(accs[-5:]) > np.mean(accs[:5]) + 0.1, \
+        (np.mean(accs[:5]), np.mean(accs[-5:]))
+    assert np.mean(costs[-5:]) < np.mean(costs[:5])
