@@ -16,12 +16,19 @@ import jax.numpy as jnp
 
 from .core import ir
 
-__all__ = ["enable", "disable", "amp_guard", "cast_inputs", "force"]
+__all__ = ["enable", "disable", "amp_guard", "cast_inputs", "force",
+           "active", "keep_bf16"]
 
 
-def enable(program=None):
+def enable(program=None, pure=False):
+    """``pure=True`` additionally keeps matmul/conv OUTPUTS in bf16, so
+    the whole activation stream (the dominant HBM traffic) is half-width
+    — parameters, optimizer state, batch-norm statistics and loss math
+    stay f32 (master-weights pattern). Plain AMP only narrows the
+    matmul/conv operands and writes activations back at f32."""
     program = program or ir.default_main_program()
     program._amp = True
+    program._amp_pure = bool(pure)
     return program
 
 
@@ -60,26 +67,43 @@ _FORCE = None  # tri-state: None = auto (device probe), True/False = pinned
 def force(mode):
     """Pin the cast decision: ``force(True)`` applies bf16 casts even on
     the CPU backend (numerics tests), ``force(False)`` disables them,
-    ``force(None)`` restores the device probe."""
+    ``force(None)`` restores the device probe. Returns the previous pin
+    so callers can restore an outer pin instead of clobbering it."""
     global _FORCE
+    prev = _FORCE
     _FORCE = mode
+    return prev
 
 
-def cast_inputs(ctx, *arrays):
-    """bf16-cast float operands when the op's program runs under AMP.
+def active(ctx):
+    """Whether AMP casting applies for this op's program on this backend.
     No-op off TPU (unless ``force(True)``): AMP targets the MXU; CPU XLA
     lacks the mixed bf16->f32 dot emitter."""
     global _ON_TPU
     if not getattr(ctx.block.program, "_amp", False):
-        return arrays
+        return False
     if _FORCE is not None:
-        if not _FORCE:
-            return arrays
-    else:
-        if _ON_TPU is None:
-            _ON_TPU = _on_tpu()
-        if not _ON_TPU:
-            return arrays
+        return bool(_FORCE)
+    if _ON_TPU is None:
+        _ON_TPU = _on_tpu()
+    return _ON_TPU
+
+
+def keep_bf16(ctx, out_dtype=None):
+    """True when matmul/conv outputs should stay bf16 (pure AMP mode)
+    instead of being cast back to the declared activation dtype.
+    ``out_dtype``: the op's declared output dtype — narrowing only
+    applies to f32/bf16 activations (ints and f64 stay exact)."""
+    if out_dtype is not None and out_dtype not in (jnp.float32,
+                                                   jnp.bfloat16):
+        return False
+    return getattr(ctx.block.program, "_amp_pure", False) and active(ctx)
+
+
+def cast_inputs(ctx, *arrays):
+    """bf16-cast float operands when the op's program runs under AMP."""
+    if not active(ctx):
+        return arrays
     return tuple(
         a.astype(jnp.bfloat16)
         if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)

@@ -54,6 +54,15 @@ def elementwise(ctx, fn):
     scale = ctx.attr("scale")  # fused scale some paddle elementwise ops carry
     if scale is not None and scale != 1.0:
         out = out * scale
+    if out.dtype != xd.dtype:
+        # pure AMP: a bf16 activation combined with an f32 param (bias
+        # add, bn-style scale) promotes to f32 — write the result back
+        # half-width so the activation stream stays bf16 (compute above
+        # already happened at the promoted precision)
+        from .. import amp
+        import jax.numpy as jnp
+        if xd.dtype == jnp.bfloat16 and amp.keep_bf16(ctx):
+            out = out.astype(xd.dtype)
     ctx.set_output("Out", with_lod_of(x, out))
 
 

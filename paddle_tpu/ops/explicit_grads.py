@@ -306,18 +306,19 @@ def conv2d_grad(ctx):
                 and conv_impl() == "matmul"
                 and not _conv2d_is_s2d_stem(x, w, s, p, d, groups))
     if not use_taps:
-        # replay the EXACT production forward dispatch (layout/impl/s2d
-        # as autotuned) under jax.vjp: XLA's conv transpose rules emit the
-        # native backprop convs in the same layout, and the re-traced
-        # forward primitive CSEs with the real forward. pe mirrors the
-        # forward lowering's accumulation policy (f32 accumulation for
-        # bf16 operands outside AMP) so the replay is bit-identical.
-        amp_on = getattr(ctx.block.program, "_amp", False)
-        pe = (jnp.float32 if (not amp_on and x.dtype in (jnp.bfloat16,))
-              else None)
+        # replay the production forward dispatch (layout/impl/s2d as
+        # autotuned) under jax.vjp: XLA's conv transpose rules emit the
+        # native backprop convs in the same layout. pe stays None here
+        # even though the forward lowering uses f32 accumulation for
+        # bf16 operands outside AMP: lax.conv's TRANSPOSE rule rejects
+        # an f32 cotangent against bf16 operands (same limitation the
+        # forward's AMP comment records), so a pe-carrying replay cannot
+        # be differentiated at all. The MXU still accumulates in f32
+        # internally; only the replayed output's dtype differs, and the
+        # primal is dead code here (vjp keeps x/w as residuals).
 
         def f(x_, w_):
-            return conv2d_apply(x_, w_, s, p, d, groups, pe)
+            return conv2d_apply(x_, w_, s, p, d, groups, None)
         _, vjp = jax.vjp(f, x, w)
         dx, dw = vjp(dy.astype(x.dtype))
         if want_dx:

@@ -28,14 +28,18 @@ def cross_entropy(ctx):
     x = ctx.input("X")
     xd = raw_data(x)
     label = raw_data(ctx.input("Label"))
-    logx = jnp.log(jnp.clip(xd, 1e-15, 1.0))
+    # log/sum in f32 regardless of activation width (bf16 probabilities
+    # under pure AMP would lose the loss signal); output back in x dtype
+    x32 = xd.astype(jnp.float32)
+    logx = jnp.log(jnp.clip(x32, 1e-15, 1.0))
     if ctx.attr("soft_label", False):
-        loss = -jnp.sum(label.astype(xd.dtype) * logx, axis=-1, keepdims=True)
+        loss = -jnp.sum(label.astype(jnp.float32) * logx, axis=-1,
+                        keepdims=True)
     else:
         lab = label.astype(jnp.int32).reshape(label.shape[0])
         picked = jnp.take_along_axis(logx, lab[:, None], axis=-1)
         loss = -picked
-    ctx.set_output("Y", with_lod_of(x, loss))
+    ctx.set_output("Y", with_lod_of(x, loss.astype(xd.dtype)))
 
 
 @register_op("softmax_with_cross_entropy", infer_shape=_infer_loss_rowwise)
@@ -44,14 +48,17 @@ def softmax_with_cross_entropy(ctx):
     numerically-stable path (XLA fuses logsumexp into the matmul epilogue)."""
     logits = raw_data(ctx.input("Logits"))
     label = raw_data(ctx.input("Label"))
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ctx.set_output("Softmax", jnp.exp(logp))
+    # logsumexp in f32 (bf16 logits under pure AMP); outputs in the
+    # logits dtype to honor the declared var dtypes
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ctx.set_output("Softmax", jnp.exp(logp).astype(logits.dtype))
     if ctx.attr("soft_label", False):
-        loss = -jnp.sum(label.astype(logits.dtype) * logp, axis=-1, keepdims=True)
+        loss = -jnp.sum(label.astype(jnp.float32) * logp, axis=-1,
+                        keepdims=True)
     else:
         lab = label.astype(jnp.int32).reshape(label.shape[0])
         loss = -jnp.take_along_axis(logp, lab[:, None], axis=-1)
-    ctx.set_output("Loss", loss)
+    ctx.set_output("Loss", loss.astype(logits.dtype))
 
 
 @register_op("sigmoid_cross_entropy_with_logits", infer_shape=None)

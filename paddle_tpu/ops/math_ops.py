@@ -52,8 +52,10 @@ def mul(ctx):
     x2 = flatten_to_2d(x, xn)
     y2 = flatten_to_2d(y, yn)
     out = jnp.matmul(x2, y2, preferred_element_type=_acc_type(x))
-    if out.dtype != out_dtype:
-        out = out.astype(out_dtype)
+    # pure AMP: store the activation half-width (f32 MXU accumulation
+    # still happened via preferred_element_type)
+    out = out.astype(jnp.bfloat16 if amp.keep_bf16(ctx, out_dtype)
+                     else out_dtype)
     out = out.reshape(tuple(x.shape[:xn]) + tuple(y.shape[yn:]))
     ctx.set_output("Out", with_lod_of(x_v, out))
 
@@ -89,8 +91,8 @@ def matmul(ctx):
     if ctx.attr("transpose_Y", False):
         y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
     out = jnp.matmul(x, y, preferred_element_type=_acc_type(x))
-    if out.dtype != out_dtype:
-        out = out.astype(out_dtype)
+    out = out.astype(jnp.bfloat16 if amp.keep_bf16(ctx, out_dtype)
+                     else out_dtype)
     alpha = ctx.attr("alpha", 1.0)
     if alpha != 1.0:
         out = out * alpha

@@ -378,7 +378,9 @@ def conv2d(ctx):
     pe = (jnp.float32 if (not amp_on and x.dtype in (jnp.bfloat16,))
           else None)
     out = conv2d_apply(x, w, s, p, d, groups, pe)
-    ctx.set_output("Output", out.astype(out_dtype))
+    out = out.astype(jnp.bfloat16 if amp.keep_bf16(ctx, out_dtype)
+                     else out_dtype)
+    ctx.set_output("Output", out)
 
 
 @register_op("depthwise_conv2d", infer_shape=_infer_conv2d)
@@ -667,22 +669,25 @@ def batch_norm(ctx):
     caxis = 1 if (x.ndim == 4 and layout == "NCHW") else x.ndim - 1
     cshape[caxis] = x.shape[caxis]
 
+    # statistics always accumulate in f32: a bf16 mean over N*H*W
+    # elements (pure-AMP activations) loses most of its mantissa
+    xs = x.astype(jnp.float32) if x.dtype != jnp.float32 else x
     if is_test:
         use_mean, use_var = mean, var
         saved_mean, saved_var = mean, var
         new_mean, new_var = mean, var
     else:
-        bm = jnp.mean(x, axis=axes)
-        bv = jnp.var(x, axis=axes)
+        bm = jnp.mean(xs, axis=axes)
+        bv = jnp.var(xs, axis=axes)
         use_mean, use_var = bm, bv
         saved_mean = bm
         saved_var = 1.0 / jnp.sqrt(bv + eps)
         new_mean = momentum * mean + (1.0 - momentum) * bm
         new_var = momentum * var + (1.0 - momentum) * bv
     inv = 1.0 / jnp.sqrt(use_var + eps)
-    y = (x - use_mean.reshape(cshape)) * (inv * scale).reshape(cshape) \
+    y = (xs - use_mean.reshape(cshape)) * (inv * scale).reshape(cshape) \
         + bias.reshape(cshape)
-    ctx.set_output("Y", y)
+    ctx.set_output("Y", y.astype(x.dtype))
     ctx.set_output("MeanOut", new_mean)
     ctx.set_output("VarianceOut", new_var)
     ctx.set_output("SavedMean", saved_mean)

@@ -80,6 +80,51 @@ def test_amp_off_tpu_is_noop_without_force():
         assert "bf16" not in txt
 
 
+def test_pure_amp_bf16_activations_train():
+    """pure AMP keeps the activation stream bf16 end-to-end (conv out,
+    bn out) while params/optimizer/loss math stay f32, and a small
+    convnet still trains: loss finite and decreasing."""
+    amp.force(True)
+    try:
+        main, startup = pt.Program(), pt.Program()
+        pt.switch_main_program(main)
+        pt.switch_startup_program(startup)
+        img = layers.data("img", shape=[3, 8, 8], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        c = layers.conv2d(img, num_filters=8, filter_size=3, padding=1)
+        bn = layers.batch_norm(c)
+        act = layers.relu(bn)
+        pool = layers.pool2d(act, pool_size=8, pool_type="avg")
+        pred = layers.fc(pool, size=4, act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, label))
+        pt.Momentum(learning_rate=0.05, momentum=0.9).minimize(loss)
+        amp.enable(main, pure=True)
+
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor(pt.CPUPlace())
+            exe.run(startup)
+            rng = np.random.RandomState(0)
+            feed = {"img": rng.rand(16, 3, 8, 8).astype("float32"),
+                    "label": rng.randint(0, 4, (16, 1)).astype("int64")}
+            losses = []
+            for _ in range(12):
+                lv, cv, bv = exe.run(feed=feed,
+                                     fetch_list=[loss, c, bn],
+                                     return_numpy=False)
+                losses.append(float(np.asarray(lv, dtype=np.float32)))
+            import jax.numpy as jnp
+            assert cv.dtype == jnp.bfloat16, cv.dtype
+            assert bv.dtype == jnp.bfloat16, bv.dtype
+            # params stay f32 master copies
+            w = scope.find_var(main.global_block().all_parameters()[0].name)
+            assert np.asarray(w).dtype == np.float32
+        assert all(np.isfinite(losses)), losses
+        assert losses[-1] < losses[0], losses
+    finally:
+        amp.force(None)
+
+
 @pytest.mark.tpu
 def test_amp_bf16_on_device():
     """On a real accelerator the probe enables casts without force."""

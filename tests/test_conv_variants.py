@@ -63,6 +63,29 @@ def test_conv_variant_matches_default(env, monkeypatch, _baseline):
         np.testing.assert_allclose(ref, var, rtol=2e-4, atol=2e-5)
 
 
+def test_bf16_conv_grad_without_amp():
+    """bf16 operands OUTSIDE AMP replay the forward with f32 accumulation
+    (pe=f32), so the vjp cotangent must be fed in the replayed output's
+    dtype — regression: a bf16-cast cotangent crashed jax.vjp with a
+    dtype mismatch while lowering conv2d_grad."""
+    img = layers.data("img", shape=[3, 8, 8], dtype="bfloat16")
+    c = layers.conv2d(img, num_filters=4, filter_size=3, padding=1,
+                      param_attr=pt.ParamAttr(name="wbf.w"))
+    avg = layers.mean(layers.cast(c, "float32"))
+    pt.SGD(learning_rate=0.0).minimize(avg)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    import jax.numpy as jnp
+    loss, gw = exe.run(feed={"img": x.astype(jnp.bfloat16)},
+                       fetch_list=[avg, "wbf.w@GRAD"])
+    assert np.isfinite(np.asarray(loss, dtype=np.float32)).all()
+    gw = np.asarray(gw, dtype=np.float32)
+    assert gw.shape == (4, 3, 3, 3) and np.isfinite(gw).all()
+    assert np.abs(gw).max() > 0
+
+
 def test_s2d_gate_requires_exact_stem_shape(monkeypatch):
     """s2d must not trigger on non-stem convs (odd size / wrong kernel):
     the program still runs and matches the plain lowering."""

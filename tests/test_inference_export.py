@@ -27,6 +27,16 @@ def test_export_compiled_round_trip(tmp_path):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-6)
 
+    # pipelined serving path: R stacked requests, one device dispatch
+    stacked = np.stack([sample, sample * 0.5, sample * 2.0])
+    outs = model.run_many({"x": stacked})[0]
+    assert np.asarray(outs).shape == (3,) + np.asarray(want).shape
+    np.testing.assert_allclose(np.asarray(outs)[0], np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+    single = model.run({"x": sample * 2.0})[0]
+    np.testing.assert_allclose(np.asarray(outs)[2], np.asarray(single),
+                               rtol=1e-5, atol=1e-6)
+
 
 def test_c_abi_inference_entry_point(tmp_path):
     """Export a model, then run inference from a plain C program through
