@@ -774,25 +774,34 @@ def child_main(tag):
         wd.phase("probe", max(_remaining(), 1))
         try:
             import jax.numpy as jnp
-            n = 4096
+            n, iters = 4096, 16
             k1, k2 = jax.random.split(jax.random.PRNGKey(0))
             a = jax.random.normal(k1, (n, n), jnp.bfloat16)
             b = jax.random.normal(k2, (n, n), jnp.bfloat16)
 
             @jax.jit
             def mm_chain(a_, b_):
+                # c = c @ b chains the carry through every matmul: no
+                # perturbation op needed (the r4 probe's `a + c*1e-30`
+                # added an n^2 elementwise pass per iteration and halved
+                # the reported rate), and nothing can hoist or fold
                 def body(c, _):
-                    c = (a_ + c * 1e-30) @ b_
-                    return c, None
-                return jax.lax.scan(body, jnp.zeros_like(a_), None,
-                                    length=8)[0]
+                    c = jnp.dot(c, b_,
+                                preferred_element_type=jnp.float32)
+                    return c.astype(jnp.bfloat16), None
+                return jax.lax.scan(body, a_, None, length=iters)[0]
 
             # read back a 1x1 slice: still a true host-transfer sync over
             # the tunnel, without timing the full 33 MB result payload
             float(np.asarray(mm_chain(a, b)[:1, :1]).astype(np.float32))
-            t0 = time.perf_counter()
-            float(np.asarray(mm_chain(a, b)[:1, :1]).astype(np.float32))
-            dt = (time.perf_counter() - t0) / 8
+            dt = float("inf")
+            for _ in range(3 if _remaining() > 30 else 1):
+                t0 = time.perf_counter()
+                float(np.asarray(mm_chain(a, b)[:1, :1])
+                      .astype(np.float32))
+                dt = min(dt, (time.perf_counter() - t0) / iters)
+                if _remaining() < 15:
+                    break
             tflops = 2 * n ** 3 / dt / 1e12
             _log(tag, "probe matmul %dx%d: %.1f TFLOP/s (peak %.0f)"
                  % (n, n, tflops, peak / 1e12))

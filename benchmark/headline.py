@@ -1,0 +1,12 @@
+"""The bench headline configuration, shared by the perf harnesses.
+
+Single source of truth for the autotuned conv-lowering picks the r4
+headline run settled on (benchmark/results/bench_r4_v5e.json), so the
+decomposition/sweep harnesses measure the same lowering the headline
+reports. If the autotuner's winners change on a new device generation,
+this is the one place to update.
+"""
+
+HEADLINE_ENV = {"PADDLE_TPU_CONV_IMPL": "conv",
+                "PADDLE_TPU_CONV_LAYOUT": "nhwc",
+                "PADDLE_TPU_CONV_S2D": "1"}

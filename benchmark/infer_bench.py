@@ -88,8 +88,12 @@ def bench_one(batch, iters=8, windows=3, image_size=224, tmp=None,
                                    image_size).astype("float32")}
         t0 = time.perf_counter()
         staged = model.stage(stacked)  # host->device, timed separately
-        import jax
-        jax.block_until_ready(staged["img"])
+        # block_until_ready is NOT a true sync on the tunnelled device
+        # (bench.py's timing invariant): only a device->host read-back
+        # proves the transfer landed. Reduce on-device first so the
+        # read-back itself moves 4 bytes, not the staged batch.
+        import jax.numpy as jnp
+        float(np.asarray(jnp.sum(staged["img"][..., :1, :1, :1])))
         feed_s = time.perf_counter() - t0
         feed_mb = stacked["img"].nbytes / 1e6
 

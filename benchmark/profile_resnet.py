@@ -38,7 +38,7 @@ def build_step(batch, amp_on=True):
     avg = layers.mean(layers.cross_entropy(pred, label))
     pt.Momentum(learning_rate=0.1, momentum=0.9).minimize(avg)
     if amp_on:
-        pt.amp.enable(main)
+        pt.amp.enable(main, pure=(amp_on == "pure"))
     return main, startup, avg
 
 
@@ -71,27 +71,52 @@ def lower_step(batch, amp_on=True):
                .lower(state, feed, jax.random.PRNGKey(0)).compile())
 
 
+_DTYPE_BYTES = {"bf16": 2, "f16": 2, "f32": 4, "f64": 8, "s32": 4,
+                "u32": 4, "s64": 8, "u64": 8, "s8": 1, "u8": 1,
+                "pred": 1, "s16": 2, "u16": 2}
+
+
 def hlo_census(compiled):
-    """Optimized-HLO op census: count + total shape-bytes per op kind."""
+    """Optimized-HLO op census: count + total output-bytes per op kind.
+
+    The byte attribution is the *output shape* of each instruction — a
+    lower bound on what the op moves (reads not counted) but enough to
+    rank which categories dominate HBM traffic.
+    """
     text = compiled.as_text()
     census = collections.Counter()
+    bytes_by_kind = collections.Counter()
     conv_lines, transpose_bytes = [], 0
+    in_entry = False
     for line in text.splitlines():
+        if line.startswith("ENTRY "):
+            in_entry = True
+            continue
+        if line and not line[0].isspace():
+            # a new (fused/called) computation header leaves the entry body
+            in_entry = in_entry and not line.startswith("%")
+            continue
         m = re.search(r"=\s+\S+\s+(\w[\w-]*)\(", line)
         if not m:
             continue
         kind = m.group(1)
         census[kind] += 1
+        if not in_entry:
+            # fusion-internal instructions are not materialized in HBM;
+            # only entry-computation outputs count as traffic
+            continue
+        sm = re.match(r"\s*\S+\s+=\s+\(?(\w+)\[([\d,]*)\]", line)
+        if sm and sm.group(1) in _DTYPE_BYTES:
+            n = 1
+            for d in filter(None, sm.group(2).split(",")):
+                n *= int(d)
+            nbytes = n * _DTYPE_BYTES[sm.group(1)]
+            bytes_by_kind[kind] += nbytes
+            if kind == "transpose":
+                transpose_bytes += nbytes
         if kind == "convolution":
             conv_lines.append(line.strip()[:160])
-        if kind == "transpose":
-            sm = re.match(r"\s*\S+\s+=\s+(\w+)\[([\d,]*)\]", line)
-            if sm and sm.group(2):
-                n = 1
-                for d in sm.group(2).split(","):
-                    n *= int(d)
-                transpose_bytes += n * (2 if "bf16" in sm.group(1) else 4)
-    return census, conv_lines, transpose_bytes
+    return census, conv_lines, transpose_bytes, bytes_by_kind
 
 
 def main(argv=None):
@@ -104,7 +129,8 @@ def main(argv=None):
     amp_on = True
     if any(a.startswith("--amp") for a in argv):
         a = [a for a in argv if a.startswith("--amp")][0]
-        amp_on = not a.endswith("=0")
+        amp_on = ("pure" if a.endswith("=pure")
+                  else not a.endswith("=0"))
         argv = [x for x in argv if not x.startswith("--amp")]
     batch = int(argv[0]) if argv else 32
 
@@ -113,7 +139,7 @@ def main(argv=None):
     flops = float(ca.get("flops", 0.0))
     bytes_acc = float(ca.get("bytes accessed", 0.0))
     analytic = 3 * 3.8e9 * batch  # 3x fwd, 3.8 GFLOP/img fwd @224
-    census, conv_lines, transpose_bytes = hlo_census(compiled)
+    census, conv_lines, transpose_bytes, bytes_by_kind = hlo_census(compiled)
     try:
         mem = compiled.memory_analysis()
         peak_bytes = int(getattr(mem, "temp_size_in_bytes", 0)
@@ -134,6 +160,8 @@ def main(argv=None):
         "n_convolutions": census.get("convolution", 0),
         "n_transposes": census.get("transpose", 0),
         "transpose_bytes": transpose_bytes,
+        "output_bytes_by_kind_top": {
+            k: int(v) for k, v in bytes_by_kind.most_common(12)},
         "sample_conv_hlo": conv_lines[:4],
     }
     line = json.dumps(report, indent=2)

@@ -54,15 +54,18 @@ def elementwise(ctx, fn):
     scale = ctx.attr("scale")  # fused scale some paddle elementwise ops carry
     if scale is not None and scale != 1.0:
         out = out * scale
-    if out.dtype != xd.dtype:
+    import jax.numpy as jnp
+    if (out.dtype != jnp.bfloat16
+            and jnp.bfloat16 in (xd.dtype, yb.dtype)):
         # pure AMP: a bf16 activation combined with an f32 param (bias
         # add, bn-style scale) promotes to f32 — write the result back
         # half-width so the activation stream stays bf16 (compute above
-        # already happened at the promoted precision)
+        # already happened at the promoted precision). Either operand
+        # can be the bf16 activation: Y is one for e.g. residual adds
+        # emitted as add(f32_branch, bf16_branch)
         from .. import amp
-        import jax.numpy as jnp
-        if xd.dtype == jnp.bfloat16 and amp.keep_bf16(ctx):
-            out = out.astype(xd.dtype)
+        if amp.keep_bf16(ctx):
+            out = out.astype(jnp.bfloat16)
     ctx.set_output("Out", with_lod_of(x, out))
 
 

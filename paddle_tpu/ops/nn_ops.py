@@ -669,9 +669,11 @@ def batch_norm(ctx):
     caxis = 1 if (x.ndim == 4 and layout == "NCHW") else x.ndim - 1
     cshape[caxis] = x.shape[caxis]
 
-    # statistics always accumulate in f32: a bf16 mean over N*H*W
-    # elements (pure-AMP activations) loses most of its mantissa
-    xs = x.astype(jnp.float32) if x.dtype != jnp.float32 else x
+    # statistics always accumulate in >=f32: a bf16 mean over N*H*W
+    # elements (pure-AMP activations) loses most of its mantissa. Only
+    # the narrow dtypes are widened — f64 input stays f64 end-to-end
+    xs = (x.astype(jnp.float32)
+          if x.dtype in (jnp.bfloat16, jnp.float16) else x)
     if is_test:
         use_mean, use_var = mean, var
         saved_mean, saved_var = mean, var

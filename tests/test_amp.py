@@ -125,6 +125,37 @@ def test_pure_amp_bf16_activations_train():
         amp.force(None)
 
 
+def test_pure_amp_keeps_bf16_when_bf16_operand_is_y():
+    """elementwise_add(f32_branch, bf16_activation) must stay bf16 under
+    pure AMP: the half-width write-back keys on EITHER operand being the
+    bf16 activation, not just X (r4 review finding — an f32 X silently
+    widened the whole downstream activation stream)."""
+    amp.force(True)
+    try:
+        main, startup = pt.Program(), pt.Program()
+        pt.switch_main_program(main)
+        pt.switch_startup_program(startup)
+        img = layers.data("img", shape=[3, 8, 8], dtype="float32")
+        # X = raw f32 feed, Y = bf16 conv activation
+        c = layers.conv2d(img, num_filters=3, filter_size=3, padding=1)
+        s = layers.elementwise_add(img, c)
+        amp.enable(main, pure=True)
+
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe = pt.Executor(pt.CPUPlace())
+            exe.run(startup)
+            rng = np.random.RandomState(0)
+            sv, cv = exe.run(
+                feed={"img": rng.rand(2, 3, 8, 8).astype("float32")},
+                fetch_list=[s, c], return_numpy=False)
+            import jax.numpy as jnp
+            assert cv.dtype == jnp.bfloat16, cv.dtype
+            assert sv.dtype == jnp.bfloat16, sv.dtype
+    finally:
+        amp.force(None)
+
+
 @pytest.mark.tpu
 def test_amp_bf16_on_device():
     """On a real accelerator the probe enables casts without force."""
