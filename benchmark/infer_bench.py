@@ -86,14 +86,23 @@ def bench_one(batch, iters=8, windows=3, image_size=224, tmp=None,
 
         stacked = {"img": rng.rand(pipeline, batch, 3, image_size,
                                    image_size).astype("float32")}
-        t0 = time.perf_counter()
-        staged = model.stage(stacked)  # host->device, timed separately
         # block_until_ready is NOT a true sync on the tunnelled device
         # (bench.py's timing invariant): only a device->host read-back
         # proves the transfer landed. Reduce on-device first so the
-        # read-back itself moves 4 bytes, not the staged batch.
+        # read-back itself moves 4 bytes, not the staged batch. Warm
+        # pass first: the slice+sum sync program's trace/compile and
+        # stage()'s own dispatch path must not land inside the timed
+        # window (stage of a NUMPY feed re-transfers every call, so the
+        # second, timed stage still measures a real host->device copy).
         import jax.numpy as jnp
-        float(np.asarray(jnp.sum(staged["img"][..., :1, :1, :1])))
+
+        def _staged_sync(s):
+            float(np.asarray(jnp.sum(s["img"][..., :1, :1, :1])))
+
+        _staged_sync(model.stage(stacked))
+        t0 = time.perf_counter()
+        staged = model.stage(stacked)  # host->device, timed
+        _staged_sync(staged)
         feed_s = time.perf_counter() - t0
         feed_mb = stacked["img"].nbytes / 1e6
 

@@ -15,6 +15,7 @@ from .layer_helper import LayerHelper
 __all__ = [
     "prior_box", "iou_similarity", "box_coder", "bipartite_match",
     "target_assign", "mine_hard_examples", "multiclass_nms",
+    "multiclass_nms_padded",
     "detection_output", "detection_map", "ssd_loss", "multi_box_head",
     "roi_pool",
 ]
@@ -127,20 +128,46 @@ def multiclass_nms(bboxes, scores, background_label=0, score_threshold=0.01,
     return out
 
 
+def multiclass_nms_padded(bboxes, scores, background_label=0,
+                          score_threshold=0.01, nms_top_k=400,
+                          nms_threshold=0.3, keep_top_k=200, name=None):
+    """Device-native fixed-capacity NMS: (out [N, keep_top_k, 6],
+    valid_count [N]) — compiles into exported inference programs (the
+    TPU-native serving form of multiclass_nms; see the op docstring)."""
+    helper = LayerHelper("multiclass_nms_padded", **locals())
+    out = helper.create_variable_for_type_inference("float32")
+    valid = helper.create_variable_for_type_inference("int32")
+    out.stop_gradient = valid.stop_gradient = True
+    helper.append_op(type="multiclass_nms_padded",
+                     inputs={"BBoxes": [bboxes], "Scores": [scores]},
+                     outputs={"Out": [out], "ValidCount": [valid]},
+                     attrs={"background_label": background_label,
+                            "score_threshold": score_threshold,
+                            "nms_top_k": nms_top_k,
+                            "nms_threshold": nms_threshold,
+                            "keep_top_k": keep_top_k})
+    return out, valid
+
+
 def detection_output(loc, scores, prior_box, prior_box_var,
                      background_label=0, nms_threshold=0.3, nms_top_k=400,
-                     keep_top_k=200, score_threshold=0.01, name=None):
-    """Decode + per-class NMS. reference: layers/detection.py:46."""
+                     keep_top_k=200, score_threshold=0.01, name=None,
+                     padded=False):
+    """Decode + per-class NMS. reference: layers/detection.py:46.
+
+    ``padded=True`` routes to the device-native fixed-capacity NMS and
+    returns (out, valid_count) — the jittable/exportable serving path."""
     from . import nn as _nn
     from . import tensor as _tensor
     decoded = box_coder(prior_box, prior_box_var, loc,
                         code_type="decode_center_size")
     scores_t = _nn.transpose(scores, perm=[0, 2, 1])  # [N, C, M]
-    return multiclass_nms(decoded, scores_t,
-                          background_label=background_label,
-                          score_threshold=score_threshold,
-                          nms_top_k=nms_top_k, nms_threshold=nms_threshold,
-                          keep_top_k=keep_top_k)
+    nms = multiclass_nms_padded if padded else multiclass_nms
+    return nms(decoded, scores_t,
+               background_label=background_label,
+               score_threshold=score_threshold,
+               nms_top_k=nms_top_k, nms_threshold=nms_threshold,
+               keep_top_k=keep_top_k)
 
 
 def detection_map(detect_res, label, overlap_threshold=0.5,
@@ -184,12 +211,15 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
     # conf: [N, M, C]; cross entropy per prior
     conf_sm = _nn.softmax(confidence)
     cls_loss = _cross_entropy_3d(conf_sm, gt_label_t)
-    neg_indices, updated_match = mine_hard_examples(
-        cls_loss, matched_indices, neg_pos_ratio)
-    # 3. final targets incl. mined negatives
-    conf_target, conf_weight = target_assign(
-        gt_label, matched_indices, negative_indices=neg_indices,
-        mismatch_value=background_label)
+    # 3. hard-negative mining as a dense device mask (r4): same weights
+    # the host mine_hard_examples + target_assign(NegIndices) pair
+    # produces, but fixed-shape — the whole ssd_loss jit-compiles
+    # instead of segmenting around host ops every step
+    conf_weight = _ssd_conf_weight(cls_loss, matched_indices,
+                                   neg_pos_ratio)
+    # negatives carry the background label either way, so the plain
+    # match-gather target (already computed) IS the final conf target
+    conf_target = gt_label_t
     enc = box_coder(prior_box,
                     prior_box_var if prior_box_var is not None else
                     _tensor.ones([prior_box.shape[0] or 1, 4], "float32"),
@@ -207,6 +237,18 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
         _nn.scale(loc_l, scale=loc_loss_weight),
         _nn.scale(conf_l, scale=conf_loss_weight))
     return loss
+
+
+def _ssd_conf_weight(cls_loss, match_indices, neg_pos_ratio):
+    helper = LayerHelper("ssd_hard_neg_mask")
+    w = helper.create_variable_for_type_inference("float32")
+    w.stop_gradient = True
+    helper.append_op(type="ssd_hard_neg_mask",
+                     inputs={"ClsLoss": [cls_loss],
+                             "MatchIndices": [match_indices]},
+                     outputs={"ConfWeight": [w]},
+                     attrs={"neg_pos_ratio": neg_pos_ratio})
+    return w
 
 
 def _smooth_l1(x):

@@ -56,7 +56,8 @@ def elementwise(ctx, fn):
         out = out * scale
     import jax.numpy as jnp
     if (out.dtype != jnp.bfloat16
-            and jnp.bfloat16 in (xd.dtype, yb.dtype)):
+            and jnp.bfloat16 in (xd.dtype, yb.dtype)
+            and jnp.float64 not in (xd.dtype, yb.dtype)):
         # pure AMP: a bf16 activation combined with an f32 param (bias
         # add, bn-style scale) promotes to f32 — write the result back
         # half-width so the activation stream stays bf16 (compute above

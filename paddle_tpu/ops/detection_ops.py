@@ -6,9 +6,14 @@ detection_output,detection_map,roi_pool}_op.* and the legacy gserver
 MultiBoxLossLayer/DetectionOutputLayer/ROIPoolLayer.
 
 Static-shape ops (prior_box, iou_similarity, box_coder, roi_pool) are pure
-jax; matching/NMS/mAP have data-dependent outputs (LoD results) and run as
-host ops on the eager path, like the reference's CPU-only kernels
-(multiclass_nms_op.cc is CPU-only in the reference too).
+jax. The SSD *training* chain (bipartite_match, target_assign without
+NegIndices, ssd_hard_neg_mask) is device-native too — fixed-capacity
+lowerings padded from the LoD's feed-time max_lens, so ssd_loss compiles
+into one XLA program. Only the ops whose *outputs* are data-dependent
+LoD results (multiclass_nms, detection_map, mine_hard_examples, and
+target_assign when fed ragged NegIndices) run as host ops on the eager
+path, like the reference's CPU-only kernels (multiclass_nms_op.cc is
+CPU-only in the reference too).
 """
 from __future__ import annotations
 
@@ -150,57 +155,128 @@ def box_coder(ctx):
     ctx.set_output("OutputBox", with_lod_of(target_v, out))
 
 
-@register_op("bipartite_match", host=True, no_gradient=True)
+@register_op("bipartite_match", no_gradient=True)
 def bipartite_match(ctx):
     """Greedy bipartite matching per batch item (LoD level groups rows).
+
+    Device-native (r4): the ragged DistMat is scattered into a
+    fixed-capacity [B, Rmax, M] block padded with -inf (Rmax from the
+    LoD's feed-time max_lens when available, else the total row count),
+    and the inherently sequential greedy argmax loop runs as a
+    lax.scan of min(Rmax, M) masked iterations — so an SSD matching
+    step compiles into the training program instead of bouncing to the
+    host each step.
     reference: operators/bipartite_match_op.cc BipartiteMatchKernel."""
     dist_v = ctx.input("DistMat")
-    dist = np.asarray(raw_data(dist_v))
+    dist = raw_data(dist_v)
     match_type = str(ctx.attr("match_type", "bipartite"))
     overlap_threshold = float(ctx.attr("dist_threshold", 0.5))
+    total, M = dist.shape
     if isinstance(dist_v, TracedLoD) and dist_v.lod:
-        offs = np.asarray(dist_v.lod[-1])
+        offs = dist_v.lod[-1].astype(jnp.int32)
+        B = int(offs.shape[0]) - 1
+        ml = dist_v.max_lens[-1] if dist_v.max_lens else None
+        rmax = int(ml) if ml else total
+        # scatter ragged rows into [B, Rmax, M]; -inf padding can never
+        # win an argmax, so empty/short segments stay unmatched (-1)
+        seg = jnp.clip(jnp.searchsorted(offs, jnp.arange(total),
+                                        side="right") - 1, 0, max(B - 1, 0))
+        pos = jnp.arange(total) - offs[seg]
+        padded = jnp.full((B, rmax, M), -jnp.inf, dist.dtype)
+        padded = padded.at[seg, pos].set(dist)
     else:
-        offs = np.asarray([0, dist.shape[0]])
-    B = len(offs) - 1
-    M = dist.shape[1]
-    match_idx = np.full((B, M), -1, np.int32)
-    match_dist = np.zeros((B, M), np.float32)
-    for b in range(B):
-        d = dist[offs[b]:offs[b + 1]].copy()   # [rows, M]
-        if d.size == 0:
-            continue
-        # greedy global-max assignment
-        work = d.copy()
-        n_rows = work.shape[0]
-        for _ in range(min(n_rows, M)):
-            r, c = np.unravel_index(np.argmax(work), work.shape)
-            if work[r, c] <= 0:
-                break
-            match_idx[b, c] = r
-            match_dist[b, c] = d[r, c]
-            work[r, :] = -1
-            work[:, c] = -1
+        B, rmax = 1, total
+        padded = dist[None]
+
+    n_iter = min(rmax, M)
+
+    def match_one(d):
+        def body(carry, _):
+            work, midx, mdist = carry
+            flat = jnp.argmax(work)
+            r, c = flat // M, flat % M
+            v = work[r, c]
+            take = v > 0  # the reference stops at the first non-positive
+            midx = jnp.where(take, midx.at[c].set(r.astype(jnp.int32)),
+                             midx)
+            mdist = jnp.where(
+                take, mdist.at[c].set(v.astype(jnp.float32)), mdist)
+            invalidated = work.at[r, :].set(-jnp.inf).at[:, c].set(-jnp.inf)
+            work = jnp.where(take, invalidated, work)
+            return (work, midx, mdist), None
+
+        init = (d, jnp.full((M,), -1, jnp.int32),
+                jnp.zeros((M,), jnp.float32))
+        (_, midx, mdist), _ = jax.lax.scan(body, init, None, length=n_iter)
         if match_type == "per_prediction":
-            for c in range(M):
-                if match_idx[b, c] == -1:
-                    r = int(np.argmax(d[:, c]))
-                    if d[r, c] >= overlap_threshold:
-                        match_idx[b, c] = r
-                        match_dist[b, c] = d[r, c]
-    ctx.set_output("ColToRowMatchIndices", jnp.asarray(match_idx))
-    ctx.set_output("ColToRowMatchDist", jnp.asarray(match_dist))
+            # unmatched cols fall back to their best row over the FULL
+            # (un-invalidated) matrix when it clears the threshold
+            col_best = jnp.argmax(d, axis=0).astype(jnp.int32)
+            col_val = jnp.max(d, axis=0)
+            take = (midx < 0) & (col_val >= overlap_threshold)
+            midx = jnp.where(take, col_best, midx)
+            mdist = jnp.where(take, col_val.astype(jnp.float32), mdist)
+        return midx, mdist
+
+    if total == 0:
+        midx = jnp.full((B, M), -1, jnp.int32)
+        mdist = jnp.zeros((B, M), jnp.float32)
+    else:
+        midx, mdist = jax.vmap(match_one)(padded)
+    ctx.set_output("ColToRowMatchIndices", midx)
+    ctx.set_output("ColToRowMatchDist", mdist)
 
 
-@register_op("target_assign", host=True, no_gradient=True)
+def _target_assign_is_host(op):
+    # ragged NegIndices (from host mine_hard_examples) force the eager
+    # path; the plain match-gather form lowers to device code
+    return bool(op.input("NegIndices"))
+
+
+@register_op("target_assign", host=_target_assign_is_host,
+             no_gradient=True)
 def target_assign(ctx):
     """Scatter per-gt rows to per-prior slots by match indices.
+
+    Device-native (r4) when NegIndices is absent: a pure batched gather
+    ``out[b, m] = x[offs[b] + match[b, m]]`` masked by ``match >= 0`` —
+    jittable with fixed shapes. With ragged NegIndices the op stays on
+    the host path (the jit-compiled SSD loss uses ssd_hard_neg_mask
+    instead, which produces the same weights as a dense mask).
     reference: operators/target_assign_op.h."""
     x_v = ctx.input("X")
-    x = np.asarray(raw_data(x_v))                 # [total_gt, K]
-    match = np.asarray(raw_data(ctx.input("MatchIndices")))  # [B, M]
     neg_v = ctx.input("NegIndices")
     mismatch_value = ctx.attr("mismatch_value", 0)
+    if neg_v is None:
+        x = raw_data(x_v)
+        match = raw_data(ctx.input("MatchIndices"))       # [B, M]
+        offs = (x_v.lod[-1].astype(jnp.int32)
+                if isinstance(x_v, TracedLoD) and x_v.lod
+                else jnp.asarray([0, x.shape[0]], jnp.int32))
+        B, M = match.shape
+        per_prior = (x.ndim == 3)   # [total_gt, M, K] (encoded loc)
+        K = x.shape[-1] if x.ndim > 1 else 1
+        x2 = x if per_prior else x.reshape(x.shape[0], K)
+        if int(x2.shape[0]) == 0:
+            # an all-background batch (zero gt rows anywhere): every
+            # match is -1, so the result is all-mismatch with 0 weights
+            out = jnp.full((B, M, K), mismatch_value, x2.dtype)
+            ctx.set_output("Out", out)
+            ctx.set_output("OutWeight", jnp.zeros((B, M, 1), jnp.float32))
+            return
+        total = int(x2.shape[0])
+        idx = jnp.clip(offs[:B, None] + jnp.clip(match, 0), 0, total - 1)
+        gathered = (x2[idx, jnp.arange(M)[None, :]] if per_prior
+                    else x2[idx])                         # [B, M, K]
+        mask = (match >= 0)[..., None]
+        out = jnp.where(mask, gathered,
+                        jnp.asarray(mismatch_value, x2.dtype))
+        wt = mask.astype(jnp.float32)
+        ctx.set_output("Out", out)
+        ctx.set_output("OutWeight", wt)
+        return
+    x = np.asarray(raw_data(x_v))                 # [total_gt, K]
+    match = np.asarray(raw_data(ctx.input("MatchIndices")))  # [B, M]
     offs = np.asarray(x_v.lod[-1]) if isinstance(x_v, TracedLoD) and x_v.lod \
         else np.asarray([0, x.shape[0]])
     B, M = match.shape
@@ -217,14 +293,13 @@ def target_assign(ctx):
                 out[b, m] = x2[offs[b] + r, m] if per_prior \
                     else x2[offs[b] + r]
                 wt[b, m] = 1.0
-    if neg_v is not None:
-        neg = np.asarray(raw_data(neg_v)).reshape(-1)
-        noffs = np.asarray(neg_v.lod[-1]) if isinstance(neg_v, TracedLoD) \
-            and neg_v.lod else np.asarray([0, len(neg)])
-        for b in range(min(B, len(noffs) - 1)):
-            for idx in neg[noffs[b]:noffs[b + 1]]:
-                out[b, int(idx)] = mismatch_value
-                wt[b, int(idx)] = 1.0
+    neg = np.asarray(raw_data(neg_v)).reshape(-1)
+    noffs = np.asarray(neg_v.lod[-1]) if isinstance(neg_v, TracedLoD) \
+        and neg_v.lod else np.asarray([0, len(neg)])
+    for b in range(min(B, len(noffs) - 1)):
+        for idx in neg[noffs[b]:noffs[b + 1]]:
+            out[b, int(idx)] = mismatch_value
+            wt[b, int(idx)] = 1.0
     ctx.set_output("Out", jnp.asarray(out))
     ctx.set_output("OutWeight", jnp.asarray(wt))
 
@@ -252,6 +327,110 @@ def mine_hard_examples(ctx):
         jnp.asarray(np.asarray(neg_rows, np.int32).reshape(-1, 1)),
         (jnp.asarray(noffs),)))
     ctx.set_output("UpdatedMatchIndices", jnp.asarray(upd))
+
+
+@register_op("ssd_hard_neg_mask", no_gradient=True)
+def ssd_hard_neg_mask(ctx):
+    """Dense device-native form of max-negative hard mining: the conf
+    weight ``(matched | mined-negative)`` as a [B, M, 1] f32 mask.
+
+    Produces exactly the OutWeight that host mine_hard_examples +
+    target_assign(NegIndices) compose — ranks negative candidates by
+    classification loss (stable argsort, ties keep prior order like the
+    reference's stable std::sort) and keeps the top
+    ``min(M - n_pos, max(1, n_pos) * neg_pos_ratio)`` per image — but
+    with a fixed output shape, so the whole SSD loss jit-compiles.
+    reference: operators/mine_hard_examples_op.cc (mining math) +
+    operators/target_assign_op.h (weight semantics)."""
+    cls_loss = raw_data(ctx.input("ClsLoss"))
+    match = raw_data(ctx.input("MatchIndices"))         # [B, M]
+    ratio = float(ctx.attr("neg_pos_ratio", 3.0))
+    B, M = match.shape
+    loss2 = cls_loss.reshape(B, M)
+    neg_cand = match < 0
+    masked = jnp.where(neg_cand, loss2.astype(jnp.float32), -jnp.inf)
+    order = jnp.argsort(-masked, axis=1, stable=True)   # loss desc
+    rank = jnp.zeros((B, M), jnp.int32).at[
+        jnp.arange(B)[:, None], order].set(
+        jnp.broadcast_to(jnp.arange(M, dtype=jnp.int32)[None, :], (B, M)))
+    n_pos = jnp.sum((match >= 0).astype(jnp.int32), axis=1)       # [B]
+    n_neg = jnp.minimum(
+        M - n_pos,
+        (jnp.maximum(n_pos, 1).astype(jnp.float32) * ratio)
+        .astype(jnp.int32))
+    neg_sel = neg_cand & (rank < n_neg[:, None])
+    w = ((match >= 0) | neg_sel).astype(jnp.float32)[..., None]
+    ctx.set_output("ConfWeight", w)
+
+
+@register_op("multiclass_nms_padded", no_gradient=True)
+def multiclass_nms_padded(ctx):
+    """Fixed-capacity device NMS: Out [B, keep_top_k, 6] rows
+    [label, score, x1, y1, x2, y2] sorted by score, zero-padded past
+    ValidCount [B].
+
+    The TPU-native serving contract for the reference's multiclass_nms
+    (operators/multiclass_nms_op.cc): same per-class greedy suppression
+    and cross-class cap, but with static shapes so it compiles into the
+    exported inference program (the analog of TF's combined NMS). The
+    LoD-output multiclass_nms op remains for exact API parity; this op
+    is what detection_output(padded=True) uses.
+
+    Per class: top nms_top_k candidates by score, then a lax.scan over
+    them keeps box i iff no higher-scored kept box overlaps it beyond
+    nms_threshold — identical to the reference's sorted greedy loop.
+    """
+    bboxes = raw_data(ctx.input("BBoxes"))   # [B, M, 4]
+    scores = raw_data(ctx.input("Scores"))   # [B, C, M]
+    bg = int(ctx.attr("background_label", 0))
+    score_threshold = float(ctx.attr("score_threshold", 0.01))
+    nms_threshold = float(ctx.attr("nms_threshold", 0.3))
+    nms_top_k = int(ctx.attr("nms_top_k", 400))
+    keep_top_k = int(ctx.attr("keep_top_k", 200))
+    B, C, M = scores.shape
+    k = min(nms_top_k if nms_top_k > 0 else M, M)
+    # the serving contract is FIXED [B, keep_top_k, 6] regardless of
+    # C/M: select min(cap, C*k) real candidates, zero-pad the rest
+    cap = keep_top_k if keep_top_k > 0 else C * k
+    sel = min(cap, C * k)
+
+    def nms_class(boxes, sc):
+        # boxes [M, 4], sc [M] -> (kept mask [k], scores [k], idx [k])
+        masked = jnp.where(sc > score_threshold, sc, -jnp.inf)
+        val, idx = jax.lax.top_k(masked, k)
+        bsel = boxes[idx]
+        iou = _iou_matrix(bsel, bsel)            # [k, k]
+        ar = jnp.arange(k)
+
+        def body(keep, i):
+            earlier = keep & (ar < i)
+            sup = jnp.any(earlier & (iou[i] > nms_threshold))
+            return keep.at[i].set(~sup & jnp.isfinite(val[i])), None
+
+        keep, _ = jax.lax.scan(body, jnp.zeros((k,), bool), ar)
+        return keep, val, idx
+
+    def one_image(boxes, sc):
+        # vmap over classes; background and sub-threshold entries are
+        # masked to -inf so they can't reach the cross-class top-k
+        keep, val, idx = jax.vmap(lambda s: nms_class(boxes, s))(sc)
+        cls_ok = (jnp.arange(C) != bg)[:, None]
+        flat_score = jnp.where(keep & cls_ok & jnp.isfinite(val),
+                               val, -jnp.inf).reshape(-1)   # [C*k]
+        top_val, top_i = jax.lax.top_k(flat_score, sel)
+        label = (top_i // k).astype(jnp.float32)
+        box = boxes[idx.reshape(-1)[top_i]]
+        valid = top_val > -jnp.inf
+        rows = jnp.concatenate(
+            [label[:, None], top_val[:, None], box], axis=1)
+        rows = jnp.where(valid[:, None], rows, 0.0)
+        rows = jnp.pad(rows.astype(jnp.float32),
+                       ((0, cap - sel), (0, 0)))
+        return rows, jnp.sum(valid.astype(jnp.int32))
+
+    out, n = jax.vmap(one_image)(bboxes, scores)
+    ctx.set_output("Out", out)
+    ctx.set_output("ValidCount", n)
 
 
 def _nms_single(boxes, scores, thresh, top_k):
