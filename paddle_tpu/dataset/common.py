@@ -7,9 +7,15 @@ helpers).
 This environment has no network egress, so every dataset module generates a
 *deterministic synthetic* corpus with the exact field types/shapes/vocab
 structure of the real one (seeded per dataset). When the real files are
-already present in the cache dir (placed there out of band), they are used
-instead where a parser exists; otherwise the synthetic generator is the
-source of truth for tests and benchmarks.
+already present in the cache dir (placed there out of band), they are
+parsed instead — every module carries a real-format parser matching the
+reference's pipeline (mnist idx, cifar pickle-tar, uci_housing text,
+imikolov ptb tgz, imdb aclImdb tar, movielens ml-1m zip, conll05
+words/props gz, wmt14/wmt16 tgz, sentiment movie_reviews zip, flowers
+jpg-tgz + .mat, voc2012 tar, mq2007 extracted LETOR text; each parser
+is exercised by a real-format fixture test in
+tests/test_data_pipeline.py). Only without the files is the synthetic
+generator the source of truth for tests and benchmarks.
 """
 from __future__ import annotations
 

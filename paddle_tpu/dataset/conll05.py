@@ -1,13 +1,140 @@
 """CoNLL-2005 SRL. reference: python/paddle/v2/dataset/conll05.py — rows of
 (word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, verb_ids, mark, label_ids)
-— 8 input sequences + BIO label sequence; get_dict()/get_embedding()."""
+— 8 input sequences + BIO label sequence; get_dict()/get_embedding().
+
+Real-data path: when ``wordDict.txt / verbDict.txt / targetDict.txt``
+and ``conll05st-tests.tar.gz`` (the files the reference's download()
+caches) are present under ``<data_home>/conll05/``, they are parsed
+with the reference's exact pipeline — dict files line-number-indexed,
+the label dict built as B-/I- pairs per tag plus O (tags iterated in
+sorted order; the reference iterates a set, i.e. arbitrary order), the
+props-file span notation converted to BIO, predicate context ±2 words
+broadcast over the sentence, and the 5-token mark window. Like the
+reference (whose training set is not public), train() reads the same
+test.wsj corpus when real data is present. get_embedding() keeps the
+array contract (the reference returns the raw downloaded file path),
+sized to the active word dict."""
 from __future__ import annotations
+
+import gzip
+import tarfile
 
 import numpy as np
 
 from . import common
 
 __all__ = ["get_dict", "get_embedding", "test", "train"]
+
+UNK_IDX = 0
+
+_DATA_TAR = "conll05st-tests.tar.gz"
+_WORDS_MEMBER = "conll05st-release/test.wsj/words/test.wsj.words.gz"
+_PROPS_MEMBER = "conll05st-release/test.wsj/props/test.wsj.props.gz"
+
+
+def _real_files():
+    files = {n: common.cached_file("conll05", n) for n in
+             ("wordDict.txt", "verbDict.txt", "targetDict.txt", _DATA_TAR)}
+    return files if all(files.values()) else None
+
+
+def _load_dict(path):
+    with open(path) as f:
+        return {l.strip(): i for i, l in enumerate(f)}
+
+
+def _load_label_dict(path):
+    tags = set()
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("B-") or line.startswith("I-"):
+                tags.add(line[2:])
+    d = {}
+    for tag in sorted(tags):
+        d["B-" + tag] = len(d)
+        d["I-" + tag] = len(d)
+    d["O"] = len(d)
+    return d
+
+
+def _corpus_reader(tar_path):
+    """Yield (sentence_words, predicate, BIO_labels) per predicate, the
+    reference's span->BIO conversion verbatim."""
+    def gen():
+        with tarfile.open(tar_path) as tf:
+            words_file = gzip.GzipFile(
+                fileobj=tf.extractfile(_WORDS_MEMBER))
+            props_file = gzip.GzipFile(
+                fileobj=tf.extractfile(_PROPS_MEMBER))
+            sentences, one_seg = [], []
+            for word, label in zip(words_file, props_file):
+                word = word.decode().strip()
+                label = label.decode().strip().split()
+                if not label:   # end of sentence
+                    labels = [[x[i] for x in one_seg]
+                              for i in range(len(one_seg[0]))] \
+                        if one_seg else []
+                    if labels:
+                        verb_list = [x for x in labels[0] if x != "-"]
+                        for i, lbl in enumerate(labels[1:]):
+                            cur_tag, in_bracket, seq = "O", False, []
+                            for l in lbl:
+                                if l == "*" and not in_bracket:
+                                    seq.append("O")
+                                elif l == "*" and in_bracket:
+                                    seq.append("I-" + cur_tag)
+                                elif l == "*)":
+                                    seq.append("I-" + cur_tag)
+                                    in_bracket = False
+                                elif "(" in l and ")" in l:
+                                    cur_tag = l[1:l.find("*")]
+                                    seq.append("B-" + cur_tag)
+                                    in_bracket = False
+                                elif "(" in l:
+                                    cur_tag = l[1:l.find("*")]
+                                    seq.append("B-" + cur_tag)
+                                    in_bracket = True
+                                else:
+                                    raise RuntimeError(
+                                        "Unexpected label: %s" % l)
+                            yield sentences, verb_list[i], seq
+                    sentences, one_seg = [], []
+                else:
+                    sentences.append(word)
+                    one_seg.append(label)
+
+    return gen
+
+
+def _real_reader(files):
+    word_dict = _load_dict(files["wordDict.txt"])
+    verb_dict = _load_dict(files["verbDict.txt"])
+    label_dict = _load_label_dict(files["targetDict.txt"])
+    corpus = _corpus_reader(files[_DATA_TAR])
+
+    def reader():
+        for sentence, predicate, labels in corpus():
+            sen_len = len(sentence)
+            verb_index = labels.index("B-V")
+            mark = [0] * sen_len
+            ctx = {}
+            for off, default in ((-2, "bos"), (-1, "bos"), (0, None),
+                                 (1, "eos"), (2, "eos")):
+                j = verb_index + off
+                if 0 <= j < sen_len:
+                    mark[j] = 1
+                    ctx[off] = sentence[j]
+                else:
+                    ctx[off] = default
+            word_idx = [word_dict.get(w, UNK_IDX) for w in sentence]
+            ctxs = [[word_dict.get(ctx[o], UNK_IDX)] * sen_len
+                    for o in (-2, -1, 0, 1, 2)]
+            pred_idx = [verb_dict.get(predicate)] * sen_len
+            label_idx = [label_dict.get(w) for w in labels]
+            yield tuple([word_idx] + ctxs + [pred_idx, mark, label_idx])
+
+    return reader
 
 WORD_VOCAB = 4000
 LABEL_KINDS = 30          # ~ 2*roles + O  (BIO over roles)
@@ -17,6 +144,11 @@ TEST_SIZE = 64
 
 
 def get_dict():
+    files = _real_files()
+    if files:
+        return (_load_dict(files["wordDict.txt"]),
+                _load_dict(files["verbDict.txt"]),
+                _load_label_dict(files["targetDict.txt"]))
     word_dict = {"<w%d>" % i: i for i in range(WORD_VOCAB)}
     verb_dict = {"<v%d>" % i: i for i in range(PRED_VOCAB)}
     label_dict = {"<l%d>" % i: i for i in range(LABEL_KINDS)}
@@ -24,8 +156,10 @@ def get_dict():
 
 
 def get_embedding():
+    files = _real_files()
+    n = len(_load_dict(files["wordDict.txt"])) if files else WORD_VOCAB
     rng = common.seeded_rng("conll05-emb")
-    return rng.normal(0, 0.1, (WORD_VOCAB, 32)).astype(np.float32)
+    return rng.normal(0, 0.1, (n, 32)).astype(np.float32)
 
 
 def _reader(n, split):
@@ -52,8 +186,16 @@ def _reader(n, split):
 
 
 def train():
+    files = _real_files()
+    if files:
+        # the real CoNLL-05 training set is not public; the reference
+        # trains on the test.wsj corpus too (conll05.py test() docstring)
+        return _real_reader(files)
     return _reader(TRAIN_SIZE, "train")
 
 
 def test():
+    files = _real_files()
+    if files:
+        return _real_reader(files)
     return _reader(TEST_SIZE, "test")

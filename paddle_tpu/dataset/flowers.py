@@ -1,6 +1,21 @@
 """Oxford 102 flowers. reference: python/paddle/v2/dataset/flowers.py — rows
-of (image [3*224*224] float32, label int in [0,102))."""
+of (image [3*224*224] float32, label int in [0,102)).
+
+When the real archives are present under ``<data_home>/flowers/``
+(``102flowers.tgz`` + ``imagelabels.mat`` + ``setid.mat`` — the files
+the reference's download() caches), they are parsed the reference's
+way: split ids from setid.mat with the reference's deliberate swap
+(train = ``tstid``, test = ``trnid`` — the "test" fold is the larger
+one, per the comment at flowers.py:50), labels from imagelabels.mat
+made 0-based, jpgs decoded + resized short-side 256 + center-cropped
+224 + channel-reversed to BGR + mean-subtracted ([103.94, 116.78,
+123.68], the reference's simple_transform defaults), flattened CHW
+float32. Deviation: no random crop/flip on train (deterministic center
+crop; the reference's train mapper randomises). Without the archives
+the synthetic corpus below ([0,1] values, same shapes/labels) is used."""
 from __future__ import annotations
+
+import tarfile
 
 import numpy as np
 
@@ -12,8 +27,55 @@ TRAIN_SIZE = 128
 TEST_SIZE = 32
 DIM = 3 * 224 * 224
 
+# the reference's deliberate swap: tstid is the (larger) training fold
+_FLAGS = {"train": "tstid", "test": "trnid", "valid": "valid"}
+_MEAN_BGR = np.array([103.94, 116.78, 123.68], np.float32)
+
+
+def _archives():
+    files = {n: common.cached_file("flowers", n) for n in
+             ("102flowers.tgz", "imagelabels.mat", "setid.mat")}
+    return files if all(files.values()) else None
+
+
+def _decode(blob):
+    import io
+
+    from PIL import Image
+    im = Image.open(io.BytesIO(blob)).convert("RGB")
+    w, h = im.size
+    s = 256.0 / min(w, h)
+    im = im.resize((max(int(round(w * s)), 256),
+                    max(int(round(h * s)), 256)))
+    w, h = im.size
+    x0, y0 = (w - 224) // 2, (h - 224) // 2
+    arr = np.asarray(im.crop((x0, y0, x0 + 224, y0 + 224)),
+                     dtype=np.float32)           # HWC RGB
+    arr = arr[:, :, ::-1] - _MEAN_BGR            # BGR, mean-subtracted
+    return arr.transpose(2, 0, 1).reshape(-1)    # CHW flat
+
+
+def _real_reader(files, split):
+    def reader():
+        import scipy.io as scio
+        labels = scio.loadmat(files["imagelabels.mat"])["labels"][0]
+        indexes = scio.loadmat(files["setid.mat"])[_FLAGS[split]][0]
+        wanted = {"jpg/image_%05d.jpg" % i: int(labels[i - 1]) - 1
+                  for i in indexes}
+        with tarfile.open(files["102flowers.tgz"]) as tf:
+            for m in tf.getmembers():
+                if m.name in wanted:
+                    yield (_decode(tf.extractfile(m).read()),
+                           wanted[m.name])
+
+    return reader
+
 
 def _reader(n, split):
+    files = _archives()
+    if files:
+        return _real_reader(files, split)
+
     def reader():
         rng = common.seeded_rng("flowers-" + split)
         for _ in range(n):

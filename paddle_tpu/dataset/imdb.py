@@ -1,6 +1,19 @@
 """IMDB sentiment. reference: python/paddle/v2/dataset/imdb.py — rows of
-(word_id_sequence, label 0/1); word_dict() maps token -> id."""
+(word_id_sequence, label 0/1); word_dict() maps token -> id.
+
+When the real ``aclImdb_v1.tar.gz`` is present under
+``<data_home>/imdb/``, it is parsed the reference's way: reviews under
+``aclImdb/{split}/{pos,neg}/*.txt``, punctuation stripped + lowercased
+tokens, vocabulary sorted by (-freq, word) over all four splits with
+``<unk>`` appended last, and — matching the reference's label
+convention — **pos = 0, neg = 1**. The synthetic fallback keeps its own
+(documented) 1 = positive convention; code that learns a binary
+classifier is agnostic either way."""
 from __future__ import annotations
+
+import re
+import string
+import tarfile
 
 import numpy as np
 
@@ -15,7 +28,44 @@ TEST_SIZE = 256
 _POS_WORDS = None
 
 
+def _archive():
+    return common.cached_file("imdb", "aclImdb_v1.tar.gz")
+
+
+def _tokenize(blob):
+    txt = blob.decode("utf-8", "replace").lower()
+    return txt.translate(str.maketrans("", "", string.punctuation)).split()
+
+
+def _real_docs(tar_path, pattern):
+    pat = re.compile(pattern)
+    with tarfile.open(tar_path) as tf:
+        for m in tf.getmembers():
+            if bool(pat.match(m.name)):
+                yield _tokenize(tf.extractfile(m).read())
+
+
+_DICT_CACHE = {}
+
+
 def word_dict():
+    tar = _archive()
+    if tar:
+        if tar in _DICT_CACHE:
+            return _DICT_CACHE[tar]
+        freq = {}
+        # one pass over the tar: each _real_docs call re-decompresses
+        # the whole gz stream, so the four split/polarity corpora are
+        # matched with a single combined pattern
+        for toks in _real_docs(
+                tar, r".*aclImdb/(train|test)/(pos|neg)/.*\.txt$"):
+            for w in toks:
+                freq[w] = freq.get(w, 0) + 1
+        kept = sorted(freq.items(), key=lambda t: (-t[1], t[0]))
+        d = {w: i for i, (w, _) in enumerate(kept)}
+        d["<unk>"] = len(d)
+        _DICT_CACHE[tar] = d
+        return d
     return {"<w%d>" % i: i for i in range(VOCAB)}
 
 
@@ -46,9 +96,28 @@ def _reader(n, split):
     return reader
 
 
+def _real_reader(split, word_idx):
+    tar = _archive()
+
+    def reader():
+        wd = word_idx if word_idx is not None else word_dict()
+        unk = wd.get("<unk>", len(wd) - 1)
+        # reference label convention: pos = 0, neg = 1
+        for label, pol in ((0, "pos"), (1, "neg")):
+            for toks in _real_docs(
+                    tar, r".*aclImdb/%s/%s/.*\.txt$" % (split, pol)):
+                yield [wd.get(w, unk) for w in toks], label
+
+    return reader
+
+
 def train(word_idx=None):
+    if _archive():
+        return _real_reader("train", word_idx)
     return _reader(TRAIN_SIZE, "train")
 
 
 def test(word_idx=None):
+    if _archive():
+        return _real_reader("test", word_idx)
     return _reader(TEST_SIZE, "test")

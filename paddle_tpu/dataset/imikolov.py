@@ -1,7 +1,18 @@
 """PTB language model (imikolov). reference:
 python/paddle/v2/dataset/imikolov.py — build_dict() then train(word_idx, n)
-yields n-gram tuples of word ids (the word2vec book test feeds n=5)."""
+yields n-gram tuples of word ids (the word2vec book test feeds n=5).
+
+When the real ``simple-examples.tgz`` (the archive the reference's
+download() caches) is present under ``<data_home>/imikolov/``, its
+``data/ptb.{train,valid}.txt`` members are parsed with the reference's
+exact pipeline: frequency dict over train+valid with ``<e>`` appended
+per line, min_word_freq filter, (-freq, word) sort order, ``<unk>``
+appended last; readers wrap each line as ``<s> ... <e>`` and emit
+n-grams with unknown words mapped to ``<unk>``. Otherwise a
+deterministic synthetic corpus is generated."""
 from __future__ import annotations
+
+import tarfile
 
 import numpy as np
 
@@ -14,7 +25,41 @@ TRAIN_SENT = 512
 TEST_SENT = 128
 
 
+_MEMBERS = {"train": "data/ptb.train.txt", "test": "data/ptb.valid.txt"}
+
+
+def _archive():
+    return common.cached_file("imikolov", "simple-examples.tgz")
+
+
+def _read_lines(tar_path, member):
+    with tarfile.open(tar_path) as tf:
+        for m in tf.getmembers():
+            if m.name.endswith(member):
+                f = tf.extractfile(m)
+                return [l.decode("utf-8", "replace") for l in f.readlines()]
+    raise ValueError("%s: no member ending in %r" % (tar_path, member))
+
+
+def _word_count(lines, freq):
+    for l in lines:
+        for w in l.strip().split():
+            freq[w] = freq.get(w, 0) + 1
+        freq["<e>"] = freq.get("<e>", 0) + 1
+    return freq
+
+
 def build_dict(min_word_freq=50):
+    tar = _archive()
+    if tar:
+        freq = _word_count(_read_lines(tar, _MEMBERS["train"]), {})
+        freq = _word_count(_read_lines(tar, _MEMBERS["test"]), freq)
+        freq.pop("<unk>", None)
+        kept = [(w, c) for w, c in freq.items() if c > min_word_freq]
+        kept.sort(key=lambda t: (-t[1], t[0]))
+        d = {w: i for i, (w, _) in enumerate(kept)}
+        d["<unk>"] = len(d)
+        return d
     d = {"<w%d>" % i: i for i in range(VOCAB - 2)}
     d["<unk>"] = VOCAB - 2
     d["<e>"] = VOCAB - 1
@@ -36,6 +81,19 @@ def _sentences(split, n_sent):
 
 
 def _ngram_reader(split, n_sent, word_idx, n):
+    tar = _archive()
+    if tar:
+        def reader():
+            unk = word_idx["<unk>"]
+            for l in _read_lines(tar, _MEMBERS[split]):
+                toks = ["<s>"] + l.strip().split() + ["<e>"]
+                ids = [word_idx.get(w, unk) for w in toks]
+                if len(ids) >= n:
+                    for i in range(n, len(ids) + 1):
+                        yield tuple(ids[i - n:i])
+
+        return reader
+
     def reader():
         for sent in _sentences(split, n_sent):
             if len(sent) >= n:

@@ -1,7 +1,18 @@
 """MovieLens-1M. reference: python/paddle/v2/dataset/movielens.py — rows of
 (user_id, gender_id, age_id, job_id, movie_id, category_ids, title_ids,
-score); plus max_*_id helpers the recommender book test uses."""
+score); plus max_*_id helpers the recommender book test uses.
+
+When the real ``ml-1m.zip`` is present under ``<data_home>/movielens/``,
+its ``users.dat / movies.dat / ratings.dat`` members are parsed
+(``::``-separated, latin-1 titles): gender M/F -> 0/1, age mapped to its
+``age_table`` index, category and title vocabularies built from the
+corpus in sorted order, and a seeded 90/10 train/test split over rating
+rows (the reference splits with a seeded ``random.random() < 0.1`` the
+same way). The score is the raw 1-5 rating, like the synthetic corpus.
+Otherwise the deterministic synthetic corpus below is used."""
 from __future__ import annotations
+
+import zipfile
 
 import numpy as np
 
@@ -22,27 +33,97 @@ TRAIN_SIZE = 2048
 TEST_SIZE = 256
 
 
+_META = None
+
+
+def _archive():
+    return common.cached_file("movielens", "ml-1m.zip")
+
+
+def _meta():
+    """Parse the real archive once: (users, movies, ratings, cat_dict,
+    title_dict) or None when only the synthetic corpus is available."""
+    global _META
+    zpath = _archive()
+    if _META is not None and _META[0] == zpath:
+        return _META[1]
+    if not zpath:
+        _META = (None, None)
+        return None
+    users, movies, cats, titles = {}, {}, {}, {}
+    with zipfile.ZipFile(zpath) as z:
+        def lines(name):
+            for nm in z.namelist():
+                if nm.endswith(name):
+                    return z.read(nm).decode("latin-1").splitlines()
+            raise ValueError("%s: no member ending in %r" % (zpath, name))
+
+        for l in lines("users.dat"):
+            uid, gender, age, job = l.strip().split("::")[:4]
+            users[int(uid)] = (int(uid), 0 if gender == "M" else 1,
+                              age_table.index(int(age)), int(job))
+        # the reference strips the trailing "(year)" from each title
+        # (re ^(.*)\((\d+)\)$ group 1) and lowercases title words before
+        # building MOVIE_TITLE_DICT (movielens.py:106-127; its set
+        # iteration order was arbitrary — sorted here for determinism)
+        import re
+        year_pat = re.compile(r"^(.*)\((\d+)\)$")
+        raw_movies = []
+        for l in lines("movies.dat"):
+            mid, title, genres = l.strip().split("::")
+            m = year_pat.match(title)
+            if m:
+                title = m.group(1)
+            raw_movies.append((int(mid), title, genres.split("|")))
+        for _, title, genres in raw_movies:
+            for g in genres:
+                cats.setdefault(g, None)
+            for t in title.split():
+                titles.setdefault(t.lower(), None)
+        cat_dict = {g: i for i, g in enumerate(sorted(cats))}
+        title_dict = {t: i for i, t in enumerate(sorted(titles))}
+        for mid, title, genres in raw_movies:
+            movies[mid] = (mid, sorted(cat_dict[g] for g in genres),
+                           [title_dict[t.lower()] for t in title.split()])
+        ratings = []
+        for l in lines("ratings.dat"):
+            uid, mid, score = l.strip().split("::")[:3]
+            ratings.append((int(uid), int(mid), float(score)))
+    _META = (zpath, (users, movies, ratings, cat_dict, title_dict))
+    return _META[1]
+
+
 def max_user_id():
-    return _N_USERS
+    m = _meta()
+    return max(m[0]) if m else _N_USERS
 
 
 def max_movie_id():
-    return _N_MOVIES
+    m = _meta()
+    return max(m[1]) if m else _N_MOVIES
 
 
 def max_job_id():
-    return _N_JOBS - 1
+    m = _meta()
+    return (max(u[3] for u in m[0].values()) if m else _N_JOBS - 1)
 
 
 def movie_categories():
-    return {"<c%d>" % i: i for i in range(_N_CATEGORIES)}
+    m = _meta()
+    return dict(m[3]) if m else {"<c%d>" % i: i
+                                 for i in range(_N_CATEGORIES)}
 
 
 def get_movie_title_dict():
-    return {"<t%d>" % i: i for i in range(_TITLE_VOCAB)}
+    m = _meta()
+    return dict(m[4]) if m else {"<t%d>" % i: i
+                                 for i in range(_TITLE_VOCAB)}
 
 
 def user_info():
+    m = _meta()
+    if m:
+        return dict(m[0])
     rng = common.seeded_rng("ml-users")
     return {i: (i, int(rng.randint(0, 2)), int(rng.randint(0, len(age_table))),
                 int(rng.randint(0, _N_JOBS)))
@@ -50,6 +131,9 @@ def user_info():
 
 
 def movie_info():
+    m = _meta()
+    if m:
+        return dict(m[1])
     rng = common.seeded_rng("ml-movies")
     return {i: (i,
                 sorted(set(int(c) for c in rng.randint(0, _N_CATEGORIES,
@@ -60,6 +144,24 @@ def movie_info():
 
 
 def _reader(n, split):
+    m = _meta()
+    if m:
+        def reader():
+            users, movies, ratings = m[0], m[1], m[2]
+            # seeded 90/10 split over rating rows, like the reference's
+            # rand.random() < test_ratio with a fixed seed
+            coin = common.seeded_rng("ml-split").rand(len(ratings))
+            want_test = (split == "test")
+            for (uid, mid, score), c in zip(ratings, coin):
+                if (c < 0.1) != want_test:
+                    continue
+                _, gender, age, job = users[uid]
+                _, cats, title = movies[mid]
+                yield uid, gender, age, job, mid, cats, title, \
+                    np.array([score], np.float32)
+
+        return reader
+
     users = user_info()
     movies = movie_info()
 

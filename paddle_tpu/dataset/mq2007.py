@@ -1,7 +1,21 @@
 """MQ2007 learning-to-rank. reference: python/paddle/v2/dataset/mq2007.py —
-pairwise mode yields (query_pos_features, query_neg_features), listwise
-(label_list, feature_list); 46 features per doc."""
+pairwise mode yields (label [1], higher_doc [46], lower_doc [46]) per
+C(n,2) pair with differing relevance; listwise yields
+(relevance [n, 1], features [n, 46]) per query; 46 features per doc.
+
+Real-data path: the reference downloads ``MQ2007.rar`` — a rar archive
+this environment cannot unpack (no rarfile/unrar). Instead, the
+*extracted* LETOR text files are consumed when present under
+``<data_home>/mq2007/`` as ``Fold1/train.txt`` / ``Fold1/test.txt``
+(the members the reference reads after extraction). Parsing follows the
+reference: ``rel qid:N 1:v ... 46:v #comment`` lines, grouped by qid in
+file order, queries whose relevance sums to zero filtered out, each
+query list sorted by descending relevance before pair/list generation
+(QueryList._correct_ranking_). The synthetic fallback generates the
+same tuple shapes."""
 from __future__ import annotations
+
+import os
 
 import numpy as np
 
@@ -14,7 +28,69 @@ TRAIN_QUERIES = 128
 TEST_QUERIES = 32
 
 
+def _real_file(split):
+    for rel in ("Fold1/%s.txt" % split,
+                "MQ2007/Fold1/%s.txt" % split,
+                "MQ2007/MQ2007/Fold1/%s.txt" % split):
+        p = os.path.join(common.data_home(), "mq2007", rel)
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def _load_querylists(path):
+    lists, current, prev_qid = [], None, None
+    with open(path) as f:
+        for line in f:
+            parts = line.split("#")[0].split()
+            if len(parts) < 2 + N_FEATURES:
+                continue
+            rel = int(parts[0])
+            qid = int(parts[1].split(":")[1])
+            feat = np.array([float(p.split(":")[1])
+                             for p in parts[2:2 + N_FEATURES]],
+                            np.float32)
+            if qid != prev_qid:
+                if current:
+                    lists.append(current)
+                current, prev_qid = [], qid
+            current.append((rel, feat))
+    if current:
+        lists.append(current)
+    # query_filter: drop all-zero-relevance queries; _correct_ranking_:
+    # sort each list by descending relevance (reference mq2007.py)
+    out = []
+    for ql in lists:
+        if sum(r for r, _ in ql) != 0:
+            out.append(sorted(ql, key=lambda t: -t[0]))
+    return out
+
+
+def _gen(querylists, format):
+    for ql in querylists:
+        if format == "pairwise":
+            for i in range(len(ql)):
+                for j in range(i + 1, len(ql)):
+                    ri, fi = ql[i]
+                    rj, fj = ql[j]
+                    if ri > rj:
+                        yield np.array([1]), fi, fj
+                    elif ri < rj:
+                        yield np.array([1]), fj, fi
+        else:
+            yield (np.array([[r] for r, _ in ql]),
+                   np.array([f for _, f in ql]))
+
+
 def _reader(n_queries, split, format):
+    path = _real_file(split)
+    if path:
+        def reader():
+            for row in _gen(_load_querylists(path), format):
+                yield row
+
+        return reader
+
     def reader():
         rng = common.seeded_rng("mq2007-" + split)
         w = common.seeded_rng("mq2007-w").normal(0, 1, N_FEATURES)
@@ -23,13 +99,10 @@ def _reader(n_queries, split, format):
             feats = rng.normal(0, 1, (n_docs, N_FEATURES)).astype(np.float32)
             scores = feats @ w + rng.normal(0, 0.1, n_docs)
             rels = np.digitize(scores, np.percentile(scores, [33, 66]))
-            if format == "pairwise":
-                for i in range(n_docs):
-                    for j in range(n_docs):
-                        if rels[i] > rels[j]:
-                            yield feats[i], feats[j]
-            else:
-                yield [int(r) for r in rels], [f for f in feats]
+            order = np.argsort(-rels)
+            ql = [(int(rels[i]), feats[i]) for i in order]
+            for row in _gen([ql], format):
+                yield row
 
     return reader
 

@@ -1,19 +1,84 @@
 """NLTK movie-review sentiment. reference:
-python/paddle/v2/dataset/sentiment.py — rows of (word_ids, label 0/1)."""
+python/paddle/v2/dataset/sentiment.py — rows of (word_ids, label 0/1).
+
+When the real NLTK corpus zip (``movie_reviews.zip``) is present under
+``<data_home>/sentiment/``, it is parsed the reference's way: word dict
+over the whole corpus by descending frequency (ties alphabetical; the
+reference's py2 cmp-sort left tie order unspecified), files interleaved
+neg/pos (label 0 = neg, 1 = pos, from the path like the reference's
+``0 if 'neg' in sample_file``), first 80% of the interleaved list is
+train, the rest test (the reference hardcodes 1600/400 of its fixed
+2000 files — the same 80/20 ratio). The corpus files are pre-tokenized,
+so whitespace splitting matches NLTK's reader on this corpus. Without
+the zip, the synthetic IMDB-style corpus below is used."""
 from __future__ import annotations
+
+import zipfile
 
 from . import common, imdb
 
 __all__ = ["get_word_dict", "train", "test"]
 
 
+def _archive():
+    return common.cached_file("sentiment", "movie_reviews.zip")
+
+
+def _files(z, pol):
+    return sorted(n for n in z.namelist()
+                  if ("movie_reviews/%s/" % pol) in n
+                  and n.endswith(".txt"))
+
+
+def _tokens(z, name):
+    return z.read(name).decode("utf-8", "replace").lower().split()
+
+
+_DICT_CACHE = {}
+
+
 def get_word_dict():
-    return imdb.word_dict()
+    zpath = _archive()
+    if not zpath:
+        return imdb.word_dict()
+    if zpath in _DICT_CACHE:
+        return _DICT_CACHE[zpath]
+    freq = {}
+    with zipfile.ZipFile(zpath) as z:
+        for pol in ("neg", "pos"):
+            for name in _files(z, pol):
+                for w in _tokens(z, name):
+                    freq[w] = freq.get(w, 0) + 1
+    kept = sorted(freq.items(), key=lambda t: (-t[1], t[0]))
+    _DICT_CACHE[zpath] = {w: i for i, (w, _) in enumerate(kept)}
+    return _DICT_CACHE[zpath]
+
+
+def _real_reader(split):
+    zpath = _archive()
+    wd = get_word_dict()   # cached: built once, not once per epoch
+
+    def reader():
+        with zipfile.ZipFile(zpath) as z:
+            neg, pos = _files(z, "neg"), _files(z, "pos")
+            interleaved = [f for pair in zip(neg, pos) for f in pair]
+            cut = int(len(interleaved) * 0.8)
+            part = interleaved[:cut] if split == "train" \
+                else interleaved[cut:]
+            for name in part:
+                label = 0 if "/neg/" in name else 1
+                yield [wd[w] for w in _tokens(z, name)], label
+
+    return reader
 
 
 def train():
+    if _archive():
+        return _real_reader("train")
     return imdb._reader(512, "sent-train")
 
 
 def test():
+    if _archive():
+        return _real_reader("test")
     return imdb._reader(128, "sent-test")

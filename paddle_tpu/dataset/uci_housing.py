@@ -1,5 +1,13 @@
 """UCI Housing. reference: python/paddle/v2/dataset/uci_housing.py — rows of
-(features[13] float32 normalised, price[1] float32)."""
+(features[13] float32 normalised, price[1] float32).
+
+When the real ``housing.data`` (the file the reference's download()
+caches) is present under ``<data_home>/uci_housing/``, it is parsed and
+normalised exactly as the reference does — per-feature
+``(x - avg) / (max - min)`` computed over the whole corpus, then an
+80/20 train/test split in file order (404/102 on the real 506 rows).
+Otherwise a deterministic synthetic corpus with the same schema is
+generated."""
 from __future__ import annotations
 
 import numpy as np
@@ -23,7 +31,36 @@ _W = _rng.normal(0.0, 1.0, 13).astype(np.float32)
 _B = 22.5
 
 
+def _load_real(path):
+    data = np.loadtxt(path).astype(np.float32)
+    if data.ndim != 2 or data.shape[1] != 14:
+        raise ValueError("%s: expected 14 whitespace columns, got %s"
+                         % (path, data.shape))
+    # reference normalisation (v2/dataset/uci_housing.py feature_range):
+    # (x - avg) / (max - min) per feature over the WHOLE corpus
+    feats = data[:, :13]
+    spread = feats.max(axis=0) - feats.min(axis=0)
+    spread[spread == 0] = 1.0
+    data[:, :13] = (feats - feats.mean(axis=0)) / spread
+    return data
+
+
+def _real_reader(path, split):
+    def reader():
+        data = _load_real(path)
+        cut = int(len(data) * 0.8)
+        rows = data[:cut] if split == "train" else data[cut:]
+        for r in rows:
+            yield r[:13], r[13:14].copy()
+
+    return reader
+
+
 def _reader(n, split):
+    path = common.cached_file("uci_housing", "housing.data")
+    if path:
+        return _real_reader(path, split)
+
     def reader():
         rng = common.seeded_rng("uci-" + split)
         for _ in range(n):

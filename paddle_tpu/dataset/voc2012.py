@@ -1,7 +1,19 @@
 """PASCAL VOC2012 segmentation. reference:
 python/paddle/v2/dataset/voc2012.py — rows of (image [3,H,W], seg label
-[H,W] int in [0,21))."""
+[H,W] int in [0,21)).
+
+When the real ``VOCtrainval_11-May-2012.tar`` is present under
+``<data_home>/voc2012/``, it is parsed exactly like the reference:
+ids from ``ImageSets/Segmentation/{trainval,train,val}.txt`` with the
+reference's split mapping (train() -> trainval, test() -> train,
+val() -> val — voc2012.py:67-81), jpg decoded to an HWC uint8 array and
+the palette png to an HW uint8 array of class indices (border pixels
+keep the VOC value 255), both yielded raw like the reference. The
+synthetic fallback below keeps its own (documented) CHW-float contract
+for shape-stable tests."""
 from __future__ import annotations
+
+import tarfile
 
 import numpy as np
 
@@ -13,8 +25,42 @@ H = W = 64   # synthetic resolution (real images vary)
 TRAIN_SIZE = 64
 TEST_SIZE = 16
 
+_SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/%s.txt"
+_DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/%s.jpg"
+_LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/%s.png"
+# reference split mapping (voc2012.py:67-81): its test() reads 'train'
+_SUBSETS = {"train": "trainval", "test": "train", "val": "val"}
+
+
+def _archive():
+    return common.cached_file("voc2012", "VOCtrainval_11-May-2012.tar")
+
+
+def _real_reader(tar_path, split):
+    def reader():
+        import io
+
+        from PIL import Image
+        with tarfile.open(tar_path) as tf:
+            members = {m.name: m for m in tf.getmembers()}
+            ids = tf.extractfile(
+                members[_SET_FILE % _SUBSETS[split]]).read() \
+                .decode().split()
+            for line in ids:
+                img = Image.open(io.BytesIO(tf.extractfile(
+                    members[_DATA_FILE % line]).read()))
+                lbl = Image.open(io.BytesIO(tf.extractfile(
+                    members[_LABEL_FILE % line]).read()))
+                yield np.array(img), np.array(lbl)
+
+    return reader
+
 
 def _reader(n, split):
+    tar = _archive()
+    if tar:
+        return _real_reader(tar, split)
+
     def reader():
         rng = common.seeded_rng("voc2012-" + split)
         for _ in range(n):

@@ -1,7 +1,18 @@
 """WMT-14 fr->en. reference: python/paddle/v2/dataset/wmt14.py — rows of
 (src_ids, trg_ids_with_<s>, trg_ids_next_with_<e>); ids 0/1/2 are
-<s>/<e>/<unk>."""
+<s>/<e>/<unk>.
+
+When the real ``wmt14.tgz`` (the reference's preprocessed
+wmt_shrinked_data archive) is present under ``<data_home>/wmt14/``, it
+is parsed the reference's way: ``src.dict``/``trg.dict`` members
+truncated to dict_size (line number = id, first three lines are
+<s>/<e>/<unk>), sentence pairs tab-separated in the ``train/train`` and
+``test/test`` members, source wrapped in <s>...<e>, pairs longer than
+80 tokens dropped. The synthetic fallback keeps its (documented)
+unwrapped source convention."""
 from __future__ import annotations
+
+import tarfile
 
 from . import common
 
@@ -11,8 +22,59 @@ START, END, UNK = 0, 1, 2
 TRAIN_SIZE = 512
 TEST_SIZE = 64
 
+_MEMBERS = {"train": "train/train", "test": "test/test"}
+
+
+def _archive():
+    return common.cached_file("wmt14", "wmt14.tgz")
+
+
+def _read_dicts(tar_path, dict_size):
+    def to_dict(fd, size):
+        d = {}
+        for i, line in enumerate(fd):
+            if i >= size:
+                break
+            d[line.decode("utf-8", "replace").strip()] = i
+        return d
+
+    with tarfile.open(tar_path) as f:
+        src = [m.name for m in f if m.name.endswith("src.dict")]
+        trg = [m.name for m in f if m.name.endswith("trg.dict")]
+        return (to_dict(f.extractfile(src[0]), dict_size),
+                to_dict(f.extractfile(trg[0]), dict_size))
+
+
+def _real_reader(tar_path, split, dict_size):
+    def reader():
+        src_dict, trg_dict = _read_dicts(tar_path, dict_size)
+        end_id, start_id = trg_dict["<e>"], trg_dict["<s>"]
+        with tarfile.open(tar_path) as f:
+            names = [m.name for m in f
+                     if m.name.endswith(_MEMBERS[split])]
+            for name in names:
+                for line in f.extractfile(name):
+                    parts = line.decode("utf-8", "replace") \
+                        .strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src_ids = [src_dict.get(w, UNK) for w in
+                               ["<s>"] + parts[0].split() + ["<e>"]]
+                    trg_ids = [trg_dict.get(w, UNK)
+                               for w in parts[1].split()]
+                    if len(src_ids) > 80 or len(trg_ids) > 80:
+                        continue
+                    yield (src_ids, [start_id] + trg_ids,
+                           trg_ids + [end_id])
+
+    return reader
+
 
 def _reader(n, split, dict_size):
+    tar = _archive()
+    if tar:
+        return _real_reader(tar, split, dict_size)
+
     def reader():
         rng = common.seeded_rng("wmt14-" + split)
         for _ in range(n):

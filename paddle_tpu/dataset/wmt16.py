@@ -1,6 +1,16 @@
 """WMT-16 en->de (multi-lingual API of the reference).
-reference: python/paddle/v2/dataset/wmt16.py."""
+reference: python/paddle/v2/dataset/wmt16.py.
+
+When the real ``wmt16.tar.gz`` is present under ``<data_home>/wmt16/``,
+its ``wmt16/{train,val,test}`` members are parsed the reference's way:
+tab-separated en/de pairs, per-language vocabularies built from the
+train member with <s>/<e>/<unk> as ids 0/1/2 then words by descending
+frequency (ties alphabetical — the reference's py2 sort left tie order
+unspecified), both sides wrapped <s>...<e> / start-next shifted. The
+synthetic fallback below keeps its own deterministic corpus."""
 from __future__ import annotations
+
+import tarfile
 
 from . import common
 
@@ -9,15 +19,84 @@ __all__ = ["train", "test", "validation", "get_dict"]
 TRAIN_SIZE = 512
 TEST_SIZE = 64
 
+_MARKS = ("<s>", "<e>", "<unk>")
+
+
+def _archive():
+    return common.cached_file("wmt16", "wmt16.tar.gz")
+
+
+_DICT_CACHE = {}
+
+
+def _build_real_dict(tar_path, dict_size, lang):
+    key = (tar_path, dict_size, lang)
+    if key in _DICT_CACHE:
+        return _DICT_CACHE[key]
+    freq = {}
+    col = 0 if lang == "en" else 1
+    with tarfile.open(tar_path) as f:
+        for line in f.extractfile("wmt16/train"):
+            parts = line.decode("utf-8", "replace").strip().split("\t")
+            if len(parts) != 2:
+                continue
+            for w in parts[col].split():
+                freq[w] = freq.get(w, 0) + 1
+    words = [w for w, _ in sorted(freq.items(),
+                                  key=lambda t: (-t[1], t[0]))]
+    d = {m: i for i, m in enumerate(_MARKS)}
+    for w in words:
+        if len(d) >= dict_size:
+            break
+        d[w] = len(d)
+    _DICT_CACHE[key] = d
+    return d
+
 
 def get_dict(lang, dict_size, reverse=False):
-    d = {"<w%d>" % i: i for i in range(dict_size)}
+    tar = _archive()
+    d = (_build_real_dict(tar, dict_size, lang) if tar
+         else {"<w%d>" % i: i for i in range(dict_size)})
     if reverse:
         return {v: k for k, v in d.items()}
     return d
 
 
-def _reader(n, split, src_dict_size, trg_dict_size):
+def _real_reader(tar_path, member, src_dict_size, trg_dict_size,
+                 src_lang):
+    def reader():
+        src_dict = _build_real_dict(tar_path, src_dict_size, src_lang)
+        trg_dict = _build_real_dict(tar_path, trg_dict_size,
+                                    "de" if src_lang == "en" else "en")
+        start_id, end_id, unk_id = (src_dict[m] for m in _MARKS)
+        src_col = 0 if src_lang == "en" else 1
+        with tarfile.open(tar_path) as f:
+            for line in f.extractfile(member):
+                parts = line.decode("utf-8", "replace") \
+                    .strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src_ids = [start_id] + [
+                    src_dict.get(w, unk_id)
+                    for w in parts[src_col].split()] + [end_id]
+                trg_ids = [trg_dict.get(w, unk_id)
+                           for w in parts[1 - src_col].split()]
+                yield (src_ids, [start_id] + trg_ids,
+                       trg_ids + [end_id])
+
+    return reader
+
+
+_REAL_MEMBERS = {"train": "wmt16/train", "test": "wmt16/test",
+                 "valid": "wmt16/val"}
+
+
+def _reader(n, split, src_dict_size, trg_dict_size, src_lang="en"):
+    tar = _archive()
+    if tar:
+        return _real_reader(tar, _REAL_MEMBERS[split], src_dict_size,
+                            trg_dict_size, src_lang)
+
     def reader():
         rng = common.seeded_rng("wmt16-" + split)
         for _ in range(n):
@@ -30,12 +109,15 @@ def _reader(n, split, src_dict_size, trg_dict_size):
 
 
 def train(src_dict_size, trg_dict_size, src_lang="en"):
-    return _reader(TRAIN_SIZE, "train", src_dict_size, trg_dict_size)
+    return _reader(TRAIN_SIZE, "train", src_dict_size, trg_dict_size,
+                   src_lang)
 
 
 def test(src_dict_size, trg_dict_size, src_lang="en"):
-    return _reader(TEST_SIZE, "test", src_dict_size, trg_dict_size)
+    return _reader(TEST_SIZE, "test", src_dict_size, trg_dict_size,
+                   src_lang)
 
 
 def validation(src_dict_size, trg_dict_size, src_lang="en"):
-    return _reader(TEST_SIZE, "valid", src_dict_size, trg_dict_size)
+    return _reader(TEST_SIZE, "valid", src_dict_size, trg_dict_size,
+                   src_lang)

@@ -215,3 +215,348 @@ def test_convert_and_cluster_files_reader(tmp_path):
     assert len(s0) + len(s1) == 10
     assert {x[0] for x in s0} | {x[0] for x in s1} == set(range(10))
     assert {x[0] for x in s0} & {x[0] for x in s1} == set()
+
+
+def test_uci_housing_real_file_parsed(tmp_path, monkeypatch):
+    """Real housing.data: reference normalisation (x-avg)/(max-min) and
+    80/20 in-order split."""
+    d = tmp_path / "uci_housing"
+    d.mkdir()
+    rng = np.random.RandomState(5)
+    raw = np.round(rng.rand(10, 14) * 50, 3)
+    with open(d / "housing.data", "w") as f:
+        for r in raw:
+            f.write(" ".join("%.4f" % v for v in r) + "\n")
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+    from paddle_tpu.dataset import uci_housing
+    tr = list(uci_housing.train()())
+    te = list(uci_housing.test()())
+    assert len(tr) == 8 and len(te) == 2
+    feats = raw[:, :13]
+    want = (feats - feats.mean(0)) / (feats.max(0) - feats.min(0))
+    np.testing.assert_allclose(tr[0][0], want[0], rtol=1e-4)
+    np.testing.assert_allclose(te[-1][1], raw[-1, 13:14], rtol=1e-5)
+
+
+def test_imikolov_real_tgz_parsed(tmp_path, monkeypatch):
+    """Real simple-examples.tgz: reference dict order (-freq, word),
+    <unk> last, <s>/<e> wrapping, n-gram emission."""
+    import io
+    import tarfile
+    d = tmp_path / "imikolov"
+    d.mkdir()
+    train_txt = b"the cat sat\nthe cat ran\n"
+    valid_txt = b"the dog sat\n"
+    with tarfile.open(d / "simple-examples.tgz", "w:gz") as tar:
+        for name, blob in (("./simple-examples/data/ptb.train.txt",
+                            train_txt),
+                           ("./simple-examples/data/ptb.valid.txt",
+                            valid_txt)):
+            info = tarfile.TarInfo(name)
+            info.size = len(blob)
+            tar.addfile(info, io.BytesIO(blob))
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+    from paddle_tpu.dataset import imikolov
+    wd = imikolov.build_dict(min_word_freq=1)
+    # freqs: the=3, <e>=3, cat=2, sat=2 (>1 kept); ties alphabetical
+    assert list(wd)[:4] == ["<e>", "the", "cat", "sat"]
+    assert wd["<unk>"] == 4
+    grams = list(imikolov.train(wd, 2)())
+    # first line -> <s> the cat sat <e>: 4 bigrams, <s> is unk
+    assert grams[0] == (wd["<unk>"], wd["the"])
+    assert (wd["cat"], wd["sat"]) in grams
+    assert grams[3] == (wd["sat"], wd["<e>"])
+
+
+def test_imdb_real_tar_parsed(tmp_path, monkeypatch):
+    """Real aclImdb_v1.tar.gz: pos=0/neg=1 labels, punctuation-stripped
+    lowercase tokens, (-freq, word) vocab with <unk> last."""
+    import io
+    import tarfile
+    d = tmp_path / "imdb"
+    d.mkdir()
+    docs = {"aclImdb/train/pos/0_9.txt": b"Great GREAT movie!",
+            "aclImdb/train/neg/0_2.txt": b"awful movie.",
+            "aclImdb/test/pos/0_8.txt": b"great",
+            "aclImdb/test/neg/0_3.txt": b"awful"}
+    with tarfile.open(d / "aclImdb_v1.tar.gz", "w:gz") as tar:
+        for name, blob in docs.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(blob)
+            tar.addfile(info, io.BytesIO(blob))
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+    from paddle_tpu.dataset import imdb
+    wd = imdb.word_dict()
+    # freqs: great=3, awful=2, movie=2 -> great, awful, movie (tie alpha)
+    assert list(wd) == ["great", "awful", "movie", "<unk>"]
+    rows = list(imdb.train(wd)())
+    assert ([wd["great"], wd["great"], wd["movie"]], 0) in rows
+    assert ([wd["awful"], wd["movie"]], 1) in rows
+
+
+def test_movielens_real_zip_parsed(tmp_path, monkeypatch):
+    """Real ml-1m.zip: ::-separated members, gender/age/job encoding,
+    corpus-built category+title dicts, seeded 90/10 split."""
+    import zipfile
+    d = tmp_path / "movielens"
+    d.mkdir()
+    with zipfile.ZipFile(d / "ml-1m.zip", "w") as z:
+        z.writestr("ml-1m/users.dat",
+                   "1::F::1::10::48067\n2::M::56::16::70072\n")
+        z.writestr("ml-1m/movies.dat",
+                   "1::Toy Story (1995)::Animation|Comedy\n"
+                   "2::Heat (1995)::Action\n")
+        z.writestr("ml-1m/ratings.dat",
+                   "1::1::5::978300760\n2::2::3::978301968\n"
+                   "1::2::4::978302109\n")
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+    import paddle_tpu.dataset.movielens as ml
+    ml._META = None   # drop any cached synthetic/other-path meta
+    assert ml.max_user_id() == 2 and ml.max_movie_id() == 2
+    assert ml.max_job_id() == 16
+    cats = ml.movie_categories()
+    assert set(cats) == {"Action", "Animation", "Comedy"}
+    users = ml.user_info()
+    assert users[1] == (1, 1, 0, 10)       # F -> 1, age 1 -> index 0
+    assert users[2][1:3] == (0, 6)         # M -> 0, age 56 -> index 6
+    rows = list(ml.train()()) + list(ml.test()())
+    assert len(rows) == 3
+    row = next(r for r in rows if r[0] == 1 and r[4] == 1)
+    assert row[7][0] == 5.0
+    title_d = ml.get_movie_title_dict()
+    # year stripped, words lowercased (reference movielens.py:106-127)
+    assert set(title_d) == {"toy", "story", "heat"}
+    assert row[6] == [title_d["toy"], title_d["story"]]
+    ml._META = None
+
+
+def test_conll05_real_files_parsed(tmp_path, monkeypatch):
+    """Real conll05st files: dict line-indexing, B-/I- label dict, props
+    span -> BIO conversion, predicate ctx +-2 broadcast, mark window."""
+    import gzip as _gzip
+    import io
+    import tarfile
+    d = tmp_path / "conll05"
+    d.mkdir()
+    (d / "wordDict.txt").write_text("the\ncat\nsat\nhere\n")
+    (d / "verbDict.txt").write_text("sit\n")
+    (d / "targetDict.txt").write_text("B-A0\nI-A0\nB-V\nO\n")
+    # one 4-token sentence, one predicate column: "(A0*  *)  (V*)  *"
+    words = b"the\ncat\nsat\nhere\n\n"
+    props = (b"-\t(A0*\n-\t*)\nsit\t(V*)\n-\t*\n\n")
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        for name, blob in (
+                ("conll05st-release/test.wsj/words/test.wsj.words.gz",
+                 _gzip.compress(words)),
+                ("conll05st-release/test.wsj/props/test.wsj.props.gz",
+                 _gzip.compress(props))):
+            info = tarfile.TarInfo(name)
+            info.size = len(blob)
+            tar.addfile(info, io.BytesIO(blob))
+    (d / "conll05st-tests.tar.gz").write_bytes(buf.getvalue())
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+    from paddle_tpu.dataset import conll05
+    wd, vd, ld = conll05.get_dict()
+    assert wd == {"the": 0, "cat": 1, "sat": 2, "here": 3}
+    assert vd == {"sit": 0}
+    # tags sorted: A0 then V -> B-A0=0 I-A0=1 B-V=2 I-V=3 O=4
+    assert ld["B-A0"] == 0 and ld["B-V"] == 2 and ld["O"] == 4
+    rows = list(conll05.test()())
+    assert len(rows) == 1
+    (w, n2, n1, c0, p1, p2, verb, mark, lab) = rows[0]
+    assert w == [0, 1, 2, 3]
+    # predicate at index 2 ("sat"): ctx -2=the -1=cat 0=sat +1=here +2=eos
+    assert n2 == [0] * 4 and n1 == [1] * 4 and c0 == [2] * 4
+    assert p1 == [3] * 4 and p2 == [0] * 4     # eos unk -> 0
+    assert verb == [0] * 4
+    assert mark == [1, 1, 1, 1]                # window covers all 4
+    assert lab == [ld["B-A0"], ld["I-A0"], ld["B-V"], ld["O"]]
+    # train() reads the same public test.wsj corpus (reference quirk)
+    assert list(conll05.train()()) == rows
+
+
+def _tar_with(path, members):
+    import io
+    import tarfile
+    with tarfile.open(path, "w:gz") as tar:
+        for name, blob in members.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(blob)
+            tar.addfile(info, io.BytesIO(blob))
+
+
+def test_wmt14_real_tgz_parsed(tmp_path, monkeypatch):
+    d = tmp_path / "wmt14"
+    d.mkdir()
+    _tar_with(d / "wmt14.tgz", {
+        "wmt14/src.dict": b"<s>\n<e>\n<unk>\nchat\nle\n",
+        "wmt14/trg.dict": b"<s>\n<e>\n<unk>\ncat\nthe\n",
+        "wmt14/train/train": b"le chat\tthe cat\nmystery\t\t\n",
+        "wmt14/test/test": b"le inconnu\tthe unknown\n"})
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+    from paddle_tpu.dataset import wmt14
+    rows = list(wmt14.train(5)())
+    # malformed 3-column line skipped; src wrapped <s>..<e>
+    assert rows == [([0, 4, 3, 1], [0, 4, 3], [4, 3, 1])]
+    te = list(wmt14.test(5)())
+    assert te == [([0, 4, 2, 1], [0, 4, 2], [4, 2, 1])]
+
+
+def test_wmt16_real_tar_parsed(tmp_path, monkeypatch):
+    d = tmp_path / "wmt16"
+    d.mkdir()
+    _tar_with(d / "wmt16.tar.gz", {
+        "wmt16/train": b"the cat\tdie katze\nthe dog\tder hund\n",
+        "wmt16/val": b"the cat\tdie katze\n",
+        "wmt16/test": b"a cat\teine katze\n"})
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+    import paddle_tpu.dataset.wmt16 as wmt16
+    wmt16._DICT_CACHE.clear()
+    en = wmt16.get_dict("en", 10)
+    # freq: the=2 then cat/dog alphabetical after marks 0/1/2
+    assert en["<s>"] == 0 and en["<e>"] == 1 and en["<unk>"] == 2
+    assert en["the"] == 3 and en["cat"] == 4 and en["dog"] == 5
+    rev = wmt16.get_dict("en", 10, reverse=True)
+    assert rev[3] == "the"
+    rows = list(wmt16.test(10, 10)())
+    de = wmt16.get_dict("de", 10)
+    # de dict from train only (freq ties alphabetical after the marks):
+    # der=3 die=4 hund=5 katze=6; "eine"/"a" unseen in train -> unk=2
+    assert de["katze"] == 6
+    assert rows == [([0, 2, 4, 1],
+                     [0, 2, de["katze"]],
+                     [2, de["katze"], 1])]
+    # de->en direction swaps columns
+    rows_de = list(wmt16.test(10, 10, src_lang="de")())
+    assert rows_de[0][0] == [0, 2, de["katze"], 1]
+    wmt16._DICT_CACHE.clear()
+
+
+def test_sentiment_real_zip_parsed(tmp_path, monkeypatch):
+    import zipfile
+    d = tmp_path / "sentiment"
+    d.mkdir()
+    with zipfile.ZipFile(d / "movie_reviews.zip", "w") as z:
+        z.writestr("movie_reviews/neg/cv000_1.txt", "bad film bad")
+        z.writestr("movie_reviews/neg/cv001_2.txt", "dull film")
+        z.writestr("movie_reviews/pos/cv000_3.txt", "good film")
+        z.writestr("movie_reviews/pos/cv001_4.txt", "great film good")
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+    from paddle_tpu.dataset import sentiment
+    wd = sentiment.get_word_dict()
+    # freq: film=4, bad=2, good=2 (tie alpha), dull=1, great=1
+    assert list(wd)[:3] == ["film", "bad", "good"]
+    tr = list(sentiment.train()())
+    te = list(sentiment.test()())
+    # 4 files interleaved neg/pos; 80% -> 3 train, 1 test
+    assert len(tr) == 3 and len(te) == 1
+    assert tr[0] == ([wd["bad"], wd["film"], wd["bad"]], 0)
+    assert tr[1] == ([wd["good"], wd["film"]], 1)
+    assert te[0][1] == 1
+
+
+def test_flowers_real_archives_parsed(tmp_path, monkeypatch):
+    import io
+    import tarfile
+    from PIL import Image
+    import scipy.io as scio
+    d = tmp_path / "flowers"
+    d.mkdir()
+    # two tiny jpgs
+    blobs = {}
+    for i, color in ((1, (255, 0, 0)), (2, (0, 255, 0))):
+        im = Image.new("RGB", (300, 280), color)
+        buf = io.BytesIO()
+        im.save(buf, "JPEG")
+        blobs["jpg/image_%05d.jpg" % i] = buf.getvalue()
+    with tarfile.open(d / "102flowers.tgz", "w:gz") as tar:
+        for name, blob in blobs.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(blob)
+            tar.addfile(info, io.BytesIO(blob))
+    scio.savemat(d / "imagelabels.mat",
+                 {"labels": np.array([[5, 9]])})
+    scio.savemat(d / "setid.mat",
+                 {"tstid": np.array([[1]]), "trnid": np.array([[2]]),
+                  "valid": np.array([[2]])})
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+    from paddle_tpu.dataset import flowers
+    tr = list(flowers.train()())
+    te = list(flowers.test()())
+    assert len(tr) == 1 and len(te) == 1
+    img, label = tr[0]     # train = tstid -> image 1, label 5 -> 4
+    assert label == 4 and te[0][1] == 8
+    assert img.shape == (3 * 224 * 224,) and img.dtype == np.float32
+    chw = img.reshape(3, 224, 224)
+    # red RGB image -> BGR channel 0 is blue(0) - mean_b, channel 2 red
+    assert abs(chw[0, 0, 0] - (0 - 103.94)) < 10.0
+    assert chw[2, 0, 0] > 100.0
+
+
+def test_voc2012_real_tar_parsed(tmp_path, monkeypatch):
+    import io
+    import tarfile
+    from PIL import Image
+    d = tmp_path / "voc2012"
+    d.mkdir()
+    img = Image.new("RGB", (20, 10), (10, 20, 30))
+    ibuf = io.BytesIO()
+    img.save(ibuf, "JPEG")
+    lab_arr = np.zeros((10, 20), np.uint8)
+    lab_arr[3, 3] = 7
+    lbl = Image.fromarray(lab_arr, mode="P")
+    # full 256-entry palette so PNG save can't remap the indices
+    lbl.putpalette([v for i in range(256) for v in (i, i, i)])
+    lbuf = io.BytesIO()
+    lbl.save(lbuf, "PNG")
+    members = {
+        "VOCdevkit/VOC2012/ImageSets/Segmentation/trainval.txt":
+            b"2007_000001\n",
+        "VOCdevkit/VOC2012/ImageSets/Segmentation/train.txt":
+            b"2007_000001\n",
+        "VOCdevkit/VOC2012/ImageSets/Segmentation/val.txt":
+            b"2007_000001\n",
+        "VOCdevkit/VOC2012/JPEGImages/2007_000001.jpg": ibuf.getvalue(),
+        "VOCdevkit/VOC2012/SegmentationClass/2007_000001.png":
+            lbuf.getvalue(),
+    }
+    with tarfile.open(d / "VOCtrainval_11-May-2012.tar", "w") as tar:
+        for name, blob in members.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(blob)
+            tar.addfile(info, io.BytesIO(blob))
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+    from paddle_tpu.dataset import voc2012
+    rows = list(voc2012.train()())
+    assert len(rows) == 1
+    im, lab = rows[0]
+    # reference contract: raw HWC uint8 image, HW uint8 palette label
+    assert im.shape == (10, 20, 3) and im.dtype == np.uint8
+    assert lab.shape == (10, 20) and lab[3, 3] == 7 and lab[0, 0] == 0
+
+
+def test_mq2007_real_letor_file_parsed(tmp_path, monkeypatch):
+    d = tmp_path / "mq2007" / "Fold1"
+    d.mkdir(parents=True)
+    feats1 = " ".join("%d:%0.1f" % (i + 1, 0.1 * i) for i in range(46))
+    feats2 = " ".join("%d:%0.1f" % (i + 1, 0.2) for i in range(46))
+    feats3 = " ".join("%d:%0.1f" % (i + 1, 0.3) for i in range(46))
+    (d / "train.txt").write_text(
+        "0 qid:1 %s #docid=a\n"
+        "2 qid:1 %s #docid=b\n"
+        "0 qid:2 %s #docid=c\n" % (feats1, feats2, feats3))
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+    from paddle_tpu.dataset import mq2007
+    pairs = list(mq2007.train("pairwise")())
+    # qid=2 filtered (all-zero relevance); qid=1 -> one ordered pair
+    assert len(pairs) == 1
+    label, hi, lo = pairs[0]
+    assert label.tolist() == [1]
+    np.testing.assert_allclose(hi, np.full(46, 0.2, np.float32))
+    np.testing.assert_allclose(
+        lo, np.arange(46, dtype=np.float32) * np.float32(0.1), rtol=1e-6)
+    rows = list(mq2007.train("listwise")())
+    assert len(rows) == 1
+    rels, fs = rows[0]
+    assert rels.tolist() == [[2], [0]] and fs.shape == (2, 46)
