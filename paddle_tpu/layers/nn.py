@@ -203,7 +203,7 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
 
 def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
            pool_padding=0, global_pooling=False, use_cudnn=True,
-           ceil_mode=False, use_mkldnn=False, name=None):
+           ceil_mode=False, use_mkldnn=False, name=None, exclusive=True):
     helper = LayerHelper("pool2d", **locals())
     if isinstance(pool_size, int):
         pool_size = [pool_size, pool_size]
@@ -217,7 +217,8 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
                      attrs={"pooling_type": pool_type, "ksize": pool_size,
                             "strides": pool_stride, "paddings": pool_padding,
                             "global_pooling": global_pooling,
-                            "ceil_mode": ceil_mode})
+                            "ceil_mode": ceil_mode,
+                            "exclusive": exclusive})
     return out
 
 

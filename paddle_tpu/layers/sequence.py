@@ -17,7 +17,8 @@ __all__ = [
     "dynamic_lstm", "dynamic_lstmp", "dynamic_gru", "lstm_unit", "gru_unit",
     "sequence_conv",
     "sequence_pool", "sequence_softmax", "sequence_expand", "sequence_concat",
-    "sequence_reshape", "sequence_slice", "sequence_erase",
+    "sequence_reshape", "sequence_reverse", "sequence_slice",
+    "sequence_erase",
     "sequence_first_step", "sequence_last_step", "lod_reset", "row_conv",
     "linear_chain_crf", "crf_decoding", "warpctc", "ctc_greedy_decoder",
     "chunk_eval", "nce", "kmax_seq_score", "sub_nested_seq",
@@ -269,13 +270,30 @@ def sequence_reshape(input, new_dim):
     return out
 
 
+def sequence_reverse(x, name=None):
+    """Reverse each sequence's rows in place (per-sequence flip).
+    reference: operators/sequence_reverse_op.h."""
+    helper = LayerHelper("sequence_reverse", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.lod_level = x.lod_level
+    out.shape = x.shape
+    helper.append_op(type="sequence_reverse", inputs={"X": [x]},
+                     outputs={"Y": [out]})
+    return out
+
+
 def sequence_slice(input, offset, length, name=None):
+    """``offset=None`` slices from each sequence's begin; ``length=None``
+    slices to its end (v1 seq_slice_layer's open-ended sides)."""
     helper = LayerHelper("sequence_slice", **locals())
     out = helper.create_variable_for_type_inference(input.dtype)
     out.lod_level = input.lod_level
-    helper.append_op(type="sequence_slice",
-                     inputs={"X": [input], "Offset": [offset],
-                             "Length": [length]},
+    inputs = {"X": [input]}
+    if offset is not None:
+        inputs["Offset"] = [offset]
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op(type="sequence_slice", inputs=inputs,
                      outputs={"Out": [out]})
     return out
 

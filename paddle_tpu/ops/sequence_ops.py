@@ -348,15 +348,28 @@ def sequence_slice(ctx):
     """reference: operators/sequence_slice_op.cc (eager-only: ragged output
     sizes are data-dependent)."""
     x = ctx.input("X")
-    offset = np.asarray(raw_data(ctx.input("Offset"))).reshape(-1)
-    length = np.asarray(raw_data(ctx.input("Length"))).reshape(-1)
     data = np.asarray(raw_data(x))
     offs = np.asarray(seq_offsets(x))
+    seq_lens = offs[1:] - offs[:-1]
+    off_v, len_v = ctx.input("Offset"), ctx.input("Length")
+    # either side may be absent (v1 seq_slice_layer's open-ended
+    # slices): missing Offset = sequence begin, missing Length = to end
+    offset = (np.asarray(raw_data(off_v)).reshape(-1) if off_v is not None
+              else np.zeros(len(seq_lens), np.int64))
+    length = (np.asarray(raw_data(len_v)).reshape(-1) if len_v is not None
+              else seq_lens - offset)
     pieces, lens = [], []
     for i in range(len(offs) - 1):
-        s = int(offs[i] + offset[i])
-        pieces.append(data[s:s + int(length[i])])
-        lens.append(int(length[i]))
+        o, ln, sl = int(offset[i]), int(length[i]), int(seq_lens[i])
+        if o < 0 or ln < 0 or o + ln > sl:
+            # reference PADDLE_ENFORCE in sequence_slice_op.h — fail at
+            # the fault site instead of emitting a corrupt LoD
+            raise ValueError(
+                "sequence_slice: seq %d has %d rows but offset=%d "
+                "length=%d" % (i, sl, o, ln))
+        s = int(offs[i]) + o
+        pieces.append(data[s:s + ln])
+        lens.append(ln)
     out = np.concatenate(pieces, axis=0) if pieces else data[:0]
     new_offs = np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
     ctx.set_output("Out", TracedLoD(jnp.asarray(out),

@@ -251,13 +251,11 @@ def img_pool_layer(input, pool_size, name=None, num_channels=None,
                    pool_type=None, stride=1, padding=0, layer_attr=None,
                    pool_size_y=None, stride_y=None, padding_y=None,
                    ceil_mode=True, exclude_mode=None):
-    """reference: layers.py img_pool_layer. ``exclude_mode`` (padded-
-    border divisor choice for avg pool) is not mapped; only the default
-    is supported."""
-    if exclude_mode is not None:
-        raise NotImplementedError(
-            "img_pool_layer exclude_mode is not supported (XLA avg pool "
-            "uses the include-padding divisor)")
+    """reference: layers.py img_pool_layer. ``exclude_mode`` maps onto
+    the pool op's ``exclusive`` attr: the gserver avg pool's
+    excludeMode divisor choice (reference: math/Matrix.h:915
+    ``excludeMode = true`` default — padding cells excluded from the
+    average unless exclude_mode=False)."""
     var, c, h, w = _as_image(input, num_channels)
     pt = (pool_type or MaxPooling()).name
     is_sum = pt == "sum"
@@ -266,9 +264,15 @@ def img_pool_layer(input, pool_size, name=None, num_channels=None,
     py = pool_size_y or pool_size
     sy = stride_y or stride
     pdy = padding_y if padding_y is not None else padding
+    # sum pool: avg * full-window-area is exact only with the INCLUSIVE
+    # divisor (padding cells contribute 0 to the sum); avg pool follows
+    # exclude_mode (gserver default excludeMode=true)
     out = F.pool2d(var, pool_size=(pool_size, py), pool_type=pt,
                    pool_stride=(stride, sy), pool_padding=(padding, pdy),
-                   ceil_mode=ceil_mode, name=name)
+                   ceil_mode=ceil_mode, name=name,
+                   exclusive=(False if is_sum
+                              else True if exclude_mode is None
+                              else bool(exclude_mode)))
     if is_sum:
         out = F.scale(out, scale=float(pool_size * py))
 
@@ -702,12 +706,27 @@ def memory(name, size=None, boot_layer=None, is_seq=False):
 def recurrent_group(step, input, reverse=False, name=None):
     """Run ``step`` over the sequence(s); memories recur by name
     (reference: layers.py recurrent_group -> RecurrentGradientMachine).
-    Maps onto DynamicRNN: ragged batches shrink as sequences end."""
-    if reverse:
-        raise NotImplementedError(
-            "reverse=True: reverse the sequences at the reader (or use "
-            "lstmemory/grumemory reverse=True, which scan backward)")
+    Maps onto DynamicRNN: ragged batches shrink as sequences end.
+
+    ``reverse=True`` scans each sequence back-to-front like the
+    reference's reversed RecurrentGradientMachine: sequence inputs are
+    per-sequence flipped going in and the outputs flipped back, so
+    output rows stay aligned with the original time order."""
     inputs = list(input) if isinstance(input, (list, tuple)) else [input]
+    if reverse:
+        inputs = [i if isinstance(i, StaticInput) else
+                  LayerOutput(None, F.sequence_reverse(i.var),
+                              size=i.size)
+                  for i in inputs]
+        # name=None: the inner group's output is immediately rewrapped;
+        # registering `name` for both vars would trip the duplicate-step
+        # -layer check when built inside another group's step
+        fwd = recurrent_group(step, inputs, reverse=False, name=None)
+        if isinstance(fwd, (list, tuple)):
+            return [LayerOutput(name, F.sequence_reverse(o.var),
+                                size=o.size) for o in fwd]
+        return LayerOutput(name, F.sequence_reverse(fwd.var),
+                           size=fwd.size)
     rnn = F.DynamicRNN()
     ctx = {"memories": [], "made": {}, "rnn": rnn}
 
@@ -1426,13 +1445,17 @@ def seq_concat_layer(a, b, act=None, name=None, layer_attr=None,
 def seq_slice_layer(input, starts, ends, name=None):
     """reference: layers.py seq_slice_layer (SequenceSliceLayer). starts/
     ends are [n_seqs, 1] integer layers; either may be None (sequence
-    begin / end)."""
-    if starts is None or ends is None:
-        raise NotImplementedError(
-            "seq_slice_layer needs both starts and ends here (open-ended "
-            "slices need runtime sequence lengths as a feed)")
-    offsets = starts.var
-    lengths = F.elementwise_sub(ends.var, starts.var)
+    begin / end — the op fills the missing side from each sequence's
+    actual bounds)."""
+    if starts is None and ends is None:
+        raise ValueError("seq_slice_layer: starts and ends are both None")
+    offsets = starts.var if starts is not None else None
+    if ends is None:
+        lengths = None           # to each sequence's end
+    elif starts is None:
+        lengths = ends.var       # from begin: length = end index
+    else:
+        lengths = F.elementwise_sub(ends.var, starts.var)
     out = F.sequence_slice(input.var, offsets, lengths)
     return LayerOutput(name or out.name, out, size=input.size)
 

@@ -608,3 +608,125 @@ def test_pool3d_rejects_sum_like_reference():
     x = tch.data_layer("vol", size=8, depth=2, height=2, width=2)
     with pytest.raises(ValueError, match="max-projection"):
         tch.img_pool3d_layer(x, pool_size=2, pool_type=tch.SumPooling())
+
+
+def test_recurrent_group_reverse_scans_backward():
+    """reverse=True runs the step back-to-front per sequence; a
+    running-sum memory therefore accumulates suffix sums, emitted in
+    original time order (reference: reversed RecurrentGradientMachine)."""
+    rng = np.random.RandomState(13)
+    seqs = [rng.rand(4, 3).astype("float32"),
+            rng.rand(2, 3).astype("float32")]
+    x = tch.data_layer("s", size=3, is_seq=True)
+
+    def step(ipt):
+        mem = tch.memory(name="acc", size=3)
+        acc = tch.addto_layer([mem, ipt], name="acc",
+                              act=tch.LinearActivation(), bias_attr=False)
+        return acc
+
+    fwd = tch.recurrent_group(step=step, input=x)
+    rev = tch.recurrent_group(step=step, input=x, reverse=True)
+    o_f, o_r = _run([fwd, rev], {}, lod_feed={"s": build_lod_tensor(seqs)})
+    want_f = np.concatenate([np.cumsum(s, axis=0) for s in seqs])
+    # reverse: suffix sums, rows aligned to original positions
+    want_r = np.concatenate([np.cumsum(s[::-1], axis=0)[::-1]
+                             for s in seqs])
+    np.testing.assert_allclose(o_f, want_f, rtol=1e-5)
+    np.testing.assert_allclose(o_r, want_r, rtol=1e-5)
+
+
+def test_img_pool_exclude_mode_and_sum_padding():
+    """exclude_mode maps to the pool op's divisor choice; sum pooling
+    with padding stays exact (avg_inclusive * window_area)."""
+    img = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    x = tch.data_layer("img", size=16, height=4, width=4)
+    avg_ex = tch.img_pool_layer(x, pool_size=3, stride=3, padding=1,
+                                pool_type=tch.AvgPooling(),
+                                num_channels=1, ceil_mode=False)
+    avg_in = tch.img_pool_layer(x, pool_size=3, stride=3, padding=1,
+                                pool_type=tch.AvgPooling(),
+                                num_channels=1, ceil_mode=False,
+                                exclude_mode=False)
+    sm = tch.img_pool_layer(x, pool_size=3, stride=3, padding=1,
+                            pool_type=tch.SumPooling(),
+                            num_channels=1, ceil_mode=False)
+    o_ex, o_in, o_sm = _run([avg_ex, avg_in, sm],
+                            {"img": img.reshape(1, 16)})
+    padded = np.pad(img[0, 0], 1)
+    wins = [padded[0:3, 0:3], padded[0:3, 3:6],
+            padded[3:6, 0:3], padded[3:6, 3:6]]
+    valid = [4, 4, 4, 4]   # corner windows: 2x2 valid cells
+    np.testing.assert_allclose(
+        o_ex.reshape(-1), [w.sum() / v for w, v in zip(wins, valid)],
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        o_in.reshape(-1), [w.sum() / 9.0 for w in wins], rtol=1e-5)
+    np.testing.assert_allclose(
+        o_sm.reshape(-1), [w.sum() for w in wins], rtol=1e-5)
+
+
+def test_seq_slice_open_ended_sides():
+    """seq_slice_layer with starts=None (from begin) or ends=None (to
+    end) — reference SequenceSliceLayer's optional sides."""
+    rng = np.random.RandomState(14)
+    seqs = [rng.rand(5, 2).astype("float32"),
+            rng.rand(3, 2).astype("float32")]
+    x = tch.data_layer("s", size=2, is_seq=True)
+    starts = tch.data_layer("st", size=1)
+    ends = tch.data_layer("en", size=1)
+    from_begin = tch.seq_slice_layer(x, starts=None, ends=ends)
+    to_end = tch.seq_slice_layer(x, starts=starts, ends=None)
+    st = np.array([[1], [1]], np.int64)
+    en = np.array([[3], [2]], np.int64)
+    o_b, o_e = _run([from_begin, to_end],
+                    {"st": st, "en": en},
+                    lod_feed={"s": build_lod_tensor(seqs)})
+    np.testing.assert_allclose(
+        o_b, np.concatenate([seqs[0][:3], seqs[1][:2]]), rtol=1e-6)
+    np.testing.assert_allclose(
+        o_e, np.concatenate([seqs[0][1:], seqs[1][1:]]), rtol=1e-6)
+
+
+def test_recurrent_group_reverse_nested_named():
+    """A NAMED reversed group built while an enclosing group context is
+    active must not trip the duplicate-step-layer check: the inner
+    unreversed group's output is rewrapped, and registering the name for
+    both vars raised 'two step layers share the name' (r4 review
+    finding). An enclosing ctx is pushed directly — the registration
+    happens at LayerOutput construction, not at run time."""
+    from paddle_tpu.trainer_config_helpers import layers as v1_layers
+    rng = np.random.RandomState(15)
+    seqs = [rng.rand(3, 2).astype("float32")]
+    x = tch.data_layer("s", size=2, is_seq=True)
+
+    def inner_step(ipt):
+        mem = tch.memory(name="iacc", size=2)
+        return tch.addto_layer([mem, ipt], name="iacc",
+                               act=tch.LinearActivation(),
+                               bias_attr=False)
+
+    outer_ctx = {"memories": [], "made": {}, "rnn": None,
+                 "make_memory": None}
+    v1_layers._group_stack.append(outer_ctx)
+    try:
+        rev = tch.recurrent_group(step=inner_step, input=x, reverse=True,
+                                  name="inner")
+    finally:
+        v1_layers._group_stack.pop()
+    out, = _run([rev], {}, lod_feed={"s": build_lod_tensor(seqs)})
+    want = np.cumsum(seqs[0][::-1], axis=0)[::-1]
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_seq_slice_out_of_range_raises():
+    """Out-of-range offsets fail loudly instead of emitting a corrupt
+    LoD (r4 review finding; reference PADDLE_ENFORCE)."""
+    rng = np.random.RandomState(16)
+    seqs = [rng.rand(5, 2).astype("float32")]
+    x = tch.data_layer("s", size=2, is_seq=True)
+    starts = tch.data_layer("st", size=1)
+    sliced = tch.seq_slice_layer(x, starts=starts, ends=None)
+    with pytest.raises(Exception, match="sequence_slice"):
+        _run([sliced], {"st": np.array([[6]], np.int64)},
+             lod_feed={"s": build_lod_tensor(seqs)})
