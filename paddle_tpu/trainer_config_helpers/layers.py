@@ -259,6 +259,12 @@ def img_pool_layer(input, pool_size, name=None, num_channels=None,
     var, c, h, w = _as_image(input, num_channels)
     pt = (pool_type or MaxPooling()).name
     is_sum = pt == "sum"
+    if is_sum and exclude_mode is not None:
+        # sum pooling has no divisor for exclude_mode to choose; refuse
+        # loudly rather than silently dropping the argument
+        raise ValueError(
+            "img_pool_layer: exclude_mode is meaningless with "
+            "SumPooling (there is no divisor); remove the argument")
     if is_sum:  # spatial sum pool = avg * window area (reference semantics)
         pt = "avg"
     py = pool_size_y or pool_size

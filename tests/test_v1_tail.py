@@ -730,3 +730,13 @@ def test_seq_slice_out_of_range_raises():
     with pytest.raises(Exception, match="sequence_slice"):
         _run([sliced], {"st": np.array([[6]], np.int64)},
              lod_feed={"s": build_lod_tensor(seqs)})
+
+
+def test_img_pool_sum_with_exclude_mode_raises():
+    """exclude_mode has no meaning for sum pooling (no divisor): loud
+    ValueError instead of silently dropping the argument."""
+    x = tch.data_layer("imgx", size=16, height=4, width=4)
+    with pytest.raises(ValueError, match="SumPooling"):
+        tch.img_pool_layer(x, pool_size=2, stride=2,
+                           pool_type=tch.SumPooling(), num_channels=1,
+                           exclude_mode=True)
