@@ -22,8 +22,35 @@ __all__ = [
     "logical_or", "lod_rank_table", "max_sequence_len", "lod_tensor_to_array",
     "array_to_lod_tensor", "shrink_memory", "reorder_lod_tensor_by_rank",
     "beam_search", "beam_search_decode", "zeros_like",
-    "split_lod_tensor", "merge_lod_tensor",
+    "split_lod_tensor", "merge_lod_tensor", "Print",
 ]
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Print the tensor whenever it is accessed (works under jit via a
+    debug callback). ``first_n`` caps how many times this op prints;
+    ``summarize`` caps the printed element count.
+    reference: layers/control_flow.py:149 Print -> operators/print_op.cc.
+    The backward phase of print_phase is accepted but inert (the op is
+    no-gradient here; the reference prints gradients in that phase)."""
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = input.shape
+    out.lod_level = getattr(input, "lod_level", 0)
+    helper.append_op(
+        type="print", inputs={"In": [input]},
+        outputs={"Out": [out]},
+        attrs={"first_n": first_n, "summarize": summarize,
+               "message": message or "",
+               "print_tensor_name": print_tensor_name,
+               "print_tensor_type": print_tensor_type,
+               "print_tensor_shape": print_tensor_shape,
+               "print_tensor_lod": print_tensor_lod,
+               "print_phase": str(print_phase).upper()})
+    return out
 
 
 # -- compare / logical -------------------------------------------------------

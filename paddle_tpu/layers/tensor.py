@@ -15,7 +15,7 @@ __all__ = [
     "create_tensor", "create_parameter", "create_global_var", "cast",
     "concat", "sums", "assign", "fill_constant",
     "fill_constant_batch_size_like", "ones", "zeros", "argmax", "argmin",
-    "reverse", "increment",
+    "reverse", "increment", "autoincreased_step_counter",
 ]
 
 
@@ -148,6 +148,30 @@ def reverse(x, axis):
                      attrs={"axis": axis if isinstance(axis, (list, tuple))
                             else [axis]})
     return out
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Persistable int64 counter incremented by ``step`` once per
+    executed run; the first observed value is ``begin``.
+
+    Like the reference, the default name is the FIXED
+    ``@STEP_COUNTER@`` and an existing counter is returned as-is (no
+    second increment op), so every call site shares one global step —
+    two increments per run would make LR schedules decay double-speed.
+    reference: layers/tensor.py autoincreased_step_counter."""
+    from ..initializer import ConstantInitializer
+    name = counter_name or "@STEP_COUNTER@"
+    block = ir.default_main_program().global_block()
+    if block.has_var(name):
+        return block.var(name)
+    helper = LayerHelper("global_step_counter")
+    counter = helper.create_global_variable(
+        name=name, shape=(1,), dtype="int64", persistable=True)
+    helper.set_variable_initializer(
+        counter, ConstantInitializer(begin - step))
+    increment(counter, value=step, in_place=True)
+    counter.stop_gradient = True
+    return counter
 
 
 def increment(x, value=1.0, in_place=True):

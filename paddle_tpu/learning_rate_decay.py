@@ -20,16 +20,13 @@ __all__ = ["exponential_decay", "natural_exp_decay", "inverse_time_decay",
 
 
 def _decay_step_counter(begin=0):
-    """Persistable float32 step counter incremented once per executed step
-    (reference: layers/tensor.py autoincreased_step_counter)."""
-    helper = LayerHelper("global_step_counter")
-    counter = helper.create_global_variable(
-        name=unique_name.generate("@LR_DECAY_COUNTER@"),
-        shape=(1,), dtype="float32", persistable=True)
-    helper.set_variable_initializer(
-        counter, ConstantInitializer(float(begin - 1)))
-    layers.increment(counter, value=1.0, in_place=True)
-    return counter
+    """Shared float32 view of the LR-decay step counter (reference:
+    fluid's _decay_step_counter — autoincreased_step_counter under the
+    fixed '@LR_DECAY_COUNTER@' name, cast to float32; all schedules in
+    a program read the SAME counter, incremented once per step)."""
+    counter = layers.autoincreased_step_counter(
+        counter_name="@LR_DECAY_COUNTER@", begin=begin, step=1)
+    return layers.cast(counter, "float32")
 
 
 def _binary(op_type, x, y):

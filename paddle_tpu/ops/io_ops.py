@@ -77,13 +77,36 @@ def load_combine(ctx):
     ctx.set_outputs("Out", outs)
 
 
+_PRINT_COUNTS = {}
+
+
 @register_op("print", no_gradient=True)
 def print_op(ctx):
-    """reference: operators/print_op.cc — works under jit via debug callback."""
+    """reference: operators/print_op.cc — works under jit via debug
+    callback. Honors ``summarize`` (cap on printed elements) and
+    ``first_n`` (cap on print count — a host-side counter shared by all
+    executions of this op instance, like the reference's mutable
+    ``times_`` member)."""
     x = ctx.input("In") if ctx.has_input("In") else ctx.input("X")
     msg = ctx.attr("message", "")
-    jax.debug.print(msg + " {x}", x=raw_data(x))
+    summarize = int(ctx.attr("summarize", -1) or -1)
+    first_n = int(ctx.attr("first_n", -1) or -1)
+    data = raw_data(x)
+    shown = data.reshape(-1)[:summarize] if summarize > 0 else data
     slot = "Out" if ctx.output_names("Out") else "Output"
+    # the first_n budget must survive re-traces and eager re-invocation
+    # (the lowering runs once per trace on the jit path but once per
+    # STEP on the eager/hybrid paths) — key a process-level counter by
+    # the op's output var name, the analog of the reference print_op's
+    # mutable times_ member
+    key = (ctx.output_names(slot) or [msg])[0]
+
+    def emit(v):
+        _PRINT_COUNTS[key] = _PRINT_COUNTS.get(key, 0) + 1
+        if first_n < 0 or _PRINT_COUNTS[key] <= first_n:
+            print("%s %s" % (msg, v), flush=True)
+
+    jax.debug.callback(emit, shown)
     ctx.set_output(slot, x)
 
 

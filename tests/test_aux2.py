@@ -310,3 +310,52 @@ def test_hybrid_degradation_logged_once(caplog):
             if "host-path op" in r.message]
     assert len(msgs) == 1, msgs
     assert "conditional_block" in msgs[0]
+
+
+def test_print_layer_and_step_counter(capsys):
+    """fluid.layers.Print passes through under jit (summarize + first_n
+    honored) and autoincreased_step_counter counts executed runs
+    (reference: layers/control_flow.py:149 Print, layers/tensor.py
+    autoincreased_step_counter)."""
+    import paddle_tpu as fluid
+    fluid.switch_main_program(fluid.Program())
+    fluid.switch_startup_program(fluid.Program())
+    x = fluid.layers.data("px", shape=[4], dtype="float32")
+    y = fluid.layers.Print(x, message="dbg:", summarize=2, first_n=2)
+    out = fluid.layers.scale(y, scale=2.0)
+    step = fluid.layers.autoincreased_step_counter(begin=1, step=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(fluid.default_startup_program())
+        xv = np.arange(8, dtype=np.float32).reshape(2, 4)
+        for i in range(3):
+            o, s = exe.run(feed={"px": xv}, fetch_list=[out, step])
+            np.testing.assert_allclose(np.asarray(o), xv * 2, rtol=1e-6)
+            assert int(np.asarray(s).reshape(-1)[0]) == i + 1
+    printed = capsys.readouterr().out
+    assert printed.count("dbg:") == 2       # first_n caps the emissions
+    first = printed.splitlines()[0]
+    # summarize=2: the flattened first two elements [0, 1], nothing more
+    assert "[0. 1.]" in first, first
+
+
+def test_step_counter_shared_single_increment():
+    """Two call sites sharing a counter name read the SAME variable and
+    the counter advances by exactly one step per run (r4 review finding:
+    a second increment op would make LR schedules decay double-speed)."""
+    import paddle_tpu as fluid
+    fluid.switch_main_program(fluid.Program())
+    fluid.switch_startup_program(fluid.Program())
+    a = fluid.layers.autoincreased_step_counter()
+    b = fluid.layers.autoincreased_step_counter()
+    assert a.name == b.name == "@STEP_COUNTER@"
+    n_inc = sum(1 for op in
+                fluid.default_main_program().global_block().ops
+                if op.type == "increment")
+    assert n_inc == 1, n_inc
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(fluid.default_startup_program())
+        for i in range(3):
+            s, = exe.run(fetch_list=[a])
+            assert int(np.asarray(s).reshape(-1)[0]) == i + 1
