@@ -5,11 +5,37 @@ Xavier/MSRA each appending an init op to the startup block).
 """
 from __future__ import annotations
 
+import contextlib
 import math
 
 import numpy as np
 
 from .core import ir
+
+_force_init_on_cpu_ = False
+
+
+def force_init_on_cpu():
+    """Whether initializers are currently pinned to host (reference:
+    initializer.py:27). Advisory here: the startup program is one jitted
+    XLA computation and placement is the Executor's — the flag is kept
+    for API parity and read by code porting the reference's
+    GPU-counter-on-CPU idiom."""
+    return _force_init_on_cpu_
+
+
+@contextlib.contextmanager
+def init_on_cpu():
+    """``with init_on_cpu():`` scope marking initializers host-pinned
+    (reference: initializer.py:32). See force_init_on_cpu for why this
+    is advisory on TPU."""
+    global _force_init_on_cpu_
+    prev = _force_init_on_cpu_
+    _force_init_on_cpu_ = True
+    try:
+        yield
+    finally:
+        _force_init_on_cpu_ = prev
 
 
 class Initializer(object):

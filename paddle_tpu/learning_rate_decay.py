@@ -11,8 +11,6 @@ from __future__ import annotations
 import math
 
 from . import layers
-from .core import unique_name
-from .initializer import ConstantInitializer
 from .layers.layer_helper import LayerHelper
 
 __all__ = ["exponential_decay", "natural_exp_decay", "inverse_time_decay",

@@ -91,23 +91,45 @@ def print_op(ctx):
     msg = ctx.attr("message", "")
     summarize = int(ctx.attr("summarize", -1) or -1)
     first_n = int(ctx.attr("first_n", -1) or -1)
+    phase = str(ctx.attr("print_phase", "BOTH")).upper()
     data = raw_data(x)
-    shown = data.reshape(-1)[:summarize] if summarize > 0 else data
     slot = "Out" if ctx.output_names("Out") else "Output"
+    ctx.set_output(slot, x)
+    if phase == "BACKWARD":
+        # the reference prints only gradients in this phase; this op is
+        # no-gradient here, so the faithful forward behavior is silence
+        # (NOT printing the forward tensor every step)
+        return
+    shown = data.reshape(-1)[:summarize] if summarize > 0 else data
+    out_name = (ctx.output_names(slot) or [msg])[0]
+    header = [msg] if msg else []
+    if ctx.attr("print_tensor_name", True):
+        header.append("name: %s" % out_name)
+    if ctx.attr("print_tensor_type", True):
+        header.append("dtype: %s" % data.dtype)
+    if ctx.attr("print_tensor_shape", True):
+        header.append("shape: %s" % (tuple(data.shape),))
+    if ctx.attr("print_tensor_lod", True) and getattr(x, "lod", None):
+        try:
+            header.append("lod: %s" % ([list(map(int, np.asarray(l)))
+                                        for l in x.lod],))
+        except Exception:
+            pass  # offsets are traced inside jit — shape info only
+    prefix = "  ".join(header)
     # the first_n budget must survive re-traces and eager re-invocation
     # (the lowering runs once per trace on the jit path but once per
     # STEP on the eager/hybrid paths) — key a process-level counter by
-    # the op's output var name, the analog of the reference print_op's
-    # mutable times_ member
-    key = (ctx.output_names(slot) or [msg])[0]
+    # (program uid, output var name): stable across steps of one
+    # program, never shared with a rebuilt program even when
+    # unique_name counters were reset (r4 review finding)
+    key = (ctx.block.program._uid, out_name)
 
     def emit(v):
         _PRINT_COUNTS[key] = _PRINT_COUNTS.get(key, 0) + 1
         if first_n < 0 or _PRINT_COUNTS[key] <= first_n:
-            print("%s %s" % (msg, v), flush=True)
+            print("%s %s" % (prefix, v), flush=True)
 
     jax.debug.callback(emit, shown)
-    ctx.set_output(slot, x)
 
 
 @register_op("feed", no_gradient=True)

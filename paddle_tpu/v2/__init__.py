@@ -29,6 +29,17 @@ from .inference import infer, Inference  # noqa: F401
 from .. import dataset            # noqa: F401
 from .. import reader             # noqa: F401
 
+# make the reference's import idioms resolvable as module paths too
+# (``import paddle.v2.dataset.mnist`` etc., not just attribute access)
+import sys as _sys
+
+_sys.modules[__name__ + ".dataset"] = dataset
+for _n in getattr(dataset, "__all__", ()):
+    _sub = getattr(dataset, _n, None)
+    if _sub is not None:
+        _sys.modules["%s.dataset.%s" % (__name__, _n)] = _sub
+_sys.modules[__name__ + ".reader"] = reader
+
 
 def init(use_gpu=False, trainer_count=1, **kwargs):
     """reference: python/paddle/v2/__init__.py init() (swig_paddle.initPaddle

@@ -31,6 +31,11 @@ SMOKE_FILES = {
     "test_models.py",
     "test_parallel.py",
     "test_registry_audit.py",
+    # serialization goldens: seconds to run, and the class of drift they
+    # catch (op attrs changing the serialized program form) comes
+    # exactly from the op/layer edits smoke is meant to gate
+    "test_config_serialization.py",
+    "test_detection.py",
 }
 
 

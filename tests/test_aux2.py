@@ -359,3 +359,33 @@ def test_step_counter_shared_single_increment():
         for i in range(3):
             s, = exe.run(fetch_list=[a])
             assert int(np.asarray(s).reshape(-1)[0]) == i + 1
+
+
+def test_print_first_n_fresh_program_fresh_budget(capsys):
+    """A rebuilt program gets its own first_n budget even when
+    unique_name counters were reset, and print_phase='backward' is
+    silent on forward (r4 review findings)."""
+    import paddle_tpu as fluid
+
+    def build_and_run(phase="both"):
+        fluid.switch_main_program(fluid.Program())
+        fluid.switch_startup_program(fluid.Program())
+        x = fluid.layers.data("px", shape=[2], dtype="float32")
+        y = fluid.layers.Print(x, message="fresh:", first_n=1,
+                               print_phase=phase)
+        out = fluid.layers.scale(y, scale=1.0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(fluid.default_startup_program())
+            for _ in range(2):
+                exe.run(feed={"px": np.ones((1, 2), np.float32)},
+                        fetch_list=[out])
+
+    from paddle_tpu.core import unique_name
+    for _ in range(2):
+        with unique_name.guard():
+            build_and_run()
+    assert capsys.readouterr().out.count("fresh:") == 2  # 1 per program
+    with unique_name.guard():
+        build_and_run(phase="backward")
+    assert capsys.readouterr().out.count("fresh:") == 0
