@@ -34,8 +34,10 @@ def Print(input, first_n=-1, message=None, summarize=-1,
     debug callback). ``first_n`` caps how many times this op prints;
     ``summarize`` caps the printed element count.
     reference: layers/control_flow.py:149 Print -> operators/print_op.cc.
-    The backward phase of print_phase is accepted but inert (the op is
-    no-gradient here; the reference prints gradients in that phase)."""
+    ``print_phase='backward'`` is fully silent: the reference prints
+    only gradients in that phase and this op is no-gradient here, so
+    the faithful behavior is to emit nothing (not to print the forward
+    tensor)."""
     helper = LayerHelper("print")
     out = helper.create_variable_for_type_inference(input.dtype)
     out.shape = input.shape

@@ -134,10 +134,17 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
 
 def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
                      padding=0, stride=1, dilation=1, param_attr=None,
-                     bias_attr=None, use_cudnn=True, act=None, name=None):
+                     bias_attr=None, use_cudnn=True, act=None, name=None,
+                     groups=None):
     helper = LayerHelper("conv2d_transpose", **locals())
     dtype = helper.input_dtype()
     num_channels = input.shape[1]
+    groups = groups or 1
+    if num_filters % groups or num_channels % groups:
+        raise ValueError(
+            "conv2d_transpose: num_filters (%d) and input channels (%d) "
+            "must both be divisible by groups (%d)"
+            % (num_filters, num_channels, groups))
     if isinstance(stride, int):
         stride = [stride, stride]
     if isinstance(padding, int):
@@ -152,7 +159,7 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
                        ow - (w - 1) * stride[1] + 2 * padding[1]]
     elif isinstance(filter_size, int):
         filter_size = [filter_size, filter_size]
-    filter_shape = [num_channels, num_filters] + list(filter_size)
+    filter_shape = [num_channels, num_filters // groups] + list(filter_size)
     w = helper.create_parameter(helper.param_attr, shape=filter_shape,
                                 dtype=dtype)
     pre_bias = helper.create_variable_for_type_inference(dtype)
@@ -160,14 +167,14 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
                      inputs={"Input": [input], "Filter": [w]},
                      outputs={"Output": [pre_bias]},
                      attrs={"strides": stride, "paddings": padding,
-                            "dilations": dilation})
+                            "dilations": dilation, "groups": groups})
     pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
     return helper.append_activation(pre_act)
 
 
 def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
                      padding=0, stride=1, dilation=1, param_attr=None,
-                     bias_attr=None, act=None, name=None):
+                     bias_attr=None, act=None, name=None, groups=None):
     """reference: operators/conv_transpose_op.cc 3d registration (and the
     v1 DeConv3DLayer, gserver/layers/DeConv3DLayer.cpp). NCDHW, filter
     IODHW."""
@@ -188,7 +195,13 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
                        for i in range(3)]
     elif isinstance(filter_size, int):
         filter_size = [filter_size] * 3
-    filter_shape = [num_channels, num_filters] + list(filter_size)
+    groups = groups or 1
+    if num_filters % groups or num_channels % groups:
+        raise ValueError(
+            "conv3d_transpose: num_filters (%d) and input channels (%d) "
+            "must both be divisible by groups (%d)"
+            % (num_filters, num_channels, groups))
+    filter_shape = [num_channels, num_filters // groups] + list(filter_size)
     w = helper.create_parameter(helper.param_attr, shape=filter_shape,
                                 dtype=dtype)
     pre_bias = helper.create_variable_for_type_inference(dtype)
@@ -196,7 +209,7 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
                      inputs={"Input": [input], "Filter": [w]},
                      outputs={"Output": [pre_bias]},
                      attrs={"strides": stride, "paddings": padding,
-                            "dilations": dilation})
+                            "dilations": dilation, "groups": groups})
     pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
     return helper.append_activation(pre_act)
 

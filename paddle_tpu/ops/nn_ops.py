@@ -407,6 +407,7 @@ def _infer_conv2d_transpose(op, block):
     d = op.attr("dilations", [1, 1])
     n, _, h, w = xv.shape
     _, oc, kh, kw = fv.shape
+    oc *= int(op.attr("groups", 1) or 1)
     ov.shape = (n, oc,
                 (h - 1) * s[0] - 2 * p[0] + (kh - 1) * d[0] + 1,
                 (w - 1) * s[1] - 2 * p[1] + (kw - 1) * d[1] + 1)
@@ -428,18 +429,33 @@ def conv2d_transpose(ctx):
     s = ctx.attr("strides", [1, 1])
     p = ctx.attr("paddings", [0, 0])
     d = ctx.attr("dilations", [1, 1])
+    g = int(ctx.attr("groups", 1) or 1)
     kh, kw = w.shape[2], w.shape[3]
     keh = (kh - 1) * d[0] + 1  # effective (dilated) kernel extents
     kew = (kw - 1) * d[1] + 1
     out = jax.lax.conv_general_dilated(
-        x, jnp.flip(w, (2, 3)),
+        x, jnp.flip(_regroup_transpose_filter(w, g), (2, 3)),
         window_strides=(1, 1),
         padding=[(keh - 1 - p[0], keh - 1 - p[0]),
                  (kew - 1 - p[1], kew - 1 - p[1])],
         lhs_dilation=tuple(s),
         rhs_dilation=tuple(d),
-        dimension_numbers=("NCHW", "IOHW", "NCHW"))
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        feature_group_count=g)
     ctx.set_output("Output", out)
+
+
+def _regroup_transpose_filter(w, groups):
+    """Paddle transpose-conv filters are [C_in, F/G, k...]; lax's grouped
+    conv wants [C_in/G, F, k...] with output chunks group-major —
+    W_lax[i, g*(F/G)+j] = W[g*(C_in/G)+i, j]."""
+    if groups in (None, 1):
+        return w
+    c, fg = w.shape[0], w.shape[1]
+    rest = tuple(w.shape[2:])
+    w = w.reshape((groups, c // groups, fg) + rest)
+    w = jnp.moveaxis(w, 0, 1)
+    return w.reshape((c // groups, groups * fg) + rest)
 
 
 def _infer_conv3d_transpose(op, block):
@@ -452,7 +468,7 @@ def _infer_conv3d_transpose(op, block):
     p = op.attr("paddings", [0, 0, 0])
     d = op.attr("dilations", [1, 1, 1])
     n = xv.shape[0]
-    oc = fv.shape[1]
+    oc = fv.shape[1] * int(op.attr("groups", 1) or 1)
     spatial = tuple(
         (xv.shape[2 + i] - 1) * s[i] - 2 * p[i]
         + (fv.shape[2 + i] - 1) * d[i] + 1 for i in range(3))
@@ -470,14 +486,16 @@ def conv3d_transpose(ctx):
     s = ctx.attr("strides", [1, 1, 1])
     p = ctx.attr("paddings", [0, 0, 0])
     d = ctx.attr("dilations", [1, 1, 1])
+    g = int(ctx.attr("groups", 1) or 1)
     ke = [(w.shape[2 + i] - 1) * d[i] + 1 for i in range(3)]
     out = jax.lax.conv_general_dilated(
-        x, jnp.flip(w, (2, 3, 4)),
+        x, jnp.flip(_regroup_transpose_filter(w, g), (2, 3, 4)),
         window_strides=(1, 1, 1),
         padding=[(ke[i] - 1 - p[i], ke[i] - 1 - p[i]) for i in range(3)],
         lhs_dilation=tuple(s),
         rhs_dilation=tuple(d),
-        dimension_numbers=("NCDHW", "IODHW", "NCDHW"))
+        dimension_numbers=("NCDHW", "IODHW", "NCDHW"),
+        feature_group_count=g)
     ctx.set_output("Output", out)
 
 

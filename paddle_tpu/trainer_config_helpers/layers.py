@@ -226,9 +226,9 @@ def img_conv_layer(input, filter_size, num_filters, name=None,
         # ExpandConvTransLayer (deconv) — reference layers.py trans=True
         out = F.conv2d_transpose(
             var, num_filters=num_filters, filter_size=(filter_size, fy),
-            stride=(stride, sy), padding=(padding, py), act=_act_name(act),
-            param_attr=_param(param_attr), bias_attr=_bias(bias_attr),
-            name=name)
+            stride=(stride, sy), padding=(padding, py), groups=groups,
+            act=_act_name(act), param_attr=_param(param_attr),
+            bias_attr=_bias(bias_attr), name=name)
         oh = (h - 1) * stride - 2 * padding + filter_size
         ow = (w - 1) * sy - 2 * py + fy
         return LayerOutput(name or out.name, out,
@@ -1803,14 +1803,10 @@ def img_conv3d_layer(input, filter_size, num_filters, name=None,
     st = stride if isinstance(stride, (list, tuple)) else [stride] * 3
     pd = padding if isinstance(padding, (list, tuple)) else [padding] * 3
     if trans:
-        if groups not in (None, 1):
-            raise NotImplementedError(
-                "img_conv3d_layer(trans=True) with groups=%r — the "
-                "conv3d_transpose lowering is ungrouped" % (groups,))
         out = F.conv3d_transpose(
             var, num_filters=num_filters, filter_size=fs, stride=st,
-            padding=pd, act=_act_name(act), param_attr=_param(param_attr),
-            bias_attr=_bias(bias_attr))
+            padding=pd, groups=groups, act=_act_name(act),
+            param_attr=_param(param_attr), bias_attr=_bias(bias_attr))
         od = (d - 1) * st[0] - 2 * pd[0] + fs[0]
         oh = (h - 1) * st[1] - 2 * pd[1] + fs[1]
         ow = (w - 1) * st[2] - 2 * pd[2] + fs[2]

