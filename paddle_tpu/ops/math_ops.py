@@ -193,13 +193,24 @@ def cumsum(ctx):
 # -- reductions -------------------------------------------------------------
 
 def _reduce(ctx, fn):
-    x = raw_data(ctx.input("X"))
-    if ctx.attr("reduce_all", False):
+    xv = ctx.input("X")
+    x = raw_data(xv)
+    reduce_all = ctx.attr("reduce_all", False)
+    if reduce_all:
         dim = None
     else:
         dim = ctx.attr("dim", [0])
         dim = tuple(dim) if isinstance(dim, (list, tuple)) else (dim,)
     out = fn(x, axis=dim, keepdims=ctx.attr("keep_dim", False))
+    if (not reduce_all and dim is not None
+            and 0 not in {d % x.ndim for d in dim}):
+        # batch dim untouched (reduced over feature dims only): the
+        # input's sequence structure still describes the output — keep
+        # the LoD (e.g. dot_prod over a ragged pair feeding
+        # sequence_softmax). Guarding on the REDUCED DIMS, not on a row
+        # -count coincidence: reduce over dim 0 of a square tensor must
+        # not inherit the lod.
+        out = with_lod_of(xv, out)
     ctx.set_output("Out", out)
 
 

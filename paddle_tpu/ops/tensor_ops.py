@@ -346,13 +346,20 @@ def _infer_slice(op, block):
 
 @register_op("slice", infer_shape=_infer_slice)
 def slice_op(ctx):
-    x = raw_data(ctx.input("Input"))
+    xv = ctx.input("Input")
+    x = raw_data(xv)
     axes = ctx.attr("axes")
     starts, ends = ctx.attr("starts"), ctx.attr("ends")
     idx = [slice(None)] * x.ndim
     for a, s, e in zip(axes, starts, ends):
         idx[a] = slice(s, e)
-    ctx.set_output("Out", x[tuple(idx)])
+    out = x[tuple(idx)]
+    if 0 not in axes:
+        # rows untouched: a feature-dim slice of a sequence is still the
+        # same sequence (v1 identity_projection(offset=...) over ragged
+        # inputs feeds sequence ops downstream)
+        out = with_lod_of(xv, out)
+    ctx.set_output("Out", out)
 
 
 @register_op("crop")

@@ -434,12 +434,18 @@ def trans_full_matrix_projection(input, size, param_attr=None):
 
 def identity_projection(input, offset=None, size=None):
     def build():
-        if offset:
-            end = offset + (size or input.size - offset)
-            return F.slice(input.var, axes=[1], starts=[offset],
+        # offset=0 with a size is still a slice ('if offset:' silently
+        # passed the FULL tensor through for the first slice of a
+        # multi-head split — r4 fix)
+        if offset is not None or size is not None:
+            off = offset or 0
+            end = off + (size or input.size - off)
+            return F.slice(input.var, axes=[1], starts=[off],
                            ends=[end])
         return input.var
-    return _Projection(build, size or input.size)
+    # declared width must account for an offset-only slice (cols
+    # offset..input.size), not report the full input width
+    return _Projection(build, size or (input.size - (offset or 0)))
 
 
 def table_projection(input, size, param_attr=None):

@@ -15,7 +15,10 @@ from .poolings import MaxPooling
 __all__ = ["simple_img_conv_pool", "img_conv_bn_pool", "simple_lstm",
            "simple_gru", "bidirectional_lstm", "sequence_conv_pool",
            "img_conv_group", "small_vgg", "bidirectional_gru",
-           "simple_attention", "dot_product_attention"]
+           "simple_attention", "dot_product_attention",
+           "lstmemory_unit", "lstmemory_group", "gru_unit", "gru_group",
+           "simple_gru2", "text_conv_pool", "img_separable_conv",
+           "vgg_16_network", "inputs", "multi_head_attention"]
 
 
 def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
@@ -262,3 +265,249 @@ def dot_product_attention(encoded_sequence, attended_sequence,
     weighted = F.elementwise_mul(attended_sequence.var, weights)
     ctx = F.sequence_pool(input=weighted, pool_type="sum")
     return LayerOutput(name, ctx, size=attended_sequence.size)
+
+
+# ---------------------------------------------------------------------------
+# step-level recurrent units + their recurrent_group wrappers
+# (reference: networks.py lstmemory_unit:717, lstmemory_group:836,
+#  gru_unit:940, gru_group:1002, simple_gru2:1163)
+
+def lstmemory_unit(input, out_memory=None, name=None, size=None,
+                   param_attr=None, act=None, gate_act=None,
+                   state_act=None, input_proj_bias_attr=None,
+                   input_proj_layer_attr=None, lstm_bias_attr=None,
+                   lstm_layer_attr=None):
+    """One LSTM time step for use inside recurrent_group (attention-era
+    pattern): hidden/state memories recur by name, the input plus the
+    recurrent projection feed lstm_step_layer, and the cell state is
+    re-exposed via get_output_layer."""
+    from .layers import (memory, mixed_layer, identity_projection,
+                         full_matrix_projection, lstm_step_layer,
+                         get_output_layer)
+    if size is None:
+        assert input.size % 4 == 0
+        size = input.size // 4
+    name = name or "lstmemory_unit"
+    out_mem = out_memory if out_memory is not None else \
+        memory(name=name, size=size)
+    state_mem = memory(name="%s_state" % name, size=size)
+    m = mixed_layer(size=size * 4, name="%s_input_recurrent" % name,
+                    bias_attr=input_proj_bias_attr,
+                    act=LinearActivation(),
+                    input=[identity_projection(input),
+                           full_matrix_projection(out_mem, size * 4,
+                                                  param_attr=param_attr)])
+    lstm_out = lstm_step_layer(
+        input=m, state=state_mem, size=size, bias_attr=lstm_bias_attr,
+        act=act, gate_act=gate_act, state_act=state_act, name=name)
+    get_output_layer(name="%s_state" % name, input=lstm_out,
+                     arg_name="state")
+    return lstm_out
+
+
+def lstmemory_group(input, size=None, name=None, out_memory=None,
+                    reverse=False, param_attr=None, act=None,
+                    gate_act=None, state_act=None,
+                    input_proj_bias_attr=None, input_proj_layer_attr=None,
+                    lstm_bias_attr=None, lstm_layer_attr=None):
+    """recurrent_group form of lstmemory: same math, but every step's
+    hidden (and cell) state is user-accessible — the attention-model
+    building block."""
+    from .layers import recurrent_group
+    name = name or "lstmemory_group"
+
+    def step(ipt):
+        return lstmemory_unit(
+            input=ipt, out_memory=out_memory, name=name, size=size,
+            param_attr=param_attr, act=act, gate_act=gate_act,
+            state_act=state_act,
+            input_proj_bias_attr=input_proj_bias_attr,
+            input_proj_layer_attr=input_proj_layer_attr,
+            lstm_bias_attr=lstm_bias_attr,
+            lstm_layer_attr=lstm_layer_attr)
+
+    return recurrent_group(step=step, input=input, reverse=reverse,
+                           name="%s_recurrent_group" % name)
+
+
+def gru_unit(input, memory_boot=None, name=None, size=None,
+             gate_act=None, act=None, gru_bias_attr=None,
+             gru_param_attr=None, gru_layer_attr=None, naive=False):
+    """One GRU time step for use inside recurrent_group."""
+    from .layers import memory, gru_step_layer, gru_step_naive_layer
+    if size is None:
+        size = input.size // 3
+    name = name or "gru_unit"
+    out_mem = memory(name=name, size=size, boot_layer=memory_boot)
+    step = gru_step_naive_layer if naive else gru_step_layer
+    return step(input=input, output_mem=out_mem, size=size,
+                bias_attr=gru_bias_attr, param_attr=gru_param_attr,
+                act=act, gate_act=gate_act, name=name)
+
+
+def gru_group(input, memory_boot=None, size=None, name=None,
+              reverse=False, gru_bias_attr=None, gru_param_attr=None,
+              act=None, gate_act=None, gru_layer_attr=None, naive=False):
+    """recurrent_group form of grumemory: per-step hidden states are
+    user-accessible."""
+    from .layers import recurrent_group
+    name = name or "gru_group"
+
+    def step(ipt):
+        return gru_unit(input=ipt, memory_boot=memory_boot, name=name,
+                        size=size, gate_act=gate_act, act=act,
+                        gru_bias_attr=gru_bias_attr,
+                        gru_param_attr=gru_param_attr,
+                        gru_layer_attr=gru_layer_attr, naive=naive)
+
+    return recurrent_group(step=step, input=input, reverse=reverse,
+                           name="%s_recurrent_group" % name)
+
+
+def simple_gru2(input, size, name=None, reverse=False,
+                mixed_param_attr=None, mixed_bias_attr=None,
+                gru_param_attr=None, gru_bias_attr=None, act=None,
+                gate_act=None, mixed_layer_attr=None, gru_cell_attr=None):
+    """simple_gru built on the fused grumemory layer (faster than the
+    step-wise gru_group; same math)."""
+    from .layers import mixed_layer, full_matrix_projection
+    name = name or "simple_gru2"
+    m = mixed_layer(size=size * 3, name="%s_transform" % name,
+                    bias_attr=mixed_bias_attr, act=LinearActivation(),
+                    input=[full_matrix_projection(
+                        input, size * 3, param_attr=mixed_param_attr)])
+    return grumemory(m, size=size, name=name, reverse=reverse, act=act,
+                     gate_act=gate_act, bias_attr=gru_bias_attr,
+                     param_attr=gru_param_attr)
+
+
+# reference alias (networks.py:136)
+text_conv_pool = sequence_conv_pool
+
+
+def img_separable_conv(input, num_channels, num_out_channels, filter_size,
+                       stride=1, padding=0, depth_multiplier=1, act=None,
+                       bias_attr=None, param_attr=None, shared_bias=True,
+                       layer_type="exconv", name=None):
+    """Depthwise (groups == channels) + 1x1 pointwise convolution
+    (Xception's separable conv; reference networks.py:439)."""
+    name = name or "img_separable_conv"
+    depthwise = img_conv_layer(
+        name="%s_depthwise_conv" % name, input=input,
+        num_channels=num_channels,
+        num_filters=num_channels * depth_multiplier,
+        groups=num_channels, filter_size=filter_size, stride=stride,
+        padding=padding, act=LinearActivation(), bias_attr=bias_attr,
+        param_attr=param_attr, shared_biases=shared_bias)
+    return img_conv_layer(
+        name="%s_pointwise_conv" % name, input=depthwise,
+        num_channels=num_channels * depth_multiplier,
+        num_filters=num_out_channels, filter_size=1, stride=1, padding=0,
+        act=act, bias_attr=bias_attr, param_attr=param_attr,
+        shared_biases=shared_bias)
+
+
+def vgg_16_network(input_image, num_channels, num_classes=1000):
+    """The 16-layer VGG net (reference networks.py:547): five conv
+    groups [64x2, 128x2, 256x3, 512x3, 512x3] with 2x2 max pools, two
+    dropout+fc(4096) blocks, softmax classifier."""
+    from .layers import dropout_layer
+    tmp = img_conv_group(
+        input=input_image, num_channels=num_channels, conv_padding=1,
+        conv_num_filter=[64, 64], conv_filter_size=3,
+        conv_act=ReluActivation(), pool_size=2, pool_stride=2,
+        pool_type=MaxPooling())
+    for filters in ([128, 128], [256, 256, 256], [512, 512, 512],
+                    [512, 512, 512]):
+        tmp = img_conv_group(
+            input=tmp, conv_padding=1, conv_num_filter=filters,
+            conv_filter_size=3, conv_act=ReluActivation(), pool_size=2,
+            pool_stride=2, pool_type=MaxPooling())
+    tmp = dropout_layer(input=tmp, dropout_rate=0.5)
+    tmp = fc_layer(input=tmp, size=4096, act=LinearActivation())
+    tmp = dropout_layer(input=tmp, dropout_rate=0.5)
+    tmp = fc_layer(input=tmp, size=4096, act=LinearActivation())
+    from .activations import SoftmaxActivation
+    return fc_layer(input=tmp, size=num_classes, act=SoftmaxActivation())
+
+
+def inputs(layers, *args):
+    """Declare the network's input order (reference networks.py:1707).
+    Program-as-config makes feed routing explicit at Executor.run, so
+    this records the declared order on the default program for
+    introspection parity rather than driving a config_parser."""
+    from .layers import LayerOutput
+    from ..core import ir
+    if isinstance(layers, (LayerOutput, str)):
+        layers = [layers]
+    layers = list(layers) + list(args)
+    names = [l.name if isinstance(l, LayerOutput) else str(l)
+             for l in layers]
+    ir.default_main_program()._v1_input_order = names
+    return names
+
+
+def multi_head_attention(query, key, value, key_proj_size, value_proj_size,
+                         head_num, attention_type, softmax_param_attr=None,
+                         name=None):
+    """Multi-head attention over sequences (reference networks.py:1580):
+    per-head slices of shared Q/K/V projections, scaled dot-product (or
+    additive) scores, sequence softmax, weighted sum pool, heads
+    concatenated. Context vector size = value_proj_size * head_num."""
+    import math as _math
+    from .activations import SequenceSoftmaxActivation
+    from .layers import (mixed_layer, full_matrix_projection,
+                         identity_projection, expand_layer,
+                         dot_prod_layer, slope_intercept_layer,
+                         scaling_layer, pooling_layer, concat_layer)
+    from .poolings import SumPooling
+    assert attention_type in ("dot-product attention",
+                              "additive attention")
+    name = name or "multi_head_attention"
+    query_proj = mixed_layer(
+        size=key_proj_size * head_num, name="%s_query_proj" % name,
+        input=[full_matrix_projection(query,
+                                      key_proj_size * head_num)])
+    query_proj = expand_layer(input=query_proj, expand_as=key)
+    key_proj = mixed_layer(
+        size=key_proj_size * head_num, name="%s_key_proj" % name,
+        input=[full_matrix_projection(key, key_proj_size * head_num)])
+    value_proj = mixed_layer(
+        size=value_proj_size * head_num, name="%s_value_proj" % name,
+        input=[full_matrix_projection(value,
+                                      value_proj_size * head_num)])
+
+    heads = []
+    for i in range(head_num):
+        sub_q = mixed_layer(size=key_proj_size, input=[
+            identity_projection(query_proj, offset=key_proj_size * i,
+                                size=key_proj_size)])
+        sub_k = mixed_layer(size=key_proj_size, input=[
+            identity_projection(key_proj, offset=key_proj_size * i,
+                                size=key_proj_size)])
+        sub_v = mixed_layer(size=value_proj_size, input=[
+            identity_projection(value_proj, offset=value_proj_size * i,
+                                size=value_proj_size)])
+        if attention_type == "dot-product attention":
+            m = dot_prod_layer(sub_q, sub_k,
+                               name="%s_dot-product_%d" % (name, i))
+            m = slope_intercept_layer(
+                m, slope=_math.sqrt(1.0 / key_proj_size),
+                name="%s_dot-product_scaling_%d" % (name, i))
+        else:
+            m = mixed_layer(
+                size=key_proj_size, act=TanhActivation(),
+                name="%s_combine_%d" % (name, i),
+                input=[identity_projection(sub_q),
+                       identity_projection(sub_k)])
+        weight = fc_layer(input=m, size=1,
+                          act=SequenceSoftmaxActivation(),
+                          param_attr=softmax_param_attr,
+                          bias_attr=False,
+                          name="%s_softmax_%d" % (name, i))
+        scaled = scaling_layer(weight=weight, input=sub_v,
+                               name="%s_scaling_%d" % (name, i))
+        heads.append(pooling_layer(input=scaled,
+                                   pooling_type=SumPooling(),
+                                   name="%s_pooling_%d" % (name, i)))
+    return concat_layer(input=heads, name="%s_concat" % name)

@@ -1,0 +1,137 @@
+"""v1 network combinators round 2 (reference:
+trainer_config_helpers/networks.py lstmemory_unit:717 lstmemory_group:836
+gru_unit:940 gru_group:1002 simple_gru2:1163 img_separable_conv:439
+vgg_16_network:547 multi_head_attention:1580 inputs:1707,
+text_conv_pool alias:136)."""
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.trainer_config_helpers as tch
+from paddle_tpu.core.lod import build_lod_tensor
+
+
+def _run(fetches, feed):
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    feed = exe.prepare_feed(feed)
+    return [np.asarray(o) for o in
+            exe.run(feed=feed, fetch_list=[f.var for f in fetches])]
+
+
+def test_recurrent_unit_groups_run_and_train():
+    """lstmemory_group / gru_group / simple_gru2 produce per-step
+    hidden sequences and train."""
+    rng = np.random.RandomState(0)
+    seqs = [rng.rand(4, 8).astype("float32"),
+            rng.rand(2, 8).astype("float32")]
+    x = tch.data_layer("s", size=8, is_seq=True)
+    lg = tch.lstmemory_group(
+        tch.mixed_layer(size=16,
+                        input=[tch.full_matrix_projection(x, 16)]),
+        name="lg")
+    gg = tch.gru_group(
+        tch.mixed_layer(size=12,
+                        input=[tch.full_matrix_projection(x, 12)]),
+        name="gg")
+    sg2 = tch.simple_gru2(x, size=5, name="sg2")
+    loss = pt.layers.mean(pt.layers.concat_nn(
+        [pt.layers.reduce_sum(v.var, dim=[1], keep_dim=True)
+         for v in (lg, gg, sg2)], axis=1))
+    pt.optimizer.SGD(learning_rate=0.02).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    feed = exe.prepare_feed({"s": build_lod_tensor(seqs)})
+    o1, o2, o3 = [np.asarray(o) for o in exe.run(
+        feed=feed, fetch_list=[lg.var, gg.var, sg2.var])]
+    assert o1.shape == (6, 4) and o2.shape == (6, 4) and o3.shape == (6, 5)
+    l0 = float(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0]))
+    for _ in range(5):
+        l = float(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0]))
+    assert np.isfinite(l0) and l != l0
+
+
+def test_reverse_group_matches_forward_on_reversed_input():
+    """gru_group(reverse=True) == forward group over pre-reversed
+    sequences, rows re-flipped — weights shared by building both under
+    the same name in one program."""
+    rng = np.random.RandomState(1)
+    seq = rng.rand(3, 6).astype("float32")
+    x = tch.data_layer("s", size=6, is_seq=True)
+    xr = tch.data_layer("s_rev", size=6, is_seq=True)
+    pa = tch.ParameterAttribute(name="revg_w")
+    ba = tch.ParameterAttribute(name="revg_b", initial_std=0.0)
+    rev = tch.gru_group(x, size=2, name="shared_g", reverse=True,
+                        gru_param_attr=pa, gru_bias_attr=ba)
+    fwd = tch.gru_group(xr, size=2, name="shared_g2",
+                        gru_param_attr=pa, gru_bias_attr=ba)
+    o_rev, o_fwd = _run([rev, fwd],
+                        {"s": build_lod_tensor([seq]),
+                         "s_rev": build_lod_tensor([seq[::-1].copy()])})
+    assert o_rev.shape == (3, 2)
+    np.testing.assert_allclose(o_rev, o_fwd[::-1], rtol=1e-5)
+
+
+def test_img_separable_conv_param_shapes():
+    """depthwise (groups=C) + pointwise 1x1: parameter count is
+    C*mult*k*k + C*mult*out (the separability point)."""
+    img = tch.data_layer("img", size=3 * 8 * 8, height=8, width=8)
+    sep = tch.img_separable_conv(img, num_channels=3, num_out_channels=8,
+                                 filter_size=3, padding=1,
+                                 act=tch.ReluActivation())
+    o, = _run([sep], {"img": np.random.RandomState(2).rand(
+        2, 3 * 8 * 8).astype("float32")})
+    # image layers keep NCHW internally; .size carries the flat width
+    assert o.shape == (2, 8, 8, 8) and sep.size == 8 * 8 * 8
+    params = pt.default_main_program().global_block().all_parameters()
+    wshapes = sorted(tuple(p.shape) for p in params if "conv" in p.name
+                     and len(p.shape) == 4)
+    # depthwise OIHW [3,1,3,3] (groups=3), pointwise [8,3,1,1]
+    assert (3, 1, 3, 3) in wshapes and (8, 3, 1, 1) in wshapes, wshapes
+
+
+def test_vgg_16_network_builds_and_classifies():
+    img = tch.data_layer("img", size=3 * 32 * 32, height=32, width=32)
+    out = tch.vgg_16_network(img, num_channels=3, num_classes=7)
+    o, = _run([out], {"img": np.random.RandomState(3).rand(
+        2, 3 * 32 * 32).astype("float32")})
+    assert o.shape == (2, 7)
+    np.testing.assert_allclose(o.sum(1), 1.0, rtol=1e-4)  # softmax rows
+
+
+def test_multi_head_attention_both_types():
+    rng = np.random.RandomState(4)
+    q = tch.data_layer("q", size=6)
+    kv = tch.data_layer("kv", size=6, is_seq=True)
+    c1 = tch.multi_head_attention(query=q, key=kv, value=kv,
+                                  key_proj_size=4, value_proj_size=4,
+                                  head_num=2,
+                                  attention_type="dot-product attention")
+    c2 = tch.multi_head_attention(query=q, key=kv, value=kv,
+                                  key_proj_size=4, value_proj_size=4,
+                                  head_num=2, name="mha_add",
+                                  attention_type="additive attention")
+    o1, o2 = _run([c1, c2], {
+        "q": rng.rand(2, 6).astype("float32"),
+        "kv": build_lod_tensor([rng.rand(3, 6).astype("float32"),
+                                rng.rand(5, 6).astype("float32")])})
+    # context = value_proj_size * head_num per query row
+    assert o1.shape == (2, 8) and o2.shape == (2, 8)
+
+
+def test_identity_projection_offset_zero_slices():
+    """offset=0 with a size must SLICE, not pass the full tensor (the
+    bug that silently widened multi-head head 0 — r4 fix)."""
+    x = tch.data_layer("x", size=6)
+    first = tch.mixed_layer(size=2, input=[
+        tch.identity_projection(x, offset=0, size=2)])
+    o, = _run([first], {"x": np.arange(12, dtype=np.float32)
+                        .reshape(2, 6)})
+    np.testing.assert_allclose(o, [[0, 1], [6, 7]], rtol=1e-6)
+
+
+def test_text_conv_pool_alias_and_inputs():
+    assert tch.text_conv_pool is tch.sequence_conv_pool
+    x = tch.data_layer("t", size=4, is_seq=True)
+    names = tch.inputs([x])
+    assert names == ["t"]
+    assert pt.default_main_program()._v1_input_order == ["t"]
