@@ -354,7 +354,7 @@ def slice_op(ctx):
     for a, s, e in zip(axes, starts, ends):
         idx[a] = slice(s, e)
     out = x[tuple(idx)]
-    if 0 not in axes:
+    if 0 not in {a % x.ndim for a in axes}:
         # rows untouched: a feature-dim slice of a sequence is still the
         # same sequence (v1 identity_projection(offset=...) over ragged
         # inputs feeds sequence ops downstream)

@@ -70,3 +70,19 @@ class LogActivation(BaseActivation):
 
 
 __all__ = [n for n in dir() if n.endswith("Activation")]
+
+
+class ReciprocalActivation(BaseActivation):
+    name = "reciprocal"
+
+
+class SoftSignActivation(BaseActivation):
+    name = "softsign"
+
+
+class SqrtActivation(BaseActivation):
+    name = "sqrt"
+
+
+# recompute: classes defined after the first computation must export too
+__all__ = [n for n in dir() if n.endswith("Activation")]

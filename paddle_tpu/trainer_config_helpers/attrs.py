@@ -19,9 +19,16 @@ class ParameterAttribute(object):
                  initial_mean=None, initial_max=None, initial_min=None,
                  l1_rate=None, l2_rate=None, learning_rate=1.0,
                  momentum=None, gradient_clipping_threshold=None,
-                 sparse_update=False):
+                 sparse_update=False, update_hooks=None):
         self.name = name
         self.is_static = is_static
+        self.update_hooks = update_hooks
+        if update_hooks is not None:
+            import warnings
+            warnings.warn(
+                "ParameterAttribute(update_hooks=...): the pruning hook "
+                "is carried for config parity but no training pass "
+                "applies it here", stacklevel=2)
         self.initial_std = initial_std
         self.initial_mean = initial_mean
         self.initial_max = initial_max
@@ -60,3 +67,27 @@ class ExtraLayerAttribute(object):
 
 
 ExtraAttr = ExtraLayerAttribute
+
+
+class HookAttribute(object):
+    """Parameter update hook config (reference: attrs.py HookAttribute —
+    'pruning' with a sparsity_ratio). CARRIED but NOT APPLIED here:
+    ParameterAttribute(update_hooks=...) stores the hook for config
+    round-trips; no training-time pruning pass consumes it yet, so a
+    warning is emitted when one is attached."""
+
+    def __init__(self, type, sparsity_ratio=None):
+        if type != "pruning":
+            raise ValueError("unsupported hook type %r (reference "
+                             "supports 'pruning')" % (type,))
+        if sparsity_ratio is not None \
+                and not 0.0 <= sparsity_ratio <= 1.0:
+            raise ValueError("sparsity_ratio must be in [0, 1]")
+        self.type = type
+        self.sparsity_ratio = sparsity_ratio
+
+
+HookAttr = HookAttribute
+
+
+__all__ += ["HookAttribute", "HookAttr"]

@@ -272,11 +272,17 @@ def img_pool_layer(input, pool_size, name=None, num_channels=None,
     pdy = padding_y if padding_y is not None else padding
     # sum pool: avg * full-window-area is exact only with the INCLUSIVE
     # divisor (padding cells contribute 0 to the sum); avg pool follows
-    # exclude_mode (gserver default excludeMode=true)
+    # exclude_mode (gserver default excludeMode=true), and
+    # CudnnAvgInclPadPooling forces the inclusive divisor by type
+    incl_pad = bool(getattr(pool_type, "include_pad", False))
+    if incl_pad and exclude_mode:
+        raise ValueError(
+            "img_pool_layer: CudnnAvgInclPadPooling and "
+            "exclude_mode=True request contradictory divisors")
     out = F.pool2d(var, pool_size=(pool_size, py), pool_type=pt,
                    pool_stride=(stride, sy), pool_padding=(padding, pdy),
                    ceil_mode=ceil_mode, name=name,
-                   exclusive=(False if is_sum
+                   exclusive=(False if (is_sum or incl_pad)
                               else True if exclude_mode is None
                               else bool(exclude_mode)))
     if is_sum:
@@ -759,6 +765,14 @@ def recurrent_group(step, input, reverse=False, name=None):
         with rnn.block():
             args = []
             for i in inputs:
+                # SubsequenceInput is defined later in this module; the
+                # name resolves at call time
+                if isinstance(i, SubsequenceInput):
+                    raise NotImplementedError(
+                        "recurrent_group over SubsequenceInput (nested "
+                        "sub-sequence steps) is not supported — scan "
+                        "the inner level with a second recurrent_group "
+                        "over the flattened sequence instead")
                 if isinstance(i, StaticInput):
                     v = rnn.static_input(i.input.var)
                     args.append(LayerOutput(None, v, size=i.size))
@@ -1707,11 +1721,12 @@ def lstm_step_layer(input, state, size=None, act=None, name=None,
     the new cell rides get_output_layer(..., 'state')."""
     size = size or state.size
     gates = input.var
-    i = F.sigmoid(F.slice(gates, axes=[1], starts=[0], ends=[size]))
-    f = F.sigmoid(F.slice(gates, axes=[1], starts=[size],
-                          ends=[2 * size]))
-    o = F.sigmoid(F.slice(gates, axes=[1], starts=[2 * size],
-                          ends=[3 * size]))
+    gact = getattr(F, _act_name(gate_act) or "sigmoid")
+    i = gact(F.slice(gates, axes=[1], starts=[0], ends=[size]))
+    f = gact(F.slice(gates, axes=[1], starts=[size],
+                     ends=[2 * size]))
+    o = gact(F.slice(gates, axes=[1], starts=[2 * size],
+                     ends=[3 * size]))
     g = getattr(F, _act_name(act) or "tanh")(
         F.slice(gates, axes=[1], starts=[3 * size], ends=[4 * size]))
     c_new = F.elementwise_add(F.elementwise_mul(f, state.var),
@@ -2127,3 +2142,58 @@ def slice_projection(input, slices):
                  for s, e in slices]
         return parts[0] if len(parts) == 1 else F.concat(parts, axis=1)
     return _Projection(build, size)
+
+
+# the reference's base of generation-mode inputs (layers.py
+# BaseGeneratedInput); isinstance(x, BaseGeneratedInput) must accept
+# GeneratedInput, so the existing class is re-exported as the base and
+# registered as a virtual subclass relationship via alias
+BaseGeneratedInput = GeneratedInput
+
+
+class SubsequenceInput(object):
+    """Marks a recurrent_group input as a NESTED sequence whose
+    sub-sequences are the step unit (reference: layers.py
+    SubsequenceInput). The group machinery here scans single-level
+    sequences; nested scanning raises with this actionable message when
+    the wrapper is passed."""
+
+    def __init__(self, input):
+        self.input = input
+        self.size = input.size
+
+
+class LayerType(object):
+    """Layer-type name constants (reference: layers.py LayerType). The
+    Program IR carries op types instead, so these exist for config
+    introspection parity."""
+    DATA = "data"
+    FC_LAYER = "fc"
+    MIXED_LAYER = "mixed"
+    LSTMEMORY = "lstmemory"
+    GRUMEMORY = "gated_recurrent"
+    SEQUENCE_LAST_INSTANCE = "seqlastins"
+    SEQUENCE_FIRST_INSTANCE = "seqfirstins"
+    POOLING_MAX = "max"
+    POOLING_AVG = "average"
+    CONV_LAYER = "conv"
+    CONVTRANS_LAYER = "convt"
+    POOL_LAYER = "pool"
+    BATCH_NORM_LAYER = "batch_norm"
+    CONCAT_LAYER = "concat"
+    COST = "cost"
+
+    @staticmethod
+    def is_layer_type(type_name):
+        return isinstance(type_name, str) and bool(type_name)
+
+
+# reference compatibility aliases (layers.py:1123 print_layer =
+# printer_layer; convex_comb_layer is the deprecated name of
+# linear_comb_layer)
+print_layer = printer_layer
+convex_comb_layer = linear_comb_layer
+
+
+__all__ += ["BaseGeneratedInput", "SubsequenceInput", "LayerType",
+            "print_layer", "convex_comb_layer"]

@@ -336,6 +336,10 @@ def gru_unit(input, memory_boot=None, name=None, size=None,
     """One GRU time step for use inside recurrent_group."""
     from .layers import memory, gru_step_layer, gru_step_naive_layer
     if size is None:
+        assert input.size % 3 == 0, (
+            "gru_unit: input width %d is not 3*size — project the input "
+            "to 3*size first (the reference asserts the same)"
+            % input.size)
         size = input.size // 3
     name = name or "gru_unit"
     out_mem = memory(name=name, size=size, boot_layer=memory_boot)
@@ -423,10 +427,12 @@ def vgg_16_network(input_image, num_channels, num_classes=1000):
             input=tmp, conv_padding=1, conv_num_filter=filters,
             conv_filter_size=3, conv_act=ReluActivation(), pool_size=2,
             pool_stride=2, pool_type=MaxPooling())
+    # the reference's fc head: relu fc(4096) with 0.5 output dropout,
+    # twice (linear would collapse the two layers into one map)
+    tmp = fc_layer(input=tmp, size=4096, act=ReluActivation())
     tmp = dropout_layer(input=tmp, dropout_rate=0.5)
-    tmp = fc_layer(input=tmp, size=4096, act=LinearActivation())
+    tmp = fc_layer(input=tmp, size=4096, act=ReluActivation())
     tmp = dropout_layer(input=tmp, dropout_rate=0.5)
-    tmp = fc_layer(input=tmp, size=4096, act=LinearActivation())
     from .activations import SoftmaxActivation
     return fc_layer(input=tmp, size=num_classes, act=SoftmaxActivation())
 

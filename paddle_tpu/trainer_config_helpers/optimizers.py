@@ -136,3 +136,28 @@ def make_optimizer():
     return _SETTINGS["learning_method"].to_fluid(
         _SETTINGS["learning_rate"],
         regularization=reg.to_fluid() if reg is not None else None)
+
+
+class Optimizer(object):
+    """Base of the v1 settings objects (reference: optimizers.py
+    Optimizer — every settings() argument object derives from it)."""
+
+
+class BaseRegularization(Optimizer):
+    pass
+
+
+class ModelAverage(Optimizer):
+    """settings(model_average=...) argument (reference: optimizers.py
+    ModelAverage:319): window sizes for parameter averaging. The fluid
+    analog is paddle_tpu.optimizer.ModelAverage, which the v2 trainer
+    instantiates from these fields."""
+
+    def __init__(self, average_window, max_average_window=None,
+                 do_average_in_cpu=False):
+        self.average_window = average_window
+        self.max_average_window = max_average_window
+        self.do_average_in_cpu = do_average_in_cpu
+
+
+__all__ += ["Optimizer", "BaseRegularization", "ModelAverage"]

@@ -38,3 +38,23 @@ class SumPooling(BasePoolingType):
 
 class SquareRootNPooling(BasePoolingType):
     name = "sqrt"
+
+
+class CudnnAvgInclPadPooling(BasePoolingType):
+    """Average pooling with the INCLUSIVE divisor — padding cells count
+    (reference: poolings.py CudnnAvgInclPadPooling; the cudnn
+    CUDNN_POOLING_AVERAGE_COUNT_INCLUDE_PADDING mode). img_pool_layer
+    maps this onto the pool op's exclusive=False."""
+    name = "avg"
+    include_pad = True
+
+
+class MaxWithMaskPooling(BasePoolingType):
+    """Max pooling that also records argmax positions in the reference
+    (MaxPoolWithMaskLayer, for unpooling). The pooled VALUES are what
+    the layer output carries there too; the index side lives in the
+    fluid op max_pool2d_with_index when needed."""
+    name = "max"
+
+
+__all__ += ["CudnnAvgInclPadPooling", "MaxWithMaskPooling"]

@@ -740,3 +740,49 @@ def test_img_pool_sum_with_exclude_mode_raises():
         tch.img_pool_layer(x, pool_size=2, stride=2,
                            pool_type=tch.SumPooling(), num_channels=1,
                            exclude_mode=True)
+
+
+def test_namespace_parity_classes_and_aliases():
+    """The v1 class/alias tail from the namespace audit: activation
+    classes resolve to working lowerings, CudnnAvgInclPadPooling forces
+    the inclusive divisor, HookAttribute validates, print/convex_comb
+    aliases bind, LayerType/SubsequenceInput/BaseGeneratedInput exist."""
+    assert tch.print_layer is tch.printer_layer
+    assert tch.convex_comb_layer is tch.linear_comb_layer
+    assert tch.BaseGeneratedInput is tch.GeneratedInput
+    assert tch.LayerType.is_layer_type("fc")
+    with pytest.raises(ValueError):
+        tch.HookAttribute("unknown")
+    hk = tch.HookAttr("pruning", 0.5)
+    assert hk.sparsity_ratio == 0.5
+    tch.ParameterAttribute(update_hooks=hk)
+
+    x = tch.data_layer("nx", size=4)
+    # constant positive weights: sqrt/reciprocal need positive pre-acts
+    pos = tch.ParameterAttribute(initial_mean=0.1, initial_std=0.0)
+    outs = [tch.fc_layer(x, size=3, act=a(), param_attr=pos,
+                         bias_attr=False)
+            for a in (tch.ReciprocalActivation, tch.SoftSignActivation,
+                      tch.SqrtActivation)]
+    img = np.arange(16, dtype=np.float32).reshape(1, 16)
+    xi = tch.data_layer("nimg", size=16, height=4, width=4)
+    incl = tch.img_pool_layer(xi, pool_size=3, stride=3, padding=1,
+                              pool_type=tch.CudnnAvgInclPadPooling(),
+                              num_channels=1, ceil_mode=False)
+    mx = tch.img_pool_layer(xi, pool_size=2, stride=2,
+                            pool_type=tch.MaxWithMaskPooling(),
+                            num_channels=1)
+    rs = _run(outs + [incl, mx],
+              {"nx": np.abs(np.random.RandomState(7).rand(2, 4))
+               .astype("float32") + 0.5,
+               "nimg": img})
+    assert all(np.isfinite(r).all() for r in rs[:3])
+    padded = np.pad(img.reshape(4, 4), 1)
+    wins = [padded[0:3, 0:3], padded[0:3, 3:6],
+            padded[3:6, 0:3], padded[3:6, 3:6]]
+    np.testing.assert_allclose(rs[3].reshape(-1),
+                               [w.sum() / 9.0 for w in wins], rtol=1e-5)
+    np.testing.assert_allclose(
+        rs[4].reshape(-1),
+        img.reshape(4, 4).reshape(2, 2, 2, 2).transpose(0, 2, 1, 3)
+        .reshape(4, 4).max(1), rtol=1e-5)
