@@ -25,6 +25,10 @@ from . import topology            # noqa: F401
 from .minibatch import batch      # noqa: F401
 from .trainer import SGD          # noqa: F401
 from .inference import infer, Inference  # noqa: F401
+from . import evaluator           # noqa: F401
+# the reference's v2 namespace re-exports the fluid default programs
+from ..core.ir import (default_main_program,      # noqa: F401
+                       default_startup_program)   # noqa: F401
 
 from .. import dataset            # noqa: F401
 from .. import reader             # noqa: F401

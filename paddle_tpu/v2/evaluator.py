@@ -1,0 +1,19 @@
+"""v2 evaluator namespace: every v1 ``*_evaluator`` re-exposed without
+the suffix (reference: v2/evaluator.py — the same mechanical rename via
+__convert_to_v2__; here the v1 helpers are already plain functions)."""
+from __future__ import annotations
+
+from ..trainer_config_helpers import evaluators as _evs
+
+__all__ = []
+
+
+def _initialize():
+    for name in _evs.__all__:
+        if name.endswith("_evaluator"):
+            new = name[:-len("_evaluator")]
+            globals()[new] = getattr(_evs, name)
+            __all__.append(new)
+
+
+_initialize()

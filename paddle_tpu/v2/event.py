@@ -40,3 +40,17 @@ class EndIteration(WithMetric):
         self.batch_id = batch_id
         self.cost = cost
         self.metrics = {"cost": cost}
+
+
+class EndForwardBackward(object):
+    """Fired after a batch's forward/backward, before the parameter
+    update (reference: v2/event.py:90; ``gm`` is the gradient-machine
+    analog — here the trainer passes its executor)."""
+
+    def __init__(self, pass_id, batch_id, gm):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.gm = gm
+
+
+__all__ += ["EndForwardBackward"]

@@ -116,3 +116,74 @@ def simple_transform(im, resize_size, crop_size, is_train,
 def batch_images(ims):
     """Stack a list of CHW images into [N, C, H, W] float32."""
     return np.stack([np.asarray(i, np.float32) for i in ims])
+
+
+def load_image_bytes(bytes, is_color=True):
+    """Decode an image from a bytes blob to an HWC (or HW) uint8 array
+    (reference: v2/image.py:111 — cv2.imdecode there; PIL here)."""
+    import io
+
+    from PIL import Image
+    im = Image.open(io.BytesIO(bytes))
+    im = im.convert("RGB" if is_color else "L")
+    return np.asarray(im)
+
+
+def load_image(file, is_color=True):
+    """Load an image file to an HWC (or HW) uint8 array
+    (reference: v2/image.py:135)."""
+    with open(file, "rb") as f:
+        return load_image_bytes(f.read(), is_color=is_color)
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    """load_image + simple_transform in one call
+    (reference: v2/image.py:348)."""
+    return simple_transform(load_image(filename, is_color=is_color),
+                            resize_size, crop_size, is_train, mean=mean)
+
+
+def batch_images_from_tar(data_file, dataset_name, img2label,
+                          num_per_batch=1024):
+    """Pre-batch tar-archived images into pickle files of (data, label)
+    lists, returning the path of the batch-list file (reference:
+    v2/image.py:48 — same file layout: <tar>_batch/batch_N + meta)."""
+    import os
+    import pickle
+    import tarfile
+
+    out_path = "%s_batch" % data_file
+    meta_file = os.path.join(out_path, "%s_batch_list" % dataset_name)
+    if os.path.exists(meta_file):
+        return meta_file
+    os.makedirs(out_path, exist_ok=True)
+    data, labels, batch_names = [], [], []
+
+    def flush():
+        # dataset_name in the filename: two datasets batched from the
+        # same tar must not overwrite each other's pickles (the
+        # reference embeds it the same way)
+        name = os.path.join(out_path, "%s_batch_%d"
+                            % (dataset_name, len(batch_names)))
+        with open(name, "wb") as f:
+            pickle.dump({"data": data[:], "label": labels[:]}, f)
+        batch_names.append(name)
+        del data[:], labels[:]
+
+    with tarfile.open(data_file) as tf:
+        for m in tf.getmembers():
+            if m.name in img2label:
+                data.append(tf.extractfile(m).read())
+                labels.append(img2label[m.name])
+                if len(data) == num_per_batch:
+                    flush()
+    if data:
+        flush()
+    with open(meta_file, "w") as f:
+        f.write("\n".join(batch_names) + "\n")
+    return meta_file
+
+
+__all__ += ["load_image_bytes", "load_image", "load_and_transform",
+            "batch_images_from_tar"]

@@ -69,6 +69,11 @@ class SGD(object):
                 c, = self.exe.run(self.__topology__.main_program,
                                   feed=feed, fetch_list=[self.cost.var],
                                   scope=scope)
+                # fwd/bwd/update fuse into ONE jitted step here, so the
+                # reference's between-phases event fires right after the
+                # step with the executor as the gradient-machine analog
+                handler(v2_event.EndForwardBackward(pass_id, batch_id,
+                                                    self.exe))
                 c = float(np.asarray(c).reshape(-1)[0])
                 costs.append(c)
                 handler(v2_event.EndIteration(pass_id, batch_id, c))

@@ -919,3 +919,64 @@ def test_v1_network_combinators():
         assert np.asarray(p3).shape == (2, 4)
         np.testing.assert_allclose(np.asarray(p3).sum(1), np.ones(2),
                                    rtol=1e-5)
+
+
+def test_v2_namespace_tail(tmp_path):
+    """r4 v2 audit closures: default programs re-exported, evaluator
+    namespace (v1 *_evaluator sans suffix), EndForwardBackward fired
+    between step and EndIteration, image load/batch helpers."""
+    import io
+    import pickle
+    import tarfile
+
+    from PIL import Image
+
+    import paddle_tpu.v2 as v2
+
+    assert v2.default_main_program() is not None
+    assert callable(v2.evaluator.classification_error)
+
+    # image helpers
+    im = Image.new("RGB", (10, 8), (1, 2, 3))
+    p = str(tmp_path / "a.png")
+    im.save(p)
+    arr = v2.image.load_image(p)
+    assert arr.shape == (8, 10, 3)
+    chw = v2.image.load_and_transform(p, resize_size=8, crop_size=6,
+                                      is_train=False)
+    assert chw.shape[0] == 3 and chw.shape[1] == 6
+
+    # batch_images_from_tar writes batch pickles + meta list
+    blob = io.BytesIO()
+    im.save(blob, "JPEG")
+    tar_p = str(tmp_path / "imgs.tar")
+    with tarfile.open(tar_p, "w") as tf:
+        info = tarfile.TarInfo("jpg/image_00001.jpg")
+        data = blob.getvalue()
+        info.size = len(data)
+        tf.addfile(info, io.BytesIO(data))
+    meta = v2.image.batch_images_from_tar(
+        tar_p, "train", {"jpg/image_00001.jpg": 4})
+    batch_file = open(meta).read().split()[0]
+    batch = pickle.load(open(batch_file, "rb"))
+    assert batch["label"] == [4] and len(batch["data"]) == 1
+
+    # EndForwardBackward ordering in SGD.train
+    events = []
+    x = v2.layer.data(name="x", type=v2.data_type.dense_vector(4))
+    y = v2.layer.data(name="y", type=v2.data_type.dense_vector(1))
+    pred = v2.layer.fc(input=x, size=1,
+                       act=v2.activation.Linear())
+    cost = v2.layer.mse_cost(input=pred, label=y)
+    params = v2.parameters.create(cost)
+    trainer = v2.trainer.SGD(cost=cost, parameters=params,
+                             update_equation=v2.optimizer.Momentum(
+                                 learning_rate=0.01, momentum=0.9))
+    rng = np.random.RandomState(0)
+    rows = [(rng.rand(4).astype("float32"),
+             rng.rand(1).astype("float32")) for _ in range(8)]
+    trainer.train(v2.minibatch.batch(lambda: iter(rows), 4),
+                  num_passes=1,
+                  event_handler=lambda e: events.append(type(e).__name__))
+    i_fb = events.index("EndForwardBackward")
+    assert events[i_fb + 1] == "EndIteration"
