@@ -1,26 +1,65 @@
 """py_paddle / SWIG-API compatibility surface.
 
 reference: paddle/api/PaddleAPI.h + paddle/py_paddle — hand-written SWIG
-wrappers (Matrix, Vector, Arguments, GradientMachine, SequenceGenerator)
-that the v2 API drove. In this framework the whole binding layer is
-structurally unnecessary (pure-Python over jax), so this module is a thin
-compatibility facade mapping the SWIG classes onto the fluid path — enough
-to port reference scripts written against ``py_paddle.swig_paddle``:
+wrappers (Matrix, Vector, Arguments, GradientMachine, SequenceGenerator,
+Trainer, ParameterUpdater, ...) that the v2 API drove. In this framework
+the whole binding layer is structurally unnecessary (pure-Python over
+jax), so this module is a compatibility facade mapping every class in
+PaddleAPI.h onto the fluid path — enough to port reference scripts
+written against ``py_paddle.swig_paddle``:
 
 - ``Matrix``/``Vector``/``IVector``: numpy-backed value holders with the
   createDense/createVector/copyToNumpyMat accessors.
 - ``Arguments``: slot container with value/ids + sequence-start positions
   (the LoD ancestor, reference: parameter/Argument.h:84).
-- ``GradientMachine.createFromConfigProto(topology)``: wraps a v2
-  Topology (Program pair) with forward / forwardBackward driven by the
-  fluid Executor — the ``NeuralNetwork::forward`` role.
+- ``GradientMachine``: wraps a topology/config with forward /
+  forwardBackward driven by the fluid Executor (the
+  ``NeuralNetwork::forward`` role), plus parameter access
+  (reference: api/GradientMachine.cpp).
+- ``SequenceGenerator``: beam-search generation over the compiled decode
+  program (reference: api/SequenceGenerator.cpp / PaddleAPI.h:1025).
+- ``Trainer``/``ParameterUpdater``/``Evaluator``: the training-loop trio
+  (reference: api/Trainer.cpp, api/ParameterUpdater.cpp,
+  api/Evaluator.cpp).
 """
 from __future__ import annotations
 
+import json
+import os
+
 import numpy as np
 
-__all__ = ["Matrix", "Vector", "IVector", "Arguments", "GradientMachine",
-           "initPaddle"]
+__all__ = [
+    "Matrix", "Vector", "IVector", "Arguments", "GradientMachine",
+    "initPaddle", "Parameter", "ParameterConfig", "ModelConfig",
+    "TrainerConfig", "OptimizationConfig", "UpdateCallback",
+    "ParameterTraverseCallback", "ParameterOptimizer", "ParameterUpdater",
+    "Evaluator", "Trainer", "ISequenceResults", "SequenceGenerator",
+    "UnsupportError", "RangeError",
+]
+
+# enum parity (reference: PaddleAPI.h:33-47 + parameter/Parameter.h)
+PASS_TRAIN = 0
+PASS_TEST = 1
+PASS_GC = 2
+PARAMETER_VALUE = 0
+PARAMETER_GRADIENT = 1
+PARAMETER_MOMENTUM = 2
+CREATE_MODE_NORMAL = 0
+CREATE_MODE_SGD_SPARSE_CPU_TRAINING = 3
+CREATE_MODE_TESTING = 4
+
+
+class UnsupportError(RuntimeError):
+    """reference: PaddleAPI.h:61 — operation the backend cannot do."""
+
+
+class RangeError(IndexError):
+    """reference: PaddleAPI.h:58 — index out of range."""
+
+
+# reference re-declares IOError for SWIG; python's builtin plays the role
+IOError = IOError
 
 
 def initPaddle(*args):
@@ -62,11 +101,18 @@ class Vector(object):
     def create(data):
         return Vector(data)
 
+    @staticmethod
+    def createZero(sz):
+        return Vector(np.zeros(sz, np.float32))
+
     def getSize(self):
         return self._a.shape[0]
 
     def copyToNumpyArray(self):
         return np.array(self._a)
+
+    def copyFromNumpyArray(self, arr):
+        np.copyto(self._a, np.asarray(arr, np.float32).reshape(-1))
 
 
 class IVector(object):
@@ -129,25 +175,250 @@ class Arguments(object):
         return data
 
 
+class ParameterConfig(object):
+    """reference: PaddleAPI.h:498 over proto/ParameterConfig.proto — the
+    per-parameter metadata view."""
+
+    def __init__(self, name, dims):
+        self._name = name
+        self._dims = list(int(d) for d in dims)
+
+    def getName(self):
+        return self._name
+
+    def toProtoString(self):
+        return json.dumps({"name": self._name, "dims": self._dims,
+                           "size": int(np.prod(self._dims))},
+                          sort_keys=True)
+
+
+class Parameter(object):
+    """reference: PaddleAPI.h:551 — scope-backed parameter handle with
+    value/gradient/momentum buffer access. Buffers are VIEWS when the
+    scope holds numpy (in-place update works, the reference contract);
+    device arrays are materialised to numpy on first touch."""
+
+    def __init__(self, var, scope, machine=None, pid=0):
+        self._var = var
+        self._scope = scope
+        self._machine = machine
+        self._pid = pid
+
+    def getName(self):
+        return self._var.name
+
+    def getID(self):
+        return self._pid
+
+    def getSize(self):
+        return int(np.prod(self._var.shape))
+
+    def getConfig(self):
+        return ParameterConfig(self._var.name, self._var.shape)
+
+    def _value(self):
+        return np.asarray(self._scope.find_var(self._var.name))
+
+    def _set_value(self, arr):
+        self._scope.set_var(self._var.name,
+                            np.asarray(arr, np.float32)
+                            .reshape(self._var.shape))
+
+    def getBuf(self, ptype=PARAMETER_VALUE):
+        if ptype == PARAMETER_VALUE:
+            val = self._scope.find_var(self._var.name)
+            if not (isinstance(val, np.ndarray)
+                    and val.flags.writeable):
+                # materialise device array to writable numpy so the
+                # buffer is a live view (the SWIG in-place contract)
+                val = np.array(val)
+                self._scope.set_var(self._var.name, val)
+            return Vector(val.reshape(-1))
+        if ptype == PARAMETER_GRADIENT:
+            if self._machine is None or not hasattr(self._machine, "_grads"):
+                raise UnsupportError("no gradient yet — run "
+                                     "forwardBackward first")
+            return Vector(self._machine._grads[self._var.name].reshape(-1))
+        raise UnsupportError("buffer type %r not held by the facade"
+                             % (ptype,))
+
+    def setValueUpdated(self):
+        return None
+
+    def save(self, filename):
+        np.save(filename if filename.endswith(".npy") else filename + ".npy",
+                self._value())
+        return True
+
+    def load(self, filename):
+        path = filename if filename.endswith(".npy") else filename + ".npy"
+        if not os.path.exists(path):
+            return False
+        self._set_value(np.load(path))
+        return True
+
+
+class ModelConfig(object):
+    """reference: PaddleAPI.h:600 — opaque model config obtained from
+    TrainerConfig, consumed by GradientMachine.createByModelConfig."""
+
+    def __init__(self, parsed):
+        # parsed: trainer_config_helpers.config_parser.ModelConfig
+        self._parsed = parsed
+
+
+class OptimizationConfig(object):
+    """reference: PaddleAPI.h:528 — the settings() half of a trainer
+    config. Holds the fluid optimizer factory plus the v1 settings dict
+    (learning_rate, batch_size, model_average window...)."""
+
+    def __init__(self, settings=None, make_optimizer=None):
+        self._settings = dict(settings or {})
+        self._make_optimizer = make_optimizer
+
+    @staticmethod
+    def createFromProtoString(s):
+        return OptimizationConfig(settings=json.loads(s))
+
+    def toProtoString(self):
+        return json.dumps(
+            {k: v for k, v in self._settings.items()
+             if isinstance(v, (int, float, str, bool, type(None)))},
+            sort_keys=True)
+
+    def learning_rate(self):
+        return float(self._settings.get("learning_rate", 1e-3))
+
+
+class TrainerConfig(object):
+    """reference: PaddleAPI.h:621 — model config + optimization config,
+    loaded from a trainer-config python file (config-as-data: the file is
+    executed under parse_config, settings() captured alongside)."""
+
+    def __init__(self, model_config, optimization_config):
+        self._model = model_config
+        self._opt = optimization_config
+
+    @staticmethod
+    def createFromTrainerConfigFile(path, *args):
+        from .trainer_config_helpers import config_parser, optimizers
+        parsed = config_parser.parse_config(path)
+        settings = optimizers.get_settings()
+        mk = optimizers.make_optimizer if settings else None
+        return TrainerConfig(ModelConfig(parsed),
+                             OptimizationConfig(settings, mk))
+
+    @staticmethod
+    def createFromProtoString(s):
+        from .trainer_config_helpers import config_parser
+        from .core.serialize import program_from_protostr
+        d = json.loads(s)
+        mc = config_parser.ModelConfig.__new__(config_parser.ModelConfig)
+        mc.main_program = program_from_protostr(
+            json.dumps(d["main_program"]))
+        mc.startup_program = program_from_protostr(
+            json.dumps(d["startup_program"]))
+        mc.output_layer_names = d["output_layer_names"]
+        mc.output_var_names = d.get("output_var_names",
+                                    d["output_layer_names"])
+        mc.input_layer_names = d["input_layer_names"]
+        mc.parameter_names = d["parameter_names"]
+        return TrainerConfig(ModelConfig(mc), OptimizationConfig())
+
+    def getModelConfig(self):
+        return self._model
+
+    def getOptimizationConfig(self):
+        return self._opt
+
+
+class UpdateCallback(object):
+    """reference: PaddleAPI.h:656 — inherit and override apply(parameter)
+    to observe/modify each parameter after backward."""
+
+    def apply(self, parameter):
+        return None
+
+
+class ParameterTraverseCallback(object):
+    """reference: PaddleAPI.h:663 — internal traversal hook used by
+    ParameterOptimizer.needSpecialTraversal; apply(vecs, config, sparseId)."""
+
+    def apply(self, vecs, config, sparse_id=0):
+        return None
+
+
 class GradientMachine(object):
     """reference: api/GradientMachine.cpp (createFromConfigProto /
     forward / forwardBackward over gserver's GradientMachine.h:88)."""
 
     def __init__(self, topology, scope=None):
         from . import Executor, CPUPlace, Scope
+        from .trainer_config_helpers.config_parser import (
+            ModelConfig as _ParsedConfig)
         from .v2.topology import Topology
-        if not isinstance(topology, Topology):
-            topology = Topology(topology)
-        self._topo = topology
         self._scope = scope or Scope()
         self._exe = Executor(CPUPlace())
-        self._exe.run(topology.startup_program, scope=self._scope)
-        self._data_vars = topology.data_type()
+        if isinstance(topology, ModelConfig):
+            topology = topology._parsed
+        if isinstance(topology, _ParsedConfig):
+            self._topo = None
+            self._main = topology.main_program
+            self._startup = topology.startup_program
+            blk = self._main.global_block()
+            # output_layer_names are v1 display names; the program vars
+            # live under output_var_names
+            out_names = getattr(topology, "output_var_names",
+                                topology.output_layer_names)
+            self._outputs = [blk.var(n) for n in out_names]
+            order = getattr(self._main, "_data_vars_order", None)
+            if order:
+                self._data_vars = [(v.name, v) for v in order]
+            else:
+                # deserialized programs carry the feed order in the
+                # config's input_layer_names instead
+                self._data_vars = [(n, blk.var(n))
+                                   for n in topology.input_layer_names]
+        else:
+            if not isinstance(topology, Topology):
+                topology = Topology(topology)
+            self._topo = topology
+            self._main = topology.main_program
+            self._startup = topology.startup_program
+            self._outputs = [lo.var for lo in topology.layers]
+            self._data_vars = topology.data_type()
+        self._exe.run(self._startup, scope=self._scope)
 
     # reference API name; "config proto" is the Program-as-config here
     @staticmethod
     def createFromConfigProto(topology, *args, **kwargs):
         return GradientMachine(topology)
+
+    @staticmethod
+    def createByConfigProtoStr(proto_str, mode=CREATE_MODE_NORMAL,
+                               parameter_types=None):
+        return GradientMachine(
+            TrainerConfig.createFromProtoString(proto_str)
+            .getModelConfig())
+
+    @staticmethod
+    def createByModelConfig(conf, mode=CREATE_MODE_NORMAL,
+                            parameter_types=None):
+        return GradientMachine(conf)
+
+    def start(self):
+        return None
+
+    def finish(self):
+        return None
+
+    def prefetch(self, in_args):
+        """Sparse-row prefetch (reference: GradientMachine::prefetch) —
+        XLA owns transfer scheduling; accepted and ignored."""
+        return None
+
+    def onPassEnd(self):
+        return None
 
     def _feeds(self, in_args):
         feed = {}
@@ -165,14 +436,24 @@ class GradientMachine(object):
 
     def forward(self, in_args, out_args, pass_type=None):
         """Run the topology's outputs; results land in ``out_args``."""
-        outs = [lo.var for lo in self._topo.layers]
         self._last_feed = self._feeds(in_args)
-        vals = self._exe.run(self._topo.main_program,
+        vals = self._exe.run(self._main,
                              feed=self._last_feed,
-                             fetch_list=outs, scope=self._scope)
+                             fetch_list=self._outputs, scope=self._scope)
+        self._last_outs = [np.asarray(v) for v in vals]
         return self._fill_out_args(out_args, vals)
 
-    def forwardBackward(self, in_args, out_args, pass_type=None):
+    def _append_grads(self):
+        from .core.backward import append_backward
+        from .core.ir import program_guard
+        if not getattr(self, "_grads_appended", False):
+            cost = self._outputs[0]
+            with program_guard(self._main, self._startup):
+                self._param_grads = append_backward(cost)
+            self._grads_appended = True
+
+    def forwardBackward(self, in_args, out_args, pass_type=None,
+                        callback=None):
         """forward + backward: parameter gradients are computed against
         the topology's cost (its FIRST output, the v2 convention) and kept
         readable via ``getParamGrad`` — the GradientMachine contract where
@@ -180,32 +461,96 @@ class GradientMachine(object):
         api/GradientMachine.cpp forwardBackward). Outputs and grads come
         from ONE executor run, so stochastic ops (dropout) see a single
         forward and the reported activations match the gradients."""
-        from .core.backward import append_backward
-        from .core.ir import program_guard
-        if not getattr(self, "_grads_appended", False):
-            cost = self._topo.layers[0].var
-            with program_guard(self._topo.main_program,
-                               self._topo.startup_program):
-                self._param_grads = append_backward(cost)
-            self._grads_appended = True
-        outs = [lo.var for lo in self._topo.layers]
+        self._append_grads()
         grad_vars = [g for _p, g in self._param_grads]
         self._last_feed = self._feeds(in_args)
-        vals = self._exe.run(self._topo.main_program,
+        vals = self._exe.run(self._main,
                              feed=self._last_feed,
-                             fetch_list=outs + grad_vars,
+                             fetch_list=self._outputs + grad_vars,
                              scope=self._scope)
+        n = len(self._outputs)
+        self._last_outs = [np.asarray(v) for v in vals[:n]]
         self._grads = {p.name: np.asarray(v) for (p, _g), v in
-                       zip(self._param_grads, vals[len(outs):])}
-        return self._fill_out_args(out_args, vals[:len(outs)])
+                       zip(self._param_grads, vals[n:])}
+        out = self._fill_out_args(out_args, vals[:n])
+        if callback is not None:
+            for p in self._parameters():
+                callback.apply(p)
+        return out
+
+    def backward(self, callback=None):
+        """Gradient half alone (reference: GradientMachine::backward). The
+        executor recomputes forward+backward in one compiled program, so
+        this re-runs the last forward's feed with gradients on."""
+        if not hasattr(self, "_last_feed"):
+            raise UnsupportError("backward() needs a forward first")
+        self._append_grads()
+        grad_vars = [g for _p, g in self._param_grads]
+        vals = self._exe.run(self._main, feed=self._last_feed,
+                             fetch_list=grad_vars, scope=self._scope)
+        self._grads = {p.name: np.asarray(v) for (p, _g), v in
+                       zip(self._param_grads, vals)}
+        if callback is not None:
+            for p in self._parameters():
+                callback.apply(p)
 
     def getParamGrad(self, name):
         """numpy gradient of a parameter from the last forwardBackward."""
         return self._grads[name]
 
+    def _parameters(self):
+        vars_ = sorted(self._main.all_parameters(), key=lambda v: v.name)
+        return [Parameter(v, self._scope, machine=self, pid=i)
+                for i, v in enumerate(vars_)]
+
+    def getParameterSize(self):
+        return len(self._main.all_parameters())
+
+    def getParameter(self, i):
+        ps = self._parameters()
+        if not 0 <= i < len(ps):
+            raise RangeError("parameter index %d out of range" % i)
+        return ps[i]
+
+    # all parameters are "non static" here (no fixed embedding tables)
+    def getNonStaticParameterSize(self):
+        return self.getParameterSize()
+
+    def getNonStaticParameter(self, i):
+        return self.getParameter(i)
+
+    def randParameters(self):
+        """Re-run the startup program (reference: randParameters re-runs
+        the initializers)."""
+        self._exe.run(self._startup, scope=self._scope)
+
+    def loadParameters(self, path):
+        from . import io as fluid_io
+        prog = fluid_io._build_io_program(
+            "load", path, self._main.all_parameters(), None)
+        self._exe.run(prog, scope=self._scope)
+
+    def saveParameters(self, path):
+        from . import io as fluid_io
+        os.makedirs(path, exist_ok=True)
+        prog = fluid_io._build_io_program(
+            "save", path, self._main.all_parameters(), None)
+        self._exe.run(prog, scope=self._scope)
+
     def getParameters(self):
         from .v2.parameters import Parameters
+        if self._topo is None:
+            raise UnsupportError("getParameters() needs a Topology-built "
+                                 "machine")
         return Parameters(self._topo, scope=self._scope)
+
+    def getLayerOutput(self, name):
+        """Single-layer activation as Arguments (reference:
+        GradientMachine::getLayerOutput)."""
+        vals = self.getLayerOutputs(name)
+        out = Arguments(1)
+        out.setSlotValue(0, Matrix(np.atleast_2d(vals[name])))
+        return out
 
     def getLayerOutputs(self, names):
         """Activations for named layers from the LAST forward's inputs
@@ -215,10 +560,437 @@ class GradientMachine(object):
                 "getLayerOutputs needs a forward first — call "
                 "forward()/forwardBackward() before reading activations")
         names = [names] if isinstance(names, str) else list(names)
-        vals = self._exe.run(self._topo.main_program,
+        vals = self._exe.run(self._main,
                              feed=self._last_feed, fetch_list=names,
                              scope=self._scope)
         return {n: np.asarray(v) for n, v in zip(names, vals)}
+
+    def asSequenceGenerator(self, dict_=(), begin_id=0, end_id=0,
+                            max_length=100, beam_size=-1):
+        """reference: GradientMachine::asSequenceGenerator — the machine's
+        program must be a generation topology (built with the v1
+        beam_search DSL or a fluid While+beam_search decode program) whose
+        outputs are (translation_ids, translation_scores)."""
+        gen = SequenceGenerator(self)
+        if dict_:
+            gen.setDict(list(dict_))
+        gen.setBos(begin_id)
+        gen.setEos(end_id)
+        gen.setMaxLength(max_length)
+        if beam_size and beam_size != -1:
+            gen.setBeamSize(beam_size)
+        return gen
+
+    def makeEvaluator(self):
+        return Evaluator(self)
+
+    def eval(self, evaluator):
+        evaluator._accumulate(self)
+
+
+class Evaluator(object):
+    """reference: PaddleAPI.h:919 over api/Evaluator.cpp — start/finish
+    bracket a stage; ``gm.eval(ev)`` accumulates the machine's metric
+    outputs (the v2 convention: outputs after the cost are evaluator
+    layers, e.g. classification_error). toString mirrors the reference's
+    printed "name=value" report."""
+
+    def __init__(self, machine):
+        self._machine = machine
+        self._names = [getattr(v, "name", "out%d" % i)
+                       for i, v in enumerate(machine._outputs)]
+        self.start()
+
+    def start(self):
+        self._sums = {n: 0.0 for n in self._names}
+        self._weights = {n: 0.0 for n in self._names}
+
+    def finish(self):
+        return None
+
+    def _accumulate(self, machine):
+        outs = getattr(machine, "_last_outs", None)
+        if outs is None:
+            raise UnsupportError("eval() needs a forward first")
+        for n, v in zip(self._names, outs):
+            v = np.asarray(v, np.float64).reshape(-1)
+            self._sums[n] += float(v.sum())
+            self._weights[n] += v.size
+
+    def getNames(self):
+        return list(self._names)
+
+    def getValue(self, name):
+        w = self._weights.get(name, 0.0)
+        if w == 0.0:
+            return float("nan")
+        return self._sums[name] / w
+
+    def toString(self):
+        return "  ".join("%s=%.6g" % (n, self.getValue(n))
+                         for n in self._names)
+
+    __repr__ = toString
+
+
+class ParameterOptimizer(object):
+    """reference: PaddleAPI.h:685 over parameter/ParameterOptimizer.h —
+    the raw per-parameter apply rule. The facade exposes the numpy apply
+    used by the parameter-server path (sgd + momentum), the same
+    reference split where the optimizer library was shared between
+    trainer and pserver."""
+
+    def __init__(self, config):
+        self._config = config
+        self._velocity = {}
+
+    @staticmethod
+    def create(optimization_config):
+        return ParameterOptimizer(optimization_config)
+
+    def startPass(self):
+        return None
+
+    def finishPass(self):
+        return None
+
+    def startBatch(self, num_samples):
+        return None
+
+    def finishBatch(self):
+        return None
+
+    def needSpecialTraversal(self, config):
+        return None
+
+    def update(self, parameter, gradient=None):
+        """In-place sgd/momentum apply on the parameter's value buffer."""
+        s = self._config._settings
+        lr = float(s.get("learning_rate", 1e-3))
+        mom = 0.0
+        lm = s.get("learning_method")
+        if lm is not None:
+            mom = float(getattr(lm, "momentum", 0.0) or 0.0)
+        g = (gradient if gradient is not None
+             else parameter._machine._grads[parameter.getName()])
+        g = np.asarray(g, np.float32).reshape(-1)
+        v = np.asarray(parameter._scope.find_var(parameter.getName()),
+                       np.float32)
+        shape = v.shape
+        v = v.reshape(-1)
+        if mom:
+            vel = self._velocity.setdefault(
+                parameter.getName(), np.zeros_like(v))
+            vel *= mom
+            vel -= lr * g
+            v = v + vel
+        else:
+            v = v - lr * g
+        parameter._scope.set_var(parameter.getName(), v.reshape(shape))
+
+
+class ParameterUpdater(object):
+    """reference: PaddleAPI.h:835 over api/ParameterUpdater.cpp. The
+    local updater applies gradients with the numpy optimizer rule; the
+    "remote" creators map onto the same local apply (the pserver role is
+    played by parallel/async_sgd's service when used for real training —
+    this facade is the script-compat veneer)."""
+
+    def __init__(self, config, remote=False):
+        self._config = config
+        self._opt = ParameterOptimizer(config)
+        self._machine = None
+        self._remote = remote
+        self._avg = None          # ModelAverage shadow
+        self._avg_saved = None
+        self._n_updates = 0
+
+    @staticmethod
+    def createLocalUpdater(config):
+        return ParameterUpdater(config)
+
+    @staticmethod
+    def createRemoteUpdater(config, pass_count=1, use_sparse_updater=False):
+        return ParameterUpdater(config, remote=True)
+
+    @staticmethod
+    def createNewRemoteUpdater(config, pserver_spec="", use_etcd=False):
+        return ParameterUpdater(config, remote=True)
+
+    def init(self, gradient_machine):
+        self._machine = gradient_machine
+        s = self._config._settings
+        ma = s.get("model_average")
+        if ma is not None or s.get("average_window"):
+            self._avg = {}
+
+    def startPass(self):
+        self._opt.startPass()
+
+    def finishPass(self):
+        self._opt.finishPass()
+
+    def startBatch(self, batch_size):
+        self._opt.startBatch(batch_size)
+        return PASS_TRAIN
+
+    def finishBatch(self, cost=0.0):
+        self._opt.finishBatch()
+        self._n_updates += 1
+        if self._avg is not None and self._machine is not None:
+            for p in self._machine._parameters():
+                cur = p._value().astype(np.float64)
+                acc = self._avg.get(p.getName())
+                self._avg[p.getName()] = (cur if acc is None else
+                                          acc + (cur - acc)
+                                          / self._n_updates)
+
+    def update(self, parameter):
+        self._opt.update(parameter)
+
+    def getParametersRemote(self, full_size=False, apply=False):
+        """Local facade: parameters already live in the scope."""
+        return None
+
+    def apply(self):
+        """Swap averaged parameters in (reference: AverageOptimizer
+        apply — store current, load average)."""
+        if self._avg is None or self._machine is None:
+            return None
+        self._avg_saved = {p.getName(): p._value().copy()
+                           for p in self._machine._parameters()}
+        for p in self._machine._parameters():
+            if p.getName() in self._avg:
+                p._set_value(self._avg[p.getName()])
+
+    def restore(self):
+        """Restore current values after apply() (reference: restore)."""
+        if self._avg_saved is None:
+            return None
+        for p in (self._machine._parameters() if self._machine else []):
+            if p.getName() in self._avg_saved:
+                p._set_value(self._avg_saved[p.getName()])
+        self._avg_saved = None
+
+    def catchUpWith(self):
+        """Delayed-regularization catch-up (reference: catchUpWith). The
+        numpy apply path regularizes eagerly, so there is nothing
+        pending."""
+        return None
+
+
+class Trainer(object):
+    """reference: PaddleAPI.h:955 over api/Trainer.cpp — the script-level
+    train loop: startTrain/startTrainPass bracket passes,
+    trainOneDataBatch runs fwd+bwd+update on fed Arguments."""
+
+    def __init__(self, config, machine):
+        self._config = config
+        self._machine = machine
+        self._updater = ParameterUpdater.createLocalUpdater(
+            config.getOptimizationConfig() if config else
+            OptimizationConfig())
+        self._updater.init(machine)
+        self._out = Arguments(len(machine._outputs))
+        self._testing = False
+        self._test_evaluator = None
+
+    @staticmethod
+    def create(config, machine=None):
+        if machine is None:
+            machine = GradientMachine(config.getModelConfig())
+        return Trainer(config, machine)
+
+    @staticmethod
+    def createByCommandLine():
+        raise UnsupportError(
+            "createByCommandLine reads gflags; build a TrainerConfig from "
+            "the config file and use Trainer.create(config) instead")
+
+    def startTrain(self):
+        self._machine.start()
+
+    def finishTrain(self):
+        self._machine.finish()
+
+    def startTrainPass(self):
+        self._updater.startPass()
+
+    def finishTrainPass(self):
+        self._updater.finishPass()
+        self._machine.onPassEnd()
+
+    def trainOneDataBatch(self, batch_size, args):
+        self._updater.startBatch(batch_size)
+        self._machine.forwardBackward(args, self._out, PASS_TRAIN)
+        for p in self._machine._parameters():
+            self._updater.update(p)
+        cost = float(np.mean(self._out.getSlotValue(0).copyToNumpyMat()))
+        self._updater.finishBatch(cost)
+        return cost
+
+    def trainOneBatch(self, batch_size):
+        raise UnsupportError(
+            "trainOneBatch pulls from the C++ DataProvider; feed batches "
+            "explicitly via trainOneDataBatch(batch_size, args)")
+
+    def startTestPeriod(self):
+        self._testing = True
+        self._test_evaluator = self._machine.makeEvaluator()
+        self._test_evaluator.start()
+
+    def testOneDataBatch(self, batch_size, args):
+        self._machine.forward(args, self._out, PASS_TEST)
+        self._machine.eval(self._test_evaluator)
+
+    def finishTestPeriod(self):
+        self._testing = False
+        if self._test_evaluator is not None:
+            self._test_evaluator.finish()
+        return self._test_evaluator
+
+    def forwardOneBatch(self, batch_size):
+        raise UnsupportError(
+            "forwardOneBatch pulls from the C++ DataProvider; call "
+            "machine.forward(args, out) with explicit Arguments")
+
+    def getForwardOutput(self):
+        return self._out
+
+    def getLayerOutput(self, layer_name):
+        return self._machine.getLayerOutput(layer_name)
+
+
+class ISequenceResults(object):
+    """reference: PaddleAPI.h:1004 — N-best results for one input."""
+
+    def getSize(self):
+        raise NotImplementedError
+
+    def getSentence(self, i, split=False):
+        raise NotImplementedError
+
+    def getSequence(self, i):
+        raise NotImplementedError
+
+    def getScore(self, i):
+        raise NotImplementedError
+
+
+class _SequenceResults(ISequenceResults):
+    def __init__(self, sequences, scores, dictionary=None):
+        self._seqs = sequences
+        self._scores = scores
+        self._dict = dictionary
+
+    def getSize(self):
+        return len(self._seqs)
+
+    def _check(self, i):
+        if not 0 <= i < len(self._seqs):
+            raise RangeError("result index %d out of range" % i)
+
+    def getSequence(self, i):
+        self._check(i)
+        return list(self._seqs[i])
+
+    def getScore(self, i):
+        self._check(i)
+        return float(self._scores[i])
+
+    def getSentence(self, i, split=False):
+        self._check(i)
+        if self._dict is None:
+            raise RangeError("no dictionary set — call setDict first")
+        words = [self._dict[w] if 0 <= w < len(self._dict) else "<unk>"
+                 for w in self._seqs[i]]
+        return words if split else " ".join(words)
+
+
+class SequenceGenerator(object):
+    """reference: PaddleAPI.h:1025 over api/SequenceGenerator.cpp — drive
+    the machine's compiled beam-search decode program and unpack the
+    two-level LoD result into per-source N-best lists. The machine's
+    program must take ``init_ids``/``init_scores`` seed slots (what the
+    v1 beam_search DSL and the fluid decode pattern both build —
+    tests/book/test_machine_translation.py decoder_decode)."""
+
+    def __init__(self, machine):
+        self._machine = machine
+        self._dict = None
+        self._bos = 0
+        self._eos = 0
+        self._max_length = 100
+        self._beam_size = None
+
+    def setDict(self, words):
+        self._dict = list(words)
+
+    def setBos(self, bos):
+        self._bos = int(bos)
+
+    def setEos(self, eos):
+        self._eos = int(eos)
+
+    def setMaxLength(self, maxlen):
+        self._max_length = int(maxlen)
+
+    def setBeamSize(self, beam_size):
+        self._beam_size = int(beam_size)
+
+    def _seed(self, n_seqs):
+        from .core.lod import LoDTensor
+        lod = [list(range(n_seqs + 1)), list(range(n_seqs + 1))]
+        ids = LoDTensor(np.full((n_seqs, 1), self._bos, np.int64), lod)
+        scores = LoDTensor(np.ones((n_seqs, 1), np.float32), lod)
+        return ids, scores
+
+    def generateSequence(self, in_args):
+        m = self._machine
+        feed = m._feeds(in_args)
+        # count source sequences from the first slot's LoD (1 if dense)
+        n_seqs = 1
+        s0 = in_args._slots[0] if in_args.getSlotNum() else {}
+        if "seq_start" in s0:
+            n_seqs = s0["seq_start"].getSize() - 1
+        init_ids, init_scores = self._seed(n_seqs)
+        feed.setdefault("init_ids", init_ids)
+        feed.setdefault("init_scores", init_scores)
+        vals = m._exe.run(m._main, feed=feed,
+                          fetch_list=m._outputs[:2], scope=m._scope,
+                          return_numpy=False)
+        ids_t, scores_t = vals
+        lod = ids_t.lod()
+        flat_ids = np.asarray(ids_t).reshape(-1).astype(int)
+        flat_scores = np.asarray(scores_t).reshape(-1)
+        # level 0: per-source sentence ranges; level 1: per-sentence tokens
+        seqs, scores = [], []
+        sent_lo = lod[1]
+        for a, b in zip(sent_lo, sent_lo[1:]):
+            toks = list(flat_ids[a:b])
+            # drop the bos seed token; stop at eos; cap at max_length
+            if toks and toks[0] == self._bos:
+                toks = toks[1:]
+            if self._eos in toks:
+                toks = toks[:toks.index(self._eos)]
+            toks = toks[:self._max_length]
+            seqs.append(toks)
+            # reference scores the whole sentence by its accumulated
+            # log-prob: the last step's score entry
+            scores.append(float(flat_scores[b - 1]) if b > a else 0.0)
+        # sort WITHIN each source's candidate group (lod level 0) so the
+        # source-to-result association survives; the reference contract
+        # is one source per call, where this reduces to a plain sort
+        src_lo = lod[0] if len(lod) > 1 else [0, len(seqs)]
+        order = []
+        for a, b in zip(src_lo, src_lo[1:]):
+            order.extend(sorted(range(a, b), key=lambda i: -scores[i]))
+        return _SequenceResults([seqs[i] for i in order],
+                                [scores[i] for i in order], self._dict)
+
+    @staticmethod
+    def createByGradientMachineSharedPtr(machine):
+        return SequenceGenerator(machine)
 
 
 # the reference package exposes these under py_paddle.swig_paddle
@@ -228,6 +1000,28 @@ class _SwigModule(object):
     IVector = IVector
     Arguments = Arguments
     GradientMachine = GradientMachine
+    Parameter = Parameter
+    ParameterConfig = ParameterConfig
+    ModelConfig = ModelConfig
+    TrainerConfig = TrainerConfig
+    OptimizationConfig = OptimizationConfig
+    UpdateCallback = UpdateCallback
+    ParameterTraverseCallback = ParameterTraverseCallback
+    ParameterOptimizer = ParameterOptimizer
+    ParameterUpdater = ParameterUpdater
+    Evaluator = Evaluator
+    Trainer = Trainer
+    ISequenceResults = ISequenceResults
+    SequenceGenerator = SequenceGenerator
+    UnsupportError = UnsupportError
+    RangeError = RangeError
+    IOError = IOError
+    PASS_TRAIN = PASS_TRAIN
+    PASS_TEST = PASS_TEST
+    PARAMETER_VALUE = PARAMETER_VALUE
+    PARAMETER_GRADIENT = PARAMETER_GRADIENT
+    CREATE_MODE_NORMAL = CREATE_MODE_NORMAL
+    CREATE_MODE_TESTING = CREATE_MODE_TESTING
     initPaddle = staticmethod(initPaddle)
 
 

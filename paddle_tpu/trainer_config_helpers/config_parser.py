@@ -23,8 +23,18 @@ class ModelConfig(object):
     def __init__(self, main_program, startup_program, outputs):
         self.main_program = main_program
         self.startup_program = startup_program
-        self.output_layer_names = [getattr(o, "name", str(o))
-                                   for o in outputs]
+        # a LayerOutput's display name can be None (e.g. beam_search's
+        # score slot) — fall back to the underlying var's name
+        self.output_layer_names = [
+            getattr(o, "name", None)
+            or getattr(getattr(o, "var", None), "name", str(o))
+            for o in outputs]
+        # the display name is a v1 layer name, NOT necessarily a program
+        # variable — keep the actual output var names for executors
+        self.output_var_names = [
+            getattr(getattr(o, "var", None), "name", None)
+            or getattr(o, "name", str(o))
+            for o in outputs]
         order = getattr(main_program, "_data_vars_order", [])
         self.input_layer_names = [v.name for v in order]
         self.parameter_names = sorted(
@@ -36,6 +46,7 @@ class ModelConfig(object):
             "startup_program": program_to_dict(self.startup_program),
             "input_layer_names": self.input_layer_names,
             "output_layer_names": self.output_layer_names,
+            "output_var_names": self.output_var_names,
             "parameter_names": self.parameter_names,
         }
 
