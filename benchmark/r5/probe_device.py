@@ -1,0 +1,14 @@
+import json, time, sys
+t0 = time.time()
+import jax
+devs = jax.devices()
+t1 = time.time()
+import jax.numpy as jnp
+x = jnp.ones((1024, 1024), dtype=jnp.bfloat16)
+y = (x @ x).block_until_ready()
+t2 = time.time()
+out = {"ok": True, "platform": devs[0].platform, "device": str(devs[0].device_kind),
+       "n": len(devs), "t_devices_s": round(t1-t0,2), "t_matmul_s": round(t2-t1,2)}
+print(json.dumps(out))
+with open("/root/repo/benchmark/r5/probe_device.json","w") as f:
+    json.dump(out, f)

@@ -1,0 +1,92 @@
+"""CTR wide&deep end-to-end demo: sparse slots + async parameter server.
+
+The BASELINE "CTR DeepFM / wide&deep" workload composed from the pieces
+built for it: SelectedRows sparse embedding gradients cross the wire as
+row subsets, the parameter service applies them server-side, and two
+unbarriered workers train the shared model (reference:
+doc/design/cluster_train/large_model_dist_train.md).
+
+Run: python examples/ctr_demo.py   (CPU is fine; set JAX_PLATFORMS=cpu)
+"""
+import threading
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.models import wide_deep, synthetic_click_batch
+from paddle_tpu.parallel.async_sgd import (AsyncParameterServer,
+                                           AsyncSGDUpdater,
+                                           build_grad_program)
+
+SLOTS, DENSE, VOCAB, EMB = 16, 8, 1000, 8
+BATCH, STEPS, WORKERS = 256, 60, 2
+
+
+def build():
+    avg_cost, auc_var, prob, feeds = wide_deep(
+        num_sparse_slots=SLOTS, dense_dim=DENSE, vocab_size=VOCAB,
+        embed_dim=EMB, hidden_sizes=(64, 32))
+    pg = build_grad_program(avg_cost)
+    return avg_cost, auc_var, pg
+
+
+def worker(wid, address, main, startup, avg_cost, auc_var, pg, report):
+    # scope passed explicitly: scope_guard's stack is process-global and
+    # unbarriered worker threads must not fight over it
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup, scope=scope)
+    upd = AsyncSGDUpdater(address, worker_id=wid)
+    rng = np.random.RandomState(wid)
+    for step in range(STEPS):
+        upd.pull_into(scope, step=step)
+        feed = synthetic_click_batch(rng, BATCH, SLOTS, DENSE, VOCAB)
+        fetched = exe.run(main, feed=feed, scope=scope,
+                          fetch_list=[avg_cost, auc_var] +
+                          [g.name for _p, g in pg])
+        loss = float(np.asarray(fetched[0]).reshape(-1)[0])
+        auc = float(np.asarray(fetched[1]).reshape(-1)[0])
+        # sparse grads ship as row subsets (push converts)
+        upd.push({p.name: gv for (p, _g), gv
+                  in zip(pg, fetched[2:])}, step=step)
+        if step % 10 == 0 or step == STEPS - 1:
+            print("worker %d step %2d  loss %.4f  batch-auc %.3f"
+                  % (wid, step, loss, auc))
+        report[wid] = (loss, auc)
+    upd.close()
+
+
+def main():
+    avg_cost, auc_var, pg = build()
+    main_prog = pt.default_main_program()
+    startup = pt.default_startup_program()
+
+    # server owns the parameters: init once, serve numpy buffers
+    scope0 = pt.Scope()
+    with pt.scope_guard(scope0):
+        pt.Executor(pt.CPUPlace()).run(startup)
+        params = {p.name: np.array(scope0.find_var(p.name))
+                  for p, _g in pg}
+    server = AsyncParameterServer(params, lr=0.1, optimizer="momentum",
+                                  momentum=0.9, n_workers=WORKERS,
+                                  staleness_cap=4).start()
+    try:
+        report = {}
+        threads = [threading.Thread(
+            target=worker, args=(w, server.address, main_prog, startup,
+                                 avg_cost, auc_var, pg, report))
+            for w in range(WORKERS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        losses = [v[0] for v in report.values()]
+        aucs = [v[1] for v in report.values()]
+        print("final: mean loss %.4f  mean batch-auc %.3f"
+              % (np.mean(losses), np.mean(aucs)))
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

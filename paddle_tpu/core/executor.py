@@ -423,6 +423,12 @@ def _fetch_to_host(val, return_numpy=True):
         t = LoDTensor(np.asarray(val.data),
                       [list(np.asarray(l)) for l in val.lod])
         return t
+    from ..ops.selected_rows import SelectedRowsVal
+    if isinstance(val, SelectedRowsVal):
+        # keep the row structure (np.asarray would produce a useless 0-d
+        # object array); callers that want dense use .to_dense()
+        return SelectedRowsVal(np.asarray(val.rows),
+                               np.asarray(val.values), val.height)
     if return_numpy:
         return np.asarray(val)
     return val

@@ -16,7 +16,8 @@ from .layer_helper import LayerHelper
 __all__ = [
     "fc", "embedding", "dropout", "conv2d", "conv2d_transpose", "pool2d",
     "batch_norm", "layer_norm", "softmax", "cross_entropy",
-    "square_error_cost", "softmax_with_cross_entropy", "accuracy", "topk",
+    "square_error_cost", "softmax_with_cross_entropy", "accuracy", "auc",
+    "topk",
     "matmul", "reshape", "transpose", "split", "concat_nn", "reduce_sum",
     "reduce_mean", "reduce_max", "reduce_min", "l2_normalize", "one_hot",
     "clip", "clip_by_norm", "mean", "mul", "scale", "dot", "cos_sim", "slice",
@@ -455,6 +456,21 @@ def accuracy(input, label, k=1, correct=None, total=None):
                      outputs={"Accuracy": [acc_out], "Correct": [correct],
                               "Total": [total]})
     return acc_out
+
+
+def auc(input, label, curve="ROC", num_thresholds=200, topk=1):
+    """reference: layers/metric.py auc -> operators/auc_op.cc. ``input``
+    is the (N, 2) softmax or (N, 1) sigmoid click probability."""
+    helper = LayerHelper("auc")
+    auc_out = helper.create_variable_for_type_inference("float32")
+    auc_out.shape = ()
+    auc_out.stop_gradient = True
+    helper.append_op(type="auc",
+                     inputs={"Out": [input], "Label": [label]},
+                     outputs={"AUC": [auc_out]},
+                     attrs={"curve": curve,
+                            "num_thresholds": num_thresholds})
+    return auc_out
 
 
 def topk(input, k, name=None):

@@ -13,3 +13,4 @@ from .resnet import resnet, resnet_cifar10, resnet_imagenet  # noqa: F401
 from .alexnet import alexnet  # noqa: F401
 from .googlenet import googlenet  # noqa: F401
 from .transformer import transformer_lm, transformer_block  # noqa: F401
+from .ctr import wide_deep, deepfm, synthetic_click_batch  # noqa: F401

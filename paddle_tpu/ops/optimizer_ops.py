@@ -5,6 +5,7 @@ and writes ParamOut (aliasing Param, so the executor state pass carries the
 update). On TPU all of these fuse into the backward XLA computation."""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..core.executor import raw_data
@@ -13,6 +14,17 @@ from ..core.registry import register_op
 
 def _lr(ctx):
     return raw_data(ctx.input("LearningRate")).reshape(())
+
+
+def _grad(ctx):
+    """Dense gradient view; SelectedRows (sparse embedding grads) are
+    densified — the reference's non-lazy accumulator semantics, identical
+    numerics to a dense grad (reference: math/selected_rows_functor.*)."""
+    g = ctx.input("Grad")
+    from .selected_rows import SelectedRowsVal
+    if isinstance(g, SelectedRowsVal):
+        return g.to_dense()
+    return raw_data(g)
 
 
 @register_op("sgd", no_gradient=True, stateful_outputs=("ParamOut",))
@@ -32,7 +44,7 @@ def sgd(ctx):
              stateful_outputs=("ParamOut", "VelocityOut"))
 def momentum(ctx):
     p = raw_data(ctx.input("Param"))
-    g = raw_data(ctx.input("Grad"))
+    g = _grad(ctx)
     v = raw_data(ctx.input("Velocity"))
     mu = ctx.attr("mu")
     lr = _lr(ctx)
@@ -48,8 +60,9 @@ def momentum(ctx):
 @register_op("adam", no_gradient=True,
              stateful_outputs=("ParamOut", "Moment1Out", "Moment2Out"))
 def adam(ctx):
+    from .selected_rows import SelectedRowsVal
     p = raw_data(ctx.input("Param"))
-    g = raw_data(ctx.input("Grad"))
+    g = ctx.input("Grad")
     m1 = raw_data(ctx.input("Moment1"))
     m2 = raw_data(ctx.input("Moment2"))
     b1p = raw_data(ctx.input("Beta1Pow")).reshape(())
@@ -58,6 +71,36 @@ def adam(ctx):
     b2 = ctx.attr("beta2", 0.999)
     eps = ctx.attr("epsilon", 1e-8)
     lr = _lr(ctx) * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
+    if isinstance(g, SelectedRowsVal):
+        if ctx.attr("lazy_mode", False):
+            # reference: operators/adam_op.h lazy_mode — touch only the
+            # looked-up rows. Duplicates are merged on the batch-sized
+            # row set (size= keeps unique jittable); no [vocab, dim]
+            # scratch is materialised. Padding lanes carry row==height:
+            # their gathers clamp, their scatters drop — harmless.
+            n = g.rows.shape[0]
+            height = p.shape[0]
+            rows = jnp.unique(g.rows, size=n, fill_value=height)
+            inv = jnp.searchsorted(rows, g.rows)
+            gr = jax.ops.segment_sum(g.values, inv, num_segments=n)
+            m1r = b1 * m1[rows] + (1.0 - b1) * gr
+            m2r = b2 * m2[rows] + (1.0 - b2) * gr * gr
+            pr = p[rows] - lr * m1r / (jnp.sqrt(m2r) + eps)
+            # mask padding lanes so the clamped-gather garbage never
+            # lands even if a backend clamps scatter indices
+            valid = (rows < height)[:, None]
+            ctx.set_output("ParamOut", p.at[rows].set(
+                jnp.where(valid, pr, p[rows])))
+            ctx.set_output("Moment1Out", m1.at[rows].set(
+                jnp.where(valid, m1r, m1[rows])))
+            ctx.set_output("Moment2Out", m2.at[rows].set(
+                jnp.where(valid, m2r, m2[rows])))
+            return
+        # non-lazy (reference default): untouched rows still decay —
+        # identical numerics to the dense grad
+        g = g.to_dense()
+    else:
+        g = raw_data(g)
     m1n = b1 * m1 + (1.0 - b1) * g
     m2n = b2 * m2 + (1.0 - b2) * g * g
     ctx.set_output("ParamOut", p - lr * m1n / (jnp.sqrt(m2n) + eps))
@@ -69,7 +112,7 @@ def adam(ctx):
              stateful_outputs=("ParamOut", "MomentOut", "InfNormOut"))
 def adamax(ctx):
     p = raw_data(ctx.input("Param"))
-    g = raw_data(ctx.input("Grad"))
+    g = _grad(ctx)
     m = raw_data(ctx.input("Moment"))
     inf = raw_data(ctx.input("InfNorm"))
     b1p = raw_data(ctx.input("Beta1Pow")).reshape(())
@@ -88,7 +131,7 @@ def adamax(ctx):
              stateful_outputs=("ParamOut", "MomentOut"))
 def adagrad(ctx):
     p = raw_data(ctx.input("Param"))
-    g = raw_data(ctx.input("Grad"))
+    g = _grad(ctx)
     m = raw_data(ctx.input("Moment"))
     eps = ctx.attr("epsilon", 1e-6)
     mn = m + g * g
@@ -100,7 +143,7 @@ def adagrad(ctx):
              stateful_outputs=("ParamOut", "MomentOut"))
 def decayed_adagrad(ctx):
     p = raw_data(ctx.input("Param"))
-    g = raw_data(ctx.input("Grad"))
+    g = _grad(ctx)
     m = raw_data(ctx.input("Moment"))
     decay = ctx.attr("decay", 0.95)
     eps = ctx.attr("epsilon", 1e-6)
@@ -114,7 +157,7 @@ def decayed_adagrad(ctx):
                                "AvgSquaredUpdateOut"))
 def adadelta(ctx):
     p = raw_data(ctx.input("Param"))
-    g = raw_data(ctx.input("Grad"))
+    g = _grad(ctx)
     ag = raw_data(ctx.input("AvgSquaredGrad"))
     au = raw_data(ctx.input("AvgSquaredUpdate"))
     rho = ctx.attr("rho", 0.95)
@@ -131,7 +174,7 @@ def adadelta(ctx):
              stateful_outputs=("ParamOut", "MomentOut", "MeanSquareOut"))
 def rmsprop(ctx):
     p = raw_data(ctx.input("Param"))
-    g = raw_data(ctx.input("Grad"))
+    g = _grad(ctx)
     ms = raw_data(ctx.input("MeanSquare"))
     mom = raw_data(ctx.input("Moment"))
     rho = ctx.attr("decay", 0.9)
@@ -148,7 +191,7 @@ def rmsprop(ctx):
              stateful_outputs=("ParamOut", "SquaredAccumOut", "LinearAccumOut"))
 def ftrl(ctx):
     p = raw_data(ctx.input("Param"))
-    g = raw_data(ctx.input("Grad"))
+    g = _grad(ctx)
     sq = raw_data(ctx.input("SquaredAccumulator"))
     lin = raw_data(ctx.input("LinearAccumulator"))
     l1 = ctx.attr("l1", 0.0)
@@ -174,7 +217,7 @@ def ftrl(ctx):
 @register_op("proximal_gd", no_gradient=True, stateful_outputs=("ParamOut",))
 def proximal_gd(ctx):
     p = raw_data(ctx.input("Param"))
-    g = raw_data(ctx.input("Grad"))
+    g = _grad(ctx)
     l1 = ctx.attr("l1", 0.0)
     l2 = ctx.attr("l2", 0.0)
     lr = _lr(ctx)
@@ -189,7 +232,7 @@ def proximal_gd(ctx):
              stateful_outputs=("ParamOut", "MomentOut"))
 def proximal_adagrad(ctx):
     p = raw_data(ctx.input("Param"))
-    g = raw_data(ctx.input("Grad"))
+    g = _grad(ctx)
     m = raw_data(ctx.input("Moment"))
     l1 = ctx.attr("l1", 0.0)
     l2 = ctx.attr("l2", 0.0)

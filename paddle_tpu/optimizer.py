@@ -174,10 +174,13 @@ class AdagradOptimizer(Optimizer):
 
 class AdamOptimizer(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
-                 epsilon=1e-8, **kwargs):
+                 epsilon=1e-8, lazy_mode=False, **kwargs):
         super(AdamOptimizer, self).__init__(learning_rate, **kwargs)
         self.type = "adam"
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        # reference: adam_op.cc lazy_mode — sparse grads update only the
+        # looked-up rows (no accumulator decay on untouched rows)
+        self._lazy_mode = lazy_mode
 
     def _create_accumulators(self, block, parameters):
         helper = LayerHelper("adam")
@@ -208,7 +211,8 @@ class AdamOptimizer(Optimizer):
             outputs={"ParamOut": [param_and_grad[0]],
                      "Moment1Out": [m1], "Moment2Out": [m2]},
             attrs={"beta1": self._beta1, "beta2": self._beta2,
-                   "epsilon": self._epsilon})
+                   "epsilon": self._epsilon,
+                   "lazy_mode": self._lazy_mode})
 
     def _finish_update(self, block):
         """Advance beta powers once per step (reference: adam scale ops)."""

@@ -48,7 +48,43 @@ from typing import Dict, Optional
 
 import numpy as np
 
-__all__ = ["AsyncParameterServer", "AsyncSGDUpdater", "build_grad_program"]
+__all__ = ["AsyncParameterServer", "AsyncSGDUpdater", "build_grad_program",
+           "SparseRows"]
+
+
+class SparseRows(object):
+    """Wire form of a SelectedRows gradient / row-subset parameter slice:
+    only the touched rows cross the network (reference:
+    doc/design/cluster_train/large_model_dist_train.md — trainers ship
+    sparse grads and prefetch only needed rows)."""
+
+    def __init__(self, rows, values, height):
+        self.rows = np.asarray(rows, np.int64).reshape(-1)
+        self.values = np.asarray(values, np.float32)
+        self.height = int(height)
+
+    def merged(self):
+        """(unique_rows, summed_values) — duplicate lookups accumulate,
+        the SelectedRows merge-add contract."""
+        uniq, inv = np.unique(self.rows, return_inverse=True)
+        out = np.zeros((uniq.size,) + self.values.shape[1:], np.float32)
+        np.add.at(out, inv, self.values)
+        return uniq, out
+
+
+def _to_wire_grad(g):
+    """numpy-ify a fetched gradient; SelectedRowsVal crosses as
+    SparseRows instead of densifying."""
+    try:
+        from ..ops.selected_rows import SelectedRowsVal
+    except Exception:                                   # pragma: no cover
+        SelectedRowsVal = ()
+    if isinstance(g, SparseRows):
+        return g
+    if isinstance(g, SelectedRowsVal):
+        return SparseRows(np.asarray(g.rows), np.asarray(g.values),
+                          g.height)
+    return np.asarray(g)
 
 
 def _send_msg(sock, obj):
@@ -82,7 +118,8 @@ class _Handler(socketserver.BaseRequestHandler):
                 kind = msg["op"]
                 if kind == "pull":
                     _send_msg(self.request, srv._pull(
-                        msg["worker"], msg["step"]))
+                        msg["worker"], msg["step"],
+                        msg.get("sparse_rows")))
                 elif kind == "push":
                     _send_msg(self.request, srv._push(
                         msg["worker"], msg["step"], msg["grads"]))
@@ -171,7 +208,7 @@ class AsyncParameterServer(object):
             return -1  # unregistered workers count as step -1 (none pushed)
         return min(self._clock.values())
 
-    def _pull(self, worker, step):
+    def _pull(self, worker, step, sparse_rows=None):
         with self._cv:
             if self.staleness_cap is not None:
                 # SSP gate: a pull for step t is admitted once every
@@ -187,9 +224,18 @@ class AsyncParameterServer(object):
                     return {"error": "staleness gate timed out "
                                      "(worker %r step %d, clocks %r)"
                                      % (worker, step, self._clock)}
-            return {"version": self._version,
-                    "params": {k: v.copy()
-                               for k, v in self._params.items()}}
+            out = {}
+            for k, v in self._params.items():
+                if sparse_rows is not None and k in sparse_rows:
+                    # large-model prefetch: ship only the rows this
+                    # trainer's next batch looks up (reference:
+                    # large_model_dist_train.md prefetch design)
+                    rows = np.unique(np.asarray(sparse_rows[k],
+                                                np.int64).reshape(-1))
+                    out[k] = SparseRows(rows, v[rows], v.shape[0])
+                else:
+                    out[k] = v.copy()
+            return {"version": self._version, "params": out}
 
     def _push(self, worker, step, grads):
         with self._cv:
@@ -203,6 +249,20 @@ class AsyncParameterServer(object):
                                  % unknown}
             for name, g in grads.items():
                 p = self._params[name]
+                if isinstance(g, SparseRows):
+                    # row-subset apply: only the touched rows move
+                    # (reference: operators/sgd_op.h SelectedRows branch;
+                    # sparse momentum decays touched rows only, the
+                    # lookup-table pserver convention)
+                    rows, vals = g.merged()
+                    if self._opt == "momentum":
+                        v = self._velocity[name]
+                        v[rows] *= self._mu
+                        v[rows] += vals
+                        p[rows] -= self._lr * v[rows]
+                    else:
+                        p[rows] -= self._lr * vals
+                    continue
                 g = np.asarray(g, dtype=np.float32).reshape(p.shape)
                 if self._opt == "momentum":
                     v = self._velocity[name]
@@ -246,19 +306,32 @@ class AsyncSGDUpdater(object):
             raise RuntimeError(rep["error"])
         return rep
 
-    def pull(self, step=0):
-        rep = self._rpc({"op": "pull", "worker": self.worker_id,
-                         "step": step})
+    def pull(self, step=0, sparse_rows=None):
+        """``sparse_rows``: {param_name: row ids} — those tables come
+        back as SparseRows slices instead of full matrices (the
+        large-model prefetch path)."""
+        msg = {"op": "pull", "worker": self.worker_id, "step": step}
+        if sparse_rows is not None:
+            msg["sparse_rows"] = {k: np.asarray(v, np.int64).reshape(-1)
+                                  for k, v in sparse_rows.items()}
+        rep = self._rpc(msg)
         return rep["version"], rep["params"]
 
-    def pull_into(self, scope, step=0):
-        version, params = self.pull(step)
+    def pull_into(self, scope, step=0, sparse_rows=None):
+        version, params = self.pull(step, sparse_rows=sparse_rows)
         for name, value in params.items():
-            scope.set_var(name, value)
+            if isinstance(value, SparseRows):
+                dest = np.asarray(scope.find_var(name))
+                if not dest.flags.writeable:
+                    dest = dest.copy()
+                dest[value.rows] = value.values
+                scope.set_var(name, dest)
+            else:
+                scope.set_var(name, value)
         return version
 
     def push(self, grads, step):
-        grads = {k: np.asarray(v) for k, v in grads.items()}
+        grads = {k: _to_wire_grad(v) for k, v in grads.items()}
         rep = self._rpc({"op": "push", "worker": self.worker_id,
                          "step": step, "grads": grads})
         return rep["version"]

@@ -94,12 +94,7 @@ def classification_error_evaluator(input, label, name=None, weight=None,
 
 def auc_evaluator(input, label, name=None, weight=None):
     """reference: evaluators.py auc_evaluator."""
-    helper = LayerHelper("auc")
-    out = helper.create_variable_for_type_inference("float32")
-    helper.append_op(type="auc",
-                     inputs={"Out": [input.var], "Label": [label.var]},
-                     outputs={"AUC": [out]},
-                     attrs={"num_thresholds": 200})
+    out = F.auc(input.var, label.var)
     return LayerOutput(name or "auc", out, size=1)
 
 

@@ -1,0 +1,217 @@
+"""CTR workload end-to-end: wide&deep + DeepFM over sparse slots, local
+and async-pserver training (reference:
+doc/design/cluster_train/large_model_dist_train.md,
+operators/lookup_table_op.cc is_sparse/is_distributed)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models import wide_deep, deepfm, synthetic_click_batch
+
+pytestmark = pytest.mark.smoke
+
+SLOTS, DENSE, VOCAB, EMB = 6, 4, 50, 4
+
+
+def _fresh():
+    main, startup = pt.Program(), pt.Program()
+    pt.switch_main_program(main)
+    pt.switch_startup_program(startup)
+    from paddle_tpu.core import unique_name
+    unique_name._counters.clear()
+    return main, startup
+
+
+def _train_local(build, steps=40, lr=0.01):
+    _fresh()
+    avg_cost, auc_var, prob, feeds = build()
+    pt.optimizer.Adam(learning_rate=lr).minimize(avg_cost)
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        exe.run(pt.default_startup_program())
+        rng = np.random.RandomState(0)
+        losses, auc = [], 0.0
+        for _ in range(steps):
+            feed = synthetic_click_batch(rng, 64, SLOTS, DENSE, VOCAB)
+            c, a = exe.run(feed=feed, fetch_list=[avg_cost, auc_var])
+            losses.append(float(np.asarray(c)))
+            auc = float(np.asarray(a))
+    return losses, auc, exe.stats
+
+
+def test_wide_deep_trains_and_jits():
+    losses, auc, stats = _train_local(
+        lambda: wide_deep(num_sparse_slots=SLOTS, dense_dim=DENSE,
+                          vocab_size=VOCAB, embed_dim=EMB,
+                          hidden_sizes=(16, 8)))
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-5:]) < 0.85 * np.mean(losses[:5]), losses
+    assert 0.5 < auc <= 1.0, auc
+    # sparse lookup + SelectedRows adam must stay on the jit path
+    assert stats["jit_runs"] > 0 and stats["eager_runs"] == 0, stats
+
+
+def test_deepfm_trains():
+    losses, auc, _ = _train_local(
+        lambda: deepfm(num_sparse_slots=SLOTS, dense_dim=DENSE,
+                       vocab_size=VOCAB, embed_dim=EMB,
+                       hidden_sizes=(16,)))
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-5:]) < 0.95 * np.mean(losses[:5]), losses
+    assert 0.5 < auc <= 1.0, auc
+
+
+def test_wide_deep_sparse_matches_dense_embedding_grads():
+    """is_sparse=True (SelectedRows grads) and is_sparse=False must train
+    identically — the non-lazy accumulator contract
+    (reference: math/selected_rows_functor.* merge-add semantics)."""
+    out = {}
+    for sparse in (True, False):
+        _fresh()
+        avg_cost, _auc, _p, _f = wide_deep(
+            num_sparse_slots=SLOTS, dense_dim=DENSE, vocab_size=VOCAB,
+            embed_dim=EMB, hidden_sizes=(8,), is_sparse=sparse,
+            with_auc=False)
+        pt.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+        exe = pt.Executor(pt.CPUPlace())
+        with pt.scope_guard(pt.Scope()):
+            exe.run(pt.default_startup_program())
+            rng = np.random.RandomState(7)
+            losses = []
+            for _ in range(6):
+                feed = synthetic_click_batch(rng, 32, SLOTS, DENSE, VOCAB)
+                c, = exe.run(feed=feed, fetch_list=[avg_cost])
+                losses.append(float(np.asarray(c)))
+        out[sparse] = losses
+    np.testing.assert_allclose(out[True], out[False], rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_wide_deep_async_pserver():
+    """The composed BASELINE workload: sparse CTR model + the async
+    parameter service (grad-only program, server-side apply) — the
+    pserver distributed mode the embeddings were built for."""
+    from paddle_tpu.parallel.async_sgd import (AsyncParameterServer,
+                                               AsyncSGDUpdater,
+                                               build_grad_program)
+    _fresh()
+    avg_cost, _auc, _p, _f = wide_deep(
+        num_sparse_slots=SLOTS, dense_dim=DENSE, vocab_size=VOCAB,
+        embed_dim=EMB, hidden_sizes=(8,), with_auc=False)
+    pg = build_grad_program(avg_cost)
+    main = pt.default_main_program()
+    startup = pt.default_startup_program()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        pnames = [p.name for p, _g in pg]
+        server = AsyncParameterServer(
+            {n: np.asarray(scope.find_var(n)) for n in pnames},
+            lr=0.1, optimizer="sgd", n_workers=1,
+            staleness_cap=0).start()
+        try:
+            upd = AsyncSGDUpdater(server.address, worker_id=0)
+            rng = np.random.RandomState(1)
+            losses = []
+            for step in range(12):
+                upd.pull_into(scope, step=step)
+                feed = synthetic_click_batch(rng, 64, SLOTS, DENSE, VOCAB)
+                fetched = exe.run(main, feed=feed,
+                                  fetch_list=[avg_cost] +
+                                  [g.name for _p, g in pg])
+                losses.append(float(np.asarray(fetched[0])))
+                # raw fetched values: SelectedRows grads cross the wire
+                # as row subsets (push does the conversion)
+                upd.push({p.name: gv for (p, _g), gv
+                          in zip(pg, fetched[1:])}, step=step)
+            upd.close()
+        finally:
+            server.stop()
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses
+
+
+def test_adam_lazy_mode_touches_only_looked_up_rows():
+    """lazy_mode adam (reference: adam_op.cc lazy_mode) must leave
+    untouched embedding rows and their accumulators bit-identical, and
+    merge duplicate lookups."""
+    _fresh()
+    ids = pt.layers.data("ids", shape=[1], dtype="int64")
+    emb = pt.layers.embedding(ids, size=[20, 3], is_sparse=True,
+                              param_attr=pt.ParamAttr(name="lazy_emb"))
+    loss = pt.layers.mean(emb)
+    pt.optimizer.Adam(learning_rate=0.5, lazy_mode=True).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(pt.default_startup_program())
+        before = np.array(scope.find_var("lazy_emb"))
+        feed = {"ids": np.array([[2], [2], [7]], np.int64)}
+        exe.run(feed=feed, fetch_list=[loss])
+        after = np.array(scope.find_var("lazy_emb"))
+    touched = sorted(set(np.where(
+        np.abs(after - before).sum(axis=1) > 0)[0]))
+    assert touched == [2, 7], touched
+    # duplicate row 2 got double the gradient mass of row 7
+    d2 = np.abs(after[2] - before[2]).sum()
+    d7 = np.abs(after[7] - before[7]).sum()
+    assert d2 > d7 > 0
+
+
+def test_sparse_rows_wire_roundtrip():
+    """Push ships SelectedRows as row subsets; pull with sparse_rows
+    prefetches only the requested table rows (reference:
+    large_model_dist_train.md)."""
+    from paddle_tpu.parallel.async_sgd import (AsyncParameterServer,
+                                               AsyncSGDUpdater, SparseRows)
+    rng = np.random.RandomState(0)
+    table = rng.randn(40, 3).astype(np.float32)
+    dense = rng.randn(5).astype(np.float32)
+    server = AsyncParameterServer(
+        {"emb": table.copy(), "w": dense.copy()}, lr=1.0,
+        optimizer="sgd", n_workers=1, staleness_cap=None).start()
+    try:
+        upd = AsyncSGDUpdater(server.address, worker_id=0)
+        # sparse push: rows [2, 2, 7] — duplicates must merge-add
+        g = SparseRows(rows=[2, 2, 7],
+                       values=np.ones((3, 3), np.float32), height=40)
+        upd.push({"emb": g}, step=0)
+        _v, params = upd.pull(step=1)
+        expect = table.copy()
+        expect[2] -= 2.0      # two duplicate rows, lr=1
+        expect[7] -= 1.0
+        np.testing.assert_allclose(params["emb"], expect, rtol=1e-6)
+        # untouched rows identical
+        np.testing.assert_array_equal(params["emb"][0], table[0])
+        # sparse pull: only requested rows cross
+        _v, params = upd.pull(step=2, sparse_rows={"emb": [7, 2, 7]})
+        sl = params["emb"]
+        assert isinstance(sl, SparseRows)
+        assert sl.values.shape == (2, 3)      # deduped [2, 7]
+        np.testing.assert_allclose(sl.values[0], expect[2], rtol=1e-6)
+        np.testing.assert_allclose(sl.values[1], expect[7], rtol=1e-6)
+        assert not isinstance(params["w"], SparseRows)
+        upd.close()
+    finally:
+        server.stop()
+
+
+def test_ctr_inference_prob_shape():
+    """Serving slice: the click probability head feeds without labels."""
+    _fresh()
+    _cost, _auc, prob, _f = wide_deep(
+        num_sparse_slots=SLOTS, dense_dim=DENSE, vocab_size=VOCAB,
+        embed_dim=EMB, hidden_sizes=(8,), with_auc=False)
+    from paddle_tpu.io import get_inference_program
+    infer_prog = get_inference_program([prob])
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        exe.run(pt.default_startup_program())
+        rng = np.random.RandomState(2)
+        feed = synthetic_click_batch(rng, 16, SLOTS, DENSE, VOCAB)
+        feed.pop("click")
+        out, = exe.run(infer_prog, feed=feed, fetch_list=[prob])
+    out = np.asarray(out)
+    assert out.shape == (16, 1)
+    assert ((out >= 0) & (out <= 1)).all()
