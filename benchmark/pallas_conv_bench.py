@@ -1,0 +1,111 @@
+"""Per-shape microbench: pallas conv3x3 vs lax.conv on ResNet-50's
+3x3 conv census (reference role: conv_cudnn_op.cu.cc per-shape algorithm
+search). Writes benchmark/results/pallas_conv_<device>.json.
+
+Run on whatever device is live (`python -m benchmark.pallas_conv_bench`);
+on CPU the pallas kernel runs in interpret mode, so the numbers are only
+meaningful on TPU — the device kind is recorded with every row.
+
+NOTE (r4 lesson, benchmark/results/mfu_levers_*.json): an isolated 3x3
+microbench CANNOT justify adoption — impl=matmul won this exact probe
+2.6x and regressed the end-to-end step 3x. Adoption lives in bench.py's
+pallas_trial phase, which times the full training step. This file exists
+for the per-shape evidence table.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+# ResNet-50 bottleneck 3x3 convs at the bench's bs128 (NHWC: N, H, W, C->O)
+CENSUS = [
+    (128, 56, 56, 64, 64),
+    (128, 28, 28, 128, 128),
+    (128, 14, 14, 256, 256),
+    (128, 7, 7, 512, 512),
+]
+
+
+def _time_best(fn, *args, iters=8, trials=3):
+    import jax
+    out = fn(*args)
+    jax.block_until_ready(out)
+    # true sync: 1-element host readback (tunnelled PJRT can ack early)
+    float(np.asarray(out.reshape(-1)[:1]).astype(np.float32))
+    best = float("inf")
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        float(np.asarray(out.reshape(-1)[:1]).astype(np.float32))
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def bench(batch=None, dtype="bfloat16", iters=8):
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.kernels.conv3x3 import conv3x3_s1_nhwc
+
+    dev = jax.devices()[0]
+    dt = jnp.dtype(dtype)
+    rows = []
+    for (n, h, w_, c, o) in CENSUS:
+        n = batch or n
+        k1, k2 = jax.random.split(jax.random.PRNGKey(len(rows)))
+        x = jax.random.normal(k1, (n, h, w_, c), dt)
+        w = jax.random.normal(k2, (3, 3, c, o), dt) * 0.05
+
+        @jax.jit
+        def lax_conv(x_, w_):
+            return jax.lax.conv_general_dilated(
+                x_, w_, window_strides=(1, 1), padding=[(1, 1), (1, 1)],
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                preferred_element_type=jnp.float32).astype(x_.dtype)
+
+        @jax.jit
+        def pallas_conv(x_, w_):
+            return conv3x3_s1_nhwc(x_, w_)
+
+        flops = 2 * n * h * w_ * c * o * 9
+        t_lax = _time_best(lax_conv, x, w, iters=iters)
+        t_pal = _time_best(pallas_conv, x, w, iters=iters)
+        row = {"shape": [n, h, w_, c, o],
+               "lax_ms": round(1e3 * t_lax, 3),
+               "pallas_ms": round(1e3 * t_pal, 3),
+               "lax_tflops": round(flops / t_lax / 1e12, 1),
+               "pallas_tflops": round(flops / t_pal / 1e12, 1),
+               "speedup": round(t_lax / t_pal, 3)}
+        rows.append(row)
+        print(json.dumps(row))
+    try:
+        import subprocess
+        commit = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, timeout=10).stdout.strip()
+    except Exception:
+        commit = "unknown"
+    rec = {"device": str(getattr(dev, "device_kind", dev.platform)),
+           "platform": dev.platform, "dtype": dtype, "rows": rows,
+           "commit": commit,
+           "note": "interpret-mode (meaningless) if platform != tpu; "
+                   "adoption decided end-to-end in bench.py pallas_trial"}
+    rdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "results")
+    os.makedirs(rdir, exist_ok=True)
+    safe = rec["device"].replace(" ", "_").replace("/", "_")
+    path = os.path.join(rdir, "pallas_conv_%s.json" % safe)
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1)
+    print("wrote", path)
+    return rec
+
+
+if __name__ == "__main__":
+    import sys
+    bs = int(sys.argv[1]) if len(sys.argv) > 1 else None
+    bench(batch=bs)

@@ -1,0 +1,127 @@
+"""Pallas 3x3/stride-1 convolution — the custom-kernel counterfactual for
+ResNet's mid-network convs.
+
+reference role: paddle/fluid/operators/conv_cudnn_op.cu.cc — the
+reference answers a slow generic conv with a specialised kernel path
+(cuDNN per-shape algorithm search). The TPU-first analog: a fused
+im2col-matmul in VMEM. The 9 taps of a 3x3 kernel are 9 MXU matmuls of
+(H*W, C) @ (C, O) accumulated in f32 registers — no HBM im2col buffer,
+no intermediate writes between taps (the failure mode of the lax-level
+shifted-einsum impl that regressed 3x end-to-end in r4: XLA materialised
+tap intermediates. Here the accumulation never leaves VMEM).
+
+Layout: NHWC activations (C on the 128-lane axis), HWIO weights — the
+MXU-native conv layout. One grid step per image: the whole padded
+feature map sits in VMEM (ResNet-50's largest 3x3 slab is
+58x58x64xbf16 = 430 KB; the largest weight block 3*3*512*512xbf16 =
+4.6 MB — both comfortably inside the ~16 MB VMEM with double
+buffering). Weights use a constant index map, so the pipeline keeps
+them resident across the batch grid — weight-stationary.
+
+Backward is a jax.custom_vjp: dx reuses the same kernel with spatially
+rotated, io-swapped weights (a 3x3/s1 conv again); dw is the 9-tap
+correlation done as einsums (one (C, N*H*W) @ (N*H*W, O) contraction
+per tap — MXU-shaped, and XLA handles the cross-batch reduction well).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["conv3x3_s1_nhwc", "supports_conv3x3"]
+
+
+def supports_conv3x3(w_shape, strides, paddings, dilations, groups):
+    """True when (kh, kw)=(3, 3), stride 1, pad 1, no dilation/groups —
+    the ResNet mid-network conv population this kernel targets."""
+    return (groups == 1 and tuple(dilations) == (1, 1)
+            and tuple(strides) == (1, 1) and tuple(paddings) == (1, 1)
+            and tuple(w_shape[-2:]) in ((3, 3),))
+
+
+def _kernel(x_ref, w_ref, o_ref, *, H, W, C, O, out_dtype):
+    # x_ref: (1, H+2, W+2, C) padded image; w_ref: (3, 3, C, O)
+    acc = jnp.zeros((H * W, O), jnp.float32)
+    for dy in range(3):
+        for dx in range(3):
+            xs = x_ref[0, dy:dy + H, dx:dx + W, :].reshape(H * W, C)
+            acc += jnp.dot(xs, w_ref[dy, dx],
+                           preferred_element_type=jnp.float32)
+    o_ref[0] = acc.reshape(H, W, O).astype(out_dtype)
+
+
+def _interpret_default():
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.jit, static_argnames=("out_dtype", "interpret"))
+def _conv3x3_fwd(x, w, out_dtype=None, interpret=None):
+    """x: (N, H, W, C); w: (3, 3, C, O) -> (N, H, W, O)."""
+    N, H, W, C = x.shape
+    O = w.shape[3]
+    out_dtype = out_dtype or x.dtype
+    if interpret is None:
+        interpret = _interpret_default()
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    kern = functools.partial(_kernel, H=H, W=W, C=C, O=O,
+                             out_dtype=out_dtype)
+    flops = 2 * N * H * W * C * O * 9
+    return pl.pallas_call(
+        kern,
+        grid=(N,),
+        in_specs=[
+            pl.BlockSpec((1, H + 2, W + 2, C), lambda n: (n, 0, 0, 0)),
+            # constant index map: weights stay VMEM-resident across the
+            # batch grid (weight-stationary)
+            pl.BlockSpec((3, 3, C, O), lambda n: (0, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, W, O), lambda n: (n, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((N, H, W, O), out_dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=flops, transcendentals=0,
+            bytes_accessed=x.size * x.dtype.itemsize
+            + w.size * w.dtype.itemsize
+            + N * H * W * O * jnp.dtype(out_dtype).itemsize),
+        interpret=interpret,
+    )(xp, w)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def conv3x3_s1_nhwc(x, w, out_dtype=None):
+    """3x3/s1/p1 convolution, NHWC x HWIO -> NHWC, f32 accumulation.
+
+    Differentiable (custom vjp); on non-TPU backends the kernel runs in
+    pallas interpret mode, so tests and CPU fallbacks stay correct."""
+    return _conv3x3_fwd(x, w, out_dtype=out_dtype)
+
+
+def _vjp_fwd(x, w, out_dtype):
+    return _conv3x3_fwd(x, w, out_dtype=out_dtype), (x, w)
+
+
+def _vjp_bwd(out_dtype, res, g):
+    x, w = res
+    # dx: full-correlation of g with the rotated kernel — another
+    # 3x3/s1/p1 conv, so the pallas kernel serves its own backward
+    w_rot = jnp.transpose(w[::-1, ::-1], (0, 1, 3, 2))   # (3,3,O,C)
+    dx = _conv3x3_fwd(g.astype(x.dtype), w_rot, out_dtype=None)
+    # dw[dy,dx,c,o] = sum_{n,h,w} xpad[n,h+dy,w+dx,c] g[n,h,w,o]
+    N, H, W, C = x.shape
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    taps = []
+    for dy in range(3):
+        row = []
+        for dxx in range(3):
+            patch = xp[:, dy:dy + H, dxx:dxx + W, :]
+            row.append(jnp.einsum("nhwc,nhwo->co", patch, g,
+                                  preferred_element_type=jnp.float32))
+        taps.append(jnp.stack(row))
+    dw = jnp.stack(taps).astype(w.dtype)                 # (3,3,C,O)
+    return dx.astype(x.dtype), dw
+
+
+conv3x3_s1_nhwc.defvjp(_vjp_fwd, _vjp_bwd)

@@ -352,6 +352,18 @@ def conv2d_apply(x, w, s, p, d, groups, pe):
         # the stem rewrite outranks conv_impl: the tuner times the stem
         # candidates specifically, so an enabled s2d pick must execute
         return _conv_stem_s2d(x, w, pe)
+    if conv_impl() == "pallas3x3":
+        from ..kernels.conv3x3 import conv3x3_s1_nhwc, supports_conv3x3
+        if supports_conv3x3(w.shape, s, p, d, groups):
+            # fused im2col-matmul in VMEM (kernels/conv3x3.py); only the
+            # 3x3/s1/p1 population routes here — everything else stays
+            # on the native lax.conv path
+            out_dt = jnp.float32 if pe == jnp.float32 else None
+            out = conv3x3_s1_nhwc(jnp.transpose(x, (0, 2, 3, 1)),
+                                  jnp.transpose(w, (2, 3, 1, 0)),
+                                  out_dt)
+            return jnp.transpose(out, (0, 3, 1, 2))
+        return _conv_native(x, w, s, p, d, groups, pe)
     if groups == 1 and tuple(d) == (1, 1) and conv_impl() == "matmul":
         return _conv_shifted_matmul(x, w, s, p)
     return _conv_native(x, w, s, p, d, groups, pe)

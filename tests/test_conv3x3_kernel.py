@@ -1,0 +1,121 @@
+"""Pallas 3x3 conv kernel: numerics vs lax.conv, custom vjp vs jax.vjp,
+and the conv_impl=pallas3x3 dispatch through the conv2d op (reference
+role: operators/conv_cudnn_op.cu.cc specialised conv path)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.kernels.conv3x3 import conv3x3_s1_nhwc, supports_conv3x3
+
+pytestmark = pytest.mark.smoke
+
+
+def _ref_conv(x, w):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=[(1, 1), (1, 1)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+@pytest.mark.parametrize("shape", [
+    (2, 8, 8, 16, 32),      # small generic
+    (1, 7, 7, 64, 64),      # ResNet last-stage geometry (scaled channels)
+    (2, 14, 14, 32, 16),    # non-square channel ratio
+])
+def test_matches_lax_conv(shape):
+    n, h, w_, c, o = shape
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(n, h, w_, c), jnp.float32)
+    w = jnp.asarray(rng.randn(3, 3, c, o) * 0.1, jnp.float32)
+    got = conv3x3_s1_nhwc(x, w)
+    want = _ref_conv(x, w)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_bf16_f32_accumulation():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 8, 8, 32), jnp.bfloat16)
+    w = jnp.asarray(rng.randn(3, 3, 32, 16) * 0.1, jnp.bfloat16)
+    got = conv3x3_s1_nhwc(x, w, jnp.float32)
+    assert got.dtype == jnp.float32
+    want = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=[(1, 1), (1, 1)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_custom_vjp_matches_lax_grads():
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(2, 6, 6, 8), jnp.float32)
+    w = jnp.asarray(rng.randn(3, 3, 8, 4) * 0.2, jnp.float32)
+
+    def loss_pallas(x_, w_):
+        return jnp.sum(conv3x3_s1_nhwc(x_, w_) ** 2)
+
+    def loss_ref(x_, w_):
+        return jnp.sum(_ref_conv(x_, w_) ** 2)
+
+    gx, gw = jax.grad(loss_pallas, argnums=(0, 1))(x, w)
+    rx, rw = jax.grad(loss_ref, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_supports_predicate():
+    assert supports_conv3x3((64, 64, 3, 3), (1, 1), (1, 1), (1, 1), 1)
+    assert not supports_conv3x3((64, 64, 3, 3), (2, 2), (1, 1), (1, 1), 1)
+    assert not supports_conv3x3((64, 64, 1, 1), (1, 1), (1, 1), (1, 1), 1)
+    assert not supports_conv3x3((64, 64, 3, 3), (1, 1), (1, 1), (1, 1), 2)
+    assert not supports_conv3x3((64, 64, 3, 3), (1, 1), (0, 0), (1, 1), 1)
+
+
+def test_conv2d_op_dispatch_and_grads(monkeypatch):
+    """conv_impl=pallas3x3 routes eligible convs through the kernel and
+    the program-level backward (vjp replay of conv2d_apply) still
+    produces correct gradients; ineligible convs (stride 2) keep the
+    native path in the same program."""
+    monkeypatch.setenv("PADDLE_TPU_CONV_IMPL", "pallas3x3")
+    import paddle_tpu as pt
+
+    def build_and_train():
+        main, startup = pt.Program(), pt.Program()
+        pt.switch_main_program(main)
+        pt.switch_startup_program(startup)
+        from paddle_tpu.core import unique_name
+        unique_name._counters.clear()
+        img = pt.layers.data("img", shape=[8, 10, 10], dtype="float32")
+        lbl = pt.layers.data("lbl", shape=[1], dtype="int64")
+        c1 = pt.layers.conv2d(img, num_filters=16, filter_size=3,
+                              padding=1, act="relu")       # pallas path
+        c2 = pt.layers.conv2d(c1, num_filters=16, filter_size=3,
+                              stride=2, padding=1, act="relu")  # native
+        pool = pt.layers.pool2d(c2, pool_size=5, pool_type="avg")
+        pred = pt.layers.fc(pool, size=4, act="softmax")
+        loss = pt.layers.mean(pt.layers.cross_entropy(pred, lbl))
+        pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = pt.Executor(pt.CPUPlace())
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe.run(pt.default_startup_program())
+            rng = np.random.RandomState(3)
+            feed = {"img": rng.rand(4, 8, 10, 10).astype("float32"),
+                    "lbl": rng.randint(0, 4, (4, 1)).astype("int64")}
+            return [float(np.asarray(exe.run(feed=feed,
+                                             fetch_list=[loss])[0]))
+                    for _ in range(8)]
+
+    losses = build_and_train()
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+    # same program on the native path gives matching step-0 loss
+    monkeypatch.setenv("PADDLE_TPU_CONV_IMPL", "conv")
+    losses_native = build_and_train()
+    np.testing.assert_allclose(losses, losses_native, rtol=2e-4,
+                               atol=2e-5)
