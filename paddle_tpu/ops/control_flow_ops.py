@@ -653,6 +653,18 @@ def while_grad(ctx):
             cot.get(n, jax.tree_util.tree_map(jnp.zeros_like, o))
             for n, o in zip(w_float, outs))
         gins = vjp(cot_vec)
+
+        def _add_cot(x, y):
+            # integer leaves (e.g. a TracedLoD's offset arrays) carry
+            # float0 cotangents by jax design — they contribute nothing,
+            # so keep whichever side is real instead of adding
+            from jax import dtypes as _jdt
+            if getattr(x, "dtype", None) == _jdt.float0:
+                return y
+            if getattr(y, "dtype", None) == _jdt.float0:
+                return x
+            return jnp.add(x, y)
+
         new_cot = {}
         for n, g in zip(p_names, gins):
             if n in w_set:
@@ -660,7 +672,7 @@ def while_grad(ctx):
             else:
                 prev = cot.get(n)
                 new_cot[n] = g if prev is None else \
-                    jax.tree_util.tree_map(jnp.add, prev, g)
+                    jax.tree_util.tree_map(_add_cot, prev, g)
         # cotangents of non-carried written vars die (overwritten next pass)
         cot = new_cot
 
