@@ -142,34 +142,32 @@ def parent_main():
             return (state["best"] is not None
                     and state["best"].get("platform") != "cpu")
 
-    # -- phase 1: the device child, ALONE ---------------------------------
-    # A fallback-CPU reserve is held back only while no TPU headline
-    # exists; once one is banked the device child may spend everything.
-    cpu_reserve = float(os.environ.get("BENCH_CPU_RESERVE_SEC", "420"))
-    axon_thread = None
-    if os.environ.get("JAX_PLATFORMS", "axon") != "cpu":
-        # cap: device init (register + jax.devices + first compile rung)
-        # may consume at most this before we declare the relay dead.
-        # r3's mistake was an uncapped retry loop eating the full budget.
-        init_window = min(0.45 * max(_remaining(), 0), 600.0)
+    def run_device_child(phase_name, init_window, cpu_reserve=None):
+        """Spawn the axon child and babysit it: kill on init-window
+        expiry without a FRESH device_up mark, on the CPU-reserve
+        boundary (phase 1 only), or on the deadline. Shared by phase 1
+        and the phase-3 late re-probe so the relay-dead detection has
+        exactly one implementation."""
         axon_env = dict(base_env)
         pool_ips = axon_env.pop("PALLAS_AXON_POOL_IPS", None)
         if pool_ips is not None:
             axon_env["BENCH_AXON_POOL_IPS"] = pool_ips
         axon_env["BENCH_INIT_WINDOW"] = repr(init_window)
-        _log("parent", "phase 1: device child, init window %.0fs"
-             % init_window)
+        _log("parent", "%s: device child, init window %.0fs"
+             % (phase_name, init_window))
         t_spawn = time.time()
-        p, axon_thread = spawn("axon", axon_env)
+        p, t = spawn("axon", axon_env)
         while p.poll() is None and _remaining() > 5:
             time.sleep(2)
             up = mark("device_up")
-            if up is None and time.time() - t_spawn > init_window:
-                _log("parent", "no device_up within %.0fs: relay presumed "
-                     "dead, killing device child" % init_window)
+            if ((up is None or up < t_spawn)
+                    and time.time() - t_spawn > init_window):
+                _log("parent", "%s: no device_up within %.0fs: relay "
+                     "presumed dead, killing device child"
+                     % (phase_name, init_window))
                 p.kill()
                 break
-            if (not have_tpu_headline()
+            if (cpu_reserve is not None and not have_tpu_headline()
                     and _remaining() < cpu_reserve):
                 _log("parent", "no TPU headline with %.0fs left: killing "
                      "device child for CPU fallback" % _remaining())
@@ -179,7 +177,19 @@ def parent_main():
             _log("parent", "deadline: killing device child")
             p.kill()
         p.wait()  # the CPU phase must never overlap a live jax child
-        axon_thread.join(timeout=5)
+        t.join(timeout=5)
+
+    # -- phase 1: the device child, ALONE ---------------------------------
+    # A fallback-CPU reserve is held back only while no TPU headline
+    # exists; once one is banked the device child may spend everything.
+    cpu_reserve = float(os.environ.get("BENCH_CPU_RESERVE_SEC", "420"))
+    if os.environ.get("JAX_PLATFORMS", "axon") != "cpu":
+        # cap: device init (register + jax.devices + first compile rung)
+        # may consume at most this before we declare the relay dead.
+        # r3's mistake was an uncapped retry loop eating the full budget.
+        run_device_child(
+            "phase 1", min(0.45 * max(_remaining(), 0), 600.0),
+            cpu_reserve=cpu_reserve)
 
     # -- phase 2: CPU fallback, only if the device produced nothing -------
     if not have_tpu_headline() and _remaining() > 45:
@@ -195,6 +205,15 @@ def parent_main():
             _log("parent", "deadline: killing cpu child")
             p.kill()
         t.join(timeout=5)
+
+    # -- phase 3: LATE device re-probe ------------------------------------
+    # A relay that was dead at phase 1 can be restarted host-side
+    # mid-budget. With a CPU number already banked and real time left,
+    # spend it on one more device attempt — a TPU headline outranks any
+    # CPU row in merge(), so this can only improve the final line.
+    if (not have_tpu_headline() and _remaining() > 500
+            and os.environ.get("JAX_PLATFORMS", "axon") != "cpu"):
+        run_device_child("phase 3", min(240.0, 0.4 * _remaining()))
 
     with lock:
         state["final"] = True
@@ -215,6 +234,20 @@ def parent_main():
 # ---------------------------------------------------------------------------
 # children: one process, one platform, an escalating stage ladder
 # ---------------------------------------------------------------------------
+
+def _git_commit():
+    """Producing commit, stamped on every emitted record so results files
+    are traceable to the exact tree that made them."""
+    try:
+        import subprocess
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__))
+        ).stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
 
 def _peak_flops(dev):
     if getattr(dev, "platform", "") == "cpu":
@@ -607,16 +640,44 @@ def child_main(tag):
     for k in _TUNE_DEFAULTS:
         picks[k] = os.environ.get(k, picks[k])
 
+    # measured attainable ceiling for ResNet-sized (4096-class) matmuls,
+    # from the banked chained-matmul census — so the headline carries
+    # MFU against what the chip actually attains at these op sizes, not
+    # only against the nominal peak (VERDICT r4 weakness #2). The file
+    # is keyed to the chip that measured it (same convention as the
+    # autotune cache); a ceiling from another generation is never used.
+    attainable = None
+    try:
+        safe_dev = "%s_%s" % (
+            getattr(dev, "device_kind", "?"),
+            os.environ.get("PALLAS_AXON_TPU_GEN", ""))
+        safe_dev = safe_dev.replace("|", "_").replace("/", "_") \
+            .replace(" ", "_").rstrip("_")
+        cdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "benchmark", "results")
+        path = os.path.join(cdir, "matmul_ceiling_%s.json" % safe_dev)
+        if os.path.exists(path):
+            with open(path) as f:
+                for r_ in json.load(f).get("rows", []):
+                    if r_.get("n") == 4096 and r_.get("tflops"):
+                        attainable = r_["tflops"] * 1e12
+                        break
+    except Exception:
+        pass
+
     def headline(img_s, bs, extra=None, steps=None, fuse=None):
         rec = {"kind": "headline", "metric": METRIC,
                "value": round(img_s, 2), "unit": "images/sec",
                "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
                "batch": bs, "steps": steps, "fuse": fuse,
-               "platform": platform,
+               "platform": platform, "commit": _git_commit(),
                "conv_impl": picks["PADDLE_TPU_CONV_IMPL"],
                "conv_layout": picks["PADDLE_TPU_CONV_LAYOUT"],
                "conv_s2d": picks["PADDLE_TPU_CONV_S2D"],
                "mfu": round(img_s * _ANALYTIC_FLOPS_PER_IMG / peak, 4)}
+        if attainable and platform != "cpu":
+            rec["mfu_attainable"] = round(
+                img_s * _ANALYTIC_FLOPS_PER_IMG / attainable, 4)
         rec.update(extra or {})
         return rec
 
@@ -656,6 +717,13 @@ def child_main(tag):
             # v5e (benchmark/results/mfu_levers_*.json, amp=pure row)
             (big_bs, big_steps, big_fuse, "pure"),
         ]
+        # VERDICT-r4 re-sweep: r4's single-window bs128/192/256 compares
+        # were inside the 8% contention band — widen the pure-AMP sweep
+        # so the polish phase's multi-window resample settles whichever
+        # batch actually wins on the day's chip
+        for sweep_bs in (192, 256, 384):
+            if sweep_bs != big_bs:
+                ladder.append((sweep_bs, big_steps, big_fuse, "pure"))
 
     for batch, steps, fuse, amp in ladder:
         if final is not None and _remaining() < 150:
@@ -706,6 +774,43 @@ def child_main(tag):
             finally:
                 wd.clear()
 
+    # -- pallas 3x3 conv trial: END-TO-END, never microbench-adopted ------
+    # r4 lesson: impl=matmul won its isolated 3x3 microbench 2.6x and
+    # lost the full step 3x. So the custom kernel (kernels/conv3x3.py)
+    # is adopted only if it beats the winning rung's throughput on the
+    # same exact config; otherwise the measured negative result is still
+    # recorded on the headline for the evidence trail.
+    if final is not None and platform != "cpu" and _remaining() > 300:
+        wd.phase("pallas_trial", max(_remaining(), 1))
+        prev_impl = os.environ.get("PADDLE_TPU_CONV_IMPL")
+        try:
+            os.environ["PADDLE_TPU_CONV_IMPL"] = "pallas3x3"
+            img_s = _measure(pt, layers, models, tag, final["batch"],
+                             steps=final.get("steps") or 8,
+                             fuse=final.get("fuse") or 2,
+                             amp_on=final.get("amp", True))
+            _log(tag, "pallas3x3 trial: %.1f img/s (incumbent %.1f)"
+                 % (img_s, final["value"]))
+            if img_s > final["value"]:
+                picks["PADDLE_TPU_CONV_IMPL"] = "pallas3x3"
+                final = headline(img_s, final["batch"],
+                                 steps=final.get("steps"),
+                                 fuse=final.get("fuse"),
+                                 extra={"amp": final.get("amp", True)})
+                prev_impl = "pallas3x3"  # keep for polish rounds
+            else:
+                final = dict(final)
+                final["pallas3x3_img_s"] = round(img_s, 2)
+            _emit(final)
+        except Exception as e:
+            _log(tag, "pallas3x3 trial failed: %r" % e)
+        finally:
+            if prev_impl is None:
+                os.environ.pop("PADDLE_TPU_CONV_IMPL", None)
+            else:
+                os.environ["PADDLE_TPU_CONV_IMPL"] = prev_impl
+            wd.clear()
+
     # AMP-off comparison (kept from r2: proves bf16 wins on-device)
     if final is not None and platform != "cpu" and _remaining() > 150:
         wd.phase("amp_off", max(_remaining(), 1))
@@ -745,6 +850,28 @@ def child_main(tag):
                  % (r["tokens_per_sec"], r["ms_per_batch"]))
         except Exception as e:
             _log(tag, "lstm phase failed: %r" % e)
+        finally:
+            wd.clear()
+
+    # third north-star metric: seq2seq NMT tokens/sec (BASELINE.json
+    # config #4, book/08 machine translation WITH attention) — fields on
+    # the same headline record
+    if final is not None and platform != "cpu" and _remaining() > 240:
+        wd.phase("nmt", max(_remaining(), 1))
+        try:
+            from benchmark.nmt_bench import bench as nmt_bench
+            _log(tag, "nmt bench bs=64 h=512 ...")
+            r = nmt_bench(batch_size=64, src_len=30, trg_len=30,
+                          dict_size=30000, word_dim=512, hidden=512,
+                          iters=4)
+            final = dict(final)
+            final["nmt_tokens_per_sec"] = r["tokens_per_sec"]
+            final["nmt_ms_per_batch"] = r["ms_per_batch"]
+            _emit(final)
+            _log(tag, "nmt: %.0f tokens/s (%.1f ms/batch)"
+                 % (r["tokens_per_sec"], r["ms_per_batch"]))
+        except Exception as e:
+            _log(tag, "nmt phase failed: %r" % e)
         finally:
             wd.clear()
 
