@@ -3,11 +3,15 @@
 // Role: the reference ships a C ABI for inference deployment
 // (paddle/capi/gradient_machine.h:36 paddle_gradient_machine_create_for_-
 // inference, :52 paddle_gradient_machine_forward) so applications embed the
-// model without the Python stack. Here the exported artifact is a compiled
-// StableHLO program (paddle_tpu/inference.py export_compiled); the runtime
-// that executes it is XLA via an embedded CPython+jax interpreter — the
-// same dependency surface the artifact needs anyway, behind a stable flat
-// C ABI. Build: make -C native capi  ->  libpaddle_tpu_capi.so.
+// model without the Python stack.
+//
+// THIS FILE IS THE COMPATIBILITY SHIM TIER: it satisfies the C contract by
+// embedding a CPython+jax interpreter, so it carries the full Python
+// dependency surface (the thing the reference capi exists to avoid,
+// capi/capi.h:18-23). The Python-free tier is native/paddle_tpu_pjrt.cc —
+// a PJRT C API embedder that compiles the artifact's raw StableHLO and
+// runs with no Python in the process (doc/design/capi_native_loader.md).
+// Build: make -C native capi  ->  libpaddle_tpu_capi.so.
 //
 // Contract (all float32, row-major):
 //   paddle_tpu_init(repo_root)               once per process

@@ -1,0 +1,481 @@
+// paddle_tpu Python-free inference loader over the PJRT C API.
+//
+// Role: the reference's C API exists precisely so deployments embed the
+// model WITHOUT the heavy runtime (paddle/capi/capi.h:18-23). The
+// paddle_tpu_capi.cc shim satisfies the contract by embedding CPython;
+// THIS loader removes that dependency entirely: it dlopen()s a PJRT
+// plugin (libtpu.so for TPU; any GetPjrtApi-exporting .so), compiles the
+// artifact's raw StableHLO bytecode (written by
+// paddle_tpu.inference.export_compiled as __module__.stablehlo_bc), maps
+// the weights blob (__weights__.bin + __signature__.json), and serves
+// forward() with no Python anywhere in the process.
+//
+// Build:  make -C native pjrt   ->  libpaddle_tpu_pjrt.so
+// Deps:   the PJRT C API header only (vendored include path at build
+//         time); at runtime just libdl + the plugin .so.
+//
+// C ABI (all errors: rc != 0, message via ptpu_pjrt_last_error):
+//   ptpu_pjrt_init(plugin_so_path)
+//   h  = ptpu_pjrt_load(artifact_dir)        // compile + stage weights
+//   rc = ptpu_pjrt_forward_f32(h, in_bufs, in_ndims, in_dims, n_inputs,
+//                              out_buf, out_capacity_f32,
+//                              out_dims, out_ndim_inout)  // output 0
+//   ptpu_pjrt_unload(h); ptpu_pjrt_shutdown();
+
+#include <dlfcn.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+std::string g_err;
+void* g_dl = nullptr;
+const PJRT_Api* g_api = nullptr;
+PJRT_Client* g_client = nullptr;
+
+void set_err_from(PJRT_Error* err) {
+  PJRT_Error_Message_Args m;
+  std::memset(&m, 0, sizeof(m));
+  m.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+  m.error = err;
+  g_api->PJRT_Error_Message(&m);
+  g_err.assign(m.message, m.message_size);
+  PJRT_Error_Destroy_Args d;
+  std::memset(&d, 0, sizeof(d));
+  d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  d.error = err;
+  g_api->PJRT_Error_Destroy(&d);
+}
+
+// returns true on error (and records the message)
+bool failed(PJRT_Error* err) {
+  if (err == nullptr) return false;
+  set_err_from(err);
+  return true;
+}
+
+bool await_event(PJRT_Event* ev) {
+  if (!ev) return false;
+  PJRT_Event_Await_Args a;
+  std::memset(&a, 0, sizeof(a));
+  a.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+  a.event = ev;
+  bool bad = failed(g_api->PJRT_Event_Await(&a));
+  PJRT_Event_Destroy_Args d;
+  std::memset(&d, 0, sizeof(d));
+  d.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+  d.event = ev;
+  g_api->PJRT_Event_Destroy(&d);
+  return bad;
+}
+
+// --- tiny JSON reader for the signature file (flat, known schema) ---------
+// Parses only what export_compiled writes: {"args":[{"name":..,
+// "dtype":"float32|bfloat16|int64|int32","shape":[..],"offset":N,
+// "nbytes":N,"kind":"param|feed"},...]}. No nested objects beyond this.
+
+struct ArgSpec {
+  std::string name, dtype, kind;
+  std::vector<int64_t> shape;
+  size_t offset = 0, nbytes = 0;
+};
+
+bool parse_signature(const std::string& text, std::vector<ArgSpec>* out) {
+  size_t pos = text.find("\"args\"");
+  if (pos == std::string::npos) return false;
+  pos = text.find('[', pos);
+  size_t end = text.rfind(']');
+  if (pos == std::string::npos || end == std::string::npos) return false;
+  size_t p = pos;
+  while (true) {
+    size_t ob = text.find('{', p);
+    if (ob == std::string::npos || ob > end) break;
+    size_t cb = text.find('}', ob);
+    if (cb == std::string::npos) return false;
+    std::string obj = text.substr(ob, cb - ob + 1);
+    ArgSpec s;
+    auto str_field = [&](const char* key) -> std::string {
+      size_t k = obj.find(std::string("\"") + key + "\"");
+      if (k == std::string::npos) return "";
+      size_t q1 = obj.find('"', obj.find(':', k));
+      size_t q2 = obj.find('"', q1 + 1);
+      return obj.substr(q1 + 1, q2 - q1 - 1);
+    };
+    auto num_field = [&](const char* key) -> long long {
+      size_t k = obj.find(std::string("\"") + key + "\"");
+      if (k == std::string::npos) return 0;
+      return std::strtoll(obj.c_str() + obj.find(':', k) + 1, nullptr, 10);
+    };
+    s.name = str_field("name");
+    s.dtype = str_field("dtype");
+    s.kind = str_field("kind");
+    s.offset = (size_t)num_field("offset");
+    s.nbytes = (size_t)num_field("nbytes");
+    size_t sb = obj.find('[', obj.find("\"shape\""));
+    size_t se = obj.find(']', sb);
+    std::stringstream ss(obj.substr(sb + 1, se - sb - 1));
+    std::string tok;
+    while (std::getline(ss, tok, ','))
+      if (!tok.empty()) s.shape.push_back(std::strtoll(tok.c_str(),
+                                                       nullptr, 10));
+    out->push_back(std::move(s));
+    p = cb + 1;
+  }
+  return !out->empty();
+}
+
+PJRT_Buffer_Type dtype_code(const std::string& d) {
+  if (d == "float32") return PJRT_Buffer_Type_F32;
+  if (d == "bfloat16") return PJRT_Buffer_Type_BF16;
+  if (d == "float16") return PJRT_Buffer_Type_F16;
+  if (d == "int64") return PJRT_Buffer_Type_S64;
+  if (d == "int32") return PJRT_Buffer_Type_S32;
+  return PJRT_Buffer_Type_INVALID;
+}
+
+struct Model {
+  PJRT_LoadedExecutable* exec = nullptr;
+  std::vector<ArgSpec> args;               // params then feeds, call order
+  std::vector<PJRT_Buffer*> param_bufs;    // staged once at load
+  size_t n_outputs = 0;
+};
+
+void destroy_buffer(PJRT_Buffer* b) {
+  if (!b) return;
+  PJRT_Buffer_Destroy_Args bd;
+  std::memset(&bd, 0, sizeof(bd));
+  bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+  bd.buffer = b;
+  g_api->PJRT_Buffer_Destroy(&bd);
+}
+
+// frees the DEVICE state too — a load-path failure after compile must
+// not leak the executable or already-staged weights
+void destroy_model(Model* m) {
+  if (!m) return;
+  for (PJRT_Buffer* b : m->param_bufs) destroy_buffer(b);
+  if (m->exec) {
+    PJRT_LoadedExecutable_Destroy_Args ed;
+    std::memset(&ed, 0, sizeof(ed));
+    ed.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
+    ed.executable = m->exec;
+    g_api->PJRT_LoadedExecutable_Destroy(&ed);
+  }
+  delete m;
+}
+
+std::vector<Model*> g_models;
+
+PJRT_Device* first_device() {
+  PJRT_Client_AddressableDevices_Args a;
+  std::memset(&a, 0, sizeof(a));
+  a.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  a.client = g_client;
+  if (failed(g_api->PJRT_Client_AddressableDevices(&a))) return nullptr;
+  if (a.num_addressable_devices == 0) {
+    g_err = "PJRT client has no addressable devices";
+    return nullptr;
+  }
+  return a.addressable_devices[0];
+}
+
+PJRT_Buffer* to_device(const void* data, PJRT_Buffer_Type type,
+                       const int64_t* dims, size_t ndims) {
+  PJRT_Device* dev = first_device();
+  if (!dev) return nullptr;
+  PJRT_Client_BufferFromHostBuffer_Args a;
+  std::memset(&a, 0, sizeof(a));
+  a.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+  a.client = g_client;
+  a.data = data;
+  a.type = type;
+  a.dims = dims;
+  a.num_dims = ndims;
+  a.host_buffer_semantics = PJRT_HostBufferSemantics_kImmutableOnlyDuringCall;
+  a.device = dev;
+  if (failed(g_api->PJRT_Client_BufferFromHostBuffer(&a))) return nullptr;
+  if (await_event(a.done_with_host_buffer)) return nullptr;
+  return a.buffer;
+}
+
+bool read_file(const std::string& path, std::string* out) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) {
+    g_err = "cannot open " + path;
+    return false;
+  }
+  std::stringstream ss;
+  ss << f.rdbuf();
+  *out = ss.str();
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* ptpu_pjrt_last_error() { return g_err.c_str(); }
+
+int ptpu_pjrt_init(const char* plugin_so_path) {
+  if (g_client) return 0;
+  // a failed attempt must leave no dangling dlopen refcount behind —
+  // callers retry init on transient device errors
+  auto reset = [](int rc) {
+    if (g_dl) dlclose(g_dl);
+    g_dl = nullptr;
+    g_api = nullptr;
+    return rc;
+  };
+  g_dl = dlopen(plugin_so_path, RTLD_NOW | RTLD_LOCAL);
+  if (!g_dl) {
+    g_err = std::string("dlopen failed: ") + dlerror();
+    return 1;
+  }
+  typedef const PJRT_Api* (*GetApiFn)();
+  GetApiFn get_api = (GetApiFn)dlsym(g_dl, "GetPjrtApi");
+  if (!get_api) {
+    g_err = std::string("GetPjrtApi not found in ") + plugin_so_path;
+    return reset(2);
+  }
+  g_api = get_api();
+  if (!g_api) {
+    g_err = "GetPjrtApi returned null";
+    return reset(3);
+  }
+  PJRT_Plugin_Initialize_Args ia;
+  std::memset(&ia, 0, sizeof(ia));
+  ia.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+  if (failed(g_api->PJRT_Plugin_Initialize(&ia))) return reset(4);
+  PJRT_Client_Create_Args ca;
+  std::memset(&ca, 0, sizeof(ca));
+  ca.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  if (failed(g_api->PJRT_Client_Create(&ca))) return reset(5);
+  g_client = ca.client;
+  return 0;
+}
+
+long ptpu_pjrt_load(const char* artifact_dir) {
+  if (!g_client) {
+    g_err = "ptpu_pjrt_init first";
+    return -1;
+  }
+  std::string dir(artifact_dir);
+  std::string code, sig_text, weights;
+  if (!read_file(dir + "/__module__.stablehlo_bc", &code)) return -1;
+  if (!read_file(dir + "/__signature__.json", &sig_text)) return -1;
+  if (!read_file(dir + "/__weights__.bin", &weights)) return -1;
+
+  Model* m = new Model();
+  if (!parse_signature(sig_text, &m->args)) {
+    g_err = "bad __signature__.json";
+    destroy_model(m);
+    return -1;
+  }
+
+  PJRT_Program prog;
+  std::memset(&prog, 0, sizeof(prog));
+  prog.struct_size = PJRT_Program_STRUCT_SIZE;
+  prog.code = &code[0];
+  prog.code_size = code.size();
+  prog.format = "mlir";
+  prog.format_size = 4;
+
+  // minimal serialized CompileOptionsProto: executable_build_options
+  // (field 3) { num_replicas (field 4) = 1, num_partitions (field 5) = 1 }
+  static const char kOpts[] = {0x1A, 0x04, 0x20, 0x01, 0x28, 0x01};
+
+  PJRT_Client_Compile_Args ca;
+  std::memset(&ca, 0, sizeof(ca));
+  ca.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+  ca.client = g_client;
+  ca.program = &prog;
+  ca.compile_options = kOpts;
+  ca.compile_options_size = sizeof(kOpts);
+  if (failed(g_api->PJRT_Client_Compile(&ca))) {
+    destroy_model(m);
+    return -1;
+  }
+  m->exec = ca.executable;
+
+  // number of outputs, via the underlying executable
+  PJRT_LoadedExecutable_GetExecutable_Args ga;
+  std::memset(&ga, 0, sizeof(ga));
+  ga.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+  ga.loaded_executable = m->exec;
+  if (failed(g_api->PJRT_LoadedExecutable_GetExecutable(&ga))) {
+    destroy_model(m);
+    return -1;
+  }
+  PJRT_Executable_NumOutputs_Args na;
+  std::memset(&na, 0, sizeof(na));
+  na.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+  na.executable = ga.executable;
+  if (failed(g_api->PJRT_Executable_NumOutputs(&na))) {
+    destroy_model(m);
+    return -1;
+  }
+  m->n_outputs = na.num_outputs;
+
+  // stage the weights once (the serving contract: no per-request
+  // parameter transfer)
+  for (const ArgSpec& s : m->args) {
+    if (s.kind != "param") continue;
+    if (s.offset + s.nbytes > weights.size()) {
+      g_err = "weights blob too small for " + s.name;
+      destroy_model(m);
+      return -1;
+    }
+    PJRT_Buffer* b = to_device(weights.data() + s.offset,
+                               dtype_code(s.dtype), s.shape.data(),
+                               s.shape.size());
+    if (!b) {
+      destroy_model(m);
+      return -1;
+    }
+    m->param_bufs.push_back(b);
+  }
+  g_models.push_back(m);
+  return (long)g_models.size() - 1;
+}
+
+int ptpu_pjrt_num_outputs(long h) {
+  // unload() nulls the slot, so the range check alone is not enough
+  if (h < 0 || h >= (long)g_models.size() || !g_models[h]) return -1;
+  return (int)g_models[h]->n_outputs;
+}
+
+int ptpu_pjrt_forward_f32(long h, const float* const* inputs,
+                          const size_t* in_ndims,
+                          const int64_t* const* in_dims, size_t n_inputs,
+                          float* out_buf, size_t out_capacity_f32,
+                          int64_t* out_dims, size_t* out_ndim) {
+  if (h < 0 || h >= (long)g_models.size() || !g_models[h]) {
+    g_err = "bad handle";
+    return 1;
+  }
+  Model* m = g_models[h];
+  size_t n_feeds = 0;
+  for (const ArgSpec& s : m->args)
+    if (s.kind == "feed") n_feeds++;
+  if (n_inputs != n_feeds) {
+    g_err = "expected " + std::to_string(n_feeds) + " inputs";
+    return 2;
+  }
+  // argument list: params (staged) then feeds (transferred now), in the
+  // signature's order
+  std::vector<PJRT_Buffer*> arg_bufs;
+  std::vector<PJRT_Buffer*> feed_bufs;
+  size_t pi = 0, fi = 0;
+  for (const ArgSpec& s : m->args) {
+    if (s.kind == "param") {
+      arg_bufs.push_back(m->param_bufs[pi++]);
+    } else {
+      PJRT_Buffer* b = to_device(inputs[fi], dtype_code(s.dtype),
+                                 in_dims[fi], in_ndims[fi]);
+      if (!b) {
+        // free feeds already transferred in this call before bailing
+        for (PJRT_Buffer* fb : feed_bufs) destroy_buffer(fb);
+        return 3;
+      }
+      feed_bufs.push_back(b);
+      arg_bufs.push_back(b);
+      fi++;
+    }
+  }
+
+  std::vector<PJRT_Buffer*> outs(m->n_outputs, nullptr);
+  PJRT_Buffer** out_list = outs.data();
+  PJRT_Buffer* const* arg_list = arg_bufs.data();
+  PJRT_Event* done = nullptr;
+
+  PJRT_ExecuteOptions opts;
+  std::memset(&opts, 0, sizeof(opts));
+  opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+
+  PJRT_LoadedExecutable_Execute_Args ea;
+  std::memset(&ea, 0, sizeof(ea));
+  ea.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  ea.executable = m->exec;
+  ea.options = &opts;
+  ea.argument_lists = &arg_list;
+  ea.num_devices = 1;
+  ea.num_args = arg_bufs.size();
+  ea.output_lists = &out_list;
+  ea.device_complete_events = &done;
+  int rc = 0;
+  if (failed(g_api->PJRT_LoadedExecutable_Execute(&ea))) {
+    rc = 4;
+  } else if (await_event(done)) {
+    rc = 5;
+  }
+
+  if (rc == 0) {
+    // read back output 0
+    PJRT_Buffer_ToHostBuffer_Args ta;
+    std::memset(&ta, 0, sizeof(ta));
+    ta.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+    ta.src = outs[0];
+    if (failed(g_api->PJRT_Buffer_ToHostBuffer(&ta))) {  // query size
+      rc = 6;
+    } else if (ta.dst_size > out_capacity_f32 * sizeof(float)) {
+      g_err = "output needs " + std::to_string(ta.dst_size) + " bytes";
+      rc = 7;
+    } else {
+      ta.dst = out_buf;
+      if (failed(g_api->PJRT_Buffer_ToHostBuffer(&ta)) ||
+          await_event(ta.event)) {
+        rc = 8;
+      } else {
+        PJRT_Buffer_Dimensions_Args da;
+        std::memset(&da, 0, sizeof(da));
+        da.struct_size = PJRT_Buffer_Dimensions_Args_STRUCT_SIZE;
+        da.buffer = outs[0];
+        if (!failed(g_api->PJRT_Buffer_Dimensions(&da))) {
+          size_t cap = *out_ndim;
+          *out_ndim = da.num_dims;
+          for (size_t i = 0; i < da.num_dims && i < cap; ++i)
+            out_dims[i] = da.dims[i];
+        }
+      }
+    }
+  }
+
+  for (PJRT_Buffer* b : feed_bufs) destroy_buffer(b);
+  for (PJRT_Buffer* b : outs) destroy_buffer(b);
+  return rc;
+}
+
+void ptpu_pjrt_unload(long h) {
+  if (h < 0 || h >= (long)g_models.size() || !g_models[h]) return;
+  destroy_model(g_models[h]);
+  g_models[h] = nullptr;
+}
+
+void ptpu_pjrt_shutdown() {
+  for (size_t i = 0; i < g_models.size(); ++i)
+    if (g_models[i]) ptpu_pjrt_unload((long)i);
+  g_models.clear();
+  if (g_client) {
+    PJRT_Client_Destroy_Args cd;
+    std::memset(&cd, 0, sizeof(cd));
+    cd.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+    cd.client = g_client;
+    g_api->PJRT_Client_Destroy(&cd);
+    g_client = nullptr;
+  }
+  if (g_dl) {
+    dlclose(g_dl);
+    g_dl = nullptr;
+  }
+  g_api = nullptr;
+}
+
+}  // extern "C"

@@ -24,6 +24,12 @@ from .core.scope import global_scope
 EXPORTED_FILE = "__compiled__.stablehlo"
 PARAMS_FILE = "__params__.pkl"
 META_FILE = "__meta__.json"
+# Python-free deployment tier (native/paddle_tpu_pjrt.cc): raw StableHLO
+# bytecode + flat weights blob + call signature — everything a PJRT C API
+# embedder needs, no pickle/Python anywhere
+NATIVE_MODULE_FILE = "__module__.stablehlo_bc"
+NATIVE_WEIGHTS_FILE = "__weights__.bin"
+NATIVE_SIGNATURE_FILE = "__signature__.json"
 
 __all__ = ["export_compiled", "load_compiled", "CompiledModel"]
 
@@ -108,6 +114,37 @@ def export_compiled(dirname, feeded_var_names, target_vars, executor,
         json.dump({"feed_names": feed_order, "fetch_names": fetch_names,
                    "feed_shapes": {n: list(np.asarray(example_feed[n]).shape)
                                    for n in feed_order}}, f)
+
+    # Python-free tier: raw module bytecode + flat weights + signature
+    # (the PJRT C API takes "mlir"-format bytecode directly; the args
+    # list mirrors fn's flatten order: params then feeds). Dtypes/shapes
+    # come from the exported module's CANONICAL avals, not the raw numpy
+    # inputs — jax canonicalizes f64->f32 / i64->i32 (x64 off), and a
+    # blob written in the pre-canonical dtype would feed the compiled
+    # module garbage.
+    with open(os.path.join(dirname, NATIVE_MODULE_FILE), "wb") as f:
+        f.write(exported.mlir_module_serialized)
+    avals = list(exported.in_avals)  # flat: params then feeds
+    assert len(avals) == len(param_order) + len(feed_order)
+    arg_specs, offset = [], 0
+    with open(os.path.join(dirname, NATIVE_WEIGHTS_FILE), "wb") as f:
+        for n, av in zip(param_order, avals):
+            a = np.ascontiguousarray(
+                np.asarray(params[n]).astype(av.dtype))
+            f.write(a.tobytes())
+            arg_specs.append({"name": n, "kind": "param",
+                              "dtype": str(av.dtype),
+                              "shape": list(av.shape),
+                              "offset": offset, "nbytes": a.nbytes})
+            offset += a.nbytes
+    for n, av in zip(feed_order, avals[len(param_order):]):
+        arg_specs.append({"name": n, "kind": "feed",
+                          "dtype": str(av.dtype), "shape": list(av.shape),
+                          "offset": 0, "nbytes": 0})
+    with open(os.path.join(dirname, NATIVE_SIGNATURE_FILE), "w") as f:
+        json.dump({"format": "stablehlo_bytecode",
+                   "arg_order": "params_then_feeds",
+                   "fetch_names": fetch_names, "args": arg_specs}, f)
     return fetch_names
 
 

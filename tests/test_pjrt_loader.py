@@ -1,0 +1,123 @@
+"""Python-free PJRT deployment tier: native artifacts written by
+export_compiled + the C loader's buildability and error paths
+(reference role: paddle/capi/capi.h:18-23 — deploy WITHOUT the heavy
+runtime; design: doc/design/capi_native_loader.md)."""
+import ctypes
+import json
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+pytestmark = pytest.mark.smoke
+
+NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
+
+
+def _build_loader():
+    r = subprocess.run(["make", "-C", NATIVE_DIR, "pjrt"],
+                       capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip("native toolchain unavailable: %s" % r.stderr[-200:])
+    return os.path.join(NATIVE_DIR, "libpaddle_tpu_pjrt.so")
+
+
+def _export_tiny(tmp_path):
+    main, startup = pt.Program(), pt.Program()
+    pt.switch_main_program(main)
+    pt.switch_startup_program(startup)
+    from paddle_tpu.core import unique_name
+    unique_name._counters.clear()
+    x = pt.layers.data("x", shape=[4], dtype="float32")
+    y = pt.layers.fc(x, size=3, act="softmax")
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        pt.inference.export_compiled(
+            str(tmp_path), ["x"], [y], exe, main_program=main,
+            example_feed={"x": np.zeros((2, 4), np.float32)}, scope=scope)
+    return str(tmp_path)
+
+
+def test_native_artifacts_written(tmp_path):
+    d = _export_tiny(tmp_path)
+    # raw StableHLO bytecode (MLIR bytecode magic "ML\xefR")
+    bc = open(os.path.join(d, "__module__.stablehlo_bc"), "rb").read()
+    assert bc[:4] == b"ML\xefR", bc[:8]
+    sig = json.load(open(os.path.join(d, "__signature__.json")))
+    assert sig["arg_order"] == "params_then_feeds"
+    params = [a for a in sig["args"] if a["kind"] == "param"]
+    feeds = [a for a in sig["args"] if a["kind"] == "feed"]
+    assert sig["args"][:len(params)] == params  # params strictly first
+    assert len(feeds) == 1 and feeds[0]["shape"] == [2, 4]
+    blob = os.path.getsize(os.path.join(d, "__weights__.bin"))
+    assert blob == sum(a["nbytes"] for a in params)
+    # fc weight (4,3) f32 + bias (3,)
+    assert blob == 4 * 3 * 4 + 3 * 4
+
+
+def test_loader_symbols_and_error_paths(tmp_path):
+    so = _build_loader()
+    lib = ctypes.CDLL(so)
+    lib.ptpu_pjrt_last_error.restype = ctypes.c_char_p
+    lib.ptpu_pjrt_init.argtypes = [ctypes.c_char_p]
+    lib.ptpu_pjrt_load.restype = ctypes.c_long
+    lib.ptpu_pjrt_load.argtypes = [ctypes.c_char_p]
+    # every ABI entry point resolves
+    for sym in ["ptpu_pjrt_init", "ptpu_pjrt_load", "ptpu_pjrt_forward_f32",
+                "ptpu_pjrt_num_outputs", "ptpu_pjrt_unload",
+                "ptpu_pjrt_shutdown", "ptpu_pjrt_last_error"]:
+        assert hasattr(lib, sym), sym
+    # bogus plugin path -> dlopen error, clean message
+    rc = lib.ptpu_pjrt_init(b"/nonexistent/plugin.so")
+    assert rc == 1
+    assert b"dlopen" in lib.ptpu_pjrt_last_error()
+    # a real .so without GetPjrtApi -> detected, not crashed
+    rc = lib.ptpu_pjrt_init(so.encode())  # the loader itself
+    assert rc == 2
+    assert b"GetPjrtApi" in lib.ptpu_pjrt_last_error()
+    # load before init -> guarded
+    rc = lib.ptpu_pjrt_load(str(tmp_path).encode())
+    assert rc == -1
+    assert b"init" in lib.ptpu_pjrt_last_error()
+
+
+@pytest.mark.skipif(
+    os.environ.get("PTPU_PJRT_PLUGIN") is None,
+    reason="full execute needs a live PJRT plugin; set PTPU_PJRT_PLUGIN="
+           "/path/to/libtpu.so on a TPU host")
+def test_loader_end_to_end(tmp_path):
+    """Python-free forward vs the Python tier, on a real plugin."""
+    so = _build_loader()
+    d = _export_tiny(tmp_path)
+    lib = ctypes.CDLL(so)
+    lib.ptpu_pjrt_last_error.restype = ctypes.c_char_p
+    lib.ptpu_pjrt_load.restype = ctypes.c_long
+    assert lib.ptpu_pjrt_init(
+        os.environ["PTPU_PJRT_PLUGIN"].encode()) == 0, \
+        lib.ptpu_pjrt_last_error()
+    h = lib.ptpu_pjrt_load(d.encode())
+    assert h >= 0, lib.ptpu_pjrt_last_error()
+    x = np.random.RandomState(0).rand(2, 4).astype(np.float32)
+    out = np.zeros(6, np.float32)
+    out_dims = (ctypes.c_int64 * 4)()
+    out_ndim = ctypes.c_size_t(4)
+    in_ptr = (ctypes.POINTER(ctypes.c_float) * 1)(
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    dims = (ctypes.c_int64 * 2)(2, 4)
+    dim_ptrs = (ctypes.POINTER(ctypes.c_int64) * 1)(dims)
+    ndims = (ctypes.c_size_t * 1)(2)
+    rc = lib.ptpu_pjrt_forward_f32(
+        ctypes.c_long(h), in_ptr, ndims, dim_ptrs, 1,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), 6,
+        out_dims, ctypes.byref(out_ndim))
+    assert rc == 0, lib.ptpu_pjrt_last_error()
+    assert out_ndim.value == 2 and list(out_dims[:2]) == [2, 3]
+    ref = pt.inference.load_compiled(d).run({"x": x})[0]
+    np.testing.assert_allclose(out.reshape(2, 3), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+    lib.ptpu_pjrt_shutdown()
