@@ -781,7 +781,10 @@ def child_main(tag):
     # same exact config; otherwise the measured negative result is still
     # recorded on the headline for the evidence trail.
     if final is not None and platform != "cpu" and _remaining() > 300:
-        wd.phase("pallas_trial", max(_remaining(), 1))
+        # bounded cap: a wedged Mosaic compile must not starve the
+        # polish/probe phases of their budget (the watchdog os._exit()s
+        # the child, and every prior stage has already been emitted)
+        wd.phase("pallas_trial", min(max(_remaining() - 180, 1), 600))
         prev_impl = os.environ.get("PADDLE_TPU_CONV_IMPL")
         try:
             os.environ["PADDLE_TPU_CONV_IMPL"] = "pallas3x3"

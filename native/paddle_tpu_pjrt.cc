@@ -146,6 +146,7 @@ struct Model {
   std::vector<ArgSpec> args;               // params then feeds, call order
   std::vector<PJRT_Buffer*> param_bufs;    // staged once at load
   size_t n_outputs = 0;
+  std::string out0_dtype = "float32";      // from the signature
 };
 
 void destroy_buffer(PJRT_Buffer* b) {
@@ -279,6 +280,17 @@ long ptpu_pjrt_load(const char* artifact_dir) {
     destroy_model(m);
     return -1;
   }
+  // output 0's dtype, for the f32-only forward ABI check ("outputs"
+  // section follows "args"; first dtype after it is output 0's)
+  size_t op = sig_text.find("\"outputs\"");
+  if (op != std::string::npos) {
+    size_t dk = sig_text.find("\"dtype\"", op);
+    if (dk != std::string::npos) {
+      size_t q1 = sig_text.find('"', sig_text.find(':', dk));
+      size_t q2 = sig_text.find('"', q1 + 1);
+      m->out0_dtype = sig_text.substr(q1 + 1, q2 - q1 - 1);
+    }
+  }
 
   PJRT_Program prog;
   std::memset(&prog, 0, sizeof(prog));
@@ -363,8 +375,24 @@ int ptpu_pjrt_forward_f32(long h, const float* const* inputs,
   }
   Model* m = g_models[h];
   size_t n_feeds = 0;
-  for (const ArgSpec& s : m->args)
-    if (s.kind == "feed") n_feeds++;
+  for (const ArgSpec& s : m->args) {
+    if (s.kind != "feed") continue;
+    n_feeds++;
+    // the _f32 ABI moves raw float32 host memory: transferring it
+    // tagged with another dtype would feed the device garbage with
+    // rc==0 — refuse instead (an int/bf16-feed model needs a typed
+    // entry point, not reinterpretation)
+    if (s.dtype != "float32") {
+      g_err = "feed '" + s.name + "' is " + s.dtype +
+              "; ptpu_pjrt_forward_f32 only serves float32 feeds";
+      return 2;
+    }
+  }
+  if (m->out0_dtype != "float32") {
+    g_err = "output 0 is " + m->out0_dtype +
+            "; ptpu_pjrt_forward_f32 only serves float32 outputs";
+    return 2;
+  }
   if (n_inputs != n_feeds) {
     g_err = "expected " + std::to_string(n_feeds) + " inputs";
     return 2;

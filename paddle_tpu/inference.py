@@ -141,10 +141,14 @@ def export_compiled(dirname, feeded_var_names, target_vars, executor,
         arg_specs.append({"name": n, "kind": "feed",
                           "dtype": str(av.dtype), "shape": list(av.shape),
                           "offset": 0, "nbytes": 0})
+    out_specs = [{"name": n, "dtype": str(av.dtype),
+                  "shape": list(av.shape)}
+                 for n, av in zip(fetch_names, exported.out_avals)]
     with open(os.path.join(dirname, NATIVE_SIGNATURE_FILE), "w") as f:
         json.dump({"format": "stablehlo_bytecode",
                    "arg_order": "params_then_feeds",
-                   "fetch_names": fetch_names, "args": arg_specs}, f)
+                   "fetch_names": fetch_names, "args": arg_specs,
+                   "outputs": out_specs}, f)
     return fetch_names
 
 

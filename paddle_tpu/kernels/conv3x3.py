@@ -55,7 +55,12 @@ def _kernel(x_ref, w_ref, o_ref, *, H, W, C, O, out_dtype):
 
 
 def _interpret_default():
-    return jax.default_backend() != "tpu"
+    # compiled Mosaic path ONLY on backends known to lower this kernel
+    # (a TPU plugin may register as "tpu" or "axon"); everything else —
+    # cpu tests, gpu hosts — takes the slow-but-correct interpreter.
+    # The trial in bench.py relies on this: interpret mode on the real
+    # chip would be silently catastrophic in a timed comparison.
+    return jax.default_backend() not in ("tpu", "axon")
 
 
 @functools.partial(jax.jit, static_argnames=("out_dtype", "interpret"))
@@ -94,8 +99,9 @@ def _conv3x3_fwd(x, w, out_dtype=None, interpret=None):
 def conv3x3_s1_nhwc(x, w, out_dtype=None):
     """3x3/s1/p1 convolution, NHWC x HWIO -> NHWC, f32 accumulation.
 
-    Differentiable (custom vjp); on non-TPU backends the kernel runs in
-    pallas interpret mode, so tests and CPU fallbacks stay correct."""
+    Differentiable (custom vjp); on backends other than tpu/axon the
+    kernel runs in pallas interpret mode, so tests and CPU/GPU
+    fallbacks stay correct (slowly) while TPU gets compiled Mosaic."""
     return _conv3x3_fwd(x, w, out_dtype=out_dtype)
 
 

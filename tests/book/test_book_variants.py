@@ -42,7 +42,8 @@ def _train(exe, img, label, avg_cost, acc, batches=40):
     place = fluid.CPUPlace()
     feeder = fluid.DataFeeder(place=place, feed_list=[img, label])
     train_reader = fluid.reader.batch(
-        fluid.reader.shuffle(fluid.dataset.mnist.train(), buf_size=500),
+        fluid.reader.shuffle(fluid.dataset.mnist.train(), buf_size=500,
+                             seed=7),
         batch_size=64)
     costs, accs = [], []
     for i, data in enumerate(train_reader()):

@@ -67,7 +67,8 @@ def test_image_classification(net):
     exe = fluid.Executor(place)
     exe.run(fluid.default_startup_program())
     train_reader = fluid.reader.batch(
-        fluid.reader.shuffle(fluid.dataset.cifar.train10(), buf_size=512),
+        fluid.reader.shuffle(fluid.dataset.cifar.train10(), buf_size=512,
+                             seed=7),
         batch_size=32)
 
     costs, accs = [], []

@@ -41,7 +41,8 @@ def test_recognize_digits(net):
     exe.run(fluid.default_startup_program())
     feeder = fluid.DataFeeder(place=place, feed_list=[img, label])
     train_reader = fluid.reader.batch(
-        fluid.reader.shuffle(fluid.dataset.mnist.train(), buf_size=500),
+        fluid.reader.shuffle(fluid.dataset.mnist.train(), buf_size=500,
+                             seed=7),
         batch_size=64)
 
     costs, accs = [], []

@@ -66,7 +66,8 @@ def test_recommender_system():
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(fluid.default_startup_program())
     reader = fluid.reader.batch(
-        fluid.reader.shuffle(fluid.dataset.movielens.train(), buf_size=512),
+        fluid.reader.shuffle(fluid.dataset.movielens.train(), buf_size=512,
+                             seed=7),
         batch_size=32)
 
     costs = []

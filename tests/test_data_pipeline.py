@@ -121,7 +121,8 @@ def test_feeder_trains_on_mnist():
     feeder = fluid.DataFeeder(feed_list=[img, label],
                               place=fluid.CPUPlace())
     train_reader = fluid.reader.batch(
-        fluid.reader.shuffle(fluid.dataset.mnist.train(), buf_size=500),
+        fluid.reader.shuffle(fluid.dataset.mnist.train(), buf_size=500,
+                             seed=7),
         batch_size=64)
     losses = []
     for batch in train_reader():
