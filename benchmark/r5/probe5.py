@@ -1,0 +1,10 @@
+import json, time
+t0 = time.time()
+import jax
+devs = jax.devices()
+out = {"ok": True, "platform": devs[0].platform,
+       "device": str(devs[0].device_kind), "n": len(devs),
+       "t_devices_s": round(time.time() - t0, 2)}
+print(json.dumps(out))
+with open("/root/repo/benchmark/r5/probe5.json", "w") as f:
+    json.dump(out, f)
