@@ -5,10 +5,16 @@ reference: python/paddle/trainer_config_helpers/layers.py (105 defs) and
 evaluators.py (17 defs) — the name-for-name audit lives in
 test_v1_surface_audit below.
 """
+import os
 import re
 
 import numpy as np
 import pytest
+
+# parity audits need the reference checkout; plain users of the
+# framework don't have one — skip, don't error (same idiom as
+# test_registry_audit.py)
+_REF_TCH_DIR = "/root/reference/python/paddle/trainer_config_helpers"
 
 import paddle_tpu as pt
 import paddle_tpu.trainer_config_helpers as tch
@@ -478,6 +484,8 @@ def test_pnpair_evaluator_orders():
     assert float(pos) == 3.0 and float(neg) == 0.0  # perfectly ordered
 
 
+@pytest.mark.skipif(not os.path.isdir(_REF_TCH_DIR),
+                    reason="reference checkout not present (parity audit)")
 def test_v1_surface_audit():
     """Name-for-name audit vs the reference (VERDICT r2 item 6 done
     criterion): every reference def resolves here; exclusions would be
