@@ -82,13 +82,8 @@ def bench(batch=None, dtype="bfloat16", iters=8):
                "speedup": round(t_lax / t_pal, 3)}
         rows.append(row)
         print(json.dumps(row))
-    try:
-        import subprocess
-        commit = subprocess.run(
-            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
-            text=True, timeout=10).stdout.strip()
-    except Exception:
-        commit = "unknown"
+    from bench import _git_commit
+    commit = _git_commit()
     rec = {"device": str(getattr(dev, "device_kind", dev.platform)),
            "platform": dev.platform, "dtype": dtype, "rows": rows,
            "commit": commit,

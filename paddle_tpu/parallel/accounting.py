@@ -60,14 +60,10 @@ def collective_bytes(program, specs, mesh_shape, zero_axis=None,
       per step: (n-1)/n of the looked-up rows live off-chip, gathered
       forward and scatter-added backward.
     """
-    dp = 1
-    data_axis = None
-    for axis, size in mesh_shape.items():
-        if axis not in (zero_axis,) and data_axis is None:
-            data_axis = axis
-        # conventional: first axis named 'dp' is the data axis
-        if axis == "dp":
-            data_axis = axis
+    # the axis named 'dp' is the data axis by convention; otherwise the
+    # first non-zero axis plays the role
+    data_axis = "dp" if "dp" in mesh_shape else next(
+        (a for a in mesh_shape if a != zero_axis), None)
     dp = mesh_shape.get(data_axis, 1)
     emb_names = set(embedding_params)
     replicated, sharded = _param_bytes(
