@@ -605,27 +605,35 @@ def child_main(tag):
     _log(tag, "initializing device ...")
     # bounded retry INSIDE the init window: a tunnelled backend can fail
     # transiently while its pool provisions (observed RuntimeError
-    # UNAVAILABLE). The watchdog caps the total, so retrying cannot eat
-    # the budget the way r3's uncapped loop did.
-    init_deadline = time.time() + min(init_window, max(_remaining(), 1))
-    wd.phase("jax.devices", min(init_window, max(_remaining(), 1)))
+    # UNAVAILABLE). The budget is a declared RetryPolicy (paddle_tpu's
+    # resilience layer — importing it provably does not initialize jax
+    # backends) capped by max_elapsed, so retrying cannot eat the budget
+    # the way r3's uncapped loop did; the watchdog still caps the total.
+    from paddle_tpu.resilience import RetryError, RetryPolicy
+
+    init_budget = min(init_window, max(_remaining(), 1))
+    wd.phase("jax.devices", init_budget)
     t0 = time.time()
-    dev = None
-    while dev is None:
+
+    def reset_backends(attempt, exc, delay):
+        _log(tag, "device init failed (%r), retrying in %.0fs"
+             % (exc, delay))
         try:
-            dev = jax.devices()[0]
-        except Exception as e:
-            if time.time() + 25 > init_deadline:
-                _log(tag, "device init failed (%r), init window exhausted"
-                     % e)
-                return
-            _log(tag, "device init failed (%r), retrying in 20s" % e)
-            time.sleep(20)
-            try:
-                from jax.extend.backend import clear_backends
-                clear_backends()
-            except Exception:
-                pass
+            from jax.extend.backend import clear_backends
+            clear_backends()
+        except Exception:
+            pass
+
+    probe = RetryPolicy(
+        max_attempts=1000, backoff=20.0, multiplier=1.0, jitter=0.0,
+        max_elapsed=max(init_budget - 5.0, 1.0), on_retry=reset_backends,
+        name="bench.device_init")
+    try:
+        dev = probe.call(lambda: jax.devices()[0])
+    except RetryError as e:
+        _log(tag, "device init failed (%r), init window exhausted"
+             % (e.last,))
+        return
     wd.clear()
     _log(tag, "device up in %.1fs: %s (%s)"
          % (time.time() - t0, dev, getattr(dev, "device_kind", "?")))
@@ -676,7 +684,10 @@ def child_main(tag):
             import glob as _glob
             rdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "benchmark", "results")
-            cands = sorted(_glob.glob(os.path.join(rdir, "bench_r*_*.json")))
+            # newest by mtime: lexicographic order breaks at round 10
+            # (bench_r10 sorts before bench_r2)
+            cands = sorted(_glob.glob(os.path.join(rdir, "bench_r*_*.json")),
+                           key=os.path.getmtime)
             if cands:
                 with open(cands[-1]) as f:
                     banked = json.load(f)

@@ -92,8 +92,28 @@ bool parse_signature(const std::string& text, std::vector<ArgSpec>* out) {
   size_t pos = text.find("\"args\"");
   if (pos == std::string::npos) return false;
   pos = text.find('[', pos);
-  size_t end = text.rfind(']');
-  if (pos == std::string::npos || end == std::string::npos) return false;
+  if (pos == std::string::npos) return false;
+  // bound the scan at the args array's own closing ']' via a
+  // string-aware bracket count: rfind(']') would swallow the outputs
+  // array into the args (kind=="" entries -> inflated num_args and OOB
+  // reads on every forward), and a plain search for the "outputs" key
+  // would be fooled by an ARG named "outputs"
+  size_t end = std::string::npos;
+  int depth = 0;
+  bool in_str = false, esc = false;
+  for (size_t i = pos; i < text.size(); ++i) {
+    char ch = text[i];
+    if (in_str) {
+      if (esc) esc = false;
+      else if (ch == '\\') esc = true;
+      else if (ch == '"') in_str = false;
+      continue;
+    }
+    if (ch == '"') { in_str = true; continue; }
+    if (ch == '[') ++depth;
+    else if (ch == ']' && --depth == 0) { end = i; break; }
+  }
+  if (end == std::string::npos) return false;
   size_t p = pos;
   while (true) {
     size_t ob = text.find('{', p);
@@ -126,7 +146,11 @@ bool parse_signature(const std::string& text, std::vector<ArgSpec>* out) {
     while (std::getline(ss, tok, ','))
       if (!tok.empty()) s.shape.push_back(std::strtoll(tok.c_str(),
                                                        nullptr, 10));
-    out->push_back(std::move(s));
+    // only param/feed entries belong in the call-argument list; anything
+    // else (a stray output spec, a future kind) must not be staged as a
+    // weight or counted as a feed
+    if (s.kind == "param" || s.kind == "feed")
+      out->push_back(std::move(s));
     p = cb + 1;
   }
   return !out->empty();
@@ -203,7 +227,12 @@ PJRT_Buffer* to_device(const void* data, PJRT_Buffer_Type type,
   a.host_buffer_semantics = PJRT_HostBufferSemantics_kImmutableOnlyDuringCall;
   a.device = dev;
   if (failed(g_api->PJRT_Client_BufferFromHostBuffer(&a))) return nullptr;
-  if (await_event(a.done_with_host_buffer)) return nullptr;
+  if (await_event(a.done_with_host_buffer)) {
+    // the transfer was created; failing to await must not leak the
+    // device buffer
+    destroy_buffer(a.buffer);
+    return nullptr;
+  }
   return a.buffer;
 }
 
@@ -224,6 +253,25 @@ bool read_file(const std::string& path, std::string* out) {
 extern "C" {
 
 const char* ptpu_pjrt_last_error() { return g_err.c_str(); }
+
+// Test-only probe: parse a signature JSON exactly as ptpu_pjrt_load
+// would and report what lands in the call-argument list. Returns the
+// total number of arg entries (what num_args would be), with the
+// param/feed split in the out-params; -1 on parse failure. Lets the
+// parser be unit-tested over ctypes without a live PJRT plugin.
+int ptpu_pjrt_sig_parse(const char* sig_json, int* n_params, int* n_feeds) {
+  if (!sig_json) return -1;
+  std::vector<ArgSpec> args;
+  if (!parse_signature(std::string(sig_json), &args)) return -1;
+  int np = 0, nf = 0;
+  for (const ArgSpec& s : args) {
+    if (s.kind == "param") ++np;
+    else ++nf;  // parse_signature admits only param|feed
+  }
+  if (n_params) *n_params = np;
+  if (n_feeds) *n_feeds = nf;
+  return (int)args.size();
+}
 
 int ptpu_pjrt_init(const char* plugin_so_path) {
   if (g_client) return 0;

@@ -50,6 +50,7 @@ from . import enforce  # noqa: F401
 from .flags import FLAGS, set_flags, get_flags, flags_guard  # noqa: F401
 from . import inference  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import resilience  # noqa: F401
 from .io import (  # noqa: F401
     save_vars, save_params, save_persistables, load_vars, load_params,
     load_persistables, save_inference_model, load_inference_model,

@@ -23,23 +23,44 @@ TPU-native form (the orbax role, self-contained):
   a ``_COMPLETE`` marker with step + per-file sizes is written last — a
   torn checkpoint is never mistaken for a good one (the md5/uuid-in-etcd
   role).
+- **hardened** (the resilience layer): every shard and the manifest carry
+  a CRC32 computed before the bytes leave memory, so bit-rot and torn
+  writes that keep the size intact are DETECTED on load
+  (``CheckpointCorruption``), and — when the checkpoint sits in a
+  retention root (``keep_last=``) — load falls back to the previous
+  complete checkpoint automatically, recording a
+  ``checkpoint_fallback`` resilience event (the reference's
+  md5-mismatch → previous-etcd-snapshot behavior). The byte path runs
+  through ``fault_point("checkpoint.write")`` so chaos tests corrupt
+  real checkpoints deterministically.
 """
 from __future__ import annotations
 
+import io as _io
 import json
 import os
+import re
 import shutil
 import threading
+import warnings
+import zlib
 
 import numpy as np
 
 from .core.scope import global_scope
+from .resilience import fault_point, record_event
 
-__all__ = ["save_checkpoint", "load_checkpoint", "latest_checkpoint",
-           "AsyncCheckpoint"]
+__all__ = ["save_checkpoint", "load_checkpoint", "load_latest",
+           "latest_checkpoint", "AsyncCheckpoint", "CheckpointCorruption"]
 
 _MANIFEST = "_MANIFEST.json"
 _COMPLETE = "_COMPLETE"
+
+
+class CheckpointCorruption(IOError):
+    """A checkpoint's bytes do not match their recorded CRC32 (or its
+    manifest no longer parses): the marker said complete, the data
+    disagrees."""
 
 
 def _snapshot(scope, var_names):
@@ -81,25 +102,48 @@ def _snapshot(scope, var_names):
 
 def _write(dirname, entries, step):
     tmp = dirname + ".tmp"
+    # clear stale CONTENTS but keep the dir itself: for retention saves
+    # it doubles as the step-number reservation (made synchronously in
+    # save_checkpoint) and must never blink out of existence
     if os.path.exists(tmp):
-        shutil.rmtree(tmp)
-    os.makedirs(tmp)
+        for f in os.listdir(tmp):
+            p = os.path.join(tmp, f)
+            if os.path.isdir(p):
+                shutil.rmtree(p)
+            else:
+                os.remove(p)
+    else:
+        os.makedirs(tmp)
     manifest = {"step": step, "vars": {}}
     sizes = {}
     for name, e in entries.items():
         files = []
         for i, sh in enumerate(e["shards"]):
             fn = "%s.shard%d.npy" % (name.replace("/", "__"), i)
-            np.save(os.path.join(tmp, fn), sh["data"])
-            files.append({"file": fn, "index": sh["index"]})
-            sizes[fn] = int(os.path.getsize(os.path.join(tmp, fn)))
+            # serialize in memory: the CRC is of the bytes we MEANT to
+            # write; the fault point sits between CRC and disk, exactly
+            # where real bit-rot lives
+            buf = _io.BytesIO()
+            np.save(buf, sh["data"])
+            raw = buf.getvalue()
+            crc = zlib.crc32(raw) & 0xFFFFFFFF
+            raw = fault_point("checkpoint.write", payload=raw)
+            with open(os.path.join(tmp, fn), "wb") as f:
+                f.write(raw)
+            files.append({"file": fn, "index": sh["index"], "crc32": crc})
+            sizes[fn] = len(raw)
         manifest["vars"][name] = {"shape": e["shape"],
                                   "dtype": e["dtype"], "files": files}
-    with open(os.path.join(tmp, _MANIFEST), "w") as f:
-        json.dump(manifest, f)
-    # marker LAST: its presence certifies every byte above it
+    mraw = json.dumps(manifest).encode("utf-8")
+    mcrc = zlib.crc32(mraw) & 0xFFFFFFFF
+    mraw = fault_point("checkpoint.write", payload=mraw)
+    with open(os.path.join(tmp, _MANIFEST), "wb") as f:
+        f.write(mraw)
+    # marker LAST: its presence certifies every byte above it; it also
+    # carries the manifest's CRC (the manifest carries the shards')
     with open(os.path.join(tmp, _COMPLETE), "w") as f:
-        json.dump({"step": step, "sizes": sizes}, f)
+        json.dump({"step": step, "sizes": sizes,
+                   "manifest_crc32": mcrc}, f)
     # never delete the old GOOD checkpoint before the new one is in place:
     # move it aside, swap, then drop the aside copy
     aside = dirname + ".old"
@@ -131,11 +175,56 @@ class AsyncCheckpoint(object):
         return not self._thread.is_alive()
 
 
+# serializes auto-step resolution + .tmp reservation so overlapping
+# async saves cannot resolve the same index and clobber each other's
+# in-flight write
+_reserve_lock = threading.Lock()
+
+
+def _retained_dir(root, step):
+    """Checkpoint dir for ``step`` under a retention root; with no step,
+    the next free index after the newest existing one. In-flight ``.tmp``
+    reservations count as taken."""
+    if step is None:
+        taken = [-1]
+        if os.path.isdir(root):
+            for d in os.listdir(root):
+                for suffix in (".tmp", ".old"):
+                    if d.endswith(suffix):
+                        d = d[:-len(suffix)]
+                        break
+                if d.startswith("ckpt-"):
+                    try:
+                        taken.append(int(d[len("ckpt-"):]))
+                    except ValueError:
+                        pass
+        step = max(taken) + 1
+    return os.path.join(root, "ckpt-%08d" % step), step
+
+
+def _prune(root, keep_last):
+    """Drop all but the newest ``keep_last`` COMPLETE checkpoints under
+    ``root`` (torn/partial dirs are left for inspection — they are
+    skipped by latest_checkpoint and cheap to remove by hand)."""
+    cands = [os.path.join(root, d) for d in os.listdir(root)
+             if os.path.isdir(os.path.join(root, d))
+             and not d.endswith((".tmp", ".old"))]
+    cands = [d for d in cands if _is_complete(d)]
+    cands.sort(key=lambda d: (os.path.getmtime(d), d), reverse=True)
+    for stale in cands[keep_last:]:
+        shutil.rmtree(stale, ignore_errors=True)
+
+
 def save_checkpoint(dirname, main_program=None, scope=None, step=None,
-                    async_=False):
+                    async_=False, keep_last=None):
     """Persist every persistable var of ``main_program`` from ``scope``.
     Sharded arrays write per-shard files; ``async_=True`` returns an
-    AsyncCheckpoint after the (synchronous) device->host snapshot."""
+    AsyncCheckpoint after the (synchronous) device->host snapshot.
+
+    ``keep_last=N`` switches to the retention layout: ``dirname`` is a
+    ROOT holding ``ckpt-<step>`` dirs, the newest N complete checkpoints
+    are kept, older ones pruned — the layout ``load_latest`` and the
+    corruption fallback of ``load_checkpoint`` walk."""
     from .core import ir
 
     program = main_program or ir.default_main_program()
@@ -144,8 +233,22 @@ def save_checkpoint(dirname, main_program=None, scope=None, step=None,
              if v.persistable and v.type == ir.VarType.LOD_TENSOR]
     entries = _snapshot(scope, names)  # consistency point
 
+    root = None
+    if keep_last is not None:
+        if keep_last < 1:
+            raise ValueError("keep_last must be >= 1")
+        root = dirname
+        os.makedirs(root, exist_ok=True)
+        with _reserve_lock:
+            dirname, step = _retained_dir(root, step)
+            # reserve the slot NOW (the async write only materializes
+            # the final dir at rename time); _write keeps this dir alive
+            os.makedirs(dirname + ".tmp", exist_ok=True)
+
     if not async_:
         _write(dirname, entries, step)
+        if root is not None:
+            _prune(root, keep_last)
         return dirname
 
     state = {"dirname": dirname, "error": None}
@@ -153,6 +256,8 @@ def save_checkpoint(dirname, main_program=None, scope=None, step=None,
     def work():
         try:
             _write(dirname, entries, step)
+            if root is not None:
+                _prune(root, keep_last)
         except BaseException as e:  # re-raised from result()
             state["error"] = e
 
@@ -187,31 +292,95 @@ def latest_checkpoint(root):
     return max(cands, key=os.path.getmtime) if cands else None
 
 
-def load_checkpoint(dirname, main_program=None, scope=None,
-                    dist_context=None):
-    """Reassemble arrays from the manifest and install them in ``scope``,
-    sharded per ``dist_context`` when given (may differ from the saving
-    mesh). Returns the manifest's step."""
-    import jax
+def _read_shard(dirname, sh, verify):
+    """One shard file -> ndarray, CRC-checked against the manifest."""
+    path = os.path.join(dirname, sh["file"])
+    with open(path, "rb") as f:
+        raw = f.read()
+    fault_point("checkpoint.load")
+    want = sh.get("crc32")  # absent in pre-hardening checkpoints
+    if verify and want is not None \
+            and (zlib.crc32(raw) & 0xFFFFFFFF) != want:
+        raise CheckpointCorruption(
+            "checkpoint shard %s fails its CRC32 (stored %d)"
+            % (path, want))
+    try:
+        return np.load(_io.BytesIO(raw))
+    except Exception as e:
+        raise CheckpointCorruption("checkpoint shard %s unreadable: %r"
+                                   % (path, e))
 
-    from .core import ir
+
+# retention-layout entry names (save_checkpoint(keep_last=)); automatic
+# corruption fallback is confined to these — a standalone checkpoint's
+# siblings are arbitrary dirs (another model's root, say), not history
+_RETAIN_RE = re.compile(r"^ckpt-\d{8}$")
+
+
+def _previous_complete(dirname):
+    """The newest COMPLETE retention sibling strictly older than
+    ``dirname`` — the fallback target when ``dirname`` turns out
+    corrupt. Ordered by (mtime, name) so retention names break mtime
+    ties. None unless ``dirname`` itself is a retention entry."""
+    me = os.path.abspath(dirname)
+    if not _RETAIN_RE.match(os.path.basename(me)):
+        return None
+    root = os.path.dirname(me)
+    mine = (os.path.getmtime(me), me)
+    cands = []
+    for d in os.listdir(root):
+        p = os.path.abspath(os.path.join(root, d))
+        if p == me or not os.path.isdir(p) \
+                or not _RETAIN_RE.match(d):
+            continue
+        if not _is_complete(p):
+            continue
+        key = (os.path.getmtime(p), p)
+        if key < mine:
+            cands.append((key, p))
+    return max(cands)[1] if cands else None
+
+
+def _load_one(dirname, program, scope, dist_context, verify):
+    """Read + verify + install ONE checkpoint dir. Values are staged and
+    only installed after every shard verified — a corrupt shard must not
+    leave the scope half-overwritten."""
+    import jax
 
     if not _is_complete(dirname):
         raise IOError("checkpoint %r is missing or torn (no valid %s)"
                       % (dirname, _COMPLETE))
-    program = main_program or ir.default_main_program()
-    scope = scope or global_scope()
-    with open(os.path.join(dirname, _MANIFEST)) as f:
-        manifest = json.load(f)
+    with open(os.path.join(dirname, _COMPLETE)) as f:
+        marker = json.load(f)  # parsed fine a moment ago in _is_complete
+    with open(os.path.join(dirname, _MANIFEST), "rb") as f:
+        mraw = f.read()
+    want = marker.get("manifest_crc32")  # absent pre-hardening
+    if verify and want is not None \
+            and (zlib.crc32(mraw) & 0xFFFFFFFF) != want:
+        raise CheckpointCorruption(
+            "checkpoint manifest in %r fails its CRC32" % dirname)
+    try:
+        manifest = json.loads(mraw.decode("utf-8"))
+    except ValueError as e:
+        raise CheckpointCorruption("checkpoint manifest in %r unreadable: "
+                                   "%r" % (dirname, e))
     wanted = {v.name for v in program.list_vars() if v.persistable}
+    staged = {}
     for name, e in manifest["vars"].items():
         if name not in wanted:
             continue
         arr = np.zeros(tuple(e["shape"]), dtype=np.dtype(e["dtype"]))
         for sh in e["files"]:
-            data = np.load(os.path.join(dirname, sh["file"]))
+            data = _read_shard(dirname, sh, verify)
             sl = tuple(slice(a, b) for a, b in sh["index"])
-            arr[sl] = data
+            try:
+                arr[sl] = data
+            except (ValueError, TypeError) as err:
+                raise CheckpointCorruption(
+                    "checkpoint shard %s has wrong shape/dtype: %r"
+                    % (sh["file"], err))
+        staged[name] = arr
+    for name, arr in staged.items():
         if dist_context is not None:
             val = jax.device_put(arr,
                                  dist_context.sharding_for(name, arr))
@@ -219,3 +388,61 @@ def load_checkpoint(dirname, main_program=None, scope=None,
             val = jax.numpy.asarray(arr)
         scope.set_var(name, val)
     return manifest.get("step")
+
+
+def load_checkpoint(dirname, main_program=None, scope=None,
+                    dist_context=None, verify=True, fallback=True):
+    """Reassemble arrays from the manifest and install them in ``scope``,
+    sharded per ``dist_context`` when given (may differ from the saving
+    mesh). Returns the manifest's step.
+
+    Every shard's CRC32 is verified (``verify=False`` skips it). On
+    corruption, with ``fallback=True``, the newest older COMPLETE
+    sibling checkpoint is loaded instead — transparently, walking back
+    as far as the retention window reaches — and a
+    ``checkpoint_fallback`` resilience event records the substitution.
+    With no fallback available (or ``fallback=False``)
+    ``CheckpointCorruption`` propagates."""
+    from .core import ir
+
+    program = main_program or ir.default_main_program()
+    scope = scope or global_scope()
+    return _load_with_fallback(dirname, program, scope, dist_context,
+                               verify, fallback)[1]
+
+
+def _load_with_fallback(dirname, program, scope, dist_context, verify,
+                        fallback):
+    """-> (dirname_actually_loaded, step), walking back through the
+    retention history on corruption when ``fallback`` is set."""
+    while True:
+        try:
+            step = _load_one(dirname, program, scope, dist_context, verify)
+            return dirname, step
+        except CheckpointCorruption as e:
+            if not fallback:
+                raise
+            prev = _previous_complete(dirname)
+            if prev is None:
+                raise
+            record_event("checkpoint_fallback", site="checkpoint.load",
+                         bad=os.path.abspath(dirname), used=prev,
+                         error=str(e))
+            warnings.warn("checkpoint %s is corrupt (%s); falling back to "
+                          "%s" % (dirname, e, prev))
+            dirname = prev
+
+
+def load_latest(root, main_program=None, scope=None, dist_context=None):
+    """Load the newest loadable COMPLETE checkpoint under ``root`` (the
+    retention layout ``save_checkpoint(keep_last=)`` writes), falling
+    back past corrupt ones. Returns (dirname_actually_loaded, step) or
+    None when the root holds no complete checkpoint."""
+    from .core import ir
+
+    newest = latest_checkpoint(root)
+    if newest is None:
+        return None
+    program = main_program or ir.default_main_program()
+    return _load_with_fallback(newest, program, scope or global_scope(),
+                               dist_context, True, True)

@@ -59,18 +59,42 @@ def md5file(fname):
     return hash_md5.hexdigest()
 
 
-def download(url, module_name, md5sum):
+def download(url, module_name, md5sum, retry_policy=None):
     """reference: v2/dataset/common.py download — here: cache-lookup only
-    (zero egress); raises with a clear message if the file is absent."""
+    (zero egress); raises with a clear message if the file is absent.
+
+    The lookup runs under a RetryPolicy (the resilience layer): on a
+    cluster the cache dir is synced out of band, so a file that is
+    missing or md5-torn NOW may be complete on the next attempt. The
+    default budget retries ``PADDLE_TPU_DOWNLOAD_RETRIES`` times
+    (default 1 = the old single-shot behavior); pass ``retry_policy``
+    for full control. Each attempt crosses the ``dataset.download``
+    fault site."""
+    from ..resilience import RetryPolicy, RetryError, fault_point
+
     dirname = os.path.join(DATA_HOME, module_name)
     filename = os.path.join(dirname, url.split("/")[-1])
-    if os.path.exists(filename) and (not md5sum
-                                     or md5file(filename) == md5sum):
-        return filename
-    raise RuntimeError(
-        "dataset file %s is not cached and this environment has no network "
-        "access; place the file under %s or use the synthetic reader "
-        "(the default)" % (url, dirname))
+
+    def attempt():
+        fault_point("dataset.download")
+        if os.path.exists(filename) and (not md5sum
+                                         or md5file(filename) == md5sum):
+            return filename
+        raise RuntimeError(
+            "dataset file %s is not cached and this environment has no "
+            "network access; place the file under %s or use the synthetic "
+            "reader (the default)" % (url, dirname))
+
+    if retry_policy is None:
+        attempts = max(int(os.environ.get("PADDLE_TPU_DOWNLOAD_RETRIES",
+                                          "1")), 1)
+        retry_policy = RetryPolicy(max_attempts=attempts, backoff=0.5,
+                                   multiplier=2.0, max_backoff=10.0,
+                                   name="dataset.download")
+    try:
+        return retry_policy.call(attempt)
+    except RetryError as e:
+        raise e.last
 
 
 def seeded_rng(name):

@@ -13,6 +13,8 @@ import os
 import subprocess
 import threading
 
+from ..resilience import fault_point
+
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libpaddle_tpu_native.so")
@@ -180,6 +182,9 @@ class Reader(object):
         return self
 
     def __next__(self):
+        # resilience fault site: chaos tests drop/delay/corrupt records
+        # here without touching the native layer (disarmed: one dict get)
+        fault_point("reader.next")
         p = ctypes.POINTER(ctypes.c_uint8)()
         n = self._lib.rio_reader_next(self._h, ctypes.byref(p))
         if n == -1:

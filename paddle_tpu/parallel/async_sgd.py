@@ -48,6 +48,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..resilience import RetryError, RetryPolicy, fault_point, record_event
+
 __all__ = ["AsyncParameterServer", "AsyncSGDUpdater", "build_grad_program",
            "SparseRows"]
 
@@ -110,6 +112,14 @@ def _recv_msg(sock):
 
 
 class _Handler(socketserver.BaseRequestHandler):
+    def setup(self):
+        with self.server.conns_lock:
+            self.server.conns.add(self.request)
+
+    def finish(self):
+        with self.server.conns_lock:
+            self.server.conns.discard(self.request)
+
     def handle(self):
         srv = self.server.owner
         try:
@@ -135,6 +145,14 @@ class _Handler(socketserver.BaseRequestHandler):
 class _TCPServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        # live client connections, so stop() can sever them the way a
+        # killed pserver process would (handler threads otherwise keep
+        # serving open sockets after shutdown())
+        self.conns = set()
+        self.conns_lock = threading.Lock()
 
 
 class AsyncParameterServer(object):
@@ -185,6 +203,20 @@ class AsyncParameterServer(object):
     def stop(self):
         self._srv.shutdown()
         self._srv.server_close()
+        # sever live connections too: stop() models pserver DEATH, and a
+        # dead process drops its TCP — clients must see a reset, not a
+        # zombie handler thread happily serving on
+        with self._srv.conns_lock:
+            conns = list(self._srv.conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
         if self._thread is not None:
             self._thread.join(timeout=5)
 
@@ -281,40 +313,114 @@ class AsyncParameterServer(object):
 class AsyncSGDUpdater(object):
     """Trainer-side client (reference RemoteParameterUpdater role): pull
     the newest parameters into the scope, run the compiled grad program,
-    push the gradients — no barrier with other workers."""
+    push the gradients — no barrier with other workers.
 
-    def __init__(self, address, worker_id=0, timeout=180.0):
+    Failure semantics (the resilience layer): every RPC attempt redials
+    a broken connection under ``retry_policy`` — bounded reconnect with
+    exponential backoff, never a hang. When the budget is exhausted and
+    ``degraded_ok`` is set (the default), the worker CONTINUES in
+    degraded mode instead of crashing: ``pull`` serves the last
+    successfully pulled parameters (frozen-parameter local training, the
+    reference trainer's behavior when its pserver link drops and the job
+    manager hasn't killed it yet) and ``push`` drops the gradient. Every
+    degradation is counted (``degraded_steps``, ``dropped_pushes``) and
+    recorded as a ``degraded`` resilience event; the first successful
+    RPC afterwards clears ``degraded``."""
+
+    def __init__(self, address, worker_id=0, timeout=180.0,
+                 retry_policy=None, degraded_ok=True):
         # the socket deadline must comfortably exceed the server's
         # pull_timeout (default 60s): if the client gave up first, the
         # server's late reply would stay queued and desync every
         # subsequent request on this connection
         self._addr = tuple(address)
         self.worker_id = worker_id
-        self._sock = socket.create_connection(self._addr, timeout=timeout)
+        self._timeout = timeout
+        # EOFError: pickle hits a peer that died mid-reply; OSError
+        # covers ConnectionError + socket.timeout + refused redials.
+        # max_elapsed bounds the whole RPC even when a partitioned
+        # network blackholes the dial (no RST -> each connect burns its
+        # full connect timeout, not an instant refusal)
+        self._retry = retry_policy or RetryPolicy(
+            max_attempts=4, backoff=0.25, multiplier=2.0, max_backoff=2.0,
+            jitter=0.1, max_elapsed=90.0, retry_on=(OSError, EOFError),
+            name="async_sgd.rpc")
+        self._degraded_ok = degraded_ok
+        self._sock = None
+        self._last_params = None     # last FULL pull, for degraded serves
+        self._last_version = None
+        self.degraded = False        # currently cut off from the pserver
+        self.degraded_steps = 0      # pulls served from the local cache
+        self.dropped_pushes = 0      # grads dropped while cut off
 
-    def _rpc(self, msg):
-        try:
-            _send_msg(self._sock, msg)
-            rep = _recv_msg(self._sock)
-        except Exception:
-            # a timed-out/broken exchange leaves an unconsumed reply in
-            # flight — the connection is unusable, don't let the next
-            # call read a stale response as its own
-            self._sock.close()
-            raise
-        if "error" in rep:
-            raise RuntimeError(rep["error"])
+    def _close_sock(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def _rpc(self, msg, site):
+        """One exchange under the retry budget; reconnects between
+        attempts. Server-side semantic errors (staleness-gate timeout,
+        bad names) raise RuntimeError and are NOT retried."""
+        def attempt():
+            fault_point(site)
+            if self._sock is None:
+                # dial with a short deadline (a healthy pserver accepts
+                # in milliseconds; only a blackholed one takes longer),
+                # then widen to the RPC timeout for the exchange itself
+                # (a staleness-gated pull legitimately blocks a while)
+                self._sock = socket.create_connection(
+                    self._addr, timeout=min(self._timeout, 10.0))
+                self._sock.settimeout(self._timeout)
+            try:
+                _send_msg(self._sock, msg)
+                rep = _recv_msg(self._sock)
+            except Exception:
+                # a timed-out/broken exchange leaves an unconsumed reply
+                # in flight — the connection is unusable, don't let the
+                # next call read a stale response as its own
+                self._close_sock()
+                raise
+            if "error" in rep:
+                raise RuntimeError(rep["error"])
+            return rep
+
+        rep = self._retry.call(attempt)
+        self.degraded = False
         return rep
 
     def pull(self, step=0, sparse_rows=None):
         """``sparse_rows``: {param_name: row ids} — those tables come
         back as SparseRows slices instead of full matrices (the
-        large-model prefetch path)."""
+        large-model prefetch path). With the pserver unreachable past
+        the retry budget, serves the last full pull instead (degraded
+        mode, recorded)."""
         msg = {"op": "pull", "worker": self.worker_id, "step": step}
         if sparse_rows is not None:
             msg["sparse_rows"] = {k: np.asarray(v, np.int64).reshape(-1)
                                   for k, v in sparse_rows.items()}
-        rep = self._rpc(msg)
+        try:
+            rep = self._rpc(msg, "async_sgd.pull_params")
+        except RetryError as e:
+            if not self._degraded_ok or self._last_params is None:
+                raise
+            self.degraded = True
+            self.degraded_steps += 1
+            record_event("degraded", site="async_sgd.pull_params",
+                         worker=self.worker_id, step=step,
+                         served="cached_params", error=repr(e.last))
+            return self._last_version, {k: v.copy() for k, v
+                                        in self._last_params.items()}
+        if sparse_rows is None:
+            # only full pulls are cacheable: a row-subset pull would
+            # freeze every OTHER row at whatever the cache held. The
+            # arrays are freshly unpickled from this reply, so holding
+            # references costs nothing per step; the degraded serve path
+            # copies on the way out
+            self._last_params = rep["params"]
+            self._last_version = rep["version"]
         return rep["version"], rep["params"]
 
     def pull_into(self, scope, step=0, sparse_rows=None):
@@ -331,18 +437,35 @@ class AsyncSGDUpdater(object):
         return version
 
     def push(self, grads, step):
+        """Push gradients; with the pserver unreachable past the retry
+        budget the gradient is DROPPED (recorded) rather than blocking
+        training — async SGD tolerates lost updates by design, the same
+        reason the reference caps rather than queues lagged gradients."""
         grads = {k: _to_wire_grad(v) for k, v in grads.items()}
-        rep = self._rpc({"op": "push", "worker": self.worker_id,
-                         "step": step, "grads": grads})
+        try:
+            rep = self._rpc({"op": "push", "worker": self.worker_id,
+                             "step": step, "grads": grads},
+                            "async_sgd.push_grads")
+        except RetryError as e:
+            if not self._degraded_ok:
+                raise
+            self.degraded = True
+            self.dropped_pushes += 1
+            record_event("degraded", site="async_sgd.push_grads",
+                         worker=self.worker_id, step=step,
+                         served="dropped_push", error=repr(e.last))
+            return self._last_version
         return rep["version"]
 
     def close(self):
+        if self._sock is None:
+            return
         try:
             _send_msg(self._sock, {"op": "bye"})
             _recv_msg(self._sock)
         except Exception:
             pass
-        self._sock.close()
+        self._close_sock()
 
 
 def build_grad_program(loss, parameter_list=None):

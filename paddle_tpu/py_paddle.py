@@ -452,6 +452,16 @@ class GradientMachine(object):
                 self._param_grads = append_backward(cost)
             self._grads_appended = True
 
+    @staticmethod
+    def _dense_grad(v):
+        """Fetched gradient -> dense ndarray. Sparse-embedding models
+        fetch SelectedRowsVal gradients; np.asarray on those would store
+        a 0-d object array, poisoning every getParamGrad consumer."""
+        from .ops.selected_rows import SelectedRowsVal
+        if isinstance(v, SelectedRowsVal):
+            v = v.to_dense()
+        return np.asarray(v)
+
     def forwardBackward(self, in_args, out_args, pass_type=None,
                         callback=None):
         """forward + backward: parameter gradients are computed against
@@ -470,7 +480,7 @@ class GradientMachine(object):
                              scope=self._scope)
         n = len(self._outputs)
         self._last_outs = [np.asarray(v) for v in vals[:n]]
-        self._grads = {p.name: np.asarray(v) for (p, _g), v in
+        self._grads = {p.name: self._dense_grad(v) for (p, _g), v in
                        zip(self._param_grads, vals[n:])}
         out = self._fill_out_args(out_args, vals[:n])
         if callback is not None:
@@ -488,7 +498,7 @@ class GradientMachine(object):
         grad_vars = [g for _p, g in self._param_grads]
         vals = self._exe.run(self._main, feed=self._last_feed,
                              fetch_list=grad_vars, scope=self._scope)
-        self._grads = {p.name: np.asarray(v) for (p, _g), v in
+        self._grads = {p.name: self._dense_grad(v) for (p, _g), v in
                        zip(self._param_grads, vals)}
         if callback is not None:
             for p in self._parameters():

@@ -1,0 +1,40 @@
+"""Framework-wide fault tolerance: retry budgets, fault injection,
+degraded-mode records.
+
+The reference's distributed story is fault tolerance end to end — the Go
+master leases RecordIO chunks with timeouts/failure caps and snapshots to
+etcd, the pserver checkpoints and re-registers, trainers redial — and
+this package is that posture rebuilt as one subsystem (HiCCL, arxiv
+2408.05962, argues the same: coordination layers deserve explicit
+failure semantics, not scattered try/excepts):
+
+- :mod:`.retry` — ``RetryPolicy``: the declared budget every
+  cross-host/cross-process edge spends (device probes in bench.py,
+  dataset cache lookups, pserver RPC).
+- :mod:`.faults` — deterministic injection registry; tests and the
+  ``PADDLE_TPU_FAULT_SPEC`` env var arm named sites to raise, delay, or
+  corrupt at the Nth hit.
+- :mod:`.events` — the process-local record of every degradation, so
+  "it kept going" is auditable.
+
+Consumers elsewhere in the package: checkpoint.py (CRC + fallback to the
+previous complete checkpoint), trainer.py (SIGTERM preemption
+checkpoint), parallel/async_sgd.py (bounded reconnect, then recorded
+degraded continuation), paddle_tpu.native.Reader (reader.next site),
+dataset/common.py, and bench.py's device-init probe.
+"""
+from .events import record_event, events, clear_events  # noqa: F401
+from .retry import (  # noqa: F401
+    RetryPolicy, RetryError, AttemptTimeout, retry,
+)
+from .faults import (  # noqa: F401
+    FaultError, arm, disarm, reset, hits, armed, fault_point,
+    parse_fault_spec, load_fault_spec,
+)
+
+__all__ = [
+    "record_event", "events", "clear_events",
+    "RetryPolicy", "RetryError", "AttemptTimeout", "retry",
+    "FaultError", "arm", "disarm", "reset", "hits", "armed",
+    "fault_point", "parse_fault_spec", "load_fault_spec",
+]

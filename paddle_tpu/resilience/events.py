@@ -1,0 +1,52 @@
+"""Structured resilience event log.
+
+Every degraded-mode continuation, retry exhaustion, checkpoint fallback,
+and preemption checkpoint is RECORDED here, process-locally — the
+reference's job-event trail (the Go master logging task requeues and the
+pserver logging re-registrations) without an etcd to write to. Tests and
+operators read it to prove a failure was handled rather than swallowed:
+"no hang, no crash" is only trustworthy when the degradation left a
+record.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+__all__ = ["record_event", "events", "clear_events"]
+
+# bounded: a multi-day outage records several events per step, and the
+# audit trail must not become its own resource leak — oldest drop first
+_MAX_EVENTS = 10_000
+
+_lock = threading.Lock()
+_events = collections.deque(maxlen=_MAX_EVENTS)
+
+
+def record_event(kind, site=None, **info):
+    """Append one event. ``kind`` is a short machine-readable tag
+    ('retry_exhausted', 'degraded', 'checkpoint_fallback',
+    'preempt_checkpoint', ...); ``site`` names the code location in the
+    fault-registry naming scheme ('async_sgd.push_grads')."""
+    ev = {"kind": kind, "site": site, "time": time.time()}
+    ev.update(info)
+    with _lock:
+        _events.append(ev)
+    return ev
+
+
+def events(kind=None, site=None):
+    """Snapshot of recorded events, optionally filtered."""
+    with _lock:
+        out = list(_events)
+    if kind is not None:
+        out = [e for e in out if e["kind"] == kind]
+    if site is not None:
+        out = [e for e in out if e["site"] == site]
+    return out
+
+
+def clear_events():
+    with _lock:
+        _events.clear()

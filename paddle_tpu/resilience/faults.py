@@ -1,0 +1,266 @@
+"""Deterministic fault injection, keyed by site name.
+
+The reference proves its fault tolerance by killing things: the Go
+master's tests drop workers mid-lease and watch the chunk requeue
+(go/master/service_internal_test.go role), and paddle_tpu already does
+that ad hoc for the native task master. This module makes the technique
+a first-class, *declarative* surface: production code calls
+``fault_point("site.name", payload)`` at its failure-relevant edges, and
+tests — or an operator chaos-testing a cluster via the
+``PADDLE_TPU_FAULT_SPEC`` env var — arm a site to raise, delay, or
+corrupt at the Nth hit. Disarmed sites cost one dict lookup.
+
+Instrumented sites (grow this list with the codebase):
+
+========================  ====================================================
+site                      where
+========================  ====================================================
+``checkpoint.write``      every shard/manifest byte-blob before it hits disk
+                          (corrupt-able: models bit-rot AFTER the CRC was
+                          computed)
+``checkpoint.load``       each shard read back (raise/delay)
+``async_sgd.push_grads``  trainer->pserver gradient push, per RPC attempt
+``async_sgd.pull_params`` pserver->trainer parameter pull, per RPC attempt
+``reader.next``           each record out of the native recordio reader
+``dataset.download``      each dataset cache-lookup attempt
+========================  ====================================================
+
+Spec grammar (env var or ``load_fault_spec`` string)::
+
+    site:action[:key=value[,key=value...]][;site:action[...]]...
+
+    action  = raise | delay | corrupt
+    nth     = 1-based hit that triggers (default 1); '*' = every hit
+    times   = how many consecutive hits fire (default 1); '*' = unbounded
+    delay   = seconds (delay action)
+    exc     = exception class name from builtins (raise action;
+              default FaultError)
+    message = exception text (raise action; '_' stands for space)
+    seed    = corruption determinism seed (corrupt action)
+
+e.g. ``PADDLE_TPU_FAULT_SPEC="checkpoint.write:corrupt:nth=2,seed=7;``
+``async_sgd.push_grads:raise:nth=1,times=2,exc=ConnectionError"``.
+
+Hit counting starts when a site is armed (disarmed sites are not
+counted — the fast path must stay a lookup). All mutation is
+lock-protected; ``fault_point`` itself is thread-safe.
+"""
+from __future__ import annotations
+
+import builtins
+import random
+import threading
+import time
+
+from .events import record_event
+
+__all__ = ["FaultError", "arm", "disarm", "reset", "hits", "armed",
+           "fault_point", "parse_fault_spec", "load_fault_spec"]
+
+_ENV_VAR = "PADDLE_TPU_FAULT_SPEC"
+_ACTIONS = ("raise", "delay", "corrupt")
+
+
+class FaultError(RuntimeError):
+    """Default exception an armed 'raise' site throws."""
+
+
+class _Fault(object):
+    __slots__ = ("site", "action", "nth", "times", "delay", "message",
+                 "exc", "seed", "hits", "fired")
+
+    def __init__(self, site, action, nth, times, delay, message, exc, seed):
+        self.site = site
+        self.action = action
+        self.nth = nth          # 1-based first firing hit
+        self.times = times      # None = unbounded window
+        self.delay = delay
+        self.message = message
+        self.exc = exc
+        self.seed = seed
+        self.hits = 0           # counted from arming time
+        self.fired = 0
+
+    def should_fire(self):
+        if self.hits < self.nth:
+            return False
+        return self.times is None or self.hits < self.nth + self.times
+
+
+_lock = threading.Lock()
+_faults = {}          # site -> _Fault
+_env_loaded = False
+
+
+def arm(site, action="raise", nth=1, times=1, delay=0.0, message=None,
+        exc=None, seed=0):
+    """Arm ``site``. The fault fires on hits ``nth .. nth+times-1``
+    (1-based, counted from now); ``times=None`` keeps firing forever."""
+    if action not in _ACTIONS:
+        raise ValueError("action must be one of %r" % (_ACTIONS,))
+    if nth < 1:
+        raise ValueError("nth is 1-based")
+    if exc is not None and not (isinstance(exc, type)
+                                and issubclass(exc, BaseException)):
+        raise ValueError("exc must be an exception class")
+    f = _Fault(site, action, int(nth),
+               None if times is None else int(times),
+               float(delay), message, exc or FaultError, int(seed))
+    with _lock:
+        _faults[site] = f
+    return f
+
+
+def disarm(site):
+    with _lock:
+        return _faults.pop(site, None) is not None
+
+
+def reset():
+    """Disarm everything and forget counters (test teardown)."""
+    with _lock:
+        _faults.clear()
+
+
+def hits(site):
+    """Hits at ``site`` since arming (0 if not armed)."""
+    with _lock:
+        f = _faults.get(site)
+        return f.hits if f else 0
+
+
+def armed():
+    """Snapshot {site: action} of armed faults."""
+    with _lock:
+        return {s: f.action for s, f in _faults.items()}
+
+
+def _corrupt_bytes(data, rng):
+    """Flip a deterministic handful of bytes — enough to break any CRC,
+    few enough to keep sizes identical (a torn-size fault is the
+    _COMPLETE marker's job, not this one's)."""
+    buf = bytearray(data)
+    if not buf:
+        return bytes(buf)
+    for _ in range(min(8, len(buf))):
+        buf[rng.randrange(len(buf))] ^= 0xFF
+    return bytes(buf)
+
+
+def fault_point(site, payload=None):
+    """Declare a failure-relevant edge. Returns ``payload`` (possibly
+    corrupted); raises/delays when the site is armed and the hit count is
+    inside the firing window. Disarmed cost: one dict lookup."""
+    _load_env_once()
+    with _lock:
+        f = _faults.get(site)
+        if f is None:
+            return payload
+        f.hits += 1
+        if not f.should_fire():
+            return payload
+        f.fired += 1
+        action, fired = f.action, f.fired
+    record_event("fault_injected", site=site, action=action, hit=fired)
+    if action == "raise":
+        raise f.exc(f.message or
+                    "injected fault at %r (hit %d)" % (site, f.hits))
+    if action == "delay":
+        time.sleep(f.delay)
+        return payload
+    # corrupt: only byte-like payloads carry data to damage; a site that
+    # passes nothing just counts the hit
+    if payload is None:
+        return payload
+    rng = random.Random((f.seed, f.fired))
+    if isinstance(payload, (bytes, bytearray)):
+        return _corrupt_bytes(payload, rng)
+    try:
+        import numpy as np
+        if isinstance(payload, np.ndarray):
+            flat = np.frombuffer(_corrupt_bytes(payload.tobytes(), rng),
+                                 dtype=payload.dtype)
+            return flat.reshape(payload.shape)
+    except ImportError:                                 # pragma: no cover
+        pass
+    raise TypeError("cannot corrupt payload of type %s at %r"
+                    % (type(payload).__name__, site))
+
+
+# -- spec parsing -------------------------------------------------------------
+
+def parse_fault_spec(spec):
+    """Parse the grammar into a list of ``arm()`` kwarg dicts (pure
+    function; raises ValueError with the offending entry on bad input)."""
+    out = []
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":", 2)
+        if len(parts) < 2:
+            raise ValueError("bad fault entry %r (want site:action[:kv])"
+                             % entry)
+        site, action = parts[0].strip(), parts[1].strip()
+        if action not in _ACTIONS:
+            raise ValueError("bad action %r in %r" % (action, entry))
+        kw = {"site": site, "action": action}
+        if len(parts) == 3 and parts[2].strip():
+            for pair in parts[2].split(","):
+                if "=" not in pair:
+                    raise ValueError("bad key=value %r in %r"
+                                     % (pair, entry))
+                k, v = (s.strip() for s in pair.split("=", 1))
+                if k == "nth":
+                    if v == "*":
+                        kw["nth"], kw["times"] = 1, None
+                    else:
+                        kw["nth"] = int(v)
+                elif k == "times":
+                    kw["times"] = None if v == "*" else int(v)
+                elif k == "delay":
+                    kw["delay"] = float(v)
+                elif k == "seed":
+                    kw["seed"] = int(v)
+                elif k == "message":
+                    kw["message"] = v.replace("_", " ")
+                elif k == "exc":
+                    e = getattr(builtins, v, None)
+                    if not (isinstance(e, type)
+                            and issubclass(e, BaseException)):
+                        raise ValueError("exc %r is not a builtin "
+                                         "exception (in %r)" % (v, entry))
+                    kw["exc"] = e
+                else:
+                    raise ValueError("unknown key %r in %r" % (k, entry))
+        out.append(kw)
+    return out
+
+
+def load_fault_spec(spec=None):
+    """Arm every entry of ``spec`` (default: the ``PADDLE_TPU_FAULT_SPEC``
+    env var). Returns the number of sites armed."""
+    import os
+    if spec is None:
+        spec = os.environ.get(_ENV_VAR, "")
+    entries = parse_fault_spec(spec)
+    for kw in entries:
+        arm(**kw)
+    return len(entries)
+
+
+def _load_env_once():
+    """First fault_point arms the env spec, so chaos runs need no code
+    change — exactly how the reference reads gflags at process start."""
+    global _env_loaded
+    if _env_loaded:
+        return
+    with _lock:
+        if _env_loaded:
+            return
+        _env_loaded = True
+    try:
+        load_fault_spec()
+    except ValueError as e:                              # pragma: no cover
+        import warnings
+        warnings.warn("ignoring malformed %s: %s" % (_ENV_VAR, e))

@@ -8,6 +8,8 @@ paddle/trainer/ParamUtil.cpp.
 from __future__ import annotations
 
 import os
+import signal
+import threading
 import time
 
 import numpy as np
@@ -69,6 +71,9 @@ class Trainer(object):
         self.fetch_list = [cost] + list(fetch_list or [])
         self.checkpoint_dir = checkpoint_dir
         self._initialized = False
+        # set by the SIGTERM preemption hook; train() drains the current
+        # batch, writes a final synchronous checkpoint, and returns
+        self.preempted = False
 
     def _maybe_init(self):
         if self._initialized:
@@ -84,11 +89,57 @@ class Trainer(object):
                     self.checkpoint_dir, self.main_program,
                     dist_context=self.exe.dist_context)
             else:
-                # resume = load persistables (optimizer accumulators
-                # included; reference: io.py save_persistables semantics)
-                _io.load_persistables(self.exe, self.checkpoint_dir,
-                                      main_program=self.main_program)
+                newest = _ckpt.latest_checkpoint(self.checkpoint_dir)
+                files = [os.path.join(self.checkpoint_dir, f)
+                         for f in os.listdir(self.checkpoint_dir)
+                         if os.path.isfile(os.path.join(
+                             self.checkpoint_dir, f))]
+                if newest is not None and (
+                        not files or os.path.getmtime(newest)
+                        >= max(os.path.getmtime(f) for f in files)):
+                    # retention root (save_checkpoint(keep_last=)):
+                    # newest complete checkpoint, falling back past
+                    # corrupt ones. Newest-wins vs the persistables
+                    # files this trainer itself writes (per-pass +
+                    # preemption saves land in the root as flat files):
+                    # a preemption checkpoint must not lose to an older
+                    # retained dir on resume
+                    _ckpt.load_latest(self.checkpoint_dir,
+                                      self.main_program,
+                                      dist_context=self.exe.dist_context)
+                else:
+                    # resume = load persistables (optimizer accumulators
+                    # included; reference: io.py save_persistables
+                    # semantics)
+                    _io.load_persistables(self.exe, self.checkpoint_dir,
+                                          main_program=self.main_program)
         self._initialized = True
+
+    def _install_preemption_hook(self):
+        """SIGTERM -> preempted flag; the training loop turns it into a
+        final synchronous checkpoint (the k8s/TPU-maintenance preemption
+        contract: the grace window is for draining one batch and writing
+        state, reference role: the pserver's crash-safe checkpoint +
+        re-register dance). Only the main thread may own signal
+        handlers; elsewhere the hook is a no-op. Returns (installed,
+        previous_handler)."""
+        if threading.current_thread() is not threading.main_thread():
+            return False, None
+
+        def on_sigterm(signum, frame):
+            self.preempted = True
+
+        try:
+            return True, signal.signal(signal.SIGTERM, on_sigterm)
+        except ValueError:          # embedded interpreters
+            return False, None
+
+    def _preempt_checkpoint(self, pass_id, batch_id):
+        from .resilience import record_event
+        self.save_checkpoint()
+        record_event("preempt_checkpoint", site="trainer.train",
+                     dirname=self.checkpoint_dir, pass_id=pass_id,
+                     batch_id=batch_id)
 
     def train(self, reader, num_passes=1, event_handler=None):
         self._maybe_init()
@@ -96,31 +147,48 @@ class Trainer(object):
         from .flags import FLAGS
         handler = event_handler or (lambda e: None)
         log_period = FLAGS.log_period
-        for pass_id in range(num_passes):
-            handler(BeginPass(pass_id))
-            costs = []
-            with _prof.timer("pass"):
-                for batch_id, data in enumerate(reader()):
-                    handler(BeginIteration(pass_id, batch_id))
-                    with _prof.timer("batch"):
-                        outs = self.exe.run(self.main_program,
-                                            feed=self.feeder.feed(data),
-                                            fetch_list=self.fetch_list)
-                    cost = float(np.asarray(outs[0]).reshape(-1)[0])
-                    costs.append(cost)
-                    if log_period and (batch_id + 1) % log_period == 0:
-                        # the reference's per-log_period batch line
-                        # (reference: TrainerInternal.cpp:159-171)
-                        print("pass %d batch %d: cost=%.6f (avg %.6f)"
-                              % (pass_id, batch_id, cost,
-                                 float(np.mean(costs[-log_period:]))))
-                    handler(EndIteration(pass_id, batch_id, cost,
-                                         {"fetches": outs[1:]}))
-            if self.checkpoint_dir:
-                self.save_checkpoint()
-            handler(EndPass(pass_id,
-                            {"avg_cost": float(np.mean(costs))
-                             if costs else float("nan")}))
+        # a fresh train() gets a fresh preemption state: the flag from a
+        # previous preempted run must not end this one after one batch
+        self.preempted = False
+        old_sigterm = None
+        hook_installed = False
+        if self.checkpoint_dir:
+            hook_installed, old_sigterm = self._install_preemption_hook()
+        try:
+            for pass_id in range(num_passes):
+                handler(BeginPass(pass_id))
+                costs = []
+                batch_id = -1
+                with _prof.timer("pass"):
+                    for batch_id, data in enumerate(reader()):
+                        handler(BeginIteration(pass_id, batch_id))
+                        with _prof.timer("batch"):
+                            outs = self.exe.run(self.main_program,
+                                                feed=self.feeder.feed(data),
+                                                fetch_list=self.fetch_list)
+                        cost = float(np.asarray(outs[0]).reshape(-1)[0])
+                        costs.append(cost)
+                        if log_period and (batch_id + 1) % log_period == 0:
+                            # the reference's per-log_period batch line
+                            # (reference: TrainerInternal.cpp:159-171)
+                            print("pass %d batch %d: cost=%.6f (avg %.6f)"
+                                  % (pass_id, batch_id, cost,
+                                     float(np.mean(costs[-log_period:]))))
+                        handler(EndIteration(pass_id, batch_id, cost,
+                                             {"fetches": outs[1:]}))
+                        if self.preempted:
+                            break
+                if self.preempted and self.checkpoint_dir:
+                    self._preempt_checkpoint(pass_id, batch_id)
+                    return
+                if self.checkpoint_dir:
+                    self.save_checkpoint()
+                handler(EndPass(pass_id,
+                                {"avg_cost": float(np.mean(costs))
+                                 if costs else float("nan")}))
+        finally:
+            if hook_installed:
+                signal.signal(signal.SIGTERM, old_sigterm)
 
     def _test_program(self, fetches):
         """Pruned for-test clone: drops backward + optimizer ops so

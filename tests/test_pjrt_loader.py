@@ -86,6 +86,71 @@ def test_loader_symbols_and_error_paths(tmp_path):
     assert b"init" in lib.ptpu_pjrt_last_error()
 
 
+def test_signature_parse_excludes_outputs(tmp_path):
+    """The parser must bound its scan at the "outputs" key: before the
+    fix it swallowed output specs into the args array as kind="" entries
+    (inflated num_args + OOB reads on every forward)."""
+    so = _build_loader()
+    lib = ctypes.CDLL(so)
+    lib.ptpu_pjrt_sig_parse.restype = ctypes.c_int
+    lib.ptpu_pjrt_sig_parse.argtypes = [ctypes.c_char_p,
+                                        ctypes.POINTER(ctypes.c_int),
+                                        ctypes.POINTER(ctypes.c_int)]
+
+    def parse(sig):
+        n_params, n_feeds = ctypes.c_int(-9), ctypes.c_int(-9)
+        total = lib.ptpu_pjrt_sig_parse(json.dumps(sig).encode(),
+                                        ctypes.byref(n_params),
+                                        ctypes.byref(n_feeds))
+        return total, n_params.value, n_feeds.value
+
+    # adversarial hand-built signature: 2 params + 1 feed + 2 outputs
+    sig = {
+        "arg_order": "params_then_feeds",
+        "args": [
+            {"name": "w", "dtype": "float32", "shape": [4, 3],
+             "offset": 0, "nbytes": 48, "kind": "param"},
+            {"name": "b", "dtype": "float32", "shape": [3],
+             "offset": 48, "nbytes": 12, "kind": "param"},
+            {"name": "x", "dtype": "float32", "shape": [2, 4],
+             "kind": "feed"},
+        ],
+        "outputs": [
+            {"name": "out0", "dtype": "float32", "shape": [2, 3]},
+            {"name": "out1", "dtype": "int32", "shape": [2]},
+        ],
+    }
+    assert parse(sig) == (3, 2, 1)
+
+    # unknown kinds must not be staged as weights or counted as feeds
+    sig["args"].append({"name": "aux", "dtype": "float32", "shape": [1],
+                        "kind": "scratch"})
+    assert parse(sig) == (3, 2, 1)
+
+    # an ARG literally named "outputs" must not truncate the scan (the
+    # bound is the args array's own ']', not a substring search)
+    sig["args"] = sig["args"][:3] + [
+        {"name": "outputs", "dtype": "float32", "shape": [2],
+         "kind": "feed"}]
+    assert parse(sig) == (4, 2, 2)
+
+    # a REAL exported artifact parses to its own args list
+    d = _export_tiny(tmp_path)
+    real = open(os.path.join(d, "__signature__.json")).read()
+    want = json.loads(real)["args"]
+    n_params, n_feeds = ctypes.c_int(), ctypes.c_int()
+    total = lib.ptpu_pjrt_sig_parse(real.encode(), ctypes.byref(n_params),
+                                    ctypes.byref(n_feeds))
+    assert total == len(want)
+    assert n_params.value == sum(a["kind"] == "param" for a in want)
+    assert n_feeds.value == sum(a["kind"] == "feed" for a in want)
+
+    # malformed input is rejected, not crashed on
+    assert lib.ptpu_pjrt_sig_parse(b"{}", None, None) == -1
+    assert lib.ptpu_pjrt_sig_parse(
+        b'{"args": [], "outputs": []}', None, None) == -1
+
+
 @pytest.mark.skipif(
     os.environ.get("PTPU_PJRT_PLUGIN") is None,
     reason="full execute needs a live PJRT plugin; set PTPU_PJRT_PLUGIN="

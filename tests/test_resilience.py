@@ -1,0 +1,574 @@
+"""Resilience subsystem: retry budgets, fault injection, hardened
+checkpoints, preemption, and async-SGD degraded mode (reference posture:
+the Go master's lease/timeout/failure-cap + etcd snapshots and the
+pserver's checkpoint/re-register, go/master/service.go,
+go/pserver/service.go; HiCCL arxiv 2408.05962 for the
+failure-semantics-as-subsystem framing). All CPU-only and fast."""
+import os
+import signal
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import checkpoint, layers
+from paddle_tpu import resilience as R
+from paddle_tpu.parallel import AsyncParameterServer, AsyncSGDUpdater
+from paddle_tpu.resilience import (AttemptTimeout, FaultError, RetryError,
+                                   RetryPolicy)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    R.reset()
+    R.clear_events()
+    yield
+    R.reset()
+    R.clear_events()
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+def test_retry_succeeds_after_transient_failures():
+    slept = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    p = RetryPolicy(max_attempts=5, backoff=0.1, multiplier=2.0,
+                    jitter=0.0, sleep=slept.append,
+                    retry_on=(ConnectionError,), name="t")
+    assert p.call(flaky) == "ok"
+    assert calls["n"] == 3
+    # deterministic exponential schedule with jitter off
+    assert slept == [0.1, 0.2]
+
+
+def test_retry_backoff_jitter_bounded_and_seeded():
+    p1 = RetryPolicy(backoff=1.0, multiplier=2.0, max_backoff=8.0,
+                     jitter=0.25, seed=7)
+    p2 = RetryPolicy(backoff=1.0, multiplier=2.0, max_backoff=8.0,
+                     jitter=0.25, seed=7)
+    d1 = [p1.delay(a) for a in range(1, 7)]
+    d2 = [p2.delay(a) for a in range(1, 7)]
+    assert d1 == d2  # seeded -> reproducible
+    for a, d in enumerate(d1, start=1):
+        nominal = min(1.0 * 2.0 ** (a - 1), 8.0)
+        assert nominal * 0.75 <= d <= nominal * 1.25
+    assert any(abs(d - min(2.0 ** (a - 1), 8.0)) > 1e-9
+               for a, d in enumerate(d1, start=1))  # jitter actually moves
+
+
+def test_retry_exhaustion_raises_retry_error_with_cause():
+    p = RetryPolicy(max_attempts=3, backoff=0.0,
+                    retry_on=(ConnectionError,), name="edge")
+
+    def dead():
+        raise ConnectionError("still down")
+
+    with pytest.raises(RetryError) as ei:
+        p.call(dead)
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.last, ConnectionError)
+    evs = R.events(kind="retry_exhausted", site="edge")
+    assert len(evs) == 1 and evs[0]["attempts"] == 3
+
+
+def test_retry_allowlist_passes_other_exceptions_through():
+    p = RetryPolicy(max_attempts=5, backoff=0.0,
+                    retry_on=(ConnectionError,))
+    calls = {"n": 0}
+
+    def typo():
+        calls["n"] += 1
+        raise KeyError("bug, not weather")
+
+    with pytest.raises(KeyError):
+        p.call(typo)
+    assert calls["n"] == 1  # no budget spent on a real bug
+
+
+def test_retry_watchdog_times_out_hung_attempt():
+    p = RetryPolicy(max_attempts=2, backoff=0.01, attempt_timeout=0.05,
+                    retry_on=())
+    state = {"n": 0}
+
+    def hangs_once():
+        state["n"] += 1
+        if state["n"] == 1:
+            time.sleep(1.0)  # the wedged C call
+        return state["n"]
+
+    t0 = time.time()
+    assert p.call(hangs_once) == 2
+    assert time.time() - t0 < 0.8  # did not wait out the hang
+    assert isinstance(p.last_attempts[0][0], AttemptTimeout)
+
+
+def test_retry_max_elapsed_caps_total_budget():
+    clock = {"t": 0.0}
+    slept = []
+
+    def sleep(d):
+        slept.append(d)
+        clock["t"] += d
+
+    p = RetryPolicy(max_attempts=100, backoff=10.0, multiplier=1.0,
+                    jitter=0.0, max_elapsed=25.0, sleep=sleep,
+                    clock=lambda: clock["t"], retry_on=(ConnectionError,))
+
+    def dead():
+        raise ConnectionError("down")
+
+    with pytest.raises(RetryError) as ei:
+        p.call(dead)
+    # attempts at t=0,10,20; the sleep to t=30 would exceed 25 -> stop
+    assert ei.value.attempts == 3
+    assert slept == [10.0, 10.0]
+
+
+def test_retry_decorator_form():
+    calls = {"n": 0}
+
+    @R.retry(max_attempts=3, backoff=0.0, retry_on=(ValueError,))
+    def sometimes():
+        calls["n"] += 1
+        if calls["n"] < 2:
+            raise ValueError("warming up")
+        return 42
+
+    assert sometimes() == 42
+    assert calls["n"] == 2
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+# ---------------------------------------------------------------------------
+
+def test_fault_spec_parsing():
+    entries = R.parse_fault_spec(
+        "checkpoint.write:corrupt:nth=2,seed=7;"
+        "async_sgd.push_grads:raise:nth=1,times=2,exc=ConnectionError;"
+        "reader.next:delay:nth=*,delay=0.01;"
+        "dataset.download:raise:message=disk_gone")
+    assert entries[0] == {"site": "checkpoint.write", "action": "corrupt",
+                          "nth": 2, "seed": 7}
+    assert entries[1]["exc"] is ConnectionError
+    assert entries[1]["nth"] == 1 and entries[1]["times"] == 2
+    assert entries[2]["nth"] == 1 and entries[2]["times"] is None
+    assert entries[3]["message"] == "disk gone"
+    for bad in ("justasite", "s:badaction", "s:raise:nth=x",
+                "s:raise:exc=NotAnException", "s:raise:wat=1"):
+        with pytest.raises(ValueError):
+            R.parse_fault_spec(bad)
+
+
+def test_fault_nth_hit_window():
+    R.arm("site.a", action="raise", nth=3, times=2)
+    R.fault_point("site.a")  # 1
+    R.fault_point("site.a")  # 2
+    for _ in range(2):       # 3, 4 fire
+        with pytest.raises(FaultError):
+            R.fault_point("site.a")
+    R.fault_point("site.a")  # 5: window closed
+    assert R.hits("site.a") == 5
+    evs = R.events(kind="fault_injected", site="site.a")
+    assert len(evs) == 2
+
+
+def test_fault_spec_env_arming(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_FAULT_SPEC",
+                       "env.site:raise:nth=1,exc=TimeoutError")
+    assert R.load_fault_spec() == 1
+    assert R.armed() == {"env.site": "raise"}
+    with pytest.raises(TimeoutError):
+        R.fault_point("env.site")
+
+
+def test_fault_corrupt_is_seeded_and_size_preserving():
+    payload = b"checkpoint shard bytes" * 32
+
+    def corrupt_once(seed):
+        R.reset()
+        R.arm("c", action="corrupt", nth=1, seed=seed)
+        return R.fault_point("c", payload)
+
+    a, b, c = corrupt_once(5), corrupt_once(5), corrupt_once(6)
+    assert a == b != c          # deterministic per seed
+    assert a != payload
+    assert len(a) == len(payload)  # CRC's job, not the size check's
+
+
+def test_fault_point_thread_safety_counts_every_hit():
+    R.arm("mt", action="raise", nth=10_000)  # count, never fire
+    n_threads, per = 8, 250
+
+    def spin():
+        for _ in range(per):
+            R.fault_point("mt")
+
+    ts = [threading.Thread(target=spin) for _ in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert R.hits("mt") == n_threads * per
+
+
+def test_reader_next_fault_site(tmp_path):
+    from paddle_tpu import native
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    p = str(tmp_path / "r.rio")
+    with native.Writer(p) as w:
+        for i in range(5):
+            w.write(b"rec%d" % i)
+    R.arm("reader.next", action="raise", nth=3,
+          message="injected reader fault")
+    out = []
+    with pytest.raises(FaultError, match="injected reader fault"):
+        for rec in native.Reader(p):
+            out.append(rec)
+    assert out == [b"rec0", b"rec1"]
+
+
+# ---------------------------------------------------------------------------
+# hardened checkpoints
+# ---------------------------------------------------------------------------
+
+def _ckpt_model():
+    x = layers.data("x", shape=[4], dtype="float32")
+    out = layers.fc(x, size=3, param_attr=pt.ParamAttr(name="rz_w"),
+                    bias_attr=pt.ParamAttr(name="rz_b"))
+    return out
+
+
+def test_checkpoint_corruption_detected_and_fallback(tmp_path):
+    """THE acceptance path: corruption armed on checkpoint.write, load
+    detects the bad CRC and transparently recovers from the previous
+    complete checkpoint, leaving an audit event."""
+    _ckpt_model()
+    main = pt.default_main_program()
+    root = str(tmp_path / "root")
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    scope = pt.global_scope()
+
+    d1 = checkpoint.save_checkpoint(root, main, scope=scope, step=1,
+                                    keep_last=4)
+    w1 = np.asarray(scope.find_var("rz_w")).copy()
+
+    # train a bit, then save a checkpoint whose BYTES rot on the way to
+    # disk (after the CRC was computed — real bit-rot)
+    scope.set_var("rz_w", np.asarray(scope.find_var("rz_w")) + 1.0)
+    R.arm("checkpoint.write", action="corrupt", nth=1, times=1, seed=11)
+    d2 = checkpoint.save_checkpoint(root, main, scope=scope, step=2,
+                                    keep_last=4)
+    R.reset()
+
+    # the corrupt checkpoint IS the newest complete one: sizes match, the
+    # marker exists — only the CRC knows
+    assert checkpoint.latest_checkpoint(root) == d2
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        got = checkpoint.load_latest(root, main, scope=scope)
+    assert got is not None and got[1] == 1  # recovered from step 1
+    assert got[0] == d1  # and reports the dir it ACTUALLY loaded
+    np.testing.assert_allclose(np.asarray(scope.find_var("rz_w")), w1,
+                               rtol=1e-6)
+    evs = R.events(kind="checkpoint_fallback")
+    assert len(evs) == 1
+    assert evs[0]["bad"] == os.path.abspath(d2)
+    assert evs[0]["used"] == os.path.abspath(d1)
+
+    # without fallback the corruption is a loud error, not a silent load
+    with pytest.raises(checkpoint.CheckpointCorruption):
+        checkpoint.load_checkpoint(d2, main, scope=pt.Scope(),
+                                   fallback=False)
+
+
+def test_checkpoint_corrupt_load_does_not_half_install(tmp_path):
+    """A corrupt shard must leave the scope untouched (staged install)."""
+    _ckpt_model()
+    main = pt.default_main_program()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    scope = pt.global_scope()
+    d = str(tmp_path / "solo")
+    R.arm("checkpoint.write", action="corrupt", nth=2, times=1, seed=3)
+    checkpoint.save_checkpoint(d, main, scope=scope, step=9)
+    R.reset()
+    before_w = np.asarray(scope.find_var("rz_w")).copy()
+    scope.set_var("rz_w", before_w + 5.0)
+    with pytest.raises(checkpoint.CheckpointCorruption):
+        checkpoint.load_checkpoint(d, main, scope=scope)  # no sibling
+    np.testing.assert_allclose(np.asarray(scope.find_var("rz_w")),
+                               before_w + 5.0)
+
+
+def test_checkpoint_fallback_confined_to_retention_siblings(tmp_path):
+    """A standalone corrupt checkpoint must NOT fall back to an
+    arbitrary sibling dir (another model's root, say) — automatic
+    substitution is only safe inside a retention history."""
+    _ckpt_model()
+    main = pt.default_main_program()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    scope = pt.global_scope()
+    # a complete, same-var-names sibling that must never be used
+    checkpoint.save_checkpoint(str(tmp_path / "other_model"), main,
+                               scope=scope, step=1)
+    R.arm("checkpoint.write", action="corrupt", nth=1, times=1, seed=2)
+    d = str(tmp_path / "this_model")
+    checkpoint.save_checkpoint(d, main, scope=scope, step=2)
+    R.reset()
+    with pytest.raises(checkpoint.CheckpointCorruption):
+        checkpoint.load_checkpoint(d, main, scope=scope)  # fallback=True
+    assert not R.events(kind="checkpoint_fallback")
+
+
+def test_checkpoint_manifest_corruption_detected(tmp_path):
+    """The manifest's own CRC (in the _COMPLETE marker) catches rot in
+    the metadata, not just the shard data."""
+    _ckpt_model()
+    main = pt.default_main_program()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    d = str(tmp_path / "mck")
+    # _write emits: one fault hit per shard file, then the manifest —
+    # rz_w + rz_b = 2 shards, so hit 3 is the manifest
+    R.arm("checkpoint.write", action="corrupt", nth=3, times=1, seed=4)
+    checkpoint.save_checkpoint(d, main, scope=pt.global_scope(), step=1)
+    R.reset()
+    assert checkpoint._is_complete(d)  # sizes still match
+    with pytest.raises(checkpoint.CheckpointCorruption):
+        checkpoint.load_checkpoint(d, main, scope=pt.Scope(),
+                                   fallback=False)
+
+
+def test_checkpoint_keep_last_retention(tmp_path):
+    _ckpt_model()
+    main = pt.default_main_program()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    root = str(tmp_path / "root")
+    for s in range(1, 6):
+        checkpoint.save_checkpoint(root, main, scope=pt.global_scope(),
+                                   step=s, keep_last=2)
+    left = sorted(d for d in os.listdir(root)
+                  if not d.endswith((".tmp", ".old")))
+    assert left == ["ckpt-%08d" % 4, "ckpt-%08d" % 5]
+    # auto-numbered step continues past the pruned history
+    d = checkpoint.save_checkpoint(root, main, scope=pt.global_scope(),
+                                   keep_last=2)
+    assert d.endswith("ckpt-%08d" % 6)
+
+
+def test_checkpoint_async_retention_saves_do_not_collide(tmp_path):
+    """Two overlapping async auto-numbered saves must reserve distinct
+    ckpt indices — the second must not rmtree the first's in-flight .tmp
+    (the delay fault holds the first write open)."""
+    _ckpt_model()
+    main = pt.default_main_program()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    root = str(tmp_path / "root")
+    R.arm("checkpoint.write", action="delay", nth=1, times=1, delay=0.4)
+    h1 = checkpoint.save_checkpoint(root, main, scope=pt.global_scope(),
+                                    async_=True, keep_last=4)
+    h2 = checkpoint.save_checkpoint(root, main, scope=pt.global_scope(),
+                                    async_=True, keep_last=4)
+    d1, d2 = h1.result(timeout=30), h2.result(timeout=30)
+    assert d1 != d2
+    assert {os.path.basename(d1), os.path.basename(d2)} == \
+        {"ckpt-%08d" % 0, "ckpt-%08d" % 1}
+    for d in (d1, d2):
+        assert checkpoint.load_checkpoint(d, main, scope=pt.Scope(),
+                                          fallback=False) in (0, 1)
+
+
+def test_checkpoint_crc_recorded_per_shard(tmp_path):
+    import json
+    _ckpt_model()
+    main = pt.default_main_program()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(pt.default_startup_program())
+    d = str(tmp_path / "ck")
+    checkpoint.save_checkpoint(d, main, scope=pt.global_scope(), step=1)
+    with open(os.path.join(d, "_MANIFEST.json")) as f:
+        manifest = json.load(f)
+    for e in manifest["vars"].values():
+        for sh in e["files"]:
+            assert isinstance(sh["crc32"], int)
+
+
+# ---------------------------------------------------------------------------
+# trainer preemption
+# ---------------------------------------------------------------------------
+
+def test_sigterm_preemption_writes_final_checkpoint(tmp_path):
+    ck = str(tmp_path / "preempt")
+    x = layers.data("x", shape=[4], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="int64")
+    pred = layers.fc(x, size=2, act="softmax",
+                     param_attr=pt.ParamAttr(name="pe_w"))
+    loss = layers.mean(layers.cross_entropy(pred, y))
+    trainer = pt.Trainer(loss, pt.SGD(learning_rate=0.1),
+                         feed_list=[x, y], place=pt.CPUPlace(),
+                         checkpoint_dir=ck)
+    rng = np.random.RandomState(0)
+    rows = [(rng.rand(4).astype("float32"), int(i % 2)) for i in range(64)]
+
+    def reader():
+        for r in rows:
+            yield r
+
+    import paddle_tpu.reader as RD
+    seen = []
+
+    def handler(e):
+        if isinstance(e, pt.EndIteration):
+            seen.append(e.batch_id)
+            if e.batch_id == 2:
+                # the k8s/TPU-maintenance SIGTERM, delivered for real
+                signal.raise_signal(signal.SIGTERM)
+
+    old = signal.getsignal(signal.SIGTERM)
+    trainer.train(RD.batch(reader, batch_size=4), num_passes=4,
+                  event_handler=handler)
+    assert trainer.preempted
+    assert seen == [0, 1, 2]  # drained the batch, then stopped
+    assert os.path.isdir(ck) and os.listdir(ck)  # checkpoint written
+    evs = R.events(kind="preempt_checkpoint")
+    assert len(evs) == 1
+    assert evs[0]["pass_id"] == 0 and evs[0]["batch_id"] == 2
+    assert signal.getsignal(signal.SIGTERM) == old  # handler restored
+
+    # a later train() on the same object starts fresh — the stale flag
+    # must not end it after one batch
+    ran = []
+    trainer.train(RD.batch(reader, batch_size=4), num_passes=1,
+                  event_handler=lambda e: ran.append(e))
+    assert not trainer.preempted
+    assert sum(isinstance(e, pt.EndIteration) for e in ran) == 16
+
+
+# ---------------------------------------------------------------------------
+# async SGD: reconnect + degraded mode
+# ---------------------------------------------------------------------------
+
+def _fast_rpc_policy():
+    return RetryPolicy(max_attempts=3, backoff=0.02, multiplier=2.0,
+                       jitter=0.0, retry_on=(OSError, EOFError),
+                       name="async_sgd.rpc")
+
+
+def test_async_sgd_transient_push_fault_is_retried():
+    server = AsyncParameterServer({"w": np.zeros(3, np.float32)},
+                                  lr=0.1).start()
+    try:
+        upd = AsyncSGDUpdater(server.address, worker_id=0,
+                              retry_policy=_fast_rpc_policy())
+        upd.pull(step=0)
+        # two consecutive connection faults, then clean air: the push
+        # must land exactly once
+        R.arm("async_sgd.push_grads", action="raise", nth=1, times=2,
+              exc=ConnectionError)
+        ver = upd.push({"w": np.ones(3, np.float32)}, step=0)
+        assert ver == 1 and server.version == 1
+        assert upd.dropped_pushes == 0 and not upd.degraded
+        upd.close()
+    finally:
+        R.reset()
+        server.stop()
+
+
+def test_async_sgd_pserver_death_degrades_without_hang():
+    """THE acceptance path: kill the pserver mid-run; the worker does a
+    bounded backoff-reconnect, then continues in recorded degraded mode
+    — no hang, no crash."""
+    server = AsyncParameterServer({"w": np.full(3, 2.0, np.float32)},
+                                  lr=0.1).start()
+    upd = AsyncSGDUpdater(server.address, worker_id=0,
+                          retry_policy=_fast_rpc_policy())
+    v, params = upd.pull(step=0)
+    upd.push({"w": np.ones(3, np.float32)}, step=0)
+    v1, p1 = upd.pull(step=1)  # post-update params now cached
+
+    server.stop()  # the pserver dies, connections reset
+
+    t0 = time.time()
+    for step in range(2, 6):
+        ver, params = upd.pull(step=step)
+        assert np.allclose(params["w"], p1["w"])  # frozen at last pull
+        upd.push({"w": np.ones(3, np.float32)}, step=step)
+    elapsed = time.time() - t0
+
+    assert elapsed < 10.0                      # bounded, not a hang
+    assert upd.degraded
+    assert upd.degraded_steps == 4 and upd.dropped_pushes == 4
+    pulls = R.events(kind="degraded", site="async_sgd.pull_params")
+    pushes = R.events(kind="degraded", site="async_sgd.push_grads")
+    assert len(pulls) == 4 and len(pushes) == 4
+    assert pulls[0]["served"] == "cached_params"
+    assert pushes[0]["served"] == "dropped_push"
+    upd.close()
+
+
+def test_async_sgd_no_cache_means_loud_failure():
+    """Degraded mode needs something to degrade TO: a worker that never
+    completed a pull must fail loudly, not train on garbage."""
+    server = AsyncParameterServer({"w": np.zeros(2, np.float32)},
+                                  lr=0.1).start()
+    addr = server.address
+    server.stop()
+    upd = AsyncSGDUpdater(addr, worker_id=0,
+                          retry_policy=_fast_rpc_policy())
+    with pytest.raises(RetryError):
+        upd.pull(step=0)
+
+
+# ---------------------------------------------------------------------------
+# dataset download retry
+# ---------------------------------------------------------------------------
+
+def test_download_retry_until_file_appears(tmp_path, monkeypatch):
+    from paddle_tpu.dataset import common
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
+    fn = os.path.join(str(tmp_path), "mod", "blob.bin")
+
+    def sync_arrives(attempt, exc, delay):
+        os.makedirs(os.path.dirname(fn), exist_ok=True)
+        with open(fn, "wb") as f:
+            f.write(b"data")
+
+    pol = RetryPolicy(max_attempts=3, backoff=0.0, on_retry=sync_arrives,
+                      name="dataset.download")
+    got = common.download("http://host/blob.bin", "mod", md5sum=None,
+                          retry_policy=pol)
+    assert got == fn
+    # absent + budget exhausted -> the original clear RuntimeError
+    with pytest.raises(RuntimeError, match="not cached"):
+        common.download("http://host/never.bin", "mod", md5sum=None,
+                        retry_policy=RetryPolicy(max_attempts=2,
+                                                 backoff=0.0))
+    # each attempt crosses the fault site; download unwraps the
+    # RetryError to its cause
+    R.arm("dataset.download", action="raise", nth=1, times=None,
+          exc=ConnectionError)
+    with pytest.raises(ConnectionError):
+        common.download("http://host/blob.bin", "mod", md5sum=None,
+                        retry_policy=RetryPolicy(max_attempts=2,
+                                                 backoff=0.0,
+                                                 retry_on=(OSError,)))
+    assert R.hits("dataset.download") == 2
