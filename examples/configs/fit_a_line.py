@@ -1,0 +1,30 @@
+"""Book config: linear regression (fit-a-line) for `paddle_tpu train`
+and `paddle_tpu lint`. Synthetic reader — no dataset download, so the
+config builds (and lints) offline."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def model():
+    x = layers.data(name="x", shape=[13], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    y_predict = layers.fc(input=x, size=1, act=None)
+    cost = layers.square_error_cost(input=y_predict, label=y)
+    avg_cost = layers.mean(cost)
+
+    def reader():
+        rng = np.random.RandomState(0)
+        w = rng.rand(13, 1).astype(np.float32)
+        for _ in range(64):
+            xs = rng.rand(13).astype(np.float32)
+            yield xs, (xs @ w).astype(np.float32)
+
+    return {
+        "cost": avg_cost,
+        "feed_list": [x, y],
+        "reader": pt.reader.batch(reader, batch_size=16),
+        "optimizer": pt.optimizer.SGD(learning_rate=0.01),
+        "num_passes": 1,
+    }

@@ -1,0 +1,27 @@
+"""Book config: MNIST-shaped conv classifier (recognize-digits) for
+`paddle_tpu train` / `paddle_tpu lint`, with a synthetic digit reader."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers, models
+
+
+def model():
+    img = layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    pred, avg_cost, acc = models.lenet5(img, label)
+
+    def reader():
+        rng = np.random.RandomState(0)
+        for _ in range(64):
+            yield (rng.rand(1, 28, 28).astype(np.float32),
+                   rng.randint(0, 10, (1,)).astype(np.int64))
+
+    return {
+        "cost": avg_cost,
+        "metrics": [acc],
+        "feed_list": [img, label],
+        "reader": pt.reader.batch(reader, batch_size=16),
+        "optimizer": pt.optimizer.Adam(learning_rate=0.001),
+        "num_passes": 1,
+    }

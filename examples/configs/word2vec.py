@@ -1,0 +1,37 @@
+"""Book config: word2vec-style N-gram model (shared embedding table) for
+`paddle_tpu train` / `paddle_tpu lint`, with a synthetic corpus reader."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+VOCAB = 200
+EMB = 16
+
+
+def model():
+    words = [layers.data(name="w%d" % i, shape=[1], dtype="int64")
+             for i in range(4)]
+    next_word = layers.data(name="next_word", shape=[1], dtype="int64")
+    embs = [layers.embedding(
+        w, size=[VOCAB, EMB], dtype="float32",
+        param_attr=pt.ParamAttr(name="shared_w")) for w in words]
+    concat = layers.concat(input=embs, axis=1)
+    hidden = layers.fc(input=concat, size=64, act="sigmoid")
+    predict = layers.fc(input=hidden, size=VOCAB, act="softmax")
+    cost = layers.cross_entropy(input=predict, label=next_word)
+    avg_cost = layers.mean(cost)
+
+    def reader():
+        rng = np.random.RandomState(0)
+        seq = rng.randint(0, VOCAB, 512).astype(np.int64)
+        for i in range(len(seq) - 5):
+            yield tuple(seq[i + j].reshape(1) for j in range(5))
+
+    return {
+        "cost": avg_cost,
+        "feed_list": words + [next_word],
+        "reader": pt.reader.batch(reader, batch_size=32),
+        "optimizer": pt.optimizer.SGD(learning_rate=0.001),
+        "num_passes": 1,
+    }

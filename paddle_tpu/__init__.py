@@ -45,6 +45,8 @@ from .memory_optimization_transpiler import (  # noqa: F401
     memory_optimize, release_memory,
 )
 from . import amp  # noqa: F401
+from . import analysis  # noqa: F401
+from .analysis import ProgramVerifyError  # noqa: F401
 from . import flags  # noqa: F401
 from . import enforce  # noqa: F401
 from .flags import FLAGS, set_flags, get_flags, flags_guard  # noqa: F401

@@ -1,0 +1,32 @@
+"""paddle_tpu.analysis: rule-based static verification of the Program IR.
+
+The "program as IR" design only pays off if the IR can be checked before
+the expensive step (trace + XLA compile): a malformed Program otherwise
+surfaces as a cryptic jax error deep inside core/executor.py. This package
+walks a Program once, dispatches to registered rules, and returns
+``Diagnostic``s with stable PTxxx codes (doc/diagnostics.md).
+
+Entry points:
+- ``verify(program, rules=None, strict=False, fetches=None)`` — run rules,
+  return diagnostics; ``strict`` raises ``ProgramVerifyError`` on errors.
+- ``paddle_tpu lint <config.py>`` — CLI wrapper (rendered report, exit 1
+  on errors, ``--dot`` graph with failing ops highlighted).
+- ``PADDLE_TPU_VERIFY=1`` / ``FLAGS.verify`` — executor pre-trace hook.
+- ``check_after_pass`` — self-check run by memory_optimize and the
+  parallel sharding transpiler after they touch a program.
+"""
+from .diagnostics import (  # noqa: F401
+    Diagnostic, ProgramVerifyError, Severity, render_diagnostics,
+)
+from .runner import (  # noqa: F401
+    Rule, ProgramFacts, STRUCTURAL_CODES, check_after_pass, register_rule,
+    registered_rules, resolve_rules, verify, verify_or_raise,
+)
+from . import rules  # noqa: F401  (registers the built-in PT rules)
+
+__all__ = [
+    "Diagnostic", "ProgramVerifyError", "Severity", "render_diagnostics",
+    "Rule", "ProgramFacts", "STRUCTURAL_CODES", "check_after_pass",
+    "register_rule", "registered_rules", "resolve_rules", "verify",
+    "verify_or_raise", "rules",
+]

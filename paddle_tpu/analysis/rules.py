@@ -1,0 +1,464 @@
+"""Built-in verifier rules (the PTxxx code table; see doc/diagnostics.md).
+
+Each rule is small and independently selectable: ``verify(p, rules=["PT006"])``
+runs just the write-after-write check. Severities follow one principle:
+ERROR means the program cannot mean what was written (a trace would crash or
+silently read garbage); WARNING means it is suspicious but executable.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import ir, registry
+from .diagnostics import Severity
+from .runner import Rule, op_sub_blocks, register_rule
+
+GRAD_SUFFIX = ir.GRAD_SUFFIX
+
+
+@register_rule
+class UndefinedVarRule(Rule):
+    """PT001 undefined input / PT002 use-before-def.
+
+    Honors the block parent chain and control-flow sub-block attrs: a name
+    counts as defined if any op earlier on the walk path produced it, if it
+    is persistable (parameters / optimizer state come from the scope), or
+    if it is a feed-style var (declared but produced by no op anywhere —
+    the executor binds those from the feed dict or leaves them to fail
+    with its own readable KeyError)."""
+
+    code = "PT001"
+    name = "undefined-var"
+    emits = ("PT001", "PT002")
+
+    def visit_op(self, walk):
+        facts = self.facts
+        fw = facts.first_writer.get(walk.block.idx, {})
+        for n in walk.op.input_arg_names:
+            if not n or n in walk.defined:
+                continue
+            v = facts.scope_var(walk.block, n)
+            if v is not None and v.persistable:
+                continue
+            first_local = fw.get(n)
+            if first_local is not None and first_local >= walk.op_idx:
+                self.emit(
+                    "op %r reads %r which is first produced later in the "
+                    "same block (op %d)" % (walk.op.type, n, first_local),
+                    block_idx=walk.block.idx, op_idx=walk.op_idx, var=n,
+                    hint="reorder the ops or wire the producer before this "
+                         "use", code="PT002")
+            elif v is None and n not in facts.produced_anywhere:
+                self.emit(
+                    "op %r reads %r which is declared in no enclosing "
+                    "block and produced by no op" % (walk.op.type, n),
+                    block_idx=walk.block.idx, op_idx=walk.op_idx, var=n,
+                    hint="create the variable (block.create_var / "
+                         "layers.data) or fix the slot name",
+                    code="PT001")
+
+
+@register_rule
+class UnregisteredOpRule(Rule):
+    """PT003: op type absent from core.registry — the trace would die in
+    lookup_checked mid-compile; report it up front with the op located."""
+
+    code = "PT003"
+    name = "unregistered-op"
+    emits = ("PT003",)
+
+    def visit_op(self, walk):
+        if registry.lookup(walk.op.type) is None:
+            self.emit("op type %r has no registered lowering"
+                      % walk.op.type,
+                      block_idx=walk.block.idx, op_idx=walk.op_idx,
+                      hint="register it with core.registry.register_op or "
+                           "fix the type name")
+
+
+@register_rule
+class WriteAfterWriteRule(Rule):
+    """PT006: a var is written twice with no read in between, and neither
+    write goes through a ``stateful_outputs`` slot (in-place contract like
+    increment's Out or the optimizer ParamOut slots). The first write is a
+    dead store at best and a lost update at worst."""
+
+    code = "PT006"
+    name = "write-after-write"
+    severity = Severity.WARNING
+    emits = ("PT006",)
+
+    def begin(self, program, facts, sink):
+        super(WriteAfterWriteRule, self).begin(program, facts, sink)
+        # block idx -> name -> (op_idx, was_stateful_slot)
+        self._writers: Dict[int, Dict[str, Tuple[int, bool]]] = {}
+
+    def _retire(self, block, names, include_self=True):
+        """The executor env is flat: a read (or a sub-block write) of a
+        name consumes pending writes in EVERY enclosing block, not just
+        the one the op sits in."""
+        seen = set()
+        blk = block if include_self else block.parent_block
+        while blk is not None and blk.idx not in seen:
+            seen.add(blk.idx)
+            writers = self._writers.get(blk.idx)
+            if writers:
+                for n in names:
+                    writers.pop(n, None)
+            blk = blk.parent_block
+
+    def visit_op(self, walk):
+        writers = self._writers.setdefault(walk.block.idx, {})
+        reads = set(n for n in walk.op.input_arg_names if n)
+        self._retire(walk.block, reads)
+        if walk.depth > 0:
+            # a sub-block write to a parent-pending name counts as a use
+            # of the parent's store (loop-carried update), but must not
+            # hide double writes WITHIN the sub-block itself
+            self._retire(walk.block,
+                         set(n for n in walk.op.output_arg_names if n),
+                         include_self=False)
+        opdef = registry.lookup(walk.op.type)
+        stateful = set(opdef.stateful_outputs) if opdef is not None else set()
+        for slot, names in walk.op.outputs.items():
+            for n in names:
+                if not n or n in self.facts.persistable:
+                    continue
+                prev = writers.get(n)
+                is_stateful = slot in stateful
+                if prev is not None and not prev[1] and not is_stateful \
+                        and n not in reads:
+                    self.emit(
+                        "%r written by op %d is overwritten by op %d (%s) "
+                        "without ever being read" % (n, prev[0],
+                                                     walk.op_idx,
+                                                     walk.op.type),
+                        block_idx=walk.block.idx, op_idx=walk.op_idx,
+                        var=n,
+                        hint="drop the dead store, or mark the output "
+                             "slot stateful_outputs if this is an "
+                             "in-place update")
+                writers[n] = (walk.op_idx, is_stateful)
+
+
+@register_rule
+class SubBlockRule(Rule):
+    """PT010: control-flow structure — sub-block attrs must point at a real
+    block of this program (not the op's own block), and the block parent
+    chain must be acyclic and in range."""
+
+    code = "PT010"
+    name = "invalid-sub-block"
+    emits = ("PT010",)
+
+    def visit_op(self, walk):
+        nblocks = len(self.program.blocks)
+        for key, sub, raw in op_sub_blocks(walk.op, self.program):
+            if sub is None:
+                what = ("index %r out of range [0, %d)" % (raw, nblocks)
+                        if isinstance(raw, int)
+                        else "Block of a different Program")
+                self.emit("op %r attr %r: sub-block %s"
+                          % (walk.op.type, key, what),
+                          block_idx=walk.block.idx, op_idx=walk.op_idx,
+                          hint="point the attr at a block created by "
+                               "program.create_block()")
+            elif sub.idx == walk.block.idx:
+                self.emit("op %r attr %r: sub-block is the op's own block "
+                          "%d (self-recursion)" % (walk.op.type, key,
+                                                   sub.idx),
+                          block_idx=walk.block.idx, op_idx=walk.op_idx)
+
+    def finish(self):
+        nblocks = len(self.program.blocks)
+        for blk in self.program.blocks:
+            seen = set()
+            idx = blk.idx
+            while idx >= 0:
+                if idx >= nblocks:
+                    self.emit("block %d has out-of-range parent %d"
+                              % (blk.idx, idx), block_idx=blk.idx)
+                    break
+                if idx in seen:
+                    self.emit("block parent chain starting at block %d "
+                              "cycles through block %d"
+                              % (blk.idx, idx), block_idx=blk.idx,
+                              hint="parent_idx must strictly descend "
+                                   "toward block 0")
+                    break
+                seen.add(idx)
+                idx = self.program.blocks[idx].parent_idx
+
+
+@register_rule
+class ShapePropagationRule(Rule):
+    """PT004 shape-infer failure / PT005 shape conflict.
+
+    Re-runs every op's registered infer_shape over a scratch deepcopy of
+    the program in build order (sub-blocks before the op that owns them,
+    matching how append_op interleaved them), reporting exceptions instead
+    of swallowing them the way Block._infer_shape must at build time —
+    and then diffs the re-propagated shapes/dtypes against the program's
+    declared ones, so a transform that invalidated a shape annotation is
+    caught before XLA produces an unrelated-looking trace error."""
+
+    code = "PT004"
+    name = "shape-propagation"
+    emits = ("PT004", "PT005")
+
+    def finish(self):
+        try:
+            scratch = copy.deepcopy(self.program)
+        except Exception as e:  # non-copyable attr (e.g. a live handle)
+            self.emit("program not deep-copyable (%s); shape "
+                      "re-propagation skipped" % e,
+                      severity=Severity.INFO)
+            return
+        visited: Set[int] = set()
+
+        def run_block(blk):
+            if blk.idx in visited:
+                return
+            visited.add(blk.idx)
+            for i, op in enumerate(blk.ops):
+                for _k, sub, _raw in op_sub_blocks(op, scratch):
+                    if sub is not None:
+                        run_block(sub)
+                opdef = registry.lookup(op.type)
+                if opdef is None or opdef.infer_shape is None:
+                    continue
+                try:
+                    opdef.infer_shape(op, blk)
+                except Exception as e:
+                    self.emit("shape inference for op %r failed: %s"
+                              % (op.type, e),
+                              block_idx=blk.idx, op_idx=i, code="PT004",
+                              hint="fix the input shapes/attrs; run with "
+                                   "PADDLE_TPU_DEBUG_SHAPES=1 to catch "
+                                   "this at build time")
+
+        run_block(scratch.global_block())
+        for blk in scratch.blocks:
+            if blk.idx not in visited:
+                run_block(blk)
+        for orig_blk, new_blk in zip(self.program.blocks, scratch.blocks):
+            for name, orig_v in orig_blk.vars.items():
+                new_v = new_blk.vars.get(name)
+                if new_v is None:
+                    continue
+                if (orig_v.shape is not None and new_v.shape is not None
+                        and tuple(orig_v.shape) != tuple(new_v.shape)):
+                    self.emit(
+                        "declared shape %s of %r conflicts with "
+                        "re-propagated shape %s"
+                        % (tuple(orig_v.shape), name, tuple(new_v.shape)),
+                        block_idx=orig_blk.idx, var=name, code="PT005",
+                        severity=Severity.WARNING,
+                        hint="a pass or manual edit stale-d this shape; "
+                             "re-run shape inference or fix the producer")
+
+
+@register_rule
+class OrphanGradRule(Rule):
+    """PT007: a ``@GRAD`` var whose forward partner does not exist anywhere
+    in the var scope chain — backward transforms create grads next to their
+    forward var, so an orphan means a rename/prune half-applied."""
+
+    code = "PT007"
+    name = "orphan-grad"
+    severity = Severity.WARNING
+    emits = ("PT007",)
+
+    def finish(self):
+        for blk in self.program.blocks:
+            for name in blk.vars:
+                if GRAD_SUFFIX not in name:
+                    continue
+                base = name.split(GRAD_SUFFIX)[0]
+                if not base:
+                    continue
+                if blk._find_var_recursive(base) is None \
+                        and base not in self.facts.produced_anywhere:
+                    self.emit(
+                        "gradient var %r has no forward partner %r"
+                        % (name, base),
+                        block_idx=blk.idx, var=name,
+                        hint="the forward var was renamed or pruned "
+                             "without its gradient")
+
+
+@register_rule
+class DeadVarRule(Rule):
+    """PT008: a var declared in a block but referenced by no op anywhere —
+    dead weight from an abandoned edit or a half-removed op."""
+
+    code = "PT008"
+    name = "dead-var"
+    severity = Severity.WARNING
+    emits = ("PT008",)
+
+    def finish(self):
+        for blk in self.program.blocks:
+            for name, v in blk.vars.items():
+                if name in self.facts.referenced or v.persistable \
+                        or isinstance(v, ir.Parameter):
+                    continue
+                self.emit("var %r is referenced by no op" % name,
+                          block_idx=blk.idx, var=name,
+                          hint="delete it, or wire it to the op that was "
+                               "meant to consume it")
+
+
+@register_rule
+class UnusedParameterRule(Rule):
+    """PT009: a Parameter no op reads or writes in this program. Its
+    buffer would still be donated to every jitted step — wasted HBM."""
+
+    code = "PT009"
+    name = "unused-parameter"
+    severity = Severity.WARNING
+    emits = ("PT009",)
+
+    def finish(self):
+        for blk in self.program.blocks:
+            for name, v in blk.vars.items():
+                if isinstance(v, ir.Parameter) \
+                        and name not in self.facts.referenced:
+                    self.emit("parameter %r is used by no op" % name,
+                              block_idx=blk.idx, var=name,
+                              hint="remove the layer that created it or "
+                                   "connect it to the graph")
+
+
+@register_rule
+class ShardingRule(Rule):
+    """PT011: ``program._shardings`` consistency — every annotated name
+    must exist, and the PartitionSpec rank must not exceed the var rank
+    (GSPMD would reject it deep inside jit with a mesh-axis error)."""
+
+    code = "PT011"
+    name = "sharding-mismatch"
+    emits = ("PT011",)
+
+    def finish(self):
+        shardings = getattr(self.program, "_shardings", None) or {}
+        declared = {}
+        for blk in self.program.blocks:
+            declared.update(blk.vars)
+        for name, spec in shardings.items():
+            v = declared.get(name)
+            if v is None:
+                self.emit("sharding annotates %r which exists in no block"
+                          % name, var=name,
+                          hint="drop the stale annotation or fix the name")
+                continue
+            try:
+                spec_rank = len([p for p in tuple(spec)])
+            except TypeError:
+                continue  # opaque spec object; nothing to check
+            if v.shape is not None and spec_rank > len(v.shape):
+                self.emit(
+                    "sharding spec %s (rank %d) exceeds rank %d of %r"
+                    % (tuple(spec), spec_rank, len(v.shape), name),
+                    var=name,
+                    hint="a PartitionSpec may name at most one mesh axis "
+                         "per tensor dimension")
+
+
+@register_rule
+class CreateVarConflictRule(Rule):
+    """PT012: surfaces the shape/dtype conflicts Block.create_var recorded
+    when a second create_var hit an existing name with different metadata
+    (the silent-return trap)."""
+
+    code = "PT012"
+    name = "create-var-conflict"
+    severity = Severity.WARNING
+    emits = ("PT012",)
+
+    def finish(self):
+        for (blk_idx, name, field, old, new) in getattr(
+                self.program, "_var_def_conflicts", ()):
+            self.emit(
+                "create_var(%r) requested %s %s but the existing var has "
+                "%s; the existing var was returned unchanged"
+                % (name, field, new, old),
+                block_idx=blk_idx, var=name,
+                hint="rename one of the two, or make the declarations "
+                     "agree")
+
+
+@register_rule
+class RecordedShapeFailureRule(Rule):
+    """PT013: surfaces the bounded Program._shape_infer_failures record —
+    build-time inference failures that used to pile up in a list nobody
+    read."""
+
+    code = "PT013"
+    name = "recorded-shape-failure"
+    severity = Severity.WARNING
+    emits = ("PT013",)
+
+    def finish(self):
+        for (op_type, msg) in getattr(self.program,
+                                      "_shape_infer_failures", ()):
+            self.emit("shape inference failed while building op %r: %s"
+                      % (op_type, msg),
+                      hint="run with PADDLE_TPU_DEBUG_SHAPES=1 to raise "
+                           "at the failing append_op")
+        dropped = getattr(self.program, "_shape_infer_dropped", 0)
+        if dropped:
+            self.emit("%d additional shape-inference failures were "
+                      "recorded and dropped (bounded at %d)"
+                      % (dropped, ir.SHAPE_INFER_FAILURE_CAP))
+
+
+@register_rule
+class DeadOpRule(Rule):
+    """PT014: ops not reverse-reachable from the fetch targets (plus
+    persistable writes and host/side-effect ops). Active only when
+    verify() is given ``fetches`` — without them every sink op is a
+    potential fetch and reachability is vacuous. Reuses Program.prune's
+    sub-block-reads logic so keeping a control-flow op keeps its body's
+    upstream producers."""
+
+    code = "PT014"
+    name = "dead-op"
+    severity = Severity.WARNING
+    emits = ("PT014",)
+
+    def __init__(self):
+        self._fetches: Optional[List[str]] = None
+
+    def set_fetches(self, fetches):
+        self._fetches = list(fetches)
+
+    def finish(self):
+        if not self._fetches:
+            return
+        blk = self.program.global_block()
+        needed = set(self._fetches)
+        persist = self.facts.persistable
+        dead: List[int] = []
+        for i in range(len(blk.ops) - 1, -1, -1):
+            op = blk.ops[i]
+            opdef = registry.lookup(op.type)
+            host = opdef is not None and (
+                opdef.host(op) if callable(opdef.host) else opdef.host)
+            outs = set(n for n in op.output_arg_names if n)
+            keep = bool(outs & needed) or bool(outs & persist) \
+                or host or not outs
+            if keep:
+                needed.update(n for n in op.input_arg_names if n)
+                needed |= ir.sub_block_read_names(op, self.program)
+            else:
+                dead.append(i)
+        for i in reversed(dead):
+            op = blk.ops[i]
+            self.emit("op %r (outputs %s) is unreachable from the fetch "
+                      "targets %s" % (op.type, op.output_arg_names,
+                                      self._fetches),
+                      block_idx=blk.idx, op_idx=i,
+                      hint="prune it (Program.prune) or fetch what it "
+                           "computes")

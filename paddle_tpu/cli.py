@@ -74,6 +74,51 @@ def cmd_bench(args):
     return 0
 
 
+def cmd_lint(args):
+    """Statically verify the program a train config builds — same config
+    contract as ``train`` (the file defines ``model()``) but nothing is
+    executed or compiled: the Program IR is built and handed to
+    paddle_tpu.analysis.verify. Exit 0 clean / warnings-only, 1 on
+    error diagnostics (or any diagnostic with --strict), 2 if the config
+    itself fails to build."""
+    import paddle_tpu as pt
+    from paddle_tpu import analysis
+
+    main, startup = pt.Program(), pt.Program()
+    try:
+        cfg = _load_config(args.config)
+        with pt.program_guard(main, startup):
+            spec = cfg.model()
+    except Exception as e:
+        print("lint: config %r failed to build: %s: %s"
+              % (args.config, type(e).__name__, e))
+        return 2
+    fetches = None
+    if isinstance(spec, dict) and spec.get("cost") is not None:
+        # metrics (accuracy etc.) count as fetch roots too: a trainer
+        # fetches them per pass, so they are not dead ops
+        fetches = [spec["cost"]] + list(spec.get("metrics", ()))
+    diags = analysis.verify(main, fetches=fetches)
+    startup_diags = analysis.verify(startup)
+    for label, ds in (("main program", diags),
+                      ("startup program", startup_diags)):
+        report = analysis.render_diagnostics(ds, label=label)
+        print(report if report else "%s: clean" % label)
+    if args.dot:
+        from paddle_tpu import debugger
+        bad_ops = {d.op_idx for d in diags
+                   if d.block_idx == 0 and d.op_idx is not None
+                   and d.is_error}
+        debugger.draw_block_graphviz(main.global_block(),
+                                     op_highlights=bad_ops, path=args.dot)
+        print("lint: wrote %s (%d op(s) highlighted)"
+              % (args.dot, len(bad_ops)))
+    all_diags = diags + startup_diags
+    failed = any(d.is_error for d in all_diags) \
+        or (args.strict and all_diags)
+    return 1 if failed else 0
+
+
 def cmd_info(args):
     import jax
 
@@ -121,6 +166,17 @@ def main(argv=None):
     b.add_argument("--batch_size", type=int, default=64)
     b.add_argument("extra", nargs="*")
     b.set_defaults(fn=cmd_bench)
+
+    lint = sub.add_parser(
+        "lint", help="statically verify a train config's Program IR "
+                     "(paddle_tpu.analysis; exit 1 on PT errors)")
+    lint.add_argument("config")
+    lint.add_argument("--dot", default=None, metavar="PATH",
+                      help="write a graphviz .dot of the main block with "
+                           "failing ops highlighted")
+    lint.add_argument("--strict", action="store_true",
+                      help="treat warnings as failures")
+    lint.set_defaults(fn=cmd_lint)
 
     i = sub.add_parser("info", help="device / build report")
     i.set_defaults(fn=cmd_info)

@@ -457,6 +457,10 @@ class Executor(object):
         self.stats = {"jit_runs": 0, "eager_runs": 0, "hybrid_runs": 0}
         # programs whose trace hit data-dependent control flow: run eager
         self._force_eager = set()
+        # (uid, version) pairs already checked by the pre-trace verifier
+        # (PADDLE_TPU_VERIFY / FLAGS.verify): verify once per program
+        # version, not per step
+        self._verified = set()
         # programs already warned about host-path degradation (one line per
         # program, not per step)
         self._degradation_logged = set()
@@ -550,6 +554,7 @@ class Executor(object):
         (reference: utils/Flags.cpp:44-65). Requires the jit path and a
         constant feed across the K steps."""
         program = program if program is not None else ir.default_main_program()
+        self._maybe_verify(program)
         scope = scope if scope is not None else global_scope()
         feed = feed or {}
         fetch_list = fetch_list or []
@@ -961,6 +966,30 @@ class Executor(object):
         return jitted
 
     # -- helpers ---------------------------------------------------------------
+    def _maybe_verify(self, program):
+        """Opt-in pre-trace static check (PADDLE_TPU_VERIFY=1 or
+        FLAGS.verify): a malformed program raises ONE readable
+        ProgramVerifyError listing every diagnostic, instead of the
+        cryptic jax error the trace would hit later. Runs once per
+        (program uid, version)."""
+        import os
+        if not (os.environ.get("PADDLE_TPU_VERIFY", "").lower()
+                in ("1", "true", "yes", "on")):
+            from ..flags import FLAGS
+            if not FLAGS.verify:
+                return
+        key = (program._uid, program._version)
+        if key in self._verified:
+            return
+        from ..analysis import render_diagnostics, verify_or_raise
+        diags = verify_or_raise(program, context="pre-trace verify")
+        if diags:  # warnings only (errors raised above): surface once
+            import warnings
+            warnings.warn("program %d verification warnings:\n%s"
+                          % (program._uid, render_diagnostics(diags)),
+                          RuntimeWarning)
+        self._verified.add(key)
+
     def _persistable_names(self, program):
         return {v.name for v in program.list_vars() if v.persistable}
 

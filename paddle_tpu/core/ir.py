@@ -21,9 +21,44 @@ from .types import VarType, convert_dtype
 
 GRAD_SUFFIX = "@GRAD"
 
+# per-program cap on recorded build-time diagnostics (shape-infer failures,
+# create_var conflicts): enough to debug with, never unbounded growth for a
+# long-lived program that keeps appending ops
+SHAPE_INFER_FAILURE_CAP = 64
+
 
 def grad_var_name(name: str) -> str:
     return name + GRAD_SUFFIX
+
+
+def sub_block_read_names(op: "Operator", program: "Program") -> set:
+    """All names a control-flow op's sub-blocks read (recursive, cycle-safe):
+    keeping the op must keep its body's upstream producers. Shared by
+    Program.prune and the analysis dead-op rule — the sub-block attr
+    conventions (Block values, or int under 'sub_block'/'block') live here
+    in one place."""
+
+    def subs(o):
+        for key, a in o.attrs.items():
+            if isinstance(a, Block) and a.program is program:
+                yield a
+            elif isinstance(a, int) and not isinstance(a, bool) \
+                    and key in ("sub_block", "block") \
+                    and 0 <= a < len(program.blocks):
+                yield program.blocks[a]
+
+    names = set()
+    seen = set()
+    stack = list(subs(op))
+    while stack:
+        blk = stack.pop()
+        if blk.idx in seen:  # corrupt programs may cycle; never recurse off
+            continue
+        seen.add(blk.idx)
+        for sop in blk.ops:
+            names.update(n for n in sop.input_arg_names if n)
+            stack.extend(subs(sop))
+    return names
 
 
 class Variable(object):
@@ -54,6 +89,8 @@ class Variable(object):
         return len(self.shape) if self.shape is not None else None
 
     def numel(self):
+        if self.shape is None:
+            return None  # shape not yet known (pre-inference var)
         n = 1
         for d in self.shape:
             n *= max(d, 1) if d != -1 else 1
@@ -188,7 +225,9 @@ class Block(object):
 
     @property
     def parent_block(self):
-        if self.parent_idx < 0:
+        # out-of-range guards the lookups the verifier runs on corrupt
+        # programs (it reports the bad index as PT010 instead of crashing)
+        if self.parent_idx < 0 or self.parent_idx >= len(self.program.blocks):
             return None
         return self.program.blocks[self.parent_idx]
 
@@ -196,10 +235,47 @@ class Block(object):
     def create_var(self, **kwargs) -> Variable:
         name = kwargs.get("name")
         if name is not None and name in self.vars:
-            return self.vars[name]
+            existing = self.vars[name]
+            self._check_var_redefinition(existing, kwargs)
+            return existing
         var = Variable(self, **kwargs)
         self.vars[var.name] = var
         return var
+
+    def _check_var_redefinition(self, existing, kwargs):
+        """create_var on an existing name returns the existing var; if the
+        request carried a conflicting shape/dtype that silent return hides
+        a real bug — warn and record it for the PT012 verifier rule."""
+        conflicts = []
+        shape = kwargs.get("shape")
+        if shape is not None and existing.shape is not None:
+            req = tuple(shape)
+            cur = tuple(existing.shape)
+            # -1 is the batch wildcard: only fixed dims can conflict
+            if len(req) != len(cur) or any(
+                    a != b for a, b in zip(cur, req)
+                    if a != -1 and b != -1):
+                conflicts.append(("shape", cur, req))
+        dtype = kwargs.get("dtype")
+        if dtype is not None and existing.type == VarType.LOD_TENSOR \
+                and kwargs.get("type", VarType.LOD_TENSOR) \
+                == VarType.LOD_TENSOR:
+            req_dt = convert_dtype(dtype)
+            if req_dt != existing.dtype:
+                conflicts.append(("dtype", existing.dtype, req_dt))
+        if not conflicts:
+            return
+        rec = getattr(self.program, "_var_def_conflicts", None)
+        if rec is None:
+            rec = self.program._var_def_conflicts = []
+        import warnings
+        for field, cur, req in conflicts:
+            if len(rec) < SHAPE_INFER_FAILURE_CAP:
+                rec.append((self.idx, existing.name, field, cur, req))
+            warnings.warn(
+                "create_var(%r) requested %s %s but an existing var with "
+                "%s %s was returned" % (existing.name, field, req, field,
+                                        cur), RuntimeWarning)
 
     def create_parameter(self, **kwargs) -> Parameter:
         shape = kwargs.pop("shape")
@@ -225,9 +301,11 @@ class Block(object):
 
     def _find_var_recursive(self, name) -> Optional[Variable]:
         blk = self
-        while blk is not None:
+        seen = set()  # a corrupt parent chain may cycle; never hang on it
+        while blk is not None and blk.idx not in seen:
             if name in blk.vars:
                 return blk.vars[name]
+            seen.add(blk.idx)
             blk = blk.parent_block
         return None
 
@@ -265,14 +343,20 @@ class Block(object):
                 opdef.infer_shape(op, self)
             except Exception as e:
                 # best-effort (real shapes come from tracing) but never
-                # silent: the failure is recorded for debugging, and
-                # PADDLE_TPU_DEBUG_SHAPES=1 surfaces it immediately —
-                # otherwise shape bugs appear only as cryptic trace errors
+                # silent: the failure is recorded for debugging (bounded —
+                # analysis.verify surfaces the record as PT013
+                # diagnostics), and PADDLE_TPU_DEBUG_SHAPES=1 surfaces it
+                # immediately — otherwise shape bugs appear only as
+                # cryptic trace errors
                 import os
                 rec = getattr(self.program, "_shape_infer_failures", None)
                 if rec is None:
                     rec = self.program._shape_infer_failures = []
-                rec.append((op.type, str(e)))
+                if len(rec) < SHAPE_INFER_FAILURE_CAP:
+                    rec.append((op.type, str(e)))
+                else:
+                    self.program._shape_infer_dropped = getattr(
+                        self.program, "_shape_infer_dropped", 0) + 1
                 from ..flags import FLAGS
                 if (os.environ.get("PADDLE_TPU_DEBUG_SHAPES")
                         or FLAGS.debug_shapes):
@@ -373,29 +457,13 @@ class Program(object):
         p = self.clone(for_test=True)
         blk = p.global_block()
 
-        def sub_block_reads(op, prog):
-            """All names a control-flow op's sub-blocks read (recursive):
-            keeping the op must keep its body's upstream producers."""
-            names = set()
-            for key, a in op.attrs.items():
-                sub = None
-                if isinstance(a, Block):
-                    sub = a
-                elif isinstance(a, int) and key in ("sub_block", "block"):
-                    sub = prog.blocks[a]
-                if sub is not None:
-                    for sop in sub.ops:
-                        names |= set(sop.input_arg_names)
-                        names |= sub_block_reads(sop, prog)
-            return names
-
         needed = set(fetches)
         kept = []
         for op in reversed(blk.ops):
             if set(op.output_arg_names) & needed:
                 kept.append(op)
                 needed |= set(op.input_arg_names)
-                needed |= sub_block_reads(op, p)
+                needed |= sub_block_read_names(op, p)
         blk.ops = list(reversed(kept))
         return p
 

@@ -46,10 +46,16 @@ def pprint_program_codes(program, show_backward=False):
                        for b in program.blocks)
 
 
-def draw_block_graphviz(block, highlights=None, path="./temp.dot"):
+def draw_block_graphviz(block, highlights=None, path="./temp.dot",
+                        op_highlights=None):
     """Emit a graphviz .dot of the op/var dataflow
-    (reference: debuger.py draw_block_graphviz + graphviz.py)."""
+    (reference: debuger.py draw_block_graphviz + graphviz.py).
+
+    ``highlights``: var names to fill yellow. ``op_highlights``: op indices
+    to fill red — the lint CLI uses this to mark ops with error
+    diagnostics."""
     highlights = set(highlights or ())
+    op_highlights = set(op_highlights or ())
     lines = ["digraph G {", "  rankdir=TB;"]
     seen_vars = set()
 
@@ -72,8 +78,9 @@ def draw_block_graphviz(block, highlights=None, path="./temp.dot"):
 
     for i, op in enumerate(block.ops):
         onid = "op_%d" % i
+        color = "#ff6188" if i in op_highlights else "#a9dcdf"
         lines.append('  %s [label="%s", shape=ellipse, style=filled, '
-                     'fillcolor="#a9dcdf"];' % (onid, op.type))
+                     'fillcolor="%s"];' % (onid, op.type, color))
         for n in op.input_arg_names:
             lines.append("  %s -> %s;" % (var_node(n), onid))
         for n in op.output_arg_names:

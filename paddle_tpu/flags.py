@@ -210,6 +210,11 @@ DEFINE_bool("conv_first_s2d", False,
             "numerically exact, autotuned by bench.py")
 DEFINE_bool("debug_shapes", False,
             "raise (instead of recording) on shape-inference failures")
+DEFINE_bool("verify", False,
+            "run the paddle_tpu.analysis static verifier on every program "
+            "before its first trace (also enabled by PADDLE_TPU_VERIFY=1); "
+            "malformed programs raise ProgramVerifyError with the full "
+            "PT-code diagnostic list instead of a cryptic trace error")
 DEFINE_string("data_home", "~/.cache/paddle_tpu/dataset",
               "dataset cache directory (reference: v2/dataset common)")
 DEFINE_int32("log_period", 100,

@@ -118,6 +118,11 @@ def memory_optimize(input_program: ir.Program, print_log=False, level=0,
             print("memory_optimize: %s can reuse %s" % (reuse, dead))
         print("memory_optimize: %d reuse pairs (XLA buffer sharing), "
               "remat enabled" % len(pairs))
+    # self-check: every program-to-program transform proves it left the
+    # graph well-formed (cheap structural rules only — no deepcopy — so
+    # this does not tax the training-setup path it runs on)
+    from .analysis import check_after_pass
+    check_after_pass(input_program, "memory_optimize")
     return pairs
 
 

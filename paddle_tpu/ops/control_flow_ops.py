@@ -119,7 +119,8 @@ def _index_of(ctx, slot="I"):
     return int(np.asarray(raw_data(v)).reshape(-1)[0])
 
 
-@register_op("write_to_array", grad_maker=_write_to_array_grad_maker)
+@register_op("write_to_array", grad_maker=_write_to_array_grad_maker,
+             stateful_outputs=("Out",))
 def write_to_array(ctx):
     x = ctx.input("X")
     i = _index_of(ctx)

@@ -12,7 +12,11 @@ from ..core.registry import register_op
 
 
 def _infer_loss_rowwise(op, block, x_slot="X"):
-    xv = block._find_var_recursive(op.input(x_slot)[0])
+    # softmax_with_cross_entropy feeds its activations via "Logits"
+    names = op.input(x_slot) or op.input("Logits")
+    if not names:
+        return
+    xv = block._find_var_recursive(names[0])
     for slot in ("Y", "Out", "Loss"):
         for n in op.output(slot):
             ov = block._find_var_recursive(n)

@@ -208,6 +208,14 @@ class DistributeTranspiler(object):
                 base = v.name[:-len(ir.GRAD_SUFFIX)]
                 if base in specs:
                     specs[v.name] = specs[base]
+        # record the assignment on the program (the _shardings annotation
+        # slot the IR reserves) and self-check: the PT011 rule proves every
+        # annotated name exists with spec rank <= var rank, and the
+        # structural rules prove the program this context will jit is
+        # still well-formed — a sharding pass must not ship a broken graph
+        program._shardings = dict(specs)
+        from ..analysis import check_after_pass
+        check_after_pass(program, "DistributeTranspiler.transpile")
         return DistContext(mesh, strategy, specs)
 
 

@@ -1,0 +1,613 @@
+"""paddle_tpu.analysis: Program-IR verifier + lint framework.
+
+Two halves, matching the acceptance contract:
+- zero false positives: verify() must report NOTHING on every well-formed
+  program we can build — the book networks (built inline, no datasets) and
+  the models zoo;
+- golden defects: each seeded defect class maps to its exact stable PT
+  code (doc/diagnostics.md is the table).
+Plus the integration choke points (executor pre-trace hook, lint CLI,
+post-pass self-checks) and the ir.py satellites (numel(None-shape),
+create_var conflicts, bounded _shape_infer_failures).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import analysis, layers, models
+from paddle_tpu.analysis import (Diagnostic, ProgramVerifyError, Severity,
+                                 render_diagnostics, verify)
+from paddle_tpu.core import ir
+
+
+def codes(diags):
+    return sorted({d.code for d in diags})
+
+
+# ---------------------------------------------------------------------------
+# zero false positives over book-style networks and the model zoo
+# ---------------------------------------------------------------------------
+
+def _build_fit_a_line():
+    x = layers.data(name="x", shape=[13], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    y_predict = layers.fc(input=x, size=1, act=None)
+    avg = layers.mean(layers.square_error_cost(input=y_predict, label=y))
+    pt.optimizer.SGD(learning_rate=0.01).minimize(avg)
+
+
+def _build_recognize_digits():
+    img = layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    _pred, avg, _acc = models.lenet5(img, label)
+    pt.optimizer.Adam(learning_rate=0.001).minimize(avg)
+
+
+def _build_word2vec():
+    ws = [layers.data(name="w%d" % i, shape=[1], dtype="int64")
+          for i in range(4)]
+    nxt = layers.data(name="next_word", shape=[1], dtype="int64")
+    embs = [layers.embedding(w, size=[100, 16], dtype="float32",
+                             param_attr=pt.ParamAttr(name="shared_w"))
+            for w in ws]
+    hid = layers.fc(layers.concat(embs, axis=1), size=32, act="sigmoid")
+    pred = layers.fc(hid, size=100, act="softmax")
+    avg = layers.mean(layers.cross_entropy(input=pred, label=nxt))
+    pt.optimizer.SGD(learning_rate=0.001).minimize(avg)
+
+
+def _build_understand_sentiment_conv():
+    words = layers.data(name="words", shape=[1], dtype="int64", lod_level=1)
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    emb = layers.embedding(words, size=[200, 32], dtype="float32")
+    conv = layers.sequence_conv(emb, num_filters=16, filter_size=3,
+                                act="tanh")
+    pool = layers.sequence_pool(conv, pool_type="max")
+    pred = layers.fc(pool, size=2, act="softmax")
+    avg = layers.mean(layers.cross_entropy(input=pred, label=label))
+    pt.optimizer.Adam(learning_rate=0.002).minimize(avg)
+
+
+def _build_static_rnn_bptt():
+    T, B, D = 4, 2, 3
+    x = layers.data("x", shape=[T, B, D], append_batch_size=False)
+    x.stop_gradient = False
+    h_boot = layers.data("h_boot", shape=[B, D], append_batch_size=False)
+    h_boot.stop_gradient = False
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x)
+        h_pre = rnn.memory(init=h_boot)
+        h = layers.scale(layers.elementwise_add(x_t, h_pre), scale=1.0)
+        rnn.update_memory(h_pre, h)
+        rnn.step_output(h)
+    loss = layers.mean(rnn())
+    pt.append_backward(loss, parameter_list=["x", "h_boot"])
+
+
+def _build_while_array_sum():
+    d0 = layers.data("d0", shape=[10], append_batch_size=False)
+    d1 = layers.data("d1", shape=[10], append_batch_size=False)
+    i = layers.zeros(shape=[1], dtype="int64")
+    i.stop_gradient = True
+    init = layers.zeros(shape=[10], dtype="float32")
+    mem_array = layers.array_write(x=init, i=i)
+    data_array = layers.array_write(x=d0, i=i)
+    i = layers.increment(i)
+    layers.array_write(d1, i, array=data_array)
+    i = layers.zeros(shape=[1], dtype="int64")
+    i.stop_gradient = True
+    array_len = layers.fill_constant(shape=[1], dtype="int64", value=2)
+    array_len.stop_gradient = True
+    cond = layers.less_than(x=i, y=array_len)
+    while_op = layers.While(cond=cond)
+    with while_op.block():
+        d = layers.array_read(array=data_array, i=i)
+        prev = layers.array_read(array=mem_array, i=i)
+        result = layers.sums(input=[d, prev])
+        i = layers.increment(x=i, in_place=True)
+        layers.array_write(result, i=i, array=mem_array)
+        layers.less_than(x=i, y=array_len, cond=cond)
+    layers.array_read(array=mem_array, i=i)
+
+
+BOOK_BUILDERS = {
+    "fit_a_line": _build_fit_a_line,
+    "recognize_digits": _build_recognize_digits,
+    "word2vec": _build_word2vec,
+    "understand_sentiment_conv": _build_understand_sentiment_conv,
+    "static_rnn_bptt": _build_static_rnn_bptt,
+    "while_array_sum": _build_while_array_sum,
+}
+
+
+@pytest.mark.parametrize("name", sorted(BOOK_BUILDERS))
+def test_verify_book_programs_zero_false_positives(name):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        BOOK_BUILDERS[name]()
+    diags = verify(main)
+    assert diags == [], "main: %s" % render_diagnostics(diags)
+    diags = verify(startup)
+    assert diags == [], "startup: %s" % render_diagnostics(diags)
+
+
+def _zoo_classifier(build_fn, shape):
+    img = layers.data("img", shape=shape, dtype="float32")
+    label = layers.data("label", shape=[1], dtype="int64")
+    pred = build_fn(img)
+    avg = layers.mean(layers.cross_entropy(input=pred, label=label))
+    pt.optimizer.Momentum(learning_rate=0.01, momentum=0.9).minimize(avg)
+
+
+ZOO_BUILDERS = {
+    "mlp": lambda: models.mlp(layers.data("x", shape=[64]),
+                              layers.data("label", shape=[1],
+                                          dtype="int64")),
+    "lenet5": lambda: models.lenet5(layers.data("img", shape=[1, 28, 28]),
+                                    layers.data("label", shape=[1],
+                                                dtype="int64")),
+    "resnet_cifar10": lambda: _zoo_classifier(
+        lambda im: models.resnet_cifar10(im, depth=20), [3, 32, 32]),
+    "vgg_cifar": lambda: _zoo_classifier(models.vgg_cifar, [3, 32, 32]),
+    "alexnet": lambda: _zoo_classifier(
+        lambda im: models.alexnet(im, class_dim=10), [3, 224, 224]),
+}
+
+
+@pytest.mark.parametrize("name", sorted(ZOO_BUILDERS))
+def test_verify_model_zoo_zero_false_positives(name):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ZOO_BUILDERS[name]()
+    diags = verify(main) + verify(startup)
+    assert diags == [], render_diagnostics(diags)
+
+
+# ---------------------------------------------------------------------------
+# golden defects: each seeded defect yields its exact PT code
+# ---------------------------------------------------------------------------
+
+def _fresh_block():
+    prog = pt.Program()
+    return prog, prog.global_block()
+
+
+def _var(blk, name, shape=(2, 3)):
+    return blk.create_var(name=name, shape=shape, dtype="float32")
+
+
+def test_pt001_undefined_input():
+    prog, blk = _fresh_block()
+    _var(blk, "a")
+    out = _var(blk, "out")
+    blk.append_op("elementwise_add", inputs={"X": "a", "Y": "ghost"},
+                  outputs={"Out": out})
+    diags = verify(prog, rules=["PT001"])
+    assert codes(diags) == ["PT001"] and diags[0].var == "ghost"
+    assert diags[0].is_error
+
+
+def test_pt002_use_before_def():
+    prog, blk = _fresh_block()
+    a = _var(blk, "a")
+    mid = _var(blk, "mid")
+    out = _var(blk, "out")
+    # reads `mid` which is only produced by the NEXT op
+    blk.append_op("elementwise_add", inputs={"X": a, "Y": mid},
+                  outputs={"Out": out})
+    blk.append_op("scale", inputs={"X": a}, outputs={"Out": mid},
+                  attrs={"scale": 2.0})
+    diags = verify(prog, rules=["PT002"])
+    assert codes(diags) == ["PT002"] and diags[0].var == "mid"
+    with pytest.raises(ProgramVerifyError):
+        verify(prog, strict=True)
+
+
+def test_pt003_unregistered_op():
+    prog, blk = _fresh_block()
+    a = _var(blk, "a")
+    out = _var(blk, "out")
+    blk.append_op("definitely_not_an_op", inputs={"X": a},
+                  outputs={"Out": out})
+    diags = verify(prog, rules=["PT003"])
+    assert codes(diags) == ["PT003"]
+
+
+def test_pt004_shape_infer_failure_reported_not_swallowed():
+    prog, blk = _fresh_block()
+    a = blk.create_var(name="a", shape=(2, 3), dtype="float32")
+    b = blk.create_var(name="b", shape=(2, 3), dtype="float32")
+    out = blk.create_var(name="out", dtype="float32")
+    blk.append_op("concat", inputs={"X": [a, b]}, outputs={"Out": out},
+                  attrs={"axis": 5})  # axis out of range: infer raises
+    diags = verify(prog, rules=["PT004"])
+    assert "PT004" in codes(diags)
+
+
+def test_pt005_shape_conflict_after_manual_corruption():
+    prog, blk = _fresh_block()
+    a = _var(blk, "a", shape=(4, 8))
+    out = blk.create_var(name="out", dtype="float32")
+    blk.append_op("scale", inputs={"X": a}, outputs={"Out": out},
+                  attrs={"scale": 1.0})
+    assert verify(prog) == []
+    out.shape = (99, 99)  # stale annotation a broken pass would leave
+    diags = verify(prog, rules=["PT005"])
+    assert codes(diags) == ["PT005"] and diags[0].var == "out"
+
+
+def test_pt006_write_after_write():
+    prog, blk = _fresh_block()
+    out = _var(blk, "out")
+    blk.append_op("fill_constant", outputs={"Out": out},
+                  attrs={"shape": [2, 3], "value": 0.0,
+                         "dtype": "float32"})
+    blk.append_op("fill_constant", outputs={"Out": out},
+                  attrs={"shape": [2, 3], "value": 1.0,
+                         "dtype": "float32"})
+    diags = verify(prog, rules=["PT006"])
+    assert codes(diags) == ["PT006"]
+    assert diags[0].severity == Severity.WARNING
+
+
+def test_pt006_not_fired_for_stateful_or_read_between():
+    prog, blk = _fresh_block()
+    out = _var(blk, "out")
+    other = _var(blk, "other")
+    blk.append_op("fill_constant", outputs={"Out": out},
+                  attrs={"shape": [2, 3], "value": 0.0,
+                         "dtype": "float32"})
+    blk.append_op("scale", inputs={"X": out}, outputs={"Out": other},
+                  attrs={"scale": 1.0})  # read retires the pending write
+    blk.append_op("fill_constant", outputs={"Out": out},
+                  attrs={"shape": [2, 3], "value": 1.0,
+                         "dtype": "float32"})
+    assert verify(prog, rules=["PT006"]) == []
+
+
+def test_pt006_not_fired_when_read_happens_in_sub_block():
+    """The executor env is flat: a sub-block read consumes the parent
+    block's pending write, so overwriting afterwards is not a dead
+    store."""
+    prog = pt.Program()
+    blk = prog.global_block()
+    x = _var(blk, "x")
+    sub = prog.create_block()
+    sub_out = sub.create_var(name="sub_out", shape=(2, 3), dtype="float32")
+    blk.append_op("fill_constant", outputs={"Out": x},
+                  attrs={"shape": [2, 3], "value": 0.0,
+                         "dtype": "float32"})
+    sub.append_op("scale", inputs={"X": x}, outputs={"Out": sub_out},
+                  attrs={"scale": 1.0})
+    cond = _var(blk, "cond")
+    blk.append_op("fill_constant", outputs={"Out": cond},
+                  attrs={"shape": [1], "value": 1.0, "dtype": "float32"})
+    blk.append_op("while", inputs={"Cond": cond},
+                  outputs={"Out": sub_out},
+                  attrs={"sub_block": sub.idx})
+    blk.append_op("fill_constant", outputs={"Out": x},
+                  attrs={"shape": [2, 3], "value": 1.0,
+                         "dtype": "float32"})
+    assert verify(prog, rules=["PT006"]) == []
+
+
+def test_verify_with_fetches_survives_self_referential_sub_block():
+    """A corrupt sub_block attr pointing at the op's own block must come
+    back as PT010, not crash the dead-op reachability walk."""
+    prog, blk = _fresh_block()
+    a = _var(blk, "a")
+    out = _var(blk, "out")
+    blk.append_op("scale", inputs={"X": a}, outputs={"Out": out},
+                  attrs={"scale": 1.0, "sub_block": 0})
+    diags = verify(prog, fetches=["out"])
+    assert "PT010" in codes(diags)
+
+
+def test_pt007_orphan_grad():
+    prog, blk = _fresh_block()
+    _var(blk, "x@GRAD")
+    diags = verify(prog, rules=["PT007"])
+    assert codes(diags) == ["PT007"] and diags[0].var == "x@GRAD"
+
+
+def test_pt008_dead_var():
+    prog, blk = _fresh_block()
+    a = _var(blk, "a")
+    out = _var(blk, "out")
+    _var(blk, "never_touched")
+    blk.append_op("scale", inputs={"X": a}, outputs={"Out": out},
+                  attrs={"scale": 1.0})
+    diags = verify(prog, rules=["PT008"])
+    assert codes(diags) == ["PT008"]
+    assert diags[0].var == "never_touched"
+
+
+def test_pt009_unused_parameter():
+    prog = pt.Program()
+    blk = prog.global_block()
+    blk.create_parameter(name="w_unused", shape=[4, 4], dtype="float32")
+    diags = verify(prog, rules=["PT009"])
+    assert codes(diags) == ["PT009"] and diags[0].var == "w_unused"
+
+
+def test_pt010_bad_sub_block_index():
+    prog, blk = _fresh_block()
+    a = _var(blk, "a")
+    blk.append_op("while", inputs={"Cond": a}, outputs={},
+                  attrs={"sub_block": 99})
+    diags = verify(prog, rules=["PT010"])
+    assert codes(diags) == ["PT010"] and diags[0].is_error
+
+
+def test_pt010_parent_cycle():
+    prog = pt.Program()
+    b1 = prog.create_block()
+    b1.parent_idx = 1  # self-cycle
+    diags = verify(prog, rules=["PT010"])
+    assert codes(diags) == ["PT010"]
+
+
+def test_pt011_sharding_mismatch():
+    from jax.sharding import PartitionSpec as P
+    prog, blk = _fresh_block()
+    a = _var(blk, "a", shape=(4, 8))
+    out = _var(blk, "out")
+    blk.append_op("scale", inputs={"X": a}, outputs={"Out": out},
+                  attrs={"scale": 1.0})
+    prog._shardings["nonexistent"] = P("dp")
+    diags = verify(prog, rules=["PT011"])
+    assert codes(diags) == ["PT011"] and diags[0].var == "nonexistent"
+    prog._shardings.clear()
+    prog._shardings["a"] = P("dp", None, "tp")  # rank 3 > var rank 2
+    diags = verify(prog, rules=["PT011"])
+    assert codes(diags) == ["PT011"] and diags[0].var == "a"
+    prog._shardings["a"] = P("dp")  # rank 1 <= 2: fine
+    del prog._shardings["a"]
+
+
+def test_pt012_create_var_conflict_warns_and_diagnoses():
+    prog, blk = _fresh_block()
+    blk.create_var(name="v", shape=[2, 3], dtype="float32")
+    with pytest.warns(RuntimeWarning, match="create_var"):
+        v = blk.create_var(name="v", shape=[4, 5], dtype="float32")
+    assert tuple(v.shape) == (2, 3)  # existing var returned unchanged
+    with pytest.warns(RuntimeWarning, match="dtype"):
+        blk.create_var(name="v", dtype="int64")
+    diags = verify(prog, rules=["PT012"])
+    assert codes(diags) == ["PT012"] and len(diags) == 2
+
+
+def test_create_var_no_conflict_cases():
+    prog, blk = _fresh_block()
+    blk.create_var(name="v", shape=[-1, 3], dtype="float32")
+    # same rank, batch wildcard on either side: no conflict
+    blk.create_var(name="v", shape=[16, 3], dtype="float32")
+    blk.create_var(name="v")  # bare re-get
+    assert not getattr(prog, "_var_def_conflicts", [])
+
+
+def test_pt013_recorded_shape_failures_bounded():
+    prog, blk = _fresh_block()
+    a = blk.create_var(name="a", shape=(2, 3), dtype="float32")
+    b = blk.create_var(name="b", shape=(2, 3), dtype="float32")
+    for i in range(ir.SHAPE_INFER_FAILURE_CAP + 10):
+        out = blk.create_var(name="out%d" % i, dtype="float32")
+        blk.append_op("concat", inputs={"X": [a, b]},
+                      outputs={"Out": out}, attrs={"axis": 5})
+    assert len(prog._shape_infer_failures) == ir.SHAPE_INFER_FAILURE_CAP
+    assert prog._shape_infer_dropped == 10
+    diags = verify(prog, rules=["PT013"])
+    assert codes(diags) == ["PT013"]
+    # cap + 1 summary line about the dropped remainder
+    assert len(diags) == ir.SHAPE_INFER_FAILURE_CAP + 1
+
+
+def test_pt014_dead_op_with_fetches():
+    prog, blk = _fresh_block()
+    a = _var(blk, "a")
+    used = _var(blk, "used")
+    stray = _var(blk, "stray")
+    blk.append_op("scale", inputs={"X": a}, outputs={"Out": used},
+                  attrs={"scale": 1.0})
+    blk.append_op("scale", inputs={"X": a}, outputs={"Out": stray},
+                  attrs={"scale": 3.0})
+    diags = verify(prog, fetches=["used"], rules=["PT014"])
+    assert codes(diags) == ["PT014"] and diags[0].op_idx == 1
+    # without fetches the rule is inert (every sink is a potential fetch)
+    assert verify(prog, rules=["PT014"]) == []
+
+
+def test_distinct_codes_per_defect_class():
+    """The acceptance contract: every seeded defect class maps to its own
+    stable code — no two classes share one."""
+    seen = {cls.code for cls in analysis.registered_rules()}
+    assert len(seen) == len(analysis.registered_rules())
+    all_emitted = [c for cls in analysis.registered_rules()
+                   for c in getattr(cls, "emits", (cls.code,))]
+    assert len(all_emitted) == len(set(all_emitted))
+    assert set(all_emitted) == {
+        "PT001", "PT002", "PT003", "PT004", "PT005", "PT006", "PT007",
+        "PT008", "PT009", "PT010", "PT011", "PT012", "PT013", "PT014"}
+
+
+# ---------------------------------------------------------------------------
+# runner plumbing
+# ---------------------------------------------------------------------------
+
+def test_rule_selection_by_code_name_and_class():
+    from paddle_tpu.analysis.rules import UnregisteredOpRule
+    prog, blk = _fresh_block()
+    a = _var(blk, "a")
+    blk.append_op("bogus_op", inputs={"X": a}, outputs={})
+    for sel in (["PT003"], ["unregistered-op"], [UnregisteredOpRule],
+                [UnregisteredOpRule()]):
+        assert codes(verify(prog, rules=sel)) == ["PT003"]
+    with pytest.raises(ValueError):
+        verify(prog, rules=["PT999"])
+
+
+def test_render_and_error_shape():
+    d1 = Diagnostic("PT001", Severity.ERROR, "boom", block_idx=0, op_idx=3,
+                    var="x", hint="fix it")
+    d2 = Diagnostic("PT006", Severity.WARNING, "meh")
+    text = render_diagnostics([d2, d1])
+    assert "PT001 error" in text and "1 error(s), 1 warning(s)" in text
+    assert text.index("PT001") < text.index("PT006")  # errors first
+    err = ProgramVerifyError([d1, d2], context="unit-test")
+    assert "unit-test" in str(err) and len(err.errors) == 1
+
+
+def test_variable_numel():
+    prog, blk = _fresh_block()
+    v = blk.create_var(name="shaped", shape=[4, -1, 3], dtype="float32")
+    assert v.numel() == 12
+    unshaped = blk.create_var(name="unshaped", dtype="float32")
+    assert unshaped.shape is None
+    assert unshaped.numel() is None  # used to raise TypeError
+
+
+# ---------------------------------------------------------------------------
+# integration choke points
+# ---------------------------------------------------------------------------
+
+def _broken_program():
+    prog, blk = _fresh_block()
+    a = _var(blk, "a")
+    mid = _var(blk, "mid")
+    out = _var(blk, "out")
+    blk.append_op("elementwise_add", inputs={"X": a, "Y": mid},
+                  outputs={"Out": out})
+    blk.append_op("scale", inputs={"X": a}, outputs={"Out": mid},
+                  attrs={"scale": 2.0})
+    return prog
+
+
+def test_executor_pretrace_hook_via_flag():
+    exe = pt.Executor(pt.CPUPlace())
+    prog = _broken_program()
+    with pt.flags_guard(verify=True):
+        with pytest.raises(ProgramVerifyError) as ei:
+            exe.run(prog, feed={"a": np.zeros((2, 3), np.float32)},
+                    fetch_list=["out"])
+    assert "PT002" in str(ei.value)
+
+
+def test_executor_pretrace_hook_via_env(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_VERIFY", "1")
+    exe = pt.Executor(pt.CPUPlace())
+    with pytest.raises(ProgramVerifyError):
+        exe.run(_broken_program(),
+                feed={"a": np.zeros((2, 3), np.float32)},
+                fetch_list=["out"])
+
+
+def test_executor_pretrace_hook_passes_clean_program():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        out = layers.scale(x, scale=2.0)
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.flags_guard(verify=True):
+        exe.run(startup)
+        got, = exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                       fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(got), 2 * np.ones((2, 4)))
+    # verified once per (uid, version): cached on the second run
+    assert (main._uid, main._version) in exe._verified
+
+
+def test_memory_optimize_self_checks():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        _build_fit_a_line()
+    pairs = pt.memory_optimize(main)  # clean program: no raise
+    assert isinstance(pairs, list)
+    with pytest.raises(ProgramVerifyError):
+        pt.memory_optimize(_broken_program())
+
+
+def test_transpile_self_checks_and_annotates():
+    from paddle_tpu.parallel import DistributeTranspiler, make_mesh
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        _build_fit_a_line()
+    mesh = make_mesh({"dp": -1})
+    ctx = DistributeTranspiler().transpile(program=main, mesh=mesh)
+    assert main._shardings  # the pass now records its assignment
+    assert set(main._shardings) == set(ctx.specs)
+    assert verify(main) == []  # incl. the PT011 consistency rule
+    with pytest.raises(ProgramVerifyError):
+        DistributeTranspiler().transpile(program=_broken_program(),
+                                         mesh=mesh)
+
+
+def test_lint_cli(tmp_path):
+    from paddle_tpu.cli import main as cli_main
+    good = tmp_path / "good_config.py"
+    good.write_text(
+        "import paddle_tpu as pt\n"
+        "from paddle_tpu import layers\n\n"
+        "def model():\n"
+        "    x = layers.data(name='x', shape=[8], dtype='float32')\n"
+        "    y = layers.data(name='y', shape=[1], dtype='float32')\n"
+        "    pred = layers.fc(input=x, size=1)\n"
+        "    avg = layers.mean(layers.square_error_cost(pred, y))\n"
+        "    return {'cost': avg, 'feed_list': [x, y], 'reader': None}\n")
+    assert cli_main(["lint", str(good)]) == 0
+    dot = tmp_path / "g.dot"
+    assert cli_main(["lint", str(good), "--dot", str(dot)]) == 0
+    assert dot.exists() and "digraph" in dot.read_text()
+
+    bad = tmp_path / "bad_config.py"
+    bad.write_text(
+        "import paddle_tpu as pt\n\n"
+        "def model():\n"
+        "    prog = pt.default_main_program()\n"
+        "    blk = prog.global_block()\n"
+        "    a = blk.create_var(name='a', shape=[2], dtype='float32')\n"
+        "    mid = blk.create_var(name='mid', shape=[2],"
+        " dtype='float32')\n"
+        "    out = blk.create_var(name='out', shape=[2],"
+        " dtype='float32')\n"
+        "    blk.append_op('elementwise_add',"
+        " inputs={'X': a, 'Y': mid}, outputs={'Out': out})\n"
+        "    blk.append_op('scale', inputs={'X': a},"
+        " outputs={'Out': mid}, attrs={'scale': 2.0})\n"
+        "    return {'cost': out, 'feed_list': [a], 'reader': None}\n")
+    assert cli_main(["lint", str(bad)]) == 1
+
+    broken = tmp_path / "broken_config.py"
+    broken.write_text("def model():\n    raise RuntimeError('nope')\n")
+    assert cli_main(["lint", str(broken)]) == 2
+
+
+def test_lint_strict_fails_on_warnings(tmp_path):
+    from paddle_tpu.cli import main as cli_main
+    cfg = tmp_path / "warny.py"
+    cfg.write_text(
+        "import paddle_tpu as pt\n"
+        "from paddle_tpu import layers\n\n"
+        "def model():\n"
+        "    x = layers.data(name='x', shape=[8], dtype='float32')\n"
+        "    out = layers.scale(x, scale=1.0)\n"
+        "    blk = pt.default_main_program().global_block()\n"
+        "    blk.create_var(name='dead_weight', shape=[2],"
+        " dtype='float32')\n"
+        "    return {'cost': out, 'feed_list': [x], 'reader': None}\n")
+    assert cli_main(["lint", str(cfg)]) == 0       # warning only
+    assert cli_main(["lint", str(cfg), "--strict"]) == 1
+
+
+def test_draw_block_graphviz_op_highlights(tmp_path):
+    from paddle_tpu import debugger
+    prog, blk = _fresh_block()
+    a = _var(blk, "a")
+    out = _var(blk, "out")
+    blk.append_op("scale", inputs={"X": a}, outputs={"Out": out},
+                  attrs={"scale": 1.0})
+    path = str(tmp_path / "g.dot")
+    text = debugger.draw_block_graphviz(blk, op_highlights={0}, path=path)
+    assert '#ff6188' in text and os.path.exists(path)

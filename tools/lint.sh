@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Static-analysis gate: run the Program-IR verifier (`paddle_tpu lint`)
+# over every book config, then a pyflakes pass over the package when the
+# tool is available (the CI image may not ship it; we never pip install
+# from this script).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+rc=0
+for cfg in examples/configs/*.py; do
+  echo "== paddle_tpu lint $cfg"
+  python -m paddle_tpu lint "$cfg" || rc=1
+done
+
+if python -c "import pyflakes" >/dev/null 2>&1; then
+  echo "== pyflakes paddle_tpu"
+  python -m pyflakes paddle_tpu || rc=1
+else
+  echo "== pyflakes not installed; skipping"
+fi
+
+exit $rc
