@@ -783,6 +783,36 @@ def child_main(tag):
             final = rec
         _emit(final)
 
+    # -- async execution pipeline: sync vs pipelined Trainer loop ----------
+    # BENCH_PIPELINE=0 skips; by default BOTH modes run and both numbers
+    # (plus the overlap counters) land on the banked record, so the
+    # pipeline's win — or a regression — is in the BENCH_*.json evidence.
+    # Cheap and CPU-capable: runs on the tier-1 fallback child too.
+    if os.environ.get("BENCH_PIPELINE", "1") != "0" and _remaining() > 90:
+        wd.phase("pipeline", min(max(_remaining() - 30, 1), 420))
+        try:
+            # shared harness (same code as the tools/perf_smoke.sh gate)
+            from benchmark.pipeline_bench import bench as pipeline_bench
+            prec = pipeline_bench()
+            _log(tag, "pipeline: sync %.2f -> pipelined %.2f steps/s "
+                 "(x%.2f), feed_wait %.2f ms/step vs %.2f ms/step, "
+                 "parity=%s"
+                 % (prec["pipeline_sync_steps_s"],
+                    prec["pipeline_steps_s"], prec["pipeline_speedup"],
+                    prec["pipeline_feed_wait_ms_per_step"],
+                    prec["pipeline_ms_per_step"],
+                    prec["pipeline_parity"]))
+            if final is not None:
+                final = dict(final)
+                final.update(prec)
+                _emit(final)
+            else:
+                _emit(dict({"kind": "pipeline"}, **prec))
+        except Exception as e:
+            _log(tag, "pipeline phase failed: %r" % e)
+        finally:
+            wd.clear()
+
     # -- autotune the conv lowering, then re-measure if picks changed ------
     if (final is not None and platform != "cpu" and _remaining() > 360):
         wd.phase("autotune", max(_remaining(), 1))

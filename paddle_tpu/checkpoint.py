@@ -202,16 +202,33 @@ def _retained_dir(root, step):
     return os.path.join(root, "ckpt-%08d" % step), step
 
 
+def _mtime_or_none(path):
+    """mtime of ``path``, or None if a concurrent prune deleted it between
+    listdir and stat — a vanished candidate must not fail an intact save."""
+    try:
+        return os.path.getmtime(path)
+    except (FileNotFoundError, NotADirectoryError):
+        return None
+
+
 def _prune(root, keep_last):
     """Drop all but the newest ``keep_last`` COMPLETE checkpoints under
     ``root`` (torn/partial dirs are left for inspection — they are
-    skipped by latest_checkpoint and cheap to remove by hand)."""
+    skipped by latest_checkpoint and cheap to remove by hand). Tolerant
+    of concurrent prunes (async_ saves overlap): entries deleted under
+    our feet are simply skipped."""
     cands = [os.path.join(root, d) for d in os.listdir(root)
              if os.path.isdir(os.path.join(root, d))
              and not d.endswith((".tmp", ".old"))]
-    cands = [d for d in cands if _is_complete(d)]
-    cands.sort(key=lambda d: (os.path.getmtime(d), d), reverse=True)
-    for stale in cands[keep_last:]:
+    stamped = []
+    for d in cands:
+        if not _is_complete(d):
+            continue
+        mt = _mtime_or_none(d)
+        if mt is not None:
+            stamped.append((mt, d))
+    stamped.sort(reverse=True)
+    for _, stale in stamped[keep_last:]:
         shutil.rmtree(stale, ignore_errors=True)
 
 
@@ -288,8 +305,10 @@ def latest_checkpoint(root):
     cands = [os.path.join(root, d) for d in os.listdir(root)
              if os.path.isdir(os.path.join(root, d))
              and not d.endswith((".tmp", ".old"))]
-    cands = [d for d in cands if _is_complete(d)]
-    return max(cands, key=os.path.getmtime) if cands else None
+    # same concurrent-prune tolerance as _prune: stat can lose the race
+    stamped = [(_mtime_or_none(d), d) for d in cands if _is_complete(d)]
+    stamped = [(mt, d) for mt, d in stamped if mt is not None]
+    return max(stamped)[1] if stamped else None
 
 
 def _read_shard(dirname, sh, verify):

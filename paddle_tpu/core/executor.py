@@ -416,6 +416,81 @@ def _dist_shardings(dist, state, feed):
     return (state_sh, feed_sh, repl)
 
 
+class AsyncFetch(object):
+    """Lazy fetch handle (``Executor.run(..., sync=False)``).
+
+    Wraps a still-on-device value instead of round-tripping it through
+    ``block_until_ready`` + numpy on every step — the fetch half of the
+    async execution pipeline (see paddle_tpu/pipeline.py). The device
+    value materialises to host exactly once, at first access:
+
+    - ``value()`` / ``numpy()`` / ``float(h)`` / ``np.asarray(h)``
+    - ``block()`` waits for the device computation WITHOUT transferring
+    - ``ready`` polls completion without blocking
+
+    Materialisation is counted in the owning Executor's
+    ``stats["fetch_sync_count"]`` so the pipeline's sync points stay
+    observable.
+    """
+
+    __slots__ = ("_value", "_host", "_done", "_return_numpy", "_stats")
+
+    def __init__(self, value, return_numpy=True, stats=None):
+        self._value = value
+        self._return_numpy = return_numpy
+        self._host = None
+        self._done = False
+        self._stats = stats
+
+    @property
+    def ready(self):
+        """True once the device computation behind this value finished
+        (a materialised handle is trivially ready)."""
+        if self._done:
+            return True
+        try:
+            return all(l.is_ready() for l
+                       in jax.tree_util.tree_leaves(self._value)
+                       if hasattr(l, "is_ready"))
+        except Exception:
+            return True
+
+    def block(self):
+        """Wait for the device value without fetching it to host."""
+        try:
+            jax.block_until_ready(self._value)
+        except Exception:
+            pass  # host-side values (eager path) have nothing to wait on
+        return self
+
+    def value(self):
+        """Materialise (once) and return the host value."""
+        if not self._done:
+            self._host = _fetch_to_host(self._value, self._return_numpy)
+            self._done = True
+            self._value = None  # release the device buffer reference
+            if self._stats is not None:
+                self._stats["fetch_sync_count"] += 1
+            from .. import profiler as _prof
+            _prof.update_pipeline_counters(fetch_sync_count=1)
+        return self._host
+
+    def numpy(self):
+        return np.asarray(self.value())
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self.value())
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(np.asarray(self.value()).reshape(-1)[0])
+
+    def __repr__(self):
+        state = ("materialized" if self._done
+                 else "ready" if self.ready else "pending")
+        return "AsyncFetch(%s)" % state
+
+
 def _fetch_to_host(val, return_numpy=True):
     if isinstance(val, ConcreteScalar):
         val = val.data
@@ -432,6 +507,23 @@ def _fetch_to_host(val, return_numpy=True):
     if return_numpy:
         return np.asarray(val)
     return val
+
+
+# Process-level warm-start compile registry: compiled step functions keyed
+# exactly like the per-Executor cache, shared across Executor instances so a
+# second Executor over the same (program uid, version, feed signature) skips
+# the trace+compile entirely (the in-process half of the persistent compile
+# cache; the cross-process half is jax's compilation_cache_dir, configured by
+# paddle_tpu.pipeline.maybe_enable_compile_cache). Bounded: cleared wholesale
+# past _WARM_JIT_LIMIT entries (keys embed program uids, which are never
+# reused in-process, so stale entries are dead weight, not corruption).
+_WARM_JIT_CACHE: Dict[Any, Any] = {}
+_WARM_JIT_LIMIT = 256
+
+
+def clear_warm_cache():
+    """Drop the process-level compiled-step registry (test isolation)."""
+    _WARM_JIT_CACHE.clear()
 
 
 class Executor(object):
@@ -453,8 +545,15 @@ class Executor(object):
         self._check_nan_inf_arg = check_nan_inf
         # which path each run() took — tests assert dynamic-control-flow
         # programs really compile (VERDICT r1 item 3); hybrid = host ops
-        # interpreted between jitted device segments
-        self.stats = {"jit_runs": 0, "eager_runs": 0, "hybrid_runs": 0}
+        # interpreted between jitted device segments. The pipeline counters
+        # (lazy_fetches/fetch_sync_count/compile_cache_hits/feed_wait_ms/
+        # dispatch_depth) make the async execution pipeline observable:
+        # overlap is only real when feed_wait stays below step time and
+        # fetch syncs stay rare (see doc/async_pipeline.md)
+        self.stats = {"jit_runs": 0, "eager_runs": 0, "hybrid_runs": 0,
+                      "lazy_fetches": 0, "fetch_sync_count": 0,
+                      "compile_cache_hits": 0, "feed_wait_ms": 0.0,
+                      "dispatch_depth": 0}
         # programs whose trace hit data-dependent control flow: run eager
         self._force_eager = set()
         # (uid, version) pairs already checked by the pre-trace verifier
@@ -545,14 +644,24 @@ class Executor(object):
 
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_jit=True, feed_var_name="feed",
-            fetch_var_name="fetch", dist_context=None, repeat=1):
+            fetch_var_name="fetch", dist_context=None, repeat=1,
+            sync=True):
         """``repeat=K`` compiles K whole training steps into one
         ``lax.scan`` dispatch (fetches come from the last step). This is the
         standard TPU step-fusion pattern: one host round-trip amortises K
         steps of dispatch and argument shipping — the modern analog of the
         reference's num_batches_per_send_parameter local accumulation
         (reference: utils/Flags.cpp:44-65). Requires the jit path and a
-        constant feed across the K steps."""
+        constant feed across the K steps.
+
+        ``sync=False`` returns :class:`AsyncFetch` handles backed by the
+        still-on-device fetch values instead of blocking on a device->host
+        transfer per call — the dispatch stays asynchronous and the host
+        is free to prepare the next feed while the device computes (the
+        fetch half of paddle_tpu.pipeline). Values materialise lazily at
+        first access; paths that compute eagerly on the host
+        (``check_nan_inf``, host ops) still return handles, just trivially
+        ready ones."""
         program = program if program is not None else ir.default_main_program()
         self._maybe_verify(program)
         scope = scope if scope is not None else global_scope()
@@ -644,6 +753,10 @@ class Executor(object):
             jax.block_until_ready([raw_data(o) for o in outs])
             _prof.record_run("program_%d_run" % program._uid,
                              time.perf_counter() - t0)
+        if not sync:
+            self.stats["lazy_fetches"] += len(outs)
+            return [AsyncFetch(o, return_numpy=return_numpy,
+                               stats=self.stats) for o in outs]
         return [_fetch_to_host(o, return_numpy) for o in outs]
 
     # -- hybrid path: jitted device segments + interpreted host ops ----------
@@ -854,18 +967,30 @@ class Executor(object):
                state_sig)
         fn = self._cache.get(key)
         if fn is None:
+            # warm start: another Executor in this process already compiled
+            # this exact (program, feed signature, fetches, state) step
+            fn = _WARM_JIT_CACHE.get(key)
+            if fn is not None:
+                self._cache[key] = fn
+                self.stats["compile_cache_hits"] += 1
+                _prof.update_pipeline_counters(compile_cache_hits=1)
+        if fn is None:
             shardings = (_dist_shardings(dist, state, feed)
                          if dist is not None else None)
             fn = self._compile(program, feed, fetch_names, state_names,
                                shardings=shardings, dist=dist,
                                repeat=repeat)
             self._cache[key] = fn
+            if len(_WARM_JIT_CACHE) >= _WARM_JIT_LIMIT:
+                _WARM_JIT_CACHE.clear()
+            _WARM_JIT_CACHE[key] = fn
         rng_key = self._rng_key(program, scope)
         try:
             fetches, new_state, new_key = fn(state, feed, rng_key)
         except Exception:
             # a failed first trace must not leave a dead compiled fn cached
             self._cache.pop(key, None)
+            _WARM_JIT_CACHE.pop(key, None)
             raise
         for n, v in new_state.items():
             scope.set_var(n, v)
@@ -874,6 +999,11 @@ class Executor(object):
 
     def _compile(self, program, feed_template, fetch_names, state_names,
                  shardings=None, dist=None, repeat=1):
+        # first compile in the process configures jax's on-disk XLA cache
+        # (~/.cache/paddle_tpu/xla by default; FLAGS.compile_cache=0 opts
+        # out) so repeat runs skip the cold compile entirely
+        from ..pipeline import maybe_enable_compile_cache
+        maybe_enable_compile_cache()
         block = program.global_block()
         persist = self._persistable_names(program)
         written = {n for op_ in _iter_ops(block) for n in op_.output_arg_names}

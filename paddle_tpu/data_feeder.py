@@ -73,13 +73,21 @@ class DataFeeder(object):
             self.feed_shapes.append(each_var.shape)
             self.feed_dtypes.append(convert_dtype(each_var.dtype))
         self.place = place
+        # per-field converter specs, resolved once: feed() builds fresh
+        # converters from these each call, so it carries no mutable state
+        # between calls — safe to run on the async pipeline's feed thread
+        # concurrently with Executor.run on the main thread
+        self._converter_specs = list(zip(self.feed_lod_level,
+                                         self.feed_shapes,
+                                         self.feed_dtypes))
 
     def feed(self, iterable):
+        """Minibatch (iterable of per-sample field tuples) -> feed dict.
+        Stateless per call (thread-safe; see _converter_specs)."""
         converters = [
             DataToLoDTensorConverter(lod_level=lod, shape=shape or (),
                                      dtype=dtype)
-            for lod, shape, dtype in zip(self.feed_lod_level,
-                                         self.feed_shapes, self.feed_dtypes)]
+            for lod, shape, dtype in self._converter_specs]
         for each_sample in iterable:
             if len(each_sample) != len(converters):
                 raise ValueError(

@@ -415,11 +415,13 @@ class AsyncSGDUpdater(object):
                                         in self._last_params.items()}
         if sparse_rows is None:
             # only full pulls are cacheable: a row-subset pull would
-            # freeze every OTHER row at whatever the cache held. The
-            # arrays are freshly unpickled from this reply, so holding
-            # references costs nothing per step; the degraded serve path
-            # copies on the way out
-            self._last_params = rep["params"]
+            # freeze every OTHER row at whatever the cache held. Copy on
+            # store: callers (pull_into -> scope, optimizer updates) may
+            # mutate the returned arrays in place, and a degraded-mode
+            # serve must reflect the pserver's last reply, not whatever
+            # the trainer did to those buffers since
+            self._last_params = {k: np.array(v, copy=True)
+                                 for k, v in rep["params"].items()}
             self._last_version = rep["version"]
         return rep["version"], rep["params"]
 

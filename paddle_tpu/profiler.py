@@ -18,12 +18,15 @@ __all__ = ["timer", "stat_summary", "print_stats", "reset_stats",
            "BarrierStat",
            "start_profiler", "stop_profiler", "reset_profiler", "profiler",
            "cuda_profiler", "xla_trace", "profiler_enabled", "record_run",
-           "record_op_event", "record_program_analysis", "write_timeline"]
+           "record_op_event", "record_program_analysis", "write_timeline",
+           "update_pipeline_counters", "pipeline_counters",
+           "reset_pipeline_counters"]
 
 _enabled = False
 _records = defaultdict(list)  # label -> [seconds]
 _op_events = []               # chrome-trace X events (eager per-op spans)
 _program_analyses = {}        # label -> {flops, bytes, collectives, ...}
+_pipeline_counters = defaultdict(float)  # async-pipeline observability
 _T0 = time.perf_counter()
 
 
@@ -63,6 +66,29 @@ def reset_profiler():
     _records.clear()
     del _op_events[:]
     _program_analyses.clear()
+    _pipeline_counters.clear()
+
+
+def update_pipeline_counters(**counters):
+    """Accumulate async-pipeline observability counters (always on — a
+    few dict adds per pass/materialisation, not per op). Keys in use:
+    ``feed_wait_ms``, ``dispatch_depth`` (kept as a max, not a sum),
+    ``fetch_sync_count``, ``compile_cache_hits``, ``pipeline_batches``,
+    ``slot_reuse``, ``fallback_sync``."""
+    for k, v in counters.items():
+        if k == "dispatch_depth":
+            _pipeline_counters[k] = max(_pipeline_counters[k], float(v))
+        else:
+            _pipeline_counters[k] += float(v)
+
+
+def pipeline_counters():
+    """Snapshot {counter: value} of the async-pipeline counters."""
+    return dict(_pipeline_counters)
+
+
+def reset_pipeline_counters():
+    _pipeline_counters.clear()
 
 
 def record_op_event(op_type, name, t_start, t_end):
@@ -140,6 +166,9 @@ def write_timeline(path):
     - ``host_events``: aggregated wall-time table (profiler.h role).
     - ``programs``: per-compiled-program XLA cost analysis, collective
       census ('barrier stat' for mesh runs) and memory analysis.
+    - ``pipeline``: async-execution-pipeline counters (feed-wait ms,
+      dispatch depth, fetch syncs, compile-cache hits) — the overlap
+      evidence for paddle_tpu.pipeline.
     """
     import json
     rows = []
@@ -155,6 +184,7 @@ def write_timeline(path):
         "trace_events": list(_op_events),
         "host_events": rows,
         "programs": dict(_program_analyses),
+        "pipeline": dict(_pipeline_counters),
     }
     with open(path, "w") as f:
         json.dump(artifact, f, indent=1)

@@ -140,10 +140,19 @@ def compose(*readers, **kwargs):
 def buffered(reader, size):
     """Background-thread prefetch buffer.
     reference: v2/reader/decorator.py buffered (and the double-buffer thread
-    in gserver/dataproviders/DataProvider.h DoubleBufferedDataProvider)."""
+    in gserver/dataproviders/DataProvider.h DoubleBufferedDataProvider).
+
+    A producer-thread exception is re-raised in the consumer instead of
+    silently truncating the stream — the host-side feed stage of
+    paddle_tpu.pipeline relies on this to tell "reader done" from
+    "reader died"."""
 
     class _End(object):
         pass
+
+    class _Err(object):
+        def __init__(self, error):
+            self.error = error
 
     def data_reader():
         r = reader()
@@ -153,8 +162,9 @@ def buffered(reader, size):
             try:
                 for d in r:
                     q.put(d)
-            finally:
                 q.put(_End())
+            except BaseException as e:
+                q.put(_Err(e))
 
         t = threading.Thread(target=feed, daemon=True)
         t.start()
@@ -162,6 +172,8 @@ def buffered(reader, size):
             e = q.get()
             if isinstance(e, _End):
                 break
+            if isinstance(e, _Err):
+                raise e.error
             yield e
 
     return data_reader

@@ -23,6 +23,10 @@ site                      where
 ``async_sgd.pull_params`` pserver->trainer parameter pull, per RPC attempt
 ``reader.next``           each record out of the native recordio reader
 ``dataset.download``      each dataset cache-lookup attempt
+``pipeline.feed_next``    the async pipeline's feed thread, per batch,
+                          before feed conversion + device_put (a raise
+                          kills the thread -> recorded fallback to
+                          synchronous feeding)
 ========================  ====================================================
 
 Spec grammar (env var or ``load_fault_spec`` string)::
@@ -150,29 +154,48 @@ def _corrupt_bytes(data, rng):
 def fault_point(site, payload=None):
     """Declare a failure-relevant edge. Returns ``payload`` (possibly
     corrupted); raises/delays when the site is armed and the hit count is
-    inside the firing window. Disarmed cost: one dict lookup."""
+    inside the firing window. Disarmed cost: one LOCK-FREE dict lookup —
+    this sits on pipelined hot loops (reader.next, pipeline.feed_next),
+    where taking the registry lock per call would serialise the feed
+    thread against arm/disarm and every other instrumented site."""
     _load_env_once()
+    if site not in _faults:
+        # read-mostly fast path: membership reads on a dict are atomic
+        # under CPython, and arming is a rare, test-time event. A racing
+        # arm() is picked up on the next hit — counting starts "when a
+        # site is armed" only up to that one-call window.
+        return payload
     with _lock:
         f = _faults.get(site)
-        if f is None:
+        if f is None:  # disarmed between the lock-free check and here
             return payload
         f.hits += 1
         if not f.should_fire():
             return payload
         f.fired += 1
-        action, fired = f.action, f.fired
+        # capture EVERYTHING this firing needs while still under the
+        # lock: concurrent hits at the same armed site (overlapping
+        # async checkpoint saves) would otherwise read each other's
+        # f.hits/f.fired and derive the same corruption seed / wrong
+        # hit numbers
+        action, hits, fired = f.action, f.hits, f.fired
+        exc, message, delay, seed = f.exc, f.message, f.delay, f.seed
     record_event("fault_injected", site=site, action=action, hit=fired)
     if action == "raise":
-        raise f.exc(f.message or
-                    "injected fault at %r (hit %d)" % (site, f.hits))
+        raise exc(message or
+                  "injected fault at %r (hit %d)" % (site, hits))
     if action == "delay":
-        time.sleep(f.delay)
+        time.sleep(delay)
         return payload
     # corrupt: only byte-like payloads carry data to damage; a site that
     # passes nothing just counts the hit
     if payload is None:
         return payload
-    rng = random.Random((f.seed, f.fired))
+    # int seed: seeding random.Random with a non-int hashable is
+    # deprecated (3.9+) and an error on newer CPythons; hash() of an
+    # int tuple is deterministic across processes (PYTHONHASHSEED only
+    # perturbs str/bytes hashing)
+    rng = random.Random(hash((seed, fired)))
     if isinstance(payload, (bytes, bytearray)):
         return _corrupt_bytes(payload, rng)
     try:

@@ -19,6 +19,7 @@ from .core import ir
 from .core.executor import Executor
 from .core.scope import global_scope
 from .data_feeder import DataFeeder
+from .pipeline import FeedPipeline, materialize, materialize_scalar
 
 
 class BeginPass(object):
@@ -39,11 +40,36 @@ class BeginIteration(object):
 
 
 class EndIteration(object):
+    """Under the async pipeline, ``cost``/``metrics`` hold lazy
+    AsyncFetch handles: a handler that never touches them costs no
+    device sync, one that reads them materialises exactly then (the
+    declared per-iteration sync point). Synchronous mode stores plain
+    floats/arrays and behaves as before."""
+
     def __init__(self, pass_id, batch_id, cost, metrics=None):
         self.pass_id = pass_id
         self.batch_id = batch_id
-        self.cost = cost
-        self.metrics = metrics or {}
+        self._cost = cost
+        self._metrics = metrics or {}
+
+    @property
+    def cost(self):
+        self._cost = materialize_scalar(self._cost)
+        return self._cost
+
+    @cost.setter
+    def cost(self, value):
+        self._cost = value
+
+    @property
+    def metrics(self):
+        self._metrics = {k: materialize(v)
+                         for k, v in self._metrics.items()}
+        return self._metrics
+
+    @metrics.setter
+    def metrics(self, value):
+        self._metrics = value or {}
 
 
 class Trainer(object):
@@ -141,12 +167,27 @@ class Trainer(object):
                      dirname=self.checkpoint_dir, pass_id=pass_id,
                      batch_id=batch_id)
 
-    def train(self, reader, num_passes=1, event_handler=None):
+    def train(self, reader, num_passes=1, event_handler=None,
+              pipeline=None, pipeline_depth=None):
+        """``pipeline=True`` runs the async execution pipeline
+        (paddle_tpu.pipeline): a feed thread prepares + device_puts batch
+        k+1 while batch k computes, and fetches stay on device until a
+        real sync point — the handler touching ``.cost``/``.metrics``,
+        the log-period progress line, pass end, or a checkpoint. Losses
+        are bit-identical to the synchronous mode. Defaults follow
+        ``FLAGS.pipeline`` / ``FLAGS.pipeline_depth``; ``check_nan_inf``
+        always forces the synchronous per-op path."""
         self._maybe_init()
         from . import profiler as _prof
         from .flags import FLAGS
         handler = event_handler or (lambda e: None)
         log_period = FLAGS.log_period
+        use_pipe = FLAGS.pipeline if pipeline is None else bool(pipeline)
+        depth = int(pipeline_depth if pipeline_depth is not None
+                    else FLAGS.pipeline_depth)
+        if use_pipe and (depth < 1 or self.exe.check_nan_inf):
+            # the NaN/Inf scan needs the synchronous per-op path
+            use_pipe = False
         # a fresh train() gets a fresh preemption state: the flag from a
         # previous preempted run must not end this one after one batch
         self.preempted = False
@@ -159,25 +200,56 @@ class Trainer(object):
                 handler(BeginPass(pass_id))
                 costs = []
                 batch_id = -1
+                pipe = None
                 with _prof.timer("pass"):
-                    for batch_id, data in enumerate(reader()):
-                        handler(BeginIteration(pass_id, batch_id))
-                        with _prof.timer("batch"):
-                            outs = self.exe.run(self.main_program,
-                                                feed=self.feeder.feed(data),
-                                                fetch_list=self.fetch_list)
-                        cost = float(np.asarray(outs[0]).reshape(-1)[0])
-                        costs.append(cost)
-                        if log_period and (batch_id + 1) % log_period == 0:
-                            # the reference's per-log_period batch line
-                            # (reference: TrainerInternal.cpp:159-171)
-                            print("pass %d batch %d: cost=%.6f (avg %.6f)"
-                                  % (pass_id, batch_id, cost,
-                                     float(np.mean(costs[-log_period:]))))
-                        handler(EndIteration(pass_id, batch_id, cost,
-                                             {"fetches": outs[1:]}))
-                        if self.preempted:
-                            break
+                    try:
+                        if use_pipe:
+                            pipe = FeedPipeline(reader, self.feeder,
+                                                self.exe, depth=depth)
+                            batches = pipe
+                        else:
+                            batches = reader()
+                        for batch_id, data in enumerate(batches):
+                            handler(BeginIteration(pass_id, batch_id))
+                            with _prof.timer("batch"):
+                                if use_pipe:
+                                    # data is already a device-resident
+                                    # feed dict from the pipeline ring
+                                    outs = self.exe.run(
+                                        self.main_program, feed=data,
+                                        fetch_list=self.fetch_list,
+                                        sync=False)
+                                    cost = outs[0]  # lazy AsyncFetch
+                                else:
+                                    outs = self.exe.run(
+                                        self.main_program,
+                                        feed=self.feeder.feed(data),
+                                        fetch_list=self.fetch_list)
+                                    cost = float(
+                                        np.asarray(outs[0]).reshape(-1)[0])
+                            costs.append(cost)
+                            if log_period and \
+                                    (batch_id + 1) % log_period == 0:
+                                # the reference's per-log_period batch line
+                                # (reference: TrainerInternal.cpp:159-171)
+                                # — a declared materialization point
+                                window = [materialize_scalar(c)
+                                          for c in costs[-log_period:]]
+                                print("pass %d batch %d: cost=%.6f "
+                                      "(avg %.6f)"
+                                      % (pass_id, batch_id, window[-1],
+                                         float(np.mean(window))))
+                            handler(EndIteration(pass_id, batch_id, cost,
+                                                 {"fetches": outs[1:]}))
+                            if self.preempted:
+                                break
+                    finally:
+                        if pipe is not None:
+                            pipe.close()
+                            self._merge_pipeline_stats(pipe, _prof)
+                # pass end is a materialization point (and it precedes
+                # every checkpoint below, keeping saves synchronous)
+                costs = [materialize_scalar(c) for c in costs]
                 if self.preempted and self.checkpoint_dir:
                     self._preempt_checkpoint(pass_id, batch_id)
                     return
@@ -189,6 +261,21 @@ class Trainer(object):
         finally:
             if hook_installed:
                 signal.signal(signal.SIGTERM, old_sigterm)
+
+    def _merge_pipeline_stats(self, pipe, _prof):
+        """Fold one pass's FeedPipeline counters into Executor.stats and
+        the profiler's pipeline section so the overlap is observable."""
+        st = pipe.stats
+        es = self.exe.stats
+        es["feed_wait_ms"] += st["feed_wait_ms"]
+        es["dispatch_depth"] = max(es["dispatch_depth"],
+                                   st["max_in_flight"])
+        _prof.update_pipeline_counters(
+            feed_wait_ms=st["feed_wait_ms"],
+            dispatch_depth=st["max_in_flight"],
+            pipeline_batches=st["batches"],
+            slot_reuse=st["slot_reuse"],
+            fallback_sync=1 if st["fallback_sync"] else 0)
 
     def _test_program(self, fetches):
         """Pruned for-test clone: drops backward + optimizer ops so

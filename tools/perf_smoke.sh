@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Perf smoke gate: the async execution pipeline must match the
+# synchronous Trainer loop bit-for-bit and must not be slower, on a tiny
+# fit_a_line run — CPU tier-1, no device or dataset needed. Companion to
+# tools/lint.sh (static gate); this is the dynamic one. One retry damps
+# shared-CI scheduler noise before calling a throughput loss real.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+python tools/perf_smoke.py "$@" && exit 0
+echo "perf_smoke: first attempt failed; retrying once" >&2
+exec python tools/perf_smoke.py "$@"
