@@ -53,6 +53,7 @@ from . import flags  # noqa: F401
 from . import enforce  # noqa: F401
 from .flags import FLAGS, set_flags, get_flags, flags_guard  # noqa: F401
 from . import inference  # noqa: F401
+from . import serving  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import resilience  # noqa: F401
 from .io import (  # noqa: F401

@@ -1,4 +1,4 @@
-"""Command line: ``python -m paddle_tpu {train,bench,info,convert}``.
+"""Command line: ``python -m paddle_tpu {train,bench,lint,serve,info,convert}``.
 
 reference: the ``paddle`` binary (paddle/trainer/TrainerMain.cpp:32 —
 ``paddle train``, ``paddle pserver``, ``paddle merge_model``; launch wrapper
@@ -119,6 +119,51 @@ def cmd_lint(args):
     return 1 if failed else 0
 
 
+def cmd_serve(args):
+    """Serve a compiled artifact over HTTP (paddle_tpu.serving): validate
+    the artifact directory (exit 1, readable message, nothing started on
+    a bad one), register + warm it, then run the JSON endpoint until
+    SIGTERM/SIGINT — which drains cleanly and exits 0."""
+    from paddle_tpu import inference, serving
+
+    problems = inference.validate_artifact(args.artifact_dir)
+    if problems:
+        print("serve: cannot serve %r:" % args.artifact_dir,
+              file=sys.stderr)
+        for p in problems:
+            print("  - " + p, file=sys.stderr)
+        return 1
+    service = serving.InferenceService(
+        max_batch=args.max_batch or None,
+        batch_timeout_ms=(args.batch_timeout_ms
+                          if args.batch_timeout_ms >= 0 else None),
+        queue_depth=args.queue_depth or None)
+    try:
+        entry = service.load_model(args.name, args.artifact_dir)
+    except Exception as e:
+        print("serve: failed to load %r: %s: %s"
+              % (args.artifact_dir, type(e).__name__, e), file=sys.stderr)
+        service.close()
+        return 1
+    server = serving.make_server(service, host=args.host, port=args.port)
+    host, port = server.server_address[:2]
+    # one parseable readiness line: smoke tests and operators read the
+    # bound port from here (--port 0 binds a free one)
+    print(json.dumps({"serving": {
+        "host": host, "port": port, "model": args.name,
+        "version": entry.version, "warmup_ms": round(entry.warmup_ms, 3),
+        "max_batch": service.max_batch,
+        "batch_timeout_ms": service.batch_timeout_ms}}), flush=True)
+    try:
+        signum = serving.httpd.serve_until_shutdown(server)
+    finally:
+        server.server_close()
+        service.close()
+    print(json.dumps({"serving_stopped": {
+        "signal": signum, "stats": service.stats}}), flush=True)
+    return 0
+
+
 def cmd_info(args):
     import jax
 
@@ -177,6 +222,26 @@ def main(argv=None):
     lint.add_argument("--strict", action="store_true",
                       help="treat warnings as failures")
     lint.set_defaults(fn=cmd_lint)
+
+    sv = sub.add_parser(
+        "serve", help="serve a compiled inference artifact over HTTP "
+                      "(paddle_tpu.serving; SIGTERM drains and exits 0)")
+    sv.add_argument("artifact_dir",
+                    help="directory written by inference.export_compiled")
+    sv.add_argument("--name", default="default",
+                    help="model name in the registry / URL")
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument("--port", type=int, default=8500,
+                    help="0 binds a free port (printed on the readiness "
+                         "line)")
+    sv.add_argument("--max_batch", type=int, default=0,
+                    help="override FLAGS.serve_max_batch (0 = flag)")
+    sv.add_argument("--batch_timeout_ms", type=float, default=-1.0,
+                    help="override FLAGS.serve_batch_timeout_ms "
+                         "(negative = flag)")
+    sv.add_argument("--queue_depth", type=int, default=0,
+                    help="override FLAGS.serve_queue_depth (0 = flag)")
+    sv.set_defaults(fn=cmd_serve)
 
     i = sub.add_parser("info", help="device / build report")
     i.set_defaults(fn=cmd_info)

@@ -243,3 +243,23 @@ DEFINE_bool("compile_cache", True,
 DEFINE_string("compile_cache_dir", "~/.cache/paddle_tpu/xla",
               "directory for the persistent XLA compilation cache "
               "(used when FLAGS.compile_cache is on)")
+DEFINE_int32("serve_max_batch", 8,
+             "online serving (paddle_tpu.serving): most requests the "
+             "micro-batcher coalesces into one run_many device dispatch. "
+             "Also sets the padding buckets (powers of two capped here) "
+             "the model registry pre-compiles at warm-up, so raising it "
+             "on a live service only takes effect for models (re)loaded "
+             "afterwards")
+DEFINE_float("serve_batch_timeout_ms", 2.0,
+             "online serving: how long the dispatch loop holds the "
+             "OLDEST queued request open for same-model arrivals before "
+             "dispatching a partial batch — the latency/throughput "
+             "knob: 0 dispatches immediately (lowest latency, occupancy "
+             "only from true concurrency); larger values trade p50 "
+             "latency for fuller batches")
+DEFINE_int32("serve_queue_depth", 64,
+             "online serving: bound on requests queued for dispatch "
+             "across all models; request queue_depth+1 is shed "
+             "immediately with OverloadError (HTTP 429) and a recorded "
+             "request_shed degradation event instead of queuing into "
+             "certain lateness")

@@ -31,7 +31,36 @@ NATIVE_MODULE_FILE = "__module__.stablehlo_bc"
 NATIVE_WEIGHTS_FILE = "__weights__.bin"
 NATIVE_SIGNATURE_FILE = "__signature__.json"
 
-__all__ = ["export_compiled", "load_compiled", "CompiledModel"]
+__all__ = ["export_compiled", "load_compiled", "CompiledModel",
+           "ArtifactError", "validate_artifact"]
+
+
+class ArtifactError(RuntimeError):
+    """A compiled-inference artifact directory is missing, incomplete,
+    or corrupt. One readable message names every offending file."""
+
+
+def validate_artifact(dirname):
+    """Check that ``dirname`` holds a loadable compiled artifact.
+
+    Returns a list of human-readable problems (empty = valid): missing
+    directory, each missing ``__compiled__.stablehlo`` /
+    ``__params__.pkl`` / ``__meta__.json``, and empty files. Cheap —
+    stat only, no deserialization; ``CompiledModel`` runs it before
+    loading and surfaces corrupt *contents* with the same error type."""
+    if not os.path.isdir(dirname):
+        return ["artifact directory %r does not exist (expected the "
+                "directory export_compiled wrote)" % dirname]
+    problems = []
+    for fname, role in ((EXPORTED_FILE, "serialized StableHLO program"),
+                        (PARAMS_FILE, "pickled parameters"),
+                        (META_FILE, "feed/fetch metadata")):
+        path = os.path.join(dirname, fname)
+        if not os.path.isfile(path):
+            problems.append("missing %s (%s)" % (fname, role))
+        elif os.path.getsize(path) == 0:
+            problems.append("%s is empty (%s)" % (fname, role))
+    return problems
 
 
 def export_compiled(dirname, feeded_var_names, target_vars, executor,
@@ -156,14 +185,37 @@ class CompiledModel(object):
     def __init__(self, dirname):
         import jax
         from jax import export as jexport
-        with open(os.path.join(dirname, EXPORTED_FILE), "rb") as f:
-            self._exported = jexport.deserialize(f.read())
-        with open(os.path.join(dirname, PARAMS_FILE), "rb") as f:
-            self._params = pickle.load(f)
-        with open(os.path.join(dirname, META_FILE)) as f:
-            meta = json.load(f)
-        self.feed_names = meta["feed_names"]
-        self.fetch_names = meta["fetch_names"]
+        problems = validate_artifact(dirname)
+        if problems:
+            raise ArtifactError(
+                "cannot load compiled artifact %r:\n  - %s"
+                % (dirname, "\n  - ".join(problems)))
+        try:
+            with open(os.path.join(dirname, EXPORTED_FILE), "rb") as f:
+                self._exported = jexport.deserialize(f.read())
+        except Exception as e:
+            raise ArtifactError(
+                "artifact %r: %s is corrupt (%s: %s) — re-export with "
+                "export_compiled" % (dirname, EXPORTED_FILE,
+                                     type(e).__name__, e)) from e
+        try:
+            with open(os.path.join(dirname, PARAMS_FILE), "rb") as f:
+                self._params = pickle.load(f)
+        except Exception as e:
+            raise ArtifactError(
+                "artifact %r: %s is corrupt (%s: %s) — re-export with "
+                "export_compiled" % (dirname, PARAMS_FILE,
+                                     type(e).__name__, e)) from e
+        try:
+            with open(os.path.join(dirname, META_FILE)) as f:
+                meta = json.load(f)
+            self.feed_names = meta["feed_names"]
+            self.fetch_names = meta["fetch_names"]
+        except Exception as e:
+            raise ArtifactError(
+                "artifact %r: %s is corrupt or incomplete (%s: %s) — "
+                "re-export with export_compiled"
+                % (dirname, META_FILE, type(e).__name__, e)) from e
         # Parameters live on-device for the lifetime of the model — a
         # serving process must not pay the full-weights host->device
         # transfer on every request (ResNet-50: ~102 MB/call otherwise).
@@ -183,6 +235,16 @@ class CompiledModel(object):
 
         # jit's own shape-keyed cache retraces per distinct stack depth R
         self._scan_call = jax.jit(scanned)
+
+    @property
+    def feed_spec(self):
+        """``{feed name: (shape tuple, dtype str)}`` from the exported
+        module's canonical avals (flat order: params then feeds) — the
+        contract a serving tier validates requests against and shapes
+        warm-up zeros from."""
+        avals = list(self._exported.in_avals)[len(self._param_vals):]
+        return {n: (tuple(av.shape), str(av.dtype))
+                for n, av in zip(self.feed_names, avals)}
 
     @staticmethod
     def _feed_val(a):

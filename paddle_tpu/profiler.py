@@ -20,13 +20,16 @@ __all__ = ["timer", "stat_summary", "print_stats", "reset_stats",
            "cuda_profiler", "xla_trace", "profiler_enabled", "record_run",
            "record_op_event", "record_program_analysis", "write_timeline",
            "update_pipeline_counters", "pipeline_counters",
-           "reset_pipeline_counters"]
+           "reset_pipeline_counters",
+           "update_serving_counters", "serving_counters",
+           "reset_serving_counters"]
 
 _enabled = False
 _records = defaultdict(list)  # label -> [seconds]
 _op_events = []               # chrome-trace X events (eager per-op spans)
 _program_analyses = {}        # label -> {flops, bytes, collectives, ...}
 _pipeline_counters = defaultdict(float)  # async-pipeline observability
+_serving_counters = defaultdict(float)   # online-serving observability
 _T0 = time.perf_counter()
 
 
@@ -67,6 +70,7 @@ def reset_profiler():
     del _op_events[:]
     _program_analyses.clear()
     _pipeline_counters.clear()
+    _serving_counters.clear()
 
 
 def update_pipeline_counters(**counters):
@@ -89,6 +93,28 @@ def pipeline_counters():
 
 def reset_pipeline_counters():
     _pipeline_counters.clear()
+
+
+def update_serving_counters(**counters):
+    """Accumulate online-serving observability counters (always on — a
+    few dict adds per BATCH, not per request-row). Keys in use:
+    ``requests``, ``batches``, ``padded_rows``, ``queue_wait_ms``,
+    ``shed_overload``, ``shed_deadline``, ``failed``;
+    ``max_occupancy`` is kept as a max, not a sum."""
+    for k, v in counters.items():
+        if k == "max_occupancy":
+            _serving_counters[k] = max(_serving_counters[k], float(v))
+        else:
+            _serving_counters[k] += float(v)
+
+
+def serving_counters():
+    """Snapshot {counter: value} of the online-serving counters."""
+    return dict(_serving_counters)
+
+
+def reset_serving_counters():
+    _serving_counters.clear()
 
 
 def record_op_event(op_type, name, t_start, t_end):
@@ -169,6 +195,9 @@ def write_timeline(path):
     - ``pipeline``: async-execution-pipeline counters (feed-wait ms,
       dispatch depth, fetch syncs, compile-cache hits) — the overlap
       evidence for paddle_tpu.pipeline.
+    - ``serving``: online-serving counters (requests, batches, padded
+      rows, queue-wait ms, shed counts, max batch occupancy) — the
+      coalescing evidence for paddle_tpu.serving.
     """
     import json
     rows = []
@@ -185,6 +214,7 @@ def write_timeline(path):
         "host_events": rows,
         "programs": dict(_program_analyses),
         "pipeline": dict(_pipeline_counters),
+        "serving": dict(_serving_counters),
     }
     with open(path, "w") as f:
         json.dump(artifact, f, indent=1)

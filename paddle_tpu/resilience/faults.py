@@ -27,6 +27,16 @@ site                      where
                           before feed conversion + device_put (a raise
                           kills the thread -> recorded fallback to
                           synchronous feeding)
+``serving.dispatch``      the micro-batcher's device dispatch, per batch,
+                          before run/run_many (a raise fails that batch's
+                          requests with a recorded batch_failed event —
+                          the dispatch loop survives; a delay models a
+                          slow device and backs the queue up into
+                          admission control)
+``serving.reload``        model-registry warm-up, per (re)load, before
+                          the jit pre-trigger (a raise on a hot reload
+                          rolls back to the serving version with a
+                          recorded reload_rollback event)
 ========================  ====================================================
 
 Spec grammar (env var or ``load_fault_spec`` string)::

@@ -1,0 +1,50 @@
+"""Online inference serving over AOT-compiled artifacts.
+
+The reference deploys by multi-threading gradient machines behind its C
+API (paddle/capi/gradient_machine.h:36
+``paddle_gradient_machine_create_for_inference``) and the fluid inference
+engine's ``Load`` — one request, one forward, per thread. The TPU-native
+redesign inverts that: XLA wants FEW, LARGE dispatches, so the serving
+tier's job is to *coalesce* concurrent single requests into one padded
+device dispatch (``CompiledModel.run_many``) without retracing and
+without letting a burst melt the queue. Three pieces:
+
+- :mod:`~paddle_tpu.serving.batcher` — the dynamic micro-batcher: a
+  bounded request queue feeding a dispatch loop that stacks
+  same-signature requests into fixed padding buckets (so ``lax.scan``
+  compiles once per bucket, never per queue depth), with a max batch
+  size and a batch-formation timeout as the latency/throughput knob.
+- :mod:`~paddle_tpu.serving.registry` — named, versioned
+  ``load_compiled`` artifacts with warm-up on load (the jit is
+  pre-triggered at every bucket), atomic hot reload behind in-flight
+  requests, and rollback to the serving version when a reload's warm-up
+  fails (fault site ``serving.reload``).
+- :mod:`~paddle_tpu.serving.admission` — queue-depth backpressure,
+  per-request deadlines, and shed-on-overload, recorded through
+  ``paddle_tpu.resilience`` degradation events so chaos specs cover the
+  serving path.
+
+:class:`~paddle_tpu.serving.service.InferenceService` ties them together
+in-process; :mod:`~paddle_tpu.serving.httpd` puts a stdlib JSON endpoint
+in front of it, and ``paddle_tpu serve <artifact_dir>`` is the CLI verb.
+Knobs: ``FLAGS.serve_max_batch`` / ``serve_batch_timeout_ms`` /
+``serve_queue_depth``; architecture and overload semantics in
+``doc/serving.md``.
+"""
+from __future__ import annotations
+
+from .admission import (  # noqa: F401
+    AdmissionController, DeadlineExceededError, ModelUnavailableError,
+    OverloadError, ServingError,
+)
+from .batcher import MicroBatcher, bucket_for, padding_buckets  # noqa: F401
+from .registry import ModelEntry, ModelRegistry  # noqa: F401
+from .service import InferenceService  # noqa: F401
+from .httpd import make_server  # noqa: F401
+
+__all__ = [
+    "InferenceService", "ModelRegistry", "ModelEntry", "MicroBatcher",
+    "AdmissionController", "ServingError", "OverloadError",
+    "DeadlineExceededError", "ModelUnavailableError",
+    "padding_buckets", "bucket_for", "make_server",
+]

@@ -1,0 +1,96 @@
+"""Admission control: who gets in the queue, who gets shed, and why.
+
+An online service's failure mode is not a crash — it is a convoy: a
+burst outruns the device, the queue grows, every request's latency
+inherits the whole backlog, and by the time the backlog drains the
+clients have timed out anyway. The controls here are the standard three
+(the reference leaves this to however many pserver/capi threads the
+operator configured; here it is explicit policy):
+
+- **queue-depth backpressure** — at most ``queue_depth`` requests may
+  wait for dispatch; request ``queue_depth + 1`` is rejected *now* with
+  :class:`OverloadError` instead of queuing into certain lateness.
+- **per-request deadlines** — a request carrying ``deadline_ms`` that is
+  already late when the dispatcher reaches it is shed with
+  :class:`DeadlineExceededError` rather than burned device time on (the
+  client stopped listening; serving it helps nobody).
+- **shed accounting** — every shed is a recorded
+  ``paddle_tpu.resilience`` degradation event (``request_shed``), so "we
+  dropped load" is auditable the same way checkpoint fallbacks and
+  degraded pserver modes are, and chaos specs can assert on it.
+"""
+from __future__ import annotations
+
+import time
+
+from ..resilience import record_event
+
+__all__ = ["ServingError", "OverloadError", "DeadlineExceededError",
+           "ModelUnavailableError", "AdmissionController"]
+
+
+class ServingError(RuntimeError):
+    """Base of the serving tier's request-rejection errors."""
+
+
+class OverloadError(ServingError):
+    """Shed at admission: the bounded request queue is full."""
+
+
+class DeadlineExceededError(ServingError):
+    """Shed at dispatch: the request's deadline passed while it queued."""
+
+
+class ModelUnavailableError(ServingError):
+    """No model (or no live version) registered under the requested name."""
+
+
+class AdmissionController(object):
+    """Policy object consulted by the service/batcher at the two shed
+    points. Stateless beyond its knobs — the queue it bounds lives in
+    the batcher, whose lock makes the depth check exact."""
+
+    def __init__(self, queue_depth):
+        self.queue_depth = max(int(queue_depth), 1)
+
+    # -- admission (called under the batcher's queue lock) -------------------
+    def check_queue(self, pending, model=None):
+        """Raise :class:`OverloadError` when ``pending`` queued requests
+        leave no room for one more; records the shed."""
+        if pending >= self.queue_depth:
+            record_event("request_shed", site="serving.admission",
+                         reason="overload", model=model,
+                         queue_depth=self.queue_depth)
+            raise OverloadError(
+                "serving queue full (%d pending >= queue_depth=%d); "
+                "request shed — retry with backoff or raise "
+                "FLAGS.serve_queue_depth" % (pending, self.queue_depth))
+
+    # -- deadlines -----------------------------------------------------------
+    @staticmethod
+    def deadline_from(deadline_ms, now=None):
+        """Absolute monotonic deadline for a relative ``deadline_ms``
+        budget (None = no deadline)."""
+        if deadline_ms is None:
+            return None
+        now = time.monotonic() if now is None else now
+        return now + float(deadline_ms) / 1e3
+
+    @staticmethod
+    def expired(request, now=None):
+        if request.deadline_t is None:
+            return False
+        now = time.monotonic() if now is None else now
+        return now > request.deadline_t
+
+    def shed_deadline(self, request, now=None):
+        """Fail an expired request with a recorded degradation event."""
+        now = time.monotonic() if now is None else now
+        late_ms = (now - request.deadline_t) * 1e3
+        record_event("request_shed", site="serving.dispatch",
+                     reason="deadline", model=request.model,
+                     late_ms=late_ms)
+        request.fail(DeadlineExceededError(
+            "request deadline exceeded %.1f ms before dispatch "
+            "(model %r); shed instead of serving a dead client"
+            % (late_ms, request.model)))

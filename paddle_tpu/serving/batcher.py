@@ -1,0 +1,272 @@
+"""Dynamic micro-batching: coalesce concurrent requests into one dispatch.
+
+A TPU (and XLA generally) amortizes dispatch overhead over batch size;
+serving traffic arrives one request at a time. The micro-batcher bridges
+the two: requests enter a bounded queue, and a single dispatch thread
+forms batches per model — it takes the oldest pending request, then
+waits up to ``batch_timeout_ms`` (the latency/throughput knob) for more
+same-model requests before stacking up to ``max_batch`` of them and
+driving ONE ``CompiledModel.run_many`` device dispatch. Results are
+scattered back to the per-request futures.
+
+Two compile-stability rules keep the hot path trace-free:
+
+- **fixed padding buckets**: a batch of R requests is padded (by
+  repeating the last request's rows) up to the smallest bucket in
+  ``padding_buckets(max_batch)`` — powers of two capped by max_batch —
+  so ``run_many``'s ``lax.scan`` sees only ``len(buckets)`` distinct
+  stack depths, never one per queue depth. Padded rows are computed and
+  discarded; scan iterations are independent, so live rows stay
+  bit-identical to per-request ``run()``.
+- **singleton fast path**: a batch of one skips the scan entirely and
+  calls ``run()`` — same compiled program the warm-up primed.
+
+Failure contract: the dispatch edge is fault site ``serving.dispatch``;
+a raise there fails that batch's requests (each future carries the
+error) and records a ``batch_failed`` degradation event — the dispatch
+loop itself never dies. Expired requests are shed at dispatch via the
+:class:`~paddle_tpu.serving.admission.AdmissionController`.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+import numpy as np
+
+from ..resilience import fault_point, record_event
+from .admission import ModelUnavailableError, ServingError
+
+__all__ = ["padding_buckets", "bucket_for", "Request", "MicroBatcher"]
+
+
+def padding_buckets(max_batch):
+    """Fixed stack-depth buckets for ``max_batch``: powers of two, with
+    ``max_batch`` itself as the cap (e.g. 8 -> [1, 2, 4, 8];
+    6 -> [1, 2, 4, 6]). Each bucket is one ``lax.scan`` trace."""
+    max_batch = max(int(max_batch), 1)
+    out, b = [], 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return out
+
+
+def bucket_for(r, buckets):
+    """Smallest bucket that fits ``r`` requests."""
+    for b in buckets:
+        if b >= r:
+            return b
+    return buckets[-1]
+
+
+class Request(object):
+    """One queued inference request; resolves to a list of per-fetch
+    arrays (no leading batch axis added or removed — the rows are
+    exactly what ``run()`` would have returned)."""
+
+    __slots__ = ("model", "feed", "deadline_t", "enqueue_t", "dequeue_t",
+                 "done_t", "_done", "_result", "_error")
+
+    def __init__(self, model, feed, deadline_t=None):
+        self.model = model
+        self.feed = feed
+        self.deadline_t = deadline_t
+        self.enqueue_t = time.monotonic()
+        self.dequeue_t = None
+        self.done_t = None
+        self._done = threading.Event()
+        self._result = None
+        self._error = None
+
+    def resolve(self, result):
+        self._result = result
+        self.done_t = time.monotonic()
+        self._done.set()
+
+    def fail(self, exc):
+        self._error = exc
+        self.done_t = time.monotonic()
+        self._done.set()
+
+    @property
+    def done(self):
+        return self._done.is_set()
+
+    def wait(self, timeout=None):
+        """Block for the result; re-raises the shed/dispatch error."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("inference request still pending after "
+                               "%.3fs (model %r)" % (timeout, self.model))
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    @property
+    def queue_wait_ms(self):
+        end = self.dequeue_t or self.done_t or time.monotonic()
+        return (end - self.enqueue_t) * 1e3
+
+    @property
+    def latency_ms(self):
+        end = self.done_t or time.monotonic()
+        return (end - self.enqueue_t) * 1e3
+
+
+class MicroBatcher(object):
+    """Bounded per-model request queues + the single dispatch thread.
+
+    ``admission`` bounds the total queued depth (checked under the queue
+    lock, so the bound is exact) and sheds expired requests at dispatch.
+    ``on_shed(request, reason)`` / ``on_batch(requests, bucket)`` /
+    ``on_fail(requests, exc)`` are observer hooks the owning service
+    uses for metrics; they run on the dispatch thread and must be cheap.
+    """
+
+    def __init__(self, registry, max_batch, batch_timeout_ms, admission,
+                 on_shed=None, on_batch=None, on_fail=None):
+        self.registry = registry
+        self.max_batch = max(int(max_batch), 1)
+        self.batch_timeout_s = max(float(batch_timeout_ms), 0.0) / 1e3
+        self.buckets = padding_buckets(self.max_batch)
+        self.admission = admission
+        self._on_shed = on_shed or (lambda req, reason: None)
+        self._on_batch = on_batch or (lambda reqs, bucket: None)
+        self._on_fail = on_fail or (lambda reqs, exc: None)
+        self._queues = {}           # model name -> deque[Request]
+        self._cond = threading.Condition()
+        self._running = True
+        self._thread = threading.Thread(target=self._dispatch_loop,
+                                        name="paddle_tpu-serve-dispatch",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- producer side -------------------------------------------------------
+    def submit(self, request):
+        """Enqueue under the admission bound; raises
+        :class:`OverloadError` on a full queue, :class:`ServingError`
+        after close()."""
+        with self._cond:
+            if not self._running:
+                raise ServingError("serving dispatch loop is closed")
+            self.admission.check_queue(self._pending_locked(),
+                                       model=request.model)
+            self._queues.setdefault(
+                request.model, collections.deque()).append(request)
+            self._cond.notify_all()
+        return request
+
+    def pending(self):
+        with self._cond:
+            return self._pending_locked()
+
+    def _pending_locked(self):
+        return sum(len(q) for q in self._queues.values())
+
+    # -- dispatch loop -------------------------------------------------------
+    def _dispatch_loop(self):
+        while True:
+            batch = self._form_batch()
+            if batch is None:
+                return
+            name, requests = batch
+            if requests:
+                self._run_batch(name, requests)
+
+    def _form_batch(self):
+        """Block for work, then give later arrivals up to
+        ``batch_timeout_s`` (measured from the OLDEST queued request) to
+        coalesce. Returns (model, [requests]) or None at shutdown."""
+        with self._cond:
+            while self._running and self._pending_locked() == 0:
+                self._cond.wait(0.1)
+            if not self._running and self._pending_locked() == 0:
+                return None
+            # serve the model whose head request has waited longest
+            name = min((n for n, q in self._queues.items() if q),
+                       key=lambda n: self._queues[n][0].enqueue_t)
+            q = self._queues[name]
+            form_deadline = q[0].enqueue_t + self.batch_timeout_s
+            while self._running and len(q) < self.max_batch:
+                rem = form_deadline - time.monotonic()
+                if rem <= 0:
+                    break
+                self._cond.wait(rem)
+            if not self._running:
+                # close() ran while we waited (the wait releases the
+                # lock): it already collected and failed these requests
+                # as shutdown orphans — popping our stale deque ref
+                # would dispatch work whose futures are dead
+                return name, []
+            now = time.monotonic()
+            take = min(len(q), self.max_batch)
+            requests = [q.popleft() for _ in range(take)]
+            for r in requests:
+                r.dequeue_t = now
+            if not q:
+                del self._queues[name]
+            self._cond.notify_all()
+        return name, requests
+
+    def _run_batch(self, name, requests):
+        # shed what is already dead, then dispatch the rest as one stack
+        live = []
+        for r in requests:
+            if self.admission.expired(r):
+                self.admission.shed_deadline(r)
+                self._on_shed(r, "deadline")
+            else:
+                live.append(r)
+        if not live:
+            return
+        try:
+            entry = self.registry.get(name)
+        except ModelUnavailableError as e:
+            for r in live:
+                r.fail(e)
+            self._on_fail(live, e)
+            return
+        model = entry.model
+        n_live = len(live)
+        bucket = bucket_for(n_live, self.buckets)
+        try:
+            fault_point("serving.dispatch")
+            if bucket == 1:
+                rows = [[np.asarray(o) for o in model.run(live[0].feed)]]
+            else:
+                # pad to the bucket by repeating the last live request's
+                # rows — computed and discarded, never returned
+                pad = [live[-1]] * (bucket - n_live)
+                stacked = {
+                    fn: np.stack([np.asarray(r.feed[fn])
+                                  for r in live + pad])
+                    for fn in model.feed_names}
+                outs = [np.asarray(o) for o in model.run_many(stacked)]
+                rows = [[o[i] for o in outs] for i in range(n_live)]
+        except BaseException as e:
+            record_event("batch_failed", site="serving.dispatch",
+                         model=name, version=entry.version,
+                         requests=n_live, error=repr(e))
+            for r in live:
+                r.fail(e)
+            self._on_fail(live, e)
+            return
+        for r, row in zip(live, rows):
+            r.resolve(row)
+        self._on_batch(live, bucket)
+
+    # -- shutdown ------------------------------------------------------------
+    def close(self):
+        """Stop the dispatch thread; queued-but-undispatched requests
+        fail with :class:`ServingError` (idempotent)."""
+        with self._cond:
+            self._running = False
+            orphans = [r for q in self._queues.values() for r in q]
+            self._queues.clear()
+            self._cond.notify_all()
+        for r in orphans:
+            r.fail(ServingError("service shut down before dispatch"))
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)

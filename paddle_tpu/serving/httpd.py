@@ -1,0 +1,202 @@
+"""Stdlib JSON/HTTP front end over :class:`InferenceService`.
+
+Deliberately ``http.server``-based: the container constraint is "no new
+dependencies", and a serving tier whose transport is three stdlib
+classes is also trivially auditable. The routes follow the de-facto
+model-server shape (one verb-suffixed model URL, health and stats
+endpoints):
+
+====== ================================ ===================================
+method path                             body / response
+====== ================================ ===================================
+POST   ``/v1/models/<name>:predict``    ``{"inputs": {feed: nested-list},
+                                        "deadline_ms": optional}`` ->
+                                        ``{"outputs": [...], "model":
+                                        name, "version": v}``
+POST   ``/v1/models/<name>:reload``     ``{"dirname": path}`` -> new
+                                        version, or 409 + rollback info
+GET    ``/v1/models``                   registry listing
+GET    ``/healthz``                     liveness + registered models
+GET    ``/statz``                       ``InferenceService.stats``
+====== ================================ ===================================
+
+Error mapping: 429 overload shed, 504 deadline shed, 404 unknown model,
+400 malformed input, 500 dispatch failure — each body carries
+``{"error": ..., "kind": ...}``. The server is a
+``ThreadingHTTPServer``: one thread per connection *blocks* in
+``InferenceService.infer`` while the single dispatch thread batches
+across them — concurrency lives in the batcher, not here.
+"""
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from .admission import (DeadlineExceededError, ModelUnavailableError,
+                        OverloadError)
+
+__all__ = ["make_server", "serve_until_shutdown"]
+
+_MAX_BODY = 64 * 1024 * 1024
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    # request logging would serialize every request on stderr writes
+    server_version = "paddle_tpu-serve"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    @property
+    def service(self):
+        return self.server.service
+
+    # -- plumbing ------------------------------------------------------------
+    def _reply(self, code, payload):
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_json(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        if n > _MAX_BODY:
+            raise ValueError("request body too large (%d bytes)" % n)
+        raw = self.rfile.read(n) if n else b"{}"
+        body = json.loads(raw.decode("utf-8"))
+        if not isinstance(body, dict):
+            raise ValueError("request body must be a JSON object")
+        return body
+
+    # -- routes --------------------------------------------------------------
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._reply(200, {"ok": True,
+                              "models": self.service.registry.info()})
+        elif self.path == "/statz":
+            self._reply(200, self.service.stats)
+        elif self.path == "/v1/models":
+            self._reply(200, self.service.registry.info())
+        else:
+            self._reply(404, {"error": "no route %r" % self.path,
+                              "kind": "not_found"})
+
+    def do_POST(self):
+        try:
+            body = self._read_json()
+        except Exception as e:
+            # the body may be partly or wholly unread (oversized guard):
+            # replying on a keep-alive connection would desync it — the
+            # leftover bytes would parse as the next request line
+            self.close_connection = True
+            return self._reply(400, {"error": "bad JSON body: %s" % e,
+                                     "kind": "bad_request"})
+        if self.path.startswith("/v1/models/") and \
+                self.path.endswith(":predict"):
+            name = self.path[len("/v1/models/"):-len(":predict")]
+            return self._predict(name, body)
+        if self.path.startswith("/v1/models/") and \
+                self.path.endswith(":reload"):
+            name = self.path[len("/v1/models/"):-len(":reload")]
+            return self._reload(name, body)
+        self._reply(404, {"error": "no route %r" % self.path,
+                          "kind": "not_found"})
+
+    def _predict(self, name, body):
+        try:
+            entry = self.service.registry.get(name)
+            inputs = body.get("inputs")
+            if not isinstance(inputs, dict):
+                raise ValueError('body must carry {"inputs": {name: '
+                                 "nested-list}}")
+            # only CONVERT here (JSON nested lists -> exported dtype);
+            # the signature itself — missing names, shapes — is checked
+            # once, by the service's _checked_feed, whose ValueError
+            # maps to 400 below
+            spec = entry.model.feed_spec
+            feed = {fn: np.asarray(inputs[fn], dtype=dtype)
+                    for fn, (_, dtype) in spec.items() if fn in inputs}
+            rows = self.service.infer(name, feed,
+                                      deadline_ms=body.get("deadline_ms"))
+        except ModelUnavailableError as e:
+            return self._reply(404, {"error": str(e),
+                                     "kind": "model_unavailable"})
+        except OverloadError as e:
+            return self._reply(429, {"error": str(e), "kind": "overload"})
+        except DeadlineExceededError as e:
+            return self._reply(504, {"error": str(e), "kind": "deadline"})
+        except ValueError as e:
+            return self._reply(400, {"error": str(e),
+                                     "kind": "bad_request"})
+        except Exception as e:
+            return self._reply(500, {"error": repr(e), "kind": "dispatch"})
+        # report from the entry captured at admission: re-fetching here
+        # would race a concurrent unload/reload into a lost response or
+        # a version that never served this request
+        self._reply(200, {
+            "model": name, "version": entry.version,
+            "fetch_names": list(entry.model.fetch_names),
+            "outputs": [np.asarray(r).tolist() for r in rows]})
+
+    def _reload(self, name, body):
+        dirname = body.get("dirname")
+        if not dirname:
+            return self._reply(400, {"error": 'reload wants {"dirname": '
+                                              "path}",
+                                     "kind": "bad_request"})
+        try:
+            entry = self.service.reload_model(name, dirname)
+        except Exception as e:
+            # rollback: the previously published version keeps serving
+            kept = None
+            try:
+                kept = self.service.registry.get(name).version
+            except ModelUnavailableError:
+                pass
+            return self._reply(409, {"error": repr(e), "kind": "reload",
+                                     "serving_version": kept})
+        self._reply(200, {"model": name, "version": entry.version,
+                          "warmup_ms": entry.warmup_ms})
+
+
+def make_server(service, host="127.0.0.1", port=0):
+    """Bind a :class:`ThreadingHTTPServer` over ``service``; ``port=0``
+    picks a free port (read it back from ``server.server_address``).
+    The caller owns ``serve_forever()`` / ``shutdown()``."""
+    server = ThreadingHTTPServer((host, port), _Handler)
+    server.daemon_threads = True
+    server.service = service
+    return server
+
+
+def serve_until_shutdown(server, signals=None):
+    """``serve_forever`` with clean signal-driven shutdown. ``signals``
+    (default SIGTERM+SIGINT) trip ``server.shutdown()`` from a helper
+    thread — calling it from the handler's own (main) thread would
+    deadlock against the blocked ``serve_forever``. Returns the signal
+    number that stopped the server, or None after an external
+    ``shutdown()``. Restores previous handlers."""
+    import signal as _signal
+    import threading
+    signals = signals if signals is not None else (_signal.SIGTERM,
+                                                   _signal.SIGINT)
+    stopped = {"signum": None}
+    previous = {}
+
+    def on_signal(signum, frame):
+        stopped["signum"] = signum
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    for s in signals:
+        previous[s] = _signal.signal(s, on_signal)
+    try:
+        server.serve_forever(poll_interval=0.1)
+    finally:
+        for s, h in previous.items():
+            _signal.signal(s, h)
+    return stopped["signum"]

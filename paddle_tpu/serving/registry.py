@@ -1,0 +1,157 @@
+"""Model registry: named, versioned artifacts with warm-up and hot reload.
+
+The reference swaps models by restarting serving processes (a new
+``paddle_gradient_machine_create_for_inference`` per deploy); a TPU
+serving process cannot afford that — the cold cost is the jit trace +
+XLA compile, not the weight load. So the registry makes the expensive
+part explicit and keeps it OFF the request path:
+
+- **load = validate + deserialize + warm up + publish.** Warm-up drives
+  the freshly loaded :class:`~paddle_tpu.inference.CompiledModel` once
+  through ``run()`` and once through ``run_many()`` at every padding
+  bucket, with zero feeds shaped from the artifact's own signature — so
+  every compiled variant the micro-batcher can ever request exists
+  before the first request arrives.
+- **hot reload is atomic and behind in-flight requests.** The new
+  version is fully built (including warm-up) before a single dict swap
+  publishes it; dispatches that already took the old entry keep their
+  reference and finish on the old weights. No request ever observes a
+  half-loaded model.
+- **failed warm-up rolls back.** If validation/deserialize/warm-up of a
+  reload raises (fault site ``serving.reload`` — chaos specs can arm it
+  via ``PADDLE_TPU_FAULT_SPEC``), the serving version stays published, a
+  ``reload_rollback`` degradation event is recorded, and the error
+  propagates to the reloader alone.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..inference import load_compiled
+from ..resilience import fault_point, record_event
+from .admission import ModelUnavailableError
+from .batcher import padding_buckets
+
+__all__ = ["ModelEntry", "ModelRegistry"]
+
+
+class ModelEntry(object):
+    """One published (name, version): immutable once published."""
+
+    __slots__ = ("name", "version", "dirname", "model", "loaded_at",
+                 "warmup_ms", "warm_buckets")
+
+    def __init__(self, name, version, dirname, model, warmup_ms,
+                 warm_buckets):
+        self.name = name
+        self.version = version
+        self.dirname = dirname
+        self.model = model
+        self.loaded_at = time.time()
+        self.warmup_ms = warmup_ms
+        self.warm_buckets = tuple(warm_buckets)
+
+    def describe(self):
+        return {"version": self.version, "dirname": self.dirname,
+                "loaded_at": self.loaded_at,
+                "warmup_ms": round(self.warmup_ms, 3),
+                "warm_buckets": list(self.warm_buckets),
+                "feed_names": list(self.model.feed_names),
+                "fetch_names": list(self.model.fetch_names)}
+
+
+class ModelRegistry(object):
+    def __init__(self, warm_buckets=None):
+        """``warm_buckets``: stack depths to pre-trigger at load time;
+        defaults to ``padding_buckets(FLAGS.serve_max_batch)`` so the
+        registry and the micro-batcher agree without plumbing."""
+        if warm_buckets is None:
+            from ..flags import FLAGS
+            warm_buckets = padding_buckets(FLAGS.serve_max_batch)
+        self.warm_buckets = tuple(sorted(set(int(b) for b in warm_buckets)))
+        self._models = {}       # name -> ModelEntry
+        self._versions = {}     # name -> last assigned version int
+        self._lock = threading.Lock()
+
+    # -- lookup (reads snapshot under the lock: a concurrent first load
+    # of a NEW name mutates the dict mid-iteration otherwise) ---------------
+    def get(self, name):
+        with self._lock:
+            entry = self._models.get(name)
+            registered = sorted(self._models) if entry is None else None
+        if entry is None:
+            raise ModelUnavailableError(
+                "no model registered under %r (registered: %s)"
+                % (name, registered or "none"))
+        return entry
+
+    def names(self):
+        with self._lock:
+            return sorted(self._models)
+
+    def versions(self):
+        """{name: published version} snapshot."""
+        with self._lock:
+            return {n: e.version for n, e in self._models.items()}
+
+    def info(self):
+        with self._lock:
+            entries = sorted(self._models.items())
+        return {n: e.describe() for n, e in entries}
+
+    # -- load / reload -------------------------------------------------------
+    def load(self, name, dirname, warm=True):
+        """Load (or hot-reload) ``dirname`` as ``name``. Blocks the
+        caller for the full validate+deserialize+warm-up cost; the
+        request path never blocks — it serves the previous version until
+        the single-assignment publish below. Raises (with a rollback
+        event when a previous version keeps serving) on any failure."""
+        prev = self._models.get(name)
+        try:
+            model = load_compiled(dirname)
+            warmup_ms = self._warm_up(model, name) if warm else 0.0
+        except BaseException as e:
+            if prev is not None:
+                record_event("reload_rollback", site="serving.reload",
+                             model=name, kept_version=prev.version,
+                             dirname=dirname, error=repr(e))
+            raise
+        with self._lock:
+            version = self._versions.get(name, 0) + 1
+            self._versions[name] = version
+            entry = ModelEntry(name, version, dirname, model, warmup_ms,
+                               self.warm_buckets if warm else ())
+            # the publish: one dict assignment, atomic under the GIL —
+            # in-flight batches hold the old entry and finish on it
+            self._models[name] = entry
+        record_event("model_loaded", site="serving.reload", model=name,
+                     version=version, dirname=dirname,
+                     warmup_ms=round(warmup_ms, 3))
+        return entry
+
+    reload = load
+
+    def unload(self, name):
+        with self._lock:
+            return self._models.pop(name, None) is not None
+
+    def _warm_up(self, model, name):
+        """Pre-trigger the jit at the single-request path and at every
+        padding bucket, with zeros shaped from the artifact signature.
+        ``serving.reload`` fires first so chaos specs can fail a reload
+        exactly where a real bad artifact would."""
+        import numpy as np
+        t0 = time.monotonic()
+        fault_point("serving.reload")
+        zeros = {n: np.zeros(shape, dtype=dtype)
+                 for n, (shape, dtype) in model.feed_spec.items()}
+        outs = model.run(zeros)
+        for b in self.warm_buckets:
+            if b > 1:
+                stacked = {n: np.stack([z] * b) for n, z in zeros.items()}
+                outs = model.run_many(stacked)
+        # a warm-up that silently produced nothing is a broken artifact
+        if not list(outs):
+            raise ValueError("warm-up of %r produced no outputs" % name)
+        return (time.monotonic() - t0) * 1e3

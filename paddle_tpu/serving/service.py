@@ -1,0 +1,228 @@
+"""InferenceService: the in-process serving front end.
+
+Ties the registry, micro-batcher, and admission controller into one
+object with a blocking ``infer()`` / non-blocking ``infer_async()`` API
+and a metrics surface (``.stats``) on the same pattern as
+``Executor.stats`` and the async pipeline's profiler counters: request
+and shed counts, batch occupancy, queue wait, and p50/p99 end-to-end
+latency, mirrored into ``profiler.serving_counters()`` and the
+``serving`` section of the timeline artifact.
+
+The HTTP endpoint (:mod:`~paddle_tpu.serving.httpd`) and the
+``paddle_tpu serve`` CLI verb are thin shells over this class — tests
+and embedders use it directly.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+
+import numpy as np
+
+from .admission import AdmissionController, OverloadError
+from .batcher import MicroBatcher, Request
+
+__all__ = ["InferenceService"]
+
+# bounded latency reservoirs: long-lived servers must not grow a list
+# per request; percentiles over the most recent window are the ones an
+# operator acts on anyway
+_WINDOW = 4096
+
+
+def _percentile(values, q):
+    if not values:
+        return 0.0
+    s = sorted(values)
+    return s[min(int(q * len(s)), len(s) - 1)]
+
+
+class InferenceService(object):
+    """Online inference over registered compiled artifacts.
+
+    Usage::
+
+        svc = InferenceService()                       # knobs from FLAGS
+        svc.load_model("resnet", "./artifact_dir")     # warm-up included
+        outs = svc.infer("resnet", {"x": batch})       # list per fetch
+        svc.reload_model("resnet", "./artifact_v2")    # atomic hot swap
+        svc.stats                                      # metrics snapshot
+        svc.close()
+
+    Knob defaults come from ``FLAGS.serve_max_batch`` /
+    ``serve_batch_timeout_ms`` / ``serve_queue_depth``.
+    """
+
+    def __init__(self, registry=None, max_batch=None, batch_timeout_ms=None,
+                 queue_depth=None):
+        from ..flags import FLAGS
+        self.max_batch = int(max_batch if max_batch is not None
+                             else FLAGS.serve_max_batch)
+        self.batch_timeout_ms = float(
+            batch_timeout_ms if batch_timeout_ms is not None
+            else FLAGS.serve_batch_timeout_ms)
+        depth = int(queue_depth if queue_depth is not None
+                    else FLAGS.serve_queue_depth)
+        from .batcher import padding_buckets
+        from .registry import ModelRegistry
+        self.registry = registry or ModelRegistry(
+            warm_buckets=padding_buckets(self.max_batch))
+        self.admission = AdmissionController(depth)
+        self._lock = threading.Lock()
+        self._counts = collections.Counter()
+        self._occupancy_sum = 0
+        self._max_occupancy = 0
+        self._padded_rows = 0
+        self._queue_wait_ms = collections.deque(maxlen=_WINDOW)
+        self._latency_ms = collections.deque(maxlen=_WINDOW)
+        self._batcher = MicroBatcher(
+            self.registry, self.max_batch, self.batch_timeout_ms,
+            self.admission, on_shed=self._on_shed,
+            on_batch=self._on_batch, on_fail=self._on_fail)
+        self._closed = False
+
+    # -- model management ----------------------------------------------------
+    def load_model(self, name, dirname, warm=True):
+        return self.registry.load(name, dirname, warm=warm)
+
+    def reload_model(self, name, dirname, warm=True):
+        """Atomic hot reload; on failure the previous version keeps
+        serving (rollback) and the error propagates to this caller."""
+        return self.registry.load(name, dirname, warm=warm)
+
+    # -- request path --------------------------------------------------------
+    def infer_async(self, name, feed, deadline_ms=None):
+        """Enqueue one request; returns its :class:`Request` handle
+        (``.wait()`` for the rows). Raises :class:`OverloadError`
+        immediately when the queue is full. ``feed`` maps each of the
+        model's feed names to one request's arrays (the exported
+        per-request shape, no extra batch axis)."""
+        entry = self.registry.get(name)   # fail fast on unknown models
+        feed = self._checked_feed(name, entry.model, feed)
+        req = Request(name, feed,
+                      self.admission.deadline_from(deadline_ms))
+        with self._lock:
+            self._counts["requests"] += 1
+        try:
+            self._batcher.submit(req)
+        except OverloadError:
+            with self._lock:
+                self._counts["shed_overload"] += 1
+            from .. import profiler as _prof
+            _prof.update_serving_counters(shed_overload=1)
+            raise
+        return req
+
+    @staticmethod
+    def _checked_feed(name, model, feed):
+        """Validate one request against the artifact signature BEFORE it
+        queues: a malformed feed must fail its own submit, not poison
+        every co-batched request at np.stack time. Array-likes are
+        checked by attribute only (never np.asarray on a possibly
+        device-resident value — that forces a device->host transfer);
+        plain lists/scalars are converted to the exported dtype here."""
+        spec = model.feed_spec
+        out = {}
+        for fn, (shape, dtype) in spec.items():
+            if fn not in feed:
+                raise ValueError(
+                    "feed for model %r is missing %r (wants %s)"
+                    % (name, fn, sorted(spec)))
+            v = feed[fn]
+            if not hasattr(v, "shape"):
+                v = np.asarray(v, dtype=dtype)
+            if tuple(v.shape) != tuple(shape):
+                raise ValueError(
+                    "feed %r for model %r has shape %s; the artifact was "
+                    "exported for %s (one request = one exported feed, "
+                    "no extra batch axis)"
+                    % (fn, name, tuple(v.shape), tuple(shape)))
+            if str(getattr(v, "dtype", dtype)) != dtype:
+                raise ValueError(
+                    "feed %r for model %r has dtype %s; the artifact was "
+                    "exported for %s" % (fn, name, v.dtype, dtype))
+            out[fn] = v
+        return out
+
+    def infer(self, name, feed, deadline_ms=None, timeout=None):
+        """Blocking inference: list of per-fetch arrays, bit-identical
+        to ``CompiledModel.run(feed)`` on the served version."""
+        return self.infer_async(name, feed, deadline_ms).wait(timeout)
+
+    # -- observer hooks (dispatch thread) ------------------------------------
+    def _on_batch(self, requests, bucket):
+        n = len(requests)
+        with self._lock:
+            self._counts["completed"] += n
+            self._counts["batches"] += 1
+            self._occupancy_sum += n
+            self._max_occupancy = max(self._max_occupancy, n)
+            self._padded_rows += bucket - n
+            for r in requests:
+                self._queue_wait_ms.append(r.queue_wait_ms)
+                self._latency_ms.append(r.latency_ms)
+        from .. import profiler as _prof
+        _prof.update_serving_counters(
+            requests=n, batches=1, padded_rows=bucket - n,
+            max_occupancy=n,
+            queue_wait_ms=sum(r.queue_wait_ms for r in requests))
+
+    def _on_shed(self, request, reason):
+        with self._lock:
+            self._counts["shed_" + reason] += 1
+        from .. import profiler as _prof
+        _prof.update_serving_counters(**{"shed_" + reason: 1})
+
+    def _on_fail(self, requests, exc):
+        with self._lock:
+            self._counts["failed"] += len(requests)
+        from .. import profiler as _prof
+        _prof.update_serving_counters(failed=len(requests))
+
+    # -- metrics -------------------------------------------------------------
+    @property
+    def stats(self):
+        """Snapshot: counts, occupancy, queue wait, p50/p99 latency."""
+        with self._lock:
+            c = dict(self._counts)
+            batches = c.get("batches", 0)
+            qw = list(self._queue_wait_ms)
+            lat = list(self._latency_ms)
+            snap = {
+                "requests": c.get("requests", 0),
+                "completed": c.get("completed", 0),
+                "failed": c.get("failed", 0),
+                "shed_overload": c.get("shed_overload", 0),
+                "shed_deadline": c.get("shed_deadline", 0),
+                "pending": self._batcher.pending(),
+                "batches": batches,
+                "batch_occupancy": (self._occupancy_sum / batches
+                                    if batches else 0.0),
+                "max_occupancy": self._max_occupancy,
+                "padded_rows": self._padded_rows,
+                "queue_wait_ms_p50": _percentile(qw, 0.50),
+                "queue_wait_ms_p99": _percentile(qw, 0.99),
+                "latency_ms_p50": _percentile(lat, 0.50),
+                "latency_ms_p99": _percentile(lat, 0.99),
+                "models": self.registry.versions(),
+            }
+        snap["shed"] = snap["shed_overload"] + snap["shed_deadline"]
+        return snap
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            self._batcher.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # convenience for embedders comparing against the offline path
+    @staticmethod
+    def as_numpy(rows):
+        return [np.asarray(r) for r in rows]

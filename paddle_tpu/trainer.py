@@ -290,22 +290,65 @@ class Trainer(object):
             self._test_cache = (names, pruned)
         return self._test_cache[1]
 
-    def test(self, reader, fetch_list=None, program=None):
+    def test(self, reader, fetch_list=None, program=None, pipeline=None,
+             pipeline_depth=None):
         """Average fetched metrics over a reader (reference:
-        v2/trainer.py test / fluid book tests' test loops)."""
+        v2/trainer.py test / fluid book tests' test loops).
+
+        ``pipeline=True`` (default ``FLAGS.pipeline``) runs the eval
+        loop through the same async pipeline as training: a feed thread
+        prepares + device_puts batch k+1 while batch k computes, and
+        fetches materialise one batch BEHIND the dispatch (batch k's
+        metrics are read while k+1 computes; the final batch at the
+        return-value sync point) — the loop never blocks on the batch it
+        just launched, and accumulation stays O(1) in pass length.
+        Results are bit-identical to the synchronous loop;
+        ``check_nan_inf`` forces synchronous."""
         self._maybe_init()
+        from . import profiler as _prof
+        from .flags import FLAGS
         fetches = fetch_list or self.fetch_list
         program = program or self._test_program(fetches)
-        acc = None
-        n = 0
-        for data in reader():
-            outs = self.exe.run(program, feed=self.feeder.feed(data),
-                                fetch_list=fetches)
-            vals = [float(np.asarray(o).reshape(-1)[0]) for o in outs]
-            acc = vals if acc is None else [a + v for a, v in zip(acc,
-                                                                  vals)]
-            n += 1
-        return [a / max(n, 1) for a in (acc or [])]
+        use_pipe = FLAGS.pipeline if pipeline is None else bool(pipeline)
+        depth = int(pipeline_depth if pipeline_depth is not None
+                    else FLAGS.pipeline_depth)
+        if use_pipe and (depth < 1 or self.exe.check_nan_inf):
+            use_pipe = False
+        state = {"acc": None, "n": 0}
+
+        def fold(outs):
+            # accumulation is O(1) in pass length — a 50k-batch eval
+            # must not buffer 50k fetch tensors host- or device-side
+            vals = [materialize_scalar(o) for o in outs]
+            state["acc"] = (vals if state["acc"] is None
+                            else [a + v for a, v in zip(state["acc"],
+                                                        vals)])
+            state["n"] += 1
+
+        pipe = None
+        try:
+            if use_pipe:
+                pipe = FeedPipeline(reader, self.feeder, self.exe,
+                                    depth=depth)
+                prev = None  # fold batch k-1 while batch k computes
+                for data in pipe:
+                    outs = self.exe.run(program, feed=data,
+                                        fetch_list=fetches, sync=False)
+                    if prev is not None:
+                        fold(prev)
+                    prev = outs
+                if prev is not None:
+                    fold(prev)  # the pass-end sync point
+            else:
+                for data in reader():
+                    fold(self.exe.run(program,
+                                      feed=self.feeder.feed(data),
+                                      fetch_list=fetches))
+        finally:
+            if pipe is not None:
+                pipe.close()
+                self._merge_pipeline_stats(pipe, _prof)
+        return [a / max(state["n"], 1) for a in (state["acc"] or [])]
 
     def save_checkpoint(self, dirname=None, sharded=False, async_=False,
                         step=None):

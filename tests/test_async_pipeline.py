@@ -313,6 +313,30 @@ def test_examples_config_parity():
     assert l_sync and l_sync == l_pipe
 
 
+def test_eval_pipeline_parity():
+    """Trainer.test rides the same async pipeline as training (ROADMAP
+    follow-up from PR 3): feed prefetch + lazy fetches, with the whole
+    eval pass materializing at its one sync point — the return value.
+    Results must match the synchronous eval loop exactly."""
+    with pt.scope_guard(pt.Scope()):
+        main, startup, cost, feeds = _build()
+        tr = pt.Trainer(cost=cost, optimizer=pt.SGD(learning_rate=0.05),
+                        feed_list=feeds, place=pt.CPUPlace(),
+                        main_program=main, startup_program=startup)
+        tr.train(_reader(), num_passes=1, pipeline=False)
+        base_lazy = tr.exe.stats["lazy_fetches"]
+
+        sync_metrics = tr.test(_reader(seed=11), pipeline=False)
+        assert tr.exe.stats["lazy_fetches"] == base_lazy
+        pipe_metrics = tr.test(_reader(seed=11), pipeline=True)
+        assert tr.exe.stats["lazy_fetches"] > base_lazy  # eval went lazy
+        assert sync_metrics == pipe_metrics              # exact parity
+        # FLAGS.pipeline drives the default for eval too
+        with pt.flags_guard(pipeline=True):
+            flag_metrics = tr.test(_reader(seed=11))
+        assert flag_metrics == sync_metrics
+
+
 def test_profiler_pipeline_counters(tmp_path):
     from paddle_tpu import profiler
     profiler.reset_pipeline_counters()

@@ -38,6 +38,74 @@ def test_export_compiled_round_trip(tmp_path):
                                rtol=1e-5, atol=1e-6)
 
 
+def _export_tiny(tmp_path):
+    x = fluid.layers.data("x", shape=[6], dtype="float32")
+    pred = fluid.layers.fc(x, size=3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    sample = np.random.RandomState(0).rand(4, 6).astype(np.float32)
+    d = str(tmp_path / "compiled")
+    fluid.inference.export_compiled(d, ["x"], [pred], exe,
+                                    example_feed={"x": sample})
+    return d, sample
+
+
+def test_run_many_no_retrace_and_staged_passthrough(tmp_path):
+    """Serving hot-path guards: a second same-depth stack reuses the
+    scan's compiled trace, and stage()d device-resident feeds pass
+    through ``_feed_val`` untouched (no device->host->device round
+    trip)."""
+    d, sample = _export_tiny(tmp_path)
+    model = fluid.inference.load_compiled(d)
+
+    stack3 = {"x": np.stack([sample, sample * 0.5, sample * 2.0])}
+    model.run_many(stack3)
+    traced = model._scan_call._cache_size()
+    model.run_many({"x": np.stack([sample * 3.0, sample, sample])})
+    assert model._scan_call._cache_size() == traced  # same depth: no retrace
+    model.run_many({"x": np.stack([sample, sample * 4.0])})
+    assert model._scan_call._cache_size() == traced + 1  # new depth traces
+
+    staged = model.stage({"x": sample})
+    assert model._feed_val(staged["x"]) is staged["x"]
+    host = np.asarray(sample)
+    assert isinstance(model._feed_val(host), np.ndarray)
+    np.testing.assert_array_equal(np.asarray(model.run(staged)[0]),
+                                  np.asarray(model.run({"x": sample})[0]))
+
+    spec = model.feed_spec
+    assert spec == {"x": ((4, 6), "float32")}
+
+
+def test_artifact_validation_readable_errors(tmp_path):
+    """A missing/incomplete/corrupt artifact dir raises one readable
+    ArtifactError naming the offending files — not a raw
+    FileNotFoundError or pickle error mid-init."""
+    from paddle_tpu.inference import (ArtifactError, validate_artifact,
+                                      EXPORTED_FILE, PARAMS_FILE,
+                                      META_FILE)
+    missing = str(tmp_path / "never-exported")
+    assert any("does not exist" in p for p in validate_artifact(missing))
+    with pytest.raises(ArtifactError, match="does not exist"):
+        fluid.inference.load_compiled(missing)
+
+    d, _ = _export_tiny(tmp_path)
+    os.remove(os.path.join(d, PARAMS_FILE))
+    os.truncate(os.path.join(d, META_FILE), 0)
+    problems = "\n".join(validate_artifact(d))
+    assert PARAMS_FILE in problems and META_FILE in problems
+    with pytest.raises(ArtifactError) as ei:
+        fluid.inference.load_compiled(d)
+    assert PARAMS_FILE in str(ei.value) and META_FILE in str(ei.value)
+
+    # corrupt contents (right files, wrong bytes) name the bad file too
+    d2, _ = _export_tiny(tmp_path / "second")
+    with open(os.path.join(d2, EXPORTED_FILE), "wb") as f:
+        f.write(b"not stablehlo")
+    with pytest.raises(ArtifactError, match="stablehlo"):
+        fluid.inference.load_compiled(d2)
+
+
 def test_c_abi_inference_entry_point(tmp_path):
     """Export a model, then run inference from a plain C program through
     libpaddle_tpu_capi.so — no Python in the deployment code path
