@@ -1,0 +1,132 @@
+"""Gradient-communication policy measurement harness.
+
+The ONE implementation shared by tools/comm_smoke.py (CI gate) and any
+bench.py comm phase, so the parity checks, the dispatch accounting, and
+the loss-closeness criterion cannot drift between the evidence record
+and the gate.
+
+Workload: a deliberately many-parameter MLP (several small fc layers, so
+bucketing has real fusion to do) trained through
+``parallel.data_parallel_step_fn`` on a forced 8-virtual-device CPU
+``dp`` mesh — the same explicit-collective path a real multi-chip DP job
+takes; only the fabric differs. Each policy trains the same
+``passes x batches`` schedule from the same init, and the summary
+reports per-policy final losses, dispatch counts (from the bucket plan),
+and the modelled bytes-on-wire.
+"""
+from __future__ import annotations
+
+
+def build_mesh(n=8):
+    import jax
+    from paddle_tpu.parallel import make_mesh
+    devs = jax.devices()
+    if len(devs) < n:
+        raise RuntimeError(
+            "comm bench needs %d devices (run under XLA_FLAGS="
+            "--xla_force_host_platform_device_count=%d on CPU); got %d"
+            % (n, n, len(devs)))
+    return make_mesh({"dp": n}, devices=devs[:n])
+
+
+def bench(passes=3, batches=3, batch=64, feat=32, hidden=48, depth=4,
+          classes=8, lr=0.1, hosts=2, bucket_kb=16, seed=0):
+    """Train the same model under every comm policy; returns the summary
+    dict the smoke gate asserts over."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu import comm
+    from paddle_tpu.comm import CommPolicy
+
+    mesh = build_mesh()
+
+    rng = np.random.RandomState(seed)
+
+    def init_params():
+        p = {}
+        d_in = feat
+        for i in range(depth):
+            d_out = hidden if i < depth - 1 else classes
+            s = np.sqrt(2.0 / d_in)
+            p["w%d" % i] = jnp.asarray(
+                rng.randn(d_in, d_out).astype(np.float32) * s)
+            p["b%d" % i] = jnp.zeros((d_out,), jnp.float32)
+            d_in = d_out
+        return p
+
+    def loss_fn(p, x, y):
+        h = x
+        for i in range(depth - 1):
+            h = jnp.maximum(h @ p["w%d" % i] + p["b%d" % i], 0)
+        logits = h @ p["w%d" % (depth - 1)] + p["b%d" % (depth - 1)]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], 1))
+
+    rule = np.random.RandomState(99).randn(feat, classes)
+    data = []
+    for b in range(batches):
+        x = np.random.RandomState(100 + b).rand(batch, feat).astype(
+            np.float32)
+        y = (x @ rule).argmax(1).astype(np.int64)
+        data.append((x, y))
+
+    params0 = init_params()
+    n_params = len(jax.tree_util.tree_leaves(params0))
+
+    def bare_pmean_losses():
+        """The pre-comm per-leaf pmean path — the bit-parity baseline."""
+        rep, xs = P(), P("dp")
+
+        def per_device(p, x, y, lr_):
+            loss, grads = jax.value_and_grad(loss_fn)(p, x, y)
+            loss = jax.lax.pmean(loss, "dp")
+            grads = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, "dp"), grads)
+            return loss, jax.tree_util.tree_map(
+                lambda a, g: a - lr_ * g, p, grads)
+
+        pspecs = jax.tree_util.tree_map(lambda _: rep, params0)
+        step = jax.jit(comm.shard_map(
+            per_device, mesh, in_specs=(pspecs, xs, xs, rep),
+            out_specs=(rep, pspecs)))
+        p, ls = dict(params0), []
+        for ep in range(passes):
+            for x, y in data:
+                loss, p = step(p, x, y, jnp.float32(lr))
+                ls.append(float(loss))
+        return ls
+
+    def run_policy(policy):
+        from paddle_tpu.parallel import data_parallel_step_fn
+        step, state0 = data_parallel_step_fn(loss_fn, mesh, policy=policy)
+        p = dict(params0)
+        state = state0(p)
+        ls = []
+        for ep in range(passes):
+            for x, y in data:
+                loss, p, state = step(p, state, x, y, lr)
+                ls.append(float(loss))
+        summary = comm.plan_summary(p, policy, axis_size=8)
+        summary["losses"] = ls
+        summary["final_loss"] = ls[-1]
+        summary["comm_quant_fallbacks"] = int(
+            state.get("comm_quant_fallbacks", 0))
+        return summary
+
+    bucket_bytes = bucket_kb * 1024
+    policies = {
+        "none": CommPolicy(base="none"),
+        "fused": CommPolicy(base="fused", bucket_bytes=bucket_bytes),
+        "hierarchical": CommPolicy(base="hierarchical",
+                                   bucket_bytes=bucket_bytes, hosts=hosts),
+        "int8": CommPolicy(base="fused", bucket_bytes=bucket_bytes,
+                           quant="int8"),
+    }
+    out = {"n_params": n_params, "bare_losses": bare_pmean_losses(),
+           "policies": {}}
+    for name, pol in policies.items():
+        out["policies"][name] = run_policy(pol)
+    return out

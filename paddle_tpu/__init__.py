@@ -33,6 +33,7 @@ from . import dataset  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401
 from . import parallel  # noqa: F401
 from .parallel import DistributeTranspiler  # noqa: F401
+from . import comm  # noqa: F401
 from . import concurrency  # noqa: F401
 from .concurrency import Go, Channel  # noqa: F401
 from . import pipeline  # noqa: F401

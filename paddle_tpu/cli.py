@@ -1,4 +1,5 @@
-"""Command line: ``python -m paddle_tpu {train,bench,lint,serve,info,convert}``.
+"""Command line: ``python -m paddle_tpu
+{train,bench,lint,serve,accounting,info,convert}``.
 
 reference: the ``paddle`` binary (paddle/trainer/TrainerMain.cpp:32 —
 ``paddle train``, ``paddle pserver``, ``paddle merge_model``; launch wrapper
@@ -164,6 +165,55 @@ def cmd_serve(args):
     return 0
 
 
+def cmd_accounting(args):
+    """Quantify a train config's gradient-communication design: the
+    per-chip collective byte counts of the transpiled parameter set
+    (parallel.accounting ring formulas) plus the paddle_tpu.comm policy
+    matrix — bytes-on-wire and dispatch counts for
+    none/fused/hierarchical/int8 over the requested mesh. Pure analysis:
+    nothing is compiled or executed, no devices needed. Same config
+    contract as ``train``/``lint`` (the file defines ``model()``)."""
+    import paddle_tpu as pt
+    from paddle_tpu.parallel import accounting
+
+    mesh_shape = {}
+    for pair in (args.mesh or "dp=8").split(","):
+        k, eq, v = pair.partition("=")
+        try:
+            if not (eq and k.strip()):
+                raise ValueError("missing '='")
+            mesh_shape[k.strip()] = int(v)
+        except ValueError:
+            print("accounting: bad --mesh entry %r (want axis=size, e.g. "
+                  "'dp=8' or 'dp=4,tp=2')" % pair)
+            return 2
+    main, startup = pt.Program(), pt.Program()
+    try:
+        cfg = _load_config(args.config)
+        with pt.program_guard(main, startup):
+            cfg.model()
+    except Exception as e:
+        print("accounting: config %r failed to build: %s: %s"
+              % (args.config, type(e).__name__, e))
+        return 2
+    specs = getattr(main, "_shardings", None) or {}
+    try:
+        report = {
+            "mesh": mesh_shape,
+            "collectives": accounting.collective_bytes(
+                main, specs, mesh_shape),
+            "comm": accounting.comm_policy_table(
+                main, specs, mesh_shape, hosts=args.hosts or None,
+                bucket_mb=args.bucket_mb or None),
+        }
+    except ValueError as e:
+        # e.g. --hosts not dividing the data axis: readable, not a trace
+        print("accounting: %s" % e)
+        return 2
+    print(json.dumps(report, indent=2))
+    return 0
+
+
 def cmd_info(args):
     import jax
 
@@ -242,6 +292,20 @@ def main(argv=None):
     sv.add_argument("--queue_depth", type=int, default=0,
                     help="override FLAGS.serve_queue_depth (0 = flag)")
     sv.set_defaults(fn=cmd_serve)
+
+    acc = sub.add_parser(
+        "accounting", help="per-chip collective bytes + comm-policy "
+                           "matrix for a train config (paddle_tpu.comm; "
+                           "pure analysis, no devices)")
+    acc.add_argument("config")
+    acc.add_argument("--mesh", default="dp=8",
+                     help="mesh axis sizes, e.g. 'dp=8' or 'dp=4,tp=2'")
+    acc.add_argument("--hosts", type=int, default=0,
+                     help="host count for the hierarchical rows "
+                          "(0 = 2 when the axis divides, else flat)")
+    acc.add_argument("--bucket_mb", type=float, default=0.0,
+                     help="override FLAGS.comm_bucket_mb (0 = flag)")
+    acc.set_defaults(fn=cmd_accounting)
 
     i = sub.add_parser("info", help="device / build report")
     i.set_defaults(fn=cmd_info)

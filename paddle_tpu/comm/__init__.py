@@ -1,0 +1,63 @@
+"""Topology-aware gradient communication: bucketed, hierarchical,
+quantized collectives.
+
+The reference made gradient synchronisation a first-class subsystem — the
+C++/Go parameter servers (reference: paddle/pserver/ParameterServer2.h:57,
+go/pserver/service.go) and the DistributeTranspiler's send/recv rewrite
+(reference: python/paddle/fluid/distribute_transpiler.py:132) — while this
+rebuild synced with bare ``lax.psum``/``pmean`` calls scattered through
+``paddle_tpu/parallel/``: one unfused full-precision all-reduce per
+parameter, blind to the host/chip topology. This package replaces those
+call sites with a composable collective layer built from three levers:
+
+- **bucketing/fusion** (:mod:`.bucket`): flatten the grad pytree into
+  size-bounded dtype-homogeneous buckets so ONE fused all-reduce replaces
+  N per-param ones (latency amortisation — each collective is a dispatch
+  and a barrier), with an exact unflatten-back-to-pytree round trip;
+- **hierarchical all-reduce** (:mod:`.hierarchical`): over a
+  (host, chip) factorisation of the data axis, intra-host reduce-scatter
+  -> inter-host ring all-reduce on 1/chips of the bytes -> intra-host
+  all-gather (HiCCL's composition, arxiv.org/pdf/2408.05962) — the
+  slow inter-host wire carries 1/chips of the traffic a flat ring would
+  put on it;
+- **quantized all-reduce** (:mod:`.quant`): int8 symmetric quantisation
+  with per-chunk fp32 scales and error-feedback residuals carried in
+  optimizer state (EQuARX-style, arxiv.org/pdf/2506.17615), off by
+  default, with a recorded ``comm_degraded`` resilience event + clean
+  fallback to full precision when a bucket's dynamic range overflows.
+
+Entry point: ``all_reduce_grads(grads, axis_name, policy, state)`` — call
+it inside a ``shard_map``/``pmap`` body where today a
+``tree_map(pmean, grads)`` sits. ``policy=None`` resolves from flags
+(``comm_policy``/``comm_bucket_mb``/``comm_quant``); the ``none`` policy
+is bit-identical to the bare-psum path it replaces.
+
+Fault sites (armable via ``PADDLE_TPU_FAULT_SPEC``, see
+``paddle_tpu.resilience.faults``): ``comm.quantize`` fires at the
+per-bucket quantised-path build — a raise degrades that build to full
+precision with a recorded ``comm_degraded`` event; ``comm.bucket_roundtrip``
+fires at bucket-plan build — a raise degrades to the unbucketed ``none``
+path, same event.
+"""
+from __future__ import annotations
+
+from .policy import (  # noqa: F401
+    CommPolicy, resolve_policy, bytes_on_wire, policy_table,
+)
+from .bucket import (  # noqa: F401
+    BucketPlan, build_plan, flatten_to_buckets, unflatten_from_buckets,
+)
+from .hierarchical import hierarchical_all_reduce  # noqa: F401
+from .quant import quantized_all_reduce  # noqa: F401
+from .compat import shard_map  # noqa: F401
+from .allreduce import (  # noqa: F401
+    all_reduce_grads, init_state, record_step_stats, plan_summary,
+)
+
+__all__ = [
+    "CommPolicy", "resolve_policy", "bytes_on_wire", "policy_table",
+    "BucketPlan", "build_plan", "flatten_to_buckets",
+    "unflatten_from_buckets",
+    "hierarchical_all_reduce", "quantized_all_reduce", "shard_map",
+    "all_reduce_grads", "init_state", "record_step_stats", "plan_summary",
+]

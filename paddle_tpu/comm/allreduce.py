@@ -1,0 +1,238 @@
+"""The comm entry point: ``all_reduce_grads`` + its state/stat plumbing.
+
+Call ``all_reduce_grads(grads, axis_name, policy, state)`` inside a
+``shard_map``/``pmap`` body exactly where a ``tree_map(pmean, grads)``
+sat. The routing is policy-driven:
+
+==============  =============================================================
+policy          collective shape
+==============  =============================================================
+none            per-leaf ``lax.pmean`` — BIT-identical to the bare-psum
+                path this subsystem replaced (the parity baseline)
+fused           bucket the pytree (:mod:`.bucket`), one ``pmean`` per
+                flat bucket — N-params dispatches become N-buckets
+hierarchical    bucketed + topology-routed (:mod:`.hierarchical`):
+                intra-host reduce-scatter -> inter-host ring on 1/chips
+                of the bytes -> intra-host all-gather
+int8 (quant)    bucketed + quantised (:mod:`.quant`): int8 wire payloads
+                with per-chunk fp32 scales and error-feedback residuals
+                carried in ``state``; composes with ``hierarchical``
+                (the inter-host leg quantises, no EF needed — intra-host
+                sums stay exact)
+==============  =============================================================
+
+Everything here happens at TRACE time except the collectives themselves,
+so the policy dispatch costs nothing per step. Build-time degradations
+(armed ``comm.bucket_roundtrip``/``comm.quantize`` fault sites) fall
+back a rung — to unbucketed / full-precision — with a recorded
+``comm_degraded`` event, and the step function still builds: comm policy
+failures must never kill a training job that full precision could run.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..resilience.events import record_event
+from ..resilience.faults import fault_point, FaultError
+from .bucket import build_plan, flatten_to_buckets, unflatten_from_buckets
+from .hierarchical import hierarchical_all_reduce
+from .policy import (CommPolicy, resolve_policy, bytes_on_wire,
+                     bucket_wire_bytes, quant_inert_for)
+from .quant import quantized_all_reduce
+
+__all__ = ["all_reduce_grads", "init_state", "record_step_stats",
+           "plan_summary"]
+
+
+def init_state(grads, policy: Optional[CommPolicy] = None) -> Dict[str, Any]:
+    """Comm state the step function carries across steps: the cumulative
+    quant-fallback counter, plus error-feedback residuals (zeros like the
+    grads) when the policy quantises. Thread it through the step and pass
+    each step's output back in — the residuals ARE optimizer state (they
+    bias-correct the next update), so checkpoint them with it."""
+    policy = policy if policy is not None else resolve_policy()
+    state: Dict[str, Any] = {
+        "comm_quant_fallbacks": jnp.zeros((), jnp.int32)}
+    if policy.quantized and policy.base != "hierarchical":
+        state["residual"] = jax.tree_util.tree_map(
+            lambda g: jnp.zeros(jnp.shape(g), jnp.result_type(g)), grads)
+    return state
+
+
+def _pmean_tree(grads, axis_name):
+    return jax.tree_util.tree_map(
+        lambda g: jax.lax.pmean(g, axis_name), grads)
+
+
+def all_reduce_grads(grads, axis_name, policy: Optional[CommPolicy] = None,
+                     state: Optional[Dict[str, Any]] = None):
+    """Mean-reduce a gradient pytree over ``axis_name``. Returns
+    ``(synced_grads, new_state)`` — ``new_state`` is ``None`` iff
+    ``state`` was (stateless call; quantised policies then run without
+    error feedback only if ``hierarchical``, and raise for the fused int8
+    form, whose convergence story depends on the residuals)."""
+    n = int(jax.lax.psum(1, axis_name))  # concrete under shard_map/pmap
+    policy = policy if policy is not None else resolve_policy(axis_size=n)
+    if policy.is_noop or n == 1:
+        return _pmean_tree(grads, axis_name), state
+    if policy.quantized and policy.base != "hierarchical" and (
+            state is None or "residual" not in state):
+        # a state dict WITHOUT residuals (built under a non-quant policy,
+        # or restored from a pre-int8 checkpoint) must not silently train
+        # without error feedback — that is exactly the biased drift the
+        # residuals exist to prevent
+        raise ValueError(
+            "the fused int8 policy carries error-feedback residuals in comm "
+            "state, and the given state has none: build it with "
+            "comm.init_state(grads, policy) under THIS policy and thread it "
+            "through the step (see doc/comm.md), or use "
+            "comm_policy=hierarchical whose inter-host quantisation is "
+            "stateless")
+
+    chips = policy.chips(n) if policy.base == "hierarchical" else 1
+    try:
+        plan = build_plan(grads, policy.bucket_bytes,
+                          pad_multiple=max(chips, 1))
+    except FaultError as e:
+        # bucket-plan fault: degrade to the unbucketed per-leaf path —
+        # one step-build's worth of lost fusion, not a dead job
+        record_event("comm_degraded", site="comm.bucket_roundtrip",
+                     policy=policy.base, error=str(e))
+        return _pmean_tree(grads, axis_name), state
+
+    # trace-time observability: one record per step-function build (not
+    # per step — the traced collectives run without host involvement)
+    from .. import profiler as _prof
+    _prof.update_comm_counters(
+        comm_builds=1, comm_buckets=plan.num_buckets,
+        comm_dispatches=plan.num_buckets,
+        comm_payload_bytes=plan.total_bytes(),
+        comm_bytes=sum(
+            bucket_wire_bytes(nbytes, b.dtype, policy, n)
+            for b, nbytes in zip(plan.buckets, plan.payload_bytes())))
+
+    flats = flatten_to_buckets(plan, grads)
+    residual = state.get("residual") if state else None
+    if residual is not None:
+        res_flats = flatten_to_buckets(plan, residual)
+        flats = [f + r for f, r in zip(flats, res_flats)]
+
+    out_flats, new_res_flats = [], []
+    fallbacks = jnp.zeros((), jnp.int32)
+    for bucket, flat in zip(plan.buckets, flats):
+        # only fp32 buckets quantise (int8-of-bf16 would come back as
+        # fp32, silently breaking the exact-dtype round-trip contract;
+        # int buckets have no sane int8 form), and hierarchical int8 is
+        # inert at hosts=1 — no inter-host hop exists, so building the
+        # vote there would count phantom fallbacks for a quantisation
+        # that never runs
+        quant_this = not quant_inert_for(policy, bucket.dtype)
+        if quant_this:
+            try:
+                fault_point("comm.quantize")
+            except FaultError as e:
+                # quantise fault: this bucket rides full precision for
+                # the lifetime of the traced step function
+                record_event("comm_degraded", site="comm.quantize",
+                             policy=policy.base, error=str(e))
+                quant_this = False
+        if policy.base == "hierarchical":
+            if quant_this:
+                # same all-finite vote as the fused path: a non-finite
+                # chunk would quantise to scale=inf -> NaN garbage, so
+                # every device agrees (pmin) and the exact full-precision
+                # composition runs instead, counted as a fallback
+                finite = jnp.isfinite(flat).all().astype(jnp.int32)
+                ok = jax.lax.pmin(finite, axis_name) > 0
+                out = jax.lax.cond(
+                    ok,
+                    lambda v: hierarchical_all_reduce(
+                        v, axis_name, policy.hosts, quant_inter=True,
+                        quant_chunk=policy.quant_chunk),
+                    lambda v: hierarchical_all_reduce(
+                        v, axis_name, policy.hosts, quant_inter=False),
+                    flat)
+                fallbacks = fallbacks + jnp.where(ok, 0, 1).astype(
+                    jnp.int32)
+            else:
+                out = hierarchical_all_reduce(
+                    flat, axis_name, policy.hosts, quant_inter=False)
+            new_res_flats.append(jnp.zeros_like(flat))
+        elif quant_this:
+            out, res, fell = quantized_all_reduce(
+                flat, axis_name, chunk=policy.quant_chunk)
+            new_res_flats.append(res)
+            fallbacks = fallbacks + fell
+        else:
+            out = jax.lax.pmean(flat, axis_name)
+            new_res_flats.append(jnp.zeros_like(flat))
+        out_flats.append(out)
+
+    synced = unflatten_from_buckets(plan, out_flats)
+    new_state = None
+    if state is not None:
+        new_state = dict(state)
+        new_state["comm_quant_fallbacks"] = (
+            state["comm_quant_fallbacks"] + fallbacks)
+        if residual is not None:
+            new_state["residual"] = unflatten_from_buckets(
+                plan, new_res_flats)
+    return synced, new_state
+
+
+def plan_summary(grads, policy: Optional[CommPolicy] = None,
+                 axis_size: Optional[int] = None) -> Dict[str, Any]:
+    """Host-side (no tracing) summary of what a policy does to one grad
+    set: bucket count, payload bytes, modelled wire bytes per chip, and
+    collective dispatch count. Feeds Executor.stats, the profiler's comm
+    section, and the accounting CLI."""
+    import numpy as np
+    if axis_size is None:
+        axis_size = len(jax.devices())
+    policy = policy if policy is not None else resolve_policy(
+        axis_size=axis_size)
+    leaves = jax.tree_util.tree_leaves(grads)
+    n_leaves = len(leaves)
+    if policy.is_noop:
+        payload = int(sum(
+            int(np.prod(np.shape(l) or (1,)))
+            * np.dtype(jnp.result_type(l)).itemsize for l in leaves))
+        return {"policy": "none", "comm_buckets": n_leaves,
+                "comm_payload_bytes": payload,
+                "comm_bytes": bytes_on_wire(payload, policy, axis_size),
+                "comm_dispatches": n_leaves}
+    chips = policy.chips(axis_size) if policy.base == "hierarchical" else 1
+    plan = build_plan(grads, policy.bucket_bytes,
+                      pad_multiple=max(chips, 1))
+    payload = plan.total_bytes()
+    name = policy.base if not policy.quantized else (
+        "%s+%s" % (policy.base, policy.quant))
+    return {"policy": name, "comm_buckets": plan.num_buckets,
+            "comm_payload_bytes": int(payload),
+            "comm_bytes": int(sum(
+                bucket_wire_bytes(nbytes, b.dtype, policy, axis_size)
+                for b, nbytes in zip(plan.buckets, plan.payload_bytes()))),
+            "comm_dispatches": plan.num_buckets}
+
+
+def record_step_stats(state, last_fallbacks=0, stats=None):
+    """Host-side, after a step: fold the carried comm state into the
+    profiler's comm counters (and ``stats``, e.g. an ``Executor.stats``
+    dict, when given) and record a ``comm_degraded`` event when NEW
+    dynamic-range fallbacks appeared since ``last_fallbacks``. Returns
+    the cumulative fallback count — pass it back next call."""
+    from .. import profiler
+    if not state:
+        return last_fallbacks
+    fallbacks = int(state.get("comm_quant_fallbacks", 0))
+    profiler.update_comm_counters(comm_quant_fallbacks=fallbacks)
+    if stats is not None:
+        stats["comm_quant_fallbacks"] = fallbacks
+    if fallbacks > last_fallbacks:
+        record_event("comm_degraded", site="comm.quantize",
+                     reason="dynamic_range_overflow",
+                     new_fallbacks=fallbacks - last_fallbacks)
+    return fallbacks

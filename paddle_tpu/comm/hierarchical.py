@@ -1,0 +1,92 @@
+"""Hierarchical all-reduce over a (host, chip) factorisation of the data
+axis.
+
+A flat ring all-reduce over n = hosts x chips devices pushes
+``2 (n-1)/n * B`` bytes through EVERY link — including the scarce
+inter-host ones (DCN between pods; 1-GbE in the reference's clusters,
+whose measured 60.9% efficiency at 100 trainers is exactly this wall,
+reference: benchmark/cluster/vgg16/README.md:38-46). HiCCL's composition
+(arxiv.org/pdf/2408.05962) routes with the topology instead:
+
+1. intra-host **reduce-scatter** (fast ICI): chip c ends up owning the
+   host-local sum of chunk c — 1/chips of the vector;
+2. inter-host **ring all-reduce** on that chunk only: the slow wire
+   carries ``1/chips`` of the bytes a flat ring would put on it;
+3. intra-host **all-gather** (fast ICI) reassembles the full vector.
+
+Built from ``psum_scatter``/``ppermute``/``all_gather`` with
+``axis_index_groups`` over ONE named axis, so it drops into any
+``shard_map``/``pmap`` body exactly where a ``lax.pmean`` sat. The device
+order within the axis is assumed host-major (host = index // chips) —
+jax's device enumeration order on multihost TPU.
+
+The inter-host leg optionally quantises its payload to int8 with
+per-chunk fp32 scales (EQuARX's observation that the slow wire is where
+shrinking bytes pays; each hop re-quantises its accumulated value, so
+the error grows with hosts — bounded, and OFF by default).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["hierarchical_all_reduce", "topology_groups"]
+
+
+def topology_groups(hosts, chips):
+    """Host-major index groups of an axis of size hosts*chips:
+    (intra-host groups, inter-host ring permutation pairs)."""
+    intra = [[h * chips + c for c in range(chips)] for h in range(hosts)]
+    ring = [(h * chips + c, ((h + 1) % hosts) * chips + c)
+            for h in range(hosts) for c in range(chips)]
+    return intra, ring
+
+
+def hierarchical_all_reduce(flat, axis_name, hosts, mean=True,
+                            quant_inter=False, quant_chunk=256):
+    """All-reduce a flat 1-D vector over ``axis_name`` = hosts x chips,
+    routing along the topology. Call inside shard_map/pmap; the flat
+    length must be divisible by the per-host chip count (the bucket
+    planner pads to it — ``build_plan(pad_multiple=chips)``).
+    """
+    n = jax.lax.psum(1, axis_name)  # concrete under shard_map/pmap
+    n = int(n)
+    hosts = max(int(hosts), 1)
+    if n % hosts:
+        raise ValueError("axis %r size %d not divisible by hosts=%d"
+                         % (axis_name, n, hosts))
+    chips = n // hosts
+    intra, ring = topology_groups(hosts, chips)
+    if chips > 1:
+        if flat.shape[0] % chips:
+            raise ValueError(
+                "flat length %d not divisible by chips=%d (bucket plans "
+                "must pad with pad_multiple=chips)" % (flat.shape[0], chips))
+        # 1) intra-host reduce-scatter: chip c owns chunk c of the
+        #    host-local sum
+        piece = jax.lax.psum_scatter(flat, axis_name,
+                                     axis_index_groups=intra, tiled=True)
+    else:
+        piece = flat
+    # 2) inter-host shift-add ring over the chunk: hosts-1 hops, each
+    #    bringing the chunk accumulated k hosts upstream
+    if hosts > 1:
+        acc, t = piece, piece
+        for _ in range(hosts - 1):
+            if quant_inter:
+                from .quant import quantize, dequantize
+                q, scales, numel = quantize(t, quant_chunk)
+                q = jax.lax.ppermute(q, axis_name, ring)
+                scales = jax.lax.ppermute(scales, axis_name, ring)
+                t = dequantize(q, scales, numel)
+            else:
+                t = jax.lax.ppermute(t, axis_name, ring)
+            acc = acc + t
+        piece = acc
+    # 3) intra-host all-gather reassembles the full vector everywhere
+    if chips > 1:
+        flat = jax.lax.all_gather(piece, axis_name,
+                                  axis_index_groups=intra, tiled=True)
+    else:
+        flat = piece
+    return flat / n if mean else flat

@@ -1,0 +1,233 @@
+"""Communication policies + the bytes-on-wire model.
+
+A ``CommPolicy`` is the resolved answer to "how do gradients cross the
+wire": the base collective shape (``none``/``fused``/``hierarchical``),
+the bucket size bound, the wire precision (``none``/``int8``), and the
+(host, chip) factorisation of the data axis the hierarchical composition
+routes along. ``resolve_policy`` fills unset fields from the process
+flags (``comm_policy``, ``comm_bucket_mb``, ``comm_quant``,
+``comm_hosts``), so one flag flip re-routes every integrated step
+builder without code changes — the gflags discipline the reference used
+for its trainer_count/num_gradient_servers topology knobs
+(reference: paddle/utils/Flags.cpp:44-65).
+
+``bytes_on_wire`` is the analytic per-chip model of what each policy
+puts on the interconnect — the quantitative design tool
+``parallel.accounting`` and the ``paddle_tpu accounting`` CLI verb
+surface (real multi-chip fabric isn't reachable from CI, so the model
+IS the evidence, exactly like accounting.py's ring formulas).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+BASES = ("none", "fused", "hierarchical")
+QUANTS = ("none", "int8")
+
+# fp32 scale per quantisation chunk rides beside the int8 payload
+QUANT_SCALE_BYTES = 4
+
+
+class CommPolicy(object):
+    """Resolved gradient-communication policy (immutable value object)."""
+
+    __slots__ = ("base", "bucket_bytes", "quant", "hosts", "quant_chunk")
+
+    def __init__(self, base="none", bucket_bytes=4 * 1024 * 1024,
+                 quant="none", hosts=1, quant_chunk=256):
+        if base not in BASES:
+            raise ValueError("comm policy base must be one of %r, got %r"
+                             % (BASES, base))
+        if quant not in QUANTS:
+            raise ValueError("comm quant must be one of %r, got %r"
+                             % (QUANTS, quant))
+        if quant != "none" and base == "none":
+            # quantisation needs the bucketed flat form to chunk over;
+            # promote silently (documented in doc/comm.md)
+            base = "fused"
+        self.base = base
+        self.bucket_bytes = int(bucket_bytes)
+        self.quant = quant
+        self.hosts = max(int(hosts), 1)
+        self.quant_chunk = int(quant_chunk)
+
+    @property
+    def is_noop(self):
+        """True when the policy is bit-identical to the bare psum path."""
+        return self.base == "none" and self.quant == "none"
+
+    @property
+    def quantized(self):
+        return self.quant != "none"
+
+    def chips(self, axis_size):
+        """Per-host chip count of the (host, chip) factorisation."""
+        if axis_size % self.hosts:
+            raise ValueError(
+                "comm_hosts=%d does not divide the data axis (%d devices); "
+                "the hierarchical composition needs axis = hosts x chips"
+                % (self.hosts, axis_size))
+        return axis_size // self.hosts
+
+    def key(self):
+        return (self.base, self.bucket_bytes, self.quant, self.hosts,
+                self.quant_chunk)
+
+    def __eq__(self, other):
+        return isinstance(other, CommPolicy) and self.key() == other.key()
+
+    def __hash__(self):
+        return hash(self.key())
+
+    def __repr__(self):
+        return ("CommPolicy(base=%r, bucket_mb=%.1f, quant=%r, hosts=%d)"
+                % (self.base, self.bucket_bytes / 1024.0 / 1024.0,
+                   self.quant, self.hosts))
+
+
+def resolve_policy(base=None, bucket_mb=None, quant=None, hosts=None,
+                   axis_size: Optional[int] = None) -> CommPolicy:
+    """Build a CommPolicy, filling unset fields from FLAGS.
+
+    ``hosts`` resolution order: explicit arg > ``FLAGS.comm_hosts`` (0 =
+    auto) > ``jax.process_count()`` when it divides ``axis_size`` > 1
+    (flat — hierarchical degenerates to reduce-scatter + all-gather over
+    the whole axis, which is still the bandwidth-optimal flat form).
+    """
+    from ..flags import FLAGS
+    base = base if base is not None else FLAGS.comm_policy
+    bucket_mb = bucket_mb if bucket_mb is not None else FLAGS.comm_bucket_mb
+    quant = quant if quant is not None else FLAGS.comm_quant
+    if hosts is None:
+        hosts = FLAGS.comm_hosts
+    if not hosts:  # 0 = auto-detect from the process topology
+        import jax
+        hosts = jax.process_count()
+        if axis_size is not None and (hosts < 1 or axis_size % hosts):
+            hosts = 1
+    return CommPolicy(base=base, bucket_bytes=int(bucket_mb * 1024 * 1024),
+                      quant=quant, hosts=hosts)
+
+
+def _quant_payload(nbytes, quant_chunk):
+    """fp32 payload of ``nbytes`` -> (int8 payload + scales) wire bytes."""
+    elems = nbytes // 4
+    chunks = -(-max(elems, 1) // quant_chunk)
+    return elems + chunks * QUANT_SCALE_BYTES
+
+
+def bytes_on_wire(nbytes, policy: CommPolicy, axis_size: int) -> int:
+    """Per-chip bytes sent to all-reduce one fp32 payload of ``nbytes``
+    under ``policy`` over a data axis of ``axis_size`` devices.
+
+    Models the implemented algorithms, not the textbook optimum:
+
+    - ``none``/``fused``: ring all-reduce, ``2 (n-1)/n * B`` (fusion
+      changes the dispatch count, not the bytes);
+    - ``fused`` + int8: gather-based quantised all-reduce — each chip
+      sends its local int8 payload to the n-1 peers, ``(n-1) * B_q``;
+    - ``hierarchical``: intra-host reduce-scatter ``(c-1)/c * B``
+      + inter-host shift-add ring on the 1/c chunk ``(h-1) * B/c``
+      + intra-host all-gather ``(c-1)/c * B``;
+    - ``hierarchical`` + int8: same, with the inter-host chunk quantised.
+    """
+    n = max(int(axis_size), 1)
+    if n == 1:
+        return 0
+    if policy.base == "hierarchical":
+        h = policy.hosts
+        c = policy.chips(n)
+        chunk = -(-nbytes // max(c, 1))
+        inter = chunk if policy.quant == "none" else \
+            _quant_payload(chunk, policy.quant_chunk)
+        intra = 2 * (c - 1) / c * nbytes if c > 1 else 0
+        return int(intra + (h - 1) * inter)
+    if policy.quantized:
+        return int((n - 1) * _quant_payload(nbytes, policy.quant_chunk))
+    return int(2 * (n - 1) / n * nbytes)
+
+
+def quant_inert_for(policy: CommPolicy, dtype) -> bool:
+    """True when a quantised policy does NOT actually quantise a bucket
+    of this dtype: only fp32 buckets quantise (int8-of-bf16 would change
+    the round-trip dtype), and the hierarchical form quantises the
+    inter-host hop only — with one host there is no such hop."""
+    import numpy as np
+    if not policy.quantized:
+        return True
+    if np.dtype(dtype) != np.dtype(np.float32):
+        return True
+    return policy.base == "hierarchical" and policy.hosts == 1
+
+
+def bucket_wire_bytes(nbytes, dtype, policy: CommPolicy,
+                      axis_size: int) -> int:
+    """``bytes_on_wire`` for ONE bucket, pricing quantisation only where
+    the runtime actually quantises (see ``quant_inert_for``) — so the
+    model the accounting/stats report matches the bytes the implemented
+    collectives put on the wire, bucket by bucket."""
+    if policy.quantized and quant_inert_for(policy, dtype):
+        policy = CommPolicy(base=policy.base,
+                            bucket_bytes=policy.bucket_bytes,
+                            quant="none", hosts=policy.hosts,
+                            quant_chunk=policy.quant_chunk)
+    return bytes_on_wire(nbytes, policy, axis_size)
+
+
+def inter_host_bytes_per_link(nbytes, policy: CommPolicy,
+                              axis_size: int) -> int:
+    """Bytes one host-boundary link carries per step — the number that
+    actually decides multi-host scaling (per-chip totals hide it: flat
+    and hierarchical move the SAME per-chip bytes at hosts=2, but the
+    flat ring streams the whole reduction through every boundary link
+    while the hierarchical form crosses with 1/chips of it).
+
+    - flat ring (``none``/``fused``): the ring stream transits every
+      link, boundary ones included: ``2 (n-1)/n * B``;
+    - gather-based int8: the all-gather ring moves every device's
+      quantised payload through every link: ``(n-1) * B_q``;
+    - hierarchical: chip c's inter-host ring moves its ``B/chips`` chunk
+      ``hosts-1`` times over its own boundary link: ``(h-1) * B/c``
+      (int8 inter leg: quantised chunk).
+    """
+    n = max(int(axis_size), 1)
+    if n == 1:
+        return 0
+    if policy.base == "hierarchical":
+        h, c = policy.hosts, policy.chips(n)
+        if h == 1:
+            return 0
+        chunk = -(-nbytes // max(c, 1))
+        if policy.quantized:
+            chunk = _quant_payload(chunk, policy.quant_chunk)
+        return int((h - 1) * chunk)
+    if policy.quantized:
+        return int((n - 1) * _quant_payload(nbytes, policy.quant_chunk))
+    return int(2 * (n - 1) / n * nbytes)
+
+
+def policy_table(param_bytes, axis_size, n_params=None, hosts=2,
+                 bucket_mb=None):
+    """Bytes-on-wire + dispatch-count comparison of every policy for one
+    grad set — the matrix ``paddle_tpu accounting --comm`` prints and
+    doc/comm.md documents."""
+    from ..flags import FLAGS
+    bucket_mb = bucket_mb if bucket_mb is not None else FLAGS.comm_bucket_mb
+    bucket_bytes = int(bucket_mb * 1024 * 1024)
+    n_buckets = max(-(-int(param_bytes) // bucket_bytes), 1)
+    rows = []
+    for base, quant in (("none", "none"), ("fused", "none"),
+                        ("hierarchical", "none"), ("fused", "int8"),
+                        ("hierarchical", "int8")):
+        p = CommPolicy(base=base, bucket_bytes=bucket_bytes, quant=quant,
+                       hosts=hosts if base == "hierarchical" else 1)
+        rows.append({
+            "policy": base if quant == "none" else "%s+%s" % (base, quant),
+            "bytes_per_chip": bytes_on_wire(param_bytes, p, axis_size),
+            "inter_host_bytes_per_link": inter_host_bytes_per_link(
+                param_bytes, p, axis_size),
+            "collective_dispatches": (n_params if base == "none" and n_params
+                                      else n_buckets),
+            "hosts": p.hosts,
+        })
+    return rows

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -520,6 +521,37 @@ def _fetch_to_host(val, return_numpy=True):
 _WARM_JIT_CACHE: Dict[Any, Any] = {}
 _WARM_JIT_LIMIT = 256
 
+# One process-wide lock for FIRST calls of compiled step functions.
+# jax.jit is lazy: _compile returns untraced wrappers, and the trace that
+# runs on the first call walks the SHARED Program/Variable objects,
+# annotating shapes/dtypes as it goes. Two executors first-calling
+# concurrently (the async-SGD worker pattern: N threads, one program)
+# interleave those mutations and one thread bakes a numerically WRONG
+# trace into its per-executor cache — every later run of that executor is
+# silently corrupt (reproduced: 3 worker threads on the forced-8-device
+# CPU mesh diverged to nan; bit-exact once first calls serialize).
+# Serialized first calls cost nothing steady-state: the traced fn is
+# marked ready and later calls take jax's lock-free C++ fast path.
+_FIRST_TRACE_LOCK = threading.Lock()
+
+
+class _TracedOnce(object):
+    """Compiled-step wrapper that serializes the tracing first call."""
+
+    __slots__ = ("fn", "_ready")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self._ready = threading.Event()
+
+    def __call__(self, *args):
+        if self._ready.is_set():
+            return self.fn(*args)
+        with _FIRST_TRACE_LOCK:
+            out = self.fn(*args)
+        self._ready.set()
+        return out
+
 
 def clear_warm_cache():
     """Drop the process-level compiled-step registry (test isolation)."""
@@ -550,10 +582,15 @@ class Executor(object):
         # dispatch_depth) make the async execution pipeline observable:
         # overlap is only real when feed_wait stays below step time and
         # fetch syncs stay rare (see doc/async_pipeline.md)
+        # the comm_* entries model the DP grad-sync wire traffic of the
+        # compiled program under the active comm policy (paddle_tpu.comm;
+        # refreshed per compile), and record quant fallbacks folded in by
+        # comm.record_step_stats(..., stats=exe.stats)
         self.stats = {"jit_runs": 0, "eager_runs": 0, "hybrid_runs": 0,
                       "lazy_fetches": 0, "fetch_sync_count": 0,
                       "compile_cache_hits": 0, "feed_wait_ms": 0.0,
-                      "dispatch_depth": 0}
+                      "dispatch_depth": 0, "comm_bytes": 0,
+                      "comm_buckets": 0, "comm_quant_fallbacks": 0}
         # programs whose trace hit data-dependent control flow: run eager
         self._force_eager = set()
         # (uid, version) pairs already checked by the pre-trace verifier
@@ -955,6 +992,18 @@ class Executor(object):
         else:
             state_names, state_sig = cached
             state = {n: scope.find_var(n) for n in state_names}
+        # numpy-valued state (a fresh pserver pull, a user set_var) must be
+        # COPIED into an XLA-owned device buffer before the donated call:
+        # jax zero-copy-aliases aligned host buffers, so donating one hands
+        # XLA memory whose python owner can be dropped (scope replaces the
+        # entry with new_state right after the async dispatch) and recycled
+        # mid-execution. Under concurrent executors that read/write recycle
+        # produced silently WRONG gradients — reproduced deterministically
+        # by tests/test_async_sgd.py's 3-worker pattern on the forced
+        # 8-device CPU mesh; bit-exact with the copy. Device-array state
+        # (the steady training loop) passes through untouched.
+        state = {n: jnp.array(v) if isinstance(v, np.ndarray) else v
+                 for n, v in state.items()}
         if dist is not None:
             # align committed buffers with the declared shardings (no-op when
             # already placed; reshards e.g. replicated startup output → tp)
@@ -977,9 +1026,11 @@ class Executor(object):
         if fn is None:
             shardings = (_dist_shardings(dist, state, feed)
                          if dist is not None else None)
-            fn = self._compile(program, feed, fetch_names, state_names,
-                               shardings=shardings, dist=dist,
-                               repeat=repeat)
+            fn = _TracedOnce(self._compile(
+                program, feed, fetch_names, state_names,
+                shardings=shardings, dist=dist, repeat=repeat))
+            if dist is not None:
+                self._record_comm_model(program, dist)
             self._cache[key] = fn
             if len(_WARM_JIT_CACHE) >= _WARM_JIT_LIMIT:
                 _WARM_JIT_CACHE.clear()
@@ -996,6 +1047,53 @@ class Executor(object):
             scope.set_var(n, v)
         scope.set_var(RNG_VAR, new_key)
         return fetches
+
+    def _record_comm_model(self, program, dist):
+        """Refresh the comm_* stats entries: the modelled per-step wire
+        traffic of this program's DP gradient sync under the active comm
+        policy (paddle_tpu.comm). A model, not a measurement — GSPMD owns
+        the actual collective schedule on this path — but it is the same
+        bytes model the explicit data_parallel_step_fn path realises, so
+        `paddle_tpu accounting` and the profiler's comm section agree
+        across both. Runs once per fresh compile."""
+        from .. import comm
+        from .. import profiler as _prof
+        data_axis = dist.strategy.data_axis
+        n = dict(dist.mesh.shape).get(data_axis, 1)
+        if n <= 1:
+            return
+        grads_tpl = {}
+        for p in program.all_parameters():
+            spec = dist.specs.get(p.name)
+            if [a for a in (spec or ()) if a is not None]:
+                continue  # tp/ZeRO-sharded: not on the DP all-reduce path
+            if not p.shape:
+                continue
+            try:
+                dtype = np.dtype(getattr(p.dtype, "name", p.dtype) or
+                                 "float32")
+            except TypeError:
+                continue
+            grads_tpl[p.name] = jax.ShapeDtypeStruct(tuple(p.shape), dtype)
+        if not grads_tpl:
+            return
+        from ..resilience.faults import FaultError
+        policy = comm.resolve_policy(axis_size=n)
+        try:
+            summary = comm.plan_summary(grads_tpl, policy, axis_size=n)
+        except (FaultError, ValueError):
+            # observability must never kill the run: an armed
+            # comm.bucket_roundtrip fault or an axis/hosts mismatch only
+            # costs the byte model on this GSPMD path (the collectives
+            # themselves are GSPMD-derived, not comm-built)
+            return
+        self.stats["comm_bytes"] = summary["comm_bytes"]
+        self.stats["comm_buckets"] = summary["comm_buckets"]
+        _prof.update_comm_counters(
+            comm_builds=1, comm_bytes=summary["comm_bytes"],
+            comm_buckets=summary["comm_buckets"],
+            comm_dispatches=summary["comm_dispatches"],
+            comm_payload_bytes=summary["comm_payload_bytes"])
 
     def _compile(self, program, feed_template, fetch_names, state_names,
                  shardings=None, dist=None, repeat=1):

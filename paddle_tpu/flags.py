@@ -257,6 +257,39 @@ DEFINE_float("serve_batch_timeout_ms", 2.0,
              "knob: 0 dispatches immediately (lowest latency, occupancy "
              "only from true concurrency); larger values trade p50 "
              "latency for fuller batches")
+DEFINE_string("comm_policy", "none",
+              "gradient-communication policy for the DP sync path "
+              "(paddle_tpu.comm): 'none' = per-parameter pmean, "
+              "bit-identical to the pre-comm psum path; 'fused' = "
+              "bucketed (comm_bucket_mb) single all-reduce per bucket — "
+              "N-param dispatches become N-bucket dispatches; "
+              "'hierarchical' = bucketed + topology-routed: intra-host "
+              "reduce-scatter -> inter-host ring on 1/chips of the "
+              "bytes -> intra-host all-gather (the slow inter-host wire "
+              "carries 1/chips of the flat-ring traffic). Policy matrix "
+              "and when each wins: doc/comm.md")
+DEFINE_float("comm_bucket_mb", 4.0,
+             "bucket size bound in MiB for the fused/hierarchical/int8 "
+             "comm policies: grad leaves are concatenated, in "
+             "declaration order and per dtype, into flat buckets of at "
+             "most this many payload bytes (a larger leaf gets its own "
+             "bucket). Bigger buckets amortise dispatch latency; "
+             "smaller ones overlap earlier with the backward pass")
+DEFINE_string("comm_quant", "none",
+              "wire precision for the comm policies: 'none' (fp32) or "
+              "'int8' (symmetric per-chunk quantisation with fp32 "
+              "scales + error-feedback residuals carried in comm state, "
+              "EQuARX-style). With comm_policy=hierarchical only the "
+              "inter-host leg quantises (stateless); otherwise the "
+              "policy promotes to fused buckets. Dynamic-range overflow "
+              "falls back to full precision for that step with a "
+              "recorded comm_degraded event")
+DEFINE_int32("comm_hosts", 0,
+             "host count of the (host, chip) factorisation the "
+             "hierarchical comm policy routes along; 0 = auto "
+             "(jax.process_count() when it divides the data axis, else "
+             "flat). Set explicitly to simulate a multi-host topology "
+             "on a forced CPU mesh (tools/comm_smoke.py uses 2x4)")
 DEFINE_int32("serve_queue_depth", 64,
              "online serving: bound on requests queued for dispatch "
              "across all models; request queue_depth+1 is shed "

@@ -13,6 +13,7 @@ into send/recv ops.
 from .mesh import make_mesh, get_default_mesh, set_default_mesh  # noqa: F401
 from .api import (  # noqa: F401
     DistContext, ShardingStrategy, DistributeTranspiler, data_parallel,
+    data_parallel_step_fn,
 )
 from .env import get_world_size, get_rank, init_distributed  # noqa: F401
 from .ring import (  # noqa: F401

@@ -22,7 +22,8 @@ import json
 
 import numpy as np
 
-__all__ = ["collective_bytes", "scaling_table", "DTYPE_BYTES"]
+__all__ = ["collective_bytes", "scaling_table", "DTYPE_BYTES",
+           "comm_policy_table"]
 
 DTYPE_BYTES = {"float32": 4, "float16": 2, "bfloat16": 2, "int64": 8,
                "int32": 4}
@@ -104,6 +105,33 @@ def collective_bytes(program, specs, mesh_shape, zero_axis=None,
     rows["param_bytes_replicated"] = int(max(replicated, 0))
     rows["param_bytes_sharded"] = {k: int(v) for k, v in sharded.items()}
     return rows
+
+
+def comm_policy_table(program, specs, mesh_shape, dtype_bytes=4,
+                      hosts=None, bucket_mb=None):
+    """Bytes-on-wire + dispatch-count matrix of every paddle_tpu.comm
+    policy for the DP-synced (replicated) parameter set of a transpiled
+    program — the ``paddle_tpu accounting`` CLI's comm section, and the
+    same model ``comm.plan_summary`` applies to live step builds.
+
+    ``hosts`` parameterises the hierarchical rows (None = 2, the
+    smallest topology where the decomposition differs from flat);
+    ``bucket_mb`` defaults to ``FLAGS.comm_bucket_mb``.
+    """
+    from ..comm.policy import policy_table
+    data_axis = "dp" if "dp" in mesh_shape else next(iter(mesh_shape), None)
+    n = mesh_shape.get(data_axis, 1)
+    replicated, _sharded = _param_bytes(program, specs, dtype_bytes)
+    n_params = sum(
+        1 for p in program.all_parameters()
+        if not [a for a in (specs.get(p.name) or ()) if a is not None])
+    hosts = hosts if hosts else (2 if n % 2 == 0 and n > 1 else 1)
+    return {
+        "data_axis": data_axis, "axis_size": int(n),
+        "dp_synced_param_bytes": int(replicated),
+        "policies": policy_table(replicated, n, n_params=n_params,
+                                 hosts=hosts, bucket_mb=bucket_mb),
+    }
 
 
 def pipeline_accounting(n_micro, pp, act_bytes_per_micro):

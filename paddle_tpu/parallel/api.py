@@ -25,13 +25,14 @@ import re
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core import ir
 from .mesh import get_default_mesh
 
 __all__ = ["ShardingStrategy", "DistContext", "DistributeTranspiler",
-           "data_parallel"]
+           "data_parallel", "data_parallel_step_fn"]
 
 
 class ShardingStrategy(object):
@@ -217,6 +218,72 @@ class DistributeTranspiler(object):
         from ..analysis import check_after_pass
         check_after_pass(program, "DistributeTranspiler.transpile")
         return DistContext(mesh, strategy, specs)
+
+
+def data_parallel_step_fn(loss_fn, mesh: Optional[Mesh] = None,
+                          axis_name=None, policy=None, donate=False):
+    """Explicit-collective data-parallel training-step builder whose
+    gradient sync routes through ``paddle_tpu.comm`` — the jax-level
+    counterpart of the Executor's GSPMD path, for step functions that
+    want policy-controlled collectives (bucketed / hierarchical /
+    quantized) instead of whatever GSPMD derives.
+
+    ``loss_fn(params, x, y) -> scalar`` is the per-device loss over the
+    LOCAL batch shard. Returns ``(step, comm_state0_fn)``:
+
+    - ``step(params, comm_state, x, y, lr) -> (loss, new_params,
+      new_comm_state)`` — jitted; ``x``/``y`` are global batches whose
+      leading dim shards over ``axis_name``; the SGD update runs on the
+      comm-synced mean gradients.
+    - ``comm_state0_fn(params) -> comm_state`` builds the initial comm
+      state (error-feedback residuals + fallback counter). Carry it
+      through the loop and checkpoint it with optimizer state — for
+      quantised policies the residuals bias-correct the next update.
+
+    ``policy=None`` resolves from flags at build time
+    (``comm_policy``/``comm_bucket_mb``/``comm_quant``/``comm_hosts``);
+    the resolved ``none`` policy is BIT-identical to a bare
+    ``tree_map(pmean, grads)`` sync (tests/test_comm.py proves it).
+    """
+    from .. import comm
+
+    mesh = mesh or get_default_mesh()
+    if mesh is None:
+        raise ValueError("no mesh: pass one or set_default_mesh(...)")
+    axis_name = axis_name or mesh.axis_names[0]
+    n_dev = mesh.shape[axis_name]
+    policy = policy if policy is not None else comm.resolve_policy(
+        axis_size=n_dev)
+
+    def comm_state0_fn(params):
+        grads_like = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(jnp.shape(p), jnp.result_type(p)), params)
+        return comm.init_state(grads_like, policy)
+
+    def per_device(params, comm_state, x, y, lr):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        loss = jax.lax.pmean(loss, axis_name)
+        grads, comm_state = comm.all_reduce_grads(
+            grads, axis_name, policy, comm_state)
+        new_params = jax.tree_util.tree_map(
+            lambda p, g: p - lr * g, params, grads)
+        return loss, new_params, comm_state
+
+    rep = P()
+    xspec = P(axis_name)
+
+    def step(params, comm_state, x, y, lr):
+        pspecs = jax.tree_util.tree_map(lambda _: rep, params)
+        sspecs = jax.tree_util.tree_map(lambda _: rep, comm_state)
+        smapped = comm.shard_map(
+            per_device, mesh,
+            in_specs=(pspecs, sspecs, xspec, xspec, rep),
+            out_specs=(rep, pspecs, sspecs))
+        return smapped(params, comm_state, x, y,
+                       jnp.asarray(lr, jnp.float32))
+
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums), comm_state0_fn
 
 
 def data_parallel(mesh: Optional[Mesh] = None, axis=None) -> DistContext:

@@ -29,9 +29,35 @@ pick ``n_micro >= 4 * n_stages`` to keep it small.
 """
 from __future__ import annotations
 
+import warnings
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _resolve_stateless_policy(comm_policy, data_axis, mesh):
+    """Resolve the comm policy for a pipeline builder's data-axis grad
+    sync. The pipelined step functions carry no comm state, so the
+    fused-int8 policy (whose convergence depends on error-feedback
+    residuals) downgrades to its full-precision base with a warning;
+    hierarchical int8 is stateless and passes through."""
+    from .. import comm
+    if not data_axis:
+        return None
+    policy = comm_policy if comm_policy is not None else \
+        comm.resolve_policy(axis_size=mesh.shape[data_axis])
+    if policy.quantized and policy.base != "hierarchical":
+        warnings.warn(
+            "comm_quant=%s needs error-feedback state the pipelined step "
+            "builders do not carry; syncing %r grads at full precision "
+            "(use parallel.data_parallel_step_fn for fused int8, or "
+            "comm_policy=hierarchical for stateless inter-host int8)"
+            % (policy.quant, data_axis))
+        policy = comm.CommPolicy(base=policy.base,
+                                 bucket_bytes=policy.bucket_bytes,
+                                 quant="none", hosts=policy.hosts)
+    return policy
 
 __all__ = ["pipeline", "pipelined_step_fn", "stack_stage_params",
            "pipeline_hetero", "pipelined_hetero_step_fn"]
@@ -170,12 +196,20 @@ def pipeline_hetero(stage_fns, n_micro, axis_name="pp", remat=False):
 
 
 def pipelined_hetero_step_fn(stage_fns, loss_fn, mesh: Mesh, n_micro,
-                             axis_name="pp", data_axis=None, remat=False):
+                             axis_name="pp", data_axis=None, remat=False,
+                             comm_policy=None):
     """Training-step builder for heterogeneous stages: returns a jitted
     ``step(params_tuple, x, y, lr) -> (loss, new_params_tuple)`` where
-    ``params_tuple[i]`` is stage i's own pytree (any structure)."""
-    from jax.experimental.shard_map import shard_map
+    ``params_tuple[i]`` is stage i's own pytree (any structure).
 
+    The ``data_axis`` gradient sync routes through
+    ``comm.all_reduce_grads`` under ``comm_policy`` (None = resolve from
+    the comm_* flags; the resolved ``none`` policy is bit-identical to
+    the per-leaf pmean this replaced)."""
+    from .. import comm
+    from ..comm import shard_map
+
+    comm_policy = _resolve_stateless_policy(comm_policy, data_axis, mesh)
     n_stages = len(stage_fns)
     body = pipeline_hetero(stage_fns, n_micro, axis_name=axis_name,
                            remat=remat)
@@ -198,8 +232,9 @@ def pipelined_hetero_step_fn(stage_fns, loss_fn, mesh: Mesh, n_micro,
         grads = jax.tree_util.tree_map(
             lambda g: jax.lax.psum(g, axis_name), grads)
         if data_axis:
-            grads = jax.tree_util.tree_map(
-                lambda g: jax.lax.pmean(g, data_axis), grads)
+            # DP sync rides the comm subsystem (bucketed/hierarchical
+            # per comm_policy; `none` = the per-leaf pmean of old)
+            grads, _ = comm.all_reduce_grads(grads, data_axis, comm_policy)
         new_params = jax.tree_util.tree_map(
             lambda p, g: p - lr * g, params, grads)
         return loss, new_params
@@ -241,10 +276,9 @@ def pipelined_hetero_step_fn(stage_fns, loss_fn, mesh: Mesh, n_micro,
 
         param_specs = jax.tree_util.tree_map(lambda _: rep, params)
         smapped = shard_map(
-            per_device, mesh=mesh,
+            per_device, mesh,
             in_specs=(param_specs, xspec, xspec, rep, rep, rep),
-            out_specs=(rep, param_specs),
-            check_rep=False)
+            out_specs=(rep, param_specs))
         lr = jnp.asarray(lr, jnp.float32)
         return smapped(params, xm, ym, lr, act_z, out_z)
 
@@ -253,7 +287,7 @@ def pipelined_hetero_step_fn(stage_fns, loss_fn, mesh: Mesh, n_micro,
 
 def pipelined_step_fn(stage_fn, loss_fn, mesh: Mesh, n_micro,
                       axis_name="pp", data_axis=None, remat=False,
-                      donate=False):
+                      donate=False, comm_policy=None):
     """Whole pipelined training-step builder: returns a jitted
     ``step(stacked_params, x, y, lr) -> (loss, new_params)``.
 
@@ -266,10 +300,15 @@ def pipelined_step_fn(stage_fn, loss_fn, mesh: Mesh, n_micro,
     of pipeline parallelism: weights never move).
 
     With ``data_axis`` set (mesh has that axis too), the microbatch dim
-    shards over it and gradients psum over ``data_axis`` only — dp × pp.
+    shards over it and gradients sync over ``data_axis`` only — dp × pp —
+    through ``comm.all_reduce_grads`` under ``comm_policy`` (None =
+    resolve from the comm_* flags; ``none`` is bit-identical to the
+    per-leaf pmean this replaced).
     """
-    from jax.experimental.shard_map import shard_map
+    from .. import comm
+    from ..comm import shard_map
 
+    comm_policy = _resolve_stateless_policy(comm_policy, data_axis, mesh)
     body = pipeline(stage_fn, n_micro, axis_name=axis_name, remat=remat)
     batch_spec = (None, data_axis) if data_axis else (None,)
 
@@ -290,8 +329,9 @@ def pipelined_step_fn(stage_fn, loss_fn, mesh: Mesh, n_micro,
         loss, grads = jax.value_and_grad(loss_of)(params)
         loss = jax.lax.psum(loss, axis_name)  # undo the 1/n_pp in the report
         if data_axis:
-            grads = jax.tree_util.tree_map(
-                lambda g: jax.lax.pmean(g, data_axis), grads)
+            # DP sync rides the comm subsystem (bucketed/hierarchical
+            # per comm_policy; `none` = the per-leaf pmean of old)
+            grads, _ = comm.all_reduce_grads(grads, data_axis, comm_policy)
         new_params = jax.tree_util.tree_map(
             lambda p, g: p - lr * g, params, grads)
         return loss, new_params
@@ -299,10 +339,9 @@ def pipelined_step_fn(stage_fn, loss_fn, mesh: Mesh, n_micro,
     pspec = P(axis_name)
     xspec = P(*batch_spec)
     smapped = shard_map(
-        per_device, mesh=mesh,
+        per_device, mesh,
         in_specs=(pspec, xspec, xspec, P()),
-        out_specs=(P(), pspec),
-        check_rep=False)
+        out_specs=(P(), pspec))
 
     def step(stacked_params, x, y, lr):
         n = x.shape[0]

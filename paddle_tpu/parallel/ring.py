@@ -153,13 +153,13 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, seq_axis: str = "sp",
                            causal=False):
     """shard_map wrapper: q/k/v are global [batch, seq, heads, dim] arrays
     (or sharded already); the sequence dim shards over ``seq_axis``."""
-    from jax import shard_map
+    from ..comm import shard_map
     spec = P(None, seq_axis, None, None)
     fn = functools.partial(ring_attention, axis_name=seq_axis,
                            causal=causal)
-    # check_vma=False: pallas_call out_shapes don't carry vma annotations
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_vma=False)(q, k, v)
+    # check off: pallas_call out_shapes don't carry vma annotations
+    return shard_map(fn, mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)(q, k, v)
 
 
 def ulysses_attention(q, k, v, axis_name, causal=False, scale=None):
@@ -201,9 +201,9 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None):
 
 def ulysses_attention_sharded(q, k, v, mesh: Mesh, seq_axis: str = "sp",
                               causal=False):
-    from jax import shard_map
+    from ..comm import shard_map
     spec = P(None, seq_axis, None, None)
     fn = functools.partial(ulysses_attention, axis_name=seq_axis,
                            causal=causal)
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+    return shard_map(fn, mesh, in_specs=(spec, spec, spec),
                      out_specs=spec)(q, k, v)

@@ -22,7 +22,8 @@ __all__ = ["timer", "stat_summary", "print_stats", "reset_stats",
            "update_pipeline_counters", "pipeline_counters",
            "reset_pipeline_counters",
            "update_serving_counters", "serving_counters",
-           "reset_serving_counters"]
+           "reset_serving_counters",
+           "update_comm_counters", "comm_counters", "reset_comm_counters"]
 
 _enabled = False
 _records = defaultdict(list)  # label -> [seconds]
@@ -30,6 +31,7 @@ _op_events = []               # chrome-trace X events (eager per-op spans)
 _program_analyses = {}        # label -> {flops, bytes, collectives, ...}
 _pipeline_counters = defaultdict(float)  # async-pipeline observability
 _serving_counters = defaultdict(float)   # online-serving observability
+_comm_counters = defaultdict(float)      # gradient-communication observability
 _T0 = time.perf_counter()
 
 
@@ -71,6 +73,7 @@ def reset_profiler():
     _program_analyses.clear()
     _pipeline_counters.clear()
     _serving_counters.clear()
+    _comm_counters.clear()
 
 
 def update_pipeline_counters(**counters):
@@ -115,6 +118,30 @@ def serving_counters():
 
 def reset_serving_counters():
     _serving_counters.clear()
+
+
+def update_comm_counters(**counters):
+    """Accumulate gradient-communication observability counters
+    (paddle_tpu.comm; a few dict adds per step-BUILD or per recorded
+    step, never per collective). Keys in use: ``comm_bytes`` (modelled
+    per-chip wire bytes per step), ``comm_payload_bytes``,
+    ``comm_buckets``, ``comm_dispatches``, ``comm_builds``;
+    ``comm_quant_fallbacks`` is a cumulative gauge kept as a max, not a
+    sum (the comm state already accumulates it across steps)."""
+    for k, v in counters.items():
+        if k == "comm_quant_fallbacks":
+            _comm_counters[k] = max(_comm_counters[k], float(v))
+        else:
+            _comm_counters[k] += float(v)
+
+
+def comm_counters():
+    """Snapshot {counter: value} of the gradient-communication counters."""
+    return dict(_comm_counters)
+
+
+def reset_comm_counters():
+    _comm_counters.clear()
 
 
 def record_op_event(op_type, name, t_start, t_end):
@@ -198,6 +225,9 @@ def write_timeline(path):
     - ``serving``: online-serving counters (requests, batches, padded
       rows, queue-wait ms, shed counts, max batch occupancy) — the
       coalescing evidence for paddle_tpu.serving.
+    - ``comm``: gradient-communication counters (modelled wire bytes,
+      bucket/dispatch counts, cumulative quant fallbacks) — the
+      fusion/topology evidence for paddle_tpu.comm.
     """
     import json
     rows = []
@@ -215,6 +245,7 @@ def write_timeline(path):
         "programs": dict(_program_analyses),
         "pipeline": dict(_pipeline_counters),
         "serving": dict(_serving_counters),
+        "comm": dict(_comm_counters),
     }
     with open(path, "w") as f:
         json.dump(artifact, f, indent=1)

@@ -37,6 +37,20 @@ site                      where
                           the jit pre-trigger (a raise on a hot reload
                           rolls back to the serving version with a
                           recorded reload_rollback event)
+``comm.quantize``         paddle_tpu.comm, per bucket at the quantised
+                          all-reduce BUILD (trace time — the traced
+                          collectives never re-enter the host): a raise
+                          degrades that bucket to full precision for
+                          the step function's lifetime, with a recorded
+                          ``comm_degraded`` event; the step build
+                          survives (runtime dynamic-range overflows
+                          take the in-jit full-precision branch and are
+                          surfaced by comm.record_step_stats instead)
+``comm.bucket_roundtrip`` paddle_tpu.comm bucket-plan build, per
+                          all_reduce_grads trace: a raise degrades the
+                          whole sync to the unbucketed per-leaf path
+                          (policy ``none`` shape) with a recorded
+                          ``comm_degraded`` event
 ========================  ====================================================
 
 Spec grammar (env var or ``load_fault_spec`` string)::

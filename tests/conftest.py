@@ -17,6 +17,32 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np
 import pytest
 
+# The ONE definition of the forced multi-device CPU setup (the XLA_FLAGS
+# lines above): multi-chip sharding tests ask for the platform through
+# these helpers instead of re-reading jax.devices() and hand-rolling
+# meshes per test file.
+FORCED_CPU_DEVICES = 8
+
+
+@pytest.fixture(scope="session")
+def forced_cpu_devices():
+    """The forced virtual CPU devices, or a named skip when the platform
+    did not come up with enough (e.g. XLA_FLAGS were overridden)."""
+    devs = jax.devices()
+    if len(devs) < FORCED_CPU_DEVICES:
+        pytest.skip("needs the forced %d-device CPU platform, got %d "
+                    "device(s)" % (FORCED_CPU_DEVICES, len(devs)))
+    return devs[:FORCED_CPU_DEVICES]
+
+
+@pytest.fixture
+def dp8_mesh(forced_cpu_devices):
+    """A {'dp': 8} mesh over the forced CPU devices — the data-parallel
+    fixture test_comm.py and the parallel tests share."""
+    from paddle_tpu.parallel import make_mesh
+    return make_mesh({"dp": FORCED_CPU_DEVICES},
+                     devices=forced_cpu_devices)
+
 # The <=3-minute pre-commit tier (VERDICT r3 item 4): broad, fast coverage —
 # core IR/executor, the whole per-op contract suite, control flow, sequence,
 # models, parallelism meshes, and the registry-vs-reference audit. Measured

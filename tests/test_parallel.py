@@ -32,14 +32,14 @@ def _data(bs=16, feat=16, classes=4, seed=0):
     return {"x": xs, "label": ys}
 
 
-def test_mesh_shapes():
+def test_mesh_shapes(forced_cpu_devices):
     m = make_mesh({"dp": -1})
     assert m.devices.size == len(jax.devices())
     m2 = make_mesh({"dp": 4, "tp": 2})
     assert m2.shape["dp"] == 4 and m2.shape["tp"] == 2
 
 
-def test_data_parallel_training_matches_single_device():
+def test_data_parallel_training_matches_single_device(dp8_mesh):
     feed = _data()
     # single-device reference run
     avg = _build_mlp_trainer()
@@ -56,7 +56,7 @@ def test_data_parallel_training_matches_single_device():
         avg2 = _build_mlp_trainer()
         scope = pt.Scope()
         with pt.scope_guard(scope):
-            mesh = make_mesh({"dp": -1})
+            mesh = dp8_mesh
             ctx = data_parallel(mesh)
             exe2 = pt.Executor(pt.CPUPlace(), dist_context=ctx)
             exe2.run(startup)

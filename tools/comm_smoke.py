@@ -1,0 +1,85 @@
+"""Comm smoke gate (CPU tier-1): the paddle_tpu.comm gradient-sync
+policies must hold their numerics contract on a forced 8-device run —
+
+(a) ``none`` policy losses BIT-identical to the bare per-leaf pmean
+    path it replaced;
+(b) ``fused`` and ``hierarchical`` within fp32 reduction tolerance of
+    ``none``;
+(c) ``int8`` (error feedback on) within 2% relative final loss of fp32
+    over a 3-pass mnist-sized run, with zero dynamic-range fallbacks;
+(d) fusion is real: collective dispatches (buckets) strictly below the
+    parameter count.
+
+The measurement lives in benchmark/comm_bench.py — the SAME harness any
+bench comm phase emits evidence from, so gate and evidence cannot
+drift. Companion to tools/lint.sh (static), tools/perf_smoke.sh (async
+pipeline), tools/serve_smoke.sh (serving). Exit 0 on pass, 1 on
+failure; prints a one-line JSON summary either way.
+
+Invoked by tools/comm_smoke.sh; usable directly:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/comm_smoke.py
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from benchmark.comm_bench import bench
+    r = bench(passes=3, batches=3)
+    pol = r["policies"]
+    failures = []
+
+    if pol["none"]["losses"] != r["bare_losses"]:
+        failures.append("none policy not bit-identical to the bare pmean "
+                        "path")
+    ref = pol["none"]["losses"]
+    for name in ("fused", "hierarchical"):
+        ls = pol[name]["losses"]
+        worst = max(abs(a - b) / max(abs(b), 1e-9)
+                    for a, b in zip(ls, ref))
+        if worst > 1e-4:
+            failures.append("%s policy deviates %.2e rel from none "
+                            "(fp32 reduction tolerance 1e-4)"
+                            % (name, worst))
+    q_rel = abs(pol["int8"]["final_loss"] - pol["none"]["final_loss"]) \
+        / max(abs(pol["none"]["final_loss"]), 1e-9)
+    if q_rel > 0.02:
+        failures.append("int8 final loss %.4f vs fp32 %.4f: %.1f%% > 2%%"
+                        % (pol["int8"]["final_loss"],
+                           pol["none"]["final_loss"], 100 * q_rel))
+    if pol["int8"]["comm_quant_fallbacks"]:
+        failures.append("int8 run hit %d dynamic-range fallbacks on a "
+                        "healthy model"
+                        % pol["int8"]["comm_quant_fallbacks"])
+    if not pol["fused"]["comm_buckets"] < r["n_params"]:
+        failures.append("no fusion: %d buckets for %d params"
+                        % (pol["fused"]["comm_buckets"], r["n_params"]))
+
+    summary = {
+        "ok": not failures,
+        "n_params": r["n_params"],
+        "fused_buckets": pol["fused"]["comm_buckets"],
+        "none_final": pol["none"]["final_loss"],
+        "int8_final": pol["int8"]["final_loss"],
+        "int8_rel_final_loss": round(q_rel, 5),
+        "bytes_per_chip": {k: v["comm_bytes"] for k, v in pol.items()},
+    }
+    print(json.dumps(summary))
+    if failures:
+        for f in failures:
+            print("comm_smoke FAIL: %s" % f, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

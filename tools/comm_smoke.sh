@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Comm smoke gate: the paddle_tpu.comm gradient-sync policies must hold
+# their numerics contract on a forced 8-device CPU run — none-policy
+# bit-exactness, fused/hierarchical fp32-tolerance parity, int8
+# loss-curve closeness (2% final-loss) with error feedback, and real
+# dispatch reduction (buckets < param count). Companion to
+# tools/lint.sh / perf_smoke.sh / serve_smoke.sh. One retry damps
+# shared-CI scheduler noise.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+python tools/comm_smoke.py "$@" && exit 0
+echo "comm_smoke: first attempt failed; retrying once" >&2
+exec python tools/comm_smoke.py "$@"
