@@ -1,0 +1,156 @@
+"""MFU ladder: bank each kernel lever's contribution per shape.
+
+The reproducible form of the MFU campaign's claim structure: for every
+shape in the census, three rungs —
+
+  stock    - the XLA lowering (lax.conv / dense attention / jnp.matmul)
+  default  - the Pallas kernel with its hard-coded default config
+  tuned    - the Pallas kernel with the autotune winner for this
+             (device, shape) — searched live unless the winner cache
+             already has it
+
+so the evidence says not just "tuned is X times stock" but how much of
+X the kernel itself buys and how much the search buys on top. Rows go
+to benchmark/results/mfu_ladder_<device>.json in the shared
+paddle_tpu.bench.v1 schema, re-written after every row.
+
+Timer discipline matches the autotune loop (paddle_tpu/tune/timer.py):
+wall clock (best-of-trials, readback sync) on a real accelerator; on
+CPU the deterministic model timer stands in and the record SAYS so —
+model-timed rungs are structure evidence, not performance claims.
+
+Usage: python -m benchmark.mfu_ladder [--census quick|resnet|attention]
+                                      [--budget N] [--timer auto|wall|model]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# (kernel, key) populations. resnet mirrors pallas_conv_bench.CENSUS;
+# quick is CI-sized (interpret mode must finish in seconds).
+CENSUS = {
+    "quick": [
+        ("conv3x3", {"n": 4, "h": 14, "w": 14, "c": 32, "o": 32,
+                     "dtype": "float32"}),
+        ("flash_attention", {"b": 1, "s": 128, "h": 2, "d": 32,
+                             "causal": True, "dtype": "float32"}),
+        ("matmul", {"m": 64, "k": 256, "n": 256, "dtype": "float32"}),
+    ],
+    "resnet": [
+        ("conv3x3", {"n": 128, "h": 56, "w": 56, "c": 64, "o": 64,
+                     "dtype": "bfloat16"}),
+        ("conv3x3", {"n": 128, "h": 28, "w": 28, "c": 128, "o": 128,
+                     "dtype": "bfloat16"}),
+        ("conv3x3", {"n": 128, "h": 14, "w": 14, "c": 256, "o": 256,
+                     "dtype": "bfloat16"}),
+        ("conv3x3", {"n": 128, "h": 7, "w": 7, "c": 512, "o": 512,
+                     "dtype": "bfloat16"}),
+    ],
+    "attention": [
+        ("flash_attention", {"b": 8, "s": 1024, "h": 8, "d": 64,
+                             "causal": True, "dtype": "bfloat16"}),
+        ("flash_attention", {"b": 8, "s": 2048, "h": 8, "d": 64,
+                             "causal": True, "dtype": "bfloat16"}),
+        ("matmul", {"m": 8192, "k": 1024, "n": 4096,
+                    "dtype": "bfloat16"}),
+    ],
+}
+
+
+def _flops(kernel, key):
+    if kernel == "conv3x3":
+        return 2 * key["n"] * key["h"] * key["w"] * key["c"] * key["o"] * 9
+    if kernel == "flash_attention":
+        # qk^T + pv, causal halves the useful work
+        f = 4 * key["b"] * key["h"] * key["s"] * key["s"] * key["d"]
+        return f // 2 if key.get("causal") else f
+    return 2 * key["m"] * key["k"] * key["n"]
+
+
+def ladder_row(kernel, key, timer, budget=None, cache=None):
+    """One census entry -> one row with the three rungs."""
+    from paddle_tpu import tune
+
+    space = tune.get_space(kernel)
+    operands = space.make_operands(key)
+    ref_fn = space.reference(key)
+    stock_s = float(timer(ref_fn, operands, candidate=dict(tune.XLA_CONFIG),
+                          space=space, key=key))
+    default_cfg = space.default_config(key)
+    row = {"kernel": kernel, "sig": tune.signature(key),
+           "timer": getattr(timer, "kind", "custom"),
+           "stock_ms": round(stock_s * 1e3, 4)}
+    try:
+        fn = space.build(default_cfg, key)
+        default_s = float(timer(fn, operands, candidate=default_cfg,
+                                space=space, key=key))
+        row["default_ms"] = round(default_s * 1e3, 4)
+        row["default_vs_stock"] = round(stock_s / default_s, 3)
+    except Exception as e:
+        row["default_ms"] = None
+        row["default_error"] = "%s: %s" % (type(e).__name__, str(e)[:160])
+    res = tune.autotune(kernel, key, timer=timer, budget=budget,
+                        cache=cache)
+    if res.ok:
+        row["tuned_ms"] = round(res.winner_seconds * 1e3, 4)
+        row["tuned_config"] = res.winner
+        row["tuned_vs_stock"] = round(stock_s / res.winner_seconds, 3)
+        flops = _flops(kernel, key)
+        for rung in ("stock", "default", "tuned"):
+            ms = row.get("%s_ms" % rung)
+            if ms:
+                row["%s_tflops" % rung] = round(flops / (ms * 1e-3) / 1e12,
+                                                2)
+    else:
+        row["tuned_ms"] = None
+        row["tuned_error"] = "no eligible candidate"
+    return row
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--census", default="quick",
+                    choices=sorted(CENSUS))
+    ap.add_argument("--budget", type=int, default=0)
+    ap.add_argument("--timer", default="auto",
+                    choices=["auto", "wall", "model"])
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    from paddle_tpu import tune
+    from paddle_tpu.tune.results import bench_record, write_result
+
+    timer = {"wall": tune.wall_timer, "model": tune.model_timer,
+             "auto": tune.default_timer}[args.timer]()
+    cache = tune.WinnerCache()
+    budget = args.budget or None
+    rows, path = [], None
+    for kernel, key in CENSUS[args.census]:
+        print("[ladder] %s %s ..." % (kernel, tune.signature(key)),
+              file=sys.stderr, flush=True)
+        try:
+            row = ladder_row(kernel, key, timer, budget=budget,
+                             cache=cache)
+        except Exception as e:
+            row = {"kernel": kernel, "sig": tune.signature(key),
+                   "error": "%s: %s" % (type(e).__name__, str(e)[:200])}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+        # persist after every row (mfu_levers convention)
+        path = write_result(
+            bench_record("mfu_ladder", rows,
+                         meta={"census": args.census,
+                               "budget": args.budget,
+                               "cache_dir": cache.cache_dir}),
+            path=args.out)
+    print("wrote %s" % path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

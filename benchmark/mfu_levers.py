@@ -6,6 +6,10 @@ toggled INDIVIDUALLY and the end-to-end ResNet-50 training throughput is
 measured on the device, so every row attributes a delta to exactly one
 change. Rows go to benchmark/results/mfu_levers_<device>.json.
 
+Rows persist in the shared paddle_tpu.bench.v1 schema
+(paddle_tpu/tune/results.py), re-written after every row so a budget
+kill keeps the table so far.
+
 Levers (see doc/design/mfu_notes.md for the mechanism behind each):
   fuse      - steps per dispatch (lax.scan step fusion; amortizes the
               host->device round trip, which dominates on a tunnelled
@@ -65,6 +69,7 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     import jax
+    from paddle_tpu.tune.results import bench_record, write_result
     dev = jax.devices()[0]
     dev_key = "%s|%s" % (getattr(dev, "device_kind", "?"),
                          os.environ.get("PALLAS_AXON_TPU_GEN", ""))
@@ -87,7 +92,6 @@ def main(argv=None):
         os.path.dirname(os.path.abspath(__file__)), "results",
         "mfu_levers_%s.json" % dev_key.replace("|", "_")
         .replace("/", "_").replace(" ", "_"))
-    os.makedirs(os.path.dirname(out), exist_ok=True)
     rows = []
     if args.only:
         only = {n.strip() for n in args.only.split(",")}
@@ -110,9 +114,9 @@ def main(argv=None):
         rows.append(row)
         print(json.dumps(row), flush=True)
         # persist after every row: a budget kill keeps the table so far
-        with open(out, "w") as f:
-            json.dump({"device": dev_key, "base": BASE,
-                       "steps": args.steps, "rows": rows}, f, indent=1)
+        write_result(bench_record(
+            "mfu_levers", rows, device=dev_key,
+            meta={"base": BASE, "steps": args.steps}), path=out)
     print("wrote %s" % out)
 
 

@@ -1,24 +1,26 @@
 """Per-shape microbench: pallas conv3x3 vs lax.conv on ResNet-50's
 3x3 conv census (reference role: conv_cudnn_op.cu.cc per-shape algorithm
-search). Writes benchmark/results/pallas_conv_<device>.json.
+search). Writes benchmark/results/pallas_conv_<device>.json in the
+shared paddle_tpu.bench.v1 schema (paddle_tpu/tune/results.py).
 
 Run on whatever device is live (`python -m benchmark.pallas_conv_bench`);
 on CPU the pallas kernel runs in interpret mode, so the numbers are only
-meaningful on TPU — the device kind is recorded with every row.
+meaningful on TPU — the device kind is recorded with every record.
+
+Timing and parity ride the shared paddle_tpu.tune helpers (time_best's
+best-of-trials windows with a 1-element readback sync; parity_report's
+dtype-aware tolerance) — the same measurement the autotune loop and
+mfu_ladder.py use, so rows are comparable across harnesses.
 
 NOTE (r4 lesson, benchmark/results/mfu_levers_*.json): an isolated 3x3
 microbench CANNOT justify adoption — impl=matmul won this exact probe
 2.6x and regressed the end-to-end step 3x. Adoption lives in bench.py's
-pallas_trial phase, which times the full training step. This file exists
-for the per-shape evidence table.
+pallas_trial phase and the tune winner cache (timed per shape, stock XLA
+always in the race). This file exists for the per-shape evidence table.
 """
 from __future__ import annotations
 
 import json
-import os
-import time
-
-import numpy as np
 
 
 # ResNet-50 bottleneck 3x3 convs at the bench's bs128 (NHWC: N, H, W, C->O)
@@ -30,28 +32,13 @@ CENSUS = [
 ]
 
 
-def _time_best(fn, *args, iters=8, trials=3):
-    import jax
-    out = fn(*args)
-    jax.block_until_ready(out)
-    # true sync: 1-element host readback (tunnelled PJRT can ack early)
-    float(np.asarray(out.reshape(-1)[:1]).astype(np.float32))
-    best = float("inf")
-    for _ in range(trials):
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn(*args)
-        float(np.asarray(out.reshape(-1)[:1]).astype(np.float32))
-        best = min(best, (time.perf_counter() - t0) / iters)
-    return best
-
-
 def bench(batch=None, dtype="bfloat16", iters=8):
     import jax
     import jax.numpy as jnp
     from paddle_tpu.kernels.conv3x3 import conv3x3_s1_nhwc
+    from paddle_tpu.tune.results import bench_record, write_result
+    from paddle_tpu.tune.timer import parity_report, time_best
 
-    dev = jax.devices()[0]
     dt = jnp.dtype(dtype)
     rows = []
     for (n, h, w_, c, o) in CENSUS:
@@ -72,30 +59,26 @@ def bench(batch=None, dtype="bfloat16", iters=8):
             return conv3x3_s1_nhwc(x_, w_)
 
         flops = 2 * n * h * w_ * c * o * 9
-        t_lax = _time_best(lax_conv, x, w, iters=iters)
-        t_pal = _time_best(pallas_conv, x, w, iters=iters)
+        t_lax = time_best(lax_conv, x, w, iters=iters)
+        t_pal = time_best(pallas_conv, x, w, iters=iters)
+        mismatch = parity_report(lax_conv(x, w), pallas_conv(x, w))
         row = {"shape": [n, h, w_, c, o],
                "lax_ms": round(1e3 * t_lax, 3),
                "pallas_ms": round(1e3 * t_pal, 3),
                "lax_tflops": round(flops / t_lax / 1e12, 1),
                "pallas_tflops": round(flops / t_pal / 1e12, 1),
-               "speedup": round(t_lax / t_pal, 3)}
+               "speedup": round(t_lax / t_pal, 3),
+               "parity": mismatch is None,
+               "parity_note": mismatch}
         rows.append(row)
         print(json.dumps(row))
-    from bench import _git_commit
-    commit = _git_commit()
-    rec = {"device": str(getattr(dev, "device_kind", dev.platform)),
-           "platform": dev.platform, "dtype": dtype, "rows": rows,
-           "commit": commit,
-           "note": "interpret-mode (meaningless) if platform != tpu; "
-                   "adoption decided end-to-end in bench.py pallas_trial"}
-    rdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "results")
-    os.makedirs(rdir, exist_ok=True)
-    safe = rec["device"].replace(" ", "_").replace("/", "_")
-    path = os.path.join(rdir, "pallas_conv_%s.json" % safe)
-    with open(path, "w") as f:
-        json.dump(rec, f, indent=1)
+    rec = bench_record(
+        "pallas_conv", rows,
+        meta={"dtype": dtype,
+              "note": "interpret-mode (meaningless) if platform != tpu; "
+                      "adoption decided end-to-end in bench.py "
+                      "pallas_trial + the tune winner cache"})
+    path = write_result(rec)
     print("wrote", path)
     return rec
 

@@ -72,20 +72,23 @@ def child_main(args):
 
 
 def parent_main(args):
+    from paddle_tpu.tune.results import bench_record, write_result
     rows = []
     device = None
 
     def persist():
         # write after EVERY row (mfu_levers.py convention): a hung child
-        # or budget kill must not lose the already-measured table
+        # or budget kill must not lose the already-measured table —
+        # shared paddle_tpu.bench.v1 schema
         out_path = args.out or os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "results",
             "xla_flags_%s.json" % (device or "unknown").replace(" ", "_"))
-        with open(out_path, "w") as f:
-            json.dump({"note": "XLA flag sweep, ResNet-50 train step, "
-                               "bs128/fuse4/pure-AMP base unless overridden",
-                       "device": device, "rows": rows}, f, indent=1)
-        return out_path
+        rec = bench_record(
+            "xla_flags", rows, device=device or "unknown",
+            meta={"note": "XLA flag sweep, ResNet-50 train step, "
+                          "bs128/fuse4/pure-AMP base unless overridden",
+                  "steps": args.steps})
+        return write_result(rec, path=out_path)
 
     for name, flags, over in CONFIGS:
         env = dict(os.environ)

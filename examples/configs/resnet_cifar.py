@@ -1,0 +1,35 @@
+"""Book config: CIFAR-shaped ResNet-20 classifier for `paddle_tpu
+train` / `lint` / `tune`, with a synthetic image reader.
+
+This is the canonical `paddle_tpu tune` target: the 3x3/s1/p1 residual
+convs are exactly the conv3x3 kernel's population, and the final FC is
+a tunable gemm when its shape clears the MXU-alignment gate."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers, models
+
+
+def model():
+    img = layers.data(name="img", shape=[3, 32, 32], dtype="float32")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    pred = models.resnet_cifar10(img, class_dim=10, depth=20)
+    cost = layers.cross_entropy(input=pred, label=label)
+    avg_cost = layers.mean(x=cost)
+    acc = layers.accuracy(input=pred, label=label)
+
+    def reader():
+        rng = np.random.RandomState(0)
+        for _ in range(32):
+            yield (rng.rand(3, 32, 32).astype(np.float32),
+                   rng.randint(0, 10, (1,)).astype(np.int64))
+
+    return {
+        "cost": avg_cost,
+        "metrics": [acc],
+        "feed_list": [img, label],
+        "reader": pt.reader.batch(reader, batch_size=8),
+        "optimizer": pt.optimizer.Momentum(learning_rate=0.01,
+                                           momentum=0.9),
+        "num_passes": 1,
+    }

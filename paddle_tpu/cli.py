@@ -1,5 +1,5 @@
 """Command line: ``python -m paddle_tpu
-{train,bench,lint,serve,accounting,info,convert}``.
+{train,bench,lint,serve,accounting,tune,info,convert}``.
 
 reference: the ``paddle`` binary (paddle/trainer/TrainerMain.cpp:32 —
 ``paddle train``, ``paddle pserver``, ``paddle merge_model``; launch wrapper
@@ -214,6 +214,176 @@ def cmd_accounting(args):
     return 0
 
 
+def _tune_populations(program, batch, compute_dtype=None):
+    """Walk the program and collect the tunable-kernel shape keys its ops
+    actually hit: conv2d ops inside the conv3x3 kernel's population,
+    flash_attention ops, and mul gemms inside the matmul kernel's. The
+    feed batch dim (-1) substitutes ``batch``. Returns
+    [(kernel, key_dict)], deduplicated, declaration order.
+
+    ``compute_dtype`` overrides the IR-declared var dtype for the conv
+    and mul keys: dispatch keys on the dtype the op RUNS at, and under
+    AMP that is bfloat16 (amp.cast_inputs fires before tune.lookup), not
+    the declared float32 — winners tuned at the wrong dtype would never
+    hit. Defaults to bfloat16 when the program is AMP-marked."""
+    from paddle_tpu.kernels.conv3x3 import supports_conv3x3
+    from paddle_tpu.kernels.matmul import supports_matmul
+
+    if compute_dtype is None and getattr(program, "_amp", False):
+        compute_dtype = "bfloat16"
+
+    def shape_of(block, name):
+        v = block._find_var_recursive(name)
+        if v is None or v.shape is None:
+            return None
+        return tuple(batch if int(s) == -1 else int(s) for s in v.shape)
+
+    def run_dtype(block, name):
+        if compute_dtype:
+            return compute_dtype
+        v = block._find_var_recursive(name)
+        return str(getattr(v, "dtype", "float32") or "float32")
+
+    out, seen = [], set()
+
+    def add(kernel, key):
+        k = (kernel, tuple(sorted(key.items())))
+        if k not in seen:
+            seen.add(k)
+            out.append((kernel, key))
+
+    for block in program.blocks:
+        for op in block.ops:
+            if op.type == "conv2d":
+                xs = shape_of(block, op.input("Input")[0])
+                ws = shape_of(block, op.input("Filter")[0])
+                if not xs or not ws or len(xs) != 4:
+                    continue
+                s = op.attr("strides", [1, 1])
+                p = op.attr("paddings", [0, 0])
+                d = op.attr("dilations", [1, 1])
+                g = op.attr("groups", 1) or 1
+                if supports_conv3x3(ws, s, p, d, g):
+                    n, c, h, w = xs
+                    dt = run_dtype(block, op.input("Input")[0])
+                    add("conv3x3", {"n": n, "h": h, "w": w, "c": c,
+                                    "o": int(ws[0]), "dtype": dt})
+            elif op.type == "flash_attention":
+                qs = shape_of(block, op.input("Q")[0])
+                if not qs or len(qs) != 4:
+                    continue
+                # no AMP override: attention_ops does not amp-cast, so
+                # the op runs at the declared q dtype
+                qv = block._find_var_recursive(op.input("Q")[0])
+                dt = str(getattr(qv, "dtype", "float32") or "float32")
+                add("flash_attention",
+                    {"b": qs[0], "s": qs[1], "h": qs[2], "d": qs[3],
+                     "causal": bool(op.attr("causal", False)),
+                     "dtype": dt})
+            elif op.type == "mul":
+                xs = shape_of(block, op.input("X")[0])
+                ys = shape_of(block, op.input("Y")[0])
+                if not xs or not ys:
+                    continue
+                xn = op.attr("x_num_col_dims", 1)
+                yn = op.attr("y_num_col_dims", 1)
+                m = 1
+                for v in xs[:xn]:
+                    m *= v
+                k = 1
+                for v in xs[xn:]:
+                    k *= v
+                n = 1
+                for v in ys[yn:]:
+                    n *= v
+                dt = run_dtype(block, op.input("X")[0])
+                if supports_matmul((m, k), (k, n), dt):
+                    add("matmul", {"m": m, "k": k, "n": n, "dtype": dt})
+    return out
+
+
+def cmd_tune(args):
+    """Autotune the Pallas kernels a train config's program actually
+    uses (paddle_tpu.tune): enumerate each kernel's valid configs for
+    the shapes in the program, compile+parity-check+time every
+    candidate, persist winners in the per-(device, shape) cache, and
+    print the winners table. ``--dry-run`` only enumerates. Exit 0 on
+    success, 1 when a population ends with zero eligible candidates,
+    2 when the config fails to build."""
+    import paddle_tpu as pt
+    from paddle_tpu import tune as tune_mod
+    from paddle_tpu.tune import results as results_mod
+
+    main, startup = pt.Program(), pt.Program()
+    try:
+        cfg_mod = _load_config(args.config)
+        with pt.program_guard(main, startup):
+            cfg_mod.model()
+    except Exception as e:
+        print("tune: config %r failed to build: %s: %s"
+              % (args.config, type(e).__name__, e), file=sys.stderr)
+        return 2
+    pops = _tune_populations(main, args.batch,
+                             compute_dtype=args.dtype or None)
+    if not pops:
+        print("tune: no tunable kernel populations in %r (conv3x3 / "
+              "flash_attention / matmul shapes)" % args.config)
+        return 0
+    from paddle_tpu.flags import FLAGS
+    dev = results_mod.device_kind()
+    budget = args.budget if args.budget > 0 else (FLAGS.tune_budget or
+                                                  None)
+    timer = None
+    if args.timer == "wall":
+        timer = tune_mod.wall_timer()
+    elif args.timer == "model":
+        timer = tune_mod.model_timer()
+    if args.dry_run:
+        # same budget arithmetic as the real loop (stock rung included),
+        # so the printed count is exactly what a run would time
+        print("%-16s %-44s %10s" % ("kernel", "signature", "candidates"))
+        for kernel, key in pops:
+            space = tune_mod.get_space(kernel)
+            cands = space.candidates(
+                key, budget=(budget - 1) if budget else None)
+            print("%-16s %-44s %10d"
+                  % (kernel, tune_mod.signature(key), len(cands) + 1))
+        print("tune: dry run — nothing timed, nothing cached")
+        return 0
+    from paddle_tpu import profiler as _prof
+    rows, failed = [], 0
+    cache = tune_mod.WinnerCache()
+    print("%-16s %-44s %-34s %12s %6s" % ("kernel", "signature", "winner",
+                                          "time", "cands"))
+    for kernel, key in pops:
+        res = tune_mod.autotune(kernel, key, timer=timer, budget=budget,
+                                cache=cache)
+        _prof.update_tune_counters(tune_loops=1,
+                                   tune_candidates=len(res.records))
+        rows.append(res.row())
+        if not res.ok:
+            failed += 1
+            print("%-16s %-44s %-34s %12s %6d"
+                  % (kernel, res.sig, "<NO ELIGIBLE CANDIDATE>", "-",
+                     len(res.records)))
+            continue
+        win = ("xla" if res.winner.get("use") == "xla" else
+               ",".join("%s=%s" % kv for kv in sorted(res.winner.items())))
+        print("%-16s %-44s %-34s %10.3fms %6d"
+              % (kernel, res.sig, win, res.winner_seconds * 1e3,
+                 len(res.records)))
+    rec = results_mod.bench_record(
+        "tune", rows, device=dev,
+        meta={"config": args.config, "batch": args.batch,
+              "budget": budget or 0,
+              "timer": rows and rows[0]["timer"] or None,
+              "cache_dir": cache.cache_dir})
+    path = results_mod.write_result(rec, path=args.out)
+    print("tune: %d population(s), %d failed; winners cached in %s; "
+          "evidence %s" % (len(pops), failed, cache.path, path))
+    return 1 if failed else 0
+
+
 def cmd_info(args):
     import jax
 
@@ -306,6 +476,35 @@ def main(argv=None):
     acc.add_argument("--bucket_mb", type=float, default=0.0,
                      help="override FLAGS.comm_bucket_mb (0 = flag)")
     acc.set_defaults(fn=cmd_accounting)
+
+    tn = sub.add_parser(
+        "tune", help="autotune the Pallas kernels a train config uses "
+                     "(paddle_tpu.tune; winners persist per device+shape)")
+    tn.add_argument("config")
+    tn.add_argument("--batch", type=int, default=8,
+                    help="batch size substituted for the feed dim (-1) "
+                         "when deriving kernel shapes")
+    tn.add_argument("--dtype", default=None,
+                    help="compute dtype for the conv/matmul keys (e.g. "
+                         "bfloat16). Default: bfloat16 when the config "
+                         "builds an AMP-marked program — dispatch keys "
+                         "on the dtype the op RUNS at — else the "
+                         "declared var dtype")
+    tn.add_argument("--budget", type=int, default=0,
+                    help="cap candidates per (kernel, shape), stock-XLA "
+                         "rung included (0 = FLAGS.tune_budget)")
+    tn.add_argument("--dry-run", action="store_true",
+                    help="enumerate populations and candidate counts "
+                         "only; nothing timed or cached")
+    tn.add_argument("--timer", choices=["auto", "wall", "model"],
+                    default="auto",
+                    help="auto = wall clock on tpu/axon, deterministic "
+                         "model timer elsewhere (CPU interpret-mode wall "
+                         "times are noise)")
+    tn.add_argument("--out", default=None, metavar="PATH",
+                    help="evidence-record path (default "
+                         "benchmark/results/tune_<device>.json)")
+    tn.set_defaults(fn=cmd_tune)
 
     i = sub.add_parser("info", help="device / build report")
     i.set_defaults(fn=cmd_info)

@@ -534,6 +534,38 @@ _WARM_JIT_LIMIT = 256
 # marked ready and later calls take jax's lock-free C++ fast path.
 _FIRST_TRACE_LOCK = threading.Lock()
 
+# Per-program locks for the eager/hybrid paths. The jit path only walks
+# the shared Program/Variable objects on its FIRST call (serialized by
+# _FIRST_TRACE_LOCK above) — but the per-op interpreter and the hybrid
+# segment runner re-trace the shared program state on EVERY run (op attr
+# setdefaults, variable shape/dtype annotation, ConcreteScalar counter
+# propagation). Two executors eager-running one program concurrently
+# interleave those mutations exactly like the first-trace race PR 5
+# fixed for jit. One RLock per program uid: same-program eager runs
+# serialize (that is the correctness requirement), different programs
+# stay concurrent; re-entrant because a hybrid bailout re-enters
+# trace_ops on the same thread.
+_EAGER_LOCKS_GUARD = threading.Lock()
+_EAGER_TRACE_LOCKS: Dict[int, "threading.RLock"] = {}
+
+
+def _program_trace_lock(uid):
+    with _EAGER_LOCKS_GUARD:
+        lk = _EAGER_TRACE_LOCKS.get(uid)
+        if lk is None:
+            if len(_EAGER_TRACE_LOCKS) > 1024:
+                # bound dead-program locks — but evict only UNHELD ones:
+                # dropping a lock another thread is inside would hand a
+                # fresh lock to the next caller and reintroduce the
+                # shared-program trace race this registry exists to stop
+                for dead_uid in list(_EAGER_TRACE_LOCKS):
+                    dead = _EAGER_TRACE_LOCKS[dead_uid]
+                    if dead.acquire(blocking=False):
+                        dead.release()
+                        del _EAGER_TRACE_LOCKS[dead_uid]
+            lk = _EAGER_TRACE_LOCKS[uid] = threading.RLock()
+        return lk
+
 
 class _TracedOnce(object):
     """Compiled-step wrapper that serializes the tracing first call."""
@@ -586,11 +618,18 @@ class Executor(object):
         # compiled program under the active comm policy (paddle_tpu.comm;
         # refreshed per compile), and record quant fallbacks folded in by
         # comm.record_step_stats(..., stats=exe.stats)
+        # the tune_* entries mirror paddle_tpu.tune's process-level
+        # kernel-dispatch counters (hits = cached winner applied, misses
+        # = kernel default config, fallbacks = stock XLA); dispatch
+        # happens at trace time, so they move once per compile — the
+        # snapshot refreshes at the end of every run()
         self.stats = {"jit_runs": 0, "eager_runs": 0, "hybrid_runs": 0,
                       "lazy_fetches": 0, "fetch_sync_count": 0,
                       "compile_cache_hits": 0, "feed_wait_ms": 0.0,
                       "dispatch_depth": 0, "comm_bytes": 0,
-                      "comm_buckets": 0, "comm_quant_fallbacks": 0}
+                      "comm_buckets": 0, "comm_quant_fallbacks": 0,
+                      "tune_hits": 0, "tune_misses": 0,
+                      "tune_fallbacks": 0}
         # programs whose trace hit data-dependent control flow: run eager
         self._force_eager = set()
         # (uid, version) pairs already checked by the pre-trace verifier
@@ -790,6 +829,8 @@ class Executor(object):
             jax.block_until_ready([raw_data(o) for o in outs])
             _prof.record_run("program_%d_run" % program._uid,
                              time.perf_counter() - t0)
+        from .. import tune as _tune
+        self.stats.update(_tune.counters())
         if not sync:
             self.stats["lazy_fetches"] += len(outs)
             return [AsyncFetch(o, return_numpy=return_numpy,
@@ -802,7 +843,15 @@ class Executor(object):
         run as: [jit segment] [host op] [jit segment] … — the device math
         compiles, only the genuinely host-bound ops interpret. The
         reference interprets EVERY op (executor.cc:125); round 1 here
-        dropped such programs entirely to the interpreter (weak item 3)."""
+        dropped such programs entirely to the interpreter (weak item 3).
+
+        Serialized per program: unlike the jit path (one mutating trace,
+        then pure compiled calls), this path re-walks the shared Program
+        state every run — see _program_trace_lock."""
+        with _program_trace_lock(program._uid):
+            return self._run_hybrid_impl(program, feed, fetch_names, scope)
+
+    def _run_hybrid_impl(self, program, feed, fetch_names, scope):
         from .. import profiler as _prof
         _prof.set_phase("eager")
         block = program.global_block()
@@ -940,6 +989,15 @@ class Executor(object):
 
     # -- eager path (host ops, debugging) -------------------------------------
     def _run_eager(self, program, feed, fetch_names, scope):
+        """Per-op interpreter run, serialized per program: trace_ops
+        annotates the SHARED Program/Variable objects as it walks (the
+        jit path does this once under _FIRST_TRACE_LOCK; here it happens
+        every run), so concurrent eager executors over one program must
+        take turns — see _program_trace_lock."""
+        with _program_trace_lock(program._uid):
+            return self._run_eager_impl(program, feed, fetch_names, scope)
+
+    def _run_eager_impl(self, program, feed, fetch_names, scope):
         from .. import profiler as _prof
         _prof.set_phase("eager")
         block = program.global_block()

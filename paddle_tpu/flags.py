@@ -290,6 +290,26 @@ DEFINE_int32("comm_hosts", 0,
              "(jax.process_count() when it divides the data axis, else "
              "flat). Set explicitly to simulate a multi-host topology "
              "on a forced CPU mesh (tools/comm_smoke.py uses 2x4)")
+DEFINE_bool("tune", True,
+            "consult the paddle_tpu.tune winner cache at kernel dispatch "
+            "sites: a cached per-(device, shape) winner activates the "
+            "Pallas kernel with the winning config (tune_hits); a miss "
+            "keeps legacy behavior — the kernel's default config where a "
+            "kernel is already flag-enabled (tune_misses), stock XLA "
+            "lowering otherwise (tune_fallbacks). 0 disables cache "
+            "consultation entirely: dispatch is exactly the pre-tune "
+            "build, with fallbacks still counted so the stats say why "
+            "nothing was tuned")
+DEFINE_string("tune_cache_dir", "~/.cache/paddle_tpu/tune",
+              "directory of the persistent kernel-winner cache "
+              "(winners.json keyed device_kind|kernel|shape-signature, "
+              "entry-CRC checked; written by `paddle_tpu tune` and "
+              "tune.autotune) — deliberately beside compile_cache_dir: "
+              "both are per-device derived state, safe to wipe")
+DEFINE_int32("tune_budget", 0,
+             "cap on candidates the autotune loop compiles+times per "
+             "(kernel, shape), stock-XLA rung included; 0 = the full "
+             "valid space. The CLI's --budget overrides per run")
 DEFINE_int32("serve_queue_depth", 64,
              "online serving: bound on requests queued for dispatch "
              "across all models; request queue_depth+1 is shed "

@@ -8,3 +8,4 @@ works on any backend; see /opt/skills/guides/pallas_guide.md for the
 blocking rules followed here.
 """
 from .flash_attention import flash_attention  # noqa: F401
+from .matmul import matmul  # noqa: F401

@@ -32,6 +32,22 @@ BLOCK_Q = 128
 BLOCK_K = 128
 NEG_INF = -1e30
 
+DEFAULT_CONFIG = {"block_q": BLOCK_Q, "block_k": BLOCK_K}
+
+
+def _blocks_from_config(config, Sq, Sk):
+    """Resolve (block_q, block_k) for the call shape: configured blocks
+    (a paddle_tpu.tune "flash_attention" pick) clamp to the sequence
+    lengths and fall back to the 128 defaults when they don't divide the
+    padded sequence — a stale cache entry must degrade, not fail."""
+    cfg = dict(DEFAULT_CONFIG)
+    cfg.update(dict(config) if config else {})
+    bq = min(int(cfg["block_q"]), max(Sq, 1))
+    bk = min(int(cfg["block_k"]), max(Sk, 1))
+    if bq < 1 or bk < 1:
+        bq, bk = min(BLOCK_Q, Sq), min(BLOCK_K, Sk)
+    return bq, bk
+
 
 def _dense_reference(q, k, v, causal, scale):
     s = jnp.einsum("bqd,bkd->bqk", q, k) * scale
@@ -100,7 +116,8 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, causal, scale, block_k,
     l_ref[0] = (m + jnp.log(den_safe)).astype(jnp.float32)
 
 
-def _fa_forward(q3, k3, v3, causal, scale, valid_len, interpret):
+def _fa_forward(q3, k3, v3, causal, scale, valid_len, interpret,
+                config=None):
     """q3 [BH, Sq, D], k3/v3 [BH, Sk, D] -> (o [BH, Sq, D], lse [BH, Sq]).
     Sq may differ from Sk (ring-attention block chaining); causal requires
     Sq == Sk (aligned positions)."""
@@ -109,8 +126,7 @@ def _fa_forward(q3, k3, v3, causal, scale, valid_len, interpret):
 
     BH, Sq, D = q3.shape
     Sk = k3.shape[1]
-    block_q = min(BLOCK_Q, Sq)
-    block_k = min(BLOCK_K, Sk)
+    block_q, block_k = _blocks_from_config(config, Sq, Sk)
     kernel = functools.partial(_fa_kernel, causal=causal, scale=scale,
                                block_k=block_k, kv_len=Sk,
                                valid_len=valid_len)
@@ -206,13 +222,12 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, l_ref, dl_ref,
 
 
 def _fa_backward(q3, k3, v3, do3, lse, delta, causal, scale, valid_len,
-                 interpret):
+                 interpret, config=None):
     from jax.experimental import pallas as pl
 
     BH, Sq, D = q3.shape
     Sk = k3.shape[1]
-    block_q = min(BLOCK_Q, Sq)
-    block_k = min(BLOCK_K, Sk)
+    block_q, block_k = _blocks_from_config(config, Sq, Sk)
     dkv = pl.pallas_call(
         functools.partial(_fa_bwd_dkv_kernel, causal=causal, scale=scale,
                           block_q=block_q, q_len=Sq, kv_len=Sk,
@@ -265,19 +280,19 @@ def _on_tpu():
     return _amp_on_tpu()
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q3, k3, v3, causal, scale, valid_len):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q3, k3, v3, causal, scale, valid_len, config=None):
     """[BH, S, D] x3 -> (o [BH, S, D], lse [BH, S]); S % block == 0."""
     return _fa_forward(q3, k3, v3, causal, scale, valid_len,
-                       interpret=not _on_tpu())
+                       interpret=not _on_tpu(), config=config)
 
 
-def _flash_fwd(q3, k3, v3, causal, scale, valid_len):
-    o, lse = _flash(q3, k3, v3, causal, scale, valid_len)
+def _flash_fwd(q3, k3, v3, causal, scale, valid_len, config=None):
+    o, lse = _flash(q3, k3, v3, causal, scale, valid_len, config)
     return (o, lse), (q3, k3, v3, o, lse)
 
 
-def _flash_bwd(causal, scale, valid_len, res, cots):
+def _flash_bwd(causal, scale, valid_len, config, res, cots):
     q3, k3, v3, o, lse = res
     do3, dlse = cots
     # delta folds the lse cotangent: ds = p * (dp - rowsum(do*o) + dlse)
@@ -286,7 +301,8 @@ def _flash_bwd(causal, scale, valid_len, res, cots):
     if dlse is not None:
         delta = delta - dlse
     dq, dk, dv = _fa_backward(q3, k3, v3, do3, lse, delta, causal, scale,
-                              valid_len, interpret=not _on_tpu())
+                              valid_len, interpret=not _on_tpu(),
+                              config=config)
     return dq, dk, dv
 
 
@@ -300,34 +316,37 @@ def _pad_seq(x, S_pad):
     return jnp.pad(x, ((0, 0), (0, S_pad - S), (0, 0), (0, 0)))
 
 
-def flash_attention_with_lse(q, k, v, causal=False, scale=None):
+def flash_attention_with_lse(q, k, v, causal=False, scale=None,
+                             config=None):
     """q/k/v: [batch, seq, heads, dim] -> (out [B, S, H, D], lse [B, H, S]).
 
-    Any sequence length: S pads up to the 128-wide block internally; padded
+    Any sequence length: S pads up to the block width internally; padded
     k positions are masked inside the kernels and padded q rows sliced off.
     The lse output makes per-block results mergeable (ring attention).
+    ``config`` is a paddle_tpu.tune "flash_attention" pick
+    ({block_q, block_k}); None keeps the 128x128 defaults.
     """
     B, S, H, D = q.shape
     Sk = k.shape[1]
     if causal and S != Sk:
         raise ValueError("causal flash attention needs q/k aligned lengths")
     scale = scale if scale is not None else D ** -0.5
-    bq = min(BLOCK_Q, max(S, 1))
-    bk = min(BLOCK_K, max(Sk, 1))
+    bq, bk = _blocks_from_config(config, S, Sk)
     S_pad = ((S + bq - 1) // bq) * bq
     Sk_pad = ((Sk + bk - 1) // bk) * bk
+    frozen = tuple(sorted(dict(config).items())) if config else None
     q3 = _pad_seq(q, S_pad).transpose(0, 2, 1, 3).reshape(B * H, S_pad, D)
     k3 = _pad_seq(k, Sk_pad).transpose(0, 2, 1, 3).reshape(B * H, Sk_pad, D)
     v3 = _pad_seq(v, Sk_pad).transpose(0, 2, 1, 3).reshape(B * H, Sk_pad, D)
-    o3, lse = _flash(q3, k3, v3, causal, scale, Sk)
+    o3, lse = _flash(q3, k3, v3, causal, scale, Sk, frozen)
     o = o3.reshape(B, H, S_pad, D)[:, :, :S].transpose(0, 2, 1, 3)
     return o, lse.reshape(B, H, S_pad)[:, :, :S]
 
 
-def flash_attention(q, k, v, causal=False, scale=None):
+def flash_attention(q, k, v, causal=False, scale=None, config=None):
     """q/k/v: [batch, seq, heads, dim] -> [batch, seq, heads, dim].
 
     Pallas streamed-softmax forward on TPU (interpret mode elsewhere),
     Pallas recompute backward (dq/dk/dv kernels) — no [S, S] buffer in
     either direction, any sequence length."""
-    return flash_attention_with_lse(q, k, v, causal, scale)[0]
+    return flash_attention_with_lse(q, k, v, causal, scale, config)[0]

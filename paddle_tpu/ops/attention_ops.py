@@ -3,6 +3,12 @@
 No 2018 reference equivalent (attention postdates the codebase); these ops
 give the layers DSL a fused attention primitive the transformer-era models
 use, with the Pallas kernel on TPU and dense fallback elsewhere.
+
+Block sizes route through paddle_tpu.tune: a cached per-(device, shape)
+winner runs the kernel with the winning {block_q, block_k}; a miss runs
+the 128x128 default (the flash kernel IS this op's default lowering, so
+the site is always 'enabled'); a winner that says stock XLA is fastest
+lowers through the dense einsum-softmax composition instead.
 """
 from __future__ import annotations
 
@@ -11,14 +17,35 @@ import jax.numpy as jnp
 from ..core.executor import raw_data, with_lod_of
 from ..core.registry import register_op
 from ..kernels import flash_attention as _flash
+from ..kernels.flash_attention import _dense_reference
+
+
+def _dense_attention(q, k, v, causal):
+    B, S, H, D = q.shape
+    t = lambda a: a.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    o = _dense_reference(t(q), t(k), t(v), causal, D ** -0.5)
+    return o.reshape(B, H, S, D).transpose(0, 2, 1, 3).astype(q.dtype)
 
 
 @register_op("flash_attention")
 def flash_attention_op(ctx):
     """Q/K/V: [batch, seq, heads, dim] dense tensors."""
+    from .. import tune
     q = raw_data(ctx.input("Q"))
     k = raw_data(ctx.input("K"))
     v = raw_data(ctx.input("V"))
     causal = bool(ctx.attr("causal", False))
-    out = _flash(q, k, v, causal=causal)
+    B, S, H, D = q.shape
+    cfg = tune.lookup(
+        "flash_attention",
+        {"b": int(B), "s": int(S), "h": int(H), "d": int(D),
+         "causal": causal, "dtype": str(q.dtype)},
+        enabled=True)
+    if cfg is None:
+        # a tuned winner decided the dense lowering beats the streamed
+        # kernel for this (device, shape) — e.g. short sequences where
+        # the [S, S] tile fits VMEM anyway
+        out = _dense_attention(q, k, v, causal)
+    else:
+        out = _flash(q, k, v, causal=causal, config=cfg or None)
     ctx.set_output("Out", out)

@@ -24,6 +24,28 @@ def _acc_type(x):
     return jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
 
 
+def _gemm_dispatch(x2, y2):
+    """The mul op's 2-D gemm, routed through paddle_tpu.tune: ONLY a
+    cached per-(device, shape) winner activates the blocked Pallas
+    matmul (kernels/matmul.py) — stock XLA stays the default lowering,
+    so an untuned process is bit-identical to the pre-tune build. A
+    winner of 'use: xla' and every unsupported shape lower through
+    jnp.matmul (recorded as tune_fallbacks / hits respectively)."""
+    from .. import tune
+    from ..kernels.matmul import matmul as _pallas_matmul, supports_matmul
+    M, K = (int(v) for v in x2.shape)
+    N = int(y2.shape[-1])
+    if supports_matmul((M, K), (K, N), x2.dtype):
+        cfg = tune.lookup(
+            "matmul", {"m": M, "k": K, "n": N, "dtype": str(x2.dtype)},
+            enabled=False)
+        if cfg:
+            return _pallas_matmul(x2, y2, None, cfg)
+    else:
+        tune.record_fallback("matmul")
+    return jnp.matmul(x2, y2, preferred_element_type=_acc_type(x2))
+
+
 def _infer_mul(op, block):
     xv = block._find_var_recursive(op.input("X")[0])
     yv = block._find_var_recursive(op.input("Y")[0])
@@ -51,7 +73,7 @@ def mul(ctx):
     yn = ctx.attr("y_num_col_dims", 1)
     x2 = flatten_to_2d(x, xn)
     y2 = flatten_to_2d(y, yn)
-    out = jnp.matmul(x2, y2, preferred_element_type=_acc_type(x))
+    out = _gemm_dispatch(x2, y2)
     # pure AMP: store the activation half-width (f32 MXU accumulation
     # still happened via preferred_element_type)
     out = out.astype(jnp.bfloat16 if amp.keep_bf16(ctx, out_dtype)

@@ -347,23 +347,38 @@ def conv2d_apply(x, w, s, p, d, groups, pe):
     shared by the lowering below AND by explicit_grads.conv2d_grad's vjp
     replay — one definition, so the backward always runs in the same
     layout/impl the autotuner picked for the forward (and XLA can CSE the
-    replayed primitive with the real forward)."""
+    replayed primitive with the real forward).
+
+    Kernel adoption routes through paddle_tpu.tune: a cached per-(device,
+    shape) winner activates the pallas conv3x3 with the winning tiling; a
+    miss keeps the legacy flag behavior (conv_impl=pallas3x3 runs the
+    default config); no applicable kernel (or a winner that says stock
+    XLA is fastest) lowers through lax.conv with a recorded
+    tune_fallback."""
     if _conv2d_is_s2d_stem(x, w, s, p, d, groups):
         # the stem rewrite outranks conv_impl: the tuner times the stem
         # candidates specifically, so an enabled s2d pick must execute
         return _conv_stem_s2d(x, w, pe)
-    if conv_impl() == "pallas3x3":
-        from ..kernels.conv3x3 import conv3x3_s1_nhwc, supports_conv3x3
-        if supports_conv3x3(w.shape, s, p, d, groups):
+    from ..kernels.conv3x3 import conv3x3_s1_nhwc, supports_conv3x3
+    from .. import tune
+    if supports_conv3x3(w.shape, s, p, d, groups):
+        N, C, H, W = x.shape
+        cfg = tune.lookup(
+            "conv3x3",
+            {"n": int(N), "h": int(H), "w": int(W), "c": int(C),
+             "o": int(w.shape[0]), "dtype": str(x.dtype)},
+            enabled=conv_impl() == "pallas3x3")
+        if cfg is not None:
             # fused im2col-matmul in VMEM (kernels/conv3x3.py); only the
             # 3x3/s1/p1 population routes here — everything else stays
             # on the native lax.conv path
             out_dt = jnp.float32 if pe == jnp.float32 else None
             out = conv3x3_s1_nhwc(jnp.transpose(x, (0, 2, 3, 1)),
                                   jnp.transpose(w, (2, 3, 1, 0)),
-                                  out_dt)
+                                  out_dt, cfg or None)
             return jnp.transpose(out, (0, 3, 1, 2))
-        return _conv_native(x, w, s, p, d, groups, pe)
+    else:
+        tune.record_fallback("conv3x3")
     if groups == 1 and tuple(d) == (1, 1) and conv_impl() == "matmul":
         return _conv_shifted_matmul(x, w, s, p)
     return _conv_native(x, w, s, p, d, groups, pe)

@@ -23,7 +23,8 @@ __all__ = ["timer", "stat_summary", "print_stats", "reset_stats",
            "reset_pipeline_counters",
            "update_serving_counters", "serving_counters",
            "reset_serving_counters",
-           "update_comm_counters", "comm_counters", "reset_comm_counters"]
+           "update_comm_counters", "comm_counters", "reset_comm_counters",
+           "update_tune_counters", "tune_counters", "reset_tune_counters"]
 
 _enabled = False
 _records = defaultdict(list)  # label -> [seconds]
@@ -32,6 +33,7 @@ _program_analyses = {}        # label -> {flops, bytes, collectives, ...}
 _pipeline_counters = defaultdict(float)  # async-pipeline observability
 _serving_counters = defaultdict(float)   # online-serving observability
 _comm_counters = defaultdict(float)      # gradient-communication observability
+_tune_counters = defaultdict(float)      # kernel-autotuning observability
 _T0 = time.perf_counter()
 
 
@@ -74,6 +76,7 @@ def reset_profiler():
     _pipeline_counters.clear()
     _serving_counters.clear()
     _comm_counters.clear()
+    _tune_counters.clear()
 
 
 def update_pipeline_counters(**counters):
@@ -142,6 +145,27 @@ def comm_counters():
 
 def reset_comm_counters():
     _comm_counters.clear()
+
+
+def update_tune_counters(**counters):
+    """Accumulate kernel-autotuning observability counters
+    (paddle_tpu.tune; a few dict adds per kernel DISPATCH, which happens
+    at trace time — once per compile, never per step). Keys in use:
+    ``tune_hits`` (cached winner applied), ``tune_misses`` (kernel ran
+    its default config), ``tune_fallbacks`` (stock XLA lowering),
+    ``tune_loops`` / ``tune_candidates`` (autotune-loop activity from
+    the CLI / smoke gate)."""
+    for k, v in counters.items():
+        _tune_counters[k] += float(v)
+
+
+def tune_counters():
+    """Snapshot {counter: value} of the kernel-autotuning counters."""
+    return dict(_tune_counters)
+
+
+def reset_tune_counters():
+    _tune_counters.clear()
 
 
 def record_op_event(op_type, name, t_start, t_end):
@@ -228,6 +252,9 @@ def write_timeline(path):
     - ``comm``: gradient-communication counters (modelled wire bytes,
       bucket/dispatch counts, cumulative quant fallbacks) — the
       fusion/topology evidence for paddle_tpu.comm.
+    - ``tune``: kernel-autotuning counters (winner-cache hits/misses/
+      stock-XLA fallbacks at dispatch, autotune-loop activity) — the
+      adoption evidence for paddle_tpu.tune.
     """
     import json
     rows = []
@@ -246,6 +273,7 @@ def write_timeline(path):
         "pipeline": dict(_pipeline_counters),
         "serving": dict(_serving_counters),
         "comm": dict(_comm_counters),
+        "tune": dict(_tune_counters),
     }
     with open(path, "w") as f:
         json.dump(artifact, f, indent=1)

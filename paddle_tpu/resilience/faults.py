@@ -51,6 +51,21 @@ site                      where
                           whole sync to the unbucketed per-leaf path
                           (policy ``none`` shape) with a recorded
                           ``comm_degraded`` event
+``tune.candidate``        paddle_tpu.tune autotune loop, per candidate
+                          config, before build/compile: a raise is
+                          indistinguishable from a real candidate
+                          failure — recorded as a failed candidate +
+                          ``tune_candidate_failed`` event, skipped, the
+                          loop survives and still picks a winner from
+                          the rest (stock XLA is always in the race)
+``tune.cache``            paddle_tpu.tune winner-cache write, per
+                          persist, between entry-CRC computation and
+                          disk (corrupt-able, the checkpoint.write
+                          convention): the next load DETECTS the rot,
+                          drops the file/entry with a recorded
+                          ``tune_cache_corrupt`` event, and dispatch
+                          falls back to default-config/stock-XLA until
+                          a re-tune repopulates
 ========================  ====================================================
 
 Spec grammar (env var or ``load_fault_spec`` string)::

@@ -1,0 +1,176 @@
+"""Persistent per-(device, kernel, shape) winner cache.
+
+The cuDNN-search half of the reference's ``conv_cudnn_op.cu.cc`` kept
+its per-shape algorithm picks in an in-process map; ours must survive
+the process (tuning costs real device minutes) and travel with the
+compile cache, so winners live in a JSON file next to the PR-3 XLA
+compile cache:
+
+    <FLAGS.tune_cache_dir>/winners.json
+    {"schema": "paddle_tpu.tune.v1",
+     "entries": {"<device_kind>|<kernel>|<sig>":
+                 {"config": {...}, "time_ms": ..., "timer": "wall|model",
+                  "commit": ..., "crc32": <entry CRC>}}}
+
+Integrity follows the checkpoint convention (checkpoint.py): every
+entry carries a CRC32 over its canonical JSON (computed before the
+bytes leave memory), and the write path passes through the
+``tune.cache`` fault site so chaos tests can bit-rot the file after
+the CRC was computed. A corrupt file or entry is DETECTED, dropped,
+and recorded as a ``tune_cache_corrupt`` degradation event — dispatch
+then simply misses (default config / stock XLA) and the next
+``paddle_tpu tune`` run re-tunes. Never a crash.
+
+A process-level in-memory layer fronts the file: the first lookup per
+cache dir loads and validates once; every later lookup is a dict hit.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import zlib
+
+from ..resilience.events import record_event
+from ..resilience.faults import fault_point
+
+__all__ = ["WinnerCache", "default_cache_dir", "cache_key",
+           "clear_memory_cache"]
+
+SCHEMA = "paddle_tpu.tune.v1"
+FILENAME = "winners.json"
+
+_mem_lock = threading.Lock()
+_mem = {}          # cache_dir -> {key: entry}  (validated, CRC-checked)
+
+
+def default_cache_dir():
+    from ..flags import FLAGS
+    return os.path.expanduser(FLAGS.tune_cache_dir)
+
+
+def cache_key(device_kind, kernel, sig):
+    return "%s|%s|%s" % (device_kind, kernel, sig)
+
+
+def _entry_crc(entry):
+    """CRC32 of the entry's canonical JSON minus the crc field itself."""
+    body = {k: v for k, v in entry.items() if k != "crc32"}
+    raw = json.dumps(body, sort_keys=True).encode("utf-8")
+    return zlib.crc32(raw) & 0xFFFFFFFF
+
+
+def clear_memory_cache():
+    """Drop the process-level layer (test isolation / post-tune reload)."""
+    with _mem_lock:
+        _mem.clear()
+
+
+class WinnerCache(object):
+    """File-backed winner store for one cache directory."""
+
+    def __init__(self, cache_dir=None):
+        self.cache_dir = os.path.expanduser(cache_dir or
+                                            default_cache_dir())
+        self.path = os.path.join(self.cache_dir, FILENAME)
+
+    # -- load ----------------------------------------------------------------
+    def _load_validated(self):
+        """Read + validate the file: {key: entry} with every surviving
+        entry CRC-verified. Corruption is recorded, not raised."""
+        if not os.path.exists(self.path):
+            return {}
+        try:
+            with open(self.path, "rb") as f:
+                raw = f.read()
+            doc = json.loads(raw.decode("utf-8"))
+            if doc.get("schema") != SCHEMA:
+                raise ValueError("schema %r != %r"
+                                 % (doc.get("schema"), SCHEMA))
+            entries = doc.get("entries", {})
+            if not isinstance(entries, dict):
+                raise ValueError("entries is not a mapping")
+        except (ValueError, OSError, UnicodeDecodeError) as e:
+            record_event("tune_cache_corrupt", site="tune.cache",
+                         path=self.path, error=str(e)[:200])
+            return {}
+        out = {}
+        for key, entry in entries.items():
+            if (not isinstance(entry, dict)
+                    or entry.get("crc32") != _entry_crc(entry)):
+                record_event("tune_cache_corrupt", site="tune.cache",
+                             path=self.path, key=key,
+                             error="entry CRC mismatch")
+                continue
+            out[key] = entry
+        return out
+
+    def entries(self):
+        """Validated entries through the in-memory layer."""
+        with _mem_lock:
+            cached = _mem.get(self.cache_dir)
+        if cached is not None:
+            return cached
+        loaded = self._load_validated()
+        with _mem_lock:
+            # a racing loader may have won; keep the first installed map
+            return _mem.setdefault(self.cache_dir, loaded)
+
+    def get(self, key):
+        return self.entries().get(key)
+
+    def get_config(self, key):
+        e = self.get(key)
+        return dict(e["config"]) if e and "config" in e else None
+
+    # -- store ---------------------------------------------------------------
+    def put(self, key, config, time_ms=None, timer=None, meta=None):
+        """Install a winner and persist. The whole read-modify-write
+        holds the process lock — two threads tuning different kernels
+        against one cache dir must not drop each other's winner (file
+        writes are operator-action rate; the coarse lock is fine).
+        Cross-process stays last-writer-wins, same as the XLA compile
+        cache."""
+        entry = {"config": dict(config),
+                 "time_ms": None if time_ms is None else float(time_ms),
+                 "timer": timer}
+        if meta:
+            entry.update(meta)
+        entry["crc32"] = _entry_crc(entry)
+        with _mem_lock:
+            current = _mem.get(self.cache_dir)
+            if current is None:
+                current = self._load_validated()
+            entries = dict(current)
+            entries[key] = entry
+            self._write(entries)
+            _mem[self.cache_dir] = entries
+        return entry
+
+    def _write(self, entries):
+        doc = {"schema": SCHEMA, "entries": entries}
+        raw = json.dumps(doc, indent=1, sort_keys=True).encode("utf-8")
+        # the fault site sits between CRC computation and disk — the
+        # checkpoint.write convention: models bit-rot after integrity
+        # metadata was derived
+        raw = fault_point("tune.cache", raw)
+        os.makedirs(self.cache_dir, exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(raw)
+        os.replace(tmp, self.path)
+
+    def drop(self, key):
+        """Remove one entry (used by re-tune-after-corruption flows);
+        same whole-RMW locking as put()."""
+        with _mem_lock:
+            current = _mem.get(self.cache_dir)
+            if current is None:
+                current = self._load_validated()
+            entries = dict(current)
+            if entries.pop(key, None) is None:
+                _mem.setdefault(self.cache_dir, current)
+                return False
+            self._write(entries)
+            _mem[self.cache_dir] = entries
+        return True

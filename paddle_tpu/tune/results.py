@@ -1,0 +1,88 @@
+"""Shared benchmark-result JSON schema (``paddle_tpu.bench.v1``).
+
+Before this module every benchmark wrote its own ad-hoc shape
+(``pallas_conv_bench`` one, ``mfu_levers`` another, ``xla_flags_sweep`` a
+third), so banking evidence across rounds meant re-learning each file.
+One record shape now serves ``benchmark/{pallas_conv_bench,mfu_levers,
+xla_flags_sweep,mfu_ladder}.py`` and the tune CLI's winners table:
+
+    {"schema": "paddle_tpu.bench.v1",
+     "bench":  "<harness name>",
+     "device": "<device_kind>", "platform": "cpu|tpu|...",
+     "commit": "<git sha or null>",
+     "meta":   {...harness-specific configuration...},
+     "rows":   [{...one measurement each...}]}
+
+``write_result`` persists after every update (the mfu_levers convention:
+a hung child or budget kill must not lose the rows already measured).
+"""
+from __future__ import annotations
+
+import json
+import os
+
+__all__ = ["bench_record", "write_result", "device_kind", "git_commit",
+           "results_dir"]
+
+SCHEMA = "paddle_tpu.bench.v1"
+
+
+def device_kind():
+    """Canonical device identity for result files and cache keys."""
+    import jax
+    dev = jax.devices()[0]
+    return str(getattr(dev, "device_kind", dev.platform) or dev.platform)
+
+
+def platform():
+    import jax
+    return jax.devices()[0].platform
+
+
+def git_commit():
+    try:
+        from bench import _git_commit
+        return _git_commit()
+    except Exception:
+        return None
+
+
+def bench_record(bench, rows, meta=None, device=None, platform_name=None):
+    """``device``/``platform_name`` given together skip jax entirely —
+    harnesses that fork device children (xla_flags_sweep) must not
+    initialize a backend in the parent."""
+    if device is None:
+        device = device_kind()
+        if platform_name is None:
+            platform_name = platform()
+    return {
+        "schema": SCHEMA,
+        "bench": bench,
+        "device": device,
+        "platform": platform_name,
+        "commit": git_commit(),
+        "meta": dict(meta or {}),
+        "rows": list(rows),
+    }
+
+
+def results_dir():
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(root, "benchmark", "results")
+
+
+def write_result(rec, path=None):
+    """Write ``rec`` to ``benchmark/results/<bench>_<device>.json`` (or
+    ``path``); returns the path. Safe to call once per row."""
+    if path is None:
+        safe = str(rec.get("device", "unknown")).replace(" ", "_")
+        safe = safe.replace("/", "_").replace("|", "_")
+        path = os.path.join(results_dir(),
+                            "%s_%s.json" % (rec["bench"], safe))
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f, indent=1)
+    os.replace(tmp, path)
+    return path

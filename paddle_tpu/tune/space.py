@@ -1,0 +1,339 @@
+"""Kernel search spaces: what is tunable, what is valid, what is stock.
+
+The reference answers a slow generic conv by *searching* — cuDNN's
+per-shape algorithm search in ``conv_cudnn_op.cu.cc`` enumerates
+algorithms, times each, and keeps the winner per shape. A
+:class:`KernelSpace` is that idea made declarative for Pallas kernels:
+
+- ``params``: the tunable axes (tile/block shapes, grid order) with
+  their candidate values;
+- ``is_valid``: the hard constraints — divisibility, MXU/lane alignment
+  (last dim multiples of 128, sublane multiples of 8), and a VMEM
+  footprint model (``vmem_bytes`` must fit the ~16 MB/core budget with
+  double-buffering headroom);
+- ``build``: config -> callable, the thing the autotune loop compiles,
+  parity-checks against ``reference`` (the stock XLA lowering), and
+  times;
+- ``make_operands``: deterministic example inputs for a shape key.
+
+A *key* is a plain dict describing one shape/dtype population instance
+(e.g. ``{"n": 128, "h": 28, "w": 28, "c": 128, "o": 128, "dtype":
+"bfloat16"}``); ``signature(key)`` renders it canonically for the
+winner cache. Three spaces ship: conv3x3, flash_attention, matmul
+(kernels/{conv3x3,flash_attention,matmul}.py — each refactored to take
+the config these spaces emit instead of hard-coded constants).
+"""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+__all__ = ["KernelSpace", "Conv3x3Space", "FlashAttentionSpace",
+           "MatmulSpace", "get_space", "space_names", "signature"]
+
+# usable VMEM budget per core: ~16 MB hardware minus headroom for
+# double buffering and the compiler's own scratch
+VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def _itemsize(dtype):
+    import jax.numpy as jnp
+    return jnp.dtype(dtype).itemsize
+
+
+def signature(key):
+    """Canonical cache-signature string for a shape key dict."""
+    return ",".join("%s=%s" % (k, key[k]) for k in sorted(key))
+
+
+class KernelSpace(object):
+    """Base: declares the contract; subclasses fill the kernel-specific
+    parts. ``candidates`` is shared — cartesian product of ``params``
+    filtered by ``is_valid``, default config first, deduplicated."""
+
+    name = None
+    params = {}
+
+    # -- to be provided by subclasses ---------------------------------------
+    def default_config(self, key):
+        raise NotImplementedError
+
+    def is_valid(self, config, key):
+        raise NotImplementedError
+
+    def vmem_bytes(self, config, key):
+        raise NotImplementedError
+
+    def build(self, config, key):
+        """config -> callable(*operands) running the kernel variant."""
+        raise NotImplementedError
+
+    def reference(self, key):
+        """callable(*operands) running the stock XLA lowering."""
+        raise NotImplementedError
+
+    def make_operands(self, key, seed=0):
+        raise NotImplementedError
+
+    # -- shared --------------------------------------------------------------
+    def candidates(self, key, budget=None):
+        """Valid configs for ``key``: the default config first, then the
+        pruned cartesian product of ``params``. ``budget`` caps the list
+        length — the default survives any positive cap, ``budget=0``
+        means ZERO kernel candidates (the autotune loop maps a total
+        budget of 1 here: stock XLA only), ``None`` is uncapped."""
+        default = self.default_config(key)
+        out, seen = [], set()
+        for cfg in [default] + self._enumerate(key):
+            frozen = tuple(sorted(cfg.items()))
+            if frozen in seen:
+                continue
+            seen.add(frozen)
+            if self.is_valid(cfg, key) \
+                    and self.vmem_bytes(cfg, key) <= VMEM_BUDGET:
+                out.append(dict(cfg))
+        if budget is not None:
+            out = out[:max(int(budget), 0)]
+        return out
+
+    def _enumerate(self, key):
+        names = sorted(self.params)
+        return [dict(zip(names, vals)) for vals in
+                itertools.product(*(self.params[n] for n in names))]
+
+
+# ---------------------------------------------------------------------------
+
+
+class Conv3x3Space(KernelSpace):
+    """Tiling space of kernels/conv3x3.py (3x3/s1/p1 NHWC conv).
+
+    key: {n, h, w, c, o, dtype}. block_o=0 means the full output-channel
+    extent; grid_order 'no' is weight-stationary (batch outer), 'on'
+    activation-stationary (output-channel outer)."""
+
+    name = "conv3x3"
+    params = {
+        "block_n": (1, 2, 4, 8),
+        "block_o": (0, 128, 256),
+        "grid_order": ("no", "on"),
+    }
+
+    def default_config(self, key):
+        from ..kernels.conv3x3 import DEFAULT_CONFIG
+        return dict(DEFAULT_CONFIG)
+
+    def is_valid(self, config, key):
+        bn, bo = int(config["block_n"]), int(config["block_o"])
+        if bn < 1 or key["n"] % bn:
+            return False
+        bo = bo or key["o"]
+        if key["o"] % bo:
+            return False
+        # lane alignment: a partial output-channel tile must still fill
+        # the 128-wide lane axis
+        if bo != key["o"] and bo % 128:
+            return False
+        return config.get("grid_order", "no") in ("no", "on")
+
+    def vmem_bytes(self, config, key):
+        it = _itemsize(key["dtype"])
+        bn = int(config["block_n"])
+        bo = int(config["block_o"]) or key["o"]
+        h, w, c = key["h"], key["w"], key["c"]
+        x_tile = bn * (h + 2) * (w + 2) * c * it
+        w_tile = 9 * c * bo * it
+        o_tile = bn * h * w * bo * it
+        acc = h * w * bo * 4
+        # in/out tiles double-buffer; the f32 accumulator does not
+        return 2 * (x_tile + w_tile + o_tile) + acc
+
+    def make_operands(self, key, seed=0):
+        import jax.numpy as jnp
+        rng = np.random.RandomState(seed)
+        x = jnp.asarray(rng.randn(key["n"], key["h"], key["w"], key["c"]),
+                        key["dtype"])
+        w = jnp.asarray(rng.randn(3, 3, key["c"], key["o"]) * 0.1,
+                        key["dtype"])
+        return (x, w)
+
+    def build(self, config, key):
+        import jax
+        from ..kernels.conv3x3 import conv3x3_s1_nhwc
+        frozen = tuple(sorted(config.items()))
+
+        @jax.jit
+        def fn(x, w):
+            return conv3x3_s1_nhwc(x, w, None, frozen)
+
+        return fn
+
+    def reference(self, key):
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def fn(x, w):
+            return jax.lax.conv_general_dilated(
+                x, w, window_strides=(1, 1), padding=[(1, 1), (1, 1)],
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                preferred_element_type=jnp.float32).astype(x.dtype)
+
+        return fn
+
+
+class FlashAttentionSpace(KernelSpace):
+    """Block space of kernels/flash_attention.py.
+
+    key: {b, s, h, d, causal, dtype}. The padded sequence rounds up to
+    the block width, so every block size divides by construction; the
+    constraints are alignment and the VMEM residency of the streamed
+    k/v plus the [block_q, block_k] score tile."""
+
+    name = "flash_attention"
+    params = {
+        "block_q": (64, 128, 256, 512),
+        "block_k": (64, 128, 256, 512),
+    }
+
+    def default_config(self, key):
+        from ..kernels.flash_attention import DEFAULT_CONFIG
+        return dict(DEFAULT_CONFIG)
+
+    def is_valid(self, config, key):
+        bq, bk = int(config["block_q"]), int(config["block_k"])
+        # q rides the sublane axis of the score tile, k the 128-lane axis
+        if bq < 8 or bq % 8 or bk < 128 or bk % 128:
+            return False
+        # oversized blocks just pad the (short) sequence to one block;
+        # beyond 4x the real length the padding work dominates — prune
+        return bq <= max(key["s"], 1) * 4 and bk <= max(key["s"], 1) * 4
+
+    def vmem_bytes(self, config, key):
+        it = _itemsize(key["dtype"])
+        bq, bk = int(config["block_q"]), int(config["block_k"])
+        s = max(key["s"], bk)
+        d = key["d"]
+        q_tile = bq * d * it
+        kv = 2 * s * d * it           # k and v stay resident per q block
+        o_tile = bq * d * it
+        score = bq * bk * 4           # f32 score/prob tile
+        stats = 3 * bq * 4            # m / num-row / den rows
+        return 2 * (q_tile + o_tile) + kv + score + stats
+
+    def make_operands(self, key, seed=0):
+        import jax.numpy as jnp
+        rng = np.random.RandomState(seed)
+        shape = (key["b"], key["s"], key["h"], key["d"])
+        q = jnp.asarray(rng.randn(*shape), key["dtype"])
+        k = jnp.asarray(rng.randn(*shape), key["dtype"])
+        v = jnp.asarray(rng.randn(*shape), key["dtype"])
+        return (q, k, v)
+
+    def build(self, config, key):
+        import jax
+        from ..kernels.flash_attention import flash_attention
+        causal = bool(key.get("causal", False))
+        cfg = dict(config)
+
+        @jax.jit
+        def fn(q, k, v):
+            return flash_attention(q, k, v, causal=causal, config=cfg)
+
+        return fn
+
+    def reference(self, key):
+        import jax
+        from ..kernels.flash_attention import _dense_reference
+        causal = bool(key.get("causal", False))
+
+        @jax.jit
+        def fn(q, k, v):
+            B, S, H, D = q.shape
+            t = lambda a: a.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+            o = _dense_reference(t(q), t(k), t(v), causal, D ** -0.5)
+            return o.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+        return fn
+
+
+class MatmulSpace(KernelSpace):
+    """Tile space of kernels/matmul.py (2-D gemm). key: {m, k, n, dtype};
+    block 0 = full extent (the kernel default)."""
+
+    name = "matmul"
+    params = {
+        "block_m": (0, 8, 64, 128, 256, 512),
+        "block_n": (0, 128, 256, 512),
+        "block_k": (0, 128, 256, 512),
+    }
+
+    def default_config(self, key):
+        from ..kernels.matmul import DEFAULT_CONFIG
+        return dict(DEFAULT_CONFIG)
+
+    def is_valid(self, config, key):
+        M, K, N = key["m"], key["k"], key["n"]
+        bm = int(config["block_m"]) or M
+        bn = int(config["block_n"]) or N
+        bk = int(config["block_k"]) or K
+        if M % bm or N % bn or K % bk:
+            return False
+        # MXU alignment: sublane multiple of 8, lane multiple of 128
+        if bm % 8 or bn % 128 or bk % 128:
+            return False
+        return True
+
+    def vmem_bytes(self, config, key):
+        it = _itemsize(key["dtype"])
+        M, K, N = key["m"], key["k"], key["n"]
+        bm = int(config["block_m"]) or M
+        bn = int(config["block_n"]) or N
+        bk = int(config["block_k"]) or K
+        return 2 * (bm * bk + bk * bn) * it + bm * bn * (it + 4)
+
+    def make_operands(self, key, seed=0):
+        import jax.numpy as jnp
+        rng = np.random.RandomState(seed)
+        x = jnp.asarray(rng.randn(key["m"], key["k"]), key["dtype"])
+        w = jnp.asarray(rng.randn(key["k"], key["n"]) * 0.1, key["dtype"])
+        return (x, w)
+
+    def build(self, config, key):
+        import jax
+        from ..kernels.matmul import matmul
+        frozen = tuple(sorted(config.items()))
+
+        @jax.jit
+        def fn(x, w):
+            return matmul(x, w, None, frozen)
+
+        return fn
+
+    def reference(self, key):
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def fn(x, w):
+            acc = (jnp.float32 if x.dtype in (jnp.bfloat16,) else None)
+            return jnp.matmul(x, w, preferred_element_type=acc).astype(
+                x.dtype)
+
+        return fn
+
+
+_SPACES = {sp.name: sp for sp in
+           (Conv3x3Space(), FlashAttentionSpace(), MatmulSpace())}
+
+
+def get_space(name):
+    if name not in _SPACES:
+        raise KeyError("unknown kernel space %r (have: %s)"
+                       % (name, ", ".join(sorted(_SPACES))))
+    return _SPACES[name]
+
+
+def space_names():
+    return sorted(_SPACES)

@@ -387,3 +387,76 @@ def test_error_paths_are_actionable():
         # recovery: a correct feed still works after the failures
         out, = exe.run(main, feed=feed, fetch_list=[y])
         assert np.asarray(out).shape == (2, 2)
+
+
+def test_concurrent_eager_executors_shared_program():
+    """Regression (tune PR satellite, carried from ROADMAP): the per-op
+    eager/hybrid paths re-trace SHARED Program/Variable state on every
+    run — unlike the jit path, whose single mutating first trace PR 5
+    serialized. Two executors eager-stepping one program concurrently
+    used to interleave those mutations; now same-program eager runs
+    serialize on a per-program RLock. The assertion is the strong one:
+    every thread's losses must be BIT-IDENTICAL to a single-thread run
+    from the same initial state."""
+    import threading
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    x = layers.data("x", shape=[8])
+    label = layers.data("lbl", shape=[1])
+    h = layers.fc(x, size=16, act="tanh")
+    y = layers.fc(h, size=1)
+    cost = layers.mean(x=layers.square(layers.elementwise_sub(y, label)))
+    pt.optimizer.SGD(learning_rate=0.05).minimize(cost)
+    main = pt.default_main_program()
+    startup = pt.default_startup_program()
+
+    rng = np.random.RandomState(0)
+    feeds = [{"x": rng.randn(4, 8).astype(np.float32),
+              "lbl": rng.randn(4, 1).astype(np.float32)}
+             for _ in range(6)]
+
+    def init_scope():
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            pt.Executor(pt.CPUPlace()).run(startup)
+        return scope
+
+    def run_steps(scope, out, idx=0):
+        # scope passes EXPLICITLY: scope_guard is process-global, so two
+        # threads guarding different scopes would race on "the" current
+        # scope — a test bug, not the executor race under test
+        try:
+            exe = pt.Executor(pt.CPUPlace())
+            losses = []
+            for f in feeds:
+                l, = exe.run(main, feed=f, fetch_list=[cost],
+                             use_jit=False, scope=scope)
+                losses.append(float(np.asarray(l)))
+            out[idx] = losses
+        except Exception as e:  # surfaced on the main thread below
+            out[idx] = e
+
+    # single-thread reference from a fresh init
+    ref = {}
+    run_steps(init_scope(), ref)
+    assert not isinstance(ref[0], Exception)
+
+    # two threads, each its own scope (fresh inits from the SAME startup
+    # program -> identical params), both eager over the shared program
+    scopes = [init_scope(), init_scope()]
+    results = {}
+    threads = [threading.Thread(target=run_steps,
+                                args=(scopes[i], results, i))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive(), "eager thread hung"
+    for i in range(2):
+        if isinstance(results[i], Exception):
+            raise results[i]
+        assert results[i] == ref[0], (
+            "thread %d diverged from the serial reference" % i)

@@ -1,0 +1,186 @@
+"""CPU interpret-mode parity net for paddle_tpu/kernels/ — every Pallas
+kernel vs its stock-XLA lowering across a small shape grid.
+
+The tune satellite's tier-1 safety net: kernels used to be covered only
+at single hand-picked shapes (test_conv3x3_kernel / test_flash_attention
+/ test_fused_lstm); the autotuner now drives them across whole config
+spaces, so the parity net must sweep shapes too. All comparisons go
+through the shared tolerance policy in paddle_tpu/tune/timer.py — the
+same gate the autotune loop applies to candidates.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.tune.timer import parity_report
+
+pytestmark = pytest.mark.smoke
+
+
+# -- conv3x3 ----------------------------------------------------------------
+
+CONV_GRID = [
+    # (n, h, w, c, o) — odd spatial, non-square channel ratios, n > 1
+    (1, 5, 5, 8, 8),
+    (2, 8, 8, 16, 32),
+    (3, 7, 9, 32, 8),
+    (4, 14, 14, 8, 16),
+]
+
+
+def _conv_ref(x, w):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=[(1, 1), (1, 1)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+@pytest.mark.parametrize("shape", CONV_GRID)
+def test_conv3x3_parity_grid(shape):
+    from paddle_tpu.kernels.conv3x3 import conv3x3_s1_nhwc
+    n, h, w_, c, o = shape
+    rng = np.random.RandomState(hash(shape) % 2**31)
+    x = jnp.asarray(rng.randn(n, h, w_, c), jnp.float32)
+    w = jnp.asarray(rng.randn(3, 3, c, o) * 0.1, jnp.float32)
+    assert parity_report(_conv_ref(x, w), conv3x3_s1_nhwc(x, w)) is None
+
+
+# -- flash attention --------------------------------------------------------
+
+ATTN_GRID = [
+    # (b, s, h, d, causal) incl. a ragged (non-128-multiple) length
+    (1, 64, 1, 16, False),
+    (2, 128, 2, 32, True),
+    (1, 200, 2, 32, True),
+    (2, 256, 1, 64, False),
+]
+
+
+def _attn_ref(q, k, v, causal):
+    from paddle_tpu.kernels.flash_attention import _dense_reference
+    B, S, H, D = q.shape
+    t = lambda a: a.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    o = _dense_reference(t(q), t(k), t(v), causal, D ** -0.5)
+    return o.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+@pytest.mark.parametrize("shape", ATTN_GRID)
+def test_flash_attention_parity_grid(shape):
+    from paddle_tpu.kernels import flash_attention
+    b, s, h, d, causal = shape
+    rng = np.random.RandomState(hash(shape) % 2**31)
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    got = flash_attention(q, k, v, causal=causal)
+    assert parity_report(_attn_ref(q, k, v, causal), got) is None
+
+
+# -- fused LSTM / GRU -------------------------------------------------------
+# stock lowering = the lax.scan recurrence sequence_ops falls back to;
+# reproduced here as the plain-jnp scan over the same gate math
+
+LSTM_GRID = [
+    # (T, N, D) incl. a masked ragged batch
+    (3, 2, 8),
+    (5, 4, 16),
+    (7, 3, 8),
+]
+
+
+def _lstm_ref(xs, w, h0, c0, mask):
+    def step(carry, inp):
+        h, c = carry
+        x_t, m = inp
+        g = x_t + jnp.dot(h, w)
+        D = h.shape[-1]
+        cand = jnp.tanh(g[:, :D])
+        i = jax.nn.sigmoid(g[:, D:2 * D])
+        f = jax.nn.sigmoid(g[:, 2 * D:3 * D])
+        o = jax.nn.sigmoid(g[:, 3 * D:])
+        c_new = f * c + i * cand
+        h_new = o * jnp.tanh(c_new)
+        m = m[:, None]
+        h2 = h_new * m + h * (1 - m)
+        c2 = c_new * m + c * (1 - m)
+        return (h2, c2), (h2, c2)
+
+    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), (xs, mask))
+    return hs, cs
+
+
+@pytest.mark.parametrize("shape", LSTM_GRID)
+def test_fused_lstm_parity_grid(shape):
+    from paddle_tpu.kernels.fused_lstm import fused_lstm
+    T, N, D = shape
+    rng = np.random.RandomState(hash(shape) % 2**31)
+    xs = jnp.asarray(rng.randn(T, N, 4 * D) * 0.5, jnp.float32)
+    w = jnp.asarray(rng.randn(D, 4 * D) * 0.2, jnp.float32)
+    h0 = jnp.asarray(rng.randn(N, D) * 0.1, jnp.float32)
+    c0 = jnp.asarray(rng.randn(N, D) * 0.1, jnp.float32)
+    # ragged: last sequence ends two steps early
+    mask = np.ones((T, N), np.float32)
+    if T > 2:
+        mask[-2:, -1] = 0.0
+    mask = jnp.asarray(mask)
+    hs, cs = fused_lstm(xs, w, h0, c0, mask)
+    ref_h, ref_c = _lstm_ref(xs, w, h0, c0, mask)
+    assert parity_report(ref_h, hs) is None
+    assert parity_report(ref_c, cs) is None
+
+
+def _gru_ref(xs, w, h0, mask):
+    def step(h, inp):
+        x_t, m = inp
+        D = h.shape[-1]
+        ur = jax.nn.sigmoid(x_t[:, :2 * D] + jnp.dot(h, w[:, :2 * D]))
+        u, r = ur[:, :D], ur[:, D:]
+        cand = jnp.tanh(x_t[:, 2 * D:] + jnp.dot(r * h, w[:, 2 * D:]))
+        h_new = (1 - u) * h + u * cand
+        m = m[:, None]
+        h2 = h_new * m + h * (1 - m)
+        return h2, h2
+
+    _, hs = jax.lax.scan(step, h0, (xs, mask))
+    return hs
+
+
+@pytest.mark.parametrize("shape", LSTM_GRID)
+def test_fused_gru_parity_grid(shape):
+    from paddle_tpu.kernels.fused_gru import fused_gru
+    T, N, D = shape
+    rng = np.random.RandomState(hash(shape) % 2**31)
+    xs = jnp.asarray(rng.randn(T, N, 3 * D) * 0.5, jnp.float32)
+    w = jnp.asarray(rng.randn(D, 3 * D) * 0.2, jnp.float32)
+    h0 = jnp.asarray(rng.randn(N, D) * 0.1, jnp.float32)
+    mask = np.ones((T, N), np.float32)
+    if T > 2:
+        mask[-2:, -1] = 0.0
+    mask = jnp.asarray(mask)
+    hs = fused_gru(xs, w, h0, mask)
+    assert parity_report(_gru_ref(xs, w, h0, mask), hs) is None
+
+
+# -- blocked matmul ---------------------------------------------------------
+
+MM_GRID = [
+    (8, 128, 128),
+    (16, 256, 128),
+    (64, 128, 256),
+]
+
+
+@pytest.mark.parametrize("shape", MM_GRID)
+def test_matmul_parity_grid(shape):
+    from paddle_tpu.kernels.matmul import matmul
+    M, K, N = shape
+    rng = np.random.RandomState(hash(shape) % 2**31)
+    x = jnp.asarray(rng.randn(M, K), jnp.float32)
+    w = jnp.asarray(rng.randn(K, N) * 0.1, jnp.float32)
+    ref = jnp.matmul(x, w)
+    assert parity_report(ref, matmul(x, w)) is None
+    # a blocked config must agree too (the autotune loop's gate)
+    cfg = {"block_m": 8, "block_n": 128, "block_k": 128}
+    assert parity_report(ref, matmul(x, w, None, cfg)) is None

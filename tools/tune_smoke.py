@@ -1,0 +1,146 @@
+"""Tune smoke gate (CPU tier-1): the paddle_tpu.tune autotuning loop,
+winner cache, and dispatch integration must hold their contract in
+pallas interpret mode with the deterministic injectable timer —
+
+(a) the autotune loop completes on one conv and one attention shape and
+    a winner lands in the cache (cache file exists, entry CRC-valid,
+    in-memory lookup returns it);
+(b) an injected per-candidate fault (site ``tune.candidate``) is
+    isolated: the faulted candidate is recorded as failed, the loop
+    still produces a winner;
+(c) a corrupted cache entry (site ``tune.cache``, checkpoint-style
+    post-CRC bit-rot) is DETECTED on reload, dropped with a recorded
+    ``tune_cache_corrupt`` event, and re-tuning repopulates it;
+(d) dispatch honors the cache switch: with FLAGS.tune=0 a conv2d trace
+    lowers through stock XLA and records ``tune_fallbacks``; with the
+    cache armed it records ``tune_hits``.
+
+Everything runs against a throwaway cache dir — the gate never touches
+``~/.cache/paddle_tpu/tune``. Exit 0 on pass, 1 on failure; prints a
+one-line JSON summary either way.
+
+Invoked by tools/tune_smoke.sh; usable directly:
+    JAX_PLATFORMS=cpu python tools/tune_smoke.py
+"""
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CONV_KEY = {"n": 2, "h": 8, "w": 8, "c": 16, "o": 32, "dtype": "float32"}
+ATTN_KEY = {"b": 1, "s": 128, "h": 2, "d": 32, "causal": False,
+            "dtype": "float32"}
+
+
+def main():
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers, tune
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.resilience.events import events as recorded_events
+    from paddle_tpu.tune import cache as cache_mod
+
+    tmp = tempfile.mkdtemp(prefix="tune_smoke_")
+    pt.flags.FLAGS.tune_cache_dir = tmp
+    tune.clear_memory_cache()
+    tune.reset_counters()
+    failures = []
+    summary = {"cache_dir": tmp}
+    timer = tune.model_timer()
+
+    # (a) loop completes, winners cached, for one conv + one attn shape
+    for kernel, key in (("conv3x3", CONV_KEY),
+                        ("flash_attention", ATTN_KEY)):
+        res = tune.autotune(kernel, key, timer=timer, budget=6)
+        summary["%s_winner" % kernel] = res.winner
+        summary["%s_candidates" % kernel] = len(res.records)
+        if not res.ok:
+            failures.append("%s: no eligible candidate" % kernel)
+            continue
+        tune.clear_memory_cache()  # force the disk round trip
+        got = tune.WinnerCache().get_config(res.cache_key)
+        if got != res.winner:
+            failures.append("%s: winner did not survive the cache round "
+                            "trip (%r != %r)" % (kernel, got, res.winner))
+    from paddle_tpu.tune.results import device_kind
+    conv_ck = cache_mod.cache_key(device_kind(), "conv3x3",
+                                  tune.signature(CONV_KEY))
+
+    # (b) injected candidate fault is isolated, loop survives
+    faults.reset()
+    faults.arm("tune.candidate", "raise", nth=2, times=1)
+    res = tune.autotune("conv3x3", CONV_KEY, timer=timer, budget=6)
+    faults.reset()
+    n_err = sum(1 for r in res.records if r["status"] == "error")
+    if n_err != 1:
+        failures.append("candidate fault not isolated (error records: %d)"
+                        % n_err)
+    if not res.ok:
+        failures.append("loop died on an injected candidate fault")
+    if not recorded_events(kind="tune_candidate_failed"):
+        failures.append("candidate failure left no degradation record")
+
+    # (c) corrupted cache file detected on reload, re-tune repopulates
+    faults.arm("tune.cache", "corrupt", nth=1, times=1, seed=7)
+    tune.autotune("conv3x3", CONV_KEY, timer=timer, budget=6)  # bit-rots
+    faults.reset()
+    tune.clear_memory_cache()
+    if tune.WinnerCache().get_config(conv_ck) is not None:
+        failures.append("corrupted cache not detected — stale config "
+                        "served")
+    if not recorded_events(kind="tune_cache_corrupt"):
+        failures.append("cache corruption left no degradation record")
+    res = tune.autotune("conv3x3", CONV_KEY, timer=timer, budget=6)
+    tune.clear_memory_cache()
+    if tune.WinnerCache().get_config(conv_ck) != res.winner:
+        failures.append("re-tune after corruption did not repopulate")
+
+    # (d) dispatch: cache-off -> stock XLA + tune_fallbacks; cache-on ->
+    # tune_hits. One tiny conv program traced under each mode.
+    def trace_conv():
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            img = layers.data("img", shape=[16, 8, 8], dtype="float32")
+            out = layers.conv2d(input=img, num_filters=32, filter_size=3,
+                                padding=1, act=None)
+        scope = pt.Scope()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup, scope=scope)
+        feed = {"img": np.zeros((2, 16, 8, 8), np.float32)}
+        exe.run(main, feed=feed, fetch_list=[out], scope=scope)
+        return exe.stats
+
+    tune.reset_counters()
+    pt.flags.FLAGS.tune = False
+    stats_off = trace_conv()
+    if stats_off["tune_hits"] or not stats_off["tune_fallbacks"]:
+        failures.append("tune=0 dispatch expected fallbacks only, got %r"
+                        % {k: v for k, v in stats_off.items()
+                           if "tune" in k})
+    pt.flags.FLAGS.tune = True
+    tune.reset_counters()
+    from paddle_tpu.core.executor import clear_warm_cache
+    clear_warm_cache()
+    stats_on = trace_conv()
+    if not stats_on["tune_hits"]:
+        failures.append("tune=1 dispatch expected a cache hit, got %r"
+                        % {k: v for k, v in stats_on.items()
+                           if "tune" in k})
+
+    summary["failures"] = failures
+    summary["dispatch_off"] = {k: v for k, v in stats_off.items()
+                               if "tune" in k}
+    summary["dispatch_on"] = {k: v for k, v in stats_on.items()
+                              if "tune" in k}
+    print(json.dumps({"tune_smoke": summary}))
+    shutil.rmtree(tmp, ignore_errors=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
