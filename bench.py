@@ -813,6 +813,39 @@ def child_main(tag):
         finally:
             wd.clear()
 
+    # -- comm/compute overlap: serialized vs staged DP step ----------------
+    # BENCH_COMM=0 skips; cheap and CPU-capable like the pipeline phase.
+    # Banks overlap-on vs overlap-off step time + parity on the headline
+    # (and benchmark/results/comm_overlap_*.json via the shared
+    # harness), so the next real-TPU run has a CPU baseline row to
+    # compare the latency-hiding win against.
+    if os.environ.get("BENCH_COMM", "1") != "0" and _remaining() > 90:
+        wd.phase("comm_overlap", min(max(_remaining() - 30, 1), 300))
+        try:
+            from benchmark.comm_bench import bench_overlap, \
+                bank_overlap_result
+            crec = bench_overlap()
+            bank_overlap_result(crec)
+            _log(tag, "comm overlap: serial %.2f -> staged %.2f steps/s "
+                 "(x%.3f), parity=%s, %d buckets issued early "
+                 "(%d est. hidden bytes)"
+                 % (crec["comm_serial_steps_s"],
+                    crec["comm_overlap_steps_s"],
+                    crec["comm_overlap_speedup"],
+                    crec["comm_overlap_parity"],
+                    crec["comm_overlap_buckets_early"],
+                    crec["comm_overlap_hidden_bytes_est"]))
+            if final is not None:
+                final = dict(final)
+                final.update(crec)
+                _emit(final)
+            else:
+                _emit(dict({"kind": "comm_overlap"}, **crec))
+        except Exception as e:
+            _log(tag, "comm overlap phase failed: %r" % e)
+        finally:
+            wd.clear()
+
     # -- autotune the conv lowering, then re-measure if picks changed ------
     if (final is not None and platform != "cpu" and _remaining() > 360):
         wd.phase("autotune", max(_remaining(), 1))

@@ -204,7 +204,9 @@ def cmd_accounting(args):
                 main, specs, mesh_shape),
             "comm": accounting.comm_policy_table(
                 main, specs, mesh_shape, hosts=args.hosts or None,
-                bucket_mb=args.bucket_mb or None),
+                bucket_mb=args.bucket_mb or None,
+                split_ratio=(args.split_ratio
+                             if args.split_ratio >= 0 else None)),
         }
     except ValueError as e:
         # e.g. --hosts not dividing the data axis: readable, not a trace
@@ -475,6 +477,12 @@ def main(argv=None):
                           "(0 = 2 when the axis divides, else flat)")
     acc.add_argument("--bucket_mb", type=float, default=0.0,
                      help="override FLAGS.comm_bucket_mb (0 = flag)")
+    acc.add_argument("--split-ratio", type=float, default=-1.0,
+                     dest="split_ratio",
+                     help="primary-path fraction for the multipath rows "
+                          "(negative = FLAGS.comm_split_ratio; derive "
+                          "from measured bandwidths via "
+                          "comm.measured_split_ratio)")
     acc.set_defaults(fn=cmd_accounting)
 
     tn = sub.add_parser(

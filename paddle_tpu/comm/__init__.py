@@ -32,32 +32,53 @@ it inside a ``shard_map``/``pmap`` body where today a
 (``comm_policy``/``comm_bucket_mb``/``comm_quant``); the ``none`` policy
 is bit-identical to the bare-psum path it replaces.
 
+Two more levers ride on top (ISSUE 7): **comm/compute overlap**
+(:mod:`.overlap` — staged per-bucket sync+update in
+backward-finalisation order, ``FLAGS.comm_overlap``), and **multi-path
+aggregation** (:mod:`.multipath` — FlexLink-style split of large
+buckets over the primary ICI ring and the secondary inter-host path
+simultaneously, ``comm_policy=multipath`` + ``comm_split_ratio``). The
+quantised family gains the 2-shot reduce-scatter+all-gather form
+(``comm_quant=int8_2shot``) whose ring-shaped cost scales past the
+n=8 crossover where the gather form stops winning.
+
 Fault sites (armable via ``PADDLE_TPU_FAULT_SPEC``, see
 ``paddle_tpu.resilience.faults``): ``comm.quantize`` fires at the
 per-bucket quantised-path build — a raise degrades that build to full
 precision with a recorded ``comm_degraded`` event; ``comm.bucket_roundtrip``
 fires at bucket-plan build — a raise degrades to the unbucketed ``none``
-path, same event.
+path, same event; ``comm.overlap`` fires at staged-step build — a raise
+degrades to the serialized sync-then-update path, same event.
 """
 from __future__ import annotations
 
 from .policy import (  # noqa: F401
     CommPolicy, resolve_policy, bytes_on_wire, policy_table,
+    path_split_bytes, measured_split_ratio, stateless_policy,
 )
 from .bucket import (  # noqa: F401
     BucketPlan, build_plan, flatten_to_buckets, unflatten_from_buckets,
 )
 from .hierarchical import hierarchical_all_reduce  # noqa: F401
-from .quant import quantized_all_reduce  # noqa: F401
+from .multipath import multipath_all_reduce  # noqa: F401
+from .quant import (  # noqa: F401
+    quantized_all_reduce, quantized_reduce_scatter_all_gather,
+)
 from .compat import shard_map  # noqa: F401
 from .allreduce import (  # noqa: F401
     all_reduce_grads, init_state, record_step_stats, plan_summary,
 )
+from .overlap import staged_sync_and_update, overlap_enabled  # noqa: F401
+from . import overlap  # noqa: F401
 
 __all__ = [
     "CommPolicy", "resolve_policy", "bytes_on_wire", "policy_table",
+    "path_split_bytes", "measured_split_ratio", "stateless_policy",
     "BucketPlan", "build_plan", "flatten_to_buckets",
     "unflatten_from_buckets",
-    "hierarchical_all_reduce", "quantized_all_reduce", "shard_map",
+    "hierarchical_all_reduce", "multipath_all_reduce",
+    "quantized_all_reduce", "quantized_reduce_scatter_all_gather",
+    "shard_map",
     "all_reduce_grads", "init_state", "record_step_stats", "plan_summary",
+    "staged_sync_and_update", "overlap_enabled",
 ]

@@ -34,14 +34,17 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..resilience.events import record_event
 from ..resilience.faults import fault_point, FaultError
 from .bucket import build_plan, flatten_to_buckets, unflatten_from_buckets
 from .hierarchical import hierarchical_all_reduce
+from .multipath import multipath_all_reduce
 from .policy import (CommPolicy, resolve_policy, bytes_on_wire,
                      bucket_wire_bytes, quant_inert_for)
-from .quant import quantized_all_reduce
+from .quant import (quantized_all_reduce,
+                    quantized_reduce_scatter_all_gather)
 
 __all__ = ["all_reduce_grads", "init_state", "record_step_stats",
            "plan_summary"]
@@ -56,7 +59,7 @@ def init_state(grads, policy: Optional[CommPolicy] = None) -> Dict[str, Any]:
     policy = policy if policy is not None else resolve_policy()
     state: Dict[str, Any] = {
         "comm_quant_fallbacks": jnp.zeros((), jnp.int32)}
-    if policy.quantized and policy.base != "hierarchical":
+    if policy.quantized and policy.base == "fused":
         state["residual"] = jax.tree_util.tree_map(
             lambda g: jnp.zeros(jnp.shape(g), jnp.result_type(g)), grads)
     return state
@@ -67,18 +70,83 @@ def _pmean_tree(grads, axis_name):
         lambda g: jax.lax.pmean(g, axis_name), grads)
 
 
+def _bucket_collective(bucket, flat, axis_name, policy, n):
+    """Run ONE bucket's collective under ``policy`` — the shared routing
+    used by both the serialized sync (:func:`all_reduce_grads`) and the
+    staged overlap path (:mod:`.overlap`), so the two builds can never
+    drift numerically. Returns ``(out, new_residual, fell_back)``;
+    ``fell_back`` is an int32 scalar counting a dynamic-range fallback.
+    """
+    quant_this = not quant_inert_for(policy, bucket.dtype)
+    if quant_this:
+        try:
+            fault_point("comm.quantize")
+        except FaultError as e:
+            # quantise fault: this bucket rides full precision for
+            # the lifetime of the traced step function
+            record_event("comm_degraded", site="comm.quantize",
+                         policy=policy.base, error=str(e))
+            quant_this = False
+    zero = jnp.zeros((), jnp.int32)
+    if policy.base in ("hierarchical", "multipath"):
+        chips = policy.chips(n)
+        if policy.base == "multipath":
+            nbytes = bucket.numel * np.dtype(bucket.dtype).itemsize
+            k = policy.split_elems(flat.shape[0], nbytes, chips)
+
+            def run(v, quant_inter):
+                return multipath_all_reduce(
+                    v, axis_name, policy.hosts, k,
+                    quant_inter=quant_inter,
+                    quant_chunk=policy.quant_chunk)
+        else:
+            def run(v, quant_inter):
+                return hierarchical_all_reduce(
+                    v, axis_name, policy.hosts, quant_inter=quant_inter,
+                    quant_chunk=policy.quant_chunk)
+        if quant_this:
+            # same all-finite vote as the fused path: a non-finite
+            # chunk would quantise to scale=inf -> NaN garbage, so
+            # every device agrees (pmin) and the exact full-precision
+            # composition runs instead, counted as a fallback
+            finite = jnp.isfinite(flat).all().astype(jnp.int32)
+            ok = jax.lax.pmin(finite, axis_name) > 0
+            out = jax.lax.cond(
+                ok, lambda v: run(v, True), lambda v: run(v, False), flat)
+            fell = jnp.where(ok, 0, 1).astype(jnp.int32)
+        else:
+            out = run(flat, False)
+            fell = zero
+        return out, jnp.zeros_like(flat), fell
+    if quant_this:
+        reduce = (quantized_reduce_scatter_all_gather
+                  if policy.quant == "int8_2shot" else quantized_all_reduce)
+        out, res, fell = reduce(flat, axis_name, chunk=policy.quant_chunk)
+        return out, res, fell
+    return jax.lax.pmean(flat, axis_name), jnp.zeros_like(flat), zero
+
+
 def all_reduce_grads(grads, axis_name, policy: Optional[CommPolicy] = None,
-                     state: Optional[Dict[str, Any]] = None):
+                     state: Optional[Dict[str, Any]] = None,
+                     schedule=None):
     """Mean-reduce a gradient pytree over ``axis_name``. Returns
     ``(synced_grads, new_state)`` — ``new_state`` is ``None`` iff
     ``state`` was (stateless call; quantised policies then run without
-    error feedback only if ``hierarchical``, and raise for the fused int8
-    form, whose convergence story depends on the residuals)."""
+    error feedback only if ``hierarchical``/``multipath``, and raise for
+    the fused int8 forms, whose convergence story depends on the
+    residuals).
+
+    ``schedule="backward"`` issues the bucket collectives in
+    backward-finalisation order (:meth:`.bucket.BucketPlan
+    .backward_schedule`) instead of declaration order — the issue order
+    the overlap step uses so the first dispatches are the ones the
+    remaining backward chain no longer touches. Values are unchanged
+    (assembly stays in plan order); only the trace order moves."""
     n = int(jax.lax.psum(1, axis_name))  # concrete under shard_map/pmap
     policy = policy if policy is not None else resolve_policy(axis_size=n)
     if policy.is_noop or n == 1:
         return _pmean_tree(grads, axis_name), state
-    if policy.quantized and policy.base != "hierarchical" and (
+    if policy.quantized and policy.base == "fused" and (
             state is None or "residual" not in state):
         # a state dict WITHOUT residuals (built under a non-quant policy,
         # or restored from a pre-int8 checkpoint) must not silently train
@@ -89,10 +157,11 @@ def all_reduce_grads(grads, axis_name, policy: Optional[CommPolicy] = None,
             "state, and the given state has none: build it with "
             "comm.init_state(grads, policy) under THIS policy and thread it "
             "through the step (see doc/comm.md), or use "
-            "comm_policy=hierarchical whose inter-host quantisation is "
-            "stateless")
+            "comm_policy=hierarchical/multipath whose inter-host "
+            "quantisation is stateless")
 
-    chips = policy.chips(n) if policy.base == "hierarchical" else 1
+    chips = (policy.chips(n)
+             if policy.base in ("hierarchical", "multipath") else 1)
     try:
         plan = build_plan(grads, policy.bucket_bytes,
                           pad_multiple=max(chips, 1))
@@ -120,56 +189,20 @@ def all_reduce_grads(grads, axis_name, policy: Optional[CommPolicy] = None,
         res_flats = flatten_to_buckets(plan, residual)
         flats = [f + r for f, r in zip(flats, res_flats)]
 
-    out_flats, new_res_flats = [], []
+    issue_order = (plan.backward_schedule() if schedule == "backward"
+                   else list(range(plan.num_buckets)))
+    out_flats = [None] * plan.num_buckets
+    new_res_flats = [None] * plan.num_buckets
     fallbacks = jnp.zeros((), jnp.int32)
-    for bucket, flat in zip(plan.buckets, flats):
-        # only fp32 buckets quantise (int8-of-bf16 would come back as
-        # fp32, silently breaking the exact-dtype round-trip contract;
-        # int buckets have no sane int8 form), and hierarchical int8 is
-        # inert at hosts=1 — no inter-host hop exists, so building the
-        # vote there would count phantom fallbacks for a quantisation
-        # that never runs
-        quant_this = not quant_inert_for(policy, bucket.dtype)
-        if quant_this:
-            try:
-                fault_point("comm.quantize")
-            except FaultError as e:
-                # quantise fault: this bucket rides full precision for
-                # the lifetime of the traced step function
-                record_event("comm_degraded", site="comm.quantize",
-                             policy=policy.base, error=str(e))
-                quant_this = False
-        if policy.base == "hierarchical":
-            if quant_this:
-                # same all-finite vote as the fused path: a non-finite
-                # chunk would quantise to scale=inf -> NaN garbage, so
-                # every device agrees (pmin) and the exact full-precision
-                # composition runs instead, counted as a fallback
-                finite = jnp.isfinite(flat).all().astype(jnp.int32)
-                ok = jax.lax.pmin(finite, axis_name) > 0
-                out = jax.lax.cond(
-                    ok,
-                    lambda v: hierarchical_all_reduce(
-                        v, axis_name, policy.hosts, quant_inter=True,
-                        quant_chunk=policy.quant_chunk),
-                    lambda v: hierarchical_all_reduce(
-                        v, axis_name, policy.hosts, quant_inter=False),
-                    flat)
-                fallbacks = fallbacks + jnp.where(ok, 0, 1).astype(
-                    jnp.int32)
-            else:
-                out = hierarchical_all_reduce(
-                    flat, axis_name, policy.hosts, quant_inter=False)
-            new_res_flats.append(jnp.zeros_like(flat))
-        elif quant_this:
-            out, res, fell = quantized_all_reduce(
-                flat, axis_name, chunk=policy.quant_chunk)
-            new_res_flats.append(res)
-            fallbacks = fallbacks + fell
-        else:
-            out = jax.lax.pmean(flat, axis_name)
-            new_res_flats.append(jnp.zeros_like(flat))
-        out_flats.append(out)
+    for bi in issue_order:
+        # per-bucket routing (quant scoping, all-finite votes, fault
+        # degradation) lives in _bucket_collective, shared with the
+        # overlap.staged path so the two builds cannot drift
+        out, res, fell = _bucket_collective(
+            plan.buckets[bi], flats[bi], axis_name, policy, n)
+        out_flats[bi] = out
+        new_res_flats[bi] = res
+        fallbacks = fallbacks + fell
 
     synced = unflatten_from_buckets(plan, out_flats)
     new_state = None
@@ -204,18 +237,26 @@ def plan_summary(grads, policy: Optional[CommPolicy] = None,
                 "comm_payload_bytes": payload,
                 "comm_bytes": bytes_on_wire(payload, policy, axis_size),
                 "comm_dispatches": n_leaves}
-    chips = policy.chips(axis_size) if policy.base == "hierarchical" else 1
+    chips = (policy.chips(axis_size)
+             if policy.base in ("hierarchical", "multipath") else 1)
     plan = build_plan(grads, policy.bucket_bytes,
                       pad_multiple=max(chips, 1))
     payload = plan.total_bytes()
     name = policy.base if not policy.quantized else (
         "%s+%s" % (policy.base, policy.quant))
+    # multipath flies two collectives per split bucket (one per path)
+    dispatches = plan.num_buckets
+    if policy.base == "multipath":
+        for b, nbytes in zip(plan.buckets, plan.payload_bytes()):
+            k = policy.split_elems(b.numel + b.pad, nbytes, chips)
+            if 0 < k < b.numel + b.pad:
+                dispatches += 1
     return {"policy": name, "comm_buckets": plan.num_buckets,
             "comm_payload_bytes": int(payload),
             "comm_bytes": int(sum(
                 bucket_wire_bytes(nbytes, b.dtype, policy, axis_size)
                 for b, nbytes in zip(plan.buckets, plan.payload_bytes()))),
-            "comm_dispatches": plan.num_buckets}
+            "comm_dispatches": dispatches}
 
 
 def record_step_stats(state, last_fallbacks=0, stats=None):

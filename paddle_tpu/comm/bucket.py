@@ -76,6 +76,21 @@ class BucketPlan(object):
     def total_bytes(self):
         return sum(self.payload_bytes())
 
+    def backward_schedule(self):
+        """Bucket indices in backward-finalisation order: reverse
+        autodiff produces the LAST-declared parameters' gradients first
+        (the loss-side layers differentiate before the input-side ones),
+        so the bucket holding the highest leaf positions is complete
+        earliest in the backward chain. The overlap step issues each
+        bucket's collective in this order, so the first dispatches are
+        the ones whose operands the remaining backward does not touch —
+        the structure XLA's latency-hiding scheduler needs to run them
+        behind the rest of backward."""
+        order = sorted(range(len(self.buckets)),
+                       key=lambda i: max(self.buckets[i].leaf_ids),
+                       reverse=True)
+        return order
+
 
 def build_plan(grads, bucket_bytes, pad_multiple=1) -> BucketPlan:
     """Assign every leaf of ``grads`` (arrays or ShapeDtypeStructs) to a

@@ -1,0 +1,72 @@
+"""Multi-path all-reduce: split one bucket over the primary AND
+secondary fabric simultaneously (FlexLink-style, PAPERS.md).
+
+A TPU pod exposes more than one route between any two chips: the
+primary ICI fabric the flat ring streams over, and the secondary route
+through the host boundary (DCN / host network) the hierarchical
+composition exercises. A single-path collective leaves whichever fabric
+it does not use idle; FlexLink's measurement (+27% effective bandwidth)
+is that routing a bandwidth-proportional slice of the payload over each
+path at the same time finishes sooner than either path alone.
+
+The implementation splits a flat bucket at a chips-aligned point:
+
+- ``flat[:k]`` (the ``split_ratio`` slice) all-reduces as a plain flat
+  ring over the whole axis — the primary path;
+- ``flat[k:]`` all-reduces through :func:`.hierarchical_all_reduce` —
+  intra-host reduce-scatter, inter-host ring (optionally int8), intra-
+  host all-gather — the secondary path, whose inter-host leg crosses
+  the host boundary on DIFFERENT links than the primary ring stream.
+
+The two collectives share no operands, so they are data-independent in
+the compiled program and the scheduler runs them concurrently. The
+reassembled vector is the exact concatenation of the two path results:
+the split/concat machinery moves bytes, never values (bitwise-proven in
+tests/test_comm.py), so with both paths running the same reduction the
+result is bitwise the unsplit collective's.
+
+Buckets below ``policy.MULTIPATH_MIN_BYTES`` (64 KiB) ride the primary
+path whole — splitting them buys no bandwidth and costs a dispatch.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .hierarchical import hierarchical_all_reduce
+
+__all__ = ["multipath_all_reduce", "split_flat"]
+
+
+def split_flat(flat, k):
+    """``flat -> (flat[:k], flat[k:])`` — the (trivial, bitwise-exact)
+    split the multipath collective reassembles with ``concatenate``."""
+    return flat[:k], flat[k:]
+
+
+def multipath_all_reduce(flat, axis_name, hosts, k, mean=True,
+                         quant_inter=False, quant_chunk=256):
+    """All-reduce a flat 1-D vector with ``flat[:k]`` on the primary
+    path (flat psum ring over the whole axis) and ``flat[k:]`` on the
+    secondary path (hierarchical over the (hosts, chips) factorisation,
+    inter-host leg optionally int8). ``k`` comes from
+    ``CommPolicy.split_elems`` — chips-aligned, 0 or ``len(flat)``
+    degenerate to a single path. Call inside shard_map/pmap.
+    """
+    n = int(jax.lax.psum(1, axis_name))
+    numel = flat.shape[0]
+    k = min(max(int(k), 0), numel)
+    if k == numel:  # whole bucket primary (small bucket / ratio 1.0)
+        out = jax.lax.psum(flat, axis_name)
+        return out / n if mean else out
+    if k == 0:      # whole bucket secondary (ratio 0.0)
+        return hierarchical_all_reduce(
+            flat, axis_name, hosts, mean=mean, quant_inter=quant_inter,
+            quant_chunk=quant_chunk)
+    primary, secondary = split_flat(flat, k)
+    out_p = jax.lax.psum(primary, axis_name)
+    out_s = hierarchical_all_reduce(
+        secondary, axis_name, hosts, mean=False, quant_inter=quant_inter,
+        quant_chunk=quant_chunk)
+    out = jnp.concatenate([out_p, out_s])
+    return out / n if mean else out

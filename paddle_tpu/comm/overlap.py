@@ -1,0 +1,191 @@
+"""Comm/compute overlap: hide gradient sync behind the backward pass.
+
+The serialized DP step is ``backward -> sync every bucket -> update
+every param``: the whole gradient pytree reassembles (an all-bucket
+join) before the first weight update, so the interconnect sits idle
+during backward and the MXU sits idle during sync — the r4 real-TPU run
+measured MFU 0.145 with exactly this shape. The staged step this module
+builds removes both joins:
+
+- each comm bucket's collective is issued **in backward-finalisation
+  order** (:meth:`..bucket.BucketPlan.backward_schedule`): reverse
+  autodiff produces the loss-side layers' gradients first, so the
+  buckets issued first are precisely the ones whose operands the
+  remaining backward chain no longer touches — data-independent of it,
+  which is the structure XLA's latency-hiding scheduler needs to run
+  the collective BEHIND the rest of backward;
+- each bucket's parameter update applies **immediately** after its own
+  collective — no bucket's update waits on another bucket's wire time,
+  so the final join of the step is element-wise updates, not a global
+  reassembly barrier.
+
+Numerics are unchanged by construction: the per-bucket collective is
+the same :func:`..allreduce._bucket_collective` the serialized path
+runs (same reduction order within every bucket), and the update math is
+applied leaf-by-leaf with the same operands — under ``comm_policy=none``
+the staged step is BIT-identical to the serialized one
+(tests/test_comm.py proves it over 3 passes).
+
+Fault site ``comm.overlap`` (armable via ``PADDLE_TPU_FAULT_SPEC``)
+fires at staged-build: the integrated step builders catch the raise,
+record a ``comm_degraded`` event, and fall back to the serialized path
+— overlap is an optimisation, never a correctness dependency.
+
+On CPU CI the evidence is parity + a no-slower gate
+(tools/comm_smoke.py, benchmark/comm_bench.py); the latency the
+restructure hides is only measurable on a real fabric, so the profiler
+counters (``comm_overlap_buckets_early``,
+``comm_overlap_hidden_bytes_est``) are labelled estimates.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..resilience.events import record_event
+from ..resilience.faults import fault_point, FaultError
+from .allreduce import _bucket_collective
+from .bucket import build_plan, flatten_to_buckets
+from .policy import CommPolicy, resolve_policy, bucket_wire_bytes
+
+__all__ = ["staged_sync_and_update", "overlap_enabled"]
+
+
+def overlap_enabled(overlap=None):
+    """Resolve an ``overlap=None`` builder argument from
+    ``FLAGS.comm_overlap``."""
+    if overlap is not None:
+        return bool(overlap)
+    from ..flags import FLAGS
+    return bool(FLAGS.comm_overlap)
+
+
+def _record_build(wire_bytes_per_bucket, issue_order):
+    """``wire_bytes_per_bucket`` indexes by bucket id, in the SAME
+    modelled-wire-bytes units as the ``comm_bytes`` counter
+    (``bucket_wire_bytes``), on every path — the cumulative estimate
+    must stay comparable across bucketed and degraded builds."""
+    from .. import profiler as _prof
+    hidden = 0
+    if len(issue_order) > 1:
+        # everything issued before the final bucket can hide behind the
+        # remaining backward chain + the earlier buckets' updates; the
+        # last-issued bucket's wire time is the only unhidable tail
+        hidden = sum(wire_bytes_per_bucket[i] for i in issue_order[:-1])
+    _prof.update_comm_counters(
+        comm_overlap_builds=1,
+        comm_overlap_buckets_early=max(len(issue_order) - 1, 0),
+        comm_overlap_hidden_bytes_est=hidden)
+
+
+def staged_sync_and_update(params, grads, axis_name, update_leaf,
+                           policy: Optional[CommPolicy] = None,
+                           state: Optional[Dict[str, Any]] = None):
+    """Staged gradient sync + parameter update for one DP step.
+
+    Call inside a ``shard_map``/``pmap`` body where the serialized form
+    ``grads, st = all_reduce_grads(...); params = tree_map(update, ...)``
+    sat. ``update_leaf(param_leaf, synced_grad_leaf) -> new_leaf`` is
+    the per-leaf update rule (e.g. ``lambda p, g: p - lr * g``).
+    Returns ``(new_params, new_state)``.
+
+    Raises :class:`~paddle_tpu.resilience.faults.FaultError` when the
+    ``comm.overlap`` fault site is armed — callers degrade to the
+    serialized path with a recorded ``comm_degraded`` event.
+    """
+    fault_point("comm.overlap")
+    n = int(jax.lax.psum(1, axis_name))
+    policy = policy if policy is not None else resolve_policy(axis_size=n)
+
+    p_leaves, p_tree = jax.tree_util.tree_flatten(params)
+    g_leaves = jax.tree_util.tree_leaves(grads)
+    if len(p_leaves) != len(g_leaves):
+        raise ValueError("params have %d leaves but grads %d"
+                         % (len(p_leaves), len(g_leaves)))
+
+    def per_leaf_staged():
+        # unbucketed: issue one collective per leaf in backward order
+        # (last-declared leaf's grad finalises first), update immediately
+        new_leaves = list(p_leaves)
+        order = list(range(len(g_leaves)))[::-1]
+        for i in order:
+            g = jax.lax.pmean(g_leaves[i], axis_name)
+            new_leaves[i] = update_leaf(p_leaves[i], g)
+        # per-leaf rides a plain fp32 ring: model wire bytes like the
+        # bucketed path does so the cumulative estimate stays in one
+        # unit system (2(n-1)/n * payload)
+        wire = [int(2 * (n - 1) / n * int(jnp.size(g_leaves[i]))
+                    * jnp.result_type(g_leaves[i]).itemsize)
+                for i in order]
+        _record_build(wire, list(range(len(order))))
+        return jax.tree_util.tree_unflatten(p_tree, new_leaves), state
+
+    if policy.is_noop or n == 1:
+        return per_leaf_staged()
+    if policy.quantized and policy.base == "fused" and (
+            state is None or "residual" not in state):
+        raise ValueError(
+            "the fused int8 policy carries error-feedback residuals in comm "
+            "state, and the given state has none: build it with "
+            "comm.init_state(grads, policy) under THIS policy (see "
+            "doc/comm.md)")
+
+    chips = (policy.chips(n)
+             if policy.base in ("hierarchical", "multipath") else 1)
+    try:
+        plan = build_plan(grads, policy.bucket_bytes,
+                          pad_multiple=max(chips, 1))
+    except FaultError as e:
+        # bucket-plan fault: same degradation rung as the serialized
+        # path — unbucketed, but still staged (the restructure is sound
+        # without fusion; only the dispatch amortisation is lost)
+        record_event("comm_degraded", site="comm.bucket_roundtrip",
+                     policy=policy.base, error=str(e))
+        return per_leaf_staged()
+
+    flats = flatten_to_buckets(plan, grads)
+    residual = state.get("residual") if state else None
+    if residual is not None:
+        res_flats = flatten_to_buckets(plan, residual)
+        flats = [f + r for f, r in zip(flats, res_flats)]
+
+    schedule = plan.backward_schedule()
+    wire = [bucket_wire_bytes(nbytes, b.dtype, policy, n)
+            for b, nbytes in zip(plan.buckets, plan.payload_bytes())]
+    _record_build(wire, schedule)
+
+    from .. import profiler as _prof
+    _prof.update_comm_counters(
+        comm_builds=1, comm_buckets=plan.num_buckets,
+        comm_dispatches=plan.num_buckets,
+        comm_payload_bytes=plan.total_bytes(),
+        comm_bytes=sum(wire))
+
+    new_leaves = list(p_leaves)
+    new_res_flats = [None] * plan.num_buckets
+    fallbacks = jnp.zeros((), jnp.int32)
+    for bi in schedule:
+        b, flat = plan.buckets[bi], flats[bi]
+        out, res, fell = _bucket_collective(b, flat, axis_name, policy, n)
+        new_res_flats[bi] = res
+        fallbacks = fallbacks + fell
+        # this bucket's leaves update NOW — no other bucket's collective
+        # is an operand of this slice/reshape/update chain
+        off = 0
+        for leaf_id, shape, size in zip(b.leaf_ids, b.shapes, b.sizes):
+            g = out[off:off + size].reshape(shape)
+            new_leaves[leaf_id] = update_leaf(p_leaves[leaf_id], g)
+            off += size
+
+    new_state = None
+    if state is not None:
+        new_state = dict(state)
+        new_state["comm_quant_fallbacks"] = (
+            state["comm_quant_fallbacks"] + fallbacks)
+        if residual is not None:
+            from .bucket import unflatten_from_buckets
+            new_state["residual"] = unflatten_from_buckets(
+                plan, new_res_flats)
+    return jax.tree_util.tree_unflatten(p_tree, new_leaves), new_state

@@ -21,20 +21,25 @@ from __future__ import annotations
 
 from typing import Optional
 
-BASES = ("none", "fused", "hierarchical")
-QUANTS = ("none", "int8")
+BASES = ("none", "fused", "hierarchical", "multipath")
+QUANTS = ("none", "int8", "int8_2shot")
 
 # fp32 scale per quantisation chunk rides beside the int8 payload
 QUANT_SCALE_BYTES = 4
+
+# multipath: buckets below this payload ride the primary path whole —
+# splitting a small bucket buys no bandwidth and costs a second dispatch
+MULTIPATH_MIN_BYTES = 64 * 1024
 
 
 class CommPolicy(object):
     """Resolved gradient-communication policy (immutable value object)."""
 
-    __slots__ = ("base", "bucket_bytes", "quant", "hosts", "quant_chunk")
+    __slots__ = ("base", "bucket_bytes", "quant", "hosts", "quant_chunk",
+                 "split_ratio")
 
     def __init__(self, base="none", bucket_bytes=4 * 1024 * 1024,
-                 quant="none", hosts=1, quant_chunk=256):
+                 quant="none", hosts=1, quant_chunk=256, split_ratio=0.75):
         if base not in BASES:
             raise ValueError("comm policy base must be one of %r, got %r"
                              % (BASES, base))
@@ -45,11 +50,26 @@ class CommPolicy(object):
             # quantisation needs the bucketed flat form to chunk over;
             # promote silently (documented in doc/comm.md)
             base = "fused"
+        if quant == "int8_2shot" and base != "fused":
+            # the 2-shot reduce-scatter+all-gather form IS a flat-axis
+            # collective shape of its own; composing it under the
+            # hierarchical/multipath routing would nest two topology
+            # decompositions with no bytes to win (their inter-host legs
+            # already quantise via plain int8)
+            raise ValueError(
+                "comm quant 'int8_2shot' is a fused-base form (the "
+                "reduce-scatter+all-gather IS the collective shape); use "
+                "quant='int8' with base=%r, whose inter-host leg "
+                "quantises" % base)
         self.base = base
         self.bucket_bytes = int(bucket_bytes)
         self.quant = quant
         self.hosts = max(int(hosts), 1)
         self.quant_chunk = int(quant_chunk)
+        if not (0.0 <= float(split_ratio) <= 1.0):
+            raise ValueError("comm split_ratio must be in [0, 1], got %r"
+                             % (split_ratio,))
+        self.split_ratio = float(split_ratio)
 
     @property
     def is_noop(self):
@@ -69,9 +89,23 @@ class CommPolicy(object):
                 % (self.hosts, axis_size))
         return axis_size // self.hosts
 
+    def split_elems(self, numel, nbytes, chips):
+        """Primary-path element count of a multipath bucket split: the
+        split point honours the configured ratio, keeps the secondary
+        slice divisible by the per-host chip count (its hierarchical
+        reduce-scatter needs it), and sends small buckets
+        (< MULTIPATH_MIN_BYTES) down the primary path whole."""
+        if self.base != "multipath" or nbytes < MULTIPATH_MIN_BYTES:
+            return numel
+        chips = max(int(chips), 1)
+        # round the primary slice to a chips multiple so the secondary
+        # remainder (numel is already padded to chips) stays divisible
+        k = int(round(numel * self.split_ratio / chips)) * chips
+        return min(max(k, 0), numel)
+
     def key(self):
         return (self.base, self.bucket_bytes, self.quant, self.hosts,
-                self.quant_chunk)
+                self.quant_chunk, self.split_ratio)
 
     def __eq__(self, other):
         return isinstance(other, CommPolicy) and self.key() == other.key()
@@ -80,12 +114,15 @@ class CommPolicy(object):
         return hash(self.key())
 
     def __repr__(self):
-        return ("CommPolicy(base=%r, bucket_mb=%.1f, quant=%r, hosts=%d)"
+        extra = (", split_ratio=%.2f" % self.split_ratio
+                 if self.base == "multipath" else "")
+        return ("CommPolicy(base=%r, bucket_mb=%.1f, quant=%r, hosts=%d%s)"
                 % (self.base, self.bucket_bytes / 1024.0 / 1024.0,
-                   self.quant, self.hosts))
+                   self.quant, self.hosts, extra))
 
 
 def resolve_policy(base=None, bucket_mb=None, quant=None, hosts=None,
+                   split_ratio=None,
                    axis_size: Optional[int] = None) -> CommPolicy:
     """Build a CommPolicy, filling unset fields from FLAGS.
 
@@ -98,6 +135,8 @@ def resolve_policy(base=None, bucket_mb=None, quant=None, hosts=None,
     base = base if base is not None else FLAGS.comm_policy
     bucket_mb = bucket_mb if bucket_mb is not None else FLAGS.comm_bucket_mb
     quant = quant if quant is not None else FLAGS.comm_quant
+    if split_ratio is None:
+        split_ratio = FLAGS.comm_split_ratio
     if hosts is None:
         hosts = FLAGS.comm_hosts
     if not hosts:  # 0 = auto-detect from the process topology
@@ -106,7 +145,32 @@ def resolve_policy(base=None, bucket_mb=None, quant=None, hosts=None,
         if axis_size is not None and (hosts < 1 or axis_size % hosts):
             hosts = 1
     return CommPolicy(base=base, bucket_bytes=int(bucket_mb * 1024 * 1024),
-                      quant=quant, hosts=hosts)
+                      quant=quant, hosts=hosts, split_ratio=split_ratio)
+
+
+def measured_split_ratio(primary_gbps, secondary_gbps):
+    """FlexLink's split rule: route bytes in proportion to measured
+    per-path bandwidth, so both paths finish together. Returns the
+    PRIMARY-path fraction for ``CommPolicy(split_ratio=...)`` /
+    ``FLAGS.comm_split_ratio``."""
+    p, s = float(primary_gbps), float(secondary_gbps)
+    if p <= 0 or s < 0:
+        raise ValueError("bandwidths must be positive, got %r/%r"
+                         % (primary_gbps, secondary_gbps))
+    return p / (p + s)
+
+
+def stateless_policy(policy: CommPolicy) -> CommPolicy:
+    """The nearest policy a comm-state-less step builder can run: the
+    fused int8 forms carry error-feedback residuals in comm state, so
+    they downgrade to their full-precision base; hierarchical/multipath
+    inter-host quantisation is stateless and passes through."""
+    if policy.quantized and policy.base == "fused":
+        return CommPolicy(base=policy.base, bucket_bytes=policy.bucket_bytes,
+                          quant="none", hosts=policy.hosts,
+                          quant_chunk=policy.quant_chunk,
+                          split_ratio=policy.split_ratio)
+    return policy
 
 
 def _quant_payload(nbytes, quant_chunk):
@@ -126,14 +190,25 @@ def bytes_on_wire(nbytes, policy: CommPolicy, axis_size: int) -> int:
       changes the dispatch count, not the bytes);
     - ``fused`` + int8: gather-based quantised all-reduce — each chip
       sends its local int8 payload to the n-1 peers, ``(n-1) * B_q``;
+    - ``fused`` + int8_2shot: quantised reduce-scatter (all-to-all of
+      1/n shards) + quantised all-gather — ``2 (n-1)/n * B_q``, the
+      form that keeps shrinking past n=8 where the gather form stops;
     - ``hierarchical``: intra-host reduce-scatter ``(c-1)/c * B``
       + inter-host shift-add ring on the 1/c chunk ``(h-1) * B/c``
       + intra-host all-gather ``(c-1)/c * B``;
-    - ``hierarchical`` + int8: same, with the inter-host chunk quantised.
+    - ``hierarchical`` + int8: same, with the inter-host chunk quantised;
+    - ``multipath`` (FlexLink): a ``split_ratio`` slice rides the flat
+      ring (primary path) while the remainder rides the hierarchical
+      composition (secondary path) simultaneously — total per-chip
+      bytes are the sum; the win is that they move on DIFFERENT links
+      (see ``path_split_bytes`` / ``inter_host_bytes_per_link``).
     """
     n = max(int(axis_size), 1)
     if n == 1:
         return 0
+    if policy.base == "multipath":
+        split = path_split_bytes(nbytes, policy, n)
+        return split["primary"] + split["secondary"]
     if policy.base == "hierarchical":
         h = policy.hosts
         c = policy.chips(n)
@@ -142,22 +217,61 @@ def bytes_on_wire(nbytes, policy: CommPolicy, axis_size: int) -> int:
             _quant_payload(chunk, policy.quant_chunk)
         intra = 2 * (c - 1) / c * nbytes if c > 1 else 0
         return int(intra + (h - 1) * inter)
+    if policy.quant == "int8_2shot":
+        return int(2 * (n - 1) / n
+                   * _quant_payload(nbytes, policy.quant_chunk))
     if policy.quantized:
         return int((n - 1) * _quant_payload(nbytes, policy.quant_chunk))
     return int(2 * (n - 1) / n * nbytes)
 
 
+def _multipath_split(nbytes, policy: CommPolicy, axis_size: int):
+    """The ONE place the bytes model decides a multipath bucket's split:
+    ``(primary_bytes, secondary_bytes, hier_policy)`` — the fp32-element
+    split point (chips-aligned, min-bytes floor, via ``split_elems``)
+    and the shadow hierarchical policy the secondary slice prices as.
+    ``path_split_bytes`` and ``inter_host_bytes_per_link`` both consume
+    it, so the per-chip and per-link columns can never disagree."""
+    c = policy.chips(axis_size)
+    elems = max(int(nbytes) // 4, 1)  # model in fp32 elements
+    k = policy.split_elems(elems, nbytes, c)
+    b_primary = 4 * k
+    hier = CommPolicy(base="hierarchical", bucket_bytes=policy.bucket_bytes,
+                      quant=policy.quant, hosts=policy.hosts,
+                      quant_chunk=policy.quant_chunk)
+    return b_primary, int(nbytes) - b_primary, hier
+
+
+def path_split_bytes(nbytes, policy: CommPolicy, axis_size: int) -> dict:
+    """Per-path per-chip bytes of one multipath bucket: the primary
+    slice (ratio r) as a flat ring, the secondary slice (1-r) as the
+    hierarchical composition (inter-host leg quantised when the policy
+    quantises). Non-multipath policies report everything on the primary
+    path — the column the accounting table prints either way."""
+    n = max(int(axis_size), 1)
+    if n == 1:
+        return {"primary": 0, "secondary": 0, "split_ratio": None}
+    if policy.base != "multipath":
+        return {"primary": bytes_on_wire(nbytes, policy, n),
+                "secondary": 0, "split_ratio": None}
+    b_primary, b_secondary, hier = _multipath_split(nbytes, policy, n)
+    return {"primary": int(2 * (n - 1) / n * b_primary),
+            "secondary": bytes_on_wire(b_secondary, hier, n),
+            "split_ratio": policy.split_ratio}
+
+
 def quant_inert_for(policy: CommPolicy, dtype) -> bool:
     """True when a quantised policy does NOT actually quantise a bucket
     of this dtype: only fp32 buckets quantise (int8-of-bf16 would change
-    the round-trip dtype), and the hierarchical form quantises the
-    inter-host hop only — with one host there is no such hop."""
+    the round-trip dtype), and the hierarchical/multipath forms quantise
+    the inter-host hop only — with one host there is no such hop."""
     import numpy as np
     if not policy.quantized:
         return True
     if np.dtype(dtype) != np.dtype(np.float32):
         return True
-    return policy.base == "hierarchical" and policy.hosts == 1
+    return policy.base in ("hierarchical", "multipath") and \
+        policy.hosts == 1
 
 
 def bucket_wire_bytes(nbytes, dtype, policy: CommPolicy,
@@ -170,7 +284,8 @@ def bucket_wire_bytes(nbytes, dtype, policy: CommPolicy,
         policy = CommPolicy(base=policy.base,
                             bucket_bytes=policy.bucket_bytes,
                             quant="none", hosts=policy.hosts,
-                            quant_chunk=policy.quant_chunk)
+                            quant_chunk=policy.quant_chunk,
+                            split_ratio=policy.split_ratio)
     return bytes_on_wire(nbytes, policy, axis_size)
 
 
@@ -193,6 +308,12 @@ def inter_host_bytes_per_link(nbytes, policy: CommPolicy,
     n = max(int(axis_size), 1)
     if n == 1:
         return 0
+    if policy.base == "multipath":
+        # primary slice streams the boundary like any flat ring; the
+        # secondary slice crosses with its hierarchical 1/c chunk
+        b_primary, b_secondary, hier = _multipath_split(nbytes, policy, n)
+        return int(2 * (n - 1) / n * b_primary) + \
+            inter_host_bytes_per_link(b_secondary, hier, n)
     if policy.base == "hierarchical":
         h, c = policy.hosts, policy.chips(n)
         if h == 1:
@@ -201,33 +322,58 @@ def inter_host_bytes_per_link(nbytes, policy: CommPolicy,
         if policy.quantized:
             chunk = _quant_payload(chunk, policy.quant_chunk)
         return int((h - 1) * chunk)
+    if policy.quant == "int8_2shot":
+        return int(2 * (n - 1) / n
+                   * _quant_payload(nbytes, policy.quant_chunk))
     if policy.quantized:
         return int((n - 1) * _quant_payload(nbytes, policy.quant_chunk))
     return int(2 * (n - 1) / n * nbytes)
 
 
 def policy_table(param_bytes, axis_size, n_params=None, hosts=2,
-                 bucket_mb=None):
+                 bucket_mb=None, split_ratio=None):
     """Bytes-on-wire + dispatch-count comparison of every policy for one
     grad set — the matrix ``paddle_tpu accounting --comm`` prints and
-    doc/comm.md documents."""
+    doc/comm.md documents. Multipath rows carry the split ratio and the
+    per-path byte columns (primary = flat ICI ring slice, secondary =
+    hierarchical inter-host slice); non-multipath rows put everything on
+    the primary path."""
     from ..flags import FLAGS
     bucket_mb = bucket_mb if bucket_mb is not None else FLAGS.comm_bucket_mb
+    if split_ratio is None:
+        split_ratio = FLAGS.comm_split_ratio
     bucket_bytes = int(bucket_mb * 1024 * 1024)
     n_buckets = max(-(-int(param_bytes) // bucket_bytes), 1)
     rows = []
     for base, quant in (("none", "none"), ("fused", "none"),
                         ("hierarchical", "none"), ("fused", "int8"),
-                        ("hierarchical", "int8")):
+                        ("fused", "int8_2shot"), ("hierarchical", "int8"),
+                        ("multipath", "none"), ("multipath", "int8")):
         p = CommPolicy(base=base, bucket_bytes=bucket_bytes, quant=quant,
-                       hosts=hosts if base == "hierarchical" else 1)
+                       hosts=hosts if base in ("hierarchical", "multipath")
+                       else 1, split_ratio=split_ratio)
+        split = path_split_bytes(param_bytes, p, axis_size)
+        # a SPLIT bucket costs one extra dispatch (two collectives fly,
+        # one per path) — but only when the split actually happens:
+        # small buckets and ratio 0/1 degenerate to a single path, the
+        # same decision plan_summary makes per live bucket
+        if base == "none" and n_params:
+            dispatches = n_params
+        elif base == "multipath":
+            per_bucket = min(int(param_bytes), bucket_bytes)
+            b_p, b_s, _ = _multipath_split(per_bucket, p, axis_size)
+            dispatches = n_buckets * (2 if 0 < b_p < per_bucket else 1)
+        else:
+            dispatches = n_buckets
         rows.append({
             "policy": base if quant == "none" else "%s+%s" % (base, quant),
             "bytes_per_chip": bytes_on_wire(param_bytes, p, axis_size),
+            "bytes_primary_path": split["primary"],
+            "bytes_secondary_path": split["secondary"],
+            "split_ratio": split["split_ratio"],
             "inter_host_bytes_per_link": inter_host_bytes_per_link(
                 param_bytes, p, axis_size),
-            "collective_dispatches": (n_params if base == "none" and n_params
-                                      else n_buckets),
+            "collective_dispatches": dispatches,
             "hosts": p.hosts,
         })
     return rows

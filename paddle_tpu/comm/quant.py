@@ -4,10 +4,25 @@ feedback (EQuARX-style, arxiv.org/pdf/2506.17615).
 Symmetric per-chunk quantisation: a flat fp32 vector is viewed as
 ``[n_chunks, chunk]``; each chunk q = round(x / s) with
 ``s = max|x| / 127`` rides the wire as int8 beside one fp32 scale —
-~3.9x fewer bytes than fp32 at chunk=256. The all-reduce itself is
-gather-based: every device all-gathers the peers' (int8, scale) payloads
-and dequantise-averages locally — int8 really crosses the wire, which is
-what the bytes model in ``policy.bytes_on_wire`` prices.
+~3.9x fewer bytes than fp32 at chunk=256. Two collective shapes:
+
+- **gather-based** (``quantized_all_reduce``): every device all-gathers
+  the peers' (int8, scale) payloads and dequantise-averages locally —
+  ``(n-1) * B_q`` per chip, which wins bytes only below n=8 (its value
+  past that is dispatch latency);
+- **2-shot** (``quantized_reduce_scatter_all_gather``, EQuARX's
+  bandwidth-optimal form): shot 1 all-to-alls each device's quantised
+  1/n SHARDS so shard i's owner dequantise-sums the contributions; shot
+  2 re-quantises the reduced shard and all-gathers it —
+  ``2 (n-1)/n * B_q`` per chip, the ring-shaped cost that keeps
+  shrinking at any axis size. Error feedback is preserved across both
+  shots: the local shot-1 error rides every device's residual, and the
+  shard OWNER carries the shot-2 re-quantisation error (exactly once,
+  so the next step's sum recovers it — carrying it on every device
+  would over-correct n-fold).
+
+int8 really crosses the wire in both forms, which is what the bytes
+model in ``policy.bytes_on_wire`` prices.
 
 Two degradation paths, both surfaced as ``comm_degraded`` resilience
 events (doc/comm.md):
@@ -32,7 +47,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["quantize", "dequantize", "quantized_all_reduce"]
+__all__ = ["quantize", "dequantize", "quantized_all_reduce",
+           "quantized_reduce_scatter_all_gather"]
 
 _QMAX = 127.0
 
@@ -82,6 +98,70 @@ def quantized_all_reduce(flat, axis_name, chunk=256, mean=True):
         deq = (all_q.astype(jnp.float32) * all_s).reshape(n_dev, -1)
         total = jnp.sum(deq, axis=0)[:numel]
         residual = x - dequantize(q, scales, numel)
+        return total, residual, jnp.zeros((), jnp.int32)
+
+    def exact_branch(x):
+        return (jax.lax.psum(x, axis_name), jnp.zeros_like(x),
+                jnp.ones((), jnp.int32))
+
+    total, residual, fell_back = jax.lax.cond(
+        ok, quant_branch, exact_branch, flat)
+    return (total / n_dev if mean else total), residual, fell_back
+
+
+def quantized_reduce_scatter_all_gather(flat, axis_name, chunk=256,
+                                        mean=True):
+    """2-shot quantised all-reduce of one flat fp32 bucket: int8
+    reduce-scatter (via all-to-all of 1/n shards) + int8 all-gather.
+
+    Per-chip wire bytes are ``2 (n-1)/n * B_q`` — ring-shaped, so unlike
+    the gather form it keeps beating the fp32 ring at ANY axis size
+    (``policy.bytes_on_wire`` prices both; tests assert the crossover).
+
+    Returns ``(reduced, local_residual, fell_back)`` with the same
+    contract as :func:`quantized_all_reduce`: the residual carries the
+    local shot-1 quantisation error everywhere plus the shot-2
+    re-quantisation error on the reduced shard at its OWNER only (added
+    back into the next step's local gradient, the next sum recovers it
+    exactly once), and a psum'd all-finite vote runs the exact
+    full-precision branch when the dynamic range overflows anywhere.
+    """
+    n_dev = int(jax.lax.psum(1, axis_name))
+    numel = flat.shape[0]
+    # shard layout: n_dev rows of whole quantisation chunks, so scales
+    # never straddle a shard boundary
+    per_dev = -(-numel // n_dev)
+    shard = -(-per_dev // chunk) * chunk
+    pad = shard * n_dev - numel
+    row_chunks = shard // chunk
+    finite = jnp.isfinite(flat).all().astype(jnp.int32)
+    ok = jax.lax.pmin(finite, axis_name) > 0
+
+    def quant_branch(x):
+        padded = jnp.pad(x, (0, pad))
+        # shot 1: quantise my full vector, then all-to-all the per-shard
+        # rows so shard i's owner holds every peer's int8 row i
+        q1, s1, _ = quantize(padded, chunk)       # [n_dev*row_chunks, chunk]
+        q1_t = jax.lax.all_to_all(
+            q1.reshape(n_dev, row_chunks, chunk), axis_name,
+            split_axis=0, concat_axis=0, tiled=True)
+        s1_t = jax.lax.all_to_all(
+            s1.reshape(n_dev, row_chunks, 1), axis_name,
+            split_axis=0, concat_axis=0, tiled=True)
+        deq = q1_t.astype(jnp.float32) * s1_t     # [n_dev, row_chunks, chunk]
+        owned = jnp.sum(deq, axis=0).reshape(-1)  # my reduced shard [shard]
+        # shot 2: re-quantise the reduced shard, all-gather it back
+        q2, s2, _ = quantize(owned, chunk)        # [row_chunks, chunk]
+        q2_all = jax.lax.all_gather(q2, axis_name, tiled=True)
+        s2_all = jax.lax.all_gather(s2, axis_name, tiled=True)
+        total = dequantize(q2_all, s2_all, n_dev * shard)[:numel]
+        # error feedback: shot-1 error is mine everywhere; shot-2 error
+        # lives on the reduced shard and is carried by its owner alone
+        r1 = (padded - dequantize(q1, s1, n_dev * shard)
+              ).reshape(n_dev, shard)
+        r2_own = owned - dequantize(q2, s2, shard)
+        me = jax.lax.axis_index(axis_name)
+        residual = (r1.at[me].add(r2_own).reshape(-1))[:numel]
         return total, residual, jnp.zeros((), jnp.int32)
 
     def exact_branch(x):

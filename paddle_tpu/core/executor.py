@@ -390,6 +390,49 @@ def _to_device_value(v, device=None):
     return jax.device_put(np.asarray(v), device)
 
 
+# scalar fetches on the explicit-comm path are pmean'd back to their
+# global meaning — sound ONLY for mean-type batch reductions (possibly
+# through linear ops): a reduce_sum fetch would come back divided by
+# the axis size, a max fetch as a mean of per-shard maxima
+_MEAN_SCALAR_OPS = frozenset(("mean", "accuracy"))
+_LINEAR_SCALAR_OPS = frozenset(("scale", "cast", "assign", "sum",
+                                "elementwise_add", "elementwise_sub"))
+
+
+def _scalar_fetch_sound(ops, name, persistables, feeds, depth=8):
+    """True when pmean-ing the per-shard scalar ``name`` recovers its
+    global-batch meaning: it must resolve, through linear ops only, to
+    mean-type reductions or replicated (producer-less non-feed) state.
+    Unknown producers fail closed — the build falls back to GSPMD."""
+    if depth <= 0:
+        return False
+    producer = None
+    for op_ in ops:
+        if name in op_.output_arg_names:
+            producer = op_  # last write wins
+    if producer is None:
+        # state/persistable scalars are replicated -> pmean is identity;
+        # a raw feed (batch-shaped) reaching here means we lost track
+        return name in persistables and name not in feeds
+    if producer.type in _MEAN_SCALAR_OPS:
+        return True
+    if producer.type in _LINEAR_SCALAR_OPS:
+        return all(_scalar_fetch_sound(ops, i, persistables, feeds,
+                                       depth - 1)
+                   for i in producer.input_arg_names)
+    return False
+
+
+def _comm_flags_sig():
+    """Comm-flag fingerprint for the jit caches: the compiled step under
+    a mesh embeds the comm policy (explicit collective routing and/or
+    the recorded byte model), so a policy flip must recompile."""
+    from ..flags import FLAGS
+    return (FLAGS.comm_policy, FLAGS.comm_quant, FLAGS.comm_bucket_mb,
+            FLAGS.comm_hosts, FLAGS.comm_split_ratio, FLAGS.comm_overlap,
+            FLAGS.comm_gspmd)
+
+
 def _dist_shardings(dist, state, feed):
     """in_shardings pytree for ``fn(state, feed, rng_key)`` under a mesh.
 
@@ -623,11 +666,17 @@ class Executor(object):
         # = kernel default config, fallbacks = stock XLA); dispatch
         # happens at trace time, so they move once per compile — the
         # snapshot refreshes at the end of every run()
+        # comm_path says HOW the last compiled program's DP grads sync:
+        # "explicit" = routed through the paddle_tpu.comm collectives
+        # (comm_* stats measured from the traced plan), "model" = GSPMD
+        # owns the schedule and comm_* is the byte model, "" = no DP
+        # sync compiled yet
         self.stats = {"jit_runs": 0, "eager_runs": 0, "hybrid_runs": 0,
                       "lazy_fetches": 0, "fetch_sync_count": 0,
                       "compile_cache_hits": 0, "feed_wait_ms": 0.0,
                       "dispatch_depth": 0, "comm_bytes": 0,
                       "comm_buckets": 0, "comm_quant_fallbacks": 0,
+                      "comm_path": "",
                       "tune_hits": 0, "tune_misses": 0,
                       "tune_fallbacks": 0}
         # programs whose trace hit data-dependent control flow: run eager
@@ -1071,6 +1120,10 @@ class Executor(object):
         key = (program._uid, program._version, _feed_signature(feed),
                tuple(fetch_names), repeat, _prof.profiler_enabled(),
                dist.cache_token() if dist is not None else None,
+               # the compiled step depends on the comm flags under a
+               # mesh (explicit collective routing + the byte model):
+               # a flags_guard flip must not hit a stale compile
+               _comm_flags_sig() if dist is not None else None,
                state_sig)
         fn = self._cache.get(key)
         if fn is None:
@@ -1106,6 +1159,254 @@ class Executor(object):
         scope.set_var(RNG_VAR, new_key)
         return fetches
 
+    def _explicit_comm_plan(self, program, block, dist, feed_template):
+        """Host-side eligibility for routing this program's DP gradient
+        sync through the explicit paddle_tpu.comm collectives (instead
+        of leaving the schedule to GSPMD and only modelling the bytes).
+
+        Eligible = pure data parallelism with a clean backward/optimizer
+        boundary: every persistable replicated, every array feed batch-
+        sharded over the data axis, all ``@GRAD`` writes before the
+        first update op, and no op whose semantics couple the global
+        batch or draw randomness (those change meaning under a
+        per-shard trace). Returns a plan dict or ``None`` — ineligible
+        programs keep the GSPMD path with the byte model, which is
+        always correct."""
+        from ..flags import FLAGS
+        from .. import comm
+        if not FLAGS.comm_gspmd:
+            return None
+        data_axis = dist.strategy.data_axis
+        n = dict(dist.mesh.shape).get(data_axis, 1)
+        if n <= 1:
+            return None
+        try:
+            policy = comm.resolve_policy(axis_size=n)
+        except Exception:
+            return None
+        if policy.is_noop:
+            # the none policy keeps the pre-explicit GSPMD build
+            # bit-identical — the parity contract doc/comm.md states
+            return None
+        if any(a for spec in dist.specs.values()
+               for a in (spec or ()) if a is not None):
+            return None  # tensor/ZeRO-sharded vars: not a pure-DP program
+        risky = ("dropout", "random", "batch_norm", "lookup_table")
+        for op_ in _iter_ops(block):
+            if any(r in op_.type for r in risky):
+                return None
+        param_names = {p.name for p in program.all_parameters()}
+        grad_names = {p + ir.GRAD_SUFFIX for p in param_names}
+        grad_writes = [i for i, op_ in enumerate(block.ops)
+                       if set(op_.output_arg_names) & grad_names]
+        update_idx = [i for i, op_ in enumerate(block.ops)
+                      if (set(op_.input_arg_names) & grad_names)
+                      and (set(op_.output_arg_names) & param_names)]
+        if not grad_writes or not update_idx:
+            return None  # not a training program (e.g. startup/eval)
+        boundary = min(update_idx)
+        if max(grad_writes) >= boundary:
+            return None  # interleaved backward/update: no clean sync point
+        local_batches = set()
+        for name, v in feed_template.items():
+            if isinstance(v, TracedLoD):
+                return None  # LoD offsets are global; per-shard is wrong
+            shape = tuple(getattr(v, "shape", ()) or ())
+            if not shape:
+                continue  # scalar feed replicates harmlessly
+            spec = dist.strategy.spec_for_feed(name, shape, dist.mesh)
+            if not tuple(spec) or tuple(spec)[0] != data_axis or \
+                    shape[0] % n:
+                return None  # a replicated array feed would double-count
+            local_batches.add(shape[0] // n)
+        if not local_batches:
+            return None
+        stateless = comm.stateless_policy(policy)
+        if stateless is not policy:
+            import warnings
+            warnings.warn(
+                "comm_quant=%s carries error-feedback state the Executor "
+                "path does not thread; syncing at full precision "
+                "(comm_policy=hierarchical/multipath quantises its "
+                "inter-host leg statelessly)" % policy.quant)
+        return {"axis_name": data_axis, "n": n, "policy": stateless,
+                "pre_ops": list(block.ops[:boundary]),
+                "post_ops": list(block.ops[boundary:]),
+                "grad_names": sorted(grad_names),
+                "local_batches": local_batches}
+
+    def _compile_explicit_comm(self, program, block, dist, plan,
+                               feed_template, fetch_names, state_names,
+                               extra_out, shardings, repeat, fallback):
+        """Build the explicit-comm step: the program traces per-device
+        under shard_map, ``comm.all_reduce_grads`` carries the DP sync
+        at the backward/optimizer boundary (backward-order bucket issue
+        when ``FLAGS.comm_overlap``), and scalar fetches pmean back to
+        their global meaning. The returned dispatcher decides at FIRST
+        call (an ``eval_shape`` dry run, no donation at risk): a build
+        that cannot hold the contract — a non-scalar non-batch fetch, a
+        trace error — degrades to ``fallback`` (the standard GSPMD jit)
+        with a recorded ``comm_degraded`` event. A comm-policy routing
+        failure must never kill a job GSPMD could run."""
+        from .. import comm
+        from ..flags import FLAGS
+        from jax.sharding import PartitionSpec as P
+        axis_name, n, policy = (plan["axis_name"], plan["n"],
+                                plan["policy"])
+        grad_names = plan["grad_names"]
+        local_batches = plan["local_batches"]
+        mesh = dist.mesh
+        schedule = "backward" if FLAGS.comm_overlap else None
+        capture = {}
+
+        def per_device(state, feed, rng_key, sync=True):
+            env = dict(feed)
+            env.update(state)
+            rng = RngSource(rng_key)
+            trace_ops(_SegView(block, plan["pre_ops"]), env, rng, None)
+            grads = {g: env[g] for g in grad_names
+                     if g in env and hasattr(env[g], "ndim")}
+            if not grads:
+                raise RuntimeError(
+                    "no gradient materialised before the sync boundary")
+            capture["grads"] = {
+                k: jax.ShapeDtypeStruct(jnp.shape(v), jnp.result_type(v))
+                for k, v in grads.items()}
+            if sync:  # the shape pre-pass runs outside shard_map, where
+                # the axis is unbound — the sync changes no shapes
+                synced, _ = comm.all_reduce_grads(
+                    grads, axis_name, policy, None, schedule=schedule)
+                env.update(synced)
+            trace_ops(_SegView(block, plan["post_ops"]), env, rng, None)
+            new_state = {nm: raw_data(env[nm]) if isinstance(
+                env[nm], ConcreteScalar) else env[nm] for nm in state_names}
+            for nm in extra_out:
+                if nm in env:
+                    v = env[nm]
+                    new_state[nm] = raw_data(v) if isinstance(
+                        v, ConcreteScalar) else v
+            fetches = [env[nm] for nm in fetch_names]
+            return fetches, new_state, rng.key
+
+        def local_aval(name, v):
+            shape = tuple(v.shape)
+            if shape and shape[0] % n == 0:
+                spec = dist.strategy.spec_for_feed(name, shape, mesh)
+                if tuple(spec) and tuple(spec)[0] == axis_name:
+                    shape = (shape[0] // n,) + shape[1:]
+            return jax.ShapeDtypeStruct(shape, v.dtype)
+
+        def build(state, feed, rng_key):
+            # abstract pre-pass on LOCAL avals: learn each output's
+            # per-device shape, then pick out_specs — scalars pmean back
+            # to the global mean, batch-leading values reassemble over
+            # the data axis; anything else has no sound global meaning
+            # under a per-shard trace, so the build refuses (-> fallback)
+            st_avals = jax.tree_util.tree_map(
+                lambda v: jax.ShapeDtypeStruct(jnp.shape(v),
+                                               jnp.result_type(v)), state)
+            fd_avals = {k: local_aval(k, v) for k, v in feed.items()}
+            key_aval = jax.ShapeDtypeStruct(jnp.shape(rng_key),
+                                            jnp.result_type(rng_key))
+            out_shape = jax.eval_shape(
+                functools.partial(per_device, sync=False),
+                st_avals, fd_avals, key_aval)
+            f_shapes, ns_shapes, _ = out_shape
+            all_ops = plan["pre_ops"] + plan["post_ops"]
+            persistables = {v.name for v in program.list_vars()
+                            if v.persistable}
+            pmean_idx, f_specs = set(), []
+            for i, f in enumerate(f_shapes):
+                if int(np.prod(f.shape or (1,))) == 1:
+                    # scalar (and [1]-shaped scalar-like, the mean op's
+                    # shape) fetches pmean back to their global-batch
+                    # meaning — but only mean-type reductions survive
+                    # that (a reduce_sum would come back divided by n)
+                    if not _scalar_fetch_sound(all_ops, fetch_names[i],
+                                               persistables, set(feed)):
+                        raise RuntimeError(
+                            "scalar fetch %r does not resolve to a "
+                            "mean-type batch reduction: pmean would "
+                            "change its meaning" % fetch_names[i])
+                    pmean_idx.add(i)
+                    f_specs.append(P())
+                elif f.shape[0] in local_batches and \
+                        fetch_names[i] not in state_names:
+                    f_specs.append(P(*((axis_name,)
+                                       + (None,) * (len(f.shape) - 1))))
+                else:
+                    raise RuntimeError(
+                        "fetch %r is neither scalar nor batch-leading "
+                        "(local shape %r): no sound per-shard assembly"
+                        % (fetch_names[i], tuple(f.shape)))
+
+            def final(state, feed, rng_key):
+                fetches, new_state, key = per_device(state, feed, rng_key)
+                fetches = [jax.lax.pmean(f, axis_name) if i in pmean_idx
+                           else f for i, f in enumerate(fetches)]
+                return fetches, new_state, key
+
+            in_specs = (
+                jax.tree_util.tree_map(lambda _: P(), state),
+                {k: (P(*((axis_name,) + (None,) * (len(v.shape) - 1)))
+                     if tuple(v.shape) != tuple(feed[k].shape) else P())
+                 for k, v in fd_avals.items()},
+                P())
+            out_specs = (f_specs,
+                         jax.tree_util.tree_map(lambda _: P(), ns_shapes),
+                         P())
+            one = comm.shard_map(final, mesh, in_specs=in_specs,
+                                 out_specs=out_specs)
+            if repeat == 1:
+                fn = one
+            else:
+                def fn(state, feed, rng_key):
+                    fetches, state, rng_key = one(state, feed, rng_key)
+
+                    def body(carry, _):
+                        st, key = carry
+                        f, st2, key2 = one(st, feed, key)
+                        return (st2, key2), f
+
+                    (state, rng_key), fs = jax.lax.scan(
+                        body, (state, rng_key), None, length=repeat - 1)
+                    return [f[-1] for f in fs], state, rng_key
+            jitted = jax.jit(fn, donate_argnums=(0,),
+                             in_shardings=shardings)
+            # dry-run the whole build abstractly before committing: a
+            # trace failure here costs nothing (no donation happened)
+            jax.eval_shape(fn, st_avals,
+                           {k: jax.ShapeDtypeStruct(tuple(v.shape),
+                                                    v.dtype)
+                            for k, v in feed.items()}, key_aval)
+            return jitted
+
+        cell = {}
+
+        def dispatch(state, feed, rng_key):
+            if "fn" not in cell:
+                try:
+                    cell["fn"] = build(state, feed, rng_key)
+                    self.stats["comm_path"] = "explicit"
+                    grads_tpl = capture.get("grads")
+                    if grads_tpl:
+                        # measured-from-the-trace: the plan built over
+                        # the grads the program actually produced, not
+                        # the parameter-list model
+                        s = comm.plan_summary(grads_tpl, plan["policy"],
+                                              axis_size=n)
+                        self.stats["comm_bytes"] = s["comm_bytes"]
+                        self.stats["comm_buckets"] = s["comm_buckets"]
+                except Exception as e:
+                    from ..resilience.events import record_event
+                    record_event("comm_degraded", site="comm.gspmd",
+                                 policy=plan["policy"].base, error=str(e))
+                    self.stats["comm_path"] = "model"
+                    cell["fn"] = fallback
+            return cell["fn"](state, feed, rng_key)
+
+        return dispatch
+
     def _record_comm_model(self, program, dist):
         """Refresh the comm_* stats entries: the modelled per-step wire
         traffic of this program's DP gradient sync under the active comm
@@ -1120,6 +1421,11 @@ class Executor(object):
         n = dict(dist.mesh.shape).get(data_axis, 1)
         if n <= 1:
             return
+        # refreshed per compile, like every comm_* stat: an earlier
+        # explicit-path program must not leave "explicit" sticking to a
+        # later ineligible one (the dispatcher re-asserts "explicit" at
+        # its first call, which happens after this)
+        self.stats["comm_path"] = "model"
         grads_tpl = {}
         for p in program.all_parameters():
             spec = dist.specs.get(p.name)
@@ -1249,6 +1555,18 @@ class Executor(object):
                 return memo["c"](state, feed, rng_key)
 
             return profiled
+        if dist is not None:
+            # (4) of the comm tentpole: eligible pure-DP programs route
+            # their grad sync through the explicit comm collectives; the
+            # dispatcher degrades to the plain GSPMD jit at first call
+            # if the build cannot hold the contract
+            plan = self._explicit_comm_plan(program, block, dist,
+                                            feed_template)
+            if plan is not None:
+                return self._compile_explicit_comm(
+                    program, block, dist, plan, feed_template,
+                    fetch_names, state_names, extra_out, shardings,
+                    repeat, jitted)
         return jitted
 
     # -- helpers ---------------------------------------------------------------

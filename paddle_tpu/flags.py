@@ -290,6 +290,43 @@ DEFINE_int32("comm_hosts", 0,
              "(jax.process_count() when it divides the data axis, else "
              "flat). Set explicitly to simulate a multi-host topology "
              "on a forced CPU mesh (tools/comm_smoke.py uses 2x4)")
+DEFINE_bool("comm_overlap", False,
+            "overlap gradient communication with the tail of backward "
+            "(paddle_tpu.comm.overlap): the DP step builders issue each "
+            "comm bucket's all-reduce in backward-finalisation order, as "
+            "its own data-independent collective, and apply that "
+            "bucket's parameter update immediately — no bucket waits on "
+            "another's collective, so XLA's latency-hiding scheduler "
+            "can hide the early buckets behind the remaining backward "
+            "chain. 0 (default) keeps the serialized sync-then-update "
+            "step, bit-identical to the pre-overlap build. A raise at "
+            "fault site comm.overlap degrades to the serialized path "
+            "with a recorded comm_degraded event")
+DEFINE_float("comm_split_ratio", 0.75,
+             "fraction of each large bucket the multipath comm policy "
+             "(comm_policy=multipath, FlexLink-style) routes over the "
+             "PRIMARY path (flat ring over ICI); the remainder rides "
+             "the SECONDARY path (hierarchical inter-host hop over the "
+             "comm_hosts factorisation) at the same time, so both "
+             "fabrics carry bytes simultaneously. Configure from "
+             "measured per-path bandwidths via "
+             "comm.measured_split_ratio(primary_gbps, secondary_gbps); "
+             "buckets below 64 KiB ride the primary path whole "
+             "(splitting them buys nothing and costs a dispatch)")
+DEFINE_bool("comm_gspmd", True,
+            "route the GSPMD Executor path's data-parallel gradient "
+            "sync through the explicit paddle_tpu.comm collectives "
+            "(bucketed/hierarchical/quantized per comm_policy) instead "
+            "of only modelling the bytes: eligible pure-DP programs "
+            "trace under shard_map with comm.all_reduce_grads at the "
+            "backward/optimizer boundary, and Executor.stats reports "
+            "comm_path='explicit' with stats measured from the traced "
+            "plan. Only engages when comm_policy != 'none' (the none "
+            "policy keeps the pre-PR GSPMD build bit-identical); "
+            "ineligible programs (tensor/ZeRO sharding, batch-coupled "
+            "or random ops, non-batch fetches) fall back to the "
+            "modelled path with a recorded comm_degraded event. 0 "
+            "forces model-only")
 DEFINE_bool("tune", True,
             "consult the paddle_tpu.tune winner cache at kernel dispatch "
             "sites: a cached per-(device, shape) winner activates the "

@@ -108,15 +108,18 @@ def collective_bytes(program, specs, mesh_shape, zero_axis=None,
 
 
 def comm_policy_table(program, specs, mesh_shape, dtype_bytes=4,
-                      hosts=None, bucket_mb=None):
+                      hosts=None, bucket_mb=None, split_ratio=None):
     """Bytes-on-wire + dispatch-count matrix of every paddle_tpu.comm
     policy for the DP-synced (replicated) parameter set of a transpiled
     program — the ``paddle_tpu accounting`` CLI's comm section, and the
     same model ``comm.plan_summary`` applies to live step builds.
 
-    ``hosts`` parameterises the hierarchical rows (None = 2, the
-    smallest topology where the decomposition differs from flat);
-    ``bucket_mb`` defaults to ``FLAGS.comm_bucket_mb``.
+    ``hosts`` parameterises the hierarchical/multipath rows (None = 2,
+    the smallest topology where the decomposition differs from flat);
+    ``bucket_mb`` defaults to ``FLAGS.comm_bucket_mb``; ``split_ratio``
+    (None = ``FLAGS.comm_split_ratio``) sets the multipath rows'
+    primary-path fraction, surfaced per row beside the per-path byte
+    columns (``bytes_primary_path``/``bytes_secondary_path``).
     """
     from ..comm.policy import policy_table
     data_axis = "dp" if "dp" in mesh_shape else next(iter(mesh_shape), None)
@@ -130,7 +133,8 @@ def comm_policy_table(program, specs, mesh_shape, dtype_bytes=4,
         "data_axis": data_axis, "axis_size": int(n),
         "dp_synced_param_bytes": int(replicated),
         "policies": policy_table(replicated, n, n_params=n_params,
-                                 hosts=hosts, bucket_mb=bucket_mb),
+                                 hosts=hosts, bucket_mb=bucket_mb,
+                                 split_ratio=split_ratio),
     }
 
 

@@ -221,12 +221,13 @@ class DistributeTranspiler(object):
 
 
 def data_parallel_step_fn(loss_fn, mesh: Optional[Mesh] = None,
-                          axis_name=None, policy=None, donate=False):
+                          axis_name=None, policy=None, donate=False,
+                          overlap=None):
     """Explicit-collective data-parallel training-step builder whose
     gradient sync routes through ``paddle_tpu.comm`` — the jax-level
     counterpart of the Executor's GSPMD path, for step functions that
     want policy-controlled collectives (bucketed / hierarchical /
-    quantized) instead of whatever GSPMD derives.
+    quantized / multipath) instead of whatever GSPMD derives.
 
     ``loss_fn(params, x, y) -> scalar`` is the per-device loss over the
     LOCAL batch shard. Returns ``(step, comm_state0_fn)``:
@@ -241,11 +242,25 @@ def data_parallel_step_fn(loss_fn, mesh: Optional[Mesh] = None,
       quantised policies the residuals bias-correct the next update.
 
     ``policy=None`` resolves from flags at build time
-    (``comm_policy``/``comm_bucket_mb``/``comm_quant``/``comm_hosts``);
-    the resolved ``none`` policy is BIT-identical to a bare
-    ``tree_map(pmean, grads)`` sync (tests/test_comm.py proves it).
+    (``comm_policy``/``comm_bucket_mb``/``comm_quant``/``comm_hosts``/
+    ``comm_split_ratio``); the resolved ``none`` policy is BIT-identical
+    to a bare ``tree_map(pmean, grads)`` sync (tests/test_comm.py
+    proves it).
+
+    ``overlap=None`` resolves from ``FLAGS.comm_overlap``. When on, the
+    step is the staged comm/compute-overlap form
+    (:func:`paddle_tpu.comm.staged_sync_and_update`): each bucket's
+    collective issues in backward-finalisation order and its parameter
+    update applies immediately — data-independent of the remaining
+    backward chain, so the scheduler can hide the sync behind it. Off
+    (the default) keeps the serialized sync-then-update step,
+    bit-identical to the pre-overlap build; a raise at the armed
+    ``comm.overlap`` fault site degrades overlap-on back to the
+    serialized path with a recorded ``comm_degraded`` event.
     """
     from .. import comm
+    from ..resilience.events import record_event
+    from ..resilience.faults import FaultError
 
     mesh = mesh or get_default_mesh()
     if mesh is None:
@@ -254,19 +269,35 @@ def data_parallel_step_fn(loss_fn, mesh: Optional[Mesh] = None,
     n_dev = mesh.shape[axis_name]
     policy = policy if policy is not None else comm.resolve_policy(
         axis_size=n_dev)
+    use_overlap = comm.overlap_enabled(overlap)
 
     def comm_state0_fn(params):
         grads_like = jax.tree_util.tree_map(
             lambda p: jnp.zeros(jnp.shape(p), jnp.result_type(p)), params)
         return comm.init_state(grads_like, policy)
 
-    def per_device(params, comm_state, x, y, lr):
-        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
-        loss = jax.lax.pmean(loss, axis_name)
+    def _serialized(params, comm_state, grads, lr):
         grads, comm_state = comm.all_reduce_grads(
             grads, axis_name, policy, comm_state)
         new_params = jax.tree_util.tree_map(
             lambda p, g: p - lr * g, params, grads)
+        return new_params, comm_state
+
+    def per_device(params, comm_state, x, y, lr):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        loss = jax.lax.pmean(loss, axis_name)
+        if use_overlap:
+            try:
+                new_params, comm_state = comm.staged_sync_and_update(
+                    params, grads, axis_name,
+                    lambda p, g: p - lr * g, policy, comm_state)
+                return loss, new_params, comm_state
+            except FaultError as e:
+                # overlap fault: one step-build's worth of lost overlap,
+                # not a dead job — the serialized path is always sound
+                record_event("comm_degraded", site="comm.overlap",
+                             policy=policy.base, error=str(e))
+        new_params, comm_state = _serialized(params, comm_state, grads, lr)
         return loss, new_params, comm_state
 
     rep = P()

@@ -39,25 +39,50 @@ from jax.sharding import Mesh, PartitionSpec as P
 def _resolve_stateless_policy(comm_policy, data_axis, mesh):
     """Resolve the comm policy for a pipeline builder's data-axis grad
     sync. The pipelined step functions carry no comm state, so the
-    fused-int8 policy (whose convergence depends on error-feedback
-    residuals) downgrades to its full-precision base with a warning;
-    hierarchical int8 is stateless and passes through."""
+    fused-int8 policies (whose convergence depends on error-feedback
+    residuals) downgrade to their full-precision base with a warning;
+    hierarchical/multipath int8 is stateless and passes through."""
     from .. import comm
     if not data_axis:
         return None
     policy = comm_policy if comm_policy is not None else \
         comm.resolve_policy(axis_size=mesh.shape[data_axis])
-    if policy.quantized and policy.base != "hierarchical":
+    stateless = comm.stateless_policy(policy)
+    if stateless is not policy:
         warnings.warn(
             "comm_quant=%s needs error-feedback state the pipelined step "
             "builders do not carry; syncing %r grads at full precision "
             "(use parallel.data_parallel_step_fn for fused int8, or "
-            "comm_policy=hierarchical for stateless inter-host int8)"
-            % (policy.quant, data_axis))
-        policy = comm.CommPolicy(base=policy.base,
-                                 bucket_bytes=policy.bucket_bytes,
-                                 quant="none", hosts=policy.hosts)
-    return policy
+            "comm_policy=hierarchical/multipath for stateless inter-host "
+            "int8)" % (policy.quant, data_axis))
+    return stateless
+
+
+def _sync_and_update(params, grads, data_axis, comm_policy, lr,
+                     use_overlap):
+    """Shared tail of the pipelined per-device bodies: data-axis grad
+    sync through paddle_tpu.comm (staged overlap form when enabled,
+    degrading to the serialized form on an armed ``comm.overlap`` fault
+    site) followed by the SGD update."""
+    from .. import comm
+    from ..resilience.events import record_event
+    from ..resilience.faults import FaultError
+    if data_axis and use_overlap:
+        try:
+            new_params, _ = comm.staged_sync_and_update(
+                params, grads, data_axis, lambda p, g: p - lr * g,
+                comm_policy, None)
+            return new_params
+        except FaultError as e:
+            record_event("comm_degraded", site="comm.overlap",
+                         policy=comm_policy.base if comm_policy else "none",
+                         error=str(e))
+    if data_axis:
+        # DP sync rides the comm subsystem (bucketed/hierarchical/
+        # multipath per comm_policy; `none` = the per-leaf pmean of old)
+        grads, _ = comm.all_reduce_grads(grads, data_axis, comm_policy)
+    return jax.tree_util.tree_map(
+        lambda p, g: p - lr * g, params, grads)
 
 __all__ = ["pipeline", "pipelined_step_fn", "stack_stage_params",
            "pipeline_hetero", "pipelined_hetero_step_fn"]
@@ -197,7 +222,7 @@ def pipeline_hetero(stage_fns, n_micro, axis_name="pp", remat=False):
 
 def pipelined_hetero_step_fn(stage_fns, loss_fn, mesh: Mesh, n_micro,
                              axis_name="pp", data_axis=None, remat=False,
-                             comm_policy=None):
+                             comm_policy=None, overlap=None):
     """Training-step builder for heterogeneous stages: returns a jitted
     ``step(params_tuple, x, y, lr) -> (loss, new_params_tuple)`` where
     ``params_tuple[i]`` is stage i's own pytree (any structure).
@@ -205,11 +230,14 @@ def pipelined_hetero_step_fn(stage_fns, loss_fn, mesh: Mesh, n_micro,
     The ``data_axis`` gradient sync routes through
     ``comm.all_reduce_grads`` under ``comm_policy`` (None = resolve from
     the comm_* flags; the resolved ``none`` policy is bit-identical to
-    the per-leaf pmean this replaced)."""
+    the per-leaf pmean this replaced). ``overlap=None`` resolves from
+    ``FLAGS.comm_overlap``: on, the sync+update is the staged
+    comm/compute-overlap form (see ``data_parallel_step_fn``)."""
     from .. import comm
     from ..comm import shard_map
 
     comm_policy = _resolve_stateless_policy(comm_policy, data_axis, mesh)
+    use_overlap = comm.overlap_enabled(overlap)
     n_stages = len(stage_fns)
     body = pipeline_hetero(stage_fns, n_micro, axis_name=axis_name,
                            remat=remat)
@@ -231,12 +259,8 @@ def pipelined_hetero_step_fn(stage_fns, loss_fn, mesh: Mesh, n_micro,
         # untaken switch branches differentiate to zeros); collect
         grads = jax.tree_util.tree_map(
             lambda g: jax.lax.psum(g, axis_name), grads)
-        if data_axis:
-            # DP sync rides the comm subsystem (bucketed/hierarchical
-            # per comm_policy; `none` = the per-leaf pmean of old)
-            grads, _ = comm.all_reduce_grads(grads, data_axis, comm_policy)
-        new_params = jax.tree_util.tree_map(
-            lambda p, g: p - lr * g, params, grads)
+        new_params = _sync_and_update(params, grads, data_axis,
+                                      comm_policy, lr, use_overlap)
         return loss, new_params
 
     xspec = P(*batch_spec)
@@ -287,7 +311,7 @@ def pipelined_hetero_step_fn(stage_fns, loss_fn, mesh: Mesh, n_micro,
 
 def pipelined_step_fn(stage_fn, loss_fn, mesh: Mesh, n_micro,
                       axis_name="pp", data_axis=None, remat=False,
-                      donate=False, comm_policy=None):
+                      donate=False, comm_policy=None, overlap=None):
     """Whole pipelined training-step builder: returns a jitted
     ``step(stacked_params, x, y, lr) -> (loss, new_params)``.
 
@@ -303,12 +327,15 @@ def pipelined_step_fn(stage_fn, loss_fn, mesh: Mesh, n_micro,
     shards over it and gradients sync over ``data_axis`` only — dp × pp —
     through ``comm.all_reduce_grads`` under ``comm_policy`` (None =
     resolve from the comm_* flags; ``none`` is bit-identical to the
-    per-leaf pmean this replaced).
+    per-leaf pmean this replaced). ``overlap=None`` resolves from
+    ``FLAGS.comm_overlap``: on, the sync+update is the staged
+    comm/compute-overlap form (see ``data_parallel_step_fn``).
     """
     from .. import comm
     from ..comm import shard_map
 
     comm_policy = _resolve_stateless_policy(comm_policy, data_axis, mesh)
+    use_overlap = comm.overlap_enabled(overlap)
     body = pipeline(stage_fn, n_micro, axis_name=axis_name, remat=remat)
     batch_spec = (None, data_axis) if data_axis else (None,)
 
@@ -328,12 +355,8 @@ def pipelined_step_fn(stage_fn, loss_fn, mesh: Mesh, n_micro,
 
         loss, grads = jax.value_and_grad(loss_of)(params)
         loss = jax.lax.psum(loss, axis_name)  # undo the 1/n_pp in the report
-        if data_axis:
-            # DP sync rides the comm subsystem (bucketed/hierarchical
-            # per comm_policy; `none` = the per-leaf pmean of old)
-            grads, _ = comm.all_reduce_grads(grads, data_axis, comm_policy)
-        new_params = jax.tree_util.tree_map(
-            lambda p, g: p - lr * g, params, grads)
+        new_params = _sync_and_update(params, grads, data_axis,
+                                      comm_policy, lr, use_overlap)
         return loss, new_params
 
     pspec = P(axis_name)

@@ -128,7 +128,13 @@ def update_comm_counters(**counters):
     (paddle_tpu.comm; a few dict adds per step-BUILD or per recorded
     step, never per collective). Keys in use: ``comm_bytes`` (modelled
     per-chip wire bytes per step), ``comm_payload_bytes``,
-    ``comm_buckets``, ``comm_dispatches``, ``comm_builds``;
+    ``comm_buckets``, ``comm_dispatches``, ``comm_builds``; the overlap
+    step (comm.overlap) adds ``comm_overlap_builds``,
+    ``comm_overlap_buckets_early`` (buckets issued before the final
+    one — each data-independent of the remaining backward chain) and
+    ``comm_overlap_hidden_bytes_est`` (wire bytes of those early
+    buckets — the estimate of what the latency-hiding scheduler can
+    hide; an estimate, CPU CI cannot time a real fabric);
     ``comm_quant_fallbacks`` is a cumulative gauge kept as a max, not a
     sum (the comm state already accumulates it across steps)."""
     for k, v in counters.items():

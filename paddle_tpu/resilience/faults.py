@@ -51,6 +51,17 @@ site                      where
                           whole sync to the unbucketed per-leaf path
                           (policy ``none`` shape) with a recorded
                           ``comm_degraded`` event
+``comm.overlap``          paddle_tpu.comm.overlap staged-step build,
+                          per step-function trace (comm_overlap=1): a
+                          raise degrades that build to the serialized
+                          sync-then-update path with a recorded
+                          ``comm_degraded`` event — overlap is an
+                          optimisation, never a correctness dependency
+``comm.gspmd``            not a fault_point: the SITE recorded on the
+                          ``comm_degraded`` event when the Executor's
+                          explicit-comm build (FLAGS.comm_gspmd) finds
+                          a program it cannot hold the contract for
+                          and falls back to the plain GSPMD jit
 ``tune.candidate``        paddle_tpu.tune autotune loop, per candidate
                           config, before build/compile: a raise is
                           indistinguishable from a real candidate

@@ -22,7 +22,8 @@ import paddle_tpu as pt
 from paddle_tpu import comm
 from paddle_tpu.comm import (CommPolicy, build_plan, flatten_to_buckets,
                              unflatten_from_buckets, hierarchical_all_reduce,
-                             quantized_all_reduce, bytes_on_wire)
+                             quantized_all_reduce, bytes_on_wire,
+                             quantized_reduce_scatter_all_gather)
 from paddle_tpu.comm.quant import quantize, dequantize
 from paddle_tpu.flags import flags_guard
 from paddle_tpu.parallel import data_parallel_step_fn, make_mesh
@@ -223,7 +224,8 @@ def test_accounting_comm_policy_table(dp8_mesh):
     assert table["dp_synced_param_bytes"] > 0
     rows = {r["policy"]: r for r in table["policies"]}
     assert set(rows) == {"none", "fused", "hierarchical", "fused+int8",
-                         "hierarchical+int8"}
+                         "fused+int8_2shot", "hierarchical+int8",
+                         "multipath", "multipath+int8"}
     # fusion: fewer dispatches than parameters; same bytes as none
     assert rows["fused"]["collective_dispatches"] < \
         rows["none"]["collective_dispatches"]
@@ -235,6 +237,18 @@ def test_accounting_comm_policy_table(dp8_mesh):
     # quantisation: int8 shrinks inter-host bytes further
     assert rows["hierarchical+int8"]["inter_host_bytes_per_link"] < \
         rows["hierarchical"]["inter_host_bytes_per_link"]
+    # 2-shot: the scalable int8 form — beats the gather form at n=8
+    assert rows["fused+int8_2shot"]["bytes_per_chip"] < \
+        rows["fused+int8"]["bytes_per_chip"]
+    # multipath: the per-path columns decompose the per-chip total and
+    # carry the configured split ratio
+    mp = rows["multipath"]
+    assert mp["split_ratio"] is not None
+    assert mp["bytes_primary_path"] + mp["bytes_secondary_path"] == \
+        mp["bytes_per_chip"]
+    # non-multipath rows put everything on the primary path
+    assert rows["fused"]["bytes_secondary_path"] == 0
+    assert rows["fused"]["split_ratio"] is None
 
 
 def test_accounting_cli_verb(tmp_path, capsys):
@@ -256,8 +270,18 @@ def test_accounting_cli_verb(tmp_path, capsys):
     report = json.loads(capsys.readouterr().out)
     assert report["mesh"] == {"dp": 8}
     assert report["comm"]["dp_synced_param_bytes"] > 0
-    assert len(report["comm"]["policies"]) == 5
+    assert len(report["comm"]["policies"]) == 8
     assert "dp_grad_allreduce" in report["collectives"]
+    assert all("bytes_primary_path" in row
+               for row in report["comm"]["policies"])
+    # --split-ratio parameterises the multipath rows
+    rc2 = cli.main(["accounting", str(cfg), "--mesh", "dp=8", "--hosts",
+                    "2", "--split-ratio", "0.5"])
+    assert rc2 == 0
+    report2 = json.loads(capsys.readouterr().out)
+    mp = [r for r in report2["comm"]["policies"]
+          if r["policy"] == "multipath"][0]
+    assert mp["split_ratio"] == 0.5
 
 
 # ---------------------------------------------------------------------------
@@ -596,3 +620,462 @@ def test_pipelined_step_fn_comm_policy_parity(forced_cpu_devices):
     fused = run(CommPolicy(base="fused", bucket_bytes=512))
     assert ref == run(CommPolicy(base="none"))  # deterministic harness
     np.testing.assert_allclose(fused, ref, rtol=1e-5)
+
+
+def test_pipelined_step_fn_overlap_parity(forced_cpu_devices):
+    """dp x pp: the staged overlap sync holds parity through the
+    pipelined step builder too (stateless policies only there)."""
+    from paddle_tpu.parallel import pipelined_step_fn
+    mesh = make_mesh({"dp": 2, "pp": 4}, devices=forced_cpu_devices)
+    n_micro, B, D = 4, 16, 8
+    rng = np.random.RandomState(0)
+    stacked = {"w": jnp.asarray(rng.randn(4, D, D).astype(np.float32) * 0.3)}
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"])
+
+    def loss_fn(yp, yt):
+        return jnp.mean((yp - yt) ** 2)
+
+    x = rng.randn(B, D).astype(np.float32)
+    yt = rng.randn(B, D).astype(np.float32)
+
+    def run(policy, overlap):
+        step = pipelined_step_fn(stage_fn, loss_fn, mesh, n_micro,
+                                 data_axis="dp", comm_policy=policy,
+                                 overlap=overlap)
+        p, ls = {"w": stacked["w"]}, []
+        for _ in range(3):
+            loss, p = step(p, x, yt, 0.05)
+            ls.append(float(loss))
+        return ls
+
+    ref = run(CommPolicy(base="none"), False)
+    assert run(CommPolicy(base="none"), True) == ref  # BIT-identical
+    np.testing.assert_allclose(
+        run(CommPolicy(base="fused", bucket_bytes=512), True), ref,
+        rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# comm/compute overlap: the staged step (ISSUE 7 tentpole)
+
+
+def test_backward_schedule_orders_buckets():
+    """The overlap issue order: the bucket holding the HIGHEST leaf
+    positions (last-declared params, first-finalised grads) goes
+    first."""
+    tree = {"p%02d" % i: jnp.ones((64,), jnp.float32) for i in range(6)}
+    plan = build_plan(tree, bucket_bytes=512)  # 2 leaves per bucket
+    order = plan.backward_schedule()
+    assert sorted(order) == list(range(plan.num_buckets))
+    maxima = [max(plan.buckets[i].leaf_ids) for i in order]
+    assert maxima == sorted(maxima, reverse=True)
+    assert order[0] == plan.num_buckets - 1  # last bucket issues first
+
+
+def test_overlap_bit_identical_policy_none(dp8_mesh):
+    """Acceptance: overlap-on under comm_policy=none is BIT-identical
+    to the serialized path over 3 passes — the staged restructure moves
+    issue order and update staging, never values."""
+    ser, _, _ = _train(dp8_mesh, CommPolicy(base="none"))
+    ov, _, state = _train_overlap(dp8_mesh, CommPolicy(base="none"))
+    assert ov == ser
+    assert int(state["comm_quant_fallbacks"]) == 0
+
+
+def _train_overlap(mesh, policy, steps=9, lr=0.1, seed=0):
+    step, state0 = data_parallel_step_fn(_mlp_loss, mesh, policy=policy,
+                                         overlap=True)
+    params = _mlp_params(seed)
+    state = state0(params)
+    batches = [_mlp_data(seed=s) for s in range(3)]
+    losses = []
+    for i in range(steps):
+        x, y = batches[i % 3]
+        loss, params, state = step(params, state, x, y, lr)
+        losses.append(float(loss))
+    return losses, params, state
+
+
+@pytest.mark.parametrize("policy_kw", [
+    dict(base="fused", bucket_bytes=1024),
+    dict(base="hierarchical", bucket_bytes=1024, hosts=2),
+    dict(base="multipath", bucket_bytes=1024, hosts=2, split_ratio=0.5),
+    dict(base="fused", bucket_bytes=4096, quant="int8"),
+    dict(base="fused", bucket_bytes=4096, quant="int8_2shot"),
+])
+def test_overlap_parity_per_policy(dp8_mesh, policy_kw):
+    """Every policy x overlap: the staged step runs the SAME per-bucket
+    collective (_bucket_collective is shared), so losses match the
+    serialized build exactly up to fp tolerance."""
+    pol = CommPolicy(**policy_kw)
+    ser, _, _ = _train(dp8_mesh, pol, steps=6)
+    ov, _, _ = _train_overlap(dp8_mesh, pol, steps=6)
+    np.testing.assert_allclose(ov, ser, rtol=1e-6)
+
+
+def test_overlap_fault_degrades_to_serialized(dp8_mesh):
+    """Armed comm.overlap: the staged build degrades to the serialized
+    path with a recorded comm_degraded event — losses land exactly on
+    the serialized build's."""
+    ser, _, _ = _train(dp8_mesh, CommPolicy(base="fused",
+                                            bucket_bytes=1024), steps=3)
+    faults.load_fault_spec("comm.overlap:raise:nth=1,times=*")
+    got, _, _ = _train_overlap(dp8_mesh, CommPolicy(base="fused",
+                                                    bucket_bytes=1024),
+                               steps=3)
+    assert got == ser
+    evs = R.events(kind="comm_degraded", site="comm.overlap")
+    assert evs
+
+
+def test_overlap_records_profiler_counters(dp8_mesh):
+    from paddle_tpu import profiler
+    profiler.reset_comm_counters()
+    _train_overlap(dp8_mesh, CommPolicy(base="fused", bucket_bytes=1024),
+                   steps=1)
+    c = profiler.comm_counters()
+    assert c["comm_overlap_builds"] >= 1
+    # 1KiB buckets split the MLP grads -> at least one early bucket
+    # with estimated hidden bytes
+    assert c["comm_overlap_buckets_early"] >= 1
+    assert c["comm_overlap_hidden_bytes_est"] > 0
+
+
+def test_overlap_resolves_from_flag(dp8_mesh):
+    """overlap=None defers to FLAGS.comm_overlap at build time."""
+    from paddle_tpu import profiler
+    with flags_guard(comm_overlap=True):
+        profiler.reset_comm_counters()
+        step, state0 = data_parallel_step_fn(
+            _mlp_loss, dp8_mesh,
+            policy=CommPolicy(base="fused", bucket_bytes=1024))
+        params = _mlp_params()
+        x, y = _mlp_data()
+        step(params, state0(params), x, y, 0.1)
+        assert profiler.comm_counters()["comm_overlap_builds"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# 2-shot int8: reduce-scatter + all-gather (scales past n=8)
+
+
+def test_2shot_allreduce_error_bound(dp8_mesh):
+    """The 2-shot result is the mean within two quantisation steps
+    (shot-1 + shot-2 rounding), and the residual is live error
+    feedback."""
+    x = np.random.RandomState(7).randn(8, 1000).astype(np.float32)
+
+    def body(v):
+        out, res, fell = quantized_reduce_scatter_all_gather(
+            jax.lax.squeeze(v, (0,)), "dp", chunk=128)
+        return out[None], res[None], fell[None]
+
+    out, res, fell = comm.shard_map(
+        body, dp8_mesh, in_specs=P("dp"),
+        out_specs=(P("dp"), P("dp"), P("dp")))(x)
+    assert int(np.asarray(fell).sum()) == 0
+    np.testing.assert_allclose(np.asarray(out)[0], x.mean(0), atol=0.05)
+    # every device dequantises the same gathered payload (fp noise only)
+    assert np.asarray(out).std(axis=0).max() < 1e-6
+    # residuals are real (nonzero) and bounded by the quantisation step
+    r = np.asarray(res)
+    assert np.abs(r).max() > 0.0
+    assert np.abs(r).max() < 0.5
+
+
+def test_2shot_bytes_beat_gather_and_ring_at_8():
+    """The crossover doc/comm.md documents: at n=8 the gather int8 form
+    LOSES to the fp32 ring while the 2-shot form beats both — and keeps
+    winning as n grows."""
+    B = 1 << 20
+    for n in (8, 16, 64):
+        two = bytes_on_wire(B, CommPolicy(base="fused",
+                                          quant="int8_2shot"), n)
+        gather = bytes_on_wire(B, CommPolicy(base="fused", quant="int8"), n)
+        ring = bytes_on_wire(B, CommPolicy(base="fused"), n)
+        assert two < ring, (n, two, ring)
+        assert two < gather, (n, two, gather)
+    # the gather form's honest failure mode at n=8: >= the fp32 ring
+    assert bytes_on_wire(B, CommPolicy(base="fused", quant="int8"), 8) \
+        >= bytes_on_wire(B, CommPolicy(base="fused"), 8)
+
+
+def test_2shot_error_feedback_trains_close(dp8_mesh):
+    ref, _, _ = _train(dp8_mesh, CommPolicy(base="none"), steps=18)
+    q, _, state = _train(dp8_mesh, CommPolicy(
+        base="fused", bucket_bytes=4096, quant="int8_2shot"), steps=18)
+    assert abs(q[-1] - ref[-1]) / ref[-1] < 0.02, (q[-1], ref[-1])
+    assert int(state["comm_quant_fallbacks"]) == 0
+    res_mag = max(float(jnp.abs(r).max())
+                  for r in jax.tree_util.tree_leaves(state["residual"]))
+    assert res_mag > 0.0  # error feedback is live state
+
+
+def test_2shot_overflow_falls_back(dp8_mesh):
+    step, state0 = data_parallel_step_fn(
+        _mlp_loss, dp8_mesh,
+        policy=CommPolicy(base="fused", bucket_bytes=4096,
+                          quant="int8_2shot"))
+    params = _mlp_params()
+    params = dict(params, w2=params["w2"].at[0, 0].set(jnp.inf))
+    state = state0(params)
+    x, y = _mlp_data()
+    _, _, state = step(params, state, x, y, 0.1)
+    assert int(state["comm_quant_fallbacks"]) > 0
+
+
+def test_2shot_requires_fused_base():
+    """int8_2shot IS a flat-axis collective shape: composing it under
+    hierarchical/multipath is refused readably (their inter-host legs
+    quantise via plain int8 instead)."""
+    with pytest.raises(ValueError, match="fused-base"):
+        CommPolicy(base="hierarchical", quant="int8_2shot", hosts=2)
+    with pytest.raises(ValueError, match="fused-base"):
+        CommPolicy(base="multipath", quant="int8_2shot", hosts=2)
+    # none promotes to fused, like plain int8
+    assert CommPolicy(base="none", quant="int8_2shot").base == "fused"
+
+
+# ---------------------------------------------------------------------------
+# multipath (FlexLink): primary + secondary path simultaneously
+
+
+def test_multipath_split_reassembles_bitwise(dp8_mesh):
+    """The split/concat machinery moves bytes, never values: with BOTH
+    paths running the same reduction (hosts=1 secondary = flat RS+AG =
+    psum-equivalent mean), the reassembled vector is bitwise the
+    unsplit psum's per element of each slice."""
+    from paddle_tpu.comm.multipath import split_flat
+    x = np.random.RandomState(3).randn(8, 512).astype(np.float32)
+    k = 256
+
+    def split_body(v):
+        flat = jax.lax.squeeze(v, (0,))
+        a, b = split_flat(flat, k)
+        # same collective on both slices: psum — reassembly must be
+        # bitwise the unsplit psum (elementwise op, disjoint slices)
+        out = jnp.concatenate([jax.lax.psum(a, "dp"),
+                               jax.lax.psum(b, "dp")])
+        return out[None]
+
+    def whole_body(v):
+        return jax.lax.psum(jax.lax.squeeze(v, (0,)), "dp")[None]
+
+    split_out = comm.shard_map(split_body, dp8_mesh, in_specs=P("dp"),
+                               out_specs=P("dp"))(x)
+    whole_out = comm.shard_map(whole_body, dp8_mesh, in_specs=P("dp"),
+                               out_specs=P("dp"))(x)
+    np.testing.assert_array_equal(np.asarray(split_out),
+                                  np.asarray(whole_out))
+
+
+def test_multipath_all_reduce_is_mean(dp8_mesh):
+    x = np.random.RandomState(5).randn(8, 1024).astype(np.float32)
+
+    def body(v):
+        return comm.multipath_all_reduce(
+            jax.lax.squeeze(v, (0,)), "dp", hosts=2, k=512)[None]
+
+    out = comm.shard_map(body, dp8_mesh, in_specs=P("dp"),
+                         out_specs=P("dp"))(x)
+    # secondary slice reassociates (hierarchical): fp32 tolerance
+    np.testing.assert_allclose(np.asarray(out),
+                               np.tile(x.mean(0), (8, 1)), rtol=1e-5)
+
+
+def test_multipath_trains_close(dp8_mesh):
+    ref, _, _ = _train(dp8_mesh, CommPolicy(base="none"))
+    mp, _, _ = _train(dp8_mesh, CommPolicy(
+        base="multipath", bucket_bytes=1024, hosts=2, split_ratio=0.5))
+    np.testing.assert_allclose(mp, ref, rtol=1e-5)
+
+
+def test_multipath_split_elems_alignment():
+    """The split point honours the ratio, stays chips-aligned (the
+    secondary slice feeds a hierarchical reduce-scatter) and leaves
+    small buckets whole on the primary path."""
+    from paddle_tpu.comm.policy import MULTIPATH_MIN_BYTES
+    p = CommPolicy(base="multipath", hosts=2, split_ratio=0.75)
+    numel = 100_000  # 400 KB > floor
+    k = p.split_elems(numel, numel * 4, chips=4)
+    assert k % 4 == 0 and (numel - k) % 4 == 0
+    assert abs(k / numel - 0.75) < 0.01
+    # below the floor: everything primary
+    small = (MULTIPATH_MIN_BYTES // 4) - 4
+    assert p.split_elems(small, small * 4, chips=4) == small
+    # extremes clamp
+    assert CommPolicy(base="multipath", hosts=2, split_ratio=1.0) \
+        .split_elems(numel, numel * 4, 4) == numel
+    assert CommPolicy(base="multipath", hosts=2, split_ratio=0.0) \
+        .split_elems(numel, numel * 4, 4) == 0
+
+
+def test_measured_split_ratio():
+    from paddle_tpu.comm import measured_split_ratio
+    # FlexLink's rule: bytes proportional to bandwidth
+    assert measured_split_ratio(3.0, 1.0) == 0.75
+    assert measured_split_ratio(1.0, 0.0) == 1.0
+    with pytest.raises(ValueError):
+        measured_split_ratio(0.0, 1.0)
+
+
+def test_multipath_bytes_model():
+    """path_split_bytes decomposes the per-chip total; the primary ring
+    slice and the secondary hierarchical slice price like their
+    single-path forms."""
+    from paddle_tpu.comm import path_split_bytes
+    B, n = 1 << 20, 8
+    p = CommPolicy(base="multipath", hosts=2, split_ratio=0.5)
+    split = path_split_bytes(B, p, n)
+    assert split["split_ratio"] == 0.5
+    assert split["primary"] + split["secondary"] == bytes_on_wire(B, p, n)
+    # each path prices as its own algorithm on its slice (chips=4
+    # alignment can shift the split point by < 1 chunk)
+    half = B // 2
+    assert abs(split["primary"]
+               - bytes_on_wire(half, CommPolicy(base="fused"), n)) < 64
+    assert abs(split["secondary"] - bytes_on_wire(
+        half, CommPolicy(base="hierarchical", hosts=2), n)) < 64
+    # the point of the split: the boundary link carries LESS than a
+    # flat ring (part of the stream crosses on the secondary path's
+    # 1/chips chunk), more than pure hierarchical
+    from paddle_tpu.comm.policy import inter_host_bytes_per_link
+    flat = inter_host_bytes_per_link(B, CommPolicy(base="fused"), n)
+    hier = inter_host_bytes_per_link(
+        B, CommPolicy(base="hierarchical", hosts=2), n)
+    mp = inter_host_bytes_per_link(B, p, n)
+    assert hier < mp < flat
+
+
+def test_policy_table_multipath_dispatches_honest():
+    """The table doubles multipath dispatches only when the split
+    actually happens — a sub-floor bucket or ratio 1.0 flies ONE
+    collective, matching plan_summary's live decision."""
+    from paddle_tpu.comm.policy import policy_table
+    small = {r["policy"]: r for r in policy_table(32 * 1024, 8, hosts=2)}
+    assert small["multipath"]["collective_dispatches"] == \
+        small["fused"]["collective_dispatches"]  # below the 64 KiB floor
+    whole = {r["policy"]: r
+             for r in policy_table(1 << 20, 8, hosts=2, split_ratio=1.0)}
+    assert whole["multipath"]["collective_dispatches"] == \
+        whole["fused"]["collective_dispatches"]  # ratio 1.0: one path
+    split = {r["policy"]: r
+             for r in policy_table(1 << 20, 8, hosts=2, split_ratio=0.5)}
+    assert split["multipath"]["collective_dispatches"] == \
+        2 * split["fused"]["collective_dispatches"]
+
+
+# ---------------------------------------------------------------------------
+# executor: explicit comm routing on the GSPMD path (tentpole part 4)
+
+
+def _dp_program():
+    from paddle_tpu import layers
+    prog, startup = pt.Program(), pt.Program()
+    with pt.program_guard(prog, startup):
+        x = layers.data("x", shape=[16], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="int64")
+        h = layers.fc(x, size=32, act="relu")
+        pred = layers.fc(h, size=4, act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, y))
+        pt.SGD(learning_rate=0.1).minimize(loss)
+    return prog, startup, loss, pred
+
+
+def _run_executor(prog, startup, fetches, dp8_mesh, n_steps=3):
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.parallel import data_parallel
+    scope = Scope()
+    ctx = data_parallel(dp8_mesh)
+    exe = pt.Executor(pt.CPUPlace(), dist_context=ctx)
+    exe.run(startup, scope=scope)
+    xs, ys = _mlp_data()
+    losses = []
+    out = None
+    for _ in range(n_steps):
+        out = exe.run(prog, feed={"x": xs, "y": ys[:, None]},
+                      fetch_list=fetches, scope=scope)
+        losses.append(float(np.asarray(out[0]).reshape(())))
+    return losses, exe, out
+
+
+def test_executor_explicit_comm_path(dp8_mesh):
+    """comm_policy != none routes the GSPMD Executor path's grad sync
+    through the explicit comm collectives: stats say so, and losses +
+    batch fetches match the model-path build."""
+    prog, startup, loss, pred = _dp_program()
+    ref, exe0, out0 = _run_executor(prog, startup, [loss, pred], dp8_mesh)
+    assert exe0.stats["comm_path"] == "model"  # none policy: GSPMD owns
+    with flags_guard(comm_policy="fused", comm_hosts=2):
+        got, exe, out = _run_executor(prog, startup, [loss, pred],
+                                      dp8_mesh)
+    assert exe.stats["comm_path"] == "explicit"
+    assert exe.stats["comm_bytes"] > 0 and exe.stats["comm_buckets"] >= 1
+    assert not R.events(kind="comm_degraded", site="comm.gspmd")
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+    # batch-leading fetch reassembles over the data axis
+    assert np.asarray(out[1]).shape == np.asarray(out0[1]).shape
+    np.testing.assert_allclose(np.asarray(out[1]), np.asarray(out0[1]),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_executor_explicit_comm_overlap_and_policies(dp8_mesh):
+    """hierarchical/multipath + comm_overlap ride the executor path
+    too (overlap = backward-order bucket issue inside the trace)."""
+    prog, startup, loss, _ = _dp_program()
+    ref, _, _ = _run_executor(prog, startup, [loss], dp8_mesh)
+    for kw in (dict(comm_policy="hierarchical", comm_hosts=2),
+               dict(comm_policy="multipath", comm_hosts=2),
+               dict(comm_policy="fused", comm_overlap=True)):
+        with flags_guard(**kw):
+            got, exe, _ = _run_executor(prog, startup, [loss], dp8_mesh)
+        assert exe.stats["comm_path"] == "explicit", kw
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_executor_explicit_ineligible_falls_back(dp8_mesh):
+    """A fetch with no sound per-shard assembly (non-scalar, non-batch)
+    degrades to the plain GSPMD jit with a recorded comm_degraded event
+    — never a dead job."""
+    prog, startup, loss, _ = _dp_program()
+    w_name = prog.all_parameters()[0].name
+    w_var = prog.global_block().var(w_name)
+    ref, _, _ = _run_executor(prog, startup, [loss, w_var], dp8_mesh)
+    with flags_guard(comm_policy="fused", comm_hosts=2):
+        got, exe, _ = _run_executor(prog, startup, [loss, w_var],
+                                    dp8_mesh)
+    assert exe.stats["comm_path"] == "model"
+    evs = R.events(kind="comm_degraded", site="comm.gspmd")
+    assert evs
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_executor_comm_path_not_sticky(dp8_mesh):
+    """An earlier explicit-path compile must not leave stats claiming
+    'explicit' for a LATER ineligible program on the same Executor."""
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.parallel import data_parallel
+    prog, startup, loss, _ = _dp_program()
+    w_var = prog.global_block().var(prog.all_parameters()[0].name)
+    scope = Scope()
+    xs, ys = _mlp_data()
+    with flags_guard(comm_policy="fused", comm_hosts=2):
+        ctx = data_parallel(dp8_mesh)
+        exe = pt.Executor(pt.CPUPlace(), dist_context=ctx)
+        exe.run(startup, scope=scope)
+        exe.run(prog, feed={"x": xs, "y": ys[:, None]},
+                fetch_list=[loss], scope=scope)
+        assert exe.stats["comm_path"] == "explicit"
+        # new fetch set -> new compile; the param fetch is ineligible
+        exe.run(prog, feed={"x": xs, "y": ys[:, None]},
+                fetch_list=[loss, w_var], scope=scope)
+        assert exe.stats["comm_path"] == "model"
+
+
+def test_executor_gspmd_flag_forces_model_path(dp8_mesh):
+    prog, startup, loss, _ = _dp_program()
+    with flags_guard(comm_policy="fused", comm_gspmd=False):
+        _, exe, _ = _run_executor(prog, startup, [loss], dp8_mesh)
+    assert exe.stats["comm_path"] == "model"
