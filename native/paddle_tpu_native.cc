@@ -328,6 +328,10 @@ int master_task_finished(void* h, int64_t id) {
   return 0;
 }
 
+// returns 1 when this failure exhausted failure_max and the task was
+// dropped, 0 when it was re-queued, -1 for an unknown/expired lease —
+// the drop decision is made here, under the lock, so RPC callers never
+// need a racy counts()-delta to learn it
 int master_task_failed(void* h, int64_t id) {
   auto* m = static_cast<Master*>(h);
   std::lock_guard<std::mutex> g(m->mu);
@@ -336,10 +340,11 @@ int master_task_failed(void* h, int64_t id) {
   Task t = std::move(it->second.first);
   m->pending.erase(it);
   t.failures++;
-  if (t.failures >= m->failure_max)
+  if (t.failures >= m->failure_max) {
     m->failed.push_back(std::move(t));
-  else
-    m->todo.push_back(std::move(t));
+    return 1;
+  }
+  m->todo.push_back(std::move(t));
   return 0;
 }
 

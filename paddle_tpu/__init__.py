@@ -57,6 +57,7 @@ from . import inference  # noqa: F401
 from . import serving  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import resilience  # noqa: F401
+from . import elastic  # noqa: F401
 from .io import (  # noqa: F401
     save_vars, save_params, save_persistables, load_vars, load_params,
     load_persistables, save_inference_model, load_inference_model,

@@ -456,12 +456,40 @@ def load_latest(root, main_program=None, scope=None, dist_context=None):
     """Load the newest loadable COMPLETE checkpoint under ``root`` (the
     retention layout ``save_checkpoint(keep_last=)`` writes), falling
     back past corrupt ones. Returns (dirname_actually_loaded, step) or
-    None when the root holds no complete checkpoint."""
+    None when the root holds no complete checkpoint.
+
+    Tolerant of concurrent prunes (the resume path the elastic
+    supervisor exercises while an async save's retention prune runs):
+    when the newest checkpoint vanishes between ``latest_checkpoint``
+    and the manifest read, the scan falls through to the next-newest
+    complete root instead of raising."""
     from .core import ir
 
-    newest = latest_checkpoint(root)
-    if newest is None:
-        return None
     program = main_program or ir.default_main_program()
-    return _load_with_fallback(newest, program, scope or global_scope(),
-                               dist_context, True, True)
+    scope = scope or global_scope()
+    tried = set()
+    while True:
+        newest = latest_checkpoint(root)
+        if newest is None:
+            return None
+        if newest in tried:
+            # the same entry came back after failing once: not a
+            # prune race — surface the real error below
+            return _load_with_fallback(newest, program, scope,
+                                       dist_context, True, True)
+        tried.add(newest)
+        try:
+            return _load_with_fallback(newest, program, scope,
+                                       dist_context, True, True)
+        except (IOError, OSError) as e:
+            # CheckpointCorruption subclasses IOError but is already
+            # handled (with its own fallback walk) inside
+            # _load_with_fallback — reaching here corrupt means the
+            # whole retention history is bad; don't re-scan
+            if isinstance(e, CheckpointCorruption):
+                raise
+            if os.path.isdir(newest):
+                raise  # dir still there: a real read error, not a prune
+            record_event("checkpoint_pruned_during_load",
+                         site="checkpoint.load", bad=newest)
+            # vanished under us: re-scan for the next-newest complete

@@ -526,17 +526,19 @@ def main(argv=None):
 
     # the reference exposed cluster fan-out through the same binary
     # (`paddle train/pserver`, scripts/cluster_train); mirror that shape
+    from .launch import add_launch_arguments
     ln = sub.add_parser(
-        "launch", help="multi-process launcher (see paddle_tpu.launch)")
-    ln.add_argument("--nprocs", type=int, required=True)
-    ln.add_argument("--coordinator", required=True)
+        "launch", help="multi-process launcher — fail-fast or "
+                       "--elastic survive-and-resize (see "
+                       "paddle_tpu.launch / paddle_tpu.elastic)")
+    add_launch_arguments(ln)
     ln.add_argument("script_argv", nargs=argparse.REMAINDER)
 
     def cmd_launch(args):
-        from .launch import launch
+        from .launch import _shell_rc, run_from_args
         if not args.script_argv:
             p.error("launch: missing training script")
-        return launch(args.nprocs, args.coordinator, args.script_argv)
+        return _shell_rc(run_from_args(args, args.script_argv))
 
     ln.set_defaults(fn=cmd_launch)
 

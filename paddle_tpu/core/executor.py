@@ -671,6 +671,10 @@ class Executor(object):
         # (comm_* stats measured from the traced plan), "model" = GSPMD
         # owns the schedule and comm_* is the byte model, "" = no DP
         # sync compiled yet
+        # the elastic_* entries mirror paddle_tpu.elastic's process-level
+        # counters (world resizes, lost ranks, requeued dataset tasks,
+        # cross-world resume latency) folded in by
+        # elastic.record_stats(stats=exe.stats)
         self.stats = {"jit_runs": 0, "eager_runs": 0, "hybrid_runs": 0,
                       "lazy_fetches": 0, "fetch_sync_count": 0,
                       "compile_cache_hits": 0, "feed_wait_ms": 0.0,
@@ -678,7 +682,10 @@ class Executor(object):
                       "comm_buckets": 0, "comm_quant_fallbacks": 0,
                       "comm_path": "",
                       "tune_hits": 0, "tune_misses": 0,
-                      "tune_fallbacks": 0}
+                      "tune_fallbacks": 0,
+                      "elastic_resizes": 0, "elastic_lost_ranks": 0,
+                      "elastic_requeued_tasks": 0,
+                      "elastic_resume_ms": 0.0}
         # programs whose trace hit data-dependent control flow: run eager
         self._force_eager = set()
         # (uid, version) pairs already checked by the pre-trace verifier

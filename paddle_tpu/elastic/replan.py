@@ -1,0 +1,142 @@
+"""Mesh/comm re-planning for a (survivor) world.
+
+When the elastic supervisor shrinks the world from N to S workers, the
+job cannot simply rerun its old build: the data axis changed size, the
+hierarchical all-reduce's (host, chip) ``axis_index_groups`` were
+computed for N hosts (HiCCL's factorisation, arxiv.org/pdf/2408.05962),
+and a stale compile keyed on the old comm flags would silently sync
+over groups that no longer exist. ``replan`` recomputes all of it for
+the survivor set:
+
+- the (host, chip) factorisation: ``hosts = world_size`` (one process
+  per host, the launcher's shape), ``dp = world_size * chips_per_host``;
+- the resolved :class:`paddle_tpu.comm.CommPolicy` for the new axis
+  size (bucketing/quant crossovers re-evaluated at the new n);
+- the rebuilt hierarchical/multipath ``axis_index_groups`` (via
+  ``comm.hierarchical.topology_groups`` — summarised in the plan for
+  audit);
+- ``apply_flags()`` pushes ``comm_hosts`` into FLAGS so BOTH step
+  builders see the new topology: ``data_parallel_step_fn`` re-traces at
+  the new dp size (a fresh build per plan), and the Executor's GSPMD
+  path re-keys its jit cache through ``_comm_flags_sig`` — the shrunk
+  world cannot hit a stale compile.
+
+Fault site ``elastic.replan``: a raise degrades the plan to the flat
+``hosts=1`` factorisation (topology-blind but always correct) with a
+recorded ``elastic_degraded`` event — re-planning is an optimisation,
+never a correctness dependency.
+"""
+from __future__ import annotations
+
+from ..resilience import fault_point, record_event
+
+__all__ = ["ElasticPlan", "replan"]
+
+
+class ElasticPlan(object):
+    """Resolved topology + comm plan for one world size (immutable)."""
+
+    __slots__ = ("world_size", "chips_per_host", "hosts", "dp", "policy",
+                 "degraded")
+
+    def __init__(self, world_size, chips_per_host, hosts, policy,
+                 degraded=False):
+        self.world_size = int(world_size)
+        self.chips_per_host = int(chips_per_host)
+        self.hosts = int(hosts)
+        self.dp = self.world_size * self.chips_per_host
+        self.policy = policy
+        self.degraded = bool(degraded)
+
+    def groups(self):
+        """(intra-host groups, inter-host ring pairs) the hierarchical
+        composition will use over this plan's data axis — the
+        ``axis_index_groups`` rebuilt for the survivor set."""
+        from ..comm.hierarchical import topology_groups
+        hosts = max(self.policy.hosts, 1)
+        return topology_groups(hosts, self.dp // hosts)
+
+    def cache_signature(self):
+        """The comm fingerprint a compile under this plan embeds; two
+        plans with different signatures can never share a jit cache
+        entry (the Executor joins the same fields via
+        ``_comm_flags_sig`` once ``apply_flags`` ran)."""
+        return (self.dp,) + self.policy.key()
+
+    def apply_flags(self):
+        """Install the plan's topology into the process flags (the one
+        mutable step — everything downstream reads flags at build time).
+        Returns self for chaining."""
+        from ..flags import FLAGS
+        FLAGS.comm_hosts = self.policy.hosts
+        return self
+
+    def make_mesh(self, axis="dp", devices=None):
+        """Fresh dp mesh of this plan's size (local virtual devices on
+        CPU, the global device set on a pod)."""
+        from ..parallel.mesh import make_mesh
+        return make_mesh({axis: self.dp}, devices=devices)
+
+    def step_fn(self, loss_fn, axis="dp", devices=None, **kw):
+        """``data_parallel_step_fn`` re-traced for this plan's dp size
+        and policy — the jax-level re-plan consumer. Pass ``devices=``
+        when the plan is a sub-mesh of the local device set (the
+        shrunk-world case on a forced CPU mesh)."""
+        from ..parallel.api import data_parallel_step_fn
+        return data_parallel_step_fn(
+            loss_fn, mesh=self.make_mesh(axis, devices=devices),
+            axis_name=axis, policy=self.policy, **kw)
+
+    def summary(self):
+        intra, ring = self.groups()
+        return {
+            "world_size": self.world_size,
+            "chips_per_host": self.chips_per_host,
+            "hosts": self.hosts,
+            "dp": self.dp,
+            "degraded": self.degraded,
+            "policy": {"base": self.policy.base,
+                       "quant": self.policy.quant,
+                       "hosts": self.policy.hosts,
+                       "bucket_bytes": self.policy.bucket_bytes},
+            "intra_groups": len(intra),
+            "ring_pairs": len(ring),
+            "cache_signature": list(map(str, self.cache_signature())),
+        }
+
+    def __repr__(self):
+        return ("ElasticPlan(world=%d, hosts=%d, dp=%d, policy=%r%s)"
+                % (self.world_size, self.hosts, self.dp, self.policy,
+                   ", DEGRADED" if self.degraded else ""))
+
+
+def replan(world_size, chips_per_host=1, base=None, quant=None,
+           bucket_mb=None, split_ratio=None):
+    """Recompute the (host, chip) factorisation + comm policy for a
+    world of ``world_size`` processes with ``chips_per_host`` local
+    chips each. Unset policy fields resolve from flags (the same
+    resolution every step builder uses), EXCEPT ``hosts`` which this
+    function owns — that is the re-plan."""
+    from .. import comm
+
+    world_size = int(world_size)
+    if world_size < 1:
+        raise ValueError("world_size must be >= 1, got %d" % world_size)
+    chips_per_host = max(int(chips_per_host), 1)
+    dp = world_size * chips_per_host
+    degraded = False
+    try:
+        fault_point("elastic.replan")
+        hosts = world_size
+    except Exception as e:
+        # topology-blind flat plan: hierarchical degenerates to the
+        # whole-axis reduce-scatter + all-gather — correct, just not
+        # routed; the job keeps training
+        record_event("elastic_degraded", site="elastic.replan",
+                     error=str(e), world_size=world_size)
+        hosts, degraded = 1, True
+    policy = comm.resolve_policy(base=base, bucket_mb=bucket_mb,
+                                 quant=quant, hosts=hosts,
+                                 split_ratio=split_ratio, axis_size=dp)
+    return ElasticPlan(world_size, chips_per_host, hosts, policy,
+                       degraded=degraded)

@@ -1,0 +1,159 @@
+"""Cross-world resume: checkpoint + task-master snapshot, as ONE point.
+
+A resumed world must agree with itself twice over: the model state
+(parameters + optimizer accumulators, re-sharded onto the possibly
+SMALLER survivor mesh by ``checkpoint.load_checkpoint``'s
+``dist_context=`` path) and the data pass (which dataset tasks are
+still owed). The reference solved this with the Go master's etcd
+snapshot next to the pserver checkpoint (PAPER.md §Go runtime,
+go/master/service.go:313-366); here the pairing is explicit on disk:
+
+- the trainer writes, per checkpoint step, the task-master snapshot
+  FIRST (``<root>/.master-<step>.snap``), then the checkpoint
+  (``ckpt-<step>``), then moves the snapshot inside the checkpoint dir
+  as ``master.snap``;
+- ``resume_point(root)`` picks the newest COMPLETE checkpoint and its
+  step-PAIRED snapshot (in-dir first, root-level by step second) — a
+  newer orphan snapshot from a step whose checkpoint never completed
+  is ignored, so restoring it can never re-queue a task the resumed
+  model already contains (the double-processing window) nor drop one
+  it does not (the lost-task window).
+
+Every crash window lands on a consistent pair: whichever of
+{checkpoint, snapshot} did not make it to step k, the resume point is
+the step-(k-1) pair and the k-th task re-runs exactly once in the
+resumed timeline.
+
+Fault site ``elastic.resume``: a raise marks the newest pair unusable
+and the walk falls through to the next-older complete pair, with a
+recorded ``elastic_degraded`` event.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import re
+import time
+
+from ..resilience import fault_point, record_event
+
+__all__ = ["ResumePoint", "resume_point", "resume", "snapshot_path",
+           "pair_snapshot", "record_stats", "SNAP_IN_DIR"]
+
+SNAP_IN_DIR = "master.snap"
+_CKPT_RE = re.compile(r"^ckpt-(\d{8})$")
+
+ResumePoint = collections.namedtuple(
+    "ResumePoint", ["ckpt_dir", "step", "snapshot"])
+
+
+def snapshot_path(root, step):
+    """Root-level snapshot path for ``step`` — where the trainer writes
+    it before the checkpoint lands (then moves it in-dir)."""
+    return os.path.join(root, ".master-%08d.snap" % int(step))
+
+
+def ckpt_step(ckpt_dir):
+    """Step encoded in a retention checkpoint dir name, or None."""
+    m = _CKPT_RE.match(os.path.basename(os.path.abspath(ckpt_dir)))
+    return int(m.group(1)) if m else None
+
+
+def pair_snapshot(ckpt_dir):
+    """The task-master snapshot PAIRED with ``ckpt_dir`` — in-dir
+    ``master.snap`` first, else the root-level snapshot with the SAME
+    step (never a newer orphan), else None."""
+    indir = os.path.join(ckpt_dir, SNAP_IN_DIR)
+    if os.path.exists(indir):
+        return indir
+    step = ckpt_step(ckpt_dir)
+    if step is None:
+        return None
+    root_level = snapshot_path(os.path.dirname(os.path.abspath(ckpt_dir)),
+                               step)
+    return root_level if os.path.exists(root_level) else None
+
+
+def resume_point(root):
+    """Newest consistent (checkpoint, snapshot) pair under ``root``:
+    a ResumePoint, or None when the root holds no complete checkpoint.
+    ``snapshot`` is None when no paired snapshot exists (a job that ran
+    without a task master resumes the model alone)."""
+    from .. import checkpoint as _ckpt
+
+    skip = set()
+    while True:
+        cands = []
+        if os.path.isdir(root):
+            for d in os.listdir(root):
+                p = os.path.join(root, d)
+                if p in skip or not _CKPT_RE.match(d):
+                    continue
+                if not os.path.isdir(p) or not _ckpt._is_complete(p):
+                    continue
+                mt = _ckpt._mtime_or_none(p)
+                if mt is not None:
+                    cands.append((mt, p))
+        if not cands:
+            return None
+        newest = max(cands)[1]
+        try:
+            fault_point("elastic.resume")
+        except Exception as e:
+            record_event("elastic_degraded", site="elastic.resume",
+                         error=str(e), skipped=newest)
+            skip.add(newest)
+            continue
+        return ResumePoint(newest, ckpt_step(newest),
+                           pair_snapshot(newest))
+
+
+def resume(root, main_program=None, scope=None, dist_context=None):
+    """Restore the newest consistent checkpoint onto the CURRENT mesh
+    (``dist_context`` may describe a smaller survivor world than the
+    saving one — persistables re-shard/replicate on load, optimizer
+    state included) and return the ResumePoint actually loaded, or None
+    when there is nothing to resume. Records an ``elastic_resume``
+    event and the resume latency in the profiler's elastic counters."""
+    from .. import checkpoint as _ckpt
+    from .. import profiler as _prof
+    from ..core import ir
+    from ..core.scope import global_scope
+
+    rp = resume_point(root)
+    if rp is None:
+        return None
+    program = main_program or ir.default_main_program()
+    t0 = time.perf_counter()
+    used, step = _ckpt._load_with_fallback(
+        rp.ckpt_dir, program, scope or global_scope(), dist_context,
+        True, True)
+    dt_ms = (time.perf_counter() - t0) * 1e3
+    if used != rp.ckpt_dir:
+        # corruption fallback walked past the chosen pair: re-pair the
+        # snapshot with what was actually loaded (degraded but
+        # consistent — the older pair)
+        rp = ResumePoint(used, ckpt_step(used) if ckpt_step(used)
+                         is not None else step, pair_snapshot(used))
+    elif rp.step is None:
+        rp = ResumePoint(used, step, rp.snapshot)
+    _prof.update_elastic_counters(elastic_resumes=1,
+                                  elastic_resume_ms=dt_ms)
+    record_event("elastic_resume", site="elastic.resume",
+                 ckpt_dir=rp.ckpt_dir, step=rp.step,
+                 snapshot=rp.snapshot, latency_ms=round(dt_ms, 3))
+    return rp
+
+
+def record_stats(stats):
+    """Fold the process-level elastic counters into an ``Executor.stats``
+    dict (the comm.record_step_stats convention)."""
+    from .. import profiler as _prof
+
+    c = _prof.elastic_counters()
+    stats["elastic_resizes"] = int(c.get("elastic_resizes", 0))
+    stats["elastic_lost_ranks"] = int(c.get("elastic_lost_ranks", 0))
+    stats["elastic_requeued_tasks"] = int(
+        c.get("elastic_requeued_tasks", 0))
+    stats["elastic_resume_ms"] = float(c.get("elastic_resume_ms", 0.0))
+    return stats

@@ -1,0 +1,396 @@
+"""The elasticity coordinator: survive worker loss, re-plan, resume.
+
+``paddle_tpu.launch``'s original contract was the reference launcher's
+fail-fast job abort (any worker dies -> the job dies). This module is
+the other posture the reference's Go runtime established (PAPER.md §Go
+runtime: etcd task queue, master snapshots, pserver re-registration):
+a supervisor that treats worker death as an EVENT to classify, not a
+verdict —
+
+- **transient** (non-zero exit: an app crash, an OOM, a flaky node):
+  relaunch the gang at FULL world size, spending a bounded restart
+  budget on the resilience ``RetryPolicy`` backoff schedule;
+- **permanent** (signal death — the machine is gone — or the budget is
+  spent): shrink the world by the lost rank, re-queue its leased
+  dataset tasks through the task master (restored from the snapshot
+  PAIRED with the checkpoint the survivors will resume from, see
+  :mod:`.resume`), record an ``elastic_resize`` degradation event, and
+  relaunch the survivors — the job only dies when the quorum
+  (``min_workers``) is gone.
+
+Worker LIVENESS decisions ride process exit (event-driven ``wait``, no
+busy-polling); the task-master worker registry's heartbeats
+(``v2.master.client(worker_name=...)``) inform the health sweep but
+never kill a job on their own — a flaky probe must not look like a
+dead machine (fault site ``elastic.heartbeat`` proves that). A hung
+worker cannot wedge the supervisor either: gang stops escalate
+SIGTERM -> SIGKILL after a ``grace_sec`` drain window.
+
+Every generation gets a FRESH coordinator port and a re-planned world
+(the workers re-run :func:`paddle_tpu.elastic.replan.replan` for the
+survivor count); the supervisor's own audit trail lands in
+``resilience.events()`` and, when ``state_dir`` is set, in
+``<state_dir>/events.jsonl`` + per-generation ``workers-gen<g>.json``
+(world size, pids, addresses) — which is also how an external chaos
+driver aims its kills (benchmark/chaos_run.py).
+"""
+from __future__ import annotations
+
+import json
+import os
+import queue
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+from ..resilience import RetryPolicy, record_event
+from ..resilience.faults import fault_point
+
+__all__ = ["ElasticSupervisor", "TaskMasterHost", "Gang", "free_port"]
+
+
+def free_port(host="127.0.0.1"):
+    """A currently-free TCP port on ``host`` (each elastic generation
+    gets a fresh coordinator address: a dead generation's lingering
+    socket state must not wedge the next barrier init)."""
+    with socket.socket() as sk:
+        sk.bind((host, 0))
+        return sk.getsockname()[1]
+
+
+class TaskMasterHost(object):
+    """A served native TaskMaster owned by the supervisor — the etcd/Go
+    master role: it OUTLIVES worker generations, so the dataset pass
+    survives a resize. ``restore_from`` swaps in a fresh master rebuilt
+    from a snapshot (the state paired with the checkpoint the survivors
+    resume from) on a fresh port."""
+
+    def __init__(self, tasks, timeout_sec=60.0, failure_max=3,
+                 host="127.0.0.1"):
+        from ..native import TaskMaster
+        self.timeout_sec = float(timeout_sec)
+        self.failure_max = int(failure_max)
+        self._host = host
+        self._master = TaskMaster(failure_max=self.failure_max,
+                                  timeout_sec=self.timeout_sec)
+        for t in tasks:
+            self._master.add_task(t if isinstance(t, bytes)
+                                  else str(t).encode("utf-8"))
+        self.port = self._master.serve(0)
+        self.addr = "%s:%d" % (host, self.port)
+
+    def counts(self):
+        return self._master.counts()
+
+    def worker_count(self):
+        return self._master.worker_count()
+
+    def restore_from(self, snap_path):
+        """Replace the queue with the snapshot's todo+pending set (leased
+        tasks re-queued re-runnable) on a FRESH port. Returns the task
+        count restored."""
+        from ..native import TaskMaster
+        fresh = TaskMaster(failure_max=self.failure_max,
+                           timeout_sec=self.timeout_sec)
+        n = fresh.restore(snap_path)
+        port = fresh.serve(0)
+        old, self._master = self._master, fresh
+        self.port, self.addr = port, "%s:%d" % (self._host, port)
+        old.close()
+        return n
+
+    def close(self):
+        if self._master is not None:
+            self._master.close()
+            self._master = None
+
+
+class Gang(object):
+    """One generation's worker processes, waited event-driven: a daemon
+    thread per worker blocks in ``Popen.wait`` and queues ``(rank,
+    rc)`` — the supervisor sleeps on the queue, never busy-polls."""
+
+    def __init__(self, argv, envs, python=None):
+        python = python or sys.executable
+        self._procs = []
+        self._exits = queue.Queue()
+        for rank, env in enumerate(envs):
+            p = subprocess.Popen([python] + list(argv), env=env)
+            self._procs.append(p)
+            t = threading.Thread(target=self._reap, args=(rank, p),
+                                 daemon=True)
+            t.start()
+
+    def _reap(self, rank, p):
+        self._exits.put((rank, p.wait()))
+
+    def next_exit(self, timeout=None):
+        """Next ``(rank, rc)``, or None after ``timeout`` seconds."""
+        try:
+            return self._exits.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def pids(self):
+        return {rank: p.pid for rank, p in enumerate(self._procs)}
+
+    def live(self):
+        return [r for r, p in enumerate(self._procs) if p.poll() is None]
+
+    def stop(self, grace_sec=10.0):
+        """Drain the gang: SIGTERM everyone still alive (the trainers'
+        preemption hook turns that into a final checkpoint), then
+        escalate to SIGKILL after ``grace_sec`` — a worker wedged in a
+        dead collective cannot hold the supervisor hostage. Returns
+        {rank: rc} with the REAL exit codes (negative = signal)."""
+        for p in self._procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGTERM)
+                except (ProcessLookupError, OSError):
+                    pass
+        deadline = time.monotonic() + max(float(grace_sec), 0.0)
+        rcs = {}
+        for rank, p in enumerate(self._procs):
+            remaining = deadline - time.monotonic()
+            try:
+                rcs[rank] = p.wait(timeout=max(remaining, 0.0))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                rcs[rank] = p.wait()
+        return rcs
+
+
+class ElasticSupervisor(object):
+    """Run ``script_argv`` as an elastic multi-process job.
+
+    Parameters mirror the ``paddle_tpu.launch --elastic`` CLI:
+    ``min_workers`` (the quorum), ``restart_budget`` (transient
+    full-world relaunches), ``grace_sec`` (SIGTERM drain window before
+    SIGKILL). ``master_tasks`` (payload list) turns on the supervisor-
+    owned task master (workers find it at ``PADDLE_TPU_MASTER_ADDR``);
+    ``snapshot_root`` points at the checkpoint retention root so a
+    resize restores the master from the snapshot PAIRED with the
+    checkpoint the survivors will load (:mod:`paddle_tpu.elastic.resume`).
+    """
+
+    def __init__(self, nprocs, coordinator, script_argv, min_workers=None,
+                 restart_budget=None, grace_sec=10.0, env=None, python=None,
+                 state_dir=None, master_tasks=None, master_timeout_sec=60.0,
+                 master_failure_max=3, snapshot_root=None,
+                 sweep_interval=None):
+        from ..flags import FLAGS
+        if nprocs < 1:
+            raise ValueError("nprocs must be >= 1, got %d" % nprocs)
+        self.nprocs = int(nprocs)
+        self.coordinator_host = (coordinator or "127.0.0.1").partition(
+            ":")[0] or "127.0.0.1"
+        self.script_argv = list(script_argv)
+        self.min_workers = int(min_workers if min_workers is not None
+                               else FLAGS.elastic_min_workers)
+        self.restart_budget = int(restart_budget if restart_budget
+                                  is not None
+                                  else FLAGS.elastic_restart_budget)
+        if self.min_workers < 1:
+            raise ValueError("min_workers must be >= 1, got %d"
+                             % self.min_workers)
+        self.grace_sec = float(grace_sec)
+        self.base_env = dict(env if env is not None else os.environ)
+        self.python = python
+        self.state_dir = state_dir
+        self.master_tasks = master_tasks
+        self.master_timeout_sec = float(master_timeout_sec)
+        self.master_failure_max = int(master_failure_max)
+        self.snapshot_root = snapshot_root
+        self.sweep_interval = (float(sweep_interval)
+                               if sweep_interval is not None
+                               else min(1.0, self.master_timeout_sec / 4.0))
+        self._failed_seen = 0
+
+    # -- audit trail --------------------------------------------------------
+    def _event(self, kind, **info):
+        ev = record_event(kind, site="elastic.supervisor", **info)
+        if self.state_dir:
+            os.makedirs(self.state_dir, exist_ok=True)
+            with open(os.path.join(self.state_dir, "events.jsonl"),
+                      "a") as f:
+                f.write(json.dumps(ev) + "\n")
+        return ev
+
+    def _write_gen_state(self, generation, world, gang, coordinator,
+                         master):
+        if not self.state_dir:
+            return
+        os.makedirs(self.state_dir, exist_ok=True)
+        path = os.path.join(self.state_dir,
+                            "workers-gen%d.json" % generation)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"generation": generation, "world": world,
+                       "pids": gang.pids(), "coordinator": coordinator,
+                       "master_addr": master.addr if master else None},
+                      f)
+        os.replace(tmp, path)
+
+    # -- worker environment -------------------------------------------------
+    def _rank_env(self, rank, world, generation, coordinator, master):
+        e = dict(self.base_env)
+        e["PADDLE_TPU_COORDINATOR"] = coordinator
+        e["PADDLE_TPU_NUM_PROCESSES"] = str(world)
+        e["PADDLE_TPU_PROCESS_ID"] = str(rank)
+        e["PADDLE_TPU_ELASTIC"] = "1"
+        e["PADDLE_TPU_ELASTIC_GENERATION"] = str(generation)
+        if self.state_dir:
+            e["PADDLE_TPU_ELASTIC_STATE"] = self.state_dir
+        if master is not None:
+            e["PADDLE_TPU_MASTER_ADDR"] = master.addr
+            e["PADDLE_TPU_MASTER_TIMEOUT"] = str(self.master_timeout_sec)
+        return e
+
+    # -- health sweep -------------------------------------------------------
+    def _sweep(self, master):
+        """Periodic health pass between exit events: the heartbeat/
+        registry probe (fault site ``elastic.heartbeat`` — a raise is
+        counted + recorded, never fatal) and the task-master reclaim
+        tick (``counts()`` re-queues expired leases server-side; a
+        failure-cap drop surfaces as a ``task_dropped`` event)."""
+        from .. import profiler as _prof
+        try:
+            fault_point("elastic.heartbeat")
+        except Exception as e:
+            _prof.update_elastic_counters(elastic_heartbeat_failures=1)
+            self._event("elastic_heartbeat_failed", error=str(e))
+            return
+        if master is None:
+            return
+        try:
+            c = master.counts()
+        except Exception as e:  # master RPC hiccup: inform, don't kill
+            _prof.update_elastic_counters(elastic_heartbeat_failures=1)
+            self._event("elastic_heartbeat_failed", error=str(e))
+            return
+        if c["failed"] > self._failed_seen:
+            self._event("task_dropped",
+                        n=c["failed"] - self._failed_seen,
+                        failed_total=c["failed"])
+            self._failed_seen = c["failed"]
+
+    def _restore_master(self, master):
+        """Re-align the task queue with the checkpoint the relaunched
+        workers will resume from: restore from the snapshot PAIRED
+        with the resume point (:mod:`.resume`), so tasks finished
+        after that checkpoint are re-leased — their model
+        contributions roll back with the model state on EVERY
+        relaunch, transient restarts included, not just resizes.
+        Returns the restored task count, or None when no pair exists
+        yet (then the dead worker's leases simply expire server-side)."""
+        if master is None:
+            return None
+        snap = None
+        if self.snapshot_root:
+            from .resume import resume_point
+            rp = resume_point(self.snapshot_root)
+            snap = rp.snapshot if rp is not None else None
+        if not snap:
+            return None
+        n = master.restore_from(snap)
+        self._event("elastic_master_restore", snapshot=snap, tasks=n)
+        return n
+
+    # -- the generation loop ------------------------------------------------
+    def run(self):
+        from .. import profiler as _prof
+
+        master = None
+        if self.master_tasks is not None:
+            master = TaskMasterHost(self.master_tasks,
+                                    timeout_sec=self.master_timeout_sec,
+                                    failure_max=self.master_failure_max,
+                                    host=self.coordinator_host)
+        world = self.nprocs
+        generation = 0
+        transient_used = 0
+        gang = None
+        retry = RetryPolicy(max_attempts=self.restart_budget + 1,
+                            backoff=0.5, multiplier=2.0, max_backoff=10.0,
+                            jitter=0.1, seed=0, name="elastic.restart")
+        try:
+            while True:
+                coordinator = "%s:%d" % (self.coordinator_host,
+                                         free_port(self.coordinator_host))
+                envs = [self._rank_env(r, world, generation, coordinator,
+                                       master) for r in range(world)]
+                gang = Gang(self.script_argv, envs, python=self.python)
+                self._write_gen_state(generation, world, gang,
+                                      coordinator, master)
+                self._event("elastic_generation", generation=generation,
+                            world=world, coordinator=coordinator)
+                done, failed = set(), None
+                while len(done) < world and failed is None:
+                    item = gang.next_exit(timeout=self.sweep_interval)
+                    if item is None:
+                        self._sweep(master)
+                        continue
+                    rank, rc = item
+                    if rc == 0:
+                        done.add(rank)
+                    else:
+                        failed = (rank, rc)
+                if failed is None:
+                    self._event("elastic_job_complete",
+                                generation=generation, world=world)
+                    return 0
+                rank, rc = failed
+                # the dead worker's leased tasks: what a resize re-queues
+                pending = 0
+                if master is not None:
+                    try:
+                        pending = master.counts()["pending"]
+                    except Exception:
+                        pending = 0
+                self._event("elastic_worker_exit", rank=rank, rc=rc,
+                            generation=generation, world=world)
+                gang.stop(self.grace_sec)  # drain + escalate survivors
+                permanent = rc < 0 or transient_used >= self.restart_budget
+                if not permanent:
+                    transient_used += 1
+                    delay = retry.delay(transient_used)
+                    self._event("elastic_restart", rank=rank, rc=rc,
+                                attempt=transient_used,
+                                backoff_sec=round(delay, 3),
+                                generation=generation)
+                    _prof.update_elastic_counters(elastic_restarts=1)
+                    self._restore_master(master)
+                    time.sleep(delay)
+                    generation += 1
+                    continue
+                new_world = world - 1
+                if new_world < self.min_workers:
+                    self._event("elastic_quorum_lost", world=world,
+                                min_workers=self.min_workers, rank=rank,
+                                rc=rc)
+                    return rc
+                requeued = pending
+                n = self._restore_master(master)
+                if n is not None:
+                    requeued = n
+                self._event("elastic_resize", generation=generation,
+                            from_world=world, to_world=new_world,
+                            lost_rank=rank, rc=rc,
+                            requeued_tasks=requeued)
+                _prof.update_elastic_counters(
+                    elastic_resizes=1, elastic_lost_ranks=1,
+                    elastic_requeued_tasks=requeued)
+                world = new_world
+                generation += 1
+        finally:
+            # an exception anywhere in the generation loop must not
+            # leak the current gang as orphan workers (cheap no-op
+            # when they already exited)
+            if gang is not None:
+                gang.stop(self.grace_sec)
+            if master is not None:
+                master.close()

@@ -347,6 +347,29 @@ DEFINE_int32("tune_budget", 0,
              "cap on candidates the autotune loop compiles+times per "
              "(kernel, shape), stock-XLA rung included; 0 = the full "
              "valid space. The CLI's --budget overrides per run")
+DEFINE_bool("elastic", False,
+            "default supervision mode for paddle_tpu.launch: True turns "
+            "the launcher's fail-fast job abort into survive-and-resize "
+            "(paddle_tpu.elastic) — on worker death the supervisor "
+            "classifies the loss (signal death = permanent, crash exit = "
+            "transient while the restart budget lasts), re-queues the "
+            "dead worker's leased dataset tasks through the task master, "
+            "re-plans the (host, chip) comm factorisation for the "
+            "survivor set, and relaunches the job on the survivors from "
+            "load_latest + the paired task-master snapshot, recording an "
+            "elastic_resize event — the job only dies when the quorum "
+            "(elastic_min_workers) is gone. CLI --elastic overrides")
+DEFINE_int32("elastic_min_workers", 1,
+             "elastic quorum: the smallest world size the supervisor "
+             "will resize down to; one more permanent worker loss below "
+             "this aborts the job with the real exit code (CLI "
+             "--elastic-min-workers overrides)")
+DEFINE_int32("elastic_restart_budget", 2,
+             "how many transient worker failures (non-zero exit, not "
+             "signal death) the elastic supervisor restarts at FULL "
+             "world size before treating the next one as permanent; "
+             "restarts back off on the resilience RetryPolicy schedule "
+             "(CLI --elastic-restart-budget overrides)")
 DEFINE_int32("serve_queue_depth", 64,
              "online serving: bound on requests queued for dispatch "
              "across all models; request queue_depth+1 is shed "

@@ -10,73 +10,193 @@ coordinator, and propagates failure — the moral equivalent of the
 reference's ssh fan-out, for localhost process counts or as the per-host
 entry point under k8s (see cluster/ for pod specs).
 
+Two supervision modes:
+
+- **fail-fast** (default): any worker's non-zero exit kills the job —
+  the reference launcher's job-abort semantics. Exits are waited
+  event-driven (no busy-poll); shutdown SIGTERMs the survivors and
+  escalates to SIGKILL after ``--grace-sec`` so a hung worker cannot
+  wedge the launcher; the first failing worker's REAL exit code
+  propagates (signal deaths map to the shell convention 128+N).
+- **elastic** (``--elastic`` / ``FLAGS.elastic``): worker death is
+  classified and survived — transient failures restart the gang at
+  full world size on a bounded RetryPolicy backoff budget; permanent
+  losses (signal deaths, exhausted budget) shrink the world to the
+  survivors, re-queue the dead worker's leased dataset tasks through
+  the task master, and relaunch from ``load_latest`` + the paired
+  master snapshot, recording an ``elastic_resize`` event. The job only
+  dies when the quorum (``--elastic-min-workers``) is gone. See
+  :mod:`paddle_tpu.elastic`.
+
 Usage:
   python -m paddle_tpu.launch --nprocs 4 --coordinator HOST:PORT \
+      [--elastic --state-dir DIR --snapshot-root CKPT_ROOT] \
       train.py --your-args
-Workers see PADDLE_TPU_COORDINATOR / _NUM_PROCESSES / _PROCESS_ID, which
-``paddle_tpu.parallel.env.init_distributed()`` consumes.
+Workers see PADDLE_TPU_COORDINATOR / _NUM_PROCESSES / _PROCESS_ID (plus
+PADDLE_TPU_ELASTIC / _ELASTIC_GENERATION / _MASTER_ADDR under
+``--elastic``), which ``paddle_tpu.parallel.env`` consumes and
+validates.
 """
 from __future__ import annotations
 
 import argparse
 import os
-import signal
-import subprocess
 import sys
 
 
-def launch(nprocs, coordinator, script_argv, env=None, python=None):
-    """Spawn ``nprocs`` ranked worker processes; return the first non-zero
-    exit code (killing the rest), or 0. The fail-fast barrier matches the
-    reference launcher's job-abort semantics."""
-    procs = []
+def launch(nprocs, coordinator, script_argv, env=None, python=None,
+           grace_sec=10.0, master_tasks=None, master_timeout_sec=60.0):
+    """Fail-fast mode: spawn ``nprocs`` ranked worker processes; return
+    the first non-zero exit code (stopping the rest: SIGTERM, then
+    SIGKILL after ``grace_sec``), or 0. ``master_tasks`` optionally
+    hosts a launcher-owned task master (payload list) the workers find
+    at ``PADDLE_TPU_MASTER_ADDR`` — the single-generation counterpart
+    of the elastic supervisor's, so fail-fast and elastic runs of the
+    same script are comparable."""
+    from .elastic.supervisor import Gang, TaskMasterHost
+
     base_env = dict(env if env is not None else os.environ)
-    python = python or sys.executable
-    rc = 0
+    master = None
+    if master_tasks is not None:
+        master = TaskMasterHost(master_tasks,
+                                timeout_sec=master_timeout_sec)
     try:
+        envs = []
         for rank in range(nprocs):
             e = dict(base_env)
             e["PADDLE_TPU_COORDINATOR"] = coordinator
             e["PADDLE_TPU_NUM_PROCESSES"] = str(nprocs)
             e["PADDLE_TPU_PROCESS_ID"] = str(rank)
-            procs.append(subprocess.Popen([python] + list(script_argv),
-                                          env=e))
-        remaining = set(range(nprocs))
-        while remaining and rc == 0:
-            for i in list(remaining):
-                r = procs[i].poll()
-                if r is None:
-                    continue
-                remaining.discard(i)
+            if master is not None:
+                e["PADDLE_TPU_MASTER_ADDR"] = master.addr
+                e["PADDLE_TPU_MASTER_TIMEOUT"] = str(master_timeout_sec)
+            envs.append(e)
+        gang = Gang(script_argv, envs, python=python)
+        try:
+            rc, done = 0, set()
+            # event-driven: each exit arrives on the gang's queue;
+            # nothing polls (the old 50ms busy-loop is gone)
+            while len(done) < nprocs:
+                rank, r = gang.next_exit()
                 if r != 0:
                     rc = r
-            if remaining and rc == 0:
-                import time
-                time.sleep(0.05)
+                    break
+                done.add(rank)
+            return rc
+        finally:
+            # every exit path — including an exception in the wait
+            # loop — drains the gang; no orphan workers
+            gang.stop(grace_sec)
     finally:
-        for p in procs:
-            if p.poll() is None:
-                p.send_signal(signal.SIGTERM)
-        for p in procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
-    return rc
+        if master is not None:
+            master.close()
+
+
+def launch_elastic(nprocs, coordinator, script_argv, env=None, python=None,
+                   grace_sec=10.0, min_workers=None, restart_budget=None,
+                   state_dir=None, master_tasks=None,
+                   master_timeout_sec=60.0, snapshot_root=None):
+    """Elastic mode: survive-and-resize supervision (see
+    :class:`paddle_tpu.elastic.ElasticSupervisor` for the full
+    contract). Returns the job's exit code: 0 when a generation
+    completes, the real failing code when the quorum is lost."""
+    from .elastic.supervisor import ElasticSupervisor
+
+    return ElasticSupervisor(
+        nprocs, coordinator, script_argv, min_workers=min_workers,
+        restart_budget=restart_budget, grace_sec=grace_sec, env=env,
+        python=python, state_dir=state_dir, master_tasks=master_tasks,
+        master_timeout_sec=master_timeout_sec,
+        snapshot_root=snapshot_root).run()
+
+
+def _shell_rc(rc):
+    """Popen returncodes are negative for signal deaths; shells expect
+    128+N. The REAL code still propagates either way."""
+    return rc if rc >= 0 else 128 - rc
+
+
+def add_launch_arguments(ap):
+    """The launcher's argument set, shared with the ``paddle_tpu
+    launch`` CLI verb (cli.py)."""
+    from .flags import FLAGS
+    ap.add_argument("--nprocs", type=int, default=1)
+    ap.add_argument("--coordinator", default="127.0.0.1:12355")
+    ap.add_argument("--grace-sec", type=float, default=10.0,
+                    dest="grace_sec",
+                    help="SIGTERM drain window before SIGKILL when "
+                         "stopping workers (a hung worker cannot wedge "
+                         "the launcher)")
+    ap.add_argument("--elastic", action=argparse.BooleanOptionalAction,
+                    default=FLAGS.elastic,
+                    help="survive-and-resize supervision instead of "
+                         "fail-fast job abort (paddle_tpu.elastic); "
+                         "--no-elastic forces fail-fast even when the "
+                         "elastic flag defaults it on")
+    ap.add_argument("--elastic-min-workers", type=int,
+                    default=FLAGS.elastic_min_workers,
+                    dest="elastic_min_workers",
+                    help="quorum: smallest world size a resize may "
+                         "reach; below it the job aborts with the real "
+                         "exit code")
+    ap.add_argument("--elastic-restart-budget", type=int,
+                    default=FLAGS.elastic_restart_budget,
+                    dest="elastic_restart_budget",
+                    help="transient failures restarted at FULL world "
+                         "size (RetryPolicy backoff) before the next "
+                         "one counts as permanent")
+    ap.add_argument("--state-dir", default=None, dest="state_dir",
+                    help="elastic audit-trail directory (events.jsonl "
+                         "+ per-generation worker pid maps)")
+    ap.add_argument("--snapshot-root", default=None, dest="snapshot_root",
+                    help="checkpoint retention root; a resize restores "
+                         "the task master from the snapshot PAIRED "
+                         "with the checkpoint the survivors resume "
+                         "from (paddle_tpu.elastic.resume)")
+    ap.add_argument("--master-tasks-file", default=None,
+                    dest="master_tasks_file",
+                    help="newline-separated task payloads; hosts a "
+                         "launcher-owned task master the workers find "
+                         "at PADDLE_TPU_MASTER_ADDR")
+    ap.add_argument("--master-timeout-sec", type=float, default=60.0,
+                    dest="master_timeout_sec",
+                    help="task-master lease TTL (doubles as the worker "
+                         "registry heartbeat lease)")
+    return ap
+
+
+def run_from_args(args, script_argv):
+    """Dispatch a parsed launcher namespace (shared with cli.py)."""
+    master_tasks = None
+    if args.master_tasks_file:
+        with open(args.master_tasks_file, "rb") as f:
+            master_tasks = [ln for ln in f.read().splitlines() if ln]
+    if args.elastic:
+        return launch_elastic(
+            args.nprocs, args.coordinator, script_argv,
+            grace_sec=args.grace_sec,
+            min_workers=args.elastic_min_workers,
+            restart_budget=args.elastic_restart_budget,
+            state_dir=args.state_dir, master_tasks=master_tasks,
+            master_timeout_sec=args.master_timeout_sec,
+            snapshot_root=args.snapshot_root)
+    return launch(args.nprocs, args.coordinator, script_argv,
+                  grace_sec=args.grace_sec, master_tasks=master_tasks,
+                  master_timeout_sec=args.master_timeout_sec)
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="paddle_tpu.launch",
-        description="rank-assigning multi-process launcher")
-    ap.add_argument("--nprocs", type=int, default=1)
-    ap.add_argument("--coordinator", default="127.0.0.1:12355")
+        description="rank-assigning multi-process launcher "
+                    "(fail-fast or --elastic survive-and-resize)")
+    add_launch_arguments(ap)
     ap.add_argument("script", nargs=argparse.REMAINDER,
                     help="script and its args")
     args = ap.parse_args(argv)
     if not args.script:
         ap.error("missing training script")
-    return launch(args.nprocs, args.coordinator, args.script)
+    return _shell_rc(run_from_args(args, args.script))
 
 
 if __name__ == "__main__":

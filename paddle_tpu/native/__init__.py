@@ -263,7 +263,9 @@ class TaskMaster(object):
         self._lib.master_task_finished(self._h, task_id)
 
     def task_failed(self, task_id):
-        self._lib.master_task_failed(self._h, task_id)
+        """1 = failure_max exhausted, task dropped; 0 = re-queued;
+        -1 = unknown/expired lease."""
+        return self._lib.master_task_failed(self._h, task_id)
 
     def counts(self):
         vals = [ctypes.c_int64() for _ in range(4)]
@@ -378,10 +380,12 @@ class MasterClient(object):
         rc, _ = self._call(self.FIN, struct.pack("<q", task_id))
         return rc == 0
 
-    def task_failed(self, task_id) -> bool:
+    def task_failed(self, task_id) -> int:
+        """Same tri-state as TaskMaster.task_failed (1 dropped, 0
+        re-queued, -1 unknown lease) — decided atomically server-side."""
         import struct
         rc, _ = self._call(self.FAIL, struct.pack("<q", task_id))
-        return rc == 0
+        return rc
 
     def counts(self):
         import struct

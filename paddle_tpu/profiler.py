@@ -24,7 +24,9 @@ __all__ = ["timer", "stat_summary", "print_stats", "reset_stats",
            "update_serving_counters", "serving_counters",
            "reset_serving_counters",
            "update_comm_counters", "comm_counters", "reset_comm_counters",
-           "update_tune_counters", "tune_counters", "reset_tune_counters"]
+           "update_tune_counters", "tune_counters", "reset_tune_counters",
+           "update_elastic_counters", "elastic_counters",
+           "reset_elastic_counters"]
 
 _enabled = False
 _records = defaultdict(list)  # label -> [seconds]
@@ -34,6 +36,7 @@ _pipeline_counters = defaultdict(float)  # async-pipeline observability
 _serving_counters = defaultdict(float)   # online-serving observability
 _comm_counters = defaultdict(float)      # gradient-communication observability
 _tune_counters = defaultdict(float)      # kernel-autotuning observability
+_elastic_counters = defaultdict(float)   # elasticity observability
 _T0 = time.perf_counter()
 
 
@@ -77,6 +80,7 @@ def reset_profiler():
     _serving_counters.clear()
     _comm_counters.clear()
     _tune_counters.clear()
+    _elastic_counters.clear()
 
 
 def update_pipeline_counters(**counters):
@@ -174,6 +178,28 @@ def reset_tune_counters():
     _tune_counters.clear()
 
 
+def update_elastic_counters(**counters):
+    """Accumulate elasticity observability counters (paddle_tpu.elastic;
+    a few dict adds per RESIZE/RESUME — rare, operator-visible events,
+    never per step). Keys in use: ``elastic_resizes`` (world shrinks),
+    ``elastic_lost_ranks``, ``elastic_restarts`` (transient full-world
+    relaunches), ``elastic_requeued_tasks`` (the dead worker's leased
+    dataset tasks re-queued through the task master),
+    ``elastic_resumes`` and ``elastic_resume_ms`` (cross-world
+    checkpoint-restore latency), ``elastic_heartbeat_failures``."""
+    for k, v in counters.items():
+        _elastic_counters[k] += float(v)
+
+
+def elastic_counters():
+    """Snapshot {counter: value} of the elasticity counters."""
+    return dict(_elastic_counters)
+
+
+def reset_elastic_counters():
+    _elastic_counters.clear()
+
+
 def record_op_event(op_type, name, t_start, t_end):
     """Per-op span from the eager interpreter path (on the jit path the
     per-op loop does not exist at run time — op granularity comes from the
@@ -261,6 +287,9 @@ def write_timeline(path):
     - ``tune``: kernel-autotuning counters (winner-cache hits/misses/
       stock-XLA fallbacks at dispatch, autotune-loop activity) — the
       adoption evidence for paddle_tpu.tune.
+    - ``elastic``: elasticity counters (resizes, lost ranks, requeued
+      tasks, resume latency) — the survive-and-resize evidence for
+      paddle_tpu.elastic.
     """
     import json
     rows = []
@@ -280,6 +309,7 @@ def write_timeline(path):
         "serving": dict(_serving_counters),
         "comm": dict(_comm_counters),
         "tune": dict(_tune_counters),
+        "elastic": dict(_elastic_counters),
     }
     with open(path, "w") as f:
         json.dump(artifact, f, indent=1)

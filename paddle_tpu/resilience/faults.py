@@ -77,6 +77,26 @@ site                      where
                           ``tune_cache_corrupt`` event, and dispatch
                           falls back to default-config/stock-XLA until
                           a re-tune repopulates
+``elastic.heartbeat``     the elastic supervisor's health sweep, per
+                          sweep: a raise models a flapping
+                          heartbeat/registry probe — counted and
+                          recorded (``elastic_heartbeat_failed``
+                          event), the sweep continues; worker LIVENESS
+                          decisions stay on process exit, so a flaky
+                          probe can never kill a healthy job
+``elastic.replan``        paddle_tpu.elastic.replan, per mesh/comm
+                          re-plan for a (survivor) world: a raise
+                          degrades the plan to the flat hosts=1
+                          factorisation (topology-blind but always
+                          correct) with a recorded
+                          ``elastic_degraded`` event — training
+                          continues on the survivors either way
+``elastic.resume``        paddle_tpu.elastic.resume resume-point
+                          resolution, per resolution: a raise marks
+                          the newest checkpoint+snapshot pair
+                          unusable — the walk falls through to the
+                          next-older complete pair with a recorded
+                          ``elastic_degraded`` event
 ========================  ====================================================
 
 Spec grammar (env var or ``load_fault_spec`` string)::

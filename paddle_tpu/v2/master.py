@@ -92,6 +92,57 @@ class client(object):
     def _api(self):
         return self._rpc if self._rpc is not None else self._master
 
+    # -- raw task stream -----------------------------------------------------
+    # The recordio-free face of the same lease contract: payloads are
+    # opaque bytes (the elastic chaos harness leases batch ids, not
+    # files). next_record/records stay the recordio path.
+    def get_task(self, block=True, poll_sec=0.05, should_stop=None):
+        """Lease the next task: ``(task_id, payload)``, or ``(None,
+        None)`` at pass end. ``block=True`` waits while other workers
+        hold the remaining leases (``should_stop()`` can break the
+        wait -> ``("wait", None)``); ``block=False`` returns ``("wait",
+        None)`` immediately in that state."""
+        while True:
+            tid, payload = self._api().get_task()
+            if tid != "wait" or not block:
+                return tid, payload
+            if should_stop is not None and should_stop():
+                return "wait", None
+            time.sleep(poll_sec)
+
+    def task_finished(self, task_id):
+        """Mark a leased task done. Returns False when the lease had
+        already expired and the task was reclaimed (remote mode) — the
+        caller's work may be redone by a survivor; don't double-commit."""
+        rc = self._api().task_finished(task_id)
+        # in-process TaskMaster returns None; MasterClient returns bool
+        return True if rc is None else bool(rc)
+
+    def task_failed(self, task_id):
+        """Report a poisoned task. Returns True when THIS failure
+        exhausted the master's ``failure_max`` and the task was DROPPED
+        from the pass — the master decides that atomically under its
+        lock (no cross-worker counts race) — recorded as a
+        ``task_dropped`` resilience event so the loss is auditable (the
+        Go master logs the same discard, go/master/service.go:313)."""
+        from ..resilience import record_event
+        dropped = self._api().task_failed(task_id) == 1
+        if dropped:
+            record_event("task_dropped", site="master.task",
+                         task_id=task_id,
+                         failed_total=self._api().counts()["failed"])
+        return dropped
+
+    def counts(self):
+        return self._api().counts()
+
+    def snapshot(self, path):
+        """Atomic todo+pending snapshot (leased tasks persisted
+        re-runnable) — pair it with a model checkpoint so a resumed
+        world's data pass restarts exactly where the model state says
+        it should (paddle_tpu.elastic.resume)."""
+        self._api().snapshot(path)
+
     # -- dataset ------------------------------------------------------------
     def set_dataset(self, paths, trainer_id=0):
         """Register recordio files as the pass's task list. Exactly ONE

@@ -1,0 +1,578 @@
+"""Elastic multi-host training (paddle_tpu.elastic): supervisor
+classify/restart/resize semantics over real OS processes, mesh/comm
+re-planning for survivor worlds, checkpoint <-> task-master-snapshot
+resume pairing, the v2 master's crash re-queue contract from the RPC
+(multi-process) side, launcher env validation, and the load_latest
+prune-race fallthrough the supervisor's resume path exercises. The full
+kill-one-of-four chaos acceptance is tools/elastic_smoke.sh (and the
+slow test at the bottom)."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import checkpoint, layers
+from paddle_tpu import resilience as R
+from paddle_tpu.elastic import replan as replan_mod
+from paddle_tpu.elastic import resume as resume_mod
+from paddle_tpu.elastic.supervisor import ElasticSupervisor
+from paddle_tpu.flags import FLAGS, flags_guard
+from paddle_tpu.launch import launch
+from paddle_tpu.parallel import env as penv
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# parallel/env.py: validated world
+
+
+def test_world_parses_and_validates():
+    w = penv.world({"PADDLE_TPU_COORDINATOR": "h:1",
+                    "PADDLE_TPU_NUM_PROCESSES": "4",
+                    "PADDLE_TPU_PROCESS_ID": "3",
+                    "PADDLE_TPU_ELASTIC": "1",
+                    "PADDLE_TPU_ELASTIC_GENERATION": "2"})
+    assert w == ("h:1", 4, 3, True, 2)
+    # unset stays None (the TPU-pod auto-detect path)
+    w0 = penv.world({})
+    assert w0.num_processes is None and w0.process_id is None
+    assert not w0.elastic and w0.generation == 0
+
+
+@pytest.mark.parametrize("env,frag", [
+    ({"PADDLE_TPU_NUM_PROCESSES": "four",
+      "PADDLE_TPU_PROCESS_ID": "0"}, "not an integer"),
+    ({"PADDLE_TPU_NUM_PROCESSES": "0",
+      "PADDLE_TPU_PROCESS_ID": "0"}, "must be > 0"),
+    ({"PADDLE_TPU_NUM_PROCESSES": "4",
+      "PADDLE_TPU_PROCESS_ID": "4"}, "out of range"),
+    ({"PADDLE_TPU_NUM_PROCESSES": "4",
+      "PADDLE_TPU_PROCESS_ID": "-1"}, ">= 0"),
+    ({"PADDLE_TPU_NUM_PROCESSES": "4"}, "set together"),
+    ({"PADDLE_TPU_PROCESS_ID": "1"}, "set together"),
+])
+def test_world_readable_errors(env, frag):
+    with pytest.raises(ValueError) as ei:
+        penv.world(env)
+    assert frag in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# elastic.replan: survivor-world re-planning
+
+
+def test_replan_factorises_survivor_world():
+    with flags_guard(comm_policy="hierarchical", comm_hosts=0):
+        p4 = replan_mod.replan(4)
+        p3 = replan_mod.replan(3)
+    assert (p4.world_size, p4.hosts, p4.dp) == (4, 4, 4)
+    assert (p3.world_size, p3.hosts, p3.dp) == (3, 3, 3)
+    assert p4.policy.hosts == 4 and p3.policy.hosts == 3
+    # the rebuilt axis_index_groups differ with the topology
+    intra4, ring4 = p4.groups()
+    intra3, ring3 = p3.groups()
+    assert len(intra4) == 4 and len(intra3) == 3
+    assert ring4 != ring3
+    # a shrunk world can never hit a stale compile: the signature the
+    # executor joins into its jit cache key changes
+    assert p4.cache_signature() != p3.cache_signature()
+
+
+def test_replan_chips_per_host():
+    with flags_guard(comm_policy="hierarchical", comm_hosts=0):
+        p = replan_mod.replan(2, chips_per_host=4)
+    assert (p.hosts, p.dp) == (2, 8)
+    intra, _ = p.groups()
+    assert intra == [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+
+def test_replan_apply_flags_rekeys_executor_cache():
+    from paddle_tpu.core.executor import _comm_flags_sig
+    with flags_guard(comm_policy="hierarchical", comm_hosts=0):
+        replan_mod.replan(4).apply_flags()
+        sig4 = _comm_flags_sig()
+        replan_mod.replan(3).apply_flags()
+        sig3 = _comm_flags_sig()
+    assert sig4 != sig3
+
+
+def test_replan_step_fn_retraces_per_world(forced_cpu_devices):
+    """The SAME loss trains under both the full-world and the
+    survivor-world plan: each plan's step fn is a fresh trace at its
+    own dp size with its own hierarchical grouping."""
+    import jax.numpy as jnp
+
+    def loss_fn(params, x, y):
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    losses = {}
+    with flags_guard(comm_policy="hierarchical", comm_hosts=0):
+        for world in (4, 2):
+            plan = replan_mod.replan(world)
+            step, state0_fn = plan.step_fn(
+                loss_fn, devices=forced_cpu_devices[:plan.dp])
+            params = {"w": jnp.ones((4,), jnp.float32)}
+            state = state0_fn(params)
+            x = jnp.arange(8 * 4, dtype=jnp.float32).reshape(8, 4) / 32.0
+            y = 0.25 * x.sum(axis=1) + 1.0  # not fit by the ones-init
+            loss, params2, state = step(params, state, x, y, 0.01)
+            losses[world] = float(loss)
+            assert not np.allclose(np.asarray(params2["w"]),
+                                   np.asarray(params["w"]))
+    # same global batch, same init: the mean-gradient step agrees
+    # across worlds up to reassociation
+    np.testing.assert_allclose(losses[4], losses[2], rtol=1e-5)
+
+
+def test_replan_fault_degrades_to_flat_with_event():
+    R.clear_events()
+    R.arm("elastic.replan", "raise")
+    try:
+        with flags_guard(comm_policy="hierarchical", comm_hosts=0):
+            p = replan_mod.replan(4)
+    finally:
+        R.disarm("elastic.replan")
+    assert p.degraded and p.hosts == 1 and p.policy.hosts == 1
+    assert p.dp == 4  # the world itself is NOT degraded, only routing
+    evs = R.events(kind="elastic_degraded", site="elastic.replan")
+    assert len(evs) == 1 and evs[0]["world_size"] == 4
+    assert p.summary()["degraded"] is True
+
+
+# ---------------------------------------------------------------------------
+# elastic.resume: checkpoint <-> snapshot pairing
+
+
+def _fake_complete_ckpt(root, step):
+    d = os.path.join(root, "ckpt-%08d" % step)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "_COMPLETE"), "w") as f:
+        json.dump({"step": step, "sizes": {}}, f)
+    # distinct mtimes so newest-wins ordering is deterministic
+    t = 1_700_000_000 + step
+    os.utime(d, (t, t))
+    return d
+
+
+def test_resume_point_pairs_snapshot_by_step(tmp_path):
+    root = str(tmp_path)
+    d1 = _fake_complete_ckpt(root, 1)
+    d2 = _fake_complete_ckpt(root, 2)
+    # in-dir snapshot for step 1; step 2's was moved in-dir too
+    open(os.path.join(d1, resume_mod.SNAP_IN_DIR), "w").write("s1")
+    open(os.path.join(d2, resume_mod.SNAP_IN_DIR), "w").write("s2")
+    # a NEWER orphan snapshot whose checkpoint never completed must be
+    # ignored — restoring it would double-process the step-3 task
+    open(resume_mod.snapshot_path(root, 3), "w").write("s3-orphan")
+    rp = resume_mod.resume_point(root)
+    assert rp.step == 2
+    assert rp.snapshot == os.path.join(d2, resume_mod.SNAP_IN_DIR)
+
+
+def test_resume_point_falls_back_to_root_level_snap(tmp_path):
+    # the kill window between "checkpoint complete" and "snapshot moved
+    # in-dir": the root-level snapshot with the SAME step still pairs
+    root = str(tmp_path)
+    d2 = _fake_complete_ckpt(root, 2)
+    open(resume_mod.snapshot_path(root, 2), "w").write("s2")
+    rp = resume_mod.resume_point(root)
+    assert rp.ckpt_dir == d2 and rp.step == 2
+    assert rp.snapshot == resume_mod.snapshot_path(root, 2)
+    # no snapshot at all: the model alone resumes
+    d3 = _fake_complete_ckpt(root, 3)
+    rp = resume_mod.resume_point(root)
+    assert rp.ckpt_dir == d3 and rp.snapshot is None
+
+
+def test_resume_fault_walks_to_older_pair(tmp_path):
+    root = str(tmp_path)
+    d1 = _fake_complete_ckpt(root, 1)
+    _fake_complete_ckpt(root, 2)
+    R.clear_events()
+    R.arm("elastic.resume", "raise")  # nth=1: only the newest is marked
+    try:
+        rp = resume_mod.resume_point(root)
+    finally:
+        R.disarm("elastic.resume")
+    assert rp.ckpt_dir == d1 and rp.step == 1
+    assert R.events(kind="elastic_degraded", site="elastic.resume")
+
+
+def test_resume_point_empty_root(tmp_path):
+    assert resume_mod.resume_point(str(tmp_path)) is None
+    assert resume_mod.resume_point(str(tmp_path / "missing")) is None
+
+
+# ---------------------------------------------------------------------------
+# checkpoint.load_latest: concurrent-prune fallthrough (the resume path
+# the supervisor exercises while an async save's retention prune runs)
+
+
+def _build_ckpt_program():
+    from paddle_tpu.core import unique_name
+    unique_name._counters.clear()
+    main, startup = pt.Program(), pt.Program()
+    pt.switch_main_program(main)
+    pt.switch_startup_program(startup)
+    x = layers.data("x", shape=[4], dtype="float32")
+    layers.fc(x, size=2, param_attr=pt.ParamAttr(name="el_w"))
+    return main, startup
+
+
+def test_load_latest_survives_pruned_newest(tmp_path, monkeypatch):
+    main, startup = _build_ckpt_program()
+    scope = pt.Scope()
+    root = str(tmp_path / "root")
+    with pt.scope_guard(scope):
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        checkpoint.save_checkpoint(root, main, scope=scope, step=1,
+                                   keep_last=5)
+        checkpoint.save_checkpoint(root, main, scope=scope, step=2,
+                                   keep_last=5)
+    real = checkpoint.latest_checkpoint
+    pruned = os.path.join(root, "ckpt-00000099")
+    calls = {"n": 0}
+
+    def racing(r):
+        calls["n"] += 1
+        # first scan hands back an entry a concurrent prune then deletes
+        return pruned if calls["n"] == 1 else real(r)
+
+    monkeypatch.setattr(checkpoint, "latest_checkpoint", racing)
+    R.clear_events()
+    with pt.scope_guard(scope):
+        used, step = checkpoint.load_latest(root, main, scope=scope)
+    assert step == 2 and used.endswith("ckpt-00000002")
+    assert calls["n"] == 2
+    assert R.events(kind="checkpoint_pruned_during_load")
+
+
+def test_load_latest_real_error_still_raises(tmp_path):
+    # a present-but-torn manifest read error must NOT be eaten by the
+    # prune-race tolerance
+    main, startup = _build_ckpt_program()
+    scope = pt.Scope()
+    root = str(tmp_path / "root")
+    with pt.scope_guard(scope):
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        checkpoint.save_checkpoint(root, main, scope=scope, step=1,
+                                   keep_last=5)
+    d = os.path.join(root, "ckpt-00000001")
+    os.remove(os.path.join(d, checkpoint._MANIFEST))
+    # _COMPLETE still references the shard sizes, manifest is gone ->
+    # the dir exists, so the error surfaces (as a read failure)
+    with pytest.raises((IOError, OSError)):
+        with pt.scope_guard(scope):
+            checkpoint.load_latest(root, main, scope=scope)
+
+
+# ---------------------------------------------------------------------------
+# v2 master: crash re-queue semantics from the RPC (multi-process) side
+
+
+_LEASE_AND_DIE = textwrap.dedent("""
+    import os, signal, sys
+    sys.path.insert(0, %(repo)r)
+    from paddle_tpu.v2 import master as v2m
+    c = v2m.client(%(addr)r)
+    tid, payload = c.get_task()
+    assert tid not in (None, "wait"), tid
+    print("LEASED %%s" %% payload.decode(), flush=True)
+    os.kill(os.getpid(), signal.SIGKILL)
+""")
+
+
+def _serve_master(n_tasks, timeout_sec, failure_max=3):
+    native = pytest.importorskip("paddle_tpu.native")
+    if not native.available():
+        pytest.skip("no native toolchain")
+    m = native.TaskMaster(failure_max=failure_max,
+                          timeout_sec=timeout_sec)
+    for i in range(n_tasks):
+        m.add_task(b"t-%d" % i)
+    port = m.serve(0)
+    return m, "127.0.0.1:%d" % port
+
+
+def test_master_rpc_dead_worker_task_releases_exactly_once():
+    """A SIGKILLed worker's leased task is re-leased EXACTLY once to a
+    survivor past timeout_sec, and the pass still ends."""
+    from paddle_tpu.v2 import master as v2m
+    m, addr = _serve_master(4, timeout_sec=0.5)
+    try:
+        child = subprocess.Popen(
+            [sys.executable, "-c",
+             _LEASE_AND_DIE % {"repo": REPO, "addr": addr}],
+            stdout=subprocess.PIPE, text=True)
+        line = child.stdout.readline()
+        assert line.startswith("LEASED"), line
+        dead_payload = line.split()[1].encode()
+        child.wait(timeout=30)
+
+        survivor = v2m.client(addr, worker_name="survivor")
+        seen = []
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            tid, payload = survivor.get_task(block=False)
+            if tid is None:
+                break
+            if tid == "wait":
+                time.sleep(0.05)  # the dead lease has not expired yet
+                continue
+            seen.append(payload)
+            assert survivor.task_finished(tid)
+        assert sorted(seen) == sorted(b"t-%d" % i for i in range(4))
+        assert seen.count(dead_payload) == 1  # re-leased exactly once
+        c = survivor.counts()
+        assert c == {"todo": 0, "pending": 0, "done": 4, "failed": 0}
+        survivor.close()
+    finally:
+        m.close()
+
+
+def test_master_rpc_failure_max_drops_with_event_and_pass_ends():
+    """failure_max exhaustion DROPS the task with a recorded
+    task_dropped event — and pass-end still fires for the survivors."""
+    from paddle_tpu.v2 import master as v2m
+    m, addr = _serve_master(2, timeout_sec=30.0, failure_max=2)
+    R.clear_events()
+    try:
+        c = v2m.client(addr)
+        dropped = None
+        finished = []
+        while True:
+            tid, payload = c.get_task(block=False)
+            if tid is None:
+                break
+            assert tid != "wait"
+            if payload == b"t-0":
+                # poison: report failure; the second one exhausts
+                # failure_max=2 and must record the drop
+                was_dropped = c.task_failed(tid)
+                if was_dropped:
+                    dropped = payload
+            else:
+                assert c.task_finished(tid)
+                finished.append(payload)
+        assert dropped == b"t-0"
+        assert finished == [b"t-1"]
+        counts = c.counts()
+        assert counts["failed"] == 1 and counts["done"] == 1
+        # pass end fired (get_task returned None) despite the poison
+        evs = R.events(kind="task_dropped", site="master.task")
+        assert len(evs) == 1 and evs[0]["failed_total"] == 1
+        c.close()
+    finally:
+        m.close()
+
+
+# ---------------------------------------------------------------------------
+# supervisor: classify / restart / resize / quorum over real processes
+
+
+def _worker_script(tmp_path, body):
+    p = tmp_path / "worker.py"
+    p.write_text(textwrap.dedent("""
+        import os, signal, sys, time
+        rank = int(os.environ["PADDLE_TPU_PROCESS_ID"])
+        gen = int(os.environ.get("PADDLE_TPU_ELASTIC_GENERATION", "0"))
+        world = int(os.environ["PADDLE_TPU_NUM_PROCESSES"])
+        state = os.environ.get("PADDLE_TPU_ELASTIC_STATE", "")
+    """) + textwrap.dedent(body))
+    return str(p)
+
+
+def _events_of(state_dir, kind=None):
+    path = os.path.join(state_dir, "events.jsonl")
+    evs = []
+    if os.path.exists(path):
+        with open(path) as f:
+            evs = [json.loads(ln) for ln in f]
+    return [e for e in evs if kind is None or e["kind"] == kind]
+
+
+def test_supervisor_resizes_on_signal_death(tmp_path):
+    script = _worker_script(tmp_path, """
+        if gen == 0 and rank == 1:
+            os.kill(os.getpid(), signal.SIGKILL)
+        time.sleep(0.2)
+    """)
+    sd = str(tmp_path / "state")
+    rc = ElasticSupervisor(3, "127.0.0.1", [script], min_workers=2,
+                           restart_budget=2, grace_sec=3.0, state_dir=sd,
+                           sweep_interval=0.1).run()
+    assert rc == 0
+    resizes = _events_of(sd, "elastic_resize")
+    assert len(resizes) == 1
+    assert resizes[0]["from_world"] == 3 and resizes[0]["to_world"] == 2
+    assert resizes[0]["lost_rank"] == 1 and resizes[0]["rc"] == -9
+    gens = _events_of(sd, "elastic_generation")
+    assert [g["world"] for g in gens] == [3, 2]
+    assert _events_of(sd, "elastic_job_complete")
+
+
+def test_supervisor_transient_restart_consumes_budget(tmp_path):
+    # crash-exit (rc 3) once, then succeed: ONE full-world restart, no
+    # resize — the transient classification
+    script = _worker_script(tmp_path, """
+        marker = os.path.join(state, "crashed-once")
+        if rank == 0 and not os.path.exists(marker):
+            open(marker, "w").close()
+            sys.exit(3)
+        time.sleep(0.1)
+    """)
+    sd = str(tmp_path / "state")
+    os.makedirs(sd)
+    rc = ElasticSupervisor(2, "127.0.0.1", [script], min_workers=1,
+                           restart_budget=2, grace_sec=3.0, state_dir=sd,
+                           sweep_interval=0.1).run()
+    assert rc == 0
+    restarts = _events_of(sd, "elastic_restart")
+    assert len(restarts) == 1 and restarts[0]["rc"] == 3
+    assert not _events_of(sd, "elastic_resize")
+    assert [g["world"] for g in _events_of(sd, "elastic_generation")] \
+        == [2, 2]
+
+
+def test_supervisor_exhausted_budget_resizes(tmp_path):
+    # rank 1 crash-exits EVERY generation: budget 1 -> one restart,
+    # then the loss is permanent -> resize to 1 -> completes
+    script = _worker_script(tmp_path, """
+        if rank == 1:
+            sys.exit(7)
+        time.sleep(0.1)
+    """)
+    sd = str(tmp_path / "state")
+    rc = ElasticSupervisor(2, "127.0.0.1", [script], min_workers=1,
+                           restart_budget=1, grace_sec=3.0, state_dir=sd,
+                           sweep_interval=0.1).run()
+    assert rc == 0
+    assert len(_events_of(sd, "elastic_restart")) == 1
+    resizes = _events_of(sd, "elastic_resize")
+    assert len(resizes) == 1 and resizes[0]["to_world"] == 1
+
+
+def test_supervisor_quorum_lost_propagates_real_rc(tmp_path):
+    script = _worker_script(tmp_path, """
+        if rank == 0:
+            os.kill(os.getpid(), signal.SIGKILL)
+        time.sleep(0.2)
+    """)
+    sd = str(tmp_path / "state")
+    rc = ElasticSupervisor(2, "127.0.0.1", [script], min_workers=2,
+                           restart_budget=0, grace_sec=3.0, state_dir=sd,
+                           sweep_interval=0.1).run()
+    assert rc == -9  # the real exit code, never masked
+    assert _events_of(sd, "elastic_quorum_lost")
+    assert not _events_of(sd, "elastic_resize")
+
+
+def test_supervisor_heartbeat_fault_is_counted_not_fatal(tmp_path):
+    from paddle_tpu import profiler as prof
+    script = _worker_script(tmp_path, """
+        time.sleep(0.5)
+    """)
+    sd = str(tmp_path / "state")
+    before = prof.elastic_counters().get("elastic_heartbeat_failures", 0)
+    R.arm("elastic.heartbeat", "raise", times=2)
+    try:
+        rc = ElasticSupervisor(1, "127.0.0.1", [script], min_workers=1,
+                               grace_sec=3.0, state_dir=sd,
+                               sweep_interval=0.1).run()
+    finally:
+        R.disarm("elastic.heartbeat")
+    assert rc == 0  # a flaky probe can never kill a healthy job
+    assert _events_of(sd, "elastic_heartbeat_failed")
+    after = prof.elastic_counters().get("elastic_heartbeat_failures", 0)
+    assert after >= before + 1
+
+
+def test_launch_fail_fast_escalates_hung_worker(tmp_path):
+    # rank 0 ignores SIGTERM (a worker wedged in a dead collective);
+    # rank 1 fails -> launch must SIGKILL past grace and return the
+    # REAL failing code promptly instead of wedging for 60s
+    script = _worker_script(tmp_path, """
+        if rank == 0:
+            signal.signal(signal.SIGTERM, signal.SIG_IGN)
+            time.sleep(60)
+        else:
+            time.sleep(0.2)
+            sys.exit(5)
+    """)
+    t0 = time.monotonic()
+    rc = launch(2, "127.0.0.1:0", [script], grace_sec=0.5)
+    assert rc == 5
+    assert time.monotonic() - t0 < 20
+
+
+def test_launch_success_exit_zero(tmp_path):
+    script = _worker_script(tmp_path, """
+        sys.exit(0)
+    """)
+    assert launch(2, "127.0.0.1:0", [script]) == 0
+
+
+# ---------------------------------------------------------------------------
+# observability: counters / timeline / executor stats
+
+
+def test_elastic_counters_and_timeline_section(tmp_path):
+    from paddle_tpu import profiler as prof
+    prof.reset_elastic_counters()
+    prof.update_elastic_counters(elastic_resizes=1, elastic_lost_ranks=1,
+                                 elastic_requeued_tasks=5,
+                                 elastic_resume_ms=12.5)
+    art = prof.write_timeline(str(tmp_path / "t.json"))
+    assert art["elastic"]["elastic_resizes"] == 1
+    assert art["elastic"]["elastic_requeued_tasks"] == 5
+    stats = {"elastic_resizes": 0, "elastic_lost_ranks": 0,
+             "elastic_requeued_tasks": 0, "elastic_resume_ms": 0.0}
+    resume_mod.record_stats(stats)
+    assert stats["elastic_resizes"] == 1
+    assert stats["elastic_resume_ms"] == 12.5
+    prof.reset_elastic_counters()
+    assert prof.elastic_counters() == {}
+
+
+def test_executor_stats_have_elastic_section():
+    exe = pt.Executor(pt.CPUPlace())
+    for k in ("elastic_resizes", "elastic_lost_ranks",
+              "elastic_requeued_tasks", "elastic_resume_ms"):
+        assert k in exe.stats
+
+
+def test_elastic_flags_declared():
+    assert FLAGS.elastic is False
+    assert FLAGS.elastic_min_workers >= 1
+    assert FLAGS.elastic_restart_budget >= 0
+
+
+# ---------------------------------------------------------------------------
+# the full chaos acceptance (the smoke gate's leg, pytest form)
+
+
+@pytest.mark.slow
+def test_chaos_kill_one_of_four_resumes_on_survivors(tmp_path):
+    sys.path.insert(0, REPO)
+    import benchmark.chaos_run as cr
+    report = cr.run_chaos(str(tmp_path / "chaos"), nprocs=4, tasks=8,
+                          kill_rank=0, kill_after=2, timeout=600)
+    assert report["rc"] == 0
+    assert report["killed"] is not None
+    resizes = [e for e in report["events"]
+               if e["kind"] == "elastic_resize"]
+    assert len(resizes) == 1
+    assert (resizes[0]["from_world"], resizes[0]["to_world"]) == (4, 3)
+    assert cr.check_exactly_once(report) == []
+    assert cr.check_continuity(report) == []
+    assert cr.check_replan(report) == []
