@@ -1,0 +1,210 @@
+"""Continuous-batching generation measurement harness.
+
+The ONE implementation shared by tools/gen_smoke.py (CI gate) and any
+bench.py generation phase, so the parity check, the trace-count
+assertion, and the throughput criterion cannot drift between the
+evidence record and the gate.
+
+Workload: a small decoder-only transformer LM (random weights — the
+engine's economics do not depend on training) flooded with
+mixed-length prompts. Two engines over the SAME model answer the same
+flood:
+
+- **continuous**: ``max_running`` slots, iteration-level scheduling —
+  the thing under test;
+- **sequential**: ``max_running=1`` — the same paged machinery, one
+  request at a time; the honest per-request-decode baseline (it shares
+  every per-step cost, so the ratio isolates the batching win, not
+  harness overhead).
+
+Both engines are warmed before timing, waves are INTERLEAVED
+(continuous/sequential per wave — the comm_bench lesson: sequential
+phases measure CPU load drift, interleaved ones measure the code), and
+the gated ratio is the best wave. Greedy parity is judged against
+``serving.reference_decode`` (full-sequence recompute per token) —
+token-identical, the continuous-batching correctness bar — and the
+continuous engine must finish the whole flood with ONE decode trace.
+"""
+from __future__ import annotations
+
+import time
+
+
+def build_model(vocab=29, hidden=32, num_layers=2, num_heads=4,
+                max_seq=96, seed=0):
+    from paddle_tpu.models import transformer as tm
+    cfg = tm.TransformerConfig(vocab_size=vocab, hidden=hidden,
+                               num_layers=num_layers, num_heads=num_heads,
+                               max_seq=max_seq)
+    return tm.TransformerLM(tm.init_params(cfg, seed=seed), cfg)
+
+
+def mixed_prompts(model, n, max_new, seed=0):
+    """Mixed-length flood: prompt lengths spread over [2, ~max_seq/2],
+    the shape that breaks request-level batching."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    V = model.config.vocab_size
+    top = max(3, (model.config.max_seq - max_new) // 2)
+    return [list(rng.randint(0, V, int(rng.randint(2, top))))
+            for _ in range(n)]
+
+
+def _flood(engine, prompts, max_new):
+    """Submit everything async, wait for everything; returns wall
+    seconds (the engine's stats carry the rest)."""
+    t0 = time.perf_counter()
+    handles = [engine.submit(p, max_new_tokens=max_new) for p in prompts]
+    results = [h.wait(timeout=600) for h in handles]
+    return time.perf_counter() - t0, results
+
+
+def bench(requests=12, max_new=12, max_running=8, kv_pages=None,
+          page_tokens=8, waves=2, seed=0):
+    """Run the continuous-vs-sequential matrix; returns the summary dict
+    the smoke gate asserts over."""
+    from paddle_tpu.serving import GenerationEngine, reference_decode
+
+    model = build_model(seed=seed)
+    cfg = model.config
+    if kv_pages is None:
+        # room for max_running full-reservation sequences plus slack
+        kv_pages = -(-cfg.max_seq // page_tokens) * (max_running + 2)
+    prompts = mixed_prompts(model, requests, max_new, seed=seed)
+    want = [reference_decode(model, p, max_new) for p in prompts]
+
+    cont = GenerationEngine(model, max_running=max_running,
+                            kv_pages=kv_pages, page_tokens=page_tokens,
+                            queue_depth=4 * requests, warm=True,
+                            name="cont")
+    seq = GenerationEngine(model, max_running=1, kv_pages=kv_pages,
+                           page_tokens=page_tokens,
+                           queue_depth=4 * requests, warm=True,
+                           name="seq")
+    try:
+        t_cont, t_seq, outputs = [], [], None
+        for _ in range(waves):
+            tc, results = _flood(cont, prompts, max_new)
+            ts, _ = _flood(seq, prompts, max_new)
+            t_cont.append(tc)
+            t_seq.append(ts)
+            outputs = results
+        cont_stats = cont.stats
+        seq_stats = seq.stats
+    finally:
+        cont.close()
+        seq.close()
+
+    bit_exact = all(r.tokens == w for r, w in zip(outputs, want))
+    tokens = requests * max_new
+    ratio = max(s / c for s, c in zip(t_seq, t_cont))
+    best_cont = min(t_cont)
+    return {
+        "requests": requests,
+        "max_new_tokens": max_new,
+        "max_running": max_running,
+        "kv_pages": kv_pages,
+        "page_tokens": page_tokens,
+        "prompt_lens": sorted(len(p) for p in prompts),
+        "bit_exact": bit_exact,
+        "tokens_per_wave": tokens,
+        "continuous_s": [round(t, 4) for t in t_cont],
+        "sequential_s": [round(t, 4) for t in t_seq],
+        "throughput_ratio": round(ratio, 3),
+        "continuous_tokens_per_s": round(tokens / best_cont, 1),
+        "running_occupancy": round(cont_stats["running_occupancy"], 3),
+        "max_running_seen": cont_stats["max_running_seen"],
+        "decode_traces": cont_stats["decode_traces"],
+        "sequential_decode_traces": seq_stats["decode_traces"],
+        "decode_steps": cont_stats["decode_steps"],
+        "sequential_decode_steps": seq_stats["decode_steps"],
+        "page_utilization_max": round(cont_stats["page_utilization_max"],
+                                      3),
+        "completed": cont_stats["completed"],
+        "failed": cont_stats["failed"] + cont_stats["shed"],
+        "ttft_ms_p50": round(cont_stats["ttft_ms_p50"], 3),
+        "ttft_ms_p99": round(cont_stats["ttft_ms_p99"], 3),
+        "intertoken_ms_p50": round(cont_stats["intertoken_ms_p50"], 3),
+        "intertoken_ms_p99": round(cont_stats["intertoken_ms_p99"], 3),
+    }
+
+
+def bench_exhaustion(page_tokens=4, seed=1):
+    """The degrade-and-record leg: a pool too small for the big request
+    sheds it AT SUBMIT with a recorded kv_pool_exhausted event, keeps
+    serving the small ones, and under reserve='prompt' a mid-flight
+    starvation resolves by preemption with identical greedy output."""
+    from paddle_tpu import resilience
+    from paddle_tpu.serving import (GenerationEngine, PoolExhausted,
+                                    reference_decode)
+
+    model = build_model(max_seq=64, seed=seed)
+    resilience.clear_events()
+    out = {}
+    # pool of 6 pages x 4 tokens = 24 cache positions
+    eng = GenerationEngine(model, max_running=2, kv_pages=6,
+                           page_tokens=page_tokens, queue_depth=16,
+                           warm=True, name="exhaust")
+    try:
+        shed = False
+        try:
+            eng.submit(list(range(20)), max_new_tokens=8)  # needs 7 pages
+        except PoolExhausted:
+            shed = True
+        small = [[1, 2, 3], [4, 5]]
+        res = [eng.generate(p, max_new_tokens=6, timeout=300)
+               for p in small]
+        out["shed_at_submit"] = shed
+        out["survivors_ok"] = all(
+            r.tokens == reference_decode(model, p, 6)
+            for r, p in zip(res, small))
+        out["engine_alive"] = eng.stats["completed"] == len(small)
+    finally:
+        eng.close()
+    evs = resilience.events(kind="kv_pool_exhausted")
+    out["exhaustion_events"] = len(evs)
+    # preemption leg: prompt-only reservation, two sequences racing a
+    # pool that cannot hold both to completion
+    pre = GenerationEngine(model, max_running=2, kv_pages=5,
+                           page_tokens=page_tokens, queue_depth=16,
+                           reserve="prompt", warm=True, name="preempt")
+    try:
+        prompts = [[1, 2, 3, 4, 5, 6], [7, 8, 9, 10, 11, 12]]
+        handles = [pre.submit(p, max_new_tokens=8) for p in prompts]
+        res = [h.wait(timeout=300) for h in handles]
+        out["preempt_parity"] = all(
+            r.tokens == reference_decode(model, p, 8)
+            for r, p in zip(res, prompts))
+        st = pre.stats
+        out["preemptions"] = st["preemptions"]
+        out["preempt_completed"] = st["completed"]
+    finally:
+        pre.close()
+    return out
+
+
+if __name__ == "__main__":
+    import argparse
+    import json
+    import os
+    import sys
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=12)
+    ap.add_argument("--max-new", type=int, default=12)
+    ap.add_argument("--max-running", type=int, default=8)
+    ap.add_argument("--waves", type=int, default=2)
+    ap.add_argument("--bank", action="store_true",
+                    help="persist a paddle_tpu.bench.v1 row under "
+                         "benchmark/results/")
+    a = ap.parse_args()
+    summary = bench(requests=a.requests, max_new=a.max_new,
+                    max_running=a.max_running, waves=a.waves)
+    summary["exhaustion"] = bench_exhaustion()
+    print(json.dumps(summary, indent=1))
+    if a.bank:
+        from paddle_tpu.tune import results as results_mod
+        rec = results_mod.bench_record("gen", [summary])
+        print("banked:", results_mod.write_result(rec))

@@ -121,13 +121,18 @@ def cmd_lint(args):
 
 
 def cmd_serve(args):
-    """Serve a compiled artifact over HTTP (paddle_tpu.serving): validate
-    the artifact directory (exit 1, readable message, nothing started on
-    a bad one), register + warm it, then run the JSON endpoint until
-    SIGTERM/SIGINT — which drains cleanly and exits 0."""
+    """Serve a compiled OR generative artifact over HTTP
+    (paddle_tpu.serving): validate the artifact directory (exit 1,
+    readable message, nothing started on a bad one), register + warm it
+    — a generative artifact stands a continuous-batching engine up
+    behind ``:generate`` — then run the JSON endpoint until
+    SIGTERM/SIGINT, which drains cleanly and exits 0."""
     from paddle_tpu import inference, serving
 
-    problems = inference.validate_artifact(args.artifact_dir)
+    generative = inference.is_generative_artifact(args.artifact_dir)
+    problems = (inference.validate_generative_artifact(args.artifact_dir)
+                if generative
+                else inference.validate_artifact(args.artifact_dir))
     if problems:
         print("serve: cannot serve %r:" % args.artifact_dir,
               file=sys.stderr)
@@ -139,8 +144,17 @@ def cmd_serve(args):
         batch_timeout_ms=(args.batch_timeout_ms
                           if args.batch_timeout_ms >= 0 else None),
         queue_depth=args.queue_depth or None)
+    gen_kwargs = {}
+    if generative:
+        if args.max_running:
+            gen_kwargs["max_running"] = args.max_running
+        if args.kv_pages:
+            gen_kwargs["kv_pages"] = args.kv_pages
+        if args.page_tokens:
+            gen_kwargs["page_tokens"] = args.page_tokens
     try:
-        entry = service.load_model(args.name, args.artifact_dir)
+        entry = service.load_model(args.name, args.artifact_dir,
+                                   **gen_kwargs)
     except Exception as e:
         print("serve: failed to load %r: %s: %s"
               % (args.artifact_dir, type(e).__name__, e), file=sys.stderr)
@@ -150,18 +164,28 @@ def cmd_serve(args):
     host, port = server.server_address[:2]
     # one parseable readiness line: smoke tests and operators read the
     # bound port from here (--port 0 binds a free one)
-    print(json.dumps({"serving": {
+    info = {
         "host": host, "port": port, "model": args.name,
+        "kind": "generative" if generative else "compiled",
         "version": entry.version, "warmup_ms": round(entry.warmup_ms, 3),
         "max_batch": service.max_batch,
-        "batch_timeout_ms": service.batch_timeout_ms}}), flush=True)
+        "batch_timeout_ms": service.batch_timeout_ms}
+    if generative:
+        info.update({"max_running": entry.engine.max_running,
+                     "kv_pages": entry.engine.pool.num_pages,
+                     "page_tokens": entry.engine.pool.page_tokens,
+                     "max_context": entry.engine.max_context})
+    print(json.dumps({"serving": info}), flush=True)
     try:
         signum = serving.httpd.serve_until_shutdown(server)
     finally:
+        # snapshot BEFORE close(): close drops the generation engines,
+        # and the shutdown record is the run's serving evidence
+        final_stats = service.stats
         server.server_close()
         service.close()
     print(json.dumps({"serving_stopped": {
-        "signal": signum, "stats": service.stats}}), flush=True)
+        "signal": signum, "stats": final_stats}}), flush=True)
     return 0
 
 
@@ -446,10 +470,13 @@ def main(argv=None):
     lint.set_defaults(fn=cmd_lint)
 
     sv = sub.add_parser(
-        "serve", help="serve a compiled inference artifact over HTTP "
-                      "(paddle_tpu.serving; SIGTERM drains and exits 0)")
+        "serve", help="serve a compiled or generative artifact over "
+                      "HTTP (paddle_tpu.serving; generative artifacts "
+                      "get continuous-batching :generate; SIGTERM "
+                      "drains and exits 0)")
     sv.add_argument("artifact_dir",
-                    help="directory written by inference.export_compiled")
+                    help="directory written by inference.export_compiled "
+                         "or inference.export_generative")
     sv.add_argument("--name", default="default",
                     help="model name in the registry / URL")
     sv.add_argument("--host", default="127.0.0.1")
@@ -463,6 +490,15 @@ def main(argv=None):
                          "(negative = flag)")
     sv.add_argument("--queue_depth", type=int, default=0,
                     help="override FLAGS.serve_queue_depth (0 = flag)")
+    sv.add_argument("--max_running", type=int, default=0,
+                    help="generative artifacts: override "
+                         "FLAGS.serve_max_running (0 = flag)")
+    sv.add_argument("--kv_pages", type=int, default=0,
+                    help="generative artifacts: override "
+                         "FLAGS.serve_kv_pages (0 = flag)")
+    sv.add_argument("--page_tokens", type=int, default=0,
+                    help="generative artifacts: override "
+                         "FLAGS.serve_page_tokens (0 = flag)")
     sv.set_defaults(fn=cmd_serve)
 
     acc = sub.add_parser(

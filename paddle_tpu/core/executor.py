@@ -249,9 +249,22 @@ def _annotate_op_error(e, op):
     crash context of reference utils/CustomStackTrace.h): deep trace
     errors otherwise point at jax internals with no hint WHICH program op
     produced the offending computation."""
+    note = ("while lowering op %r (inputs=%s -> outputs=%s)"
+            % (op.type, op.input_arg_names, op.output_arg_names))
     try:
-        e.add_note("while lowering op %r (inputs=%s -> outputs=%s)"
-                   % (op.type, op.input_arg_names, op.output_arg_names))
+        e.add_note(note)
+    except AttributeError:
+        # BaseException.add_note is 3.11+; on older interpreters set the
+        # PEP 678 __notes__ list by hand — tracebacks and tests read it
+        # the same way either version
+        try:
+            notes = getattr(e, "__notes__", None)
+            if isinstance(notes, list):
+                notes.append(note)
+            else:
+                e.__notes__ = [note]
+        except Exception:
+            pass
     except Exception:
         pass  # non-annotatable exception type; never mask the original
 

@@ -376,3 +376,24 @@ DEFINE_int32("serve_queue_depth", 64,
              "immediately with OverloadError (HTTP 429) and a recorded "
              "request_shed degradation event instead of queuing into "
              "certain lateness")
+DEFINE_int32("serve_max_running", 8,
+             "generation engine (paddle_tpu.serving.generator): most "
+             "sequences decoded concurrently by the fused iteration-"
+             "level decode step. Fixes the decode program's batch "
+             "shape, so it is compiled ONCE per engine — raising it on "
+             "a live engine has no effect; set it before the engine is "
+             "built. Idle rows cost one masked lane each, so size it "
+             "to the sustained concurrency, not the peak queue")
+DEFINE_int32("serve_kv_pages", 64,
+             "generation engine: usable pages preallocated in the "
+             "per-model paged KV pool (one extra trash page is added "
+             "internally). Pool token capacity = serve_kv_pages x "
+             "serve_page_tokens; admission reserves ceil((prompt + "
+             "max_new_tokens) / serve_page_tokens) pages per sequence, "
+             "and a request that could NEVER fit is shed at submit "
+             "with a recorded kv_pool_exhausted event")
+DEFINE_int32("serve_page_tokens", 16,
+             "generation engine: K/V positions per page. Smaller pages "
+             "waste less tail capacity per sequence but grow the block "
+             "tables (max_blocks = ceil(max_seq / page_tokens) gather "
+             "indices per row in the fused decode step)")

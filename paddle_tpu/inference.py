@@ -30,9 +30,17 @@ META_FILE = "__meta__.json"
 NATIVE_MODULE_FILE = "__module__.stablehlo_bc"
 NATIVE_WEIGHTS_FILE = "__weights__.bin"
 NATIVE_SIGNATURE_FILE = "__signature__.json"
+# Generative artifact (autoregressive serving): weights + model config,
+# NOT a frozen StableHLO program — the generation engine re-traces its
+# prefill/decode faces around the paged pool geometry at load time, so
+# what must persist is the params dict and the hyperparameters
+GEN_PARAMS_FILE = "__gen_params__.pkl"
+GEN_CONFIG_FILE = "__gen_config__.json"
 
 __all__ = ["export_compiled", "load_compiled", "CompiledModel",
-           "ArtifactError", "validate_artifact"]
+           "ArtifactError", "validate_artifact",
+           "export_generative", "load_generative",
+           "validate_generative_artifact", "is_generative_artifact"]
 
 
 class ArtifactError(RuntimeError):
@@ -281,3 +289,98 @@ class CompiledModel(object):
 
 def load_compiled(dirname):
     return CompiledModel(dirname)
+
+
+# ---------------------------------------------------------------------------
+# Generative artifacts (paddle_tpu.serving.generator): a trained
+# transformer LM exported for continuous-batching decode. Unlike
+# export_compiled, nothing is AOT-frozen here — the decode program's
+# shape depends on serving knobs (max_running, page pool), which belong
+# to the DEPLOYMENT, not the artifact. The artifact is weights + config.
+
+def is_generative_artifact(dirname):
+    """True when ``dirname`` looks like an export_generative directory
+    (presence test only — validate_generative_artifact judges health)."""
+    return os.path.isfile(os.path.join(dirname, GEN_CONFIG_FILE))
+
+
+def validate_generative_artifact(dirname):
+    """Problem list (empty = valid) for a generative artifact — the
+    validate_artifact contract for the autoregressive tier."""
+    if not os.path.isdir(dirname):
+        return ["artifact directory %r does not exist (expected the "
+                "directory export_generative wrote)" % dirname]
+    problems = []
+    for fname, role in ((GEN_CONFIG_FILE, "model config JSON"),
+                        (GEN_PARAMS_FILE, "pickled parameters")):
+        path = os.path.join(dirname, fname)
+        if not os.path.isfile(path):
+            problems.append("missing %s (%s)" % (fname, role))
+        elif os.path.getsize(path) == 0:
+            problems.append("%s is empty (%s)" % (fname, role))
+    return problems
+
+
+def export_generative(dirname, config, scope=None, params=None):
+    """Serialize a trained transformer LM for the generation engine.
+
+    ``config``: a :class:`~paddle_tpu.models.transformer.TransformerConfig`
+    (or its dict). ``params``: explicit {name: array}; default extracts
+    the transformer_lm ParamAttr names from ``scope`` (default global
+    scope) via ``models.transformer.params_from_scope``.
+    """
+    from .models import transformer as _tm
+    if isinstance(config, dict):
+        config = _tm.TransformerConfig.from_dict(config)
+    if params is None:
+        params = _tm.params_from_scope(config, scope)
+    missing = [n for n in _tm.param_names(config) if n not in params]
+    if missing:
+        raise ValueError("params dict is missing %s" % missing)
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, GEN_PARAMS_FILE), "wb") as f:
+        pickle.dump({n: np.asarray(params[n])
+                     for n in _tm.param_names(config)}, f)
+    with open(os.path.join(dirname, GEN_CONFIG_FILE), "w") as f:
+        json.dump({"family": "transformer_lm",
+                   "config": config.to_dict()}, f)
+    return dirname
+
+
+def load_generative(dirname):
+    """Load a generative artifact as the
+    :class:`~paddle_tpu.models.transformer.TransformerLM` serving face
+    (params device-resident). Raises :class:`ArtifactError` with every
+    problem named, the load_compiled convention."""
+    from .models import transformer as _tm
+    problems = validate_generative_artifact(dirname)
+    if problems:
+        raise ArtifactError(
+            "cannot load generative artifact %r:\n  - %s"
+            % (dirname, "\n  - ".join(problems)))
+    try:
+        with open(os.path.join(dirname, GEN_CONFIG_FILE)) as f:
+            meta = json.load(f)
+        family = meta["family"]
+        config = _tm.TransformerConfig.from_dict(meta["config"])
+    except Exception as e:
+        raise ArtifactError(
+            "artifact %r: %s is corrupt or incomplete (%s: %s) — "
+            "re-export with export_generative"
+            % (dirname, GEN_CONFIG_FILE, type(e).__name__, e)) from e
+    if family != "transformer_lm":
+        raise ArtifactError(
+            "artifact %r: unknown generative family %r (this build "
+            "serves 'transformer_lm')" % (dirname, family))
+    try:
+        with open(os.path.join(dirname, GEN_PARAMS_FILE), "rb") as f:
+            params = pickle.load(f)
+    except Exception as e:
+        raise ArtifactError(
+            "artifact %r: %s is corrupt (%s: %s) — re-export with "
+            "export_generative" % (dirname, GEN_PARAMS_FILE,
+                                   type(e).__name__, e)) from e
+    try:
+        return _tm.TransformerLM(params, config)
+    except ValueError as e:
+        raise ArtifactError("artifact %r: %s" % (dirname, e)) from e

@@ -12,5 +12,7 @@ from .vgg import vgg16, vgg_cifar  # noqa: F401
 from .resnet import resnet, resnet_cifar10, resnet_imagenet  # noqa: F401
 from .alexnet import alexnet  # noqa: F401
 from .googlenet import googlenet  # noqa: F401
-from .transformer import transformer_lm, transformer_block  # noqa: F401
+from .transformer import (  # noqa: F401
+    TransformerConfig, TransformerLM, transformer_lm, transformer_block,
+)
 from .ctr import wide_deep, deepfm, synthetic_click_batch  # noqa: F401

@@ -90,3 +90,313 @@ def transformer_lm(tokens, vocab_size, hidden=64, num_layers=2,
                      bias_attr=ParamAttr(name="final_ln_b"))
     return L.fc(x, size=vocab_size, num_flatten_dims=2, bias_attr=False,
                 param_attr=ParamAttr(name="lm_head"))
+
+
+# ---------------------------------------------------------------------------
+# Autoregressive serving face: the SAME weights transformer_lm trains,
+# re-expressed as pure jax functions the generation engine
+# (paddle_tpu.serving.generator) can jit once and drive per token.
+#
+# Three entry points, one math:
+#
+# - ``forward(params, tokens, config)``: full-sequence logits — the
+#   pure-jax mirror of the transformer_lm Program (anchored by a parity
+#   test against the Executor path), and the reference decoder for the
+#   continuous-batching bit-parity proof.
+# - ``prefill_step(...)``: one prompt through the full forward, its
+#   per-layer K/V scattered into the paged pool through the sequence's
+#   block table, last-real-position logits returned. Traced once per
+#   prompt-length bucket.
+# - ``decode_step(...)``: ONE token for every running sequence at once —
+#   single-token attention that reads K/V *through the block table*
+#   (gather) and writes the new position's K/V *through it* (scatter).
+#   All operands have fixed [max_running, ...] shapes, so the engine's
+#   hot loop is trace-free at any mix of sequence lengths.
+#
+# The math mirrors the op lowerings exactly (ops/attention_ops dense
+# reference, ops/nn_ops layer_norm eps=1e-5, mul's flatten-then-gemm):
+# masked-out cache columns contribute exp(-inf)=0 — exact zeros — so a
+# cached single-token step computes the same attention row the full
+# forward does, and greedy decode through the cache is token-identical
+# to full-sequence recompute (proven in tests/test_generation.py).
+
+LN_EPS = 1e-5
+
+
+class TransformerConfig(object):
+    """Static hyperparameters of one decoder-only LM — everything the
+    serving tier needs to rebuild the jax functions around a params
+    dict (JSON round-trip for the generative artifact)."""
+
+    __slots__ = ("vocab_size", "hidden", "num_layers", "num_heads",
+                 "ffn_mult", "max_seq", "eos_id")
+
+    def __init__(self, vocab_size, hidden=64, num_layers=2, num_heads=4,
+                 ffn_mult=4, max_seq=128, eos_id=None):
+        if hidden % num_heads:
+            raise ValueError("hidden=%d not divisible by num_heads=%d"
+                             % (hidden, num_heads))
+        self.vocab_size = int(vocab_size)
+        self.hidden = int(hidden)
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.ffn_mult = int(ffn_mult)
+        self.max_seq = int(max_seq)
+        self.eos_id = None if eos_id is None else int(eos_id)
+
+    @property
+    def head_dim(self):
+        return self.hidden // self.num_heads
+
+    def to_dict(self):
+        return {"vocab_size": self.vocab_size, "hidden": self.hidden,
+                "num_layers": self.num_layers, "num_heads": self.num_heads,
+                "ffn_mult": self.ffn_mult, "max_seq": self.max_seq,
+                "eos_id": self.eos_id}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(**d)
+
+
+def param_names(config):
+    """Declaration-ordered parameter names — exactly the ParamAttr names
+    transformer_lm creates, so trained scopes export losslessly."""
+    names = ["tok_emb", "pos_emb"]
+    for i in range(config.num_layers):
+        p = "blk%d" % i
+        names += [p + s for s in ("_ln1_w", "_ln1_b", "_q", "_k", "_v",
+                                  "_proj", "_ln2_w", "_ln2_b", "_up",
+                                  "_down")]
+    names += ["final_ln_w", "final_ln_b", "lm_head"]
+    return names
+
+
+def init_params(config, seed=0):
+    """Random float32 params (benchmarks/tests that don't train first).
+    Scaled-normal projections, unit layer norms — the shapes
+    transformer_lm's ParamAttrs would create."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    H, V, S = config.hidden, config.vocab_size, config.max_seq
+    F = H * config.ffn_mult
+
+    def w(shape, scale):
+        return (rng.randn(*shape) * scale).astype(np.float32)
+
+    p = {"tok_emb": w((V, H), 0.05), "pos_emb": w((S, H), 0.05)}
+    for i in range(config.num_layers):
+        pre = "blk%d" % i
+        p[pre + "_ln1_w"] = np.ones((H,), np.float32)
+        p[pre + "_ln1_b"] = np.zeros((H,), np.float32)
+        for s in ("_q", "_k", "_v", "_proj"):
+            p[pre + s] = w((H, H), (2.0 / H) ** 0.5)
+        p[pre + "_ln2_w"] = np.ones((H,), np.float32)
+        p[pre + "_ln2_b"] = np.zeros((H,), np.float32)
+        p[pre + "_up"] = w((H, F), (2.0 / H) ** 0.5)
+        p[pre + "_down"] = w((F, H), (2.0 / F) ** 0.5)
+    p["final_ln_w"] = np.ones((H,), np.float32)
+    p["final_ln_b"] = np.zeros((H,), np.float32)
+    p["lm_head"] = w((H, V), (2.0 / H) ** 0.5)
+    return p
+
+
+def params_from_scope(config, scope=None):
+    """Extract the trained transformer_lm weights from ``scope`` (default
+    global scope) as the {name: np.ndarray} dict the serving face runs
+    on. Raises with every missing name listed."""
+    import numpy as np
+    from ..core.scope import global_scope
+    scope = scope or global_scope()
+    out, missing = {}, []
+    for n in param_names(config):
+        v = scope.find_var(n) if scope.has_var(n) else None
+        if v is None:
+            missing.append(n)
+        else:
+            out[n] = np.asarray(v)
+    if missing:
+        raise ValueError(
+            "scope is missing transformer params %s — was transformer_lm "
+            "built with this config and the startup program run?" % missing)
+    return out
+
+
+def _ln(x, w, b):
+    import jax.numpy as jnp
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) / jnp.sqrt(var + LN_EPS) * w + b
+
+
+def _dense_causal_attention(q, k, v, num_heads):
+    """[B, S, H] q/k/v -> [B, S, H]; the ops/attention_ops dense lowering
+    verbatim (einsum scores, tril -inf mask, jax.nn.softmax)."""
+    import jax
+    import jax.numpy as jnp
+    B, S, H = q.shape
+    dh = H // num_heads
+    t = lambda a: (a.reshape(B, S, num_heads, dh)
+                   .transpose(0, 2, 1, 3).reshape(B * num_heads, S, dh))
+    s = jnp.einsum("bqd,bkd->bqk", t(q), t(k)) * dh ** -0.5
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    s = jnp.where(mask[None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bqk,bkd->bqd", p, t(v))
+    return (o.reshape(B, num_heads, S, dh)
+            .transpose(0, 2, 1, 3).reshape(B, S, H))
+
+
+def _forward_kv(params, tokens, config):
+    """Full forward over ``tokens`` [B, S] -> (logits [B, S, V],
+    k [L, B, S, nh, dh], v [L, B, S, nh, dh])."""
+    import jax.numpy as jnp
+    nh, dh = config.num_heads, config.head_dim
+    B, S = tokens.shape
+    ids = tokens.astype(jnp.int32)
+    x = jnp.take(params["tok_emb"], ids, axis=0) \
+        + jnp.take(params["pos_emb"], jnp.arange(S, dtype=jnp.int32),
+                   axis=0)[None]
+    ks, vs = [], []
+    for i in range(config.num_layers):
+        pre = "blk%d" % i
+        h = _ln(x, params[pre + "_ln1_w"], params[pre + "_ln1_b"])
+        q = h @ params[pre + "_q"]
+        k = h @ params[pre + "_k"]
+        v = h @ params[pre + "_v"]
+        ks.append(k.reshape(B, S, nh, dh))
+        vs.append(v.reshape(B, S, nh, dh))
+        att = _dense_causal_attention(q, k, v, nh)
+        x = x + att @ params[pre + "_proj"]
+        h2 = _ln(x, params[pre + "_ln2_w"], params[pre + "_ln2_b"])
+        up = jnp.maximum(h2 @ params[pre + "_up"], 0.0)
+        x = x + up @ params[pre + "_down"]
+    x = _ln(x, params["final_ln_w"], params["final_ln_b"])
+    logits = x @ params["lm_head"]
+    return logits, jnp.stack(ks), jnp.stack(vs)
+
+
+def forward(params, tokens, config):
+    """Full-sequence logits [B, S, V] — the pure-jax mirror of the
+    transformer_lm Program (parity test: tests/test_generation.py)."""
+    return _forward_kv(params, tokens, config)[0]
+
+
+def prefill_step(params, k_pages, v_pages, tokens, length, pages, config):
+    """One prompt (``tokens`` [S_bucket], real length ``length``) through
+    the full forward; per-layer K/V scattered into the paged pool at the
+    sequence's ``pages`` ([max_blocks], trash-padded) and the logits of
+    the last REAL position returned (the first sampled token's
+    distribution). Positions >= length route to the trash page — padding
+    never lands in live cache. Jit once per prompt bucket; donate the
+    pools."""
+    import jax.numpy as jnp
+    T = k_pages.shape[2]
+    trash = k_pages.shape[1] - 1
+    logits, k, v = _forward_kv(params, tokens[None], config)
+    pos = jnp.arange(tokens.shape[0], dtype=jnp.int32)
+    page = jnp.where(pos < length, pages[pos // T], trash)
+    slot = pos % T
+    k_pages = k_pages.at[:, page, slot].set(k[:, 0])
+    v_pages = v_pages.at[:, page, slot].set(v[:, 0])
+    return logits[0, length - 1], k_pages, v_pages
+
+
+def decode_step(params, k_pages, v_pages, block_tables, positions, tokens,
+                active, config):
+    """ONE fused token step for the whole running batch.
+
+    ``k_pages``/``v_pages``: [L, num_pages+1, page_tokens, nh, dh] (the
+    last page is the trash page — writes for inactive rows land there).
+    ``block_tables``: [R, max_blocks] int32 page ids, trash-padded.
+    ``positions``: [R] int32 — the new token's 0-based position (== how
+    many tokens the row has cached). ``tokens``: [R] int32 — the last
+    sampled token per row. ``active``: [R] bool.
+
+    Returns (logits [R, V], k_pages, v_pages). Every operand shape is
+    fixed by (max_running, pool shape), so the engine compiles this ONCE
+    and runs it at any mix of sequence lengths. Attention reads the
+    row's whole gathered table and masks columns > position to -inf:
+    exp(-inf)=0 exactly, so each row computes the same softmax row a
+    full-sequence forward would."""
+    import jax
+    import jax.numpy as jnp
+    nh, dh = config.num_heads, config.head_dim
+    R = tokens.shape[0]
+    T = k_pages.shape[2]
+    trash = k_pages.shape[1] - 1
+    C = block_tables.shape[1] * T          # max gatherable context
+    rows = jnp.arange(R, dtype=jnp.int32)
+    pos = positions.astype(jnp.int32)
+    x = jnp.take(params["tok_emb"], tokens.astype(jnp.int32), axis=0) \
+        + jnp.take(params["pos_emb"], pos, axis=0)
+    page = jnp.where(active, block_tables[rows, pos // T], trash)
+    slot = pos % T
+    colmask = (jnp.arange(C, dtype=jnp.int32)[None, :] <= pos[:, None])
+    for i in range(config.num_layers):
+        pre = "blk%d" % i
+        h = _ln(x, params[pre + "_ln1_w"], params[pre + "_ln1_b"])
+        q = (h @ params[pre + "_q"]).reshape(R, nh, dh)
+        k_new = (h @ params[pre + "_k"]).reshape(R, nh, dh)
+        v_new = (h @ params[pre + "_v"]).reshape(R, nh, dh)
+        k_pages = k_pages.at[i, page, slot].set(k_new)
+        v_pages = v_pages.at[i, page, slot].set(v_new)
+        # block-table gather: [R, max_blocks, T, nh, dh] -> [R, C, nh, dh]
+        kc = k_pages[i][block_tables].reshape(R, C, nh, dh)
+        vc = v_pages[i][block_tables].reshape(R, C, nh, dh)
+        s = jnp.einsum("rhd,rchd->rhc", q, kc) * dh ** -0.5
+        s = jnp.where(colmask[:, None, :], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        att = jnp.einsum("rhc,rchd->rhd", p, vc).reshape(R, nh * dh)
+        x = x + att @ params[pre + "_proj"]
+        h2 = _ln(x, params[pre + "_ln2_w"], params[pre + "_ln2_b"])
+        up = jnp.maximum(h2 @ params[pre + "_up"], 0.0)
+        x = x + up @ params[pre + "_down"]
+    x = _ln(x, params["final_ln_w"], params["final_ln_b"])
+    return x @ params["lm_head"], k_pages, v_pages
+
+
+class TransformerLM(object):
+    """Weights + config bound into the serving face the generation
+    engine drives: ``forward`` for references/parity, ``prefill_step``/
+    ``decode_step`` for the paged hot path. Params are moved to device
+    once (a generation process must not re-upload weights per step)."""
+
+    def __init__(self, params, config):
+        import jax
+        if isinstance(config, dict):
+            config = TransformerConfig.from_dict(config)
+        self.config = config
+        missing = [n for n in param_names(config) if n not in params]
+        if missing:
+            raise ValueError("params dict is missing %s" % missing)
+        self.params = {n: jax.device_put(params[n])
+                       for n in param_names(config)}
+
+    # -- pool geometry the engine builds around ------------------------------
+    @property
+    def kv_spec(self):
+        """(num_layers, num_heads, head_dim) of one cached position."""
+        c = self.config
+        return (c.num_layers, c.num_heads, c.head_dim)
+
+    # -- entry points (pure; the engine jits them) ---------------------------
+    def forward(self, tokens):
+        return forward(self.params, tokens, self.config)
+
+    def prefill_fn(self):
+        cfg = self.config
+
+        def fn(params, k_pages, v_pages, tokens, length, pages):
+            return prefill_step(params, k_pages, v_pages, tokens, length,
+                                pages, cfg)
+        return fn
+
+    def decode_fn(self):
+        cfg = self.config
+
+        def fn(params, k_pages, v_pages, block_tables, positions, tokens,
+               active):
+            return decode_step(params, k_pages, v_pages, block_tables,
+                               positions, tokens, active, cfg)
+        return fn

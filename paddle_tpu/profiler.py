@@ -26,7 +26,9 @@ __all__ = ["timer", "stat_summary", "print_stats", "reset_stats",
            "update_comm_counters", "comm_counters", "reset_comm_counters",
            "update_tune_counters", "tune_counters", "reset_tune_counters",
            "update_elastic_counters", "elastic_counters",
-           "reset_elastic_counters"]
+           "reset_elastic_counters",
+           "update_generation_counters", "generation_counters",
+           "reset_generation_counters"]
 
 _enabled = False
 _records = defaultdict(list)  # label -> [seconds]
@@ -37,6 +39,7 @@ _serving_counters = defaultdict(float)   # online-serving observability
 _comm_counters = defaultdict(float)      # gradient-communication observability
 _tune_counters = defaultdict(float)      # kernel-autotuning observability
 _elastic_counters = defaultdict(float)   # elasticity observability
+_generation_counters = defaultdict(float)  # autoregressive-serving observability
 _T0 = time.perf_counter()
 
 
@@ -81,6 +84,7 @@ def reset_profiler():
     _comm_counters.clear()
     _tune_counters.clear()
     _elastic_counters.clear()
+    _generation_counters.clear()
 
 
 def update_pipeline_counters(**counters):
@@ -200,6 +204,34 @@ def reset_elastic_counters():
     _elastic_counters.clear()
 
 
+_GEN_MAX_KEYS = frozenset(("gen_max_running", "gen_page_util_max"))
+
+
+def update_generation_counters(**counters):
+    """Accumulate autoregressive-serving observability counters
+    (paddle_tpu.serving.generator; a few dict adds per engine STEP or
+    per retired request, never per token-row). Keys in use:
+    ``gen_requests``, ``gen_completed``, ``gen_prefills``,
+    ``gen_decode_steps``, ``gen_tokens`` (generated, prompt excluded),
+    ``gen_shed_overload`` / ``gen_shed_deadline`` / ``gen_shed_pool``,
+    ``gen_preemptions``, ``gen_failed``; ``gen_max_running`` and
+    ``gen_page_util_max`` are kept as maxima, not sums."""
+    for k, v in counters.items():
+        if k in _GEN_MAX_KEYS:
+            _generation_counters[k] = max(_generation_counters[k], float(v))
+        else:
+            _generation_counters[k] += float(v)
+
+
+def generation_counters():
+    """Snapshot {counter: value} of the autoregressive-serving counters."""
+    return dict(_generation_counters)
+
+
+def reset_generation_counters():
+    _generation_counters.clear()
+
+
 def record_op_event(op_type, name, t_start, t_end):
     """Per-op span from the eager interpreter path (on the jit path the
     per-op loop does not exist at run time — op granularity comes from the
@@ -290,6 +322,10 @@ def write_timeline(path):
     - ``elastic``: elasticity counters (resizes, lost ranks, requeued
       tasks, resume latency) — the survive-and-resize evidence for
       paddle_tpu.elastic.
+    - ``generation``: autoregressive-serving counters (prefills, fused
+      decode steps, generated tokens, running-batch/page-utilization
+      maxima, sheds/preemptions) — the continuous-batching evidence for
+      paddle_tpu.serving.generator.
     """
     import json
     rows = []
@@ -310,6 +346,7 @@ def write_timeline(path):
         "comm": dict(_comm_counters),
         "tune": dict(_tune_counters),
         "elastic": dict(_elastic_counters),
+        "generation": dict(_generation_counters),
     }
     with open(path, "w") as f:
         json.dump(artifact, f, indent=1)

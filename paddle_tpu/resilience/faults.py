@@ -37,6 +37,17 @@ site                      where
                           the jit pre-trigger (a raise on a hot reload
                           rolls back to the serving version with a
                           recorded reload_rollback event)
+``serving.generate``      the generation engine's device edges, hit
+                          once per prefill and once per fused decode
+                          step: a raise at prefill fails THAT request
+                          (generate_failed event, slot and pages
+                          recycled); a raise at the decode step fails
+                          the running sequences (their cache rows are
+                          suspect) and the engine loop keeps admitting
+                          and serving — the serving.dispatch contract,
+                          generation-shaped; a delay models a slow
+                          device and stretches inter-token latency
+                          into the deadline shed path
 ``comm.quantize``         paddle_tpu.comm, per bucket at the quantised
                           all-reduce BUILD (trace time — the traced
                           collectives never re-enter the host): a raise

@@ -24,11 +24,29 @@ without letting a burst melt the queue. Three pieces:
   ``paddle_tpu.resilience`` degradation events so chaos specs cover the
   serving path.
 
+The autoregressive tier rides beside the micro-batcher (request-level
+stacking is wrong by construction for decode — a finished sequence
+would keep burning device time as padding):
+
+- :mod:`~paddle_tpu.serving.kvcache` — the paged KV pool: fixed-size
+  pages preallocated per model, per-sequence block tables, O(1) host
+  alloc/free, exhaustion as policy (shed/preempt + recorded
+  ``kv_pool_exhausted`` events), never a crash.
+- :mod:`~paddle_tpu.serving.generator` — continuous (iteration-level)
+  batching: one engine loop that admits prefills, runs ONE fused decode
+  step for the whole running batch through block-table gather attention
+  (compiled once — trace-free at any mix of sequence lengths), samples,
+  and retires finished sequences mid-flight so their pages recycle.
+  Greedy output is token-identical to sequential full-sequence decode.
+
 :class:`~paddle_tpu.serving.service.InferenceService` ties them together
-in-process; :mod:`~paddle_tpu.serving.httpd` puts a stdlib JSON endpoint
-in front of it, and ``paddle_tpu serve <artifact_dir>`` is the CLI verb.
-Knobs: ``FLAGS.serve_max_batch`` / ``serve_batch_timeout_ms`` /
-``serve_queue_depth``; architecture and overload semantics in
+in-process (``infer``/``infer_async`` + ``generate``/``generate_async``;
+``load_model`` auto-detects compiled vs generative artifacts);
+:mod:`~paddle_tpu.serving.httpd` puts a stdlib JSON endpoint in front of
+it, and ``paddle_tpu serve <artifact_dir>`` is the CLI verb. Knobs:
+``FLAGS.serve_max_batch`` / ``serve_batch_timeout_ms`` /
+``serve_queue_depth`` / ``serve_max_running`` / ``serve_kv_pages`` /
+``serve_page_tokens``; architecture and overload semantics in
 ``doc/serving.md``.
 """
 from __future__ import annotations
@@ -38,13 +56,23 @@ from .admission import (  # noqa: F401
     OverloadError, ServingError,
 )
 from .batcher import MicroBatcher, bucket_for, padding_buckets  # noqa: F401
+from .kvcache import (  # noqa: F401
+    BlockTable, PagePool, PoolExhausted, pages_for,
+)
 from .registry import ModelEntry, ModelRegistry  # noqa: F401
-from .service import InferenceService  # noqa: F401
+from .service import GenEntry, InferenceService  # noqa: F401
 from .httpd import make_server  # noqa: F401
+from .generator import (  # noqa: F401
+    GenerationEngine, GenRequest, GenResult, reference_decode,
+    sample_token,
+)
 
 __all__ = [
     "InferenceService", "ModelRegistry", "ModelEntry", "MicroBatcher",
     "AdmissionController", "ServingError", "OverloadError",
     "DeadlineExceededError", "ModelUnavailableError",
     "padding_buckets", "bucket_for", "make_server",
+    "PagePool", "BlockTable", "PoolExhausted", "pages_for",
+    "GenerationEngine", "GenRequest", "GenResult", "GenEntry",
+    "reference_decode", "sample_token",
 ]

@@ -3,11 +3,16 @@
 A TPU (and XLA generally) amortizes dispatch overhead over batch size;
 serving traffic arrives one request at a time. The micro-batcher bridges
 the two: requests enter a bounded queue, and a single dispatch thread
-forms batches per model — it takes the oldest pending request, then
-waits up to ``batch_timeout_ms`` (the latency/throughput knob) for more
-same-model requests before stacking up to ``max_batch`` of them and
-driving ONE ``CompiledModel.run_many`` device dispatch. Results are
-scattered back to the per-request futures.
+forms batches per **(model, feed-shape signature)** — it takes the
+oldest pending request, then waits up to ``batch_timeout_ms`` (the
+latency/throughput knob) for more same-model same-shape requests before
+stacking up to ``max_batch`` of them and driving ONE
+``CompiledModel.run_many`` device dispatch. Results are scattered back
+to the per-request futures. Shape-bucket routing means mixed-shape
+traffic to one model (e.g. per-shape artifact variants sharing a name,
+or a direct embedder whose model runs several shapes) coalesces into
+per-shape full batches instead of poisoning the stack — a batch is
+shape-homogeneous by construction.
 
 Two compile-stability rules keep the hot path trace-free:
 
@@ -38,7 +43,8 @@ import numpy as np
 from ..resilience import fault_point, record_event
 from .admission import ModelUnavailableError, ServingError
 
-__all__ = ["padding_buckets", "bucket_for", "Request", "MicroBatcher"]
+__all__ = ["padding_buckets", "bucket_for", "feed_shape_sig", "Request",
+           "MicroBatcher"]
 
 
 def padding_buckets(max_batch):
@@ -62,17 +68,33 @@ def bucket_for(r, buckets):
     return buckets[-1]
 
 
+def feed_shape_sig(feed):
+    """Canonical (name, shape) signature of one request's feed — the
+    shape-bucket routing key. Attribute-only on array-likes (never
+    np.asarray a possibly device-resident value); plain lists fall back
+    to np.shape."""
+    sig = []
+    for fn in sorted(feed):
+        v = feed[fn]
+        shape = getattr(v, "shape", None)
+        if shape is None:
+            shape = np.shape(v)
+        sig.append((fn, tuple(int(d) for d in shape)))
+    return tuple(sig)
+
+
 class Request(object):
     """One queued inference request; resolves to a list of per-fetch
     arrays (no leading batch axis added or removed — the rows are
     exactly what ``run()`` would have returned)."""
 
-    __slots__ = ("model", "feed", "deadline_t", "enqueue_t", "dequeue_t",
-                 "done_t", "_done", "_result", "_error")
+    __slots__ = ("model", "feed", "shape_sig", "deadline_t", "enqueue_t",
+                 "dequeue_t", "done_t", "_done", "_result", "_error")
 
     def __init__(self, model, feed, deadline_t=None):
         self.model = model
         self.feed = feed
+        self.shape_sig = feed_shape_sig(feed)
         self.deadline_t = deadline_t
         self.enqueue_t = time.monotonic()
         self.dequeue_t = None
@@ -135,7 +157,11 @@ class MicroBatcher(object):
         self._on_shed = on_shed or (lambda req, reason: None)
         self._on_batch = on_batch or (lambda reqs, bucket: None)
         self._on_fail = on_fail or (lambda reqs, exc: None)
-        self._queues = {}           # model name -> deque[Request]
+        # shape-bucket routing: queues are keyed (model, feed shape
+        # signature), so a formed batch is shape-homogeneous BY
+        # CONSTRUCTION — mixed-shape traffic to one model coalesces
+        # into per-shape full batches instead of poisoning np.stack
+        self._queues = {}           # (model, shape_sig) -> deque[Request]
         self._cond = threading.Condition()
         self._running = True
         self._thread = threading.Thread(target=self._dispatch_loop,
@@ -154,7 +180,8 @@ class MicroBatcher(object):
             self.admission.check_queue(self._pending_locked(),
                                        model=request.model)
             self._queues.setdefault(
-                request.model, collections.deque()).append(request)
+                (request.model, request.shape_sig),
+                collections.deque()).append(request)
             self._cond.notify_all()
         return request
 
@@ -171,23 +198,25 @@ class MicroBatcher(object):
             batch = self._form_batch()
             if batch is None:
                 return
-            name, requests = batch
+            (name, _sig), requests = batch
             if requests:
                 self._run_batch(name, requests)
 
     def _form_batch(self):
         """Block for work, then give later arrivals up to
         ``batch_timeout_s`` (measured from the OLDEST queued request) to
-        coalesce. Returns (model, [requests]) or None at shutdown."""
+        coalesce. Returns ((model, shape_sig), [requests]) or None at
+        shutdown."""
         with self._cond:
             while self._running and self._pending_locked() == 0:
                 self._cond.wait(0.1)
             if not self._running and self._pending_locked() == 0:
                 return None
-            # serve the model whose head request has waited longest
-            name = min((n for n, q in self._queues.items() if q),
-                       key=lambda n: self._queues[n][0].enqueue_t)
-            q = self._queues[name]
+            # serve the (model, shape) queue whose head has waited
+            # longest — later same-shape arrivals coalesce behind it
+            key = min((k for k, q in self._queues.items() if q),
+                      key=lambda k: self._queues[k][0].enqueue_t)
+            q = self._queues[key]
             form_deadline = q[0].enqueue_t + self.batch_timeout_s
             while self._running and len(q) < self.max_batch:
                 rem = form_deadline - time.monotonic()
@@ -199,16 +228,16 @@ class MicroBatcher(object):
                 # lock): it already collected and failed these requests
                 # as shutdown orphans — popping our stale deque ref
                 # would dispatch work whose futures are dead
-                return name, []
+                return key, []
             now = time.monotonic()
             take = min(len(q), self.max_batch)
             requests = [q.popleft() for _ in range(take)]
             for r in requests:
                 r.dequeue_t = now
             if not q:
-                del self._queues[name]
+                del self._queues[key]
             self._cond.notify_all()
-        return name, requests
+        return key, requests
 
     def _run_batch(self, name, requests):
         # shed what is already dead, then dispatch the rest as one stack

@@ -1,0 +1,779 @@
+"""Continuous (iteration-level) batching: the autoregressive engine.
+
+The PR-4 micro-batcher is *request*-level: it stacks whole requests and
+returns when the whole stack returns — correct for one-shot inference,
+wrong by construction for autoregressive decode, where a batch would run
+at the pace of its longest sequence and every finished row would keep
+burning device time as padding. This engine schedules at the *iteration*
+level (Orca; vLLM's continuous batching, PAPERS.md): one loop that each
+step
+
+1. **admits** queued prompts into free slots while their page
+   reservation fits (token-budget admission over the paged KV pool),
+   running one prefill per admitted prompt (traced once per
+   prompt-length bucket),
+2. runs **ONE fused decode step** for every running sequence at once —
+   sequences at arbitrary, different positions — via
+   ``models/transformer.decode_step``'s block-table gather attention;
+   the step's operand shapes are fixed by (max_running, pool shape), so
+   it is compiled ONCE and the hot loop is trace-free at any mix of
+   sequence lengths,
+3. **samples** (greedy or temperature) on the host from the returned
+   logits, and
+4. **retires** finished sequences immediately — their slot and pages
+   recycle into the next step's admission, mid-flight.
+
+Degrade-and-record, never crash: pool exhaustion at submit is a shed
+with a recorded ``kv_pool_exhausted`` event; mid-flight starvation (only
+possible under ``reserve="prompt"``) preempts the starved sequence back
+to the queue head (recompute-on-resume — greedy decode re-derives the
+same continuation) or sheds it when preemption cannot help; a raise at
+fault site ``serving.generate`` fails that step's sequences with a
+``generate_failed`` event and the loop keeps serving.
+
+Knobs: ``FLAGS.serve_max_running`` / ``serve_kv_pages`` /
+``serve_page_tokens`` / ``serve_queue_depth``. Metrics mirror into
+``profiler.generation_counters()`` and the timeline artifact's
+``generation`` section.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+import numpy as np
+
+from ..resilience import fault_point, record_event
+from .admission import (AdmissionController, DeadlineExceededError,
+                        OverloadError, ServingError)
+from .batcher import bucket_for, padding_buckets
+from .kvcache import BlockTable, PagePool, PoolExhausted, pages_for
+from .service import _WINDOW, _percentile
+
+__all__ = ["GenRequest", "GenResult", "GenerationEngine", "sample_token",
+           "reference_decode"]
+
+# how many preemptions one request may absorb before the engine calls
+# the pool genuinely too small for it and sheds instead of thrashing
+_PREEMPT_LIMIT = 2
+
+
+def sample_token(logits, temperature, rng):
+    """One token id from a [V] logits row — THE sampling rule, shared by
+    the engine, the sequential reference, and the benchmarks so parity
+    can never drift. ``temperature <= 0`` is greedy (np.argmax,
+    deterministic tie-break); otherwise softmax at ``temperature``
+    sampled with ``rng`` (np.random.RandomState)."""
+    logits = np.asarray(logits, np.float64)
+    if temperature is None or temperature <= 0.0:
+        return int(np.argmax(logits))
+    z = (logits - logits.max()) / float(temperature)
+    p = np.exp(z)
+    p /= p.sum()
+    return int(rng.choice(len(p), p=p))
+
+
+def reference_decode(model, prompt, max_new_tokens, temperature=0.0,
+                     seed=0, eos_id=None):
+    """Sequential full-sequence decode: recompute the whole forward per
+    token, no cache — the slow, obviously-correct decoder the
+    continuous-batching parity proof compares against (greedy outputs
+    must be token-identical)."""
+    import jax.numpy as jnp
+    if eos_id is None:
+        eos_id = model.config.eos_id
+    toks = [int(t) for t in prompt]
+    out = []
+    rng = np.random.RandomState(seed)
+    for _ in range(int(max_new_tokens)):
+        logits = np.asarray(
+            model.forward(jnp.asarray([toks], jnp.int32)))[0, -1]
+        t = sample_token(logits, temperature, rng)
+        out.append(t)
+        toks.append(t)
+        if eos_id is not None and t == eos_id:
+            break
+    return out
+
+
+class GenResult(object):
+    """What a finished generation resolves to."""
+
+    __slots__ = ("tokens", "finish_reason", "ttft_ms", "latency_ms",
+                 "preemptions")
+
+    def __init__(self, tokens, finish_reason, ttft_ms, latency_ms,
+                 preemptions):
+        self.tokens = tokens
+        self.finish_reason = finish_reason
+        self.ttft_ms = ttft_ms
+        self.latency_ms = latency_ms
+        self.preemptions = preemptions
+
+    def describe(self):
+        return {"tokens": list(self.tokens),
+                "finish_reason": self.finish_reason,
+                "ttft_ms": round(self.ttft_ms, 3),
+                "latency_ms": round(self.latency_ms, 3),
+                "preemptions": self.preemptions}
+
+
+class GenRequest(object):
+    """One queued/running generation; resolves to a :class:`GenResult`.
+
+    Sampled tokens accumulate HERE (not on the running slot), so a
+    preempted request carries its progress back through the queue and
+    resumes by prefilling prompt+progress — no token is ever re-sampled,
+    and its RNG stream continues where it stopped."""
+
+    __slots__ = ("prompt", "max_new_tokens", "temperature", "seed",
+                 "deadline_t", "enqueue_t", "tokens", "preemptions",
+                 "model_version", "_rng", "_ttft_ms", "_done", "_result",
+                 "_error")
+
+    def __init__(self, prompt, max_new_tokens, temperature=0.0, seed=0,
+                 deadline_t=None):
+        self.prompt = [int(t) for t in prompt]
+        # stamped by InferenceService.generate_async: the registry
+        # version of the engine that took this submit
+        self.model_version = None
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature or 0.0)
+        self.seed = int(seed or 0)
+        self.deadline_t = deadline_t
+        self.enqueue_t = time.monotonic()
+        self.tokens = []
+        self.preemptions = 0
+        self._rng = np.random.RandomState(self.seed)
+        self._ttft_ms = None
+        self._done = threading.Event()
+        self._result = None
+        self._error = None
+
+    @property
+    def budget_left(self):
+        return self.max_new_tokens - len(self.tokens)
+
+    @property
+    def pending_prompt(self):
+        """What a (re)prefill must feed: original prompt + progress."""
+        return self.prompt + self.tokens
+
+    def resolve(self, finish_reason):
+        self._result = GenResult(
+            list(self.tokens), finish_reason,
+            self._ttft_ms if self._ttft_ms is not None else 0.0,
+            (time.monotonic() - self.enqueue_t) * 1e3, self.preemptions)
+        self._done.set()
+
+    def fail(self, exc):
+        self._error = exc
+        self._done.set()
+
+    @property
+    def done(self):
+        return self._done.is_set()
+
+    def wait(self, timeout=None):
+        """Block for the :class:`GenResult`; re-raises shed/step errors."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("generation still pending after %.3fs"
+                               % (timeout,))
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class _Running(object):
+    """One occupied engine slot."""
+
+    __slots__ = ("req", "slot", "table", "cached", "last_token", "last_t")
+
+    def __init__(self, req, slot, table):
+        self.req = req
+        self.slot = slot
+        self.table = table
+        self.cached = 0          # positions written into the paged cache
+        self.last_token = None   # next decode step's input token
+        self.last_t = time.monotonic()
+
+
+class GenerationEngine(object):
+    """The per-model generation engine: paged KV pool + one engine
+    thread running the admit/decode/sample/retire loop.
+
+    ``reserve`` — the token-budget admission policy:
+
+    - ``"full"`` (default): admission reserves pages for
+      prompt + max_new_tokens, so a running sequence can never starve
+      mid-flight; occupancy is bounded by worst-case reservations.
+    - ``"prompt"``: admission reserves the prompt only and pages are
+      allocated on demand at block boundaries; higher admission
+      throughput, and mid-flight starvation is handled by preemption
+      (recompute-on-resume) with a recorded ``kv_pool_exhausted`` event.
+    """
+
+    def __init__(self, model, max_running=None, kv_pages=None,
+                 page_tokens=None, queue_depth=None, reserve="full",
+                 eos_id=None, name="model", warm=False):
+        import jax
+        from ..flags import FLAGS
+        if reserve not in ("full", "prompt"):
+            raise ValueError("reserve must be 'full' or 'prompt'")
+        self.model = model
+        self.name = name
+        self.reserve = reserve
+        self.max_running = int(max_running if max_running is not None
+                               else FLAGS.serve_max_running)
+        self.queue_depth = int(queue_depth if queue_depth is not None
+                               else FLAGS.serve_queue_depth)
+        page_tokens = int(page_tokens if page_tokens is not None
+                          else FLAGS.serve_page_tokens)
+        kv_pages = int(kv_pages if kv_pages is not None
+                       else FLAGS.serve_kv_pages)
+        cfg = model.config
+        self.eos_id = cfg.eos_id if eos_id is None else int(eos_id)
+        self.max_context = int(cfg.max_seq)
+        self.max_blocks = pages_for(self.max_context, page_tokens)
+        L, nh, dh = model.kv_spec
+        self.pool = PagePool(kv_pages, page_tokens, L, nh, dh)
+        self._kp, self._vp = self.pool.zeros()
+        # the two compiled faces: decode ONCE per (max_running, pool),
+        # prefill once per prompt-length bucket; pools are donated so
+        # the cache is updated in place step to step
+        self._decode = jax.jit(model.decode_fn(), donate_argnums=(1, 2))
+        self._prefill = jax.jit(model.prefill_fn(), donate_argnums=(1, 2))
+        # prompt-length buckets share the batcher's padding policy (ONE
+        # powers-of-two-capped algorithm for both tiers)
+        self._buckets = padding_buckets(self.max_context)
+        self._queue = collections.deque()
+        self._seqs = []            # _Running, slot-ordered
+        self._admitting = 0        # popped from queue, prefill underway
+        #   (in neither _queue nor _seqs — drain must count these too)
+        self._free_slots = list(range(self.max_running))
+        self._cond = threading.Condition()
+        self._alive = True
+        self._draining = False
+        self._counts = collections.Counter()
+        self._busy_s = 0.0
+        self._occupancy_sum = 0
+        self._max_running_seen = 0
+        self._page_util_max = 0.0
+        self._ttft_ms = collections.deque(maxlen=_WINDOW)
+        self._intertoken_ms = collections.deque(maxlen=_WINDOW)
+        # warm BEFORE the engine thread exists — warm_up and the loop
+        # share the donated pool arrays
+        self.warmup_ms = self.warm_up() if warm else 0.0
+        self._thread = threading.Thread(
+            target=self._loop, name="paddle_tpu-generate-" + name,
+            daemon=True)
+        self._thread.start()
+
+    def warm_up(self, buckets=None):
+        """Pre-trigger every compile the request path can need — the
+        fused decode step and each prompt bucket's prefill — with
+        all-trash block tables, so the warm traffic writes only to the
+        trash page and the live cache stays untouched. Returns the
+        warm-up wall time in ms (the registry's load convention).
+        Runs from the constructor (``warm=True``) before the engine
+        thread starts; on a live engine it would race the loop's
+        ownership of the donated pool arrays — don't."""
+        import jax.numpy as jnp
+        t0 = time.monotonic()
+        trash_row = np.full((self.max_blocks,), self.pool.trash_page,
+                            np.int32)
+        for S_b in (self._buckets if buckets is None else buckets):
+            _, self._kp, self._vp = self._prefill(
+                self.model.params, self._kp, self._vp,
+                jnp.asarray(np.zeros((S_b,), np.int32)), np.int32(1),
+                jnp.asarray(trash_row))
+        R = self.max_running
+        _, self._kp, self._vp = self._decode(
+            self.model.params, self._kp, self._vp,
+            jnp.asarray(np.tile(trash_row, (R, 1))),
+            jnp.asarray(np.zeros((R,), np.int32)),
+            jnp.asarray(np.zeros((R,), np.int32)),
+            jnp.asarray(np.zeros((R,), bool)))
+        return (time.monotonic() - t0) * 1e3
+
+    # -- submit side ---------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=16, temperature=0.0, seed=0,
+               deadline_ms=None):
+        """Queue one prompt; returns the :class:`GenRequest` handle.
+        Sheds NOW (with the house recorded events) when the queue is
+        full, the request could never fit the pool, or it exceeds the
+        model's context window."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("prompt must hold at least one token id")
+        V = self.model.config.vocab_size
+        if min(prompt) < 0 or max(prompt) >= V:
+            raise ValueError("prompt token ids must be in [0, %d)" % V)
+        max_new_tokens = int(max_new_tokens)
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        temperature = float(temperature or 0.0)
+        if not np.isfinite(temperature) or temperature < 0.0:
+            # reject HERE, on the caller's thread: json accepts NaN, and
+            # a NaN temperature reaching sample_token would raise on the
+            # engine thread and fail every other in-flight generation
+            raise ValueError("temperature must be finite and >= 0.0, "
+                             "got %r" % temperature)
+        total = len(prompt) + max_new_tokens
+        if total > self.max_context:
+            raise ValueError(
+                "prompt (%d) + max_new_tokens (%d) exceeds the model "
+                "context window (%d)" % (len(prompt), max_new_tokens,
+                                         self.max_context))
+        if not self.pool.can_fit(total):
+            record_event("kv_pool_exhausted", site="serving.generate",
+                         action="shed", model=self.name,
+                         want_pages=pages_for(total,
+                                              self.pool.page_tokens),
+                         pool_pages=self.pool.num_pages)
+            with self._cond:
+                self._counts["shed_pool"] += 1
+            self._update_prof(gen_shed_pool=1)
+            raise PoolExhausted(
+                "request needs %d token(s) of cache; the pool holds %d "
+                "(serve_kv_pages=%d x serve_page_tokens=%d) — shed "
+                "instead of wedging the engine"
+                % (total, self.pool.num_pages * self.pool.page_tokens,
+                   self.pool.num_pages, self.pool.page_tokens))
+        req = GenRequest(prompt, max_new_tokens, temperature, seed,
+                         AdmissionController.deadline_from(deadline_ms))
+        with self._cond:
+            if not self._alive:
+                raise ServingError("generation engine is closed")
+            if self._draining:
+                raise ServingError(
+                    "generation engine is draining (hot reload in "
+                    "progress) — resubmit to the replacement engine")
+            if len(self._queue) >= self.queue_depth:
+                record_event("request_shed", site="serving.generate",
+                             reason="overload", model=self.name,
+                             queue_depth=self.queue_depth)
+                self._counts["shed_overload"] += 1
+                self._update_prof(gen_shed_overload=1)
+                raise OverloadError(
+                    "generation queue full (%d pending >= queue_depth="
+                    "%d); request shed — retry with backoff or raise "
+                    "FLAGS.serve_queue_depth"
+                    % (len(self._queue), self.queue_depth))
+            self._counts["submitted"] += 1
+            self._queue.append(req)
+            self._cond.notify_all()
+        self._update_prof(gen_requests=1)
+        return req
+
+    def generate(self, prompt, max_new_tokens=16, temperature=0.0, seed=0,
+                 deadline_ms=None, timeout=None):
+        """Blocking convenience: submit + wait -> :class:`GenResult`."""
+        return self.submit(prompt, max_new_tokens, temperature, seed,
+                           deadline_ms).wait(timeout)
+
+    # -- engine loop ---------------------------------------------------------
+    def _loop(self):
+        while True:
+            with self._cond:
+                while self._alive and not self._queue and not self._seqs:
+                    self._cond.wait(0.1)
+                if not self._alive:
+                    return
+            try:
+                self._admit()
+                if self._seqs:
+                    self._step()
+                else:
+                    # queued work that cannot admit yet (e.g. a requeue
+                    # race on the pool): block briefly instead of
+                    # spinning the admission check
+                    with self._cond:
+                        if self._alive and self._queue:
+                            self._cond.wait(0.01)
+            except BaseException as e:
+                # engine-thread bugs degrade to failed requests, never a
+                # silently dead loop (the batcher's contract)
+                self._fail_running(e)
+
+    def drain(self, timeout=None):
+        """Stop accepting new submits and wait for the queue and the
+        running set to empty — the hot-reload handover: in-flight
+        generations finish on THIS engine while the replacement takes
+        new traffic. Returns True when fully drained, False on timeout
+        (the caller decides whether to close anyway)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            self._draining = True
+            while self._alive and (self._queue or self._seqs
+                                   or self._admitting):
+                if deadline is not None and time.monotonic() >= deadline:
+                    return False
+                self._cond.wait(0.05)
+            return not (self._queue or self._seqs or self._admitting)
+
+    def close(self):
+        """Stop the engine; queued and running requests fail with
+        :class:`ServingError` (idempotent). For a graceful handover
+        call :meth:`drain` first."""
+        with self._cond:
+            if not self._alive:
+                return
+            self._alive = False
+            orphans = list(self._queue)
+            self._queue.clear()
+            self._cond.notify_all()
+        for r in orphans:
+            r.fail(ServingError("generation engine shut down before "
+                                "dispatch"))
+        if self._thread.is_alive() and \
+                threading.current_thread() is not self._thread:
+            self._thread.join(timeout=10.0)
+        for s in list(self._seqs):
+            s.table.release()
+            if not s.req.done:
+                s.req.fail(ServingError("generation engine shut down "
+                                        "mid-flight"))
+        del self._seqs[:]
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- admission ------------------------------------------------------------
+    def _reserve_tokens(self, req):
+        """Cache positions ``req`` needs up front — the ONE encoding of
+        the reserve policy, shared by admission (page arithmetic) and
+        ``_start`` (actual allocation) so they cannot disagree:
+        ``full`` holds the whole generation budget, ``prompt`` only the
+        prefill (later growth may preempt)."""
+        if self.reserve == "full":
+            return len(req.pending_prompt) + req.budget_left
+        return len(req.pending_prompt)
+
+    def _reservation(self, req):
+        """Pages admission must see free before ``req`` may start."""
+        return pages_for(self._reserve_tokens(req), self.pool.page_tokens)
+
+    def _admit(self):
+        """Move queued requests into free slots while their reservation
+        fits (FIFO — a big head request waits rather than starve)."""
+        while True:
+            with self._cond:
+                if not self._queue or not self._free_slots:
+                    return
+                req = self._queue[0]
+                if AdmissionController.expired(req):
+                    self._queue.popleft()
+                    self._shed_deadline(req)
+                    continue
+                if self._reservation(req) > self.pool.available:
+                    return
+                self._queue.popleft()
+                slot = self._free_slots.pop(0)
+                self._admitting += 1
+            try:
+                self._start(req, slot)
+            except PoolExhausted as e:
+                # raced another consumer of the pool (shouldn't happen
+                # with one engine thread, but the accounting is shared):
+                # put both back and retry next iteration
+                with self._cond:
+                    self._queue.appendleft(req)
+                    self._free_slots.insert(0, slot)
+                    self._free_slots.sort()
+                record_event("kv_pool_exhausted", site="serving.generate",
+                             action="requeue", model=self.name,
+                             error=repr(e))
+                return
+            finally:
+                with self._cond:
+                    self._admitting -= 1
+                    self._cond.notify_all()
+
+    def _start(self, req, slot):
+        """Prefill ``req`` into its freshly allocated block table and
+        sample its first token; may retire immediately (budget 1/eos)."""
+        import jax.numpy as jnp
+        prompt = req.pending_prompt
+        table = BlockTable(self.pool)
+        table.ensure(self._reserve_tokens(req))
+        t0 = time.monotonic()
+        try:
+            fault_point("serving.generate")
+            S_b = bucket_for(len(prompt), self._buckets)
+            padded = np.zeros((S_b,), np.int32)
+            padded[:len(prompt)] = prompt
+            last, self._kp, self._vp = self._prefill(
+                self.model.params, self._kp, self._vp,
+                jnp.asarray(padded), np.int32(len(prompt)),
+                jnp.asarray(table.as_row(self.max_blocks)))
+            logits = np.asarray(last)
+        except BaseException as e:
+            table.release()
+            with self._cond:
+                self._free_slots.append(slot)
+                self._free_slots.sort()
+                self._counts["failed"] += 1
+            record_event("generate_failed", site="serving.generate",
+                         model=self.name, phase="prefill", error=repr(e))
+            self._update_prof(gen_failed=1)
+            req.fail(e)
+            if self._ensure_pools():
+                # the raise consumed the donated pool arrays — every
+                # running sequence's cache went with them
+                self._fail_running(ServingError(
+                    "kv pool arrays lost to a failed prefill: %r" % (e,)))
+            return
+        self._busy_s += time.monotonic() - t0
+        run = _Running(req, slot, table)
+        run.cached = len(prompt)
+        with self._cond:
+            self._counts["prefills"] += 1
+            self._counts["prompt_tokens"] += len(prompt)
+            self._counts["tokens"] += 1    # the prefill's first token
+            self._seqs.append(run)
+            self._seqs.sort(key=lambda s: s.slot)
+            self._max_running_seen = max(self._max_running_seen,
+                                         len(self._seqs))
+        self._update_prof(gen_prefills=1, gen_tokens=1,
+                          gen_max_running=len(self._seqs))
+        self._accept_token(run, logits)
+
+    # -- the fused decode step ------------------------------------------------
+    def _step(self):
+        import jax.numpy as jnp
+        self._grow_tables()
+        seqs = list(self._seqs)
+        if not seqs:
+            return
+        R, MB = self.max_running, self.max_blocks
+        tables = np.full((R, MB), self.pool.trash_page, np.int32)
+        positions = np.zeros((R,), np.int32)
+        tokens = np.zeros((R,), np.int32)
+        active = np.zeros((R,), bool)
+        for s in seqs:
+            tables[s.slot] = s.table.as_row(MB)
+            positions[s.slot] = s.cached
+            tokens[s.slot] = s.last_token
+            active[s.slot] = True
+        t0 = time.monotonic()
+        try:
+            fault_point("serving.generate")
+            logits, self._kp, self._vp = self._decode(
+                self.model.params, self._kp, self._vp,
+                jnp.asarray(tables), jnp.asarray(positions),
+                jnp.asarray(tokens), jnp.asarray(active))
+            rows = np.asarray(logits)
+        except BaseException as e:
+            self._fail_running(e)
+            self._ensure_pools()
+            return
+        self._busy_s += time.monotonic() - t0
+        util = self.pool.utilization()["frac"]
+        # token counters flush ONCE per fused step (every running row
+        # accepts exactly one token below) — per-row updates on the hot
+        # loop are the profiler contract violation its docstring names
+        with self._cond:
+            self._counts["decode_steps"] += 1
+            self._counts["tokens"] += len(seqs)
+            self._occupancy_sum += len(seqs)
+            self._page_util_max = max(self._page_util_max, util)
+        self._update_prof(gen_decode_steps=1, gen_page_util_max=util,
+                          gen_tokens=len(seqs))
+        for s in seqs:
+            s.cached += 1
+            self._accept_token(s, rows[s.slot])
+
+    def _ensure_pools(self):
+        """A raise from INSIDE a donated jitted call (device OOM,
+        XlaRuntimeError) consumes the pool arrays before it surfaces —
+        without this, every later prefill/decode would hit
+        'Array has been deleted' and the engine would fail forever
+        while claiming to keep serving. Rebuild the arrays when that
+        happened; the caller must already have failed every sequence
+        whose cache lived in the lost buffers. Returns True when a
+        rebuild was needed."""
+        deleted = getattr(self._kp, "is_deleted", None)
+        if deleted is None or not deleted():
+            return False
+        self._kp, self._vp = self.pool.zeros()
+        return True
+
+    def _grow_tables(self):
+        """Make room for each running row's next position; starvation
+        preempts (or sheds, when preemption cannot help)."""
+        for s in list(self._seqs):
+            try:
+                s.table.ensure(s.cached + 1)
+            except PoolExhausted:
+                if len(self._seqs) > 1 and \
+                        s.req.preemptions < _PREEMPT_LIMIT:
+                    self._preempt(s)
+                else:
+                    self._shed_pool(s)
+
+    def _evict(self, s, counter=None, requeue=False):
+        """The one eviction primitive: release the row's pages, recycle
+        its slot, optionally bump a counter / re-queue its request
+        (front), and wake drain()/admission waiters. Every path that
+        removes a running sequence — retire, preempt, shed, deadline,
+        step failure — MUST come through here so the lock discipline
+        and free-slot ordering cannot drift apart. What happens to the
+        request afterwards (resolve/fail) is the caller's job."""
+        s.table.release()
+        with self._cond:
+            if s in self._seqs:
+                self._seqs.remove(s)
+            self._free_slots.append(s.slot)
+            self._free_slots.sort()
+            if counter is not None:
+                self._counts[counter] += 1
+            if requeue:
+                self._queue.appendleft(s.req)
+            self._cond.notify_all()
+
+    def _preempt(self, s):
+        """Recompute-on-resume: free the row's pages and re-queue the
+        request (front) carrying its progress — greedy decode re-derives
+        the same continuation from prompt+progress, so preemption is
+        invisible in the output stream."""
+        record_event("kv_pool_exhausted", site="serving.generate",
+                     action="preempt", model=self.name,
+                     generated=len(s.req.tokens),
+                     preemptions=s.req.preemptions + 1)
+        s.req.preemptions += 1
+        self._evict(s, counter="preemptions", requeue=True)
+        self._update_prof(gen_preemptions=1)
+
+    def _shed_pool(self, s):
+        record_event("kv_pool_exhausted", site="serving.generate",
+                     action="shed", model=self.name,
+                     generated=len(s.req.tokens))
+        self._evict(s, counter="shed_pool")
+        self._update_prof(gen_shed_pool=1)
+        s.req.fail(PoolExhausted(
+            "kv page pool exhausted mid-flight after %d generated "
+            "token(s) and preemption could not help — shrink "
+            "max_new_tokens, raise FLAGS.serve_kv_pages, or use "
+            "reserve='full' admission" % len(s.req.tokens)))
+
+    # -- sampling / retirement ------------------------------------------------
+    def _accept_token(self, s, logits):
+        req = s.req
+        now = time.monotonic()
+        tok = sample_token(logits, req.temperature, req._rng)
+        req.tokens.append(tok)
+        s.last_token = tok
+        if req._ttft_ms is None:
+            req._ttft_ms = (now - req.enqueue_t) * 1e3
+            self._ttft_ms.append(req._ttft_ms)
+        else:
+            self._intertoken_ms.append((now - s.last_t) * 1e3)
+        s.last_t = now
+        if self.eos_id is not None and tok == self.eos_id:
+            self._retire(s, "eos")
+        elif req.budget_left <= 0:
+            self._retire(s, "length")
+        elif AdmissionController.expired(req):
+            self._retire_deadline(s)
+
+    def _retire(self, s, reason):
+        """Finish a sequence NOW: its pages and slot recycle into the
+        very next admission — the continuous half of the batching."""
+        self._evict(s, counter="completed")
+        self._update_prof(gen_completed=1)
+        s.req.resolve(reason)
+
+    def _retire_deadline(self, s):
+        self._evict(s)
+        self._shed_deadline(s.req, generated=len(s.req.tokens))
+
+    def _shed_deadline(self, req, generated=0):
+        late_ms = (time.monotonic() - req.deadline_t) * 1e3
+        record_event("request_shed", site="serving.generate",
+                     reason="deadline", model=self.name, late_ms=late_ms,
+                     generated=generated)
+        with self._cond:
+            self._counts["shed_deadline"] += 1
+        self._update_prof(gen_shed_deadline=1)
+        req.fail(DeadlineExceededError(
+            "generation deadline exceeded %.1f ms ago (%d token(s) "
+            "generated); shed instead of serving a dead client"
+            % (late_ms, generated)))
+
+    def _fail_running(self, exc):
+        """A raise at the fused step fails the RUNNING sequences (their
+        cache rows are suspect) and the loop keeps serving — the
+        batcher's batch_failed contract, generation-shaped."""
+        seqs = list(self._seqs)
+        if not seqs:
+            return
+        record_event("generate_failed", site="serving.generate",
+                     model=self.name, phase="decode",
+                     sequences=len(seqs), error=repr(exc))
+        for s in seqs:
+            self._evict(s, counter="failed")
+            s.req.fail(exc)
+        self._update_prof(gen_failed=len(seqs))
+
+    # -- metrics --------------------------------------------------------------
+    @staticmethod
+    def _trace_count(fn):
+        """Compiled-trace count via the jit wrapper's cache probe — a
+        private jax surface (no public one exists), so degrade to -1
+        when a jax bump renames it rather than 500-ing every /statz."""
+        probe = getattr(fn, "_cache_size", None)
+        try:
+            return int(probe()) if probe is not None else -1
+        except Exception:
+            return -1
+
+    @staticmethod
+    def _update_prof(**kw):
+        from .. import profiler as _prof
+        _prof.update_generation_counters(**kw)
+
+    @property
+    def stats(self):
+        """Snapshot of the generation metrics surface."""
+        with self._cond:
+            c = dict(self._counts)
+            steps = c.get("decode_steps", 0)
+            ttft = list(self._ttft_ms)
+            itl = list(self._intertoken_ms)
+            snap = {
+                "submitted": c.get("submitted", 0),
+                "completed": c.get("completed", 0),
+                "failed": c.get("failed", 0),
+                "shed_overload": c.get("shed_overload", 0),
+                "shed_deadline": c.get("shed_deadline", 0),
+                "shed_pool": c.get("shed_pool", 0),
+                "preemptions": c.get("preemptions", 0),
+                "prefills": c.get("prefills", 0),
+                "decode_steps": steps,
+                "tokens_generated": c.get("tokens", 0),
+                "prompt_tokens": c.get("prompt_tokens", 0),
+                "queued": len(self._queue),
+                "running": len(self._seqs),
+                "max_running_seen": self._max_running_seen,
+                "running_occupancy": (self._occupancy_sum / steps
+                                      if steps else 0.0),
+                "page_utilization": self.pool.utilization(),
+                "page_utilization_max": self._page_util_max,
+                "ttft_ms_p50": _percentile(ttft, 0.50),
+                "ttft_ms_p99": _percentile(ttft, 0.99),
+                "intertoken_ms_p50": _percentile(itl, 0.50),
+                "intertoken_ms_p99": _percentile(itl, 0.99),
+                "tokens_per_s": (c.get("tokens", 0) / self._busy_s
+                                 if self._busy_s > 0 else 0.0),
+                "decode_traces": self._trace_count(self._decode),
+                "prefill_traces": self._trace_count(self._prefill),
+            }
+        snap["shed"] = (snap["shed_overload"] + snap["shed_deadline"]
+                        + snap["shed_pool"])
+        return snap

@@ -13,19 +13,28 @@ POST   ``/v1/models/<name>:predict``    ``{"inputs": {feed: nested-list},
                                         "deadline_ms": optional}`` ->
                                         ``{"outputs": [...], "model":
                                         name, "version": v}``
+POST   ``/v1/models/<name>:generate``   ``{"tokens": [ids],
+                                        "max_new_tokens": N,
+                                        "temperature": t, "seed": s,
+                                        "deadline_ms": optional}`` ->
+                                        ``{"tokens": [...],
+                                        "finish_reason": ...,
+                                        "ttft_ms": ..., ...}``
 POST   ``/v1/models/<name>:reload``     ``{"dirname": path}`` -> new
                                         version, or 409 + rollback info
-GET    ``/v1/models``                   registry listing
+GET    ``/v1/models``                   registry listing (both kinds)
 GET    ``/healthz``                     liveness + registered models
 GET    ``/statz``                       ``InferenceService.stats``
 ====== ================================ ===================================
 
-Error mapping: 429 overload shed, 504 deadline shed, 404 unknown model,
-400 malformed input, 500 dispatch failure — each body carries
-``{"error": ..., "kind": ...}``. The server is a
+Error mapping: 429 overload shed (and kv-pool exhaustion — kind
+``kv_pool_exhausted``: backpressure, not a server fault), 504 deadline
+shed, 404 unknown model, 400 malformed input, 500 dispatch failure —
+each body carries ``{"error": ..., "kind": ...}``. The server is a
 ``ThreadingHTTPServer``: one thread per connection *blocks* in
-``InferenceService.infer`` while the single dispatch thread batches
-across them — concurrency lives in the batcher, not here.
+``InferenceService.infer``/``generate`` while a single dispatch/engine
+thread batches across them — concurrency lives in the batcher and the
+generation engine, not here.
 """
 from __future__ import annotations
 
@@ -77,11 +86,11 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         if self.path == "/healthz":
             self._reply(200, {"ok": True,
-                              "models": self.service.registry.info()})
+                              "models": self.service.model_info()})
         elif self.path == "/statz":
             self._reply(200, self.service.stats)
         elif self.path == "/v1/models":
-            self._reply(200, self.service.registry.info())
+            self._reply(200, self.service.model_info())
         else:
             self._reply(404, {"error": "no route %r" % self.path,
                               "kind": "not_found"})
@@ -100,6 +109,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self.path.endswith(":predict"):
             name = self.path[len("/v1/models/"):-len(":predict")]
             return self._predict(name, body)
+        if self.path.startswith("/v1/models/") and \
+                self.path.endswith(":generate"):
+            name = self.path[len("/v1/models/"):-len(":generate")]
+            return self._generate(name, body)
         if self.path.startswith("/v1/models/") and \
                 self.path.endswith(":reload"):
             name = self.path[len("/v1/models/"):-len(":reload")]
@@ -142,6 +155,47 @@ class _Handler(BaseHTTPRequestHandler):
             "model": name, "version": entry.version,
             "fetch_names": list(entry.model.fetch_names),
             "outputs": [np.asarray(r).tolist() for r in rows]})
+
+    def _generate(self, name, body):
+        """Autoregressive generation: ``{"tokens": [ids],
+        "max_new_tokens": N, "temperature": t, "seed": s,
+        "deadline_ms": optional}`` -> the GenResult fields. Pool
+        exhaustion is backpressure, not a server fault: 429 with kind
+        ``kv_pool_exhausted``."""
+        from .kvcache import PoolExhausted
+        try:
+            tokens = body.get("tokens")
+            if not isinstance(tokens, list) or not tokens:
+                raise ValueError('body must carry {"tokens": '
+                                 "[token ids]}")
+            # the handle carries the version of the engine that took the
+            # submit — a re-fetch here would race a hot :reload into
+            # attributing new-model tokens to the old version
+            req = self.service.generate_async(
+                name, tokens,
+                max_new_tokens=int(body.get("max_new_tokens", 16)),
+                temperature=float(body.get("temperature", 0.0)),
+                seed=int(body.get("seed", 0)),
+                deadline_ms=body.get("deadline_ms"))
+            res = req.wait()
+        except ModelUnavailableError as e:
+            return self._reply(404, {"error": str(e),
+                                     "kind": "model_unavailable"})
+        except PoolExhausted as e:
+            return self._reply(429, {"error": str(e),
+                                     "kind": "kv_pool_exhausted"})
+        except OverloadError as e:
+            return self._reply(429, {"error": str(e), "kind": "overload"})
+        except DeadlineExceededError as e:
+            return self._reply(504, {"error": str(e), "kind": "deadline"})
+        except (TypeError, ValueError) as e:
+            return self._reply(400, {"error": str(e),
+                                     "kind": "bad_request"})
+        except Exception as e:
+            return self._reply(500, {"error": repr(e), "kind": "dispatch"})
+        out = {"model": name, "version": req.model_version}
+        out.update(res.describe())
+        self._reply(200, out)
 
     def _reload(self, name, body):
         dirname = body.get("dirname")

@@ -1,0 +1,193 @@
+"""Paged KV-cache: the memory system under continuous batching.
+
+A naive autoregressive server gives every sequence a max-length K/V
+buffer up front — most of it never used, and the worst sequence bounds
+everyone's admission. The paged design (vLLM's PagedAttention, see
+PAPERS.md) splits the cache into fixed-size **pages** of
+``page_tokens`` positions each, preallocated once per model as one
+device-resident pool, and gives each running sequence a **block table**
+— the ordered list of page ids its positions live in. Allocation is
+O(1) list ops on the host; the device never sees fragmentation because
+attention reads K/V *through* the block table (gather) and writes the
+new position *through* it (scatter) — see
+``models/transformer.decode_step``.
+
+Layout: ``[num_layers, num_pages + 1, page_tokens, heads, head_dim]``
+per K and V. The LAST page is the **trash page**: block tables are
+padded with it, and writes for inactive batch rows are routed to it, so
+every scatter in the jitted step has a fixed shape and a legal target —
+no masking branches, no retraces. Trash contents are garbage by design
+and are never read unmasked.
+
+Exhaustion is policy, not a crash: ``alloc`` raises
+:class:`PoolExhausted` (a :class:`ServingError`), and the generation
+engine turns that into the house degrade-and-record convention — a shed
+or a preemption with a recorded ``kv_pool_exhausted`` event. The pool
+itself never kills anything.
+
+Knobs: ``FLAGS.serve_kv_pages`` (usable pages in the pool) and
+``FLAGS.serve_page_tokens`` (positions per page).
+"""
+from __future__ import annotations
+
+import threading
+
+from .admission import ServingError
+
+__all__ = ["PoolExhausted", "PagePool", "BlockTable", "pages_for"]
+
+
+class PoolExhausted(ServingError):
+    """The page pool cannot satisfy an allocation right now."""
+
+
+def pages_for(tokens, page_tokens):
+    """Pages needed to hold ``tokens`` positions (ceil division; at
+    least one — a live sequence always owns a page)."""
+    tokens = max(int(tokens), 1)
+    return -(-tokens // int(page_tokens))
+
+
+class PagePool(object):
+    """Preallocated per-model K/V page pool + host-side allocator.
+
+    Device arrays (``k_pages``/``v_pages``) are owned by the engine loop
+    (they are donated through the jitted steps and replaced each call);
+    this object owns the *accounting*: which page ids are free, which
+    are live, high-water marks. Thread-safe — ``submit`` threads consult
+    feasibility while the engine thread allocates.
+    """
+
+    def __init__(self, num_pages, page_tokens, num_layers, num_heads,
+                 head_dim, dtype="float32"):
+        if num_pages < 1:
+            raise ValueError("num_pages must be >= 1")
+        if page_tokens < 1:
+            raise ValueError("page_tokens must be >= 1")
+        self.num_pages = int(num_pages)
+        self.page_tokens = int(page_tokens)
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = str(dtype)
+        self._lock = threading.Lock()
+        # free list kept SORTED so allocation order is deterministic
+        # (tests and replays see the same page ids for the same history)
+        self._free = list(range(self.num_pages))
+        self._live = set()
+        self._max_live = 0
+
+    # -- device arrays -------------------------------------------------------
+    @property
+    def trash_page(self):
+        """Id of the write-sink page (the extra last page)."""
+        return self.num_pages
+
+    def zeros(self):
+        """Freshly zeroed (k_pages, v_pages) device arrays in the pool
+        layout — built once by the engine, then donated step to step."""
+        import jax.numpy as jnp
+        shape = (self.num_layers, self.num_pages + 1, self.page_tokens,
+                 self.num_heads, self.head_dim)
+        return jnp.zeros(shape, self.dtype), jnp.zeros(shape, self.dtype)
+
+    # -- allocator -----------------------------------------------------------
+    def alloc(self, n):
+        """Take ``n`` pages; raises :class:`PoolExhausted` (allocating
+        nothing) when fewer are free."""
+        n = int(n)
+        with self._lock:
+            if n > len(self._free):
+                raise PoolExhausted(
+                    "kv page pool exhausted: want %d page(s), %d of %d "
+                    "free" % (n, len(self._free), self.num_pages))
+            pages = self._free[:n]
+            del self._free[:n]
+            self._live.update(pages)
+            self._max_live = max(self._max_live, len(self._live))
+            return pages
+
+    def free(self, pages):
+        """Return pages to the pool. Double-free and foreign ids raise —
+        including a duplicate id WITHIN one call, which would enter the
+        free list twice and hand the same page to two sequences —
+        aliasing a live page corrupts another sequence's cache, so the
+        accounting must be loud, not forgiving."""
+        pages = list(pages)
+        with self._lock:
+            seen = set()
+            bad = []
+            for p in pages:
+                if p not in self._live or p in seen:
+                    bad.append(p)
+                seen.add(p)
+            if bad:
+                raise ValueError("freeing pages %s that are not live "
+                                 "(double free, duplicate, or foreign "
+                                 "id)" % bad)
+            for p in pages:
+                self._live.discard(p)
+                self._free.append(p)
+            self._free.sort()
+
+    # -- accounting ----------------------------------------------------------
+    @property
+    def available(self):
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def live(self):
+        with self._lock:
+            return len(self._live)
+
+    def can_fit(self, tokens):
+        """Whether a sequence of ``tokens`` total positions could EVER be
+        held (feasibility — submit-time shed test)."""
+        return pages_for(tokens, self.page_tokens) <= self.num_pages
+
+    def utilization(self):
+        """{live, free, num_pages, max_live, frac} snapshot."""
+        with self._lock:
+            live = len(self._live)
+            return {"live": live, "free": len(self._free),
+                    "num_pages": self.num_pages, "max_live": self._max_live,
+                    "frac": live / float(self.num_pages)}
+
+
+class BlockTable(object):
+    """One sequence's ordered page list + position bookkeeping."""
+
+    __slots__ = ("pool", "pages", "length")
+
+    def __init__(self, pool, pages=(), length=0):
+        self.pool = pool
+        self.pages = list(pages)
+        self.length = int(length)   # positions written so far
+
+    @property
+    def capacity(self):
+        return len(self.pages) * self.pool.page_tokens
+
+    def ensure(self, tokens):
+        """Grow the table to hold ``tokens`` total positions; allocates
+        from the pool (raises :class:`PoolExhausted` allocating
+        nothing — the caller decides shed vs preempt)."""
+        need = pages_for(tokens, self.pool.page_tokens) - len(self.pages)
+        if need > 0:
+            self.pages.extend(self.pool.alloc(need))
+
+    def release(self):
+        """Free every page back to the pool (idempotent)."""
+        if self.pages:
+            self.pool.free(self.pages)
+            self.pages = []
+        self.length = 0
+
+    def as_row(self, max_blocks):
+        """Fixed-width int32 row for the device block table, trash-padded."""
+        import numpy as np
+        row = np.full((max_blocks,), self.pool.trash_page, np.int32)
+        n = min(len(self.pages), max_blocks)
+        row[:n] = self.pages[:n]
+        return row

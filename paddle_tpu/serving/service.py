@@ -8,6 +8,17 @@ and shed counts, batch occupancy, queue wait, and p50/p99 end-to-end
 latency, mirrored into ``profiler.serving_counters()`` and the
 ``serving`` section of the timeline artifact.
 
+Two request families share the front end:
+
+- **one-shot inference** (``infer`` / ``infer_async``) over compiled
+  artifacts through the micro-batcher — the PR-4 path;
+- **autoregressive generation** (``generate`` / ``generate_async``)
+  over generative artifacts through a per-model
+  :class:`~paddle_tpu.serving.generator.GenerationEngine` (continuous
+  batching + paged KV-cache). ``load_model`` auto-detects which kind a
+  directory holds; eligibility is decided per artifact, and the
+  micro-batcher keeps serving the non-autoregressive models.
+
 The HTTP endpoint (:mod:`~paddle_tpu.serving.httpd`) and the
 ``paddle_tpu serve`` CLI verb are thin shells over this class — tests
 and embedders use it directly.
@@ -19,10 +30,11 @@ import threading
 
 import numpy as np
 
-from .admission import AdmissionController, OverloadError
+from .admission import (AdmissionController, ModelUnavailableError,
+                        OverloadError, ServingError)
 from .batcher import MicroBatcher, Request
 
-__all__ = ["InferenceService"]
+__all__ = ["InferenceService", "GenEntry"]
 
 # bounded latency reservoirs: long-lived servers must not grow a list
 # per request; percentiles over the most recent window are the ones an
@@ -35,6 +47,40 @@ def _percentile(values, q):
         return 0.0
     s = sorted(values)
     return s[min(int(q * len(s)), len(s) - 1)]
+
+
+class GenEntry(object):
+    """One published generative (name, version): the registry
+    ModelEntry's shape, generation-flavored. ``engine_kwargs`` records
+    the deployment's engine knobs so a later reload without explicit
+    kwargs (the HTTP ``:reload`` path) rebuilds the SAME geometry
+    instead of silently falling back to the flag defaults."""
+
+    __slots__ = ("name", "version", "dirname", "engine", "engine_kwargs",
+                 "loaded_at")
+
+    def __init__(self, name, version, dirname, engine, engine_kwargs=None):
+        import time as _time
+        self.name = name
+        self.version = version
+        self.dirname = dirname
+        self.engine = engine
+        self.engine_kwargs = dict(engine_kwargs or {})
+        self.loaded_at = _time.time()
+
+    @property
+    def warmup_ms(self):
+        return self.engine.warmup_ms
+
+    def describe(self):
+        eng = self.engine
+        return {"version": self.version, "dirname": self.dirname,
+                "loaded_at": self.loaded_at, "kind": "generative",
+                "warmup_ms": round(eng.warmup_ms, 3),
+                "max_running": eng.max_running,
+                "kv_pages": eng.pool.num_pages,
+                "page_tokens": eng.pool.page_tokens,
+                "max_context": eng.max_context}
 
 
 class InferenceService(object):
@@ -79,16 +125,154 @@ class InferenceService(object):
             self.registry, self.max_batch, self.batch_timeout_ms,
             self.admission, on_shed=self._on_shed,
             on_batch=self._on_batch, on_fail=self._on_fail)
+        self._generators = {}       # name -> GenEntry
+        self._gen_versions = {}     # name -> last assigned version int
+        # serializes generative load/reload/drop per SERVICE: two racing
+        # :reload threads would otherwise both build engines and both
+        # retire only the older one — the loser's engine thread and
+        # device-resident pool would leak for the process lifetime
+        self._gen_reload_lock = threading.Lock()
         self._closed = False
 
     # -- model management ----------------------------------------------------
-    def load_model(self, name, dirname, warm=True):
-        return self.registry.load(name, dirname, warm=warm)
+    def load_model(self, name, dirname, warm=True, **gen_kwargs):
+        """Load (or hot-reload) ``dirname`` as ``name``. The artifact
+        kind decides the path: an ``export_generative`` directory builds
+        a generation engine (``gen_kwargs`` — max_running/kv_pages/...
+        — apply there); anything else goes through the compiled-model
+        registry (``gen_kwargs`` are rejected: a compiled artifact has
+        no engine to configure)."""
+        from ..inference import is_generative_artifact
+        if is_generative_artifact(dirname):
+            return self.load_generative(name, dirname, warm=warm,
+                                        **gen_kwargs)
+        if gen_kwargs:
+            raise TypeError(
+                "%r is a compiled artifact; generation engine knobs %s "
+                "do not apply" % (dirname, sorted(gen_kwargs)))
+        entry = self.registry.load(name, dirname, warm=warm)
+        # a compiled artifact replacing a generative name: retire the
+        # stale engine, or it would keep answering :generate with the
+        # previous model forever
+        self._drop_generative(name)
+        return entry
 
-    def reload_model(self, name, dirname, warm=True):
+    def reload_model(self, name, dirname, warm=True, **gen_kwargs):
         """Atomic hot reload; on failure the previous version keeps
         serving (rollback) and the error propagates to this caller."""
-        return self.registry.load(name, dirname, warm=warm)
+        return self.load_model(name, dirname, warm=warm, **gen_kwargs)
+
+    # cap on how long a hot reload waits for the previous engine's
+    # in-flight generations before closing it anyway
+    _DRAIN_TIMEOUT_S = 60.0
+
+    def load_generative(self, name, dirname, warm=True, **engine_kwargs):
+        """Load a generative artifact and stand its engine up. The new
+        engine is fully built (and warmed) BEFORE the publish swap; the
+        previous engine drains its in-flight sequences (new submits go
+        to the replacement) and closes after the swap — the registry's
+        hot-reload discipline. A reload without explicit
+        ``engine_kwargs`` reuses the previous deployment's knobs (the
+        HTTP ``:reload`` path must not silently reset the pool
+        geometry to flag defaults). On failure the previous version
+        keeps serving with a recorded ``reload_rollback`` event."""
+        from ..inference import load_generative
+        from ..resilience import record_event
+        from .generator import GenerationEngine
+        with self._gen_reload_lock:
+            self._check_open()
+            prev = self._generators.get(name)
+            if not engine_kwargs and prev is not None:
+                engine_kwargs = dict(prev.engine_kwargs)
+            engine_kwargs.setdefault("queue_depth",
+                                     self.admission.queue_depth)
+            try:
+                model = load_generative(dirname)
+                engine = GenerationEngine(model, name=name, warm=warm,
+                                          **engine_kwargs)
+            except BaseException as e:
+                if prev is not None:
+                    record_event("reload_rollback", site="serving.reload",
+                                 model=name, kept_version=prev.version,
+                                 dirname=dirname, error=repr(e))
+                raise
+            with self._lock:
+                version = self._gen_versions.get(name, 0) + 1
+                self._gen_versions[name] = version
+                entry = GenEntry(name, version, dirname, engine,
+                                 engine_kwargs)
+                self._generators[name] = entry
+            record_event("model_loaded", site="serving.reload", model=name,
+                         version=version, dirname=dirname,
+                         artifact="generative",
+                         warmup_ms=round(engine.warmup_ms, 3))
+            if prev is not None:
+                prev.engine.drain(timeout=self._DRAIN_TIMEOUT_S)
+                prev.engine.close()
+            # a generative artifact replacing a compiled name: retire the
+            # stale compiled entry, or it would keep answering :predict
+            # with the previous model forever
+            self.registry.unload(name)
+            return entry
+
+    def register_generative(self, name, model, **engine_kwargs):
+        """In-process entry point (tests/benchmarks/embedders): stand an
+        engine up over an already-built
+        :class:`~paddle_tpu.models.transformer.TransformerLM`."""
+        from .generator import GenerationEngine
+        with self._gen_reload_lock:
+            self._check_open()
+            prev = self._generators.get(name)
+            engine_kwargs.setdefault("queue_depth",
+                                     self.admission.queue_depth)
+            engine = GenerationEngine(model, name=name, **engine_kwargs)
+            with self._lock:
+                version = self._gen_versions.get(name, 0) + 1
+                self._gen_versions[name] = version
+                entry = GenEntry(name, version, "<in-process>", engine,
+                                 engine_kwargs)
+                self._generators[name] = entry
+            if prev is not None:
+                prev.engine.drain(timeout=self._DRAIN_TIMEOUT_S)
+                prev.engine.close()
+            self.registry.unload(name)
+            return entry
+
+    def _check_open(self):
+        """Called under ``_gen_reload_lock``: a generative load racing
+        :meth:`close` must lose — an engine published after the close
+        sweep would leak its thread and device-resident page pool for
+        the process lifetime."""
+        if self._closed:
+            raise RuntimeError("InferenceService is closed")
+
+    def _drop_generative(self, name):
+        """Retire ``name``'s generation engine (cross-kind replacement),
+        draining in-flight work first."""
+        with self._gen_reload_lock:
+            with self._lock:
+                entry = self._generators.pop(name, None)
+            if entry is not None:
+                entry.engine.drain(timeout=self._DRAIN_TIMEOUT_S)
+                entry.engine.close()
+
+    def _gen_entry(self, name):
+        with self._lock:
+            entry = self._generators.get(name)
+            known = sorted(self._generators) if entry is None else None
+        if entry is None:
+            raise ModelUnavailableError(
+                "no generative model registered under %r (registered: "
+                "%s)" % (name, known or "none"))
+        return entry
+
+    def model_info(self):
+        """Registry listing covering both families (httpd /v1/models)."""
+        info = self.registry.info()
+        with self._lock:
+            gens = dict(self._generators)
+        info.update({n: e.describe() for n, e in gens.items()})
+        return info
 
     # -- request path --------------------------------------------------------
     def infer_async(self, name, feed, deadline_ms=None):
@@ -149,6 +333,46 @@ class InferenceService(object):
         to ``CompiledModel.run(feed)`` on the served version."""
         return self.infer_async(name, feed, deadline_ms).wait(timeout)
 
+    # -- generation path -----------------------------------------------------
+    def generate_async(self, name, tokens, max_new_tokens=16,
+                       temperature=0.0, seed=0, deadline_ms=None):
+        """Enqueue one autoregressive generation on ``name``'s engine;
+        returns its :class:`~paddle_tpu.serving.generator.GenRequest`
+        handle (``.wait()`` for the
+        :class:`~paddle_tpu.serving.generator.GenResult`). Sheds raise
+        immediately (OverloadError / PoolExhausted), the engine's
+        submit contract. The handle's ``model_version`` is stamped from
+        the entry that took the submit, so responses attribute tokens
+        to the version that produced them even across a hot reload."""
+        entry = self._gen_entry(name)
+        try:
+            req = entry.engine.submit(
+                tokens, max_new_tokens=max_new_tokens,
+                temperature=temperature, seed=seed,
+                deadline_ms=deadline_ms)
+        except ServingError:
+            # lost the race with a hot reload: the entry fetched above
+            # drained/closed before this submit landed. Retry ONCE
+            # against the current registry state — the replacement
+            # engine owns new traffic; a second loss means the model is
+            # genuinely going away and the error is real
+            entry = self._gen_entry(name)
+            req = entry.engine.submit(
+                tokens, max_new_tokens=max_new_tokens,
+                temperature=temperature, seed=seed,
+                deadline_ms=deadline_ms)
+        req.model_version = entry.version
+        return req
+
+    def generate(self, name, tokens, max_new_tokens=16, temperature=0.0,
+                 seed=0, deadline_ms=None, timeout=None):
+        """Blocking generation -> GenResult (greedy outputs are
+        token-identical to sequential full-sequence decode of the same
+        prompt — the continuous-batching parity contract)."""
+        return self.generate_async(name, tokens, max_new_tokens,
+                                   temperature, seed,
+                                   deadline_ms).wait(timeout)
+
     # -- observer hooks (dispatch thread) ------------------------------------
     def _on_batch(self, requests, bucket):
         n = len(requests)
@@ -206,14 +430,35 @@ class InferenceService(object):
                 "latency_ms_p99": _percentile(lat, 0.99),
                 "models": self.registry.versions(),
             }
+            gens = dict(self._generators)
         snap["shed"] = snap["shed_overload"] + snap["shed_deadline"]
+        if gens:
+            snap["generation"] = {n: e.engine.stats
+                                  for n, e in sorted(gens.items())}
+            snap["models"].update({n: e.version
+                                   for n, e in gens.items()})
         return snap
 
     # -- lifecycle -----------------------------------------------------------
     def close(self):
-        if not self._closed:
+        # _closed flips under _gen_reload_lock so an in-flight
+        # load_generative either publishes BEFORE the sweep below
+        # (its engine is collected here) or observes _closed and
+        # refuses — no engine can be published into a closed service
+        with self._gen_reload_lock:
+            if self._closed:
+                return
             self._closed = True
-            self._batcher.close()
+            with self._lock:
+                gens = list(self._generators.values())
+                self._generators.clear()
+        self._batcher.close()
+        # same contract as hot reload: in-flight generations finish
+        # (bounded) before the engine is torn down, so a SIGTERM
+        # drain-and-exit never 500s a request mid-stream
+        for e in gens:
+            e.engine.drain(timeout=self._DRAIN_TIMEOUT_S)
+            e.engine.close()
 
     def __enter__(self):
         return self

@@ -1,0 +1,674 @@
+"""Continuous batching + paged KV-cache acceptance suite.
+
+Contracts under test: the paged pool's allocator never aliases live
+pages across alloc/free/realloc cycles and exhaustion allocates nothing;
+greedy continuous-batched output is token-identical to sequential
+full-sequence decode (the parity bar) through ONE compiled decode trace;
+pool exhaustion, deadlines and overload shed with recorded degradation
+events while the engine loop keeps serving; a fault armed at
+``serving.generate`` fails that step's requests and nothing else; the
+generative artifact round-trips through export/load and the service/HTTP
+surface serves it beside compiled artifacts; and the micro-batcher's
+shape-bucket routing keeps mixed-shape traffic batchable.
+"""
+import collections
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import profiler, resilience
+from paddle_tpu.inference import (ArtifactError, export_generative,
+                                  is_generative_artifact, load_generative,
+                                  validate_generative_artifact)
+from paddle_tpu.models import transformer as tm
+from paddle_tpu.serving import (BlockTable, DeadlineExceededError,
+                                GenerationEngine, InferenceService,
+                                ModelUnavailableError, OverloadError,
+                                PagePool, PoolExhausted, ServingError,
+                                make_server, pages_for, reference_decode,
+                                sample_token)
+from paddle_tpu.serving.admission import AdmissionController
+from paddle_tpu.serving.batcher import MicroBatcher, Request, feed_shape_sig
+
+VOCAB = 23
+MAX_SEQ = 48
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tm.TransformerConfig(vocab_size=VOCAB, hidden=16, num_layers=2,
+                               num_heads=2, max_seq=MAX_SEQ)
+    return tm.TransformerLM(tm.init_params(cfg, seed=3), cfg)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    resilience.reset()
+    resilience.clear_events()
+    yield
+    resilience.reset()
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_running", 4)
+    kw.setdefault("kv_pages", 64)
+    kw.setdefault("page_tokens", 8)
+    kw.setdefault("queue_depth", 64)
+    kw.setdefault("warm", False)
+    return GenerationEngine(model, **kw)
+
+
+# -- paged pool allocator -----------------------------------------------------
+
+def test_pages_for():
+    assert pages_for(1, 8) == 1
+    assert pages_for(8, 8) == 1
+    assert pages_for(9, 8) == 2
+    assert pages_for(0, 8) == 1   # a live sequence always owns a page
+
+
+def test_pool_alloc_free_cycles_never_alias():
+    # property test: random alloc/free/realloc traffic; at every step
+    # the owners' page sets stay pairwise disjoint and inside the pool
+    pool = PagePool(num_pages=13, page_tokens=4, num_layers=1,
+                    num_heads=1, head_dim=4)
+    rng = np.random.RandomState(11)
+    owners = {}
+    for step in range(300):
+        if owners and rng.rand() < 0.4:
+            key = list(owners)[rng.randint(len(owners))]
+            pool.free(owners.pop(key))
+        else:
+            want = int(rng.randint(1, 5))
+            try:
+                owners[step] = pool.alloc(want)
+            except PoolExhausted:
+                assert pool.available < want
+        held = [p for pages in owners.values() for p in pages]
+        assert len(held) == len(set(held))          # no page owned twice
+        assert all(0 <= p < pool.num_pages for p in held)
+        assert pool.live == len(held)
+        assert pool.available == pool.num_pages - len(held)
+    for pages in owners.values():
+        pool.free(pages)
+    assert pool.available == pool.num_pages and pool.live == 0
+    assert pool.utilization()["max_live"] <= pool.num_pages
+
+
+def test_pool_exhaustion_allocates_nothing():
+    pool = PagePool(4, 8, 1, 1, 4)
+    got = pool.alloc(3)
+    with pytest.raises(PoolExhausted):
+        pool.alloc(2)
+    # the failed alloc took nothing: the last page is still allocatable
+    assert pool.available == 1 and pool.live == 3
+    pool.free(got)
+    assert pool.available == 4
+
+
+def test_pool_double_free_and_foreign_free_raise():
+    pool = PagePool(4, 8, 1, 1, 4)
+    pages = pool.alloc(2)
+    pool.free(pages)
+    with pytest.raises(ValueError):
+        pool.free(pages)            # double free
+    with pytest.raises(ValueError):
+        pool.free([99])             # foreign id
+    assert pool.available == 4      # accounting undamaged
+    # a duplicate id WITHIN one call would enter the free list twice
+    # and alias the page to two future owners — must be loud too
+    p = pool.alloc(1)
+    with pytest.raises(ValueError):
+        pool.free([p[0], p[0]])
+    assert pool.live == 1           # the failed free released nothing
+    pool.free(p)
+    assert pool.available == 4
+
+
+def test_block_table_grow_release_and_row():
+    pool = PagePool(8, 4, 1, 1, 4)
+    t = BlockTable(pool)
+    t.ensure(1)
+    assert len(t.pages) == 1
+    t.ensure(9)                     # 9 tokens @ 4/page -> 3 pages
+    assert len(t.pages) == 3 and pool.live == 3
+    row = t.as_row(max_blocks=5)
+    assert row.dtype == np.int32 and row.shape == (5,)
+    assert list(row[:3]) == t.pages
+    assert all(row[3:] == pool.trash_page)   # trash-padded tail
+    t.release()
+    assert pool.live == 0 and t.pages == [] and t.length == 0
+    t.release()                     # idempotent
+
+
+def test_can_fit_is_feasibility_not_availability():
+    pool = PagePool(4, 8, 1, 1, 4)
+    pool.alloc(4)
+    assert pool.can_fit(32)         # would fit an EMPTY pool
+    assert not pool.can_fit(33)
+
+
+# -- sampling -----------------------------------------------------------------
+
+def test_sample_token_greedy_and_temperature():
+    logits = np.array([0.1, 3.0, -1.0, 3.0])
+    assert sample_token(logits, 0.0, None) == 1      # argmax, first-wins
+    r1 = [sample_token(logits, 0.8, np.random.RandomState(5))
+          for _ in range(8)]
+    r2 = [sample_token(logits, 0.8, np.random.RandomState(5))
+          for _ in range(8)]
+    assert r1 == r2                                  # seeded determinism
+
+
+# -- transformer serving face -------------------------------------------------
+
+def test_forward_matches_executor_program():
+    # the pure-jax serving forward is the SAME function the Executor
+    # lowers from the transformer_lm Program — prove it on the trained
+    # weights extraction path (params_from_scope)
+    B, S = 2, 12
+    cfg = tm.TransformerConfig(vocab_size=VOCAB, hidden=16, num_layers=1,
+                               num_heads=2, max_seq=S)
+    with pt.scope_guard(pt.Scope()):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            toks = pt.layers.data("tokens", shape=[S], dtype="int64")
+            logits = tm.transformer_lm(toks, VOCAB, hidden=16,
+                                       num_layers=1, num_heads=2)
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        ids = np.random.RandomState(0).randint(0, VOCAB, (B, S))
+        want = exe.run(main, feed={"tokens": ids.astype(np.int64)},
+                       fetch_list=[logits])[0]
+        params = tm.params_from_scope(cfg)
+    got = np.asarray(tm.forward(params, ids.astype(np.int32), cfg))
+    np.testing.assert_allclose(got, np.asarray(want), rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_params_from_scope_missing_names_listed():
+    cfg = tm.TransformerConfig(vocab_size=VOCAB, hidden=16, num_layers=1,
+                               num_heads=2, max_seq=8)
+    with pt.scope_guard(pt.Scope()):
+        with pytest.raises(ValueError, match="tok_emb"):
+            tm.params_from_scope(cfg)
+
+
+# -- the parity proof ---------------------------------------------------------
+
+def test_greedy_parity_mixed_lengths_one_trace(model):
+    # mixed-length flood through one engine: token-identical to the
+    # sequential full-sequence reference, ONE decode trace for all of it
+    rng = np.random.RandomState(7)
+    prompts = [list(rng.randint(0, VOCAB, n))
+               for n in (1, 2, 5, 9, 16, 23, 30)]
+    want = [reference_decode(model, p, 10) for p in prompts]
+    with _engine(model) as eng:
+        handles = [eng.submit(p, max_new_tokens=10) for p in prompts]
+        got = [h.wait(timeout=300) for h in handles]
+        st = eng.stats
+    for g, w, p in zip(got, want, prompts):
+        assert g.tokens == w, "prompt %r drifted" % (p,)
+        assert g.finish_reason == "length"
+        assert g.latency_ms >= g.ttft_ms > 0.0
+    assert st["decode_traces"] == 1
+    assert st["completed"] == len(prompts)
+    assert st["max_running_seen"] > 1        # batching really happened
+    assert st["page_utilization"]["live"] == 0   # everything recycled
+
+
+def test_eos_retires_immediately(model):
+    prompt = [3, 1, 4, 1, 5]
+    ref = reference_decode(model, prompt, 12)
+    eos = ref[2]                 # make the 3rd greedy token the stop
+    with _engine(model, eos_id=eos) as eng:
+        res = eng.generate(prompt, max_new_tokens=12, timeout=300)
+    assert res.finish_reason == "eos"
+    assert res.tokens == ref[:3]
+    assert res.tokens[-1] == eos
+
+
+def test_temperature_seeded_determinism(model):
+    prompt = [2, 7, 9]
+    with _engine(model) as eng:
+        a = eng.generate(prompt, max_new_tokens=8, temperature=0.7,
+                         seed=13, timeout=300)
+        b = eng.generate(prompt, max_new_tokens=8, temperature=0.7,
+                         seed=13, timeout=300)
+        c = eng.generate(prompt, max_new_tokens=8, temperature=0.7,
+                         seed=14, timeout=300)
+    assert a.tokens == b.tokens
+    assert all(0 <= t < VOCAB for t in a.tokens)
+    assert len(c.tokens) == 8
+
+
+def test_submit_validation(model):
+    with _engine(model) as eng:
+        with pytest.raises(ValueError):
+            eng.submit([], max_new_tokens=4)
+        with pytest.raises(ValueError):
+            eng.submit([VOCAB + 5], max_new_tokens=4)
+        with pytest.raises(ValueError):
+            eng.submit([1], max_new_tokens=0)
+        with pytest.raises(ValueError):      # context overflow
+            eng.submit([1] * (MAX_SEQ - 1), max_new_tokens=2)
+
+
+# -- degrade-and-record -------------------------------------------------------
+
+def test_infeasible_request_shed_at_submit_engine_survives(model):
+    # pool: 4 pages x 4 tokens = 16 positions; a 20-position request can
+    # NEVER fit -> shed at submit with a recorded event; small requests
+    # keep serving through the same engine loop
+    with _engine(model, max_running=2, kv_pages=4, page_tokens=4) as eng:
+        with pytest.raises(PoolExhausted):
+            eng.submit(list(range(12)), max_new_tokens=8)
+        small = [1, 2, 3]
+        res = eng.generate(small, max_new_tokens=4, timeout=300)
+        assert res.tokens == reference_decode(model, small, 4)
+        st = eng.stats
+    assert st["shed_pool"] == 1 and st["completed"] == 1
+    evs = resilience.events(kind="kv_pool_exhausted")
+    assert evs and evs[0]["site"] == "serving.generate"
+    assert evs[0]["action"] == "shed"
+
+
+def test_preemption_resumes_with_identical_output(model):
+    # prompt-only reservation + a pool that cannot hold two sequences to
+    # completion: one gets preempted (recompute-on-resume) and the final
+    # greedy outputs are still token-identical to the reference
+    prompts = [[1, 2, 3, 4, 5, 6], [7, 8, 9, 10, 11, 12]]
+    with _engine(model, max_running=2, kv_pages=4, page_tokens=4,
+                 reserve="prompt") as eng:
+        handles = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        got = [h.wait(timeout=300) for h in handles]
+        st = eng.stats
+    for g, p in zip(got, prompts):
+        assert g.tokens == reference_decode(model, p, 8)
+    assert st["preemptions"] >= 1
+    assert st["completed"] == 2
+    acts = [e["action"] for e in
+            resilience.events(kind="kv_pool_exhausted")]
+    assert "preempt" in acts
+
+
+def test_expired_deadline_is_shed_not_served(model):
+    with _engine(model) as eng:
+        with pytest.raises(DeadlineExceededError):
+            eng.generate([1, 2], max_new_tokens=4, deadline_ms=-1,
+                         timeout=60)
+        # a sane deadline still serves
+        res = eng.generate([1, 2], max_new_tokens=4, deadline_ms=60_000,
+                           timeout=300)
+        assert len(res.tokens) == 4
+        assert eng.stats["shed_deadline"] == 1
+    evs = resilience.events(kind="request_shed", site="serving.generate")
+    assert evs and evs[0]["reason"] == "deadline"
+
+
+def test_queue_overload_sheds_now(model):
+    # a slow device (delay fault on the engine's device edges) backs the
+    # queue up into admission; the over-depth submit is rejected NOW
+    with _engine(model, max_running=1, queue_depth=2) as eng:
+        resilience.arm("serving.generate", action="delay", delay=0.25,
+                       nth=1, times=None)
+        handles = [eng.submit([1, 2], max_new_tokens=6)
+                   for _ in range(3)]       # 1 running + 2 queued
+        with pytest.raises(OverloadError):
+            for _ in range(4):              # depth check is racy by one
+                eng.submit([3, 4], max_new_tokens=6)
+        resilience.disarm("serving.generate")
+        for h in handles:
+            assert len(h.wait(timeout=300).tokens) == 6
+        assert eng.stats["shed_overload"] >= 1
+    assert any(e["reason"] == "overload" for e in
+               resilience.events(kind="request_shed"))
+
+
+def test_fault_at_prefill_fails_that_request_only(model):
+    with _engine(model) as eng:
+        resilience.arm("serving.generate", action="raise", nth=1, times=1)
+        with pytest.raises(resilience.FaultError):
+            eng.generate([1, 2, 3], max_new_tokens=4, timeout=300)
+        # the loop survives and the pool leaked nothing
+        res = eng.generate([1, 2, 3], max_new_tokens=4, timeout=300)
+        assert res.tokens == reference_decode(model, [1, 2, 3], 4)
+        st = eng.stats
+        assert st["failed"] == 1 and st["completed"] == 1
+        assert st["page_utilization"]["live"] == 0
+    evs = resilience.events(kind="generate_failed")
+    assert evs and evs[0]["phase"] == "prefill"
+
+
+def test_fault_at_decode_fails_running_loop_survives(model):
+    with _engine(model) as eng:
+        # hit 1 = the request's prefill (passes), hit 2 = the fused
+        # decode step -> the running sequence fails, engine keeps serving
+        resilience.arm("serving.generate", action="raise", nth=2, times=1)
+        with pytest.raises(resilience.FaultError):
+            eng.generate([5, 6, 7], max_new_tokens=6, timeout=300)
+        res = eng.generate([5, 6, 7], max_new_tokens=6, timeout=300)
+        assert res.tokens == reference_decode(model, [5, 6, 7], 6)
+        assert eng.stats["page_utilization"]["live"] == 0
+    evs = resilience.events(kind="generate_failed")
+    assert evs and evs[0]["phase"] == "decode"
+
+
+def test_pool_arrays_lost_mid_flight_engine_recovers(model):
+    # a raise from INSIDE a donated jitted call consumes the pool
+    # arrays (device OOM shape); simulate the loss and prove the engine
+    # rebuilds them instead of failing every later request forever
+    with _engine(model) as eng:
+        eng._kp.delete()
+        eng._vp.delete()
+        with pytest.raises(Exception):
+            eng.generate([1, 2, 3], max_new_tokens=4, timeout=300)
+        res = eng.generate([1, 2, 3], max_new_tokens=4, timeout=300)
+        assert res.tokens == reference_decode(model, [1, 2, 3], 4)
+        assert eng.stats["failed"] == 1 and eng.stats["completed"] == 1
+
+
+def test_drain_finishes_inflight_and_blocks_new_submits(model):
+    with _engine(model, max_running=1) as eng:
+        resilience.arm("serving.generate", action="delay", delay=0.05,
+                       nth=1, times=None)
+        h = eng.submit([1, 2, 3], max_new_tokens=6)
+        assert eng.drain(timeout=120)          # waits for the work
+        with pytest.raises(ServingError):
+            eng.submit([4, 5], max_new_tokens=2)
+        resilience.disarm("serving.generate")
+        res = h.wait(timeout=60)
+    assert res.tokens == reference_decode(model, [1, 2, 3], 6)
+
+
+def test_close_fails_queued_and_running(model):
+    eng = _engine(model, max_running=1)
+    resilience.arm("serving.generate", action="delay", delay=0.2,
+                   nth=1, times=None)
+    handles = [eng.submit([1, 2], max_new_tokens=8) for _ in range(3)]
+    eng.close()
+    resilience.disarm("serving.generate")
+    outcomes = []
+    for h in handles:
+        try:
+            outcomes.append(h.wait(timeout=60))
+        except Exception as e:
+            outcomes.append(e)
+    # nothing hangs; whatever did not finish failed loudly
+    assert all(isinstance(o, Exception) or o.finish_reason
+               for o in outcomes)
+    assert eng.pool.live == 0
+    eng.close()                              # idempotent
+
+
+def test_generation_profiler_counters(model):
+    profiler.reset_generation_counters()
+    with _engine(model) as eng:
+        eng.generate([1, 2, 3], max_new_tokens=5, timeout=300)
+    c = profiler.generation_counters()
+    assert c["gen_requests"] == 1 and c["gen_completed"] == 1
+    assert c["gen_tokens"] == 5
+    assert c["gen_prefills"] == 1 and c["gen_decode_steps"] == 4
+    assert 0 < c["gen_page_util_max"] <= 1.0
+    profiler.reset_generation_counters()
+
+
+# -- generative artifacts + service surface -----------------------------------
+
+def test_export_load_roundtrip_and_validation(tmp_path, model):
+    art = str(tmp_path / "gen_art")
+    export_generative(art, model.config,
+                      params={n: np.asarray(model.params[n])
+                              for n in tm.param_names(model.config)})
+    assert is_generative_artifact(art)
+    assert validate_generative_artifact(art) == []
+    loaded = load_generative(art)
+    assert loaded.config.to_dict() == model.config.to_dict()
+    prompt = [4, 8, 15]
+    with GenerationEngine(loaded, max_running=2, kv_pages=32,
+                          page_tokens=8, warm=False) as eng:
+        res = eng.generate(prompt, max_new_tokens=6, timeout=300)
+    assert res.tokens == reference_decode(model, prompt, 6)
+    # validation names every problem
+    assert validate_generative_artifact(str(tmp_path / "nope"))
+    (tmp_path / "half").mkdir()
+    (tmp_path / "half" / "__gen_config__.json").write_text("{}")
+    probs = validate_generative_artifact(str(tmp_path / "half"))
+    assert any("__gen_params__" in p for p in probs)
+    with pytest.raises(ArtifactError):
+        load_generative(str(tmp_path / "half"))
+
+
+def test_service_serves_generative_beside_compiled(tmp_path, model):
+    gen_dir = str(tmp_path / "gen")
+    export_generative(gen_dir, model.config,
+                      params={n: np.asarray(model.params[n])
+                              for n in tm.param_names(model.config)})
+    with InferenceService() as svc:
+        entry = svc.load_model("lm", gen_dir, warm=False, max_running=2,
+                               kv_pages=32, page_tokens=8)
+        assert entry.version == 1
+        prompt = [2, 4, 6]
+        res = svc.generate("lm", prompt, max_new_tokens=5, timeout=300)
+        assert res.tokens == reference_decode(model, prompt, 5)
+        # reload bumps the version behind in-flight traffic
+        entry2 = svc.reload_model("lm", gen_dir, warm=False,
+                                  max_running=2, kv_pages=32,
+                                  page_tokens=8)
+        assert entry2.version == 2
+        res2 = svc.generate("lm", prompt, max_new_tokens=5, timeout=300)
+        assert res2.tokens == res.tokens
+        info = svc.model_info()
+        assert info["lm"]["kind"] == "generative"
+        st = svc.stats
+        # per-engine stats: the reload stood a FRESH engine up, so the
+        # published counter is the new engine's
+        assert st["generation"]["lm"]["completed"] == 1
+        assert st["models"]["lm"] == 2
+        with pytest.raises(ModelUnavailableError):
+            svc.generate("ghost", [1], max_new_tokens=2)
+
+
+def test_reload_drains_inflight_and_keeps_geometry(tmp_path, model):
+    gen_dir = str(tmp_path / "gen")
+    export_generative(gen_dir, model.config,
+                      params={n: np.asarray(model.params[n])
+                              for n in tm.param_names(model.config)})
+    with InferenceService(queue_depth=5) as svc:
+        svc.load_model("lm", gen_dir, warm=False, max_running=2,
+                       kv_pages=32, page_tokens=8)
+        # --queue_depth plumbs through to the engine's admission bound
+        assert svc._gen_entry("lm").engine.queue_depth == 5
+        # slow the device edges so the request is genuinely in flight
+        # when the reload lands
+        resilience.arm("serving.generate", action="delay", delay=0.05,
+                       nth=1, times=None)
+        h = svc.generate_async("lm", [1, 2, 3], max_new_tokens=10)
+        entry2 = svc.reload_model("lm", gen_dir, warm=False)
+        resilience.disarm("serving.generate")
+        # the in-flight generation finished on the drained old engine
+        res = h.wait(timeout=300)
+        assert res.tokens == reference_decode(model, [1, 2, 3], 10)
+        # a kwarg-less reload (the HTTP :reload path) kept the
+        # deployment's geometry instead of resetting to flag defaults
+        assert entry2.engine.pool.num_pages == 32
+        assert entry2.engine.pool.page_tokens == 8
+        assert entry2.engine.max_running == 2
+        res2 = svc.generate("lm", [1, 2, 3], max_new_tokens=10,
+                            timeout=300)
+        assert res2.tokens == res.tokens
+
+
+def test_cross_kind_reload_retires_stale_entry(tmp_path, model):
+    gen_dir = str(tmp_path / "gen")
+    export_generative(gen_dir, model.config,
+                      params={n: np.asarray(model.params[n])
+                              for n in tm.param_names(model.config)})
+    art = str(tmp_path / "compiled")
+    with pt.scope_guard(pt.Scope()):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", shape=[4], dtype="float32")
+            y = pt.layers.fc(x, size=2, bias_attr=False)
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        pt.inference.export_compiled(
+            art, ["x"], [y], exe, main_program=main,
+            example_feed={"x": np.zeros((2, 4), np.float32)})
+    feed = {"x": np.ones((2, 4), np.float32)}
+    with InferenceService() as svc:
+        svc.load_model("m", art)
+        svc.infer("m", feed, timeout=60)
+        # compiled -> generative: the stale compiled entry must retire,
+        # or :predict would keep serving the previous model forever
+        svc.load_model("m", gen_dir, warm=False, max_running=2,
+                       kv_pages=32, page_tokens=8)
+        assert len(svc.generate("m", [1, 2], max_new_tokens=3,
+                                timeout=300).tokens) == 3
+        with pytest.raises(ModelUnavailableError):
+            svc.infer("m", feed, timeout=60)
+        assert svc.model_info()["m"]["kind"] == "generative"
+        # generative -> compiled: the engine retires symmetrically
+        svc.load_model("m", art)
+        svc.infer("m", feed, timeout=60)
+        with pytest.raises(ModelUnavailableError):
+            svc.generate("m", [1, 2], max_new_tokens=3)
+
+
+def test_http_generate_endpoint(tmp_path, model):
+    gen_dir = str(tmp_path / "gen")
+    export_generative(gen_dir, model.config,
+                      params={n: np.asarray(model.params[n])
+                              for n in tm.param_names(model.config)})
+    svc = InferenceService()
+    # 4 pages x 8 tokens = 32 cache positions: small enough that a
+    # 40-position request is infeasible (the 429 leg) while the short
+    # ones fit comfortably
+    svc.load_model("lm", gen_dir, warm=False, max_running=2,
+                   kv_pages=4, page_tokens=8)
+    server = make_server(svc, host="127.0.0.1", port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    base = "http://%s:%d" % server.server_address[:2]
+
+    def post(path, body, expect):
+        req = urllib.request.Request(
+            base + path, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                assert r.status == expect
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            assert e.code == expect, e.read()
+            return json.loads(e.read())
+
+    try:
+        prompt = [3, 5, 7]
+        out = post("/v1/models/lm:generate",
+                   {"tokens": prompt, "max_new_tokens": 4}, 200)
+        assert out["tokens"] == reference_decode(model, prompt, 4)
+        assert out["finish_reason"] == "length"
+        assert out["model"] == "lm" and out["version"] == 1
+        bad = post("/v1/models/lm:generate", {"tokens": []}, 400)
+        assert bad["kind"] == "bad_request"
+        miss = post("/v1/models/ghost:generate", {"tokens": [1]}, 404)
+        assert miss["kind"] == "model_unavailable"
+        # an infeasible request maps to 429 kv_pool_exhausted
+        too_big = post("/v1/models/lm:generate",
+                       {"tokens": list(range(20)),
+                        "max_new_tokens": 20}, 429)
+        assert too_big["kind"] == "kv_pool_exhausted"
+        with urllib.request.urlopen(base + "/v1/models",
+                                    timeout=30) as r:
+            listing = json.loads(r.read())
+        assert listing["lm"]["kind"] == "generative"
+    finally:
+        server.shutdown()
+        server.server_close()
+        svc.close()
+
+
+def test_gen_knobs_rejected_on_compiled_artifact(tmp_path):
+    art = str(tmp_path / "compiled")
+    with pt.scope_guard(pt.Scope()):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", shape=[4], dtype="float32")
+            y = pt.layers.fc(x, size=2, bias_attr=False)
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        pt.inference.export_compiled(
+            art, ["x"], [y], exe, main_program=main,
+            example_feed={"x": np.zeros((2, 4), np.float32)})
+    with InferenceService() as svc:
+        with pytest.raises(TypeError, match="kv_pages"):
+            svc.load_model("m", art, kv_pages=8)
+        svc.load_model("m", art)      # sans knobs it loads fine
+
+
+# -- micro-batcher shape-bucket routing ---------------------------------------
+
+def test_feed_shape_sig_is_canonical():
+    a = feed_shape_sig({"x": np.zeros((2, 3)), "y": np.zeros((4,))})
+    b = feed_shape_sig({"y": np.zeros((4,)), "x": np.ones((2, 3))})
+    assert a == b == (("x", (2, 3)), ("y", (4,)))
+    assert feed_shape_sig({"x": [[1, 2]]}) == (("x", (1, 2)),)
+    assert a != feed_shape_sig({"x": np.zeros((2, 4)),
+                                "y": np.zeros((4,))})
+
+
+class _FakeModel(object):
+    feed_names = ("x",)
+    fetch_names = ("y",)
+
+    def run(self, feed):
+        return [np.asarray(feed["x"]) * 2.0]
+
+    def run_many(self, stacked):
+        return [np.asarray(stacked["x"]) * 2.0]
+
+
+class _FakeRegistry(object):
+    class _Entry(object):
+        model = _FakeModel()
+        version = 1
+
+    def get(self, name):
+        return self._Entry()
+
+
+def test_mixed_shapes_route_to_homogeneous_batches():
+    # two shapes interleaved at one model: every DISPATCHED batch is
+    # shape-homogeneous by construction, both shapes coalesce (no
+    # singleton convoy), and results stay exact — before shape-bucket
+    # routing this traffic pattern np.stack-failed the whole batch
+    dispatched = []
+    batcher = MicroBatcher(
+        _FakeRegistry(), max_batch=4, batch_timeout_ms=150.0,
+        admission=AdmissionController(64),
+        on_batch=lambda reqs, bucket: dispatched.append(
+            [r.shape_sig for r in reqs]))
+    feeds = []
+    rng = np.random.RandomState(3)
+    for i in range(8):
+        shape = (2, 3) if i % 2 == 0 else (5,)
+        feeds.append(rng.rand(*shape).astype(np.float32))
+    try:
+        reqs = [batcher.submit(Request("m", {"x": f})) for f in feeds]
+        got = [r.wait(timeout=60) for r in reqs]
+    finally:
+        batcher.close()
+    for g, f in zip(got, feeds):
+        np.testing.assert_array_equal(g[0], f * 2.0)
+    assert dispatched
+    for sigs in dispatched:
+        assert len(set(sigs)) == 1          # homogeneous by construction
+    assert max(len(sigs) for sigs in dispatched) > 1   # real coalescing
+    assert len(dispatched) < len(feeds)

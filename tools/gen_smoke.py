@@ -1,0 +1,83 @@
+"""Generation smoke gate (CPU tier-1): the continuous-batching engine
+(paddle_tpu.serving.generator) must (a) produce greedy outputs
+token-identical to sequential full-sequence decode (the parity bar),
+(b) beat a sequential per-request decode loop by >= 2x token throughput
+under a mixed-length flood — the whole point of iteration-level
+scheduling is that finished sequences stop costing device time, so if
+it cannot clearly beat one-at-a-time on the SAME machinery, the tier is
+overhead, (c) run the entire flood through ONE compiled decode trace
+(no per-length recompiles — the trace-free hot loop claim), and (d)
+degrade-and-record on kv pool exhaustion: an infeasible request sheds
+at submit with a recorded ``kv_pool_exhausted`` event, the engine loop
+keeps serving, and a mid-flight starvation under prompt-only
+reservation resolves by preemption with identical greedy output.
+
+The measurement itself lives in benchmark/gen_bench.py — ONE
+implementation shared by this gate and the evidence record, so the
+criteria cannot drift. Companion to tools/serve_smoke.sh (one-shot
+micro-batching tier); invoked by tools/gen_smoke.sh, which retries once
+to damp shared-CI scheduler noise. Exit 0 on pass, 1 on failure; prints
+a one-line JSON summary either way.
+
+    JAX_PLATFORMS=cpu python tools/gen_smoke.py
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REQUESTS = 12
+MAX_NEW = 12
+MAX_RUNNING = 8
+WAVES = 2
+MIN_RATIO = 2.0
+
+
+def main():
+    from benchmark.gen_bench import bench, bench_exhaustion
+
+    summary = bench(requests=REQUESTS, max_new=MAX_NEW,
+                    max_running=MAX_RUNNING, waves=WAVES)
+    ex = bench_exhaustion()
+    summary["exhaustion"] = ex
+
+    failures = []
+    if not summary["bit_exact"]:
+        failures.append("continuous greedy output not token-identical "
+                        "to sequential full-sequence decode")
+    if summary["throughput_ratio"] < MIN_RATIO:
+        failures.append(
+            "continuous batching only x%.3f over sequential per-request "
+            "decode (gate: >= x%.1f)" % (summary["throughput_ratio"],
+                                         MIN_RATIO))
+    if summary["decode_traces"] != 1:
+        failures.append(
+            "decode compiled %d traces over a mixed-length flood "
+            "(gate: exactly 1 — the hot loop must be trace-free)"
+            % summary["decode_traces"])
+    if summary["completed"] != WAVES * REQUESTS or summary["failed"]:
+        failures.append("lost requests: %r" % summary)
+    if not ex["shed_at_submit"]:
+        failures.append("infeasible request was not shed at submit")
+    if not ex["survivors_ok"] or not ex["engine_alive"]:
+        failures.append("engine did not keep serving after pool "
+                        "exhaustion: %r" % ex)
+    if ex["exhaustion_events"] < 1:
+        failures.append("pool exhaustion left no recorded "
+                        "kv_pool_exhausted event")
+    if not ex["preempt_parity"]:
+        failures.append("preempted sequence's greedy output drifted "
+                        "from the reference (recompute-on-resume broken)")
+    summary["ok"] = not failures
+    print(json.dumps(summary))
+    if failures:
+        for f in failures:
+            print("gen_smoke FAIL: %s" % f, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

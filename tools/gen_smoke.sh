@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Generation smoke gate: continuous batching + paged KV-cache must give
+# greedy outputs token-identical to sequential full-sequence decode,
+# >= 2x token throughput over per-request decode under a mixed-length
+# flood, exactly ONE compiled decode trace (no per-length recompiles),
+# and degrade-and-record (never crash) on kv pool exhaustion — CPU
+# tier-1, in-process, no device or sockets needed. Companion to
+# tools/serve_smoke.sh (one-shot micro-batching tier). One retry damps
+# shared-CI scheduler noise before calling a throughput loss real.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+python tools/gen_smoke.py "$@" && exit 0
+echo "gen_smoke: first attempt failed; retrying once" >&2
+exec python tools/gen_smoke.py "$@"
