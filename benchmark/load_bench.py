@@ -1,0 +1,513 @@
+"""Kill-tolerant router load harness (paddle_tpu.serving.router).
+
+The ONE implementation shared by tools/router_smoke.py (CI gate) and
+the banked evidence record, so the loss accounting, the chaos legs, and
+the balance criterion cannot drift between gate and evidence
+(the gen_bench/comm_bench convention).
+
+Workload: a replica fleet (``paddle_tpu serve`` subprocesses under
+:class:`~paddle_tpu.serving.pool.ReplicaPool`, each publishing a
+compiled predict model AND a tiny generative model) behind one
+:class:`~paddle_tpu.serving.router.Router`, flooded with interleaved
+``:predict`` + ``:generate`` traffic from concurrent HTTP clients.
+Three legs:
+
+- **kill**: one replica is SIGKILLed mid-flood. In-flight requests to
+  it fail over; the pool restarts it (exactly one recorded
+  ``router_replica_restart``); the gate is ZERO lost accepted requests
+  — every request ends in a 2xx or an orderly shed (429/503/504 with a
+  Retry-After the clients honor), never a connection error or 5xx.
+- **rolling reload**: ``:reload`` to the v2 artifact mid-flood fans out
+  one replica at a time, health-gated; afterwards every replica serves
+  v2 and the flood never saw an outage. A separate leg reloads a BAD
+  artifact: the rollout aborts on the first replica (which rolls itself
+  back), the fleet keeps serving v2 intact, and a ``reload_rollback``
+  event is recorded.
+- **balance**: the same mixed flood (no chaos) is measured twice in the
+  same run — ``least_loaded`` vs ``round_robin``. Request COUNTS are
+  the wrong fairness metric under heterogeneous cost (a generate costs
+  ~50x a predict), so the banked spread is **load spread**: max/min of
+  per-replica peak load score (queue depth + generation backlog + KV
+  pressure + in-flight) as observed by the router's poller, with
+  (1+x) smoothing; per-replica request spread is banked alongside for
+  transparency. Least-loaded must beat round-robin on load spread and
+  keep request spread under a threshold.
+
+Predict responses are verified against the artifact's known closed form
+(row sums x scale), which also proves WHICH version answered across the
+rolling reload.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+import numpy as np
+
+DIM = 6
+ROWS = 4
+OUT = 3
+V1_SCALE = 0.5
+V2_SCALE = 1.0
+
+GEN_VOCAB = 23
+GEN_MAX_SEQ = 64
+GEN_MAX_NEW = 8
+
+_CLIENT_RETRIES = 40
+_RETRY_CAP_S = 0.5
+
+
+# -- artifacts ----------------------------------------------------------------
+
+def export_predict_artifact(dirname, scale):
+    """y = x @ W with W constant-filled: outputs are row sums x scale,
+    so responses are verifiable and v1/v2 are tellable (the
+    test_serving fixture shape)."""
+    import paddle_tpu as pt
+    with pt.scope_guard(pt.Scope()):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.layers.data("x", shape=[DIM], dtype="float32")
+            w = pt.ParamAttr(
+                name="route_w",
+                initializer=pt.initializer.ConstantInitializer(scale))
+            out = pt.layers.fc(x, size=OUT, param_attr=w,
+                               bias_attr=False, act=None)
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        pt.inference.export_compiled(
+            dirname, ["x"], [out], exe, main_program=main,
+            example_feed={"x": np.zeros((ROWS, DIM), np.float32)})
+    return dirname
+
+
+def export_gen_artifact(dirname, seed=0):
+    from paddle_tpu import inference
+    from paddle_tpu.models import transformer as tm
+    cfg = tm.TransformerConfig(vocab_size=GEN_VOCAB, hidden=32,
+                               num_layers=2, num_heads=4,
+                               max_seq=GEN_MAX_SEQ)
+    inference.export_generative(dirname, cfg,
+                                params=tm.init_params(cfg, seed=seed))
+    return dirname
+
+
+def build_artifacts(root):
+    """v1/v2 predict artifacts, the generative artifact, and a bad
+    (non-artifact) directory for the failed-reload leg."""
+    os.makedirs(root, exist_ok=True)
+    arts = {
+        "v1": export_predict_artifact(os.path.join(root, "v1"), V1_SCALE),
+        "v2": export_predict_artifact(os.path.join(root, "v2"), V2_SCALE),
+        "gen": export_gen_artifact(os.path.join(root, "gen")),
+        "bad": os.path.join(root, "bad"),
+    }
+    os.makedirs(arts["bad"], exist_ok=True)
+    with open(os.path.join(arts["bad"], "compiled_model.json"), "w") as f:
+        f.write("")   # named but empty: validate_artifact rejects it
+    return arts
+
+
+# -- fleet --------------------------------------------------------------------
+
+def start_fleet(arts, replicas, name="m", gen_name="g", max_running=4,
+                kv_pages=32, page_tokens=8, queue_depth=128,
+                env_overrides=None, poll_ms=40, ready_timeout=420.0):
+    """Pool + router + front HTTP server, ready to take traffic.
+    Returns (pool, router, server, base_url)."""
+    from paddle_tpu.serving import (ReplicaPool, Router,
+                                    make_router_server)
+    serve_args = ["--extra_model", "%s=%s" % (gen_name, arts["gen"]),
+                  "--max_running", str(max_running),
+                  "--kv_pages", str(kv_pages),
+                  "--page_tokens", str(page_tokens),
+                  "--queue_depth", str(queue_depth)]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    pool = ReplicaPool(arts["v1"], replicas, name=name,
+                       serve_args=serve_args, env=env,
+                       env_overrides=env_overrides,
+                       ready_timeout=ready_timeout)
+    pool.start(wait=True)
+    router = Router(pool, poll_ms=poll_ms)
+    router.poll_once()
+    router.start_polling()
+    server = make_router_server(router)
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     kwargs={"poll_interval": 0.1}).start()
+    host, port = server.server_address[:2]
+    return pool, router, server, "http://%s:%d" % (host, port)
+
+
+def stop_fleet(pool, router, server):
+    server.shutdown()
+    server.server_close()
+    router.close()
+    pool.stop()
+
+
+# -- clients ------------------------------------------------------------------
+
+def _get(url, timeout=30.0):
+    """One transport implementation with the Router (its HTTPError-is-
+    an-answer contract included) — the harness must not drift from the
+    system it measures."""
+    from paddle_tpu.serving import Router
+    return Router._get_json(url, timeout)
+
+
+def _post(url, payload, timeout=120.0):
+    from paddle_tpu.serving import Router
+    status, body, _headers = Router._post_json(url, payload, timeout)
+    return status, body
+
+
+def make_tasks(n_predict, n_generate, seed=0):
+    """Deterministic interleaved task list. Each predict carries its
+    feed and the expected row sums (scale applied by the checker);
+    generates carry mixed-length prompts."""
+    rng = np.random.RandomState(seed)
+    tasks = []
+    for i in range(n_predict):
+        x = rng.rand(ROWS, DIM).astype(np.float32)
+        tasks.append(("predict", {"x": x.tolist(),
+                                  "sums": x.sum(axis=1).tolist()}))
+    for i in range(n_generate):
+        ln = int(rng.randint(2, 20))
+        tasks.append(("generate",
+                      {"tokens": rng.randint(0, GEN_VOCAB,
+                                             ln).tolist()}))
+    order = rng.permutation(len(tasks))
+    return [tasks[i] for i in order]
+
+
+class FloodRunner(object):
+    """Concurrent HTTP flood with orderly-shed retries and loss
+    accounting. ``done`` counts finished tasks (the chaos legs trigger
+    off it); results classify every task as completed (2xx), shed
+    (ran out of retries on 429/503/504), or LOST (connection error /
+    unexpected status — the thing the gate forbids)."""
+
+    def __init__(self, base_url, tasks, threads=8, model="m",
+                 gen_model="g"):
+        self.base_url = base_url
+        self.tasks = tasks
+        self.threads = threads
+        self.model = model
+        self.gen_model = gen_model
+        self.results = [None] * len(tasks)
+        self.done = 0
+        self._next = 0
+        self._lock = threading.Lock()
+        self._workers = []
+
+    def _take(self):
+        with self._lock:
+            if self._next >= len(self.tasks):
+                return None
+            i = self._next
+            self._next += 1
+            return i
+
+    def _run_one(self, kind, spec):
+        if kind == "predict":
+            url = "%s/v1/models/%s:predict" % (self.base_url, self.model)
+            payload = {"inputs": {"x": spec["x"]}}
+        else:
+            url = "%s/v1/models/%s:generate" % (self.base_url,
+                                                self.gen_model)
+            payload = {"tokens": spec["tokens"],
+                       "max_new_tokens": GEN_MAX_NEW}
+        t0 = time.monotonic()
+        sheds = 0
+        for attempt in range(_CLIENT_RETRIES):
+            try:
+                status, body = self._post(url, payload)
+            except Exception as e:
+                return {"kind": kind, "status": "lost",
+                        "error": repr(e), "sheds": sheds,
+                        "latency_ms": (time.monotonic() - t0) * 1e3}
+            if 200 <= status < 300:
+                out = {"kind": kind, "status": "completed",
+                       "sheds": sheds, "replica": body.get("replica"),
+                       "latency_ms": (time.monotonic() - t0) * 1e3}
+                if kind == "predict":
+                    out["version"] = body.get("version")
+                    out["scale_ok"] = self._check_scale(spec, body)
+                else:
+                    toks = body.get("tokens") or []
+                    out["tokens_ok"] = (0 < len(toks) <= GEN_MAX_NEW)
+                return out
+            if status in (429, 503, 504):
+                sheds += 1
+                hint = float(body.get("retry_after_ms") or 100.0) / 1e3
+                time.sleep(min(max(hint, 0.01), _RETRY_CAP_S))
+                continue
+            return {"kind": kind, "status": "lost", "http": status,
+                    "error": body.get("error"), "sheds": sheds,
+                    "latency_ms": (time.monotonic() - t0) * 1e3}
+        return {"kind": kind, "status": "shed", "sheds": sheds,
+                "latency_ms": (time.monotonic() - t0) * 1e3}
+
+    _post = staticmethod(_post)
+
+    @staticmethod
+    def _check_scale(spec, body):
+        """True when the outputs match v1 OR v2 (both are legal during
+        a rolling reload) and are internally consistent with the
+        version the response claims."""
+        try:
+            out = np.asarray(body["outputs"][0], np.float32)
+            sums = np.asarray(spec["sums"], np.float32)
+            for scale in (V1_SCALE, V2_SCALE):
+                want = np.repeat((sums * scale)[:, None], OUT, axis=1)
+                if np.allclose(out, want, rtol=1e-4, atol=1e-5):
+                    return True
+            return False
+        except Exception:
+            return False
+
+    def _worker(self):
+        while True:
+            i = self._take()
+            if i is None:
+                return
+            kind, spec = self.tasks[i]
+            res = self._run_one(kind, spec)
+            self.results[i] = res
+            with self._lock:
+                self.done += 1
+
+    def start(self):
+        for _ in range(self.threads):
+            t = threading.Thread(target=self._worker, daemon=True)
+            t.start()
+            self._workers.append(t)
+        return self
+
+    def wait(self, timeout=900.0):
+        deadline = time.monotonic() + timeout
+        for t in self._workers:
+            t.join(timeout=max(deadline - time.monotonic(), 0.0))
+        return self.done == len(self.tasks)
+
+    def wait_done(self, n, timeout=600.0):
+        deadline = time.monotonic() + timeout
+        while self.done < n and time.monotonic() < deadline:
+            time.sleep(0.02)
+        return self.done >= n
+
+    def summary(self):
+        res = [r for r in self.results if r is not None]
+        lat = sorted(r["latency_ms"] for r in res)
+
+        def pct(q):
+            return (round(lat[min(int(q * len(lat)), len(lat) - 1)], 2)
+                    if lat else 0.0)
+
+        counts = {"completed": 0, "shed": 0, "lost": 0}
+        for r in res:
+            counts[r["status"]] += 1
+        per_replica = {}
+        for r in res:
+            rep = r.get("replica")
+            if rep is not None:
+                per_replica[rep] = per_replica.get(rep, 0) + 1
+        bad_payloads = [r for r in res
+                        if r["status"] == "completed"
+                        and not (r.get("scale_ok", True)
+                                 and r.get("tokens_ok", True))]
+        return {
+            "tasks": len(self.tasks), "finished": len(res),
+            "completed": counts["completed"], "shed": counts["shed"],
+            "lost": counts["lost"],
+            "lost_detail": [r for r in res if r["status"] == "lost"][:5],
+            "bad_payloads": len(bad_payloads),
+            "client_retries": sum(r["sheds"] for r in res),
+            "latency_ms_p50": pct(0.50), "latency_ms_p99": pct(0.99),
+            "per_replica_completed": per_replica,
+        }
+
+
+# -- spread metrics -----------------------------------------------------------
+
+def spread_metrics(router_stats):
+    reps = router_stats["replicas"].values()
+    peaks = [r["peak_load"] for r in reps] or [0.0]
+    routed = [r["routed"] for r in reps] or [0]
+    return {
+        "peak_loads": sorted(round(p, 3) for p in peaks),
+        "routed": sorted(routed),
+        "load_spread": round((1.0 + max(peaks)) / (1.0 + min(peaks)), 4),
+        "request_spread": round(
+            max(routed) / max(float(min(routed)), 1.0), 4),
+    }
+
+
+# -- the measurement ----------------------------------------------------------
+
+def bench(root, replicas=3, n_predict=240, n_generate=30,
+          balance_predict=120, balance_generate=16, threads=8,
+          kill_at=1 / 3.0, reload_at=2 / 3.0, bad_reload=True,
+          balance=True, seed=0):
+    """Full harness: chaos flood (kill + rolling reload (+ failed
+    reload)) then the least-loaded-vs-round-robin balance phases.
+    Returns the summary dict the smoke gate asserts over."""
+    from paddle_tpu import resilience
+
+    arts = build_artifacts(os.path.join(root, "artifacts"))
+    resilience.clear_events()
+    out = {"replicas": replicas, "n_predict": n_predict,
+           "n_generate": n_generate, "threads": threads}
+    pool, router, server, url = start_fleet(arts, replicas)
+    try:
+        # ---- chaos leg ----------------------------------------------------
+        tasks = make_tasks(n_predict, n_generate, seed=seed)
+        runner = FloodRunner(url, tasks, threads=threads).start()
+        n = len(tasks)
+        runner.wait_done(int(n * kill_at))
+        killed_pid = pool.kill(replicas - 1, signal.SIGKILL)
+        t_kill = time.monotonic()
+        runner.wait_done(int(n * reload_at))
+        status, body = _post("%s/v1/models/m:reload" % url,
+                             {"dirname": arts["v2"]}, timeout=600.0)
+        out["reload_status"] = status
+        out["reload_body"] = body
+        runner.wait(timeout=900.0)
+        out["flood"] = runner.summary()
+        out["killed_pid"] = killed_pid
+
+        # restart evidence: the pool respawned the killed worker
+        restart_events = resilience.events(kind="router_replica_restart")
+        out["restart_events"] = len(restart_events)
+        # wait for the respawn to become ready again (bounded)
+        deadline = time.monotonic() + 300.0
+        while time.monotonic() < deadline:
+            reps = pool.snapshot()
+            if len(reps) == replicas and all(r.ready for r in reps):
+                break
+            time.sleep(0.2)
+        out["restart_ready_s"] = round(time.monotonic() - t_kill, 2)
+        out["fleet_ready_after_kill"] = all(
+            r.ready for r in pool.snapshot())
+
+        # reload evidence: every replica serves v2 now
+        versions = {}
+        for rep in pool.snapshot():
+            try:
+                _, info = _get(rep.base_url + "/v1/models", timeout=10.0)
+                versions[rep.index] = (info.get("m") or {}).get("dirname")
+            except Exception as e:
+                versions[rep.index] = repr(e)
+        # the replica that restarted AFTER the rolling reload rebooted
+        # from the pool's launch artifact (v1) — an honest limitation
+        # recorded below; every replica that lived through the rollout
+        # must be on v2
+        out["post_reload_dirnames"] = versions
+        out["reload_all_v2"] = all(v == arts["v2"]
+                                   for i, v in versions.items()
+                                   if i != replicas - 1)
+
+        # ---- failed-reload leg --------------------------------------------
+        if bad_reload:
+            status, body = _post("%s/v1/models/m:reload" % url,
+                                 {"dirname": arts["bad"]}, timeout=600.0)
+            out["bad_reload_status"] = status
+            out["bad_reload_body"] = body
+            rb = resilience.events(kind="reload_rollback")
+            out["reload_rollback_events"] = len(
+                [e for e in rb if e.get("site") == "serving.route"])
+            survivors = {}
+            for rep in pool.snapshot():
+                try:
+                    _, info = _get(rep.base_url + "/v1/models",
+                                   timeout=10.0)
+                    survivors[rep.index] = (info.get("m")
+                                            or {}).get("dirname")
+                except Exception as e:
+                    survivors[rep.index] = repr(e)
+            out["bad_reload_dirnames"] = survivors
+            out["fleet_intact_after_bad_reload"] = all(
+                v in (arts["v1"], arts["v2"])
+                for v in survivors.values())
+            # and the fleet still answers traffic
+            probe = FloodRunner(url, make_tasks(8, 2, seed=seed + 1),
+                                threads=4).start()
+            probe.wait(timeout=300.0)
+            out["post_bad_reload_probe"] = probe.summary()
+
+        # ---- balance phases -----------------------------------------------
+        if balance:
+            out["balance"] = {}
+            for policy in ("least_loaded", "round_robin"):
+                router.policy = policy
+                router.reset_stats()
+                b = FloodRunner(url, make_tasks(balance_predict,
+                                                balance_generate,
+                                                seed=seed + 2),
+                                threads=threads).start()
+                b.wait(timeout=900.0)
+                st = router.stats()
+                out["balance"][policy] = {
+                    "flood": b.summary(),
+                    "spread": spread_metrics(st),
+                }
+            ll = out["balance"]["least_loaded"]["spread"]
+            rr = out["balance"]["round_robin"]["spread"]
+            out["balance"]["ll_beats_rr_load_spread"] = (
+                ll["load_spread"] <= rr["load_spread"])
+        out["router_stats"] = router.stats()
+    finally:
+        stop_fleet(pool, router, server)
+    return out
+
+
+if __name__ == "__main__":
+    import argparse
+    import sys
+    import tempfile
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--predict", type=int, default=240)
+    ap.add_argument("--generate", type=int, default=30)
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--root", default=None)
+    ap.add_argument("--bank", action="store_true",
+                    help="persist a paddle_tpu.bench.v1 row under "
+                         "benchmark/results/")
+    a = ap.parse_args()
+    root = a.root or tempfile.mkdtemp(prefix="paddle_tpu_load_bench_")
+    summary = bench(root, replicas=a.replicas, n_predict=a.predict,
+                    n_generate=a.generate, threads=a.threads)
+    print(json.dumps(summary, indent=1, default=str))
+    if a.bank:
+        from paddle_tpu.tune import results as results_mod
+        row = {
+            "replicas": summary["replicas"],
+            "flood": summary["flood"],
+            "restart_events": summary["restart_events"],
+            "restart_ready_s": summary["restart_ready_s"],
+            "reload_status": summary["reload_status"],
+            "reload_all_v2": summary["reload_all_v2"],
+            "bad_reload_status": summary.get("bad_reload_status"),
+            "fleet_intact_after_bad_reload":
+                summary.get("fleet_intact_after_bad_reload"),
+            "balance": {
+                p: summary["balance"][p]["spread"]
+                for p in ("least_loaded", "round_robin")},
+            "ll_beats_rr_load_spread":
+                summary["balance"]["ll_beats_rr_load_spread"],
+            "p50_ms": summary["flood"]["latency_ms_p50"],
+            "p99_ms": summary["flood"]["latency_ms_p99"],
+        }
+        rec = results_mod.bench_record(
+            "load_router", [row],
+            meta={"n_predict": a.predict, "n_generate": a.generate,
+                  "threads": a.threads})
+        print("banked:", results_mod.write_result(rec))

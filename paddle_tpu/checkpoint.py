@@ -400,11 +400,15 @@ def _load_one(dirname, program, scope, dist_context, verify):
                     % (sh["file"], err))
         staged[name] = arr
     for name, arr in staged.items():
+        # copy=True guarantees an XLA-owned buffer: device_put/asarray of
+        # a bare numpy array may alias its memory zero-copy on CPU, and a
+        # later donated training step would then free memory numpy still
+        # owns — use-after-free reads (NaN'd weights, zeroed fetches) that
+        # surface as a flaky cross-mesh-restore loss divergence
+        val = jax.numpy.array(arr, copy=True)
         if dist_context is not None:
-            val = jax.device_put(arr,
+            val = jax.device_put(val,
                                  dist_context.sharding_for(name, arr))
-        else:
-            val = jax.numpy.asarray(arr)
         scope.set_var(name, val)
     return manifest.get("step")
 

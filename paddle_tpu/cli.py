@@ -1,5 +1,5 @@
 """Command line: ``python -m paddle_tpu
-{train,bench,lint,serve,accounting,tune,info,convert}``.
+{train,bench,lint,serve,route,accounting,tune,info,convert}``.
 
 reference: the ``paddle`` binary (paddle/trainer/TrainerMain.cpp:32 —
 ``paddle train``, ``paddle pserver``, ``paddle merge_model``; launch wrapper
@@ -120,44 +120,92 @@ def cmd_lint(args):
     return 1 if failed else 0
 
 
+def _parse_extra_models(pairs, primary=None):
+    """``--extra_model name=dir`` entries -> [(name, dir)]; raises
+    ValueError on a malformed pair or a name collision (two extras, or
+    an extra shadowing ``primary``/``--name`` — load_model would
+    silently hot-swap the earlier artifact)."""
+    out = []
+    seen = {primary} if primary else set()
+    for pair in pairs or []:
+        name, eq, dirname = pair.partition("=")
+        if not (eq and name.strip() and dirname.strip()):
+            raise ValueError("bad --extra_model %r (want name=dir)" % pair)
+        name = name.strip()
+        if name in seen:
+            raise ValueError("duplicate model name %r (--extra_model "
+                             "must not repeat a name or shadow --name)"
+                             % name)
+        seen.add(name)
+        out.append((name, dirname.strip()))
+    return out
+
+
+def _validate_artifacts(verb, artifact_dir, extra_models):
+    """Validate the primary + every extra artifact up front; prints the
+    problems and returns False on a bad one (nothing gets started)."""
+    from paddle_tpu import inference
+    for label, dirname in [("artifact", artifact_dir)] + [
+            ("extra model %r" % n, d) for n, d in extra_models]:
+        generative = inference.is_generative_artifact(dirname)
+        problems = (inference.validate_generative_artifact(dirname)
+                    if generative else inference.validate_artifact(dirname))
+        if problems:
+            print("%s: cannot serve %s %r:" % (verb, label, dirname),
+                  file=sys.stderr)
+            for p in problems:
+                print("  - " + p, file=sys.stderr)
+            return False
+    return True
+
+
 def cmd_serve(args):
     """Serve a compiled OR generative artifact over HTTP
     (paddle_tpu.serving): validate the artifact directory (exit 1,
     readable message, nothing started on a bad one), register + warm it
     — a generative artifact stands a continuous-batching engine up
     behind ``:generate`` — then run the JSON endpoint until
-    SIGTERM/SIGINT, which drains cleanly and exits 0."""
+    SIGTERM/SIGINT, which drains cleanly and exits 0. Repeatable
+    ``--extra_model name=dir`` entries publish additional artifacts
+    from the same process (how a router replica serves a predict model
+    and a generate model side by side)."""
     from paddle_tpu import inference, serving
 
+    try:
+        extra_models = _parse_extra_models(args.extra_model,
+                                           primary=args.name)
+    except ValueError as e:
+        print("serve: %s" % e, file=sys.stderr)
+        return 1
     generative = inference.is_generative_artifact(args.artifact_dir)
-    problems = (inference.validate_generative_artifact(args.artifact_dir)
-                if generative
-                else inference.validate_artifact(args.artifact_dir))
-    if problems:
-        print("serve: cannot serve %r:" % args.artifact_dir,
-              file=sys.stderr)
-        for p in problems:
-            print("  - " + p, file=sys.stderr)
+    if not _validate_artifacts("serve", args.artifact_dir, extra_models):
         return 1
     service = serving.InferenceService(
         max_batch=args.max_batch or None,
         batch_timeout_ms=(args.batch_timeout_ms
                           if args.batch_timeout_ms >= 0 else None),
         queue_depth=args.queue_depth or None)
-    gen_kwargs = {}
-    if generative:
-        if args.max_running:
-            gen_kwargs["max_running"] = args.max_running
-        if args.kv_pages:
-            gen_kwargs["kv_pages"] = args.kv_pages
-        if args.page_tokens:
-            gen_kwargs["page_tokens"] = args.page_tokens
+    gen_overrides = {}
+    if args.max_running:
+        gen_overrides["max_running"] = args.max_running
+    if args.kv_pages:
+        gen_overrides["kv_pages"] = args.kv_pages
+    if args.page_tokens:
+        gen_overrides["page_tokens"] = args.page_tokens
+    loading = args.artifact_dir
     try:
-        entry = service.load_model(args.name, args.artifact_dir,
-                                   **gen_kwargs)
+        entry = service.load_model(
+            args.name, args.artifact_dir,
+            **(gen_overrides if generative else {}))
+        for extra_name, extra_dir in extra_models:
+            loading = extra_dir
+            service.load_model(
+                extra_name, extra_dir,
+                **(gen_overrides
+                   if inference.is_generative_artifact(extra_dir) else {}))
     except Exception as e:
         print("serve: failed to load %r: %s: %s"
-              % (args.artifact_dir, type(e).__name__, e), file=sys.stderr)
+              % (loading, type(e).__name__, e), file=sys.stderr)
         service.close()
         return 1
     server = serving.make_server(service, host=args.host, port=args.port)
@@ -170,6 +218,8 @@ def cmd_serve(args):
         "version": entry.version, "warmup_ms": round(entry.warmup_ms, 3),
         "max_batch": service.max_batch,
         "batch_timeout_ms": service.batch_timeout_ms}
+    if extra_models:
+        info["extra_models"] = [n for n, _ in extra_models]
     if generative:
         info.update({"max_running": entry.engine.max_running,
                      "kv_pages": entry.engine.pool.num_pages,
@@ -185,6 +235,94 @@ def cmd_serve(args):
         server.server_close()
         service.close()
     print(json.dumps({"serving_stopped": {
+        "signal": signum, "stats": final_stats}}), flush=True)
+    return 0
+
+
+def cmd_route(args):
+    """Front a fleet of ``serve`` replicas with the multi-replica router
+    (paddle_tpu.serving.router): validate the artifact(s), spawn and
+    supervise ``--replicas`` worker processes (SIGTERM->SIGKILL drain,
+    RetryPolicy restarts on crash), and run the proxy tier —
+    least-loaded routing from polled /statz, health eject/probation,
+    one failover retry, rolling ``:reload`` — until SIGTERM/SIGINT,
+    which drains the fleet and exits 0."""
+    from paddle_tpu.flags import FLAGS
+    from paddle_tpu.serving import (ReplicaPool, Router, httpd,
+                                    make_router_server)
+
+    try:
+        extra_models = _parse_extra_models(args.extra_model,
+                                           primary=args.name)
+    except ValueError as e:
+        print("route: %s" % e, file=sys.stderr)
+        return 1
+    if not _validate_artifacts("route", args.artifact_dir, extra_models):
+        return 1
+    serve_args = []
+    if args.max_batch:
+        serve_args += ["--max_batch", str(args.max_batch)]
+    if args.batch_timeout_ms >= 0:
+        serve_args += ["--batch_timeout_ms", str(args.batch_timeout_ms)]
+    if args.queue_depth:
+        serve_args += ["--queue_depth", str(args.queue_depth)]
+    if args.max_running:
+        serve_args += ["--max_running", str(args.max_running)]
+    if args.kv_pages:
+        serve_args += ["--kv_pages", str(args.kv_pages)]
+    if args.page_tokens:
+        serve_args += ["--page_tokens", str(args.page_tokens)]
+    for n, d in extra_models:
+        serve_args += ["--extra_model", "%s=%s" % (n, d)]
+    try:
+        pool = ReplicaPool(
+            args.artifact_dir, args.replicas or FLAGS.route_replicas,
+            name=args.name, host=args.host, serve_args=serve_args,
+            restart_budget=(args.restart_budget if args.restart_budget >= 0
+                            else None),
+            grace_sec=args.grace_sec)
+        pool.start(wait=True)
+    except Exception as e:
+        print("route: %s" % e, file=sys.stderr)
+        return 1
+    router = None
+    try:
+        # anything failing before the serve loop (say, the router port
+        # already bound) must still drain the fleet pool.start spawned
+        # — no orphan serve workers on an exception
+        router = Router(pool, policy=args.policy,
+                        poll_ms=args.poll_ms if args.poll_ms > 0 else None)
+        router.poll_once()
+        router.start_polling()
+        server = make_router_server(router, host=args.host,
+                                    port=args.port)
+    except Exception as e:
+        if router is not None:
+            router.close()
+        pool.stop()
+        print("route: %s: %s" % (type(e).__name__, e), file=sys.stderr)
+        return 1
+    host, port = server.server_address[:2]
+    print(json.dumps({"router": {
+        "host": host, "port": port, "model": args.name,
+        "policy": router.policy,
+        "replicas": [{"index": w["index"], "port": w["port"],
+                      "pid": w["pid"]}
+                     for w in pool.describe()["workers"]]}}), flush=True)
+    try:
+        signum = httpd.serve_until_shutdown(server)
+    finally:
+        final_stats = None
+        try:
+            # stats/close can take a couple of seconds (the close joins
+            # the poller) — a second Ctrl-C landing there must still
+            # drain the fleet, so pool.stop() is not gated on them
+            final_stats = router.stats()
+            server.server_close()
+            router.close()
+        finally:
+            pool.stop()
+    print(json.dumps({"router_stopped": {
         "signal": signum, "stats": final_stats}}), flush=True)
     return 0
 
@@ -499,7 +637,67 @@ def main(argv=None):
     sv.add_argument("--page_tokens", type=int, default=0,
                     help="generative artifacts: override "
                          "FLAGS.serve_page_tokens (0 = flag)")
+    sv.add_argument("--extra_model", action="append", default=[],
+                    metavar="NAME=DIR",
+                    help="additional artifact(s) to publish from the "
+                         "same process (repeatable): a router replica "
+                         "serves its predict and generate models side "
+                         "by side this way")
     sv.set_defaults(fn=cmd_serve)
+
+    rt = sub.add_parser(
+        "route", help="front N supervised `serve` replicas with the "
+                      "multi-replica router (paddle_tpu.serving.router: "
+                      "least-loaded proxying, health eject/probation, "
+                      "failover, rolling :reload; SIGTERM drains the "
+                      "fleet and exits 0)")
+    rt.add_argument("artifact_dir",
+                    help="artifact every replica serves (compiled or "
+                         "generative; see also --extra_model)")
+    rt.add_argument("--name", default="default",
+                    help="model name in the registry / URL")
+    rt.add_argument("--host", default="127.0.0.1")
+    rt.add_argument("--port", type=int, default=8600,
+                    help="router port; 0 binds a free one (printed on "
+                         "the readiness line). Replicas always bind "
+                         "free ports")
+    rt.add_argument("--replicas", type=int, default=0,
+                    help="worker process count (0 = "
+                         "FLAGS.route_replicas)")
+    rt.add_argument("--policy", choices=["least_loaded", "round_robin"],
+                    default="least_loaded",
+                    help="replica selection: least_loaded scores each "
+                         "replica from its polled /statz (queue depth + "
+                         "generation backlog + KV pressure) plus live "
+                         "in-flight counts; round_robin is the "
+                         "load-blind baseline benchmark/load_bench.py "
+                         "compares against")
+    rt.add_argument("--poll_ms", type=int, default=0,
+                    help="health/load poll interval (0 = "
+                         "FLAGS.route_poll_ms)")
+    rt.add_argument("--restart_budget", type=int, default=-1,
+                    help="restarts per dead replica before declaring it "
+                         "lost (negative = FLAGS.route_restart_budget)")
+    rt.add_argument("--grace_sec", type=float, default=5.0,
+                    help="SIGTERM drain window before the pool "
+                         "escalates to SIGKILL at shutdown")
+    rt.add_argument("--max_batch", type=int, default=0,
+                    help="forwarded to every replica (0 = flag)")
+    rt.add_argument("--batch_timeout_ms", type=float, default=-1.0,
+                    help="forwarded to every replica (negative = flag)")
+    rt.add_argument("--queue_depth", type=int, default=0,
+                    help="forwarded to every replica (0 = flag)")
+    rt.add_argument("--max_running", type=int, default=0,
+                    help="forwarded to every replica (0 = flag)")
+    rt.add_argument("--kv_pages", type=int, default=0,
+                    help="forwarded to every replica (0 = flag)")
+    rt.add_argument("--page_tokens", type=int, default=0,
+                    help="forwarded to every replica (0 = flag)")
+    rt.add_argument("--extra_model", action="append", default=[],
+                    metavar="NAME=DIR",
+                    help="additional artifact(s) every replica publishes "
+                         "(repeatable)")
+    rt.set_defaults(fn=cmd_route)
 
     acc = sub.add_parser(
         "accounting", help="per-chip collective bytes + comm-policy "

@@ -397,3 +397,38 @@ DEFINE_int32("serve_page_tokens", 16,
              "waste less tail capacity per sequence but grow the block "
              "tables (max_blocks = ceil(max_seq / page_tokens) gather "
              "indices per row in the fused decode step)")
+DEFINE_int32("route_replicas", 3,
+             "serving router (paddle_tpu.serving.router): how many "
+             "`serve` worker processes the replica pool spawns and "
+             "supervises behind one `paddle_tpu route` front tier "
+             "(CLI --replicas overrides)")
+DEFINE_int32("route_poll_ms", 100,
+             "serving router: background poll interval for each "
+             "replica's /statz (load score) and /healthz (liveness). "
+             "Between polls the score is freshened by the router's own "
+             "in-flight request count, so a shorter interval mainly "
+             "tightens eject/readmit latency, not balance")
+DEFINE_int32("route_eject_after", 3,
+             "serving router: consecutive /healthz failures before a "
+             "replica is ejected from routing (it keeps being polled; "
+             "see route_readmit_after for the probation readmit)")
+DEFINE_int32("route_readmit_after", 2,
+             "serving router: consecutive /healthz successes an "
+             "ejected replica must bank (probation) before it is "
+             "readmitted to routing — one lucky poll must not put a "
+             "flapping replica back in rotation")
+DEFINE_int32("route_restart_budget", 2,
+             "serving router: how many times the replica pool restarts "
+             "one dead `serve` worker (on the resilience RetryPolicy "
+             "backoff schedule, each restart a recorded "
+             "router_replica_restart event) before declaring it lost "
+             "(router_replica_lost; the remaining replicas keep "
+             "serving). The budget bounds crash LOOPS: a respawn that "
+             "stays up 60s (ReplicaPool budget_reset_s) resets the "
+             "slot's record")
+DEFINE_float("route_proxy_timeout_s", 300.0,
+             "serving router: socket timeout for one proxied replica "
+             "request (predict/generate/reload). A request carrying "
+             "deadline_ms uses min(deadline, this). Proxy failures "
+             "inside the window fail over once to the next-best "
+             "replica")

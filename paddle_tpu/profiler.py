@@ -28,7 +28,9 @@ __all__ = ["timer", "stat_summary", "print_stats", "reset_stats",
            "update_elastic_counters", "elastic_counters",
            "reset_elastic_counters",
            "update_generation_counters", "generation_counters",
-           "reset_generation_counters"]
+           "reset_generation_counters",
+           "update_router_counters", "router_counters",
+           "reset_router_counters"]
 
 _enabled = False
 _records = defaultdict(list)  # label -> [seconds]
@@ -40,6 +42,7 @@ _comm_counters = defaultdict(float)      # gradient-communication observability
 _tune_counters = defaultdict(float)      # kernel-autotuning observability
 _elastic_counters = defaultdict(float)   # elasticity observability
 _generation_counters = defaultdict(float)  # autoregressive-serving observability
+_router_counters = defaultdict(float)     # multi-replica-router observability
 _T0 = time.perf_counter()
 
 
@@ -85,6 +88,7 @@ def reset_profiler():
     _tune_counters.clear()
     _elastic_counters.clear()
     _generation_counters.clear()
+    _router_counters.clear()
 
 
 def update_pipeline_counters(**counters):
@@ -232,6 +236,40 @@ def reset_generation_counters():
     _generation_counters.clear()
 
 
+_ROUTER_MAX_KEYS = frozenset(("router_peak_load", "router_replicas"))
+
+
+def update_router_counters(**counters):
+    """Accumulate multi-replica-router observability counters
+    (paddle_tpu.serving.router/pool; a few dict adds per routed request
+    or per supervision event, recorded in the ROUTER process — each
+    replica keeps its own serving/generation counters). Keys in use:
+    ``router_requests`` (proxied attempts), ``router_failovers``,
+    ``router_no_replica`` (503s: no healthy replica),
+    ``router_proxy_failed`` (503s: replicas were routable but both
+    failover attempts died on transport), ``router_ejects``
+    / ``router_readmits`` (health state transitions),
+    ``router_reloads`` / ``router_reload_rollbacks`` (rolling hot
+    reload outcomes), ``router_replica_restarts`` /
+    ``router_replica_lost`` (pool supervision); ``router_peak_load``
+    (largest per-replica load score observed by the poller) and
+    ``router_replicas`` (configured pool size) are kept as maxima."""
+    for k, v in counters.items():
+        if k in _ROUTER_MAX_KEYS:
+            _router_counters[k] = max(_router_counters[k], float(v))
+        else:
+            _router_counters[k] += float(v)
+
+
+def router_counters():
+    """Snapshot {counter: value} of the multi-replica-router counters."""
+    return dict(_router_counters)
+
+
+def reset_router_counters():
+    _router_counters.clear()
+
+
 def record_op_event(op_type, name, t_start, t_end):
     """Per-op span from the eager interpreter path (on the jit path the
     per-op loop does not exist at run time — op granularity comes from the
@@ -326,6 +364,10 @@ def write_timeline(path):
       decode steps, generated tokens, running-batch/page-utilization
       maxima, sheds/preemptions) — the continuous-batching evidence for
       paddle_tpu.serving.generator.
+    - ``router``: multi-replica-router counters (proxied requests,
+      failovers, health ejects/readmits, rolling-reload outcomes,
+      replica restarts, peak load score) — the fleet evidence for
+      paddle_tpu.serving.router.
     """
     import json
     rows = []
@@ -347,6 +389,7 @@ def write_timeline(path):
         "tune": dict(_tune_counters),
         "elastic": dict(_elastic_counters),
         "generation": dict(_generation_counters),
+        "router": dict(_router_counters),
     }
     with open(path, "w") as f:
         json.dump(artifact, f, indent=1)

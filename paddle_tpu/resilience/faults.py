@@ -48,6 +48,16 @@ site                      where
                           generation-shaped; a delay models a slow
                           device and stretches inter-token latency
                           into the deadline shed path
+``serving.route``         the router's proxy edge
+                          (paddle_tpu.serving.router), hit once per
+                          proxied replica attempt, before the upstream
+                          POST: a raise is indistinguishable from a
+                          dead replica — that attempt fails over to
+                          the next-best replica with a recorded
+                          ``route_failover`` event and the router
+                          keeps serving (never a crash); a delay
+                          models a slow fabric and stretches proxied
+                          latency into the client's deadline
 ``comm.quantize``         paddle_tpu.comm, per bucket at the quantised
                           all-reduce BUILD (trace time — the traced
                           collectives never re-enter the host): a raise

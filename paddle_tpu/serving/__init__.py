@@ -66,6 +66,8 @@ from .generator import (  # noqa: F401
     GenerationEngine, GenRequest, GenResult, reference_decode,
     sample_token,
 )
+from .pool import ReplicaPool, StaticPool  # noqa: F401
+from .router import Router, make_router_server  # noqa: F401
 
 __all__ = [
     "InferenceService", "ModelRegistry", "ModelEntry", "MicroBatcher",
@@ -75,4 +77,5 @@ __all__ = [
     "PagePool", "BlockTable", "PoolExhausted", "pages_for",
     "GenerationEngine", "GenRequest", "GenResult", "GenEntry",
     "reference_decode", "sample_token",
+    "ReplicaPool", "StaticPool", "Router", "make_router_server",
 ]

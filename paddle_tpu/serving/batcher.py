@@ -189,6 +189,14 @@ class MicroBatcher(object):
         with self._cond:
             return self._pending_locked()
 
+    def pending_for(self, model):
+        """Queued requests for ONE model (summed over its shape-bucket
+        queues) — the per-model queue depth the /healthz readiness
+        detail reports."""
+        with self._cond:
+            return sum(len(q) for (m, _sig), q in self._queues.items()
+                       if m == model)
+
     def _pending_locked(self):
         return sum(len(q) for q in self._queues.values())
 

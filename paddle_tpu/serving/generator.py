@@ -397,6 +397,14 @@ class GenerationEngine(object):
                 # silently dead loop (the batcher's contract)
                 self._fail_running(e)
 
+    @property
+    def draining(self):
+        """True between :meth:`drain` and :meth:`close` — the hot-reload
+        handover window. Surfaces in the /healthz readiness detail so a
+        router stops sending new work here."""
+        with self._cond:
+            return self._draining
+
     def drain(self, timeout=None):
         """Stop accepting new submits and wait for the queue and the
         running set to empty — the hot-reload handover: in-flight
@@ -760,6 +768,7 @@ class GenerationEngine(object):
                 "prompt_tokens": c.get("prompt_tokens", 0),
                 "queued": len(self._queue),
                 "running": len(self._seqs),
+                "max_running": self.max_running,
                 "max_running_seen": self._max_running_seen,
                 "running_occupancy": (self._occupancy_sum / steps
                                       if steps else 0.0),

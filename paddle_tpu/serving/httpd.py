@@ -30,7 +30,15 @@ GET    ``/statz``                       ``InferenceService.stats``
 Error mapping: 429 overload shed (and kv-pool exhaustion — kind
 ``kv_pool_exhausted``: backpressure, not a server fault), 504 deadline
 shed, 404 unknown model, 400 malformed input, 500 dispatch failure —
-each body carries ``{"error": ..., "kind": ...}``. The server is a
+each body carries ``{"error": ..., "kind": ...}``. 429 answers also
+carry a back-off hint derived from current queue-wait stats
+(``InferenceService.retry_after_ms``): a ``Retry-After`` header in
+integral delta-seconds plus the precise ``retry_after_ms`` body field,
+so clients (and the router) back off proportionally to the actual
+backlog. ``/healthz`` keeps its 200-liveness contract and adds a
+``ready`` object — per-model kind/version/queue depth, and for
+generative models KV page utilization + draining state — the readiness
+detail the router tier weights and drains on. The server is a
 ``ThreadingHTTPServer``: one thread per connection *blocks* in
 ``InferenceService.infer``/``generate`` while a single dispatch/engine
 thread batches across them — concurrency lives in the batcher and the
@@ -51,6 +59,44 @@ __all__ = ["make_server", "serve_until_shutdown"]
 _MAX_BODY = 64 * 1024 * 1024
 
 
+def write_json_reply(handler, code, payload, retry_after_ms=None):
+    """Serialize one JSON answer on ``handler`` (the serve AND router
+    handlers share this — the Retry-After contract must not drift).
+    ``retry_after_ms`` (429/503 answers) adds both faces of the
+    back-off hint: a ``Retry-After`` header in RFC 7231 integral
+    delta-seconds (ceil, min 1) for generic clients, and the precise
+    ``retry_after_ms`` in the body for the router and our own clients —
+    derived from current queue-wait stats so backoff scales with the
+    actual backlog instead of a fixed constant."""
+    if retry_after_ms is not None:
+        payload = dict(payload)
+        payload["retry_after_ms"] = round(float(retry_after_ms), 3)
+    body = json.dumps(payload).encode("utf-8")
+    handler.send_response(code)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(body)))
+    if retry_after_ms is not None:
+        handler.send_header("Retry-After",
+                            str(max(1, int(-(-retry_after_ms // 1000)))))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def read_json_body(handler):
+    """Read + parse one request's JSON object body on ``handler`` (the
+    serve AND router handlers share this — the size cap and dict
+    contract must not drift). Raises ValueError on an oversized or
+    non-object body; the caller maps it to a 400."""
+    n = int(handler.headers.get("Content-Length") or 0)
+    if n > _MAX_BODY:
+        raise ValueError("request body too large (%d bytes)" % n)
+    raw = handler.rfile.read(n) if n else b"{}"
+    body = json.loads(raw.decode("utf-8"))
+    if not isinstance(body, dict):
+        raise ValueError("request body must be a JSON object")
+    return body
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     # request logging would serialize every request on stderr writes
@@ -64,29 +110,28 @@ class _Handler(BaseHTTPRequestHandler):
         return self.server.service
 
     # -- plumbing ------------------------------------------------------------
-    def _reply(self, code, payload):
-        body = json.dumps(payload).encode("utf-8")
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+    def _reply(self, code, payload, retry_after_ms=None):
+        write_json_reply(self, code, payload,
+                         retry_after_ms=retry_after_ms)
+
+    def _retry_hint(self, model=None):
+        try:
+            return self.service.retry_after_ms(model)
+        except Exception:           # the hint must never fail the shed
+            return 1000.0
 
     def _read_json(self):
-        n = int(self.headers.get("Content-Length") or 0)
-        if n > _MAX_BODY:
-            raise ValueError("request body too large (%d bytes)" % n)
-        raw = self.rfile.read(n) if n else b"{}"
-        body = json.loads(raw.decode("utf-8"))
-        if not isinstance(body, dict):
-            raise ValueError("request body must be a JSON object")
-        return body
+        return read_json_body(self)
 
     # -- routes --------------------------------------------------------------
     def do_GET(self):
         if self.path == "/healthz":
+            # liveness contract unchanged (200 + "ok" + "models" —
+            # existing callers keep working); "ready" adds the per-model
+            # readiness detail the router weights and drains on
             self._reply(200, {"ok": True,
-                              "models": self.service.model_info()})
+                              "models": self.service.model_info(),
+                              "ready": self.service.readiness()})
         elif self.path == "/statz":
             self._reply(200, self.service.stats)
         elif self.path == "/v1/models":
@@ -140,7 +185,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._reply(404, {"error": str(e),
                                      "kind": "model_unavailable"})
         except OverloadError as e:
-            return self._reply(429, {"error": str(e), "kind": "overload"})
+            return self._reply(429, {"error": str(e), "kind": "overload"},
+                               retry_after_ms=self._retry_hint(name))
         except DeadlineExceededError as e:
             return self._reply(504, {"error": str(e), "kind": "deadline"})
         except ValueError as e:
@@ -183,9 +229,11 @@ class _Handler(BaseHTTPRequestHandler):
                                      "kind": "model_unavailable"})
         except PoolExhausted as e:
             return self._reply(429, {"error": str(e),
-                                     "kind": "kv_pool_exhausted"})
+                                     "kind": "kv_pool_exhausted"},
+                               retry_after_ms=self._retry_hint(name))
         except OverloadError as e:
-            return self._reply(429, {"error": str(e), "kind": "overload"})
+            return self._reply(429, {"error": str(e), "kind": "overload"},
+                               retry_after_ms=self._retry_hint(name))
         except DeadlineExceededError as e:
             return self._reply(504, {"error": str(e), "kind": "deadline"})
         except (TypeError, ValueError) as e:

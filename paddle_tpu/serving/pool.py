@@ -1,0 +1,409 @@
+"""Replica pool: spawn, supervise, and restart N ``serve`` workers.
+
+One ``paddle_tpu serve`` process owns one batcher, one generation
+engine, one KV pool — which caps throughput at a single process and
+makes every crash a full outage. The pool is the supervision half of
+the router tier (the reference ran this in Go: the master and pservers
+registered in etcd and watched each other's health; here the pool IS
+the watcher): it spawns ``n`` identical ``serve`` subprocesses on free
+ports, reads each one's readiness line for the bound port, and treats
+worker death the way the elastic supervisor treats trainer death — as
+an event to classify and absorb, never a verdict:
+
+- an unexpected exit (crash, OOM, an operator's ``kill -9``) restarts
+  that replica on the resilience :class:`RetryPolicy` backoff schedule,
+  spending a per-replica ``restart_budget``; every restart is a
+  recorded ``router_replica_restart`` degradation event, and the
+  restarted worker comes back on a FRESH port (the router re-discovers
+  it through :meth:`ReplicaPool.snapshot`). A respawn that stays up
+  ``budget_reset_s`` (default 60 s) resets the slot's record — the
+  budget bounds crash loops, not the fleet's lifetime crash total;
+- a spent budget marks the replica **lost** (``router_replica_lost``
+  event) — the remaining replicas keep serving, the pool never raises;
+- :meth:`ReplicaPool.stop` drains the fleet with the elastic
+  supervisor's escalation: SIGTERM (each worker's ``serve`` loop
+  drains in-flight requests and exits 0), then SIGKILL after
+  ``grace_sec`` — a worker wedged in a bad compile cannot hold the
+  pool hostage.
+
+The pool knows nothing about HTTP routing; it only answers "which
+worker processes exist right now, and are they ready". The router
+(:mod:`paddle_tpu.serving.router`) polls :meth:`snapshot` and layers
+health, load scoring, and failover on top.
+"""
+from __future__ import annotations
+
+import json
+import os
+import queue
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+from ..resilience import RetryPolicy, record_event
+
+__all__ = ["Replica", "ReplicaPool", "StaticReplica", "StaticPool"]
+
+
+def _repo_root():
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+class Replica(object):
+    """One live ``serve`` worker: process handle + readiness state.
+
+    ``generation`` counts respawns of this slot (0 = the original
+    process); the router resets its per-replica health state whenever
+    the generation it sees changes — a fresh process must not inherit
+    its predecessor's eject record.
+    """
+
+    __slots__ = ("index", "generation", "proc", "host", "port", "info",
+                 "_ready", "_reader", "last_line")
+
+    def __init__(self, index, generation, proc, host):
+        self.index = index
+        self.generation = generation
+        self.proc = proc
+        self.host = host
+        self.port = None
+        self.info = None          # the readiness line's {"serving": ...}
+        self.last_line = None     # most recent stdout JSON (stop stats)
+        self._ready = threading.Event()
+        self._reader = threading.Thread(
+            target=self._read_stdout, daemon=True,
+            name="paddle_tpu-replica-%d-stdout" % index)
+        self._reader.start()
+
+    def _read_stdout(self):
+        """Parse the worker's stdout: the first ``{"serving": ...}``
+        line carries the bound port (the ``serve`` readiness contract);
+        everything after is drained so a chatty worker can never block
+        on a full pipe, and the last JSON line is kept (the
+        ``serving_stopped`` evidence)."""
+        for line in self.proc.stdout:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            self.last_line = obj
+            if "serving" in obj and not self._ready.is_set():
+                self.info = obj["serving"]
+                self.port = int(self.info["port"])
+                self._ready.set()
+
+    @property
+    def pid(self):
+        return self.proc.pid
+
+    @property
+    def alive(self):
+        return self.proc.poll() is None
+
+    @property
+    def ready(self):
+        return self.alive and self._ready.is_set()
+
+    @property
+    def base_url(self):
+        if self.port is None:
+            return None
+        return "http://%s:%d" % (self.host, self.port)
+
+    def wait_ready(self, timeout):
+        """Block until the readiness line arrives; False on timeout or
+        if the process died first."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._ready.wait(timeout=0.2):
+                return True
+            if not self.alive:
+                return False
+        return self._ready.is_set()
+
+    def signal(self, signum):
+        try:
+            self.proc.send_signal(signum)
+        except (ProcessLookupError, OSError):
+            pass
+
+
+class ReplicaPool(object):
+    """Spawn and supervise ``n`` ``paddle_tpu serve`` workers.
+
+    ``serve_args`` is the extra argv every worker gets (``--max_batch``,
+    ``--extra_model name=dir``, ...); ``env_overrides`` maps replica
+    index -> extra env vars for THAT worker (how the load harness arms
+    a fault spec in exactly one replica). Ports are always ``--port 0``
+    — each worker binds a free one and reports it on the readiness
+    line.
+    """
+
+    def __init__(self, artifact_dir, n, name="default", host="127.0.0.1",
+                 serve_args=None, env=None, env_overrides=None,
+                 restart_budget=None, grace_sec=5.0, ready_timeout=180.0,
+                 budget_reset_s=60.0, python=None):
+        from ..flags import FLAGS
+        if n < 1:
+            raise ValueError("replica count must be >= 1, got %d" % n)
+        self.artifact_dir = artifact_dir
+        self.n = int(n)
+        self.name = name
+        self.host = host
+        self.serve_args = list(serve_args or [])
+        self.env_overrides = dict(env_overrides or {})
+        self.restart_budget = int(
+            restart_budget if restart_budget is not None
+            else FLAGS.route_restart_budget)
+        self.grace_sec = float(grace_sec)
+        self.ready_timeout = float(ready_timeout)
+        self.budget_reset_s = float(budget_reset_s)
+        self.python = python or sys.executable
+        self.base_env = dict(env if env is not None else os.environ)
+        # the workers import paddle_tpu with `python -m`: the repo root
+        # must be importable regardless of the supervisor's own cwd
+        root = _repo_root()
+        pp = self.base_env.get("PYTHONPATH", "")
+        if root not in pp.split(os.pathsep):
+            self.base_env["PYTHONPATH"] = (root + os.pathsep + pp if pp
+                                           else root)
+        self._lock = threading.Lock()
+        self._replicas = [None] * self.n      # index -> Replica
+        self._restarts_used = [0] * self.n
+        self._lost = [False] * self.n
+        self._exits = queue.Queue()           # (index, generation, rc)
+        self._closing = False
+        self._retry = RetryPolicy(max_attempts=self.restart_budget + 1,
+                                  backoff=0.25, multiplier=2.0,
+                                  max_backoff=5.0, jitter=0.1, seed=0,
+                                  name="router.replica_restart")
+        self._monitor = None
+
+    # -- spawning ------------------------------------------------------------
+    def _spawn(self, index, generation):
+        argv = [self.python, "-m", "paddle_tpu", "serve",
+                self.artifact_dir, "--name", self.name,
+                "--host", self.host, "--port", "0"] + self.serve_args
+        env = dict(self.base_env)
+        env.update(self.env_overrides.get(index, {}))
+        proc = subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
+                                text=True)
+        rep = Replica(index, generation, proc, self.host)
+        threading.Thread(target=self._reap, args=(rep,), daemon=True,
+                         name="paddle_tpu-replica-%d-wait" % index).start()
+        return rep
+
+    def _reap(self, rep):
+        self._exits.put((rep.index, rep.generation, rep.proc.wait()))
+
+    def start(self, wait=True):
+        """Spawn the fleet; with ``wait`` (default), block until every
+        replica's readiness line arrives — raising RuntimeError (after
+        stopping the fleet) if any worker dies or times out before
+        becoming ready, with its index named."""
+        from .. import profiler as _prof
+        _prof.update_router_counters(router_replicas=self.n)
+        try:
+            with self._lock:
+                for i in range(self.n):
+                    self._replicas[i] = self._spawn(i, 0)
+        except Exception:
+            # a failed Popen partway through (fork ENOMEM, bad
+            # interpreter) must not orphan the workers already running
+            self.stop()
+            raise
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         daemon=True,
+                                         name="paddle_tpu-pool-monitor")
+        self._monitor.start()
+        if wait:
+            for i, rep in enumerate(list(self._replicas)):
+                if not rep.wait_ready(self.ready_timeout):
+                    rc = rep.proc.poll()
+                    self.stop()
+                    raise RuntimeError(
+                        "replica %d never became ready (%s) — check the "
+                        "worker's stderr above" %
+                        (i, "exit code %s" % rc if rc is not None
+                         else "timeout after %.0fs" % self.ready_timeout))
+        return self
+
+    # -- supervision ---------------------------------------------------------
+    def _monitor_loop(self):
+        """Classify exits: during shutdown they are expected; otherwise
+        restart on the budget, then declare the slot lost. Runs until
+        ``stop()`` flips ``_closing`` and the queue drains."""
+        from .. import profiler as _prof
+        while True:
+            try:
+                index, generation, rc = self._exits.get(timeout=0.2)
+            except queue.Empty:
+                if self._closing:
+                    return
+                continue
+            with self._lock:
+                if self._closing:
+                    continue
+                current = self._replicas[index]
+                if current is None or current.generation != generation:
+                    continue      # stale exit of an already-replaced proc
+                used = self._restarts_used[index]
+                if used >= self.restart_budget:
+                    self._lost[index] = True
+                    record_event("router_replica_lost", site="serving.route",
+                                 replica=index, rc=rc,
+                                 restarts_used=used)
+                    _prof.update_router_counters(router_replica_lost=1)
+                    continue
+                self._restarts_used[index] = used + 1
+            delay = self._retry.delay(used + 1)
+            record_event("router_replica_restart", site="serving.route",
+                         replica=index, rc=rc, attempt=used + 1,
+                         backoff_sec=round(delay, 3))
+            _prof.update_router_counters(router_replica_restarts=1)
+            # the backoff sleeps on its own thread: one replica's
+            # backoff must not delay the monitor's classification (and
+            # respawn) of every OTHER dead replica behind it in the
+            # queue
+            threading.Thread(
+                target=self._respawn_after,
+                args=(index, generation, delay), daemon=True,
+                name="paddle_tpu-replica-%d-respawn" % index).start()
+
+    def _respawn_after(self, index, generation, delay):
+        time.sleep(delay)
+        with self._lock:
+            if self._closing:
+                return
+            rep = self._spawn(index, generation + 1)
+            self._replicas[index] = rep
+        threading.Thread(
+            target=self._maybe_reset_budget, args=(rep,), daemon=True,
+            name="paddle_tpu-replica-%d-budget" % index).start()
+
+    def _maybe_reset_budget(self, rep):
+        """A respawn that stays up ``budget_reset_s`` earns the slot a
+        clean restart record — the budget bounds crash LOOPS, not the
+        lifetime total: a long-running fleet must not march to lost
+        replicas on one recoverable crash a week (the systemd
+        StartLimitIntervalSec / erlang supervisor convention)."""
+        time.sleep(self.budget_reset_s)
+        with self._lock:
+            if (not self._closing and rep.alive
+                    and self._replicas[rep.index] is rep):
+                self._restarts_used[rep.index] = 0
+
+    # -- the router's view ---------------------------------------------------
+    def snapshot(self):
+        """Current replica list (lost slots excluded) — the router polls
+        this; a restarted worker shows up with a bumped generation and a
+        fresh port."""
+        with self._lock:
+            return [r for i, r in enumerate(self._replicas)
+                    if r is not None and not self._lost[i]]
+
+    def describe(self):
+        with self._lock:
+            return {
+                "replicas": self.n,
+                "lost": [i for i, x in enumerate(self._lost) if x],
+                "restarts_used": list(self._restarts_used),
+                "workers": [
+                    {"index": r.index, "generation": r.generation,
+                     "pid": r.pid, "port": r.port, "ready": r.ready}
+                    for r in self._replicas if r is not None],
+            }
+
+    def kill(self, index, signum=signal.SIGKILL):
+        """Send ``signum`` to replica ``index`` (the chaos harness's
+        aim point — a SIGKILL here exercises the restart path)."""
+        with self._lock:
+            rep = self._replicas[index]
+        if rep is not None:
+            rep.signal(signum)
+        return rep.pid if rep is not None else None
+
+    # -- shutdown ------------------------------------------------------------
+    def stop(self):
+        """SIGTERM the fleet (each worker drains and exits 0), escalate
+        to SIGKILL after ``grace_sec``; returns {index: rc}."""
+        with self._lock:
+            self._closing = True
+            reps = [r for r in self._replicas if r is not None]
+        for r in reps:
+            if r.alive:
+                r.signal(signal.SIGTERM)
+        deadline = time.monotonic() + max(self.grace_sec, 0.0)
+        rcs = {}
+        for r in reps:
+            remaining = deadline - time.monotonic()
+            try:
+                rcs[r.index] = r.proc.wait(timeout=max(remaining, 0.0))
+            except subprocess.TimeoutExpired:
+                r.proc.kill()
+                rcs[r.index] = r.proc.wait()
+        if self._monitor is not None and self._monitor.is_alive():
+            self._monitor.join(timeout=5.0)
+        return rcs
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class StaticReplica(object):
+    """A pool entry for an externally-managed worker (tests, or replicas
+    someone else supervises — e.g. k8s pods behind fixed addresses)."""
+
+    __slots__ = ("index", "generation", "host", "port")
+
+    def __init__(self, index, host, port, generation=0):
+        self.index = index
+        self.generation = generation
+        self.host = host
+        self.port = int(port)
+
+    alive = True
+    ready = True
+    pid = None
+
+    @property
+    def base_url(self):
+        return "http://%s:%d" % (self.host, self.port)
+
+
+class StaticPool(object):
+    """Route over a fixed address list instead of supervised
+    subprocesses: ``StaticPool(["127.0.0.1:8500", ...])``. No restarts
+    — a dead address is the router's eject machinery's problem."""
+
+    def __init__(self, addresses):
+        self._replicas = []
+        for i, addr in enumerate(addresses):
+            host, _, port = str(addr).rpartition(":")
+            self._replicas.append(
+                StaticReplica(i, host or "127.0.0.1", int(port)))
+
+    def snapshot(self):
+        return list(self._replicas)
+
+    def describe(self):
+        return {"replicas": len(self._replicas), "lost": [],
+                "workers": [{"index": r.index, "port": r.port,
+                             "generation": r.generation, "ready": True}
+                            for r in self._replicas]}
+
+    def kill(self, index, signum=None):
+        raise RuntimeError("StaticPool does not own its workers")
+
+    def stop(self):
+        return {}

@@ -274,6 +274,51 @@ class InferenceService(object):
         info.update({n: e.describe() for n, e in gens.items()})
         return info
 
+    def readiness(self):
+        """Per-model readiness detail for ``/healthz``: what a router
+        needs to weight and drain on — kind, version, queue depth, and
+        (generative) KV page utilization + draining state. Presence of
+        a model key means "loaded"; ``draining`` True means the engine
+        is handing over to a replacement and new work should go
+        elsewhere."""
+        out = {}
+        for name in self.registry.names():
+            try:
+                entry = self.registry.get(name)
+            except ModelUnavailableError:
+                continue
+            out[name] = {"kind": "compiled", "version": entry.version,
+                         "queued": self._batcher.pending_for(name),
+                         "draining": False}
+        with self._lock:
+            gens = dict(self._generators)
+        for name, e in gens.items():
+            st = e.engine.stats
+            out[name] = {"kind": "generative", "version": e.version,
+                         "queued": st["queued"], "running": st["running"],
+                         "page_utilization": round(
+                             st["page_utilization"]["frac"], 4),
+                         "draining": e.engine.draining}
+        return out
+
+    def retry_after_ms(self, model=None):
+        """Back-off hint for 429/503 answers, derived from the queue-wait
+        the service is CURRENTLY delivering: a client that retries after
+        roughly one p99 queue-wait arrives behind a drained backlog
+        instead of re-feeding the convoy. Floor: one batch-formation
+        window. For a generative ``model``, the inter-token p50 times
+        the queued depth estimates the engine's drain time and takes
+        the max. Clamped to [1 ms, 30 s]."""
+        with self._lock:
+            qw = list(self._queue_wait_ms)
+            gen = self._generators.get(model) if model else None
+        est = max(self.batch_timeout_ms, _percentile(qw, 0.99))
+        if gen is not None:
+            st = gen.engine.stats
+            est = max(est,
+                      st["intertoken_ms_p50"] * (st["queued"] + 1))
+        return min(max(est, 1.0), 30000.0)
+
     # -- request path --------------------------------------------------------
     def infer_async(self, name, feed, deadline_ms=None):
         """Enqueue one request; returns its :class:`Request` handle
@@ -419,6 +464,7 @@ class InferenceService(object):
                 "shed_overload": c.get("shed_overload", 0),
                 "shed_deadline": c.get("shed_deadline", 0),
                 "pending": self._batcher.pending(),
+                "max_batch": self.max_batch,
                 "batches": batches,
                 "batch_occupancy": (self._occupancy_sum / batches
                                     if batches else 0.0),

@@ -1,0 +1,112 @@
+"""Router smoke gate (CPU CI): a shrunk benchmark/load_bench.py run —
+2 supervised ``serve`` replicas behind the least-loaded router under an
+interleaved predict+generate flood must survive (a) one replica
+SIGKILLed mid-flood and (b) one rolling hot reload mid-flood with ZERO
+lost accepted requests (every request ends in a 2xx or an orderly
+Retry-After shed — never a connection error or 5xx), exactly one
+recorded ``router_replica_restart`` event, and (c) a failed-artifact
+reload rolled back with the fleet serving intact. The router's p99 must
+come back finite, and completed predict payloads must match the known
+closed form of whichever artifact version legitimately answered.
+
+The measurement lives in benchmark/load_bench.py — ONE implementation
+shared by this gate and the banked evidence record, so the criteria
+cannot drift. Invoked by tools/router_smoke.sh (one retry damps
+shared-CI scheduler noise). Exit 0 on pass, 1 on failure; prints a
+one-line JSON summary either way.
+
+    JAX_PLATFORMS=cpu python tools/router_smoke.py
+"""
+import json
+import math
+import os
+import shutil
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPLICAS = 2
+PREDICT = 80
+GENERATE = 10
+THREADS = 6
+
+
+def main():
+    from benchmark.load_bench import bench
+
+    root = tempfile.mkdtemp(prefix="paddle_tpu_router_smoke_")
+    try:
+        s = bench(root, replicas=REPLICAS, n_predict=PREDICT,
+                  n_generate=GENERATE, threads=THREADS,
+                  balance=False)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    flood = s["flood"]
+    failures = []
+    if flood["lost"] != 0:
+        failures.append("lost accepted requests: %d (%r)"
+                        % (flood["lost"], flood["lost_detail"]))
+    if flood["completed"] != flood["tasks"]:
+        failures.append(
+            "flood did not complete every request: %d/%d (sheds must "
+            "resolve by client retry on Retry-After)"
+            % (flood["completed"], flood["tasks"]))
+    if flood["bad_payloads"]:
+        failures.append("%d completed responses failed the closed-form "
+                        "check" % flood["bad_payloads"])
+    if s["restart_events"] != 1:
+        failures.append("expected exactly one router_replica_restart "
+                        "event, got %d" % s["restart_events"])
+    if not s["fleet_ready_after_kill"]:
+        failures.append("killed replica never came back ready")
+    if s["reload_status"] != 200 or not s["reload_all_v2"]:
+        failures.append("rolling reload did not land v2 fleet-wide: "
+                        "status=%s dirnames=%r"
+                        % (s["reload_status"],
+                           s["post_reload_dirnames"]))
+    if s.get("bad_reload_status") == 200:
+        failures.append("bad-artifact reload reported success")
+    if not s.get("fleet_intact_after_bad_reload"):
+        failures.append("fleet not intact after bad-artifact reload: %r"
+                        % s.get("bad_reload_dirnames"))
+    if s.get("reload_rollback_events", 0) < 1:
+        failures.append("failed reload left no reload_rollback event")
+    probe = s.get("post_bad_reload_probe", {})
+    if probe.get("completed") != probe.get("tasks"):
+        failures.append("fleet stopped answering after the bad reload: "
+                        "%r" % probe)
+    p99 = flood["latency_ms_p99"]
+    if not (p99 > 0 and math.isfinite(p99)):
+        failures.append("router p99 not finite: %r" % p99)
+
+    summary = {
+        "ok": not failures,
+        "replicas": REPLICAS,
+        "tasks": flood["tasks"],
+        "completed": flood["completed"],
+        "lost": flood["lost"],
+        "client_retries": flood["client_retries"],
+        "p50_ms": flood["latency_ms_p50"],
+        "p99_ms": flood["latency_ms_p99"],
+        "restart_events": s["restart_events"],
+        "restart_ready_s": s["restart_ready_s"],
+        "reload_status": s["reload_status"],
+        "reload_all_v2": s["reload_all_v2"],
+        "bad_reload_status": s.get("bad_reload_status"),
+        "fleet_intact_after_bad_reload":
+            s.get("fleet_intact_after_bad_reload"),
+        "per_replica_completed": flood["per_replica_completed"],
+    }
+    print(json.dumps(summary))
+    if failures:
+        for f in failures:
+            print("router_smoke FAIL: %s" % f, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

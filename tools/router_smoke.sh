@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Router smoke gate: a 2-replica `serve` fleet behind the least-loaded
+# router must survive one replica SIGKILL and one rolling hot reload
+# under an interleaved predict+generate flood with zero lost accepted
+# requests, exactly one router_replica_restart event, and a
+# failed-artifact reload rolled back fleet-wide intact — CPU tier,
+# real subprocesses and sockets (this gate is ABOUT the process
+# boundary). Companion to tools/serve_smoke.sh (single-process tier)
+# and tools/gen_smoke.sh (generation engine). One retry damps shared-CI
+# scheduler noise before calling a timing-dependent loss real.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+python tools/router_smoke.py "$@" && exit 0
+echo "router_smoke: first attempt failed; retrying once" >&2
+exec python tools/router_smoke.py "$@"
