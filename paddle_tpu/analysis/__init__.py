@@ -10,10 +10,20 @@ Entry points:
 - ``verify(program, rules=None, strict=False, fetches=None)`` — run rules,
   return diagnostics; ``strict`` raises ``ProgramVerifyError`` on errors.
 - ``paddle_tpu lint <config.py>`` — CLI wrapper (rendered report, exit 1
-  on errors, ``--dot`` graph with failing ops highlighted).
-- ``PADDLE_TPU_VERIFY=1`` / ``FLAGS.verify`` — executor pre-trace hook.
-- ``check_after_pass`` — self-check run by memory_optimize and the
-  parallel sharding transpiler after they touch a program.
+  on errors, ``--dot`` graph with failing ops highlighted, ``--comm``
+  for the collective-consistency pass).
+- ``PADDLE_TPU_VERIFY=1`` / ``FLAGS.verify`` — executor pre-trace hook
+  (plus the collective-consistency pass when the explicit-comm path is
+  taken).
+- ``check_after_pass`` — self-check run by memory_optimize, the parallel
+  sharding transpiler, and ``core.backward.append_backward`` after they
+  touch a program.
+
+Distributed-correctness companions (this package, beyond the Program
+walk): :mod:`.comm_rules` (PT020-PT023 collective consistency),
+:mod:`.sanitize` (donation-aliasing sanitizer,
+``PADDLE_TPU_SANITIZE=alias``), :mod:`.locks` (lock-order race
+detector, ``PADDLE_TPU_SANITIZE=locks``).
 """
 from .diagnostics import (  # noqa: F401
     Diagnostic, ProgramVerifyError, Severity, render_diagnostics,
@@ -23,10 +33,16 @@ from .runner import (  # noqa: F401
     registered_rules, resolve_rules, verify, verify_or_raise,
 )
 from . import rules  # noqa: F401  (registers the built-in PT rules)
+from .rules import mark_pipeline_stages  # noqa: F401
+from . import comm_rules  # noqa: F401
+from .sanitize import SanitizeError, sanitize_modes  # noqa: F401
+from . import sanitize  # noqa: F401
+from . import locks  # noqa: F401
 
 __all__ = [
     "Diagnostic", "ProgramVerifyError", "Severity", "render_diagnostics",
     "Rule", "ProgramFacts", "STRUCTURAL_CODES", "check_after_pass",
     "register_rule", "registered_rules", "resolve_rules", "verify",
-    "verify_or_raise", "rules",
+    "verify_or_raise", "rules", "mark_pipeline_stages", "comm_rules",
+    "SanitizeError", "sanitize_modes", "sanitize", "locks",
 ]

@@ -1,0 +1,393 @@
+"""Collective-consistency pass (PT020-PT023): verify a replica's ordered
+collective sequence is a pure function of (world, policy).
+
+Collective programs here are built **per replica**: each process derives
+its own BucketPlan from its local grads template, resolves its own
+CommPolicy from flags, and issues the bucket collectives in a schedule
+order. Collectives rendezvous by program order — if two replicas
+disagree on the bucket set, the issue order, or the
+``axis_index_groups`` factorisation, the pod deadlocks (or silently
+sums mismatched operands), and nothing on single-process CPU CI can
+observe it. This pass checks the things that must therefore be provable
+*statically*:
+
+- **PT020 — order divergence**: the ordered collective sequence must be
+  exactly the canonical function of (grads template, policy, axis size,
+  overlap flag): buckets in plan order (backward-finalisation order
+  under overlap), same dtype/element-count/path decisions per entry. A
+  declared schedule that permutes it, a rebuild that differs (the
+  sequence depended on something replica-local, e.g. dict insertion
+  order), or a peer fingerprint that mismatches all land here.
+- **PT021 — bucket-plan / param-set mismatch**: the plan must cover the
+  grads template exactly — every leaf in exactly one bucket, sizes and
+  dtypes agreeing. A plan built for a different parameter set (a stale
+  plan surviving a model edit or an elastic resize) lands here.
+- **PT022 — axis-group factorisation**: ``hosts`` must divide the axis,
+  and ``topology_groups(hosts, chips)`` must partition the axis index
+  space (each index in exactly one intra-host group; ring pairs in
+  range, one per index). A wrong ``comm_hosts`` after a resize re-plan
+  — which today only fails on the real fabric — lands here.
+- **PT023 — overlap schedule vs gradient finalisation**: the overlap
+  issue order may only reference real buckets, each exactly once, and
+  must not issue a bucket before one whose gradients finalise earlier
+  (reverse autodiff finalises last-declared leaves first, so bucket
+  readiness is ordered by min leaf id, descending). A schedule edit
+  that issues a bucket whose grads are not yet finalised at its slot
+  lands here.
+
+Entry points: ``verify_comm`` (the full pass over one replica's
+inputs), ``paddle_tpu lint --comm`` (CLI), the Executor's explicit-comm
+path under ``PADDLE_TPU_VERIFY``, and ``elastic.replan`` (topology leg,
+after every resize). ``schedule_fingerprint`` is the cross-replica
+currency: equal fingerprints == equal collective programs.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .diagnostics import Diagnostic, ProgramVerifyError, Severity
+
+__all__ = ["grads_template_from_program", "collective_sequence",
+           "schedule_fingerprint", "check_bucket_plan", "check_topology",
+           "check_overlap_schedule", "check_replica_fingerprints",
+           "verify_comm", "verify_comm_or_raise"]
+
+COMM_CODES = ("PT020", "PT021", "PT022", "PT023")
+
+
+def _diag(code, message, var=None, hint=None, severity=Severity.ERROR):
+    return Diagnostic(code, severity, message, var=var, hint=hint)
+
+
+def grads_template_from_program(program) -> Dict[str, Any]:
+    """The grads template a DP step of ``program`` would sync: one
+    ``ShapeDtypeStruct`` per trainable parameter with a known shape,
+    keyed ``<param>@GRAD`` (the explicit-comm path's grad set). Pure
+    host-side metadata — nothing is traced."""
+    import jax
+    from ..core import ir
+    out = {}
+    for p in program.all_parameters():
+        if not getattr(p, "trainable", True) or p.shape is None:
+            continue
+        shape = tuple(int(s) for s in p.shape)
+        if any(s < 0 for s in shape):
+            continue  # batch-dependent parameter shape: not static
+        out[p.name + ir.GRAD_SUFFIX] = jax.ShapeDtypeStruct(
+            shape, np.dtype(p.dtype or "float32"))
+    return out
+
+
+def _build_plan(template, policy, axis_size):
+    from ..comm.bucket import build_plan
+    chips = (policy.chips(axis_size)
+             if policy.base in ("hierarchical", "multipath") else 1)
+    return build_plan(template, policy.bucket_bytes,
+                      pad_multiple=max(chips, 1))
+
+
+def collective_sequence(plan, policy, axis_size,
+                        overlap: bool = False,
+                        schedule: Optional[Sequence[int]] = None
+                        ) -> List[Tuple]:
+    """The ordered collective sequence this (plan, policy, world) flies:
+    one tuple per bucket, in issue order, carrying everything a peer
+    must agree on for the collectives to rendezvous — bucket id, dtype,
+    padded element count, quantisation decision, multipath split point.
+    ``schedule`` overrides the issue order (the declared order under
+    test); default is the canonical one."""
+    from ..comm.policy import quant_inert_for
+    if schedule is None:
+        schedule = (plan.backward_schedule() if overlap
+                    else list(range(plan.num_buckets)))
+    chips = (policy.chips(axis_size)
+             if policy.base in ("hierarchical", "multipath") else 1)
+    seq = []
+    for bi in schedule:
+        if not (0 <= bi < plan.num_buckets):
+            seq.append(("invalid-bucket", int(bi)))
+            continue
+        b = plan.buckets[bi]
+        elems = b.numel + b.pad
+        nbytes = b.numel * np.dtype(b.dtype).itemsize
+        split = (policy.split_elems(elems, nbytes, chips)
+                 if policy.base == "multipath" else elems)
+        seq.append(("bucket", int(bi), str(np.dtype(b.dtype)), int(elems),
+                    policy.base, policy.quant,
+                    not quant_inert_for(policy, b.dtype), int(split)))
+    return seq
+
+
+def schedule_fingerprint(plan, policy, axis_size, overlap: bool = False,
+                         schedule: Optional[Sequence[int]] = None) -> str:
+    """Digest of the full collective program: the ordered sequence plus
+    the (world, policy) inputs and the topology groups. Two replicas
+    whose fingerprints match will issue the same collectives in the
+    same order over the same axis groups."""
+    from ..comm.hierarchical import topology_groups
+    seq = collective_sequence(plan, policy, axis_size, overlap=overlap,
+                              schedule=schedule)
+    hosts = policy.hosts if policy.base in ("hierarchical", "multipath") \
+        else 1
+    groups = (topology_groups(hosts, axis_size // hosts)
+              if hosts >= 1 and axis_size % hosts == 0 else None)
+    blob = repr((int(axis_size), policy.key(), bool(overlap), seq, groups))
+    return hashlib.sha1(blob.encode("utf-8")).hexdigest()
+
+
+def check_bucket_plan(plan, template) -> List[Diagnostic]:
+    """PT021: the plan must cover the grads template exactly."""
+    import jax
+    diags = []
+    leaves = jax.tree_util.tree_leaves(template)
+    if plan.n_leaves != len(leaves):
+        diags.append(_diag(
+            "PT021", "bucket plan was built for %d grad leaves but the "
+            "program's parameter set has %d" % (plan.n_leaves, len(leaves)),
+            hint="rebuild the plan from THIS program's grads (stale plans "
+                 "do not survive model edits or elastic resizes)"))
+        return diags
+    seen: Dict[int, int] = {}
+    for bi, b in enumerate(plan.buckets):
+        for leaf_id, shape, size in zip(b.leaf_ids, b.shapes, b.sizes):
+            if not (0 <= leaf_id < len(leaves)):
+                diags.append(_diag(
+                    "PT021", "bucket %d references leaf %d outside the "
+                    "template's %d leaves" % (bi, leaf_id, len(leaves))))
+                continue
+            if leaf_id in seen:
+                diags.append(_diag(
+                    "PT021", "leaf %d appears in buckets %d and %d — a "
+                    "grad would be synced twice" % (leaf_id, seen[leaf_id],
+                                                    bi)))
+            seen[leaf_id] = bi
+            leaf = leaves[leaf_id]
+            lsize = int(np.prod(np.shape(leaf))) if np.shape(leaf) else 1
+            if lsize != size or tuple(np.shape(leaf)) != tuple(shape):
+                diags.append(_diag(
+                    "PT021", "bucket %d records leaf %d as shape %s "
+                    "(%d elems) but the template leaf is %s (%d elems)"
+                    % (bi, leaf_id, tuple(shape), size,
+                       tuple(np.shape(leaf)), lsize)))
+    missing = sorted(set(range(len(leaves))) - set(seen))
+    if missing:
+        diags.append(_diag(
+            "PT021", "grad leaves %s are in no bucket — their gradients "
+            "would never sync" % (missing[:8],),
+            hint="rebuild the plan from the full grads template"))
+    return diags
+
+
+def check_topology(policy, axis_size) -> List[Diagnostic]:
+    """PT022: (hosts, chips) factorisation + axis_index_groups sanity."""
+    from ..comm.hierarchical import topology_groups
+    diags = []
+    n = int(axis_size)
+    if policy.base not in ("hierarchical", "multipath"):
+        return diags
+    hosts = int(policy.hosts)
+    if hosts < 1:
+        diags.append(_diag("PT022", "comm_hosts=%d is not a host count"
+                           % hosts))
+        return diags
+    if n % hosts:
+        diags.append(_diag(
+            "PT022", "comm_hosts=%d does not divide the data axis "
+            "(%d replicas): the (host, chip) factorisation cannot hold "
+            "and per-replica axis_index_groups would disagree"
+            % (hosts, n),
+            hint="re-plan hosts for the new world (elastic.replan owns "
+                 "this after a resize) or fix FLAGS.comm_hosts"))
+        return diags
+    chips = n // hosts
+    intra, ring = topology_groups(hosts, chips)
+    flat = [i for g in intra for i in g]
+    if sorted(flat) != list(range(n)) or \
+            any(len(g) != chips for g in intra):
+        diags.append(_diag(
+            "PT022", "intra-host groups do not partition the axis "
+            "index space [0, %d) into %d groups of %d" % (n, hosts,
+                                                          chips)))
+    srcs = [a for a, _ in ring]
+    if sorted(srcs) != list(range(n)) or \
+            any(not (0 <= b < n) for _, b in ring):
+        diags.append(_diag(
+            "PT022", "inter-host ring pairs are not a permutation of "
+            "the axis index space [0, %d)" % n))
+    return diags
+
+
+def check_overlap_schedule(plan, schedule=None) -> List[Diagnostic]:
+    """PT023: the overlap issue order vs gradient finalisation.
+
+    Readiness model: reverse autodiff finalises the LAST-declared
+    leaves' grads first, so bucket b is complete only once its SMALLEST
+    leaf id finalises. An issue order that schedules bucket X before
+    bucket Y — where the canonical order has Y first and Y's grads
+    finalise before X's — claims to issue X at a point in the backward
+    chain where its grads do not exist yet."""
+    diags = []
+    schedule = list(plan.backward_schedule() if schedule is None
+                    else schedule)
+    nb = plan.num_buckets
+    seen = set()
+    for bi in schedule:
+        if not (0 <= bi < nb):
+            diags.append(_diag(
+                "PT023", "overlap schedule references bucket %d of a "
+                "%d-bucket plan" % (bi, nb)))
+        elif bi in seen:
+            diags.append(_diag(
+                "PT023", "overlap schedule issues bucket %d twice"
+                % bi))
+        seen.add(bi)
+    missing = sorted(set(range(nb)) - seen)
+    if missing:
+        diags.append(_diag(
+            "PT023", "overlap schedule never issues bucket(s) %s — "
+            "their grads would never sync" % (missing[:8],)))
+    if diags:
+        return diags
+    canonical = plan.backward_schedule()
+    canon_pos = {bi: p for p, bi in enumerate(canonical)}
+    ready = {bi: min(plan.buckets[bi].leaf_ids) for bi in range(nb)}
+    for p, x in enumerate(schedule):
+        for y in schedule[p + 1:]:
+            # x issued before y, canonically y first, and y's grads
+            # finalise strictly before x's (higher min leaf id)
+            if canon_pos[y] < canon_pos[x] and ready[y] > ready[x]:
+                diags.append(_diag(
+                    "PT023", "overlap schedule issues bucket %d before "
+                    "bucket %d, but bucket %d's grads finalise only "
+                    "after bucket %d's in the backward chain (min leaf "
+                    "%d vs %d) — at its issue slot its grads do not "
+                    "exist yet" % (x, y, x, y, ready[x], ready[y]),
+                    hint="issue buckets in BucketPlan.backward_schedule "
+                         "order"))
+                break  # one finding per misplaced bucket is enough
+    return diags
+
+
+def check_replica_fingerprints(fingerprints) -> List[Diagnostic]:
+    """PT020 (cross-replica leg): ``fingerprints`` maps replica rank ->
+    :func:`schedule_fingerprint`; any disagreement is an order
+    divergence that deadlocks the pod at the first mismatched
+    rendezvous."""
+    if not isinstance(fingerprints, dict):
+        fingerprints = dict(enumerate(fingerprints))
+    by_fp: Dict[str, List] = {}
+    for rank, fp in fingerprints.items():
+        by_fp.setdefault(fp, []).append(rank)
+    if len(by_fp) <= 1:
+        return []
+    groups = sorted((sorted(map(str, ranks)) for ranks in by_fp.values()),
+                    key=len, reverse=True)
+    return [_diag(
+        "PT020", "replicas disagree on the collective program: ranks %s "
+        "vs %s would issue different bucket sequences and deadlock at "
+        "the first mismatched rendezvous"
+        % (", ".join(groups[0]), " / ".join(",".join(g)
+                                            for g in groups[1:])),
+        hint="the sequence must be a pure function of (world, policy): "
+             "check for replica-local inputs (dict order, local device "
+             "counts, stale comm flags) leaking into the plan")]
+
+
+def verify_comm(template, policy=None, axis_size=None, overlap=None,
+                schedule=None, expect_fingerprint=None
+                ) -> Tuple[List[Diagnostic], Optional[str]]:
+    """Run the full collective-consistency pass over ONE replica's
+    inputs: the grads ``template`` (pytree of arrays or
+    ShapeDtypeStructs, e.g. :func:`grads_template_from_program`), the
+    resolved ``policy`` (None = resolve from flags), and the data-axis
+    size. Returns ``(diagnostics, fingerprint)``; the fingerprint is
+    None when no plan could be built.
+
+    ``schedule`` is a declared issue order to validate (PT020/PT023);
+    ``expect_fingerprint`` is a peer replica's fingerprint (PT020).
+    ``overlap=None`` resolves from ``FLAGS.comm_overlap``.
+    """
+    from .. import comm
+    if axis_size is None:
+        import jax
+        axis_size = len(jax.devices())
+    axis_size = int(axis_size)
+    if policy is None:
+        policy = comm.resolve_policy(axis_size=axis_size)
+    if overlap is None:
+        overlap = comm.overlap_enabled(None)
+    diags = list(check_topology(policy, axis_size))
+    if policy.is_noop or axis_size <= 1:
+        # per-leaf pmean path: the sequence is the leaf order itself
+        import jax
+        import jax.numpy as jnp
+        leaves = jax.tree_util.tree_leaves(template)
+        blob = repr((axis_size, policy.key(),
+                     [(str(np.dtype(jnp.result_type(l))),
+                       tuple(np.shape(l))) for l in leaves]))
+        fp = hashlib.sha1(blob.encode("utf-8")).hexdigest()
+        if expect_fingerprint is not None and expect_fingerprint != fp:
+            diags += check_replica_fingerprints(
+                {"self": fp, "peer": expect_fingerprint})
+        return diags, fp
+    try:
+        plan = _build_plan(template, policy, axis_size)
+    except Exception as e:
+        diags.append(_diag(
+            "PT021", "bucket plan failed to build for this grads "
+            "template under %r: %s: %s" % (policy, type(e).__name__, e)))
+        return diags, None
+    diags += check_bucket_plan(plan, template)
+    if overlap or schedule is not None:
+        diags += check_overlap_schedule(plan, schedule=schedule)
+    canonical = (plan.backward_schedule() if overlap
+                 else list(range(plan.num_buckets)))
+    if schedule is not None and list(schedule) != canonical and \
+            sorted(schedule) == sorted(canonical):
+        diags.append(_diag(
+            "PT020", "declared issue order %s diverges from the "
+            "canonical order %s for (world=%d, %r, overlap=%s) — the "
+            "sequence is not a pure function of (world, policy), so "
+            "another replica computing the canonical order would "
+            "rendezvous a different collective"
+            % (list(schedule)[:12], canonical[:12], axis_size, policy,
+               bool(overlap)),
+            hint="derive the issue order from BucketPlan (declaration "
+                 "order, or backward_schedule under overlap); never "
+                 "permute it locally"))
+    fp = schedule_fingerprint(plan, policy, axis_size, overlap=overlap)
+    # determinism leg: a second build from the same inputs must produce
+    # the same sequence — if it does not, something replica-local (and
+    # run-local) leaked into the plan
+    try:
+        plan2 = _build_plan(template, policy, axis_size)
+        fp2 = schedule_fingerprint(plan2, policy, axis_size,
+                                   overlap=overlap)
+    except Exception:
+        fp2 = None
+    if fp2 is not None and fp2 != fp:
+        diags.append(_diag(
+            "PT020", "two plan builds from the SAME (grads, policy, "
+            "world) produced different collective sequences — the "
+            "schedule depends on replica-local state and will diverge "
+            "across the pod"))
+    if expect_fingerprint is not None and expect_fingerprint != fp:
+        diags += check_replica_fingerprints(
+            {"self": fp, "peer": expect_fingerprint})
+    return diags, fp
+
+
+def verify_comm_or_raise(template, policy=None, axis_size=None,
+                         overlap=None, schedule=None,
+                         expect_fingerprint=None, context=None) -> str:
+    """``verify_comm`` raising one readable :class:`ProgramVerifyError`
+    on any error diagnostic; returns the fingerprint otherwise."""
+    diags, fp = verify_comm(template, policy=policy, axis_size=axis_size,
+                            overlap=overlap, schedule=schedule,
+                            expect_fingerprint=expect_fingerprint)
+    if any(d.is_error for d in diags):
+        raise ProgramVerifyError(diags, context=context)
+    return fp

@@ -45,14 +45,19 @@ class Diagnostic(object):
         return self.severity == Severity.ERROR
 
     def location(self) -> str:
+        """``file:line``-style ref: ``block0:op3`` (+ the var), the one
+        format every rule's findings print — tooling greps it, and the
+        lint report stays column-stable across rule families."""
         parts = []
-        if self.block_idx is not None:
-            parts.append("block %d" % self.block_idx)
-        if self.op_idx is not None:
-            parts.append("op %d" % self.op_idx)
+        if self.block_idx is not None and self.op_idx is not None:
+            parts.append("block%d:op%d" % (self.block_idx, self.op_idx))
+        elif self.block_idx is not None:
+            parts.append("block%d" % self.block_idx)
+        elif self.op_idx is not None:
+            parts.append("op%d" % self.op_idx)
         if self.var:
             parts.append("var %r" % self.var)
-        return ", ".join(parts)
+        return " ".join(parts)
 
     def __str__(self):
         loc = self.location()

@@ -1,0 +1,352 @@
+"""Lock-order race detector (``PADDLE_TPU_SANITIZE=locks``).
+
+The concurrent subsystems (serving batcher/engine/registry/service,
+the replica pool and router, the paged KV allocator) each own a lock or
+condition; a deadlock needs only two of them acquired in opposite
+orders on two threads — a bug CPU CI can *order-check* even when it
+never wins the race. This module is the lockdep-style answer:
+
+- every shared lock in those subsystems is built through
+  :func:`make_lock` / :func:`make_rlock` / :func:`make_condition`
+  (the "shared lock constructor") with a stable dotted name;
+- when the ``locks`` sanitize mode is active (env/flag, or
+  :func:`enable` — the threaded test fixtures use the latter), the
+  constructor returns an instrumented wrapper that records the
+  **acquisition-order graph**: holding A while acquiring B adds the
+  edge A -> B. Lock *names* are the graph nodes (lockdep's lock-class
+  idea), so two orders observed on different objects of the same class
+  still collide;
+- :func:`report` returns the cycles in that graph (each one a
+  potential deadlock: some interleaving of the observed acquisitions
+  blocks forever) and the **held-across-join hazards** — a thread that
+  called ``Thread.join`` while holding an instrumented lock that the
+  joined thread is KNOWN (in this run) to take: the join deadlocks the
+  moment the joined thread blocks on that lock. Holding a lock the
+  joined thread never touches is deliberately NOT flagged (the serving
+  tier holds its reload lock across an engine-thread join by design —
+  the engine thread never takes it);
+- with the mode set via env, an ``atexit`` hook prints a non-empty
+  report to stderr, so ``PADDLE_TPU_SANITIZE=locks python train.py``
+  needs no harness.
+
+Honest limits, stated plainly: CPU CI cannot observe a real deadlock —
+only the order inversion that permits one. A cycle is a *potential*
+deadlock (the classic false-positive being orders that are mutually
+exclusive by construction); an empty report only covers the
+interleavings the run actually executed. Overhead when the mode is off
+is zero: the constructors return plain ``threading`` primitives.
+"""
+from __future__ import annotations
+
+import atexit
+import sys
+import threading
+import weakref
+from typing import Dict, List, Tuple
+
+__all__ = ["make_lock", "make_rlock", "make_condition", "enable",
+           "disable", "enabled", "reset", "report", "tracing",
+           "held_locks"]
+
+_state_lock = threading.Lock()   # guards the graph/hazard records (raw:
+#   never held while taking an instrumented lock, so it cannot deadlock)
+_enabled = False
+_edges: Dict[Tuple[str, str], dict] = {}   # (a, b) -> first-observation
+_join_hazards: List[dict] = []
+# Thread object -> lock names it has taken. Keyed by the OBJECT (weakly,
+# so dead threads drop out), not the ident: CPython recycles idents, and
+# a recycled ident would inherit a dead thread's lock set and produce
+# phantom held-across-join hazards.
+_thread_locks = weakref.WeakKeyDictionary()
+_tls = threading.local()
+_orig_join = None
+_atexit_registered = False
+
+
+def _held() -> list:
+    st = getattr(_tls, "held", None)
+    if st is None:
+        st = _tls.held = []
+    return st
+
+
+def held_locks() -> List[str]:
+    """Names of instrumented locks the CURRENT thread holds, outermost
+    first (recursive re-acquisitions appear once)."""
+    return [l.name for l in _held()]
+
+
+def enabled() -> bool:
+    if _enabled:
+        return True
+    from .sanitize import locks_enabled
+    # a typo'd PADDLE_TPU_SANITIZE must raise here, not silently run
+    # with plain locks while the operator believes the detector is on —
+    # same contract as sanitize.modes()
+    return locks_enabled()
+
+
+def _record_acquire(lock):
+    held = _held()
+    t = threading.current_thread()
+    with _state_lock:
+        _thread_locks.setdefault(t, set()).add(lock.name)
+        for h in held:
+            if h.name != lock.name:
+                _edges.setdefault((h.name, lock.name),
+                                  {"thread": t.name})
+    held.append(lock)
+
+
+def _record_release(lock):
+    held = _held()
+    # release order need not mirror acquire order; drop the newest entry
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] is lock:
+            del held[i]
+            return
+
+
+class _TracedLock(object):
+    """Instrumented ``threading.Lock``: records acquisition-order edges.
+    Duck-types everything ``threading.Condition`` needs from its inner
+    lock (acquire/release/context manager), so conditions built over it
+    are instrumented too."""
+
+    _reentrant = False
+
+    def __init__(self, name):
+        self.name = name
+        self._inner = (threading.RLock() if self._reentrant
+                       else threading.Lock())
+
+    def _depths(self):
+        d = getattr(_tls, "depths", None)
+        if d is None:
+            d = _tls.depths = {}
+        return d
+
+    def acquire(self, blocking=True, timeout=-1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            if self._reentrant:
+                d = self._depths()
+                d[id(self)] = d.get(id(self), 0) + 1
+                if d[id(self)] > 1:
+                    return got  # re-entry: no new edge, held entry exists
+            _record_acquire(self)
+        return got
+
+    def release(self):
+        if self._reentrant:
+            d = self._depths()
+            depth = d.get(id(self), 1) - 1
+            if depth > 0:
+                d[id(self)] = depth
+                self._inner.release()
+                return
+            d.pop(id(self), None)
+        _record_release(self)
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked() if hasattr(self._inner, "locked") \
+            else False
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return "<TracedLock %s of %r>" % (self.name, self._inner)
+
+
+class _TracedRLock(_TracedLock):
+    _reentrant = True
+
+    # Condition built over an RLock uses these to drop ALL recursion
+    # levels around wait() (stock threading semantics); without them
+    # Condition's fallback releases ONE level and a wait() inside a
+    # re-entered condition would deadlock
+    def _release_save(self):
+        depth = self._depths().pop(id(self), 1)
+        _record_release(self)
+        return (self._inner._release_save(), depth)
+
+    def _acquire_restore(self, saved):
+        state, depth = saved
+        self._inner._acquire_restore(state)
+        self._depths()[id(self)] = depth
+        _record_acquire(self)
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+
+def _patched_join(self, timeout=None):
+    held = held_locks()
+    if held and self is not threading.current_thread():
+        with _state_lock:
+            # a hazard only when the JOINED thread is known to take one
+            # of the held locks: that is the pair that deadlocks the
+            # moment the joined thread blocks on it mid-exit
+            wanted = _thread_locks.get(self, set())
+            overlap = sorted(set(held) & wanted)
+            if overlap:
+                _join_hazards.append({
+                    "thread": threading.current_thread().name,
+                    "joined": self.name,
+                    "held": held,
+                    "contended": overlap,
+                })
+    return _orig_join(self, timeout)
+
+
+def _install_join_patch():
+    global _orig_join
+    if _orig_join is None:
+        _orig_join = threading.Thread.join
+        threading.Thread.join = _patched_join
+
+
+def _remove_join_patch():
+    global _orig_join
+    if _orig_join is not None:
+        threading.Thread.join = _orig_join
+        _orig_join = None
+
+
+def make_lock(name: str):
+    """The shared lock constructor: a plain ``threading.Lock`` normally,
+    an instrumented one under the ``locks`` sanitize mode. ``name`` is
+    the lock-class node in the order graph — use a stable dotted path
+    (e.g. ``"serving.router.state"``), shared by every instance of the
+    same lock role."""
+    if not enabled():
+        return threading.Lock()
+    _ensure_active()
+    return _TracedLock(name)
+
+
+def make_rlock(name: str):
+    if not enabled():
+        return threading.RLock()
+    _ensure_active()
+    return _TracedRLock(name)
+
+
+def make_condition(name: str):
+    """A ``threading.Condition`` whose mutex's acquisition order is
+    recorded like any other lock. The mutex is REENTRANT — stock
+    ``threading.Condition()`` defaults to an RLock, and callers (e.g.
+    the generation engine's admit loop) legitimately re-enter it — so
+    the instrumented form must not tighten the semantics."""
+    return threading.Condition(make_rlock(name))
+
+
+def _ensure_active():
+    """First traced-lock construction under env-driven mode installs the
+    join patch + the atexit report."""
+    global _atexit_registered
+    _install_join_patch()
+    if not _atexit_registered:
+        _atexit_registered = True
+        atexit.register(_atexit_report)
+
+
+def _atexit_report():
+    rep = report()
+    if rep["cycles"] or rep["join_hazards"]:
+        print("PADDLE_TPU_SANITIZE=locks report:", file=sys.stderr)
+        for c in rep["cycles"]:
+            print("  potential deadlock: lock-order cycle %s"
+                  % " -> ".join(c + [c[0]]), file=sys.stderr)
+        for h in rep["join_hazards"]:
+            print("  held-across-join: thread %r joined %r while "
+                  "holding %s" % (h["thread"], h["joined"],
+                                  ", ".join(h["held"])), file=sys.stderr)
+
+
+def enable():
+    """Turn tracing on programmatically (the test-fixture path; the env
+    var needs no call). Locks built BEFORE this stay uninstrumented."""
+    global _enabled
+    _enabled = True
+    _install_join_patch()
+
+
+def disable():
+    global _enabled
+    _enabled = False
+    _remove_join_patch()
+
+
+def reset():
+    """Clear the recorded graph and hazard list (between tests)."""
+    with _state_lock:
+        _edges.clear()
+        _thread_locks.clear()
+        del _join_hazards[:]
+
+
+def _find_cycles(adj: Dict[str, set]) -> List[List[str]]:
+    """Simple cycles in the order graph, deduplicated by node set —
+    enough to NAME the locks involved; the edge examples carry who."""
+    cycles, seen_sets = [], set()
+    # iterate over sorted nodes so reports are deterministic
+    for start in sorted(adj):
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(adj.get(node, ())):
+                if nxt == start and len(path) > 1:
+                    key = frozenset(path)
+                    if key not in seen_sets:
+                        seen_sets.add(key)
+                        cycles.append(list(path))
+                elif nxt not in path and len(path) < 16:
+                    stack.append((nxt, path + [nxt]))
+    return cycles
+
+
+def report() -> dict:
+    """The detector's findings so far: ``cycles`` (each a list of lock
+    names forming an order cycle — a potential deadlock),
+    ``join_hazards``, the observed edge list, and counts."""
+    with _state_lock:
+        edges = {e: dict(meta) for e, meta in _edges.items()}
+        hazards = [dict(h) for h in _join_hazards]
+    adj: Dict[str, set] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, set()).add(b)
+    return {
+        "cycles": _find_cycles(adj),
+        "join_hazards": hazards,
+        "edges": sorted("%s -> %s" % e for e in edges),
+        "edge_count": len(edges),
+    }
+
+
+class tracing(object):
+    """``with locks.tracing() as get_report:`` — enable, run, and hand
+    back a callable returning the final report; tracing is disabled and
+    the graph reset on exit (the report survives via the callable)."""
+
+    def __enter__(self):
+        reset()
+        enable()
+        self._final = None
+
+        def get():
+            return self._final if self._final is not None else report()
+        return get
+
+    def __exit__(self, *exc):
+        self._final = report()
+        disable()
+        reset()
+        return False

@@ -10,7 +10,10 @@ from __future__ import annotations
 import copy
 from typing import Dict, List, Optional, Set, Tuple
 
+import numpy as np
+
 from ..core import ir, registry
+from ..core.types import is_floating
 from .diagnostics import Severity
 from .runner import Rule, op_sub_blocks, register_rule
 
@@ -462,3 +465,215 @@ class DeadOpRule(Rule):
                       block_idx=blk.idx, op_idx=i,
                       hint="prune it (Program.prune) or fetch what it "
                            "computes")
+
+
+# ---------------------------------------------------------------------------
+# dataflow rules (PT015-PT017): dtype flow, LoD levels, pipeline stages
+
+
+def _canonical_float(dtype):
+    """Declared dtype -> canonical float name, or None for non-floats /
+    unknown. float64 folds into float32 (jax x64 is off; no precision
+    boundary to police between them on this stack)."""
+    if dtype is None:
+        return None
+    try:
+        if not is_floating(dtype):
+            return None
+        name = str(np.dtype(dtype))
+    except Exception:
+        return None
+    return {"float64": "float32", "float16": "float16"}.get(name, name)
+
+
+@register_rule
+class DtypeFlowRule(Rule):
+    """PT015: mixed float widths meet at one op with no ``cast`` between
+    — e.g. an fp32 var consumed where bf16 is produced. jnp silently
+    promotes (bf16 + fp32 -> fp32), so nothing crashes: the bf16 savings
+    quietly evaporate, or an intended-fp32 accumulation quietly runs
+    reduced. The AMP path is exempt by construction (``amp.cast_inputs``
+    casts at lowering and declared dtypes stay fp32); ``cast`` itself,
+    grad replay ops and the optimizer update ops (whose slots hold
+    master-precision state beside compute-precision grads by design)
+    are exempt by type."""
+
+    code = "PT015"
+    name = "dtype-flow"
+    severity = Severity.WARNING
+    emits = ("PT015",)
+
+    EXEMPT_TYPES = frozenset(("cast", "generic_grad", "feed", "fetch",
+                              "print", "cond", "while"))
+
+    def _exempt(self, op):
+        if op.type in self.EXEMPT_TYPES or op.type.endswith("_grad"):
+            return True
+        opdef = registry.lookup(op.type)
+        # optimizer updates: ParamOut-stateful ops legitimately mix a
+        # master-precision param with a compute-precision grad
+        return opdef is not None and "ParamOut" in opdef.stateful_outputs
+
+    def visit_op(self, walk):
+        if self._exempt(walk.op):
+            return
+        by_float: Dict[str, str] = {}
+        for n in walk.op.input_arg_names:
+            if not n:
+                continue
+            v = self.facts.scope_var(walk.block, n)
+            f = _canonical_float(getattr(v, "dtype", None)) if v else None
+            if f:
+                by_float.setdefault(f, n)
+        if len(by_float) > 1:
+            pairs = ", ".join("%s=%r" % (f, n)
+                              for f, n in sorted(by_float.items()))
+            self.emit(
+                "op %r mixes float widths with no cast between (%s): "
+                "jnp promotes silently, so either the reduced-precision "
+                "input's savings are lost or an fp32 path quietly runs "
+                "narrow" % (walk.op.type, pairs),
+                block_idx=walk.block.idx, op_idx=walk.op_idx,
+                var=sorted(by_float.values())[0],
+                hint="insert a cast op (layers.cast) at the boundary, "
+                     "or mark the program AMP so amp.cast_inputs owns "
+                     "the cast")
+
+
+@register_rule
+class LoDFlowRule(Rule):
+    """PT016: LoD-level consistency across sequence ops. The sequence
+    lowerings (ops/sequence_ops.py) call ``seq_offsets`` on specific
+    input slots and raise mid-trace when the var carries no LoD; the
+    declared ``lod_level`` makes that checkable statically. A pooled
+    output (lod_level 0) fed back into a sequence op — the classic
+    chain break — lands here at lint time instead of as a trace error."""
+
+    code = "PT016"
+    name = "lod-flow"
+    emits = ("PT016",)
+
+    # op type -> (input slot that must carry LoD, minimum lod_level) —
+    # exactly the slots whose lowering calls seq_offsets on the slot
+    LOD_REQUIRED = {
+        "sequence_pool": ("X", 1), "sequence_softmax": ("X", 1),
+        "sequence_concat": ("X", 1), "sequence_reshape": ("X", 1),
+        "sequence_conv": ("X", 1), "sequence_slice": ("X", 1),
+        "sequence_erase": ("X", 1), "sequence_reverse": ("X", 1),
+        "sequence_expand": ("Y", 1), "row_conv": ("X", 1),
+        "lstm": ("Input", 1), "lstmp": ("Input", 1), "gru": ("Input", 1),
+        "warpctc": ("Logits", 1),
+    }
+
+    def visit_op(self, walk):
+        req = self.LOD_REQUIRED.get(walk.op.type)
+        if req is None:
+            return
+        slot, min_level = req
+        for n in walk.op.inputs.get(slot, ()):
+            if not n:
+                continue
+            v = self.facts.scope_var(walk.block, n)
+            if v is None:
+                continue  # PT001's finding, not ours
+            level = getattr(v, "lod_level", 0) or 0
+            if level < min_level:
+                self.emit(
+                    "op %r slot %r consumes %r with declared "
+                    "lod_level=%d, but the lowering needs a sequence "
+                    "(lod_level>=%d) — the trace would die in "
+                    "seq_offsets" % (walk.op.type, slot, n, level,
+                                     min_level),
+                    block_idx=walk.block.idx, op_idx=walk.op_idx, var=n,
+                    hint="feed a LoDTensor (layers.data(lod_level=1)) "
+                         "or keep lod_level annotations flowing through "
+                         "the producing layer")
+
+
+def mark_pipeline_stages(program, stages):
+    """Annotate ``program`` with a pipeline stage split over its global
+    block: ``stages`` is a list of ``(start, end)`` half-open op-index
+    ranges in stage order (``parallel.pipeline``'s per-stage op
+    segments). The PT017 rule verifies the split on the next
+    ``verify``; without the annotation the rule is inert."""
+    program._pipeline_stages = [(int(a), int(b)) for a, b in stages]
+    return program
+
+
+@register_rule
+class PipelineStageRule(Rule):
+    """PT017: ``parallel.pipeline`` stage-split verification. Active
+    only when the program carries a ``_pipeline_stages`` annotation
+    (:func:`mark_pipeline_stages`). The split must partition the global
+    block's ops, and every stage's consumed vars must be produced by
+    the same/an earlier stage or fed — a var produced in a LATER stage
+    (a cross-stage back-edge) cannot flow through the one-directional
+    activation channel the pipeline schedule compiles to. A skip over
+    non-adjacent stages is legal dataflow but cannot ride the
+    stage-to-stage ppermute handoff, so it warns."""
+
+    code = "PT017"
+    name = "pipeline-stage-split"
+    emits = ("PT017",)
+
+    def finish(self):
+        stages = getattr(self.program, "_pipeline_stages", None)
+        if not stages:
+            return
+        blk = self.program.global_block()
+        n_ops = len(blk.ops)
+        covered = [None] * n_ops  # op idx -> stage idx
+        prev_end = 0
+        for si, (a, b) in enumerate(stages):
+            if not (0 <= a <= b <= n_ops):
+                self.emit("stage %d range (%d, %d) is outside the "
+                          "global block's %d ops" % (si, a, b, n_ops),
+                          block_idx=0)
+                return
+            if a != prev_end:
+                self.emit("stage split has a %s at op %d (stage %d "
+                          "starts at %d)"
+                          % ("gap" if a > prev_end else "overlap",
+                             prev_end, si, a), block_idx=0,
+                          hint="stages must partition the block's ops "
+                               "contiguously, in order")
+                return
+            for i in range(a, b):
+                covered[i] = si
+            prev_end = b
+        if prev_end != n_ops:
+            self.emit("stage split covers ops [0, %d) but the block has "
+                      "%d — trailing ops belong to no stage"
+                      % (prev_end, n_ops), block_idx=0)
+            return
+        producer_stage: Dict[str, int] = {}
+        fw = self.facts.first_writer.get(0, {})
+        for name, op_idx in fw.items():
+            producer_stage[name] = covered[op_idx]
+        for i, op in enumerate(blk.ops):
+            si = covered[i]
+            for n in op.input_arg_names:
+                if not n:
+                    continue
+                ps = producer_stage.get(n)
+                if ps is None:
+                    continue  # fed / persistable / produced nowhere
+                if ps > si:
+                    self.emit(
+                        "stage %d op %r consumes %r which is first "
+                        "produced in LATER stage %d — a cross-stage "
+                        "back-edge the pipeline's forward-only "
+                        "activation channel cannot carry"
+                        % (si, op.type, n, ps),
+                        block_idx=0, op_idx=i, var=n,
+                        hint="move the producer into an earlier stage "
+                             "or redraw the stage boundaries")
+                elif ps < si - 1:
+                    self.emit(
+                        "stage %d op %r consumes %r from non-adjacent "
+                        "stage %d: legal dataflow, but the value must "
+                        "be re-materialised or carried through every "
+                        "intermediate stage's activation payload"
+                        % (si, op.type, n, ps),
+                        block_idx=0, op_idx=i, var=n,
+                        severity=Severity.WARNING)

@@ -223,12 +223,16 @@ def verify_or_raise(program: ir.Program, rules=None, fetches=None,
     return diags
 
 
-def check_after_pass(program: ir.Program, pass_name: str
-                     ) -> List[Diagnostic]:
+def check_after_pass(program: ir.Program, pass_name: str,
+                     extra_rules=()) -> List[Diagnostic]:
     """Post-transform self-check: the cheap structural rules only (linear,
     no program deepcopy), raising if the pass broke dataflow. Called by
-    memory_optimize and the parallel sharding transpiler after they touch
-    a program, so every program-to-program transform proves it kept the
-    graph well-formed."""
-    return verify_or_raise(program, rules=list(STRUCTURAL_CODES),
-                           context="after pass %r" % pass_name)
+    memory_optimize, the parallel sharding transpiler, and
+    ``core.backward.append_backward`` after they touch a program, so
+    every program-to-program transform proves it kept the graph
+    well-formed. ``extra_rules``: additional cheap codes a caller wants
+    in the same walk (append_backward adds PT007 — the orphan-@GRAD
+    check belongs at the point gradients are created)."""
+    return verify_or_raise(
+        program, rules=list(STRUCTURAL_CODES) + list(extra_rules),
+        context="after pass %r" % pass_name)

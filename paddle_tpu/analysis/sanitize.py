@@ -1,0 +1,167 @@
+"""Donation-aliasing sanitizer: catch host-owned buffers headed for
+donated argument positions.
+
+The two nastiest memory bugs in this repo's history had the same shape:
+a bare numpy-backed buffer was handed to jax, which on CPU may alias the
+host memory zero-copy, and a later jitted call with ``donate_argnums``
+then freed memory python still owned — use-after-free reads that surface
+as silently wrong gradients (PR 5, the async_sgd "flake") or NaN'd
+weights on a flaky cross-mesh restore (PR 10, ``checkpoint._load_one``).
+Both were fixed by copying into an XLA-owned device buffer at the choke
+point. This module makes the *bug class* checkable:
+
+- **always-on guards** at the two previously-fixed sites
+  (``core.executor._run_jit`` state ingestion and ``checkpoint``
+  restore): a cheap ``isinstance`` scan of the values about to occupy a
+  donated position — if the copy those fixes installed ever regresses,
+  the run raises a readable :class:`SanitizeError` naming the variable
+  and the entry point instead of silently corrupting state;
+- **opt-in deep mode** (``PADDLE_TPU_SANITIZE=alias`` or
+  ``FLAGS.sanitize="alias"``): the device-transfer choke points
+  (executor state ingestion, checkpoint restore, the serving engine's
+  KV-pool install) additionally verify that each ingested device buffer
+  does **not** share memory with its host-side source
+  (``unsafe_buffer_pointer`` vs the numpy data pointer — the exact
+  zero-copy alias the donated step would free).
+
+Honest limits: the pointer comparison is best-effort (sharded /
+multi-buffer arrays expose no single pointer and are skipped), and the
+sanitizer sees only the instrumented choke points — it is a tripwire
+for a known bug shape, not a general memory checker.
+
+The companion mode ``PADDLE_TPU_SANITIZE=locks`` lives in
+:mod:`.locks` (lock-order race detector); both modes parse from the
+same env var / flag, comma-separated.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["SanitizeError", "modes", "sanitize_modes", "alias_enabled",
+           "locks_enabled", "check_donated", "host_aliases"]
+
+KNOWN_MODES = ("alias", "locks")
+
+
+class SanitizeError(RuntimeError):
+    """A host-owned buffer was caught flowing into a donated argument
+    position. Carries ``var`` (the offending variable name) and
+    ``entry`` (the instrumented choke point) so tests and operators can
+    match on them."""
+
+    def __init__(self, message, var=None, entry=None):
+        super(SanitizeError, self).__init__(message)
+        self.var = var
+        self.entry = entry
+
+
+def modes() -> frozenset:
+    """The active sanitize modes: the union of ``PADDLE_TPU_SANITIZE``
+    and ``FLAGS.sanitize``, comma/space-separated. Unknown tokens raise
+    a readable ValueError (a typo'd mode silently sanitizing nothing is
+    worse than failing)."""
+    raw = os.environ.get("PADDLE_TPU_SANITIZE", "")
+    try:
+        from ..flags import FLAGS
+        raw += "," + (FLAGS.sanitize or "")
+    except Exception:
+        pass
+    out = set()
+    for tok in raw.replace(",", " ").split():
+        if tok not in KNOWN_MODES:
+            raise ValueError(
+                "unknown PADDLE_TPU_SANITIZE mode %r (known: %s)"
+                % (tok, ", ".join(KNOWN_MODES)))
+        out.add(tok)
+    return frozenset(out)
+
+
+# the name the package-level export uses (analysis.sanitize_modes)
+sanitize_modes = modes
+
+
+def alias_enabled() -> bool:
+    return "alias" in modes()
+
+
+def locks_enabled() -> bool:
+    return "locks" in modes()
+
+
+def _data_pointer(arr) -> Optional[int]:
+    """Best-effort host data pointer of a numpy array."""
+    try:
+        return int(arr.__array_interface__["data"][0])
+    except Exception:
+        return None
+
+
+def _device_pointer(val) -> Optional[int]:
+    """Best-effort device buffer pointer of a (single-device) jax array.
+    Sharded / deleted / non-jax values return None (check skipped)."""
+    try:
+        return int(val.unsafe_buffer_pointer())
+    except Exception:
+        return None
+
+
+def host_aliases(device_val, host_arr) -> bool:
+    """True when ``device_val`` (a jax array) demonstrably shares its
+    buffer with ``host_arr`` (a numpy array) — the zero-copy alias a
+    donated call would free out from under numpy. Best-effort: False
+    when either pointer is unavailable."""
+    hp = _data_pointer(host_arr)
+    dp = _device_pointer(device_val)
+    return hp is not None and dp is not None and hp == dp
+
+
+def _is_host_backed(v) -> bool:
+    """A bare numpy array (or subclass) — memory python owns, which a
+    donated jitted call must never be handed directly."""
+    return isinstance(v, np.ndarray)
+
+
+def check_donated(values, entry: str, always: bool = False,
+                  host_sources: Optional[Dict] = None) -> None:
+    """Verify ``values`` (dict name -> value, or iterable of (name,
+    value) pairs) are safe to occupy donated argument positions at
+    ``entry``.
+
+    - ``always=True`` (the previously-fixed sites): the bare-numpy scan
+      runs unconditionally — it can only fire if the copy-at-ingest fix
+      regressed, so the cost is an isinstance per value.
+    - otherwise the scan runs only in ``alias`` mode.
+    - in ``alias`` mode, ``host_sources`` (name -> the host-side numpy
+      array each value was ingested from) additionally enables the
+      pointer-alias check.
+
+    Raises :class:`SanitizeError` naming the variable and entry point.
+    """
+    deep = alias_enabled()
+    if not (always or deep):
+        return
+    items = values.items() if isinstance(values, dict) else values
+    for name, v in items:
+        if _is_host_backed(v):
+            raise SanitizeError(
+                "sanitize[alias]: %r at %s is a bare numpy-backed buffer "
+                "about to occupy a DONATED argument position — jax may "
+                "alias it zero-copy and the donated call would then free "
+                "memory numpy still owns (the use-after-free shape fixed "
+                "in PR 5's executor state ingestion and PR 10's "
+                "checkpoint restore). Copy it into an XLA-owned buffer "
+                "first (jnp.array(v), not device_put)" % (name, entry),
+                var=name, entry=entry)
+        if deep and host_sources:
+            src = host_sources.get(name)
+            if src is not None and host_aliases(v, src):
+                raise SanitizeError(
+                    "sanitize[alias]: %r at %s zero-copy ALIASES its "
+                    "host-side numpy source (device buffer pointer == "
+                    "numpy data pointer); a donated call would free "
+                    "memory numpy still owns. Copy it into an XLA-owned "
+                    "buffer (jnp.array(arr, copy=True))" % (name, entry),
+                    var=name, entry=entry)

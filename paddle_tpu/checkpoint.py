@@ -399,6 +399,7 @@ def _load_one(dirname, program, scope, dist_context, verify):
                     "checkpoint shard %s has wrong shape/dtype: %r"
                     % (sh["file"], err))
         staged[name] = arr
+    from .analysis.sanitize import check_donated
     for name, arr in staged.items():
         # copy=True guarantees an XLA-owned buffer: device_put/asarray of
         # a bare numpy array may alias its memory zero-copy on CPU, and a
@@ -409,6 +410,13 @@ def _load_one(dirname, program, scope, dist_context, verify):
         if dist_context is not None:
             val = jax.device_put(val,
                                  dist_context.sharding_for(name, arr))
+        # donation-aliasing guard (always-on at this previously-fixed
+        # site): the restored value must be XLA-owned before it enters a
+        # scope whose entries ride donated training steps;
+        # PADDLE_TPU_SANITIZE=alias also proves no zero-copy alias of
+        # the staged host array survived
+        check_donated({name: val}, "checkpoint.restore", always=True,
+                      host_sources={name: arr})
         scope.set_var(name, val)
     return manifest.get("step")
 

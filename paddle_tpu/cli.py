@@ -79,9 +79,12 @@ def cmd_lint(args):
     """Statically verify the program a train config builds — same config
     contract as ``train`` (the file defines ``model()``) but nothing is
     executed or compiled: the Program IR is built and handed to
-    paddle_tpu.analysis.verify. Exit 0 clean / warnings-only, 1 on
-    error diagnostics (or any diagnostic with --strict), 2 if the config
-    itself fails to build."""
+    paddle_tpu.analysis.verify. ``--comm`` adds the
+    collective-consistency pass (PT020-PT023) over the parameter set's
+    grads template at ``--comm-axis`` replicas under the comm_* flags
+    (or ``--comm-policy``/``--comm-hosts`` overrides). Exit 0 clean /
+    warnings-only, 1 on error diagnostics (or any diagnostic with
+    --strict), 2 if the config itself fails to build."""
     import paddle_tpu as pt
     from paddle_tpu import analysis
 
@@ -101,20 +104,47 @@ def cmd_lint(args):
         fetches = [spec["cost"]] + list(spec.get("metrics", ()))
     diags = analysis.verify(main, fetches=fetches)
     startup_diags = analysis.verify(startup)
-    for label, ds in (("main program", diags),
-                      ("startup program", startup_diags)):
+    comm_diags = []
+    reports = [("main program", diags), ("startup program", startup_diags)]
+    if args.comm:
+        from paddle_tpu.analysis import comm_rules
+        from paddle_tpu import comm as comm_mod
+        tpl = comm_rules.grads_template_from_program(main)
+        if not tpl:
+            # no row in the report either: a "clean" verdict for checks
+            # that never executed would misreport the gate log
+            print("comm pass: no static-shaped parameters; skipped")
+        else:
+            try:
+                policy = comm_mod.resolve_policy(
+                    base=args.comm_policy or None,
+                    hosts=args.comm_hosts or None,
+                    axis_size=args.comm_axis)
+                comm_diags, fp = comm_rules.verify_comm(
+                    tpl, policy, axis_size=args.comm_axis)
+            except ValueError as e:
+                print("lint: bad comm options: %s" % e)
+                return 2
+            print("comm pass: %d grad leaves, axis=%d, %r -> "
+                  "fingerprint %s" % (len(tpl), args.comm_axis, policy,
+                                      fp))
+            reports.append(("comm pass", comm_diags))
+    for label, ds in reports:
         report = analysis.render_diagnostics(ds, label=label)
         print(report if report else "%s: clean" % label)
     if args.dot:
         from paddle_tpu import debugger
+        # errors always fill red; the PT015+ dataflow/comm families
+        # highlight at any severity — their findings are exactly the
+        # ops a reader wants to see on the graph
         bad_ops = {d.op_idx for d in diags
                    if d.block_idx == 0 and d.op_idx is not None
-                   and d.is_error}
+                   and (d.is_error or d.code >= "PT015")}
         debugger.draw_block_graphviz(main.global_block(),
                                      op_highlights=bad_ops, path=args.dot)
         print("lint: wrote %s (%d op(s) highlighted)"
               % (args.dot, len(bad_ops)))
-    all_diags = diags + startup_diags
+    all_diags = diags + startup_diags + comm_diags
     failed = any(d.is_error for d in all_diags) \
         or (args.strict and all_diags)
     return 1 if failed else 0
@@ -605,6 +635,24 @@ def main(argv=None):
                            "failing ops highlighted")
     lint.add_argument("--strict", action="store_true",
                       help="treat warnings as failures")
+    lint.add_argument("--comm", action="store_true",
+                      help="run the collective-consistency pass "
+                           "(PT020-PT023) over the config's parameter "
+                           "grads template: bucket plan coverage, "
+                           "canonical issue order, (host, chip) "
+                           "axis-group factorisation, overlap schedule "
+                           "vs gradient finalisation")
+    lint.add_argument("--comm-axis", type=int, default=8,
+                      dest="comm_axis",
+                      help="data-axis size (replica count) the comm "
+                           "pass checks against")
+    lint.add_argument("--comm-policy", default="", dest="comm_policy",
+                      help="comm policy base for the pass (empty = "
+                           "FLAGS.comm_policy)")
+    lint.add_argument("--comm-hosts", type=int, default=0,
+                      dest="comm_hosts",
+                      help="host count for the hierarchical/multipath "
+                           "factorisation (0 = FLAGS.comm_hosts)")
     lint.set_defaults(fn=cmd_lint)
 
     sv = sub.add_parser(

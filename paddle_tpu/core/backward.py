@@ -189,7 +189,27 @@ def append_backward(loss: ir.Variable, parameter_list=None, no_grad_set=None,
                             outputs={"Out": [canon]})
             g = canon
         params_and_grads.append((p, block.var(g)))
+    _check_backward_pass(program)
     return params_and_grads
+
+
+def _check_backward_pass(program):
+    """Always-on post-pass self-check (the soaked ROADMAP item): the
+    cheap structural rules prove backward kept the graph well-formed,
+    and PT007 catches an orphan ``@GRAD`` at the point gradients are
+    created — a rename/prune half-applied here would otherwise only
+    surface at lint time (or as a wrong optimizer update). Structural
+    ERRORs raise; the warning-severity PT007 findings surface as one
+    python warning."""
+    import warnings
+
+    from ..analysis import check_after_pass, render_diagnostics
+    diags = check_after_pass(program, "append_backward",
+                             extra_rules=("PT007",))
+    orphans = [d for d in diags if d.code == "PT007"]
+    if orphans:
+        warnings.warn("append_backward left orphan gradient vars:\n%s"
+                      % render_diagnostics(orphans), RuntimeWarning)
 
 
 def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):

@@ -446,6 +446,18 @@ def _comm_flags_sig():
             FLAGS.comm_gspmd)
 
 
+def _verify_requested():
+    """True when the opt-in static verifier is on (PADDLE_TPU_VERIFY=1
+    env or FLAGS.verify) — shared by the pre-trace program verify and
+    the explicit-comm path's collective-consistency pass."""
+    import os
+    if os.environ.get("PADDLE_TPU_VERIFY", "").lower() in (
+            "1", "true", "yes", "on"):
+        return True
+    from ..flags import FLAGS
+    return bool(FLAGS.verify)
+
+
 def _dist_shardings(dist, state, feed):
     """in_shardings pytree for ``fn(state, feed, rng_key)`` under a mesh.
 
@@ -1129,8 +1141,17 @@ class Executor(object):
         # by tests/test_async_sgd.py's 3-worker pattern on the forced
         # 8-device CPU mesh; bit-exact with the copy. Device-array state
         # (the steady training loop) passes through untouched.
+        raw_state = state
         state = {n: jnp.array(v) if isinstance(v, np.ndarray) else v
-                 for n, v in state.items()}
+                 for n, v in raw_state.items()}
+        # donation-aliasing guard (always-on at this previously-fixed
+        # site): nothing numpy-backed may reach the donated argument
+        # position; PADDLE_TPU_SANITIZE=alias additionally proves the
+        # copies above did not zero-copy alias their host sources
+        from ..analysis.sanitize import check_donated
+        check_donated(state, "executor._run_jit", always=True,
+                      host_sources={n: v for n, v in raw_state.items()
+                                    if isinstance(v, np.ndarray)})
         if dist is not None:
             # align committed buffers with the declared shardings (no-op when
             # already placed; reshards e.g. replicated startup output → tp)
@@ -1406,7 +1427,7 @@ class Executor(object):
         def dispatch(state, feed, rng_key):
             if "fn" not in cell:
                 try:
-                    cell["fn"] = build(state, feed, rng_key)
+                    built = build(state, feed, rng_key)
                     self.stats["comm_path"] = "explicit"
                     grads_tpl = capture.get("grads")
                     if grads_tpl:
@@ -1423,6 +1444,23 @@ class Executor(object):
                                  policy=plan["policy"].base, error=str(e))
                     self.stats["comm_path"] = "model"
                     cell["fn"] = fallback
+                else:
+                    # collective-consistency pass (PT020-PT023), same
+                    # opt-in as the pre-trace verify: the explicit path
+                    # just chose an ordered collective sequence per
+                    # replica — prove it is the pure function of
+                    # (world, policy) its peers compute. OUTSIDE the
+                    # try, before caching: a verifier finding raises
+                    # readably instead of degrading to GSPMD as if the
+                    # routing itself had failed
+                    if _verify_requested() and capture.get("grads"):
+                        from ..analysis import comm_rules
+                        comm_rules.verify_comm_or_raise(
+                            capture["grads"], plan["policy"], axis_size=n,
+                            overlap=bool(FLAGS.comm_overlap),
+                            context="explicit-comm collective "
+                                    "consistency")
+                    cell["fn"] = built
             return cell["fn"](state, feed, rng_key)
 
         return dispatch
@@ -1596,12 +1634,8 @@ class Executor(object):
         ProgramVerifyError listing every diagnostic, instead of the
         cryptic jax error the trace would hit later. Runs once per
         (program uid, version)."""
-        import os
-        if not (os.environ.get("PADDLE_TPU_VERIFY", "").lower()
-                in ("1", "true", "yes", "on")):
-            from ..flags import FLAGS
-            if not FLAGS.verify:
-                return
+        if not _verify_requested():
+            return
         key = (program._uid, program._version)
         if key in self._verified:
             return

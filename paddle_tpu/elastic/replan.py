@@ -63,6 +63,36 @@ class ElasticPlan(object):
         ``_comm_flags_sig`` once ``apply_flags`` ran)."""
         return (self.dp,) + self.policy.key()
 
+    def verify(self, check_flags=False):
+        """Collective-consistency check of this plan's topology
+        (``analysis.comm_rules``, PT022): the (host, chip)
+        factorisation must divide the data axis and the rebuilt
+        ``axis_index_groups`` must partition it — the wrong-re-plan
+        class that otherwise only fails on the real fabric.
+        ``check_flags=True`` additionally audits that the PROCESS flags
+        agree with the plan (a resize that re-planned but never
+        ``apply_flags()``-ed leaves a stale ``comm_hosts`` feeding
+        every other step builder). Returns the diagnostics;
+        :func:`replan` runs this and degrades to the flat plan on any
+        error."""
+        from ..analysis import comm_rules
+        from ..analysis.diagnostics import Diagnostic, Severity
+        diags = comm_rules.check_topology(self.policy, self.dp)
+        if check_flags:
+            from ..flags import FLAGS
+            flagged = int(FLAGS.comm_hosts)
+            if flagged and flagged != self.policy.hosts:
+                diags.append(Diagnostic(
+                    "PT022", Severity.ERROR,
+                    "FLAGS.comm_hosts=%d disagrees with the plan's "
+                    "hosts=%d for world=%d: step builders resolving "
+                    "from flags would factorise a different axis-group "
+                    "set than this plan" % (flagged, self.policy.hosts,
+                                            self.world_size),
+                    hint="call plan.apply_flags() after every resize "
+                         "re-plan"))
+        return diags
+
     def apply_flags(self):
         """Install the plan's topology into the process flags (the one
         mutable step — everything downstream reads flags at build time).
@@ -138,5 +168,23 @@ def replan(world_size, chips_per_host=1, base=None, quant=None,
     policy = comm.resolve_policy(base=base, bucket_mb=bucket_mb,
                                  quant=quant, hosts=hosts,
                                  split_ratio=split_ratio, axis_size=dp)
-    return ElasticPlan(world_size, chips_per_host, hosts, policy,
+    plan = ElasticPlan(world_size, chips_per_host, hosts, policy,
                        degraded=degraded)
+    if not degraded:
+        # collective-consistency audit of the re-plan (PT022): a wrong
+        # (host, chip) factorisation here deadlocks the surviving pod at
+        # its first collective and is invisible on CPU — same
+        # degradation rung as the fault site: flat hosts=1 is
+        # topology-blind but always correct
+        errors = [d for d in plan.verify() if d.is_error]
+        if errors:
+            record_event("elastic_degraded", site="elastic.replan",
+                         error="; ".join(str(d) for d in errors),
+                         world_size=world_size)
+            flat = comm.resolve_policy(base=base, bucket_mb=bucket_mb,
+                                       quant=quant, hosts=1,
+                                       split_ratio=split_ratio,
+                                       axis_size=dp)
+            plan = ElasticPlan(world_size, chips_per_host, 1, flat,
+                               degraded=True)
+    return plan

@@ -214,7 +214,24 @@ DEFINE_bool("verify", False,
             "run the paddle_tpu.analysis static verifier on every program "
             "before its first trace (also enabled by PADDLE_TPU_VERIFY=1); "
             "malformed programs raise ProgramVerifyError with the full "
-            "PT-code diagnostic list instead of a cryptic trace error")
+            "PT-code diagnostic list instead of a cryptic trace error. "
+            "When the Executor takes the explicit-collective path this "
+            "also runs the PT020-PT023 collective-consistency pass over "
+            "the traced grad set")
+DEFINE_string("sanitize", "",
+              "runtime sanitizer modes, comma-separated (union with the "
+              "PADDLE_TPU_SANITIZE env var): 'alias' arms the "
+              "donation-aliasing checks at the device-transfer choke "
+              "points (executor state ingestion, checkpoint restore, "
+              "serving KV-pool install) — a numpy-backed buffer headed "
+              "for a donated argument position raises SanitizeError "
+              "naming the var and entry point; 'locks' swaps the shared "
+              "lock constructor (analysis.locks) for instrumented locks "
+              "that record the acquisition-order graph and report "
+              "cycles (potential deadlocks) and held-across-join "
+              "hazards at process exit. Both cost nothing when off; "
+              "honest limit: CPU CI can only observe the ORDER "
+              "inversion, never the deadlock itself (doc/diagnostics.md)")
 DEFINE_string("data_home", "~/.cache/paddle_tpu/dataset",
               "dataset cache directory (reference: v2/dataset common)")
 DEFINE_int32("log_period", 100,

@@ -144,6 +144,11 @@ class LayerHelper(object):
         b = self.create_parameter(bias_attr, shape=size, dtype=input_var.dtype,
                                   is_bias=True)
         tmp = self.create_variable_for_type_inference(dtype=input_var.dtype)
+        # bias add is row-wise: the output is the same (possibly ragged)
+        # batch as the input, so the LoD annotation must flow through —
+        # dropping it here breaks the declared lod chain a downstream
+        # sequence op needs (analysis rule PT016 polices exactly this)
+        tmp.lod_level = getattr(input_var, "lod_level", 0)
         self.append_op(type="elementwise_add",
                        inputs={"X": [input_var], "Y": [b]},
                        outputs={"Out": [tmp]},
@@ -159,6 +164,8 @@ class LayerHelper(object):
         act = copy.deepcopy(act)
         act_type = act.pop("type")
         tmp = self.create_variable_for_type_inference(dtype=input_var.dtype)
+        # activations are elementwise: LoD flows through (see append_bias_op)
+        tmp.lod_level = getattr(input_var, "lod_level", 0)
         self.append_op(type=act_type, inputs={"X": [input_var]},
                        outputs={"Out": [tmp]}, attrs=act)
         return tmp

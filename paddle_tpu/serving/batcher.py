@@ -42,6 +42,9 @@ import numpy as np
 
 from ..resilience import fault_point, record_event
 from .admission import ModelUnavailableError, ServingError
+# the shared lock constructor: plain threading primitives normally, the
+# lock-order race detector's instrumented ones under PADDLE_TPU_SANITIZE=locks
+from ..analysis import locks as _locks
 
 __all__ = ["padding_buckets", "bucket_for", "feed_shape_sig", "Request",
            "MicroBatcher"]
@@ -162,7 +165,7 @@ class MicroBatcher(object):
         # CONSTRUCTION — mixed-shape traffic to one model coalesces
         # into per-shape full batches instead of poisoning np.stack
         self._queues = {}           # (model, shape_sig) -> deque[Request]
-        self._cond = threading.Condition()
+        self._cond = _locks.make_condition("serving.batcher.cond")
         self._running = True
         self._thread = threading.Thread(target=self._dispatch_loop,
                                         name="paddle_tpu-serve-dispatch",

@@ -50,6 +50,9 @@ from .admission import (AdmissionController, DeadlineExceededError,
 from .batcher import bucket_for, padding_buckets
 from .kvcache import BlockTable, PagePool, PoolExhausted, pages_for
 from .service import _WINDOW, _percentile
+# the shared lock constructor: plain threading primitives normally, the
+# lock-order race detector's instrumented ones under PADDLE_TPU_SANITIZE=locks
+from ..analysis import locks as _locks
 
 __all__ = ["GenRequest", "GenResult", "GenerationEngine", "sample_token",
            "reference_decode"]
@@ -239,6 +242,7 @@ class GenerationEngine(object):
         L, nh, dh = model.kv_spec
         self.pool = PagePool(kv_pages, page_tokens, L, nh, dh)
         self._kp, self._vp = self.pool.zeros()
+        self._check_pool_install("serving.engine_pool_install")
         # the two compiled faces: decode ONCE per (max_running, pool),
         # prefill once per prompt-length bucket; pools are donated so
         # the cache is updated in place step to step
@@ -252,7 +256,7 @@ class GenerationEngine(object):
         self._admitting = 0        # popped from queue, prefill underway
         #   (in neither _queue nor _seqs — drain must count these too)
         self._free_slots = list(range(self.max_running))
-        self._cond = threading.Condition()
+        self._cond = _locks.make_condition("serving.generator.cond")
         self._alive = True
         self._draining = False
         self._counts = collections.Counter()
@@ -610,7 +614,17 @@ class GenerationEngine(object):
         if deleted is None or not deleted():
             return False
         self._kp, self._vp = self.pool.zeros()
+        self._check_pool_install("serving.engine_pool_rebuild")
         return True
+
+    def _check_pool_install(self, entry):
+        """Donation-aliasing sanitizer choke point
+        (``PADDLE_TPU_SANITIZE=alias``): the K/V pool arrays ride every
+        prefill/decode call at DONATED positions — a numpy-backed buffer
+        installed here is exactly the zero-copy-alias-then-free shape
+        the executor and checkpoint guards exist for."""
+        from ..analysis.sanitize import check_donated
+        check_donated({"k_pages": self._kp, "v_pages": self._vp}, entry)
 
     def _grow_tables(self):
         """Make room for each running row's next position; starvation

@@ -33,6 +33,9 @@ from __future__ import annotations
 import threading
 
 from .admission import ServingError
+# the shared lock constructor: plain threading primitives normally, the
+# lock-order race detector's instrumented ones under PADDLE_TPU_SANITIZE=locks
+from ..analysis import locks as _locks
 
 __all__ = ["PoolExhausted", "PagePool", "BlockTable", "pages_for"]
 
@@ -70,7 +73,7 @@ class PagePool(object):
         self.num_heads = int(num_heads)
         self.head_dim = int(head_dim)
         self.dtype = str(dtype)
-        self._lock = threading.Lock()
+        self._lock = _locks.make_lock("serving.kvcache.pool")
         # free list kept SORTED so allocation order is deterministic
         # (tests and replays see the same page ids for the same history)
         self._free = list(range(self.num_pages))

@@ -43,6 +43,9 @@ import threading
 import time
 
 from ..resilience import RetryPolicy, record_event
+# the shared lock constructor: plain threading primitives normally, the
+# lock-order race detector's instrumented ones under PADDLE_TPU_SANITIZE=locks
+from ..analysis import locks as _locks
 
 __all__ = ["Replica", "ReplicaPool", "StaticReplica", "StaticPool"]
 
@@ -173,7 +176,7 @@ class ReplicaPool(object):
         if root not in pp.split(os.pathsep):
             self.base_env["PYTHONPATH"] = (root + os.pathsep + pp if pp
                                            else root)
-        self._lock = threading.Lock()
+        self._lock = _locks.make_lock("serving.pool.state")
         self._replicas = [None] * self.n      # index -> Replica
         self._restarts_used = [0] * self.n
         self._lost = [False] * self.n

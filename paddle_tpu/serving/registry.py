@@ -32,6 +32,9 @@ from ..inference import load_compiled
 from ..resilience import fault_point, record_event
 from .admission import ModelUnavailableError
 from .batcher import padding_buckets
+# the shared lock constructor: plain threading primitives normally, the
+# lock-order race detector's instrumented ones under PADDLE_TPU_SANITIZE=locks
+from ..analysis import locks as _locks
 
 __all__ = ["ModelEntry", "ModelRegistry"]
 
@@ -72,7 +75,7 @@ class ModelRegistry(object):
         self.warm_buckets = tuple(sorted(set(int(b) for b in warm_buckets)))
         self._models = {}       # name -> ModelEntry
         self._versions = {}     # name -> last assigned version int
-        self._lock = threading.Lock()
+        self._lock = _locks.make_lock("serving.registry.state")
 
     # -- lookup (reads snapshot under the lock: a concurrent first load
     # of a NEW name mutates the dict mid-iteration otherwise) ---------------

@@ -66,6 +66,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from ..resilience import fault_point, record_event
 from .httpd import read_json_body, write_json_reply
 from .service import _percentile
+# the shared lock constructor: plain threading primitives normally, the
+# lock-order race detector's instrumented ones under PADDLE_TPU_SANITIZE=locks
+from ..analysis import locks as _locks
 
 __all__ = ["Router", "RouterStats", "make_router_server"]
 
@@ -125,14 +128,14 @@ class Router(object):
         self.proxy_timeout_s = float(
             proxy_timeout_s if proxy_timeout_s is not None
             else FLAGS.route_proxy_timeout_s)
-        self._lock = threading.Lock()
+        self._lock = _locks.make_lock("serving.router.state")
         self._states = {}            # pool index -> _ReplicaState
         self._counts = {}            # router-level counters
         self._latency_ms = []        # bounded: recent proxied latencies
         self._prev_model_counts = {} # model -> (requests, sheds) last poll
         self._pressure = {}          # model -> latest pressure snapshot
         self._rr_next = 0
-        self._reload_lock = threading.Lock()
+        self._reload_lock = _locks.make_lock("serving.router.reload")
         self._poller = None
         self._probe_exec = None
         self._closed = False

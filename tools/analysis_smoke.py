@@ -1,0 +1,211 @@
+"""Distributed-correctness analysis smoke gate (CPU tier-1).
+
+The PT-rule verifier proved its structural half in PR 2; this gate
+proves the PR-12 distributed-correctness suite end to end, on CPU,
+with seeded defects — because every bug class it covers is invisible
+on a clean single-process run:
+
+1. **lint sweep** — ``paddle_tpu lint --comm`` over every
+   ``examples/configs/*.py`` exits 0 (zero false positives under the
+   new PT015-PT017 dataflow rules AND the PT020-PT023 comm pass);
+2. **collective consistency** — a seeded bucket-order permutation is
+   caught as PT020, a wrong (host, chip) factorisation as PT022, a
+   stale plan against a changed param set as PT021, an
+   issue-before-finalisation overlap schedule as PT023; the clean
+   canonical schedule passes all four;
+3. **donation-aliasing sanitizer** — the seeded PR-10 shape (a bare
+   numpy-backed buffer at a donated position) raises ``SanitizeError``
+   naming the var and entry point, while a real checkpoint
+   save/restore round trip under ``PADDLE_TPU_SANITIZE=alias`` is
+   silent;
+4. **lock-order race detector** — a seeded A->B/B->A inversion is
+   reported as a cycle and a held-across-join as a hazard, while a
+   real generation-engine run plus a router construction under the
+   instrumented lock constructor is silent (no cycles, no hazards).
+
+Exit 0 on pass, 1 on failure; prints a one-line JSON summary either
+way. Invoked by tools/analysis_smoke.sh and hooked into tools/lint.sh
+beside the other five smokes.
+
+    JAX_PLATFORMS=cpu python tools/analysis_smoke.py
+"""
+import glob
+import json
+import os
+import sys
+import threading
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+failures = []
+summary = {}
+
+
+def check(name, ok, detail=""):
+    summary[name] = bool(ok)
+    if not ok:
+        failures.append("%s%s" % (name, (": " + detail) if detail else ""))
+
+
+def lint_sweep():
+    from paddle_tpu.cli import main as cli_main
+    cfgs = sorted(glob.glob(os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples", "configs", "*.py")))
+    check("lint_configs_found", bool(cfgs))
+    for cfg in cfgs:
+        rc = cli_main(["lint", cfg, "--comm", "--comm-axis", "8",
+                       "--comm-policy", "fused"])
+        check("lint_clean:%s" % os.path.basename(cfg), rc == 0,
+              "exit %d" % rc)
+
+
+def comm_seeded():
+    import jax
+    import numpy as np
+    from paddle_tpu.analysis import comm_rules
+    from paddle_tpu.comm import CommPolicy, build_plan
+
+    tpl = {"p%02d@GRAD" % i: jax.ShapeDtypeStruct((128,),
+                                                  np.dtype("float32"))
+           for i in range(6)}
+    pol = CommPolicy(base="fused", bucket_bytes=1024)
+    diags, fp = comm_rules.verify_comm(tpl, pol, axis_size=8)
+    check("comm_clean_canonical", diags == [] and fp,
+          "; ".join(map(str, diags)))
+
+    plan = build_plan(tpl, pol.bucket_bytes)
+    permuted = list(reversed(range(plan.num_buckets)))
+    diags, _ = comm_rules.verify_comm(tpl, pol, axis_size=8,
+                                      schedule=permuted)
+    check("comm_pt020_permuted_schedule",
+          any(d.code == "PT020" for d in diags))
+
+    bad_hosts = CommPolicy(base="hierarchical", hosts=3)
+    check("comm_pt022_wrong_hosts",
+          any(d.code == "PT022"
+              for d in comm_rules.check_topology(bad_hosts, 8)))
+
+    smaller = dict(list(tpl.items())[:4])
+    check("comm_pt021_param_set_mismatch",
+          any(d.code == "PT021"
+              for d in comm_rules.check_bucket_plan(plan, smaller)))
+
+    canonical = plan.backward_schedule()
+    check("comm_pt023_overlap_hazard",
+          any(d.code == "PT023"
+              for d in comm_rules.check_overlap_schedule(
+                  plan, list(reversed(canonical))))
+          and comm_rules.check_overlap_schedule(plan, canonical) == [])
+
+
+def sanitizer_seeded():
+    import numpy as np
+    from paddle_tpu.analysis import SanitizeError, sanitize
+
+    os.environ["PADDLE_TPU_SANITIZE"] = "alias"
+    try:
+        # seeded: the PR-10 restore shape — bare numpy at a donated slot
+        fired = False
+        try:
+            sanitize.check_donated(
+                {"fc_0.w_0": np.ones((4, 2), np.float32)},
+                "checkpoint.restore")
+        except SanitizeError as e:
+            fired = e.var == "fc_0.w_0" and e.entry == "checkpoint.restore"
+        check("sanitize_alias_seeded_fires", fired)
+
+        # clean: a real save/restore round trip is silent under the mode
+        import tempfile
+
+        import paddle_tpu as pt
+        from paddle_tpu import checkpoint as ckpt
+        from paddle_tpu import layers
+        import jax.numpy as jnp
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data(name="x", shape=[4], dtype="float32")
+            layers.fc(input=x, size=2, act=None)
+        scope = pt.Scope()
+        for v in main.list_vars():
+            if v.persistable and v.shape is not None:
+                scope.set_var(v.name, jnp.zeros(tuple(v.shape)))
+        with tempfile.TemporaryDirectory() as d:
+            ckpt.save_checkpoint(os.path.join(d, "c"), main_program=main,
+                                 scope=scope, step=1)
+            scope2 = pt.Scope()
+            step = ckpt.load_checkpoint(os.path.join(d, "c"),
+                                        main_program=main, scope=scope2)
+        check("sanitize_alias_clean_restore", step == 1)
+    finally:
+        os.environ.pop("PADDLE_TPU_SANITIZE", None)
+
+
+def locks_seeded_and_clean():
+    from paddle_tpu.analysis import locks
+
+    # seeded inversion -> cycle; seeded held-across-join (the joined
+    # thread takes the held lock) -> hazard
+    with locks.tracing() as get_report:
+        a, b = locks.make_lock("smoke.A"), locks.make_lock("smoke.B")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        took = threading.Event()
+
+        def worker():
+            with a:
+                pass
+            took.set()
+
+        t = threading.Thread(target=worker)
+        t.start()
+        took.wait(5)
+        with a:
+            t.join()
+    rep = get_report()
+    check("locks_seeded_cycle",
+          any(set(c) == {"smoke.A", "smoke.B"} for c in rep["cycles"]))
+    check("locks_seeded_join_hazard", bool(rep["join_hazards"]))
+
+    # clean leg: a REAL generation-engine run + a router construction
+    # under the instrumented constructor — silent
+    from paddle_tpu.models import transformer as tm
+    from paddle_tpu.serving import GenerationEngine, Router, StaticPool
+    cfg = tm.TransformerConfig(vocab_size=17, hidden=16, num_layers=1,
+                               num_heads=2, max_seq=32)
+    model = tm.TransformerLM(tm.init_params(cfg, seed=1), cfg)
+    with locks.tracing() as get_report:
+        eng = GenerationEngine(model, max_running=2, kv_pages=16,
+                               page_tokens=4, warm=True, name="smoke")
+        try:
+            res = eng.generate([1, 2, 3], max_new_tokens=4)
+            ok = len(res.tokens) >= 1
+        finally:
+            eng.close()
+        router = Router(StaticPool([]), poll_ms=50)
+        router.close()
+    rep = get_report()
+    check("locks_clean_generator_run",
+          ok and rep["cycles"] == [] and rep["join_hazards"] == [],
+          json.dumps({k: rep[k] for k in ("cycles", "join_hazards")}))
+
+
+def main():
+    lint_sweep()
+    comm_seeded()
+    sanitizer_seeded()
+    locks_seeded_and_clean()
+    ok = not failures
+    print(json.dumps({"analysis_smoke": {
+        "ok": ok, "failures": failures,
+        "checks": {k: v for k, v in sorted(summary.items())}}}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

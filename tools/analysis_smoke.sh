@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Distributed-correctness analysis smoke gate: the PT015-PT023 rules,
+# the donation-aliasing sanitizer, and the lock-order race detector must
+# each catch their seeded defect AND stay silent on the clean legs
+# (tools/analysis_smoke.py holds the criteria). Companion to the other
+# five smokes (perf/serve/comm/tune/gen/elastic/router); also invoked
+# from tools/lint.sh.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+python tools/analysis_smoke.py
