@@ -206,6 +206,17 @@ def _trainer_main(client, state_dir, gen, world_size, stop):
                                 "task": i})
             continue
         step += 1
+        # audit row BEFORE the snapshot/checkpoint pair: a SIGKILL in
+        # the (commit .. row) window used to span both fsync-heavy
+        # saves, and the committed task then had no row — the
+        # exactly-once checker read it as LOST (~1/3 of chaos runs).
+        # Written here, every kill window reconciles: no checkpoint at
+        # this step -> the resume truncates the timeline at step-1 and
+        # the row (like the task) rolls back with the model; a
+        # checkpoint that did land keeps both
+        _append_jsonl(log, {"kind": "task", "gen": gen, "step": step,
+                            "task": i, "world": world_size,
+                            "loss": loss_v, "probe": probe()})
         # snapshot FIRST, checkpoint second, pair third: every kill
         # window lands on a consistent (model, data-pass) point
         snap = resume_mod.snapshot_path(root, step)
@@ -214,9 +225,6 @@ def _trainer_main(client, state_dir, gen, world_size, stop):
         ckpt_dir = ckpt.save_checkpoint(root, main, step=step,
                                         keep_last=KEEP_LAST)
         os.replace(snap, os.path.join(ckpt_dir, resume_mod.SNAP_IN_DIR))
-        _append_jsonl(log, {"kind": "task", "gen": gen, "step": step,
-                            "task": i, "world": world_size,
-                            "loss": loss_v, "probe": probe()})
     return 0
 
 
@@ -246,6 +254,12 @@ def _worker_env(state_dir, policy, fault_spec):
         env["PADDLE_TPU_FAULT_SPEC"] = fault_spec
     env["PADDLE_TPU_FLAGS"] = "comm_policy=%s" % policy
     env["PADDLE_TPU_ELASTIC_STATE"] = state_dir
+    # only rank 0 trains in this harness (the peers are heartbeating
+    # liveness bodies), so the job-start schedule-fingerprint exchange
+    # (elastic.fingerprints) can never complete — cap its wait so each
+    # generation pays ~2s for the recorded-incomplete advisory instead
+    # of the full pod-scale timeout
+    env["PADDLE_TPU_FINGERPRINT_TIMEOUT"] = "2"
     return env
 
 

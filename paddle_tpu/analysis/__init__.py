@@ -21,7 +21,9 @@ Entry points:
 
 Distributed-correctness companions (this package, beyond the Program
 walk): :mod:`.comm_rules` (PT020-PT023 collective consistency),
-:mod:`.sanitize` (donation-aliasing sanitizer,
+:mod:`.memory` (PT030-PT034 static memory planner: liveness-based
+peak-HBM lint, the Executor's pre-compile OOM preflight, KV-pool
+sizing), :mod:`.sanitize` (donation-aliasing sanitizer,
 ``PADDLE_TPU_SANITIZE=alias``), :mod:`.locks` (lock-order race
 detector, ``PADDLE_TPU_SANITIZE=locks``).
 """
@@ -35,6 +37,7 @@ from .runner import (  # noqa: F401
 from . import rules  # noqa: F401  (registers the built-in PT rules)
 from .rules import mark_pipeline_stages  # noqa: F401
 from . import comm_rules  # noqa: F401
+from . import memory  # noqa: F401
 from .sanitize import SanitizeError, sanitize_modes  # noqa: F401
 from . import sanitize  # noqa: F401
 from . import locks  # noqa: F401
@@ -44,5 +47,5 @@ __all__ = [
     "Rule", "ProgramFacts", "STRUCTURAL_CODES", "check_after_pass",
     "register_rule", "registered_rules", "resolve_rules", "verify",
     "verify_or_raise", "rules", "mark_pipeline_stages", "comm_rules",
-    "SanitizeError", "sanitize_modes", "sanitize", "locks",
+    "memory", "SanitizeError", "sanitize_modes", "sanitize", "locks",
 ]

@@ -1,0 +1,636 @@
+"""Static memory planner (PT030-PT034): liveness-based peak-HBM analysis.
+
+The worst memory failure mode is silent: a program compiles fine and
+then dies inside XLA with an unreadable OOM — or fits today and stops
+fitting after an elastic resize redistributes the global batch over
+fewer workers. This module turns "does this program + batch + mesh fit
+this device" into a *lint answer*: one walk over the Program IR (op
+order per block, descending into control-flow sub-blocks, var last-use)
+computes a byte-resolved residency timeline —
+
+- **params + optimizer slots**: persistable, live the whole step (the
+  executor donates them, so each buffer is counted once — the in-place
+  ``ParamOut`` update writes the same var, not a second allocation);
+- **activations kept for backward**: live from their forward producer
+  to the last consumer, which for a training program is the ``*_grad``
+  replay op that reads them — the dominant transient class;
+- **gradients**: non-persistable ``@GRAD`` vars, freed progressively as
+  the optimizer updates consume them;
+- **feeds**: host-fed buffers, live from step start to their last use.
+
+From the timeline: the predicted peak, the high-water op, and the
+top-k resident tensors at that point. The per-op kernel *scratch* is
+priced by the same VMEM footprint model ``tune/space.py`` uses to prune
+autotune candidates (reused, not duplicated) and reported beside the
+HBM numbers.
+
+Checks (codes in doc/diagnostics.md):
+
+- **PT030** (error): predicted peak exceeds the budget — names the
+  high-water op and the top-5 resident tensors at that point.
+- **PT031** (warning): donation opportunity missed — a large feed
+  buffer is dead after its consuming op and shape/dtype-compatible
+  with one of its outputs, but feeds are not donated (XLA already
+  reuses in-jit buffers; the jit *boundary* is where donation is a
+  real decision, cf. the executor's donate_argnums state).
+- **PT032** (warning): an activation kept live across the whole step
+  by a persistable marking that nothing — backward included — ever
+  reads (write-only state: pure resident waste).
+- **PT033** (warning): unknown-size vars (shape-inference failures,
+  unresolved batch dims). The peak degrades to a *bounded lower
+  estimate* with the degradation reported — never a silently wrong
+  number.
+- **PT034** (error): serving KV-pool sizing — ``serve_kv_pages x
+  serve_page_tokens x layers x heads x head_dim`` (x2 for K and V,
+  +1 trash page per layer) vs budget minus model bytes; checked by
+  ``inference.validate_generative_artifact`` when a budget is known.
+
+Entry points: ``paddle_tpu lint --memory [--budget-gb G --mesh dp=N]``;
+the Executor preflight under ``PADDLE_TPU_VERIFY`` (raises one readable
+``ProgramVerifyError`` with the residency table BEFORE the jit
+compile); ``elastic.replan`` / ``ElasticPlan.audit_memory`` after every
+resize; the ``paddle_tpu accounting`` memory columns; and
+``memory_optimization_transpiler``, whose liveness is this pass.
+
+Honest limits (doc/diagnostics.md): the estimate is *static* — it
+ignores XLA fusion, rematerialisation and allocator fragmentation, so
+the preflight is a lower bound on what the compiled program needs, not
+a guarantee it fits. Predicted-vs-actual is made visible via
+:func:`measure_live_bytes` (``jax.live_arrays`` on CPU) in the
+profiler's ``memory`` timeline section.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..core import ir, registry
+from .diagnostics import Diagnostic, ProgramVerifyError, Severity
+from .runner import op_sub_blocks
+
+__all__ = ["MemoryPlan", "plan_memory", "check_memory", "check_kv_pool",
+           "verify_memory_or_raise", "resolve_budget_bytes",
+           "measure_live_bytes", "compute_liveness", "flatten_ops",
+           "MEMORY_CODES", "kv_pool_bytes", "fmt_bytes"]
+
+MEMORY_CODES = ("PT030", "PT031", "PT032", "PT033", "PT034")
+
+# below this, a missed feed donation is noise: XLA's own reuse and the
+# allocator's slack dwarf it (PT031 stays quiet on toy configs)
+DONATION_MIN_BYTES = 1 << 20
+
+GRAD_SUFFIX = ir.GRAD_SUFFIX
+
+
+def _dtype_bytes(dtype):
+    try:
+        return int(np.dtype(getattr(dtype, "name", dtype) or
+                            "float32").itemsize)
+    except TypeError:
+        return 4
+
+
+def fmt_bytes(n):
+    """Human byte count, the one formatter every memory surface uses
+    (residency tables, PT030/PT034 messages, the serve CLI's aggregate
+    verdict)."""
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return ("%.2f %s" % (n, unit)) if unit != "B" \
+                else ("%d B" % int(n))
+        n /= 1024.0
+
+
+_fmt_bytes = fmt_bytes  # internal call sites
+
+
+def flatten_ops(program: ir.Program) -> List[Tuple[ir.Block, int,
+                                                   ir.Operator]]:
+    """Ops in execution order: each block's ops in sequence, descending
+    into control-flow sub-blocks at the op that owns them (the walk
+    order ``runner.verify`` uses, flattened so every op gets one global
+    timeline slot). Cycle-safe on corrupt sub-block graphs."""
+    out: List[Tuple[ir.Block, int, ir.Operator]] = []
+    visited: Set[int] = set()
+
+    def walk(block):
+        if block.idx in visited:
+            return
+        visited.add(block.idx)
+        for i, op in enumerate(block.ops):
+            out.append((block, i, op))
+            for _key, sub, _raw in op_sub_blocks(op, program):
+                if sub is not None:
+                    walk(sub)
+    walk(program.global_block())
+    return out
+
+
+def compute_liveness(uses: Sequence[Set[str]], defs: Sequence[Set[str]]
+                     ) -> Tuple[List[Set[str]], List[Set[str]]]:
+    """Classic backward dataflow over a linear op list: returns
+    ``(live_in, live_out)`` per op. The one liveness implementation in
+    the tree — ``memory_optimization_transpiler.ControlFlowGraph`` and
+    :func:`plan_memory` both sit on it."""
+    n = len(uses)
+    live_in: List[Set[str]] = [set() for _ in range(n)]
+    live_out: List[Set[str]] = [set() for _ in range(n)]
+    changed = True
+    while changed:
+        changed = False
+        for i in range(n - 1, -1, -1):
+            out = set(live_in[i + 1]) if i + 1 < n else set()
+            new_in = uses[i] | (out - defs[i])
+            if new_in != live_in[i] or out != live_out[i]:
+                live_in[i] = new_in
+                live_out[i] = out
+                changed = True
+    return live_in, live_out
+
+
+class _VarRec(object):
+    """One tensor's residency: byte size, class, live interval."""
+
+    __slots__ = ("name", "nbytes", "cls", "start", "end", "exact",
+                 "block_idx")
+
+    def __init__(self, name, nbytes, cls, start, end, exact, block_idx):
+        self.name = name
+        self.nbytes = int(nbytes)
+        self.cls = cls
+        self.start = int(start)
+        self.end = int(end)
+        self.exact = bool(exact)
+        self.block_idx = block_idx
+
+
+class MemoryPlan(object):
+    """Residency timeline + derived facts for one (program, batch, dp).
+
+    ``peak_bytes`` / ``peak_index`` / ``peak_op``: the high-water mark;
+    ``class_bytes``: per-class totals (params / optimizer_state /
+    gradients / activations / feeds); ``unknown``: var names whose size
+    could not be resolved (the peak is then a lower bound and ``exact``
+    is False); ``vmem_scratch``: worst per-op kernel VMEM footprint
+    priced by the tune spaces' model."""
+
+    def __init__(self, program, records, n_ops, batch, dp, unknown,
+                 peak_bytes, peak_index, peak_op, vmem_scratch=None,
+                 flat_ops=None, produced=None, read_anywhere=None):
+        self.program = program
+        self.records: Dict[str, _VarRec] = records
+        self.n_ops = n_ops
+        self.batch = batch
+        self.dp = dp
+        self.unknown: List[str] = unknown
+        self.peak_bytes = int(peak_bytes)
+        self.peak_index = peak_index
+        self.peak_op = peak_op  # (block_idx, op_idx, op_type) or None
+        self.vmem_scratch = vmem_scratch  # (op_type, bytes) or None
+        # the walk's own maps, carried so check_memory never re-walks:
+        # the flat op list, name -> first-producer index, and the set
+        # of names read by any op
+        self._flat_ops = flat_ops if flat_ops is not None \
+            else flatten_ops(program)
+        self._produced: Dict[str, int] = produced or {}
+        self._read_anywhere: Set[str] = read_anywhere or set()
+
+    @property
+    def exact(self):
+        return not self.unknown
+
+    @property
+    def class_bytes(self) -> Dict[str, int]:
+        out = {"params": 0, "optimizer_state": 0, "gradients": 0,
+               "activations": 0, "feeds": 0}
+        for r in self.records.values():
+            out[r.cls] = out.get(r.cls, 0) + r.nbytes
+        return out
+
+    def residents_at(self, index, k=None):
+        """Tensors live at timeline slot ``index``, largest first."""
+        live = [r for r in self.records.values()
+                if r.start <= index <= r.end]
+        live.sort(key=lambda r: (-r.nbytes, r.name))
+        return live[:k] if k is not None else live
+
+    def top_residents(self, k=5):
+        if self.peak_index is None:
+            return []
+        return self.residents_at(self.peak_index, k)
+
+    def peak_op_ref(self) -> str:
+        if self.peak_op is None:
+            return "<empty program>"
+        blk, opi, optype = self.peak_op
+        return "block%d:op%d (%s)" % (blk, opi, optype)
+
+    def summary(self) -> Dict:
+        """JSON-able digest — the ``paddle_tpu accounting`` memory
+        section and the elastic audit record."""
+        cb = self.class_bytes
+        return {
+            "batch_per_device": self.batch,
+            "dp": self.dp,
+            "param_bytes": cb["params"],
+            "optimizer_state_bytes": cb["optimizer_state"],
+            "gradient_bytes": cb["gradients"],
+            "activation_bytes": cb["activations"],
+            "feed_bytes": cb["feeds"],
+            "peak_bytes": self.peak_bytes,
+            "peak_op": self.peak_op_ref(),
+            "exact": self.exact,
+            "unknown_vars": len(self.unknown),
+            "vmem_scratch_bytes": (self.vmem_scratch[1]
+                                   if self.vmem_scratch else 0),
+        }
+
+    def table(self, budget_bytes=None) -> str:
+        """The human residency report (the one the preflight's
+        ProgramVerifyError embeds)."""
+        cb = self.class_bytes
+        lines = ["predicted per-device HBM residency (batch=%s, dp=%d):"
+                 % (self.batch if self.batch is not None else "?",
+                    self.dp)]
+        for label, key in (("params", "params"),
+                           ("optimizer state", "optimizer_state"),
+                           ("gradients", "gradients"),
+                           ("activations", "activations"),
+                           ("feeds", "feeds")):
+            lines.append("  %-16s %12s" % (label, _fmt_bytes(cb[key])))
+        peak = "  %-16s %12s at %s" % ("peak", _fmt_bytes(self.peak_bytes),
+                                       self.peak_op_ref())
+        if budget_bytes:
+            peak += "  [budget %s]" % _fmt_bytes(budget_bytes)
+        lines.append(peak)
+        for r in self.top_residents(5):
+            lines.append("    resident at peak: %-28s %12s  (%s)"
+                         % (r.name, _fmt_bytes(r.nbytes), r.cls))
+        if self.vmem_scratch:
+            lines.append("  kernel VMEM scratch (worst op %s): %s"
+                         % (self.vmem_scratch[0],
+                            _fmt_bytes(self.vmem_scratch[1])))
+        if self.unknown:
+            lines.append("  %d unknown-size var(s) (%s%s) — peak is a "
+                         "LOWER BOUND"
+                         % (len(self.unknown),
+                            ", ".join(self.unknown[:4]),
+                            ", ..." if len(self.unknown) > 4 else ""))
+        return "\n".join(lines)
+
+
+def _var_nbytes(v, batch):
+    """(nbytes, exact) for a declared Variable; ``exact`` is False when
+    a dim is unresolved (unknown shape, or -1 with no batch): the
+    unresolved dim prices as 1 — a bounded lower estimate."""
+    shape = getattr(v, "shape", None)
+    if shape is None:
+        return 0, False
+    n, exact = 1, True
+    for d in shape:
+        d = int(d) if d is not None else -1
+        if d == -1:
+            if batch is not None:
+                n *= max(int(batch), 1)
+            else:
+                exact = False  # unresolved batch dim: price as 1
+        elif d <= 0:
+            exact = False
+        else:
+            n *= d
+    return n * _dtype_bytes(getattr(v, "dtype", "float32")), exact
+
+
+def _vmem_scratch(program, batch):
+    """Worst per-op kernel VMEM footprint, priced by the tune spaces'
+    model over the tunable populations the program actually hits (the
+    exact model the autotuner prunes candidates with). Best-effort:
+    any failure prices as None, never kills the plan."""
+    try:
+        from ..cli import _tune_populations
+        from ..tune import get_space
+        worst = None
+        for kernel, key in _tune_populations(program, batch or 1):
+            space = get_space(kernel)
+            cfg = space.default_config(key)
+            nb = int(space.vmem_bytes(cfg, key))
+            if worst is None or nb > worst[1]:
+                worst = (kernel, nb)
+        return worst
+    except Exception:
+        return None
+
+
+def plan_memory(program: ir.Program, batch=None, fetches=None, dp=1,
+                sizes_override=None, vmem=True) -> MemoryPlan:
+    """Build the residency timeline for ``program``.
+
+    ``batch`` substitutes the feed wildcard dim (-1); ``dp`` models a
+    data-parallel mesh by pricing the PER-DEVICE shard of the batch
+    (params replicate, batch-dim tensors divide). ``fetches`` extend
+    those vars' residency to the step end (the executor materialises
+    them at the boundary). ``sizes_override`` maps var name -> exact
+    nbytes (the Executor preflight passes real array sizes for state
+    and feeds, replacing the declared-shape estimate)."""
+    fetches = set(f.name if isinstance(f, ir.Variable) else f
+                  for f in (fetches or ()))
+    sizes_override = sizes_override or {}
+    per_dev_batch = batch
+    if batch is not None and dp and dp > 1:
+        per_dev_batch = -(-int(batch) // int(dp))
+    ops = flatten_ops(program)
+    n_ops = len(ops)
+
+    produced: Dict[str, int] = {}
+    last_use: Dict[str, int] = {}
+    ref_block: Dict[str, ir.Block] = {}
+    read_anywhere: Set[str] = set()
+    for idx, (block, _opi, op) in enumerate(ops):
+        for name in op.input_arg_names:
+            if name:
+                last_use[name] = idx
+                read_anywhere.add(name)
+                ref_block.setdefault(name, block)
+        for name in op.output_arg_names:
+            if name:
+                produced.setdefault(name, idx)
+                last_use[name] = idx  # a written var lives at least here
+                ref_block.setdefault(name, block)
+
+    records: Dict[str, _VarRec] = {}
+    unknown: List[str] = []
+    for name in set(produced) | set(last_use):
+        block = ref_block[name]
+        v = block._find_var_recursive(name)
+        persistable = v is not None and v.persistable
+        is_param = isinstance(v, ir.Parameter)
+        is_grad = GRAD_SUFFIX in name
+        if name in sizes_override:
+            nbytes, exact = int(sizes_override[name]), True
+        elif v is None:
+            nbytes, exact = 0, False
+        else:
+            nbytes, exact = _var_nbytes(v, per_dev_batch)
+        if not exact:
+            unknown.append(name)
+        if persistable:
+            cls = "params" if is_param else "optimizer_state"
+            start, end = 0, max(n_ops - 1, 0)
+        elif name not in produced:
+            cls = "feeds"
+            start, end = 0, last_use[name]
+        else:
+            cls = "gradients" if is_grad else "activations"
+            start = produced[name]
+            end = last_use[name]
+            if name in fetches:
+                end = max(n_ops - 1, 0)
+        records[name] = _VarRec(name, nbytes, cls, start, end, exact,
+                                block.idx)
+
+    # peak via event deltas over the flat timeline
+    deltas = [0] * (n_ops + 1)
+    for r in records.values():
+        deltas[r.start] += r.nbytes
+        if r.end + 1 <= n_ops:
+            deltas[r.end + 1] -= r.nbytes
+    peak, cur, peak_idx = 0, 0, None
+    for i in range(n_ops):
+        cur += deltas[i]
+        if cur > peak:
+            peak, peak_idx = cur, i
+    if peak_idx is None and records:
+        # op-less program (vars only): everything resident at once
+        peak = sum(r.nbytes for r in records.values())
+    peak_op = None
+    if peak_idx is not None and ops:
+        blk, opi, op = ops[peak_idx]
+        peak_op = (blk.idx, opi, op.type)
+    unknown.sort()
+    return MemoryPlan(program, records, n_ops, per_dev_batch, int(dp or 1),
+                      unknown, peak, peak_idx, peak_op,
+                      vmem_scratch=_vmem_scratch(program, per_dev_batch)
+                      if vmem else None,
+                      flat_ops=ops, produced=produced,
+                      read_anywhere=read_anywhere)
+
+
+def _diag(code, message, severity=Severity.ERROR, **kw):
+    return Diagnostic(code, severity, message, **kw)
+
+
+def check_memory(program: ir.Program, budget_bytes=None, batch=None,
+                 fetches=None, dp=1, plan=None, sizes_override=None,
+                 donation_min_bytes=DONATION_MIN_BYTES, vmem=True
+                 ) -> Tuple[MemoryPlan, List[Diagnostic]]:
+    """The full static memory pass: build (or reuse) the plan, return
+    ``(plan, diagnostics)`` for PT030-PT033. ``vmem=False`` skips the
+    kernel-scratch pricing (display-only; the preflight's hot path
+    drops it)."""
+    if plan is None:
+        plan = plan_memory(program, batch=batch, fetches=fetches, dp=dp,
+                           sizes_override=sizes_override, vmem=vmem)
+    diags: List[Diagnostic] = []
+
+    # PT033 first: it qualifies the PT030 verdict (lower bound)
+    if plan.unknown:
+        diags.append(_diag(
+            "PT033", "%d var(s) have unresolved sizes (%s%s): the "
+            "predicted peak %s is a LOWER BOUND, not the real number"
+            % (len(plan.unknown), ", ".join(plan.unknown[:8]),
+               ", ..." if len(plan.unknown) > 8 else "",
+               _fmt_bytes(plan.peak_bytes)),
+            severity=Severity.WARNING,
+            hint="declare static shapes (or pass --batch so the feed "
+                 "wildcard resolves); PT013 lists the shape-inference "
+                 "failures that feed this"))
+
+    if budget_bytes and plan.peak_bytes > budget_bytes:
+        top = ", ".join("%s=%s (%s)" % (r.name, _fmt_bytes(r.nbytes),
+                                        r.cls)
+                        for r in plan.top_residents(5))
+        blk_idx, op_idx = (plan.peak_op[0], plan.peak_op[1]) \
+            if plan.peak_op else (None, None)
+        diags.append(_diag(
+            "PT030", "predicted peak HBM %s exceeds the budget %s "
+            "(overflow %s) — high-water op %s; top residents: %s"
+            % (_fmt_bytes(plan.peak_bytes), _fmt_bytes(budget_bytes),
+               _fmt_bytes(plan.peak_bytes - budget_bytes),
+               plan.peak_op_ref(), top or "<none>"),
+            block_idx=blk_idx, op_idx=op_idx,
+            hint="shrink the batch, shard the params over more devices "
+                 "(--mesh dp=N), enable rematerialisation "
+                 "(memory_optimize), or raise --budget-gb if the "
+                 "device really has more"))
+
+    # PT031: a large FEED buffer dead after its consuming op,
+    # shape/dtype-compatible with one of that op's outputs, not donated
+    # — in-jit reuse is XLA's job; the jit boundary is where donation
+    # is a real decision and feeds today are never donated
+    ops = plan._flat_ops  # the plan's own walk: no second flatten
+    for name, rec in sorted(plan.records.items()):
+        if rec.cls != "feeds" or rec.nbytes < donation_min_bytes:
+            continue
+        if rec.end >= len(ops):
+            continue
+        block, opi, op = ops[rec.end]
+        if name not in op.input_arg_names:
+            continue  # last use was as an output (shouldn't happen)
+        opdef = registry.lookup(op.type)
+        stateful = set(opdef.stateful_outputs) if opdef is not None \
+            else set()
+        v = block._find_var_recursive(name)
+        for slot, outs in op.outputs.items():
+            if slot in stateful:
+                continue  # already an in-place contract
+            for out_name in outs:
+                if not out_name or out_name == name:
+                    continue
+                ov = block._find_var_recursive(out_name)
+                if (v is not None and ov is not None
+                        and v.shape is not None and ov.shape is not None
+                        and tuple(v.shape) == tuple(ov.shape)
+                        and v.dtype == ov.dtype):
+                    diags.append(_diag(
+                        "PT031", "feed %r (%s) is dead after op %r and "
+                        "shape/dtype-compatible with its output %r, but "
+                        "feed buffers are not donated — both stay "
+                        "resident across the step"
+                        % (name, _fmt_bytes(rec.nbytes), op.type,
+                           out_name),
+                        severity=Severity.WARNING, block_idx=block.idx,
+                        op_idx=opi, var=name,
+                        hint="donate the feed ring's buffers to the "
+                             "step once jax exposes stable donation "
+                             "for non-state args (ROADMAP), or reuse "
+                             "the feed dict across steps "
+                             "(Executor.prepare_feed)"))
+                    break
+            else:
+                continue
+            break
+
+    # PT032: persistable non-Parameter produced by an op but read by
+    # nothing — its persistable marking pins it resident (and in the
+    # executor's donated state) across every step for no reader
+    for name, rec in sorted(plan.records.items()):
+        if rec.cls != "optimizer_state":
+            continue
+        v = None
+        for blk in program.blocks:
+            if name in blk.vars:
+                v = blk.vars[name]
+                break
+        if v is None or isinstance(v, ir.Parameter):
+            continue
+        if name in plan._produced and name not in plan._read_anywhere:
+            diags.append(_diag(
+                "PT032", "persistable %r (%s) is written but read by no "
+                "op (backward included): its persistable marking keeps "
+                "it resident — and in the donated state pytree — across "
+                "every step for nothing"
+                % (name, _fmt_bytes(rec.nbytes)),
+                severity=Severity.WARNING, var=name,
+                hint="drop the persistable marking (let it die at its "
+                     "last real use) or delete the producer"))
+    return plan, diags
+
+
+# ---------------------------------------------------------------------------
+# PT034: serving KV-pool sizing
+
+
+def kv_pool_bytes(num_layers, num_heads, head_dim, kv_pages, page_tokens,
+                  dtype="float32"):
+    """Bytes of the paged KV pool the generation engine preallocates:
+    K and V, ``[layers, pages + 1, page_tokens, heads, head_dim]`` each
+    (the +1 is the trash write-sink page — serving/kvcache.py)."""
+    per = (int(num_layers) * (int(kv_pages) + 1) * int(page_tokens)
+           * int(num_heads) * int(head_dim) * _dtype_bytes(dtype))
+    return 2 * per  # K and V
+
+
+def check_kv_pool(num_layers, num_heads, head_dim, kv_pages, page_tokens,
+                  dtype="float32", model_bytes=0, budget_bytes=None
+                  ) -> List[Diagnostic]:
+    """PT034: the preallocated KV pool plus the resident model must fit
+    the budget. Returns [] when no budget is known (CPU dev boxes)."""
+    if not budget_bytes:
+        return []
+    pool = kv_pool_bytes(num_layers, num_heads, head_dim, kv_pages,
+                         page_tokens, dtype)
+    headroom = int(budget_bytes) - int(model_bytes)
+    if pool <= headroom:
+        return []
+    return [_diag(
+        "PT034", "KV page pool needs %s (%d pages x %d tokens x %d "
+        "layers x %d heads x %d head_dim, K+V + trash page) but only "
+        "%s remain after the %s model on a %s budget"
+        % (_fmt_bytes(pool), int(kv_pages), int(page_tokens),
+           int(num_layers), int(num_heads), int(head_dim),
+           _fmt_bytes(max(headroom, 0)), _fmt_bytes(model_bytes),
+           _fmt_bytes(budget_bytes)),
+        hint="lower --kv_pages / FLAGS.serve_kv_pages or "
+             "--page_tokens, serve a smaller model, or raise "
+             "FLAGS.memory_budget_gb if the device really has more")]
+
+
+# ---------------------------------------------------------------------------
+# budget resolution + runtime measurement
+
+
+def resolve_budget_bytes(budget_gb=None, device=None) -> Optional[int]:
+    """The budget the checks compare against: an explicit ``--budget-gb``
+    beats ``FLAGS.memory_budget_gb`` beats the detected device memory
+    (``device.memory_stats()['bytes_limit']`` — present on TPU, usually
+    absent on CPU). None = no budget known: PT030/PT034 stay silent."""
+    if budget_gb:
+        return int(float(budget_gb) * (1 << 30))
+    from ..flags import FLAGS
+    if FLAGS.memory_budget_gb > 0:
+        return int(float(FLAGS.memory_budget_gb) * (1 << 30))
+    if device is not None:
+        try:
+            stats = device.memory_stats()
+            limit = (stats or {}).get("bytes_limit")
+            if limit:
+                return int(limit)
+        except Exception:
+            pass
+    return None
+
+
+def measure_live_bytes() -> int:
+    """Sum of bytes behind every live ``jax.Array`` in the process —
+    the predicted-vs-actual evidence source on CPU (the profiler's
+    ``memory`` section records both). Best-effort: 0 when jax cannot
+    enumerate."""
+    try:
+        import jax
+        return int(sum(int(getattr(a, "nbytes", 0) or 0)
+                       for a in jax.live_arrays()))
+    except Exception:
+        return 0
+
+
+def verify_memory_or_raise(program, budget_bytes, batch=None, fetches=None,
+                           dp=1, sizes_override=None, context=None,
+                           vmem=False) -> MemoryPlan:
+    """The Executor preflight: run :func:`check_memory` and raise ONE
+    readable :class:`ProgramVerifyError` — residency table included —
+    when the predicted peak exceeds the budget, BEFORE any XLA compile
+    burns minutes on a program that cannot fit. Kernel-scratch pricing
+    is off by default here: it is a display row, and the common
+    no-budget/fits path must not pay a tune-space walk per fresh
+    compile."""
+    plan, diags = check_memory(program, budget_bytes=budget_bytes,
+                               batch=batch, fetches=fetches, dp=dp,
+                               sizes_override=sizes_override, vmem=vmem)
+    errors = [d for d in diags if d.is_error]
+    if errors:
+        ctx = context or "memory preflight"
+        raise ProgramVerifyError(
+            errors, context="%s\n%s" % (ctx, plan.table(budget_bytes)))
+    return plan

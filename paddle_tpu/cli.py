@@ -75,6 +75,56 @@ def cmd_bench(args):
     return 0
 
 
+def _parse_mesh(spec, verb):
+    """'dp=4,tp=2' -> {axis: size}; malformed entries — missing '=',
+    non-integer or < 1 sizes, empty segments from a stray comma — are
+    REJECTED with a readable message (returns None): silently skipping
+    one would price/verify a different mesh than the operator asked
+    for."""
+    spec = (spec or "").strip()
+    if not spec:
+        return {}
+    mesh = {}
+    for pair in spec.split(","):
+        k, eq, v = pair.partition("=")
+        try:
+            if not (eq and k.strip()):
+                raise ValueError("missing '='")
+            size = int(v)
+            if size < 1:
+                raise ValueError("size < 1")
+            mesh[k.strip()] = size
+        except ValueError:
+            print("%s: bad --mesh entry %r (want axis=size with "
+                  "size >= 1, e.g. 'dp=8' or 'dp=4,tp=2')" % (verb, pair))
+            return None
+    return mesh
+
+
+def _append_train_step(verb, spec, main, startup):
+    """Append backward + optimizer ops to ``main`` so the memory pass
+    prices the TRAIN step, not just the forward build. A config with a
+    cost but no optimizer gets the same default SGD ``cmd_train``
+    would use — ``paddle_tpu train`` of that config runs a full
+    backward, so pricing it forward-only would report a peak far below
+    what the train run allocates. Returns True on success; a minimize
+    failure is reported (one consistent line across the lint and
+    accounting surfaces) and degrades to forward-only analysis."""
+    import paddle_tpu as pt
+    if not (isinstance(spec, dict) and spec.get("cost") is not None):
+        return False
+    optimizer = spec.get("optimizer") or pt.optimizer.SGD(
+        learning_rate=0.01)
+    try:
+        with pt.program_guard(main, startup):
+            optimizer.minimize(spec["cost"])
+    except Exception as e:
+        print("%s: could not append the backward (%s: %s); analysing "
+              "the forward program only" % (verb, type(e).__name__, e))
+        return False
+    return True
+
+
 def cmd_lint(args):
     """Statically verify the program a train config builds — same config
     contract as ``train`` (the file defines ``model()``) but nothing is
@@ -82,9 +132,14 @@ def cmd_lint(args):
     paddle_tpu.analysis.verify. ``--comm`` adds the
     collective-consistency pass (PT020-PT023) over the parameter set's
     grads template at ``--comm-axis`` replicas under the comm_* flags
-    (or ``--comm-policy``/``--comm-hosts`` overrides). Exit 0 clean /
-    warnings-only, 1 on error diagnostics (or any diagnostic with
-    --strict), 2 if the config itself fails to build."""
+    (or ``--comm-policy``/``--comm-hosts`` overrides). ``--memory``
+    adds the static memory planner (PT030-PT033): the backward +
+    optimizer ops are appended (when the config names an optimizer) so
+    the liveness pass sees the full training step, and the predicted
+    per-device peak is checked against ``--budget-gb`` /
+    ``FLAGS.memory_budget_gb`` at ``--batch`` over ``--mesh dp=N``.
+    Exit 0 clean / warnings-only, 1 on error diagnostics (or any
+    diagnostic with --strict), 2 if the config itself fails to build."""
     import paddle_tpu as pt
     from paddle_tpu import analysis
 
@@ -105,7 +160,34 @@ def cmd_lint(args):
     diags = analysis.verify(main, fetches=fetches)
     startup_diags = analysis.verify(startup)
     comm_diags = []
+    memory_diags = []
     reports = [("main program", diags), ("startup program", startup_diags)]
+    if args.memory:
+        from paddle_tpu.analysis import memory as memory_mod
+        mesh = _parse_mesh(args.mesh, "lint")
+        if mesh is None:
+            return 2
+        ignored = sorted(a for a in mesh if a != "dp")
+        if ignored:
+            # the memory model shards the batch over dp only — saying
+            # so beats silently pricing a different mesh than asked
+            print("lint: --memory shards the batch over 'dp' only; "
+                  "mesh axis(es) %s ignored (params priced replicated)"
+                  % ", ".join(ignored))
+        # the residency question is about the TRAIN step: append
+        # backward + optimizer ops so activations-to-backward and
+        # gradient lifetimes are in the walk (the structural rules
+        # above already ran on the as-built program)
+        train_step = _append_train_step("lint", spec, main, startup)
+        budget = memory_mod.resolve_budget_bytes(
+            budget_gb=args.budget_gb or None)
+        plan, memory_diags = memory_mod.check_memory(
+            main, budget_bytes=budget, batch=args.batch,
+            fetches=fetches, dp=mesh.get("dp", 1))
+        print("memory pass (%s program):"
+              % ("train-step" if train_step else "forward-only"))
+        print(plan.table(budget))
+        reports.append(("memory pass", memory_diags))
     if args.comm:
         from paddle_tpu.analysis import comm_rules
         from paddle_tpu import comm as comm_mod
@@ -144,7 +226,7 @@ def cmd_lint(args):
                                      op_highlights=bad_ops, path=args.dot)
         print("lint: wrote %s (%d op(s) highlighted)"
               % (args.dot, len(bad_ops)))
-    all_diags = diags + startup_diags + comm_diags
+    all_diags = diags + startup_diags + comm_diags + memory_diags
     failed = any(d.is_error for d in all_diags) \
         or (args.strict and all_diags)
     return 1 if failed else 0
@@ -171,14 +253,26 @@ def _parse_extra_models(pairs, primary=None):
     return out
 
 
-def _validate_artifacts(verb, artifact_dir, extra_models):
+def _validate_artifacts(verb, artifact_dir, extra_models, kv_pages=None,
+                        page_tokens=None):
     """Validate the primary + every extra artifact up front; prints the
-    problems and returns False on a bad one (nothing gets started)."""
+    problems and returns False on a bad one (nothing gets started).
+    ``kv_pages``/``page_tokens``: the CLI's pool overrides — PT034 must
+    size the pool the engine will ACTUALLY allocate, not the flag
+    default. Beyond the per-model check, the AGGREGATE of every
+    co-hosted generative model (weights + pool each) is checked
+    against the budget: one process loads them all, so each fitting
+    alone proves nothing."""
     from paddle_tpu import inference
+    from paddle_tpu.analysis import memory as memory_mod
+    budget = memory_mod.resolve_budget_bytes()
+    total, gen_labels = 0, []
     for label, dirname in [("artifact", artifact_dir)] + [
             ("extra model %r" % n, d) for n, d in extra_models]:
         generative = inference.is_generative_artifact(dirname)
-        problems = (inference.validate_generative_artifact(dirname)
+        problems = (inference.validate_generative_artifact(
+                        dirname, kv_pages=kv_pages,
+                        page_tokens=page_tokens)
                     if generative else inference.validate_artifact(dirname))
         if problems:
             print("%s: cannot serve %s %r:" % (verb, label, dirname),
@@ -186,6 +280,21 @@ def _validate_artifacts(verb, artifact_dir, extra_models):
             for p in problems:
                 print("  - " + p, file=sys.stderr)
             return False
+        if generative and budget:
+            nb = inference.generative_memory_bytes(
+                dirname, kv_pages=kv_pages, page_tokens=page_tokens)
+            if nb is not None:
+                total += nb
+                gen_labels.append("%s=%s" % (label,
+                                             memory_mod.fmt_bytes(nb)))
+    if budget and len(gen_labels) > 1 and total > budget:
+        print("%s: cannot serve: PT034 the co-hosted generative models "
+              "need %s together (%s) on a %s budget — each fits alone, "
+              "one process loads them all"
+              % (verb, memory_mod.fmt_bytes(total),
+                 ", ".join(gen_labels), memory_mod.fmt_bytes(budget)),
+              file=sys.stderr)
+        return False
     return True
 
 
@@ -208,7 +317,9 @@ def cmd_serve(args):
         print("serve: %s" % e, file=sys.stderr)
         return 1
     generative = inference.is_generative_artifact(args.artifact_dir)
-    if not _validate_artifacts("serve", args.artifact_dir, extra_models):
+    if not _validate_artifacts("serve", args.artifact_dir, extra_models,
+                               kv_pages=args.kv_pages or None,
+                               page_tokens=args.page_tokens or None):
         return 1
     service = serving.InferenceService(
         max_batch=args.max_batch or None,
@@ -287,7 +398,9 @@ def cmd_route(args):
     except ValueError as e:
         print("route: %s" % e, file=sys.stderr)
         return 1
-    if not _validate_artifacts("route", args.artifact_dir, extra_models):
+    if not _validate_artifacts("route", args.artifact_dir, extra_models,
+                               kv_pages=args.kv_pages or None,
+                               page_tokens=args.page_tokens or None):
         return 1
     serve_args = []
     if args.max_batch:
@@ -362,32 +475,34 @@ def cmd_accounting(args):
     per-chip collective byte counts of the transpiled parameter set
     (parallel.accounting ring formulas) plus the paddle_tpu.comm policy
     matrix — bytes-on-wire and dispatch counts for
-    none/fused/hierarchical/int8 over the requested mesh. Pure analysis:
-    nothing is compiled or executed, no devices needed. Same config
-    contract as ``train``/``lint`` (the file defines ``model()``)."""
+    none/fused/hierarchical/int8 over the requested mesh — plus the
+    ``memory`` columns: per-device params / optimizer state /
+    activations / gradients / feeds and the predicted peak from the
+    static memory planner (analysis.memory) at ``--batch``, the
+    per-parameter-class sizing table the FSDP direction needs as
+    input. Pure analysis: nothing is compiled or executed, no devices
+    needed. Same config contract as ``train``/``lint`` (the file
+    defines ``model()``)."""
     import paddle_tpu as pt
     from paddle_tpu.parallel import accounting
 
-    mesh_shape = {}
-    for pair in (args.mesh or "dp=8").split(","):
-        k, eq, v = pair.partition("=")
-        try:
-            if not (eq and k.strip()):
-                raise ValueError("missing '='")
-            mesh_shape[k.strip()] = int(v)
-        except ValueError:
-            print("accounting: bad --mesh entry %r (want axis=size, e.g. "
-                  "'dp=8' or 'dp=4,tp=2')" % pair)
-            return 2
+    mesh_shape = _parse_mesh(args.mesh or "dp=8", "accounting")
+    if mesh_shape is None:
+        return 2
     main, startup = pt.Program(), pt.Program()
     try:
         cfg = _load_config(args.config)
         with pt.program_guard(main, startup):
-            cfg.model()
+            spec = cfg.model()
     except Exception as e:
         print("accounting: config %r failed to build: %s: %s"
               % (args.config, type(e).__name__, e))
         return 2
+    # memory columns price the TRAIN step (optimizer slots, grads,
+    # activations-to-backward); comm tables read parameters only,
+    # which minimize() does not change
+    train_step = _append_train_step("accounting", spec, main, startup)
+    fetches = [spec["cost"]] if train_step else None
     specs = getattr(main, "_shardings", None) or {}
     try:
         report = {
@@ -399,6 +514,11 @@ def cmd_accounting(args):
                 bucket_mb=args.bucket_mb or None,
                 split_ratio=(args.split_ratio
                              if args.split_ratio >= 0 else None)),
+            "memory": dict(
+                accounting.memory_table(main, mesh_shape,
+                                        batch=args.batch,
+                                        fetches=fetches),
+                train_step=train_step),
         }
     except ValueError as e:
         # e.g. --hosts not dividing the data axis: readable, not a trace
@@ -653,6 +773,25 @@ def main(argv=None):
                       dest="comm_hosts",
                       help="host count for the hierarchical/multipath "
                            "factorisation (0 = FLAGS.comm_hosts)")
+    lint.add_argument("--memory", action="store_true",
+                      help="run the static memory planner (PT030-PT033, "
+                           "analysis.memory): liveness-based per-device "
+                           "peak-HBM prediction over the full train step "
+                           "(backward + optimizer appended when the "
+                           "config names one), checked against the "
+                           "budget; prints the residency table")
+    lint.add_argument("--budget-gb", type=float, default=0.0,
+                      dest="budget_gb",
+                      help="per-device HBM budget for --memory (GiB; "
+                           "0 = FLAGS.memory_budget_gb, which at 0 "
+                           "leaves PT030 unchecked — the honest default "
+                           "on a devbox with no TPU attached)")
+    lint.add_argument("--batch", type=int, default=16,
+                      help="global batch substituted for the feed "
+                           "wildcard dim (-1) in the --memory pass")
+    lint.add_argument("--mesh", default="dp=1",
+                      help="mesh for the --memory pass, e.g. 'dp=8': "
+                           "the batch shards over dp, params replicate")
     lint.set_defaults(fn=cmd_lint)
 
     sv = sub.add_parser(
@@ -759,6 +898,9 @@ def main(argv=None):
                           "(0 = 2 when the axis divides, else flat)")
     acc.add_argument("--bucket_mb", type=float, default=0.0,
                      help="override FLAGS.comm_bucket_mb (0 = flag)")
+    acc.add_argument("--batch", type=int, default=16,
+                     help="global batch for the memory columns (shards "
+                          "over the data axis; feeds' wildcard dim)")
     acc.add_argument("--split-ratio", type=float, default=-1.0,
                      dest="split_ratio",
                      help="primary-path fraction for the multipath rows "

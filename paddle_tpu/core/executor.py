@@ -710,7 +710,10 @@ class Executor(object):
                       "tune_fallbacks": 0,
                       "elastic_resizes": 0, "elastic_lost_ranks": 0,
                       "elastic_requeued_tasks": 0,
-                      "elastic_resume_ms": 0.0}
+                      "elastic_resume_ms": 0.0,
+                      # the memory preflight's last predicted peak
+                      # (PADDLE_TPU_VERIFY; analysis.memory PT030)
+                      "mem_predicted_peak_bytes": 0}
         # programs whose trace hit data-dependent control flow: run eager
         self._force_eager = set()
         # (uid, version) pairs already checked by the pre-trace verifier
@@ -1176,6 +1179,17 @@ class Executor(object):
                 self.stats["compile_cache_hits"] += 1
                 _prof.update_pipeline_counters(compile_cache_hits=1)
         if fn is None:
+            # static memory preflight (PADDLE_TPU_VERIFY, PT030): a
+            # program whose predicted peak HBM cannot fit the budget
+            # raises ONE readable ProgramVerifyError with the residency
+            # table HERE — before the XLA compile burns minutes on a
+            # step that would only die in an unreadable device OOM.
+            # Fresh-compile path only: a cached fn already proved it
+            # compiles, and the plan is a function of (program, feed
+            # signature, state signature) — exactly this cache key
+            if _verify_requested():
+                self._memory_preflight(program, feed, state, fetch_names,
+                                       dist)
             shardings = (_dist_shardings(dist, state, feed)
                          if dist is not None else None)
             fn = _TracedOnce(self._compile(
@@ -1460,6 +1474,20 @@ class Executor(object):
                             overlap=bool(FLAGS.comm_overlap),
                             context="explicit-comm collective "
                                     "consistency")
+                    import os as _os
+                    if _os.environ.get("PADDLE_TPU_ELASTIC_STATE") \
+                            and capture.get("grads"):
+                        # elastic job start: cross-replica fingerprint
+                        # exchange — divergence refuses the first
+                        # collective readably (PT020), same rung as the
+                        # verifier above, gated on the launch contract
+                        # instead of PADDLE_TPU_VERIFY
+                        from ..elastic.fingerprints import \
+                            check_replica_schedule
+                        check_replica_schedule(
+                            capture["grads"], policy=plan["policy"],
+                            axis_size=n,
+                            overlap=bool(FLAGS.comm_overlap))
                     cell["fn"] = built
             return cell["fn"](state, feed, rng_key)
 
@@ -1647,6 +1675,73 @@ class Executor(object):
                           % (program._uid, render_diagnostics(diags)),
                           RuntimeWarning)
         self._verified.add(key)
+
+    def _memory_preflight(self, program, feed, state, fetch_names, dist):
+        """Opt-in pre-compile memory check (PADDLE_TPU_VERIFY, PT030):
+        price the step's residency from the REAL array sizes (state +
+        feed buffers exact, IR-declared shapes for the activations and
+        gradients in between) and raise a readable ProgramVerifyError
+        with the residency table when the predicted peak exceeds the
+        budget (FLAGS.memory_budget_gb, or the device's detected
+        bytes_limit). The estimate ignores XLA fusion/remat — a lower
+        bound, which is the right direction for a refusal gate."""
+        from ..analysis import memory as _mem
+
+        def nbytes_of(v):
+            if isinstance(v, TracedLoD):
+                return getattr(v.data, "nbytes", None)
+            return getattr(v, "nbytes", None)
+
+        dp = 1
+        mesh_shape = {}
+        if dist is not None:
+            mesh_shape = dict(dist.mesh.shape)
+            dp = mesh_shape.get(dist.strategy.data_axis, 1)
+        # budget autodetect must work on the mesh too: a pod's device
+        # exposes bytes_limit exactly where OOM matters most
+        budget = _mem.resolve_budget_bytes(
+            device=(dist.mesh.devices.flat[0] if dist is not None
+                    else self._device()))
+        sizes = {}
+        for n, v in state.items():
+            nb = nbytes_of(v)
+            if not nb:
+                continue
+            if dist is not None:
+                # nbytes is the GLOBAL logical size; a ZeRO/tp-sharded
+                # var costs each device only its shard — pricing it
+                # replicated would spuriously refuse a fitting job
+                spec = dist.specs.get(n)
+                for axis in (a for a in (spec or ()) if a is not None):
+                    nb //= max(mesh_shape.get(axis, 1), 1)
+            sizes[n] = nb
+        batch = None
+        block = program.global_block()
+        for n, v in feed.items():
+            shape = tuple(getattr(v, "shape", ()) or ())
+            declared = block._find_var_recursive(n)
+            if (shape and declared is not None and declared.shape
+                    and int(declared.shape[0]) == -1):
+                batch = max(batch or 0, int(shape[0]))
+            nb = nbytes_of(v)
+            if nb and dp == 1:
+                sizes[n] = nb  # under a mesh the feed shards: let the
+                # declared shape price the per-device slice instead
+        plan = _mem.verify_memory_or_raise(
+            program, budget, batch=batch, fetches=fetch_names, dp=dp,
+            sizes_override=sizes,
+            context="executor memory preflight (before jit compile, "
+                    "program %d)" % program._uid)
+        from .. import profiler as _prof
+        # the measured half of the predicted-vs-actual pair the
+        # timeline's memory section documents: live buffers at this
+        # step boundary (state + feeds are in; the compile hasn't run).
+        # Once per fresh compile, never per step
+        _prof.update_memory_counters(
+            mem_preflights=1, mem_predicted_peak_bytes=plan.peak_bytes,
+            mem_measured_live_bytes=_mem.measure_live_bytes())
+        self.stats["mem_predicted_peak_bytes"] = plan.peak_bytes
+        return plan
 
     def _persistable_names(self, program):
         return {v.name for v in program.list_vars() if v.persistable}

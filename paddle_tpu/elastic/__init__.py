@@ -39,15 +39,20 @@ from .resume import resume as resume_latest  # noqa: F401
 from .supervisor import (  # noqa: F401
     ElasticSupervisor, TaskMasterHost, Gang, free_port,
 )
+from .fingerprints import (  # noqa: F401
+    check_replica_schedule, publish_fingerprint, gather_fingerprints,
+)
 # the submodules stay addressable as attributes (elastic.replan.replan,
 # elastic.resume.resume): the verb aliases above exist because the
 # module names and their primary verbs collide
-from . import replan, resume, supervisor  # noqa: F401
+from . import fingerprints, replan, resume, supervisor  # noqa: F401
 
 __all__ = [
     "ElasticPlan", "plan_for",
     "ResumePoint", "resume_point", "resume_latest", "snapshot_path",
     "pair_snapshot", "record_stats", "SNAP_IN_DIR",
     "ElasticSupervisor", "TaskMasterHost", "Gang", "free_port",
-    "replan", "resume", "supervisor",
+    "check_replica_schedule", "publish_fingerprint",
+    "gather_fingerprints",
+    "fingerprints", "replan", "resume", "supervisor",
 ]

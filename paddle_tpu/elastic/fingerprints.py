@@ -1,0 +1,190 @@
+"""Cross-replica schedule-fingerprint exchange at elastic job start.
+
+PR 12 built the per-replica half: ``analysis.comm_rules`` proves ONE
+replica's ordered collective sequence is a pure function of (world,
+policy) and digests it as a ``schedule_fingerprint``. This module is
+the cross-replica half the ROADMAP left open: under ``paddle_tpu
+launch --elastic`` (with ``--state-dir``), every rank publishes its
+fingerprint into the shared state directory before issuing its first
+collective, reads its peers' back, and runs
+``comm_rules.check_replica_fingerprints`` — a divergence (e.g. one
+rank launched with a stale ``comm_bucket_mb`` or a different
+``comm_policy``) REFUSES the first collective with one readable error
+naming both fingerprints, instead of deadlocking the pod at the first
+mismatched rendezvous.
+
+Files: ``<state_dir>/fingerprints/gen<G>-rank<R>.json`` (atomic
+rename), one per (generation, rank) — a resize bumps the generation,
+so stale fingerprints from the pre-resize world never collide with the
+survivors' fresh exchange.
+
+Failure posture: divergence is an ERROR (raise — issuing the
+collective would hang or silently mis-sum); an exchange that cannot
+complete (no state dir, peers slow past the timeout, unreadable file)
+is ADVISORY — recorded as a ``fingerprint_exchange_incomplete`` event
+and waved through, because refusing to train when a peer is merely
+slow to write a JSON file would convert a monitoring feature into a
+new failure mode.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["publish_fingerprint", "gather_fingerprints",
+           "check_replica_schedule", "fingerprint_dir"]
+
+# (state_dir, generation, rank) triples this PROCESS already exchanged:
+# the record files are keyed per (generation, rank), so a process that
+# builds a SECOND grad-bearing program (a later Executor compile, a
+# flags change) must not overwrite its published fingerprint — a slow
+# peer gathering after the overwrite would compare mixed programs and
+# spuriously refuse. The exchange covers the FIRST grad-bearing build
+# of each generation (the job-start contract); later builds still run
+# the local self-check.
+_EXCHANGED = set()
+_EXCHANGED_LOCK = threading.Lock()
+
+_ENV_STATE = "PADDLE_TPU_ELASTIC_STATE"
+_ENV_RANK = "PADDLE_TPU_PROCESS_ID"
+_ENV_WORLD = "PADDLE_TPU_NUM_PROCESSES"
+_ENV_GEN = "PADDLE_TPU_ELASTIC_GENERATION"
+
+
+def fingerprint_dir(state_dir):
+    return os.path.join(state_dir, "fingerprints")
+
+
+def _path(state_dir, generation, rank):
+    return os.path.join(fingerprint_dir(state_dir),
+                        "gen%d-rank%d.json" % (int(generation), int(rank)))
+
+
+def publish_fingerprint(state_dir, rank, fingerprint, generation=0,
+                        meta=None):
+    """Atomically write this rank's fingerprint record. Returns the
+    path."""
+    os.makedirs(fingerprint_dir(state_dir), exist_ok=True)
+    path = _path(state_dir, generation, rank)
+    tmp = path + ".tmp.%d" % os.getpid()
+    with open(tmp, "w") as f:
+        json.dump({"rank": int(rank), "generation": int(generation),
+                   "fingerprint": str(fingerprint),
+                   "meta": meta or {}}, f)
+    os.replace(tmp, path)
+    return path
+
+
+def gather_fingerprints(state_dir, world, generation=0, timeout_sec=30.0,
+                        poll_sec=0.05):
+    """Wait (bounded) for every rank's record of this generation and
+    return {rank: fingerprint} for those that arrived — possibly
+    incomplete after ``timeout_sec``; the caller decides whether a
+    partial set is acceptable."""
+    deadline = time.monotonic() + float(timeout_sec)
+    out = {}
+    while True:
+        for rank in range(int(world)):
+            if rank in out:
+                continue
+            path = _path(state_dir, generation, rank)
+            try:
+                with open(path) as f:
+                    rec = json.load(f)
+                out[rank] = str(rec["fingerprint"])
+            except (OSError, ValueError, KeyError):
+                continue  # not written yet / mid-rename: poll again
+        if len(out) >= int(world) or time.monotonic() >= deadline:
+            return out
+        time.sleep(poll_sec)
+
+
+def check_replica_schedule(template, policy=None, axis_size=None,
+                           overlap=None, env=None, timeout_sec=None):
+    """The job-start gate: compute this replica's collective program
+    fingerprint from its grads ``template`` (the same
+    ``comm_rules.verify_comm`` pass — local errors raise immediately),
+    publish it, gather the peers', and refuse on divergence.
+
+    Reads the elastic contract from the environment (``env`` overrides
+    for tests): no ``PADDLE_TPU_ELASTIC_STATE``, a world of 1, or an
+    unparsable rank means there is nothing to exchange — returns the
+    local fingerprint and does nothing else, so single-process runs and
+    the fail-fast launcher pay zero cost.
+
+    Raises :class:`paddle_tpu.analysis.ProgramVerifyError` (PT020) on
+    divergence — the readable refusal, BEFORE the first collective
+    rendezvous that would otherwise deadlock."""
+    from ..analysis import comm_rules
+    from ..analysis.diagnostics import ProgramVerifyError
+    from ..resilience import record_event
+
+    env = os.environ if env is None else env
+    state_dir = env.get(_ENV_STATE, "")
+    try:
+        world = int(env.get(_ENV_WORLD, "1"))
+        rank = int(env.get(_ENV_RANK, "0"))
+        generation = int(env.get(_ENV_GEN, "0") or 0)
+    except ValueError:
+        return None  # parallel.env validates and raises readably; not us
+    # local self-check first: a replica whose OWN sequence is broken
+    # must not publish it as if it were an agreed program
+    diags, fp = comm_rules.verify_comm(template, policy=policy,
+                                       axis_size=axis_size,
+                                       overlap=overlap)
+    if any(d.is_error for d in diags):
+        raise ProgramVerifyError(
+            diags, context="collective self-check before the "
+                           "fingerprint exchange (rank %d)" % rank)
+    if not state_dir or world <= 1 or fp is None:
+        return fp
+    token = (os.path.abspath(state_dir), generation, rank)
+    if timeout_sec is None:
+        # an unparsable override must not become a new failure mode
+        # (the module's whole posture): fall back to the default
+        try:
+            timeout_sec = float(env.get("PADDLE_TPU_FINGERPRINT_TIMEOUT",
+                                        "30"))
+        except ValueError:
+            timeout_sec = 30.0
+    # the WHOLE exchange runs under the latch lock: a second
+    # grad-bearing build racing in this process must not publish over
+    # the record mid-gather (a slow peer would compare mixed
+    # programs) — it waits here, then sees the latch and returns
+    with _EXCHANGED_LOCK:
+        if token in _EXCHANGED:
+            return fp  # first grad-bearing build already exchanged
+        publish_fingerprint(state_dir, rank, fp, generation=generation,
+                            meta={"axis_size": axis_size,
+                                  "overlap": bool(overlap)})
+        got = gather_fingerprints(state_dir, world,
+                                  generation=generation,
+                                  timeout_sec=timeout_sec)
+        if len(got) < world:
+            # a slow peer is a monitoring gap, not a refusal
+            record_event("fingerprint_exchange_incomplete",
+                         state_dir=state_dir, generation=generation,
+                         have=sorted(got), world=world)
+            _EXCHANGED.add(token)
+            return fp
+        divergence = comm_rules.check_replica_fingerprints(got)
+        if divergence:
+            by_fp = {}
+            for r, f in sorted(got.items()):
+                by_fp.setdefault(f, []).append(r)
+            detail = "; ".join("ranks %s -> %s" % (rs, f)
+                               for f, rs in sorted(by_fp.items(),
+                                                   key=lambda kv: kv[1]))
+            record_event("fingerprint_divergence",
+                         generation=generation, detail=detail)
+            # the token is NOT latched: a refused exchange stays
+            # retryable (e.g. after the operator fixes the flag)
+            raise ProgramVerifyError(
+                divergence,
+                context="schedule-fingerprint exchange at job start "
+                        "(generation %d): %s — refusing the first "
+                        "collective" % (generation, detail))
+        _EXCHANGED.add(token)
+    return fp

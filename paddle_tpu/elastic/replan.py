@@ -37,7 +37,7 @@ class ElasticPlan(object):
     """Resolved topology + comm plan for one world size (immutable)."""
 
     __slots__ = ("world_size", "chips_per_host", "hosts", "dp", "policy",
-                 "degraded")
+                 "degraded", "memory_audit")
 
     def __init__(self, world_size, chips_per_host, hosts, policy,
                  degraded=False):
@@ -47,6 +47,7 @@ class ElasticPlan(object):
         self.dp = self.world_size * self.chips_per_host
         self.policy = policy
         self.degraded = bool(degraded)
+        self.memory_audit = None  # set by audit_memory()
 
     def groups(self):
         """(intra-host groups, inter-host ring pairs) the hierarchical
@@ -92,6 +93,50 @@ class ElasticPlan(object):
                     hint="call plan.apply_flags() after every resize "
                          "re-plan"))
         return diags
+
+    def audit_memory(self, program, global_batch, budget_bytes=None,
+                     fetches=None):
+        """Post-resize per-device memory audit (analysis.memory): the
+        GLOBAL batch redistributes over this plan's (smaller) dp, so
+        each survivor's per-device batch — and with it the activation
+        and feed residency — GROWS. A resize that re-plans the comm
+        topology but overflows HBM would only fail later, as an
+        unreadable OOM inside the first resumed step; this prices it
+        up front and records ``elastic_degraded`` with the predicted
+        overflow instead. Never raises: like the comm-topology audit,
+        prediction is advisory — the supervisor keeps its survivors
+        and the operator gets the number. Returns the audit dict
+        (also stored as ``plan.memory_audit``)."""
+        from ..analysis import memory as _mem
+        from .. import profiler as _prof
+        budget = (budget_bytes if budget_bytes is not None
+                  else _mem.resolve_budget_bytes())
+        plan = _mem.plan_memory(program, batch=int(global_batch),
+                                fetches=fetches, dp=self.dp, vmem=False)
+        audit = {
+            "world_size": self.world_size,
+            "dp": self.dp,
+            "global_batch": int(global_batch),
+            "per_device_batch": plan.batch,
+            "predicted_peak_bytes": plan.peak_bytes,
+            "peak_op": plan.peak_op_ref(),
+            "budget_bytes": budget,
+            "fits": (budget is None or plan.peak_bytes <= budget),
+            "exact": plan.exact,
+        }
+        _prof.update_memory_counters(
+            mem_plans=1, mem_predicted_peak_bytes=plan.peak_bytes)
+        if budget is not None and plan.peak_bytes > budget:
+            record_event(
+                "elastic_degraded", site="elastic.memory",
+                world_size=self.world_size,
+                predicted_peak_bytes=plan.peak_bytes,
+                budget_bytes=budget,
+                overflow_bytes=plan.peak_bytes - budget,
+                peak_op=plan.peak_op_ref(),
+                per_device_batch=plan.batch)
+        self.memory_audit = audit
+        return audit
 
     def apply_flags(self):
         """Install the plan's topology into the process flags (the one
@@ -141,12 +186,20 @@ class ElasticPlan(object):
 
 
 def replan(world_size, chips_per_host=1, base=None, quant=None,
-           bucket_mb=None, split_ratio=None):
+           bucket_mb=None, split_ratio=None, program=None,
+           global_batch=None, memory_budget_bytes=None):
     """Recompute the (host, chip) factorisation + comm policy for a
     world of ``world_size`` processes with ``chips_per_host`` local
     chips each. Unset policy fields resolve from flags (the same
     resolution every step builder uses), EXCEPT ``hosts`` which this
-    function owns — that is the re-plan."""
+    function owns — that is the re-plan.
+
+    ``program`` + ``global_batch``: additionally audit the post-resize
+    per-device memory residency (:meth:`ElasticPlan.audit_memory`) —
+    the global batch over fewer workers means bigger per-device
+    activations, and an over-budget prediction records
+    ``elastic_degraded`` with the overflow instead of letting the
+    resumed generation OOM."""
     from .. import comm
 
     world_size = int(world_size)
@@ -187,4 +240,7 @@ def replan(world_size, chips_per_host=1, base=None, quant=None,
                                        axis_size=dp)
             plan = ElasticPlan(world_size, chips_per_host, 1, flat,
                                degraded=True)
+    if program is not None and global_batch is not None:
+        plan.audit_memory(program, global_batch,
+                          budget_bytes=memory_budget_bytes)
     return plan

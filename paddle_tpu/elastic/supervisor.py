@@ -304,6 +304,21 @@ class ElasticSupervisor(object):
     def run(self):
         from .. import profiler as _prof
 
+        # a relaunch reusing --state-dir restarts the generation counter
+        # at 0, so a PREVIOUS run's fingerprint records (gen0-rank*.json)
+        # would collide with this job's exchange — a stale divergent
+        # record could spuriously refuse a corrected job, a stale match
+        # could mask a real divergence. The supervisor owns the state
+        # dir: clear the exchange before any worker publishes
+        if self.state_dir:
+            from .fingerprints import fingerprint_dir
+            fdir = fingerprint_dir(self.state_dir)
+            if os.path.isdir(fdir):
+                for fn in os.listdir(fdir):
+                    try:
+                        os.unlink(os.path.join(fdir, fn))
+                    except OSError:
+                        pass  # a racing writer: its fresh record stands
         master = None
         if self.master_tasks is not None:
             master = TaskMasterHost(self.master_tasks,

@@ -217,7 +217,22 @@ DEFINE_bool("verify", False,
             "PT-code diagnostic list instead of a cryptic trace error. "
             "When the Executor takes the explicit-collective path this "
             "also runs the PT020-PT023 collective-consistency pass over "
-            "the traced grad set")
+            "the traced grad set, and every fresh compile runs the "
+            "static memory preflight (analysis.memory, PT030): a "
+            "program whose predicted peak HBM exceeds the budget "
+            "raises with the residency table BEFORE the XLA compile "
+            "instead of dying in an unreadable device OOM")
+DEFINE_float("memory_budget_gb", 0.0,
+             "per-device HBM budget (GiB) the static memory planner "
+             "checks predicted peaks against (lint --memory, the "
+             "executor preflight under PADDLE_TPU_VERIFY, the elastic "
+             "post-resize audit, PT034 KV-pool sizing). 0 = autodetect "
+             "from device.memory_stats()['bytes_limit'] (present on "
+             "TPU; usually absent on CPU, where the checks then stay "
+             "silent). The CLI --budget-gb overrides per run. The "
+             "estimate is static — it ignores XLA fusion/remat and "
+             "allocator fragmentation, so a predicted fit is a lower "
+             "bound, not a guarantee (doc/diagnostics.md)")
 DEFINE_string("sanitize", "",
               "runtime sanitizer modes, comma-separated (union with the "
               "PADDLE_TPU_SANITIZE env var): 'alias' arms the "

@@ -304,9 +304,24 @@ def is_generative_artifact(dirname):
     return os.path.isfile(os.path.join(dirname, GEN_CONFIG_FILE))
 
 
-def validate_generative_artifact(dirname):
+def validate_generative_artifact(dirname, kv_pages=None, page_tokens=None,
+                                 budget_bytes=None, check_pool=True):
     """Problem list (empty = valid) for a generative artifact — the
-    validate_artifact contract for the autoregressive tier."""
+    validate_artifact contract for the autoregressive tier.
+
+    Also runs the PT034 KV-pool sizing check (analysis.memory) when a
+    per-device budget is known (``budget_bytes``, else
+    ``FLAGS.memory_budget_gb``; silent when neither is set — the CPU
+    devbox default): the pool the engine would preallocate for this
+    model at ``kv_pages`` x ``page_tokens`` (defaults
+    ``FLAGS.serve_kv_pages`` / ``FLAGS.serve_page_tokens``) plus the
+    resident weights must fit — caught at validate time, not as an
+    allocation failure after the replica warmed up. Callers that know
+    the real deployment geometry must pass it (the serve/route CLIs
+    forward their --kv_pages/--page_tokens overrides);
+    ``check_pool=False`` skips the sizing leg entirely — the
+    artifact-integrity contract for loaders that validated geometry
+    elsewhere."""
     if not os.path.isdir(dirname):
         return ["artifact directory %r does not exist (expected the "
                 "directory export_generative wrote)" % dirname]
@@ -318,7 +333,73 @@ def validate_generative_artifact(dirname):
             problems.append("missing %s (%s)" % (fname, role))
         elif os.path.getsize(path) == 0:
             problems.append("%s is empty (%s)" % (fname, role))
+    if not problems and check_pool:
+        problems += _kv_pool_problems(dirname, kv_pages=kv_pages,
+                                      page_tokens=page_tokens,
+                                      budget_bytes=budget_bytes)
     return problems
+
+
+def _gen_geometry(dirname, kv_pages=None, page_tokens=None):
+    """The ONE reader of a generative artifact's sizing inputs:
+    ``(layers, heads, head_dim, model_bytes, kv_pages, page_tokens)``
+    with the pool knobs defaulted from flags, or None when the
+    artifact is unreadable (integrity problems are the validator's
+    findings, not ours). Shared by the per-model PT034 check and the
+    serve CLI's aggregate check so the two can never diverge on what
+    geometry they price."""
+    from .flags import FLAGS
+    try:
+        with open(os.path.join(dirname, GEN_CONFIG_FILE)) as f:
+            cfg = json.load(f)["config"]
+        hidden, heads = int(cfg["hidden"]), int(cfg["num_heads"])
+        layers = int(cfg["num_layers"])
+        model_bytes = os.path.getsize(os.path.join(dirname,
+                                                   GEN_PARAMS_FILE))
+    except Exception:
+        return None
+    return (layers, heads, hidden // max(heads, 1), model_bytes,
+            kv_pages if kv_pages else FLAGS.serve_kv_pages,
+            page_tokens if page_tokens else FLAGS.serve_page_tokens)
+
+
+def generative_memory_bytes(dirname, kv_pages=None, page_tokens=None):
+    """Resident bytes one generative artifact costs a serve process:
+    model weights (params file size) + the KV page pool the engine
+    would preallocate at ``kv_pages`` x ``page_tokens`` (defaults from
+    flags). None when the artifact is unreadable. Used by the
+    serve/route CLIs to check the AGGREGATE of co-hosted models
+    against the budget (each model alone fitting proves nothing about
+    the process)."""
+    from .analysis import memory as _mem
+    geo = _gen_geometry(dirname, kv_pages=kv_pages,
+                        page_tokens=page_tokens)
+    if geo is None:
+        return None
+    layers, heads, head_dim, model_bytes, pages, ptokens = geo
+    return int(model_bytes) + _mem.kv_pool_bytes(layers, heads, head_dim,
+                                                 pages, ptokens)
+
+
+def _kv_pool_problems(dirname, kv_pages=None, page_tokens=None,
+                      budget_bytes=None):
+    """PT034 leg of validate_generative_artifact: best-effort (a
+    malformed config JSON is load_generative's finding, not ours),
+    [] when no budget is known."""
+    from .analysis import memory as _mem
+    budget = (int(budget_bytes) if budget_bytes
+              else _mem.resolve_budget_bytes())
+    if not budget:
+        return []
+    geo = _gen_geometry(dirname, kv_pages=kv_pages,
+                        page_tokens=page_tokens)
+    if geo is None:
+        return []
+    layers, heads, head_dim, model_bytes, pages, ptokens = geo
+    diags = _mem.check_kv_pool(layers, heads, head_dim, pages, ptokens,
+                               model_bytes=model_bytes,
+                               budget_bytes=budget)
+    return [str(d) for d in diags]
 
 
 def export_generative(dirname, config, scope=None, params=None):
@@ -353,7 +434,13 @@ def load_generative(dirname):
     (params device-resident). Raises :class:`ArtifactError` with every
     problem named, the load_compiled convention."""
     from .models import transformer as _tm
-    problems = validate_generative_artifact(dirname)
+    # integrity only: the loader does not know the DEPLOYMENT's pool
+    # geometry (max_running/kv_pages live in the engine kwargs), so
+    # re-running PT034 here against the flag defaults would refuse a
+    # fitting override — or wave through an oversized one. Sizing
+    # belongs to validate time with the real geometry (the serve/route
+    # CLIs forward theirs); the pool allocation itself is loud anyway
+    problems = validate_generative_artifact(dirname, check_pool=False)
     if problems:
         raise ArtifactError(
             "cannot load generative artifact %r:\n  - %s"

@@ -24,7 +24,10 @@ __all__ = ["memory_optimize", "release_memory", "ControlFlowGraph"]
 
 class ControlFlowGraph(object):
     """Liveness over a block's op list (reference: the class of the same
-    name, memory_optimization_transpiler.py)."""
+    name, memory_optimization_transpiler.py). The dataflow solve itself
+    lives in ``analysis.memory.compute_liveness`` — the ONE liveness
+    implementation, shared with the static memory planner's residency
+    timeline (PT030-PT033)."""
 
     def __init__(self, program: ir.Program):
         self.program = program
@@ -39,19 +42,9 @@ class ControlFlowGraph(object):
         self.live_out: List[Set[str]] = [set() for _ in range(n)]
 
     def analyze(self):
-        changed = True
-        n = len(self.ops)
-        while changed:
-            changed = False
-            for i in range(n - 1, -1, -1):
-                out = set()
-                if i + 1 < n:
-                    out = set(self.live_in[i + 1])
-                new_in = self.uses[i] | (out - self.defs[i])
-                if new_in != self.live_in[i] or out != self.live_out[i]:
-                    self.live_in[i] = new_in
-                    self.live_out[i] = out
-                    changed = True
+        from .analysis.memory import compute_liveness
+        self.live_in, self.live_out = compute_liveness(self.uses,
+                                                       self.defs)
         return self
 
     def reuse_pairs(self) -> List[Tuple[str, str]]:
@@ -101,6 +94,8 @@ def memory_optimize(input_program: ir.Program, print_log=False, level=0,
     (selective checkpointing). Default: the activation-heavy set
     DEFAULT_REMAT_TYPES; pass True for every op (the old global flag),
     False (or an empty iterable) for none, or an iterable of type names."""
+    from .analysis.memory import plan_memory
+    peak_before = plan_memory(input_program, vmem=False).peak_bytes
     cfg = ControlFlowGraph(input_program).analyze()
     pairs = cfg.reuse_pairs()
     input_program._memory_optimized = True
@@ -123,6 +118,16 @@ def memory_optimize(input_program: ir.Program, print_log=False, level=0,
     # this does not tax the training-setup path it runs on)
     from .analysis import check_after_pass
     check_after_pass(input_program, "memory_optimize")
+    # ...and that a pass whose whole purpose is memory never INCREASED
+    # the predicted peak — the regression the pre-planner code could
+    # not see (today the pass only marks remat, so the peaks are equal;
+    # this pins the contract for any future rewriting variant)
+    peak_after = plan_memory(input_program, vmem=False).peak_bytes
+    if peak_after > peak_before:
+        raise RuntimeError(
+            "memory_optimize INCREASED the predicted peak HBM: %d -> %d "
+            "bytes — the pass violated its own contract"
+            % (peak_before, peak_after))
     return pairs
 
 

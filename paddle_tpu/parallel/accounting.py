@@ -23,7 +23,7 @@ import json
 import numpy as np
 
 __all__ = ["collective_bytes", "scaling_table", "DTYPE_BYTES",
-           "comm_policy_table"]
+           "comm_policy_table", "memory_table"]
 
 DTYPE_BYTES = {"float32": 4, "float16": 2, "bfloat16": 2, "int64": 8,
                "int32": 4}
@@ -136,6 +136,26 @@ def comm_policy_table(program, specs, mesh_shape, dtype_bytes=4,
                                  hosts=hosts, bucket_mb=bucket_mb,
                                  split_ratio=split_ratio),
     }
+
+
+def memory_table(program, mesh_shape, batch=16, fetches=None):
+    """Per-device HBM residency columns for the ``paddle_tpu
+    accounting`` CLI — params / optimizer state / gradients /
+    activations / feeds and the predicted peak (with its high-water
+    op), beside the comm-bytes table. Delegates to the shared
+    liveness pass (``analysis.memory.plan_memory``): the batch shards
+    over the ``dp`` axis ONLY, params replicate — same contract as
+    ``lint --memory``, with any other mesh axes reported in
+    ``ignored_axes`` rather than silently changing the model (a tp
+    axis shards params, which this pass does not price). Pure
+    analysis — nothing is compiled or executed."""
+    dp = mesh_shape.get("dp", 1)
+    from ..analysis.memory import plan_memory
+    plan = plan_memory(program, batch=batch, fetches=fetches, dp=dp)
+    out = plan.summary()
+    out["data_axis"] = "dp" if "dp" in mesh_shape else None
+    out["ignored_axes"] = sorted(a for a in mesh_shape if a != "dp")
+    return out
 
 
 def pipeline_accounting(n_micro, pp, act_bytes_per_micro):

@@ -302,8 +302,28 @@ def data_parallel_step_fn(loss_fn, mesh: Optional[Mesh] = None,
 
     rep = P()
     xspec = P(axis_name)
+    exchanged = []  # once-cell: the trace-time fingerprint exchange
 
     def step(params, comm_state, x, y, lr):
+        # elastic job start (paddle_tpu launch --elastic --state-dir):
+        # publish this replica's schedule_fingerprint and check the
+        # peers' BEFORE the first collective is even traced — a rank
+        # launched under divergent comm flags refuses here with one
+        # readable PT020 error naming both fingerprints, instead of
+        # deadlocking the pod at the first mismatched rendezvous.
+        # Runs in the tracing first call (host-side, once); inert
+        # without the elastic env contract, so every other caller of
+        # this builder pays nothing
+        import os as _os
+        if not exchanged and _os.environ.get("PADDLE_TPU_ELASTIC_STATE"):
+            from ..elastic.fingerprints import check_replica_schedule
+            tpl = jax.tree_util.tree_map(
+                lambda p: jax.ShapeDtypeStruct(jnp.shape(p),
+                                               jnp.result_type(p)),
+                params)
+            check_replica_schedule(tpl, policy=policy, axis_size=n_dev,
+                                   overlap=use_overlap)
+            exchanged.append(True)
         pspecs = jax.tree_util.tree_map(lambda _: rep, params)
         sspecs = jax.tree_util.tree_map(lambda _: rep, comm_state)
         smapped = comm.shard_map(

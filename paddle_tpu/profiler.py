@@ -30,7 +30,9 @@ __all__ = ["timer", "stat_summary", "print_stats", "reset_stats",
            "update_generation_counters", "generation_counters",
            "reset_generation_counters",
            "update_router_counters", "router_counters",
-           "reset_router_counters"]
+           "reset_router_counters",
+           "update_memory_counters", "memory_counters",
+           "reset_memory_counters"]
 
 _enabled = False
 _records = defaultdict(list)  # label -> [seconds]
@@ -43,6 +45,7 @@ _tune_counters = defaultdict(float)      # kernel-autotuning observability
 _elastic_counters = defaultdict(float)   # elasticity observability
 _generation_counters = defaultdict(float)  # autoregressive-serving observability
 _router_counters = defaultdict(float)     # multi-replica-router observability
+_memory_counters = defaultdict(float)     # static-memory-planner observability
 _T0 = time.perf_counter()
 
 
@@ -236,6 +239,36 @@ def reset_generation_counters():
     _generation_counters.clear()
 
 
+_MEM_MAX_KEYS = frozenset(("mem_predicted_peak_bytes",
+                           "mem_measured_live_bytes"))
+
+
+def update_memory_counters(**counters):
+    """Accumulate static-memory-planner observability counters
+    (paddle_tpu.analysis.memory; a few dict adds per PREFLIGHT/plan
+    build — once per fresh compile, never per step). Keys in use:
+    ``mem_preflights`` (executor pre-compile checks run),
+    ``mem_plans`` (lint/accounting/elastic plan builds),
+    ``mem_predicted_peak_bytes`` and ``mem_measured_live_bytes``
+    (``jax.live_arrays`` evidence) — both kept as maxima, so the
+    timeline's ``memory`` section reads as the run's high-water
+    predicted-vs-actual pair."""
+    for k, v in counters.items():
+        if k in _MEM_MAX_KEYS:
+            _memory_counters[k] = max(_memory_counters[k], float(v))
+        else:
+            _memory_counters[k] += float(v)
+
+
+def memory_counters():
+    """Snapshot {counter: value} of the static-memory-planner counters."""
+    return dict(_memory_counters)
+
+
+def reset_memory_counters():
+    _memory_counters.clear()
+
+
 _ROUTER_MAX_KEYS = frozenset(("router_peak_load", "router_replicas"))
 
 
@@ -368,6 +401,9 @@ def write_timeline(path):
       failovers, health ejects/readmits, rolling-reload outcomes,
       replica restarts, peak load score) — the fleet evidence for
       paddle_tpu.serving.router.
+    - ``memory``: static-memory-planner counters (preflights/plans run,
+      predicted peak vs ``jax.live_arrays`` measured high-water — the
+      predicted-vs-actual evidence for paddle_tpu.analysis.memory).
     """
     import json
     rows = []
@@ -390,6 +426,7 @@ def write_timeline(path):
         "elastic": dict(_elastic_counters),
         "generation": dict(_generation_counters),
         "router": dict(_router_counters),
+        "memory": dict(_memory_counters),
     }
     with open(path, "w") as f:
         json.dump(artifact, f, indent=1)

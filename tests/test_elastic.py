@@ -576,3 +576,160 @@ def test_chaos_kill_one_of_four_resumes_on_survivors(tmp_path):
     assert cr.check_exactly_once(report) == []
     assert cr.check_continuity(report) == []
     assert cr.check_replan(report) == []
+
+
+# ---------------------------------------------------------------------------
+# cross-replica schedule-fingerprint exchange at job start (PR-12's open
+# follow-on): ranks publish into --state-dir, divergence refuses the
+# first collective with a readable PT020 error naming both fingerprints
+
+
+def _fp_env(state_dir, rank=0, world=2, generation=0):
+    return {"PADDLE_TPU_ELASTIC_STATE": str(state_dir),
+            "PADDLE_TPU_NUM_PROCESSES": str(world),
+            "PADDLE_TPU_PROCESS_ID": str(rank),
+            "PADDLE_TPU_ELASTIC_GENERATION": str(generation)}
+
+
+def _template(n=4):
+    import jax
+    return {"p%d@GRAD" % i: jax.ShapeDtypeStruct((256,),
+                                                 np.dtype("float32"))
+            for i in range(n)}
+
+
+def _peer_fp(tpl, policy, axis_size):
+    from paddle_tpu.analysis import comm_rules
+    diags, fp = comm_rules.verify_comm(tpl, policy, axis_size=axis_size)
+    assert not diags and fp
+    return fp
+
+
+def test_fingerprint_clean_exchange(tmp_path):
+    from paddle_tpu.comm import CommPolicy
+    from paddle_tpu.elastic import fingerprints as fps
+    tpl = _template()
+    pol = CommPolicy(base="fused", bucket_bytes=1024)
+    fps.publish_fingerprint(str(tmp_path), 1, _peer_fp(tpl, pol, 8))
+    fp = fps.check_replica_schedule(
+        tpl, policy=pol, axis_size=8, overlap=False,
+        env=_fp_env(tmp_path), timeout_sec=5)
+    assert fp == _peer_fp(tpl, pol, 8)
+
+
+def test_fingerprint_divergence_refuses_with_both_named(tmp_path):
+    from paddle_tpu.analysis import ProgramVerifyError
+    from paddle_tpu.comm import CommPolicy
+    from paddle_tpu.elastic import fingerprints as fps
+    R.clear_events()
+    tpl = _template()
+    pol_mine = CommPolicy(base="fused", bucket_bytes=1024)
+    pol_peer = CommPolicy(base="fused", bucket_bytes=256)  # stale flag
+    peer = _peer_fp(tpl, pol_peer, 8)
+    fps.publish_fingerprint(str(tmp_path), 1, peer)
+    with pytest.raises(ProgramVerifyError) as ei:
+        fps.check_replica_schedule(
+            tpl, policy=pol_mine, axis_size=8, overlap=False,
+            env=_fp_env(tmp_path), timeout_sec=5)
+    msg = str(ei.value)
+    mine = _peer_fp(tpl, pol_mine, 8)
+    assert "PT020" in msg and "refusing the first collective" in msg
+    assert mine in msg and peer in msg  # names BOTH fingerprints
+    assert R.events("fingerprint_divergence")
+    R.clear_events()
+
+
+def test_fingerprint_incomplete_exchange_is_advisory(tmp_path):
+    from paddle_tpu.comm import CommPolicy
+    from paddle_tpu.elastic import fingerprints as fps
+    R.clear_events()
+    tpl = _template()
+    pol = CommPolicy(base="fused", bucket_bytes=1024)
+    # world of 3, nobody else publishes: a slow peer must not convert
+    # the monitoring feature into a new failure mode
+    fp = fps.check_replica_schedule(
+        tpl, policy=pol, axis_size=8, overlap=False,
+        env=_fp_env(tmp_path, rank=0, world=3), timeout_sec=0.2)
+    assert fp
+    evs = R.events("fingerprint_exchange_incomplete")
+    assert evs and evs[0]["world"] == 3 and evs[0]["have"] == [0]
+    R.clear_events()
+
+
+def test_fingerprint_inert_without_elastic_env(tmp_path):
+    from paddle_tpu.comm import CommPolicy
+    from paddle_tpu.elastic import fingerprints as fps
+    tpl = _template()
+    pol = CommPolicy(base="fused", bucket_bytes=1024)
+    fp = fps.check_replica_schedule(tpl, policy=pol, axis_size=8,
+                                    overlap=False, env={})
+    assert fp  # the local fingerprint still comes back
+    assert not os.path.isdir(fps.fingerprint_dir(str(tmp_path)))
+
+
+def test_step_fn_refuses_first_collective_on_divergence(
+        tmp_path, monkeypatch, forced_cpu_devices):
+    """The wiring leg: a data_parallel_step_fn built under the elastic
+    env contract runs the exchange in its tracing first call — a peer
+    rank launched with a divergent comm flag makes the FIRST step
+    raise readably, before any collective rendezvous."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.analysis import ProgramVerifyError
+    from paddle_tpu.comm import CommPolicy
+    from paddle_tpu.elastic import fingerprints as fps
+    from paddle_tpu.parallel import data_parallel_step_fn
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    def loss_fn(params, x, y):
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    tpl = jax.tree_util.tree_map(
+        lambda p: jax.ShapeDtypeStruct(jnp.shape(p),
+                                       jnp.result_type(p)), params)
+    peer_pol = CommPolicy(base="fused", bucket_bytes=256)
+    fps.publish_fingerprint(str(tmp_path), 1,
+                            _peer_fp(tpl, peer_pol, 2))
+    for k, v in _fp_env(tmp_path, rank=0, world=2).items():
+        monkeypatch.setenv(k, v)
+    monkeypatch.setenv("PADDLE_TPU_FINGERPRINT_TIMEOUT", "5")
+    mesh = make_mesh({"dp": 2}, devices=forced_cpu_devices[:2])
+    with flags_guard(comm_policy="fused", comm_bucket_mb=4.0,
+                     comm_overlap=False):
+        step, state0_fn = data_parallel_step_fn(loss_fn, mesh=mesh,
+                                                axis_name="dp")
+        state = state0_fn(params)
+        x = jnp.ones((8, 4), jnp.float32)
+        y = jnp.ones((8,), jnp.float32)
+        with pytest.raises(ProgramVerifyError) as ei:
+            step(params, state, x, y, 0.01)
+    assert "refusing the first collective" in str(ei.value)
+
+
+def test_fingerprint_exchange_latches_once_per_generation(tmp_path):
+    """A later grad-bearing build in the same process must not
+    overwrite the agreed job-start record (a slow peer would compare
+    mixed programs) — but only a SUCCESSFUL exchange latches."""
+    from paddle_tpu.comm import CommPolicy
+    from paddle_tpu.elastic import fingerprints as fps
+    tpl = _template()
+    pol = CommPolicy(base="fused", bucket_bytes=1024)
+    fps.publish_fingerprint(str(tmp_path), 1, _peer_fp(tpl, pol, 8))
+    env = _fp_env(tmp_path)
+    fp1 = fps.check_replica_schedule(tpl, policy=pol, axis_size=8,
+                                     overlap=False, env=env,
+                                     timeout_sec=5)
+    assert fp1
+    rank0 = os.path.join(fps.fingerprint_dir(str(tmp_path)),
+                         "gen0-rank0.json")
+    before = open(rank0).read()
+    # second build, different policy: would diverge, but the exchange
+    # already completed for this generation — local check only, the
+    # published record stays untouched
+    pol2 = CommPolicy(base="fused", bucket_bytes=256)
+    fp2 = fps.check_replica_schedule(tpl, policy=pol2, axis_size=8,
+                                     overlap=False, env=env,
+                                     timeout_sec=5)
+    assert fp2 and fp2 != fp1
+    assert open(rank0).read() == before

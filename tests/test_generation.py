@@ -318,8 +318,17 @@ def test_queue_overload_sheds_now(model):
     with _engine(model, max_running=1, queue_depth=2) as eng:
         resilience.arm("serving.generate", action="delay", delay=0.25,
                        nth=1, times=None)
-        handles = [eng.submit([1, 2], max_new_tokens=6)
-                   for _ in range(3)]       # 1 running + 2 queued
+        first = eng.submit([1, 2], max_new_tokens=6)
+        # the engine thread must DEQUEUE the first request before the
+        # next two fill the depth-2 queue — under full-suite load it
+        # can be scheduled late, and the 3rd submit would then shed
+        # (observed ~1/5 full runs); admission itself is what's under
+        # test, not the engine thread's scheduling latency
+        deadline = time.time() + 30
+        while eng.stats["queued"] and time.time() < deadline:
+            time.sleep(0.005)
+        handles = [first] + [eng.submit([1, 2], max_new_tokens=6)
+                             for _ in range(2)]  # 1 running + 2 queued
         with pytest.raises(OverloadError):
             for _ in range(4):              # depth check is racy by one
                 eng.submit([3, 4], max_new_tokens=6)

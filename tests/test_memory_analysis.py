@@ -1,0 +1,450 @@
+"""Static memory planner (paddle_tpu.analysis.memory, PT030-PT034).
+
+Same contract shape as test_analysis.py: zero false positives on every
+well-formed builder at a generous budget, one golden test per PT code,
+plus the four integration choke points — lint --memory CLI, the
+Executor pre-compile preflight under PADDLE_TPU_VERIFY, the elastic
+post-resize audit, and the accounting memory columns — and the
+memory_optimize rebase onto the shared liveness pass.
+"""
+import gc
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import analysis, layers
+from paddle_tpu.analysis import ProgramVerifyError
+from paddle_tpu.analysis import memory as mem
+from paddle_tpu.core import ir
+from paddle_tpu.flags import FLAGS, flags_guard
+
+
+def codes(diags):
+    return sorted({d.code for d in diags})
+
+
+def _build_train_program(size=4, feat=13):
+    """fit-a-line-shaped train step: forward + backward + SGD."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data(name="x", shape=[feat], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        pred = layers.fc(input=x, size=size, act=None)
+        cost = layers.mean(layers.square_error_cost(input=pred, label=y))
+        pt.optimizer.Momentum(learning_rate=0.01,
+                              momentum=0.9).minimize(cost)
+    return main, startup, cost
+
+
+# ---------------------------------------------------------------------------
+# the plan itself
+
+
+def test_plan_classifies_and_prices_the_train_step():
+    main, _startup, cost = _build_train_program()
+    plan = mem.plan_memory(main, batch=16, fetches=[cost])
+    cb = plan.class_bytes
+    # params: fc W [13,4] + b [4]; momentum adds velocity slots
+    assert cb["params"] == (13 * 4 + 4) * 4
+    assert cb["optimizer_state"] >= (13 * 4 + 4) * 4  # velocities (+lr)
+    assert cb["gradients"] > 0 and cb["activations"] > 0
+    assert cb["feeds"] == 16 * (13 + 1) * 4
+    assert plan.exact and plan.peak_bytes > cb["params"]
+    # the high-water mark of a train step sits in the backward chain
+    assert plan.peak_op is not None
+    assert "block0:op" in plan.peak_op_ref()
+    assert plan.top_residents(3)
+    assert "peak" in plan.table()
+
+
+def test_plan_shards_batch_over_dp_but_replicates_params():
+    main, _startup, cost = _build_train_program()
+    p1 = mem.plan_memory(main, batch=16, fetches=[cost], dp=1)
+    p4 = mem.plan_memory(main, batch=16, fetches=[cost], dp=4)
+    assert p4.class_bytes["feeds"] * 4 == p1.class_bytes["feeds"]
+    assert p4.class_bytes["params"] == p1.class_bytes["params"]
+    assert p4.peak_bytes < p1.peak_bytes
+
+
+def test_fetched_var_lives_to_step_end():
+    main, _startup, cost = _build_train_program()
+    plan = mem.plan_memory(main, batch=16, fetches=[cost])
+    rec = plan.records[cost.name]
+    assert rec.end == plan.n_ops - 1
+
+
+def test_compute_liveness_matches_cfg_contract():
+    # the shared dataflow solve the transpiler's ControlFlowGraph uses
+    uses = [set(), {"a"}, {"b"}]
+    defs = [{"a"}, {"b"}, {"c"}]
+    live_in, live_out = mem.compute_liveness(uses, defs)
+    assert live_out[0] == {"a"} and live_in[1] == {"a"}
+    assert live_out[1] == {"b"} and live_in[2] == {"b"}
+    assert live_out[2] == set()
+
+
+# ---------------------------------------------------------------------------
+# golden defects, one per code
+
+
+def test_pt030_over_budget_names_high_water_op_and_residents():
+    main, _startup, cost = _build_train_program()
+    plan, diags = mem.check_memory(main, batch=16, fetches=[cost],
+                                   budget_bytes=64)
+    (d,) = [d for d in diags if d.code == "PT030"]
+    assert d.is_error
+    assert plan.peak_op_ref() in d.message      # names the op
+    top = plan.top_residents(1)[0]
+    assert top.name in d.message                # and the residents
+    assert d.hint
+    # generous budget: silent
+    _plan, diags = mem.check_memory(main, batch=16, fetches=[cost],
+                                    budget_bytes=1 << 34)
+    assert "PT030" not in codes(diags)
+
+
+def test_pt031_big_dead_feed_with_compatible_output():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data(name="bigfeed", shape=[512, 1024],
+                        append_batch_size=False, dtype="float32")
+        layers.scale(x, scale=2.0)  # same-shape output; x dies here
+    _plan, diags = mem.check_memory(main, batch=1)
+    hits = [d for d in diags if d.code == "PT031"]
+    assert hits and hits[0].var == "bigfeed"
+    assert hits[0].severity == analysis.Severity.WARNING
+    assert "donate" in (hits[0].hint or "")
+    # below the noise threshold: silent (XLA's own reuse dwarfs it)
+    main2, startup2 = pt.Program(), pt.Program()
+    with pt.program_guard(main2, startup2):
+        x2 = layers.data(name="smallfeed", shape=[4, 4],
+                         append_batch_size=False, dtype="float32")
+        layers.scale(x2, scale=2.0)
+    _plan, diags2 = mem.check_memory(main2, batch=1)
+    assert "PT031" not in codes(diags2)
+
+
+def test_pt032_write_only_persistable():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        h = layers.fc(input=x, size=4)
+        blk = main.global_block()
+        dead = blk.create_var(name="kept_for_nothing", shape=[4, 4],
+                              dtype="float32", persistable=True)
+        blk.append_op("assign", inputs={"X": [h]},
+                      outputs={"Out": [dead]})
+    _plan, diags = mem.check_memory(main, batch=16)
+    hits = [d for d in diags if d.code == "PT032"]
+    assert hits and hits[0].var == "kept_for_nothing"
+    # a persistable the program READS (accumulator shape) is fine:
+    # the optimizer slots of a real train step must not fire it
+    tmain, _tstartup, _cost = _build_train_program()
+    _plan, tdiags = mem.check_memory(tmain, batch=16)
+    assert "PT032" not in codes(tdiags)
+
+
+def test_pt033_unknown_sizes_degrade_to_bounded_estimate():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        h = layers.fc(input=x, size=4)
+        blk = main.global_block()
+        mystery = blk.create_var(name="mystery", dtype="float32")
+        blk.append_op("assign", inputs={"X": [h]},
+                      outputs={"Out": [mystery]})
+        # simulate a shape-inference failure (PT013's feed-in): the
+        # assign infer repopulated it, so blank it post-append
+        mystery.shape = None
+    plan, diags = mem.check_memory(main, batch=16)
+    assert not plan.exact and "mystery" in plan.unknown
+    hits = [d for d in diags if d.code == "PT033"]
+    assert hits and "LOWER BOUND" in hits[0].message
+    # with no batch either, the feed wildcard is unresolved too
+    plan2 = mem.plan_memory(main, batch=None)
+    assert "x" in plan2.unknown
+
+
+def test_pt034_kv_pool_sizing():
+    # 4 layers x 2 heads x 8 head_dim, 64 pages x 16 tokens, K+V fp32:
+    # 2 * 4*(64+1)*16*2*8*4 = 2.6 MB
+    pool = mem.kv_pool_bytes(4, 2, 8, 64, 16)
+    assert pool == 2 * 4 * 65 * 16 * 2 * 8 * 4
+    over = mem.check_kv_pool(4, 2, 8, 64, 16, model_bytes=0,
+                             budget_bytes=pool - 1)
+    assert codes(over) == ["PT034"] and over[0].is_error
+    assert "pages" in over[0].message and over[0].hint
+    # model bytes eat the headroom
+    assert mem.check_kv_pool(4, 2, 8, 64, 16, model_bytes=2 * pool,
+                             budget_bytes=2 * pool + pool - 1)
+    # fits / no budget: silent
+    assert mem.check_kv_pool(4, 2, 8, 64, 16, budget_bytes=pool) == []
+    assert mem.check_kv_pool(4, 2, 8, 64, 16, budget_bytes=None) == []
+
+
+def test_pt034_in_validate_generative_artifact(tmp_path):
+    from paddle_tpu import inference
+    from paddle_tpu.models import transformer as tm
+    cfg = tm.TransformerConfig(vocab_size=17, hidden=16, num_layers=2,
+                               num_heads=2, max_seq=32)
+    d = str(tmp_path / "gen")
+    inference.export_generative(d, cfg,
+                                params=tm.init_params(cfg, seed=0))
+    # no budget: valid artifact stays valid
+    assert inference.validate_generative_artifact(d) == []
+    # a budget smaller than the pool: PT034 problem string
+    probs = inference.validate_generative_artifact(d, kv_pages=64,
+                                                   page_tokens=16,
+                                                   budget_bytes=1024)
+    assert probs and "PT034" in probs[0]
+    # generous explicit budget: silent again
+    assert inference.validate_generative_artifact(
+        d, budget_bytes=1 << 34) == []
+
+
+# ---------------------------------------------------------------------------
+# zero false positives at a generous budget
+
+
+def test_zero_false_positives_on_train_builders():
+    builders = []
+
+    def fit_a_line():
+        x = layers.data(name="x", shape=[13], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        avg = layers.mean(layers.square_error_cost(
+            input=layers.fc(input=x, size=1), label=y))
+        pt.optimizer.SGD(learning_rate=0.01).minimize(avg)
+
+    def mlp():
+        x = layers.data(name="img", shape=[784], dtype="float32")
+        label = layers.data(name="label", shape=[1], dtype="int64")
+        h = layers.fc(input=x, size=64, act="relu")
+        pred = layers.fc(input=h, size=10, act="softmax")
+        avg = layers.mean(layers.cross_entropy(input=pred, label=label))
+        pt.optimizer.Adam(learning_rate=0.001).minimize(avg)
+
+    builders += [fit_a_line, mlp]
+    for build in builders:
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            build()
+        _plan, diags = mem.check_memory(main, batch=16,
+                                        budget_bytes=1 << 36)
+        errors = [d for d in diags if d.is_error]
+        assert errors == [], "%s: %s" % (build.__name__, errors)
+
+
+# ---------------------------------------------------------------------------
+# choke point: lint CLI
+
+
+def _cfg_path(name):
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "examples", "configs", name)
+
+
+def test_lint_memory_cli_exit_codes(capsys):
+    from paddle_tpu.cli import main as cli_main
+    cfg = _cfg_path("fit_a_line.py")
+    assert cli_main(["lint", cfg, "--memory", "--budget-gb", "64"]) == 0
+    out = capsys.readouterr().out
+    assert "predicted per-device HBM residency" in out
+    assert "train-step program" in out
+    # an absurdly tiny budget: exit 1, high-water op named
+    rc = cli_main(["lint", cfg, "--memory", "--budget-gb", "1e-7"])
+    out = capsys.readouterr().out
+    assert rc == 1 and "PT030" in out and "high-water op" in out
+
+
+def test_accounting_memory_columns(capsys):
+    import json
+    from paddle_tpu.cli import main as cli_main
+    rc = cli_main(["accounting", _cfg_path("fit_a_line.py"),
+                   "--mesh", "dp=4", "--batch", "32"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    memtab = report["memory"]
+    assert memtab["train_step"] is True
+    assert memtab["dp"] == 4 and memtab["batch_per_device"] == 8
+    for k in ("param_bytes", "optimizer_state_bytes", "gradient_bytes",
+              "activation_bytes", "feed_bytes", "peak_bytes", "peak_op"):
+        assert k in memtab
+    assert memtab["peak_bytes"] > memtab["param_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# choke point: executor preflight (PADDLE_TPU_VERIFY)
+
+
+def _run_once(budget_gb, verify=True):
+    main, startup, cost = _build_train_program()
+    exe = pt.Executor()
+    scope = pt.Scope()
+    exe.run(startup, scope=scope)
+    feed = {"x": np.random.RandomState(0).rand(16, 13).astype(np.float32),
+            "y": np.random.RandomState(1).rand(16, 1).astype(np.float32)}
+    with flags_guard(verify=verify, memory_budget_gb=budget_gb):
+        out = exe.run(main, feed=feed, fetch_list=[cost], scope=scope)
+    return exe, out
+
+
+def test_executor_preflight_raises_before_compile():
+    main, startup, cost = _build_train_program()
+    exe = pt.Executor()
+    scope = pt.Scope()
+    exe.run(startup, scope=scope)
+    feed = {"x": np.zeros((16, 13), np.float32),
+            "y": np.zeros((16, 1), np.float32)}
+    with flags_guard(verify=True, memory_budget_gb=1e-7):
+        with pytest.raises(ProgramVerifyError) as ei:
+            exe.run(main, feed=feed, fetch_list=[cost], scope=scope)
+    msg = str(ei.value)
+    assert "before jit compile" in msg
+    assert "high-water op" in msg
+    assert "predicted per-device HBM residency" in msg  # the table
+    # the main program never compiled (only startup's jit run counted)
+    assert exe.stats["jit_runs"] == 1
+
+
+def test_executor_preflight_silent_at_generous_budget():
+    exe, out = _run_once(64.0)
+    assert np.isfinite(np.asarray(out[0])).all()
+    assert exe.stats["mem_predicted_peak_bytes"] > 0
+    from paddle_tpu import profiler
+    assert profiler.memory_counters().get("mem_preflights", 0) >= 1
+
+
+def test_executor_preflight_off_without_verify():
+    # tiny budget but PADDLE_TPU_VERIFY off: the preflight must not run
+    exe, out = _run_once(1e-7, verify=False)
+    assert np.isfinite(np.asarray(out[0])).all()
+
+
+def test_preflight_prediction_tracks_measured_live_bytes():
+    """Feed-dominated model: the predicted peak must land within 25%
+    of the measured live-buffer delta at the step boundary (the
+    acceptance bound; analysis_smoke runs the same check in a fresh
+    process)."""
+    gc.collect()
+    base = mem.measure_live_bytes()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data(name="x", shape=[1024], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        pred = layers.fc(input=x, size=4, act=None)
+        cost = layers.mean(layers.square_error_cost(input=pred, label=y))
+        pt.optimizer.SGD(learning_rate=0.01).minimize(cost)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    exe.run(startup, scope=scope)
+    batch = 2048  # feed = 2048 x 1024 x 4B = 8 MiB >> params (16 KiB)
+    feed = exe.prepare_feed(
+        {"x": np.ones((batch, 1024), np.float32),
+         "y": np.ones((batch, 1), np.float32)})
+    with flags_guard(verify=True, memory_budget_gb=64.0):
+        out = exe.run(main, feed=feed, fetch_list=[cost], scope=scope)
+    float(np.asarray(out[0]))  # materialise the fetch
+    gc.collect()
+    measured = mem.measure_live_bytes() - base
+    predicted = exe.stats["mem_predicted_peak_bytes"]
+    assert predicted > 0 and measured > 0
+    assert abs(predicted - measured) / measured < 0.25, \
+        "predicted %d vs measured %d" % (predicted, measured)
+
+
+# ---------------------------------------------------------------------------
+# choke point: elastic post-resize audit
+
+
+def test_replan_memory_audit_records_overflow():
+    from paddle_tpu import elastic, resilience
+    main, _startup, cost = _build_train_program()
+    resilience.clear_events()
+    # generous: fits, no event
+    plan = elastic.plan_for(2, program=main, global_batch=64,
+                            memory_budget_bytes=1 << 36)
+    assert plan.memory_audit["fits"] is True
+    assert plan.memory_audit["per_device_batch"] == 32
+    assert resilience.events("elastic_degraded") == []
+    # a resize from 4 -> 2 workers doubles the per-device batch; under
+    # a tiny budget the audit records the predicted overflow instead
+    # of letting the resumed generation OOM
+    plan2 = elastic.plan_for(2, program=main, global_batch=64,
+                             memory_budget_bytes=1024)
+    assert plan2.memory_audit["fits"] is False
+    evs = resilience.events("elastic_degraded", site="elastic.memory")
+    assert evs and evs[0]["overflow_bytes"] > 0
+    assert "block0:op" in evs[0]["peak_op"]
+    resilience.clear_events()
+
+
+def test_replan_audit_peak_grows_as_world_shrinks():
+    from paddle_tpu import elastic
+    main, _startup, _cost = _build_train_program()
+    a4 = elastic.plan_for(4, program=main, global_batch=64,
+                          memory_budget_bytes=1 << 36).memory_audit
+    a2 = elastic.plan_for(2, program=main, global_batch=64,
+                          memory_budget_bytes=1 << 36).memory_audit
+    assert a2["predicted_peak_bytes"] > a4["predicted_peak_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# memory_optimize rebased on the shared pass
+
+
+def test_memory_optimize_on_shared_liveness_and_peak_contract():
+    from paddle_tpu.memory_optimization_transpiler import (
+        ControlFlowGraph, memory_optimize)
+    main, _startup, _cost = _build_train_program()
+    cfg = ControlFlowGraph(main).analyze()
+    assert len(cfg.live_in) == len(main.global_block().ops)
+    before = mem.plan_memory(main, batch=16, vmem=False).peak_bytes
+    pairs = memory_optimize(main)  # runs the never-increases assert
+    assert isinstance(pairs, list)
+    after = mem.plan_memory(main, batch=16, vmem=False).peak_bytes
+    assert after <= before
+    assert main._memory_optimized
+
+
+# ---------------------------------------------------------------------------
+# profiler section
+
+
+def test_memory_timeline_section(tmp_path):
+    from paddle_tpu import profiler
+    profiler.reset_memory_counters()
+    profiler.update_memory_counters(mem_plans=1,
+                                    mem_predicted_peak_bytes=1000)
+    profiler.update_memory_counters(mem_predicted_peak_bytes=500,
+                                    mem_measured_live_bytes=900)
+    counters = profiler.memory_counters()
+    assert counters["mem_predicted_peak_bytes"] == 1000  # kept as max
+    assert counters["mem_measured_live_bytes"] == 900
+    art = profiler.write_timeline(str(tmp_path / "t.json"))
+    assert art["memory"]["mem_plans"] == 1
+    profiler.reset_memory_counters()
+
+
+def test_generative_memory_bytes_and_aggregate_inputs(tmp_path):
+    from paddle_tpu import inference
+    from paddle_tpu.models import transformer as tm
+    cfg = tm.TransformerConfig(vocab_size=17, hidden=16, num_layers=2,
+                               num_heads=2, max_seq=32)
+    d = str(tmp_path / "gen")
+    inference.export_generative(d, cfg,
+                                params=tm.init_params(cfg, seed=0))
+    nb = inference.generative_memory_bytes(d, kv_pages=8, page_tokens=4)
+    model_bytes = os.path.getsize(os.path.join(d, "__gen_params__.pkl"))
+    assert nb == model_bytes + mem.kv_pool_bytes(2, 2, 8, 8, 4)
+    # unreadable artifact: None, not a raise (integrity is the
+    # validator's finding)
+    assert inference.generative_memory_bytes(str(tmp_path / "no")) is None
+    # the loader validates integrity ONLY: a pool that would overflow
+    # the flag budget must not stop load_generative (the deployment's
+    # geometry is the engine's, not the flags')
+    with flags_guard(memory_budget_gb=1e-9):
+        model = inference.load_generative(d)
+    assert model is not None

@@ -1,24 +1,34 @@
-"""Distributed-correctness analysis smoke gate (CPU tier-1).
+"""Distributed-correctness + memory analysis smoke gate (CPU tier-1).
 
 The PT-rule verifier proved its structural half in PR 2; this gate
-proves the PR-12 distributed-correctness suite end to end, on CPU,
-with seeded defects — because every bug class it covers is invisible
-on a clean single-process run:
+proves the PR-12 distributed-correctness suite AND the PR-13 static
+memory planner end to end, on CPU, with seeded defects — because every
+bug class covered is invisible on a clean single-process run:
 
-1. **lint sweep** — ``paddle_tpu lint --comm`` over every
+1. **lint sweep** — ``paddle_tpu lint --comm --memory`` over every
    ``examples/configs/*.py`` exits 0 (zero false positives under the
-   new PT015-PT017 dataflow rules AND the PT020-PT023 comm pass);
+   PT015-PT017 dataflow rules, the PT020-PT023 comm pass, and the
+   PT030-PT033 memory pass at a generous budget);
 2. **collective consistency** — a seeded bucket-order permutation is
    caught as PT020, a wrong (host, chip) factorisation as PT022, a
    stale plan against a changed param set as PT021, an
    issue-before-finalisation overlap schedule as PT023; the clean
    canonical schedule passes all four;
-3. **donation-aliasing sanitizer** — the seeded PR-10 shape (a bare
+3. **static memory planner** — an over-budget config makes ``lint
+   --memory`` exit 1 naming the high-water op; a seeded donation miss
+   emits the PT031 hint; the Executor preflight under
+   ``PADDLE_TPU_VERIFY`` raises a readable ``ProgramVerifyError``
+   (residency table included) under a tiny artificial budget BEFORE
+   any XLA compile, while the same run at a generous budget is
+   silent; and on a feed-dominated model the predicted peak lands
+   within 25% of the measured ``jax.live_arrays`` delta at the step
+   boundary (the acceptance bound);
+4. **donation-aliasing sanitizer** — the seeded PR-10 shape (a bare
    numpy-backed buffer at a donated position) raises ``SanitizeError``
    naming the var and entry point, while a real checkpoint
    save/restore round trip under ``PADDLE_TPU_SANITIZE=alias`` is
    silent;
-4. **lock-order race detector** — a seeded A->B/B->A inversion is
+5. **lock-order race detector** — a seeded A->B/B->A inversion is
    reported as a cycle and a held-across-join as a hazard, while a
    real generation-engine run plus a router construction under the
    instrumented lock constructor is silent (no cycles, no hazards).
@@ -56,7 +66,8 @@ def lint_sweep():
     check("lint_configs_found", bool(cfgs))
     for cfg in cfgs:
         rc = cli_main(["lint", cfg, "--comm", "--comm-axis", "8",
-                       "--comm-policy", "fused"])
+                       "--comm-policy", "fused",
+                       "--memory", "--budget-gb", "64"])
         check("lint_clean:%s" % os.path.basename(cfg), rc == 0,
               "exit %d" % rc)
 
@@ -98,6 +109,92 @@ def comm_seeded():
               for d in comm_rules.check_overlap_schedule(
                   plan, list(reversed(canonical))))
           and comm_rules.check_overlap_schedule(plan, canonical) == [])
+
+
+def memory_seeded():
+    import contextlib
+    import gc
+    import io
+
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.analysis import ProgramVerifyError
+    from paddle_tpu.analysis import memory as mem
+    from paddle_tpu.cli import main as cli_main
+    from paddle_tpu.flags import flags_guard
+
+    cfg = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples", "configs", "fit_a_line.py")
+
+    # over-budget config: lint --memory exits 1 naming the high-water op
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(["lint", cfg, "--memory", "--budget-gb", "1e-7"])
+    out = buf.getvalue()
+    check("memory_lint_over_budget_exit1", rc == 1, "exit %d" % rc)
+    check("memory_lint_names_high_water_op",
+          "high-water op" in out and "block0:op" in out)
+
+    # seeded donation miss: a big feed dead after its consumer, with a
+    # shape/dtype-compatible output -> PT031 with the hint
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data(name="bigfeed", shape=[512, 1024],
+                        append_batch_size=False, dtype="float32")
+        layers.scale(x, scale=2.0)
+    _plan, diags = mem.check_memory(main, batch=1)
+    hits = [d for d in diags if d.code == "PT031"]
+    check("memory_pt031_donation_miss",
+          bool(hits) and "donate" in (hits[0].hint or ""),
+          "; ".join(map(str, diags)))
+
+    # executor preflight: tiny artificial budget raises the readable
+    # error (residency table, high-water op) BEFORE any compile; the
+    # same model at a generous budget runs silent — and on this
+    # feed-dominated model the predicted peak lands within 25% of the
+    # measured jax.live_arrays delta at the step boundary
+    gc.collect()
+    base = mem.measure_live_bytes()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data(name="x", shape=[1024], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        pred = layers.fc(input=x, size=4, act=None)
+        cost = layers.mean(layers.square_error_cost(input=pred,
+                                                    label=y))
+        pt.optimizer.SGD(learning_rate=0.01).minimize(cost)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    exe.run(startup, scope=scope)
+    batch = 2048  # feed 8 MiB >> params 16 KiB: peak ~= boundary live
+    feed = exe.prepare_feed(
+        {"x": np.ones((batch, 1024), np.float32),
+         "y": np.ones((batch, 1), np.float32)})
+    raised = False
+    with flags_guard(verify=True, memory_budget_gb=1e-7):
+        try:
+            exe.run(main, feed=feed, fetch_list=[cost], scope=scope)
+        except ProgramVerifyError as e:
+            raised = ("high-water op" in str(e)
+                      and "predicted per-device HBM residency" in str(e)
+                      and exe.stats["jit_runs"] == 1)  # startup only
+    check("memory_preflight_raises_before_compile", raised)
+    with flags_guard(verify=True, memory_budget_gb=64.0):
+        out = exe.run(main, feed=feed, fetch_list=[cost], scope=scope)
+    ok_run = bool(np.isfinite(np.asarray(out[0])).all())
+    check("memory_preflight_real_run_silent", ok_run)
+    float(np.asarray(out[0]).reshape(-1)[0])
+    gc.collect()
+    measured = mem.measure_live_bytes() - base
+    predicted = exe.stats["mem_predicted_peak_bytes"]
+    rel = (abs(predicted - measured) / measured) if measured else 1.0
+    check("memory_predicted_within_25pct_of_measured", rel < 0.25,
+          "predicted %d vs measured %d (rel %.3f)"
+          % (predicted, measured, rel))
+    summary["memory_predicted_peak_bytes"] = int(predicted)
+    summary["memory_measured_live_bytes"] = int(measured)
 
 
 def sanitizer_seeded():
@@ -196,6 +293,7 @@ def locks_seeded_and_clean():
 
 
 def main():
+    memory_seeded()  # first: the live-bytes delta wants a quiet process
     lint_sweep()
     comm_seeded()
     sanitizer_seeded()

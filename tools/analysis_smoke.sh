@@ -1,6 +1,9 @@
 #!/usr/bin/env bash
-# Distributed-correctness analysis smoke gate: the PT015-PT023 rules,
-# the donation-aliasing sanitizer, and the lock-order race detector must
+# Distributed-correctness + memory analysis smoke gate: the PT015-PT023
+# rules, the PT030-PT033 static memory planner (over-budget lint exits 1
+# naming the high-water op, the executor preflight raises BEFORE any XLA
+# compile, predicted peak within 25% of measured jax.live_arrays), the
+# donation-aliasing sanitizer, and the lock-order race detector must
 # each catch their seeded defect AND stay silent on the clean legs
 # (tools/analysis_smoke.py holds the criteria). Companion to the other
 # five smokes (perf/serve/comm/tune/gen/elastic/router); also invoked
