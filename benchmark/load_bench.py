@@ -33,6 +33,22 @@ Three legs:
   transparency. Least-loaded must beat round-robin on load spread and
   keep request spread under a threshold.
 
+The **diurnal leg** (:func:`diurnal`, ``--mode diurnal``) closes the
+autoscaling loop: a fleet starting at ``min_replicas`` behind an
+attached :class:`~paddle_tpu.serving.autoscale.Autoscaler` takes a
+generate-heavy flood (the pressure signal rises over the smoothed
+EWMA), must scale UP within the replica budget while the flood runs,
+then — traffic gone, a light probe trickle still flowing — drain and
+shrink back to ``min_replicas``. The gate: at least one
+``autoscale_up`` and one ``autoscale_down`` (the smoke pins EXACTLY
+one of each via a long up-cooldown), ZERO lost requests through both
+transitions, finite p99 in both phases, final fleet back at the floor.
+The **breaker leg** (:func:`breaker_leg`) arms a crash fault in the
+slot the autoscaler will grow into: the scale-up dies inside its
+warm-up window, the crash-loop circuit breaker opens (recorded
+``autoscale_breaker_open``), refuses further scale-ups, and the
+original fleet keeps serving — zero lost.
+
 Predict responses are verified against the artifact's known closed form
 (row sums x scale), which also proves WHICH version answered across the
 rolling reload.
@@ -58,6 +74,11 @@ GEN_MAX_NEW = 8
 
 _CLIENT_RETRIES = 40
 _RETRY_CAP_S = 0.5
+
+# the ONE default for the autoscale legs' stretched decode step (the
+# serving.generate delay fault) — the fleet arming, the summary record,
+# and the banked row must all read the same number
+DECODE_DELAY_S = 0.025
 
 
 # -- artifacts ----------------------------------------------------------------
@@ -115,8 +136,10 @@ def build_artifacts(root):
 
 def start_fleet(arts, replicas, name="m", gen_name="g", max_running=4,
                 kv_pages=32, page_tokens=8, queue_depth=128,
-                env_overrides=None, poll_ms=40, ready_timeout=420.0):
-    """Pool + router + front HTTP server, ready to take traffic.
+                env_overrides=None, poll_ms=40, ready_timeout=420.0,
+                restart_budget=None, extra_env=None):
+    """Pool + router + front HTTP server, ready to take traffic — the
+    ONE fleet bring-up both the chaos and the autoscale legs share.
     Returns (pool, router, server, base_url)."""
     from paddle_tpu.serving import (ReplicaPool, Router,
                                     make_router_server)
@@ -127,9 +150,11 @@ def start_fleet(arts, replicas, name="m", gen_name="g", max_running=4,
                   "--queue_depth", str(queue_depth)]
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
+    env.update(extra_env or {})
     pool = ReplicaPool(arts["v1"], replicas, name=name,
                        serve_args=serve_args, env=env,
                        env_overrides=env_overrides,
+                       restart_budget=restart_budget,
                        ready_timeout=ready_timeout)
     pool.start(wait=True)
     router = Router(pool, poll_ms=poll_ms)
@@ -142,11 +167,66 @@ def start_fleet(arts, replicas, name="m", gen_name="g", max_running=4,
     return pool, router, server, "http://%s:%d" % (host, port)
 
 
-def stop_fleet(pool, router, server):
+def stop_fleet(pool, router, server, autoscaler=None):
+    if autoscaler is not None:
+        autoscaler.close()
     server.shutdown()
     server.server_close()
     router.close()
     pool.stop()
+
+
+def start_autoscaled_fleet(arts, min_replicas=1, max_replicas=3,
+                           name="m", gen_name="g", max_running=2,
+                           kv_pages=32, page_tokens=8, queue_depth=64,
+                           env_overrides=None, poll_ms=40,
+                           ready_timeout=420.0, restart_budget=1,
+                           up_pressure=0.8, down_pressure=0.15,
+                           k_up=2, quiet_polls=8, cooldown_s=600.0,
+                           down_cooldown_s=2.0, tick_s=0.25,
+                           warmup_s=300.0, breaker_backoff_s=3600.0,
+                           drain_deadline_s=60.0,
+                           decode_delay_s=DECODE_DELAY_S):
+    """A ``min_replicas`` fleet with the closed-loop autoscaler
+    attached. ``max_running`` defaults LOW (2) so a generate-heavy
+    flood drives the pressure signal over the up-threshold on one
+    replica; the long default up-cooldown pins the wave at exactly one
+    scale-up (the smoke's "exactly one autoscale_up" criterion), and
+    the huge breaker backoff keeps an opened breaker observably open.
+
+    ``decode_delay_s`` arms the ``serving.generate`` DELAY fault in
+    every replica: the tiny CPU model decodes its whole batch in
+    milliseconds, so without a stretched per-step latency no backlog —
+    no pressure — ever exists to sense (a real deployment's decode is
+    device-bound; the faults table documents delay as exactly this
+    slow-device model). Recorded honestly in the banked row — the gate
+    proves the CONTROL PLANE (thresholds, hysteresis, drain, breaker),
+    not data-plane throughput. Returns (pool, router, autoscaler,
+    server, base_url)."""
+    from paddle_tpu.serving import Autoscaler
+    extra_env = None
+    if decode_delay_s:
+        extra_env = {"PADDLE_TPU_FAULT_SPEC":
+                     "serving.generate:delay:nth=*,delay=%g"
+                     % decode_delay_s}
+    pool, router, server, url = start_fleet(
+        arts, min_replicas, name=name, gen_name=gen_name,
+        max_running=max_running, kv_pages=kv_pages,
+        page_tokens=page_tokens, queue_depth=queue_depth,
+        env_overrides=env_overrides, poll_ms=poll_ms,
+        ready_timeout=ready_timeout, restart_budget=restart_budget,
+        extra_env=extra_env)
+    autoscaler = Autoscaler(
+        router, pool, min_replicas=min_replicas,
+        max_replicas=max_replicas, up_pressure=up_pressure,
+        down_pressure=down_pressure, k_up=k_up,
+        quiet_polls=quiet_polls, cooldown_s=cooldown_s,
+        down_cooldown_s=down_cooldown_s, poll_s=tick_s,
+        warmup_s=warmup_s, breaker_backoff_s=breaker_backoff_s,
+        drain_deadline_s=drain_deadline_s)
+    router.autoscaler = autoscaler
+    autoscaler.start()
+    return pool, router, autoscaler, server, url
 
 
 # -- clients ------------------------------------------------------------------
@@ -165,10 +245,14 @@ def _post(url, payload, timeout=120.0):
     return status, body
 
 
-def make_tasks(n_predict, n_generate, seed=0):
+def make_tasks(n_predict, n_generate, seed=0, gen_max_new=GEN_MAX_NEW,
+               prompt_lo=2, prompt_hi=20):
     """Deterministic interleaved task list. Each predict carries its
     feed and the expected row sums (scale applied by the checker);
-    generates carry mixed-length prompts."""
+    generates carry mixed-length prompts. ``gen_max_new`` sizes the
+    decode work per generate (the autoscale legs crank it up so the
+    backlog — the pressure signal — actually builds on CPU; prompt +
+    new tokens must stay under the artifact's max_seq)."""
     rng = np.random.RandomState(seed)
     tasks = []
     for i in range(n_predict):
@@ -176,10 +260,11 @@ def make_tasks(n_predict, n_generate, seed=0):
         tasks.append(("predict", {"x": x.tolist(),
                                   "sums": x.sum(axis=1).tolist()}))
     for i in range(n_generate):
-        ln = int(rng.randint(2, 20))
+        ln = int(rng.randint(prompt_lo, prompt_hi))
         tasks.append(("generate",
                       {"tokens": rng.randint(0, GEN_VOCAB,
-                                             ln).tolist()}))
+                                             ln).tolist(),
+                       "max_new": int(gen_max_new)}))
     order = rng.permutation(len(tasks))
     return [tasks[i] for i in order]
 
@@ -220,7 +305,8 @@ class FloodRunner(object):
             url = "%s/v1/models/%s:generate" % (self.base_url,
                                                 self.gen_model)
             payload = {"tokens": spec["tokens"],
-                       "max_new_tokens": GEN_MAX_NEW}
+                       "max_new_tokens": spec.get("max_new",
+                                                  GEN_MAX_NEW)}
         t0 = time.monotonic()
         sheds = 0
         for attempt in range(_CLIENT_RETRIES):
@@ -239,7 +325,9 @@ class FloodRunner(object):
                     out["scale_ok"] = self._check_scale(spec, body)
                 else:
                     toks = body.get("tokens") or []
-                    out["tokens_ok"] = (0 < len(toks) <= GEN_MAX_NEW)
+                    out["tokens_ok"] = (
+                        0 < len(toks) <= spec.get("max_new",
+                                                  GEN_MAX_NEW))
                 return out
             if status in (429, 503, 504):
                 sheds += 1
@@ -465,6 +553,148 @@ def bench(root, replicas=3, n_predict=240, n_generate=30,
     return out
 
 
+def _wait_for(predicate, timeout, interval=0.2):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def diurnal(root, min_replicas=1, max_replicas=3, flood_predict=30,
+            flood_generate=60, probe_predict=10, probe_generate=2,
+            threads=10, seed=0, gen_max_new=48,
+            scale_up_timeout=300.0, scale_down_timeout=300.0,
+            **fleet_kw):
+    """The closed-loop leg: flood -> scale-up within budget, idle (a
+    light probe trickle still flowing) -> drain-first scale-down, zero
+    lost and finite p99 through both transitions. The flood is
+    generate-HEAVY with long decodes (``gen_max_new``): on CPU a short
+    generate finishes in milliseconds and no backlog — no pressure —
+    ever builds; sustained decode work is what makes the signal real.
+    Returns the summary the smoke gate asserts over."""
+    from paddle_tpu import resilience
+
+    arts = build_artifacts(os.path.join(root, "artifacts"))
+    resilience.clear_events()
+    out = {"min_replicas": min_replicas, "max_replicas": max_replicas,
+           "flood_predict": flood_predict,
+           "flood_generate": flood_generate, "threads": threads,
+           "gen_max_new": gen_max_new,
+           "decode_delay_s": fleet_kw.get("decode_delay_s",
+                                          DECODE_DELAY_S)}
+    pool, router, autoscaler, server, url = start_autoscaled_fleet(
+        arts, min_replicas=min_replicas, max_replicas=max_replicas,
+        **fleet_kw)
+    try:
+        # ---- flood: the morning peak -------------------------------------
+        # prompt + max_new must fit the artifact's max_seq (64)
+        tasks = make_tasks(flood_predict, flood_generate, seed=seed,
+                           gen_max_new=gen_max_new, prompt_hi=12)
+        runner = FloodRunner(url, tasks, threads=threads).start()
+        peak = {"replicas": len(pool.snapshot())}
+
+        def _scaled_up():
+            peak["replicas"] = max(peak["replicas"],
+                                   len(pool.snapshot()))
+            return bool(resilience.events(kind="autoscale_up"))
+
+        out["scaled_up_in_time"] = _wait_for(_scaled_up,
+                                             scale_up_timeout,
+                                             interval=0.1)
+        runner.wait(timeout=900.0)
+        out["flood"] = runner.summary()
+        # the new replica must finish warming (ready) before the quiet
+        # window can shrink it drain-first — wait for the controller to
+        # clear its warm-up watch
+        _wait_for(lambda: not autoscaler.stats()["warming"], 300.0)
+        peak["replicas"] = max(peak["replicas"], len(pool.snapshot()))
+        out["replicas_peak"] = peak["replicas"]
+
+        # ---- idle: the night, with a probe trickle -----------------------
+        probe = FloodRunner(url, make_tasks(probe_predict,
+                                            probe_generate,
+                                            seed=seed + 1),
+                            threads=2).start()
+
+        def _scaled_down():
+            return (bool(resilience.events(kind="autoscale_down"))
+                    and len(pool.snapshot()) == min_replicas)
+
+        out["scaled_down_in_time"] = _wait_for(_scaled_down,
+                                               scale_down_timeout)
+        probe.wait(timeout=600.0)
+        out["idle_probe"] = probe.summary()
+        out["final_replicas"] = len(pool.snapshot())
+        ups = resilience.events(kind="autoscale_up")
+        downs = resilience.events(kind="autoscale_down")
+        out["autoscale_ups"] = len(ups)
+        out["autoscale_downs"] = len(downs)
+        out["down_drained"] = bool(downs) and downs[-1]["drained"]
+        out["breaker_opens"] = len(
+            resilience.events(kind="autoscale_breaker_open"))
+        out["degraded"] = len(
+            resilience.events(kind="autoscale_degraded"))
+        out["lost_total"] = (out["flood"]["lost"]
+                             + out["idle_probe"]["lost"])
+        out["autoscale_stats"] = autoscaler.stats()
+        out["router_stats"] = router.stats()
+    finally:
+        stop_fleet(pool, router, server, autoscaler=autoscaler)
+    return out
+
+
+def breaker_leg(root, seed=0, flood_predict=16, flood_generate=40,
+                threads=8, gen_max_new=48, open_timeout=300.0,
+                **fleet_kw):
+    """The crash-loop leg: the slot the autoscaler grows into is armed
+    to die at artifact load (``serving.reload:raise`` in that worker's
+    env), so the scale-up crash-loops inside its warm-up window — the
+    breaker must open, refuse further scale-ups, and the original
+    fleet must keep serving with zero lost."""
+    from paddle_tpu import resilience
+
+    arts = build_artifacts(os.path.join(root, "artifacts"))
+    resilience.clear_events()
+    out = {"decode_delay_s": fleet_kw.get("decode_delay_s",
+                                          DECODE_DELAY_S)}
+    # index 1 is the first slot grow() allocates above a 1-replica
+    # fleet: every boot of THAT worker dies at model load
+    overrides = {1: {"PADDLE_TPU_FAULT_SPEC":
+                     "serving.reload:raise:times=*"}}
+    pool, router, autoscaler, server, url = start_autoscaled_fleet(
+        arts, min_replicas=1, max_replicas=2,
+        env_overrides=overrides, **fleet_kw)
+    try:
+        tasks = make_tasks(flood_predict, flood_generate, seed=seed,
+                           gen_max_new=gen_max_new, prompt_hi=12)
+        runner = FloodRunner(url, tasks, threads=threads).start()
+        out["breaker_opened_in_time"] = _wait_for(
+            lambda: bool(
+                resilience.events(kind="autoscale_breaker_open")),
+            open_timeout)
+        runner.wait(timeout=900.0)
+        out["flood"] = runner.summary()
+        out["autoscale_ups"] = len(
+            resilience.events(kind="autoscale_up"))
+        out["breaker_opens"] = len(
+            resilience.events(kind="autoscale_breaker_open"))
+        out["breaker_state"] = autoscaler.breaker_state
+        out["active_replicas"] = len(pool.snapshot())
+        # the fleet still answers after the breaker verdict
+        probe = FloodRunner(url, make_tasks(6, 1, seed=seed + 1),
+                            threads=2).start()
+        probe.wait(timeout=300.0)
+        out["post_breaker_probe"] = probe.summary()
+        out["lost_total"] = (out["flood"]["lost"]
+                             + out["post_breaker_probe"]["lost"])
+        out["autoscale_stats"] = autoscaler.stats()
+    finally:
+        stop_fleet(pool, router, server, autoscaler=autoscaler)
+    return out
+
+
 if __name__ == "__main__":
     import argparse
     import sys
@@ -473,18 +703,74 @@ if __name__ == "__main__":
     sys.path.insert(0, os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
     ap = argparse.ArgumentParser()
-    ap.add_argument("--replicas", type=int, default=3)
-    ap.add_argument("--predict", type=int, default=240)
-    ap.add_argument("--generate", type=int, default=30)
-    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--mode", choices=["chaos", "diurnal"],
+                    default="chaos",
+                    help="chaos = the PR-10 kill/reload/balance run; "
+                         "diurnal = the autoscaling flood->idle wave "
+                         "(+ the crash-loop breaker leg)")
+    ap.add_argument("--replicas", type=int, default=3,
+                    help="chaos mode only (diurnal sizes its fleet "
+                         "from the [min,max] autoscale budget)")
+    ap.add_argument("--predict", type=int, default=0,
+                    help="predict task count (0 = the mode's default: "
+                         "240 chaos, 30 diurnal)")
+    ap.add_argument("--generate", type=int, default=0,
+                    help="generate task count (0 = the mode's "
+                         "default: 30 chaos, 60 diurnal)")
+    ap.add_argument("--threads", type=int, default=8,
+                    help="flood client threads (both modes; the "
+                         "breaker leg inherits it too)")
     ap.add_argument("--root", default=None)
     ap.add_argument("--bank", action="store_true",
                     help="persist a paddle_tpu.bench.v1 row under "
                          "benchmark/results/")
     a = ap.parse_args()
     root = a.root or tempfile.mkdtemp(prefix="paddle_tpu_load_bench_")
-    summary = bench(root, replicas=a.replicas, n_predict=a.predict,
-                    n_generate=a.generate, threads=a.threads)
+    if a.mode == "diurnal":
+        dkw = {}
+        if a.predict:
+            dkw["flood_predict"] = a.predict
+        if a.generate:
+            dkw["flood_generate"] = a.generate
+        summary = diurnal(os.path.join(root, "diurnal"),
+                          threads=a.threads, **dkw)
+        summary["breaker_leg"] = breaker_leg(
+            os.path.join(root, "breaker"), threads=a.threads)
+        print(json.dumps(summary, indent=1, default=str))
+        if a.bank:
+            from paddle_tpu.tune import results as results_mod
+            row = {
+                "min_replicas": summary["min_replicas"],
+                "max_replicas": summary["max_replicas"],
+                "replicas_peak": summary["replicas_peak"],
+                "final_replicas": summary["final_replicas"],
+                "autoscale_ups": summary["autoscale_ups"],
+                "autoscale_downs": summary["autoscale_downs"],
+                "down_drained": summary["down_drained"],
+                "lost_total": summary["lost_total"],
+                "flood": summary["flood"],
+                "idle_probe": summary["idle_probe"],
+                "flood_p99_ms": summary["flood"]["latency_ms_p99"],
+                "idle_p99_ms":
+                    summary["idle_probe"]["latency_ms_p99"],
+                "breaker": {
+                    "opened":
+                        summary["breaker_leg"]["breaker_opens"],
+                    "state": summary["breaker_leg"]["breaker_state"],
+                    "active_replicas":
+                        summary["breaker_leg"]["active_replicas"],
+                    "lost_total":
+                        summary["breaker_leg"]["lost_total"],
+                },
+            }
+            rec = results_mod.bench_record(
+                "load_autoscale", [row],
+                meta={"threads": a.threads})
+            print("banked:", results_mod.write_result(rec))
+        sys.exit(0)
+    summary = bench(root, replicas=a.replicas,
+                    n_predict=a.predict or 240,
+                    n_generate=a.generate or 30, threads=a.threads)
     print(json.dumps(summary, indent=1, default=str))
     if a.bank:
         from paddle_tpu.tune import results as results_mod

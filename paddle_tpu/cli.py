@@ -387,10 +387,13 @@ def cmd_route(args):
     RetryPolicy restarts on crash), and run the proxy tier —
     least-loaded routing from polled /statz, health eject/probation,
     one failover retry, rolling ``:reload`` — until SIGTERM/SIGINT,
-    which drains the fleet and exits 0."""
+    which drains the fleet and exits 0. With ``--autoscale`` the
+    closed-loop controller (paddle_tpu.serving.autoscale) grows and
+    shrinks the fleet on the smoothed pressure signal within
+    [--min_replicas, --max_replicas]."""
     from paddle_tpu.flags import FLAGS
-    from paddle_tpu.serving import (ReplicaPool, Router, httpd,
-                                    make_router_server)
+    from paddle_tpu.serving import (Autoscaler, ReplicaPool, Router,
+                                    httpd, make_router_server)
 
     try:
         extra_models = _parse_extra_models(args.extra_model,
@@ -417,9 +420,29 @@ def cmd_route(args):
         serve_args += ["--page_tokens", str(args.page_tokens)]
     for n, d in extra_models:
         serve_args += ["--extra_model", "%s=%s" % (n, d)]
+    if args.autoscale:
+        max_replicas = args.max_replicas or max(args.min_replicas,
+                                                FLAGS.route_replicas)
+        if args.min_replicas < 1 or max_replicas < args.min_replicas:
+            print("route: --autoscale wants 1 <= min_replicas <= "
+                  "max_replicas, got [%d, %d]"
+                  % (args.min_replicas, max_replicas), file=sys.stderr)
+            return 1
+        initial = args.replicas or args.min_replicas
+        if not args.min_replicas <= initial <= max_replicas:
+            # a fleet starting outside the budget is one the controller
+            # can never bring inside it (it shrinks one replica per
+            # quiet window, and only when the load is quiet)
+            print("route: --autoscale wants --replicas inside "
+                  "[%d, %d], got %d"
+                  % (args.min_replicas, max_replicas, initial),
+                  file=sys.stderr)
+            return 1
+    else:
+        initial = args.replicas or FLAGS.route_replicas
     try:
         pool = ReplicaPool(
-            args.artifact_dir, args.replicas or FLAGS.route_replicas,
+            args.artifact_dir, initial,
             name=args.name, host=args.host, serve_args=serve_args,
             restart_budget=(args.restart_budget if args.restart_budget >= 0
                             else None),
@@ -429,6 +452,7 @@ def cmd_route(args):
         print("route: %s" % e, file=sys.stderr)
         return 1
     router = None
+    autoscaler = None
     try:
         # anything failing before the serve loop (say, the router port
         # already bound) must still drain the fleet pool.start spawned
@@ -437,21 +461,44 @@ def cmd_route(args):
                         poll_ms=args.poll_ms if args.poll_ms > 0 else None)
         router.poll_once()
         router.start_polling()
+        if args.autoscale:
+            autoscaler = Autoscaler(
+                router, pool, min_replicas=args.min_replicas,
+                max_replicas=max_replicas,
+                up_pressure=(args.scale_up_pressure
+                             if args.scale_up_pressure > 0 else None),
+                down_pressure=(args.scale_down_pressure
+                               if args.scale_down_pressure >= 0
+                               else None),
+                cooldown_s=(args.cooldown_s
+                            if args.cooldown_s >= 0 else None))
+            router.autoscaler = autoscaler
+            autoscaler.start()
         server = make_router_server(router, host=args.host,
                                     port=args.port)
     except Exception as e:
+        if autoscaler is not None:
+            autoscaler.close()
         if router is not None:
             router.close()
         pool.stop()
         print("route: %s: %s" % (type(e).__name__, e), file=sys.stderr)
         return 1
     host, port = server.server_address[:2]
-    print(json.dumps({"router": {
+    info = {
         "host": host, "port": port, "model": args.name,
         "policy": router.policy,
         "replicas": [{"index": w["index"], "port": w["port"],
                       "pid": w["pid"]}
-                     for w in pool.describe()["workers"]]}}), flush=True)
+                     for w in pool.describe()["workers"]]}
+    if autoscaler is not None:
+        info["autoscale"] = {
+            "min_replicas": autoscaler.min_replicas,
+            "max_replicas": autoscaler.max_replicas,
+            "up_pressure": autoscaler.up_pressure,
+            "down_pressure": autoscaler.down_pressure,
+            "cooldown_s": autoscaler.cooldown_s}
+    print(json.dumps({"router": info}), flush=True)
     try:
         signum = httpd.serve_until_shutdown(server)
     finally:
@@ -460,6 +507,8 @@ def cmd_route(args):
             # stats/close can take a couple of seconds (the close joins
             # the poller) — a second Ctrl-C landing there must still
             # drain the fleet, so pool.stop() is not gated on them
+            if autoscaler is not None:
+                autoscaler.close()
             final_stats = router.stats()
             server.server_close()
             router.close()
@@ -865,6 +914,40 @@ def main(argv=None):
     rt.add_argument("--restart_budget", type=int, default=-1,
                     help="restarts per dead replica before declaring it "
                          "lost (negative = FLAGS.route_restart_budget)")
+    rt.add_argument("--autoscale", action="store_true",
+                    help="close the loop on the pressure signal "
+                         "(paddle_tpu.serving.autoscale): grow/shrink "
+                         "the fleet between --min_replicas and "
+                         "--max_replicas from the EWMA-smoothed "
+                         "per-model pressure in /statz — scale-up "
+                         "after a sustained overload, drain-first "
+                         "scale-down after a longer quiet window, "
+                         "crash-loop circuit breaker on dying "
+                         "scale-ups")
+    rt.add_argument("--min_replicas", "--min-replicas", type=int,
+                    default=1,
+                    help="autoscale floor (also the initial fleet size "
+                         "when --autoscale is on and --replicas is 0)")
+    rt.add_argument("--max_replicas", "--max-replicas", type=int,
+                    default=0,
+                    help="autoscale ceiling (0 = max(min_replicas, "
+                         "FLAGS.route_replicas))")
+    rt.add_argument("--scale_up_pressure", "--scale-up-pressure",
+                    type=float, default=0.0,
+                    help="smoothed pressure that triggers a scale-up "
+                         "after k_up consecutive control ticks (0 = "
+                         "FLAGS.route_scale_up_pressure)")
+    rt.add_argument("--scale_down_pressure", "--scale-down-pressure",
+                    type=float, default=-1.0,
+                    help="smoothed pressure under which the (longer) "
+                         "quiet window triggers a drain-first "
+                         "scale-down (negative = "
+                         "FLAGS.route_scale_down_pressure)")
+    rt.add_argument("--cooldown_s", "--cooldown-s", type=float,
+                    default=-1.0,
+                    help="minimum seconds between scale-ups; the "
+                         "scale-down cooldown is 2x (negative = "
+                         "FLAGS.route_cooldown_s)")
     rt.add_argument("--grace_sec", type=float, default=5.0,
                     help="SIGTERM drain window before the pool "
                          "escalates to SIGKILL at shutdown")

@@ -39,7 +39,6 @@ from __future__ import annotations
 import json
 import os
 import queue
-import signal
 import socket
 import subprocess
 import sys
@@ -48,6 +47,7 @@ import time
 
 from ..resilience import RetryPolicy, record_event
 from ..resilience.faults import fault_point
+from ..resilience.supervise import SlotSupervision, escalate_stop
 
 __all__ = ["ElasticSupervisor", "TaskMasterHost", "Gang", "free_port"]
 
@@ -144,24 +144,11 @@ class Gang(object):
         """Drain the gang: SIGTERM everyone still alive (the trainers'
         preemption hook turns that into a final checkpoint), then
         escalate to SIGKILL after ``grace_sec`` — a worker wedged in a
-        dead collective cannot hold the supervisor hostage. Returns
+        dead collective cannot hold the supervisor hostage. The
+        escalation is the shared ``resilience.supervise`` one (the
+        serving replica pool drains with the exact same code). Returns
         {rank: rc} with the REAL exit codes (negative = signal)."""
-        for p in self._procs:
-            if p.poll() is None:
-                try:
-                    p.send_signal(signal.SIGTERM)
-                except (ProcessLookupError, OSError):
-                    pass
-        deadline = time.monotonic() + max(float(grace_sec), 0.0)
-        rcs = {}
-        for rank, p in enumerate(self._procs):
-            remaining = deadline - time.monotonic()
-            try:
-                rcs[rank] = p.wait(timeout=max(remaining, 0.0))
-            except subprocess.TimeoutExpired:
-                p.kill()
-                rcs[rank] = p.wait()
-        return rcs
+        return escalate_stop(enumerate(self._procs), grace_sec)
 
 
 class ElasticSupervisor(object):
@@ -327,11 +314,16 @@ class ElasticSupervisor(object):
                                     host=self.coordinator_host)
         world = self.nprocs
         generation = 0
-        transient_used = 0
         gang = None
-        retry = RetryPolicy(max_attempts=self.restart_budget + 1,
-                            backoff=0.5, multiplier=2.0, max_backoff=10.0,
-                            jitter=0.1, seed=0, name="elastic.restart")
+        # the shared supervision core: one job-level slot spends the
+        # transient restart budget on the RetryPolicy schedule — the
+        # same arithmetic the serving replica pool spends per slot
+        sup = SlotSupervision(
+            self.restart_budget,
+            retry=RetryPolicy(max_attempts=self.restart_budget + 1,
+                              backoff=0.5, multiplier=2.0,
+                              max_backoff=10.0, jitter=0.1, seed=0,
+                              name="elastic.restart"))
         try:
             while True:
                 coordinator = "%s:%d" % (self.coordinator_host,
@@ -369,17 +361,20 @@ class ElasticSupervisor(object):
                 self._event("elastic_worker_exit", rank=rank, rc=rc,
                             generation=generation, world=world)
                 gang.stop(self.grace_sec)  # drain + escalate survivors
-                permanent = rc < 0 or transient_used >= self.restart_budget
-                if not permanent:
-                    transient_used += 1
-                    delay = retry.delay(transient_used)
+                # classification: a signal death means the machine is
+                # gone — permanent, never a budget spend. A non-zero
+                # exit asks the shared core whether the transient
+                # budget still covers a full-world relaunch.
+                decision = (sup.classify_exit("job") if rc >= 0 else None)
+                if decision is not None and decision.action == "restart":
                     self._event("elastic_restart", rank=rank, rc=rc,
-                                attempt=transient_used,
-                                backoff_sec=round(delay, 3),
+                                attempt=decision.attempt,
+                                backoff_sec=round(decision.backoff_sec,
+                                                  3),
                                 generation=generation)
                     _prof.update_elastic_counters(elastic_restarts=1)
                     self._restore_master(master)
-                    time.sleep(delay)
+                    time.sleep(decision.backoff_sec)
                     generation += 1
                     continue
                 new_world = world - 1

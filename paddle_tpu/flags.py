@@ -464,3 +464,35 @@ DEFINE_float("route_proxy_timeout_s", 300.0,
              "deadline_ms uses min(deadline, this). Proxy failures "
              "inside the window fail over once to the next-best "
              "replica")
+DEFINE_float("route_pressure_alpha", 0.4,
+             "serving router: EWMA smoothing factor for the per-model "
+             "autoscale pressure signal (smoothed = alpha*raw + "
+             "(1-alpha)*previous, seeded with the first raw sample). "
+             "/statz exposes both 'pressure' (raw, one poll window) "
+             "and 'pressure_smoothed'; the autoscaler acts ONLY on the "
+             "smoothed one, so a single poll spike can neither trigger "
+             "a scale-up nor mask a sustained overload. Must be in "
+             "(0, 1]; 1.0 disables smoothing")
+DEFINE_float("route_scale_up_pressure", 1.0,
+             "autoscaler (paddle_tpu.serving.autoscale): smoothed "
+             "pressure at or above this for k_up consecutive control "
+             "ticks grows the fleet by one replica (pressure = "
+             "backlog/capacity + shed_rate, so 1.0 means the backlog "
+             "equals the healthy fleet's capacity). Must exceed "
+             "route_scale_down_pressure — the dead band between them "
+             "is the hysteresis that stops oscillating load from "
+             "thrashing the fleet")
+DEFINE_float("route_scale_down_pressure", 0.2,
+             "autoscaler: smoothed pressure at or below this for the "
+             "(longer) quiet window shrinks the fleet by one replica, "
+             "drain-first: the victim is marked draining in the "
+             "router, in-flight requests run out (bounded by the drain "
+             "deadline), then the worker is retired on the shared "
+             "SIGTERM->SIGKILL escalation — no request is lost to a "
+             "policy decision")
+DEFINE_float("route_cooldown_s", 30.0,
+             "autoscaler: minimum seconds between scale-UPs (the "
+             "scale-down cooldown defaults to 2x this, and a "
+             "scale-down additionally waits it out since the last "
+             "scale-up). Cooldowns are the second flap guard after "
+             "the threshold hysteresis")

@@ -31,6 +31,8 @@ __all__ = ["timer", "stat_summary", "print_stats", "reset_stats",
            "reset_generation_counters",
            "update_router_counters", "router_counters",
            "reset_router_counters",
+           "update_autoscale_counters", "autoscale_counters",
+           "reset_autoscale_counters",
            "update_memory_counters", "memory_counters",
            "reset_memory_counters"]
 
@@ -45,6 +47,7 @@ _tune_counters = defaultdict(float)      # kernel-autotuning observability
 _elastic_counters = defaultdict(float)   # elasticity observability
 _generation_counters = defaultdict(float)  # autoregressive-serving observability
 _router_counters = defaultdict(float)     # multi-replica-router observability
+_autoscale_counters = defaultdict(float)  # closed-loop-autoscaler observability
 _memory_counters = defaultdict(float)     # static-memory-planner observability
 _T0 = time.perf_counter()
 
@@ -92,6 +95,8 @@ def reset_profiler():
     _elastic_counters.clear()
     _generation_counters.clear()
     _router_counters.clear()
+    _autoscale_counters.clear()
+    _memory_counters.clear()
 
 
 def update_pipeline_counters(**counters):
@@ -303,6 +308,40 @@ def reset_router_counters():
     _router_counters.clear()
 
 
+_AUTOSCALE_MAX_KEYS = frozenset(("autoscale_replicas",
+                                 "autoscale_pressure_max"))
+
+
+def update_autoscale_counters(**counters):
+    """Accumulate closed-loop-autoscaler observability counters
+    (paddle_tpu.serving.autoscale; a few dict adds per control tick
+    and per decision). Keys in use: ``autoscale_ticks`` (control-loop
+    iterations), ``autoscale_ups`` / ``autoscale_downs`` (fleet
+    resizes), ``autoscale_breaker_opens`` /
+    ``autoscale_breaker_half_opens`` / ``autoscale_breaker_closes``
+    (crash-loop circuit-breaker transitions),
+    ``autoscale_breaker_refused`` (scale-ups the open breaker vetoed),
+    ``autoscale_degraded`` (controller failures degraded to a fixed
+    fleet); ``autoscale_replicas`` (largest fleet size reached) and
+    ``autoscale_pressure_max`` (largest smoothed pressure observed)
+    are kept as maxima."""
+    for k, v in counters.items():
+        if k in _AUTOSCALE_MAX_KEYS:
+            _autoscale_counters[k] = max(_autoscale_counters[k],
+                                         float(v))
+        else:
+            _autoscale_counters[k] += float(v)
+
+
+def autoscale_counters():
+    """Snapshot {counter: value} of the autoscaler counters."""
+    return dict(_autoscale_counters)
+
+
+def reset_autoscale_counters():
+    _autoscale_counters.clear()
+
+
 def record_op_event(op_type, name, t_start, t_end):
     """Per-op span from the eager interpreter path (on the jit path the
     per-op loop does not exist at run time — op granularity comes from the
@@ -401,6 +440,10 @@ def write_timeline(path):
       failovers, health ejects/readmits, rolling-reload outcomes,
       replica restarts, peak load score) — the fleet evidence for
       paddle_tpu.serving.router.
+    - ``autoscale``: closed-loop-autoscaler counters (control ticks,
+      scale-ups/downs, breaker transitions, degraded falls, max fleet
+      size and max smoothed pressure) — the sizing evidence for
+      paddle_tpu.serving.autoscale.
     - ``memory``: static-memory-planner counters (preflights/plans run,
       predicted peak vs ``jax.live_arrays`` measured high-water — the
       predicted-vs-actual evidence for paddle_tpu.analysis.memory).
@@ -426,6 +469,7 @@ def write_timeline(path):
         "elastic": dict(_elastic_counters),
         "generation": dict(_generation_counters),
         "router": dict(_router_counters),
+        "autoscale": dict(_autoscale_counters),
         "memory": dict(_memory_counters),
     }
     with open(path, "w") as f:

@@ -16,6 +16,10 @@ failure semantics, not scattered try/excepts):
   corrupt at the Nth hit.
 - :mod:`.events` — the process-local record of every degradation, so
   "it kept going" is auditable.
+- :mod:`.supervise` — the ONE slot-lifecycle idiom (restart budget +
+  crash-loop window + generation bump + SIGTERM->SIGKILL escalation)
+  both the elastic trainer supervisor and the serving replica pool
+  consume, so their judgement cannot drift.
 
 Consumers elsewhere in the package: checkpoint.py (CRC + fallback to the
 previous complete checkpoint), trainer.py (SIGTERM preemption
@@ -31,10 +35,15 @@ from .faults import (  # noqa: F401
     FaultError, arm, disarm, reset, hits, armed, fault_point,
     parse_fault_spec, load_fault_spec,
 )
+from .supervise import (  # noqa: F401
+    SlotDecision, SlotSupervision, escalate_stop, signal_quietly,
+)
 
 __all__ = [
     "record_event", "events", "clear_events",
     "RetryPolicy", "RetryError", "AttemptTimeout", "retry",
     "FaultError", "arm", "disarm", "reset", "hits", "armed",
     "fault_point", "parse_fault_spec", "load_fault_spec",
+    "SlotDecision", "SlotSupervision", "escalate_stop",
+    "signal_quietly",
 ]

@@ -58,6 +58,16 @@ site                      where
                           keeps serving (never a crash); a delay
                           models a slow fabric and stretches proxied
                           latency into the client's deadline
+``serving.autoscale``     the closed-loop autoscaler's control tick
+                          (paddle_tpu.serving.autoscale), hit once per
+                          tick before any decision: a raise — armed or
+                          real — records ``autoscale_degraded`` and
+                          freezes the fleet at its current size (no
+                          more grows/shrinks); the router keeps
+                          serving — a dead controller is a sizing
+                          regression, never an outage; a delay models
+                          a slow control plane and stretches the
+                          reaction time, not correctness
 ``comm.quantize``         paddle_tpu.comm, per bucket at the quantised
                           all-reduce BUILD (trace time — the traced
                           collectives never re-enter the host): a raise

@@ -1,0 +1,156 @@
+"""One supervision idiom for trainers and serving replicas.
+
+The elastic launcher (:mod:`paddle_tpu.elastic.supervisor`) and the
+serving replica pool (:mod:`paddle_tpu.serving.pool`) grew the same
+slot-lifecycle machinery twice: a bounded restart budget spent on a
+:class:`~paddle_tpu.resilience.retry.RetryPolicy` backoff schedule, a
+crash-loop window that distinguishes "this process keeps dying" from
+"one recoverable crash a week", a SIGTERM -> SIGKILL grace escalation
+so a wedged worker cannot hold its supervisor hostage, and a
+generation counter so a respawned process never inherits its
+predecessor's health record. Two copies of the same judgement drift —
+this module is the ONE implementation both consume (the reference ran
+this role in Go: the master and pservers registered in etcd and
+supervised each other with exactly one lease/backoff idiom).
+
+Three pieces, deliberately policy-free (what counts as "dead", which
+event kinds to record, and whether a signal death is permanent stay at
+the call sites — the elastic supervisor treats signal death as a
+machine gone, the pool treats every death as restartable):
+
+- :class:`SlotSupervision` — per-slot restart-budget accounting with
+  the crash-loop reset window and the generation counter. NOT itself
+  thread-safe: callers hold their own state lock around it (the pool's
+  monitor lock, the supervisor's single thread).
+- :func:`escalate_stop` — the shared SIGTERM -> one-shared-deadline ->
+  SIGKILL drain over any set of ``Popen``-shaped processes.
+- :func:`signal_quietly` — send a signal to a process that may already
+  be gone (the race every stop path has).
+"""
+from __future__ import annotations
+
+import signal as _signal
+import subprocess
+import time
+from collections import namedtuple
+
+__all__ = ["SlotDecision", "SlotSupervision", "escalate_stop",
+           "signal_quietly"]
+
+
+#: The verdict on one slot exit. ``action`` is ``"restart"`` (spend one
+#: budget unit, wait ``backoff_sec``, respawn) or ``"lost"`` (budget
+#: exhausted — the slot stays down). ``attempt`` is the 1-based restart
+#: attempt for a restart decision; ``used`` the budget spent so far.
+SlotDecision = namedtuple("SlotDecision",
+                          ["action", "attempt", "backoff_sec", "used"])
+
+
+class SlotSupervision(object):
+    """Restart-budget + crash-loop-window + generation accounting for a
+    set of supervised slots (replica indices, worker ranks, or a single
+    job-level slot).
+
+    ``restart_budget`` bounds consecutive restarts of one slot;
+    :meth:`note_stable` resets a slot's record (the caller decides what
+    "stayed up long enough" means — the pool arms a ``budget_reset_s``
+    timer per respawn, the elastic supervisor never resets: a training
+    job's transient budget is per-job by design). ``retry`` supplies
+    the backoff schedule (None = restart immediately).
+    """
+
+    def __init__(self, restart_budget, retry=None):
+        if restart_budget < 0:
+            raise ValueError("restart_budget must be >= 0, got %d"
+                             % restart_budget)
+        self.restart_budget = int(restart_budget)
+        self.retry = retry
+        self._used = {}          # slot -> restarts spent this window
+        self._lost = set()       # slots whose budget is exhausted
+        self._generations = {}   # slot -> current generation (0-based)
+
+    # -- budget -------------------------------------------------------------
+    def classify_exit(self, slot=0):
+        """The supervision verdict on ``slot`` dying: a ``restart``
+        decision SPENDS one budget unit and carries the jittered
+        backoff; a ``lost`` decision marks the slot lost."""
+        used = self._used.get(slot, 0)
+        if used >= self.restart_budget:
+            self._lost.add(slot)
+            return SlotDecision("lost", None, 0.0, used)
+        self._used[slot] = used + 1
+        backoff = self.retry.delay(used + 1) if self.retry is not None \
+            else 0.0
+        return SlotDecision("restart", used + 1, backoff, used + 1)
+
+    def note_stable(self, slot=0):
+        """A respawn survived its crash-loop window: the slot earns a
+        clean restart record (the systemd ``StartLimitIntervalSec`` /
+        erlang supervisor convention — the budget bounds crash LOOPS,
+        not the lifetime crash total)."""
+        self._used[slot] = 0
+
+    def used(self, slot=0):
+        return self._used.get(slot, 0)
+
+    def used_map(self, slots):
+        return [self._used.get(s, 0) for s in slots]
+
+    def is_lost(self, slot=0):
+        return slot in self._lost
+
+    def lost_slots(self):
+        return sorted(self._lost)
+
+    # -- generations --------------------------------------------------------
+    def generation(self, slot=0):
+        return self._generations.get(slot, 0)
+
+    def bump_generation(self, slot=0):
+        """Advance and return the slot's generation — a respawned
+        process gets a NEW generation so supervisors/routers reset the
+        health state they keyed on the old one."""
+        g = self._generations.get(slot, 0) + 1
+        self._generations[slot] = g
+        return g
+
+    def reset_generation(self, slot=0, generation=0):
+        """Pin a slot's generation (fresh spawn of a new slot)."""
+        self._generations[slot] = int(generation)
+
+
+def signal_quietly(proc, signum):
+    """Send ``signum`` to a Popen-shaped process, swallowing the
+    already-gone races (every stop path has them)."""
+    try:
+        proc.send_signal(signum)
+    except (ProcessLookupError, OSError):
+        pass
+
+
+def escalate_stop(procs, grace_sec, term_signal=_signal.SIGTERM):
+    """Drain a set of processes with the shared grace escalation:
+    ``term_signal`` (default SIGTERM — each worker's drain hook runs)
+    to everything still alive, then ONE shared deadline ``grace_sec``
+    out; stragglers are SIGKILLed. A hung worker can never hold its
+    supervisor hostage, and the REAL exit codes (negative = signal)
+    come back as ``{key: rc}``.
+
+    ``procs`` is an iterable of ``(key, popen)`` — the elastic gang
+    passes ranks, the replica pool passes slot indices, the autoscaler
+    passes the one victim it is retiring.
+    """
+    procs = list(procs)
+    for _, p in procs:
+        if p.poll() is None:
+            signal_quietly(p, term_signal)
+    deadline = time.monotonic() + max(float(grace_sec), 0.0)
+    rcs = {}
+    for key, p in procs:
+        remaining = deadline - time.monotonic()
+        try:
+            rcs[key] = p.wait(timeout=max(remaining, 0.0))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            rcs[key] = p.wait()
+    return rcs

@@ -68,6 +68,7 @@ from .generator import (  # noqa: F401
 )
 from .pool import ReplicaPool, StaticPool  # noqa: F401
 from .router import Router, make_router_server  # noqa: F401
+from .autoscale import Autoscaler  # noqa: F401
 
 __all__ = [
     "InferenceService", "ModelRegistry", "ModelEntry", "MicroBatcher",
@@ -78,4 +79,5 @@ __all__ = [
     "GenerationEngine", "GenRequest", "GenResult", "GenEntry",
     "reference_decode", "sample_token",
     "ReplicaPool", "StaticPool", "Router", "make_router_server",
+    "Autoscaler",
 ]

@@ -1,0 +1,464 @@
+"""Closed-loop autoscaling on the router's pressure signal.
+
+PR 10 built the sensing half of "serving at planet scale": the router
+exposes per-model ``pressure = backlog/capacity + shed_rate`` in
+RouterStats. This module is the acting half — the reference's Go
+master/etcd runtime existed so a fleet could grow, shrink, and lose
+members without an operator in the loop; here one controller thread
+closes that loop over the :class:`~paddle_tpu.serving.pool.ReplicaPool`
+within a ``[min_replicas, max_replicas]`` budget. Four defenses keep a
+feedback loop from becoming the outage:
+
+**Hysteresis + flap guard.** Decisions read only the EWMA-SMOOTHED
+pressure (:meth:`Router.pressure_smoothed` — a single poll spike can
+neither trigger a scale-up nor mask a sustained overload). Scale-up
+needs the signal to hold at or above ``up_pressure`` for ``k_up``
+CONSECUTIVE control ticks; scale-down needs it at or below the (lower)
+``down_pressure`` for the longer ``quiet_polls`` window. Each
+direction then has its own cooldown (``cooldown_s`` up,
+``down_cooldown_s`` down, default 2x), and a scale-down additionally
+waits out ``down_cooldown_s`` since the LAST scale-up — oscillating
+load lands in the dead band between the thresholds and cannot thrash
+the fleet. One decision per tick, and never while a previous scale-up
+is still warming.
+
+**Drain-first scale-down.** The victim (the highest-index active slot
+— last grown, first retired) is marked ``draining`` in the router so
+no new work routes to it, the controller waits for the router-tracked
+in-flight count to reach zero (or ``drain_deadline_s``), and only then
+retires the slot through :meth:`ReplicaPool.shrink` — the shared
+SIGTERM -> SIGKILL escalation, under which the worker's ``serve`` loop
+drains its own queue before exiting. No request is ever lost to a
+policy decision. The whole sequence holds the pool's
+``membership_lock``, so a rolling reload can never interleave with a
+shrink.
+
+**Crash-loop circuit breaker.** Every scale-up is watched through a
+``warmup_s`` window: if the fresh replica dies inside it (the pool
+respawning it — a generation bump — or marking it lost, or it never
+reports ready), the breaker OPENS (recorded ``autoscale_breaker_open``)
+and the controller refuses further scale-ups: a bad artifact or a
+poisoned host must not march the budget to ``max_replicas`` worth of
+crash loops. After ``breaker_backoff_s`` the breaker goes HALF-OPEN
+and allows exactly one probe scale-up: a probe that warms closes the
+breaker (``autoscale_breaker_close``), a probe that dies re-opens it.
+The crash-looping slot itself is retired so the pool stops burning
+restart budget on it.
+
+**Degrade, never die.** The control tick is fault site
+``serving.autoscale``: ANY controller failure (armed or real) records
+``autoscale_degraded`` and freezes the fleet at its current size — the
+router keeps serving; a dead autoscaler is a sizing regression, not an
+outage.
+
+Decisions surface in RouterStats (``/statz`` -> ``autoscale``), in
+``resilience.events()`` (``autoscale_up`` / ``autoscale_down`` /
+breaker events), and in ``profiler.autoscale_counters()`` + the
+timeline artifact's ``autoscale`` section. CLI: ``paddle_tpu route
+--autoscale --min_replicas 1 --max_replicas 4 [--scale_up_pressure
+1.0 --scale_down_pressure 0.2 --cooldown_s 30]``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..resilience import fault_point, record_event
+# the shared lock constructor (lock-order race detector under
+# PADDLE_TPU_SANITIZE=locks)
+from ..analysis import locks as _locks
+
+__all__ = ["Autoscaler"]
+
+
+class Autoscaler(object):
+    """The control loop. ``router`` supplies the smoothed signal and
+    the drain handles; ``pool`` must own its membership
+    (:class:`ReplicaPool` — a :class:`StaticPool` raises on grow).
+
+    Tunables default from flags: ``up_pressure``
+    (FLAGS.route_scale_up_pressure), ``down_pressure``
+    (FLAGS.route_scale_down_pressure), ``cooldown_s``
+    (FLAGS.route_cooldown_s; ``down_cooldown_s`` defaults to 2x).
+    ``clock``/``sleep`` are injectable so the whole state machine is
+    testable without real waiting (the RetryPolicy convention).
+    """
+
+    def __init__(self, router, pool, min_replicas=1, max_replicas=None,
+                 up_pressure=None, down_pressure=None, k_up=3,
+                 quiet_polls=10, cooldown_s=None, down_cooldown_s=None,
+                 poll_s=None, warmup_s=60.0, breaker_backoff_s=30.0,
+                 drain_deadline_s=30.0, clock=time.monotonic,
+                 sleep=time.sleep):
+        from ..flags import FLAGS
+        self.router = router
+        self.pool = pool
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas if max_replicas is not None
+                                else max(self.min_replicas,
+                                         FLAGS.route_replicas))
+        if self.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1, got %d"
+                             % self.min_replicas)
+        if self.max_replicas < self.min_replicas:
+            raise ValueError("max_replicas (%d) must be >= min_replicas "
+                             "(%d)" % (self.max_replicas,
+                                       self.min_replicas))
+        self.up_pressure = float(
+            up_pressure if up_pressure is not None
+            else FLAGS.route_scale_up_pressure)
+        self.down_pressure = float(
+            down_pressure if down_pressure is not None
+            else FLAGS.route_scale_down_pressure)
+        if not self.down_pressure < self.up_pressure:
+            raise ValueError(
+                "hysteresis wants down_pressure (%g) < up_pressure (%g)"
+                % (self.down_pressure, self.up_pressure))
+        self.k_up = int(k_up)
+        self.quiet_polls = int(quiet_polls)
+        if self.k_up < 1 or self.quiet_polls < 1:
+            raise ValueError("k_up and quiet_polls must be >= 1")
+        self.cooldown_s = float(cooldown_s if cooldown_s is not None
+                                else FLAGS.route_cooldown_s)
+        self.down_cooldown_s = float(
+            down_cooldown_s if down_cooldown_s is not None
+            else 2.0 * self.cooldown_s)
+        self.poll_s = float(poll_s if poll_s is not None
+                            else max(router.poll_s, 0.05))
+        self.warmup_s = float(warmup_s)
+        self.breaker_backoff_s = float(breaker_backoff_s)
+        self.drain_deadline_s = float(drain_deadline_s)
+        self._clock = clock
+        self._sleep = sleep
+        self._lock = _locks.make_lock("serving.autoscale.state")
+        self._up_streak = 0
+        self._quiet_streak = 0
+        self._last_up_t = None
+        self._last_down_t = None
+        self._pending = {}     # index -> {"gen", "deadline", "probe"}
+        self._breaker = "closed"
+        self._breaker_until = None
+        self._counts = {}
+        self._decisions = []   # bounded trail for /statz
+        self._last_signal = None
+        self._degraded = False
+        self._degraded_error = None
+        self._closed = False
+        self._wake = threading.Event()
+        self._thread = None
+
+    # -- bookkeeping ---------------------------------------------------------
+    def _count(self, key, n=1):
+        from .. import profiler as _prof
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + n
+        _prof.update_autoscale_counters(**{key: n})
+
+    def _decision(self, action, **info):
+        with self._lock:
+            self._decisions.append(dict(info, action=action,
+                                        t=round(self._clock(), 3)))
+            del self._decisions[:-32]
+
+    def _active(self):
+        """The committed fleet size: live slots, a still-warming grow
+        included (it is capacity the budget already spent)."""
+        return len(self.pool.snapshot())
+
+    def signal(self):
+        """The control signal: the max per-model smoothed pressure (the
+        fleet is homogeneous — every replica serves every model, so the
+        hottest model sizes the pool). None before the first poll."""
+        vals = self.router.pressure_smoothed()
+        if not vals:
+            return None
+        return max(vals.values())
+
+    # -- the control tick ----------------------------------------------------
+    def tick(self):
+        """One control decision. Any failure inside — the armed
+        ``serving.autoscale`` site or a real bug — degrades the
+        controller to a FIXED fleet with a recorded event; the router
+        never dies with it."""
+        if self._degraded or self._closed:
+            return
+        try:
+            fault_point("serving.autoscale")
+            self._tick_inner()
+        except Exception as e:
+            from .. import profiler as _prof
+            self._degraded = True
+            self._degraded_error = repr(e)
+            record_event("autoscale_degraded", site="serving.autoscale",
+                         error=repr(e), replicas=self._safe_active())
+            _prof.update_autoscale_counters(autoscale_degraded=1)
+
+    def _safe_active(self):
+        try:
+            return self._active()
+        except Exception:
+            return None
+
+    def _tick_inner(self):
+        from .. import profiler as _prof
+        now = self._clock()
+        self._check_warmups(now)
+        sig = self.signal()
+        self._last_signal = sig
+        _prof.update_autoscale_counters(autoscale_ticks=1)
+        if sig is not None:
+            _prof.update_autoscale_counters(autoscale_pressure_max=sig)
+        if sig is None:
+            return
+        # streaks: CONSECUTIVE ticks on one side of a threshold. The
+        # dead band between down_pressure and up_pressure resets both —
+        # oscillating load never accumulates either decision.
+        self._up_streak = self._up_streak + 1 \
+            if sig >= self.up_pressure else 0
+        self._quiet_streak = self._quiet_streak + 1 \
+            if sig <= self.down_pressure else 0
+        if self._pending:
+            return    # a scale-up is still warming: one change at a time
+        active = self._active()
+        # floor reconciliation: a replica the pool declared LOST (spent
+        # restart budget) drops the fleet below min_replicas with no
+        # pressure required to notice — the floor is a guarantee, not a
+        # threshold. Rides the same cooldown and breaker gates as a
+        # pressure scale-up (a crash-looping artifact must not fight
+        # the floor forever).
+        if (active < self.min_replicas
+                and self._cooled(now, self._last_up_t, self.cooldown_s)):
+            if not self._breaker_allows(now):
+                self._count("autoscale_breaker_refused")
+                return
+            self._scale_up(now, sig, active, reason="floor")
+            return
+        if (self._up_streak >= self.k_up
+                and active < self.max_replicas
+                and self._cooled(now, self._last_up_t, self.cooldown_s)):
+            if not self._breaker_allows(now):
+                self._count("autoscale_breaker_refused")
+                return
+            self._scale_up(now, sig, active)
+            return        # one decision per tick
+        if (self._quiet_streak >= self.quiet_polls
+                and active > self.min_replicas
+                and self._cooled(now, self._last_down_t,
+                                 self.down_cooldown_s)
+                and self._cooled(now, self._last_up_t,
+                                 self.down_cooldown_s)):
+            self._scale_down(now, sig, active)
+
+    @staticmethod
+    def _cooled(now, last_t, cooldown):
+        return last_t is None or (now - last_t) >= cooldown
+
+    # -- breaker -------------------------------------------------------------
+    def _breaker_allows(self, now):
+        if self._breaker == "closed":
+            return True
+        if self._breaker == "open":
+            if self._breaker_until is not None \
+                    and now >= self._breaker_until:
+                self._breaker = "half_open"
+                record_event("autoscale_breaker_half_open",
+                             site="serving.autoscale")
+                self._count("autoscale_breaker_half_opens")
+                return True     # this tick's scale-up is the probe
+            return False
+        # half_open with no pending probe (the probe resolved the tick
+        # it was watched): allow another probe
+        return True
+
+    def _breaker_open(self, now, replica, reason):
+        self._breaker = "open"
+        self._breaker_until = now + self.breaker_backoff_s
+        record_event("autoscale_breaker_open", site="serving.autoscale",
+                     replica=replica, reason=reason,
+                     backoff_s=self.breaker_backoff_s)
+        self._count("autoscale_breaker_opens")
+        self._decision("breaker_open", replica=replica, reason=reason)
+
+    def _check_warmups(self, now):
+        """Watch every scale-up through its warm-up window: ready in
+        time closes the loop (and the breaker, for a probe); a death —
+        the pool respawned it (generation bump), marked it lost, or the
+        process is simply gone — or a warm-up timeout opens the
+        breaker and retires the crash-looping slot."""
+        for index in list(self._pending):
+            p = self._pending[index]
+            info = self.pool.slot_info(index)
+            died = (info["lost"] or info["retired"]
+                    or (info["generation"] is not None
+                        and info["generation"] > p["gen"])
+                    or (info["exists"] and not info["alive"]))
+            if info["ready"] and not died:
+                with self._lock:
+                    del self._pending[index]
+                if p["probe"] or self._breaker != "closed":
+                    self._breaker = "closed"
+                    self._breaker_until = None
+                    record_event("autoscale_breaker_close",
+                                 site="serving.autoscale", replica=index)
+                    self._count("autoscale_breaker_closes")
+                self._decision("warmed", replica=index)
+                continue
+            reason = None
+            if died:
+                reason = "lost" if info["lost"] else "died_in_warmup"
+            elif now >= p["deadline"]:
+                reason = "warmup_timeout"
+            if reason is None:
+                continue    # still booting, window open
+            with self._lock:
+                del self._pending[index]
+            self._breaker_open(now, index, reason)
+            # stop the pool burning restart budget on a crash loop the
+            # breaker already judged; shrink is idempotent on a lost
+            # slot (the process is gone either way)
+            if not info["retired"]:
+                try:
+                    self.pool.shrink(index)
+                except Exception:
+                    pass    # already lost/stopped: the retire is moot
+            self.router.forget(index)
+
+    # -- decisions -----------------------------------------------------------
+    def _scale_up(self, now, sig, active, reason="pressure"):
+        from .. import profiler as _prof
+        probe = self._breaker == "half_open"
+        rep = self.pool.grow()
+        with self._lock:
+            self._pending[rep.index] = {"gen": rep.generation,
+                                        "deadline": now + self.warmup_s,
+                                        "probe": probe}
+        self._up_streak = 0
+        self._quiet_streak = 0
+        self._last_up_t = now
+        record_event("autoscale_up", site="serving.autoscale",
+                     replica=rep.index, pressure=sig, reason=reason,
+                     replicas_from=active, replicas_to=active + 1,
+                     probe=probe)
+        self._count("autoscale_ups")
+        _prof.update_autoscale_counters(autoscale_replicas=active + 1)
+        self._decision("up", replica=rep.index, pressure=sig,
+                       replicas=active + 1, probe=probe, reason=reason)
+
+    def _pick_victim(self):
+        reps = self.pool.snapshot()
+        if not reps:
+            return None
+        return max(reps, key=lambda r: r.index).index
+
+    def _scale_down(self, now, sig, active):
+        from .. import profiler as _prof
+        # the whole drain+retire holds the pool's ONE membership lock:
+        # a rolling reload serializes against it instead of probing the
+        # replica we are draining
+        with self.pool.membership_lock:
+            victim = self._pick_victim()
+            if victim is None or self._active() <= self.min_replicas:
+                return     # membership changed while we waited the lock
+            self.router.set_draining(victim, True)
+            drained = self._await_drain(victim)
+            inflight = self.router.replica_inflight(victim)
+            rc = self.pool.shrink(victim)
+        self.router.forget(victim)
+        self._up_streak = 0
+        self._quiet_streak = 0
+        self._last_down_t = self._clock()
+        record_event("autoscale_down", site="serving.autoscale",
+                     replica=victim, pressure=sig,
+                     replicas_from=active, replicas_to=active - 1,
+                     drained=drained, inflight_at_stop=inflight, rc=rc)
+        self._count("autoscale_downs")
+        _prof.update_autoscale_counters(autoscale_replicas=active - 1)
+        self._decision("down", replica=victim, pressure=sig,
+                       replicas=active - 1, drained=drained)
+
+    def _await_drain(self, index):
+        """Wait for the router-tracked in-flight count at ``index`` to
+        reach zero, bounded by ``drain_deadline_s``. The slot is
+        already draining, so the count only falls. True = fully
+        drained; False = deadline hit (the worker's own SIGTERM drain
+        still runs — the escalation window is the second net)."""
+        deadline = self._clock() + self.drain_deadline_s
+        while self._clock() < deadline:
+            if self.router.replica_inflight(index) <= 0:
+                return True
+            self._sleep(min(0.05, self.drain_deadline_s))
+        return self.router.replica_inflight(index) <= 0
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        """Start the control thread (idempotent)."""
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="paddle_tpu-autoscaler")
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._closed:
+            self.tick()
+            if self._degraded:
+                return    # fixed fleet from here on; router lives
+            self._wake.wait(self.poll_s)
+            self._wake.clear()
+
+    def close(self):
+        self._closed = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.poll_s
+                              + self.drain_deadline_s + 2.0)
+
+    # -- observability -------------------------------------------------------
+    @property
+    def degraded(self):
+        return self._degraded
+
+    @property
+    def breaker_state(self):
+        return self._breaker
+
+    def stats(self):
+        """The ``autoscale`` section of RouterStats ``/statz``. Called
+        cross-thread (the /statz HTTP handlers through Router.stats);
+        everything the control thread mutates is snapshotted under the
+        state lock."""
+        with self._lock:
+            counts = dict(self._counts)
+            decisions = list(self._decisions[-8:])
+            warming = sorted(self._pending)
+            up_streak = self._up_streak
+            quiet_streak = self._quiet_streak
+            breaker = self._breaker
+            last_signal = self._last_signal
+            degraded = self._degraded
+            degraded_error = self._degraded_error
+        out = {
+            "active": self._safe_active(),
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+            "pressure": last_signal,
+            "up_pressure": self.up_pressure,
+            "down_pressure": self.down_pressure,
+            "k_up": self.k_up,
+            "quiet_polls": self.quiet_polls,
+            "up_streak": up_streak,
+            "quiet_streak": quiet_streak,
+            "warming": warming,
+            "breaker": breaker,
+            "degraded": degraded,
+            "ups": counts.get("autoscale_ups", 0),
+            "downs": counts.get("autoscale_downs", 0),
+            "breaker_opens": counts.get("autoscale_breaker_opens", 0),
+            "breaker_refused": counts.get("autoscale_breaker_refused",
+                                          0),
+            "last_decisions": decisions,
+        }
+        if degraded_error:
+            out["degraded_error"] = degraded_error
+        return out

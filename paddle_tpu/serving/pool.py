@@ -1,4 +1,4 @@
-"""Replica pool: spawn, supervise, and restart N ``serve`` workers.
+"""Replica pool: spawn, supervise, and resize N ``serve`` workers.
 
 One ``paddle_tpu serve`` process owns one batcher, one generation
 engine, one KV pool — which caps throughput at a single process and
@@ -8,7 +8,10 @@ registered in etcd and watched each other's health; here the pool IS
 the watcher): it spawns ``n`` identical ``serve`` subprocesses on free
 ports, reads each one's readiness line for the bound port, and treats
 worker death the way the elastic supervisor treats trainer death — as
-an event to classify and absorb, never a verdict:
+an event to classify and absorb, never a verdict. The classification
+arithmetic itself (restart budget, crash-loop window, backoff,
+generation bump, grace escalation) lives in the ONE shared
+:mod:`paddle_tpu.resilience.supervise` core both supervisors consume:
 
 - an unexpected exit (crash, OOM, an operator's ``kill -9``) restarts
   that replica on the resilience :class:`RetryPolicy` backoff schedule,
@@ -20,11 +23,22 @@ an event to classify and absorb, never a verdict:
   budget bounds crash loops, not the fleet's lifetime crash total;
 - a spent budget marks the replica **lost** (``router_replica_lost``
   event) — the remaining replicas keep serving, the pool never raises;
-- :meth:`ReplicaPool.stop` drains the fleet with the elastic
-  supervisor's escalation: SIGTERM (each worker's ``serve`` loop
-  drains in-flight requests and exits 0), then SIGKILL after
-  ``grace_sec`` — a worker wedged in a bad compile cannot hold the
-  pool hostage.
+- :meth:`ReplicaPool.stop` drains the fleet with the shared
+  escalation: SIGTERM (each worker's ``serve`` loop drains in-flight
+  requests and exits 0), then SIGKILL after ``grace_sec`` — a worker
+  wedged in a bad compile cannot hold the pool hostage. A restart
+  backoff pending at stop time is CANCELLED (the sleep rides a stop
+  event), so a closing pool can never spawn an orphan worker.
+
+The fleet is elastic at run time: :meth:`grow` adds a slot (the
+autoscaler's scale-up), :meth:`shrink` retires one — an EXPECTED exit
+the monitor will not respawn — with the same grace escalation (the
+autoscaler's drain-first scale-down). Every membership change (grow,
+shrink, restart respawn, lost) fires the registered ``on_membership``
+listeners so the router's poller picks up new and drained replicas
+mid-flight instead of at its next timer tick. All membership mutation
+serializes on ``membership_lock`` — the one lock the rolling reload
+and the autoscaler share, so a shrink can never land mid-rollout.
 
 The pool knows nothing about HTTP routing; it only answers "which
 worker processes exist right now, and are they ready". The router
@@ -43,6 +57,8 @@ import threading
 import time
 
 from ..resilience import RetryPolicy, record_event
+from ..resilience.supervise import (SlotSupervision, escalate_stop,
+                                    signal_quietly)
 # the shared lock constructor: plain threading primitives normally, the
 # lock-order race detector's instrumented ones under PADDLE_TPU_SANITIZE=locks
 from ..analysis import locks as _locks
@@ -131,10 +147,7 @@ class Replica(object):
         return self._ready.is_set()
 
     def signal(self, signum):
-        try:
-            self.proc.send_signal(signum)
-        except (ProcessLookupError, OSError):
-            pass
+        signal_quietly(self.proc, signum)
 
 
 class ReplicaPool(object):
@@ -143,8 +156,9 @@ class ReplicaPool(object):
     ``serve_args`` is the extra argv every worker gets (``--max_batch``,
     ``--extra_model name=dir``, ...); ``env_overrides`` maps replica
     index -> extra env vars for THAT worker (how the load harness arms
-    a fault spec in exactly one replica). Ports are always ``--port 0``
-    — each worker binds a free one and reports it on the readiness
+    a fault spec in exactly one replica — including a slot the
+    autoscaler will only grow into later). Ports are always ``--port
+    0`` — each worker binds a free one and reports it on the readiness
     line.
     """
 
@@ -177,16 +191,38 @@ class ReplicaPool(object):
             self.base_env["PYTHONPATH"] = (root + os.pathsep + pp if pp
                                            else root)
         self._lock = _locks.make_lock("serving.pool.state")
+        # membership mutation (grow/shrink/rolling-reload) serializes
+        # here — NOT on _lock, which protects the fast bookkeeping: a
+        # shrink holds membership_lock for its whole drain window
+        self.membership_lock = _locks.make_rlock("serving.pool.membership")
         self._replicas = [None] * self.n      # index -> Replica
-        self._restarts_used = [0] * self.n
-        self._lost = [False] * self.n
+        self._retired = [False] * self.n      # shrunk slots: no respawn
+        self._sup = SlotSupervision(
+            self.restart_budget,
+            retry=RetryPolicy(max_attempts=self.restart_budget + 1,
+                              backoff=0.25, multiplier=2.0,
+                              max_backoff=5.0, jitter=0.1, seed=0,
+                              name="router.replica_restart"))
         self._exits = queue.Queue()           # (index, generation, rc)
         self._closing = False
-        self._retry = RetryPolicy(max_attempts=self.restart_budget + 1,
-                                  backoff=0.25, multiplier=2.0,
-                                  max_backoff=5.0, jitter=0.1, seed=0,
-                                  name="router.replica_restart")
+        self._stop_event = threading.Event()  # cancels pending backoffs
+        self._listeners = []                  # membership-change callbacks
         self._monitor = None
+
+    # -- membership listeners ------------------------------------------------
+    def on_membership(self, fn):
+        """Register a zero-arg callback fired after every membership
+        change (grow/shrink/restart-respawn/lost) — the router hooks
+        its poll wake-up here so a change is seen mid-flight, not at
+        the next timer tick."""
+        self._listeners.append(fn)
+
+    def _notify_membership(self):
+        for fn in list(self._listeners):
+            try:
+                fn()
+            except Exception:
+                pass   # a listener's glitch must never stall supervision
 
     # -- spawning ------------------------------------------------------------
     def _spawn(self, index, generation):
@@ -239,9 +275,10 @@ class ReplicaPool(object):
 
     # -- supervision ---------------------------------------------------------
     def _monitor_loop(self):
-        """Classify exits: during shutdown they are expected; otherwise
-        restart on the budget, then declare the slot lost. Runs until
-        ``stop()`` flips ``_closing`` and the queue drains."""
+        """Classify exits: during shutdown they are expected, and so is
+        the exit of a slot :meth:`shrink` retired; otherwise restart on
+        the shared supervision budget, then declare the slot lost. Runs
+        until ``stop()`` flips ``_closing`` and the queue drains."""
         from .. import profiler as _prof
         while True:
             try:
@@ -253,22 +290,26 @@ class ReplicaPool(object):
             with self._lock:
                 if self._closing:
                     continue
+                if self._retired[index]:
+                    continue      # shrink's expected exit, not a crash
                 current = self._replicas[index]
                 if current is None or current.generation != generation:
                     continue      # stale exit of an already-replaced proc
-                used = self._restarts_used[index]
-                if used >= self.restart_budget:
-                    self._lost[index] = True
+                decision = self._sup.classify_exit(index)
+                if decision.action == "lost":
                     record_event("router_replica_lost", site="serving.route",
                                  replica=index, rc=rc,
-                                 restarts_used=used)
+                                 restarts_used=decision.used)
                     _prof.update_router_counters(router_replica_lost=1)
-                    continue
-                self._restarts_used[index] = used + 1
-            delay = self._retry.delay(used + 1)
+                    lost = True
+                else:
+                    lost = False
+            if lost:
+                self._notify_membership()
+                continue
             record_event("router_replica_restart", site="serving.route",
-                         replica=index, rc=rc, attempt=used + 1,
-                         backoff_sec=round(delay, 3))
+                         replica=index, rc=rc, attempt=decision.attempt,
+                         backoff_sec=round(decision.backoff_sec, 3))
             _prof.update_router_counters(router_replica_restarts=1)
             # the backoff sleeps on its own thread: one replica's
             # backoff must not delay the monitor's classification (and
@@ -276,16 +317,28 @@ class ReplicaPool(object):
             # queue
             threading.Thread(
                 target=self._respawn_after,
-                args=(index, generation, delay), daemon=True,
+                args=(index, current, decision.backoff_sec), daemon=True,
                 name="paddle_tpu-replica-%d-respawn" % index).start()
 
-    def _respawn_after(self, index, generation, delay):
-        time.sleep(delay)
+    def _respawn_after(self, index, dead, delay):
+        # the backoff rides the stop event, NOT time.sleep: stop() (or
+        # a shrink retiring this slot) cancels the pending respawn
+        # instead of letting it fire into a closing pool and orphan a
+        # serve worker. ``dead`` is the replica whose exit scheduled
+        # this respawn: if the slot holds anything else by wake-up
+        # time (a shrink retired it and a later grow() RECYCLED the
+        # index), the respawn is stale — spawning would overwrite the
+        # recycled worker and orphan it
+        if self._stop_event.wait(delay):
+            return
         with self._lock:
-            if self._closing:
+            if self._closing or self._retired[index]:
                 return
-            rep = self._spawn(index, generation + 1)
+            if self._replicas[index] is not dead:
+                return
+            rep = self._spawn(index, self._sup.bump_generation(index))
             self._replicas[index] = rep
+        self._notify_membership()
         threading.Thread(
             target=self._maybe_reset_budget, args=(rep,), daemon=True,
             name="paddle_tpu-replica-%d-budget" % index).start()
@@ -296,30 +349,138 @@ class ReplicaPool(object):
         lifetime total: a long-running fleet must not march to lost
         replicas on one recoverable crash a week (the systemd
         StartLimitIntervalSec / erlang supervisor convention)."""
-        time.sleep(self.budget_reset_s)
+        if self._stop_event.wait(self.budget_reset_s):
+            return
         with self._lock:
             if (not self._closing and rep.alive
                     and self._replicas[rep.index] is rep):
-                self._restarts_used[rep.index] = 0
+                self._sup.note_stable(rep.index)
+
+    # -- elastic membership --------------------------------------------------
+    def grow(self):
+        """Add one slot to the fleet (the autoscaler's scale-up):
+        recycle the lowest retired (cleanly shrunk, not lost) slot if
+        one exists — an oscillating up/down/up fleet must not grow the
+        slot table without bound — else spawn at the next index. The
+        recycled slot comes back on a bumped generation (any stale
+        state keyed on the old one resets) with a clean restart
+        record, supervised exactly like the original fleet. Does NOT
+        wait for readiness — the caller watches the returned
+        :class:`Replica` (the autoscaler's warm-up window). Returns
+        the new replica."""
+        from .. import profiler as _prof
+        with self.membership_lock:
+            with self._lock:
+                if self._closing:
+                    raise RuntimeError("pool is stopped")
+                index = None
+                for i, retired in enumerate(self._retired):
+                    if retired and not self._sup.is_lost(i):
+                        index = i
+                        break
+                appended = index is None
+                if appended:
+                    index = len(self._replicas)
+                    self._replicas.append(None)
+                    self._retired.append(False)
+                    self.n = len(self._replicas)
+                    generation = 0
+                else:
+                    self._retired[index] = False
+                    self._sup.note_stable(index)
+                    generation = self._sup.bump_generation(index)
+                try:
+                    rep = self._spawn(index, generation)
+                except Exception:
+                    # a failed Popen must not corrupt the slot table:
+                    # un-append the fresh slot, or put a recycled one
+                    # back in the retired (re-recyclable) state
+                    if appended:
+                        self._replicas.pop()
+                        self._retired.pop()
+                        self.n = len(self._replicas)
+                    else:
+                        self._retired[index] = True
+                    raise
+                self._replicas[index] = rep
+                active = self._active_count_locked()
+        record_event("router_replica_added", site="serving.route",
+                     replica=index, pid=rep.pid)
+        _prof.update_router_counters(router_replicas=active)
+        self._notify_membership()
+        return rep
+
+    def _active_count_locked(self):
+        return sum(1 for i, r in enumerate(self._replicas)
+                   if r is not None and not self._sup.is_lost(i)
+                   and not self._retired[i])
+
+    def shrink(self, index, grace_sec=None):
+        """Retire slot ``index`` (the autoscaler's drain-first
+        scale-down): mark it retired FIRST — its exit is expected, the
+        monitor will not respawn it and a pending restart backoff is
+        abandoned — then drain the worker with the shared SIGTERM ->
+        SIGKILL escalation. Returns the worker's exit code (None if the
+        slot had no live process)."""
+        with self.membership_lock:
+            with self._lock:
+                if not 0 <= index < len(self._replicas):
+                    raise IndexError("no replica slot %d" % index)
+                self._retired[index] = True
+                rep = self._replicas[index]
+            rc = None
+            if rep is not None and rep.proc.poll() is None:
+                rc = escalate_stop(
+                    [(index, rep.proc)],
+                    self.grace_sec if grace_sec is None else grace_sec,
+                ).get(index)
+            elif rep is not None:
+                rc = rep.proc.poll()
+        record_event("router_replica_retired", site="serving.route",
+                     replica=index, rc=rc)
+        self._notify_membership()
+        return rc
+
+    def slot_info(self, index):
+        """One slot's supervision state — what the autoscaler's warm-up
+        watch reads (a generation bump or a lost mark inside the
+        warm-up window is a crash-looping scale-up)."""
+        with self._lock:
+            rep = (self._replicas[index]
+                   if 0 <= index < len(self._replicas) else None)
+            return {
+                "exists": rep is not None,
+                "generation": rep.generation if rep is not None else None,
+                "alive": bool(rep is not None and rep.alive),
+                "ready": bool(rep is not None and rep.ready),
+                "lost": self._sup.is_lost(index),
+                "retired": (self._retired[index]
+                            if 0 <= index < len(self._retired) else True),
+            }
 
     # -- the router's view ---------------------------------------------------
     def snapshot(self):
-        """Current replica list (lost slots excluded) — the router polls
-        this; a restarted worker shows up with a bumped generation and a
-        fresh port."""
+        """Current replica list (lost and retired slots excluded) — the
+        router polls this; a restarted worker shows up with a bumped
+        generation and a fresh port, a grown one at a new index."""
         with self._lock:
             return [r for i, r in enumerate(self._replicas)
-                    if r is not None and not self._lost[i]]
+                    if r is not None and not self._sup.is_lost(i)
+                    and not self._retired[i]]
 
     def describe(self):
         with self._lock:
+            indices = range(len(self._replicas))
             return {
                 "replicas": self.n,
-                "lost": [i for i, x in enumerate(self._lost) if x],
-                "restarts_used": list(self._restarts_used),
+                "active": self._active_count_locked(),
+                "lost": self._sup.lost_slots(),
+                "retired": [i for i in indices if self._retired[i]],
+                "restarts_used": self._sup.used_map(indices),
                 "workers": [
                     {"index": r.index, "generation": r.generation,
-                     "pid": r.pid, "port": r.port, "ready": r.ready}
+                     "pid": r.pid, "port": r.port, "ready": r.ready,
+                     "retired": self._retired[r.index]}
                     for r in self._replicas if r is not None],
             }
 
@@ -335,22 +496,14 @@ class ReplicaPool(object):
     # -- shutdown ------------------------------------------------------------
     def stop(self):
         """SIGTERM the fleet (each worker drains and exits 0), escalate
-        to SIGKILL after ``grace_sec``; returns {index: rc}."""
+        to SIGKILL after ``grace_sec``; pending restart backoffs are
+        cancelled. Returns {index: rc}."""
         with self._lock:
             self._closing = True
+            self._stop_event.set()
             reps = [r for r in self._replicas if r is not None]
-        for r in reps:
-            if r.alive:
-                r.signal(signal.SIGTERM)
-        deadline = time.monotonic() + max(self.grace_sec, 0.0)
-        rcs = {}
-        for r in reps:
-            remaining = deadline - time.monotonic()
-            try:
-                rcs[r.index] = r.proc.wait(timeout=max(remaining, 0.0))
-            except subprocess.TimeoutExpired:
-                r.proc.kill()
-                rcs[r.index] = r.proc.wait()
+        rcs = escalate_stop(((r.index, r.proc) for r in reps),
+                            self.grace_sec)
         if self._monitor is not None and self._monitor.is_alive():
             self._monitor.join(timeout=5.0)
         return rcs
@@ -387,14 +540,22 @@ class StaticReplica(object):
 class StaticPool(object):
     """Route over a fixed address list instead of supervised
     subprocesses: ``StaticPool(["127.0.0.1:8500", ...])``. No restarts
-    — a dead address is the router's eject machinery's problem."""
+    — a dead address is the router's eject machinery's problem — and no
+    autoscaling (grow/shrink raise: someone else owns the membership);
+    ``membership_lock`` still exists so the rolling reload serializes
+    the same way over either pool kind."""
 
     def __init__(self, addresses):
+        self.membership_lock = _locks.make_rlock(
+            "serving.pool.membership")
         self._replicas = []
         for i, addr in enumerate(addresses):
             host, _, port = str(addr).rpartition(":")
             self._replicas.append(
                 StaticReplica(i, host or "127.0.0.1", int(port)))
+
+    def on_membership(self, fn):
+        pass   # static membership never changes
 
     def snapshot(self):
         return list(self._replicas)
@@ -407,6 +568,12 @@ class StaticPool(object):
 
     def kill(self, index, signum=None):
         raise RuntimeError("StaticPool does not own its workers")
+
+    def grow(self):
+        raise RuntimeError("StaticPool does not own its membership")
+
+    def shrink(self, index, grace_sec=None):
+        raise RuntimeError("StaticPool does not own its membership")
 
     def stop(self):
         return {}

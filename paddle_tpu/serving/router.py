@@ -52,7 +52,20 @@ signal: per-model ``pressure = backlog / capacity + shed_rate``, where
 backlog and capacity aggregate over healthy replicas (queued work vs.
 ``max_batch``/``max_running`` slots) and ``shed_rate`` is the shed
 fraction since the previous poll. Sustained pressure > 1.0 means the
-fleet is undersized; ~0 means it can shrink.
+fleet is undersized; ~0 means it can shrink. Both the RAW per-poll
+value and an EWMA-smoothed one (``FLAGS.route_pressure_alpha``) are
+exposed — the closed-loop autoscaler
+(:mod:`paddle_tpu.serving.autoscale`) acts only on the smoothed
+signal, so one poll-window spike can neither trigger a scale-up nor
+mask a real sustained overload.
+
+**Membership.** The router registers with the pool's
+``on_membership`` hook: a grow, shrink, restart respawn, or lost slot
+wakes the poller immediately, so new and drained replicas are picked
+up mid-flight instead of at the next timer tick. Rolling reload and
+the autoscaler's membership mutations serialize on the pool's ONE
+``membership_lock`` — a shrink can never land mid-rollout and a
+rollout can never probe a replica the autoscaler just drained.
 """
 from __future__ import annotations
 
@@ -112,7 +125,7 @@ class Router(object):
 
     def __init__(self, pool, policy="least_loaded", poll_ms=None,
                  eject_after=None, readmit_after=None,
-                 proxy_timeout_s=None):
+                 proxy_timeout_s=None, pressure_alpha=None):
         from ..flags import FLAGS
         if policy not in ("least_loaded", "round_robin"):
             raise ValueError("policy must be least_loaded or round_robin, "
@@ -128,17 +141,34 @@ class Router(object):
         self.proxy_timeout_s = float(
             proxy_timeout_s if proxy_timeout_s is not None
             else FLAGS.route_proxy_timeout_s)
+        self.pressure_alpha = float(
+            pressure_alpha if pressure_alpha is not None
+            else FLAGS.route_pressure_alpha)
+        if not 0.0 < self.pressure_alpha <= 1.0:
+            raise ValueError("pressure_alpha must be in (0, 1], got %r"
+                             % self.pressure_alpha)
         self._lock = _locks.make_lock("serving.router.state")
         self._states = {}            # pool index -> _ReplicaState
         self._counts = {}            # router-level counters
         self._latency_ms = []        # bounded: recent proxied latencies
         self._prev_model_counts = {} # model -> (requests, sheds) last poll
-        self._pressure = {}          # model -> latest pressure snapshot
+        self._pressure = {}          # model -> latest RAW pressure
+        self._pressure_ewma = {}     # model -> EWMA-smoothed pressure
         self._rr_next = 0
-        self._reload_lock = _locks.make_lock("serving.router.reload")
+        # membership mutation (rolling reload here, grow/shrink in the
+        # autoscaler) serializes on the POOL's one lock
+        self._membership_lock = getattr(pool, "membership_lock", None)
+        if self._membership_lock is None:
+            self._membership_lock = _locks.make_rlock(
+                "serving.pool.membership")
         self._poller = None
+        self._poll_wake = threading.Event()
         self._probe_exec = None
         self._closed = False
+        self.autoscaler = None       # attached by serving.autoscale
+        register = getattr(pool, "on_membership", None)
+        if register is not None:
+            register(self.notify_membership)
 
     def _probe_pool(self):
         """Reused executor for the concurrent health/load probes — a
@@ -210,10 +240,18 @@ class Router(object):
     # -- polling -------------------------------------------------------------
     def _state_for(self, rep):
         """Find-or-make the state for a pool slot, resetting it when the
-        pool respawned the process (generation bump)."""
+        pool respawned the process (generation bump). The HEALTH record
+        resets with the process — a fresh worker must not inherit its
+        predecessor's eject record — but ``draining`` is a SLOT-level
+        policy mark (an autoscaler drain or a rolling reload in
+        progress): a victim that crashes and respawns mid-drain must
+        not silently re-enter rotation while its shrink proceeds."""
         st = self._states.get(rep.index)
         if st is None or st.generation != rep.generation:
-            st = _ReplicaState(rep.index, rep.generation)
+            fresh = _ReplicaState(rep.index, rep.generation)
+            if st is not None:
+                fresh.draining = st.draining
+            st = fresh
             self._states[rep.index] = st
         return st
 
@@ -348,7 +386,22 @@ class Router(object):
                     4)
                 self._prev_model_counts[name] = (requests[name],
                                                  sheds[name])
+            # EWMA smoothing: the autoscaler's signal. Seeded with the
+            # first raw sample; a model that vanished from every statz
+            # decays from its last value instead of sticking (an empty
+            # poll sweep must read as pressure falling to zero)
+            a = self.pressure_alpha
+            ewma = {}
+            for name in set(pressure) | set(self._pressure_ewma):
+                raw = pressure.get(name, 0.0)
+                prev = self._pressure_ewma.get(name)
+                s = round(raw if prev is None
+                          else a * raw + (1.0 - a) * prev, 4)
+                if name not in pressure and s <= 1e-3:
+                    continue   # fully decayed and gone from every statz
+                ewma[name] = s
             self._pressure = pressure
+            self._pressure_ewma = ewma
 
     def start_polling(self):
         """Start the background poll thread (idempotent)."""
@@ -366,16 +419,69 @@ class Router(object):
             except Exception as e:   # the poller must outlive any glitch
                 record_event("router_poll_error", site="serving.route",
                              error=repr(e))
-            time.sleep(self.poll_s)
+            # the sleep rides an event: a membership change (grow,
+            # shrink, restart respawn) wakes the poller immediately so
+            # the new fleet shape is scored mid-flight, and close()
+            # does not wait out a full interval
+            self._poll_wake.wait(self.poll_s)
+            self._poll_wake.clear()
+
+    def notify_membership(self):
+        """Pool membership changed (grow/shrink/restart/lost): wake the
+        poller now instead of at its next timer tick. Registered with
+        the pool's ``on_membership`` hook at construction."""
+        self._poll_wake.set()
 
     def close(self):
         self._closed = True
+        self._poll_wake.set()
         if self._poller is not None:
             self._poller.join(timeout=self.poll_s + 2.0)
         with self._lock:
             exec_, self._probe_exec = self._probe_exec, None
         if exec_ is not None:
             exec_.shutdown(wait=False)
+
+    # -- the autoscaler's handles -------------------------------------------
+    def pressure_raw(self):
+        """Latest per-model raw pressure (one poll window)."""
+        with self._lock:
+            return dict(self._pressure)
+
+    def pressure_smoothed(self):
+        """Latest per-model EWMA-smoothed pressure — the only signal
+        the autoscaler acts on."""
+        with self._lock:
+            return dict(self._pressure_ewma)
+
+    def set_draining(self, index, draining):
+        """Hold new work off replica ``index`` (or release it) — the
+        autoscaler's drain-first step before a shrink. Returns whether
+        a state for the slot existed."""
+        with self._lock:
+            st = self._states.get(index)
+            if st is None:
+                for rep in self.pool.snapshot():
+                    if rep.index == index:
+                        st = self._state_for(rep)
+                        break
+            if st is None:
+                return False
+            st.draining = bool(draining)
+            return True
+
+    def replica_inflight(self, index):
+        """Router-tracked proxied requests outstanding at ``index`` —
+        what the drain step waits to hit zero."""
+        with self._lock:
+            st = self._states.get(index)
+            return st.inflight if st is not None else 0
+
+    def forget(self, index):
+        """Drop the router-side state of a slot the pool retired —
+        a future slot reusing the index must start clean."""
+        with self._lock:
+            self._states.pop(index, None)
 
     # -- picking -------------------------------------------------------------
     def _routable(self, exclude=()):
@@ -556,14 +662,19 @@ class Router(object):
         healthy majority's upgrade by hanging its reload and aborting
         the rollout; skipped indices ride the answer so the operator
         knows to re-issue ``:reload`` once they recover (a skipped
-        replica readmits on its OLD artifact). Returns (status,
-        body)."""
-        with self._reload_lock:
+        replica readmits on its OLD artifact). Already-draining
+        replicas (an autoscaler shrink in progress) are skipped the
+        same way. The whole rollout holds the pool's ONE
+        ``membership_lock``, so a shrink cannot land mid-reload and
+        have the loop probe a replica the autoscaler just drained.
+        Returns (status, body)."""
+        with self._membership_lock:
             reps, skipped = [], []
             for r in self.pool.snapshot():
                 with self._lock:
-                    ejected = self._state_for(r).ejected
-                if r.ready and not ejected:
+                    st = self._state_for(r)
+                    ineligible = st.ejected or st.draining
+                if r.ready and not ineligible:
                     reps.append(r)
                 else:
                     skipped.append(r.index)
@@ -574,9 +685,11 @@ class Router(object):
             done = []        # [(rep, previous_dirname)]
             for rep in reps:
                 prev = self._current_dirname(rep, name)
-                with self._lock:
-                    st = self._state_for(rep)
-                    st.draining = True
+                # index-based, not via a captured state object: if the
+                # replica crashes and respawns mid-reload, the clear
+                # below must land on the CURRENT slot state, not a
+                # stale generation's
+                self.set_draining(rep.index, True)
                 try:
                     try:
                         status, payload, _ = self._post_json(
@@ -592,8 +705,7 @@ class Router(object):
                                      "health gate" % rep.index,
                             "kind": "health_gate"}
                 finally:
-                    with self._lock:
-                        st.draining = False
+                    self.set_draining(rep.index, False)
                 if status != 200:
                     rolled_back, rb_failed = self._roll_back(name, done)
                     record_event(
@@ -634,9 +746,7 @@ class Router(object):
             if not prev:
                 failed.append(rep.index)
                 continue
-            with self._lock:
-                st = self._state_for(rep)
-                st.draining = True
+            self.set_draining(rep.index, True)
             try:
                 try:
                     status, _, _ = self._post_json(
@@ -651,8 +761,7 @@ class Router(object):
                     # rollback — the replica is wedged, not restored
                     failed.append(rep.index)
             finally:
-                with self._lock:
-                    st.draining = False
+                self.set_draining(rep.index, False)
         return rolled, failed
 
     # -- stats ---------------------------------------------------------------
@@ -681,11 +790,15 @@ class Router(object):
                 }
             counts = dict(self._counts)
             pressure = dict(self._pressure)
+            pressure_smoothed = dict(self._pressure_ewma)
         routed = [r["routed"] for r in replicas.values()] or [0]
-        return {
+        autoscale = (self.autoscaler.stats()
+                     if self.autoscaler is not None else None)
+        out = {
             "policy": self.policy,
             "replicas": replicas,
             "pressure": pressure,
+            "pressure_smoothed": pressure_smoothed,
             "proxied": counts.get("router_requests", 0),
             "failovers": counts.get("router_failovers", 0),
             "no_replica": counts.get("router_no_replica", 0),
@@ -700,6 +813,9 @@ class Router(object):
             "routed_min": min(routed),
             "pool": self.pool.describe(),
         }
+        if autoscale is not None:
+            out["autoscale"] = autoscale
+        return out
 
     def reset_stats(self):
         """Zero the routing/latency counters and per-replica peaks (the

@@ -81,6 +81,7 @@ LOCK_SANITIZED_FILES = {
     "test_serving.py",
     "test_router.py",
     "test_generation.py",
+    "test_autoscale.py",
 }
 
 

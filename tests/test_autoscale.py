@@ -1,0 +1,636 @@
+"""Closed-loop autoscaler (paddle_tpu.serving.autoscale) + shared
+supervision core (paddle_tpu.resilience.supervise) acceptance suite.
+
+Contracts under test — the control loop over SCRIPTED pool/router
+fakes with an injected clock (the state machine is deterministic, no
+threads, no sockets): flap guard under oscillating load (the dead band
+between the thresholds accumulates neither decision), scale-up after
+k_up sustained polls bounded by max_replicas and the up cooldown,
+scale-down only after the longer quiet window and drain-FIRST (the
+victim is marked draining and in-flight runs to zero — or the drain
+deadline — before the slot is retired), the crash-loop circuit
+breaker's open/half-open/close walk, and the armed
+``serving.autoscale`` site degrading the controller to a fixed fleet
+without touching the router.
+
+The supervision-core half: SlotSupervision budget arithmetic matches
+what the replica pool and the elastic supervisor each implemented
+before the extraction (the parity tests), escalate_stop really
+escalates SIGTERM -> SIGKILL over live processes, a ReplicaPool
+stop()/shrink() cancels a pending restart-backoff respawn, and the
+rolling reload serializes on the pool's ONE membership lock.
+"""
+import json
+import subprocess
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from paddle_tpu import resilience
+from paddle_tpu.resilience import RetryPolicy
+from paddle_tpu.resilience.supervise import (SlotSupervision,
+                                             escalate_stop)
+from paddle_tpu.serving import Autoscaler, Router, StaticPool
+from paddle_tpu.serving.pool import ReplicaPool
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    resilience.reset()
+    resilience.clear_events()
+    yield
+    resilience.reset()
+
+
+# -- scripted fakes -----------------------------------------------------------
+
+class _Clock(object):
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+class _Slot(object):
+    def __init__(self, index, ready=True, alive=True):
+        self.index = index
+        self.generation = 0
+        self.ready = ready
+        self.alive = alive
+        self.lost = False
+        self.retired = False
+
+
+class _ScriptedPool(object):
+    """ReplicaPool's membership face, scripted: tests flip slot state
+    (ready/alive/lost/generation) to drive the warm-up watch."""
+
+    def __init__(self, n=1, ready_on_grow=False):
+        self.membership_lock = threading.RLock()
+        self.ready_on_grow = ready_on_grow
+        self.slots = {i: _Slot(i) for i in range(n)}
+        self.grown = []
+        self.shrunk = []
+
+    def snapshot(self):
+        return [s for s in self.slots.values()
+                if not s.lost and not s.retired]
+
+    def grow(self):
+        idx = (max(self.slots) + 1) if self.slots else 0
+        s = _Slot(idx, ready=self.ready_on_grow)
+        self.slots[idx] = s
+        self.grown.append(idx)
+        return s
+
+    def shrink(self, index, grace_sec=None):
+        self.slots[index].retired = True
+        self.shrunk.append(index)
+        return 0
+
+    def slot_info(self, index):
+        s = self.slots.get(index)
+        if s is None:
+            return {"exists": False, "generation": None, "alive": False,
+                    "ready": False, "lost": False, "retired": True}
+        return {"exists": True, "generation": s.generation,
+                "alive": s.alive, "ready": s.ready, "lost": s.lost,
+                "retired": s.retired}
+
+
+class _ScriptedRouter(object):
+    poll_s = 0.01
+
+    def __init__(self):
+        self.pressure = {}
+        self.draining_calls = []
+        self.forgot = []
+        self.inflight_seq = {}     # index -> successive drain readings
+        self.inflight_default = 0
+
+    def pressure_smoothed(self):
+        return dict(self.pressure)
+
+    def set_draining(self, index, value):
+        self.draining_calls.append((index, bool(value)))
+        return True
+
+    def replica_inflight(self, index):
+        seq = self.inflight_seq.get(index)
+        if seq:
+            return seq.pop(0) if len(seq) > 1 else seq[0]
+        return self.inflight_default
+
+    def forget(self, index):
+        self.forgot.append(index)
+
+    def notify_membership(self):
+        pass
+
+
+def _scaler(pool, router, clock, **kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 3)
+    kw.setdefault("up_pressure", 1.0)
+    kw.setdefault("down_pressure", 0.2)
+    kw.setdefault("k_up", 3)
+    kw.setdefault("quiet_polls", 5)
+    kw.setdefault("cooldown_s", 10.0)
+    kw.setdefault("down_cooldown_s", 20.0)
+    kw.setdefault("poll_s", 1.0)
+    kw.setdefault("warmup_s", 30.0)
+    kw.setdefault("breaker_backoff_s", 60.0)
+    kw.setdefault("drain_deadline_s", 1.0)
+    return Autoscaler(router, pool, clock=clock, sleep=clock.advance,
+                      **kw)
+
+
+def _tick(a, clock, pressure=None, n=1, dt=1.0):
+    for _ in range(n):
+        if pressure is not None:
+            a.router.pressure["m"] = pressure
+        clock.advance(dt)
+        a.tick()
+
+
+# -- the control loop ---------------------------------------------------------
+
+def test_flap_guard_oscillating_load_never_thrashes():
+    """Load flapping across the whole band every tick accumulates
+    neither streak: zero decisions over 40 ticks."""
+    clock = _Clock()
+    pool, router = _ScriptedPool(n=2), _ScriptedRouter()
+    a = _scaler(pool, router, clock)
+    for i in range(40):
+        _tick(a, clock, pressure=(5.0 if i % 2 == 0 else 0.0))
+    assert pool.grown == [] and pool.shrunk == []
+    assert resilience.events(kind="autoscale_up") == []
+    assert resilience.events(kind="autoscale_down") == []
+
+
+def test_scale_up_after_k_sustained_polls_then_cooldown():
+    clock = _Clock()
+    pool, router = _ScriptedPool(n=1), _ScriptedRouter()
+    a = _scaler(pool, router, clock)
+    _tick(a, clock, pressure=2.0, n=2)
+    assert pool.grown == []          # streak of 2 < k_up=3
+    _tick(a, clock, pressure=2.0)
+    assert pool.grown == [1]         # third consecutive poll fires
+    ups = resilience.events(kind="autoscale_up")
+    assert len(ups) == 1 and ups[0]["replicas_to"] == 2
+    # still warming: no second grow no matter the pressure
+    _tick(a, clock, pressure=5.0, n=3)
+    assert pool.grown == [1]
+    # warmed, but inside the 10s up-cooldown: still just one
+    pool.slots[1].ready = True
+    _tick(a, clock, pressure=5.0, n=3, dt=1.0)
+    assert pool.grown == [1]
+    # past the cooldown the sustained overload buys the next replica
+    _tick(a, clock, pressure=5.0, n=3, dt=4.0)
+    assert pool.grown == [1, 2]
+
+
+def test_scale_up_respects_max_replicas():
+    clock = _Clock()
+    pool, router = _ScriptedPool(n=3), _ScriptedRouter()
+    a = _scaler(pool, router, clock, max_replicas=3)
+    _tick(a, clock, pressure=9.0, n=10, dt=5.0)
+    assert pool.grown == []
+    assert a.stats()["active"] == 3
+
+
+def test_scale_down_waits_longer_quiet_window():
+    clock = _Clock()
+    pool, router = _ScriptedPool(n=2), _ScriptedRouter()
+    a = _scaler(pool, router, clock)
+    _tick(a, clock, pressure=0.0, n=4, dt=6.0)
+    assert pool.shrunk == []         # quiet streak 4 < quiet_polls=5
+    _tick(a, clock, pressure=0.0, dt=6.0)
+    assert pool.shrunk == [1]        # highest-index slot is the victim
+
+
+def test_scale_down_drains_before_stop_and_zero_inflight():
+    clock = _Clock()
+    pool, router = _ScriptedPool(n=2), _ScriptedRouter()
+    router.inflight_seq[1] = [2, 1, 0]
+    a = _scaler(pool, router, clock)
+    _tick(a, clock, pressure=0.0, n=5, dt=6.0)
+    # drain-first ordering: draining marked, inflight ran to zero,
+    # THEN the slot retired and the router state dropped
+    assert router.draining_calls == [(1, True)]
+    assert pool.shrunk == [1]
+    assert router.forgot == [1]
+    ev = resilience.events(kind="autoscale_down")
+    assert len(ev) == 1
+    assert ev[0]["drained"] is True
+    assert ev[0]["inflight_at_stop"] == 0
+    assert ev[0]["replicas_to"] == 1
+
+
+def test_scale_down_drain_deadline_bounds_the_wait():
+    clock = _Clock()
+    pool, router = _ScriptedPool(n=2), _ScriptedRouter()
+    router.inflight_seq[1] = [3]     # never drains
+    a = _scaler(pool, router, clock, drain_deadline_s=0.5)
+    _tick(a, clock, pressure=0.0, n=5, dt=6.0)
+    assert pool.shrunk == [1]        # bounded: the shrink still lands
+    ev = resilience.events(kind="autoscale_down")
+    assert ev[0]["drained"] is False
+    assert ev[0]["inflight_at_stop"] == 3
+
+
+def test_floor_reconciliation_after_lost_replica():
+    """min_replicas is a GUARANTEE, not a threshold: a replica the
+    pool declared lost drops the fleet below the floor and the
+    controller grows back WITHOUT any pressure — gated by the same
+    cooldown and breaker as a pressure scale-up."""
+    clock = _Clock()
+    pool, router = _ScriptedPool(n=2), _ScriptedRouter()
+    a = _scaler(pool, router, clock, min_replicas=2, max_replicas=3)
+    pool.slots[1].lost = True       # budget-exhausted crash
+    _tick(a, clock, pressure=0.0)   # quiet load: no up-streak at all
+    assert pool.grown == [2]
+    up = resilience.events(kind="autoscale_up")[-1]
+    assert up["reason"] == "floor"
+    # the replacement warms; the fleet sits at the floor again
+    pool.slots[2].ready = True
+    _tick(a, clock, pressure=0.0, n=3)
+    assert a.stats()["active"] == 2
+    assert len(resilience.events(kind="autoscale_up")) == 1
+
+
+def test_scale_down_respects_min_replicas():
+    clock = _Clock()
+    pool, router = _ScriptedPool(n=1), _ScriptedRouter()
+    a = _scaler(pool, router, clock, min_replicas=1)
+    _tick(a, clock, pressure=0.0, n=20, dt=6.0)
+    assert pool.shrunk == []
+
+
+def test_scale_down_waits_out_cooldown_since_last_up():
+    """Hysteresis across directions: a replica added moments ago is
+    not immediately drained when the burst ends — the down decision
+    waits down_cooldown_s since the LAST scale-up."""
+    clock = _Clock()
+    pool, router = _ScriptedPool(n=1), _ScriptedRouter()
+    a = _scaler(pool, router, clock, down_cooldown_s=50.0)
+    _tick(a, clock, pressure=2.0, n=3)
+    assert pool.grown == [1]
+    pool.slots[1].ready = True
+    # quiet immediately after the up: streak passes quiet_polls but
+    # the since-last-up cooldown (50s) holds the shrink back
+    _tick(a, clock, pressure=0.0, n=8, dt=2.0)
+    assert pool.shrunk == []
+    _tick(a, clock, pressure=0.0, n=6, dt=10.0)
+    assert pool.shrunk == [1]
+
+
+def test_breaker_opens_on_warmup_death_and_refuses_ups():
+    clock = _Clock()
+    pool, router = _ScriptedPool(n=1), _ScriptedRouter()
+    a = _scaler(pool, router, clock)
+    _tick(a, clock, pressure=2.0, n=3)
+    assert pool.grown == [1]
+    # the fresh replica crash-loops: the pool respawned it once
+    # (generation bump) and then it died for good
+    pool.slots[1].generation = 1
+    pool.slots[1].alive = False
+    _tick(a, clock, pressure=2.0)
+    assert a.breaker_state == "open"
+    opens = resilience.events(kind="autoscale_breaker_open")
+    assert len(opens) == 1 and opens[0]["replica"] == 1
+    assert pool.shrunk == [1]        # the crash loop is retired
+    # sustained pressure + elapsed cooldown: the open breaker refuses
+    _tick(a, clock, pressure=5.0, n=5, dt=4.0)
+    assert pool.grown == [1]
+    assert a.stats()["breaker_refused"] >= 1
+    assert len(resilience.events(kind="autoscale_up")) == 1
+
+
+def test_breaker_half_open_probe_closes_on_success():
+    clock = _Clock()
+    pool, router = _ScriptedPool(n=1), _ScriptedRouter()
+    a = _scaler(pool, router, clock, breaker_backoff_s=60.0)
+    _tick(a, clock, pressure=2.0, n=3)
+    pool.slots[1].alive = False
+    _tick(a, clock, pressure=2.0)
+    assert a.breaker_state == "open"
+    # past the backoff: exactly one probe scale-up goes through
+    _tick(a, clock, pressure=2.0, n=2, dt=30.0)
+    assert pool.grown == [1, 2]
+    assert resilience.events(kind="autoscale_breaker_half_open")
+    probe_up = resilience.events(kind="autoscale_up")[-1]
+    assert probe_up["probe"] is True
+    # the probe warms (inside its warm-up window): breaker closes
+    pool.slots[2].ready = True
+    _tick(a, clock, pressure=2.0)
+    assert a.breaker_state == "closed"
+    assert resilience.events(kind="autoscale_breaker_close")
+
+
+def test_breaker_reopens_on_probe_death():
+    clock = _Clock()
+    pool, router = _ScriptedPool(n=1), _ScriptedRouter()
+    a = _scaler(pool, router, clock, breaker_backoff_s=60.0)
+    _tick(a, clock, pressure=2.0, n=3)
+    pool.slots[1].alive = False
+    _tick(a, clock, pressure=2.0)
+    _tick(a, clock, pressure=2.0, n=2, dt=30.0)   # half-open probe
+    assert pool.grown == [1, 2]
+    pool.slots[2].alive = False                   # the probe dies too
+    _tick(a, clock, pressure=2.0)
+    assert a.breaker_state == "open"
+    assert len(resilience.events(kind="autoscale_breaker_open")) == 2
+
+
+def test_armed_fault_site_degrades_to_fixed_fleet():
+    """serving.autoscale raising — armed or a real controller bug —
+    freezes the fleet with a recorded event; later ticks are inert and
+    the router is untouched (degrade, never die)."""
+    clock = _Clock()
+    pool, router = _ScriptedPool(n=2), _ScriptedRouter()
+    a = _scaler(pool, router, clock)
+    resilience.arm("serving.autoscale", "raise")
+    _tick(a, clock, pressure=2.0)
+    assert a.degraded
+    ev = resilience.events(kind="autoscale_degraded")
+    assert len(ev) == 1 and "injected fault" in ev[0]["error"]
+    resilience.disarm("serving.autoscale")
+    # sustained overload after the degrade: the fleet stays fixed
+    _tick(a, clock, pressure=9.0, n=10, dt=5.0)
+    assert pool.grown == [] and pool.shrunk == []
+    st = a.stats()
+    assert st["degraded"] is True and st["active"] == 2
+
+
+def test_autoscaler_validates_hysteresis_and_budget():
+    clock = _Clock()
+    pool, router = _ScriptedPool(n=1), _ScriptedRouter()
+    with pytest.raises(ValueError):
+        _scaler(pool, router, clock, up_pressure=0.2, down_pressure=0.5)
+    with pytest.raises(ValueError):
+        _scaler(pool, router, clock, min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        _scaler(pool, router, clock, min_replicas=0)
+
+
+def test_stats_and_profiler_counters(tmp_path):
+    from paddle_tpu import profiler
+    profiler.reset_autoscale_counters()
+    clock = _Clock()
+    pool, router = _ScriptedPool(n=1), _ScriptedRouter()
+    a = _scaler(pool, router, clock)
+    _tick(a, clock, pressure=2.0, n=3)
+    pool.slots[1].ready = True
+    _tick(a, clock, pressure=2.0)
+    st = a.stats()
+    assert st["ups"] == 1 and st["downs"] == 0
+    assert st["active"] == 2
+    assert st["breaker"] == "closed"
+    assert st["last_decisions"][-1]["action"] == "warmed"
+    counters = profiler.autoscale_counters()
+    assert counters["autoscale_ups"] == 1
+    assert counters["autoscale_ticks"] >= 4
+    assert counters["autoscale_replicas"] == 2
+    assert counters["autoscale_pressure_max"] == pytest.approx(2.0)
+    art = profiler.write_timeline(str(tmp_path / "t.json"))
+    assert art["autoscale"]["autoscale_ups"] == 1
+
+
+# -- the shared supervision core ---------------------------------------------
+
+def test_slot_supervision_budget_arithmetic_matches_pool_shape():
+    """Parity with the pool's pre-extraction accounting: attempt
+    numbers, backoff schedule (the pool's RetryPolicy parameters), and
+    the lost verdict at budget exhaustion."""
+    retry = RetryPolicy(max_attempts=3, backoff=0.25, multiplier=2.0,
+                        max_backoff=5.0, jitter=0.0, seed=0)
+    sup = SlotSupervision(2, retry=retry)
+    d1 = sup.classify_exit(0)
+    assert (d1.action, d1.attempt) == ("restart", 1)
+    assert d1.backoff_sec == pytest.approx(0.25)
+    d2 = sup.classify_exit(0)
+    assert (d2.action, d2.attempt) == ("restart", 2)
+    assert d2.backoff_sec == pytest.approx(0.5)
+    d3 = sup.classify_exit(0)
+    assert d3.action == "lost" and d3.used == 2
+    assert sup.is_lost(0) and sup.lost_slots() == [0]
+    # an independent slot spends its own budget
+    assert sup.classify_exit(1).attempt == 1
+    assert not sup.is_lost(1)
+
+
+def test_slot_supervision_note_stable_resets_crash_loop_window():
+    sup = SlotSupervision(1, retry=None)
+    assert sup.classify_exit(0).action == "restart"
+    sup.note_stable(0)            # stayed up budget_reset_s
+    assert sup.classify_exit(0).action == "restart"
+    assert sup.classify_exit(0).action == "lost"
+
+
+def test_slot_supervision_elastic_job_shape():
+    """Parity with the elastic supervisor's pre-extraction transient
+    budget: one job-level slot, attempts 1..budget then permanent."""
+    retry = RetryPolicy(max_attempts=2, backoff=0.5, multiplier=2.0,
+                        max_backoff=10.0, jitter=0.0, seed=0)
+    sup = SlotSupervision(1, retry=retry)
+    d = sup.classify_exit("job")
+    assert (d.action, d.attempt, d.backoff_sec) == ("restart", 1, 0.5)
+    assert sup.classify_exit("job").action == "lost"
+
+
+def test_slot_supervision_generation_bump():
+    sup = SlotSupervision(3)
+    assert sup.generation(0) == 0
+    assert sup.bump_generation(0) == 1
+    assert sup.bump_generation(0) == 2
+    assert sup.generation(1) == 0
+    sup.reset_generation(0, 0)
+    assert sup.generation(0) == 0
+
+
+def test_escalate_stop_drains_then_kills():
+    """A SIGTERM-compliant process exits on the drain signal; a
+    SIGTERM-ignoring one is SIGKILLed at the shared deadline — real
+    exit codes either way."""
+    polite = subprocess.Popen([sys.executable, "-c",
+                               "import time; time.sleep(60)"])
+    stubborn = subprocess.Popen(
+        [sys.executable, "-u", "-c",
+         "import signal, sys, time\n"
+         "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+         "print('armored', flush=True)\n"
+         "time.sleep(60)"],
+        stdout=subprocess.PIPE, text=True)
+    assert stubborn.stdout.readline().strip() == "armored"
+    t0 = time.monotonic()
+    rcs = escalate_stop([("polite", polite), ("stubborn", stubborn)],
+                        grace_sec=2.0)
+    assert rcs["polite"] == -15          # drained on SIGTERM
+    assert rcs["stubborn"] == -9         # escalated to SIGKILL
+    assert time.monotonic() - t0 < 30.0  # ONE shared deadline
+
+
+# -- ReplicaPool membership hardening -----------------------------------------
+
+def test_pool_stop_cancels_pending_respawn_backoff(tmp_path):
+    """stop() during a restart-backoff sleep cancels the pending
+    respawn — the backoff thread returns promptly and never spawns a
+    worker into the closed pool (the orphan-serve-worker bug)."""
+    pool = ReplicaPool(str(tmp_path), 1, restart_budget=1)
+    t = threading.Thread(target=pool._respawn_after,
+                         args=(0, None, 30.0), daemon=True)
+    t.start()
+    time.sleep(0.1)
+    t0 = time.monotonic()
+    pool.stop()
+    t.join(timeout=5.0)
+    assert not t.is_alive(), "respawn backoff ignored stop()"
+    assert time.monotonic() - t0 < 5.0   # cancelled, not waited out
+    assert pool._replicas[0] is None     # nothing was spawned
+
+
+def test_pool_shrink_retires_slot_and_cancels_its_respawn(tmp_path):
+    """A retired (shrunk) slot's pending respawn is abandoned: the
+    monitor marked it expected-exit, the backoff thread must not
+    resurrect it."""
+    pool = ReplicaPool(str(tmp_path), 1, restart_budget=1)
+    pool._retired[0] = True
+    pool._respawn_after(0, None, 0.0)
+    assert pool._replicas[0] is None
+    # and a respawn whose slot was RECYCLED by a later grow() is
+    # stale: it must not overwrite (and orphan) the new occupant
+    pool._retired[0] = False
+    sentinel = object()
+    pool._replicas[0] = sentinel
+    pool._respawn_after(0, None, 0.0)
+    assert pool._replicas[0] is sentinel
+    pool._replicas[0] = None
+    pool.stop()
+
+
+def test_grow_extends_supervision_bookkeeping(tmp_path):
+    """grow() under a closed pool refuses instead of orphaning."""
+    pool = ReplicaPool(str(tmp_path), 1)
+    pool.stop()
+    with pytest.raises(RuntimeError):
+        pool.grow()
+
+
+def test_grow_recycles_retired_slots_not_lost_ones(tmp_path):
+    """An oscillating up/down/up fleet reuses cleanly shrunk slot
+    indices (bumped generation, clean restart record) instead of
+    growing the slot table without bound; LOST slots stay dead."""
+    import types
+
+    pool = ReplicaPool(str(tmp_path), 2)
+    spawned = []
+
+    def fake_spawn(index, generation):
+        spawned.append((index, generation))
+        return types.SimpleNamespace(index=index, generation=generation,
+                                     pid=4242, alive=True, ready=False,
+                                     proc=None, port=None)
+
+    pool._spawn = fake_spawn
+    pool._sup._used[1] = 2
+    pool._retired[1] = True
+    rep = pool.grow()
+    assert (rep.index, rep.generation) == (1, 1)   # recycled + bumped
+    assert pool._retired[1] is False
+    assert pool._sup.used(1) == 0                  # clean record
+    assert pool.n == 2
+    # no retired slot free: the table extends
+    rep2 = pool.grow()
+    assert (rep2.index, rep2.generation) == (2, 0)
+    assert pool.n == 3
+    # a LOST slot (budget-exhausted crash loop) is never recycled
+    pool._retired[0] = True
+    pool._sup._lost.add(0)
+    rep3 = pool.grow()
+    assert rep3.index == 3
+
+    # a failed spawn corrupts nothing: a fresh slot is un-appended, a
+    # recycled one goes back to the retired (re-recyclable) state
+    def boom(index, generation):
+        raise OSError("fork ENOMEM")
+
+    pool._spawn = boom
+    n_before = pool.n
+    with pytest.raises(OSError):
+        pool.grow()   # no retired slot free: the append path
+    assert pool.n == n_before and len(pool._replicas) == n_before
+    pool._retired[1] = True
+    with pytest.raises(OSError):
+        pool.grow()   # the recycle path
+    assert pool._retired[1] is True   # back to recyclable
+
+
+# -- membership-lock serialization --------------------------------------------
+
+class _MiniHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _reply(self, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._reply({"ok": True,
+                         "ready": {"m": {"draining": False}}})
+        elif self.path == "/statz":
+            self._reply({"pending": 0})
+        else:
+            self._reply({"m": {"dirname": "/art/v1"}})
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        self.rfile.read(n)
+        self._reply({"model": "m"})
+
+
+def test_rolling_reload_serializes_on_pool_membership_lock():
+    """The satellite bug: a shrink landing mid-reload (or vice versa)
+    must be impossible — both sides take the POOL's one membership
+    lock. Holding it (as the autoscaler's drain+shrink does) blocks
+    the rollout until release."""
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _MiniHandler)
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True,
+                     kwargs={"poll_interval": 0.05}).start()
+    try:
+        pool = StaticPool(["127.0.0.1:%d" % srv.server_address[1]])
+        router = Router(pool, poll_ms=10)
+        router.poll_once()
+        assert router._membership_lock is pool.membership_lock
+        result = {}
+
+        def reload():
+            result["answer"] = router.rolling_reload("m", "/art/v2")
+
+        with pool.membership_lock:
+            t = threading.Thread(target=reload, daemon=True)
+            t.start()
+            time.sleep(0.4)
+            assert "answer" not in result, \
+                "rolling reload ran despite the held membership lock"
+        t.join(timeout=10.0)
+        assert result["answer"][0] == 200
+    finally:
+        srv.shutdown()
+        srv.server_close()

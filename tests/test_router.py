@@ -648,6 +648,62 @@ def test_pressure_signal_and_stats():
         a.shutdown()
 
 
+def test_pressure_ewma_smooths_spikes_and_decays():
+    """/statz exposes BOTH the raw per-poll pressure and the
+    EWMA-smoothed one: a single poll spike moves the smoothed signal
+    only alpha of the way (can't trigger a scale-up), and an idle
+    fleet's smoothed signal decays instead of snapping to zero (can't
+    mask a sustained overload behind one quiet poll)."""
+    z = {"pending": 8, "max_batch": 4, "requests": 10, "shed": 0,
+         "models": {"m": 1}}
+    a = _fake_replica({"statz": z})
+    try:
+        r = _router_over([a.server_address[1]], pressure_alpha=0.5)
+        r.poll_once()
+        st = r.stats()
+        # seeded with the first raw sample
+        assert st["pressure"]["m"] == pytest.approx(2.0)
+        assert st["pressure_smoothed"]["m"] == pytest.approx(2.0)
+        # one quiet poll: raw snaps to 0, smoothed only halves
+        a.cfg["statz"] = dict(z, pending=0)
+        r.poll_once()
+        st = r.stats()
+        assert st["pressure"]["m"] == pytest.approx(0.0)
+        assert st["pressure_smoothed"]["m"] == pytest.approx(1.0)
+        assert r.pressure_smoothed()["m"] == pytest.approx(1.0)
+        # one spike poll from quiet: smoothed moves halfway back up
+        a.cfg["statz"] = dict(z, pending=8)
+        r.poll_once()
+        assert r.stats()["pressure_smoothed"]["m"] == pytest.approx(1.5)
+    finally:
+        a.shutdown()
+
+
+def test_set_draining_inflight_forget_apis():
+    """The autoscaler's drain handles: set_draining holds new work off
+    a replica (pick skips it), replica_inflight reads the
+    router-tracked count, forget drops the slot's state."""
+    a = _fake_replica({"statz": {"pending": 0}})
+    b = _fake_replica({"statz": {"pending": 0}})
+    try:
+        r = _router_over([a.server_address[1], b.server_address[1]])
+        r.poll_once()
+        assert r.set_draining(1, True) is True
+        picks = {r.pick().index for _ in range(6)}
+        assert picks == {0}
+        assert r.stats()["replicas"]["1"]["draining"] is True
+        assert r.set_draining(1, False) is True
+        assert r.replica_inflight(0) == 0
+        r.forget(1)
+        assert "1" not in r.stats()["replicas"]
+        # unknown slot: honest no-op
+        assert r.set_draining(9, True) is False
+        assert r.replica_inflight(9) == 0
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
 def test_router_front_server_routes_and_reports(art_v1):
     live = _LiveReplica(art_v1)
     try:
@@ -746,7 +802,9 @@ def test_pool_restarts_sigkilled_replica(art_v1):
 def test_pool_budget_resets_after_healthy_uptime(art_v1):
     """A respawn that stays up budget_reset_s earns the slot a clean
     restart record (the budget bounds crash loops, not lifetime
-    total); a stale or dead respawn does not."""
+    total); a stale or dead respawn does not. The accounting lives in
+    the shared supervision core (resilience.supervise) now — same
+    contract."""
     pool = ReplicaPool(art_v1, 1, budget_reset_s=0.01)
 
     class _FakeRep(object):
@@ -755,20 +813,20 @@ def test_pool_budget_resets_after_healthy_uptime(art_v1):
 
     rep = _FakeRep()
     pool._replicas[0] = rep
-    pool._restarts_used[0] = 2
+    pool._sup._used[0] = 2
     pool._maybe_reset_budget(rep)
-    assert pool._restarts_used == [0]
+    assert pool._sup.used(0) == 0
     # a respawn that was itself replaced (stale) must not reset
-    pool._restarts_used[0] = 2
+    pool._sup._used[0] = 2
     pool._replicas[0] = _FakeRep()
     pool._maybe_reset_budget(rep)
-    assert pool._restarts_used == [2]
+    assert pool._sup.used(0) == 2
     # nor a dead one
     rep2 = _FakeRep()
     rep2.alive = False
     pool._replicas[0] = rep2
     pool._maybe_reset_budget(rep2)
-    assert pool._restarts_used == [2]
+    assert pool._sup.used(0) == 2
 
 
 def test_static_pool_and_replica_shapes():

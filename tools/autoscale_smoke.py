@@ -1,0 +1,137 @@
+"""Autoscaler smoke gate (CPU CI): the closed control loop over real
+``serve`` subprocesses — a diurnal mini-wave on a 1-replica fleet with
+a [1, 3] budget must scale UP under a generate-heavy flood (EXACTLY
+one ``autoscale_up`` — the long up-cooldown pins the wave to one
+step), drain-and-shrink back to 1 when the traffic stops (EXACTLY one
+``autoscale_down``, drain-first), lose ZERO requests through both
+transitions, and keep p99 finite in both phases. A second leg arms a
+crash fault in the slot the autoscaler grows into: the scale-up dies
+inside its warm-up window, the crash-loop circuit breaker must open
+(recorded ``autoscale_breaker_open``), refuse further scale-ups, and
+the original fleet must keep serving — zero lost, controller alive.
+
+The measurement lives in benchmark/load_bench.py (diurnal/breaker_leg)
+— ONE implementation shared by this gate and the banked evidence
+record, so the criteria cannot drift. Invoked by
+tools/autoscale_smoke.sh (one retry damps shared-CI scheduler noise).
+Exit 0 on pass, 1 on failure; prints a one-line JSON summary either
+way.
+
+    JAX_PLATFORMS=cpu python tools/autoscale_smoke.py
+"""
+import json
+import math
+import os
+import shutil
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from benchmark.load_bench import breaker_leg, diurnal
+
+    root = tempfile.mkdtemp(prefix="paddle_tpu_autoscale_smoke_")
+    try:
+        d = diurnal(os.path.join(root, "diurnal"), min_replicas=1,
+                    max_replicas=3, flood_predict=24,
+                    flood_generate=44, probe_predict=8,
+                    probe_generate=1, threads=8)
+        b = breaker_leg(os.path.join(root, "breaker"),
+                        flood_predict=10, flood_generate=32,
+                        threads=6)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    failures = []
+    # ---- diurnal wave -----------------------------------------------------
+    if d["autoscale_ups"] != 1:
+        failures.append("expected exactly one autoscale_up, got %d"
+                        % d["autoscale_ups"])
+    if d["autoscale_downs"] != 1:
+        failures.append("expected exactly one autoscale_down, got %d"
+                        % d["autoscale_downs"])
+    if not d["scaled_up_in_time"]:
+        failures.append("fleet never scaled up under the flood")
+    if not d["scaled_down_in_time"]:
+        failures.append("fleet never drained back down when idle")
+    if d["replicas_peak"] < 2:
+        failures.append("replica peak %d never left the floor"
+                        % d["replicas_peak"])
+    if d["final_replicas"] != d["min_replicas"]:
+        failures.append("fleet ended at %d replicas, wanted the floor "
+                        "%d" % (d["final_replicas"],
+                                d["min_replicas"]))
+    if not d["down_drained"]:
+        failures.append("scale-down retired a replica that had not "
+                        "drained")
+    if d["lost_total"] != 0:
+        failures.append(
+            "lost requests through the wave: %d (flood %r / probe %r)"
+            % (d["lost_total"], d["flood"]["lost_detail"],
+               d["idle_probe"]["lost_detail"]))
+    for phase in ("flood", "idle_probe"):
+        s = d[phase]
+        if s["completed"] != s["tasks"]:
+            failures.append("%s did not complete every request: %d/%d"
+                            % (phase, s["completed"], s["tasks"]))
+        if s["bad_payloads"]:
+            failures.append("%d %s responses failed the closed-form "
+                            "check" % (s["bad_payloads"], phase))
+        p99 = s["latency_ms_p99"]
+        if not (p99 > 0 and math.isfinite(p99)):
+            failures.append("%s p99 not finite: %r" % (phase, p99))
+    if d["degraded"]:
+        failures.append("controller degraded during the clean wave")
+    if d["breaker_opens"]:
+        failures.append("breaker opened during the clean wave")
+
+    # ---- crash-loop breaker ----------------------------------------------
+    if not b["breaker_opened_in_time"]:
+        failures.append("breaker never opened on the crash-looping "
+                        "scale-up")
+    if b["breaker_state"] != "open":
+        failures.append("breaker state %r, wanted open (backoff is "
+                        "hours)" % b["breaker_state"])
+    if b["autoscale_ups"] != 1:
+        failures.append("open breaker did not pin scale-ups at 1, got "
+                        "%d" % b["autoscale_ups"])
+    if b["active_replicas"] != 1:
+        failures.append("crash-looping slot not retired: %d active"
+                        % b["active_replicas"])
+    if b["lost_total"] != 0:
+        failures.append("lost requests on the breaker leg: %d"
+                        % b["lost_total"])
+    probe = b["post_breaker_probe"]
+    if probe["completed"] != probe["tasks"]:
+        failures.append("fleet stopped answering after the breaker "
+                        "verdict: %r" % probe)
+
+    summary = {
+        "ok": not failures,
+        "ups": d["autoscale_ups"],
+        "downs": d["autoscale_downs"],
+        "replicas_peak": d["replicas_peak"],
+        "final_replicas": d["final_replicas"],
+        "down_drained": d["down_drained"],
+        "lost_total": d["lost_total"],
+        "flood_p50_ms": d["flood"]["latency_ms_p50"],
+        "flood_p99_ms": d["flood"]["latency_ms_p99"],
+        "idle_p99_ms": d["idle_probe"]["latency_ms_p99"],
+        "breaker_opens": b["breaker_opens"],
+        "breaker_state": b["breaker_state"],
+        "breaker_ups": b["autoscale_ups"],
+        "breaker_lost": b["lost_total"],
+    }
+    print(json.dumps(summary))
+    if failures:
+        for f in failures:
+            print("autoscale_smoke FAIL: %s" % f, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Autoscaler smoke gate: a 1-replica fleet with a [1,3] budget behind
+# the closed-loop controller must ride a diurnal mini-wave — flood ->
+# EXACTLY one autoscale_up, idle -> EXACTLY one drain-first
+# autoscale_down back to the floor — with zero lost requests and
+# finite p99 through both transitions, and a crash-looping scale-up
+# must open the circuit breaker while the original fleet keeps
+# serving. CPU tier, real `serve` subprocesses and sockets (the
+# control loop IS about the process boundary). Companion to
+# tools/router_smoke.sh (the static-fleet chaos legs); measurement
+# shared with benchmark/load_bench.py --mode diurnal. One retry damps
+# shared-CI scheduler noise before calling a timing-dependent miss
+# real.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+python tools/autoscale_smoke.py "$@" && exit 0
+echo "autoscale_smoke: first attempt failed; retrying once" >&2
+exec python tools/autoscale_smoke.py "$@"
