@@ -26,10 +26,29 @@ the checkpoint, then moves the snapshot inside the checkpoint dir
 (:mod:`paddle_tpu.elastic.resume` explains why every kill window then
 lands on a consistent pair).
 
+Two worker shapes share the harness (``PADDLE_TPU_CHAOS_MODE``):
+
+- ``executor`` (the PR-8 original): rank 0 drives a raw Executor loop;
+  ranks 1..W-1 are heartbeating liveness bodies.
+- ``trainer`` (the real thing): EVERY rank runs
+  ``Trainer.train(elastic=True)`` — the actual training loop with the
+  async pipeline and the ``comm_overlap`` step builds. Rank 0 owns the
+  audited lease stream (``task_reader`` batches leased from the
+  supervisor's master, checkpoints PAIRED with master snapshots);
+  ranks 1..W-1 run the same code path lease-free on a local data
+  stream scoped to the master's pass (on a real pod the leased batch
+  shards over the mesh inside ONE SPMD program; CPU processes are
+  islands, so only one rank can own the audited stream —
+  doc/elasticity.md). Seeding knobs for the failure-policy legs:
+  ``CHAOS_NAN_TASK=<i>`` poisons task i's batch with a NaN (the
+  numeric guardrail's quarry), ``CHAOS_HANG_TASK=<i>`` wedges task
+  i's read once, marker-guarded (the step watchdog's quarry).
+
 Worker mode (spawned by the launcher):
     python benchmark/chaos_run.py worker
 Driver API (used by tools/elastic_smoke.py and tests/test_elastic.py):
     run_chaos(state_dir, nprocs=4, tasks=12, kill_rank=0, kill_after=3)
+    run_chaos(..., mode="trainer")
 """
 from __future__ import annotations
 
@@ -46,7 +65,30 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 GLOBAL_BATCH = 12    # divisible by every world size the harness visits
 FEATURES = 8
 KEEP_LAST = 4
+CHAOS_LR = 0.5
 TASK_RE = re.compile(rb"^batch-(\d+)$")
+
+
+def _chaos_graph():
+    """The ONE chaos model both worker shapes build (fc-tanh ->
+    fc-softmax -> cross-entropy mean): the parity legs compare losses
+    across modes, so the graph must be impossible to edit in one place
+    only. The optimizer is applied by the caller (the Trainer shape
+    minimizes inside Trainer.__init__)."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+
+    main, startup = pt.Program(), pt.Program()
+    pt.switch_main_program(main)
+    pt.switch_startup_program(startup)
+    x = layers.data("x", shape=[FEATURES], dtype="float32")
+    y = layers.data("y", shape=[1], dtype="int64")
+    h = layers.fc(x, size=8, act="tanh",
+                  param_attr=pt.ParamAttr(name="chaos_w1"))
+    pred = layers.fc(h, size=2, act="softmax",
+                     param_attr=pt.ParamAttr(name="chaos_w2"))
+    loss = layers.mean(layers.cross_entropy(pred, y))
+    return main, startup, x, y, loss
 
 
 def task_payloads(n):
@@ -85,17 +127,24 @@ def _append_jsonl(path, row):
         os.fsync(f.fileno())
 
 
-def worker_main():
-    """One rank of the elastic job. MUST run before any jax import: the
-    local virtual CPU mesh (world_size devices) is forced here."""
-    world_size = int(os.environ["PADDLE_TPU_NUM_PROCESSES"])
-    rank = int(os.environ["PADDLE_TPU_PROCESS_ID"])
+def _force_local_mesh(world_size):
+    """MUST run before any jax import: the local virtual CPU mesh
+    (world_size devices) standing in for the pod."""
     os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")
     flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
                    flags)
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=%d" % world_size)
+
+
+def worker_main():
+    """One rank of the elastic job, dispatched on the harness mode."""
+    world_size = int(os.environ["PADDLE_TPU_NUM_PROCESSES"])
+    rank = int(os.environ["PADDLE_TPU_PROCESS_ID"])
+    _force_local_mesh(world_size)
+    if os.environ.get("PADDLE_TPU_CHAOS_MODE", "executor") == "trainer":
+        return trainer_worker_main(world_size, rank)
 
     state_dir = os.environ["PADDLE_TPU_ELASTIC_STATE"]
     gen = int(os.environ.get("PADDLE_TPU_ELASTIC_GENERATION", "0"))
@@ -132,7 +181,6 @@ def _trainer_main(client, state_dir, gen, world_size, stop):
 
     import paddle_tpu as pt
     from paddle_tpu import checkpoint as ckpt
-    from paddle_tpu import layers
     from paddle_tpu.elastic import replan as replan_mod
     from paddle_tpu.elastic import resume as resume_mod
     from paddle_tpu.parallel import (DistributeTranspiler,
@@ -150,17 +198,8 @@ def _trainer_main(client, state_dir, gen, world_size, stop):
         json.dump(plan.summary(), f, indent=1)
 
     # -- the program (identical across generations and modes) -------------
-    main, startup = pt.Program(), pt.Program()
-    pt.switch_main_program(main)
-    pt.switch_startup_program(startup)
-    x = layers.data("x", shape=[FEATURES], dtype="float32")
-    y = layers.data("y", shape=[1], dtype="int64")
-    h = layers.fc(x, size=8, act="tanh",
-                  param_attr=pt.ParamAttr(name="chaos_w1"))
-    pred = layers.fc(h, size=2, act="softmax",
-                     param_attr=pt.ParamAttr(name="chaos_w2"))
-    loss = layers.mean(layers.cross_entropy(pred, y))
-    pt.SGD(learning_rate=0.5).minimize(loss)
+    main, startup, x, y, loss = _chaos_graph()
+    pt.SGD(learning_rate=CHAOS_LR).minimize(loss)
 
     mesh = plan.make_mesh()
     ctx = DistributeTranspiler().transpile(
@@ -229,6 +268,124 @@ def _trainer_main(client, state_dir, gen, world_size, stop):
 
 
 # ---------------------------------------------------------------------------
+# the real-Trainer worker: every rank runs Trainer.train(elastic=True)
+
+
+def _build_chaos_trainer():
+    """The chaos model as a Trainer (the optimizer lands via
+    Trainer.__init__'s minimize — same ONE graph as the executor leg)."""
+    import paddle_tpu as pt
+
+    main, startup, x, y, loss = _chaos_graph()
+    trainer = pt.Trainer(cost=loss,
+                         optimizer=pt.SGD(learning_rate=CHAOS_LR),
+                         feed_list=[x, y], place=pt.CPUPlace(),
+                         main_program=main, startup_program=startup)
+    return trainer, loss
+
+
+def trainer_worker_main(world_size, rank):
+    """One rank of the real-Trainer elastic job: ``Trainer.train(
+    elastic=True)`` with the async pipeline on (``comm_overlap`` etc.
+    arrive via PADDLE_TPU_FLAGS). Rank 0 owns the audited lease
+    stream + paired checkpoints; other ranks run the same loop
+    lease-free on local batches scoped to the master's pass."""
+    import numpy as np
+
+    from paddle_tpu.pipeline import materialize_scalar
+
+    state_dir = os.environ["PADDLE_TPU_ELASTIC_STATE"]
+    gen = int(os.environ.get("PADDLE_TPU_ELASTIC_GENERATION", "0"))
+    root = os.path.join(state_dir, "ckpt")
+    os.makedirs(root, exist_ok=True)
+    log = os.path.join(state_dir, "losses-rank0.jsonl")
+
+    trainer, loss = _build_chaos_trainer()
+    eval_prog = trainer.main_program.prune(feeds=["x", "y"],
+                                           fetches=(loss.name,))
+    px, py = _probe_batch()
+
+    def probe():
+        out, = trainer.exe.run(eval_prog, feed={"x": px, "y": py},
+                               fetch_list=[loss])
+        return float(np.asarray(out).reshape(-1)[0])
+
+    if rank != 0:
+        # same Trainer.train(elastic=True) code path, lease-free: a
+        # local data stream scoped to the master's pass (the rank still
+        # registers + heartbeats through the worker role)
+        from paddle_tpu.v2 import master as v2_master
+        poll = v2_master.client(
+            os.environ["PADDLE_TPU_MASTER_ADDR"],
+            timeout_sec=float(os.environ.get("PADDLE_TPU_MASTER_TIMEOUT",
+                                             "60")))
+
+        def body_reader():
+            i = 0
+            while True:
+                c = poll.counts()
+                if c["todo"] + c["pending"] == 0:
+                    return
+                bx, by = _batch(10_000 + 100 * rank + (i % 50))
+                yield list(zip(bx, by))
+                i += 1
+                # liveness bodies exercise the loop, they don't race it:
+                # unthrottled they starve rank 0 of CPU and flood the
+                # log with their own progress lines
+                time.sleep(0.05)
+
+        try:
+            trainer.train(body_reader, num_passes=1, elastic=True,
+                          pipeline=True)
+        finally:
+            poll.close()
+        return 0
+
+    nan_task = int(os.environ.get("CHAOS_NAN_TASK", "-1"))
+    hang_task = int(os.environ.get("CHAOS_HANG_TASK", "-1"))
+    hang_marker = os.path.join(state_dir, "hang-fired")
+
+    def task_reader(payload):
+        i = int(TASK_RE.match(payload).group(1))
+        if i == hang_task and not os.path.exists(hang_marker):
+            # a stalled reader, once (the marker survives the restart):
+            # the step watchdog must turn this into exit 75
+            with open(hang_marker, "w") as f:
+                f.write("1")
+            time.sleep(3600)
+        bx, by = _batch(i)
+        if i == nan_task:
+            bx = bx.copy()
+            bx[0, 0] = np.nan
+        return list(zip(bx, by))
+
+    def on_resume(worker):
+        _append_jsonl(log, {"kind": "resume", "gen": gen,
+                            "step": worker.step, "world": world_size,
+                            "probe": probe()})
+
+    def on_commit(step, tid, payload, cost):
+        i = int(TASK_RE.match(payload).group(1))
+        # audit row AFTER the lease commit, BEFORE the paired
+        # snapshot/checkpoint (the PR-13 kill-window reconciliation)
+        _append_jsonl(log, {"kind": "task", "gen": gen, "step": step,
+                            "task": i, "world": world_size,
+                            "loss": materialize_scalar(cost),
+                            "probe": probe()})
+
+    def on_skip(tid, payload):
+        i = int(TASK_RE.match(payload).group(1))
+        _append_jsonl(log, {"kind": "skip", "gen": gen, "task": i,
+                            "world": world_size})
+
+    trainer.train(elastic=True, task_reader=task_reader,
+                  elastic_root=root, on_resume=on_resume,
+                  on_commit=on_commit, on_skip=on_skip,
+                  num_passes=1, pipeline=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # driver
 
 
@@ -245,35 +402,46 @@ def _read_jsonl(path):
     return rows
 
 
-def _worker_env(state_dir, policy, fault_spec):
+def _worker_env(state_dir, policy, fault_spec, mode="executor",
+                flags=None, extra_env=None):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PADDLE_TPU_FAULT_SPEC", None)
     if fault_spec:
         env["PADDLE_TPU_FAULT_SPEC"] = fault_spec
-    env["PADDLE_TPU_FLAGS"] = "comm_policy=%s" % policy
+    kv = {"comm_policy": policy}
+    kv.update(flags or {})
+    env["PADDLE_TPU_FLAGS"] = ",".join(
+        "%s=%s" % (k, v) for k, v in sorted(kv.items()))
     env["PADDLE_TPU_ELASTIC_STATE"] = state_dir
-    # only rank 0 trains in this harness (the peers are heartbeating
-    # liveness bodies), so the job-start schedule-fingerprint exchange
-    # (elastic.fingerprints) can never complete — cap its wait so each
-    # generation pays ~2s for the recorded-incomplete advisory instead
-    # of the full pod-scale timeout
+    env["PADDLE_TPU_CHAOS_MODE"] = mode
+    # only rank 0 leases-and-audits in this harness, so the job-start
+    # schedule-fingerprint exchange (elastic.fingerprints) may not
+    # complete — cap its wait so each generation pays ~2s for the
+    # recorded-incomplete advisory instead of the pod-scale timeout
     env["PADDLE_TPU_FINGERPRINT_TIMEOUT"] = "2"
+    env.update(extra_env or {})
     return env
 
 
 def run_chaos(state_dir, nprocs=4, tasks=12, kill_rank=0, kill_after=3,
               elastic=True, policy="hierarchical", fault_spec=None,
-              min_workers=2, grace_sec=15.0, timeout=900.0):
+              min_workers=2, grace_sec=15.0, timeout=900.0,
+              mode="executor", flags=None, extra_env=None,
+              restart_budget=1):
     """Run one chaos scenario; returns the report dict the checkers
     consume. ``kill_rank=None`` runs failure-free (the parity leg);
     ``elastic=False`` runs the same script under the fail-fast
-    launcher (the bit-parity reference)."""
+    launcher (the bit-parity reference); ``mode="trainer"`` runs every
+    rank through ``Trainer.train(elastic=True)`` (``flags`` adds
+    PADDLE_TPU_FLAGS entries — comm_overlap, step_timeout_s,
+    loss_skip_budget — and ``extra_env`` the seeding knobs)."""
     from paddle_tpu.launch import launch, launch_elastic
 
     os.makedirs(state_dir, exist_ok=True)
-    env = _worker_env(state_dir, policy, fault_spec)
+    env = _worker_env(state_dir, policy, fault_spec, mode=mode,
+                      flags=flags, extra_env=extra_env)
     argv = [os.path.join(REPO, "benchmark", "chaos_run.py"), "worker"]
     payloads = task_payloads(tasks)
     box = {}
@@ -284,7 +452,7 @@ def run_chaos(state_dir, nprocs=4, tasks=12, kill_rank=0, kill_after=3,
                 box["rc"] = launch_elastic(
                     nprocs, "127.0.0.1", argv, env=env,
                     grace_sec=grace_sec, min_workers=min_workers,
-                    restart_budget=1, state_dir=state_dir,
+                    restart_budget=restart_budget, state_dir=state_dir,
                     master_tasks=payloads, master_timeout_sec=60.0,
                     snapshot_root=os.path.join(state_dir, "ckpt"))
             else:
@@ -456,6 +624,72 @@ def check_parity(elastic_report, plain_report):
                 % (len(a), len(b),
                    next((p for p in zip(a, b) if p[0] != p[1]), None))]
     return []
+
+
+def check_guardrail(report, seeded_task):
+    """Seeded-NaN leg: the seeded batch is SKIPPED (skip row +
+    batch_skipped event), every task is accounted exactly once across
+    task/skip rows, any checkpoint rewind is bounded (one per budget
+    window), and the pass completes with a finite, decreasing probe."""
+    rows = report["rows"]
+    problems = []
+    tasks = [r["task"] for r in rows if r["kind"] == "task"]
+    skips = [r["task"] for r in rows if r["kind"] == "skip"]
+    if seeded_task not in skips:
+        problems.append("seeded task %d was not skipped (skips=%r)"
+                        % (seeded_task, sorted(skips)))
+    if seeded_task in tasks:
+        problems.append("seeded task %d also COUNTED as a good step"
+                        % seeded_task)
+    want = list(range(report["tasks"]))
+    if sorted(tasks + skips) != want:
+        problems.append("task+skip multiset mismatch: got %r"
+                        % sorted(tasks + skips))
+    if not [e for e in report["events"]
+            if e["kind"] == "batch_skipped"]:
+        problems.append("no batch_skipped event recorded")
+    rewinds = [e for e in report["events"]
+               if e["kind"] == "guard_rewind"]
+    if len(rewinds) > 2:
+        problems.append("%d guard rewinds — the once-per-window bound "
+                        "looks broken" % len(rewinds))
+    good = [r for r in rows if r["kind"] == "task"]
+    if good:
+        import math
+        last = good[-1]["probe"]
+        if not math.isfinite(last):
+            problems.append("final probe loss is not finite: %r" % last)
+        start = next((r["probe"] for r in rows
+                      if r["kind"] == "resume" and r["gen"] == 0),
+                     good[0]["probe"])
+        if not last < start:
+            problems.append("probe loss did not decrease despite the "
+                            "skip policy: %.6f -> %.6f" % (start, last))
+    else:
+        problems.append("no good steps survived the seeded NaN")
+    return problems
+
+
+def check_watchdog(report):
+    """Seeded-hang leg: the watchdog turned the wedged step into a
+    TRANSIENT restart — step_hung recorded, exactly one
+    elastic_restart, NO resize (full world came back) — and the
+    resumed pass still processed every task exactly once."""
+    problems = []
+    if not [e for e in report["events"] if e["kind"] == "step_hung"]:
+        problems.append("no step_hung event recorded")
+    restarts = [e for e in report["events"]
+                if e["kind"] == "elastic_restart"]
+    if len(restarts) != 1:
+        problems.append("expected exactly 1 elastic_restart, got %d"
+                        % len(restarts))
+    resizes = [e for e in report["events"]
+               if e["kind"] == "elastic_resize"]
+    if resizes:
+        problems.append("a hang must restart at FULL world, but the "
+                        "job resized: %r" % (resizes,))
+    problems.extend(check_exactly_once(report))
+    return problems
 
 
 def main():

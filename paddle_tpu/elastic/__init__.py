@@ -3,7 +3,7 @@
 The fusion point of the ``resilience`` (retry/fault/event) and ``comm``
 (topology-parameterised collectives) subsystems, after the reference's
 Go runtime (PAPER.md §Go runtime: etcd task queue, master snapshots,
-pserver re-registration). Four parts:
+pserver re-registration). Five parts:
 
 - :mod:`.supervisor` — ``ElasticSupervisor``: the coordinator behind
   ``paddle_tpu.launch --elastic``; classifies worker death
@@ -18,6 +18,12 @@ pserver re-registration). Four parts:
   makes a resumed world consistent with itself: model state and the
   dataset pass restart from the same point, so no task is double-
   processed or lost across a resize.
+- :mod:`.worker` — ``ElasticWorker``: the WORKER half of the protocol
+  as a first-class role, so ``Trainer.train(elastic=True)`` — the real
+  training loop, pipeline and comm_overlap included — leases batches
+  through the supervisor-owned task master, pairs its checkpoints with
+  master snapshots, and resumes cross-world like the chaos harness
+  always did by hand.
 - the chaos harness that proves it: ``benchmark/chaos_run.py`` +
   ``tools/elastic_smoke.sh`` (CPU CI), the same recipe as the real
   TPU-pod chaos run (cluster/README.md).
@@ -42,10 +48,11 @@ from .supervisor import (  # noqa: F401
 from .fingerprints import (  # noqa: F401
     check_replica_schedule, publish_fingerprint, gather_fingerprints,
 )
+from .worker import ElasticWorker  # noqa: F401
 # the submodules stay addressable as attributes (elastic.replan.replan,
 # elastic.resume.resume): the verb aliases above exist because the
 # module names and their primary verbs collide
-from . import fingerprints, replan, resume, supervisor  # noqa: F401
+from . import fingerprints, replan, resume, supervisor, worker  # noqa: F401
 
 __all__ = [
     "ElasticPlan", "plan_for",
@@ -53,6 +60,6 @@ __all__ = [
     "pair_snapshot", "record_stats", "SNAP_IN_DIR",
     "ElasticSupervisor", "TaskMasterHost", "Gang", "free_port",
     "check_replica_schedule", "publish_fingerprint",
-    "gather_fingerprints",
-    "fingerprints", "replan", "resume", "supervisor",
+    "gather_fingerprints", "ElasticWorker",
+    "fingerprints", "replan", "resume", "supervisor", "worker",
 ]

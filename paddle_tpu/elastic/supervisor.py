@@ -230,6 +230,10 @@ class ElasticSupervisor(object):
         e["PADDLE_TPU_PROCESS_ID"] = str(rank)
         e["PADDLE_TPU_ELASTIC"] = "1"
         e["PADDLE_TPU_ELASTIC_GENERATION"] = str(generation)
+        # the SIGTERM->SIGKILL window, exported so a draining trainer
+        # can budget its final checkpoint against the REAL deadline
+        # (and record preempt_truncated when it cannot fit)
+        e["PADDLE_TPU_GRACE_SEC"] = str(self.grace_sec)
         if self.state_dir:
             e["PADDLE_TPU_ELASTIC_STATE"] = self.state_dir
         if master is not None:

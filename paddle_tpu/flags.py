@@ -402,6 +402,60 @@ DEFINE_int32("elastic_restart_budget", 2,
              "world size before treating the next one as permanent; "
              "restarts back off on the resilience RetryPolicy schedule "
              "(CLI --elastic-restart-budget overrides)")
+DEFINE_float("step_timeout_s", 0.0,
+             "per-step deadline for the Trainer loop's hang watchdog "
+             "(paddle_tpu.resilience.watchdog). 0 (default) = off. When "
+             "set, a monitor thread checks that the training loop makes "
+             "progress (every batch and every declared materialization "
+             "point re-arms the deadline); a step that exceeds it — a "
+             "wedged collective, a stalled reader, a hung device — "
+             "records a durable step_hung event, dumps the profiler "
+             "timeline artifact next to the elastic state dir, and "
+             "exits the worker with code 75 (EX_TEMPFAIL) so an elastic "
+             "supervisor classifies the death as TRANSIENT and "
+             "restarts it from the paired checkpoint: a hang becomes a "
+             "restart, never a wedged gang. Size it to several times "
+             "the slowest legitimate step (cold compiles re-arm the "
+             "deadline only when they finish)")
+DEFINE_float("loss_spike_factor", 0.0,
+             "numeric guardrail (paddle_tpu.resilience.guardrails): a "
+             "batch whose loss exceeds this factor times the running "
+             "median of recent accepted losses is treated like a "
+             "non-finite loss — the batch is SKIPPED (not counted into "
+             "pass metrics, recorded as a batch_skipped event) under "
+             "the loss_skip_budget. 0 (default) = spike detection off "
+             "(non-finite detection is governed by loss_skip_budget "
+             "alone). The comparison starts after 3 accepted batches; "
+             "values below ~2 will false-positive on normal early-"
+             "training noise")
+DEFINE_int32("loss_skip_budget", 0,
+             "numeric guardrail: how many CONSECUTIVE batches the "
+             "Trainer loop may skip (non-finite loss, or a spike past "
+             "loss_spike_factor) before escalating. 0 (default) = "
+             "guardrails off — a non-finite loss flows through exactly "
+             "as before (check_nan_inf keeps its per-op raise "
+             "semantics). On budget exhaustion the loop REWINDS model "
+             "+ optimizer state to the last checkpoint (the PAIRED "
+             "checkpoint in elastic mode) once per budget window and "
+             "keeps training; a second consecutive exhaustion with no "
+             "accepted batch in between gives up with "
+             "FloatingPointError. Each skip forces a per-batch loss "
+             "materialization — under pipeline=True the guardrail "
+             "check is a declared sync point")
+DEFINE_int32("elastic_ckpt_period", 1,
+             "elastic Trainer worker (Trainer.train(elastic=True)): "
+             "lease-committed batches between paired checkpoint+"
+             "task-master-snapshot saves. 1 (default) pairs every "
+             "committed batch — the chaos-gate setting; larger values "
+             "amortise checkpoint cost, and a kill then replays up to "
+             "period-1 committed tasks from the paired snapshot "
+             "(still exactly-once in the resumed timeline: the model "
+             "rolls back to the same point the task master does). A "
+             "numeric-guardrail REWIND, by contrast, cannot roll the "
+             "live master back, so at period>1 it discards up to "
+             "period-1 accepted batches' contributions with a recorded "
+             "guard_rewind_dropped_commits event — run period=1 when "
+             "every contribution must survive a rewind")
 DEFINE_int32("serve_queue_depth", 64,
              "online serving: bound on requests queued for dispatch "
              "across all models; request queue_depth+1 is shed "

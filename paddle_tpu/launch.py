@@ -67,6 +67,8 @@ def launch(nprocs, coordinator, script_argv, env=None, python=None,
             e["PADDLE_TPU_COORDINATOR"] = coordinator
             e["PADDLE_TPU_NUM_PROCESSES"] = str(nprocs)
             e["PADDLE_TPU_PROCESS_ID"] = str(rank)
+            # drain budget for the trainers' SIGTERM preemption hook
+            e["PADDLE_TPU_GRACE_SEC"] = str(grace_sec)
             if master is not None:
                 e["PADDLE_TPU_MASTER_ADDR"] = master.addr
                 e["PADDLE_TPU_MASTER_TIMEOUT"] = str(master_timeout_sec)

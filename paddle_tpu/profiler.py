@@ -34,7 +34,9 @@ __all__ = ["timer", "stat_summary", "print_stats", "reset_stats",
            "update_autoscale_counters", "autoscale_counters",
            "reset_autoscale_counters",
            "update_memory_counters", "memory_counters",
-           "reset_memory_counters"]
+           "reset_memory_counters",
+           "update_trainer_counters", "trainer_counters",
+           "reset_trainer_counters"]
 
 _enabled = False
 _records = defaultdict(list)  # label -> [seconds]
@@ -49,6 +51,7 @@ _generation_counters = defaultdict(float)  # autoregressive-serving observabilit
 _router_counters = defaultdict(float)     # multi-replica-router observability
 _autoscale_counters = defaultdict(float)  # closed-loop-autoscaler observability
 _memory_counters = defaultdict(float)     # static-memory-planner observability
+_trainer_counters = defaultdict(float)    # trainer-loop failure-policy observability
 _T0 = time.perf_counter()
 
 
@@ -97,6 +100,7 @@ def reset_profiler():
     _router_counters.clear()
     _autoscale_counters.clear()
     _memory_counters.clear()
+    _trainer_counters.clear()
 
 
 def update_pipeline_counters(**counters):
@@ -214,6 +218,30 @@ def elastic_counters():
 
 def reset_elastic_counters():
     _elastic_counters.clear()
+
+
+def update_trainer_counters(**counters):
+    """Accumulate trainer-loop failure-policy observability counters
+    (the elastic-worker/watchdog/guardrail machinery; a few dict adds
+    per SKIP/REWIND/HANG — operator-visible events, never per step).
+    Keys in use: ``batches_skipped`` (numeric-guardrail skips),
+    ``guard_rewinds`` (budget-exhaustion checkpoint rewinds),
+    ``steps_hung`` (watchdog firings — normally the last counter the
+    process ever bumps), ``elastic_tasks_committed`` and
+    ``elastic_task_failures`` (lease accounting of the elastic Trainer
+    worker), ``preempts_truncated`` (SIGTERM drains that could not fit
+    a final checkpoint inside the grace window)."""
+    for k, v in counters.items():
+        _trainer_counters[k] += float(v)
+
+
+def trainer_counters():
+    """Snapshot {counter: value} of the trainer-loop counters."""
+    return dict(_trainer_counters)
+
+
+def reset_trainer_counters():
+    _trainer_counters.clear()
 
 
 _GEN_MAX_KEYS = frozenset(("gen_max_running", "gen_page_util_max"))
@@ -447,6 +475,10 @@ def write_timeline(path):
     - ``memory``: static-memory-planner counters (preflights/plans run,
       predicted peak vs ``jax.live_arrays`` measured high-water — the
       predicted-vs-actual evidence for paddle_tpu.analysis.memory).
+    - ``trainer``: trainer-loop failure-policy counters (guardrail
+      batch skips and rewinds, watchdog step_hung firings, elastic
+      lease commits, truncated preemptions — the survival evidence
+      for the elastic Trainer worker).
     """
     import json
     rows = []
@@ -471,6 +503,7 @@ def write_timeline(path):
         "router": dict(_router_counters),
         "autoscale": dict(_autoscale_counters),
         "memory": dict(_memory_counters),
+        "trainer": dict(_trainer_counters),
     }
     with open(path, "w") as f:
         json.dump(artifact, f, indent=1)

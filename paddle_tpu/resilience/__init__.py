@@ -20,6 +20,13 @@ failure semantics, not scattered try/excepts):
   crash-loop window + generation bump + SIGTERM->SIGKILL escalation)
   both the elastic trainer supervisor and the serving replica pool
   consume, so their judgement cannot drift.
+- :mod:`.watchdog` — ``StepWatchdog``: the per-step progress deadline
+  that turns a wedged training step (hung collective, stalled reader)
+  into a recorded ``step_hung`` + non-zero exit the elastic supervisor
+  restarts transiently — a hang becomes a restart, never a wedged gang.
+- :mod:`.guardrails` — ``NumericGuard``: non-finite/spiking losses
+  skip the batch under a consecutive-skip budget, exhaustion rewinds
+  to the last checkpoint once per window before giving up.
 
 Consumers elsewhere in the package: checkpoint.py (CRC + fallback to the
 previous complete checkpoint), trainer.py (SIGTERM preemption
@@ -27,23 +34,28 @@ checkpoint), parallel/async_sgd.py (bounded reconnect, then recorded
 degraded continuation), paddle_tpu.native.Reader (reader.next site),
 dataset/common.py, and bench.py's device-init probe.
 """
-from .events import record_event, events, clear_events  # noqa: F401
+from .events import (  # noqa: F401
+    record_event, record_durable_event, events, clear_events,
+)
 from .retry import (  # noqa: F401
     RetryPolicy, RetryError, AttemptTimeout, retry,
 )
 from .faults import (  # noqa: F401
-    FaultError, arm, disarm, reset, hits, armed, fault_point,
-    parse_fault_spec, load_fault_spec,
+    FaultError, SITE_TABLE, arm, disarm, reset, hits, armed,
+    fault_point, parse_fault_spec, load_fault_spec,
 )
 from .supervise import (  # noqa: F401
     SlotDecision, SlotSupervision, escalate_stop, signal_quietly,
 )
+from .watchdog import StepWatchdog, STEP_HUNG_EXIT  # noqa: F401
+from .guardrails import NumericGuard  # noqa: F401
 
 __all__ = [
-    "record_event", "events", "clear_events",
+    "record_event", "record_durable_event", "events", "clear_events",
     "RetryPolicy", "RetryError", "AttemptTimeout", "retry",
-    "FaultError", "arm", "disarm", "reset", "hits", "armed",
-    "fault_point", "parse_fault_spec", "load_fault_spec",
+    "FaultError", "SITE_TABLE", "arm", "disarm", "reset", "hits",
+    "armed", "fault_point", "parse_fault_spec", "load_fault_spec",
     "SlotDecision", "SlotSupervision", "escalate_stop",
     "signal_quietly",
+    "StepWatchdog", "STEP_HUNG_EXIT", "NumericGuard",
 ]

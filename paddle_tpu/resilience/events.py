@@ -11,10 +11,13 @@ record.
 from __future__ import annotations
 
 import collections
+import json
+import os
 import threading
 import time
 
-__all__ = ["record_event", "events", "clear_events"]
+__all__ = ["record_event", "record_durable_event", "events",
+           "clear_events"]
 
 # bounded: a multi-day outage records several events per step, and the
 # audit trail must not become its own resource leak — oldest drop first
@@ -33,6 +36,50 @@ def record_event(kind, site=None, **info):
     ev.update(info)
     with _lock:
         _events.append(ev)
+    return ev
+
+
+def _json_line(ev):
+    """RFC-compliant JSON for the on-disk audit trail: json.dumps would
+    happily emit bare ``NaN``/``Infinity`` tokens (a guardrail's
+    non-finite loss is a ROUTINE payload here), which Python reads back
+    but strict consumers — jq, a Go/JS log pipeline — reject. Non-
+    finite floats serialize as their repr strings instead."""
+    try:
+        return json.dumps(ev, allow_nan=False)
+    except ValueError:
+        def fix(v):
+            if isinstance(v, float) and (v != v or v in
+                                         (float("inf"), float("-inf"))):
+                return repr(v)
+            if isinstance(v, dict):
+                return {k: fix(x) for k, x in v.items()}
+            if isinstance(v, (list, tuple)):
+                return [fix(x) for x in v]
+            return v
+        return json.dumps(fix(ev), allow_nan=False)
+
+
+def record_durable_event(kind, site=None, state_dir=None, **info):
+    """``record_event`` that ALSO lands in the elastic job's on-disk
+    audit trail (``<state_dir>/events.jsonl``) when one exists —
+    ``state_dir`` defaults to the launcher-exported
+    ``PADDLE_TPU_ELASTIC_STATE``. Workers use this for events that must
+    survive the process (a watchdog about to ``os._exit``, a preemption
+    about to be SIGKILLed): the in-memory record dies with them, the
+    appended line does not. One ``O_APPEND`` write per event — short
+    JSON lines land atomically beside the supervisor's own."""
+    ev = record_event(kind, site=site, **info)
+    state_dir = state_dir or os.environ.get("PADDLE_TPU_ELASTIC_STATE")
+    if state_dir:
+        try:
+            os.makedirs(state_dir, exist_ok=True)
+            with open(os.path.join(state_dir, "events.jsonl"), "a") as f:
+                f.write(_json_line(ev) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError:
+            pass  # the in-memory record still stands
     return ev
 
 

@@ -128,6 +128,18 @@ site                      where
                           unusable — the walk falls through to the
                           next-older complete pair with a recorded
                           ``elastic_degraded`` event
+``trainer.step``          the Trainer.train loop, once per training
+                          step before the Executor dispatch: a delay
+                          models a WEDGED step (a hung collective, a
+                          stalled device) — with ``FLAGS.
+                          step_timeout_s`` set, the step watchdog
+                          trips, records a durable ``step_hung``
+                          event, dumps the profiler timeline and
+                          exits 75 so an elastic supervisor restarts
+                          the worker transiently; a raise models a
+                          step failure and propagates out of
+                          ``train()`` (non-zero exit -> the same
+                          transient-restart path)
 ========================  ====================================================
 
 Spec grammar (env var or ``load_fault_spec`` string)::
@@ -160,10 +172,43 @@ import time
 from .events import record_event
 
 __all__ = ["FaultError", "arm", "disarm", "reset", "hits", "armed",
-           "fault_point", "parse_fault_spec", "load_fault_spec"]
+           "fault_point", "parse_fault_spec", "load_fault_spec",
+           "SITE_TABLE"]
 
 _ENV_VAR = "PADDLE_TPU_FAULT_SPEC"
 _ACTIONS = ("raise", "delay", "corrupt")
+
+# The machine-readable face of the docstring table above: site ->
+# (defining module under paddle_tpu/, armable). ``armable=False`` marks
+# names that are only EVENT sites (recorded on degradation events but
+# never a ``fault_point`` call). tests/test_trainer_resilience.py walks
+# this registry and asserts code, this table, the docstring table and
+# cluster/README.md agree — drift between them is a test failure, not
+# a doc rot.
+SITE_TABLE = {
+    "checkpoint.write": ("checkpoint.py", True),
+    "checkpoint.load": ("checkpoint.py", True),
+    "async_sgd.push_grads": ("parallel/async_sgd.py", True),
+    "async_sgd.pull_params": ("parallel/async_sgd.py", True),
+    "reader.next": ("native/__init__.py", True),
+    "dataset.download": ("dataset/common.py", True),
+    "pipeline.feed_next": ("pipeline.py", True),
+    "serving.dispatch": ("serving/batcher.py", True),
+    "serving.reload": ("serving/registry.py", True),
+    "serving.generate": ("serving/generator.py", True),
+    "serving.route": ("serving/router.py", True),
+    "serving.autoscale": ("serving/autoscale.py", True),
+    "comm.quantize": ("comm/allreduce.py", True),
+    "comm.bucket_roundtrip": ("comm/bucket.py", True),
+    "comm.overlap": ("comm/overlap.py", True),
+    "comm.gspmd": ("core/executor.py", False),
+    "tune.candidate": ("tune/loop.py", True),
+    "tune.cache": ("tune/cache.py", True),
+    "elastic.heartbeat": ("elastic/supervisor.py", True),
+    "elastic.replan": ("elastic/replan.py", True),
+    "elastic.resume": ("elastic/resume.py", True),
+    "trainer.step": ("trainer.py", True),
+}
 
 
 class FaultError(RuntimeError):

@@ -20,6 +20,8 @@ from .core.executor import Executor
 from .core.scope import global_scope
 from .data_feeder import DataFeeder
 from .pipeline import FeedPipeline, materialize, materialize_scalar
+from .resilience import (NumericGuard, StepWatchdog, fault_point,
+                         record_durable_event)
 
 
 class BeginPass(object):
@@ -100,11 +102,27 @@ class Trainer(object):
         # set by the SIGTERM preemption hook; train() drains the current
         # batch, writes a final synchronous checkpoint, and returns
         self.preempted = False
+        self._preempt_at = None      # monotonic stamp of the SIGTERM
+        self._grace_sec = None       # launcher-exported drain window
+        self._last_ckpt_secs = None  # duration of the last save (est.)
 
-    def _maybe_init(self):
+    def _maybe_init(self, load=True):
+        """Run startup once; ``load=False`` skips the checkpoint-restore
+        walk (the elastic worker resumes through the PAIRED
+        ``elastic.resume`` protocol instead of the flat newest-wins
+        one)."""
         if self._initialized:
             return
         self.exe.run(self.startup_program)
+        if load:
+            self._load_checkpoint_state()
+        self._initialized = True
+
+    def _load_checkpoint_state(self):
+        """Restore from ``checkpoint_dir`` (manifest layout, retention
+        root, or flat persistables — newest wins). Returns True when
+        anything was loaded; also the numeric guardrail's non-elastic
+        rewind target."""
         if self.checkpoint_dir and os.path.isdir(self.checkpoint_dir) and \
                 os.listdir(self.checkpoint_dir):
             from . import checkpoint as _ckpt
@@ -114,6 +132,7 @@ class Trainer(object):
                 _ckpt.load_checkpoint(
                     self.checkpoint_dir, self.main_program,
                     dist_context=self.exe.dist_context)
+                return True
             else:
                 newest = _ckpt.latest_checkpoint(self.checkpoint_dir)
                 files = [os.path.join(self.checkpoint_dir, f)
@@ -139,7 +158,8 @@ class Trainer(object):
                     # semantics)
                     _io.load_persistables(self.exe, self.checkpoint_dir,
                                           main_program=self.main_program)
-        self._initialized = True
+            return True
+        return False
 
     def _install_preemption_hook(self):
         """SIGTERM -> preempted flag; the training loop turns it into a
@@ -147,28 +167,84 @@ class Trainer(object):
         contract: the grace window is for draining one batch and writing
         state, reference role: the pserver's crash-safe checkpoint +
         re-register dance). Only the main thread may own signal
-        handlers; elsewhere the hook is a no-op. Returns (installed,
+        handlers; elsewhere the hook is a no-op (``request_preempt()``
+        is the off-main-thread equivalent). Returns (installed,
         previous_handler)."""
+        # the supervisor/launcher exports its SIGTERM->SIGKILL window so
+        # the drain can be budgeted against the REAL deadline
+        grace = os.environ.get("PADDLE_TPU_GRACE_SEC")
+        if grace:
+            try:
+                self._grace_sec = float(grace)
+            except ValueError:
+                self._grace_sec = None
         if threading.current_thread() is not threading.main_thread():
             return False, None
 
         def on_sigterm(signum, frame):
             self.preempted = True
+            self._preempt_at = time.monotonic()
 
         try:
             return True, signal.signal(signal.SIGTERM, on_sigterm)
         except ValueError:          # embedded interpreters
             return False, None
 
-    def _preempt_checkpoint(self, pass_id, batch_id):
+    def request_preempt(self):
+        """Programmatic preemption: same drain-then-checkpoint path as
+        the SIGTERM hook, for callers that own ``train()`` on a
+        non-main thread (where ``signal.signal`` is unavailable)."""
+        self.preempted = True
+        self._preempt_at = time.monotonic()
+
+    def _preempt_checkpoint(self, pass_id, batch_id, save_fn=None):
+        """Final drain checkpoint, budgeted against the launcher's
+        ``--grace-sec``: when the remaining window cannot plausibly fit
+        the save (judged by the last measured save duration), a durable
+        ``preempt_truncated`` event lands FIRST — before SIGKILL can —
+        and the save is still attempted (checkpoints are atomic: a
+        SIGKILL mid-write leaves the previous one intact). A save that
+        finishes but overran the window records the same event
+        post-hoc."""
+        from . import profiler as _prof
         from .resilience import record_event
-        self.save_checkpoint()
+        t0 = time.monotonic()
+        remaining = None
+        if self._grace_sec is not None and self._preempt_at is not None:
+            remaining = self._grace_sec - (t0 - self._preempt_at)
+        est = self._last_ckpt_secs
+        truncated = remaining is not None and (
+            remaining <= 0
+            or (est is not None and est * 1.2 > remaining))
+        if truncated:
+            _prof.update_trainer_counters(preempts_truncated=1)
+            record_durable_event(
+                "preempt_truncated", site="trainer.train",
+                phase="pre", remaining_sec=round(remaining, 3),
+                last_save_sec=est, pass_id=pass_id, batch_id=batch_id)
+        (save_fn or self.save_checkpoint)()
+        took = time.monotonic() - t0
+        if not truncated and remaining is not None and took > remaining:
+            _prof.update_trainer_counters(preempts_truncated=1)
+            record_durable_event(
+                "preempt_truncated", site="trainer.train",
+                phase="post", overran_sec=round(took - remaining, 3),
+                pass_id=pass_id, batch_id=batch_id)
         record_event("preempt_checkpoint", site="trainer.train",
                      dirname=self.checkpoint_dir, pass_id=pass_id,
                      batch_id=batch_id)
 
-    def train(self, reader, num_passes=1, event_handler=None,
-              pipeline=None, pipeline_depth=None):
+    def _guard_rewind(self):
+        """Non-elastic numeric-guardrail rewind: reload the newest state
+        from ``checkpoint_dir``. Returns True when a restore happened."""
+        if not self.checkpoint_dir:
+            return False
+        return self._load_checkpoint_state()
+
+    def train(self, reader=None, num_passes=1, event_handler=None,
+              pipeline=None, pipeline_depth=None, elastic=None,
+              task_reader=None, elastic_root=None, on_commit=None,
+              on_skip=None, on_resume=None):
         """``pipeline=True`` runs the async execution pipeline
         (paddle_tpu.pipeline): a feed thread prepares + device_puts batch
         k+1 while batch k computes, and fetches stay on device until a
@@ -176,10 +252,68 @@ class Trainer(object):
         the log-period progress line, pass end, or a checkpoint. Losses
         are bit-identical to the synchronous mode. Defaults follow
         ``FLAGS.pipeline`` / ``FLAGS.pipeline_depth``; ``check_nan_inf``
-        always forces the synchronous per-op path."""
-        self._maybe_init()
+        always forces the synchronous per-op path.
+
+        ``elastic=True`` runs the loop as an ELASTIC WORKER
+        (paddle_tpu.elastic.worker, doc/elasticity.md): the launcher
+        env is resolved and validated, the (host, chip)/comm plan is
+        re-computed for this generation's world and the program
+        transpiled onto its mesh, checkpoints pair with task-master
+        snapshots, and — when ``task_reader`` is given (``payload ->
+        one minibatch``) — batches lease through the supervisor-owned
+        task master with exactly-once commit accounting. Without
+        ``task_reader`` the plain ``reader`` drives a lease-free worker
+        (same role minus the master). Composes with ``pipeline=`` and
+        the ``comm_overlap``/``comm_policy`` flags in one job.
+
+        Two loop-level failure policies, both off by default:
+        ``FLAGS.step_timeout_s`` arms the step-hang watchdog (a wedged
+        step exits 75 for a transient supervisor restart) and
+        ``FLAGS.loss_skip_budget`` arms the numeric guardrails
+        (non-finite/spiking losses skip the batch, budget exhaustion
+        rewinds to the last checkpoint once per window). The guardrail
+        check materializes each batch's loss — a declared per-batch
+        sync point under ``pipeline=True``."""
         from . import profiler as _prof
         from .flags import FLAGS
+        use_elastic = FLAGS.elastic if elastic is None else bool(elastic)
+        if reader is None and not (use_elastic and task_reader is not None):
+            raise ValueError("train() needs a reader (or elastic=True "
+                             "with task_reader=)")
+        worker = None
+        if use_elastic:
+            from .elastic.worker import ElasticWorker
+            if task_reader is not None and reader is not None:
+                raise ValueError(
+                    "train(elastic=True) takes EITHER a plain reader "
+                    "(lease-free worker) OR task_reader= (master-leased "
+                    "batches), not both")
+            worker = ElasticWorker(
+                self, task_reader=task_reader,
+                root=elastic_root or self.checkpoint_dir,
+                on_commit=on_commit, on_skip=on_skip)
+            try:
+                worker.setup()
+                # startup first, PAIRED resume second (the flat
+                # newest-wins restore of _maybe_init would ignore the
+                # snapshot pairing)
+                self._maybe_init(load=False)
+                worker.resume()
+                self._elastic_worker = worker
+                if on_resume is not None:
+                    # the restored-state hook (the chaos harness writes
+                    # its probe-continuity anchor here)
+                    on_resume(worker)
+                if task_reader is not None:
+                    reader = worker.reader()
+            except BaseException:
+                # setup() may already have REGISTERED a heartbeating
+                # master client; a failure before the loop's own
+                # finally owns the worker must not leak that phantom
+                # membership until process exit
+                worker.close()
+                raise
+        self._maybe_init()
         handler = event_handler or (lambda e: None)
         log_period = FLAGS.log_period
         use_pipe = FLAGS.pipeline if pipeline is None else bool(pipeline)
@@ -188,12 +322,40 @@ class Trainer(object):
         if use_pipe and (depth < 1 or self.exe.check_nan_inf):
             # the NaN/Inf scan needs the synchronous per-op path
             use_pipe = False
+        watchdog = None
+        if FLAGS.step_timeout_s > 0:
+            watchdog = StepWatchdog(FLAGS.step_timeout_s)
+            if worker is not None:
+                # the lease wait ticks a live deadline (idle != hung)
+                worker.watchdog = watchdog
+        guard = None
+        if FLAGS.loss_skip_budget > 0:
+            base_rewind = (worker.rewind if worker is not None
+                           else self._guard_rewind)
+
+            def rewind_fn():
+                # a checkpoint restore is recovery, not a step: the
+                # step deadline pauses around it like it does around
+                # the symmetric checkpoint save
+                if watchdog is not None:
+                    watchdog.disarm()
+                try:
+                    return base_rewind()
+                finally:
+                    if watchdog is not None:
+                        watchdog.arm("guard-rewind")
+
+            guard = NumericGuard(
+                FLAGS.loss_skip_budget,
+                spike_factor=FLAGS.loss_spike_factor,
+                rewind_fn=rewind_fn)
         # a fresh train() gets a fresh preemption state: the flag from a
         # previous preempted run must not end this one after one batch
         self.preempted = False
+        self._preempt_at = None
         old_sigterm = None
         hook_installed = False
-        if self.checkpoint_dir:
+        if self.checkpoint_dir or (worker is not None and worker.root):
             hook_installed, old_sigterm = self._install_preemption_hook()
         try:
             for pass_id in range(num_passes):
@@ -201,6 +363,11 @@ class Trainer(object):
                 costs = []
                 batch_id = -1
                 pipe = None
+                if watchdog is not None:
+                    # the deadline covers the first batch's feed+compile
+                    # too — a reader wedged before its first yield is
+                    # still a hang
+                    watchdog.arm("pass%d/start" % pass_id)
                 with _prof.timer("pass"):
                     try:
                         if use_pipe:
@@ -211,6 +378,14 @@ class Trainer(object):
                             batches = reader()
                         for batch_id, data in enumerate(batches):
                             handler(BeginIteration(pass_id, batch_id))
+                            if watchdog is not None:
+                                watchdog.ping("pass%d/batch%d"
+                                              % (pass_id, batch_id))
+                            # chaos lever: delay = a wedged step (the
+                            # watchdog's quarry), raise = a step failure
+                            # that propagates (the supervisor's
+                            # transient-restart path)
+                            fault_point("trainer.step")
                             with _prof.timer("batch"):
                                 if use_pipe:
                                     # data is already a device-resident
@@ -227,7 +402,37 @@ class Trainer(object):
                                         fetch_list=self.fetch_list)
                                     cost = float(
                                         np.asarray(outs[0]).reshape(-1)[0])
-                            costs.append(cost)
+                            skipped = False
+                            if guard is not None:
+                                # the guardrail sync point: a wedged
+                                # device surfaces HERE under the async
+                                # pipeline, inside the armed deadline
+                                cost = materialize_scalar(cost)
+                                skipped = guard.check(
+                                    cost, pass_id=pass_id,
+                                    batch_id=batch_id) != "ok"
+                                if watchdog is not None:
+                                    watchdog.ping(
+                                        "pass%d/batch%d/guarded"
+                                        % (pass_id, batch_id))
+                            counted = True
+                            if worker is not None:
+                                # lease commit + (on the cadence) the
+                                # paired checkpoint — not a step, so the
+                                # step deadline pauses around it
+                                if watchdog is not None:
+                                    watchdog.disarm()
+                                counted = worker.commit(cost=cost,
+                                                        skipped=skipped)
+                                if watchdog is not None:
+                                    watchdog.arm("pass%d/batch%d/next"
+                                                 % (pass_id, batch_id))
+                            if not skipped and counted:
+                                # a lapsed lease (counted=False) is a
+                                # batch the audited timeline disowns —
+                                # a survivor re-runs it; pass metrics
+                                # must agree with the lease accounting
+                                costs.append(cost)
                             if log_period and \
                                     (batch_id + 1) % log_period == 0:
                                 # the reference's per-log_period batch line
@@ -235,10 +440,14 @@ class Trainer(object):
                                 # — a declared materialization point
                                 window = [materialize_scalar(c)
                                           for c in costs[-log_period:]]
-                                print("pass %d batch %d: cost=%.6f "
-                                      "(avg %.6f)"
-                                      % (pass_id, batch_id, window[-1],
-                                         float(np.mean(window))))
+                                if window:
+                                    print("pass %d batch %d: cost=%.6f "
+                                          "(avg %.6f)"
+                                          % (pass_id, batch_id, window[-1],
+                                             float(np.mean(window))))
+                                if watchdog is not None:
+                                    watchdog.ping("pass%d/batch%d/log"
+                                                  % (pass_id, batch_id))
                             handler(EndIteration(pass_id, batch_id, cost,
                                                  {"fetches": outs[1:]}))
                             if self.preempted:
@@ -250,15 +459,45 @@ class Trainer(object):
                 # pass end is a materialization point (and it precedes
                 # every checkpoint below, keeping saves synchronous)
                 costs = [materialize_scalar(c) for c in costs]
-                if self.preempted and self.checkpoint_dir:
-                    self._preempt_checkpoint(pass_id, batch_id)
+                if watchdog is not None:
+                    watchdog.disarm()
+                # a guardrail-skipped batch's update may still sit in
+                # the params (non-finite case) until a rewind or an
+                # accepted batch clears it: persisting that state would
+                # make the poison the newest resume point
+                tainted = guard is not None and guard.tainted
+                if tainted and (worker is not None and worker.root
+                                or self.checkpoint_dir):
+                    record_durable_event(
+                        "checkpoint_skipped_tainted",
+                        site="trainer.guard", pass_id=pass_id,
+                        batch_id=batch_id, preempted=self.preempted)
+                if self.preempted:
+                    if tainted:
+                        return
+                    if worker is not None and worker.root:
+                        self._preempt_checkpoint(
+                            pass_id, batch_id,
+                            save_fn=worker.pair_checkpoint)
+                    elif self.checkpoint_dir:
+                        self._preempt_checkpoint(pass_id, batch_id)
                     return
-                if self.checkpoint_dir:
+                if tainted:
+                    pass                      # keep the last clean save
+                elif worker is not None:
+                    worker.pair_checkpoint()  # pass-end pair (no-op when
+                    #                           the cadence already did)
+                elif self.checkpoint_dir:
                     self.save_checkpoint()
                 handler(EndPass(pass_id,
                                 {"avg_cost": float(np.mean(costs))
                                  if costs else float("nan")}))
         finally:
+            if watchdog is not None:
+                watchdog.close()
+            if worker is not None:
+                worker.record_stats(self.exe.stats)
+                worker.close()
             if hook_installed:
                 signal.signal(signal.SIGTERM, old_sigterm)
 
@@ -358,19 +597,26 @@ class Trainer(object):
         (the Go pserver checkpoint role)."""
         dirname = dirname or self.checkpoint_dir
         from . import checkpoint as _ckpt
-        if sharded or async_:
-            return _ckpt.save_checkpoint(dirname, self.main_program,
-                                         step=step, async_=async_)
-        os.makedirs(dirname, exist_ok=True)
-        # a stale manifest in the same dir would shadow this newer
-        # persistables save on resume (_maybe_init prefers the manifest
-        # layout); retire it
-        for fn in (_ckpt._COMPLETE, _ckpt._MANIFEST):
-            p = os.path.join(dirname, fn)
-            if os.path.exists(p):
-                os.remove(p)
-        _io.save_persistables(self.exe, dirname,
-                              main_program=self.main_program)
+        t0 = time.monotonic()
+        try:
+            if sharded or async_:
+                return _ckpt.save_checkpoint(dirname, self.main_program,
+                                             step=step, async_=async_)
+            os.makedirs(dirname, exist_ok=True)
+            # a stale manifest in the same dir would shadow this newer
+            # persistables save on resume (_maybe_init prefers the
+            # manifest layout); retire it
+            for fn in (_ckpt._COMPLETE, _ckpt._MANIFEST):
+                p = os.path.join(dirname, fn)
+                if os.path.exists(p):
+                    os.remove(p)
+            _io.save_persistables(self.exe, dirname,
+                                  main_program=self.main_program)
+        finally:
+            # the preemption drain budgets its final save against this
+            # (an async_ save measures only the device->host snapshot —
+            # still the synchronous part a drain would wait on)
+            self._last_ckpt_secs = time.monotonic() - t0
 
     def save_inference_model(self, dirname, feeded_var_names, target_vars):
         _io.save_inference_model(dirname, feeded_var_names, target_vars,

@@ -733,3 +733,451 @@ def test_fingerprint_exchange_latches_once_per_generation(tmp_path):
                                      timeout_sec=5)
     assert fp2 and fp2 != fp1
     assert open(rank0).read() == before
+
+
+# ---------------------------------------------------------------------------
+# Trainer.train(elastic=True): the real loop as an elastic worker (PR 15)
+
+
+def _worker_trainer(checkpoint_dir=None):
+    main = pt.default_main_program()
+    startup = pt.default_startup_program()
+    x = layers.data("wx", shape=[4], dtype="float32")
+    y = layers.data("wy", shape=[1], dtype="int64")
+    h = layers.fc(x, size=8, act="tanh")
+    pred = layers.fc(h, size=2, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, y))
+    return pt.Trainer(cost=loss, optimizer=pt.SGD(learning_rate=0.3),
+                      feed_list=[x, y], place=pt.CPUPlace(),
+                      main_program=main, startup_program=startup,
+                      checkpoint_dir=checkpoint_dir)
+
+
+def _task_batch(payload, nan=False):
+    i = int(payload.decode().split("-")[1])
+    rng = np.random.RandomState(100 + i)
+    bx = rng.rand(8, 4).astype("float32")
+    if nan:
+        bx = bx.copy()
+        bx[0, 0] = np.nan
+    by = (bx.sum(axis=1) > 2).astype("int64").reshape(-1, 1)
+    return list(zip(bx, by))
+
+
+def _lease_env(monkeypatch, master, state_dir, timeout="30"):
+    monkeypatch.setenv("PADDLE_TPU_NUM_PROCESSES", "1")
+    monkeypatch.setenv("PADDLE_TPU_PROCESS_ID", "0")
+    monkeypatch.setenv("PADDLE_TPU_ELASTIC", "1")
+    monkeypatch.setenv("PADDLE_TPU_ELASTIC_GENERATION", "0")
+    monkeypatch.setenv("PADDLE_TPU_ELASTIC_STATE", str(state_dir))
+    if master is not None:
+        monkeypatch.setenv("PADDLE_TPU_MASTER_ADDR", master.addr)
+        monkeypatch.setenv("PADDLE_TPU_MASTER_TIMEOUT", timeout)
+    else:
+        monkeypatch.delenv("PADDLE_TPU_MASTER_ADDR", raising=False)
+
+
+def _mk_master(tasks, timeout_sec=30.0, failure_max=3):
+    from paddle_tpu.elastic.supervisor import TaskMasterHost
+    return TaskMasterHost([b"batch-%d" % i for i in range(tasks)],
+                          timeout_sec=timeout_sec,
+                          failure_max=failure_max)
+
+
+def test_trainer_elastic_worker_leases_pairs_and_resumes(
+        tmp_path, monkeypatch):
+    """The tentpole contract in one process: Trainer.train(elastic=True)
+    leases every task exactly once through the supervisor-owned master,
+    pairs each checkpoint with a master snapshot, writes the
+    plan-gen<G>.json audit artifact, and folds lease accounting into
+    Executor.stats."""
+    import glob
+    master = _mk_master(5)
+    root = str(tmp_path / "ckpt")
+    _lease_env(monkeypatch, master, tmp_path)
+    tr = _worker_trainer()
+    commits = []
+    try:
+        with flags_guard(comm_hosts=FLAGS.comm_hosts):
+            tr.train(elastic=True, task_reader=_task_batch,
+                     elastic_root=root,
+                     on_commit=lambda s, t, p, c: commits.append(
+                         (s, p.decode())))
+    finally:
+        master.close()
+    assert [c[0] for c in commits] == [1, 2, 3, 4, 5]
+    assert sorted(c[1] for c in commits) == \
+        ["batch-%d" % i for i in range(5)]
+    assert tr.exe.stats["elastic_tasks_committed"] == 5
+    assert tr.exe.stats["elastic_lease_losses"] == 0
+    # every retained checkpoint carries its paired master snapshot
+    snaps = glob.glob(os.path.join(root, "ckpt-*",
+                                   resume_mod.SNAP_IN_DIR))
+    assert snaps
+    assert os.path.exists(os.path.join(str(tmp_path), "plan-gen0.json"))
+    # the worker went through the paired-resume path (fresh run: step 0)
+    assert tr._elastic_worker.step == 5
+
+
+def test_trainer_elastic_worker_resumes_from_paired_point(
+        tmp_path, monkeypatch):
+    """A second generation over the same root resumes at the paired
+    step and only processes the still-owed tasks."""
+    root = str(tmp_path / "ckpt")
+    master = _mk_master(4)
+    _lease_env(monkeypatch, master, tmp_path)
+    tr = _worker_trainer()
+    with flags_guard(comm_hosts=FLAGS.comm_hosts):
+        tr.train(elastic=True, task_reader=_task_batch,
+                 elastic_root=root)
+    master.close()
+    assert tr._elastic_worker.step == 4
+    # generation 1: a fresh master restored from the PAIRED snapshot
+    # (the supervisor's restore path) has nothing left to lease
+    rp = resume_mod.resume_point(root)
+    assert rp is not None and rp.step == 4 and rp.snapshot
+    master2 = _mk_master(0)
+    n = master2.restore_from(rp.snapshot)
+    assert n == 0                      # all 4 committed before the pair
+    monkeypatch.setenv("PADDLE_TPU_ELASTIC_GENERATION", "1")
+    monkeypatch.setenv("PADDLE_TPU_MASTER_ADDR", master2.addr)
+    tr2 = _worker_trainer()
+    commits2 = []
+    try:
+        with flags_guard(comm_hosts=FLAGS.comm_hosts):
+            tr2.train(elastic=True, task_reader=_task_batch,
+                      elastic_root=root,
+                      on_commit=lambda s, t, p, c: commits2.append(s))
+    finally:
+        master2.close()
+    assert commits2 == []              # nothing double-processed
+    assert tr2._elastic_worker.step == 4   # resumed, not restarted
+
+
+def test_trainer_elastic_lease_lapse_not_double_counted(
+        tmp_path, monkeypatch):
+    """A commit whose lease lapsed (task_finished -> False) must NOT
+    advance the step or checkpoint — the task belongs to a survivor."""
+    master = _mk_master(2, timeout_sec=0.5)
+    root = str(tmp_path / "ckpt")
+    _lease_env(monkeypatch, master, tmp_path, timeout="0.5")
+    tr = _worker_trainer()
+    from paddle_tpu.elastic.worker import ElasticWorker
+
+    worker = ElasticWorker(tr, task_reader=_task_batch, root=root)
+    try:
+        with flags_guard(comm_hosts=FLAGS.comm_hosts):
+            worker.setup()
+            tr._maybe_init(load=False)
+            gen = worker.reader()()
+            next(gen)                        # lease batch-0
+            time.sleep(1.2)                  # ... let the lease expire
+            worker.client.counts()           # server-side reclaim sweep
+            # the stale commit must come back False and count nothing
+            assert worker.commit(cost=1.0) is False
+            assert worker.step == 0
+            assert worker.lease_losses == 1
+            # the reclaimed task re-leases and commits exactly once
+            seen = [next(gen), next(gen)]
+            assert worker.commit(cost=1.0) is True
+            assert worker.commit(cost=1.0) is True
+            assert worker.step == 2
+    finally:
+        worker.close()
+        master.close()
+    ev = R.events(kind="elastic_lease_lost")
+    assert ev and ev[-1]["site"] == "trainer.elastic"
+
+
+def test_trainer_elastic_poison_task_follows_failure_contract(
+        tmp_path, monkeypatch):
+    """A task_reader raise fails the lease back to the master (the
+    PR-1 poison-task contract): the task re-leases and, within
+    failure_max, still lands exactly once."""
+    R.clear_events()
+    master = _mk_master(3)
+    root = str(tmp_path / "ckpt")
+    _lease_env(monkeypatch, master, tmp_path)
+    tr = _worker_trainer()
+    poisoned = {"left": 1}
+
+    def flaky_reader(payload):
+        if payload == b"batch-1" and poisoned["left"]:
+            poisoned["left"] -= 1
+            raise RuntimeError("seeded poison read")
+        return _task_batch(payload)
+
+    commits = []
+    try:
+        with flags_guard(comm_hosts=FLAGS.comm_hosts):
+            tr.train(elastic=True, task_reader=flaky_reader,
+                     elastic_root=root,
+                     on_commit=lambda s, t, p, c: commits.append(
+                         p.decode()))
+    finally:
+        master.close()
+    assert sorted(commits) == ["batch-0", "batch-1", "batch-2"]
+    ev = R.events(kind="elastic_task_read_failed")
+    assert len(ev) == 1 and not ev[0]["dropped"]
+    assert tr.exe.stats["elastic_task_failures"] == 1
+
+
+def test_trainer_elastic_pipeline_feed_fault_degrades_exactly_once(
+        tmp_path, monkeypatch):
+    """PR-3 contract inside the elastic pass: an armed
+    pipeline.feed_next raise flips the pipeline to synchronous feeding,
+    RETRYING the failed batch — and the lease accounting still commits
+    every task exactly once."""
+    master = _mk_master(4)
+    root = str(tmp_path / "ckpt")
+    _lease_env(monkeypatch, master, tmp_path)
+    tr = _worker_trainer()
+    commits = []
+    R.arm("pipeline.feed_next", "raise", nth=2, times=1)
+    try:
+        with flags_guard(comm_hosts=FLAGS.comm_hosts):
+            tr.train(elastic=True, task_reader=_task_batch,
+                     elastic_root=root, pipeline=True, pipeline_depth=2,
+                     on_commit=lambda s, t, p, c: commits.append(
+                         p.decode()))
+    finally:
+        R.disarm("pipeline.feed_next")
+        master.close()
+    assert sorted(commits) == ["batch-%d" % i for i in range(4)]
+    assert R.events(kind="pipeline_degraded")
+    assert tr.exe.stats["elastic_lease_losses"] == 0
+
+
+def test_trainer_elastic_reader_next_fault_retries_exactly_once(
+        tmp_path, monkeypatch):
+    """PR-1 contract inside the elastic pass: task payloads are
+    recordio paths, an armed reader.next raise poisons one read —
+    the worker fails the lease, the master re-queues it, and the retry
+    (fault window passed) commits the task exactly once."""
+    from paddle_tpu import native
+    if not native.available():
+        pytest.skip("no native toolchain")
+    R.clear_events()
+    rng = np.random.RandomState(7)
+    paths = []
+    for i in range(3):
+        p = str(tmp_path / ("task%d.rio" % i))
+        with native.Writer(p) as w:
+            for _ in range(8):
+                w.write(rng.rand(4).astype("float32").tobytes())
+        paths.append(p)
+    from paddle_tpu.elastic.supervisor import TaskMasterHost
+    master = TaskMasterHost([p.encode() for p in paths],
+                            timeout_sec=30.0, failure_max=3)
+    root = str(tmp_path / "ckpt")
+    _lease_env(monkeypatch, master, tmp_path)
+    tr = _worker_trainer()
+
+    def rio_reader(payload):
+        rows = [np.frombuffer(rec, dtype="float32")
+                for rec in native.Reader(payload.decode())]
+        bx = np.stack(rows).astype("float32")
+        by = (bx.sum(axis=1) > 2).astype("int64").reshape(-1, 1)
+        return list(zip(bx, by))
+
+    commits = []
+    R.arm("reader.next", "raise", nth=4, times=1)
+    try:
+        with flags_guard(comm_hosts=FLAGS.comm_hosts):
+            tr.train(elastic=True, task_reader=rio_reader,
+                     elastic_root=root,
+                     on_commit=lambda s, t, p, c: commits.append(
+                         os.path.basename(p.decode())))
+    finally:
+        R.disarm("reader.next")
+        master.close()
+    assert sorted(commits) == ["task0.rio", "task1.rio", "task2.rio"]
+    assert len(R.events(kind="elastic_task_read_failed")) == 1
+
+
+def test_train_elastic_argument_validation(tmp_path, monkeypatch):
+    _lease_env(monkeypatch, None, tmp_path)
+    tr = _worker_trainer()
+    # task_reader without a master address is a readable error
+    with pytest.raises(ValueError, match="task master"):
+        tr.train(elastic=True, task_reader=_task_batch,
+                 elastic_root=str(tmp_path / "r"))
+    # both reader shapes at once is a readable error
+    master = _mk_master(1)
+    monkeypatch.setenv("PADDLE_TPU_MASTER_ADDR", master.addr)
+    try:
+        with pytest.raises(ValueError, match="not both"):
+            tr.train(lambda: iter(()), elastic=True,
+                     task_reader=_task_batch)
+    finally:
+        master.close()
+    # no reader at all is a readable error
+    with pytest.raises(ValueError, match="needs a reader"):
+        tr.train()
+
+
+def test_trainer_elastic_guardrail_skip_commits_but_does_not_pair(
+        tmp_path, monkeypatch):
+    """A guardrail-skipped batch consumes its lease (the task is done —
+    its CONTRIBUTION is what the policy discarded) but neither advances
+    the audited step nor pairs a checkpoint of the poisoned model."""
+    R.clear_events()
+    master = _mk_master(6)
+    root = str(tmp_path / "ckpt")
+    _lease_env(monkeypatch, master, tmp_path)
+    tr = _worker_trainer()
+    skips, commits = [], []
+
+    def nan_at_2(payload):
+        return _task_batch(payload, nan=payload == b"batch-2")
+
+    try:
+        with flags_guard(comm_hosts=FLAGS.comm_hosts,
+                         loss_skip_budget=2):
+            tr.train(elastic=True, task_reader=nan_at_2,
+                     elastic_root=root,
+                     on_commit=lambda s, t, p, c: commits.append(
+                         (s, p.decode())),
+                     on_skip=lambda t, p: skips.append(p.decode()))
+    finally:
+        master.close()
+    skipped = set(skips)
+    assert "batch-2" in skipped            # the seeded batch
+    committed = [p for _, p in commits]
+    assert sorted(committed + skips) == \
+        ["batch-%d" % i for i in range(6)]
+    # steps stay contiguous over the GOOD batches only
+    assert [s for s, _ in commits] == list(range(1, len(commits) + 1))
+    assert len(R.events(kind="guard_rewind")) == 1
+
+
+def test_worker_rewind_rolls_the_step_back_with_the_model(
+        tmp_path, monkeypatch):
+    """At ckpt_period > 1 the newest pair can be OLDER than the last
+    good commit: the rewind must roll the step counter back with the
+    model, or later pairs would be labelled with erased training."""
+    from paddle_tpu.elastic.worker import ElasticWorker
+    master = _mk_master(4)
+    root = str(tmp_path / "ckpt")
+    _lease_env(monkeypatch, master, tmp_path)
+    tr = _worker_trainer()
+    worker = ElasticWorker(tr, task_reader=_task_batch, root=root,
+                           ckpt_period=2)
+    try:
+        with flags_guard(comm_hosts=FLAGS.comm_hosts):
+            worker.setup()
+            tr._maybe_init(load=False)
+            gen = worker.reader()()
+            for _ in range(3):
+                next(gen)
+                assert worker.commit(cost=1.0)
+            assert worker.step == 3            # pair landed at step 2
+            assert worker._last_pair_step == 2
+            assert worker.rewind() is True
+            assert worker.step == 2            # counter follows the model
+            assert worker._last_pair_step == 2
+    finally:
+        worker.close()
+        master.close()
+
+
+def test_train_elastic_setup_failure_closes_the_master_client(
+        tmp_path, monkeypatch):
+    """A raise between worker.setup() (which REGISTERS a heartbeating
+    worker) and the training loop's own finally must not leak the
+    registered client until process exit."""
+    master = _mk_master(2)
+    _lease_env(monkeypatch, master, tmp_path)
+    tr = _worker_trainer()
+
+    def boom(worker):
+        raise RuntimeError("seeded on_resume failure")
+
+    try:
+        with flags_guard(comm_hosts=FLAGS.comm_hosts):
+            with pytest.raises(RuntimeError, match="seeded on_resume"):
+                tr.train(elastic=True, task_reader=_task_batch,
+                         elastic_root=str(tmp_path / "ckpt"),
+                         on_resume=boom)
+        assert tr._elastic_worker.client is None   # close() ran
+    finally:
+        master.close()
+
+
+def test_lease_wait_tick_never_masks_an_owed_step(tmp_path, monkeypatch):
+    """The feed thread's idle tick extends a live deadline ONLY while
+    no lease is outstanding: an uncommitted lease means the main thread
+    owes a step — if that step is the wedged one, polling for the NEXT
+    lease must not keep re-arming the deadline over it."""
+    from paddle_tpu.elastic.worker import ElasticWorker
+    from paddle_tpu.resilience.watchdog import StepWatchdog
+    monkeypatch.setenv("PADDLE_TPU_NUM_PROCESSES", "1")
+    monkeypatch.setenv("PADDLE_TPU_PROCESS_ID", "0")
+    tr = _worker_trainer()
+    worker = ElasticWorker(tr, task_reader=_task_batch,
+                           root=None, env={"PADDLE_TPU_NUM_PROCESSES": "1",
+                                           "PADDLE_TPU_PROCESS_ID": "0",
+                                           "PADDLE_TPU_MASTER_ADDR": "x:1"})
+    fired = []
+    wd = StepWatchdog(10.0, on_hang=fired.append, poll_s=0.02)
+    try:
+        worker.watchdog = wd
+        wd.arm("step")
+        d0 = wd._deadline
+        time.sleep(0.05)
+        worker._leases.append(("t1", b"batch-0"))   # an owed step
+        assert worker._lease_wait_tick() is False
+        assert wd._deadline == d0                   # NOT re-armed
+        worker._leases.clear()                      # idle: no step owed
+        assert worker._lease_wait_tick() is False
+        assert wd._deadline > d0                    # re-armed
+    finally:
+        wd.close()
+
+
+def test_disowned_batch_excluded_from_pass_metrics(tmp_path, monkeypatch):
+    """A batch whose lease lapsed (commit -> False) already ran, but the
+    audited timeline disowns it: EndPass avg_cost must agree with the
+    lease accounting, not with raw batch count."""
+    from paddle_tpu.elastic.worker import ElasticWorker
+    # short lease TTL: the simulated lapse leaves the task pending until
+    # the master reclaims it, and the pass can only end after the retry
+    master = _mk_master(3, timeout_sec=1.0)
+    root = str(tmp_path / "ckpt")
+    _lease_env(monkeypatch, master, tmp_path, timeout="1.0")
+    tr = _worker_trainer()
+    real_commit = ElasticWorker.commit
+    calls = {"n": 0}
+
+    def lapse_second(self, cost=None, skipped=False):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            # simulate the lapsed lease: pop the ledger head without
+            # committing — the master re-leases the task later
+            self._leases.popleft()
+            self.lease_losses += 1
+            return False
+        return real_commit(self, cost=cost, skipped=skipped)
+
+    monkeypatch.setattr(ElasticWorker, "commit", lapse_second)
+    committed, end_iters, end_pass = [], [], []
+
+    def handler(e):
+        name = type(e).__name__
+        if name == "EndIteration":
+            end_iters.append(e.batch_id)
+        elif name == "EndPass":
+            end_pass.append(e.metrics["avg_cost"])
+
+    try:
+        with flags_guard(comm_hosts=FLAGS.comm_hosts):
+            tr.train(elastic=True, task_reader=_task_batch,
+                     elastic_root=root, event_handler=handler,
+                     on_commit=lambda s, t, p, c: committed.append(
+                         float(c)))
+    finally:
+        master.close()
+    assert len(committed) == 3                 # every task exactly once
+    assert len(end_iters) == 4                 # one disowned re-run
+    assert end_pass and end_pass[0] == pytest.approx(
+        float(np.mean(committed)))             # metrics == accounting

@@ -20,6 +20,22 @@ hold on a real multi-process chaos run —
     to the flat factorisation (recorded) and the job still completes
     with every task processed.
 
+The REAL-TRAINER legs (``mode="trainer"``: every rank runs
+``Trainer.train(elastic=True)`` with ``pipeline=True`` under
+``comm_overlap`` — the PR-8 protocol spoken by the actual loop):
+
+(g) **trainer chaos**: rank 0 (the lease owner) SIGKILLed mid-pass —
+    resize 4 -> 3, every task exactly once, probe-loss continuity at
+    the paired resume;
+(h) **numeric guardrail**: a seeded non-finite batch is SKIPPED
+    (recorded ``batch_skipped``), the poisoned window rewinds to the
+    last paired checkpoint (bounded), and the pass completes with a
+    decreasing probe;
+(i) **step watchdog**: a seeded hung read trips ``step_timeout_s`` —
+    recorded ``step_hung``, exit 75, exactly one TRANSIENT supervisor
+    restart at full world (never a resize, never a wedged gang), every
+    task still exactly once.
+
 The measurement lives in benchmark/chaos_run.py — the same harness an
 operator points at a real TPU pod (cluster/README.md). Companion to
 tools/{lint,perf_smoke,serve_smoke,comm_smoke,tune_smoke}.sh. Exit 0
@@ -92,6 +108,55 @@ def main():
     for p in cr.check_exactly_once(flt):
         failures.append("fault leg exactly_once: %s" % p)
 
+    # (g): the REAL Trainer as elastic worker — every rank runs
+    # Trainer.train(elastic=True, pipeline=True) under comm_overlap;
+    # the lease-owning rank is SIGKILLed mid-pass
+    tleg = cr.run_chaos(
+        tempfile.mkdtemp(prefix="elastic_smoke_trainer_"),
+        nprocs=4, tasks=10, kill_rank=0, kill_after=2, elastic=True,
+        mode="trainer", flags={"comm_overlap": 1}, timeout=600)
+    if tleg["rc"] != 0:
+        failures.append("trainer leg exit code %d" % tleg["rc"])
+    if tleg["killed"] is None:
+        failures.append("trainer leg never fired its kill")
+    tresizes = [e for e in tleg["events"]
+                if e["kind"] == "elastic_resize"]
+    if len(tresizes) != 1 or tresizes[0]["from_world"] != 4 \
+            or tresizes[0]["to_world"] != 3:
+        failures.append("trainer leg resize was %r, want exactly one "
+                        "4 -> 3" % (tresizes,))
+    for name, probs in (
+            ("exactly_once", cr.check_exactly_once(tleg)),
+            ("continuity", cr.check_continuity(tleg)),
+            ("replan", cr.check_replan(tleg))):
+        for p in probs:
+            failures.append("trainer %s: %s" % (name, p))
+
+    # (h): seeded non-finite batch -> guardrail skip + bounded rewind
+    nan = cr.run_chaos(
+        tempfile.mkdtemp(prefix="elastic_smoke_nan_"),
+        nprocs=2, tasks=8, kill_rank=None, elastic=True,
+        mode="trainer",
+        flags={"comm_overlap": 1, "loss_skip_budget": 2},
+        extra_env={"CHAOS_NAN_TASK": "3"}, timeout=420)
+    if nan["rc"] != 0:
+        failures.append("nan leg exit code %d" % nan["rc"])
+    for p in cr.check_guardrail(nan, 3):
+        failures.append("nan leg: %s" % p)
+
+    # (i): seeded hung read -> watchdog -> transient restart, no wedge
+    hang = cr.run_chaos(
+        tempfile.mkdtemp(prefix="elastic_smoke_hang_"),
+        nprocs=2, tasks=6, kill_rank=None, elastic=True,
+        mode="trainer",
+        flags={"comm_overlap": 1, "step_timeout_s": 5},
+        extra_env={"CHAOS_HANG_TASK": "2"}, timeout=480,
+        restart_budget=1)
+    if hang["rc"] != 0:
+        failures.append("hang leg exit code %d" % hang["rc"])
+    for p in cr.check_watchdog(hang):
+        failures.append("hang leg: %s" % p)
+
     eff = cr.effective_timeline(chaos["rows"])
     summary = {
         "ok": not failures,
@@ -108,6 +173,16 @@ def main():
         "parity_rows": len([r for r in par_e["rows"]
                             if r["kind"] == "task"]),
         "fault_plan_degraded": bool(plan0.get("degraded")),
+        "trainer_rc": tleg["rc"],
+        "trainer_resize": ({"from": tresizes[0]["from_world"],
+                            "to": tresizes[0]["to_world"]}
+                           if tresizes else None),
+        "nan_skips": len([r for r in nan["rows"]
+                          if r["kind"] == "skip"]),
+        "nan_rewinds": len([e for e in nan["events"]
+                            if e["kind"] == "guard_rewind"]),
+        "hang_restarts": len([e for e in hang["events"]
+                              if e["kind"] == "elastic_restart"]),
         "state_dir": chaos_state,
     }
     print(json.dumps(summary))
