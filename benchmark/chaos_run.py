@@ -42,7 +42,11 @@ Two worker shapes share the harness (``PADDLE_TPU_CHAOS_MODE``):
   doc/elasticity.md). Seeding knobs for the failure-policy legs:
   ``CHAOS_NAN_TASK=<i>`` poisons task i's batch with a NaN (the
   numeric guardrail's quarry), ``CHAOS_HANG_TASK=<i>`` wedges task
-  i's read once, marker-guarded (the step watchdog's quarry).
+  i's read once, marker-guarded (the step watchdog's quarry),
+  ``CHAOS_SLOW_RANK=<r>`` (+ ``CHAOS_SLOW_DELAY``/``CHAOS_SLOW_GENS``)
+  delay-arms rank r's every ``trainer.step`` for the first N
+  generations — the gray-failure detector's quarry: alive and
+  heartbeating, just consistently slower than its peers.
 
 Worker mode (spawned by the launcher):
     python benchmark/chaos_run.py worker
@@ -300,6 +304,23 @@ def trainer_worker_main(world_size, rank):
     os.makedirs(root, exist_ok=True)
     log = os.path.join(state_dir, "losses-rank0.jsonl")
 
+    # gray-failure lever: ONE rank runs every step through an armed
+    # trainer.step delay for the first CHAOS_SLOW_GENS generations —
+    # alive, answering, heartbeating, just consistently slow (the
+    # failure binary health cannot see). Generation-gated so the story
+    # completes: gen 0 slow -> condemned -> transient restart; gen 1
+    # still slow -> budget spent -> demoted to a resize; the resized
+    # gang runs clean and step time recovers. Armed in-process because
+    # the launcher's env is rank-uniform — only the rank itself knows
+    # whether it is the slow one.
+    slow_rank = int(os.environ.get("CHAOS_SLOW_RANK", "-1"))
+    slow_gens = int(os.environ.get("CHAOS_SLOW_GENS", "2"))
+    if rank == slow_rank and gen < slow_gens:
+        from paddle_tpu import resilience
+        resilience.arm("trainer.step", "delay", nth=1, times=None,
+                       delay=float(os.environ.get("CHAOS_SLOW_DELAY",
+                                                  "1.0")))
+
     trainer, loss = _build_chaos_trainer()
     eval_prog = trainer.main_program.prune(feeds=["x", "y"],
                                            fetches=(loss.name,))
@@ -429,14 +450,17 @@ def run_chaos(state_dir, nprocs=4, tasks=12, kill_rank=0, kill_after=3,
               elastic=True, policy="hierarchical", fault_spec=None,
               min_workers=2, grace_sec=15.0, timeout=900.0,
               mode="executor", flags=None, extra_env=None,
-              restart_budget=1):
+              restart_budget=1, gray_ratio=None, gray_budget=None):
     """Run one chaos scenario; returns the report dict the checkers
     consume. ``kill_rank=None`` runs failure-free (the parity leg);
     ``elastic=False`` runs the same script under the fail-fast
     launcher (the bit-parity reference); ``mode="trainer"`` runs every
     rank through ``Trainer.train(elastic=True)`` (``flags`` adds
     PADDLE_TPU_FLAGS entries — comm_overlap, step_timeout_s,
-    loss_skip_budget — and ``extra_env`` the seeding knobs)."""
+    loss_skip_budget — and ``extra_env`` the seeding knobs, including
+    CHAOS_SLOW_RANK/_DELAY/_GENS for the gray-failure leg).
+    ``gray_ratio``/``gray_budget`` arm the supervisor's gray-failure
+    sweep over the workers' step-time heartbeats."""
     from paddle_tpu.launch import launch, launch_elastic
 
     os.makedirs(state_dir, exist_ok=True)
@@ -454,7 +478,8 @@ def run_chaos(state_dir, nprocs=4, tasks=12, kill_rank=0, kill_after=3,
                     grace_sec=grace_sec, min_workers=min_workers,
                     restart_budget=restart_budget, state_dir=state_dir,
                     master_tasks=payloads, master_timeout_sec=60.0,
-                    snapshot_root=os.path.join(state_dir, "ckpt"))
+                    snapshot_root=os.path.join(state_dir, "ckpt"),
+                    gray_ratio=gray_ratio, gray_budget=gray_budget)
             else:
                 box["rc"] = launch(
                     nprocs, "127.0.0.1:0", argv, env=env,
@@ -492,17 +517,26 @@ def run_chaos(state_dir, nprocs=4, tasks=12, kill_rank=0, kill_after=3,
         raise box["error"]
 
     plans = {}
+    heartbeats = {}
     for fn in sorted(os.listdir(state_dir)):
         m = re.match(r"^plan-gen(\d+)\.json$", fn)
         if m:
             with open(os.path.join(state_dir, fn)) as f:
                 plans[int(m.group(1))] = json.load(f)
+        m = re.match(r"^heartbeat-rank(\d+)\.json$", fn)
+        if m:
+            try:
+                with open(os.path.join(state_dir, fn)) as f:
+                    heartbeats[int(m.group(1))] = json.load(f)
+            except (OSError, ValueError):
+                pass  # torn final write from a stopped worker
     return {
         "rc": box["rc"],
         "killed": killed,
         "rows": _read_jsonl(log),
         "events": _read_jsonl(os.path.join(state_dir, "events.jsonl")),
         "plans": plans,
+        "heartbeats": heartbeats,
         "tasks": tasks,
         "nprocs": nprocs,
     }
@@ -688,6 +722,52 @@ def check_watchdog(report):
     if resizes:
         problems.append("a hang must restart at FULL world, but the "
                         "job resized: %r" % (resizes,))
+    problems.extend(check_exactly_once(report))
+    return problems
+
+
+def check_grayfail(report, slow_rank, delay_s):
+    """Slow-rank leg: the delay-armed rank was condemned by latency
+    skew alone (it never crashed), mitigated on the budget — exactly
+    one transient restart, then the recurrence demoted it to a resize
+    — the pass still completed exactly-once, and the final
+    generation's step time recovered (well under the injected
+    delay)."""
+    problems = []
+    events = report["events"]
+    if not [e for e in events if e["kind"] == "gray_suspected"]:
+        problems.append("no gray_suspected recorded")
+    mit = [e for e in events if e["kind"] == "gray_mitigated"]
+    restarts = [e for e in mit if e.get("action") == "restart"]
+    resizes = [e for e in mit if e.get("action") == "resize"]
+    if len(restarts) != 1:
+        problems.append("expected exactly 1 gray restart, got %d"
+                        % len(restarts))
+    if len(resizes) != 1:
+        problems.append("expected exactly 1 gray resize (budget-spent "
+                        "recurrence), got %d" % len(resizes))
+    for e in restarts + resizes:
+        if e.get("rank") != slow_rank:
+            problems.append("gray mitigation condemned rank %r, the "
+                            "armed slow rank is %d" % (e.get("rank"),
+                                                       slow_rank))
+    # the rank was SLOW, never dead: no worker-exit classification ran
+    if [e for e in events if e["kind"] == "elastic_worker_exit"]:
+        problems.append("an elastic_worker_exit fired — the gray leg "
+                        "must mitigate a LIVE rank")
+    gens = [e["generation"] for e in events
+            if e["kind"] == "elastic_generation"]
+    hb = report.get("heartbeats", {})
+    final = [h for h in hb.values() if h.get("generation") == max(gens)]
+    if not final:
+        problems.append("no final-generation heartbeats to prove "
+                        "recovery")
+    else:
+        worst = max(h["step_ms_ewma"] for h in final)
+        if worst > delay_s * 1e3 / 2.0:
+            problems.append("step time did not recover after the "
+                            "resize: worst EWMA %.0fms vs injected "
+                            "delay %.0fms" % (worst, delay_s * 1e3))
     problems.extend(check_exactly_once(report))
     return problems
 

@@ -49,6 +49,13 @@ warm-up window, the crash-loop circuit breaker opens (recorded
 ``autoscale_breaker_open``), refuses further scale-ups, and the
 original fleet keeps serving — zero lost.
 
+The **gray leg** (:func:`gray_leg`, ``--mode gray``) delay-arms ONE
+replica slow (its ``/healthz`` stays 200): the router's latency
+SkewDetector must eject it mid-flood, tail requests stuck past the
+hedge deadline fire budgeted hedges at the next-best replica, and the
+post-ejection flood's p99 must measurably recover — zero lost through
+the whole episode.
+
 Predict responses are verified against the artifact's known closed form
 (row sums x scale), which also proves WHICH version answered across the
 rolling reload.
@@ -137,10 +144,12 @@ def build_artifacts(root):
 def start_fleet(arts, replicas, name="m", gen_name="g", max_running=4,
                 kv_pages=32, page_tokens=8, queue_depth=128,
                 env_overrides=None, poll_ms=40, ready_timeout=420.0,
-                restart_budget=None, extra_env=None):
+                restart_budget=None, extra_env=None, router_kw=None):
     """Pool + router + front HTTP server, ready to take traffic — the
     ONE fleet bring-up both the chaos and the autoscale legs share.
-    Returns (pool, router, server, base_url)."""
+    ``router_kw`` forwards extra :class:`Router` keywords (the gray leg
+    arms ``gray_ratio``/``hedge_budget`` this way). Returns
+    (pool, router, server, base_url)."""
     from paddle_tpu.serving import (ReplicaPool, Router,
                                     make_router_server)
     serve_args = ["--extra_model", "%s=%s" % (gen_name, arts["gen"]),
@@ -157,7 +166,7 @@ def start_fleet(arts, replicas, name="m", gen_name="g", max_running=4,
                        restart_budget=restart_budget,
                        ready_timeout=ready_timeout)
     pool.start(wait=True)
-    router = Router(pool, poll_ms=poll_ms)
+    router = Router(pool, poll_ms=poll_ms, **(router_kw or {}))
     router.poll_once()
     router.start_polling()
     server = make_router_server(router)
@@ -277,12 +286,15 @@ class FloodRunner(object):
     unexpected status — the thing the gate forbids)."""
 
     def __init__(self, base_url, tasks, threads=8, model="m",
-                 gen_model="g"):
+                 gen_model="g", pace_s=0.0):
         self.base_url = base_url
         self.tasks = tasks
         self.threads = threads
         self.model = model
         self.gen_model = gen_model
+        # per-thread sleep between tasks: the gray leg stretches its
+        # flood so detection (a poll-cadence streak) happens IN flight
+        self.pace_s = pace_s
         self.results = [None] * len(tasks)
         self.done = 0
         self._next = 0
@@ -368,6 +380,8 @@ class FloodRunner(object):
             self.results[i] = res
             with self._lock:
                 self.done += 1
+            if self.pace_s:
+                time.sleep(self.pace_s)
 
     def start(self):
         for _ in range(self.threads):
@@ -695,6 +709,95 @@ def breaker_leg(root, seed=0, flood_predict=16, flood_generate=40,
     return out
 
 
+def gray_leg(root, replicas=3, slow_index=2, slow_delay_s=0.3,
+             phase_predict=300, phase_generate=6, threads=6,
+             pace_s=0.04, seed=0, gray_ratio=3.0, gray_hold_s=600.0,
+             hedge_budget=0.25, hedge_min_ms=40.0, eject_timeout=90.0):
+    """The gray-failure leg: one replica is delay-armed SLOW
+    (``serving.dispatch`` + ``serving.generate`` in ITS env only) while
+    its ``/healthz`` keeps answering 200 — binary health sees nothing.
+    The router's SkewDetector must condemn its proxied-latency EWMA and
+    eject it (``gray_mitigated`` action=eject) mid-flood; idempotent
+    ``:predict`` requests stuck past the p99-derived hedge deadline
+    fire ONE hedged attempt at the next-best replica (first answer
+    wins, budgeted as a traffic fraction, ``:generate`` never hedged).
+    Phase A (slow replica in rotation until ejected) and phase B (after
+    ejection) are measured with the same flood shape: the gate is
+    p99_B < p99_A, zero lost in both, hedges > 0 and under budget, and
+    the condemned replica's direct ``/healthz`` still 200 at the moment
+    of ejection. ``gray_hold_s`` is long so the ejected replica stays
+    out for the whole measurement."""
+    from paddle_tpu import resilience
+
+    arts = build_artifacts(os.path.join(root, "artifacts"))
+    resilience.clear_events()
+    out = {"replicas": replicas, "slow_index": slow_index,
+           "slow_delay_s": slow_delay_s, "gray_ratio": gray_ratio,
+           "hedge_budget": hedge_budget}
+    # the slow replica: every predict batch AND every generate step
+    # stretched — alive, correct, 200-healthy, just consistently late
+    overrides = {slow_index: {
+        "PADDLE_TPU_FAULT_SPEC":
+            "serving.dispatch:delay:nth=*,times=*,delay=%g;"
+            "serving.generate:delay:nth=*,times=*,delay=%g"
+            % (slow_delay_s, slow_delay_s)}}
+    pool, router, server, url = start_fleet(
+        arts, replicas, env_overrides=overrides,
+        router_kw={"gray_ratio": gray_ratio, "gray_hold_s": gray_hold_s,
+                   "hedge_budget": hedge_budget,
+                   "hedge_min_ms": hedge_min_ms})
+    try:
+        # ---- phase A: slow replica in rotation until condemned ------------
+        tasks = make_tasks(phase_predict, phase_generate, seed=seed,
+                           gen_max_new=4)
+        runner = FloodRunner(url, tasks, threads=threads,
+                             pace_s=pace_s).start()
+        out["ejected_in_time"] = _wait_for(
+            lambda: bool(resilience.events(kind="gray_mitigated")),
+            eject_timeout, interval=0.05)
+        # the point of the leg: at the moment the router condemns it,
+        # the replica's own binary health is still a clean 200
+        try:
+            status, _body = _get(
+                pool.snapshot()[slow_index].base_url + "/healthz",
+                timeout=10.0)
+            out["condemned_healthz"] = status
+        except Exception as e:
+            out["condemned_healthz"] = repr(e)
+        runner.wait(timeout=900.0)
+        out["phase_a"] = runner.summary()
+        st = router.stats()
+        out["hedges"] = st.get("hedges", 0)
+        out["hedge_wins"] = st.get("hedge_wins", 0)
+        out["proxied_a"] = st.get("proxied", 0)
+        out["gray_ejects"] = st.get("gray_ejects", 0)
+        out["gray_suspected_events"] = len(
+            resilience.events(kind="gray_suspected"))
+        ejected = [i for i, r in st["replicas"].items()
+                   if r.get("gray_ejected")]
+        out["gray_ejected_replicas"] = ejected
+        out["latency_ewmas_ms"] = {
+            i: r.get("latency_ewma_ms")
+            for i, r in st["replicas"].items()}
+
+        # ---- phase B: the condemned replica out of rotation ---------------
+        probe = FloodRunner(url, make_tasks(phase_predict // 2,
+                                            phase_generate, seed=seed + 1,
+                                            gen_max_new=4),
+                            threads=threads, pace_s=pace_s).start()
+        probe.wait(timeout=900.0)
+        out["phase_b"] = probe.summary()
+        out["p99_a_ms"] = out["phase_a"]["latency_ms_p99"]
+        out["p99_b_ms"] = out["phase_b"]["latency_ms_p99"]
+        out["p99_recovered"] = out["p99_b_ms"] < out["p99_a_ms"]
+        out["lost_total"] = (out["phase_a"]["lost"]
+                             + out["phase_b"]["lost"])
+        out["router_stats"] = router.stats()
+    finally:
+        stop_fleet(pool, router, server)
+    return out
+
+
 if __name__ == "__main__":
     import argparse
     import sys
@@ -703,11 +806,12 @@ if __name__ == "__main__":
     sys.path.insert(0, os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
     ap = argparse.ArgumentParser()
-    ap.add_argument("--mode", choices=["chaos", "diurnal"],
+    ap.add_argument("--mode", choices=["chaos", "diurnal", "gray"],
                     default="chaos",
                     help="chaos = the PR-10 kill/reload/balance run; "
                          "diurnal = the autoscaling flood->idle wave "
-                         "(+ the crash-loop breaker leg)")
+                         "(+ the crash-loop breaker leg); gray = the "
+                         "slow-replica latency-ejection + hedging leg")
     ap.add_argument("--replicas", type=int, default=3,
                     help="chaos mode only (diurnal sizes its fleet "
                          "from the [min,max] autoscale budget)")
@@ -726,6 +830,35 @@ if __name__ == "__main__":
                          "benchmark/results/")
     a = ap.parse_args()
     root = a.root or tempfile.mkdtemp(prefix="paddle_tpu_load_bench_")
+    if a.mode == "gray":
+        summary = gray_leg(os.path.join(root, "gray"),
+                           threads=a.threads)
+        print(json.dumps(summary, indent=1, default=str))
+        if a.bank:
+            from paddle_tpu.tune import results as results_mod
+            row = {
+                "replicas": summary["replicas"],
+                "slow_index": summary["slow_index"],
+                "slow_delay_s": summary["slow_delay_s"],
+                "gray_ratio": summary["gray_ratio"],
+                "hedge_budget": summary["hedge_budget"],
+                "ejected_in_time": summary["ejected_in_time"],
+                "condemned_healthz": summary["condemned_healthz"],
+                "gray_ejects": summary["gray_ejects"],
+                "hedges": summary["hedges"],
+                "hedge_wins": summary["hedge_wins"],
+                "proxied_a": summary["proxied_a"],
+                "p99_a_ms": summary["p99_a_ms"],
+                "p99_b_ms": summary["p99_b_ms"],
+                "p99_recovered": summary["p99_recovered"],
+                "lost_total": summary["lost_total"],
+                "phase_a": summary["phase_a"],
+                "phase_b": summary["phase_b"],
+            }
+            rec = results_mod.bench_record(
+                "load_gray", [row], meta={"threads": a.threads})
+            print("banked:", results_mod.write_result(rec))
+        sys.exit(0)
     if a.mode == "diurnal":
         dkw = {}
         if a.predict:

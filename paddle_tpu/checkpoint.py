@@ -211,6 +211,21 @@ def _mtime_or_none(path):
         return None
 
 
+def _retained_step(path):
+    """The step number parsed from a ``ckpt-<step>`` basename, or -1
+    for anything else. Retention ordering is by THIS first and mtime
+    only as tiebreak: coarse-mtime filesystems (1s granularity) stamp
+    two same-second saves identically, which made "newest" and the
+    corruption-fallback walk ambiguous under pure mtime ordering."""
+    name = os.path.basename(os.path.normpath(path))
+    if name.startswith("ckpt-"):
+        try:
+            return int(name[len("ckpt-"):])
+        except ValueError:
+            pass
+    return -1
+
+
 def _prune(root, keep_last):
     """Drop all but the newest ``keep_last`` COMPLETE checkpoints under
     ``root`` (torn/partial dirs are left for inspection — they are
@@ -226,9 +241,9 @@ def _prune(root, keep_last):
             continue
         mt = _mtime_or_none(d)
         if mt is not None:
-            stamped.append((mt, d))
+            stamped.append((_retained_step(d), mt, d))
     stamped.sort(reverse=True)
-    for _, stale in stamped[keep_last:]:
+    for _, _, stale in stamped[keep_last:]:
         shutil.rmtree(stale, ignore_errors=True)
 
 
@@ -306,9 +321,10 @@ def latest_checkpoint(root):
              if os.path.isdir(os.path.join(root, d))
              and not d.endswith((".tmp", ".old"))]
     # same concurrent-prune tolerance as _prune: stat can lose the race
-    stamped = [(_mtime_or_none(d), d) for d in cands if _is_complete(d)]
-    stamped = [(mt, d) for mt, d in stamped if mt is not None]
-    return max(stamped)[1] if stamped else None
+    stamped = [(_retained_step(d), _mtime_or_none(d), d)
+               for d in cands if _is_complete(d)]
+    stamped = [(st, mt, d) for st, mt, d in stamped if mt is not None]
+    return max(stamped)[2] if stamped else None
 
 
 def _read_shard(dirname, sh, verify):
@@ -339,13 +355,16 @@ _RETAIN_RE = re.compile(r"^ckpt-\d{8}$")
 def _previous_complete(dirname):
     """The newest COMPLETE retention sibling strictly older than
     ``dirname`` — the fallback target when ``dirname`` turns out
-    corrupt. Ordered by (mtime, name) so retention names break mtime
-    ties. None unless ``dirname`` itself is a retention entry."""
+    corrupt. Ordered by (step, mtime, name): the step number parsed
+    from the ``ckpt-<step>`` name is authoritative, mtime only a
+    tiebreak — two same-second saves on a coarse-mtime filesystem
+    must still walk back in step order. None unless ``dirname``
+    itself is a retention entry."""
     me = os.path.abspath(dirname)
     if not _RETAIN_RE.match(os.path.basename(me)):
         return None
     root = os.path.dirname(me)
-    mine = (os.path.getmtime(me), me)
+    mine = (_retained_step(me), os.path.getmtime(me), me)
     cands = []
     for d in os.listdir(root):
         p = os.path.abspath(os.path.join(root, d))
@@ -354,7 +373,7 @@ def _previous_complete(dirname):
             continue
         if not _is_complete(p):
             continue
-        key = (os.path.getmtime(p), p)
+        key = (_retained_step(p), os.path.getmtime(p), p)
         if key < mine:
             cands.append((key, p))
     return max(cands)[1] if cands else None

@@ -395,6 +395,13 @@ def cmd_route(args):
     from paddle_tpu.serving import (Autoscaler, ReplicaPool, Router,
                                     httpd, make_router_server)
 
+    if args.state_dir:
+        # the audit trail: every record_durable_event() in this process
+        # (router ejections/failovers, autoscale decisions, breaker
+        # transitions, gray verdicts) defaults its events.jsonl here,
+        # so the evidence survives a router crash
+        os.makedirs(args.state_dir, exist_ok=True)
+        os.environ["PADDLE_TPU_ELASTIC_STATE"] = args.state_dir
     try:
         extra_models = _parse_extra_models(args.extra_model,
                                            primary=args.name)
@@ -458,7 +465,8 @@ def cmd_route(args):
         # already bound) must still drain the fleet pool.start spawned
         # — no orphan serve workers on an exception
         router = Router(pool, policy=args.policy,
-                        poll_ms=args.poll_ms if args.poll_ms > 0 else None)
+                        poll_ms=args.poll_ms if args.poll_ms > 0 else None,
+                        state_dir=args.state_dir or None)
         router.poll_once()
         router.start_polling()
         if args.autoscale:
@@ -967,6 +975,13 @@ def main(argv=None):
                     metavar="NAME=DIR",
                     help="additional artifact(s) every replica publishes "
                          "(repeatable)")
+    rt.add_argument("--state-dir", "--state_dir", default=None,
+                    dest="state_dir",
+                    help="durable event directory (events.jsonl): "
+                         "ejections, failovers, breaker transitions, "
+                         "autoscale decisions and gray-failure verdicts "
+                         "survive a router crash — the serving twin of "
+                         "launch --state-dir")
     rt.set_defaults(fn=cmd_route)
 
     acc = sub.add_parser(

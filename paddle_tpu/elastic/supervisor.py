@@ -18,6 +18,16 @@ verdict —
   relaunch the survivors — the job only dies when the quorum
   (``min_workers``) is gone.
 
+- **gray** (``FLAGS.gray_step_ratio`` > 0: alive but consistently
+  slow): the health sweep feeds each rank's published step-time EWMA
+  (``heartbeat-rank<r>.json``) into the shared
+  :mod:`paddle_tpu.resilience.grayfail` skew detector; a condemned
+  rank is mitigated on a job-scoped budget — first
+  ``gray_mitigation_budget`` transient full-world restarts, then a
+  demotion to permanent (the resize path above), never below the
+  quorum. ``gray_suspected``/``gray_mitigated`` land in the durable
+  event trail.
+
 Worker LIVENESS decisions ride process exit (event-driven ``wait``, no
 busy-polling); the task-master worker registry's heartbeats
 (``v2.master.client(worker_name=...)``) inform the health sweep but
@@ -47,6 +57,8 @@ import time
 
 from ..resilience import RetryPolicy, record_event
 from ..resilience.faults import fault_point
+from ..resilience.grayfail import (SkewDetector, SUSPECT as _GRAY_SUSPECT,
+                                   CONDEMNED as _GRAY_CONDEMNED)
 from ..resilience.supervise import SlotSupervision, escalate_stop
 
 __all__ = ["ElasticSupervisor", "TaskMasterHost", "Gang", "free_port"]
@@ -168,7 +180,7 @@ class ElasticSupervisor(object):
                  restart_budget=None, grace_sec=10.0, env=None, python=None,
                  state_dir=None, master_tasks=None, master_timeout_sec=60.0,
                  master_failure_max=3, snapshot_root=None,
-                 sweep_interval=None):
+                 sweep_interval=None, gray_ratio=None, gray_budget=None):
         from ..flags import FLAGS
         if nprocs < 1:
             raise ValueError("nprocs must be >= 1, got %d" % nprocs)
@@ -196,6 +208,13 @@ class ElasticSupervisor(object):
                                if sweep_interval is not None
                                else min(1.0, self.master_timeout_sec / 4.0))
         self._failed_seen = 0
+        # gray-failure detection (resilience.grayfail): judge per-rank
+        # step wall time from the workers' heartbeat files; 0 = off
+        self.gray_ratio = float(gray_ratio if gray_ratio is not None
+                                else FLAGS.gray_step_ratio)
+        self.gray_budget = int(gray_budget if gray_budget is not None
+                               else FLAGS.gray_mitigation_budget)
+        self._gray_restarts_used = 0   # persists ACROSS generations
 
     # -- audit trail --------------------------------------------------------
     def _event(self, kind, **info):
@@ -269,6 +288,50 @@ class ElasticSupervisor(object):
                         failed_total=c["failed"])
             self._failed_seen = c["failed"]
 
+    def _gray_sweep(self, gray, generation, world, done):
+        """One gray-failure evaluation pass: read the CURRENT
+        generation's per-rank heartbeats (``heartbeat-rank<r>.json``,
+        written by the elastic worker every iteration), feed each
+        live rank's step-time EWMA into the shared skew detector, and
+        return the first NEWLY-condemned rank (None otherwise). The
+        JUDGEMENT — median+MAD baseline, breach streaks, hysteresis —
+        is resilience.grayfail's; only the mitigation policy lives in
+        :meth:`run`. ``gray_suspected`` is recorded exactly once per
+        escalation (the verdict's ``changed`` edge)."""
+        from .. import profiler as _prof
+        if gray is None or not self.state_dir:
+            return None
+        for rank in range(world):
+            if rank in done:       # exited 0: its heartbeat is history
+                continue
+            path = os.path.join(self.state_dir,
+                                "heartbeat-rank%d.json" % rank)
+            try:
+                with open(path) as f:
+                    hb = json.load(f)
+            except (OSError, ValueError):
+                continue           # not written yet / mid-replace
+            if hb.get("generation") != generation:
+                continue           # stale: a previous generation's file
+            ewma = hb.get("step_ms_ewma")
+            if ewma is None:
+                continue
+            gray.observe(rank, float(ewma))
+        condemned = None
+        for rank, v in sorted(gray.evaluate().items()):
+            if not v.changed:
+                continue
+            info = dict(rank=rank, generation=generation,
+                        metric="step_ms_ewma", stat=round(v.stat, 3),
+                        baseline=round(v.baseline, 3),
+                        threshold=round(v.threshold, 3), streak=v.streak)
+            if v.state == _GRAY_SUSPECT:
+                self._event("gray_suspected", **info)
+                _prof.update_grayfail_counters(gray_suspected=1)
+            elif v.state == _GRAY_CONDEMNED and condemned is None:
+                condemned = rank
+        return condemned
+
     def _restore_master(self, master):
         """Re-align the task queue with the checkpoint the relaunched
         workers will resume from: restore from the snapshot PAIRED
@@ -310,6 +373,16 @@ class ElasticSupervisor(object):
                         os.unlink(os.path.join(fdir, fn))
                     except OSError:
                         pass  # a racing writer: its fresh record stands
+            # same staleness hazard for the gray-failure heartbeats: a
+            # PREVIOUS run's generation-0 files would be judged as THIS
+            # run's generation 0
+            if os.path.isdir(self.state_dir):
+                for fn in os.listdir(self.state_dir):
+                    if fn.startswith("heartbeat-rank"):
+                        try:
+                            os.unlink(os.path.join(self.state_dir, fn))
+                        except OSError:
+                            pass
         master = None
         if self.master_tasks is not None:
             master = TaskMasterHost(self.master_tasks,
@@ -339,21 +412,96 @@ class ElasticSupervisor(object):
                                       coordinator, master)
                 self._event("elastic_generation", generation=generation,
                             world=world, coordinator=coordinator)
-                done, failed = set(), None
-                while len(done) < world and failed is None:
+                # a FRESH detector per generation: a relaunched gang's
+                # ranks share no history with the one that was judged
+                # (the mitigation BUDGET, by contrast, is job-scoped —
+                # self._gray_restarts_used survives this line)
+                gray = (SkewDetector(ratio=self.gray_ratio)
+                        if self.gray_ratio > 0 else None)
+                done, failed, condemned = set(), None, None
+                while len(done) < world and failed is None \
+                        and condemned is None:
                     item = gang.next_exit(timeout=self.sweep_interval)
                     if item is None:
                         self._sweep(master)
+                        slow = self._gray_sweep(gray, generation,
+                                                world, done)
+                        if slow is not None and \
+                                self._gray_restarts_used \
+                                >= self.gray_budget and \
+                                world - 1 < self.min_workers:
+                            # quorum guard: can neither restart (budget
+                            # spent) nor shrink — a slow gang beats no
+                            # gang. The detector's changed-edge keeps
+                            # this from re-firing every sweep.
+                            self._event("gray_mitigation_skipped",
+                                        rank=slow, generation=generation,
+                                        reason="quorum",
+                                        min_workers=self.min_workers,
+                                        world=world)
+                            slow = None
+                        condemned = slow
                         continue
                     rank, rc = item
                     if rc == 0:
                         done.add(rank)
                     else:
                         failed = (rank, rc)
-                if failed is None:
+                if failed is None and condemned is None:
                     self._event("elastic_job_complete",
                                 generation=generation, world=world)
                     return 0
+                if condemned is not None:
+                    # gray mitigation: the rank is ALIVE but judged
+                    # consistently slower than its peers. Budgeted
+                    # escalation — first a transient full-world restart
+                    # (a flaky node often recovers relaunched); once
+                    # the budget is spent, demote to permanent and
+                    # resize through the SAME machinery a signal death
+                    # uses. One mitigation in flight by construction:
+                    # this loop is the only actor and it relaunches
+                    # before sweeping again (quorum was already held
+                    # in the sweep branch above).
+                    gang.stop(self.grace_sec)
+                    if self._gray_restarts_used < self.gray_budget:
+                        self._gray_restarts_used += 1
+                        self._event("gray_mitigated", action="restart",
+                                    rank=condemned, generation=generation,
+                                    restarts_used=self._gray_restarts_used,
+                                    budget=self.gray_budget)
+                        _prof.update_grayfail_counters(
+                            gray_mitigated_restarts=1)
+                        _prof.update_elastic_counters(elastic_restarts=1)
+                        self._restore_master(master)
+                        generation += 1
+                        continue
+                    new_world = world - 1
+                    requeued = 0
+                    if master is not None:
+                        try:
+                            requeued = master.counts()["pending"]
+                        except Exception:
+                            requeued = 0
+                    n = self._restore_master(master)
+                    if n is not None:
+                        requeued = n
+                    self._event("gray_mitigated", action="resize",
+                                rank=condemned, generation=generation,
+                                from_world=world, to_world=new_world,
+                                restarts_used=self._gray_restarts_used,
+                                budget=self.gray_budget)
+                    self._event("elastic_resize", generation=generation,
+                                from_world=world, to_world=new_world,
+                                lost_rank=condemned, rc=None,
+                                requeued_tasks=requeued, gray=True)
+                    _prof.update_grayfail_counters(
+                        gray_mitigated_resizes=1)
+                    _prof.update_elastic_counters(
+                        elastic_resizes=1, elastic_lost_ranks=1,
+                        elastic_requeued_tasks=requeued)
+                    world = new_world
+                    generation += 1
+                    continue
                 rank, rc = failed
                 # the dead worker's leased tasks: what a resize re-queues
                 pending = 0

@@ -115,6 +115,11 @@ class ElasticWorker(object):
         self.commits = 0
         self.lease_losses = 0
         self.task_failures = 0
+        # per-step wall-time record for the supervisor's gray-failure
+        # sweep (resilience.grayfail): EWMA + a short window, published
+        # per iteration into <state>/heartbeat-rank<r>.json
+        self._hb_window = collections.deque(maxlen=8)
+        self._hb_ewma = None
 
     # -- generation setup ----------------------------------------------------
     def setup(self):
@@ -329,6 +334,49 @@ class ElasticWorker(object):
                     to_step=rp.step, dropped=before - rp.step,
                     rank=self.rank, generation=self.generation)
         return True
+
+    def publish_heartbeat(self, step_ms, feed_wait_ms=None):
+        """Publish this rank's per-step wall time into the elastic
+        state dir (``heartbeat-rank<r>.json``, atomic replace) — the
+        metric the supervisor's gray-failure sweep judges against the
+        peer ranks. ``step_ms`` is the iteration wall delta (dispatch
+        + reader wait + any injected delay — an async pipeline makes a
+        device-timer-only number blind to exactly the stalls gray
+        detection exists for) with the commit/checkpoint span excluded
+        by the caller (legitimate per-role overhead: only the lease
+        owner pays it, and it must not make that rank a false
+        outlier); ``feed_wait_ms`` rides along for the audit trail.
+        No state dir -> no-op (a non-elastic run has no supervisor to
+        read it)."""
+        if not self.state_dir:
+            return None
+        step_ms = float(step_ms)
+        self._hb_window.append(step_ms)
+        alpha = 0.3
+        self._hb_ewma = (step_ms if self._hb_ewma is None
+                         else alpha * step_ms
+                         + (1.0 - alpha) * self._hb_ewma)
+        payload = {
+            "rank": self.rank,
+            "generation": self.generation,
+            "step": self.step,
+            "step_ms": round(step_ms, 3),
+            "step_ms_ewma": round(self._hb_ewma, 3),
+            "step_ms_window": [round(v, 3) for v in self._hb_window],
+            "feed_wait_ms": (round(float(feed_wait_ms), 3)
+                             if feed_wait_ms is not None else None),
+            "time": time.time(),
+        }
+        path = os.path.join(self.state_dir,
+                            "heartbeat-rank%d.json" % self.rank)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+        except OSError:
+            return None   # observability only — never fail the step
+        return path
 
     def close(self):
         if self.client is not None:

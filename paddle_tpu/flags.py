@@ -550,3 +550,75 @@ DEFINE_float("route_cooldown_s", 30.0,
              "scale-down additionally waits it out since the last "
              "scale-up). Cooldowns are the second flap guard after "
              "the threshold hysteresis")
+DEFINE_float("gray_step_ratio", 0.0,
+             "gray-failure detection for the elastic TRAINING gang "
+             "(paddle_tpu.resilience.grayfail consumed by the elastic "
+             "supervisor): a rank whose per-step wall time — published "
+             "in its heartbeat-rank<N>.json under --state-dir — stays "
+             "above ratio x the cross-rank median (median+MAD robust "
+             "baseline, consecutive sweeps, hysteresis) is condemned "
+             "as a GRAY failure: alive and heartbeating but "
+             "consistently slower than its peers, dragging every "
+             "collective to its pace. 0.0 (default) = detection off; "
+             "enable with a ratio comfortably above legitimate skew "
+             "(3.0 is the chaos-gate setting). Mitigation is budgeted "
+             "by gray_mitigation_budget and recorded as durable "
+             "gray_suspected / gray_mitigated events; it never drops "
+             "the gang below --min-workers and runs at most one "
+             "mitigation per generation. CPU caveat: the CI legs "
+             "inject slowness via delay faults on trainer.step — real "
+             "cross-host skew (thermal throttle, a bad NIC) needs the "
+             "pod trip")
+DEFINE_int32("gray_mitigation_budget", 1,
+             "gray-failure mitigation budget for the elastic "
+             "supervisor: how many condemned-rank mitigations are "
+             "spent as TRANSIENT restarts (full-world relaunch from "
+             "the paired checkpoint — maybe the host just had a bad "
+             "hour) before a recurrence is demoted to PERMANENT: the "
+             "condemned rank is dropped and the gang resizes via the "
+             "normal clean-resize machinery. Spent per job, not per "
+             "generation, so a persistently slow host cannot buy "
+             "itself a restart loop")
+DEFINE_float("route_gray_ratio", 0.0,
+             "gray-failure detection for the SERVING fleet "
+             "(paddle_tpu.resilience.grayfail consumed by the "
+             "router's poller): a replica whose proxied-latency EWMA "
+             "stays above ratio x the cross-replica median (same "
+             "robust baseline + streak + hysteresis detector as the "
+             "training tier) is drained and ejected into the normal "
+             "probation/readmit cycle EVEN THOUGH its /healthz still "
+             "answers 200 — latency-only ejection, recorded as "
+             "durable gray_suspected / gray_mitigated events and "
+             "counted in /statz. 0.0 (default) = detection off; 3.0 "
+             "is the load_bench slow-replica-leg setting. Needs at "
+             "least 3 replicas with traffic to pick an outlier (the "
+             "median of a pair splits it)")
+DEFINE_float("route_gray_hold_s", 10.0,
+             "serving router: how long a latency-ejected (gray) "
+             "replica is held out of rotation before its detector "
+             "record is forgotten and the normal /healthz probation "
+             "(route_readmit_after) may readmit it. An ejected "
+             "replica receives no traffic, so its latency signal "
+             "cannot clear itself — the hold is the readmit path, and "
+             "a replica that is still slow after readmission is "
+             "simply condemned again")
+DEFINE_float("route_hedge_budget", 0.0,
+             "serving router: request hedging for IDEMPOTENT "
+             ":predict proxies only (:generate consumes KV budget and "
+             "decode slots — it is NEVER hedged). A predict still "
+             "unanswered past the hedge deadline — the router's "
+             "observed p99 proxied latency, floored at "
+             "route_hedge_min_ms — fires ONE hedged attempt at the "
+             "next-best replica; the first answer wins and the loser "
+             "is discarded on arrival. This value caps hedges as a "
+             "fraction of proxied traffic (0.05 = at most 5% extra "
+             "load) so tail-chasing can never melt an overloaded "
+             "fleet. 0.0 (default) = hedging off. Hedges and hedge "
+             "wins are counted in /statz and the grayfail profiler "
+             "family")
+DEFINE_float("route_hedge_min_ms", 20.0,
+             "serving router: floor for the p99-derived hedge "
+             "deadline, and the deadline used while fewer than 20 "
+             "latency samples exist. Keeps a fast fleet (p99 of a "
+             "few ms) from hedging on scheduler noise — a hedge "
+             "should chase a genuinely late request, not jitter")

@@ -97,11 +97,15 @@ def launch(nprocs, coordinator, script_argv, env=None, python=None,
 def launch_elastic(nprocs, coordinator, script_argv, env=None, python=None,
                    grace_sec=10.0, min_workers=None, restart_budget=None,
                    state_dir=None, master_tasks=None,
-                   master_timeout_sec=60.0, snapshot_root=None):
+                   master_timeout_sec=60.0, snapshot_root=None,
+                   gray_ratio=None, gray_budget=None):
     """Elastic mode: survive-and-resize supervision (see
     :class:`paddle_tpu.elastic.ElasticSupervisor` for the full
     contract). Returns the job's exit code: 0 when a generation
-    completes, the real failing code when the quorum is lost."""
+    completes, the real failing code when the quorum is lost.
+    ``gray_ratio``/``gray_budget`` arm gray-failure detection over the
+    workers' step-time heartbeats (FLAGS.gray_step_ratio /
+    FLAGS.gray_mitigation_budget when None)."""
     from .elastic.supervisor import ElasticSupervisor
 
     return ElasticSupervisor(
@@ -109,7 +113,8 @@ def launch_elastic(nprocs, coordinator, script_argv, env=None, python=None,
         restart_budget=restart_budget, grace_sec=grace_sec, env=env,
         python=python, state_dir=state_dir, master_tasks=master_tasks,
         master_timeout_sec=master_timeout_sec,
-        snapshot_root=snapshot_root).run()
+        snapshot_root=snapshot_root, gray_ratio=gray_ratio,
+        gray_budget=gray_budget).run()
 
 
 def _shell_rc(rc):
@@ -155,6 +160,18 @@ def add_launch_arguments(ap):
                          "the task master from the snapshot PAIRED "
                          "with the checkpoint the survivors resume "
                          "from (paddle_tpu.elastic.resume)")
+    ap.add_argument("--gray-step-ratio", type=float,
+                    default=FLAGS.gray_step_ratio,
+                    dest="gray_step_ratio",
+                    help="gray-failure detection: condemn a rank whose "
+                         "step-time EWMA sits this factor above the "
+                         "gang median (resilience.grayfail; 0 = off)")
+    ap.add_argument("--gray-mitigation-budget", type=int,
+                    default=FLAGS.gray_mitigation_budget,
+                    dest="gray_mitigation_budget",
+                    help="transient full-world restarts spent on a "
+                         "gray-slow rank before it is demoted to "
+                         "permanent (resize); job-scoped")
     ap.add_argument("--master-tasks-file", default=None,
                     dest="master_tasks_file",
                     help="newline-separated task payloads; hosts a "
@@ -181,7 +198,9 @@ def run_from_args(args, script_argv):
             restart_budget=args.elastic_restart_budget,
             state_dir=args.state_dir, master_tasks=master_tasks,
             master_timeout_sec=args.master_timeout_sec,
-            snapshot_root=args.snapshot_root)
+            snapshot_root=args.snapshot_root,
+            gray_ratio=args.gray_step_ratio,
+            gray_budget=args.gray_mitigation_budget)
     return launch(args.nprocs, args.coordinator, script_argv,
                   grace_sec=args.grace_sec, master_tasks=master_tasks,
                   master_timeout_sec=args.master_timeout_sec)

@@ -340,13 +340,22 @@ class MasterClient(object):
         import socket
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._call_lock = threading.Lock()
 
     def _call(self, op, payload=b""):
+        # one request/response pair at a time: under pipeline=True the
+        # feed thread leases (GET) while the main thread commits (FIN)
+        # on the SAME connection — unserialized, the two readers cross
+        # responses, so a commit can consume a lease reply (a spurious
+        # "lease lost" for a task the master counted done — a row
+        # silently missing from the exactly-once audit trail)
         import struct
-        self._sock.sendall(struct.pack("<BI", op, len(payload)) + payload)
-        hdr = self._recv(12)
-        a, n = struct.unpack("<qI", hdr)
-        data = self._recv(n) if n else b""
+        with self._call_lock:
+            self._sock.sendall(struct.pack("<BI", op, len(payload))
+                               + payload)
+            hdr = self._recv(12)
+            a, n = struct.unpack("<qI", hdr)
+            data = self._recv(n) if n else b""
         return a, data
 
     def _recv(self, n):

@@ -36,7 +36,9 @@ __all__ = ["timer", "stat_summary", "print_stats", "reset_stats",
            "update_memory_counters", "memory_counters",
            "reset_memory_counters",
            "update_trainer_counters", "trainer_counters",
-           "reset_trainer_counters"]
+           "reset_trainer_counters",
+           "update_grayfail_counters", "grayfail_counters",
+           "reset_grayfail_counters"]
 
 _enabled = False
 _records = defaultdict(list)  # label -> [seconds]
@@ -52,6 +54,7 @@ _router_counters = defaultdict(float)     # multi-replica-router observability
 _autoscale_counters = defaultdict(float)  # closed-loop-autoscaler observability
 _memory_counters = defaultdict(float)     # static-memory-planner observability
 _trainer_counters = defaultdict(float)    # trainer-loop failure-policy observability
+_grayfail_counters = defaultdict(float)   # gray-failure-detection observability
 _T0 = time.perf_counter()
 
 
@@ -101,6 +104,7 @@ def reset_profiler():
     _autoscale_counters.clear()
     _memory_counters.clear()
     _trainer_counters.clear()
+    _grayfail_counters.clear()
 
 
 def update_pipeline_counters(**counters):
@@ -317,7 +321,12 @@ def update_router_counters(**counters):
     / ``router_readmits`` (health state transitions),
     ``router_reloads`` / ``router_reload_rollbacks`` (rolling hot
     reload outcomes), ``router_replica_restarts`` /
-    ``router_replica_lost`` (pool supervision); ``router_peak_load``
+    ``router_replica_lost`` (pool supervision), ``router_gray_ejects``
+    / ``router_gray_readmits`` (latency-skew ejections — replica
+    answered /healthz 200 but the SkewDetector condemned its proxied
+    latency EWMA), ``router_hedges`` / ``router_hedge_wins`` (hedged
+    ``:predict`` attempts fired past the p99 deadline, and how many
+    answered before the primary); ``router_peak_load``
     (largest per-replica load score observed by the poller) and
     ``router_replicas`` (configured pool size) are kept as maxima."""
     for k, v in counters.items():
@@ -368,6 +377,31 @@ def autoscale_counters():
 
 def reset_autoscale_counters():
     _autoscale_counters.clear()
+
+
+def update_grayfail_counters(**counters):
+    """Accumulate gray-failure-detection observability counters
+    (paddle_tpu.resilience.grayfail consumers — the elastic supervisor
+    and the serving router; a few dict adds per detector verdict
+    change or hedged request). Keys in use: ``gray_suspected`` (verdict
+    escalations recorded at either tier), ``gray_mitigated_restarts``
+    / ``gray_mitigated_resizes`` (the supervisor's budgeted
+    mitigations of a condemned rank), ``gray_ejects`` /
+    ``gray_readmits`` (the router's latency-only replica ejections and
+    their probation returns), ``router_hedges`` (hedged :predict
+    attempts fired past the p99 deadline) and ``router_hedge_wins``
+    (hedges whose answer beat the primary)."""
+    for k, v in counters.items():
+        _grayfail_counters[k] += float(v)
+
+
+def grayfail_counters():
+    """Snapshot {counter: value} of the gray-failure counters."""
+    return dict(_grayfail_counters)
+
+
+def reset_grayfail_counters():
+    _grayfail_counters.clear()
 
 
 def record_op_event(op_type, name, t_start, t_end):
@@ -504,6 +538,7 @@ def write_timeline(path):
         "autoscale": dict(_autoscale_counters),
         "memory": dict(_memory_counters),
         "trainer": dict(_trainer_counters),
+        "grayfail": dict(_grayfail_counters),
     }
     with open(path, "w") as f:
         json.dump(artifact, f, indent=1)

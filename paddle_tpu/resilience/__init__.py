@@ -20,6 +20,11 @@ failure semantics, not scattered try/excepts):
   crash-loop window + generation bump + SIGTERM->SIGKILL escalation)
   both the elastic trainer supervisor and the serving replica pool
   consume, so their judgement cannot drift.
+- :mod:`.grayfail` — ``SkewDetector``: the ONE robust latency-skew
+  judgement (median+MAD baseline, breach streaks, hysteresis) the
+  elastic supervisor and the serving router both consume to notice
+  members that are alive but consistently slower than their peers —
+  the gray failures binary health checks cannot see.
 - :mod:`.watchdog` — ``StepWatchdog``: the per-step progress deadline
   that turns a wedged training step (hung collective, stalled reader)
   into a recorded ``step_hung`` + non-zero exit the elastic supervisor
@@ -47,6 +52,7 @@ from .faults import (  # noqa: F401
 from .supervise import (  # noqa: F401
     SlotDecision, SlotSupervision, escalate_stop, signal_quietly,
 )
+from .grayfail import GrayVerdict, SkewDetector  # noqa: F401
 from .watchdog import StepWatchdog, STEP_HUNG_EXIT  # noqa: F401
 from .guardrails import NumericGuard  # noqa: F401
 
@@ -56,6 +62,6 @@ __all__ = [
     "FaultError", "SITE_TABLE", "arm", "disarm", "reset", "hits",
     "armed", "fault_point", "parse_fault_spec", "load_fault_spec",
     "SlotDecision", "SlotSupervision", "escalate_stop",
-    "signal_quietly",
+    "signal_quietly", "GrayVerdict", "SkewDetector",
     "StepWatchdog", "STEP_HUNG_EXIT", "NumericGuard",
 ]

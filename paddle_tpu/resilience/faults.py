@@ -179,35 +179,39 @@ _ENV_VAR = "PADDLE_TPU_FAULT_SPEC"
 _ACTIONS = ("raise", "delay", "corrupt")
 
 # The machine-readable face of the docstring table above: site ->
-# (defining module under paddle_tpu/, armable). ``armable=False`` marks
-# names that are only EVENT sites (recorded on degradation events but
-# never a ``fault_point`` call). tests/test_trainer_resilience.py walks
-# this registry and asserts code, this table, the docstring table and
-# cluster/README.md agree — drift between them is a test failure, not
-# a doc rot.
+# (defining module under paddle_tpu/, armable, delay_documented).
+# ``armable=False`` marks names that are only EVENT sites (recorded on
+# degradation events but never a ``fault_point`` call).
+# ``delay_documented=True`` marks the sites whose docstring row
+# documents DELAY semantics — the slow-device/slow-rank model the
+# gray-failure chaos legs (benchmark/chaos_run.py CHAOS_SLOW_RANK,
+# benchmark/load_bench.py gray_leg) arm to fake a gray member.
+# tests/test_trainer_resilience.py walks this registry and asserts
+# code, this table, the docstring table and cluster/README.md agree —
+# drift between them is a test failure, not a doc rot.
 SITE_TABLE = {
-    "checkpoint.write": ("checkpoint.py", True),
-    "checkpoint.load": ("checkpoint.py", True),
-    "async_sgd.push_grads": ("parallel/async_sgd.py", True),
-    "async_sgd.pull_params": ("parallel/async_sgd.py", True),
-    "reader.next": ("native/__init__.py", True),
-    "dataset.download": ("dataset/common.py", True),
-    "pipeline.feed_next": ("pipeline.py", True),
-    "serving.dispatch": ("serving/batcher.py", True),
-    "serving.reload": ("serving/registry.py", True),
-    "serving.generate": ("serving/generator.py", True),
-    "serving.route": ("serving/router.py", True),
-    "serving.autoscale": ("serving/autoscale.py", True),
-    "comm.quantize": ("comm/allreduce.py", True),
-    "comm.bucket_roundtrip": ("comm/bucket.py", True),
-    "comm.overlap": ("comm/overlap.py", True),
-    "comm.gspmd": ("core/executor.py", False),
-    "tune.candidate": ("tune/loop.py", True),
-    "tune.cache": ("tune/cache.py", True),
-    "elastic.heartbeat": ("elastic/supervisor.py", True),
-    "elastic.replan": ("elastic/replan.py", True),
-    "elastic.resume": ("elastic/resume.py", True),
-    "trainer.step": ("trainer.py", True),
+    "checkpoint.write": ("checkpoint.py", True, False),
+    "checkpoint.load": ("checkpoint.py", True, False),
+    "async_sgd.push_grads": ("parallel/async_sgd.py", True, False),
+    "async_sgd.pull_params": ("parallel/async_sgd.py", True, False),
+    "reader.next": ("native/__init__.py", True, False),
+    "dataset.download": ("dataset/common.py", True, False),
+    "pipeline.feed_next": ("pipeline.py", True, False),
+    "serving.dispatch": ("serving/batcher.py", True, True),
+    "serving.reload": ("serving/registry.py", True, False),
+    "serving.generate": ("serving/generator.py", True, True),
+    "serving.route": ("serving/router.py", True, True),
+    "serving.autoscale": ("serving/autoscale.py", True, True),
+    "comm.quantize": ("comm/allreduce.py", True, False),
+    "comm.bucket_roundtrip": ("comm/bucket.py", True, False),
+    "comm.overlap": ("comm/overlap.py", True, False),
+    "comm.gspmd": ("core/executor.py", False, False),
+    "tune.candidate": ("tune/loop.py", True, False),
+    "tune.cache": ("tune/cache.py", True, False),
+    "elastic.heartbeat": ("elastic/supervisor.py", True, False),
+    "elastic.replan": ("elastic/replan.py", True, False),
+    "elastic.resume": ("elastic/resume.py", True, False),
+    "trainer.step": ("trainer.py", True, True),
 }
 
 

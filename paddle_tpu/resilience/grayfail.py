@@ -1,0 +1,248 @@
+"""Gray-failure skew detection: ONE robust latency-outlier judgement
+for the training gang and the serving fleet.
+
+The stack's health decisions were binary until now — the elastic
+supervisor acts on process EXIT, the router ejects on MISSED /healthz
+polls, the step watchdog fires on a FULL hang. A gray failure slips
+all three: a rank or replica that is alive, answering every probe, and
+consistently 5x slower than its peers (thermal throttle, bad host,
+flaky NIC) drags every collective to its pace or ruins fleet p99 while
+tripping nothing. Both tiers need the same judgement — "is this member
+a sustained latency outlier against its peers?" — and, as with
+:mod:`.supervise`, two copies of that judgement would drift. This
+module is the ONE implementation both consume.
+
+:class:`SkewDetector` keeps a rolling window of a scalar metric per
+member (per-step wall ms for ranks, proxied-latency EWMA ms for
+replicas) and, on each :meth:`evaluate` pass, compares every warmed-up
+member against a ROBUST cross-member baseline: the median of member
+medians, spread-guarded by the MAD (median absolute deviation). A
+member breaches when its window median clears BOTH the multiplicative
+ratio over the baseline and the MAD band — so a tight fleet (MAD = 0,
+everyone equal) can never condemn anyone on noise, and one very slow
+member cannot drag the baseline up to hide itself (medians, not
+means). Breaches must be CONSECUTIVE evaluations to accumulate a
+streak; verdicts escalate healthy -> suspect -> condemned on streak
+thresholds and de-escalate only after a clear-streak of non-breaching
+evaluations (hysteresis), with per-direction cooldowns so a member
+cannot flap between verdicts faster than either cooldown allows.
+
+Deliberately policy-free, the :class:`.supervise.SlotSupervision`
+extraction pattern: the detector never kills, ejects, records events,
+or spends budgets — the elastic supervisor decides "condemned rank ->
+budgeted restart-then-resize" and the router decides "condemned
+replica -> drain + eject into probation"; both record their own
+durable events. NOT itself thread-safe: callers hold their own lock
+(the router's state lock, the supervisor's single thread).
+
+Degenerate cases are hard guarantees, pinned by tests/test_grayfail.py:
+
+- fewer than ``warmup`` samples in a member's window: that member is
+  neither judged nor counted as a peer;
+- fewer than ``min_peers`` OTHER warmed-up members: no verdict ever
+  escalates (a single-member population has no baseline to skew from);
+- all members equal (MAD = 0): nobody breaches, even at baseline 0;
+- an oscillating metric (fast/slow alternation): the window MEDIAN
+  stays near the population and consecutive-breach streaks reset on
+  every clean evaluation — no streak accumulates (the flap guard).
+"""
+from __future__ import annotations
+
+from collections import deque, namedtuple
+
+__all__ = ["GrayVerdict", "SkewDetector",
+           "HEALTHY", "SUSPECT", "CONDEMNED"]
+
+HEALTHY = "healthy"
+SUSPECT = "suspect"
+CONDEMNED = "condemned"
+
+#: One member's judgement from :meth:`SkewDetector.evaluate`.
+#: ``state`` is ``healthy``/``suspect``/``condemned``; ``stat`` the
+#: member's window median; ``baseline`` the cross-member median of
+#: medians; ``threshold`` the breach bar this pass; ``streak`` the
+#: consecutive-breach count; ``changed`` True when this evaluation
+#: moved the member's state (the caller's record-once edge trigger).
+GrayVerdict = namedtuple(
+    "GrayVerdict",
+    ["state", "stat", "baseline", "threshold", "streak", "changed"])
+
+
+def _median(values):
+    vs = sorted(values)
+    n = len(vs)
+    if n == 0:
+        return 0.0
+    mid = n // 2
+    if n % 2:
+        return float(vs[mid])
+    return (vs[mid - 1] + vs[mid]) / 2.0
+
+
+class _Member(object):
+    __slots__ = ("window", "breach_streak", "clear_streak", "state",
+                 "escalated_at", "cleared_at")
+
+    def __init__(self, window):
+        self.window = deque(maxlen=window)
+        self.breach_streak = 0
+        self.clear_streak = 0
+        self.state = HEALTHY
+        self.escalated_at = None   # eval tick of the last escalation
+        self.cleared_at = None     # eval tick of the last de-escalation
+
+
+class SkewDetector(object):
+    """Robust cross-member latency-skew detector (see module doc).
+
+    ``ratio`` is the multiplicative breach bar over the cross-member
+    baseline (a member must be > ``ratio`` x the median of medians);
+    ``mad_k`` the additive robust band (AND > baseline + ``mad_k`` x
+    MAD — with MAD = 0 the band is zero-width and the ratio bar alone
+    must clear, which at an all-equal population it never does).
+    ``window`` bounds each member's rolling sample window; ``warmup``
+    is the minimum samples before a member is judged or counted as a
+    peer; ``min_peers`` the minimum number of OTHER warmed-up members
+    required before anyone can breach. ``suspect_after`` /
+    ``condemn_after`` are the consecutive-breach streaks that escalate
+    a verdict; ``clear_after`` the consecutive clean evaluations that
+    de-escalate one step (condemned -> suspect -> healthy).
+    ``escalate_cooldown`` / ``clear_cooldown`` are per-direction
+    evaluation-tick cooldowns: after a de-escalation the member cannot
+    escalate again for ``escalate_cooldown`` ticks, and after an
+    escalation it cannot de-escalate for ``clear_cooldown`` ticks — a
+    member can flap no faster than the slower cooldown.
+    """
+
+    def __init__(self, ratio=3.0, mad_k=4.0, window=8, warmup=3,
+                 min_peers=1, suspect_after=2, condemn_after=4,
+                 clear_after=2, escalate_cooldown=2, clear_cooldown=2):
+        if ratio <= 1.0:
+            raise ValueError("ratio must be > 1.0, got %r" % (ratio,))
+        if warmup < 1 or window < warmup:
+            raise ValueError("need window >= warmup >= 1, got "
+                             "window=%r warmup=%r" % (window, warmup))
+        if not (1 <= suspect_after <= condemn_after):
+            raise ValueError(
+                "need 1 <= suspect_after <= condemn_after, got %r/%r"
+                % (suspect_after, condemn_after))
+        self.ratio = float(ratio)
+        self.mad_k = float(mad_k)
+        self.window = int(window)
+        self.warmup = int(warmup)
+        self.min_peers = max(int(min_peers), 1)
+        self.suspect_after = int(suspect_after)
+        self.condemn_after = int(condemn_after)
+        self.clear_after = max(int(clear_after), 1)
+        self.escalate_cooldown = max(int(escalate_cooldown), 0)
+        self.clear_cooldown = max(int(clear_cooldown), 0)
+        self._members = {}
+        self._tick = 0
+
+    # -- samples ------------------------------------------------------------
+    def observe(self, member, value):
+        """Append one metric sample to ``member``'s rolling window."""
+        m = self._members.get(member)
+        if m is None:
+            m = self._members[member] = _Member(self.window)
+        m.window.append(float(value))
+
+    def forget(self, member):
+        """Drop ``member``'s window, streaks, and verdict — the caller
+        restarted/replaced it (generation bump) or readmitted it after
+        mitigation; a fresh process never inherits its predecessor's
+        health record."""
+        self._members.pop(member, None)
+
+    def members(self):
+        return sorted(self._members)
+
+    # -- judgement ----------------------------------------------------------
+    def _stats(self):
+        """{member: window median} over warmed-up members only."""
+        return {k: _median(m.window)
+                for k, m in self._members.items()
+                if len(m.window) >= self.warmup}
+
+    def evaluate(self):
+        """Run one evaluation pass and return {member: GrayVerdict}
+        over every warmed-up member. Pure judgement — no side effects
+        beyond the detector's own streak/verdict state."""
+        self._tick += 1
+        stats = self._stats()
+        verdicts = {}
+        judgeable = len(stats) >= self.min_peers + 1
+        baseline = _median(stats.values()) if stats else 0.0
+        mad = _median([abs(v - baseline) for v in stats.values()]) \
+            if stats else 0.0
+        # Both bars must clear: the ratio bar keeps a tight fleet
+        # (MAD=0) from condemning noise, the MAD band keeps a noisy
+        # fleet from condemning its own spread.
+        threshold = max(baseline * self.ratio,
+                        baseline + self.mad_k * mad)
+        for member, stat in stats.items():
+            m = self._members[member]
+            breach = judgeable and stat > threshold and stat > 0.0
+            if breach:
+                m.breach_streak += 1
+                m.clear_streak = 0
+            else:
+                m.breach_streak = 0
+                m.clear_streak += 1
+            changed = self._transition(m)
+            verdicts[member] = GrayVerdict(
+                m.state, stat, baseline, threshold,
+                m.breach_streak, changed)
+        return verdicts
+
+    def _transition(self, m):
+        """Apply streaks to the member's verdict under the
+        per-direction cooldowns; returns True when the state moved."""
+        before = m.state
+        can_escalate = (m.cleared_at is None
+                        or self._tick - m.cleared_at
+                        >= self.escalate_cooldown)
+        can_clear = (m.escalated_at is None
+                     or self._tick - m.escalated_at
+                     >= self.clear_cooldown)
+        if m.breach_streak > 0 and can_escalate:
+            if m.state == HEALTHY \
+                    and m.breach_streak >= self.suspect_after:
+                m.state = SUSPECT
+            if m.state == SUSPECT \
+                    and m.breach_streak >= self.condemn_after:
+                m.state = CONDEMNED
+        elif m.clear_streak >= self.clear_after and can_clear \
+                and m.state != HEALTHY:
+            m.state = SUSPECT if m.state == CONDEMNED else HEALTHY
+            m.clear_streak = 0
+        if m.state != before:
+            if _RANK[m.state] > _RANK[before]:
+                m.escalated_at = self._tick
+            else:
+                m.cleared_at = self._tick
+            return True
+        return False
+
+    # -- introspection ------------------------------------------------------
+    def verdict(self, member):
+        """The member's current state (``healthy`` when unknown)."""
+        m = self._members.get(member)
+        return m.state if m is not None else HEALTHY
+
+    def condemned(self):
+        return sorted(k for k, m in self._members.items()
+                      if m.state == CONDEMNED)
+
+    def suspects(self):
+        return sorted(k for k, m in self._members.items()
+                      if m.state in (SUSPECT, CONDEMNED))
+
+    def stats(self):
+        """Observability snapshot: per-member median/streak/state."""
+        return {k: {"stat": _median(m.window), "samples": len(m.window),
+                    "breach_streak": m.breach_streak, "state": m.state}
+                for k, m in self._members.items()}
+
+
+_RANK = {HEALTHY: 0, SUSPECT: 1, CONDEMNED: 2}

@@ -63,7 +63,7 @@ from __future__ import annotations
 import threading
 import time
 
-from ..resilience import fault_point, record_event
+from ..resilience import fault_point, record_durable_event
 # the shared lock constructor (lock-order race detector under
 # PADDLE_TPU_SANITIZE=locks)
 from ..analysis import locks as _locks
@@ -189,7 +189,7 @@ class Autoscaler(object):
             from .. import profiler as _prof
             self._degraded = True
             self._degraded_error = repr(e)
-            record_event("autoscale_degraded", site="serving.autoscale",
+            record_durable_event("autoscale_degraded", site="serving.autoscale",
                          error=repr(e), replicas=self._safe_active())
             _prof.update_autoscale_counters(autoscale_degraded=1)
 
@@ -261,7 +261,7 @@ class Autoscaler(object):
             if self._breaker_until is not None \
                     and now >= self._breaker_until:
                 self._breaker = "half_open"
-                record_event("autoscale_breaker_half_open",
+                record_durable_event("autoscale_breaker_half_open",
                              site="serving.autoscale")
                 self._count("autoscale_breaker_half_opens")
                 return True     # this tick's scale-up is the probe
@@ -273,7 +273,7 @@ class Autoscaler(object):
     def _breaker_open(self, now, replica, reason):
         self._breaker = "open"
         self._breaker_until = now + self.breaker_backoff_s
-        record_event("autoscale_breaker_open", site="serving.autoscale",
+        record_durable_event("autoscale_breaker_open", site="serving.autoscale",
                      replica=replica, reason=reason,
                      backoff_s=self.breaker_backoff_s)
         self._count("autoscale_breaker_opens")
@@ -298,7 +298,7 @@ class Autoscaler(object):
                 if p["probe"] or self._breaker != "closed":
                     self._breaker = "closed"
                     self._breaker_until = None
-                    record_event("autoscale_breaker_close",
+                    record_durable_event("autoscale_breaker_close",
                                  site="serving.autoscale", replica=index)
                     self._count("autoscale_breaker_closes")
                 self._decision("warmed", replica=index)
@@ -335,7 +335,7 @@ class Autoscaler(object):
         self._up_streak = 0
         self._quiet_streak = 0
         self._last_up_t = now
-        record_event("autoscale_up", site="serving.autoscale",
+        record_durable_event("autoscale_up", site="serving.autoscale",
                      replica=rep.index, pressure=sig, reason=reason,
                      replicas_from=active, replicas_to=active + 1,
                      probe=probe)
@@ -367,7 +367,7 @@ class Autoscaler(object):
         self._up_streak = 0
         self._quiet_streak = 0
         self._last_down_t = self._clock()
-        record_event("autoscale_down", site="serving.autoscale",
+        record_durable_event("autoscale_down", site="serving.autoscale",
                      replica=victim, pressure=sig,
                      replicas_from=active, replicas_to=active - 1,
                      drained=drained, inflight_at_stop=inflight, rc=rc)

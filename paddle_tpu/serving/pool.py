@@ -56,7 +56,7 @@ import sys
 import threading
 import time
 
-from ..resilience import RetryPolicy, record_event
+from ..resilience import RetryPolicy, record_durable_event
 from ..resilience.supervise import (SlotSupervision, escalate_stop,
                                     signal_quietly)
 # the shared lock constructor: plain threading primitives normally, the
@@ -297,7 +297,7 @@ class ReplicaPool(object):
                     continue      # stale exit of an already-replaced proc
                 decision = self._sup.classify_exit(index)
                 if decision.action == "lost":
-                    record_event("router_replica_lost", site="serving.route",
+                    record_durable_event("router_replica_lost", site="serving.route",
                                  replica=index, rc=rc,
                                  restarts_used=decision.used)
                     _prof.update_router_counters(router_replica_lost=1)
@@ -307,7 +307,7 @@ class ReplicaPool(object):
             if lost:
                 self._notify_membership()
                 continue
-            record_event("router_replica_restart", site="serving.route",
+            record_durable_event("router_replica_restart", site="serving.route",
                          replica=index, rc=rc, attempt=decision.attempt,
                          backoff_sec=round(decision.backoff_sec, 3))
             _prof.update_router_counters(router_replica_restarts=1)
@@ -404,7 +404,7 @@ class ReplicaPool(object):
                     raise
                 self._replicas[index] = rep
                 active = self._active_count_locked()
-        record_event("router_replica_added", site="serving.route",
+        record_durable_event("router_replica_added", site="serving.route",
                      replica=index, pid=rep.pid)
         _prof.update_router_counters(router_replicas=active)
         self._notify_membership()
@@ -436,7 +436,7 @@ class ReplicaPool(object):
                 ).get(index)
             elif rep is not None:
                 rc = rep.proc.poll()
-        record_event("router_replica_retired", site="serving.route",
+        record_durable_event("router_replica_retired", site="serving.route",
                      replica=index, rc=rc)
         self._notify_membership()
         return rc

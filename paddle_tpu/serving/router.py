@@ -38,6 +38,31 @@ indistinguishable from a dead replica — degrade to failover, never a
 router crash. When no healthy replica exists the router sheds with 503
 + ``Retry-After`` instead of hanging.
 
+**Gray failures.** Binary health misses a replica that answers every
+/healthz but runs 5x slower than its peers (thermal throttle, bad
+host, flaky NIC). With ``route_gray_ratio`` > 0 the poller feeds each
+replica's proxied-latency EWMA into the ONE
+:class:`~paddle_tpu.resilience.grayfail.SkewDetector` shared with the
+elastic supervisor (robust median+MAD baseline, consecutive-breach
+streaks, hysteresis); a CONDEMNED replica is drained and ejected into
+the same probation cycle as a health-failing one — even though its
+/healthz is 200 — and held out (``route_gray_hold_s``) before the
+normal readmit probation may return it, its detector record forgotten
+so a recovered replica starts clean and a still-slow one is simply
+condemned again. Recorded as durable ``gray_suspected`` /
+``gray_mitigated`` events; the last routable replica is never
+gray-ejected (a slow answer beats no answer).
+
+**Hedging.** With ``route_hedge_budget`` > 0, an IDEMPOTENT
+``:predict`` proxy still unanswered past the router's observed p99
+(floored at ``route_hedge_min_ms``) fires ONE hedged attempt at the
+next-best replica; the first answer wins, the loser is discarded on
+arrival. ``:generate`` is NEVER hedged — it consumes KV pages and
+decode slots, and a duplicate generation is real double work, not a
+cheap insurance read. The budget caps hedges as a fraction of proxied
+traffic, so tail-chasing cannot melt an overloaded fleet; hedges and
+wins are counted in /statz and the ``grayfail`` profiler family.
+
 **Rolling reload.** ``:reload`` at the router fans out ONE replica at
 a time: drain (stop routing new work to it), proxy the reload, then
 gate on the reloaded replica passing ``/healthz`` before the next one
@@ -76,7 +101,11 @@ import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from ..resilience import fault_point, record_event
+from ..resilience import (fault_point, record_event,
+                          record_durable_event)
+from ..resilience.grayfail import (SkewDetector,
+                                   SUSPECT as _SUSPECT,
+                                   CONDEMNED as _CONDEMNED)
 from .httpd import read_json_body, write_json_reply
 from .service import _percentile
 # the shared lock constructor: plain threading primitives normally, the
@@ -96,7 +125,8 @@ class _ReplicaState(object):
 
     __slots__ = ("index", "generation", "failures", "ok_streak", "ejected",
                  "statz", "statz_t", "score", "inflight", "routed",
-                 "draining", "peak_load")
+                 "draining", "peak_load", "lat_ewma", "lat_n",
+                 "gray_ejected", "gray_t")
 
     def __init__(self, index, generation):
         self.index = index
@@ -111,6 +141,10 @@ class _ReplicaState(object):
         self.routed = 0
         self.draining = False  # rolling reload holds new work off
         self.peak_load = 0.0
+        self.lat_ewma = None   # proxied-latency EWMA (gray signal), ms
+        self.lat_n = 0         # proxied answers folded into the EWMA
+        self.gray_ejected = False  # ejected on latency, /healthz still 200
+        self.gray_t = None     # monotonic time of the gray ejection
 
 
 class Router(object):
@@ -125,7 +159,9 @@ class Router(object):
 
     def __init__(self, pool, policy="least_loaded", poll_ms=None,
                  eject_after=None, readmit_after=None,
-                 proxy_timeout_s=None, pressure_alpha=None):
+                 proxy_timeout_s=None, pressure_alpha=None,
+                 gray_ratio=None, gray_hold_s=None, hedge_budget=None,
+                 hedge_min_ms=None, state_dir=None):
         from ..flags import FLAGS
         if policy not in ("least_loaded", "round_robin"):
             raise ValueError("policy must be least_loaded or round_robin, "
@@ -147,6 +183,23 @@ class Router(object):
         if not 0.0 < self.pressure_alpha <= 1.0:
             raise ValueError("pressure_alpha must be in (0, 1], got %r"
                              % self.pressure_alpha)
+        self.gray_ratio = float(gray_ratio if gray_ratio is not None
+                                else FLAGS.route_gray_ratio)
+        self.gray_hold_s = float(gray_hold_s if gray_hold_s is not None
+                                 else FLAGS.route_gray_hold_s)
+        self.hedge_budget = float(hedge_budget if hedge_budget is not None
+                                  else FLAGS.route_hedge_budget)
+        self.hedge_min_ms = float(hedge_min_ms if hedge_min_ms is not None
+                                  else FLAGS.route_hedge_min_ms)
+        # ONE skew detector (resilience.grayfail), shared judgement with
+        # the elastic supervisor; policy (drain+eject into probation)
+        # stays here. None = latency ejection off.
+        self._gray = SkewDetector(ratio=self.gray_ratio) \
+            if self.gray_ratio > 0 else None
+        # where durable events land (route --state-dir); None degrades
+        # record_durable_event to the in-memory record (or the
+        # PADDLE_TPU_ELASTIC_STATE env default)
+        self.state_dir = state_dir
         self._lock = _locks.make_lock("serving.router.state")
         self._states = {}            # pool index -> _ReplicaState
         self._counts = {}            # router-level counters
@@ -164,6 +217,7 @@ class Router(object):
         self._poller = None
         self._poll_wake = threading.Event()
         self._probe_exec = None
+        self._hedge_exec = None
         self._closed = False
         self.autoscaler = None       # attached by serving.autoscale
         register = getattr(pool, "on_membership", None)
@@ -183,12 +237,33 @@ class Router(object):
                     thread_name_prefix="paddle_tpu-router-probe")
             return self._probe_exec
 
+    def _hedge_pool(self):
+        """Separate executor for hedged :predict attempts — a slow
+        proxied request (bounded only by proxy_timeout_s) must not
+        starve the health probes the ejection machinery runs on."""
+        with self._lock:
+            if self._hedge_exec is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._hedge_exec = ThreadPoolExecutor(
+                    max_workers=16,
+                    thread_name_prefix="paddle_tpu-router-hedge")
+            return self._hedge_exec
+
     # -- counters ------------------------------------------------------------
     def _count(self, key, n=1):
         from .. import profiler as _prof
         with self._lock:
             self._counts[key] = self._counts.get(key, 0) + n
         _prof.update_router_counters(**{key: n})
+
+    def _record(self, kind, **info):
+        """Record one router event durably when a ``--state-dir`` was
+        wired (ejections, failovers, reload rollbacks must survive a
+        router crash — the trainer got events.jsonl in the elastic
+        state dir, this is the serving tier's same trail); without one
+        this is exactly ``record_event``."""
+        return record_durable_event(kind, site="serving.route",
+                                    state_dir=self.state_dir, **info)
 
     # -- transport -----------------------------------------------------------
     @staticmethod
@@ -253,6 +328,10 @@ class Router(object):
                 fresh.draining = st.draining
             st = fresh
             self._states[rep.index] = st
+            if self._gray is not None:
+                # a fresh process must not inherit its predecessor's
+                # latency record either
+                self._gray.forget(rep.index)
         return st
 
     def _probe(self, rep):
@@ -306,16 +385,30 @@ class Router(object):
                     st.score = self.statz_load(statz)
                     st.peak_load = max(st.peak_load,
                                        st.score + st.inflight)
+                    readmitted = False
+                    gray_released = False
                     if st.ejected:
-                        st.ok_streak += 1
-                        if st.ok_streak >= self.readmit_after:
-                            st.ejected = False
-                            st.ok_streak = 0
-                            readmitted = True
-                        else:
-                            readmitted = False
-                    else:
-                        readmitted = False
+                        held = st.gray_ejected
+                        if held and st.gray_t is not None and \
+                                time.monotonic() - st.gray_t \
+                                >= self.gray_hold_s:
+                            # the gray hold expired: forget the stale
+                            # latency record (an ejected replica gets
+                            # no traffic, so the signal cannot clear
+                            # itself) and release the slot into the
+                            # NORMAL probation cycle below
+                            st.gray_ejected = False
+                            st.gray_t = None
+                            if self._gray is not None:
+                                self._gray.forget(rep.index)
+                            gray_released = True
+                            held = False
+                        if not held:
+                            st.ok_streak += 1
+                            if st.ok_streak >= self.readmit_after:
+                                st.ejected = False
+                                st.ok_streak = 0
+                                readmitted = True
                 else:
                     st.ok_streak = 0
                     st.failures += 1
@@ -328,16 +421,79 @@ class Router(object):
                 from .. import profiler as _prof
                 _prof.update_router_counters(
                     router_peak_load=st.peak_load)
+                if gray_released:
+                    self._count("router_gray_readmits")
+                    _prof.update_grayfail_counters(gray_readmits=1)
                 if readmitted:
-                    record_event("router_replica_readmit",
-                                 site="serving.route", replica=rep.index)
+                    self._record("router_replica_readmit",
+                                 replica=rep.index)
                     self._count("router_readmits")
             elif ejected_now:
-                record_event("router_replica_eject", site="serving.route",
+                self._record("router_replica_eject",
                              replica=rep.index,
                              failures=self.eject_after)
                 self._count("router_ejects")
+        self._gray_poll(reps)
         self._update_pressure(reps)
+
+    def _gray_poll(self, reps):
+        """Feed per-replica proxied-latency EWMAs into the skew
+        detector and eject a condemned replica (drain into the normal
+        probation cycle) even though its /healthz answers 200. The
+        JUDGEMENT is resilience.grayfail's; only the policy — drain +
+        eject, never the last routable replica, durable events — lives
+        here."""
+        if self._gray is None:
+            return
+        to_record = []
+        with self._lock:
+            routable = 0
+            observable = []
+            for rep in reps:
+                st = self._states.get(rep.index)
+                if st is None or not rep.ready:
+                    continue
+                if st.ejected or st.draining:
+                    continue
+                routable += 1
+                if st.lat_n > 0 and st.lat_ewma is not None:
+                    observable.append((rep.index, st))
+            for idx, st in observable:
+                self._gray.observe(idx, st.lat_ewma)
+            for idx, v in self._gray.evaluate().items():
+                st = self._states.get(idx)
+                if st is None or not v.changed:
+                    continue
+                if v.state == _SUSPECT:
+                    to_record.append(("gray_suspected", idx, v, None))
+                elif v.state == _CONDEMNED and not st.ejected:
+                    if routable <= 1:
+                        # a slow answer beats no answer: the last
+                        # routable replica is never gray-ejected
+                        continue
+                    st.ejected = True
+                    st.gray_ejected = True
+                    st.gray_t = time.monotonic()
+                    st.ok_streak = 0
+                    routable -= 1
+                    to_record.append(("gray_mitigated", idx, v,
+                                      "eject"))
+        from .. import profiler as _prof
+        for kind, idx, v, action in to_record:
+            info = {"replica": idx,
+                    "metric": "proxied_latency_ewma_ms",
+                    "stat": round(v.stat, 3),
+                    "baseline": round(v.baseline, 3),
+                    "threshold": round(v.threshold, 3),
+                    "streak": v.streak}
+            if action is not None:
+                info["action"] = action
+            self._record(kind, **info)
+            if action is None:
+                _prof.update_grayfail_counters(gray_suspected=1)
+            else:
+                self._count("router_gray_ejects")
+                _prof.update_grayfail_counters(gray_ejects=1)
 
     def _update_pressure(self, reps):
         """Refresh the per-model autoscale signal from the healthy
@@ -439,8 +595,11 @@ class Router(object):
             self._poller.join(timeout=self.poll_s + 2.0)
         with self._lock:
             exec_, self._probe_exec = self._probe_exec, None
+            hexec, self._hedge_exec = self._hedge_exec, None
         if exec_ is not None:
             exec_.shutdown(wait=False)
+        if hexec is not None:
+            hexec.shutdown(wait=False)
 
     # -- the autoscaler's handles -------------------------------------------
     def pressure_raw(self):
@@ -482,6 +641,8 @@ class Router(object):
         a future slot reusing the index must start clean."""
         with self._lock:
             self._states.pop(index, None)
+            if self._gray is not None:
+                self._gray.forget(index)
 
     # -- picking -------------------------------------------------------------
     def _routable(self, exclude=()):
@@ -525,6 +686,101 @@ class Router(object):
         base = _percentile(lat, 0.50) if lat else self.poll_s * 1e3
         return max(base, self.poll_s * 1e3, 50.0)
 
+    @staticmethod
+    def _fold_latency(st, lat_ms, alpha=0.3):
+        """Fold one proxied answer into the replica's latency EWMA —
+        the per-member metric the gray-failure detector judges.
+        Caller holds the state lock."""
+        st.lat_n += 1
+        st.lat_ewma = lat_ms if st.lat_ewma is None else \
+            alpha * lat_ms + (1.0 - alpha) * st.lat_ewma
+
+    def _hedge_deadline_s(self):
+        """The p99-derived hedge deadline in seconds, floored at
+        route_hedge_min_ms (the floor alone until 20 samples exist —
+        an empty histogram must not hedge everything)."""
+        with self._lock:
+            lat = list(self._latency_ms)
+        p99 = _percentile(lat, 0.99) if len(lat) >= 20 else 0.0
+        return max(p99, self.hedge_min_ms) / 1e3
+
+    def _hedge_allowed(self):
+        """Budget gate: hedges fired so far stay under
+        hedge_budget x proxied requests — tail-chasing must never add
+        unbounded load to an already-melting fleet."""
+        with self._lock:
+            req = self._counts.get("router_requests", 0)
+            fired = self._counts.get("router_hedges", 0)
+        return (fired + 1) <= self.hedge_budget * max(req, 1)
+
+    def _spawn_post(self, rep, path, body, timeout):
+        """One replica POST on the hedge executor with the full
+        per-replica bookkeeping (inflight, routed, latency EWMA)
+        attached to the future — the hedged path needs BOTH attempts
+        tracked even though only one answer is consumed; the loser's
+        done-callback still settles its replica's books."""
+        with self._lock:
+            st = self._state_for(rep)
+            st.inflight += 1
+            st.routed += 1
+            st.peak_load = max(st.peak_load, st.score + st.inflight)
+        t0 = time.monotonic()
+        fut = self._hedge_pool().submit(
+            self._post_json, rep.base_url + path, body, timeout)
+
+        def _settle(_f):
+            with self._lock:
+                st.inflight -= 1
+                lat = (time.monotonic() - t0) * 1e3
+                self._latency_ms.append(lat)
+                del self._latency_ms[:-4096]
+                self._fold_latency(st, lat)
+        fut.add_done_callback(_settle)
+        return fut
+
+    def _post_hedged(self, rep, path, body, timeout):
+        """Attempt 0 of an idempotent ``:predict`` with hedging armed:
+        fire the primary, wait out the hedge deadline, then fire at
+        most ONE hedged attempt at the next-best replica (budget
+        permitting); the FIRST ANSWER wins and the loser is discarded
+        on arrival. Returns (status, payload, winner_index,
+        hedge_indices); status None = every fired attempt died on
+        transport (payload carries the last error's repr) — the
+        caller's normal failover takes over."""
+        from concurrent.futures import wait, FIRST_COMPLETED
+        from .. import profiler as _prof
+        fault_point("serving.route")
+        futs = {self._spawn_post(rep, path, body, timeout): rep.index}
+        extra = []
+        done, _ = wait(list(futs),
+                       timeout=min(self._hedge_deadline_s(), timeout),
+                       return_when=FIRST_COMPLETED)
+        if not done:
+            hedge = self.pick(exclude=(rep.index,))
+            if hedge is not None and self._hedge_allowed():
+                self._count("router_hedges")
+                _prof.update_grayfail_counters(router_hedges=1)
+                futs[self._spawn_post(hedge, path, body,
+                                      timeout)] = hedge.index
+                extra.append(hedge.index)
+        last_err = None
+        remaining = set(futs)
+        while remaining:
+            done, _ = wait(list(remaining),
+                           return_when=FIRST_COMPLETED)
+            for f in done:
+                remaining.discard(f)
+                if f.exception() is not None:
+                    last_err = f.exception()
+                    continue
+                status, payload, _hdrs = f.result()
+                widx = futs[f]
+                if widx != rep.index:
+                    self._count("router_hedge_wins")
+                    _prof.update_grayfail_counters(router_hedge_wins=1)
+                return status, payload, widx, extra
+        return None, repr(last_err), None, extra
+
     def proxy(self, path, body, deadline_ms=None):
         """Route one POST to the best replica with one failover retry.
         Returns (status, body_dict, replica_index_or_None). Transport
@@ -535,7 +791,10 @@ class Router(object):
         2x its deadline. A ``route_failover`` event is recorded only
         once the retry has an actual target: a lone replica's 429 must
         not read as a failover in /statz. No routable replica ->
-        (503, shed body, None)."""
+        (503, shed body, None). With ``route_hedge_budget`` > 0 an
+        idempotent ``:predict``'s FIRST attempt may fire one hedged
+        sibling attempt past the p99 deadline (see ``_post_hedged``);
+        ``:generate`` never hedges."""
         deadline_t = None
         if deadline_ms is not None:
             deadline_t = time.monotonic() + max(float(deadline_ms) / 1e3,
@@ -549,7 +808,7 @@ class Router(object):
             if rep is None:
                 break
             if pending_failover is not None:
-                record_event("route_failover", site="serving.route",
+                self._record("route_failover",
                              path=path, **pending_failover)
                 self._count("router_failovers")
                 pending_failover = None
@@ -558,6 +817,25 @@ class Router(object):
             if deadline_t is not None:
                 timeout = min(timeout,
                               max(deadline_t - time.monotonic(), 0.05))
+            if attempt == 0 and self.hedge_budget > 0 \
+                    and path.endswith(":predict"):
+                status, payload, widx, extra = self._post_hedged(
+                    rep, path, body, timeout)
+                for i in extra:
+                    if i not in tried:
+                        tried.append(i)
+                if status is None:
+                    pending_failover = {"replica": rep.index,
+                                        "attempt": attempt + 1,
+                                        "error": payload}
+                    continue
+                if status in (429, 503):
+                    last_answer = (status, payload, widx)
+                    pending_failover = {"replica": widx,
+                                        "attempt": attempt + 1,
+                                        "status": status}
+                    continue
+                return status, payload, widx
             with self._lock:
                 st = self._state_for(rep)
                 st.inflight += 1
@@ -576,9 +854,10 @@ class Router(object):
             finally:
                 with self._lock:
                     st.inflight -= 1
-                    self._latency_ms.append(
-                        (time.monotonic() - t0) * 1e3)
+                    lat = (time.monotonic() - t0) * 1e3
+                    self._latency_ms.append(lat)
                     del self._latency_ms[:-4096]
+                    self._fold_latency(st, lat)
             if status in (429, 503) and attempt == 0:
                 # exhaustion is an honest answer, but a sibling may
                 # have room: one retry at the next-best replica
@@ -598,13 +877,13 @@ class Router(object):
             # honestly so /statz doesn't misread a transient double
             # failure as an ejected fleet.
             self._count("router_proxy_failed")
-            record_event("request_shed", site="serving.route",
+            self._record("request_shed",
                          reason="failover_exhausted", path=path)
             return 503, {"error": "all failover attempts failed "
                                   "(tried %s)" % tried,
                          "kind": "failover_exhausted"}, None
         self._count("router_no_replica")
-        record_event("request_shed", site="serving.route",
+        self._record("request_shed",
                      reason="no_replica", path=path)
         return 503, {"error": "no healthy replica available",
                      "kind": "no_replica"}, None
@@ -708,8 +987,8 @@ class Router(object):
                     self.set_draining(rep.index, False)
                 if status != 200:
                     rolled_back, rb_failed = self._roll_back(name, done)
-                    record_event(
-                        "reload_rollback", site="serving.route",
+                    self._record(
+                        "reload_rollback",
                         model=name, dirname=dirname,
                         failed_replica=rep.index,
                         reloaded_then_rolled_back=rolled_back,
@@ -726,7 +1005,7 @@ class Router(object):
                     return status, payload
                 done.append((rep, prev))
             self._count("router_reloads")
-            record_event("router_reload", site="serving.route", model=name,
+            self._record("router_reload", model=name,
                          dirname=dirname,
                          replicas=[r.index for r, _ in done],
                          skipped=skipped)
@@ -778,6 +1057,10 @@ class Router(object):
                     "ready": bool(rep is not None and rep.ready),
                     "generation": st.generation,
                     "ejected": st.ejected,
+                    "gray_ejected": st.gray_ejected,
+                    "latency_ewma_ms": (round(st.lat_ewma, 3)
+                                        if st.lat_ewma is not None
+                                        else None),
                     "draining": st.draining,
                     "health_failures": st.failures,
                     "routed": st.routed,
@@ -805,6 +1088,12 @@ class Router(object):
             "proxy_failed": counts.get("router_proxy_failed", 0),
             "ejects": counts.get("router_ejects", 0),
             "readmits": counts.get("router_readmits", 0),
+            "gray_ejects": counts.get("router_gray_ejects", 0),
+            "gray_readmits": counts.get("router_gray_readmits", 0),
+            "hedges": counts.get("router_hedges", 0),
+            "hedge_wins": counts.get("router_hedge_wins", 0),
+            "hedge_budget": self.hedge_budget,
+            "gray_ratio": self.gray_ratio,
             "reloads": counts.get("router_reloads", 0),
             "reload_rollbacks": counts.get("router_reload_rollbacks", 0),
             "latency_ms_p50": _percentile(lat, 0.50),

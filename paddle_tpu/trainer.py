@@ -376,7 +376,34 @@ class Trainer(object):
                             batches = pipe
                         else:
                             batches = reader()
+                        last_iter_t = None
+                        feed_wait_seen = 0.0
+                        commit_ms_last = 0.0
                         for batch_id, data in enumerate(batches):
+                            # the gray-failure heartbeat: the wall
+                            # delta between iteration starts (reader
+                            # wait + dispatch + any injected stall —
+                            # the async pipeline makes a batch-timer-
+                            # only number blind to these) MINUS the
+                            # commit/checkpoint span: that is
+                            # legitimate per-role overhead (only the
+                            # lease owner pays it), not gray slowness —
+                            # the step watchdog pauses around it for
+                            # the same reason
+                            now_t = time.monotonic()
+                            if worker is not None and \
+                                    last_iter_t is not None:
+                                fw = None
+                                if pipe is not None:
+                                    total = pipe.stats["feed_wait_ms"]
+                                    fw = total - feed_wait_seen
+                                    feed_wait_seen = total
+                                worker.publish_heartbeat(
+                                    max((now_t - last_iter_t) * 1e3
+                                        - commit_ms_last, 0.0),
+                                    feed_wait_ms=fw)
+                            last_iter_t = now_t
+                            commit_ms_last = 0.0
                             handler(BeginIteration(pass_id, batch_id))
                             if watchdog is not None:
                                 watchdog.ping("pass%d/batch%d"
@@ -422,8 +449,11 @@ class Trainer(object):
                                 # step deadline pauses around it
                                 if watchdog is not None:
                                     watchdog.disarm()
+                                commit_t0 = time.monotonic()
                                 counted = worker.commit(cost=cost,
                                                         skipped=skipped)
+                                commit_ms_last = (time.monotonic()
+                                                  - commit_t0) * 1e3
                                 if watchdog is not None:
                                     watchdog.arm("pass%d/batch%d/next"
                                                  % (pass_id, batch_id))

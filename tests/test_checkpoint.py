@@ -156,3 +156,48 @@ def test_trainer_resumes_from_sharded_checkpoint(tmp_path):
         np.testing.assert_allclose(
             np.asarray(pt.global_scope().find_var("tr_w")), w_saved,
             rtol=1e-6)
+
+
+# -- retention ordering: step number first, mtime only as tiebreak ----------
+
+def _fake_retained(root, step, mtime=None):
+    """A minimal COMPLETE retention entry (empty checkpoint): enough
+    for the ordering walk, cheap enough to make many."""
+    import json
+    d = os.path.join(root, "ckpt-%08d" % step)
+    os.makedirs(d)
+    with open(os.path.join(d, "_COMPLETE"), "w") as f:
+        json.dump({"sizes": {}}, f)
+    if mtime is not None:
+        os.utime(d, (mtime, mtime))
+    return d
+
+
+def test_retention_order_is_step_first_mtime_tiebreak(tmp_path):
+    """A coarse-mtime filesystem can stamp two same-second saves
+    identically — or even mis-order them. The step parsed from the
+    ckpt-<step> name is authoritative for 'newest' and for the
+    corruption-fallback walk; mtime only breaks ties."""
+    import time
+    root = str(tmp_path)
+    now = time.time()
+    d1 = _fake_retained(root, 1, now)
+    d2 = _fake_retained(root, 2, now)
+    d3 = _fake_retained(root, 3, now)
+    # mis-stamped: the HIGHEST step carries the OLDEST mtime
+    os.utime(d3, (now - 5, now - 5))
+    assert checkpoint.latest_checkpoint(root) == d3
+    assert checkpoint._previous_complete(d3) == d2
+    assert checkpoint._previous_complete(d2) == d1
+    assert checkpoint._previous_complete(d1) is None
+
+
+def test_prune_keeps_highest_steps_not_newest_mtimes(tmp_path):
+    import time
+    root = str(tmp_path)
+    now = time.time()
+    dirs = {s: _fake_retained(root, s, now) for s in (1, 2, 3, 4)}
+    os.utime(dirs[4], (now - 60, now - 60))  # newest step, oldest mtime
+    checkpoint._prune(root, keep_last=2)
+    assert sorted(os.listdir(root)) == ["ckpt-00000003",
+                                        "ckpt-00000004"]

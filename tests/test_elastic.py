@@ -497,6 +497,95 @@ def test_supervisor_heartbeat_fault_is_counted_not_fatal(tmp_path):
     assert after >= before + 1
 
 
+def _gray_worker_script(tmp_path, slow_rank, slow_gens, slow_ms=900.0,
+                        iters=60):
+    """Workers that publish their own heartbeats: ``slow_rank`` reports
+    a step-time EWMA ~18x its peers while ``gen < slow_gens``, everyone
+    else (and every later generation) reports healthy 50 ms. The
+    supervisor sees exactly what a real Trainer-published heartbeat
+    stream would say, without the training loop's runtime."""
+    p = tmp_path / "gray_worker.py"
+    p.write_text(textwrap.dedent("""
+        import json, os, time
+        rank = int(os.environ["PADDLE_TPU_PROCESS_ID"])
+        gen = int(os.environ.get("PADDLE_TPU_ELASTIC_GENERATION", "0"))
+        state = os.environ["PADDLE_TPU_ELASTIC_STATE"]
+        slow = rank == %d and gen < %d
+        for i in range(%d):
+            hb = {"rank": rank, "generation": gen, "step": i,
+                  "step_ms_ewma": %r if slow else 50.0}
+            tmp = os.path.join(state, ".hb-%%d.tmp" %% rank)
+            with open(tmp, "w") as f:
+                json.dump(hb, f)
+            os.replace(tmp, os.path.join(
+                state, "heartbeat-rank%%d.json" %% rank))
+            time.sleep(0.1)
+    """ % (slow_rank, slow_gens, iters, slow_ms)))
+    return str(p)
+
+
+def test_supervisor_gray_restart_then_resize(tmp_path):
+    """The mitigation ladder: a persistently slow rank is condemned
+    from its heartbeats, spends the one transient restart, recurs, and
+    is demoted to a permanent loss (clean resize) — the post-resize
+    2-member world cannot condemn anyone (no majority) and the job
+    completes."""
+    script = _gray_worker_script(tmp_path, slow_rank=1, slow_gens=2)
+    sd = str(tmp_path / "state")
+    rc = ElasticSupervisor(3, "127.0.0.1", [script], min_workers=2,
+                           restart_budget=0, grace_sec=3.0, state_dir=sd,
+                           sweep_interval=0.1, gray_ratio=3.0,
+                           gray_budget=1).run()
+    assert rc == 0
+    mits = _events_of(sd, "gray_mitigated")
+    assert [(m["action"], m["rank"]) for m in mits] == \
+        [("restart", 1), ("resize", 1)]
+    assert _events_of(sd, "gray_suspected")
+    resizes = _events_of(sd, "elastic_resize")
+    assert len(resizes) == 1 and resizes[0]["gray"] is True
+    assert resizes[0]["rc"] is None  # nothing died: there IS no rc
+    assert [g["world"] for g in _events_of(sd, "elastic_generation")] \
+        == [3, 3, 2]
+    assert not _events_of(sd, "elastic_worker_exit")
+    assert _events_of(sd, "elastic_job_complete")
+
+
+def test_supervisor_gray_never_breaks_quorum(tmp_path):
+    """Budget spent and the world already at min_workers: the verdict
+    is recorded (gray_mitigation_skipped, reason=quorum) and the job
+    keeps running SLOW to completion — degraded beats dead."""
+    script = _gray_worker_script(tmp_path, slow_rank=1, slow_gens=99,
+                                 iters=30)
+    sd = str(tmp_path / "state")
+    rc = ElasticSupervisor(3, "127.0.0.1", [script], min_workers=3,
+                           restart_budget=0, grace_sec=3.0, state_dir=sd,
+                           sweep_interval=0.1, gray_ratio=3.0,
+                           gray_budget=0).run()
+    assert rc == 0
+    skips = _events_of(sd, "gray_mitigation_skipped")
+    assert skips and skips[0]["reason"] == "quorum" \
+        and skips[0]["rank"] == 1
+    assert not _events_of(sd, "gray_mitigated")
+    assert not _events_of(sd, "elastic_resize")
+    assert _events_of(sd, "elastic_job_complete")
+
+
+def test_supervisor_gray_quiet_on_healthy_gang(tmp_path):
+    """The flap pin at the supervisor tier: identical healthy
+    heartbeats with detection armed produce ZERO gray events."""
+    script = _gray_worker_script(tmp_path, slow_rank=0, slow_gens=0,
+                                 iters=20)
+    sd = str(tmp_path / "state")
+    rc = ElasticSupervisor(3, "127.0.0.1", [script], min_workers=2,
+                           restart_budget=0, grace_sec=3.0, state_dir=sd,
+                           sweep_interval=0.1, gray_ratio=3.0,
+                           gray_budget=1).run()
+    assert rc == 0
+    assert not _events_of(sd, "gray_suspected")
+    assert not _events_of(sd, "gray_mitigated")
+    assert not _events_of(sd, "gray_mitigation_skipped")
+
+
 def test_launch_fail_fast_escalates_hung_worker(tmp_path):
     # rank 0 ignores SIGTERM (a worker wedged in a dead collective);
     # rank 1 fails -> launch must SIGKILL past grace and return the

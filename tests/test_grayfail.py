@@ -1,0 +1,232 @@
+"""Detector math for gray-failure skew detection (resilience/grayfail).
+
+Pins the degenerate-case guarantees the module doc promises: warmup
+gating, MAD=0 safety, single-member safety, the oscillation flap
+guard, and verdict hysteresis / per-direction cooldown boundaries —
+the contract both the elastic supervisor and the serving router build
+mitigation policy on.
+"""
+import pytest
+
+from paddle_tpu.resilience.grayfail import (
+    CONDEMNED, HEALTHY, SUSPECT, GrayVerdict, SkewDetector)
+
+
+def feed(det, samples, n=1):
+    """Observe {member: value} n times."""
+    for _ in range(n):
+        for m, v in samples.items():
+            det.observe(m, v)
+
+
+def drive(det, samples, evals, n_obs=1):
+    """n_obs observations then one evaluate, repeated; returns the
+    last verdict map."""
+    out = {}
+    for _ in range(evals):
+        feed(det, samples, n=n_obs)
+        out = det.evaluate()
+    return out
+
+
+def test_window_shorter_than_warmup_is_not_judged():
+    det = SkewDetector(warmup=3, window=8, suspect_after=1,
+                       condemn_after=1)
+    feed(det, {0: 10.0, 1: 10.0, 2: 500.0}, n=2)  # below warmup
+    verdicts = det.evaluate()
+    assert verdicts == {}
+    assert det.verdict(2) == HEALTHY
+    # one more sample each and the same skew is judged
+    feed(det, {0: 10.0, 1: 10.0, 2: 500.0})
+    verdicts = det.evaluate()
+    assert verdicts[2].state != HEALTHY
+
+
+def test_all_members_equal_mad_zero_condemns_nobody():
+    det = SkewDetector(suspect_after=1, condemn_after=1, warmup=1)
+    for value in (25.0, 0.0):  # including baseline 0: no div-by-zero
+        det = SkewDetector(suspect_after=1, condemn_after=1, warmup=1)
+        verdicts = drive(det, {m: value for m in range(4)}, evals=6)
+        assert len(verdicts) == 4
+        assert all(v.state == HEALTHY for v in verdicts.values())
+        assert all(v.streak == 0 for v in verdicts.values())
+
+
+def test_single_member_population_never_condemned():
+    det = SkewDetector(warmup=1, suspect_after=1, condemn_after=1)
+    verdicts = drive(det, {0: 9999.0}, evals=10)
+    assert verdicts[0].state == HEALTHY
+    assert det.condemned() == []
+
+
+def test_two_member_population_cannot_pick_an_outlier():
+    # the cross-member median of a pair splits it: neither member can
+    # clear a ratio bar anchored at the midpoint — condemnation needs
+    # at least two honest peers.
+    det = SkewDetector(warmup=1, suspect_after=1, condemn_after=2)
+    verdicts = drive(det, {0: 10.0, 1: 1000.0}, evals=8)
+    assert all(v.state == HEALTHY for v in verdicts.values())
+
+
+def test_sustained_outlier_escalates_to_condemned():
+    det = SkewDetector(warmup=2, suspect_after=2, condemn_after=4,
+                       clear_cooldown=0)
+    feed(det, {0: 10.0, 1: 11.0, 2: 10.0, 3: 200.0})  # warm up first
+    states = []
+    for _ in range(6):
+        feed(det, {0: 10.0, 1: 11.0, 2: 10.0, 3: 200.0})
+        states.append(det.evaluate()[3].state)
+    assert states[0] == HEALTHY          # streak 1 < suspect_after
+    assert states[1] == SUSPECT          # streak 2
+    assert states[3] == CONDEMNED        # streak 4
+    assert det.condemned() == [3]
+    # healthy peers untouched
+    assert det.verdict(0) == HEALTHY
+    # the verdict carries the judgement evidence
+    v = det.evaluate()
+    assert isinstance(v[3], GrayVerdict)
+    assert v[3].stat > v[3].threshold >= v[3].baseline
+
+
+def test_oscillating_metric_accumulates_no_streak():
+    # the flap guard: a member whose statistic oscillates across
+    # EVALUATIONS (slow one pass, clean the next — a periodic GC
+    # pause, a checkpoint cadence) breaches only on alternating
+    # ticks, and every clean tick resets the consecutive-breach
+    # streak — with suspect_after=2 no streak ever accumulates.
+    det = SkewDetector(warmup=1, window=1, suspect_after=2,
+                       condemn_after=3)
+    for i in range(24):
+        slow = 400.0 if i % 2 else 10.0
+        feed(det, {0: 10.0, 1: 12.0, 2: 11.0, 3: slow})
+        verdicts = det.evaluate()
+        assert verdicts[3].state == HEALTHY
+    assert det.suspects() == []
+
+
+def test_mild_oscillation_smoothed_away_by_window_median():
+    # oscillation FASTER than the evaluation cadence lands whole in
+    # one window; the window median sits at the cohort's scale and a
+    # member bouncing around the baseline never breaches the ratio
+    # bar.
+    det = SkewDetector(warmup=4, window=8, suspect_after=1,
+                       condemn_after=2)
+    for i in range(20):
+        bouncy = 25.0 if i % 2 else 8.0   # median ~16, ratio bar ~31
+        feed(det, {0: 10.0, 1: 12.0, 2: 11.0, 3: bouncy})
+        verdicts = det.evaluate()
+    assert verdicts[3].state == HEALTHY
+    assert det.suspects() == []
+
+
+def test_streak_resets_on_single_clean_evaluation():
+    det = SkewDetector(warmup=1, window=1, suspect_after=3,
+                       condemn_after=6)
+    base = {0: 10.0, 1: 10.0, 2: 10.0}
+    feed(det, {**base, 3: 500.0})
+    assert det.evaluate()[3].streak == 1
+    feed(det, {**base, 3: 500.0})
+    assert det.evaluate()[3].streak == 2
+    feed(det, {**base, 3: 10.0})   # one clean window
+    assert det.evaluate()[3].streak == 0
+    feed(det, {**base, 3: 500.0})
+    assert det.evaluate()[3].streak == 1  # starts over, no memory
+
+
+def test_hysteresis_requires_clear_streak_to_deescalate():
+    det = SkewDetector(warmup=1, window=1, suspect_after=1,
+                       condemn_after=10, clear_after=3,
+                       escalate_cooldown=0, clear_cooldown=0)
+    base = {0: 10.0, 1: 10.0, 2: 10.0}
+    drive(det, {**base, 3: 500.0}, evals=2)
+    assert det.verdict(3) == SUSPECT
+    # one or two clean evaluations are NOT enough (clear_after=3)
+    drive(det, {**base, 3: 10.0}, evals=2)
+    assert det.verdict(3) == SUSPECT
+    drive(det, {**base, 3: 10.0}, evals=1)
+    assert det.verdict(3) == HEALTHY
+    # condemned de-escalates one step at a time: -> suspect first
+    det2 = SkewDetector(warmup=1, window=1, suspect_after=1,
+                        condemn_after=2, clear_after=2,
+                        escalate_cooldown=0, clear_cooldown=0)
+    drive(det2, {**base, 3: 500.0}, evals=3)
+    assert det2.verdict(3) == CONDEMNED
+    drive(det2, {**base, 3: 10.0}, evals=2)
+    assert det2.verdict(3) == SUSPECT
+    drive(det2, {**base, 3: 10.0}, evals=2)
+    assert det2.verdict(3) == HEALTHY
+
+
+def test_clear_cooldown_blocks_immediate_deescalation():
+    det = SkewDetector(warmup=1, window=1, suspect_after=1,
+                       condemn_after=10, clear_after=1,
+                       escalate_cooldown=0, clear_cooldown=3)
+    base = {0: 10.0, 1: 10.0, 2: 10.0}
+    drive(det, {**base, 3: 500.0}, evals=1)
+    assert det.verdict(3) == SUSPECT          # escalated at tick 1
+    drive(det, {**base, 3: 10.0}, evals=2)  # ticks 2,3 in cooldown
+    assert det.verdict(3) == SUSPECT
+    drive(det, {**base, 3: 10.0}, evals=1)  # tick 4: cooldown over
+    assert det.verdict(3) == HEALTHY
+
+
+def test_escalate_cooldown_blocks_immediate_reescalation():
+    det = SkewDetector(warmup=1, window=1, suspect_after=1,
+                       condemn_after=10, clear_after=1,
+                       escalate_cooldown=3, clear_cooldown=0)
+    base = {0: 10.0, 1: 10.0, 2: 10.0}
+    drive(det, {**base, 3: 500.0}, evals=1)
+    drive(det, {**base, 3: 10.0}, evals=1)
+    assert det.verdict(3) == HEALTHY          # cleared at tick 2
+    drive(det, {**base, 3: 500.0}, evals=2)  # ticks 3,4 in cooldown
+    assert det.verdict(3) == HEALTHY
+    drive(det, {**base, 3: 500.0}, evals=1)  # tick 5: cooldown over
+    assert det.verdict(3) == SUSPECT
+
+
+def test_changed_flag_fires_exactly_on_transitions():
+    det = SkewDetector(warmup=1, window=1, suspect_after=2,
+                       condemn_after=4, clear_cooldown=0)
+    base = {0: 10.0, 1: 10.0, 2: 10.0}
+    changes = []
+    for _ in range(6):
+        feed(det, {**base, 3: 500.0})
+        v = det.evaluate()[3]
+        changes.append((v.state, v.changed))
+    assert changes.count((SUSPECT, True)) == 1
+    assert changes.count((CONDEMNED, True)) == 1
+    assert not any(ch for st, ch in changes if st == HEALTHY)
+
+
+def test_forget_drops_history_and_verdict():
+    det = SkewDetector(warmup=1, window=1, suspect_after=1,
+                       condemn_after=2)
+    base = {0: 10.0, 1: 10.0, 2: 10.0}
+    drive(det, {**base, 3: 500.0}, evals=3)
+    assert det.verdict(3) == CONDEMNED
+    det.forget(3)
+    assert det.verdict(3) == HEALTHY
+    assert 3 not in det.members()
+    # a fresh process under the same key starts clean
+    feed(det, {**base, 3: 10.0})
+    assert det.evaluate()[3].state == HEALTHY
+
+
+def test_constructor_rejects_nonsense():
+    with pytest.raises(ValueError):
+        SkewDetector(ratio=1.0)
+    with pytest.raises(ValueError):
+        SkewDetector(window=2, warmup=3)
+    with pytest.raises(ValueError):
+        SkewDetector(suspect_after=5, condemn_after=2)
+
+
+def test_median_of_slow_majority_cannot_hide_in_mean():
+    # robust baseline: one slow member cannot drag the baseline up —
+    # medians, not means. 4 fast + 1 slow: baseline sits at the fast
+    # cohort and the slow member is condemned.
+    det = SkewDetector(warmup=1, suspect_after=1, condemn_after=2)
+    verdicts = drive(det, {0: 10.0, 1: 11.0, 2: 9.0, 3: 10.0,
+                           4: 300.0}, evals=4)
+    assert verdicts[4].state == CONDEMNED
+    assert all(verdicts[m].state == HEALTHY for m in range(4))

@@ -308,6 +308,64 @@ def test_trainer_kill_and_resume(tmp_path):
     assert losses[3] < losses[0], losses
 
 
+def test_master_client_concurrent_calls_never_cross_responses():
+    """ONE MasterClient connection used from two threads (the elastic
+    worker's reality under pipeline=True: the feed thread leases while
+    the main thread commits) must serialize request/response pairs —
+    crossed frames made a successful FIN read a GET's reply, a spurious
+    lease-lost that silently dropped a row from the exactly-once audit
+    trail."""
+    import threading
+
+    native = pytest.importorskip("paddle_tpu.native")
+    if not native.available():
+        pytest.skip("no native toolchain")
+    m = native.TaskMaster(failure_max=3, timeout_sec=60.0)
+    n_tasks = 200
+    for i in range(n_tasks):
+        m.add_task(b"t%d" % i)
+    port = m.serve(0)
+    cli = native.MasterClient("127.0.0.1", port)
+    leased = []
+    lease_done = threading.Event()
+    errors = []
+
+    def _leaser():
+        try:
+            while True:
+                tid, payload = cli.get_task()
+                if tid is None:
+                    break
+                if tid == "wait":
+                    continue
+                assert payload.startswith(b"t"), payload
+                leased.append(tid)
+        except Exception as e:          # pragma: no cover - failure path
+            errors.append(repr(e))
+        finally:
+            lease_done.set()
+
+    t = threading.Thread(target=_leaser, daemon=True)
+    t.start()
+    finished = 0
+    spurious = []
+    while finished < n_tasks and not lease_done.is_set() or leased:
+        if not leased:
+            continue
+        tid = leased.pop(0)
+        if cli.task_finished(tid):
+            finished += 1
+        else:
+            spurious.append(tid)
+    t.join(timeout=30.0)
+    cli.close()
+    m.close()
+    assert not errors, errors
+    assert not spurious, ("crossed responses: %d spurious lease losses %r"
+                          % (len(spurious), spurious[:5]))
+    assert finished == n_tasks, finished
+
+
 def test_master_serve_stop_with_open_connection():
     """close() must not deadlock while a client connection is still open
     (handler threads parked in read() are shut down before joining)."""

@@ -158,6 +158,7 @@ class _FakeHandler(BaseHTTPRequestHandler):
         self.rfile.read(n)
         cfg = self.server.cfg
         self.server.posts.append(self.path)
+        time.sleep(cfg.get("post_delay", 0.0))   # a gray-slow replica
         status, payload = cfg.get("post", (200, {"outputs": [[0.0]],
                                                  "version": 1}))
         self._reply(status, payload)
@@ -827,6 +828,222 @@ def test_pool_budget_resets_after_healthy_uptime(art_v1):
     pool._replicas[0] = rep2
     pool._maybe_reset_budget(rep2)
     assert pool._sup.used(0) == 2
+
+
+# -- gray failures + hedging --------------------------------------------------
+
+def _seed_latency(r, lat_by_index):
+    """Plant per-replica proxied-latency EWMAs the poller will judge —
+    the unit-level stand-in for real traffic having flowed."""
+    with r._lock:
+        for idx, ewma in lat_by_index.items():
+            st = r._states[idx]
+            st.lat_ewma = float(ewma)
+            st.lat_n = max(st.lat_n, 1)
+
+
+def _gray_fleet(n=3, **router_kw):
+    fakes = [_fake_replica({"statz": {"pending": 0}}) for _ in range(n)]
+    router_kw.setdefault("gray_ratio", 3.0)
+    router_kw.setdefault("gray_hold_s", 60.0)
+    r = _router_over([f.server_address[1] for f in fakes], **router_kw)
+    return fakes, r
+
+
+def test_gray_latency_skew_ejects_despite_200_healthz():
+    """The tentpole's serving half: a replica whose /healthz answers
+    200 every poll but whose proxied latency sits far above its peers
+    is condemned by the skew detector and drained out of rotation —
+    with the long gray hold pinning it out until the hold expires."""
+    fakes, r = _gray_fleet()
+    try:
+        r.poll_once()
+        _seed_latency(r, {0: 10.0, 1: 10.0, 2: 500.0})
+        for _ in range(8):
+            r.poll_once()
+        st = r.stats()
+        assert st["replicas"]["2"]["ejected"] is True
+        assert st["replicas"]["2"]["gray_ejected"] is True
+        assert st["gray_ejects"] == 1
+        # ...while the replica's OWN health endpoint still says 200
+        s, body, _ = _get("http://127.0.0.1:%d/healthz"
+                          % fakes[2].server_address[1])
+        assert s == 200 and body["ok"] is True
+        assert {r.pick().index for _ in range(6)} <= {0, 1}
+        sus = resilience.events(kind="gray_suspected")
+        mit = resilience.events(kind="gray_mitigated")
+        assert len(sus) == 1 and sus[0]["replica"] == 2
+        assert len(mit) == 1 and mit[0]["action"] == "eject"
+        assert mit[0]["metric"] == "proxied_latency_ewma_ms"
+        # the gray hold (60s here) blocks the healthz probation from
+        # readmitting a replica whose slowness was never re-measured
+        for _ in range(4):
+            r.poll_once()
+        assert r.stats()["replicas"]["2"]["ejected"] is True
+    finally:
+        r.close()
+        for f in fakes:
+            f.shutdown()
+
+
+def test_gray_hold_expiry_releases_into_probation():
+    fakes, r = _gray_fleet(gray_hold_s=0.05, readmit_after=2)
+    try:
+        r.poll_once()
+        _seed_latency(r, {0: 10.0, 1: 10.0, 2: 500.0})
+        for _ in range(8):
+            r.poll_once()
+        assert r.stats()["replicas"]["2"]["gray_ejected"] is True
+        time.sleep(0.06)
+        # replica recovered while ejected; the detector's record of it
+        # is forgotten on release, so the fresh EWMA judges it anew
+        _seed_latency(r, {2: 10.0})
+        r.poll_once()                 # hold expired: released, streak 1
+        st = r.stats()["replicas"]["2"]
+        assert st["gray_ejected"] is False
+        assert st["ejected"] is True  # still in probation
+        r.poll_once()                 # streak 2 == readmit_after
+        assert r.stats()["replicas"]["2"]["ejected"] is False
+        assert r.stats()["gray_readmits"] == 1
+        # back in rotation and healthy: no further gray events
+        for _ in range(6):
+            r.poll_once()
+        assert len(resilience.events(kind="gray_mitigated")) == 1
+    finally:
+        r.close()
+        for f in fakes:
+            f.shutdown()
+
+
+def test_gray_never_ejects_last_routable_replica():
+    """A slow answer beats no answer: when everyone else is draining,
+    the condemned verdict is NOT acted on."""
+    fakes, r = _gray_fleet()
+    try:
+        r.poll_once()
+        _seed_latency(r, {0: 10.0, 1: 10.0, 2: 500.0})
+        for _ in range(3):            # warmup + suspect, not condemned
+            r.poll_once()
+        assert not r.stats()["replicas"]["2"]["ejected"]
+        r.set_draining(0, True)
+        r.set_draining(1, True)
+        for _ in range(6):            # verdict turns condemned here
+            r.poll_once()
+        assert r.stats()["replicas"]["2"]["ejected"] is False
+        assert r.stats()["gray_ejects"] == 0
+        assert resilience.events(kind="gray_mitigated") == []
+        assert r.pick().index == 2
+    finally:
+        r.close()
+        for f in fakes:
+            f.shutdown()
+
+
+def test_gray_flap_guard_and_healthy_fleet_zero_events():
+    """Mild latency oscillation (bouncing inside the ratio bar) must
+    never condemn, and an evenly-matched fleet must record ZERO gray
+    events — the flap-guard pin at the serving tier."""
+    fakes, r = _gray_fleet()
+    try:
+        r.poll_once()
+        for i in range(12):           # flapper bounces 8 <-> 25
+            _seed_latency(r, {0: 10.0, 1: 11.0,
+                              2: 25.0 if i % 2 else 8.0})
+            r.poll_once()
+        assert not any(s["ejected"]
+                       for s in r.stats()["replicas"].values())
+        assert resilience.events(kind="gray_suspected") == []
+        assert resilience.events(kind="gray_mitigated") == []
+        # perfectly even fleet: still nothing
+        _seed_latency(r, {0: 10.0, 1: 10.0, 2: 10.0})
+        for _ in range(8):
+            r.poll_once()
+        assert resilience.events(kind="gray_suspected") == []
+        assert r.stats()["gray_ejects"] == 0
+    finally:
+        r.close()
+        for f in fakes:
+            f.shutdown()
+
+
+def test_hedge_fires_past_deadline_and_first_answer_wins():
+    """An idempotent :predict stuck on a slow primary fires ONE hedged
+    attempt at the next-best replica after the hedge deadline; the
+    hedge's answer comes back first and wins — the client never waits
+    out the slow replica."""
+    slow = _fake_replica({"statz": {"pending": 0}, "post_delay": 0.8})
+    fast = _fake_replica({"statz": {"pending": 0}})
+    try:
+        r = _router_over([slow.server_address[1],
+                          fast.server_address[1]],
+                         hedge_budget=1.0, hedge_min_ms=40.0)
+        r.poll_once()
+        t0 = time.monotonic()
+        # score tiebreak picks index 0 — the slow primary — first
+        status, body, rep = r.proxy("/v1/models/m:predict",
+                                    {"inputs": {}})
+        took = time.monotonic() - t0
+        assert status == 200 and rep == 1
+        assert took < 0.6, "first answer did not win (%.2fs)" % took
+        st = r.stats()
+        assert st["hedges"] == 1 and st["hedge_wins"] == 1
+        from paddle_tpu import profiler
+        assert profiler.grayfail_counters()["router_hedges"] >= 1
+    finally:
+        r.close()
+        slow.shutdown()
+        fast.shutdown()
+
+
+def test_generate_is_never_hedged():
+    """:generate is NOT idempotent (decode state, sampling) — a slow
+    generate rides out its primary, no hedge, no duplicate side
+    effects."""
+    slow = _fake_replica({"statz": {"pending": 0}, "post_delay": 0.3})
+    fast = _fake_replica({"statz": {"pending": 0}})
+    try:
+        r = _router_over([slow.server_address[1],
+                          fast.server_address[1]],
+                         hedge_budget=1.0, hedge_min_ms=40.0)
+        r.poll_once()
+        status, body, rep = r.proxy("/v1/models/m:generate",
+                                    {"prompt": "x"})
+        assert status == 200 and rep == 0   # waited out the primary
+        assert r.stats()["hedges"] == 0
+    finally:
+        r.close()
+        slow.shutdown()
+        fast.shutdown()
+
+
+def test_hedge_budget_caps_traffic_fraction():
+    """hedge_budget=0.5 over 4 slow requests allows exactly 2 hedges
+    ((fired+1) <= budget x proxied at each decision point) — tail
+    chasing is bounded, it can never double the fleet's load."""
+    slow = _fake_replica({"statz": {"pending": 0}, "post_delay": 0.3})
+    fast = _fake_replica({"statz": {"pending": 0}})
+    try:
+        r = _router_over([slow.server_address[1],
+                          fast.server_address[1]],
+                         hedge_budget=0.5, hedge_min_ms=30.0)
+        r.poll_once()
+        for _ in range(4):
+            status, _, _ = r.proxy("/v1/models/m:predict",
+                                   {"inputs": {}})
+            assert status == 200
+            # let the abandoned slow primary settle so every request's
+            # pick lands on the (inflight-free) slow replica again —
+            # the budget arithmetic below needs all 4 to want a hedge
+            time.sleep(0.35)
+        st = r.stats()
+        assert st["proxied"] == 4
+        assert st["hedges"] == 2
+        assert st["hedges"] <= st["hedge_budget"] * st["proxied"]
+        assert st["hedge_wins"] == st["hedges"]
+    finally:
+        r.close()
+        slow.shutdown()
+        fast.shutdown()
 
 
 def test_static_pool_and_replica_shapes():

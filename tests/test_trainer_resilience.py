@@ -89,7 +89,7 @@ def test_site_table_matches_docstring_table():
 
 
 def test_every_armable_site_arms_and_fires():
-    for site, (_, armable) in faults.SITE_TABLE.items():
+    for site, (_, armable, _delay) in faults.SITE_TABLE.items():
         if not armable:
             continue
         faults.arm(site, "raise", nth=1, times=1)
@@ -101,7 +101,7 @@ def test_every_armable_site_arms_and_fires():
 
 
 def test_sites_exist_at_documented_modules():
-    for site, (module, armable) in faults.SITE_TABLE.items():
+    for site, (module, armable, _delay) in faults.SITE_TABLE.items():
         path = os.path.join(REPO, "paddle_tpu", module)
         assert os.path.isfile(path), \
             "%s documents module %s which does not exist" % (site, module)
@@ -122,6 +122,69 @@ def test_every_site_documented_in_cluster_readme():
     missing = [s for s in faults.SITE_TABLE if s not in readme]
     assert not missing, \
         "cluster/README.md has no row for fault site(s) %r" % missing
+
+
+def test_delay_marked_sites_document_delay_semantics():
+    """A site the gray chaos legs delay-arm must say what a delay
+    MEANS in its docstring row — the mark in SITE_TABLE is a claim
+    about the docs, so the docs must hold it."""
+    rows = re.split(r"^``", faults.__doc__, flags=re.MULTILINE)
+    doc_of = {}
+    for row in rows:
+        m = re.match(r"([a-z_0-9]+\.[a-z_0-9]+)``", row)
+        if m:
+            doc_of[m.group(1)] = row
+    for site, (_m, _armable, delay_doc) in faults.SITE_TABLE.items():
+        if delay_doc:
+            assert "delay" in doc_of.get(site, ""), \
+                "site %r is marked delay_documented but its docstring " \
+                "row never mentions delay semantics" % site
+    # the gray legs' actual levers must be marked
+    for site in ("trainer.step", "serving.dispatch", "serving.generate",
+                 "serving.route"):
+        assert faults.SITE_TABLE[site][2], \
+            "gray chaos lever %r lost its delay_documented mark" % site
+
+
+# the gray-failure event vocabulary: every kind the detector tiers emit
+# must have a row in the operator docs — doc/elasticity.md covers the
+# training tier, doc/serving.md the serving tier, cluster/README.md
+# both (the chaos-operations face)
+GRAY_EVENT_DOCS = {
+    "gray_suspected": ("doc/elasticity.md", "doc/serving.md",
+                       "cluster/README.md"),
+    "gray_mitigated": ("doc/elasticity.md", "doc/serving.md",
+                       "cluster/README.md"),
+    "gray_mitigation_skipped": ("doc/elasticity.md",
+                                "cluster/README.md"),
+}
+
+
+def test_gray_events_documented_row_for_row():
+    for kind, docs in GRAY_EVENT_DOCS.items():
+        for rel in docs:
+            with open(os.path.join(REPO, rel)) as f:
+                text = f.read()
+            assert kind in text, \
+                "gray event %r has no row in %s" % (kind, rel)
+
+
+def test_gray_events_actually_emitted_by_the_code():
+    """The vocabulary above is not aspirational: each kind appears in
+    the module that claims to emit it."""
+    emitters = {
+        "gray_suspected": ("paddle_tpu/elastic/supervisor.py",
+                           "paddle_tpu/serving/router.py"),
+        "gray_mitigated": ("paddle_tpu/elastic/supervisor.py",
+                           "paddle_tpu/serving/router.py"),
+        "gray_mitigation_skipped": ("paddle_tpu/elastic/supervisor.py",),
+    }
+    for kind, modules in emitters.items():
+        for rel in modules:
+            with open(os.path.join(REPO, rel)) as f:
+                src = f.read()
+            assert kind in src, \
+                "%s never emits documented gray event %r" % (rel, kind)
 
 
 # ---------------------------------------------------------------------------

@@ -375,14 +375,21 @@ def test_v2_master_client_remote_two_workers(tmp_path):
     c1.set_dataset(paths)
     c2.set_dataset(paths)  # second registration is a no-op
     got = {0: [], 1: []}
+    # each worker leases its FIRST record before either drains: "both
+    # workers got work" must not hinge on thread-start timing (under a
+    # loaded single-CPU CI one thread can drain all four tiny tasks
+    # before the other is scheduled at all)
+    streams = {0: c1.records(), 1: c2.records()}
+    got[0].append(next(streams[0]))
+    got[1].append(next(streams[1]))
 
-    def worker(i, c):
+    def worker(i):
         # a worker with no leasable task blocks until pass end, so the two
         # workers must drain concurrently (the real deployment shape)
-        got[i] = list(c.records())
+        got[i].extend(streams[i])
 
-    ts = [threading.Thread(target=worker, args=(0, c1)),
-          threading.Thread(target=worker, args=(1, c2))]
+    ts = [threading.Thread(target=worker, args=(0,)),
+          threading.Thread(target=worker, args=(1,))]
     for t in ts:
         t.start()
     for t in ts:

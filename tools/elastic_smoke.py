@@ -34,7 +34,16 @@ The REAL-TRAINER legs (``mode="trainer"``: every rank runs
 (i) **step watchdog**: a seeded hung read trips ``step_timeout_s`` —
     recorded ``step_hung``, exit 75, exactly one TRANSIENT supervisor
     restart at full world (never a resize, never a wedged gang), every
-    task still exactly once.
+    task still exactly once;
+(j) **gray failure**: one rank is delay-armed SLOW (``CHAOS_SLOW_RANK``
+    — alive, exiting 0, just 30x over the gang median) — the
+    supervisor's SkewDetector condemns it from step-time heartbeats,
+    spends its one transient restart, then demotes the recurrence to
+    permanent (clean resize 3 -> 2), the pass completes exactly-once
+    and step time recovers; the healthy legs above double as the flap
+    pin: gray detection armed on (j) never fires on a well-behaved
+    gang (checked inside the leg — gen-2 post-resize world is
+    slow-free and records nothing).
 
 The measurement lives in benchmark/chaos_run.py — the same harness an
 operator points at a real TPU pod (cluster/README.md). Companion to
@@ -157,6 +166,24 @@ def main():
     for p in cr.check_watchdog(hang):
         failures.append("hang leg: %s" % p)
 
+    # (j): delay-armed slow rank -> gray condemned -> one transient
+    # restart -> recurrence resized away -> clean completion.
+    # CHAOS_SLOW_GENS=2 keeps the lever armed through the restart so
+    # the budget-spent path (demote to permanent) is exercised too;
+    # generation 2 runs slow-free and must record no gray events.
+    gray = cr.run_chaos(
+        tempfile.mkdtemp(prefix="elastic_smoke_gray_"),
+        nprocs=3, tasks=12, kill_rank=None, elastic=True,
+        mode="trainer", min_workers=2, gray_ratio=3.0, gray_budget=1,
+        extra_env={"CHAOS_SLOW_RANK": "0", "CHAOS_SLOW_DELAY": "2.0",
+                   "CHAOS_SLOW_GENS": "2"}, timeout=480)
+    if gray["rc"] != 0:
+        failures.append("gray leg exit code %d" % gray["rc"])
+    for p in cr.check_grayfail(gray, slow_rank=0, delay_s=2.0):
+        failures.append("gray leg: %s" % p)
+    for p in cr.check_exactly_once(gray):
+        failures.append("gray leg exactly_once: %s" % p)
+
     eff = cr.effective_timeline(chaos["rows"])
     summary = {
         "ok": not failures,
@@ -183,6 +210,9 @@ def main():
                             if e["kind"] == "guard_rewind"]),
         "hang_restarts": len([e for e in hang["events"]
                               if e["kind"] == "elastic_restart"]),
+        "gray_mitigations": [
+            (e.get("action"), e.get("rank")) for e in gray["events"]
+            if e["kind"] == "gray_mitigated"],
         "state_dir": chaos_state,
     }
     print(json.dumps(summary))

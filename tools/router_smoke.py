@@ -9,6 +9,15 @@ reload rolled back with the fleet serving intact. The router's p99 must
 come back finite, and completed predict payloads must match the known
 closed form of whichever artifact version legitimately answered.
 
+The GRAY leg (benchmark/load_bench.py ``gray_leg``): a 3-replica fleet
+with one replica delay-armed consistently slow while its ``/healthz``
+stays 200 — the router's latency SkewDetector must eject it mid-flood
+(``gray_mitigated`` action=eject, /healthz of the condemned replica
+verified 200 at that moment), budgeted hedges must fire on ``:predict``
+tails (> 0 and under ``hedge_budget`` x proxied), the post-ejection
+p99 must measurably recover, and zero requests may be lost through the
+whole episode.
+
 The measurement lives in benchmark/load_bench.py — ONE implementation
 shared by this gate and the banked evidence record, so the criteria
 cannot drift. Invoked by tools/router_smoke.sh (one retry damps
@@ -34,13 +43,14 @@ THREADS = 6
 
 
 def main():
-    from benchmark.load_bench import bench
+    from benchmark.load_bench import bench, gray_leg
 
     root = tempfile.mkdtemp(prefix="paddle_tpu_router_smoke_")
     try:
         s = bench(root, replicas=REPLICAS, n_predict=PREDICT,
                   n_generate=GENERATE, threads=THREADS,
                   balance=False)
+        g = gray_leg(os.path.join(root, "gray"), threads=THREADS)
     finally:
         shutil.rmtree(root, ignore_errors=True)
 
@@ -82,6 +92,29 @@ def main():
     if not (p99 > 0 and math.isfinite(p99)):
         failures.append("router p99 not finite: %r" % p99)
 
+    # ---- the gray leg ----------------------------------------------------
+    if not g["ejected_in_time"]:
+        failures.append("gray: slow replica was never latency-ejected")
+    if g["condemned_healthz"] != 200:
+        failures.append("gray: condemned replica /healthz was %r, the "
+                        "leg only proves anything if binary health saw "
+                        "nothing" % (g["condemned_healthz"],))
+    if g["gray_ejects"] < 1:
+        failures.append("gray: no router_gray_ejects counted")
+    if g["lost_total"] != 0:
+        failures.append("gray: lost %d requests through the episode"
+                        % g["lost_total"])
+    if not g["p99_recovered"]:
+        failures.append("gray: p99 did not recover after ejection "
+                        "(A=%.2fms B=%.2fms)"
+                        % (g["p99_a_ms"], g["p99_b_ms"]))
+    if g["hedges"] < 1:
+        failures.append("gray: no hedged attempts fired")
+    if g["hedges"] > g["hedge_budget"] * max(g["proxied_a"], 1) + 1:
+        failures.append("gray: %d hedges exceed the %.2f budget over "
+                        "%d proxied" % (g["hedges"], g["hedge_budget"],
+                                        g["proxied_a"]))
+
     summary = {
         "ok": not failures,
         "replicas": REPLICAS,
@@ -99,6 +132,16 @@ def main():
         "fleet_intact_after_bad_reload":
             s.get("fleet_intact_after_bad_reload"),
         "per_replica_completed": flood["per_replica_completed"],
+        "gray": {
+            "ejected_in_time": g["ejected_in_time"],
+            "condemned_healthz": g["condemned_healthz"],
+            "gray_ejects": g["gray_ejects"],
+            "hedges": g["hedges"],
+            "hedge_wins": g["hedge_wins"],
+            "p99_a_ms": g["p99_a_ms"],
+            "p99_b_ms": g["p99_b_ms"],
+            "lost": g["lost_total"],
+        },
     }
     print(json.dumps(summary))
     if failures:
