@@ -23,9 +23,11 @@ Distributed-correctness companions (this package, beyond the Program
 walk): :mod:`.comm_rules` (PT020-PT023 collective consistency),
 :mod:`.memory` (PT030-PT034 static memory planner: liveness-based
 peak-HBM lint, the Executor's pre-compile OOM preflight, KV-pool
-sizing), :mod:`.sanitize` (donation-aliasing sanitizer,
-``PADDLE_TPU_SANITIZE=alias``), :mod:`.locks` (lock-order race
-detector, ``PADDLE_TPU_SANITIZE=locks``).
+sizing), :mod:`.sharding` (PT040-PT045 static sharding analyzer:
+PartitionSpec propagation, implicit-reshard pricing, the SpecLayout
+collective-vocabulary audit), :mod:`.sanitize` (donation-aliasing
+sanitizer, ``PADDLE_TPU_SANITIZE=alias``), :mod:`.locks` (lock-order
+race detector, ``PADDLE_TPU_SANITIZE=locks``).
 """
 from .diagnostics import (  # noqa: F401
     Diagnostic, ProgramVerifyError, Severity, render_diagnostics,
@@ -38,6 +40,10 @@ from . import rules  # noqa: F401  (registers the built-in PT rules)
 from .rules import mark_pipeline_stages  # noqa: F401
 from . import comm_rules  # noqa: F401
 from . import memory  # noqa: F401
+from . import sharding  # noqa: F401
+from .sharding import (  # noqa: F401
+    ShardingPlan, check_sharding, verify_sharding_or_raise,
+)
 from .sanitize import SanitizeError, sanitize_modes  # noqa: F401
 from . import sanitize  # noqa: F401
 from . import locks  # noqa: F401
@@ -47,5 +53,7 @@ __all__ = [
     "Rule", "ProgramFacts", "STRUCTURAL_CODES", "check_after_pass",
     "register_rule", "registered_rules", "resolve_rules", "verify",
     "verify_or_raise", "rules", "mark_pipeline_stages", "comm_rules",
-    "memory", "SanitizeError", "sanitize_modes", "sanitize", "locks",
+    "memory", "sharding", "ShardingPlan", "check_sharding",
+    "verify_sharding_or_raise", "SanitizeError", "sanitize_modes",
+    "sanitize", "locks",
 ]

@@ -122,11 +122,18 @@ def collective_sequence(plan, policy, axis_size,
 
 
 def schedule_fingerprint(plan, policy, axis_size, overlap: bool = False,
-                         schedule: Optional[Sequence[int]] = None) -> str:
+                         schedule: Optional[Sequence[int]] = None,
+                         sharding: Optional[str] = None) -> str:
     """Digest of the full collective program: the ordered sequence plus
     the (world, policy) inputs and the topology groups. Two replicas
     whose fingerprints match will issue the same collectives in the
-    same order over the same axis groups."""
+    same order over the same axis groups.
+
+    ``sharding`` folds in the sharded-collective vocabulary
+    (``analysis.sharding.sharding_fingerprint`` — the PT044 currency:
+    all-gather-on-use / reduce-scatter-grad sequences implied by the
+    SpecLayout) so the cross-replica exchange also refuses a peer whose
+    specs diverge, not just one whose bucket schedule does."""
     from ..comm.hierarchical import topology_groups
     seq = collective_sequence(plan, policy, axis_size, overlap=overlap,
                               schedule=schedule)
@@ -135,6 +142,8 @@ def schedule_fingerprint(plan, policy, axis_size, overlap: bool = False,
     groups = (topology_groups(hosts, axis_size // hosts)
               if hosts >= 1 and axis_size % hosts == 0 else None)
     blob = repr((int(axis_size), policy.key(), bool(overlap), seq, groups))
+    if sharding is not None:
+        blob = repr((blob, str(sharding)))
     return hashlib.sha1(blob.encode("utf-8")).hexdigest()
 
 
@@ -297,7 +306,7 @@ def check_replica_fingerprints(fingerprints) -> List[Diagnostic]:
 
 
 def verify_comm(template, policy=None, axis_size=None, overlap=None,
-                schedule=None, expect_fingerprint=None
+                schedule=None, expect_fingerprint=None, sharding=None
                 ) -> Tuple[List[Diagnostic], Optional[str]]:
     """Run the full collective-consistency pass over ONE replica's
     inputs: the grads ``template`` (pytree of arrays or
@@ -309,6 +318,9 @@ def verify_comm(template, policy=None, axis_size=None, overlap=None,
     ``schedule`` is a declared issue order to validate (PT020/PT023);
     ``expect_fingerprint`` is a peer replica's fingerprint (PT020).
     ``overlap=None`` resolves from ``FLAGS.comm_overlap``.
+    ``sharding`` is an optional ``analysis.sharding.sharding_fingerprint``
+    folded into the digest (the PT044 vocabulary): replicas must then
+    also agree on the sharded-collective program their specs imply.
     """
     from .. import comm
     if axis_size is None:
@@ -328,6 +340,8 @@ def verify_comm(template, policy=None, axis_size=None, overlap=None,
         blob = repr((axis_size, policy.key(),
                      [(str(np.dtype(jnp.result_type(l))),
                        tuple(np.shape(l))) for l in leaves]))
+        if sharding is not None:
+            blob = repr((blob, str(sharding)))
         fp = hashlib.sha1(blob.encode("utf-8")).hexdigest()
         if expect_fingerprint is not None and expect_fingerprint != fp:
             diags += check_replica_fingerprints(
@@ -358,14 +372,15 @@ def verify_comm(template, policy=None, axis_size=None, overlap=None,
             hint="derive the issue order from BucketPlan (declaration "
                  "order, or backward_schedule under overlap); never "
                  "permute it locally"))
-    fp = schedule_fingerprint(plan, policy, axis_size, overlap=overlap)
+    fp = schedule_fingerprint(plan, policy, axis_size, overlap=overlap,
+                              sharding=sharding)
     # determinism leg: a second build from the same inputs must produce
     # the same sequence — if it does not, something replica-local (and
     # run-local) leaked into the plan
     try:
         plan2 = _build_plan(template, policy, axis_size)
         fp2 = schedule_fingerprint(plan2, policy, axis_size,
-                                   overlap=overlap)
+                                   overlap=overlap, sharding=sharding)
     except Exception:
         fp2 = None
     if fp2 is not None and fp2 != fp:

@@ -324,7 +324,8 @@ def _vmem_scratch(program, batch):
 
 
 def plan_memory(program: ir.Program, batch=None, fetches=None, dp=1,
-                sizes_override=None, vmem=True) -> MemoryPlan:
+                sizes_override=None, vmem=True, specs=None,
+                mesh_shape=None) -> MemoryPlan:
     """Build the residency timeline for ``program``.
 
     ``batch`` substitutes the feed wildcard dim (-1); ``dp`` models a
@@ -333,10 +334,23 @@ def plan_memory(program: ir.Program, batch=None, fetches=None, dp=1,
     those vars' residency to the step end (the executor materialises
     them at the boundary). ``sizes_override`` maps var name -> exact
     nbytes (the Executor preflight passes real array sizes for state
-    and feeds, replacing the declared-shape estimate)."""
+    and feeds, replacing the declared-shape estimate).
+
+    ``specs`` + ``mesh_shape`` (a propagated spec table from
+    ``analysis.sharding`` and the axis-name -> size mesh) price sharded
+    residency: a persistable var with a spec divides by its shard
+    factor instead of being assumed replicated — PT030 then reflects
+    the FSDP layout instead of refusing programs that actually fit."""
     fetches = set(f.name if isinstance(f, ir.Variable) else f
                   for f in (fetches or ()))
     sizes_override = sizes_override or {}
+    shard_div = {}
+    if specs and mesh_shape:
+        from ..parallel.spec_layout import normalize_spec, shard_factor
+        for name, spec in specs.items():
+            f = shard_factor(normalize_spec(spec), mesh_shape)
+            if f > 1:
+                shard_div[name] = f
     per_dev_batch = batch
     if batch is not None and dp and dp > 1:
         per_dev_batch = -(-int(batch) // int(dp))
@@ -373,6 +387,11 @@ def plan_memory(program: ir.Program, batch=None, fetches=None, dp=1,
             nbytes, exact = 0, False
         else:
             nbytes, exact = _var_nbytes(v, per_dev_batch)
+        if persistable and name in shard_div:
+            # sharded residency: each device holds 1/f of the tensor
+            # (batch-dim division via ``dp`` covers the non-persistable
+            # classes; persistable state shards by its PartitionSpec)
+            nbytes //= shard_div[name]
         if not exact:
             unknown.append(name)
         if persistable:
@@ -423,15 +442,18 @@ def _diag(code, message, severity=Severity.ERROR, **kw):
 
 def check_memory(program: ir.Program, budget_bytes=None, batch=None,
                  fetches=None, dp=1, plan=None, sizes_override=None,
-                 donation_min_bytes=DONATION_MIN_BYTES, vmem=True
+                 donation_min_bytes=DONATION_MIN_BYTES, vmem=True,
+                 specs=None, mesh_shape=None
                  ) -> Tuple[MemoryPlan, List[Diagnostic]]:
     """The full static memory pass: build (or reuse) the plan, return
     ``(plan, diagnostics)`` for PT030-PT033. ``vmem=False`` skips the
     kernel-scratch pricing (display-only; the preflight's hot path
-    drops it)."""
+    drops it). ``specs``/``mesh_shape`` price sharded persistable
+    residency (see :func:`plan_memory`)."""
     if plan is None:
         plan = plan_memory(program, batch=batch, fetches=fetches, dp=dp,
-                           sizes_override=sizes_override, vmem=vmem)
+                           sizes_override=sizes_override, vmem=vmem,
+                           specs=specs, mesh_shape=mesh_shape)
     diags: List[Diagnostic] = []
 
     # PT033 first: it qualifies the PT030 verdict (lower bound)
@@ -617,7 +639,8 @@ def measure_live_bytes() -> int:
 
 def verify_memory_or_raise(program, budget_bytes, batch=None, fetches=None,
                            dp=1, sizes_override=None, context=None,
-                           vmem=False) -> MemoryPlan:
+                           vmem=False, specs=None,
+                           mesh_shape=None) -> MemoryPlan:
     """The Executor preflight: run :func:`check_memory` and raise ONE
     readable :class:`ProgramVerifyError` — residency table included —
     when the predicted peak exceeds the budget, BEFORE any XLA compile
@@ -627,7 +650,8 @@ def verify_memory_or_raise(program, budget_bytes, batch=None, fetches=None,
     compile."""
     plan, diags = check_memory(program, budget_bytes=budget_bytes,
                                batch=batch, fetches=fetches, dp=dp,
-                               sizes_override=sizes_override, vmem=vmem)
+                               sizes_override=sizes_override, vmem=vmem,
+                               specs=specs, mesh_shape=mesh_shape)
     errors = [d for d in diags if d.is_error]
     if errors:
         ctx = context or "memory preflight"

@@ -1,0 +1,850 @@
+"""Static sharding analyzer: PartitionSpec propagation over the Program IR.
+
+Under GSPMD a wrong or missing spec does not *fail* — the partitioner
+silently inserts resharding collectives whose wire cost can dwarf the
+planned schedules.  The one tool that can catch that before paying for a
+compile is a static pass: one walk over the Program IR flows the declared
+PartitionSpecs (``program._shardings``, feed defaults, and the canonical
+``parallel.spec_layout`` table for spec-less parameters) through op
+semantics — elementwise preserves, matmul contracts the shared axis,
+transpose/reshape/concat remap dims — and reports:
+
+- **PT040** spec/mesh validity: unknown axis name, a known dim not
+  divisible by its axes' sizes, one mesh axis used twice in a spec.
+- **PT041** implicit reshard: operands meet at an op with incompatible
+  propagated specs; the finding names the resharding collective GSPMD
+  would insert and its wire bytes (ring formulas, the comm bytes model).
+- **PT042** a large (>= 1 MiB) persistable tensor left fully replicated
+  on a mesh that carries a non-data axis — the FSDP miss.
+- **PT043** declared-vs-propagated conflict: a ``_shardings`` entry the
+  dataflow contradicts (the declaration wins for further propagation).
+- **PT044** sharded collective-vocabulary audit, extending PT020-PT023:
+  the all-gather-on-use / reduce-scatter-grad sequence must be a pure
+  function of (world, SpecLayout) — grad and param specs diverging at an
+  optimizer update, a non-deterministic rebuild, or a peer fingerprint
+  mismatch all break that contract.
+- **PT045** resize safety: a dim sharded over the data axis that cannot
+  re-factorise at ``FLAGS.elastic_min_workers`` — caught at lint time,
+  not mid-resize.
+
+Entry points::
+
+    plan, diags = check_sharding(program, mesh_shape={"dp": 4, "fsdp": 2})
+    verify_sharding_or_raise(program, mesh_shape=..., context="...")
+    seq = plan.collectives            # the PT044 vocabulary
+    fp  = plan.fingerprint            # folds into schedule_fingerprint
+
+Cost: one linear IR walk (O(ops + vars)) per plan — run once per lint /
+fresh compile / resize, never per step.  **Honest limits**: propagation
+models op *semantics*, not XLA's full SPMD partitioner — where the remap
+is ambiguous (rank-changing reshapes, flattened matmul groups mixing
+sharded dims) the pass conservatively drops to replicated rather than
+guess, so it can miss resharding XLA would insert but never invents one
+that is not implied by the specs it was given.  Backward ops are priced
+by co-sharding (``x@GRAD`` follows ``x``), not re-derived.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional, Tuple
+
+from ..core import ir
+from ..parallel.spec_layout import (DATA_AXIS_ALIASES, SpecLayout,
+                                    classify_params, layout_table,
+                                    normalize_spec, restrict_spec,
+                                    shard_factor, spec_axes)
+from .diagnostics import Diagnostic, ProgramVerifyError, Severity
+from .memory import _var_nbytes, flatten_ops, fmt_bytes
+
+__all__ = [
+    "SHARDING_CODES", "REPLICATED_MIN_BYTES", "ShardingPlan",
+    "check_sharding", "verify_sharding_or_raise", "propagate_shardings",
+    "sharded_collective_sequence", "sharding_fingerprint",
+    "reshard_bytes", "fmt_spec",
+]
+
+SHARDING_CODES = ("PT040", "PT041", "PT042", "PT043", "PT044", "PT045")
+
+# PT042 threshold: below this a replicated tensor is noise, not a miss
+# (same rung as memory.DONATION_MIN_BYTES).
+REPLICATED_MIN_BYTES = 1 << 20
+
+# Ops whose inputs must agree per aligned dim (output takes the merge).
+_ELEMENTWISE = frozenset((
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_max", "elementwise_min",
+    "elementwise_pow", "elementwise_mod", "sum",
+))
+
+# Contraction ops: X @ Y with Y a (K, N) weight.
+_MATMUL = frozenset(("mul", "matmul", "matmul_v2"))
+
+
+def fmt_spec(entries) -> str:
+    """Human spelling of a normalised spec: P('dp', ('fsdp','tp'), None)."""
+    entries = normalize_spec(entries)
+    if not any(entries):
+        return "replicated"
+    parts = []
+    for e in entries:
+        if not e:
+            parts.append("None")
+        elif len(e) == 1:
+            parts.append("'%s'" % e[0])
+        else:
+            parts.append("(%s)" % ", ".join("'%s'" % a for a in e))
+    return "P(%s)" % ", ".join(parts)
+
+
+def _ring_bytes(payload: int, n: int) -> int:
+    """Ring all-gather / reduce-scatter wire bytes for a FULL-tensor
+    payload over n ranks: (n-1)/n * payload (parallel.accounting)."""
+    if n <= 1:
+        return 0
+    return (n - 1) * payload // n
+
+
+def reshard_bytes(nbytes: int, from_spec, to_spec, mesh_shape
+                  ) -> Tuple[int, str]:
+    """(wire bytes, collective) GSPMD would insert to re-lay a tensor.
+
+    Model, honestly simple: axes sharded in ``from`` but absent in
+    ``to`` are all-gathered (ring, full-tensor payload); axes present
+    in both but on a different dim move via all-to-all (priced like a
+    ring pass over the moved axes); axes only in ``to`` are a free
+    dynamic-slice.
+    """
+    from_spec = normalize_spec(from_spec)
+    to_spec = normalize_spec(to_spec)
+    f = {}
+    t = {}
+    for d, axes in enumerate(from_spec):
+        for a in axes:
+            f[a] = d
+    for d, axes in enumerate(to_spec):
+        for a in axes:
+            t[a] = d
+    gathered = sorted(a for a in f if a not in t)
+    moved = sorted(a for a in f if a in t and t[a] != f[a])
+    total = 0
+    parts = []
+    n = 1
+    for a in gathered:
+        n *= int(mesh_shape.get(a, 1))
+    if n > 1:
+        total += _ring_bytes(nbytes, n)
+        parts.append("all-gather(%s)" % ",".join(gathered))
+    n = 1
+    for a in moved:
+        n *= int(mesh_shape.get(a, 1))
+    if n > 1:
+        total += _ring_bytes(nbytes, n)
+        parts.append("all-to-all(%s)" % ",".join(moved))
+    if not parts:
+        return 0, "dynamic-slice"
+    return total, "+".join(parts)
+
+
+def _diag(code, message, severity=Severity.ERROR, **kw):
+    return Diagnostic(code=code, severity=severity, message=message, **kw)
+
+
+def _align(entries, ndim):
+    """Right-align a lower-rank operand's entries to ``ndim`` dims
+    (numpy broadcasting: a rank-1 bias rides the last dim)."""
+    entries = tuple(entries)
+    if len(entries) >= ndim:
+        return entries[len(entries) - ndim:] if ndim else ()
+    return ((),) * (ndim - len(entries)) + entries
+
+
+class _Prop(object):
+    """One propagation walk: env of var -> (normalised spec, provenance)."""
+
+    def __init__(self, program, mesh_shape, layout, declared, diags):
+        self.program = program
+        self.mesh = dict(mesh_shape)
+        self.layout = layout
+        self.declared = declared      # name -> normalised spec
+        self.diags = diags
+        self.env: Dict[str, Tuple[Tuple[str, ...], ...]] = {}
+        self.provenance: Dict[str, str] = {}
+        self.reshard_events: List[dict] = []
+
+    # -- helpers -----------------------------------------------------------
+    def _var(self, block, name):
+        return block._find_var_recursive(name)
+
+    def spec_of(self, name, ndim=None):
+        s = self.env.get(name, ())
+        return normalize_spec(s, ndim) if ndim is not None else s
+
+    @staticmethod
+    def _conflicts(a, b):
+        """Per-dim conflict: both sharded, differently — or one mesh axis
+        living on different dims of the two specs."""
+        ndim = max(len(a), len(b))
+        a = _align(a, ndim)
+        b = _align(b, ndim)
+        for ea, eb in zip(a, b):
+            if ea and eb and ea != eb:
+                return True
+        pos_a = {ax: d for d, axes in enumerate(a) for ax in axes}
+        pos_b = {ax: d for d, axes in enumerate(b) for ax in axes}
+        for ax, d in pos_a.items():
+            if ax in pos_b and pos_b[ax] != d:
+                return True
+        return False
+
+    def _price(self, block, name, from_spec, to_spec):
+        v = self._var(block, name)
+        nbytes, _exact = _var_nbytes(v, None) if v is not None else (0, False)
+        return reshard_bytes(nbytes, from_spec, to_spec, self.mesh)
+
+    def _emit_reshard(self, block, op_idx, op, name, have, want, why):
+        bytes_, coll = self._price(block, name, have, want)
+        self.reshard_events.append({
+            "var": name, "op": op.type, "block_idx": block.idx,
+            "op_idx": op_idx, "from": fmt_spec(have), "to": fmt_spec(want),
+            "collective": coll, "bytes": bytes_,
+        })
+        self.diags.append(_diag(
+            "PT041",
+            "implicit reshard at %s: %s — '%s' arrives %s but meets %s; "
+            "GSPMD inserts %s moving %s on the wire"
+            % (op.type, why, name, fmt_spec(have), fmt_spec(want),
+               coll, fmt_bytes(bytes_)),
+            block_idx=block.idx, op_idx=op_idx, var=name,
+            hint="align the specs (program._shardings / SpecLayout) or "
+                 "insert the reshard deliberately where it is cheapest"))
+
+    # -- transfer functions ------------------------------------------------
+    def _merge_inputs(self, block, op_idx, op, names):
+        """Aligned merge of several operands' specs (elementwise/sum).
+        Replicated-vs-sharded is a free dynamic-slice; sharded-vs-
+        differently-sharded is PT041.  Returns the merged spec at the
+        rank of the widest operand."""
+        specs = []
+        ndim = 0
+        for n in names:
+            v = self._var(block, n)
+            r = len(v.shape) if (v is not None and v.shape is not None) else 0
+            ndim = max(ndim, r)
+            specs.append((n, self.spec_of(n)))
+        merged = [()] * ndim
+        owner = [None] * ndim
+        used = {}  # axis -> dim it already shards in the merge
+        for n, s in specs:
+            s = _align(s, ndim)
+            for d in range(ndim):
+                if not s[d]:
+                    continue
+                if merged[d] and merged[d] != s[d]:
+                    self._emit_reshard(
+                        block, op_idx, op, n,
+                        self.spec_of(n), tuple(merged),
+                        "operand '%s' is %s on dim %d"
+                        % (owner[d], fmt_spec(tuple(merged)), d))
+                    continue  # first operand wins, like the partitioner
+                if not merged[d]:
+                    clash = next((ax for ax in s[d]
+                                  if used.get(ax, d) != d), None)
+                    if clash is not None:
+                        # one mesh axis on two different dims across the
+                        # operands: GSPMD must move it — all-to-all.
+                        self._emit_reshard(
+                            block, op_idx, op, n,
+                            self.spec_of(n), tuple(merged),
+                            "axis '%s' already shards dim %d"
+                            % (clash, used[clash]))
+                        continue
+                    merged[d] = s[d]
+                    owner[d] = n
+                    for ax in s[d]:
+                        used[ax] = d
+        return tuple(merged)
+
+    def _group_axis(self, entries, lo, hi):
+        """The single axis set sharding dims [lo, hi) when they flatten
+        into one matmul group; () when unsharded, None when ambiguous
+        (several sharded dims in the group — conservative bail)."""
+        found = ()
+        for d in range(lo, min(hi, len(entries))):
+            if entries[d]:
+                if found:
+                    return None
+                found = entries[d]
+        return found
+
+    def transfer(self, block, op_idx, op):
+        t = op.type
+        outs = {}
+
+        if t.endswith("_grad"):
+            # co-sharding: x@GRAD follows x; anything else replicated.
+            for name in op.output_arg_names:
+                if name.endswith(ir.GRAD_SUFFIX):
+                    base = name[:-len(ir.GRAD_SUFFIX)]
+                    if base in self.env:
+                        outs[name] = self.env[base]
+                        self.provenance.setdefault(name, "grad-of:%s" % base)
+                        continue
+                outs.setdefault(name, ())
+            return outs
+
+        ins = op.inputs
+        if "Param" in ins and "Grad" in ins and op.output_arg_names:
+            # optimizer update: the reduce-scatter-grad contract — grad
+            # spec must equal param spec or the PT044 vocabulary is not
+            # a function of (world, SpecLayout).
+            pname = ins["Param"][0] if ins["Param"] else None
+            gname = ins["Grad"][0] if ins["Grad"] else None
+            pspec = self.spec_of(pname) if pname else ()
+            gspec = self.spec_of(gname) if gname else ()
+            if pname and gname and self._conflicts(pspec, gspec):
+                self.diags.append(_diag(
+                    "PT044",
+                    "sharded-collective contract broken at %s: param '%s' "
+                    "is %s but its grad arrives %s — the reduce-scatter-"
+                    "grad / all-gather-on-use sequence is no longer a pure "
+                    "function of (world, SpecLayout)"
+                    % (t, pname, fmt_spec(pspec), fmt_spec(gspec)),
+                    block_idx=block.idx, op_idx=op_idx, var=gname,
+                    hint="co-shard the gradient with its parameter "
+                         "(DistributeTranspiler does this by construction)"))
+            for name in op.output_arg_names:
+                outs[name] = pspec
+            return outs
+
+        if t in _ELEMENTWISE:
+            names = [n for n in op.input_arg_names if self._var(block, n)]
+            merged = self._merge_inputs(block, op_idx, op, names)
+            for name in op.output_arg_names:
+                outs[name] = merged
+            return outs
+
+        if t in _MATMUL:
+            xs = ins.get("X", ())
+            ys = ins.get("Y", ())
+            xname = xs[0] if xs else None
+            yname = ys[0] if ys else None
+            xv = self._var(block, xname) if xname else None
+            yv = self._var(block, yname) if yname else None
+            xr = len(xv.shape) if (xv is not None and xv.shape) else 2
+            yr = len(yv.shape) if (yv is not None and yv.shape) else 2
+            xspec = self.spec_of(xname, xr) if xname else ()
+            yspec = self.spec_of(yname, yr) if yname else ()
+            ncol = int(op.attr("x_num_col_dims", 1) or 1)
+            row = self._group_axis(xspec, 0, ncol)
+            xk = self._group_axis(xspec, ncol, xr)
+            yk = yspec[0] if yspec else ()
+            yn = yspec[1] if len(yspec) > 1 else ()
+            if row is None or xk is None:
+                row, xk = (), ()  # ambiguous flatten: conservative bail
+            if xk and yk and xk != yk:
+                self._emit_reshard(
+                    block, op_idx, op, xname, xspec,
+                    (row,) + ((),) * (max(xr - ncol, 1) - 1) + (yk,),
+                    "contraction dims disagree ('%s' K is %s)"
+                    % (yname, fmt_spec((yk,))))
+                xk = yk
+            # sharded contraction == planned all-reduce (megatron), not a
+            # finding. Output: (row, yn); one axis on both sides -> keep row.
+            out_n = yn if (yn and yn != row) else ()
+            for name in op.output_arg_names:
+                v = self._var(block, name)
+                r = len(v.shape) if (v is not None and v.shape) else 2
+                outs[name] = _align((row,) + ((),) * max(r - 2, 0) + (out_n,),
+                                    r) if r >= 2 else (row,)
+            return outs
+
+        if "conv" in t and "Filter" in ins:
+            inp = ins.get("Input", ins.get("X", ()))
+            iname = inp[0] if inp else None
+            fname = ins["Filter"][0] if ins["Filter"] else None
+            iv = self._var(block, iname) if iname else None
+            ir_ = len(iv.shape) if (iv is not None and iv.shape) else 4
+            ispec = self.spec_of(iname, ir_) if iname else ((),) * 4
+            fspec = self.spec_of(fname, 4) if fname else ((),) * 4
+            # contraction: input channels (dim 1) vs filter in-channels
+            # (dim 1); spatial support windows make spatial shards a
+            # halo-exchange we do not model (conservative: flag nothing,
+            # drop the shard on the output's spatial dims).
+            if len(ispec) > 1 and len(fspec) > 1 and ispec[1] and fspec[1] \
+                    and ispec[1] != fspec[1]:
+                self._emit_reshard(
+                    block, op_idx, op, iname, ispec,
+                    (ispec[0], fspec[1]) + ((),) * (ir_ - 2),
+                    "in-channel dims disagree ('%s' is %s)"
+                    % (fname, fmt_spec(fspec)))
+            ospec = (ispec[0] if ispec else (), fspec[0] if fspec else ())
+            for name in op.output_arg_names:
+                v = self._var(block, name)
+                r = len(v.shape) if (v is not None and v.shape) else 4
+                outs[name] = _align((ospec[0], ospec[1]) + ((),) * (r - 2), r) \
+                    if r >= 2 else ()
+            return outs
+
+        if t.startswith("lookup_table"):
+            w = ins.get("W", ())
+            idsn = (ins.get("Ids") or ins.get("X") or ())
+            wspec = self.spec_of(w[0], 2) if w else ((), ())
+            idspec = self.spec_of(idsn[0]) if idsn else ()
+            # row (vocab) shard contracts away in the gather; the output
+            # carries (ids dims..., emb dim spec).
+            lead = idspec[0] if idspec else ()
+            for name in op.output_arg_names:
+                v = self._var(block, name)
+                r = len(v.shape) if (v is not None and v.shape) else 2
+                outs[name] = _align((lead,) + ((),) * max(r - 2, 0)
+                                    + (wspec[1],), r)
+            return outs
+
+        if t in ("transpose", "transpose2"):
+            xs = ins.get("X", ())
+            xname = xs[0] if xs else None
+            perm = op.attr("axis", None) or op.attr("perm", None)
+            if xname and perm:
+                v = self._var(block, xname)
+                r = len(v.shape) if (v is not None and v.shape) else len(perm)
+                s = self.spec_of(xname, r)
+                permuted = tuple(s[p] if 0 <= p < len(s) else ()
+                                 for p in perm)
+                for name in op.output_arg_names:
+                    if not name.endswith("XShape"):
+                        outs[name] = permuted
+            for name in op.output_arg_names:
+                outs.setdefault(name, ())
+            return outs
+
+        if t == "concat":
+            names = [n for n in op.input_arg_names if self._var(block, n)]
+            axis = int(op.attr("axis", 0) or 0)
+            merged = list(self._merge_inputs(block, op_idx, op, names))
+            if 0 <= axis < len(merged) and merged[axis]:
+                # concatenating along a sharded dim is a gather per input
+                self._emit_reshard(
+                    block, op_idx, op, names[0],
+                    tuple(merged), tuple(m if d != axis else ()
+                                         for d, m in enumerate(merged)),
+                    "concat axis %d is sharded" % axis)
+                merged[axis] = ()
+            for name in op.output_arg_names:
+                outs[name] = tuple(merged)
+            return outs
+
+        if t in ("reshape", "reshape2", "flatten", "flatten2",
+                 "squeeze", "squeeze2", "unsqueeze", "unsqueeze2"):
+            xs = ins.get("X", ())
+            xname = xs[0] if xs else None
+            if xname:
+                xv = self._var(block, xname)
+                s = self.spec_of(xname)
+                for name in op.output_arg_names:
+                    if name.endswith("XShape"):
+                        outs[name] = ()
+                        continue
+                    ov = self._var(block, name)
+                    keep = ()
+                    if (xv is not None and ov is not None and xv.shape and
+                            ov.shape and s and s[0] and
+                            xv.shape[0] == ov.shape[0]):
+                        # leading (batch) dim survives the reshape; the
+                        # rest is ambiguous -> replicated (honest limit).
+                        keep = s[0]
+                    ov_r = len(ov.shape) if (ov is not None and ov.shape) \
+                        else 1
+                    outs[name] = ((keep,) + ((),) * (ov_r - 1)) if ov_r \
+                        else ()
+            for name in op.output_arg_names:
+                outs.setdefault(name, ())
+            return outs
+
+        # default: one data input -> same-rank outputs inherit its spec;
+        # everything else replicated. Covers activations, scale, cast,
+        # pool (spatial shards already dropped at the conv), batch_norm
+        # (Y follows X; rank-1 stats replicated), softmax, dropout, ...
+        primary = None
+        for slot in ("X", "Input"):
+            if ins.get(slot):
+                primary = ins[slot][0]
+                break
+        if primary is None and len(op.input_arg_names) == 1:
+            primary = op.input_arg_names[0]
+        pspec = self.spec_of(primary) if primary else ()
+        pv = self._var(block, primary) if primary else None
+        pr = len(pv.shape) if (pv is not None and pv.shape) else None
+        for name in op.output_arg_names:
+            v = self._var(block, name)
+            r = len(v.shape) if (v is not None and v.shape) else None
+            if pspec and pr is not None and r == pr:
+                outs[name] = pspec
+            else:
+                outs[name] = ()
+        return outs
+
+
+class ShardingPlan(object):
+    """Result of one propagation walk, consumed by lint, accounting,
+    the Executor preflight, and ``elastic.replan``."""
+    __slots__ = ("mesh_shape", "specs", "provenance", "classes",
+                 "reshard_events", "collectives", "fingerprint",
+                 "min_workers", "layout", "_nbytes")
+
+    def __init__(self, mesh_shape, specs, provenance, classes,
+                 reshard_events, collectives, fingerprint, min_workers,
+                 layout):
+        self.mesh_shape = dict(mesh_shape)
+        self.specs = specs
+        self.provenance = provenance
+        self.classes = classes
+        self.reshard_events = reshard_events
+        self.collectives = collectives
+        self.fingerprint = fingerprint
+        self.min_workers = min_workers
+        self.layout = layout
+        self._nbytes = {}  # param name -> full bytes, filled by check_sharding
+
+    def total_reshard_bytes(self) -> int:
+        return sum(e["bytes"] for e in self.reshard_events)
+
+    def class_table(self) -> Dict[str, dict]:
+        """Per-parameter-class rollup: count, bytes (full and per-device
+        shard), the spec set — the accounting --sharding section."""
+        out: Dict[str, dict] = {}
+        for name, cls in sorted(self.classes.items()):
+            spec = normalize_spec(self.specs.get(name, ()))
+            nbytes = self._nbytes.get(name, 0)
+            f = shard_factor(spec, self.mesh_shape)
+            row = out.setdefault(cls, {
+                "count": 0, "bytes": 0, "sharded_bytes": 0, "specs": set()})
+            row["count"] += 1
+            row["bytes"] += nbytes
+            row["sharded_bytes"] += nbytes // f
+            row["specs"].add(fmt_spec(spec))
+        for row in out.values():
+            row["specs"] = sorted(row["specs"])
+        return out
+
+    def table(self) -> str:
+        """Rendered text table for verify context / lint output."""
+        mesh = "x".join("%s=%d" % kv for kv in sorted(self.mesh_shape.items()))
+        lines = ["sharding plan over mesh [%s]  fingerprint %s"
+                 % (mesh or "single-device", self.fingerprint[:12])]
+        ct = self.class_table()
+        for cls in sorted(ct):
+            row = ct[cls]
+            lines.append(
+                "  %-14s %3d param(s)  %10s full  %10s sharded  %s"
+                % (cls, row["count"], fmt_bytes(row["bytes"]),
+                   fmt_bytes(row["sharded_bytes"]), ", ".join(row["specs"])))
+        if self.reshard_events:
+            lines.append("  implicit reshards: %d, %s on the wire"
+                         % (len(self.reshard_events),
+                            fmt_bytes(self.total_reshard_bytes())))
+            for e in self.reshard_events[:5]:
+                lines.append("    block%d:op%d %s '%s' %s -> %s (%s, %s)"
+                             % (e["block_idx"], e["op_idx"], e["op"],
+                                e["var"], e["from"], e["to"],
+                                e["collective"], fmt_bytes(e["bytes"])))
+        else:
+            lines.append("  implicit reshards: none")
+        return "\n".join(lines)
+
+    def summary(self) -> dict:
+        """JSON-able summary (the accounting --sharding section)."""
+        return {
+            "mesh": dict(self.mesh_shape),
+            "fingerprint": self.fingerprint,
+            "classes": {
+                cls: {"count": row["count"], "bytes": row["bytes"],
+                      "sharded_bytes": row["sharded_bytes"],
+                      "specs": row["specs"]}
+                for cls, row in self.class_table().items()},
+            "reshard_events": list(self.reshard_events),
+            "reshard_bytes": self.total_reshard_bytes(),
+            "collectives": [list(c) for c in self.collectives],
+            "min_workers": self.min_workers,
+        }
+
+
+def sharded_collective_sequence(specs, mesh_shape, classes=None,
+                                data_axis=None, reshard_events=()):
+    """The deterministic collective vocabulary a (world, SpecLayout)
+    pair implies — PT044's currency, ordered canonically by name:
+    every parameter sharded over a non-data axis costs an
+    all-gather-on-use + a reduce-scatter-grad; every purely replicated
+    parameter on a data axis costs the classic grad all-reduce; every
+    implicit reshard rides along so divergent propagation also diverges
+    the fingerprint."""
+    mesh_shape = dict(mesh_shape)
+    if data_axis is None:
+        for cand in DATA_AXIS_ALIASES:
+            if cand in mesh_shape:
+                data_axis = cand
+                break
+    seq: List[Tuple] = []
+    for name in sorted(classes or specs):
+        spec = normalize_spec(specs.get(name, ()))
+        nondata = tuple(a for a in spec_axes(spec) if a != data_axis)
+        if nondata:
+            seq.append(("all-gather", name, nondata))
+            seq.append(("reduce-scatter", name + ir.GRAD_SUFFIX, nondata))
+        elif data_axis and int(mesh_shape.get(data_axis, 1)) > 1:
+            seq.append(("all-reduce", name + ir.GRAD_SUFFIX, (data_axis,)))
+    for e in reshard_events:
+        seq.append(("reshard", e["var"], e["collective"], e["bytes"]))
+    return seq
+
+
+def sharding_fingerprint(seq, mesh_shape) -> str:
+    """sha1 over the canonical collective vocabulary — equal
+    fingerprints == identical sharded-collective programs.  Folds into
+    ``comm_rules.schedule_fingerprint(..., sharding=...)`` so the
+    elastic fingerprint exchange learns the new vocabulary."""
+    blob = repr((sorted(dict(mesh_shape).items()), list(seq)))
+    return hashlib.sha1(blob.encode("utf-8")).hexdigest()
+
+
+def _validate_declared(name, var, spec, mesh_shape, diags):
+    """PT040: unknown axis / duplicate axis / non-dividing dim."""
+    entries = normalize_spec(spec)
+    seen = set()
+    ok = True
+    for d, axes in enumerate(entries):
+        factor = 1
+        for ax in axes:
+            if ax not in mesh_shape:
+                diags.append(_diag(
+                    "PT040",
+                    "spec for '%s' names mesh axis '%s' but the mesh has "
+                    "axes {%s}" % (name, ax, ", ".join(sorted(mesh_shape))),
+                    var=name,
+                    hint="fix the axis name or lint with the mesh this "
+                         "spec was written for (--mesh dp=4,fsdp=2,tp=2)"))
+                ok = False
+                continue
+            if ax in seen:
+                diags.append(_diag(
+                    "PT040",
+                    "spec for '%s' uses mesh axis '%s' twice — one axis "
+                    "can shard one dim" % (name, ax), var=name))
+                ok = False
+                continue
+            seen.add(ax)
+            factor *= int(mesh_shape[ax])
+        dim = None
+        if var is not None and var.shape is not None and d < len(var.shape):
+            dim = var.shape[d]
+        if dim is not None and dim >= 0 and factor > 1 and dim % factor != 0:
+            diags.append(_diag(
+                "PT040",
+                "spec for '%s' shards dim %d (size %d) %d-ways over %s — "
+                "not divisible, GSPMD would pad or reject"
+                % (name, d, dim, factor, fmt_spec((axes,))), var=name))
+            ok = False
+    return ok
+
+
+def propagate_shardings(program, mesh_shape, layout=None):
+    """Low-level walk: returns (prop, diags) with the full env.  Most
+    callers want ``check_sharding``."""
+    diags: List[Diagnostic] = []
+    layout = layout or SpecLayout()
+    mesh_shape = dict(mesh_shape or {})
+    gb = program.global_block()
+
+    declared_raw = getattr(program, "_shardings", None) or {}
+    declared = {}
+    for name, spec in declared_raw.items():
+        v = gb._find_var_recursive(name)
+        ndim = len(v.shape) if (v is not None and v.shape is not None) else None
+        entries = normalize_spec(spec, ndim)
+        _validate_declared(name, v, entries, mesh_shape, diags)
+        declared[name] = entries
+
+    prop = _Prop(program, mesh_shape, layout, declared, diags)
+
+    produced = set()
+    for _blk, _i, op in flatten_ops(program):
+        produced.update(op.output_arg_names)
+
+    params = {p.name: p for p in program.all_parameters()}
+    classes = classify_params(program)
+    table = layout_table(program, layout, mesh_shape)
+    data_axis = layout.data_axis_in(mesh_shape)
+
+    # -- seeds: declared beats layout beats co-sharding beats feed default
+    for name, var in gb.vars.items():
+        if name in declared:
+            prop.env[name] = declared[name]
+            prop.provenance[name] = "declared"
+        elif name in params:
+            prop.env[name] = table.get(name, ())
+            prop.provenance[name] = "layout:%s" % classes.get(name, "other")
+        elif getattr(var, "persistable", False):
+            owner = None
+            for pname in params:
+                if name.startswith(pname) and name != pname and \
+                        (owner is None or len(pname) > len(owner)):
+                    owner = pname
+            if owner is not None:
+                prop.env[name] = prop.env.get(
+                    owner, table.get(owner, ()))
+                prop.provenance[name] = "co-sharded:%s" % owner
+        elif name not in produced and var.shape:
+            # feed: dim0 (the batch) over the data axis when the mesh
+            # carries one; -1 wildcards assume the runtime picks a
+            # divisible per-device batch.
+            if data_axis and int(mesh_shape.get(data_axis, 1)) > 1:
+                d0 = var.shape[0]
+                if d0 is None or d0 < 0 or \
+                        d0 % int(mesh_shape[data_axis]) == 0:
+                    ndim = len(var.shape)
+                    prop.env[name] = ((data_axis,),) + ((),) * (ndim - 1)
+                    prop.provenance[name] = "feed"
+
+    # also seed declared specs for vars outside the global block
+    for name, entries in declared.items():
+        if name not in prop.env:
+            prop.env[name] = entries
+            prop.provenance[name] = "declared"
+
+    # -- the walk
+    for block, op_idx, op in flatten_ops(program):
+        outs = prop.transfer(block, op_idx, op)
+        for name, spec in outs.items():
+            spec = normalize_spec(spec)
+            if name in declared and name in produced:
+                decl = declared[name]
+                if prop._conflicts(decl, spec):
+                    diags.append(_diag(
+                        "PT043",
+                        "declared spec for '%s' is %s but dataflow "
+                        "propagates %s out of %s — the declaration "
+                        "contradicts the program (declaration kept)"
+                        % (name, fmt_spec(decl), fmt_spec(spec), op.type),
+                        block_idx=block.idx, op_idx=op_idx, var=name,
+                        hint="fix the _shardings entry or the producing "
+                             "op's operand specs"))
+                spec = decl
+            prev = prop.env.get(name)
+            prop.env[name] = spec
+            if prev is None or prev != spec:
+                prop.provenance.setdefault(
+                    name, "propagated:block%d:op%d" % (block.idx, op_idx))
+
+    return prop, diags, classes, data_axis
+
+
+def check_sharding(program, mesh_shape=None, layout=None, min_workers=None,
+                   expect_fingerprint=None):
+    """Run the full pass: returns ``(ShardingPlan, [Diagnostic])``."""
+    mesh_shape = dict(mesh_shape or getattr(program, "_mesh_axes", None)
+                      or {"dp": 1})
+    layout = layout or SpecLayout()
+    if min_workers is None:
+        from ..flags import FLAGS
+        min_workers = max(int(getattr(FLAGS, "elastic_min_workers", 1)), 1)
+
+    prop, diags, classes, data_axis = propagate_shardings(
+        program, mesh_shape, layout)
+    gb = program.global_block()
+
+    nbytes_cache: Dict[str, int] = {}
+
+    def nbytes_of(name):
+        if name not in nbytes_cache:
+            v = gb._find_var_recursive(name)
+            nbytes_cache[name] = _var_nbytes(v, None)[0] if v is not None \
+                else 0
+        return nbytes_cache[name]
+
+    # -- PT042: replicated large persistable tensors on a sharding mesh
+    nondata_ways = 1
+    for ax, size in mesh_shape.items():
+        if ax != data_axis:
+            nondata_ways *= int(size)
+    if nondata_ways > 1:
+        for name, var in sorted(gb.vars.items()):
+            if not getattr(var, "persistable", False):
+                continue
+            spec = normalize_spec(prop.env.get(name, ()))
+            if any(spec):
+                continue
+            nb = nbytes_of(name)
+            if nb >= REPLICATED_MIN_BYTES:
+                diags.append(_diag(
+                    "PT042",
+                    "'%s' (%s) is fully replicated on a mesh with %d "
+                    "non-data-axis devices — the FSDP miss: every device "
+                    "holds the full tensor"
+                    % (name, fmt_bytes(nb), nondata_ways),
+                    severity=Severity.WARNING, var=name,
+                    hint="give it a _shardings entry or let the "
+                         "SpecLayout table classify it"))
+
+    # -- PT045: resize safety at elastic_min_workers
+    if data_axis and min_workers > 1:
+        for name in sorted(prop.env):
+            v = gb._find_var_recursive(name)
+            if v is None or v.shape is None:
+                continue
+            spec = normalize_spec(prop.env[name], len(v.shape))
+            for d, axes in enumerate(spec):
+                if data_axis not in axes:
+                    continue
+                dim = v.shape[d]
+                if dim is not None and dim >= 0 and dim % min_workers != 0:
+                    diags.append(_diag(
+                        "PT045",
+                        "'%s' dim %d (size %d) is sharded over the data "
+                        "axis but does not re-factorise at "
+                        "elastic_min_workers=%d — an elastic resize to "
+                        "the floor would strand it"
+                        % (name, d, dim, min_workers), var=name,
+                        hint="pad the dim, raise elastic_min_workers, or "
+                             "replicate this tensor"))
+
+    # -- PT044: collective vocabulary, determinism + expectation legs
+    seq = sharded_collective_sequence(
+        prop.env, mesh_shape, classes=classes, data_axis=data_axis,
+        reshard_events=prop.reshard_events)
+    fp = sharding_fingerprint(seq, mesh_shape)
+    seq2 = sharded_collective_sequence(
+        prop.env, mesh_shape, classes=classes, data_axis=data_axis,
+        reshard_events=prop.reshard_events)
+    if sharding_fingerprint(seq2, mesh_shape) != fp:
+        diags.append(_diag(
+            "PT044",
+            "sharded-collective sequence is not deterministic: two "
+            "builds from identical (world, SpecLayout) differ"))
+    if expect_fingerprint is not None and expect_fingerprint != fp:
+        diags.append(_diag(
+            "PT044",
+            "sharding fingerprint %s does not match the expected %s — "
+            "this replica derives a different collective vocabulary from "
+            "the same (world, SpecLayout)" % (fp[:12],
+                                              expect_fingerprint[:12]),
+            hint="all ranks must agree on mesh axes and the SpecLayout "
+                 "table before the first collective"))
+
+    plan = ShardingPlan(mesh_shape, dict(prop.env), dict(prop.provenance),
+                        classes, list(prop.reshard_events), seq, fp,
+                        min_workers, layout)
+    plan._nbytes = {n: nbytes_of(n) for n in classes}
+    return plan, diags
+
+
+def verify_sharding_or_raise(program, mesh_shape=None, layout=None,
+                             min_workers=None, context="sharding verify"):
+    """Preflight: raise one readable ProgramVerifyError (with the plan
+    table as context) when the pass finds errors; returns
+    ``(plan, diags)`` — warnings are the caller's to surface."""
+    plan, diags = check_sharding(program, mesh_shape=mesh_shape,
+                                 layout=layout, min_workers=min_workers)
+    errors = [d for d in diags if d.is_error]
+    if errors:
+        raise ProgramVerifyError(
+            diags, context="%s\n%s" % (context, plan.table()))
+    return plan, diags

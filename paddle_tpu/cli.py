@@ -125,6 +125,36 @@ def _append_train_step(verb, spec, main, startup):
     return True
 
 
+def _parse_specs(pairs, verb):
+    """``--spec var=dim0,dim1,...`` entries -> {var: spec tuple}. Each
+    dim token is a mesh axis name, several joined with '+', or empty /
+    '-' for a replicated dim (``--spec "x=dp,tp"``,
+    ``--spec "w=fsdp+tp,-"``). Malformed entries are REJECTED with a
+    readable message (returns None) — silently skipping one would
+    verify different shardings than the operator seeded."""
+    out = {}
+    for pair in pairs or []:
+        name, eq, spec = pair.partition("=")
+        if not (eq and name.strip()):
+            print("%s: bad --spec entry %r (want var=axis,axis,... with "
+                  "empty or '-' for a replicated dim and '+' joining "
+                  "multi-axis dims, e.g. 'x=dp,tp' or 'w=fsdp+tp,-')"
+                  % (verb, pair))
+            return None
+        entries = []
+        for tok in spec.split(","):
+            tok = tok.strip()
+            if not tok or tok == "-":
+                entries.append(None)
+            elif "+" in tok:
+                entries.append(tuple(a.strip() for a in tok.split("+")
+                                     if a.strip()))
+            else:
+                entries.append(tok)
+        out[name.strip()] = tuple(entries)
+    return out
+
+
 def cmd_lint(args):
     """Statically verify the program a train config builds — same config
     contract as ``train`` (the file defines ``model()``) but nothing is
@@ -138,11 +168,19 @@ def cmd_lint(args):
     the liveness pass sees the full training step, and the predicted
     per-device peak is checked against ``--budget-gb`` /
     ``FLAGS.memory_budget_gb`` at ``--batch`` over ``--mesh dp=N``.
+    ``--sharding`` adds the static sharding analyzer (PT040-PT045):
+    PartitionSpec propagation over ``--mesh`` (e.g.
+    ``--mesh dp=4,fsdp=2,tp=2``), with ``--spec var=dp,tp`` overriding
+    or seeding individual entries; when it runs, the memory pass prices
+    sharded (not replicated) persistable state from the propagated
+    specs. ``--all`` runs every pass with one combined summary.
     Exit 0 clean / warnings-only, 1 on error diagnostics (or any
     diagnostic with --strict), 2 if the config itself fails to build."""
     import paddle_tpu as pt
     from paddle_tpu import analysis
 
+    if args.all:
+        args.comm = args.memory = args.sharding = True
     main, startup = pt.Program(), pt.Program()
     try:
         cfg = _load_config(args.config)
@@ -161,16 +199,44 @@ def cmd_lint(args):
     startup_diags = analysis.verify(startup)
     comm_diags = []
     memory_diags = []
+    sharding_diags = []
+    sharding_plan = None
     reports = [("main program", diags), ("startup program", startup_diags)]
+    train_step = None
+    if args.sharding:
+        from paddle_tpu.analysis import sharding as sharding_mod
+        mesh = _parse_mesh(args.mesh, "lint")
+        if mesh is None:
+            return 2
+        overrides = _parse_specs(getattr(args, "spec", None), "lint")
+        if overrides is None:
+            return 2
+        if overrides:
+            merged = dict(getattr(main, "_shardings", None) or {})
+            merged.update(overrides)
+            main._shardings = merged
+        # the spec question is about the TRAIN step too: grads must
+        # co-shard with their params (PT044) and the optimizer updates
+        # are where that contract is checked
+        train_step = _append_train_step("lint", spec, main, startup)
+        sharding_plan, sharding_diags = sharding_mod.check_sharding(
+            main, mesh_shape=mesh)
+        print("sharding pass (%s program):"
+              % ("train-step" if train_step else "forward-only"))
+        print(sharding_plan.table())
+        reports.append(("sharding pass", sharding_diags))
     if args.memory:
         from paddle_tpu.analysis import memory as memory_mod
         mesh = _parse_mesh(args.mesh, "lint")
         if mesh is None:
             return 2
+        shard_specs = sharding_plan.specs if sharding_plan is not None \
+            else (getattr(main, "_shardings", None) or None)
         ignored = sorted(a for a in mesh if a != "dp")
-        if ignored:
-            # the memory model shards the batch over dp only — saying
-            # so beats silently pricing a different mesh than asked
+        if ignored and not shard_specs:
+            # the batch shards over dp only — with no spec table the
+            # params price replicated; saying so beats silently pricing
+            # a different mesh than asked (run --sharding to fix)
             print("lint: --memory shards the batch over 'dp' only; "
                   "mesh axis(es) %s ignored (params priced replicated)"
                   % ", ".join(ignored))
@@ -178,12 +244,14 @@ def cmd_lint(args):
         # backward + optimizer ops so activations-to-backward and
         # gradient lifetimes are in the walk (the structural rules
         # above already ran on the as-built program)
-        train_step = _append_train_step("lint", spec, main, startup)
+        if train_step is None:
+            train_step = _append_train_step("lint", spec, main, startup)
         budget = memory_mod.resolve_budget_bytes(
             budget_gb=args.budget_gb or None)
         plan, memory_diags = memory_mod.check_memory(
             main, budget_bytes=budget, batch=args.batch,
-            fetches=fetches, dp=mesh.get("dp", 1))
+            fetches=fetches, dp=mesh.get("dp", 1),
+            specs=shard_specs, mesh_shape=mesh if shard_specs else None)
         print("memory pass (%s program):"
               % ("train-step" if train_step else "forward-only"))
         print(plan.table(budget))
@@ -219,16 +287,23 @@ def cmd_lint(args):
         # errors always fill red; the PT015+ dataflow/comm families
         # highlight at any severity — their findings are exactly the
         # ops a reader wants to see on the graph
-        bad_ops = {d.op_idx for d in diags
+        bad_ops = {d.op_idx for d in diags + sharding_diags
                    if d.block_idx == 0 and d.op_idx is not None
                    and (d.is_error or d.code >= "PT015")}
         debugger.draw_block_graphviz(main.global_block(),
                                      op_highlights=bad_ops, path=args.dot)
         print("lint: wrote %s (%d op(s) highlighted)"
               % (args.dot, len(bad_ops)))
-    all_diags = diags + startup_diags + comm_diags + memory_diags
+    all_diags = diags + startup_diags + comm_diags + memory_diags \
+        + sharding_diags
     failed = any(d.is_error for d in all_diags) \
         or (args.strict and all_diags)
+    if args.all:
+        errs = sum(1 for d in all_diags if d.is_error)
+        warns = len(all_diags) - errs
+        print("lint --all: %d pass(es), %d error(s), %d warning(s) -> %s"
+              % (len(reports), errs, warns,
+                 "FAIL" if failed else "clean"))
     return 1 if failed else 0
 
 
@@ -537,9 +612,12 @@ def cmd_accounting(args):
     activations / gradients / feeds and the predicted peak from the
     static memory planner (analysis.memory) at ``--batch``, the
     per-parameter-class sizing table the FSDP direction needs as
-    input. Pure analysis: nothing is compiled or executed, no devices
-    needed. Same config contract as ``train``/``lint`` (the file
-    defines ``model()``)."""
+    input. ``--sharding`` adds the propagated-PartitionSpec plan
+    (analysis.sharding): per-class spec table, fingerprint, priced
+    implicit reshards, and any PT040-PT045 diagnostics as a
+    ``sharding`` section. Pure analysis: nothing is compiled or
+    executed, no devices needed. Same config contract as
+    ``train``/``lint`` (the file defines ``model()``)."""
     import paddle_tpu as pt
     from paddle_tpu.parallel import accounting
 
@@ -577,6 +655,17 @@ def cmd_accounting(args):
                                         fetches=fetches),
                 train_step=train_step),
         }
+        if args.sharding:
+            from paddle_tpu.analysis import sharding as sharding_mod
+            plan, sharding_diags = sharding_mod.check_sharding(
+                main, mesh_shape=mesh_shape)
+            report["sharding"] = dict(
+                plan.summary(),
+                diagnostics=[{"code": d.code,
+                              "severity": d.severity,
+                              "message": d.message,
+                              "location": d.location()}
+                             for d in sharding_diags])
     except ValueError as e:
         # e.g. --hosts not dividing the data axis: readable, not a trace
         print("accounting: %s" % e)
@@ -847,8 +936,28 @@ def main(argv=None):
                       help="global batch substituted for the feed "
                            "wildcard dim (-1) in the --memory pass")
     lint.add_argument("--mesh", default="dp=1",
-                      help="mesh for the --memory pass, e.g. 'dp=8': "
-                           "the batch shards over dp, params replicate")
+                      help="mesh for the --memory/--sharding passes, "
+                           "e.g. 'dp=8' or 'dp=4,fsdp=2,tp=2': the "
+                           "batch shards over dp; params replicate "
+                           "unless --sharding propagates their specs")
+    lint.add_argument("--sharding", action="store_true",
+                      help="run the static sharding analyzer "
+                           "(PT040-PT045, analysis.sharding): propagate "
+                           "PartitionSpecs through the train step over "
+                           "--mesh, price implicit reshards, and audit "
+                           "the sharded collective vocabulary; prints "
+                           "the sharding plan table")
+    lint.add_argument("--spec", action="append", default=None,
+                      metavar="VAR=SPEC",
+                      help="override/seed one variable's PartitionSpec "
+                           "for --sharding (repeatable), e.g. "
+                           "--spec 'x=dp,tp' --spec 'w=fsdp+tp,-' "
+                           "(',' separates dims, '+' joins axes on one "
+                           "dim, '-' or empty = replicated dim)")
+    lint.add_argument("--all", action="store_true",
+                      help="run every pass (structural + --comm + "
+                           "--memory + --sharding) with one combined "
+                           "summary and exit code")
     lint.set_defaults(fn=cmd_lint)
 
     sv = sub.add_parser(
@@ -1005,6 +1114,11 @@ def main(argv=None):
                           "(negative = FLAGS.comm_split_ratio; derive "
                           "from measured bandwidths via "
                           "comm.measured_split_ratio)")
+    acc.add_argument("--sharding", action="store_true",
+                     help="add the propagated-PartitionSpec plan "
+                          "(analysis.sharding PT040-PT045): per-class "
+                          "spec table, fingerprint, priced implicit "
+                          "reshards, diagnostics")
     acc.set_defaults(fn=cmd_accounting)
 
     tn = sub.add_parser(

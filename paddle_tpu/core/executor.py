@@ -1190,6 +1190,7 @@ class Executor(object):
             if _verify_requested():
                 self._memory_preflight(program, feed, state, fetch_names,
                                        dist)
+                self._sharding_preflight(program, dist)
             shardings = (_dist_shardings(dist, state, feed)
                          if dist is not None else None)
             fn = _TracedOnce(self._compile(
@@ -1481,13 +1482,18 @@ class Executor(object):
                         # exchange — divergence refuses the first
                         # collective readably (PT020), same rung as the
                         # verifier above, gated on the launch contract
-                        # instead of PADDLE_TPU_VERIFY
+                        # instead of PADDLE_TPU_VERIFY. The sharding
+                        # preflight's fingerprint (when a spec table
+                        # exists) folds the PT044 sharded-collective
+                        # vocabulary into the exchanged digest
                         from ..elastic.fingerprints import \
                             check_replica_schedule
                         check_replica_schedule(
                             capture["grads"], policy=plan["policy"],
                             axis_size=n,
-                            overlap=bool(FLAGS.comm_overlap))
+                            overlap=bool(FLAGS.comm_overlap),
+                            sharding=self.stats.get(
+                                "sharding_fingerprint"))
                     cell["fn"] = built
             return cell["fn"](state, feed, rng_key)
 
@@ -1741,6 +1747,41 @@ class Executor(object):
             mem_preflights=1, mem_predicted_peak_bytes=plan.peak_bytes,
             mem_measured_live_bytes=_mem.measure_live_bytes())
         self.stats["mem_predicted_peak_bytes"] = plan.peak_bytes
+        return plan
+
+    def _sharding_preflight(self, program, dist):
+        """Opt-in pre-compile sharding check (PADDLE_TPU_VERIFY,
+        PT040-PT045): propagate the program's PartitionSpecs through
+        one IR walk and raise a readable ProgramVerifyError — plan
+        table included — BEFORE the jit compile, instead of letting
+        GSPMD silently insert the resharding collectives a wrong spec
+        implies. Only runs when the program carries specs (pure
+        single-device programs pay nothing)."""
+        specs = getattr(program, "_shardings", None)
+        if not specs:
+            return
+        mesh_shape = None
+        if dist is not None:
+            mesh_shape = dict(dist.mesh.shape)
+        elif getattr(program, "_mesh_axes", None):
+            mesh_shape = dict(program._mesh_axes)
+        if not mesh_shape:
+            return  # specs with no mesh: nothing to check them against
+        from ..analysis import sharding as _shard
+        plan, diags = _shard.verify_sharding_or_raise(
+            program, mesh_shape=mesh_shape,
+            context="executor sharding preflight (before jit compile, "
+                    "program %d)" % program._uid)
+        if any(not d.is_error for d in diags):
+            import warnings
+            from ..analysis import render_diagnostics
+            warnings.warn(
+                "program %d sharding preflight warnings:\n%s"
+                % (program._uid,
+                   render_diagnostics([d for d in diags
+                                       if not d.is_error])),
+                RuntimeWarning)
+        self.stats["sharding_fingerprint"] = plan.fingerprint
         return plan
 
     def _persistable_names(self, program):

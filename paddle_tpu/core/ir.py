@@ -394,6 +394,10 @@ class Program(object):
         # sharding annotations: var name -> jax PartitionSpec-like tuple,
         # attached by paddle_tpu.parallel (the transpiler-as-sharding-pass)
         self._shardings: Dict[str, Any] = {}
+        # mesh annotation: axis name -> size, attached alongside
+        # _shardings so analysis.sharding can check specs against the
+        # mesh they were written for without a live jax Mesh
+        self._mesh_axes: Dict[str, int] = {}
         self._is_distributed = False
 
     # -- block management --------------------------------------------------

@@ -102,7 +102,8 @@ def gather_fingerprints(state_dir, world, generation=0, timeout_sec=30.0,
 
 
 def check_replica_schedule(template, policy=None, axis_size=None,
-                           overlap=None, env=None, timeout_sec=None):
+                           overlap=None, env=None, timeout_sec=None,
+                           sharding=None):
     """The job-start gate: compute this replica's collective program
     fingerprint from its grads ``template`` (the same
     ``comm_rules.verify_comm`` pass — local errors raise immediately),
@@ -116,7 +117,12 @@ def check_replica_schedule(template, policy=None, axis_size=None,
 
     Raises :class:`paddle_tpu.analysis.ProgramVerifyError` (PT020) on
     divergence — the readable refusal, BEFORE the first collective
-    rendezvous that would otherwise deadlock."""
+    rendezvous that would otherwise deadlock.
+
+    ``sharding`` (an ``analysis.sharding.sharding_fingerprint``) extends
+    the exchanged vocabulary to the sharded collectives the replica's
+    PartitionSpecs imply (PT044): ranks whose SpecLayouts diverge refuse
+    here too, not at the first mismatched all-gather-on-use."""
     from ..analysis import comm_rules
     from ..analysis.diagnostics import ProgramVerifyError
     from ..resilience import record_event
@@ -133,7 +139,7 @@ def check_replica_schedule(template, policy=None, axis_size=None,
     # must not publish it as if it were an agreed program
     diags, fp = comm_rules.verify_comm(template, policy=policy,
                                        axis_size=axis_size,
-                                       overlap=overlap)
+                                       overlap=overlap, sharding=sharding)
     if any(d.is_error for d in diags):
         raise ProgramVerifyError(
             diags, context="collective self-check before the "

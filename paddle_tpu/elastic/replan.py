@@ -37,7 +37,7 @@ class ElasticPlan(object):
     """Resolved topology + comm plan for one world size (immutable)."""
 
     __slots__ = ("world_size", "chips_per_host", "hosts", "dp", "policy",
-                 "degraded", "memory_audit")
+                 "degraded", "memory_audit", "sharding_audit")
 
     def __init__(self, world_size, chips_per_host, hosts, policy,
                  degraded=False):
@@ -48,6 +48,7 @@ class ElasticPlan(object):
         self.policy = policy
         self.degraded = bool(degraded)
         self.memory_audit = None  # set by audit_memory()
+        self.sharding_audit = None  # set by audit_sharding()
 
     def groups(self):
         """(intra-host groups, inter-host ring pairs) the hierarchical
@@ -138,6 +139,56 @@ class ElasticPlan(object):
         self.memory_audit = audit
         return audit
 
+    def audit_sharding(self, program, min_workers=None):
+        """Post-resize sharding audit (analysis.sharding, PT040-PT045):
+        re-propagate the program's PartitionSpecs over the resized mesh
+        — the data axis is now this plan's ``dp``, the other annotated
+        axes ride along unchanged — and record ``elastic_degraded``
+        (site ``elastic.sharding``) when the specs no longer factorise
+        (a dim that divided the old world but not the new one, or an
+        implicit reshard the resize introduced). Never raises:
+        advisory, degrade-not-die — the supervisor keeps its survivors
+        and the operator gets the finding. Returns the audit dict
+        (also stored as ``plan.sharding_audit``); None when the
+        program carries no specs."""
+        specs = getattr(program, "_shardings", None)
+        if not specs:
+            return None
+        from ..analysis import sharding as _shard
+        mesh_shape = dict(getattr(program, "_mesh_axes", None) or {})
+        data_axis = None
+        for cand in ("dp", "data"):
+            if cand in mesh_shape:
+                data_axis = cand
+                break
+        mesh_shape[data_axis or "dp"] = self.dp
+        try:
+            splan, diags = _shard.check_sharding(
+                program, mesh_shape=mesh_shape, min_workers=min_workers)
+        except Exception as e:  # the audit must not kill the resize
+            record_event("elastic_degraded", site="elastic.sharding",
+                         world_size=self.world_size, error=str(e))
+            self.sharding_audit = {"error": str(e)}
+            return self.sharding_audit
+        errors = [d for d in diags if d.is_error]
+        audit = {
+            "world_size": self.world_size,
+            "dp": self.dp,
+            "mesh": dict(mesh_shape),
+            "fingerprint": splan.fingerprint,
+            "reshard_bytes": splan.total_reshard_bytes(),
+            "errors": [str(d) for d in errors],
+            "warnings": [str(d) for d in diags if not d.is_error],
+            "fits": not errors,
+        }
+        if errors:
+            record_event("elastic_degraded", site="elastic.sharding",
+                         world_size=self.world_size,
+                         errors=[str(d) for d in errors[:4]],
+                         reshard_bytes=splan.total_reshard_bytes())
+        self.sharding_audit = audit
+        return audit
+
     def apply_flags(self):
         """Install the plan's topology into the process flags (the one
         mutable step — everything downstream reads flags at build time).
@@ -199,7 +250,9 @@ def replan(world_size, chips_per_host=1, base=None, quant=None,
     the global batch over fewer workers means bigger per-device
     activations, and an over-budget prediction records
     ``elastic_degraded`` with the overflow instead of letting the
-    resumed generation OOM."""
+    resumed generation OOM. A ``program`` carrying ``_shardings``
+    additionally gets the post-resize sharding audit
+    (:meth:`ElasticPlan.audit_sharding`, site ``elastic.sharding``)."""
     from .. import comm
 
     world_size = int(world_size)
@@ -243,4 +296,6 @@ def replan(world_size, chips_per_host=1, base=None, quant=None,
     if program is not None and global_batch is not None:
         plan.audit_memory(program, global_batch,
                           budget_bytes=memory_budget_bytes)
+    if program is not None:
+        plan.audit_sharding(program)
     return plan

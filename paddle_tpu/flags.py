@@ -221,7 +221,11 @@ DEFINE_bool("verify", False,
             "static memory preflight (analysis.memory, PT030): a "
             "program whose predicted peak HBM exceeds the budget "
             "raises with the residency table BEFORE the XLA compile "
-            "instead of dying in an unreadable device OOM")
+            "instead of dying in an unreadable device OOM. Programs "
+            "carrying declared PartitionSpecs (program._shardings) also "
+            "run the static sharding preflight (analysis.sharding, "
+            "PT040-PT045): invalid or conflicting specs raise with the "
+            "sharding plan table before the jit compile")
 DEFINE_float("memory_budget_gb", 0.0,
              "per-device HBM budget (GiB) the static memory planner "
              "checks predicted peaks against (lint --memory, the "

@@ -215,6 +215,9 @@ class DistributeTranspiler(object):
         # structural rules prove the program this context will jit is
         # still well-formed — a sharding pass must not ship a broken graph
         program._shardings = dict(specs)
+        # the mesh the specs were written for (axis name -> size), so the
+        # static sharding pass can validate without a live jax Mesh
+        program._mesh_axes = {str(k): int(v) for k, v in mesh.shape.items()}
         from ..analysis import check_after_pass
         check_after_pass(program, "DistributeTranspiler.transpile")
         return DistContext(mesh, strategy, specs)

@@ -1,0 +1,276 @@
+"""Canonical SpecLayout table: parameter classes -> PartitionSpecs.
+
+The ROADMAP's "beyond pure-DP: FSDP x TP" direction needs one
+authoritative answer to "how is each parameter class sharded over the
+named (data, fsdp, tp) mesh axes".  This module is that answer: a small
+frozen table mapping *parameter classes* (embedding tables, matmul
+weights, conv filters, norm scales / biases) to PartitionSpecs, plus the
+classifier that assigns each ``Parameter`` of a Program to its class by
+looking at the op slot that consumes it.
+
+The table is consumed by three layers:
+
+- ``analysis.sharding`` seeds its propagation pass with
+  ``layout_table(program, layout, mesh_shape)`` for every parameter the
+  user did not explicitly shard via ``program._shardings``;
+- ``paddle_tpu accounting <cfg> --sharding`` tabulates per-class specs
+  and bytes — the sizing x spec input the FSDP build consumes;
+- the memory planner prices sharded residency from the same specs.
+
+Specs here are *intents*: ``restrict_spec`` projects an intent onto a
+concrete mesh, dropping axes the mesh does not carry (a dp-only mesh
+leaves every parameter replicated) and axes whose size does not divide
+the dimension (a (13, 1) weight never picks up an fsdp=2 shard).  The
+projected table is therefore valid by construction — PT040 findings can
+only come from *declared* specs.
+
+Mesh-axis naming: the repo's data axis is ``"dp"`` (``ShardingStrategy``
+default); the literature's ``"data"`` is accepted as an alias wherever a
+data axis is looked up.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+# Parameter classes, in the order accounting tabulates them.
+PARAM_CLASSES = ("embedding", "matmul_weight", "conv_filter",
+                 "norm_or_bias", "other")
+
+# Aliases accepted for the data axis when projecting onto a mesh.
+DATA_AXIS_ALIASES = ("dp", "data")
+
+
+@dataclass(frozen=True)
+class SpecLayout:
+    """Canonical per-parameter-class PartitionSpec intents.
+
+    Axis names are parameters so a mesh built with different labels
+    (e.g. ``data`` instead of ``dp``) gets a matching table.
+    """
+    data_axis: str = "dp"
+    fsdp_axis: str = "fsdp"
+    tp_axis: str = "tp"
+
+    # -- per-class intents (tuples of per-dim entries; None = replicated
+    #    on that dim, a tuple means the dim is sharded over several axes)
+    def embedding(self):
+        # vocab rows over fsdp x tp (row-sharded table: lookup contracts
+        # the vocab dim, so the row shard never materialises full).
+        return ((self.fsdp_axis, self.tp_axis), None)
+
+    def matmul_weight(self):
+        # rows (input features) over fsdp, cols (output features) over
+        # tp: megatron column-parallel with a ZeRO-3 row shard.
+        return (self.fsdp_axis, self.tp_axis)
+
+    def matmul_weight_row(self):
+        # megatron row-parallel: the second of two stacked GEMMs
+        # contracts the tp-sharded feature dim the first produced
+        # (all-reduce over tp), leaving its own output fsdp-tailed.
+        return (self.tp_axis, self.fsdp_axis)
+
+    def conv_filter(self):
+        # out-channel shard over fsdp; spatial/in-channel replicated.
+        return (self.fsdp_axis,)
+
+    def norm_or_bias(self):
+        return ()
+
+    def other(self):
+        return ()
+
+    def spec_for_class(self, cls: str):
+        if cls not in PARAM_CLASSES:
+            raise ValueError("unknown parameter class %r (one of %s)"
+                             % (cls, ", ".join(PARAM_CLASSES)))
+        return getattr(self, cls)()
+
+    def data_axis_in(self, mesh_shape) -> Optional[str]:
+        """The data axis this mesh actually carries, or None."""
+        for name in (self.data_axis,) + tuple(DATA_AXIS_ALIASES):
+            if name in mesh_shape:
+                return name
+        return None
+
+
+def normalize_spec(spec, ndim: Optional[int] = None) -> Tuple[Tuple[str, ...], ...]:
+    """Normalise any spec spelling to a tuple of per-dim axis tuples.
+
+    Accepts a ``jax.sharding.PartitionSpec``, a tuple/list whose entries
+    are ``None`` / ``"axis"`` / ``("a", "b")``, or ``None`` (fully
+    replicated).  When ``ndim`` is given the result is padded with
+    replicated entries (and clamped — over-rank specs are PT011's
+    finding, not a crash here).
+    """
+    entries = [] if spec is None else list(spec)
+    out = []
+    for e in entries:
+        if e is None:
+            out.append(())
+        elif isinstance(e, str):
+            out.append((e,))
+        else:
+            out.append(tuple(e))
+    if ndim is not None:
+        while len(out) < ndim:
+            out.append(())
+        out = out[:ndim]
+    return tuple(out)
+
+
+def spec_axes(entries) -> Tuple[str, ...]:
+    """All mesh axes a normalised spec shards over, in dim order."""
+    out = []
+    for e in entries:
+        out.extend(e)
+    return tuple(out)
+
+
+def shard_factor(entries, mesh_shape) -> int:
+    """Number of ways the tensor is split: product of its axes' sizes."""
+    f = 1
+    for ax in spec_axes(entries):
+        f *= int(mesh_shape.get(ax, 1))
+    return max(f, 1)
+
+
+def restrict_spec(spec, shape, mesh_shape) -> Tuple[Tuple[str, ...], ...]:
+    """Project a spec intent onto a concrete mesh and tensor shape.
+
+    Drops axes the mesh does not carry (or carries at size 1), axes
+    already used by an earlier dim, and axes whose size does not evenly
+    divide the dim (unknown dims — ``None`` shape or a ``-1`` batch
+    wildcard — are assumed divisible; the runtime picks the batch).
+    The result is valid by construction.
+    """
+    ndim = None if shape is None else len(shape)
+    entries = normalize_spec(spec, ndim)
+    seen = set()
+    out = []
+    for i, axes in enumerate(entries):
+        dim = None
+        if shape is not None and i < len(shape):
+            dim = shape[i]
+        keep = []
+        factor = 1
+        for ax in axes:
+            size = int(mesh_shape.get(ax, 0))
+            if size <= 1 or ax in seen:
+                continue
+            if dim is not None and dim >= 0 and dim % (factor * size) != 0:
+                continue
+            keep.append(ax)
+            factor *= size
+            seen.add(ax)
+        out.append(tuple(keep))
+    return tuple(out)
+
+
+def classify_params(program) -> Dict[str, str]:
+    """Assign every Parameter of ``program`` to a PARAM_CLASSES entry.
+
+    Classification is by the *consuming op slot* — the same signal the
+    lowering uses — not by name patterns: ``lookup_table .W`` is an
+    embedding, ``mul``/``matmul`` ``.Y`` a matmul weight, a ``Filter``
+    slot of any conv a conv filter; remaining rank<=1 parameters are
+    norm scales / biases.
+    """
+    consumers: Dict[str, list] = {}
+    for blk in program.blocks:
+        for op in blk.ops:
+            if op.type.endswith("_grad"):
+                continue
+            for slot, names in op.inputs.items():
+                for n in names:
+                    consumers.setdefault(n, []).append((op.type, slot))
+    out: Dict[str, str] = {}
+    for p in program.all_parameters():
+        cls = "other"
+        for op_type, slot in consumers.get(p.name, ()):
+            if slot == "W" and op_type.startswith("lookup_table"):
+                cls = "embedding"
+                break
+            if slot == "Y" and op_type in ("mul", "matmul", "matmul_v2"):
+                cls = "matmul_weight"
+                break
+            if slot == "Filter" and "conv" in op_type:
+                cls = "conv_filter"
+                break
+        if cls == "other" and p.shape is not None and len(p.shape) <= 1:
+            cls = "norm_or_bias"
+        out[p.name] = cls
+    return out
+
+
+def _row_parallel_weights(program, classes) -> set:
+    """Megatron alternation: walk the forward ops in order, tracking
+    which activations carry a tp-sharded last dim (the output of a
+    column-parallel matmul, flowed through shape-preserving ops).  A
+    matmul weight first consumed by such an activation is row-parallel
+    (contract the tp dim, all-reduce, emerge fsdp-tailed) — stacked FC
+    layers then chain without a single implicit reshard, which is the
+    whole point of a *canonical* table."""
+    row_parallel = set()
+    decided = set()
+    tp_tail = set()
+    for blk in program.blocks:
+        for op in blk.ops:
+            if op.type.endswith("_grad"):
+                continue
+            if op.type in ("mul", "matmul", "matmul_v2"):
+                xs = op.inputs.get("X", ())
+                ys = op.inputs.get("Y", ())
+                x = xs[0] if xs else None
+                y = ys[0] if ys else None
+                if y in classes and classes[y] == "matmul_weight":
+                    if y not in decided:
+                        decided.add(y)
+                        if x in tp_tail:
+                            row_parallel.add(y)
+                    if y not in row_parallel:
+                        tp_tail.update(op.output_arg_names)
+                    continue
+            if any(n in tp_tail for n in op.input_arg_names):
+                tp_tail.update(op.output_arg_names)
+    return row_parallel
+
+
+def layout_table(program, layout: Optional[SpecLayout] = None,
+                 mesh_shape=None) -> Dict[str, Tuple[Tuple[str, ...], ...]]:
+    """Per-parameter normalised specs from the canonical table.
+
+    With a ``mesh_shape`` the intents are projected via ``restrict_spec``
+    (valid by construction); without one the raw intents are returned.
+    """
+    layout = layout or SpecLayout()
+    classes = classify_params(program)
+    row_parallel = _row_parallel_weights(program, classes)
+    table: Dict[str, Tuple[Tuple[str, ...], ...]] = {}
+    for p in program.all_parameters():
+        if p.name in row_parallel:
+            intent = layout.matmul_weight_row()
+        else:
+            intent = layout.spec_for_class(classes[p.name])
+        if mesh_shape:
+            table[p.name] = restrict_spec(intent, p.shape, mesh_shape)
+        else:
+            ndim = None if p.shape is None else len(p.shape)
+            table[p.name] = normalize_spec(intent, ndim)
+    return table
+
+
+def as_partition_spec(entries):
+    """Normalised entries -> ``jax.sharding.PartitionSpec`` (lazy jax)."""
+    from jax.sharding import PartitionSpec as P
+    args = []
+    for e in entries:
+        if not e:
+            args.append(None)
+        elif len(e) == 1:
+            args.append(e[0])
+        else:
+            args.append(tuple(e))
+    while args and args[-1] is None:
+        args.pop()
+    return P(*args)

@@ -1,0 +1,510 @@
+"""Static sharding analyzer (PT040-PT045) + the canonical SpecLayout
+table: zero false positives over the book builders at dp-only and
+dp x fsdp x tp meshes, one seeded golden test per code, and the four
+choke points (lint CLI, Executor preflight, elastic replan audit,
+accounting section).  Companion to test_memory_analysis.py /
+test_analysis.py — same builder idiom, same `codes()` helper.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import analysis, layers, models
+from paddle_tpu.analysis import ProgramVerifyError
+from paddle_tpu.analysis import sharding as shard
+from paddle_tpu.core import ir
+from paddle_tpu.flags import flags_guard
+from paddle_tpu.parallel import spec_layout as sl
+
+MESH3 = {"dp": 4, "fsdp": 2, "tp": 2}
+
+
+def codes(diags):
+    return sorted({d.code for d in diags})
+
+
+def errors(diags):
+    return [d for d in diags if d.is_error]
+
+
+# ---------------------------------------------------------------------------
+# SpecLayout table + spec algebra
+# ---------------------------------------------------------------------------
+
+def test_normalize_and_fmt_spec():
+    assert sl.normalize_spec(None) == ()
+    assert sl.normalize_spec(("dp", None), 2) == (("dp",), ())
+    assert sl.normalize_spec((("fsdp", "tp"),), 2) == (("fsdp", "tp"), ())
+    # pads and clamps to ndim
+    assert sl.normalize_spec(("dp",), 3) == (("dp",), (), ())
+    assert sl.normalize_spec(("dp", "tp", "fsdp"), 2) == (("dp",), ("tp",))
+    assert shard.fmt_spec(()) == "replicated"
+    assert shard.fmt_spec((("dp",), ("fsdp", "tp"), ())) == \
+        "P('dp', ('fsdp', 'tp'), None)"
+
+
+def test_restrict_spec_is_valid_by_construction():
+    mesh = {"dp": 4, "fsdp": 2, "tp": 2}
+    # unknown axis dropped, non-dividing axis dropped, reused axis
+    # dropped, size-1 axis dropped — whatever survives must validate
+    got = sl.restrict_spec((("bogus",), ("tp",)), (8, 10), mesh)
+    assert got == ((), ("tp",))
+    got = sl.restrict_spec((("tp",), ("tp",)), (8, 10), mesh)
+    assert got == (("tp",), ())
+    assert sl.restrict_spec((("fsdp",),), (7,), mesh) == ((),)
+    assert sl.restrict_spec((("fsdp",),), (-1,), mesh) == (("fsdp",),)
+    assert sl.restrict_spec((("dp",),), (8,), {"dp": 1}) == ((),)
+    diags = []
+    shard._validate_declared("v", None, got, mesh, diags)
+    assert diags == []
+
+
+def test_classify_params_and_megatron_alternation():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data(name="x", shape=[64], dtype="float32")
+        h1 = layers.fc(input=x, size=64, act="relu")
+        h2 = layers.fc(input=h1, size=64, act="relu")
+        layers.fc(input=h2, size=64, act=None)
+    classes = sl.classify_params(main)
+    weights = [p.name for p in main.all_parameters() if len(p.shape) == 2]
+    assert all(classes[w] == "matmul_weight" for w in weights)
+    table = sl.layout_table(main, sl.SpecLayout(), MESH3)
+    specs = [table[w] for w in weights]
+    # stacked GEMMs alternate column/row parallel so the chain
+    # contracts the sharded dim (planned all-reduce) with NO reshard
+    assert specs[0] == (("fsdp",), ("tp",))
+    assert specs[1] == (("tp",), ("fsdp",))
+    assert specs[2] == (("fsdp",), ("tp",))
+
+
+def test_layout_table_classes():
+    lay = sl.SpecLayout()
+    assert lay.embedding() == (("fsdp", "tp"), None)
+    assert lay.norm_or_bias() == ()
+    assert lay.data_axis_in({"data": 8}) == "data"
+    assert lay.data_axis_in({"tp": 8}) is None
+
+
+# ---------------------------------------------------------------------------
+# zero false positives over the book builders, both meshes
+# ---------------------------------------------------------------------------
+
+def _fit_a_line():
+    x = layers.data(name="x", shape=[13], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    avg = layers.mean(layers.square_error_cost(
+        input=layers.fc(input=x, size=1), label=y))
+    pt.optimizer.SGD(learning_rate=0.01).minimize(avg)
+
+
+def _digits():
+    img = layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    _pred, avg, _acc = models.lenet5(img, label)
+    pt.optimizer.Adam(learning_rate=0.001).minimize(avg)
+
+
+def _word2vec():
+    ws = [layers.data(name="w%d" % i, shape=[1], dtype="int64")
+          for i in range(4)]
+    nxt = layers.data(name="next_word", shape=[1], dtype="int64")
+    embs = [layers.embedding(w, size=[100, 16], dtype="float32",
+                             param_attr=pt.ParamAttr(name="shared_w"))
+            for w in ws]
+    hid = layers.fc(layers.concat(embs, axis=1), size=32, act="sigmoid")
+    pred = layers.fc(hid, size=100, act="softmax")
+    avg = layers.mean(layers.cross_entropy(input=pred, label=nxt))
+    pt.optimizer.SGD(learning_rate=0.001).minimize(avg)
+
+
+def _resnet():
+    img = layers.data(name="img", shape=[3, 32, 32], dtype="float32")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    pred = models.resnet_cifar10(img, class_dim=10, depth=20)
+    avg = layers.mean(layers.cross_entropy(input=pred, label=label))
+    pt.optimizer.SGD(learning_rate=0.1).minimize(avg)
+
+
+@pytest.mark.parametrize("mesh", [{"dp": 4}, MESH3],
+                         ids=["dp-only", "dp-fsdp-tp"])
+@pytest.mark.parametrize("build", [_fit_a_line, _digits, _word2vec,
+                                   _resnet])
+def test_zero_false_positives_book_builders(build, mesh):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        build()
+    plan, diags = shard.check_sharding(main, mesh_shape=mesh)
+    assert errors(diags) == [], "%s @ %s: %s" % (build.__name__, mesh,
+                                                 errors(diags))
+    assert not [d for d in diags if d.code == "PT042"]
+    assert plan.fingerprint
+
+
+# ---------------------------------------------------------------------------
+# golden seeded-violation tests, one per code
+# ---------------------------------------------------------------------------
+
+def _weight_name(main, rank=2):
+    return [p.name for p in main.all_parameters()
+            if len(p.shape) == rank][0]
+
+
+def test_pt040_unknown_dup_and_nondividing():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        _fit_a_line()
+    main._shardings = {"x": (None, "bogus")}
+    _plan, diags = shard.check_sharding(main, mesh_shape=MESH3)
+    assert "PT040" in codes(diags)
+    assert any("mesh has axes" in d.message for d in diags)
+
+    main._shardings = {"x": ("dp", "dp")}
+    _plan, diags = shard.check_sharding(main, mesh_shape=MESH3)
+    assert any(d.code == "PT040" and "twice" in d.message for d in diags)
+
+    main._shardings = {"x": (None, "tp")}  # dim1 = 13, tp = 2
+    _plan, diags = shard.check_sharding(main, mesh_shape=MESH3)
+    assert any(d.code == "PT040" and "not divisible" in d.message
+               for d in diags)
+
+
+def test_pt041_implicit_reshard_is_priced():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        _digits()
+    w = _weight_name(main)  # the (800, 10) FC weight
+    main._shardings = {w: ("tp", "fsdp")}  # fights the pooled activation
+    plan, diags = shard.check_sharding(main, mesh_shape=MESH3)
+    hits = [d for d in diags if d.code == "PT041"]
+    assert hits, codes(diags)
+    d = hits[0]
+    assert d.is_error
+    assert "implicit reshard at mul" in d.message
+    assert "arrives" in d.message and "on the wire" in d.message
+    assert d.op_idx is not None and "block0:op" in d.location()
+    assert plan.total_reshard_bytes() > 0
+    ev = plan.reshard_events[0]
+    assert ev["bytes"] > 0 and ev["collective"]
+    assert "implicit reshards: 1" in plan.table()
+
+
+def test_pt042_replicated_large_param_warns():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data(name="x", shape=[512], dtype="float32")
+        layers.fc(input=x, size=512, act=None)  # 1 MiB weight
+    w = _weight_name(main)
+    main._shardings = {w: ()}  # pinned replicated: the FSDP miss
+    _plan, diags = shard.check_sharding(main, mesh_shape=MESH3)
+    hits = [d for d in diags if d.code == "PT042"]
+    assert hits and not hits[0].is_error  # WARNING, not ERROR
+    assert "replicated" in hits[0].message
+    # same declaration on a data-parallel-only mesh: replication is the
+    # design, not a miss — no warning
+    _plan, diags = shard.check_sharding(main, mesh_shape={"dp": 8})
+    assert "PT042" not in codes(diags)
+
+
+def test_pt043_declaration_contradicts_dataflow():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        _fit_a_line()
+    mul_out = next(op.output_arg_names[0]
+                   for op in main.global_block().ops if op.type == "mul")
+    main._shardings = {"x": ("dp", None), mul_out: ("fsdp", None)}
+    _plan, diags = shard.check_sharding(main, mesh_shape=MESH3)
+    hits = [d for d in diags if d.code == "PT043"]
+    assert hits, codes(diags)
+    assert "contradicts the program" in hits[0].message
+
+
+def test_pt044_param_grad_conflict():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        _digits()
+    w = _weight_name(main)  # (800, 10): divisible both ways
+    main._shardings = {w: ("fsdp", None),
+                       w + ir.GRAD_SUFFIX: ("tp", None)}
+    _plan, diags = shard.check_sharding(main, mesh_shape=MESH3)
+    hits = [d for d in diags if d.code == "PT044"]
+    assert hits, codes(diags)
+    assert "no longer a pure function" in hits[0].message
+
+
+def test_pt044_fingerprint_determinism_and_expectation():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        _fit_a_line()
+    plan1, diags1 = shard.check_sharding(main, mesh_shape=MESH3)
+    plan2, _ = shard.check_sharding(main, mesh_shape=MESH3)
+    assert plan1.fingerprint == plan2.fingerprint
+    assert "PT044" not in codes(diags1)
+    _plan, diags = shard.check_sharding(main, mesh_shape=MESH3,
+                                        expect_fingerprint="0" * 40)
+    assert any(d.code == "PT044" and "does not match" in d.message
+               for d in diags)
+
+
+def test_pt045_elastic_floor_divisibility():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data(name="x", shape=[10, 8], dtype="float32",
+                        append_batch_size=False)
+        layers.scale(x, scale=2.0)
+    main._shardings = {"x": ("dp", None)}
+    _plan, diags = shard.check_sharding(main, mesh_shape={"dp": 2},
+                                        min_workers=3)
+    hits = [d for d in diags if d.code == "PT045"]
+    assert hits and "elastic_min_workers=3" in hits[0].message
+    # divides at the floor -> clean; floor of 1 never fires
+    _plan, diags = shard.check_sharding(main, mesh_shape={"dp": 2},
+                                        min_workers=5)
+    assert "PT045" not in codes(diags)
+    _plan, diags = shard.check_sharding(main, mesh_shape={"dp": 2},
+                                        min_workers=1)
+    assert "PT045" not in codes(diags)
+
+
+# ---------------------------------------------------------------------------
+# pricing formulas + collective vocabulary
+# ---------------------------------------------------------------------------
+
+def test_reshard_bytes_ring_formulas():
+    mesh = {"dp": 2, "fsdp": 2, "tp": 4}
+    # gathering a tp-sharded tensor: ring all-gather (n-1)/n * payload
+    total, coll = shard.reshard_bytes(1024, (("tp",), ()), ((), ()), mesh)
+    assert total == (4 - 1) * 1024 // 4
+    assert "all-gather" in coll
+    # axis moves dims: all-to-all, same ring volume
+    total, coll = shard.reshard_bytes(1024, (("tp",), ()), ((), ("tp",)),
+                                      mesh)
+    assert total == (4 - 1) * 1024 // 4
+    assert "all-to-all" in coll
+    # only NEW sharding: a free dynamic-slice
+    total, coll = shard.reshard_bytes(1024, ((), ()), (("tp",), ()), mesh)
+    assert total == 0 and coll == "dynamic-slice"
+
+
+def test_sharded_collective_vocabulary():
+    specs = {"w": (("fsdp",), ()), "b": ()}
+    classes = {"w": "matmul_weight", "b": "norm_or_bias"}
+    seq = shard.sharded_collective_sequence(
+        specs, {"dp": 2, "fsdp": 2}, classes=classes, data_axis="dp")
+    kinds = {(k, n) for k, n, _ in seq}
+    # fsdp-sharded param: all-gather on use + reduce-scatter its grad
+    assert ("all-gather", "w") in kinds
+    assert ("reduce-scatter", "w" + ir.GRAD_SUFFIX) in kinds
+    # replicated param on dp>1: plain grad all-reduce
+    assert ("all-reduce", "b" + ir.GRAD_SUFFIX) in kinds
+    fp = shard.sharding_fingerprint(seq, {"dp": 2, "fsdp": 2})
+    assert fp != shard.sharding_fingerprint(seq, {"dp": 4, "fsdp": 2})
+
+
+def test_schedule_fingerprint_folds_sharding():
+    import jax
+    from paddle_tpu.analysis import comm_rules
+    from paddle_tpu.comm import CommPolicy
+    tpl = {"p%d@GRAD" % i: jax.ShapeDtypeStruct((64,), np.dtype("float32"))
+           for i in range(3)}
+    pol = CommPolicy(base="fused", bucket_bytes=1024)
+    _d1, fp_plain = comm_rules.verify_comm(tpl, pol, axis_size=4)
+    _d2, fp_shard = comm_rules.verify_comm(tpl, pol, axis_size=4,
+                                           sharding="abc123")
+    assert fp_plain and fp_shard and fp_plain != fp_shard
+    # same sharding vocabulary -> same fingerprint (exchangeable)
+    _d3, fp_again = comm_rules.verify_comm(tpl, pol, axis_size=4,
+                                           sharding="abc123")
+    assert fp_shard == fp_again
+
+
+# ---------------------------------------------------------------------------
+# choke points: executor preflight, elastic replan, memory pricing, CLI
+# ---------------------------------------------------------------------------
+
+def test_executor_preflight_raises_before_compile():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data(name="x", shape=[13], dtype="float32")
+        pred = layers.fc(input=x, size=4, act=None)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    exe.run(startup, scope=scope)
+    jit_before = exe.stats["jit_runs"]
+    main._mesh_axes = dict(MESH3)
+    main._shardings = {"x": (None, "tp")}  # 13 % 2 != 0
+    feed = exe.prepare_feed({"x": np.ones((4, 13), np.float32)})
+    with flags_guard(verify=True):
+        with pytest.raises(ProgramVerifyError) as ei:
+            exe.run(main, feed=feed, fetch_list=[pred], scope=scope)
+    assert "PT040" in str(ei.value)
+    assert "sharding plan over mesh" in str(ei.value)
+    assert exe.stats["jit_runs"] == jit_before  # raised BEFORE compile
+    main._shardings = {"x": ("dp", None)}
+    with flags_guard(verify=True):
+        out = exe.run(main, feed=feed, fetch_list=[pred], scope=scope)
+    assert np.isfinite(np.asarray(out[0])).all()
+    assert exe.stats["sharding_fingerprint"]
+
+
+def test_replan_audits_sharding():
+    from paddle_tpu.elastic import replan as replan_mod
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        _fit_a_line()
+    main._mesh_axes = {"dp": 8}
+    main._shardings = {"x": ("dp", None)}
+    plan = replan_mod.replan(4, chips_per_host=1, program=main,
+                             global_batch=64)
+    audit = plan.sharding_audit
+    assert audit is not None
+    assert audit["dp"] == 4 and audit["mesh"]["dp"] == 4
+    assert audit["fits"] and audit["errors"] == []
+    assert audit["fingerprint"]
+    # a program with no declared specs: nothing to audit
+    main2, startup2 = pt.Program(), pt.Program()
+    with pt.program_guard(main2, startup2):
+        _fit_a_line()
+    plan2 = replan_mod.replan(4, chips_per_host=1, program=main2,
+                              global_batch=64)
+    assert plan2.sharding_audit is None
+
+
+def test_memory_planner_prices_sharded_residency():
+    from paddle_tpu.analysis import memory as mem
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data(name="x", shape=[64], dtype="float32")
+        layers.fc(input=x, size=64, act=None)
+    w = _weight_name(main)
+    base, _ = mem.check_memory(main, batch=4)
+    sharded, _ = mem.check_memory(
+        main, batch=4, specs={w: (("fsdp",), ())},
+        mesh_shape={"dp": 1, "fsdp": 2})
+    full = base.class_bytes["params"]
+    assert sharded.class_bytes["params"] < full
+    # the 16 KiB weight halves; the tiny bias stays replicated
+    assert sharded.class_bytes["params"] == full - 64 * 64 * 4 // 2
+
+
+def test_lint_cli_sharding_exit_codes(tmp_path, capsys):
+    from paddle_tpu.cli import main as cli_main
+    cfg = tmp_path / "cfg.py"
+    cfg.write_text(
+        "import paddle_tpu as pt\n"
+        "from paddle_tpu import layers\n\n"
+        "def model():\n"
+        "    x = layers.data(name='x', shape=[16], dtype='float32')\n"
+        "    y = layers.data(name='y', shape=[1], dtype='float32')\n"
+        "    pred = layers.fc(input=x, size=4, act=None)\n"
+        "    cost = layers.mean(layers.square_error_cost(input=pred,\n"
+        "                                                label=y))\n"
+        "    return {'cost': cost, 'optimizer':\n"
+        "            pt.optimizer.SGD(learning_rate=0.01)}\n")
+    rc = cli_main(["lint", str(cfg), "--sharding",
+                   "--mesh", "dp=4,fsdp=2,tp=2"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "sharding plan over mesh" in out
+    assert "sharding pass: clean" in out
+    # seeded: a feed spec whose dim cannot divide -> PT040, exit 1
+    rc = cli_main(["lint", str(cfg), "--sharding",
+                   "--mesh", "dp=4,fsdp=2,tp=2", "--spec", "y=dp,tp"])
+    out = capsys.readouterr().out
+    assert rc == 1 and "PT040" in out
+    # malformed --spec refuses with a readable message, exit 2
+    rc = cli_main(["lint", str(cfg), "--sharding", "--spec", "nonsense"])
+    out = capsys.readouterr().out
+    assert rc == 2 and "bad --spec" in out
+
+
+def test_lint_cli_all_and_dot(tmp_path, capsys):
+    from paddle_tpu.cli import main as cli_main
+    cfg = tmp_path / "cfg.py"
+    cfg.write_text(
+        "import paddle_tpu as pt\n"
+        "from paddle_tpu import layers\n\n"
+        "def model():\n"
+        "    x = layers.data(name='x', shape=[16], dtype='float32')\n"
+        "    y = layers.data(name='y', shape=[1], dtype='float32')\n"
+        "    pred = layers.fc(input=x, size=4, act=None)\n"
+        "    cost = layers.mean(layers.square_error_cost(input=pred,\n"
+        "                                                label=y))\n"
+        "    return {'cost': cost, 'optimizer':\n"
+        "            pt.optimizer.SGD(learning_rate=0.01)}\n")
+    rc = cli_main(["lint", str(cfg), "--all", "--budget-gb", "64",
+                   "--mesh", "dp=2,tp=2"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for needle in ("sharding pass", "memory pass", "comm pass",
+                   "lint --all:"):
+        assert needle in out, out
+    assert "-> clean" in out
+    # --dot fills the sharding finding's op red
+    dot = tmp_path / "g.dot"
+    rc = cli_main(["lint", str(cfg), "--sharding",
+                   "--mesh", "dp=2,tp=2", "--spec", "y=tp,dp",
+                   "--dot", str(dot)])
+    capsys.readouterr()
+    assert rc == 1 and dot.exists()
+    assert "op(s) highlighted" not in dot.read_text()  # message != graph
+    assert "fillcolor" in dot.read_text()
+
+
+def test_accounting_cli_sharding_section(tmp_path, capsys):
+    from paddle_tpu.cli import main as cli_main
+    cfg = tmp_path / "cfg.py"
+    cfg.write_text(
+        "import paddle_tpu as pt\n"
+        "from paddle_tpu import layers\n\n"
+        "def model():\n"
+        "    x = layers.data(name='x', shape=[16], dtype='float32')\n"
+        "    y = layers.data(name='y', shape=[1], dtype='float32')\n"
+        "    pred = layers.fc(input=x, size=4, act=None)\n"
+        "    cost = layers.mean(layers.square_error_cost(input=pred,\n"
+        "                                                label=y))\n"
+        "    return {'cost': cost, 'optimizer':\n"
+        "            pt.optimizer.SGD(learning_rate=0.01)}\n")
+    rc = cli_main(["accounting", str(cfg), "--mesh", "dp=2,fsdp=2",
+                   "--sharding"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    report = json.loads(out)
+    assert "sharding" in report
+    sec = report["sharding"]
+    assert sec["mesh"] == {"dp": 2, "fsdp": 2}
+    assert sec["fingerprint"] and "classes" in sec
+    assert sec["diagnostics"] == []
+
+
+def test_verify_or_raise_carries_plan_table():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        _fit_a_line()
+    main._shardings = {"x": (None, "bogus")}
+    with pytest.raises(ProgramVerifyError) as ei:
+        shard.verify_sharding_or_raise(main, mesh_shape=MESH3)
+    assert "sharding plan over mesh" in str(ei.value)
+    assert "PT040" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# doc drift guard: every registered PT code has a row in diagnostics.md
+# ---------------------------------------------------------------------------
+
+def test_every_pt_code_documented():
+    from paddle_tpu.analysis import comm_rules, memory
+    doc = open(os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "doc", "diagnostics.md")).read()
+    all_codes = set()
+    for cls in analysis.registered_rules():
+        all_codes.update(getattr(cls, "emits", ()))
+    all_codes.update(comm_rules.COMM_CODES)
+    all_codes.update(memory.MEMORY_CODES)
+    all_codes.update(shard.SHARDING_CODES)
+    missing = sorted(c for c in all_codes if ("| %s " % c) not in doc)
+    assert missing == [], \
+        "PT codes with no row in doc/diagnostics.md: %s" % missing

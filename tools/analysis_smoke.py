@@ -23,12 +23,22 @@ bug class covered is invisible on a clean single-process run:
    silent; and on a feed-dominated model the predicted peak lands
    within 25% of the measured ``jax.live_arrays`` delta at the step
    boundary (the acceptance bound);
-4. **donation-aliasing sanitizer** — the seeded PR-10 shape (a bare
+4. **static sharding analyzer** — ``lint --sharding`` over every book
+   config at a dp=4 x fsdp=2 x tp=2 mesh exits 0 (zero false
+   positives from PartitionSpec propagation under the canonical
+   SpecLayout table); a seeded incompatible spec (``--spec``) makes
+   the same config exit 1 with a PT041 naming the op, both propagated
+   specs, and the priced reshard bytes on the wire; a dimension that
+   stops dividing at ``elastic_min_workers`` is caught as PT045; and
+   the Executor preflight under ``PADDLE_TPU_VERIFY`` raises the same
+   PT040 finding (sharding plan table included) BEFORE any jit
+   compile, while the clean-spec run is silent;
+5. **donation-aliasing sanitizer** — the seeded PR-10 shape (a bare
    numpy-backed buffer at a donated position) raises ``SanitizeError``
    naming the var and entry point, while a real checkpoint
    save/restore round trip under ``PADDLE_TPU_SANITIZE=alias`` is
    silent;
-5. **lock-order race detector** — a seeded A->B/B->A inversion is
+6. **lock-order race detector** — a seeded A->B/B->A inversion is
    reported as a cycle and a held-across-join as a hazard, while a
    real generation-engine run plus a router construction under the
    instrumented lock constructor is silent (no cycles, no hazards).
@@ -197,6 +207,97 @@ def memory_seeded():
     summary["memory_measured_live_bytes"] = int(measured)
 
 
+def sharding_seeded():
+    import contextlib
+    import io
+
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.analysis import ProgramVerifyError
+    from paddle_tpu.analysis import sharding as shard
+    from paddle_tpu.cli import main as cli_main
+    from paddle_tpu.flags import flags_guard
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfgs = sorted(glob.glob(os.path.join(root, "examples", "configs",
+                                         "*.py")))
+    # clean sweep: propagation over the 3-axis mesh must produce zero
+    # findings on every book config (the zero-false-positive bar)
+    for cfg in cfgs:
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = cli_main(["lint", cfg, "--sharding",
+                           "--mesh", "dp=4,fsdp=2,tp=2"])
+        check("sharding_clean:%s" % os.path.basename(cfg), rc == 0,
+              "exit %d\n%s" % (rc, buf.getvalue()))
+
+    # seeded implicit reshard: a column-parallel spec forced onto the
+    # digits FC weight conflicts with the propagated pooled activation
+    # -> PT041 naming the op, both specs, and the priced wire bytes.
+    # Fresh subprocess: --spec addresses params by their as-built names
+    # (fc_0.w_0), and unique_name counters advance in THIS process
+    import subprocess
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu", "lint",
+         os.path.join(root, "examples", "configs",
+                      "recognize_digits_conv.py"),
+         "--sharding", "--mesh", "dp=4,fsdp=2,tp=2",
+         "--spec", "fc_0.w_0=tp,fsdp"],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=root)
+    out = proc.stdout + proc.stderr
+    check("sharding_pt041_seeded_exit1", proc.returncode == 1,
+          "exit %d" % proc.returncode)
+    check("sharding_pt041_priced_bytes",
+          "PT041" in out and "implicit reshard at mul" in out
+          and "on the wire" in out and "arrives" in out, out[-800:])
+
+    # PT045: a batch dim that divides the launch mesh but NOT the
+    # elastic floor — caught before the first shrink, not during it
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data(name="x", shape=[10, 8], dtype="float32",
+                        append_batch_size=False)
+        layers.scale(x, scale=2.0)
+    main._shardings = {"x": ("dp", None)}
+    _plan, diags = shard.check_sharding(main, mesh_shape={"dp": 2},
+                                        min_workers=3)
+    check("sharding_pt045_resize_unsafe",
+          any(d.code == "PT045" for d in diags),
+          "; ".join(map(str, diags)))
+
+    # executor preflight: a declared spec that cannot divide its dim
+    # raises the readable PT040 (sharding plan table included) BEFORE
+    # any fresh jit compile; the corrected spec runs silent
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data(name="x", shape=[13], dtype="float32")
+        pred = layers.fc(input=x, size=4, act=None)
+    exe = pt.Executor()
+    scope = pt.Scope()
+    exe.run(startup, scope=scope)
+    jit_before = exe.stats["jit_runs"]
+    main._mesh_axes = {"dp": 2, "tp": 2}
+    main._shardings = {"x": (None, "tp")}  # 13 % 2 != 0 -> PT040
+    feed = exe.prepare_feed({"x": np.ones((4, 13), np.float32)})
+    raised = False
+    with flags_guard(verify=True):
+        try:
+            exe.run(main, feed=feed, fetch_list=[pred], scope=scope)
+        except ProgramVerifyError as e:
+            raised = ("PT040" in str(e)
+                      and "sharding plan over mesh" in str(e)
+                      and exe.stats["jit_runs"] == jit_before)
+    check("sharding_preflight_raises_before_compile", raised)
+    main._shardings = {"x": ("dp", None)}
+    with flags_guard(verify=True):
+        out2 = exe.run(main, feed=feed, fetch_list=[pred], scope=scope)
+    check("sharding_preflight_clean_run_silent",
+          bool(np.isfinite(np.asarray(out2[0])).all())
+          and exe.stats.get("sharding_fingerprint"))
+
+
 def sanitizer_seeded():
     import numpy as np
     from paddle_tpu.analysis import SanitizeError, sanitize
@@ -296,6 +397,7 @@ def main():
     memory_seeded()  # first: the live-bytes delta wants a quiet process
     lint_sweep()
     comm_seeded()
+    sharding_seeded()
     sanitizer_seeded()
     locks_seeded_and_clean()
     ok = not failures

@@ -3,7 +3,12 @@
 # rules, the PT030-PT033 static memory planner (over-budget lint exits 1
 # naming the high-water op, the executor preflight raises BEFORE any XLA
 # compile, predicted peak within 25% of measured jax.live_arrays), the
-# donation-aliasing sanitizer, and the lock-order race detector must
+# PT040-PT045 static sharding analyzer (zero false positives at a
+# dp x fsdp x tp mesh over every book config, a seeded incompatible
+# spec exits 1 with the priced PT041 reshard, PT045 catches the
+# elastic-floor divisibility break, the executor sharding preflight
+# raises before any jit compile while the clean-spec run is silent),
+# the donation-aliasing sanitizer, and the lock-order race detector must
 # each catch their seeded defect AND stay silent on the clean legs
 # (tools/analysis_smoke.py holds the criteria). Companion to the other
 # five smokes (perf/serve/comm/tune/gen/elastic/router); also invoked

@@ -10,10 +10,10 @@ export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 rc=0
 for cfg in examples/configs/*.py; do
   echo "== paddle_tpu lint $cfg"
-  python -m paddle_tpu lint "$cfg" --comm --memory --budget-gb 64 || rc=1
+  python -m paddle_tpu lint "$cfg" --all --budget-gb 64 || rc=1
 done
 
-echo "== analysis smoke (seeded comm/memory/sanitizer/lock defects)"
+echo "== analysis smoke (seeded comm/memory/sharding/sanitizer/lock defects)"
 python tools/analysis_smoke.py || rc=1
 
 if python -c "import pyflakes" >/dev/null 2>&1; then
