@@ -24,6 +24,11 @@ the gated ratio is the best wave. Greedy parity is judged against
 ``serving.reference_decode`` (full-sequence recompute per token) —
 token-identical, the continuous-batching correctness bar — and the
 continuous engine must finish the whole flood with ONE decode trace.
+
+``bench_fused`` runs the decode-fast-path matrix on the same flood:
+device-side sampling (and optionally the paged-attention kernel) vs
+host sampling — token-identical greedy output, zero host logit syncs
+on the fused path, and fused throughput no worse than host.
 """
 from __future__ import annotations
 
@@ -129,6 +134,84 @@ def bench(requests=12, max_new=12, max_running=8, kv_pages=None,
     }
 
 
+def bench_fused(requests=12, max_new=12, max_running=8, kv_pages=None,
+                page_tokens=8, waves=3, seed=0, attn_config=None,
+                vocab=2048):
+    """The decode-fast-path leg: fused (device-side sampling, and the
+    paged-attention kernel when ``attn_config`` is given) vs host
+    sampling, same flood, interleaved waves. Greedy output must stay
+    token-identical across both engines, the fused engine must run the
+    whole flood without a single host logit sync, and both must hold
+    the one-decode-trace contract. The gated criterion is the PAIRED
+    per-wave ratio (host/fused, best wave) >= 1 — the fused step's win
+    is the [R, V] logits device->host sync plus the host-side per-row
+    sampling it deletes, which scales with VOCAB, so this leg runs a
+    realistic-vocab model (a vocab-29 toy would understate the tax
+    being measured to the noise floor)."""
+    from paddle_tpu import profiler
+    from paddle_tpu.serving import GenerationEngine, reference_decode
+
+    model = build_model(vocab=vocab, seed=seed)
+    cfg = model.config
+    if kv_pages is None:
+        kv_pages = -(-cfg.max_seq // page_tokens) * (max_running + 2)
+    prompts = mixed_prompts(model, requests, max_new, seed=seed)
+    want = [reference_decode(model, p, max_new) for p in prompts]
+
+    fused = GenerationEngine(model, max_running=max_running,
+                             kv_pages=kv_pages, page_tokens=page_tokens,
+                             queue_depth=4 * requests, warm=True,
+                             name="fused", device_sample=True,
+                             attn_config=attn_config)
+    host = GenerationEngine(model, max_running=max_running,
+                            kv_pages=kv_pages, page_tokens=page_tokens,
+                            queue_depth=4 * requests, warm=True,
+                            name="host", device_sample=False)
+    try:
+        t_fused, t_host, outputs = [], [], None
+        for _ in range(waves):
+            tf, results = _flood(fused, prompts, max_new)
+            th, host_results = _flood(host, prompts, max_new)
+            t_fused.append(tf)
+            t_host.append(th)
+            outputs = results
+        fused_stats = fused.stats
+        host_stats = host.stats
+    finally:
+        fused.close()
+        host.close()
+
+    tokens = requests * max_new
+    prof = profiler.generation_counters()
+    return {
+        "requests": requests,
+        "max_new_tokens": max_new,
+        "max_running": max_running,
+        "attn_config": attn_config,
+        "attn_kernel": fused_stats["attn_kernel"],
+        "bit_exact": all(r.tokens == w for r, w in zip(outputs, want)),
+        "host_bit_exact": all(r.tokens == w
+                              for r, w in zip(host_results, want)),
+        "logprobs_present": all(r.logprobs is not None
+                                and len(r.logprobs) == len(r.tokens)
+                                for r in outputs),
+        "fused_s": [round(t, 4) for t in t_fused],
+        "host_s": [round(t, 4) for t in t_host],
+        "fused_tokens_per_s": round(tokens / min(t_fused), 1),
+        "host_tokens_per_s": round(tokens / min(t_host), 1),
+        "speedup": round(max(h / f for h, f in zip(t_host, t_fused)), 3),
+        "fused_decode_traces": fused_stats["decode_traces"],
+        "host_decode_traces": host_stats["decode_traces"],
+        "fused_host_logit_syncs": fused_stats["host_logit_syncs"],
+        "host_host_logit_syncs": host_stats["host_logit_syncs"],
+        "device_sample_steps": fused_stats["device_sample_steps"],
+        "kernel_hits": fused_stats["kernel_hits"],
+        "gen_device_sample_steps": prof.get("gen_device_sample_steps", 0),
+        "completed": fused_stats["completed"],
+        "failed": fused_stats["failed"] + fused_stats["shed"],
+    }
+
+
 def bench_exhaustion(page_tokens=4, seed=1):
     """The degrade-and-record leg: a pool too small for the big request
     sheds it AT SUBMIT with a recorded kv_pool_exhausted event, keeps
@@ -202,6 +285,9 @@ if __name__ == "__main__":
     a = ap.parse_args()
     summary = bench(requests=a.requests, max_new=a.max_new,
                     max_running=a.max_running, waves=a.waves)
+    summary["fused"] = bench_fused(requests=a.requests, max_new=a.max_new,
+                                   max_running=a.max_running,
+                                   waves=a.waves)
     summary["exhaustion"] = bench_exhaustion()
     print(json.dumps(summary, indent=1))
     if a.bank:

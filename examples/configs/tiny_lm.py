@@ -1,0 +1,85 @@
+"""Book config: tiny transformer LM on a synthetic copy-task corpus,
+plus the export step that turns the trained weights into a generative
+artifact the serving stack (and the tune CLI) can walk.
+
+Train it like any book config::
+
+    python -m paddle_tpu train examples/configs/tiny_lm.py
+
+Or run the full train -> artifact flow in one process::
+
+    python -c "from examples.configs.tiny_lm import export; \
+export('artifacts/tiny_lm')"
+
+The exported directory is a valid ``paddle_tpu tune`` target: the tune
+CLI recognizes generative artifacts and enumerates the paged-attention
+decode population for the deployment geometry the serve flags describe::
+
+    python -m paddle_tpu tune artifacts/tiny_lm --dry-run
+    python -m paddle_tpu tune artifacts/tiny_lm --timer model
+
+The winner lands in the per-(device, shape) cache, and a
+``GenerationEngine`` built over the same pool geometry re-hits it when
+it compiles its decode step (doc/tuning.md, doc/serving.md).
+"""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers, models
+
+VOCAB = 32
+SEQ = 32
+BATCH = 16
+HIDDEN = 32
+LAYERS = 2
+HEADS = 4
+
+
+def lm_config():
+    """The serving-side TransformerConfig matching model() exactly —
+    shared so export() can never drift from the trained Program."""
+    from paddle_tpu.models.transformer import TransformerConfig
+    return TransformerConfig(vocab_size=VOCAB, hidden=HIDDEN,
+                             num_layers=LAYERS, num_heads=HEADS,
+                             max_seq=SEQ)
+
+
+def model():
+    toks = layers.data("toks", shape=[SEQ], dtype="int64")
+    toks.shape = (-1, SEQ)
+    tgt = layers.data("tgt", shape=[SEQ], dtype="int64")
+    tgt.shape = (-1, SEQ)
+    logits = models.transformer_lm(toks, vocab_size=VOCAB, hidden=HIDDEN,
+                                   num_layers=LAYERS, num_heads=HEADS)
+    flat = layers.reshape(logits, shape=[-1, VOCAB])
+    cost = layers.mean(layers.softmax_with_cross_entropy(
+        flat, layers.reshape(tgt, shape=[-1, 1])))
+
+    def reader():
+        rng = np.random.RandomState(0)
+        for _ in range(24):
+            xs = rng.randint(0, VOCAB, (SEQ,)).astype(np.int64)
+            yield xs, (xs + 1) % VOCAB
+
+    return {
+        "cost": cost,
+        "feed_list": [toks, tgt],
+        "reader": pt.reader.batch(reader, batch_size=BATCH),
+        "optimizer": pt.optimizer.Adam(learning_rate=0.01),
+        "num_passes": 1,
+    }
+
+
+def export(dirname, num_passes=1):
+    """Train in-process, then serialize the weights as a generative
+    artifact (inference.export_generative). Returns ``dirname``."""
+    from paddle_tpu import inference
+    pt.switch_main_program(pt.Program())
+    pt.switch_startup_program(pt.Program())
+    with pt.scope_guard(pt.Scope()):
+        spec = model()
+        trainer = pt.Trainer(cost=spec["cost"],
+                             optimizer=spec["optimizer"],
+                             feed_list=spec["feed_list"])
+        trainer.train(spec["reader"], num_passes=num_passes)
+        return inference.export_generative(dirname, lm_config())

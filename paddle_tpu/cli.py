@@ -762,6 +762,34 @@ def _tune_populations(program, batch, compute_dtype=None):
     return out
 
 
+def _gen_artifact_populations(dirname):
+    """The paged-attention population a generative artifact's SERVING
+    deployment would dispatch on: one key per pool geometry, built from
+    the artifact's transformer config plus the serve flags
+    (``serve_max_running`` / ``serve_page_tokens``) — the exact
+    ``population_key`` the engine consults at construction, so a winner
+    tuned here is the winner the engine re-hits. Raises ValueError when
+    the artifact's config JSON is unreadable."""
+    from paddle_tpu.flags import FLAGS
+    from paddle_tpu.inference import GEN_CONFIG_FILE
+    from paddle_tpu.kernels.paged_attention import population_key
+    from paddle_tpu.serving.kvcache import pages_for
+    try:
+        with open(os.path.join(dirname, GEN_CONFIG_FILE)) as f:
+            cfg = json.load(f)["config"]
+        hidden, heads = int(cfg["hidden"]), int(cfg["num_heads"])
+        max_seq = int(cfg["max_seq"])
+    except Exception as e:
+        raise ValueError("generative artifact %r: %s unreadable (%s: %s)"
+                         % (dirname, GEN_CONFIG_FILE,
+                            type(e).__name__, e)) from e
+    page_tokens = int(FLAGS.serve_page_tokens)
+    key = population_key(FLAGS.serve_max_running,
+                         pages_for(max_seq, page_tokens),
+                         page_tokens, heads, hidden // max(heads, 1))
+    return [("paged_attention", key)]
+
+
 def cmd_tune(args):
     """Autotune the Pallas kernels a train config's program actually
     uses (paddle_tpu.tune): enumerate each kernel's valid configs for
@@ -769,22 +797,37 @@ def cmd_tune(args):
     candidate, persist winners in the per-(device, shape) cache, and
     print the winners table. ``--dry-run`` only enumerates. Exit 0 on
     success, 1 when a population ends with zero eligible candidates,
-    2 when the config fails to build."""
+    2 when the config fails to build.
+
+    ``config`` may also be a generative-artifact DIRECTORY (an
+    ``export_generative`` output): the population is then the
+    paged-attention decode key for the deployment geometry the serve
+    flags describe, and the cached winner is exactly what
+    ``GenerationEngine`` consults when it compiles its decode step."""
     import paddle_tpu as pt
     from paddle_tpu import tune as tune_mod
     from paddle_tpu.tune import results as results_mod
+    from paddle_tpu import inference as _inf
 
-    main, startup = pt.Program(), pt.Program()
-    try:
-        cfg_mod = _load_config(args.config)
-        with pt.program_guard(main, startup):
-            cfg_mod.model()
-    except Exception as e:
-        print("tune: config %r failed to build: %s: %s"
-              % (args.config, type(e).__name__, e), file=sys.stderr)
-        return 2
-    pops = _tune_populations(main, args.batch,
-                             compute_dtype=args.dtype or None)
+    if os.path.isdir(args.config) and _inf.is_generative_artifact(
+            args.config):
+        try:
+            pops = _gen_artifact_populations(args.config)
+        except ValueError as e:
+            print("tune: %s" % e, file=sys.stderr)
+            return 2
+    else:
+        main, startup = pt.Program(), pt.Program()
+        try:
+            cfg_mod = _load_config(args.config)
+            with pt.program_guard(main, startup):
+                cfg_mod.model()
+        except Exception as e:
+            print("tune: config %r failed to build: %s: %s"
+                  % (args.config, type(e).__name__, e), file=sys.stderr)
+            return 2
+        pops = _tune_populations(main, args.batch,
+                                 compute_dtype=args.dtype or None)
     if not pops:
         print("tune: no tunable kernel populations in %r (conv3x3 / "
               "flash_attention / matmul shapes)" % args.config)
@@ -1124,7 +1167,11 @@ def main(argv=None):
     tn = sub.add_parser(
         "tune", help="autotune the Pallas kernels a train config uses "
                      "(paddle_tpu.tune; winners persist per device+shape)")
-    tn.add_argument("config")
+    tn.add_argument("config",
+                    help="train config .py, or a generative-artifact "
+                         "directory (export_generative output) — the "
+                         "latter tunes the paged-attention decode key "
+                         "for the serve-flag pool geometry")
     tn.add_argument("--batch", type=int, default=8,
                     help="batch size substituted for the feed dim (-1) "
                          "when deriving kernel shapes")

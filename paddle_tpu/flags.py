@@ -487,6 +487,20 @@ DEFINE_int32("serve_page_tokens", 16,
              "waste less tail capacity per sequence but grow the block "
              "tables (max_blocks = ceil(max_seq / page_tokens) gather "
              "indices per row in the fused decode step)")
+DEFINE_bool("serve_device_sample", True,
+            "generation engine: sample the next token INSIDE the jitted "
+            "decode/prefill step (seeded jax.random.categorical keyed "
+            "by fold_in(PRNGKey(seed), token_offset); temperature<=0 is "
+            "argmax) so each step returns [R] tokens + logprobs instead "
+            "of [R, V] logits and the host loop is pure bookkeeping. "
+            "Greedy output is token-identical to host sampling; "
+            "temperature output is a DIFFERENT (but seeded, "
+            "reproducible) stream than the host RandomState path. 0 "
+            "restores host-side sampling bit-identically; a fused build "
+            "failure degrades to the same host path with a recorded "
+            "device_sample_degraded event (fault site serving.sample). "
+            "Resolved once at engine construction — flipping it needs "
+            "a new engine (hot reload)")
 DEFINE_int32("route_replicas", 3,
              "serving router (paddle_tpu.serving.router): how many "
              "`serve` worker processes the replica pool spawns and "

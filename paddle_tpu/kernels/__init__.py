@@ -9,3 +9,4 @@ blocking rules followed here.
 """
 from .flash_attention import flash_attention  # noqa: F401
 from .matmul import matmul  # noqa: F401
+from .paged_attention import paged_attention  # noqa: F401

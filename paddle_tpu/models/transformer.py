@@ -303,7 +303,7 @@ def prefill_step(params, k_pages, v_pages, tokens, length, pages, config):
 
 
 def decode_step(params, k_pages, v_pages, block_tables, positions, tokens,
-                active, config):
+                active, config, attn_config=None):
     """ONE fused token step for the whole running batch.
 
     ``k_pages``/``v_pages``: [L, num_pages+1, page_tokens, nh, dh] (the
@@ -316,23 +316,31 @@ def decode_step(params, k_pages, v_pages, block_tables, positions, tokens,
     Returns (logits [R, V], k_pages, v_pages). Every operand shape is
     fixed by (max_running, pool shape), so the engine compiles this ONCE
     and runs it at any mix of sequence lengths. Attention reads the
-    row's whole gathered table and masks columns > position to -inf:
+    row's K/V through its block table and masks columns > position:
     exp(-inf)=0 exactly, so each row computes the same softmax row a
-    full-sequence forward would."""
-    import jax
+    full-sequence forward would. ``attn_config`` is a paddle_tpu.tune
+    "paged_attention" pick routing the read through the Pallas paged-
+    attention kernel; None (or an invalid pick) runs the always-legal
+    block-table gather."""
     import jax.numpy as jnp
+    from ..kernels.paged_attention import (paged_attention,
+                                           paged_attention_reference,
+                                           resolve_block_config)
     nh, dh = config.num_heads, config.head_dim
     R = tokens.shape[0]
     T = k_pages.shape[2]
     trash = k_pages.shape[1] - 1
-    C = block_tables.shape[1] * T          # max gatherable context
     rows = jnp.arange(R, dtype=jnp.int32)
     pos = positions.astype(jnp.int32)
     x = jnp.take(params["tok_emb"], tokens.astype(jnp.int32), axis=0) \
         + jnp.take(params["pos_emb"], pos, axis=0)
     page = jnp.where(active, block_tables[rows, pos // T], trash)
     slot = pos % T
-    colmask = (jnp.arange(C, dtype=jnp.int32)[None, :] <= pos[:, None])
+    # resolve the kernel pick ONCE per trace: invalid/stale configs
+    # degrade to the gather here, so a bad cache entry can never fail
+    # the decode trace mid-serving
+    use_kernel = resolve_block_config(attn_config, R,
+                                      block_tables.shape[1]) is not None
     for i in range(config.num_layers):
         pre = "blk%d" % i
         h = _ln(x, params[pre + "_ln1_w"], params[pre + "_ln1_b"])
@@ -341,19 +349,96 @@ def decode_step(params, k_pages, v_pages, block_tables, positions, tokens,
         v_new = (h @ params[pre + "_v"]).reshape(R, nh, dh)
         k_pages = k_pages.at[i, page, slot].set(k_new)
         v_pages = v_pages.at[i, page, slot].set(v_new)
-        # block-table gather: [R, max_blocks, T, nh, dh] -> [R, C, nh, dh]
-        kc = k_pages[i][block_tables].reshape(R, C, nh, dh)
-        vc = v_pages[i][block_tables].reshape(R, C, nh, dh)
-        s = jnp.einsum("rhd,rchd->rhc", q, kc) * dh ** -0.5
-        s = jnp.where(colmask[:, None, :], s, -jnp.inf)
-        p = jax.nn.softmax(s, axis=-1)
-        att = jnp.einsum("rhc,rchd->rhd", p, vc).reshape(R, nh * dh)
-        x = x + att @ params[pre + "_proj"]
+        if use_kernel:
+            att = paged_attention(q, k_pages[i], v_pages[i], block_tables,
+                                  pos, config=attn_config)
+        else:
+            att = paged_attention_reference(q, k_pages[i], v_pages[i],
+                                            block_tables, pos)
+        x = x + att.reshape(R, nh * dh) @ params[pre + "_proj"]
         h2 = _ln(x, params[pre + "_ln2_w"], params[pre + "_ln2_b"])
         up = jnp.maximum(h2 @ params[pre + "_up"], 0.0)
         x = x + up @ params[pre + "_down"]
     x = _ln(x, params["final_ln_w"], params["final_ln_b"])
     return x @ params["lm_head"], k_pages, v_pages
+
+
+def device_sample(logits, temperatures, seeds, counters):
+    """Seeded per-row sampling INSIDE the jitted step: ``logits``
+    [R, V]; ``temperatures`` [R] f32 (<= 0 = greedy argmax);
+    ``seeds``/``counters`` [R] int32. Each row's key is
+    ``fold_in(PRNGKey(seed), counter)`` with counter = the sampled
+    token's position in the FULL sequence (prompt + generated) — the
+    stream is a pure function of (seed, position), so it is independent
+    of batch slot and RESUMES at the right point after a preemption
+    recompute. Returns (tokens [R] int32, logprobs [R] f32 — the
+    UNtempered log-softmax at the chosen token, what the retire path
+    reads instead of re-materializing logits)."""
+    import jax
+    import jax.numpy as jnp
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def _sampled(_):
+        def one(row, temp, seed, ctr):
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), ctr)
+            return jax.random.categorical(
+                key, row / jnp.maximum(temp, 1e-6)).astype(jnp.int32)
+        return jax.vmap(one)(logits, temperatures, seeds, counters)
+
+    # the categorical draw prices the FULL [R, V] gumbel trick — behind
+    # a batch-level cond so an all-greedy step (the common serving
+    # steady state, and the parity gates) never pays it; tempered rows
+    # keep the exact per-row stream (the cond branch is the same vmap)
+    sampled = jax.lax.cond(jnp.any(temperatures > 0.0), _sampled,
+                           lambda _: greedy, None)
+    toks = jnp.where(temperatures > 0.0, sampled, greedy)
+    logps = jnp.take_along_axis(
+        jax.nn.log_softmax(logits, axis=-1), toks[:, None], axis=-1)[:, 0]
+    return toks, logps
+
+
+def decode_step_sampled(params, k_pages, v_pages, block_tables, positions,
+                        tokens, active, temperatures, seeds,
+                        config, attn_config=None):
+    """The fused decode FAST PATH: decode_step + :func:`device_sample`
+    in one jit, returning ([R] int32 sampled tokens, [R] f32 logprobs,
+    k_pages, v_pages) — the host transfer per step shrinks from
+    [R, V] logits to two [R] rows and the host loop becomes pure
+    bookkeeping. The per-row RNG counter is derived ON DEVICE as
+    ``positions + 1``: at decode time the row's token offset always
+    equals its cached length + 1 (one token accepted per step, and a
+    preemption resume re-prefills the full prefix), so the fused step
+    adds NO per-step host->device operands beyond the host path —
+    temperatures/seeds only change when the running set changes and
+    the engine caches their device copies."""
+    import jax.numpy as jnp
+    logits, k_pages, v_pages = decode_step(
+        params, k_pages, v_pages, block_tables, positions, tokens,
+        active, config, attn_config=attn_config)
+    toks, logps = device_sample(logits, temperatures, seeds,
+                                jnp.asarray(positions, jnp.int32) + 1)
+    return toks, logps, k_pages, v_pages
+
+
+def prefill_step_sampled(params, k_pages, v_pages, tokens, length, pages,
+                         temperature, seed, config):
+    """prefill_step + device sampling of the FIRST token: returns
+    (token int32, logprob f32, k_pages, v_pages) — no [V] logits row
+    crosses to the host on the fused path. The RNG counter is the
+    sampled token's position in the FULL sequence (= ``length``, the
+    fed prefix), matching the decode step's on-device ``positions + 1``
+    derivation — so a preemption resume, which re-prefills
+    prompt+progress, continues the exact stream the decode steps were
+    drawing from."""
+    import jax.numpy as jnp
+    last, k_pages, v_pages = prefill_step(params, k_pages, v_pages,
+                                          tokens, length, pages, config)
+    toks, logps = device_sample(
+        last[None], jnp.asarray([temperature], jnp.float32),
+        jnp.asarray([seed], jnp.int32),
+        jnp.asarray([length], jnp.int32))
+    return toks[0], logps[0], k_pages, v_pages
 
 
 class TransformerLM(object):
@@ -392,11 +477,39 @@ class TransformerLM(object):
                                 pages, cfg)
         return fn
 
-    def decode_fn(self):
+    def decode_fn(self, attn_config=None):
         cfg = self.config
 
         def fn(params, k_pages, v_pages, block_tables, positions, tokens,
                active):
             return decode_step(params, k_pages, v_pages, block_tables,
-                               positions, tokens, active, cfg)
+                               positions, tokens, active, cfg,
+                               attn_config=attn_config)
+        return fn
+
+    # -- fused (device-sampling) faces ---------------------------------------
+    def prefill_sample_fn(self):
+        cfg = self.config
+
+        def fn(params, k_pages, v_pages, tokens, length, pages,
+               temperature, seed):
+            return prefill_step_sampled(params, k_pages, v_pages, tokens,
+                                        length, pages, temperature, seed,
+                                        cfg)
+        return fn
+
+    def decode_sample_fn(self, attn_config=None):
+        cfg = self.config
+
+        def fn(params, k_pages, v_pages, block_tables, positions, tokens,
+               active, temperatures, seeds):
+            import jax.numpy as jnp
+            toks, logps, k_pages, v_pages = decode_step_sampled(
+                params, k_pages, v_pages, block_tables, positions,
+                tokens, active, temperatures, seeds, cfg,
+                attn_config=attn_config)
+            # ONE [2R] f32 row crosses to the host per step (tokens are
+            # exact in f32 up to vocab 2^24), not two fetches
+            packed = jnp.concatenate([toks.astype(jnp.float32), logps])
+            return packed, k_pages, v_pages
         return fn

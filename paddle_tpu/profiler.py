@@ -258,7 +258,12 @@ def update_generation_counters(**counters):
     ``gen_requests``, ``gen_completed``, ``gen_prefills``,
     ``gen_decode_steps``, ``gen_tokens`` (generated, prompt excluded),
     ``gen_shed_overload`` / ``gen_shed_deadline`` / ``gen_shed_pool``,
-    ``gen_preemptions``, ``gen_failed``; ``gen_max_running`` and
+    ``gen_preemptions``, ``gen_failed``;
+    ``gen_device_sample_steps`` (decode steps whose sampling ran inside
+    the jit), ``gen_host_logit_syncs`` (device edges that materialized
+    a full logits row/batch on the host to sample — 0 on the fused
+    path), ``gen_kernel_hits`` (decode steps routed through the Pallas
+    paged-attention kernel); ``gen_max_running`` and
     ``gen_page_util_max`` are kept as maxima, not sums."""
     for k, v in counters.items():
         if k in _GEN_MAX_KEYS:

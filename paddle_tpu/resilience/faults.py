@@ -48,6 +48,14 @@ site                      where
                           generation-shaped; a delay models a slow
                           device and stretches inter-token latency
                           into the deadline shed path
+``serving.sample``        the generation engine's fused-face build
+                          (device-side sampling jits, once per engine
+                          construction with serve_device_sample on): a
+                          raise degrades THAT engine to host-side
+                          sampling for its lifetime with a recorded
+                          device_sample_degraded event — same tokens
+                          under greedy, the loop keeps serving; never
+                          a crash
 ``serving.route``         the router's proxy edge
                           (paddle_tpu.serving.router), hit once per
                           proxied replica attempt, before the upstream
@@ -200,6 +208,7 @@ SITE_TABLE = {
     "serving.dispatch": ("serving/batcher.py", True, True),
     "serving.reload": ("serving/registry.py", True, False),
     "serving.generate": ("serving/generator.py", True, True),
+    "serving.sample": ("serving/generator.py", True, False),
     "serving.route": ("serving/router.py", True, True),
     "serving.autoscale": ("serving/autoscale.py", True, True),
     "comm.quantize": ("comm/allreduce.py", True, False),

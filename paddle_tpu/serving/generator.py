@@ -14,12 +14,21 @@ step
    prompt-length bucket),
 2. runs **ONE fused decode step** for every running sequence at once —
    sequences at arbitrary, different positions — via
-   ``models/transformer.decode_step``'s block-table gather attention;
-   the step's operand shapes are fixed by (max_running, pool shape), so
-   it is compiled ONCE and the hot loop is trace-free at any mix of
+   ``models/transformer.decode_step``; attention reads K/V through the
+   block tables (the Pallas paged-attention kernel when a
+   paddle_tpu.tune winner picked one, the gather otherwise) and the
+   step's operand shapes are fixed by (max_running, pool shape), so it
+   is compiled ONCE and the hot loop is trace-free at any mix of
    sequence lengths,
-3. **samples** (greedy or temperature) on the host from the returned
-   logits, and
+3. **samples** (greedy or seeded temperature categorical) — ON DEVICE
+   inside the same jit by default (``FLAGS.serve_device_sample``): the
+   step returns ``[R]`` int32 tokens plus the per-row logprob instead
+   of ``[R, V]`` logits, the host loop is pure bookkeeping, and
+   ``gen_host_logit_syncs`` stays 0. With the flag off, sampling runs
+   on host from the returned logits — bit-identical to the pre-fused
+   engine — and host sampling is also the automatic fallback when the
+   fused build fails (fault site ``serving.sample``, recorded
+   ``device_sample_degraded`` event, the engine keeps serving), and
 4. **retires** finished sequences immediately — their slot and pages
    recycle into the next step's admission, mid-flight.
 
@@ -27,12 +36,14 @@ Degrade-and-record, never crash: pool exhaustion at submit is a shed
 with a recorded ``kv_pool_exhausted`` event; mid-flight starvation (only
 possible under ``reserve="prompt"``) preempts the starved sequence back
 to the queue head (recompute-on-resume — greedy decode re-derives the
-same continuation) or sheds it when preemption cannot help; a raise at
+same continuation, and a resumed request's device RNG stream continues
+at its sequence position) or sheds it when preemption cannot help; a raise at
 fault site ``serving.generate`` fails that step's sequences with a
 ``generate_failed`` event and the loop keeps serving.
 
 Knobs: ``FLAGS.serve_max_running`` / ``serve_kv_pages`` /
-``serve_page_tokens`` / ``serve_queue_depth``. Metrics mirror into
+``serve_page_tokens`` / ``serve_queue_depth`` /
+``serve_device_sample``. Metrics mirror into
 ``profiler.generation_counters()`` and the timeline artifact's
 ``generation`` section.
 """
@@ -101,25 +112,33 @@ def reference_decode(model, prompt, max_new_tokens, temperature=0.0,
 
 
 class GenResult(object):
-    """What a finished generation resolves to."""
+    """What a finished generation resolves to. ``logprobs`` is the
+    per-token log-softmax of the raw (untempered) logits at the chosen
+    token — populated by the device-sampling fast path (it rides back
+    with the token, so the retire path never re-materializes logits);
+    ``None`` on the host-sampling path."""
 
     __slots__ = ("tokens", "finish_reason", "ttft_ms", "latency_ms",
-                 "preemptions")
+                 "preemptions", "logprobs")
 
     def __init__(self, tokens, finish_reason, ttft_ms, latency_ms,
-                 preemptions):
+                 preemptions, logprobs=None):
         self.tokens = tokens
         self.finish_reason = finish_reason
         self.ttft_ms = ttft_ms
         self.latency_ms = latency_ms
         self.preemptions = preemptions
+        self.logprobs = logprobs
 
     def describe(self):
-        return {"tokens": list(self.tokens),
-                "finish_reason": self.finish_reason,
-                "ttft_ms": round(self.ttft_ms, 3),
-                "latency_ms": round(self.latency_ms, 3),
-                "preemptions": self.preemptions}
+        out = {"tokens": list(self.tokens),
+               "finish_reason": self.finish_reason,
+               "ttft_ms": round(self.ttft_ms, 3),
+               "latency_ms": round(self.latency_ms, 3),
+               "preemptions": self.preemptions}
+        if self.logprobs is not None:
+            out["logprobs"] = [round(lp, 6) for lp in self.logprobs]
+        return out
 
 
 class GenRequest(object):
@@ -131,9 +150,9 @@ class GenRequest(object):
     and its RNG stream continues where it stopped."""
 
     __slots__ = ("prompt", "max_new_tokens", "temperature", "seed",
-                 "deadline_t", "enqueue_t", "tokens", "preemptions",
-                 "model_version", "_rng", "_ttft_ms", "_done", "_result",
-                 "_error")
+                 "deadline_t", "enqueue_t", "tokens", "logprobs",
+                 "preemptions", "model_version", "_rng", "_ttft_ms",
+                 "_done", "_result", "_error")
 
     def __init__(self, prompt, max_new_tokens, temperature=0.0, seed=0,
                  deadline_t=None):
@@ -147,6 +166,9 @@ class GenRequest(object):
         self.deadline_t = deadline_t
         self.enqueue_t = time.monotonic()
         self.tokens = []
+        # device-sampling path only: one logprob per sampled token
+        # (carried with tokens, so preemption keeps them aligned)
+        self.logprobs = []
         self.preemptions = 0
         self._rng = np.random.RandomState(self.seed)
         self._ttft_ms = None
@@ -167,7 +189,10 @@ class GenRequest(object):
         self._result = GenResult(
             list(self.tokens), finish_reason,
             self._ttft_ms if self._ttft_ms is not None else 0.0,
-            (time.monotonic() - self.enqueue_t) * 1e3, self.preemptions)
+            (time.monotonic() - self.enqueue_t) * 1e3, self.preemptions,
+            logprobs=(list(self.logprobs)
+                      if len(self.logprobs) == len(self.tokens)
+                      else None))
         self._done.set()
 
     def fail(self, exc):
@@ -215,11 +240,21 @@ class GenerationEngine(object):
       allocated on demand at block boundaries; higher admission
       throughput, and mid-flight starvation is handled by preemption
       (recompute-on-resume) with a recorded ``kv_pool_exhausted`` event.
+
+    ``device_sample`` — sample inside the jitted step (None defers to
+    ``FLAGS.serve_device_sample``); a fused-face build failure degrades
+    to host sampling with a recorded event (fault site
+    ``serving.sample``). ``attn_config`` — a paddle_tpu.tune
+    "paged_attention" pick for the decode step's attention; None
+    consults the winner cache (miss/stock winner -> the gather path).
+    Both are resolved ONCE here: the compiled-once decode contract
+    means they cannot change on a live engine.
     """
 
     def __init__(self, model, max_running=None, kv_pages=None,
                  page_tokens=None, queue_depth=None, reserve="full",
-                 eos_id=None, name="model", warm=False):
+                 eos_id=None, name="model", warm=False,
+                 device_sample=None, attn_config=None):
         import jax
         from ..flags import FLAGS
         if reserve not in ("full", "prompt"):
@@ -243,11 +278,44 @@ class GenerationEngine(object):
         self.pool = PagePool(kv_pages, page_tokens, L, nh, dh)
         self._kp, self._vp = self.pool.zeros()
         self._check_pool_install("serving.engine_pool_install")
+        if attn_config is None:
+            # one dispatch decision per engine: the decode step is
+            # compiled ONCE, so the winner-cache consult happens here,
+            # not per trace. A miss or a stock winner keeps the gather.
+            from .. import tune as _tune
+            from ..kernels.paged_attention import population_key
+            attn_config = _tune.lookup(
+                "paged_attention",
+                population_key(self.max_running, self.max_blocks,
+                               page_tokens, nh, dh), enabled=False)
+        self.attn_config = attn_config or None
         # the two compiled faces: decode ONCE per (max_running, pool),
         # prefill once per prompt-length bucket; pools are donated so
         # the cache is updated in place step to step
-        self._decode = jax.jit(model.decode_fn(), donate_argnums=(1, 2))
+        self._decode = jax.jit(model.decode_fn(self.attn_config),
+                               donate_argnums=(1, 2))
         self._prefill = jax.jit(model.prefill_fn(), donate_argnums=(1, 2))
+        # the fused (device-sampling) faces: same math + seeded
+        # categorical in-jit; build failure degrades to host sampling
+        # and the engine keeps serving (fault site serving.sample)
+        if device_sample is None:
+            device_sample = bool(FLAGS.serve_device_sample)
+        self.device_sample = False
+        self._decode_s = self._prefill_s = None
+        self._sample_meta = None   # cached (temps, seeds) device copies
+        if device_sample:
+            try:
+                fault_point("serving.sample")
+                self._decode_s = jax.jit(
+                    model.decode_sample_fn(self.attn_config),
+                    donate_argnums=(1, 2))
+                self._prefill_s = jax.jit(model.prefill_sample_fn(),
+                                          donate_argnums=(1, 2))
+                self.device_sample = True
+            except BaseException as e:
+                record_event("device_sample_degraded",
+                             site="serving.sample", model=name,
+                             error=repr(e))
         # prompt-length buckets share the batcher's padding policy (ONE
         # powers-of-two-capped algorithm for both tiers)
         self._buckets = padding_buckets(self.max_context)
@@ -288,17 +356,28 @@ class GenerationEngine(object):
         trash_row = np.full((self.max_blocks,), self.pool.trash_page,
                             np.int32)
         for S_b in (self._buckets if buckets is None else buckets):
-            _, self._kp, self._vp = self._prefill(
-                self.model.params, self._kp, self._vp,
-                jnp.asarray(np.zeros((S_b,), np.int32)), np.int32(1),
-                jnp.asarray(trash_row))
+            if self.device_sample:
+                _, _, self._kp, self._vp = self._prefill_s(
+                    self.model.params, self._kp, self._vp,
+                    jnp.asarray(np.zeros((S_b,), np.int32)), np.int32(1),
+                    jnp.asarray(trash_row), np.float32(0.0), np.int32(0))
+            else:
+                _, self._kp, self._vp = self._prefill(
+                    self.model.params, self._kp, self._vp,
+                    jnp.asarray(np.zeros((S_b,), np.int32)), np.int32(1),
+                    jnp.asarray(trash_row))
         R = self.max_running
-        _, self._kp, self._vp = self._decode(
-            self.model.params, self._kp, self._vp,
-            jnp.asarray(np.tile(trash_row, (R, 1))),
-            jnp.asarray(np.zeros((R,), np.int32)),
-            jnp.asarray(np.zeros((R,), np.int32)),
-            jnp.asarray(np.zeros((R,), bool)))
+        tables = jnp.asarray(np.tile(trash_row, (R, 1)))
+        zeros_i = jnp.asarray(np.zeros((R,), np.int32))
+        if self.device_sample:
+            _, self._kp, self._vp = self._decode_s(
+                self.model.params, self._kp, self._vp, tables, zeros_i,
+                zeros_i, jnp.asarray(np.zeros((R,), bool)),
+                jnp.asarray(np.zeros((R,), np.float32)), zeros_i)
+        else:
+            _, self._kp, self._vp = self._decode(
+                self.model.params, self._kp, self._vp, tables, zeros_i,
+                zeros_i, jnp.asarray(np.zeros((R,), bool)))
         return (time.monotonic() - t0) * 1e3
 
     # -- submit side ---------------------------------------------------------
@@ -509,22 +588,37 @@ class GenerationEngine(object):
 
     def _start(self, req, slot):
         """Prefill ``req`` into its freshly allocated block table and
-        sample its first token; may retire immediately (budget 1/eos)."""
+        sample its first token; may retire immediately (budget 1/eos).
+        On the fused path the first token is sampled ON DEVICE (its RNG
+        counter = the token's position in the full sequence, so a
+        preemption resume — which re-prefills prompt+progress —
+        continues the stream); only [1] token + logprob cross to the
+        host — no [V] logits row."""
         import jax.numpy as jnp
         prompt = req.pending_prompt
         table = BlockTable(self.pool)
         table.ensure(self._reserve_tokens(req))
         t0 = time.monotonic()
+        tok = logp = logits = None
         try:
             fault_point("serving.generate")
             S_b = bucket_for(len(prompt), self._buckets)
             padded = np.zeros((S_b,), np.int32)
             padded[:len(prompt)] = prompt
-            last, self._kp, self._vp = self._prefill(
-                self.model.params, self._kp, self._vp,
-                jnp.asarray(padded), np.int32(len(prompt)),
-                jnp.asarray(table.as_row(self.max_blocks)))
-            logits = np.asarray(last)
+            if self.device_sample:
+                tok_d, logp_d, self._kp, self._vp = self._prefill_s(
+                    self.model.params, self._kp, self._vp,
+                    jnp.asarray(padded), np.int32(len(prompt)),
+                    jnp.asarray(table.as_row(self.max_blocks)),
+                    np.float32(req.temperature),
+                    np.int32(req.seed & 0x7FFFFFFF))
+                tok, logp = int(tok_d), float(logp_d)
+            else:
+                last, self._kp, self._vp = self._prefill(
+                    self.model.params, self._kp, self._vp,
+                    jnp.asarray(padded), np.int32(len(prompt)),
+                    jnp.asarray(table.as_row(self.max_blocks)))
+                logits = np.asarray(last)
         except BaseException as e:
             table.release()
             with self._cond:
@@ -552,9 +646,17 @@ class GenerationEngine(object):
             self._seqs.sort(key=lambda s: s.slot)
             self._max_running_seen = max(self._max_running_seen,
                                          len(self._seqs))
-        self._update_prof(gen_prefills=1, gen_tokens=1,
-                          gen_max_running=len(self._seqs))
-        self._accept_token(run, logits)
+        if self.device_sample:
+            self._update_prof(gen_prefills=1, gen_tokens=1,
+                              gen_max_running=len(self._seqs))
+            self._record_token(run, tok, logp)
+        else:
+            self._update_prof(gen_prefills=1, gen_tokens=1,
+                              gen_max_running=len(self._seqs),
+                              gen_host_logit_syncs=1)
+            with self._cond:
+                self._counts["host_logit_syncs"] += 1
+            self._accept_token(run, logits)
 
     # -- the fused decode step ------------------------------------------------
     def _step(self):
@@ -568,19 +670,49 @@ class GenerationEngine(object):
         positions = np.zeros((R,), np.int32)
         tokens = np.zeros((R,), np.int32)
         active = np.zeros((R,), bool)
+        fused = self.device_sample
+        if fused:
+            temps = np.zeros((R,), np.float32)
+            seeds = np.zeros((R,), np.int32)
         for s in seqs:
             tables[s.slot] = s.table.as_row(MB)
             positions[s.slot] = s.cached
             tokens[s.slot] = s.last_token
             active[s.slot] = True
+            if fused:
+                temps[s.slot] = s.req.temperature
+                seeds[s.slot] = s.req.seed & 0x7FFFFFFF
         t0 = time.monotonic()
         try:
             fault_point("serving.generate")
-            logits, self._kp, self._vp = self._decode(
-                self.model.params, self._kp, self._vp,
-                jnp.asarray(tables), jnp.asarray(positions),
-                jnp.asarray(tokens), jnp.asarray(active))
-            rows = np.asarray(logits)
+            if fused:
+                # temps/seeds only change when the running SET changes
+                # (admit/retire/preempt), so their device copies are
+                # cached — the fused step uploads the same operands per
+                # step as the host path; each row's RNG counter is
+                # derived on device as positions + 1 (= its token
+                # offset, which RESUMES after preemption)
+                cached = self._sample_meta
+                if (cached is None
+                        or not np.array_equal(temps, cached[0])
+                        or not np.array_equal(seeds, cached[1])):
+                    cached = (temps, seeds, jnp.asarray(temps),
+                              jnp.asarray(seeds))
+                    self._sample_meta = cached
+                packed, self._kp, self._vp = self._decode_s(
+                    self.model.params, self._kp, self._vp,
+                    jnp.asarray(tables), jnp.asarray(positions),
+                    jnp.asarray(tokens), jnp.asarray(active),
+                    cached[2], cached[3])
+                packed = np.asarray(packed)
+                tok_rows = packed[:R].astype(np.int32)
+                logp_rows = packed[R:]
+            else:
+                logits, self._kp, self._vp = self._decode(
+                    self.model.params, self._kp, self._vp,
+                    jnp.asarray(tables), jnp.asarray(positions),
+                    jnp.asarray(tokens), jnp.asarray(active))
+                rows = np.asarray(logits)
         except BaseException as e:
             self._fail_running(e)
             self._ensure_pools()
@@ -590,16 +722,27 @@ class GenerationEngine(object):
         # token counters flush ONCE per fused step (every running row
         # accepts exactly one token below) — per-row updates on the hot
         # loop are the profiler contract violation its docstring names
+        kernel_hit = 1 if self.attn_config else 0
         with self._cond:
             self._counts["decode_steps"] += 1
             self._counts["tokens"] += len(seqs)
+            self._counts["kernel_hits"] += kernel_hit
+            self._counts["device_sample_steps" if fused
+                          else "host_logit_syncs"] += 1
             self._occupancy_sum += len(seqs)
             self._page_util_max = max(self._page_util_max, util)
-        self._update_prof(gen_decode_steps=1, gen_page_util_max=util,
-                          gen_tokens=len(seqs))
+        prof = {"gen_decode_steps": 1, "gen_page_util_max": util,
+                "gen_tokens": len(seqs), "gen_kernel_hits": kernel_hit}
+        prof["gen_device_sample_steps" if fused
+             else "gen_host_logit_syncs"] = 1
+        self._update_prof(**prof)
         for s in seqs:
             s.cached += 1
-            self._accept_token(s, rows[s.slot])
+            if fused:
+                self._record_token(s, int(tok_rows[s.slot]),
+                                   float(logp_rows[s.slot]))
+            else:
+                self._accept_token(s, rows[s.slot])
 
     def _ensure_pools(self):
         """A raise from INSIDE a donated jitted call (device OOM,
@@ -686,10 +829,21 @@ class GenerationEngine(object):
 
     # -- sampling / retirement ------------------------------------------------
     def _accept_token(self, s, logits):
+        """Host-sampling path: sample from the materialized [V] logits
+        row, then book-keep."""
+        tok = sample_token(logits, s.req.temperature, s.req._rng)
+        self._record_token(s, tok, None)
+
+    def _record_token(self, s, tok, logp=None):
+        """Pure bookkeeping for ONE accepted token — the whole host-side
+        job of the fused path: append (token, logprob), stamp latency,
+        and retire on eos/length/deadline straight off the returned
+        token, never off re-materialized logits."""
         req = s.req
         now = time.monotonic()
-        tok = sample_token(logits, req.temperature, req._rng)
         req.tokens.append(tok)
+        if logp is not None:
+            req.logprobs.append(logp)
         s.last_token = tok
         if req._ttft_ms is None:
             req._ttft_ms = (now - req.enqueue_t) * 1e3
@@ -794,8 +948,19 @@ class GenerationEngine(object):
                 "intertoken_ms_p99": _percentile(itl, 0.99),
                 "tokens_per_s": (c.get("tokens", 0) / self._busy_s
                                  if self._busy_s > 0 else 0.0),
-                "decode_traces": self._trace_count(self._decode),
-                "prefill_traces": self._trace_count(self._prefill),
+                "device_sample": self.device_sample,
+                "device_sample_steps": c.get("device_sample_steps", 0),
+                "host_logit_syncs": c.get("host_logit_syncs", 0),
+                "attn_kernel": bool(self.attn_config),
+                "kernel_hits": c.get("kernel_hits", 0),
+                # the ACTIVE faces' trace counts — the compiled-once
+                # contract is on the path actually serving
+                "decode_traces": self._trace_count(
+                    self._decode_s if self.device_sample
+                    else self._decode),
+                "prefill_traces": self._trace_count(
+                    self._prefill_s if self.device_sample
+                    else self._prefill),
             }
         snap["shed"] = (snap["shed_overload"] + snap["shed_deadline"]
                         + snap["shed_pool"])

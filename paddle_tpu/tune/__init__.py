@@ -42,13 +42,14 @@ from .cache import (WinnerCache, cache_key, clear_memory_cache,
                     default_cache_dir)
 from .loop import TuneResult, XLA_CONFIG, autotune, default_timer
 from .space import (Conv3x3Space, FlashAttentionSpace, KernelSpace,
-                    MatmulSpace, get_space, signature, space_names)
+                    MatmulSpace, PagedAttentionSpace, get_space,
+                    signature, space_names)
 from .timer import (model_timer, parity_ok, parity_report, table_timer,
                     time_best, wall_timer)
 
 __all__ = [
     "KernelSpace", "Conv3x3Space", "FlashAttentionSpace", "MatmulSpace",
-    "get_space", "space_names", "signature",
+    "PagedAttentionSpace", "get_space", "space_names", "signature",
     "autotune", "TuneResult", "XLA_CONFIG", "default_timer",
     "WinnerCache", "cache_key", "default_cache_dir", "clear_memory_cache",
     "wall_timer", "model_timer", "table_timer", "time_best",

@@ -19,9 +19,10 @@ algorithms, times each, and keeps the winner per shape. A
 A *key* is a plain dict describing one shape/dtype population instance
 (e.g. ``{"n": 128, "h": 28, "w": 28, "c": 128, "o": 128, "dtype":
 "bfloat16"}``); ``signature(key)`` renders it canonically for the
-winner cache. Three spaces ship: conv3x3, flash_attention, matmul
-(kernels/{conv3x3,flash_attention,matmul}.py — each refactored to take
-the config these spaces emit instead of hard-coded constants).
+winner cache. Four spaces ship: conv3x3, flash_attention, matmul and
+paged_attention (kernels/{conv3x3,flash_attention,matmul,
+paged_attention}.py — each taking the config these spaces emit instead
+of hard-coded constants).
 """
 from __future__ import annotations
 
@@ -30,7 +31,8 @@ import itertools
 import numpy as np
 
 __all__ = ["KernelSpace", "Conv3x3Space", "FlashAttentionSpace",
-           "MatmulSpace", "get_space", "space_names", "signature"]
+           "MatmulSpace", "PagedAttentionSpace", "get_space",
+           "space_names", "signature"]
 
 # usable VMEM budget per core: ~16 MB hardware minus headroom for
 # double buffering and the compiler's own scratch
@@ -324,8 +326,94 @@ class MatmulSpace(KernelSpace):
         return fn
 
 
+class PagedAttentionSpace(KernelSpace):
+    """Block space of kernels/paged_attention.py — the generation
+    engine's decode-step attention over the paged KV pool.
+
+    key: {r, mb, t, nh, dh, dtype} (max_running, max_blocks per row,
+    page_tokens, heads, head dim — ``kernels.paged_attention.
+    population_key`` is the one encoder). ``block_r`` rows and
+    ``block_kv`` pages per row ride one grid step; each (row, page)
+    pair is a separate resident page in VMEM, so validity is
+    divisibility plus the MAX_PAGES_RESIDENT cap and the VMEM budget.
+    Candidate 0 of the autotune loop is stock XLA — which for this
+    space IS the block-table gather path the engine runs today."""
+
+    name = "paged_attention"
+    params = {
+        "block_r": (1, 2, 4, 8),
+        "block_kv": (1, 2, 4, 8),
+    }
+
+    def default_config(self, key):
+        from ..kernels.paged_attention import DEFAULT_CONFIG
+        return dict(DEFAULT_CONFIG)
+
+    def is_valid(self, config, key):
+        from ..kernels.paged_attention import resolve_block_config
+        return resolve_block_config(config, key["r"], key["mb"]) \
+            is not None
+
+    def vmem_bytes(self, config, key):
+        from ..kernels.paged_attention import resolve_block_config
+        resolved = resolve_block_config(config, key["r"], key["mb"])
+        if resolved is None:
+            return VMEM_BUDGET + 1
+        br, bkv = resolved
+        it = _itemsize(key["dtype"])
+        nh, dh, t = key["nh"], key["dh"], key["t"]
+        q_tile = br * nh * dh * it
+        kv = 2 * br * bkv * t * nh * dh * it   # resident k+v pages
+        o_tile = br * nh * dh * it
+        scratch = br * nh * 4 * 2 + br * nh * dh * 4
+        # q/kv/out tiles double-buffer; the f32 scratch does not
+        return 2 * (q_tile + kv + o_tile) + scratch
+
+    def make_operands(self, key, seed=0):
+        """A running batch mid-flight: ragged positions, one row parked
+        entirely on the trash page, one first-token row — the shapes the
+        parity gate must hold on."""
+        import jax.numpy as jnp
+        rng = np.random.RandomState(seed)
+        R, MB, T = key["r"], key["mb"], key["t"]
+        nh, dh = key["nh"], key["dh"]
+        pages = max(2, min(R * MB, 4 * MB))
+        trash = pages
+        kp = jnp.asarray(rng.randn(pages + 1, T, nh, dh), key["dtype"])
+        vp = jnp.asarray(rng.randn(pages + 1, T, nh, dh), key["dtype"])
+        q = jnp.asarray(rng.randn(R, nh, dh), key["dtype"])
+        tables = np.full((R, MB), trash, np.int32)
+        positions = np.zeros((R,), np.int32)
+        for r in range(R):
+            if r == 0:
+                continue                       # row 0: all-trash parked
+            positions[r] = 0 if r == 1 else int(rng.randint(0, MB * T))
+            used = positions[r] // T + 1
+            tables[r, :used] = rng.randint(0, pages, used)
+        return (q, kp, vp, jnp.asarray(tables), jnp.asarray(positions))
+
+    def build(self, config, key):
+        import jax
+        from ..kernels.paged_attention import paged_attention
+        cfg = dict(config)
+
+        @jax.jit
+        def fn(q, kp, vp, tables, positions):
+            return paged_attention(q, kp, vp, tables, positions,
+                                   config=cfg)
+
+        return fn
+
+    def reference(self, key):
+        import jax
+        from ..kernels.paged_attention import paged_attention_reference
+
+        return jax.jit(paged_attention_reference)
+
+
 _SPACES = {sp.name: sp for sp in
-           (Conv3x3Space(), FlashAttentionSpace(), MatmulSpace())}
+           (Conv3x3Space(), FlashAttentionSpace(), MatmulSpace(),
+            PagedAttentionSpace())}
 
 
 def get_space(name):

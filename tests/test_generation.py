@@ -681,3 +681,163 @@ def test_mixed_shapes_route_to_homogeneous_batches():
         assert len(set(sigs)) == 1          # homogeneous by construction
     assert max(len(sigs) for sigs in dispatched) > 1   # real coalescing
     assert len(dispatched) < len(feeds)
+
+
+# -- fused decode fast path ---------------------------------------------------
+
+def test_device_sample_greedy_identity_three_paths(model):
+    # host sampling / device sampling / device sampling + interpret-mode
+    # paged-attention kernel: all token-identical to the reference, all
+    # through ONE decode trace, with the right counters on each path
+    prompts = [[1, 2, 3], [4, 5, 6, 7, 8], [9], [2, 4, 6, 8, 10, 12]]
+    want = [reference_decode(model, p, 8) for p in prompts]
+    for kw, fused, kernel in (
+            ({"device_sample": False}, False, False),
+            ({"device_sample": True}, True, False),
+            ({"device_sample": True,
+              "attn_config": {"block_r": 2, "block_kv": 1}}, True, True)):
+        with _engine(model, **kw) as eng:
+            handles = [eng.submit(p, max_new_tokens=8) for p in prompts]
+            got = [h.wait(timeout=300) for h in handles]
+            st = eng.stats
+        assert all(g.tokens == w for g, w in zip(got, want)), kw
+        assert st["decode_traces"] == 1
+        assert st["device_sample"] is fused
+        assert st["attn_kernel"] is kernel
+        if fused:
+            assert st["device_sample_steps"] > 0
+            assert st["host_logit_syncs"] == 0
+            assert all(g.logprobs is not None
+                       and len(g.logprobs) == len(g.tokens) for g in got)
+        else:
+            assert st["device_sample_steps"] == 0
+            assert st["host_logit_syncs"] > 0
+            assert all(g.logprobs is None for g in got)
+        if kernel:
+            assert st["kernel_hits"] == st["decode_steps"]
+        else:
+            assert st["kernel_hits"] == 0
+
+
+def test_device_sample_golden_stream(model):
+    # the tempered stream is PINNED: token at sequence position n is
+    # categorical(fold_in(PRNGKey(seed & 0x7FFFFFFF), n), logits/temp) —
+    # recompute it from the full-sequence forward and the raw jax ops
+    import jax
+    import jax.numpy as jnp
+    prompt, temp, seed, n_new = [3, 1, 4], 0.7, 12345, 6
+    seq = list(prompt)
+    expect = []
+    for _ in range(n_new):
+        logits = tm.forward(model.params,
+                            np.asarray([seq], np.int32),
+                            model.config)[0, len(seq) - 1]
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(seed & 0x7FFFFFFF), len(seq))
+        tok = int(jax.random.categorical(key, logits / temp))
+        expect.append(tok)
+        seq.append(tok)
+    with _engine(model, device_sample=True) as eng:
+        got = eng.generate(prompt, max_new_tokens=n_new,
+                           temperature=temp, seed=seed, timeout=300)
+    assert got.tokens == expect
+    # and the stream is reproducible across engines
+    with _engine(model, device_sample=True) as eng:
+        again = eng.generate(prompt, max_new_tokens=n_new,
+                             temperature=temp, seed=seed, timeout=300)
+    assert again.tokens == expect
+
+
+def test_device_sample_logprobs_are_log_softmax(model):
+    import jax
+    prompt = [5, 6, 7]
+    with _engine(model, device_sample=True) as eng:
+        res = eng.generate(prompt, max_new_tokens=5, timeout=300)
+    seq = list(prompt)
+    for tok, lp in zip(res.tokens, res.logprobs):
+        logits = tm.forward(model.params,
+                            np.asarray([seq], np.int32),
+                            model.config)[0, len(seq) - 1]
+        want = float(jax.nn.log_softmax(logits)[tok])
+        assert abs(lp - want) < 1e-3
+        seq.append(tok)
+
+
+def test_device_sample_preemption_resumes_stream(model):
+    # tempered generation through a preempting engine must equal the
+    # unpreempted engine's stream — the RNG counter is the token's
+    # sequence position, so recompute-on-resume continues, not restarts
+    prompts = [[1, 2, 3, 4, 5, 6], [7, 8, 9, 10, 11, 12]]
+    with _engine(model, device_sample=True) as big:
+        want = [big.generate(p, max_new_tokens=8, temperature=0.6,
+                             seed=i + 5, timeout=300).tokens
+                for i, p in enumerate(prompts)]
+    pre = GenerationEngine(model, max_running=2, kv_pages=5,
+                           page_tokens=4, reserve="prompt",
+                           name="preempt_rng", device_sample=True)
+    try:
+        handles = [pre.submit(p, max_new_tokens=8, temperature=0.6,
+                              seed=i + 5)
+                   for i, p in enumerate(prompts)]
+        got = [h.wait(timeout=300).tokens for h in handles]
+        st = pre.stats
+    finally:
+        pre.close()
+    assert st["preemptions"] >= 1      # the scenario really preempted
+    assert got == want
+
+
+def test_serving_sample_fault_degrades_to_host(model):
+    from paddle_tpu.resilience import faults
+    prompt = [1, 2, 3]
+    want = reference_decode(model, prompt, 6)
+    faults.arm("serving.sample", "raise", nth=1, times=1)
+    with _engine(model, device_sample=True) as eng:
+        res = eng.generate(prompt, max_new_tokens=6, timeout=300)
+        st = eng.stats
+    assert res.tokens == want          # output unchanged on the host path
+    assert st["device_sample"] is False
+    assert st["host_logit_syncs"] > 0
+    assert res.logprobs is None
+    evs = resilience.events(kind="device_sample_degraded")
+    assert len(evs) == 1 and evs[0]["site"] == "serving.sample"
+
+
+def test_serve_device_sample_flag_resolves_at_construction(model):
+    from paddle_tpu.flags import flags_guard
+    with flags_guard(serve_device_sample=False):
+        with _engine(model) as eng:
+            res = eng.generate([1, 2, 3], max_new_tokens=4, timeout=300)
+            assert eng.stats["device_sample"] is False
+    assert res.tokens == reference_decode(model, [1, 2, 3], 4)
+    with flags_guard(serve_device_sample=True):
+        with _engine(model) as eng:
+            assert eng.stats["device_sample"] is True
+
+
+def test_fused_profiler_counters_flush_once_per_step(model):
+    profiler.reset_generation_counters()
+    with _engine(model, device_sample=True) as eng:
+        eng.generate([1, 2, 3], max_new_tokens=5, timeout=300)
+    c = profiler.generation_counters()
+    assert c["gen_decode_steps"] == 4
+    assert c["gen_device_sample_steps"] == 4
+    assert c.get("gen_host_logit_syncs", 0) == 0
+    assert c.get("gen_kernel_hits", 0) == 0    # gather default: no kernel
+    profiler.reset_generation_counters()
+    with _engine(model, device_sample=True,
+                 attn_config={"block_r": 2, "block_kv": 1}) as eng:
+        eng.generate([1, 2, 3], max_new_tokens=5, timeout=300)
+    c = profiler.generation_counters()
+    assert c["gen_kernel_hits"] == 4
+    profiler.reset_generation_counters()
+
+
+def test_gen_result_describe_carries_logprobs(model):
+    with _engine(model, device_sample=True) as eng:
+        res = eng.generate([1, 2, 3], max_new_tokens=4, timeout=300)
+    out = res.describe()
+    assert len(out["logprobs"]) == len(out["tokens"])
+    with _engine(model, device_sample=False) as eng:
+        res = eng.generate([1, 2, 3], max_new_tokens=4, timeout=300)
+    assert "logprobs" not in res.describe()

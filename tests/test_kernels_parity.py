@@ -184,3 +184,80 @@ def test_matmul_parity_grid(shape):
     # a blocked config must agree too (the autotune loop's gate)
     cfg = {"block_m": 8, "block_n": 128, "block_k": 128}
     assert parity_report(ref, matmul(x, w, None, cfg)) is None
+
+
+# -- paged attention --------------------------------------------------------
+
+def _paged_operands(R, pages, MB, T, nh, dh, seed, all_trash_row=None,
+                    zero_pos_row=None):
+    """Decode-shaped operands over the pool layout [pages+1, T, nh, dh]
+    (last page = trash sink): ragged positions, per-row block tables,
+    optionally one parked (all-trash) row and one pos==0 row."""
+    rng = np.random.RandomState(seed)
+    kp = jnp.asarray(rng.randn(pages + 1, T, nh, dh) * 0.3, jnp.float32)
+    vp = jnp.asarray(rng.randn(pages + 1, T, nh, dh) * 0.3, jnp.float32)
+    q = jnp.asarray(rng.randn(R, nh, dh), jnp.float32)
+    tables = rng.randint(0, pages, (R, MB)).astype(np.int32)
+    positions = rng.randint(0, MB * T, (R,)).astype(np.int32)
+    if all_trash_row is not None:
+        tables[all_trash_row] = pages          # trash page everywhere
+        positions[all_trash_row] = 0
+    if zero_pos_row is not None:
+        positions[zero_pos_row] = 0
+    return q, kp, vp, jnp.asarray(tables), jnp.asarray(positions)
+
+
+PAGED_GRID = [
+    # (R, pages, MB, T, nh, dh, block_r, block_kv)
+    (4, 6, 3, 8, 2, 16, 1, 1),      # default config
+    (4, 6, 3, 8, 2, 16, 2, 1),      # row blocking
+    (8, 10, 4, 4, 2, 8, 4, 2),      # row x kv blocking
+    (8, 12, 6, 8, 4, 8, 2, 3),      # block_kv not a power of two
+    (2, 4, 2, 16, 1, 32, 2, 2),     # whole table resident per row
+]
+
+
+@pytest.mark.parametrize("shape", PAGED_GRID)
+def test_paged_attention_parity_grid(shape):
+    from paddle_tpu.kernels.paged_attention import (
+        paged_attention, paged_attention_reference)
+    R, pages, MB, T, nh, dh, br, bkv = shape
+    q, kp, vp, tables, positions = _paged_operands(
+        R, pages, MB, T, nh, dh, hash(shape) % 2**31,
+        all_trash_row=0, zero_pos_row=1)
+    ref = paged_attention_reference(q, kp, vp, tables, positions)
+    got = paged_attention(q, kp, vp, tables, positions,
+                          config={"block_r": br, "block_kv": bkv})
+    assert parity_report(ref, got) is None
+
+
+def test_paged_attention_mixed_batch_under_jit():
+    # the engine's shape: mixed ragged/parked/fresh rows, kernel under
+    # jax.jit (scalar-prefetch grid must compose with tracing)
+    from paddle_tpu.kernels.paged_attention import (
+        paged_attention, paged_attention_reference)
+    q, kp, vp, tables, positions = _paged_operands(
+        8, 10, 4, 8, 2, 16, 77, all_trash_row=3, zero_pos_row=5)
+    fn = jax.jit(lambda *a: paged_attention(
+        *a, config={"block_r": 2, "block_kv": 2}))
+    got = fn(q, kp, vp, tables, positions)
+    ref = paged_attention_reference(q, kp, vp, tables, positions)
+    assert parity_report(ref, got) is None
+
+
+def test_paged_attention_invalid_config_degrades_to_reference():
+    # a stale/invalid winner (block_r not dividing R, oversized tile)
+    # must DEGRADE to the gather reference, never fail — the
+    # conv3x3/flash dispatch contract
+    from paddle_tpu.kernels.paged_attention import (
+        paged_attention, paged_attention_reference, resolve_block_config)
+    q, kp, vp, tables, positions = _paged_operands(4, 6, 3, 8, 2, 16, 5)
+    ref = paged_attention_reference(q, kp, vp, tables, positions)
+    for bad in ({"block_r": 3, "block_kv": 1},    # 3 does not divide R=4
+                {"block_r": 1, "block_kv": 2},    # 2 does not divide MB=3
+                {"block_r": 8, "block_kv": 3},    # br*bkv > resident cap
+                {"block_r": 0, "block_kv": 1}):
+        assert resolve_block_config(bad, 4, 3) is None
+        got = paged_attention(q, kp, vp, tables, positions, config=bad)
+        assert parity_report(ref, got) is None
+    assert resolve_block_config(None, 4, 3) is None

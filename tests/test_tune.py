@@ -309,3 +309,119 @@ def test_cli_tune_end_to_end_caches_winners(tiny_config, tmp_path,
     rec = json.loads(out.read_text())
     assert rec["schema"] == "paddle_tpu.bench.v1"
     assert rec["rows"] and rec["rows"][0]["kernel"] == "conv3x3"
+
+# -- paged attention space ---------------------------------------------------
+
+PA_KEY = {"r": 4, "mb": 3, "t": 4, "nh": 2, "dh": 8, "dtype": "float32"}
+
+
+def test_paged_attention_space_candidates_and_validity():
+    sp = tune.get_space("paged_attention")
+    cands = sp.candidates(PA_KEY)
+    assert cands[0] == sp.default_config(PA_KEY)
+    for cfg in cands:
+        assert sp.is_valid(cfg, PA_KEY)
+        assert sp.vmem_bytes(cfg, PA_KEY) <= tune.space.VMEM_BUDGET
+        # block_r must divide r=4, block_kv must divide mb=3
+        assert 4 % cfg["block_r"] == 0
+        assert 3 % cfg["block_kv"] == 0
+    # (1,2,4) x (1,): block_r=8 is pruned by r=4 divisibility and of
+    # block_kv (1,2,4,8) only 1 divides mb=3
+    assert len(cands) == 3
+    assert sp.candidates(PA_KEY, budget=2) == cands[:2]
+
+
+def test_paged_attention_population_key_is_engine_signature():
+    # the CLI's artifact walk and the engine's dispatch consult must
+    # produce the same signature or winners can never be re-hit
+    from paddle_tpu.kernels.paged_attention import population_key
+    assert population_key(4, 3, 4, 2, 8) == PA_KEY
+
+
+def test_paged_attention_autotune_end_to_end_model_timer():
+    res = tune.autotune("paged_attention", PA_KEY,
+                        timer=tune.model_timer())
+    assert res.ok and res.winner is not None
+    # stock gather rides as candidate 0 and every timed candidate
+    # passed the parity gate against the gather reference
+    assert res.records[0]["config"] == tune.XLA_CONFIG
+    assert all(r["status"] == "ok" for r in res.records)
+    tune.clear_memory_cache()
+    assert tune.WinnerCache().get_config(res.cache_key) == res.winner
+
+
+def test_paged_attention_winner_rehit_by_second_process(_isolated_tune):
+    import subprocess
+    import sys
+    target = {"block_r": 2, "block_kv": 1}
+    table = {frozenset(target.items()): 0.01,
+             frozenset(tune.XLA_CONFIG.items()): 0.5}
+    res = tune.autotune("paged_attention", PA_KEY,
+                        timer=tune.table_timer(table, default=1.0))
+    assert res.ok and res.winner == target
+    code = (
+        "import os\n"
+        "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+        "from paddle_tpu import tune\n"
+        "cfg = tune.lookup('paged_attention', %r)\n"
+        "print('HIT', sorted((cfg or {}).items()))\n" % (PA_KEY,))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PADDLE_TPU_FLAGS="tune_cache_dir=%s,tune=true"
+               % _isolated_tune)
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    assert "HIT [('block_kv', 1), ('block_r', 2)]" in out.stdout
+
+
+def test_paged_attention_dispatch_reaches_engine():
+    # a cached kernel winner for the pool geometry is picked up by a
+    # GenerationEngine at construction (the compiled-once consult)
+    from paddle_tpu.kernels.paged_attention import population_key
+    from paddle_tpu.models import transformer as tm
+    from paddle_tpu.serving import GenerationEngine, reference_decode
+    cfg = tm.TransformerConfig(vocab_size=17, hidden=16, num_layers=1,
+                               num_heads=2, max_seq=12)
+    model = tm.TransformerLM(tm.init_params(cfg, seed=1), cfg)
+    key = population_key(2, 3, 4, 2, 8)
+    target = {"block_r": 2, "block_kv": 1}
+    table = {frozenset(target.items()): 0.01}
+    res = tune.autotune("paged_attention", key,
+                        timer=tune.table_timer(table, default=1.0))
+    assert res.winner == target
+    eng = GenerationEngine(model, max_running=2, kv_pages=8,
+                           page_tokens=4, name="dispatch")
+    try:
+        assert eng.attn_config == target
+        out = eng.generate([1, 2, 3], max_new_tokens=4, timeout=300)
+        st = eng.stats
+    finally:
+        eng.close()
+    assert st["attn_kernel"] is True and st["kernel_hits"] > 0
+    assert out.tokens == reference_decode(model, [1, 2, 3], 4)
+    c = tune.counters()
+    assert c["tune_hits"] >= 1
+
+
+def test_cli_tune_generative_artifact_dry_run(tmp_path, capsys):
+    from paddle_tpu import cli
+    from paddle_tpu.flags import FLAGS
+    from paddle_tpu.inference import export_generative
+    from paddle_tpu.kernels.paged_attention import population_key
+    from paddle_tpu.models import transformer as tm
+    from paddle_tpu.serving import pages_for
+    cfg = tm.TransformerConfig(vocab_size=17, hidden=16, num_layers=1,
+                               num_heads=2, max_seq=16)
+    art = str(tmp_path / "lm_artifact")
+    export_generative(art, cfg, params=tm.init_params(cfg, seed=0))
+    rc = cli.main(["tune", art, "--dry-run"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "paged_attention" in out and "dry run" in out
+    # the printed candidate count is the real space arithmetic + stock
+    key = population_key(FLAGS.serve_max_running,
+                         pages_for(cfg.max_seq, FLAGS.serve_page_tokens),
+                         FLAGS.serve_page_tokens, 2, 8)
+    n = len(tune.get_space("paged_attention").candidates(key)) + 1
+    line = [l for l in out.splitlines() if "paged_attention" in l][0]
+    assert line.split()[-1] == str(n)

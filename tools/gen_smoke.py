@@ -6,11 +6,18 @@ under a mixed-length flood — the whole point of iteration-level
 scheduling is that finished sequences stop costing device time, so if
 it cannot clearly beat one-at-a-time on the SAME machinery, the tier is
 overhead, (c) run the entire flood through ONE compiled decode trace
-(no per-length recompiles — the trace-free hot loop claim), and (d)
+(no per-length recompiles — the trace-free hot loop claim), (d)
 degrade-and-record on kv pool exhaustion: an infeasible request sheds
 at submit with a recorded ``kv_pool_exhausted`` event, the engine loop
 keeps serving, and a mid-flight starvation under prompt-only
-reservation resolves by preemption with identical greedy output.
+reservation resolves by preemption with identical greedy output, and
+(e) hold the decode-fast-path contract: the fused engine (device-side
+sampling) stays token-identical to the host-sampling engine AND the
+reference, syncs ZERO [R, V] logit rows to the host, keeps the one
+decode trace, is no slower than host sampling on the paired interleaved
+waves, and an armed ``serving.sample`` fault degrades the engine to
+host sampling with a recorded ``device_sample_degraded`` event while
+output stays identical.
 
 The measurement itself lives in benchmark/gen_bench.py — ONE
 implementation shared by this gate and the evidence record, so the
@@ -35,13 +42,50 @@ WAVES = 2
 MIN_RATIO = 2.0
 
 
+def _degrade_leg():
+    """Armed ``serving.sample``: the fused-face build fails, the engine
+    records ``device_sample_degraded``, keeps serving on host sampling,
+    and greedy output is unchanged."""
+    from paddle_tpu import resilience
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.serving import GenerationEngine, reference_decode
+    from benchmark.gen_bench import build_model
+
+    model = build_model(max_seq=64, seed=2)
+    resilience.clear_events()
+    faults.arm("serving.sample", "raise", nth=1, times=1)
+    try:
+        eng = GenerationEngine(model, max_running=2, kv_pages=20,
+                               page_tokens=4, warm=True, name="degrade",
+                               device_sample=True)
+        try:
+            prompt = [1, 2, 3, 4]
+            res = eng.generate(prompt, max_new_tokens=6, timeout=300)
+            st = eng.stats
+        finally:
+            eng.close()
+    finally:
+        faults.disarm("serving.sample")
+    return {
+        "degraded_to_host": not st["device_sample"],
+        "tokens_ok": res.tokens == reference_decode(model, prompt, 6),
+        "events": len(resilience.events(kind="device_sample_degraded")),
+        "host_logit_syncs": st["host_logit_syncs"],
+    }
+
+
 def main():
-    from benchmark.gen_bench import bench, bench_exhaustion
+    from benchmark.gen_bench import bench, bench_exhaustion, bench_fused
 
     summary = bench(requests=REQUESTS, max_new=MAX_NEW,
                     max_running=MAX_RUNNING, waves=WAVES)
+    fused = bench_fused(requests=REQUESTS, max_new=MAX_NEW,
+                        max_running=MAX_RUNNING, waves=3)
+    summary["fused"] = fused
     ex = bench_exhaustion()
     summary["exhaustion"] = ex
+    deg = _degrade_leg()
+    summary["sample_degrade"] = deg
 
     failures = []
     if not summary["bit_exact"]:
@@ -70,6 +114,31 @@ def main():
     if not ex["preempt_parity"]:
         failures.append("preempted sequence's greedy output drifted "
                         "from the reference (recompute-on-resume broken)")
+    if not fused["bit_exact"] or not fused["host_bit_exact"]:
+        failures.append("fused decode path drifted from the reference "
+                        "(fused %s, host %s)" % (fused["bit_exact"],
+                                                 fused["host_bit_exact"]))
+    if fused["fused_host_logit_syncs"] != 0:
+        failures.append(
+            "fused path synced %d [R, V] logit rows to the host "
+            "(gate: 0 — sampling must stay on device)"
+            % fused["fused_host_logit_syncs"])
+    if fused["fused_decode_traces"] != 1:
+        failures.append("fused decode compiled %d traces (gate: 1)"
+                        % fused["fused_decode_traces"])
+    if not fused["logprobs_present"]:
+        failures.append("fused path lost per-token logprobs")
+    if fused["speedup"] < 1.0:
+        failures.append(
+            "fused decode step x%.3f vs host sampling on every paired "
+            "wave (gate: >= x1.0 on the best wave — deleting the logit "
+            "sync must not LOSE)" % fused["speedup"])
+    if not deg["degraded_to_host"] or not deg["tokens_ok"]:
+        failures.append("armed serving.sample did not degrade cleanly: "
+                        "%r" % deg)
+    if deg["events"] < 1:
+        failures.append("serving.sample degrade left no recorded "
+                        "device_sample_degraded event")
     summary["ok"] = not failures
     print(json.dumps(summary))
     if failures:

@@ -4,7 +4,11 @@
 # >= 2x token throughput over per-request decode under a mixed-length
 # flood, exactly ONE compiled decode trace (no per-length recompiles),
 # and degrade-and-record (never crash) on kv pool exhaustion — CPU
-# tier-1, in-process, no device or sockets needed. Companion to
+# tier-1, in-process, no device or sockets needed. The fused decode
+# fast path rides the same gate: device-side sampling token-identical
+# to host sampling, zero host logit syncs, no slower than host on the
+# paired interleaved waves, and an armed serving.sample fault degrades
+# to host sampling with a recorded event. Companion to
 # tools/serve_smoke.sh (one-shot micro-batching tier). One retry damps
 # shared-CI scheduler noise before calling a throughput loss real.
 set -uo pipefail
