@@ -29,6 +29,11 @@ continuous engine must finish the whole flood with ONE decode trace.
 device-side sampling (and optionally the paged-attention kernel) vs
 host sampling — token-identical greedy output, zero host logit syncs
 on the fused path, and fused throughput no worse than host.
+
+``bench_speculative`` runs the draft-propose / fused-verify rounds
+(self-draft, 100% greedy acceptance) against the plain fused engine on
+the same flood — the paired ratio isolates dispatch-count
+amortization, the only speculation win a CPU box measures honestly.
 """
 from __future__ import annotations
 
@@ -212,6 +217,90 @@ def bench_fused(requests=12, max_new=12, max_running=8, kv_pages=None,
     }
 
 
+def bench_speculative(requests=12, max_new=12, max_running=8,
+                      kv_pages=None, page_tokens=8, waves=3, seed=0,
+                      spec_k=4, vocab=29, hidden=16, num_layers=1,
+                      num_heads=2, max_seq=64):
+    """The speculative-decoding leg: draft-propose / fused-verify vs
+    the plain fused engine, same flood, interleaved waves. The draft is
+    the TARGET ITSELF (self-draft): greedy acceptance is 100% by
+    construction, every round emits ``spec_k + 1`` tokens in exactly
+    TWO dispatches (one draft scan, one k-wide verify), and the paired
+    per-wave ratio isolates the one mechanism a CPU box can measure
+    honestly — dispatch-count amortization. A genuinely small draft's
+    acceptance economics are a TPU question (doc/serving.md); here a
+    "small" draft would not be meaningfully cheaper and the ratio
+    would measure model size, not the round structure. For the same
+    reason this leg runs a SMALL model (the other legs' vocab-2048
+    geometry is compute-bound on CPU, where a self-draft round's ~2x
+    FLOPs swamps the dispatch structure it exists to measure; the
+    small geometry is dispatch/host-overhead-bound, the regime a real
+    TPU decode step is in for its memory-bandwidth reasons). Greedy
+    output must stay token-identical across both engines and the
+    reference at any k, the speculative flood must report
+    acceptance > 0 with zero host logit syncs, and the propose/verify
+    programs must each compile exactly once."""
+    from paddle_tpu.serving import GenerationEngine, reference_decode
+
+    model = build_model(vocab=vocab, hidden=hidden, num_layers=num_layers,
+                        num_heads=num_heads, max_seq=max_seq, seed=seed)
+    cfg = model.config
+    if kv_pages is None:
+        kv_pages = -(-cfg.max_seq // page_tokens) * (max_running + 2)
+    prompts = mixed_prompts(model, requests, max_new, seed=seed)
+    want = [reference_decode(model, p, max_new) for p in prompts]
+
+    spec = GenerationEngine(model, max_running=max_running,
+                            kv_pages=kv_pages, page_tokens=page_tokens,
+                            queue_depth=4 * requests, warm=True,
+                            name="spec", draft_model=model,
+                            spec_k=spec_k)
+    plain = GenerationEngine(model, max_running=max_running,
+                             kv_pages=kv_pages, page_tokens=page_tokens,
+                             queue_depth=4 * requests, warm=True,
+                             name="plain_fused", device_sample=True)
+    try:
+        t_spec, t_plain, outputs, plain_results = [], [], None, None
+        for _ in range(waves):
+            ts, results = _flood(spec, prompts, max_new)
+            tp, plain_results = _flood(plain, prompts, max_new)
+            t_spec.append(ts)
+            t_plain.append(tp)
+            outputs = results
+        spec_stats = spec.stats
+        plain_stats = plain.stats
+    finally:
+        spec.close()
+        plain.close()
+
+    tokens = requests * max_new
+    return {
+        "requests": requests,
+        "max_new_tokens": max_new,
+        "max_running": max_running,
+        "spec_k": spec_k,
+        "bit_exact": all(r.tokens == w for r, w in zip(outputs, want)),
+        "plain_bit_exact": all(r.tokens == w
+                               for r, w in zip(plain_results, want)),
+        "spec_s": [round(t, 4) for t in t_spec],
+        "plain_s": [round(t, 4) for t in t_plain],
+        "spec_tokens_per_s": round(tokens / min(t_spec), 1),
+        "plain_tokens_per_s": round(tokens / min(t_plain), 1),
+        "speedup": round(max(p / s for p, s in zip(t_plain, t_spec)), 3),
+        "acceptance_rate": spec_stats["acceptance_rate"],
+        "spec_steps": spec_stats["spec_steps"],
+        "draft_tokens": spec_stats["draft_tokens"],
+        "accepted_tokens": spec_stats["accepted_tokens"],
+        "spec_degraded": spec_stats["spec_degraded"],
+        "spec_host_logit_syncs": spec_stats["host_logit_syncs"],
+        "spec_propose_traces": spec_stats["spec_propose_traces"],
+        "spec_verify_traces": spec_stats["spec_verify_traces"],
+        "plain_decode_traces": plain_stats["decode_traces"],
+        "completed": spec_stats["completed"],
+        "failed": spec_stats["failed"] + spec_stats["shed"],
+    }
+
+
 def bench_exhaustion(page_tokens=4, seed=1):
     """The degrade-and-record leg: a pool too small for the big request
     sheds it AT SUBMIT with a recorded kv_pool_exhausted event, keeps
@@ -288,6 +377,9 @@ if __name__ == "__main__":
     summary["fused"] = bench_fused(requests=a.requests, max_new=a.max_new,
                                    max_running=a.max_running,
                                    waves=a.waves)
+    summary["speculative"] = bench_speculative(
+        requests=a.requests, max_new=a.max_new,
+        max_running=a.max_running, waves=a.waves)
     summary["exhaustion"] = bench_exhaustion()
     print(json.dumps(summary, indent=1))
     if a.bank:

@@ -329,7 +329,7 @@ def _parse_extra_models(pairs, primary=None):
 
 
 def _validate_artifacts(verb, artifact_dir, extra_models, kv_pages=None,
-                        page_tokens=None):
+                        page_tokens=None, draft_dir=None):
     """Validate the primary + every extra artifact up front; prints the
     problems and returns False on a bad one (nothing gets started).
     ``kv_pages``/``page_tokens``: the CLI's pool overrides — PT034 must
@@ -337,13 +337,24 @@ def _validate_artifacts(verb, artifact_dir, extra_models, kv_pages=None,
     default. Beyond the per-model check, the AGGREGATE of every
     co-hosted generative model (weights + pool each) is checked
     against the budget: one process loads them all, so each fitting
-    alone proves nothing."""
+    alone proves nothing. ``draft_dir`` (a ``--draft_dir`` speculation
+    draft) joins the aggregate the same way — it costs its weights plus
+    its own page pool; a speculative ARTIFACT needs no extra entry,
+    its draft side is already priced into its own bytes."""
     from paddle_tpu import inference
     from paddle_tpu.analysis import memory as memory_mod
     budget = memory_mod.resolve_budget_bytes()
+    if draft_dir and not inference.is_generative_artifact(draft_dir):
+        print("%s: cannot serve: --draft_dir %r is not a generative "
+              "artifact (speculation drafts are export_generative "
+              "directories)" % (verb, draft_dir), file=sys.stderr)
+        return False
     total, gen_labels = 0, []
-    for label, dirname in [("artifact", artifact_dir)] + [
-            ("extra model %r" % n, d) for n, d in extra_models]:
+    entries = [("artifact", artifact_dir)] + [
+        ("extra model %r" % n, d) for n, d in extra_models]
+    if draft_dir:
+        entries.append(("speculation draft", draft_dir))
+    for label, dirname in entries:
         generative = inference.is_generative_artifact(dirname)
         problems = (inference.validate_generative_artifact(
                         dirname, kv_pages=kv_pages,
@@ -384,6 +395,7 @@ def cmd_serve(args):
     from the same process (how a router replica serves a predict model
     and a generate model side by side)."""
     from paddle_tpu import inference, serving
+    from paddle_tpu.flags import FLAGS
 
     try:
         extra_models = _parse_extra_models(args.extra_model,
@@ -392,9 +404,15 @@ def cmd_serve(args):
         print("serve: %s" % e, file=sys.stderr)
         return 1
     generative = inference.is_generative_artifact(args.artifact_dir)
+    draft_dir = args.draft_dir or FLAGS.serve_draft_dir or None
+    if draft_dir and not generative:
+        print("serve: --draft_dir only pairs with a generative primary "
+              "artifact", file=sys.stderr)
+        return 1
     if not _validate_artifacts("serve", args.artifact_dir, extra_models,
                                kv_pages=args.kv_pages or None,
-                               page_tokens=args.page_tokens or None):
+                               page_tokens=args.page_tokens or None,
+                               draft_dir=draft_dir):
         return 1
     service = serving.InferenceService(
         max_batch=args.max_batch or None,
@@ -408,11 +426,24 @@ def cmd_serve(args):
         gen_overrides["kv_pages"] = args.kv_pages
     if args.page_tokens:
         gen_overrides["page_tokens"] = args.page_tokens
+    # speculation plumbing for the PRIMARY model only: an external
+    # --draft_dir loads here; a speculative artifact needs nothing —
+    # the registry auto-detects and pairs it on load
+    primary_overrides = dict(gen_overrides)
+    if args.spec_k:
+        primary_overrides["spec_k"] = args.spec_k
     loading = args.artifact_dir
     try:
+        if draft_dir:
+            loading = draft_dir
+            primary_overrides["draft_model"] = \
+                inference.load_generative(draft_dir)
+            primary_overrides.setdefault("spec_k",
+                                         FLAGS.serve_spec_k)
+        loading = args.artifact_dir
         entry = service.load_model(
             args.name, args.artifact_dir,
-            **(gen_overrides if generative else {}))
+            **(primary_overrides if generative else {}))
         for extra_name, extra_dir in extra_models:
             loading = extra_dir
             service.load_model(
@@ -441,6 +472,11 @@ def cmd_serve(args):
                      "kv_pages": entry.engine.pool.num_pages,
                      "page_tokens": entry.engine.pool.page_tokens,
                      "max_context": entry.engine.max_context})
+        st = entry.engine.stats
+        if st["speculative"] or st["spec_degraded"]:
+            info.update({"speculative": st["speculative"],
+                         "spec_k": st["spec_k"],
+                         "spec_degraded": st["spec_degraded"]})
     print(json.dumps({"serving": info}), flush=True)
     try:
         signum = serving.httpd.serve_until_shutdown(server)
@@ -485,9 +521,14 @@ def cmd_route(args):
         return 1
     if not _validate_artifacts("route", args.artifact_dir, extra_models,
                                kv_pages=args.kv_pages or None,
-                               page_tokens=args.page_tokens or None):
+                               page_tokens=args.page_tokens or None,
+                               draft_dir=args.draft_dir or None):
         return 1
     serve_args = []
+    if args.draft_dir:
+        serve_args += ["--draft_dir", args.draft_dir]
+    if args.spec_k:
+        serve_args += ["--spec_k", str(args.spec_k)]
     if args.max_batch:
         serve_args += ["--max_batch", str(args.max_batch)]
     if args.batch_timeout_ms >= 0:
@@ -1033,6 +1074,16 @@ def main(argv=None):
     sv.add_argument("--page_tokens", type=int, default=0,
                     help="generative artifacts: override "
                          "FLAGS.serve_page_tokens (0 = flag)")
+    sv.add_argument("--draft_dir", default="",
+                    help="generative artifacts: pair a draft model "
+                         "(an export_generative directory, same "
+                         "vocabulary) for speculative decoding; empty "
+                         "defers to FLAGS.serve_draft_dir / a paired "
+                         "speculative artifact's own draft")
+    sv.add_argument("--spec_k", type=int, default=0,
+                    help="generative artifacts: speculation depth "
+                         "override (0 = FLAGS.serve_spec_k or the "
+                         "paired artifact's qualified k)")
     sv.add_argument("--extra_model", action="append", default=[],
                     metavar="NAME=DIR",
                     help="additional artifact(s) to publish from the "
@@ -1123,6 +1174,12 @@ def main(argv=None):
                     help="forwarded to every replica (0 = flag)")
     rt.add_argument("--page_tokens", type=int, default=0,
                     help="forwarded to every replica (0 = flag)")
+    rt.add_argument("--draft_dir", default="",
+                    help="speculation draft forwarded to every replica "
+                         "(empty = none)")
+    rt.add_argument("--spec_k", type=int, default=0,
+                    help="speculation depth forwarded to every replica "
+                         "(0 = flag/artifact default)")
     rt.add_argument("--extra_model", action="append", default=[],
                     metavar="NAME=DIR",
                     help="additional artifact(s) every replica publishes "

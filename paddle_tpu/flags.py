@@ -501,6 +501,25 @@ DEFINE_bool("serve_device_sample", True,
             "device_sample_degraded event (fault site serving.sample). "
             "Resolved once at engine construction — flipping it needs "
             "a new engine (hot reload)")
+DEFINE_string("serve_draft_dir", "",
+              "generation engine: directory of an exported generative "
+              "artifact to load as the DRAFT model for speculative "
+              "decoding (same vocabulary as the target; typically much "
+              "smaller). Empty disables speculation unless the serving "
+              "artifact itself is a paired speculative export "
+              "(inference.export_speculative), which carries its own "
+              "draft and wins. The draft gets its own KV page pool "
+              "sized by serve_kv_pages x serve_page_tokens, priced into "
+              "the PT034 memory check alongside the target's")
+DEFINE_int32("serve_spec_k", 4,
+             "generation engine: speculation depth — how many tokens "
+             "the draft model proposes per round before ONE fused "
+             "target step verifies them all. Per-request spec_k can "
+             "only lower it. Greedy output is token-identical to "
+             "non-speculative decode at any k; higher k wins only "
+             "while the draft's acceptance rate holds up (watch "
+             "acceptance_rate in /statz). 0 disables speculation even "
+             "when a draft is available")
 DEFINE_int32("route_replicas", 3,
              "serving router (paddle_tpu.serving.router): how many "
              "`serve` worker processes the replica pool spawns and "

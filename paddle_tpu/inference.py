@@ -36,11 +36,18 @@ NATIVE_SIGNATURE_FILE = "__signature__.json"
 # what must persist is the params dict and the hyperparameters
 GEN_PARAMS_FILE = "__gen_params__.pkl"
 GEN_CONFIG_FILE = "__gen_config__.json"
+# speculative pairing: a speculative artifact is a normal generative
+# artifact (the TARGET, at top level) plus a nested generative artifact
+# (the DRAFT, in __draft__/) plus the pairing metadata (__spec__.json)
+SPEC_CONFIG_FILE = "__spec__.json"
+DRAFT_SUBDIR = "__draft__"
 
 __all__ = ["export_compiled", "load_compiled", "CompiledModel",
            "ArtifactError", "validate_artifact",
            "export_generative", "load_generative",
-           "validate_generative_artifact", "is_generative_artifact"]
+           "validate_generative_artifact", "is_generative_artifact",
+           "export_speculative", "load_speculative",
+           "is_speculative_artifact"]
 
 
 class ArtifactError(RuntimeError):
@@ -333,6 +340,11 @@ def validate_generative_artifact(dirname, kv_pages=None, page_tokens=None,
             problems.append("missing %s (%s)" % (fname, role))
         elif os.path.getsize(path) == 0:
             problems.append("%s is empty (%s)" % (fname, role))
+    if not problems and is_speculative_artifact(dirname):
+        # paired artifact: target + draft + k validate TOGETHER —
+        # shipping a target whose draft cannot load (or cannot pair)
+        # would only surface as a degrade event after deploy
+        problems += _spec_problems(dirname)
     if not problems and check_pool:
         problems += _kv_pool_problems(dirname, kv_pages=kv_pages,
                                       page_tokens=page_tokens,
@@ -377,8 +389,21 @@ def generative_memory_bytes(dirname, kv_pages=None, page_tokens=None):
     if geo is None:
         return None
     layers, heads, head_dim, model_bytes, pages, ptokens = geo
-    return int(model_bytes) + _mem.kv_pool_bytes(layers, heads, head_dim,
-                                                 pages, ptokens)
+    total = int(model_bytes) + _mem.kv_pool_bytes(layers, heads, head_dim,
+                                                  pages, ptokens)
+    # a speculative pairing co-hosts the DRAFT too: its weights plus its
+    # own page pool (same kv_pages x page_tokens geometry as the
+    # target's — the DraftEngine mirrors it), priced into the same
+    # aggregate so the PT034 co-residency check sees what the serve
+    # process will actually allocate
+    if is_speculative_artifact(dirname):
+        draft = generative_memory_bytes(
+            os.path.join(dirname, DRAFT_SUBDIR), kv_pages=kv_pages,
+            page_tokens=page_tokens)
+        if draft is None:
+            return None
+        total += draft
+    return total
 
 
 def _kv_pool_problems(dirname, kv_pages=None, page_tokens=None,
@@ -396,6 +421,15 @@ def _kv_pool_problems(dirname, kv_pages=None, page_tokens=None,
     if geo is None:
         return []
     layers, heads, head_dim, model_bytes, pages, ptokens = geo
+    if is_speculative_artifact(dirname):
+        # fold the whole draft side (weights + its pool) into the
+        # resident-bytes term, so the diagnostic prices the pairing's
+        # true co-residency, not the target alone
+        draft = generative_memory_bytes(
+            os.path.join(dirname, DRAFT_SUBDIR), kv_pages=kv_pages,
+            page_tokens=page_tokens)
+        if draft is not None:
+            model_bytes = int(model_bytes) + int(draft)
     diags = _mem.check_kv_pool(layers, heads, head_dim, pages, ptokens,
                                model_bytes=model_bytes,
                                budget_bytes=budget)
@@ -471,3 +505,121 @@ def load_generative(dirname):
         return _tm.TransformerLM(params, config)
     except ValueError as e:
         raise ArtifactError("artifact %r: %s" % (dirname, e)) from e
+
+
+# ---------------------------------------------------------------------------
+# Speculative pairings: one directory shipping target + draft + k as a
+# unit, validated as a unit. The target lives at the top level (so every
+# existing generative tool — validators, loaders, the registry — keeps
+# working on it unchanged), the draft is a full generative artifact
+# nested in __draft__/, and __spec__.json records the pairing (the
+# speculation depth the pairing was qualified at).
+
+def _spec_pairing_problems(config, draft_config, spec_k):
+    """The pairing rules, shared by export (refuse to write a broken
+    pairing) and validate (catch one written by hand): identical
+    vocabularies (speculative accept compares token ids), a draft
+    context that covers every position it could propose at, k >= 1."""
+    problems = []
+    try:
+        k = int(spec_k)
+    except (TypeError, ValueError):
+        k = 0
+    if k < 1:
+        problems.append("speculation depth k must be an int >= 1, got "
+                        "%r" % (spec_k,))
+    if config.vocab_size != draft_config.vocab_size:
+        problems.append(
+            "draft vocab_size=%d != target vocab_size=%d — speculative "
+            "accept compares token ids, the vocabularies must be "
+            "identical" % (draft_config.vocab_size, config.vocab_size))
+    if draft_config.max_seq < config.max_seq:
+        problems.append(
+            "draft max_seq=%d < target max_seq=%d — the draft must "
+            "cover every position the target can decode at"
+            % (draft_config.max_seq, config.max_seq))
+    return problems
+
+
+def is_speculative_artifact(dirname):
+    """True when ``dirname`` looks like an export_speculative directory
+    (a generative artifact carrying a __spec__.json pairing)."""
+    return (is_generative_artifact(dirname)
+            and os.path.isfile(os.path.join(dirname, SPEC_CONFIG_FILE)))
+
+
+def _spec_problems(dirname):
+    """Pairing-specific problem list for a speculative artifact whose
+    target side already validated (the validate_generative_artifact
+    spec leg)."""
+    from .models import transformer as _tm
+    try:
+        with open(os.path.join(dirname, SPEC_CONFIG_FILE)) as f:
+            spec = json.load(f)
+        spec_k = spec["spec_k"]
+    except Exception as e:
+        return ["%s is corrupt or incomplete (%s: %s) — re-export with "
+                "export_speculative" % (SPEC_CONFIG_FILE,
+                                        type(e).__name__, e)]
+    draft_dir = os.path.join(dirname, DRAFT_SUBDIR)
+    problems = ["draft artifact (%s/): %s" % (DRAFT_SUBDIR, p)
+                for p in validate_generative_artifact(draft_dir,
+                                                      check_pool=False)]
+    if problems:
+        return problems
+    try:
+        with open(os.path.join(dirname, GEN_CONFIG_FILE)) as f:
+            config = _tm.TransformerConfig.from_dict(
+                json.load(f)["config"])
+        with open(os.path.join(draft_dir, GEN_CONFIG_FILE)) as f:
+            draft_config = _tm.TransformerConfig.from_dict(
+                json.load(f)["config"])
+    except Exception as e:
+        return ["config JSON unreadable while checking the speculative "
+                "pairing (%s: %s)" % (type(e).__name__, e)]
+    return _spec_pairing_problems(config, draft_config, spec_k)
+
+
+def export_speculative(dirname, config, draft_config, spec_k,
+                       params=None, draft_params=None, scope=None,
+                       draft_scope=None):
+    """Serialize a target + draft pairing for speculative decoding —
+    one directory, one deploy unit. Refuses to write a pairing the
+    engine would refuse to build (vocab mismatch, draft context too
+    small, k < 1): a broken pairing caught here is a failed export, not
+    a ``speculation_degraded`` event after the replica warmed up."""
+    from .models import transformer as _tm
+    if isinstance(config, dict):
+        config = _tm.TransformerConfig.from_dict(config)
+    if isinstance(draft_config, dict):
+        draft_config = _tm.TransformerConfig.from_dict(draft_config)
+    problems = _spec_pairing_problems(config, draft_config, spec_k)
+    if problems:
+        raise ValueError("cannot export speculative pairing:\n  - %s"
+                         % "\n  - ".join(problems))
+    export_generative(dirname, config, scope=scope, params=params)
+    export_generative(os.path.join(dirname, DRAFT_SUBDIR), draft_config,
+                      scope=draft_scope, params=draft_params)
+    with open(os.path.join(dirname, SPEC_CONFIG_FILE), "w") as f:
+        json.dump({"spec_k": int(spec_k)}, f)
+    return dirname
+
+
+def load_speculative(dirname):
+    """Load a speculative pairing as ``(target, draft, spec_k)`` —
+    both :class:`~paddle_tpu.models.transformer.TransformerLM` faces,
+    params device-resident. Raises :class:`ArtifactError` with every
+    problem named (pairing problems included — the unit loads together
+    or not at all)."""
+    problems = _spec_problems(dirname) if is_speculative_artifact(dirname) \
+        else ["missing %s (speculative pairing metadata) — export with "
+              "export_speculative" % SPEC_CONFIG_FILE]
+    if problems:
+        raise ArtifactError(
+            "cannot load speculative artifact %r:\n  - %s"
+            % (dirname, "\n  - ".join(problems)))
+    target = load_generative(dirname)
+    draft = load_generative(os.path.join(dirname, DRAFT_SUBDIR))
+    with open(os.path.join(dirname, SPEC_CONFIG_FILE)) as f:
+        spec_k = int(json.load(f)["spec_k"])
+    return target, draft, spec_k

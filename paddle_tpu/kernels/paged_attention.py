@@ -199,6 +199,49 @@ def _pa_pallas(q, k_pages, v_pages, block_tables, positions, block_r,
               *([k_pages] * nkv), *([v_pages] * nkv))
 
 
+def paged_attention_kwide(q, k_pages, v_pages, block_tables, positions,
+                          config=None, interpret=None):
+    """The speculative-verify face: ``K1`` query lanes per row against
+    the SAME paged pool. ``q``: [R, K1, nh, dh] (lane i is the token fed
+    at ``positions[r, i]``, K/V for all lanes already scattered);
+    ``positions``: [R, K1] int32 — each lane masks its own columns, so
+    lane i attends exactly the prefix a plain decode step at that
+    position would. No new kernel: lanes flatten into rows
+    ([R*K1, ...], tables repeated per lane) and ride the single-query
+    face — the per-lane math is the decode step's verbatim, which is
+    what makes greedy verification token-identical to non-speculative
+    decode. ``config`` follows the decode contract: None (or a pick
+    that cannot tile R*K1 rows) runs the gather reference.
+
+    The gather path shares the K/V materialization across lanes: all
+    K1 queries of a row walk the SAME block table, so the pool is
+    gathered once per row ([R, C, ...]) and the lanes ride a batched
+    [K1, C] attention against it — without the sharing, the verify
+    step pays K1 duplicate gathers and K1 separate vector-matrix
+    products, and the k-wide step costs ~K1x a plain decode step
+    instead of ~1x gather + K1x (tiny) matmul FLOPs. The kernel path
+    still flattens (the Pallas face is single-query per row); lanes
+    repeat their tables and ride it unchanged."""
+    R, K1, nh, dh = q.shape
+    if resolve_block_config(config, R * K1, block_tables.shape[1]) is None:
+        T = k_pages.shape[1]
+        C = block_tables.shape[1] * T
+        kc = k_pages[block_tables].reshape(R, C, nh, dh)
+        vc = v_pages[block_tables].reshape(R, C, nh, dh)
+        s = jnp.einsum("rlhd,rchd->rlhc", q, kc) * dh ** -0.5
+        colmask = (jnp.arange(C, dtype=jnp.int32)[None, None, :]
+                   <= positions.astype(jnp.int32)[:, :, None])
+        s = jnp.where(colmask[:, :, None, :], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("rlhc,rchd->rlhd", p, vc)
+    qf = q.reshape(R * K1, nh, dh)
+    tables = jnp.repeat(block_tables, K1, axis=0)
+    pos = positions.reshape(R * K1).astype(jnp.int32)
+    out = paged_attention(qf, k_pages, v_pages, tables, pos,
+                          config=config, interpret=interpret)
+    return out.reshape(R, K1, nh, dh)
+
+
 def paged_attention(q, k_pages, v_pages, block_tables, positions,
                     config=None, interpret=None):
     """One decode step of attention for the whole running batch.

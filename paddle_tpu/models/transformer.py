@@ -441,6 +441,245 @@ def prefill_step_sampled(params, k_pages, v_pages, tokens, length, pages,
     return toks[0], logps[0], k_pages, v_pages
 
 
+# ---------------------------------------------------------------------------
+# Speculative decoding faces (serving/speculative.py drives these).
+#
+# One round: the DRAFT model proposes k tokens autoregressively
+# (``draft_propose_step`` — a lax.scan of k+1 decode steps over the
+# draft's OWN page pool, one trace total), then the TARGET model runs
+# ONE k+1-lane verify step (``verify_step_sampled``) that scatters all
+# k+1 positions' K/V and attends every lane at once, accepts the
+# longest valid draft prefix, and samples the correction/bonus token on
+# device. Only a packed [R, 2(k+1)+1] f32 row crosses to the host —
+# draft logits never leave the device (gen_host_logit_syncs stays 0).
+#
+# RNG discipline: every draw is keyed by the drawn token's absolute
+# position in the full sequence — ``fold_in(PRNGKey(seed), position)``
+# for the plain/bonus draw (the SAME key the non-speculative fused step
+# uses at that position, so a cap-0 row is bit-identical to plain
+# decode), and salted variants of it for the draft proposal, the accept
+# uniform, and the residual draw. Pure functions of (seed, position)
+# means a preemption resume — which re-prefills prompt+progress and
+# restarts the round at the same position — replays the exact
+# accept/reject history.
+#
+# Stale-write safety: verify scatters K/V for ALL k+1 lanes, including
+# drafts that end up rejected. No rollback is needed — attention masks
+# columns past each query's position, and every overshot position is
+# re-scattered (with its true token) by a later round before any
+# unmasked read, because rounds always restart at the first unaccepted
+# position. The engine only trims page-table overshoot (allocator
+# bookkeeping), never cache contents.
+
+_DRAFT_SALT = 0x5D    # the draft model's own proposal draws
+_ACCEPT_SALT = 0x5A   # the accept/reject uniform per draft position
+_RESID_SALT = 0x5E    # the residual draw after a rejection
+
+
+def draft_propose_step(params, k_pages, v_pages, block_tables, positions,
+                       tokens, active, temperatures, seeds, spec_caps,
+                       k, config):
+    """Propose ``k`` tokens per row from the DRAFT model: a lax.scan of
+    k+1 :func:`decode_step` substeps over the draft's own paged pool.
+    Substep j feeds the row's current token at position ``positions+j``
+    (substep 0 feeds the pending last sampled token, later substeps
+    feed the row's own proposals), writes its K/V live only while
+    ``j <= spec_caps[r]`` (capped/plain rows route overshoot to the
+    trash page), and samples the next proposal — greedy argmax, or a
+    categorical keyed ``fold_in(fold_in(PRNGKey(seed), position+j+1),
+    _DRAFT_SALT)`` for tempered rows. The final substep only writes
+    K/V, keeping the draft cache exactly caught up with the target's.
+    Returns (drafts [R, k] int32, draft_logits [R, k, V] f32, k_pages,
+    v_pages); ONE trace per (k, geometry) — the scan body is traced
+    once."""
+    import jax
+    import jax.numpy as jnp
+    pos0 = jnp.asarray(positions, jnp.int32)
+
+    def substep(carry, j):
+        kp, vp, cur = carry
+        write_ok = active & (j <= spec_caps)
+        logits, kp, vp = decode_step(params, kp, vp, block_tables,
+                                     pos0 + j, cur, write_ok, config)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        def one(row, temp, seed, idx):
+            key = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(seed), idx),
+                _DRAFT_SALT)
+            return jax.random.categorical(
+                key, row / jnp.maximum(temp, 1e-6)).astype(jnp.int32)
+
+        sampled = jax.lax.cond(
+            jnp.any(temperatures > 0.0),
+            lambda _: jax.vmap(one)(logits, temperatures, seeds,
+                                    pos0 + j + 1),
+            lambda _: greedy, None)
+        nxt = jnp.where(temperatures > 0.0, sampled, greedy)
+        return (kp, vp, nxt), (nxt, logits)
+
+    (k_pages, v_pages, _), (toks, logits) = jax.lax.scan(
+        substep, (k_pages, v_pages, jnp.asarray(tokens, jnp.int32)),
+        jnp.arange(k + 1, dtype=jnp.int32))
+    drafts = jnp.transpose(toks[:k])                     # [R, k]
+    draft_logits = jnp.transpose(logits[:k], (1, 0, 2))  # [R, k, V]
+    return drafts, draft_logits, k_pages, v_pages
+
+
+def verify_step(params, k_pages, v_pages, block_tables, positions, tokens,
+                active, spec_caps, config, attn_config=None):
+    """ONE target-model step over ``K1 = k+1`` lanes per row: lane i
+    feeds ``tokens[r, i]`` at position ``positions[r]+i`` (lane 0 is
+    the pending last sampled token, lanes 1..k the draft proposals).
+    Per layer, ALL lanes' K/V scatter first, then every lane attends
+    through the block table with its own position mask — so lane i
+    computes exactly the logits a plain decode step would after
+    accepting lanes < i. Lanes past ``spec_caps[r]`` (and inactive
+    rows) write to the trash page. Returns (logits [R, K1, V],
+    k_pages, v_pages)."""
+    import jax.numpy as jnp
+    from ..kernels.paged_attention import paged_attention_kwide
+    nh, dh = config.num_heads, config.head_dim
+    R, K1 = tokens.shape
+    T = k_pages.shape[2]
+    trash = k_pages.shape[1] - 1
+    rows = jnp.arange(R, dtype=jnp.int32)
+    lanes = jnp.arange(K1, dtype=jnp.int32)
+    pos = positions.astype(jnp.int32)[:, None] + lanes[None, :]  # [R, K1]
+    live = active[:, None] & (lanes[None, :] <= spec_caps[:, None])
+    x = jnp.take(params["tok_emb"], tokens.astype(jnp.int32), axis=0) \
+        + jnp.take(params["pos_emb"], pos, axis=0)
+    page = jnp.where(live, block_tables[rows[:, None], pos // T], trash)
+    slot = pos % T
+    for i in range(config.num_layers):
+        pre = "blk%d" % i
+        h = _ln(x, params[pre + "_ln1_w"], params[pre + "_ln1_b"])
+        q = (h @ params[pre + "_q"]).reshape(R, K1, nh, dh)
+        k_new = (h @ params[pre + "_k"]).reshape(R, K1, nh, dh)
+        v_new = (h @ params[pre + "_v"]).reshape(R, K1, nh, dh)
+        k_pages = k_pages.at[i, page, slot].set(k_new)
+        v_pages = v_pages.at[i, page, slot].set(v_new)
+        att = paged_attention_kwide(q, k_pages[i], v_pages[i],
+                                    block_tables, pos, config=attn_config)
+        x = x + att.reshape(R, K1, nh * dh) @ params[pre + "_proj"]
+        h2 = _ln(x, params[pre + "_ln2_w"], params[pre + "_ln2_b"])
+        up = jnp.maximum(h2 @ params[pre + "_up"], 0.0)
+        x = x + up @ params[pre + "_down"]
+    x = _ln(x, params["final_ln_w"], params["final_ln_b"])
+    return x @ params["lm_head"], k_pages, v_pages
+
+
+def speculative_accept(logits, drafts, draft_logits, positions,
+                       temperatures, seeds, spec_caps):
+    """The accept/reject rule, on device. ``logits`` [R, K1, V] target
+    verify logits; ``drafts`` [R, K] / ``draft_logits`` [R, K, V] the
+    proposals; ``spec_caps`` [R] int32 — draft i only counts while
+    ``i < cap`` (cap 0 = plain row).
+
+    Greedy rows (temp <= 0) accept the longest prefix with
+    ``drafts[i] == argmax(logits[:, i])`` and emit
+    ``argmax(logits[:, a])`` as the correction/bonus — by construction
+    the exact token sequence non-speculative greedy decode emits.
+    Tempered rows use canonical rejection sampling: draft i accepts iff
+    ``log u <= log q(d) - log p(d)`` (q = tempered target, p = tempered
+    draft, u keyed ``_ACCEPT_SALT`` at the draft's position); the first
+    rejection resamples from ``norm(max(q - p, 0))`` keyed
+    ``_RESID_SALT``; a fully-accepted row draws its bonus with the
+    PLAIN position key — the same key the non-speculative fused step
+    uses, so cap-0 rows reproduce the plain stream bit-exactly.
+
+    Returns (emitted [R, K1] int32, n_out [R] int32 in 1..K1,
+    logprobs [R, K1] f32 — UNtempered target log-softmax at the emitted
+    token, the same convention as :func:`device_sample`)."""
+    import jax
+    import jax.numpy as jnp
+    R, K1, V = logits.shape
+    K = K1 - 1
+    pos0 = jnp.asarray(positions, jnp.int32)
+    lanes = jnp.arange(K, dtype=jnp.int32)
+    lanes1 = jnp.arange(K1, dtype=jnp.int32)
+    temp = jnp.maximum(temperatures, 1e-6)[:, None, None]
+    is_greedy = temperatures <= 0.0
+
+    greedy_t = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # [R, K1]
+    g_acc = drafts == greedy_t[:, :K]
+    lq = jax.nn.log_softmax(logits[:, :K] / temp, axis=-1)
+    lp = jax.nn.log_softmax(draft_logits / temp, axis=-1)
+    lq_d = jnp.take_along_axis(lq, drafts[..., None], axis=-1)[..., 0]
+    lp_d = jnp.take_along_axis(lp, drafts[..., None], axis=-1)[..., 0]
+    didx = pos0[:, None] + 1 + lanes[None, :]  # draft i's position
+
+    def _accept_u(seed, idx):
+        key = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(seed), idx),
+            _ACCEPT_SALT)
+        return jax.random.uniform(key)
+
+    u = jax.vmap(lambda s, ix: jax.vmap(
+        lambda j: _accept_u(s, j))(ix))(seeds, didx)
+    t_acc = jnp.log(u) <= lq_d - lp_d
+    acc = jnp.where(is_greedy[:, None], g_acc, t_acc)
+    acc = acc & (lanes[None, :] < spec_caps[:, None])
+    a = jnp.cumprod(acc.astype(jnp.int32), axis=1).sum(axis=1)  # [R]
+
+    # correction/bonus token, from lane a's distributions
+    lt_a = jnp.take_along_axis(logits, a[:, None, None], axis=1)[:, 0]
+    ld_a = jnp.take_along_axis(
+        draft_logits, jnp.minimum(a, K - 1)[:, None, None], axis=1)[:, 0]
+    qa = jax.nn.softmax(lt_a / temp[:, :, 0], axis=-1)
+    pa = jax.nn.softmax(ld_a / temp[:, :, 0], axis=-1)
+    resid = jnp.maximum(qa - pa, 0.0)
+    rsum = jnp.sum(resid, axis=-1, keepdims=True)
+    resid = jnp.where(rsum > 0.0, resid, qa)
+
+    def _final_t(seed, idx, rejected, log_resid, lt_scaled):
+        base = jax.random.fold_in(jax.random.PRNGKey(seed), idx)
+        t_resid = jax.random.categorical(
+            jax.random.fold_in(base, _RESID_SALT), log_resid)
+        t_plain = jax.random.categorical(base, lt_scaled)
+        return jnp.where(rejected, t_resid, t_plain).astype(jnp.int32)
+
+    final_t = jax.lax.cond(
+        jnp.any(temperatures > 0.0),
+        lambda _: jax.vmap(_final_t)(
+            seeds, pos0 + a + 1, a < spec_caps,
+            jnp.log(resid + 1e-38), lt_a / temp[:, :, 0]),
+        lambda _: jnp.take_along_axis(greedy_t, a[:, None],
+                                      axis=1)[:, 0], None)
+    final_g = jnp.take_along_axis(greedy_t, a[:, None], axis=1)[:, 0]
+    final = jnp.where(is_greedy, final_g, final_t)
+
+    drafts_pad = jnp.concatenate([drafts, drafts[:, :1]], axis=1)
+    emitted = jnp.where(lanes1[None, :] < a[:, None], drafts_pad,
+                        final[:, None])
+    logps = jnp.take_along_axis(
+        jax.nn.log_softmax(logits, axis=-1), emitted[..., None],
+        axis=-1)[..., 0]
+    return emitted, a + 1, logps
+
+
+def verify_step_sampled(params, k_pages, v_pages, block_tables, positions,
+                        tokens, drafts, draft_logits, active, temperatures,
+                        seeds, spec_caps, config, attn_config=None):
+    """The fused speculative verify: :func:`verify_step` over
+    ``[last_token, drafts...]`` + :func:`speculative_accept` in one
+    jit. Returns (packed [R, 2*K1+1] f32 — emitted tokens [K1], n_out,
+    logprobs [K1] per row, ONE host transfer — , k_pages, v_pages)."""
+    import jax.numpy as jnp
+    tokens_k1 = jnp.concatenate(
+        [jnp.asarray(tokens, jnp.int32)[:, None], drafts], axis=1)
+    logits, k_pages, v_pages = verify_step(
+        params, k_pages, v_pages, block_tables, positions, tokens_k1,
+        active, spec_caps, config, attn_config=attn_config)
+    emitted, n_out, logps = speculative_accept(
+        logits, drafts, draft_logits, positions, temperatures, seeds,
+        spec_caps)
+    packed = jnp.concatenate(
+        [emitted.astype(jnp.float32), n_out.astype(jnp.float32)[:, None],
+         logps], axis=1)
+    return packed, k_pages, v_pages
+
+
 class TransformerLM(object):
     """Weights + config bound into the serving face the generation
     engine drives: ``forward`` for references/parity, ``prefill_step``/
@@ -512,4 +751,36 @@ class TransformerLM(object):
             # exact in f32 up to vocab 2^24), not two fetches
             packed = jnp.concatenate([toks.astype(jnp.float32), logps])
             return packed, k_pages, v_pages
+        return fn
+
+    # -- speculative faces ---------------------------------------------------
+    def draft_propose_fn(self, k):
+        """This model as the DRAFT: propose ``k`` tokens per row over
+        its own page pool (serving/speculative.py jits this once per
+        (k, geometry))."""
+        cfg = self.config
+
+        def fn(params, k_pages, v_pages, block_tables, positions, tokens,
+               active, temperatures, seeds, spec_caps):
+            return draft_propose_step(params, k_pages, v_pages,
+                                      block_tables, positions, tokens,
+                                      active, temperatures, seeds,
+                                      spec_caps, k, cfg)
+        return fn
+
+    def verify_sample_fn(self, attn_config=None):
+        """This model as the TARGET: one fused k+1-lane verify +
+        accept/reject + device sampling step (k is carried by the
+        drafts operand's shape, so the engine jits this once per
+        (k, geometry))."""
+        cfg = self.config
+
+        def fn(params, k_pages, v_pages, block_tables, positions, tokens,
+               drafts, draft_logits, active, temperatures, seeds,
+               spec_caps):
+            return verify_step_sampled(params, k_pages, v_pages,
+                                       block_tables, positions, tokens,
+                                       drafts, draft_logits, active,
+                                       temperatures, seeds, spec_caps,
+                                       cfg, attn_config=attn_config)
         return fn

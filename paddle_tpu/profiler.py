@@ -28,7 +28,7 @@ __all__ = ["timer", "stat_summary", "print_stats", "reset_stats",
            "update_elastic_counters", "elastic_counters",
            "reset_elastic_counters",
            "update_generation_counters", "generation_counters",
-           "reset_generation_counters",
+           "reset_generation_counters", "speculation_counters",
            "update_router_counters", "router_counters",
            "reset_router_counters",
            "update_autoscale_counters", "autoscale_counters",
@@ -264,7 +264,15 @@ def update_generation_counters(**counters):
     a full logits row/batch on the host to sample — 0 on the fused
     path), ``gen_kernel_hits`` (decode steps routed through the Pallas
     paged-attention kernel); ``gen_max_running`` and
-    ``gen_page_util_max`` are kept as maxima, not sums."""
+    ``gen_page_util_max`` are kept as maxima, not sums.
+
+    Speculative decoding adds ``gen_spec_steps`` (decode steps that ran
+    as draft-propose / fused-verify rounds), ``gen_draft_tokens``
+    (tokens the draft proposed), ``gen_accepted_tokens`` (proposals the
+    target's verify accepted — acceptance rate is their ratio, surfaced
+    by :func:`speculation_counters`), and ``gen_spec_degraded``
+    (speculation dropped to plain decode; fault site
+    ``serving.speculate``)."""
     for k, v in counters.items():
         if k in _GEN_MAX_KEYS:
             _generation_counters[k] = max(_generation_counters[k], float(v))
@@ -275,6 +283,23 @@ def update_generation_counters(**counters):
 def generation_counters():
     """Snapshot {counter: value} of the autoregressive-serving counters."""
     return dict(_generation_counters)
+
+
+def speculation_counters():
+    """The speculative-decoding slice of the generation counters, plus
+    the derived ``acceptance_rate`` (accepted / drafted; 0.0 before any
+    speculative round). This is the timeline artifact's ``speculation``
+    section — all zeros on a non-speculative engine."""
+    g = _generation_counters
+    drafted = g.get("gen_draft_tokens", 0.0)
+    return {
+        "spec_steps": g.get("gen_spec_steps", 0.0),
+        "draft_tokens": drafted,
+        "accepted_tokens": g.get("gen_accepted_tokens", 0.0),
+        "acceptance_rate": (g.get("gen_accepted_tokens", 0.0) / drafted
+                            if drafted else 0.0),
+        "spec_degraded": g.get("gen_spec_degraded", 0.0),
+    }
 
 
 def reset_generation_counters():
@@ -539,6 +564,7 @@ def write_timeline(path):
         "tune": dict(_tune_counters),
         "elastic": dict(_elastic_counters),
         "generation": dict(_generation_counters),
+        "speculation": speculation_counters(),
         "router": dict(_router_counters),
         "autoscale": dict(_autoscale_counters),
         "memory": dict(_memory_counters),

@@ -56,6 +56,18 @@ site                      where
                           device_sample_degraded event — same tokens
                           under greedy, the loop keeps serving; never
                           a crash
+``serving.speculate``     the speculative-decoding draft side
+                          (paddle_tpu.serving.speculative), hit at
+                          draft-engine build, per draft prefill, and
+                          per propose round: a raise ANYWHERE degrades
+                          that engine to plain fused decode for its
+                          lifetime with a recorded
+                          ``speculation_degraded`` event — a perf
+                          regression (no drafted tokens), never an
+                          outage; running sequences are unharmed
+                          because only the draft's own pool is at
+                          stake, and greedy output is token-identical
+                          either way
 ``serving.route``         the router's proxy edge
                           (paddle_tpu.serving.router), hit once per
                           proxied replica attempt, before the upstream
@@ -209,6 +221,7 @@ SITE_TABLE = {
     "serving.reload": ("serving/registry.py", True, False),
     "serving.generate": ("serving/generator.py", True, True),
     "serving.sample": ("serving/generator.py", True, False),
+    "serving.speculate": ("serving/speculative.py", True, False),
     "serving.route": ("serving/router.py", True, True),
     "serving.autoscale": ("serving/autoscale.py", True, True),
     "comm.quantize": ("comm/allreduce.py", True, False),

@@ -38,6 +38,13 @@ would keep burning device time as padding):
   (compiled once — trace-free at any mix of sequence lengths), samples,
   and retires finished sequences mid-flight so their pages recycle.
   Greedy output is token-identical to sequential full-sequence decode.
+- :mod:`~paddle_tpu.serving.speculative` — speculative decoding's draft
+  side: a small same-vocabulary draft model (its own page pool + block
+  tables) proposes k tokens per round in one dispatch, the target
+  verifies all k+1 lanes in ONE fused step, and rejected lanes cost a
+  page-table trim, never a cache rollback. Greedy output stays
+  token-identical; any draft failure degrades to plain decode (fault
+  site ``serving.speculate``), recorded, never an outage.
 
 :class:`~paddle_tpu.serving.service.InferenceService` ties them together
 in-process (``infer``/``infer_async`` + ``generate``/``generate_async``;
@@ -66,6 +73,7 @@ from .generator import (  # noqa: F401
     GenerationEngine, GenRequest, GenResult, reference_decode,
     sample_token,
 )
+from .speculative import DraftEngine  # noqa: F401
 from .pool import ReplicaPool, StaticPool  # noqa: F401
 from .router import Router, make_router_server  # noqa: F401
 from .autoscale import Autoscaler  # noqa: F401
@@ -77,7 +85,7 @@ __all__ = [
     "padding_buckets", "bucket_for", "make_server",
     "PagePool", "BlockTable", "PoolExhausted", "pages_for",
     "GenerationEngine", "GenRequest", "GenResult", "GenEntry",
-    "reference_decode", "sample_token",
+    "reference_decode", "sample_token", "DraftEngine",
     "ReplicaPool", "StaticPool", "Router", "make_router_server",
     "Autoscaler",
 ]

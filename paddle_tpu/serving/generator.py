@@ -41,6 +41,19 @@ at its sequence position) or sheds it when preemption cannot help; a raise at
 fault site ``serving.generate`` fails that step's sequences with a
 ``generate_failed`` event and the loop keeps serving.
 
+**Speculative decoding** (``draft_model=`` + ``spec_k``, flags
+``serve_draft_dir``/``serve_spec_k``): each decode step becomes a
+draft-propose / fused-verify round — a small draft model proposes up to
+k tokens into ITS OWN page pool (``serving/speculative.DraftEngine``),
+then ONE k+1-lane fused target step verifies them all, accepting the
+longest valid prefix and sampling the correction on device
+(``models/transformer.verify_step_sampled``). Greedy output is
+token-identical to plain decode; tempered rows use rejection sampling on
+the position-keyed RNG stream so preemption replays exactly. Rejected
+lanes cost only a page-table trim (``BlockTable.trim``) — never a cache
+rollback. Fault site ``serving.speculate`` degrades speculation to plain
+fused decode with a ``speculation_degraded`` event.
+
 Knobs: ``FLAGS.serve_max_running`` / ``serve_kv_pages`` /
 ``serve_page_tokens`` / ``serve_queue_depth`` /
 ``serve_device_sample``. Metrics mirror into
@@ -61,6 +74,7 @@ from .admission import (AdmissionController, DeadlineExceededError,
 from .batcher import bucket_for, padding_buckets
 from .kvcache import BlockTable, PagePool, PoolExhausted, pages_for
 from .service import _WINDOW, _percentile
+from .speculative import DraftEngine
 # the shared lock constructor: plain threading primitives normally, the
 # lock-order race detector's instrumented ones under PADDLE_TPU_SANITIZE=locks
 from ..analysis import locks as _locks
@@ -151,12 +165,17 @@ class GenRequest(object):
 
     __slots__ = ("prompt", "max_new_tokens", "temperature", "seed",
                  "deadline_t", "enqueue_t", "tokens", "logprobs",
-                 "preemptions", "model_version", "_rng", "_ttft_ms",
-                 "_done", "_result", "_error")
+                 "preemptions", "model_version", "spec_k", "_rng",
+                 "_ttft_ms", "_done", "_result", "_error")
 
     def __init__(self, prompt, max_new_tokens, temperature=0.0, seed=0,
-                 deadline_t=None):
+                 deadline_t=None, spec_k=None):
         self.prompt = [int(t) for t in prompt]
+        # per-request speculation-depth cap (None = engine default;
+        # 0 = plain decode for this request). Part of the request
+        # IDENTITY: a resumed preemption re-derives the same round
+        # boundaries from it, which the tempered replay proof needs.
+        self.spec_k = None if spec_k is None else int(spec_k)
         # stamped by InferenceService.generate_async: the registry
         # version of the engine that took this submit
         self.model_version = None
@@ -216,7 +235,8 @@ class GenRequest(object):
 class _Running(object):
     """One occupied engine slot."""
 
-    __slots__ = ("req", "slot", "table", "cached", "last_token", "last_t")
+    __slots__ = ("req", "slot", "table", "cached", "last_token", "last_t",
+                 "spec_cap")
 
     def __init__(self, req, slot, table):
         self.req = req
@@ -225,6 +245,7 @@ class _Running(object):
         self.cached = 0          # positions written into the paged cache
         self.last_token = None   # next decode step's input token
         self.last_t = time.monotonic()
+        self.spec_cap = 0        # draft lanes this row runs this round
 
 
 class GenerationEngine(object):
@@ -254,7 +275,8 @@ class GenerationEngine(object):
     def __init__(self, model, max_running=None, kv_pages=None,
                  page_tokens=None, queue_depth=None, reserve="full",
                  eos_id=None, name="model", warm=False,
-                 device_sample=None, attn_config=None):
+                 device_sample=None, attn_config=None, draft_model=None,
+                 spec_k=None):
         import jax
         from ..flags import FLAGS
         if reserve not in ("full", "prompt"):
@@ -319,6 +341,38 @@ class GenerationEngine(object):
         # prompt-length buckets share the batcher's padding policy (ONE
         # powers-of-two-capped algorithm for both tiers)
         self._buckets = padding_buckets(self.max_context)
+        # speculative decoding: a DraftEngine (its own page pool +
+        # propose face) plus the target's k+1-lane fused verify face.
+        # Speculation REQUIRES the fused sampling faces — verification
+        # IS device sampling — and any failure here (including an armed
+        # serving.speculate fault) degrades to plain fused decode with
+        # a recorded speculation_degraded event: a perf regression,
+        # never an outage.
+        if spec_k is None:
+            spec_k = int(FLAGS.serve_spec_k)
+        self.spec_k = int(spec_k) if draft_model is not None else 0
+        self._spec = None
+        self._spec_degraded = False
+        self._verify_s = None
+        if draft_model is not None and self.spec_k >= 1:
+            try:
+                if not self.device_sample:
+                    raise ServingError(
+                        "speculative decoding needs the fused "
+                        "device-sampling faces, which did not build on "
+                        "this engine")
+                self._spec = DraftEngine(
+                    draft_model, self.spec_k, cfg, kv_pages, page_tokens,
+                    self.max_context, self._buckets, name=name)
+                self._verify_s = jax.jit(
+                    model.verify_sample_fn(self.attn_config),
+                    donate_argnums=(1, 2))
+            except BaseException as e:
+                self._spec = None
+                self._spec_degraded = True
+                record_event("speculation_degraded",
+                             site="serving.speculate", model=name,
+                             phase="build", error=repr(e))
         self._queue = collections.deque()
         self._seqs = []            # _Running, slot-ordered
         self._admitting = 0        # popped from queue, prefill underway
@@ -378,11 +432,27 @@ class GenerationEngine(object):
             _, self._kp, self._vp = self._decode(
                 self.model.params, self._kp, self._vp, tables, zeros_i,
                 zeros_i, jnp.asarray(np.zeros((R,), bool)))
+        if self._spec is not None:
+            # one draft warm (prefill buckets + propose) whose device
+            # outputs feed the verify warm — the speculative hot loop
+            # is then trace-free too
+            try:
+                drafts, dlogits = self._spec.warm(R)
+                _, self._kp, self._vp = self._verify_s(
+                    self.model.params, self._kp, self._vp, tables,
+                    zeros_i, zeros_i, drafts, dlogits,
+                    jnp.asarray(np.zeros((R,), bool)),
+                    jnp.asarray(np.zeros((R,), np.float32)), zeros_i,
+                    zeros_i)
+            except BaseException as e:
+                self._degrade_spec("warm", e)
+                self._ensure_pools()   # a verify raise may have
+                #   consumed the donated target pool arrays
         return (time.monotonic() - t0) * 1e3
 
     # -- submit side ---------------------------------------------------------
     def submit(self, prompt, max_new_tokens=16, temperature=0.0, seed=0,
-               deadline_ms=None):
+               deadline_ms=None, spec_k=None):
         """Queue one prompt; returns the :class:`GenRequest` handle.
         Sheds NOW (with the house recorded events) when the queue is
         full, the request could never fit the pool, or it exceeds the
@@ -403,6 +473,11 @@ class GenerationEngine(object):
             # engine thread and fail every other in-flight generation
             raise ValueError("temperature must be finite and >= 0.0, "
                              "got %r" % temperature)
+        if spec_k is not None:
+            spec_k = int(spec_k)
+            if spec_k < 0:
+                raise ValueError("spec_k must be >= 0 (0 disables "
+                                 "speculation for this request)")
         total = len(prompt) + max_new_tokens
         if total > self.max_context:
             raise ValueError(
@@ -425,7 +500,8 @@ class GenerationEngine(object):
                 % (total, self.pool.num_pages * self.pool.page_tokens,
                    self.pool.num_pages, self.pool.page_tokens))
         req = GenRequest(prompt, max_new_tokens, temperature, seed,
-                         AdmissionController.deadline_from(deadline_ms))
+                         AdmissionController.deadline_from(deadline_ms),
+                         spec_k=spec_k)
         with self._cond:
             if not self._alive:
                 raise ServingError("generation engine is closed")
@@ -451,10 +527,10 @@ class GenerationEngine(object):
         return req
 
     def generate(self, prompt, max_new_tokens=16, temperature=0.0, seed=0,
-                 deadline_ms=None, timeout=None):
+                 deadline_ms=None, timeout=None, spec_k=None):
         """Blocking convenience: submit + wait -> :class:`GenResult`."""
         return self.submit(prompt, max_new_tokens, temperature, seed,
-                           deadline_ms).wait(timeout)
+                           deadline_ms, spec_k=spec_k).wait(timeout)
 
     # -- engine loop ---------------------------------------------------------
     def _loop(self):
@@ -527,6 +603,9 @@ class GenerationEngine(object):
                 s.req.fail(ServingError("generation engine shut down "
                                         "mid-flight"))
         del self._seqs[:]
+        if self._spec is not None:
+            self._spec.close()
+            self._spec = None
 
     def __enter__(self):
         return self
@@ -598,6 +677,16 @@ class GenerationEngine(object):
         prompt = req.pending_prompt
         table = BlockTable(self.pool)
         table.ensure(self._reserve_tokens(req))
+        if self._spec is not None:
+            # the paired draft reservation: admit on BOTH pools or on
+            # neither (a PoolExhausted here rides the same requeue path
+            # as the target's)
+            try:
+                self._spec.ensure_slot(slot, self._reserve_tokens(req))
+            except PoolExhausted:
+                self._spec.release_slot(slot)
+                table.release()
+                raise
         t0 = time.monotonic()
         tok = logp = logits = None
         try:
@@ -621,6 +710,8 @@ class GenerationEngine(object):
                 logits = np.asarray(last)
         except BaseException as e:
             table.release()
+            if self._spec is not None:
+                self._spec.release_slot(slot)
             with self._cond:
                 self._free_slots.append(slot)
                 self._free_slots.sort()
@@ -636,17 +727,43 @@ class GenerationEngine(object):
                     "kv pool arrays lost to a failed prefill: %r" % (e,)))
             return
         self._busy_s += time.monotonic() - t0
+        if self._spec is not None:
+            # the draft mirrors the prompt into ITS pool; a failure here
+            # (fault site serving.speculate) degrades speculation engine
+            # wide — the target's prefill already succeeded, so the
+            # request keeps running plain
+            try:
+                self._spec.prefill(slot, padded, len(prompt))
+            except BaseException as e:
+                self._degrade_spec("prefill", e)
         run = _Running(req, slot, table)
         run.cached = len(prompt)
+        # A preemption resume on a SPECULATIVE engine discards the
+        # prefill's sample: the canonical stream's token at the resume
+        # position came from a draft-accept / residual draw (different
+        # salt, different distribution), so recording the plain-keyed
+        # prefill sample would fork the tempered history. Instead the
+        # row re-enters the round loop pending its last emitted token —
+        # round boundaries re-derive identically (caps are pure
+        # functions of (request, progress)) and the next round replays
+        # the exact accept/reject draws.
+        resumed_spec = self._spec is not None and len(req.tokens) > 0
+        if resumed_spec:
+            run.cached = len(prompt) - 1
+            run.last_token = req.tokens[-1]
         with self._cond:
             self._counts["prefills"] += 1
             self._counts["prompt_tokens"] += len(prompt)
-            self._counts["tokens"] += 1    # the prefill's first token
+            if not resumed_spec:
+                self._counts["tokens"] += 1   # the prefill's first token
             self._seqs.append(run)
             self._seqs.sort(key=lambda s: s.slot)
             self._max_running_seen = max(self._max_running_seen,
                                          len(self._seqs))
-        if self.device_sample:
+        if resumed_spec:
+            self._update_prof(gen_prefills=1,
+                              gen_max_running=len(self._seqs))
+        elif self.device_sample:
             self._update_prof(gen_prefills=1, gen_tokens=1,
                               gen_max_running=len(self._seqs))
             self._record_token(run, tok, logp)
@@ -660,6 +777,9 @@ class GenerationEngine(object):
 
     # -- the fused decode step ------------------------------------------------
     def _step(self):
+        if self._spec is not None:
+            self._step_spec()
+            return
         import jax.numpy as jnp
         self._grow_tables()
         seqs = list(self._seqs)
@@ -744,6 +864,160 @@ class GenerationEngine(object):
             else:
                 self._accept_token(s, rows[s.slot])
 
+    # -- the speculative round ------------------------------------------------
+    def _grow_tables_spec(self):
+        """Speculative variant of :meth:`_grow_tables`: grow BOTH pools
+        to the row's round window (cached + cap + 1), where ``cap`` —
+        the number of draft lanes the row runs this round — is a PURE
+        function of the request and its progress (engine k, per-request
+        k, remaining budget, context clamp). Purity is what makes the
+        tempered accept/reject stream replay bit-exactly across
+        preemption: a resume re-derives identical round boundaries from
+        prompt+progress. Pool starvation therefore preempts or sheds
+        through the normal machinery — it must never quietly shrink one
+        row's cap."""
+        for s in list(self._seqs):
+            req_k = (s.req.spec_k if s.req.spec_k is not None
+                     else self.spec_k)
+            cap = max(0, min(self.spec_k, req_k, s.req.budget_left - 1,
+                             self.max_context - 1 - s.cached))
+            try:
+                s.table.ensure(s.cached + cap + 1)
+                self._spec.ensure_slot(s.slot, s.cached + cap + 1)
+            except PoolExhausted:
+                if len(self._seqs) > 1 and \
+                        s.req.preemptions < _PREEMPT_LIMIT:
+                    self._preempt(s)
+                else:
+                    self._shed_pool(s)
+                continue
+            s.spec_cap = cap
+
+    def _step_spec(self):
+        """One speculative round for the whole running batch: the draft
+        proposes up to k tokens per row (its own pool, ONE dispatch),
+        the target verifies every lane in ONE fused step, and the host
+        does pure bookkeeping — consume the accepted prefix plus the
+        correction/bonus token, then roll the page overshoot back to
+        both pools (``BlockTable.trim``; cache CONTENTS never roll
+        back, see kvcache). A propose failure degrades speculation and
+        skips the round (the loop re-steps plain); a verify failure
+        follows the plain step's serving.generate decode contract."""
+        import jax.numpy as jnp
+        self._grow_tables_spec()
+        seqs = list(self._seqs)
+        if not seqs:
+            return
+        R, MB = self.max_running, self.max_blocks
+        MBd = self._spec.max_blocks
+        K1 = self.spec_k + 1
+        tables = np.full((R, MB), self.pool.trash_page, np.int32)
+        dtables = np.full((R, MBd), self._spec.pool.trash_page, np.int32)
+        positions = np.zeros((R,), np.int32)
+        tokens = np.zeros((R,), np.int32)
+        active = np.zeros((R,), bool)
+        temps = np.zeros((R,), np.float32)
+        seeds = np.zeros((R,), np.int32)
+        caps = np.zeros((R,), np.int32)
+        for s in seqs:
+            tables[s.slot] = s.table.as_row(MB)
+            dtables[s.slot] = self._spec.row(s.slot)
+            positions[s.slot] = s.cached
+            tokens[s.slot] = s.last_token
+            active[s.slot] = True
+            temps[s.slot] = s.req.temperature
+            seeds[s.slot] = s.req.seed & 0x7FFFFFFF
+            caps[s.slot] = s.spec_cap
+        t0 = time.monotonic()
+        try:
+            fault_point("serving.generate")
+            cached = self._sample_meta
+            if (cached is None
+                    or not np.array_equal(temps, cached[0])
+                    or not np.array_equal(seeds, cached[1])):
+                cached = (temps, seeds, jnp.asarray(temps),
+                          jnp.asarray(seeds))
+                self._sample_meta = cached
+            pos_d = jnp.asarray(positions)
+            tok_d = jnp.asarray(tokens)
+            act_d = jnp.asarray(active)
+            caps_d = jnp.asarray(caps)
+            try:
+                drafts, dlogits = self._spec.propose(
+                    jnp.asarray(dtables), pos_d, tok_d, act_d,
+                    cached[2], cached[3], caps_d)
+            except BaseException as pe:
+                self._degrade_spec("propose", pe)
+                return
+            packed, self._kp, self._vp = self._verify_s(
+                self.model.params, self._kp, self._vp,
+                jnp.asarray(tables), pos_d, tok_d, drafts, dlogits,
+                act_d, cached[2], cached[3], caps_d)
+            packed = np.asarray(packed)
+        except BaseException as e:
+            self._fail_running(e)
+            self._ensure_pools()
+            return
+        self._busy_s += time.monotonic() - t0
+        tok_rows = packed[:, :K1].astype(np.int32)
+        n_out = packed[:, K1].astype(np.int32)
+        logp_rows = packed[:, K1 + 1:]
+        drafted = int(sum(s.spec_cap for s in seqs))
+        accepted = int(sum(max(int(n_out[s.slot]) - 1, 0) for s in seqs))
+        consumed = 0
+        for s in seqs:
+            for j in range(int(n_out[s.slot])):
+                if s.req.done:
+                    break   # retired mid-round; the tail is discarded
+                s.cached += 1
+                consumed += 1
+                self._record_token(s, int(tok_rows[s.slot, j]),
+                                   float(logp_rows[s.slot, j]))
+            if s.req.done:
+                continue
+            # roll the speculation overshoot back to both pools: pages
+            # past what the accepted point (plus the reserve policy's
+            # floor) needs are free again before the next admission
+            floor = max(s.cached + 1, self._reserve_tokens(s.req))
+            s.table.trim(floor)
+            self._spec.trim_slot(s.slot, floor)
+        util = self.pool.utilization()["frac"]
+        kernel_hit = 1 if self.attn_config else 0
+        with self._cond:
+            self._counts["decode_steps"] += 1
+            self._counts["spec_steps"] += 1
+            self._counts["tokens"] += consumed
+            self._counts["draft_tokens"] += drafted
+            self._counts["accepted_tokens"] += accepted
+            self._counts["kernel_hits"] += kernel_hit
+            self._counts["device_sample_steps"] += 1
+            self._occupancy_sum += len(seqs)
+            self._page_util_max = max(self._page_util_max, util)
+        self._update_prof(
+            gen_decode_steps=1, gen_page_util_max=util,
+            gen_tokens=consumed, gen_kernel_hits=kernel_hit,
+            gen_device_sample_steps=1, gen_spec_steps=1,
+            gen_draft_tokens=drafted, gen_accepted_tokens=accepted)
+
+    def _degrade_spec(self, phase, exc):
+        """Speculation failed (fault site ``serving.speculate``): drop
+        the draft engine and keep serving plain fused decode — a
+        recorded perf regression, never an outage. Running sequences
+        are unharmed: the draft pool is the only state a draft failure
+        can consume, and the target's cache never depended on it."""
+        spec = self._spec
+        if spec is None:
+            return
+        self._spec = None
+        self._spec_degraded = True
+        try:
+            spec.close()
+        except Exception:
+            pass
+        record_event("speculation_degraded", site="serving.speculate",
+                     model=self.name, phase=phase, error=repr(exc))
+        self._update_prof(gen_spec_degraded=1)
+
     def _ensure_pools(self):
         """A raise from INSIDE a donated jitted call (device OOM,
         XlaRuntimeError) consumes the pool arrays before it surfaces —
@@ -791,6 +1065,8 @@ class GenerationEngine(object):
         and free-slot ordering cannot drift apart. What happens to the
         request afterwards (resolve/fail) is the caller's job."""
         s.table.release()
+        if self._spec is not None:
+            self._spec.release_slot(s.slot)
         with self._cond:
             if s in self._seqs:
                 self._seqs.remove(s)
@@ -953,6 +1229,25 @@ class GenerationEngine(object):
                 "host_logit_syncs": c.get("host_logit_syncs", 0),
                 "attn_kernel": bool(self.attn_config),
                 "kernel_hits": c.get("kernel_hits", 0),
+                "speculative": self._spec is not None,
+                "spec_k": self.spec_k,
+                "spec_degraded": self._spec_degraded,
+                "spec_steps": c.get("spec_steps", 0),
+                "draft_tokens": c.get("draft_tokens", 0),
+                "accepted_tokens": c.get("accepted_tokens", 0),
+                "acceptance_rate": (
+                    c.get("accepted_tokens", 0)
+                    / float(c.get("draft_tokens", 0))
+                    if c.get("draft_tokens", 0) else 0.0),
+                "spec_verify_traces": (
+                    self._trace_count(self._verify_s)
+                    if self._verify_s is not None else 0),
+                "spec_propose_traces": (
+                    self._spec.propose_traces
+                    if self._spec is not None else 0),
+                "draft_page_utilization": (
+                    self._spec.pool.utilization()
+                    if self._spec is not None else None),
                 # the ACTIVE faces' trace counts — the compiled-once
                 # contract is on the path actually serving
                 "decode_traces": self._trace_count(

@@ -205,7 +205,9 @@ class _Handler(BaseHTTPRequestHandler):
     def _generate(self, name, body):
         """Autoregressive generation: ``{"tokens": [ids],
         "max_new_tokens": N, "temperature": t, "seed": s,
-        "deadline_ms": optional}`` -> the GenResult fields. Pool
+        "deadline_ms": optional, "spec_k": optional}`` -> the GenResult
+        fields. ``spec_k`` caps this request's speculation depth on a
+        speculative engine (0 = plain decode); ignored elsewhere. Pool
         exhaustion is backpressure, not a server fault: 429 with kind
         ``kv_pool_exhausted``."""
         from .kvcache import PoolExhausted
@@ -214,6 +216,7 @@ class _Handler(BaseHTTPRequestHandler):
             if not isinstance(tokens, list) or not tokens:
                 raise ValueError('body must carry {"tokens": '
                                  "[token ids]}")
+            spec_k = body.get("spec_k")
             # the handle carries the version of the engine that took the
             # submit — a re-fetch here would race a hot :reload into
             # attributing new-model tokens to the old version
@@ -222,7 +225,8 @@ class _Handler(BaseHTTPRequestHandler):
                 max_new_tokens=int(body.get("max_new_tokens", 16)),
                 temperature=float(body.get("temperature", 0.0)),
                 seed=int(body.get("seed", 0)),
-                deadline_ms=body.get("deadline_ms"))
+                deadline_ms=body.get("deadline_ms"),
+                spec_k=None if spec_k is None else int(spec_k))
             res = req.wait()
         except ModelUnavailableError as e:
             return self._reply(404, {"error": str(e),

@@ -180,6 +180,25 @@ class BlockTable(object):
         if need > 0:
             self.pages.extend(self.pool.alloc(need))
 
+    def trim(self, tokens):
+        """Shrink the table back to the pages ``tokens`` total positions
+        need, freeing the tail — the speculative-decoding rollback
+        primitive: a verify round grows the table to cover k+1
+        optimistic positions, and the pages past the accepted point go
+        back to the pool between rounds (cache CONTENTS need no
+        rollback — stale writes are masked and re-scattered; only the
+        allocator accounting rolls back). Rides :meth:`PagePool.free`,
+        so a bookkeeping bug double-freeing a trimmed page stays loud.
+        Returns the number of pages freed."""
+        keep = pages_for(tokens, self.pool.page_tokens)
+        if keep >= len(self.pages):
+            return 0
+        tail = self.pages[keep:]
+        del self.pages[keep:]
+        self.pool.free(tail)
+        self.length = min(self.length, self.capacity)
+        return len(tail)
+
     def release(self):
         """Free every page back to the pool (idempotent)."""
         if self.pages:

@@ -178,19 +178,43 @@ class InferenceService(object):
         ``engine_kwargs`` reuses the previous deployment's knobs (the
         HTTP ``:reload`` path must not silently reset the pool
         geometry to flag defaults). On failure the previous version
-        keeps serving with a recorded ``reload_rollback`` event."""
-        from ..inference import load_generative
+        keeps serving with a recorded ``reload_rollback`` event.
+
+        A speculative pairing (``inference.export_speculative``) is
+        auto-detected: the draft model and the pairing's k ride into
+        the engine kwargs, and the ARTIFACT is the source of truth —
+        it overrides a stale draft reused from the previous
+        deployment's kwargs, and reloading a plain artifact over a
+        speculative one drops the old draft rather than resurrecting
+        it."""
+        from ..inference import (is_speculative_artifact,
+                                 load_generative, load_speculative)
         from ..resilience import record_event
         from .generator import GenerationEngine
         with self._gen_reload_lock:
             self._check_open()
             prev = self._generators.get(name)
+            explicit_draft = "draft_model" in engine_kwargs
             if not engine_kwargs and prev is not None:
                 engine_kwargs = dict(prev.engine_kwargs)
             engine_kwargs.setdefault("queue_depth",
                                      self.admission.queue_depth)
             try:
-                model = load_generative(dirname)
+                if is_speculative_artifact(dirname):
+                    model, draft, spec_k = load_speculative(dirname)
+                    if not explicit_draft:
+                        engine_kwargs["draft_model"] = draft
+                        # an explicitly-passed spec_k (CLI --spec_k)
+                        # still wins over the pairing's qualified k
+                        engine_kwargs.setdefault("spec_k", spec_k)
+                elif not explicit_draft:
+                    # plain artifact: never inherit a previous
+                    # deployment's draft across the reload
+                    model = load_generative(dirname)
+                    engine_kwargs.pop("draft_model", None)
+                    engine_kwargs.pop("spec_k", None)
+                else:
+                    model = load_generative(dirname)
                 engine = GenerationEngine(model, name=name, warm=warm,
                                           **engine_kwargs)
             except BaseException as e:
@@ -383,7 +407,8 @@ class InferenceService(object):
 
     # -- generation path -----------------------------------------------------
     def generate_async(self, name, tokens, max_new_tokens=16,
-                       temperature=0.0, seed=0, deadline_ms=None):
+                       temperature=0.0, seed=0, deadline_ms=None,
+                       spec_k=None):
         """Enqueue one autoregressive generation on ``name``'s engine;
         returns its :class:`~paddle_tpu.serving.generator.GenRequest`
         handle (``.wait()`` for the
@@ -397,7 +422,7 @@ class InferenceService(object):
             req = entry.engine.submit(
                 tokens, max_new_tokens=max_new_tokens,
                 temperature=temperature, seed=seed,
-                deadline_ms=deadline_ms)
+                deadline_ms=deadline_ms, spec_k=spec_k)
         except ServingError:
             # lost the race with a hot reload: the entry fetched above
             # drained/closed before this submit landed. Retry ONCE
@@ -408,18 +433,18 @@ class InferenceService(object):
             req = entry.engine.submit(
                 tokens, max_new_tokens=max_new_tokens,
                 temperature=temperature, seed=seed,
-                deadline_ms=deadline_ms)
+                deadline_ms=deadline_ms, spec_k=spec_k)
         req.model_version = entry.version
         return req
 
     def generate(self, name, tokens, max_new_tokens=16, temperature=0.0,
-                 seed=0, deadline_ms=None, timeout=None):
+                 seed=0, deadline_ms=None, timeout=None, spec_k=None):
         """Blocking generation -> GenResult (greedy outputs are
         token-identical to sequential full-sequence decode of the same
         prompt — the continuous-batching parity contract)."""
         return self.generate_async(name, tokens, max_new_tokens,
-                                   temperature, seed,
-                                   deadline_ms).wait(timeout)
+                                   temperature, seed, deadline_ms,
+                                   spec_k=spec_k).wait(timeout)
 
     # -- observer hooks (dispatch thread) ------------------------------------
     def _on_batch(self, requests, bucket):

@@ -1,0 +1,201 @@
+"""Speculative decoding's draft side: a second engine inside the first.
+
+One speculative round replaces one fused decode step: the DRAFT model —
+small, cheap, same vocabulary — proposes ``k`` tokens autoregressively
+(``models/transformer.draft_propose_step``, a lax.scan of k+1 decode
+substeps in ONE jit dispatch), then the TARGET model verifies all k+1
+positions in a single fused step (``verify_step_sampled``) that accepts
+the longest valid draft prefix and samples the correction/bonus token on
+device. Greedy output is token-identical to non-speculative decode (the
+hard gate — longest-matching-prefix + argmax correction reconstructs the
+plain greedy sequence exactly); tempered rows use canonical rejection
+sampling keyed by the position-keyed fold_in stream, so a preemption
+resume replays the exact accept/reject history.
+
+This module owns everything drafted: the draft model's OWN
+:class:`~paddle_tpu.serving.kvcache.PagePool` and per-slot
+:class:`BlockTable`\\ s (sized by the same allocator as the target's —
+same page_tokens, same loud free discipline), the jitted propose and
+prefill faces, and their warm-up. The proposals and draft logits it
+returns are DEVICE arrays handed straight to the target's verify jit —
+no draft logits row ever crosses to the host, so the engine's
+``gen_host_logit_syncs == 0`` invariant survives speculation.
+
+Fault site ``serving.speculate`` (armable): it guards the draft-engine
+build, the draft prefill, and every propose call. A raise anywhere here
+is a PERF regression, never an outage — the generation engine records a
+``speculation_degraded`` event, drops the draft engine, and keeps
+serving plain fused decode; running sequences are unharmed because the
+draft pool is the only state a propose failure can consume.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..resilience import fault_point
+from .kvcache import BlockTable, PagePool, pages_for
+
+__all__ = ["DraftEngine"]
+
+
+def _trace_count(fn):
+    """Compiled-trace count via the jit cache probe (same degrade-to--1
+    contract as the generation engine's)."""
+    probe = getattr(fn, "_cache_size", None)
+    try:
+        return int(probe()) if probe is not None else -1
+    except Exception:
+        return -1
+
+
+class DraftEngine(object):
+    """The draft half of a speculative generation engine.
+
+    Owned by a :class:`~paddle_tpu.serving.generator.GenerationEngine`
+    and driven only from its engine thread (the pool arrays are donated
+    through the propose jit exactly like the target's — single-owner
+    discipline). ``kv_pages``/``page_tokens`` mirror the target pool's
+    geometry so a reservation that admits on the target admits here
+    too; a draft-side exhaustion mid-flight preempts the row through
+    the normal machinery.
+    """
+
+    def __init__(self, model, k, target_config, kv_pages, page_tokens,
+                 max_context, buckets, name="model"):
+        import jax
+        fault_point("serving.speculate")
+        k = int(k)
+        if k < 1:
+            raise ValueError("speculation depth k must be >= 1, got %d"
+                             % k)
+        dc = model.config
+        if dc.vocab_size != target_config.vocab_size:
+            raise ValueError(
+                "draft vocab_size=%d != target vocab_size=%d — "
+                "speculative accept compares token ids, the "
+                "vocabularies must be identical"
+                % (dc.vocab_size, target_config.vocab_size))
+        if dc.max_seq < int(max_context):
+            raise ValueError(
+                "draft max_seq=%d < target context window %d — the "
+                "draft must cover every position it proposes at"
+                % (dc.max_seq, int(max_context)))
+        self.model = model
+        self.k = k
+        self.name = name
+        self.max_context = int(max_context)
+        self.max_blocks = pages_for(self.max_context, page_tokens)
+        L, nh, dh = model.kv_spec
+        self.pool = PagePool(kv_pages, page_tokens, L, nh, dh)
+        self._kp, self._vp = self.pool.zeros()
+        self._check_pool_install("serving.draft_pool_install")
+        self._propose = jax.jit(model.draft_propose_fn(k),
+                                donate_argnums=(1, 2))
+        self._prefill = jax.jit(model.prefill_fn(), donate_argnums=(1, 2))
+        self._buckets = list(buckets)
+        self._tables = {}   # slot -> BlockTable (draft pool)
+
+    # -- per-slot block tables ----------------------------------------------
+    def ensure_slot(self, slot, tokens):
+        """Grow (creating if needed) slot's draft table to hold
+        ``tokens`` positions; raises PoolExhausted allocating nothing."""
+        t = self._tables.get(slot)
+        if t is None:
+            t = self._tables[slot] = BlockTable(self.pool)
+        t.ensure(tokens)
+
+    def trim_slot(self, slot, tokens):
+        """Roll back slot's speculation-overshoot pages (see
+        ``BlockTable.trim``)."""
+        t = self._tables.get(slot)
+        return t.trim(tokens) if t is not None else 0
+
+    def release_slot(self, slot):
+        """Free slot's draft pages (idempotent — eviction rides this)."""
+        t = self._tables.pop(slot, None)
+        if t is not None:
+            t.release()
+
+    def release_all(self):
+        for slot in list(self._tables):
+            self.release_slot(slot)
+
+    def row(self, slot):
+        return self._tables[slot].as_row(self.max_blocks)
+
+    # -- jitted faces --------------------------------------------------------
+    def prefill(self, slot, padded, length):
+        """Scatter one prompt's K/V into the draft pool (bucketed like
+        the target prefill; the logits never leave the device)."""
+        import jax.numpy as jnp
+        fault_point("serving.speculate")
+        _, self._kp, self._vp = self._prefill(
+            self.model.params, self._kp, self._vp, jnp.asarray(padded),
+            np.int32(length), jnp.asarray(self.row(slot)))
+
+    def propose(self, tables, positions, tokens, active, temperatures,
+                seeds, spec_caps):
+        """One k-token proposal round for the whole running batch.
+        Returns (drafts [R, k], draft_logits [R, k, V]) as DEVICE
+        arrays — they feed the target's verify jit directly."""
+        fault_point("serving.speculate")
+        drafts, draft_logits, self._kp, self._vp = self._propose(
+            self.model.params, self._kp, self._vp, tables, positions,
+            tokens, active, temperatures, seeds, spec_caps)
+        return drafts, draft_logits
+
+    def warm(self, max_running):
+        """Pre-trigger the draft compiles with all-trash tables (every
+        prefill bucket + the propose face). Returns the warm propose's
+        (drafts, draft_logits) device arrays so the caller can feed its
+        verify warm-up without a second propose."""
+        import jax.numpy as jnp
+        trash_row = np.full((self.max_blocks,), self.pool.trash_page,
+                            np.int32)
+        for S_b in self._buckets:
+            _, self._kp, self._vp = self._prefill(
+                self.model.params, self._kp, self._vp,
+                jnp.asarray(np.zeros((S_b,), np.int32)), np.int32(1),
+                jnp.asarray(trash_row))
+        R = int(max_running)
+        zeros_i = jnp.asarray(np.zeros((R,), np.int32))
+        drafts, draft_logits, self._kp, self._vp = self._propose(
+            self.model.params, self._kp, self._vp,
+            jnp.asarray(np.tile(trash_row, (R, 1))), zeros_i, zeros_i,
+            jnp.asarray(np.zeros((R,), bool)),
+            jnp.asarray(np.zeros((R,), np.float32)), zeros_i, zeros_i)
+        return drafts, draft_logits
+
+    # -- plumbing ------------------------------------------------------------
+    def _check_pool_install(self, entry):
+        # same donation-aliasing sanitizer choke point as the target
+        # pool (PADDLE_TPU_SANITIZE=alias)
+        from ..analysis.sanitize import check_donated
+        check_donated({"k_pages": self._kp, "v_pages": self._vp}, entry)
+
+    def ensure_pools(self):
+        """Rebuild the draft pool arrays if a raise consumed them (the
+        target engine's ``_ensure_pools`` contract, draft-shaped)."""
+        deleted = getattr(self._kp, "is_deleted", None)
+        if deleted is None or not deleted():
+            return False
+        self._kp, self._vp = self.pool.zeros()
+        self._check_pool_install("serving.draft_pool_rebuild")
+        return True
+
+    @property
+    def propose_traces(self):
+        return _trace_count(self._propose)
+
+    @property
+    def prefill_traces(self):
+        return _trace_count(self._prefill)
+
+    def stats(self):
+        return {"k": self.k,
+                "page_utilization": self.pool.utilization(),
+                "propose_traces": self.propose_traces,
+                "prefill_traces": self.prefill_traces}
+
+    def close(self):
+        self.release_all()

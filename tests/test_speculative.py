@@ -1,0 +1,321 @@
+"""Speculative decoding acceptance suite.
+
+Contracts under test: greedy output through the draft-propose /
+fused-verify rounds is token-identical to the non-speculative engine
+and the full-sequence reference at any acceptance rate (self-draft and
+a perturbed draft); a request with ``spec_k=0`` is bit-identical to the
+plain fused engine (greedy AND tempered); the tempered accept/reject
+stream is position-keyed, so a preempted speculative engine resumes it
+bit-exactly; any draft-side failure (fault site ``serving.speculate``,
+at build or per propose round) degrades to plain fused decode with a
+recorded ``speculation_degraded`` event and unchanged output — a perf
+regression, never an outage; the propose and verify programs each
+compile exactly once; rejected lanes roll back through
+``BlockTable.trim`` page accounting under the allocator's loud-free
+discipline; and the paired artifact (``__draft__/`` + ``__spec__.json``)
+round-trips through export/validate/load and auto-pairs on the
+service surface.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu import profiler, resilience
+from paddle_tpu.inference import (ArtifactError, export_generative,
+                                  export_speculative,
+                                  generative_memory_bytes,
+                                  is_speculative_artifact, load_speculative,
+                                  validate_generative_artifact)
+from paddle_tpu.models import transformer as tm
+from paddle_tpu.serving import (BlockTable, GenerationEngine,
+                                InferenceService, PagePool, PoolExhausted,
+                                reference_decode)
+
+VOCAB = 23
+MAX_SEQ = 48
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tm.TransformerConfig(vocab_size=VOCAB, hidden=16, num_layers=2,
+                               num_heads=2, max_seq=MAX_SEQ)
+    return tm.TransformerLM(tm.init_params(cfg, seed=3), cfg)
+
+
+@pytest.fixture(scope="module")
+def draft(model):
+    # a deliberately WRONG draft: the target's weights plus noise, so
+    # acceptance is partial and the reject/correct path really runs
+    rng = np.random.RandomState(9)
+    params = {k: np.asarray(v) + rng.randn(*v.shape).astype(np.float32) * 0.02
+              for k, v in model.params.items()}
+    return tm.TransformerLM(params, model.config)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    resilience.reset()
+    resilience.clear_events()
+    yield
+    resilience.reset()
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_running", 4)
+    kw.setdefault("kv_pages", 64)
+    kw.setdefault("page_tokens", 8)
+    kw.setdefault("queue_depth", 64)
+    kw.setdefault("warm", False)
+    return GenerationEngine(model, **kw)
+
+
+def _params(model):
+    return {n: np.asarray(model.params[n])
+            for n in tm.param_names(model.config)}
+
+
+# -- greedy identity ----------------------------------------------------------
+
+def test_greedy_identity_three_paths(model, draft):
+    # host sampling / plain fused / fused + speculative (self-draft AND a
+    # perturbed draft): all token-identical to the reference — the draft
+    # only moves the acceptance rate, never the output
+    prompts = [[1, 2, 3, 4, 5], [6, 7], [8, 9, 10], [2, 4, 6, 8]]
+    want = [reference_decode(model, p, 10) for p in prompts]
+    cases = [({"device_sample": False}, None),
+             ({"device_sample": True}, None),
+             ({"draft_model": model, "spec_k": 4}, 1.0),
+             ({"draft_model": draft, "spec_k": 4}, None)]
+    for kw, want_acc in cases:
+        with _engine(model, **kw) as eng:
+            handles = [eng.submit(p, max_new_tokens=10) for p in prompts]
+            got = [h.wait(timeout=300).tokens for h in handles]
+            st = eng.stats
+        assert got == want, kw
+        if "draft_model" in kw:
+            assert st["speculative"] and not st["spec_degraded"]
+            assert st["spec_steps"] > 0 and st["draft_tokens"] > 0
+            assert st["host_logit_syncs"] == 0
+            # speculation saved fused steps vs one-token-per-step decode
+            assert st["accepted_tokens"] > 0
+            if want_acc is not None:       # self-draft: 100% by identity
+                assert st["acceptance_rate"] == want_acc
+            # ONE propose trace, ONE verify trace for the whole flood
+            assert st["spec_propose_traces"] == 1
+            assert st["spec_verify_traces"] == 1
+        else:
+            assert st["speculative"] is False
+            assert st["spec_steps"] == 0
+
+
+def test_spec_k_zero_request_matches_plain_engine(model, draft):
+    # per-request spec_k=0 opts out: greedy AND tempered outputs are
+    # bit-identical to the plain fused engine (the bonus lane uses the
+    # SAME position-keyed stream as non-speculative device sampling)
+    prompt = [1, 2, 3, 4, 5]
+    with _engine(model, device_sample=True) as plain, \
+            _engine(model, draft_model=draft, spec_k=4) as spec:
+        for temp, seed in ((0.0, 0), (0.9, 5), (1.3, 17)):
+            a = plain.generate(prompt, max_new_tokens=10, temperature=temp,
+                               seed=seed, timeout=300).tokens
+            b = spec.generate(prompt, max_new_tokens=10, temperature=temp,
+                              seed=seed, timeout=300, spec_k=0).tokens
+            assert a == b, temp
+        assert spec.stats["draft_tokens"] == 0    # caps really were 0
+
+
+def test_per_request_spec_k_validated(model, draft):
+    with _engine(model, draft_model=draft, spec_k=4) as eng:
+        with pytest.raises(ValueError):
+            eng.submit([1, 2], max_new_tokens=4, spec_k=-1)
+
+
+# -- tempered stream: determinism + preemption replay -------------------------
+
+def test_tempered_spec_stream_deterministic(model, draft):
+    prompt = [3, 1, 4, 1, 5]
+    with _engine(model, draft_model=draft, spec_k=4) as eng:
+        runs = [eng.generate(prompt, max_new_tokens=10, temperature=0.8,
+                             seed=11, timeout=300).tokens
+                for _ in range(2)]
+    assert runs[0] == runs[1]
+
+
+def test_preemption_mid_speculation_resumes_stream(model, draft):
+    # tempered generation through a preempting speculative engine must
+    # equal the unpreempted speculative engine's stream: accept/reject
+    # draws are keyed by (seed, absolute position, salt) and per-round
+    # caps are pure functions of (request, progress), so a resume
+    # re-prefills prompt+progress and replays the exact history
+    prompts = [[1, 2, 3, 4, 5, 6], [7, 8, 9, 10, 11, 12]]
+    with _engine(model, draft_model=draft, spec_k=3) as big:
+        want = [big.generate(p, max_new_tokens=8, temperature=0.6,
+                             seed=i + 5, timeout=300).tokens
+                for i, p in enumerate(prompts)]
+    pre = GenerationEngine(model, max_running=2, kv_pages=6,
+                           page_tokens=4, reserve="prompt",
+                           name="spec_preempt", draft_model=draft,
+                           spec_k=3)
+    try:
+        handles = [pre.submit(p, max_new_tokens=8, temperature=0.6,
+                              seed=i + 5)
+                   for i, p in enumerate(prompts)]
+        got = [h.wait(timeout=300).tokens for h in handles]
+        st = pre.stats
+    finally:
+        pre.close()
+    assert st["preemptions"] >= 1      # the scenario really preempted
+    assert not st["spec_degraded"]     # pool pressure preempts, never degrades
+    assert got == want
+    assert pre.pool.live == 0          # both pools drained clean
+
+
+# -- degrade-and-record -------------------------------------------------------
+
+def test_speculate_fault_at_build_degrades(model, draft):
+    from paddle_tpu.resilience import faults
+    prompt = [1, 2, 3]
+    want = reference_decode(model, prompt, 6)
+    faults.arm("serving.speculate", "raise", nth=1, times=1)
+    with _engine(model, draft_model=draft, spec_k=4) as eng:
+        res = eng.generate(prompt, max_new_tokens=6, timeout=300)
+        st = eng.stats
+    assert res.tokens == want          # output unchanged on the plain path
+    assert st["spec_degraded"] and not st["speculative"]
+    evs = resilience.events(kind="speculation_degraded")
+    assert evs and evs[0]["phase"] == "build"
+
+
+def test_speculate_fault_at_propose_degrades_midstream(model, draft):
+    # build succeeds, then a propose round raises: the engine drops the
+    # draft mid-request and finishes on plain fused decode — running
+    # sequences are unharmed and greedy output does not change
+    from paddle_tpu.resilience import faults
+    prompt = [5, 6, 7, 8]
+    want = reference_decode(model, prompt, 8)
+    with _engine(model, draft_model=draft, spec_k=4) as eng:
+        # skip the build + prefill hits, fail the second propose round
+        faults.arm("serving.speculate", "raise", nth=3, times=1)
+        res = eng.generate(prompt, max_new_tokens=8, timeout=300)
+        st = eng.stats
+    assert res.tokens == want
+    assert st["spec_degraded"]
+    assert st["failed"] == 0           # degrade is not a request failure
+    evs = resilience.events(kind="speculation_degraded")
+    assert evs and evs[0]["phase"] == "propose"
+
+
+# -- rollback primitive -------------------------------------------------------
+
+def test_block_table_trim_frees_tail_pages_loudly():
+    pool = PagePool(num_pages=8, page_tokens=4, num_layers=1,
+                    num_heads=1, head_dim=4)
+    table = BlockTable(pool)
+    table.ensure(14)                   # 4 pages for 14 optimistic tokens
+    assert pool.live == 4
+    tail_page = table.pages[-1]
+    freed = table.trim(6)              # keep 6 tokens -> 2 pages
+    assert freed == 2 and pool.live == 2
+    assert table.trim(6) == 0          # trim to the same floor: no-op
+    with pytest.raises(ValueError):    # loud-free discipline survives trim
+        pool.free([tail_page])         # the trimmed page is already free
+    table.ensure(14)                   # regrow reuses the freed pages
+    assert pool.live == 4
+    table.release()
+    assert pool.live == 0
+
+
+def test_spec_engine_needs_room_for_draft_pool(model, draft):
+    # the draft pool is sized by the same allocator: a request that can
+    # never fit sheds at submit on BOTH pools, allocating nothing
+    with _engine(model, draft_model=draft, spec_k=2, kv_pages=4,
+                 page_tokens=4, max_running=1) as eng:
+        with pytest.raises(PoolExhausted):
+            eng.submit([1, 2, 3] * 9, max_new_tokens=8)
+        assert eng.pool.live == 0
+
+
+# -- counters -----------------------------------------------------------------
+
+def test_speculation_profiler_counters_and_timeline(tmp_path, model, draft):
+    profiler.reset_generation_counters()
+    with _engine(model, draft_model=model, spec_k=4) as eng:
+        eng.generate([1, 2, 3], max_new_tokens=8, timeout=300)
+    c = profiler.speculation_counters()
+    assert c["spec_steps"] > 0 and c["draft_tokens"] > 0
+    assert c["acceptance_rate"] == 1.0          # self-draft
+    assert c["spec_degraded"] == 0
+    g = profiler.generation_counters()
+    assert g["gen_spec_steps"] == c["spec_steps"]
+    assert g.get("gen_host_logit_syncs", 0) == 0
+    path = str(tmp_path / "timeline.json")
+    profiler.write_timeline(path)
+    with open(path) as f:
+        art = json.load(f)
+    assert art["speculation"]["spec_steps"] == c["spec_steps"]
+    profiler.reset_generation_counters()
+
+
+# -- paired artifact ----------------------------------------------------------
+
+def test_export_speculative_roundtrip_and_validation(tmp_path, model, draft):
+    art = str(tmp_path / "spec_art")
+    export_speculative(art, model.config, draft.config, 3,
+                       params=_params(model), draft_params=_params(draft))
+    assert is_speculative_artifact(art)
+    assert validate_generative_artifact(art) == []
+    target, loaded_draft, spec_k = load_speculative(art)
+    assert spec_k == 3
+    assert target.config.to_dict() == model.config.to_dict()
+    prompt = [4, 8, 15]
+    with GenerationEngine(target, max_running=2, kv_pages=32,
+                          page_tokens=8, warm=False,
+                          draft_model=loaded_draft, spec_k=spec_k) as eng:
+        res = eng.generate(prompt, max_new_tokens=6, timeout=300)
+        assert eng.stats["speculative"]
+    assert res.tokens == reference_decode(model, prompt, 6)
+    # the draft's weights + pool are priced into the memory estimate
+    plain = str(tmp_path / "plain_art")
+    export_generative(plain, model.config, params=_params(model))
+    assert (generative_memory_bytes(art, kv_pages=32, page_tokens=8) >
+            generative_memory_bytes(plain, kv_pages=32, page_tokens=8))
+    # a broken pairing is a failed export, not a degrade at warm-up
+    other = tm.TransformerConfig(vocab_size=VOCAB + 1, hidden=16,
+                                 num_layers=2, num_heads=2,
+                                 max_seq=MAX_SEQ)
+    with pytest.raises(ValueError):
+        export_speculative(str(tmp_path / "bad"), model.config, other, 3,
+                           params=_params(model))
+    # a damaged draft subdir is a named validation problem
+    os.remove(os.path.join(art, "__draft__", "__gen_params__.pkl"))
+    probs = validate_generative_artifact(art)
+    assert any("__draft__" in p for p in probs)
+    with pytest.raises(ArtifactError):
+        load_speculative(art)
+
+
+def test_service_auto_pairs_speculative_artifact(tmp_path, model, draft):
+    spec_dir = str(tmp_path / "spec")
+    plain_dir = str(tmp_path / "plain")
+    export_speculative(spec_dir, model.config, draft.config, 3,
+                       params=_params(model), draft_params=_params(draft))
+    export_generative(plain_dir, model.config, params=_params(model))
+    prompt = [2, 4, 6]
+    want = reference_decode(model, prompt, 5)
+    with InferenceService() as svc:
+        svc.load_model("lm", spec_dir, warm=False, max_running=2,
+                       kv_pages=32, page_tokens=8)
+        st = svc.stats["generation"]["lm"]
+        assert st["speculative"] and st["spec_k"] == 3
+        res = svc.generate("lm", prompt, max_new_tokens=5, timeout=300)
+        assert res.tokens == want
+        # reloading a PLAIN artifact over it drops the draft: the
+        # artifact, not the old entry, is the source of truth
+        svc.reload_model("lm", plain_dir, warm=False, max_running=2,
+                         kv_pages=32, page_tokens=8)
+        st2 = svc.stats["generation"]["lm"]
+        assert not st2["speculative"]
+        res2 = svc.generate("lm", prompt, max_new_tokens=5, timeout=300)
+        assert res2.tokens == want

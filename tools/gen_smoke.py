@@ -17,7 +17,14 @@ reference, syncs ZERO [R, V] logit rows to the host, keeps the one
 decode trace, is no slower than host sampling on the paired interleaved
 waves, and an armed ``serving.sample`` fault degrades the engine to
 host sampling with a recorded ``device_sample_degraded`` event while
-output stays identical.
+output stays identical, and (f) hold the speculative-decoding
+contract: a self-draft speculative engine stays token-identical to
+the plain fused engine AND the reference, reports acceptance > 0 with
+zero host logit syncs through exactly one propose + one verify trace,
+is no slower than the plain fused engine on paired interleaved waves
+(self-draft makes the ratio pure dispatch amortization), and an armed
+``serving.speculate`` fault degrades to plain fused decode with a
+recorded ``speculation_degraded`` event and unchanged output.
 
 The measurement itself lives in benchmark/gen_bench.py — ONE
 implementation shared by this gate and the evidence record, so the
@@ -74,18 +81,56 @@ def _degrade_leg():
     }
 
 
+def _spec_degrade_leg():
+    """Armed ``serving.speculate``: the draft engine's build fails, the
+    engine records ``speculation_degraded``, keeps serving plain fused
+    decode, and greedy output is unchanged."""
+    from paddle_tpu import resilience
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.serving import GenerationEngine, reference_decode
+    from benchmark.gen_bench import build_model
+
+    model = build_model(max_seq=64, seed=2)
+    resilience.clear_events()
+    faults.arm("serving.speculate", "raise", nth=1, times=1)
+    try:
+        eng = GenerationEngine(model, max_running=2, kv_pages=20,
+                               page_tokens=4, warm=True,
+                               name="spec_degrade", draft_model=model,
+                               spec_k=4)
+        try:
+            prompt = [1, 2, 3, 4]
+            res = eng.generate(prompt, max_new_tokens=6, timeout=300)
+            st = eng.stats
+        finally:
+            eng.close()
+    finally:
+        faults.disarm("serving.speculate")
+    return {
+        "degraded_to_plain": st["spec_degraded"] and not st["speculative"],
+        "tokens_ok": res.tokens == reference_decode(model, prompt, 6),
+        "events": len(resilience.events(kind="speculation_degraded")),
+    }
+
+
 def main():
-    from benchmark.gen_bench import bench, bench_exhaustion, bench_fused
+    from benchmark.gen_bench import (bench, bench_exhaustion, bench_fused,
+                                     bench_speculative)
 
     summary = bench(requests=REQUESTS, max_new=MAX_NEW,
                     max_running=MAX_RUNNING, waves=WAVES)
     fused = bench_fused(requests=REQUESTS, max_new=MAX_NEW,
                         max_running=MAX_RUNNING, waves=3)
     summary["fused"] = fused
+    spec = bench_speculative(requests=REQUESTS, max_new=MAX_NEW,
+                             max_running=MAX_RUNNING, waves=3)
+    summary["speculative"] = spec
     ex = bench_exhaustion()
     summary["exhaustion"] = ex
     deg = _degrade_leg()
     summary["sample_degrade"] = deg
+    sdeg = _spec_degrade_leg()
+    summary["speculate_degrade"] = sdeg
 
     failures = []
     if not summary["bit_exact"]:
@@ -139,6 +184,39 @@ def main():
     if deg["events"] < 1:
         failures.append("serving.sample degrade left no recorded "
                         "device_sample_degraded event")
+    if not spec["bit_exact"] or not spec["plain_bit_exact"]:
+        failures.append("speculative decode drifted from the reference "
+                        "(spec %s, plain %s)" % (spec["bit_exact"],
+                                                 spec["plain_bit_exact"]))
+    if spec["spec_degraded"]:
+        failures.append("speculative engine degraded during the smoke "
+                        "flood: %r" % spec)
+    if not spec["acceptance_rate"] > 0:
+        failures.append("self-draft flood reported zero acceptance "
+                        "(rate %r — the accept path is dead)"
+                        % spec["acceptance_rate"])
+    if spec["spec_host_logit_syncs"] != 0:
+        failures.append(
+            "speculative path synced %d [R, V] logit rows to the host "
+            "(gate: 0 — accept/reject must stay on device)"
+            % spec["spec_host_logit_syncs"])
+    if spec["spec_propose_traces"] != 1 or spec["spec_verify_traces"] != 1:
+        failures.append(
+            "speculative flood compiled %d propose / %d verify traces "
+            "(gate: exactly 1 each)" % (spec["spec_propose_traces"],
+                                        spec["spec_verify_traces"]))
+    if spec["speedup"] < 1.0:
+        failures.append(
+            "speculative rounds x%.3f vs plain fused decode on every "
+            "paired wave (gate: >= x1.0 on the best wave — two "
+            "dispatches per k+1 tokens must not LOSE to k+1)"
+            % spec["speedup"])
+    if not sdeg["degraded_to_plain"] or not sdeg["tokens_ok"]:
+        failures.append("armed serving.speculate did not degrade "
+                        "cleanly: %r" % sdeg)
+    if sdeg["events"] < 1:
+        failures.append("serving.speculate degrade left no recorded "
+                        "speculation_degraded event")
     summary["ok"] = not failures
     print(json.dumps(summary))
     if failures:

@@ -8,9 +8,14 @@
 # fast path rides the same gate: device-side sampling token-identical
 # to host sampling, zero host logit syncs, no slower than host on the
 # paired interleaved waves, and an armed serving.sample fault degrades
-# to host sampling with a recorded event. Companion to
-# tools/serve_smoke.sh (one-shot micro-batching tier). One retry damps
-# shared-CI scheduler noise before calling a throughput loss real.
+# to host sampling with a recorded event. The speculative leg rides it
+# too: self-draft rounds token-identical to the plain fused engine,
+# acceptance > 0, zero host logit syncs, one propose + one verify
+# trace, no slower than plain fused on the paired waves, and an armed
+# serving.speculate fault degrades to plain decode with a recorded
+# event. Companion to tools/serve_smoke.sh (one-shot micro-batching
+# tier). One retry damps shared-CI scheduler noise before calling a
+# throughput loss real.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
