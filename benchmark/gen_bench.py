@@ -355,6 +355,97 @@ def bench_exhaustion(page_tokens=4, seed=1):
     return out
 
 
+def bench_prefix(requests=4, max_new=8, prefix_tokens=32, page_tokens=8,
+                 waves=2, seed=0):
+    """The paired shared-vs-private wave: N requests over one long
+    common prefix answered by two engines over the SAME model — prefix
+    sharing off, then on — reporting the footprint and admission deltas
+    at equal (token-identical greedy) output.
+
+    Two legs, both paired:
+
+    - **footprint**: a pool comfortable for either engine; the row
+      reports peak live pages per engine and their ratio. Sharing must
+      not change a single token; it only changes how many physical
+      pages the wave pins.
+    - **admission**: a pool sized BELOW requests x the private
+      per-request footprint. The shared engine (cache warmed by one
+      request) admits the whole wave concurrently because admission
+      reserves dedup-aware effective tokens; the private engine
+      serializes against physical pages. Nothing is shed either way.
+    """
+    from paddle_tpu.serving import GenerationEngine, reference_decode
+
+    model = build_model(max_seq=96, seed=seed)
+    V = model.config.vocab_size
+    prefix = [(7 * i + 3) % V for i in range(prefix_tokens)]
+    prompts = [prefix + [(i + 1) % V, (2 * i + 5) % V]
+               for i in range(requests)]
+    want = [reference_decode(model, p, max_new) for p in prompts]
+
+    # private per-request footprint in pages (prompt + decode budget)
+    pages_per_req = -(-(prefix_tokens + 2 + max_new) // page_tokens)
+    prefix_pages = prefix_tokens // page_tokens   # full pages only
+    tail_pages = pages_per_req - prefix_pages
+    roomy = pages_per_req * requests
+    tight = prefix_pages + tail_pages * requests  # < roomy for N > 1
+
+    out = {
+        "requests": requests,
+        "prefix_tokens": prefix_tokens,
+        "max_new_tokens": max_new,
+        "page_tokens": page_tokens,
+        "private_pages_per_request": pages_per_req,
+        "roomy_kv_pages": roomy,
+        "tight_kv_pages": tight,
+    }
+
+    def _run(sharing, kv_pages, label):
+        eng = GenerationEngine(model, max_running=requests,
+                               kv_pages=kv_pages, page_tokens=page_tokens,
+                               queue_depth=4 * requests, warm=True,
+                               prefix_sharing=sharing, name=label)
+        try:
+            # one solo request first: publishes the prefix so the
+            # timed wave probes a warm cache (no-op when sharing off)
+            eng.generate(prompts[0], max_new_tokens=max_new, timeout=600)
+            results = None
+            for _ in range(waves):
+                _, results = _flood(eng, prompts, max_new)
+            st = eng.stats
+        finally:
+            eng.close()
+        exact = all(r.tokens == w for r, w in zip(results, want))
+        return st, exact
+
+    # footprint leg: roomy pool, paired engines
+    peaks = {}
+    for label, sharing in (("private", False), ("shared", True)):
+        st, exact = _run(sharing, roomy, "fp_" + label)
+        peaks[label] = st["page_utilization_max"] * roomy
+        out["footprint_%s_bit_exact" % label] = exact
+        out["footprint_%s_peak_pages" % label] = round(peaks[label], 1)
+        if sharing:
+            out["prefix_hits"] = st["prefix_hits"]
+            out["prefix_hit_requests"] = st["prefix_hit_requests"]
+            out["cow_copies"] = st["cow_copies"]
+            util = st["page_utilization"]
+            out["dedup_ratio"] = util.get("dedup_ratio")
+    out["footprint_ratio"] = (round(peaks["private"] / peaks["shared"], 3)
+                              if peaks["shared"] else 0.0)
+
+    # admission leg: tight pool, same wave
+    for label, sharing in (("private", False), ("shared", True)):
+        st, exact = _run(sharing, tight, "adm_" + label)
+        out["admission_%s_bit_exact" % label] = exact
+        out["admission_%s_max_running_seen" % label] = \
+            st["max_running_seen"]
+        out["admission_%s_shed" % label] = st["shed"] + st["failed"]
+    out["bit_exact"] = all(
+        out[k] for k in out if k.endswith("_bit_exact"))
+    return out
+
+
 if __name__ == "__main__":
     import argparse
     import json
@@ -371,18 +462,28 @@ if __name__ == "__main__":
     ap.add_argument("--bank", action="store_true",
                     help="persist a paddle_tpu.bench.v1 row under "
                          "benchmark/results/")
+    ap.add_argument("--mode", choices=["all", "prefix"], default="all",
+                    help="'prefix' runs only the paired shared-vs-"
+                         "private wave and banks it as gen_prefix")
     a = ap.parse_args()
-    summary = bench(requests=a.requests, max_new=a.max_new,
-                    max_running=a.max_running, waves=a.waves)
-    summary["fused"] = bench_fused(requests=a.requests, max_new=a.max_new,
-                                   max_running=a.max_running,
-                                   waves=a.waves)
-    summary["speculative"] = bench_speculative(
-        requests=a.requests, max_new=a.max_new,
-        max_running=a.max_running, waves=a.waves)
-    summary["exhaustion"] = bench_exhaustion()
+    if a.mode == "prefix":
+        summary = bench_prefix()
+        bench_name = "gen_prefix"
+    else:
+        summary = bench(requests=a.requests, max_new=a.max_new,
+                        max_running=a.max_running, waves=a.waves)
+        summary["fused"] = bench_fused(requests=a.requests,
+                                       max_new=a.max_new,
+                                       max_running=a.max_running,
+                                       waves=a.waves)
+        summary["speculative"] = bench_speculative(
+            requests=a.requests, max_new=a.max_new,
+            max_running=a.max_running, waves=a.waves)
+        summary["exhaustion"] = bench_exhaustion()
+        summary["prefix"] = bench_prefix()
+        bench_name = "gen"
     print(json.dumps(summary, indent=1))
     if a.bank:
         from paddle_tpu.tune import results as results_mod
-        rec = results_mod.bench_record("gen", [summary])
+        rec = results_mod.bench_record(bench_name, [summary])
         print("banked:", results_mod.write_result(rec))

@@ -44,6 +44,10 @@ Checks (codes in doc/diagnostics.md):
   serve_page_tokens x layers x heads x head_dim`` (x2 for K and V,
   +1 trash page per layer) vs budget minus model bytes; checked by
   ``inference.validate_generative_artifact`` when a budget is known.
+  Copy-on-write prefix sharing never changes this number — the pool
+  preallocates physically — so :func:`kv_pool_residency` reports the
+  sharing win as *capacity* columns (effective pages/tokens at a
+  dedup ratio) beside the physical price, not as a discount on it.
 
 Entry points: ``paddle_tpu lint --memory [--budget-gb G --mesh dp=N]``;
 the Executor preflight under ``PADDLE_TPU_VERIFY`` (raises one readable
@@ -72,7 +76,8 @@ from .runner import op_sub_blocks
 __all__ = ["MemoryPlan", "plan_memory", "check_memory", "check_kv_pool",
            "verify_memory_or_raise", "resolve_budget_bytes",
            "measure_live_bytes", "compute_liveness", "flatten_ops",
-           "MEMORY_CODES", "kv_pool_bytes", "fmt_bytes"]
+           "MEMORY_CODES", "kv_pool_bytes", "kv_pool_residency",
+           "fmt_bytes"]
 
 MEMORY_CODES = ("PT030", "PT031", "PT032", "PT033", "PT034")
 
@@ -597,6 +602,37 @@ def check_kv_pool(num_layers, num_heads, head_dim, kv_pages, page_tokens,
         hint="lower --kv_pages / FLAGS.serve_kv_pages or "
              "--page_tokens, serve a smaller model, or raise "
              "FLAGS.memory_budget_gb if the device really has more")]
+
+
+def kv_pool_residency(num_layers, num_heads, head_dim, kv_pages,
+                      page_tokens, dtype="float32", dedup_ratio=1.0):
+    """Shared-page sizing columns for the paged KV pool — the
+    ``accounting`` CLI's ``kv_pool`` section and the static twin of the
+    live pool's /statz snapshot (serving/kvcache.py).
+
+    Residency is priced by PHYSICAL pages: copy-on-write prefix sharing
+    (serving/prefix.py) never shrinks the preallocation, it multiplies
+    what those pages can hold. ``dedup_ratio`` (effective refcounts over
+    live physical pages; 1.0 = no sharing) therefore scales the
+    *capacity* columns (``effective_pages`` / ``effective_tokens`` —
+    what admission reserves against) and leaves ``physical_bytes``
+    alone, which is exactly why :func:`check_kv_pool` keeps charging
+    the physical pool against the budget: sharing raises throughput
+    per byte, never bytes."""
+    pool = kv_pool_bytes(num_layers, num_heads, head_dim, kv_pages,
+                         page_tokens, dtype)
+    phys = int(kv_pages)
+    ratio = max(float(dedup_ratio), 1.0)
+    page = (2 * int(num_layers) * int(page_tokens) * int(num_heads)
+            * int(head_dim) * _dtype_bytes(dtype))
+    return {
+        "physical_pages": phys,
+        "physical_bytes": int(pool),
+        "page_bytes": int(page),
+        "dedup_ratio": round(ratio, 4),
+        "effective_pages": int(phys * ratio),
+        "effective_tokens": int(phys * ratio) * int(page_tokens),
+    }
 
 
 # ---------------------------------------------------------------------------

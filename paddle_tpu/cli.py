@@ -418,7 +418,8 @@ def cmd_serve(args):
         max_batch=args.max_batch or None,
         batch_timeout_ms=(args.batch_timeout_ms
                           if args.batch_timeout_ms >= 0 else None),
-        queue_depth=args.queue_depth or None)
+        queue_depth=args.queue_depth or None,
+        tier=args.tier or None)
     gen_overrides = {}
     if args.max_running:
         gen_overrides["max_running"] = args.max_running
@@ -426,6 +427,8 @@ def cmd_serve(args):
         gen_overrides["kv_pages"] = args.kv_pages
     if args.page_tokens:
         gen_overrides["page_tokens"] = args.page_tokens
+    if args.prefix_sharing:
+        gen_overrides["prefix_sharing"] = True
     # speculation plumbing for the PRIMARY model only: an external
     # --draft_dir loads here; a speculative artifact needs nothing —
     # the registry auto-detects and pairs it on load
@@ -465,6 +468,8 @@ def cmd_serve(args):
         "version": entry.version, "warmup_ms": round(entry.warmup_ms, 3),
         "max_batch": service.max_batch,
         "batch_timeout_ms": service.batch_timeout_ms}
+    if service.tier:
+        info["tier"] = service.tier
     if extra_models:
         info["extra_models"] = [n for n, _ in extra_models]
     if generative:
@@ -477,6 +482,9 @@ def cmd_serve(args):
             info.update({"speculative": st["speculative"],
                          "spec_k": st["spec_k"],
                          "spec_degraded": st["spec_degraded"]})
+        if st.get("prefix_sharing") or st.get("prefix_degraded"):
+            info.update({"prefix_sharing": st["prefix_sharing"],
+                         "prefix_degraded": st["prefix_degraded"]})
     print(json.dumps({"serving": info}), flush=True)
     try:
         signum = serving.httpd.serve_until_shutdown(server)
@@ -541,9 +549,48 @@ def cmd_route(args):
         serve_args += ["--kv_pages", str(args.kv_pages)]
     if args.page_tokens:
         serve_args += ["--page_tokens", str(args.page_tokens)]
+    if args.prefix_sharing:
+        serve_args += ["--prefix_sharing"]
     for n, d in extra_models:
         serve_args += ["--extra_model", "%s=%s" % (n, d)]
-    if args.autoscale:
+    tier_counts = None
+    serve_args_overrides = {}
+    tier_of = {}
+    if args.tiers:
+        tier_counts = {}
+        try:
+            for part in args.tiers.split(","):
+                k, _, v = part.partition("=")
+                k = k.strip()
+                if k not in ("prefill", "decode"):
+                    raise ValueError("unknown tier %r" % k)
+                tier_counts[k] = int(v)
+                if tier_counts[k] < 1:
+                    raise ValueError("tier %r wants >= 1 replica" % k)
+        except ValueError as e:
+            print("route: bad --tiers %r: %s" % (args.tiers, e),
+                  file=sys.stderr)
+            return 1
+        if set(tier_counts) != {"prefill", "decode"}:
+            print("route: --tiers wants BOTH classes, e.g. "
+                  "prefill=1,decode=2", file=sys.stderr)
+            return 1
+        initial = sum(tier_counts.values())
+        if args.replicas and args.replicas != initial:
+            print("route: --tiers fixes the fleet size at %d; drop "
+                  "--replicas" % initial, file=sys.stderr)
+            return 1
+        idx = 0
+        for t in ("prefill", "decode"):
+            for _ in range(tier_counts[t]):
+                serve_args_overrides[idx] = ["--tier", t]
+                tier_of[idx] = t
+                idx += 1
+        # per-tier autoscale budget: each class may grow by `headroom`
+        # above its configured floor (default: double the tier)
+        tier_headroom = (max(args.max_replicas - initial, 0)
+                         if args.max_replicas else initial)
+    elif args.autoscale:
         max_replicas = args.max_replicas or max(args.min_replicas,
                                                 FLAGS.route_replicas)
         if args.min_replicas < 1 or max_replicas < args.min_replicas:
@@ -567,6 +614,7 @@ def cmd_route(args):
         pool = ReplicaPool(
             args.artifact_dir, initial,
             name=args.name, host=args.host, serve_args=serve_args,
+            serve_args_overrides=serve_args_overrides or None,
             restart_budget=(args.restart_budget if args.restart_budget >= 0
                             else None),
             grace_sec=args.grace_sec)
@@ -575,7 +623,7 @@ def cmd_route(args):
         print("route: %s" % e, file=sys.stderr)
         return 1
     router = None
-    autoscaler = None
+    autoscalers = []
     try:
         # anything failing before the serve loop (say, the router port
         # already bound) must still drain the fleet pool.start spawned
@@ -585,8 +633,22 @@ def cmd_route(args):
                         state_dir=args.state_dir or None)
         router.poll_once()
         router.start_polling()
-        if args.autoscale:
-            autoscaler = Autoscaler(
+        if args.autoscale and tier_counts:
+            # one controller PER serving class, each on its
+            # class-correct signal (queue depth / page occupancy)
+            autoscalers = [
+                Autoscaler(
+                    router, pool, tier=t,
+                    min_replicas=tier_counts[t],
+                    max_replicas=tier_counts[t] + tier_headroom,
+                    cooldown_s=(args.cooldown_s
+                                if args.cooldown_s >= 0 else None))
+                for t in ("prefill", "decode")]
+            router.autoscaler = list(autoscalers)
+            for a in autoscalers:
+                a.start()
+        elif args.autoscale:
+            autoscalers = [Autoscaler(
                 router, pool, min_replicas=args.min_replicas,
                 max_replicas=max_replicas,
                 up_pressure=(args.scale_up_pressure
@@ -595,14 +657,14 @@ def cmd_route(args):
                                if args.scale_down_pressure >= 0
                                else None),
                 cooldown_s=(args.cooldown_s
-                            if args.cooldown_s >= 0 else None))
-            router.autoscaler = autoscaler
-            autoscaler.start()
+                            if args.cooldown_s >= 0 else None))]
+            router.autoscaler = autoscalers[0]
+            autoscalers[0].start()
         server = make_router_server(router, host=args.host,
                                     port=args.port)
     except Exception as e:
-        if autoscaler is not None:
-            autoscaler.close()
+        for a in autoscalers:
+            a.close()
         if router is not None:
             router.close()
         pool.stop()
@@ -612,16 +674,28 @@ def cmd_route(args):
     info = {
         "host": host, "port": port, "model": args.name,
         "policy": router.policy,
-        "replicas": [{"index": w["index"], "port": w["port"],
-                      "pid": w["pid"]}
+        "replicas": [dict({"index": w["index"], "port": w["port"],
+                           "pid": w["pid"]},
+                          **({"tier": tier_of[w["index"]]}
+                             if w["index"] in tier_of else {}))
                      for w in pool.describe()["workers"]]}
-    if autoscaler is not None:
+    if tier_counts:
+        info["tiers"] = dict(tier_counts)
+    if len(autoscalers) == 1 and autoscalers[0].tier is None:
+        a = autoscalers[0]
         info["autoscale"] = {
-            "min_replicas": autoscaler.min_replicas,
-            "max_replicas": autoscaler.max_replicas,
-            "up_pressure": autoscaler.up_pressure,
-            "down_pressure": autoscaler.down_pressure,
-            "cooldown_s": autoscaler.cooldown_s}
+            "min_replicas": a.min_replicas,
+            "max_replicas": a.max_replicas,
+            "up_pressure": a.up_pressure,
+            "down_pressure": a.down_pressure,
+            "cooldown_s": a.cooldown_s}
+    elif autoscalers:
+        info["autoscale"] = [
+            {"tier": a.tier, "min_replicas": a.min_replicas,
+             "max_replicas": a.max_replicas,
+             "up_pressure": a.up_pressure,
+             "down_pressure": a.down_pressure,
+             "cooldown_s": a.cooldown_s} for a in autoscalers]
     print(json.dumps({"router": info}), flush=True)
     try:
         signum = httpd.serve_until_shutdown(server)
@@ -631,8 +705,8 @@ def cmd_route(args):
             # stats/close can take a couple of seconds (the close joins
             # the poller) — a second Ctrl-C landing there must still
             # drain the fleet, so pool.stop() is not gated on them
-            if autoscaler is not None:
-                autoscaler.close()
+            for a in autoscalers:
+                a.close()
             final_stats = router.stats()
             server.server_close()
             router.close()
@@ -656,7 +730,10 @@ def cmd_accounting(args):
     input. ``--sharding`` adds the propagated-PartitionSpec plan
     (analysis.sharding): per-class spec table, fingerprint, priced
     implicit reshards, and any PT040-PT045 diagnostics as a
-    ``sharding`` section. Pure analysis: nothing is compiled or
+    ``sharding`` section. ``--generative DIR`` adds a ``kv_pool``
+    section: the artifact's physical-page KV residency with
+    dedup-ratio capacity columns (``--dedup-ratio``; speculative
+    pairings fold the draft in). Pure analysis: nothing is compiled or
     executed, no devices needed. Same config contract as
     ``train``/``lint`` (the file defines ``model()``)."""
     import paddle_tpu as pt
@@ -696,6 +773,15 @@ def cmd_accounting(args):
                                         fetches=fetches),
                 train_step=train_step),
         }
+        if args.generative:
+            from paddle_tpu import inference as _inf
+            res = _inf.generative_residency(
+                args.generative, dedup_ratio=args.dedup_ratio)
+            if res is None:
+                print("accounting: --generative %r is not a readable "
+                      "generative artifact" % args.generative)
+                return 2
+            report["kv_pool"] = res
         if args.sharding:
             from paddle_tpu.analysis import sharding as sharding_mod
             plan, sharding_diags = sharding_mod.check_sharding(
@@ -1084,6 +1170,20 @@ def main(argv=None):
                     help="generative artifacts: speculation depth "
                          "override (0 = FLAGS.serve_spec_k or the "
                          "paired artifact's qualified k)")
+    sv.add_argument("--prefix_sharing", "--prefix-sharing",
+                    action="store_true",
+                    help="generative artifacts: copy-on-write prefix "
+                         "sharing over the paged KV pool — concurrent "
+                         "same-prefix requests pin one physical copy "
+                         "of their shared prefill pages (greedy output "
+                         "stays bit-identical; default "
+                         "FLAGS.serve_prefix_sharing)")
+    sv.add_argument("--tier", default="", choices=["", "prefill",
+                                                   "decode"],
+                    help="serving class for a disaggregated fleet "
+                         "(advertised through /statz so the router "
+                         "two-hops :generate as prefill -> handoff -> "
+                         "decode); empty = a do-everything replica")
     sv.add_argument("--extra_model", action="append", default=[],
                     metavar="NAME=DIR",
                     help="additional artifact(s) to publish from the "
@@ -1180,6 +1280,21 @@ def main(argv=None):
     rt.add_argument("--spec_k", type=int, default=0,
                     help="speculation depth forwarded to every replica "
                          "(0 = flag/artifact default)")
+    rt.add_argument("--prefix_sharing", "--prefix-sharing",
+                    action="store_true",
+                    help="forward copy-on-write KV prefix sharing to "
+                         "every replica")
+    rt.add_argument("--tiers", default="",
+                    help="disaggregated fleet layout, e.g. "
+                         "'prefill=1,decode=2': the first N replicas "
+                         "serve --tier prefill, the rest --tier decode, "
+                         "and the router two-hops :generate as "
+                         "prefill -> handoff -> decode (fault site "
+                         "serving.ship: a failed hop re-prefills on "
+                         "the decode tier). With --autoscale each tier "
+                         "gets its OWN controller on its class-correct "
+                         "signal (queue depth / page occupancy), "
+                         "floored at its configured count")
     rt.add_argument("--extra_model", action="append", default=[],
                     metavar="NAME=DIR",
                     help="additional artifact(s) every replica publishes "
@@ -1214,6 +1329,18 @@ def main(argv=None):
                           "(negative = FLAGS.comm_split_ratio; derive "
                           "from measured bandwidths via "
                           "comm.measured_split_ratio)")
+    acc.add_argument("--generative", default="", metavar="DIR",
+                     help="also price a generative artifact's KV-pool "
+                          "residency (inference.generative_residency): "
+                          "physical pages/bytes + the dedup-ratio "
+                          "capacity columns as a 'kv_pool' section; a "
+                          "speculative pairing folds the draft in")
+    acc.add_argument("--dedup-ratio", type=float, default=1.0,
+                     dest="dedup_ratio",
+                     help="prefix-sharing dedup ratio to price the "
+                          "--generative capacity columns at (1.0 = no "
+                          "sharing; e.g. the live pool's observed "
+                          "dedup_ratio stat)")
     acc.add_argument("--sharding", action="store_true",
                      help="add the propagated-PartitionSpec plan "
                           "(analysis.sharding PT040-PT045): per-class "

@@ -520,6 +520,41 @@ DEFINE_int32("serve_spec_k", 4,
              "while the draft's acceptance rate holds up (watch "
              "acceptance_rate in /statz). 0 disables speculation even "
              "when a draft is available")
+DEFINE_bool("serve_prefix_sharing", False,
+            "generation engine: content-hash prefill pages (rolling "
+            "blake2b chain over serve_page_tokens-sized token chunks) "
+            "and let N concurrent requests PIN one physical copy of a "
+            "shared prompt prefix instead of each paying full-price KV "
+            "pages. The pool refcounts pages; the first divergent "
+            "write copy-on-writes just that page; admission discounts "
+            "its reservation by the cached full pages it will pin; an "
+            "LRU keeps unreferenced prefix pages warm until allocation "
+            "pressure reclaims them. Greedy output is bit-identical "
+            "with sharing on or off. A failure in the sharing layer "
+            "degrades that engine to plain private pages with a "
+            "recorded prefix_degraded event (fault site "
+            "serving.prefix), never an outage")
+DEFINE_string("serve_tier", "",
+              "serving tier class for the disaggregated fleet "
+              "(serving/disagg.py): empty = a normal do-everything "
+              "replica; 'prefill' advertises the replica as prefill-"
+              "class (router sends it fresh prompts, ships the "
+              "finished KV pages + request state to a decode replica); "
+              "'decode' advertises decode-class (receives handoff "
+              "artifacts, runs the steady-state token loop). The tier "
+              "is advertised through /statz; the Router never "
+              "dispatches a tier to work outside its class")
+DEFINE_float("route_prefill_up_queue", 4.0,
+             "tiered autoscale: a prefill-class tier scales UP when "
+             "its per-replica mean queue depth (queued + running "
+             "prefills — the compute-bound signal) exceeds this; see "
+             "route_scale_down_pressure's decode analogue "
+             "route_decode_up_frac for the decode tier")
+DEFINE_float("route_decode_up_frac", 0.8,
+             "tiered autoscale: a decode-class tier scales UP when its "
+             "mean KV page-pool PHYSICAL occupancy fraction exceeds "
+             "this (memory-bound signal — decode replicas run out of "
+             "pages long before they run out of FLOPs)")
 DEFINE_int32("route_replicas", 3,
              "serving router (paddle_tpu.serving.router): how many "
              "`serve` worker processes the replica pool spawns and "

@@ -47,7 +47,7 @@ __all__ = ["export_compiled", "load_compiled", "CompiledModel",
            "export_generative", "load_generative",
            "validate_generative_artifact", "is_generative_artifact",
            "export_speculative", "load_speculative",
-           "is_speculative_artifact"]
+           "is_speculative_artifact", "generative_residency"]
 
 
 class ArtifactError(RuntimeError):
@@ -404,6 +404,42 @@ def generative_memory_bytes(dirname, kv_pages=None, page_tokens=None):
             return None
         total += draft
     return total
+
+
+def generative_residency(dirname, kv_pages=None, page_tokens=None,
+                         dedup_ratio=1.0):
+    """Shared-page residency report for one generative artifact — the
+    ``accounting --generative`` section. Prices the pool by PHYSICAL
+    pages (``analysis.memory.kv_pool_residency``: prefix sharing
+    multiplies capacity, never shrinks the preallocation) with the
+    dedup-ratio capacity columns beside it; a speculative pairing folds
+    the draft's weights + its own pool into ``total_physical_bytes``
+    and reports the draft's columns under ``draft`` so the pairing's
+    co-residency stays honest. None when the artifact is unreadable.
+    ``dedup_ratio`` is an assumption to price (e.g. the live pool's
+    observed ``dedup_ratio`` stat), default 1.0 = no sharing."""
+    from .analysis import memory as _mem
+    geo = _gen_geometry(dirname, kv_pages=kv_pages,
+                        page_tokens=page_tokens)
+    if geo is None:
+        return None
+    layers, heads, head_dim, model_bytes, pages, ptokens = geo
+    out = {
+        "model_bytes": int(model_bytes),
+        "kv_pool": _mem.kv_pool_residency(layers, heads, head_dim,
+                                          pages, ptokens,
+                                          dedup_ratio=dedup_ratio),
+    }
+    total = int(model_bytes) + out["kv_pool"]["physical_bytes"]
+    if is_speculative_artifact(dirname):
+        draft = generative_residency(
+            os.path.join(dirname, DRAFT_SUBDIR), kv_pages=kv_pages,
+            page_tokens=page_tokens, dedup_ratio=dedup_ratio)
+        if draft is not None:
+            out["draft"] = draft
+            total += draft["total_physical_bytes"]
+    out["total_physical_bytes"] = total
+    return out
 
 
 def _kv_pool_problems(dirname, kv_pages=None, page_tokens=None,

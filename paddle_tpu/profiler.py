@@ -29,6 +29,7 @@ __all__ = ["timer", "stat_summary", "print_stats", "reset_stats",
            "reset_elastic_counters",
            "update_generation_counters", "generation_counters",
            "reset_generation_counters", "speculation_counters",
+           "prefix_counters",
            "update_router_counters", "router_counters",
            "reset_router_counters",
            "update_autoscale_counters", "autoscale_counters",
@@ -272,7 +273,17 @@ def update_generation_counters(**counters):
     target's verify accepted — acceptance rate is their ratio, surfaced
     by :func:`speculation_counters`), and ``gen_spec_degraded``
     (speculation dropped to plain decode; fault site
-    ``serving.speculate``)."""
+    ``serving.speculate``).
+
+    Prefix sharing and disaggregation add ``gen_prefix_hits`` (prefill
+    pages satisfied from the shared cache instead of recomputed),
+    ``gen_prefix_published`` (pages a prefill published for reuse),
+    ``gen_cow_copies`` (copy-on-write page splits on first divergent
+    write), ``gen_prefix_degraded`` (sharing dropped to private pages;
+    fault site ``serving.prefix``), ``gen_handoff_installs`` (prefill
+    artifacts installed on a decode replica), and ``gen_handoff_failed``
+    (handoffs that fell back to re-prefill; fault site
+    ``serving.ship``) — surfaced by :func:`prefix_counters`."""
     for k, v in counters.items():
         if k in _GEN_MAX_KEYS:
             _generation_counters[k] = max(_generation_counters[k], float(v))
@@ -299,6 +310,27 @@ def speculation_counters():
         "acceptance_rate": (g.get("gen_accepted_tokens", 0.0) / drafted
                             if drafted else 0.0),
         "spec_degraded": g.get("gen_spec_degraded", 0.0),
+    }
+
+
+def prefix_counters():
+    """The prefix-sharing / disaggregation slice of the generation
+    counters, plus the derived ``hit_rate`` (cache-hit pages over pages
+    published + hit; 0.0 before any shared prefill). This is the
+    timeline artifact's ``prefix`` section — all zeros on an engine
+    without sharing or handoffs."""
+    g = _generation_counters
+    hits = g.get("gen_prefix_hits", 0.0)
+    published = g.get("gen_prefix_published", 0.0)
+    return {
+        "prefix_hits": hits,
+        "prefix_published": published,
+        "hit_rate": (hits / (hits + published) if hits + published
+                     else 0.0),
+        "cow_copies": g.get("gen_cow_copies", 0.0),
+        "prefix_degraded": g.get("gen_prefix_degraded", 0.0),
+        "handoff_installs": g.get("gen_handoff_installs", 0.0),
+        "handoff_failed": g.get("gen_handoff_failed", 0.0),
     }
 
 
@@ -565,6 +597,7 @@ def write_timeline(path):
         "elastic": dict(_elastic_counters),
         "generation": dict(_generation_counters),
         "speculation": speculation_counters(),
+        "prefix": prefix_counters(),
         "router": dict(_router_counters),
         "autoscale": dict(_autoscale_counters),
         "memory": dict(_memory_counters),

@@ -88,6 +88,26 @@ site                      where
                           regression, never an outage; a delay models
                           a slow control plane and stretches the
                           reaction time, not correctness
+``serving.prefix``        copy-on-write prefix sharing
+                          (paddle_tpu.serving.prefix), hit at cache
+                          build and per prefix match: a raise degrades
+                          that engine to plain no-sharing private
+                          pages for its lifetime with a recorded
+                          ``prefix_degraded`` event — a memory-
+                          economics regression (every request pays
+                          full-price pages again), never an outage;
+                          running sequences and greedy outputs are
+                          bit-identical with sharing on or off
+``serving.ship``          the disaggregated prefill->decode handoff
+                          hop (paddle_tpu.serving.disagg), hit once
+                          per shipped artifact before the decode-tier
+                          install: a raise loses the HOP, never the
+                          request — the original prompt is re-
+                          submitted to the decode engine, which re-
+                          prefills locally (slower, bit-identical
+                          output) with a recorded ``handoff_failed``
+                          event; overload/pool-exhaustion answers are
+                          honest backpressure and propagate unchanged
 ``comm.quantize``         paddle_tpu.comm, per bucket at the quantised
                           all-reduce BUILD (trace time — the traced
                           collectives never re-enter the host): a raise
@@ -224,6 +244,8 @@ SITE_TABLE = {
     "serving.speculate": ("serving/speculative.py", True, False),
     "serving.route": ("serving/router.py", True, True),
     "serving.autoscale": ("serving/autoscale.py", True, True),
+    "serving.prefix": ("serving/prefix.py", True, False),
+    "serving.ship": ("serving/disagg.py", True, False),
     "comm.quantize": ("comm/allreduce.py", True, False),
     "comm.bucket_roundtrip": ("comm/bucket.py", True, False),
     "comm.overlap": ("comm/overlap.py", True, False),

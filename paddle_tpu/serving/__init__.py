@@ -45,6 +45,22 @@ would keep burning device time as padding):
   page-table trim, never a cache rollback. Greedy output stays
   token-identical; any draft failure degrades to plain decode (fault
   site ``serving.speculate``), recorded, never an outage.
+- :mod:`~paddle_tpu.serving.prefix` — copy-on-write prefix sharing
+  over the paged pool: prefill pages are content-hashed (rolling chain
+  over ``serve_page_tokens``-sized chunks) and refcounted, so N
+  concurrent same-prefix requests pin ONE physical copy; the first
+  divergent write copies just that page (the engine's CoW move), and
+  an LRU keeps unreferenced prefix pages warm until allocation
+  pressure reclaims them. Greedy output is bit-identical sharing on or
+  off (fault site ``serving.prefix`` degrades to private pages).
+- :mod:`~paddle_tpu.serving.disagg` — disaggregated prefill/decode
+  tiers: a prefill-class :class:`~paddle_tpu.serving.disagg.
+  PrefillEngine` runs only the prompt pass and exports the finished KV
+  pages + request state as a :class:`~paddle_tpu.serving.disagg.
+  HandoffArtifact`; :func:`~paddle_tpu.serving.disagg.ship` delivers
+  it into a decode-class engine's ``submit_prefilled`` (fault site
+  ``serving.ship``: a failed hop re-prefills on the decode tier —
+  slower, bit-identical, never lost).
 
 :class:`~paddle_tpu.serving.service.InferenceService` ties them together
 in-process (``infer``/``infer_async`` + ``generate``/``generate_async``;
@@ -74,6 +90,8 @@ from .generator import (  # noqa: F401
     sample_token,
 )
 from .speculative import DraftEngine  # noqa: F401
+from .prefix import PrefixCache  # noqa: F401
+from .disagg import HandoffArtifact, PrefillEngine, ship  # noqa: F401
 from .pool import ReplicaPool, StaticPool  # noqa: F401
 from .router import Router, make_router_server  # noqa: F401
 from .autoscale import Autoscaler  # noqa: F401
@@ -86,6 +104,7 @@ __all__ = [
     "PagePool", "BlockTable", "PoolExhausted", "pages_for",
     "GenerationEngine", "GenRequest", "GenResult", "GenEntry",
     "reference_decode", "sample_token", "DraftEngine",
+    "PrefixCache", "HandoffArtifact", "PrefillEngine", "ship",
     "ReplicaPool", "StaticPool", "Router", "make_router_server",
     "Autoscaler",
 ]

@@ -51,12 +51,27 @@ restart budget on it.
 router keeps serving; a dead autoscaler is a sizing regression, not an
 outage.
 
+**Per-tier mode.** A disaggregated fleet runs one controller PER
+serving class (``Autoscaler(..., tier="prefill")`` /
+``tier="decode"``), each acting on the class-correct signal from
+:meth:`Router.tier_signal` instead of the fleet-wide pressure: the
+prefill tier scales on mean queue depth per replica (prompt passes
+arrive as a queue; threshold ``FLAGS.route_prefill_up_queue``), the
+decode tier on mean KV page-pool occupancy (decode capacity IS page
+inventory; threshold ``FLAGS.route_decode_up_frac``). A tiered
+controller counts, grows (``pool.grow(extra_args=["--tier", ...])`` —
+the tier rides the slot's serve-args override, sticky across
+restarts), and shrinks ONLY its own class; the min/max budget is per
+tier. Everything else — hysteresis, drain-first, breaker, degrade —
+is identical.
+
 Decisions surface in RouterStats (``/statz`` -> ``autoscale``), in
 ``resilience.events()`` (``autoscale_up`` / ``autoscale_down`` /
 breaker events), and in ``profiler.autoscale_counters()`` + the
 timeline artifact's ``autoscale`` section. CLI: ``paddle_tpu route
 --autoscale --min_replicas 1 --max_replicas 4 [--scale_up_pressure
-1.0 --scale_down_pressure 0.2 --cooldown_s 30]``.
+1.0 --scale_down_pressure 0.2 --cooldown_s 30]``; tiered:
+``paddle_tpu route --tiers prefill=1,decode=2 --autoscale``.
 """
 from __future__ import annotations
 
@@ -89,10 +104,24 @@ class Autoscaler(object):
                  quiet_polls=10, cooldown_s=None, down_cooldown_s=None,
                  poll_s=None, warmup_s=60.0, breaker_backoff_s=30.0,
                  drain_deadline_s=30.0, clock=time.monotonic,
-                 sleep=time.sleep):
+                 sleep=time.sleep, tier=None):
         from ..flags import FLAGS
         self.router = router
         self.pool = pool
+        if tier is not None and tier not in ("prefill", "decode"):
+            raise ValueError("tier must be None, 'prefill' or 'decode', "
+                             "got %r" % tier)
+        self.tier = tier
+        if tier is not None:
+            # class-correct threshold defaults: the signal's UNITS
+            # differ per tier (queue depth vs occupancy fraction), so
+            # the fleet-wide pressure defaults would be nonsense here
+            if up_pressure is None:
+                up_pressure = (FLAGS.route_prefill_up_queue
+                               if tier == "prefill"
+                               else FLAGS.route_decode_up_frac)
+            if down_pressure is None:
+                down_pressure = float(up_pressure) / 4.0
         self.min_replicas = int(min_replicas)
         self.max_replicas = int(max_replicas if max_replicas is not None
                                 else max(self.min_replicas,
@@ -162,13 +191,30 @@ class Autoscaler(object):
 
     def _active(self):
         """The committed fleet size: live slots, a still-warming grow
-        included (it is capacity the budget already spent)."""
-        return len(self.pool.snapshot())
+        included (it is capacity the budget already spent). A tiered
+        controller counts only its OWN class — a replica whose tier
+        the router has not learned yet counts iff this controller grew
+        it (it is in the warm-up watch)."""
+        reps = self.pool.snapshot()
+        if self.tier is None:
+            return len(reps)
+        with self._lock:
+            pending = set(self._pending)
+        n = 0
+        for r in reps:
+            t = self.router.replica_tier(r.index)
+            if t == self.tier or (not t and r.index in pending):
+                n += 1
+        return n
 
     def signal(self):
-        """The control signal: the max per-model smoothed pressure (the
-        fleet is homogeneous — every replica serves every model, so the
-        hottest model sizes the pool). None before the first poll."""
+        """The control signal. Fleet-wide mode: the max per-model
+        smoothed pressure (the fleet is homogeneous — every replica
+        serves every model, so the hottest model sizes the pool); None
+        before the first poll. Tiered mode: the router's per-class
+        signal (queue depth for prefill, page occupancy for decode)."""
+        if self.tier is not None:
+            return self.router.tier_signal(self.tier)
         vals = self.router.pressure_smoothed()
         if not vals:
             return None
@@ -327,7 +373,11 @@ class Autoscaler(object):
     def _scale_up(self, now, sig, active, reason="pressure"):
         from .. import profiler as _prof
         probe = self._breaker == "half_open"
-        rep = self.pool.grow()
+        # only a tiered controller needs the override plumbing — the
+        # plain call keeps every duck-typed pool (tests, StaticPool
+        # raising) working unchanged
+        rep = (self.pool.grow(extra_args=["--tier", self.tier])
+               if self.tier else self.pool.grow())
         with self._lock:
             self._pending[rep.index] = {"gen": rep.generation,
                                         "deadline": now + self.warmup_s,
@@ -346,6 +396,11 @@ class Autoscaler(object):
 
     def _pick_victim(self):
         reps = self.pool.snapshot()
+        if self.tier is not None:
+            # a tiered controller retires only its OWN class — the
+            # decode tier idling must never shrink a prefill replica
+            reps = [r for r in reps
+                    if self.router.replica_tier(r.index) == self.tier]
         if not reps:
             return None
         return max(reps, key=lambda r: r.index).index
@@ -440,6 +495,7 @@ class Autoscaler(object):
             degraded_error = self._degraded_error
         out = {
             "active": self._safe_active(),
+            "tier": self.tier,
             "min_replicas": self.min_replicas,
             "max_replicas": self.max_replicas,
             "pressure": last_signal,

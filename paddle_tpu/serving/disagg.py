@@ -1,0 +1,308 @@
+"""Disaggregated serving: prefill-tier engines + the KV handoff hop.
+
+Prefill and decode are different machines wearing one API: prefill is
+compute-bound and bursty (one big matmul wave per request, then done),
+decode is memory-bound and steady (one small step per token, pinned to
+the KV pool). A fleet of do-everything replicas sizes both phases with
+one knob and scales them with one signal, so it is always wrong for at
+least one of them. This module splits the roles — the reference
+framework's signature move (the DistributeTranspiler rewriting one
+program into cooperating trainer/pserver processes), applied to the
+serving tier:
+
+- :class:`PrefillEngine` is the prefill-class replica's engine: it runs
+  ONLY the prompt pass (same compiled prefill faces and the same
+  position-keyed device sampling as
+  :class:`~paddle_tpu.serving.generator.GenerationEngine`, so the first
+  token is bit-identical to a local prefill), then EXPORTS the finished
+  KV pages and the request state as a :class:`HandoffArtifact` and
+  frees its own pages — a prefill replica holds a request's memory for
+  milliseconds, not for the decode lifetime.
+- :class:`HandoffArtifact` is the wire unit: prompt, first sampled
+  token (+ logprob), sampling params, pool geometry, and the raw K/V
+  page contents. ``to_payload``/``from_payload`` give it a JSON body
+  (base64 float arrays) for the HTTP hop between real replicas.
+- :func:`ship` is the hop itself, fault site ``serving.ship``: deliver
+  the artifact into a decode-class engine's
+  ``submit_prefilled``. A failed hop NEVER loses the request — it is
+  re-submitted as a plain prompt to the decode engine (which
+  re-prefills locally: slower, identical output, recorded
+  ``handoff_failed`` event). Overload/pool-exhaustion answers from the
+  decode engine are honest backpressure and propagate unchanged; the
+  fallback exists for the hop dying, not for the fleet being full.
+
+Honest CPU-vs-TPU caveat (doc/serving.md spells it out): on this CPU
+build the "ship" is a host round trip through numpy/base64 and the
+decode side re-uploads the pages; a TPU deployment would DMA pages
+between device HBMs (ICI/DCN) and the artifact would carry device
+buffer handles, not bytes. The protocol, accounting, and failure
+semantics are what this module pins down; the transport is the part a
+TPU backend swaps.
+"""
+from __future__ import annotations
+
+import base64
+import time
+
+import numpy as np
+
+from ..resilience import fault_point, record_event
+from .admission import ServingError
+from .batcher import bucket_for, padding_buckets
+from .kvcache import PagePool, pages_for
+
+__all__ = ["HandoffArtifact", "PrefillEngine", "ship"]
+
+
+class HandoffArtifact(object):
+    """One finished prefill, packaged for the decode tier: the request
+    state that makes the continuation bit-exact (prompt, first sampled
+    token + logprob, temperature, seed, budget), the pool geometry the
+    pages were written under, and the raw page contents
+    (``k_pages``/``v_pages``, shape ``[L, n_pages, T, nh, dh]``)."""
+
+    __slots__ = ("prompt", "first_token", "first_logprob", "temperature",
+                 "seed", "max_new_tokens", "page_tokens", "num_layers",
+                 "num_heads", "head_dim", "k_pages", "v_pages")
+
+    def __init__(self, prompt, first_token, first_logprob, temperature,
+                 seed, max_new_tokens, page_tokens, num_layers, num_heads,
+                 head_dim, k_pages, v_pages):
+        self.prompt = [int(t) for t in prompt]
+        self.first_token = int(first_token)
+        self.first_logprob = (None if first_logprob is None
+                              else float(first_logprob))
+        self.temperature = float(temperature)
+        self.seed = int(seed)
+        self.max_new_tokens = int(max_new_tokens)
+        self.page_tokens = int(page_tokens)
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.k_pages = np.asarray(k_pages)
+        self.v_pages = np.asarray(v_pages)
+
+    @property
+    def pages(self):
+        return int(self.k_pages.shape[1])
+
+    @property
+    def kv_bytes(self):
+        """Wire weight of the hop (both page arrays) — what the comm
+        model would price as one inter-replica transfer."""
+        return int(self.k_pages.nbytes + self.v_pages.nbytes)
+
+    # -- wire format ----------------------------------------------------------
+    def to_payload(self):
+        """JSON-able dict (the ``:decode`` HTTP body): scalars inline,
+        page contents as base64 of the raw little-endian bytes plus
+        dtype/shape so the receive side rebuilds them exactly."""
+        def pack(a):
+            a = np.ascontiguousarray(a)
+            return {"dtype": str(a.dtype), "shape": list(a.shape),
+                    "data": base64.b64encode(a.tobytes()).decode("ascii")}
+        return {"prompt": list(self.prompt),
+                "first_token": self.first_token,
+                "first_logprob": self.first_logprob,
+                "temperature": self.temperature,
+                "seed": self.seed,
+                "max_new_tokens": self.max_new_tokens,
+                "page_tokens": self.page_tokens,
+                "num_layers": self.num_layers,
+                "num_heads": self.num_heads,
+                "head_dim": self.head_dim,
+                "k_pages": pack(self.k_pages),
+                "v_pages": pack(self.v_pages)}
+
+    @classmethod
+    def from_payload(cls, payload):
+        """Inverse of :meth:`to_payload`; raises ValueError on a
+        malformed body (the HTTP side maps it to 400)."""
+        def unpack(obj):
+            if not isinstance(obj, dict):
+                raise ValueError("page block must be {dtype, shape, data}")
+            raw = base64.b64decode(obj["data"])
+            a = np.frombuffer(raw, dtype=np.dtype(obj["dtype"]))
+            return a.reshape([int(d) for d in obj["shape"]]).copy()
+        try:
+            return cls(payload["prompt"], payload["first_token"],
+                       payload.get("first_logprob"),
+                       payload.get("temperature", 0.0),
+                       payload.get("seed", 0),
+                       payload.get("max_new_tokens", 16),
+                       payload["page_tokens"], payload["num_layers"],
+                       payload["num_heads"], payload["head_dim"],
+                       unpack(payload["k_pages"]),
+                       unpack(payload["v_pages"]))
+        except (KeyError, TypeError) as e:
+            raise ValueError("malformed handoff payload: %r" % (e,))
+
+
+class PrefillEngine(object):
+    """The prefill-class replica's engine: prompt pass + first-token
+    sample + page export, nothing else — no decode loop, no continuous
+    batching, no long-lived page residency. Synchronous by design: a
+    prefill is one compiled call, and the HTTP server's thread-per-
+    connection model already provides the concurrency.
+
+    Shares the :class:`GenerationEngine` compile discipline: the fused
+    prefill face (prefill + seeded device sampling in one jit) compiles
+    once per prompt-length bucket; geometry (``page_tokens``, KV spec)
+    must match the decode tier's pools or ``submit_prefilled`` rejects
+    the artifact. ``kv_pages`` only needs to cover the LARGEST single
+    prompt (pages are freed as soon as the artifact is exported), not a
+    running set — the memory asymmetry that makes the tier split pay.
+    """
+
+    def __init__(self, model, kv_pages=None, page_tokens=None,
+                 name="model", eos_id=None, device_sample=None):
+        import jax
+        from ..flags import FLAGS
+        self.model = model
+        self.name = name
+        cfg = model.config
+        self.eos_id = cfg.eos_id if eos_id is None else int(eos_id)
+        self.max_context = int(cfg.max_seq)
+        page_tokens = int(page_tokens if page_tokens is not None
+                          else FLAGS.serve_page_tokens)
+        if kv_pages is None:
+            # enough for one max-length prompt: the working set is one
+            # request deep (pages free at export), so the flag default
+            # for a decode pool would be pure waste here
+            kv_pages = pages_for(self.max_context, page_tokens)
+        L, nh, dh = model.kv_spec
+        self.pool = PagePool(int(kv_pages), page_tokens, L, nh, dh)
+        self._kp, self._vp = self.pool.zeros()
+        self.max_blocks = pages_for(self.max_context, page_tokens)
+        self._buckets = padding_buckets(self.max_context)
+        self._prefill = jax.jit(model.prefill_fn(), donate_argnums=(1, 2))
+        if device_sample is None:
+            device_sample = bool(FLAGS.serve_device_sample)
+        self.device_sample = False
+        self._prefill_s = None
+        if device_sample:
+            # same fused face as the decode tier's engine — the first
+            # token must come from the SAME position-keyed RNG stream
+            # the decode replica would have used locally, or the hop
+            # would fork tempered outputs
+            self._prefill_s = jax.jit(model.prefill_sample_fn(),
+                                      donate_argnums=(1, 2))
+            self.device_sample = True
+        self._counts = {"prefills": 0, "prompt_tokens": 0,
+                        "exported_pages": 0, "exported_bytes": 0}
+        self._busy_s = 0.0
+        self._closed = False
+
+    def prefill(self, prompt, max_new_tokens=16, temperature=0.0, seed=0):
+        """Run one prompt pass and export it: returns a
+        :class:`HandoffArtifact` ready to :func:`ship`. Pages are
+        allocated for the prompt only, gathered to host right after the
+        compiled call, and freed before returning — this engine's pool
+        occupancy is transient by construction. Raises
+        :class:`PoolExhausted` (admission backpressure) or ValueError
+        on an infeasible prompt, exactly like ``submit``."""
+        import jax.numpy as jnp
+        if self._closed:
+            raise ServingError("prefill engine is closed")
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("prompt must be a non-empty token list")
+        if any(t < 0 or t >= self.model.config.vocab_size for t in prompt):
+            raise ValueError("prompt token out of range [0, %d)"
+                             % self.model.config.vocab_size)
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt) + int(max_new_tokens) > self.max_context:
+            raise ValueError(
+                "prompt (%d) + max_new_tokens (%d) exceeds the model "
+                "context window (%d)" % (len(prompt), max_new_tokens,
+                                         self.max_context))
+        T = self.pool.page_tokens
+        pages = self.pool.alloc(pages_for(len(prompt), T))
+        row = np.full((self.max_blocks,), self.pool.trash_page, np.int32)
+        row[:len(pages)] = pages
+        t0 = time.monotonic()
+        try:
+            S_b = bucket_for(len(prompt), self._buckets)
+            padded = np.zeros((S_b,), np.int32)
+            padded[:len(prompt)] = prompt
+            if self.device_sample:
+                tok_d, logp_d, self._kp, self._vp = self._prefill_s(
+                    self.model.params, self._kp, self._vp,
+                    jnp.asarray(padded), np.int32(len(prompt)),
+                    jnp.asarray(row), np.float32(temperature),
+                    np.int32(int(seed) & 0x7FFFFFFF))
+                tok, logp = int(tok_d), float(logp_d)
+            else:
+                last, self._kp, self._vp = self._prefill(
+                    self.model.params, self._kp, self._vp,
+                    jnp.asarray(padded), np.int32(len(prompt)),
+                    jnp.asarray(row))
+                from .generator import sample_token
+                rng = np.random.RandomState(int(seed) & 0x7FFFFFFF)
+                tok = sample_token(np.asarray(last), temperature, rng)
+                logp = None
+            # gather JUST the written pages to host — the export copy a
+            # TPU backend would replace with a device-to-device DMA
+            ids = jnp.asarray(np.asarray(pages, np.int32))
+            k = np.asarray(self._kp[:, ids])
+            v = np.asarray(self._vp[:, ids])
+        finally:
+            self._busy_s += time.monotonic() - t0
+            self.pool.free(pages)
+        self._counts["prefills"] += 1
+        self._counts["prompt_tokens"] += len(prompt)
+        self._counts["exported_pages"] += len(pages)
+        art = HandoffArtifact(
+            prompt, tok, logp, temperature, seed, max_new_tokens,
+            T, self.pool.num_layers, self.pool.num_heads,
+            self.pool.head_dim, k, v)
+        self._counts["exported_bytes"] += art.kv_bytes
+        return art
+
+    @property
+    def stats(self):
+        return dict(self._counts, busy_s=round(self._busy_s, 4),
+                    kv_pages=self.pool.num_pages,
+                    page_tokens=self.pool.page_tokens)
+
+    def close(self):
+        self._closed = True
+
+
+def ship(artifact, decode_engine, deadline_ms=None):
+    """Deliver one handoff into a decode-class engine — the inter-tier
+    hop, fault site ``serving.ship``. Returns the decode engine's
+    request handle (``.wait()`` for the GenResult).
+
+    Failure semantics (the tier split's whole safety story):
+
+    - A hop failure — the armed fault, a geometry mismatch from a
+      version-split fleet, the install face dying — re-submits the
+      ORIGINAL prompt to the decode engine, which re-prefills locally:
+      slower (the prefill ran twice), bit-identical (same seed, same
+      position-keyed stream), never lost. Recorded ``handoff_failed``.
+    - Overload/pool-exhaustion raised by the decode engine's admission
+      are honest backpressure, NOT hop failures: they propagate to the
+      caller (whose retry/backoff machinery owns them) — re-prefilling
+      into a full pool would just burn a second prefill to hit the
+      same wall.
+    """
+    from .admission import OverloadError
+    from .kvcache import PoolExhausted
+    try:
+        fault_point("serving.ship")
+        return decode_engine.submit_prefilled(artifact,
+                                              deadline_ms=deadline_ms)
+    except (OverloadError, PoolExhausted):
+        raise
+    except BaseException as e:
+        record_event("handoff_failed", site="serving.ship",
+                     model=getattr(decode_engine, "name", "?"),
+                     pages=artifact.pages, error=repr(e))
+        from .. import profiler as _prof
+        _prof.update_generation_counters(gen_handoff_failed=1)
+        return decode_engine.submit(
+            artifact.prompt, max_new_tokens=artifact.max_new_tokens,
+            temperature=artifact.temperature, seed=artifact.seed,
+            deadline_ms=deadline_ms, spec_k=0)

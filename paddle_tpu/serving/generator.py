@@ -54,11 +54,36 @@ lanes cost only a page-table trim (``BlockTable.trim``) — never a cache
 rollback. Fault site ``serving.speculate`` degrades speculation to plain
 fused decode with a ``speculation_degraded`` event.
 
+**Prefix sharing** (``prefix_sharing=`` / ``FLAGS.serve_prefix_sharing``,
+``serving/prefix.py``): prefill pages are content-hashed and published
+to a per-engine cache; a later request whose prompt starts with the
+same chunks PINS the same physical pages (``PagePool.ref``) instead of
+allocating them — admission reserves against *effective* (dedup-aware)
+pages while exhaustion stays priced in *physical* pages, so N
+same-prefix requests admit past the pool's nominal private capacity.
+The first divergent write into a still-shared page (a generated token
+landing in a shared partial tail page) triggers copy-on-write: ONE
+page is allocated and device-copied, the table swaps to it, the shared
+original stays pristine for everyone else. Greedy output is
+bit-identical with sharing on or off (same tokens ⇒ same page bytes ⇒
+same attention reads). Fault site ``serving.prefix`` degrades the
+engine to plain private pages with a recorded ``prefix_degraded``
+event — a memory regression, never an outage.
+
+**Disaggregated decode** (``serving/disagg.py``): ``submit_prefilled``
+accepts a handoff artifact — finished KV page contents + request state
+exported by a prefill-tier engine — and INSTALLS the pages into this
+engine's pool instead of recomputing the prefill; the request then
+decodes here as if it had prefilled locally (same position-keyed RNG
+stream, bit-identical continuation). A failed handoff re-prefills on
+this tier through the normal ``submit`` path (fault site
+``serving.ship``, recorded ``handoff_failed`` — slower, never lost).
+
 Knobs: ``FLAGS.serve_max_running`` / ``serve_kv_pages`` /
 ``serve_page_tokens`` / ``serve_queue_depth`` /
-``serve_device_sample``. Metrics mirror into
-``profiler.generation_counters()`` and the timeline artifact's
-``generation`` section.
+``serve_device_sample`` / ``serve_prefix_sharing``. Metrics mirror
+into ``profiler.generation_counters()`` and the timeline artifact's
+``generation`` + ``prefix`` sections.
 """
 from __future__ import annotations
 
@@ -73,6 +98,7 @@ from .admission import (AdmissionController, DeadlineExceededError,
                         OverloadError, ServingError)
 from .batcher import bucket_for, padding_buckets
 from .kvcache import BlockTable, PagePool, PoolExhausted, pages_for
+from .prefix import PrefixCache
 from .service import _WINDOW, _percentile
 from .speculative import DraftEngine
 # the shared lock constructor: plain threading primitives normally, the
@@ -165,8 +191,8 @@ class GenRequest(object):
 
     __slots__ = ("prompt", "max_new_tokens", "temperature", "seed",
                  "deadline_t", "enqueue_t", "tokens", "logprobs",
-                 "preemptions", "model_version", "spec_k", "_rng",
-                 "_ttft_ms", "_done", "_result", "_error")
+                 "preemptions", "model_version", "spec_k", "handoff",
+                 "_rng", "_ttft_ms", "_done", "_result", "_error")
 
     def __init__(self, prompt, max_new_tokens, temperature=0.0, seed=0,
                  deadline_t=None, spec_k=None):
@@ -179,6 +205,11 @@ class GenRequest(object):
         # stamped by InferenceService.generate_async: the registry
         # version of the engine that took this submit
         self.model_version = None
+        # a disaggregated handoff artifact (serving/disagg.py): the
+        # FIRST _start installs its exported pages instead of
+        # prefilling, then clears this — a later preemption resumes
+        # through the normal recompute-on-resume prefill
+        self.handoff = None
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature or 0.0)
         self.seed = int(seed or 0)
@@ -276,7 +307,7 @@ class GenerationEngine(object):
                  page_tokens=None, queue_depth=None, reserve="full",
                  eos_id=None, name="model", warm=False,
                  device_sample=None, attn_config=None, draft_model=None,
-                 spec_k=None):
+                 spec_k=None, prefix_sharing=None):
         import jax
         from ..flags import FLAGS
         if reserve not in ("full", "prompt"):
@@ -300,6 +331,27 @@ class GenerationEngine(object):
         self.pool = PagePool(kv_pages, page_tokens, L, nh, dh)
         self._kp, self._vp = self.pool.zeros()
         self._check_pool_install("serving.engine_pool_install")
+        # copy-on-write prefix sharing: a per-engine content-addressed
+        # cache over THIS pool; build failure (fault site
+        # serving.prefix) degrades to plain private pages — recorded,
+        # never an outage
+        if prefix_sharing is None:
+            prefix_sharing = bool(FLAGS.serve_prefix_sharing)
+        self._prefix = None
+        self._prefix_degraded = False
+        if prefix_sharing:
+            try:
+                self._prefix = PrefixCache(self.pool, name=name)
+            except BaseException as e:
+                self._prefix_degraded = True
+                record_event("prefix_degraded", site="serving.prefix",
+                             model=name, phase="build", error=repr(e))
+        # lazily-jitted page faces: _cow copies ONE page slice (the
+        # copy-on-write move), _install scatters a handoff artifact's
+        # exported pages into the pool; both donate so the pool is
+        # updated in place, both compile once on first use
+        self._cow = None
+        self._install = None
         if attn_config is None:
             # one dispatch decision per engine: the decode step is
             # compiled ONCE, so the winner-cache consult happens here,
@@ -484,6 +536,17 @@ class GenerationEngine(object):
                 "prompt (%d) + max_new_tokens (%d) exceeds the model "
                 "context window (%d)" % (len(prompt), max_new_tokens,
                                          self.max_context))
+        req = GenRequest(prompt, max_new_tokens, temperature, seed,
+                         AdmissionController.deadline_from(deadline_ms),
+                         spec_k=spec_k)
+        return self._enqueue(req)
+
+    def _enqueue(self, req):
+        """Shared submit tail for :meth:`submit` and
+        :meth:`submit_prefilled`: the pool-feasibility shed (PHYSICAL
+        pages — exhaustion policy never prices sharing in), the
+        liveness/drain/queue-depth checks, and the actual append."""
+        total = len(req.pending_prompt) + req.budget_left
         if not self.pool.can_fit(total):
             record_event("kv_pool_exhausted", site="serving.generate",
                          action="shed", model=self.name,
@@ -499,9 +562,6 @@ class GenerationEngine(object):
                 "instead of wedging the engine"
                 % (total, self.pool.num_pages * self.pool.page_tokens,
                    self.pool.num_pages, self.pool.page_tokens))
-        req = GenRequest(prompt, max_new_tokens, temperature, seed,
-                         AdmissionController.deadline_from(deadline_ms),
-                         spec_k=spec_k)
         with self._cond:
             if not self._alive:
                 raise ServingError("generation engine is closed")
@@ -525,6 +585,60 @@ class GenerationEngine(object):
             self._cond.notify_all()
         self._update_prof(gen_requests=1)
         return req
+
+    def submit_prefilled(self, artifact, deadline_ms=None):
+        """Queue a disaggregated handoff (serving/disagg.py): the
+        artifact carries a prefill-tier engine's finished KV page
+        contents plus the request state that makes the decode
+        continuation bit-exact (first sampled token + logprob,
+        temperature, seed — the position-keyed device RNG stream needs
+        nothing else). ``_start`` INSTALLS the pages instead of
+        recomputing the prefill. Speculation is disabled for handoff
+        requests (``spec_k=0``): the draft pool never saw the prompt,
+        and plain fused decode is bit-identical anyway. Sheds exactly
+        like :meth:`submit` (queue depth, physical feasibility)."""
+        pool = self.pool
+        if (int(artifact.page_tokens) != pool.page_tokens
+                or int(artifact.num_layers) != pool.num_layers
+                or int(artifact.num_heads) != pool.num_heads
+                or int(artifact.head_dim) != pool.head_dim):
+            raise ServingError(
+                "handoff artifact geometry (layers=%s heads=%s "
+                "head_dim=%s page_tokens=%s) does not match this "
+                "engine's pool (layers=%d heads=%d head_dim=%d "
+                "page_tokens=%d) — the tiers must serve the same model "
+                "geometry" % (artifact.num_layers, artifact.num_heads,
+                              artifact.head_dim, artifact.page_tokens,
+                              pool.num_layers, pool.num_heads,
+                              pool.head_dim, pool.page_tokens))
+        prompt = [int(t) for t in artifact.prompt]
+        max_new_tokens = int(artifact.max_new_tokens)
+        if len(prompt) + max_new_tokens > self.max_context:
+            raise ValueError(
+                "handoff prompt (%d) + max_new_tokens (%d) exceeds the "
+                "model context window (%d)"
+                % (len(prompt), max_new_tokens, self.max_context))
+        req = GenRequest(prompt, max_new_tokens,
+                         float(artifact.temperature),
+                         int(artifact.seed),
+                         AdmissionController.deadline_from(deadline_ms),
+                         spec_k=0)
+        req.tokens = [int(artifact.first_token)]
+        if artifact.first_logprob is not None:
+            req.logprobs = [float(artifact.first_logprob)]
+        if (self.eos_id is not None and req.tokens[0] == self.eos_id) \
+                or req.budget_left <= 0:
+            # the prefill tier's one token already finished the request
+            with self._cond:
+                self._counts["submitted"] += 1
+                self._counts["completed"] += 1
+            self._update_prof(gen_requests=1, gen_completed=1)
+            req._ttft_ms = 0.0
+            req.resolve("eos" if req.tokens[0] == self.eos_id
+                        else "length")
+            return req
+        req.handoff = artifact
+        return self._enqueue(req)
 
     def generate(self, prompt, max_new_tokens=16, temperature=0.0, seed=0,
                  deadline_ms=None, timeout=None, spec_k=None):
@@ -606,6 +720,9 @@ class GenerationEngine(object):
         if self._spec is not None:
             self._spec.close()
             self._spec = None
+        if self._prefix is not None:
+            self._prefix.clear()
+            self._prefix = None
 
     def __enter__(self):
         return self
@@ -626,8 +743,18 @@ class GenerationEngine(object):
         return len(req.pending_prompt)
 
     def _reservation(self, req):
-        """Pages admission must see free before ``req`` may start."""
-        return pages_for(self._reserve_tokens(req), self.pool.page_tokens)
+        """Pages admission must see free before ``req`` may start —
+        EFFECTIVE (dedup-aware): leading full prompt pages already in
+        the prefix cache will be pinned, not allocated, so they do not
+        draw on the free list. The partial tail page is never
+        discounted even when cached — copy-on-write buys it back at
+        the first generated token, so counting it would overdraw the
+        pool by one page per request. Exhaustion and the submit-time
+        shed stay priced in PHYSICAL pages (``can_fit``)."""
+        pages = pages_for(self._reserve_tokens(req), self.pool.page_tokens)
+        if req.handoff is None and self._prefix is not None:
+            pages -= self._prefix.probe(req.pending_prompt)
+        return max(pages, 0)
 
     def _admit(self):
         """Move queued requests into free slots while their reservation
@@ -675,8 +802,24 @@ class GenerationEngine(object):
         host — no [V] logits row."""
         import jax.numpy as jnp
         prompt = req.pending_prompt
+        handoff = req.handoff
         table = BlockTable(self.pool)
-        table.ensure(self._reserve_tokens(req))
+        matched = 0
+        if handoff is None and self._prefix is not None:
+            # pin the longest cached page run covering this prompt; a
+            # raise here (fault site serving.prefix) degrades the
+            # engine to private pages and the request just prefills
+            try:
+                shared, _covered = self._prefix.match(prompt)
+                table.pages.extend(shared)
+                matched = len(shared)
+            except BaseException as e:
+                self._degrade_prefix("match", e)
+        try:
+            table.ensure(self._reserve_tokens(req))
+        except PoolExhausted:
+            table.release()   # drops the prefix pins too
+            raise
         if self._spec is not None:
             # the paired draft reservation: admit on BOTH pools or on
             # neither (a PoolExhausted here rides the same requeue path
@@ -687,27 +830,35 @@ class GenerationEngine(object):
                 self._spec.release_slot(slot)
                 table.release()
                 raise
+        if matched:
+            with self._cond:
+                self._counts["prefix_hits"] += matched
+                self._counts["prefix_hit_requests"] += 1
+            self._update_prof(gen_prefix_hits=matched)
         t0 = time.monotonic()
         tok = logp = logits = None
         try:
             fault_point("serving.generate")
-            S_b = bucket_for(len(prompt), self._buckets)
-            padded = np.zeros((S_b,), np.int32)
-            padded[:len(prompt)] = prompt
-            if self.device_sample:
-                tok_d, logp_d, self._kp, self._vp = self._prefill_s(
-                    self.model.params, self._kp, self._vp,
-                    jnp.asarray(padded), np.int32(len(prompt)),
-                    jnp.asarray(table.as_row(self.max_blocks)),
-                    np.float32(req.temperature),
-                    np.int32(req.seed & 0x7FFFFFFF))
-                tok, logp = int(tok_d), float(logp_d)
+            if handoff is not None:
+                self._install_handoff(table, handoff)
             else:
-                last, self._kp, self._vp = self._prefill(
-                    self.model.params, self._kp, self._vp,
-                    jnp.asarray(padded), np.int32(len(prompt)),
-                    jnp.asarray(table.as_row(self.max_blocks)))
-                logits = np.asarray(last)
+                S_b = bucket_for(len(prompt), self._buckets)
+                padded = np.zeros((S_b,), np.int32)
+                padded[:len(prompt)] = prompt
+                if self.device_sample:
+                    tok_d, logp_d, self._kp, self._vp = self._prefill_s(
+                        self.model.params, self._kp, self._vp,
+                        jnp.asarray(padded), np.int32(len(prompt)),
+                        jnp.asarray(table.as_row(self.max_blocks)),
+                        np.float32(req.temperature),
+                        np.int32(req.seed & 0x7FFFFFFF))
+                    tok, logp = int(tok_d), float(logp_d)
+                else:
+                    last, self._kp, self._vp = self._prefill(
+                        self.model.params, self._kp, self._vp,
+                        jnp.asarray(padded), np.int32(len(prompt)),
+                        jnp.asarray(table.as_row(self.max_blocks)))
+                    logits = np.asarray(last)
         except BaseException as e:
             table.release()
             if self._spec is not None:
@@ -727,15 +878,29 @@ class GenerationEngine(object):
                     "kv pool arrays lost to a failed prefill: %r" % (e,)))
             return
         self._busy_s += time.monotonic() - t0
-        if self._spec is not None:
+        if handoff is None and self._spec is not None:
             # the draft mirrors the prompt into ITS pool; a failure here
             # (fault site serving.speculate) degrades speculation engine
             # wide — the target's prefill already succeeded, so the
-            # request keeps running plain
+            # request keeps running plain. (Handoff requests skip the
+            # mirror: they run spec_k=0, so their draft lanes never
+            # propose and the draft cache never needs their prompt.)
             try:
                 self._spec.prefill(slot, padded, len(prompt))
             except BaseException as e:
                 self._degrade_spec("prefill", e)
+        if handoff is None and self._prefix is not None:
+            # publish the freshly written prompt pages (full AND the
+            # partial tail) so the next same-prefix request pins them
+            try:
+                published = self._prefix.publish(prompt, table.pages)
+            except BaseException as e:
+                self._degrade_prefix("publish", e)
+            else:
+                if published:
+                    with self._cond:
+                        self._counts["prefix_published"] += published
+                    self._update_prof(gen_prefix_published=published)
         run = _Running(req, slot, table)
         run.cached = len(prompt)
         # A preemption resume on a SPECULATIVE engine discards the
@@ -747,20 +912,36 @@ class GenerationEngine(object):
         # round boundaries re-derive identically (caps are pure
         # functions of (request, progress)) and the next round replays
         # the exact accept/reject draws.
-        resumed_spec = self._spec is not None and len(req.tokens) > 0
-        if resumed_spec:
+        resumed_spec = (handoff is None and self._spec is not None
+                        and len(req.tokens) > 0)
+        if handoff is not None:
+            # the artifact's pages cover the ORIGINAL prompt; pending
+            # already carries the prefill tier's first token, so the
+            # next decode step writes that token's K/V at position
+            # len(prompt) - 1 and the RNG stream continues exactly
+            # where a local prefill would have left it
+            run.cached = len(prompt) - len(req.tokens)
+            run.last_token = req.tokens[-1]
+            req.handoff = None   # a preemption resumes by re-prefill
+        elif resumed_spec:
             run.cached = len(prompt) - 1
             run.last_token = req.tokens[-1]
         with self._cond:
-            self._counts["prefills"] += 1
-            self._counts["prompt_tokens"] += len(prompt)
-            if not resumed_spec:
+            if handoff is not None:
+                self._counts["handoff_installs"] += 1
+            else:
+                self._counts["prefills"] += 1
+                self._counts["prompt_tokens"] += len(prompt)
+            if handoff is None and not resumed_spec:
                 self._counts["tokens"] += 1   # the prefill's first token
             self._seqs.append(run)
             self._seqs.sort(key=lambda s: s.slot)
             self._max_running_seen = max(self._max_running_seen,
                                          len(self._seqs))
-        if resumed_spec:
+        if handoff is not None:
+            self._update_prof(gen_handoff_installs=1,
+                              gen_max_running=len(self._seqs))
+        elif resumed_spec:
             self._update_prof(gen_prefills=1,
                               gen_max_running=len(self._seqs))
         elif self.device_sample:
@@ -884,6 +1065,10 @@ class GenerationEngine(object):
             try:
                 s.table.ensure(s.cached + cap + 1)
                 self._spec.ensure_slot(s.slot, s.cached + cap + 1)
+                # the verify step rewrites position s.cached and writes
+                # up to cap+1 new ones — unshare every covering page
+                self._unshare_for_write(s.table, s.cached,
+                                        s.cached + cap + 1)
             except PoolExhausted:
                 if len(self._seqs) > 1 and \
                         s.req.preemptions < _PREEMPT_LIMIT:
@@ -1018,6 +1203,103 @@ class GenerationEngine(object):
                      model=self.name, phase=phase, error=repr(exc))
         self._update_prof(gen_spec_degraded=1)
 
+    def _degrade_prefix(self, phase, exc):
+        """Prefix sharing failed (fault site ``serving.prefix``): drop
+        the cache and keep serving plain private pages — a
+        memory-economics regression, never an outage. Running tables
+        that already share pages between THEMSELVES keep them (the
+        copy-on-write check in ``_unshare_for_write`` runs regardless
+        of the cache, so shared history stays safe to the end)."""
+        cache = self._prefix
+        if cache is None:
+            return
+        self._prefix = None
+        self._prefix_degraded = True
+        try:
+            cache.clear()
+        except Exception:
+            pass
+        record_event("prefix_degraded", site="serving.prefix",
+                     model=self.name, phase=phase, error=repr(exc))
+        self._update_prof(gen_prefix_degraded=1)
+
+    def _unshare_for_write(self, table, start, upto):
+        """Copy-on-write: before the step writes positions
+        ``[start, upto)``, any covering page that is still SHARED
+        (another table or the prefix cache pins it) is replaced by a
+        fresh device copy — ONE page allocated and copied
+        (``kp.at[:, new].set(kp[:, old])`` under donation), the shared
+        original stays pristine for everyone else. May raise
+        :class:`PoolExhausted` mid-walk (the caller's preempt/shed
+        machinery decides); pages already copied stay consistently
+        private, so a later resume is unaffected."""
+        T = self.pool.page_tokens
+        last = min((upto - 1) // T + 1, len(table.pages))
+        copies = 0
+        for i in range(start // T, last):
+            old = table.pages[i]
+            if self.pool.refcount(old) <= 1:
+                continue
+            new = self.pool.alloc(1)[0]
+            if self._cow is None:
+                import jax
+
+                def _cow_fn(kp, vp, src, dst):
+                    return (kp.at[:, dst].set(kp[:, src]),
+                            vp.at[:, dst].set(vp[:, src]))
+                self._cow = jax.jit(_cow_fn, donate_argnums=(0, 1))
+            self._kp, self._vp = self._cow(self._kp, self._vp,
+                                           np.int32(old), np.int32(new))
+            table.pages[i] = new
+            self.pool.free([old])
+            copies += 1
+        if copies:
+            with self._cond:
+                self._counts["cow_copies"] += copies
+            self._update_prof(gen_cow_copies=copies)
+
+    def _install_handoff(self, table, artifact):
+        """The decode tier's receive side of the disaggregated hop
+        (serving/disagg.py): scatter the artifact's exported K/V page
+        contents into this pool at the table's freshly allocated ids.
+        Fixed-shape — ids trash-padded to ``max_blocks``, contents
+        zero-padded — so the face compiles once. On CPU this is a
+        host->device copy of the whole padded block; a real TPU
+        deployment would DMA the pages directly (doc/serving.md spells
+        out the honest caveat)."""
+        import jax
+        import jax.numpy as jnp
+        k, v = artifact.k_pages, artifact.v_pages
+        pool = self.pool
+        n = int(k.shape[1])
+        expect = (pool.num_layers, n, pool.page_tokens, pool.num_heads,
+                  pool.head_dim)
+        if tuple(k.shape) != expect or tuple(v.shape) != expect:
+            raise ServingError(
+                "handoff page content shape %r/%r does not match the "
+                "pool layout %r" % (tuple(k.shape), tuple(v.shape),
+                                    expect))
+        if n > len(table.pages):
+            raise ServingError(
+                "handoff carries %d page(s) but the table only holds "
+                "%d" % (n, len(table.pages)))
+        if self._install is None:
+            def _install_fn(kp, vp, ids, kc, vc):
+                return kp.at[:, ids].set(kc), vp.at[:, ids].set(vc)
+            self._install = jax.jit(_install_fn, donate_argnums=(0, 1))
+        MB = self.max_blocks
+        ids = np.full((MB,), pool.trash_page, np.int32)
+        ids[:n] = table.pages[:n]
+        shape = (pool.num_layers, MB, pool.page_tokens, pool.num_heads,
+                 pool.head_dim)
+        kc = np.zeros(shape, np.asarray(k).dtype)
+        vc = np.zeros(shape, kc.dtype)
+        kc[:, :n] = k
+        vc[:, :n] = v
+        self._kp, self._vp = self._install(
+            self._kp, self._vp, jnp.asarray(ids), jnp.asarray(kc),
+            jnp.asarray(vc))
+
     def _ensure_pools(self):
         """A raise from INSIDE a donated jitted call (device OOM,
         XlaRuntimeError) consumes the pool arrays before it surfaces —
@@ -1031,6 +1313,10 @@ class GenerationEngine(object):
         if deleted is None or not deleted():
             return False
         self._kp, self._vp = self.pool.zeros()
+        if self._prefix is not None:
+            # cached prefix contents died with the arrays — a stale
+            # entry would splice zero pages into someone's prompt
+            self._prefix.reset()
         self._check_pool_install("serving.engine_pool_rebuild")
         return True
 
@@ -1044,11 +1330,13 @@ class GenerationEngine(object):
         check_donated({"k_pages": self._kp, "v_pages": self._vp}, entry)
 
     def _grow_tables(self):
-        """Make room for each running row's next position; starvation
-        preempts (or sheds, when preemption cannot help)."""
+        """Make room for each running row's next position — and
+        copy-on-write any still-shared page the write would land in;
+        starvation preempts (or sheds, when preemption cannot help)."""
         for s in list(self._seqs):
             try:
                 s.table.ensure(s.cached + 1)
+                self._unshare_for_write(s.table, s.cached, s.cached + 1)
             except PoolExhausted:
                 if len(self._seqs) > 1 and \
                         s.req.preemptions < _PREEMPT_LIMIT:
@@ -1229,6 +1517,16 @@ class GenerationEngine(object):
                 "host_logit_syncs": c.get("host_logit_syncs", 0),
                 "attn_kernel": bool(self.attn_config),
                 "kernel_hits": c.get("kernel_hits", 0),
+                "prefix_sharing": self._prefix is not None,
+                "prefix_degraded": self._prefix_degraded,
+                "prefix_hits": c.get("prefix_hits", 0),
+                "prefix_hit_requests": c.get("prefix_hit_requests", 0),
+                "prefix_published": c.get("prefix_published", 0),
+                "cow_copies": c.get("cow_copies", 0),
+                "prefix_cache": (self._prefix.stats()
+                                 if self._prefix is not None else None),
+                "handoff_installs": c.get("handoff_installs", 0),
+                "page_release_rate": self.pool.release_rate(),
                 "speculative": self._spec is not None,
                 "spec_k": self.spec_k,
                 "spec_degraded": self._spec_degraded,

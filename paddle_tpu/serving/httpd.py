@@ -20,6 +20,18 @@ POST   ``/v1/models/<name>:generate``   ``{"tokens": [ids],
                                         ``{"tokens": [...],
                                         "finish_reason": ...,
                                         "ttft_ms": ..., ...}``
+POST   ``/v1/models/<name>:prefill``    ``{"tokens": [ids],
+                                        "max_new_tokens": N,
+                                        "temperature": t, "seed": s}`` ->
+                                        handoff-artifact wire payload
+                                        (prefill-tier half of the
+                                        disaggregated hop)
+POST   ``/v1/models/<name>:decode``     ``{"artifact": payload,
+                                        "deadline_ms": optional}`` ->
+                                        GenResult fields (decode-tier
+                                        half; a bad artifact
+                                        re-prefills here — the
+                                        ``serving.ship`` fallback)
 POST   ``/v1/models/<name>:reload``     ``{"dirname": path}`` -> new
                                         version, or 409 + rollback info
 GET    ``/v1/models``                   registry listing (both kinds)
@@ -130,6 +142,7 @@ class _Handler(BaseHTTPRequestHandler):
             # existing callers keep working); "ready" adds the per-model
             # readiness detail the router weights and drains on
             self._reply(200, {"ok": True,
+                              "tier": getattr(self.service, "tier", ""),
                               "models": self.service.model_info(),
                               "ready": self.service.readiness()})
         elif self.path == "/statz":
@@ -158,6 +171,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self.path.endswith(":generate"):
             name = self.path[len("/v1/models/"):-len(":generate")]
             return self._generate(name, body)
+        if self.path.startswith("/v1/models/") and \
+                self.path.endswith(":prefill"):
+            name = self.path[len("/v1/models/"):-len(":prefill")]
+            return self._prefill(name, body)
+        if self.path.startswith("/v1/models/") and \
+                self.path.endswith(":decode"):
+            name = self.path[len("/v1/models/"):-len(":decode")]
+            return self._decode(name, body)
         if self.path.startswith("/v1/models/") and \
                 self.path.endswith(":reload"):
             name = self.path[len("/v1/models/"):-len(":reload")]
@@ -227,6 +248,77 @@ class _Handler(BaseHTTPRequestHandler):
                 seed=int(body.get("seed", 0)),
                 deadline_ms=body.get("deadline_ms"),
                 spec_k=None if spec_k is None else int(spec_k))
+            res = req.wait()
+        except ModelUnavailableError as e:
+            return self._reply(404, {"error": str(e),
+                                     "kind": "model_unavailable"})
+        except PoolExhausted as e:
+            return self._reply(429, {"error": str(e),
+                                     "kind": "kv_pool_exhausted"},
+                               retry_after_ms=self._retry_hint(name))
+        except OverloadError as e:
+            return self._reply(429, {"error": str(e), "kind": "overload"},
+                               retry_after_ms=self._retry_hint(name))
+        except DeadlineExceededError as e:
+            return self._reply(504, {"error": str(e), "kind": "deadline"})
+        except (TypeError, ValueError) as e:
+            return self._reply(400, {"error": str(e),
+                                     "kind": "bad_request"})
+        except Exception as e:
+            return self._reply(500, {"error": repr(e), "kind": "dispatch"})
+        out = {"model": name, "version": req.model_version}
+        out.update(res.describe())
+        self._reply(200, out)
+
+    def _prefill(self, name, body):
+        """Prefill-tier half of the disaggregated hop: run ONLY the
+        prompt pass and answer with the handoff artifact's wire payload
+        (base64 KV pages + request state) for the router to ship to a
+        decode-class replica. Same error mapping as :generate — the
+        prefill pool exhausting on an over-long prompt is backpressure
+        too."""
+        from .kvcache import PoolExhausted
+        try:
+            tokens = body.get("tokens")
+            if not isinstance(tokens, list) or not tokens:
+                raise ValueError('body must carry {"tokens": '
+                                 "[token ids]}")
+            art = self.service.prefill(
+                name, tokens,
+                max_new_tokens=int(body.get("max_new_tokens", 16)),
+                temperature=float(body.get("temperature", 0.0)),
+                seed=int(body.get("seed", 0)))
+        except ModelUnavailableError as e:
+            return self._reply(404, {"error": str(e),
+                                     "kind": "model_unavailable"})
+        except PoolExhausted as e:
+            return self._reply(429, {"error": str(e),
+                                     "kind": "kv_pool_exhausted"},
+                               retry_after_ms=self._retry_hint(name))
+        except OverloadError as e:
+            return self._reply(429, {"error": str(e), "kind": "overload"},
+                               retry_after_ms=self._retry_hint(name))
+        except (TypeError, ValueError) as e:
+            return self._reply(400, {"error": str(e),
+                                     "kind": "bad_request"})
+        except Exception as e:
+            return self._reply(500, {"error": repr(e), "kind": "dispatch"})
+        self._reply(200, {"model": name, "artifact": art.to_payload()})
+
+    def _decode(self, name, body):
+        """Decode-tier half: install a shipped artifact into ``name``'s
+        engine and decode to completion. A malformed artifact is the
+        SENDER's fault (400); an install failure re-prefills here via
+        the ``serving.ship`` fallback and still answers 200 — slower,
+        never lost."""
+        from .kvcache import PoolExhausted
+        try:
+            payload = body.get("artifact")
+            if not isinstance(payload, dict):
+                raise ValueError('body must carry {"artifact": '
+                                 "handoff payload}")
+            req = self.service.decode_handoff_async(
+                name, payload, deadline_ms=body.get("deadline_ms"))
             res = req.wait()
         except ModelUnavailableError as e:
             return self._reply(404, {"error": str(e),

@@ -25,12 +25,28 @@ engine turns that into the house degrade-and-record convention — a shed
 or a preemption with a recorded ``kv_pool_exhausted`` event. The pool
 itself never kills anything.
 
+**Sharing** (copy-on-write prefix reuse, ``serving/prefix.py``): every
+live page carries a REFCOUNT. ``alloc`` hands out pages at refcount 1;
+``ref`` lets another holder (a second BlockTable pinning the same
+prompt prefix, or the prefix cache itself) pin the same physical page;
+``free`` decrements and returns the page to the free list only at zero.
+Accounting therefore splits in two: *physical* pages (what the device
+actually holds — the exhaustion policy's unit) and *effective* pages
+(sum of refcounts — what the same traffic would cost without sharing).
+The pool stays write-dumb: deciding when a shared page must be copied
+before a divergent write (CoW) is the engine's job; the pool only
+answers ``refcount``/``is_shared``. An optional ``reclaimer`` hook lets
+the prefix cache's LRU give unreferenced-but-cached pages back under
+allocation pressure before ``alloc`` declares exhaustion.
+
 Knobs: ``FLAGS.serve_kv_pages`` (usable pages in the pool) and
 ``FLAGS.serve_page_tokens`` (positions per page).
 """
 from __future__ import annotations
 
+import collections
 import threading
+import time
 
 from .admission import ServingError
 # the shared lock constructor: plain threading primitives normally, the
@@ -78,7 +94,12 @@ class PagePool(object):
         # (tests and replays see the same page ids for the same history)
         self._free = list(range(self.num_pages))
         self._live = set()
+        self._refs = {}            # live page id -> refcount (>= 1)
         self._max_live = 0
+        self._reclaim = None       # see set_reclaimer
+        # rolling log of (monotonic t, pages physically released) — the
+        # observed page-release rate that prices a 429 Retry-After hint
+        self._release_log = collections.deque(maxlen=256)
 
     # -- device arrays -------------------------------------------------------
     @property
@@ -95,27 +116,77 @@ class PagePool(object):
         return jnp.zeros(shape, self.dtype), jnp.zeros(shape, self.dtype)
 
     # -- allocator -----------------------------------------------------------
-    def alloc(self, n):
-        """Take ``n`` pages; raises :class:`PoolExhausted` (allocating
-        nothing) when fewer are free."""
-        n = int(n)
+    def set_reclaimer(self, fn):
+        """Install (or clear, with None) the allocation-pressure hook:
+        ``fn(n_short) -> pages_freed`` is called OUTSIDE the pool lock
+        when ``alloc`` comes up ``n_short`` pages short, and should
+        release cold cached pages back (via the normal :meth:`free`
+        path). The prefix cache's LRU registers here so warm-but-unused
+        prefix pages yield to live traffic before exhaustion fires."""
         with self._lock:
-            if n > len(self._free):
-                raise PoolExhausted(
-                    "kv page pool exhausted: want %d page(s), %d of %d "
-                    "free" % (n, len(self._free), self.num_pages))
-            pages = self._free[:n]
-            del self._free[:n]
-            self._live.update(pages)
-            self._max_live = max(self._max_live, len(self._live))
-            return pages
+            self._reclaim = fn
+
+    def alloc(self, n):
+        """Take ``n`` pages at refcount 1; raises :class:`PoolExhausted`
+        (allocating nothing) when fewer are free — after giving the
+        registered reclaimer one chance to evict cold cached pages."""
+        n = int(n)
+        for attempt in (0, 1):
+            with self._lock:
+                if n <= len(self._free):
+                    pages = self._free[:n]
+                    del self._free[:n]
+                    self._live.update(pages)
+                    for p in pages:
+                        self._refs[p] = 1
+                    self._max_live = max(self._max_live, len(self._live))
+                    return pages
+                short = n - len(self._free)
+                reclaim = self._reclaim
+            if attempt or reclaim is None:
+                break
+            # outside the lock: the reclaimer frees through the normal
+            # free() path (which re-takes it) — same lock order as any
+            # other holder, no inversion
+            if not reclaim(short):
+                break
+        raise PoolExhausted(
+            "kv page pool exhausted: want %d page(s), %d of %d "
+            "free" % (n, self.available, self.num_pages))
+
+    def ref(self, pages):
+        """Pin additional references on already-live pages (a second
+        BlockTable sharing a prefix, or the prefix cache itself).
+        Foreign/free ids raise — pinning a page nobody owns would
+        resurrect garbage as shared state."""
+        pages = list(pages)
+        with self._lock:
+            bad = [p for p in pages if p not in self._live]
+            if bad:
+                raise ValueError("ref on pages %s that are not live "
+                                 "(free or foreign id)" % bad)
+            for p in pages:
+                self._refs[p] += 1
+
+    def refcount(self, page):
+        """Current refcount of ``page`` (0 when free/foreign)."""
+        with self._lock:
+            return self._refs.get(page, 0)
+
+    def is_shared(self, page):
+        """True when more than one holder pins ``page`` — the engine's
+        copy-on-write test before a divergent write."""
+        with self._lock:
+            return self._refs.get(page, 0) > 1
 
     def free(self, pages):
-        """Return pages to the pool. Double-free and foreign ids raise —
-        including a duplicate id WITHIN one call, which would enter the
-        free list twice and hand the same page to two sequences —
-        aliasing a live page corrupts another sequence's cache, so the
-        accounting must be loud, not forgiving."""
+        """Drop one reference per page; a page returns to the free list
+        only when its refcount reaches zero. Double-free and foreign ids
+        raise — including a duplicate id WITHIN one call (one HOLDER
+        never legitimately frees the same page twice in one release;
+        counting it twice would silently eat another holder's
+        reference) — aliasing a live page corrupts another sequence's
+        cache, so the accounting must be loud, not forgiving."""
         pages = list(pages)
         with self._lock:
             seen = set()
@@ -128,10 +199,29 @@ class PagePool(object):
                 raise ValueError("freeing pages %s that are not live "
                                  "(double free, duplicate, or foreign "
                                  "id)" % bad)
+            released = 0
             for p in pages:
-                self._live.discard(p)
-                self._free.append(p)
-            self._free.sort()
+                self._refs[p] -= 1
+                if self._refs[p] == 0:
+                    del self._refs[p]
+                    self._live.discard(p)
+                    self._free.append(p)
+                    released += 1
+            if released:
+                self._free.sort()
+                self._release_log.append((time.monotonic(), released))
+
+    def release_rate(self, window_s=30.0):
+        """Observed physical page-release rate (pages/s) over the last
+        ``window_s`` seconds — what a 429's Retry-After hint divides
+        by: 'you want W pages; at R pages/s that is W/R seconds'."""
+        cutoff = time.monotonic() - float(window_s)
+        with self._lock:
+            events = [(t, n) for t, n in self._release_log if t >= cutoff]
+        if not events:
+            return 0.0
+        span = max(time.monotonic() - events[0][0], 1e-3)
+        return sum(n for _, n in events) / span
 
     # -- accounting ----------------------------------------------------------
     @property
@@ -149,13 +239,28 @@ class PagePool(object):
         held (feasibility — submit-time shed test)."""
         return pages_for(tokens, self.page_tokens) <= self.num_pages
 
+    @property
+    def effective(self):
+        """Sum of refcounts — pages this traffic would hold WITHOUT
+        sharing. ``effective / live`` is the dedup ratio."""
+        with self._lock:
+            return sum(self._refs.values())
+
     def utilization(self):
-        """{live, free, num_pages, max_live, frac} snapshot."""
+        """{live, free, num_pages, max_live, frac, effective,
+        shared_pages, dedup_ratio} snapshot — ``frac`` stays PHYSICAL
+        (the exhaustion/autoscale signal); ``effective`` and
+        ``dedup_ratio`` are the sharing win."""
         with self._lock:
             live = len(self._live)
+            effective = sum(self._refs.values())
+            shared = sum(1 for c in self._refs.values() if c > 1)
             return {"live": live, "free": len(self._free),
                     "num_pages": self.num_pages, "max_live": self._max_live,
-                    "frac": live / float(self.num_pages)}
+                    "frac": live / float(self.num_pages),
+                    "effective": effective, "shared_pages": shared,
+                    "dedup_ratio": (effective / float(live)
+                                    if live else 1.0)}
 
 
 class BlockTable(object):

@@ -157,14 +157,19 @@ class ReplicaPool(object):
     ``--extra_model name=dir``, ...); ``env_overrides`` maps replica
     index -> extra env vars for THAT worker (how the load harness arms
     a fault spec in exactly one replica — including a slot the
-    autoscaler will only grow into later). Ports are always ``--port
-    0`` — each worker binds a free one and reports it on the readiness
-    line.
+    autoscaler will only grow into later); ``serve_args_overrides``
+    maps replica index -> extra argv appended after ``serve_args`` for
+    THAT worker — how a disaggregated fleet gives each slot its tier
+    (``--tier prefill`` / ``--tier decode``) while sharing the rest of
+    the deployment config. Overrides stick to the SLOT: a crash-restart
+    respawns with the same tier. Ports are always ``--port 0`` — each
+    worker binds a free one and reports it on the readiness line.
     """
 
     def __init__(self, artifact_dir, n, name="default", host="127.0.0.1",
                  serve_args=None, env=None, env_overrides=None,
-                 restart_budget=None, grace_sec=5.0, ready_timeout=180.0,
+                 serve_args_overrides=None, restart_budget=None,
+                 grace_sec=5.0, ready_timeout=180.0,
                  budget_reset_s=60.0, python=None):
         from ..flags import FLAGS
         if n < 1:
@@ -175,6 +180,8 @@ class ReplicaPool(object):
         self.host = host
         self.serve_args = list(serve_args or [])
         self.env_overrides = dict(env_overrides or {})
+        self.serve_args_overrides = {
+            int(i): list(v) for i, v in (serve_args_overrides or {}).items()}
         self.restart_budget = int(
             restart_budget if restart_budget is not None
             else FLAGS.route_restart_budget)
@@ -228,7 +235,8 @@ class ReplicaPool(object):
     def _spawn(self, index, generation):
         argv = [self.python, "-m", "paddle_tpu", "serve",
                 self.artifact_dir, "--name", self.name,
-                "--host", self.host, "--port", "0"] + self.serve_args
+                "--host", self.host, "--port", "0"] + self.serve_args \
+            + self.serve_args_overrides.get(index, [])
         env = dict(self.base_env)
         env.update(self.env_overrides.get(index, {}))
         proc = subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
@@ -357,7 +365,7 @@ class ReplicaPool(object):
                 self._sup.note_stable(rep.index)
 
     # -- elastic membership --------------------------------------------------
-    def grow(self):
+    def grow(self, extra_args=None):
         """Add one slot to the fleet (the autoscaler's scale-up):
         recycle the lowest retired (cleanly shrunk, not lost) slot if
         one exists — an oscillating up/down/up fleet must not grow the
@@ -366,8 +374,11 @@ class ReplicaPool(object):
         state keyed on the old one resets) with a clean restart
         record, supervised exactly like the original fleet. Does NOT
         wait for readiness — the caller watches the returned
-        :class:`Replica` (the autoscaler's warm-up window). Returns
-        the new replica."""
+        :class:`Replica` (the autoscaler's warm-up window).
+        ``extra_args`` (a tiered autoscaler's ``--tier <class>``)
+        becomes the slot's ``serve_args_overrides`` entry — sticky
+        across crash-restarts, REPLACING whatever a previously retired
+        occupant of a recycled slot had. Returns the new replica."""
         from .. import profiler as _prof
         with self.membership_lock:
             with self._lock:
@@ -389,6 +400,14 @@ class ReplicaPool(object):
                     self._retired[index] = False
                     self._sup.note_stable(index)
                     generation = self._sup.bump_generation(index)
+                prev_override = self.serve_args_overrides.get(index)
+                if extra_args is not None:
+                    self.serve_args_overrides[index] = list(extra_args)
+                elif not appended:
+                    # recycled slot, no explicit args: drop the retired
+                    # occupant's override rather than resurrecting a
+                    # tier nobody asked for
+                    self.serve_args_overrides.pop(index, None)
                 try:
                     rep = self._spawn(index, generation)
                 except Exception:
@@ -401,6 +420,10 @@ class ReplicaPool(object):
                         self.n = len(self._replicas)
                     else:
                         self._retired[index] = True
+                    if prev_override is None:
+                        self.serve_args_overrides.pop(index, None)
+                    else:
+                        self.serve_args_overrides[index] = prev_override
                     raise
                 self._replicas[index] = rep
                 active = self._active_count_locked()
@@ -569,7 +592,7 @@ class StaticPool(object):
     def kill(self, index, signum=None):
         raise RuntimeError("StaticPool does not own its workers")
 
-    def grow(self):
+    def grow(self, extra_args=None):
         raise RuntimeError("StaticPool does not own its membership")
 
     def shrink(self, index, grace_sec=None):

@@ -1,0 +1,216 @@
+"""Copy-on-write prefix sharing over the paged KV pool.
+
+Millions of requests open with the same system prompt, yet the paged
+engine (serving/generator.py) pays full-price KV pages for every one of
+them. This module is the dedup layer: after a prefill writes a prompt
+into its pages, each page's *content* — the exact token chunk it holds,
+chained to everything before it — is hashed and published here; the
+next request whose prompt starts with the same chunks PINS those same
+physical pages into its own BlockTable (``PagePool.ref``) instead of
+allocating and recomputing… the pool's refcounts make N concurrent
+tables share one physical prefix safely.
+
+**The chain key.** Page *i* of a prompt covers token chunk
+``[i*T, min(L, (i+1)*T))`` (T = ``serve_page_tokens``). Its key is
+``blake2b(key_{i-1} || chunk_bytes)`` — a rolling hash, so a chunk only
+matches at the same position after the same history, and a partial
+final chunk (different byte length) can never collide with a full one.
+Content-addressing is sound because K/V at a position is a
+deterministic function of the token prefix alone: same tokens, same
+compiled prefill, bit-identical page bytes.
+
+**Copy-on-write is the ENGINE's move, not ours.** Shared pages are
+immutable history; the first *divergent* write (a generated token
+landing inside a shared page — only possible for a partial final
+chunk) makes the engine allocate a fresh page, device-copy that one
+page, and swap it into the table (``GenerationEngine._unshare_for_
+write``). Prefill re-scatters over matched pages are bit-identical
+rewrites and need no copy.
+
+**LRU warmth.** The cache holds its OWN reference on every published
+page, so a prompt stays warm after its last user retires
+(unreferenced-but-cached). Under allocation pressure the pool's
+reclaimer hook (``PagePool.set_reclaimer``) walks this LRU oldest-first
+and evicts entries whose page only the cache still pins — cold prefix
+pages yield to live traffic before exhaustion ever fires, and entries
+still shared with running tables are never force-freed.
+
+Fault site ``serving.prefix`` (hit at cache build and per match):
+a raise degrades that engine to plain no-sharing private pages for its
+lifetime with a recorded ``prefix_degraded`` event — a memory-economics
+regression, never an outage, and greedy output is bit-identical with
+sharing on or off.
+"""
+from __future__ import annotations
+
+import collections
+import hashlib
+
+from ..resilience import fault_point
+# the shared lock constructor: plain threading primitives normally, the
+# lock-order race detector's instrumented ones under PADDLE_TPU_SANITIZE=locks
+from ..analysis import locks as _locks
+
+__all__ = ["PrefixCache", "chunk_keys"]
+
+
+def chunk_keys(tokens, page_tokens):
+    """Yield ``(key, start, end)`` per page-sized chunk of ``tokens``
+    (the final chunk may be partial). ``key`` is the 16-byte rolling
+    blake2b chain digest — position- and history-dependent."""
+    tokens = list(tokens)
+    T = int(page_tokens)
+    prev = b""
+    for start in range(0, len(tokens), T):
+        chunk = tokens[start:start + T]
+        h = hashlib.blake2b(prev, digest_size=16)
+        h.update(b",".join(b"%d" % int(t) for t in chunk))
+        prev = h.digest()
+        yield prev, start, start + len(chunk)
+
+
+class _Entry(object):
+    __slots__ = ("key", "page", "tokens")
+
+    def __init__(self, key, page, tokens):
+        self.key = key
+        self.page = page       # physical page id (cache holds one ref)
+        self.tokens = tokens   # positions of the page actually covered
+
+
+class PrefixCache(object):
+    """Content-addressed prefix-page cache over ONE :class:`PagePool`.
+
+    Thread-safe; lock order is cache -> pool (``match``/``publish``/
+    ``_reclaim`` take the cache lock then call into the pool), and the
+    pool calls the reclaimer OUTSIDE its own lock, so the order can
+    never invert.
+    """
+
+    def __init__(self, pool, name="model"):
+        fault_point("serving.prefix")
+        self.pool = pool
+        self.name = name
+        self._lock = _locks.make_lock("serving.prefix.cache")
+        # key -> _Entry, in LRU order (oldest first)
+        self._entries = collections.OrderedDict()
+        self._counts = collections.Counter()
+        pool.set_reclaimer(self._reclaim)
+
+    # -- lookup ---------------------------------------------------------------
+    def probe(self, tokens):
+        """How many leading FULL pages of ``tokens`` are cached right
+        now — the admission discount: these pages will be pinned, not
+        allocated, so the reservation shrinks by this many. Partial
+        final chunks are deliberately NOT counted even when cached: the
+        first generated token lands inside that page and copy-on-write
+        buys it back, so discounting it would let admission overdraw
+        the pool by one page per request. No pinning, no LRU touch —
+        a feasibility probe, racing eviction is handled by the
+        admission requeue path."""
+        T = self.pool.page_tokens
+        n = 0
+        with self._lock:
+            for key, start, end in chunk_keys(tokens, T):
+                if end - start < T or key not in self._entries:
+                    break
+                n += 1
+        return n
+
+    def match(self, tokens):
+        """Pin the longest cached page run covering a prefix of
+        ``tokens``: each matched page gets one ``pool.ref`` for the
+        caller's BlockTable (released through the table's normal
+        ``free`` path). Returns ``(pages, covered_tokens)``. Matched
+        entries move to MRU."""
+        fault_point("serving.prefix")
+        pages, covered = [], 0
+        with self._lock:
+            for key, start, end in chunk_keys(tokens, self.pool.page_tokens):
+                entry = self._entries.get(key)
+                if entry is None or entry.tokens != end - start:
+                    break
+                self._entries.move_to_end(key)
+                pages.append(entry.page)
+                covered = end
+            if pages:
+                self.pool.ref(pages)
+                self._counts["hits"] += len(pages)
+                self._counts["hit_requests"] += 1
+            else:
+                self._counts["miss_requests"] += 1
+        return pages, covered
+
+    # -- publish --------------------------------------------------------------
+    def publish(self, tokens, pages):
+        """Register the pages now holding ``tokens`` (page *i* of
+        ``pages`` holds chunk *i*; the final chunk may be partial —
+        partial pages ARE published, that is what makes same-prompt
+        requests share their tail page until copy-on-write diverges
+        them). Already-cached chunks are skipped (and refreshed to
+        MRU); new entries pin one cache reference per page. Returns the
+        number of pages newly published."""
+        published = 0
+        with self._lock:
+            for i, (key, start, end) in enumerate(
+                    chunk_keys(tokens, self.pool.page_tokens)):
+                if i >= len(pages):
+                    break
+                if key in self._entries:
+                    self._entries.move_to_end(key)
+                    continue
+                self.pool.ref([pages[i]])
+                self._entries[key] = _Entry(key, pages[i], end - start)
+                published += 1
+            self._counts["published"] += published
+        return published
+
+    # -- eviction -------------------------------------------------------------
+    def _reclaim(self, n_short):
+        """PagePool pressure hook: evict cold entries — oldest first,
+        only those whose page the cache alone still pins (refcount 1;
+        freeing those actually returns pages) — until ``n_short`` pages
+        came back or the LRU runs dry. Returns pages freed."""
+        freed = 0
+        with self._lock:
+            for key in list(self._entries):
+                if freed >= n_short:
+                    break
+                entry = self._entries[key]
+                if self.pool.refcount(entry.page) != 1:
+                    continue   # a running table still shares it
+                del self._entries[key]
+                self.pool.free([entry.page])
+                freed += 1
+            self._counts["evictions"] += freed
+        return freed
+
+    def reset(self):
+        """Drop every entry and its cache reference but stay
+        registered — the pool-rebuild path (``_ensure_pools``): the
+        arrays were re-zeroed, so cached content is gone and serving a
+        stale entry would splice zero pages into someone's prompt."""
+        with self._lock:
+            for entry in self._entries.values():
+                try:
+                    self.pool.free([entry.page])
+                except ValueError:
+                    pass   # pool accounting was reset under us
+            self._entries.clear()
+
+    def clear(self):
+        """Full teardown (engine close / degrade): :meth:`reset` plus
+        unregister from the pool's pressure hook."""
+        self.reset()
+        self.pool.set_reclaimer(None)
+
+    # -- accounting -----------------------------------------------------------
+    def stats(self):
+        with self._lock:
+            c = dict(self._counts)
+            return {"entries": len(self._entries),
+                    "hits": c.get("hits", 0),
+                    "hit_requests": c.get("hit_requests", 0),
+                    "miss_requests": c.get("miss_requests", 0),
+                    "published": c.get("published", 0),
+                    "evictions": c.get("evictions", 0)}

@@ -91,6 +91,26 @@ up mid-flight instead of at the next timer tick. Rolling reload and
 the autoscaler's membership mutations serialize on the pool's ONE
 ``membership_lock`` — a shrink can never land mid-rollout and a
 rollout can never probe a replica the autoscaler just drained.
+
+**Disaggregated tiers.** Replicas advertise their class through
+``/statz`` (``tier``: ``""``/``prefill``/``decode``, the ``serve
+--tier`` flag); the poller caches it per slot. When the routable fleet
+holds BOTH classes, ``:generate`` becomes the two-hop disaggregated
+path: hop 1 POSTs ``:prefill`` at the least-loaded prefill replica
+(prompt pass only, answers the handoff artifact), hop 2 POSTs
+``:decode`` at the least-loaded decode replica. The inter-tier hop is
+fault site ``serving.ship``: a decode replica dying mid-handoff (or
+the armed fault) records ``handoff_failed`` and RE-PREFILLS by routing
+the original ``:generate`` to the decode tier — slower, bit-identical,
+never lost. A one-tier (or untiered) fleet routes ``:generate``
+single-hop exactly as before. 429 ``kv_pool_exhausted`` answers are
+BACKPRESSURE, not failures: the replica that shed is held out of
+``pick()`` for its own ``retry_after_ms`` hint, so the failover retry
+and subsequent requests go to siblings with actual page inventory
+instead of re-feeding the exhausted pool. ``tier_signal()`` gives the
+per-tier autoscalers their class-correct signal: mean queue depth per
+prefill replica (prefill load arrives as a queue), mean KV page-pool
+occupancy per decode replica (decode capacity IS page inventory).
 """
 from __future__ import annotations
 
@@ -126,7 +146,7 @@ class _ReplicaState(object):
     __slots__ = ("index", "generation", "failures", "ok_streak", "ejected",
                  "statz", "statz_t", "score", "inflight", "routed",
                  "draining", "peak_load", "lat_ewma", "lat_n",
-                 "gray_ejected", "gray_t")
+                 "gray_ejected", "gray_t", "tier", "backoff_until")
 
     def __init__(self, index, generation):
         self.index = index
@@ -145,6 +165,8 @@ class _ReplicaState(object):
         self.lat_n = 0         # proxied answers folded into the EWMA
         self.gray_ejected = False  # ejected on latency, /healthz still 200
         self.gray_t = None     # monotonic time of the gray ejection
+        self.tier = None       # serving class from /statz; None = unknown
+        self.backoff_until = 0.0   # kv_pool_exhausted hold (monotonic)
 
 
 class Router(object):
@@ -382,6 +404,7 @@ class Router(object):
                     st.failures = 0
                     st.statz = statz
                     st.statz_t = time.monotonic()
+                    st.tier = str(statz.get("tier") or "")
                     st.score = self.statz_load(statz)
                     st.peak_load = max(st.peak_load,
                                        st.score + st.inflight)
@@ -645,9 +668,16 @@ class Router(object):
                 self._gray.forget(index)
 
     # -- picking -------------------------------------------------------------
-    def _routable(self, exclude=()):
-        out = []
+    def _routable(self, exclude=(), tier=None):
+        """``tier`` filters to one serving class (None = any, including
+        untiered). A replica holding a ``kv_pool_exhausted`` backoff is
+        skipped — its own Retry-After said when capacity plausibly
+        exists; re-dispatching sooner just re-feeds the exhausted pool
+        — unless EVERY candidate is backing off (a slow answer beats a
+        blanket 503 when the whole class is page-starved)."""
+        out, held = [], []
         reps = self.pool.snapshot()
+        now = time.monotonic()
         with self._lock:
             for rep in reps:
                 if rep.index in exclude or not rep.ready:
@@ -655,13 +685,15 @@ class Router(object):
                 st = self._state_for(rep)
                 if st.ejected or st.draining:
                     continue
-                out.append((rep, st))
-        return out
+                if tier is not None and st.tier != tier:
+                    continue
+                (held if st.backoff_until > now else out).append((rep, st))
+        return out or held
 
-    def pick(self, exclude=()):
+    def pick(self, exclude=(), tier=None):
         """The least-loaded healthy replica (or the next in rotation
         under round_robin); None when nothing is routable."""
-        cands = self._routable(exclude)
+        cands = self._routable(exclude, tier=tier)
         if not cands:
             return None
         if self.policy == "round_robin":
@@ -676,6 +708,69 @@ class Router(object):
             best = min(cands, key=lambda c: (c[1].score + c[1].inflight,
                                              c[1].routed, c[0].index))
         return best[0]
+
+    def tier_signal(self, tier):
+        """The per-tier autoscale signal, class-correct by design:
+        ``prefill`` load arrives as a queue (mean generative backlog +
+        router-tracked inflight per routable prefill replica — prompt
+        passes block the handler, so the router's own outstanding count
+        IS the queue); ``decode`` capacity is page inventory (mean KV
+        page-pool occupancy fraction per routable decode replica). 0.0
+        when the tier has no routable member with a statz snapshot."""
+        vals = []
+        reps = self.pool.snapshot()
+        with self._lock:
+            for rep in reps:
+                st = self._states.get(rep.index)
+                if st is None or not rep.ready or st.ejected \
+                        or st.draining or st.tier != tier:
+                    continue
+                z = st.statz
+                if z is None:
+                    continue
+                gens = z.get("generation") or {}
+                if tier == "prefill":
+                    q = float(z.get("pending", 0)) + st.inflight
+                    for g in gens.values():
+                        q += float(g.get("queued", 0)) \
+                            + float(g.get("running", 0))
+                    vals.append(q)
+                else:
+                    frac = 0.0
+                    for g in gens.values():
+                        pu = g.get("page_utilization", 0.0)
+                        if isinstance(pu, dict):
+                            pu = pu.get("frac", 0.0)
+                        frac = max(frac, float(pu))
+                    vals.append(frac)
+        return round(sum(vals) / len(vals), 4) if vals else 0.0
+
+    def replica_tier(self, index):
+        """The cached serving class of slot ``index`` (None = never
+        polled healthy) — the tiered autoscaler's victim filter."""
+        with self._lock:
+            st = self._states.get(index)
+            return st.tier if st is not None else None
+
+    def _note_backpressure(self, index, payload):
+        """A ``kv_pool_exhausted`` 429 holds its replica out of pick()
+        for the replica's OWN Retry-After hint (capped at 10 s — the
+        poller keeps refreshing real state underneath): honest
+        backpressure, distinct from the eject machinery, which is for
+        replicas answering WRONG."""
+        if not isinstance(payload, dict) or \
+                payload.get("kind") != "kv_pool_exhausted":
+            return
+        try:
+            retry_s = float(payload.get("retry_after_ms") or 0.0) / 1e3
+        except (TypeError, ValueError):
+            retry_s = 0.0
+        hold = min(max(retry_s, self.poll_s), 10.0)
+        with self._lock:
+            st = self._states.get(index)
+            if st is not None:
+                st.backoff_until = time.monotonic() + hold
+        self._count("router_backpressure_holds")
 
     # -- proxying ------------------------------------------------------------
     def retry_after_ms(self):
@@ -781,7 +876,7 @@ class Router(object):
                 return status, payload, widx, extra
         return None, repr(last_err), None, extra
 
-    def proxy(self, path, body, deadline_ms=None):
+    def proxy(self, path, body, deadline_ms=None, tier=None):
         """Route one POST to the best replica with one failover retry.
         Returns (status, body_dict, replica_index_or_None). Transport
         failures and 429/503 answers try the next-best once (the first
@@ -804,7 +899,7 @@ class Router(object):
         pending_failover = None    # failed attempt awaiting a retry target
         self._count("router_requests")
         for attempt in range(2):
-            rep = self.pick(exclude=tried)
+            rep = self.pick(exclude=tried, tier=tier)
             if rep is None:
                 break
             if pending_failover is not None:
@@ -830,6 +925,8 @@ class Router(object):
                                         "error": payload}
                     continue
                 if status in (429, 503):
+                    if status == 429 and widx is not None:
+                        self._note_backpressure(widx, payload)
                     last_answer = (status, payload, widx)
                     pending_failover = {"replica": widx,
                                         "attempt": attempt + 1,
@@ -858,6 +955,8 @@ class Router(object):
                     self._latency_ms.append(lat)
                     del self._latency_ms[:-4096]
                     self._fold_latency(st, lat)
+            if status == 429:
+                self._note_backpressure(rep.index, payload)
             if status in (429, 503) and attempt == 0:
                 # exhaustion is an honest answer, but a sibling may
                 # have room: one retry at the next-best replica
@@ -887,6 +986,117 @@ class Router(object):
                      reason="no_replica", path=path)
         return 503, {"error": "no healthy replica available",
                      "kind": "no_replica"}, None
+
+    def _post_tracked(self, rep, path, body, timeout):
+        """One POST with the full per-replica bookkeeping (inflight,
+        routed, latency EWMA) — the two-hop disagg path's transport.
+        Returns (status, payload); transport failures propagate."""
+        with self._lock:
+            st = self._state_for(rep)
+            st.inflight += 1
+            st.routed += 1
+            st.peak_load = max(st.peak_load, st.score + st.inflight)
+        t0 = time.monotonic()
+        try:
+            status, payload, _ = self._post_json(rep.base_url + path,
+                                                 body, timeout)
+            return status, payload
+        finally:
+            with self._lock:
+                st.inflight -= 1
+                lat = (time.monotonic() - t0) * 1e3
+                self._latency_ms.append(lat)
+                del self._latency_ms[:-4096]
+                self._fold_latency(st, lat)
+
+    def proxy_generate(self, name, body, deadline_ms=None):
+        """Route one ``:generate``. On a fleet whose routable set holds
+        BOTH serving classes this is the disaggregated two-hop —
+        ``:prefill`` at the least-loaded prefill replica, the returned
+        artifact shipped via ``:decode`` to the least-loaded decode
+        replica (fault site ``serving.ship``); anything less tiered
+        falls through to the plain single-hop :meth:`proxy`. Failure
+        semantics mirror :func:`~paddle_tpu.serving.disagg.ship`: a
+        prefill-tier miss or a decode replica dying mid-handoff
+        re-routes the ORIGINAL request to the decode tier, which
+        re-prefills locally — slower, bit-identical, never lost
+        (recorded ``handoff_failed``). Returns (status, body_dict,
+        replica_index_or_None) like :meth:`proxy`."""
+        path = "/v1/models/%s:generate" % name
+        pre = self.pick(tier="prefill")
+        if pre is None or not self._routable(tier="decode"):
+            return self.proxy(path, body, deadline_ms=deadline_ms)
+        deadline_t = None
+        if deadline_ms is not None:
+            deadline_t = time.monotonic() + max(float(deadline_ms) / 1e3,
+                                                0.05)
+
+        def budget():
+            t = self.proxy_timeout_s
+            if deadline_t is not None:
+                t = min(t, max(deadline_t - time.monotonic(), 0.05))
+            return t
+
+        self._count("router_requests")
+        # hop 1: the prompt pass on the prefill tier
+        try:
+            fault_point("serving.route")
+            status, payload = self._post_tracked(
+                pre, "/v1/models/%s:prefill" % name, body, budget())
+        except Exception as e:
+            status, payload = None, {"error": repr(e)}
+        artifact = (payload or {}).get("artifact") \
+            if status == 200 else None
+        if artifact is None:
+            if status == 429:
+                self._note_backpressure(pre.index, payload)
+            # the prefill tier missing its hop must not fail the
+            # request: the decode tier runs it whole, single-hop
+            self._count("router_handoff_fallbacks")
+            return self.proxy(path, body, deadline_ms=deadline_ms,
+                              tier="decode")
+        # hop 2: ship the artifact to the decode tier (one failover)
+        tried = [pre.index]
+        last_answer = None
+        for attempt in range(2):
+            dec = self.pick(exclude=tried, tier="decode")
+            if dec is None:
+                break
+            tried.append(dec.index)
+            try:
+                fault_point("serving.ship")
+                status, payload = self._post_tracked(
+                    dec, "/v1/models/%s:decode" % name,
+                    {"artifact": artifact, "deadline_ms": deadline_ms},
+                    budget())
+            except Exception as e:
+                # the decode replica died mid-handoff: the artifact is
+                # gone with the connection — record it and RE-PREFILL
+                # by routing the original request to the decode tier
+                record_durable_event(
+                    "handoff_failed", site="serving.ship",
+                    state_dir=self.state_dir, model=name,
+                    prefill_replica=pre.index, decode_replica=dec.index,
+                    error=repr(e))
+                self._count("router_handoff_failed")
+                from .. import profiler as _prof
+                _prof.update_generation_counters(gen_handoff_failed=1)
+                return self.proxy(path, body, deadline_ms=deadline_ms,
+                                  tier="decode")
+            if status == 429:
+                self._note_backpressure(dec.index, payload)
+            if status in (429, 503) and attempt == 0:
+                last_answer = (status, payload, dec.index)
+                continue
+            if status == 200:
+                self._count("router_handoffs")
+            return status, payload, dec.index
+        if last_answer is not None:
+            return last_answer
+        self._count("router_no_replica")
+        self._record("request_shed", reason="no_replica", path=path)
+        return 503, {"error": "no routable decode replica for the "
+                              "handoff", "kind": "no_replica"}, None
 
     def models(self):
         """GET /v1/models proxied from the best replica (the fleet is
@@ -1056,6 +1266,9 @@ class Router(object):
                     "url": rep.base_url if rep is not None else None,
                     "ready": bool(rep is not None and rep.ready),
                     "generation": st.generation,
+                    "tier": st.tier,
+                    "backpressure_hold_s": round(
+                        max(st.backoff_until - time.monotonic(), 0.0), 3),
                     "ejected": st.ejected,
                     "gray_ejected": st.gray_ejected,
                     "latency_ewma_ms": (round(st.lat_ewma, 3)
@@ -1075,8 +1288,13 @@ class Router(object):
             pressure = dict(self._pressure)
             pressure_smoothed = dict(self._pressure_ewma)
         routed = [r["routed"] for r in replicas.values()] or [0]
-        autoscale = (self.autoscaler.stats()
-                     if self.autoscaler is not None else None)
+        # one fleet-wide autoscaler, or a LIST of per-tier ones
+        autoscale = None
+        if self.autoscaler is not None:
+            if isinstance(self.autoscaler, (list, tuple)):
+                autoscale = [a.stats() for a in self.autoscaler]
+            else:
+                autoscale = self.autoscaler.stats()
         out = {
             "policy": self.policy,
             "replicas": replicas,
@@ -1092,6 +1310,11 @@ class Router(object):
             "gray_readmits": counts.get("router_gray_readmits", 0),
             "hedges": counts.get("router_hedges", 0),
             "hedge_wins": counts.get("router_hedge_wins", 0),
+            "handoffs": counts.get("router_handoffs", 0),
+            "handoff_failed": counts.get("router_handoff_failed", 0),
+            "handoff_fallbacks": counts.get("router_handoff_fallbacks", 0),
+            "backpressure_holds": counts.get("router_backpressure_holds",
+                                             0),
             "hedge_budget": self.hedge_budget,
             "gray_ratio": self.gray_ratio,
             "reloads": counts.get("router_reloads", 0),
@@ -1174,8 +1397,13 @@ class _RouterHandler(BaseHTTPRequestHandler):
         for verb in (":predict", ":generate"):
             if self.path.startswith("/v1/models/") and \
                     self.path.endswith(verb):
-                status, payload, replica = self.router.proxy(
-                    self.path, body, deadline_ms=deadline_ms)
+                if verb == ":generate":
+                    name = self.path[len("/v1/models/"):-len(verb)]
+                    status, payload, replica = self.router.proxy_generate(
+                        name, body, deadline_ms=deadline_ms)
+                else:
+                    status, payload, replica = self.router.proxy(
+                        self.path, body, deadline_ms=deadline_ms)
                 if replica is not None and isinstance(payload, dict):
                     payload = dict(payload)
                     payload["replica"] = replica

@@ -103,8 +103,19 @@ class InferenceService(object):
     """
 
     def __init__(self, registry=None, max_batch=None, batch_timeout_ms=None,
-                 queue_depth=None):
+                 queue_depth=None, tier=None):
         from ..flags import FLAGS
+        # serving tier class for the disaggregated fleet (FLAGS.
+        # serve_tier): "" = do-everything replica, "prefill"/"decode"
+        # advertise the class through /statz and /healthz so the router
+        # never dispatches a tier to work outside its class. The tier
+        # is a ROUTING contract, not a capability fence — a prefill
+        # replica can still decode (the re-prefill fallback depends on
+        # decode replicas being whole engines).
+        self.tier = str(tier if tier is not None else FLAGS.serve_tier)
+        if self.tier not in ("", "prefill", "decode"):
+            raise ValueError("tier must be '', 'prefill' or 'decode', "
+                             "got %r" % self.tier)
         self.max_batch = int(max_batch if max_batch is not None
                              else FLAGS.serve_max_batch)
         self.batch_timeout_ms = float(
@@ -130,6 +141,12 @@ class InferenceService(object):
             on_batch=self._on_batch, on_fail=self._on_fail)
         self._generators = {}       # name -> GenEntry
         self._gen_versions = {}     # name -> last assigned version int
+        # name -> (gen version, disagg.PrefillEngine): the prefill-tier
+        # face over the SAME model a generative entry serves, built
+        # lazily on the first ``:prefill`` and retired with its entry —
+        # version-keyed so a hot reload never exports KV computed by
+        # the previous weights
+        self._prefill_engines = {}
         # serializes generative load/reload/drop per SERVICE: two racing
         # :reload threads would otherwise both build engines and both
         # retire only the older one — the loser's engine thread and
@@ -236,6 +253,7 @@ class InferenceService(object):
             if prev is not None:
                 prev.engine.drain(timeout=self._DRAIN_TIMEOUT_S)
                 prev.engine.close()
+            self._drop_prefill(name, keep_version=version)
             # a generative artifact replacing a compiled name: retire the
             # stale compiled entry, or it would keep answering :predict
             # with the previous model forever
@@ -262,6 +280,7 @@ class InferenceService(object):
             if prev is not None:
                 prev.engine.drain(timeout=self._DRAIN_TIMEOUT_S)
                 prev.engine.close()
+            self._drop_prefill(name, keep_version=version)
             self.registry.unload(name)
             return entry
 
@@ -282,6 +301,18 @@ class InferenceService(object):
             if entry is not None:
                 entry.engine.drain(timeout=self._DRAIN_TIMEOUT_S)
                 entry.engine.close()
+            self._drop_prefill(name)
+
+    def _drop_prefill(self, name, keep_version=None):
+        """Retire ``name``'s cached prefill engine unless it already
+        matches ``keep_version`` — called on reload/drop so a stale
+        prefill face never outlives the weights it was traced over."""
+        with self._lock:
+            cached = self._prefill_engines.get(name)
+            if cached is None or cached[0] == keep_version:
+                return
+            del self._prefill_engines[name]
+        cached[1].close()
 
     def _gen_entry(self, name):
         with self._lock:
@@ -335,7 +366,13 @@ class InferenceService(object):
         instead of re-feeding the convoy. Floor: one batch-formation
         window. For a generative ``model``, the inter-token p50 times
         the queued depth estimates the engine's drain time and takes
-        the max. Clamped to [1 ms, 30 s]."""
+        the max. A pool-exhausted shed takes a further max against the
+        OBSERVED page-release rate: queued-depth-many sequences each
+        need pages, and pages come back at ``pool.release_rate()``
+        pages/s, so waiting ``(queued+1)/rate`` seconds is when capacity
+        plausibly exists — the batch window would tell an exhausted-pool
+        client to hammer a server that cannot admit anyone. Clamped to
+        [1 ms, 30 s]."""
         with self._lock:
             qw = list(self._queue_wait_ms)
             gen = self._generators.get(model) if model else None
@@ -344,6 +381,9 @@ class InferenceService(object):
             st = gen.engine.stats
             est = max(est,
                       st["intertoken_ms_p50"] * (st["queued"] + 1))
+            rate = st.get("page_release_rate", 0.0)
+            if rate > 0.0:
+                est = max(est, 1000.0 * (st["queued"] + 1) / rate)
         return min(max(est, 1.0), 30000.0)
 
     # -- request path --------------------------------------------------------
@@ -437,6 +477,69 @@ class InferenceService(object):
         req.model_version = entry.version
         return req
 
+    # -- disaggregated tier path ---------------------------------------------
+    def _prefill_for(self, entry):
+        """The cached prefill engine for ``entry``, built on first use
+        over the entry's OWN model object (same weights, same page
+        geometry as the decode pools it will hand off to)."""
+        with self._lock:
+            cached = self._prefill_engines.get(entry.name)
+            if cached is not None and cached[0] == entry.version:
+                return cached[1]
+        from .disagg import PrefillEngine
+        eng = PrefillEngine(entry.engine.model,
+                            page_tokens=entry.engine.pool.page_tokens,
+                            name=entry.name, eos_id=entry.engine.eos_id)
+        with self._lock:
+            cached = self._prefill_engines.get(entry.name)
+            if cached is not None and cached[0] == entry.version:
+                stale = eng          # lost a build race: keep the winner
+                eng = cached[1]
+            else:
+                stale = cached[1] if cached is not None else None
+                self._prefill_engines[entry.name] = (entry.version, eng)
+        if stale is not None:
+            stale.close()
+        return eng
+
+    def prefill(self, name, tokens, max_new_tokens=16, temperature=0.0,
+                seed=0):
+        """Prefill-tier entry point (httpd ``:prefill``): run ONLY the
+        prompt pass on ``name``'s weights and return the
+        :class:`~paddle_tpu.serving.disagg.HandoffArtifact` — finished
+        KV pages + enough request state for any decode-class replica to
+        continue bit-exactly."""
+        entry = self._gen_entry(name)
+        return self._prefill_for(entry).prefill(
+            tokens, max_new_tokens=max_new_tokens,
+            temperature=temperature, seed=seed)
+
+    def decode_handoff_async(self, name, payload, deadline_ms=None):
+        """Decode-tier entry point (httpd ``:decode``): install a
+        shipped artifact (wire payload or HandoffArtifact) into
+        ``name``'s engine via :func:`~paddle_tpu.serving.disagg.ship`
+        and return the request handle. The ship fallback applies — a
+        bad artifact re-prefills HERE rather than failing the request —
+        while overload/pool-exhaustion propagate as backpressure."""
+        from .disagg import HandoffArtifact, ship
+        artifact = (payload if isinstance(payload, HandoffArtifact)
+                    else HandoffArtifact.from_payload(payload))
+        entry = self._gen_entry(name)
+        try:
+            req = ship(artifact, entry.engine, deadline_ms=deadline_ms)
+        except ServingError:
+            # same reload race as generate_async: retry once against
+            # the current entry
+            entry = self._gen_entry(name)
+            req = ship(artifact, entry.engine, deadline_ms=deadline_ms)
+        req.model_version = entry.version
+        return req
+
+    def decode_handoff(self, name, payload, deadline_ms=None, timeout=None):
+        """Blocking :meth:`decode_handoff_async` -> GenResult."""
+        return self.decode_handoff_async(name, payload,
+                                         deadline_ms=deadline_ms).wait(timeout)
+
     def generate(self, name, tokens, max_new_tokens=16, temperature=0.0,
                  seed=0, deadline_ms=None, timeout=None, spec_k=None):
         """Blocking generation -> GenResult (greedy outputs are
@@ -503,14 +606,18 @@ class InferenceService(object):
                 "latency_ms_p50": _percentile(lat, 0.50),
                 "latency_ms_p99": _percentile(lat, 0.99),
                 "models": self.registry.versions(),
+                "tier": self.tier,
             }
             gens = dict(self._generators)
+            pre = {n: v[1] for n, v in self._prefill_engines.items()}
         snap["shed"] = snap["shed_overload"] + snap["shed_deadline"]
         if gens:
             snap["generation"] = {n: e.engine.stats
                                   for n, e in sorted(gens.items())}
             snap["models"].update({n: e.version
                                    for n, e in gens.items()})
+        if pre:
+            snap["prefill"] = {n: e.stats for n, e in sorted(pre.items())}
         return snap
 
     # -- lifecycle -----------------------------------------------------------
@@ -526,6 +633,10 @@ class InferenceService(object):
             with self._lock:
                 gens = list(self._generators.values())
                 self._generators.clear()
+                pre = [v[1] for v in self._prefill_engines.values()]
+                self._prefill_engines.clear()
+        for p in pre:
+            p.close()
         self._batcher.close()
         # same contract as hot reload: in-flight generations finish
         # (bounded) before the engine is torn down, so a SIGTERM

@@ -1,0 +1,627 @@
+"""Copy-on-write prefix sharing + disaggregated prefill/decode suite.
+
+Contracts under test — the PR that makes the page pool, not the
+replica, the serving capacity unit:
+
+- The refcounted allocator's invariants stay LOUD under sharing:
+  double-free, duplicate-within-one-call and foreign-id free/ref all
+  raise; a page returns to the free list only at refcount zero; the
+  physical live set and the effective refcount ledger agree under
+  random alloc/ref/free traffic.
+- A BlockTable trimming or releasing pages it shares with another
+  holder drops only its OWN reference — the other table's cache is
+  untouched.
+- The PrefixCache probes full pages only (the admission discount),
+  pins matched runs, publishes partial tails (so copy-on-write fires
+  on divergence), and its LRU reclaimer evicts only pages the cache
+  alone still pins.
+- Engine-level sharing is invisible to outputs: greedy decode is
+  bit-identical with sharing on and off; >= 4 concurrent same-prefix
+  requests run inside a pool sized BELOW 4x their private footprint;
+  preemption + resume of a request holding shared prefix pages replays
+  bit-exact; the armed ``serving.prefix`` site degrades to private
+  pages with a recorded event, never an outage.
+- Disaggregation: prefill -> ship -> decode reproduces the
+  single-engine output exactly; the handoff artifact survives its wire
+  encoding; a failed hop (armed ``serving.ship``, geometry mismatch)
+  re-prefills on the decode engine — slower, bit-identical, recorded —
+  while decode-side admission backpressure propagates honestly; the
+  Router two-hops :generate across tier-labelled replicas and
+  re-routes when the decode hop dies mid-handoff; the tiered
+  Autoscaler scales each class on ITS signal and never retires the
+  other tier's replicas.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu import resilience
+from paddle_tpu.serving import (BlockTable, GenerationEngine,
+                                HandoffArtifact, InferenceService,
+                                OverloadError, PagePool, PoolExhausted,
+                                PrefillEngine, PrefixCache, Router,
+                                ServingError, StaticPool, make_server,
+                                pages_for, reference_decode, ship)
+from paddle_tpu.models import transformer as tm
+
+VOCAB = 23
+MAX_SEQ = 48
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tm.TransformerConfig(vocab_size=VOCAB, hidden=16, num_layers=2,
+                               num_heads=2, max_seq=MAX_SEQ)
+    return tm.TransformerLM(tm.init_params(cfg, seed=3), cfg)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    resilience.reset()
+    resilience.clear_events()
+    yield
+    resilience.reset()
+
+
+def _pool(**kw):
+    kw.setdefault("num_pages", 12)
+    kw.setdefault("page_tokens", 4)
+    kw.setdefault("num_layers", 1)
+    kw.setdefault("num_heads", 1)
+    kw.setdefault("head_dim", 4)
+    return PagePool(**kw)
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_running", 4)
+    kw.setdefault("kv_pages", 64)
+    kw.setdefault("page_tokens", 8)
+    kw.setdefault("queue_depth", 64)
+    kw.setdefault("warm", False)
+    return GenerationEngine(model, **kw)
+
+
+# -- refcounted allocator invariants ------------------------------------------
+
+def test_refcount_pin_and_release_cycle():
+    pool = _pool()
+    pages = pool.alloc(2)
+    assert all(pool.refcount(p) == 1 for p in pages)
+    pool.ref(pages)                       # second holder pins
+    assert all(pool.refcount(p) == 2 for p in pages)
+    assert pool.is_shared(pages[0])
+    pool.free(pages)                      # drops to 1: still live
+    assert pool.live == 2 and pool.available == 10
+    pool.free(pages)                      # zero: physically reclaimed
+    assert pool.live == 0 and pool.available == 12
+
+
+def test_double_free_stays_loud():
+    pool = _pool()
+    (p,) = pool.alloc(1)
+    pool.free([p])
+    with pytest.raises(ValueError):
+        pool.free([p])
+
+
+def test_duplicate_free_within_one_call_stays_loud():
+    # one HOLDER never legitimately frees a page twice in one release;
+    # counting it twice would silently eat another holder's reference
+    pool = _pool()
+    (p,) = pool.alloc(1)
+    pool.ref([p])
+    with pytest.raises(ValueError):
+        pool.free([p, p])
+    assert pool.refcount(p) == 2          # the refused call ate NOTHING
+
+
+def test_foreign_free_and_foreign_ref_stay_loud():
+    pool = _pool()
+    pool.alloc(1)
+    with pytest.raises(ValueError):
+        pool.free([999])
+    with pytest.raises(ValueError):
+        pool.ref([999])                   # resurrecting garbage as shared
+
+
+def test_refcount_ledger_matches_holders_under_random_traffic():
+    # property test: random alloc/ref/free traffic; at every step the
+    # effective refcount sum equals the holders' page count and the
+    # physical live set equals their union
+    rng = np.random.RandomState(7)
+    pool = _pool(num_pages=16)
+    holders = []                          # each list is freed exactly once
+    for _ in range(400):
+        op = rng.randint(3)
+        if op == 0 and pool.available:
+            holders.append(pool.alloc(rng.randint(1, pool.available + 1)))
+        elif op == 1 and holders:
+            src = holders[rng.randint(len(holders))]
+            pool.ref(src)
+            holders.append(list(src))
+        elif holders:
+            pool.free(holders.pop(rng.randint(len(holders))))
+        assert pool.effective == sum(len(h) for h in holders)
+        union = set().union(*map(set, holders)) if holders else set()
+        assert pool.live == len(union)
+    for h in holders:
+        pool.free(h)
+    assert pool.live == 0 and pool.effective == 0
+
+
+def test_trim_on_shared_page_frees_only_own_reference():
+    pool = _pool(num_pages=8)
+    a = BlockTable(pool)
+    a.ensure(8)                           # 2 pages
+    pool.ref(a.pages)                     # b shares a's pages (a prefix pin)
+    b = BlockTable(pool, pages=list(a.pages), length=8)
+    assert b.trim(4) == 1                 # b's tail REFERENCE dropped...
+    assert [pool.refcount(p) for p in a.pages] == [2, 1]
+    assert pool.live == 2                 # ...but nothing physically freed
+    b.release()
+    assert pool.live == 2                 # a still holds both
+    a.release()
+    assert pool.live == 0
+
+
+# -- the prefix cache ---------------------------------------------------------
+
+def test_prefix_probe_match_publish_roundtrip():
+    pool = _pool(num_pages=8)
+    cache = PrefixCache(pool, name="t")
+    toks = list(range(10))                # 2 full pages + a 2-token tail
+    t = BlockTable(pool)
+    t.ensure(10)
+    assert cache.publish(toks, t.pages) == 3   # partial tail IS published
+    assert cache.probe(toks) == 2              # probe counts FULL pages only
+    pages, covered = cache.match(toks)
+    assert pages == t.pages and covered == 10
+    # each matched page now pins: table + cache + the match
+    assert all(pool.refcount(p) == 3 for p in pages)
+    st = cache.stats()
+    assert st["hits"] == 3 and st["hit_requests"] == 1
+    pool.free(pages)                      # the match's pins
+    t.release()
+    assert pool.live == 3                 # cache alone keeps them warm
+
+
+def test_prefix_chain_hash_is_history_dependent():
+    # same third chunk after a different second chunk must NOT match:
+    # the rolling digest chains, so a page's key encodes its history
+    pool = _pool(num_pages=8)
+    cache = PrefixCache(pool, name="t")
+    a = [1, 2, 3, 4, 5, 6, 7, 8]
+    t = BlockTable(pool)
+    t.ensure(8)
+    cache.publish(a, t.pages)
+    assert cache.probe([1, 2, 3, 4, 5, 6, 7, 8]) == 2
+    assert cache.probe([9, 9, 9, 9, 5, 6, 7, 8]) == 0
+
+
+def test_prefix_lru_reclaims_only_unshared_pages():
+    pool = _pool(num_pages=4)
+    cache = PrefixCache(pool, name="t")
+    a = BlockTable(pool)
+    a.ensure(8)
+    cache.publish([1, 2, 3, 4, 5, 6, 7, 8], a.pages)
+    b = BlockTable(pool)
+    b.ensure(8)
+    cache.publish([9, 10, 11, 12, 13, 14, 15, 16], b.pages)
+    a.release()                           # cache alone pins a's pages
+    got = pool.alloc(2)                   # full pool: pressure hook fires
+    assert len(got) == 2
+    assert cache.stats()["evictions"] == 2
+    # b's entries survived — its pages are still shared with b's table
+    assert cache.probe([9, 10, 11, 12, 13, 14, 15, 16]) == 2
+    assert cache.probe([1, 2, 3, 4, 5, 6, 7, 8]) == 0
+
+
+# -- engine-level sharing -----------------------------------------------------
+
+def test_sharing_bit_identical_and_counters(model):
+    base = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16]
+    prompts = [base + [t] for t in (17, 18, 19, 20)]
+    want = [reference_decode(model, p, 6) for p in prompts]
+    with _engine(model, prefix_sharing=True, name="share") as eng:
+        handles = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        got = [h.wait(timeout=300).tokens for h in handles]
+        st = eng.stats
+    assert got == want                    # bit-identical to unshared decode
+    assert st["prefix_sharing"] and not st["prefix_degraded"]
+    assert st["prefix_hits"] > 0          # later requests pinned warm pages
+    assert st["prefix_published"] > 0
+
+
+def test_cow_diverges_shared_tail_correctly(model):
+    # two requests share a prompt whose tail page is PARTIAL: the first
+    # generated token writes into the shared page, so copy-on-write must
+    # split it — outputs stay bit-exact and the copy is counted
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3]        # 10 tokens, T=8
+    want = reference_decode(model, prompt, 6)
+    with _engine(model, prefix_sharing=True, name="cow") as eng:
+        first = eng.generate(prompt, max_new_tokens=6, timeout=300)
+        second = eng.generate(prompt, max_new_tokens=6, timeout=300)
+        st = eng.stats
+    assert first.tokens == want and second.tokens == want
+    assert st["cow_copies"] >= 1
+
+
+def test_four_same_prefix_requests_below_4x_private_footprint(model):
+    # acceptance: private footprint is pages_for(32 + 8) = 5 pages each,
+    # 4x = 20; the pool holds 12. Warm the cache once, then 4 concurrent
+    # same-prefix requests must all run simultaneously and bit-exactly.
+    prefix = list(range(1, 17)) + list(range(1, 17))   # 32 tokens = 4 pages
+    assert pages_for(32 + 8, 8) * 4 == 20
+    with _engine(model, prefix_sharing=True, kv_pages=12, max_running=4,
+                 name="fleet") as eng:
+        warm = eng.generate(prefix, max_new_tokens=8, timeout=300)
+        assert warm.tokens == reference_decode(model, prefix, 8)
+        handles = [eng.submit(prefix, max_new_tokens=8) for _ in range(4)]
+        got = [h.wait(timeout=300).tokens for h in handles]
+        st = eng.stats
+    assert got == [reference_decode(model, prefix, 8)] * 4
+    assert st["max_running_seen"] >= 4    # genuinely concurrent
+    assert st["prefix_hit_requests"] >= 4
+    assert st["shed"] == 0 == st["failed"]
+
+
+def test_preempt_resume_with_shared_prefix_is_bit_exact(model):
+    # prompt-only reservation + a pool too small for both sequences to
+    # finish: one preempts (recompute-on-resume) while both share the
+    # first prompt page — the preempted release must not corrupt the
+    # survivor's shared page, and both outputs stay reference-exact
+    prompts = [[1, 2, 3, 4, 5, 6], [1, 2, 3, 4, 9, 10]]
+    with _engine(model, prefix_sharing=True, max_running=2, kv_pages=5,
+                 page_tokens=4, reserve="prompt", name="pre") as eng:
+        handles = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        got = [h.wait(timeout=300) for h in handles]
+        st = eng.stats
+    for g, p in zip(got, prompts):
+        assert g.tokens == reference_decode(model, p, 8)
+    assert st["preemptions"] >= 1
+    assert st["completed"] == 2
+
+
+def test_armed_prefix_site_degrades_to_private_pages(model):
+    # a raise at serving.prefix during the cache BUILD degrades the
+    # engine to plain private pages: recorded, still serving, bit-exact
+    resilience.faults.arm("serving.prefix", "raise", nth=1, times=1)
+    with _engine(model, prefix_sharing=True, name="deg") as eng:
+        res = eng.generate([1, 2, 3, 4, 5], max_new_tokens=6, timeout=300)
+        st = eng.stats
+    assert res.tokens == reference_decode(model, [1, 2, 3, 4, 5], 6)
+    assert st["prefix_degraded"] and not st["prefix_sharing"]
+    evs = resilience.events(kind="prefix_degraded", site="serving.prefix")
+    assert evs and evs[0]["phase"] == "build"
+
+
+def test_armed_prefix_match_degrades_midstream(model):
+    # the site armed AFTER build fires inside match(): the engine drops
+    # sharing engine-wide, the request just prefills privately
+    with _engine(model, prefix_sharing=True, name="deg2") as eng:
+        eng.generate([1, 2, 3, 4, 5, 6, 7, 8], max_new_tokens=4,
+                     timeout=300)
+        resilience.faults.arm("serving.prefix", "raise", nth=1, times=1)
+        res = eng.generate([1, 2, 3, 4, 5, 6, 7, 8], max_new_tokens=4,
+                           timeout=300)
+        st = eng.stats
+    assert res.tokens == reference_decode(model, [1, 2, 3, 4, 5, 6, 7, 8], 4)
+    assert st["prefix_degraded"]
+    assert resilience.events(kind="prefix_degraded")
+
+
+# -- disaggregated prefill/decode ---------------------------------------------
+
+def test_prefill_ship_decode_matches_single_engine(model):
+    prompt = [5, 7, 11, 2, 9, 4, 8, 6]
+    want = reference_decode(model, prompt, 6)
+    pre = PrefillEngine(model, page_tokens=8, name="pre")
+    try:
+        art = pre.prefill(prompt, max_new_tokens=6)
+        assert art.pages == pages_for(len(prompt), 8)
+        assert pre.pool.live == 0         # export freed the transient pages
+        with _engine(model, name="dec") as dec:
+            res = ship(art, dec).wait(timeout=300)
+            st = dec.stats
+        assert res.tokens == want
+        assert st["handoff_installs"] == 1
+        assert st["prefills"] == 0        # the decode tier never prefilled
+    finally:
+        pre.close()
+    assert resilience.events(kind="handoff_failed") == []
+
+
+def test_handoff_artifact_survives_wire_encoding(model):
+    pre = PrefillEngine(model, page_tokens=8, name="pre")
+    try:
+        art = pre.prefill([5, 7, 11, 2, 9], max_new_tokens=6, seed=11,
+                          temperature=0.7)
+        back = HandoffArtifact.from_payload(art.to_payload())
+    finally:
+        pre.close()
+    assert back.prompt == art.prompt
+    assert back.first_token == art.first_token
+    assert back.seed == 11 and back.temperature == 0.7
+    np.testing.assert_array_equal(back.k_pages, art.k_pages)
+    np.testing.assert_array_equal(back.v_pages, art.v_pages)
+    with pytest.raises(ValueError):
+        HandoffArtifact.from_payload({"prompt": [1]})   # malformed -> 400
+
+
+def test_armed_ship_reprefills_on_decode_engine(model):
+    prompt = [5, 7, 11, 2, 9, 4, 8, 6]
+    want = reference_decode(model, prompt, 6)
+    pre = PrefillEngine(model, page_tokens=8, name="pre")
+    try:
+        art = pre.prefill(prompt, max_new_tokens=6)
+        resilience.faults.arm("serving.ship", "raise", nth=1, times=1)
+        with _engine(model, name="dec") as dec:
+            res = ship(art, dec).wait(timeout=300)
+            st = dec.stats
+    finally:
+        pre.close()
+    assert res.tokens == want             # slower, bit-identical, never lost
+    assert st["handoff_installs"] == 0 and st["prefills"] == 1
+    evs = resilience.events(kind="handoff_failed", site="serving.ship")
+    assert len(evs) == 1
+
+
+def test_geometry_mismatch_reprefills_not_fails(model):
+    # a version-split fleet: prefill tier on page_tokens=4, decode on 8.
+    # submit_prefilled refuses the artifact; ship treats it as a hop
+    # failure and re-prefills — the request still completes bit-exactly
+    prompt = [5, 7, 11, 2, 9]
+    pre = PrefillEngine(model, page_tokens=4, name="pre")
+    try:
+        art = pre.prefill(prompt, max_new_tokens=6)
+        with _engine(model, page_tokens=8, name="dec") as dec:
+            with pytest.raises(ServingError):
+                dec.submit_prefilled(art)
+            res = ship(art, dec).wait(timeout=300)
+    finally:
+        pre.close()
+    assert res.tokens == reference_decode(model, prompt, 6)
+    assert resilience.events(kind="handoff_failed", site="serving.ship")
+
+
+def test_ship_propagates_decode_backpressure(model):
+    # decode-side admission overload is honest backpressure, NOT a hop
+    # failure: re-prefilling into a full queue would just burn a second
+    # prefill to hit the same wall
+    pre = PrefillEngine(model, page_tokens=8, name="pre")
+    try:
+        art = pre.prefill([5, 7, 11], max_new_tokens=4)
+
+        class _Full(object):
+            name = "dec"
+
+            def submit_prefilled(self, artifact, deadline_ms=None):
+                raise OverloadError("queue full")
+
+        with pytest.raises(OverloadError):
+            ship(art, _Full())
+    finally:
+        pre.close()
+    assert resilience.events(kind="handoff_failed") == []
+
+
+# -- the two-tier fleet behind one Router -------------------------------------
+
+class _TierReplica(object):
+    """A real tier-labelled serving stack on a local port."""
+
+    def __init__(self, model, tier, **engine_kw):
+        engine_kw.setdefault("max_running", 4)
+        engine_kw.setdefault("kv_pages", 64)
+        engine_kw.setdefault("page_tokens", 8)
+        engine_kw.setdefault("warm", False)
+        self.svc = InferenceService(tier=tier)
+        self.svc.register_generative("m", model, **engine_kw)
+        self.server = make_server(self.svc)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever, daemon=True,
+                         kwargs={"poll_interval": 0.05}).start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+        self.svc.close()
+
+
+def test_router_two_hop_and_mid_handoff_death(model):
+    """Acceptance: a :generate entering the router is prefilled on the
+    prefill-class replica and decoded on the decode-class replica with
+    single-replica output; a hop-2 death re-prefills on the decode tier
+    with a recorded handoff_failed, never a failed request."""
+    pre = _TierReplica(model, "prefill")
+    dec = _TierReplica(model, "decode")
+    router = Router(StaticPool(["127.0.0.1:%d" % pre.port,
+                                "127.0.0.1:%d" % dec.port]), poll_ms=100)
+    try:
+        router.poll_once()
+        assert router.replica_tier(0) == "prefill"
+        assert router.replica_tier(1) == "decode"
+        prompt = [5, 7, 11, 2, 9, 4, 8, 6]
+        want = reference_decode(model, prompt, 6)
+        status, payload, rep = router.proxy_generate(
+            "m", {"tokens": prompt, "max_new_tokens": 6})
+        assert status == 200 and payload["tokens"] == want
+        assert rep == 1                   # decoded on the decode tier
+        st = router.stats()
+        assert st["handoffs"] == 1 and st["handoff_failed"] == 0
+        pre_stats = pre.svc.stats["prefill"]["m"]
+        assert pre_stats["prefills"] == 1          # hop 1 really prefilled
+        dec_eng = dec.svc.stats["generation"]["m"]
+        assert dec_eng["handoff_installs"] == 1    # hop 2 installed pages
+
+        # hop 2 dies mid-handoff (the armed inter-tier site): the router
+        # re-routes the ORIGINAL request to the decode tier (re-prefill)
+        resilience.faults.arm("serving.ship", "raise", nth=1, times=1)
+        status, payload, rep = router.proxy_generate(
+            "m", {"tokens": prompt, "max_new_tokens": 6})
+        assert status == 200 and payload["tokens"] == want
+        evs = resilience.events(kind="handoff_failed", site="serving.ship")
+        assert len(evs) == 1
+        assert router.stats()["handoff_failed"] == 1
+        # idle fleet: both class signals are quiet
+        assert router.tier_signal("prefill") == 0.0
+        assert router.tier_signal("decode") <= 1.0
+    finally:
+        router.close()
+        pre.close()
+        dec.close()
+
+
+# -- tiered autoscale (scripted fakes, injected clock) ------------------------
+
+class _Clock(object):
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+class _Slot(object):
+    def __init__(self, index):
+        self.index = index
+        self.generation = 0
+        self.ready = True
+        self.alive = True
+        self.lost = False
+        self.retired = False
+
+
+class _TierPool(object):
+    def __init__(self, n):
+        self.membership_lock = threading.RLock()
+        self.slots = {i: _Slot(i) for i in range(n)}
+        self.grown = []       # (index, extra_args)
+        self.shrunk = []
+
+    def snapshot(self):
+        return [s for s in self.slots.values()
+                if not s.lost and not s.retired]
+
+    def grow(self, extra_args=None):
+        idx = max(self.slots) + 1 if self.slots else 0
+        self.slots[idx] = _Slot(idx)
+        self.grown.append((idx, list(extra_args or [])))
+        return self.slots[idx]
+
+    def shrink(self, index, grace_sec=None):
+        self.slots[index].retired = True
+        self.shrunk.append(index)
+        return 0
+
+    def slot_info(self, index):
+        s = self.slots.get(index)
+        if s is None:
+            return {"exists": False, "generation": None, "alive": False,
+                    "ready": False, "lost": False, "retired": True}
+        return {"exists": True, "generation": s.generation,
+                "alive": s.alive, "ready": s.ready, "lost": s.lost,
+                "retired": s.retired}
+
+
+class _TierRouter(object):
+    poll_s = 0.01
+
+    def __init__(self, tiers):
+        self.tiers = dict(tiers)          # index -> class
+        self.signals = {"prefill": 0.0, "decode": 0.0}
+        self.draining = []
+        self.forgot = []
+
+    def tier_signal(self, tier):
+        return self.signals[tier]
+
+    def replica_tier(self, index):
+        return self.tiers.get(index, "")
+
+    def pressure_smoothed(self):
+        return {}
+
+    def set_draining(self, index, value):
+        self.draining.append((index, bool(value)))
+        return True
+
+    def replica_inflight(self, index):
+        return 0
+
+    def forget(self, index):
+        self.forgot.append(index)
+
+    def notify_membership(self):
+        pass
+
+
+def _tiered(router, pool, tier, **kw):
+    from paddle_tpu.serving import Autoscaler
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 3)
+    kw.setdefault("k_up", 2)
+    kw.setdefault("quiet_polls", 3)
+    kw.setdefault("cooldown_s", 5.0)
+    kw.setdefault("down_cooldown_s", 10.0)
+    kw.setdefault("poll_s", 1.0)
+    kw.setdefault("warmup_s", 30.0)
+    kw.setdefault("drain_deadline_s", 1.0)
+    clock = kw.pop("clock")
+    return Autoscaler(router, pool, tier=tier, clock=clock,
+                      sleep=clock.advance, **kw)
+
+
+def test_tiered_scaleup_reads_class_correct_signal():
+    """The prefill controller reacts to prefill queue depth and grows a
+    prefill-classed replica; the decode controller sees ITS calm signal
+    and does nothing — each tier has its own scaling law."""
+    clock = _Clock()
+    pool = _TierPool(n=2)
+    router = _TierRouter({0: "prefill", 1: "decode"})
+    a_pre = _tiered(router, pool, "prefill", clock=clock,
+                    up_pressure=4.0, down_pressure=1.0)
+    a_dec = _tiered(router, pool, "decode", clock=clock,
+                    up_pressure=0.8, down_pressure=0.2)
+    router.signals["prefill"] = 9.0       # deep prefill queue, calm pools
+    for _ in range(3):
+        clock.advance(1.0)
+        a_pre.tick()
+        a_dec.tick()
+    assert pool.grown == [(2, ["--tier", "prefill"])]
+    router.tiers[2] = "prefill"
+    ups = resilience.events(kind="autoscale_up")
+    assert len(ups) == 1 and ups[0]["pressure"] == 9.0
+
+
+def test_tiered_scaledown_never_retires_other_class():
+    """A decode controller at its floor-of-idle retires only decode
+    replicas — the highest-index PREFILL replica is never its victim."""
+    clock = _Clock()
+    pool = _TierPool(n=4)                 # 0,1 decode; 2,3 prefill
+    router = _TierRouter({0: "decode", 1: "decode",
+                          2: "prefill", 3: "prefill"})
+    a = _tiered(router, pool, "decode", clock=clock,
+                up_pressure=0.8, down_pressure=0.2, cooldown_s=0.0)
+    router.signals["decode"] = 0.0        # idle page pools
+    for _ in range(6):
+        clock.advance(1.0)
+        a.tick()
+    assert pool.shrunk == [1]             # the highest-index DECODE replica
+    assert router.tiers[pool.shrunk[0]] == "decode"
+    downs = resilience.events(kind="autoscale_down")
+    assert len(downs) == 1
+
+
+def test_tiered_active_counts_own_class_only():
+    clock = _Clock()
+    pool = _TierPool(n=5)
+    router = _TierRouter({0: "prefill", 1: "decode", 2: "decode",
+                          3: "decode", 4: "prefill"})
+    a = _tiered(router, pool, "decode", clock=clock,
+                up_pressure=0.8, down_pressure=0.2)
+    assert a._active() == 3

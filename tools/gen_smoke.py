@@ -24,7 +24,16 @@ zero host logit syncs through exactly one propose + one verify trace,
 is no slower than the plain fused engine on paired interleaved waves
 (self-draft makes the ratio pure dispatch amortization), and an armed
 ``serving.speculate`` fault degrades to plain fused decode with a
-recorded ``speculation_degraded`` event and unchanged output.
+recorded ``speculation_degraded`` event and unchanged output, and (g) hold the
+prefix-sharing contract: a same-prefix wave admits PAST the private
+per-request footprint (the whole wave concurrent in a pool the unshared
+engine serializes against), stays token-identical to the unshared
+engine, and reports sharing counters > 0, and (h) hold the
+disaggregation contract: prefill-class -> ship -> decode-class output
+is token-identical to a single-engine decode, the decode tier installs
+shipped pages instead of re-prefilling, the prefill tier's residency is
+transient, and an armed ``serving.ship`` hop re-prefills on the decode
+tier with a recorded ``handoff_failed`` event and zero lost requests.
 
 The measurement itself lives in benchmark/gen_bench.py — ONE
 implementation shared by this gate and the evidence record, so the
@@ -113,9 +122,58 @@ def _spec_degrade_leg():
     }
 
 
+def _disagg_leg():
+    """Prefill-class -> ship -> decode-class round trip: output must be
+    token-identical to a single-engine decode of the same prompt, the
+    decode tier must install the shipped pages (no local prefill), the
+    prefill tier's pool residency must be transient (zero after export),
+    and an armed ``serving.ship`` hop must re-prefill on the decode tier
+    — slower, recorded ``handoff_failed``, never lost."""
+    from paddle_tpu import resilience
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.serving import (GenerationEngine, PrefillEngine,
+                                    reference_decode, ship)
+    from benchmark.gen_bench import build_model
+
+    model = build_model(max_seq=64, seed=2)
+    resilience.clear_events()
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    want = reference_decode(model, prompt, 8)
+    pre = PrefillEngine(model, page_tokens=4, name="pre")
+    dec = GenerationEngine(model, max_running=2, kv_pages=20,
+                           page_tokens=4, warm=True, name="dec")
+    out = {}
+    try:
+        art = pre.prefill(prompt, max_new_tokens=8)
+        res = ship(art, dec).wait(timeout=300)
+        st = dec.stats
+        out["round_trip_ok"] = res.tokens == want
+        out["handoff_installs"] = st["handoff_installs"]
+        out["decode_prefills"] = st["prefills"]
+        out["prefill_residency_zero"] = pre.pool.live == 0
+        # armed hop: the ship fails, the decode tier re-prefills the
+        # original prompt — bit-identical, never lost
+        faults.arm("serving.ship", "raise", nth=1, times=1)
+        try:
+            art2 = pre.prefill(prompt, max_new_tokens=8)
+            res2 = ship(art2, dec).wait(timeout=300)
+        finally:
+            faults.disarm("serving.ship")
+        st2 = dec.stats
+        out["reprefill_ok"] = res2.tokens == want
+        out["reprefill_prefills"] = st2["prefills"]
+        out["failed"] = st2["failed"]
+    finally:
+        pre.close()
+        dec.close()
+    out["handoff_failed_events"] = len(
+        resilience.events(kind="handoff_failed"))
+    return out
+
+
 def main():
     from benchmark.gen_bench import (bench, bench_exhaustion, bench_fused,
-                                     bench_speculative)
+                                     bench_prefix, bench_speculative)
 
     summary = bench(requests=REQUESTS, max_new=MAX_NEW,
                     max_running=MAX_RUNNING, waves=WAVES)
@@ -131,6 +189,10 @@ def main():
     summary["sample_degrade"] = deg
     sdeg = _spec_degrade_leg()
     summary["speculate_degrade"] = sdeg
+    px = bench_prefix()
+    summary["prefix"] = px
+    dis = _disagg_leg()
+    summary["disagg"] = dis
 
     failures = []
     if not summary["bit_exact"]:
@@ -217,6 +279,49 @@ def main():
     if sdeg["events"] < 1:
         failures.append("serving.speculate degrade left no recorded "
                         "speculation_degraded event")
+    if not px["bit_exact"]:
+        failures.append("prefix sharing changed greedy output "
+                        "(the CoW rule is broken): %r" % px)
+    if px["admission_shared_max_running_seen"] < px["requests"]:
+        failures.append(
+            "shared engine admitted only %d of %d same-prefix requests "
+            "concurrently in the tight pool (gate: the whole wave — "
+            "admission must reserve effective, dedup-aware tokens)"
+            % (px["admission_shared_max_running_seen"], px["requests"]))
+    if px["admission_shared_max_running_seen"] <= \
+            px["admission_private_max_running_seen"]:
+        failures.append(
+            "sharing bought no admission headroom (shared %d vs "
+            "private %d concurrent in a %d-page pool)"
+            % (px["admission_shared_max_running_seen"],
+               px["admission_private_max_running_seen"],
+               px["tight_kv_pages"]))
+    if px["admission_shared_shed"] or px["admission_private_shed"]:
+        failures.append("the same-prefix wave shed requests: %r" % px)
+    if not (px["prefix_hits"] > 0 and px["prefix_hit_requests"] > 0):
+        failures.append("prefix sharing reported zero hits over a "
+                        "same-prefix wave (the cache is dead): %r" % px)
+    if not dis["round_trip_ok"]:
+        failures.append("prefill->ship->decode output drifted from the "
+                        "single-engine decode: %r" % dis)
+    if dis["handoff_installs"] < 1 or dis["decode_prefills"] != 0:
+        failures.append(
+            "decode tier did not install the shipped pages (installs "
+            "%d, local prefills %d — the handoff ran as a re-prefill)"
+            % (dis["handoff_installs"], dis["decode_prefills"]))
+    if not dis["prefill_residency_zero"]:
+        failures.append("prefill tier held pages after export "
+                        "(residency must be transient)")
+    if not dis["reprefill_ok"] or dis["reprefill_prefills"] < 1:
+        failures.append("armed serving.ship did not re-prefill "
+                        "bit-identically on the decode tier: %r" % dis)
+    if dis["handoff_failed_events"] < 1:
+        failures.append("failed handoff left no recorded "
+                        "handoff_failed event")
+    if dis["failed"]:
+        failures.append("the tier split lost %d requests (gate: a "
+                        "failed hop degrades, never loses)"
+                        % dis["failed"])
     summary["ok"] = not failures
     print(json.dumps(summary))
     if failures:
